@@ -1,0 +1,29 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle (reference: /root/reference, surveyed in SURVEY.md).
+
+Built on JAX/XLA: the imperative Tensor/Layer/Optimizer surface mirrors the
+reference's dygraph API (python/paddle/*), while compute lowers through XLA to
+the MXU and distribution rides jax.sharding meshes + XLA collectives instead
+of ProcessGroup/NCCL.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from ._core import dtype as _dtype_mod
+from ._core.dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+bool = bool_  # paddle.bool
+
+from ._core.tensor import Tensor, to_tensor  # noqa: F401,E402
+from ._core.autograd import (  # noqa: F401,E402
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+)
+from ._core.flags import set_flags, get_flags  # noqa: F401,E402
+from ._core.random import seed, get_rng_state, set_rng_state  # noqa: F401,E402
+
+from .ops import *  # noqa: F401,F403,E402
+from . import ops  # noqa: E402

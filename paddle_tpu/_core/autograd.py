@@ -1,0 +1,340 @@
+"""Eager autograd engine on a functional substrate.
+
+TPU-native re-design of the reference's eager autograd
+(reference: paddle/fluid/eager/ — GradNodeBase grad_node_info.h:197,
+RunBackward backward.cc:105, Backward backward.cc:439,
+GradNodeAccumulation accumulation/).
+
+Instead of generated per-op GradNode classes, every traced-through op records
+one tape ``Node`` holding the ``jax.vjp`` closure of its primitive function.
+``backward()`` walks the tape in reverse topological order, exactly like the
+reference's BFS over GradNodeBase, and accumulates ``.grad`` on leaf tensors
+(the reference's GradNodeAccumulation).
+
+The tape is pure Python bookkeeping — it works identically on concrete
+``jax.Array`` values (eager/dygraph mode) and on tracers (inside ``jax.jit``),
+so the same imperative code is jit-able.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool) -> bool:
+    old = is_grad_enabled()
+    _state.grad_enabled = v
+    return old
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording
+    (reference: python/paddle/base/dygraph/base.py no_grad_)."""
+
+    def __enter__(self):
+        self._old = _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._old)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._old = _set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._old)
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    class _Guard:
+        def __init__(self):
+            self._old = _set_grad_enabled(mode)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _set_grad_enabled(self._old)
+            return False
+
+    return _Guard()
+
+
+class InputRef:
+    """Edge of the tape graph: which tensor an input grad routes to, and the
+    node that produced that tensor *at record time*. Snapshotting the
+    producer here (instead of reading ``tensor._node`` at backward time)
+    makes in-place rebinding of tensors safe: the graph is over value
+    history, not object identity. jax arrays are immutable, so saved
+    activations can never be corrupted by in-place ops — unlike the
+    reference, which needs an inplace-version guard
+    (paddle/fluid/eager/utils.h)."""
+
+    __slots__ = ("tensor", "node", "out_index")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.node = tensor._node
+        self.out_index = tensor._out_index
+
+
+class Node:
+    """One recorded op on the tape (analog of GradNodeBase,
+    reference: paddle/fluid/eager/grad_node_info.h:197)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "out_is_seq", "name",
+                 "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_meta, out_is_seq, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = [InputRef(t) for t in inputs]
+        self.out_meta = out_meta  # list of (shape, dtype) per differentiable output
+        self.out_is_seq = out_is_seq  # fn returned a tuple/list (cotangent structure)
+        self.name = name
+
+
+def _is_diff_dtype(d) -> bool:
+    return dtypes.is_floating_point(d) or dtypes.is_complex(d)
+
+
+def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
+    """Run primitive ``fn`` over raw values of ``args`` and record a tape node.
+
+    ``args`` may mix Tensors and raw values; only float/complex Tensors with
+    ``stop_gradient=False`` are differentiated. Returns Tensor (or tuple of
+    Tensors if ``fn`` returns a tuple/list or ``multi_out``).
+    """
+    from .tensor import Tensor  # local import to break the cycle
+
+    raw: List[Any] = []
+    tensors: List[Tuple[int, Tensor]] = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            raw.append(a._value)
+            tensors.append((i, a))
+        else:
+            raw.append(a)
+
+    track = is_grad_enabled() and any(
+        (not t.stop_gradient) and _is_diff_dtype(t.dtype) for _, t in tensors)
+
+    if not track:
+        out = fn(*raw)
+        return _wrap_outputs(out, node=None, stop_gradient=True,
+                             multi_out=multi_out)
+
+    diff = [(i, t) for i, t in tensors
+            if (not t.stop_gradient) and _is_diff_dtype(t.dtype)]
+    diff_idx = [i for i, _ in diff]
+    diff_tensors = [t for _, t in diff]
+
+    def f(*diff_vals):
+        vals = list(raw)
+        for j, i in enumerate(diff_idx):
+            vals[i] = diff_vals[j]
+        return fn(*vals)
+
+    out_vals, vjp_fn = jax.vjp(f, *[raw[i] for i in diff_idx])
+
+    is_seq = isinstance(out_vals, (tuple, list))
+    flat_outs = list(out_vals) if is_seq else [out_vals]
+    out_meta = [(tuple(o.shape), jnp.result_type(o)) for o in flat_outs]
+    node = Node(vjp_fn, diff_tensors, out_meta, is_seq,
+                name=name or getattr(fn, "__name__", "op"))
+
+    outs = []
+    for k, o in enumerate(flat_outs):
+        sg = not _is_diff_dtype(jnp.result_type(o))
+        t = Tensor(o, stop_gradient=sg, _internal=True)
+        if not sg:
+            t._node = node
+            t._out_index = k
+        outs.append(t)
+    if is_seq or multi_out:
+        return tuple(outs)
+    return outs[0]
+
+
+def _wrap_outputs(out, node, stop_gradient, multi_out):
+    from .tensor import Tensor
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=stop_gradient, _internal=True)
+                     for o in out)
+    t = Tensor(out, stop_gradient=stop_gradient, _internal=True)
+    return (t,) if multi_out else t
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None):
+    """Run reverse accumulation from ``tensors``
+    (reference: egr::Backward paddle/fluid/eager/backward.cc:439,
+    RunBackward backward.cc:105).
+
+    ``grad_sink``: if given (a dict), leaf gradients are accumulated into
+    ``grad_sink[id(tensor)]`` instead of ``tensor.grad`` — used by the
+    functional :func:`grad` API so it never mutates ``.grad`` state.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"backward: got {len(tensors)} tensors but {len(grad_tensors)} "
+            "grad_tensors")
+
+    # node -> list of accumulated output cotangents
+    pending: dict = {}
+    roots: List[Node] = []
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True")
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward root "
+                    f"(shape={t.shape})")
+            gval = jnp.ones_like(t._value)
+        else:
+            gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._node
+        if node is None:
+            _accumulate_leaf(t, gval, grad_sink)
+            continue
+        slot = pending.setdefault(id(node), [node, [None] * len(node.out_meta)])
+        k = t._out_index
+        slot[1][k] = gval if slot[1][k] is None else slot[1][k] + gval
+        roots.append(node)
+
+    # topological order via iterative DFS over node graph
+    order: List[Node] = []
+    seen = set()
+    stack = [(n, False) for n in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for ref in node.inputs:
+            if ref.node is not None and id(ref.node) not in seen:
+                stack.append((ref.node, False))
+
+    # reverse topological = order reversed (DFS postorder gives children first)
+    for node in reversed(order):
+        slot = pending.get(id(node))
+        if slot is None:
+            continue
+        out_grads = [
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(slot[1], node.out_meta)
+        ]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time: "
+                "set retain_graph=True on the first backward() call")
+        in_grads = node.vjp_fn(tuple(out_grads) if node.out_is_seq
+                               else out_grads[0])
+        for ref, g in zip(node.inputs, in_grads):
+            t = ref.tensor
+            for hook in t._grad_hooks:
+                h = hook(Tensor(g, stop_gradient=True, _internal=True))
+                if h is not None:
+                    g = h._value if isinstance(h, Tensor) else h
+            if ref.node is None or t._retain_grads:
+                _accumulate_leaf(t, g, grad_sink)
+            if ref.node is not None:
+                s = pending.setdefault(
+                    id(ref.node), [ref.node, [None] * len(ref.node.out_meta)])
+                k = ref.out_index
+                s[1][k] = g if s[1][k] is None else s[1][k] + g
+        if not retain_graph:
+            node.vjp_fn = None
+        del pending[id(node)]
+
+
+def _accumulate_leaf(t, gval, grad_sink=None):
+    from .tensor import Tensor
+    if grad_sink is not None:
+        prev = grad_sink.get(id(t))
+        grad_sink[id(t)] = gval if prev is None else prev + gval
+        return
+    if t.grad is None:
+        t._grad = Tensor(gval, stop_gradient=True, _internal=True)
+    else:
+        t._grad = Tensor(t._grad._value + gval, stop_gradient=True,
+                         _internal=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """Functional gradient API (reference: python/paddle/autograd/autograd.py
+    ``paddle.grad``). Computes grads of outputs w.r.t. inputs without touching
+    ``.grad`` of any tensor (gradients flow into a side sink)."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.autograd.jacobian / jax.grad "
+            "composition for higher-order derivatives")
+
+    saved_retain = [(t, t._retain_grads) for t in inputs]
+    sink: dict = {}
+    for t in inputs:
+        t._retain_grads = True  # ensure non-leaf inputs receive grads
+    try:
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=bool(retain_graph), grad_sink=sink)
+        res = []
+        for t in inputs:
+            g = sink.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the inputs was not used in the graph; pass "
+                        "allow_unused=True to return None for it")
+                res.append(None)
+            else:
+                res.append(Tensor(g, stop_gradient=True, _internal=True))
+        return res
+    finally:
+        for t, r in saved_retain:
+            t._retain_grads = r

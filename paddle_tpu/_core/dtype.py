@@ -1,0 +1,136 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference's DataType enum (reference: paddle/phi/common/data_type.h)
+as thin aliases over numpy/jax dtypes, plus default-dtype state
+(reference: python/paddle/framework/framework.py set_default_dtype).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtypes (jax uses the same).
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float": float32,
+    "float64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """Set the global default float dtype (reference:
+    python/paddle/framework/framework.py:set_default_dtype)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, "
+            f"got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def _canonicalize(d):
+    """Map 64-bit types to their 32-bit TPU-native counterparts unless jax
+    x64 is enabled (TPU has no fast int64/float64 path; this mirrors jax's
+    own default-x32 canonicalisation)."""
+    import jax
+    if jax.config.jax_enable_x64:
+        return d
+    return {np.dtype("int64"): int32, np.dtype("uint64"): np.dtype("uint32"),
+            np.dtype("float64"): float32,
+            np.dtype("complex128"): complex64}.get(d, d)
+
+
+def convert_dtype(d, canonicalize=True):
+    """Normalise any dtype spec (str, np.dtype, python type, jnp dtype) to a
+    numpy dtype object."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        name = d.split(".")[-1]  # accept "paddle.float32" style
+        out = _STR_ALIASES.get(name) or np.dtype(name)
+    elif d is bool:
+        out = bool_
+    elif d is int:
+        out = int64
+    elif d is float:
+        out = _default_dtype
+    elif d is complex:
+        out = complex64
+    else:
+        out = np.dtype(d)
+    return _canonicalize(out) if canonicalize else out
+
+
+def is_floating_point(d):
+    return convert_dtype(d) in _FLOATING
+
+
+def is_integer(d):
+    return convert_dtype(d) in _INTEGER
+
+
+def is_complex(d):
+    return convert_dtype(d) in _COMPLEX
+
+
+def is_bool(d):
+    return convert_dtype(d) == bool_
+
+
+def dtype_name(d):
+    d = convert_dtype(d)
+    return d.name
+
+
+def promote_types(a, b):
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))
+
+
+def finfo(d):
+    return ml_dtypes.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return np.iinfo(convert_dtype(d))

@@ -1,0 +1,111 @@
+"""Global runtime flag registry.
+
+TPU-native analog of the reference's gflags-backed flag layer
+(reference: paddle/common/flags.h:38, paddle/common/flags.cc ~190 flags;
+python surface python/paddle/base/framework.py:132 set_flags / :157 get_flags).
+
+Flags are declared in-process, override-able from the environment as
+``FLAGS_<name>`` at first access, and settable via :func:`set_flags`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help", "env_read")
+
+    def __init__(self, name: str, default: Any, type_: type, help_: str):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type_
+        self.help = help_
+        self.env_read = False
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_OBSERVERS: Dict[str, Callable[[Any], None]] = {}
+
+
+def _coerce(flag: _Flag, value: Any) -> Any:
+    if flag.type is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    return flag.type(value)
+
+
+def define_flag(name: str, default: Any, help_: str = "",
+                type_: Optional[type] = None) -> None:
+    """Declare a flag (analog of PHI_DEFINE_EXPORTED_*)."""
+    with _lock:
+        if name in _REGISTRY:
+            return
+        _REGISTRY[name] = _Flag(name, default,
+                                type_ or (type(default) if default is not None else str),
+                                help_)
+
+
+def _flag(name: str) -> _Flag:
+    if name.startswith("FLAGS_"):
+        name = name[len("FLAGS_"):]
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown flag: {name!r}")
+    f = _REGISTRY[name]
+    if not f.env_read:
+        env = os.environ.get("FLAGS_" + f.name)
+        if env is not None:
+            f.value = _coerce(f, env)
+        f.env_read = True
+    return f
+
+
+def get_flags(names):
+    """Read one or more flags (reference: base/framework.py:157)."""
+    single = isinstance(names, str)
+    if single:
+        names = [names]
+    out = {}
+    for n in names:
+        f = _flag(n)
+        out["FLAGS_" + f.name] = f.value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flags from a dict (reference: base/framework.py:132)."""
+    for name, value in flags.items():
+        f = _flag(name)
+        f.env_read = True
+        f.value = _coerce(f, value)
+        obs = _OBSERVERS.get(f.name)
+        if obs is not None:
+            obs(f.value)
+
+
+def on_flag_change(name: str, fn: Callable[[Any], None]) -> None:
+    _OBSERVERS[name] = fn
+
+
+def flag_value(name: str):
+    return _flag(name).value
+
+
+def all_flags() -> Dict[str, Any]:
+    return {"FLAGS_" + k: _flag(k).value for k in _REGISTRY}
+
+
+# ---- core flags (subset of reference paddle/common/flags.cc) ----
+define_flag("check_nan_inf", False, "check outputs of every op for nan/inf")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0 log only")
+define_flag("benchmark", False, "sync after op for stable timing")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (no-op on TPU; XLA owns memory)")
+define_flag("use_stride_kernel", True, "allow view/stride ops to alias (JAX always copies under the hood)")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("log_level", 0, "VLOG verbosity for paddle_tpu internals")
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA owns device memory")
+define_flag("embedding_deterministic", 0, "deterministic embedding grad (no-op: XLA scatter is deterministic)")
+define_flag("cudnn_deterministic", False, "kept for parity; TPU is deterministic by default")

@@ -1,0 +1,465 @@
+"""Tensor: imperative wrapper over ``jax.Array``.
+
+TPU-native analog of the reference's eager Tensor
+(reference: paddle/phi/api/include/tensor.h:82 paddle::Tensor;
+python surface python/paddle/base/dygraph/tensor_patch_methods.py).
+
+Design: a ``Tensor`` owns a ``jax.Array`` (or tracer) in ``_value`` plus
+autograd bookkeeping (``stop_gradient``, ``.grad``, tape node). In-place ops
+rebind ``_value`` and bump ``_version`` (the reference's inplace_version
+counter, paddle/fluid/eager/utils.h) so the tape can detect illegal
+mutation of saved activations. Tensors are registered as a jax pytree node,
+so they flow through ``jax.jit`` / ``jax.tree_util`` transparently.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from . import dtype as dtypes
+
+
+def _to_jax(data, dtype=None):
+    if isinstance(data, Tensor):
+        data = data._value
+    if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
+        return data if dtype is None else data.astype(dtypes.convert_dtype(dtype))
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtypes.convert_dtype(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(dtypes.get_default_dtype())
+    elif arr.dtype == np.int32:
+        pass
+    return jnp.asarray(arr)
+
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = ("_value", "_stop_gradient", "_grad", "_node", "_out_index",
+                 "_version", "_retain_grads", "_grad_hooks", "name",
+                 "persistable", "__weakref__", "__dict__")
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name: Optional[str] = None, _internal: bool = False):
+        if _internal:
+            self._value = data
+        else:
+            self._value = _to_jax(data, dtype)
+        self._stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self._version = 0
+        self._retain_grads = False
+        self._grad_hooks = []
+        self.persistable = False
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+
+    # ---- basic properties ----
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(jnp.result_type(self._value))
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def place(self):
+        try:
+            devs = self._value.devices()
+            return next(iter(devs))
+        except Exception:
+            return jax.devices()[0]
+
+    @property
+    def T(self):
+        return autograd.apply(lambda x: jnp.swapaxes(x, -1, -2)
+                              if x.ndim >= 2 else x, self, name="t")
+
+    @property
+    def mT(self):
+        return self.T
+
+    @property
+    def inplace_version(self):
+        return self._version
+
+    # ---- conversion ----
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._value
+
+    def astype(self, dtype):
+        d = dtypes.convert_dtype(dtype)
+        return autograd.apply(lambda x: x.astype(d), self, name="cast")
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, np.dtype)) and str(a).split(":")[0] not in (
+                    "cpu", "gpu", "tpu", "xpu"):
+                t = t.astype(a)
+        return t
+
+    def cpu(self):
+        v = jax.device_put(self._value, jax.devices("cpu")[0]) \
+            if jax.devices()[0].platform != "cpu" else self._value
+        return Tensor(v, stop_gradient=self._stop_gradient, _internal=True)
+
+    def cuda(self, *a, **k):  # parity alias: accelerator placement
+        return Tensor(jax.device_put(self._value, jax.devices()[0]),
+                      stop_gradient=self._stop_gradient, _internal=True)
+
+    def pin_memory(self):
+        return self
+
+    # ---- autograd surface ----
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], None if grad_tensor is None else [grad_tensor],
+                          retain_graph=retain_graph)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._value),
+                                stop_gradient=True, _internal=True)
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, _internal=True)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self._stop_gradient = True
+        return self
+
+    def clone(self):
+        return autograd.apply(lambda x: x + 0, self, name="clone")
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(h):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    # ---- in-place machinery ----
+    def _inplace_assign(self, new_value, node=None, out_index=0):
+        self._value = new_value
+        self._version += 1
+        self._node = node
+        self._out_index = out_index
+
+    def _inplace_from(self, t: "Tensor"):
+        self._value = t._value
+        self._version += 1
+        self._node = t._node
+        self._out_index = t._out_index
+        if t._node is not None:
+            # e.g. buf[i] = net_out where buf had stop_gradient=True: the
+            # result now depends on a differentiable input, so it must track
+            self._stop_gradient = False
+        return self
+
+    def copy_(self, other, blocking=True):
+        o = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        self._inplace_assign(o.astype(self.dtype))
+        return self
+
+    def set_value(self, value):
+        return self.copy_(value)
+
+    def fill_(self, value):
+        self._inplace_assign(jnp.full_like(self._value, value))
+        return self
+
+    def zero_(self):
+        self._inplace_assign(jnp.zeros_like(self._value))
+        return self
+
+    # ---- indexing ----
+    def __getitem__(self, idx):
+        idx = _index_to_raw(idx)
+        return autograd.apply(lambda x: x[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _index_to_raw(idx)
+        if isinstance(value, Tensor):
+            out = autograd.apply(
+                lambda x, val: x.at[idx].set(val.astype(x.dtype)
+                                             if hasattr(val, "astype") else val),
+                self, value, name="setitem")
+        else:
+            out = autograd.apply(lambda x: x.at[idx].set(value), self,
+                                 name="setitem")
+        self._inplace_from(out)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ---- operators ----
+    def _binary(self, other, fn, name, reverse=False):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(other)
+        if reverse:
+            return autograd.apply(lambda y, x: fn(x, y), self, other, name=name)
+        return autograd.apply(fn, self, other, name=name)
+
+    def __add__(self, o):
+        return self._binary(o, jnp.add, "add")
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, jnp.subtract, "subtract")
+
+    def __rsub__(self, o):
+        return self._binary(o, jnp.subtract, "subtract", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, jnp.multiply, "multiply")
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, jnp.true_divide, "divide")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, jnp.true_divide, "divide", reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binary(o, jnp.floor_divide, "floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._binary(o, jnp.floor_divide, "floor_divide", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, jnp.remainder, "remainder")
+
+    def __rmod__(self, o):
+        return self._binary(o, jnp.remainder, "remainder", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, jnp.power, "pow")
+
+    def __rpow__(self, o):
+        return self._binary(o, jnp.power, "pow", reverse=True)
+
+    def __matmul__(self, o):
+        return self._binary(o, jnp.matmul, "matmul")
+
+    def __rmatmul__(self, o):
+        return self._binary(o, jnp.matmul, "matmul", reverse=True)
+
+    def __neg__(self):
+        return autograd.apply(jnp.negative, self, name="neg")
+
+    def __abs__(self):
+        return autograd.apply(jnp.abs, self, name="abs")
+
+    def __invert__(self):
+        return autograd.apply(jnp.logical_not, self, name="logical_not")
+
+    # comparisons (outputs bool -> stop_gradient)
+    def __eq__(self, o):
+        return self._binary(o, lambda a, b: a == b, "equal")
+
+    def __ne__(self, o):
+        return self._binary(o, lambda a, b: a != b, "not_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, lambda a, b: a < b, "less_than")
+
+    def __le__(self, o):
+        return self._binary(o, lambda a, b: a <= b, "less_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, lambda a, b: a > b, "greater_than")
+
+    def __ge__(self, o):
+        return self._binary(o, lambda a, b: a >= b, "greater_equal")
+
+    def __and__(self, o):
+        return self._binary(o, jnp.logical_and, "logical_and")
+
+    def __or__(self, o):
+        return self._binary(o, jnp.logical_or, "logical_or")
+
+    def __xor__(self, o):
+        return self._binary(o, jnp.logical_xor, "logical_xor")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._value)
+            body = np.array2string(val, precision=8, separator=", ")
+        except Exception:
+            body = repr(self._value)  # tracer
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self._stop_gradient},\n       {body})")
+
+    # in-place arithmetic (API parity: trailing underscore)
+    def add_(self, o):
+        return self._inplace_from(self.__add__(o))
+
+    def subtract_(self, o):
+        return self._inplace_from(self.__sub__(o))
+
+    def multiply_(self, o):
+        return self._inplace_from(self.__mul__(o))
+
+    def scale_(self, scale=1.0, bias=0.0):
+        return self._inplace_from(autograd.apply(
+            lambda x: x * scale + bias, self, name="scale"))
+
+    def clip_(self, min=None, max=None):
+        return self._inplace_from(autograd.apply(
+            lambda x: jnp.clip(x, min, max), self, name="clip"))
+
+
+def _index_to_raw(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py
+    EagerParamBase)."""
+
+    def __init__(self, data=None, dtype=None, name=None, trainable=True,
+                 _internal=False, **kwargs):
+        super().__init__(data, dtype=dtype, name=name, stop_gradient=not trainable,
+                         _internal=_internal)
+        self.persistable = True
+        self.optimize_attr = kwargs.get("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.get("regularizer", None)
+        self.need_clip = kwargs.get("need_clip", True)
+        self.is_distributed = False
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# ---- pytree registration: Tensors flow through jax transforms ----
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (type(t), t._stop_gradient)
+
+
+def _tensor_unflatten(aux, children):
+    cls, sg = aux
+    if cls is Parameter:
+        t = Parameter.__new__(Parameter)
+        Tensor.__init__(t, children[0], stop_gradient=sg, _internal=True)
+        t.persistable = True
+        t.optimize_attr = {"learning_rate": 1.0}
+        t.regularizer = None
+        t.need_clip = True
+        t.is_distributed = False
+        return t
+    return cls(children[0], stop_gradient=sg, _internal=True)
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """reference: python/paddle/tensor/creation.py to_tensor."""
+    if isinstance(data, Tensor) and dtype is None:
+        return Tensor(data._value, stop_gradient=stop_gradient, _internal=True)
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,11 @@
+from .initializer import (
+    Initializer, Constant, Normal, TruncatedNormal, Uniform, XavierNormal,
+    XavierUniform, KaimingNormal, KaimingUniform, Assign, Orthogonal, Dirac,
+    ParamAttr, _resolve_param_attr, constant, normal, uniform,
+)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    from . import initializer as _m
+    _m._GLOBAL_WEIGHT_INIT = weight_init
+    _m._GLOBAL_BIAS_INIT = bias_init

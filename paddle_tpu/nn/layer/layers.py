@@ -1,0 +1,543 @@
+"""Layer: the stateful module base class.
+
+TPU-native analog of the reference's ``nn.Layer``
+(reference: python/paddle/nn/layer/layers.py:354) — parameter/buffer/sublayer
+registries, hooks, state_dict, train/eval, dtype casting — with one addition
+the reference doesn't need: :meth:`functional_call`, which runs ``forward``
+with parameters substituted from a pytree so the same imperative module can be
+jit-compiled/differentiated functionally (jax.grad over parameters) without
+leaking tracers into module state.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, Parameter
+from ..._core import dtype as dtypes
+from ..._core.autograd import no_grad
+from ..initializer.initializer import _resolve_param_attr, XavierUniform, Constant
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+_hook_id = [0]
+
+
+class Layer:
+    """reference: python/paddle/nn/layer/layers.py:354 (class Layer)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
+        self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_by_pure_fp16 = False
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ---- parameter/buffer/sublayer registration ----
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError(
+                    "call super().__init__() before assigning parameters")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError(
+                    "call super().__init__() before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        else:
+            for d in (params, layers, buffers):
+                if d is not None and name in d:
+                    if value is None or isinstance(value, Tensor):
+                        d[name] = value
+                        return
+                    del d[name]
+            object.__setattr__(self, name, value)
+            return
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d:
+                extra += list(d)
+        return list(super().__dir__()) + extra
+
+    # ---- factory helpers (reference: layers.py create_parameter) ----
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        if attr is False:  # paddle idiom: bias_attr=False -> no parameter
+            return None
+        dtype = dtypes.convert_dtype(dtype) or self._dtype
+        init, name, trainable = _resolve_param_attr(attr, is_bias,
+                                                    default_initializer)
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, name=name, trainable=trainable, _internal=True)
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        return Tensor(jnp.zeros([], dtypes.convert_dtype(dtype) or self._dtype),
+                      _internal=True)
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    # ---- iteration ----
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None or id(l) in layers_set:
+                continue
+            layers_set.add(id(l))
+            p = prefix + ("." if prefix else "") + name
+            yield p, l
+            yield from l.named_sublayers(prefix=p, layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += [(prefix + ("." if prefix else "") + n, l)
+                       for n, l in self.named_sublayers()]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (lp + ("." if lp else "") + name, p)
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True,
+                      persistable_only=False):
+        seen = set()
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += [(prefix + ("." if prefix else "") + n, l)
+                       for n, l in self.named_sublayers()]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                if persistable_only and \
+                        name in layer._non_persistable_buffer_names:
+                    continue
+                seen.add(id(b))
+                yield (lp + ("." if lp else "") + name, b)
+
+    # ---- modes ----
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ---- hooks ----
+    def register_forward_pre_hook(self, hook):
+        _hook_id[0] += 1
+        self._forward_pre_hooks[_hook_id[0]] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, _hook_id[0])
+
+    def register_forward_post_hook(self, hook):
+        _hook_id[0] += 1
+        self._forward_post_hooks[_hook_id[0]] = hook
+        return HookRemoveHelper(self._forward_post_hooks, _hook_id[0])
+
+    # ---- call ----
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    # ---- state dict ----
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers, persistable_only=True):
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        with no_grad():
+            for k, v in matched.items():
+                t = own[k]
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(
+                    np.asarray(v))
+                if tuple(t.shape) != tuple(val.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: {t.shape} vs "
+                        f"{list(val.shape)}")
+                t._inplace_assign(val.astype(t.dtype))
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- dtype / device movement ----
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_params(dtypes.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_params(dtypes.convert_dtype(dtype))
+        return self
+
+    def _cast_params(self, dtype, include_buffers=True):
+        with no_grad():
+            for p in self.parameters():
+                if dtypes.is_floating_point(p.dtype):
+                    p._inplace_assign(p._value.astype(dtype))
+            if include_buffers:
+                for b in self.buffers():
+                    if dtypes.is_floating_point(b.dtype):
+                        b._inplace_assign(b._value.astype(dtype))
+        for l in self.sublayers(include_self=True):
+            l._dtype = dtype
+
+    def float(self):
+        return self.astype(dtypes.float32)
+
+    def half(self):
+        return self.astype(dtypes.float16)
+
+    def bfloat16(self):
+        return self.astype(dtypes.bfloat16)
+
+    # ---- functional bridge (TPU-native addition) ----
+    def functional_call(self, params: Dict[str, Any], *args,
+                        buffers: Optional[Dict[str, Any]] = None,
+                        training: Optional[bool] = None, **kwargs):
+        """Run ``forward`` with parameter values taken from ``params``
+        (a dict name -> jax array / Tensor), restoring module state after.
+        This is the bridge that makes the imperative Layer jit/grad-able:
+        ``jax.grad(lambda p: layer.functional_call(p, x).mean())``.
+        """
+        named = dict(self.named_parameters())
+        saved = {}
+        old_training = self.training
+        try:
+            for k, v in params.items():
+                p = named[k]
+                saved[k] = (p, p._value, p._node, p._out_index)
+                val = v._value if isinstance(v, Tensor) else v
+                p._value = val
+                p._node = None
+                p._out_index = 0
+            if buffers:
+                namedb = dict(self.named_buffers())
+                for k, v in buffers.items():
+                    b = namedb[k]
+                    saved["buf:" + k] = (b, b._value, b._node, b._out_index)
+                    b._value = v._value if isinstance(v, Tensor) else v
+            if training is not None:
+                self.train() if training else self.eval()
+            return self(*args, **kwargs)
+        finally:
+            if training is not None:
+                self.train() if old_training else self.eval()
+            for k, (t, val, node, oi) in saved.items():
+                t._value, t._node, t._out_index = val, node, oi
+
+    def raw_parameters(self) -> Dict[str, Any]:
+        """Parameters as a plain dict name -> jax array (a pytree for jax
+        transforms)."""
+        return {k: p._value for k, p in self.named_parameters()}
+
+    def raw_buffers(self) -> Dict[str, Any]:
+        return {k: b._value for k, b in self.named_buffers()}
+
+    def load_raw_parameters(self, tree: Dict[str, Any]):
+        named = dict(self.named_parameters())
+        for k, v in tree.items():
+            named[k]._inplace_assign(v)
+
+    # ---- misc ----
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [extra] if extra else []
+        for name, l in self.named_children():
+            child = repr(l).split("\n")
+            child = [child[0]] + ["  " + c for c in child[1:]]
+            lines.append(f"({name}): " + "\n".join(child))
+        main = self.__class__.__name__
+        if not lines:
+            return f"{main}()"
+        body = "\n".join("  " + l for l in lines)
+        return f"{main}(\n{body}\n)"
+
+
+class Sequential(Layer):
+    """reference: python/paddle/nn/layer/container.py Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, input):
+        for layer in self._sub_layers.values():
+            input = layer(input)
+        return input
+
+
+class LayerList(Layer):
+    """reference: python/paddle/nn/layer/container.py LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[int(idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def forward(self, *a, **k):
+        raise NotImplementedError("LayerList is a container")
+
+
+class ParameterList(Layer):
+    """reference: python/paddle/nn/layer/container.py ParameterList."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[int(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def forward(self, *a, **k):
+        raise NotImplementedError("ParameterList is a container")
+
+
+class LayerDict(Layer):
+    """reference: python/paddle/nn/layer/container.py LayerDict."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for k, v in items:
+            self[k] = v
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        l = self._sub_layers[key]
+        del self._sub_layers[key]
+        return l
+
+    def forward(self, *a, **k):
+        raise NotImplementedError("LayerDict is a container")

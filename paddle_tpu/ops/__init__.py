@@ -1,0 +1,25 @@
+"""Functional op surface (reference: python/paddle/tensor/*).
+
+Importing this package registers every op and attaches the Tensor method
+surface (the reference's monkey-patch pass in python/paddle/tensor/__init__.py).
+"""
+from . import _registry
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import math  # noqa: F401
+from . import creation  # noqa: F401
+from . import manipulation  # noqa: F401
+from . import logic  # noqa: F401
+from . import linalg  # noqa: F401
+from . import search  # noqa: F401
+from . import random  # noqa: F401
+
+_registry.attach_tensor_methods()
+
+OPS = _registry.OPS

@@ -1,0 +1,70 @@
+"""Op registration helpers.
+
+TPU-native replacement for the reference's YAML-driven codegen
+(reference: paddle/phi/ops/yaml/ops.yaml — 472 ops; generated C++ API via
+paddle/phi/api/generator/api_gen.py). On TPU there is no kernel-dispatch
+layer to generate: every op is its jnp/lax primitive composition, traced by
+XLA. What we keep from the reference's discipline is a single registry so the
+Tensor method surface is attached uniformly (the reference's monkey-patch in
+python/paddle/tensor/__init__.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+
+OPS: Dict[str, Callable] = {}
+TENSOR_METHODS: Dict[str, Callable] = {}
+
+
+def register(name: str, tensor_method: bool = True, method_name: str = None):
+    def deco(fn):
+        OPS[name] = fn
+        if tensor_method:
+            TENSOR_METHODS[method_name or name] = fn
+        return fn
+    return deco
+
+
+def as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def unary(name: str, jfn, tensor_method=True, inplace_variant=True):
+    """Create + register a differentiable unary op from a jnp function."""
+    def op(x, name=None):
+        return apply(jfn, as_tensor(x), name=name)
+    op.__name__ = name
+    register(name, tensor_method)(op)
+    if inplace_variant and tensor_method:
+        def op_(self, name=None):
+            return self._inplace_from(op(self))
+        op_.__name__ = name + "_"
+        TENSOR_METHODS[name + "_"] = op_
+    return op
+
+
+def binary(name: str, jfn, tensor_method=True):
+    def op(x, y, name=None):
+        return apply(jfn, as_tensor(x), as_tensor(y), name=name)
+    op.__name__ = name
+    register(name, tensor_method)(op)
+    return op
+
+
+def attach_tensor_methods():
+    """Attach every registered op as a Tensor method (reference pattern:
+    python/paddle/tensor/__init__.py tensor method attach list)."""
+    for mname, fn in TENSOR_METHODS.items():
+        if mname.endswith("_") and hasattr(Tensor, mname):
+            continue
+        if not hasattr(Tensor, mname):
+            setattr(Tensor, mname, fn)

@@ -1,0 +1,209 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor, to_tensor
+from .._core import dtype as dtypes
+from ._registry import register, as_tensor, raw
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else (default or dtypes.get_default_dtype())
+
+
+@register("zeros", tensor_method=False)
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)), _internal=True)
+
+
+@register("ones", tensor_method=False)
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)), _internal=True)
+
+
+@register("full", tensor_method=False)
+def full(shape, fill_value, dtype=None, name=None):
+    fv = raw(fill_value)
+    if dtype is None:
+        return Tensor(jnp.full(_shape(shape), fv), _internal=True)
+    return Tensor(jnp.full(_shape(shape), fv, _dt(dtype)), _internal=True)
+
+
+@register("zeros_like")
+def zeros_like(x, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.zeros_like(raw(as_tensor(x)), dtype=d), _internal=True)
+
+
+@register("ones_like")
+def ones_like(x, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.ones_like(raw(as_tensor(x)), dtype=d), _internal=True)
+
+
+@register("full_like")
+def full_like(x, fill_value, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.full_like(raw(as_tensor(x)), raw(fill_value), dtype=d),
+                  _internal=True)
+
+
+@register("empty", tensor_method=False)
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@register("empty_like")
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@register("arange", tensor_method=False)
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = raw(start), raw(end), raw(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (np.int64 if jnp.result_type(start, end, step) in
+                 (jnp.int32, jnp.int64) else dtypes.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)),
+                  _internal=True)
+
+
+@register("linspace", tensor_method=False)
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(raw(start), raw(stop), int(raw(num)),
+                               dtype=_dt(dtype)), _internal=True)
+
+
+@register("logspace", tensor_method=False)
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(raw(start), raw(stop), int(raw(num)),
+                               base=raw(base), dtype=_dt(dtype)),
+                  _internal=True)
+
+
+@register("eye", tensor_method=False)
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)),
+                  _internal=True)
+
+
+@register("diag", tensor_method=False)
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        out = jnp.diag(v, k=offset)
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.eye(out.shape[0], dtype=bool) if offset == 0 else \
+                jnp.diag(jnp.ones(v.shape[0], dtype=bool), k=offset)
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return apply(f, as_tensor(x), name="diag")
+
+
+@register("diagflat", tensor_method=False)
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=offset), as_tensor(x),
+                 name="diagflat")
+
+
+@register("diag_embed", tensor_method=False)
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + (-offset if offset < 0 else 0)
+        c = idx + (offset if offset > 0 else 0)
+        out = out.at[..., r, c].set(v)
+        src = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        perm = [d for d in src if d not in (out.ndim - 2, out.ndim - 1)]
+        res = [None] * out.ndim
+        res[d1] = out.ndim - 2
+        res[d2] = out.ndim - 1
+        it = iter(perm)
+        for i in range(out.ndim):
+            if res[i] is None:
+                res[i] = next(it)
+        return jnp.transpose(out, res) if (d1, d2) != (out.ndim - 2,
+                                                       out.ndim - 1) else out
+    return apply(f, as_tensor(input), name="diag_embed")
+
+
+@register("tril", tensor_method=True)
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), as_tensor(x), name="tril")
+
+
+@register("triu", tensor_method=True)
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), as_tensor(x), name="triu")
+
+
+@register("tril_indices", tensor_method=False)
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(_dt(dtype))),
+                  _internal=True)
+
+
+@register("triu_indices", tensor_method=False)
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(_dt(dtype))),
+                  _internal=True)
+
+
+@register("meshgrid", tensor_method=False)
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")),
+                 *[as_tensor(a) for a in args], name="meshgrid")
+    return list(outs)
+
+
+@register("assign", tensor_method=False)
+def assign(x, output=None, name=None):
+    src = as_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, int,
+                                             float)) else Tensor(np.asarray(x))
+    out = apply(lambda v: v + 0 if jnp.issubdtype(jnp.result_type(v),
+                                                  jnp.inexact) else v,
+                src, name="assign")
+    if output is not None:
+        output._inplace_from(out)
+        return output
+    return out
+
+
+@register("clone")
+def clone(x, name=None):
+    return as_tensor(x).clone()
+
+
+@register("complex", tensor_method=False)
+def complex(real, imag, name=None):
+    return apply(jax.lax.complex, as_tensor(real), as_tensor(imag),
+                 name="complex")
+
+
+@register("polar", tensor_method=False)
+def polar(abs, angle, name=None):
+    return apply(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+                 as_tensor(abs), as_tensor(angle), name="polar")

@@ -1,0 +1,281 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py,
+kernels paddle/phi/kernels/{matmul,svd,qr,cholesky,...}_kernel.*).
+
+Matmuls are the MXU path: they lower straight to XLA dot_general, with
+precision controlled by FLAGS_tpu_matmul_precision.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from .._core.flags import flag_value
+from ._registry import register, as_tensor, raw
+
+
+def _precision():
+    p = flag_value("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+@register("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b, precision=_precision())
+    return apply(f, as_tensor(x), as_tensor(y), name="matmul")
+
+
+@register("mm")
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+@register("bmm")
+def bmm(x, y, name=None):
+    return apply(lambda a, b: jnp.matmul(a, b, precision=_precision()),
+                 as_tensor(x), as_tensor(y), name="bmm")
+
+
+@register("dot")
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), as_tensor(x),
+                 as_tensor(y), name="dot")
+
+
+@register("mv")
+def mv(x, vec, name=None):
+    return apply(lambda a, b: jnp.matmul(a, b, precision=_precision()),
+                 as_tensor(x), as_tensor(vec), name="mv")
+
+
+@register("addmm", tensor_method=False)
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i +
+                 alpha * jnp.matmul(a, b, precision=_precision()),
+                 as_tensor(input), as_tensor(x), as_tensor(y), name="addmm")
+
+
+@register("einsum", tensor_method=False)
+def einsum(equation, *operands, name=None):
+    ts = [as_tensor(o) for o in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs, precision=_precision()),
+                 *ts, name="einsum")
+
+
+@register("norm", tensor_method=False)
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if p is None:
+        p = 2 if axis is not None or x.ndim == 1 else "fro"
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def f(v):
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(v, compute_uv=False), axis=-1,
+                           keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax,
+                                 keepdims=keepdim), 1.0 / p)
+    return apply(f, x, name="norm")
+
+
+vector_norm = norm
+
+
+@register("matrix_norm", tensor_method=False)
+def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                                           keepdims=keepdim),
+                 as_tensor(x), name="matrix_norm")
+
+
+@register("dist", tensor_method=False)
+def dist(x, y, p=2, name=None):
+    return norm(as_tensor(x) - as_tensor(y), p=float(p))
+
+
+@register("t")
+def t(input, name=None):
+    return apply(lambda v: v.T if v.ndim == 2 else v, as_tensor(input),
+                 name="t")
+
+
+@register("transpose_matmul", tensor_method=False)
+def transpose_matmul(x, y):
+    return matmul(x, y, transpose_x=True)
+
+
+@register("cross", tensor_method=False)
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis if axis != 9 else next(
+            i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(f, as_tensor(x), as_tensor(y), name="cross")
+
+
+@register("histogram", tensor_method=False)
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    xv = np.asarray(raw(as_tensor(input)))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (xv.min(), xv.max())
+    h, _ = np.histogram(xv, bins=bins, range=(lo, hi),
+                        weights=None if weight is None else
+                        np.asarray(raw(as_tensor(weight))), density=density)
+    return Tensor(jnp.asarray(h if density else h.astype(np.int32)))
+
+
+@register("dot_general", tensor_method=False)
+def dot_general(lhs, rhs, dimension_numbers, name=None):
+    """TPU-native extra: direct XLA dot_general access (no reference analog;
+    the MXU primitive underlying all matmul ops)."""
+    return apply(lambda a, b: jax.lax.dot_general(
+        a, b, dimension_numbers, precision=_precision()),
+        as_tensor(lhs), as_tensor(rhs), name="dot_general")
+
+
+# ---- decompositions / solvers (CPU-offloaded where XLA-TPU lacks them) ----
+def _linalg_op(name, jfn, n_out=1, tensor_method=False):
+    def op(x, *args, name=None, **kwargs):
+        res = apply(lambda v: jfn(v, *args, **kwargs), as_tensor(x), name=name)
+        return res
+    op.__name__ = name
+    register(name, tensor_method)(op)
+    return op
+
+
+cholesky = _linalg_op("cholesky", lambda v, upper=False:
+                      jnp.linalg.cholesky(v) if not upper
+                      else jnp.swapaxes(jnp.linalg.cholesky(
+                          jnp.swapaxes(v, -1, -2).conj()), -1, -2).conj())
+inverse = _linalg_op("inverse", jnp.linalg.inv)
+matrix_power = _linalg_op("matrix_power", jnp.linalg.matrix_power)
+pinv = _linalg_op("pinv", jnp.linalg.pinv)
+
+
+@register("det", tensor_method=False)
+def det(x, name=None):
+    return apply(jnp.linalg.det, as_tensor(x), name="det")
+
+
+@register("slogdet", tensor_method=False)
+def slogdet(x, name=None):
+    outs = apply(lambda v: tuple(jnp.linalg.slogdet(v)), as_tensor(x),
+                 name="slogdet")
+    return outs
+
+
+@register("svd", tensor_method=False)
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda v: tuple(jnp.linalg.svd(
+        v, full_matrices=full_matrices)), as_tensor(x), name="svd")
+
+
+@register("qr", tensor_method=False)
+def qr(x, mode="reduced", name=None):
+    return apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), as_tensor(x),
+                 name="qr")
+
+
+@register("eig", tensor_method=False)
+def eig(x, name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    w, v = np.linalg.eig(xv)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+@register("eigh", tensor_method=False)
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda v: tuple(jnp.linalg.eigh(v,
+                                                 symmetrize_input=False)),
+                 as_tensor(x), name="eigh")
+
+
+@register("eigvals", tensor_method=False)
+def eigvals(x, name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    return Tensor(jnp.asarray(np.linalg.eigvals(xv)))
+
+
+@register("eigvalsh", tensor_method=False)
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v), as_tensor(x),
+                 name="eigvalsh")
+
+
+@register("solve", tensor_method=False)
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, as_tensor(x), as_tensor(y), name="solve")
+
+
+@register("triangular_solve", tensor_method=False)
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), as_tensor(x), as_tensor(y),
+        name="triangular_solve")
+
+
+@register("cholesky_solve", tensor_method=False)
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        z = jax.scipy.linalg.solve_triangular(L, b, lower=not upper,
+                                              trans=1 if upper else 0)
+        return jax.scipy.linalg.solve_triangular(L, z, lower=not upper,
+                                                 trans=0 if upper else 1)
+    return apply(f, as_tensor(x), as_tensor(y), name="cholesky_solve")
+
+
+@register("lstsq", tensor_method=False)
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    yv = np.asarray(raw(as_tensor(y)))
+    sol, res, rank, sv = np.linalg.lstsq(xv, yv, rcond=rcond)
+    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
+            Tensor(np.asarray(rank)), Tensor(jnp.asarray(sv)))
+
+
+@register("matrix_rank", tensor_method=False)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.matrix_rank(v, tol=tol), as_tensor(x),
+                 name="matrix_rank")
+
+
+@register("lu", tensor_method=False)
+def lu(x, pivot=True, get_infos=False, name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    import scipy.linalg as sla
+    lu_mat, piv = sla.lu_factor(xv)
+    outs = (Tensor(jnp.asarray(lu_mat)),
+            Tensor(jnp.asarray((piv + 1).astype(np.int32))))
+    if get_infos:
+        return outs + (Tensor(np.zeros(1, np.int32)),)
+    return outs
+
+
+@register("corrcoef", tensor_method=False)
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), as_tensor(x),
+                 name="corrcoef")
+
+
+@register("cov", tensor_method=False)
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar,
+                                   ddof=1 if ddof else 0), as_tensor(x),
+                 name="cov")

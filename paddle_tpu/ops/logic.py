@@ -1,0 +1,63 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from ._registry import register, as_tensor, binary, unary, raw
+
+equal = binary("equal", lambda a, b: a == b)
+not_equal = binary("not_equal", lambda a, b: a != b)
+less_than = binary("less_than", lambda a, b: a < b)
+less_equal = binary("less_equal", lambda a, b: a <= b)
+greater_than = binary("greater_than", lambda a, b: a > b)
+greater_equal = binary("greater_equal", lambda a, b: a >= b)
+logical_and = binary("logical_and", jnp.logical_and)
+logical_or = binary("logical_or", jnp.logical_or)
+logical_xor = binary("logical_xor", jnp.logical_xor)
+logical_not = unary("logical_not", jnp.logical_not, inplace_variant=False)
+bitwise_and = binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = unary("bitwise_not", jnp.bitwise_not, inplace_variant=False)
+bitwise_left_shift = binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = binary("bitwise_right_shift", jnp.right_shift)
+
+
+@register("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=float(raw(rtol)),
+                                           atol=float(raw(atol)),
+                                           equal_nan=equal_nan),
+                 as_tensor(x), as_tensor(y), name="allclose")
+
+
+@register("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=float(raw(rtol)),
+                                          atol=float(raw(atol)),
+                                          equal_nan=equal_nan),
+                 as_tensor(x), as_tensor(y), name="isclose")
+
+
+@register("equal_all")
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), as_tensor(x),
+                 as_tensor(y), name="equal_all")
+
+
+@register("is_empty", tensor_method=False)
+def is_empty(x, name=None):
+    return Tensor(np.asarray(as_tensor(x).size == 0))
+
+
+@register("is_tensor", tensor_method=False)
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+@register("isreal", tensor_method=False)
+def isreal(x, name=None):
+    return apply(lambda v: jnp.isreal(v), as_tensor(x), name="isreal")

@@ -1,0 +1,546 @@
+"""Shape/layout manipulation ops
+(reference: python/paddle/tensor/manipulation.py; stride/view kernels
+paddle/phi/kernels/stride/ — on TPU views are XLA copies that fuse away)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from .._core import dtype as dtypes
+from ._registry import register, as_tensor, raw, TENSOR_METHODS
+
+
+def _ishape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+@register("reshape")
+def reshape(x, shape, name=None):
+    s = _ishape(shape)
+    return apply(lambda v: jnp.reshape(v, s), as_tensor(x), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_from(reshape(x, shape))
+
+
+TENSOR_METHODS["reshape_"] = reshape_
+
+
+@register("view")
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = dtypes.convert_dtype(shape_or_dtype)
+    return apply(lambda v: v.view(d) if hasattr(v, "view") else
+                 jax.lax.bitcast_convert_type(v, d), as_tensor(x), name="view")
+
+
+@register("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+
+    def f(v):
+        shape = v.shape[:sa] + (-1,) + v.shape[so + 1:]
+        return jnp.reshape(v, shape)
+    return apply(f, x, name="flatten")
+
+
+@register("squeeze")
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return apply(lambda v: jnp.squeeze(v, axis=ax), x, name="squeeze")
+
+
+@register("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._value) if isinstance(a, Tensor) else int(a) for a in axes]
+    return apply(lambda v: jnp.expand_dims(v, axis=tuple(axes)), as_tensor(x),
+                 name="unsqueeze")
+
+
+for _n, _f in (("squeeze", squeeze), ("unsqueeze", unsqueeze)):
+    def _mk(f):
+        def op_(self, axis=None):
+            return self._inplace_from(f(self, axis) if axis is not None
+                                      else f(self))
+        return op_
+    TENSOR_METHODS[_n + "_"] = _mk(_f)
+
+
+@register("transpose")
+def transpose(x, perm=None, name=None):
+    p = None if perm is None else tuple(int(i) for i in perm)
+    return apply(lambda v: jnp.transpose(v, p), as_tensor(x), name="transpose")
+
+
+@register("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), as_tensor(x),
+                 name="moveaxis")
+
+
+@register("swapaxes")
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis0, axis1), as_tensor(x),
+                 name="swapaxes")
+
+
+swapdims = swapaxes
+TENSOR_METHODS["swapdims"] = swapaxes
+
+
+@register("concat", tensor_method=False)
+def concat(x, axis=0, name=None):
+    axis = int(raw(axis))
+    ts = [as_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *ts, name="concat")
+
+
+@register("stack", tensor_method=False)
+def stack(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *ts, name="stack")
+
+
+@register("unstack", tensor_method=False)
+def unstack(x, axis=0, num=None, name=None):
+    x = as_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    outs = apply(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+                 x, name="unstack")
+    return list(outs)
+
+
+@register("split", tensor_method=False)
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    axis = int(raw(axis))
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} is not divisible by "
+                f"num={num_or_sections} (reference errors likewise)")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(raw(s)) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def f(v):
+        return tuple(jax.lax.slice_in_dim(v, int(o), int(o + s), axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply(f, x, name="split"))
+
+
+@register("chunk", tensor_method=False)
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@register("tensor_split", tensor_method=False)
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = as_tensor(x)
+    dim = x.shape[int(axis)]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+        return split(x, sizes, axis)
+    idx = [0] + list(num_or_indices) + [dim]
+    sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis)
+
+
+@register("tile")
+def tile(x, repeat_times, name=None):
+    r = _ishape(repeat_times)
+    return apply(lambda v: jnp.tile(v, r), as_tensor(x), name="tile")
+
+
+@register("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    rep = raw(repeats)
+    return apply(lambda v: jnp.repeat(v, rep, axis=axis), as_tensor(x),
+                 name="repeat_interleave")
+
+
+@register("expand")
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    s = list(_ishape(shape))
+    xs = x.shape
+    for i in range(1, len(xs) + 1):
+        if s[-i] == -1:
+            s[-i] = xs[-i]
+    return apply(lambda v: jnp.broadcast_to(v, tuple(s)), x, name="expand")
+
+
+@register("expand_as")
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register("broadcast_tensors", tensor_method=False)
+def broadcast_tensors(input, name=None):
+    ts = [as_tensor(t) for t in input]
+    outs = apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts,
+                 name="broadcast_tensors")
+    return list(outs)
+
+
+@register("broadcast_shape", tensor_method=False)
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@register("flip")
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda v: jnp.flip(v, axis=ax), as_tensor(x), name="flip")
+
+
+@register("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), as_tensor(x),
+                 name="rot90")
+
+
+@register("roll")
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda v: jnp.roll(v, sh, axis=ax), as_tensor(x), name="roll")
+
+
+@register("gather", tensor_method=False)
+def gather(x, index, axis=0, name=None):
+    idx = raw(as_tensor(index))
+    axis = int(raw(axis))
+    return apply(lambda v: jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx,
+                                    axis=axis), as_tensor(x), name="gather")
+
+
+@register("gather_nd", tensor_method=False)
+def gather_nd(x, index, name=None):
+    idx = raw(as_tensor(index))
+
+    def f(v):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply(f, as_tensor(x), name="gather_nd")
+
+
+@register("take_along_axis", tensor_method=False)
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = raw(as_tensor(indices))
+    return apply(lambda v: jnp.take_along_axis(v, idx, axis=axis),
+                 as_tensor(arr), name="take_along_axis")
+
+
+@register("put_along_axis", tensor_method=False)
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    idx = raw(as_tensor(indices))
+    arr = as_tensor(arr)
+    vals = as_tensor(values) if not np.isscalar(values) else values
+
+    def f(v, *rest):
+        val = rest[0] if rest else jnp.full_like(idx, values, dtype=v.dtype)
+        val = jnp.broadcast_to(val, idx.shape) if hasattr(val, "shape") else val
+        if reduce == "assign":
+            mode = "set"
+        elif reduce in ("add", "sum"):
+            mode = "add"
+        elif reduce in ("mul", "multiply"):
+            mode = "multiply"
+        elif reduce == "amax":
+            mode = "max"
+        elif reduce == "amin":
+            mode = "min"
+        else:
+            raise ValueError(f"unsupported reduce {reduce}")
+        # build open indices for all other axes
+        ax = axis % v.ndim
+        ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = tuple(idx if d == ax else ii[d] for d in range(v.ndim))
+        return getattr(v.at[full_idx], mode)(val)
+    args = (arr, vals) if isinstance(vals, Tensor) else (arr,)
+    return apply(f, *args, name="put_along_axis")
+
+
+@register("scatter", tensor_method=False)
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = raw(as_tensor(index))
+
+    def f(v, u):
+        if overwrite:
+            return v.at[idx].set(u)
+        return v.at[idx].add(u)
+    return apply(f, as_tensor(x), as_tensor(updates), name="scatter")
+
+
+@register("scatter_nd_add", tensor_method=False)
+def scatter_nd_add(x, index, updates, name=None):
+    idx = raw(as_tensor(index))
+
+    def f(v, u):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply(f, as_tensor(x), as_tensor(updates), name="scatter_nd_add")
+
+
+@register("scatter_nd", tensor_method=False)
+def scatter_nd(index, updates, shape, name=None):
+    idx = raw(as_tensor(index))
+    s = _ishape(shape)
+
+    def f(u):
+        return jnp.zeros(s, u.dtype).at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply(f, as_tensor(updates), name="scatter_nd")
+
+
+@register("index_select", tensor_method=False)
+def index_select(x, index, axis=0, name=None):
+    idx = raw(as_tensor(index))
+    return apply(lambda v: jnp.take(v, idx, axis=axis), as_tensor(x),
+                 name="index_select")
+
+
+@register("index_add", tensor_method=False)
+def index_add(x, index, axis, value, name=None):
+    idx = raw(as_tensor(index))
+
+    def f(v, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        return jnp.moveaxis(vm.at[idx].add(um), 0, axis)
+    return apply(f, as_tensor(x), as_tensor(value), name="index_add")
+
+
+@register("index_put", tensor_method=False)
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(raw(as_tensor(i)) for i in indices)
+
+    def f(v, u):
+        return v.at[idx].add(u) if accumulate else v.at[idx].set(u)
+    return apply(f, as_tensor(x), as_tensor(value), name="index_put")
+
+
+@register("masked_select", tensor_method=False)
+def masked_select(x, mask, name=None):
+    # dynamic-shape output: evaluated on host (not jittable), parity API
+    xv = np.asarray(raw(as_tensor(x)))
+    mv = np.asarray(raw(as_tensor(mask)))
+    return Tensor(jnp.asarray(xv[np.broadcast_to(mv, xv.shape)]),
+                  _internal=True)
+
+
+@register("masked_fill", tensor_method=False)
+def masked_fill(x, mask, value, name=None):
+    m = raw(as_tensor(mask))
+    v = raw(value)
+    return apply(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                 as_tensor(x), name="masked_fill")
+
+
+@register("where", tensor_method=False)
+def where(condition, x=None, y=None, name=None):
+    cond = raw(as_tensor(condition))
+    if x is None and y is None:
+        nz = np.nonzero(np.asarray(cond))
+        return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)),
+                      _internal=True)
+    return apply(lambda a, b: jnp.where(cond, a, b), as_tensor(x),
+                 as_tensor(y), name="where")
+
+
+@register("nonzero", tensor_method=False)
+def nonzero(x, as_tuple=False, name=None):
+    nz = np.nonzero(np.asarray(raw(as_tensor(x))))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int32))[:, None],
+                            _internal=True) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)),
+                  _internal=True)
+
+
+@register("pad", tensor_method=False)
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(raw(p)) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to last len(pad)//2 spatial dims,
+        # ordered (last_dim_lo, last_dim_hi, second_last_lo, ...)
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NLC/NHWC/NDHWC
+            dims = list(range(1, 1 + k))
+        else:  # NCL/NCHW/NCDHW
+            dims = list(range(nd - k, nd))
+        for i, d in enumerate(reversed(dims)):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return apply(lambda v: jnp.pad(v, width, mode="constant",
+                                       constant_values=value), x, name="pad")
+    return apply(lambda v: jnp.pad(v, width, mode=jmode), x, name="pad")
+
+
+@register("as_strided", tensor_method=False)
+def as_strided(x, shape, stride, offset=0, name=None):
+    def f(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for dim, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx += r.reshape((-1,) + (1,) * (len(shape) - dim - 1))
+        return flat[jnp.asarray(idx)]
+    return apply(f, as_tensor(x), name="as_strided")
+
+
+@register("unfold", tensor_method=False)
+def unfold(x, axis, size, step, name=None):
+    x = as_tensor(x)
+    dim = x.shape[axis]
+    n = (dim - size) // step + 1
+
+    def f(v):
+        vm = jnp.moveaxis(v, axis, 0)
+        windows = jnp.stack([jax.lax.dynamic_slice_in_dim(vm, i * step, size, 0)
+                             for i in range(n)], axis=0)
+        # windows: (n, size, ...) -> move to (..., n, size) at position axis
+        w = jnp.moveaxis(windows, (0, 1), (axis, v.ndim))
+        return w
+    return apply(f, x, name="unfold")
+
+
+@register("unique", tensor_method=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    res = np.unique(xv, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = [Tensor(jnp.asarray(r), _internal=True) for r in res]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@register("unique_consecutive", tensor_method=False)
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    if axis is None:
+        xv = xv.reshape(-1)
+        keep = np.concatenate([[True], xv[1:] != xv[:-1]])
+    else:
+        d = np.any(np.diff(xv, axis=axis) != 0,
+                   axis=tuple(i for i in range(xv.ndim) if i != axis))
+        keep = np.concatenate([[True], d])
+    vals = np.compress(keep, xv, axis=0 if axis is None else axis)
+    outs = [Tensor(jnp.asarray(vals), _internal=True)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int32)), _internal=True))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        cnt = np.diff(np.concatenate([idx, [len(keep)]]))
+        outs.append(Tensor(jnp.asarray(cnt.astype(np.int32)), _internal=True))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@register("one_hot", tensor_method=False)
+def one_hot(x, num_classes, name=None):
+    idx = raw(as_tensor(x))
+    return Tensor(jax.nn.one_hot(idx, num_classes,
+                                 dtype=dtypes.get_default_dtype()),
+                  _internal=True)
+
+
+@register("bincount", tensor_method=False)
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = raw(as_tensor(x))
+    w = raw(as_tensor(weights)) if weights is not None else None
+    return Tensor(jnp.bincount(xv, weights=w, minlength=minlength),
+                  _internal=True)
+
+
+@register("numel", tensor_method=False)
+def numel(x, name=None):
+    return Tensor(np.asarray(as_tensor(x).size, dtype=np.int64),
+                  _internal=False)
+
+
+@register("shape", tensor_method=False)
+def shape(input):
+    return Tensor(np.asarray(as_tensor(input).shape, dtype=np.int64))
+
+
+@register("slice", tensor_method=False)
+def slice(input, axes, starts, ends, name=None):
+    x = as_tensor(input)
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(raw(st)); en = int(raw(en))
+        sl[ax] = builtins.slice(st, en)
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, name="slice")
+
+
+@register("strided_slice", tensor_method=False)
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = as_tensor(x)
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax] = builtins.slice(int(raw(st)), int(raw(en)), int(raw(sr)))
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, name="strided_slice")
+
+
+@register("crop", tensor_method=False)
+def crop(x, shape=None, offsets=None, name=None):
+    x = as_tensor(x)
+    s = _ishape(shape)
+    offs = [0] * x.ndim if offsets is None else [int(raw(o)) for o in offsets]
+    s = [x.shape[i] - offs[i] if d == -1 else d for i, d in enumerate(s)]
+    sl = tuple(builtins.slice(o, o + d) for o, d in zip(offs, s))
+    return apply(lambda v: v[sl], x, name="crop")
+
+
+@register("flatten_", tensor_method=False)
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_from(flatten(x, start_axis, stop_axis))
+
+
+TENSOR_METHODS["flatten_"] = flatten_

@@ -1,0 +1,326 @@
+"""Elementwise math + reductions (reference: python/paddle/tensor/math.py,
+kernels paddle/phi/kernels/*{activation,elementwise,reduce}*)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from .._core import dtype as dtypes
+from ._registry import register, as_tensor, unary, binary, raw
+
+# ---- unary elementwise ----
+exp = unary("exp", jnp.exp)
+expm1 = unary("expm1", jnp.expm1)
+log = unary("log", jnp.log)
+log2 = unary("log2", jnp.log2)
+log10 = unary("log10", jnp.log10)
+log1p = unary("log1p", jnp.log1p)
+sqrt = unary("sqrt", jnp.sqrt)
+rsqrt = unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = unary("square", jnp.square)
+abs = unary("abs", jnp.abs)
+absolute = abs
+ceil = unary("ceil", jnp.ceil)
+floor = unary("floor", jnp.floor)
+round = unary("round", jnp.round)
+trunc = unary("trunc", jnp.trunc)
+frac = unary("frac", lambda x: x - jnp.trunc(x))
+sign = unary("sign", jnp.sign)
+sin = unary("sin", jnp.sin)
+cos = unary("cos", jnp.cos)
+tan = unary("tan", jnp.tan)
+asin = unary("asin", jnp.arcsin)
+acos = unary("acos", jnp.arccos)
+atan = unary("atan", jnp.arctan)
+sinh = unary("sinh", jnp.sinh)
+cosh = unary("cosh", jnp.cosh)
+tanh = unary("tanh", jnp.tanh)
+asinh = unary("asinh", jnp.arcsinh)
+acosh = unary("acosh", jnp.arccosh)
+atanh = unary("atanh", jnp.arctanh)
+reciprocal = unary("reciprocal", lambda x: 1.0 / x)
+neg = unary("neg", jnp.negative)
+negative = neg
+erf = unary("erf", jax.lax.erf)
+erfinv = unary("erfinv", jax.lax.erf_inv)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+lgamma = unary("lgamma", jsp.gammaln)
+digamma = unary("digamma", jsp.digamma)
+i0 = unary("i0", jsp.i0)
+i0e = unary("i0e", jsp.i0e)
+i1 = unary("i1", jsp.i1)
+i1e = unary("i1e", jsp.i1e)
+angle = unary("angle", jnp.angle)
+conj = unary("conj", jnp.conj)
+real = unary("real", jnp.real)
+imag = unary("imag", jnp.imag)
+rad2deg = unary("rad2deg", jnp.rad2deg)
+deg2rad = unary("deg2rad", jnp.deg2rad)
+logit = unary("logit", jsp.logit)
+isnan = unary("isnan", jnp.isnan, inplace_variant=False)
+isinf = unary("isinf", jnp.isinf, inplace_variant=False)
+isfinite = unary("isfinite", jnp.isfinite, inplace_variant=False)
+
+# ---- binary elementwise ----
+add = binary("add", jnp.add)
+subtract = binary("subtract", jnp.subtract)
+multiply = binary("multiply", jnp.multiply)
+divide = binary("divide", jnp.true_divide)
+floor_divide = binary("floor_divide", jnp.floor_divide)
+remainder = binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = binary("pow", jnp.power)
+maximum = binary("maximum", jnp.maximum)
+minimum = binary("minimum", jnp.minimum)
+fmax = binary("fmax", jnp.fmax)
+fmin = binary("fmin", jnp.fmin)
+atan2 = binary("atan2", jnp.arctan2)
+hypot = binary("hypot", jnp.hypot)
+logaddexp = binary("logaddexp", jnp.logaddexp)
+heaviside = binary("heaviside", jnp.heaviside)
+gcd = binary("gcd", jnp.gcd)
+lcm = binary("lcm", jnp.lcm)
+nextafter = binary("nextafter", jnp.nextafter)
+copysign = binary("copysign", jnp.copysign)
+ldexp = binary("ldexp", jnp.ldexp)
+inner = binary("inner", jnp.inner)
+outer = binary("outer", jnp.outer)
+kron = binary("kron", jnp.kron)
+
+
+@register("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = raw(scale), raw(bias)
+    if bias_after_scale:
+        return apply(lambda v: v * s + b, as_tensor(x), name="scale")
+    return apply(lambda v: (v + b) * s, as_tensor(x), name="scale")
+
+
+@register("clip")
+def clip(x, min=None, max=None, name=None):
+    mn, mx = raw(min), raw(max)
+    return apply(lambda v: jnp.clip(v, mn, mx), as_tensor(x), name="clip")
+
+
+@register("lerp")
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), as_tensor(x),
+                     as_tensor(y), weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), as_tensor(x),
+                 as_tensor(y), name="lerp")
+
+
+@register("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), as_tensor(x),
+                 name="stanh")
+
+
+@register("multiplex")
+def multiplex(inputs, index, name=None):
+    idx = raw(as_tensor(index))
+    return apply(lambda *xs: jnp.stack(xs, 0)[jnp.squeeze(idx, -1),
+                                              jnp.arange(xs[0].shape[0])],
+                 *[as_tensor(i) for i in inputs], name="multiplex")
+
+
+@register("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf),
+                 as_tensor(x), name="nan_to_num")
+
+
+# ---- reductions ----
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _reduce(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+        d = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+        def f(v):
+            out = jfn(v, axis=ax, keepdims=keepdim)
+            return out.astype(d) if d is not None else out
+        return apply(f, as_tensor(x), name=name)
+    op.__name__ = name
+    register(name)(op)
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+
+
+@register("max")
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.max(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 as_tensor(x), name="max")
+
+
+@register("min")
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.min(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 as_tensor(x), name="min")
+
+
+@register("amax")
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+@register("amin")
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+@register("logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jsp.logsumexp(v, axis=_norm_axis(axis),
+                                         keepdims=keepdim),
+                 as_tensor(x), name="logsumexp")
+
+
+@register("all")
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 as_tensor(x), name="all")
+
+
+@register("any")
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 as_tensor(x), name="any")
+
+
+@register("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_norm_axis(axis),
+                                             keepdims=keepdim).astype(jnp.int32),
+                 as_tensor(x), name="count_nonzero")
+
+
+# ---- cumulative ----
+@register("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        out = jnp.cumsum(vv, axis=0 if axis is None else int(axis))
+        return out.astype(d) if d else out
+    return apply(f, as_tensor(x), name="cumsum")
+
+
+@register("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+
+    def f(v):
+        out = jnp.cumprod(v, axis=int(dim))
+        return out.astype(d) if d else out
+    return apply(f, as_tensor(x), name="cumprod")
+
+
+def _cum_extremum(x, axis, cmp, name):
+    """Shared cummax/cummin: associative scan carrying (value, index) pairs;
+    ties keep the later index (reference: paddle/phi/kernels/cum_maxmin_*)."""
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        idx = jnp.broadcast_to(
+            jnp.arange(vv.shape[ax], dtype=jnp.int32).reshape(
+                (-1,) + (1,) * (vv.ndim - ax - 1)), vv.shape)
+
+        def combine(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = cmp(bv, av)
+            return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+        vals, inds = jax.lax.associative_scan(combine, (vv, idx), axis=ax)
+        return vals, inds
+    return apply(f, as_tensor(x), name=name)
+
+
+@register("cummax", tensor_method=False)
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extremum(x, axis, lambda b, a: b >= a, "cummax")
+
+
+@register("cummin", tensor_method=False)
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extremum(x, axis, lambda b, a: b <= a, "cummin")
+
+
+@register("logcumsumexp")
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+    return apply(f, as_tensor(x), name="logcumsumexp")
+
+
+@register("diff", tensor_method=False)
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [as_tensor(x)]
+    pre = app = None
+    if prepend is not None:
+        pre = as_tensor(prepend)
+        args.append(pre)
+    if append is not None:
+        app = as_tensor(append)
+        args.append(app)
+
+    def f(v, *rest):
+        i = 0
+        p = a = None
+        if pre is not None:
+            p = rest[i]; i += 1
+        if app is not None:
+            a = rest[i]
+        return jnp.diff(v, n=n, axis=axis, prepend=p, append=a)
+    return apply(f, *args, name="diff")
+
+
+@register("trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                     axis2=axis2), as_tensor(x), name="trace")
+
+
+@register("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                        axis2=axis2),
+                 as_tensor(x), name="diagonal")
+
+
+@register("increment", tensor_method=False)
+def increment(x, value=1.0, name=None):
+    out = apply(lambda v: v + value, x, name="increment")
+    return x._inplace_from(out)
+
+
+@register("accuracy", tensor_method=False)
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    def f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = (topk == lab.reshape(-1, 1)).any(axis=-1)
+        return hit.mean(dtype=jnp.float32)
+    return apply(f, as_tensor(input), as_tensor(label), name="accuracy")

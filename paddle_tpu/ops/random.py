@@ -1,0 +1,172 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from .._core import dtype as dtypes
+from .._core.random import next_rng_key
+from ._registry import register, as_tensor, raw
+from .creation import _shape, _dt
+
+
+@register("rand", tensor_method=False)
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+@register("uniform", tensor_method=False)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = next_rng_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(raw(min)),
+                                     maxval=float(raw(max))), _internal=True)
+
+
+@register("randn", tensor_method=False)
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_rng_key(), _shape(shape), _dt(dtype)),
+                  _internal=True)
+
+
+@register("normal", tensor_method=False)
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean) if not np.isscalar(mean) else mean
+        s = as_tensor(std) if not np.isscalar(std) else std
+        mshape = (np.shape(raw(m)) if not np.isscalar(m) else
+                  np.shape(raw(s)))
+        key = next_rng_key()
+        eps = jax.random.normal(key, mshape, dtypes.get_default_dtype())
+        args = [t for t in (m, s) if isinstance(t, Tensor)]
+
+        def f(*vs):
+            i = 0
+            mm = vs[i] if isinstance(m, Tensor) else m
+            i += isinstance(m, Tensor)
+            ss = vs[i] if isinstance(s, Tensor) else s
+            return mm + ss * eps
+        return apply(f, *args, name="normal")
+    sh = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(
+        next_rng_key(), sh, dtypes.get_default_dtype()), _internal=True)
+
+
+@register("standard_normal", tensor_method=False)
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@register("gaussian", tensor_method=False)
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = next_rng_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape),
+                                                 _dt(dtype)), _internal=True)
+
+
+@register("randint", tensor_method=False)
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_rng_key(), _shape(shape),
+                                     int(raw(low)), int(raw(high)),
+                                     _dt(dtype, np.dtype("int64"))),
+                  _internal=True)
+
+
+@register("randint_like", tensor_method=False)
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = as_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+@register("randperm", tensor_method=False)
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_rng_key(), int(raw(n)))
+                  .astype(_dt(dtype, np.dtype("int64"))), _internal=True)
+
+
+@register("shuffle", tensor_method=False)
+def shuffle(x, axis=0, name=None):
+    perm = jax.random.permutation(next_rng_key(),
+                                  as_tensor(x).shape[int(axis)])
+    return apply(lambda v: jnp.take(v, perm, axis=int(axis)), as_tensor(x),
+                 name="shuffle")
+
+
+@register("multinomial", tensor_method=False)
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xv = raw(as_tensor(x))
+    key = next_rng_key()
+    logits = jnp.log(jnp.clip(xv, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + xv.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if xv.ndim > 1 else out
+    else:
+        g = jax.random.gumbel(key, xv.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int32), _internal=True)
+
+
+@register("bernoulli", tensor_method=False)
+def bernoulli(x, name=None):
+    p = raw(as_tensor(x))
+    return Tensor(jax.random.bernoulli(next_rng_key(), p).astype(
+        jnp.result_type(p)), _internal=True)
+
+
+@register("poisson", tensor_method=False)
+def poisson(x, name=None):
+    lam = raw(as_tensor(x))
+    return Tensor(jax.random.poisson(next_rng_key(), lam).astype(
+        jnp.result_type(lam)), _internal=True)
+
+
+@register("binomial", tensor_method=False)
+def binomial(count, prob, name=None):
+    n = raw(as_tensor(count))
+    p = raw(as_tensor(prob))
+    return Tensor(jax.random.binomial(next_rng_key(), n, p).astype(jnp.int32),
+                  _internal=True)
+
+
+@register("exponential_", tensor_method=False)
+def exponential_(x, lam=1.0, name=None):
+    x = as_tensor(x)
+    v = jax.random.exponential(next_rng_key(), tuple(x.shape),
+                               x.dtype) / lam
+    x._inplace_assign(v)
+    return x
+
+
+@register("normal_", tensor_method=False)
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x = as_tensor(x)
+    v = mean + std * jax.random.normal(next_rng_key(), tuple(x.shape), x.dtype)
+    x._inplace_assign(v)
+    return x
+
+
+@register("uniform_", tensor_method=False)
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x = as_tensor(x)
+    key = next_rng_key() if seed == 0 else jax.random.key(seed)
+    x._inplace_assign(jax.random.uniform(key, tuple(x.shape), x.dtype,
+                                         minval=min, maxval=max))
+    return x
+
+
+@register("rand_like", tensor_method=False)
+def rand_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return uniform(x.shape, dtype or x.dtype, 0.0, 1.0)
+
+
+@register("randn_like", tensor_method=False)
+def randn_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return randn(x.shape, dtype or x.dtype)

@@ -1,0 +1,184 @@
+"""Search / sort / statistics ops (reference: python/paddle/tensor/search.py,
+python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from ._registry import register, as_tensor, raw
+
+
+@register("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmax(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(jnp.int32)
+    return apply(f, as_tensor(x), name="argmax")
+
+
+@register("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmin(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(jnp.int32)
+    return apply(f, as_tensor(x), name="argmin")
+
+
+@register("argsort")
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=True,
+                          descending=descending)
+        return idx.astype(jnp.int32)
+    return apply(f, as_tensor(x), name="argsort")
+
+
+@register("sort", tensor_method=False)
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis, stable=True, descending=descending)
+        return out
+    return apply(f, as_tensor(x), name="sort")
+
+
+@register("topk")
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(raw(k))
+
+    def f(v):
+        ax = -1 if axis is None else int(axis)
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int32), -1, ax))
+    return apply(f, as_tensor(x), name="topk")
+
+
+@register("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        sv = jnp.sort(v, axis=axis)
+        si = jnp.argsort(v, axis=axis, stable=True)
+        val = jnp.take(sv, k - 1, axis=axis)
+        idx = jnp.take(si, k - 1, axis=axis).astype(jnp.int32)
+        if keepdim:
+            val = jnp.expand_dims(val, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return val, idx
+    return apply(f, as_tensor(x), name="kthvalue")
+
+
+@register("mode", tensor_method=False)
+def mode(x, axis=-1, keepdim=False, name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    import scipy.stats as st
+    m = st.mode(xv, axis=axis, keepdims=keepdim)
+    vals = m.mode
+    idx = np.apply_along_axis(
+        lambda a: a.shape[0] - 1 - np.argmax(a[::-1]), axis,
+        (xv == np.expand_dims(np.asarray(vals).squeeze(axis)
+                              if not keepdim else np.asarray(vals), axis)
+         if not keepdim else (xv == vals)))
+    if keepdim:
+        idx = np.expand_dims(idx, axis)
+    return (Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idx.astype(jnp.int32))))
+
+
+@register("median", tensor_method=False)
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdim)
+        vv = jnp.sort(v.reshape(-1) if axis is None else v,
+                      axis=0 if axis is None else axis)
+        ax = 0 if axis is None else axis
+        n = vv.shape[ax]
+        return jnp.take(vv, (n - 1) // 2, axis=ax)
+    return apply(f, as_tensor(x), name="median")
+
+
+@register("nanmedian", tensor_method=False)
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                 as_tensor(x), name="nanmedian")
+
+
+@register("quantile", tensor_method=False)
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    qv = raw(as_tensor(q)) if not np.isscalar(q) else q
+    return apply(lambda v: jnp.quantile(v, qv, axis=axis, keepdims=keepdim,
+                                        method=interpolation),
+                 as_tensor(x), name="quantile")
+
+
+@register("nanquantile", tensor_method=False)
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    qv = raw(as_tensor(q)) if not np.isscalar(q) else q
+    return apply(lambda v: jnp.nanquantile(v, qv, axis=axis, keepdims=keepdim,
+                                           method=interpolation),
+                 as_tensor(x), name="nanquantile")
+
+
+@register("std", tensor_method=False)
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), as_tensor(x), name="std")
+
+
+@register("var", tensor_method=False)
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), as_tensor(x), name="var")
+
+
+@register("searchsorted", tensor_method=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+
+    def f(s, v):
+        out = jnp.searchsorted(s, v, side=side) if s.ndim == 1 else \
+            jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                s.reshape(-1, s.shape[-1]),
+                v.reshape(-1, v.shape[-1])).reshape(v.shape)
+        return out.astype(jnp.int32)
+    return apply(f, as_tensor(sorted_sequence), as_tensor(values),
+                 name="searchsorted")
+
+
+@register("bucketize", tensor_method=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+@register("index_sample", tensor_method=False)
+def index_sample(x, index, name=None):
+    idx = raw(as_tensor(index))
+    return apply(lambda v: jnp.take_along_axis(v, idx, axis=1), as_tensor(x),
+                 name="index_sample")
+
+
+@register("histogramdd", tensor_method=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xv = np.asarray(raw(as_tensor(x)))
+    h, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density,
+                              weights=None if weights is None else
+                              np.asarray(raw(as_tensor(weights))))
+    return (Tensor(jnp.asarray(h)),
+            [Tensor(jnp.asarray(e)) for e in edges])
