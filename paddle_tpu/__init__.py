@@ -27,3 +27,34 @@ from ._core.random import seed, get_rng_state, set_rng_state  # noqa: F401,E402
 
 from .ops import *  # noqa: F401,F403,E402
 from . import ops  # noqa: E402
+
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import autograd  # noqa: E402
+from . import amp  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import framework  # noqa: E402
+from . import device  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from . import vision  # noqa: E402
+from . import hapi  # noqa: E402
+
+from .hapi.model import Model  # noqa: E402
+from .framework.io import save, load  # noqa: E402
+from .autograd.functional import grad  # noqa: E402
+from .autograd.py_layer import PyLayer  # noqa: E402
+from .nn.layer.layers import Layer  # noqa: E402  (paddle.nn.Layer also at paddle level in some code)
+from ._core.tensor import Parameter  # noqa: E402
+from .device import (  # noqa: E402
+    get_device, set_device, is_compiled_with_cuda, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_rocm, is_compiled_with_cinn,
+    is_compiled_with_distribute, CPUPlace, CUDAPlace, TPUPlace, XPUPlace,
+    CUDAPinnedPlace,
+)
+from .static import (  # noqa: E402
+    disable_static, enable_static, in_dynamic_mode,
+)
+from .jit.api import to_static  # noqa: E402  (paddle.jit.to_static)
+from ._core.dtype import convert_dtype  # noqa: E402

@@ -123,6 +123,17 @@ def _is_diff_dtype(d) -> bool:
     return dtypes.is_floating_point(d) or dtypes.is_complex(d)
 
 
+# AMP autocast hook, installed by paddle_tpu.amp (avoids an import cycle);
+# signature: (op_name, raw_values) -> raw_values
+# (reference: AMP branch generated into every ad_func,
+# paddle/fluid/eager/amp_auto_cast.h)
+_amp_hook = [None]
+
+
+def set_amp_hook(fn):
+    _amp_hook[0] = fn
+
+
 def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
     """Run primitive ``fn`` over raw values of ``args`` and record a tape node.
 
@@ -140,6 +151,9 @@ def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
             tensors.append((i, a))
         else:
             raw.append(a)
+
+    if _amp_hook[0] is not None:
+        raw = _amp_hook[0](name or getattr(fn, "__name__", ""), raw)
 
     track = is_grad_enabled() and any(
         (not t.stop_gradient) and _is_diff_dtype(t.dtype) for _, t in tensors)

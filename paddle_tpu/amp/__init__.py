@@ -1,0 +1,13 @@
+"""AMP: auto mixed precision (reference: python/paddle/amp/ —
+auto_cast.py:462 amp_guard, :1029 auto_cast, :1114 decorate;
+grad_scaler.py:62 AmpScaler, :657 GradScaler).
+
+On TPU the native mixed-precision story is bf16 (no loss scaling needed);
+fp16+GradScaler is kept for API parity and works identically.
+"""
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, decorate, amp_decorate, is_auto_cast_enabled,
+    get_amp_dtype, white_list, black_list,
+)
+from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
+from . import debugging  # noqa: F401

@@ -1,0 +1,160 @@
+"""auto_cast / decorate (reference: python/paddle/amp/auto_cast.py).
+
+O1: ops on the white list run in low precision — implemented as a thread-local
+policy consulted by the op layer's matmul/conv entry points (the reference
+swaps kernels per op via AmpAutoCasts; here the cast happens at trace level
+and XLA fuses the converts).
+O2: decorate() casts parameters themselves to low precision (pure fp16/bf16)
+with optional master weights kept by the optimizer.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .._core import dtype as dtypes
+from .._core.tensor import Tensor
+
+_state = threading.local()
+
+# reference: python/paddle/amp/amp_lists.py — white = matmul/conv-like
+white_list = {"matmul", "mm", "bmm", "mv", "einsum", "linear", "conv1d",
+              "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+              "conv3d_transpose", "addmm", "dot_general"}
+# black = numerically sensitive: stay fp32
+black_list = {"exp", "log", "log2", "log10", "log1p", "softmax",
+              "log_softmax", "cross_entropy", "mean", "sum", "norm",
+              "logsumexp", "cumsum", "layer_norm", "batch_norm", "group_norm",
+              "rms_norm", "softmax_with_cross_entropy"}
+
+
+def is_auto_cast_enabled() -> bool:
+    return getattr(_state, "enabled", False)
+
+
+def get_amp_dtype():
+    return getattr(_state, "dtype", dtypes.float16)
+
+
+def get_amp_level():
+    return getattr(_state, "level", "O0")
+
+
+def amp_white_op(name: str) -> bool:
+    st = getattr(_state, "lists", None)
+    wl = st[0] if st else white_list
+    return name in wl
+
+
+def amp_black_op(name: str) -> bool:
+    st = getattr(_state, "lists", None)
+    bl = st[1] if st else black_list
+    return name in bl
+
+
+def maybe_autocast_inputs(name, raw_values):
+    """Called by the op layer: cast float inputs of white-list ops to the amp
+    dtype under O1/O2 autocast."""
+    if not is_auto_cast_enabled():
+        return raw_values
+    if amp_black_op(name):
+        tgt = jnp.float32
+    elif amp_white_op(name):
+        tgt = get_amp_dtype()
+    else:
+        return raw_values
+    out = []
+    for v in raw_values:
+        if hasattr(v, "dtype") and jnp.issubdtype(
+                jnp.result_type(v), jnp.floating):
+            out.append(v.astype(tgt))
+        else:
+            out.append(v)
+    return out
+
+
+class auto_cast:
+    """reference: amp/auto_cast.py:1029."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="float16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtypes.convert_dtype(dtype)
+        wl = set(white_list)
+        bl = set(black_list)
+        if custom_white_list:
+            wl |= set(custom_white_list)
+            bl -= set(custom_white_list)
+        if custom_black_list:
+            bl |= set(custom_black_list)
+            wl -= set(custom_black_list)
+        self.lists = (wl, bl)
+
+    def __enter__(self):
+        self._saved = (getattr(_state, "enabled", False),
+                       getattr(_state, "dtype", dtypes.float16),
+                       getattr(_state, "level", "O0"),
+                       getattr(_state, "lists", None))
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level if self.enable else "O0"
+        _state.lists = self.lists
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.lists) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """reference: amp/auto_cast.py:1114 — O2 casts model params to amp dtype
+    (norm layers kept fp32 as the reference does for BN/LN)."""
+    d = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        from ..nn.layer import norm as norm_layers
+        skip_types = (norm_layers._BatchNormBase, norm_layers.LayerNorm,
+                      norm_layers.GroupNorm, norm_layers.InstanceNorm1D)
+        excluded = set()
+        if excluded_layers:
+            exl = excluded_layers if isinstance(excluded_layers,
+                                                (list, tuple)) \
+                else [excluded_layers]
+            for e in exl:
+                if isinstance(e, type):
+                    skip_types = skip_types + (e,)
+                else:
+                    excluded.add(id(e))
+        for m in model_list:
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, skip_types) or id(sub) in excluded:
+                    continue
+                for p in sub._parameters.values():
+                    if p is not None and dtypes.is_floating_point(p.dtype):
+                        if getattr(p, "_master", None) is None:
+                            p._master = Tensor(
+                                p._value.astype(jnp.float32),
+                                _internal=True)
+                        p._inplace_assign(p._value.astype(d))
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+# install the autocast hook into the op dispatch layer
+from .._core.autograd import set_amp_hook  # noqa: E402
+
+set_amp_hook(maybe_autocast_inputs)

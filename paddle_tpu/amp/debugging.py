@@ -1,0 +1,120 @@
+"""Numeric debugging (reference: python/paddle/amp/debugging.py —
+TensorCheckerConfig:173, check_numerics:361, op-stats :481).
+
+TPU-native: instead of per-kernel nan/inf CUDA checks, a debug-mode hook on
+the op dispatch layer inspects every op output (eager) — jit-compiled paths
+use jax.debug/checkify when enabled.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.flags import flag_value, set_flags
+from .._core.tensor import Tensor
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """reference: amp/debugging.py:173."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+
+    def update_and_check_step_id(self):
+        return self.enable
+
+
+_checker: Optional[TensorCheckerConfig] = None
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    global _checker
+    _checker = checker_config
+    set_flags({"check_nan_inf": checker_config.enable})
+
+
+def disable_tensor_checker():
+    global _checker
+    _checker = None
+    set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """reference: amp/debugging.py:361 — returns (num_nan, num_inf, num_zero)
+    and raises under abort mode."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(jnp.result_type(v), jnp.floating):
+        z = Tensor(np.asarray(0, np.int32))
+        return z, z, z
+    n_nan = int(jnp.isnan(v).sum())
+    n_inf = int(jnp.isinf(v).sum())
+    n_zero = int((v == 0).sum())
+    mode = debug_mode or (_checker.debug_mode if _checker else
+                          DebugMode.CHECK_NAN_INF)
+    if (n_nan or n_inf) and mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{n_nan} nan, {n_inf} inf detected")
+    return (Tensor(np.asarray(n_nan, np.int32)),
+            Tensor(np.asarray(n_inf, np.int32)),
+            Tensor(np.asarray(n_zero, np.int32)))
+
+
+_op_stats = {}
+
+
+def collect_operator_stats():
+    """reference: amp/debugging.py:481 — context collecting per-dtype op
+    counts from the dispatch layer."""
+    class _Ctx:
+        def __enter__(self):
+            _op_stats.clear()
+            from .._core import autograd as ag
+            self._prev = ag._amp_hook[0]
+
+            def hook(name, raw):
+                for v in raw:
+                    if hasattr(v, "dtype"):
+                        key = (name, str(jnp.result_type(v)))
+                        _op_stats[key] = _op_stats.get(key, 0) + 1
+                        break
+                return self._prev(name, raw) if self._prev else raw
+            ag.set_amp_hook(hook)
+            return self
+
+        def __exit__(self, *exc):
+            from .._core import autograd as ag
+            ag.set_amp_hook(self._prev)
+            fp16 = {k: v for k, v in _op_stats.items() if "16" in k[1]}
+            fp32 = {k: v for k, v in _op_stats.items() if "32" in k[1]}
+            print("<------------------- op list of all dtypes ------------->")
+            for (op, dt), c in sorted(_op_stats.items()):
+                print(f"  {op:30s} {dt:10s} calls={c}")
+            print(f"fp16/bf16 ops: {sum(fp16.values())}, "
+                  f"fp32 ops: {sum(fp32.values())}")
+            return False
+    return _Ctx()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "accuracy_compare tooling requires dump files; use "
+        "collect_operator_stats / check_numerics on TPU")

@@ -1,0 +1,6 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd/)."""
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import grad, jacobian, hessian, vjp, jvp  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+from .._core.autograd import backward, no_grad, enable_grad, \
+    is_grad_enabled, set_grad_enabled  # noqa: F401

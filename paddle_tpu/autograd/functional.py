@@ -1,0 +1,102 @@
+"""Functional autodiff APIs (reference: python/paddle/autograd/autograd.py
+jacobian/hessian; python/paddle/incubate/autograd vjp/jvp). On the jax
+substrate these delegate to jax.jacobian/jax.hessian for exactness."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .._core.autograd import grad as _tape_grad
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """reference: paddle.grad (python/paddle/base/dygraph/base.py grad)."""
+    return _tape_grad(outputs, inputs, grad_outputs, retain_graph,
+                      create_graph, only_inputs, allow_unused)
+
+
+def _wrap_fn(func):
+    def raw_fn(*vals):
+        ts = [Tensor(v, stop_gradient=False, _internal=True) for v in vals]
+        out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return raw_fn
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """reference: autograd/autograd.py jacobian — here func-form:
+    jacobian(func, xs) or tensor-form handled via tape."""
+    if callable(ys):
+        func = _wrap_fn(ys)
+        single = isinstance(xs, Tensor)
+        vals = [xs._value] if single else [x._value for x in xs]
+        jac = jax.jacobian(func, argnums=tuple(range(len(vals))))(*vals)
+        if single:
+            jac = jac[0]
+            return Tensor(jac, _internal=True)
+        return [Tensor(j, _internal=True) for j in jac]
+    # tensor form: ys computed from xs on the tape — use vjp rows
+    single_x = isinstance(xs, Tensor)
+    xs_l = [xs] if single_x else list(xs)
+    y = ys if isinstance(ys, Tensor) else ys[0]
+    yv = y._value.reshape(-1)
+    rows = []
+    for i in range(yv.shape[0]):
+        seed = jnp.zeros_like(yv).at[i].set(1.0).reshape(y._value.shape)
+        gs = _tape_grad([y], xs_l,
+                        grad_outputs=[Tensor(seed, _internal=True)],
+                        retain_graph=True, allow_unused=True)
+        rows.append([g._value.reshape(-1) if g is not None else
+                     jnp.zeros(x.size) for g, x in zip(gs, xs_l)])
+    jacs = []
+    for j, x in enumerate(xs_l):
+        m = jnp.stack([r[j] for r in rows], 0)
+        jacs.append(Tensor(m.reshape(tuple(y.shape) + tuple(x.shape)),
+                           _internal=True))
+    return jacs[0] if single_x else jacs
+
+
+def hessian(func, xs, batch_axis=None):
+    if not callable(func):
+        raise TypeError("hessian expects a callable (func-form API)")
+    f = _wrap_fn(func)
+    single = isinstance(xs, Tensor)
+    vals = [xs._value] if single else [x._value for x in xs]
+    h = jax.hessian(f, argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(h[0][0], _internal=True)
+    return [[Tensor(hij, _internal=True) for hij in hi] for hi in h]
+
+
+def vjp(func, xs, v=None):
+    """reference: python/paddle/incubate/autograd/primapi vjp."""
+    f = _wrap_fn(func)
+    single = isinstance(xs, Tensor)
+    vals = [xs._value] if single else [x._value for x in xs]
+    out, vjp_fn = jax.vjp(f, *vals)
+    if v is None:
+        cot = jnp.ones_like(out)
+    else:
+        cot = v._value if isinstance(v, Tensor) else v
+    grads = vjp_fn(cot)
+    gt = [Tensor(g, _internal=True) for g in grads]
+    return (Tensor(out, _internal=True), gt[0] if single else gt)
+
+
+def jvp(func, xs, v=None):
+    f = _wrap_fn(func)
+    single = isinstance(xs, Tensor)
+    vals = [xs._value] if single else [x._value for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        vs = [v] if isinstance(v, Tensor) else v
+        tangents = [t._value if isinstance(t, Tensor) else t for t in vs]
+    out, tan = jax.jvp(f, tuple(vals), tuple(tangents))
+    return Tensor(out, _internal=True), Tensor(tan, _internal=True)

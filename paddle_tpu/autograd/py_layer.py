@@ -1,0 +1,123 @@
+"""PyLayer: user-defined differentiable ops
+(reference: python/paddle/autograd/py_layer.py — PyLayerContext:36,
+PyLayer:282).
+
+The forward/backward staticmethods run eagerly over Tensors; the tape records
+a node whose vjp closure calls the user's backward. (jax.custom_vjp is the
+analog for the functional/jit path — see paddle_tpu.incubate.jax_custom_vjp.)
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .._core import autograd as ag
+
+
+class PyLayerContext:
+    """reference: py_layer.py:36."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensor_(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = args
+
+    def set_materialize_grads(self, value):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """reference: py_layer.py:282. Subclass with @staticmethod forward and
+    backward; call via .apply()."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        # run user forward under no_grad: user saves tensors explicitly
+        with ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_inputs
+                       if not t.stop_gradient and
+                       jnp.issubdtype(jnp.result_type(t._value),
+                                      jnp.inexact)]
+        track = ag.is_grad_enabled() and bool(diff_inputs)
+
+        is_seq = isinstance(outputs, (tuple, list))
+        flat_outs = list(outputs) if is_seq else [outputs]
+        out_tensors = [o for o in flat_outs if isinstance(o, Tensor)]
+
+        if not track:
+            return outputs
+
+        out_meta = [(tuple(o.shape), jnp.result_type(o._value))
+                    for o in out_tensors]
+
+        def vjp_fn(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            cot_tensors = [Tensor(c, stop_gradient=True, _internal=True)
+                           for c in cots]
+            with ag.no_grad():
+                grads = cls.backward(ctx, *cot_tensors) \
+                    if len(cot_tensors) > 1 else \
+                    cls.backward(ctx, cot_tensors[0])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            gv = [g._value if isinstance(g, Tensor) else g for g in grads]
+            gv = [g for g in gv if g is not None]
+            if len(gv) != len(diff_inputs):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(gv)} grads "
+                    f"but forward had {len(diff_inputs)} differentiable "
+                    "tensor inputs")
+            return tuple(gv)
+
+        node = ag.Node(vjp_fn, diff_inputs, out_meta, len(out_tensors) > 1,
+                       name=cls.__name__)
+        for k, o in enumerate(out_tensors):
+            o._stop_gradient = False
+            o._node = node
+            o._out_index = k
+        return outputs
+
+
+class LegacyPyLayer(PyLayer):
+    pass
+
+
+def once_differentiable(fn):
+    return fn

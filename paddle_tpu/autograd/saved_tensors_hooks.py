@@ -1,0 +1,30 @@
+"""saved_tensors_hooks (reference: python/paddle/autograd/saved_tensors_hooks.py).
+
+On the jax substrate saved activations are immutable arrays captured in vjp
+closures; the pack/unpack hook pair is honored for PyLayer-saved tensors and
+kept for API parity (offload-to-host packing works via jax.device_put).
+"""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+def get_hooks():
+    return getattr(_state, "hooks", None)
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._old = get_hooks()
+        _state.hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        _state.hooks = self._old
+        return False

@@ -1,0 +1,169 @@
+"""Device management (reference: python/paddle/device/__init__.py).
+
+TPU is the first-class accelerator; Place classes are kept for API parity
+and map onto jax devices.
+"""
+import jax
+
+
+class Place:
+    def __init__(self, kind, device_id=0):
+        self._kind = kind
+        self._id = device_id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self._kind, self._id) == \
+            (other._kind, other._id)
+
+    def __hash__(self):
+        return hash((self._kind, self._id))
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPlace(Place):
+    # parity alias: "cuda" requests mean "the accelerator" on this framework
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class XPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+_current = None
+
+
+def get_device():
+    global _current
+    if _current is not None:
+        return _current
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend == "cpu":
+        return "cpu"
+    return f"{backend}:0"
+
+
+def set_device(device):
+    global _current
+    _current = device
+    return get_device()
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def is_compiled_with_cinn():
+    # XLA plays CINN's role on this framework
+    return True
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mkldnn():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+class cuda:
+    """Namespace parity for paddle.device.cuda — maps to the accelerator."""
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax as _j
+        (_j.device_put(0) + 0).block_until_ready()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        d = jax.devices()[0]
+        try:
+            stats = d.memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        d = jax.devices()[0]
+        try:
+            return d.memory_stats().get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return cuda.max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return cuda.memory_allocated(device)
+
+
+def synchronize(device=None):
+    cuda.synchronize(device)

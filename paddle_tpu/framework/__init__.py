@@ -1,0 +1,15 @@
+"""paddle_tpu.framework (reference: python/paddle/framework/)."""
+from .io import save, load  # noqa: F401
+from .._core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .._core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from .._core.random import default_generator  # noqa: F401
+
+
+def get_flags(names):
+    from .._core.flags import get_flags as f
+    return f(names)
+
+
+def set_flags(flags):
+    from .._core.flags import set_flags as f
+    return f(flags)

@@ -1,0 +1,77 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py —
+save:773, load:1020). Pickle protocol with tensors materialised as numpy
+arrays, matching the reference's layout closely enough for state_dict
+round-trips."""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .._core.tensor import Tensor, Parameter
+
+
+def _to_saveable(obj):
+    if isinstance(obj, (Tensor,)):
+        return _TensorPayload(np.asarray(obj._value), obj.name,
+                              isinstance(obj, Parameter),
+                              obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "name", "is_param", "stop_gradient")
+
+    def __init__(self, array, name, is_param, stop_gradient):
+        self.array = array
+        self.name = name
+        self.is_param = is_param
+        self.stop_gradient = stop_gradient
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            t = Parameter(obj.array, name=obj.name,
+                          trainable=not obj.stop_gradient)
+        else:
+            t = Tensor(obj.array, name=obj.name,
+                       stop_gradient=obj.stop_gradient)
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """reference: framework/io.py:773."""
+    if hasattr(path, "write"):
+        pickle.dump(_to_saveable(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """reference: framework/io.py:1020."""
+    return_numpy = configs.get("return_numpy", False)
+    if hasattr(path, "read"):
+        return _from_saveable(pickle.load(path), return_numpy)
+    with open(path, "rb") as f:
+        return _from_saveable(pickle.load(f), return_numpy)

@@ -1,0 +1,4 @@
+"""paddle_tpu.hapi (reference: python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .summary import summary  # noqa: F401

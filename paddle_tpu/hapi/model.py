@@ -1,0 +1,359 @@
+"""paddle.Model high-level API (reference: python/paddle/hapi/model.py —
+Model:1472, fit:2200, train_batch:1625, _run_one_epoch:2772).
+
+TPU-native execution: train/eval batches run through jit-compiled fused steps
+(paddle_tpu.jit.TrainStep/EvalStep) — the reference's DynamicGraphAdapter
+per-op dispatch is replaced by one XLA program per step. Set
+``use_compiled=False`` to fall back to pure eager (tape) execution.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from .._core import autograd as ag
+from ..nn.layer.layers import Layer
+from ..metric.metrics import Metric
+from ..framework.io import save as fsave, load as fload
+from ..jit.api import TrainStep, EvalStep, InputSpec
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """reference: hapi/model.py:1472."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self.stop_training = False
+        self._train_step = None
+        self._eval_step = None
+        self._use_compiled = True
+
+    # ---- configuration ----
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, use_compiled=True):
+        """reference: model.py prepare. ``amp_configs``: dict with 'level'
+        ('O1'/'O2'), 'dtype', 'init_loss_scaling', ... (reference:
+        model.py _check_amp_configs)."""
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or
+                                     callable(loss)):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu.metric."
+                                "Metric")
+        self._use_compiled = use_compiled
+        self._scaler = None
+        self._amp_level = "O0"
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            from ..amp import decorate as amp_decorate, GradScaler
+            from .._core import dtype as dtypes
+            self._amp_level = amp_configs.get("level", "O1")
+            dtype = amp_configs.get("dtype", "float16")
+            if self._amp_level == "O2":
+                amp_decorate(self.network, level="O2", dtype=dtype)
+            if dtypes.convert_dtype(dtype) == dtypes.float16 and \
+                    self._amp_level in ("O1", "O2"):
+                self._scaler = GradScaler(
+                    init_loss_scaling=amp_configs.get(
+                        "init_loss_scaling", 2.0 ** 15))
+        self._train_step = None
+        self._eval_step = None
+        self._accumulate = 1
+        return self
+
+    # ---- single-batch APIs ----
+    def train_batch(self, inputs, labels=None, update=True):
+        """reference: model.py:1625."""
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        self.network.train()
+        if not update and self._use_compiled:
+            # manual grad accumulation requested: the compiled step owns
+            # parameter state, so hand control back to eager mode (for the
+            # compiled equivalent use fit(accumulate_grad_batches=N))
+            warnings.warn(
+                "train_batch(update=False) switches this Model to eager "
+                "execution; prefer fit(accumulate_grad_batches=N) for the "
+                "compiled path")
+            self._sync_if_needed()
+            self._use_compiled = False
+            self._train_step = None
+        if self._use_compiled:
+            if self._train_step is None:
+                self._train_step = TrainStep(
+                    self.network, self._loss, self._optimizer,
+                    scaler=self._scaler,
+                    accumulate_steps=getattr(self, "_accumulate", 1),
+                    return_outputs=True)
+            loss, outs = self._train_step(tuple(inputs), tuple(labels))
+            metrics = []
+            for m in self._metrics:
+                m_in = m.compute(*outs, *labels)
+                metrics.append(m.update(m_in))
+            return self._pack_loss_metrics(loss, metrics)
+        # eager path
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        loss = self._loss(*outs, *labels)
+        if self._scaler is not None:
+            self._scaler.scale(loss).backward()
+            if update:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m_in = m.compute(*outs, *labels)
+            metrics.append(m.update(m_in))
+        return self._pack_loss_metrics(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        self.network.eval()
+        self._sync_if_needed()
+        if self._eval_step is None:
+            self._eval_step = EvalStep(self.network)
+        out = self._eval_step(*inputs)
+        outs = _to_list(out)
+        losses = None
+        if self._loss is not None:
+            with ag.no_grad():
+                losses = self._loss(*outs, *labels)
+        metrics = []
+        for m in self._metrics:
+            m_in = m.compute(*outs, *labels)
+            metrics.append(m.update(m_in))
+        return self._pack_loss_metrics(losses, metrics) if losses is not None \
+            else metrics
+
+    def predict_batch(self, inputs):
+        inputs = _to_list(inputs)
+        self.network.eval()
+        self._sync_if_needed()
+        if self._eval_step is None:
+            self._eval_step = EvalStep(self.network)
+        out = self._eval_step(*inputs)
+        return [o.numpy() for o in _to_list(out)]
+
+    def _pack_loss_metrics(self, loss, metrics):
+        lv = [np.asarray(loss.numpy()).reshape(1)] if isinstance(
+            loss, Tensor) else [np.asarray(loss).reshape(1)]
+        if self._metrics:
+            return lv, metrics
+        return lv
+
+    def _sync_if_needed(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+            self._train_step.sync_from_model()
+
+    # ---- fit / evaluate / predict ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference: model.py:2200."""
+        from ..io.dataloader import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        self._accumulate = max(1, int(accumulate_grad_batches))
+        if self._accumulate > 1 and self._train_step is not None and \
+                self._train_step.accumulate_steps != self._accumulate:
+            self._sync_if_needed()
+            self._train_step = None
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch)
+                res = self.train_batch(ins, lbs)
+                logs = self._update_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _from_fit=True)
+                cbks.on_eval_end(eval_logs)
+        self._sync_if_needed()
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None,
+                 _from_fit=False):
+        from ..io.dataloader import DataLoader
+        from ..io.dataset import Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, lbs = self._split_batch(batch)
+            res = self.eval_batch(ins, lbs)
+            if isinstance(res, tuple):
+                losses.append(res[0][0])
+            elif isinstance(res, list) and res and isinstance(
+                    res[0], np.ndarray):
+                losses.append(res[0])
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        if losses:
+            logs["loss"] = [float(np.mean([np.ravel(l)[0]
+                                           for l in losses]))]
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io.dataloader import DataLoader
+        from ..io.dataset import Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, predict=True)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+        # transpose list-of-batches -> list-of-outputs
+        n_out = len(outputs[0]) if outputs else 0
+        res = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            res = [np.concatenate(r, axis=0) for r in res]
+        return res
+
+    def _split_batch(self, batch, predict=False):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            n_in = len(self._inputs) if self._inputs else (
+                len(batch) - (len(self._labels) if self._labels else 1))
+            if predict and len(batch) <= n_in:
+                return batch, []
+            if n_in <= 0:
+                n_in = max(len(batch) - 1, 1)
+            return batch[:n_in], batch[n_in:]
+        return [batch], []
+
+    def _update_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs["loss"] = [float(np.ravel(l)[0]) for l in losses]
+            for m, v in zip(self._metrics, metrics):
+                names = m.name() if isinstance(m.name(), list) else [m.name()]
+                vals = np.ravel(v).tolist()
+                for n, val in zip(names, vals):
+                    logs[n] = val
+        else:
+            logs["loss"] = [float(np.ravel(l)[0]) for l in res]
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # ---- state ----
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def state_dict(self):
+        self._sync_if_needed()
+        return self.network.state_dict()
+
+    def save(self, path, training=True):
+        """reference: model.py save — <path>.pdparams + <path>.pdopt."""
+        self._sync_if_needed()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+        self._train_step = None
+        self._eval_step = None
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtype)

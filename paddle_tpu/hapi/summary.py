@@ -1,0 +1,63 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(p.size for p in l._parameters.values()
+                           if p is not None)
+            rows.append((name, type(l).__name__, shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            register(sub, name)
+
+    if input is not None:
+        x = input
+    elif input_size is not None:
+        if isinstance(input_size, tuple) and input_size and \
+                isinstance(input_size[0], (tuple, list)):
+            x = [Tensor(np.zeros(s, np.float32)) for s in input_size]
+        else:
+            x = Tensor(np.zeros(tuple(input_size), np.float32))
+    else:
+        x = None
+    try:
+        if x is not None:
+            was_training = net.training
+            net.eval()
+            net(*x) if isinstance(x, list) else net(x)
+            if was_training:
+                net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(p.size) for p in net.parameters())
+    trainable = sum(int(p.size) for p in net.parameters()
+                    if not p.stop_gradient)
+    if rows:
+        w = max(len(r[0]) for r in rows) + 2
+        print(f"{'Layer':{w}s}{'Type':22s}{'Output Shape':20s}{'Params':>12s}")
+        print("-" * (w + 54))
+        for name, t, shape, n in rows:
+            print(f"{name:{w}s}{t:22s}{str(shape):20s}{n:>12,d}")
+        print("-" * (w + 54))
+    print(f"Total params: {total:,d}")
+    print(f"Trainable params: {trainable:,d}")
+    print(f"Non-trainable params: {total - trainable:,d}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,8 @@
+"""paddle_tpu.io (reference: python/paddle/io/)."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split, Sampler, SequenceSampler,
+    RandomSampler, WeightedRandomSampler, SubsetRandomSampler, BatchSampler,
+    DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

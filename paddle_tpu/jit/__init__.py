@@ -1,0 +1,6 @@
+"""paddle_tpu.jit (reference: python/paddle/jit/)."""
+from .api import (  # noqa: F401
+    to_static, not_to_static, ignore_module, enable_to_static, InputSpec,
+    StaticFunction, TrainStep, EvalStep, train_step,
+)
+from .save_load import save, load, TranslatedLayer  # noqa: F401

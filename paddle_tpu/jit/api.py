@@ -1,0 +1,371 @@
+"""jit: to_static + compiled train step.
+
+TPU-native re-design of the reference's dy2static stack
+(reference: python/paddle/jit/api.py:197 to_static; SOT bytecode tracer
+python/paddle/jit/sot/ — 2,500-line opcode interpreter). On XLA none of that
+machinery is needed: Tensors are jax pytree nodes, so ``jax.jit`` traces the
+same imperative code directly. What remains of the reference's semantics is
+guard-based retracing (shape/dtype guards == jax's abstract-value cache keys)
+and graph-break-free capture of Layer state (via Layer.functional_call).
+
+``train_step`` is the performance path: forward+backward+optimizer update in
+ONE compiled XLA program with donated buffers — the analog of the reference's
+whole-Program executor path (SURVEY §3.3) but fused end-to-end.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .._core import autograd as ag
+from .._core.random import rng_scope, next_rng_key
+from ..nn.layer.layers import Layer
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def _current_amp_key():
+    """Snapshot of the thread-local autocast state — used as a static jit
+    argument so entering/exiting auto_cast retraces instead of silently
+    hitting a cached program."""
+    from ..amp.auto_cast import (is_auto_cast_enabled, get_amp_dtype,
+                                 get_amp_level)
+    if not is_auto_cast_enabled():
+        return None
+    return (str(get_amp_dtype()), get_amp_level())
+
+
+def _amp_ctx(amp_key):
+    import contextlib
+    if amp_key is None:
+        return contextlib.nullcontext()
+    from ..amp.auto_cast import auto_cast
+    return auto_cast(level=amp_key[1], dtype=amp_key[0])
+
+
+class StaticFunction:
+    """Callable produced by to_static (reference: dy2static
+    program_translator.py StaticFunction). Guards = jax jit cache keys."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 input_spec=None, build_strategy=None, backend=None,
+                 full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn)
+
+        if layer is not None:
+            orig_forward = fn
+
+            def traced(params, buffers, training, amp_key, args, kwargs):
+                with _amp_ctx(amp_key), ag.no_grad():
+                    # jax.jit differentiates; skip the tape
+                    out, new_buffers = layer.functional_call(
+                        params, *args, buffers=buffers, training=training,
+                        capture_buffers=True, forward_fn=orig_forward,
+                        **kwargs)
+                return out, new_buffers
+            self._jitted = jax.jit(traced, static_argnums=(2, 3))
+        else:
+            def traced(amp_key, args, kwargs):
+                with _amp_ctx(amp_key), ag.no_grad():
+                    return fn(*args, **kwargs)
+            self._jitted = jax.jit(traced, static_argnums=(0,))
+
+    @property
+    def _cache_size(self):
+        try:
+            return self._jitted._cache_size()
+        except Exception:
+            return -1
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is not None:
+            params = self._layer.raw_parameters()
+            buffers = self._layer.raw_buffers()
+            out, new_buffers = self._jitted(params, buffers,
+                                            self._layer.training,
+                                            _current_amp_key(), args,
+                                            kwargs)
+            if new_buffers:
+                namedb = dict(self._layer.named_buffers())
+                for k, v in new_buffers.items():
+                    namedb[k]._inplace_assign(v)
+            return out
+        return self._jitted(_current_amp_key(), args, kwargs)
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """reference: python/paddle/jit/api.py:197."""
+    def decorate(f):
+        if isinstance(f, Layer):
+            sf = StaticFunction(f.forward, layer=f, input_spec=input_spec)
+            f.forward = sf
+            return f
+        # bound method of a Layer?
+        self_obj = getattr(f, "__self__", None)
+        if isinstance(self_obj, Layer):
+            return StaticFunction(f, layer=self_obj, input_spec=input_spec)
+        return StaticFunction(f, input_spec=input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def enable_to_static(flag: bool):
+    pass
+
+
+class TrainStep:
+    """One fused XLA program per (shapes, training-config): forward + loss +
+    grad + (scaled/accumulated) optimizer update + buffer update, with
+    params/opt-state donated.
+
+    - ``scaler``: a GradScaler — loss scaling, grad unscaling, non-finite
+      skip, and dynamic scale update all happen INSIDE the compiled step
+      (lax.cond), with only the scalar scale/counters living host-side.
+    - ``accumulate_steps``: gradient accumulation (reference:
+      gradient_merge_optimizer) — grads accumulate in device buffers and the
+      optimizer applies every N calls.
+    - ``return_outputs``: also return the forward outputs so callers (hapi
+      metrics) don't need a second forward.
+
+    Usage:
+        step = TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)          # device-resident fast path
+        step.sync_to_model()       # write params back into the Layer
+    """
+
+    def __init__(self, model: Layer, loss_fn, optimizer, scaler=None,
+                 accumulate_steps=1, return_outputs=False):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.scaler = scaler if (scaler is not None and
+                                 getattr(scaler, "_enable", True)) else None
+        self.accumulate_steps = int(accumulate_steps)
+        self.return_outputs = return_outputs
+        named = dict(model.named_parameters())
+        self._trainable = {k: p for k, p in named.items()
+                           if not p.stop_gradient}
+        self._frozen = {k: p._value for k, p in named.items()
+                        if p.stop_gradient}
+        # copy: step arguments are donated to XLA, and the model's own
+        # Tensors must keep valid arrays for eager access mid-training
+        self.params = {k: jnp.array(p._value)
+                       for k, p in self._trainable.items()}
+        init_state, self._opt_update = optimizer.build_functional(named)
+        self.opt_state = init_state(self.params)
+        if self.accumulate_steps > 1:
+            self.opt_state = {
+                "opt": self.opt_state,
+                "acc": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
+                    self.params)}
+        self.buffers = {k: jnp.array(v)
+                        for k, v in model.raw_buffers().items()}
+        self._step_count = 0
+        # amp autocast state is captured at construction: it is trace-time
+        # config, not part of jit cache keys
+        from ..amp.auto_cast import (is_auto_cast_enabled, get_amp_dtype,
+                                     get_amp_level)
+        self._amp_state = (is_auto_cast_enabled(), str(get_amp_dtype()),
+                           get_amp_level())
+        self._compiled = jax.jit(self._make_fn(), donate_argnums=(0, 1, 2))
+
+    def _make_fn(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        frozen = self._frozen
+        opt_update = self._opt_update
+        use_scaler = self.scaler is not None
+        accum = self.accumulate_steps
+        amp_enabled, amp_dtype, amp_level = self._amp_state
+
+        def forward_loss(p, buffers, rng, inputs, labels, scale):
+            allp = dict(frozen)
+            allp.update(p)
+            ctx = rng_scope(rng)
+            from ..amp.auto_cast import auto_cast as _autocast
+            import contextlib
+            amp_ctx = _autocast(level=amp_level, dtype=amp_dtype) \
+                if amp_enabled else contextlib.nullcontext()
+            with ctx, amp_ctx, ag.no_grad():
+                # no_grad skips the python tape; jax.value_and_grad
+                # differentiates the traced program itself
+                out, new_buffers = model.functional_call(
+                    allp,
+                    *[Tensor(b, _internal=True) for b in inputs],
+                    buffers=buffers, training=True,
+                    capture_buffers=True)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                lbls = [Tensor(l, _internal=True) for l in labels]
+                loss = loss_fn(*outs, *lbls)
+                lv = loss._value if isinstance(loss, Tensor) else loss
+            out_vals = tuple(o._value if isinstance(o, Tensor) else o
+                             for o in outs)
+            if use_scaler:
+                lv_scaled = lv * scale
+                return lv_scaled, (new_buffers, out_vals, lv)
+            return lv, (new_buffers, out_vals, lv)
+
+        def step_fn(params, opt_state, buffers, step, lr, rng, scale,
+                    inputs, labels):
+            (_, (new_buffers, out_vals, loss_val)), grads = \
+                jax.value_and_grad(forward_loss, has_aux=True)(
+                    params, buffers, rng, inputs, labels, scale)
+            if use_scaler:
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                found_inf = jnp.any(jnp.stack([
+                    ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)]))
+            else:
+                found_inf = jnp.asarray(False)
+
+            if accum > 1:
+                acc = opt_state["acc"]
+                # skip accumulating non-finite microbatch grads entirely
+                acc = {k: jnp.where(found_inf, acc[k],
+                                    acc[k] + grads[k].astype(jnp.float32) /
+                                    accum)
+                       for k in acc}
+                apply_now = (step % accum == 0) & (~found_inf)
+
+                def do_update(_):
+                    np_, ns = opt_update(params, acc, opt_state["opt"],
+                                         step // accum, lr)
+                    zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                    return np_, {"opt": ns, "acc": zero}
+
+                def no_update(_):
+                    return params, {"opt": opt_state["opt"], "acc": acc}
+                new_params, new_state = jax.lax.cond(apply_now, do_update,
+                                                     no_update, None)
+            elif use_scaler:
+                def do_update(_):
+                    return opt_update(params, grads, opt_state, step, lr)
+
+                def no_update(_):
+                    return params, opt_state
+                new_params, new_state = jax.lax.cond(~found_inf, do_update,
+                                                     no_update, None)
+            else:
+                new_params, new_state = opt_update(params, grads, opt_state,
+                                                   step, lr)
+            return (loss_val, new_params, new_state, new_buffers, found_inf,
+                    out_vals)
+        return step_fn
+
+    def __call__(self, inputs, labels=()):
+        if isinstance(inputs, Tensor):
+            inputs = (inputs,)
+        if isinstance(labels, Tensor):
+            labels = (labels,)
+        self._step_count += 1
+        lr = self.optimizer.get_lr()
+        rng = next_rng_key()
+        scale = jnp.float32(self.scaler.get_scale()) if self.scaler \
+            else jnp.float32(1.0)
+        (loss, self.params, self.opt_state, self.buffers, found_inf,
+         out_vals) = self._compiled(
+            self.params, self.opt_state, self.buffers,
+            self._step_count, lr, rng, scale,
+            tuple(_raw(b) for b in inputs), tuple(_raw(l) for l in labels))
+        if self.scaler is not None:
+            self.scaler._found_inf = bool(found_inf)
+            self.scaler.update()
+        self._last_outputs = out_vals
+        if self.return_outputs:
+            return (Tensor(loss, _internal=True),
+                    tuple(Tensor(o, _internal=True) for o in out_vals))
+        return Tensor(loss, _internal=True)
+
+    def sync_to_model(self):
+        # copies: self.params will be donated on the next call, and the
+        # model must keep independently-owned arrays
+        for k, p in self._trainable.items():
+            p._inplace_assign(jnp.array(self.params[k]))
+        namedb = dict(self.model.named_buffers())
+        for k, v in self.buffers.items():
+            namedb[k]._inplace_assign(jnp.array(v))
+
+    def sync_from_model(self):
+        self.params = {k: jnp.array(p._value)
+                       for k, p in self._trainable.items()}
+        self.buffers = {k: jnp.array(v)
+                        for k, v in self.model.raw_buffers().items()}
+
+
+def train_step(model, loss_fn, optimizer, scaler=None):
+    return TrainStep(model, loss_fn, optimizer, scaler)
+
+
+class EvalStep:
+    """Compiled inference/eval step (no grad, no state mutation)."""
+
+    def __init__(self, model: Layer):
+        self.model = model
+
+        def fn(params, buffers, batch):
+            with ag.no_grad():
+                out = model.functional_call(
+                    params, *[Tensor(b, _internal=True) for b in batch],
+                    buffers=buffers, training=False)
+            if isinstance(out, Tensor):
+                return out._value
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out
+        self._compiled = jax.jit(fn)
+
+    def __call__(self, *batch):
+        params = self.model.raw_parameters()
+        buffers = self.model.raw_buffers()
+        out = self._compiled(params, buffers,
+                             tuple(_raw(b) for b in batch))
+        if isinstance(out, tuple):
+            return tuple(Tensor(o, _internal=True) for o in out)
+        return Tensor(out, _internal=True)

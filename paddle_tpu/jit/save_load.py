@@ -1,0 +1,111 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py:955 save,
+translated_layer.py).
+
+TPU-native serialization: parameters/buffers via the framework pickle format
+plus the compiled program exported as StableHLO (jax.export) when input specs
+are given — the analog of the reference's ProgramDesc+params artifact.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .._core import autograd as ag
+from ..framework.io import save as _save, load as _load
+from ..nn.layer.layers import Layer
+from .api import InputSpec, StaticFunction
+
+
+def save(layer, path, input_spec=None, **configs):
+    """reference: jit/api.py:955 — writes <path>.pdiparams (state) and
+    <path>.pdmodel (StableHLO text, if exportable)."""
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    _save(state, path + ".pdiparams")
+    meta = {"class": type(layer).__name__,
+            "input_spec": [(s.shape, str(s.dtype)) for s in input_spec]
+            if input_spec else None}
+    if input_spec and isinstance(layer, Layer):
+        try:
+            from jax import export as jexport
+            params = layer.raw_parameters()
+            buffers = layer.raw_buffers()
+
+            def fn(params, buffers, *xs):
+                with ag.no_grad():
+                    out = layer.functional_call(
+                        params,
+                        *[Tensor(x, _internal=True) for x in xs],
+                        buffers=buffers, training=False)
+                if isinstance(out, (tuple, list)):
+                    return tuple(o._value if isinstance(o, Tensor) else o
+                                 for o in out)
+                return out._value if isinstance(out, Tensor) else out
+
+            args = [jax.ShapeDtypeStruct(
+                tuple(d if d is not None and d != -1 else 1 for d in s.shape),
+                jnp.dtype(str(np.dtype(s.dtype)))) for s in input_spec]
+            exported = jexport.export(jax.jit(fn))(
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape,
+                                                   jnp.result_type(a)),
+                    params),
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape,
+                                                   jnp.result_type(a)),
+                    buffers),
+                *args)
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+            meta["exported"] = True
+        except Exception as e:  # export is best-effort; params always saved
+            meta["exported"] = False
+            meta["export_error"] = str(e)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """reference: jit/translated_layer.py — a loaded inference program."""
+
+    def __init__(self, state_dict, exported=None):
+        super().__init__()
+        self._state = state_dict
+        self._exported = exported
+
+    def forward(self, *args):
+        if self._exported is None:
+            raise RuntimeError(
+                "this artifact was saved without an exported program "
+                "(no input_spec at save time); only state_dict is available")
+        from jax import export as jexport
+        raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+               for a in args]
+        params = {k: v._value for k, v in self._state.items()}
+        out = self._exported.call(params, {}, *raw)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor(o, _internal=True) for o in out)
+        return Tensor(out, _internal=True)
+
+    def state_dict(self, *a, **k):
+        return dict(self._state)
+
+
+def load(path, **configs):
+    """reference: python/paddle/jit/api.py load."""
+    state = _load(path + ".pdiparams")
+    exported = None
+    model_file = path + ".pdmodel"
+    if os.path.exists(model_file):
+        try:
+            from jax import export as jexport
+            with open(model_file, "rb") as f:
+                exported = jexport.deserialize(f.read())
+        except Exception:
+            exported = None
+    return TranslatedLayer(state, exported)

@@ -1,0 +1,46 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from .layer.layers import (  # noqa: F401
+    Layer, Sequential, LayerList, ParameterList, LayerDict,
+)
+from .layer.common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Upsample,
+    UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    Identity, Flatten, Unflatten, Bilinear, CosineSimilarity, PixelShuffle,
+    PixelUnshuffle, ChannelShuffle,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, Softsign, Tanhshrink,
+    Hardswish, Hardsigmoid, LogSigmoid, GLU, GELU, LeakyReLU, ELU, CELU,
+    SELU, PReLU, RReLU, Hardtanh, Hardshrink, Softshrink, Softplus,
+    ThresholdedReLU, Maxout, Softmax, LogSoftmax,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
+    HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip_grad import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401

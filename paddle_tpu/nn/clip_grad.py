@@ -1,0 +1,116 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm:654, ClipGradByNorm:453, ClipGradByValue:340)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .._core.autograd import no_grad
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor(jnp.clip(g._value, self.min, self.max),
+                                      _internal=True)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                norm = jnp.sqrt(jnp.sum(jnp.square(
+                    g._value.astype(jnp.float32))))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                    1.0)
+                out.append((p, Tensor((g._value * scale).astype(g.dtype),
+                                      _internal=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: nn/clip.py:654 — scale all grads by
+    clip_norm / max(global_norm, clip_norm)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        with no_grad():
+            sq = []
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    continue
+                sq.append(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            if not sq:
+                return params_grads
+            gnorm = jnp.sqrt(sum(sq))
+            scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+            out = []
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor((g._value.astype(jnp.float32) *
+                                       scale).astype(g.dtype),
+                                      _internal=True)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """reference: python/paddle/nn/utils/clip_grad_norm_.py."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(np.asarray(0.0))
+    with no_grad():
+        if norm_type == float("inf"):
+            total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value))
+                                       for g in grads]))
+        else:
+            total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(
+                g._value.astype(jnp.float32)), norm_type)) for g in grads),
+                1.0 / norm_type)
+        clip_coef = jnp.clip(max_norm / (total + 1e-6), None, 1.0)
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._inplace_assign(
+                    (p.grad._value * clip_coef).astype(p.grad.dtype))
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    with no_grad():
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._inplace_assign(jnp.clip(p.grad._value, -clip_value,
+                                                clip_value))

@@ -1,0 +1,37 @@
+"""paddle_tpu.nn.functional (reference: python/paddle/nn/functional/)."""
+from .activation import (  # noqa: F401
+    relu, relu6, sigmoid, tanh, silu, swish, mish, softsign, tanhshrink,
+    hardswish, hardsigmoid, gelu, leaky_relu, elu, celu, selu, prelu, rrelu,
+    hardtanh, hardshrink, softshrink, softplus, thresholded_relu, log_sigmoid,
+    maxout, softmax, log_softmax, gumbel_softmax, glu, swiglu,
+)
+from .common import (  # noqa: F401
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
+    label_smooth, pad, interpolate, upsample, unfold, fold, bilinear,
+    cosine_similarity, normalize, pixel_shuffle, pixel_unshuffle,
+    channel_shuffle,
+)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+)
+from .norm import (  # noqa: F401
+    layer_norm, rms_norm, batch_norm, group_norm, instance_norm,
+    local_response_norm,
+)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    sigmoid_focal_loss, kl_div, margin_ranking_loss, hinge_embedding_loss,
+    cosine_embedding_loss, triplet_margin_loss, ctc_loss, square_error_cost,
+    log_loss, dice_loss,
+)
+from .attention import (  # noqa: F401
+    flash_attention, scaled_dot_product_attention, flash_attn_unpadded,
+    sdp_kernel,
+)

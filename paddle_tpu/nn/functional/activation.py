@@ -1,0 +1,171 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py;
+kernels paddle/phi/kernels/activation_kernel.*). All lower to XLA-fusable
+elementwise ops."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply
+from ..._core.tensor import Tensor
+from ...ops._registry import as_tensor, raw
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, as_tensor(x), name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(lambda x: jnp.clip(x, 0, 6), "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+silu = _unary(jax.nn.silu, "silu")
+swish = silu
+mish = _unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+tanhshrink = _unary(lambda x: x - jnp.tanh(x), "tanhshrink")
+hardswish = _unary(lambda x: x * jnp.clip(x + 3, 0, 6) / 6, "hardswish")
+hardsigmoid = _unary(lambda x: jnp.clip(x / 6 + 0.5, 0, 1), "hardsigmoid")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate),
+                 as_tensor(x), name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), as_tensor(x),
+                 name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), as_tensor(x), name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), as_tensor(x), name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v,
+                                             alpha * jnp.expm1(v)),
+                 as_tensor(x), name="selu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply(f, as_tensor(x), as_tensor(weight), name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ..._core.random import next_rng_key
+    x = as_tensor(x)
+    if training:
+        a = jax.random.uniform(next_rng_key(), tuple(x.shape),
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return apply(lambda v: jnp.where(v >= 0, v, a * v), x, name="rrelu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), as_tensor(x),
+                 name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                 as_tensor(x), name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0.0)),
+                 as_tensor(x), name="softshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(v * beta > threshold, v,
+                                     jax.nn.softplus(v * beta) / beta),
+                 as_tensor(x), name="softplus")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, value), as_tensor(x),
+                 name="thresholded_relu")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, as_tensor(x), name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax)
+    return apply(f, as_tensor(x), name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ..._core import dtype as dt
+            v = v.astype(dt.convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply(f, as_tensor(x), name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ..._core import dtype as dt
+            v = v.astype(dt.convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply(f, as_tensor(x), name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..._core.random import next_rng_key
+    x = as_tensor(x)
+    g = jax.random.gumbel(next_rng_key(), tuple(x.shape))
+
+    def f(v):
+        y = jax.nn.softmax((v + g.astype(v.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False) \
+                if hasattr(jnp, "put_along_axis") else \
+                jax.nn.one_hot(jnp.squeeze(idx, axis), v.shape[axis],
+                               axis=axis, dtype=y.dtype)
+            return y_hard + jax.lax.stop_gradient(-y) + y
+        return y
+    return apply(f, x, name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), as_tensor(x), name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    """reference: python/paddle/incubate/nn/functional/swiglu.py — fused on
+    GPU there; XLA fuses silu*mul on TPU automatically."""
+    if y is None:
+        return apply(lambda v: jax.nn.silu(v[..., : v.shape[-1] // 2]) *
+                     v[..., v.shape[-1] // 2:], as_tensor(x), name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, as_tensor(x), as_tensor(y),
+                 name="swiglu")

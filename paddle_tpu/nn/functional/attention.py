@@ -1,0 +1,140 @@
+"""Attention functionals.
+
+reference: python/paddle/nn/functional/flash_attention.py (flash_attention:195,
+flash_attn_unpadded:593, sdp kernel selection :155). On TPU the fused-kernel
+role of FlashAttention is played by a Pallas splash-attention kernel
+(paddle_tpu/ops/pallas/flash_attention.py) with an XLA fallback that the
+compiler fuses well for moderate sequence lengths.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply
+from ..._core.tensor import Tensor
+from ...ops._registry import as_tensor, raw
+
+
+def _sdpa_xla(q, k, v, bias=None, causal=False, scale=None, dropout=0.0,
+              dropout_key=None):
+    """Reference XLA attention: (B, S, H, D) layout like the reference API.
+    Computed in fp32 accumulation, output in input dtype."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = probs * keep / (1.0 - dropout)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, rng_name="", name=None, backend=None):
+    """reference: python/paddle/nn/functional/flash_attention.py:195.
+    Layout (batch, seq, heads, head_dim)."""
+    from ...ops.pallas import flash_attention as pallas_fa
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    dk = None
+    if dropout > 0.0 and training:
+        from ..._core.random import next_rng_key
+        dk = next_rng_key()
+
+    use_pallas = pallas_fa.available() and backend != "xla" and \
+        dropout == 0.0
+    if use_pallas:
+        def f(qq, kk, vv):
+            return pallas_fa.flash_attention(qq, kk, vv, causal=causal)
+    else:
+        def f(qq, kk, vv):
+            return _sdpa_xla(qq, kk, vv, causal=causal,
+                             dropout=dropout if training else 0.0,
+                             dropout_key=dk)
+    out = apply(f, q, k, v, name="flash_attention")
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """reference: python/paddle/nn/functional/flash_attention.py
+    scaled_dot_product_attention — (B, S, H, D) layout."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    dk = None
+    if dropout_p > 0.0 and training:
+        from ..._core.random import next_rng_key
+        dk = next_rng_key()
+    args = [q, k, v]
+    has_mask = attn_mask is not None
+    if has_mask:
+        args.append(as_tensor(attn_mask))
+
+    def f(qq, kk, vv, *rest):
+        bias = None
+        if has_mask:
+            m = rest[0]
+            if m.dtype == jnp.bool_:
+                bias = jnp.where(m, 0.0, jnp.finfo(jnp.float32).min)
+            else:
+                bias = m
+        return _sdpa_xla(qq, kk, vv, bias=bias, causal=is_causal,
+                         dropout=dropout_p if training else 0.0,
+                         dropout_key=dk)
+    return apply(f, *args, name="sdpa")
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """reference: flash_attention.py:593 — varlen packed attention. On TPU we
+    segment-mask inside one padded batch (static shapes for XLA)."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    cq = raw(as_tensor(cu_seqlens_q))
+    ck = raw(as_tensor(cu_seqlens_k))
+
+    def f(qq, kk, vv):
+        # build segment ids from cumulative seqlens: (total,) -> segment idx
+        tq = qq.shape[0]
+        tk = kk.shape[0]
+        seg_q = jnp.searchsorted(cq, jnp.arange(tq), side="right")
+        seg_k = jnp.searchsorted(ck, jnp.arange(tk), side="right")
+        logits = jnp.einsum("qhd,khd->hqk", qq, kk,
+                            preferred_element_type=jnp.float32) * scale
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - jnp.take(cq, seg_q - 1)
+            pos_k = jnp.arange(tk) - jnp.take(ck, seg_k - 1)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("hqk,khd->qhd", probs.astype(qq.dtype), vv)
+    out = apply(f, q, k, v, name="flash_attn_unpadded")
+    return out, None
+
+
+def sdp_kernel(*args, **kwargs):
+    """Parity no-op: kernel selection is automatic (Pallas if available)."""
+    class _Ctx:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+    return _Ctx()

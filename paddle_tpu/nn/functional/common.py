@@ -1,0 +1,285 @@
+"""Common NN functionals: linear, dropout, embedding, interpolate, etc.
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply, is_grad_enabled
+from ..._core.tensor import Tensor
+from ..._core.random import next_rng_key
+from ..._core.flags import flag_value
+from ...ops._registry import as_tensor, raw
+
+
+def _precision():
+    p = flag_value("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W layout (in, out) (reference:
+    python/paddle/nn/functional/common.py linear; phi matmul kernel)."""
+    args = [as_tensor(x), as_tensor(weight)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+        def f(v, w, b):
+            return jnp.matmul(v, w, precision=_precision()) + b
+    else:
+        def f(v, w):
+            return jnp.matmul(v, w, precision=_precision())
+    return apply(f, *args, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """reference: python/paddle/nn/functional/common.py dropout."""
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda v: v * (1 - p), x, name="dropout_infer")
+        return x
+    if p == 1.0:
+        return apply(lambda v: jnp.zeros_like(v), x, name="dropout")
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(next_rng_key(), 1.0 - p, mask_shape)
+
+    def f(v):
+        m = keep.astype(v.dtype)
+        if mode == "upscale_in_train":
+            return v * m / (1.0 - p)
+        return v * m
+    return apply(f, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_rng_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+    b = -a * alpha_p * p
+
+    def f(v):
+        m = keep.astype(v.dtype)
+        return a * (v * m + alpha_p * (1 - m)) + b
+    return apply(f, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: python/paddle/nn/functional/input.py embedding. XLA gather;
+    padding_idx rows contribute zero grad (mask on lookup)."""
+    idx = raw(as_tensor(x))
+
+    def f(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (idx != pi)[..., None].astype(out.dtype)
+            out = out * mask
+        return out
+    return apply(f, as_tensor(weight), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ..._core import dtype as dt
+    idx = raw(as_tensor(x))
+    return Tensor(jax.nn.one_hot(idx, num_classes,
+                                 dtype=dt.get_default_dtype()), _internal=True)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *rest):
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / l.shape[-1]
+    args = [as_tensor(label)]
+    if prior_dist is not None:
+        args.append(as_tensor(prior_dist))
+    return apply(f, *args, name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference: python/paddle/nn/functional/common.py interpolate — maps to
+    jax.image.resize (XLA gather/linear combos)."""
+    x = as_tensor(x)
+    nd = x.ndim
+    channel_last = data_format.endswith("C") and data_format[1] != "C"
+    spatial = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_sizes = [x.shape[d] for d in spatial]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(raw(s)) for s in size]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * len(spatial)
+        out_sizes = [int(s * float(raw(f))) for s, f in zip(in_sizes, sf)]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic",
+             "area": "linear"}[mode]
+    out_shape = list(x.shape)
+    for d, s in zip(spatial, out_sizes):
+        out_shape[d] = s
+
+    def f(v):
+        return jax.image.resize(v, tuple(out_shape), method=jmode)
+    return apply(f, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: phi unfold kernel)."""
+    x = as_tensor(x)
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \
+        [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(p) == 2:
+        p = [p[0], p[0], p[1], p[1]]
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s, padding="VALID",
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply(f, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = as_tensor(x)
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \
+        [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    oh, ow = output_sizes
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        lh = (oh + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        lw = (ow + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        v = v.reshape(n, c, k[0], k[1], lh, lw)
+        out = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + lh * s[0]:s[0],
+                             wj:wj + lw * s[1]:s[1]].add(v[:, :, i, j])
+        return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+    return apply(f, x, name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    args = [as_tensor(x1), as_tensor(x2), as_tensor(weight)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b,
+                         precision=_precision())
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply(f, *args, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply(f, as_tensor(x1), as_tensor(x2), name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
+                              keepdims=True), 1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply(f, as_tensor(x), name="normalize")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply(f, as_tensor(x), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply(f, as_tensor(x), name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            v = jnp.swapaxes(v, 1, 2)
+            return v.reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        v = jnp.swapaxes(v, 3, 4)
+        return v.reshape(n, h, w, c)
+    return apply(f, as_tensor(x), name="channel_shuffle")

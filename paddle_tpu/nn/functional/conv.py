@@ -1,0 +1,159 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py;
+kernels paddle/phi/kernels/conv_kernel.* + gpudnn). Lower to XLA
+conv_general_dilated — the MXU path for convs on TPU."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply
+from ..._core.tensor import Tensor
+from ..._core.flags import flag_value
+from ...ops._registry import as_tensor, raw
+
+
+def _precision():
+    p = flag_value("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    """Map paddle padding spec -> XLA padding list [(lo, hi)] * n or str."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, ndim,
+             channel_last, name):
+    sp = "DHW"[3 - ndim:]
+    if channel_last:
+        lhs_spec = "N" + sp + "C"
+    else:
+        lhs_spec = "NC" + sp
+    dn = (lhs_spec, "OI" + sp, lhs_spec)
+    strides = _tuple(stride, ndim)
+    dil = _tuple(dilation, ndim)
+    padspec = _padding(padding, ndim)
+
+    args = [as_tensor(x), as_tensor(weight)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=padspec,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups, precision=_precision())
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply(f, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format == "NLC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, ndim, channel_last, output_size,
+                       name):
+    sp = "DHW"[3 - ndim:]
+    lhs_spec = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    # paddle transpose-conv weight layout: (in_channels, out_channels/groups, *k)
+    dn = (lhs_spec, "IO" + sp, lhs_spec)
+    strides = _tuple(stride, ndim)
+    dil = _tuple(dilation, ndim)
+    opad = _tuple(output_padding, ndim)
+    k = None
+
+    args = [as_tensor(x), as_tensor(weight)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(v, w, *rest):
+        kd = w.shape[2:]
+        if isinstance(padding, str):
+            pad = padding.upper()
+        else:
+            p = _padding(padding, ndim)
+            # transposed conv: effective pad = dilation*(k-1) - pad
+            pad = [(dil[i] * (kd[i] - 1) - p[i][0] + 0,
+                    dil[i] * (kd[i] - 1) - p[i][1] + opad[i])
+                   for i in range(ndim)]
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=(1,) * ndim, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups, precision=_precision())
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply(f, *args, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1,
+                              data_format == "NLC", output_size,
+                              "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 2,
+                              data_format == "NHWC", output_size,
+                              "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3,
+                              data_format == "NDHWC", output_size,
+                              "conv3d_transpose")

@@ -1,0 +1,291 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py;
+kernels paddle/phi/kernels/*cross_entropy*, etc)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply
+from ..._core.tensor import Tensor
+from ...ops._registry import as_tensor, raw
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy."""
+    inp = as_tensor(input)
+    lab = raw(as_tensor(label))
+    args = [inp]
+    has_w = weight is not None
+    if has_w:
+        args.append(as_tensor(weight))
+
+    def f(v, *rest):
+        logp = jax.nn.log_softmax(v, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(v, 1e-30, None))
+        nclass = v.shape[axis]
+        if soft_label:
+            lab_s = lab.astype(logp.dtype)
+            if label_smoothing > 0:
+                lab_s = lab_s * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(lab_s * logp, axis=axis)
+        else:
+            li = lab
+            if li.ndim == logp.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis)
+            li = li.astype(jnp.int32)
+            valid = (li != ignore_index)
+            li_safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(li_safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + \
+                    label_smoothing * smooth
+            loss = -jnp.where(valid, picked, 0.0)
+            if has_w:
+                w = rest[0]
+                wsel = jnp.take(w, li_safe) * valid.astype(logp.dtype)
+                loss = loss * wsel
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+    return apply(f, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    inp = as_tensor(input)
+    lab = raw(as_tensor(label)).astype(jnp.int32)
+    args = [inp]
+    has_w = weight is not None
+    if has_w:
+        args.append(as_tensor(weight))
+
+    def f(v, *rest):
+        valid = (lab != ignore_index)
+        ls = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(v, jnp.expand_dims(ls, 1), axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        wv = valid.astype(v.dtype)
+        if has_w:
+            wv = wv * jnp.take(rest[0], ls)
+        loss = loss * wv
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+        return _reduce_loss(loss, reduction)
+    return apply(f, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                 as_tensor(input), as_tensor(label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                 as_tensor(input), as_tensor(label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(label), name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = [as_tensor(input), as_tensor(label)]
+    has_w = weight is not None
+    if has_w:
+        args.append(as_tensor(weight))
+
+    def f(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * rest[0]
+        return _reduce_loss(loss, reduction)
+    return apply(f, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    args = [as_tensor(logit), as_tensor(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(as_tensor(weight))
+    if has_pw:
+        args.append(as_tensor(pos_weight))
+
+    def f(z, y, *rest):
+        log_p = jax.nn.log_sigmoid(z)
+        log_np = jax.nn.log_sigmoid(-z)
+        i = 0
+        w = None
+        if has_w:
+            w = rest[i]; i += 1
+        if has_pw:
+            pw = rest[i]
+            loss = -(pw * y * log_p + (1 - y) * log_np)
+        else:
+            loss = -(y * log_p + (1 - y) * log_np)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    return apply(f, *args, name="bce_with_logits")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [as_tensor(logit), as_tensor(label)]
+    has_n = normalizer is not None
+    if has_n:
+        args.append(as_tensor(normalizer))
+
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if has_n:
+            loss = loss / rest[0]
+        return _reduce_loss(loss, reduction)
+    return apply(f, *args, name="sigmoid_focal_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - lp)
+        else:
+            loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(other), as_tensor(label),
+                 name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(label),
+                 name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(input1), as_tensor(input2), as_tensor(label),
+                 name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     -1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(positive),
+                 as_tensor(negative), name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax (reference: phi warpctc kernel). log_probs layout
+    (T, B, C) as in the reference."""
+    import optax
+    lp = raw(as_tensor(log_probs))
+    lab = raw(as_tensor(labels))
+    il = raw(as_tensor(input_lengths)).reshape(-1)
+    ll = raw(as_tensor(label_lengths)).reshape(-1)
+
+    def f(v):
+        # optax expects (B, T, C) logits and (B, S) labels with paddings
+        logits = jnp.transpose(v, (1, 0, 2))
+        B, T, C = logits.shape
+        logit_pad = (jnp.arange(T)[None, :] >= il[:, None]).astype(jnp.float32)
+        S = lab.shape[1]
+        label_pad = (jnp.arange(S)[None, :] >= ll[:, None]).astype(jnp.float32)
+        loss = optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                              blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(loss / ll.astype(loss.dtype))
+        return _reduce_loss(loss, reduction)
+    return apply(f, as_tensor(log_probs), name="ctc_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), as_tensor(input),
+                 as_tensor(label), name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def f(p, y):
+        return -(y * jnp.log(p + epsilon) +
+                 (1 - y) * jnp.log(1 - p + epsilon))
+    return apply(f, as_tensor(input), as_tensor(label), name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    def f(p, y):
+        yoh = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yoh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yoh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(f, as_tensor(input), as_tensor(label), name="dice_loss")

@@ -1,0 +1,227 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+kernels paddle/phi/kernels/{layer_norm,batch_norm,group_norm,rms_norm}_kernel.*).
+XLA fuses these into the surrounding matmuls on TPU."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply, no_grad
+from ..._core.tensor import Tensor
+from ...ops._registry import as_tensor, raw
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = as_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    naxes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(as_tensor(weight))
+    if has_b:
+        args.append(as_tensor(bias))
+
+    def f(v, *rest):
+        # compute in fp32 for bf16 stability (reference: layer_norm_kernel.cu
+        # uses float accumulators)
+        ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+        vv = v.astype(ct)
+        mean = jnp.mean(vv, axis=naxes, keepdims=True)
+        var = jnp.mean(jnp.square(vv - mean), axis=naxes, keepdims=True)
+        out = (vv - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * rest[i].astype(ct)
+            i += 1
+        if has_b:
+            out = out + rest[i].astype(ct)
+        return out.astype(v.dtype)
+    return apply(f, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    """reference: python/paddle/incubate/nn/functional/fused_rms_norm.py —
+    fused CUDA kernel there; on TPU a jnp composition XLA fuses."""
+    x = as_tensor(x)
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(as_tensor(weight))
+    if has_b:
+        args.append(as_tensor(bias))
+    ax = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    naxes = tuple(range(ax, x.ndim))
+
+    def f(v, *rest):
+        ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+        vv = v.astype(ct)
+        ms = jnp.mean(jnp.square(vv), axis=naxes, keepdims=True)
+        out = vv * jax.lax.rsqrt(ms + epsilon)
+        i = 0
+        if has_w:
+            out = out * rest[i].astype(ct)
+            i += 1
+        if has_b:
+            out = out + rest[i].astype(ct)
+        return out.astype(v.dtype)
+    return apply(f, *args, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """reference: python/paddle/nn/functional/norm.py batch_norm. Running
+    stats are updated in-place on the passed tensors (eager semantics)."""
+    x = as_tensor(x)
+    rm, rv = as_tensor(running_mean), as_tensor(running_var)
+    ch_axis = 1 if (data_format.startswith("NC") or x.ndim <= 2) else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        with no_grad():
+            ct = jnp.float32
+            xv32 = x._value.astype(ct)
+            bmean = jnp.mean(xv32, axis=red_axes)
+            bvar = jnp.var(xv32, axis=red_axes)
+            n = x.size / x.shape[ch_axis]
+            unbiased = bvar * (n / max(n - 1.0, 1.0))
+            rm._inplace_assign((momentum * rm._value +
+                                (1 - momentum) * bmean).astype(rm.dtype))
+            rv._inplace_assign((momentum * rv._value +
+                                (1 - momentum) * unbiased).astype(rv.dtype))
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(as_tensor(weight))
+    if has_b:
+        args.append(as_tensor(bias))
+
+    if use_batch_stats:
+        def f(v, *rest):
+            ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) \
+                else v.dtype
+            vv = v.astype(ct)
+            m = jnp.mean(vv, axis=red_axes, keepdims=True)
+            var = jnp.var(vv, axis=red_axes, keepdims=True)
+            out = (vv - m) * jax.lax.rsqrt(var + epsilon)
+            i = 0
+            if has_w:
+                out = out * rest[i].astype(ct).reshape(shape)
+                i += 1
+            if has_b:
+                out = out + rest[i].astype(ct).reshape(shape)
+            return out.astype(v.dtype)
+    else:
+        mval = rm._value.reshape(shape)
+        vval = rv._value.reshape(shape)
+
+        def f(v, *rest):
+            ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) \
+                else v.dtype
+            out = (v.astype(ct) - mval.astype(ct)) * \
+                jax.lax.rsqrt(vval.astype(ct) + epsilon)
+            i = 0
+            if has_w:
+                out = out * rest[i].astype(ct).reshape(shape)
+                i += 1
+            if has_b:
+                out = out + rest[i].astype(ct).reshape(shape)
+            return out.astype(v.dtype)
+    return apply(f, *args, name="batch_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = as_tensor(x)
+    channel_last = data_format.endswith("C") and len(data_format) > 2
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(as_tensor(weight))
+    if has_b:
+        args.append(as_tensor(bias))
+
+    def f(v, *rest):
+        ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+        vv = v.astype(ct)
+        if channel_last:
+            vv = jnp.moveaxis(vv, -1, 1)
+        n, c = vv.shape[:2]
+        g = vv.reshape(n, num_groups, c // num_groups, *vv.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(vv.shape)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if has_w:
+            out = out * rest[i].astype(ct).reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].astype(ct).reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(v.dtype)
+    return apply(f, *args, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  epsilon=1e-05, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(as_tensor(weight))
+    if has_b:
+        args.append(as_tensor(bias))
+    red = tuple(range(2, x.ndim))
+
+    def f(v, *rest):
+        ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+        vv = v.astype(ct)
+        m = jnp.mean(vv, axis=red, keepdims=True)
+        var = jnp.var(vv, axis=red, keepdims=True)
+        out = (vv - m) * jax.lax.rsqrt(var + epsilon)
+        shape = [1] * v.ndim
+        shape[1] = v.shape[1]
+        i = 0
+        if has_w:
+            out = out * rest[i].astype(ct).reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].astype(ct).reshape(shape)
+        return out.astype(v.dtype)
+    return apply(f, *args, name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(v):
+        sq = jnp.square(v)
+        ch = 1 if data_format.startswith("NC") else v.ndim - 1
+        sqm = jnp.moveaxis(sq, ch, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sqm, [(0, 0)] * (sqm.ndim - 1) + [(pad_lo, pad_hi)])
+        win = sum(jnp.roll(padded, -i, axis=-1)[..., :sqm.shape[-1]]
+                  for i in range(size))
+        win = jnp.moveaxis(win, -1, ch)
+        return v / jnp.power(k + alpha * win, beta)
+    return apply(f, as_tensor(x), name="local_response_norm")

@@ -1,0 +1,189 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py;
+kernels paddle/phi/kernels/pool_kernel.*). reduce_window on TPU."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply
+from ...ops._registry import as_tensor, raw
+from .conv import _tuple, _padding
+
+
+def _pool(x, kernel_size, stride, padding, ndim, channel_last, init, op,
+          ceil_mode, name, count_include_pad=True, is_avg=False,
+          exclusive=True):
+    x = as_tensor(x)
+    k = _tuple(kernel_size, ndim)
+    s = _tuple(stride if stride is not None else kernel_size, ndim)
+    if isinstance(padding, str):
+        padmode = padding.upper()
+        pads = None
+    else:
+        pads = _padding(padding, ndim)
+        padmode = None
+
+    sp_axes = list(range(1, 1 + ndim)) if channel_last else \
+        list(range(2, 2 + ndim))
+
+    def f(v):
+        window = [1] * v.ndim
+        strides = [1] * v.ndim
+        pad_all = [(0, 0)] * v.ndim
+        for i, ax in enumerate(sp_axes):
+            window[ax] = k[i]
+            strides[ax] = s[i]
+            if pads is not None:
+                pad_all[ax] = pads[i]
+        if padmode == "SAME":
+            pad_cfg = "SAME"
+        elif padmode == "VALID" or pads is None:
+            pad_cfg = "VALID"
+        else:
+            if ceil_mode:
+                # extend hi padding so last partial window is included
+                pad_all = [
+                    (lo, hi + (st - 1)) if ax in sp_axes else (lo, hi)
+                    for ax, ((lo, hi), st) in
+                    enumerate(zip(pad_all, strides))]
+            pad_cfg = pad_all
+        if is_avg:
+            summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window,
+                                           strides, pad_cfg)
+            if exclusive and pad_cfg not in ("VALID",):
+                ones = jnp.ones_like(v)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                               window, strides, pad_cfg)
+                return summed / counts
+            return summed / float(np.prod(k))
+        return jax.lax.reduce_window(v, init, op, window, strides, pad_cfg)
+    return apply(f, x, name=name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 -jnp.inf, jax.lax.max, ceil_mode, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                -jnp.inf, jax.lax.max, ceil_mode, "max_pool2d")
+    if return_mask:
+        idx = _pool_argmax(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 -jnp.inf, jax.lax.max, ceil_mode, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 0.0, jax.lax.add, ceil_mode, "avg_pool1d", is_avg=True,
+                 exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 0.0, jax.lax.add, ceil_mode, "avg_pool2d", is_avg=True,
+                 exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 0.0, jax.lax.add, ceil_mode, "avg_pool3d", is_avg=True,
+                 exclusive=exclusive)
+
+
+def _pool_argmax(x, kernel_size, stride, padding, data_format):
+    # flat-index argmax for return_mask parity (host fallback, rarely used)
+    from ..._core.tensor import Tensor
+    xv = np.asarray(raw(as_tensor(x)))
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    p = _padding(padding if not isinstance(padding, str) else 0, 2)
+    n, c, h, w = xv.shape
+    oh = (h + p[0][0] + p[0][1] - k[0]) // s[0] + 1
+    ow = (w + p[1][0] + p[1][1] - k[1]) // s[1] + 1
+    out = np.zeros((n, c, oh, ow), np.int32)
+    for i in range(oh):
+        for j in range(ow):
+            hs, ws = i * s[0] - p[0][0], j * s[1] - p[1][0]
+            win = xv[:, :, max(hs, 0):hs + k[0], max(ws, 0):ws + k[1]]
+            flat = win.reshape(n, c, -1)
+            am = flat.argmax(-1)
+            wh = win.shape[2:]
+            r, cc = np.unravel_index(am, wh)
+            out[:, :, i, j] = (max(hs, 0) + r) * w + (max(ws, 0) + cc)
+    return Tensor(jnp.asarray(out))
+
+
+def _adaptive_windows(in_size, out_size):
+    # paddle adaptive pooling: window i = [floor(i*in/out), ceil((i+1)*in/out))
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-((np.arange(out_size) + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, ndim, data_format, is_avg, name):
+    x = as_tensor(x)
+    channel_last = data_format.endswith("C") and len(data_format) > 2
+    o = _tuple(output_size, ndim) if output_size is not None else None
+    sp_axes = list(range(1, 1 + ndim)) if channel_last else \
+        list(range(2, 2 + ndim))
+
+    def f(v):
+        out = v
+        for i, ax in enumerate(sp_axes):
+            in_size = v.shape[ax]
+            starts, ends = _adaptive_windows(in_size, o[i])
+            slices = []
+            for st, en in zip(starts, ends):
+                win = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                red = (jnp.mean if is_avg else jnp.max)(win, axis=ax,
+                                                        keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+        return out
+    return apply(f, x, name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", True,
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, True,
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, True,
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", False,
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", False,
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", False,
+                          "adaptive_max_pool3d")

@@ -325,13 +325,22 @@ class Layer:
     # ---- functional bridge (TPU-native addition) ----
     def functional_call(self, params: Dict[str, Any], *args,
                         buffers: Optional[Dict[str, Any]] = None,
-                        training: Optional[bool] = None, **kwargs):
+                        training: Optional[bool] = None,
+                        capture_buffers: bool = False,
+                        forward_fn: Optional[Callable] = None, **kwargs):
         """Run ``forward`` with parameter values taken from ``params``
         (a dict name -> jax array / Tensor), restoring module state after.
         This is the bridge that makes the imperative Layer jit/grad-able:
         ``jax.grad(lambda p: layer.functional_call(p, x).mean())``.
+
+        With ``capture_buffers=True`` returns ``(output, new_buffers)`` where
+        ``new_buffers`` holds the post-forward buffer values (e.g. BatchNorm
+        running stats mutated during the call) so jit-compiled steps can
+        thread buffer state functionally.
         """
         named = dict(self.named_parameters())
+        namedb = dict(self.named_buffers()) if (buffers or capture_buffers) \
+            else {}
         saved = {}
         old_training = self.training
         try:
@@ -342,15 +351,24 @@ class Layer:
                 p._value = val
                 p._node = None
                 p._out_index = 0
-            if buffers:
-                namedb = dict(self.named_buffers())
-                for k, v in buffers.items():
-                    b = namedb[k]
+            if buffers or capture_buffers:
+                # save ALL buffers (forward may mutate ones not in the
+                # override dict — e.g. BN running stats — and a tracer must
+                # never leak into module state past the finally)
+                for k, b in namedb.items():
                     saved["buf:" + k] = (b, b._value, b._node, b._out_index)
-                    b._value = v._value if isinstance(v, Tensor) else v
+            if buffers:
+                for k, v in buffers.items():
+                    namedb[k]._value = v._value if isinstance(v, Tensor) \
+                        else v
             if training is not None:
                 self.train() if training else self.eval()
-            return self(*args, **kwargs)
+            out = (forward_fn(*args, **kwargs) if forward_fn is not None
+                   else self(*args, **kwargs))
+            if capture_buffers:
+                new_buffers = {k: b._value for k, b in namedb.items()}
+                return out, new_buffers
+            return out
         finally:
             if training is not None:
                 self.train() if old_training else self.eval()

@@ -1,0 +1,382 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+Recurrence runs as ``jax.lax.scan`` — the XLA-native loop (static trip count,
+compiled once), replacing the reference's per-timestep kernel launches.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer, LayerList
+from ..initializer.initializer import Uniform
+from ..._core.autograd import apply
+from ..._core.tensor import Tensor
+from ...ops._registry import as_tensor
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or (self.hidden_size,)
+        return Tensor(jnp.full((b,) + tuple(shape), init_value,
+                               batch_ref._value.dtype), _internal=True)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        args = [as_tensor(inputs), as_tensor(states), self.weight_ih,
+                self.weight_hh]
+        has_b = self.bias_ih is not None
+        if has_b:
+            args += [self.bias_ih, self.bias_hh]
+
+        def f(x, h, wih, whh, *bs):
+            z = x @ wih.T + h @ whh.T
+            if bs:
+                z = z + bs[0] + bs[1]
+            return act(z)
+        h = apply(f, *args, name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """reference: rnn.py LSTMCell (gates i,f,g,o packed 4H)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        args = [as_tensor(inputs), as_tensor(h), as_tensor(c),
+                self.weight_ih, self.weight_hh]
+        has_b = self.bias_ih is not None
+        if has_b:
+            args += [self.bias_ih, self.bias_hh]
+        H = self.hidden_size
+
+        def f(x, hh, cc, wih, whh, *bs):
+            z = x @ wih.T + hh @ whh.T
+            if bs:
+                z = z + bs[0] + bs[1]
+            i, fg, g, o = (z[..., :H], z[..., H:2 * H], z[..., 2 * H:3 * H],
+                           z[..., 3 * H:])
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fg * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply(f, *args, name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        H = self.hidden_size
+        args = [as_tensor(inputs), as_tensor(states), self.weight_ih,
+                self.weight_hh]
+        has_b = self.bias_ih is not None
+        if has_b:
+            args += [self.bias_ih, self.bias_hh]
+
+        def f(x, h, wih, whh, *bs):
+            gx = x @ wih.T
+            gh = h @ whh.T
+            if bs:
+                gx = gx + bs[0]
+                gh = gh + bs[1]
+            r = jax.nn.sigmoid(gx[..., :H] + gh[..., :H])
+            z = jax.nn.sigmoid(gx[..., H:2 * H] + gh[..., H:2 * H])
+            n = jnp.tanh(gx[..., 2 * H:] + r * gh[..., 2 * H:])
+            return (1 - z) * n + z * h
+        h = apply(f, *args, name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference: rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager scan in python (tape-recorded); under jit this unrolls into
+        # the trace — acceptable for moderate T; _RNNBase uses lax.scan
+        x = inputs
+        if not self.time_major:
+            x = x.transpose([1, 0, 2])
+        T = x.shape[0]
+        states = initial_states
+        outs = []
+        rng = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in rng:
+            o, states = self.cell(x[t], states)
+            outs.append(o)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...ops.manipulation import stack
+        out = stack(outs, axis=0)
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw)
+        from ...ops.manipulation import concat
+        return concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net over lax.scan
+    (reference: rnn.py _RNNBase / cudnn multi-layer path)."""
+
+    MODES = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate = self.MODES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                isz = input_size if layer == 0 else \
+                    hidden_size * self.bidirect
+                sfx = f"{layer}" + ("_reverse" if d else "")
+                wih = self.create_parameter([gate * hidden_size, isz],
+                                            weight_ih_attr,
+                                            default_initializer=u)
+                whh = self.create_parameter([gate * hidden_size, hidden_size],
+                                            weight_hh_attr,
+                                            default_initializer=u)
+                bih = self.create_parameter([gate * hidden_size],
+                                            bias_ih_attr, is_bias=True,
+                                            default_initializer=u)
+                bhh = self.create_parameter([gate * hidden_size],
+                                            bias_hh_attr, is_bias=True,
+                                            default_initializer=u)
+                self.add_parameter(f"weight_ih_l{sfx}", wih)
+                self.add_parameter(f"weight_hh_l{sfx}", whh)
+                self.add_parameter(f"bias_ih_l{sfx}", bih)
+                self.add_parameter(f"bias_hh_l{sfx}", bhh)
+                self._all_weights.append((wih, whh, bih, bhh))
+
+    def _cell_step(self, mode, H):
+        if mode == "LSTM":
+            def step(carry, xt, wih, whh, bih, bhh):
+                h, c = carry
+                z = xt @ wih.T + h @ whh.T + bih + bhh
+                i, f, g, o = (z[..., :H], z[..., H:2 * H],
+                              z[..., 2 * H:3 * H], z[..., 3 * H:])
+                c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+                return (h2, c2), h2
+        elif mode == "GRU":
+            def step(carry, xt, wih, whh, bih, bhh):
+                h = carry[0]
+                gx = xt @ wih.T + bih
+                gh = h @ whh.T + bhh
+                r = jax.nn.sigmoid(gx[..., :H] + gh[..., :H])
+                z = jax.nn.sigmoid(gx[..., H:2 * H] + gh[..., H:2 * H])
+                n = jnp.tanh(gx[..., 2 * H:] + r * gh[..., 2 * H:])
+                h2 = (1 - z) * n + z * h
+                return (h2,), h2
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, xt, wih, whh, bih, bhh):
+                h = carry[0]
+                h2 = act(xt @ wih.T + h @ whh.T + bih + bhh)
+                return (h2,), h2
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        H = self.hidden_size
+        mode = self.mode
+        n_state = 2 if mode == "LSTM" else 1
+        step = self._cell_step(mode, H)
+        nl, bd = self.num_layers, self.bidirect
+        weights = self._all_weights
+
+        x = as_tensor(inputs)
+        bt_major = not self.time_major
+        args = [x] + [p for w4 in weights for p in w4]
+
+        def f(xv, *flat_w):
+            xs = xv
+            if bt_major:
+                xs = jnp.swapaxes(xs, 0, 1)  # (T, B, C)
+            B = xs.shape[1]
+            h_final = []
+            c_final = []
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(bd):
+                    idx = (layer * bd + d) * 4
+                    wih, whh, bih, bhh = flat_w[idx:idx + 4]
+                    h0 = jnp.zeros((B, H), xs.dtype)
+                    carry = (h0, jnp.zeros((B, H), xs.dtype)) \
+                        if n_state == 2 else (h0,)
+                    seq = xs[::-1] if d == 1 else xs
+
+                    def body(carry, xt):
+                        c2, o = step(carry, xt, wih, whh, bih, bhh)
+                        return c2, o
+                    carry, ys = jax.lax.scan(body, carry, seq)
+                    if d == 1:
+                        ys = ys[::-1]
+                    outs_dir.append(ys)
+                    h_final.append(carry[0])
+                    if n_state == 2:
+                        c_final.append(carry[1])
+                xs = outs_dir[0] if bd == 1 else jnp.concatenate(outs_dir, -1)
+            out = xs
+            if bt_major:
+                out = jnp.swapaxes(out, 0, 1)
+            hN = jnp.stack(h_final, 0)
+            if n_state == 2:
+                cN = jnp.stack(c_final, 0)
+                return out, hN, cN
+            return out, hN
+        res = apply(f, *args, name=mode.lower())
+        if n_state == 2:
+            out, hN, cN = res
+            return out, (hN, cN)
+        out, hN = res
+        return out, hN
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

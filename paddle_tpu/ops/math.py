@@ -146,7 +146,7 @@ def _norm_axis(axis):
     return axis if axis is None else int(axis)
 
 
-def _reduce(name, jfn):
+def _reduce(op_name, jfn):
     def op(x, axis=None, keepdim=False, name=None, dtype=None):
         ax = _norm_axis(axis)
         d = dtypes.convert_dtype(dtype) if dtype is not None else None
@@ -154,9 +154,9 @@ def _reduce(name, jfn):
         def f(v):
             out = jfn(v, axis=ax, keepdims=keepdim)
             return out.astype(d) if d is not None else out
-        return apply(f, as_tensor(x), name=name)
-    op.__name__ = name
-    register(name)(op)
+        return apply(f, as_tensor(x), name=op_name)
+    op.__name__ = op_name
+    register(op_name)(op)
     return op
 
 

@@ -1,0 +1,244 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127).
+
+Each optimizer defines a pure ``_update_rule(param, grad, state, lr, ctx) ->
+(new_param, new_state)`` over raw jax arrays. The eager ``step()`` applies it
+per-parameter (the reference's dygraph path); the same rule is reused
+functionally by the jit train-step builder (paddle_tpu.jit.train_step) and by
+the distributed sharding wrappers — one source of truth, two execution modes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, Parameter
+from .._core.autograd import no_grad
+from .._core import dtype as dtypes
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups (reference: optimizer.py _param_groups)
+                self._param_groups = parameters
+                parameters = [p for g in parameters for p in g["params"]]
+            else:
+                self._param_groups = None
+        else:
+            self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay  # None or L2Decay-like
+        # state: slot name -> {id(param): Tensor}
+        self._accumulators: Dict[str, Dict[int, Tensor]] = {}
+        self._aux: Dict[str, Any] = {}
+        self._global_step = 0
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---- state accessors ----
+    def _acc(self, name: str, p: Tensor, init=None, dtype=None) -> Tensor:
+        slot = self._accumulators.setdefault(name, {})
+        t = slot.get(id(p))
+        if t is None:
+            d = dtype or (jnp.float32 if p.dtype in (
+                dtypes.float16, dtypes.bfloat16) else p.dtype)
+            val = jnp.zeros(tuple(p.shape), d) if init is None else init
+            t = Tensor(val, _internal=True)
+            slot[id(p)] = t
+        return t
+
+    # ---- subclass interface ----
+    def _slots(self) -> Sequence[str]:
+        return ()
+
+    def _update_rule(self, p, g, state: Dict[str, Any], lr, ctx: Dict) \
+            -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def _context(self) -> Dict:
+        return {}
+
+    # ---- main entry points ----
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        params_grads = []
+        wd_map = {}
+        if self._param_groups is not None:
+            for group in self._param_groups:
+                glr = lr * group.get("learning_rate", 1.0)
+                gwd = group.get("weight_decay", self._weight_decay)
+                for p in group["params"]:
+                    if not p.stop_gradient and p.grad is not None:
+                        params_grads.append((p, p.grad, glr))
+                        wd_map[id(p)] = gwd
+        else:
+            for p in self._parameter_list:
+                if not p.stop_gradient and p.grad is not None:
+                    plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                        if hasattr(p, "optimize_attr") else lr
+                    params_grads.append((p, p.grad, plr))
+                    wd_map[id(p)] = self._weight_decay
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g, _ in params_grads])
+            params_grads = [(p, g, plr) for (p, _, plr), (_, g) in
+                            zip(params_grads, clipped)]
+        self._global_step += 1
+        ctx = self._context()
+        ctx["step"] = self._global_step
+        for p, g, plr in params_grads:
+            ctx["weight_decay"] = wd_map.get(id(p))
+            ctx["param"] = p
+            state = {s: self._acc(s, p) for s in self._slots()}
+            sv = {k: t._value for k, t in state.items()}
+            # master weights: low-precision params update an fp32 master
+            # copy and are re-cast each step (reference: multi_precision
+            # kernels, e.g. adamw master_weight path)
+            use_master = p.dtype in (dtypes.float16, dtypes.bfloat16)
+            if use_master:
+                master = self._acc("master", p, init=getattr(
+                    p, "_master", None)._value if getattr(
+                        p, "_master", None) is not None
+                    else p._value.astype(jnp.float32))
+                pv = master._value
+            else:
+                pv = p._value
+            new_p, new_s = self._update_rule(pv, g._value, sv, plr, ctx)
+            if use_master:
+                master._inplace_assign(new_p.astype(jnp.float32))
+            p._inplace_assign(new_p.astype(p.dtype))
+            for k, t in state.items():
+                t._inplace_assign(new_s[k])
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in (self._parameter_list or []):
+            p.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # ---- state dict ----
+    def state_dict(self):
+        sd = {}
+        names = self._param_names()
+        for slot, d in self._accumulators.items():
+            for pid, t in d.items():
+                pname = names.get(pid, str(pid))
+                sd[f"{pname}@{slot}"] = t
+        sd["@global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        names = {v: k for k, v in self._param_names().items()}
+        for key, val in state_dict.items():
+            if key == "@global_step":
+                self._global_step = int(val)
+                continue
+            if key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(val)
+                continue
+            if "@" not in key:
+                continue
+            pname, slot = key.rsplit("@", 1)
+            pid = names.get(pname)
+            if pid is None:
+                continue
+            d = self._accumulators.setdefault(slot, {})
+            v = val._value if isinstance(val, Tensor) else jnp.asarray(
+                np.asarray(val))
+            if pid in d:
+                d[pid]._inplace_assign(v)
+            else:
+                d[pid] = Tensor(v, _internal=True)
+
+    def _param_names(self):
+        return {id(p): p.name for p in (self._parameter_list or [])}
+
+    # ---- functional core for jit/train_step ----
+    def build_functional(self, named_params: Dict[str, Tensor]):
+        """Return (init_state_fn, update_fn) closed over static config.
+
+        update_fn(params, grads, state, step) -> (new_params, new_state),
+        pure over pytrees — this is what jit-compiled training steps and
+        sharded optimizers call.
+        """
+        slots = tuple(self._slots())
+        ctx_static = self._context()
+        wd = self._weight_decay
+        rule = self._update_rule
+        lr_holder = self
+
+        def init_state(params):
+            state = {}
+            for k, p in params.items():
+                low = jnp.result_type(p) in (jnp.float16, jnp.bfloat16)
+                d = jnp.float32 if low else jnp.result_type(p)
+                st = {s: jnp.zeros(jnp.shape(p), d) for s in slots}
+                if low:
+                    # fp32 master copy for low-precision params
+                    st["master"] = jnp.asarray(p, jnp.float32)
+                state[k] = st
+            return state
+
+        def update(params, grads, state, step, lr=None):
+            lr = lr_holder.get_lr() if lr is None else lr
+            new_params, new_state = {}, {}
+            for k, p in params.items():
+                g = grads.get(k)
+                if g is None:
+                    new_params[k] = p
+                    new_state[k] = state[k]
+                    continue
+                ctx = dict(ctx_static)
+                ctx["step"] = step
+                ctx["weight_decay"] = wd
+                ctx["param"] = None
+                st = dict(state[k])
+                pv = st.get("master", p)
+                np_, ns = rule(pv, g, st, lr, ctx)
+                if "master" in st:
+                    ns = dict(ns)
+                    ns["master"] = np_.astype(jnp.float32)
+                new_params[k] = np_.astype(jnp.result_type(p))
+                new_state[k] = ns
+            return new_params, new_state
+
+        return init_state, update
+
+    @property
+    def _parameters(self):
+        return self._parameter_list

@@ -1,0 +1,275 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,
+adam,adamw,adagrad,adadelta,rmsprop,adamax,lamb,lbfgs}.py). Update rules are
+pure jnp functions applied eagerly or inside jit."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+def _apply_l2(g, p, wd):
+    if wd:
+        return g + wd * p
+    return g
+
+
+class SGD(Optimizer):
+    """reference: python/paddle/optimizer/sgd.py."""
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    """reference: python/paddle/optimizer/momentum.py."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _slots(self):
+        return ("velocity",)
+
+    def _context(self):
+        return {"momentum": self._momentum, "nesterov": self._nesterov}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        v = ctx["momentum"] * state["velocity"] + g
+        if ctx["nesterov"]:
+            upd = g + ctx["momentum"] * v
+        else:
+            upd = v
+        state["velocity"] = v
+        return p - lr * upd, state
+
+
+class Adam(Optimizer):
+    """reference: python/paddle/optimizer/adam.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _slots(self):
+        return ("moment1", "moment2")
+
+    def _context(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "eps": self._epsilon}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        b1, b2, eps = ctx["beta1"], ctx["beta2"], ctx["eps"]
+        t = ctx["step"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        state["moment1"] = m
+        state["moment2"] = v
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps), state
+
+
+class AdamW(Adam):
+    """reference: python/paddle/optimizer/adamw.py — decoupled decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coupled_wd = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _context(self):
+        c = super()._context()
+        c["adamw_wd"] = self._coupled_wd
+        c["decay_fn"] = self._apply_decay_param_fun
+        return c
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        b1, b2, eps = ctx["beta1"], ctx["beta2"], ctx["eps"]
+        t = ctx["step"]
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        wd = ctx["adamw_wd"]
+        decay_fn = ctx.get("decay_fn")
+        do_decay = True
+        param = ctx.get("param")
+        if decay_fn is not None and param is not None:
+            do_decay = decay_fn(param.name)
+        if wd and do_decay:
+            p32 = p32 * (1.0 - lr * wd)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        state["moment1"] = m
+        state["moment2"] = v
+        return p32 - lr * mhat / (jnp.sqrt(vhat) + eps), state
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _slots(self):
+        return ("moment", "inf_norm")
+
+    def _context(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "eps": self._epsilon}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        b1, b2, eps = ctx["beta1"], ctx["beta2"], ctx["eps"]
+        t = ctx["step"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        state["moment"] = m
+        state["inf_norm"] = u
+        return p - (lr / (1 - b1 ** t)) * m / (u + eps), state
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _slots(self):
+        return ("moment",)
+
+    def _context(self):
+        return {"eps": self._epsilon}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        acc = state["moment"] + jnp.square(g)
+        state["moment"] = acc
+        return p - lr * g / (jnp.sqrt(acc) + ctx["eps"]), state
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _slots(self):
+        return ("avg_squared_grad", "avg_squared_update")
+
+    def _context(self):
+        return {"eps": self._epsilon, "rho": self._rho}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        eps, rho = ctx["eps"], ctx["rho"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        sg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        upd = -jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(sg + eps) * g
+        su = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        state["avg_squared_grad"] = sg
+        state["avg_squared_update"] = su
+        return p + lr * upd, state
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _slots(self):
+        return ("mean_square", "mean_grad", "momentum_acc")
+
+    def _context(self):
+        return {"rho": self._rho, "eps": self._epsilon,
+                "momentum": self._momentum, "centered": self._centered}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        rho, eps = ctx["rho"], ctx["eps"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        state["mean_square"] = ms
+        if ctx["centered"]:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            state["mean_grad"] = mg
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = ctx["momentum"] * state["momentum_acc"] + lr * g / denom
+        state["momentum_acc"] = mom
+        return p - mom, state
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py — layerwise-adapted Adam."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _slots(self):
+        return ("moment1", "moment2")
+
+    def _context(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "eps": self._epsilon, "lamb_wd": self._lamb_wd,
+                "exclude_fn": self._exclude_fn}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        b1, b2, eps = ctx["beta1"], ctx["beta2"], ctx["eps"]
+        t = ctx["step"]
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        wd = ctx["lamb_wd"]
+        param = ctx.get("param")
+        if ctx.get("exclude_fn") is not None and param is not None and \
+                ctx["exclude_fn"](param):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        state["moment1"] = m
+        state["moment2"] = v
+        return p32 - lr * trust * r, state

@@ -1,0 +1,111 @@
+"""paddle_tpu.static (reference: python/paddle/static/).
+
+The reference's static graph (ProgramDesc + Executor, SURVEY §2.1 layer 4c/5)
+is subsumed on TPU by jax tracing: a "static-mode program" is a traced+jitted
+function. This module keeps the API surface (enable_static, program_guard,
+Executor) mapping onto that substrate so static-style user code runs.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..jit.api import InputSpec  # noqa: F401
+from .._core.tensor import Tensor
+
+_state = threading.local()
+
+
+def in_dynamic_mode() -> bool:
+    return not getattr(_state, "static", False)
+
+
+def in_static_mode() -> bool:
+    return getattr(_state, "static", False)
+
+
+def enable_static():
+    _state.static = True
+
+
+def disable_static():
+    _state.static = False
+
+
+class Program:
+    """Placeholder parity object: on TPU a program is a traced function; the
+    Program object carries no graph (reference: base/framework.py:5893)."""
+
+    def __init__(self):
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    if not hasattr(_state, "main_program"):
+        _state.main_program = Program()
+    return _state.main_program
+
+
+def default_startup_program():
+    if not hasattr(_state, "startup_program"):
+        _state.startup_program = Program()
+    return _state.startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old = getattr(_state, "main_program", None)
+    _state.main_program = main_program
+    try:
+        yield
+    finally:
+        _state.main_program = old
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    raise NotImplementedError(
+        "static.data placeholders are not supported: use paddle.jit."
+        "to_static with InputSpec (the TPU-native compile path)")
+
+
+class Executor:
+    """Parity shell (reference: python/paddle/base/executor.py:1234): jitted
+    functions execute directly; run() only supports callables captured via
+    jit."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "static Executor.run over ProgramDesc has no TPU analog; "
+            "compile with paddle.jit.to_static and call the function")
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    pass
+
+
+class ExecutionStrategy:
+    pass
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd.functional import grad
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+# re-exports for static-style model code
+from ..nn import *  # noqa: F401,F403,E402

@@ -1,0 +1,185 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — cifar.py,
+mnist.py, flowers.py...).
+
+Zero-egress environment: datasets load from local files when present
+(standard binary layouts), and every dataset supports ``mode='synthetic'``
+generating deterministic fake data with the real shapes — that's what tests
+and benchmarks use (analog of the reference's test fakes, SURVEY §4).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _SyntheticImages(Dataset):
+    def __init__(self, n, shape, num_classes, transform=None, seed=0):
+        self.n = n
+        self.shape = shape
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.RandomState(seed)
+        self.images = rng.randint(0, 256, (n,) + shape).astype(np.uint8)
+        self.labels = rng.randint(0, num_classes, (n,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.n
+
+
+class Cifar10(Dataset):
+    """reference: python/paddle/vision/datasets/cifar.py Cifar10."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        assert mode in ("train", "test", "synthetic")
+        self.mode = mode
+        self.transform = transform
+        if mode == "synthetic" or (data_file is None or
+                                   not os.path.exists(data_file)):
+            if mode != "synthetic" and data_file is not None:
+                raise FileNotFoundError(
+                    f"{data_file} not found and download is impossible "
+                    "(zero-egress); pass mode='synthetic' for fake data")
+            syn = _SyntheticImages(50000 if mode == "train" else 10000,
+                                   (3, 32, 32), 10,
+                                   seed=0 if mode == "train" else 1)
+            self.data = [(syn.images[i].reshape(-1), syn.labels[i])
+                         for i in range(len(syn))]
+        else:
+            self.data = []
+            with tarfile.open(data_file, mode="r") as f:
+                names = [n for n in f.getnames()
+                         if ("data_batch" in n if mode == "train"
+                             else "test_batch" in n)]
+                for name in names:
+                    batch = pickle.load(f.extractfile(name),
+                                        encoding="bytes")
+                    for x, y in zip(batch[b"data"], batch[b"labels"]):
+                        self.data.append((x, int(y)))
+
+    def __getitem__(self, idx):
+        image, label = self.data[idx]
+        image = np.reshape(image, [3, 32, 32]).astype(np.float32)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            syn = _SyntheticImages(60000 if mode == "train" else 10000,
+                                   (1, 28, 28), 10,
+                                   seed=2 if mode == "train" else 3)
+            self.images = syn.images
+            self.labels = syn.labels
+        else:
+            with gzip.open(image_path, "rb") as f:
+                buf = f.read()
+                n = int.from_bytes(buf[4:8], "big")
+                self.images = np.frombuffer(
+                    buf, np.uint8, offset=16).reshape(n, 1, 28, 28)
+            with gzip.open(label_path, "rb") as f:
+                buf = f.read()
+                self.labels = np.frombuffer(buf, np.uint8,
+                                            offset=8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class DatasetFolder(Dataset):
+    """reference: python/paddle/vision/datasets/folder.py."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError:
+            raise RuntimeError("PIL not available; use .npy images")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        self.samples = [os.path.join(root, fn)
+                        for fn in sorted(os.listdir(root))
+                        if fn.lower().endswith(extensions)]
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

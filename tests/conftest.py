@@ -1,0 +1,28 @@
+"""Test harness config.
+
+Forces CPU platform BEFORE jax backend init (the baked axon sitecustomize
+otherwise routes to the TPU tunnel) and presents 8 virtual devices so
+sharding/collective tests run without TPU hardware — the reference's
+no-cluster distributed-test pattern (SURVEY §4: TestDistBase subprocess
+ranks ≙ xla_force_host_platform_device_count mesh).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

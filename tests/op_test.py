@@ -1,0 +1,80 @@
+"""OpTest-style helper (reference: test/legacy_test/op_test.py:418 OpTest —
+check_output:2877 against numpy reference, check_grad:3081 via numeric
+finite-difference). Here gradients are checked against jax.grad of the same
+composition, plus optional finite differences."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import Tensor
+
+
+def check_output(pd_fn, np_ref, *arrays, atol=1e-5, rtol=1e-5, kwargs=None):
+    """Run op on Tensors, compare against numpy reference."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = pd_fn(*tensors, **kwargs)
+    ref = np_ref(*arrays)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                   np.asarray(r, np.float64),
+                                   atol=atol, rtol=rtol)
+    return out
+
+
+def check_grad(pd_fn, *arrays, atol=1e-4, rtol=1e-4, kwargs=None,
+               numeric=False, eps=1e-3):
+    """Backward through the tape; compare against jax.grad of the same fn
+    applied to raw arrays (and optionally finite differences)."""
+    kwargs = kwargs or {}
+    tensors = []
+    for a in arrays:
+        t = paddle.to_tensor(np.asarray(a, np.float32))
+        t.stop_gradient = False
+        tensors.append(t)
+    out = pd_fn(*tensors, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = None
+    for o in outs:
+        term = (o * o).sum() if o.size > 1 else o * o
+        loss = term if loss is None else loss + term
+    loss.backward()
+
+    def raw_loss(*vals):
+        ts = [Tensor(v, stop_gradient=False, _internal=True) for v in vals]
+        o = pd_fn(*ts, **kwargs)
+        os_ = o if isinstance(o, (tuple, list)) else [o]
+        lv = None
+        for oo in os_:
+            t = jnp.sum(jnp.square(oo._value))
+            lv = t if lv is None else lv + t
+        return lv
+
+    vals = [t._value for t in tensors]
+    ref_grads = jax.grad(raw_loss, argnums=tuple(range(len(vals))))(*vals)
+    for t, rg in zip(tensors, ref_grads):
+        assert t.grad is not None, "missing grad"
+        np.testing.assert_allclose(np.asarray(t.grad.numpy(), np.float64),
+                                   np.asarray(rg, np.float64),
+                                   atol=atol, rtol=rtol)
+    if numeric:
+        for i, t in enumerate(tensors):
+            flat = np.asarray(vals[i]).reshape(-1)
+            num = np.zeros_like(flat, np.float64)
+            for j in range(flat.size):
+                vp = flat.copy(); vp[j] += eps
+                vm = flat.copy(); vm[j] -= eps
+                args_p = list(vals); args_p[i] = jnp.asarray(
+                    vp.reshape(vals[i].shape), jnp.float32)
+                args_m = list(vals); args_m[i] = jnp.asarray(
+                    vm.reshape(vals[i].shape), jnp.float32)
+                num[j] = (float(raw_loss(*args_p)) -
+                          float(raw_loss(*args_m))) / (2 * eps)
+            np.testing.assert_allclose(
+                np.asarray(t.grad.numpy(), np.float64).reshape(-1), num,
+                atol=5e-2, rtol=5e-2)

@@ -1,0 +1,264 @@
+"""AMP, jit, io, framework save/load, metric tests."""
+import os
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.amp import auto_cast, GradScaler, decorate
+from paddle_tpu.optimizer import SGD, Adam
+
+
+# ---- AMP ----
+def test_autocast_o1_matmul_dtype():
+    a = paddle.randn([4, 4])
+    b = paddle.randn([4, 4])
+    with auto_cast(level="O1", dtype="bfloat16"):
+        c = paddle.matmul(a, b)
+        assert c.dtype == paddle.bfloat16
+        s = paddle.sum(c)  # black list -> fp32
+        assert s.dtype == paddle.float32
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == paddle.float32
+
+
+def test_autocast_custom_lists():
+    x = paddle.randn([4])
+    with auto_cast(custom_white_list={"exp"}, dtype="bfloat16"):
+        assert paddle.exp(x).dtype == paddle.bfloat16
+
+
+def test_decorate_o2():
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    decorate(net, level="O2", dtype="bfloat16")
+    assert net[0].weight.dtype == paddle.bfloat16
+    assert net[1].weight.dtype == paddle.float32  # norm layers kept fp32
+
+
+def test_grad_scaler_flow():
+    w = paddle.to_tensor(np.array([1.0], np.float32))
+    w.stop_gradient = False
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    scaler = GradScaler(init_loss_scaling=8.0)
+    loss = (w * w).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)  # unscaled grad 2
+
+
+def test_grad_scaler_skips_inf():
+    w = paddle.to_tensor(np.array([1.0], np.float32))
+    w.stop_gradient = False
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    scaler = GradScaler(init_loss_scaling=4.0)
+    loss = (w * np.float32(np.inf)).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)  # inf grad -> step skipped
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0])
+    assert scaler.get_scale() == 2.0  # halved
+
+
+# ---- jit ----
+def test_to_static_function_caching():
+    calls = []
+
+    def f(x, y):
+        calls.append(1)
+        return paddle.matmul(x, y) + 1
+
+    sf = paddle.jit.to_static(f)
+    a = paddle.randn([2, 3])
+    b = paddle.randn([3, 4])
+    o1 = sf(a, b)
+    o2 = sf(a, b)
+    assert len(calls) == 1  # traced once
+    np.testing.assert_allclose(o1.numpy(), o2.numpy())
+    sf(paddle.randn([4, 3]), paddle.randn([3, 2]))
+    assert len(calls) == 2  # retraced on new shapes
+
+
+def test_to_static_layer_params_update_no_retrace():
+    net = nn.Linear(3, 3)
+    sf = paddle.jit.to_static(net)
+    x = paddle.randn([2, 3])
+    o1 = net(x).numpy()
+    with paddle.no_grad():
+        net.weight._inplace_assign(net.weight._value * 2)
+    o2 = net(x).numpy()
+    assert not np.allclose(o1, o2)  # new params picked up without retrace
+
+
+def test_train_step_matches_eager():
+    paddle.seed(3)
+    net_a = nn.Linear(4, 2)
+    net_b = nn.Linear(4, 2)
+    net_b.set_state_dict(net_a.state_dict())
+    x = paddle.randn([8, 4])
+    y = paddle.randint(0, 2, [8])
+    loss_fn = nn.CrossEntropyLoss()
+
+    opt_a = SGD(learning_rate=0.1, parameters=net_a.parameters())
+    step = paddle.jit.TrainStep(net_a, loss_fn, opt_a)
+    losses_c = [float(step((x,), (y,))) for _ in range(5)]
+    step.sync_to_model()
+
+    opt_b = SGD(learning_rate=0.1, parameters=net_b.parameters())
+    losses_e = []
+    for _ in range(5):
+        loss = loss_fn(net_b(x), y)
+        loss.backward()
+        opt_b.step(); opt_b.clear_grad()
+        losses_e.append(float(loss))
+    np.testing.assert_allclose(losses_c, losses_e, rtol=1e-4)
+    np.testing.assert_allclose(net_a.weight.numpy(), net_b.weight.numpy(),
+                               rtol=1e-4)
+
+
+def test_jit_save_load(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), atol=1e-5)
+
+
+# ---- io ----
+def test_dataloader_batching():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        def __len__(self):
+            return 10
+
+    dl = DataLoader(DS(), batch_size=4, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == [4, 3]
+    assert batches[2][0].shape == [2, 3]
+    dl2 = DataLoader(DS(), batch_size=4, drop_last=True)
+    assert len(list(dl2)) == 2
+
+
+def test_dataloader_shuffle_workers():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 32
+
+    seen = []
+    for batch in DataLoader(DS(), batch_size=8, shuffle=True, num_workers=2):
+        seen.extend(batch.numpy().tolist())
+    assert sorted(seen) == list(range(32))
+
+
+def test_tensor_dataset_random_split():
+    from paddle_tpu.io import TensorDataset, random_split
+    x = paddle.randn([10, 3])
+    y = paddle.arange(10)
+    ds = TensorDataset([x, y])
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler():
+    from paddle_tpu.io import DistributedBatchSampler, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return i
+
+        def __len__(self):
+            return 10
+
+    s0 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0).isdisjoint(set(i1)) or True  # padded overlap allowed
+    assert len(set(i0) | set(i1)) == 10
+
+
+# ---- framework io ----
+def test_save_load_state_dict(tmp_path):
+    net = nn.Linear(3, 3)
+    p = str(tmp_path / "sd.pdparams")
+    paddle.save(net.state_dict(), p)
+    sd = paddle.load(p)
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(sd)
+    x = paddle.randn([2, 3])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)
+
+
+def test_save_load_nested(tmp_path):
+    obj = {"a": paddle.ones([2]), "b": [paddle.zeros([3]), 5], "c": "str"}
+    p = str(tmp_path / "obj.pd")
+    paddle.save(obj, p)
+    out = paddle.load(p)
+    np.testing.assert_array_equal(out["a"].numpy(), np.ones(2))
+    assert out["b"][1] == 5 and out["c"] == "str"
+
+
+# ---- metric ----
+def test_accuracy_metric():
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]],
+                                     np.float32))
+    label = paddle.to_tensor(np.array([0, 1, 1]))
+    c = m.compute(pred, label)
+    m.update(c)
+    np.testing.assert_allclose(m.accumulate(), 2 / 3, rtol=1e-6)
+
+
+def test_auc_metric():
+    from paddle_tpu.metric import Auc
+    m = Auc()
+    preds = np.array([[0.9, 0.1], [0.6, 0.4], [0.3, 0.7], [0.1, 0.9]],
+                     np.float32)
+    labels = np.array([0, 0, 1, 1])
+    m.update(preds, labels)
+    np.testing.assert_allclose(m.accumulate(), 1.0, atol=1e-3)
+
+
+# ---- hapi ----
+def test_model_fit_eval_predict(tmp_path):
+    from paddle_tpu.io import Dataset
+
+    class DS(Dataset):
+        def __init__(self, n=64):
+            rng = np.random.RandomState(0)
+            self.x = rng.rand(n, 8).astype(np.float32)
+            self.y = (self.x[:, 0] > 0.5).astype(np.int64)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=Adam(0.05, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=paddle.metric.Accuracy())
+    model.fit(DS(), batch_size=16, epochs=12, verbose=0)
+    res = model.evaluate(DS(), batch_size=32, verbose=0)
+    assert res["acc"] > 0.8
+    preds = model.predict(DS(), batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+    model.save(str(tmp_path / "ck"))
+    model.load(str(tmp_path / "ck"))

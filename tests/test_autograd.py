@@ -1,0 +1,186 @@
+"""Autograd tape semantics (reference: test/legacy_test/test_imperative_*)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, grad as pgrad
+
+
+def test_backward_accumulates():
+    p = paddle.ones([3])
+    p.stop_gradient = False
+    (p * 2).sum().backward()
+    (p * 3).sum().backward()
+    np.testing.assert_allclose(p.grad.numpy(), 5.0 * np.ones(3))
+
+
+def test_double_backward_raises_without_retain():
+    t = paddle.ones([2])
+    t.stop_gradient = False
+    z = (t * t).sum()
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_retain_graph():
+    t = paddle.ones([2])
+    t.stop_gradient = False
+    z = (t * t).sum()
+    z.backward(retain_graph=True)
+    z.backward()
+    np.testing.assert_allclose(t.grad.numpy(), 4.0 * np.ones(2))
+
+
+def test_nonscalar_backward_needs_grad():
+    m = paddle.ones([2, 2])
+    m.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        (m * 2).backward()
+    (m * 2).backward(grad_tensor=paddle.ones([2, 2]))
+    np.testing.assert_allclose(m.grad.numpy(), 2 * np.ones((2, 2)))
+
+
+def test_stop_gradient_barrier():
+    s = paddle.ones([2])
+    s.stop_gradient = False
+    d = s.detach()
+    assert d.stop_gradient
+    out = (d * 3).sum()
+    assert out.stop_gradient
+
+
+def test_inplace_grad_routing():
+    # value-history routing: grads computed wrt recorded values
+    a = paddle.ones([2])
+    a.stop_gradient = False
+    b = a * 3.0
+    a.add_(1.0)
+    c = a * b  # c = (a0+1)*3*a0 -> dc/da0 = 3*(2a0+1) = 9 at a0=1
+    c.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), 9.0 * np.ones(2))
+
+
+def test_setitem_grad():
+    h = paddle.zeros([4])
+    h.stop_gradient = False
+    src = paddle.to_tensor([7.0])
+    src.stop_gradient = False
+    h2 = h * 2.0
+    h2[1:2] = src
+    h2.sum().backward()
+    np.testing.assert_allclose(h.grad.numpy(), [2, 0, 2, 2])
+    np.testing.assert_allclose(src.grad.numpy(), [1.0])
+
+
+def test_setitem_into_stopped_buffer():
+    buf = paddle.zeros([4])
+    net = paddle.to_tensor([5.0])
+    net.stop_gradient = False
+    buf[2:3] = net
+    assert not buf.stop_gradient
+    buf.sum().backward()
+    np.testing.assert_allclose(net.grad.numpy(), [1.0])
+
+
+def test_grad_api_does_not_touch_grads():
+    w = paddle.ones([2]); w.stop_gradient = False
+    b = paddle.ones([2]); b.stop_gradient = False
+    loss = (w * 2 + b * 3).sum()
+    gw, = pgrad(loss, [w])
+    np.testing.assert_allclose(gw.numpy(), 2 * np.ones(2))
+    assert w.grad is None and b.grad is None
+
+
+def test_grad_allow_unused():
+    x = paddle.ones([2]); x.stop_gradient = False
+    y = paddle.ones([2]); y.stop_gradient = False
+    loss = (x * 2).sum()
+    with pytest.raises(RuntimeError):
+        pgrad(loss, [y])
+    loss2 = (x * 2).sum()
+    gx, gy = pgrad(loss2, [x, y], allow_unused=True)
+    assert gy is None
+
+
+def test_register_hook():
+    x = paddle.ones([2]); x.stop_gradient = False
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()) or g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6 * np.ones(2))
+    assert len(seen) == 1
+
+
+def test_no_grad_context():
+    x = paddle.ones([2]); x.stop_gradient = False
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert paddle.is_grad_enabled()
+
+
+def test_pylayer():
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            x, = ctx.saved_tensor
+            return dy * 3 * x * x
+
+    x = paddle.to_tensor([2.0, 3.0])
+    x.stop_gradient = False
+    y = Cube.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * np.array([4.0, 9.0]))
+
+
+def test_pylayer_multi_output():
+    class Split2(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2, x * 3
+
+        @staticmethod
+        def backward(ctx, d1, d2):
+            return d1 * 2 + d2 * 3
+
+    x = paddle.to_tensor([1.0, 1.0])
+    x.stop_gradient = False
+    a, b = Split2.apply(x)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5 * np.ones(2))
+
+
+def test_jacobian_hessian():
+    from paddle_tpu.autograd import jacobian, hessian
+
+    def f(x):
+        return (x * x).sum()
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), 2 * np.eye(3), atol=1e-5)
+
+    def g(x):
+        return x * x
+    j = jacobian(g, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0, 6.0]),
+                               atol=1e-5)
+
+
+def test_tensor_in_jax_jit():
+    # Tensors are pytree nodes: imperative code runs under jax.jit
+    import jax
+
+    @jax.jit
+    def f(t):
+        return (t * 2 + 1).sum()
+
+    out = f(paddle.to_tensor([1.0, 2.0]))
+    assert float(out.numpy()) == 8.0

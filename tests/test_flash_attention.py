@@ -1,0 +1,94 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU)
+(reference: test/legacy_test/test_flash_attention.py)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.ops.pallas.flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    fa.set_interpret(True)
+    yield
+    fa.set_interpret(False)
+
+
+def _ref(q, k, v, causal):
+    B, S, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        m = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_bwd_matches_xla(causal):
+    B, S, H, D = 1, 256, 2, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.float32)
+    out = fa.flash_attention(q, k, v, causal=causal)
+    ref = _ref(q, k, v, causal)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+    g = jax.grad(lambda *a: (fa.flash_attention(*a, causal=causal) ** 2
+                             ).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (_ref(*a, causal) ** 2).sum(), (0, 1, 2))(
+        q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.abs(a - b).max()) < 5e-5
+
+
+def test_flash_gqa():
+    B, S, H, HK, D = 1, 128, 4, 2, 32
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, HK, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, HK, D), jnp.float32)
+    out = fa.flash_attention(q, k, v, causal=True)
+    kr = jnp.repeat(k, 2, axis=2)
+    vr = jnp.repeat(v, 2, axis=2)
+    ref = _ref(q, kr, vr, True)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_functional_flash_attention_api():
+    q = paddle.randn([1, 128, 2, 32])
+    out, _ = F.flash_attention(q, q, q, causal=True)
+    assert out.shape == [1, 128, 2, 32]
+
+
+def test_sdpa_with_mask():
+    B, S, H, D = 1, 16, 2, 8
+    q = paddle.randn([B, S, H, D])
+    mask = paddle.to_tensor(np.tril(np.ones((S, S), bool)))
+    out = F.scaled_dot_product_attention(q, q, q, attn_mask=mask)
+    out_causal = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    np.testing.assert_allclose(out.numpy(), out_causal.numpy(), atol=1e-5)
+
+
+def test_flash_attn_unpadded_segments():
+    # two sequences of length 3 and 5 packed into 8 tokens: attention must
+    # not cross the boundary
+    T, H, D = 8, 1, 8
+    q = paddle.randn([T, H, D])
+    cu = paddle.to_tensor(np.array([0, 3, 8], np.int32))
+    out, _ = F.flash_attn_unpadded(q, q, q, cu, cu, 5, 5,
+                                   scale=1.0 / np.sqrt(D))
+    # reference: blockwise softmax within segments
+    qv = q.numpy()[:, 0]
+    s = qv @ qv.T / np.sqrt(D)
+    mask = np.zeros((T, T), bool)
+    mask[:3, :3] = True
+    mask[3:, 3:] = True
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = p @ qv
+    np.testing.assert_allclose(out.numpy()[:, 0], ref, atol=1e-4)

@@ -1,0 +1,272 @@
+"""nn layer tests (reference: test/legacy_test/test_layers.py and
+per-layer tests)."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_values():
+    l = nn.Linear(4, 3)
+    x = paddle.randn([5, 4])
+    out = l(x)
+    assert out.shape == [5, 3]
+    ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_linear_no_bias():
+    l = nn.Linear(4, 3, bias_attr=False)
+    assert l.bias is None
+    assert len(l.parameters()) == 1
+
+
+def test_conv2d_vs_scipy():
+    from scipy.signal import correlate2d
+    conv = nn.Conv2D(1, 1, 3, padding=1, bias_attr=False)
+    x = np.random.rand(1, 1, 8, 8).astype(np.float32)
+    out = conv(paddle.to_tensor(x)).numpy()[0, 0]
+    w = conv.weight.numpy()[0, 0]
+    ref = correlate2d(x[0, 0], w, mode="same")
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_conv2d_groups_stride():
+    conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+    out = conv(paddle.randn([2, 4, 8, 8]))
+    assert out.shape == [2, 8, 4, 4]
+
+
+def test_conv_transpose_shape():
+    deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+    out = deconv(paddle.randn([1, 4, 5, 5]))
+    assert out.shape == [1, 2, 9, 9]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = paddle.randn([8, 3, 4, 4]) * 3 + 1
+    bn.train()
+    out = bn(x)
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0, atol=1e-4)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 3, 4, 4]
+
+
+def test_layernorm_rmsnorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8])
+    o = ln(x).numpy()
+    np.testing.assert_allclose(o.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(o.std(-1), 1, atol=1e-2)
+    rms = nn.RMSNorm(8)
+    o2 = rms(x).numpy()
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(o2, ref, atol=1e-5)
+
+
+def test_groupnorm():
+    gn = nn.GroupNorm(2, 4)
+    x = paddle.randn([2, 4, 3, 3])
+    o = gn(x).numpy()
+    grouped = x.numpy().reshape(2, 2, 2, 3, 3)
+    ref_m = grouped.mean(axis=(2, 3, 4))
+    np.testing.assert_allclose(
+        o.reshape(2, 2, 2, 3, 3).mean(axis=(2, 3, 4)), 0, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    np.testing.assert_allclose(emb.weight.numpy()[0], 0)
+    idx = paddle.to_tensor(np.array([[0, 1], [2, 0]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], 0)
+    # grad flows only to non-padding rows
+    emb.weight.stop_gradient = False
+    emb(idx).sum().backward()
+    np.testing.assert_allclose(emb.weight.grad.numpy()[0], 0)
+    assert np.abs(emb.weight.grad.numpy()[1]).sum() > 0
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    out = d(x)
+    kept = (out.numpy() != 0)
+    assert 0.3 < kept.mean() < 0.7
+    np.testing.assert_allclose(out.numpy()[kept], 2.0, rtol=1e-5)
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_activations_values():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+    np.testing.assert_allclose(F.gelu(t).numpy(),
+                               x * sps.ndtr(x), atol=1e-5)
+    np.testing.assert_allclose(F.silu(t).numpy(), x * sps.expit(x),
+                               atol=1e-6)
+    np.testing.assert_allclose(F.softmax(t).numpy(), sps.softmax(x),
+                               atol=1e-6)
+    np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(),
+                               np.where(x > 0, x, 0.1 * x), atol=1e-6)
+
+
+def test_swiglu():
+    x = paddle.randn([2, 8])
+    out = F.swiglu(x)
+    a, b = x.numpy()[:, :4], x.numpy()[:, 4:]
+    np.testing.assert_allclose(out.numpy(), a * sps.expit(a) * b, atol=1e-5)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2, 2).numpy()
+    np.testing.assert_array_equal(mp[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2, 2).numpy()
+    np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    aap = F.adaptive_avg_pool2d(x, 1).numpy()
+    np.testing.assert_allclose(aap[0, 0, 0, 0], 7.5)
+
+
+def test_cross_entropy_matches_manual():
+    logits = np.random.randn(6, 5).astype(np.float32)
+    labels = np.random.randint(0, 5, 6)
+    loss = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels))
+    logp = logits - sps.logsumexp(logits, axis=1, keepdims=True)
+    ref = -logp[np.arange(6), labels].mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = np.random.randn(4, 3).astype(np.float32)
+    labels = np.array([0, -100, 2, -100])
+    loss = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels), ignore_index=-100)
+    logp = logits - sps.logsumexp(logits, axis=1, keepdims=True)
+    ref = -(logp[0, 0] + logp[2, 2]) / 2
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_cross_entropy_soft_label_smoothing():
+    logits = np.random.randn(4, 3).astype(np.float32)
+    labels = np.random.randint(0, 3, 4)
+    l1 = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         label_smoothing=0.1)
+    assert np.isfinite(float(l1))
+
+
+def test_mse_l1():
+    a, b = np.random.rand(3, 3).astype(np.float32), \
+        np.random.rand(3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+        ((a - b) ** 2).mean(), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+        np.abs(a - b).mean(), rtol=1e-6)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4, dropout=0.0)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    out.mean().backward()
+    assert all(p.grad is not None for p in mha.parameters())
+
+
+def test_transformer_full():
+    t = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                       num_decoder_layers=2, dim_feedforward=32, dropout=0.0)
+    src = paddle.randn([2, 6, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = t(src, tgt)
+    assert out.shape == [2, 4, 16]
+    mask = t.generate_square_subsequent_mask(4)
+    assert mask.shape == [4, 4]
+
+
+def test_sequential_containers():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(s) == 3
+    out = s(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_state_dict_roundtrip_nested():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.backbone = nn.Sequential(nn.Linear(4, 8),
+                                          nn.BatchNorm1D(8))
+            self.head = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.head(self.backbone(x))
+
+    m1, m2 = M(), M()
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    m1.eval(); m2.eval()
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), atol=1e-6)
+    # buffers included
+    assert any("_mean" in k for k in m1.state_dict())
+
+
+def test_parameters_dedup_shared():
+    shared = nn.Linear(4, 4)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = shared
+            self.b = shared
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    m = M()
+    assert len(m.parameters()) == 2  # weight+bias counted once
+
+
+def test_clip_grad_global_norm():
+    from paddle_tpu.nn.clip_grad import ClipGradByGlobalNorm
+    p1 = paddle.ones([3]); p1.stop_gradient = False
+    g1 = paddle.to_tensor(np.array([3.0, 4.0, 0.0], np.float32))
+    clip = ClipGradByGlobalNorm(1.0)
+    (p, g), = clip([(p1, g1)])
+    np.testing.assert_allclose(np.linalg.norm(g.numpy()), 1.0, rtol=1e-5)
+
+
+def test_interpolate():
+    x = paddle.randn([1, 3, 4, 4])
+    out = F.interpolate(x, size=[8, 8], mode="nearest")
+    assert out.shape == [1, 3, 8, 8]
+    out2 = F.interpolate(x, scale_factor=2, mode="bilinear")
+    assert out2.shape == [1, 3, 8, 8]
+
+
+def test_rnn_cells():
+    cell = nn.LSTMCell(4, 8)
+    h, (h2, c2) = cell(paddle.randn([2, 4]))
+    assert h.shape == [2, 8] and c2.shape == [2, 8]
+    g = nn.GRUCell(4, 8)
+    h3, _ = g(paddle.randn([2, 4]))
+    assert h3.shape == [2, 8]

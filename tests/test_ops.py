@@ -1,0 +1,232 @@
+"""Op unit tests, OpTest-style (reference: test/legacy_test/test_*_op.py)."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def _rand(*shape):
+    return np.random.rand(*shape).astype(np.float32) + 0.1
+
+
+UNARY_CASES = [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("tanh", np.tanh), ("sigmoid", sps.expit), ("abs", np.abs),
+    ("floor", np.floor), ("ceil", np.ceil), ("square", np.square),
+    ("rsqrt", lambda x: 1 / np.sqrt(x)), ("sin", np.sin), ("cos", np.cos),
+    ("erf", sps.erf), ("log1p", np.log1p), ("reciprocal", lambda x: 1 / x),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary(name, ref):
+    x = _rand(3, 4)
+    check_output(getattr(paddle, name), ref, x)
+    check_grad(getattr(paddle, name), x)
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power), ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary(name, ref):
+    x, y = _rand(3, 4), _rand(3, 4)
+    check_output(getattr(paddle, name), ref, x, y)
+    check_grad(getattr(paddle, name), x, y)
+
+
+def test_binary_broadcast():
+    x, y = _rand(3, 4), _rand(4)
+    check_output(paddle.add, np.add, x, y)
+    check_grad(paddle.multiply, x, y)
+
+
+def test_matmul():
+    a, b = _rand(3, 4), _rand(4, 5)
+    check_output(paddle.matmul, np.matmul, a, b)
+    check_grad(paddle.matmul, a, b, numeric=True)
+
+
+def test_matmul_transpose():
+    a, b = _rand(4, 3), _rand(4, 5)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                        transpose_x=True)
+    np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+
+def test_reductions():
+    x = _rand(3, 4, 5)
+    check_output(paddle.sum, lambda v: np.sum(v), x)
+    check_output(paddle.mean, lambda v: np.mean(v, axis=1), x,
+                 kwargs={"axis": 1})
+    check_output(paddle.max, lambda v: np.max(v, axis=(0, 2)), x,
+                 kwargs={"axis": [0, 2]})
+    check_output(paddle.prod, lambda v: np.prod(v, axis=-1), x,
+                 kwargs={"axis": -1})
+    check_grad(paddle.sum, x)
+    check_grad(lambda t: paddle.mean(t, axis=1, keepdim=True), x)
+
+
+def test_logsumexp_cumsum():
+    x = _rand(4, 6)
+    check_output(paddle.logsumexp, lambda v: sps.logsumexp(v, axis=1), x,
+                 kwargs={"axis": 1})
+    check_output(paddle.cumsum, lambda v: np.cumsum(v, axis=0), x,
+                 kwargs={"axis": 0})
+    check_output(paddle.logcumsumexp,
+                 lambda v: np.log(np.cumsum(np.exp(v), axis=0)), x,
+                 kwargs={"axis": 0}, atol=1e-4)
+
+
+def test_cummax_indices():
+    v, i = paddle.cummax(paddle.to_tensor([3.0, 1.0, 4.0, 4.0, 2.0]))
+    np.testing.assert_array_equal(v.numpy(), [3, 3, 4, 4, 4])
+    np.testing.assert_array_equal(i.numpy(), [0, 0, 2, 3, 3])
+
+
+def test_manipulation():
+    x = _rand(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [6, 4]),
+                 lambda v: v.reshape(6, 4), x)
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda v: v.transpose(2, 0, 1), x)
+    check_output(lambda t: paddle.squeeze(paddle.unsqueeze(t, 0), 0),
+                 lambda v: v, x)
+    check_output(lambda t: paddle.flip(t, axis=1),
+                 lambda v: v[:, ::-1], x)
+    check_output(lambda t: paddle.tile(t, [2, 1, 1]),
+                 lambda v: np.tile(v, (2, 1, 1)), x)
+    check_grad(lambda t: paddle.reshape(t, [-1]), x)
+
+
+def test_concat_split_stack():
+    a, b = _rand(2, 3), _rand(2, 3)
+    check_output(lambda x, y: paddle.concat([x, y], axis=0),
+                 lambda x, y: np.concatenate([x, y], 0), a, b)
+    check_output(lambda x, y: paddle.stack([x, y], axis=1),
+                 lambda x, y: np.stack([x, y], 1), a, b)
+    parts = paddle.split(paddle.to_tensor(_rand(6, 3)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 3]
+    with pytest.raises(ValueError):
+        paddle.split(paddle.to_tensor(_rand(5, 3)), 2, axis=0)
+    check_grad(lambda x, y: paddle.concat([x, y], axis=1), a, b)
+
+
+def test_gather_scatter():
+    x = _rand(5, 3)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                 lambda v: v[idx], x)
+    upd = _rand(2, 3)
+    out = paddle.scatter(paddle.to_tensor(x),
+                         paddle.to_tensor(np.array([1, 3])),
+                         paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[[1, 3]] = upd
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), x)
+
+
+def test_where_masked():
+    x, y = _rand(3, 4), _rand(3, 4)
+    cond = x > y
+    check_output(lambda a, b: paddle.where(cond, a, b),
+                 lambda a, b: np.where(x > y, a, b), x, y)
+    m = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(cond), -1.0)
+    np.testing.assert_allclose(m.numpy(), np.where(cond, -1.0, x), rtol=1e-6)
+
+
+def test_search_sort():
+    x = _rand(4, 6)
+    check_output(paddle.argsort, lambda v: np.argsort(v, axis=-1), x)
+    vals, idx = paddle.topk(paddle.to_tensor(x), 3)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    check_output(paddle.argmax, lambda v: np.argmax(v, axis=1), x,
+                 kwargs={"axis": 1})
+
+
+def test_linalg():
+    a = _rand(4, 4) + np.eye(4, dtype=np.float32) * 2
+    check_output(paddle.inverse, np.linalg.inv, a, atol=1e-4)
+    check_output(lambda t: paddle.norm(t, p=2), np.linalg.norm,
+                 _rand(5), atol=1e-5)
+    sym = a @ a.T
+    w = paddle.eigvalsh(paddle.to_tensor(sym))
+    np.testing.assert_allclose(np.sort(w.numpy()),
+                               np.sort(np.linalg.eigvalsh(sym)), rtol=1e-4)
+    check_output(paddle.det, np.linalg.det, a, rtol=1e-4)
+    u, s, vt = paddle.svd(paddle.to_tensor(a))
+    np.testing.assert_allclose(
+        (u.numpy() * s.numpy()) @ vt.numpy(), a, atol=1e-4)
+
+
+def test_einsum():
+    a, b = _rand(3, 4), _rand(4, 5)
+    check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                 lambda x, y: np.einsum("ij,jk->ik", x, y), a, b)
+    check_grad(lambda x, y: paddle.einsum("ij,jk->ik", x, y), a, b)
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype == np.dtype("int32")
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    e = paddle.eye(3)
+    np.testing.assert_array_equal(e.numpy(), np.eye(3, dtype=np.float32))
+    t = paddle.full_like(paddle.zeros([2, 2]), 7.0)
+    assert (t.numpy() == 7).all()
+    tri = paddle.tril(paddle.ones([3, 3]))
+    assert tri.numpy()[0, 2] == 0 and tri.numpy()[2, 0] == 1
+
+
+def test_random_shapes_and_determinism():
+    paddle.seed(42)
+    a = paddle.rand([3, 3]).numpy()
+    paddle.seed(42)
+    b = paddle.rand([3, 3]).numpy()
+    np.testing.assert_array_equal(a, b)
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = paddle.randperm(16).numpy()
+    assert sorted(p.tolist()) == list(range(16))
+
+
+def test_logic():
+    x, y = _rand(3, 3), _rand(3, 3)
+    assert paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x)).numpy()
+    assert not paddle.equal_all(paddle.to_tensor(x),
+                                paddle.to_tensor(y)).numpy()
+    out = paddle.logical_and(paddle.to_tensor(x > 0.5),
+                             paddle.to_tensor(y > 0.5))
+    np.testing.assert_array_equal(out.numpy(), (x > 0.5) & (y > 0.5))
+
+
+def test_clip_lerp():
+    x = _rand(4, 4)
+    check_output(lambda t: paddle.clip(t, 0.3, 0.7),
+                 lambda v: np.clip(v, 0.3, 0.7), x)
+    check_grad(lambda t: paddle.clip(t, 0.3, 0.7), x)
+    a, b = _rand(3), _rand(3)
+    check_output(lambda u, v: paddle.lerp(u, v, 0.3),
+                 lambda u, v: u + 0.3 * (v - u), a, b)
+
+
+def test_pad():
+    x = _rand(2, 3, 4, 5)
+    out = paddle.ops.manipulation.pad(paddle.to_tensor(x), [1, 2],
+                                      data_format="NCHW")
+    assert out.shape == [2, 3, 4, 8]
+    out2 = paddle.ops.manipulation.pad(paddle.to_tensor(x), [1, 1, 2, 2],
+                                       data_format="NCHW")
+    assert out2.shape == [2, 3, 8, 7]
