@@ -40,6 +40,8 @@ from . import jit  # noqa: E402
 from . import static  # noqa: E402
 from . import vision  # noqa: E402
 from . import hapi  # noqa: E402
+from . import distributed  # noqa: E402
+from .distributed.parallel import DataParallel  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402
 from .framework.io import save, load  # noqa: E402

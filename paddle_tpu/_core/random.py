@@ -109,3 +109,23 @@ def next_rng_key():
 
 def in_rng_scope() -> bool:
     return getattr(_state, "scope", None) is not None
+
+
+class use_generator:
+    """Temporarily route random draws to ``gen`` (the hook RNGStatesTracker
+    uses to give each model-parallel stream its own generator — reference:
+    python/paddle/distributed/fleet/layers/mpu/random.py:34)."""
+
+    def __init__(self, gen: Generator):
+        self._gen = gen
+
+    def __enter__(self):
+        global _default_generator
+        self._old = _default_generator
+        _default_generator = self._gen
+        return self._gen
+
+    def __exit__(self, *exc):
+        global _default_generator
+        _default_generator = self._old
+        return False

@@ -1,0 +1,36 @@
+"""paddle_tpu.distributed: the distributed surface.
+
+Mirrors the reference's ``paddle.distributed`` package
+(reference: python/paddle/distributed/__init__.py) re-designed for TPU:
+mesh axes instead of ProcessGroups, GSPMD + XLA collectives over ICI/DCN
+instead of NCCL, shard_map for manual-control schedules.
+"""
+from .mesh import (  # noqa: F401
+    init_parallel_env as _init_mesh, is_initialized, get_rank,
+    get_world_size, new_group, get_group, barrier, destroy_process_group,
+    Group, ReduceOp, ParallelEnv, get_mesh, set_mesh, get_world_group,
+)
+from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, reduce_scatter, all_to_all, alltoall_single,
+    broadcast, reduce, scatter, gather, send, recv, isend, irecv, P2POp,
+    batch_isend_irecv, ppermute, shift,
+)
+from .collective import all_to_all as alltoall  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Placement, Shard, Replicate, Partial, shard_tensor,
+    dtensor_from_local, dtensor_to_local, reshard, shard_layer,
+    shard_optimizer, unshard_dtensor, is_dist_tensor, get_placements,
+)
+from .auto_parallel.api import dtensor_from_local_list  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .utils import moe_utils  # noqa: F401
+from .fleet.fleet import fleet as _fleet_facade  # noqa: F401
+
+
+def get_mesh_dim_size(axis_name: str) -> int:
+    m = get_mesh()
+    return m.shape[axis_name] if m is not None and axis_name in m.shape \
+        else 1

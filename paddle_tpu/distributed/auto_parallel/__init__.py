@@ -1,0 +1,6 @@
+from .process_mesh import ProcessMesh  # noqa: F401
+from .placement import Placement, Shard, Replicate, Partial  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, dtensor_from_local, dtensor_to_local, reshard, shard_layer,
+    get_placements, is_dist_tensor, shard_optimizer, unshard_dtensor,
+)

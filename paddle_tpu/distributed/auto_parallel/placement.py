@@ -1,0 +1,81 @@
+"""Placements: per-mesh-dim distribution states.
+
+Re-design of the reference's placement types
+(reference: paddle/phi/core/distributed/auto_parallel/placement_types.h,
+python surface paddle.distributed.{Shard,Replicate,Partial}).
+
+- ``Shard(dim)``   — tensor dim ``dim`` split across this mesh axis
+- ``Replicate()``  — replicated across this mesh axis
+- ``Partial(op)``  — each shard holds a partial reduction; the global value
+                     is op-combined over the axis (pending a reshard).
+
+Shard/Replicate lower directly to ``jax.sharding.PartitionSpec`` entries.
+Partial has no NamedSharding representation in public JAX, so DistTensors
+with Partial placements carry the *unreduced* value stacked along a hidden
+leading axis (one slice per mesh coordinate) — exact semantics, resolved to
+a reduction by ``reshard`` (the reference's p→r / p→s reshard functions,
+paddle/phi/core/distributed/auto_parallel/reshard/).
+"""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"

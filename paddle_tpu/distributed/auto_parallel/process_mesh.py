@@ -1,0 +1,97 @@
+"""ProcessMesh: the logical device mesh of the semi-auto-parallel API.
+
+Re-design of the reference's ProcessMesh
+(reference: python/paddle/distributed/auto_parallel/process_mesh.py:85,
+C++ paddle/phi/core/distributed/auto_parallel/process_mesh.h). Maps 1:1 onto
+``jax.sharding.Mesh``: dim_names are mesh axis names, the process-id ndarray
+selects/orders devices. All sharding propagation then rides XLA GSPMD
+instead of the reference's 115 C++ SPMD rules.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        if shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(tuple(shape))
+        else:
+            arr = np.asarray(mesh)
+        self._ids = arr.astype(np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._ids.ndim)]
+        if len(dim_names) != self._ids.ndim:
+            raise ValueError("dim_names must match mesh ndim")
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._ids.ravel()]
+
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._ids
+
+    @property
+    def size(self) -> int:
+        return int(self._ids.size)
+
+    def get_dim_size(self, name) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        where = np.argwhere(self._ids == process_id)
+        if where.size == 0:
+            return -1
+        return int(where[0][self._dim_names.index(dim)])
+
+    # ---- jax bridge ----
+    def to_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = {d.id: d for d in jax.devices()}
+            try:
+                device_arr = np.vectorize(lambda i: devs[i])(self._ids)
+            except KeyError as e:
+                raise ValueError(
+                    f"process id {e} not among jax.devices() "
+                    f"({len(devs)} present)") from e
+            self._jax_mesh = Mesh(device_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_mesh_with_default(mesh: Optional[ProcessMesh]) -> ProcessMesh:
+    if mesh is not None:
+        return mesh
+    n = len(jax.devices())
+    return ProcessMesh(np.arange(n), ["world"])

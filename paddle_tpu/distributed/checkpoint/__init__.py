@@ -1,0 +1,1 @@
+from .api import save_state_dict, load_state_dict  # noqa: F401

@@ -1,0 +1,387 @@
+"""Functional collective API.
+
+Re-design of the reference's eager collectives
+(reference: python/paddle/distributed/communication/{all_reduce,all_gather,
+reduce_scatter,all_to_all,broadcast,scatter,reduce,send,recv,
+batch_isend_irecv}.py over ProcessGroupNCCL,
+paddle/fluid/distributed/collective/process_group_nccl.h:37).
+
+TPU-native semantics — two regimes, one API:
+
+1. **Mapped regime** (inside ``jax.shard_map`` with the group's mesh axes
+   bound): collectives are ``jax.lax`` primitives (psum/all_gather/
+   psum_scatter/all_to_all/ppermute) compiled by XLA onto ICI. This is the
+   regime every performance path uses (pipeline schedules, ring attention,
+   MoE dispatch) and the regime the collective unit tests exercise — the
+   analog of the reference's per-rank subprocess tests (SURVEY §4).
+
+2. **Eager regime** (single controller, global arrays): explicit
+   communication does not exist on TPU — GSPMD inserts collectives when
+   computing on sharded arrays, and ``auto_parallel.reshard`` performs
+   explicit redistribution. Eager calls here implement the degenerate
+   world-size-1 semantics for API parity and raise a descriptive error for
+   nranks>1 (pointing at shard_map / reshard), rather than silently doing
+   the wrong thing.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core.tensor import Tensor
+from . import mesh as _mesh
+from .mesh import Group, ReduceOp, get_world_group, in_mapped_context
+
+
+def _resolve_group(group: Optional[Group]) -> Group:
+    return group if group is not None else get_world_group()
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(val, like=None):
+    if isinstance(like, Tensor) or like is None:
+        return Tensor(val, _internal=True)
+    return val
+
+
+def _axis(group: Group):
+    names = group.axis_names
+    return names[0] if len(names) == 1 else names
+
+
+def _eager_error(opname: str, group: Group):
+    raise RuntimeError(
+        f"{opname}: eager collectives over a {group.nranks}-device group are "
+        "not a TPU-native operation — run inside jax.shard_map (mapped "
+        "regime) or use paddle_tpu.distributed.reshard / sharding "
+        "annotations and let GSPMD insert the collective.")
+
+
+def _preduce(x, op, axis):
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        y = lax.psum(x, axis)
+        if op == ReduceOp.AVG:
+            y = y / lax.psum(jnp.ones((), x.dtype), axis)
+        return y
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis)
+    if op == ReduceOp.PROD:
+        return jnp.prod(lax.all_gather(x, axis), axis=0)
+    raise ValueError(f"unsupported ReduceOp {op}")
+
+
+# ---- collectives -----------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """reference: communication/all_reduce.py (all_reduce)."""
+    g = _resolve_group(group)
+    x = _raw(tensor)
+    if in_mapped_context(g):
+        out = x
+        for a in g.axis_names:
+            out = _preduce(out, op, a)
+        return _wrap(out, tensor)
+    if g.nranks == 1:
+        return tensor
+    _eager_error("all_reduce", g)
+
+
+def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
+               sync_op: bool = True, axis: int = 0):
+    """reference: communication/all_gather.py — gathers and concatenates
+    along dim 0. Accepts either paddle's (tensor_list, tensor) calling
+    convention or the functional ``all_gather(tensor)`` form returning the
+    concatenated result.
+    """
+    if tensor is None:
+        t, out_list = tensor_or_list, None
+    else:
+        t, out_list = tensor, tensor_or_list
+    g = _resolve_group(group)
+    x = _raw(t)
+    if in_mapped_context(g):
+        out = x
+        for a in reversed(g.axis_names):
+            out = lax.all_gather(out, a, axis=axis, tiled=True)
+    elif g.nranks == 1:
+        out = x
+    else:
+        _eager_error("all_gather", g)
+    if out_list is not None:
+        n = g.nranks
+        for i, piece in enumerate(jnp.split(out, n, axis=axis)):
+            out_list.append(Tensor(piece, _internal=True))
+        return None
+    return _wrap(out, t)
+
+
+def reduce_scatter(output, input=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True,
+                   axis: int = 0):
+    """reference: communication/reduce_scatter.py — reduce then scatter
+    along dim 0. Functional form: ``y = reduce_scatter(x)``."""
+    if input is None:
+        x_in, out_t = _raw(output), None
+    else:
+        x_in, out_t = _raw(input), output
+    g = _resolve_group(group)
+    if in_mapped_context(g):
+        if op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise ValueError("reduce_scatter supports SUM/AVG")
+        out = x_in
+        for a in g.axis_names:
+            out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+        if op == ReduceOp.AVG:
+            out = out / g.nranks
+    elif g.nranks == 1:
+        out = x_in
+    else:
+        _eager_error("reduce_scatter", g)
+    if out_t is not None:
+        out_t._inplace_assign(out)
+        return None
+    return Tensor(out, _internal=True)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None,
+               group: Optional[Group] = None, sync_op: bool = True):
+    """reference: communication/all_to_all.py. Functional single-tensor
+    form: ``y = alltoall_single(x)`` below; this list form stacks/unstacks.
+    """
+    if in_tensor_list is None:
+        in_tensor_list, out_tensor_list = out_tensor_list, None
+    g = _resolve_group(group)
+    x = jnp.stack([_raw(t) for t in in_tensor_list], axis=0)
+    if in_mapped_context(g):
+        a = _axis(g)
+        out = lax.all_to_all(x, a, split_axis=0, concat_axis=0, tiled=False)
+    elif g.nranks == 1:
+        out = x
+    else:
+        _eager_error("all_to_all", g)
+    pieces = [Tensor(out[i], _internal=True) for i in range(out.shape[0])]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(pieces)
+        return None
+    return pieces
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group: Optional[Group] = None,
+                    sync_op: bool = True, axis: int = 0):
+    """reference: communication/all_to_all.py alltoall_single — equal-split
+    all-to-all along ``axis`` (static shapes: TPU requires equal splits).
+    """
+    g = _resolve_group(group)
+    x = _raw(in_tensor)
+    if in_mapped_context(g):
+        a = _axis(g)
+        out = lax.all_to_all(x, a, split_axis=axis, concat_axis=axis,
+                             tiled=True)
+    elif g.nranks == 1:
+        out = x
+    else:
+        _eager_error("alltoall_single", g)
+    if out_tensor is not None:
+        out_tensor._inplace_assign(out)
+        return None
+    return _wrap(out, in_tensor)
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    """reference: communication/broadcast.py — all ranks end with src's
+    value. Mapped impl: mask + psum (one ICI reduction)."""
+    g = _resolve_group(group)
+    x = _raw(tensor)
+    if in_mapped_context(g):
+        idx = g.rank
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        out = masked
+        for a in g.axis_names:
+            out = lax.psum(out, a)
+        if isinstance(tensor, Tensor):
+            tensor._inplace_assign(out)
+            return tensor
+        return out
+    if g.nranks == 1:
+        return tensor
+    _eager_error("broadcast", g)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    """reference: communication/reduce.py — dst rank gets the reduction,
+    other ranks keep their input (the reference leaves them undefined)."""
+    g = _resolve_group(group)
+    x = _raw(tensor)
+    if in_mapped_context(g):
+        red = x
+        for a in g.axis_names:
+            red = _preduce(red, op, a)
+        out = jnp.where(g.rank == dst, red, x)
+        if isinstance(tensor, Tensor):
+            tensor._inplace_assign(out)
+            return tensor
+        return out
+    if g.nranks == 1:
+        return tensor
+    _eager_error("reduce", g)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    """reference: communication/scatter.py — src's list is distributed; rank
+    i receives tensor_list[i]."""
+    g = _resolve_group(group)
+    if in_mapped_context(g):
+        a = _axis(g)
+        stacked = jnp.stack([_raw(t) for t in tensor_list], axis=0)
+        masked = jnp.where(g.rank == src, stacked, jnp.zeros_like(stacked))
+        full = lax.psum(masked, a)
+        out = full[g.rank]
+        if isinstance(tensor, Tensor):
+            tensor._inplace_assign(out)
+            return tensor
+        return out
+    if g.nranks == 1:
+        out = _raw(tensor_list[0])
+        if isinstance(tensor, Tensor):
+            tensor._inplace_assign(out)
+            return tensor
+        return Tensor(out, _internal=True)
+    _eager_error("scatter", g)
+
+
+def gather(tensor, gather_list=None, dst: int = 0,
+           group: Optional[Group] = None, sync_op: bool = True):
+    """reference: communication/gather.py."""
+    g = _resolve_group(group)
+    x = _raw(tensor)
+    if in_mapped_context(g):
+        a = _axis(g)
+        full = lax.all_gather(x, a, axis=0, tiled=False)
+        if gather_list is not None:
+            for i in range(g.nranks):
+                gather_list.append(Tensor(full[i], _internal=True))
+            return None
+        return Tensor(full, _internal=True)
+    if g.nranks == 1:
+        if gather_list is not None:
+            gather_list.append(tensor)
+            return None
+        return tensor
+    _eager_error("gather", g)
+
+
+# ---- point-to-point (ppermute-based) --------------------------------------
+
+def ppermute(tensor, perm: Sequence, group: Optional[Group] = None):
+    """TPU-native p2p primitive: pairwise send over ICI neighbours
+    (reference's send/recv pairs, p2p_communication.py:573 — subsumed by
+    lax.ppermute; perm is a list of (src, dst))."""
+    g = _resolve_group(group)
+    x = _raw(tensor)
+    if not in_mapped_context(g):
+        if g.nranks == 1:
+            return tensor
+        _eager_error("ppermute", g)
+    out = lax.ppermute(x, _axis(g), perm=list(perm))
+    return _wrap(out, tensor)
+
+
+def shift(tensor, offset: int = 1, group: Optional[Group] = None,
+          wrap: bool = True):
+    """Ring shift by ``offset`` (PP/ring-attention building block)."""
+    g = _resolve_group(group)
+    n = g.nranks
+    if wrap:
+        perm = [(i, (i + offset) % n) for i in range(n)]
+    else:
+        perm = [(i, i + offset) for i in range(n) if 0 <= i + offset < n]
+    return ppermute(tensor, perm, g)
+
+
+class P2POp:
+    """reference: communication/batch_isend_irecv.py P2POp.
+
+    SPMD divergence from the reference: the program is traced ONCE for all
+    ranks, so a rank-specific destination cannot appear in the op list.
+    ``peer`` is therefore a RING OFFSET from each rank (peer=+1 sends to
+    rank+1, the pipeline-next pattern), not an absolute rank id. This is
+    exactly the pattern the reference's pipeline scheduler uses
+    (p2p_communication.py send-next/recv-prev).
+    """
+
+    def __init__(self, op, tensor, peer: int, group: Optional[Group] = None):
+        self.op = op  # isend / irecv callables below
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst: int, group: Optional[Group] = None):
+    """``dst`` is a ring offset in the mapped regime (see P2POp)."""
+    return P2POp(isend, tensor, dst, group)
+
+
+def irecv(tensor, src: int, group: Optional[Group] = None):
+    """``src`` is a ring offset in the mapped regime (see P2POp)."""
+    return P2POp(irecv, tensor, src, group)
+
+
+send = isend
+recv = irecv
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    """reference: communication/batch_isend_irecv.py:90 — execute a batch of
+    p2p ops. TPU-native: each matched send/recv pair becomes ONE ppermute
+    (a single collective-permute over ICI). Sends and recvs must come in
+    matched pairs whose offsets are consistent (recv offset = -send offset,
+    i.e. data received from the rank the symmetric send targets).
+    """
+    sends = [p for p in p2p_op_list if p.op is isend]
+    recvs = [p for p in p2p_op_list if p.op is irecv]
+    if not sends and not recvs:
+        return []
+    if len(sends) != len(recvs):
+        raise ValueError(
+            f"batch_isend_irecv needs matched send/recv pairs, got "
+            f"{len(sends)} sends / {len(recvs)} recvs")
+    g = _resolve_group(sends[0].group if sends else recvs[0].group)
+    if not in_mapped_context(g):
+        if g.nranks == 1:
+            return []
+        _eager_error("batch_isend_irecv", g)
+    a = _axis(g)
+    n = g.nranks
+    results = []
+    for s, r in zip(sends, recvs):
+        if (r.peer + s.peer) % n != 0:
+            raise ValueError(
+                f"send offset {s.peer} and recv offset {r.peer} do not "
+                "describe the same ring rotation (need recv = -send mod "
+                "group size)")
+        perm = [(i, (i + s.peer) % n) for i in range(n)]
+        out = lax.ppermute(_raw(s.tensor), a, perm=perm)
+        if isinstance(r.tensor, Tensor):
+            r.tensor._inplace_assign(out)
+        results.append(Tensor(out, _internal=True))
+    return results
+
+
+def barrier(group: Optional[Group] = None):
+    g = _resolve_group(group)
+    if in_mapped_context(g):
+        return lax.psum(jnp.zeros(()), _axis(g))
+    return _mesh.barrier(g)

@@ -1,0 +1,17 @@
+"""paddle_tpu.distributed.fleet — hybrid-parallel orchestration.
+
+Mirrors ``paddle.distributed.fleet``
+(reference: python/paddle/distributed/fleet/__init__.py).
+"""
+from .fleet import (  # noqa: F401
+    init, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, get_strategy, worker_num, worker_index,
+    is_first_worker, barrier_worker,
+)
+from .base.strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)
+from . import meta_parallel  # noqa: F401
+from .recompute.recompute import recompute, recompute_sequential  # noqa: F401
+from .utils import sequence_parallel_utils  # noqa: F401

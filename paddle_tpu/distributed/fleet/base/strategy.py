@@ -1,0 +1,65 @@
+"""DistributedStrategy: training-strategy configuration.
+
+Re-design of the reference's protobuf-backed DistributedStrategy
+(reference: paddle/fluid/framework/distributed_strategy.proto,
+python/paddle/distributed/fleet/base/distributed_strategy.py:284). The
+reference serializes to protobuf for the static-graph compiler; here the
+strategy is a plain validated config consumed by fleet.init and the jit
+train-step builder.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+_HYBRID_DEFAULTS = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
+        # amp (reference: distributed_strategy.proto amp_configs)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0, "use_pure_fp16": False,
+            "use_bf16": True,
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {"stage": 1,
+                                                 "offload": False}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1, "schedule_mode": "1F1B",
+            "micro_batch_size": 1,
+        }
+        # gradient merge
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        self.find_unused_parameters = False
+        self.hybrid_parallel_order = list(_HYBRID_DEFAULTS["order"])
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and isinstance(v, dict) and \
+                "hybrid_configs" in self.__dict__:
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(v)
+            object.__setattr__(self, k, merged)
+            return
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        return (f"DistributedStrategy(hybrid={self.hybrid_configs}, "
+                f"amp={self.amp}, recompute={self.recompute}, "
+                f"sharding={self.sharding})")

@@ -1,0 +1,176 @@
+"""Hybrid-parallel topology over the global device mesh.
+
+Re-design of the reference's CommunicateTopology/HybridCommunicateGroup
+(reference: python/paddle/distributed/fleet/base/topology.py:70,189). The
+reference builds an N-D cartesian rank grid and creates an NCCL subgroup per
+axis. TPU-native: the grid IS a ``jax.sharding.Mesh`` whose named axes are
+the parallelism dimensions — "creating a subgroup" is just viewing one axis;
+XLA lowers any collective over that axis onto the right ICI ring.
+
+Default axis order follows the reference: [data, pipe, sharding, sep, model]
+(fleet.py:702-724 hybrid_parallel_order).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ... import mesh as _mesh
+from ...mesh import Group
+
+# canonical axis names (reference uses dp/pp/sharding/sep/mp internally)
+AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:70."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = AXES,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+        self._world = int(np.prod(self._dims))
+        self._grid = np.arange(self._world).reshape(self._dims)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._names)
+
+    def get_dim(self, name) -> int:
+        return self._dims[self._names.index(name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world
+
+    def get_rank(self, **kwargs) -> int:
+        idx = tuple(kwargs[n] for n in self._names)
+        return int(self._grid[idx])
+
+    def get_coord(self, rank: int):
+        return tuple(int(c) for c in
+                     np.argwhere(self._grid == rank)[0])
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        ax = self._names.index(axis_name)
+        sl = [slice(None)] * len(self._names)
+        sl[ax] = index
+        return [int(r) for r in self._grid[tuple(sl)].ravel()]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        ax = self._names.index(axis_name)
+        moved = np.moveaxis(self._grid, ax, -1)
+        return [[int(r) for r in row]
+                for row in moved.reshape(-1, self._dims[ax])]
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:189 — per-axis group accessors.
+
+    Holds the jax Mesh (axes ordered [dp, pp, sharding, sep, mp]) and hands
+    out axis-view Groups.
+    """
+
+    def __init__(self, topology: CommunicateTopology,
+                 mesh: Optional[Mesh] = None):
+        self._topo = topology
+        dims = [topology.get_dim(n) for n in topology.get_hybrid_group_names()]
+        if mesh is None:
+            devs = np.asarray(jax.devices()[:topology.world_size()],
+                              dtype=object).reshape(dims)
+            mesh = Mesh(devs, tuple(topology.get_hybrid_group_names()))
+        self._mesh = mesh
+        self._groups: Dict[str, Group] = {}
+        _mesh.set_mesh(mesh)
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    def _axis_group(self, name: str) -> Group:
+        if name not in self._groups:
+            self._groups[name] = _mesh.new_group(axis_name=name)
+        return self._groups[name]
+
+    # ---- degrees ----
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # ---- groups ----
+    def get_data_parallel_group(self) -> Group:
+        return self._axis_group("dp")
+
+    def get_model_parallel_group(self) -> Group:
+        return self._axis_group("mp")
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._axis_group("pp")
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._axis_group("sep")
+
+    def get_check_parallel_group(self) -> Group:
+        return _mesh.get_world_group()
+
+    # ---- ranks (0 on the single controller; axis_index when mapped) ----
+    def _axis_rank(self, name: str) -> int:
+        try:
+            return int(jax.lax.axis_index(name))
+        except Exception:
+            return 0
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("mp")
+
+    def get_stage_id(self):
+        return self._axis_rank("pp")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    def get_global_rank(self):
+        return _mesh.get_rank()
+
+    # pipeline helpers (reference: topology.py is_first_stage/is_last_stage)
+    @property
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    @property
+    def is_last_stage(self):
+        return self.get_stage_id() == self.get_pipe_parallel_world_size() - 1
+
+    def get_p2p_groups(self):
+        return self.get_pipe_parallel_group()
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(dp=0, pp=stage_id, sharding=0, sep=0,
+                                   mp=0)

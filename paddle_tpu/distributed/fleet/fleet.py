@@ -1,0 +1,142 @@
+"""Fleet: the hybrid-parallel orchestration entry.
+
+Re-design of the reference's fleet
+(reference: python/paddle/distributed/fleet/fleet.py:151 Fleet, init:218,
+_init_hybrid_parallel_env:674, model dispatch fleet/model.py:142-174,
+optimizer fleet/optimizer.py:24).
+
+TPU-native: ``fleet.init`` builds ONE global jax Mesh whose axes are the
+hybrid-parallel dimensions (default order [dp, pp, sharding, sep, mp] —
+the reference's hybrid_parallel_order) and installs it process-wide. All
+"subgroup creation" becomes axis views; parameter broadcast at init is
+unnecessary (single controller = single source of truth).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ..._core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .base.strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup, AXES
+from .. import mesh as _mesh
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+# reference alias: fleet.get_hybrid_communicate_group()
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """reference: fleet.py:218."""
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hc = strategy.hybrid_configs
+    order = list(hc.get("order") or strategy.hybrid_parallel_order or
+                 ["dp", "pp", "sharding", "sep", "mp"])
+    degrees = {
+        "dp": int(hc.get("dp_degree", 1)),
+        "mp": int(hc.get("mp_degree", 1)),
+        "pp": int(hc.get("pp_degree", 1)),
+        "sharding": int(hc.get("sharding_degree", 1)),
+        "sep": int(hc.get("sep_degree", 1)),
+    }
+    ndev = len(jax.devices())
+    prod = int(np.prod([max(d, 1) for d in degrees.values()]))
+    if prod > ndev:
+        raise ValueError(
+            f"hybrid degrees {degrees} need {prod} devices, "
+            f"only {ndev} present")
+    # fill dp to consume remaining devices (reference: dp_degree=-1 auto)
+    if degrees["dp"] <= 0 or (hc.get("dp_degree") in (None, -1)):
+        degrees["dp"] = ndev // (prod // max(degrees["dp"], 1))
+    dims = [degrees[a] for a in order]
+    topo = CommunicateTopology(order, dims)
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return fleet
+
+
+def distributed_model(model: Layer):
+    """reference: fleet/model.py:32 — dispatch on topology (model.py:142-174).
+    """
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init first")
+    from ..parallel import DataParallel
+    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.parallel_layers import PipelineLayer
+    from .meta_parallel.engines import (TensorParallel, ShardingParallel,
+                                        SegmentParallel)
+    strategy = _fleet_state["strategy"]
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError(
+                "pipeline parallel requires the model to be a PipelineLayer "
+                "(reference: meta_parallel/pipeline_parallel.py:255)")
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    if hcg.get_sep_parallel_world_size() > 1:
+        return SegmentParallel(model, hcg, strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet/optimizer.py:24 -> HybridParallelOptimizer
+    (meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:266)."""
+    from .meta_optimizers.hybrid_parallel_optimizer import (
+        HybridParallelOptimizer)
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _fleet_state["strategy"])
+
+
+def get_strategy():
+    return _fleet_state["strategy"]
+
+
+def worker_num() -> int:
+    return _mesh.get_world_size()
+
+
+def worker_index() -> int:
+    return _mesh.get_rank()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    _mesh.barrier()
+
+
+class _FleetModule:
+    """Callable-attribute facade matching ``paddle.distributed.fleet``."""
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    DistributedStrategy = DistributedStrategy
+    worker_num = staticmethod(worker_num)
+    worker_index = staticmethod(worker_index)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+
+
+fleet = _FleetModule()

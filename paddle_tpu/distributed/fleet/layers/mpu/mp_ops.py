@@ -1,0 +1,165 @@
+"""Model-parallel communication ops.
+
+Re-design of the reference's mp_ops
+(reference: python/paddle/distributed/fleet/layers/mpu/mp_ops.py —
+_c_identity:91, _c_concat:134, _c_split:196, _mp_allreduce:293, split:714).
+
+The reference implements these as eager NCCL calls with custom backward
+rules (identity fwd / allreduce bwd etc.). TPU-native, the same contracts
+are expressed as SHARDING transitions on global arrays — XLA GSPMD inserts
+the collective (or its transpose in the backward) over the ICI ring:
+
+  _c_identity   : fwd identity,     bwd all-reduce   ≙ replicate -> replicate
+                  (GSPMD derives the grad psum from the sharded consumer)
+  _mp_allreduce : fwd all-reduce,   bwd identity     ≙ partial   -> replicate
+  _c_split      : fwd local slice,  bwd all-gather   ≙ replicate -> Shard(-1)
+  _c_concat     : fwd all-gather,   bwd local slice  ≙ Shard(-1) -> replicate
+
+Inside ``shard_map`` (manual-control regime) the same functions fall back to
+explicit lax collectives with custom_vjp parity rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....._core.tensor import Tensor
+from ....._core import autograd as ag
+from .... import mesh as _mesh
+from ....mesh import Group, in_mapped_context
+
+
+def _mp_group(group) -> Group:
+    if group is not None:
+        return group
+    from ...fleet import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_group()
+    return _mesh.get_world_group()
+
+
+def _constraint(x, spec, mesh):
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+    except Exception:
+        return x
+
+
+def _apply(fn, *tensors, name):
+    return ag.apply(fn, *tensors, name=name)
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Identity fwd / all-reduce bwd (reference mp_ops.py:91)."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return tensor
+    if in_mapped_context(g):
+        axis = g.axis_names[0]
+
+        @jax.custom_vjp
+        def ident(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, ct):
+            return (lax.psum(ct, axis),)
+
+        ident.defvjp(fwd, bwd)
+        return _apply(ident, tensor, name="c_identity")
+    # GSPMD: consumers' sharded weights produce the grad reduction
+    return tensor
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """All-reduce fwd / identity bwd (reference mp_ops.py:293)."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return tensor
+    if in_mapped_context(g):
+        axis = g.axis_names[0]
+
+        @jax.custom_vjp
+        def ar(x):
+            return lax.psum(x, axis)
+
+        def fwd(x):
+            return lax.psum(x, axis), None
+
+        def bwd(_, ct):
+            return (ct,)
+
+        ar.defvjp(fwd, bwd)
+        return _apply(ar, tensor, name="mp_allreduce")
+    # GSPMD: the partial produced by a row-parallel matmul is reduced by
+    # XLA when we constrain the output to replicated over the mp axis.
+    return _apply(
+        lambda x: _constraint(x, P(), g.mesh), tensor, name="mp_allreduce")
+
+
+def _c_split(tensor, group=None, axis=-1):
+    """Take this rank's slice along ``axis`` (reference mp_ops.py:196)."""
+    g = _mp_group(group)
+    n = g.nranks
+    if n == 1:
+        return tensor
+    if in_mapped_context(g):
+        aname = g.axis_names[0]
+
+        def f(x):
+            idx = lax.axis_index(aname)
+            size = x.shape[axis] // n
+            return lax.dynamic_slice_in_dim(x, idx * size, size, axis)
+        return _apply(f, tensor, name="c_split")
+    # GSPMD: constrain to sharded along `axis` over the mp mesh axis —
+    # the array stays global; each device materializes only its shard.
+    nd = tensor.ndim
+    ax = axis % nd
+    spec = [None] * nd
+    spec[ax] = g.axis_names[0]
+    return _apply(lambda x: _constraint(x, P(*spec), g.mesh),
+                  tensor, name="c_split")
+
+
+def _c_concat(tensor, group=None, axis=-1):
+    """All-gather along ``axis`` (reference mp_ops.py:134)."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return tensor
+    if in_mapped_context(g):
+        aname = g.axis_names[0]
+        return _apply(lambda x: lax.all_gather(x, aname, axis=axis % x.ndim,
+                                               tiled=True),
+                      tensor, name="c_concat")
+    return _apply(lambda x: _constraint(x, P(), g.mesh),
+                  tensor, name="c_concat")
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False):
+    """Vocab-parallel softmax-CE (reference mp_ops.py
+    _c_softmax_with_cross_entropy). GSPMD computes the global softmax over
+    the vocab-sharded logits directly."""
+    from .....nn.functional.loss import cross_entropy
+    loss = cross_entropy(logits, label, reduction="none", soft_label=False)
+    if return_softmax:
+        from .....nn.functional.activation import softmax
+        return loss, softmax(logits, axis=-1)
+    return loss
+
+
+def split(x, size, num_partitions=1, operation="linear", axis=0, gather_out=True):
+    """reference: mp_ops.py:714 paddle.distributed.split — one-shot
+    parallel linear/embedding. Provided for API parity; prefer the
+    ColumnParallelLinear/RowParallelLinear layers."""
+    raise NotImplementedError(
+        "paddle_tpu: use fleet.meta_parallel ColumnParallelLinear/"
+        "RowParallelLinear/VocabParallelEmbedding instead of "
+        "distributed.split")

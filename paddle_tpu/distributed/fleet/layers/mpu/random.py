@@ -1,0 +1,82 @@
+"""Per-parallel-stream RNG state tracking.
+
+Re-design of the reference's RNGStatesTracker
+(reference: python/paddle/distributed/fleet/layers/mpu/random.py:34). The
+reference snapshots/restores CUDA generator state per named stream so that
+e.g. dropout inside TP layers is identical across TP ranks ("local_seed")
+while DP ranks differ ("global_seed"). Stateless-PRNG equivalent: each named
+stream owns a key-splitting Generator; the context manager routes draws to
+it. Under jit the train-step wrapper threads traced keys instead (see
+_core/random.py rng_scope) and folds in the mesh axis index for per-rank
+streams.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+from ....._core import random as _random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, _random.Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = _random.Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            if n in self.states_:
+                self.states_[n].set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        with _random.use_generator(self.states_[name]):
+            yield
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 2023):
+    """reference: mpu/random.py model_parallel_random_seed — derive
+    distinct local/global seeds per mp rank. Single-controller: mp-rank
+    folding happens inside traced programs; here we install the two named
+    streams the reference uses."""
+    from ...fleet import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    global_seed = seed
+    local_seed = seed + 1024 + mp_rank
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", global_seed)
+    tracker.add("local_seed", local_seed)
+    _random.seed(global_seed)
+
+
+def determinate_seed(name: str) -> int:
+    g = _RNG_STATE_TRACKER.states_.get(name)
+    return g.initial_seed() if g else 0

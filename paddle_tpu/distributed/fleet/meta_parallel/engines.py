@@ -1,0 +1,86 @@
+"""Parallel engine wrappers (non-pipeline).
+
+Re-design of the reference's meta_parallel engines
+(reference: python/paddle/distributed/fleet/meta_parallel/
+tensor_parallel.py:28, sharding_parallel.py:25, segment_parallel.py:26).
+
+The reference engines broadcast parameters/inputs across their groups at
+construction and install grad-sync hooks. Single-controller TPU: parameters
+have one source of truth and grad sync is compiled into the backward, so
+these wrappers carry the API surface (and the input/activation sharding
+policy for their axis) with no eager communication.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...._core.tensor import Tensor
+from ....nn.layer.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    """reference: meta_parallel/meta_parallel_base.py MetaParallelBase."""
+
+    def __init__(self, layers: Layer, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(MetaParallelBase):
+    """reference: meta_parallel/tensor_parallel.py:28 — broadcasts inputs
+    and syncs params across the mp group. Under GSPMD both are implicit in
+    the shardings installed by the mpu layers."""
+
+
+class ShardingParallel(MetaParallelBase):
+    """reference: meta_parallel/sharding_parallel.py:25."""
+
+
+class SegmentParallel(MetaParallelBase):
+    """reference: meta_parallel/segment_parallel.py:26 — sequence split
+    across the sep axis: inputs get their sequence dim sharded over 'sep'.
+    """
+
+    def forward(self, *inputs, **kwargs):
+        hcg = self._hcg
+        n = hcg.get_sep_parallel_world_size()
+        if n > 1:
+            mesh = hcg.mesh
+
+            def place(x):
+                if isinstance(x, Tensor) and x.ndim >= 2 and \
+                        x.shape[1] % n == 0:
+                    # [b, s, ...]: shard seq dim over sep
+                    spec = [None] * x.ndim
+                    spec[1] = "sep"
+                    try:
+                        return Tensor(jax.device_put(
+                            x._value, NamedSharding(mesh, P(*spec))),
+                            _internal=True)
+                    except Exception:
+                        return x
+                return x
+            inputs = tuple(place(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)

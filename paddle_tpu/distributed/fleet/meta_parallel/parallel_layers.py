@@ -1,0 +1,173 @@
+"""Pipeline model description: LayerDesc / SegmentLayers / PipelineLayer.
+
+Re-design of the reference's pp_layers
+(reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py — LayerDesc:57, SharedLayerDesc:77, SegmentLayers:93,
+PipelineLayer:258).
+
+The reference instantiates only the local stage's layers per rank. Under the
+single-controller model every layer exists once; PipelineLayer records the
+stage partition so the schedule (pipeline_parallel.py) and the SPMD
+stacked-stage path can address per-stage sublists.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...._core.tensor import Tensor
+from ....nn.layer.layers import Layer, LayerList
+
+
+class LayerDesc:
+    """reference: pp_layers.py:57."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("layer_cls must be a Layer subclass")
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py:77 — a layer shared between stages (e.g.
+    tied embedding/head). Single-controller: the first build within one
+    PipelineLayer is reused, so weight tying is object identity (no
+    grad-sync ties needed). The registry is scoped to the owning
+    PipelineLayer — two models with the same key do NOT alias."""
+
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+    def build_layer(self, registry=None) -> Layer:
+        if registry is None:
+            return super().build_layer()
+        if self.layer_name not in registry:
+            registry[self.layer_name] = super().build_layer()
+        return registry[self.layer_name]
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:93 — split N layers into num_parts stages,
+    uniformly or by a seg_method ("layer:<ClassName>" segments at class
+    boundaries; "uniform" by count)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self._layers)
+        if self.method == "uniform" or self.num_parts <= 1:
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self._layers)
+                     if self._name_of(d) == cls_name]
+            if len(marks) < self.num_parts:
+                raise ValueError(
+                    f"only {len(marks)} '{cls_name}' layers for "
+                    f"{self.num_parts} stages")
+            # distribute marked layers evenly; boundaries at marks
+            per = len(marks) // self.num_parts
+            extra = len(marks) % self.num_parts
+            bounds = [0]
+            idx = 0
+            for s in range(self.num_parts):
+                take = per + (1 if s < extra else 0)
+                idx += take
+                bounds.append(marks[idx - 1] + 1 if s < self.num_parts - 1
+                              else n)
+            bounds[1] = max(bounds[1], marks[0] + 1)
+            bounds[-1] = n
+            return bounds
+        raise ValueError(f"unknown seg method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(num_parts):
+            result[i + 1] = result[i] + size + (1 if i < extra else 0)
+        return result
+
+    @staticmethod
+    def _name_of(desc):
+        if isinstance(desc, LayerDesc):
+            return desc.layer_cls.__name__
+        return type(desc).__name__
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:258."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        if num_stages is None:
+            if topology is not None:
+                num_stages = topology.get_dim("pp")
+            else:
+                from ..fleet import get_hybrid_communicate_group
+                hcg = get_hybrid_communicate_group()
+                num_stages = (hcg.get_pipe_parallel_world_size()
+                              if hcg else 1)
+        self._num_stages = int(num_stages)
+        self._layers_desc = list(layers)
+        self._segment = SegmentLayers(self._layers_desc, self._num_stages,
+                                      seg_method).do_segment()
+        built = []
+        shared_registry = {}
+        for d in self._layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                built.append(d.build_layer(shared_registry))
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            else:
+                built.append(d)
+        self.run_function = LayerList([l for l in built
+                                       if isinstance(l, Layer)])
+        self._built = built  # may include plain callables
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_layers(self, stage: int):
+        lo, hi = self._segment[stage], self._segment[stage + 1]
+        return self._built[lo:hi]
+
+    def segment_bounds(self):
+        return list(self._segment)
+
+    def forward(self, x):
+        from ..recompute.recompute import recompute
+        for i, l in enumerate(self._built):
+            if self._recompute_interval and isinstance(l, Layer) and \
+                    i % self._recompute_interval == 0:
+                x = recompute(l, *(x if isinstance(x, tuple) else (x,)))
+            else:
+                x = l(*x) if isinstance(x, tuple) else l(x)
+        return x

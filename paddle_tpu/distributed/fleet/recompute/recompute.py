@@ -1,0 +1,112 @@
+"""Recompute (activation checkpointing).
+
+Re-design of the reference's recompute
+(reference: python/paddle/distributed/fleet/recompute/recompute.py —
+RecomputeFunction:124 (PyLayer saving inputs + RNG state, replaying forward
+in backward), recompute:455).
+
+TPU-native: ``jax.checkpoint`` (remat) IS this mechanism, applied at trace
+level — the compiled backward recomputes the block instead of storing
+activations, trading MXU FLOPs for HBM. RNG parity comes free: random draws
+inside the block bake their (eagerly drawn) keys into the trace, so the
+remat replay sees identical randomness — the reference's
+preserve_rng_state=True contract without state snapshots.
+
+Parameters of a wrapped Layer are passed explicitly into the rematted
+function so the tape differentiates through them (they would otherwise be
+closure constants).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from ...._core.tensor import Tensor
+from ...._core import autograd as ag
+from ....nn.layer.layers import Layer
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """reference: recompute.py:455."""
+    layer = None
+    if isinstance(function, Layer):
+        layer = function
+    elif hasattr(function, "__self__") and isinstance(function.__self__,
+                                                      Layer):
+        layer = function.__self__
+
+    named = dict(layer.named_parameters()) if layer is not None else {}
+    pnames = list(named)
+    ptensors = [named[k] for k in pnames]
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+    kw_keys = [k for k, v in kwargs.items() if isinstance(v, Tensor)]
+    kw_tensors = [kwargs[k] for k in kw_keys]
+
+    def raw_fn(*raws):
+        n_in = len(tensor_idx)
+        n_kw = len(kw_keys)
+        in_vals = raws[:n_in]
+        kw_vals = raws[n_in:n_in + n_kw]
+        p_vals = raws[n_in + n_kw:]
+        call_args = list(args)
+        for j, i in enumerate(tensor_idx):
+            t = Tensor(in_vals[j], _internal=True)
+            t.stop_gradient = args[i].stop_gradient
+            call_args[i] = t
+        call_kwargs = dict(kwargs)
+        for j, k in enumerate(kw_keys):
+            t = Tensor(kw_vals[j], _internal=True)
+            t.stop_gradient = kwargs[k].stop_gradient
+            call_kwargs[k] = t
+        params = {k: v for k, v in zip(pnames, p_vals)}
+
+        def run():
+            return function(*call_args, **call_kwargs)
+
+        if layer is not None:
+            out = layer.functional_call(params, forward_fn=run)
+        else:
+            out = run()
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(raw_fn)
+    return ag.apply(ckpt, *tensor_args, *kw_tensors, *ptensors,
+                    name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute.py recompute_sequential — chunked Sequential
+    recompute."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    chunk = max(len(layers) // max(segments, 1), 1)
+    out = args
+    i = 0
+    while i < len(layers):
+        seg = layers[i:i + chunk]
+
+        class _Seg(Layer):
+            def __init__(self, ls):
+                super().__init__()
+                from ....nn.layer.layers import LayerList
+                self.ls = LayerList(ls)
+
+            def forward(self, *xs):
+                y = xs
+                for l in self.ls:
+                    y = l(*y) if isinstance(y, tuple) else l(y)
+                return y
+
+        seg_layer = _Seg(seg)
+        res = recompute(seg_layer, *(out if isinstance(out, tuple) else
+                                     (out,)), **kwargs)
+        out = res
+        i += chunk
+    return out

@@ -1,0 +1,182 @@
+"""Sequence parallelism (Megatron-LM style, within the TP group).
+
+Re-design of the reference's sequence_parallel_utils
+(reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+— ScatterOp:85, GatherOp:97, AllGatherOp:111, ReduceScatterOp:127,
+register_sequence_parallel_allreduce_hooks:192,
+ColumnSequenceParallelLinear:429, RowSequenceParallelLinear:564).
+
+Layout convention follows the reference: activations are [s, b, h] and the
+sequence dim (0) is split across the mp group. TPU-native: the split IS a
+sharding of dim 0 over the ``mp`` mesh axis; the scatter/gather/
+reduce-scatter transitions around the TP linears are sharding transitions
+that GSPMD lowers to the same reduce_scatter/all_gather pairs the reference
+issues manually — fused with the matmuls where profitable.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...._core import autograd as ag
+from ....nn import functional as F
+from ....nn.layer.layers import Layer
+from ...mesh import Group, in_mapped_context
+from ..layers.mpu import mp_ops
+from ..layers.mpu.mp_layers import _mp_group, _shard_param
+
+
+def _seq_spec(ndim, axis_name):
+    spec = [None] * ndim
+    spec[0] = axis_name
+    return P(*spec)
+
+
+def ScatterOp(x, group=None):
+    """Split along seq dim 0; bwd = all-gather (reference :85)."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return x
+    if in_mapped_context(g):
+        a = g.axis_names[0]
+        n = g.nranks
+
+        def f(v):
+            idx = lax.axis_index(a)
+            size = v.shape[0] // n
+            return lax.dynamic_slice_in_dim(v, idx * size, size, 0)
+        return ag.apply(f, x, name="sp_scatter")
+    return ag.apply(lambda v: mp_ops._constraint(
+        v, _seq_spec(v.ndim, g.axis_names[0]), g.mesh), x, name="sp_scatter")
+
+
+def GatherOp(x, group=None):
+    """All-gather along seq dim 0; bwd = scatter (reference :97)."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return x
+    if in_mapped_context(g):
+        a = g.axis_names[0]
+        return ag.apply(lambda v: lax.all_gather(v, a, axis=0, tiled=True),
+                        x, name="sp_gather")
+    return ag.apply(lambda v: mp_ops._constraint(v, P(), g.mesh),
+                    x, name="sp_gather")
+
+
+def AllGatherOp(x, group=None):
+    """All-gather fwd / reduce-scatter bwd (reference :111) — the input
+    transition of a column-parallel linear under SP."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return x
+    if in_mapped_context(g):
+        a = g.axis_names[0]
+
+        @jax.custom_vjp
+        def agat(v):
+            return lax.all_gather(v, a, axis=0, tiled=True)
+
+        def fwd(v):
+            return agat(v), None
+
+        def bwd(_, ct):
+            return (lax.psum_scatter(ct, a, scatter_dimension=0, tiled=True),)
+
+        agat.defvjp(fwd, bwd)
+        return ag.apply(agat, x, name="sp_allgather")
+    return ag.apply(lambda v: mp_ops._constraint(v, P(), g.mesh),
+                    x, name="sp_allgather")
+
+
+def ReduceScatterOp(x, group=None):
+    """Reduce-scatter fwd / all-gather bwd (reference :127) — the output
+    transition of a row-parallel linear under SP."""
+    g = _mp_group(group)
+    if g.nranks == 1:
+        return x
+    if in_mapped_context(g):
+        a = g.axis_names[0]
+
+        @jax.custom_vjp
+        def rs(v):
+            return lax.psum_scatter(v, a, scatter_dimension=0, tiled=True)
+
+        def fwd(v):
+            return rs(v), None
+
+        def bwd(_, ct):
+            return (lax.all_gather(ct, a, axis=0, tiled=True),)
+
+        rs.defvjp(fwd, bwd)
+        return ag.apply(rs, x, name="sp_reduce_scatter")
+    return ag.apply(lambda v: mp_ops._constraint(
+        v, _seq_spec(v.ndim, g.axis_names[0]), g.mesh),
+        x, name="sp_reduce_scatter")
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """reference :169 — SP params (LayerNorm etc.) need grad allreduce over
+    the mp group. GSPMD handles replicated-param grad reduction; keep the
+    marker for parity/tests."""
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """reference :192 — no eager hook needed: grads of replicated params are
+    reduced by the compiled backward. No-op for parity."""
+    return
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference :429 — all-gather(seq) then column-parallel matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._group = _mp_group(mp_group)
+        n = self._group.nranks
+        if out_features % max(n, 1) != 0:
+            raise ValueError("out_features not divisible by mp degree")
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=weight_attr,
+                                          is_bias=True) if has_bias else None
+        if n > 1:
+            ax = self._group.axis_names[0]
+            _shard_param(self.weight, self._group.mesh, P(None, ax))
+            if self.bias is not None:
+                _shard_param(self.bias, self._group.mesh, P(ax))
+
+    def forward(self, x):
+        x = AllGatherOp(x, self._group)
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(Layer):
+    """reference :564 — row-parallel matmul then reduce-scatter(seq)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._group = _mp_group(mp_group)
+        n = self._group.nranks
+        if in_features % max(n, 1) != 0:
+            raise ValueError("in_features not divisible by mp degree")
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=weight_attr,
+                                          is_bias=True) if has_bias else None
+        if n > 1:
+            _shard_param(self.weight, self._group.mesh,
+                         P(self._group.axis_names[0], None))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = ReduceScatterOp(out, self._group)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

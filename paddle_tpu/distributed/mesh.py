@@ -1,0 +1,265 @@
+"""Global device-mesh management: the TPU-native ProcessGroup substrate.
+
+Re-design of the reference's communication bootstrap
+(reference: python/paddle/distributed/parallel.py:978 init_parallel_env,
+python/paddle/distributed/communication/collective.py:194 new_group,
+paddle/phi/core/distributed/comm_context_manager.h:43). The reference
+rendezvouses N processes over a TCPStore and builds NCCL communicators per
+group of ranks. On TPU under JAX's single-controller SPMD model, the
+equivalent structure is a ``jax.sharding.Mesh``: devices are the "ranks",
+named mesh axes are the "groups", and XLA lowers collectives over ICI/DCN —
+no eager communicator objects exist. A :class:`Group` here is therefore a
+(mesh, axis-names) view, not a socket-holding object.
+
+Multi-host: ``init_parallel_env`` calls ``jax.distributed.initialize`` when
+coordinator env vars are present (the analog of TCPStore rendezvous —
+PADDLE_MASTER/PADDLE_TRAINER_ID ≙ coordinator_address/process_id).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_lock = threading.Lock()
+_state = {
+    "initialized": False,
+    "mesh": None,           # the global Mesh
+    "groups": {},           # gid -> Group
+    "next_gid": 1,
+}
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv —
+    env-derived rank/world info. Under single-controller JAX, rank =
+    jax.process_index (host granularity); device_id = local device."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+
+class ReduceOp:
+    """reference: python/paddle/distributed/communication/reduce.py ReduceOp."""
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A collective group = a set of devices with a named mesh axis.
+
+    reference: python/paddle/distributed/communication/group.py:29 (Group).
+    Unlike the reference (which owns a ProcessGroupNCCL), this is a view on
+    the global mesh: ``axis_names`` identify which mesh axes the collective
+    reduces over when used inside ``shard_map``; ``ranks`` list the flat
+    device ids for parity with the reference API.
+    """
+
+    def __init__(self, gid: int, mesh: Mesh, axis_names: Tuple[str, ...],
+                 ranks: Optional[List[int]] = None):
+        self.id = gid
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        if ranks is None:
+            ranks = [d.id for d in np.ravel(mesh.devices)]
+        self.ranks = list(ranks)
+
+    @property
+    def nranks(self) -> int:
+        n = 1
+        for a in self.axis_names:
+            n *= self.mesh.shape[a]
+        return n
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        # Inside shard_map: position along the group axes; outside: 0 (the
+        # single controller).
+        try:
+            idx = 0
+            for a in self.axis_names:
+                idx = idx * self.mesh.shape[a] + jax.lax.axis_index(a)
+            return idx
+        except Exception:
+            return 0
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank) if global_rank in self.ranks \
+            else -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, axes={self.axis_names}, "
+                f"nranks={self.nranks})")
+
+
+def _default_mesh_devices(devices=None):
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return np.asarray(devs)
+
+
+def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
+                      axis_names: Optional[Sequence[str]] = None) -> Group:
+    """Bootstrap the global mesh (reference: parallel.py:978
+    init_parallel_env — TCPStore rendezvous + global ProcessGroup creation).
+
+    TPU-native: if JAX multi-host env vars are present, initialize the
+    coordination service; then build the global 1-D mesh over all devices
+    (axis ``"world"``) unless an explicit shape is given.
+    """
+    with _lock:
+        if not _state["initialized"]:
+            coord = os.environ.get("PADDLE_MASTER") or \
+                os.environ.get("JAX_COORDINATOR_ADDRESS")
+            nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+            if coord and nprocs > 1 and jax.process_count() == 1:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nprocs,
+                    process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+            _state["initialized"] = True
+        if mesh_shape is None:
+            devices = _default_mesh_devices()
+            mesh = Mesh(devices, ("world",))
+        else:
+            devices = _default_mesh_devices().reshape(tuple(mesh_shape))
+            mesh = Mesh(devices, tuple(axis_names or
+                                       [f"axis{i}" for i in
+                                        range(len(mesh_shape))]))
+        _state["mesh"] = mesh
+        g = Group(0, mesh, mesh.axis_names)
+        _state["groups"][0] = g
+        return g
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def set_mesh(mesh: Mesh) -> Group:
+    """Install ``mesh`` as the global mesh (auto_parallel entry)."""
+    with _lock:
+        _state["initialized"] = True
+        _state["mesh"] = mesh
+        g = Group(0, mesh, mesh.axis_names)
+        _state["groups"][0] = g
+        return g
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _state["mesh"]
+
+
+def get_world_group() -> Group:
+    if 0 not in _state["groups"]:
+        init_parallel_env()
+    return _state["groups"][0]
+
+
+def new_group(ranks: Optional[List[int]] = None, *,
+              axis_name: Optional[str] = None, backend=None,
+              timeout=None) -> Group:
+    """reference: communication/collective.py:194 new_group.
+
+    TPU-native: a group is identified by mesh axis names. ``axis_name`` picks
+    one or more axes of the global mesh; ``ranks`` is kept for API parity
+    (used only to derive nranks when no axis matches — e.g. tests that pass
+    explicit rank lists get a 1-axis view over those devices).
+    """
+    mesh = get_mesh()
+    if mesh is None:
+        init_parallel_env()
+        mesh = get_mesh()
+    with _lock:
+        gid = _state["next_gid"]
+        _state["next_gid"] += 1
+        if axis_name is not None:
+            names = (axis_name,) if isinstance(axis_name, str) \
+                else tuple(axis_name)
+            g = Group(gid, mesh, names)
+        else:
+            ranks = list(ranks) if ranks else [d.id for d in jax.devices()]
+            devs = np.asarray([d for d in np.ravel(np.asarray(
+                jax.devices(), dtype=object)) if d.id in set(ranks)])
+            sub = Mesh(devs, (f"group{gid}",))
+            g = Group(gid, sub, (f"group{gid}",), ranks)
+        _state["groups"][gid] = g
+        return g
+
+
+def get_group(gid: int) -> Optional[Group]:
+    return _state["groups"].get(gid)
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def in_mapped_context(group: Group) -> bool:
+    """True when called under shard_map/pmap with the group's axes bound —
+    the regime where lax collectives apply (vs eager global-array ops)."""
+    try:
+        for a in group.axis_names:
+            jax.lax.axis_index(a)
+        return True
+    except Exception:
+        return False
+
+
+def barrier(group: Optional[Group] = None):
+    """reference: communication/collective.py barrier — on the single
+    controller this is a device sync."""
+    jax.block_until_ready(jax.numpy.zeros(()))
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    with _lock:
+        if group is None:
+            _state["groups"].clear()
+            _state["mesh"] = None
+            _state["initialized"] = False
+        else:
+            _state["groups"].pop(group.id, None)

@@ -1,0 +1,93 @@
+"""DataParallel + environment entry points.
+
+Re-design of the reference's DP stack
+(reference: python/paddle/distributed/parallel.py:219 DataParallel backed by
+the C++ EagerReducer, paddle/fluid/distributed/collective/reducer.h:88 —
+gradient bucketing + async allreduce on backward hooks).
+
+TPU-native: under the single-controller SPMD model DP is *batch-axis
+sharding* — inputs carry a sharding over the data axis, parameters are
+replicated, and XLA emits ONE fused gradient all-reduce over ICI during the
+backward of the jit-compiled train step. The EagerReducer's bucketing/overlap
+machinery is subsumed by the XLA scheduler, so this wrapper's job is API
+parity (scale_loss / no_sync / state passthrough) plus installing the data
+sharding on inputs when a mesh is active.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .._core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import mesh as _mesh
+
+
+class DataParallel(Layer):
+    """reference: python/paddle/distributed/parallel.py:219."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    @property
+    def _data_sharding(self) -> Optional[NamedSharding]:
+        g = self._group
+        mesh = (g.mesh if g is not None else _mesh.get_mesh())
+        if mesh is None:
+            return None
+        axis = (g.axis_names[0] if g is not None else mesh.axis_names[0])
+        return NamedSharding(mesh, PartitionSpec(axis))
+
+    def forward(self, *inputs, **kwargs):
+        sharding = self._data_sharding
+        if sharding is not None and sharding.mesh.size > 1:
+            def place(x):
+                if isinstance(x, Tensor) and x.ndim >= 1 and \
+                        x.shape[0] % sharding.mesh.size == 0:
+                    try:
+                        return Tensor(jax.device_put(x._value, sharding),
+                                      _internal=True)
+                    except Exception:
+                        return x
+                return x
+            inputs = tuple(place(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # gradient averaging is part of the compiled psum(mean) — identity
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        # GSPMD has no eager grad sync to suppress; accumulate-then-step
+        # naturally defers the all-reduce to the step that runs it.
+        yield
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def init_parallel_env(mesh_shape=None, axis_names=None):
+    """reference: parallel.py:978 — see mesh.init_parallel_env."""
+    return _mesh.init_parallel_env(mesh_shape=mesh_shape,
+                                   axis_names=axis_names)
+
+
+ParallelEnv = _mesh.ParallelEnv

@@ -1,0 +1,3 @@
+from .group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)

@@ -1,0 +1,89 @@
+"""MoE expert-parallel dispatch utilities.
+
+Re-design of the reference's moe_utils
+(reference: python/paddle/distributed/utils/moe_utils.py — global_scatter:20,
+global_gather:153; MoE layer python/paddle/incubate/distributed/models/moe/
+moe_layer.py:263 MoEScatter:99/MoEGather:149).
+
+The reference routes variable-count token batches between ranks via NCCL
+alltoall with per-rank counts. TPU/XLA requires STATIC shapes, so dispatch
+is capacity-based (the standard GShard/Switch formulation the reference's
+gates also implement): every expert receives a fixed-capacity [E, C, d]
+buffer; overflow tokens drop, underflow pads — then ONE static all_to_all
+moves expert rows to their owning devices over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..mesh import Group, in_mapped_context
+
+
+def dispatch_capacity(num_tokens: int, num_experts: int,
+                      capacity_factor: float = 1.25,
+                      min_capacity: int = 4) -> int:
+    cap = int(num_tokens * capacity_factor / num_experts)
+    cap = max(cap, min_capacity)
+    return cap
+
+
+def expert_dispatch(x, gate_idx, gate_weight, num_experts: int,
+                    capacity: int):
+    """Scatter tokens into per-expert capacity buffers.
+
+    x:           [T, d] tokens
+    gate_idx:    [T, k] chosen expert per token (top-k)
+    gate_weight: [T, k] combine weights
+    returns (buffers [E, C, d], combine_info) where combine_info re-gathers
+    expert outputs back to token order with weights (dropped tokens get 0).
+    """
+    T, d = x.shape
+    k = gate_idx.shape[1]
+    flat_e = gate_idx.reshape(-1)                       # [T*k]
+    flat_w = gate_weight.reshape(-1)
+    flat_tok = jnp.repeat(jnp.arange(T), k)
+    # position of each (token, expert) pair within its expert's buffer
+    onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)  # [T*k, E]
+    pos_in_e = (jnp.cumsum(onehot, axis=0) - 1) * onehot           # [T*k, E]
+    pos = jnp.sum(pos_in_e, axis=1)                                # [T*k]
+    keep = pos < capacity
+    flat_w = jnp.where(keep, flat_w, 0.0)
+    slot = jnp.where(keep, flat_e * capacity + pos, num_experts * capacity)
+    buffers = jnp.zeros((num_experts * capacity + 1, d), x.dtype)
+    buffers = buffers.at[slot].add(x[flat_tok])
+    buffers = buffers[:-1].reshape(num_experts, capacity, d)
+    combine = (flat_tok, slot, flat_w, T)
+    return buffers, combine
+
+
+def expert_combine(expert_out, combine):
+    """Gather expert outputs back to [T, d] with combine weights."""
+    flat_tok, slot, flat_w, T = combine
+    E, C, d = expert_out.shape
+    flat = jnp.concatenate(
+        [expert_out.reshape(E * C, d), jnp.zeros((1, d), expert_out.dtype)])
+    picked = flat[slot] * flat_w[:, None].astype(expert_out.dtype)
+    out = jnp.zeros((T, d), expert_out.dtype).at[flat_tok].add(picked)
+    return out
+
+
+def global_scatter(x, local_count=None, global_count=None,
+                   group: Optional[Group] = None):
+    """reference: moe_utils.py:20 — move per-expert buffers to expert-owning
+    devices. Static-shape equivalent: all_to_all on the leading (expert)
+    axis inside the mapped regime; identity when ep degree is 1."""
+    if group is None or group.nranks == 1 or not in_mapped_context(group):
+        return x
+    return lax.all_to_all(x, group.axis_names[0], split_axis=0,
+                          concat_axis=0, tiled=True)
+
+
+def global_gather(x, local_count=None, global_count=None,
+                  group: Optional[Group] = None):
+    """reference: moe_utils.py:153 — inverse of global_scatter (alltoall is
+    self-inverse for equal splits)."""
+    return global_scatter(x, local_count, global_count, group)
