@@ -1,0 +1,14 @@
+"""Flagship model families (TPU-native).
+
+The reference ships its LLM stack as imperative Layer graphs driven by fleet
+hybrid parallel (reference: python/paddle/incubate/, fleet meta_parallel).
+Here the flagship path is functional-first: parameters are a pytree of
+jax arrays with named-axis sharding rules, the decoder stack is a
+``lax.scan`` over stacked layer weights (one compile for N layers), and
+parallelism (dp / ZeRO-fsdp / tp / Megatron-sp) is expressed as GSPMD
+sharding annotations on a ``jax.sharding.Mesh`` instead of ProcessGroup
+calls.
+"""
+from . import llama  # noqa: F401
+from .llama import LlamaConfig  # noqa: F401
+from .train import TrainState, make_train_step, init_train_state  # noqa: F401

@@ -1,0 +1,317 @@
+"""Llama-family causal LM, TPU-first.
+
+Capability target: the reference trains Llama-style models through fleet
+hybrid parallel (reference: python/paddle/distributed/fleet/meta_parallel/,
+mpu/mp_layers.py VocabParallelEmbedding:49 / ColumnParallelLinear:336 /
+RowParallelLinear:543; fused kernels paddle/phi/kernels/fusion/
+fused_rope_kernel.cu, fused_layernorm, flash_attn_kernel.cu).
+
+TPU-native design (NOT a translation):
+- Parameters are a flat pytree of jnp arrays; decoder layers are *stacked*
+  along a leading axis and executed with ``lax.scan`` so XLA compiles one
+  layer body regardless of depth.
+- Parallelism is declared, not programmed: every leaf has a
+  ``PartitionSpec`` over mesh axes ("dp", "fsdp", "tp"). Megatron TP =
+  sharding the head/ffn axes by "tp"; ZeRO-3 = sharding the other weight
+  axis by "fsdp"; Megatron sequence-parallel = sharding the residual
+  stream's seq axis by "tp" between blocks. XLA GSPMD inserts the
+  all-gathers / reduce-scatters that the reference's mp_ops.py
+  (_c_identity:91, _mp_allreduce:293) and sequence_parallel_utils.py issue
+  by hand.
+- RoPE + RMSNorm + SwiGLU computed in bf16 with fp32 accumulation; flash
+  attention uses the Pallas kernel on TPU (ops/pallas/flash_attention.py)
+  and a fused-softmax jnp path elsewhere.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas import flash_attention as _fa
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    remat: bool = True
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    # ---- presets (sizes follow the public Llama-2 family) ----
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_layers=40, num_heads=40, num_kv_heads=40, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Small config for tests / dryruns."""
+        d = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+                 dtype=jnp.float32, remat=False)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    def num_params(self) -> int:
+        h, i, v, L = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_layers)
+        hd, nh, nkv = self.hd, self.num_heads, self.num_kv_heads
+        per_layer = (h * nh * hd + 2 * h * nkv * hd + nh * hd * h  # attn
+                     + 3 * h * i                                   # swiglu mlp
+                     + 2 * h)                                      # 2 rmsnorm
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return L * per_layer + emb + h
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6*N_matmul + attention term).
+
+        The input-embedding table is a gather, not a matmul, so it is
+        excluded from N (the lm_head matmul is real compute and stays).
+        """
+        n = self.num_params() - self.vocab_size * self.hidden_size * (
+            0 if self.tie_embeddings else 1)
+        attn = 12 * self.num_layers * self.num_heads * self.hd * seq_len
+        return 6.0 * n + attn
+
+
+# ---------------- init ----------------
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    h, i, v, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_layers)
+    hd, nh, nkv = cfg.hd, cfg.num_heads, cfg.num_kv_heads
+    k = jax.random.split(key, 8)
+    std = 0.02
+
+    def norm(kk, shape, fan_in=None):
+        s = std if fan_in is None else 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(kk, shape, jnp.float32) * s).astype(cfg.dtype)
+
+    params = {
+        "embed": norm(k[0], (v, h)),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": {
+            "wq": norm(k[1], (L, h, nh * hd), fan_in=h),
+            "wk": norm(k[2], (L, h, nkv * hd), fan_in=h),
+            "wv": norm(k[3], (L, h, nkv * hd), fan_in=h),
+            "wo": norm(k[4], (L, nh * hd, h), fan_in=nh * hd),
+            "wg": norm(k[5], (L, h, i), fan_in=h),
+            "wu": norm(k[6], (L, h, i), fan_in=h),
+            "wd": norm(k[7], (L, i, h), fan_in=i),
+            "attn_norm": jnp.ones((L, h), cfg.dtype),
+            "mlp_norm": jnp.ones((L, h), cfg.dtype),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(jax.random.fold_in(key, 99), (h, v), fan_in=h)
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpecs per leaf over mesh axes ("dp","fsdp","tp").
+
+    TP shards the head/ffn dimension; fsdp (ZeRO-3) shards the opposite
+    dimension; norms/embeddings replicate over tp and shard vocab/hidden
+    over fsdp. (reference semantics: mp_layers.py Column/RowParallelLinear
+    + sharding stage-3 group_sharded_stage3.py — here a pure declaration.)
+    """
+    return {
+        "embed": P("fsdp", "tp"),
+        "final_norm": P(None),
+        "layers": {
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "wg": P(None, "fsdp", "tp"),
+            "wu": P(None, "fsdp", "tp"),
+            "wd": P(None, "tp", "fsdp"),
+            "attn_norm": P(None, None),
+            "mlp_norm": P(None, None),
+        },
+        **({} if cfg.tie_embeddings else {"lm_head": P("fsdp", "tp")}),
+    }
+
+
+# ---------------- building blocks ----------------
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_tables(seq_len: int, hd: int, theta: float,
+                dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                      # (S, hd/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, H, hd); rotate-half formulation (reference:
+    paddle/phi/kernels/fusion/fused_rope_kernel.cu — here left to XLA
+    fusion, which folds it into the surrounding elementwise graph)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, causal=True):
+    """(B,S,H,hd) attention; Pallas flash on TPU, fused jnp elsewhere."""
+    if _fa.available() and q.shape[1] % 128 == 0 and q.shape[-1] >= 64:
+        return _fa.flash_attention(q, k, v, causal=causal)
+    b, sq, h, hd = q.shape
+    hk = k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sq), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
+    """One decoder layer. lp = per-layer params (no leading L axis)."""
+    B, S, H = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    from jax.sharding import NamedSharding
+
+    def sp(t):  # Megatron-SP: residual stream seq-sharded over tp
+        if mesh_axes is None:
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh_axes["mesh"],
+                             P(mesh_axes["data"], mesh_axes["tp"], None)))
+
+    def tpact(t):  # inside-block activations: heads/ffn sharded over tp
+        if mesh_axes is None:
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh_axes["mesh"],
+                             P(mesh_axes["data"], None, mesh_axes["tp"])))
+
+    h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = tpact(h1 @ lp["wq"]).reshape(B, S, nh, hd)
+    k = tpact(h1 @ lp["wk"]).reshape(B, S, nkv, hd)
+    v = tpact(h1 @ lp["wv"]).reshape(B, S, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    o = _attention(q, k, v, causal=True).reshape(B, S, nh * hd)
+    x = sp(x + o @ lp["wo"])
+
+    h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    g = tpact(h2 @ lp["wg"])
+    u = tpact(h2 @ lp["wu"])
+    ff = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u) @ lp["wd"]
+    return sp(x + ff)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            mesh_axes: Optional[Dict[str, Any]] = None,
+            return_hidden: bool = False) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, V) float32 (or final-norm
+    hidden states (B, S, H) when ``return_hidden``).
+
+    ``mesh_axes``: {"mesh", "data": axis-or-tuple for batch, "tp": axis} to
+    enable activation sharding constraints; None for single-device.
+    """
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    cos, sin = rope_tables(S, cfg.hd, cfg.rope_theta)
+
+    def block(carry, lp):
+        return _block(carry, lp, cos, sin, cfg, mesh_axes)
+
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if return_hidden:
+        return x
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+
+
+def _ce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-position cross-entropy, fp32 logits."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - ll
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig, mesh_axes=None,
+            seq_chunk: Optional[int] = None) -> jax.Array:
+    """Next-token cross-entropy (mean over B*(S-1)).
+
+    Forward runs on the FULL sequence (keeping seq a multiple of the flash
+    block size); the last position is masked out of the loss rather than
+    sliced off. ``seq_chunk``: compute the (B, chunk, V) fp32 logits in a
+    scan over position chunks so the full logits tensor is never
+    materialized — the HBM win that lets batch size scale (the reference
+    pays the full fp32 logits; this is a TPU-first deviation).
+    """
+    h = forward(params, tokens, cfg, mesh_axes, return_hidden=True)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = head.astype(h.dtype)
+    B, S, H = h.shape
+    labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+        axis=1)
+    denom = jnp.float32(B * (S - 1))
+    if seq_chunk is not None and S % seq_chunk != 0:
+        raise ValueError(
+            f"seq_chunk={seq_chunk} must divide seq_len={S}; a silent dense "
+            f"fallback would re-materialize the full fp32 logits")
+    if seq_chunk is None:
+        ce = _ce((h @ head).astype(jnp.float32), labels)
+        return jnp.sum(ce * mask) / denom
+
+    nc = S // seq_chunk
+    hc = jnp.moveaxis(h.reshape(B, nc, seq_chunk, H), 1, 0)
+    lc = jnp.moveaxis(labels.reshape(B, nc, seq_chunk), 1, 0)
+    mc = jnp.moveaxis(mask.reshape(B, nc, seq_chunk), 1, 0)
+
+    def body(acc, xs):
+        hh, ll, mm = xs
+        ce = _ce((hh @ head).astype(jnp.float32), ll)
+        return acc + jnp.sum(ce * mm), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (hc, lc, mc))
+    return total / denom

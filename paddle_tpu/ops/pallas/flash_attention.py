@@ -44,7 +44,9 @@ def available() -> bool:
     if _INTERPRET:
         return True
     try:
-        return jax.default_backend() == "tpu"
+        # platform (not backend name): the axon PJRT tunnel registers a
+        # backend named "axon" whose devices are TPU chips
+        return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
 
