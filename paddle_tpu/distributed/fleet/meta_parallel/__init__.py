@@ -17,3 +17,6 @@ from .engines import (  # noqa: F401
 from .pp_spmd import (  # noqa: F401
     pipeline_spmd, pipeline_loss_spmd, stack_stage_params,
 )
+from .context_parallel import (  # noqa: F401
+    ring_attention, ulysses_attention,
+)

@@ -1,0 +1,188 @@
+"""Context parallelism: ring attention + Ulysses (DeepSpeed-style) alltoall.
+
+The reference snapshot has NO ring attention / Ulysses (SURVEY §2.3 CP row:
+"Not present"); its long-context story is SEP + Megatron-SP +
+FlashAttention. CP is nonetheless first-class here (SURVEY §7 hard part 8):
+long sequences shard along a "cp"/"sep" mesh axis and attention runs as a
+ring of `ppermute` steps over ICI, overlapping compute with neighbor
+transfers, or as Ulysses head↔seq `all_to_all` swaps.
+
+Both functions are *collective* ops: they must be called inside
+``shard_map`` (or an equivalent SPMD region) with the sequence dimension
+sharded over ``axis_name``. Layout: (B, S_local, H, D).
+
+Numerics: blockwise online softmax in fp32 with a custom VJP whose backward
+re-runs the ring (kv + traveling dk/dv buffers), so peak memory stays
+O(S_local) — the point of ring attention (Liu et al. 2023).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _chunk_scores(q, k, scale, causal, qi, kj, s_loc):
+    """q (B,H,S,D) x k (B,H,S,D) -> masked fp32 scores (B,H,S,S).
+
+    qi/kj: ring positions of the q and kv chunks along the cp axis (traced
+    ints); global token index = chunk_pos * s_loc + local index.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * s_loc + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        kpos = kj * s_loc + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(qpos >= kpos, s, _NEG)
+    return s
+
+
+def _ring_fwd_scan(q, k, v, axis_name, causal, scale):
+    """Returns (out fp32 (B,H,S,D), lse (B,H,S))."""
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]  # kv travels to next rank
+
+    def body(carry, step):
+        acc, m, l, kc, vc = carry
+        src = (me - step) % n          # ring position of current kv chunk
+        s = _chunk_scores(q, kc, scale, causal, me, src, S)
+        mj = jnp.max(s, axis=-1)                     # (B,H,S)
+        m_new = jnp.maximum(m, mj)
+        # fully-masked rows keep m=_NEG; guard exp of (-inf - -inf)
+        safe_m = jnp.where(m_new <= _NEG, 0.0, m_new)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        alpha = jnp.where(m <= _NEG, 0.0, jnp.exp(m - safe_m))
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (acc_new, m_new, l_new, kc, vc), None
+
+    init = (jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.full((B, H, S), _NEG, jnp.float32),
+            jnp.zeros((B, H, S), jnp.float32), k, v)
+    (acc, m, l, _, _), _ = lax.scan(body, init, jnp.arange(n))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe[..., None]
+    lse = jnp.where(l == 0.0, _NEG, m + jnp.log(l_safe))
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attn_bhsd(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal, scale)
+    return out.astype(q.dtype)
+
+
+def _ring_attn_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, scale)
+    out = out.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_attn_bwd(axis_name, causal, scale, res, do):
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # (B,H,S)
+
+    def body(carry, step):
+        dq, kc, vc, dkc, dvc = carry
+        src = (me - step) % n
+        s = _chunk_scores(q, kc, scale, causal, me, src, S)
+        safe_lse = jnp.where(lse <= _NEG, 0.0, lse)
+        p = jnp.exp(s - safe_lse[..., None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        dvc = dvc + jnp.einsum("bhqk,bhqd->bhkd", p, do32,
+                               preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vc.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kc.astype(jnp.float32),
+                             preferred_element_type=jnp.float32)
+        dkc = dkc + jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32),
+                               preferred_element_type=jnp.float32)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        dkc = lax.ppermute(dkc, axis_name, perm)
+        dvc = lax.ppermute(dvc, axis_name, perm)
+        return (dq, kc, vc, dkc, dvc), None
+
+    init = (jnp.zeros((B, H, S, D), jnp.float32), k, v,
+            jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.zeros((B, H, S, D), jnp.float32))
+    (dq, _, _, dk, dv), _ = lax.scan(body, init, jnp.arange(n))
+    # after n ppermute hops the traveling dk/dv buffers are home again
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attn_bhsd.defvjp(_ring_attn_fwd, _ring_attn_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Ring attention over sequence-sharded q/k/v (B, S_local, H, D).
+
+    Call inside ``shard_map`` with seq sharded over ``axis_name``. GQA: kv
+    heads are repeated to match q heads.
+    """
+    b, s, h, d = q.shape
+    hk = k.shape[2]
+    if hk != h:
+        assert h % hk == 0
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _ring_attn_bhsd(qt, kt, vt, axis_name, causal, sc)
+    return out.transpose(0, 2, 1, 3)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None, attn_fn=None):
+    """Ulysses/DeepSpeed sequence parallelism: all_to_all swaps the sharded
+    dim from seq to heads, runs FULL-sequence attention locally (any
+    attn_fn, e.g. the Pallas flash kernel), and swaps back.
+
+    Requires num_heads % cp == 0. q/k/v: (B, S_local, H, D) inside
+    shard_map.
+    """
+    n = lax.psum(1, axis_name)
+    b, s, h, d = q.shape
+    hk = k.shape[2]
+    if hk != h:
+        assert h % hk == 0
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+
+    def seq2head(t):  # (B, S/n, H, D) -> (B, S, H/n, D)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(t):  # (B, S, H/n, D) -> (B, S/n, H, D)
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    if attn_fn is None:
+        from ....models.llama import _attention
+        og = _attention(qg, kg, vg, causal=causal)
+    else:
+        og = attn_fn(qg, kg, vg, causal=causal)
+    return head2seq(og)

@@ -207,19 +207,24 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
 
     from jax.sharding import NamedSharding
 
-    def sp(t):  # Megatron-SP: residual stream seq-sharded over tp
+    cp = mesh_axes.get("cp") if mesh_axes else None
+    # seq-dim sharding of the residual stream: the cp axis when context
+    # parallel is on, else the tp axis (Megatron-SP)
+    seq_axis = cp if cp else (mesh_axes["tp"] if mesh_axes else None)
+
+    def sp(t):
         if mesh_axes is None:
             return t
         return jax.lax.with_sharding_constraint(
             t, NamedSharding(mesh_axes["mesh"],
-                             P(mesh_axes["data"], mesh_axes["tp"], None)))
+                             P(mesh_axes["data"], seq_axis, None)))
 
     def tpact(t):  # inside-block activations: heads/ffn sharded over tp
         if mesh_axes is None:
             return t
         return jax.lax.with_sharding_constraint(
             t, NamedSharding(mesh_axes["mesh"],
-                             P(mesh_axes["data"], None, mesh_axes["tp"])))
+                             P(mesh_axes["data"], cp, mesh_axes["tp"])))
 
     h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = tpact(h1 @ lp["wq"]).reshape(B, S, nh, hd)
@@ -227,7 +232,18 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
     v = tpact(h1 @ lp["wv"]).reshape(B, S, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    o = _attention(q, k, v, causal=True).reshape(B, S, nh * hd)
+    if cp:
+        from jax import shard_map
+        from ..distributed.fleet.meta_parallel.context_parallel import (
+            ring_attention)
+        spec = P(mesh_axes["data"], cp, mesh_axes["tp"], None)
+        attn = shard_map(
+            partial(ring_attention, axis_name=cp, causal=True),
+            mesh=mesh_axes["mesh"], in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)
+        o = attn(q, k, v).reshape(B, S, nh * hd)
+    else:
+        o = _attention(q, k, v, causal=True).reshape(B, S, nh * hd)
     x = sp(x + o @ lp["wo"])
 
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)

@@ -45,9 +45,25 @@ def state_specs(cfg: llama.LlamaConfig) -> TrainState:
     return TrainState(P(), ps, ps, ps, ps)
 
 
+def _prune_spec(spec: P, mesh: Mesh) -> P:
+    """Drop spec entries naming axes the mesh doesn't have (e.g. "fsdp"
+    specs on a dp×cp×tp mesh) — that dimension replicates instead."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.axis_names else None)
+    return P(*out)
+
+
 def state_shardings(mesh: Mesh, cfg: llama.LlamaConfig) -> TrainState:
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs(cfg),
-                        is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, _prune_spec(s, mesh)),
+        state_specs(cfg), is_leaf=lambda x: isinstance(x, P))
 
 
 def _adamw(g, p32, m, v, step, lr, b1, b2, eps, wd):
@@ -64,12 +80,15 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
                     lr: float = 3e-4, b1: float = 0.9, b2: float = 0.95,
                     eps: float = 1e-8, weight_decay: float = 0.1,
                     grad_clip: float = 1.0, data_axes=("dp", "fsdp"),
-                    tp_axis="tp", seq_chunk: Optional[int] = None):
+                    tp_axis="tp", cp_axis=None,
+                    seq_chunk: Optional[int] = None):
     """Returns jitted ``step(state, tokens) -> (state, metrics)``.
 
     With a mesh: tokens sharded over ``data_axes`` (dp × fsdp batch
     sharding), params/opt-state per :func:`llama.param_specs` (tp + ZeRO),
-    Megatron-SP activation constraints inside the model.
+    Megatron-SP activation constraints inside the model. ``cp_axis``: also
+    shard the sequence dim over this axis and run ring attention (context
+    parallelism) inside the step.
     """
     mesh_axes = None
     if mesh is not None:
@@ -79,7 +98,9 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
         mesh_axes = {"mesh": mesh,
                      "data": data if (data is None or len(data) != 1)
                      else data[0],
-                     "tp": tp_axis if tp_axis in mesh.axis_names else None}
+                     "tp": tp_axis if tp_axis in mesh.axis_names else None,
+                     "cp": cp_axis if (cp_axis and
+                                       cp_axis in mesh.axis_names) else None}
 
     def loss(params, tokens):
         return llama.loss_fn(params, tokens, cfg, mesh_axes,
@@ -112,7 +133,7 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
         return jax.jit(step_fn, donate_argnums=(0,))
 
     st_sh = state_shardings(mesh, cfg)
-    data_spec = P(mesh_axes["data"]) if mesh_axes["data"] else P()
+    data_spec = P(mesh_axes["data"], mesh_axes["cp"])
     tok_sh = NamedSharding(mesh, data_spec)
     rep = NamedSharding(mesh, P())
     return jax.jit(step_fn, donate_argnums=(0,),
