@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.pallas import flash_attention as _fa
+from . import moe as _moe
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +52,7 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     remat: bool = True
+    moe: Optional["_moe.MoEConfig"] = None  # experts replace the dense MLP
 
     @property
     def hd(self) -> int:
@@ -79,9 +81,12 @@ class LlamaConfig:
         h, i, v, L = (self.hidden_size, self.intermediate_size,
                       self.vocab_size, self.num_layers)
         hd, nh, nkv = self.hd, self.num_heads, self.num_kv_heads
+        if self.moe is None:
+            mlp = 3 * h * i
+        else:
+            mlp = self.moe.num_experts * 3 * h * i + h * self.moe.num_experts
         per_layer = (h * nh * hd + 2 * h * nkv * hd + nh * hd * h  # attn
-                     + 3 * h * i                                   # swiglu mlp
-                     + 2 * h)                                      # 2 rmsnorm
+                     + mlp + 2 * h)                                # 2 rmsnorm
         emb = v * h * (1 if self.tie_embeddings else 2)
         return L * per_layer + emb + h
 
@@ -109,20 +114,34 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         s = std if fan_in is None else 1.0 / math.sqrt(fan_in)
         return (jax.random.normal(kk, shape, jnp.float32) * s).astype(cfg.dtype)
 
-    params = {
-        "embed": norm(k[0], (v, h)),
-        "final_norm": jnp.ones((h,), cfg.dtype),
-        "layers": {
-            "wq": norm(k[1], (L, h, nh * hd), fan_in=h),
-            "wk": norm(k[2], (L, h, nkv * hd), fan_in=h),
-            "wv": norm(k[3], (L, h, nkv * hd), fan_in=h),
-            "wo": norm(k[4], (L, nh * hd, h), fan_in=nh * hd),
+    layers = {
+        "wq": norm(k[1], (L, h, nh * hd), fan_in=h),
+        "wk": norm(k[2], (L, h, nkv * hd), fan_in=h),
+        "wv": norm(k[3], (L, h, nkv * hd), fan_in=h),
+        "wo": norm(k[4], (L, nh * hd, h), fan_in=nh * hd),
+        "attn_norm": jnp.ones((L, h), cfg.dtype),
+        "mlp_norm": jnp.ones((L, h), cfg.dtype),
+    }
+    if cfg.moe is None:
+        layers.update({
             "wg": norm(k[5], (L, h, i), fan_in=h),
             "wu": norm(k[6], (L, h, i), fan_in=h),
             "wd": norm(k[7], (L, i, h), fan_in=i),
-            "attn_norm": jnp.ones((L, h), cfg.dtype),
-            "mlp_norm": jnp.ones((L, h), cfg.dtype),
-        },
+        })
+    else:
+        E = cfg.moe.num_experts
+        layers.update({
+            "moe_gate": (jax.random.normal(k[5], (L, h, E), jnp.float32) /
+                         math.sqrt(h)),
+            "moe_wg": norm(k[6], (L, E, h, i), fan_in=h),
+            "moe_wu": norm(jax.random.fold_in(k[6], 1), (L, E, h, i),
+                           fan_in=h),
+            "moe_wd": norm(k[7], (L, E, i, h), fan_in=i),
+        })
+    params = {
+        "embed": norm(k[0], (v, h)),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": layers,
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = norm(jax.random.fold_in(key, 99), (h, v), fan_in=h)
@@ -137,20 +156,31 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     over fsdp. (reference semantics: mp_layers.py Column/RowParallelLinear
     + sharding stage-3 group_sharded_stage3.py — here a pure declaration.)
     """
-    return {
-        "embed": P("fsdp", "tp"),
-        "final_norm": P(None),
-        "layers": {
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
+    layers = {
+        "wq": P(None, "fsdp", "tp"),
+        "wk": P(None, "fsdp", "tp"),
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.moe is None:
+        layers.update({
             "wg": P(None, "fsdp", "tp"),
             "wu": P(None, "fsdp", "tp"),
             "wd": P(None, "tp", "fsdp"),
-            "attn_norm": P(None, None),
-            "mlp_norm": P(None, None),
-        },
+        })
+    else:
+        layers.update({
+            "moe_gate": P(None, None, None),
+            "moe_wg": P(None, "ep", "fsdp", "tp"),
+            "moe_wu": P(None, "ep", "fsdp", "tp"),
+            "moe_wd": P(None, "ep", "tp", "fsdp"),
+        })
+    return {
+        "embed": P("fsdp", "tp"),
+        "final_norm": P(None),
+        "layers": layers,
         **({} if cfg.tie_embeddings else {"lm_head": P("fsdp", "tp")}),
     }
 
@@ -247,21 +277,23 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
     x = sp(x + o @ lp["wo"])
 
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    g = tpact(h2 @ lp["wg"])
-    u = tpact(h2 @ lp["wu"])
-    ff = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u) @ lp["wd"]
-    return sp(x + ff)
+    if cfg.moe is not None:
+        ff, losses = _moe.moe_ffn(
+            h2, {"w_gate": lp["moe_gate"], "wg": lp["moe_wg"],
+                 "wu": lp["moe_wu"], "wd": lp["moe_wd"]},
+            cfg.moe, mesh_axes=mesh_axes)
+        aux = losses["aux_loss"] + losses["z_loss"]
+    else:
+        g = tpact(h2 @ lp["wg"])
+        u = tpact(h2 @ lp["wu"])
+        ff = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype)
+              * u) @ lp["wd"]
+        aux = jnp.float32(0.0)
+    return sp(x + ff), aux
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
-            mesh_axes: Optional[Dict[str, Any]] = None,
-            return_hidden: bool = False) -> jax.Array:
-    """tokens (B, S) int32 -> logits (B, S, V) float32 (or final-norm
-    hidden states (B, S, H) when ``return_hidden``).
-
-    ``mesh_axes``: {"mesh", "data": axis-or-tuple for batch, "tp": axis} to
-    enable activation sharding constraints; None for single-device.
-    """
+def _trunk(params, tokens, cfg: LlamaConfig, mesh_axes=None):
+    """-> (final-norm hidden (B,S,H), summed MoE aux loss scalar)."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(S, cfg.hd, cfg.rope_theta)
@@ -274,10 +306,25 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
             block, policy=jax.checkpoint_policies.nothing_saveable)
 
     def body(carry, lp):
-        return block(carry, lp), None
+        x, aux = block(carry, lp)
+        return x, aux
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, auxs = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return x, jnp.sum(auxs)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            mesh_axes: Optional[Dict[str, Any]] = None,
+            return_hidden: bool = False) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, V) float32 (or final-norm
+    hidden states (B, S, H) when ``return_hidden``).
+
+    ``mesh_axes``: {"mesh", "data": axis-or-tuple for batch, "tp": axis,
+    "cp": axis, "ep": axis} to enable activation sharding constraints;
+    None for single-device.
+    """
+    x, _ = _trunk(params, tokens, cfg, mesh_axes)
     if return_hidden:
         return x
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
@@ -302,7 +349,7 @@ def loss_fn(params, tokens, cfg: LlamaConfig, mesh_axes=None,
     materialized — the HBM win that lets batch size scale (the reference
     pays the full fp32 logits; this is a TPU-first deviation).
     """
-    h = forward(params, tokens, cfg, mesh_axes, return_hidden=True)
+    h, aux = _trunk(params, tokens, cfg, mesh_axes)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     head = head.astype(h.dtype)
     B, S, H = h.shape
@@ -317,7 +364,7 @@ def loss_fn(params, tokens, cfg: LlamaConfig, mesh_axes=None,
             f"fallback would re-materialize the full fp32 logits")
     if seq_chunk is None:
         ce = _ce((h @ head).astype(jnp.float32), labels)
-        return jnp.sum(ce * mask) / denom
+        return jnp.sum(ce * mask) / denom + aux
 
     nc = S // seq_chunk
     hc = jnp.moveaxis(h.reshape(B, nc, seq_chunk, H), 1, 0)
@@ -330,4 +377,4 @@ def loss_fn(params, tokens, cfg: LlamaConfig, mesh_axes=None,
         return acc + jnp.sum(ce * mm), None
 
     total, _ = jax.lax.scan(body, jnp.float32(0.0), (hc, lc, mc))
-    return total / denom
+    return total / denom + aux

@@ -80,7 +80,7 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
                     lr: float = 3e-4, b1: float = 0.9, b2: float = 0.95,
                     eps: float = 1e-8, weight_decay: float = 0.1,
                     grad_clip: float = 1.0, data_axes=("dp", "fsdp"),
-                    tp_axis="tp", cp_axis=None,
+                    tp_axis="tp", cp_axis=None, ep_axis=None,
                     seq_chunk: Optional[int] = None):
     """Returns jitted ``step(state, tokens) -> (state, metrics)``.
 
@@ -100,7 +100,9 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
                      else data[0],
                      "tp": tp_axis if tp_axis in mesh.axis_names else None,
                      "cp": cp_axis if (cp_axis and
-                                       cp_axis in mesh.axis_names) else None}
+                                       cp_axis in mesh.axis_names) else None,
+                     "ep": ep_axis if (ep_axis and
+                                       ep_axis in mesh.axis_names) else None}
 
     def loss(params, tokens):
         return llama.loss_fn(params, tokens, cfg, mesh_axes,
