@@ -1,0 +1,120 @@
+"""Pipeline-parallel training step for the flagship LM.
+
+The reference runs PP as a multi-process 1F1B engine with eager NCCL p2p
+(reference: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:575 forward_backward_pipeline, interleave :1174;
+passes/pipeline_scheduler_pass/pipeline_zero_bubble.py). TPU-native, the
+pipeline is ONE jitted SPMD program: decoder layers live stacked (L, ...)
+with the L dim sharded over the "pp" mesh axis, each pp coordinate applies
+its L/P-layer stage, and activations hop the pp ring via ppermute inside a
+lax.scan wavefront (meta_parallel/pp_spmd.py). AD through the scan gives
+the reverse wavefront — the backward schedule the reference hand-codes.
+
+Composes with dp (batch axis) and tp (param specs) on the same mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import llama
+from .train import TrainState, _adamw, init_train_state, state_specs
+
+
+def state_shardings_pp(mesh: Mesh, cfg: llama.LlamaConfig,
+                       pp_axis: str = "pp") -> TrainState:
+    """Like train.state_shardings but the layer-stack dim shards over pp
+    (each pipeline stage owns its own layers' weights + opt state)."""
+    from .train import _prune_spec
+
+    def fix(path_spec):
+        return P(pp_axis, *path_spec[1:])
+
+    base = state_specs(cfg)
+
+    def map_state(specs):
+        out = dict(specs)
+        out["layers"] = {k: fix(s) for k, s in specs["layers"].items()}
+        return out
+
+    sp = TrainState(base.step, map_state(base.params), map_state(base.master),
+                    map_state(base.m), map_state(base.v))
+    return jax.tree.map(lambda s: NamedSharding(mesh, _prune_spec(s, mesh)),
+                        sp, is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
+                       num_microbatches: int, pp_axis: str = "pp",
+                       dp_axis: str = "dp", lr: float = 3e-4,
+                       b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8,
+                       weight_decay: float = 0.1, grad_clip: float = 1.0):
+    """jitted ``step(state, tokens) -> (state, metrics)`` with the GPipe
+    wavefront over ``pp_axis``. Batch dim must divide num_microbatches.
+    """
+    assert cfg.moe is None, "pp+MoE composition not yet supported"
+    num_stages = mesh.shape[pp_axis]
+    assert cfg.num_layers % num_stages == 0
+    lp_per_stage = cfg.num_layers // num_stages
+    dp = dp_axis if dp_axis in mesh.axis_names else None
+
+    from ..distributed.fleet.meta_parallel.pp_spmd import pipeline_spmd
+
+    def loss(params, tokens):
+        B, S = tokens.shape
+        M = num_microbatches
+        mb = B // M
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+        cos, sin = llama.rope_tables(S, cfg.hd, cfg.rope_theta)
+
+        def stage_fn(stage_params, xin):
+            def body(c, lp):
+                y, _ = llama._block(c, lp, cos, sin, cfg, None)
+                return y, None
+            y, _ = lax.scan(body, xin, stage_params)
+            return y
+
+        stacked = jax.tree.map(
+            lambda a: a.reshape(num_stages, lp_per_stage, *a.shape[1:]),
+            params["layers"])
+        mbs = x.reshape(M, mb, S, cfg.hidden_size)
+        outs = pipeline_spmd(stage_fn, stacked, mbs, mesh, pp_axis)
+        outs = outs.reshape(B, S, cfg.hidden_size)
+        h = llama.rms_norm(outs, params["final_norm"], cfg.rms_eps)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = (h @ head.astype(h.dtype)).astype(jnp.float32)[:, :-1]
+        labels = tokens[:, 1:]
+        ce = llama._ce(logits, labels)
+        return jnp.mean(ce)
+
+    def step_fn(state: TrainState, tokens):
+        lv, grads = jax.value_and_grad(loss)(state.params, tokens)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(grads)))
+        scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * scale, grads)
+
+        def upd(g, p32, m, v):
+            return _adamw(g, p32, m, v, state.step, lr, b1, b2, eps,
+                          weight_decay)
+        out = jax.tree.map(upd, grads, state.master, state.m, state.v)
+        master = jax.tree.map(lambda t: t[0], out,
+                              is_leaf=lambda x: isinstance(x, tuple))
+        m = jax.tree.map(lambda t: t[1], out,
+                         is_leaf=lambda x: isinstance(x, tuple))
+        v = jax.tree.map(lambda t: t[2], out,
+                         is_leaf=lambda x: isinstance(x, tuple))
+        params = jax.tree.map(lambda p32, p: p32.astype(p.dtype), master,
+                              state.params)
+        return (TrainState(state.step + 1, params, master, m, v),
+                {"loss": lv, "grad_norm": gnorm})
+
+    st_sh = state_shardings_pp(mesh, cfg, pp_axis)
+    tok_sh = NamedSharding(mesh, P(dp))
+    rep = NamedSharding(mesh, P())
+    return jax.jit(step_fn, donate_argnums=(0,),
+                   in_shardings=(st_sh, tok_sh),
+                   out_shardings=(st_sh, {"loss": rep, "grad_norm": rep}))
