@@ -1,0 +1,10 @@
+"""paddle.incubate parity (reference: python/paddle/incubate/ — 42.4k LoC:
+fused-op functional APIs, MoE models, DistributedFusedLamb, ASP, autotune).
+
+On TPU the "fused" ops are expressed as jnp compositions XLA fuses (plus
+Pallas kernels for attention); the API surface is kept for drop-in parity.
+"""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401

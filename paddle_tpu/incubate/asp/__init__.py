@@ -1,0 +1,89 @@
+"""ASP — automatic 2:4 structured sparsity (reference: python/paddle/
+incubate/asp/ — asp.py decorate/prune_model, supported_layer_list).
+
+TPU note: the MXU has no sparse-tensor-core analog, so 2:4 pruning here is
+a *masking* workflow (same as the reference's training-time behavior):
+``prune_model`` computes 2:4 masks per supported weight and ``decorate``
+re-applies masks after each optimizer step, preserving the reference
+semantics for model-quality experiments.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor
+
+_masks: Dict[int, np.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_2to4_1d(v: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-|.| of every 4 consecutive elements."""
+    n = v.size - v.size % 4
+    blocks = np.abs(v[:n]).reshape(-1, 4)
+    order = np.argsort(-blocks, axis=1)
+    mask = np.zeros_like(blocks, dtype=bool)
+    rows = np.arange(blocks.shape[0])[:, None]
+    mask[rows, order[:, :2]] = True
+    full = np.ones(v.shape, dtype=bool)
+    full[:n] = mask.reshape(-1)
+    return full
+
+
+def create_mask(w: np.ndarray) -> np.ndarray:
+    if w.ndim < 2:
+        return np.ones_like(w, dtype=bool)
+    flat = w.reshape(-1, w.shape[-1])
+    mask = np.stack([_mask_2to4_1d(row) for row in flat])
+    return mask.reshape(w.shape)
+
+
+def check_mask_2_4(mask: np.ndarray) -> bool:
+    flat = mask.reshape(-1)
+    n = flat.size - flat.size % 4
+    return bool((flat[:n].reshape(-1, 4).sum(1) <= 2).all())
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every >=2D weight of the model in place."""
+    from ...nn.layer.layers import Layer
+    assert isinstance(model, Layer)
+    for name, p in model.named_parameters():
+        if p is None or p.ndim < 2 or "bias" in name:
+            continue
+        w = p.numpy()
+        mask = create_mask(w)
+        _masks[id(p)] = mask
+        p.set_value(w * mask)
+    return _masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply sparsity masks after each update
+    (reference: asp.py decorate -> OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for group in [optimizer._parameter_list or []]:
+            for p in group:
+                mask = _masks.get(id(p))
+                if mask is not None:
+                    p.set_value(p.numpy() * mask)
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(main_program=None):
+    pass
+
+
+def set_excluded_layers(param_names, main_program=None):
+    pass

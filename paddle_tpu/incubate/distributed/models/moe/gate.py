@@ -1,0 +1,56 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py). The gate owns the router weight and maps
+token features -> (expert idx, combine weight, aux losses)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....nn.layer.layers import Layer
+from .....models.moe import MoEConfig
+
+
+class NaiveGate(Layer):
+    """Plain top-k softmax routing, no aux loss."""
+
+    top_k = 2
+
+    def __init__(self, d_model, num_experts, world_size=1, topk=2):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = topk
+        self.weight = self.create_parameter([d_model, num_experts])
+
+    def config(self, capacity_factor=1.25) -> MoEConfig:
+        return MoEConfig(num_experts=self.num_experts, top_k=self.top_k,
+                         capacity_factor=capacity_factor,
+                         aux_loss_weight=0.0, z_loss_weight=0.0)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 with load-balancing aux loss (reference: gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_experts, world_size, topk)
+        self.capacity_factor = capacity[0]
+
+    def config(self, capacity_factor=None) -> MoEConfig:
+        return MoEConfig(num_experts=self.num_experts, top_k=self.top_k,
+                         capacity_factor=capacity_factor or
+                         self.capacity_factor,
+                         aux_loss_weight=0.01, z_loss_weight=1e-3)
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch routing (reference: switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, world_size=1, topk=1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_experts, world_size, 1)
+        self.capacity_factor = capacity[0]
+
+    def config(self, capacity_factor=None) -> MoEConfig:
+        return MoEConfig(num_experts=self.num_experts, top_k=1,
+                         capacity_factor=capacity_factor or
+                         self.capacity_factor,
+                         aux_loss_weight=0.01, z_loss_weight=1e-3)

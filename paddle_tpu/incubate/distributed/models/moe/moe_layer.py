@@ -1,0 +1,65 @@
+"""Imperative MoE layer (reference: python/paddle/incubate/distributed/
+models/moe/moe_layer.py:263 MoELayer — MoEScatter:99 / MoEGather:149 route
+tokens through NCCL alltoall).
+
+TPU-native: the Layer owns per-expert SwiGLU weights stacked (E, ...) and
+delegates to the functional GShard dispatch (models/moe.py) — capacity-
+based static shapes, einsum dispatch that GSPMD lowers to AllToAll when
+the expert dim is sharded over an "ep" mesh axis. The gate is a
+:class:`gate.NaiveGate`-family Layer for API parity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .....nn.layer.layers import Layer
+from ....._core.autograd import apply
+from .....ops._registry import as_tensor
+from .....models import moe as _moe
+from .gate import NaiveGate, GShardGate, SwitchGate
+
+
+class MoELayer(Layer):
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate: Optional[object] = None, top_k: int = 2,
+                 capacity_factor: float = 1.25, group=None,
+                 recompute_interval=0, **kw):
+        super().__init__()
+        if gate is None or gate == "gshard":
+            gate = GShardGate(d_model, num_experts, topk=top_k)
+        elif gate == "switch":
+            gate = SwitchGate(d_model, num_experts)
+        elif gate == "naive":
+            gate = NaiveGate(d_model, num_experts, topk=top_k)
+        self.gate = gate
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.wg = self.create_parameter([num_experts, d_model, d_hidden])
+        self.wu = self.create_parameter([num_experts, d_model, d_hidden])
+        self.wd = self.create_parameter([num_experts, d_hidden, d_model])
+        self._last_aux_loss = None
+
+    def forward(self, x):
+        x = as_tensor(x)
+        cfg = self.gate.config(self.capacity_factor)
+
+        def f(xv, gw, wg, wu, wd):
+            params = {"w_gate": gw, "wg": wg, "wu": wu, "wd": wd}
+            squeeze = xv.ndim == 2
+            if squeeze:
+                xv = xv[None]
+            out, losses = _moe.moe_ffn(xv, params, cfg)
+            aux = losses["aux_loss"] + losses["z_loss"]
+            return (out[0] if squeeze else out), aux
+
+        out, aux = apply(f, x, self.gate.weight, self.wg, self.wu, self.wd,
+                         name="moe_layer", multi_out=True)
+        self._last_aux_loss = aux
+        return out
+
+    @property
+    def aux_loss(self):
+        """Load-balancing loss of the last forward (add to the objective)."""
+        return self._last_aux_loss

@@ -1,0 +1,412 @@
+"""Fused-op functional APIs (reference: python/paddle/incubate/nn/functional/
+— fused_transformer.py, fused_rms_norm.py, swiglu.py, fused_rotary_position_
+embedding.py, fused_bias_act, fused_dropout_add, masked_multihead_attention,
+fused_moe; CUDA kernels paddle/phi/kernels/fusion/*).
+
+TPU-native: each is a jnp composition designed so XLA fuses it into one or
+few kernels (elementwise chains fold into neighbouring matmuls on the MXU);
+attention routes to the Pallas flash kernel where applicable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...._core.autograd import apply
+from ...._core.tensor import Tensor
+from ....ops._registry import as_tensor
+
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "swiglu",
+    "fused_rotary_position_embedding", "fused_bias_act",
+    "fused_dropout_add", "fused_linear", "fused_linear_activation",
+    "fused_matmul_bias", "fused_feedforward", "fused_multi_head_attention",
+    "fused_bias_dropout_residual_layer_norm", "masked_multihead_attention",
+    "fused_moe",
+]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **_):
+    """reference: incubate/nn/functional/fused_rms_norm.py — rms norm with
+    optional pre-norm bias/residual add. Returns (out, residual_out) like
+    the reference when residual is given, else out."""
+    x = as_tensor(x)
+    args = [x]
+    opt = {}
+    for nm, t in (("bias", bias), ("residual", residual),
+                  ("w", norm_weight), ("b", norm_bias)):
+        if t is not None:
+            opt[nm] = len(args)
+            args.append(as_tensor(t))
+    ax = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    naxes = tuple(range(ax, x.ndim))
+
+    def f(v, *rest):
+        ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+        vv = v.astype(ct)
+        if "bias" in opt:
+            vv = vv + rest[opt["bias"] - 1].astype(ct)
+        if "residual" in opt:
+            vv = vv + rest[opt["residual"] - 1].astype(ct)
+        res_out = vv
+        var = jnp.mean(jnp.square(vv), axis=naxes, keepdims=True)
+        out = vv * jax.lax.rsqrt(var + epsilon)
+        if "w" in opt:
+            out = out * rest[opt["w"] - 1].astype(ct)
+        if "b" in opt:
+            out = out + rest[opt["b"] - 1].astype(ct)
+        if "residual" in opt:
+            return out.astype(v.dtype), res_out.astype(v.dtype)
+        return out.astype(v.dtype)
+
+    if residual is not None:
+        return apply(f, *args, name="fused_rms_norm", multi_out=True)
+    return apply(f, *args, name="fused_rms_norm")
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **_):
+    """reference: incubate/nn/functional/fused_layer_norm.py."""
+    x = as_tensor(x)
+    args = [x]
+    opt = {}
+    for nm, t in (("bias", bias), ("residual", residual),
+                  ("w", norm_weight), ("b", norm_bias)):
+        if t is not None:
+            opt[nm] = len(args)
+            args.append(as_tensor(t))
+    ax = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    naxes = tuple(range(ax, x.ndim))
+
+    def f(v, *rest):
+        ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+        vv = v.astype(ct)
+        if "bias" in opt:
+            vv = vv + rest[opt["bias"] - 1].astype(ct)
+        if "residual" in opt:
+            vv = vv + rest[opt["residual"] - 1].astype(ct)
+        res_out = vv
+        mean = jnp.mean(vv, axis=naxes, keepdims=True)
+        var = jnp.mean(jnp.square(vv - mean), axis=naxes, keepdims=True)
+        out = (vv - mean) * jax.lax.rsqrt(var + epsilon)
+        if "w" in opt:
+            out = out * rest[opt["w"] - 1].astype(ct)
+        if "b" in opt:
+            out = out + rest[opt["b"] - 1].astype(ct)
+        if "residual" in opt:
+            return out.astype(v.dtype), res_out.astype(v.dtype)
+        return out.astype(v.dtype)
+
+    if residual is not None:
+        return apply(f, *args, name="fused_layer_norm", multi_out=True)
+    return apply(f, *args, name="fused_layer_norm")
+
+
+def swiglu(x, y=None, name=None):
+    """reference: incubate/nn/functional/swiglu.py — silu(x) * y; if y is
+    None, x is split in half along the last dim."""
+    x = as_tensor(x)
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a.astype(jnp.float32)).astype(v.dtype) * b
+        return apply(f, x, name="swiglu")
+    y = as_tensor(y)
+    return apply(
+        lambda a, b: jax.nn.silu(a.astype(jnp.float32)).astype(a.dtype) * b,
+        x, y, name="swiglu")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0, time_major=False):
+    """reference: incubate/nn/functional/fused_rotary_position_embedding.py
+    (kernel paddle/phi/kernels/fusion/fused_rope_kernel.cu). q/k/v:
+    (B, S, H, D). Returns rotated (q, k, v) (None passthrough)."""
+    outs = []
+    tensors = [t for t in (q, k, v) if t is not None]
+    q0 = as_tensor(tensors[0])
+    B, S, H, D = q0.shape
+    if sin is None or cos is None:
+        inv = 1.0 / (rotary_emb_base **
+                     (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        t = jnp.arange(S, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        cos_t, sin_t = jnp.cos(freqs), jnp.sin(freqs)
+    else:
+        cos_t = as_tensor(cos)._value.reshape(S, -1)[:, :D // 2]
+        sin_t = as_tensor(sin)._value.reshape(S, -1)[:, :D // 2]
+    if position_ids is not None:
+        pid = as_tensor(position_ids)._value  # (B, S)
+        cos_t = jnp.take(cos_t, pid, axis=0)  # (B, S, D/2)
+        sin_t = jnp.take(sin_t, pid, axis=0)
+        expand = lambda c: c[:, :, None, :]
+    else:
+        expand = lambda c: c[None, :, None, :]
+
+    def rot(t):
+        def f(x):
+            c = expand(cos_t).astype(jnp.float32)
+            s = expand(sin_t).astype(jnp.float32)
+            xf = x.astype(jnp.float32)
+            if use_neox_rotary_style:
+                x1, x2 = jnp.split(xf, 2, axis=-1)
+                out = jnp.concatenate(
+                    [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+            else:  # GPT-J interleaved pairs
+                x1 = xf[..., 0::2]
+                x2 = xf[..., 1::2]
+                o1 = x1 * c - x2 * s
+                o2 = x2 * c + x1 * s
+                out = jnp.stack([o1, o2], axis=-1).reshape(xf.shape)
+            return out.astype(x.dtype)
+        return apply(f, as_tensor(t), name="fused_rope")
+
+    result = tuple(rot(t) if t is not None else None for t in (q, k, v))
+    return result
+
+
+_ACTS = {
+    "gelu": lambda x: jax.nn.gelu(x.astype(jnp.float32)).astype(x.dtype),
+    "relu": jax.nn.relu,
+    "silu": lambda x: jax.nn.silu(x.astype(jnp.float32)).astype(x.dtype),
+    "swiglu": None,  # handled specially
+    "geglu": None,
+}
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **_):
+    """reference: incubate/nn/functional/fused_bias_act (kernel
+    fused_bias_act_kernel.cu): out = act(x + bias), with swiglu/geglu
+    splitting the last dim."""
+    x = as_tensor(x)
+    args = [x]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(v, *rest):
+        if rest:
+            v = v + rest[0]
+        if act_method in ("swiglu", "geglu"):
+            a, b = jnp.split(v, 2, axis=-1)
+            g = (jax.nn.silu if act_method == "swiglu" else jax.nn.gelu)(
+                a.astype(jnp.float32)).astype(v.dtype)
+            return g * b
+        return _ACTS[act_method](v)
+    return apply(f, *args, name="fused_bias_act")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """reference: incubate/nn/functional/fused_dropout_add.py —
+    dropout(x) + y in one pass."""
+    from ....nn.functional.common import dropout
+    d = dropout(x, p=p, training=training, mode=mode)
+    return d + as_tensor(y)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: incubate/nn/functional/blha etc. fused_matmul_bias —
+    cublasLt epilogue fusion; XLA does the same fusion natively."""
+    x, y = as_tensor(x), as_tensor(y)
+    args = [x, y]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply(f, *args, name="fused_matmul_bias")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x,
+                            transpose_y=trans_y)
+    if activation in (None, "none"):
+        return out
+    return apply(_ACTS[activation], out, name=f"fused_linear_{activation}")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode='upscale_in_train',
+                      name=None):
+    """reference: incubate/nn/functional/fused_transformer.py
+    fused_feedforward (kernel fused_feedforward_kernel.cu):
+    residual + dropout(linear2(dropout(act(linear1(ln(x)))))) with pre/post
+    layernorm."""
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    x = as_tensor(x)
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = layer_norm(x, d, ln1_scale, ln1_bias, ln1_epsilon)
+    h = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    h = apply(_ACTS.get(activation, jax.nn.relu), h, name=activation)
+    h = dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    h = dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = layer_norm(out, d, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode='upscale_in_train',
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """reference: fused_transformer.py fused_multi_head_attention (kernel
+    fused_attention_kernel.cu). qkv_weight: (3, H, D_head, D_in) as in the
+    reference layout."""
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    from ....nn.functional.attention import scaled_dot_product_attention
+    x = as_tensor(x)
+    residual = x
+    B, S, D = x.shape
+    if pre_layer_norm:
+        x = layer_norm(x, D, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkvw = as_tensor(qkv_weight)
+    three, H, Dh, Din = qkvw.shape
+    qkv = fused_matmul_bias(
+        x, qkvw.reshape([3 * H * Dh, Din]), qkv_bias, transpose_y=True)
+    qkv = qkv.reshape([B, S, 3, H, Dh])
+
+    def split3(t):
+        return (apply(lambda v: v[:, :, 0], t, name="slice_q"),
+                apply(lambda v: v[:, :, 1], t, name="slice_k"),
+                apply(lambda v: v[:, :, 2], t, name="slice_v"))
+    q, k, v = split3(qkv)
+    o = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate
+        if training else 0.0, is_causal=False)
+    o = o.reshape([B, S, H * Dh])
+    out = fused_matmul_bias(o, linear_weight, linear_bias)
+    out = dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = layer_norm(out, D, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode='upscale_in_train',
+                                           name=None):
+    """reference: incubate/nn/functional/fused_transformer.py."""
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    x = as_tensor(x)
+    if bias is not None:
+        x = x + as_tensor(bias)
+    x = dropout(x, p=dropout_rate, training=training, mode=mode)
+    out = x + as_tensor(residual)
+    return layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               out_shift=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False, **_):
+    """Decode-time single-token attention against a KV cache
+    (reference: incubate/nn/functional/masked_multihead_attention.py,
+    kernel masked_multihead_attention_kernel.cu).
+
+    x: (B, 3*H*D) fused qkv for ONE step; cache_kv: (2, B, H, max_seq, D).
+    Returns (out (B, H*D), updated cache_kv) following the reference.
+    """
+    x = as_tensor(x)
+    cache = as_tensor(cache_kv)
+    args = [x, cache]
+    if bias is not None:
+        args.append(as_tensor(bias))
+    if sequence_lengths is not None:
+        args.append(as_tensor(sequence_lengths))
+
+    two, B, H, MS, D = cache.shape
+
+    def f(xv, cachev, *rest):
+        i = 0
+        if bias is not None:
+            xv = xv + rest[i]; i += 1
+        if sequence_lengths is not None:
+            cur = rest[i].reshape(-1)  # (B,) current lengths
+        else:
+            cur = None
+        qkv = xv.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # (B, H, D)
+        if cur is None:
+            # without explicit lengths, append at position 0 of empty cache
+            step = jnp.zeros((B,), jnp.int32)
+        else:
+            step = cur.astype(jnp.int32)
+        bidx = jnp.arange(B)
+        ck = cachev[0].at[bidx, :, step].set(k)
+        cv = cachev[1].at[bidx, :, step].set(v)
+        # attention over cached positions <= step
+        s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / math.sqrt(D)
+        pos = jnp.arange(MS)[None, None, :]
+        s = jnp.where(pos <= step[:, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhs,bhsd->bhd", p.astype(cv.dtype), cv)
+        return o.reshape(B, H * D), jnp.stack([ck, cv])
+
+    return apply(f, *args, name="masked_multihead_attention", multi_out=True)
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method="None", moe_topk=2,
+              norm_topk_prob=True, **_):
+    """reference: incubate/nn/functional/fused_moe.py — top-k routed expert
+    FFN. ffn1_weight: (E, H, 2*I) swiglu-packed; ffn2: (E, I, H)."""
+    from ....models.moe import MoEConfig, moe_ffn
+    x = as_tensor(x)
+    gw = as_tensor(gate_weight)
+    w1 = as_tensor(ffn1_weight)
+    w2 = as_tensor(ffn2_weight)
+    E = gw.shape[-1]
+    cfg = MoEConfig(num_experts=E, top_k=moe_topk, capacity_factor=4.0)
+
+    def f(xv, gv, w1v, w2v):
+        half = w1v.shape[-1] // 2
+        params = {"w_gate": gv, "wg": w1v[..., :half],
+                  "wu": w1v[..., half:], "wd": w2v}
+        squeeze = xv.ndim == 2
+        if squeeze:
+            xv = xv[None]
+        out, _ = moe_ffn(xv, params, cfg)
+        return out[0] if squeeze else out
+    return apply(f, x, gw, w1, w2, name="fused_moe")

@@ -1,0 +1,3 @@
+"""reference: python/paddle/incubate/optimizer/ — DistributedFusedLamb
+(distributed_fused_lamb.py), LookAhead, ModelAverage."""
+from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401

@@ -1,0 +1,201 @@
+"""incubate fused ops + MoELayer + ASP tests (numpy-reference pattern,
+SURVEY §4 OpTest)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestFusedNorms:
+    def test_fused_rms_norm(self):
+        x = np.random.randn(2, 8).astype(np.float32)
+        w = np.random.randn(8).astype(np.float32)
+        out = F.fused_rms_norm(t(x), norm_weight=t(w))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_fused_rms_norm_residual(self):
+        x = np.random.randn(2, 8).astype(np.float32)
+        r = np.random.randn(2, 8).astype(np.float32)
+        out, res_out = F.fused_rms_norm(t(x), residual=t(r))
+        s = x + r
+        np.testing.assert_allclose(res_out.numpy(), s, rtol=1e-6)
+        ref = s / np.sqrt((s ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_fused_layer_norm(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        w = np.random.randn(8).astype(np.float32)
+        b = np.random.randn(8).astype(np.float32)
+        out = F.fused_layer_norm(t(x), norm_weight=t(w), norm_bias=t(b))
+        mu = x.mean(-1, keepdims=True)
+        sd = np.sqrt(((x - mu) ** 2).mean(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), (x - mu) / sd * w + b,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSwiglu:
+    def test_two_arg(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        y = np.random.randn(3, 4).astype(np.float32)
+        out = F.swiglu(t(x), t(y))
+        ref = x / (1 + np.exp(-x)) * y
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_one_arg_split(self):
+        x = np.random.randn(3, 8).astype(np.float32)
+        out = F.swiglu(t(x))
+        a, b = x[:, :4], x[:, 4:]
+        np.testing.assert_allclose(out.numpy(), a / (1 + np.exp(-a)) * b,
+                                   rtol=1e-5)
+
+    def test_grad(self):
+        x = t(np.random.randn(3, 4), sg=False)
+        y = t(np.random.randn(3, 4), sg=False)
+        F.swiglu(x, y).sum().backward()
+        assert x.grad is not None and y.grad is not None
+
+
+class TestRope:
+    def test_norm_preserving_and_t0(self):
+        q = np.random.randn(1, 6, 2, 8).astype(np.float32)
+        k = np.random.randn(1, 6, 2, 8).astype(np.float32)
+        qr, kr, _ = F.fused_rotary_position_embedding(t(q), t(k))
+        np.testing.assert_allclose(qr.numpy()[:, 0], q[:, 0], rtol=1e-5)
+        np.testing.assert_allclose(np.linalg.norm(qr.numpy(), axis=-1),
+                                   np.linalg.norm(q, axis=-1), rtol=1e-5)
+        np.testing.assert_allclose(np.linalg.norm(kr.numpy(), axis=-1),
+                                   np.linalg.norm(k, axis=-1), rtol=1e-5)
+
+    def test_matches_llama_rope(self):
+        from paddle_tpu.models.llama import rope_tables, apply_rope
+        q = np.random.randn(2, 8, 2, 16).astype(np.float32)
+        qr, _, _ = F.fused_rotary_position_embedding(t(q))
+        cos, sin = rope_tables(8, 16, 10000.0)
+        ref = apply_rope(jnp.asarray(q), cos, sin)
+        np.testing.assert_allclose(qr.numpy(), np.asarray(ref), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestFusedBiasAct:
+    def test_gelu(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        b = np.random.randn(8).astype(np.float32)
+        out = F.fused_bias_act(t(x), t(b), act_method="gelu")
+        ref = jax.nn.gelu(jnp.asarray(x + b))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_swiglu_packed(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        out = F.fused_bias_act(t(x), act_method="swiglu")
+        a, b = x[:, :4], x[:, 4:]
+        np.testing.assert_allclose(out.numpy(), a / (1 + np.exp(-a)) * b,
+                                   rtol=1e-5)
+
+
+class TestFusedLinear:
+    def test_matmul_bias(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        w = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        out = F.fused_matmul_bias(t(x), t(w), t(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_fused_linear_activation(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        w = np.random.randn(4, 5).astype(np.float32)
+        out = F.fused_linear_activation(t(x), t(w), activation="relu")
+        np.testing.assert_allclose(out.numpy(), np.maximum(x @ w, 0),
+                                   rtol=1e-5)
+
+
+class TestFusedTransformer:
+    def test_feedforward_shapes_and_train(self):
+        x = t(np.random.randn(2, 4, 8), sg=False)
+        w1 = t(np.random.randn(8, 16) * 0.1, sg=False)
+        w2 = t(np.random.randn(16, 8) * 0.1, sg=False)
+        out = F.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                  dropout2_rate=0.0)
+        assert out.shape == [2, 4, 8]
+        out.sum().backward()
+        assert w1.grad is not None
+
+    def test_fused_mha_layer(self):
+        import paddle_tpu.incubate.nn as inn
+        layer = inn.FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                            attn_dropout_rate=0.0)
+        x = t(np.random.randn(2, 5, 16))
+        out = layer(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_encoder_layer(self):
+        import paddle_tpu.incubate.nn as inn
+        enc = inn.FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        x = t(np.random.randn(2, 5, 16))
+        assert enc(x).shape == [2, 5, 16]
+
+
+class TestMaskedMHA:
+    def test_decode_step(self):
+        B, H, D, MS = 2, 2, 4, 8
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((B, 3 * H * D), np.float32)
+        cache = np.zeros((2, B, H, MS, D), np.float32)
+        lens = np.zeros((B, 1), np.int32)
+        out, new_cache = F.masked_multihead_attention(
+            t(x), cache_kv=t(cache), sequence_lengths=paddle.to_tensor(lens))
+        # step 0: output == v (softmax over single position)
+        qkv = x.reshape(B, 3, H, D)
+        np.testing.assert_allclose(out.numpy(), qkv[:, 2].reshape(B, H * D),
+                                   rtol=1e-5, atol=1e-6)
+        assert np.abs(new_cache.numpy()[0][:, :, 0]).sum() > 0
+
+
+class TestMoELayer:
+    def test_moe_layer_trains(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        layer = MoELayer(d_model=8, d_hidden=16, num_experts=4, top_k=2,
+                         capacity_factor=4.0)
+        x = t(np.random.randn(2, 6, 8), sg=False)
+        out = layer(x)
+        assert out.shape == [2, 6, 8]
+        (out.sum() + layer.aux_loss).backward()
+        assert layer.wg.grad is not None
+        assert layer.gate.weight.grad is not None
+
+
+class TestASP:
+    def test_mask_2_4(self):
+        from paddle_tpu.incubate import asp
+        w = np.random.randn(8, 16).astype(np.float32)
+        mask = asp.create_mask(w)
+        assert asp.check_mask_2_4(mask)
+        assert abs(asp.calculate_density(w * mask) - 0.5) < 1e-6
+
+    def test_prune_model(self):
+        from paddle_tpu.incubate import asp
+        net = paddle.nn.Linear(16, 8)
+        asp.prune_model(net)
+        d = asp.calculate_density(net.weight)
+        assert abs(d - 0.5) < 1e-6
+
+
+class TestFusedMoE:
+    def test_fused_moe_runs(self):
+        rng = np.random.default_rng(0)
+        H, I, E = 8, 16, 4
+        x = t(rng.standard_normal((6, H), np.float32))
+        gw = t(rng.standard_normal((H, E), np.float32))
+        w1 = t(rng.standard_normal((E, H, 2 * I), np.float32) * 0.1)
+        w2 = t(rng.standard_normal((E, I, H), np.float32) * 0.1)
+        out = F.fused_moe(x, gw, w1, w2, moe_topk=2)
+        assert out.shape == [6, H]
+        assert np.isfinite(out.numpy()).all()
