@@ -42,6 +42,7 @@ from . import vision  # noqa: E402
 from . import hapi  # noqa: E402
 from . import distributed  # noqa: E402
 from . import incubate  # noqa: E402
+from . import profiler  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

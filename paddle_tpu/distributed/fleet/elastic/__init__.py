@@ -1,0 +1,1 @@
+from .manager import ElasticManager, ElasticStatus, ELASTIC_EXIT_CODE  # noqa: F401

@@ -1,0 +1,123 @@
+"""Elastic training manager (reference: python/paddle/distributed/fleet/
+elastic/manager.py:125 ElasticManager — etcd rank registry, scale watch,
+ELASTIC_EXIT_CODE=101 relaunch :33; LauncherInterface :57).
+
+TPU-native: the registry is a filesystem KV (shared FS / GCS-fuse mount,
+the TPU-pod idiom) instead of etcd, and the hot signal is *preemption*:
+Cloud TPU VMs receive a maintenance-event notice; ``ElasticManager``
+watches for it (env hook) and triggers checkpoint-then-exit(101), which
+the launch controller turns into a relaunch that resumes from the last
+checkpoint.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+ELASTIC_EXIT_CODE = 101
+
+
+class ElasticStatus(enum.Enum):
+    """reference: manager.py ElasticStatus."""
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2
+    RESTART = 3
+    EXIT = 4
+
+
+class ElasticManager:
+    def __init__(self, args=None, registry_dir: Optional[str] = None,
+                 job_id: Optional[str] = None,
+                 np: Optional[int] = None):
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.registry_dir = registry_dir or os.environ.get(
+            "PADDLE_ELASTIC_REGISTRY", "/tmp/paddle_tpu_elastic")
+        self.enable = self.np > 1 or bool(
+            os.environ.get("PADDLE_ELASTIC_SERVER"))
+        self._stop = threading.Event()
+        self._preempt_cb: Optional[Callable] = None
+        self._watcher: Optional[threading.Thread] = None
+
+    # ---- registry (reference: etcd node registration) ----
+    def _node_path(self, rank):
+        return os.path.join(self.registry_dir, self.job_id,
+                            f"rank_{rank}.json")
+
+    def register(self):
+        path = self._node_path(self.rank)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"rank": self.rank, "pid": os.getpid(),
+                       "ts": time.time()}, f)
+
+    def deregister(self):
+        try:
+            os.remove(self._node_path(self.rank))
+        except OSError:
+            pass
+
+    def alive_nodes(self, ttl: float = 60.0):
+        base = os.path.join(self.registry_dir, self.job_id)
+        out = []
+        if not os.path.isdir(base):
+            return out
+        now = time.time()
+        for fn in os.listdir(base):
+            try:
+                with open(os.path.join(base, fn)) as f:
+                    d = json.load(f)
+                if now - d["ts"] < ttl:
+                    out.append(d["rank"])
+            except Exception:
+                pass
+        return sorted(out)
+
+    def heartbeat(self):
+        self.register()
+
+    # ---- health / scale decision (reference: manager._match) ----
+    def match(self) -> bool:
+        return len(self.alive_nodes()) == self.np
+
+    def wait(self, timeout: float = 300.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self.match():
+                return True
+            time.sleep(1.0)
+        return False
+
+    # ---- preemption (TPU maintenance events) ----
+    def on_preemption(self, callback: Callable):
+        """Register checkpoint-and-exit callback; triggered by SIGTERM (the
+        Cloud TPU preemption notice) or the watch file."""
+        self._preempt_cb = callback
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        if self._preempt_cb:
+            self._preempt_cb()
+        os._exit(ELASTIC_EXIT_CODE)
+
+    def watch_preemption_file(self, path: str, interval: float = 5.0):
+        """Poll a maintenance-notice file (GCE metadata watcher writes it)."""
+        def loop():
+            while not self._stop.is_set():
+                if os.path.exists(path):
+                    self._handle(None, None)
+                time.sleep(interval)
+        self._watcher = threading.Thread(target=loop, daemon=True)
+        self._watcher.start()
+
+    def exit(self, completed: bool = True) -> ElasticStatus:
+        self._stop.set()
+        self.deregister()
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

@@ -1,0 +1,151 @@
+"""Distributed launch CLI (reference: python/paddle/distributed/launch/
+main.py:23 launch; controllers/controller.py:35 ControllerBase, :79 run,
+:87 watch).
+
+TPU-native: under JAX's single-controller model one process drives all
+local chips, so the per-GPU-process fan-out of the reference becomes
+per-HOST processes. The launcher:
+
+- resolves rank/world from args or env (PADDLE_TRAINER_ID /
+  PADDLE_TRAINERS_NUM / PADDLE_MASTER ≙ process_id / num_processes /
+  coordinator_address),
+- exports the env the framework's init_parallel_env consumes,
+- for local debugging (``--nproc_per_node N``) spawns N processes with a
+  forced CPU mesh so no-cluster multi-rank tests run anywhere (SURVEY §4
+  pattern 1),
+- watches children, restarts on elastic exit code 101
+  (reference: fleet/elastic/manager.py:33 ELASTIC_EXIT_CODE).
+
+Usage: python -m paddle_tpu.distributed.launch [--nproc_per_node N]
+[--master host:port] [--rank R] [--nnodes M] script.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+ELASTIC_EXIT_CODE = 101
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator host:port")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="local debug fan-out on a CPU mesh")
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference-CLI parity")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
+                                              "3")))
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Context:
+    def __init__(self, args):
+        self.args = args
+
+
+class ControllerBase:
+    """reference: launch/controllers/controller.py:35."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.procs: List[subprocess.Popen] = []
+
+    def build_env(self, local_rank: int) -> dict:
+        a = self.ctx.args
+        env = dict(os.environ)
+        nprocs = a.nnodes * a.nproc_per_node
+        rank = a.rank * a.nproc_per_node + local_rank
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_JOB_ID": a.job_id,
+        })
+        if a.master:
+            env["PADDLE_MASTER"] = a.master
+            env["JAX_COORDINATOR_ADDRESS"] = a.master
+        if a.nproc_per_node > 1:
+            # local debug fan-out: no chip sharing — force CPU mesh
+            env["JAX_PLATFORMS"] = "cpu"
+            env.setdefault("XLA_FLAGS", "")
+            env["XLA_FLAGS"] += " --xla_force_host_platform_device_count=1"
+        return env
+
+    def spawn(self):
+        a = self.ctx.args
+        os.makedirs(a.log_dir, exist_ok=True)
+        for i in range(a.nproc_per_node):
+            env = self.build_env(i)
+            log = open(os.path.join(
+                a.log_dir, f"workerlog.{env['PADDLE_TRAINER_ID']}"), "ab")
+            cmd = [sys.executable, a.training_script,
+                   *a.training_script_args]
+            self.procs.append(subprocess.Popen(cmd, env=env, stdout=log,
+                                               stderr=subprocess.STDOUT))
+
+    def watch(self) -> int:
+        """reference: controller.py:87 — poll children; first failure kills
+        the pod; exit 101 requests elastic relaunch."""
+        while True:
+            alive = False
+            for p in self.procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    self.stop()
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(0.2)
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        t0 = time.time()
+        for p in self.procs:
+            while p.poll() is None and time.time() - t0 < 10:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+        self.procs.clear()
+
+    def run(self) -> int:
+        restarts = 0
+        while True:
+            self.spawn()
+            ret = self.watch()
+            if ret == ELASTIC_EXIT_CODE and \
+                    restarts < self.ctx.args.max_restarts:
+                restarts += 1
+                continue
+            return ret
+
+
+def launch(argv: Optional[list] = None):
+    """reference: launch/main.py:23."""
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    ctl = ControllerBase(Context(args))
+    code = ctl.run()
+    if code != 0:
+        sys.exit(code)
+
+
+if __name__ == "__main__":
+    launch()

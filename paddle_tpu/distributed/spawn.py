@@ -1,0 +1,47 @@
+"""reference: python/paddle/distributed/spawn.py — multiprocess launcher.
+
+TPU-native: forks N python processes running ``func(rank)`` with the
+PADDLE_* env wired, each on a forced single-device CPU backend (chips
+cannot be shared between processes; real multi-host uses one process per
+host via the launch CLI)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Sequence
+
+
+def _worker(func, rank, nprocs, args, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    func(*args)
+
+
+def spawn(func, args=(), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    ctx = mp.get_context("spawn")
+    procs = []
+    env = {k: v for k, v in os.environ.items() if k.startswith("PADDLE_")}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Ctx:
+        processes = procs
+
+        def join(self, timeout: Optional[float] = None):
+            for p in procs:
+                p.join(timeout)
+            for p in procs:
+                if p.exitcode not in (0, None):
+                    raise RuntimeError(
+                        f"spawned process exited with {p.exitcode}")
+
+    c = Ctx()
+    if join:
+        c.join()
+    return c

@@ -1,0 +1,18 @@
+"""paddle.profiler parity (reference: python/paddle/profiler/profiler.py:358
+Profiler, scheduler states :89, profiler_statistic.py summary,
+timer.py benchmark; native paddle/fluid/platform/profiler/ HostTracer +
+CudaTracer/CUPTI + chrometracing_logger.cc).
+
+TPU-native: host-side events via RecordEvent (perf_counter spans, the
+HostTracer analog), device-side via jax.profiler (XLA/xprof traces — the
+CUPTI analog), chrome-trace JSON export, and summary tables aggregated per
+event name. The scheduler (CLOSED/READY/RECORD/RECORD_AND_RETURN) and
+make_scheduler/export_chrome_tracing helpers mirror the reference API.
+"""
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, make_scheduler,
+    export_chrome_tracing, RecordEvent, load_profiler_result,
+)
+from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401
+from .utils import benchmark  # noqa: F401
+from . import timer  # noqa: F401

@@ -1,0 +1,63 @@
+"""Summary statistics tables (reference: python/paddle/profiler/
+profiler_statistic.py — per-event aggregation + formatted report)."""
+from __future__ import annotations
+
+import collections
+import enum
+from typing import List
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+_UNITS = {"s": 1e-9, "ms": 1e-6, "us": 1e-3, "ns": 1.0}
+
+
+class StatisticData:
+    def __init__(self, events, step_times=None):
+        self.events = events
+        self.step_times = step_times or []
+
+    def aggregate(self):
+        agg = collections.OrderedDict()
+        for e in self.events:
+            d = agg.setdefault(e.name, {"calls": 0, "total": 0.0,
+                                        "max": 0.0, "min": float("inf")})
+            d["calls"] += 1
+            d["total"] += e.duration
+            d["max"] = max(d["max"], e.duration)
+            d["min"] = min(d["min"], e.duration)
+        return agg
+
+    def report(self, time_unit="ms") -> str:
+        scale = _UNITS[time_unit]
+        agg = self.aggregate()
+        lines = []
+        if self.step_times:
+            import statistics as st
+            lines.append(
+                f"steps: {len(self.step_times)}  "
+                f"avg step: {st.mean(self.step_times) * 1e3:.3f} ms")
+        header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>16}"
+                  f"{'Avg(' + time_unit + ')':>14}"
+                  f"{'Max(' + time_unit + ')':>14}"
+                  f"{'Min(' + time_unit + ')':>14}")
+        lines.append("-" * len(header))
+        lines.append(header)
+        lines.append("-" * len(header))
+        for name, d in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
+            lines.append(
+                f"{name[:40]:<40}{d['calls']:>8}"
+                f"{d['total'] * scale:>16.4f}"
+                f"{d['total'] / d['calls'] * scale:>14.4f}"
+                f"{d['max'] * scale:>14.4f}{d['min'] * scale:>14.4f}")
+        lines.append("-" * len(header))
+        return "\n".join(lines)

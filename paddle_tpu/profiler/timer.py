@@ -1,0 +1,60 @@
+"""Benchmark step timer (reference: python/paddle/profiler/timer.py —
+Benchmark with reader/batch cost and ips)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class _Stat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self._last = None
+
+    def record(self, v):
+        self.total += v
+        self.count += 1
+
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self._start = None
+        self._step_start = None
+        self.batch_cost = _Stat()
+        self.ips_stat = _Stat()
+        self.current_event = self
+
+    def begin(self):
+        self._start = time.perf_counter()
+        self._step_start = self._start
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._step_start is not None:
+            dt = now - self._step_start
+            self.batch_cost.record(dt)
+            if num_samples:
+                self.ips_stat.record(num_samples / dt)
+        self._step_start = now
+
+    def end(self):
+        self._start = None
+
+    def step_info(self, unit: str = "samples") -> str:
+        return (f"batch_cost: {self.batch_cost.avg():.5f} s  "
+                f"ips: {self.ips_stat.avg():.3f} {unit}/s")
+
+
+_bench = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """reference: timer.py benchmark() singleton."""
+    return _bench

@@ -1,0 +1,12 @@
+"""reference: python/paddle/profiler/utils.py."""
+from .timer import benchmark  # noqa: F401
+from .profiler import RecordEvent  # noqa: F401
+
+
+def in_profiler_mode() -> bool:
+    from .profiler import _collector
+    return _collector.enabled
+
+
+def wrap_optimizers():  # API parity no-op: RecordEvent hooks are explicit
+    pass
