@@ -1,0 +1,27 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/ —
+9.3k LoC: Distribution base distribution.py, Normal, Uniform, Categorical,
+Bernoulli, Beta, Dirichlet, Gamma, Exponential, Laplace, LogNormal,
+Multinomial, Gumbel, Geometric, Cauchy, StudentT, kl.py kl_divergence +
+register_kl, transform.py, TransformedDistribution, Independent).
+
+TPU-native: sampling uses the framework's stateless PRNG stream
+(_core.random) folded per draw; densities are jnp compositions that jit
+and batch. API: sample/rsample(shape), log_prob, prob, entropy, mean,
+variance, kl_divergence.
+"""
+from .distribution import Distribution  # noqa: F401
+from .normal import Normal, LogNormal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .categorical import Categorical, Multinomial  # noqa: F401
+from .bernoulli import Bernoulli, Geometric  # noqa: F401
+from .beta import Beta, Dirichlet, Gamma, Exponential  # noqa: F401
+from .laplace import Laplace, Gumbel, Cauchy  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from . import transform  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AffineTransform, ExpTransform, SigmoidTransform,
+    TanhTransform, AbsTransform, PowerTransform, SoftmaxTransform,
+    ChainTransform,
+)

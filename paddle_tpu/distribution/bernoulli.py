@@ -1,0 +1,66 @@
+"""reference: python/paddle/distribution/bernoulli.py, geometric.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _key
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.p = _t(probs)
+        super().__init__(batch_shape=self.p.shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.p, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.p * (1 - self.p), _internal=True)
+
+    def _sample(self, shape):
+        return jax.random.bernoulli(
+            _key(), self.p, self._extend(shape)).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        eps = 1e-12
+        return v * jnp.log(self.p + eps) + (1 - v) * jnp.log1p(-self.p + eps)
+
+    def _entropy(self):
+        eps = 1e-12
+        return -(self.p * jnp.log(self.p + eps) +
+                 (1 - self.p) * jnp.log1p(-self.p + eps))
+
+
+class Geometric(Distribution):
+    """reference: python/paddle/distribution/geometric.py — #failures before
+    first success, support {0, 1, ...}."""
+
+    def __init__(self, probs, name=None):
+        self.p = _t(probs)
+        super().__init__(batch_shape=self.p.shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor((1 - self.p) / self.p, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor((1 - self.p) / self.p ** 2, _internal=True)
+
+    def _sample(self, shape):
+        u = jax.random.uniform(_key(), self._extend(shape), minval=1e-12)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.p))
+
+    def _log_prob(self, v):
+        return v * jnp.log1p(-self.p) + jnp.log(self.p)
+
+    def _entropy(self):
+        q = 1 - self.p
+        return -(q * jnp.log(q) + self.p * jnp.log(self.p)) / self.p

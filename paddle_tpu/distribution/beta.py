@@ -1,0 +1,137 @@
+"""reference: python/paddle/distribution/{beta,dirichlet,gamma,
+exponential}.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, digamma
+
+from .distribution import Distribution, _t, _key
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.alpha = _t(concentration)
+        self.rate = _t(rate)
+        shape = jnp.broadcast_shapes(self.alpha.shape, self.rate.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.alpha / self.rate, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.alpha / self.rate ** 2, _internal=True)
+
+    def _sample(self, shape):
+        g = jax.random.gamma(_key(), self.alpha, self._extend(shape))
+        return g / self.rate
+
+    def _log_prob(self, v):
+        a, b = self.alpha, self.rate
+        return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - gammaln(a)
+
+    def _entropy(self):
+        a, b = self.alpha, self.rate
+        return a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(1.0 / self.rate, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor(1.0 / self.rate ** 2, _internal=True)
+
+    def _sample(self, shape):
+        return jax.random.exponential(
+            _key(), self._extend(shape)) / self.rate
+
+    def _log_prob(self, v):
+        return jnp.log(self.rate) - self.rate * v
+
+    def _entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        shape = jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.alpha / (self.alpha + self.beta), _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)),
+                      _internal=True)
+
+    def _sample(self, shape):
+        return jax.random.beta(_key(), self.alpha, self.beta,
+                               self._extend(shape))
+
+    def _log_prob(self, v):
+        a, b = self.alpha, self.beta
+        lbeta = gammaln(a) + gammaln(b) - gammaln(a + b)
+        return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+    def _entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = gammaln(a) + gammaln(b) - gammaln(a + b)
+        return (lbeta - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.alpha = _t(concentration)
+        super().__init__(batch_shape=self.alpha.shape[:-1],
+                         event_shape=self.alpha.shape[-1:])
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.alpha / jnp.sum(self.alpha, -1, keepdims=True),
+                      _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        a0 = jnp.sum(self.alpha, -1, keepdims=True)
+        m = self.alpha / a0
+        return Tensor(m * (1 - m) / (a0 + 1), _internal=True)
+
+    def _sample(self, shape):
+        return jax.random.dirichlet(_key(), self.alpha,
+                                    tuple(shape) + self.batch_shape)
+
+    def _log_prob(self, v):
+        a = self.alpha
+        lnorm = jnp.sum(gammaln(a), -1) - gammaln(jnp.sum(a, -1))
+        return jnp.sum((a - 1) * jnp.log(v), -1) - lnorm
+
+    def _entropy(self):
+        a = self.alpha
+        a0 = jnp.sum(a, -1)
+        K = a.shape[-1]
+        lnorm = jnp.sum(gammaln(a), -1) - gammaln(a0)
+        return (lnorm + (a0 - K) * digamma(a0)
+                - jnp.sum((a - 1) * digamma(a), -1))

@@ -1,0 +1,85 @@
+"""reference: python/paddle/distribution/categorical.py, multinomial.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _key
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _t(logits)
+        elif probs is not None:
+            self.logits = jnp.log(_t(probs) + 1e-30)
+        else:
+            raise ValueError("provide logits or probs")
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def probs_param(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def _sample(self, shape):
+        return jax.random.categorical(
+            _key(), self.logits,
+            shape=tuple(shape) + self.logits.shape[:-1])
+
+    def _log_prob(self, v):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def probs(self, value):
+        from .._core.tensor import Tensor
+        p = self.probs_param
+        return Tensor(jnp.take_along_axis(
+            p, _t(value).astype(jnp.int32)[..., None], axis=-1)[..., 0],
+            _internal=True)
+
+
+class Multinomial(Distribution):
+    """reference: python/paddle/distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.p = _t(probs)
+        self.p = self.p / jnp.sum(self.p, axis=-1, keepdims=True)
+        super().__init__(batch_shape=self.p.shape[:-1],
+                         event_shape=self.p.shape[-1:])
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.total_count * self.p, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.total_count * self.p * (1 - self.p),
+                      _internal=True)
+
+    def _sample(self, shape):
+        logits = jnp.log(self.p + 1e-30)
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        K = self.p.shape[-1]
+        onehot = jax.nn.one_hot(draws, K)
+        return jnp.sum(onehot, axis=0)
+
+    def _log_prob(self, v):
+        from jax.scipy.special import gammaln
+        n = self.total_count
+        return (gammaln(n + 1.0) - jnp.sum(gammaln(v + 1.0), axis=-1)
+                + jnp.sum(v * jnp.log(self.p + 1e-30), axis=-1))
+
+    def _entropy(self):
+        # no closed form; Monte-Carlo estimate (matches reference docs note)
+        s = self._sample((64,))
+        return -jnp.mean(self._log_prob(s), axis=0)

@@ -1,0 +1,74 @@
+"""Distribution base (reference: python/paddle/distribution/distribution.py
+class Distribution)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .._core import random as _random
+from ..ops._registry import as_tensor
+
+
+def _key():
+    """Next PRNG key from the framework's global stateless stream."""
+    return _random.next_rng_key()
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x._value.astype(jnp.float32) \
+            if jnp.issubdtype(x._value.dtype, jnp.floating) else x._value
+    return jnp.asarray(np.asarray(x), jnp.float32) \
+        if not isinstance(x, jax.Array) else x
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()):  # not reparameterized
+        return Tensor(jax.lax.stop_gradient(
+            self._sample(tuple(shape))), _internal=True)
+
+    def rsample(self, shape: Sequence[int] = ()):
+        return Tensor(self._sample(tuple(shape)), _internal=True)
+
+    def _sample(self, shape):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        return Tensor(self._log_prob(_t(value)), _internal=True)
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self._log_prob(_t(value))), _internal=True)
+
+    def entropy(self):
+        return Tensor(self._entropy(), _internal=True)
+
+    def kl_divergence(self, other: "Distribution"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape

@@ -1,0 +1,37 @@
+"""reference: python/paddle/distribution/independent.py — reinterpret
+batch dims as event dims."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution
+
+
+class Independent(Distribution):
+    def __init__(self, base: Distribution,
+                 reinterpreted_batch_rank: int = 1):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(batch_shape=bs[:len(bs) - self.rank],
+                         event_shape=bs[len(bs) - self.rank:]
+                         + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def _sample(self, shape):
+        return self.base._sample(shape)
+
+    def _log_prob(self, v):
+        lp = self.base._log_prob(v)
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def _entropy(self):
+        e = self.base._entropy()
+        return jnp.sum(e, axis=tuple(range(-self.rank, 0)))

@@ -1,0 +1,92 @@
+"""reference: python/paddle/distribution/{laplace,gumbel,cauchy}.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _key
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape),
+                      _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self.batch_shape), _internal=True)
+
+    def _sample(self, shape):
+        return jax.random.laplace(
+            _key(), self._extend(shape)) * self.scale + self.loc
+
+    def _log_prob(self, v):
+        return -jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self.batch_shape)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=shape)
+
+    _EULER = 0.5772156649015329
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(self.loc + self.scale * self._EULER, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2, _internal=True)
+
+    def _sample(self, shape):
+        return jax.random.gumbel(
+            _key(), self._extend(shape)) * self.scale + self.loc
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1 + self._EULER,
+                                self.batch_shape)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=shape)
+
+    def _sample(self, shape):
+        return jax.random.cauchy(
+            _key(), self._extend(shape)) * self.scale + self.loc
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self.batch_shape)

@@ -1,0 +1,84 @@
+"""reference: python/paddle/distribution/normal.py, lognormal.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _key
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape),
+                      _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape),
+                      _internal=True)
+
+    @property
+    def stddev(self):
+        from .._core.tensor import Tensor
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape),
+                      _internal=True)
+
+    def _sample(self, shape):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return self.loc + self.scale * eps
+
+    def _log_prob(self, v):
+        var = self.scale ** 2
+        return (-((v - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def _entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class LogNormal(Distribution):
+    """reference: python/paddle/distribution/lognormal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2),
+                      _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2),
+                      _internal=True)
+
+    def _sample(self, shape):
+        return jnp.exp(self._base._sample(shape))
+
+    def _log_prob(self, v):
+        return self._base._log_prob(jnp.log(v)) - jnp.log(v)
+
+    def _entropy(self):
+        return self._base._entropy() + self.loc

@@ -1,0 +1,23 @@
+"""reference: python/paddle/distribution/transformed_distribution.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution
+from .transform import Transform, ChainTransform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        self.transform = transforms if isinstance(transforms, Transform) \
+            else ChainTransform(list(transforms))
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def _sample(self, shape):
+        return self.transform._forward(self.base._sample(shape))
+
+    def _log_prob(self, v):
+        x = self.transform._inverse(v)
+        return self.base._log_prob(x) - self.transform._fldj(x)

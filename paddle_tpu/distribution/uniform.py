@@ -1,0 +1,38 @@
+"""reference: python/paddle/distribution/uniform.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _key
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        shape = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        from .._core.tensor import Tensor
+        return Tensor((self.low + self.high) / 2, _internal=True)
+
+    @property
+    def variance(self):
+        from .._core.tensor import Tensor
+        return Tensor((self.high - self.low) ** 2 / 12, _internal=True)
+
+    def _sample(self, shape):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, v):
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self.batch_shape)

@@ -1,0 +1,16 @@
+"""paddle.quantization parity (reference: python/paddle/quantization/ —
+QuantConfig config.py, QAT qat.py, PTQ ptq.py, observers
+observers/abs_max.py, quanters quanter.py FakeQuanterWithAbsMaxObserver,
+factory.py).
+
+TPU-native: fake-quant uses the straight-through estimator expressed as
+``x + stop_gradient(q(x) - x)`` so it runs under jit and trains; int8
+simulation targets the MXU's int8 mode for deployment.
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import AbsmaxObserver, ObserverFactory  # noqa: F401
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver, quant, dequant, fake_quant,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401

@@ -1,0 +1,41 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py) — maps
+layers/types/names to (activation quanter, weight quanter) policies."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global = (activation, weight)
+        self._by_layer: List[Tuple[object, object, object]] = []
+        self._by_type: Dict[type, Tuple[object, object]] = {}
+        self._by_name: Dict[str, Tuple[object, object]] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer.append((l, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._by_type[t] = (activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._by_name[n] = (activation, weight)
+
+    def policy_for(self, name: str, layer) -> Tuple[object, object]:
+        for l, a, w in self._by_layer:
+            if l is layer:
+                return a, w
+        if name in self._by_name:
+            return self._by_name[name]
+        for t, pol in self._by_type.items():
+            if isinstance(layer, t):
+                return pol
+        return self._global

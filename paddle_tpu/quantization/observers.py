@@ -1,0 +1,49 @@
+"""Observers (reference: python/paddle/quantization/observers/abs_max.py
+AbsmaxObserver + factory.py ObserverFactory)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops._registry import as_tensor
+
+
+class ObserverFactory:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def _instance(self, layer):
+        return self._cls(**self._kwargs)
+
+
+class AbsmaxObserver(ObserverFactory):
+    """Collects running abs-max during calibration (PTQ)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits=quant_bits)
+        self._cls = AbsmaxObserverLayer
+
+
+class AbsmaxObserverLayer(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        x = as_tensor(x)
+        self._max = max(self._max, float(jnp.max(jnp.abs(x._value))))
+        return x
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._max or 1.0), _internal=True)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def zero_points(self):
+        return Tensor(jnp.zeros(()), _internal=True)

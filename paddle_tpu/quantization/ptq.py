@@ -1,0 +1,51 @@
+"""PTQ — post-training quantization (reference: python/paddle/
+quantization/ptq.py: insert observers, calibrate, convert)."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .qat import QuantedLayer
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        """Insert observers — run calibration batches through the model
+        afterwards."""
+        qat_like = __import__(
+            "paddle_tpu.quantization.qat", fromlist=["QAT"]).QAT(
+            self._config)
+        return qat_like.quantize(model, inplace)
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        """Replace observers with fixed-scale fake-quant using collected
+        scales."""
+        from .quanters import fake_quant
+
+        class _Frozen(Layer):
+            def __init__(self, inner, scale, bits):
+                super().__init__()
+                self.inner = inner
+                self._scale = scale
+                self._bits = bits
+
+            def forward(self, x):
+                return self.inner(fake_quant(x, self._scale, self._bits))
+
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, QuantedLayer):
+                parent = model
+                parts = name.split(".")
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                if sub.activation_quanter is not None and \
+                        hasattr(sub.activation_quanter, "scales"):
+                    scale = float(sub.activation_quanter.scales()._value)
+                    bits = sub.activation_quanter.bit_length()
+                    setattr(parent, parts[-1],
+                            _Frozen(sub.inner, scale, bits))
+                else:
+                    setattr(parent, parts[-1], sub.inner)
+        return model

@@ -1,0 +1,65 @@
+"""QAT — quantization-aware training (reference: python/paddle/
+quantization/qat.py QAT.quantize: wraps target layers with quant stubs)."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+
+
+class QuantedLayer(Layer):
+    """Wraps a layer: fake-quant activations in, fake-quant weight."""
+
+    def __init__(self, inner: Layer, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = (act_quanter._instance(inner)
+                                   if act_quanter else None)
+        self.weight_quanter = (weight_quanter._instance(inner)
+                               if weight_quanter else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = getattr(self.inner, "weight", None)
+        if self.weight_quanter is not None and w is not None:
+            from .quanters import fake_quant
+            import jax.numpy as jnp
+            scale = float(jnp.max(jnp.abs(w._value))) or 1.0
+            orig = w._value
+            w._value = fake_quant(w, scale)._value
+            try:
+                return self.inner(x)
+            finally:
+                w._value = orig
+        return self.inner(x)
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        targets = []
+        for name, sub in model.named_sublayers():
+            a, w = self._config.policy_for(name, sub)
+            if a is None and w is None:
+                continue
+            targets.append((name, sub, a, w))
+        for name, sub, a, w in targets:
+            parent = model
+            parts = name.split(".")
+            for p in parts[:-1]:
+                parent = getattr(parent, p)
+            setattr(parent, parts[-1], QuantedLayer(sub, a, w))
+        return model
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        """Strip quanters for export (scales were learned/observed)."""
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, QuantedLayer):
+                parent = model
+                parts = name.split(".")
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                setattr(parent, parts[-1], sub.inner)
+        return model

@@ -1,0 +1,76 @@
+"""Fake quantizers (reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver — simulated quant in forward, STE backward)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops._registry import as_tensor
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Simulated symmetric quantization with straight-through gradient:
+    x + sg(round(clip(x/s)) * s - x)."""
+    x = as_tensor(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def f(v, s):
+        s = jnp.maximum(jnp.abs(s), 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * s / qmax
+        return v + jax.lax.stop_gradient(q - v)
+    return apply(f, x, as_tensor(scale), name="fake_quant")
+
+
+def quant(x, scale, bit_length=8):
+    x = as_tensor(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(
+        lambda v, s: jnp.clip(jnp.round(v / jnp.maximum(jnp.abs(s), 1e-8)
+                                        * qmax), -qmax, qmax)
+        .astype(jnp.int8),
+        x, as_tensor(scale), name="quant")
+
+
+def dequant(x, scale, bit_length=8):
+    x = as_tensor(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(
+        lambda v, s: v.astype(jnp.float32) * jnp.abs(s) / qmax,
+        x, as_tensor(scale), name="dequant")
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """Activation fake-quant with moving-average abs-max scale
+    (reference: quanters/abs_max.py; static counterpart
+    fake_quantize_moving_average_abs_max op)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None, quant_on_weight=False):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        import jax.numpy as _j
+        self.register_buffer("scale", Tensor(_j.ones(()), _internal=True))
+        self._initialized = False
+
+    def forward(self, x):
+        x = as_tensor(x)
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._value)))
+            if not self._initialized:
+                new = cur if cur > 0 else 1.0
+                self._initialized = True
+            else:
+                r = self._moving_rate
+                new = r * float(self.scale._value) + (1 - r) * cur
+            self.scale.set_value(jnp.asarray(new, jnp.float32))
+        return fake_quant(x, self.scale, self._bit_length)
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._bit_length

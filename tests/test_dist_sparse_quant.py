@@ -1,0 +1,224 @@
+"""distribution / sparse / quantization package tests (numpy-reference
+pattern, SURVEY §4 OpTest; scipy-free closed-form checks)."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import sparse as S
+from paddle_tpu import quantization as Q
+
+
+class TestDistributions:
+    def test_normal_log_prob_entropy_kl(self):
+        n = D.Normal(0.0, 1.0)
+        # N(0,1): log_prob(0) = -0.5*log(2π)
+        np.testing.assert_allclose(float(n.log_prob(0.0).numpy()),
+                                   -0.5 * math.log(2 * math.pi), rtol=1e-6)
+        np.testing.assert_allclose(float(n.entropy().numpy()),
+                                   0.5 * (1 + math.log(2 * math.pi)),
+                                   rtol=1e-6)
+        m = D.Normal(1.0, 2.0)
+        kl = float(D.kl_divergence(n, m).numpy())
+        ref = math.log(2.0) + (1 + 1) / 8.0 - 0.5
+        np.testing.assert_allclose(kl, ref, rtol=1e-6)
+
+    def test_normal_sample_moments(self):
+        paddle.seed(0)
+        n = D.Normal(2.0, 3.0)
+        s = n.sample([20000]).numpy()
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+    def test_rsample_reparameterized_grad(self):
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        # rsample must be differentiable w.r.t. params: build dist inside
+        # a traced fn using raw jnp
+        d = D.Normal(loc, 1.0)
+        s = d.rsample([16])
+        assert s.shape == [16]
+
+    def test_uniform(self):
+        u = D.Uniform(0.0, 2.0)
+        np.testing.assert_allclose(float(u.log_prob(1.0).numpy()),
+                                   -math.log(2.0), rtol=1e-6)
+        assert float(u.log_prob(3.0).numpy()) == -np.inf
+        np.testing.assert_allclose(float(u.entropy().numpy()),
+                                   math.log(2.0), rtol=1e-6)
+
+    def test_categorical(self):
+        c = D.Categorical(logits=np.log([0.2, 0.3, 0.5]).astype(np.float32))
+        np.testing.assert_allclose(float(c.log_prob(2).numpy()),
+                                   math.log(0.5), rtol=1e-5)
+        ent = -sum(p * math.log(p) for p in (0.2, 0.3, 0.5))
+        np.testing.assert_allclose(float(c.entropy().numpy()), ent,
+                                   rtol=1e-5)
+        paddle.seed(1)
+        s = c.sample([10000]).numpy()
+        freq = np.bincount(s.astype(int), minlength=3) / 10000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    def test_bernoulli_beta_gamma(self):
+        b = D.Bernoulli(0.3)
+        np.testing.assert_allclose(float(b.mean.numpy()), 0.3)
+        np.testing.assert_allclose(float(b.log_prob(1.0).numpy()),
+                                   math.log(0.3), rtol=1e-4)
+        be = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(be.mean.numpy()), 0.4, rtol=1e-6)
+        # Beta(2,3) pdf at 0.5: x(1-x)^2 / B(2,3), B(2,3)=1/12
+        np.testing.assert_allclose(float(be.prob(0.5).numpy()),
+                                   0.5 * 0.25 * 12, rtol=1e-5)
+        g = D.Gamma(2.0, 4.0)
+        np.testing.assert_allclose(float(g.mean.numpy()), 0.5)
+        np.testing.assert_allclose(float(g.variance.numpy()), 0.125)
+
+    def test_kl_same_dist_zero(self):
+        for d in (D.Beta(2.0, 3.0), D.Gamma(2.0, 1.0),
+                  D.Laplace(0.0, 1.0), D.Exponential(2.0)):
+            kl = float(D.kl_divergence(d, d).numpy())
+            assert abs(kl) < 1e-6, type(d)
+
+    def test_laplace_gumbel(self):
+        l = D.Laplace(0.0, 1.0)
+        np.testing.assert_allclose(float(l.log_prob(0.0).numpy()),
+                                   -math.log(2.0), rtol=1e-6)
+        g = D.Gumbel(0.0, 1.0)
+        np.testing.assert_allclose(float(g.mean.numpy()), 0.5772156649,
+                                   rtol=1e-5)
+
+    def test_independent(self):
+        base = D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+        ind = D.Independent(base, 1)
+        lp = float(ind.log_prob(np.zeros(3, np.float32)).numpy())
+        np.testing.assert_allclose(lp, 3 * -0.5 * math.log(2 * math.pi),
+                                   rtol=1e-6)
+
+    def test_transformed(self):
+        base = D.Normal(0.0, 1.0)
+        ln = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = D.LogNormal(0.0, 1.0)
+        x = 1.7
+        np.testing.assert_allclose(float(ln.log_prob(x).numpy()),
+                                   float(ref.log_prob(x).numpy()),
+                                   rtol=1e-5)
+
+    def test_transforms_roundtrip(self):
+        for t in (D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform()):
+            x = np.float32(0.3)
+            y = t.forward(x)
+            back = t.inverse(y)
+            np.testing.assert_allclose(float(back.numpy()), 0.3, rtol=1e-5)
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        coo = S.to_sparse_coo(paddle.to_tensor(dense))
+        assert coo.nnz == 3
+        np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+
+    def test_csr_roundtrip(self):
+        dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        csr = S.to_sparse_csr(paddle.to_tensor(dense))
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        np.testing.assert_allclose(csr.to_coo().to_dense().numpy(), dense)
+
+    def test_create_coo(self):
+        coo = S.sparse_coo_tensor([[0, 1], [1, 0]], [10.0, 20.0], [2, 2])
+        np.testing.assert_allclose(coo.to_dense().numpy(),
+                                   [[0, 10], [20, 0]])
+
+    def test_unary_preserves_structure(self):
+        coo = S.sparse_coo_tensor([[0, 1], [1, 0]], [-1.0, 2.0], [2, 2])
+        r = S.relu(coo)
+        assert isinstance(r, S.SparseCooTensor)
+        np.testing.assert_allclose(r.to_dense().numpy(), [[0, 0], [2, 0]])
+
+    def test_add_same_pattern(self):
+        a = S.sparse_coo_tensor([[0, 1], [1, 0]], [1.0, 2.0], [2, 2])
+        b = S.sparse_coo_tensor([[0, 1], [1, 0]], [10.0, 20.0], [2, 2])
+        c = S.add(a, b)
+        np.testing.assert_allclose(c.to_dense().numpy(), [[0, 11], [22, 0]])
+
+    def test_spmm_matches_dense(self):
+        rng = np.random.default_rng(0)
+        dense = rng.standard_normal((4, 5)).astype(np.float32)
+        dense[dense < 0.3] = 0
+        y = rng.standard_normal((5, 3)).astype(np.float32)
+        coo = S.to_sparse_coo(paddle.to_tensor(dense))
+        out = S.matmul(coo, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((4, 3)).astype(np.float32)
+        mask = S.sparse_coo_tensor([[0, 2], [1, 2]], [1.0, 1.0], [3, 3])
+        out = S.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        full = x @ y
+        np.testing.assert_allclose(
+            np.asarray(out.values), [full[0, 1], full[2, 2]], rtol=1e-5)
+
+    def test_sparse_softmax(self):
+        coo = S.sparse_coo_tensor([[0, 0, 1], [0, 1, 1]],
+                                  [1.0, 1.0, 5.0], [2, 2])
+        sm = S.nn.Softmax()(coo)
+        np.testing.assert_allclose(np.asarray(sm.values), [0.5, 0.5, 1.0],
+                                   rtol=1e-5)
+
+
+class TestQuantization:
+    def test_fake_quant_values(self):
+        x = paddle.to_tensor(np.array([0.0, 0.5, 1.0, -1.0], np.float32))
+        out = Q.fake_quant(x, 1.0, bit_length=8)
+        # scale 1, 127 levels: q(0.5) = round(63.5)/127
+        np.testing.assert_allclose(out.numpy()[1], round(0.5 * 127) / 127,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out.numpy()[2], 1.0, rtol=1e-6)
+
+    def test_ste_gradient(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                             stop_gradient=False)
+        out = Q.fake_quant(x, 1.0)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+    def test_quant_dequant_roundtrip(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+        q = Q.quant(x, 1.0)
+        assert q.numpy().dtype == np.int8
+        dq = Q.dequant(q, 1.0)
+        np.testing.assert_allclose(dq.numpy(), x.numpy(), atol=1.0 / 127)
+
+    def test_qat_quantize_and_train(self):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver(), weight=None)
+        cfg.add_type_config(nn.Linear, activation=Q.AbsmaxObserver())
+        qat = Q.QAT(cfg)
+        qnet = qat.quantize(net)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        out = qnet(x)
+        assert out.shape == [2, 2]
+        back = qat.convert(qnet)
+        assert back(x).shape == [2, 2]
+
+    def test_ptq_calibrate_convert(self):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(4, 4))
+        cfg = Q.QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(nn.Linear, activation=Q.AbsmaxObserver())
+        ptq = Q.PTQ(cfg)
+        qnet = ptq.quantize(net)
+        for _ in range(3):
+            qnet(paddle.to_tensor(
+                np.random.randn(2, 4).astype(np.float32) * 3))
+        final = ptq.convert(qnet)
+        out = final(paddle.to_tensor(np.ones((1, 4), np.float32)))
+        assert np.isfinite(out.numpy()).all()
