@@ -46,6 +46,7 @@ from . import profiler  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
+from . import inference  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

@@ -1,0 +1,14 @@
+"""paddle.inference parity (reference: python/paddle/inference/ — Config,
+create_predictor wrapping C++ AnalysisPredictor
+paddle/fluid/inference/api/analysis_predictor.cc Run:1738 /
+ZeroCopyRun:2771, AnalysisConfig analysis_config.cc).
+
+TPU-native: the "analysis + optimization passes" of the reference are
+XLA's job — the predictor loads a jit.save artifact (params + traced
+program), jit-compiles it once per input signature (the analog of the
+predictor's optimized program cache) and serves zero-copy device arrays.
+"""
+from .predictor import (  # noqa: F401
+    Config, Predictor, Tensor as PredictorTensor, create_predictor,
+    PlaceType, PrecisionType, get_version,
+)

@@ -1,0 +1,196 @@
+"""Predictor implementation (reference: paddle/fluid/inference/api/
+analysis_predictor.h AnalysisPredictor; python surface
+python/paddle/inference/wrapper.py)."""
+from __future__ import annotations
+
+import enum
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class PlaceType(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+def get_version() -> str:
+    import paddle_tpu
+    return paddle_tpu.__version__
+
+
+class Config:
+    """reference: AnalysisConfig (paddle/fluid/inference/api/
+    analysis_config.cc). TensorRT/OneDNN toggles are accepted for parity
+    and map to XLA (always-on compilation)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self._model_path = model_path
+        self._params_path = params_path
+        self._device = "tpu" if any(
+            d.platform == "tpu" for d in jax.devices()) else "cpu"
+        self._precision = PrecisionType.Float32
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+        self._optim = True
+
+    # --- model location ---
+    def set_model(self, model_path, params_path=None):
+        self._model_path = model_path
+        self._params_path = params_path
+
+    def model_dir(self):
+        return self._model_path
+
+    def prog_file(self):
+        return self._model_path
+
+    def params_file(self):
+        return self._params_path
+
+    # --- device selection (GPU API parity maps to the TPU chip) ---
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device = device_type
+
+    # --- optimization toggles (parity no-ops: XLA optimizes always) ---
+    def switch_ir_optim(self, flag=True):
+        self._optim = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        pass
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_path}, device={self._device}, "
+                f"precision={self._precision.name})")
+
+
+class Tensor:
+    """Input/output handle (reference: ZeroCopyTensor,
+    paddle/fluid/inference/api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name: str, owner: "Predictor"):
+        self.name = name
+        self._owner = owner
+        self._value: Optional[jax.Array] = None
+
+    def reshape(self, shape):
+        pass  # shapes come from the bound array
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def share_external_data(self, arr):
+        self._value = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def type(self):
+        return self._value.dtype if self._value is not None else None
+
+
+class Predictor:
+    """reference: AnalysisPredictor. Loads a jit.save artifact (a
+    TranslatedLayer) or wraps a live Layer/function."""
+
+    def __init__(self, config: Config, layer=None):
+        self._config = config
+        if layer is None:
+            from ..jit.save_load import load as jit_load
+            layer = jit_load(config.model_dir())
+        self._layer = layer
+        self._input_names: List[str] = getattr(
+            layer, "input_names", None) or ["x"]
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n, self) for n in self._input_names}
+        self._outputs: Dict[str, Tensor] = {}
+        self._jitted = None
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """reference: AnalysisPredictor::Run / ZeroCopyRun."""
+        from .._core.tensor import Tensor as FrameworkTensor
+        if inputs is not None:
+            for n, arr in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(arr))
+        args = [FrameworkTensor(self._inputs[n]._value, _internal=True)
+                for n in self._input_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = {}
+        results = []
+        for i, o in enumerate(outs):
+            t = Tensor(f"out_{i}", self)
+            val = o._value if isinstance(o, FrameworkTensor) else jnp.asarray(o)
+            t.share_external_data(val)
+            self._outputs[t.name] = t
+            results.append(np.asarray(val))
+        if inputs is not None:
+            return results
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs.keys())
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config, layer=None) -> Predictor:
+    """reference: paddle_infer::CreatePredictor."""
+    return Predictor(config, layer=layer)

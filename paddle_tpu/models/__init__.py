@@ -10,5 +10,7 @@ sharding annotations on a ``jax.sharding.Mesh`` instead of ProcessGroup
 calls.
 """
 from . import llama  # noqa: F401
+from . import moe  # noqa: F401
+from . import generate  # noqa: F401
 from .llama import LlamaConfig  # noqa: F401
 from .train import TrainState, make_train_step, init_train_state  # noqa: F401

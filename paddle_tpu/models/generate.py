@@ -1,0 +1,145 @@
+"""Autoregressive decoding with a static KV cache, TPU-first.
+
+Capability target: the reference's serving stack (reference:
+paddle/fluid/inference/api/analysis_predictor.cc + fused decode kernels
+paddle/phi/kernels/fusion/masked_multihead_attention_kernel.cu,
+block_multi_head_attention_kernel.cu).
+
+TPU-native: ONE jitted program per phase — prefill writes the prompt's
+K/V into a preallocated (L, B, S_max, H, D) cache (static shapes; no
+dynamic growth), decode is a ``lax.scan`` over steps where each step does
+a single-token forward against the cache with a length mask. Greedy or
+temperature/top-k sampling via stateless PRNG.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import llama
+from .llama import LlamaConfig, rope_tables, apply_rope, rms_norm
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
+    L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.hd
+    return {
+        "k": jnp.zeros((L, batch, max_len, nkv, hd), cfg.dtype),
+        "v": jnp.zeros((L, batch, max_len, nkv, hd), cfg.dtype),
+    }
+
+
+def _attn_with_cache(q, ck, cv, length, nh):
+    """q (B,T,nh,hd) vs cache (B,Smax,nkv,hd); positions >= length masked.
+    length: scalar or (B,) current valid length INCLUDING q's tokens."""
+    B, T, _, hd = q.shape
+    nkv = ck.shape[2]
+    if nkv != nh:
+        ck = jnp.repeat(ck, nh // nkv, axis=2)
+        cv = jnp.repeat(cv, nh // nkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / math.sqrt(hd)
+    Smax = ck.shape[1]
+    kpos = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    # query i (global position length-T+i) attends to kpos <= its position
+    qpos = (length - T) + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(kpos <= qpos, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
+
+
+def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig):
+    """One decoder layer over T tokens starting at position ``pos``.
+    cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated."""
+    B, T, H = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = (h1 @ lp["wq"]).reshape(B, T, nh, hd)
+    k = (h1 @ lp["wk"]).reshape(B, T, nkv, hd)
+    v = (h1 @ lp["wv"]).reshape(B, T, nkv, hd)
+    q = apply_rope(q, lax.dynamic_slice_in_dim(cos, pos, T),
+                   lax.dynamic_slice_in_dim(sin, pos, T))
+    k = apply_rope(k, lax.dynamic_slice_in_dim(cos, pos, T),
+                   lax.dynamic_slice_in_dim(sin, pos, T))
+    cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(
+        cache_k.dtype), pos, axis=1)
+    cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(
+        cache_v.dtype), pos, axis=1)
+    o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh)
+    x = x + o.reshape(B, T, nh * hd) @ lp["wo"]
+    h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    g = jax.nn.silu((h2 @ lp["wg"]).astype(jnp.float32)).astype(x.dtype)
+    u = h2 @ lp["wu"]
+    return x + (g * u) @ lp["wd"], cache_k, cache_v
+
+
+def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
+                    max_len: int):
+    """tokens (B, T) at positions [pos, pos+T) -> (logits_last (B, V),
+    updated cache)."""
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
+
+    def body(carry, layer_in):
+        xc = carry
+        lp, ck, cv = layer_in
+        y, nk, nv = _block_infer(xc, lp, ck, cv, pos, cos, sin, cfg)
+        return y, (nk, nv)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, -1] @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
+             max_new_tokens: int = 32, max_len: Optional[int] = None,
+             temperature: float = 0.0, top_k: int = 0,
+             key: Optional[jax.Array] = None,
+             eos_token_id: Optional[int] = None) -> jax.Array:
+    """prompt (B, S_prompt) int32 -> (B, S_prompt + max_new_tokens).
+
+    greedy when temperature == 0, else temperature (+ optional top-k)
+    sampling. Whole decode loop is one jitted scan.
+    """
+    B, S = prompt.shape
+    total = S + max_new_tokens
+    max_len = max_len or total
+    assert max_len >= total
+    if key is None:
+        key = jax.random.key(0)
+    cache = init_cache(cfg, B, max_len)
+
+    logits, cache = _forward_cached(params, prompt, cache, 0, cfg, max_len)
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        l = logits / temperature
+        if top_k > 0:
+            kth = jnp.sort(l, axis=-1)[:, -top_k][:, None]
+            l = jnp.where(l < kth, -1e30, l)
+        return jax.random.categorical(k, l, axis=-1).astype(jnp.int32)
+
+    key, k0 = jax.random.split(key)
+    first = sample(logits, k0)
+
+    def step(carry, i):
+        cache, tok, kk = carry
+        kk, ks = jax.random.split(kk)
+        logits, cache = _forward_cached(
+            params, tok[:, None], cache, S + i, cfg, max_len)
+        nxt = sample(logits, ks)
+        return (cache, nxt, kk), nxt
+
+    (_, _, _), toks = lax.scan(
+        step, (cache, first, key), jnp.arange(max_new_tokens - 1))
+    out = jnp.concatenate(
+        [prompt, first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
+    return out
