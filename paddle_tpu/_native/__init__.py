@@ -1,0 +1,87 @@
+"""Native (C++) runtime components, built in-tree with g++ at first use.
+
+Where the reference is native, this framework is native too (SURVEY §2.1
+directive): the TCP coordination store (reference:
+paddle/phi/core/distributed/store/tcp_store.h:121) and the host data path
+(reference: paddle/fluid/framework/data_feed.cc) are C++ with ctypes
+bindings (pybind11 is not in this image). Build artifacts cache next to
+the sources; a pure-Python fallback keeps the framework importable on
+toolchain-less machines.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["store.cpp", "datapath.cpp"]
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _src_hash() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> str:
+    out = os.path.join(_DIR, f"libpaddle_tpu_native_{_src_hash()}.so")
+    if os.path.exists(out):
+        return out
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *srcs, "-o", out + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(out + ".tmp", out)
+    return out
+
+
+def load():
+    """Build (cached) + dlopen the native library; None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_build())
+        except Exception as e:  # no g++ / sandboxed build failure
+            _build_error = e
+            return None
+        # ---- signatures ----
+        lib.pt_store_server_start.restype = ctypes.c_void_p
+        lib.pt_store_server_start.argtypes = [ctypes.c_int]
+        lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pt_store_client_connect.restype = ctypes.c_int
+        lib.pt_store_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.pt_store_client_close.argtypes = [ctypes.c_int]
+        lib.pt_store_request.restype = ctypes.c_int
+        lib.pt_store_request.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int)]
+        lib.pt_store_free.argtypes = [ctypes.c_void_p]
+        lib.pt_collate.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+        lib.pt_shuffle_indices.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_normalize_nhwc_to_nchw.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

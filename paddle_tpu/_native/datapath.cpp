@@ -1,0 +1,86 @@
+// Fast host-side data path — native runtime component.
+//
+// Re-design of the reference's C++ data feed pipeline
+// (reference: paddle/fluid/framework/data_feed.cc, data_set.cc — native
+// readers/collators feeding the trainers without the GIL).
+//
+// Provides multi-threaded batch collation (stack N sample buffers into one
+// contiguous batch) and RNG-seeded index shuffling, both GIL-released hot
+// loops called from the DataLoader.
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Stack n sample buffers (each `bytes` long) into out (n*bytes).
+void pt_collate(const void** samples, int64_t n, int64_t bytes, void* out,
+                int num_threads) {
+  if (num_threads <= 1 || n < 4) {
+    for (int64_t i = 0; i < n; ++i)
+      std::memcpy(static_cast<char*>(out) + i * bytes, samples[i],
+                  static_cast<size_t>(bytes));
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t per = (n + num_threads - 1) / num_threads;
+  for (int t = 0; t < num_threads; ++t) {
+    int64_t lo = t * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i)
+        std::memcpy(static_cast<char*>(out) + i * bytes, samples[i],
+                    static_cast<size_t>(bytes));
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Fisher-Yates shuffle of [0, n) with a fixed seed (epoch-deterministic,
+// matching the reference's DistributedBatchSampler seeding).
+void pt_shuffle_indices(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  std::mt19937_64 rng(seed);
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = rng() % static_cast<uint64_t>(i + 1);
+    std::swap(out[i], out[j]);
+  }
+}
+
+// uint8 HWC image batch -> float32 NCHW with per-channel mean/std
+// (the torchvision-style normalize+transpose hot loop).
+void pt_normalize_nhwc_to_nchw(const uint8_t* in, int64_t n, int64_t h,
+                               int64_t w, int64_t c, const float* mean,
+                               const float* stdv, float* out,
+                               int num_threads) {
+  auto work = [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* img = in + i * h * w * c;
+      float* dst = out + i * c * h * w;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        float m = mean[ch], s = stdv[ch];
+        float inv = 1.0f / (255.0f * s);
+        for (int64_t p = 0; p < h * w; ++p)
+          dst[ch * h * w + p] =
+              (static_cast<float>(img[p * c + ch]) ) * inv - m / s;
+      }
+    }
+  };
+  if (num_threads <= 1 || n < 4) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t per = (n + num_threads - 1) / num_threads;
+  for (int t = 0; t < num_threads; ++t) {
+    int64_t lo = t * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,250 @@
+// TCP coordination store — native runtime component.
+//
+// Re-design of the reference's TCPStore
+// (reference: paddle/phi/core/distributed/store/tcp_store.h:121 TCPStore,
+// socket.cpp): the master rank runs a KV server; workers connect over TCP
+// for set/get/wait/add — used for rendezvous (exchanging coordinator
+// addresses / run metadata) and cross-process barriers before the JAX
+// coordination service is up.
+//
+// Protocol (all little-endian):
+//   request:  u8 op | u32 klen | key | u32 vlen | value
+//   ops: 0=SET 1=GET 2=WAIT(blocking get) 3=ADD(i64 delta) 4=PING
+//   response: u32 vlen | value   (ADD returns 8-byte i64; PING echoes)
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct KVState {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  KVState kv;
+  std::mutex handlers_mu;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_value(int fd, const std::string& v) {
+  uint32_t len = static_cast<uint32_t>(v.size());
+  if (!write_exact(fd, &len, 4)) return false;
+  return v.empty() || write_exact(fd, v.data(), v.size());
+}
+
+void handle_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (s->running.load()) {
+    uint8_t op;
+    if (!read_exact(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_exact(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, key.data(), klen)) break;
+    uint32_t vlen;
+    if (!read_exact(fd, &vlen, 4)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_exact(fd, val.data(), vlen)) break;
+
+    if (op == 0) {  // SET
+      {
+        std::lock_guard<std::mutex> g(s->kv.mu);
+        s->kv.data[key] = val;
+      }
+      s->kv.cv.notify_all();
+      if (!send_value(fd, "")) break;
+    } else if (op == 1) {  // GET (non-blocking; empty if missing)
+      std::string out;
+      {
+        std::lock_guard<std::mutex> g(s->kv.mu);
+        auto it = s->kv.data.find(key);
+        if (it != s->kv.data.end()) out = it->second;
+      }
+      if (!send_value(fd, out)) break;
+    } else if (op == 2) {  // WAIT: block until key exists
+      std::unique_lock<std::mutex> g(s->kv.mu);
+      s->kv.cv.wait(g, [&] {
+        return !s->running.load() ||
+               s->kv.data.find(key) != s->kv.data.end();
+      });
+      std::string out;
+      auto it = s->kv.data.find(key);
+      if (it != s->kv.data.end()) out = it->second;
+      g.unlock();
+      if (!send_value(fd, out)) break;
+    } else if (op == 3) {  // ADD: value is i64 delta; returns new value
+      int64_t delta = 0;
+      std::memcpy(&delta, val.data(), std::min<size_t>(8, val.size()));
+      int64_t cur = 0;
+      {
+        std::lock_guard<std::mutex> g(s->kv.mu);
+        auto it = s->kv.data.find(key);
+        if (it != s->kv.data.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::string stored(8, '\0');
+        std::memcpy(stored.data(), &cur, 8);
+        s->kv.data[key] = stored;
+      }
+      s->kv.cv.notify_all();
+      std::string out(8, '\0');
+      std::memcpy(out.data(), &cur, 8);
+      if (!send_value(fd, out)) break;
+    } else if (op == 4) {  // PING
+      if (!send_value(fd, "pong")) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* pt_store_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) { delete s; return nullptr; }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->running.store(true);
+  s->accept_thread = std::thread([s] {
+    while (s->running.load()) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> g(s->handlers_mu);
+      s->handlers.emplace_back(handle_conn, s, fd);
+    }
+  });
+  return s;
+}
+
+void pt_store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return;
+  s->running.store(false);
+  s->kv.cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(s->handlers_mu);
+    for (auto& t : s->handlers)
+      if (t.joinable()) t.join();
+  }
+  delete s;
+}
+
+// ---- client ----
+int pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  // retry until timeout (master may not be up yet — reference behavior)
+  int waited = 0;
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) != 0) {
+    ::close(fd);
+    if (waited >= timeout_ms) return -1;
+    ::usleep(100 * 1000);
+    waited += 100;
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void pt_store_client_close(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+// request; returns malloc'd value via out params. rc 0 ok, -1 io error.
+int pt_store_request(int fd, int op, const char* key, int klen,
+                     const char* val, int vlen, char** out, int* out_len) {
+  uint8_t op8 = static_cast<uint8_t>(op);
+  uint32_t kl = static_cast<uint32_t>(klen);
+  uint32_t vl = static_cast<uint32_t>(vlen);
+  if (!write_exact(fd, &op8, 1) || !write_exact(fd, &kl, 4) ||
+      (kl && !write_exact(fd, key, kl)) || !write_exact(fd, &vl, 4) ||
+      (vl && !write_exact(fd, val, vl)))
+    return -1;
+  uint32_t rlen;
+  if (!read_exact(fd, &rlen, 4)) return -1;
+  char* buf = static_cast<char*>(::malloc(rlen ? rlen : 1));
+  if (rlen && !read_exact(fd, buf, rlen)) {
+    ::free(buf);
+    return -1;
+  }
+  *out = buf;
+  *out_len = static_cast<int>(rlen);
+  return 0;
+}
+
+void pt_store_free(void* p) { ::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,226 @@
+"""TCPStore — rendezvous / KV coordination (reference:
+paddle/phi/core/distributed/store/tcp_store.h:121 TCPStore, store.h:24
+Store; python surface python/paddle/distributed/communication/...).
+
+Native C++ server/client (paddle_tpu/_native/store.cpp) via ctypes; a
+pure-Python socket fallback keeps the API alive without a toolchain. API
+parity: set/get/wait/add + barrier helper.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from .. import _native
+
+_OP_SET, _OP_GET, _OP_WAIT, _OP_ADD, _OP_PING = 0, 1, 2, 3, 4
+
+
+class Store:
+    """reference: store/store.h:24 — abstract base."""
+
+    def set(self, key: str, value: bytes):
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def wait(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+
+class TCPStore(Store):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        self._lib = _native.load()
+        self._server = None
+        self._py_server = None
+        self.world_size = world_size
+        if is_master:
+            port = port or _free_port()
+            if self._lib is not None:
+                self._server = self._lib.pt_store_server_start(port)
+                if not self._server:
+                    raise RuntimeError(f"TCPStore bind failed on {port}")
+            else:
+                self._py_server = _PyServer(port)
+        self.host, self.port = host, port
+        deadline = int(timeout * 1000)
+        if self._lib is not None:
+            self._fd = self._lib.pt_store_client_connect(
+                host.encode(), port, deadline)
+            if self._fd < 0:
+                raise RuntimeError(f"TCPStore connect to {host}:{port} "
+                                   f"failed")
+            self._sock = None
+        else:
+            self._fd = -1
+            self._sock = _py_connect(host, port, timeout)
+        self._io_lock = threading.Lock()
+
+    # ---- protocol ----
+    def _request(self, op: int, key: str, val: bytes = b"") -> bytes:
+        with self._io_lock:
+            if self._lib is not None:
+                out = ctypes.c_char_p()
+                out_len = ctypes.c_int()
+                rc = self._lib.pt_store_request(
+                    self._fd, op, key.encode(), len(key.encode()), val,
+                    len(val), ctypes.byref(out), ctypes.byref(out_len))
+                if rc != 0:
+                    raise RuntimeError("TCPStore io error")
+                data = ctypes.string_at(out, out_len.value)
+                self._lib.pt_store_free(out)
+                return data
+            return _py_request(self._sock, op, key, val)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._request(_OP_SET, key, bytes(value))
+
+    def get(self, key: str) -> bytes:
+        return self._request(_OP_GET, key)
+
+    def wait(self, key: str) -> bytes:
+        return self._request(_OP_WAIT, key)
+
+    def add(self, key: str, amount: int) -> int:
+        out = self._request(_OP_ADD, key, struct.pack("<q", amount))
+        return struct.unpack("<q", out)[0]
+
+    def ping(self) -> bool:
+        return self._request(_OP_PING, "") == b"pong"
+
+    def barrier(self, name: str = "barrier", timeout: float = 60.0):
+        """All world_size participants block until everyone arrived."""
+        n = self.add(f"__b_{name}", 1)
+        if n == self.world_size:
+            self.set(f"__b_{name}_done", b"1")
+        else:
+            t0 = time.time()
+            while time.time() - t0 < timeout:
+                if self.get(f"__b_{name}_done") == b"1":
+                    return
+                time.sleep(0.01)
+            raise TimeoutError(f"barrier {name}")
+
+    def __del__(self):
+        try:
+            if self._lib is not None:
+                if self._fd >= 0:
+                    self._lib.pt_store_client_close(self._fd)
+                if self._server:
+                    self._lib.pt_store_server_stop(self._server)
+            elif self._sock is not None:
+                self._sock.close()
+        except Exception:
+            pass
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+# ---- pure-python fallback (no g++) ----
+def _py_connect(host, port, timeout):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            s = socket.create_connection((host, port), timeout=5)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+def _py_request(sock, op, key, val):
+    k = key.encode()
+    sock.sendall(struct.pack("<BI", op, len(k)) + k +
+                 struct.pack("<I", len(val)) + val)
+    ln = struct.unpack("<I", _recv_exact(sock, 4))[0]
+    return _recv_exact(sock, ln)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store closed")
+        buf += chunk
+    return buf
+
+
+class _PyServer:
+    """Python fallback server speaking the same wire protocol."""
+
+    def __init__(self, port):
+        self.data = {}
+        self.cv = threading.Condition()
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", port))
+        self.sock.listen(128)
+        self.running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self.running:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op, klen = struct.unpack("<BI", _recv_exact(conn, 5))
+                key = _recv_exact(conn, klen).decode()
+                vlen = struct.unpack("<I", _recv_exact(conn, 4))[0]
+                val = _recv_exact(conn, vlen)
+                if op == _OP_SET:
+                    with self.cv:
+                        self.data[key] = val
+                        self.cv.notify_all()
+                    out = b""
+                elif op == _OP_GET:
+                    out = self.data.get(key, b"")
+                elif op == _OP_WAIT:
+                    with self.cv:
+                        self.cv.wait_for(lambda: key in self.data)
+                        out = self.data[key]
+                elif op == _OP_ADD:
+                    delta = struct.unpack("<q", val.ljust(8, b"\0"))[0]
+                    with self.cv:
+                        cur = struct.unpack(
+                            "<q", self.data.get(key, b"\0" * 8))[0] + delta
+                        self.data[key] = struct.pack("<q", cur)
+                        self.cv.notify_all()
+                    out = struct.pack("<q", cur)
+                elif op == _OP_PING:
+                    out = b"pong"
+                else:
+                    return
+                conn.sendall(struct.pack("<I", len(out)) + out)
+        except (ConnectionError, struct.error, OSError):
+            pass
+        finally:
+            conn.close()

@@ -24,7 +24,8 @@ def default_collate_fn(batch):
     """reference: io/dataloader/collate.py default_collate_fn."""
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch, axis=0))
+        from .native_collate import collate_stack
+        return Tensor(collate_stack(batch))
     if isinstance(sample, Tensor):
         from ..ops.manipulation import stack
         return stack(batch, axis=0)

@@ -1,0 +1,70 @@
+"""Native host data path wrappers (reference:
+paddle/fluid/framework/data_feed.cc — C++ feed/collate without the GIL).
+
+numpy-facing helpers over _native/datapath.cpp; fall back to numpy when
+the native lib is unavailable."""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import _native
+
+
+def collate_stack(samples: Sequence[np.ndarray],
+                  num_threads: int = 0) -> np.ndarray:
+    """np.stack(samples) through the native multi-threaded memcpy path."""
+    lib = _native.load()
+    arrs = [np.ascontiguousarray(s) for s in samples]
+    if lib is None or not arrs:
+        return np.stack(arrs)
+    first = arrs[0]
+    if any(a.shape != first.shape or a.dtype != first.dtype
+           for a in arrs[1:]):
+        return np.stack(arrs)
+    n = len(arrs)
+    out = np.empty((n,) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+    nt = num_threads or min(8, os.cpu_count() or 1)
+    lib.pt_collate(ptrs, n, first.nbytes, out.ctypes.data_as(
+        ctypes.c_void_p), nt)
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    lib = _native.load()
+    if lib is None:
+        rng = np.random.default_rng(seed)
+        return rng.permutation(n).astype(np.int64)
+    out = np.empty(n, np.int64)
+    lib.pt_shuffle_indices(n, seed,
+                           out.ctypes.data_as(
+                               ctypes.POINTER(ctypes.c_int64)))
+    return out
+
+
+def normalize_images(batch_u8_nhwc: np.ndarray, mean: Sequence[float],
+                     std: Sequence[float],
+                     num_threads: int = 0) -> np.ndarray:
+    """uint8 NHWC -> float32 NCHW with (x/255 - mean)/std, native loop."""
+    lib = _native.load()
+    x = np.ascontiguousarray(batch_u8_nhwc, np.uint8)
+    n, h, w, c = x.shape
+    m = np.asarray(mean, np.float32)
+    s = np.asarray(std, np.float32)
+    if lib is None:
+        f = x.astype(np.float32) / 255.0
+        f = (f - m) / s
+        return np.ascontiguousarray(f.transpose(0, 3, 1, 2))
+    out = np.empty((n, c, h, w), np.float32)
+    nt = num_threads or min(8, os.cpu_count() or 1)
+    lib.pt_normalize_nhwc_to_nchw(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, h, w, c,
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nt)
+    return out
