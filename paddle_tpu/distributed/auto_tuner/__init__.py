@@ -1,0 +1,3 @@
+from .tuner import AutoTuner  # noqa: F401
+from .cost_model import estimate_step_time  # noqa: F401
+from .memory_cost_model import estimate_memory_gb  # noqa: F401

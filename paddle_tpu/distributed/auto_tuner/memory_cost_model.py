@@ -1,0 +1,37 @@
+"""Per-chip memory model (reference: python/paddle/distributed/auto_tuner/
+memory_cost_model.py get_model_memory_usage) for pruning parallel configs.
+
+Accounts: params (model dtype) + fp32 master/m/v (ZeRO-sharded over the
+sharding degree), fp32 grads (transient), activations under remat
+(per-layer boundary activations / pp / cp), logits chunk.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+
+def estimate_memory_gb(model: Dict, cfg: Dict, *, bytes_per_param: int = 2,
+                       seq_chunk: int = 512) -> float:
+    """model: {num_params, num_layers, hidden, vocab, seq_len,
+    micro_batch}; cfg: {dp, tp, pp, sharding, cp(optional)}."""
+    n = model["num_params"]
+    tp, pp = cfg.get("tp", 1), cfg.get("pp", 1)
+    sh = max(cfg.get("sharding", 1), 1)
+    cp = cfg.get("cp", 1)
+    mb = model.get("micro_batch", 1)
+    S = model["seq_len"]
+    H = model["hidden"]
+    L = model["num_layers"]
+    V = model["vocab"]
+
+    n_local = n / (tp * pp)                      # tensor+pipeline split
+    params = n_local * bytes_per_param
+    # fp32 master + adam m/v, ZeRO over the sharding axis
+    opt = n_local * 12 / sh
+    grads = n_local * 4                          # transient fp32
+    # remat: keep per-layer boundary activations (L/pp of them)
+    act = (L / pp) * mb * (S / cp) * H * bytes_per_param
+    # working set of one layer recompute + chunked logits
+    work = mb * (S / cp) * max(4 * H, seq_chunk * 0) * 4
+    logits = mb * seq_chunk * (V / tp) * 4
+    return (params + opt + grads + act + work + logits) / 1e9

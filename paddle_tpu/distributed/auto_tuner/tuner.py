@@ -1,0 +1,107 @@
+"""AutoTuner (reference: python/paddle/distributed/auto_tuner/tuner.py:21
+AutoTuner — grid/prune search over dp/mp/pp/sharding candidates, ranked by
+cost; utils.py candidate generation + pruning).
+
+Usage:
+    tuner = AutoTuner(model_desc, world_size=64, hbm_gb=16)
+    cfg = tuner.search_once()          # best unexplored candidate
+    tuner.update(cfg, observed_tps)    # feed measurement back
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from .cost_model import estimate_step_time
+from .memory_cost_model import estimate_memory_gb
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    def __init__(self, model: Dict, world_size: int, hbm_gb: float = 16.0,
+                 chip: str = "v5e", tuner_cfg: Optional[Dict] = None):
+        self.model = model
+        self.world_size = world_size
+        self.hbm_gb = hbm_gb
+        self.chip = chip
+        self.tuner_cfg = tuner_cfg or {}
+        self.history: Dict[tuple, float] = {}
+        self._candidates = self._generate()
+        self._cursor = 0
+
+    # ---- candidate generation + pruning (reference: utils.py
+    # generate_combinations + prune functions) ----
+    def _generate(self) -> List[Dict]:
+        W = self.world_size
+        cands = []
+        allowed = self.tuner_cfg
+        for tp in allowed.get("mp_degree", _divisors(W)):
+            if W % tp:
+                continue
+            for pp in allowed.get("pp_degree", _divisors(W // tp)):
+                if (W // tp) % pp:
+                    continue
+                rest = W // tp // pp
+                for cp in allowed.get("cp_degree", [1]):
+                    if rest % cp:
+                        continue
+                    dp = rest // cp
+                    for sh in allowed.get("sharding_degree",
+                                          _divisors(dp)):
+                        if dp % sh:
+                            continue
+                        cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
+                               "sharding": sh}
+                        if self._prune(cfg):
+                            continue
+                        cands.append(cfg)
+        cands.sort(key=lambda c: estimate_step_time(
+            self.model, c, chip=self.chip))
+        return cands
+
+    def _prune(self, cfg) -> bool:
+        # memory prune
+        if estimate_memory_gb(self.model, cfg) > self.hbm_gb:
+            return True
+        # tp must divide heads; pp must divide layers
+        heads = self.model.get("num_heads")
+        if heads and heads % cfg["tp"]:
+            return True
+        L = self.model.get("num_layers")
+        if L and L % cfg["pp"]:
+            return True
+        # batch must divide over dp
+        B = self.model.get("global_batch")
+        if B and B % max(cfg["dp"], 1):
+            return True
+        return False
+
+    # ---- search protocol (reference: tuner.py search_once) ----
+    @property
+    def candidates(self) -> List[Dict]:
+        return list(self._candidates)
+
+    def search_once(self) -> Optional[Dict]:
+        while self._cursor < len(self._candidates):
+            cfg = self._candidates[self._cursor]
+            self._cursor += 1
+            if self._key(cfg) not in self.history:
+                return cfg
+        return None
+
+    def update(self, cfg: Dict, metric: float):
+        """metric: higher is better (e.g. tokens/sec)."""
+        self.history[self._key(cfg)] = metric
+
+    def best(self) -> Optional[Dict]:
+        if not self.history:
+            return None
+        key = max(self.history, key=self.history.get)
+        return dict(key)
+
+    @staticmethod
+    def _key(cfg: Dict) -> tuple:
+        return tuple(sorted(cfg.items()))
