@@ -47,6 +47,10 @@ from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
 from . import inference  # noqa: E402
+from . import fft  # noqa: E402
+from . import signal  # noqa: E402
+from . import audio  # noqa: E402
+from . import text  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

@@ -1,0 +1,6 @@
+"""paddle.audio parity (reference: python/paddle/audio/ — features/
+(Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC layers),
+functional/ (window functions, mel utilities), backends (wave IO))."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401

@@ -1,0 +1,66 @@
+"""reference: python/paddle/audio/backends/ — wave_backend.py load/save
+via the stdlib wave module (no soundfile dependency)."""
+from __future__ import annotations
+
+import wave as _wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .._core.tensor import Tensor
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(name: str):
+    if name != "wave_backend":
+        raise ValueError("only wave_backend is available")
+
+
+def info(filepath: str):
+    with _wave.open(filepath, "rb") as f:
+        class _Info:
+            sample_rate = f.getframerate()
+            num_frames = f.getnframes()
+            num_channels = f.getnchannels()
+            bits_per_sample = f.getsampwidth() * 8
+        return _Info()
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """16-bit PCM wav -> float32 in [-1, 1] (reference wave_backend.load)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+        width = f.getsampwidth()
+    dt = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: Optional[int] = 16):
+    arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if channels_first:
+        arr = arr.T
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(pcm.shape[1] if pcm.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(pcm.tobytes())

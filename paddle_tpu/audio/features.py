@@ -1,0 +1,90 @@
+"""reference: python/paddle/audio/features/layers.py — Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC as nn Layers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops._registry import as_tensor
+from .._core.autograd import apply
+from .. import signal as _signal
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             F.get_window(window, self.win_length))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length,
+                            self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        return apply(lambda v: jnp.abs(v) ** self.power, spec,
+                     name="spec_power")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center=True, pad_mode="reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode)
+        self.register_buffer("fbank", F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # (..., freq, T)
+        fb = self.fbank
+
+        def f(s, m):
+            return jnp.einsum("mf,...ft->...mt", m, s)
+        return apply(f, spec, fb, name="mel")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm)
+        self._ref, self._amin, self._top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self._ref, self._amin,
+                             self._top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length=None, win_length=None, window="hann",
+                 power=2.0, center=True, pad_mode="reflect", n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        self.register_buffer("dct", F.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        logmel = self.log_mel(x)            # (..., n_mels, T)
+        return apply(lambda v, d: jnp.einsum("mk,...mt->...kt", d, v),
+                     logmel, self.dct, name="mfcc")

@@ -1,0 +1,116 @@
+"""reference: python/paddle/audio/functional/{window,functional}.py —
+get_window, hz<->mel, compute_fbank_matrix, create_dct, power_to_db."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype="float32") -> Tensor:
+    n = win_length
+    sym = not fftbins
+    N = n if sym else n + 1
+    t = np.arange(N)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t / (N - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t / (N - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t / (N - 1))
+             + 0.08 * np.cos(4 * np.pi * t / (N - 1)))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(N)
+    elif window == "bartlett":
+        w = 1 - np.abs(2 * t / (N - 1) - 1)
+    else:
+        raise ValueError(f"unknown window {window}")
+    if not sym:
+        w = w[:n]
+    return Tensor(jnp.asarray(w.astype(np.float32)), _internal=True)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if np.isscalar(freq) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if np.isscalar(mel) else out
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm="slaney", dtype="float32") -> Tensor:
+    """(n_mels, n_fft//2 + 1) mel filterbank."""
+    f_max = f_max or sr / 2
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb.astype(np.float32)), _internal=True)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
+               dtype="float32") -> Tensor:
+    """(n_mels, n_mfcc) DCT-II matrix."""
+    t = np.arange(n_mels)
+    dct = np.cos(np.pi / n_mels * (t[:, None] + 0.5)
+                 * np.arange(n_mfcc)[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.astype(np.float32)), _internal=True)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    from ..ops._registry import as_tensor
+    from .._core.autograd import apply
+
+    def f(v):
+        db = 10.0 * jnp.log10(jnp.maximum(v, amin))
+        db = db - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            db = jnp.maximum(db, jnp.max(db) - top_db)
+        return db
+    return apply(f, as_tensor(spect), name="power_to_db")

@@ -1,0 +1,84 @@
+"""paddle.fft parity (reference: python/paddle/fft.py — wraps phi fft
+kernels backed by cuFFT/pocketfft). TPU-native: jnp.fft lowers to XLA's
+FFT HLO which runs on the TPU's transcendental units."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._core.autograd import apply
+from .ops._registry import as_tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(fname):
+    jf = getattr(jnp.fft, fname)
+
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda v: jf(v, n=n, axis=axis, norm=norm),
+                     as_tensor(x), name=f"fft_{fname}")
+    op.__name__ = fname
+    return op
+
+
+def _wrapN(fname):
+    jf = getattr(jnp.fft, fname)
+
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        kw = {"s": s, "norm": norm}
+        if axes is not None:
+            kw["axes"] = axes
+        return apply(lambda v: jf(v, **kw), as_tensor(x),
+                     name=f"fft_{fname}")
+    op.__name__ = fname
+    return op
+
+
+fft = _wrap1("fft")
+ifft = _wrap1("ifft")
+rfft = _wrap1("rfft")
+irfft = _wrap1("irfft")
+hfft = _wrap1("hfft")
+ihfft = _wrap1("ihfft")
+fftn = _wrapN("fftn")
+ifftn = _wrapN("ifftn")
+rfftn = _wrapN("rfftn")
+irfftn = _wrapN("irfftn")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ._core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d), _internal=True)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ._core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d), _internal=True)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), as_tensor(x),
+                 name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), as_tensor(x),
+                 name="ifftshift")

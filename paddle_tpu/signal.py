@@ -1,0 +1,121 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — stft/istft
+over the fft kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._core.autograd import apply
+from .ops._registry import as_tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Split into overlapping frames along the last axis."""
+    x = as_tensor(x)
+
+    def f(v):
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        out = v[..., idx]                      # (..., num, frame_length)
+        return jnp.moveaxis(out, -2, -1) if axis == -1 else out
+    return apply(f, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    x = as_tensor(x)
+
+    def f(v):
+        # v: (..., frame_length, num_frames) for axis=-1
+        fl, num = v.shape[-2], v.shape[-1]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        for i in range(num):                  # static unroll (num is small)
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                v[..., i])
+        return out
+    return apply(f, x, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: python/paddle/signal.py stft. x: (B, T) or (T,).
+    Returns (B, n_fft//2+1, num_frames) complex (onesided)."""
+    x = as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = as_tensor(window)
+
+    def f(v, *rest):
+        w = rest[0] if rest else jnp.ones((win_length,), v.dtype)
+        if win_length < n_fft:
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = v[:, idx] * w                 # (B, num, n_fft)
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.moveaxis(spec, 1, 2)         # (B, freq, num)
+        return out[0] if squeeze else out
+
+    args = [x] + ([window] if window is not None else [])
+    return apply(f, *args, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    x = as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = as_tensor(window)
+
+    def f(v, *rest):
+        w = rest[0] if rest else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        spec = jnp.moveaxis(v, 1, 2)           # (B, num, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * w
+        num = frames.shape[1]
+        n = n_fft + hop_length * (num - 1)
+        out = jnp.zeros((frames.shape[0], n), frames.dtype)
+        norm = jnp.zeros((n,), frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[:, sl].add(frames[:, i])
+            norm = norm.at[sl].add(w * w)
+        out = out / jnp.where(norm > 1e-11, norm, 1.0)
+        if center:
+            out = out[:, n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            if out.shape[1] < length:  # pad the uncovered tail with zeros
+                out = jnp.pad(out, ((0, 0), (0, length - out.shape[1])))
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    args = [x] + ([window] if window is not None else [])
+    return apply(f, *args, name="istft")

@@ -1,0 +1,10 @@
+"""paddle.text parity (reference: python/paddle/text/ — ViterbiDecoder /
+viterbi_decode ops, datasets Imdb/Imikolov/Movielens/UCIHousing/WMT14/16).
+
+Datasets require downloads (zero-egress here): constructors accept
+``data_file`` for pre-fetched archives and raise a clear error otherwise.
+"""
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

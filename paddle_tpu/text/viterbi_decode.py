@@ -1,0 +1,82 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py,
+kernel paddle/phi/kernels/viterbi_decode_kernel.h).
+
+TPU-native: the DP over time steps is a lax.scan; argmax backtracking is a
+reverse scan — whole decode jit-compiles."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn.layer.layers import Layer
+from .._core.autograd import apply
+from ..ops._registry import as_tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """potentials: (B, T, N) emission scores; transition: (N, N).
+    Returns (scores (B,), paths (B, T))."""
+    potentials = as_tensor(potentials)
+    transition_params = as_tensor(transition_params)
+    args = [potentials, transition_params]
+    if lengths is not None:
+        args.append(as_tensor(lengths))
+
+    def f(emis, trans, *rest):
+        B, T, N = emis.shape
+        lens = rest[0].astype(jnp.int32) if rest else \
+            jnp.full((B,), T, jnp.int32)
+        if include_bos_eos_tag:
+            # reference semantics: tags N-2 = BOS, N-1 = EOS
+            start = emis[:, 0] + trans[N - 2][None, :]
+        else:
+            start = emis[:, 0]
+
+        def step(carry, t):
+            alpha = carry                                  # (B, N)
+            # score for arriving at j from best i
+            s = alpha[:, :, None] + trans[None]            # (B, N, N)
+            best = jnp.max(s, axis=1) + emis[:, t]
+            back = jnp.argmax(s, axis=1)                   # (B, N)
+            # freeze alpha past each sequence's length
+            mask = (t < lens)[:, None]
+            new = jnp.where(mask, best, alpha)
+            return new, back
+
+        alpha, backs = lax.scan(step, start, jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # (B,)
+
+        def backtrack(carry, bk_t):
+            tag, t = carry
+            bk, tt = bk_t
+            prev = jnp.take_along_axis(bk, tag[:, None], axis=1)[:, 0]
+            # only backtrack within the sequence
+            newtag = jnp.where(tt < lens, prev.astype(jnp.int32), tag)
+            return (newtag, t), newtag
+
+        (_, _), path_rev = lax.scan(
+            backtrack, (last, 0),
+            (backs[::-1], jnp.arange(T - 1, 0, -1)))
+        paths = jnp.concatenate(
+            [path_rev[::-1].transpose(1, 0), last[:, None]], axis=1)
+        return scores, paths
+
+    return apply(f, *args, name="viterbi_decode", multi_out=True)
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = as_tensor(transitions)
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)

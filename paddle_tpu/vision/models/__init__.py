@@ -4,3 +4,9 @@ from .resnet import (  # noqa: F401
     resnet101, resnet152, resnext50_32x4d, resnext101_32x4d,
     resnext152_32x4d, wide_resnet50_2, wide_resnet101_2,
 )
+from .lenet import LeNet  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
+)
