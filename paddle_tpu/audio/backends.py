@@ -44,8 +44,13 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
         n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(n)
         width = f.getsampwidth()
-    dt = {1: np.int8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if width == 1:  # 8-bit WAV is unsigned with a 128 bias
+        data = np.frombuffer(raw, dtype=np.uint8).astype(
+            np.int16) - 128
+        data = data.reshape(-1, nch)
+    else:
+        dt = {2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
     if normalize:
         data = data.astype(np.float32) / float(2 ** (8 * width - 1))
     arr = data.T if channels_first else data
@@ -54,6 +59,10 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
 
 def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
          encoding: str = "PCM_16", bits_per_sample: Optional[int] = 16):
+    if encoding != "PCM_16" or (bits_per_sample not in (None, 16)):
+        raise ValueError(
+            f"wave backend writes PCM_16 only, got encoding={encoding!r} "
+            f"bits_per_sample={bits_per_sample!r}")
     arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
     if channels_first:
         arr = arr.T

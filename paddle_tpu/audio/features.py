@@ -5,7 +5,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..nn.layer.layers import Layer
-from ..ops._registry import as_tensor
 from .._core.autograd import apply
 from .. import signal as _signal
 from . import functional as F

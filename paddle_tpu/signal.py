@@ -11,30 +11,47 @@ __all__ = ["stft", "istft", "frame", "overlap_add"]
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Split into overlapping frames along the last axis."""
+    """Overlapping frames (reference: signal.py frame). axis=-1: signal on
+    the last dim -> (..., frame_length, num_frames); axis=0: signal on the
+    first dim -> (num_frames, frame_length, ...)."""
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
     x = as_tensor(x)
 
     def f(v):
+        if axis == 0:
+            v = jnp.moveaxis(v, 0, -1)
         n = v.shape[-1]
         num = 1 + (n - frame_length) // hop_length
         idx = (jnp.arange(frame_length)[None, :]
                + hop_length * jnp.arange(num)[:, None])
         out = v[..., idx]                      # (..., num, frame_length)
-        return jnp.moveaxis(out, -2, -1) if axis == -1 else out
+        if axis == 0:
+            # (num, frame_length, ...)
+            return jnp.moveaxis(out, (-2, -1), (0, 1))
+        return jnp.moveaxis(out, -2, -1)       # (..., frame_length, num)
     return apply(f, x, name="frame")
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
+    """reference: signal.py overlap_add. axis=-1: (..., frame_length,
+    num_frames) -> (..., T); axis=0: (num_frames, frame_length, ...) ->
+    (T, ...)."""
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
     x = as_tensor(x)
 
     def f(v):
-        # v: (..., frame_length, num_frames) for axis=-1
+        if axis == 0:
+            v = jnp.moveaxis(v, (0, 1), (-1, -2))
         fl, num = v.shape[-2], v.shape[-1]
         n = fl + hop_length * (num - 1)
         out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
         for i in range(num):                  # static unroll (num is small)
             out = out.at[..., i * hop_length:i * hop_length + fl].add(
                 v[..., i])
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
         return out
     return apply(f, x, name="overlap_add")
 

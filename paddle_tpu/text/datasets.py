@@ -28,7 +28,10 @@ class UCIHousing(Dataset):
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  download: bool = False):
-        if data_file and os.path.exists(data_file):
+        if data_file is not None:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(
+                    f"UCIHousing: data_file {data_file!r} does not exist")
             raw = np.loadtxt(data_file)
         else:  # deterministic synthetic fallback, same shape/scale
             rng = np.random.default_rng(2024)
@@ -108,7 +111,7 @@ class Imikolov(Dataset):
                 freq[t] = freq.get(t, 0) + 1
         vocab = [w for w, c in freq.items() if c >= min_word_freq]
         self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
-        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk = len(self.word_idx)
         self.data = []
         for toks in corpus:
             ids = [self.word_idx.get(t, unk) for t in toks]

@@ -29,8 +29,9 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         lens = rest[0].astype(jnp.int32) if rest else \
             jnp.full((B,), T, jnp.int32)
         if include_bos_eos_tag:
-            # reference semantics: tags N-2 = BOS, N-1 = EOS
-            start = emis[:, 0] + trans[N - 2][None, :]
+            # reference semantics (viterbi_decode_kernel.cc): row N-1 =
+            # start transitions, row N-2 = stop transitions
+            start = emis[:, 0] + trans[N - 1][None, :]
         else:
             start = emis[:, 0]
 
@@ -47,7 +48,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
 
         alpha, backs = lax.scan(step, start, jnp.arange(1, T))
         if include_bos_eos_tag:
-            alpha = alpha + trans[:, N - 1][None, :]
+            alpha = alpha + trans[N - 2][None, :]
         scores = jnp.max(alpha, axis=-1)
         last = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # (B,)
 

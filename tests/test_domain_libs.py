@@ -120,6 +120,29 @@ class TestViterbi:
             assert tuple(paths.numpy()[b]) == best_path
 
 
+class TestViterbiBosEos:
+    def test_bos_eos_rows_match_brute_force(self):
+        """Reference convention: trans row N-1 = start, row N-2 = stop."""
+        rng = np.random.default_rng(1)
+        B, T, N = 1, 4, 5
+        emis = rng.standard_normal((B, T, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            include_bos_eos_tag=True)
+        import itertools
+        best, best_path = -1e30, None
+        for seq in itertools.product(range(N), repeat=T):
+            s = trans[N - 1, seq[0]] + emis[0, 0, seq[0]]
+            for t in range(1, T):
+                s += trans[seq[t - 1], seq[t]] + emis[0, t, seq[t]]
+            s += trans[N - 2, seq[-1]]
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(scores.numpy()[0], best, rtol=1e-5)
+        assert tuple(paths.numpy()[0]) == best_path
+
+
 class TestTextDatasets:
     def test_uci_housing_synthetic(self):
         from paddle_tpu.text import UCIHousing
