@@ -52,6 +52,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     remat: bool = True
+    # remat policy: "nothing" = recompute all (min memory), "attn" = save
+    # attention outputs (skip the expensive flash recompute in backward),
+    # "dots" = save all matmul outputs (max speed, max memory)
+    remat_policy: str = "nothing"
     moe: Optional["_moe.MoEConfig"] = None  # experts replace the dense MLP
 
     @property
@@ -274,6 +278,8 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
         o = attn(q, k, v).reshape(B, S, nh * hd)
     else:
         o = _attention(q, k, v, causal=True).reshape(B, S, nh * hd)
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "attn_out")
     x = sp(x + o @ lp["wo"])
 
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -302,8 +308,13 @@ def _trunk(params, tokens, cfg: LlamaConfig, mesh_axes=None):
         return _block(carry, lp, cos, sin, cfg, mesh_axes)
 
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable)
+        policies = {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "attn": jax.checkpoint_policies.save_only_these_names(
+                "attn_out"),
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }
+        block = jax.checkpoint(block, policy=policies[cfg.remat_policy])
 
     def body(carry, lp):
         x, aux = block(carry, lp)

@@ -74,12 +74,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)            # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)            # (block_k, d)
+        q = q_ref[0]                                # (block_q, d) bf16 ok:
+        k = k_ref[0]                                # MXU takes bf16 inputs
+        v = v_ref[0]                                # with fp32 accumulate
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+            preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -94,7 +94,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_new = alpha * l_ref[:] + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
         l_ref[:] = l_new
@@ -162,10 +162,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                    # (block_q, 1)
         delta = delta_ref[0]                # (block_q, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -176,15 +176,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse)                # (bq, bk)
+        p = jnp.exp(s - lse)                # (bq, bk) f32
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
@@ -209,10 +209,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -226,9 +226,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale)
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -309,8 +309,8 @@ def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=1024):
     """(B, S, H, D) flash attention. Raw jax arrays in/out (op-layer wraps
     it into the Tensor/autograd surface)."""
     b, sq, h, d = q.shape
