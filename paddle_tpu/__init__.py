@@ -51,6 +51,11 @@ from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
+from . import version  # noqa: E402
+from . import utils  # noqa: E402
+from . import onnx  # noqa: E402
+from . import sysconfig  # noqa: E402
+from .hapi.summary import summary  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

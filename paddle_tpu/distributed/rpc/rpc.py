@@ -1,0 +1,168 @@
+"""RPC layer (reference: python/paddle/distributed/rpc/rpc.py init_rpc /
+rpc_sync / rpc_async over C++ RpcAgent, paddle/fluid/distributed/rpc/
+rpc_agent.h — brpc-based).
+
+TPU-native: host-side control-plane RPC (data-plane traffic rides XLA
+collectives, never RPC). Implementation: each worker runs a pickle-over-
+socket server thread; endpoints rendezvous through the shared filesystem
+or an explicit worker map. Functions must be importable at the callee
+(same contract as the reference).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+_state = {
+    "name": None, "rank": None, "world": None,
+    "workers": {},        # name -> (host, port)
+    "server": None,
+    "pool": None,
+}
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        c = sock.recv(8 - len(hdr))
+        if not c:
+            raise ConnectionError("rpc peer closed")
+        hdr += c
+    n = struct.unpack("<Q", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(min(1 << 20, n - len(buf)))
+        if not c:
+            raise ConnectionError("rpc peer closed")
+        buf += c
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = ("err", e)
+            _send_msg(self.request, result)
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """reference: rpc.py init_rpc. Starts this worker's server and
+    registers its endpoint; rendezvous via a shared registry dir."""
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size or int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    server = _Server(("127.0.0.1", 0), _Handler)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    reg = os.environ.get("PADDLE_RPC_REGISTRY", "/tmp/paddle_tpu_rpc")
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    os.makedirs(os.path.join(reg, job), exist_ok=True)
+    with open(os.path.join(reg, job, f"{name}.addr"), "w") as f:
+        f.write(f"{rank}\t127.0.0.1\t{port}")
+
+    _state.update(name=name, rank=rank, world=world_size, server=server,
+                  pool=concurrent.futures.ThreadPoolExecutor(16))
+    # wait for all workers to register
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        entries = os.listdir(os.path.join(reg, job))
+        if len([e for e in entries if e.endswith(".addr")]) >= world_size:
+            break
+        time.sleep(0.05)
+    for fn in os.listdir(os.path.join(reg, job)):
+        if fn.endswith(".addr"):
+            wname = fn[:-5]
+            with open(os.path.join(reg, job, fn)) as f:
+                r, host, p = f.read().split("\t")
+            _state["workers"][wname] = WorkerInfo(wname, int(r), host,
+                                                  int(p))
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    info = _state["workers"].get(to)
+    if info is None:
+        raise RuntimeError(f"unknown rpc worker {to!r}")
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or None) as s:
+        _send_msg(s, (fn, args or (), kwargs or {}))
+        status, payload = _recv_msg(s)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=180):
+    """reference: rpc.py rpc_sync — blocking remote call."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=180):
+    """reference: rpc.py rpc_async — returns a Future with .wait()."""
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle Future API alias
+    return fut
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _state["workers"][_state["name"]]
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def shutdown():
+    """reference: rpc.py shutdown (barrier semantics relaxed: local)."""
+    if _state["server"] is not None:
+        _state["server"].shutdown()
+        _state["server"] = None
+    if _state["pool"] is not None:
+        _state["pool"].shutdown(wait=False)
+        _state["pool"] = None
+    reg = os.environ.get("PADDLE_RPC_REGISTRY", "/tmp/paddle_tpu_rpc")
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    try:
+        os.remove(os.path.join(reg, job, f"{_state['name']}.addr"))
+    except OSError:
+        pass
+    _state["workers"].clear()

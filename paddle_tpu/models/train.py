@@ -29,8 +29,8 @@ class TrainState(NamedTuple):
     v: Any                # Adam second moment (fp32)
 
 
-def init_train_state(key: jax.Array, cfg: llama.LlamaConfig) -> TrainState:
-    params = llama.init_params(key, cfg)
+def init_train_state(key: jax.Array, cfg, model=None) -> TrainState:
+    params = (model if model is not None else llama).init_params(key, cfg)
     # copy=True: when the model dtype is already fp32, astype would alias
     # the param buffer and break donation (same buffer donated twice)
     master = jax.tree.map(lambda p: jnp.array(p, jnp.float32, copy=True),
@@ -40,8 +40,8 @@ def init_train_state(key: jax.Array, cfg: llama.LlamaConfig) -> TrainState:
                       jax.tree.map(jnp.copy, zeros))
 
 
-def state_specs(cfg: llama.LlamaConfig) -> TrainState:
-    ps = llama.param_specs(cfg)
+def state_specs(cfg, model=None) -> TrainState:
+    ps = (model if model is not None else llama).param_specs(cfg)
     return TrainState(P(), ps, ps, ps, ps)
 
 
@@ -60,10 +60,10 @@ def _prune_spec(spec: P, mesh: Mesh) -> P:
     return P(*out)
 
 
-def state_shardings(mesh: Mesh, cfg: llama.LlamaConfig) -> TrainState:
+def state_shardings(mesh: Mesh, cfg, model=None) -> TrainState:
     return jax.tree.map(
         lambda s: NamedSharding(mesh, _prune_spec(s, mesh)),
-        state_specs(cfg), is_leaf=lambda x: isinstance(x, P))
+        state_specs(cfg, model), is_leaf=lambda x: isinstance(x, P))
 
 
 def _adamw(g, p32, m, v, step, lr, b1, b2, eps, wd):
@@ -76,12 +76,12 @@ def _adamw(g, p32, m, v, step, lr, b1, b2, eps, wd):
     return p32, m, v
 
 
-def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
+def make_train_step(cfg, mesh: Optional[Mesh] = None, *,
                     lr: float = 3e-4, b1: float = 0.9, b2: float = 0.95,
                     eps: float = 1e-8, weight_decay: float = 0.1,
                     grad_clip: float = 1.0, data_axes=("dp", "fsdp"),
                     tp_axis="tp", cp_axis=None, ep_axis=None,
-                    seq_chunk: Optional[int] = None):
+                    seq_chunk: Optional[int] = None, model=None):
     """Returns jitted ``step(state, tokens) -> (state, metrics)``.
 
     With a mesh: tokens sharded over ``data_axes`` (dp × fsdp batch
@@ -104,9 +104,11 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
                      "ep": ep_axis if (ep_axis and
                                        ep_axis in mesh.axis_names) else None}
 
+    mdl = model if model is not None else llama
+
     def loss(params, tokens):
-        return llama.loss_fn(params, tokens, cfg, mesh_axes,
-                             seq_chunk=seq_chunk)
+        return mdl.loss_fn(params, tokens, cfg, mesh_axes,
+                           seq_chunk=seq_chunk)
 
     def step_fn(state: TrainState, tokens: jax.Array):
         lv, grads = jax.value_and_grad(loss)(state.params, tokens)
@@ -134,7 +136,7 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Optional[Mesh] = None, *,
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0,))
 
-    st_sh = state_shardings(mesh, cfg)
+    st_sh = state_shardings(mesh, cfg, mdl)
     data_spec = P(mesh_axes["data"], mesh_axes["cp"])
     tok_sh = NamedSharding(mesh, data_spec)
     rep = NamedSharding(mesh, P())

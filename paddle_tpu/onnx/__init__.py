@@ -1,0 +1,26 @@
+"""paddle.onnx parity (reference: python/paddle/onnx/export.py — delegates
+to paddle2onnx).
+
+TPU-native: the portable serving format is StableHLO, not ONNX — XLA
+consumes it directly on any backend. ``export`` traces the layer and
+writes ``<path>.stablehlo.mlir`` (plus params via jit.save). If the
+``onnx`` package is importable an ONNX protobuf conversion could be
+plugged in; this environment ships without it, so requesting
+``format="onnx"`` raises with guidance rather than silently writing a
+different format.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 9, format: str = "stablehlo", **configs):
+    if format == "onnx":
+        raise RuntimeError(
+            "ONNX export requires the paddle2onnx/onnx packages (not "
+            "available here). Use format='stablehlo' — XLA runtimes load "
+            "it directly.")
+    from ..jit.save_load import save as jit_save
+    jit_save(layer, path, input_spec=input_spec, **configs)
+    return path + ".pdmodel"

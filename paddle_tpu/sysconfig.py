@@ -1,0 +1,12 @@
+"""reference: python/paddle/sysconfig.py — get_include/get_lib."""
+import os
+
+
+def get_include() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_native")
+
+
+def get_lib() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_native")

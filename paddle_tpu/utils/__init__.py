@@ -1,0 +1,69 @@
+"""paddle.utils parity (reference: python/paddle/utils/ — deprecated
+decorator, try_import, require_version, download, dlpack, unique_name,
+layers_utils flatten/pack_sequence_as)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from .layers_utils import flatten, pack_sequence_as, map_structure  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: utils/deprecated.py."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API {fn.__module__}.{fn.__name__} is deprecated "
+                   f"since {since}")
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """reference: utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"Failed to import {module_name}. Install it "
+                          f"before using this feature.")
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/install_check.py require_version."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(f"paddle_tpu>={min_version} required, got "
+                        f"{__version__}")
+    if max_version and parse(max_version) < cur:
+        raise Exception(f"paddle_tpu<={max_version} required, got "
+                        f"{__version__}")
+
+
+def run_check():
+    """reference: utils/install_check.py run_check — smoke the device."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).numpy()
+    assert np.allclose(y, 2 * np.ones((2, 2)))
+    import jax
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! device={dev.platform} "
+          f"({getattr(dev, 'device_kind', '?')})")

@@ -1,0 +1,35 @@
+"""reference: python/paddle/utils/dlpack.py — zero-copy tensor exchange."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+
+
+def to_dlpack(x: Tensor):
+    """Export as a DLPack-protocol object (implements __dlpack__ /
+    __dlpack_device__ — the modern producer form; consumers that want the
+    legacy capsule call .__dlpack__() themselves)."""
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class _CapsuleHolder:
+    """Adapts a legacy raw capsule to the modern protocol."""
+
+    def __init__(self, cap):
+        self._cap = cap
+
+    def __dlpack__(self, **kw):
+        return self._cap
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(data) -> Tensor:
+    """Import from any __dlpack__-bearing object or a legacy capsule."""
+    if not hasattr(data, "__dlpack__"):
+        data = _CapsuleHolder(data)
+    arr = jnp.from_dlpack(data)
+    return Tensor(arr, _internal=True)

@@ -1,0 +1,22 @@
+"""reference: python/paddle/utils/layers_utils.py — pytree helpers."""
+from __future__ import annotations
+
+import jax
+
+
+def flatten(nest):
+    leaves, _ = jax.tree.flatten(
+        nest, is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+    return leaves
+
+
+def pack_sequence_as(structure, flat_sequence):
+    treedef = jax.tree.structure(
+        structure, is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+    return jax.tree.unflatten(treedef, flat_sequence)
+
+
+def map_structure(func, *structures):
+    return jax.tree.map(
+        func, *structures,
+        is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))

@@ -1,0 +1,38 @@
+"""reference: python/paddle/utils/unique_name.py — generate/guard/switch."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def _gens():
+    if not hasattr(_state, "gens"):
+        _state.gens = [{}]
+    return _state.gens
+
+
+def generate(key: str) -> str:
+    cur = _gens()[-1]
+    cur[key] = cur.get(key, -1) + 1
+    return f"{key}_{cur[key]}"
+
+
+def generate_with_ignorable_key(key: str) -> str:
+    return generate(key)
+
+
+def switch(new_generator=None):
+    old = _gens()[-1]
+    _gens()[-1] = new_generator if new_generator is not None else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    _gens().append(new_generator if isinstance(new_generator, dict) else {})
+    try:
+        yield
+    finally:
+        _gens().pop()

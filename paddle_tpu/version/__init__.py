@@ -1,0 +1,36 @@
+"""reference: python/paddle/version/__init__.py (generated at build)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = None
+xpu_version = "False"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}) — TPU-native")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
+
+
+def tpu():
+    import jax
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False

@@ -1,0 +1,139 @@
+"""GPT-2 family + RPC + misc namespace tests."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.models import gpt, train
+
+
+class TestGPT:
+    def test_forward_shapes(self):
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.init_params(jax.random.key(0), cfg)
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 16)), jnp.int32)
+        logits = gpt.forward(params, toks, cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+
+    def test_causality(self):
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.init_params(jax.random.key(0), cfg)
+        rng = np.random.default_rng(1)
+        t1 = rng.integers(0, cfg.vocab_size, (1, 12))
+        t2 = t1.copy()
+        t2[0, -1] = (t2[0, -1] + 1) % cfg.vocab_size
+        l1 = np.asarray(gpt.forward(params, jnp.asarray(t1, jnp.int32), cfg))
+        l2 = np.asarray(gpt.forward(params, jnp.asarray(t2, jnp.int32), cfg))
+        np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_num_params_matches(self):
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.init_params(jax.random.key(0), cfg)
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        assert n == cfg.num_params()
+
+    def test_trains_and_loss_decreases(self):
+        cfg = gpt.GPTConfig.tiny()
+        step = train.make_train_step(cfg, lr=1e-2, model=gpt)
+        st = train.init_train_state(jax.random.key(0), cfg, model=gpt)
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 32)), jnp.int32)
+        losses = []
+        for _ in range(8):
+            st, m = step(st, toks)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_sharded_matches_single(self):
+        cfg = gpt.GPTConfig.tiny()
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 32)), jnp.int32)
+        single = train.make_train_step(cfg, model=gpt)
+        s0 = train.init_train_state(jax.random.key(0), cfg, model=gpt)
+        s0, m0 = single(s0, toks)
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("dp", "fsdp", "tp"))
+        sharded = train.make_train_step(cfg, mesh, model=gpt)
+        s1 = jax.jit(lambda k: train.init_train_state(k, cfg, model=gpt),
+                     out_shardings=train.state_shardings(mesh, cfg, gpt))(
+            jax.random.key(0))
+        tok_sh = jax.device_put(toks, NamedSharding(mesh, P(("dp", "fsdp"))))
+        s1, m1 = sharded(s1, tok_sh)
+        np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                                   rtol=1e-5)
+
+
+class TestRPC:
+    def test_rpc_sync_async(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_RPC_REGISTRY", str(tmp_path))
+        monkeypatch.setenv("PADDLE_JOB_ID", "t1")
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("w0", rank=0, world_size=1)
+        try:
+            assert rpc.rpc_sync("w0", max, args=(3, 5)) == 5
+            fut = rpc.rpc_async("w0", pow, args=(2, 10))
+            assert fut.wait() == 1024
+            info = rpc.get_current_worker_info()
+            assert info.name == "w0" and info.rank == 0
+        finally:
+            rpc.shutdown()
+
+    def test_rpc_propagates_exceptions(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_RPC_REGISTRY", str(tmp_path))
+        monkeypatch.setenv("PADDLE_JOB_ID", "t2")
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc("w0", rank=0, world_size=1)
+        try:
+            with pytest.raises(ZeroDivisionError):
+                rpc.rpc_sync("w0", divmod, args=(1, 0))
+        finally:
+            rpc.shutdown()
+
+
+class TestMiscNamespaces:
+    def test_version(self):
+        import paddle_tpu.version as v
+        assert v.full_version == paddle.__version__
+        assert v.cuda() is False
+
+    def test_utils(self):
+        from paddle_tpu import utils
+        utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            utils.require_version("999.0.0")
+        n1 = utils.unique_name.generate("fc")
+        n2 = utils.unique_name.generate("fc")
+        assert n1 != n2
+        with utils.unique_name.guard():
+            assert utils.unique_name.generate("fc") == "fc_0"
+        flat = utils.flatten({"a": [1, 2], "b": 3})
+        assert sorted(flat) == [1, 2, 3]
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils import dlpack
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        cap = dlpack.to_dlpack(x)
+        y = dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_run_check(self, capsys):
+        from paddle_tpu import utils
+        utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_onnx_export_stablehlo(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx
+        net = nn.Linear(4, 2)
+        net.eval()
+        out = onnx.export(net, str(tmp_path / "m"),
+                          input_spec=[paddle.jit.api.InputSpec([1, 4])])
+        assert out.endswith(".pdmodel")
+        with pytest.raises(RuntimeError, match="stablehlo"):
+            onnx.export(net, str(tmp_path / "m2"), format="onnx")
