@@ -31,6 +31,7 @@ from .spawn import spawn  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import ps  # noqa: F401
 from .utils import moe_utils  # noqa: F401
 from .fleet.fleet import fleet as _fleet_facade  # noqa: F401
 

@@ -1,0 +1,3 @@
+from .the_one_ps import (  # noqa: F401
+    PsServer, PsClient, Table, TableConfig, sparse_embedding,
+)

@@ -1,0 +1,231 @@
+"""Parameter server (reference: paddle/fluid/distributed/ps/ — brpc
+PS client/server service/brpc_ps_client.h, table storage table/
+(MemoryDenseTable, MemorySparseTable, SSD), python runtime
+python/paddle/distributed/ps/the_one_ps.py).
+
+TPU-native interpretation: the PS serves *sparse embedding* workloads
+whose tables exceed device HBM. Server processes keep tables in host RAM
+(dict-of-rows sparse + ndarray dense) with table-side optimizers (SGD /
+Adagrad — the reference's sparse accessor rules); trainers pull rows,
+compute the dense part on TPU, and push gradients on backward (PyLayer
+hook). Transport is the framework RPC layer — the control-plane analog of
+the reference's brpc service.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# module-level table registry: lives in the SERVER process; RPC handlers
+# (plain functions, importable at the callee) operate on it
+_tables: Dict[str, "Table"] = {}
+_lock = threading.Lock()
+
+
+@dataclasses.dataclass
+class TableConfig:
+    name: str
+    dim: int
+    kind: str = "sparse"            # "sparse" | "dense"
+    optimizer: str = "adagrad"      # "sgd" | "adagrad"
+    lr: float = 0.05
+    init_std: float = 0.01
+    dense_rows: int = 0             # for dense tables
+
+
+class Table:
+    def __init__(self, cfg: TableConfig):
+        self.cfg = cfg
+        if cfg.kind == "dense":
+            rng = np.random.default_rng(hash(cfg.name) & 0xffff)
+            self.dense = (rng.standard_normal(
+                (cfg.dense_rows, cfg.dim)) * cfg.init_std).astype(
+                np.float32)
+            self.dense_g2 = np.zeros_like(self.dense)
+        else:
+            self.rows: Dict[int, np.ndarray] = {}
+            self.g2: Dict[int, np.ndarray] = {}
+
+    def _init_row(self, key: int) -> np.ndarray:
+        seed = (((hash(self.cfg.name) & 0xFFFFFFFF) << 20)
+                ^ (int(key) & 0xFFFFFFFF))
+        rng = np.random.default_rng(seed)
+        return (rng.standard_normal(self.cfg.dim) *
+                self.cfg.init_std).astype(np.float32)
+
+    # ---- sparse ----
+    def pull_sparse(self, keys: np.ndarray) -> np.ndarray:
+        out = np.empty((len(keys), self.cfg.dim), np.float32)
+        for i, k in enumerate(keys.tolist()):
+            row = self.rows.get(k)
+            if row is None:
+                row = self.rows[k] = self._init_row(k)
+            out[i] = row
+        return out
+
+    def push_sparse(self, keys: np.ndarray, grads: np.ndarray):
+        lr = self.cfg.lr
+        for i, k in enumerate(keys.tolist()):
+            row = self.rows.get(k)
+            if row is None:
+                row = self.rows[k] = self._init_row(k)
+            g = grads[i]
+            if self.cfg.optimizer == "adagrad":
+                acc = self.g2.setdefault(
+                    k, np.zeros(self.cfg.dim, np.float32))
+                acc += g * g
+                row -= lr * g / (np.sqrt(acc) + 1e-8)
+            else:
+                row -= lr * g
+
+    # ---- dense ----
+    def pull_dense(self) -> np.ndarray:
+        return self.dense
+
+    def push_dense(self, grads: np.ndarray):
+        lr = self.cfg.lr
+        if self.cfg.optimizer == "adagrad":
+            self.dense_g2 += grads * grads
+            self.dense -= lr * grads / (np.sqrt(self.dense_g2) + 1e-8)
+        else:
+            self.dense -= lr * grads
+
+
+# ---- RPC-served functions (executed in the server process) ----
+def _srv_create_table(cfg_dict: dict):
+    with _lock:
+        cfg = TableConfig(**cfg_dict)
+        if cfg.name not in _tables:
+            _tables[cfg.name] = Table(cfg)
+    return True
+
+
+def _srv_pull_sparse(name: str, keys: np.ndarray) -> np.ndarray:
+    return _tables[name].pull_sparse(np.asarray(keys))
+
+
+def _srv_push_sparse(name: str, keys, grads) -> bool:
+    _tables[name].push_sparse(np.asarray(keys), np.asarray(grads))
+    return True
+
+
+def _srv_pull_dense(name: str) -> np.ndarray:
+    return _tables[name].pull_dense()
+
+
+def _srv_push_dense(name: str, grads) -> bool:
+    _tables[name].push_dense(np.asarray(grads))
+    return True
+
+
+def _srv_table_size(name: str) -> int:
+    t = _tables[name]
+    return len(t.rows) if t.cfg.kind == "sparse" else t.cfg.dense_rows
+
+
+class PsServer:
+    """One PS shard (reference: brpc_ps_server.h). Uses the RPC worker
+    registry: call after rpc.init_rpc(name=...)."""
+
+    def __init__(self, tables: List[TableConfig]):
+        for cfg in tables:
+            _srv_create_table(dataclasses.asdict(cfg))
+
+
+class PsClient:
+    """reference: brpc_ps_client.h — pull/push against named servers.
+    Sparse keys are range-partitioned across servers (key % num_servers,
+    the reference's default shard rule)."""
+
+    def __init__(self, server_names: List[str]):
+        self.servers = list(server_names)
+
+    def _rpc(self):
+        from .. import rpc
+        return rpc
+
+    def create_table(self, cfg: TableConfig):
+        for s in self.servers:
+            self._rpc().rpc_sync(s, _srv_create_table,
+                                 args=(dataclasses.asdict(cfg),))
+
+    def pull_sparse(self, name: str, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).ravel()
+        n = len(self.servers)
+        out = np.empty((len(keys),), object)
+        result = np.empty((len(keys), 0), np.float32)
+        parts = {}
+        for si in range(n):
+            mask = (keys % n) == si
+            if mask.any():
+                parts[si] = (np.nonzero(mask)[0],
+                             self._rpc().rpc_async(
+                                 self.servers[si], _srv_pull_sparse,
+                                 args=(name, keys[mask])))
+        dim = None
+        rows = [None] * len(keys)
+        for si, (idx, fut) in parts.items():
+            vals = fut.wait()
+            dim = vals.shape[1]
+            for j, i in enumerate(idx.tolist()):
+                rows[i] = vals[j]
+        return np.stack(rows).astype(np.float32)
+
+    def push_sparse(self, name: str, keys: np.ndarray, grads: np.ndarray):
+        keys = np.asarray(keys, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(keys), -1)
+        n = len(self.servers)
+        futs = []
+        for si in range(n):
+            mask = (keys % n) == si
+            if mask.any():
+                futs.append(self._rpc().rpc_async(
+                    self.servers[si], _srv_push_sparse,
+                    args=(name, keys[mask], grads[mask])))
+        for f in futs:
+            f.wait()
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._rpc().rpc_sync(self.servers[0], _srv_pull_dense,
+                                    args=(name,))
+
+    def push_dense(self, name: str, grads: np.ndarray):
+        self._rpc().rpc_sync(self.servers[0], _srv_push_dense,
+                             args=(name, np.asarray(grads)))
+
+    def table_size(self, name: str) -> int:
+        return sum(self._rpc().rpc_sync(s, _srv_table_size, args=(name,))
+                   for s in self.servers)
+
+
+def sparse_embedding(client: PsClient, table: str, ids,
+                     training: bool = True):
+    """Distributed embedding lookup with push-on-backward (reference:
+    python/paddle/static/nn/common.py sparse_embedding + the PS pull/push
+    pair). Returns a Tensor of shape ids.shape + (dim,)."""
+    import jax.numpy as jnp
+    from ..._core.tensor import Tensor
+    from ...autograd.py_layer import PyLayer
+
+    ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                        np.int64)
+    flat = ids_np.ravel()
+    rows = client.pull_sparse(table, flat)      # (n, dim) host pull
+
+    class _Lookup(PyLayer):
+        @staticmethod
+        def forward(ctx, rows_t):
+            return rows_t
+
+        @staticmethod
+        def backward(ctx, grad):
+            if training:
+                client.push_sparse(table, flat, np.asarray(grad.numpy()))
+            return grad
+
+    out = _Lookup.apply(Tensor(jnp.asarray(rows), stop_gradient=False,
+                               _internal=True))
+    return out.reshape(list(ids_np.shape) + [rows.shape[1]])

@@ -1,0 +1,80 @@
+"""Parameter-server tests (reference pattern: test/legacy_test/
+test_dist_base.py PS mode — here single-process with RPC loopback)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture()
+def ps_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_RPC_REGISTRY", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "ps_test")
+    from paddle_tpu.distributed import rpc
+    rpc.init_rpc("server0", rank=0, world_size=1)
+    yield rpc
+    rpc.shutdown()
+
+
+def test_dense_pull_push(ps_env):
+    from paddle_tpu.distributed.ps import PsServer, PsClient, TableConfig
+    cfg = TableConfig(name="d0", dim=4, kind="dense", dense_rows=3,
+                      optimizer="sgd", lr=0.1)
+    PsServer([cfg])
+    client = PsClient(["server0"])
+    w0 = client.pull_dense("d0").copy()
+    g = np.ones((3, 4), np.float32)
+    client.push_dense("d0", g)
+    w1 = client.pull_dense("d0")
+    np.testing.assert_allclose(w1, w0 - 0.1 * g, rtol=1e-6)
+
+
+def test_sparse_pull_deterministic_and_push(ps_env):
+    from paddle_tpu.distributed.ps import PsClient, TableConfig
+    client = PsClient(["server0"])
+    client.create_table(TableConfig(name="emb", dim=8, optimizer="sgd",
+                                    lr=0.5))
+    keys = np.array([3, 7, 3], np.int64)
+    rows = client.pull_sparse("emb", keys)
+    assert rows.shape == (3, 8)
+    np.testing.assert_array_equal(rows[0], rows[2])   # same key same row
+    g = np.zeros((3, 8), np.float32)
+    g[0] = 1.0
+    g[2] = 1.0
+    client.push_sparse("emb", keys, g)
+    rows2 = client.pull_sparse("emb", np.array([3], np.int64))
+    np.testing.assert_allclose(rows2[0], rows[0] - 0.5 * 2.0, rtol=1e-5)
+    assert client.table_size("emb") == 2
+
+
+def test_adagrad_accumulates(ps_env):
+    from paddle_tpu.distributed.ps import PsClient, TableConfig
+    client = PsClient(["server0"])
+    client.create_table(TableConfig(name="emb_ag", dim=4,
+                                    optimizer="adagrad", lr=1.0))
+    k = np.array([5], np.int64)
+    r0 = client.pull_sparse("emb_ag", k).copy()
+    g = np.ones((1, 4), np.float32)
+    client.push_sparse("emb_ag", k, g)
+    r1 = client.pull_sparse("emb_ag", k)
+    # first adagrad step with g=1: delta = lr * 1/sqrt(1) = 1
+    np.testing.assert_allclose(r1, r0 - 1.0, rtol=1e-5)
+    client.push_sparse("emb_ag", k, g)
+    r2 = client.pull_sparse("emb_ag", k)
+    # second step: acc=2 -> delta = 1/sqrt(2)
+    np.testing.assert_allclose(r2, r1 - 1.0 / np.sqrt(2), rtol=1e-4)
+
+
+def test_sparse_embedding_backward_pushes(ps_env):
+    from paddle_tpu.distributed.ps import (PsClient, TableConfig,
+                                           sparse_embedding)
+    client = PsClient(["server0"])
+    client.create_table(TableConfig(name="emb2", dim=4, optimizer="sgd",
+                                    lr=1.0))
+    ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+    before = client.pull_sparse("emb2", np.array([1, 2], np.int64)).copy()
+    out = sparse_embedding(client, "emb2", ids)
+    assert out.shape == [1, 2, 4]
+    out.sum().backward()
+    after = client.pull_sparse("emb2", np.array([1, 2], np.int64))
+    np.testing.assert_allclose(after, before - 1.0, rtol=1e-5)
