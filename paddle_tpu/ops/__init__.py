@@ -12,6 +12,8 @@ from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 
+from .extra import *  # noqa: F401,F403
+
 from . import math  # noqa: F401
 from . import creation  # noqa: F401
 from . import manipulation  # noqa: F401
@@ -19,7 +21,11 @@ from . import logic  # noqa: F401
 from . import linalg  # noqa: F401
 from . import search  # noqa: F401
 from . import random  # noqa: F401
+from . import extra  # noqa: F401
 
 _registry.attach_tensor_methods()
+
+from . import inplace_gen as _inplace_gen  # noqa: E402
+_inplace_gen.install(globals())
 
 OPS = _registry.OPS

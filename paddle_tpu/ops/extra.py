@@ -1,0 +1,571 @@
+"""Long-tail op surface (reference: python/paddle/tensor/{math,
+manipulation,creation,linalg,logic}.py — the remaining __all__ entries).
+
+Mechanical jnp compositions; in-place ``op_`` variants are generated from
+their functional bases at the bottom (reference pattern: inplace ops share
+kernels with out-of-place, paddle/phi/ops/yaml inplace maps).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import (gammaln as _gammaln, digamma as _digamma,
+                               gammainc as _gammainc,
+                               gammaincc as _gammaincc)
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from ._registry import as_tensor, raw
+
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+
+__all__ = [
+    "inf", "nan", "newaxis", "hstack", "vstack", "dstack", "column_stack",
+    "row_stack", "hsplit", "vsplit", "dsplit", "atleast_1d", "atleast_2d",
+    "atleast_3d", "unbind", "unflatten", "view_as", "reverse", "block_diag",
+    "cartesian_prod", "combinations", "sinc", "signbit", "positive", "i0",
+    "gammaln", "sgn", "isneginf", "isposinf", "isin", "gammainc",
+    "gammaincc", "multigammaln", "polygamma", "copysign", "hypot", "ldexp",
+    "frexp", "frac", "bitwise_invert", "bitwise_left_shift",
+    "bitwise_right_shift", "less", "reduce_as", "trapezoid",
+    "cumulative_trapezoid", "histogram_bin_edges", "vander", "tensordot",
+    "cdist", "pdist", "matrix_transpose", "renorm", "slice_scatter",
+    "select_scatter", "diagonal_scatter", "masked_fill", "masked_scatter",
+    "index_fill", "take", "as_complex", "as_real", "is_complex",
+    "is_integer", "is_floating_point", "standard_gamma", "log_normal",
+    "shard_index", "add_n", "rank", "tolist", "set_printoptions",
+    "disable_signal_handler", "check_shape", "flops", "LazyGuard",
+]
+
+
+def _un(fn, name):
+    def op(x, *a, **k):
+        k.pop("name", None)
+        return apply(lambda v: fn(v, *a, **k), as_tensor(x), name=name)
+    op.__name__ = name
+    return op
+
+
+# ---- stacking / splitting ----
+def _multi(fn, name):
+    def op(xs, name_=None):
+        ts = [as_tensor(t) for t in xs]
+        return apply(lambda *vs: fn(vs), *ts, name=name)
+    op.__name__ = name
+    return op
+
+
+hstack = _multi(jnp.hstack, "hstack")
+vstack = _multi(jnp.vstack, "vstack")
+dstack = _multi(jnp.dstack, "dstack")
+column_stack = _multi(jnp.column_stack, "column_stack")
+row_stack = vstack
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = as_tensor(x)
+    parts = jnp.split(x._value, num_or_indices,
+                      axis=0 if x.ndim == 1 else 1)
+    return [apply(lambda v, p=p: p, x, name="hsplit") for p in parts]
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = as_tensor(x)
+    parts = jnp.split(x._value, num_or_indices, axis=0)
+    return [apply(lambda v, p=p: p, x, name="vsplit") for p in parts]
+
+
+def dsplit(x, num_or_indices, name=None):
+    x = as_tensor(x)
+    parts = jnp.split(x._value, num_or_indices, axis=2)
+    return [apply(lambda v, p=p: p, x, name="dsplit") for p in parts]
+
+
+def atleast_1d(*xs, name=None):
+    out = [apply(jnp.atleast_1d, as_tensor(x), name="atleast_1d")
+           for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*xs, name=None):
+    out = [apply(jnp.atleast_2d, as_tensor(x), name="atleast_2d")
+           for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*xs, name=None):
+    out = [apply(jnp.atleast_3d, as_tensor(x), name="atleast_3d")
+           for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def unbind(x, axis=0):
+    x = as_tensor(x)
+    n = x.shape[axis]
+    return [apply(lambda v, i=i: jnp.take(v, i, axis=axis), x,
+                  name="unbind") for i in range(n)]
+
+
+def unflatten(x, axis, shape, name=None):
+    x = as_tensor(x)
+
+    def f(v):
+        ax = axis % v.ndim
+        new = list(v.shape[:ax]) + list(shape) + list(v.shape[ax + 1:])
+        return v.reshape(new)
+    return apply(f, x, name="unflatten")
+
+
+def view_as(x, other, name=None):
+    return apply(lambda v, o: v.reshape(o.shape), as_tensor(x),
+                 as_tensor(other), name="view_as")
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return _un(lambda v: jnp.flip(v, ax), "reverse")(x)
+
+
+def block_diag(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+
+    def f(*vs):
+        vs = [jnp.atleast_2d(v) for v in vs]
+        R = sum(v.shape[0] for v in vs)
+        C = sum(v.shape[1] for v in vs)
+        out = jnp.zeros((R, C), vs[0].dtype)
+        r = c = 0
+        for v in vs:
+            out = jax.lax.dynamic_update_slice(out, v.astype(out.dtype),
+                                               (r, c))
+            r += v.shape[0]
+            c += v.shape[1]
+        return out
+    return apply(f, *ts, name="block_diag")
+
+
+def cartesian_prod(x, name=None):
+    ts = [as_tensor(t) for t in x]
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.ravel() for g in grids], axis=-1)
+    return apply(f, *ts, name="cartesian_prod")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    x = as_tensor(x)
+    n = x.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+    return apply(lambda v: v[jnp.asarray(idx)], x, name="combinations")
+
+
+# ---- elementwise / special ----
+sinc = _un(jnp.sinc, "sinc")
+signbit = _un(jnp.signbit, "signbit")
+positive = _un(jnp.positive, "positive")
+i0 = _un(lambda v: jax.scipy.special.i0(v), "i0")
+gammaln = _un(_gammaln, "gammaln")
+digamma_fn = _digamma
+
+
+def sgn(x, name=None):
+    def f(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+    return apply(f, as_tensor(x), name="sgn")
+
+
+def isneginf(x, name=None):
+    return _un(jnp.isneginf, "isneginf")(x)
+
+
+def isposinf(x, name=None):
+    return _un(jnp.isposinf, "isposinf")(x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda v, t: jnp.isin(v, t, invert=invert), as_tensor(x),
+                 as_tensor(test_x), name="isin")
+
+
+def gammainc(x, y, name=None):
+    return apply(_gammainc, as_tensor(x), as_tensor(y), name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply(_gammaincc, as_tensor(x), as_tensor(y), name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    def f(v):
+        c = 0.25 * p * (p - 1) * _math.log(_math.pi)
+        return c + sum(_gammaln(v - 0.5 * i) for i in range(p))
+    return apply(f, as_tensor(x), name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    if n == 0:
+        return apply(_digamma, as_tensor(x), name="polygamma")
+
+    def f(v):
+        base = lambda s: _digamma(s)
+        for _ in range(n):
+            base = jax.grad(base)
+        return jax.vmap(base)(v.reshape(-1).astype(jnp.float32)).reshape(
+            v.shape)
+    return apply(f, as_tensor(x), name="polygamma")
+
+
+def copysign(x, y, name=None):
+    return apply(jnp.copysign, as_tensor(x), as_tensor(y), name="copysign")
+
+
+def hypot(x, y, name=None):
+    return apply(jnp.hypot, as_tensor(x), as_tensor(y), name="hypot")
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+                 as_tensor(x), as_tensor(y), name="ldexp")
+
+
+def frexp(x, name=None):
+    return apply(lambda v: jnp.frexp(v), as_tensor(x), name="frexp",
+                 multi_out=True)
+
+
+def frac(x, name=None):
+    return _un(lambda v: v - jnp.trunc(v), "frac")(x)
+
+
+def bitwise_invert(x, name=None):
+    return _un(jnp.invert, "bitwise_invert")(x)
+
+
+def bitwise_left_shift(x, y, name=None):
+    return apply(jnp.left_shift, as_tensor(x), as_tensor(y),
+                 name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, name=None):
+    return apply(jnp.right_shift, as_tensor(x), as_tensor(y),
+                 name="bitwise_right_shift")
+
+
+def less(x, y, name=None):
+    return as_tensor(x) < y
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference: reduce_as op)."""
+    x, target = as_tensor(x), as_tensor(target)
+
+    def f(v, t):
+        extra = v.ndim - t.ndim
+        v = jnp.sum(v, axis=tuple(range(extra))) if extra else v
+        axes = tuple(i for i in range(v.ndim)
+                     if t.shape[i] == 1 and v.shape[i] != 1)
+        return jnp.sum(v, axis=axes, keepdims=True) if axes else v
+    return apply(f, x, target, name="reduce_as")
+
+
+# ---- reductions / integration ----
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        return apply(lambda yv, xv: jax.scipy.integrate.trapezoid(
+            yv, xv, axis=axis), y, as_tensor(x), name="trapezoid")
+    return apply(lambda yv: jax.scipy.integrate.trapezoid(
+        yv, dx=dx or 1.0, axis=axis), y, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+
+    def f(yv, *rest):
+        ax = axis % yv.ndim
+        y1 = jax.lax.slice_in_dim(yv, 1, yv.shape[ax], axis=ax)
+        y0 = jax.lax.slice_in_dim(yv, 0, yv.shape[ax] - 1, axis=ax)
+        if rest:
+            xv = rest[0]
+            x1 = jax.lax.slice_in_dim(xv, 1, xv.shape[ax], axis=ax)
+            x0 = jax.lax.slice_in_dim(xv, 0, xv.shape[ax] - 1, axis=ax)
+            d = x1 - x0
+        else:
+            d = dx or 1.0
+        return jnp.cumsum((y0 + y1) * d / 2.0, axis=ax)
+    if x is not None:
+        return apply(f, y, as_tensor(x), name="cumulative_trapezoid")
+    return apply(f, y, name="cumulative_trapezoid")
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    x = as_tensor(x)
+
+    def f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else \
+            (jnp.min(v), jnp.max(v))
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+    return apply(f, x, name="histogram_bin_edges")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _un(lambda v: jnp.vander(v, n, increasing=increasing),
+               "vander")(x)
+
+
+# ---- linalg-ish ----
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), as_tensor(x),
+                 as_tensor(y), name="tensordot")
+
+
+def cdist(x, y, p=2.0, compute_mode=None, name=None):
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, -1) + 1e-30)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+    return apply(f, as_tensor(x), as_tensor(y), name="cdist")
+
+
+def pdist(x, p=2.0, name=None):
+    x = as_tensor(x)
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def f(v):
+        d = v[:, None, :] - v[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.sum(d * d, -1) + 1e-30)
+        else:
+            m = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        return m[iu]
+    return apply(f, x, name="pdist")
+
+
+def matrix_transpose(x, name=None):
+    return _un(lambda v: jnp.swapaxes(v, -1, -2), "matrix_transpose")(x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(v):
+        ax = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=True) ** (1 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return _un(f, "renorm")(x)
+
+
+# ---- scatter-style ----
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x, value = as_tensor(x), as_tensor(value)
+
+    def f(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sd)
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return apply(f, x, value, name="slice_scatter")
+
+
+def select_scatter(x, value, axis, index, name=None):
+    x, value = as_tensor(x), as_tensor(value)
+
+    def f(v, val):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return apply(f, x, value, name="select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(v, val):
+        # build index grids for the diagonal
+        n = min(v.shape[axis1], v.shape[axis2]) - abs(offset)
+        i = jnp.arange(n) + max(0, -offset)
+        j = jnp.arange(n) + max(0, offset)
+        idx = [slice(None)] * v.ndim
+        idx[axis1] = i
+        idx[axis2] = j
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return apply(f, x, y, name="diagonal_scatter")
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda v, m: jnp.where(m, value, v), as_tensor(x),
+                 as_tensor(mask), name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = as_tensor(x), as_tensor(mask), as_tensor(value)
+
+    def f(v, m, val):
+        mflat = m.ravel()
+        pos = jnp.cumsum(mflat) - 1
+        src = jnp.take(val.ravel(), jnp.clip(pos, 0, val.size - 1))
+        return jnp.where(mflat, src, v.ravel()).reshape(v.shape)
+    return apply(f, x, mask, value, name="masked_scatter")
+
+
+def index_fill(x, index, axis, value, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def f(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply(f, x, index, name="index_fill")
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    md = {"raise": "clip"}.get(mode, mode)  # jit cannot raise; clamp
+    return apply(lambda v, i: jnp.take(v.ravel(), i, mode=md), x, index,
+                 name="take")
+
+
+# ---- complex views ----
+def as_complex(x, name=None):
+    return _un(lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+               "as_complex")(x)
+
+
+def as_real(x, name=None):
+    return _un(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+               "as_real")(x)
+
+
+def is_complex(x) -> bool:
+    return bool(jnp.issubdtype(as_tensor(x)._value.dtype,
+                               jnp.complexfloating))
+
+
+def is_integer(x) -> bool:
+    return bool(jnp.issubdtype(as_tensor(x)._value.dtype, jnp.integer))
+
+
+def is_floating_point(x) -> bool:
+    return bool(jnp.issubdtype(as_tensor(x)._value.dtype, jnp.floating))
+
+
+# ---- random ----
+def standard_gamma(alpha, name=None):
+    from .._core.random import next_rng_key
+    alpha = as_tensor(alpha)
+    key = next_rng_key()
+    return Tensor(jax.random.gamma(key, alpha._value), _internal=True)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from .._core.random import next_rng_key
+    key = next_rng_key()
+    out = jnp.exp(mean + std * jax.random.normal(
+        key, tuple(shape or [1]), jnp.float32))
+    return Tensor(out, _internal=True)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """reference: tensor/manipulation.py shard_index (PS embedding shard
+    remap)."""
+    size = (index_num + nshards - 1) // nshards
+
+    def f(v):
+        shard = v // size
+        local = v % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return _un(f, "shard_index")(input)
+
+
+def add_n(inputs, name=None):
+    ts = [as_tensor(t) for t in (inputs if isinstance(inputs, (list, tuple))
+                                 else [inputs])]
+    return apply(lambda *vs: sum(vs[1:], vs[0]), *ts, name="add_n")
+
+
+# ---- misc framework-level ----
+def rank(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x).ndim), _internal=True)
+
+
+def tolist(x):
+    return as_tensor(x).numpy().tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    pass
+
+
+def check_shape(x):
+    return list(as_tensor(x).shape)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """reference: hapi/dynamic_flops.py — rough conv/linear FLOP count."""
+    from ..nn.layer.layers import Layer
+    total = [0]
+    from .. import nn
+
+    def count(layer, inp, out):
+        if isinstance(layer, nn.Linear):
+            total[0] += 2 * int(np.prod(inp[0].shape)) * \
+                layer.weight.shape[-1] // inp[0].shape[-1]
+        elif hasattr(nn, "Conv2D") and isinstance(layer, nn.Conv2D):
+            kh, kw = layer._kernel_size if isinstance(
+                layer._kernel_size, (list, tuple)) else \
+                (layer._kernel_size, layer._kernel_size)
+            total[0] += 2 * int(np.prod(out.shape)) * \
+                layer._in_channels * kh * kw
+
+    hooks = []
+    for _, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(
+            lambda l, i, o: count(l, i, o)))
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.zeros(input_size, np.float32))
+    net(x)
+    for h in hooks:
+        h.remove()
+    return total[0]
+
+
+class LazyGuard:
+    """reference: python/paddle/nn/initializer/lazy_init.py — deferred
+    parameter initialization. Params here are cheap (host numpy), so the
+    guard is a no-op context for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,61 @@
+"""Auto-generated in-place (`op_`) variants.
+
+The reference maps every inplace op onto its functional kernel
+(paddle/phi/ops/yaml inplace entries); here each `op_` calls the
+functional op and rebinds the tensor's value via ``_inplace_from`` — the
+framework's in-place emulation on immutable jax arrays (SURVEY §7 hard
+part 1).
+"""
+from __future__ import annotations
+
+from .._core.tensor import Tensor
+from ._registry import as_tensor
+
+# functional base -> generated <base>_ names. Bases resolve against the
+# top-level paddle_tpu namespace after all op modules are loaded.
+INPLACE_BASES = [
+    "abs", "acos", "add", "addmm", "asin", "atan", "bernoulli",
+    "bitwise_and", "bitwise_invert", "bitwise_left_shift",
+    "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+    "cast", "ceil", "clip", "copysign", "cos", "cosh", "cumprod",
+    "cumsum", "digamma", "divide", "equal", "erf", "exp", "expm1",
+    "fill_diagonal", "flatten", "floor", "floor_divide", "floor_mod",
+    "frac", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+    "lcm", "ldexp", "lerp", "less_equal", "less_than", "lgamma", "log",
+    "log10", "log1p", "log2", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "logit", "masked_fill", "multiply",
+    "nan_to_num", "neg", "not_equal", "pow", "put_along_axis",
+    "reciprocal", "remainder", "renorm", "round", "rsqrt", "scale",
+    "scatter", "sigmoid", "sin", "sinh", "sqrt", "square", "squeeze",
+    "subtract", "t", "tan", "tanh", "transpose", "tril", "triu",
+    "trunc", "unsqueeze", "where", "multigammaln", "polygamma",
+    "gammainc", "gammaincc", "gammaln", "sinc", "mod", "less",
+    "masked_scatter", "index_fill",
+]
+
+
+def install(ns: dict):
+    """Generate `<op>_` into namespace ns for every base present."""
+    made = []
+    for base in INPLACE_BASES:
+        fn = ns.get(base)
+        if fn is None or (base + "_") in ns:
+            continue
+
+        def make(f):
+            def inplace(x, *args, **kwargs):
+                t = as_tensor(x)
+                out = f(t, *args, **kwargs)
+                return t._inplace_from(out)
+            return inplace
+
+        ip = make(fn)
+        ip.__name__ = base + "_"
+        ip.__doc__ = f"In-place variant of :func:`{base}` (rebinds the " \
+                     f"tensor's value)."
+        ns[base + "_"] = ip
+        # also attach as Tensor method when not already defined
+        if not hasattr(Tensor, base + "_"):
+            setattr(Tensor, base + "_", ip)
+        made.append(base + "_")
+    return made
