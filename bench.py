@@ -8,15 +8,19 @@ vs_baseline is reported against the 40%-MFU north star.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The measurement runs in a child process under a watchdog timeout; the parent
+retries transient backend-init failures (the TPU tunnel can be flaky) and
+ALWAYS prints exactly one JSON line — with an ``"error"`` field if every
+attempt failed — so the driver has something to parse no matter what.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
-import jax
-import jax.numpy as jnp
 
 
 def pick_config():
@@ -27,6 +31,8 @@ def pick_config():
     batch 2 × seq 4096 fits a 16G-HBM chip (v5e) with headroom; larger
     chips could scale up, but this config keeps the bench portable.
     """
+    import jax
+    import jax.numpy as jnp
     from paddle_tpu.models import llama
     dev = jax.devices()[0]
     if dev.platform == "tpu":
@@ -52,8 +58,11 @@ def peak_flops(dev) -> float:
     return 275e12
 
 
-def main():
-    from paddle_tpu.models import llama, train
+def measure():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import train
 
     cfg, seq, batch = pick_config()
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -80,7 +89,7 @@ def main():
     toks = batch * seq
     tps = toks / dt
     mfu = tps * cfg.flops_per_token(seq) / peak_flops(jax.devices()[0])
-    print(json.dumps({
+    return {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tps, 2),
         "unit": "tokens/s",
@@ -89,8 +98,60 @@ def main():
                   "params": cfg.num_params(),
                   "device": str(jax.devices()[0].device_kind),
                   "loss": lossv},
+    }
+
+
+def child_main():
+    plat = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
+    if plat:  # local/CI smoke runs; driver runs on the real chip
+        import jax
+        jax.config.update("jax_platforms", plat)
+    result = measure()
+    print(json.dumps(result))
+    sys.stdout.flush()
+    os._exit(0)  # skip hanging plugin destructors at interpreter exit
+
+
+def parent_main():
+    """Run the measurement in a watchdog-guarded child; retry transient
+    backend-init failures; ALWAYS print exactly one JSON line."""
+    attempts = int(os.environ.get("PADDLE_TPU_BENCH_ATTEMPTS", "3"))
+    timeout_s = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
+    last_err = "unknown"
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                timeout=timeout_s,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {i + 1}: watchdog timeout after {timeout_s}s"
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(parsed, dict) and "metric" in parsed:
+                print(line)
+                sys.stdout.flush()
+                os._exit(0)
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-15:]
+        last_err = (f"attempt {i + 1}: rc={proc.returncode}; "
+                    + " | ".join(tail)[-1500:])
+        if i + 1 < attempts:
+            time.sleep(5 * (i + 1))  # backoff before retrying a flaky tunnel
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+        "error": last_err,
     }))
+    sys.stdout.flush()
+    os._exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main()
+    parent_main()

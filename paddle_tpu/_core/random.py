@@ -27,12 +27,18 @@ class Generator:
 
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.key(seed)
-        self._offset = 0
+        self._key = None  # lazily created — constructing a key initializes
+        self._offset = 0  # the JAX backend, which must not happen at import
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int):
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._key = None
         self._offset = 0
         return self
 
@@ -41,7 +47,7 @@ class Generator:
 
     def get_state(self):
         return (self._seed, self._offset,
-                np.asarray(jax.random.key_data(self._key)))
+                np.asarray(jax.random.key_data(self.key)))
 
     def set_state(self, state):
         self._seed, self._offset, key_data = state
@@ -49,30 +55,35 @@ class Generator:
             jax.numpy.asarray(key_data))
 
     def next_key(self):
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self.key)
         self._offset += 1
         return sub
 
 
-_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+# Created on first use, never at import: ``import paddle_tpu`` must not
+# initialize the JAX backend (a hung device tunnel would poison every entry
+# point otherwise).
+_default_generator: Optional[Generator] = None
 
 
 def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(np.random.randint(0, 2**31 - 1))
     return _default_generator
 
 
 def seed(s: int):
     """reference: python/paddle/framework/random.py ``paddle.seed``."""
-    _default_generator.manual_seed(int(s))
-    return _default_generator
+    return default_generator().manual_seed(int(s))
 
 
 def get_rng_state():
-    return [_default_generator.get_state()]
+    return [default_generator().get_state()]
 
 
 def set_rng_state(state):
-    _default_generator.set_state(state[0])
+    default_generator().set_state(state[0])
 
 
 class rng_scope:
@@ -104,7 +115,7 @@ def next_rng_key():
         key, ctr = scope
         scope[1] = ctr + 1
         return jax.random.fold_in(key, ctr)
-    return _default_generator.next_key()
+    return default_generator().next_key()
 
 
 def in_rng_scope() -> bool:
@@ -121,7 +132,7 @@ class use_generator:
 
     def __enter__(self):
         global _default_generator
-        self._old = _default_generator
+        self._old = default_generator()
         _default_generator = self._gen
         return self._gen
 

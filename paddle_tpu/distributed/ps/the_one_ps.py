@@ -137,8 +137,7 @@ class PsServer:
 
 class PsClient:
     """reference: brpc_ps_client.h — pull/push against named servers.
-    Sparse keys are range-partitioned across servers (key % num_servers,
-    the reference's default shard rule)."""
+    Sparse keys are mod-hash sharded across servers (key % num_servers)."""
 
     def __init__(self, server_names: List[str]):
         self.servers = list(server_names)
@@ -154,9 +153,9 @@ class PsClient:
 
     def pull_sparse(self, name: str, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
+        if keys.size == 0:
+            return np.zeros((0, 0), np.float32)
         n = len(self.servers)
-        out = np.empty((len(keys),), object)
-        result = np.empty((len(keys), 0), np.float32)
         parts = {}
         for si in range(n):
             mask = (keys % n) == si
@@ -165,11 +164,9 @@ class PsClient:
                              self._rpc().rpc_async(
                                  self.servers[si], _srv_pull_sparse,
                                  args=(name, keys[mask])))
-        dim = None
         rows = [None] * len(keys)
         for si, (idx, fut) in parts.items():
             vals = fut.wait()
-            dim = vals.shape[1]
             for j, i in enumerate(idx.tolist()):
                 rows[i] = vals[j]
         return np.stack(rows).astype(np.float32)

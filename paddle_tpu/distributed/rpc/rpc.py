@@ -86,15 +86,21 @@ def init_rpc(name: str, rank: Optional[int] = None,
         os.environ.get("PADDLE_TRAINER_ID", "0"))
     world_size = world_size or int(
         os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    server = _Server(("127.0.0.1", 0), _Handler)
+    # The wire protocol is pickle (code execution on deserialize), so only
+    # expose the server beyond loopback when multi-host is explicitly
+    # requested via PADDLE_LOCAL_IP — the address peers should dial.
+    host = os.environ.get("PADDLE_LOCAL_IP")
+    bind = "0.0.0.0" if host else "127.0.0.1"
+    server = _Server((bind, 0), _Handler)
     port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True).start()
-
+    if not host:
+        host = "127.0.0.1"
     reg = os.environ.get("PADDLE_RPC_REGISTRY", "/tmp/paddle_tpu_rpc")
     job = os.environ.get("PADDLE_JOB_ID", "default")
     os.makedirs(os.path.join(reg, job), exist_ok=True)
     with open(os.path.join(reg, job, f"{name}.addr"), "w") as f:
-        f.write(f"{rank}\t127.0.0.1\t{port}")
+        f.write(f"{rank}\t{host}\t{port}")
 
     _state.update(name=name, rank=rank, world=world_size, server=server,
                   pool=concurrent.futures.ThreadPoolExecutor(16))

@@ -129,17 +129,26 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
 
     key, k0 = jax.random.split(key)
     first = sample(logits, k0)
+    # EOS handling in a static scan: early exit is impossible, so carry a
+    # per-sequence finished flag and pin tokens to eos once it fires
+    # (matches the reference generation stack's padded outputs —
+    # reference: python/paddle/generation/utils.py stopping_criteria).
+    eos = eos_token_id
+    done0 = (first == eos) if eos is not None else jnp.zeros((B,), bool)
 
     def step(carry, i):
-        cache, tok, kk = carry
+        cache, tok, kk, done = carry
         kk, ks = jax.random.split(kk)
         logits, cache = _forward_cached(
             params, tok[:, None], cache, S + i, cfg, max_len)
         nxt = sample(logits, ks)
-        return (cache, nxt, kk), nxt
+        if eos is not None:
+            nxt = jnp.where(done, jnp.int32(eos), nxt)
+            done = done | (nxt == eos)
+        return (cache, nxt, kk, done), nxt
 
-    (_, _, _), toks = lax.scan(
-        step, (cache, first, key), jnp.arange(max_new_tokens - 1))
+    (_, _, _, _), toks = lax.scan(
+        step, (cache, first, key, done0), jnp.arange(max_new_tokens - 1))
     out = jnp.concatenate(
         [prompt, first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
     return out

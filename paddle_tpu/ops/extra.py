@@ -67,23 +67,29 @@ column_stack = _multi(jnp.column_stack, "column_stack")
 row_stack = vstack
 
 
+def _np_split(x, num_or_indices, axis, name):
+    # split inside the traced function (multi-output apply) so gradients
+    # flow to the input — wrapping precomputed parts as captured constants
+    # would record a zero vjp
+    x = as_tensor(x)
+    if not isinstance(num_or_indices, int):
+        num_or_indices = [int(raw(i)) for i in num_or_indices]
+    return list(apply(
+        lambda v: tuple(jnp.split(v, num_or_indices, axis=axis)),
+        x, name=name))
+
+
 def hsplit(x, num_or_indices, name=None):
     x = as_tensor(x)
-    parts = jnp.split(x._value, num_or_indices,
-                      axis=0 if x.ndim == 1 else 1)
-    return [apply(lambda v, p=p: p, x, name="hsplit") for p in parts]
+    return _np_split(x, num_or_indices, 0 if x.ndim == 1 else 1, "hsplit")
 
 
 def vsplit(x, num_or_indices, name=None):
-    x = as_tensor(x)
-    parts = jnp.split(x._value, num_or_indices, axis=0)
-    return [apply(lambda v, p=p: p, x, name="vsplit") for p in parts]
+    return _np_split(x, num_or_indices, 0, "vsplit")
 
 
 def dsplit(x, num_or_indices, name=None):
-    x = as_tensor(x)
-    parts = jnp.split(x._value, num_or_indices, axis=2)
-    return [apply(lambda v, p=p: p, x, name="dsplit") for p in parts]
+    return _np_split(x, num_or_indices, 2, "dsplit")
 
 
 def atleast_1d(*xs, name=None):

@@ -54,6 +54,18 @@ def available() -> bool:
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
+def _block_iota(block_q, block_k, dim):
+    return jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), dim)
+
+
+def _zero_pad_rows(x, start, valid_len):
+    """Zero rows >= valid_len (block-local). Out-of-bounds Pallas reads are
+    undefined (NaN in interpret mode) and 0*NaN = NaN would leak through the
+    matmul accumulators, so padded inputs must be zeroed at load time."""
+    rows = start + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(rows < valid_len, x, jnp.zeros_like(x))
+
+
 # ---------------- forward ----------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, scale, causal, block_q, block_k, seq_k):
@@ -77,15 +89,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         q = q_ref[0]                                # (block_q, d) bf16 ok:
         k = k_ref[0]                                # MXU takes bf16 inputs
         v = v_ref[0]                                # with fp32 accumulate
+        if seq_k % block_k:
+            k = _zero_pad_rows(k, ki * block_k, seq_k)
+            v = _zero_pad_rows(v, ki * block_k, seq_k)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+            rows = qi * block_q + _block_iota(block_q, block_k, 0)
+            cols = ki * block_k + _block_iota(block_q, block_k, 1)
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        if seq_k % block_k:
+            # last k-block is padded: Pallas out-of-bounds reads are
+            # undefined, so mask columns >= seq_k out of the softmax
+            cols = ki * block_k + _block_iota(block_q, block_k, 1)
+            s = jnp.where(cols < seq_k, s, DEFAULT_MASK_VALUE)
         m_prev = m_ref[:]                            # (bq, 128)
         m_cur = jnp.max(s, axis=1, keepdims=True)    # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
@@ -146,7 +164,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 # ---------------- backward ----------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k):
+                    *, scale, causal, block_q, block_k, seq_q, seq_k):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -168,15 +186,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]                    # (block_q, 1)
         delta = delta_ref[0]                # (block_q, 1)
+        if seq_q % block_q:
+            q = _zero_pad_rows(q, qi * block_q, seq_q)
+            do = _zero_pad_rows(do, qi * block_q, seq_q)
+            lse = _zero_pad_rows(lse, qi * block_q, seq_q)
+            delta = _zero_pad_rows(delta, qi * block_q, seq_q)
+        if seq_k % block_k:
+            k = _zero_pad_rows(k, ki * block_k, seq_k)
+            v = _zero_pad_rows(v, ki * block_k, seq_k)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+            rows = qi * block_q + _block_iota(block_q, block_k, 0)
+            cols = ki * block_k + _block_iota(block_q, block_k, 1)
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)                # (bq, bk) f32
+        if seq_q % block_q or seq_k % block_k:
+            # padded q-rows would contaminate the dk/dv row-sums (their
+            # lse/do are out-of-bounds garbage); padded k-cols only produce
+            # garbage in dk/dv rows that get cropped, but zero them too so
+            # inf/NaN can't leak through the accumulator
+            rows = qi * block_q + _block_iota(block_q, block_k, 0)
+            cols = ki * block_k + _block_iota(block_q, block_k, 1)
+            p = jnp.where((rows < seq_q) & (cols < seq_k), p, 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -194,7 +226,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_k):
+                   dq_acc, *, scale, causal, block_q, block_k, seq_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -215,18 +247,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
+        if seq_k % block_k:
+            k = _zero_pad_rows(k, ki * block_k, seq_k)
+            v = _zero_pad_rows(v, ki * block_k, seq_k)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+            rows = qi * block_q + _block_iota(block_q, block_k, 0)
+            cols = ki * block_k + _block_iota(block_q, block_k, 1)
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale)
+        if seq_k % block_k:
+            # padded k-cols would contaminate the dq column-sums
+            cols = ki * block_k + _block_iota(block_q, block_k, 1)
+            ds = jnp.where(cols < seq_k, ds, 0.0)
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -250,7 +287,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, seq_q=sq, seq_k=sk),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
@@ -277,7 +314,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, seq_k=sk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),

@@ -92,3 +92,37 @@ def test_flash_attn_unpadded_segments():
     p /= p.sum(-1, keepdims=True)
     ref = p @ qv
     np.testing.assert_allclose(out.numpy()[:, 0], ref, atol=1e-4)
+
+
+def _ref_rect(q, k, v, causal):
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        m = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(160, 160), (128, 192), (192, 320)])
+def test_flash_nondivisible_blocks(causal, sq, sk):
+    """Sequence lengths NOT divisible by the block size: the last padded
+    block must be masked out of the softmax and out of dq/dk/dv
+    (ADVICE r1 high: unmasked Pallas out-of-bounds padding)."""
+    B, H, D = 1, 2, 64
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (B, sq, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, sk, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, sk, H, D), jnp.float32)
+    kw = dict(causal=causal, block_q=128, block_k=128)
+    out = fa.flash_attention(q, k, v, **kw)
+    ref = _ref_rect(q, k, v, causal)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+    g = jax.grad(lambda *a: (fa.flash_attention(*a, **kw) ** 2).sum(),
+                 (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (_ref_rect(*a, causal) ** 2).sum(),
+                  (0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.abs(a - b).max()) < 1e-4, float(
+            jnp.abs(a - b).max())

@@ -101,3 +101,26 @@ class TestPredictor:
         np.testing.assert_allclose(outs[0],
                                    net(paddle.to_tensor(x)).numpy(),
                                    rtol=1e-6)
+
+
+def test_generate_eos_masks_tail():
+    """Once EOS is sampled, every later token must be pinned to EOS
+    (ADVICE r1: eos_token_id was accepted but unused)."""
+    from paddle_tpu.models import llama, generate
+    import jax
+    import jax.numpy as jnp
+    cfg = llama.LlamaConfig.tiny(num_layers=1, vocab_size=16)
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = jnp.ones((2, 3), jnp.int32)
+    # high temperature so every token id (incl. eos) gets sampled quickly
+    out = generate.generate(params, prompt, cfg, max_new_tokens=24,
+                            temperature=4.0, key=jax.random.key(7),
+                            eos_token_id=5)
+    toks = np.asarray(out)[:, 3:]
+    hit = False
+    for row in toks:
+        idx = np.nonzero(row == 5)[0]
+        if idx.size:
+            hit = True
+            assert (row[idx[0]:] == 5).all(), row
+    assert hit, toks  # with T=4 over 16 ids x 24 steps, eos must appear
