@@ -132,9 +132,13 @@ class PipelineLayer(Layer):
                 num_stages = (hcg.get_pipe_parallel_world_size()
                               if hcg else 1)
         self._num_stages = int(num_stages)
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         self._layers_desc = list(layers)
-        self._segment = SegmentLayers(self._layers_desc, self._num_stages,
-                                      seg_method).do_segment()
+        # VPP segments into num_stages * num_virtual chunks (reference:
+        # pp_layers.py PipelineLayer._num_virtual_pipeline_stages)
+        self._segment = SegmentLayers(
+            self._layers_desc, self._num_stages * self._num_virtual,
+            seg_method).do_segment()
         built = []
         shared_registry = {}
         for d in self._layers_desc:

@@ -11,17 +11,19 @@ activations through eager NCCL p2p; its 1F1B order exists to bound
 in-flight activations per worker. Under XLA's single-program model the
 schedule is expressed differently:
 
-- **train_batch** keeps the reference's CONTRACT: split the batch into
-  ``accumulate_steps`` microbatches, accumulate grads across them, average
-  the loss — bit-parity with the reference's loss math (microbatch loop =
-  gradient accumulation; XLA already overlaps compute/comm within each
-  compiled step).
 - **The true pipelined execution** (stages resident on different devices,
   microbatches in flight across the `pp` mesh axis) lives in
-  :mod:`pp_spmd` — a shard_map program where each pp coordinate holds its
-  stage's (stacked) weights and activations rotate via ``ppermute``; the
-  reverse pass of the differentiated scan IS the backward pipeline. The
-  flagship Llama path and ``dryrun_multichip`` use it.
+  :mod:`pp_spmd` and is the DEFAULT whenever the PipelineLayer's stages are
+  homogeneous (same per-stage parameter structure — the stacked-stage
+  requirement) and the hybrid topology has a pp axis: the engine stacks
+  per-stage params over the pp mesh axis, runs the schedule selected by
+  ``strategy.pipeline_configs["schedule_mode"]`` ("1F1B" default,
+  "F-then-B"/"gpipe" GPipe, "VPP" interleaved, "ZB" zero-bubble), and
+  writes the resulting grads into each parameter's ``.grad`` slot so
+  ``optimizer.step()`` works unchanged.
+- **Fallback** (heterogeneous stages, pp degree 1, or a GradScaler):
+  microbatch grad accumulation — the same loss/grad math without spatial
+  parallelism.
 """
 from __future__ import annotations
 
@@ -38,13 +40,24 @@ from .parallel_layers import PipelineLayer
 class PipelineParallel(MetaParallelBase):
     """reference: meta_parallel/pipeline_parallel.py:255."""
 
+    _SCHEDULES = {"1f1b": "1f1b", "f-then-b": "gpipe", "fthenb": "gpipe",
+                  "gpipe": "gpipe", "vpp": "interleave",
+                  "interleave": "interleave", "zb": "zero_bubble",
+                  "zbh1": "zero_bubble", "zero_bubble": "zero_bubble"}
+
     def __init__(self, layers: PipelineLayer, hcg, strategy):
         super().__init__(layers, hcg, strategy)
         pc = (strategy.pipeline_configs if strategy is not None else
               {"accumulate_steps": 1})
         self.accumulate_steps = int(pc.get("accumulate_steps", 1))
         self.micro_batch_size = int(pc.get("micro_batch_size", 1))
+        mode = str(pc.get("schedule_mode", "1F1B")).lower()
+        if mode not in self._SCHEDULES:
+            raise ValueError(f"unknown pipeline schedule_mode {mode!r}; "
+                             f"one of {sorted(set(self._SCHEDULES))}")
+        self.schedule = self._SCHEDULES[mode]
         self.total_loss = None
+        self._spmd_step = None  # lazily-built jitted schedule program
 
     def _split_micro(self, data):
         """Split [B, ...] inputs into accumulate_steps microbatches."""
@@ -63,11 +76,152 @@ class PipelineParallel(MetaParallelBase):
         return [Tensor(data._value[i * sz:(i + 1) * sz], _internal=True)
                 for i in range(m)]
 
+    # ---------------- SPMD schedule path ----------------
+    def _stage_param_lists(self):
+        """Per-stage [stage][layer] name->Parameter dicts, or None when the
+        stages are not homogeneous (the stacked-stage requirement)."""
+        from ....nn.layer.layers import Layer
+        num_seg = len(self._layers.segment_bounds()) - 1
+        stages = []
+        for s in range(num_seg):
+            ls = self._layers.stage_layers(s)
+            stages.append([(l, dict(l.named_parameters()))
+                           for l in ls if isinstance(l, Layer)])
+            if any(not isinstance(l, Layer) for l in ls):
+                return None  # plain callables can't be stacked
+        ref = [[sorted((k, tuple(p.shape), str(p.dtype))
+                       for k, p in lp[1].items()) for lp in stages[0]]]
+        for st in stages[1:]:
+            sig = [sorted((k, tuple(p.shape), str(p.dtype))
+                          for k, p in lp[1].items()) for lp in st]
+            if sig != ref[0]:
+                return None
+        return stages
+
+    def _can_spmd(self, scaler):
+        if scaler is not None:
+            return None
+        hcg = self._hcg
+        if hcg is None or hcg.get_pipe_parallel_world_size() < 2:
+            return None
+        mesh = getattr(hcg, "mesh", None)
+        if mesh is None or "pp" not in mesh.axis_names:
+            return None
+        loss_layer = self._layers._loss_fn
+        from ....nn.layer.layers import Layer
+        if isinstance(loss_layer, Layer) and list(loss_layer.parameters()):
+            return None  # parametric loss heads keep the accum path
+        num_seg = len(self._layers.segment_bounds()) - 1
+        pp = hcg.get_pipe_parallel_world_size()
+        if num_seg % pp != 0:
+            return None
+        if num_seg != pp and self.schedule != "interleave":
+            return None  # virtual chunks only make sense for VPP
+        return self._stage_param_lists()
+
+    def _spmd_forward_backward(self, stages, inputs, labels):
+        """Run the selected pp_spmd schedule and write grads into .grad."""
+        import jax
+        import jax.numpy as jnp
+        from . import pp_spmd
+
+        mesh = self._hcg.mesh
+        num_stages = self._hcg.get_pipe_parallel_world_size()
+        num_seg = len(stages)
+        num_chunks = num_seg // num_stages
+        M = self.accumulate_steps
+        loss_fn = self._layers._loss_fn
+        schedule = self.schedule
+        if schedule == "interleave" and num_chunks == 1:
+            schedule = "gpipe"  # VPP with one chunk IS the plain wavefront
+
+        def to_raw(t):
+            return t._value if isinstance(t, Tensor) else t
+
+        x = to_raw(inputs)
+        lb = to_raw(labels)
+        mbs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        lbs = lb.reshape((M, lb.shape[0] // M) + lb.shape[1:])
+
+        per_stage = [[{k: jnp.asarray(p._value) for k, p in pd.items()}
+                      for _, pd in st] for st in stages]
+
+        def stage_fn(stage_params, xin):
+            t = Tensor(xin, _internal=True)
+            for (layer, _), pd in zip(stages[0], stage_params):
+                t = layer.functional_call(pd, t, training=True)
+            return to_raw(t)
+
+        def head_loss(_head, y, label):
+            out = loss_fn(Tensor(y, _internal=True),
+                          Tensor(label, _internal=True))
+            return to_raw(out)
+
+        if self._spmd_step is None:
+            if schedule in ("1f1b", "zero_bubble"):
+                stacked_tpl = pp_spmd.stack_stage_params(per_stage, mesh)
+
+                def run(stacked, mb, lab):
+                    loss, dw, _, _ = pp_spmd.pipeline_1f1b(
+                        stage_fn, head_loss, stacked, {}, mb, lab, mesh,
+                        defer_dw=(schedule == "zero_bubble"))
+                    return loss, dw
+            elif schedule == "interleave":
+                stacked_tpl = pp_spmd.stack_stage_params_interleaved(
+                    per_stage, mesh, num_chunks)
+
+                def run(stacked, mb, lab):
+                    def total(sp):
+                        outs = pp_spmd.pipeline_interleave(
+                            stage_fn, sp, mb, mesh, num_chunks)
+                        return jnp.mean(jax.vmap(
+                            lambda y, l: head_loss({}, y, l))(outs, lab))
+                    return jax.value_and_grad(total)(stacked)
+            else:  # gpipe
+                stacked_tpl = pp_spmd.stack_stage_params(per_stage, mesh)
+
+                def run(stacked, mb, lab):
+                    def total(sp):
+                        return pp_spmd.pipeline_loss_spmd(
+                            stage_fn, head_loss, sp, {}, mb, lab, mesh)
+                    return jax.value_and_grad(total)(stacked)
+            self._spmd_step = (jax.jit(run), stacked_tpl)
+
+        step, _ = self._spmd_step
+        if schedule == "interleave":
+            stacked = pp_spmd.stack_stage_params_interleaved(
+                per_stage, mesh, num_chunks)
+        else:
+            stacked = pp_spmd.stack_stage_params(per_stage, mesh)
+        loss, dstacked = step(stacked, mbs, lbs)
+
+        # scatter grads back into parameter .grad slots
+        for s, st in enumerate(stages):
+            for li, (_, pd) in enumerate(st):
+                for k, p in pd.items():
+                    if schedule == "interleave":
+                        g = dstacked[li][k][s % num_stages, s // num_stages]
+                    else:
+                        g = dstacked[li][k][s]
+                    g = Tensor(g, _internal=True)
+                    p.grad = g if p.grad is None else p.grad + g
+        return Tensor(loss, _internal=True)
+
     def forward_backward_pipeline(self, data, scaler=None):
-        """reference: pipeline_parallel.py:575 — 1F1B. Grad-accumulation
-        semantics (identical loss/grads); see module docstring for where
-        the spatial pipelining happens."""
+        """reference: pipeline_parallel.py:575. Dispatches to the pp_spmd
+        schedule selected by pipeline_configs["schedule_mode"] when the
+        stages are stackable (module docstring); grad-accumulation
+        semantics otherwise."""
         inputs, labels = data
+        stages = self._can_spmd(scaler)
+        if stages is not None:
+            try:
+                self.total_loss = self._spmd_forward_backward(
+                    stages, inputs, labels)
+                return self.total_loss
+            except Exception:
+                self._spmd_step = None
+                raise
         micro_in = self._split_micro(inputs)
         micro_lb = self._split_micro(labels)
         total = None

@@ -22,11 +22,30 @@ p2p_communication.py eager NCCL p2p). The reference pipelines across
   keeps auto-sharding them inside the manual pp program (jax.shard_map
   partial-manual mode).
 
-Zero-bubble-style schedules reorder backward-weight vs backward-input work;
-XLA's scheduler already overlaps the transposed scan's collectives with
-compute, and the bubble fraction here matches GPipe: (P-1)/(M+P-1) — driven
-down by raising the microbatch count M, the same lever the reference's
-1F1B/VPP passes pull.
+Four schedules, mirroring the reference's set (reference:
+meta_parallel/pipeline_parallel.py:575 1F1B, :1174 interleaved VPP, :2256
+FThenB; passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62):
+
+- ``gpipe`` (``pipeline_spmd``): forward wavefront scan; AD reverses it.
+  Bubble (P-1)/(M+P-1); activation residency grows with M (all in-flight
+  microbatch residuals live until the backward wavefront).
+- ``interleave`` (``pipeline_interleave``): each pp coordinate holds
+  ``num_chunks`` non-adjacent virtual stages (Megatron VPP); microbatches
+  lap the ring num_chunks times. Bubble shrinks to
+  (P-1)/(M*num_chunks + P-1) at GPipe-like residency.
+- ``1f1b`` (``pipeline_1f1b``): ONE combined scan runs the forward and the
+  hand-written backward concurrently; stage inputs live in a (2P-1)-slot
+  ring carried through the scan, so activation residency is bounded by the
+  pipeline depth — NOT by M. This is the reference 1F1B's memory contract;
+  under lockstep SPMD it costs ~P extra ticks vs gpipe, the price of
+  in-scan backward. Backward recomputes the stage forward from the saved
+  input (remat), the same tradeoff the big configs already take.
+- ``zero_bubble`` (``pipeline_1f1b(defer_dw=True)``): 1F1B structure but
+  the per-tick backward computes only dX (the serial dependency); dW
+  matmuls are hoisted out of the scan into one batched contraction over
+  the stashed (input, cotangent) pairs — the XLA translation of
+  zero-bubble's "fill bubbles with W-grad work": the serialized chain per
+  tick drops from fwd+dX+dW to fwd+dX, at gpipe-like stash memory.
 """
 from __future__ import annotations
 
@@ -117,3 +136,232 @@ def pipeline_loss_spmd(stage_fn: Callable, loss_fn: Callable,
                          pp_axis)
     losses = jax.vmap(lambda y, l: loss_fn(head_params, y, l))(outs, labels)
     return jnp.mean(losses)
+
+
+def stack_stage_params_interleaved(per_stage_params: Sequence[Any],
+                                   mesh: Mesh, num_chunks: int,
+                                   pp_axis: str = "pp"):
+    """Stack V = P*num_chunks virtual-stage pytrees into [P, num_chunks, ...]
+    arrays (virtual stage s lives on device s % P as chunk s // P — the
+    Megatron round-robin layout), dim 0 sharded over pp."""
+    P_ = mesh.shape[pp_axis]
+    V = P_ * num_chunks
+    assert len(per_stage_params) == V
+    rows = []
+    for d in range(P_):
+        chunks = [per_stage_params[c * P_ + d] for c in range(num_chunks)]
+        rows.append(jax.tree.map(lambda *xs: jnp.stack(xs, 0), *chunks))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *rows)
+
+    def place(x):
+        spec = [pp_axis] + [None] * (x.ndim - 1)
+        try:
+            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        except Exception:
+            return x
+    return jax.tree.map(place, stacked)
+
+
+def pipeline_interleave(stage_fn: Callable, stacked_params, microbatches,
+                        mesh: Mesh, num_chunks: int, pp_axis: str = "pp"):
+    """Interleaved (VPP) wavefront: V = P*num_chunks virtual stages laid
+    out round-robin; the Megatron interleaved schedule in closed form.
+
+    Device d at tick t serves coordinate u = t - d, decomposed
+    u = g*(v*P) + c*P + r  ->  chunk c, microbatch m = g*P + r.
+    This is a per-device bijection (each device busy every steady tick) and
+    every virtual stage's output is consumed by the next ring device exactly
+    one tick later — so a single ppermute carries all traffic and the
+    wavefront finishes in T = M*num_chunks + P - 1 ticks: bubble
+    (P-1)/(M*v + P-1), the VPP contract. Requires M % P == 0 (Megatron's
+    constraint, reference: meta_parallel/pipeline_parallel.py:1174).
+
+    stage_fn(chunk_params, x) -> y        (uniform across virtual stages)
+    stacked_params: pytree [P, num_chunks, ...], dim 0 sharded over pp
+    microbatches:   [M, mb, ...] stage-0 inputs
+    returns [M, ...] outputs of the last virtual stage. Differentiable.
+    """
+    num_stages = mesh.shape[pp_axis]
+    M = microbatches.shape[0]
+    assert M % num_stages == 0, (
+        f"interleaved schedule needs microbatches ({M}) % pp stages "
+        f"({num_stages}) == 0")
+    vP = num_stages * num_chunks
+    T = M * num_chunks + num_stages - 1
+    manual = frozenset({pp_axis})
+
+    def per_device(params_local, mb_local):
+        params_me = jax.tree.map(lambda x: x[0], params_local)  # [v, ...]
+        stage = lax.axis_index(pp_axis)
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        x0 = jnp.zeros_like(mb_local[0])
+        out0 = jnp.zeros((M,) + mb_local.shape[1:], mb_local.dtype)
+
+        def tick(carry, t):
+            x_rc, out_buf = carry
+            u = t - stage
+            g = jnp.where(u >= 0, u // vP, 0)
+            rem = jnp.clip(u - g * vP, 0, vP - 1)
+            c = rem // num_stages
+            m = jnp.clip(g * num_stages + rem % num_stages, 0, M - 1)
+            active = (u >= 0) & (u < M * num_chunks)
+
+            feed = lax.dynamic_index_in_dim(mb_local, m, 0, keepdims=False)
+            x_in = jnp.where((stage == 0) & (c == 0), feed, x_rc)
+            p_c = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                params_me)
+            y = stage_fn(p_c, x_in)
+            y = jnp.where(active, y, x_in)
+
+            emit = active & (stage == num_stages - 1) & (c == num_chunks - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                out_buf, y.astype(out_buf.dtype), m, 0)
+            out_buf = jnp.where(emit, upd, out_buf)
+
+            x_nx = lax.ppermute(y, pp_axis, perm)
+            return (x_nx, out_buf), None
+
+        (_, outs), _ = lax.scan(tick, (x0, out0), jnp.arange(T))
+        # out_buf is populated only on the last stage; replicate over pp
+        mask = (stage == num_stages - 1).astype(outs.dtype)
+        return lax.psum(outs * mask, pp_axis)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh, axis_names=manual,
+        in_specs=(jax.tree.map(lambda _: P(pp_axis), stacked_params), P()),
+        out_specs=P(), check_vma=False)
+    return fn(stacked_params, microbatches)
+
+
+def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
+                  head_params, microbatches, labels, mesh: Mesh,
+                  pp_axis: str = "pp", defer_dw: bool = False):
+    """Combined forward/backward 1F1B scan with depth-bounded residency.
+
+    stage_fn(stage_params, x) -> y           (uniform across stages)
+    loss_fn(head_params, y, label) -> scalar (per-microbatch mean loss)
+    stacked_params: pytree [P, ...] sharded over pp_axis
+    head_params:    replicated pytree (final norm / head weights)
+    microbatches:   [M, mb, ...]; labels: [M, ...]
+
+    Returns (mean_loss, d_stacked_params, d_head_params, d_microbatches) —
+    the hand-written pipeline VJP: stage i runs fwd of microbatch m at tick
+    i+m and bwd at tick 2(P-1)-i+m, stage inputs parked in a (2P-1)-slot
+    ring carried through the scan (activation residency ~2P, independent of
+    M). With defer_dw (zero-bubble), the in-scan backward emits only dX and
+    the stashed (x, dy) pairs; dW is one batched vjp after the scan.
+    """
+    num_stages = mesh.shape[pp_axis]
+    M = microbatches.shape[0]
+    T = M + 2 * num_stages - 2
+    R = 2 * num_stages - 1
+    manual = frozenset({pp_axis})
+    inv_m = 1.0 / M
+
+    def per_device(params_local, head, mb_local, lab_local):
+        params_me = jax.tree.map(lambda x: x[0], params_local)
+        stage = lax.axis_index(pp_axis)
+        last = num_stages - 1
+        perm_f = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        perm_b = [(i, (i - 1) % num_stages) for i in range(num_stages)]
+
+        zero_x = jnp.zeros_like(mb_local[0])
+        ring0 = jnp.zeros((R,) + zero_x.shape, zero_x.dtype)
+        dwsum0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                              params_me)
+        dhead0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                              head)
+        dx0 = jnp.zeros((M,) + zero_x.shape, jnp.float32)
+
+        def tick(carry, t):
+            (f_rc, b_rc, ring, dw, dhead, dx_out, loss_acc) = carry
+
+            # ---- forward slot: stage i runs microbatch m_f = t - i ----
+            m_f = t - stage
+            f_on = (m_f >= 0) & (m_f < M)
+            feed = lax.dynamic_index_in_dim(
+                mb_local, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(stage == 0, feed, f_rc)
+            y = stage_fn(params_me, x_in)
+            slot_f = jnp.mod(t, R)
+            ring = jnp.where(
+                f_on,
+                lax.dynamic_update_index_in_dim(ring, x_in, slot_f, 0),
+                ring)
+
+            # last stage: per-microbatch loss + cotangent, scaled by 1/M
+            lab = jax.tree.map(
+                lambda l: lax.dynamic_index_in_dim(
+                    l, jnp.clip(m_f, 0, M - 1), 0, keepdims=False),
+                lab_local)
+            lval, head_vjp = jax.vjp(lambda hp, yy: loss_fn(hp, yy, lab),
+                                     head, y)
+            dhead_c, dy_self = head_vjp(jnp.asarray(inv_m, jnp.float32))
+            on_last = f_on & (stage == last)
+            loss_acc = loss_acc + jnp.where(on_last, lval, 0.0)
+            dhead = jax.tree.map(
+                lambda acc, g: acc + jnp.where(on_last, g, 0.0),
+                dhead, dhead_c)
+
+            # ---- backward slot: stage i runs m_b = t - (2P-2-i) ----
+            m_b = t - (2 * last - stage)
+            b_on = (m_b >= 0) & (m_b < M)
+            # fwd of m_b on this stage happened at tick stage + m_b
+            slot_b = jnp.mod(stage + jnp.clip(m_b, 0, M - 1), R)
+            x_sv = lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
+            dy_in = jnp.where(stage == last, dy_self.astype(b_rc.dtype),
+                              b_rc)
+            _, stage_vjp = jax.vjp(stage_fn, params_me, x_sv)
+            dp_c, dx_c = stage_vjp(dy_in)
+            if not defer_dw:
+                dw = jax.tree.map(
+                    lambda acc, g: acc + jnp.where(b_on, g, 0.0).astype(
+                        jnp.float32),
+                    dw, dp_c)
+            dx_out = jnp.where(
+                b_on & (stage == 0),
+                lax.dynamic_update_index_in_dim(
+                    dx_out, dx_c.astype(jnp.float32),
+                    jnp.clip(m_b, 0, M - 1), 0),
+                dx_out)
+
+            f_nx = lax.ppermute(y, pp_axis, perm_f)
+            b_nx = lax.ppermute(dx_c.astype(b_rc.dtype), pp_axis, perm_b)
+            stash = (x_sv, dy_in, b_on) if defer_dw else None
+            return (f_nx, b_nx, ring, dw, dhead, dx_out, loss_acc), stash
+
+        init = (zero_x, jnp.zeros_like(zero_x), ring0, dwsum0, dhead0,
+                dx0, jnp.float32(0.0))
+        (_, _, _, dw, dhead, dx_out, loss_acc), stash = lax.scan(
+            tick, init, jnp.arange(T))
+
+        if defer_dw:
+            xs, dys, mask = stash
+            def one(x_sv, dy):
+                _, vjp = jax.vjp(stage_fn, params_me, x_sv)
+                return vjp(dy)[0]
+            dps = jax.vmap(one)(xs, dys)
+            dw = jax.tree.map(
+                lambda acc, g: acc + jnp.sum(
+                    jnp.where(mask.reshape((-1,) + (1,) * (g.ndim - 1)),
+                              g, 0.0).astype(jnp.float32), axis=0),
+                dw, dps)
+
+        # replicate scalars / edge products over pp (mask -> psum)
+        lastf = (stage == last).astype(jnp.float32)
+        loss_mean = lax.psum(loss_acc * lastf, pp_axis) * inv_m
+        dhead = jax.tree.map(lambda g: lax.psum(g * lastf, pp_axis), dhead)
+        dx_out = lax.psum(
+            dx_out * (stage == 0).astype(jnp.float32), pp_axis)
+        dw = jax.tree.map(lambda g: g[None], dw)  # -> [1,...] per device
+        return loss_mean, dw, dhead, dx_out
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh, axis_names=manual,
+        in_specs=(jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                  P(), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                   P(), P()),
+        check_vma=False)
+    return fn(stacked_params, head_params, microbatches, labels)

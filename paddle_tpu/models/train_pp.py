@@ -47,21 +47,69 @@ def state_shardings_pp(mesh: Mesh, cfg: llama.LlamaConfig,
                         sp, is_leaf=lambda x: isinstance(x, P))
 
 
+def interleave_layer_perm(cfg: llama.LlamaConfig, num_stages: int,
+                          num_chunks: int) -> "jnp.ndarray":
+    """Storage permutation for the interleaved (VPP) schedule: device d
+    must hold its num_chunks non-adjacent virtual stages contiguously, so
+    the state stores layers device-major ([d, c] order) and the step's
+    reshape to [P, v, layers/chunk] is zero-cost (no cross-shard moves).
+
+    ``params["layers"] = tree.map(lambda a: a[perm], layers)`` converts
+    canonical order to storage order; ``jnp.argsort(perm)`` converts back
+    (checkpoint IO should store canonical order).
+    """
+    L = cfg.num_layers
+    lc = L // (num_stages * num_chunks)
+    idx = []
+    for d in range(num_stages):
+        for c in range(num_chunks):
+            s = c * num_stages + d
+            idx.extend(range(s * lc, (s + 1) * lc))
+    return jnp.asarray(idx)
+
+
 def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
-                       num_microbatches: int, pp_axis: str = "pp",
+                       num_microbatches: int, schedule: str = "gpipe",
+                       num_chunks: int = 1, pp_axis: str = "pp",
                        dp_axis: str = "dp", lr: float = 3e-4,
                        b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8,
                        weight_decay: float = 0.1, grad_clip: float = 1.0):
-    """jitted ``step(state, tokens) -> (state, metrics)`` with the GPipe
-    wavefront over ``pp_axis``. Batch dim must divide num_microbatches.
+    """jitted ``step(state, tokens) -> (state, metrics)`` pipelined over
+    ``pp_axis`` with the selected schedule (pp_spmd module docstring):
+    "gpipe" AD wavefront, "interleave" VPP (state must be in
+    ``interleave_layer_perm`` storage order), "1f1b" depth-bounded
+    residency, "zero_bubble" 1F1B with deferred dW.
+    Batch dim must divide num_microbatches.
     """
     assert cfg.moe is None, "pp+MoE composition not yet supported"
+    assert schedule in ("gpipe", "interleave", "1f1b", "zero_bubble")
     num_stages = mesh.shape[pp_axis]
-    assert cfg.num_layers % num_stages == 0
-    lp_per_stage = cfg.num_layers // num_stages
+    nseg = num_stages * (num_chunks if schedule == "interleave" else 1)
+    assert cfg.num_layers % nseg == 0
+    lp_per_stage = cfg.num_layers // nseg
     dp = dp_axis if dp_axis in mesh.axis_names else None
 
-    from ..distributed.fleet.meta_parallel.pp_spmd import pipeline_spmd
+    from ..distributed.fleet.meta_parallel.pp_spmd import (
+        pipeline_spmd, pipeline_interleave, pipeline_1f1b)
+
+    def make_stage_fn(cos, sin):
+        def stage_fn(stage_params, xin):
+            def body(c, lp):
+                y, _ = llama._block(c, lp, cos, sin, cfg, None)
+                return y, None
+            y, _ = lax.scan(body, xin, stage_params)
+            return y
+        return stage_fn
+
+    def head_of(params):
+        return params["embed"].T if cfg.tie_embeddings else \
+            params["lm_head"]
+
+    def head_loss(hp, y, label):
+        h = llama.rms_norm(y, hp["final_norm"], cfg.rms_eps)
+        logits = (h @ hp["head"].astype(h.dtype)).astype(jnp.float32)
+        ce = llama._ce(logits[:, :-1], label[:, 1:])
+        return jnp.mean(ce)
 
     def loss(params, tokens):
         B, S = tokens.shape
@@ -69,29 +117,71 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
         mb = B // M
         x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
         cos, sin = llama.rope_tables(S, cfg.hd, cfg.rope_theta)
+        stage_fn = make_stage_fn(cos, sin)
 
-        def stage_fn(stage_params, xin):
-            def body(c, lp):
-                y, _ = llama._block(c, lp, cos, sin, cfg, None)
-                return y, None
-            y, _ = lax.scan(body, xin, stage_params)
-            return y
+        if schedule == "interleave":
+            stacked = jax.tree.map(
+                lambda a: a.reshape(num_stages, num_chunks, lp_per_stage,
+                                    *a.shape[1:]),
+                params["layers"])
+            mbs = x.reshape(M, mb, S, cfg.hidden_size)
+            outs = pipeline_interleave(stage_fn, stacked, mbs, mesh,
+                                       num_chunks, pp_axis)
+        else:
+            stacked = jax.tree.map(
+                lambda a: a.reshape(num_stages, lp_per_stage,
+                                    *a.shape[1:]),
+                params["layers"])
+            mbs = x.reshape(M, mb, S, cfg.hidden_size)
+            outs = pipeline_spmd(stage_fn, stacked, mbs, mesh, pp_axis)
+        outs = outs.reshape(B, S, cfg.hidden_size)
+        return _full_head_loss(params, outs, tokens)
 
+    def _full_head_loss(params, outs, tokens):
+        h = llama.rms_norm(outs, params["final_norm"], cfg.rms_eps)
+        head = head_of(params)
+        logits = (h @ head.astype(h.dtype)).astype(jnp.float32)[:, :-1]
+        ce = llama._ce(logits, tokens[:, 1:])
+        return jnp.mean(ce)
+
+    def loss_and_grads_1f1b(params, tokens):
+        B, S = tokens.shape
+        M = num_microbatches
+        mb = B // M
+        cos, sin = llama.rope_tables(S, cfg.hd, cfg.rope_theta)
+        stage_fn = make_stage_fn(cos, sin)
+
+        def embed_fn(emb):
+            x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+            return x.reshape(M, mb, S, cfg.hidden_size)
+
+        mbs, vjp_embed = jax.vjp(embed_fn, params["embed"])
+        labels = tokens.reshape(M, mb, S)
         stacked = jax.tree.map(
             lambda a: a.reshape(num_stages, lp_per_stage, *a.shape[1:]),
             params["layers"])
-        mbs = x.reshape(M, mb, S, cfg.hidden_size)
-        outs = pipeline_spmd(stage_fn, stacked, mbs, mesh, pp_axis)
-        outs = outs.reshape(B, S, cfg.hidden_size)
-        h = llama.rms_norm(outs, params["final_norm"], cfg.rms_eps)
-        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        logits = (h @ head.astype(h.dtype)).astype(jnp.float32)[:, :-1]
-        labels = tokens[:, 1:]
-        ce = llama._ce(logits, labels)
-        return jnp.mean(ce)
+        hp = {"final_norm": params["final_norm"], "head": head_of(params)}
+        lv, d_stacked, d_head, d_mbs = pipeline_1f1b(
+            stage_fn, head_loss, stacked, hp, mbs, labels, mesh, pp_axis,
+            defer_dw=(schedule == "zero_bubble"))
+        d_embed = vjp_embed(d_mbs.astype(mbs.dtype))[0].astype(jnp.float32)
+        grads = {
+            "embed": d_embed + (d_head["head"].T if cfg.tie_embeddings
+                                else 0.0),
+            "layers": jax.tree.map(
+                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]),
+                d_stacked),
+            "final_norm": d_head["final_norm"],
+        }
+        if not cfg.tie_embeddings:
+            grads["lm_head"] = d_head["head"]
+        return lv, grads
 
     def step_fn(state: TrainState, tokens):
-        lv, grads = jax.value_and_grad(loss)(state.params, tokens)
+        if schedule in ("1f1b", "zero_bubble"):
+            lv, grads = loss_and_grads_1f1b(state.params, tokens)
+        else:
+            lv, grads = jax.value_and_grad(loss)(state.params, tokens)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(grads)))
         scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))

@@ -1,0 +1,361 @@
+"""Pallas TPU fused kernels: RMSNorm(+residual), SwiGLU, RoPE, and
+decode-time block attention.
+
+TPU-native counterparts of the reference's fused GPU kernels
+(reference: paddle/phi/kernels/fusion/fused_layernorm_kernel.cu,
+fused_bias_act_kernel.cu, fused_rope_kernel.cu,
+block_multi_head_attention_kernel.cu). Each is a single HBM pass with fp32
+on-chip math and a hand-written VJP, so the backward is also one fused
+pass instead of XLA's recomputed chain.
+
+All kernels run in interpret mode on CPU for tests (``set_interpret``) and
+on real TPU otherwise; ``available()`` mirrors flash_attention's gate.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import available, set_interpret  # shared gate
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+from . import flash_attention as _fa
+
+
+def _interp():
+    return _fa._INTERPRET
+
+
+# ---------------- fused RMSNorm (+ residual) ----------------
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, r_ref, *, eps, has_res):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(
+        o_ref.dtype)
+    r_ref[...] = rstd.astype(jnp.float32)
+
+
+def _rms_norm_fwd(x, w, eps, block_rows):
+    n, h = x.shape
+    br = min(block_rows, n)
+    grid = (pl.cdiv(n, br),)
+    out, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps, has_res=False),
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, h), x.dtype),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+        interpret=_interp(),
+    )(x, w)
+    return out, rstd
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref, *, eps,
+                    n_rows, block_rows):
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    rstd = rstd_ref[...]
+    if n_rows % block_rows:
+        # zero padded rows: their garbage would leak into the dw row-sum
+        i = pl.program_id(0)
+        rows = i * block_rows + jax.lax.broadcasted_iota(
+            jnp.int32, x.shape, 0)
+        x = jnp.where(rows < n_rows, x, 0.0)
+        g = jnp.where(rows < n_rows, g, 0.0)
+        rstd = jnp.where(rows[:, :1] < n_rows, rstd, 0.0)
+    xhat = x * rstd
+    wg = g * w
+    # dx = rstd * (wg - xhat * mean(wg * xhat))
+    m = jnp.mean(wg * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (wg - xhat * m)).astype(dx_ref.dtype)
+    dwp_ref[...] = jnp.sum(g * xhat, axis=0, keepdims=True)  # block dw
+
+
+def _rms_norm_bwd(eps, block_rows, res, g):
+    x, w, rstd = res
+    n, h = x.shape
+    br = min(block_rows, n)
+    nb = pl.cdiv(n, br)
+    dx, dwp = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, eps=eps, n_rows=n,
+                          block_rows=br),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((br, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, h), x.dtype),
+                   jax.ShapeDtypeStruct((nb, h), jnp.float32)],
+        interpret=_interp(),
+    )(x, w, rstd, g)
+    return dx, jnp.sum(dwp, axis=0).astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm_2d(x, w, eps, block_rows):
+    out, _ = _rms_norm_fwd(x, w, eps, block_rows)
+    return out
+
+
+def _rms_norm_2d_fwd(x, w, eps, block_rows):
+    out, rstd = _rms_norm_fwd(x, w, eps, block_rows)
+    return out, (x, w, rstd)
+
+
+_rms_norm_2d.defvjp(_rms_norm_2d_fwd, _rms_norm_bwd)
+
+
+def rms_norm(x, w, eps: float = 1e-6, residual=None, block_rows: int = 256):
+    """Fused RMSNorm over the last dim; optional residual add fused into
+    the same pass (returns (out, x+residual) then, matching the
+    reference's fused_rms_norm contract)."""
+    if residual is not None:
+        x = x + residual  # XLA fuses this add into the kernel's HBM read
+        return rms_norm(x, w, eps, block_rows=block_rows), x
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    out = _rms_norm_2d(x2, w, float(eps), block_rows)
+    return out.reshape(shape)
+
+
+# ---------------- fused SwiGLU ----------------
+def _swiglu_fwd_kernel(g_ref, u_ref, o_ref):
+    g = g_ref[...].astype(jnp.float32)
+    u = u_ref[...].astype(jnp.float32)
+    o_ref[...] = (jax.nn.silu(g) * u).astype(o_ref.dtype)
+
+
+def _swiglu_bwd_kernel(g_ref, u_ref, d_ref, dg_ref, du_ref):
+    g = g_ref[...].astype(jnp.float32)
+    u = u_ref[...].astype(jnp.float32)
+    d = d_ref[...].astype(jnp.float32)
+    sig = jax.nn.sigmoid(g)
+    silu = g * sig
+    dsilu = sig * (1.0 + g * (1.0 - sig))
+    dg_ref[...] = (d * u * dsilu).astype(dg_ref.dtype)
+    du_ref[...] = (d * silu).astype(du_ref.dtype)
+
+
+def _swiglu_2d(g, u, block_rows):
+    n, h = g.shape
+    br = min(block_rows, n)
+    return pl.pallas_call(
+        _swiglu_fwd_kernel,
+        grid=(pl.cdiv(n, br),),
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                  pl.BlockSpec((br, h), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h), g.dtype),
+        interpret=_interp(),
+    )(g, u)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _swiglu(g, u, block_rows):
+    return _swiglu_2d(g, u, block_rows)
+
+
+def _swiglu_fwd_rule(g, u, block_rows):
+    return _swiglu_2d(g, u, block_rows), (g, u)
+
+
+def _swiglu_bwd_rule(block_rows, res, d):
+    g, u = res
+    n, h = g.shape
+    br = min(block_rows, n)
+    dg, du = pl.pallas_call(
+        _swiglu_bwd_kernel,
+        grid=(pl.cdiv(n, br),),
+        in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0))] * 3,
+        out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0))] * 2,
+        out_shape=[jax.ShapeDtypeStruct((n, h), g.dtype),
+                   jax.ShapeDtypeStruct((n, h), u.dtype)],
+        interpret=_interp(),
+    )(g, u, d)
+    return dg, du
+
+
+_swiglu.defvjp(_swiglu_fwd_rule, _swiglu_bwd_rule)
+
+
+def swiglu(g, u, block_rows: int = 256):
+    """Fused silu(g) * u (reference: fused_bias_act_kernel.cu swiglu path);
+    one HBM pass fwd, one bwd."""
+    shape = g.shape
+    out = _swiglu(g.reshape(-1, shape[-1]), u.reshape(-1, shape[-1]),
+                  block_rows)
+    return out.reshape(shape)
+
+
+# ---------------- fused RoPE (q and k in one launch) ----------------
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, sign):
+    x = x_ref[...].astype(jnp.float32)          # (1, bs, h*d)
+    c = cos_ref[...].astype(jnp.float32)        # (bs, d)
+    s = sin_ref[...].astype(jnp.float32) * sign
+    bs = x.shape[1]
+    d = c.shape[-1]
+    xh = x.reshape(bs, -1, d)                   # (bs, heads, d)
+    half = d // 2
+    x1 = xh[..., :half]
+    x2 = xh[..., half:]
+    c1 = c[:, None, :half]
+    s1 = s[:, None, :half]
+    out = jnp.concatenate([x1 * c1 - x2 * s1, x2 * c1 + x1 * s1], axis=-1)
+    o_ref[...] = out.reshape(1, bs, -1).astype(o_ref.dtype)
+
+
+def _rope_apply(x, cos, sin, sign, block_seq):
+    """x: (B, S, H, D) -> rotated; cos/sin: (S, D)."""
+    B, S, H, D = x.shape
+    bs = min(block_seq, S)
+    x3 = x.reshape(B, S, H * D)
+    out = pl.pallas_call(
+        functools.partial(_rope_kernel, sign=sign),
+        grid=(B, pl.cdiv(S, bs)),
+        in_specs=[pl.BlockSpec((1, bs, H * D), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((bs, D), lambda b, i: (i, 0)),
+                  pl.BlockSpec((bs, D), lambda b, i: (i, 0))],
+        out_specs=pl.BlockSpec((1, bs, H * D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, S, H * D), x.dtype),
+        interpret=_interp(),
+    )(x3, cos, sin)
+    return out.reshape(B, S, H, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _rope_qk(q, k, cos, sin, block_seq):
+    return (_rope_apply(q, cos, sin, 1.0, block_seq),
+            _rope_apply(k, cos, sin, 1.0, block_seq))
+
+
+def _rope_qk_fwd(q, k, cos, sin, block_seq):
+    return _rope_qk(q, k, cos, sin, block_seq), (cos, sin)
+
+
+def _rope_qk_bwd(block_seq, res, g):
+    cos, sin = res
+    dq, dk = g
+    # rotation is orthogonal: the VJP is rotation by -theta
+    return (_rope_apply(dq, cos, sin, -1.0, block_seq),
+            _rope_apply(dk, cos, sin, -1.0, block_seq), None, None)
+
+
+_rope_qk.defvjp(_rope_qk_fwd, _rope_qk_bwd)
+
+
+def rope_qk(q, k, cos, sin, block_seq: int = 256):
+    """Fused neox-style RoPE on q and k (reference:
+    fused_rope_kernel.cu). cos/sin: (S, D) tables; q (B,S,H,D),
+    k (B,S,HK,D)."""
+    return _rope_qk(q, k, cos.astype(jnp.float32),
+                    sin.astype(jnp.float32), block_seq)
+
+
+# ---------------- decode-time block attention (KV cache) ----------------
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
+                   *, scale, block_k):
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_sc[...] = jnp.full_like(m_sc, -jnp.inf)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    q = q_ref[0]                                  # (H_rep, D)
+    k = k_ref[0]                                  # (block_k, D)
+    v = v_ref[0]
+    cache_len = len_ref[0]
+    # zero possibly-padded cache rows: 0 * NaN would poison the p @ v sum
+    vrows = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+    v = jnp.where(vrows < cache_len, v, jnp.zeros_like(v))
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # (H_rep, bk)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(cols < cache_len, s, _fa.DEFAULT_MASK_VALUE)
+    m_prev = m_sc[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    p = jnp.where(cols < cache_len, p, 0.0)
+    l_sc[...] = alpha * l_sc[...] + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
+    acc[...] = acc[...] * alpha[:, :1] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_sc[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _done():
+        l = l_sc[:, :1]
+        o_ref[0] = (acc[...] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
+                     block_k: int = 512):
+    """Single-token flash attention against a padded KV cache (reference:
+    block_multi_head_attention_kernel.cu decode path).
+
+    q: (B, H, D) the current position's query
+    k_cache/v_cache: (B, S_max, HK, D); positions >= cache_len are masked
+    cache_len: scalar or (B,) int32 valid-length(s)
+    returns (B, H, D). GQA/MQA handled by head-group mapping, no repeat.
+    """
+    B, H, D = q.shape
+    S = k_cache.shape[1]
+    HK = k_cache.shape[2]
+    assert H % HK == 0
+    rep = H // HK
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    bk = min(block_k, S)
+    cache_len = jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (B,))
+
+    # (B, S, HK, D) -> (B*HK, S, D); q -> (B*HK, rep, D): one grid row per
+    # kv-head group so GQA costs no HBM duplication
+    kt = k_cache.transpose(0, 2, 1, 3).reshape(B * HK, S, D)
+    vt = v_cache.transpose(0, 2, 1, 3).reshape(B * HK, S, D)
+    qt = q.reshape(B, HK, rep, D).reshape(B * HK, rep, D)
+    lens = jnp.repeat(cache_len, HK)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=s, block_k=bk),
+        grid=(B * HK, pl.cdiv(S, bk)),
+        in_specs=[
+            pl.BlockSpec((1, rep, D), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1,), lambda i, j: (i,),
+                         memory_space=pltpu.SMEM if _PALLAS_OK else None),
+        ],
+        out_specs=pl.BlockSpec((1, rep, D), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * HK, rep, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rep, D), jnp.float32),
+            pltpu.VMEM((rep, 128), jnp.float32),
+            pltpu.VMEM((rep, 128), jnp.float32),
+        ],
+        interpret=_interp(),
+    )(qt, kt, vt, lens)
+    return out.reshape(B, HK, rep, D).reshape(B, H, D)

@@ -1,0 +1,125 @@
+"""Fused Pallas kernel numerics (interpret mode on CPU)
+(reference: paddle/phi/kernels/fusion/* GPU kernels; tests mirror
+test/legacy_test/test_fused_* numpy-reference pattern)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops.pallas.fused as fz
+import paddle_tpu.ops.pallas.flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    fa.set_interpret(True)
+    yield
+    fa.set_interpret(False)
+
+
+def test_rms_norm_matches_ref():
+    x = jax.random.normal(jax.random.key(0), (6, 33, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (64,)) * 0.1 + 1.0
+
+    def ref(x, w):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        return xf * jax.lax.rsqrt(var + 1e-6) * w
+
+    out = fz.rms_norm(x, w, 1e-6, block_rows=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, w)),
+                               atol=1e-5)
+    g = jax.grad(lambda x, w: (fz.rms_norm(x, w, 1e-6, block_rows=64)
+                               ** 2).sum(), (0, 1))(x, w)
+    gr = jax.grad(lambda x, w: (ref(x, w) ** 2).sum(), (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(gr[0]),
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(gr[1]),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_rms_norm_residual():
+    x = jax.random.normal(jax.random.key(0), (4, 16), jnp.float32)
+    r = jax.random.normal(jax.random.key(1), (4, 16), jnp.float32)
+    w = jnp.ones((16,))
+    out, res_out = fz.rms_norm(x, w, 1e-6, residual=r)
+    np.testing.assert_allclose(np.asarray(res_out), np.asarray(x + r),
+                               atol=1e-6)
+    ref = fz.rms_norm(x + r, w, 1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_swiglu_matches_ref():
+    g = jax.random.normal(jax.random.key(0), (5, 40, 32), jnp.float32)
+    u = jax.random.normal(jax.random.key(1), (5, 40, 32), jnp.float32)
+    out = fz.swiglu(g, u, block_rows=64)
+    ref = jax.nn.silu(g) * u
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    gr = jax.grad(lambda a, b: (fz.swiglu(a, b, block_rows=64) ** 2).sum(),
+                  (0, 1))(g, u)
+    rr = jax.grad(lambda a, b: ((jax.nn.silu(a) * b) ** 2).sum(),
+                  (0, 1))(g, u)
+    np.testing.assert_allclose(np.asarray(gr[0]), np.asarray(rr[0]),
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gr[1]), np.asarray(rr[1]),
+                               atol=2e-4)
+
+
+def _rope_ref(x, cos, sin):
+    d = x.shape[-1]
+    half = d // 2
+    c = cos[None, :, None, :half]
+    s = sin[None, :, None, :half]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def test_rope_qk_matches_ref():
+    B, S, H, HK, D = 2, 48, 4, 2, 32
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, S, HK, D), jnp.float32)
+    pos = jnp.arange(S, dtype=jnp.float32)
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2) / D))
+    fr = jnp.outer(pos, inv)
+    cos = jnp.tile(jnp.cos(fr), (1, 2))
+    sin = jnp.tile(jnp.sin(fr), (1, 2))
+
+    qo, ko = fz.rope_qk(q, k, cos, sin, block_seq=16)
+    np.testing.assert_allclose(np.asarray(qo),
+                               np.asarray(_rope_ref(q, cos, sin)),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ko),
+                               np.asarray(_rope_ref(k, cos, sin)),
+                               atol=1e-5)
+    # grads: rotation is orthogonal => vjp rotates by -theta
+    g = jax.grad(lambda q, k: (fz.rope_qk(q, k, cos, sin, block_seq=16)[0]
+                               ** 2).sum() +
+                 (fz.rope_qk(q, k, cos, sin, block_seq=16)[1] ** 2).sum(),
+                 (0, 1))(q, k)
+    gr = jax.grad(lambda q, k: (_rope_ref(q, cos, sin) ** 2).sum() +
+                  (_rope_ref(k, cos, sin) ** 2).sum(), (0, 1))(q, k)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(gr[0]),
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(gr[1]),
+                               atol=2e-4)
+
+
+@pytest.mark.parametrize("hk", [4, 2, 1])
+def test_decode_attention_matches_ref(hk):
+    B, H, D, S = 2, 4, 32, 96
+    q = jax.random.normal(jax.random.key(0), (B, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, S, hk, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, S, hk, D), jnp.float32)
+    lens = jnp.asarray([37, 80], jnp.int32)
+
+    out = fz.decode_attention(q, k, v, lens, block_k=32)
+
+    rep = H // hk
+    kr = jnp.repeat(k, rep, axis=2)
+    vr = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,bshd->bhs", q, kr) / np.sqrt(D)
+    mask = jnp.arange(S)[None, None, :] < lens[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhs,bshd->bhd", p, vr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
