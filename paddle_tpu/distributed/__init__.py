@@ -23,6 +23,12 @@ from .auto_parallel import (  # noqa: F401
     shard_optimizer, unshard_dtensor, is_dist_tensor, get_placements,
 )
 from .auto_parallel.api import dtensor_from_local_list  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    parallelize, parallelize_model, parallelize_optimizer, ColWiseParallel,
+    RowWiseParallel, PrepareLayerInput, PrepareLayerOutput, SplitPoint,
+    SequenceParallelBegin, SequenceParallelEnd, SequenceParallelEnable,
+    SequenceParallelDisable, Engine,
+)
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401

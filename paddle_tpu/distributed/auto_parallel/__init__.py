@@ -4,3 +4,10 @@ from .api import (  # noqa: F401
     shard_tensor, dtensor_from_local, dtensor_to_local, reshard, shard_layer,
     get_placements, is_dist_tensor, shard_optimizer, unshard_dtensor,
 )
+from .parallelize import (  # noqa: F401
+    parallelize, parallelize_model, parallelize_optimizer, set_mesh, get_mesh,
+    PlanBase, ColWiseParallel, RowWiseParallel, PrepareLayerInput,
+    PrepareLayerOutput, SequenceParallelBegin, SequenceParallelEnd,
+    SequenceParallelEnable, SequenceParallelDisable, SplitPoint,
+)
+from .engine import Engine  # noqa: F401

@@ -1,0 +1,222 @@
+"""Semi-auto static Engine: whole-program compiled fit/evaluate/predict over
+a parallelized model.
+
+reference: python/paddle/distributed/auto_parallel/static/engine.py:98 —
+there, Engine builds a static Program per mode, applies parallelization
+passes, and drives an executor. TPU-native: the "program" is the jitted
+train/eval/predict step (jit/api.py TrainStep/EvalStep — forward + loss +
+grad + optimizer update in ONE XLA program); parallelization passes are the
+NamedSharding layouts that parallelize() already stamped on the parameters,
+propagated by GSPMD. Engine's own job reduces to (a) sharding each host
+batch over the ``dp`` axis, (b) the epoch/step loop with logging + metrics,
+(c) save/load.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..._core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+def _to_batch_tuple(batch):
+    if isinstance(batch, (list, tuple)):
+        return tuple(batch)
+    return (batch,)
+
+
+class Engine:
+    """reference: auto_parallel/static/engine.py:98 Engine(model, loss,
+    optimizer, metrics, strategy). ``model`` should already be parallelized
+    (or plain — then Engine is just a compiled training loop)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = list(metrics) if isinstance(
+            metrics, (list, tuple)) else ([metrics] if metrics else [])
+        self._strategy = strategy
+        self._mesh: Optional[ProcessMesh] = getattr(
+            model, "_parallelize_mesh", None)
+        self._train_step = None
+        self._eval_step = None
+        self.history: Dict[str, List[float]] = {"loss": []}
+
+    # ---- batch sharding ----
+    def _shard_batch(self, arrays):
+        """Lay host batches out over the dp axis (the reference feeds each
+        rank its own split; single-controller GSPMD feeds the global batch
+        with a dp-sharded layout). Always returns raw jax arrays."""
+        def raw(x):
+            return x._value if isinstance(x, Tensor) else jnp.asarray(
+                np.asarray(x))
+        if self._mesh is None or "dp" not in self._mesh.dim_names:
+            return tuple(raw(a) for a in arrays)
+        jm = self._mesh.to_jax_mesh()
+        dp_n = jm.shape["dp"]
+
+        def place(x):
+            v = raw(x)
+            if v.ndim >= 1 and v.shape[0] % dp_n == 0:
+                s = NamedSharding(jm, PartitionSpec("dp"))
+            else:
+                s = NamedSharding(jm, PartitionSpec())
+            return jax.device_put(v, s)
+        return tuple(place(a) for a in arrays)
+
+    # ---- mode preparation ----
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Build (and cache) the compiled step for ``mode``. Specs are
+        accepted for API parity; compilation is shape-driven at first call.
+        """
+        if mode == "train":
+            self._ensure_train_step()
+        elif mode in ("eval", "predict"):
+            self._ensure_eval_step()
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise ValueError("Engine.fit needs loss and optimizer")
+            from ...jit.api import TrainStep
+            self._train_step = TrainStep(
+                self._model, self._loss, self._optimizer,
+                return_outputs=bool(self._metrics))
+        return self._train_step
+
+    def _ensure_eval_step(self):
+        if self._eval_step is None:
+            from ...jit.api import EvalStep
+            self._eval_step = EvalStep(self._model)
+        return self._eval_step
+
+    # ---- dataloader ----
+    def dataloader(self, dataset, batch_size=1, shuffle=False, drop_last=True,
+                   collate_fn=None, num_workers=0, mode="train"):
+        from ...io import DataLoader
+        return DataLoader(dataset, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, collate_fn=collate_fn,
+                          num_workers=num_workers)
+
+    def _iter_data(self, data, batch_size, shuffle, drop_last):
+        from ...io import DataLoader, Dataset
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__getitem__") or hasattr(data, "__iter__"):
+            if isinstance(data, Dataset) or (
+                    hasattr(data, "__len__") and not isinstance(
+                        data, (list, tuple))):
+                return DataLoader(data, batch_size=batch_size,
+                                  shuffle=shuffle, drop_last=drop_last)
+        return data
+
+    def _split(self, batch, n_labels):
+        batch = _to_batch_tuple(batch)
+        if n_labels == 0:
+            return batch, ()
+        return batch[:-n_labels], batch[-n_labels:]
+
+    # ---- modes ----
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, shuffle=True, verbose=1, n_labels=1):
+        """Epoch loop over dp-sharded batches through the compiled train
+        step (reference: static/engine.py fit)."""
+        step_fn = self._ensure_train_step()
+        loader = self._iter_data(train_data, batch_size, shuffle, True)
+        logs: Dict[str, Any] = {}
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                inputs, labels = self._split(batch, n_labels)
+                inputs = self._shard_batch(inputs)
+                labels = self._shard_batch(labels)
+                out = step_fn(inputs, labels)
+                loss = out[0] if isinstance(out, tuple) else out
+                lv = float(np.asarray(loss._value if isinstance(
+                    loss, Tensor) else loss))
+                self.history["loss"].append(lv)
+                logs = {"epoch": epoch, "step": step, "loss": lv}
+                if self._metrics and isinstance(out, tuple):
+                    for m in self._metrics:
+                        pred = out[1][0]
+                        if not isinstance(pred, Tensor):
+                            pred = Tensor(pred, _internal=True)
+                        corr = m.compute(pred,
+                                         Tensor(labels[0], _internal=True))
+                        m.update(*[np.asarray(c._value if isinstance(
+                            c, Tensor) else c) for c in (
+                            corr if isinstance(corr, (list, tuple))
+                            else [corr])])
+                        logs[m.name() if not isinstance(m.name(), list)
+                             else m.name()[0]] = m.accumulate()
+                if verbose and step % log_freq == 0:
+                    kv = " ".join(f"{k}={v:.5g}" if isinstance(v, float)
+                                  else f"{k}={v}" for k, v in logs.items())
+                    print(f"[Engine.fit] {kv}")
+            step_fn.sync_to_model()
+        return self.history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, verbose=1,
+                 n_labels=1):
+        eval_fn = self._ensure_eval_step()
+        loader = self._iter_data(valid_data, batch_size, False, False)
+        losses: List[float] = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            inputs, labels = self._split(batch, n_labels)
+            inputs = self._shard_batch(inputs)
+            labels = self._shard_batch(labels)
+            out = eval_fn(*[Tensor(a, _internal=True) for a in inputs])
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            if self._loss is not None and labels:
+                loss = self._loss(*outs, *[Tensor(l, _internal=True)
+                                           for l in labels])
+                losses.append(float(np.asarray(
+                    loss._value if isinstance(loss, Tensor) else loss)))
+        result = {"eval_loss": float(np.mean(losses)) if losses else None}
+        if verbose:
+            print(f"[Engine.evaluate] {result}")
+        return result
+
+    def predict(self, test_data, batch_size=1, steps=None):
+        eval_fn = self._ensure_eval_step()
+        loader = self._iter_data(test_data, batch_size, False, False)
+        outs: List[Any] = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            inputs = self._shard_batch(_to_batch_tuple(batch))
+            outs.append(eval_fn(*[Tensor(a, _internal=True)
+                                  for a in inputs]))
+        return outs
+
+    # ---- state ----
+    def save(self, path, training=True):
+        from ...framework import io as fio
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        state = {"model": self._model.state_dict()}
+        if training and self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        fio.save(state, path + ".pdparams")
+
+    def load(self, path):
+        from ...framework import io as fio
+        state = fio.load(path + ".pdparams")
+        self._model.set_state_dict(state["model"])
+        if "optimizer" in state and self._optimizer is not None:
+            self._optimizer.set_state_dict(state["optimizer"])
+        self._train_step = None
+        self._eval_step = None

@@ -117,6 +117,9 @@ class PipelineParallel(MetaParallelBase):
             return None
         if num_seg != pp and self.schedule != "interleave":
             return None  # virtual chunks only make sense for VPP
+        # every schedule consumes microbatches in pp-sized waves
+        if self.accumulate_steps % pp != 0:
+            return None
         return self._stage_param_lists()
 
     def _spmd_forward_backward(self, stages, inputs, labels):
@@ -159,17 +162,12 @@ class PipelineParallel(MetaParallelBase):
 
         if self._spmd_step is None:
             if schedule in ("1f1b", "zero_bubble"):
-                stacked_tpl = pp_spmd.stack_stage_params(per_stage, mesh)
-
                 def run(stacked, mb, lab):
                     loss, dw, _, _ = pp_spmd.pipeline_1f1b(
                         stage_fn, head_loss, stacked, {}, mb, lab, mesh,
                         defer_dw=(schedule == "zero_bubble"))
                     return loss, dw
             elif schedule == "interleave":
-                stacked_tpl = pp_spmd.stack_stage_params_interleaved(
-                    per_stage, mesh, num_chunks)
-
                 def run(stacked, mb, lab):
                     def total(sp):
                         outs = pp_spmd.pipeline_interleave(
@@ -178,16 +176,14 @@ class PipelineParallel(MetaParallelBase):
                             lambda y, l: head_loss({}, y, l))(outs, lab))
                     return jax.value_and_grad(total)(stacked)
             else:  # gpipe
-                stacked_tpl = pp_spmd.stack_stage_params(per_stage, mesh)
-
                 def run(stacked, mb, lab):
                     def total(sp):
                         return pp_spmd.pipeline_loss_spmd(
                             stage_fn, head_loss, sp, {}, mb, lab, mesh)
                     return jax.value_and_grad(total)(stacked)
-            self._spmd_step = (jax.jit(run), stacked_tpl)
+            self._spmd_step = jax.jit(run)
 
-        step, _ = self._spmd_step
+        step = self._spmd_step
         if schedule == "interleave":
             stacked = pp_spmd.stack_stage_params_interleaved(
                 per_stage, mesh, num_chunks)
@@ -214,6 +210,11 @@ class PipelineParallel(MetaParallelBase):
         semantics otherwise."""
         inputs, labels = data
         stages = self._can_spmd(scaler)
+        if stages is not None and not (
+                isinstance(inputs, Tensor) and isinstance(labels, Tensor)
+                and inputs.shape[0] % self.accumulate_steps == 0):
+            stages = None  # single-tensor, divisible batches only; the
+            # accum path handles everything else (and raises clear errors)
         if stages is not None:
             try:
                 self.total_loss = self._spmd_forward_backward(

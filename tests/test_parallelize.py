@@ -1,0 +1,212 @@
+"""parallelize() intermediate API + static Engine tests.
+
+Mirrors the reference's intermediate-API tests
+(test/auto_parallel/hybrid_strategy/test_parallel_api.py pattern): a GPT-2
+style Layer model run dp+mp through ``dist.parallelize`` must produce the
+same losses as the unparallelized single-device run.
+"""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import (
+    ProcessMesh, parallelize, Engine, ColWiseParallel, RowWiseParallel,
+    SplitPoint, SequenceParallelEnable, is_dist_tensor, get_placements,
+)
+from paddle_tpu.distributed.auto_parallel.placement import Shard
+
+
+VOCAB, HID, HEADS, LAYERS, SEQ = 64, 32, 4, 2, 8
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(HID)
+        self.qkv = nn.Linear(HID, 3 * HID)
+        self.proj = nn.Linear(HID, HID)
+        self.ln2 = nn.LayerNorm(HID)
+        self.up = nn.Linear(HID, 4 * HID)
+        self.down = nn.Linear(4 * HID, HID)
+
+    def forward(self, x):
+        h = self.ln1(x)
+        qkv = self.qkv(h)
+        q, k, v = paddle.split(qkv, 3, axis=-1)
+        b, s, d = q.shape
+        hd = d // HEADS
+        q = q.reshape([b, s, HEADS, hd]).transpose([0, 2, 1, 3])
+        k = k.reshape([b, s, HEADS, hd]).transpose([0, 2, 1, 3])
+        v = v.reshape([b, s, HEADS, hd]).transpose([0, 2, 1, 3])
+        att = paddle.matmul(q, k, transpose_y=True) / (hd ** 0.5)
+        att = nn.functional.softmax(att, axis=-1)
+        o = paddle.matmul(att, v).transpose([0, 2, 1, 3]).reshape([b, s, d])
+        x = x + self.proj(o)
+        return x + self.down(nn.functional.gelu(self.up(self.ln2(x))))
+
+
+class TinyGPT(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.embed = nn.Embedding(VOCAB, HID)
+        self.pos = nn.Embedding(SEQ, HID)
+        self.blocks = nn.LayerList([Block() for _ in range(LAYERS)])
+        self.lnf = nn.LayerNorm(HID)
+        self.head = nn.Linear(HID, VOCAB, bias_attr=False)
+
+    def forward(self, ids):
+        pos = paddle.arange(ids.shape[1]).unsqueeze(0)
+        x = self.embed(ids) + self.pos(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.lnf(x))
+
+
+def _loss_fn(logits, labels):
+    return nn.functional.cross_entropy(
+        logits.reshape([-1, VOCAB]), labels.reshape([-1])).mean()
+
+
+def _data(n_batches=4, batch=8):
+    rng = np.random.RandomState(0)
+    return [(rng.randint(0, VOCAB, size=(batch, SEQ)).astype("int64"),
+             rng.randint(0, VOCAB, size=(batch, SEQ)).astype("int64"))
+            for _ in range(n_batches)]
+
+
+def _run(parallel: bool, level=1):
+    paddle.seed(1234)
+    model = TinyGPT()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    if parallel:
+        mesh = ProcessMesh(
+            np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+        plan = {
+            "blocks.*.qkv": ColWiseParallel(),
+            "blocks.*.proj": RowWiseParallel(),
+            "blocks.*.up": ColWiseParallel(),
+            "blocks.*.down": RowWiseParallel(),
+            "head": ColWiseParallel(),
+        }
+        model, opt = parallelize(
+            model, opt, mesh=mesh,
+            dp_config={"sharding_level": level},
+            mp_config={"parallelize_plan": plan})
+    engine = Engine(model=model, loss=_loss_fn, optimizer=opt)
+    engine.fit(_data(), epochs=1, verbose=0)
+    return engine.history["loss"], model
+
+
+def test_parallelize_matches_single_device():
+    """dp2 x mp4 via parallelize == unparallelized run (the reference's
+    parallel-loss ≈ single-card-loss assertion)."""
+    base, _ = _run(parallel=False)
+    par, model = _run(parallel=True)
+    np.testing.assert_allclose(base, par, rtol=2e-4, atol=2e-5)
+    assert all(np.isfinite(base))
+    # and the plan actually sharded: qkv weight Shard(1) over mp
+    qkv_w = model.blocks[0].qkv.weight
+    assert is_dist_tensor(qkv_w)
+    placements = get_placements(qkv_w)
+    assert any(isinstance(p, Shard) and p.dim == 1 for p in placements)
+    row_w = model.blocks[0].proj.weight
+    assert any(isinstance(p, Shard) and p.dim == 0
+               for p in get_placements(row_w))
+
+
+def test_parallelize_zero3_param_sharding():
+    """sharding_level=3 lays params out over dp too (FSDP)."""
+    _, model = _run(parallel=True, level=3)
+    w = model.blocks[0].ln1.weight
+    assert is_dist_tensor(w)
+    mesh_axes = w._dist_placements
+    assert any(isinstance(p, Shard) for p in mesh_axes)
+
+
+def test_parallelize_sequence_parallel_runs():
+    paddle.seed(7)
+    model = TinyGPT()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    plan = {
+        "blocks.*.qkv": ColWiseParallel(),
+        "blocks.*.proj": RowWiseParallel(),
+        "blocks.*": SequenceParallelEnable(),
+    }
+    model, _ = parallelize(model, None, mesh=mesh,
+                           mp_config={"parallelize_plan": plan})
+    ids = paddle.to_tensor(
+        np.random.RandomState(3).randint(0, VOCAB, size=(8, SEQ)), "int64")
+    out = model(ids)
+    assert tuple(out.shape) == (8, SEQ, VOCAB)
+
+
+def test_pipeline_split_spec_marks_stages():
+    model = TinyGPT()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["dp", "pp", "mp"])
+    model, _ = parallelize(model, None, mesh=mesh,
+                           pp_config={"split_spec": "blocks"})
+    stages = {i: model.blocks[i]._pp_stage for i in range(LAYERS)}
+    assert stages[0] == 0 and stages[LAYERS - 1] == 1
+    assert model._pp_num_stages == 2
+    # explicit dict form
+    m2 = TinyGPT()
+    m2, _ = parallelize(m2, None, mesh=mesh, pp_config={"split_spec": {
+        "blocks.0": SplitPoint.END}})
+    assert m2.blocks[0]._pp_stage == 0 and m2.blocks[1]._pp_stage == 1
+
+
+def test_pipeline_split_balanced_nondivisible():
+    """10 blocks on a pp=4 mesh must yield exactly 4 stages (remainder
+    spread), not 5 (the floor-division bug)."""
+
+    class Deep(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList(
+                [nn.Linear(HID, HID) for _ in range(10)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "pp"])
+    m, _ = parallelize(Deep(), None, mesh=mesh,
+                       pp_config={"split_spec": "blocks"})
+    assert m._pp_num_stages == 4
+    stages = [m.blocks[i]._pp_stage for i in range(10)]
+    assert stages == sorted(stages) and stages[-1] == 3
+    # children inherit their parent block's stage, not the next one
+    assert m.blocks[0].weight is not None  # Linear has no children; check
+    # via a nested module instead
+    m2, _ = parallelize(TinyGPT(), None, mesh=mesh, pp_config={
+        "split_spec": {"blocks.0": SplitPoint.END}})
+    assert m2.blocks[0].ln1._pp_stage == m2.blocks[0]._pp_stage == 0
+    assert m2.blocks[1]._pp_stage == 1
+
+
+def test_engine_evaluate_predict_save_load(tmp_path):
+    paddle.seed(5)
+    model = TinyGPT()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    model, opt = parallelize(model, opt, mesh=mesh,
+                             dp_config={"sharding_level": 1})
+    engine = Engine(model=model, loss=_loss_fn, optimizer=opt)
+    engine.fit(_data(2), epochs=1, verbose=0)
+    ev = engine.evaluate(_data(2), verbose=0)
+    assert np.isfinite(ev["eval_loss"])
+    preds = engine.predict([(b[0],) for b in _data(2)])
+    assert len(preds) == 2
+    path = str(tmp_path / "engine_ckpt")
+    engine.save(path)
+    l0 = engine.evaluate(_data(1), verbose=0)["eval_loss"]
+    engine.load(path)
+    l1 = engine.evaluate(_data(1), verbose=0)["eval_loss"]
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
