@@ -10,7 +10,9 @@ from .mesh import (  # noqa: F401
     get_world_size, new_group, get_group, barrier, destroy_process_group,
     Group, ReduceOp, ParallelEnv, get_mesh, set_mesh, get_world_group,
 )
-from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel, init_parallel_env, shard_local_batch,
+)
 from .collective import (  # noqa: F401
     all_reduce, all_gather, reduce_scatter, all_to_all, alltoall_single,
     broadcast, reduce, scatter, gather, send, recv, isend, irecv, P2POp,
@@ -34,6 +36,7 @@ from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from .watchdog import StepWatchdog  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401

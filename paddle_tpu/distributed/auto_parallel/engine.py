@@ -86,10 +86,17 @@ class Engine:
         if self._train_step is None:
             if self._optimizer is None or self._loss is None:
                 raise ValueError("Engine.fit needs loss and optimizer")
-            from ...jit.api import TrainStep
-            self._train_step = TrainStep(
-                self._model, self._loss, self._optimizer,
-                return_outputs=bool(self._metrics))
+            if getattr(self._optimizer, "_zero_offload", False):
+                # dp_config={"offload": True}: optimizer state lives in
+                # host RAM between steps
+                from ..sharding.offload import OffloadTrainStep
+                self._train_step = OffloadTrainStep(
+                    self._model, self._loss, self._optimizer)
+            else:
+                from ...jit.api import TrainStep
+                self._train_step = TrainStep(
+                    self._model, self._loss, self._optimizer,
+                    return_outputs=bool(self._metrics))
         return self._train_step
 
     def _ensure_eval_step(self):
@@ -131,7 +138,20 @@ class Engine:
         step (reference: static/engine.py fit)."""
         step_fn = self._ensure_train_step()
         loader = self._iter_data(train_data, batch_size, shuffle, True)
-        logs: Dict[str, Any] = {}
+        # hang diagnosis (reference: comm_task_manager.cc watchdog) — armed
+        # via PADDLE_STEP_TIMEOUT seconds
+        from ..watchdog import StepWatchdog
+        wd = StepWatchdog.from_env(name="engine.fit")
+        try:
+            self._fit_loop(step_fn, loader, epochs, steps_per_epoch,
+                           log_freq, verbose, n_labels, wd)
+        finally:
+            if wd is not None:
+                wd.stop()
+        return self.history
+
+    def _fit_loop(self, step_fn, loader, epochs, steps_per_epoch, log_freq,
+                  verbose, n_labels, wd):
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
@@ -142,6 +162,8 @@ class Engine:
                 inputs = self._shard_batch(inputs)
                 labels = self._shard_batch(labels)
                 out = step_fn(inputs, labels)
+                if wd is not None:
+                    wd.tick()
                 loss = out[0] if isinstance(out, tuple) else out
                 lv = float(np.asarray(loss._value if isinstance(
                     loss, Tensor) else loss))
@@ -165,7 +187,6 @@ class Engine:
                                   else f"{k}={v}" for k, v in logs.items())
                     print(f"[Engine.fit] {kv}")
             step_fn.sync_to_model()
-        return self.history
 
     def evaluate(self, valid_data, batch_size=1, steps=None, verbose=1,
                  n_labels=1):

@@ -122,7 +122,9 @@ class ColWiseParallel(PlanBase):
             _shard_param(b, process_mesh, "mp", 0)
         if self.gather_output:
             def gather(l, inputs, output):
-                return _constrain_tree(output, process_mesh, {})
+                # gather the mp-sharded output (last) dim; other dims keep
+                # their layout
+                return _constrain_tree(output, process_mesh, {-1: None})
             layer.register_forward_post_hook(gather)
 
 
@@ -148,17 +150,24 @@ class RowWiseParallel(PlanBase):
 
 def _constrain_tree(x, mesh: ProcessMesh, dim_to_axis: Dict[int, str]):
     """with_sharding_constraint over every array in x: tensor dim d pinned to
-    mesh axis dim_to_axis[d] (when divisible), others unconstrained."""
+    mesh axis dim_to_axis[d] (when divisible) or forced replicated (axis
+    None); other dims stay UNCONSTRAINED (GSPMD keeps whatever layout flows
+    in — e.g. the dp-sharded batch dim)."""
     jm = mesh.to_jax_mesh()
+    rest = PartitionSpec.UNCONSTRAINED
 
     def one(v):
         val = v._value if hasattr(v, "_value") else v
         if not hasattr(val, "ndim"):
             return v
-        entries: List[Any] = [None] * val.ndim
+        entries: List[Any] = [rest] * val.ndim
         for d, ax in dim_to_axis.items():
             dd = d if d >= 0 else val.ndim + d
-            if 0 <= dd < val.ndim and val.shape[dd] % jm.shape[ax] == 0:
+            if not 0 <= dd < val.ndim:
+                continue
+            if ax is None:  # force this dim replicated (gathered)
+                entries[dd] = None
+            elif val.shape[dd] % jm.shape[ax] == 0:
                 entries[dd] = ax
         con = lax.with_sharding_constraint(
             val, NamedSharding(jm, PartitionSpec(*entries)))
@@ -198,10 +207,13 @@ class PrepareLayerOutput(PlanBase):
 class SequenceParallelBegin(PlanBase):
     """Start sequence parallelism after this layer: its OUTPUT's sequence dim
     is pinned to the mp axis (reference: intermediate/tensor_parallel.py:209;
-    the reference's split+transpose becomes a sharding constraint)."""
+    the reference's split+transpose becomes a sharding constraint).
+    ``need_transpose=True`` means activations are [batch, seq, hidden]
+    (seq dim 1, the reference would transpose before splitting); False means
+    they are already [seq, batch, hidden] (seq dim 0)."""
 
     def __init__(self, need_transpose: bool = True):
-        self.seq_dim = 1  # (batch, seq, hidden)
+        self.seq_dim = 1 if need_transpose else 0
 
     def apply(self, layer, process_mesh, shard_weight=None, shard_bias=None):
         sd = self.seq_dim
@@ -217,7 +229,7 @@ class SequenceParallelEnd(PlanBase):
     all-gather) (reference: intermediate/tensor_parallel.py:235)."""
 
     def __init__(self, need_transpose: bool = True):
-        self.seq_dim = 1
+        self.seq_dim = 1 if need_transpose else 0
 
     def apply(self, layer, process_mesh, shard_weight=None, shard_bias=None):
         sd = self.seq_dim
@@ -243,15 +255,17 @@ class SequenceParallelEnable(PlanBase):
 
 
 class SequenceParallelDisable(PlanBase):
-    """Opt the matched layer out: constrain its input to be replicated along
-    seq (reference: intermediate/tensor_parallel.py:296)."""
+    """Opt the matched layer out: its input's seq dim is gathered back to
+    replicated (reference: intermediate/tensor_parallel.py:296)."""
 
     def __init__(self, need_transpose: bool = True):
-        pass
+        self.seq_dim = 1 if need_transpose else 0
 
     def apply(self, layer, process_mesh, shard_weight=None, shard_bias=None):
+        sd = self.seq_dim
+
         def pre(l, inputs):
-            return _constrain_tree(inputs, process_mesh, {})
+            return _constrain_tree(inputs, process_mesh, {sd: None})
         layer.register_forward_pre_hook(pre)
 
 
@@ -325,15 +339,21 @@ def sharded_data_parallel(model, optimizer=None, level=None, offload=False,
         dp_ax = _axis_index(mesh, "dp")
         dp_n = mesh.shape[dp_ax]
         # mark every param as dist (replicated layout is a no-op) so the
-        # optimizer-state hook fires for plain params too
+        # optimizer-state hook fires for plain params too; collect the ids
+        # of excluded layers' params (shard_fn receives the accumulator
+        # slot name, not the layer name)
+        excluded_pids = set()
         for lname, sub in model.named_sublayers(include_self=True):
             for p in sub._parameters.values():
-                if p is not None and not (
-                        is_dist_tensor(p) and p._dist_mesh == mesh):
+                if p is None:
+                    continue
+                if _excluded(lname):
+                    excluded_pids.add(id(p))
+                if not (is_dist_tensor(p) and p._dist_mesh == mesh):
                     shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
 
-        def shard_fn(name, p, pmesh, placements):
-            if _excluded(name):
+        def shard_fn(slot, p, pmesh, placements):
+            if id(p) in excluded_pids:
                 return pmesh, placements
             placements = list(placements)
             if isinstance(placements[dp_ax], Replicate):
@@ -346,6 +366,9 @@ def sharded_data_parallel(model, optimizer=None, level=None, offload=False,
             return pmesh, placements
         shard_optimizer(optimizer, shard_fn)
         optimizer._zero_offload = bool(offload)
+        if offload:
+            from ..sharding.offload import offload_optimizer_states
+            offload_optimizer_states(optimizer)
     model._sharding_level = level
     return model, optimizer
 
@@ -374,7 +397,12 @@ def pipeline_parallel(model, optimizer=None, split_spec=None, mesh=None):
         if not entries:
             raise ValueError(f"split_spec prefix {split_spec!r} matched "
                              f"no sublayers")
-        k = min(pp_n or 2, len(entries))
+        if pp_n is None:
+            raise ValueError(
+                "string split_spec splits evenly over the mesh's 'pp' axis, "
+                f"but mesh {mesh} has none; pass an explicit "
+                "{name: SplitPoint} dict instead")
+        k = min(pp_n, len(entries))
         # balanced split into exactly k stages (remainder spread over the
         # first stages, np.array_split-style); boundary after each stage
         # except the last

@@ -1,1 +1,2 @@
 from .manager import ElasticManager, ElasticStatus, ELASTIC_EXIT_CODE  # noqa: F401
+from .checkpointer import ElasticCheckpointer, elastic_train  # noqa: F401

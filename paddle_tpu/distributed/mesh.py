@@ -141,7 +141,10 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
             coord = os.environ.get("PADDLE_MASTER") or \
                 os.environ.get("JAX_COORDINATOR_ADDRESS")
             nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-            if coord and nprocs > 1 and jax.process_count() == 1:
+            # NOTE: the guard must not touch jax.process_count()/devices():
+            # that would initialize the backend and make
+            # jax.distributed.initialize a no-op/error
+            if coord and nprocs > 1 and not jax.distributed.is_initialized():
                 jax.distributed.initialize(
                     coordinator_address=coord,
                     num_processes=nprocs,

@@ -49,14 +49,20 @@ class DataParallel(Layer):
     def forward(self, *inputs, **kwargs):
         sharding = self._data_sharding
         if sharding is not None and sharding.mesh.size > 1:
+            nproc = jax.process_count()
+
             def place(x):
-                if isinstance(x, Tensor) and x.ndim >= 1 and \
-                        x.shape[0] % sharding.mesh.size == 0:
-                    try:
+                if not (isinstance(x, Tensor) and x.ndim >= 1):
+                    return x
+                try:
+                    if nproc > 1:
+                        # multi-host: x is this process's LOCAL batch
+                        return shard_local_batch(x, sharding)
+                    if x.shape[0] % sharding.mesh.size == 0:
                         return Tensor(jax.device_put(x._value, sharding),
                                       _internal=True)
-                    except Exception:
-                        return x
+                except Exception:
+                    return x
                 return x
             inputs = tuple(place(x) for x in inputs)
         return self._layers(*inputs, **kwargs)
@@ -82,6 +88,28 @@ class DataParallel(Layer):
 
     def named_parameters(self, prefix="", include_sublayers=True):
         return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def shard_local_batch(data, sharding):
+    """Multi-host data feeding: each process passes its LOCAL batch and gets
+    back the GLOBAL batch-sharded array (the reference's per-rank DataLoader
+    shard ≙ this process's slice of the dp axis). Single-process: a plain
+    dp-sharded device_put.
+
+    reference: python/paddle/io DistributedBatchSampler feeds each rank its
+    split; under single-controller-per-host JAX the splits are knitted into
+    one global array via make_array_from_process_local_data.
+    """
+    import numpy as np
+    is_tensor = isinstance(data, Tensor)
+    val = data._value if is_tensor else data
+    if jax.process_count() > 1:
+        local = np.asarray(val)
+        gshape = (local.shape[0] * jax.process_count(),) + local.shape[1:]
+        arr = jax.make_array_from_process_local_data(sharding, local, gshape)
+    else:
+        arr = jax.device_put(val, sharding)
+    return Tensor(arr, _internal=True) if is_tensor else arr
 
 
 def init_parallel_env(mesh_shape=None, axis_names=None):

@@ -1,3 +1,6 @@
 from .group_sharded import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,
 )
+from .offload import (  # noqa: F401
+    OffloadTrainStep, offload_optimizer_states,
+)

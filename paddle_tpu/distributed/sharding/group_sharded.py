@@ -67,6 +67,10 @@ def group_sharded_parallel(model: Layer, optimizer, level: str,
     optimizer._acc = _shard_state_over(axis, mesh)(optimizer._acc)
     optimizer._zero_stage = stage
     optimizer._zero_axis = axis
+    if offload:
+        # eager-path host offload; compiled steps use OffloadTrainStep
+        from .offload import offload_optimizer_states
+        offload_optimizer_states(optimizer)
 
     model._zero_stage = stage
     model._zero_axis = axis

@@ -1,0 +1,125 @@
+"""Step/comm hang watchdog.
+
+reference: paddle/phi/core/distributed/comm_task_manager.cc:67 — background
+threads track per-collective timeouts and dump diagnostics when a rank
+hangs. Under XLA there are no per-collective handles to track (collectives
+compile into the step program), so the TPU-native unit of watching is the
+*step*: if the host loop does not tick within the timeout, the step program
+(or a host-side deadlock) is hung.
+
+On timeout the watchdog dumps every Python thread's stack (faulthandler,
+like the reference's stack-trace dump) to stderr and the log file, then
+either calls the user callback, raises in the main thread, or hard-exits —
+turning silent hangs (exit 124 by an outer killer) into diagnosable errors.
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+_WATCHDOG_ENV = "PADDLE_STEP_TIMEOUT"
+
+
+class StepWatchdog:
+    """Arm with a timeout; call :meth:`tick` every step. If no tick arrives
+    within ``timeout`` seconds, dump all thread stacks and act.
+
+    action: "raise" (default; interrupts the main thread — delivered as
+    KeyboardInterrupt, the only exception _thread.interrupt_main can
+    raise), "exit" (os._exit(124) after the dump — for driver-run
+    artifacts where any exit beats a hang), or "callback".
+    """
+
+    def __init__(self, timeout: float, action: str = "raise",
+                 callback: Optional[Callable] = None,
+                 log_path: Optional[str] = None, name: str = "step"):
+        if action not in ("raise", "exit", "callback"):
+            raise ValueError(action)
+        self.timeout = float(timeout)
+        self.action = action
+        self.callback = callback
+        self.log_path = log_path
+        self.name = name
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"watchdog-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- per-step ----
+    def tick(self):
+        self._last = time.monotonic()
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    # ---- internals ----
+    def _dump_stacks(self):
+        msg = (f"[watchdog] no {self.name} tick for {self.timeout:.0f}s "
+               f"(pid {os.getpid()}) — dumping all thread stacks\n")
+        sys.stderr.write(msg)
+        sys.stderr.flush()
+        try:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            pass
+        if self.log_path:
+            try:
+                with open(self.log_path, "a") as f:
+                    f.write(msg)
+                    faulthandler.dump_traceback(file=f, all_threads=True)
+            except OSError:
+                pass
+
+    def _loop(self):
+        while not self._stop.wait(min(1.0, self.timeout / 4)):
+            if time.monotonic() - self._last <= self.timeout:
+                continue
+            self._fired.set()
+            self._dump_stacks()
+            if self.action == "callback" and self.callback is not None:
+                try:
+                    self.callback()
+                finally:
+                    self._last = time.monotonic()
+                continue
+            if self.action == "exit":
+                os._exit(124)
+            # "raise": interrupt the main thread (KeyboardInterrupt)
+            import _thread
+            _thread.interrupt_main()
+            self._last = time.monotonic()
+
+    @classmethod
+    def from_env(cls, default: Optional[float] = None, **kw
+                 ) -> Optional["StepWatchdog"]:
+        """Build from PADDLE_STEP_TIMEOUT seconds (unset/0 -> None)."""
+        v = os.environ.get(_WATCHDOG_ENV)
+        t = float(v) if v else (default or 0)
+        return cls(t, **kw).start() if t > 0 else None

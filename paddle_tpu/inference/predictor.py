@@ -156,15 +156,48 @@ class Predictor:
     def get_input_handle(self, name: str) -> Tensor:
         return self._inputs[name]
 
+    def _compiled(self):
+        """One compiled XLA program per input-shape set (reference: the
+        analysis passes + engine of AnalysisPredictor::Run — here jit
+        compile-and-cache does both)."""
+        if self._jitted is None:
+            import jax
+            from .._core.tensor import Tensor as FrameworkTensor
+            layer = self._layer
+
+            def f(*raw):
+                out = layer(*[FrameworkTensor(r, _internal=True)
+                              for r in raw])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._value if isinstance(o, FrameworkTensor)
+                             else o for o in outs)
+            self._jitted = jax.jit(f)
+        return self._jitted
+
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """reference: AnalysisPredictor::Run / ZeroCopyRun."""
         from .._core.tensor import Tensor as FrameworkTensor
         if inputs is not None:
             for n, arr in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(np.asarray(arr))
-        args = [FrameworkTensor(self._inputs[n]._value, _internal=True)
-                for n in self._input_names]
-        out = self._layer(*args)
+        raw = [self._inputs[n]._value for n in self._input_names]
+        out = None
+        jit_failed = False
+        if self._jitted is not False:
+            try:
+                out = self._compiled()(*raw)
+            except Exception:
+                jit_failed = True
+                self._jitted = None  # decide after the eager attempt
+        if out is None:
+            args = [FrameworkTensor(v, _internal=True) for v in raw]
+            # bad inputs re-raise here for the user to fix — that's an
+            # input error, not a non-jittable forward
+            out = self._layer(*args)
+            if jit_failed:
+                # eager worked where jit didn't: the forward itself is
+                # non-jittable; latch eager so we don't re-trace per run
+                self._jitted = False
         outs = out if isinstance(out, (list, tuple)) else [out]
         self._outputs = {}
         results = []

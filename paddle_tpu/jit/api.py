@@ -157,6 +157,92 @@ def enable_to_static(flag: bool):
     pass
 
 
+def _write_back_opt_state(optimizer, trainable, state, step_count):
+    """Map functional state {pname: {slot: arr}} into
+    optimizer._accumulators {slot: {id(param): Tensor}} (+ global step)."""
+    import jax.numpy as _jnp
+    for pname, slots in state.items():
+        p = trainable.get(pname)
+        if p is None:
+            continue
+        for slot, val in slots.items():
+            d = optimizer._accumulators.setdefault(slot, {})
+            v = _jnp.array(val)
+            if id(p) in d:
+                d[id(p)]._inplace_assign(v)
+            else:
+                d[id(p)] = Tensor(v, _internal=True)
+    optimizer._global_step = max(optimizer._global_step, int(step_count))
+
+
+def _snapshot_model(model):
+    """(trainable params, frozen raw values, donated param copies, buffer
+    copies) — the state a compiled step needs. Copies: step arguments are
+    donated to XLA and the model's Tensors must stay valid for eager
+    access mid-training."""
+    named = dict(model.named_parameters())
+    trainable = {k: p for k, p in named.items() if not p.stop_gradient}
+    frozen = {k: p._value for k, p in named.items() if p.stop_gradient}
+    params = {k: jnp.array(p._value) for k, p in trainable.items()}
+    buffers = {k: jnp.array(v) for k, v in model.raw_buffers().items()}
+    return named, trainable, frozen, params, buffers
+
+
+def _capture_amp_state():
+    """amp autocast config is trace-time, not part of jit cache keys."""
+    from ..amp.auto_cast import (is_auto_cast_enabled, get_amp_dtype,
+                                 get_amp_level)
+    return (is_auto_cast_enabled(), str(get_amp_dtype()), get_amp_level())
+
+
+def _unscale_and_check(grads, scale, use_scaler):
+    """Undo loss scaling and detect non-finite grads (inside the compiled
+    program)."""
+    if not use_scaler:
+        return grads, jnp.asarray(False)
+    inv = 1.0 / scale
+    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+    found_inf = jnp.any(jnp.stack([
+        ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+        for g in jax.tree_util.tree_leaves(grads)]))
+    return grads, found_inf
+
+
+def _build_forward_loss(model, loss_fn, frozen, amp_state, use_scaler):
+    """forward + loss closure shared by the fused TrainStep and the
+    offloaded variant (distributed.sharding.offload.OffloadTrainStep)."""
+    amp_enabled, amp_dtype, amp_level = amp_state
+
+    def forward_loss(p, buffers, rng, inputs, labels, scale):
+        allp = dict(frozen)
+        allp.update(p)
+        ctx = rng_scope(rng)
+        from ..amp.auto_cast import auto_cast as _autocast
+        import contextlib
+        amp_ctx = _autocast(level=amp_level, dtype=amp_dtype) \
+            if amp_enabled else contextlib.nullcontext()
+        with ctx, amp_ctx, ag.no_grad():
+            # no_grad skips the python tape; jax.value_and_grad
+            # differentiates the traced program itself
+            out, new_buffers = model.functional_call(
+                allp,
+                *[Tensor(b, _internal=True) for b in inputs],
+                buffers=buffers, training=True,
+                capture_buffers=True)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            lbls = [Tensor(l, _internal=True) for l in labels]
+            loss = loss_fn(*outs, *lbls)
+            lv = loss._value if isinstance(loss, Tensor) else loss
+        out_vals = tuple(o._value if isinstance(o, Tensor) else o
+                         for o in outs)
+        if use_scaler:
+            lv_scaled = lv * scale
+            return lv_scaled, (new_buffers, out_vals, lv)
+        return lv, (new_buffers, out_vals, lv)
+
+    return forward_loss
+
+
 class TrainStep:
     """One fused XLA program per (shapes, training-config): forward + loss +
     grad + (scaled/accumulated) optimizer update + buffer update, with
@@ -186,15 +272,8 @@ class TrainStep:
                                  getattr(scaler, "_enable", True)) else None
         self.accumulate_steps = int(accumulate_steps)
         self.return_outputs = return_outputs
-        named = dict(model.named_parameters())
-        self._trainable = {k: p for k, p in named.items()
-                           if not p.stop_gradient}
-        self._frozen = {k: p._value for k, p in named.items()
-                        if p.stop_gradient}
-        # copy: step arguments are donated to XLA, and the model's own
-        # Tensors must keep valid arrays for eager access mid-training
-        self.params = {k: jnp.array(p._value)
-                       for k, p in self._trainable.items()}
+        (named, self._trainable, self._frozen, self.params,
+         self.buffers) = _snapshot_model(model)
         init_state, self._opt_update = optimizer.build_functional(named)
         self.opt_state = init_state(self.params)
         if self.accumulate_steps > 1:
@@ -203,66 +282,25 @@ class TrainStep:
                 "acc": jax.tree_util.tree_map(
                     lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
                     self.params)}
-        self.buffers = {k: jnp.array(v)
-                        for k, v in model.raw_buffers().items()}
         self._step_count = 0
-        # amp autocast state is captured at construction: it is trace-time
-        # config, not part of jit cache keys
-        from ..amp.auto_cast import (is_auto_cast_enabled, get_amp_dtype,
-                                     get_amp_level)
-        self._amp_state = (is_auto_cast_enabled(), str(get_amp_dtype()),
-                           get_amp_level())
+        self._amp_state = _capture_amp_state()
         self._compiled = jax.jit(self._make_fn(), donate_argnums=(0, 1, 2))
 
     def _make_fn(self):
-        model = self.model
         loss_fn = self.loss_fn
-        frozen = self._frozen
         opt_update = self._opt_update
         use_scaler = self.scaler is not None
         accum = self.accumulate_steps
-        amp_enabled, amp_dtype, amp_level = self._amp_state
 
-        def forward_loss(p, buffers, rng, inputs, labels, scale):
-            allp = dict(frozen)
-            allp.update(p)
-            ctx = rng_scope(rng)
-            from ..amp.auto_cast import auto_cast as _autocast
-            import contextlib
-            amp_ctx = _autocast(level=amp_level, dtype=amp_dtype) \
-                if amp_enabled else contextlib.nullcontext()
-            with ctx, amp_ctx, ag.no_grad():
-                # no_grad skips the python tape; jax.value_and_grad
-                # differentiates the traced program itself
-                out, new_buffers = model.functional_call(
-                    allp,
-                    *[Tensor(b, _internal=True) for b in inputs],
-                    buffers=buffers, training=True,
-                    capture_buffers=True)
-                outs = out if isinstance(out, (tuple, list)) else (out,)
-                lbls = [Tensor(l, _internal=True) for l in labels]
-                loss = loss_fn(*outs, *lbls)
-                lv = loss._value if isinstance(loss, Tensor) else loss
-            out_vals = tuple(o._value if isinstance(o, Tensor) else o
-                             for o in outs)
-            if use_scaler:
-                lv_scaled = lv * scale
-                return lv_scaled, (new_buffers, out_vals, lv)
-            return lv, (new_buffers, out_vals, lv)
+        forward_loss = _build_forward_loss(
+            self.model, loss_fn, self._frozen, self._amp_state, use_scaler)
 
         def step_fn(params, opt_state, buffers, step, lr, rng, scale,
                     inputs, labels):
             (_, (new_buffers, out_vals, loss_val)), grads = \
                 jax.value_and_grad(forward_loss, has_aux=True)(
                     params, buffers, rng, inputs, labels, scale)
-            if use_scaler:
-                inv = 1.0 / scale
-                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-                found_inf = jnp.any(jnp.stack([
-                    ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
-                    for g in jax.tree_util.tree_leaves(grads)]))
-            else:
-                found_inf = jnp.asarray(False)
+            grads, found_inf = _unscale_and_check(grads, scale, use_scaler)
 
             if accum > 1:
                 acc = opt_state["acc"]
@@ -330,6 +368,16 @@ class TrainStep:
         namedb = dict(self.model.named_buffers())
         for k, v in self.buffers.items():
             namedb[k]._inplace_assign(jnp.array(v))
+        self.sync_optimizer_state()
+
+    def sync_optimizer_state(self):
+        """Write the functional opt state back into the Optimizer's
+        accumulators so optimizer.state_dict() reflects training (the jit
+        path never touches the eager accumulators otherwise)."""
+        state = self.opt_state["opt"] if self.accumulate_steps > 1 \
+            else self.opt_state
+        _write_back_opt_state(self.optimizer, self._trainable, state,
+                              self._step_count)
 
     def sync_from_model(self):
         self.params = {k: jnp.array(p._value)

@@ -47,19 +47,43 @@ def save(layer, path, input_spec=None, **configs):
                                  for o in out)
                 return out._value if isinstance(out, Tensor) else out
 
-            args = [jax.ShapeDtypeStruct(
-                tuple(d if d is not None and d != -1 else 1 for d in s.shape),
-                jnp.dtype(str(np.dtype(s.dtype)))) for s in input_spec]
-            exported = jexport.export(jax.jit(fn))(
-                jax.tree_util.tree_map(
-                    lambda a: jax.ShapeDtypeStruct(a.shape,
-                                                   jnp.result_type(a)),
-                    params),
-                jax.tree_util.tree_map(
-                    lambda a: jax.ShapeDtypeStruct(a.shape,
-                                                   jnp.result_type(a)),
-                    buffers),
-                *args)
+            def spec_args(symbolic):
+                if symbolic and any(
+                        d is None or d == -1
+                        for s in input_spec for d in s.shape):
+                    scope = jexport.SymbolicScope()
+                    out = []
+                    for si, s in enumerate(input_spec):
+                        dims = ",".join(
+                            f"b{si}_{di}" if d is None or d == -1 else str(d)
+                            for di, d in enumerate(s.shape))
+                        out.append(jax.ShapeDtypeStruct(
+                            jexport.symbolic_shape(dims, scope=scope),
+                            jnp.dtype(str(np.dtype(s.dtype)))))
+                    return out
+                return [jax.ShapeDtypeStruct(
+                    tuple(d if d is not None and d != -1 else 1
+                          for d in s.shape),
+                    jnp.dtype(str(np.dtype(s.dtype)))) for s in input_spec]
+
+            def do_export(symbolic):
+                return jexport.export(jax.jit(fn))(
+                    jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(a.shape,
+                                                       jnp.result_type(a)),
+                        params),
+                    jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(a.shape,
+                                                       jnp.result_type(a)),
+                        buffers),
+                    *spec_args(symbolic))
+
+            try:
+                # None dims export shape-polymorphic (any batch at serving
+                # time); ops that can't be polymorphic fall back to 1
+                exported = do_export(symbolic=True)
+            except Exception:
+                exported = do_export(symbolic=False)
             with open(path + ".pdmodel", "wb") as f:
                 f.write(exported.serialize())
             meta["exported"] = True

@@ -33,10 +33,28 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
     }
 
 
-def _attn_with_cache(q, ck, cv, length, nh):
+def _use_decode_kernel(override=None):
+    """Pallas decode attention on real TPU; jnp composition elsewhere
+    (interpret-mode pallas inside a scan is pointlessly slow on CPU)."""
+    if override is not None:
+        return override
+    try:
+        # platform, not backend name (the axon tunnel backend drives TPUs)
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None):
     """q (B,T,nh,hd) vs cache (B,Smax,nkv,hd); positions >= length masked.
     length: scalar or (B,) current valid length INCLUDING q's tokens."""
     B, T, _, hd = q.shape
+    if T == 1 and _use_decode_kernel(use_kernel):
+        # single-token decode: fused block attention against the padded
+        # cache (reference: block_multi_head_attention_kernel.cu)
+        from ..ops.pallas.fused import decode_attention
+        o = decode_attention(q[:, 0], ck, cv, length)
+        return o[:, None]
     nkv = ck.shape[2]
     if nkv != nh:
         ck = jnp.repeat(ck, nh // nkv, axis=2)
@@ -52,7 +70,8 @@ def _attn_with_cache(q, ck, cv, length, nh):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
 
 
-def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig):
+def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
+                 use_kernel=None):
     """One decoder layer over T tokens starting at position ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated."""
     B, T, H = x.shape
@@ -69,7 +88,8 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig):
         cache_k.dtype), pos, axis=1)
     cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(
         cache_v.dtype), pos, axis=1)
-    o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh)
+    o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh,
+                         use_kernel=use_kernel)
     x = x + o.reshape(B, T, nh * hd) @ lp["wo"]
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     g = jax.nn.silu((h2 @ lp["wg"]).astype(jnp.float32)).astype(x.dtype)
@@ -78,7 +98,7 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig):
 
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
-                    max_len: int):
+                    max_len: int, use_kernel=None):
     """tokens (B, T) at positions [pos, pos+T) -> (logits_last (B, V),
     updated cache)."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
@@ -87,7 +107,8 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
     def body(carry, layer_in):
         xc = carry
         lp, ck, cv = layer_in
-        y, nk, nv = _block_infer(xc, lp, ck, cv, pos, cos, sin, cfg)
+        y, nk, nv = _block_infer(xc, lp, ck, cv, pos, cos, sin, cfg,
+                                 use_kernel=use_kernel)
         return y, (nk, nv)
 
     x, (new_k, new_v) = lax.scan(
@@ -102,7 +123,8 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
              max_new_tokens: int = 32, max_len: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
              key: Optional[jax.Array] = None,
-             eos_token_id: Optional[int] = None) -> jax.Array:
+             eos_token_id: Optional[int] = None,
+             use_kernel: Optional[bool] = None) -> jax.Array:
     """prompt (B, S_prompt) int32 -> (B, S_prompt + max_new_tokens).
 
     greedy when temperature == 0, else temperature (+ optional top-k)
@@ -117,6 +139,8 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     cache = init_cache(cfg, B, max_len)
 
     logits, cache = _forward_cached(params, prompt, cache, 0, cfg, max_len)
+    # prefill uses the jnp path (multi-token); decode steps may use the
+    # fused pallas kernel
 
     def sample(logits, k):
         if temperature == 0.0:
@@ -140,7 +164,8 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
         cache, tok, kk, done = carry
         kk, ks = jax.random.split(kk)
         logits, cache = _forward_cached(
-            params, tok[:, None], cache, S + i, cfg, max_len)
+            params, tok[:, None], cache, S + i, cfg, max_len,
+            use_kernel=use_kernel)
         nxt = sample(logits, ks)
         if eos is not None:
             nxt = jnp.where(done, jnp.int32(eos), nxt)

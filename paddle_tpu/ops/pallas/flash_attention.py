@@ -38,6 +38,20 @@ def set_interpret(v: bool):
     _INTERPRET = v
 
 
+def _interpret_mode() -> bool:
+    """True when kernels must run in pallas interpret mode: forced by
+    set_interpret, or whenever the backend is not a real TPU (CPU pallas
+    lowering supports interpret only)."""
+    if _INTERPRET:
+        return True
+    try:
+        # platform, not backend name: the axon PJRT tunnel's backend is
+        # named "axon" but its devices ARE TPU chips (compiled mode)
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
 def available() -> bool:
     if not _PALLAS_OK:
         return False
@@ -156,7 +170,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        interpret=_INTERPRET,
+        interpret=_interpret_mode(),
     )(q, k, v)
     return out, lse
 
@@ -309,7 +323,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        interpret=_INTERPRET,
+        interpret=_interpret_mode(),
     )(q, k, v, do, lse, delta)
 
     dq = pl.pallas_call(
@@ -327,7 +341,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=_INTERPRET,
+        interpret=_interpret_mode(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 

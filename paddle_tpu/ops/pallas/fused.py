@@ -33,7 +33,7 @@ from . import flash_attention as _fa
 
 
 def _interp():
-    return _fa._INTERPRET
+    return _fa._interpret_mode()
 
 
 # ---------------- fused RMSNorm (+ residual) ----------------

@@ -26,3 +26,8 @@ def _seed():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running subprocess/integration test")

@@ -124,3 +124,38 @@ def test_generate_eos_masks_tail():
             hit = True
             assert (row[idx[0]:] == 5).all(), row
     assert hit, toks  # with T=4 over 16 ids x 24 steps, eos must appear
+
+
+def test_decode_kernel_path_matches_jnp():
+    """use_kernel=True routes decode steps through the pallas decode
+    attention (interpret mode on CPU) and must produce identical greedy
+    tokens to the jnp composition."""
+    paddle.seed(0)
+    cfg = llama.LlamaConfig.tiny(num_layers=2)
+    params = llama.init_params(jax.random.key(3), cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 6)),
+        jnp.int32)
+    ref = generate.generate(params, prompt, cfg, max_new_tokens=6,
+                            use_kernel=False)
+    ker = generate.generate(params, prompt, cfg, max_new_tokens=6,
+                            use_kernel=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(ker))
+
+
+def test_predictor_jits_and_caches(tmp_path):
+    """Predictor.run compiles once per shape (reference: AnalysisPredictor
+    builds its engine once, then Run is cheap)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.inference as inference
+    from paddle_tpu.jit.api import InputSpec
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    path = str(tmp_path / "lin")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+    out1 = pred.run([x])
+    out2 = pred.run([x])
+    np.testing.assert_allclose(out1[0], out2[0], rtol=1e-6)
+    assert pred._jitted not in (None, False)  # compiled path engaged

@@ -1,0 +1,110 @@
+"""Multi-host bootstrap tests: 2 real processes form one global mesh via
+jax.distributed.initialize and train data-parallel with synced grads.
+
+Mirrors the reference's TestDistBase subprocess-ranks pattern
+(test/legacy_test/test_dist_base.py:957 _run_cluster): N localhost
+processes, crafted env (PADDLE_MASTER/PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM
+≙ the reference's endpoint env), assert parallel loss/params agree across
+ranks.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+import jax
+
+out_path = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+dist.init_parallel_env()   # jax.distributed.initialize under the hood
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
+assert len(jax.local_devices()) == 2
+
+paddle.seed(7)  # same init on every process (the reference broadcasts)
+net = nn.Linear(8, 1)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+dp = dist.DataParallel(net)
+
+from paddle_tpu.jit.api import TrainStep
+step = TrainStep(net, lambda p, y: ((p - y) ** 2).mean(), opt)
+
+mesh = dist.get_mesh()
+from jax.sharding import NamedSharding, PartitionSpec
+sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+
+r = np.random.RandomState(100 + rank)   # DIFFERENT local data per process
+w = np.arange(8, dtype="float32").reshape(8, 1) / 8.0
+losses = []
+for i in range(5):
+    xl = r.randn(8, 8).astype("float32")
+    yl = xl @ w
+    x = dist.shard_local_batch(paddle.to_tensor(xl), sharding)
+    y = dist.shard_local_batch(paddle.to_tensor(yl), sharding)
+    losses.append(float(step((x,), (y,)).numpy()))
+step.sync_to_model()
+checksum = float(sum(np.abs(p.numpy()).sum() for p in net.parameters()))
+with open(out_path, "w") as f:
+    json.dump({"rank": rank, "losses": losses, "checksum": checksum}, f)
+print("WORKER_OK", rank)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_dp(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    port = _free_port()
+    procs, outs = [], []
+    for rank in range(2):
+        out = str(tmp_path / f"out_{rank}.json")
+        outs.append(out)
+        env = dict(os.environ,
+                   PYTHONPATH=repo,
+                   PADDLE_MASTER=f"127.0.0.1:{port}",
+                   PADDLE_TRAINERS_NUM="2",
+                   PADDLE_TRAINER_ID=str(rank),
+                   JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), out], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    logs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=300)
+        logs.append(stdout.decode())
+    assert all(p.returncode == 0 for p in procs), "\n".join(
+        log[-3000:] for log in logs)
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    # one global program: both ranks observe the SAME global loss
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+    # grads were synced: params identical after 5 steps over different
+    # local data
+    np.testing.assert_allclose(r0["checksum"], r1["checksum"], rtol=1e-6)
+    assert all(np.isfinite(r0["losses"]))
+    # and training actually learned something
+    assert r0["losses"][-1] < r0["losses"][0]

@@ -193,8 +193,8 @@ def test_pipeline_split_balanced_nondivisible():
 def test_engine_evaluate_predict_save_load(tmp_path):
     paddle.seed(5)
     model = TinyGPT()
-    opt = paddle.optimizer.SGD(learning_rate=0.05,
-                               parameters=model.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=model.parameters())
     mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
     model, opt = parallelize(model, opt, mesh=mesh,
                              dp_config={"sharding_level": 1})
@@ -206,6 +206,14 @@ def test_engine_evaluate_predict_save_load(tmp_path):
     assert len(preds) == 2
     path = str(tmp_path / "engine_ckpt")
     engine.save(path)
+    # the jit path's functional opt state must be captured in the save:
+    # AdamW moments are nonzero after fit (regression: Engine.save used to
+    # write empty accumulators)
+    sd = opt.state_dict()
+    assert sd["@global_step"] > 0
+    moments = [v for k, v in sd.items() if k.endswith("@moment1")]
+    assert moments and any(
+        float(np.abs(np.asarray(m._value)).sum()) > 0 for m in moments)
     l0 = engine.evaluate(_data(1), verbose=0)["eval_loss"]
     engine.load(path)
     l1 = engine.evaluate(_data(1), verbose=0)["eval_loss"]
