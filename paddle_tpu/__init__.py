@@ -39,6 +39,7 @@ from . import device  # noqa: E402
 from . import jit  # noqa: E402
 from . import static  # noqa: E402
 from . import vision  # noqa: E402
+from . import geometric  # noqa: E402
 from . import hapi  # noqa: E402
 from . import distributed  # noqa: E402
 from . import incubate  # noqa: E402

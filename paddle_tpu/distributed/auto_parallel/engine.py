@@ -89,6 +89,12 @@ class Engine:
             if getattr(self._optimizer, "_zero_offload", False):
                 # dp_config={"offload": True}: optimizer state lives in
                 # host RAM between steps
+                if self._metrics:
+                    import warnings
+                    warnings.warn(
+                        "Engine metrics are not computed with "
+                        "offload=True (OffloadTrainStep returns loss "
+                        "only); evaluate() still reports them")
                 from ..sharding.offload import OffloadTrainStep
                 self._train_step = OffloadTrainStep(
                     self._model, self._loss, self._optimizer)

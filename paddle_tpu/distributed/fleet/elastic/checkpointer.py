@@ -82,28 +82,32 @@ def elastic_train(train_one_step: Callable[[int], Any],
     preemption-safe checkpointing:
 
     - on entry, restores the latest checkpoint (the post-relaunch resume);
-    - installs a SIGTERM handler that checkpoints the CURRENT state and
-      exits with ELASTIC_EXIT_CODE=101 (the launch controller relaunches);
+    - installs a SIGTERM handler that requests a checkpoint; at the NEXT
+      step boundary the consistent state is saved and the process exits
+      with ELASTIC_EXIT_CODE=101 (the launch controller relaunches);
     - optionally checkpoints every ``save_every`` steps as well.
 
     Returns the first step that was NOT run (== num_steps on completion).
     """
-    from .manager import ElasticManager
+    import os as _os
+    from .manager import ElasticManager, ELASTIC_EXIT_CODE
     if manager is None:
         manager = ElasticManager()
     start, state = checkpointer.load_latest()
     if state is not None:
         restore_fn(state)
-    step_box = {"step": start}  # SIGTERM handler reads the live step
 
-    def _preempt_save():
-        if step_box["step"] >= 0:  # nothing ran yet -> nothing to save
-            checkpointer.save(step_box["step"], state_fn())
-
-    manager.on_preemption(_preempt_save)
+    # SIGTERM only SETS A FLAG; the save happens at the next step boundary.
+    # Saving inside the signal handler would capture a torn state (the
+    # handler can interrupt optimizer.step mid-parameter-update).
+    preempted = {"flag": False}
+    manager.on_preemption(lambda: preempted.update(flag=True),
+                          exit_after=False)
     for step in range(start + 1, num_steps):
         train_one_step(step)
-        step_box["step"] = step
+        if preempted["flag"]:
+            checkpointer.save(step, state_fn())
+            _os._exit(ELASTIC_EXIT_CODE)
         if save_every and (step + 1) % save_every == 0:
             checkpointer.save(step, state_fn())
     checkpointer.save(num_steps - 1, state_fn())

@@ -96,16 +96,20 @@ class ElasticManager:
         return False
 
     # ---- preemption (TPU maintenance events) ----
-    def on_preemption(self, callback: Callable):
+    def on_preemption(self, callback: Callable, exit_after: bool = True):
         """Register checkpoint-and-exit callback; triggered by SIGTERM (the
-        Cloud TPU preemption notice) or the watch file."""
+        Cloud TPU preemption notice) or the watch file. ``exit_after=False``
+        only runs the callback (for loops that defer the checkpoint to a
+        step boundary and exit themselves — see elastic_train)."""
         self._preempt_cb = callback
+        self._exit_after = exit_after
         signal.signal(signal.SIGTERM, self._handle)
 
     def _handle(self, signum, frame):
         if self._preempt_cb:
             self._preempt_cb()
-        os._exit(ELASTIC_EXIT_CODE)
+        if getattr(self, "_exit_after", True):
+            os._exit(ELASTIC_EXIT_CODE)
 
     def watch_preemption_file(self, path: str, interval: float = 5.0):
         """Poll a maintenance-notice file (GCE metadata watcher writes it)."""

@@ -36,7 +36,8 @@ class StepWatchdog:
 
     def __init__(self, timeout: float, action: str = "raise",
                  callback: Optional[Callable] = None,
-                 log_path: Optional[str] = None, name: str = "step"):
+                 log_path: Optional[str] = None, name: str = "step",
+                 start_grace: Optional[float] = None):
         if action not in ("raise", "exit", "callback"):
             raise ValueError(action)
         self.timeout = float(timeout)
@@ -44,6 +45,12 @@ class StepWatchdog:
         self.callback = callback
         self.log_path = log_path
         self.name = name
+        # the first step includes XLA compilation (minutes on TPU); give it
+        # extra slack so a steady-state-sized timeout doesn't kill a
+        # healthy compile (reference: comm watchdog's separate init timeout)
+        self.start_grace = float(start_grace) if start_grace is not None \
+            else max(self.timeout * 9, 600.0)
+        self._grace_pending = True
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._fired = threading.Event()
@@ -73,6 +80,7 @@ class StepWatchdog:
 
     # ---- per-step ----
     def tick(self):
+        self._grace_pending = False
         self._last = time.monotonic()
 
     @property
@@ -99,7 +107,9 @@ class StepWatchdog:
 
     def _loop(self):
         while not self._stop.wait(min(1.0, self.timeout / 4)):
-            if time.monotonic() - self._last <= self.timeout:
+            limit = self.timeout + (self.start_grace if self._grace_pending
+                                    else 0.0)
+            if time.monotonic() - self._last <= limit:
                 continue
             self._fired.set()
             self._dump_stacks()

@@ -1,0 +1,123 @@
+"""Graph learning ops (reference: python/paddle/geometric/ —
+message_passing/send_recv.py send_u_recv:24/send_ue_recv:143/send_uv:291,
+math.py segment_sum/mean/max/min; kernels
+paddle/phi/kernels/send_u_recv_kernel.h, segment_pool_kernel.h).
+
+TPU-native: all message passing lowers to gather + segment reduction
+(jax.ops.segment_*), which XLA turns into sorted-scatter on TPU — the
+reference's per-edge CUDA atomics have no TPU analog and aren't needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from ..ops._registry import as_tensor, raw
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _num_segments(ids, given=None):
+    if given is not None:
+        return int(given)
+    idv = np.asarray(jax.device_get(raw(as_tensor(ids))))
+    return int(idv.max()) + 1 if idv.size else 0
+
+
+def _segment(name, jfn):
+    def op(data, segment_ids, name=None):
+        n = _num_segments(segment_ids)
+        return apply(lambda d, i: jfn(d, i, num_segments=n),
+                     as_tensor(data), as_tensor(segment_ids), name=name)
+    op.__name__ = name
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum)
+segment_max = _segment("segment_max", jax.ops.segment_max)
+segment_min = _segment("segment_min", jax.ops.segment_min)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+
+    def fn(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(i.shape, d.dtype), i,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (d.ndim - 1))
+    return apply(fn, as_tensor(data), as_tensor(segment_ids),
+                 name="segment_mean")
+
+
+_MSG = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+        "div": jnp.divide}
+_RED = {"sum": jax.ops.segment_sum, "mean": None,
+        "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def _reduce(msg, dst, n, reduce_op):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(dst.shape, msg.dtype), dst,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (msg.ndim - 1))
+    out = _RED[reduce_op](msg, dst, num_segments=n)
+    if reduce_op in ("max", "min"):
+        # empty segments produce +-inf; the reference zeros them
+        out = jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges, reduce at destinations
+    (reference: send_recv.py:24)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(reduce_op)
+    n = _num_segments(dst_index, out_size) if out_size is not None else \
+        raw(as_tensor(x)).shape[0]
+
+    def fn(xv, si, di):
+        return _reduce(jnp.take(xv, si, axis=0), di, n, reduce_op)
+    return apply(fn, as_tensor(x), as_tensor(src_index),
+                 as_tensor(dst_index), name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine source-node features with per-edge features, reduce at
+    destinations (reference: send_recv.py:143). y: (E, ...) edge feats."""
+    if message_op not in _MSG:
+        raise ValueError(message_op)
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(reduce_op)
+    n = _num_segments(dst_index, out_size) if out_size is not None else \
+        raw(as_tensor(x)).shape[0]
+    mfn = _MSG[message_op]
+
+    def fn(xv, yv, si, di):
+        return _reduce(mfn(jnp.take(xv, si, axis=0), yv), di, n, reduce_op)
+    return apply(fn, as_tensor(x), as_tensor(y), as_tensor(src_index),
+                 as_tensor(dst_index), name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from source and destination node features
+    (reference: send_recv.py:291)."""
+    if message_op not in _MSG:
+        raise ValueError(message_op)
+    mfn = _MSG[message_op]
+
+    def fn(xv, yv, si, di):
+        return mfn(jnp.take(xv, si, axis=0), jnp.take(yv, di, axis=0))
+    return apply(fn, as_tensor(x), as_tensor(y), as_tensor(src_index),
+                 as_tensor(dst_index), name="send_uv")

@@ -9,7 +9,7 @@ from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
     label_smooth, pad, interpolate, upsample, unfold, fold, bilinear,
     cosine_similarity, normalize, pixel_shuffle, pixel_unshuffle,
-    channel_shuffle,
+    channel_shuffle, grid_sample, affine_grid,
 )
 from .conv import (  # noqa: F401
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
@@ -23,15 +23,17 @@ from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+    lp_pool2d, max_unpool2d,
 )
 from .loss import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     sigmoid_focal_loss, kl_div, margin_ranking_loss, hinge_embedding_loss,
     cosine_embedding_loss, triplet_margin_loss, ctc_loss, square_error_cost,
-    log_loss, dice_loss,
+    log_loss, dice_loss, margin_cross_entropy,
 )
 from .attention import (  # noqa: F401
     flash_attention, scaled_dot_product_attention, flash_attn_unpadded,
     sdp_kernel,
 )
+from ...ops.parity import sequence_mask, gather_tree  # noqa: F401,E402

@@ -283,3 +283,97 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         v = jnp.swapaxes(v, 3, 4)
         return v.reshape(n, h, w, c)
     return apply(f, as_tensor(x), name="channel_shuffle")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Spatial sampling by a flow field (reference:
+    paddle/phi/kernels/grid_sample_kernel.h; python
+    nn/functional/vision.py grid_sample). x: (N, C, H, W); grid:
+    (N, Hout, Wout, 2) normalized to [-1, 1] (x then y)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(mode)
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(padding_mode)
+
+    def fn(xv, gv):
+        N, C, H, W = xv.shape
+        gx = gv[..., 0].astype(jnp.float32)
+        gy = gv[..., 1].astype(jnp.float32)
+
+        def unnorm(c, size):
+            if align_corners:
+                return (c + 1.0) * (size - 1) / 2.0
+            return ((c + 1.0) * size - 1.0) / 2.0
+
+        def fold(c, size):
+            # map out-of-range coords per padding_mode (zeros handled by
+            # masking below)
+            if padding_mode == "border":
+                return jnp.clip(c, 0, size - 1)
+            if padding_mode == "reflection":
+                lo, hi = (0.0, size - 1.0) if align_corners else \
+                    (-0.5, size - 0.5)
+                rng = hi - lo
+                if rng <= 0:
+                    return jnp.zeros_like(c)
+                c = jnp.abs((c - lo) % (2 * rng))
+                c = jnp.where(c > rng, 2 * rng - c, c) + lo
+                return jnp.clip(c, 0, size - 1)
+            return c
+
+        ix = fold(unnorm(gx, W), W)
+        iy = fold(unnorm(gy, H), H)
+        nidx = jnp.arange(N)[:, None, None]
+
+        def gather(yy, xx):
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = xv[nidx, :, yc, xc]                  # (N, Hout, Wout, C)
+            if padding_mode == "zeros":
+                ok = ((yy >= 0) & (yy <= H - 1) &
+                      (xx >= 0) & (xx <= W - 1))
+                v = v * ok[..., None].astype(v.dtype)
+            return v
+
+        if mode == "nearest":
+            out = gather(jnp.round(iy), jnp.round(ix))
+        else:
+            x0 = jnp.floor(ix)
+            y0 = jnp.floor(iy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - ix) * (y1 - iy)
+            wb = (ix - x0) * (y1 - iy)
+            wc = (x1 - ix) * (iy - y0)
+            wd = (ix - x0) * (iy - y0)
+            out = (gather(y0, x0) * wa[..., None] +
+                   gather(y0, x1) * wb[..., None] +
+                   gather(y1, x0) * wc[..., None] +
+                   gather(y1, x1) * wd[..., None])
+        return out.transpose(0, 3, 1, 2).astype(xv.dtype)
+
+    from ...ops._registry import as_tensor
+    from ..._core.autograd import apply
+    return apply(fn, as_tensor(x), as_tensor(grid), name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Affine sampling grid for grid_sample (reference:
+    paddle/phi/kernels/affine_grid_kernel.h). theta: (N, 2, 3);
+    out_shape: [N, C, H, W] -> grid (N, H, W, 2) in [-1, 1]."""
+    from ...ops._registry import as_tensor
+    from ..._core.autograd import apply
+    N, _, H, W = [int(d) for d in out_shape]
+
+    def fn(tv):
+        def axis(n):
+            if align_corners or n == 1:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+        ys, xs = jnp.meshgrid(axis(H), axis(W), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # (H, W, 3)
+        grid = jnp.einsum("hwk,nik->nhwi", base,
+                          tv.astype(jnp.float32))               # (N,H,W,2)
+        return grid.astype(tv.dtype)
+    return apply(fn, as_tensor(theta), name="affine_grid")

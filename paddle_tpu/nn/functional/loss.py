@@ -289,3 +289,42 @@ def dice_loss(input, label, epsilon=1e-05, name=None):
         union = jnp.sum(p, axis=red) + jnp.sum(yoh, axis=red)
         return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
     return apply(f, as_tensor(input), as_tensor(label), name="dice_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax CE (reference:
+    paddle/phi/kernels/margin_cross_entropy_kernel.cu; python
+    nn/functional/common margin_cross_entropy). ``logits`` are cosine
+    similarities; the label class's angle gets cos(m1*theta + m2) - m3
+    before scaling. ``group`` is accepted for parity: under GSPMD a
+    class-sharded logits tensor parallelizes automatically."""
+    lg = as_tensor(logits)
+    lb = as_tensor(label)
+
+    def fn(lv, yv):
+        lv32 = lv.astype(jnp.float32)
+        y = yv.reshape(-1)
+        onehot = jax.nn.one_hot(y, lv32.shape[-1], dtype=jnp.float32)
+        if margin1 != 1.0 or margin2 != 0.0:
+            theta = jnp.arccos(jnp.clip(lv32, -1.0 + 1e-7, 1.0 - 1e-7))
+            target = jnp.cos(margin1 * theta + margin2)
+        else:
+            target = lv32
+        target = target - margin3
+        mod = jnp.where(onehot > 0, target, lv32) * scale
+        logp = jax.nn.log_softmax(mod, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        return loss, jnp.exp(logp)
+
+    if return_softmax:
+        loss, sm = apply(fn, lg, lb, name="margin_cross_entropy")
+    else:
+        loss = apply(lambda lv, yv: fn(lv, yv)[0], lg, lb,
+                     name="margin_cross_entropy")
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, sm) if return_softmax else loss

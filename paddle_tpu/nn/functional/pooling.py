@@ -187,3 +187,55 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "NCDHW", False,
                           "adaptive_max_pool3d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """Power-average pooling (reference: lp_pool2d in
+    python/paddle/nn/functional/pooling.py): (sum |x|^p / 1) ^ (1/p) —
+    the reference uses a non-averaged sum times kernel count semantics of
+    torch: (sum x^p)^(1/p)."""
+    p = float(norm_type)
+    xt = as_tensor(x)
+
+    def fn(v):
+        from ..._core.tensor import Tensor
+        vp = jnp.abs(v.astype(jnp.float32)) ** p
+        s = raw(avg_pool2d(Tensor(vp, _internal=True), kernel_size,
+                           stride=stride, padding=padding,
+                           ceil_mode=ceil_mode, exclusive=False,
+                           data_format=data_format))
+        ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size, kernel_size)
+        return ((s * (ks[0] * ks[1])) ** (1.0 / p)).astype(v.dtype)
+    return apply(fn, xt, name="lp_pool2d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True) (reference:
+    paddle/phi/kernels/unpool_kernel.h): scatter each pooled value to the
+    flat H*W position its mask recorded."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW")
+    ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
+        else (kernel_size, kernel_size)
+    st = stride or ks
+    st = st if isinstance(st, (tuple, list)) else (st, st)
+    pd = padding if isinstance(padding, (tuple, list)) \
+        else (padding, padding)
+
+    def fn(v, idx):
+        N, C, Hp, Wp = v.shape
+        if output_size is not None:
+            Ho, Wo = output_size[-2], output_size[-1]
+        else:
+            Ho = (Hp - 1) * st[0] - 2 * pd[0] + ks[0]
+            Wo = (Wp - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat_v = v.reshape(N, C, Hp * Wp)
+        flat_i = idx.reshape(N, C, Hp * Wp).astype(jnp.int32)
+        out = jnp.zeros((N, C, Ho * Wo), v.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, val: o.at[i].set(val)))(out, flat_i, flat_v)
+        return out.reshape(N, C, Ho, Wo)
+    return apply(fn, as_tensor(x), as_tensor(indices), name="max_unpool2d")

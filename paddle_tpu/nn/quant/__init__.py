@@ -1,0 +1,102 @@
+"""Weight-only quantization for LLM serving (reference:
+python/paddle/nn/quant/quantized_linear.py — weight_quantize:30,
+weight_dequantize:100, weight_only_linear:148, llm_int8_linear:250; kernels
+paddle/phi/kernels/fusion/cutlass/ fp8/int8 gemm).
+
+TPU-native: int8/int4 weights are stored per-out-channel absmax quantized;
+the matmul path dequantizes into bf16 and lets the MXU run a dense GEMM —
+XLA fuses the dequant multiply into the matmul epilogue, so there is no
+custom cutlass kernel to port. int4 packs two nibbles per int8 byte (HBM is
+the bottleneck weight-only quant addresses; compute stays bf16).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply, no_grad
+from ..._core.tensor import Tensor
+from ...ops._registry import as_tensor, raw
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def _check_algo(algo):
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r}")
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None,
+                    group_size=-1):
+    """(in, out) weight -> (quantized weight, per-out-channel scale).
+    int4 packs pairs of rows into one int8 byte (low nibble = even row)."""
+    _check_algo(algo)
+    w = raw(as_tensor(x)).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w), axis=0)
+    if algo == "weight_only_int4":
+        q = jnp.clip(jnp.round(w / jnp.where(scale == 0, 1, scale) * 7),
+                     -8, 7).astype(jnp.int8)
+        if q.shape[0] % 2:
+            q = jnp.pad(q, ((0, 1), (0, 0)))
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        packed = (lo | hi).astype(jnp.int8)
+        return (Tensor(packed, _internal=True),
+                Tensor(scale / 7.0, _internal=True))
+    q = jnp.clip(jnp.round(w / jnp.where(scale == 0, 1, scale) * 127),
+                 -127, 127).astype(jnp.int8)
+    return (Tensor(q, _internal=True),
+            Tensor(scale / 127.0, _internal=True))
+
+
+def _unpack_int4(q):
+    lo = (q.astype(jnp.int32) << 28) >> 28        # sign-extend low nibble
+    hi = q.astype(jnp.int32) >> 4                  # arithmetic: sign-extends
+    out = jnp.stack([lo, hi], axis=1).reshape((-1,) + q.shape[1:])
+    return out.astype(jnp.int8)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype=None):
+    _check_algo(algo)
+    q = raw(as_tensor(x))
+    s = raw(as_tensor(scale)).astype(jnp.float32)
+    d = out_dtype or jnp.float32
+    if algo == "weight_only_int4":
+        q = _unpack_int4(q)
+    return Tensor((q.astype(jnp.float32) * s).astype(d), _internal=True)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) + bias. Differentiable w.r.t. x and bias
+    (the quantized weight is inference-frozen, as in the reference)."""
+    algo = "weight_only_int4" if str(weight_dtype) == "int4" \
+        else "weight_only_int8"
+    wq = raw(as_tensor(weight))
+    ws = raw(as_tensor(weight_scale)).astype(jnp.float32) \
+        if weight_scale is not None else jnp.ones((wq.shape[-1],))
+    if algo == "weight_only_int4":
+        wq = _unpack_int4(wq)
+
+    def fn(xv, *maybe_bias):
+        wde = (wq.astype(jnp.float32) * ws).astype(xv.dtype)
+        y = xv @ wde
+        if maybe_bias:
+            y = y + maybe_bias[0]
+        return y
+    if bias is not None:
+        return apply(fn, as_tensor(x), as_tensor(bias),
+                     name="weight_only_linear")
+    return apply(fn, as_tensor(x), name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """reference: quantized_linear.py:250 — the outlier-decomposition GEMM.
+    On TPU the dense bf16 MXU path already handles outliers at full
+    precision after dequant, so this is weight_only_linear int8."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")

@@ -13,6 +13,7 @@ from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 
 from .extra import *  # noqa: F401,F403
+from .parity import *  # noqa: F401,F403
 
 from . import math  # noqa: F401
 from . import creation  # noqa: F401
@@ -22,6 +23,7 @@ from . import linalg  # noqa: F401
 from . import search  # noqa: F401
 from . import random  # noqa: F401
 from . import extra  # noqa: F401
+from . import parity  # noqa: F401
 
 _registry.attach_tensor_methods()
 

@@ -279,3 +279,36 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply(lambda v: jnp.cov(v, rowvar=rowvar,
                                    ddof=1 if ddof else 0), as_tensor(x),
                  name="cov")
+
+
+@register("lu_unpack", tensor_method=False)
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu output into (P, L, U) (reference:
+    paddle/phi/kernels/lu_unpack_kernel.h). Batched inputs unpack
+    batch-wise; disabled outputs return None (3-tuple always)."""
+    lu_mat = np.asarray(raw(as_tensor(x)))
+    piv = np.asarray(raw(as_tensor(y))).astype(np.int64)
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = np.tril(lu_mat, -1)[..., :, :k].copy()
+        idx = np.arange(k)
+        L[..., idx, idx] = 1.0
+        U = np.triu(lu_mat)[..., :k, :]
+    if unpack_pivots:
+        batch = lu_mat.shape[:-2]
+        piv2 = piv.reshape((-1, piv.shape[-1]))
+        Ps = np.zeros((piv2.shape[0], m, m), lu_mat.dtype)
+        for b in range(piv2.shape[0]):
+            perm = np.arange(m)
+            for i, p in enumerate(piv2[b][:k]):
+                perm[i], perm[p - 1] = perm[p - 1], perm[i]
+            Ps[b][perm, np.arange(m)] = 1.0
+        P = Ps.reshape(batch + (m, m))
+    wrap = lambda v: None if v is None else Tensor(jnp.asarray(v),
+                                                   _internal=True)
+    return wrap(P), wrap(L), wrap(U)
+
+
+from .parity import multi_dot  # noqa: E402,F401  (paddle.linalg.multi_dot)

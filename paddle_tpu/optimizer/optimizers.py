@@ -273,3 +273,151 @@ class Lamb(Optimizer):
         state["moment1"] = m
         state["moment2"] = v
         return p32 - lr * trust * r, state
+
+
+class NAdam(Optimizer):
+    """reference: python/paddle/optimizer/nadam.py — Adam with Nesterov
+    momentum (Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+        self._momentum_decay = momentum_decay
+
+    def _slots(self):
+        return ("moment1", "moment2", "mu_product")
+
+    def _context(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "eps": self._epsilon, "psi": self._momentum_decay}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        b1, b2, eps, psi = (ctx["beta1"], ctx["beta2"], ctx["eps"],
+                            ctx["psi"])
+        t = ctx["step"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        # slot zeros mean "first step" (generic init paths create zeroed
+        # slots; the product seed is 1)
+        prev = jnp.where(state["mu_product"] == 0.0, 1.0,
+                         state["mu_product"])
+        mu_prod = prev * mu_t
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1) +
+                (1 - mu_t) * g / (1 - mu_prod))
+        vhat = v / (1 - b2 ** t)
+        state["moment1"], state["moment2"] = m, v
+        state["mu_product"] = jnp.broadcast_to(
+            mu_prod, state["moment1"].shape).astype(jnp.float32) \
+            if jnp.ndim(mu_prod) == 0 else mu_prod
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps), state
+
+
+class RAdam(Optimizer):
+    """reference: python/paddle/optimizer/radam.py — rectified Adam (Liu
+    et al. 2020): falls back to unadapted momentum while the variance
+    estimate is untrustworthy."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def _slots(self):
+        return ("moment1", "moment2")
+
+    def _context(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "eps": self._epsilon}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        b1, b2, eps = ctx["beta1"], ctx["beta2"], ctx["eps"]
+        t = ctx["step"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        state["moment1"], state["moment2"] = m, v
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                     jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                 eps))
+        vhat = jnp.sqrt(v / (1 - b2 ** t))
+        adaptive = p - lr * r * mhat / (vhat + eps)
+        plain = p - lr * mhat
+        # threshold 5 per the reference (radam.py docstring) and torch
+        return jnp.where(rho_t > 5.0, adaptive, plain), state
+
+
+class Rprop(Optimizer):
+    """reference: python/paddle/optimizer/rprop.py — resilient
+    backpropagation (sign-based per-weight step sizes; full-batch only)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _slots(self):
+        return ("prev_grad", "step_size")
+
+    def _context(self):
+        return {"etas": self._etas, "lr_range": self._lr_range,
+                "lr0": self._learning_rate
+                if isinstance(self._learning_rate, float) else 0.001}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        eta_n, eta_p = ctx["etas"]
+        lo, hi = ctx["lr_range"]
+        g = g.astype(jnp.float32)
+        sz = jnp.where(state["step_size"] == 0.0,
+                       jnp.full_like(state["step_size"], ctx["lr0"]),
+                       state["step_size"])
+        sign = jnp.sign(g * state["prev_grad"])
+        sz = jnp.clip(jnp.where(sign > 0, sz * eta_p,
+                                jnp.where(sign < 0, sz * eta_n, sz)),
+                      lo, hi)
+        # on sign change the step is skipped and the stored grad zeroed
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        state["prev_grad"] = g_eff
+        state["step_size"] = sz
+        return p - jnp.sign(g_eff) * sz, state
+
+
+class ASGD(Optimizer):
+    """reference: python/paddle/optimizer/asgd.py — averaged SGD (Polyak
+    averaging over the parameter trajectory)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._batch_num = batch_num
+
+    def _slots(self):
+        return ("d", "ys")
+
+    def _context(self):
+        return {"n": self._batch_num}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        # reference kernel: d += g - y_i; y_i = g; p -= lr/n * d
+        n = ctx["n"]
+        g = _apply_l2(g.astype(jnp.float32), p.astype(jnp.float32),
+                      ctx.get("weight_decay"))
+        d = state["d"] + g - state["ys"]
+        state["d"] = d
+        state["ys"] = g
+        return p - lr / n * d, state

@@ -1,0 +1,66 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — nms:1637,
+box_iou-style utilities; kernel paddle/phi/kernels/nms_kernel.h).
+
+TPU-native note: NMS is sequential by nature (each suppression depends on
+prior keeps). This implementation runs the O(n^2) IoU matrix on device
+(one batched jnp computation, MXU-friendly) and the greedy scan via
+lax.while-free numpy on host — NMS sits at the end of detection pipelines
+where the candidate count is small.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from ..ops._registry import as_tensor, raw
+
+__all__ = ["nms", "box_iou"]
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU of (N, 4) and (M, 4) xyxy boxes."""
+    a = raw(as_tensor(boxes1)).astype(jnp.float32)
+    b = raw(as_tensor(boxes2)).astype(jnp.float32)
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return Tensor(inter / jnp.maximum(union, 1e-9), _internal=True)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy non-maximum suppression (reference: vision/ops.py:1637).
+    boxes: (N, 4) xyxy. Returns kept indices sorted by descending score."""
+    bv = raw(as_tensor(boxes))
+    n = bv.shape[0]
+    if n == 0:
+        return Tensor(jnp.zeros((0,), jnp.int32), _internal=True)
+    sv = raw(as_tensor(scores)) if scores is not None else None
+
+    iou = np.asarray(jax.device_get(raw(box_iou(boxes, boxes))))
+    order = np.argsort(-np.asarray(jax.device_get(sv))) \
+        if sv is not None else np.arange(n)
+    cats = np.asarray(jax.device_get(raw(as_tensor(category_idxs)))) \
+        if category_idxs is not None else None
+
+    suppressed = np.zeros(n, bool)
+    keep = []
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        over = iou[i] > iou_threshold
+        if cats is not None:
+            over = over & (cats == cats[i])  # class-aware suppression
+        over[i] = False
+        suppressed |= over
+    keep = np.asarray(keep, np.int32)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep), _internal=True)

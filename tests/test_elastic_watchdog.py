@@ -25,7 +25,7 @@ class TestWatchdog:
         fired = []
         wd = StepWatchdog(0.3, action="callback",
                           callback=lambda: fired.append(1),
-                          log_path=str(log))
+                          log_path=str(log), start_grace=0)
         with wd:
             time.sleep(1.2)
         assert fired
@@ -35,7 +35,8 @@ class TestWatchdog:
         assert "test_elastic_watchdog" in log.read_text()
 
     def test_ticks_prevent_firing(self):
-        wd = StepWatchdog(0.5, action="callback", callback=lambda: None)
+        wd = StepWatchdog(0.5, action="callback", callback=lambda: None,
+                          start_grace=0)
         with wd:
             for _ in range(6):
                 time.sleep(0.15)
@@ -48,6 +49,7 @@ class TestWatchdog:
         monkeypatch.setenv("PADDLE_STEP_TIMEOUT", "30")
         wd = StepWatchdog.from_env(action="callback", callback=lambda: None)
         assert wd is not None and wd.timeout == 30.0
+        assert wd.start_grace >= 600  # first-step compile slack
         wd.stop()
 
 

@@ -1,0 +1,305 @@
+"""OpTest-style checks for the op-parity batch (tools/op_coverage.py).
+
+Pattern follows the reference's OpTest (test/legacy_test/op_test.py):
+compare against an independent oracle — torch (CPU) where the semantics
+match, numpy/scipy otherwise — plus gradient checks through jax.grad.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+rng = np.random.RandomState(0)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_torch(self, mode, pad, align):
+        import torch
+        x = rng.randn(2, 3, 6, 7).astype("float32")
+        g = rng.uniform(-1.3, 1.3, (2, 4, 5, 2)).astype("float32")
+        ours = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                             mode=mode, padding_mode=pad,
+                             align_corners=align).numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(g), mode=mode, padding_mode=pad,
+            align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype("float32"),
+                             stop_gradient=False)
+        g = paddle.to_tensor(
+            rng.uniform(-1, 1, (1, 3, 3, 2)).astype("float32"))
+        F.grid_sample(x, g).sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestAffineGrid:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_torch(self, align):
+        import torch
+        theta = rng.randn(2, 2, 3).astype("float32")
+        ours = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                             align_corners=align).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(theta), [2, 3, 4, 5],
+            align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestPooling:
+    def test_lp_pool2d_matches_torch(self):
+        import torch
+        x = np.abs(rng.randn(2, 3, 8, 8)).astype("float32")
+        ours = F.lp_pool2d(paddle.to_tensor(x), 3.0, 2, stride=2).numpy()
+        ref = torch.nn.functional.lp_pool2d(
+            torch.tensor(x), 3.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        pooled, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                    return_mask=True)
+        un = F.max_unpool2d(pooled, mask, 2, stride=2)
+        assert tuple(un.shape) == (2, 3, 8, 8)
+        # every pooled max lands back at its argmax position
+        total = un.numpy().sum()
+        np.testing.assert_allclose(total, pooled.numpy().sum(), rtol=1e-5)
+
+
+class TestMarginCE:
+    def test_zero_margin_is_scaled_ce(self):
+        logits = rng.uniform(-1, 1, (6, 10)).astype("float32")
+        label = rng.randint(0, 10, (6,))
+        ours = F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(label),
+            margin1=1.0, margin2=0.0, margin3=0.0, scale=30.0).numpy()
+        ref = F.cross_entropy(
+            paddle.to_tensor(logits * 30.0),
+            paddle.to_tensor(label)).mean().numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_margin_increases_loss(self):
+        logits = rng.uniform(-1, 1, (6, 10)).astype("float32")
+        label = rng.randint(0, 10, (6,))
+        base = F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(label),
+            margin2=0.0).numpy()
+        marg = F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(label),
+            margin2=0.5).numpy()
+        assert marg > base
+
+
+class TestSequenceBeam:
+    def test_sequence_mask(self):
+        out = paddle.sequence_mask(
+            paddle.to_tensor(np.array([1, 3, 0])), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            out, [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+
+    def test_gather_tree_matches_manual(self):
+        # beams: t0 picks [2,5]; t1 parents [0,0]; t2 parents [1,0]
+        ids = np.array([[[2, 5]], [[6, 7]], [[8, 9]]], dtype="int64")
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], dtype="int64")
+        out = paddle.gather_tree(paddle.to_tensor(ids),
+                                 paddle.to_tensor(parents)).numpy()
+        # beam0 at t2: token 8, parent 1 -> t1 token 7, parent 0 -> t0 2
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 7, 8])
+        # beam1 at t2: token 9, parent 0 -> t1 token 6 -> t0 token 2
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 6, 9])
+
+    def test_edit_distance(self):
+        d, n = paddle.edit_distance(
+            paddle.to_tensor(np.array([[1, 2, 3, 0]])),
+            paddle.to_tensor(np.array([[1, 3, 3, 4]])),
+            normalized=False)
+        np.testing.assert_allclose(d.numpy(), [[2.0]])
+        assert int(n.numpy()) == 1
+
+    def test_top_p_sampling_respects_nucleus(self):
+        probs = np.array([[0.05, 0.7, 0.25]] * 64, dtype="float32")
+        _, ids = paddle.top_p_sampling(
+            paddle.to_tensor(probs),
+            paddle.to_tensor(np.full((64,), 0.6, "float32")))
+        assert set(np.unique(ids.numpy())) == {1}  # only the 0.7 token
+
+
+class TestLinalgExtras:
+    def test_multi_dot_grad(self):
+        a = paddle.to_tensor(rng.randn(3, 4).astype("float32"),
+                             stop_gradient=False)
+        b = paddle.to_tensor(rng.randn(4, 5).astype("float32"))
+        c = paddle.to_tensor(rng.randn(5, 2).astype("float32"))
+        out = paddle.linalg.multi_dot([a, b, c])
+        ref = np.linalg.multi_dot([a.numpy(), b.numpy(), c.numpy()])
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+        out.sum().backward()
+        np.testing.assert_allclose(
+            a.grad.numpy(), (np.ones((3, 2)) @ (b.numpy() @ c.numpy()).T),
+            rtol=1e-5, atol=1e-5)
+
+    def test_lu_unpack_reconstructs(self):
+        x = rng.randn(5, 5).astype("float32")
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(x))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ L.numpy() @ U.numpy(), x, rtol=1e-4, atol=1e-4)
+
+    def test_clip_by_norm(self):
+        x = np.ones(4, "float32") * 2
+        out = paddle.clip_by_norm(paddle.to_tensor(x), 1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+        small = paddle.clip_by_norm(
+            paddle.to_tensor(x * 0.1), 10.0).numpy()
+        np.testing.assert_allclose(small, x * 0.1, rtol=1e-6)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        import paddle_tpu.geometric as geo
+        data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.], [5., 6.]], dtype="float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(
+            geo.segment_sum(data, ids).numpy(), [[4, 6], [5, 6]])
+        np.testing.assert_allclose(
+            geo.segment_mean(data, ids).numpy(), [[2, 3], [5, 6]])
+        np.testing.assert_allclose(
+            geo.segment_max(data, ids).numpy(), [[3, 4], [5, 6]])
+        np.testing.assert_allclose(
+            geo.segment_min(data, ids).numpy(), [[1, 2], [5, 6]])
+
+    def test_send_recv_grad(self):
+        import paddle_tpu.geometric as geo
+        x = paddle.to_tensor(rng.randn(4, 3).astype("float32"),
+                             stop_gradient=False)
+        src = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        dst = paddle.to_tensor(np.array([1, 1, 2, 2]))
+        out = geo.send_u_recv(x, src, dst, "mean")
+        out.sum().backward()
+        assert x.grad is not None
+        e = paddle.to_tensor(rng.randn(4, 3).astype("float32"))
+        out2 = geo.send_ue_recv(x, e, src, dst, "mul", "sum")
+        assert tuple(out2.shape) == (4, 3)
+        out3 = geo.send_uv(x, x, src, dst, "add")
+        assert tuple(out3.shape) == (4, 3)
+
+
+class TestWeightOnlyQuant:
+    def test_int8_roundtrip_and_linear(self):
+        import paddle_tpu.nn.quant as Q
+        w = rng.randn(16, 8).astype("float32")
+        qw, sc = Q.weight_quantize(paddle.to_tensor(w))
+        assert qw.numpy().dtype == np.int8
+        err = np.abs(Q.weight_dequantize(qw, sc).numpy() - w).max()
+        assert err < np.abs(w).max() / 100
+        x = paddle.to_tensor(rng.randn(4, 16).astype("float32"),
+                             stop_gradient=False)
+        y = Q.weight_only_linear(x, qw, weight_scale=sc)
+        np.testing.assert_allclose(y.numpy(), x.numpy() @ w, rtol=0.1,
+                                   atol=0.1)
+        y.sum().backward()
+        assert x.grad is not None
+
+    def test_int4_roundtrip(self):
+        import paddle_tpu.nn.quant as Q
+        w = rng.randn(16, 8).astype("float32")
+        qw, sc = Q.weight_quantize(paddle.to_tensor(w),
+                                   algo="weight_only_int4")
+        assert qw.numpy().shape == (8, 8)  # packed pairs
+        err = np.abs(Q.weight_dequantize(
+            qw, sc, algo="weight_only_int4").numpy() - w).max()
+        assert err < np.abs(w).max() / 6
+
+
+class TestNMS:
+    def test_nms_suppresses_overlaps(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         dtype="float32")
+        scores = np.array([0.9, 0.8, 0.7], dtype="float32")
+        keep = nms(paddle.to_tensor(boxes), 0.5,
+                   scores=paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(sorted(keep), [0, 2])
+
+    def test_categories_keep_cross_class(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype="float32")
+        scores = np.array([0.9, 0.8], dtype="float32")
+        cats = np.array([0, 1])
+        keep = nms(paddle.to_tensor(boxes), 0.5,
+                   scores=paddle.to_tensor(scores),
+                   category_idxs=paddle.to_tensor(cats),
+                   categories=[0, 1]).numpy()
+        np.testing.assert_array_equal(sorted(keep), [0, 1])
+
+
+class TestNewOptimizers:
+    def _train(self, opt_cls, torch_cls=None, steps=10, **kw):
+        import torch
+        paddle.seed(0)
+        w0 = rng.randn(6, 1).astype("float32")
+        X = rng.randn(32, 6).astype("float32")
+        y = X @ w0
+        lin = paddle.nn.Linear(6, 1)
+        opt = opt_cls(learning_rate=0.05, parameters=lin.parameters(), **kw)
+        tl = torch.nn.Linear(6, 1)
+        with torch.no_grad():
+            tl.weight.copy_(torch.tensor(lin.weight.numpy().T))
+            tl.bias.copy_(torch.tensor(lin.bias.numpy()))
+        topt = torch_cls(tl.parameters(), lr=0.05) if torch_cls else None
+        losses = []
+        for i in range(steps):
+            pred = lin(paddle.to_tensor(X))
+            loss = ((pred - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+            if topt is not None:
+                tloss = ((tl(torch.tensor(X)) -
+                          torch.tensor(y)) ** 2).mean()
+                topt.zero_grad()
+                tloss.backward()
+                topt.step()
+                np.testing.assert_allclose(
+                    float(loss.numpy()), float(tloss), rtol=1e-3, atol=1e-4,
+                    err_msg=f"step {i} diverged from torch")
+        return losses
+
+    def test_nadam_matches_torch(self):
+        import torch
+        self._train(paddle.optimizer.NAdam, torch.optim.NAdam)
+
+    def test_radam_matches_torch(self):
+        import torch
+        self._train(paddle.optimizer.RAdam, torch.optim.RAdam)
+
+    def test_rprop_matches_torch(self):
+        import torch
+        self._train(paddle.optimizer.Rprop, torch.optim.Rprop)
+
+    def test_asgd_converges(self):
+        losses = self._train(paddle.optimizer.ASGD, None, steps=60)
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestInplaceRandom:
+    def test_uniform_normal_exponential(self):
+        t = paddle.to_tensor(np.zeros((64, 64), "float32"))
+        t.uniform_(2.0, 3.0)
+        assert 2.0 <= t.numpy().min() and t.numpy().max() <= 3.0
+        t.normal_(mean=5.0, std=0.1)
+        assert abs(t.numpy().mean() - 5.0) < 0.05
+        t.exponential_(lam=2.0)
+        assert t.numpy().min() >= 0
+        assert abs(t.numpy().mean() - 0.5) < 0.1

