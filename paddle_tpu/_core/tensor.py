@@ -222,20 +222,22 @@ class Tensor:
 
     # ---- in-place machinery ----
     def _inplace_assign(self, new_value, node=None, out_index=0):
+        old = self._value if _inplace_hook[0] is not None else None
         self._value = new_value
         self._version += 1
         self._node = node
         self._out_index = out_index
         if _inplace_hook[0] is not None:
-            _inplace_hook[0](self, None, new_value)
+            _inplace_hook[0](self, None, new_value, old)
 
     def _inplace_from(self, t: "Tensor"):
+        old = self._value if _inplace_hook[0] is not None else None
         self._value = t._value
         self._version += 1
         self._node = t._node
         self._out_index = t._out_index
         if _inplace_hook[0] is not None:
-            _inplace_hook[0](self, t, None)
+            _inplace_hook[0](self, t, None, old)
         if t._node is not None:
             # e.g. buf[i] = net_out where buf had stop_gradient=True: the
             # result now depends on a differentiable input, so it must track

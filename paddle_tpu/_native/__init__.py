@@ -17,7 +17,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["store.cpp", "datapath.cpp"]
+_SOURCES = ["store.cpp", "datapath.cpp", "ckptio.cpp"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -78,6 +78,14 @@ def load():
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.pt_file_write.restype = ctypes.c_longlong
+        lib.pt_file_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_int]
+        lib.pt_file_read.restype = ctypes.c_longlong
+        lib.pt_file_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
             ctypes.c_int]
         _lib = lib
         return _lib

@@ -1,0 +1,98 @@
+// Parallel checkpoint chunk IO.
+//
+// reference: paddle/fluid/distributed/collective/async_load.cc (dedicated
+// transfer threads + event sync) and the save_combine/load_combine kernels
+// (paddle/phi/kernels/save_combine_kernel.h) — the native file path under
+// the reference's checkpoint stack. TPU-native port: the distributed
+// checkpoint writes raw row-major chunks; this module gives it
+// multi-threaded pwrite/pread so large shards saturate NVMe/FUSE
+// throughput instead of a single-thread memcpy loop.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+long long run_parallel(int fd, char* base, long long nbytes, int n_threads,
+                       bool write) {
+  int nt = std::max(1, std::min(n_threads, 16));
+  if (nbytes < (1 << 20)) nt = 1;  // small files: thread spawn dominates
+  long long chunk = (nbytes + nt - 1) / nt;
+  std::vector<std::thread> threads;
+  std::vector<long long> status(nt, 0);
+  for (int i = 0; i < nt; ++i) {
+    threads.emplace_back([=, &status]() {
+      long long off = static_cast<long long>(i) * chunk;
+      long long end = std::min(nbytes, off + chunk);
+      while (off < end) {
+        ssize_t n = write ? ::pwrite(fd, base + off, end - off, off)
+                          : ::pread(fd, base + off, end - off, off);
+        if (n <= 0) {
+          status[i] = -(n == 0 ? EIO : errno);
+          return;
+        }
+        off += n;
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  for (auto s : status)
+    if (s < 0) return s;
+  return nbytes;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write nbytes from data to path with n_threads parallel pwrites.
+// Returns nbytes on success, -errno on failure. fsyncs before returning
+// (the checkpointer's atomic tmp+rename contract needs durable content).
+long long pt_file_write(const char* path, const void* data, long long nbytes,
+                        int n_threads) {
+  int fd = ::open(path, O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  long long rc = nbytes;
+  if (::ftruncate(fd, nbytes) != 0) {
+    rc = -errno;
+  } else if (nbytes > 0) {
+    rc = run_parallel(fd, const_cast<char*>(static_cast<const char*>(data)),
+                      nbytes, n_threads, /*write=*/true);
+  }
+  if (rc >= 0 && ::fsync(fd) != 0) rc = -errno;
+  ::close(fd);
+  return rc;
+}
+
+// Read exactly nbytes from path into data with n_threads parallel preads.
+// Returns nbytes on success, -errno on failure (including short files).
+long long pt_file_read(const char* path, void* data, long long nbytes,
+                       int n_threads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  if (st.st_size < nbytes) {
+    ::close(fd);
+    return -EIO;  // truncated chunk: fail loudly, never zero-fill
+  }
+  long long rc = nbytes > 0
+      ? run_parallel(fd, static_cast<char*>(data), nbytes, n_threads,
+                     /*write=*/false)
+      : 0;
+  ::close(fd);
+  return rc < 0 ? rc : nbytes;
+}
+
+}  // extern "C"

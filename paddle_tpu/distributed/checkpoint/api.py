@@ -6,8 +6,13 @@ Re-design of the reference's distributed checkpoint
 (ReadItem:41 — cross-mesh re-slicing), metadata.py).
 
 TPU-native format: one directory per checkpoint
-  metadata.json           — per-tensor: shape, dtype, chunk grid, placements
-  <name>.<chunk>.npy      — row-major chunk files
+  metadata.json           — per-tensor: shape, dtype, chunk grid, crc32s
+  <name>.<chunk>.bin      — raw row-major chunk bytes (written/read by the
+                            native parallel IO when available:
+                            _native/ckptio.cpp ≙ the reference's
+                            save_combine kernels + async_load.cc threads;
+                            numpy tofile/fromfile fallback). Legacy .npy
+                            chunks still load.
 
 Save writes each tensor as a grid of chunk files following its CURRENT
 sharding (one file per distinct shard — replicas deduplicated exactly like
@@ -19,9 +24,11 @@ Async save offloads file writing to a background thread (reference :46).
 """
 from __future__ import annotations
 
+import ctypes
 import json
 import os
 import threading
+import zlib
 from typing import Dict, Optional
 
 import numpy as np
@@ -34,6 +41,52 @@ from ..auto_parallel.placement import Shard, Replicate, Partial
 from ..auto_parallel.process_mesh import ProcessMesh
 
 _async_jobs = []
+_IO_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(memoryview(np.ascontiguousarray(arr)).cast("B"))
+
+
+def _write_chunk(fpath: str, arr: np.ndarray) -> int:
+    """Raw chunk write via the native parallel writer (large chunks) or
+    numpy; returns the crc32 recorded in metadata."""
+    data = np.ascontiguousarray(arr)
+    crc = _crc(data)
+    from ... import _native
+    lib = _native.load()
+    if lib is not None and data.nbytes >= (1 << 20):
+        rc = lib.pt_file_write(fpath.encode(),
+                               data.ctypes.data_as(ctypes.c_void_p),
+                               data.nbytes, _IO_THREADS)
+        if rc == data.nbytes:
+            return crc
+    data.tofile(fpath)
+    return crc
+
+
+def _read_chunk(fpath: str, shape, dtype) -> np.ndarray:
+    if not os.path.exists(fpath):
+        # legacy .npy checkpoints (pre-.bin format) — only when no .bin
+        # exists, so a fresh save into an old directory wins
+        legacy = fpath[:-4] + ".npy"
+        if os.path.exists(legacy):
+            return np.load(legacy)
+    out = np.empty(shape, dtype=np.dtype(dtype))
+    from ... import _native
+    lib = _native.load()
+    if lib is not None and out.nbytes >= (1 << 20):
+        rc = lib.pt_file_read(fpath.encode(),
+                              out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes, _IO_THREADS)
+        if rc == out.nbytes:
+            return out
+        raise IOError(f"native read of {fpath} failed (rc={rc})")
+    got = np.fromfile(fpath, dtype=np.dtype(dtype))
+    if got.size != out.size:
+        raise IOError(f"chunk {fpath} has {got.size} elems, "
+                      f"expected {out.size}")
+    return got.reshape(shape)
 
 
 def _chunk_grid(shape, placements, mesh_shape):
@@ -67,25 +120,27 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
         else:
             placements, mesh_shape = [], []
         grid = _chunk_grid(arr.shape, placements, mesh_shape)
-        meta["state"][name] = {
+        entry = {
             "shape": list(arr.shape),
             "dtype": str(arr.dtype),
             "grid": grid,
+            "crc": {},
         }
+        meta["state"][name] = entry
         # write unique chunks (dedup: replicated axes write once)
         idx_iter = np.ndindex(*grid)
         for idx in idx_iter:
             sl = tuple(
                 slice(i * (s // g), (i + 1) * (s // g))
                 for i, s, g in zip(idx, arr.shape, grid))
-            fname = name.replace("/", "_") + "." + \
-                "_".join(map(str, idx)) + ".npy"
+            key = "_".join(map(str, idx))
+            fname = name.replace("/", "_") + "." + key + ".bin"
             jobs.append((os.path.join(path, fname),
-                         arr[sl] if arr.ndim else arr))
+                         arr[sl] if arr.ndim else arr, entry, key))
 
     def write_all():
-        for fpath, chunk in jobs:
-            np.save(fpath, chunk)
+        for fpath, chunk, entry, key in jobs:
+            entry["crc"][key] = _write_chunk(fpath, chunk)
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(meta, f)
 
@@ -117,11 +172,19 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
             raise KeyError(f"{name} not in checkpoint {path}")
         m = meta[name]
         grid = m["grid"]
+        cshape = tuple(s // g for s, g in zip(m["shape"], grid))
         parts = {}
         for idx in np.ndindex(*grid):
-            fname = name.replace("/", "_") + "." + \
-                "_".join(map(str, idx)) + ".npy"
-            parts[idx] = np.load(os.path.join(path, fname))
+            key = "_".join(map(str, idx))
+            fname = name.replace("/", "_") + "." + key + ".bin"
+            chunk = _read_chunk(os.path.join(path, fname), cshape,
+                                m["dtype"])
+            want = m.get("crc", {}).get(key)
+            if want is not None and _crc(chunk) != want:
+                raise IOError(
+                    f"checkpoint corruption: crc mismatch for {name} "
+                    f"chunk {key} in {path}")
+            parts[idx] = chunk
         # assemble global array from the chunk grid
         arr = _assemble(parts, grid, tuple(m["shape"]), m["dtype"])
         if isinstance(t, Tensor):

@@ -206,8 +206,8 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
                                       axis=axis)
         else:  # Replicate: every coordinate contributes the same tensor
             out = jnp.concatenate([x] * n, axis=axis)
-        plc[axi] = Replicate()
         if out_list is None:
+            plc[axi] = Replicate()
             return _remark(t, pm, plc, out)
     if out_list is not None:
         n = g.nranks
@@ -235,12 +235,13 @@ def reduce_scatter(output, input=None, op=ReduceOp.SUM,
             out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
         if op == ReduceOp.AVG:
             out = out / g.nranks
-    elif g.nranks > 1 and _eager_dist(
-            output if input is None else input, g) is not None:
+    elif g.nranks > 1 and (
+            _rs_info := _eager_dist(output if input is None else input,
+                                    g)) is not None:
         from .auto_parallel.placement import Shard, Replicate, Partial
         from .auto_parallel.api import _mark
         src = output if input is None else input
-        pm, axi, n, plc = _eager_dist(src, g)
+        pm, axi, n, plc = _rs_info
         if op not in (ReduceOp.SUM, ReduceOp.AVG):
             raise ValueError("reduce_scatter supports SUM/AVG")
         p = plc[axi]

@@ -17,5 +17,6 @@ from .tensor import (  # noqa: F401
 from .ops import (  # noqa: F401
     add, subtract, multiply, divide, matmul, masked_matmul, relu, abs, sin,
     tanh, pow, neg, cast, transpose, sum, sparse_coo_tensor_values_like,
+    coalesce, values, indices, divide_scalar, mask_as,
 )
 from . import nn  # noqa: F401

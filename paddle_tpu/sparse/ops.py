@@ -133,3 +133,44 @@ def masked_matmul(x, y, mask, name=None):
 
 def sparse_coo_tensor_values_like(x, values):
     return SparseCooTensor(x.indices, values, x.shape)
+
+
+def coalesce(x, name=None):
+    """reference: sparse_ops.yaml coalesce — merge duplicate coordinates."""
+    return x.coalesce() if isinstance(x, SparseCooTensor) else x
+
+
+def values(x, name=None):
+    """reference: sparse_ops.yaml values — the non-zero values as a dense
+    Tensor."""
+    return Tensor(x.values, _internal=True)
+
+
+def indices(x, name=None):
+    """reference: sparse_ops.yaml indices."""
+    return Tensor(x.indices, _internal=True)
+
+
+def divide_scalar(x, scalar, name=None):
+    """reference: sparse_ops.yaml divide_scalar — zero-preserving."""
+    return sparse_coo_tensor_values_like(x, x.values / scalar) \
+        if isinstance(x, SparseCooTensor) else type(x)(
+            x.crows, x.cols, x.values / scalar, x.shape)
+
+
+def mask_as(x, mask, name=None):
+    """reference: sparse_ops.yaml mask_as — take the dense tensor's values
+    at the sparse mask's coordinates (paddle.sparse.mask_as)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    m = mask.to_coo() if isinstance(mask, SparseCsrTensor) else mask
+    idx = tuple(m.indices[i] for i in range(m.indices.shape[0]))
+    # coalesce pads empty slots with -1 coordinates; zero their values so
+    # the wrap-around gather contributes nothing
+    valid = (m.indices >= 0).all(axis=0)
+    vals = jnp.where(
+        valid.reshape((-1,) + (1,) * (xv[idx].ndim - 1)), xv[idx], 0)
+    out = SparseCooTensor(m.indices, vals, m.shape)
+    if isinstance(mask, SparseCsrTensor):
+        from .tensor import to_sparse_csr
+        return to_sparse_csr(out)
+    return out

@@ -138,6 +138,8 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
 
 def to_sparse_coo(x, sparse_dim: Optional[int] = None) -> SparseCooTensor:
     """Dense Tensor -> COO (reference: Tensor.to_sparse_coo)."""
+    if isinstance(x, SparseCsrTensor):
+        return x.to_coo()
     x = as_tensor(x)
     arr = np.asarray(x._value)
     nd = sparse_dim or arr.ndim
@@ -148,6 +150,8 @@ def to_sparse_coo(x, sparse_dim: Optional[int] = None) -> SparseCooTensor:
 
 
 def to_sparse_csr(x) -> SparseCsrTensor:
+    if isinstance(x, SparseCooTensor):
+        x = x.to_dense()
     x = as_tensor(x)
     arr = np.asarray(x._value)
     assert arr.ndim == 2

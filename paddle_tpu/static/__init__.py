@@ -38,7 +38,7 @@ def _record(fn, args, outs):
             prog._val2out[id(o._value)] = o
 
 
-def _record_bind(alias, src_tensor, new_value):
+def _record_bind(alias, src_tensor, new_value, old_value=None):
     """In-place rebinding (y[0]=v, t.add_(v), _inplace_from): replay must
     route the alias to the producing op's output, not the build-time
     value."""
@@ -52,6 +52,8 @@ def _record_bind(alias, src_tensor, new_value):
         # map the assigned raw value back to the recorded out that
         # produced it (setitem-style ops assign an apply output's value)
         src = prog._val2out.get(id(new_value), new_value)
+    if old_value is not None and id(alias) not in prog._pre_values:
+        prog._pre_values[id(alias)] = old_value
     prog.ops.append(("bind", alias, src))
     if isinstance(alias, Tensor):
         prog._val2out[id(alias._value)] = alias
@@ -87,6 +89,10 @@ class Program:
         self.ops: list = []          # (fn, args, outs) | ("bind", alias, src)
         self.placeholders: dict = {}  # name -> placeholder Tensor
         self._val2out: dict = {}      # id(out._value) -> recorded out
+        # pre-mutation value of each tensor first rebound in-place: ops
+        # recorded BEFORE the bind must replay against this, not the
+        # final (mutated) build-time value
+        self._pre_values: dict = {}
 
     def global_block(self):
         return self
@@ -162,7 +168,12 @@ class Executor:
 
         def resolve(a):
             if isinstance(a, Tensor):
-                return env.get(id(a), a._value)
+                v = env.get(id(a))
+                if v is not None:
+                    return v
+                # not yet (re)computed this replay: a tensor later rebound
+                # in place must resolve to its PRE-mutation value here
+                return prog._pre_values.get(id(a), a._value)
             return a
 
         _state.replaying = True

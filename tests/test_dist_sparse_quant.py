@@ -222,3 +222,32 @@ class TestQuantization:
         final = ptq.convert(qnet)
         out = final(paddle.to_tensor(np.ones((1, 4), np.float32)))
         assert np.isfinite(out.numpy()).all()
+
+
+class TestSparseExtras:
+    """sparse_ops.yaml long tail: coalesce/values/indices/divide_scalar/
+    mask_as (reference: paddle/phi/kernels/sparse/)."""
+
+    def test_coalesce_and_accessors(self):
+        import paddle_tpu.sparse as sp
+        x = sp.sparse_coo_tensor([[0, 1, 1], [1, 0, 0]], [1., 2., 3.],
+                                 shape=[2, 2])
+        c = sp.coalesce(x)
+        np.testing.assert_allclose(c.to_dense().numpy(), [[0, 1], [5, 0]])
+        assert sp.values(c).shape[0] == c.nnz
+        assert sp.indices(c).shape[0] == 2
+
+    def test_divide_scalar_mask_as(self):
+        import paddle_tpu.sparse as sp
+        x = sp.sparse_coo_tensor([[0, 1, 1], [1, 0, 0]], [1., 2., 3.],
+                                 shape=[2, 2])
+        c = sp.coalesce(x)
+        np.testing.assert_allclose(
+            sp.divide_scalar(c, 2.0).to_dense().numpy(),
+            [[0, 0.5], [2.5, 0]])
+        dense = paddle.to_tensor(
+            np.arange(4, dtype="float32").reshape(2, 2))
+        np.testing.assert_allclose(
+            sp.mask_as(dense, c).to_dense().numpy(), [[0, 1], [2, 0]])
+        m2 = sp.mask_as(dense, sp.to_sparse_csr(c))
+        np.testing.assert_allclose(m2.to_dense().numpy(), [[0, 1], [2, 0]])

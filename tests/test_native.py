@@ -92,3 +92,63 @@ class TestNativeCollate:
             np.float32(std)
         ref = ref.transpose(0, 3, 1, 2)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestCkptIO:
+    """Native parallel chunk IO (_native/ckptio.cpp) + checkpoint CRC."""
+
+    def test_roundtrip_and_truncation(self, tmp_path):
+        import ctypes
+        from paddle_tpu import _native
+        lib = _native.load()
+        if lib is None:
+            pytest.skip("no native toolchain")
+        arr = np.random.RandomState(0).randn(512, 513).astype("float32")
+        p = str(tmp_path / "c.bin").encode()
+        rc = lib.pt_file_write(p, arr.ctypes.data_as(ctypes.c_void_p),
+                               arr.nbytes, 8)
+        assert rc == arr.nbytes
+        out = np.empty_like(arr)
+        rc = lib.pt_file_read(p, out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes, 8)
+        assert rc == out.nbytes
+        np.testing.assert_array_equal(arr, out)
+        # short file: loud failure, not zero-fill
+        rc = lib.pt_file_read(p, out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes * 2, 4)
+        assert rc < 0
+
+    def test_checkpoint_crc_detects_corruption(self, tmp_path):
+        import os
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        path = str(tmp_path / "ckpt")
+        big = np.random.RandomState(1).randn(512, 600).astype("float32")
+        dist.checkpoint.save_state_dict(
+            {"w": paddle.to_tensor(big)}, path)
+        # flip one byte in the chunk file
+        fname = [f for f in os.listdir(path) if f.endswith(".bin")][0]
+        fp = os.path.join(path, fname)
+        data = bytearray(open(fp, "rb").read())
+        data[100] ^= 0xFF
+        open(fp, "wb").write(bytes(data))
+        target = {"w": paddle.to_tensor(np.zeros_like(big))}
+        with pytest.raises(IOError, match="crc mismatch"):
+            dist.checkpoint.load_state_dict(target, path)
+
+    def test_new_bin_wins_over_stale_npy(self, tmp_path):
+        """Regression: saving a new checkpoint into a directory holding a
+        legacy .npy must load the fresh .bin, not the stale file."""
+        import os
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        path = str(tmp_path / "ck")
+        os.makedirs(path)
+        stale = np.zeros((4, 4), "float32")
+        np.save(os.path.join(path, "w.0_0.npy"), stale)
+        fresh = np.ones((4, 4), "float32") * 7
+        dist.checkpoint.save_state_dict({"w": paddle.to_tensor(fresh)},
+                                        path)
+        tgt = {"w": paddle.to_tensor(np.zeros_like(fresh))}
+        dist.checkpoint.load_state_dict(tgt, path)
+        np.testing.assert_allclose(tgt["w"].numpy(), fresh)

@@ -88,3 +88,16 @@ def test_inplace_rebinding_replays():
     out, = exe.run(feed={"x": np.array([10., 20.], "float32")},
                    fetch_list=[z])
     np.testing.assert_allclose(out, [6., 21.])
+
+
+def test_read_before_inplace_uses_premutation_value():
+    """Regression: an op recorded BEFORE a later in-place mutation must
+    replay against the pre-mutation value, not the final build value."""
+    t = paddle.to_tensor(np.array([1., 2.], "float32"))
+    a = t * 2.0
+    t.fill_(5.0)
+    b = t * 3.0
+    exe = paddle.static.Executor()
+    out_a, out_b = exe.run(feed={}, fetch_list=[a, b])
+    np.testing.assert_allclose(out_a, [2., 4.])
+    np.testing.assert_allclose(out_b, [15., 15.])

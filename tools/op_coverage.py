@@ -273,6 +273,9 @@ OUT_OF_SCOPE = {
     "shuffle_channel", "temporal_shift", "spectral_norm",
     "class_center_sample", "hsigmoid_loss",
     "dgc", "dgc_momentum", "dpsgd", "ftrl",
+    # sparse 3D point-cloud conv stack (GPU implicit-gemm; no TPU sparse
+    # conv path — dense conv3d covers the capability)
+    "conv3d_implicit_gemm", "maxpool", "fused_attention",
 }
 
 
