@@ -21,6 +21,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 
 def pick_config():
@@ -144,13 +145,50 @@ def child_main():
     os._exit(0)  # skip hanging plugin destructors at interpreter exit
 
 
+def probe_backend(timeout_s: int) -> Optional[str]:
+    """Fast tunnel health check: a throwaway child just initializes the
+    backend. Returns None when healthy, else an error string — so a dead
+    TPU tunnel costs ~probe-timeout per attempt instead of the full
+    measurement watchdog (the observed failure mode: jax.devices() hangs
+    indefinitely when the tunnel is down)."""
+    if os.environ.get("PADDLE_TPU_BENCH_PLATFORM"):
+        return None  # forced-platform smoke runs skip the probe
+    code = ("import jax, os, sys; d = jax.devices(); "
+            "print('PROBE_OK', d[0].platform, len(d)); "
+            "sys.stdout.flush(); os._exit(0)")  # skip plugin destructors
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        # a hung EXIT after a successful init still proves the backend
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if "PROBE_OK" in out:
+            return None
+        return f"backend probe hung >{timeout_s}s (TPU tunnel down?)"
+    if "PROBE_OK" not in proc.stdout:
+        tail = proc.stdout.strip().splitlines()[-3:]
+        return f"backend probe failed: {' | '.join(tail)[-400:]}"
+    return None
+
+
 def parent_main():
     """Run the measurement in a watchdog-guarded child; retry transient
     backend-init failures; ALWAYS print exactly one JSON line."""
     attempts = int(os.environ.get("PADDLE_TPU_BENCH_ATTEMPTS", "3"))
     timeout_s = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
+    probe_s = int(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))
     last_err = "unknown"
     for i in range(attempts):
+        perr = probe_backend(probe_s)
+        if perr is not None:
+            last_err = f"attempt {i + 1}: {perr}"
+            if i + 1 < attempts:
+                time.sleep(10 * (i + 1))
+            continue
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],

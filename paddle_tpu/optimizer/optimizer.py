@@ -115,6 +115,7 @@ class Optimizer:
         for p, g, plr in params_grads:
             ctx["weight_decay"] = wd_map.get(id(p))
             ctx["param"] = p
+            ctx["param_name"] = getattr(p, "name", "")
             state = {s: self._acc(s, p) for s in self._slots()}
             sv = {k: t._value for k, t in state.items()}
             # master weights: low-precision params update an fp32 master
@@ -227,6 +228,7 @@ class Optimizer:
                 ctx["step"] = step
                 ctx["weight_decay"] = wd
                 ctx["param"] = None
+                ctx["param_name"] = k
                 st = dict(state[k])
                 pv = st.get("master", p)
                 np_, ns = rule(pv, g, st, lr, ctx)

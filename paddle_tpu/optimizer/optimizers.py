@@ -421,3 +421,53 @@ class ASGD(Optimizer):
         state["d"] = d
         state["ys"] = g
         return p - lr / n * d, state
+
+
+class Lars(Optimizer):
+    """LARS — layer-wise adaptive rate scaling (reference:
+    paddle/phi/kernels/gpu/lars_momentum_kernel.cu; fleet meta-optimizer
+    lars_optimizer.py). Momentum with a per-parameter trust ratio
+    ||w|| / (||g|| + lambda*||w||)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _slots(self):
+        return ("velocity",)
+
+    def _context(self):
+        return {"mu": self._momentum, "coeff": self._lars_coeff,
+                "wd": self._lars_wd, "eps": self._epsilon,
+                "exclude": self._exclude}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        mu, coeff, wd, eps = (ctx["mu"], ctx["coeff"], ctx["wd"],
+                              ctx["eps"])
+        pname = (ctx.get("param_name")
+                 or getattr(ctx.get("param"), "name", "") or "")
+        if any(tok in pname for tok in ctx["exclude"]):
+            wd = 0.0
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        pn = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+        # reference kernel (lars_momentum_kernel.cc): trust ratio only when
+        # lars_weight_decay > 0 and both norms are positive; plain momentum
+        # otherwise (excluded params train at the base LR)
+        if wd > 0:
+            trust = jnp.where(
+                (pn > 0) & (gn > 0),
+                coeff * pn / (gn + wd * pn + eps), 1.0)
+        else:
+            trust = 1.0
+        v = mu * state["velocity"] + trust * lr * (g + wd * p32)
+        state["velocity"] = v
+        return p32 - v, state

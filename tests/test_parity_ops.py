@@ -303,3 +303,39 @@ class TestInplaceRandom:
         t.exponential_(lam=2.0)
         assert t.numpy().min() >= 0
         assert abs(t.numpy().mean() - 0.5) < 0.1
+
+
+class TestLars:
+    def test_trust_ratio_scales_update(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        # LARS trust ratio ~ coeff * ||w||/||g|| shrinks the step, so the
+        # base LR is large (the reference's LARS recipes use scaled LRs)
+        opt = paddle.optimizer.Lars(learning_rate=1.0, momentum=0.9,
+                                    parameters=lin.parameters())
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        losses = []
+        for _ in range(60):
+            loss = ((lin(x) - x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+        assert all(np.isfinite(losses))
+
+    def test_matches_manual_formula_one_step(self):
+        w0 = rng.randn(4, 4).astype("float32")
+        g0 = rng.randn(4, 4).astype("float32")
+        p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        p.grad = paddle.to_tensor(g0.copy())
+        opt = paddle.optimizer.Lars(learning_rate=0.1, momentum=0.0,
+                                    lars_coeff=0.001,
+                                    lars_weight_decay=0.0005,
+                                    parameters=[p])
+        opt.step()
+        pn = np.linalg.norm(w0)
+        gn = np.linalg.norm(g0)
+        trust = 0.001 * pn / (gn + 0.0005 * pn + 1e-9)
+        ref = w0 - trust * 0.1 * (g0 + 0.0005 * w0)
+        np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5, atol=1e-6)

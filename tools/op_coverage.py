@@ -29,7 +29,7 @@ ALIASES = {
     "adam_": "optimizer.Adam", "adamax_": "optimizer.Adamax",
     "adamw_": "optimizer.AdamW", "lamb_": "optimizer.Lamb",
     "momentum_": "optimizer.Momentum", "sgd_": "optimizer.SGD",
-    "rmsprop_": "optimizer.RMSProp", "lars_momentum": "optimizer.Momentum",
+    "rmsprop_": "optimizer.RMSProp", "lars_momentum": "optimizer.Lars",
     "merged_adam_": "optimizer.Adam", "merged_momentum_": "optimizer.Momentum",
     "dgc_momentum": None, "ftrl": None, "dpsgd": None, "sparse_momentum": None,
     "distributed_fused_lamb_init": "incubate.DistributedFusedLamb",
