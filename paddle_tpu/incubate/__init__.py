@@ -8,3 +8,4 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401

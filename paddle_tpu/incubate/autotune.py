@@ -1,0 +1,59 @@
+"""Runtime autotune switches (reference: python/paddle/incubate/autotune.py
+set_config — three tunables: "kernel" algorithm search, "layout"
+NCHW/NHWC flipping, "dataloader" worker-count tuning).
+
+TPU-native mapping:
+- kernel: XLA's own autotuner always runs at compile time; the switch is
+  recorded and surfaced via get_config (nothing to toggle).
+- layout: XLA chooses layouts during compilation; recorded likewise.
+- dataloader: APPLIED — when enabled, DataLoaders created with the default
+  num_workers=0 get a tuned worker count (bounded by cpu count) so host
+  input pipelines overlap device steps, the same effect the reference's
+  tuner converges to.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+_tuned_workers: Optional[int] = None
+
+
+def set_config(config=None):
+    """reference: incubate/autotune.py set_config(config=None). ``config``
+    is a dict or a path to a JSON file; None enables everything."""
+    global _tuned_workers
+    if config is None:
+        for sub in _config.values():
+            sub["enable"] = True
+    else:
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        # validate BEFORE mutating: a bad key must not leave the config
+        # half-applied
+        for key in config:
+            if key not in _config:
+                raise ValueError(f"unknown autotune section {key!r} "
+                                 f"(one of {list(_config)})")
+        for key, val in config.items():
+            _config[key].update(val)
+    if _config["dataloader"]["enable"]:
+        _tuned_workers = max(1, min(4, (os.cpu_count() or 2) // 2))
+    else:
+        _tuned_workers = None
+
+
+def get_config():
+    return json.loads(json.dumps(_config))  # deep copy
+
+
+def tuned_num_workers() -> Optional[int]:
+    """DataLoader consults this when constructed with num_workers=0."""
+    return _tuned_workers

@@ -26,6 +26,7 @@ __all__ = [
     "fused_matmul_bias", "fused_feedforward", "fused_multi_head_attention",
     "fused_bias_dropout_residual_layer_norm", "masked_multihead_attention",
     "fused_moe",
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
 ]
 
 
@@ -410,3 +411,28 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         out, _ = moe_ffn(xv, params, cfg)
         return out[0] if squeeze else out
     return apply(f, x, gw, w1, w2, name="fused_moe")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused additive-mask softmax (reference:
+    paddle/phi/kernels/fusion/gpu/fused_softmax_mask_kernel.cu;
+    incubate/nn/functional/fused_softmax_mask.py). x (B, H, S, S) scores,
+    mask (B, 1, S, S) additive (-inf style); softmax computed in fp32 —
+    XLA fuses the add into the softmax."""
+    def fn(xv, mv):
+        s32 = xv.astype(jnp.float32) + mv.astype(jnp.float32)
+        return jax.nn.softmax(s32, axis=-1).astype(xv.dtype)
+    return apply(fn, as_tensor(x), as_tensor(mask),
+                 name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle-masked) softmax (reference:
+    fused_softmax_mask_upper_triangle_kernel.cu)."""
+    def fn(xv):
+        S = xv.shape[-1]
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        s32 = jnp.where(causal, xv.astype(jnp.float32),
+                        jnp.finfo(jnp.float32).min)
+        return jax.nn.softmax(s32, axis=-1).astype(xv.dtype)
+    return apply(fn, as_tensor(x), name="softmax_mask_fuse_upper_triangle")

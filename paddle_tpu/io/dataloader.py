@@ -129,6 +129,13 @@ class DataLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.collate_fn = collate_fn
+        if num_workers == 0:
+            # incubate.autotune dataloader tuning (reference: the tuner
+            # rewrites num_workers after measuring)
+            from ..incubate.autotune import tuned_num_workers
+            tuned = tuned_num_workers()
+            if tuned:
+                num_workers = tuned
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self._is_iterable = isinstance(dataset, IterableDataset)

@@ -199,3 +199,41 @@ class TestFusedMoE:
         out = F.fused_moe(x, gw, w1, w2, moe_topk=2)
         assert out.shape == [6, H]
         assert np.isfinite(out.numpy()).all()
+
+
+class TestSoftmaxMaskFuse:
+    def test_matches_plain_softmax(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 2, 4, 4).astype("float32"),
+            stop_gradient=False)
+        mask = paddle.to_tensor(np.where(
+            np.tril(np.ones((1, 1, 4, 4))) > 0, 0, -1e30).astype("float32"))
+        fused = IF.softmax_mask_fuse(x, mask)
+        causal = IF.softmax_mask_fuse_upper_triangle(x)
+        np.testing.assert_allclose(fused.numpy(), causal.numpy(),
+                                   rtol=1e-5)
+        rows = fused.numpy().sum(-1)
+        np.testing.assert_allclose(rows, np.ones_like(rows), rtol=1e-5)
+        fused.sum().backward()
+        assert x.grad is not None
+
+
+class TestAutotune:
+    def test_set_config_applies_dataloader_workers(self):
+        from paddle_tpu.incubate import autotune
+        from paddle_tpu.io import DataLoader, TensorDataset
+        ds = TensorDataset([paddle.to_tensor(
+            np.arange(32, dtype="float32").reshape(16, 2))])
+        try:
+            autotune.set_config({"dataloader": {"enable": True}})
+            dl = DataLoader(ds, batch_size=4)
+            assert dl.num_workers >= 1
+            assert len([b for b in dl]) == 4
+            assert autotune.get_config()["dataloader"]["enable"]
+            with pytest.raises(ValueError):
+                autotune.set_config({"nope": {}})
+        finally:
+            autotune.set_config({"dataloader": {"enable": False}})
+        assert DataLoader(ds, batch_size=4).num_workers == 0
