@@ -4,4 +4,5 @@ from .optimizers import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
     NAdam, RAdam, Rprop, ASGD, Lars,
 )
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401

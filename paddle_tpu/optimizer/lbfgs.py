@@ -1,0 +1,240 @@
+"""L-BFGS with strong-Wolfe line search.
+
+reference: python/paddle/optimizer/lbfgs.py (closure-based step, history of
+(s, y) pairs, two-loop recursion, _strong_wolfe line search with cubic
+interpolation). Host-driven by design: L-BFGS is a full-batch method whose
+control flow (bracketing, zoom) is data-dependent — each closure call is
+one compiled forward/backward; the direction/line-search logic runs on
+host scalars, which on TPU costs a few scalar transfers per iteration.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+from .._core.tensor import Tensor
+
+
+def _gather_flat(ts):
+    return jnp.concatenate([
+        jnp.ravel(t._value).astype(jnp.float32) for t in ts])
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Cubic minimizer of a 1-D function from two (x, f, f') samples
+    (reference: lbfgs.py _cubic_interpolate)."""
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    sq = d1 ** 2 - g1 * g2
+    if sq >= 0:
+        d2 = np.sqrt(sq)
+        if x1 <= x2:
+            x = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            x = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(x, lo), hi)
+    return (lo + hi) / 2.0
+
+
+def _strong_wolfe(obj, t, d_norm, f0, g0, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    """Find step t satisfying strong Wolfe conditions.
+    obj(t) -> (f, directional derivative). reference: lbfgs.py
+    _strong_wolfe."""
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    f_new, g_new = obj(t)
+    ls_iter = 1
+    # bracket phase
+    bracket = None
+    while ls_iter < max_ls:
+        if f_new > f0 + c1 * t * g0 or (ls_iter > 1 and f_new >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, t, f_new, g_new)
+            break
+        if abs(g_new) <= -c2 * g0:
+            return t, f_new, ls_iter
+        if g_new >= 0:
+            bracket = (t, f_new, g_new, t_prev, f_prev, g_prev)
+            break
+        t_next = _cubic_interpolate(t_prev, f_prev, g_prev, t, f_new, g_new,
+                                    bounds=(t + 0.01 * (t - t_prev),
+                                            t * 10))
+        t_prev, f_prev, g_prev = t, f_new, g_new
+        t = t_next
+        f_new, g_new = obj(t)
+        ls_iter += 1
+    if bracket is None:
+        return t, f_new, ls_iter
+    # zoom phase
+    lo_t, lo_f, lo_g, hi_t, hi_f, hi_g = bracket
+    while ls_iter < max_ls:
+        if abs(hi_t - lo_t) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(lo_t, lo_f, lo_g, hi_t, hi_f, hi_g)
+        f_new, g_new = obj(t)
+        ls_iter += 1
+        if f_new > f0 + c1 * t * g0 or f_new >= lo_f:
+            hi_t, hi_f, hi_g = t, f_new, g_new
+        else:
+            if abs(g_new) <= -c2 * g0:
+                return t, f_new, ls_iter
+            if g_new * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+            lo_t, lo_f, lo_g = t, f_new, g_new
+    return lo_t, lo_f, ls_iter
+
+
+class LBFGS(Optimizer):
+    """reference: python/paddle/optimizer/lbfgs.py LBFGS — closure-based
+    quasi-Newton. ``step(closure)``: closure clears grads, computes the
+    loss, runs backward, returns the loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist: List = []
+        self._y_hist: List = []
+        self._rho: List = []
+        self._H_diag = 1.0
+        self._first_iter = True
+
+    # ---- flat-vector <-> params ----
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _set_flat(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.ndim else 1
+            val = flat[off:off + n].reshape(tuple(p.shape)).astype(
+                jnp.result_type(p._value))
+            p._inplace_assign(val)
+            off += n
+
+    def _flat_grad(self):
+        outs = []
+        for p in self._params():
+            g = p.grad
+            gv = jnp.zeros(tuple(p.shape), jnp.float32) if g is None \
+                else g._value.astype(jnp.float32)
+            outs.append(jnp.ravel(gv))
+        return jnp.concatenate(outs)
+
+    @staticmethod
+    def _loss_float(loss):
+        return float(np.asarray(
+            loss._value if isinstance(loss, Tensor) else loss))
+
+    def step(self, closure: Optional[Callable] = None):
+        if closure is None:
+            raise ValueError("LBFGS.step needs a closure that recomputes "
+                             "the loss and its gradients")
+        loss = closure()
+        loss_val = self._loss_float(loss)
+        flat_grad = self._flat_grad()
+        if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+            return loss
+        n_evals = 1
+        lr = self.get_lr()
+
+        for _ in range(self.max_iter):
+            # ---- direction: two-loop recursion over history ----
+            q = -flat_grad
+            alphas = []
+            for s, y, rho in zip(reversed(self._s_hist),
+                                 reversed(self._y_hist),
+                                 reversed(self._rho)):
+                a = rho * float(jnp.dot(s, q))
+                alphas.append(a)
+                q = q - a * y
+            d = q * self._H_diag
+            for (s, y, rho), a in zip(zip(self._s_hist, self._y_hist,
+                                          self._rho), reversed(alphas)):
+                b = rho * float(jnp.dot(y, d))
+                d = d + (a - b) * s
+
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self.tolerance_change:
+                break  # not a descent direction; history is stale
+            x0 = _gather_flat(self._params())
+            # reference: the gradient-scaled guess applies on the FIRST
+            # iteration only; later iterations (with or without curvature
+            # pairs) start from lr
+            t = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr \
+                if self._first_iter else lr
+            self._first_iter = False
+
+            if self.line_search_fn == "strong_wolfe":
+                # cache the last evaluation so the accepted step's
+                # loss/grad are reused instead of re-running the closure
+                cache = {}
+
+                def obj(step_size):
+                    self._set_flat(x0 + step_size * d)
+                    ls_loss = closure()
+                    lf = self._loss_float(ls_loss)
+                    fg = self._flat_grad()
+                    cache["t"], cache["loss"] = step_size, ls_loss
+                    cache["flat_grad"] = fg
+                    return lf, float(jnp.dot(fg, d))
+                d_norm = float(jnp.abs(d).max())
+                t, loss_val, ls_evals = _strong_wolfe(
+                    obj, t, d_norm, loss_val, gtd,
+                    tolerance_change=self.tolerance_change)
+                n_evals += ls_evals
+                if cache.get("t") == t:
+                    self._set_flat(x0 + t * d)
+                    loss = cache["loss"]
+                    new_flat_grad = cache["flat_grad"]
+                else:
+                    self._set_flat(x0 + t * d)
+                    loss = closure()
+                    loss_val = self._loss_float(loss)
+                    new_flat_grad = self._flat_grad()
+                    n_evals += 1
+            else:
+                self._set_flat(x0 + t * d)
+                loss = closure()
+                loss_val = self._loss_float(loss)
+                new_flat_grad = self._flat_grad()
+                n_evals += 1
+
+            # ---- curvature update ----
+            s = t * d
+            y = new_flat_grad - flat_grad
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(self._s_hist) >= self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+                    self._rho.pop(0)
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                self._rho.append(1.0 / ys)
+                self._H_diag = ys / float(jnp.dot(y, y))
+            flat_grad = new_flat_grad
+
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            if float(jnp.abs(s).max()) <= self.tolerance_change:
+                break
+            if n_evals >= self.max_eval:
+                break
+        self._global_step += 1
+        return loss

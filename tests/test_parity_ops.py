@@ -339,3 +339,65 @@ class TestLars:
         trust = 0.001 * pn / (gn + 0.0005 * pn + 1e-9)
         ref = w0 - trust * 0.1 * (g0 + 0.0005 * w0)
         np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+class TestLBFGS:
+    def _quadratic(self, line_search):
+        paddle.seed(0)
+        A = rng.randn(6, 6).astype("float32")
+        A = A @ A.T + 6 * np.eye(6, dtype="float32")  # SPD
+        b = rng.randn(6).astype("float32")
+        x = paddle.to_tensor(np.zeros(6, "float32"), stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(
+            learning_rate=1.0, max_iter=50,
+            line_search_fn=line_search, parameters=[x])
+
+        def closure():
+            opt.clear_grad()
+            loss = 0.5 * (x @ paddle.to_tensor(A) @ x) - \
+                paddle.to_tensor(b) @ x
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        ref = np.linalg.solve(A, b)
+        np.testing.assert_allclose(x.numpy(), ref, rtol=1e-3, atol=1e-3)
+
+    def test_quadratic_exact_strong_wolfe(self):
+        self._quadratic("strong_wolfe")
+
+    def test_quadratic_no_line_search(self):
+        self._quadratic(None)
+
+    def test_matches_torch_on_least_squares(self):
+        import torch
+        X = rng.randn(20, 5).astype("float32")
+        y = rng.randn(20, 1).astype("float32")
+        w = paddle.to_tensor(np.zeros((5, 1), "float32"),
+                             stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=10,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[w])
+
+        def closure():
+            opt.clear_grad()
+            loss = ((paddle.to_tensor(X) @ w - paddle.to_tensor(y)) ** 2
+                    ).mean()
+            loss.backward()
+            return loss
+
+        tw = torch.zeros((5, 1), requires_grad=True)
+        topt = torch.optim.LBFGS([tw], lr=1.0, max_iter=10,
+                                 line_search_fn="strong_wolfe")
+
+        def tclosure():
+            topt.zero_grad()
+            tl = ((torch.tensor(X) @ tw - torch.tensor(y)) ** 2).mean()
+            tl.backward()
+            return tl
+
+        for _ in range(3):
+            opt.step(closure)
+            topt.step(tclosure)
+        np.testing.assert_allclose(w.numpy(), tw.detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
