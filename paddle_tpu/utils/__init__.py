@@ -67,3 +67,4 @@ def run_check():
     dev = jax.devices()[0]
     print(f"paddle_tpu is installed successfully! device={dev.platform} "
           f"({getattr(dev, 'device_kind', '?')})")
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,195 @@
+"""Custom C++ op extensions.
+
+reference: python/paddle/utils/cpp_extension/ (CppExtension/CUDAExtension/
+load/setup JIT-building user .cc/.cu into loadable op libraries;
+paddle/fluid/framework/custom_operator.cc registers them).
+
+TPU-native split of the capability:
+- DEVICE custom kernels are written in Pallas (`paddle_tpu.ops.pallas`) —
+  that is the TPU analog of a user .cu kernel and needs no build system.
+- HOST custom ops (pre/post-processing, CPU-bound logic, third-party C++
+  libraries) are what this module builds: g++ compiles user sources into a
+  shared library; ops are exposed through a simple C ABI and run eagerly
+  via ctypes or inside ``jit`` through ``jax.pure_callback`` (XLA calls
+  back to host — the reference's host kernel path). Gradients: provide a
+  ``grad_symbol`` and the op becomes a ``jax.custom_vjp``.
+
+C ABI (float32, row-major, contiguous):
+  forward:  void NAME(const float* in0, ..., float* out, long long n);
+  backward: void GRAD(const float* in0, ..., const float* grad_out,
+                      float* grad_in0, long long n);   # unary ops only
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from ..ops._registry import as_tensor, raw
+
+
+class CppExtension:
+    """reference: cpp_extension.CppExtension — a named source bundle."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args=None, **kw):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+# CUDA does not exist here; kept so reference setup scripts import cleanly,
+# pointing users at Pallas for device kernels.
+def CUDAExtension(*a, **k):
+    raise RuntimeError(
+        "CUDAExtension has no TPU analog — write device kernels in Pallas "
+        "(paddle_tpu.ops.pallas) and host ops via CppExtension/load")
+
+
+def _build(name: str, sources: List[str], extra_cflags, build_directory,
+           verbose: bool) -> str:
+    bdir = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(bdir, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cflags or []).encode())
+    out = os.path.join(bdir, f"{name}_{h.hexdigest()[:16]}.so")
+    if not os.path.exists(out):
+        # unique tmp: concurrent builders (pytest-xdist, multi-process
+        # launch) must not race each other's g++ output
+        tmp = f"{out}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               *(extra_cflags or []), *sources, "-o", tmp]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                f"building extension {name!r} failed: g++ not found "
+                f"({e})") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"building extension {name!r} failed:\n{proc.stderr}")
+        os.replace(tmp, out)
+    return out
+
+
+class ExtensionModule:
+    """A loaded custom-op library; ``custom_op`` wraps C symbols into
+    framework ops."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self._lib = ctypes.CDLL(path)
+
+    def _sym(self, symbol: str, n_ptr: int):
+        fn = getattr(self._lib, symbol)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p] * n_ptr + [ctypes.c_longlong]
+        return fn
+
+    def custom_op(self, symbol: str, num_inputs: int = 1,
+                  grad_symbol: Optional[str] = None):
+        """Wrap C symbol into an op usable eagerly and under jit.
+        Output shape/dtype = first input's (elementwise ABI). Gradients
+        need ``grad_symbol`` (unary ops)."""
+        fwd_fn = self._sym(symbol, num_inputs + 1)
+        if grad_symbol is not None and num_inputs != 1:
+            raise ValueError("grad_symbol is supported for unary ops")
+        bwd_fn = self._sym(grad_symbol, 3) if grad_symbol else None
+
+        def host_fwd(*arrays):
+            arrs = [np.ascontiguousarray(np.asarray(a), np.float32)
+                    for a in arrays]
+            for i, a in enumerate(arrs[1:], 1):
+                if a.shape != arrs[0].shape:
+                    raise ValueError(
+                        f"{symbol}: input {i} shape {a.shape} != input 0 "
+                        f"shape {arrs[0].shape} (elementwise C ABI)")
+            out = np.empty_like(arrs[0])
+            fwd_fn(*[a.ctypes.data_as(ctypes.c_void_p) for a in arrs],
+                   out.ctypes.data_as(ctypes.c_void_p), out.size)
+            return out
+
+        def host_bwd(x, gy):
+            xa = np.ascontiguousarray(np.asarray(x), np.float32)
+            ga = np.ascontiguousarray(np.asarray(gy), np.float32)
+            gx = np.empty_like(xa)
+            bwd_fn(xa.ctypes.data_as(ctypes.c_void_p),
+                   ga.ctypes.data_as(ctypes.c_void_p),
+                   gx.ctypes.data_as(ctypes.c_void_p), gx.size)
+            return gx
+
+        def call_fwd(*raws):
+            if not any(isinstance(r, jax.core.Tracer) for r in raws):
+                # eager: straight ctypes on host buffers, no callback
+                # round-trip (docstring contract)
+                return jnp.asarray(host_fwd(*raws))
+            spec = jax.ShapeDtypeStruct(raws[0].shape, jnp.float32)
+            return jax.pure_callback(host_fwd, spec, *raws,
+                                     vmap_method="sequential")
+
+        if bwd_fn is not None:
+            @jax.custom_vjp
+            def op_val(x):
+                return call_fwd(x)
+
+            def op_val_fwd(x):
+                return call_fwd(x), x
+
+            def op_val_bwd(x, gy):
+                spec = jax.ShapeDtypeStruct(x.shape, jnp.float32)
+                return (jax.pure_callback(host_bwd, spec, x, gy,
+                                          vmap_method="sequential"),)
+            op_val.defvjp(op_val_fwd, op_val_bwd)
+
+            def op(x, name=None):
+                # route through apply so .backward() sees the custom vjp
+                from .._core.autograd import apply
+                return apply(op_val, as_tensor(x), name=symbol)
+        else:
+            def op(*tensors, name=None):
+                raws = [raw(as_tensor(t)) for t in tensors]
+                out = call_fwd(*raws)
+                t = Tensor(out, _internal=True)
+                t.stop_gradient = True  # no grad_symbol -> non-differentiable
+                return t
+        op.__name__ = symbol
+        return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
+         extra_cflags=None, build_directory: Optional[str] = None,
+         verbose: bool = False, **kw) -> ExtensionModule:
+    """reference: cpp_extension.load — JIT-build + load a custom-op
+    library."""
+    flags = list(extra_cxx_flags or extra_cflags or [])
+    path = _build(name, list(sources), flags, build_directory, verbose)
+    return ExtensionModule(name, path)
+
+
+def setup(name: Optional[str] = None, ext_modules=None, **kw):
+    """reference: cpp_extension.setup — build the extensions in place and
+    return the loaded modules (the reference installs an importable
+    package; here the returned ExtensionModules are the artifact)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    out = []
+    for ext in exts:
+        if ext is None:
+            continue
+        out.append(load(ext.name or name or "custom_ext", ext.sources,
+                        extra_cxx_flags=ext.extra_compile_args))
+    return out[0] if len(out) == 1 else out
