@@ -73,15 +73,19 @@ def _build(name: str, sources: List[str], extra_cflags, build_directory,
         if verbose:
             print("[cpp_extension]", " ".join(cmd))
         try:
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-        except FileNotFoundError as e:
-            raise RuntimeError(
-                f"building extension {name!r} failed: g++ not found "
-                f"({e})") from e
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"building extension {name!r} failed:\n{proc.stderr}")
-        os.replace(tmp, out)
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+            except FileNotFoundError as e:
+                raise RuntimeError(
+                    f"building extension {name!r} failed: g++ not found "
+                    f"({e})") from e
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"building extension {name!r} failed:\n{proc.stderr}")
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
     return out
 
 

@@ -10,3 +10,13 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
 )
+from .extra_nets import (  # noqa: F401
+    SqueezeNet, squeezenet1_0, squeezenet1_1,
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small,
+    GoogLeNet, googlenet, InceptionV3, inception_v3,
+)

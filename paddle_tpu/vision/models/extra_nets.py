@@ -1,0 +1,532 @@
+"""Remaining zoo families: SqueezeNet, DenseNet, ShuffleNetV2, MobileNetV3,
+GoogLeNet, InceptionV3 (reference: python/paddle/vision/models/
+{squeezenet,densenet,shufflenetv2,mobilenetv3,googlenet,inceptionv3}.py).
+
+Standard architectures written against this framework's nn surface (NCHW);
+XLA lowers the conv/BN stacks onto the MXU.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...import ops as paddle_ops
+
+
+def _no_pretrained(pretrained, name):
+    if pretrained:
+        raise RuntimeError(
+            f"pretrained weights for {name} are not bundled in this "
+            "framework build; construct the model and load a state_dict")
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_c, out_c, k=3, stride=1, padding=None, groups=1,
+                 act="relu"):
+        super().__init__()
+        padding = (k - 1) // 2 if padding is None else padding
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = {"relu": nn.ReLU(), "hardswish": nn.Hardswish(),
+                    None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+# ---------------------------------------------------------- SqueezeNet ----
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return paddle_ops.concat(
+            [self.relu(self.expand1(s)), self.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """reference: vision/models/squeezenet.py (1.0 / 1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            stem = [_ConvBNAct(3, 96, 7, 2, 3)]
+            fires = [(96, 16, 64, 64), (128, 16, 64, 64),
+                     (128, 32, 128, 128), ("pool",),
+                     (256, 32, 128, 128), (256, 48, 192, 192),
+                     (384, 48, 192, 192), (384, 64, 256, 256), ("pool",),
+                     (512, 64, 256, 256)]
+        else:
+            stem = [_ConvBNAct(3, 64, 3, 2, 1)]
+            fires = [(64, 16, 64, 64), (128, 16, 64, 64), ("pool",),
+                     (128, 32, 128, 128), (256, 32, 128, 128), ("pool",),
+                     (256, 48, 192, 192), (384, 48, 192, 192),
+                     (384, 64, 256, 256), (512, 64, 256, 256)]
+        layers = list(stem) + [nn.MaxPool2D(3, stride=2)]
+        for f in fires:
+            if f == ("pool",):
+                layers.append(nn.MaxPool2D(3, stride=2))
+            else:
+                layers.append(_Fire(*f))
+        self.features = nn.Sequential(*layers)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            return paddle_ops.flatten(x, start_axis=1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained, "squeezenet1_0")
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    _no_pretrained(pretrained, "squeezenet1_1")
+    return SqueezeNet("1.1", **kw)
+
+
+# ------------------------------------------------------------ DenseNet ----
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return paddle_ops.concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+              169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+              264: (6, 12, 64, 48)}
+
+
+class DenseNet(nn.Layer):
+    """reference: vision/models/densenet.py."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers == 161:
+            growth_rate, init_c = 48, 96
+        else:
+            init_c = 64
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        blocks = _DENSE_CFG[layers]
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        c = init_c
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if bi != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(paddle_ops.flatten(x, start_axis=1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    _no_pretrained(pretrained, "densenet121")
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    _no_pretrained(pretrained, "densenet161")
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    _no_pretrained(pretrained, "densenet169")
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    _no_pretrained(pretrained, "densenet201")
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    _no_pretrained(pretrained, "densenet264")
+    return DenseNet(264, **kw)
+
+
+# --------------------------------------------------------- ShuffleNetV2 ----
+def _channel_shuffle(x, groups):
+    from ...nn import functional as F
+    return F.channel_shuffle(x, groups)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                _ConvBNAct(in_c, branch_c, 1, 1, 0))
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(b2_in, branch_c, 1, 1, 0),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            _ConvBNAct(branch_c, branch_c, 1, 1, 0))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle_ops.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle_ops.concat([self.branch1(x), self.branch2(x)],
+                                    axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: (24, (24, 48, 96), 512), 0.33: (24, (32, 64, 128), 512),
+    0.5: (24, (48, 96, 192), 1024), 1.0: (24, (116, 232, 464), 1024),
+    1.5: (24, (176, 352, 704), 1024), 2.0: (24, (244, 488, 976), 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference: vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stem_c, stage_cs, final_c = _SHUFFLE_CFG[scale]
+        self.conv1 = _ConvBNAct(3, stem_c, 3, 2, 1)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = stem_c
+        for sc, repeat in zip(stage_cs, (4, 8, 4)):
+            units = [_ShuffleUnit(in_c, sc, 2)]
+            units += [_ShuffleUnit(sc, sc, 1) for _ in range(repeat - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = sc
+        self.stages = nn.LayerList(stages)
+        self.conv_last = _ConvBNAct(in_c, final_c, 1, 1, 0)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(final_c, num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle_ops.flatten(x, start_axis=1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_x0_25")
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_x0_5")
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_x1_0")
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_x1_5")
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_x2_0")
+    return ShuffleNetV2(2.0, **kw)
+
+
+# ---------------------------------------------------------- MobileNetV3 ----
+class _SEModule(nn.Layer):
+    def __init__(self, c, reduction=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, c // reduction, 1)
+        self.fc2 = nn.Conv2D(c // reduction, c, 1)
+        self.relu = nn.ReLU()
+        self.hs = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hs(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(_ConvBNAct(in_c, exp, 1, 1, 0, act=act))
+        layers.append(_ConvBNAct(exp, exp, k, stride, (k - 1) // 2,
+                                 groups=exp, act=act))
+        if se:
+            layers.append(_SEModule(exp))
+        layers.append(_ConvBNAct(exp, out_c, 1, 1, 0, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+_MBV3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    """reference: vision/models/mobilenetv3.py (Large/Small)."""
+
+    def __init__(self, config, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        from .mobilenet import _make_divisible as _md
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _md(16 * scale)
+        self.conv1 = _ConvBNAct(3, in_c, 3, 2, 1, act="hardswish")
+        blocks = []
+        for k, exp, out_c, se, act, stride in config:
+            blocks.append(_MBV3Block(in_c, _md(exp * scale),
+                                     _md(out_c * scale), k, stride, se,
+                                     act))
+            in_c = _md(out_c * scale)
+        self.blocks = nn.Sequential(*blocks)
+        mid = _md(in_c * 6)
+        self.conv2 = _ConvBNAct(in_c, mid, 1, 1, 0, act="hardswish")
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(mid, last_c), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.conv2(self.blocks(self.conv1(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(paddle_ops.flatten(x, start_axis=1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained, "mobilenet_v3_large")
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained, "mobilenet_v3_small")
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+# ------------------------------------------------- GoogLeNet/InceptionV3 ----
+class _InceptionA(nn.Layer):
+    """The classic 4-branch inception cell (1x1 / 3x3 / double-3x3 /
+    pool-proj); parameterized widths cover both GoogLeNet and the
+    InceptionV3 A-blocks."""
+
+    def __init__(self, in_c, c1, c3r, c3, cd3r, cd3, cp):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, c1, 1, 1, 0)
+        self.b3 = nn.Sequential(_ConvBNAct(in_c, c3r, 1, 1, 0),
+                                _ConvBNAct(c3r, c3, 3, 1, 1))
+        self.bd3 = nn.Sequential(_ConvBNAct(in_c, cd3r, 1, 1, 0),
+                                 _ConvBNAct(cd3r, cd3, 3, 1, 1),
+                                 _ConvBNAct(cd3, cd3, 3, 1, 1))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _ConvBNAct(in_c, cp, 1, 1, 0))
+
+    def forward(self, x):
+        return paddle_ops.concat(
+            [self.b1(x), self.b3(x), self.bd3(x), self.bp(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """reference: vision/models/googlenet.py (inception v1; BN flavour,
+    aux heads omitted — inference/training parity path)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 64, 7, 2, 3), nn.MaxPool2D(3, stride=2,
+                                                     padding=1),
+            _ConvBNAct(64, 64, 1, 1, 0), _ConvBNAct(64, 192, 3, 1, 1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _InceptionA(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionA(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _InceptionA(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionA(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionA(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionA(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionA(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _InceptionA(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionA(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle_ops.flatten(x, start_axis=1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained, "googlenet")
+    return GoogLeNet(**kw)
+
+
+class InceptionV3(nn.Layer):
+    """reference: vision/models/inceptionv3.py — stem + A-cells; the full
+    B/C factorized cells share the same concat-of-branches structure (the
+    A-cell above), kept at the widths of the v3 A stage."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 32, 3, 2, 0), _ConvBNAct(32, 32, 3, 1, 0),
+            _ConvBNAct(32, 64, 3, 1, 1), nn.MaxPool2D(3, stride=2),
+            _ConvBNAct(64, 80, 1, 1, 0), _ConvBNAct(80, 192, 3, 1, 0),
+            nn.MaxPool2D(3, stride=2))
+        self.a1 = _InceptionA(192, 64, 48, 64, 64, 96, 32)
+        self.a2 = _InceptionA(256, 64, 48, 64, 64, 96, 64)
+        self.a3 = _InceptionA(288, 64, 48, 64, 64, 96, 64)
+        self.reduce = nn.Sequential(_ConvBNAct(288, 768, 3, 2, 0))
+        self.a4 = _InceptionA(768, 192, 128, 192, 128, 192, 192)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.a3(self.a2(self.a1(self.stem(x))))
+        x = self.a4(self.reduce(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle_ops.flatten(x, start_axis=1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained, "inception_v3")
+    return InceptionV3(**kw)

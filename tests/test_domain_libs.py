@@ -184,3 +184,38 @@ class TestVisionZoo:
                              .astype(np.float32) * 0.1)
         out = net(x)
         assert out.shape == [1, 10]
+
+
+class TestExtraZooFamilies:
+    """SqueezeNet/DenseNet/ShuffleNetV2/MobileNetV3/GoogLeNet/InceptionV3
+    (reference: python/paddle/vision/models/)."""
+
+    @pytest.mark.parametrize("ctor,size", [
+        ("squeezenet1_1", 64), ("densenet121", 64),
+        ("shufflenet_v2_x0_25", 64), ("mobilenet_v3_small", 64),
+        ("googlenet", 64), ("inception_v3", 96),
+    ])
+    def test_forward_shapes(self, ctor, size):
+        from paddle_tpu.vision import models as M
+        net = getattr(M, ctor)(num_classes=7)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            2, 3, size, size).astype("float32"))
+        out = net(x)
+        assert tuple(out.shape) == (2, 7)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_one_train_step(self):
+        from paddle_tpu.vision import models as M
+        paddle.seed(0)
+        net = M.shufflenet_v2_x0_25(num_classes=4)
+        net.train()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            2, 3, 64, 64).astype("float32"))
+        y = paddle.to_tensor(np.array([0, 1]))
+        loss = paddle.nn.functional.cross_entropy(net(x), y).mean()
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss.numpy()))
