@@ -1,3 +1,5 @@
 """reference: python/paddle/incubate/optimizer/ — DistributedFusedLamb
 (distributed_fused_lamb.py), LookAhead, ModelAverage."""
 from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401
+from .modelaverage import ModelAverage  # noqa: F401
+from .lookahead import LookAhead  # noqa: F401

@@ -1,0 +1,86 @@
+"""LookAhead optimizer wrapper (reference: python/paddle/incubate/
+optimizer/lookahead.py — Zhang et al. 2019: k fast steps with an inner
+optimizer, then slow weights interpolate toward the fast weights)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """Wraps an inner optimizer; every ``k`` steps the slow copy moves
+    ``alpha`` of the way to the fast weights and the fast weights reset to
+    the slow copy."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        # slow weights start at the initial parameter values
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): p._value.astype(jnp.float32) for p in self._params()}
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._parameter_list
+                if not p.stop_gradient]
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        masters = self.inner_optimizer._accumulators.get("master", {})
+        for p in self._params():
+            slow = self._slow.get(id(p))
+            if slow is None:
+                # param unfrozen after construction: joins the slow
+                # trajectory from its current value
+                slow = p._value.astype(jnp.float32)
+            slow = slow + self.alpha * (
+                p._value.astype(jnp.float32) - slow)
+            self._slow[id(p)] = slow
+            p._inplace_assign(slow.astype(p._value.dtype))
+            # low-precision params: the inner optimizer's fp32 master is
+            # its source of truth — sync it or the next step undoes us
+            m = masters.get(id(p))
+            if m is not None:
+                m._inplace_assign(slow)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._step_count
+        names = self.inner_optimizer._param_names()
+        sd["@lookahead_slow"] = {
+            names.get(pid, str(pid)): np.asarray(v)
+            for pid, v in self._slow.items()}
+        return sd
+
+    def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)  # never mutate the caller's dict
+        self._step_count = int(state_dict.pop("@lookahead_step", 0))
+        slow = state_dict.pop("@lookahead_slow", None)
+        if slow is not None:
+            by_name = {getattr(p, "name", None): p for p in self._params()}
+            for name, v in slow.items():
+                p = by_name.get(name)
+                if p is not None:
+                    self._slow[id(p)] = jnp.asarray(np.asarray(v),
+                                                    jnp.float32)
+        self.inner_optimizer.set_state_dict(state_dict)

@@ -1,0 +1,123 @@
+"""ModelAverage (reference: python/paddle/incubate/optimizer/
+modelaverage.py:42 + average_accumulates_ kernel): sliding-window average
+of parameters applied at evaluation time.
+
+Window semantics (reference docstring): accumulation restarts when
+  num_accumulates >= min_average_window and
+  num_accumulates >= min(max_average_window,
+                         num_updates * average_window_rate)
+The rotated window (sum_2/old_num) keeps the previous window's sums so the
+applied average always spans at least min_average_window samples.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        if parameters is None:
+            raise ValueError(
+                "ModelAverage needs explicit parameters (there is no "
+                "global program to collect them from): pass "
+                "parameters=model.parameters()")
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self._sum_1: Dict[int, jnp.ndarray] = {
+            id(p): jnp.zeros(tuple(p.shape), jnp.float32)
+            for p in self._params}
+        self._sum_2 = {id(p): jnp.zeros(tuple(p.shape), jnp.float32)
+                       for p in self._params}
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._backup = None
+
+    # ---- training-side accumulation ----
+    def step(self):
+        """Accumulate the current parameter values (call after the real
+        optimizer's step; reference: average_accumulates_ op)."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for p in self._params:
+            self._sum_1[id(p)] = self._sum_1[id(p)] + \
+                p._value.astype(jnp.float32)
+        window = min(self.max_average_window,
+                     self._num_updates * self.average_window_rate)
+        if self._num_accumulates >= self.min_average_window and \
+                self._num_accumulates >= window:
+            # rotate: the finished window becomes the 'old' window
+            self._sum_2 = dict(self._sum_1)
+            self._old_num_accumulates = self._num_accumulates
+            self._sum_1 = {k: jnp.zeros_like(v)
+                           for k, v in self._sum_1.items()}
+            self._num_accumulates = 0
+
+    def minimize(self, *a, **k):
+        self.step()
+
+    def clear_grad(self):
+        pass
+
+    # ---- evaluation-side swap ----
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap parameters to their windowed average (reference:
+        ModelAverage.apply)."""
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
+            yield
+            return
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            avg = (self._sum_1[id(p)] + self._sum_2[id(p)]) / total
+            p._inplace_assign(avg.astype(self._backup[id(p)].dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        """reference: ModelAverage.restore."""
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._inplace_assign(self._backup[id(p)])
+        self._backup = None
+
+    # ---- checkpoint state (reference persists the sums/counters as
+    # optimizer accumulators) ----
+    def state_dict(self):
+        names = {id(p): getattr(p, "name", str(i))
+                 for i, p in enumerate(self._params)}
+        return {
+            "sum_1": {names[k]: np.asarray(v)
+                      for k, v in self._sum_1.items()},
+            "sum_2": {names[k]: np.asarray(v)
+                      for k, v in self._sum_2.items()},
+            "num_accumulates": self._num_accumulates,
+            "old_num_accumulates": self._old_num_accumulates,
+            "num_updates": self._num_updates,
+        }
+
+    def set_state_dict(self, sd):
+        by_name = {getattr(p, "name", str(i)): p
+                   for i, p in enumerate(self._params)}
+        for attr, key in (("_sum_1", "sum_1"), ("_sum_2", "sum_2")):
+            store = getattr(self, attr)
+            for name, v in sd.get(key, {}).items():
+                p = by_name.get(name)
+                if p is not None:
+                    store[id(p)] = jnp.asarray(np.asarray(v), jnp.float32)
+        self._num_accumulates = int(sd.get("num_accumulates", 0))
+        self._old_num_accumulates = int(sd.get("old_num_accumulates", 0))
+        self._num_updates = int(sd.get("num_updates", 0))

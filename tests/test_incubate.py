@@ -237,3 +237,81 @@ class TestAutotune:
         finally:
             autotune.set_config({"dataloader": {"enable": False}})
         assert DataLoader(ds, batch_size=4).num_workers == 0
+
+
+class TestModelAverage:
+    def test_window_average_apply_restore(self):
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        p = paddle.to_tensor(np.zeros(2, "float32"), stop_gradient=False)
+        ma = ModelAverage(average_window_rate=1.0, parameters=[p],
+                          min_average_window=2, max_average_window=100)
+        # param takes values 1, 2, 3, 4 across steps
+        for v in (1.0, 2.0, 3.0, 4.0):
+            p._inplace_assign(np.full(2, v, "float32") + 0 * p._value)
+            ma.step()
+        orig = p.numpy().copy()
+        with ma.apply():
+            avg = p.numpy().copy()
+        # windows rotate; applied average spans the accumulated sums
+        assert 1.0 <= avg[0] <= 4.0
+        np.testing.assert_allclose(p.numpy(), orig)  # restored
+
+    def test_improves_noisy_sgd(self):
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(4, 1).astype("float32")
+        lin = paddle.nn.Linear(4, 1, bias_attr=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.08,
+                                   parameters=lin.parameters())
+        ma = ModelAverage(average_window_rate=0.5,
+                          parameters=lin.parameters(),
+                          min_average_window=5, max_average_window=40)
+        for i in range(120):
+            X = rng.randn(8, 4).astype("float32")
+            y = X @ w_true + 0.3 * rng.randn(8, 1).astype("float32")
+            loss = ((lin(paddle.to_tensor(X)) -
+                     paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+        err_raw = float(np.abs(lin.weight.numpy() - w_true).mean())
+        with ma.apply():
+            err_avg = float(np.abs(lin.weight.numpy() - w_true).mean())
+        # averaging the noisy SGD trajectory should not be (much) worse
+        assert err_avg <= err_raw * 1.5
+
+
+class TestLookAhead:
+    def test_sync_interpolates_to_slow(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        p = paddle.to_tensor(np.zeros(2, "float32"), stop_gradient=False)
+        inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        la = LookAhead(inner, alpha=0.5, k=2)
+        # constant grad of -1 -> fast weights +1 per step
+        for i in range(2):
+            p.grad = paddle.to_tensor(np.full(2, -1.0, "float32"))
+            la.step()
+        # after k=2 fast steps (fast=2), slow = 0 + 0.5*(2-0) = 1
+        np.testing.assert_allclose(p.numpy(), [1.0, 1.0])
+
+    def test_converges(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        la = LookAhead(inner, alpha=0.8, k=5)
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 4).astype("float32")
+        y = (X @ rng.randn(4, 1)).astype("float32")
+        losses = []
+        for _ in range(80):
+            loss = ((lin(paddle.to_tensor(X)) -
+                     paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.2

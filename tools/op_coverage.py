@@ -229,7 +229,8 @@ ALIASES = {
     "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
     "asgd_": "optimizer.ASGD", "nadam_": "optimizer.NAdam",
     "radam_": "optimizer.RAdam", "rprop_": "optimizer.Rprop",
-    "decayed_adagrad": "optimizer.Adagrad", "average_accumulates_": None,
+    "decayed_adagrad": "optimizer.Adagrad",
+    "average_accumulates_": "incubate.ModelAverage",
     "affine_grid": "nn.functional.affine_grid",
     "nms": "vision.ops.nms",
     "assign_value_": "assign",
