@@ -54,7 +54,7 @@ class LookAhead:
                 m._inplace_assign(slow)
 
     def minimize(self, loss, *a, **k):
-        loss.backward()
+        # codebase contract: the caller has already run loss.backward()
         self.step()
 
     def clear_grad(self):

@@ -23,7 +23,8 @@ from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
-    lp_pool2d, max_unpool2d,
+    lp_pool2d, max_unpool2d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d,
 )
 from .loss import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,

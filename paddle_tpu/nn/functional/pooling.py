@@ -211,31 +211,155 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     return apply(fn, xt, name="lp_pool2d")
 
 
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                ndim, name):
+    """Shared N-D unpool scatter (reference: unpool_kernel.h /
+    unpool3d): pooled values land at their flat spatial mask positions."""
+    ks = _tuple(kernel_size, ndim)
+    st = _tuple(stride if stride is not None else kernel_size, ndim)
+    pd = _tuple(padding, ndim)
+
+    def fn(v, idx):
+        N, C = v.shape[:2]
+        sp_in = v.shape[2:]
+        if output_size is not None:
+            sp_out = tuple(output_size[-ndim:])
+        else:
+            sp_out = tuple(
+                (sp_in[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                for i in range(ndim))
+        flat_v = v.reshape(N, C, int(np.prod(sp_in)))
+        flat_i = idx.reshape(N, C, int(np.prod(sp_in))).astype(jnp.int32)
+        out = jnp.zeros((N, C, int(np.prod(sp_out))), v.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, val: o.at[i].set(val)))(out, flat_i, flat_v)
+        return out.reshape((N, C) + sp_out)
+    return apply(fn, as_tensor(x), as_tensor(indices), name=name)
+
+
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
     """Inverse of max_pool2d(return_mask=True) (reference:
-    paddle/phi/kernels/unpool_kernel.h): scatter each pooled value to the
-    flat H*W position its mask recorded."""
+    paddle/phi/kernels/unpool_kernel.h)."""
     if data_format != "NCHW":
         raise ValueError("max_unpool2d supports NCHW")
-    ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
-        else (kernel_size, kernel_size)
-    st = stride or ks
-    st = st if isinstance(st, (tuple, list)) else (st, st)
-    pd = padding if isinstance(padding, (tuple, list)) \
-        else (padding, padding)
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2, "max_unpool2d")
 
-    def fn(v, idx):
-        N, C, Hp, Wp = v.shape
-        if output_size is not None:
-            Ho, Wo = output_size[-2], output_size[-1]
-        else:
-            Ho = (Hp - 1) * st[0] - 2 * pd[0] + ks[0]
-            Wo = (Wp - 1) * st[1] - 2 * pd[1] + ks[1]
-        flat_v = v.reshape(N, C, Hp * Wp)
-        flat_i = idx.reshape(N, C, Hp * Wp).astype(jnp.int32)
-        out = jnp.zeros((N, C, Ho * Wo), v.dtype)
-        out = jax.vmap(jax.vmap(
-            lambda o, i, val: o.at[i].set(val)))(out, flat_i, flat_v)
-        return out.reshape(N, C, Ho, Wo)
-    return apply(fn, as_tensor(x), as_tensor(indices), name="max_unpool2d")
+
+def _fractional_regions(in_size, out_size, k, u):
+    """Graham fractional-pooling regions (reference docstring formula:
+    start = ceil(alpha*(i+u) - 1), end = ceil(alpha*(i+1+u) - 1); a given
+    kernel_size switches to overlapping mode with that region length).
+    Returns an (out, maxlen) int index array; ragged regions repeat their
+    last index (max over repeats is unchanged)."""
+    import math
+    alpha = in_size / out_size
+    starts, ends = [], []
+    for i in range(out_size):
+        s = math.ceil(alpha * (i + u) - 1)
+        e = math.ceil(alpha * (i + 1 + u) - 1) if k is None else s + k
+        s = max(0, min(s, in_size - 1))
+        e = max(s + 1, min(e, in_size))
+        starts.append(s)
+        ends.append(e)
+    maxlen = max(e - s for s, e in zip(starts, ends))
+    idx = np.array([[min(s + j, e - 1) for j in range(maxlen)]
+                    for s, e in zip(starts, ends)], np.int32)
+    return idx
+
+
+def _fractional_u(random_u):
+    if random_u is None:
+        from ..._core.random import next_rng_key
+        import jax
+        u = float(jax.random.uniform(next_rng_key(), ()))
+        # keep strictly inside (0, 1)
+        return min(max(u, 1e-6), 1 - 1e-6)
+    u = float(random_u)
+    if not 0.0 < u < 1.0:
+        raise ValueError(f"random_u must be in (0, 1), got {u}")
+    return u
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (Graham 2015; reference:
+    python/paddle/nn/functional/pooling.py fractional_max_pool2d,
+    phi fractional_max_pool2d kernel). NCHW."""
+    xt = as_tensor(x)
+    H, W = int(xt.shape[2]), int(xt.shape[3])
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    oh, ow = int(oh or H), int(ow or W)
+    kh, kw = ((kernel_size if isinstance(kernel_size, (tuple, list))
+               else (kernel_size, kernel_size)) if kernel_size is not None
+              else (None, None))
+    u = _fractional_u(random_u)
+    idx_h = _fractional_regions(H, oh, kh, u)
+    idx_w = _fractional_regions(W, ow, kw, u)
+
+    def pooled_fn(v):
+        # (N, C, Oh, mh, W) -> (N, C, Oh, mh, Ow, mw); ragged regions
+        # repeat their last index, which max ignores
+        block = v[:, :, idx_h, :][:, :, :, :, idx_w]
+        return block.max(axis=(3, 5))
+
+    def mask_fn(v):
+        block = v[:, :, idx_h, :][:, :, :, :, idx_w]
+        nb, nc, o1, mh, o2, mw = block.shape
+        flat = block.transpose(0, 1, 2, 4, 3, 5).reshape(
+            nb, nc, o1, o2, mh * mw)
+        am = jnp.argmax(flat, axis=-1)
+        jh, jw = am // mw, am % mw
+        habs = jnp.asarray(idx_h)[jnp.arange(o1)[None, None, :, None], jh]
+        wabs = jnp.asarray(idx_w)[jnp.arange(o2)[None, None, None, :], jw]
+        return (habs * W + wabs).astype(jnp.int32)
+
+    out = apply(pooled_fn, xt, name="fractional_max_pool2d")
+    if return_mask:
+        from ..._core.tensor import Tensor
+        mask = Tensor(mask_fn(raw(xt)), _internal=True)
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """3D fractional max pooling (reference: fractional_max_pool3d).
+    NCDHW."""
+    xt = as_tensor(x)
+    D, H, W = (int(xt.shape[2]), int(xt.shape[3]), int(xt.shape[4]))
+    od, oh, ow = (output_size if isinstance(output_size, (tuple, list))
+                  else (output_size,) * 3)
+    od, oh, ow = int(od or D), int(oh or H), int(ow or W)
+    kd, kh, kw = ((kernel_size if isinstance(kernel_size, (tuple, list))
+                   else (kernel_size,) * 3) if kernel_size is not None
+                  else (None, None, None))
+    u = _fractional_u(random_u)
+    idx_d = _fractional_regions(D, od, kd, u)
+    idx_h = _fractional_regions(H, oh, kh, u)
+    idx_w = _fractional_regions(W, ow, kw, u)
+
+    def fn(v):
+        b = v[:, :, idx_d, :, :]          # (N,C,Od,md,H,W)
+        b = b.max(axis=3)
+        b = b[:, :, :, idx_h, :]          # (N,C,Od,Oh,mh,W)
+        b = b.max(axis=4)
+        b = b[:, :, :, :, idx_w]          # (N,C,Od,Oh,Ow,mw)
+        return b.max(axis=5)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not supported; "
+            "use the 2D variant for mask-based unpooling")
+    return apply(fn, xt, name="fractional_max_pool3d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Inverse of max_pool3d with flat D*H*W indices (reference:
+    unpool3d kernel)."""
+    if data_format != "NCDHW":
+        raise ValueError("max_unpool3d supports NCDHW")
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3, "max_unpool3d")

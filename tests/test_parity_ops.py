@@ -401,3 +401,85 @@ class TestLBFGS:
             topt.step(tclosure)
         np.testing.assert_allclose(w.numpy(), tw.detach().numpy(),
                                    rtol=1e-3, atol=1e-4)
+
+
+class TestFractionalPooling:
+    def test_docstring_example(self):
+        """The reference docstring's worked example: seq [2,4,3,1,5,2,3],
+        output 5, u=0.3 -> [2,4,1,5,3]."""
+        seq = np.array([2, 4, 3, 1, 5, 2, 3], dtype="float32")
+        out = F.fractional_max_pool2d(
+            paddle.to_tensor(seq.reshape(1, 1, 1, 7)), (1, 5),
+            random_u=0.3)
+        np.testing.assert_allclose(out.numpy().ravel(), [2, 4, 1, 5, 3])
+
+    def test_matches_bruteforce_regions(self):
+        import math
+        xv = rng.randn(2, 3, 11, 13).astype("float32")
+        u = 0.41
+        out = F.fractional_max_pool2d(paddle.to_tensor(xv), (4, 5),
+                                      random_u=u).numpy()
+
+        def regions(n, o):
+            a = n / o
+            st = [max(0, min(math.ceil(a * (i + u) - 1), n - 1))
+                  for i in range(o)]
+            en = [max(s + 1, min(math.ceil(a * (i + 1 + u) - 1), n))
+                  for i, s in enumerate(st)]
+            return st, en
+        sh, eh = regions(11, 4)
+        sw, ew = regions(13, 5)
+        for i in range(4):
+            for j in range(5):
+                np.testing.assert_allclose(
+                    out[:, :, i, j],
+                    xv[:, :, sh[i]:eh[i], sw[j]:ew[j]].max(axis=(2, 3)))
+
+    def test_mask_indexes_the_max(self):
+        xv = rng.randn(2, 2, 9, 9).astype("float32")
+        out, mask = F.fractional_max_pool2d(paddle.to_tensor(xv), (3, 3),
+                                            random_u=0.6, return_mask=True)
+        flat = xv.reshape(2, 2, -1)
+        gathered = np.take_along_axis(flat, mask.numpy().reshape(2, 2, -1),
+                                      -1).reshape(out.shape)
+        np.testing.assert_allclose(gathered, out.numpy())
+
+    def test_3d_and_kernel_mode(self):
+        x3 = rng.randn(1, 2, 6, 8, 9).astype("float32")
+        o3 = F.fractional_max_pool3d(paddle.to_tensor(x3), (2, 3, 4),
+                                     random_u=0.7)
+        assert tuple(o3.shape) == (1, 2, 2, 3, 4)
+        # overlapping (kernel_size) mode
+        ok = F.fractional_max_pool2d(
+            paddle.to_tensor(rng.randn(1, 1, 10, 10).astype("float32")),
+            (4, 4), kernel_size=3, random_u=0.2)
+        assert tuple(ok.shape) == (1, 1, 4, 4)
+
+    def test_unpool3d_roundtrip(self):
+        xv = rng.randn(1, 2, 4, 4, 4).astype("float32")
+        # indices: flat argmax per 2x2x2 region, built by hand
+        pooled = np.zeros((1, 2, 2, 2, 2), "float32")
+        idx = np.zeros((1, 2, 2, 2, 2), "int32")
+        for d in range(2):
+            for i in range(2):
+                for j in range(2):
+                    win = xv[:, :, 2*d:2*d+2, 2*i:2*i+2, 2*j:2*j+2]
+                    flat = win.reshape(1, 2, -1)
+                    am = flat.argmax(-1)
+                    pooled[:, :, d, i, j] = flat.max(-1)
+                    dd, hh, ww = np.unravel_index(am, (2, 2, 2))
+                    idx[:, :, d, i, j] = ((2*d+dd) * 4 + (2*i+hh)) * 4 + \
+                        (2*j+ww)
+        un = F.max_unpool3d(paddle.to_tensor(pooled),
+                            paddle.to_tensor(idx), 2, stride=2)
+        assert tuple(un.shape) == (1, 2, 4, 4, 4)
+        np.testing.assert_allclose(un.numpy().sum(), pooled.sum(),
+                                   rtol=1e-5)
+
+    def test_random_u_sampled_when_none(self):
+        paddle.seed(1234)
+        x = paddle.to_tensor(rng.randn(1, 1, 8, 8).astype("float32"))
+        out = F.fractional_max_pool2d(x, (3, 3))
+        assert tuple(out.shape) == (1, 1, 3, 3)
+        with pytest.raises(ValueError):
+            F.fractional_max_pool2d(x, (3, 3), random_u=1.5)

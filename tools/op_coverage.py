@@ -211,8 +211,10 @@ ALIASES = {
     "dequantize_abs_max": "nn.quant.weight_dequantize",
     "dequantize_log": None,
     "lookup_table_dequant": None,
-    "fractional_max_pool2d": None, "fractional_max_pool3d": None,
-    "unpool": "nn.functional.max_unpool2d", "unpool3d": None,
+    "fractional_max_pool2d": "nn.functional.fractional_max_pool2d",
+    "fractional_max_pool3d": "nn.functional.fractional_max_pool3d",
+    "unpool": "nn.functional.max_unpool2d",
+    "unpool3d": "nn.functional.max_unpool3d",
     "lp_pool2d": "nn.functional.lp_pool2d",
     "margin_cross_entropy": "nn.functional.margin_cross_entropy",
     "gather_tree": "gather_tree", "sequence_mask": "sequence_mask",
@@ -230,7 +232,7 @@ ALIASES = {
     "asgd_": "optimizer.ASGD", "nadam_": "optimizer.NAdam",
     "radam_": "optimizer.RAdam", "rprop_": "optimizer.Rprop",
     "decayed_adagrad": "optimizer.Adagrad",
-    "average_accumulates_": "incubate.ModelAverage",
+    "average_accumulates_": "incubate.optimizer.ModelAverage",
     "affine_grid": "nn.functional.affine_grid",
     "nms": "vision.ops.nms",
     "assign_value_": "assign",
