@@ -59,12 +59,28 @@ def peak_flops(dev) -> float:
     return 275e12
 
 
+def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps):
+    import jax
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "seq": seq, "batch": batch,
+                  "params": cfg.num_params(),
+                  "device": str(jax.devices()[0].device_kind),
+                  "loss": lossv,
+                  "decode_tokens_per_sec": decode_tps},
+    }
+
+
 def measure():
     import numpy as np
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import train
 
+    t_measure_start = time.perf_counter()
     cfg, seq, batch = pick_config()
     on_tpu = jax.devices()[0].platform == "tpu"
     step = train.make_train_step(cfg, seq_chunk=512 if on_tpu else None)
@@ -94,6 +110,12 @@ def measure():
     # serving path: batched KV-cache decode throughput (reference decode
     # benches run block_multi_head_attention; here the pallas decode kernel)
     decode_tps = None
+    # the decode extra costs two more jit compiles; never let it push the
+    # run past the parent watchdog — the headline number must survive
+    budget = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
+    elapsed = time.perf_counter() - t_measure_start
+    if elapsed > 0.35 * budget:
+        return _result(tps, mfu, seq, batch, cfg, lossv, None)
     try:
         from paddle_tpu.models import generate as gen
         db, dp_len, dnew = (8, 128, 64) if on_tpu else (2, 8, 8)
@@ -121,17 +143,7 @@ def measure():
     except Exception:
         pass  # decode bench is auxiliary; never kill the headline number
 
-    return {
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tps, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.40, 4),
-        "extra": {"mfu": round(mfu, 4), "seq": seq, "batch": batch,
-                  "params": cfg.num_params(),
-                  "device": str(jax.devices()[0].device_kind),
-                  "loss": lossv,
-                  "decode_tokens_per_sec": decode_tps},
-    }
+    return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps)
 
 
 def child_main():
