@@ -106,10 +106,18 @@ class InputRef:
 
 class Node:
     """One recorded op on the tape (analog of GradNodeBase,
-    reference: paddle/fluid/eager/grad_node_info.h:197)."""
+    reference: paddle/fluid/eager/grad_node_info.h:197).
+
+    ``fn``/``raw``/``diff_idx`` (set by :func:`apply`) let ``backward(...,
+    create_graph=True)`` re-trace the VJP *as a recorded op* so the
+    gradient computation itself lands on the tape — the TPU-native analog
+    of the reference's double-grad GradNodes (generated
+    ``*_double_grad`` kernels, eager_gen.py higher-order branches).
+    ``vjp_graph_fn`` is the PyLayer override (runs the user backward in
+    grad mode)."""
 
     __slots__ = ("vjp_fn", "inputs", "out_meta", "out_is_seq", "name",
-                 "__weakref__")
+                 "fn", "raw", "diff_idx", "vjp_graph_fn", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, out_meta, out_is_seq, name=""):
         self.vjp_fn = vjp_fn
@@ -117,6 +125,10 @@ class Node:
         self.out_meta = out_meta  # list of (shape, dtype) per differentiable output
         self.out_is_seq = out_is_seq  # fn returned a tuple/list (cotangent structure)
         self.name = name
+        self.fn = None
+        self.raw = None
+        self.diff_idx = None
+        self.vjp_graph_fn = None
 
 
 def _is_diff_dtype(d) -> bool:
@@ -194,6 +206,14 @@ def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
     out_meta = [(tuple(o.shape), jnp.result_type(o)) for o in flat_outs]
     node = Node(vjp_fn, diff_tensors, out_meta, is_seq,
                 name=name or getattr(fn, "__name__", "op"))
+    # retained for create_graph=True VJP re-tracing.  Differentiable
+    # positions are nulled out: InputRef already pins those tensors and the
+    # re-trace overwrites them with live primals, so the only extra
+    # retention is non-diff inputs (indices/masks/scalars — typically tiny
+    # or already pinned as vjp residuals).
+    node.fn = fn
+    node.raw = [None if i in diff_idx else v for i, v in enumerate(raw)]
+    node.diff_idx = diff_idx
 
     outs = []
     for k, o in enumerate(flat_outs):
@@ -218,7 +238,8 @@ def _wrap_outputs(out, node, stop_gradient, multi_out):
     return (t,) if multi_out else t
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None):
+def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None,
+             create_graph=False):
     """Run reverse accumulation from ``tensors``
     (reference: egr::Backward paddle/fluid/eager/backward.cc:439,
     RunBackward backward.cc:105).
@@ -226,6 +247,14 @@ def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None):
     ``grad_sink``: if given (a dict), leaf gradients are accumulated into
     ``grad_sink[id(tensor)]`` instead of ``tensor.grad`` — used by the
     functional :func:`grad` API so it never mutates ``.grad`` state.
+
+    ``create_graph``: cotangents are carried as *Tensors* and every VJP is
+    re-traced through :func:`apply`, so the computed gradients are
+    themselves on the tape and can be differentiated again (reference:
+    double-grad GradNodes / ``paddle.grad(create_graph=True)``).  Mutating
+    an input in place (``_inplace_assign``) between the forward and a
+    ``create_graph`` backward yields the mutated primal, like the
+    reference's inplace-version guard would reject; run backward first.
     """
     from .tensor import Tensor
 
@@ -254,6 +283,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None):
                     "grad must be provided for non-scalar backward root "
                     f"(shape={t.shape})")
             gval = jnp.ones_like(t._value)
+            if create_graph:
+                gval = Tensor(gval, stop_gradient=True, _internal=True)
+        elif create_graph:
+            # keep the Tensor: grad-of-grad w.r.t. grad_outputs must flow
+            gval = g if isinstance(g, Tensor) else Tensor(
+                jnp.asarray(g), stop_gradient=True, _internal=True)
         else:
             gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._node
@@ -287,22 +322,39 @@ def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None):
         slot = pending.get(id(node))
         if slot is None:
             continue
-        out_grads = [
-            g if g is not None else jnp.zeros(shape, dtype)
-            for g, (shape, dtype) in zip(slot[1], node.out_meta)
-        ]
+        if create_graph:
+            out_grads = [
+                g if g is not None else Tensor(jnp.zeros(shape, dtype),
+                                               stop_gradient=True,
+                                               _internal=True)
+                for g, (shape, dtype) in zip(slot[1], node.out_meta)
+            ]
+        else:
+            out_grads = [
+                g if g is not None else jnp.zeros(shape, dtype)
+                for g, (shape, dtype) in zip(slot[1], node.out_meta)
+            ]
         if node.vjp_fn is None:
             raise RuntimeError(
                 "trying to backward through the graph a second time: "
                 "set retain_graph=True on the first backward() call")
-        in_grads = node.vjp_fn(tuple(out_grads) if node.out_is_seq
-                               else out_grads[0])
+        if create_graph:
+            in_grads = _node_vjp_graph(node, out_grads)
+        else:
+            in_grads = node.vjp_fn(tuple(out_grads) if node.out_is_seq
+                                   else out_grads[0])
         for ref, g in zip(node.inputs, in_grads):
             t = ref.tensor
             for hook in t._grad_hooks:
-                h = hook(Tensor(g, stop_gradient=True, _internal=True))
+                h = hook(g if isinstance(g, Tensor)
+                         else Tensor(g, stop_gradient=True, _internal=True))
                 if h is not None:
-                    g = h._value if isinstance(h, Tensor) else h
+                    if create_graph:
+                        g = h if isinstance(h, Tensor) else Tensor(
+                            jnp.asarray(h), stop_gradient=True,
+                            _internal=True)
+                    else:
+                        g = h._value if isinstance(h, Tensor) else h
             if ref.node is None or t._retain_grads:
                 _accumulate_leaf(t, g, grad_sink)
             if ref.node is not None:
@@ -310,9 +362,48 @@ def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None):
                     id(ref.node), [ref.node, [None] * len(ref.node.out_meta)])
                 k = ref.out_index
                 s[1][k] = g if s[1][k] is None else s[1][k] + g
-        if not retain_graph:
+        if not retain_graph and not create_graph:
             node.vjp_fn = None
+            node.fn = None       # free re-trace closures with the residuals
+            node.raw = None
         del pending[id(node)]
+
+
+def _node_vjp_graph(node: Node, out_grads):
+    """Run ``node``'s VJP as a *recorded* op so the result carries a tape
+    (the create_graph=True engine).  Builtin ops re-trace ``jax.vjp`` of
+    the saved primitive over (primal inputs, cotangents); PyLayer nodes
+    run their user backward in grad mode (``vjp_graph_fn``)."""
+    from .tensor import Tensor
+
+    cots = [g if isinstance(g, Tensor)
+            else Tensor(g, stop_gradient=True, _internal=True)
+            for g in out_grads]
+    if node.vjp_graph_fn is not None:
+        return node.vjp_graph_fn(cots)
+    if node.fn is None:
+        raise RuntimeError(
+            f"op '{node.name}' does not support create_graph=True "
+            "(no primitive recorded for VJP re-tracing)")
+    fn, raw, diff_idx = node.fn, node.raw, node.diff_idx
+    n_in = len(diff_idx)
+    is_seq = node.out_is_seq
+
+    def vjp_op(*vals):
+        prim, cv = vals[:n_in], vals[n_in:]
+
+        def f(*dv):
+            vs = list(raw)
+            for j, i in enumerate(diff_idx):
+                vs[i] = dv[j]
+            return fn(*vs)
+
+        _, vf = jax.vjp(f, *prim)
+        return tuple(vf(tuple(cv) if is_seq else cv[0]))
+
+    outs = apply(vjp_op, *[r.tensor for r in node.inputs], *cots,
+                 name=(node.name or "op") + "_grad", multi_out=True)
+    return list(outs)
 
 
 def _accumulate_leaf(t, gval, grad_sink=None):
@@ -320,6 +411,11 @@ def _accumulate_leaf(t, gval, grad_sink=None):
     if grad_sink is not None:
         prev = grad_sink.get(id(t))
         grad_sink[id(t)] = gval if prev is None else prev + gval
+        return
+    if isinstance(gval, Tensor):
+        # create_graph mode: .grad keeps its tape so it can be
+        # differentiated again (reference double-grad semantics)
+        t._grad = gval if t.grad is None else t._grad + gval
         return
     if t.grad is None:
         t._grad = Tensor(gval, stop_gradient=True, _internal=True)
@@ -339,18 +435,22 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.autograd.jacobian / jax.grad "
-            "composition for higher-order derivatives")
+    if retain_graph is None:
+        retain_graph = create_graph
 
     saved_retain = [(t, t._retain_grads) for t in inputs]
     sink: dict = {}
     for t in inputs:
         t._retain_grads = True  # ensure non-leaf inputs receive grads
     try:
-        backward(outputs, grad_tensors=grad_outputs,
-                 retain_graph=bool(retain_graph), grad_sink=sink)
+        if create_graph:
+            with enable_grad():
+                backward(outputs, grad_tensors=grad_outputs,
+                         retain_graph=True, grad_sink=sink,
+                         create_graph=True)
+        else:
+            backward(outputs, grad_tensors=grad_outputs,
+                     retain_graph=bool(retain_graph), grad_sink=sink)
         res = []
         for t in inputs:
             g = sink.get(id(t))
@@ -360,6 +460,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                         "one of the inputs was not used in the graph; pass "
                         "allow_unused=True to return None for it")
                 res.append(None)
+            elif isinstance(g, Tensor):
+                # create_graph mode: the grad carries its own tape
+                res.append(g)
             else:
                 res.append(Tensor(g, stop_gradient=True, _internal=True))
         return res

@@ -86,28 +86,46 @@ class PyLayer(metaclass=PyLayerMeta):
         out_meta = [(tuple(o.shape), jnp.result_type(o._value))
                     for o in out_tensors]
 
-        def vjp_fn(cots):
-            if not isinstance(cots, tuple):
-                cots = (cots,)
-            cot_tensors = [Tensor(c, stop_gradient=True, _internal=True)
-                           for c in cots]
-            with ag.no_grad():
+        def run_backward(cot_tensors, grad_mode):
+            """Invoke the user backward on Tensor cotangents and normalize
+            the result to a list of Tensors (one per diff input)."""
+            guard = ag.enable_grad() if grad_mode else ag.no_grad()
+            with guard:
                 grads = cls.backward(ctx, *cot_tensors) \
                     if len(cot_tensors) > 1 else \
                     cls.backward(ctx, cot_tensors[0])
             if not isinstance(grads, (tuple, list)):
                 grads = (grads,)
-            gv = [g._value if isinstance(g, Tensor) else g for g in grads]
-            gv = [g for g in gv if g is not None]
-            if len(gv) != len(diff_inputs):
+            gs = [g for g in grads if g is not None]
+            if len(gs) != len(diff_inputs):
                 raise ValueError(
-                    f"{cls.__name__}.backward returned {len(gv)} grads "
+                    f"{cls.__name__}.backward returned {len(gs)} grads "
                     f"but forward had {len(diff_inputs)} differentiable "
                     "tensor inputs")
-            return tuple(gv)
+            return [g if isinstance(g, Tensor)
+                    else Tensor(jnp.asarray(g), stop_gradient=True,
+                                _internal=True) for g in gs]
+
+        def vjp_fn(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            cot_tensors = [Tensor(c, stop_gradient=True, _internal=True)
+                           for c in cots]
+            return tuple(g._value for g in run_backward(cot_tensors,
+                                                        grad_mode=False))
+
+        def vjp_graph_fn(cot_tensors):
+            """create_graph=True path: run the user backward in grad mode
+            on Tensor cotangents so its ops land on the tape.  Second
+            derivatives flow through the backward fn's own computation
+            (the cotangent-linear part); residuals saved under no_grad
+            stay constants — the reference's ``once_differentiable``
+            boundary."""
+            return run_backward(cot_tensors, grad_mode=True)
 
         node = ag.Node(vjp_fn, diff_inputs, out_meta, len(out_tensors) > 1,
                        name=cls.__name__)
+        node.vjp_graph_fn = vjp_graph_fn
         for k, o in enumerate(out_tensors):
             o._stop_gradient = False
             o._node = node

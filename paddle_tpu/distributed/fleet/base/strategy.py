@@ -47,6 +47,19 @@ class DistributedStrategy:
         # gradient merge
         self.gradient_merge = False
         self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        # DGC (reference: distributed_strategy.proto dgc_configs)
+        self.dgc = False
+        self.dgc_configs: Dict[str, Any] = {
+            "rampup_begin_step": 0, "rampup_step": 1, "sparsity": [0.999],
+        }
+        # LocalSGD (reference: distributed_strategy.proto localsgd_configs)
+        self.localsgd = False
+        self.localsgd_configs: Dict[str, Any] = {"k_steps": 1,
+                                                 "begin_step": 1}
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs: Dict[str, Any] = {
+            "init_k_steps": 1, "begin_step": 1,
+        }
         self.find_unused_parameters = False
         self.hybrid_parallel_order = list(_HYBRID_DEFAULTS["order"])
 

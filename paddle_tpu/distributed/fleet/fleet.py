@@ -98,12 +98,56 @@ def distributed_model(model: Layer):
 
 def distributed_optimizer(optimizer, strategy=None):
     """reference: fleet/optimizer.py:24 -> HybridParallelOptimizer
-    (meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:266)."""
+    (meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:266);
+    strategy-selected meta-optimizers mirror fleet/meta_optimizers/
+    (dgc_optimizer.py, localsgd_optimizer.py)."""
     from .meta_optimizers.hybrid_parallel_optimizer import (
         HybridParallelOptimizer)
     hcg = get_hybrid_communicate_group()
-    return HybridParallelOptimizer(optimizer, hcg,
-                                   strategy or _fleet_state["strategy"])
+    strategy = strategy or _fleet_state["strategy"]
+    dp_group = hcg.get_data_parallel_group() if hcg is not None else None
+    if strategy is not None and getattr(strategy, "dgc", False):
+        from ...optimizer.optimizers import Momentum
+        from .meta_optimizers.dgc_optimizer import DGCMomentumOptimizer
+        if not isinstance(optimizer, Momentum):
+            import warnings
+            warnings.warn(
+                "strategy.dgc=True requires a Momentum optimizer "
+                f"(got {type(optimizer).__name__}); DGC is NOT applied "
+                "(reference: DGCOptimizer._can_apply)")
+        elif not isinstance(optimizer, DGCMomentumOptimizer):
+            cfg = strategy.dgc_configs
+            nranks = (dp_group.nranks if dp_group is not None
+                      else worker_num())
+            optimizer = DGCMomentumOptimizer(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]),
+                # keep param groups (per-group lr/weight_decay overrides)
+                parameters=(optimizer._param_groups
+                            or optimizer._parameter_list),
+                use_nesterov=optimizer._nesterov,
+                weight_decay=optimizer._weight_decay,
+                grad_clip=optimizer._grad_clip,
+                num_trainers=(max(1, nranks)
+                              if optimizer._grad_clip is not None else None),
+                group=dp_group)
+    if strategy is not None and getattr(strategy, "adaptive_localsgd", False):
+        from .meta_optimizers.localsgd_optimizer import (
+            AdaptiveLocalSGDOptimizer)
+        cfg = strategy.adaptive_localsgd_configs
+        optimizer = AdaptiveLocalSGDOptimizer(
+            optimizer, init_k_steps=cfg.get("init_k_steps", 1),
+            begin_step=cfg.get("begin_step", 1), group=dp_group)
+    elif strategy is not None and getattr(strategy, "localsgd", False):
+        from .meta_optimizers.localsgd_optimizer import LocalSGDOptimizer
+        cfg = strategy.localsgd_configs
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            begin_step=cfg.get("begin_step", 1), group=dp_group)
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
 
 
 def get_strategy():

@@ -1,0 +1,9 @@
+"""Fleet meta-optimizers (reference: fleet/meta_optimizers/__init__.py)."""
+from .hybrid_parallel_optimizer import (HybridParallelOptimizer,
+                                        DygraphShardingOptimizer)
+from .dgc_optimizer import DGCMomentumOptimizer
+from .localsgd_optimizer import LocalSGDOptimizer, AdaptiveLocalSGDOptimizer
+
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer",
+           "DGCMomentumOptimizer", "LocalSGDOptimizer",
+           "AdaptiveLocalSGDOptimizer"]

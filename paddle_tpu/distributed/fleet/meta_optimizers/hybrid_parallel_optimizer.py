@@ -57,11 +57,19 @@ class HybridParallelOptimizer:
         self._sharding_enabled = (
             hcg is not None and hcg.get_sharding_parallel_world_size() > 1)
         if self._sharding_enabled:
-            optimizer._acc = _shard_state_over(
-                "sharding", hcg.mesh)(optimizer._acc)
+            # unwrap meta-optimizer shells (LocalSGD etc.): the patch must
+            # land on the object whose step() resolves self._acc, or the
+            # accumulators silently stay replicated
+            target = optimizer
+            while hasattr(target, "_inner"):
+                target = target._inner
+            target._acc = _shard_state_over(
+                "sharding", hcg.mesh)(target._acc)
 
-    def step(self):
-        self._inner_opt.step()
+    def step(self, *args, **kwargs):
+        # forwarded so meta-optimizers with extended signatures stay
+        # reachable (AdaptiveLocalSGDOptimizer.step(loss=...))
+        self._inner_opt.step(*args, **kwargs)
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

@@ -1,0 +1,418 @@
+"""DGC + LocalSGD meta-optimizer tests.
+
+Reference behavior: fleet/meta_optimizers/dgc_optimizer.py (momentum
+correction + top-k error feedback, dense phase before rampup_begin_step),
+localsgd_optimizer.py (k-step parameter averaging; adaptive interval
+formula at :458).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import Momentum, SGD
+from paddle_tpu.distributed.fleet.meta_optimizers.dgc_optimizer import (
+    DGCMomentumOptimizer, dgc_compress, dgc_sparse_allreduce,
+    dgc_stage_sparsity)
+from paddle_tpu.distributed.fleet.meta_optimizers.localsgd_optimizer import (
+    LocalSGDOptimizer, AdaptiveLocalSGDOptimizer, localsgd_params_average)
+
+
+def _mesh(n, name="dp"):
+    devs = np.array(jax.devices("cpu")[:n])
+    return jax.sharding.Mesh(devs, (name,))
+
+
+# ---------------- DGC functional core ----------------
+
+class TestDGCCompress:
+    def test_error_feedback_invariant(self):
+        # communicated + residual == full momentum accumulation
+        g = jnp.asarray(np.random.RandomState(0).randn(64).astype(np.float32))
+        u = jnp.zeros(64)
+        v = jnp.zeros(64)
+        idx, vals, nu, nv = dgc_compress(g, u, v, momentum=0.9, k=8)
+        dense_sent = jnp.zeros(64).at[idx].add(vals)
+        # v' before clearing was v + u' = g (first step); sent + residual = g
+        np.testing.assert_allclose(np.asarray(dense_sent + nv),
+                                   np.asarray(g), rtol=1e-6)
+        # u is cleared exactly at the selected positions
+        assert np.all(np.asarray(nu)[np.asarray(idx)] == 0)
+
+    def test_topk_selects_largest(self):
+        v0 = jnp.asarray(np.array([0.1, -5.0, 0.2, 3.0], np.float32))
+        idx, vals, nu, nv = dgc_compress(v0, jnp.zeros(4), jnp.zeros(4),
+                                         momentum=0.0, k=2)
+        assert set(np.asarray(idx).tolist()) == {1, 3}
+        np.testing.assert_allclose(sorted(np.asarray(vals).tolist()),
+                                   [-5.0, 3.0])
+
+    def test_k_full_equals_dense(self):
+        g = jnp.asarray(np.random.RandomState(1).randn(16).astype(np.float32))
+        idx, vals, nu, nv = dgc_compress(g, jnp.zeros(16), jnp.zeros(16),
+                                         momentum=0.9, k=16)
+        dense = np.asarray(jnp.zeros(16).at[idx].add(vals))
+        np.testing.assert_allclose(dense, np.asarray(g), rtol=1e-6)
+        assert np.abs(np.asarray(nu)).max() == 0
+        assert np.abs(np.asarray(nv)).max() == 0
+
+    def test_stage_sparsity_schedule(self):
+        sp = [0.75, 0.9375, 0.999]
+        assert dgc_stage_sparsity(0, 5, 6, sp) is None
+        assert dgc_stage_sparsity(4, 5, 6, sp) is None
+        assert dgc_stage_sparsity(5, 5, 6, sp) == 0.75
+        assert dgc_stage_sparsity(7, 5, 6, sp) == 0.9375
+        assert dgc_stage_sparsity(9, 5, 6, sp) == 0.999
+        assert dgc_stage_sparsity(100, 5, 6, sp) == 0.999
+
+    def test_sparse_allreduce_mapped(self):
+        mesh = _mesh(4)
+        numel = 32
+        rs = np.random.RandomState(2)
+        grads = rs.randn(4, numel).astype(np.float32)
+
+        def f(g):
+            g = g.reshape(-1)
+            idx, vals, _, _ = dgc_compress(g, jnp.zeros(numel),
+                                           jnp.zeros(numel),
+                                           momentum=0.0, k=numel)
+            return dgc_sparse_allreduce(idx, vals, numel, axis="dp")
+
+        out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"))(grads.reshape(-1))
+        # every rank's output equals the mean gradient (4 tiled copies)
+        want = np.tile(grads.mean(0), 4)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+# ---------------- DGCMomentumOptimizer ----------------
+
+class TestDGCMomentumOptimizer:
+    def _params(self, n=20000):
+        w = paddle.to_tensor(np.random.RandomState(3).randn(n)
+                             .astype(np.float32) * 0.1)
+        w.stop_gradient = False
+        return w
+
+    def test_dense_phase_matches_momentum(self):
+        rs = np.random.RandomState(4)
+        init = rs.randn(20000).astype(np.float32)
+        w1 = paddle.to_tensor(init.copy()); w1.stop_gradient = False
+        w2 = paddle.to_tensor(init.copy()); w2.stop_gradient = False
+        m = Momentum(learning_rate=0.1, momentum=0.9, parameters=[w1])
+        d = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                                 rampup_begin_step=100, parameters=[w2])
+        for _ in range(3):
+            (w1 * w1).sum().backward(); m.step(); m.clear_grad()
+            (w2 * w2).sum().backward(); d.step(); d.clear_grad()
+        np.testing.assert_allclose(w1.numpy(), w2.numpy(), rtol=1e-6)
+
+    def test_compressed_converges(self):
+        w = self._params()
+        opt = DGCMomentumOptimizer(learning_rate=0.02, momentum=0.9,
+                                   rampup_begin_step=0,
+                                   sparsity=[0.9], parameters=[w])
+        first = None
+        for _ in range(60):
+            loss = (w * w).sum()
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float((w * w).sum().numpy()) < 0.05 * first
+
+    def test_small_param_takes_momentum_path(self):
+        # < 16384 elements -> plain momentum even in compressed phase
+        rs = np.random.RandomState(5)
+        init = rs.randn(32).astype(np.float32)
+        w1 = paddle.to_tensor(init.copy()); w1.stop_gradient = False
+        w2 = paddle.to_tensor(init.copy()); w2.stop_gradient = False
+        m = Momentum(learning_rate=0.1, momentum=0.9, parameters=[w1])
+        d = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                                 rampup_begin_step=0, parameters=[w2])
+        for _ in range(3):
+            (w1 * w1).sum().backward(); m.step(); m.clear_grad()
+            (w2 * w2).sum().backward(); d.step(); d.clear_grad()
+        np.testing.assert_allclose(w1.numpy(), w2.numpy(), rtol=1e-6)
+
+    def test_clip_requires_num_trainers(self):
+        from paddle_tpu.nn.clip_grad import ClipGradByNorm
+        with pytest.raises(ValueError):
+            DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                                 parameters=[self._params(100)],
+                                 grad_clip=ClipGradByNorm(1.0))
+
+    def test_local_clip_scales_by_sqrt_n(self):
+        from paddle_tpu.nn.clip_grad import ClipGradByNorm
+        opt = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                                   parameters=[self._params(100)],
+                                   grad_clip=ClipGradByNorm(2.0),
+                                   num_trainers=4)
+        assert opt._clip_norm == 2.0
+        np.testing.assert_allclose(opt._local_clip_norm, 1.0)
+        # base optimizer must NOT re-clip the averaged gradient
+        assert opt._grad_clip is None
+
+    def test_dense_phase_clips_at_full_norm(self):
+        from paddle_tpu.nn.clip_grad import ClipGradByNorm
+        w = self._params(20000)
+        opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                                   rampup_begin_step=100, parameters=[w],
+                                   grad_clip=ClipGradByNorm(0.5),
+                                   num_trainers=4)
+        before = w.numpy().copy()
+        (w * w).sum().backward()    # grad 2w, norm >> 0.5
+        opt.step()
+        # update = lr * clipped grad -> ||delta|| == 0.5
+        delta = np.linalg.norm(before - w.numpy())
+        np.testing.assert_allclose(delta, 0.5, rtol=1e-4)
+
+    def test_compressed_phase_clip_unmapped_uses_full_norm(self):
+        # outside the mapped regime no cross-rank sum follows, so the
+        # n^-0.5 local threshold must NOT shrink the clip
+        from paddle_tpu.nn.clip_grad import ClipGradByNorm
+        w = self._params(20000)
+        opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                                   rampup_begin_step=0, sparsity=[0.0],
+                                   parameters=[w],
+                                   grad_clip=ClipGradByNorm(2.0),
+                                   num_trainers=4)
+        before = w.numpy().copy()
+        (w * w).sum().backward()
+        opt.step()
+        # k = numel (sparsity 0): everything applied; clip = full 2.0
+        delta = np.linalg.norm(before - w.numpy())
+        np.testing.assert_allclose(delta, 2.0, rtol=1e-4)
+
+    def test_need_clip_false_respected(self):
+        from paddle_tpu.nn.clip_grad import ClipGradByNorm
+        w = self._params(20000)
+        w.need_clip = False
+        opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                                   rampup_begin_step=100, parameters=[w],
+                                   grad_clip=ClipGradByNorm(0.5),
+                                   num_trainers=4)
+        before = w.numpy().copy()
+        (w * w).sum().backward()   # grad 2w, norm >> 0.5
+        opt.step()
+        delta = np.linalg.norm(before - w.numpy())
+        assert delta > 10.0        # unclipped momentum/SGD step
+
+    def test_rampup_begin_counts_completed_steps(self):
+        # rampup_begin_step=1: the FIRST step is still dense (step index 0)
+        rs = np.random.RandomState(6)
+        init = rs.randn(20000).astype(np.float32)
+        w1 = paddle.to_tensor(init.copy()); w1.stop_gradient = False
+        w2 = paddle.to_tensor(init.copy()); w2.stop_gradient = False
+        m = Momentum(learning_rate=0.1, momentum=0.9, parameters=[w1])
+        d = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                                 rampup_begin_step=1, sparsity=[0.999],
+                                 parameters=[w2])
+        (w1 * w1).sum().backward(); m.step()
+        (w2 * w2).sum().backward(); d.step()
+        np.testing.assert_allclose(w1.numpy(), w2.numpy(), rtol=1e-6)
+        # the second step compresses: updates now differ
+        m.clear_grad(); d.clear_grad()
+        (w1 * w1).sum().backward(); m.step()
+        (w2 * w2).sum().backward(); d.step()
+        assert np.abs(w1.numpy() - w2.numpy()).max() > 0
+
+    def test_dgc_ignored_with_warning_for_non_momentum(self):
+        import warnings as _w
+        from paddle_tpu.distributed.fleet import fleet as fl
+        from paddle_tpu.distributed.fleet.base.strategy import (
+            DistributedStrategy)
+        from paddle_tpu.optimizer import Adam
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        s = DistributedStrategy(); s.dgc = True
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            opt = fl.distributed_optimizer(
+                Adam(learning_rate=0.1, parameters=[w]), strategy=s)
+        assert any("dgc" in str(r.message).lower() for r in rec)
+        assert not isinstance(opt._inner_opt, DGCMomentumOptimizer)
+
+    def test_state_dict_roundtrip(self):
+        w = self._params()
+        opt = DGCMomentumOptimizer(learning_rate=0.02, momentum=0.9,
+                                   rampup_begin_step=0, sparsity=[0.99],
+                                   parameters=[w])
+        for _ in range(2):
+            (w * w).sum().backward(); opt.step(); opt.clear_grad()
+        sd = opt.state_dict()
+        assert any("_dgc_u_" in k for k in sd)
+
+
+# ---------------- LocalSGD ----------------
+
+class TestLocalSGD:
+    def test_mapped_average(self):
+        mesh = _mesh(4)
+        x = np.arange(16, dtype=np.float32)
+
+        def f(p):
+            return localsgd_params_average({"w": p}, "dp")["w"]
+
+        out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"))(x)
+        want = np.tile(x.reshape(4, 4).mean(0), 4)
+        np.testing.assert_allclose(np.asarray(out), want)
+
+    def test_sync_cadence(self):
+        w = paddle.to_tensor(np.ones(4, np.float32))
+        w.stop_gradient = False
+        inner = SGD(learning_rate=0.1, parameters=[w])
+        opt = LocalSGDOptimizer(inner, k_steps=3, begin_step=2)
+        syncs = []
+        opt._average_params = lambda: syncs.append(opt._step_count)
+        for _ in range(12):
+            (w * w).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        # first sync at the first step > begin_step with k_steps elapsed
+        assert syncs == [3, 6, 9, 12]
+
+    def test_world1_average_noop(self):
+        w = paddle.to_tensor(np.array([2.0], np.float32))
+        w.stop_gradient = False
+        inner = SGD(learning_rate=0.0, parameters=[w])
+        opt = LocalSGDOptimizer(inner, k_steps=1, begin_step=0)
+        (w * w).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [2.0])
+
+    def test_state_dict_roundtrip(self):
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        opt = LocalSGDOptimizer(SGD(learning_rate=0.1, parameters=[w]),
+                                k_steps=4, begin_step=1)
+        for _ in range(5):
+            (w * w).sum().backward(); opt.step(); opt.clear_grad()
+        sd = opt.state_dict()
+        w2 = paddle.to_tensor(np.ones(2, np.float32))
+        w2.stop_gradient = False
+        opt2 = LocalSGDOptimizer(SGD(learning_rate=0.1, parameters=[w2]),
+                                 k_steps=1, begin_step=0)
+        opt2.set_state_dict(sd)
+        assert opt2._k_steps == 4 and opt2._step_count == 5
+
+    def test_adaptive_interval_formula(self):
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        inner = SGD(learning_rate=0.1, parameters=[w])
+        opt = AdaptiveLocalSGDOptimizer(inner, init_k_steps=4, begin_step=0)
+        opt._average_params = lambda: None
+        # loss0 recorded on first minimize; constant loss -> k stays ~init
+        (w * w).sum().backward()
+        loss = (w * w).sum()
+        opt.minimize(loss)
+        assert opt._loss0 is not None
+        # a 100x loss drop shrinks the interval
+        opt._step_count = 10
+        opt._last_sync = 0
+        k = opt._next_k(opt._loss0 / 100.0)
+        assert 1 <= k < 4
+        # a huge loss blowup clamps at 16
+        assert opt._next_k(opt._loss0 * 1e6) == 16
+
+
+class TestFleetStrategyWiring:
+    def test_strategy_fields(self):
+        from paddle_tpu.distributed.fleet.base.strategy import (
+            DistributedStrategy)
+        s = DistributedStrategy()
+        assert s.dgc is False and s.localsgd is False
+        assert s.dgc_configs["sparsity"] == [0.999]
+        assert s.adaptive_localsgd_configs["init_k_steps"] == 1
+
+    def test_distributed_optimizer_wraps_localsgd(self):
+        from paddle_tpu.distributed.fleet import fleet as fl
+        from paddle_tpu.distributed.fleet.base.strategy import (
+            DistributedStrategy)
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 5, "begin_step": 2}
+        opt = fl.distributed_optimizer(SGD(learning_rate=0.1,
+                                           parameters=[w]), strategy=s)
+        # HybridParallelOptimizer wrapping a LocalSGDOptimizer
+        inner = opt._inner_opt if hasattr(opt, "_inner_opt") else None
+        found = any(isinstance(o, LocalSGDOptimizer) for o in
+                    [inner, getattr(opt, "_optimizer", None),
+                     getattr(opt, "optimizer", None)] if o is not None)
+        assert found
+
+    def test_dgc_wiring_preserves_grad_clip(self):
+        from paddle_tpu.distributed.fleet import fleet as fl
+        from paddle_tpu.distributed.fleet.base.strategy import (
+            DistributedStrategy)
+        from paddle_tpu.nn.clip_grad import ClipGradByNorm
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        s = DistributedStrategy()
+        s.dgc = True
+        opt = fl.distributed_optimizer(
+            Momentum(learning_rate=0.1, momentum=0.9, parameters=[w],
+                     grad_clip=ClipGradByNorm(3.0)),
+            strategy=s)
+        inner = opt._inner_opt
+        assert isinstance(inner, DGCMomentumOptimizer)
+        assert inner._clip_norm == 3.0      # user clip not dropped
+
+    def test_hpo_step_forwards_loss_to_adaptive(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            HybridParallelOptimizer)
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        inner = AdaptiveLocalSGDOptimizer(
+            SGD(learning_rate=0.1, parameters=[w]), init_k_steps=4,
+            begin_step=0)
+        hpo = HybridParallelOptimizer(inner)
+        (w * w).sum().backward()
+        hpo.step(loss=(w * w).sum())
+        assert inner._loss0 is not None     # adaptive path reachable
+
+    def test_hpo_sharding_patch_reaches_inner_through_wrapper(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            HybridParallelOptimizer)
+
+        class FakeHCG:
+            mesh = None
+
+            def get_sharding_parallel_world_size(self):
+                return 2
+
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        sgd = SGD(learning_rate=0.1, parameters=[w])
+        orig_acc = sgd._acc
+        wrapper = LocalSGDOptimizer(sgd, k_steps=2, begin_step=0)
+        HybridParallelOptimizer(wrapper, hcg=FakeHCG())
+        # the patch must land on the INNERMOST optimizer, whose step()
+        # resolves self._acc
+        assert sgd._acc is not orig_acc.__func__ and \
+            sgd.__dict__.get("_acc") is not None
+        assert "_acc" not in wrapper.__dict__
+
+    def test_distributed_optimizer_wraps_dgc(self):
+        from paddle_tpu.distributed.fleet import fleet as fl
+        from paddle_tpu.distributed.fleet.base.strategy import (
+            DistributedStrategy)
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        w.stop_gradient = False
+        s = DistributedStrategy()
+        s.dgc = True
+        opt = fl.distributed_optimizer(
+            Momentum(learning_rate=0.1, momentum=0.9, parameters=[w]),
+            strategy=s)
+        inner = [getattr(opt, a, None) for a in
+                 ("_inner_opt", "_optimizer", "optimizer")]
+        assert any(isinstance(o, DGCMomentumOptimizer) for o in inner
+                   if o is not None)
