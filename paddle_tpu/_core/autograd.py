@@ -363,6 +363,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False, grad_sink=None,
                 k = ref.out_index
                 s[1][k] = g if s[1][k] is None else s[1][k] + g
         if not retain_graph and not create_graph:
+            # NOT freed under create_graph: the re-traced grad graph's
+            # nodes reference original nodes through their primal-input
+            # InputRefs (a later backward over the grad graph routes
+            # cotangents — zero for linear ops, nonzero otherwise —
+            # through them), so create_graph structurally implies
+            # retain_graph (same coupling as the reference/torch)
             node.vjp_fn = None
             node.fn = None       # free re-trace closures with the residuals
             node.raw = None
@@ -435,6 +441,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
+    if create_graph and retain_graph is not None and not retain_graph:
+        raise ValueError(
+            "retain_graph=False is incompatible with create_graph=True: "
+            "the re-traced gradient graph references the original graph's "
+            "nodes, so it cannot be freed")
     if retain_graph is None:
         retain_graph = create_graph
 

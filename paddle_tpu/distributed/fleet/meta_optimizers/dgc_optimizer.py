@@ -59,18 +59,21 @@ def _k_for(numel: int, s: float) -> int:
     return max(1, min(numel, int(round(numel * (1.0 - s)))))
 
 
-def dgc_compress(g, u, v, *, momentum: float, k: int):
+def dgc_compress(g, u, v, *, momentum: float, k: int,
+                 nesterov: bool = False):
     """Momentum-corrected top-k sparsification with error feedback.
 
-    u' = m*u + g ; v' = v + u' ; select the k largest |v'| entries; the
-    selected entries are communicated and cleared from BOTH accumulators
-    (reference dgc_op.h encode step), the rest stay as local residual.
+    u' = m*u + g ; v' = v + u' (plain momentum) or v' = v + g + m*u'
+    (Nesterov, matching the reference dgc op's use_nesterov branch);
+    select the k largest |v'| entries; the selected entries are
+    communicated and cleared from BOTH accumulators (reference dgc_op.h
+    encode step), the rest stay as local residual.
 
     Returns ``(idx, vals, new_u, new_v)`` with ``idx``/``vals`` of static
     length ``k`` (flat indices into the parameter).
     """
     u = momentum * u + g
-    v = v + u
+    v = v + (g + momentum * u if nesterov else u)
     flat_v = v.reshape(-1)
     _, idx = lax.top_k(jnp.abs(flat_v), k)
     vals = flat_v[idx]
@@ -214,7 +217,7 @@ class DGCMomentumOptimizer(Momentum):
             k = _k_for(numel, sparsity)
             idx, vals, nu, nv = dgc_compress(
                 p.grad._value, u._value, v._value,
-                momentum=self._momentum, k=k)
+                momentum=self._momentum, k=k, nesterov=self._nesterov)
             u._inplace_assign(nu)
             v._inplace_assign(nv)
             synced = dgc_sparse_allreduce(idx, vals, numel, axis=axis)

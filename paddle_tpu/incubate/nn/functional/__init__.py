@@ -5,11 +5,15 @@ fused_moe; CUDA kernels paddle/phi/kernels/fusion/*).
 
 TPU-native: each is a jnp composition designed so XLA fuses it into one or
 few kernels (elementwise chains fold into neighbouring matmuls on the MXU);
-attention routes to the Pallas flash kernel where applicable.
+on TPU the hot three (fused_rms_norm, swiglu, fused_rotary_position_
+embedding) dispatch to the hand-written Pallas kernels in
+``ops/pallas/fused.py`` when the call matches the kernels' fully-fused
+contract; attention routes to the Pallas flash kernel where applicable.
 """
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +21,19 @@ import jax.numpy as jnp
 from ...._core.autograd import apply
 from ...._core.tensor import Tensor
 from ....ops._registry import as_tensor
+
+
+def _use_pallas_fused() -> bool:
+    """Dispatch to the Pallas fused kernels: on TPU always; elsewhere only
+    when forced (interpret mode is correct but slow — tests use the env).
+
+    Device PLATFORM, not backend name: the axon PJRT tunnel registers a
+    backend called "axon" whose devices are real TPU chips (same check as
+    ops/pallas/flash_attention.available)."""
+    if os.environ.get("PADDLE_TPU_FORCE_PALLAS_FUSED") == "1":
+        return True
+    from ....ops.pallas import flash_attention as _fa
+    return _fa.available()
 
 
 __all__ = [
@@ -46,6 +63,22 @@ def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
             args.append(as_tensor(t))
     ax = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
     naxes = tuple(range(ax, x.ndim))
+
+    # fully-fused Pallas path (fused_rms_norm.py's hot shape: norm over the
+    # last axis with a weight, no biases)
+    if (_use_pallas_fused() and norm_bias is None and bias is None
+            and norm_weight is not None and ax == x.ndim - 1):
+        from ....ops.pallas import fused as _pf
+
+        if residual is not None:
+            def fp(v, res, w):
+                return _pf.rms_norm(v, w, float(epsilon), residual=res)
+            return apply(fp, x, as_tensor(residual), as_tensor(norm_weight),
+                         name="fused_rms_norm", multi_out=True)
+
+        def fp(v, w):
+            return _pf.rms_norm(v, w, float(epsilon))
+        return apply(fp, x, as_tensor(norm_weight), name="fused_rms_norm")
 
     def f(v, *rest):
         ct = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
@@ -110,14 +143,26 @@ def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
 
 def swiglu(x, y=None, name=None):
     """reference: incubate/nn/functional/swiglu.py — silu(x) * y; if y is
-    None, x is split in half along the last dim."""
+    None, x is split in half along the last dim. On TPU the two-operand
+    form runs the one-pass Pallas kernel (fused_bias_act swiglu path)."""
     x = as_tensor(x)
     if y is None:
+        if _use_pallas_fused():
+            from ....ops.pallas import fused as _pf
+
+            def fsplit(v):
+                a, b = jnp.split(v, 2, axis=-1)
+                return _pf.swiglu(a, b)
+            return apply(fsplit, x, name="swiglu")
+
         def f(v):
             a, b = jnp.split(v, 2, axis=-1)
             return jax.nn.silu(a.astype(jnp.float32)).astype(v.dtype) * b
         return apply(f, x, name="swiglu")
     y = as_tensor(y)
+    if _use_pallas_fused():
+        from ....ops.pallas import fused as _pf
+        return apply(lambda a, b: _pf.swiglu(a, b), x, y, name="swiglu")
     return apply(
         lambda a, b: jax.nn.silu(a.astype(jnp.float32)).astype(a.dtype) * b,
         x, y, name="swiglu")
@@ -150,6 +195,22 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         expand = lambda c: c[:, :, None, :]
     else:
         expand = lambda c: c[None, :, None, :]
+
+    # fully-fused Pallas path (fused_rope_kernel.cu's hot shape: neox
+    # style, shared tables, q+k in one launch)
+    if (_use_pallas_fused() and use_neox_rotary_style
+            and position_ids is None and q is not None and k is not None
+            and v is None):
+        from ....ops.pallas import fused as _pf
+        # the kernel reads (S, D) tables whose two halves repeat
+        cos_full = jnp.concatenate([cos_t, cos_t], axis=-1)
+        sin_full = jnp.concatenate([sin_t, sin_t], axis=-1)
+
+        def frope(qv, kv):
+            return _pf.rope_qk(qv, kv, cos_full, sin_full)
+        rq, rk = apply(frope, as_tensor(q), as_tensor(k),
+                       name="fused_rope", multi_out=True)
+        return rq, rk, None
 
     def rot(t):
         def f(x):

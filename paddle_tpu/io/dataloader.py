@@ -80,39 +80,131 @@ class _SingleProcessLoaderIter:
 
 
 class _PrefetchLoaderIter:
-    """Thread-prefetching iterator: overlaps host batch assembly with device
-    compute (the reference overlaps via multiprocess workers + pinned
-    memory; on TPU a thread pool suffices because collate is numpy-bound
-    and jax transfers release the GIL)."""
+    """Worker-pool prefetching iterator: ``num_workers`` threads assemble
+    whole batches in parallel and a reorder buffer restores sampler order
+    (reference: io/dataloader/dataloader_iter.py _DataLoaderIterMultiProcess
+    — multiprocess workers + an _order-preserving _task_infos buffer; on
+    TPU threads suffice because sample decode/collate are numpy/IO-bound
+    and release the GIL, while jax transfers also release it).
+
+    IterableDataset keeps a single assembly thread (its iterator protocol
+    is inherently sequential) but still overlaps with device compute."""
 
     def __init__(self, loader, num_workers, prefetch_factor):
-        self.inner = _SingleProcessLoaderIter(loader)
-        self.q: "queue.Queue" = queue.Queue(maxsize=max(
-            2, num_workers * prefetch_factor))
-        self._done = object()
         self._err = None
+        self._lock = threading.Lock()
+        if loader._is_iterable:
+            # sequential source: one producer thread, bounded queue
+            self.inner = _SingleProcessLoaderIter(loader)
+            self.q: "queue.Queue" = queue.Queue(
+                maxsize=max(2, num_workers * prefetch_factor))
+            self._done = object()
 
-        def worker():
+            def worker():
+                try:
+                    for item in self.inner:
+                        self.q.put(item)
+                except Exception as e:  # propagate to consumer
+                    self._err = e
+                finally:
+                    self.q.put(self._done)
+            self.t = threading.Thread(target=worker, daemon=True)
+            self.t.start()
+            self._mode = "stream"
+            return
+
+        self._mode = "pool"
+        self.dataset = loader.dataset
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        # The reference's multiprocess workers each own a dataset COPY;
+        # threads share ONE object, so stateful __getitem__ (shared file
+        # handle seek+read, decode buffers) would corrupt silently under
+        # concurrent fetch.  Default: per-sample fetch is serialized (the
+        # parallel win is collate + overlap with device compute); a
+        # dataset declaring ``thread_safe = True`` unlocks fully parallel
+        # fetch (the built-in array-backed datasets set it).
+        self._fetch_lock = (
+            None if getattr(loader.dataset, "thread_safe", False)
+            else threading.Lock())
+        # sampler consumed LAZILY under the lock: infinite/streaming batch
+        # samplers keep working, and no O(num_batches) index list is held
+        self._sampler_it = iter(loader.batch_sampler)
+        self._exhausted = False
+        self._ntasks = None           # known once the sampler raises Stop
+        self._next_task = 0
+        self._next_out = 0
+        self._buf: dict = {}
+        self._err_seq = None          # batch index the error belongs to
+        self._cap = max(2, num_workers * prefetch_factor)
+        self._cv = threading.Condition(self._lock)
+        self._threads = [threading.Thread(target=self._worker, daemon=True)
+                         for _ in range(max(1, num_workers))]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                # backpressure: don't run more than cap batches ahead
+                while (not self._exhausted and self._err_seq is None
+                       and self._next_task - self._next_out >= self._cap):
+                    self._cv.wait()
+                if self._exhausted or self._err_seq is not None:
+                    return
+                seq = self._next_task
+                try:
+                    indices = next(self._sampler_it)
+                except StopIteration:
+                    self._exhausted = True
+                    self._ntasks = self._next_task
+                    self._cv.notify_all()
+                    return
+                self._next_task += 1
             try:
-                for item in self.inner:
-                    self.q.put(item)
-            except Exception as e:  # propagate to consumer
-                self._err = e
-            finally:
-                self.q.put(self._done)
-        self.t = threading.Thread(target=worker, daemon=True)
-        self.t.start()
+                if self._fetch_lock is not None:
+                    with self._fetch_lock:
+                        samples = [self.dataset[i] for i in indices]
+                else:
+                    samples = [self.dataset[i] for i in indices]
+                batch = self.collate_fn(samples)
+            except Exception as e:
+                with self._cv:
+                    # deliver every earlier batch first: the error is
+                    # raised only when the consumer reaches THIS position
+                    # (matches the old sequential path's determinism)
+                    if self._err_seq is None or seq < self._err_seq:
+                        self._err, self._err_seq = e, seq
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._buf[seq] = batch
+                self._cv.notify_all()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self.q.get()
-        if item is self._done:
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return item
+        if self._mode == "stream":
+            item = self.q.get()
+            if item is self._done:
+                if self._err is not None:
+                    raise self._err
+                raise StopIteration
+            return item
+        with self._cv:
+            while True:
+                if self._err_seq is not None and \
+                        self._next_out == self._err_seq:
+                    raise self._err
+                if self._next_out in self._buf:
+                    batch = self._buf.pop(self._next_out)
+                    self._next_out += 1
+                    self._cv.notify_all()
+                    return batch
+                if self._ntasks is not None and \
+                        self._next_out >= self._ntasks:
+                    raise StopIteration
+                self._cv.wait()
 
 
 class DataLoader:

@@ -10,7 +10,14 @@ import numpy as np
 
 
 class Dataset:
-    """reference: io/dataloader/dataset.py Dataset."""
+    """reference: io/dataloader/dataset.py Dataset.
+
+    Set ``thread_safe = True`` on a subclass whose ``__getitem__`` is safe
+    to call from several threads at once (pure indexing, no shared
+    seek/read state): the DataLoader worker pool then fetches samples
+    fully in parallel instead of serializing the per-sample fetch."""
+
+    thread_safe = False
 
     def __getitem__(self, idx):
         raise NotImplementedError
@@ -31,6 +38,8 @@ class IterableDataset(Dataset):
 
 
 class TensorDataset(Dataset):
+    thread_safe = True   # pure array indexing
+
     def __init__(self, tensors):
         from .._core.tensor import Tensor
         assert all(t.shape[0] == tensors[0].shape[0] for t in tensors)

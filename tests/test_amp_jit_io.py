@@ -163,6 +163,57 @@ def test_dataloader_shuffle_workers():
     assert sorted(seen) == list(range(32))
 
 
+def test_dataloader_pool_preserves_order():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        thread_safe = True   # unlock fully parallel fetch
+
+        def __getitem__(self, i):
+            import time as _t
+            _t.sleep(0.001 * (i % 5))  # uneven per-sample latency
+            return np.float32(i)
+
+        def __len__(self):
+            return 40
+
+    got = []
+    for batch in DataLoader(DS(), batch_size=4, shuffle=False,
+                            num_workers=4):
+        got.extend(batch.numpy().tolist())
+    # ordered delivery despite parallel out-of-order assembly
+    assert got == [float(i) for i in range(40)]
+
+
+def test_dataloader_pool_propagates_error():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            if i == 13:
+                raise ValueError("boom-13")
+            return np.float32(i)
+
+        def __len__(self):
+            return 32
+
+    with pytest.raises(ValueError, match="boom-13"):
+        list(DataLoader(DS(), batch_size=4, shuffle=False, num_workers=3))
+
+
+def test_dataloader_pool_iterable_dataset():
+    from paddle_tpu.io import DataLoader, IterableDataset
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            return iter(np.arange(20, dtype=np.float32))
+
+    out = []
+    for b in DataLoader(Stream(), batch_size=8, num_workers=2):
+        out.extend(b.numpy().tolist())
+    assert out == [float(i) for i in range(20)]
+
+
 def test_tensor_dataset_random_split():
     from paddle_tpu.io import TensorDataset, random_split
     x = paddle.randn([10, 3])
@@ -262,3 +313,75 @@ def test_model_fit_eval_predict(tmp_path):
     assert preds[0].shape == (64, 2)
     model.save(str(tmp_path / "ck"))
     model.load(str(tmp_path / "ck"))
+
+
+def test_dataloader_pool_infinite_sampler():
+    # streaming batch_sampler: the pool must consume it lazily
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 8
+
+    def infinite_sampler():
+        i = 0
+        while True:
+            yield [i % 8, (i + 1) % 8]
+            i += 1
+
+    dl = DataLoader(DS(), batch_sampler=infinite_sampler(), num_workers=3)
+    it = iter(dl)
+    got = [next(it).numpy().tolist() for _ in range(5)]
+    assert got == [[0.0, 1.0], [1.0, 2.0], [2.0, 3.0], [3.0, 4.0],
+                   [4.0, 5.0]]
+
+
+def test_dataloader_pool_error_after_earlier_batches():
+    # every batch BEFORE the failing one is delivered first, always
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            import time as _t
+            if i == 8:
+                raise ValueError("boom-8")
+            _t.sleep(0.005)   # earlier samples are SLOWER than the failure
+            return np.float32(i)
+
+        def __len__(self):
+            return 16
+
+    dl = DataLoader(DS(), batch_size=4, shuffle=False, num_workers=4)
+    it = iter(dl)
+    assert next(it).numpy().tolist() == [0.0, 1.0, 2.0, 3.0]
+    assert next(it).numpy().tolist() == [4.0, 5.0, 6.0, 7.0]
+    with pytest.raises(ValueError, match="boom-8"):
+        next(it)
+
+
+def test_dataloader_pool_serializes_stateful_dataset():
+    # default (no thread_safe flag): shared seek/read state stays correct
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class StatefulDS(Dataset):
+        def __init__(self):
+            self.pos = None
+
+        def __getitem__(self, i):
+            import time as _t
+            self.pos = i          # "seek"
+            _t.sleep(0.001)       # interleave window
+            assert self.pos == i  # "read" sees its own seek
+            return np.float32(self.pos)
+
+        def __len__(self):
+            return 32
+
+    got = []
+    for b in DataLoader(StatefulDS(), batch_size=4, shuffle=False,
+                        num_workers=4):
+        got.extend(b.numpy().tolist())
+    assert got == [float(i) for i in range(32)]

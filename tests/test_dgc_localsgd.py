@@ -416,3 +416,33 @@ class TestFleetStrategyWiring:
                  ("_inner_opt", "_optimizer", "optimizer")]
         assert any(isinstance(o, DGCMomentumOptimizer) for o in inner
                    if o is not None)
+
+
+class TestDGCNesterov:
+    def test_nesterov_accumulation_formula(self):
+        g = jnp.asarray(np.array([1.0, 2.0], np.float32))
+        u0 = jnp.asarray(np.array([0.5, -0.5], np.float32))
+        m = 0.9
+        _, _, _, nv = dgc_compress(g, u0, jnp.zeros(2), momentum=m, k=0 + 1,
+                                   nesterov=True)
+        u1 = m * u0 + g
+        acc = g + m * u1
+        # position NOT selected keeps the nesterov accumulation
+        keep = int(np.argmin(np.abs(np.asarray(acc))))
+        np.testing.assert_allclose(np.asarray(nv)[keep],
+                                   np.asarray(acc)[keep], rtol=1e-6)
+
+    def test_nesterov_converges(self):
+        w = paddle.to_tensor(np.random.RandomState(7).randn(20000)
+                             .astype(np.float32) * 0.1)
+        w.stop_gradient = False
+        opt = DGCMomentumOptimizer(learning_rate=0.01, momentum=0.9,
+                                   use_nesterov=True, rampup_begin_step=0,
+                                   sparsity=[0.9], parameters=[w])
+        first = None
+        for _ in range(80):
+            loss = (w * w).sum()
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward(); opt.step(); opt.clear_grad()
+        assert float((w * w).sum().numpy()) < 0.05 * first

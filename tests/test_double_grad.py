@@ -143,3 +143,18 @@ class TestPyLayerDoubleGrad:
         (g2,) = pgrad(y2, [x], grad_outputs=v, create_graph=True)
         (gv,) = pgrad(g2.sum(), [v])
         np.testing.assert_allclose(gv.numpy(), [6.0], rtol=1e-6)
+
+
+def test_create_graph_rejects_explicit_no_retain():
+    # the re-traced grad graph references the original graph's nodes, so
+    # create_graph structurally implies retain_graph — the contradictory
+    # combination is an explicit error, not a silent override
+    x = _t([2.0])
+    y = (x * x).sum()
+    with pytest.raises(ValueError, match="incompatible"):
+        pgrad(y, [x], create_graph=True, retain_graph=False)
+    # and the graph is still usable afterwards
+    (g,) = pgrad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [4.0], rtol=1e-6)
+    (g2,) = pgrad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), [2.0], rtol=1e-6)

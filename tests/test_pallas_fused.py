@@ -123,3 +123,94 @@ def test_decode_attention_matches_ref(hk):
     p = jax.nn.softmax(s, axis=-1)
     ref = jnp.einsum("bhs,bshd->bhd", p, vr)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ---- incubate dispatch: the public fused APIs route to these kernels ----
+class TestIncubateDispatch:
+    """PADDLE_TPU_FORCE_PALLAS_FUSED=1 forces the Pallas path (interpret
+    mode on CPU); outputs and grads must match the jnp composition."""
+
+    def _forced(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS_FUSED", "1")
+
+    def test_fused_rms_norm_dispatch(self, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import functional as F
+        rs = np.random.RandomState(0)
+        xv = rs.randn(4, 64).astype(np.float32)
+        wv = rs.randn(64).astype(np.float32)
+
+        def run():
+            x = paddle.to_tensor(xv.copy()); x.stop_gradient = False
+            w = paddle.to_tensor(wv.copy()); w.stop_gradient = False
+            out = F.fused_rms_norm(x, norm_weight=w, epsilon=1e-6)
+            out.sum().backward()
+            return out.numpy(), x.grad.numpy(), w.grad.numpy()
+
+        o1, gx1, gw1 = run()                       # jnp path
+        self._forced(monkeypatch)
+        o2, gx2, gw2 = run()                       # pallas path
+        np.testing.assert_allclose(o1, o2, atol=2e-5)
+        np.testing.assert_allclose(gx1, gx2, atol=2e-4)
+        np.testing.assert_allclose(gw1, gw2, atol=2e-4)
+
+    def test_fused_rms_norm_residual_dispatch(self, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import functional as F
+        rs = np.random.RandomState(1)
+        xv = rs.randn(3, 32).astype(np.float32)
+        rv = rs.randn(3, 32).astype(np.float32)
+        wv = rs.randn(32).astype(np.float32)
+
+        def run():
+            x = paddle.to_tensor(xv.copy())
+            out, res = F.fused_rms_norm(
+                x, norm_weight=paddle.to_tensor(wv.copy()),
+                residual=paddle.to_tensor(rv.copy()))
+            return out.numpy(), res.numpy()
+
+        o1, r1 = run()
+        self._forced(monkeypatch)
+        o2, r2 = run()
+        np.testing.assert_allclose(o1, o2, atol=2e-5)
+        np.testing.assert_allclose(r1, r2, atol=2e-5)
+
+    def test_swiglu_dispatch(self, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import functional as F
+        rs = np.random.RandomState(2)
+        xv = rs.randn(4, 64).astype(np.float32)
+
+        def run():
+            x = paddle.to_tensor(xv.copy()); x.stop_gradient = False
+            out = F.swiglu(x)                       # split form
+            out.sum().backward()
+            return out.numpy(), x.grad.numpy()
+
+        o1, g1 = run()
+        self._forced(monkeypatch)
+        o2, g2 = run()
+        np.testing.assert_allclose(o1, o2, atol=2e-5)
+        np.testing.assert_allclose(g1, g2, atol=2e-4)
+
+    def test_fused_rope_dispatch(self, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import functional as F
+        rs = np.random.RandomState(3)
+        qv = rs.randn(2, 8, 2, 16).astype(np.float32)
+        kv = rs.randn(2, 8, 2, 16).astype(np.float32)
+
+        def run():
+            q = paddle.to_tensor(qv.copy()); q.stop_gradient = False
+            k = paddle.to_tensor(kv.copy())
+            rq, rk, rv_ = F.fused_rotary_position_embedding(q, k)
+            rq.sum().backward()
+            assert rv_ is None
+            return rq.numpy(), rk.numpy(), q.grad.numpy()
+
+        q1, k1, g1 = run()
+        self._forced(monkeypatch)
+        q2, k2, g2 = run()
+        np.testing.assert_allclose(q1, q2, atol=2e-5)
+        np.testing.assert_allclose(k1, k2, atol=2e-5)
+        np.testing.assert_allclose(g1, g2, atol=2e-4)
