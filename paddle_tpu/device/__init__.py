@@ -165,5 +165,89 @@ class cuda:
         return cuda.memory_allocated(device)
 
 
+class Event:
+    """reference: python/paddle/device/cuda/streams.py Event (pybind
+    core.CudaEvent). XLA owns device-stream scheduling, so an event is a
+    host-side sync point: ``record()`` drains outstanding work and
+    timestamps; ``elapsed_time`` is wall-clock between two records —
+    the same contract the reference's enable_timing events provide."""
+
+    def __init__(self, enable_timing=True, blocking=False, interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time as _time
+        cuda.synchronize()
+        self._t = _time.perf_counter()
+
+    def query(self) -> bool:
+        return True  # recorded work was drained synchronously
+
+    def synchronize(self):
+        pass
+
+    def elapsed_time(self, end_event) -> float:
+        if self._t is None or end_event._t is None:
+            raise RuntimeError("both events must be recorded before "
+                               "elapsed_time")
+        return (end_event._t - self._t) * 1000.0  # ms, reference contract
+
+
+class Stream:
+    """reference: device/cuda/streams.py Stream. On TPU, XLA compiles its
+    own schedule and exposes no user streams; this carries the API so
+    stream-annotated reference code runs unchanged (everything executes
+    on the single implicit compute stream)."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        cuda.synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def stream_guard(stream):
+    """reference: device/__init__.py stream_guard — a no-op scope on TPU
+    (one implicit stream), kept so reference code structure ports."""
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    try:
+        yield
+    finally:
+        _current_stream = prev
+
+
+cuda.Event = Event
+cuda.Stream = Stream
+cuda.current_stream = staticmethod(current_stream)
+cuda.stream_guard = staticmethod(stream_guard)
+
+
 def synchronize(device=None):
     cuda.synchronize(device)

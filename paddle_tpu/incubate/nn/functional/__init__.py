@@ -24,14 +24,22 @@ from ....ops._registry import as_tensor
 
 
 def _use_pallas_fused() -> bool:
-    """Dispatch to the Pallas fused kernels: on TPU always; elsewhere only
-    when forced (interpret mode is correct but slow — tests use the env).
+    """Dispatch to the Pallas fused kernels: on TPU by default (these
+    APIs' contract IS the fused kernel); elsewhere only when forced
+    (interpret mode is correct but slow — tests use the env).
+
+    ``PADDLE_TPU_FORCE_PALLAS_FUSED=1`` forces the kernels anywhere;
+    ``=0`` opts out everywhere (fall back to the XLA-fused jnp
+    composition, e.g. after a bench shows it faster on a given shape).
 
     Device PLATFORM, not backend name: the axon PJRT tunnel registers a
     backend called "axon" whose devices are real TPU chips (same check as
     ops/pallas/flash_attention.available)."""
-    if os.environ.get("PADDLE_TPU_FORCE_PALLAS_FUSED") == "1":
+    force = os.environ.get("PADDLE_TPU_FORCE_PALLAS_FUSED")
+    if force == "1":
         return True
+    if force == "0":
+        return False
     from ....ops.pallas import flash_attention as _fa
     return _fa.available()
 

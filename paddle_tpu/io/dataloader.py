@@ -79,6 +79,27 @@ class _SingleProcessLoaderIter:
         return self.collate_fn(batch)
 
 
+def _pool_worker_main(ref, wake):
+    """Worker thread entry: holds NO strong reference to the iterator
+    while idle (backpressure waits happen HERE, on the shared ``wake``
+    event, after dropping the ref), so a consumer that abandons
+    iteration lets the iterator be garbage-collected and the pool wind
+    down within one wait timeout."""
+    while True:
+        it = ref()
+        if it is None:
+            return
+        try:
+            status = it._worker_step_nowait()
+        finally:
+            del it
+        if status == "exit":
+            return
+        if status == "idle":
+            wake.wait(timeout=0.2)
+            wake.clear()
+
+
 class _PrefetchLoaderIter:
     """Worker-pool prefetching iterator: ``num_workers`` threads assemble
     whole batches in parallel and a reorder buffer restores sampler order
@@ -135,50 +156,82 @@ class _PrefetchLoaderIter:
         self._next_out = 0
         self._buf: dict = {}
         self._err_seq = None          # batch index the error belongs to
+        self._stop = False
         self._cap = max(2, num_workers * prefetch_factor)
         self._cv = threading.Condition(self._lock)
-        self._threads = [threading.Thread(target=self._worker, daemon=True)
-                         for _ in range(max(1, num_workers))]
+        # workers hold only a WEAKREF to the iterator and re-check it
+        # between steps (bounded waits): abandoning the iterator (break,
+        # early return) lets it be collected, upon which every worker
+        # exits — no thread/batch leak per epoch
+        import weakref
+        ref = weakref.ref(self)
+        self._wake = threading.Event()
+        self._threads = [
+            threading.Thread(target=_pool_worker_main,
+                             args=(ref, self._wake), daemon=True)
+            for _ in range(max(1, num_workers))]
         for t in self._threads:
             t.start()
 
-    def _worker(self):
-        while True:
-            with self._cv:
-                # backpressure: don't run more than cap batches ahead
-                while (not self._exhausted and self._err_seq is None
-                       and self._next_task - self._next_out >= self._cap):
-                    self._cv.wait()
-                if self._exhausted or self._err_seq is not None:
-                    return
-                seq = self._next_task
-                try:
-                    indices = next(self._sampler_it)
-                except StopIteration:
-                    self._exhausted = True
-                    self._ntasks = self._next_task
-                    self._cv.notify_all()
-                    return
-                self._next_task += 1
+    def close(self):
+        """Stop the worker pool (idempotent; called on exhaustion/error
+        delivery and usable explicitly after early loop exit)."""
+        if self._mode != "pool":
+            return
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._wake.set()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _worker_step_nowait(self):
+        """One NON-BLOCKING worker iteration: "work" (did a batch),
+        "idle" (backpressure — caller waits WITHOUT holding us), or
+        "exit"."""
+        with self._cv:
+            if self._stop or self._exhausted or self._err_seq is not None:
+                return "exit"
+            # backpressure: don't run more than cap batches ahead
+            if self._next_task - self._next_out >= self._cap:
+                return "idle"
+            seq = self._next_task
             try:
-                if self._fetch_lock is not None:
-                    with self._fetch_lock:
-                        samples = [self.dataset[i] for i in indices]
-                else:
-                    samples = [self.dataset[i] for i in indices]
-                batch = self.collate_fn(samples)
-            except Exception as e:
-                with self._cv:
-                    # deliver every earlier batch first: the error is
-                    # raised only when the consumer reaches THIS position
-                    # (matches the old sequential path's determinism)
-                    if self._err_seq is None or seq < self._err_seq:
-                        self._err, self._err_seq = e, seq
-                    self._cv.notify_all()
-                return
-            with self._cv:
-                self._buf[seq] = batch
+                indices = next(self._sampler_it)
+            except StopIteration:
+                self._exhausted = True
+                self._ntasks = self._next_task
                 self._cv.notify_all()
+                return "exit"
+            except Exception as e:   # buggy sampler: surface, don't hang
+                self._err, self._err_seq = e, self._next_task
+                self._cv.notify_all()
+                return "exit"
+            self._next_task += 1
+        try:
+            if self._fetch_lock is not None:
+                with self._fetch_lock:
+                    samples = [self.dataset[i] for i in indices]
+            else:
+                samples = [self.dataset[i] for i in indices]
+            batch = self.collate_fn(samples)
+        except Exception as e:
+            with self._cv:
+                # deliver every earlier batch first: the error is
+                # raised only when the consumer reaches THIS position
+                # (matches the old sequential path's determinism)
+                if self._err_seq is None or seq < self._err_seq:
+                    self._err, self._err_seq = e, seq
+                self._cv.notify_all()
+            return "exit"
+        with self._cv:
+            self._buf[seq] = batch
+            self._cv.notify_all()
+        return "work"
 
     def __iter__(self):
         return self
@@ -195,14 +248,19 @@ class _PrefetchLoaderIter:
             while True:
                 if self._err_seq is not None and \
                         self._next_out == self._err_seq:
+                    self._stop = True
+                    self._cv.notify_all()
                     raise self._err
                 if self._next_out in self._buf:
                     batch = self._buf.pop(self._next_out)
                     self._next_out += 1
                     self._cv.notify_all()
+                    self._wake.set()   # capacity freed: rouse idle workers
                     return batch
                 if self._ntasks is not None and \
                         self._next_out >= self._ntasks:
+                    self._stop = True
+                    self._cv.notify_all()
                     raise StopIteration
                 self._cv.wait()
 

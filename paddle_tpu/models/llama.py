@@ -56,6 +56,12 @@ class LlamaConfig:
     # attention outputs (skip the expensive flash recompute in backward),
     # "dots" = save all matmul outputs (max speed, max memory)
     remat_policy: str = "nothing"
+    # rms_norm/rope/swiglu implementation: "xla" (default) = jnp left to
+    # XLA fusion — measured best on the headline bench; "auto" = Pallas
+    # kernels (ops/pallas/fused.py) on TPU; "pallas" forces the kernels
+    # (interpret mode off-TPU — tests). Flip the default only with a
+    # sweep (tools/perf_sweep.py b4_pallas) showing >= parity.
+    fused_kernels: str = "xla"
     moe: Optional["_moe.MoEConfig"] = None  # experts replace the dense MLP
 
     @property
@@ -190,7 +196,17 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 # ---------------- building blocks ----------------
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def _pallas_fused(cfg: "LlamaConfig") -> bool:
+    if cfg.fused_kernels == "pallas":
+        return True
+    return cfg.fused_kernels == "auto" and _fa.available()
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             pallas: bool = False) -> jax.Array:
+    if pallas:
+        from ..ops.pallas import fused as _pf
+        return _pf.rms_norm(x, w, eps)
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
@@ -260,12 +276,20 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
             t, NamedSharding(mesh_axes["mesh"],
                              P(mesh_axes["data"], cp, mesh_axes["tp"])))
 
-    h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    fused = _pallas_fused(cfg)
+    h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps, pallas=fused)
     q = tpact(h1 @ lp["wq"]).reshape(B, S, nh, hd)
     k = tpact(h1 @ lp["wk"]).reshape(B, S, nkv, hd)
     v = tpact(h1 @ lp["wv"]).reshape(B, S, nkv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if fused:
+        from ..ops.pallas import fused as _pf
+        # the kernel reads (S, hd) tables whose two halves repeat
+        cos_f = jnp.concatenate([cos, cos], axis=-1)
+        sin_f = jnp.concatenate([sin, sin], axis=-1)
+        q, k = _pf.rope_qk(q, k, cos_f, sin_f)
+    else:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     if cp:
         from jax import shard_map
         from ..distributed.fleet.meta_parallel.context_parallel import (
@@ -282,7 +306,7 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
     o = checkpoint_name(o, "attn_out")
     x = sp(x + o @ lp["wo"])
 
-    h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps, pallas=fused)
     if cfg.moe is not None:
         ff, losses = _moe.moe_ffn(
             h2, {"w_gate": lp["moe_gate"], "wg": lp["moe_wg"],
@@ -292,8 +316,12 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
     else:
         g = tpact(h2 @ lp["wg"])
         u = tpact(h2 @ lp["wu"])
-        ff = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype)
-              * u) @ lp["wd"]
+        if fused:
+            from ..ops.pallas import fused as _pf
+            ff = _pf.swiglu(g, u) @ lp["wd"]
+        else:
+            ff = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype)
+                  * u) @ lp["wd"]
         aux = jnp.float32(0.0)
     return sp(x + ff), aux
 
@@ -321,7 +349,8 @@ def _trunk(params, tokens, cfg: LlamaConfig, mesh_axes=None):
         return x, aux
 
     x, auxs = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps,
+                 pallas=_pallas_fused(cfg))
     return x, jnp.sum(auxs)
 
 

@@ -44,4 +44,5 @@ from .layer.rnn import (  # noqa: F401
 from . import functional  # noqa: F401
 from . import quant  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .clip_grad import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,191 @@
+"""nn.utils — reparametrization hooks + parameter transforms.
+
+Reference: python/paddle/nn/utils/ — weight_norm_hook.py (weight_norm /
+remove_weight_norm), spectral_norm_hook.py (spectral_norm),
+transform_parameters.py (parameters_to_vector / vector_to_parameters),
+clip_grad_norm_.py / clip_grad_value_.py (re-exported from nn.clip_grad).
+
+TPU-native: reparametrizations are forward pre-hooks recomputing the
+effective weight from the decomposed parameters each call — the recompute
+is a handful of elementwise/reduce ops XLA folds into the consumer matmul,
+so there is no cached-weight staleness to manage (the reference caches and
+recomputes via the same hook mechanism).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, Parameter
+from ..._core.autograd import no_grad
+from ...ops._registry import as_tensor
+from ..clip_grad import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except_dim(w, dim):
+    """L2 norm over every axis except ``dim`` (dim=None: global norm),
+    shaped to broadcast back against w (reference weight_norm_hook.py
+    norm_except_dim)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(a for a in range(w.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def _compute_weight(layer, name):
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    dim = layer.__dict__["_weight_norm_dim_" + name]
+    from ..._core.autograd import apply as _apply
+    return _apply(
+        lambda vv, gv: vv * (gv / _norm_except_dim(vv, dim)),
+        v, g, name="weight_norm")
+
+
+def weight_norm(layer, name: str = "weight", dim=0):
+    """reference: nn/utils/weight_norm_hook.py weight_norm — decompose
+    ``layer.<name>`` into direction ``<name>_v`` and magnitude
+    ``<name>_g`` (w = g * v / ||v||), recomputed by a forward pre-hook."""
+    if hasattr(layer, "_weight_norm_hook_" + name):
+        raise RuntimeError(f"weight_norm already applied to '{name}'")
+    w = getattr(layer, name)
+    wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    gv = _norm_except_dim(wv, dim)
+
+    del layer._parameters[name]
+    g = Parameter(gv)
+    v = Parameter(wv)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    layer.__dict__["_weight_norm_dim_" + name] = dim
+
+    def hook(lay, inputs):
+        object.__setattr__(lay, name, _compute_weight(lay, name))
+        return None
+
+    helper = layer.register_forward_pre_hook(hook)
+    layer.__dict__["_weight_norm_hook_" + name] = helper
+    # materialize once so layer.<name> is usable before the first forward
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """reference: weight_norm_hook.py remove_weight_norm — fold g*v/||v||
+    back into a single parameter and drop the hook."""
+    helper = layer.__dict__.pop("_weight_norm_hook_" + name, None)
+    if helper is None:
+        raise ValueError(f"weight_norm was not applied to '{name}'")
+    helper.remove()
+    with no_grad():
+        w = _compute_weight(layer, name)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.__dict__.pop("_weight_norm_dim_" + name, None)
+    # drop the hook-materialized __dict__ entry so the restored parameter
+    # is visible through normal attribute lookup again
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w._value))
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim=None):
+    """reference: nn/utils/spectral_norm_hook.py — divide the weight by
+    its largest singular value, estimated by power iteration on
+    persistent u/v buffers updated each forward (training-mode update,
+    like the reference's SpectralNorm kernel)."""
+    if hasattr(layer, "_spectral_norm_hook_" + name):
+        raise RuntimeError(f"spectral_norm already applied to '{name}'")
+    if dim is None:
+        # reference default: dim 1 for Linear (out_features last), else 0
+        dim = 1 if type(layer).__name__ in ("Linear",) else 0
+    w = getattr(layer, name)
+    wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    h = wv.shape[dim]
+
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(wv))
+    import numpy as _np
+    rng = _np.random.RandomState(0)
+    layer.register_buffer(
+        name + "_u", Tensor(jnp.asarray(
+            rng.normal(size=(h,)).astype(_np.float32)), _internal=True))
+    layer.__dict__["_spectral_norm_dim_" + name] = dim
+
+    def compute(lay, update_u):
+        worig = getattr(lay, name + "_orig")
+        u_t = getattr(lay, name + "_u")
+        d = lay.__dict__["_spectral_norm_dim_" + name]
+
+        def flat2d(wm):
+            if d != 0:
+                perm = (d,) + tuple(a for a in range(wm.ndim) if a != d)
+                return jnp.transpose(wm, perm).reshape(h, -1)
+            return wm.reshape(h, -1)
+
+        # power iteration on detached values (u/v are constants in the
+        # backward, the SN-GAN convention the reference follows)
+        wm2 = flat2d(worig._value)
+        u = u_t._value
+        v = None
+        for _ in range(max(1, n_power_iterations)):
+            v = wm2.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm2 @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        if update_u and lay.training:
+            u_t._inplace_assign(u)
+
+        from ..._core.autograd import apply as _apply
+
+        # sigma = u^T W v INSIDE the traced fn: d(W/sigma)/dW keeps the
+        # -(W u v^T)/sigma^2 term (reference spectral_norm_hook backward)
+        def f(ww):
+            sigma = u @ (flat2d(ww) @ v)
+            return ww / sigma
+
+        return _apply(f, worig, name="spectral_norm")
+
+    def hook(lay, inputs):
+        object.__setattr__(lay, name, compute(lay, update_u=True))
+        return None
+
+    helper = layer.register_forward_pre_hook(hook)
+    layer.__dict__["_spectral_norm_hook_" + name] = helper
+    object.__setattr__(layer, name, compute(layer, update_u=False))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """reference: nn/utils/transform_parameters.py parameters_to_vector —
+    flatten and concatenate into one 1-D tensor."""
+    from ...ops.manipulation import concat, reshape
+    parts = [reshape(as_tensor(p), [-1]) for p in parameters]
+    return concat(parts, axis=0)
+
+
+@no_grad()
+def vector_to_parameters(vec, parameters):
+    """reference: transform_parameters.py vector_to_parameters — slice the
+    vector back into the parameter tensors IN PLACE."""
+    vec = as_tensor(vec)
+    parameters = list(parameters)
+    sizes = []
+    for p in parameters:
+        n = 1
+        for d in p.shape:
+            n *= int(d)
+        sizes.append(n)
+    if sum(sizes) != vec._value.size:
+        raise ValueError(
+            f"vector has {vec._value.size} elements but parameters "
+            f"consume {sum(sizes)}")
+    off = 0
+    for p, n in zip(parameters, sizes):
+        chunk = vec._value[off:off + n].reshape(tuple(p.shape))
+        p._inplace_assign(chunk.astype(p._value.dtype))
+        off += n

@@ -385,3 +385,56 @@ def test_dataloader_pool_serializes_stateful_dataset():
                         num_workers=4):
         got.extend(b.numpy().tolist())
     assert got == [float(i) for i in range(32)]
+
+
+def test_dataloader_pool_buggy_sampler_raises():
+    # a sampler that raises mid-stream must surface, not hang
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 8
+
+    def buggy():
+        yield [0, 1]
+        raise TypeError("bad sampler")
+
+    dl = DataLoader(DS(), batch_sampler=buggy(), num_workers=2)
+    it = iter(dl)
+    assert next(it).numpy().tolist() == [0.0, 1.0]
+    with pytest.raises(TypeError, match="bad sampler"):
+        next(it)
+
+
+def test_dataloader_pool_abandoned_iterator_winds_down():
+    import gc
+    import threading
+    import time
+    import weakref
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        thread_safe = True
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 10000
+
+    before = threading.active_count()
+    it = iter(DataLoader(DS(), batch_size=4, shuffle=False, num_workers=4))
+    next(it)
+    ref = weakref.ref(it)
+    del it          # abandon mid-iteration
+    gc.collect()
+    deadline = time.time() + 5
+    while time.time() < deadline and (
+            ref() is not None or threading.active_count() > before):
+        time.sleep(0.1)
+        gc.collect()
+    assert ref() is None            # iterator was collectable
+    assert threading.active_count() <= before + 1   # workers exited

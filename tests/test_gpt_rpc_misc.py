@@ -137,3 +137,26 @@ class TestMiscNamespaces:
         assert out.endswith(".pdmodel")
         with pytest.raises(RuntimeError, match="stablehlo"):
             onnx.export(net, str(tmp_path / "m2"), format="onnx")
+
+
+# ---- device streams/events (reference: device/cuda/streams.py) ----
+def test_device_stream_event_parity():
+    import time
+    import paddle_tpu as paddle
+    s = paddle.device.cuda.Stream()
+    e1 = paddle.device.Event()
+    e2 = paddle.device.Event()
+    e1.record()
+    time.sleep(0.03)
+    e2.record()
+    dt = e1.elapsed_time(e2)
+    assert 10 < dt < 2000
+    with paddle.device.stream_guard(s):
+        assert paddle.device.current_stream() is s
+    assert paddle.device.current_stream() is not s
+    assert s.query() and e1.query()
+    ev = s.record_event()
+    assert ev.query()
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        paddle.device.Event().elapsed_time(paddle.device.Event())

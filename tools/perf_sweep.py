@@ -19,7 +19,8 @@ cfg = llama.LlamaConfig(
     vocab_size=32000, hidden_size=1536, intermediate_size=4096,
     num_layers=20, num_heads=12, num_kv_heads=12, max_seq_len=4096,
     dtype=jnp.bfloat16, remat=variant.get("remat", True),
-    remat_policy=variant.get("policy", "nothing"))
+    remat_policy=variant.get("policy", "nothing"),
+    fused_kernels=variant.get("fused", "xla"))
 batch = variant.get("batch", 4)
 seq = 4096
 step = train.make_train_step(cfg, seq_chunk=variant.get("seq_chunk", 512))
@@ -50,6 +51,9 @@ VARIANTS = [
     {"name": "b4_dots", "batch": 4, "policy": "dots"},
     {"name": "b4_chunk1024", "batch": 4, "policy": "nothing",
      "seq_chunk": 1024},
+    {"name": "b4_pallas", "batch": 4, "policy": "nothing", "fused": "auto"},
+    {"name": "b4_attn_pallas", "batch": 4, "policy": "attn",
+     "fused": "auto"},
 ]
 
 
