@@ -76,3 +76,43 @@ from .static import (  # noqa: E402
 )
 from .jit.api import to_static  # noqa: E402  (paddle.jit.to_static)
 from ._core.dtype import convert_dtype  # noqa: E402
+
+# reference top-level odds and ends (python/paddle/__init__.py __all__)
+newaxis = None  # paddle.newaxis — numpy-style indexing alias
+from .nn.initializer.initializer import ParamAttr  # noqa: E402,F401
+from .utils.dlpack import to_dlpack, from_dlpack  # noqa: E402,F401
+# CUDA rng-state names map onto the device generator (single RNG stream)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: python/paddle/tensor/creation.py create_parameter — a
+    directly-created Parameter (initializer from attr/default, else
+    Xavier for weights / zeros for bias like the reference)."""
+    from .nn.initializer import XavierNormal, Constant
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    p = Parameter(init(tuple(shape), _dtype_mod.convert_dtype(dtype)))
+    if name or (attr is not None and getattr(attr, "name", None)):
+        p.name = name or attr.name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: python/paddle/reader/decorator.py batch — wrap a sample
+    reader into a batch reader (legacy data pipeline)."""
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader

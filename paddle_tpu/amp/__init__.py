@@ -11,3 +11,15 @@ from .auto_cast import (  # noqa: F401
 )
 from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
 from . import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None):
+    """reference: amp/auto_cast.py is_float16_supported — TPUs compute in
+    bf16 natively; fp16 storage is supported but not MXU-preferred."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """reference: amp/auto_cast.py is_bfloat16_supported — bf16 is the
+    native TPU matmul dtype."""
+    return True

@@ -4,3 +4,5 @@ functional/ (window functions, mel utilities), backends (wave IO))."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
 from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import load, save, info  # noqa: F401

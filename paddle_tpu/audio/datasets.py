@@ -1,0 +1,115 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ —
+AudioClassificationDataset base, TESS, ESC50).
+
+Same offline contract as paddle_tpu.text.datasets: pass an on-disk
+archive dir; downloads are disabled in this environment.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from . import backends as _backends
+from .features import MelSpectrogram
+
+
+class AudioClassificationDataset(Dataset):
+    """reference: audio/datasets/dataset.py — wav files + labels, with
+    optional on-the-fly feature extraction (raw | melspectrogram)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = 16000,
+                 **feat_kwargs):
+        if feat_type not in ("raw", "melspectrogram"):
+            raise ValueError(f"unsupported feat_type {feat_type!r}")
+        if len(files) != len(labels):
+            raise ValueError("files and labels must align")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat = (None if feat_type == "raw" else
+                      MelSpectrogram(sr=sample_rate, **feat_kwargs))
+
+    def _load(self, path) -> np.ndarray:
+        wav, _sr = _backends.load(path)
+        arr = wav.numpy() if hasattr(wav, "numpy") else np.asarray(wav)
+        return arr[0] if arr.ndim == 2 else arr
+
+    def __getitem__(self, idx) -> Tuple[np.ndarray, np.int64]:
+        sig = self._load(self.files[idx]).astype(np.float32)
+        if self._feat is not None:
+            from .._core.tensor import Tensor
+            sig = self._feat(Tensor(sig[None])).numpy()[0]
+        return sig, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """reference: audio/datasets/tess.py — Toronto emotional speech set:
+    7 emotions, 200 target words, 2 actresses; label = emotion index."""
+
+    labels_list = ["angry", "disgust", "fear", "happy", "neutral",
+                   "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 archive_dir: Optional[str] = None, **kwargs):
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        if archive_dir is None:
+            raise FileNotFoundError(
+                "TESS: downloads are disabled in this environment; pass "
+                "archive_dir=<path to the extracted TESS wav tree>")
+        files, labels = [], []
+        for root, _dirs, names in sorted(os.walk(archive_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                emo = n.split("_")[-1][:-4].lower()
+                if emo in self.labels_list:
+                    files.append(os.path.join(root, n))
+                    labels.append(self.labels_list.index(emo))
+        # fold split by index (reference: ranks files into n_folds)
+        sel_f, sel_l = [], []
+        for i, (f, l) in enumerate(zip(files, labels)):
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                sel_f.append(f)
+                sel_l.append(l)
+        super().__init__(sel_f, sel_l, feat_type=feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """reference: audio/datasets/esc50.py — 2000 environmental sounds in
+    50 classes, 5 predefined folds encoded in the file names
+    (fold-srcfile-take-label.wav)."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw",
+                 archive_dir: Optional[str] = None, **kwargs):
+        if archive_dir is None:
+            raise FileNotFoundError(
+                "ESC50: downloads are disabled in this environment; pass "
+                "archive_dir=<path to the extracted ESC-50 audio dir>")
+        files, labels = [], []
+        for root, _dirs, names in sorted(os.walk(archive_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                parts = n[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, label = int(parts[0]), int(parts[3])
+                keep = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if keep:
+                    files.append(os.path.join(root, n))
+                    labels.append(label)
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

@@ -251,3 +251,24 @@ cuda.stream_guard = staticmethod(stream_guard)
 
 def synchronize(device=None):
     cuda.synchronize(device)
+
+
+def get_cudnn_version():
+    """reference: device/__init__.py get_cudnn_version — None when the
+    runtime has no cuDNN (always, on TPU)."""
+    return None
+
+
+def get_all_custom_device_type():
+    """reference: device/__init__.py — no out-of-tree device plugins."""
+    return []
+
+
+def set_stream(stream=None):
+    """reference: device/__init__.py set_stream — XLA owns the schedule;
+    returns the (single) previous stream for API compatibility."""
+    global _current_stream
+    prev = _current_stream
+    if stream is not None:
+        _current_stream = stream
+    return prev

@@ -43,6 +43,34 @@ from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
 from .utils import moe_utils  # noqa: F401
 from .fleet.fleet import fleet as _fleet_facade  # noqa: F401
+from .checkpoint.api import save_state_dict, load_state_dict  # noqa: F401
+from .extras import (  # noqa: F401
+    get_backend, is_available, wait, ReduceType, ParallelMode,
+    all_gather_object, broadcast_object_list, scatter_object_list,
+    dtensor_from_fn, ShardingStage1, ShardingStage2, ShardingStage3,
+    DistAttr, shard_dataloader, shard_scaler, split,
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+)
+from . import io  # noqa: F401
+from .auto_parallel.strategy import Strategy  # noqa: F401
+from .auto_parallel.dist_model import DistModel, to_static  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: parallel_with_gloo.py — gloo's CPU-collective role is
+    played by the XLA CPU backend here; rendezvous is jax.distributed."""
+    from .mesh import init_parallel_env as _ipe
+    _ipe()
+
+
+def gloo_barrier():
+    from .mesh import barrier as _b
+    _b()
+
+
+def gloo_release():
+    pass
 
 
 def get_mesh_dim_size(axis_name: str) -> int:

@@ -1,0 +1,128 @@
+"""DistModel + distributed to_static (reference: python/paddle/distributed/
+auto_parallel/api.py DistModel:2190, to_static:2798).
+
+The reference converts a dynamic-graph layer + DistributedDataLoader into
+a static distributed Program with mode-switched train/eval/predict
+execution.  TPU-native: the "static graph" is a jit-compiled step built by
+the Engine machinery (engine.py), and the strategy knobs (Strategy from
+strategy.py) pick ZeRO level / recompute / amp at step-build time.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .engine import Engine
+from .strategy import Strategy
+
+
+class DistModel:
+    """reference: auto_parallel/api.py:2190.
+
+    Call pattern parity: set a mode with ``train()``/``eval()``/
+    ``predict()`` and invoke the model with ``dist_model(*batch)`` —
+    train mode runs forward+backward+step and returns the loss, eval
+    runs forward+loss, predict returns outputs.
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None, metrics=None):
+        self._engine = Engine(model=layer, loss=loss, optimizer=optimizer,
+                              metrics=metrics, strategy=strategy)
+        self._layer = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        if optimizer is not None and loss is not None:
+            self._mode = "train"
+        elif loss is not None:
+            self._mode = "eval"
+        else:
+            self._mode = "predict"
+
+    # ---- mode switching (reference :2200) ----
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise ValueError(
+                "train() needs both loss and optimizer (reference "
+                "DistModel contract)")
+        self._mode = "train"
+        if hasattr(self._layer, "train"):
+            self._layer.train()
+        return self
+
+    def _sync_from_train(self):
+        """Push the compiled train step's functional params back onto the
+        layer so eval/predict/state_dict see the trained weights."""
+        ts = self._engine._train_step
+        if ts is not None and hasattr(ts, "sync_to_model"):
+            ts.sync_to_model()
+
+    def eval(self):
+        if self._loss is None:
+            raise ValueError("eval() needs a loss")
+        self._sync_from_train()
+        self._mode = "eval"
+        if hasattr(self._layer, "eval"):
+            self._layer.eval()
+        return self
+
+    def predict(self):
+        self._sync_from_train()
+        self._mode = "predict"
+        if hasattr(self._layer, "eval"):
+            self._layer.eval()
+        return self
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def __call__(self, *args: Any):
+        """One batch. ``args`` = (*inputs, *labels) in train/eval mode
+        (labels = the last ``n_labels`` entries, default 1), inputs only
+        in predict mode — the reference DistModel's convention."""
+        if self._mode == "predict":
+            return self._layer(*args)
+        inputs, labels = self._engine._split(tuple(args), 1)
+        inputs = self._engine._shard_batch(inputs)
+        labels = self._engine._shard_batch(labels)
+        from ..._core.tensor import Tensor
+        if self._mode == "train":
+            step = self._engine._ensure_train_step()
+            out = step(inputs, labels)
+            return out[0] if isinstance(out, tuple) else out
+        eval_fn = self._engine._ensure_eval_step()
+        out = eval_fn(*[Tensor(a, _internal=True) for a in inputs])
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return self._loss(*outs, *[Tensor(l, _internal=True)
+                                   for l in labels])
+
+    # ---- state passthrough ----
+    def state_dict(self, *a, **k):
+        self._sync_from_train()
+        return self._layer.state_dict(*a, **k)
+
+    def set_state_dict(self, sd):
+        return self._layer.set_state_dict(sd)
+
+    def dist_main_program(self, mode=None):
+        """reference returns the static Program; here the jit step is the
+        program — exposed for introspection parity."""
+        return self._engine
+
+    def dist_startup_program(self, mode=None):
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """reference: auto_parallel/api.py to_static:2798 — build a DistModel
+    (and in the reference also a DistributedDataLoader; here the loader
+    passes through — use ``paddle_tpu.distributed.shard_dataloader`` for
+    dp-sharded batches)."""
+    dm = DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                   strategy=strategy)
+    if loader is not None:
+        return dm, loader
+    return dm

@@ -1,0 +1,93 @@
+"""Semi-auto parallel Strategy config (reference: python/paddle/distributed/
+auto_parallel/strategy.py Strategy:191 — nested config bags: sharding, amp,
+recompute, pipeline, fused_passes, gradient_merge...).
+
+The TPU build consumes these knobs in ``to_static``/``DistModel``
+(dist_model.py): sharding maps to ZeRO levels over the dp axis, amp to the
+bf16 train-step path, recompute to jax.checkpoint, pipeline to the SPMD
+schedules — all resolved when the step function is built.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+
+class _Config:
+    _defaults: Dict[str, Any] = {}
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        for k, v in self._defaults.items():
+            setattr(self, k, copy.deepcopy(v))
+        for k, v in (overrides or {}).items():
+            setattr(self, k, v)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._defaults}
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.to_dict()})"
+
+
+class ShardingConfig(_Config):
+    _defaults = {"enable": False, "stage": 1, "degree": 8,
+                 "offload": False}
+
+
+class AMPConfig(_Config):
+    _defaults = {"enable": False, "dtype": "bfloat16", "level": "O1",
+                 "init_loss_scaling": 32768.0, "use_master_weights": True}
+
+
+class RecomputeConfig(_Config):
+    _defaults = {"enable": False, "checkpoints": None,
+                 "refined_ops_patterns": None}
+
+
+class PipelineConfig(_Config):
+    _defaults = {"enable": False, "schedule_mode": "1F1B",
+                 "micro_batch_size": 1, "accumulate_steps": 1}
+
+
+class FusedPassesConfig(_Config):
+    _defaults = {"enable": False, "fused_passes_list": []}
+
+
+class GradientMergeConfig(_Config):
+    _defaults = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class MPOptimizationConfig(_Config):
+    _defaults = {"enable": False, "replace_with_parallel_cross_entropy":
+                 False}
+
+
+class DPOptimizationConfig(_Config):
+    _defaults = {"enable": False, "fuse_all_reduce_ops": True}
+
+
+class Strategy:
+    """reference: auto_parallel/strategy.py:191."""
+
+    _SECTIONS = {
+        "sharding": ShardingConfig, "amp": AMPConfig,
+        "recompute": RecomputeConfig, "pipeline": PipelineConfig,
+        "fused_passes": FusedPassesConfig,
+        "gradient_merge": GradientMergeConfig,
+        "mp_optimization": MPOptimizationConfig,
+        "dp_optimization": DPOptimizationConfig,
+    }
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        if config is not None and not isinstance(config, dict):
+            raise ValueError(f"Expected a dictionary. But received: {config}")
+        cfg = config or {}
+        for name, cls in self._SECTIONS.items():
+            setattr(self, name, cls(cfg.get(name)))
+        self.auto_mode = cfg.get("auto_mode", "semi")
+        self.seed = cfg.get("seed", None)
+
+    def __repr__(self):
+        parts = ", ".join(f"{n}={getattr(self, n)!r}"
+                          for n in self._SECTIONS)
+        return f"Strategy({parts})"

@@ -1,0 +1,329 @@
+"""Remaining public ``paddle.distributed`` surface.
+
+Reference: python/paddle/distributed/__init__.py — object collectives
+(communication/all_gather.py all_gather_object, broadcast.py
+broadcast_object_list, scatter.py scatter_object_list), backend/introspection
+helpers, ``dtensor_from_fn`` / sharding-stage markers (auto_parallel/api.py),
+``shard_dataloader`` (auto_parallel/api.py:2467), ``shard_scaler``,
+``split`` (fleet/layers/mpu/mp_ops.py:714), PS table entries
+(distributed/entry_attr.py).
+
+Single-controller TPU semantics: Python objects live once per PROCESS.
+Within one controller every "rank" sees the same object, so the object
+collectives are identity there; across real processes (multi-host) they
+exchange pickled bytes through the TCP store.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .._core.tensor import Tensor
+from . import mesh as _mesh
+from .mesh import Group, get_world_group
+
+__all__ = [
+    "get_backend", "is_available", "wait", "ReduceType", "ParallelMode",
+    "all_gather_object", "broadcast_object_list", "scatter_object_list",
+    "dtensor_from_fn", "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "DistAttr", "shard_dataloader", "shard_scaler", "split",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+]
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    """reference: communication/group.py get_backend — the collective
+    backend name. Here always XLA collectives over ICI/DCN."""
+    return "xla"
+
+
+def is_available() -> bool:
+    """reference: distributed/__init__.py is_available."""
+    return True
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream=True):
+    """reference: communication/wait.py — block until pending collective
+    work on ``tensor`` is done (XLA: block_until_ready)."""
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    try:
+        v.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor
+
+
+class ReduceType:
+    """reference: base/core ReduceType (dist-tensor Partial reduce kind)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class ParallelMode:
+    """reference: distributed/parallel.py ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+# ---------------- object collectives ----------------
+def _store():
+    from . import parallel as _par
+    return getattr(_par, "_object_store", None)
+
+
+def _nproc() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _exchange_object(obj) -> List[Any]:
+    """All-gather an arbitrary picklable object across PROCESSES (the
+    multi-host path of the object collectives): pickle -> uint8 array ->
+    length-padded process_allgather over DCN."""
+    from jax.experimental import multihost_utils as mhu
+    data = np.frombuffer(pickle.dumps(obj), np.uint8)
+    lens = np.asarray(mhu.process_allgather(
+        np.asarray([data.size], np.int64))).reshape(-1)
+    maxlen = int(lens.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[:data.size] = data
+    gathered = np.asarray(mhu.process_allgather(padded)).reshape(
+        len(lens), maxlen)
+    return [pickle.loads(gathered[i, :int(lens[i])].tobytes())
+            for i in range(len(lens))]
+
+
+def all_gather_object(object_list: List[Any], obj: Any,
+                      group: Optional[Group] = None):
+    """reference: communication/all_gather.py all_gather_object."""
+    g = group or get_world_group()
+    n = g.nranks if g is not None else 1
+    if _nproc() <= 1:
+        # single controller: every rank's python object IS this object
+        object_list.extend([obj] * max(1, n))
+        return
+    object_list.extend(_exchange_object(obj))
+
+
+def broadcast_object_list(object_list: List[Any], src: int = 0,
+                          group: Optional[Group] = None):
+    """reference: communication/broadcast.py broadcast_object_list."""
+    if _nproc() <= 1:
+        return  # single controller: src's list already is everyone's list
+    gathered = _exchange_object(list(object_list))
+    object_list[:] = gathered[src]
+
+
+def scatter_object_list(out_object_list: List[Any],
+                        in_object_list: Optional[List[Any]] = None,
+                        src: int = 0, group: Optional[Group] = None):
+    """reference: communication/scatter.py scatter_object_list."""
+    g = group or get_world_group()
+    n = g.nranks if g is not None else 1
+    if _nproc() <= 1:
+        if in_object_list is None:
+            raise ValueError("src rank needs in_object_list")
+        if len(in_object_list) != n:
+            raise ValueError(
+                f"in_object_list has {len(in_object_list)} entries for "
+                f"{n} ranks")
+        # single controller: "this rank" is rank 0's view
+        out_object_list.append(in_object_list[0])
+        return
+    rank = jax.process_index()
+    gathered = _exchange_object(in_object_list)
+    out_object_list.append(gathered[src][rank])
+
+
+# ---------------- semi-auto helpers ----------------
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """reference: auto_parallel/api.py dtensor_from_fn — build a tensor
+    with a factory then shard it."""
+    from .auto_parallel.api import shard_tensor
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+class _ShardingStage:
+    stage = 0
+
+    def __init__(self, mesh_dim: Optional[str] = None, mesh=None):
+        self.mesh_dim = mesh_dim
+        self.mesh = mesh
+
+    def __repr__(self):
+        return f"{type(self).__name__}(mesh_dim={self.mesh_dim!r})"
+
+
+class ShardingStage1(_ShardingStage):
+    """reference: auto_parallel/strategy.py ShardingStage1 marker (ZeRO-1:
+    optimizer states sharded over the data axis)."""
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+class DistAttr:
+    """reference: base DistAttr (legacy semi-auto attr: process_mesh +
+    per-dim sharding specs). Kept for construction parity; the modern
+    Placements path is paddle_tpu.distributed.shard_tensor."""
+
+    def __init__(self, mesh=None, sharding_specs: Optional[Sequence] = None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None, is_dataset_splitted=False):
+    """reference: auto_parallel/api.py shard_dataloader:2467 — wrap a
+    DataLoader so each batch lands dp-sharded on the mesh.
+
+    TPU-native: batches become global arrays sharded over the given mesh
+    dim (default: the current mesh's first axis); with
+    ``is_dataset_splitted`` the loader's batches are treated as this
+    process's local shard (multi-host)."""
+    from .auto_parallel.api import shard_tensor
+    from .auto_parallel.placement import Shard, Replicate
+
+    mesh = meshes if meshes is not None else _mesh.get_mesh()
+    if isinstance(mesh, (list, tuple)):
+        mesh = mesh[0]
+    dim = shard_dims if isinstance(shard_dims, str) else None
+
+    class _ShardedLoader:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __len__(self):
+            return len(self._inner)
+
+        def _place(self, t):
+            if not isinstance(t, Tensor) or mesh is None or t.ndim == 0:
+                return t
+            names = getattr(mesh, "dim_names", None) or \
+                list(getattr(mesh, "axis_names", []))
+            ax = dim or (names[0] if names else None)
+            if ax is None:
+                return t
+            pl = [Shard(0)] + [Replicate()] * (len(names) - 1) \
+                if names and names[0] == ax else \
+                [Shard(0) if n == ax else Replicate() for n in names]
+            try:
+                return shard_tensor(t, mesh, pl)
+            except Exception:
+                return t
+
+        def __iter__(self):
+            for batch in self._inner:
+                if isinstance(batch, (list, tuple)):
+                    yield type(batch)(self._place(b) for b in batch)
+                elif isinstance(batch, dict):
+                    yield {k: self._place(v) for k, v in batch.items()}
+                else:
+                    yield self._place(batch)
+
+    return _ShardedLoader(dataloader)
+
+
+def shard_scaler(scaler):
+    """reference: auto_parallel/api.py shard_scaler — adapt a GradScaler
+    for dist tensors. The TPU GradScaler's found-inf reduction already
+    runs on global arrays (XLA inserts the cross-device reduce), so the
+    scaler is returned as-is."""
+    return scaler
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: fleet/layers/mpu/mp_ops.py split:714 — one-call
+    model-parallel embedding/linear over the mp group."""
+    from .fleet.fleet import get_hybrid_communicate_group
+    from .fleet.layers.mpu.mp_layers import (
+        VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear)
+    hcg = get_hybrid_communicate_group()
+    group = hcg.get_model_parallel_group() if hcg is not None else None
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr,
+                                       mp_group=group)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False,
+                                      mp_group=group)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out,
+                                         mp_group=group)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation!r}")
+
+
+# ---------------- PS sparse-table entry configs ----------------
+class _EntryAttr:
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class CountFilterEntry(_EntryAttr):
+    """reference: distributed/entry_attr.py CountFilterEntry — a sparse
+    feature enters the table after being seen ``count_filter`` times."""
+
+    def __init__(self, count_filter: int):
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError("count_filter must be a non-negative integer")
+        self.count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ProbabilityEntry(_EntryAttr):
+    """reference: entry_attr.py ProbabilityEntry — admit with probability."""
+
+    def __init__(self, probability: float):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class ShowClickEntry(_EntryAttr):
+    """reference: entry_attr.py ShowClickEntry — show/click-var driven."""
+
+    def __init__(self, show_name: str, click_name: str):
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be variable names")
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"

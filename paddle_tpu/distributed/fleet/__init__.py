@@ -15,3 +15,14 @@ from .base.topology import (  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .recompute.recompute import recompute, recompute_sequential  # noqa: F401
 from .utils import sequence_parallel_utils  # noqa: F401
+from .base.role_maker import (  # noqa: F401
+    Role, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+)
+from .base.util_base import UtilBase  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset, DatasetBase  # noqa: F401
+from .data_generator import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .fleet import _FleetModule as Fleet  # noqa: F401
+# util singleton (reference: fleet.util is a UtilBase)
+util = UtilBase()

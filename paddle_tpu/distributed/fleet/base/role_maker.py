@@ -1,0 +1,110 @@
+"""Role makers (reference: python/paddle/distributed/fleet/base/
+role_maker.py — Role:40, PaddleCloudRoleMaker:548,
+UserDefinedRoleMaker:1213).
+
+Roles are resolved from the same PADDLE_* launch env the reference's
+cloud role maker reads; on a single-controller TPU pod every process is a
+WORKER (the parameter-server split only appears when distributed.ps is
+launched in server mode).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class Role:
+    """reference: base/role_maker.py:40."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def _worker_index(self) -> int:
+        raise NotImplementedError
+
+    def _worker_num(self) -> int:
+        raise NotImplementedError
+
+    def _is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def _is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self) -> bool:
+        return self._is_worker() and self._worker_index() == 0
+
+    # public aliases (reference exposes both)
+    def worker_index(self):
+        return self._worker_index()
+
+    def worker_num(self):
+        return self._worker_num()
+
+    def is_worker(self):
+        return self._is_worker()
+
+    def is_server(self):
+        return self._is_server()
+
+    def is_first_worker(self):
+        return self._is_first_worker()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """reference: base/role_maker.py:548 — resolve the role from the
+    PADDLE_* env set by the launcher."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if training_role == "PSERVER" \
+            else Role.WORKER
+        self._rank = int(os.environ.get(
+            "PADDLE_TRAINER_ID", os.environ.get("PADDLE_RANK", "0")))
+        self._size = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", os.environ.get("PADDLE_WORLD_SIZE",
+                                                  "1")))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints: List[str] = [e for e in eps.split(",") if e]
+        seps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints: List[str] = [e for e in seps.split(",")
+                                             if e]
+
+    def _worker_index(self) -> int:
+        return self._rank
+
+    def _worker_num(self) -> int:
+        return self._size
+
+    def _server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def _get_trainer_endpoints(self) -> List[str]:
+        return self._worker_endpoints
+
+    def _get_pserver_endpoints(self) -> List[str]:
+        return self._server_endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference: base/role_maker.py:1213 — explicit role/rank/size
+    instead of env discovery."""
+
+    def __init__(self, is_collective: bool = False,
+                 current_id: int = 0, role: int = Role.WORKER,
+                 worker_num: int = 1,
+                 server_endpoints: Optional[List[str]] = None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._role = role
+        self._rank = current_id
+        self._size = worker_num
+        self._server_endpoints = list(server_endpoints or [])

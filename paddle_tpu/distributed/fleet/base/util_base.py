@@ -1,0 +1,77 @@
+"""UtilBase (reference: python/paddle/distributed/fleet/base/
+util_factory.py:65 — cross-worker utility ops + file sharding)."""
+from __future__ import annotations
+
+import os
+from typing import Any, List, Sequence
+
+import numpy as np
+
+
+class UtilBase:
+    """reference: base/util_factory.py:65."""
+
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _rank_size(self):
+        if self.role_maker is not None:
+            return (self.role_maker._worker_index(),
+                    self.role_maker._worker_num())
+        from .. import fleet as _fleet_mod
+        try:
+            return (_fleet_mod.worker_index(), _fleet_mod.worker_num())
+        except Exception:
+            return 0, 1
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """reference :81 — numpy all-reduce across workers."""
+        from ... import collective as _c
+        from ...mesh import ReduceOp, get_world_group
+        from ...._core.tensor import Tensor
+        g = get_world_group()
+        arr = np.asarray(input)
+        if g is None or g.nranks <= 1:
+            return arr
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        t = Tensor(arr.astype(np.float64 if arr.dtype.kind == "f"
+                              else arr.dtype))
+        try:
+            _c.all_reduce(t, op=op, group=g)
+            return np.asarray(t._value)
+        except Exception:
+            # single-controller replicated host value: reduce of n equal
+            # copies
+            if mode == "sum":
+                return arr * g.nranks
+            return arr
+
+    def barrier(self, comm_world="worker"):
+        from ...mesh import barrier as _b
+        _b()
+
+    def all_gather(self, input, comm_world="worker") -> List[Any]:
+        from ..fleet import worker_num
+        try:
+            n = worker_num()
+        except Exception:
+            n = 1
+        return [input] * n
+
+    def get_file_shard(self, files: Sequence[str]) -> List[str]:
+        """reference :257 — contiguous block split of the file list over
+        workers (first ``len % n`` workers get one extra)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read")
+        rank, size = self._rank_size()
+        n = len(files)
+        base, extra = divmod(n, size)
+        start = rank * base + min(rank, extra)
+        count = base + (1 if rank < extra else 0)
+        return list(files[start:start + count])
+
+    def print_on_rank(self, message: str, rank_id: int):
+        rank, _ = self._rank_size()
+        if rank == rank_id:
+            print(message)

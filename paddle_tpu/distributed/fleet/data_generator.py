@@ -1,0 +1,118 @@
+"""Fleet data generators (reference: python/paddle/distributed/fleet/
+data_generator/data_generator.py — DataGenerator:25,
+MultiSlotStringDataGenerator:237, MultiSlotDataGenerator).
+
+Emit the MultiSlot text format consumed by fleet.dataset
+(``<n> v1 ... vn`` per slot per sample) from user ``generate_sample``
+overrides — the exact pipeline contract the reference's C++ datafeed
+reads."""
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Sequence, Tuple
+
+
+class DataGenerator:
+    """reference: data_generator.py:25."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: map a raw input line to
+        ``[(slot_name, [values...]), ...]`` or a generator thereof."""
+        raise NotImplementedError(
+            "generate_sample() must be overridden by the user")
+
+    def generate_batch(self, samples):
+        """Override for batch-level processing (reference default: yield
+        samples through)."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line) -> str:
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def _iter_outputs(self, lines: Iterable[str]):
+        batch = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            it = gen() if callable(gen) else iter([gen])
+            for sample in it:
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    for s in self.generate_batch(batch)():
+                        yield self._gen_str(s)
+                    batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                yield self._gen_str(s)
+
+    def run_from_stdin(self):
+        for out in self._iter_outputs(sys.stdin):
+            sys.stdout.write(out)
+
+    def run_from_files(self, filelist: Sequence[str], output):
+        """Convenience (beyond the reference's stdin pipe): render slot
+        files directly, for use with fleet.dataset.set_filelist."""
+        def lines():
+            for p in filelist:
+                with open(p, "r", encoding="utf-8",
+                          errors="replace") as f:
+                    yield from f
+        for out in self._iter_outputs(lines()):
+            output.write(out)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """reference: data_generator.py:237 — values pass through as strings."""
+
+    def _gen_str(self, line) -> str:
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        parts: List[str] = []
+        for _name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """reference: data_generator.py MultiSlotDataGenerator — values are
+    ints (sparse ids) or floats (dense); slot arity is validated to stay
+    consistent across samples."""
+
+    def __init__(self):
+        super().__init__()
+        self._proto_info: List[Tuple[str, str]] = []
+
+    def _gen_str(self, line) -> str:
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        if not self._proto_info:
+            for name, values in line:
+                kind = "float" if any(isinstance(v, float) for v in values) \
+                    else "uint64"
+                self._proto_info.append((name, kind))
+        elif len(self._proto_info) != len(line):
+            raise ValueError(
+                f"the complete field set of two given line are "
+                f"inconsistent ({len(self._proto_info)} vs {len(line)})")
+        parts: List[str] = []
+        for (name, values), (_pname, _kind) in zip(line, self._proto_info):
+            if not values:
+                raise ValueError(f"the input feasign of slot {name} is "
+                                 "empty")
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -1,0 +1,166 @@
+"""Fleet slot-data pipelines (reference: python/paddle/distributed/fleet/
+dataset/dataset.py — DatasetBase, InMemoryDataset:455, QueueDataset; data
+generators fleet/data_generator/data_generator.py).
+
+The reference backs these with the C++ MultiSlotDataFeed reading
+space-separated slot files into the trainer threads.  TPU-native: the
+pipeline is host-side python/numpy feeding jit steps, so the datasets
+here parse the same slot file format eagerly (InMemory) or lazily
+(Queue) and iterate (slot_name -> np.ndarray) batches.
+
+Slot line format (MultiSlotDataFeed): for each slot in ``use_var`` order,
+``<n> v1 ... vn`` repeated on one line per sample.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class DatasetBase:
+    """reference: fleet/dataset/dataset.py DatasetBase."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.use_var: List[Any] = []
+        self.pipe_command = "cat"
+        self.input_type = 0
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command="cat", input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        self.use_var = list(use_var or [])
+        self.pipe_command = pipe_command
+        self.input_type = input_type
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self.filelist = list(filelist)
+
+    def _var_names(self) -> List[str]:
+        names = []
+        for v in self.use_var:
+            names.append(getattr(v, "name", None) or str(v))
+        return names
+
+    def _parse_line(self, line: str) -> Optional[List[np.ndarray]]:
+        toks = line.split()
+        if not toks:
+            return None
+        slots = []
+        i = 0
+        try:
+            for _ in self.use_var:
+                n = int(toks[i])
+                vals = toks[i + 1:i + 1 + n]
+                i += 1 + n
+                arr = np.asarray([float(v) for v in vals], np.float32)
+                if all(float(v).is_integer() for v in arr.tolist()):
+                    # id slots stay integral (sparse feature ids)
+                    arr = arr.astype(np.int64)
+                slots.append(arr)
+        except (ValueError, IndexError):
+            return None
+        return slots
+
+    def _iter_samples(self) -> Iterator[List[np.ndarray]]:
+        for path in self.filelist:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                for line in f:
+                    s = self._parse_line(line)
+                    if s is not None:
+                        yield s
+
+    def _batches_from(self, samples) -> Iterator[Dict[str, np.ndarray]]:
+        names = self._var_names()
+        buf: List[List[np.ndarray]] = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._collate(names, buf)
+                buf = []
+        if buf:
+            yield self._collate(names, buf)
+
+    @staticmethod
+    def _collate(names, buf) -> Dict[str, np.ndarray]:
+        out = {}
+        for j, name in enumerate(names):
+            cols = [s[j] for s in buf]
+            width = max(len(c) for c in cols)
+            mat = np.zeros((len(cols), width), cols[0].dtype)
+            for r, c in enumerate(cols):
+                mat[r, :len(c)] = c
+            out[name] = mat
+        return out
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return 0
+
+
+class InMemoryDataset(DatasetBase):
+    """reference: fleet/dataset/dataset.py InMemoryDataset:455 — load the
+    slot files into host RAM, shuffle there, iterate batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: List[List[np.ndarray]] = []
+        self._queue_num = None
+        self._shuffle_seed = 0
+
+    def init(self, **kwargs):
+        super().init(**kwargs)
+        self._queue_num = kwargs.get("queue_num", self.thread_num)
+
+    def update_settings(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k if not k.startswith("_") else k, v)
+            if k == "batch_size":
+                self.batch_size = v
+
+    def load_into_memory(self):
+        self._memory = list(self._iter_samples())
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        rng = random.Random(self._shuffle_seed)
+        rng.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single controller: global == local
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def set_shuffle_seed(self, seed: int):
+        self._shuffle_seed = int(seed)
+
+    def __iter__(self):
+        return self._batches_from(iter(self._memory))
+
+
+class QueueDataset(DatasetBase):
+    """reference: fleet/dataset/dataset.py QueueDataset — streaming: files
+    are read on the fly, one pass, no shuffle."""
+
+    def __iter__(self):
+        return self._batches_from(self._iter_samples())

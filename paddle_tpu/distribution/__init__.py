@@ -16,6 +16,10 @@ from .categorical import Categorical, Multinomial  # noqa: F401
 from .bernoulli import Bernoulli, Geometric  # noqa: F401
 from .beta import Beta, Dirichlet, Gamma, Exponential  # noqa: F401
 from .laplace import Laplace, Gumbel, Cauchy  # noqa: F401
+from .extra_families import (  # noqa: F401
+    ExponentialFamily, Binomial, Poisson, Chi2, StudentT,
+    MultivariateNormal, ContinuousBernoulli, LKJCholesky,
+)
 from .kl import kl_divergence, register_kl  # noqa: F401
 from .independent import Independent  # noqa: F401
 from .transformed_distribution import TransformedDistribution  # noqa: F401

@@ -82,3 +82,56 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), as_tensor(x),
                  name="ifftshift")
+
+
+def _hermitian_nd(x, s, axes, norm, inverse):
+    """Shared body of hfft2/hfftn (and the ihfft* inverses): Hermitian
+    symmetry lives in the LAST transform axis (hfft/ihfft there); the
+    remaining axes take regular complex (i)ffts — the reference's
+    decomposition (python/paddle/fft.py hfftn)."""
+    ax = list(axes) if axes is not None else None
+
+    def f(v):
+        if ax is not None:
+            axs = ax
+        elif s is not None:
+            # numpy/reference semantics: no axes + explicit s -> the LAST
+            # len(s) axes are transformed
+            axs = list(range(v.ndim - len(s), v.ndim))
+        else:
+            axs = list(range(v.ndim))
+        ss = list(s) if s is not None else [None] * len(axs)
+        if inverse:
+            out = jnp.fft.ihfft(v, n=ss[-1], axis=axs[-1], norm=norm)
+            for a, n_ in zip(axs[:-1], ss[:-1]):
+                out = jnp.fft.ifft(out, n=n_, axis=a, norm=norm)
+        else:
+            out = v
+            for a, n_ in zip(axs[:-1], ss[:-1]):
+                out = jnp.fft.fft(out, n=n_, axis=a, norm=norm)
+            out = jnp.fft.hfft(out, n=ss[-1], axis=axs[-1], norm=norm)
+        return out
+    return apply(f, as_tensor(x), name="hfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference: fft.py hfft2 — 2-D FFT of a Hermitian-symmetric input."""
+    return _hermitian_nd(x, s, axes, norm, inverse=False)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference: fft.py ihfft2."""
+    return _hermitian_nd(x, s, axes, norm, inverse=True)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """reference: fft.py hfftn."""
+    return _hermitian_nd(x, s, axes, norm, inverse=False)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """reference: fft.py ihfftn."""
+    return _hermitian_nd(x, s, axes, norm, inverse=True)
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

@@ -9,3 +9,14 @@ from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
+from . import inference  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+# reference exposes the segment reductions at incubate top level
+# (python/paddle/incubate/__init__.py)
+from ..geometric import (segment_sum, segment_mean, segment_max,  # noqa: F401
+                         segment_min)
+from .nn.functional import (softmax_mask_fuse,  # noqa: F401
+                            softmax_mask_fuse_upper_triangle)
+from .graph import (graph_send_recv, graph_khop_sampler,  # noqa: F401
+                    graph_reindex, graph_sample_neighbors)
+from .ops import identity_loss  # noqa: F401

@@ -1,0 +1,152 @@
+"""Graph-learning sampling utilities (reference: python/paddle/incubate/
+operators/graph_{send_recv,reindex,sample_neighbors,khop_sampler}.py).
+
+TPU-native split: message passing (``graph_send_recv``) is the jit-able
+``geometric`` segment path; the SAMPLERS are host-side data-preparation
+ops (inherently dynamic-shaped — the reference runs them in C++ on CPU
+or with GPU hashtables), so they run in numpy on the host, like the
+DataLoader they feed.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from ..ops._registry import as_tensor
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """reference: incubate/operators/graph_send_recv.py — renamed
+    ``geometric.send_u_recv`` (pool_type -> reduce_op)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size, name=name)
+
+
+def _np(t):
+    if isinstance(t, Tensor):
+        return np.asarray(t._value)
+    return np.asarray(t)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """reference: incubate/operators/graph_sample_neighbors.py — for each
+    input node, sample up to ``sample_size`` neighbors from the CSC graph
+    (row = concatenated neighbor lists, colptr = per-node offsets).
+    Returns (out_neighbors, out_count[, out_eids])."""
+    rown = _np(row)
+    cp = _np(colptr)
+    nodes = _np(input_nodes).reshape(-1)
+    eidsn = _np(eids) if eids is not None else None
+    rng = np.random.default_rng()
+    neigh_parts, eid_parts, counts = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        neigh_parts.append(rown[sel])
+        if eidsn is not None:
+            eid_parts.append(eidsn[sel])
+        counts.append(len(sel))
+    out_n = np.concatenate(neigh_parts) if neigh_parts else \
+        np.zeros((0,), rown.dtype)
+    out_c = np.asarray(counts, np.int32)
+    outs = (Tensor(out_n), Tensor(out_c))
+    if return_eids:
+        if eidsn is None:
+            raise ValueError("return_eids=True requires eids")
+        out_e = np.concatenate(eid_parts) if eid_parts else \
+            np.zeros((0,), eidsn.dtype)
+        outs = outs + (Tensor(out_e),)
+    return outs
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """reference: incubate/operators/graph_reindex.py — contiguous ids
+    from 0 with the input nodes first (multi-edge-type supported: count
+    length = k * len(x) blocks). Returns (reindex_src, reindex_dst,
+    out_nodes)."""
+    if flag_buffer_hashtable and (value_buffer is None
+                                  or index_buffer is None):
+        raise ValueError("`value_buffer` and `index_buffer` should not "
+                         "be None if `flag_buffer_hashtable` is True.")
+    xs = _np(x).reshape(-1)
+    nb = _np(neighbors).reshape(-1)
+    ct = _np(count).reshape(-1)
+    if len(ct) % len(xs) != 0:
+        raise ValueError(
+            f"count length {len(ct)} must be a multiple of len(x) "
+            f"{len(xs)}")
+    idmap = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    src = np.empty(len(nb), np.int64)
+    for i, v in enumerate(nb):
+        v = int(v)
+        j = idmap.get(v)
+        if j is None:
+            j = len(out_nodes)
+            idmap[v] = j
+            out_nodes.append(v)
+        src[i] = j
+    dst = np.repeat(np.tile(np.arange(len(xs), dtype=np.int64),
+                            len(ct) // len(xs)), ct)
+    return (Tensor(src.astype(xs.dtype)), Tensor(dst.astype(xs.dtype)),
+            Tensor(np.asarray(out_nodes, xs.dtype)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes: Sequence[int],
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate/operators/graph_khop_sampler.py — multi-hop
+    neighbor sampling + reindex. Returns (edge_src, edge_dst,
+    sample_index, reindex_nodes[, edge_eids])."""
+    nodes = _np(input_nodes).reshape(-1)
+    frontier = nodes
+    all_neigh, all_count, all_eids = [], [], []
+    frontiers = [nodes]
+    for sz in sample_sizes:
+        res = graph_sample_neighbors(
+            row, colptr, Tensor(frontier), eids=sorted_eids,
+            sample_size=sz, return_eids=return_eids)
+        nb, ct = _np(res[0]), _np(res[1])
+        all_neigh.append(nb)
+        all_count.append((frontier, ct))
+        if return_eids:
+            all_eids.append(_np(res[2]))
+        # next frontier: newly seen nodes
+        frontier = np.unique(nb)
+        frontiers.append(frontier)
+    # unique sample universe, input nodes first
+    seen = {int(v): i for i, v in enumerate(nodes)}
+    universe = list(nodes)
+    for nb in all_neigh:
+        for v in nb:
+            v = int(v)
+            if v not in seen:
+                seen[v] = len(universe)
+                universe.append(v)
+    srcs, dsts = [], []
+    for (front, ct), nb in zip(all_count, all_neigh):
+        dst = np.repeat(front, ct)
+        srcs.append(np.asarray([seen[int(v)] for v in nb], np.int64))
+        dsts.append(np.asarray([seen[int(v)] for v in dst], np.int64))
+    edge_src = np.concatenate(srcs) if srcs else np.zeros((0,), np.int64)
+    edge_dst = np.concatenate(dsts) if dsts else np.zeros((0,), np.int64)
+    sample_index = np.asarray(universe, nodes.dtype)
+    reindex_nodes = np.asarray([seen[int(v)] for v in nodes], np.int64)
+    outs = (Tensor(edge_src.reshape(-1, 1)), Tensor(edge_dst.reshape(-1, 1)),
+            Tensor(sample_index), Tensor(reindex_nodes))
+    if return_eids:
+        eids_all = np.concatenate(all_eids) if all_eids else \
+            np.zeros((0,), np.int64)
+        outs = outs + (Tensor(eids_all),)
+    return outs

@@ -1,0 +1,20 @@
+"""paddle.incubate.inference parity (reference: python/paddle/incubate/
+inference/__init__.py — the ``wrap_decorator`` d2s inference accelerator).
+
+On TPU the capability is jit compilation itself: ``@paddle.incubate.
+inference.wrap_inference`` compiles the wrapped callable with the same
+trace-and-cache machinery as ``paddle.jit.to_static``.
+"""
+from __future__ import annotations
+
+
+def wrap_inference(fn=None, **kwargs):
+    """Compile a callable for inference (reference: incubate/inference
+    wrap_decorator). Accepts and ignores the CUDA-specific tuning kwargs
+    (cache_static_model etc.) — XLA compilation cache subsumes them."""
+    from ..jit import to_static
+
+    def deco(f):
+        return to_static(f)
+
+    return deco(fn) if fn is not None else deco

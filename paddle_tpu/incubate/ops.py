@@ -1,0 +1,27 @@
+"""Misc incubate operators (reference: python/paddle/incubate/operators/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .._core.autograd import apply
+from ..ops._registry import as_tensor
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate/operators/__init__.py identity_loss (kernel
+    phi identity_loss) — marks a tensor as a loss and reduces it;
+    reduction: 0/'sum', 1/'mean', 2/'none'."""
+    names = {0: "sum", 1: "mean", 2: "none"}
+    if isinstance(reduction, int):
+        reduction = names.get(reduction, reduction)
+    if reduction not in ("sum", "mean", "none"):
+        raise ValueError(f"unknown reduction {reduction!r}")
+
+    def f(v):
+        if reduction == "sum":
+            return jnp.sum(v)
+        if reduction == "mean":
+            return jnp.mean(v)
+        return v
+
+    return apply(f, as_tensor(x), name="identity_loss")

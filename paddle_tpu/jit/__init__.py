@@ -4,3 +4,18 @@ from .api import (  # noqa: F401
     StaticFunction, TrainStep, EvalStep, train_step,
 )
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+
+_logging_state = {"code_level": 100, "verbosity": 0}
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: jit/dy2static/logging_utils.py set_code_level — controls
+    transformed-code logging. The trace-based to_static has no generated
+    code to print; the knob is accepted and recorded."""
+    _logging_state["code_level"] = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit/dy2static/logging_utils.py set_verbosity."""
+    _logging_state["verbosity"] = int(level)

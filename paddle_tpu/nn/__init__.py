@@ -41,6 +41,15 @@ from .layer.rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,
 )
+from .layer.extras import (  # noqa: F401
+    PairwiseDistance, Softmax2D, ZeroPad1D, ZeroPad3D, Fold, Unfold,
+    FeatureAlphaDropout, LPPool1D, LPPool2D, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, FractionalMaxPool2D, FractionalMaxPool3D, ParameterDict,
+    SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    PoissonNLLLoss, GaussianNLLLoss, TripletMarginWithDistanceLoss,
+    RNNTLoss, HSigmoidLoss, AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder,
+    dynamic_decode,
+)
 from . import functional  # noqa: F401
 from . import quant  # noqa: F401
 from . import initializer  # noqa: F401

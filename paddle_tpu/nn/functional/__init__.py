@@ -35,6 +35,20 @@ from .loss import (  # noqa: F401
 )
 from .attention import (  # noqa: F401
     flash_attention, scaled_dot_product_attention, flash_attn_unpadded,
-    sdp_kernel,
+    sdp_kernel, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+)
+from .activation import (  # noqa: F401
+    relu_, tanh_, elu_, leaky_relu_, hardtanh_, thresholded_relu_,
+    softmax_,
+)
+from . import extra  # noqa: F401
+from .extra import (  # noqa: F401
+    soft_margin_loss, multi_label_soft_margin_loss, multi_margin_loss,
+    poisson_nll_loss, gaussian_nll_loss, pairwise_distance,
+    triplet_margin_with_distance_loss, npair_loss, hsigmoid_loss,
+    rnnt_loss, adaptive_log_softmax_with_loss, zeropad2d,
+    feature_alpha_dropout, lp_pool1d, max_unpool1d, temporal_shift,
+    class_center_sample, sparse_attention,
 )
 from ...ops.parity import sequence_mask, gather_tree  # noqa: F401,E402

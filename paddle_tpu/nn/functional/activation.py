@@ -169,3 +169,23 @@ def swiglu(x, y=None, name=None):
                      v[..., v.shape[-1] // 2:], as_tensor(x), name="swiglu")
     return apply(lambda a, b: jax.nn.silu(a) * b, as_tensor(x), as_tensor(y),
                  name="swiglu")
+
+
+def _make_inplace(fn, name):
+    def inplace(x, *args, **kwargs):
+        from ...ops._registry import as_tensor as _at
+        t = _at(x)
+        return t._inplace_from(fn(t, *args, **kwargs))
+    inplace.__name__ = name
+    inplace.__doc__ = f"In-place variant of :func:`{name[:-1]}` " \
+                      "(reference: the activation's `_` form)."
+    return inplace
+
+
+relu_ = _make_inplace(relu, "relu_")
+tanh_ = _make_inplace(tanh, "tanh_")
+elu_ = _make_inplace(elu, "elu_")
+leaky_relu_ = _make_inplace(leaky_relu, "leaky_relu_")
+hardtanh_ = _make_inplace(hardtanh, "hardtanh_")
+thresholded_relu_ = _make_inplace(thresholded_relu, "thresholded_relu_")
+softmax_ = _make_inplace(softmax, "softmax_")

@@ -138,3 +138,74 @@ def sdp_kernel(*args, **kwargs):
         def __exit__(self, *exc):
             return False
     return _Ctx()
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         training=True, rng_name="", name=None):
+    """reference: nn/functional/flash_attention.py flash_attn_qkvpacked —
+    qkv packed as (B, S, 3, H, D); unpack and run flash attention."""
+    t = as_tensor(qkv)
+    q = t[:, :, 0]
+    k = t[:, :, 1]
+    v = t[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax,
+                           training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """reference: flash_attention.py flash_attn_varlen_qkvpacked —
+    variable-length packed layout (total_tokens, 3, H, D) with
+    cu_seqlens; unpack onto the unpadded kernel."""
+    t = as_tensor(qkv)
+    return flash_attn_unpadded(
+        t[:, 0], t[:, 1], t[:, 2], cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q, max_seqlen_k, scale=scale, dropout=dropout,
+        causal=causal, return_softmax=return_softmax, training=training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """reference: flash_attention.py flashmask_attention — attention with
+    a compressed column-wise mask: ``startend_row_indices`` (B, H|1, S, 1)
+    gives, per key column, the first query row that must NOT attend
+    (causal form). TPU-native: the mask expands to a dense bias fused by
+    XLA; the sparse-skip speedup belongs to the Pallas flash kernel's
+    block skipping."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    if startend_row_indices is None:
+        return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                               training=training)
+    sri = raw(as_tensor(startend_row_indices))
+
+    def f(qq, kk, vv):
+        import math as _m
+        B, S, H, D = qq.shape
+        scale = 1.0 / _m.sqrt(D)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qq, kk,
+                       preferred_element_type=jnp.float32) * scale
+        start = sri[..., 0]                      # (B, H|1, S)
+        rows = jnp.arange(S)[None, None, :, None]
+        # row r attends to column c iff r < start[c] (plus causal r >= c)
+        mask = rows < start[:, :, None, :]
+        if causal:
+            mask = mask & (rows >= jnp.arange(S)[None, None, None, :])
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(qq.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+    out = apply(f, q, k, v, name="flashmask_attention")
+    if return_softmax_lse or return_seed_offset:
+        outs = (out, None)
+        if return_seed_offset:
+            outs = outs + (None,)
+        return outs
+    return out

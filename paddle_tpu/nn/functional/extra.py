@@ -1,0 +1,503 @@
+"""Functional long tail (reference: python/paddle/nn/functional/ — loss.py
+soft_margin_loss/multi_margin_loss/multi_label_soft_margin_loss/
+poisson_nll_loss/gaussian_nll_loss/triplet_margin_with_distance_loss/
+npair_loss/hsigmoid_loss/rnnt_loss/adaptive_log_softmax_with_loss,
+distance.py pairwise_distance, common.py zeropad2d/feature_alpha_dropout,
+pooling.py lp_pool1d/max_unpool1d, input.py class_center_sample,
+vision ops temporal_shift)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.autograd import apply, no_grad
+from ..._core.tensor import Tensor
+from ..._core.random import next_rng_key
+from ...ops._registry import as_tensor, raw
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    if reduction == "none":
+        return v
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+# ---------------- losses ----------------
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """reference: loss.py soft_margin_loss — log(1+exp(-y*x)), y∈{-1,1}."""
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+                       reduction)
+    return apply(f, as_tensor(input), as_tensor(label),
+                 name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """reference: loss.py multi_label_soft_margin_loss."""
+    args = [as_tensor(input), as_tensor(label)]
+    if weight is not None:
+        args.append(as_tensor(weight))
+
+    def f(x, y, *w):
+        y = y.astype(x.dtype)
+        term = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w:
+            term = term * w[0]
+        return _reduce(-jnp.mean(term, axis=-1), reduction)
+    return apply(f, *args, name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction="mean", name=None):
+    """reference: loss.py multi_margin_loss — mean_j max(0, margin -
+    x_y + x_j)^p / C (j != y), optionally class-weighted by w_y."""
+    args = [as_tensor(input), as_tensor(label)]
+    if weight is not None:
+        args.append(as_tensor(weight))
+
+    def f(x, y, *w):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, y[:, None].astype(jnp.int32),
+                                 axis=1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            m = m * jnp.take(w[0], y)[:, None]
+        mask = jnp.ones_like(m).at[jnp.arange(n), y].set(0.0)
+        return _reduce(jnp.sum(m * mask, axis=1) / c, reduction)
+    return apply(f, *args, name="multi_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """reference: loss.py poisson_nll_loss."""
+    if epsilon <= 0:
+        raise ValueError("epsilon must be positive")
+
+    def f(x, y):
+        y = y.astype(x.dtype)
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stir = (y * jnp.log(y) - y
+                    + 0.5 * jnp.log(2 * jnp.pi * y))
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(label),
+                 name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """reference: loss.py gaussian_nll_loss."""
+    def f(x, y, var):
+        var = jnp.maximum(var.astype(x.dtype), epsilon)
+        loss = 0.5 * (jnp.log(var) + (x - y.astype(x.dtype)) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return apply(f, as_tensor(input), as_tensor(label),
+                 as_tensor(variance), name="gaussian_nll_loss")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference: distance.py pairwise_distance — ||x - y + eps||_p over
+    the last dim."""
+    def f(a, b):
+        d = jnp.abs(a - b + epsilon)
+        if p == float("inf"):
+            return jnp.max(d, axis=-1, keepdims=keepdim)
+        return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply(f, as_tensor(x), as_tensor(y), name="pairwise_distance")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference: loss.py triplet_margin_with_distance_loss."""
+    a, pos, neg = as_tensor(input), as_tensor(positive), as_tensor(negative)
+    dist = distance_function or (lambda u, v: pairwise_distance(u, v))
+    d_ap = dist(a, pos)
+    d_an = dist(a, neg)
+    if swap:
+        d_pn = dist(pos, neg)
+        from ...ops.math import minimum
+        d_an = minimum(d_an, d_pn)
+    from ...ops import math as om
+
+    def f(dp, dn):
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, as_tensor(d_ap), as_tensor(d_an),
+                 name="triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: loss.py npair_loss:347 (Beta=0.25 internal scale)."""
+    def f(a, p, y):
+        beta = 0.25
+        bs = y.shape[0]
+        ym = (y[:, None] == y[None, :]).astype(jnp.float32)
+        ym = ym / jnp.sum(ym, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) \
+            * beta * l2_reg
+        sim = a @ p.T
+        # ym is doubly stochastic, so the reference's ym-weighted row
+        # reduction equals the plain mean of the per-row soft CE
+        ce = jnp.mean(-jnp.sum(
+            ym * jax.nn.log_softmax(sim, axis=-1), axis=-1))
+        return l2 + ce
+    return apply(f, as_tensor(anchor), as_tensor(positive),
+                 as_tensor(labels), name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: loss.py hsigmoid_loss (kernel phi hsigmoid_loss) —
+    hierarchical sigmoid over a complete binary tree (default) with
+    ``num_classes`` leaves; weight rows are internal nodes.
+
+    Default-tree case: leaf c's path is the binary expansion of
+    ``c + num_classes`` from the root (standard complete-tree heap
+    indexing, matching the reference kernel's MatrixBitCodeFunctor)."""
+    x = as_tensor(input)
+    lab = as_tensor(label)
+    args = [x, as_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(as_tensor(bias))
+    custom = path_table is not None and path_code is not None
+    if custom:
+        pt = raw(as_tensor(path_table))
+        pc = raw(as_tensor(path_code))
+    else:
+        # precompute heap paths for all classes on host (static table)
+        depth = max(1, int(math.ceil(math.log2(max(2, num_classes)))))
+        table = np.zeros((num_classes, depth), np.int64)
+        code = np.zeros((num_classes, depth), np.int64)
+        lengths = np.zeros((num_classes,), np.int64)
+        for c in range(num_classes):
+            node = c + num_classes
+            path = []
+            while node > 1:
+                path.append((node // 2, node % 2))
+                node //= 2
+            path.reverse()
+            lengths[c] = len(path)
+            for d, (nid, bit) in enumerate(path):
+                # internal node ids are 1..num_classes-1 -> weight row id-1
+                table[c, d] = nid - 1
+                code[c, d] = bit
+        pt_all, pc_all, ln_all = (jnp.asarray(table), jnp.asarray(code),
+                                  jnp.asarray(lengths))
+
+    yl = raw(lab).astype(jnp.int32)
+
+    def f(xv, w, *rest):
+        if custom:
+            t = pt
+            cde = pc
+            valid = (t >= 0)
+            tt = jnp.maximum(t, 0)
+        else:
+            t = jnp.take(pt_all, yl, axis=0)       # (N, depth)
+            cde = jnp.take(pc_all, yl, axis=0)
+            ln = jnp.take(ln_all, yl)              # (N,)
+            valid = jnp.arange(t.shape[1])[None, :] < ln[:, None]
+            tt = t
+        wsel = jnp.take(w, tt, axis=0)             # (N, depth, D)
+        logits = jnp.einsum("nd,nkd->nk", xv, wsel)
+        if has_bias:
+            logits = logits + jnp.take(rest[0].reshape(-1), tt)
+        sign = jnp.where(cde > 0, 1.0, -1.0)
+        # P(bit) = sigmoid(sign * logit); NLL summed over the path
+        nll = jnp.where(valid,
+                        -jax.nn.log_sigmoid(sign * logits), 0.0)
+        return jnp.sum(nll, axis=1, keepdims=True)
+    return apply(f, *args, name="hsigmoid_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """reference: loss.py rnnt_loss (kernel warprnnt) — RNN-Transducer
+    loss: -log P(label | acoustics) summed over all monotonic alignments
+    via the forward algorithm on the (T, U) lattice.
+
+    TPU-native: log-space DP with a lax.scan over time frames; the
+    within-row recurrence over label positions runs as an inner scan —
+    static shapes, grads via autodiff through the DP (the reference
+    backward is the analytic gradient of the same recursion)."""
+    x = as_tensor(input)      # (B, T, U+1, V) log probs or logits
+    lab = as_tensor(label)    # (B, U) int
+    tl = raw(as_tensor(input_lengths)).astype(jnp.int32)
+    ul = raw(as_tensor(label_lengths)).astype(jnp.int32)
+    yl = raw(lab).astype(jnp.int32)
+
+    def f(logits):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        B, T, U1, _V = lp.shape
+        NEG = jnp.float32(-1e30)
+
+        blank_lp = lp[..., blank]                      # (B, T, U1)
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], yl[:, None, :, None], axis=3)[..., 0]
+        # pad label-emission row U (no label beyond U): (B, T, U1)
+        lab_lp = jnp.concatenate(
+            [lab_lp, jnp.full((B, T, 1), NEG)], axis=2)
+
+        init_row = jnp.where(jnp.arange(U1)[None, :] == 0,
+                             jnp.float32(0.0), NEG)
+        init_row = jnp.broadcast_to(init_row, (B, U1))
+
+        def step(alpha_prev, t):
+            # horizontal move: blank emitted at frame t-1, same label pos
+            tm1 = jnp.maximum(t - 1, 0)
+            horiz = jnp.where(
+                t == 0, init_row,
+                alpha_prev + jnp.take(blank_lp, tm1, axis=1))
+            # vertical moves within frame t: label emitted at (t, u-1);
+            # sequential in u — inner scan over label positions
+            lab_t = jnp.take(lab_lp, t, axis=1)       # (B, U1)
+
+            def vstep(prev, u):
+                cur = jnp.logaddexp(horiz[:, u], prev + lab_t[:, u - 1])
+                return cur, cur
+
+            first = horiz[:, 0]
+            _, rest = jax.lax.scan(vstep, first, jnp.arange(1, U1))
+            alpha_t = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(step, jnp.zeros((B, U1), jnp.float32),
+                                 jnp.arange(T))      # (T, B, U1)
+        alphas = jnp.transpose(alphas, (1, 0, 2))    # (B, T, U1)
+        bidx = jnp.arange(B)
+        final = alphas[bidx, tl - 1, ul] + blank_lp[bidx, tl - 1, ul]
+        nll = -final
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+    return apply(f, x, name="rnnt_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: loss.py adaptive_log_softmax_with_loss — adaptive
+    softmax (Grave et al.): a head over [frequent classes + cluster
+    tokens] and low-rank tails per cluster. Returns (output, loss) where
+    output is the per-sample log probability of its target."""
+    x = as_tensor(input)
+    y = raw(as_tensor(label)).astype(jnp.int32)
+    hw = as_tensor(head_weight)
+    args = [x, hw]
+    if head_bias is not None:
+        args.append(as_tensor(head_bias))
+    tws = []
+    for pair in tail_weights:
+        a, b = pair
+        tws.append((as_tensor(a), as_tensor(b)))
+        args.extend(tws[-1])
+    n_clusters = len(cutoffs) - 1 if cutoffs and \
+        isinstance(cutoffs[-1], int) else len(tail_weights)
+    shortlist = cutoffs[0]
+
+    def f(xv, hwv, *rest):
+        off = 0
+        hb = None
+        if head_bias is not None:
+            hb = rest[0]
+            off = 1
+        tails = [(rest[off + 2 * i], rest[off + 2 * i + 1])
+                 for i in range(len(tws))]
+        head_logits = xv @ hwv
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lsm = jax.nn.log_softmax(head_logits, axis=-1)
+        # head covers shortlist + one slot per cluster
+        out = jnp.take_along_axis(
+            head_lsm, jnp.clip(y, 0, shortlist - 1)[:, None], axis=1
+        )[:, 0]
+        for i, (w1, w2) in enumerate(tails):
+            lo = cutoffs[i]
+            hi = cutoffs[i + 1]
+            in_c = (y >= lo) & (y < hi)
+            cluster_slot = shortlist + i
+            tail_logits = (xv @ w1) @ w2
+            tail_lsm = jax.nn.log_softmax(tail_logits, axis=-1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            cand = head_lsm[:, cluster_slot] + jnp.take_along_axis(
+                tail_lsm, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, cand, out)
+        return out, -jnp.mean(out)
+    return apply(f, *args, name="adaptive_log_softmax_with_loss")
+
+
+# ---------------- misc functionals ----------------
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """reference: common.py zeropad2d — pad (left, right, top, bottom)."""
+    l, r, t, b = [int(p) for p in (raw(as_tensor(padding)).tolist()
+                                   if not isinstance(padding, (list, tuple))
+                                   else padding)]
+
+    def f(v):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(v, cfg)
+    return apply(f, as_tensor(x), name="zeropad2d")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """reference: common.py feature_alpha_dropout — alpha dropout that
+    drops whole channels (SELU-preserving statistics)."""
+    if not 0 <= p < 1:
+        raise ValueError("p must be in [0, 1)")
+    x = as_tensor(x)
+    if not training or p == 0:
+        return x
+    alpha_p = -1.7580993408473766
+    a = (1 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * p * alpha_p
+    key = next_rng_key()
+
+    def f(v):
+        shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+    return apply(f, x, name="feature_alpha_dropout")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """reference: pooling.py lp_pool1d — via the 2-D kernel on (N,C,1,L)."""
+    from .pooling import lp_pool2d
+    from ...ops.manipulation import squeeze, unsqueeze
+    x = as_tensor(x)
+    x4 = unsqueeze(x, 2)
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else [1, kernel_size]
+    st = stride if stride is None else (
+        stride if isinstance(stride, (list, tuple)) else [1, stride])
+    pd = padding if isinstance(padding, (list, tuple)) else [0, padding]
+    out = lp_pool2d(x4, norm_type, ks, st, pd, ceil_mode,
+                    data_format="NCHW")
+    return squeeze(out, 2)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """reference: pooling.py max_unpool1d — via the 2-D kernel."""
+    from .pooling import max_unpool2d
+    from ...ops.manipulation import squeeze, unsqueeze
+    x4 = unsqueeze(as_tensor(x), 2)
+    idx4 = unsqueeze(as_tensor(indices), 2)
+    ks = [1, kernel_size] if not isinstance(kernel_size, (list, tuple)) \
+        else [1] + list(kernel_size)
+    st = None if stride is None else (
+        [1, stride] if not isinstance(stride, (list, tuple))
+        else [1] + list(stride))
+    pd = [0, padding] if not isinstance(padding, (list, tuple)) \
+        else [0] + list(padding)
+    osz = None if output_size is None else [1] + list(output_size)[-1:]
+    out = max_unpool2d(x4, idx4, ks, st, pd, data_format="NCHW",
+                       output_size=osz)
+    return squeeze(out, 2)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """reference: vision ops temporal_shift (kernel phi temporal_shift) —
+    shift a fraction of channels one frame forward/backward inside each
+    segment (TSM)."""
+    x = as_tensor(x)
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        keep = v5[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(
+            nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return apply(f, x, name="temporal_shift")
+
+
+@no_grad()
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """reference: input.py class_center_sample — sample class centers:
+    all positives plus uniform negatives up to num_samples; returns
+    (remapped_label, sampled_class_center). Host-side (dynamic sizes),
+    like the reference's CPU path."""
+    y = np.asarray(raw(as_tensor(label))).astype(np.int64)
+    pos = np.unique(y)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos)
+        extra = np.random.default_rng().choice(
+            rest, size=num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[y])),
+            Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """reference: nn/functional/sparse_attention.py (GPU-only kernel) —
+    block-sparse attention with a CSR connectivity pattern. TPU-native:
+    materialized as a dense mask (correctness surface; the performance
+    path on TPU is flash_attention/flashmask)."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    off = raw(as_tensor(sparse_csr_offset)).astype(jnp.int32)
+    cols = raw(as_tensor(sparse_csr_columns)).astype(jnp.int32)
+
+    def f(qv, kv, vv):
+        B, H, S, D = qv.shape
+        scale = 1.0 / math.sqrt(D)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qv, kv) * scale
+        # dense mask from CSR: row i attends to cols[off[i]:off[i+1]]
+        nnz = cols.shape[-1]
+        idx = jnp.arange(nnz)
+
+        def one_head(off_1d, cols_1d):
+            # row of nonzero r: how many offsets (excluding off[0]) are
+            # <= r -> searchsorted over off[1:]
+            rows = jnp.searchsorted(off_1d[1:], idx, side="right")
+            valid = idx < off_1d[-1]
+            m = jnp.zeros((S, S), bool)
+            return m.at[jnp.where(valid, rows, 0),
+                        jnp.where(valid, cols_1d, 0)].max(valid)
+
+        mask = jax.vmap(jax.vmap(one_head))(
+            off.reshape(B, H, -1), cols.reshape(B, H, -1))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv).astype(qv.dtype)
+    return apply(f, q, k, v, name="sparse_attention")

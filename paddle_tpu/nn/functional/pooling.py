@@ -63,6 +63,21 @@ def _pool(x, kernel_size, stride, padding, ndim, channel_last, init, op,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        # route through the 2-D masked path on (N, C, 1, L): the flat
+        # spatial index over (1, L) IS the 1-D index
+        from ...ops.manipulation import squeeze, unsqueeze
+        x4 = unsqueeze(as_tensor(x), 2)
+        ks = [1, kernel_size] if not isinstance(kernel_size, (list, tuple)) \
+            else [1] + list(kernel_size)
+        st = None if stride is None else (
+            [1, stride] if not isinstance(stride, (list, tuple))
+            else [1] + list(stride))
+        pd = [0, padding] if not isinstance(padding, (list, tuple)) \
+            else [0] + list(padding)
+        out, idx = max_pool2d(x4, ks, st, pd, return_mask=True,
+                              ceil_mode=ceil_mode, data_format="NCHW")
+        return squeeze(out, 2), squeeze(idx, 2)
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
                  -jnp.inf, jax.lax.max, ceil_mode, "max_pool1d")
 

@@ -2,6 +2,7 @@ from .initializer import (
     Initializer, Constant, Normal, TruncatedNormal, Uniform, XavierNormal,
     XavierUniform, KaimingNormal, KaimingUniform, Assign, Orthogonal, Dirac,
     ParamAttr, _resolve_param_attr, constant, normal, uniform,
+    Bilinear, calculate_gain,
 )
 
 

@@ -201,3 +201,47 @@ def _resolve_param_attr(attr, is_bias, default_initializer):
     if init is None:
         init = Constant(0.0) if is_bias else XavierUniform()
     return init, name, trainable
+
+
+def calculate_gain(nonlinearity, param=None):
+    """reference: nn/initializer/initializer.py:152 calculate_gain."""
+    import math as _math
+    if param is None:
+        param = 0.01
+    else:
+        if not isinstance(param, (bool, int, float)):
+            raise AssertionError("param must be bool/int/float")
+        param = float(param)
+    table = {
+        "sigmoid": 1.0, "linear": 1.0,
+        "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0,
+        "tanh": 5.0 / 3, "relu": _math.sqrt(2.0),
+        "leaky_relu": _math.sqrt(2.0 / (1 + param ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in table:
+        raise ValueError(f"no recommended gain for {nonlinearity!r}")
+    return table[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """reference: nn/initializer/Bilinear — upsampling-kernel init for
+    (transposed) conv weights: each output channel holds the bilinear
+    interpolation stencil (used to initialize learnable upsampling)."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("the length of shape must be 4.")
+        if shape[2] != shape[3]:
+            raise ValueError("shape[2] must be equal to shape[3].")
+        import numpy as _np
+        size = shape[3]
+        f = _np.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = _np.arange(size)
+        stencil = ((1 - _np.abs(x / f - c))[None, :]
+                   * (1 - _np.abs(x / f - c))[:, None])
+        weight = _np.broadcast_to(stencil, shape).astype(_np.float32)
+        return jnp.asarray(weight, dtype)

@@ -1,0 +1,524 @@
+"""Layer long tail (reference: python/paddle/nn/layer/ — loss.py,
+distance.py PairwiseDistance, common.py Fold/Unfold/ZeroPad*, activation.py
+Softmax2D, pooling.py LPPool/MaxUnPool/FractionalMaxPool layer forms,
+container.py ParameterDict)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .layers import Layer
+from ..._core.tensor import Parameter, Tensor
+from .. import functional as F
+from ..functional import extra as FX
+
+
+class PairwiseDistance(Layer):
+    """reference: nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return FX.pairwise_distance(x, y, self.p, self.epsilon,
+                                    self.keepdim)
+
+
+class Softmax2D(Layer):
+    """reference: nn/layer/activation.py Softmax2D — softmax over the
+    channel dim of (N, C, H, W) / (C, H, W)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3-D or 4-D input")
+        return F.softmax(x, axis=-3)
+
+
+class ZeroPad1D(Layer):
+    """reference: nn/layer/common.py ZeroPad1D."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = [padding, padding] if isinstance(padding, int) \
+            else list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class ZeroPad3D(Layer):
+    """reference: nn/layer/common.py ZeroPad3D."""
+
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = [padding] * 6 if isinstance(padding, int) \
+            else list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class Fold(Layer):
+    """reference: nn/layer/common.py Fold (col2im)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self.a
+        return F.fold(x, o, k, s, p, d)
+
+
+class Unfold(Layer):
+    """reference: nn/layer/common.py Unfold (im2col)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self.a
+        return F.unfold(x, k, s, p, d)
+
+
+class FeatureAlphaDropout(Layer):
+    """reference: nn/layer/common.py FeatureAlphaDropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return FX.feature_alpha_dropout(x, self.p, self.training)
+
+
+class LPPool1D(Layer):
+    """reference: nn/layer/pooling.py LPPool1D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                  data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, df = self.a
+        return FX.lp_pool1d(x, n, k, s, p, c, df)
+
+
+class LPPool2D(Layer):
+    """reference: nn/layer/pooling.py LPPool2D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                  data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, df = self.a
+        return F.lp_pool2d(x, n, k, s, p, c, df)
+
+
+class MaxUnPool1D(Layer):
+    """reference: nn/layer/pooling.py MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, o = self.a
+        return FX.max_unpool1d(x, indices, k, s, p, df, o)
+
+
+class MaxUnPool2D(Layer):
+    """reference: nn/layer/pooling.py MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, o = self.a
+        return F.max_unpool2d(x, indices, k, s, p, data_format=df,
+                              output_size=o)
+
+
+class MaxUnPool3D(Layer):
+    """reference: nn/layer/pooling.py MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, o = self.a
+        return F.max_unpool3d(x, indices, k, s, p, data_format=df,
+                              output_size=o)
+
+
+class FractionalMaxPool2D(Layer):
+    """reference: nn/layer/pooling.py FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self.a
+        return F.fractional_max_pool2d(x, o, k, u, m)
+
+
+class FractionalMaxPool3D(Layer):
+    """reference: nn/layer/pooling.py FractionalMaxPool3D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self.a
+        return F.fractional_max_pool3d(x, o, k, u, m)
+
+
+class ParameterDict(Layer):
+    """reference: nn/layer/container.py ParameterDict."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, param):
+        self.add_parameter(key, param)
+
+    def __delitem__(self, key):
+        del self._parameters[key]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        items = parameters.items() if isinstance(parameters, dict) \
+            else parameters
+        for k, v in items:
+            self[k] = v
+
+
+# ---------------- loss layers ----------------
+class _LossLayer(Layer):
+    def __init__(self, fn, **kw):
+        super().__init__()
+        self._fn = fn
+        self._kw = kw
+
+    def forward(self, *args):
+        return self._fn(*args, **self._kw)
+
+
+class SoftMarginLoss(_LossLayer):
+    """reference: nn/layer/loss.py SoftMarginLoss."""
+
+    def __init__(self, reduction="mean", name=None):
+        super().__init__(FX.soft_margin_loss, reduction=reduction)
+
+
+class MultiLabelSoftMarginLoss(_LossLayer):
+    """reference: nn/layer/loss.py MultiLabelSoftMarginLoss."""
+
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__(FX.multi_label_soft_margin_loss, weight=weight,
+                         reduction=reduction)
+
+
+class MultiMarginLoss(_LossLayer):
+    """reference: nn/layer/loss.py MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__(FX.multi_margin_loss, p=p, margin=margin,
+                         weight=weight, reduction=reduction)
+
+
+class PoissonNLLLoss(_LossLayer):
+    """reference: nn/layer/loss.py PoissonNLLLoss."""
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(FX.poisson_nll_loss, log_input=log_input,
+                         full=full, epsilon=epsilon, reduction=reduction)
+
+
+class GaussianNLLLoss(_LossLayer):
+    """reference: nn/layer/loss.py GaussianNLLLoss."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__(FX.gaussian_nll_loss, full=full, epsilon=epsilon,
+                         reduction=reduction)
+
+
+class TripletMarginWithDistanceLoss(_LossLayer):
+    """reference: nn/layer/loss.py TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(FX.triplet_margin_with_distance_loss,
+                         distance_function=distance_function,
+                         margin=margin, swap=swap, reduction=reduction)
+
+
+class RNNTLoss(_LossLayer):
+    """reference: nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__(FX.rnnt_loss, blank=blank,
+                         fastemit_lambda=fastemit_lambda,
+                         reduction=reduction)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss — holds the internal-node
+    weight table (num_classes-1 rows for the default complete tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2 and not is_custom:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        import numpy as np
+        rows = num_classes if is_custom else max(1, num_classes - 1)
+        rng = np.random.default_rng(0)
+        bound = (6.0 / (rows + feature_size)) ** 0.5
+        self.weight = Parameter(rng.uniform(
+            -bound, bound, (rows, feature_size)).astype(np.float32))
+        if bias_attr is not False:
+            self.bias = Parameter(np.zeros((rows, 1), np.float32))
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return FX.hsigmoid_loss(input, label, self.num_classes,
+                                self.weight, self.bias,
+                                path_table=path_table,
+                                path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — head over
+    [shortlist + clusters], factorized tails with div_value shrinkage."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > (n_classes - 1)
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError(
+                "cutoffs should be a sequence of unique, positive, "
+                "increasing integers < n_classes - 1")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        import numpy as np
+        rng = np.random.default_rng(0)
+        n_head = cutoffs[0] + len(cutoffs)
+        b = (6.0 / (in_features + n_head)) ** 0.5
+        self.head_weight = Parameter(rng.uniform(
+            -b, b, (in_features, n_head)).astype(np.float32))
+        self.head_bias = (Parameter(np.zeros((n_head,), np.float32))
+                          if head_bias else None)
+        self._tails = []
+        for i in range(len(cutoffs)):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = Parameter(rng.uniform(
+                -b, b, (in_features, hsz)).astype(np.float32))
+            w2 = Parameter(rng.uniform(
+                -b, b, (hsz, osz)).astype(np.float32))
+            self.add_parameter(f"tail_{i}_0", w1)
+            self.add_parameter(f"tail_{i}_1", w2)
+            self._tails.append((w1, w2))
+
+    def forward(self, input, label):
+        return FX.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self._tails, self.cutoffs,
+            head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full (B, n_classes) log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+        from ...ops._registry import as_tensor, raw
+        from ..._core.autograd import apply
+        args = [as_tensor(input), self.head_weight]
+        if self.head_bias is not None:
+            args.append(self.head_bias)
+        for w1, w2 in self._tails:
+            args.extend((w1, w2))
+        shortlist = self.cutoffs[0]
+        cuts = self.cutoffs
+
+        def f(xv, hw, *rest):
+            off = 1 if self.head_bias is not None else 0
+            hl = xv @ hw
+            if off:
+                hl = hl + rest[0]
+            head = jax.nn.log_softmax(hl, axis=-1)
+            parts = [head[:, :shortlist]]
+            for i in range(len(self._tails)):
+                w1, w2 = rest[off + 2 * i], rest[off + 2 * i + 1]
+                tail = jax.nn.log_softmax((xv @ w1) @ w2, axis=-1)
+                parts.append(head[:, shortlist + i:shortlist + i + 1]
+                             + tail)
+            return jnp.concatenate(parts, axis=1)
+        return apply(f, *args, name="adaptive_log_prob")
+
+    def predict(self, input):
+        from ...ops.search import argmax
+        return argmax(self.log_prob(input), axis=-1)
+
+
+class BeamSearchDecoder:
+    """reference: nn/decode.py BeamSearchDecoder — beam expansion around
+    an RNN cell; drive it with :func:`dynamic_decode`."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """reference: nn/decode.py dynamic_decode — run beam search with
+    ``decoder`` until all beams emit ``end_token`` or ``max_step_num``.
+
+    Host-driven loop (eager decode utility; the jit serving path is
+    models/generate.py). Returns (ids, scores) — ids (B, T_out,
+    beam_size) like the reference — plus sequence lengths when
+    ``return_length``."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    cell = decoder.cell
+    beam = decoder.beam_size
+    state = inits
+    # infer batch from the initial state pytree
+    first = state[0] if isinstance(state, (tuple, list)) else state
+    B = int((first._value if isinstance(first, Tensor)
+             else jnp.asarray(first)).shape[0])
+
+    # beams: log-probs (B, beam), tokens so far
+    log_probs = np.full((B, beam), -np.inf, np.float32)
+    log_probs[:, 0] = 0.0
+    tokens = np.full((B, beam, 0), decoder.start_token, np.int64)
+    cur = np.full((B, beam), decoder.start_token, np.int64)
+    finished = np.zeros((B, beam), bool)
+
+    def tile_state(s):
+        def rep(t):
+            v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            return Tensor(jnp.repeat(v, beam, axis=0), _internal=True)
+        if isinstance(s, (tuple, list)):
+            return type(s)(rep(x) for x in s)
+        return rep(s)
+
+    state = tile_state(state)
+    steps = max_step_num or 32
+    lengths = np.zeros((B, beam), np.int64)
+    for _step in range(steps):
+        inp = Tensor(jnp.asarray(cur.reshape(-1)), _internal=True)
+        if decoder.embedding_fn is not None:
+            inp = decoder.embedding_fn(inp)
+        out, state = cell(inp, state)
+        if decoder.output_fn is not None:
+            out = decoder.output_fn(out)
+        logp = np.array(jax.nn.log_softmax(
+            out._value if isinstance(out, Tensor) else jnp.asarray(out),
+            axis=-1)).reshape(B, beam, -1)
+        V = logp.shape[-1]
+        logp[finished] = -np.inf
+        logp[finished, decoder.end_token] = 0.0
+        total = log_probs[:, :, None] + logp            # (B, beam, V)
+        flat = total.reshape(B, -1)
+        top = np.argsort(-flat, axis=1)[:, :beam]
+        log_probs = np.take_along_axis(flat, top, axis=1)
+        parent = top // V
+        cur = (top % V).astype(np.int64)
+        tokens = np.take_along_axis(
+            tokens, parent[:, :, None], axis=1)
+        tokens = np.concatenate([tokens, cur[:, :, None]], axis=2)
+        finished = np.take_along_axis(finished, parent, axis=1)
+        lengths = np.take_along_axis(lengths, parent, axis=1)
+        lengths = np.where(finished, lengths, lengths + 1)
+        finished = finished | (cur == decoder.end_token)
+
+        # reorder the cell state by parent beam
+        def reorder(s):
+            def ro(t):
+                v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                vb = v.reshape(B, beam, *v.shape[1:])
+                idx = jnp.asarray(parent)
+                vb = jnp.take_along_axis(
+                    vb, idx.reshape(B, beam, *([1] * (vb.ndim - 2))),
+                    axis=1)
+                return Tensor(vb.reshape(B * beam, *v.shape[1:]),
+                              _internal=True)
+            if isinstance(s, (tuple, list)):
+                return type(s)(ro(x) for x in s)
+            return ro(s)
+        state = reorder(state)
+        if finished.all():
+            break
+
+    ids = np.transpose(tokens, (0, 2, 1))              # (B, T, beam)
+    ids_t = Tensor(jnp.asarray(ids), _internal=True)
+    scores_t = Tensor(jnp.asarray(log_probs), _internal=True)
+    if return_length:
+        return ids_t, scores_t, Tensor(jnp.asarray(lengths),
+                                       _internal=True)
+    return ids_t, scores_t

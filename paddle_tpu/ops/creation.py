@@ -207,3 +207,10 @@ def complex(real, imag, name=None):
 def polar(abs, angle, name=None):
     return apply(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
                  as_tensor(abs), as_tensor(angle), name="polar")
+
+
+@register("cast", tensor_method=False)
+def cast(x, dtype, name=None):
+    """reference: tensor/manipulation.py cast — functional dtype cast
+    (the Tensor.cast method's standalone form)."""
+    return as_tensor(x).cast(dtype)

@@ -544,3 +544,21 @@ def flatten_(x, start_axis=0, stop_axis=-1, name=None):
 
 
 TENSOR_METHODS["flatten_"] = flatten_
+
+
+@register("index_add_", tensor_method=False)
+def index_add_(x, index, axis, value, name=None):
+    """reference: manipulation.py index_add_ — in-place variant."""
+    x = as_tensor(x)
+    out = index_add(x, index, axis, value)
+    x._inplace_assign(out._value, node=out._node, out_index=out._out_index)
+    return x
+
+
+@register("index_put_", tensor_method=False)
+def index_put_(x, indices, value, accumulate=False, name=None):
+    """reference: manipulation.py index_put_ — in-place variant."""
+    x = as_tensor(x)
+    out = index_put(x, indices, value, accumulate)
+    x._inplace_assign(out._value, node=out._node, out_index=out._out_index)
+    return x

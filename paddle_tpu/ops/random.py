@@ -170,3 +170,34 @@ def rand_like(x, dtype=None, name=None):
 def randn_like(x, dtype=None, name=None):
     x = as_tensor(x)
     return randn(x.shape, dtype or x.dtype)
+
+
+@register("cauchy_", tensor_method=False)
+def cauchy_(x, loc=0, scale=1, name=None):
+    """reference: tensor/random.py cauchy_ — in-place Cauchy fill."""
+    x = as_tensor(x)
+    v = jax.random.cauchy(next_rng_key(), tuple(x.shape), x.dtype)
+    x._inplace_assign(loc + scale * v)
+    return x
+
+
+@register("geometric_", tensor_method=False)
+def geometric_(x, probs, name=None):
+    """reference: tensor/random.py geometric_ — in-place geometric fill
+    (number of trials until first success, support {1, 2, ...})."""
+    x = as_tensor(x)
+    u = jax.random.uniform(next_rng_key(), tuple(x.shape), jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+    v = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.float32(probs)))
+    x._inplace_assign(jnp.maximum(v, 1.0).astype(x.dtype))
+    return x
+
+
+@register("log_normal_", tensor_method=False)
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """reference: tensor/random.py log_normal_ — in-place exp(N(mean, std))."""
+    x = as_tensor(x)
+    v = jnp.exp(mean + std * jax.random.normal(next_rng_key(),
+                                               tuple(x.shape), x.dtype))
+    x._inplace_assign(v)
+    return x

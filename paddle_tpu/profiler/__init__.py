@@ -16,3 +16,27 @@ from .profiler import (  # noqa: F401
 from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401
 from .utils import benchmark  # noqa: F401
 from . import timer  # noqa: F401
+
+import enum as _enum
+
+
+class SummaryView(_enum.Enum):
+    """reference: profiler/profiler.py:55 SummaryView."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name=None, worker_name=None):
+    """reference: profiler/profiler.py export_protobuf — returns a
+    Profiler on_trace_ready handler. The TPU build's canonical trace
+    format is chrome-trace JSON (plus jax.profiler device traces), so
+    this delegates to export_chrome_tracing with the same signature."""
+    from .profiler import export_chrome_tracing
+    return export_chrome_tracing(dir_name, worker_name)

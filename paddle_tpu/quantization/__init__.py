@@ -14,3 +14,20 @@ from .quanters import (  # noqa: F401
 )
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
+
+# base classes + decorator (reference: quantization/factory.py quanter,
+# base_observer.py BaseObserver, base_quanter.py BaseQuanter)
+from .observers import BaseObserver  # noqa: E402,F401
+from .quanters import BaseQuanter  # noqa: E402,F401
+
+
+def quanter(class_name):
+    """reference: quantization/factory.py quanter — decorator registering
+    a quanter factory under ``class_name`` for QuantConfig lookup."""
+    def deco(cls):
+        import sys as _sys
+        mod = _sys.modules[cls.__module__]
+        setattr(mod, class_name, cls)
+        globals()[class_name] = cls
+        return cls
+    return deco

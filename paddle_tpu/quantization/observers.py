@@ -9,6 +9,18 @@ from ..nn.layer.layers import Layer
 from ..ops._registry import as_tensor
 
 
+class BaseObserver(Layer):
+    """reference: quantization/base_observer.py — the extension point for
+    statistic-collecting layers: implement forward() (collect + pass
+    through) and scales()."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
 class ObserverFactory:
     def __init__(self, **kwargs):
         self._kwargs = kwargs
@@ -25,7 +37,7 @@ class AbsmaxObserver(ObserverFactory):
         self._cls = AbsmaxObserverLayer
 
 
-class AbsmaxObserverLayer(Layer):
+class AbsmaxObserverLayer(BaseObserver):
     def __init__(self, quant_bits=8):
         super().__init__()
         self._quant_bits = quant_bits

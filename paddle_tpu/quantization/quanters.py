@@ -42,7 +42,15 @@ def dequant(x, scale, bit_length=8):
         x, as_tensor(scale), name="dequant")
 
 
-class FakeQuanterWithAbsMaxObserver(Layer):
+from .observers import BaseObserver
+
+
+class BaseQuanter(BaseObserver):
+    """reference: quantization/base_quanter.py — trainable
+    fake-quant layers extend the observer protocol."""
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """Activation fake-quant with moving-average abs-max scale
     (reference: quanters/abs_max.py; static counterpart
     fake_quantize_moving_average_abs_max op)."""

@@ -18,5 +18,8 @@ from .ops import (  # noqa: F401
     add, subtract, multiply, divide, matmul, masked_matmul, relu, abs, sin,
     tanh, pow, neg, cast, transpose, sum, sparse_coo_tensor_values_like,
     coalesce, values, indices, divide_scalar, mask_as,
+    sqrt, square, log1p, expm1, asin, atan, sinh, asinh, atanh,
+    deg2rad, rad2deg, tan, isnan, is_same_shape, addmm, mv, reshape,
+    slice, pca_lowrank,
 )
 from . import nn  # noqa: F401

@@ -174,3 +174,85 @@ def mask_as(x, mask, name=None):
         from .tensor import to_sparse_csr
         return to_sparse_csr(out)
     return out
+
+
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+tan = _unary(jnp.tan)
+isnan = _unary(jnp.isnan)
+
+
+def is_same_shape(x, y) -> bool:
+    """reference: sparse/binary.py is_same_shape."""
+    xs = x.shape if not hasattr(x, "dense_shape") else x.dense_shape
+    ys = y.shape if not hasattr(y, "dense_shape") else y.dense_shape
+    return tuple(xs) == tuple(ys)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """reference: sparse/multiary.py addmm — beta*input + alpha*(x@y),
+    sparse x with dense input/y -> dense."""
+    return input * beta + matmul(x, y) * alpha
+
+
+def mv(x, vec, name=None):
+    """reference: sparse/matmul.py mv — sparse matrix x dense vector."""
+    from ..ops.manipulation import squeeze, unsqueeze
+    return squeeze(matmul(x, unsqueeze(vec, -1)), -1)
+
+
+def reshape(x, shape, name=None):
+    """reference: sparse/unary.py reshape — COO/CSR reshape via the dense
+    layout (host-sized sparse tensors; the TPU compute path densifies
+    anyway)."""
+    from .tensor import to_sparse_coo, to_sparse_csr, is_sparse_csr
+    from ..ops.manipulation import reshape as dense_reshape
+    d = dense_reshape(x.to_dense(), shape)
+    if is_sparse_csr(x):
+        return to_sparse_csr(d)
+    return to_sparse_coo(d, len(d.shape))
+
+
+_py_slice = slice  # captured before the op below shadows the builtin
+
+
+def slice(x, axes, starts, ends, name=None):
+    """reference: sparse/unary.py slice — via the dense layout."""
+    from .tensor import to_sparse_coo, to_sparse_csr, is_sparse_csr
+    d = x.to_dense()
+    slicer = [_py_slice(None)] * len(d.shape)
+    for ax, st, en in zip(axes, starts, ends):
+        slicer[ax] = _py_slice(st, en)
+    out = d[tuple(slicer)]
+    if is_sparse_csr(x):
+        return to_sparse_csr(out)
+    return to_sparse_coo(out, len(out.shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: sparse/pca_lowrank (tensor/linalg pca_lowrank) —
+    randomized PCA: returns (U, S, V) with x ~ U diag(S) V^T."""
+    from .._core.autograd import apply as _apply
+    from ..ops._registry import as_tensor as _at
+    from .tensor import SparseCooTensor, SparseCsrTensor
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        x = x.to_dense()
+    x = _at(x)
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    import numpy as _np
+    g = _np.random.RandomState(0).randn(n, q).astype(_np.float32)
+
+    def f(v):
+        a = v.astype(jnp.float32)
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        y = a @ g
+        for _ in range(niter):
+            y = a @ (a.T @ y)
+        qm, _ = jnp.linalg.qr(y)
+        b = qm.T @ a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return qm @ u, s, vt.T
+    return _apply(f, x, name="pca_lowrank", multi_out=True)

@@ -102,13 +102,15 @@ class Program:
 
 
 def default_main_program():
-    if not hasattr(_state, "main_program"):
+    # None also means "unset": program_guard restores None after a scope
+    # entered before any default program existed
+    if getattr(_state, "main_program", None) is None:
         _state.main_program = Program()
     return _state.main_program
 
 
 def default_startup_program():
-    if not hasattr(_state, "startup_program"):
+    if getattr(_state, "startup_program", None) is None:
         _state.startup_program = Program()
     return _state.startup_program
 
@@ -164,7 +166,11 @@ class Executor:
             if ph is None:
                 raise KeyError(f"feed target {name!r} is not a "
                                f"static.data placeholder of this program")
-            env[id(ph)] = jnp.asarray(np.asarray(val))
+            if isinstance(val, Tensor):
+                val = val._value
+            # jnp.asarray passes traced arrays through (the feed may be a
+            # tracer when save_inference_model exports the replay)
+            env[id(ph)] = jnp.asarray(val)
 
         def resolve(a):
             if isinstance(a, Tensor):
@@ -224,3 +230,21 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 
 # re-exports for static-style model code
 from ..nn import *  # noqa: F401,F403,E402
+
+from .extras import (  # noqa: F401,E402
+    Variable, cpu_places, cuda_places, xpu_places, Scope, global_scope,
+    scope_guard, name_scope, device_guard, save, load, load_program_state,
+    set_program_state, serialize_program, deserialize_program,
+    serialize_persistables, deserialize_persistables, save_to_file,
+    load_from_file, normalize_program, save_inference_model,
+    load_inference_model, create_global_var, Print, accuracy, auc,
+    ctr_metric_bundle, append_backward, py_func, WeightNormParamAttr,
+    ExponentialMovingAverage,
+)
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: static create_parameter — the top-level factory."""
+    import paddle_tpu as _p
+    return _p.create_parameter(shape, dtype, name=name, attr=attr,
+                               is_bias=is_bias,
+                               default_initializer=default_initializer)

@@ -1,0 +1,471 @@
+"""Static-graph surface long tail (reference: python/paddle/static/ —
+__init__.py exports; io.py save/load/save_inference_model:?; base/
+framework.py name_scope/device_guard; base/executor.py scope utilities;
+incubate ExponentialMovingAverage lives at static level in the reference).
+
+The TPU static mode records eagerly-executed ops and replays them
+(static/__init__.py); these utilities operate on that Program plus the
+live Parameter objects captured during recording.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, Parameter
+from .._core.autograd import apply, no_grad
+from ..ops._registry import as_tensor
+
+Variable = Tensor  # reference: base/framework.py Variable — the Tensor IS it
+
+
+# ---------------- places ----------------
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..device import CUDAPlace
+    import jax
+    ids = device_ids if device_ids is not None else \
+        range(jax.device_count())
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..device import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+# ---------------- scopes / guards ----------------
+class Scope:
+    """reference: paddle/fluid/framework/scope.h:50 — named variable
+    container."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, _ScopeVar(name))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def drop_kids(self):
+        pass
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._tensor = None
+
+    def get_tensor(self):
+        return self._tensor
+
+    def set_tensor(self, t):
+        self._tensor = t
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+_name_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix: Optional[str] = None):
+    """reference: base/framework.py:7962-adjacent name_scope — nested op
+    name prefixes (cosmetic in the recorded program)."""
+    _name_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_stack.pop()
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """reference: base/framework.py device_guard — device placement hint;
+    XLA owns placement on TPU, so this is a recorded annotation."""
+    yield
+
+
+# ---------------- program state / IO ----------------
+def _program_params(program) -> Dict[str, Tensor]:
+    """Parameters captured while recording ``program`` (op args that are
+    Parameter instances)."""
+    from . import Program, default_main_program
+    prog = program if program is not None else default_main_program()
+    out: Dict[str, Tensor] = {}
+    seen = set()
+    for entry in getattr(prog, "ops", []):
+        if entry[0] == "bind":
+            continue
+        _fn, args, _outs = entry
+        for a in args:
+            if isinstance(a, Parameter) and id(a) not in seen:
+                seen.add(id(a))
+                name = getattr(a, "name", None) or f"param_{len(out)}"
+                out[name] = a
+    return out
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference: static/io.py save — persist the program's parameters."""
+    from ..framework.io import save as _save
+    _save({k: v for k, v in _program_params(program).items()},
+          model_path + ".pdparams" if not model_path.endswith(".pdparams")
+          else model_path)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference: static/io.py load."""
+    from ..framework.io import load as _load
+    path = model_path + ".pdparams" if not \
+        model_path.endswith(".pdparams") else model_path
+    state = _load(path)
+    params = _program_params(program)
+    with no_grad():
+        for k, p in params.items():
+            if k in state:
+                v = state[k]
+                p._inplace_assign(v._value if isinstance(v, Tensor)
+                                  else jnp.asarray(np.asarray(v)))
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: static/io.py load_program_state."""
+    from ..framework.io import load as _load
+    path = model_path + ".pdparams" if not \
+        model_path.endswith(".pdparams") else model_path
+    st = _load(path)
+    return {k: (np.asarray(v._value) if isinstance(v, Tensor)
+                else np.asarray(v)) for k, v in st.items()}
+
+
+def set_program_state(program, state_dict):
+    """reference: static/io.py set_program_state."""
+    params = _program_params(program)
+    with no_grad():
+        for k, p in params.items():
+            if k in state_dict:
+                p._inplace_assign(jnp.asarray(np.asarray(state_dict[k])))
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs) -> bytes:
+    """reference: static/io.py serialize_program — program structure as
+    bytes (placeholder names + op count; the executable form is
+    save_inference_model's jit artifact)."""
+    from . import default_main_program
+    prog = program or default_main_program()
+    meta = {"placeholders": list(prog.placeholders.keys()),
+            "num_ops": len(prog.ops)}
+    return pickle.dumps(meta, protocol=4)
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs) -> bytes:
+    params = _program_params(program)
+    return pickle.dumps({k: np.asarray(v._value)
+                         for k, v in params.items()}, protocol=4)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: static/io.py normalize_program — prune to the
+    feed->fetch slice. The recorded program replays only what resolves,
+    so pruning is implicit; returned as-is."""
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: static/io.py save_inference_model — persist a
+    feed->fetch callable. TPU-native: trace the Program replay into a
+    jit.save (StableHLO) artifact."""
+    from . import Executor, default_main_program
+    from ..jit import save as jit_save
+    from ..jit.api import InputSpec
+    prog = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    ex = Executor()
+
+    from ..nn.layer.layers import Layer as _Layer
+
+    class _ReplayModule(_Layer):
+        """jit.save exports compiled programs for Layers; the recorded
+        replay is wrapped as one (captured Parameters become constants
+        in the exported StableHLO — an inference artifact)."""
+
+        def forward(self, *feeds):
+            feed = {fv._placeholder_name: t
+                    for fv, t in zip(feed_vars, feeds)}
+            outs = ex.run(prog, feed=feed, fetch_list=list(fetch_vars),
+                          return_numpy=False)
+            return tuple(outs) if len(outs) > 1 else outs[0]
+
+    specs = [InputSpec(list(fv.shape), str(fv.dtype).split(".")[-1])
+             for fv in feed_vars]
+    jit_save(_ReplayModule(), path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """reference: static/io.py load_inference_model — returns
+    [program(=loaded callable), feed_names, fetch_targets]."""
+    from ..jit import load as jit_load
+    loaded = jit_load(path_prefix)
+    return [loaded, list(getattr(loaded, "_input_names", [])), None]
+
+
+# ---------------- ops / helpers ----------------
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: tensor/creation.py create_global_var."""
+    t = Tensor(jnp.full(tuple(shape), value,
+                        _cv(dtype)), _internal=True)
+    t.stop_gradient = True
+    t.persistable = persistable
+    if name:
+        t.name = name
+    sv = global_scope().var(name or f"gvar_{id(t)}")
+    sv.set_tensor(t)
+    return t
+
+
+def _cv(dtype):
+    from .._core.dtype import convert_dtype
+    return convert_dtype(dtype)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: static/nn/control_flow.py Print — debug-print the
+    tensor when the op runs (eagerly AND on every Executor replay)."""
+    x = as_tensor(input)
+    msg = message or ""
+    state = {"n": 0}
+
+    def f(v):
+        if first_n < 0 or state["n"] < first_n:
+            state["n"] += 1
+            head = f"{msg} " if msg else ""
+            print(f"{head}shape={tuple(v.shape)} dtype={v.dtype} "
+                  f"values={np.asarray(v).reshape(-1)[:summarize]}")
+        return v
+    return apply(f, x, name="print")
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference: static/nn/metric.py accuracy (top-k)."""
+    from ..metric.metrics import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """reference: static/nn/metric.py auc — returns (auc_out,
+    batch_auc_out, [state vars]); single-batch trapezoidal AUC here (the
+    streaming state lives in paddle_tpu.metric.Auc for the dygraph
+    path)."""
+    x = as_tensor(input)
+    y = as_tensor(label)
+
+    def f(p, t):
+        pos_score = p[:, 1] if p.ndim == 2 and p.shape[1] == 2 else \
+            p.reshape(-1)
+        t = t.reshape(-1).astype(jnp.float32)
+        thr = jnp.linspace(0.0, 1.0, num_thresholds + 1)
+        pred_pos = pos_score[None, :] >= thr[:, None]
+        tp = jnp.sum(pred_pos * t[None, :], axis=1)
+        fp = jnp.sum(pred_pos * (1 - t)[None, :], axis=1)
+        pos = jnp.maximum(jnp.sum(t), 1e-12)
+        neg = jnp.maximum(jnp.sum(1 - t), 1e-12)
+        tpr = tp / pos
+        fpr = fp / neg
+        return -jnp.trapezoid(tpr, fpr)
+    a = apply(f, x, y, name="auc")
+    return a, a, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference: static/nn/metric.py ctr_metric_bundle — (sqrerr, abserr,
+    prob, q, pos, total) running CTR metrics, single-batch form."""
+    x = as_tensor(input)
+    y = as_tensor(label)
+
+    def f(p, t):
+        p = p.reshape(-1)
+        t = t.reshape(-1).astype(jnp.float32)
+        sqrerr = jnp.sum((p - t) ** 2)
+        abserr = jnp.sum(jnp.abs(p - t))
+        prob = jnp.sum(p)
+        q = jnp.sum(p * p)
+        pos = jnp.sum(t)
+        total = jnp.float32(t.shape[0])
+        return sqrerr, abserr, prob, q, pos, total
+    return apply(f, x, y, name="ctr_metric_bundle", multi_out=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: base/backward.py append_backward — static autodiff:
+    returns [(param, grad)] for trainable params reaching ``loss``. The
+    gradient computation itself is recorded onto the program (apply-based
+    VJPs), so Executor replays include it."""
+    from ..autograd.functional import grad as _grad
+    from . import default_main_program
+    params = parameter_list
+    if params is None:
+        params = list(_program_params(default_main_program()).values())
+    params = [p for p in params
+              if isinstance(p, Tensor) and not p.stop_gradient]
+    grads = _grad(loss, params, retain_graph=True, allow_unused=True)
+    return [(p, g) for p, g in zip(params, grads) if g is not None]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static/nn/common.py py_func — embed a python callable as
+    an op. Replay calls the python function again (the replay engine is
+    host-side, like the reference's CPU py_func op)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [as_tensor(v) for v in xs]
+
+    def f(*vals):
+        ts = [Tensor(v, _internal=True) for v in vals]
+        res = func(*ts)
+        rs = res if isinstance(res, (tuple, list)) else [res]
+        vals_out = tuple(r._value if isinstance(r, Tensor)
+                         else jnp.asarray(np.asarray(r)) for r in rs)
+        return vals_out if len(vals_out) > 1 else vals_out[0]
+
+    result = apply(f, *xs, name="py_func",
+                   multi_out=isinstance(out, (list, tuple)))
+    return result
+
+
+class WeightNormParamAttr:
+    """reference: static/nn/common.py WeightNormParamAttr — ParamAttr that
+    requests the weight_norm reparametrization (consumed by nn.utils)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """reference: static/__init__.py ExponentialMovingAverage — shadow
+    EMA of every trainable parameter; ``update()`` after each step,
+    ``apply()``/``restore()`` swap for evaluation (with the reference's
+    bias-corrected decay when ``thres_steps`` is None)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._step = 0
+        self._shadow: Dict[int, Any] = {}
+        self._backup: Dict[int, Any] = {}
+        self._params: List[Tensor] = []
+
+    def _tracked(self):
+        if not self._params:
+            from . import default_main_program
+            self._params = list(
+                _program_params(default_main_program()).values())
+        return self._params
+
+    def register(self, parameters):
+        self._params = [p for p in parameters if not p.stop_gradient]
+
+    @no_grad()
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._tracked():
+            prev = self._shadow.get(id(p))
+            if prev is None:
+                self._shadow[id(p)] = p._value
+            else:
+                self._shadow[id(p)] = d * prev + (1 - d) * p._value
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        for p in self._tracked():
+            sh = self._shadow.get(id(p))
+            if sh is not None:
+                self._backup[id(p)] = p._value
+                p._inplace_assign(sh)
+        return _EMAGuard(self, need_restore)
+
+    @no_grad()
+    def restore(self, executor=None):
+        for p in self._tracked():
+            bk = self._backup.pop(id(p), None)
+            if bk is not None:
+                p._inplace_assign(bk)
+
+
+class _EMAGuard:
+    def __init__(self, ema, need_restore):
+        self._ema = ema
+        self._need_restore = need_restore
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._need_restore:
+            self._ema.restore()
+        return False

@@ -7,4 +7,5 @@ Datasets require downloads (zero-egress here): constructors accept
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 from .datasets import (  # noqa: F401
     Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+    Conll05st,
 )

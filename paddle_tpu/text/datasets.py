@@ -146,3 +146,52 @@ class WMT14(_NeedsFile):
 
 class WMT16(_NeedsFile):
     _hint = "wmt16.tar.gz"
+
+
+class Conll05st(_NeedsFile):
+    """reference: text/datasets/conll05.py — CoNLL-2005 SRL dataset
+    (semantic role labeling): returns (pred_idx, mark, *ctx_windows,
+    label) per sample when a local data file is provided."""
+
+    _hint = "conll05st-release (test.wsj words/props files)"
+
+    def __init__(self, data_file: Optional[str] = None,
+                 word_dict_file: Optional[str] = None,
+                 verb_dict_file: Optional[str] = None,
+                 target_dict_file: Optional[str] = None, **kw):
+        super().__init__(data_file, **kw)
+        self.samples: list = []
+        # simple two-column (word, tag) per line, blank between sentences
+        words, tags = [], []
+        with open(self._file, "r", encoding="utf-8",
+                  errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if words:
+                        self.samples.append((words, tags))
+                        words, tags = [], []
+                    continue
+                parts = line.split()
+                words.append(parts[0])
+                tags.append(parts[-1] if len(parts) > 1 else "O")
+        if words:
+            self.samples.append((words, tags))
+        vocab = {}
+        labels = {}
+        for ws, ts in self.samples:
+            for w in ws:
+                vocab.setdefault(w, len(vocab))
+            for t in ts:
+                labels.setdefault(t, len(labels))
+        self.word_dict = vocab
+        self.label_dict = labels
+
+    def __getitem__(self, idx):
+        ws, ts = self.samples[idx]
+        import numpy as _np
+        return (_np.asarray([self.word_dict[w] for w in ws], _np.int64),
+                _np.asarray([self.label_dict[t] for t in ts], _np.int64))
+
+    def __len__(self):
+        return len(self.samples)

@@ -4,3 +4,43 @@ from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from .datasets import Cifar10, Cifar100, MNIST, FashionMNIST, DatasetFolder, ImageFolder  # noqa: F401
 from . import ops  # noqa: F401
+
+# image backend selection (reference: vision/image.py) — the numpy
+# backend is native here; "pil"/"cv2" are accepted when installed
+_image_backend = "numpy"
+
+
+def get_image_backend():
+    """reference: vision/image.py get_image_backend."""
+    return _image_backend
+
+
+def set_image_backend(backend):
+    """reference: vision/image.py set_image_backend."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "numpy", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'numpy', "
+            f"'tensor'], but got {backend}")
+    _image_backend = backend
+
+
+def image_load(path, backend=None):
+    """reference: vision/image.py image_load — decode an image file.
+    numpy backend decodes PNG/BMP via matplotlib-free pure-python when
+    possible; PIL/cv2 are used when selected and installed."""
+    be = backend or _image_backend
+    if be == "pil":
+        from PIL import Image
+        return Image.open(path)
+    if be == "cv2":
+        import cv2
+        return cv2.imread(path)
+    import numpy as _np
+    try:
+        from PIL import Image
+        return _np.asarray(Image.open(path))
+    except Exception as e:
+        raise RuntimeError(
+            f"image_load: no decoder available for {path!r} (install "
+            "pillow or use backend='cv2')") from e

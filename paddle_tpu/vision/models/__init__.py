@@ -3,6 +3,7 @@ from .resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
     resnet101, resnet152, resnext50_32x4d, resnext101_32x4d,
     resnext152_32x4d, wide_resnet50_2, wide_resnet101_2,
+    resnext50_64x4d, resnext101_64x4d, resnext152_64x4d,
 )
 from .lenet import LeNet  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
@@ -14,8 +15,9 @@ from .extra_nets import (  # noqa: F401
     SqueezeNet, squeezenet1_0, squeezenet1_1,
     DenseNet, densenet121, densenet161, densenet169, densenet201,
     densenet264,
-    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
-    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish,
     MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
     mobilenet_v3_small,
     GoogLeNet, googlenet, InceptionV3, inception_v3,

@@ -28,7 +28,7 @@ class _ConvBNAct(nn.Layer):
                               bias_attr=False)
         self.bn = nn.BatchNorm2D(out_c)
         self.act = {"relu": nn.ReLU(), "hardswish": nn.Hardswish(),
-                    None: None}[act]
+                    "swish": nn.Swish(), None: None}[act]
 
     def forward(self, x):
         x = self.bn(self.conv(x))
@@ -211,7 +211,7 @@ def _channel_shuffle(x, groups):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_c = out_c // 2
@@ -220,17 +220,17 @@ class _ShuffleUnit(nn.Layer):
                 nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
                           bias_attr=False),
                 nn.BatchNorm2D(in_c),
-                _ConvBNAct(in_c, branch_c, 1, 1, 0))
+                _ConvBNAct(in_c, branch_c, 1, 1, 0, act=act))
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
-            _ConvBNAct(b2_in, branch_c, 1, 1, 0),
+            _ConvBNAct(b2_in, branch_c, 1, 1, 0, act=act),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
-            _ConvBNAct(branch_c, branch_c, 1, 1, 0))
+            _ConvBNAct(branch_c, branch_c, 1, 1, 0, act=act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -259,17 +259,18 @@ class ShuffleNetV2(nn.Layer):
         self.num_classes = num_classes
         self.with_pool = with_pool
         stem_c, stage_cs, final_c = _SHUFFLE_CFG[scale]
-        self.conv1 = _ConvBNAct(3, stem_c, 3, 2, 1)
+        self.conv1 = _ConvBNAct(3, stem_c, 3, 2, 1, act=act)
         self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         in_c = stem_c
         for sc, repeat in zip(stage_cs, (4, 8, 4)):
-            units = [_ShuffleUnit(in_c, sc, 2)]
-            units += [_ShuffleUnit(sc, sc, 1) for _ in range(repeat - 1)]
+            units = [_ShuffleUnit(in_c, sc, 2, act=act)]
+            units += [_ShuffleUnit(sc, sc, 1, act=act)
+                      for _ in range(repeat - 1)]
             stages.append(nn.Sequential(*units))
             in_c = sc
         self.stages = nn.LayerList(stages)
-        self.conv_last = _ConvBNAct(in_c, final_c, 1, 1, 0)
+        self.conv_last = _ConvBNAct(in_c, final_c, 1, 1, 0, act=act)
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -530,3 +531,13 @@ class InceptionV3(nn.Layer):
 def inception_v3(pretrained=False, **kw):
     _no_pretrained(pretrained, "inception_v3")
     return InceptionV3(**kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_x0_33")
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    _no_pretrained(pretrained, "shufflenet_v2_swish")
+    return ShuffleNetV2(1.0, act="swish", **kw)

@@ -14,9 +14,15 @@ import jax
 import jax.numpy as jnp
 
 from .._core.tensor import Tensor
+from .._core.autograd import apply
 from ..ops._registry import as_tensor, raw
+from ..nn.layer.layers import Layer
 
-__all__ = ["nms", "box_iou"]
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "psroi_pool",
+           "box_coder", "prior_box", "yolo_box", "yolo_loss",
+           "matrix_nms", "deform_conv2d", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg",
+           "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D"]
 
 
 def box_iou(boxes1, boxes2, name=None):
@@ -64,3 +70,822 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     if top_k is not None:
         keep = keep[:top_k]
     return Tensor(jnp.asarray(keep), _internal=True)
+
+
+# ---------------- detection operator long tail ----------------
+# reference: python/paddle/vision/ops.py — roi_align/roi_pool/psroi_pool
+# (kernels phi roi_align_kernel etc.), box_coder, prior_box, yolo_box,
+# yolo_loss, matrix_nms, deform_conv2d, distribute_fpn_proposals,
+# generate_proposals. jnp implementations: gather/scatter formulations
+# XLA tiles; the host-dynamic ones (proposal generation, matrix_nms
+# outputs) run on host like the reference's CPU kernels.
+
+def _rois_with_batch(boxes, boxes_num):
+    """(sum_n, 4) boxes + per-image counts -> (sum_n,) batch ids."""
+    bn = raw(as_tensor(boxes_num)).astype(np.int64)
+    return np.repeat(np.arange(len(bn)), bn)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align (phi roi_align_kernel) —
+    bilinear sampling over each RoI bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bids = jnp.asarray(_rois_with_batch(boxes, boxes_num))
+
+    def f(feat, bx):
+        C = feat.shape[1]
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        # adaptive default: the reference samples ceil(roi/bin) points
+        # per bin PER ROI (dynamic); the static-shape equivalent uses the
+        # feature-map upper bound ceil(feat/out) for every RoI — exact for
+        # full-image RoIs, oversampled (never undersampled vs a fixed 2)
+        # for small ones
+        H_in, W_in = feat.shape[-2:]
+        sr_h = sampling_ratio if sampling_ratio > 0 else max(
+            1, -(-int(H_in) // oh))
+        sr_w = sampling_ratio if sampling_ratio > 0 else max(
+            1, -(-int(W_in) // ow))
+        # sample grid: (R, oh, ow, sr_h, sr_w)
+        iy = (jnp.arange(sr_h) + 0.5) / sr_h
+        ix = (jnp.arange(sr_w) + 0.5) / sr_w
+        gy = (y1[:, None, None] + (jnp.arange(oh)[None, :, None]
+              + iy[None, None, :]) * bin_h[:, None, None])
+        gx = (x1[:, None, None] + (jnp.arange(ow)[None, :, None]
+              + ix[None, None, :]) * bin_w[:, None, None])
+
+        def sample(img, ys, xs):
+            H, W = img.shape[-2:]
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            wy = ys - y0
+            wx = xs - x0
+            out = 0.0
+            for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)),
+                                (0, 1, (1 - wy) * wx),
+                                (1, 0, wy * (1 - wx)),
+                                (1, 1, wy * wx)):
+                yy = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+                xx = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+                valid = ((ys >= -1) & (ys <= H) & (xs >= -1) & (xs <= W))
+                out = out + wgt * jnp.where(valid, img[..., yy, xx], 0.0)
+            return out
+
+        def per_roi(b, gyr, gxr):
+            img = feat[b]  # (C, H, W)
+            ys = jnp.broadcast_to(gyr[:, None, :, None],
+                                  (oh, ow, sr_h, sr_w))
+            xs = jnp.broadcast_to(gxr[None, :, None, :],
+                                  (oh, ow, sr_h, sr_w))
+            # sample per channel: vectorize channel via vmap
+            samp = jax.vmap(lambda ch: sample(ch, ys, xs))(img)
+            return jnp.mean(samp, axis=(-2, -1))      # (C, oh, ow)
+
+        return jax.vmap(per_roi)(bids, gy, gx)
+    return apply(f, as_tensor(x), as_tensor(boxes), name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference: vision/ops.py roi_pool (max pooling per RoI bin)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bids = jnp.asarray(_rois_with_batch(boxes, boxes_num))
+
+    def f(feat, bx):
+        H, W = feat.shape[-2:]
+        x1 = jnp.round(bx[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(bx[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(bx[:, 3] * spatial_scale).astype(jnp.int32)
+
+        def per_roi(b, xx1, yy1, xx2, yy2):
+            img = feat[b]
+            rh = jnp.maximum(yy2 - yy1 + 1, 1)
+            rw = jnp.maximum(xx2 - xx1 + 1, 1)
+            ys = jnp.arange(H)
+            xs = jnp.arange(W)
+            out = jnp.full((feat.shape[1], oh, ow), -jnp.inf)
+            # bin index of each pixel (pixels outside the roi -> -1)
+            by = jnp.where((ys >= yy1) & (ys <= yy2),
+                           jnp.clip(((ys - yy1) * oh) // rh, 0, oh - 1),
+                           -1)
+            bxm = jnp.where((xs >= xx1) & (xs <= xx2),
+                            jnp.clip(((xs - xx1) * ow) // rw, 0, ow - 1),
+                            -1)
+            oneh_y = (by[:, None] == jnp.arange(oh)[None, :])  # (H, oh)
+            oneh_x = (bxm[:, None] == jnp.arange(ow)[None, :])  # (W, ow)
+            masked = jnp.where(
+                oneh_y[None, :, None, :, None]
+                & oneh_x[None, None, :, None, :],
+                img[:, :, :, None, None], -jnp.inf)
+            pooled = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        return jax.vmap(per_roi)(bids, x1, y1, x2, y2)
+    return apply(f, as_tensor(x), as_tensor(boxes), name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference: vision/ops.py psroi_pool — position-sensitive RoI
+    average pooling: input C = out_C * oh * ow; bin (i, j) reads its own
+    channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bids = jnp.asarray(_rois_with_batch(boxes, boxes_num))
+
+    def f(feat, bx):
+        C = feat.shape[1]
+        out_c = C // (oh * ow)
+        H, W = feat.shape[-2:]
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        x2 = bx[:, 2] * spatial_scale
+        y2 = bx[:, 3] * spatial_scale
+        bin_h = (y2 - y1) / oh
+        bin_w = (x2 - x1) / ow
+
+        def per_roi(b, xx1, yy1, bh, bw):
+            img = feat[b].reshape(out_c, oh, ow, H, W)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            outs = []
+            for i in range(oh):
+                for j in range(ow):
+                    ylo = yy1 + i * bh
+                    yhi = yy1 + (i + 1) * bh
+                    xlo = xx1 + j * bw
+                    xhi = xx1 + (j + 1) * bw
+                    my = (ys >= jnp.floor(ylo)) & (ys < jnp.ceil(yhi))
+                    mx = (xs >= jnp.floor(xlo)) & (xs < jnp.ceil(xhi))
+                    m = my[:, None] & mx[None, :]
+                    cnt = jnp.maximum(jnp.sum(m), 1)
+                    outs.append(jnp.sum(
+                        jnp.where(m[None], img[:, i, j], 0.0),
+                        axis=(-2, -1)) / cnt)
+            return jnp.stack(outs, axis=-1).reshape(out_c, oh, ow)
+        return jax.vmap(per_roi)(bids, x1, y1, bin_h, bin_w)
+    return apply(f, as_tensor(x), as_tensor(boxes), name="psroi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference: vision/ops.py box_coder (phi box_coder_kernel)."""
+    pb = as_tensor(prior_box)
+    tb = as_tensor(target_box)
+    pbv = None if prior_box_var is None or isinstance(
+        prior_box_var, (list, tuple)) else as_tensor(prior_box_var)
+    var_list = prior_box_var if isinstance(prior_box_var, (list, tuple)) \
+        else None
+    args = [pb, tb] + ([pbv] if pbv is not None else [])
+
+    def f(p, t, *rest):
+        norm = 0.0 if box_normalized else 1.0
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw / 2
+        pcy = p[:, 1] + ph / 2
+        if rest:
+            v = rest[0]
+        elif var_list is not None:
+            v = jnp.asarray(var_list, jnp.float32)[None, :]
+        else:
+            v = jnp.ones((1, 4), jnp.float32)
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw / 2
+            tcy = t[:, 1] + th / 2
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :])], axis=-1)
+            vv = v if v.ndim == 2 else v
+            return out / (vv[None] if vv.ndim == 2 else vv)
+        # decode_center_size: t (N, M, 4) deltas on priors along `axis`
+        pw_ = pw[None, :, None] if axis == 0 else pw[:, None, None]
+        ph_ = ph[None, :, None] if axis == 0 else ph[:, None, None]
+        pcx_ = pcx[None, :, None] if axis == 0 else pcx[:, None, None]
+        pcy_ = pcy[None, :, None] if axis == 0 else pcy[:, None, None]
+        vv = v[None] if v.ndim == 2 else v
+        d = t * vv if vv.shape[-1] == 4 else t
+        dcx = d[..., 0:1] * pw_ + pcx_
+        dcy = d[..., 1:2] * ph_ + pcy_
+        dw = jnp.exp(d[..., 2:3]) * pw_
+        dh = jnp.exp(d[..., 3:4]) * ph_
+        return jnp.concatenate([dcx - dw / 2, dcy - dh / 2,
+                                dcx + dw / 2 - norm,
+                                dcy + dh / 2 - norm], axis=-1)
+    return apply(f, *args, name="box_coder")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference: vision/ops.py prior_box (SSD anchor generator)."""
+    x = as_tensor(input)
+    img = as_tensor(image)
+    H, W = int(x.shape[-2]), int(x.shape[-1])
+    IH, IW = int(img.shape[-2]), int(img.shape[-1])
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    sw = steps[0] or IW / W
+    sh = steps[1] or IH / H
+    boxes = []
+    for i in range(H):
+        for j in range(W):
+            cx = (j + offset) * sw
+            cy = (i + offset) * sh
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        sz = float(np.sqrt(ms * max_sizes[k]))
+                        cell.append((cx, cy, sz, sz))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * np.sqrt(ar),
+                                     ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * np.sqrt(ar),
+                                     ms / np.sqrt(ar)))
+                    if max_sizes:
+                        sz = float(np.sqrt(ms * max_sizes[k]))
+                        cell.append((cx, cy, sz, sz))
+            boxes.append(cell)
+    nprior = len(boxes[0])
+    arr = np.asarray(boxes, np.float32).reshape(H, W, nprior, 4)
+    out = np.stack([
+        (arr[..., 0] - arr[..., 2] / 2) / IW,
+        (arr[..., 1] - arr[..., 3] / 2) / IH,
+        (arr[..., 0] + arr[..., 2] / 2) / IW,
+        (arr[..., 1] + arr[..., 3] / 2) / IH], axis=-1)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return (Tensor(jnp.asarray(out), _internal=True),
+            Tensor(jnp.asarray(var), _internal=True))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """reference: vision/ops.py yolo_box (phi yolo_box_kernel) — decode
+    YOLOv3 head predictions into boxes + scores."""
+    anchors = list(anchors)
+    na = len(anchors) // 2
+
+    def f(pred, imsz):
+        B, C, H, W = pred.shape
+        p = pred.reshape(B, na, -1, H, W)
+        bx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2)
+        by = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        cx = (bx + gx) / W
+        cy = (by + gy) / H
+        bw = jnp.exp(p[:, :, 2]) * aw / in_w
+        bh = jnp.exp(p[:, :, 3]) * ah / in_h
+        obj = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:5 + class_num])
+        score = obj[:, :, None] * cls
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imw
+        y1 = (cy - bh / 2) * imh
+        x2 = (cx + bw / 2) * imw
+        y2 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(B, -1, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(B, -1, class_num)
+        keep = (obj.reshape(B, -1) > conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+    return apply(f, as_tensor(x), as_tensor(img_size), name="yolo_box",
+                 multi_out=True)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py yolo_loss (phi yolo_loss_kernel) —
+    YOLOv3 training loss: coordinate + objectness + class terms with
+    best-anchor assignment and ignore-region masking."""
+    anchors = list(anchors)
+    anchor_mask = list(anchor_mask)
+    na = len(anchor_mask)
+
+    def f(pred, gtb, gtl, *rest):
+        B, C, H, W = pred.shape
+        p = pred.reshape(B, na, -1, H, W)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        px = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        py = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        pw = p[:, :, 2]
+        ph = p[:, :, 3]
+        obj_logit = p[:, :, 4]
+        cls_logit = p[:, :, 5:5 + class_num]
+        aw_all = jnp.asarray(anchors[0::2], jnp.float32)
+        ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+        aw = aw_all[jnp.asarray(anchor_mask)]
+        ah = ah_all[jnp.asarray(anchor_mask)]
+
+        # gt: (B, G, 4) cx cy w h normalized to [0, 1]
+        G = gtb.shape[1]
+        gx = gtb[..., 0]
+        gy = gtb[..., 1]
+        gw = gtb[..., 2]
+        gh = gtb[..., 3]
+        valid = gw > 0
+
+        # best anchor per gt over ALL anchors (shape-only IoU)
+        inter = (jnp.minimum(gw[..., None] * in_w, aw_all)
+                 * jnp.minimum(gh[..., None] * in_h, ah_all))
+        union = (gw[..., None] * in_w * gh[..., None] * in_h
+                 + aw_all * ah_all - inter)
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-12), axis=-1)
+
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+
+        loss = jnp.zeros((B,), jnp.float32)
+        obj_target = jnp.zeros((B, na, H, W))
+        # ignore mask (reference yolov3_loss kernel): predicted boxes
+        # whose best IoU with ANY gt exceeds ignore_thresh are excluded
+        # from the negative objectness term
+        gxs = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gys = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        aw_m = aw[None, :, None, None]
+        ah_m = ah[None, :, None, None]
+        pcx = (jax.nn.sigmoid(p[:, :, 0]) + gxs) / W
+        pcy = (jax.nn.sigmoid(p[:, :, 1]) + gys) / H
+        pww = jnp.exp(jnp.clip(pw, -10, 10)) * aw_m / in_w
+        phh = jnp.exp(jnp.clip(ph, -10, 10)) * ah_m / in_h
+        px1 = pcx - pww / 2
+        py1 = pcy - phh / 2
+        px2 = pcx + pww / 2
+        py2 = pcy + phh / 2
+        g_x1 = (gx - gw / 2)[:, None, None, None, :]
+        g_y1 = (gy - gh / 2)[:, None, None, None, :]
+        g_x2 = (gx + gw / 2)[:, None, None, None, :]
+        g_y2 = (gy + gh / 2)[:, None, None, None, :]
+        ix1 = jnp.maximum(px1[..., None], g_x1)
+        iy1 = jnp.maximum(py1[..., None], g_y1)
+        ix2 = jnp.minimum(px2[..., None], g_x2)
+        iy2 = jnp.minimum(py2[..., None], g_y2)
+        inter_p = (jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0))
+        area_p = (pww * phh)[..., None]
+        area_g = (gw * gh)[:, None, None, None, :]
+        iou_pg = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-12)
+        iou_pg = jnp.where(valid[:, None, None, None, :], iou_pg, 0.0)
+        best_iou = jnp.max(iou_pg, axis=-1)        # (B, na, H, W)
+        obj_mask = (best_iou <= ignore_thresh).astype(jnp.float32)
+        bidx = jnp.arange(B)[:, None]
+        for k, am in enumerate(anchor_mask):
+            sel = valid & (best == am)          # (B, G)
+            w_sel = sel.astype(jnp.float32)
+            if rest and rest[0] is not None:
+                w_sel = w_sel * rest[0]
+            tx = gx * W - gi
+            ty = gy * H - gj
+            tw = jnp.log(jnp.maximum(
+                gw * in_w / aw_all[am], 1e-9))
+            th = jnp.log(jnp.maximum(
+                gh * in_h / ah_all[am], 1e-9))
+            scale = 2.0 - gw * gh
+            pxg = px[bidx, k, gj, gi]
+            pyg = py[bidx, k, gj, gi]
+            pwg = pw[bidx, k, gj, gi]
+            phg = ph[bidx, k, gj, gi]
+            coord = (jnp.abs(pxg - tx) + jnp.abs(pyg - ty)
+                     + jnp.abs(pwg - tw) + jnp.abs(phg - th)) * scale
+            loss = loss + jnp.sum(coord * w_sel, axis=-1)
+            obj_target = obj_target.at[bidx, k, gj, gi].max(
+                sel.astype(jnp.float32))
+            # class loss at assigned cells
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            onehot = jax.nn.one_hot(gtl, class_num) * (1 - smooth) \
+                + smooth / 2
+            clg = cls_logit[bidx, k, :, gj, gi]
+            ce = jnp.sum(
+                jnp.maximum(clg, 0) - clg * onehot
+                + jnp.log1p(jnp.exp(-jnp.abs(clg))), axis=-1)
+            loss = loss + jnp.sum(ce * w_sel, axis=-1)
+        # positives always contribute; non-ignored cells contribute as
+        # negatives
+        eff_mask = jnp.maximum(obj_mask, obj_target)
+        obj_ce = (jnp.maximum(obj_logit, 0) - obj_logit * obj_target
+                  + jnp.log1p(jnp.exp(-jnp.abs(obj_logit))))
+        loss = loss + jnp.sum(obj_ce * eff_mask, axis=(1, 2, 3))
+        return loss
+    args = [as_tensor(x), as_tensor(gt_box), as_tensor(gt_label)]
+    if gt_score is not None:
+        args.append(as_tensor(gt_score))
+    return apply(f, *args, name="yolo_loss")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference: vision/ops.py matrix_nms (phi matrix_nms_kernel) —
+    soft suppression via pairwise IoU decay. Host-side (dynamic output
+    counts, like the reference CPU kernel)."""
+    bx = np.asarray(raw(as_tensor(bboxes)), np.float32)
+    sc = np.asarray(raw(as_tensor(scores)), np.float32)
+    B, C, N = sc.shape
+    outs, idxs, nums = [], [], []
+    for b in range(B):
+        rows = []
+        ridx = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = sc[b, c] > score_threshold
+            cand = np.where(mask)[0]
+            if cand.size == 0:
+                continue
+            order = cand[np.argsort(-sc[b, c, cand])][:nms_top_k]
+            boxes_c = bx[b, order]
+            scores_c = sc[b, c, order]
+            # pairwise IoU (upper triangle: against higher-scored)
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            norm = 0.0 if normalized else 1.0
+            area = ((boxes_c[:, 2] - boxes_c[:, 0] + norm)
+                    * (boxes_c[:, 3] - boxes_c[:, 1] + norm))
+            iou = inter / np.maximum(area[:, None] + area[None, :]
+                                     - inter, 1e-12)
+            n = len(order)
+            tri = np.tril(iou, -1)
+            # iou_max[j] = max IoU of (higher-scored) box j with boxes
+            # above it — the compensation factor of the matrix-NMS paper
+            iou_max = tri.max(axis=1) if n > 1 else np.zeros(n)
+            if use_gaussian:
+                decay = np.exp((iou_max ** 2 - tri ** 2)
+                               / gaussian_sigma).min(
+                    axis=1, initial=1.0, where=np.tril(
+                        np.ones_like(tri, bool), -1))
+            else:
+                # decay[i] = min_j (1 - iou[i,j]) / (1 - iou_max[j]) over
+                # higher-scored j (column-wise compensation)
+                decay = ((1 - tri) / np.maximum(1 - iou_max[None, :],
+                                                1e-12)).min(
+                    axis=1, initial=1.0, where=np.tril(
+                        np.ones_like(tri, bool), -1))
+            dscore = scores_c * decay
+            keep = dscore > post_threshold
+            for i in np.where(keep)[0]:
+                rows.append([c, dscore[i], *boxes_c[i]])
+                ridx.append(order[i])
+        rows = np.asarray(rows, np.float32).reshape(-1, 6)
+        srt = np.argsort(-rows[:, 1])[:keep_top_k]
+        outs.append(rows[srt])
+        idxs.append(np.asarray(ridx, np.int64)[srt] if len(ridx) else
+                    np.zeros((0,), np.int64))
+        nums.append(len(srt))
+    out = Tensor(jnp.asarray(np.concatenate(outs, axis=0)
+                             if outs else np.zeros((0, 6), np.float32)),
+                 _internal=True)
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(idxs))
+                          if idxs else jnp.zeros((0,), jnp.int64),
+                          _internal=True))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32)),
+                          _internal=True))
+    return tuple(res) if len(res) > 1 else out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference: vision/ops.py deform_conv2d (phi deformable_conv) —
+    DCNv1 (mask=None) / DCNv2: sample input at offset-shifted taps, then
+    1x1-reduce with the kernel — expressed as gather + matmul so XLA maps
+    the contraction onto the MXU."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    args = [as_tensor(x), as_tensor(offset), as_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(as_tensor(bias))
+    has_mask = mask is not None
+    if has_mask:
+        args.append(as_tensor(mask))
+
+    def f(xv, off, w, *rest):
+        B, C, H, W = xv.shape
+        Cout, Cin_g, kh, kw = w.shape
+        oh = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        K = kh * kw
+        dg = deformable_groups
+        off = off.reshape(B, dg, K, 2, oh, ow)
+        base_y = (jnp.arange(oh) * st[0] - pd[0])[:, None]
+        base_x = (jnp.arange(ow) * st[1] - pd[1])[None, :]
+        ky = (jnp.arange(kh) * dl[0])[:, None]
+        kx = (jnp.arange(kw) * dl[1])[None, :]
+        # absolute sampling positions per kernel tap: (K, oh, ow)
+        py = base_y[None] + jnp.repeat(
+            ky.reshape(kh, 1, 1), kw, axis=0).reshape(K, 1, 1)
+        px = (base_x[None] + jnp.tile(kx.reshape(1, kw), (kh, 1))
+              .reshape(K, 1, 1))
+        sy = py + off[:, :, :, 0]        # (B, dg, K, oh, ow)
+        sx = px + off[:, :, :, 1]
+
+        def bilinear(img, ys, xs):
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            wy = ys - y0
+            wx = xs - x0
+            out = 0.0
+            for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)),
+                                (0, 1, (1 - wy) * wx),
+                                (1, 0, wy * (1 - wx)),
+                                (1, 1, wy * wx)):
+                yy = y0 + dy
+                xx = x0 + dx
+                valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                out = out + wgt * jnp.where(valid, img[yc, xc], 0.0)
+            return out
+
+        cpg = C // dg  # channels per deformable group
+
+        def per_image(img, sy_i, sx_i):
+            # img (C,H,W); sy_i (dg,K,oh,ow)
+            def per_dg(chans, ys, xs):
+                return jax.vmap(
+                    lambda ch: jax.vmap(bilinear, in_axes=(None, 0, 0))(
+                        ch, ys, xs))(chans)
+            cols = jax.vmap(per_dg)(img.reshape(dg, cpg, H, W),
+                                    sy_i, sx_i)      # (dg,cpg,K,oh,ow)
+            return cols.reshape(C, K, oh, ow)
+        cols = jax.vmap(per_image)(xv, sy, sx)        # (B,C,K,oh,ow)
+        if has_mask:
+            m = rest[-1].reshape(B, dg, K, oh, ow)
+            m = jnp.repeat(m, cpg, axis=1)
+            cols = cols * m
+        # grouped contraction: (B, G, Cin_g*K, oh*ow) x (G, Cout_g, Cin_g*K)
+        G = groups
+        cols = cols.reshape(B, G, (C // G) * K, oh * ow)
+        wg = w.reshape(G, Cout // G, Cin_g * kh * kw)
+        out = jnp.einsum("bgkp,gok->bgop", cols, wg).reshape(
+            B, Cout, oh, ow)
+        if has_bias:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out
+    return apply(f, *args, name="deform_conv2d")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """reference: vision/ops.py distribute_fpn_proposals — route each RoI
+    to its FPN level by scale. Host-side (dynamic per-level counts).
+    With ``rois_num`` (per-image counts of the input), the returned
+    per-level counts are per-image (length B), the layout roi_align's
+    ``boxes_num`` expects."""
+    rois = np.asarray(raw(as_tensor(fpn_rois)), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        rn = np.asarray(raw(as_tensor(rois_num)), np.int64).reshape(-1)
+    else:
+        rn = np.asarray([len(rois)], np.int64)
+    img_of = np.repeat(np.arange(len(rn)), rn)
+    nlev = max_level - min_level + 1
+    multi, nums = [], []
+    restore = np.zeros(len(rois), np.int64)
+    order = []
+    for li in range(nlev):
+        sel = lvl == min_level + li
+        # per level, keep image-major order so per-image counts slice it
+        idx = np.where(sel)[0]
+        idx = idx[np.argsort(img_of[idx], kind="stable")]
+        multi.append(Tensor(jnp.asarray(rois[idx]), _internal=True))
+        per_img = np.asarray([(img_of[idx] == b).sum()
+                              for b in range(len(rn))], np.int32)
+        nums.append(Tensor(jnp.asarray(per_img), _internal=True))
+        order.extend(idx.tolist())
+    restore[np.asarray(order, np.int64)] = np.arange(len(rois))
+    restore_t = Tensor(jnp.asarray(restore[:, None]), _internal=True)
+    if rois_num is not None:
+        return multi, restore_t, nums
+    return multi, restore_t, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """reference: vision/ops.py generate_proposals (RPN) — decode anchor
+    deltas, clip, filter small, NMS. Host-side like the reference CPU
+    kernel."""
+    sc = np.asarray(raw(as_tensor(scores)), np.float32)
+    bd = np.asarray(raw(as_tensor(bbox_deltas)), np.float32)
+    ims = np.asarray(raw(as_tensor(img_size)), np.float32)
+    an = np.asarray(raw(as_tensor(anchors)), np.float32).reshape(-1, 4)
+    var = np.asarray(raw(as_tensor(variances)), np.float32).reshape(-1, 4)
+    B, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_scores, nums = [], [], []
+    for b in range(B):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        a = an[order % len(an)] if len(an) != len(s) else an[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        v = var[order % len(var)] if len(var) != len(s) else var[order]
+        cx = acx + d[:, 0] * v[:, 0] * aw
+        cy = acy + d[:, 1] * v[:, 1] * ah
+        w = aw * np.exp(np.clip(d[:, 2] * v[:, 2], None, 10))
+        h = ah * np.exp(np.clip(d[:, 3] * v[:, 3], None, 10))
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        imh, imw = ims[b, 0], ims[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, imw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, imh - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        # greedy NMS
+        sel = []
+        idx = np.argsort(-s)
+        while len(idx) and len(sel) < post_nms_top_n:
+            i = idx[0]
+            sel.append(i)
+            if len(idx) == 1:
+                break
+            xx1 = np.maximum(boxes[i, 0], boxes[idx[1:], 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[idx[1:], 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[idx[1:], 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[idx[1:], 3])
+            inter = (np.clip(xx2 - xx1 + off, 0, None)
+                     * np.clip(yy2 - yy1 + off, 0, None))
+            ai = ((boxes[i, 2] - boxes[i, 0] + off)
+                  * (boxes[i, 3] - boxes[i, 1] + off))
+            ar = ((boxes[idx[1:], 2] - boxes[idx[1:], 0] + off)
+                  * (boxes[idx[1:], 3] - boxes[idx[1:], 1] + off))
+            iou = inter / np.maximum(ai + ar - inter, 1e-12)
+            idx = idx[1:][iou <= nms_thresh]
+        all_rois.append(boxes[sel])
+        all_scores.append(s[sel])
+        nums.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)), _internal=True)
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0)),
+                     _internal=True)
+    if return_rois_num:
+        return rois, rscores, Tensor(
+            jnp.asarray(np.asarray(nums, np.int32)), _internal=True)
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """reference: vision/ops.py read_file — raw bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)),
+                  _internal=True)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg (nvjpeg kernel) — decode a
+    uint8 JPEG byte tensor. Uses PIL when installed (no nvjpeg on TPU
+    hosts); raises a clear error otherwise."""
+    data = bytes(np.asarray(raw(as_tensor(x)), np.uint8).tobytes())
+    try:
+        from PIL import Image
+        import io as _io
+        img = Image.open(_io.BytesIO(data))
+        if mode == "gray":
+            img = img.convert("L")
+        elif mode == "rgb":
+            img = img.convert("RGB")
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[None]
+        else:
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(jnp.asarray(arr), _internal=True)
+    except ImportError as e:
+        raise RuntimeError(
+            "decode_jpeg needs pillow on TPU hosts (no nvjpeg); "
+            "`pip install pillow` in your own environment") from e
+
+
+# ---------------- layer wrappers ----------------
+class RoIAlign(Layer):
+    """reference: vision/ops.py RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        o, s = self._a
+        return roi_align(x, boxes, boxes_num, o, s)
+
+
+class RoIPool(Layer):
+    """reference: vision/ops.py RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        o, s = self._a
+        return roi_pool(x, boxes, boxes_num, o, s)
+
+
+class PSRoIPool(Layer):
+    """reference: vision/ops.py PSRoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        o, s = self._a
+        return psroi_pool(x, boxes, boxes_num, o, s)
+
+
+class DeformConv2D(Layer):
+    """reference: vision/ops.py DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from .._core.tensor import Parameter
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        rng = np.random.default_rng(0)
+        fan_in = in_channels // groups * ks[0] * ks[1]
+        bound = (6.0 / max(1, fan_in + out_channels)) ** 0.5
+        self.weight = Parameter(rng.uniform(
+            -bound, bound,
+            (out_channels, in_channels // groups, *ks)).astype(np.float32))
+        self.bias = None if bias_attr is False else Parameter(
+            np.zeros((out_channels,), np.float32))
+        self._a = (stride, padding, dilation, deformable_groups, groups)
+
+    def forward(self, x, offset, mask=None):
+        st, pd, dl, dg, g = self._a
+        return deform_conv2d(x, offset, self.weight, self.bias, st, pd,
+                             dl, dg, g, mask)

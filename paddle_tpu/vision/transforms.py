@@ -187,3 +187,477 @@ def hflip(img):
 
 def vflip(img):
     return np.asarray(img)[..., ::-1, :].copy()
+
+
+# ---------------- functional long tail ----------------
+# reference: python/paddle/vision/transforms/functional.py (+ the cv2/PIL
+# backends functional_cv2.py / functional_pil.py) — numpy backend here.
+
+def crop(img, top, left, height, width):
+    """reference: transforms/functional.py crop."""
+    img = np.asarray(img)
+    chw = img.ndim == 2 or (img.shape[0] in (1, 3, 4)
+                            and img.shape[-1] not in (1, 3, 4))
+    if img.ndim == 2:
+        return img[top:top + height, left:left + width].copy()
+    if chw:
+        return img[..., top:top + height, left:left + width].copy()
+    return img[top:top + height, left:left + width, :].copy()
+
+
+def center_crop(img, output_size):
+    """reference: functional.py center_crop."""
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _chw(np.asarray(img))
+    h, w = arr.shape[-2:]
+    th, tw = output_size
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return arr[..., i:i + th, j:j + tw].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """reference: functional.py pad — padding int | (lr, tb) | (l, t, r, b)."""
+    arr = _chw(np.asarray(img))
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l = r = int(padding[0])
+        t = b = int(padding[1])
+    else:
+        l, t, r, b = [int(p) for p in padding]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    cfg = [(0, 0)] * (arr.ndim - 2) + [(t, b), (l, r)]
+    if mode == "constant":
+        return np.pad(arr, cfg, mode, constant_values=fill)
+    return np.pad(arr, cfg, mode)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """reference: functional.py to_grayscale (ITU-R 601-2 luma)."""
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    if arr.shape[0] == 1:
+        g = arr
+    else:
+        g = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+    out = np.repeat(g, num_output_channels, axis=0)
+    return out.astype(np.asarray(img).dtype) \
+        if np.issubdtype(np.asarray(img).dtype, np.integer) else out
+
+
+def adjust_brightness(img, brightness_factor):
+    """reference: functional.py adjust_brightness — img * factor
+    (preserves the input dtype, incl. uint8)."""
+    src_dtype = np.asarray(img).dtype
+    arr = np.asarray(img).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi).astype(src_dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    """reference: functional.py adjust_contrast — blend with the mean of
+    the grayscale image."""
+    src_dtype = np.asarray(img).dtype
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    mean = to_grayscale(arr).mean()
+    return np.clip((1 - contrast_factor) * mean
+                   + contrast_factor * arr, 0, hi).astype(src_dtype)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[0], rgb[1], rgb[2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    rc = (maxc - r) / np.maximum(d, 1e-12)
+    gc = (maxc - g) / np.maximum(d, 1e-12)
+    bc = (maxc - b) / np.maximum(d, 1e-12)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(d == 0, 0.0, h)
+    return np.stack([(h / 6.0) % 1.0, s, v])
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[0], hsv[1], hsv[2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r, g, b])
+
+
+def adjust_hue(img, hue_factor):
+    """reference: functional.py adjust_hue — shift hue by hue_factor
+    (|f| <= 0.5) through HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    src_dtype = np.asarray(img).dtype
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    hsv = _rgb_to_hsv(arr / hi)
+    hsv[0] = (hsv[0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv) * hi
+    return np.clip(out, 0, hi).astype(src_dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    """reference: functional.py adjust_saturation — blend with gray."""
+    src_dtype = np.asarray(img).dtype
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    g = to_grayscale(arr)
+    g3 = np.repeat(g, arr.shape[0], axis=0)
+    return np.clip((1 - saturation_factor) * g3
+                   + saturation_factor * arr, 0, hi).astype(src_dtype)
+
+
+def _inverse_sample(img, inv, out_hw, interpolation="bilinear", fill=0.0):
+    """Sample img (C,H,W) at positions given by the inverse map
+    ``inv(ys, xs) -> (src_y, src_x)`` — the shared engine for rotate/
+    affine/perspective (reference backends use cv2.warpAffine etc.)."""
+    c, h, w = img.shape
+    oh, ow = out_hw
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    sy, sx = inv(ys, xs)
+    if interpolation == "nearest":
+        iy = np.round(sy).astype(np.int64)
+        ix = np.round(sx).astype(np.int64)
+        valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+        iy = np.clip(iy, 0, h - 1)
+        ix = np.clip(ix, 0, w - 1)
+        out = img[:, iy, ix]
+        return np.where(valid[None], out, fill).astype(np.float32)
+    y0 = np.floor(sy).astype(np.int64)
+    x0 = np.floor(sx).astype(np.int64)
+    wy = sy - y0
+    wx = sx - x0
+    out = np.zeros((c, oh, ow), np.float32)
+    for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)),
+                        (0, 1, (1 - wy) * wx),
+                        (1, 0, wy * (1 - wx)),
+                        (1, 1, wy * wx)):
+        yy = y0 + dy
+        xx = x0 + dx
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = np.clip(yy, 0, h - 1)
+        xc = np.clip(xx, 0, w - 1)
+        out += wgt[None] * np.where(valid[None], img[:, yc, xc], fill)
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """reference: functional.py rotate (degrees, counter-clockwise)."""
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    h, w = arr.shape[-2:]
+    a = -np.deg2rad(angle)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    if center is not None:
+        cx, cy = center
+    if expand:
+        corners = np.array([[0, 0], [0, w - 1], [h - 1, 0],
+                            [h - 1, w - 1]], np.float32)
+        ang = np.deg2rad(angle)
+        rot = np.array([[np.cos(ang), -np.sin(ang)],
+                        [np.sin(ang), np.cos(ang)]])
+        rel = corners - [cy, cx]
+        new = rel @ rot.T
+        oh = int(np.ceil(new[:, 0].max() - new[:, 0].min()) + 1)
+        ow = int(np.ceil(new[:, 1].max() - new[:, 1].min()) + 1)
+        ncy, ncx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow = h, w
+        ncy, ncx = cy, cx
+
+    def inv(ys, xs):
+        dy = ys - ncy
+        dx = xs - ncx
+        sy = np.cos(a) * dy - np.sin(a) * dx + cy
+        sx = np.sin(a) * dy + np.cos(a) * dx + cx
+        return sy, sx
+    return _inverse_sample(arr, inv, (oh, ow), interpolation, fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference: functional.py affine — rotation + translation + scale +
+    shear about the center, matching torchvision's parameterization."""
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    h, w = arr.shape[-2:]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    if center is not None:
+        cx, cy = center
+    rot = np.deg2rad(angle)
+    sx_sh, sy_sh = [np.deg2rad(s) for s in (
+        shear if isinstance(shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix in (x, y): R(rot) * Shear * scale
+    a = np.cos(rot - sy_sh) / max(np.cos(sy_sh), 1e-12)
+    b = -np.cos(rot - sy_sh) * np.tan(sx_sh) / max(
+        np.cos(sy_sh), 1e-12) - np.sin(rot)
+    c = np.sin(rot - sy_sh) / max(np.cos(sy_sh), 1e-12)
+    d = -np.sin(rot - sy_sh) * np.tan(sx_sh) / max(
+        np.cos(sy_sh), 1e-12) + np.cos(rot)
+    m = scale * np.array([[a, b], [c, d]], np.float32)
+    minv = np.linalg.inv(m)
+    tx, ty = translate
+
+    def inv(ys, xs):
+        dx = xs - cx - tx
+        dy = ys - cy - ty
+        sxp = minv[0, 0] * dx + minv[0, 1] * dy + cx
+        syp = minv[1, 0] * dx + minv[1, 1] * dy + cy
+        return syp, sxp
+    return _inverse_sample(arr, inv, (h, w), interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference: functional.py perspective — projective warp mapping
+    startpoints -> endpoints ((x, y) corner lists)."""
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    h, w = arr.shape[-2:]
+    # solve the 8-dof homography taking END -> START (inverse map)
+    A = []
+    bvec = []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        bvec.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec.append(sy)
+    coef = np.linalg.solve(np.asarray(A, np.float64),
+                           np.asarray(bvec, np.float64))
+    hmat = np.append(coef, 1.0).reshape(3, 3)
+
+    def inv(ys, xs):
+        den = hmat[2, 0] * xs + hmat[2, 1] * ys + hmat[2, 2]
+        sx = (hmat[0, 0] * xs + hmat[0, 1] * ys + hmat[0, 2]) / den
+        sy = (hmat[1, 0] * xs + hmat[1, 1] * ys + hmat[1, 2]) / den
+        return sy, sx
+    return _inverse_sample(arr, inv, (h, w), interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference: functional.py erase — fill the region with v."""
+    from .._core.tensor import Tensor
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        val = img._value
+        region = jnp.broadcast_to(jnp.asarray(v, val.dtype),
+                                  val[..., i:i + h, j:j + w].shape)
+        out = val.at[..., i:i + h, j:j + w].set(region)
+        if inplace:
+            img._inplace_assign(out)
+            return img
+        return Tensor(out, _internal=True)
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[..., i:i + h, j:j + w] = v
+    return out
+
+
+# ---------------- transform classes ----------------
+class ContrastTransform(BaseTransform):
+    """reference: transforms.py ContrastTransform."""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    """reference: transforms.py SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    """reference: transforms.py HueTransform."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, factor)
+
+
+class ColorJitter(BaseTransform):
+    """reference: transforms.py ColorJitter — random order of the four
+    jitters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    """reference: transforms.py Grayscale."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    """reference: transforms.py Pad."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomRotation(BaseTransform):
+    """reference: transforms.py RandomRotation."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.a = (interpolation, expand, center, fill)
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        it, ex, ce, fi = self.a
+        return rotate(img, angle, it, ex, ce, fi)
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.a = (interpolation, fill, center)
+
+    def _apply_image(self, img):
+        arr = _chw(np.asarray(img))
+        h, w = arr.shape[-2:]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = 0.0
+        if self.shear is not None:
+            shr = self.shear if isinstance(
+                self.shear, (list, tuple)) else (-self.shear, self.shear)
+            sh = np.random.uniform(shr[0], shr[1])
+        it, fi, ce = self.a
+        return affine(img, angle, (tx, ty), sc, sh, it, fi, ce)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.a = (interpolation, fill)
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _chw(np.asarray(img))
+        h, w = arr.shape[-2:]
+        d = self.distortion_scale
+        half_h = int(h * d / 2)
+        half_w = int(w * d / 2)
+        tl = (np.random.randint(0, half_w + 1),
+              np.random.randint(0, half_h + 1))
+        tr = (w - 1 - np.random.randint(0, half_w + 1),
+              np.random.randint(0, half_h + 1))
+        br = (w - 1 - np.random.randint(0, half_w + 1),
+              h - 1 - np.random.randint(0, half_h + 1))
+        bl = (np.random.randint(0, half_w + 1),
+              h - 1 - np.random.randint(0, half_h + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        it, fi = self.a
+        return perspective(img, start, [tl, tr, br, bl], it, fi)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _chw(np.asarray(img))
+        h, w = arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                v = self.value if not isinstance(self.value, str) else \
+                    np.random.randn(arr.shape[0], eh, ew)
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr

@@ -1,0 +1,484 @@
+"""nn long-tail tests (losses torch-verified; rnnt vs brute force;
+adaptive softmax vs torch; hsigmoid normalization; layers/decode)."""
+import itertools
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+F = nn.functional
+
+
+class TestLossesTorchVerified:
+    rs = np.random.RandomState(0)
+
+    def test_soft_margin(self):
+        x = self.rs.randn(6, 5).astype(np.float32)
+        y = ((self.rs.rand(6, 5) > 0.5) * 2 - 1).astype(np.float32)
+        for red in ("mean", "sum"):
+            ours = float(F.soft_margin_loss(
+                paddle.to_tensor(x), paddle.to_tensor(y),
+                reduction=red).numpy())
+            ref = float(tF.soft_margin_loss(torch.tensor(x),
+                                            torch.tensor(y),
+                                            reduction=red))
+            np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_multilabel_soft_margin(self):
+        x = self.rs.randn(6, 5).astype(np.float32)
+        y = (self.rs.rand(6, 5) > 0.5).astype(np.float32)
+        ours = float(F.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+        ref = float(tF.multilabel_soft_margin_loss(torch.tensor(x),
+                                                   torch.tensor(y)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_multi_margin(self):
+        x = self.rs.randn(6, 5).astype(np.float32)
+        y = self.rs.randint(0, 5, (6,))
+        w = np.abs(self.rs.randn(5)).astype(np.float32)
+        for p in (1, 2):
+            ours = float(F.multi_margin_loss(
+                paddle.to_tensor(x), paddle.to_tensor(y), p=p,
+                weight=paddle.to_tensor(w)).numpy())
+            ref = float(tF.multi_margin_loss(
+                torch.tensor(x), torch.tensor(y), p=p,
+                weight=torch.tensor(w)))
+            np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_poisson_nll(self):
+        x = self.rs.randn(6, 5).astype(np.float32)
+        lam = np.abs(self.rs.randn(6, 5)).astype(np.float32) + 0.5
+        for log_input, full in itertools.product([True, False],
+                                                 [True, False]):
+            ours = float(F.poisson_nll_loss(
+                paddle.to_tensor(np.abs(x) + 0.1), paddle.to_tensor(lam),
+                log_input=log_input, full=full).numpy())
+            ref = float(tF.poisson_nll_loss(
+                torch.tensor(np.abs(x) + 0.1), torch.tensor(lam),
+                log_input=log_input, full=full))
+            np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_gaussian_nll(self):
+        x = self.rs.randn(6, 5).astype(np.float32)
+        t = self.rs.randn(6, 5).astype(np.float32)
+        var = np.abs(self.rs.randn(6, 5)).astype(np.float32) + 0.1
+        ours = float(F.gaussian_nll_loss(
+            paddle.to_tensor(x), paddle.to_tensor(t),
+            paddle.to_tensor(var), full=True).numpy())
+        ref = float(tF.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(t), torch.tensor(var),
+            full=True))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_pairwise_distance(self):
+        a = self.rs.randn(4, 8).astype(np.float32)
+        b = self.rs.randn(4, 8).astype(np.float32)
+        ours = F.pairwise_distance(paddle.to_tensor(a),
+                                   paddle.to_tensor(b)).numpy()
+        ref = tF.pairwise_distance(torch.tensor(a),
+                                   torch.tensor(b)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+        lay = nn.PairwiseDistance(p=1.0)
+        ours1 = lay(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        ref1 = tF.pairwise_distance(torch.tensor(a), torch.tensor(b),
+                                    p=1.0).numpy()
+        np.testing.assert_allclose(ours1, ref1, rtol=1e-5)
+
+    def test_triplet_with_distance(self):
+        a = self.rs.randn(4, 8).astype(np.float32)
+        p = self.rs.randn(4, 8).astype(np.float32)
+        n = self.rs.randn(4, 8).astype(np.float32)
+        for swap in (False, True):
+            ours = float(F.triplet_margin_with_distance_loss(
+                paddle.to_tensor(a), paddle.to_tensor(p),
+                paddle.to_tensor(n), swap=swap).numpy())
+            ref = float(tF.triplet_margin_with_distance_loss(
+                torch.tensor(a), torch.tensor(p), torch.tensor(n),
+                swap=swap))
+            np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_npair_backward_flows(self):
+        a = paddle.to_tensor(self.rs.randn(4, 6).astype(np.float32))
+        p = paddle.to_tensor(self.rs.randn(4, 6).astype(np.float32))
+        a.stop_gradient = False
+        y = paddle.to_tensor(np.array([0, 1, 0, 2], np.int64))
+        loss = F.npair_loss(a, p, y)
+        loss.backward()
+        assert a.grad is not None and np.isfinite(a.grad.numpy()).all()
+
+
+class TestHSigmoid:
+    def test_normalizes_over_classes(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 6).astype(np.float32)
+        C = 6
+        w = rs.randn(C - 1, 6).astype(np.float32)
+        b = rs.randn(C - 1, 1).astype(np.float32)
+        tot = np.zeros(3)
+        for c in range(C):
+            lab = np.full((3,), c, np.int64)
+            loss = F.hsigmoid_loss(
+                paddle.to_tensor(x), paddle.to_tensor(lab), C,
+                paddle.to_tensor(w), paddle.to_tensor(b))
+            tot += np.exp(-loss.numpy()[:, 0])
+        np.testing.assert_allclose(tot, 1.0, rtol=1e-5)
+
+    def test_layer_trains(self):
+        from paddle_tpu.optimizer import Adam
+        rs = np.random.RandomState(2)
+        lay = nn.HSigmoidLoss(8, 4)
+        opt = Adam(0.05, parameters=lay.parameters())
+        x = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rs.randint(0, 4, (16,)))
+        l0 = None
+        for _ in range(60):
+            loss = lay(x, y).mean()
+            if l0 is None:
+                l0 = float(loss.numpy())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.7 * l0
+
+
+class TestRNNT:
+    def test_matches_brute_force(self):
+        rs = np.random.RandomState(3)
+        B, T, U, V = 1, 3, 2, 3
+        logits = rs.randn(B, T, U + 1, V).astype(np.float32)
+        labels = np.array([[1, 2]], np.int64)
+        lp = torch.log_softmax(torch.tensor(logits), dim=-1).numpy()
+
+        total = -np.inf
+        for labpos in itertools.combinations(range(T - 1 + U), U):
+            t = u = 0
+            s = 0.0
+            for i in range(T - 1 + U):
+                if i in labpos:
+                    s += lp[0, t, u, labels[0, u]]
+                    u += 1
+                else:
+                    s += lp[0, t, u, 0]
+                    t += 1
+            s += lp[0, T - 1, U, 0]
+            total = np.logaddexp(total, s)
+
+        got = float(F.rnnt_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(np.array([T], np.int64)),
+            paddle.to_tensor(np.array([U], np.int64)),
+            reduction="none").numpy()[0])
+        np.testing.assert_allclose(got, -total, rtol=1e-5)
+
+    def test_grad_and_layer(self):
+        rs = np.random.RandomState(4)
+        logits = paddle.to_tensor(rs.randn(2, 4, 3, 5).astype(np.float32))
+        logits.stop_gradient = False
+        lay = nn.RNNTLoss()
+        loss = lay(logits, paddle.to_tensor(np.array([[1, 2], [3, 4]])),
+                   paddle.to_tensor(np.array([4, 4])),
+                   paddle.to_tensor(np.array([2, 2])))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+
+
+class TestAdaptiveSoftmax:
+    def test_matches_torch(self):
+        rs = np.random.RandomState(5)
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10],
+                                                 div_value=2.0)
+        ours = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10],
+                                             div_value=2.0)
+        with paddle.no_grad():
+            ours.head_weight._inplace_assign(
+                paddle.to_tensor(tm.head.weight.detach().numpy().T)._value)
+            for i, t in enumerate(tm.tail):
+                getattr(ours, f"tail_{i}_0")._inplace_assign(
+                    paddle.to_tensor(t[0].weight.detach().numpy().T)._value)
+                getattr(ours, f"tail_{i}_1")._inplace_assign(
+                    paddle.to_tensor(t[1].weight.detach().numpy().T)._value)
+        x = rs.randn(7, 16).astype(np.float32)
+        y = rs.randint(0, 20, (7,))
+        t_out, t_loss = tm(torch.tensor(x), torch.tensor(y))
+        p_out, p_loss = ours(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(p_out.numpy(), t_out.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(p_loss.numpy()),
+                                   float(t_loss.detach()), rtol=1e-4)
+        np.testing.assert_allclose(
+            ours.log_prob(paddle.to_tensor(x)).numpy(),
+            tm.log_prob(torch.tensor(x)).detach().numpy(),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(
+            ours.predict(paddle.to_tensor(x)).numpy(),
+            tm.predict(torch.tensor(x)).numpy())
+
+
+class TestMiscFunctionals:
+    rs = np.random.RandomState(6)
+
+    def test_zeropad2d_and_layers(self):
+        x = self.rs.randn(1, 2, 3, 4).astype(np.float32)
+        out = F.zeropad2d(paddle.to_tensor(x), [1, 2, 3, 4])
+        assert out.shape == [1, 2, 10, 7]
+        np.testing.assert_allclose(out.numpy()[:, :, 3:6, 1:5], x)
+        z1 = nn.ZeroPad1D(2)(paddle.to_tensor(x[0]))
+        assert z1.shape == [2, 3, 8]
+        z3 = nn.ZeroPad3D(1)(paddle.to_tensor(
+            self.rs.randn(1, 1, 2, 2, 2).astype(np.float32)))
+        assert z3.shape == [1, 1, 4, 4, 4]
+
+    def test_temporal_shift(self):
+        x = self.rs.randn(4, 8, 2, 2).astype(np.float32)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2).numpy()
+        v = x.reshape(2, 2, 8, 2, 2)
+        # first quarter shifted from t+1; last frame zero
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   v[:, 1, :2])
+        assert np.abs(out.reshape(2, 2, 8, 2, 2)[:, 1, :2]).max() == 0
+
+    def test_lp_pool1d_matches_torch(self):
+        x = self.rs.randn(2, 3, 10).astype(np.float32)
+        ours = F.lp_pool1d(paddle.to_tensor(x), 2.0, 2, 2).numpy()
+        ref = tF.lp_pool1d(torch.tensor(x), 2.0, 2, 2).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+        lay = nn.LPPool1D(2.0, 2, 2)
+        np.testing.assert_allclose(lay(paddle.to_tensor(x)).numpy(), ref,
+                                   rtol=1e-4)
+
+    def test_max_unpool1d_roundtrip(self):
+        x = self.rs.randn(2, 3, 8).astype(np.float32)
+        pooled, idx = F.max_pool1d(paddle.to_tensor(x), 2, 2,
+                                   return_mask=True)
+        restored = F.max_unpool1d(pooled, idx, 2, 2)
+        assert restored.shape == [2, 3, 8]
+        # every pooled max lands back at its argmax position
+        t_p, t_i = tF.max_pool1d(torch.tensor(x), 2, 2,
+                                 return_indices=True)
+        t_r = tF.max_unpool1d(t_p, t_i, 2, 2).numpy()
+        np.testing.assert_allclose(restored.numpy(), t_r, rtol=1e-5)
+
+    def test_feature_alpha_dropout(self):
+        x = paddle.to_tensor(self.rs.randn(8, 4, 6).astype(np.float32))
+        out = F.feature_alpha_dropout(x, 0.5, training=True)
+        assert out.shape == x.shape
+        # eval mode: identity
+        lay = nn.FeatureAlphaDropout(0.5)
+        lay.eval()
+        np.testing.assert_allclose(lay(x).numpy(), x.numpy())
+
+    def test_class_center_sample(self):
+        y = paddle.to_tensor(np.array([1, 5, 1, 9], np.int64))
+        remapped, sampled = F.class_center_sample(y, 20, 6)
+        s = sampled.numpy()
+        assert {1, 5, 9}.issubset(set(s.tolist())) and len(s) == 6
+        r = remapped.numpy()
+        assert (s[r] == y.numpy()).all()
+
+    def test_sparse_attention_matches_dense_on_full_mask(self):
+        B, H, S, D = 1, 2, 4, 8
+        q = self.rs.randn(B, H, S, D).astype(np.float32)
+        k = self.rs.randn(B, H, S, D).astype(np.float32)
+        v = self.rs.randn(B, H, S, D).astype(np.float32)
+        off = np.tile(np.arange(0, S * S + 1, S), (B, H, 1)).astype(
+            np.int32)
+        cols = np.tile(np.tile(np.arange(S), S), (B, H, 1)).astype(
+            np.int32)
+        out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v),
+                                 paddle.to_tensor(off),
+                                 paddle.to_tensor(cols)).numpy()
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        p = torch.softmax(torch.tensor(s), dim=-1).numpy()
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_inplace_activations(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        F.relu_(x)
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh([0.0, 2.0]),
+                                   rtol=1e-6)
+
+    def test_flash_attn_qkvpacked(self):
+        qkv = self.rs.randn(2, 8, 3, 2, 16).astype(np.float32)
+        out, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv))
+        ref, _ = F.flash_attention(paddle.to_tensor(qkv[:, :, 0]),
+                                   paddle.to_tensor(qkv[:, :, 1]),
+                                   paddle.to_tensor(qkv[:, :, 2]))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_flashmask_attention_matches_causal(self):
+        B, S, H, D = 1, 8, 2, 16
+        q = self.rs.randn(B, S, H, D).astype(np.float32)
+        k = self.rs.randn(B, S, H, D).astype(np.float32)
+        v = self.rs.randn(B, S, H, D).astype(np.float32)
+        # start rows = S for every column == no extra masking -> causal
+        sri = np.full((B, 1, S, 1), S, np.int32)
+        out = F.flashmask_attention(paddle.to_tensor(q),
+                                    paddle.to_tensor(k),
+                                    paddle.to_tensor(v),
+                                    paddle.to_tensor(sri), causal=True)
+        ref, _ = F.flash_attention(paddle.to_tensor(q),
+                                   paddle.to_tensor(k),
+                                   paddle.to_tensor(v), causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestContainersAndDecode:
+    def test_parameter_dict(self):
+        pd = nn.ParameterDict({"a": paddle.create_parameter([2], "float32")})
+        pd["b"] = paddle.create_parameter([3], "float32")
+        assert set(pd.keys()) == {"a", "b"} and len(pd) == 2
+        assert "a" in pd and pd["b"].shape == [3]
+        names = [n for n, _ in pd.named_parameters()]
+        assert len(names) == 2
+        del pd["a"]
+        assert len(pd) == 1
+
+    def test_fold_unfold_layers(self):
+        x = paddle.randn([1, 3, 8, 8])
+        u = nn.Unfold(kernel_sizes=2, strides=2)(x)
+        assert u.shape == [1, 12, 16]
+        f = nn.Fold(output_sizes=[8, 8], kernel_sizes=2, strides=2)(u)
+        np.testing.assert_allclose(f.numpy(), x.numpy(), rtol=1e-5)
+
+    def test_softmax2d(self):
+        x = paddle.randn([2, 3, 4, 5])
+        out = nn.Softmax2D()(x)
+        np.testing.assert_allclose(out.numpy().sum(1),
+                                   np.ones((2, 4, 5)), rtol=1e-5)
+
+    def test_beam_search_decode_greedy_consistency(self):
+        # a cell whose output logits strongly prefer token (state_sum % V)
+        rs = np.random.RandomState(7)
+        V = 5
+
+        class Cell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, V)
+
+            def forward(self, inp, state):
+                new_state = state + 1.0
+                logits = self.lin(new_state)
+                return logits, new_state
+
+        cell = Cell()
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=3,
+                                   embedding_fn=lambda t: t)
+        init = paddle.to_tensor(rs.randn(2, 4).astype(np.float32))
+        ids, scores = nn.dynamic_decode(dec, inits=init, max_step_num=6)
+        assert ids.shape[0] == 2 and ids.shape[2] == 3
+        sc = scores.numpy()
+        # beams sorted by score
+        assert (np.diff(sc, axis=1) <= 1e-5).all()
+        ids3, scores3, lens = nn.dynamic_decode(dec, inits=init,
+                                                max_step_num=6,
+                                                return_length=True)
+        assert lens.shape == [2, 3]
+
+
+class TestDistributionFamilies:
+    """torch-verified log_prob/entropy for the new families."""
+
+    def test_binomial_poisson_chi2(self):
+        import paddle_tpu.distribution as D
+        b = D.Binomial(10, 0.3)
+        tb = torch.distributions.Binomial(10, torch.tensor(0.3))
+        for v in [0., 3., 10.]:
+            np.testing.assert_allclose(
+                float(b.log_prob(paddle.to_tensor(v)).numpy()),
+                float(tb.log_prob(torch.tensor(v))), rtol=1e-4)
+        p = D.Poisson(2.5)
+        tp = torch.distributions.Poisson(torch.tensor(2.5))
+        for v in [0., 2., 7.]:
+            np.testing.assert_allclose(
+                float(p.log_prob(paddle.to_tensor(v)).numpy()),
+                float(tp.log_prob(torch.tensor(v))), rtol=1e-4)
+        c = D.Chi2(3.0)
+        tc = torch.distributions.Chi2(torch.tensor(3.0))
+        np.testing.assert_allclose(float(c.entropy().numpy()),
+                                   float(tc.entropy()), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(c.log_prob(paddle.to_tensor(2.0)).numpy()),
+            float(tc.log_prob(torch.tensor(2.0))), rtol=1e-4)
+        assert 2.0 < float(np.mean(b.sample([3000]).numpy())) < 4.0
+
+    def test_student_t_and_mvn(self):
+        import paddle_tpu.distribution as D
+        s = D.StudentT(4.0, 1.0, 2.0)
+        ts = torch.distributions.StudentT(torch.tensor(4.0),
+                                          torch.tensor(1.0),
+                                          torch.tensor(2.0))
+        np.testing.assert_allclose(
+            float(s.log_prob(paddle.to_tensor(0.5)).numpy()),
+            float(ts.log_prob(torch.tensor(0.5))), rtol=1e-4)
+        np.testing.assert_allclose(float(s.entropy().numpy()),
+                                   float(ts.entropy()), rtol=1e-4)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mv = D.MultivariateNormal(np.zeros(2, np.float32),
+                                  covariance_matrix=cov)
+        tmv = torch.distributions.MultivariateNormal(torch.zeros(2),
+                                                     torch.tensor(cov))
+        v = np.array([0.3, -0.7], np.float32)
+        np.testing.assert_allclose(
+            float(mv.log_prob(paddle.to_tensor(v)).numpy()),
+            float(tmv.log_prob(torch.tensor(v))), rtol=1e-4)
+        np.testing.assert_allclose(float(mv.entropy().numpy()),
+                                   float(tmv.entropy()), rtol=1e-4)
+        samp = mv.sample([4000]).numpy()
+        np.testing.assert_allclose(np.cov(samp.T), cov, atol=0.15)
+
+    def test_continuous_bernoulli_and_lkj(self):
+        import paddle_tpu.distribution as D
+        cb = D.ContinuousBernoulli(0.3)
+        tcb = torch.distributions.ContinuousBernoulli(torch.tensor(0.3))
+        for v in [0.1, 0.5, 0.9]:
+            np.testing.assert_allclose(
+                float(cb.log_prob(paddle.to_tensor(v)).numpy()),
+                float(tcb.log_prob(torch.tensor(v))), rtol=1e-3)
+        np.testing.assert_allclose(float(cb.mean.numpy()),
+                                   float(tcb.mean), rtol=1e-3)
+        lkj = D.LKJCholesky(4, 0.8)
+        tl = torch.distributions.LKJCholesky(4, 0.8)
+        L = tl.sample().numpy()
+        np.testing.assert_allclose(
+            float(lkj.log_prob(paddle.to_tensor(L)).numpy()),
+            float(tl.log_prob(torch.tensor(L))), rtol=1e-3)
+        own = np.asarray(lkj.sample().numpy())
+        np.testing.assert_allclose(np.diag(own @ own.T), 1.0, rtol=1e-5)
+
+    def test_exponential_family_entropy_identity(self):
+        import paddle_tpu.distribution as D
+        import jax.numpy as jnp
+
+        class NormalEF(D.ExponentialFamily):
+            # N(mu, 1): theta = mu, logZ = mu^2/2 (+ const carrier)
+            def __init__(self, mu):
+                self.mu = jnp.float32(mu)
+                super().__init__(batch_shape=())
+
+            @property
+            def _natural_parameters(self):
+                return (self.mu,)
+
+            def _log_normalizer(self, mu):
+                return 0.5 * mu * mu
+
+            def _mean_carrier_measure(self):
+                # E[log carrier] = E[-x^2/2 - log sqrt(2pi)]
+                return -0.5 * (1 + self.mu ** 2) - 0.5 * np.log(
+                    2 * np.pi)
+
+        ent = float(NormalEF(1.3)._entropy())
+        want = 0.5 * np.log(2 * np.pi * np.e)  # N(mu,1) entropy
+        np.testing.assert_allclose(ent, want, rtol=1e-5)
