@@ -17,7 +17,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["store.cpp", "datapath.cpp", "ckptio.cpp"]
+_SOURCES = ["store.cpp", "datapath.cpp", "ckptio.cpp", "datafeed.cpp"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None

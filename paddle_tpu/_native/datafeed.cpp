@@ -1,0 +1,152 @@
+// Native MultiSlot datafeed parser — the hot loop of the PS/fleet slot
+// pipeline (reference: paddle/fluid/framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance — C++ trainer-thread parsing).
+//
+// Parses "<n> v1 ... vn" repeated per slot per line into per-slot
+// columns. The whole file parse runs WITHOUT the GIL (called via ctypes)
+// and multi-threads across line ranges.
+//
+// Protocol (two-pass, caller allocates):
+//   pass 1: pt_slotfile_scan  -> counts (n_samples, per-slot total values)
+//   pass 2: pt_slotfile_parse -> fills values + per-sample lengths
+#include <atomic>
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Line {
+  const char* begin;
+  const char* end;
+};
+
+// split buffer into non-empty lines
+static std::vector<Line> split_lines(const char* buf, int64_t len) {
+  std::vector<Line> lines;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* stop = nl ? nl : end;
+    const char* q = p;
+    while (q < stop && isspace(static_cast<unsigned char>(*q))) ++q;
+    if (q < stop) lines.push_back({p, stop});
+    p = stop + 1;
+  }
+  return lines;
+}
+
+// parse one line: for each slot read count then values; returns false on
+// malformed input (caller skips the line, like the python fallback)
+static bool parse_line(const Line& ln, int n_slots, double* vals_out,
+                       int64_t* counts_out, int64_t max_vals,
+                       int64_t* n_vals) {
+  const char* p = ln.begin;
+  const char* end = ln.end;
+  int64_t written = 0;
+  for (int s = 0; s < n_slots; ++s) {
+    // manual in-line whitespace skip: strtol's own skip would walk
+    // through '\n' into the next line on a truncated slot list
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    if (p >= end) return false;
+    char* next = nullptr;
+    long cnt = strtol(p, &next, 10);
+    if (next == p || cnt < 0) return false;
+    // the count token must END at whitespace: "1.5" parses as count 1
+    // with strtol but is malformed slot data (python fallback rejects it)
+    if (next < end && *next != ' ' && *next != '\t' && *next != '\r' &&
+        *next != '\n')
+      return false;
+    if (next > end) return false;
+    p = next;
+    for (long i = 0; i < cnt; ++i) {
+      // stay inside THIS line: strtod would happily skip the newline
+      // and consume the next line's tokens on a truncated slot
+      while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+      if (p >= end) return false;
+      double v = strtod(p, &next);
+      if (next == p || next > end) return false;
+      p = next;
+      if (vals_out) {
+        if (written >= max_vals) return false;
+        vals_out[written] = v;
+      }
+      ++written;
+    }
+    if (counts_out) counts_out[s] = cnt;
+    if (p > end) return false;
+  }
+  *n_vals = written;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: count well-formed samples and total values (all slots).
+// Returns n_samples; total_vals receives the value count.
+int64_t pt_slotfile_scan(const char* buf, int64_t len, int n_slots,
+                         int64_t* total_vals, int num_threads) {
+  auto lines = split_lines(buf, len);
+  std::atomic<int64_t> samples{0}, vals{0};
+  auto work = [&](size_t lo, size_t hi) {
+    int64_t local_s = 0, local_v = 0;
+    for (size_t i = lo; i < hi; ++i) {
+      int64_t nv = 0;
+      if (parse_line(lines[i], n_slots, nullptr, nullptr, 0, &nv)) {
+        ++local_s;
+        local_v += nv;
+      }
+    }
+    samples += local_s;
+    vals += local_v;
+  };
+  int nt = num_threads > 1 ? num_threads : 1;
+  if (nt == 1 || lines.size() < 64) {
+    work(0, lines.size());
+  } else {
+    std::vector<std::thread> ts;
+    size_t per = (lines.size() + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      size_t lo = t * per;
+      size_t hi = lo + per < lines.size() ? lo + per : lines.size();
+      if (lo >= hi) break;
+      ts.emplace_back(work, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  *total_vals = vals.load();
+  return samples.load();
+}
+
+// Pass 2: parse into caller-allocated buffers.
+//   values:  double[total_vals]   (slot-major within each sample)
+//   lengths: int64[n_samples * n_slots]  per-sample per-slot counts
+// Single-threaded fill (deterministic order); parsing already validated.
+int64_t pt_slotfile_parse(const char* buf, int64_t len, int n_slots,
+                          double* values, int64_t total_vals,
+                          int64_t* lengths, int64_t n_samples) {
+  auto lines = split_lines(buf, len);
+  int64_t si = 0, off = 0;
+  std::vector<int64_t> counts(static_cast<size_t>(n_slots));
+  for (auto& ln : lines) {
+    if (si >= n_samples) break;
+    int64_t nv = 0;
+    if (!parse_line(ln, n_slots, values + off, counts.data(),
+                    total_vals - off, &nv))
+      continue;
+    memcpy(lengths + si * n_slots, counts.data(),
+           sizeof(int64_t) * static_cast<size_t>(n_slots));
+    off += nv;
+    ++si;
+  }
+  return si;
+}
+
+}  // extern "C"

@@ -58,25 +58,127 @@ class DatasetBase:
         try:
             for _ in self.use_var:
                 n = int(toks[i])
+                if n < 0 or i + 1 + n > len(toks):
+                    return None          # truncated slot: malformed line
                 vals = toks[i + 1:i + 1 + n]
                 i += 1 + n
-                arr = np.asarray([float(v) for v in vals], np.float32)
-                if all(float(v).is_integer() for v in arr.tolist()):
-                    # id slots stay integral (sparse feature ids)
-                    arr = arr.astype(np.int64)
-                slots.append(arr)
+                slots.append(np.asarray([float(v) for v in vals],
+                                        np.float64))
         except (ValueError, IndexError):
             return None
         return slots
 
+    def _slot_dtypes(self, first_sample) -> List[Any]:
+        """Canonical dtype rule for BOTH parse paths: decided per slot
+        from the FIRST valid line of a file (the reference's MultiSlot
+        proto fixes each slot's type from the leading record) — integral
+        non-empty values -> int64 (sparse feature ids), else float32."""
+        out = []
+        for arr in first_sample:
+            a = np.asarray(arr, np.float64)
+            out.append(np.int64 if a.size and
+                       bool(np.all(a == np.round(a))) else np.float32)
+        return out
+
+    def _iter_python(self, path) -> Iterator[List[np.ndarray]]:
+        dtypes = None
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                raw_slots = self._parse_line(line)
+                if raw_slots is None:
+                    continue
+                if dtypes is None:
+                    dtypes = self._slot_dtypes(raw_slots)
+                yield [a.astype(d) for a, d in zip(raw_slots, dtypes)]
+
+    _NATIVE_CHUNK = 64 << 20  # stream files in 64 MB line-aligned blocks
+
+    def _native_lib(self):
+        from ... import _native
+        lib = _native.load()
+        if lib is None or not hasattr(lib, "pt_slotfile_scan"):
+            return None
+        import ctypes
+        lib.pt_slotfile_scan.restype = ctypes.c_int64
+        lib.pt_slotfile_parse.restype = ctypes.c_int64
+        return lib
+
+    def _parse_chunk_native(self, lib, buf: bytes, dtypes):
+        """Parse one line-aligned byte chunk with the C++ parser; returns
+        (samples, dtypes) with dtypes resolved from the first sample when
+        not yet known."""
+        import ctypes
+        n_slots = len(self.use_var)
+        total = ctypes.c_int64(0)
+        n = lib.pt_slotfile_scan(buf, ctypes.c_int64(len(buf)),
+                                 ctypes.c_int(n_slots),
+                                 ctypes.byref(total),
+                                 ctypes.c_int(self.thread_num))
+        if n <= 0:
+            return [], dtypes
+        vals = np.empty(total.value, np.float64)
+        lens = np.empty((n, n_slots), np.int64)
+        got = int(lib.pt_slotfile_parse(
+            buf, ctypes.c_int64(len(buf)), ctypes.c_int(n_slots),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(total.value),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(n)))
+        lens = lens[:got]
+        flat_lens = lens.reshape(-1)
+        ends = np.cumsum(flat_lens)
+        starts = ends - flat_lens
+        if dtypes is None and got:
+            first = [vals[starts[s]:ends[s]] for s in range(n_slots)]
+            dtypes = self._slot_dtypes(first)
+        # one full-array cast per dtype actually used; per-sample work is
+        # then two O(1) view slices per slot
+        cast = {}
+        for d in set(dtypes or []):
+            cast[d] = vals.astype(d)
+        samples = []
+        for i in range(got):
+            base = i * n_slots
+            samples.append([
+                cast[dtypes[s]][starts[base + s]:ends[base + s]]
+                for s in range(n_slots)])
+        return samples, dtypes
+
+    def _iter_native(self, path) -> Optional[Iterator[List[np.ndarray]]]:
+        lib = self._native_lib()
+        if lib is None:
+            return None
+
+        def gen():
+            dtypes = None
+            rem = b""
+            with open(path, "rb") as f:
+                while True:
+                    blk = f.read(self._NATIVE_CHUNK)
+                    if not blk:
+                        if rem.strip():
+                            samples, dtypes2 = self._parse_chunk_native(
+                                lib, rem, dtypes)
+                            yield from samples
+                        return
+                    buf = rem + blk
+                    cut = buf.rfind(b"\n")
+                    if cut < 0:
+                        rem = buf
+                        continue
+                    samples, dtypes = self._parse_chunk_native(
+                        lib, buf[:cut + 1], dtypes)
+                    rem = buf[cut + 1:]
+                    yield from samples
+        return gen()
+
     def _iter_samples(self) -> Iterator[List[np.ndarray]]:
         for path in self.filelist:
-            with open(path, "r", encoding="utf-8",
-                      errors="replace") as f:
-                for line in f:
-                    s = self._parse_line(line)
-                    if s is not None:
-                        yield s
+            native = self._iter_native(path)
+            if native is not None:
+                yield from native
+                continue
+            yield from self._iter_python(path)
 
     def _batches_from(self, samples) -> Iterator[Dict[str, np.ndarray]]:
         names = self._var_names()
