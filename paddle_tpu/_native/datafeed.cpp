@@ -10,6 +10,7 @@
 //   pass 1: pt_slotfile_scan  -> counts (n_samples, per-slot total values)
 //   pass 2: pt_slotfile_parse -> fills values + per-sample lengths
 #include <atomic>
+#include <charconv>
 #include <cctype>
 #include <cstdint>
 #include <cstdlib>
@@ -50,28 +51,29 @@ static bool parse_line(const Line& ln, int n_slots, double* vals_out,
   const char* end = ln.end;
   int64_t written = 0;
   for (int s = 0; s < n_slots; ++s) {
-    // manual in-line whitespace skip: strtol's own skip would walk
-    // through '\n' into the next line on a truncated slot list
+    // manual in-line whitespace skip (never walks through '\n' into the
+    // next line on a truncated slot list)
     while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
     if (p >= end) return false;
-    char* next = nullptr;
-    long cnt = strtol(p, &next, 10);
-    if (next == p || cnt < 0) return false;
+    // std::from_chars: locale-INDEPENDENT (strtol/strtod would honor
+    // LC_NUMERIC and diverge from the python fallback under e.g. de_DE)
+    long cnt = 0;
+    auto cres = std::from_chars(p, end, cnt);
+    if (cres.ec != std::errc() || cnt < 0) return false;
+    const char* next = cres.ptr;
     // the count token must END at whitespace: "1.5" parses as count 1
-    // with strtol but is malformed slot data (python fallback rejects it)
+    // but is malformed slot data (python fallback rejects it)
     if (next < end && *next != ' ' && *next != '\t' && *next != '\r' &&
         *next != '\n')
       return false;
-    if (next > end) return false;
     p = next;
     for (long i = 0; i < cnt; ++i) {
-      // stay inside THIS line: strtod would happily skip the newline
-      // and consume the next line's tokens on a truncated slot
       while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
       if (p >= end) return false;
-      double v = strtod(p, &next);
-      if (next == p || next > end) return false;
-      p = next;
+      double v = 0.0;
+      auto vres = std::from_chars(p, end, v);
+      if (vres.ec != std::errc()) return false;
+      p = vres.ptr;
       if (vals_out) {
         if (written >= max_vals) return false;
         vals_out[written] = v;

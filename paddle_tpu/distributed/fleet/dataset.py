@@ -68,17 +68,55 @@ class DatasetBase:
             return None
         return slots
 
-    def _slot_dtypes(self, first_sample) -> List[Any]:
-        """Canonical dtype rule for BOTH parse paths: decided per slot
-        from the FIRST valid line of a file (the reference's MultiSlot
-        proto fixes each slot's type from the leading record) — integral
-        non-empty values -> int64 (sparse feature ids), else float32."""
+    def _declared_dtypes(self) -> List[Optional[Any]]:
+        """Declared slot dtypes (reference: the MultiSlot PROTO fixes each
+        slot's type from the config, not from data): honored when
+        ``use_var`` entries carry a dtype (placeholders/tensors); plain
+        string names leave the slot undeclared (None)."""
         out = []
-        for arr in first_sample:
+        for v in self.use_var:
+            d = getattr(v, "dtype", None)
+            if d is None:
+                out.append(None)
+                continue
+            name = str(d).split(".")[-1]
+            out.append(np.int64 if "int" in name else np.float32)
+        return out
+
+    def _slot_dtypes(self, first_sample) -> List[Any]:
+        """Canonical dtype rule for BOTH parse paths: declared dtype when
+        given, else inferred per slot from the FIRST valid line of the
+        file — integral non-empty values -> int64 (sparse feature ids),
+        else float32. An inferred-int slot that later shows fractional
+        values is PROMOTED to float32 (with a warning) rather than
+        silently truncated — see :meth:`_safe_cast`."""
+        declared = self._declared_dtypes()
+        out = []
+        for arr, dec in zip(first_sample, declared):
+            if dec is not None:
+                out.append(dec)
+                continue
             a = np.asarray(arr, np.float64)
             out.append(np.int64 if a.size and
                        bool(np.all(a == np.round(a))) else np.float32)
         return out
+
+    def _safe_cast(self, arr64: np.ndarray, dtypes: List[Any],
+                   slot: int) -> np.ndarray:
+        """Cast per the slot dtype; an UNDECLARED slot inferred int64
+        falls back to float32 for any sample carrying fractions (and
+        flips the slot for the rest of the stream)."""
+        d = dtypes[slot]
+        if d is np.int64 and self._declared_dtypes()[slot] is None and \
+                arr64.size and not bool(np.all(arr64 == np.round(arr64))):
+            import warnings
+            warnings.warn(
+                f"slot {slot}: fractional values after an integral first "
+                "line — promoting the slot to float32 (declare the slot "
+                "dtype via use_var to silence)")
+            dtypes[slot] = np.float32
+            d = np.float32
+        return arr64.astype(d)
 
     def _iter_python(self, path) -> Iterator[List[np.ndarray]]:
         dtypes = None
@@ -89,7 +127,8 @@ class DatasetBase:
                     continue
                 if dtypes is None:
                     dtypes = self._slot_dtypes(raw_slots)
-                yield [a.astype(d) for a, d in zip(raw_slots, dtypes)]
+                yield [self._safe_cast(a, dtypes, s)
+                       for s, a in enumerate(raw_slots)]
 
     _NATIVE_CHUNK = 64 << 20  # stream files in 64 MB line-aligned blocks
 
@@ -131,17 +170,48 @@ class DatasetBase:
         if dtypes is None and got:
             first = [vals[starts[s]:ends[s]] for s in range(n_slots)]
             dtypes = self._slot_dtypes(first)
+        # same promote-on-fraction rule as _safe_cast, vectorized and at
+        # the SAME granularity: an UNDECLARED inferred-int64 slot flips to
+        # float32 from its first fractional SAMPLE onward (warn), never
+        # truncating — identical output to the python path regardless of
+        # chunk boundaries
+        declared = self._declared_dtypes()
+        chunk_dtypes = list(dtypes)
+        flip_at = {}
+        int_slots = [s for s in range(n_slots)
+                     if dtypes[s] is np.int64 and declared[s] is None]
+        if int_slots:
+            frac_cum = np.concatenate(
+                [[0], np.cumsum(vals != np.round(vals))])
+            for s in int_slots:
+                idx = np.arange(got) * n_slots + s
+                s_starts = starts[idx]
+                s_lens = flat_lens[idx]
+                bad = (frac_cum[s_starts + s_lens]
+                       - frac_cum[s_starts]) > 0
+                if bool(bad.any()):
+                    import warnings
+                    warnings.warn(
+                        f"slot {s}: fractional values after an integral "
+                        "first line — promoting the slot to float32 "
+                        "(declare the slot dtype via use_var to silence)")
+                    flip_at[s] = int(np.argmax(bad))
+                    dtypes[s] = np.float32   # persists to later chunks
         # one full-array cast per dtype actually used; per-sample work is
         # then two O(1) view slices per slot
         cast = {}
-        for d in set(dtypes or []):
+        for d in set(chunk_dtypes) | set(dtypes):
             cast[d] = vals.astype(d)
         samples = []
         for i in range(got):
             base = i * n_slots
-            samples.append([
-                cast[dtypes[s]][starts[base + s]:ends[base + s]]
-                for s in range(n_slots)])
+            row = []
+            for s in range(n_slots):
+                d = chunk_dtypes[s]
+                if s in flip_at and i >= flip_at[s]:
+                    d = np.float32
+                row.append(cast[d][starts[base + s]:ends[base + s]])
+            samples.append(row)
         return samples, dtypes
 
     def _iter_native(self, path) -> Optional[Iterator[List[np.ndarray]]]:

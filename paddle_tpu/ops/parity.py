@@ -21,7 +21,7 @@ from ._registry import register, as_tensor, raw, TENSOR_METHODS
 
 __all__ = [
     "sequence_mask", "gather_tree", "edit_distance", "top_p_sampling",
-    "clip_by_norm", "multi_dot",
+    "clip_by_norm", "multi_dot", "dequantize_log", "lookup_table_dequant",
 ]
 
 
@@ -198,3 +198,37 @@ def _exponential_(self, lam=1.0, seed=0, name=None):
 TENSOR_METHODS["uniform_"] = _uniform_
 TENSOR_METHODS["normal_"] = _normal_
 TENSOR_METHODS["exponential_"] = _exponential_
+
+
+@register("dequantize_log", tensor_method=False)
+def dequantize_log(x, dict, name=None):
+    """reference: phi/kernels/cpu/dequantize_log_kernel.cc — 8-bit
+    log-quantized values decode through a 128-entry magnitude table;
+    negative codes mirror to negative magnitudes."""
+    d = raw(as_tensor(dict))
+
+    def f(codes):
+        c = codes.astype(jnp.int32)
+        return jnp.where(c < 0, -jnp.take(d, c + 128), jnp.take(d, c))
+    return apply(f, as_tensor(x), name="dequantize_log")
+
+
+@register("lookup_table_dequant", tensor_method=False)
+def lookup_table_dequant(w, ids, padding_idx=-1, name=None):
+    """reference: phi/kernels/cpu/lookup_table_dequant_kernel.cc — an
+    embedding lookup whose rows are stored 8-bit quantized: row layout is
+    [min, max, packed uint8 payload in the remaining float32 columns];
+    dequant = (max-min)/256 * byte + min. padding_idx rows come back 0."""
+    def f(table, idx):
+        rows = jnp.take(table, idx.astype(jnp.int32), axis=0)
+        mn = rows[..., 0:1]
+        mx = rows[..., 1:2]
+        payload = jax.lax.bitcast_convert_type(rows[..., 2:], jnp.uint8)
+        payload = payload.reshape(*rows.shape[:-1], -1)
+        scale = (mx - mn) / 256.0
+        out = scale * payload.astype(jnp.float32) + mn
+        if padding_idx is not None and padding_idx >= 0:
+            out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+        return out
+    return apply(f, as_tensor(w), as_tensor(ids),
+                 name="lookup_table_dequant")

@@ -231,9 +231,13 @@ class TestNativeDatafeed:
                     np.asarray(sa, np.float64),
                     np.asarray(sb, np.float64))
         # dtype rule: decided from FIRST line -> slot1 ("2.0" integral)
-        # is int64 for the whole file, truncating 0.5 -> 0 consistently
+        # starts int64, then PROMOTES to float32 at the first fractional
+        # sample (0.5 preserved, not truncated) — identically on both
+        # paths
         assert native[0][0].dtype == np.int64
-        assert native[1][1].dtype == native[0][1].dtype
+        assert native[0][1].dtype == np.int64
+        assert native[1][1].dtype == np.float32
+        np.testing.assert_allclose(native[1][1], [0.5], rtol=1e-6)
 
     def test_streaming_chunks(self, tmp_path):
         """Chunked native reads preserve QueueDataset's streaming
@@ -261,3 +265,43 @@ class TestNativeDatafeed:
                     np.asarray(sa, np.float64),
                     np.asarray(sb, np.float64))
                 assert sa.dtype == sb.dtype
+
+    def test_dtype_promotion_parity(self, tmp_path):
+        """An undeclared slot with an integral first line but later
+        fractions PROMOTES to float32 (from that sample onward) instead
+        of silently truncating — identically on both paths and across
+        chunk boundaries."""
+        import warnings as _w
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import _native
+        if _native.load() is None:
+            pytest.skip("native toolchain unavailable")
+        p = tmp_path / "promo.txt"
+        lines = ["2 0 0\n", "2 1 2\n", "2 0.5 0.7\n", "2 3 4\n"]
+        p.write_text("".join(lines) * 3)
+        ds = dist.QueueDataset()
+        ds.init(batch_size=4, use_var=["dense"])
+        ds.set_filelist([str(p)])
+        ds._NATIVE_CHUNK = 16       # force chunk boundaries mid-pattern
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            native = list(ds._iter_samples())
+            ds._iter_native = lambda path: None
+            python = list(ds._iter_samples())
+        assert len(native) == len(python) == 12
+        for a, b in zip(native, python):
+            assert a[0].dtype == b[0].dtype
+            np.testing.assert_allclose(np.asarray(a[0], np.float64),
+                                       np.asarray(b[0], np.float64))
+        # fractions preserved after promotion
+        assert native[2][0].dtype == np.float32
+        np.testing.assert_allclose(native[2][0], [0.5, 0.7], rtol=1e-6)
+        # declared dtype wins and silences inference
+        class Var:
+            dtype = "float32"
+            name = "dense"
+        ds2 = dist.QueueDataset()
+        ds2.init(batch_size=4, use_var=[Var()])
+        ds2.set_filelist([str(p)])
+        out = list(ds2._iter_samples())
+        assert all(s[0].dtype == np.float32 for s in out)

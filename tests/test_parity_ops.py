@@ -483,3 +483,37 @@ class TestFractionalPooling:
         assert tuple(out.shape) == (1, 1, 3, 3)
         with pytest.raises(ValueError):
             F.fractional_max_pool2d(x, (3, 3), random_u=1.5)
+
+
+class TestDequantOps:
+    def test_dequantize_log(self):
+        import paddle_tpu as paddle
+        d = np.linspace(0.01, 2.0, 128).astype(np.float32)
+        x = np.array([0, 5, -3, 127, -128], np.int8)
+        out = paddle.dequantize_log(paddle.to_tensor(x),
+                                    paddle.to_tensor(d)).numpy()
+        want = np.asarray([d[0], d[5], -d[-3 + 128], d[127], -d[0]],
+                          np.float32)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_lookup_table_dequant(self):
+        import paddle_tpu as paddle
+        rows, width = 4, 8
+        mn, mx = -1.0, 3.0
+        bytes_ = np.random.RandomState(0).randint(
+            0, 256, (rows, width), np.uint8)
+        payload = bytes_.view(np.float32)
+        table = np.concatenate(
+            [np.full((rows, 1), mn, np.float32),
+             np.full((rows, 1), mx, np.float32), payload], 1)
+        ids = np.array([2, 0, 3], np.int64)
+        out = paddle.lookup_table_dequant(paddle.to_tensor(table),
+                                          paddle.to_tensor(ids)).numpy()
+        want = (mx - mn) / 256.0 * bytes_[ids].astype(np.float32) + mn
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+        # padding rows come back zero
+        out_p = paddle.lookup_table_dequant(
+            paddle.to_tensor(table), paddle.to_tensor(ids),
+            padding_idx=0).numpy()
+        assert np.abs(out_p[1]).max() == 0
+        np.testing.assert_allclose(out_p[0], want[0], rtol=1e-5)

@@ -119,7 +119,6 @@ ALIASES = {
     "update_loss_scaling_": "amp.GradScaler",
     "check_finite_and_unscale_": "amp.GradScaler",
     "get_tensor_from_selected_rows": None,
-    "merge_selected_rows": None,
     "limit_by_capacity": "incubate moe", "prune_gate_by_capacity":
         "incubate moe", "random_routing": "incubate moe",
     "number_count": "incubate moe",
@@ -150,7 +149,6 @@ ALIASES = {
     "huber_loss": "nn.functional.smooth_l1_loss",
     "hinge_loss": "nn.functional.hinge_embedding_loss",
     "warpctc": "nn.functional.ctc_loss",
-    "warprnnt": None,
     "bicubic_interp": "nn.functional.interpolate",
     "bilinear_interp": "nn.functional.interpolate",
     "linear_interp": "nn.functional.interpolate",
@@ -211,8 +209,6 @@ ALIASES = {
     "llm_int8_linear": "nn.quant.llm_int8_linear",
     "apply_per_channel_scale": "nn.quant (dequant fused in matmul)",
     "dequantize_abs_max": "nn.quant.weight_dequantize",
-    "dequantize_log": None,
-    "lookup_table_dequant": None,
     "fractional_max_pool2d": "nn.functional.fractional_max_pool2d",
     "fractional_max_pool3d": "nn.functional.fractional_max_pool3d",
     "unpool": "nn.functional.max_unpool2d",
@@ -222,6 +218,8 @@ ALIASES = {
     "gather_tree": "gather_tree", "sequence_mask": "sequence_mask",
     "top_p_sampling": "top_p_sampling",
     "clip_by_norm": "clip_by_norm",
+    "warprnnt": "nn.functional.rnnt_loss (lax.scan forward-DP)",
+    "merge_selected_rows": "sparse.coalesce (duplicate-row merge)",
     "dgc_clip_by_norm": "DGCMomentumOptimizer(grad_clip=...) n^-0.5 scaling",
     "multi_dot": "linalg.multi_dot", "lu_unpack": "linalg.lu_unpack",
     "edit_distance": "edit_distance",
@@ -279,6 +277,9 @@ OUT_OF_SCOPE = {
     "shuffle_channel", "temporal_shift", "spectral_norm",
     "class_center_sample", "hsigmoid_loss",
     "dpsgd", "ftrl",
+    # GPU/NPU-runtime specific: fused LSTM+attention CPU-only legacy op,
+    # flash-attention GPU helper, ascend-format identity
+    "attention_lstm", "calc_reduced_attn_scores", "npu_identity",
     # sparse 3D point-cloud conv stack (GPU implicit-gemm; no TPU sparse
     # conv path — dense conv3d covers the capability)
     "conv3d_implicit_gemm", "maxpool", "fused_attention",
