@@ -190,7 +190,7 @@ def probe_backend(timeout_s: int) -> Optional[str]:
 def parent_main():
     """Run the measurement in a watchdog-guarded child; retry transient
     backend-init failures; ALWAYS print exactly one JSON line."""
-    attempts = int(os.environ.get("PADDLE_TPU_BENCH_ATTEMPTS", "3"))
+    attempts = int(os.environ.get("PADDLE_TPU_BENCH_ATTEMPTS", "5"))
     timeout_s = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
     probe_s = int(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))
     last_err = "unknown"
@@ -199,7 +199,10 @@ def parent_main():
         if perr is not None:
             last_err = f"attempt {i + 1}: {perr}"
             if i + 1 < attempts:
-                time.sleep(10 * (i + 1))
+                # a flaky tunnel often recovers on the order of minutes;
+                # the probe itself is cheap, so wait meaningfully between
+                # attempts (total patience ~= attempts * (probe + 60s))
+                time.sleep(60)
             continue
         try:
             proc = subprocess.run(

@@ -1,0 +1,49 @@
+"""Shared harness for the BASELINE.md benchmark recipes."""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def parse_args(extra=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=["tiny", "full"], default="tiny")
+    ap.add_argument("--iters", type=int, default=10)
+    for name, kw in (extra or {}).items():
+        ap.add_argument(name, **kw)
+    return ap.parse_args()
+
+
+def build_mesh(axes, factors):
+    """Mesh over all visible devices: ``axes`` names sized by ``factors``
+    (a -1 factor absorbs the remaining devices)."""
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    n = len(devs)
+    sizes = list(factors)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = max(1, n // known)
+    used = int(np.prod(sizes))
+    return Mesh(np.asarray(devs[:used]).reshape(sizes), tuple(axes))
+
+
+def timeit(step_fn, warmup=2, iters=10):
+    import jax
+    for _ in range(warmup):
+        out = step_fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def emit(metric, value, unit, **extra):
+    print(json.dumps({"metric": metric, "value": round(float(value), 2),
+                      "unit": unit, "extra": extra}))

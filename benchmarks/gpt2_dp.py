@@ -1,0 +1,54 @@
+"""BASELINE target #2: GPT-2 data parallel, bf16 (AMP O2-equivalent).
+
+Reference recipe: fleet DP + AMP; TPU-native: dp×fsdp batch sharding with
+the bf16 train step (master fp32 optimizer states), XLA fuses the grad
+all-reduce into the backward.
+"""
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import parse_args, build_mesh, timeit, emit  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    from paddle_tpu.models import gpt, train
+
+    if args.preset == "full":
+        cfg = gpt.GPTConfig.gpt2_124m(dtype=jnp.bfloat16)
+        batch, seq = 8 * max(1, jax.device_count()), 1024
+    else:
+        cfg = gpt.GPTConfig.tiny()
+        batch, seq = 2 * max(1, jax.device_count()), 128
+
+    mesh = build_mesh(("dp", "fsdp", "tp"), (-1, 1, 1))
+    step = train.make_train_step(cfg, mesh, model=gpt)
+    state = jax.jit(lambda k: train.init_train_state(k, cfg, model=gpt),
+                    out_shardings=train.state_shardings(
+                        mesh, cfg, model=gpt))(jax.random.key(0))
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq)), jnp.int32),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec(("dp",))))
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], m = step(holder["state"], tokens)
+        return m["loss"]
+
+    dt, loss = timeit(one, iters=args.iters)
+    emit("gpt2_dp_tokens_per_sec", batch * seq / dt, "tokens/s",
+         preset=args.preset, devices=jax.device_count(),
+         loss=float(loss), params=cfg.num_params())
+
+
+if __name__ == "__main__":
+    main()

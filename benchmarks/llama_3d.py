@@ -1,0 +1,58 @@
+"""BASELINE target #4: Llama 3D hybrid (dp x pp x tp) + recompute, 1F1B.
+
+Reference recipe: TP x PP x DP with recompute on v5p-32; TPU-native: the
+SPMD pipeline wavefront (shard_map + ppermute) with the 1F1B schedule.
+"""
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import parse_args, build_mesh, timeit, emit  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    from paddle_tpu.models import llama, train, train_pp
+
+    n = max(1, jax.device_count())
+    if args.preset == "full":
+        cfg = llama.LlamaConfig.llama2_13b(dtype=jnp.bfloat16, remat=True)
+        pp, tp = 4, min(8, max(1, n // 8))
+        batch, seq, microbatches = 8, 4096, 8
+    else:
+        pp = 2 if n % 2 == 0 else 1
+        tp = 2 if (n // pp) % 2 == 0 else 1
+        cfg = llama.LlamaConfig.tiny(num_layers=4)
+        batch, seq, microbatches = 4, 64, 2 * pp
+
+    mesh = build_mesh(("dp", "pp", "tp"), (-1, pp, tp))
+    step = train_pp.make_train_step_pp(
+        cfg, mesh, num_microbatches=microbatches, schedule="1f1b")
+    state = jax.jit(lambda k: train.init_train_state(k, cfg),
+                    out_shardings=train_pp.state_shardings_pp(mesh, cfg))(
+        jax.random.key(0))
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq)), jnp.int32),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec(("dp",))))
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], m = step(holder["state"], tokens)
+        return m["loss"]
+
+    dt, loss = timeit(one, iters=args.iters)
+    emit("llama_3d_1f1b_tokens_per_sec", batch * seq / dt, "tokens/s",
+         preset=args.preset, devices=n, pp=pp, tp=tp,
+         microbatches=microbatches, loss=float(loss))
+
+
+if __name__ == "__main__":
+    main()

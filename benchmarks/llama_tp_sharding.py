@@ -1,0 +1,55 @@
+"""BASELINE target #3: Llama with tensor parallel + ZeRO sharding.
+
+Reference recipe: mp_degree=8 + sharding stage-2; TPU-native: tp axis for
+Megatron layers + fsdp axis sharding params/grads/optimizer states.
+"""
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import parse_args, build_mesh, timeit, emit  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    from paddle_tpu.models import llama, train
+
+    n = max(1, jax.device_count())
+    tp = min(8, n) if args.preset == "full" else (2 if n % 2 == 0 else 1)
+    if args.preset == "full":
+        cfg = llama.LlamaConfig.llama2_7b(dtype=jnp.bfloat16, remat=True)
+        batch, seq = max(1, n // tp) * 1, 4096
+    else:
+        cfg = llama.LlamaConfig.tiny(num_layers=2)
+        batch, seq = max(2, n // tp), 128
+
+    mesh = build_mesh(("dp", "fsdp", "tp"), (1, -1, tp))
+    step = train.make_train_step(cfg, mesh)
+    state = jax.jit(lambda k: train.init_train_state(k, cfg),
+                    out_shardings=train.state_shardings(mesh, cfg))(
+        jax.random.key(0))
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq)), jnp.int32),
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("dp", "fsdp"))))
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], m = step(holder["state"], tokens)
+        return m["loss"]
+
+    dt, loss = timeit(one, iters=args.iters)
+    emit("llama_tp_sharding_tokens_per_sec", batch * seq / dt, "tokens/s",
+         preset=args.preset, devices=n, tp=tp, loss=float(loss),
+         params=cfg.num_params())
+
+
+if __name__ == "__main__":
+    main()

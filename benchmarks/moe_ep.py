@@ -1,0 +1,63 @@
+"""BASELINE target #5: MoE with expert parallelism (ERNIE-MoE-style).
+
+Reference recipe: expert-parallel AllToAll; TPU-native: experts sharded
+over the ep mesh axis, GShard top-2 capacity routing with einsum
+dispatch/combine (the all-to-all rides ICI).
+"""
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import parse_args, build_mesh, timeit, emit  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    from paddle_tpu.models import llama, moe, train
+
+    n = max(1, jax.device_count())
+    ep = min(8, n) if args.preset == "full" else (2 if n % 2 == 0 else 1)
+    if args.preset == "full":
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=4096,
+            num_layers=12, num_heads=16, num_kv_heads=16,
+            max_seq_len=2048, dtype=jnp.bfloat16, remat=True,
+            moe=moe.MoEConfig(num_experts=ep * 2, top_k=2))
+        batch, seq = max(1, n // ep) * 2, 2048
+    else:
+        cfg = llama.LlamaConfig.tiny(
+            num_layers=2, moe=moe.MoEConfig(num_experts=max(2, ep),
+                                            top_k=2))
+        batch, seq = max(2, n // ep), 64
+
+    mesh = build_mesh(("dp", "ep", "tp"), (-1, ep, 1))
+    step = train.make_train_step(cfg, mesh, data_axes=("dp",),
+                                 ep_axis="ep")
+    state = jax.jit(lambda k: train.init_train_state(k, cfg),
+                    out_shardings=train.state_shardings(mesh, cfg))(
+        jax.random.key(0))
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq)), jnp.int32),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec(("dp",))))
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], m = step(holder["state"], tokens)
+        return m["loss"]
+
+    dt, loss = timeit(one, iters=args.iters)
+    emit("moe_ep_tokens_per_sec", batch * seq / dt, "tokens/s",
+         preset=args.preset, devices=n, ep=ep,
+         experts=cfg.moe.num_experts, loss=float(loss))
+
+
+if __name__ == "__main__":
+    main()

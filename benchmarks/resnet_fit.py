@@ -1,0 +1,64 @@
+"""BASELINE target #1: ResNet50 on CIFAR-10-shaped data via Model.fit.
+
+Reference recipe: hapi Model.fit single device; datasets are offline in
+this environment, so the data is synthetic CIFAR-shaped (the measured
+path — input pipeline + jitted train step — is identical).
+"""
+import sys
+import time
+
+import numpy as np
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import parse_args, emit  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.vision import models
+
+    if args.preset == "full":
+        net = models.resnet50(num_classes=10)
+        n_samples, batch = 2048, 128
+    else:
+        net = models.resnet18(num_classes=10)
+        n_samples, batch = 128, 32
+
+    class FakeCifar(Dataset):
+        thread_safe = True
+
+        def __init__(self, n):
+            rs = np.random.RandomState(0)
+            self.x = rs.rand(n, 3, 32, 32).astype(np.float32)
+            self.y = rs.randint(0, 10, n).astype(np.int64)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    model = paddle.Model(net)
+    model.prepare(optimizer=Momentum(0.1, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=paddle.metric.Accuracy())
+    ds = FakeCifar(n_samples)
+    model.fit(ds, batch_size=batch, epochs=1, verbose=0,
+              num_workers=2)   # warmup/compile epoch
+    epochs = max(1, args.iters)
+    t0 = time.perf_counter()
+    model.fit(ds, batch_size=batch, epochs=epochs, verbose=0,
+              num_workers=2)
+    dt = time.perf_counter() - t0
+    emit("resnet_fit_images_per_sec", n_samples * epochs / dt,
+         "images/s", preset=args.preset, batch=batch, epochs=epochs)
+
+
+if __name__ == "__main__":
+    main()

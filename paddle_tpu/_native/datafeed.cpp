@@ -11,6 +11,8 @@
 //   pass 2: pt_slotfile_parse -> fills values + per-sample lengths
 #include <atomic>
 #include <charconv>
+#include <clocale>
+#include <locale.h>
 #include <cctype>
 #include <cstdint>
 #include <cstdlib>
@@ -19,6 +21,51 @@
 #include <vector>
 
 namespace {
+
+// Number parsing with python-float()/int() compatibility AND locale
+// independence: strtod_l/strtol_l against a process-wide "C" locale
+// (python's float() is itself a C-locale strtod-equivalent: leading '+'
+// accepted, overflow saturates to +/-inf, underflow to 0). The token is
+// bounded-copied so parsing can never run past this line.
+static locale_t c_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return loc;
+}
+
+static const char* token_end(const char* p, const char* end) {
+  const char* q = p;
+  while (q < end && *q != ' ' && *q != '\t' && *q != '\r' && *q != '\n')
+    ++q;
+  return q;
+}
+
+static const char* parse_double_py(const char* p, const char* end,
+                                   double* out) {
+  const char* te = token_end(p, end);
+  char buf[64];
+  size_t n = static_cast<size_t>(te - p);
+  if (n == 0 || n >= sizeof(buf)) return nullptr;
+  memcpy(buf, p, n);
+  buf[n] = '\0';
+  char* ep = nullptr;
+  *out = strtod_l(buf, &ep, c_locale());
+  if (ep != buf + n) return nullptr;   // trailing junk in the token
+  return te;
+}
+
+static const char* parse_long_py(const char* p, const char* end,
+                                 long* out) {
+  const char* te = token_end(p, end);
+  char buf[32];
+  size_t n = static_cast<size_t>(te - p);
+  if (n == 0 || n >= sizeof(buf)) return nullptr;
+  memcpy(buf, p, n);
+  buf[n] = '\0';
+  char* ep = nullptr;
+  *out = strtol_l(buf, &ep, 10, c_locale());
+  if (ep != buf + n) return nullptr;
+  return te;
+}
 
 struct Line {
   const char* begin;
@@ -58,22 +105,16 @@ static bool parse_line(const Line& ln, int n_slots, double* vals_out,
     // std::from_chars: locale-INDEPENDENT (strtol/strtod would honor
     // LC_NUMERIC and diverge from the python fallback under e.g. de_DE)
     long cnt = 0;
-    auto cres = std::from_chars(p, end, cnt);
-    if (cres.ec != std::errc() || cnt < 0) return false;
-    const char* next = cres.ptr;
-    // the count token must END at whitespace: "1.5" parses as count 1
-    // but is malformed slot data (python fallback rejects it)
-    if (next < end && *next != ' ' && *next != '\t' && *next != '\r' &&
-        *next != '\n')
-      return false;
+    const char* next = parse_long_py(p, end, &cnt);
+    if (next == nullptr || cnt < 0) return false;  // "1.5" etc. rejected
     p = next;
     for (long i = 0; i < cnt; ++i) {
       while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
       if (p >= end) return false;
       double v = 0.0;
-      auto vres = std::from_chars(p, end, v);
-      if (vres.ec != std::errc()) return false;
-      p = vres.ptr;
+      const char* vnext = parse_double_py(p, end, &v);
+      if (vnext == nullptr) return false;
+      p = vnext;
       if (vals_out) {
         if (written >= max_vals) return false;
         vals_out[written] = v;

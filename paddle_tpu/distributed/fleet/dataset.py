@@ -102,12 +102,12 @@ class DatasetBase:
         return out
 
     def _safe_cast(self, arr64: np.ndarray, dtypes: List[Any],
-                   slot: int) -> np.ndarray:
+                   slot: int, declared: List[Optional[Any]]) -> np.ndarray:
         """Cast per the slot dtype; an UNDECLARED slot inferred int64
         falls back to float32 for any sample carrying fractions (and
         flips the slot for the rest of the stream)."""
         d = dtypes[slot]
-        if d is np.int64 and self._declared_dtypes()[slot] is None and \
+        if d is np.int64 and declared[slot] is None and \
                 arr64.size and not bool(np.all(arr64 == np.round(arr64))):
             import warnings
             warnings.warn(
@@ -120,6 +120,7 @@ class DatasetBase:
 
     def _iter_python(self, path) -> Iterator[List[np.ndarray]]:
         dtypes = None
+        declared = self._declared_dtypes()   # hoisted out of the hot loop
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             for line in f:
                 raw_slots = self._parse_line(line)
@@ -127,7 +128,7 @@ class DatasetBase:
                     continue
                 if dtypes is None:
                     dtypes = self._slot_dtypes(raw_slots)
-                yield [self._safe_cast(a, dtypes, s)
+                yield [self._safe_cast(a, dtypes, s, declared)
                        for s, a in enumerate(raw_slots)]
 
     _NATIVE_CHUNK = 64 << 20  # stream files in 64 MB line-aligned blocks

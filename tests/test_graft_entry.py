@@ -56,3 +56,27 @@ def test_bench_smoke_cpu_prints_json():
     parsed = json.loads(line)
     assert parsed["metric"] == "llama_train_tokens_per_sec_per_chip"
     assert proc.returncode == 0 and parsed["value"] > 0, proc.stdout
+
+
+def test_benchmark_recipes_smoke():
+    """The BASELINE.md benchmark recipes (benchmarks/) must run and emit
+    a JSON metric on the virtual CPU mesh (tiny preset)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = root
+    for script in ("gpt2_dp.py", "moe_ep.py"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "benchmarks", script),
+             "--iters", "2"],
+            env=env, text=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, timeout=420)
+        assert proc.returncode == 0, (script, proc.stdout[-1500:])
+        last = proc.stdout.strip().splitlines()[-1]
+        parsed = json.loads(last)
+        assert parsed["value"] > 0 and "metric" in parsed, (script, last)

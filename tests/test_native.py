@@ -305,3 +305,28 @@ class TestNativeDatafeed:
         ds2.set_filelist([str(p)])
         out = list(ds2._iter_samples())
         assert all(s[0].dtype == np.float32 for s in out)
+
+    def test_sign_overflow_nan_token_parity(self, tmp_path):
+        """'+2.5', '1e400' (inf) and 'nan' tokens parse identically on
+        both paths (strtod_l C-locale == python float())."""
+        import warnings as _w
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import _native
+        if _native.load() is None:
+            pytest.skip("native toolchain unavailable")
+        p = tmp_path / "tok.txt"
+        p.write_text("1 +2.5 1 1e400\n+1 3 1 0.5\n1 nan 1 1.0\n")
+        ds = dist.QueueDataset()
+        ds.init(batch_size=8, use_var=["a", "b"])
+        ds.set_filelist([str(p)])
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            native = list(ds._iter_samples())
+            ds._iter_native = lambda path: None
+            python = list(ds._iter_samples())
+        assert len(native) == len(python) == 3
+        for a, b in zip(native, python):
+            for sa, sb in zip(a, b):
+                assert sa.dtype == sb.dtype
+                np.testing.assert_array_equal(
+                    np.asarray(sa, np.float64), np.asarray(sb, np.float64))
