@@ -47,3 +47,28 @@ def timeit(step_fn, warmup=2, iters=10):
 def emit(metric, value, unit, **extra):
     print(json.dumps({"metric": metric, "value": round(float(value), 2),
                       "unit": unit, "extra": extra}))
+
+
+def run_train_bench(step, state, tokens, metric, iters=10, **extra):
+    """Shared measurement skeleton for the train-step recipes: run
+    ``step(state, tokens)`` ``iters`` times after warmup and emit the
+    tokens/s metric."""
+    holder = {"state": state}
+
+    def one():
+        holder["state"], m = step(holder["state"], tokens)
+        return m["loss"]
+
+    dt, loss = timeit(one, iters=iters)
+    b, s = tokens.shape[0], tokens.shape[1]
+    emit(metric, b * s / dt, "tokens/s", loss=float(loss), **extra)
+
+
+def dp_sharded_tokens(mesh, batch, seq, vocab, axes=("dp",)):
+    """Random int32 tokens laid out over the mesh's data axes."""
+    import jax
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.random.default_rng(0).integers(
+        0, vocab, (batch, seq)), jnp.int32)
+    return jax.device_put(arr, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axes)))

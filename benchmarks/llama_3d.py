@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-from benchmarks._common import parse_args, build_mesh, timeit, emit  # noqa: E402
+from benchmarks._common import (  # noqa: E402
+    parse_args, build_mesh, run_train_bench, dp_sharded_tokens)
 
 
 def main():
@@ -36,22 +37,11 @@ def main():
     state = jax.jit(lambda k: train.init_train_state(k, cfg),
                     out_shardings=train_pp.state_shardings_pp(mesh, cfg))(
         jax.random.key(0))
-    tokens = jax.device_put(
-        jnp.asarray(np.random.default_rng(0).integers(
-            0, cfg.vocab_size, (batch, seq)), jnp.int32),
-        jax.sharding.NamedSharding(mesh,
-                                   jax.sharding.PartitionSpec(("dp",))))
-
-    holder = {"state": state}
-
-    def one():
-        holder["state"], m = step(holder["state"], tokens)
-        return m["loss"]
-
-    dt, loss = timeit(one, iters=args.iters)
-    emit("llama_3d_1f1b_tokens_per_sec", batch * seq / dt, "tokens/s",
-         preset=args.preset, devices=n, pp=pp, tp=tp,
-         microbatches=microbatches, loss=float(loss))
+    tokens = dp_sharded_tokens(mesh, batch, seq, cfg.vocab_size,
+                               axes=("dp",))
+    run_train_bench(step, state, tokens, "llama_3d_1f1b_tokens_per_sec",
+                    iters=args.iters, preset=args.preset,
+                    devices=jax.device_count(), pp=pp, tp=tp, microbatches=microbatches)
 
 
 if __name__ == "__main__":

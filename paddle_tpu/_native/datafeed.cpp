@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -39,26 +40,44 @@ static const char* token_end(const char* p, const char* end) {
   return q;
 }
 
+// exotic forms BOTH paths reject by contract (documented in
+// dataset.py): hex floats ('0x10' — strtod accepts, float() rejects)
+// and PEP-515 underscores ('1_5' — float() accepts, strtod rejects).
+// Rejecting them on both sides keeps the paths sample-identical.
+static bool exotic_token(const char* p, size_t n) {
+  for (size_t i = 0; i < n; ++i)
+    if (p[i] == '_' || p[i] == 'x' || p[i] == 'X') return true;
+  return false;
+}
+
 static const char* parse_double_py(const char* p, const char* end,
                                    double* out) {
   const char* te = token_end(p, end);
-  char buf[64];
   size_t n = static_cast<size_t>(te - p);
-  if (n == 0 || n >= sizeof(buf)) return nullptr;
-  memcpy(buf, p, n);
-  buf[n] = '\0';
+  if (n == 0 || exotic_token(p, n)) return nullptr;
+  char buf[64];
   char* ep = nullptr;
-  *out = strtod_l(buf, &ep, c_locale());
-  if (ep != buf + n) return nullptr;   // trailing junk in the token
+  if (n < sizeof(buf)) {
+    memcpy(buf, p, n);
+    buf[n] = '\0';
+    *out = strtod_l(buf, &ep, c_locale());
+    if (ep != buf + n) return nullptr;  // trailing junk in the token
+  } else {
+    // pathological long token (excess precision/padding): heap copy —
+    // the python fallback parses these, so must we
+    std::string big(p, n);
+    *out = strtod_l(big.c_str(), &ep, c_locale());
+    if (ep != big.c_str() + n) return nullptr;
+  }
   return te;
 }
 
 static const char* parse_long_py(const char* p, const char* end,
                                  long* out) {
   const char* te = token_end(p, end);
-  char buf[32];
   size_t n = static_cast<size_t>(te - p);
-  if (n == 0 || n >= sizeof(buf)) return nullptr;
+  if (n == 0 || n >= 31 || exotic_token(p, n)) return nullptr;
+  char buf[32];
   memcpy(buf, p, n);
   buf[n] = '\0';
   char* ep = nullptr;

@@ -49,6 +49,14 @@ class DatasetBase:
             names.append(getattr(v, "name", None) or str(v))
         return names
 
+    @staticmethod
+    def _token_ok(tok: str) -> bool:
+        # exotic numeric forms are rejected by BOTH parse paths so native
+        # and python stay sample-identical: hex floats ('0x10' — C strtod
+        # accepts, float() rejects) and PEP-515 underscores ('1_5' —
+        # float() accepts, C strtod rejects)
+        return not any(c in tok for c in "_xX")
+
     def _parse_line(self, line: str) -> Optional[List[np.ndarray]]:
         toks = line.split()
         if not toks:
@@ -57,11 +65,15 @@ class DatasetBase:
         i = 0
         try:
             for _ in self.use_var:
+                if not self._token_ok(toks[i]):
+                    return None
                 n = int(toks[i])
                 if n < 0 or i + 1 + n > len(toks):
                     return None          # truncated slot: malformed line
                 vals = toks[i + 1:i + 1 + n]
                 i += 1 + n
+                if not all(self._token_ok(v) for v in vals):
+                    return None
                 slots.append(np.asarray([float(v) for v in vals],
                                         np.float64))
         except (ValueError, IndexError):

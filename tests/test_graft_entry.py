@@ -70,7 +70,9 @@ def test_benchmark_recipes_smoke():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["PYTHONPATH"] = root
-    for script in ("gpt2_dp.py", "moe_ep.py"):
+    for script in ("gpt2_dp.py", "moe_ep.py",
+                   "llama_tp_sharding.py", "llama_3d.py",
+                   "resnet_fit.py"):
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "benchmarks", script),
              "--iters", "2"],

@@ -315,7 +315,8 @@ class TestNativeDatafeed:
         if _native.load() is None:
             pytest.skip("native toolchain unavailable")
         p = tmp_path / "tok.txt"
-        p.write_text("1 +2.5 1 1e400\n+1 3 1 0.5\n1 nan 1 1.0\n")
+        p.write_text("1 +2.5 1 1e400\n+1 3 1 0.5\n1 nan 1 1.0\n"
+                     "1 0x10 1 1.0\n1 1_5 1 2.0\n")  # exotic: both drop
         ds = dist.QueueDataset()
         ds.init(batch_size=8, use_var=["a", "b"])
         ds.set_filelist([str(p)])
