@@ -6,7 +6,6 @@ all-reduce into the backward.
 """
 import sys
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

@@ -5,7 +5,6 @@ SPMD pipeline wavefront (shard_map + ppermute) with the 1F1B schedule.
 """
 import sys
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

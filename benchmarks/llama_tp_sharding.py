@@ -5,7 +5,6 @@ Megatron layers + fsdp axis sharding params/grads/optimizer states.
 """
 import sys
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

@@ -6,7 +6,6 @@ dispatch/combine (the all-to-all rides ICI).
 """
 import sys
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

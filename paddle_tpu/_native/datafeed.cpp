@@ -11,6 +11,7 @@
 //   pass 2: pt_slotfile_parse -> fills values + per-sample lengths
 #include <atomic>
 #include <charconv>
+#include <cerrno>
 #include <clocale>
 #include <locale.h>
 #include <cctype>
@@ -46,7 +47,10 @@ static const char* token_end(const char* p, const char* end) {
 // Rejecting them on both sides keeps the paths sample-identical.
 static bool exotic_token(const char* p, size_t n) {
   for (size_t i = 0; i < n; ++i)
-    if (p[i] == '_' || p[i] == 'x' || p[i] == 'X') return true;
+    // '(' also rejects C99 "nan(n-char-seq)" which strtod accepts but
+    // python float() does not
+    if (p[i] == '_' || p[i] == 'x' || p[i] == 'X' || p[i] == '(')
+      return true;
   return false;
 }
 
@@ -76,13 +80,21 @@ static const char* parse_long_py(const char* p, const char* end,
                                  long* out) {
   const char* te = token_end(p, end);
   size_t n = static_cast<size_t>(te - p);
-  if (n == 0 || n >= 31 || exotic_token(p, n)) return nullptr;
+  if (n == 0 || exotic_token(p, n)) return nullptr;
   char buf[32];
-  memcpy(buf, p, n);
-  buf[n] = '\0';
   char* ep = nullptr;
-  *out = strtol_l(buf, &ep, 10, c_locale());
-  if (ep != buf + n) return nullptr;
+  if (n < sizeof(buf)) {
+    memcpy(buf, p, n);
+    buf[n] = '\0';
+    *out = strtol_l(buf, &ep, 10, c_locale());
+    if (ep != buf + n) return nullptr;
+  } else {
+    // zero-padded/pathological long count token: python int() parses it
+    std::string big(p, n);
+    errno = 0;
+    *out = strtol_l(big.c_str(), &ep, 10, c_locale());
+    if (ep != big.c_str() + n || errno == ERANGE) return nullptr;
+  }
   return te;
 }
 

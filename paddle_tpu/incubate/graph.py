@@ -35,7 +35,7 @@ def _np(t):
 def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
                            perm_buffer=None, sample_size=-1,
                            return_eids=False, flag_perm_buffer=False,
-                           name=None):
+                           name=None, seed=None):
     """reference: incubate/operators/graph_sample_neighbors.py — for each
     input node, sample up to ``sample_size`` neighbors from the CSC graph
     (row = concatenated neighbor lists, colptr = per-node offsets).
@@ -44,7 +44,14 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     cp = _np(colptr)
     nodes = _np(input_nodes).reshape(-1)
     eidsn = _np(eids) if eids is not None else None
-    rng = np.random.default_rng()
+    # deterministic under paddle.seed: derive the host-side seed from
+    # the framework's PRNG stream (a per-call explicit seed wins)
+    if seed is None:
+        from .._core import random as _random
+        import jax as _jax
+        seed = int(np.asarray(
+            _jax.random.bits(_random.next_rng_key(), dtype=np.uint32)))
+    rng = np.random.default_rng(seed)
     neigh_parts, eid_parts, counts = [], [], []
     for n in nodes:
         lo, hi = int(cp[n]), int(cp[n + 1])
@@ -112,7 +119,6 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes: Sequence[int],
     nodes = _np(input_nodes).reshape(-1)
     frontier = nodes
     all_neigh, all_count, all_eids = [], [], []
-    frontiers = [nodes]
     for sz in sample_sizes:
         res = graph_sample_neighbors(
             row, colptr, Tensor(frontier), eids=sorted_eids,
@@ -124,7 +130,6 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes: Sequence[int],
             all_eids.append(_np(res[2]))
         # next frontier: newly seen nodes
         frontier = np.unique(nb)
-        frontiers.append(frontier)
     # unique sample universe, input nodes first
     seen = {int(v): i for i, v in enumerate(nodes)}
     universe = list(nodes)

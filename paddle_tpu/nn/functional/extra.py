@@ -8,8 +8,6 @@ vision ops temporal_shift)."""
 from __future__ import annotations
 
 import math
-from typing import Optional
-
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -134,7 +132,6 @@ def triplet_margin_with_distance_loss(input, positive, negative,
         d_pn = dist(pos, neg)
         from ...ops.math import minimum
         d_an = minimum(d_an, d_pn)
-    from ...ops import math as om
 
     def f(dp, dn):
         return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)

@@ -316,7 +316,9 @@ class TestNativeDatafeed:
             pytest.skip("native toolchain unavailable")
         p = tmp_path / "tok.txt"
         p.write_text("1 +2.5 1 1e400\n+1 3 1 0.5\n1 nan 1 1.0\n"
-                     "1 0x10 1 1.0\n1 1_5 1 2.0\n")  # exotic: both drop
+                     "1 0x10 1 1.0\n1 1_5 1 2.0\n"   # exotic: both drop
+                     "1 nan(1) 1 1.0\n"               # C99 nan(): both drop
+                     + "0" * 30 + "1 7 1 2.5\n")      # long count: both keep
         ds = dist.QueueDataset()
         ds.init(batch_size=8, use_var=["a", "b"])
         ds.set_filelist([str(p)])
@@ -325,7 +327,7 @@ class TestNativeDatafeed:
             native = list(ds._iter_samples())
             ds._iter_native = lambda path: None
             python = list(ds._iter_samples())
-        assert len(native) == len(python) == 3
+        assert len(native) == len(python) == 4
         for a, b in zip(native, python):
             for sa, sb in zip(a, b):
                 assert sa.dtype == sb.dtype
