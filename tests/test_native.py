@@ -318,7 +318,7 @@ class TestNativeDatafeed:
         p.write_text("1 +2.5 1 1e400\n+1 3 1 0.5\n1 nan 1 1.0\n"
                      "1 0x10 1 1.0\n1 1_5 1 2.0\n"   # exotic: both drop
                      "1 nan(1) 1 1.0\n"               # C99 nan(): both drop
-                     + "0" * 30 + "1 7 1 2.5\n")      # long count: both keep
+                     + "0" * 35 + "1 7 1 2.5\n")      # 36-char count: heap path, both keep
         ds = dist.QueueDataset()
         ds.init(batch_size=8, use_var=["a", "b"])
         ds.set_filelist([str(p)])
