@@ -86,7 +86,13 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps):
     }
 
 
-def measure():
+def _is_oom(exc) -> bool:
+    s = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s)
+
+
+def measure(batch_override: Optional[int] = None):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -94,6 +100,8 @@ def measure():
 
     t_measure_start = time.perf_counter()
     cfg, seq, batch = pick_config()
+    if batch_override is not None:
+        batch = batch_override
     on_tpu = jax.devices()[0].platform == "tpu"
     step = train.make_train_step(cfg, seq_chunk=512 if on_tpu else None)
     state = jax.jit(lambda k: train.init_train_state(k, cfg))(
@@ -163,7 +171,24 @@ def child_main():
     if plat:  # local/CI smoke runs; driver runs on the real chip
         import jax
         jax.config.update("jax_platforms", plat)
-    result = measure()
+    # The HBM-tier batch scaling in pick_config has only been validated on
+    # 16G v5e; if it overshoots on another chip, halve the batch instead of
+    # wasting a live tunnel on an OOM crash (VERDICT r2 weak #2).
+    batch_override = None
+    while True:
+        try:
+            result = measure(batch_override)
+            break
+        except Exception as e:  # noqa: BLE001 — classify, then re-raise
+            if not _is_oom(e):
+                raise
+            _, _, batch = pick_config()
+            cur = batch_override if batch_override is not None else batch
+            if cur <= 1:
+                raise  # OOM even at batch 1 — nothing left to halve
+            batch_override = max(1, cur // 2)
+            print(f"OOM at batch {cur}; retrying with batch "
+                  f"{batch_override}", file=sys.stderr)
     print(json.dumps(result))
     sys.stdout.flush()
     os._exit(0)  # skip hanging plugin destructors at interpreter exit
@@ -199,23 +224,55 @@ def probe_backend(timeout_s: int) -> Optional[str]:
     return None
 
 
+_LASTGOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LASTGOOD.json")
+
+
+def _record_last_good(parsed: dict) -> None:
+    """Persist the freshest successful TPU measurement so a later dead-tunnel
+    failure JSON can still carry a (marked-stale) number."""
+    try:
+        dev = str(parsed.get("extra", {}).get("device", "")).lower()
+        if "tpu" not in dev:
+            return  # CPU smoke runs don't overwrite the TPU record
+        with open(_LASTGOOD, "w") as f:
+            json.dump(parsed, f)
+    except Exception:
+        pass
+
+
 def parent_main():
-    """Run the measurement in a watchdog-guarded child; retry transient
-    backend-init failures; ALWAYS print exactly one JSON line."""
-    attempts = int(os.environ.get("PADDLE_TPU_BENCH_ATTEMPTS", "5"))
+    """Run the measurement in a watchdog-guarded child; ALWAYS print exactly
+    one JSON line.
+
+    Probe schedule (VERDICT r2 weak #1 — adaptive, fail-fast): two quick
+    probes catch a transiently flaky tunnel; if both hang, one long patient
+    probe catches a slow-but-alive backend. Worst case all-dead:
+    ~60+30+60+30+300 = 8 min of probing, then a maximally diagnostic error
+    JSON (per-attempt timings + last-known-good measurement marked stale).
+    """
     timeout_s = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
-    probe_s = int(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))
+    fast_s = int(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "60"))
+    long_s = int(os.environ.get("PADDLE_TPU_BENCH_LONG_PROBE", "300"))
+    schedule = [(fast_s, 30), (fast_s, 30), (long_s, 0)]
+    diag = []
     last_err = "unknown"
-    for i in range(attempts):
+    measured = 0
+    for i, (probe_s, sleep_s) in enumerate(schedule):
+        t0 = time.perf_counter()
         perr = probe_backend(probe_s)
+        diag.append({"attempt": i + 1, "probe_timeout_s": probe_s,
+                     "probe_elapsed_s": round(time.perf_counter() - t0, 1),
+                     "probe_error": perr})
         if perr is not None:
             last_err = f"attempt {i + 1}: {perr}"
-            if i + 1 < attempts:
-                # a flaky tunnel often recovers on the order of minutes;
-                # the probe itself is cheap, so wait meaningfully between
-                # attempts (total patience ~= attempts * (probe + 60s))
-                time.sleep(60)
+            if sleep_s and i + 1 < len(schedule):
+                time.sleep(sleep_s)
             continue
+        # healthy backend: run the measurement (allow one retry on a
+        # non-probe failure — e.g. a mid-measurement tunnel drop)
+        measured += 1
+        t0 = time.perf_counter()
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
@@ -224,26 +281,39 @@ def parent_main():
                 cwd=os.path.dirname(os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:
             last_err = f"attempt {i + 1}: watchdog timeout after {timeout_s}s"
+            diag[-1]["measure"] = last_err
+            if measured >= 2:
+                break
             continue
+        diag[-1]["measure_elapsed_s"] = round(time.perf_counter() - t0, 1)
         for line in reversed(proc.stdout.strip().splitlines()):
             try:
                 parsed = json.loads(line)
             except (json.JSONDecodeError, ValueError):
                 continue
             if isinstance(parsed, dict) and "metric" in parsed:
+                _record_last_good(parsed)
                 print(line)
                 sys.stdout.flush()
                 os._exit(0)
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-15:]
         last_err = (f"attempt {i + 1}: rc={proc.returncode}; "
                     + " | ".join(tail)[-1500:])
-        if i + 1 < attempts:
-            time.sleep(5 * (i + 1))  # backoff before retrying a flaky tunnel
-    print(json.dumps({
+        diag[-1]["measure"] = last_err
+        if measured >= 2:
+            break
+    out = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
         "error": last_err,
-    }))
+        "probe_diagnostics": diag,
+    }
+    try:
+        with open(_LASTGOOD) as f:
+            out["stale_last_good"] = {**json.load(f), "stale": True}
+    except Exception:
+        pass
+    print(json.dumps(out))
     sys.stdout.flush()
     os._exit(1)
 

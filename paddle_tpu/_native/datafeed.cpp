@@ -86,8 +86,9 @@ static const char* parse_long_py(const char* p, const char* end,
   if (n < sizeof(buf)) {
     memcpy(buf, p, n);
     buf[n] = '\0';
+    errno = 0;
     *out = strtol_l(buf, &ep, 10, c_locale());
-    if (ep != buf + n) return nullptr;
+    if (ep != buf + n || errno == ERANGE) return nullptr;
   } else {
     // zero-padded/pathological long count token: python int() parses it
     std::string big(p, n);
@@ -133,8 +134,9 @@ static bool parse_line(const Line& ln, int n_slots, double* vals_out,
     // next line on a truncated slot list)
     while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
     if (p >= end) return false;
-    // std::from_chars: locale-INDEPENDENT (strtol/strtod would honor
-    // LC_NUMERIC and diverge from the python fallback under e.g. de_DE)
+    // strtol_l/strtod_l with the cached "C" locale: locale-INDEPENDENT
+    // (plain strtol/strtod would honor LC_NUMERIC and diverge from the
+    // python fallback under e.g. de_DE)
     long cnt = 0;
     const char* next = parse_long_py(p, end, &cnt);
     if (next == nullptr || cnt < 0) return false;  // "1.5" etc. rejected

@@ -59,7 +59,9 @@ class LocalSGDOptimizer:
         self._begin_step = int(begin_step)
         self._group = group
         self._step_count = 0
-        self._last_sync = 0
+        # reference initializes last_step to begin_step, so the first
+        # average fires at begin_step + k_steps (not begin_step + 1)
+        self._last_sync = self._begin_step
 
     # --- delegation ---
     def __getattr__(self, item):

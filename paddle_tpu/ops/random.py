@@ -183,13 +183,14 @@ def cauchy_(x, loc=0, scale=1, name=None):
 
 @register("geometric_", tensor_method=False)
 def geometric_(x, probs, name=None):
-    """reference: tensor/random.py geometric_ — in-place geometric fill
-    (number of trials until first success, support {1, 2, ...})."""
+    """reference: tensor/random.py geometric_ — in-place fill with
+    log(u)/log1p(-p), the reference's continuous-support form (its docstring
+    example includes values < 1; no ceil/clamp)."""
     x = as_tensor(x)
     u = jax.random.uniform(next_rng_key(), tuple(x.shape), jnp.float32,
                            minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
-    v = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.float32(probs)))
-    x._inplace_assign(jnp.maximum(v, 1.0).astype(x.dtype))
+    v = jnp.log(u) / jnp.log1p(-jnp.float32(probs))
+    x._inplace_assign(v.astype(x.dtype))
     return x
 
 

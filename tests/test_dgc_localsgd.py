@@ -275,8 +275,9 @@ class TestLocalSGD:
             (w * w).sum().backward()
             opt.step()
             opt.clear_grad()
-        # first sync at the first step > begin_step with k_steps elapsed
-        assert syncs == [3, 6, 9, 12]
+        # reference cadence: _last_sync starts at begin_step, so the first
+        # average fires at begin_step + k_steps, then every k_steps
+        assert syncs == [5, 8, 11]
 
     def test_world1_average_noop(self):
         w = paddle.to_tensor(np.array([2.0], np.float32))
