@@ -166,6 +166,10 @@ def measure(batch_override: Optional[int] = None):
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps)
 
 
+_BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"
+RC_OOM_RETRY = 17  # child: OOM, deadline hit — parent should respawn at hint
+
+
 def child_main():
     plat = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
     if plat:  # local/CI smoke runs; driver runs on the real chip
@@ -173,8 +177,18 @@ def child_main():
         jax.config.update("jax_platforms", plat)
     # The HBM-tier batch scaling in pick_config has only been validated on
     # 16G v5e; if it overshoots on another chip, halve the batch instead of
-    # wasting a live tunnel on an OOM crash (VERDICT r2 weak #2).
+    # wasting a live tunnel on an OOM crash (VERDICT r2 weak #2). Each
+    # compile+OOM cycle costs minutes, so the halving ladder is persisted
+    # across child processes (_BATCH_HINT) and the child re-execs (rc=17)
+    # rather than risk the parent watchdog killing a mid-ladder attempt.
+    budget = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
+    t0 = time.perf_counter()
     batch_override = None
+    try:
+        with open(_BATCH_HINT) as f:
+            batch_override = int(f.read().strip())
+    except Exception:
+        pass
     while True:
         try:
             result = measure(batch_override)
@@ -187,8 +201,18 @@ def child_main():
             if cur <= 1:
                 raise  # OOM even at batch 1 — nothing left to halve
             batch_override = max(1, cur // 2)
+            try:
+                with open(_BATCH_HINT, "w") as f:
+                    f.write(str(batch_override))
+            except Exception:
+                pass
             print(f"OOM at batch {cur}; retrying with batch "
                   f"{batch_override}", file=sys.stderr)
+            if time.perf_counter() - t0 > 0.4 * budget:
+                # not enough watchdog left for another compile+measure:
+                # hand the ladder back to the parent
+                sys.stderr.flush()
+                os._exit(RC_OOM_RETRY)
     print(json.dumps(result))
     sys.stdout.flush()
     os._exit(0)  # skip hanging plugin destructors at interpreter exit
@@ -254,6 +278,10 @@ def parent_main():
     timeout_s = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
     fast_s = int(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "60"))
     long_s = int(os.environ.get("PADDLE_TPU_BENCH_LONG_PROBE", "300"))
+    try:  # a stale hint from an earlier run/chip must not undersize today's
+        os.remove(_BATCH_HINT)
+    except OSError:
+        pass
     schedule = [(fast_s, 30), (fast_s, 30), (long_s, 0)]
     diag = []
     last_err = "unknown"
@@ -270,16 +298,29 @@ def parent_main():
                 time.sleep(sleep_s)
             continue
         # healthy backend: run the measurement (allow one retry on a
-        # non-probe failure — e.g. a mid-measurement tunnel drop)
+        # non-probe failure — e.g. a mid-measurement tunnel drop). An
+        # rc=17 child hit the OOM-halving deadline: respawn immediately
+        # (the batch hint file carries the ladder forward) without
+        # consuming a measure attempt.
         measured += 1
         t0 = time.perf_counter()
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                timeout=timeout_s,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
+        spawns = 0
+        while True:
+            spawns += 1
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--child"],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, timeout=timeout_s,
+                    cwd=os.path.dirname(os.path.abspath(__file__)))
+            except subprocess.TimeoutExpired:
+                proc = None
+            if (proc is not None and proc.returncode == RC_OOM_RETRY
+                    and spawns < 6):
+                diag[-1]["oom_respawns"] = spawns
+                continue
+            break
+        if proc is None:
             last_err = f"attempt {i + 1}: watchdog timeout after {timeout_s}s"
             diag[-1]["measure"] = last_err
             if measured >= 2:

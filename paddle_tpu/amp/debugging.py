@@ -113,8 +113,57 @@ def collect_operator_stats():
     return _Ctx()
 
 
+def accuracy_check(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    """reference: accuracy_check op (phi/kernels/accuracy_check_kernel) —
+    elementwise closeness verdict between a result and its baseline.
+    Raises with the max error on mismatch; returns True otherwise."""
+    import numpy as np
+    from .._core.tensor import Tensor
+    xa = np.asarray(x._value if isinstance(x, Tensor) else x,
+                    dtype=np.float64)
+    ya = np.asarray(y._value if isinstance(y, Tensor) else y,
+                    dtype=np.float64)
+    ok = np.allclose(xa, ya, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    if not ok:
+        diff = np.abs(xa - ya)
+        raise AssertionError(
+            f"accuracy_check failed ({name or 'tensor'}): max abs diff "
+            f"{diff.max():.3e} at flat index {int(diff.argmax())} "
+            f"(rtol={rtol}, atol={atol})")
+    return True
+
+
 def compare_accuracy(dump_path, another_dump_path, output_filename,
                      loss_scale=1, dump_all_tensors=False):
-    raise NotImplementedError(
-        "accuracy_compare tooling requires dump files; use "
-        "collect_operator_stats / check_numerics on TPU")
+    """reference: amp/debugging.py compare_accuracy — walk two directories
+    of .npy tensor dumps (e.g. an fp32 run vs an amp run), compare arrays
+    by filename, and write a CSV report of per-tensor max abs/rel error.
+    (The reference writes xlsx from its own dump format; the TPU-native
+    dump format is plain .npy per tensor.)"""
+    import csv
+    import os
+    import numpy as np
+    rows = []
+    names = sorted(set(os.listdir(dump_path)) &
+                   set(os.listdir(another_dump_path)))
+    for fname in names:
+        if not fname.endswith(".npy"):
+            continue
+        a = np.load(os.path.join(dump_path, fname)).astype(np.float64)
+        b = np.load(os.path.join(another_dump_path, fname)).astype(
+            np.float64) * float(loss_scale)
+        if a.shape != b.shape:
+            rows.append([fname, "SHAPE MISMATCH", str(a.shape),
+                         str(b.shape)])
+            continue
+        diff = np.abs(a - b)
+        denom = np.maximum(np.abs(a), 1e-12)
+        rows.append([fname, f"{diff.max():.6e}",
+                     f"{(diff / denom).max():.6e}",
+                     "ok" if np.allclose(a, b, rtol=1e-4, atol=1e-6)
+                     else "DIFF"])
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "max_abs_err", "max_rel_err", "verdict"])
+        w.writerows(rows)
+    return rows

@@ -21,9 +21,17 @@ schedule is expressed differently:
   "F-then-B"/"gpipe" GPipe, "VPP" interleaved, "ZB" zero-bubble), and
   writes the resulting grads into each parameter's ``.grad`` slot so
   ``optimizer.step()`` works unchanged.
-- **Fallback** (heterogeneous stages, pp degree 1, or a GradScaler):
-  microbatch grad accumulation — the same loss/grad math without spatial
-  parallelism.
+- **Heterogeneous stages** (embedding stage != decoder stage != head stage
+  — the common real topology; reference pp_layers.py:93 SegmentLayers
+  segments arbitrary layers): stage param pytrees are flattened to one
+  padded f32 vector stacked [P, Lmax] over pp and dispatched per-stage via
+  ``lax.switch`` (pp_spmd.pipeline_hetero*). Shape-changing entry layers
+  (token embed) run before microbatching; shape-changing exit layers (final
+  head) run inside the per-microbatch loss — the same decomposition the
+  flagship pp step uses (models/train_pp.py).
+- **Fallback** (pp degree 1, a GradScaler, or activations that change
+  shape mid-ring): microbatch grad accumulation — the same loss/grad math
+  without spatial parallelism; WARNS that it is de-pipelining.
 """
 from __future__ import annotations
 
@@ -122,6 +130,40 @@ class PipelineParallel(MetaParallelBase):
             return None
         return self._stage_param_lists()
 
+    def _hetero_ok(self, scaler):
+        """Gates shared with _can_spmd, minus the homogeneity requirement."""
+        if scaler is not None:
+            return False
+        hcg = self._hcg
+        if hcg is None or hcg.get_pipe_parallel_world_size() < 2:
+            return False
+        mesh = getattr(hcg, "mesh", None)
+        if mesh is None or "pp" not in mesh.axis_names:
+            return False
+        loss_layer = self._layers._loss_fn
+        from ....nn.layer.layers import Layer
+        if isinstance(loss_layer, Layer) and list(loss_layer.parameters()):
+            return False
+        pp = hcg.get_pipe_parallel_world_size()
+        return self.accumulate_steps % pp == 0
+
+    def _stage_layers_hetero(self):
+        """Per-stage layer lists for the heterogeneous SPMD path — no
+        homogeneity requirement, but one stage per pp coordinate (no
+        virtual chunks) and every member a Layer."""
+        from ....nn.layer.layers import Layer
+        num_seg = len(self._layers.segment_bounds()) - 1
+        pp = self._hcg.get_pipe_parallel_world_size()
+        if num_seg != pp:
+            return None
+        stages = []
+        for s in range(num_seg):
+            ls = list(self._layers.stage_layers(s))
+            if any(not isinstance(l, Layer) for l in ls):
+                return None
+            stages.append(ls)
+        return stages
+
     def _spmd_forward_backward(self, stages, inputs, labels):
         """Run the selected pp_spmd schedule and write grads into .grad."""
         import jax
@@ -203,16 +245,159 @@ class PipelineParallel(MetaParallelBase):
                     p.grad = g if p.grad is None else p.grad + g
         return Tensor(loss, _internal=True)
 
+    # ---------------- heterogeneous SPMD path ----------------
+    def _hetero_plan(self, stages, inputs):
+        """Probe one microbatch through the stages to find the carry shape
+        and the pre/head peel (module docstring). Returns
+        (pre_layers, ring_stages, head_layers, carry_shape) or None when
+        the activations change shape mid-ring (-> accum fallback)."""
+        from ...._core.autograd import no_grad
+        m = self.accumulate_steps
+        sz = inputs.shape[0] // m
+        probe = Tensor(inputs._value[:sz], _internal=True)
+        shapes = []   # shapes[s][i] = act shape after layer i of stage s
+        with no_grad():
+            t = probe
+            for st in stages:
+                row = []
+                for layer in st:
+                    t = layer(t)
+                    row.append(tuple(t.shape))
+                shapes.append(row)
+        carry = shapes[0][-1]
+        in_shape = tuple(probe.shape)
+        # pre peel: feed must be carry-shaped
+        if in_shape == carry:
+            pre, ring0 = [], list(stages[0])
+        else:
+            cut = next((i for i, s in enumerate(shapes[0]) if s == carry),
+                       None)
+            if cut is None:
+                return None
+            pre = list(stages[0][:cut + 1])
+            ring0 = list(stages[0][cut + 1:])
+        # head peel: ring's last stage must output carry
+        last_shapes = shapes[-1]
+        if last_shapes[-1] == carry:
+            ringN, head = list(stages[-1]), []
+        else:
+            keep = 0
+            for i, s in enumerate(last_shapes):
+                if s == carry:
+                    keep = i + 1
+            ringN = list(stages[-1][:keep])
+            head = list(stages[-1][keep:])
+        # mid boundaries must all be carry
+        for s in range(1, len(stages) - 1):
+            if shapes[s][-1] != carry:
+                return None
+        ring = [ring0] + [list(st) for st in stages[1:-1]] + [ringN]
+        return pre, ring, head, carry
+
+    def _spmd_forward_backward_hetero(self, stages, inputs, labels):
+        """Heterogeneous stages: flattened-vector stacking + lax.switch
+        dispatch (pp_spmd.pipeline_hetero*); embed-like pre layers run
+        before microbatching, head-like exit layers inside the loss."""
+        import jax
+        import jax.numpy as jnp
+        from . import pp_spmd
+
+        if getattr(self, "_hetero_plan_cache", None) is None:
+            self._hetero_plan_cache = (self._hetero_plan(stages, inputs),)
+        plan = self._hetero_plan_cache[0]
+        if plan is None:
+            return None
+        pre, ring, head, carry = plan
+        mesh = self._hcg.mesh
+        M = self.accumulate_steps
+        loss_fn = self._layers._loss_fn
+        schedule = self.schedule
+        if schedule == "interleave":
+            schedule = "gpipe"  # one stage per coord == plain wavefront
+
+        def to_raw(t):
+            return t._value if isinstance(t, Tensor) else t
+
+        def params_of(layers):
+            return [{k: jnp.asarray(p._value)
+                     for k, p in dict(layer.named_parameters()).items()}
+                    for layer in layers]
+
+        def apply_layers(layers, plist, xin):
+            t = Tensor(xin, _internal=True)
+            for layer, pd in zip(layers, plist):
+                t = layer.functional_call(pd, t, training=True)
+            return to_raw(t)
+
+        x = to_raw(inputs)
+        lb = to_raw(labels)
+        xmb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        lbs = lb.reshape((M, lb.shape[0] // M) + lb.shape[1:])
+
+        ring_params = [params_of(st) for st in ring]
+        pre_params = params_of(pre)
+        head_params = params_of(head)
+        vec, specs = pp_spmd.flatten_stage_params(ring_params, mesh)
+        stage_fns = [
+            (lambda plist, xin, st=st: apply_layers(st, plist, xin))
+            for st in ring]
+
+        def head_loss(hp, y, lab):
+            out = Tensor(apply_layers(head, hp, y), _internal=True)
+            return to_raw(loss_fn(out, Tensor(lab, _internal=True)))
+
+        def pre_apply(pp_, mb):
+            return jax.vmap(lambda xi: apply_layers(pre, pp_, xi))(mb)
+
+        if self._spmd_step is None:
+            if schedule in ("1f1b", "zero_bubble"):
+                def run(v, prp, hdp, mb, lab):
+                    mbs, vjp_pre = jax.vjp(lambda q: pre_apply(q, mb), prp)
+                    loss, dv, dhead, dmbs = pp_spmd.pipeline_hetero_1f1b(
+                        stage_fns, head_loss, v, specs, hdp, mbs, lab,
+                        mesh, defer_dw=(schedule == "zero_bubble"))
+                    dpre = vjp_pre(dmbs.astype(mbs.dtype))[0]
+                    return loss, (dv, dpre, dhead)
+            else:  # gpipe wavefront, AD backward
+                def run(v, prp, hdp, mb, lab):
+                    def total(v_, prp_, hdp_):
+                        mbs = pre_apply(prp_, mb)
+                        outs = pp_spmd.pipeline_hetero(
+                            stage_fns, v_, specs, mbs, mesh)
+                        losses = jax.vmap(
+                            lambda y, l: head_loss(hdp_, y, l))(outs, lab)
+                        return jnp.mean(losses)
+                    return jax.value_and_grad(total, argnums=(0, 1, 2))(
+                        v, prp, hdp)
+            self._spmd_step = jax.jit(run)
+
+        loss, (dv, dpre, dhead) = self._spmd_step(
+            vec, pre_params, head_params, xmb, lbs)
+
+        dring = pp_spmd.unflatten_stage_grads(dv, specs)
+
+        def scatter(layers, grads):
+            for layer, gd in zip(layers, grads):
+                for k, p in dict(layer.named_parameters()).items():
+                    g = Tensor(gd[k], _internal=True)
+                    p.grad = g if p.grad is None else p.grad + g
+        for st, gst in zip(ring, dring):
+            scatter(st, gst)
+        scatter(pre, dpre)
+        scatter(head, dhead)
+        return Tensor(loss, _internal=True)
+
     def forward_backward_pipeline(self, data, scaler=None):
         """reference: pipeline_parallel.py:575. Dispatches to the pp_spmd
-        schedule selected by pipeline_configs["schedule_mode"] when the
-        stages are stackable (module docstring); grad-accumulation
-        semantics otherwise."""
+        schedule selected by pipeline_configs["schedule_mode"]: the
+        stacked-stage program for homogeneous stages, the flattened-vector
+        + lax.switch program for heterogeneous ones (module docstring);
+        grad-accumulation semantics otherwise, with a warning."""
         inputs, labels = data
+        simple = (isinstance(inputs, Tensor) and isinstance(labels, Tensor)
+                  and inputs.shape[0] % self.accumulate_steps == 0)
         stages = self._can_spmd(scaler)
-        if stages is not None and not (
-                isinstance(inputs, Tensor) and isinstance(labels, Tensor)
-                and inputs.shape[0] % self.accumulate_steps == 0):
+        if stages is not None and not simple:
             stages = None  # single-tensor, divisible batches only; the
             # accum path handles everything else (and raises clear errors)
         if stages is not None:
@@ -223,6 +408,30 @@ class PipelineParallel(MetaParallelBase):
             except Exception:
                 self._spmd_step = None
                 raise
+        # heterogeneous stages: the vec+switch SPMD program
+        if simple and self._hetero_ok(scaler):
+            hstages = self._stage_layers_hetero()
+            if hstages is not None:
+                try:
+                    loss = self._spmd_forward_backward_hetero(
+                        hstages, inputs, labels)
+                except Exception:
+                    self._spmd_step = None
+                    raise
+                if loss is not None:
+                    self.total_loss = loss
+                    return self.total_loss
+        if (self._hcg is not None
+                and self._hcg.get_pipe_parallel_world_size() > 1
+                and not getattr(self, "_warned_depipelined", False)):
+            import warnings
+            self._warned_depipelined = True
+            warnings.warn(
+                "PipelineParallel: stages cannot run the SPMD pipeline "
+                "(shape-changing mid-ring activations, non-Layer stage "
+                "members, GradScaler, or indivisible batch) — falling "
+                "back to sequential gradient accumulation with NO "
+                "pipeline parallelism.", stacklevel=2)
         micro_in = self._split_micro(inputs)
         micro_lb = self._split_micro(labels)
         total = None

@@ -29,11 +29,15 @@ _lock = threading.Lock()
 class TableConfig:
     name: str
     dim: int
-    kind: str = "sparse"            # "sparse" | "dense"
+    kind: str = "sparse"            # "sparse" | "dense" | "ssd"
     optimizer: str = "adagrad"      # "sgd" | "adagrad"
     lr: float = 0.05
     init_std: float = 0.01
     dense_rows: int = 0             # for dense tables
+    # ssd tier (reference: paddle/fluid/distributed/ps/table/
+    # ssd_sparse_table.h — RocksDB-backed rows + in-RAM hot cache):
+    cache_rows: int = 4096          # hot rows kept in RAM (LRU)
+    path: str = ""                  # spill directory ("" -> tempdir)
 
 
 class Table:
@@ -94,12 +98,129 @@ class Table:
             self.dense -= lr * grads
 
 
+class SSDTable(Table):
+    """Disk-backed sparse table (reference: paddle/fluid/distributed/ps/
+    table/ssd_sparse_table.h — the "100B features" tier). Re-designed with
+    no external KV dependency: fixed-size records (row + adagrad
+    accumulator, 2*dim float32) live in one slot file addressed through an
+    in-RAM key->slot index; a bounded LRU cache holds hot rows in RAM and
+    evicted rows write back to their slot. The key index stays in RAM —
+    the same ~O(#keys) RAM the reference pays for its RocksDB index/bloom
+    layer — while row payload (the dominant cost) lives on disk.
+    """
+
+    _REC_GROW = 65536  # slots per file extension
+
+    def __init__(self, cfg: TableConfig):
+        import os
+        import tempfile
+        self.cfg = cfg
+        self._dim = cfg.dim
+        self._rec = 2 * cfg.dim * 4  # row + g2, float32
+        d = cfg.path or tempfile.mkdtemp(prefix=f"ps_ssd_{cfg.name}_")
+        os.makedirs(d, exist_ok=True)
+        self._path = os.path.join(d, f"{cfg.name}.slots")
+        self._f = open(self._path, "w+b")
+        # the RPC server dispatches handlers on threads; seek+read/write on
+        # the shared handle (and cache/index mutation) must be serialized
+        self._tlock = threading.RLock()
+        self._capacity = 0
+        self._slots: Dict[int, int] = {}      # key -> slot (RAM index)
+        # hot cache: insertion-ordered dict as LRU; values (row, g2)
+        self._cache: "Dict[int, tuple]" = {}
+        self._evictions = 0
+
+    # --- slot io ---
+    def _ensure_capacity(self, slot: int):
+        if slot >= self._capacity:
+            self._capacity += self._REC_GROW
+            self._f.truncate(self._capacity * self._rec)
+
+    def _write_slot(self, slot: int, row: np.ndarray, g2: np.ndarray):
+        self._ensure_capacity(slot)
+        self._f.seek(slot * self._rec)
+        self._f.write(row.tobytes())
+        self._f.write(g2.tobytes())
+
+    def _read_slot(self, slot: int):
+        self._f.seek(slot * self._rec)
+        buf = self._f.read(self._rec)
+        arr = np.frombuffer(buf, np.float32).copy()
+        return arr[:self._dim], arr[self._dim:]
+
+    # --- LRU cache ---
+    def _evict_if_full(self):
+        while len(self._cache) > self.cfg.cache_rows:
+            k, (row, g2) = next(iter(self._cache.items()))
+            del self._cache[k]
+            self._write_slot(self._slots[k], row, g2)
+            self._evictions += 1
+
+    def _get(self, key: int):
+        hit = self._cache.pop(key, None)
+        if hit is not None:
+            self._cache[key] = hit          # re-insert as most-recent
+            return hit
+        slot = self._slots.get(key)
+        if slot is None:
+            self._slots[key] = len(self._slots)
+            row = self._init_row(key)
+            g2 = np.zeros(self._dim, np.float32)
+        else:
+            row, g2 = self._read_slot(slot)
+        self._cache[key] = (row, g2)
+        self._evict_if_full()
+        return row, g2
+
+    # --- Table API ---
+    def pull_sparse(self, keys: np.ndarray) -> np.ndarray:
+        out = np.empty((len(keys), self._dim), np.float32)
+        with self._tlock:
+            for i, k in enumerate(keys.tolist()):
+                out[i] = self._get(k)[0]
+        return out
+
+    def push_sparse(self, keys: np.ndarray, grads: np.ndarray):
+        lr = self.cfg.lr
+        with self._tlock:
+            for i, k in enumerate(keys.tolist()):
+                row, g2 = self._get(k)
+                g = grads[i]
+                if self.cfg.optimizer == "adagrad":
+                    g2 += g * g
+                    row -= lr * g / (np.sqrt(g2) + 1e-8)
+                else:
+                    row -= lr * g
+                self._cache[k] = (row, g2)
+
+    def flush(self):
+        """Write every cached row back to its slot (checkpoint barrier)."""
+        with self._tlock:
+            for k, (row, g2) in self._cache.items():
+                self._write_slot(self._slots[k], row, g2)
+            self._f.flush()
+
+    def stats(self) -> dict:
+        import os
+        with self._tlock:
+            self._f.flush()
+            return {"keys": len(self._slots),
+                    "ram_rows": len(self._cache),
+                    "evictions": self._evictions,
+                    "disk_bytes": os.path.getsize(self._path)}
+
+    @property
+    def rows(self):  # len() parity with the RAM table
+        return self._slots
+
+
 # ---- RPC-served functions (executed in the server process) ----
 def _srv_create_table(cfg_dict: dict):
     with _lock:
         cfg = TableConfig(**cfg_dict)
         if cfg.name not in _tables:
-            _tables[cfg.name] = Table(cfg)
+            _tables[cfg.name] = (SSDTable(cfg) if cfg.kind == "ssd"
+                                 else Table(cfg))
     return True
 
 
@@ -123,7 +244,14 @@ def _srv_push_dense(name: str, grads) -> bool:
 
 def _srv_table_size(name: str) -> int:
     t = _tables[name]
-    return len(t.rows) if t.cfg.kind == "sparse" else t.cfg.dense_rows
+    return t.cfg.dense_rows if t.cfg.kind == "dense" else len(t.rows)
+
+
+def _srv_table_stats(name: str) -> dict:
+    t = _tables[name]
+    return t.stats() if isinstance(t, SSDTable) else {
+        "keys": _srv_table_size(name), "ram_rows": _srv_table_size(name),
+        "evictions": 0, "disk_bytes": 0}
 
 
 class PsServer:
@@ -196,6 +324,10 @@ class PsClient:
     def table_size(self, name: str) -> int:
         return sum(self._rpc().rpc_sync(s, _srv_table_size, args=(name,))
                    for s in self.servers)
+
+    def table_stats(self, name: str) -> List[dict]:
+        return [self._rpc().rpc_sync(s, _srv_table_stats, args=(name,))
+                for s in self.servers]
 
 
 def sparse_embedding(client: PsClient, table: str, ids,

@@ -68,7 +68,16 @@ def _amp_ctx(amp_key):
 
 class StaticFunction:
     """Callable produced by to_static (reference: dy2static
-    program_translator.py StaticFunction). Guards = jax jit cache keys."""
+    program_translator.py StaticFunction). Guards = jax jit cache keys.
+
+    Graph-break fallback (reference: SOT's graph-break + eager resume,
+    python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py):
+    data-dependent Python control flow on tensor VALUES cannot be traced by
+    ``jax.jit`` — instead of surfacing a concretization error, the call
+    falls back to eager execution with a one-time warning. Code that should
+    stay compiled can use :mod:`paddle.static.nn` ``cond`` / ``while_loop``
+    / ``switch_case``, which lower to ``lax`` control flow.
+    """
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  input_spec=None, build_strategy=None, backend=None,
@@ -76,6 +85,12 @@ class StaticFunction:
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        # graph breaks are per input-signature (shape/dtype/static-arg
+        # guard), not whole-function: one untraceable input class must not
+        # de-optimize signatures that compiled fine (reference SOT breaks
+        # per-graph-site)
+        self._eager_keys = set()
+        self._warned_break = False
         functools.update_wrapper(self, fn)
 
         if layer is not None:
@@ -103,20 +118,64 @@ class StaticFunction:
         except Exception:
             return -1
 
+    def _signature(self, args, kwargs):
+        """Mirror of the jit cache key: Tensor leaves by (shape, dtype),
+        everything else by value — so an eager-fallback decision applies to
+        exactly the input class that failed to trace."""
+        def leaf(x):
+            if isinstance(x, Tensor):
+                return (tuple(x.shape), str(x.dtype))
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return (tuple(x.shape), str(x.dtype))
+            return repr(x)
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        training = self._layer.training if self._layer is not None else None
+        return (tuple(leaf(x) for x in flat), str(treedef), training,
+                _current_amp_key())
+
     def __call__(self, *args, **kwargs):
-        if self._layer is not None:
-            params = self._layer.raw_parameters()
-            buffers = self._layer.raw_buffers()
-            out, new_buffers = self._jitted(params, buffers,
-                                            self._layer.training,
-                                            _current_amp_key(), args,
-                                            kwargs)
-            if new_buffers:
-                namedb = dict(self._layer.named_buffers())
-                for k, v in new_buffers.items():
-                    namedb[k]._inplace_assign(v)
-            return out
-        return self._jitted(_current_amp_key(), args, kwargs)
+        # fast path: no graph break has ever occurred -> skip the
+        # signature computation entirely (it is only needed to route
+        # already-broken input classes to eager)
+        if self._eager_keys and self._signature(args, kwargs) in \
+                self._eager_keys:
+            return self._fn(*args, **kwargs)
+        import jax.errors as jerr
+        try:
+            if self._layer is not None:
+                params = self._layer.raw_parameters()
+                buffers = self._layer.raw_buffers()
+                out, new_buffers = self._jitted(params, buffers,
+                                                self._layer.training,
+                                                _current_amp_key(), args,
+                                                kwargs)
+                if new_buffers:
+                    namedb = dict(self._layer.named_buffers())
+                    for k, v in new_buffers.items():
+                        namedb[k]._inplace_assign(v)
+                return out
+            return self._jitted(_current_amp_key(), args, kwargs)
+        except (jerr.JAXTypeError,
+                jerr.NonConcreteBooleanIndexError) as e:
+            # JAXTypeError covers every tracer-concretization variant
+            # (ConcretizationTypeError, TracerArrayConversionError,
+            # TracerBool/IntegerConversionError). If the function is
+            # genuinely broken the eager re-run below raises the real error.
+            # data-dependent control flow: break the graph for THIS input
+            # signature, resume eagerly
+            self._eager_keys.add(self._signature(args, kwargs))
+            if not self._warned_break:
+                import warnings
+                self._warned_break = True
+                warnings.warn(
+                    f"to_static({getattr(self._fn, '__name__', '?')}): "
+                    f"data-dependent Python control flow cannot be compiled "
+                    f"({type(e).__name__}); falling back to eager execution "
+                    f"for this input signature. Use paddle.static.nn.cond/"
+                    f"while_loop to keep this function compiled.",
+                    stacklevel=2)
+            return self._fn(*args, **kwargs)
 
     def concrete_program_specify_input_spec(self, *a, **k):
         return None

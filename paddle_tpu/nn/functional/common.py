@@ -150,7 +150,20 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         out_shape[d] = s
 
     def f(v):
-        return jax.image.resize(v, tuple(out_shape), method=jmode)
+        if not align_corners or jmode == "nearest":
+            # half-pixel sampling == reference align_corners=False
+            return jax.image.resize(v, tuple(out_shape), method=jmode)
+        # align_corners=True: src = i * (in-1)/(out-1) — express as
+        # scale_and_translate with scale (out-1)/(in-1), zero translation
+        scale = jnp.asarray([
+            (out_shape[d] - 1) / max(v.shape[d] - 1, 1)
+            if out_shape[d] > 1 else 1.0 for d in spatial], jnp.float32)
+        # scale_and_translate samples at in=(o+0.5-t)/s-0.5; solving for
+        # the corner-aligned map in = o/s gives t = 0.5 - 0.5*s
+        translation = 0.5 - 0.5 * scale
+        return jax.image.scale_and_translate(
+            v, tuple(out_shape), tuple(spatial), scale, translation,
+            method=jmode, antialias=False)
     return apply(f, x, name="interpolate")
 
 

@@ -111,6 +111,14 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
 
     def f(v, w, *rest):
         kd = w.shape[2:]
+        if groups > 1:
+            # grouped transpose: lax blocks the O dim per group and wants
+            # I = in/groups; regroup (in, out/g, *k) -> (in/g, out, *k)
+            # with group-major O ordering
+            i_total, og = w.shape[0], w.shape[1]
+            w = jnp.moveaxis(
+                w.reshape((groups, i_total // groups, og) + kd), 0, 1
+            ).reshape((i_total // groups, groups * og) + kd)
         if isinstance(padding, str):
             pad = padding.upper()
         else:

@@ -87,15 +87,21 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
                 -jnp.inf, jax.lax.max, ceil_mode, "max_pool2d")
     if return_mask:
-        idx = _pool_argmax(x, kernel_size, stride, padding, data_format)
+        idx = _pool_argmax(x, kernel_size, stride, padding, data_format,
+                           ndim=2, ceil_mode=ceil_mode)
         return out, idx
     return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
-                 -jnp.inf, jax.lax.max, ceil_mode, "max_pool3d")
+    out = _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                -jnp.inf, jax.lax.max, ceil_mode, "max_pool3d")
+    if return_mask:
+        idx = _pool_argmax(x, kernel_size, stride, padding, data_format,
+                           ndim=3, ceil_mode=ceil_mode)
+        return out, idx
+    return out
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -121,27 +127,44 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  exclusive=exclusive)
 
 
-def _pool_argmax(x, kernel_size, stride, padding, data_format):
-    # flat-index argmax for return_mask parity (host fallback, rarely used)
+def _pool_argmax(x, kernel_size, stride, padding, data_format, ndim=2,
+                 ceil_mode=False):
+    # flat-index argmax for return_mask parity (host fallback, rarely
+    # used); works for any spatial rank — flat index is over the input's
+    # spatial volume, matching the reference's mask convention. Mirrors
+    # _pool's output geometry (ceil_mode) and layout (channel-last inputs
+    # are transposed in and the index tensor transposed back out).
     from ..._core.tensor import Tensor
+    import itertools
     xv = np.asarray(raw(as_tensor(x)))
-    k = _tuple(kernel_size, 2)
-    s = _tuple(stride if stride is not None else kernel_size, 2)
-    p = _padding(padding if not isinstance(padding, str) else 0, 2)
-    n, c, h, w = xv.shape
-    oh = (h + p[0][0] + p[0][1] - k[0]) // s[0] + 1
-    ow = (w + p[1][0] + p[1][1] - k[1]) // s[1] + 1
-    out = np.zeros((n, c, oh, ow), np.int32)
-    for i in range(oh):
-        for j in range(ow):
-            hs, ws = i * s[0] - p[0][0], j * s[1] - p[1][0]
-            win = xv[:, :, max(hs, 0):hs + k[0], max(ws, 0):ws + k[1]]
-            flat = win.reshape(n, c, -1)
-            am = flat.argmax(-1)
-            wh = win.shape[2:]
-            r, cc = np.unravel_index(am, wh)
-            out[:, :, i, j] = (max(hs, 0) + r) * w + (max(ws, 0) + cc)
-    return Tensor(jnp.asarray(out))
+    channel_last = data_format.endswith("C") and len(data_format) > 2
+    if channel_last:
+        xv = np.moveaxis(xv, -1, 1)
+    k = _tuple(kernel_size, ndim)
+    s = _tuple(stride if stride is not None else kernel_size, ndim)
+    p = _padding(padding if not isinstance(padding, str) else 0, ndim)
+    n, c = xv.shape[:2]
+    sp = xv.shape[2:]
+
+    def out_size(d):
+        span = sp[d] + p[d][0] + p[d][1] - k[d]
+        return (-(-span // s[d]) if ceil_mode else span // s[d]) + 1
+    osp = tuple(out_size(d) for d in range(ndim))
+    out = np.zeros((n, c) + osp, np.int32)
+    for pos in itertools.product(*[range(o) for o in osp]):
+        starts = [pos[d] * s[d] - p[d][0] for d in range(ndim)]
+        sl = tuple(slice(max(st, 0), min(st + k[d], sp[d]))
+                   for d, st in enumerate(starts))
+        win = xv[(slice(None), slice(None)) + sl]
+        am = win.reshape(n, c, -1).argmax(-1)
+        coords = np.unravel_index(am, win.shape[2:])
+        flat = np.zeros((n, c), np.int64)
+        for d in range(ndim):
+            flat = flat * sp[d] + (max(starts[d], 0) + coords[d])
+        out[(slice(None), slice(None)) + pos] = flat
+    if channel_last:
+        out = np.moveaxis(out, 1, -1)
+    return Tensor(jnp.asarray(out.astype(np.int32)))
 
 
 def _adaptive_windows(in_size, out_size):

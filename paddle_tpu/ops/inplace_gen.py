@@ -19,7 +19,8 @@ INPLACE_BASES = [
     "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
     "cast", "ceil", "clip", "copysign", "cos", "cosh", "cumprod",
     "cumsum", "digamma", "divide", "equal", "erf", "exp", "expm1",
-    "fill_diagonal", "flatten", "floor", "floor_divide", "floor_mod",
+    "fill_diagonal", "fill_diagonal_tensor", "flatten", "floor",
+    "floor_divide", "floor_mod",
     "frac", "gcd", "greater_equal", "greater_than", "hypot", "i0",
     "lcm", "ldexp", "lerp", "less_equal", "less_than", "lgamma", "log",
     "log10", "log1p", "log2", "logical_and", "logical_not",

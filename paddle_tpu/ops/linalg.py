@@ -251,9 +251,28 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 @register("matrix_rank", tensor_method=False)
-def matrix_rank(x, tol=None, hermitian=False, name=None):
-    return apply(lambda v: jnp.linalg.matrix_rank(v, tol=tol), as_tensor(x),
-                 name="matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None,
+                name=None):
+    """reference: linalg.py matrix_rank + matrix_rank_atol_rtol op —
+    rank = #singular values > max(atol, rtol * sigma_max); `tol` is the
+    legacy absolute form."""
+    def f(v):
+        if hermitian:
+            s = jnp.abs(jnp.linalg.eigvalsh(v))
+        else:
+            s = jnp.linalg.svd(v, compute_uv=False)
+        smax = jnp.max(s, axis=-1, keepdims=True)
+        if atol is not None or rtol is not None:
+            thr = jnp.maximum(
+                jnp.asarray(0.0 if atol is None else atol, s.dtype),
+                (0.0 if rtol is None else rtol) * smax)
+        elif tol is not None:
+            thr = jnp.asarray(tol, s.dtype)
+        else:
+            eps = jnp.finfo(s.dtype).eps
+            thr = smax * max(v.shape[-2], v.shape[-1]) * eps
+        return jnp.sum(s > thr, axis=-1).astype(jnp.int32)
+    return apply(f, as_tensor(x), name="matrix_rank")
 
 
 @register("lu", tensor_method=False)

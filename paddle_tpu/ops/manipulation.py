@@ -562,3 +562,49 @@ def index_put_(x, indices, value, accumulate=False, name=None):
     out = index_put(x, indices, value, accumulate)
     x._inplace_assign(out._value, node=out._node, out_index=out._out_index)
     return x
+
+
+@register("fill_diagonal")
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """reference: tensor/manipulation.py fill_diagonal_ — write ``value``
+    along the (offset) diagonal; ``wrap`` repeats the diagonal down tall
+    matrices like the reference (numpy fill_diagonal wrap semantics)."""
+    x = as_tensor(x)
+
+    def f(v):
+        n, m = v.shape[-2], v.shape[-1]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        d = j - i
+        mask = d == offset
+        if wrap and n > m:
+            # repeat the diagonal block every (m+1) rows
+            mask = (j - (i % (m + 1))) == offset
+        return jnp.where(mask, jnp.asarray(value, v.dtype), v)
+    return apply(f, x, name="fill_diagonal")
+
+
+@register("fill_diagonal_tensor")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """reference: tensor/manipulation.py fill_diagonal_tensor_ — write the
+    rows of ``y`` along the (dim1, dim2) diagonal."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(v, w):
+        vm = jnp.moveaxis(v, (dim1 % v.ndim, dim2 % v.ndim), (-2, -1))
+        n, m = vm.shape[-2], vm.shape[-1]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        mask = (j - i) == offset
+        # diagonal length and w broadcast to it along the last axis
+        diag_len = int(np.sum(np.asarray((np.arange(m)[None, :] -
+                                          np.arange(n)[:, None])
+                                         == offset)))
+        wf = jnp.broadcast_to(w, vm.shape[:-2] + (diag_len,))
+        full = jnp.zeros_like(vm)
+        rows = jnp.nonzero(np.asarray((np.arange(m)[None, :] -
+                                       np.arange(n)[:, None]) == offset))
+        full = full.at[..., rows[0], rows[1]].set(wf)
+        out = jnp.where(mask, full, vm)
+        return jnp.moveaxis(out, (-2, -1), (dim1 % v.ndim, dim2 % v.ndim))
+    return apply(f, x, y, name="fill_diagonal_tensor")

@@ -83,16 +83,17 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 def mode(x, axis=-1, keepdim=False, name=None):
     xv = np.asarray(raw(as_tensor(x)))
     import scipy.stats as st
-    m = st.mode(xv, axis=axis, keepdims=keepdim)
-    vals = m.mode
+    # always compute with keepdims so the broadcast against xv is valid,
+    # then squeeze at the end (keepdim=False used to double-squeeze)
+    vals = np.asarray(st.mode(xv, axis=axis, keepdims=True).mode)
     idx = np.apply_along_axis(
-        lambda a: a.shape[0] - 1 - np.argmax(a[::-1]), axis,
-        (xv == np.expand_dims(np.asarray(vals).squeeze(axis)
-                              if not keepdim else np.asarray(vals), axis)
-         if not keepdim else (xv == vals)))
+        lambda a: a.shape[0] - 1 - np.argmax(a[::-1]), axis, xv == vals)
     if keepdim:
         idx = np.expand_dims(idx, axis)
-    return (Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idx.astype(jnp.int32))))
+    else:
+        vals = np.squeeze(vals, axis)
+    return (Tensor(jnp.asarray(vals)),
+            Tensor(jnp.asarray(idx.astype(jnp.int32))))
 
 
 @register("median", tensor_method=False)

@@ -231,6 +231,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 # re-exports for static-style model code
 from ..nn import *  # noqa: F401,F403,E402
 
+from . import nn  # noqa: E402 — static.nn control flow + classic layers
 from .extras import (  # noqa: F401,E402
     Variable, cpu_places, cuda_places, xpu_places, Scope, global_scope,
     scope_guard, name_scope, device_guard, save, load, load_program_state,

@@ -1,0 +1,201 @@
+"""static.nn — control flow + classic static-graph layers.
+
+TPU-native re-design of the reference's static control-flow ops
+(reference: python/paddle/static/nn/control_flow.py cond:1080,
+while_loop:1383, case:?, switch_case — which build ConditionalBlock /
+While ops into a Program). Here the predicate decides the lowering:
+
+- **concrete predicate** (eager / static-record mode): run the taken
+  branch directly as plain Python — full autograd-tape support, and the
+  static recorder captures the executed ops.
+- **traced predicate** (inside ``to_static`` / ``jax.jit``): lower to
+  ``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` so the function stays
+  ONE compiled XLA program instead of graph-breaking to eager.
+
+This is the compiled-control-flow companion to StaticFunction's
+graph-break fallback (jit/api.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core.tensor import Tensor
+from .._core import autograd as ag
+
+# classic static.nn members that already live elsewhere in this package
+from .extras import (  # noqa: F401
+    Print, accuracy, auc, ctr_metric_bundle, py_func)
+
+
+def _scalar(pred):
+    v = pred._value if isinstance(pred, Tensor) else pred
+    return jnp.asarray(v).reshape(())
+
+
+def _is_traced(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _call_nograd(fn):
+    """Run a branch under trace: jit differentiates the traced program, so
+    the python tape is skipped (same contract as StaticFunction.traced)."""
+    with ag.no_grad():
+        return fn() if fn is not None else None
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name=None, return_names=None):
+    """reference: python/paddle/static/nn/control_flow.py:1080 cond.
+
+    Both branches must return pytrees of identical structure/shape/dtype
+    when the predicate is traced (XLA requirement)."""
+    pv = _scalar(pred)
+    if not _is_traced(pv):
+        fn = true_fn if bool(pv) else false_fn
+        return fn() if fn is not None else None
+    if true_fn is None or false_fn is None:
+        raise ValueError(
+            "static.nn.cond with a traced predicate needs BOTH branches: "
+            "XLA requires the two branch outputs to have identical pytree "
+            "structure (a missing branch would return None). Pass a "
+            "false_fn/true_fn returning the same-shaped outputs.")
+    return lax.cond(pv.astype(bool),
+                    lambda _: _call_nograd(true_fn),
+                    lambda _: _call_nograd(false_fn), None)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None):
+    """reference: python/paddle/static/nn/control_flow.py:1383 while_loop.
+
+    ``body_fn`` must return loop vars with unchanged shapes/dtypes (XLA
+    static-shape requirement — same contract as the reference's While op,
+    whose block also fixes var shapes)."""
+    loop_vars = list(loop_vars)
+    pv0 = _scalar(cond_fn(*loop_vars))
+    if not _is_traced(pv0) and not any(
+            _is_traced(v._value if isinstance(v, Tensor) else v)
+            for v in loop_vars):
+        while bool(_scalar(cond_fn(*loop_vars))):
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (tuple, list)) \
+                else [out]
+        return loop_vars
+
+    def c(vs):
+        with ag.no_grad():
+            return _scalar(cond_fn(*vs)).astype(bool)
+
+    def b(vs):
+        with ag.no_grad():
+            out = body_fn(*vs)
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    return list(lax.while_loop(c, b, tuple(loop_vars)))
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None, name=None):
+    """reference: static/nn/control_flow.py case — first true pred wins;
+    like the reference, the LAST pair's fn is the default when none given."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case() needs at least one (pred, fn) pair")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    # nest cond from the last pair outward so the FIRST true pred wins;
+    # each level is a zero-arg callable usable as the outer cond's false_fn
+    out_fn = default
+    for p, f in reversed(pairs):
+        out_fn = (lambda p=p, f=f, nxt=out_fn: cond(p, f, nxt))
+    return out_fn()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """reference: static/nn/control_flow.py switch_case.
+
+    ``branch_fns``: dict {int: fn}, list of (int, fn), or list of fns
+    (implicit keys 0..n-1)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if not fns:
+        raise ValueError("switch_case() needs at least one branch fn")
+    if default is None:
+        default = fns[-1]  # reference: last branch doubles as default
+    iv = _scalar(branch_index)
+    if not _is_traced(iv):
+        k = int(iv)
+        fn = dict(items).get(k, default)
+        return fn()
+    # selector: position of branch_index among keys, else the default slot
+    sel = jnp.full((), len(fns), jnp.int32)
+    for pos, k in enumerate(keys):
+        sel = jnp.where(iv.astype(jnp.int32) == k, jnp.int32(pos), sel)
+    return lax.switch(sel, [lambda _, f=f: _call_nograd(f) for f in fns]
+                      + [lambda _: _call_nograd(default)], None)
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation=None, name=None):
+    """reference: python/paddle/static/nn/common.py fc.
+
+    A program-BUILD api, like the reference: each call instantiates one fc
+    layer (fresh ``create_parameter`` weights, auto-named, recorded into the
+    active Program) — build the program once under ``program_guard`` and
+    replay it with ``Executor.run``; don't call fc per training step."""
+    import paddle_tpu as _p
+    xs = [x] if isinstance(x, Tensor) else list(x)
+    outs = []
+    for i, xi in enumerate(xs):
+        shape = tuple(xi.shape)
+        nfd = num_flatten_dims if num_flatten_dims > 0 \
+            else len(shape) + num_flatten_dims
+        in_dim = 1
+        for d in shape[nfd:]:
+            in_dim *= int(d)
+        w = _p.create_parameter([in_dim, size], str(xi.dtype),
+                                attr=weight_attr)
+        flat = xi.reshape(list(shape[:nfd]) + [in_dim])
+        outs.append(flat.matmul(w))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if bias_attr is not False:
+        b = _p.create_parameter([size], str(out.dtype), attr=bias_attr,
+                                is_bias=True)
+        out = out + b
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference: python/paddle/static/nn/common.py embedding.
+    Program-build api (see ``fc``): one call = one embedding table."""
+    import paddle_tpu as _p
+    import paddle_tpu.nn.functional as F
+    w = _p.create_parameter(list(size), dtype, attr=param_attr)
+    ids = input if isinstance(input, Tensor) else _p.to_tensor(input)
+    return F.embedding(ids, w, padding_idx=padding_idx)
+
+
+def sparse_embedding(*args, **kwargs):
+    """reference: static/nn/common.py sparse_embedding — PS-backed lookup.
+    Delegates to the PS-native API (distributed/ps/the_one_ps.py
+    sparse_embedding(client, table, ids)); see tests/test_ps.py for the
+    pull/push-on-backward flow."""
+    from ..distributed.ps.the_one_ps import sparse_embedding as _se
+    return _se(*args, **kwargs)

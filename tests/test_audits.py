@@ -34,3 +34,24 @@ def test_op_coverage_stays_complete():
 def test_api_coverage_stays_complete():
     out = _run("api_coverage.py")
     assert "missing=0" in out, out[-600:]
+
+
+def test_op_sweep_cannot_decay():
+    """The behavioral sweep (test_op_sweep.py + test_op_sweep_alias.py)
+    must keep exercising the full audit table: every direct op has a
+    Spec or a named dedicated-test exemption, every alias row has an
+    executable mapping, and the total behavioral count stays >= 400
+    (VERDICT r2 'do this' #3)."""
+    import test_op_sweep as sweep
+    import test_op_sweep_alias as alias_mod
+    yes = sweep._yes_ops()
+    missing = [op for op in yes
+               if op not in sweep.SPECS and op not in sweep.EXEMPT]
+    assert not missing, missing
+    for op, where in sweep.EXEMPT.items():
+        assert os.path.exists(os.path.join(ROOT, where)), (op, where)
+    alias_rows = alias_mod._alias_ops()
+    missing_a = [op for op in alias_rows if op not in alias_mod.ALIAS_EXEC]
+    assert not missing_a, missing_a
+    assert len(sweep.SPECS) + len(alias_mod.ALIAS_EXEC) >= 400, (
+        len(sweep.SPECS), len(alias_mod.ALIAS_EXEC))

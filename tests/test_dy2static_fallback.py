@@ -1,0 +1,256 @@
+"""dy2static: graph-break fallback + compiled static.nn control flow.
+
+reference behavior being matched: the SOT executor runs data-dependent
+python control flow by splitting graphs
+(python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py);
+here the equivalent is a one-time warning + eager re-execution, with
+``paddle.static.nn.cond/while_loop/switch_case`` as the stay-compiled
+alternative (lowering to lax control flow). VERDICT r2 missing #5.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu import jit
+
+
+class TestGraphBreakFallback:
+    def test_data_dependent_if_falls_back(self):
+        @jit.to_static
+        def f(x):
+            if x.sum() > 0:          # data-dependent python `if`
+                return x * 2
+            return x - 1
+
+        xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(xp)
+            assert any("falling back to eager" in str(x.message) for x in w)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        # both branches work post-fallback, and no second warning
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+            assert not any("falling back" in str(x.message) for x in w)
+
+    def test_fallback_preserves_autograd(self):
+        @jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return (x * x).sum()
+            return (x * 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        f(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+    def test_traceable_fn_stays_compiled(self):
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)          # traced once per cache entry
+            return x * 2 + 1
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        f(x); f(x); f(x)
+        assert len(calls) == 1
+
+    def test_layer_forward_falls_back(self):
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 100.0:   # data-dependent
+                    return h * 0
+                return h
+
+        net = jit.to_static(Net())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = net(x)
+            assert any("falling back" in str(x.message) for x in w)
+        assert tuple(out.shape) == (2, 4)
+
+
+class TestCompiledControlFlow:
+    def test_cond_eager_concrete(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        out = static.nn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [6.0])
+        out = static.nn.cond(x.sum() < 0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def test_cond_eager_autograd(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        out = static.nn.cond(x.sum() > 0, lambda: (x * x).sum(),
+                             lambda: x.sum())
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_cond_keeps_to_static_compiled(self):
+        traces = []
+
+        @jit.to_static
+        def f(x):
+            traces.append(1)
+            return static.nn.cond(x.sum() > 0,
+                                  lambda: x * 2, lambda: x - 1)
+
+        xp = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0, -1.0], np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(f(xp).numpy(), [2.0, 2.0])
+            np.testing.assert_allclose(f(xn).numpy(), [-2.0, -2.0])
+            assert not any("falling back" in str(x.message) for x in w)
+        assert len(traces) == 1      # ONE compiled program, both branches
+
+    def test_while_loop_eager(self):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        i, s = static.nn.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + i.astype("float32")), [i, s])
+        assert int(i.numpy()) == 5
+        np.testing.assert_allclose(s.numpy(), 10.0)
+
+    def test_while_loop_compiled(self):
+        @jit.to_static
+        def f(n, x):
+            def body(i, acc):
+                return i + 1, acc * x
+            i, acc = static.nn.while_loop(
+                lambda i, acc: i < n, body,
+                [paddle.to_tensor(np.int32(0)), paddle.ones_like(x)])
+            return acc
+
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(paddle.to_tensor(np.int32(3)), x)
+            assert not any("falling back" in str(m.message) for m in w)
+        np.testing.assert_allclose(out.numpy(), [8.0])
+
+    def test_switch_case(self):
+        def mk(v):
+            return lambda: paddle.to_tensor(np.array([v], np.float32))
+        idx = paddle.to_tensor(np.int32(1))
+        out = static.nn.switch_case(idx, {0: mk(0.0), 1: mk(10.0),
+                                          3: mk(30.0)})
+        np.testing.assert_allclose(out.numpy(), [10.0])
+        # out-of-range index -> default (last branch)
+        out = static.nn.switch_case(paddle.to_tensor(np.int32(7)),
+                                    {0: mk(0.0), 1: mk(10.0), 3: mk(30.0)})
+        np.testing.assert_allclose(out.numpy(), [30.0])
+        # explicit default
+        out = static.nn.switch_case(paddle.to_tensor(np.int32(9)),
+                                    [mk(1.0), mk(2.0)], default=mk(-1.0))
+        np.testing.assert_allclose(out.numpy(), [-1.0])
+
+    def test_switch_case_compiled(self):
+        @jit.to_static
+        def f(idx, x):
+            return static.nn.switch_case(
+                idx, {0: (lambda: x + 1), 1: (lambda: x * 10)},
+                default=lambda: x * 0)
+
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.int32(0)), x).numpy(), [3.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.int32(1)), x).numpy(), [20.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.int32(5)), x).numpy(), [0.0])
+
+    def test_case_first_true_wins(self):
+        t = paddle.to_tensor(np.array(True))
+        f_ = paddle.to_tensor(np.array(False))
+        def mk(v):
+            return lambda: paddle.to_tensor(np.array([v], np.float32))
+        out = static.nn.case([(f_, mk(1.0)), (t, mk(2.0)), (t, mk(3.0))])
+        np.testing.assert_allclose(out.numpy(), [2.0])
+        # none true, explicit default
+        out = static.nn.case([(f_, mk(1.0))], default=mk(9.0))
+        np.testing.assert_allclose(out.numpy(), [9.0])
+        # none true, implicit default = last fn
+        out = static.nn.case([(f_, mk(1.0)), (f_, mk(4.0))])
+        np.testing.assert_allclose(out.numpy(), [4.0])
+
+
+class TestStaticNNLayers:
+    def test_fc(self):
+        with static.program_guard(static.Program(), static.Program()):
+            x = paddle.to_tensor(np.ones((2, 3), np.float32))
+            out = static.nn.fc(x, size=4)
+            assert tuple(out.shape) == (2, 4)
+
+    def test_fc_flatten_dims(self):
+        with static.program_guard(static.Program(), static.Program()):
+            x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+            out = static.nn.fc(x, size=5, num_flatten_dims=2)
+            assert tuple(out.shape) == (2, 3, 5)
+
+    def test_embedding(self):
+        with static.program_guard(static.Program(), static.Program()):
+            ids = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+            out = static.nn.embedding(ids, size=(10, 6))
+            assert tuple(out.shape) == (2, 2, 6)
+
+
+class TestControlFlowErrors:
+    def test_cond_missing_branch_under_trace_raises_clearly(self):
+        @jit.to_static
+        def f(x):
+            return static.nn.cond(x.sum() > 0, lambda: x * 2)
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        with pytest.raises(ValueError, match="BOTH branches"):
+            f(x)
+
+    def test_switch_case_empty_raises(self):
+        with pytest.raises(ValueError, match="at least one branch"):
+            static.nn.switch_case(paddle.to_tensor(np.int32(0)), [])
+        with pytest.raises(ValueError, match="at least one"):
+            static.nn.case([])
+
+
+class TestPerSignatureGraphBreak:
+    def test_break_is_per_signature(self):
+        """A 2-D input that concretizes must not de-optimize the 1-D path
+        that compiled fine (reference SOT breaks per-graph-site)."""
+        traces = []
+
+        @jit.to_static
+        def f(x):
+            traces.append(1)
+            if x.ndim == 2 and x.sum() > 0:   # breaks only for 2-D
+                return x * 2
+            return x + 1
+
+        x1 = paddle.to_tensor(np.ones(3, np.float32))
+        x2 = paddle.to_tensor(np.ones((2, 2), np.float32))
+        np.testing.assert_allclose(f(x1).numpy(), 2 * np.ones(3))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(f(x2).numpy(), 2 * np.ones((2, 2)))
+            assert any("falling back" in str(m.message) for m in w)
+        # only the 2-D signature is marked eager; the 1-D path still runs
+        # through the compiled cache
+        assert len(f._eager_keys) == 1
+        np.testing.assert_allclose(f(x1).numpy(), 2 * np.ones(3))
+        np.testing.assert_allclose(f(x2).numpy(), 2 * np.ones((2, 2)))
+        assert len(f._eager_keys) == 1

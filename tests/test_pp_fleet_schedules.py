@@ -87,7 +87,10 @@ def test_fleet_heterogeneous_falls_back():
     x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
     y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
     loss = model.forward_backward_pipeline([x, y])
-    assert model._spmd_step is None  # heterogeneous -> accum path
+    # round 3: heterogeneous stages now RUN the SPMD pipeline (flattened
+    # vec + lax.switch, see tests/test_pp_hetero.py) instead of falling
+    # back to accumulation
+    assert model._spmd_step is not None
     full = pipe._loss_fn(pipe(x), y)
     np.testing.assert_allclose(float(loss.numpy()), float(full.numpy()),
                                rtol=1e-5)

@@ -1,0 +1,146 @@
+"""Heterogeneous-stage pipeline parallelism (VERDICT r2 missing #4).
+
+The reference segments ARBITRARY layers into pipeline stages
+(reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py:93 SegmentLayers, :258 PipelineLayer) — the common topology is
+embedding stage != decoder stages != head stage. These tests pin:
+
+- embed != mid != head stages train through the REAL SPMD pipeline
+  (flattened-vector stacking + lax.switch dispatch, pp_spmd.pipeline_hetero*)
+  with loss AND grads equal to the sequential eager formulation, for every
+  schedule;
+- the accumulation fallback WARNS instead of silently de-pipelining.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def _build(descs, loss_fn, schedule, num_stages=4, accumulate_steps=4):
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": num_stages}
+    strategy.pipeline_configs = {"accumulate_steps": accumulate_steps,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": schedule}
+    dist.fleet.init(strategy=strategy)
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    pipe = PipelineLayer(layers=descs, num_stages=num_stages,
+                         loss_fn=loss_fn)
+    model = dist.fleet.distributed_model(pipe)
+    return pipe, model
+
+
+def _hetero_descs(vocab=16, hidden=8, out=12):
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+    return [
+        LayerDesc(paddle.nn.Embedding, vocab, hidden),   # stage 0: embed
+        LayerDesc(paddle.nn.Linear, hidden, hidden),
+        LayerDesc(paddle.nn.Tanh),                       # stage 1
+        LayerDesc(paddle.nn.Linear, hidden, hidden),
+        LayerDesc(paddle.nn.Tanh),                       # stage 2
+        LayerDesc(paddle.nn.Linear, hidden, hidden),
+        LayerDesc(paddle.nn.Tanh),                       # stage 3 (ring)
+        LayerDesc(paddle.nn.Linear, hidden, out),        # stage 3 (head)
+    ]
+
+
+def _ref_grads(pipe, loss_fn, x, y):
+    out = pipe(x)
+    loss = loss_fn(out, y)
+    loss.backward()
+    g = {n: p.grad.numpy().copy() for n, p in pipe.named_parameters()}
+    for p in pipe.parameters():
+        p.clear_grad()
+    return float(loss.numpy()), g
+
+
+@pytest.mark.parametrize("schedule", ["F-then-B", "1F1B", "ZB"])
+def test_hetero_stages_match_eager(schedule):
+    np.random.seed(0)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    pipe, model = _build(_hetero_descs(), loss_fn, schedule)
+    x = paddle.to_tensor(np.random.randint(0, 16, (8,)).astype("int64"))
+    y = paddle.to_tensor(np.random.rand(8, 12).astype("float32"))
+    ref_loss, ref_g = _ref_grads(pipe, loss_fn, x, y)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert not any("de-pipelining" in str(m.message) or
+                       "NO pipeline" in str(m.message) for m in w), \
+            "hetero stages silently fell back to accumulation"
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=2e-4)
+    got = {n: p.grad.numpy() for n, p in pipe.named_parameters()}
+    assert set(got) == set(ref_g)
+    for n in ref_g:
+        np.testing.assert_allclose(got[n], ref_g[n], atol=5e-4,
+                                   err_msg=f"{schedule}: {n}")
+
+
+def test_hetero_train_batch_converges():
+    """End-to-end: optimizer steps through the hetero SPMD pipeline reduce
+    the loss (embed + mid + head params all receive gradients)."""
+    np.random.seed(1)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    pipe, model = _build(_hetero_descs(out=4), loss_fn, "1F1B")
+    opt = paddle.optimizer.SGD(learning_rate=0.2,
+                               parameters=pipe.parameters())
+    x = paddle.to_tensor(np.random.randint(0, 16, (8,)).astype("int64"))
+    y = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+    losses = [float(model.train_batch([x, y], opt).numpy())
+              for _ in range(8)]
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_mid_ring_shape_change_warns_and_falls_back():
+    """A stage whose OUTPUT shape differs mid-ring cannot ride the scan;
+    the engine must warn (not silently de-pipeline) and still produce
+    correct accumulation grads."""
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+    np.random.seed(2)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    descs = [
+        LayerDesc(paddle.nn.Linear, 8, 8),
+        LayerDesc(paddle.nn.Linear, 8, 12),   # stage 1 widens mid-ring
+        LayerDesc(paddle.nn.Linear, 12, 8),
+        LayerDesc(paddle.nn.Linear, 8, 8),
+    ]
+    pipe, model = _build(descs, loss_fn, "1F1B")
+    x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    ref_loss, ref_g = _ref_grads(pipe, loss_fn, x, y)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert any("NO pipeline" in str(m.message) for m in w)
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=1e-4)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=5e-4)
+
+
+def test_embed_only_first_stage():
+    """Stage 0 that is ONLY the embedding (fully peeled into pre): the
+    ring's first stage is the identity and training still matches."""
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+    np.random.seed(3)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    descs = [
+        LayerDesc(paddle.nn.Embedding, 16, 8),           # whole stage 0
+        LayerDesc(paddle.nn.Linear, 8, 8),               # stage 1
+        LayerDesc(paddle.nn.Linear, 8, 8),               # stage 2
+        LayerDesc(paddle.nn.Linear, 8, 8),               # stage 3
+    ]
+    pipe, model = _build(descs, loss_fn, "F-then-B")
+    x = paddle.to_tensor(np.random.randint(0, 16, (8,)).astype("int64"))
+    y = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    ref_loss, ref_g = _ref_grads(pipe, loss_fn, x, y)
+    loss = model.forward_backward_pipeline([x, y])
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=2e-4)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=5e-4,
+                                   err_msg=n)

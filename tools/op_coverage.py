@@ -162,7 +162,7 @@ ALIASES = {
     "data": "static.data",
     "dirichlet": "distribution.Dirichlet",
     "auc": "metric.Auc", "accuracy": "metric.Accuracy",
-    "accuracy_check": "amp.debugging accuracy_compare",
+    "accuracy_check": "amp.debugging accuracy_check/compare_accuracy",
     "check_numerics": "amp.debugging.check_numerics",
     "enable_check_model_nan_inf": "amp.debugging",
     "disable_check_model_nan_inf": "amp.debugging",
