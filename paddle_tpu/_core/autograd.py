@@ -156,12 +156,16 @@ def set_static_hook(fn):
     _static_hook[0] = fn
 
 
-def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
+def apply(fn: Callable, *args, name: str = "", multi_out: bool = False,
+          nondiff: tuple = ()):
     """Run primitive ``fn`` over raw values of ``args`` and record a tape node.
 
     ``args`` may mix Tensors and raw values; only float/complex Tensors with
-    ``stop_gradient=False`` are differentiated. Returns Tensor (or tuple of
-    Tensors if ``fn`` returns a tuple/list or ``multi_out``).
+    ``stop_gradient=False`` are differentiated. ``nondiff`` lists arg
+    positions excluded from differentiation regardless of dtype/flags
+    (e.g. soft labels — the reference's grad kernels never emit label
+    grads). Returns Tensor (or tuple of Tensors if ``fn`` returns a
+    tuple/list or ``multi_out``).
     """
     from .tensor import Tensor  # local import to break the cycle
 
@@ -178,7 +182,8 @@ def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
         raw = _amp_hook[0](name or getattr(fn, "__name__", ""), raw)
 
     track = is_grad_enabled() and any(
-        (not t.stop_gradient) and _is_diff_dtype(t.dtype) for _, t in tensors)
+        (not t.stop_gradient) and _is_diff_dtype(t.dtype)
+        and i not in nondiff for i, t in tensors)
 
     if not track:
         out = fn(*raw)
@@ -189,7 +194,8 @@ def apply(fn: Callable, *args, name: str = "", multi_out: bool = False):
         return wrapped
 
     diff = [(i, t) for i, t in tensors
-            if (not t.stop_gradient) and _is_diff_dtype(t.dtype)]
+            if (not t.stop_gradient) and _is_diff_dtype(t.dtype)
+            and i not in nondiff]
     diff_idx = [i for i, _ in diff]
     diff_tensors = [t for _, t in diff]
 

@@ -30,6 +30,18 @@ def _to_batch_tuple(batch):
     return (batch,)
 
 
+def _metric_items(m):
+    """name()/accumulate() can be parallel LISTS (e.g. Accuracy(topk=(1,5))
+    -> ['acc_top1','acc_top5']); zip them like the reference hapi loop."""
+    names = m.name()
+    vals = m.accumulate()
+    if isinstance(names, (list, tuple)):
+        vals = vals if isinstance(vals, (list, tuple, np.ndarray)) \
+            else [vals]
+        return {n: float(v) for n, v in zip(names, vals)}
+    return {names: vals}
+
+
 class Engine:
     """reference: auto_parallel/static/engine.py:98 Engine(model, loss,
     optimizer, metrics, strategy). ``model`` should already be parallelized
@@ -186,8 +198,7 @@ class Engine:
                             c, Tensor) else c) for c in (
                             corr if isinstance(corr, (list, tuple))
                             else [corr])])
-                        logs[m.name() if not isinstance(m.name(), list)
-                             else m.name()[0]] = m.accumulate()
+                        logs.update(_metric_items(m))
                 if verbose and step % log_freq == 0:
                     kv = " ".join(f"{k}={v:.5g}" if isinstance(v, float)
                                   else f"{k}={v}" for k, v in logs.items())
@@ -199,6 +210,8 @@ class Engine:
         eval_fn = self._ensure_eval_step()
         loader = self._iter_data(valid_data, batch_size, False, False)
         losses: List[float] = []
+        for m in self._metrics:
+            m.reset()
         for step, batch in enumerate(loader):
             if steps is not None and step >= steps:
                 break
@@ -212,7 +225,19 @@ class Engine:
                                            for l in labels])
                 losses.append(float(np.asarray(
                     loss._value if isinstance(loss, Tensor) else loss)))
+            if self._metrics and labels:
+                for m in self._metrics:
+                    corr = m.compute(
+                        outs[0] if isinstance(outs[0], Tensor)
+                        else Tensor(outs[0], _internal=True),
+                        Tensor(labels[0], _internal=True))
+                    m.update(*[np.asarray(
+                        c._value if isinstance(c, Tensor) else c)
+                        for c in (corr if isinstance(corr, (list, tuple))
+                                  else [corr])])
         result = {"eval_loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            result.update(_metric_items(m))
         if verbose:
             print(f"[Engine.evaluate] {result}")
         return result

@@ -88,24 +88,23 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """reference: python/paddle/nn/functional/input.py embedding. XLA gather;
-    padding_idx rows contribute zero grad (mask on lookup)."""
-    idx = raw(as_tensor(x))
-
-    def f(w):
-        out = jnp.take(w, idx, axis=0)
+    padding_idx rows contribute zero grad (mask on lookup). ids are a real
+    op argument (not a baked closure) so static-mode replay rebinds them."""
+    def f(w, ids):
+        out = jnp.take(w, ids, axis=0)
         if padding_idx is not None:
             pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
-            mask = (idx != pi)[..., None].astype(out.dtype)
+            mask = (ids != pi)[..., None].astype(out.dtype)
             out = out * mask
         return out
-    return apply(f, as_tensor(weight), name="embedding")
+    return apply(f, as_tensor(weight), as_tensor(x), name="embedding")
 
 
 def one_hot(x, num_classes, name=None):
     from ..._core import dtype as dt
-    idx = raw(as_tensor(x))
-    return Tensor(jax.nn.one_hot(idx, num_classes,
-                                 dtype=dt.get_default_dtype()), _internal=True)
+    return apply(lambda idx: jax.nn.one_hot(
+        idx, num_classes, dtype=dt.get_default_dtype()), as_tensor(x),
+        name="one_hot")
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):

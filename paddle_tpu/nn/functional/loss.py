@@ -24,13 +24,12 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   use_softmax=True, label_smoothing=0.0, name=None):
     """reference: python/paddle/nn/functional/loss.py cross_entropy."""
     inp = as_tensor(input)
-    lab = raw(as_tensor(label))
-    args = [inp]
+    args = [inp, as_tensor(label)]
     has_w = weight is not None
     if has_w:
         args.append(as_tensor(weight))
 
-    def f(v, *rest):
+    def f(v, lab, *rest):
         logp = jax.nn.log_softmax(v, axis=axis) if use_softmax else jnp.log(
             jnp.clip(v, 1e-30, None))
         nclass = v.shape[axis]
@@ -64,7 +63,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
                 return jnp.sum(loss) / denom
         return _reduce_loss(loss, reduction)
-    return apply(f, *args, name="cross_entropy")
+    return apply(f, *args, name="cross_entropy", nondiff=(1,))
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
@@ -83,13 +82,13 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
     inp = as_tensor(input)
-    lab = raw(as_tensor(label)).astype(jnp.int32)
-    args = [inp]
+    args = [inp, as_tensor(label)]
     has_w = weight is not None
     if has_w:
         args.append(as_tensor(weight))
 
-    def f(v, *rest):
+    def f(v, lab_in, *rest):
+        lab = lab_in.astype(jnp.int32)
         valid = (lab != ignore_index)
         ls = jnp.where(valid, lab, 0)
         picked = jnp.take_along_axis(v, jnp.expand_dims(ls, 1), axis=1)
@@ -101,7 +100,7 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
         if reduction == "mean":
             return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
         return _reduce_loss(loss, reduction)
-    return apply(f, *args, name="nll_loss")
+    return apply(f, *args, name="nll_loss", nondiff=(1,))
 
 
 def mse_loss(input, label, reduction="mean", name=None):

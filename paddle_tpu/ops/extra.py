@@ -412,8 +412,10 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
 
 
 def masked_fill(x, mask, value, name=None):
-    return apply(lambda v, m: jnp.where(m, value, v), as_tensor(x),
-                 as_tensor(mask), name="masked_fill")
+    # single canonical implementation (manipulation.py): Tensor values are
+    # real op args, scalars cast to x's dtype
+    from .manipulation import masked_fill as _mf
+    return _mf(x, mask, value, name=name)
 
 
 def masked_scatter(x, mask, value, name=None):

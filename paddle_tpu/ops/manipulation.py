@@ -240,36 +240,35 @@ def roll(x, shifts, axis=None, name=None):
 
 @register("gather", tensor_method=False)
 def gather(x, index, axis=0, name=None):
-    idx = raw(as_tensor(index))
     axis = int(raw(axis))
-    return apply(lambda v: jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx,
-                                    axis=axis), as_tensor(x), name="gather")
+    # index is a real op arg (not a baked closure) so static-mode replay
+    # and the tape see it — same for every indexed op below
+    return apply(lambda v, idx: jnp.take(
+        v, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis),
+        as_tensor(x), as_tensor(index), name="gather")
 
 
 @register("gather_nd", tensor_method=False)
 def gather_nd(x, index, name=None):
-    idx = raw(as_tensor(index))
-
-    def f(v):
+    def f(v, idx):
         return v[tuple(jnp.moveaxis(idx, -1, 0))]
-    return apply(f, as_tensor(x), name="gather_nd")
+    return apply(f, as_tensor(x), as_tensor(index), name="gather_nd")
 
 
 @register("take_along_axis", tensor_method=False)
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
-    idx = raw(as_tensor(indices))
-    return apply(lambda v: jnp.take_along_axis(v, idx, axis=axis),
-                 as_tensor(arr), name="take_along_axis")
+    return apply(lambda v, idx: jnp.take_along_axis(v, idx, axis=axis),
+                 as_tensor(arr), as_tensor(indices),
+                 name="take_along_axis")
 
 
 @register("put_along_axis", tensor_method=False)
 def put_along_axis(arr, indices, values, axis, reduce="assign",
                    include_self=True, broadcast=True, name=None):
-    idx = raw(as_tensor(indices))
     arr = as_tensor(arr)
     vals = as_tensor(values) if not np.isscalar(values) else values
 
-    def f(v, *rest):
+    def f(v, idx, *rest):
         val = rest[0] if rest else jnp.full_like(idx, values, dtype=v.dtype)
         val = jnp.broadcast_to(val, idx.shape) if hasattr(val, "shape") else val
         if reduce == "assign":
@@ -289,65 +288,61 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
         ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
         full_idx = tuple(idx if d == ax else ii[d] for d in range(v.ndim))
         return getattr(v.at[full_idx], mode)(val)
-    args = (arr, vals) if isinstance(vals, Tensor) else (arr,)
+    args = (arr, as_tensor(indices), vals) if isinstance(vals, Tensor) \
+        else (arr, as_tensor(indices))
     return apply(f, *args, name="put_along_axis")
 
 
 @register("scatter", tensor_method=False)
 def scatter(x, index, updates, overwrite=True, name=None):
-    idx = raw(as_tensor(index))
-
-    def f(v, u):
+    def f(v, idx, u):
         if overwrite:
             return v.at[idx].set(u)
         return v.at[idx].add(u)
-    return apply(f, as_tensor(x), as_tensor(updates), name="scatter")
+    return apply(f, as_tensor(x), as_tensor(index), as_tensor(updates),
+                 name="scatter")
 
 
 @register("scatter_nd_add", tensor_method=False)
 def scatter_nd_add(x, index, updates, name=None):
-    idx = raw(as_tensor(index))
-
-    def f(v, u):
+    def f(v, idx, u):
         return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
-    return apply(f, as_tensor(x), as_tensor(updates), name="scatter_nd_add")
+    return apply(f, as_tensor(x), as_tensor(index), as_tensor(updates),
+                 name="scatter_nd_add")
 
 
 @register("scatter_nd", tensor_method=False)
 def scatter_nd(index, updates, shape, name=None):
-    idx = raw(as_tensor(index))
     s = _ishape(shape)
 
-    def f(u):
+    def f(idx, u):
         return jnp.zeros(s, u.dtype).at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
-    return apply(f, as_tensor(updates), name="scatter_nd")
+    return apply(f, as_tensor(index), as_tensor(updates),
+                 name="scatter_nd")
 
 
 @register("index_select", tensor_method=False)
 def index_select(x, index, axis=0, name=None):
-    idx = raw(as_tensor(index))
-    return apply(lambda v: jnp.take(v, idx, axis=axis), as_tensor(x),
-                 name="index_select")
+    return apply(lambda v, idx: jnp.take(v, idx, axis=axis), as_tensor(x),
+                 as_tensor(index), name="index_select")
 
 
 @register("index_add", tensor_method=False)
 def index_add(x, index, axis, value, name=None):
-    idx = raw(as_tensor(index))
-
-    def f(v, u):
+    def f(v, idx, u):
         vm = jnp.moveaxis(v, axis, 0)
         um = jnp.moveaxis(u, axis, 0)
         return jnp.moveaxis(vm.at[idx].add(um), 0, axis)
-    return apply(f, as_tensor(x), as_tensor(value), name="index_add")
+    return apply(f, as_tensor(x), as_tensor(index), as_tensor(value),
+                 name="index_add")
 
 
 @register("index_put", tensor_method=False)
 def index_put(x, indices, value, accumulate=False, name=None):
-    idx = tuple(raw(as_tensor(i)) for i in indices)
-
-    def f(v, u):
+    def f(v, u, *idx):
         return v.at[idx].add(u) if accumulate else v.at[idx].set(u)
-    return apply(f, as_tensor(x), as_tensor(value), name="index_put")
+    return apply(f, as_tensor(x), as_tensor(value),
+                 *[as_tensor(i) for i in indices], name="index_put")
 
 
 @register("masked_select", tensor_method=False)
@@ -361,21 +356,24 @@ def masked_select(x, mask, name=None):
 
 @register("masked_fill", tensor_method=False)
 def masked_fill(x, mask, value, name=None):
-    m = raw(as_tensor(mask))
+    if isinstance(value, Tensor):
+        return apply(lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+                     as_tensor(x), as_tensor(mask), value,
+                     name="masked_fill")
     v = raw(value)
-    return apply(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a),
-                 as_tensor(x), name="masked_fill")
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                 as_tensor(x), as_tensor(mask), name="masked_fill")
 
 
 @register("where", tensor_method=False)
 def where(condition, x=None, y=None, name=None):
-    cond = raw(as_tensor(condition))
     if x is None and y is None:
-        nz = np.nonzero(np.asarray(cond))
+        nz = np.nonzero(np.asarray(raw(as_tensor(condition))))
         return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)),
                       _internal=True)
-    return apply(lambda a, b: jnp.where(cond, a, b), as_tensor(x),
-                 as_tensor(y), name="where")
+    return apply(lambda c, a, b: jnp.where(c, a, b),
+                 as_tensor(condition), as_tensor(x), as_tensor(y),
+                 name="where")
 
 
 @register("nonzero", tensor_method=False)
@@ -482,10 +480,9 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
 
 @register("one_hot", tensor_method=False)
 def one_hot(x, num_classes, name=None):
-    idx = raw(as_tensor(x))
-    return Tensor(jax.nn.one_hot(idx, num_classes,
-                                 dtype=dtypes.get_default_dtype()),
-                  _internal=True)
+    return apply(lambda idx: jax.nn.one_hot(
+        idx, num_classes, dtype=dtypes.get_default_dtype()),
+        as_tensor(x), name="one_hot")
 
 
 @register("bincount", tensor_method=False)

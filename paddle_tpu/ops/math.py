@@ -124,9 +124,10 @@ def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
 
 @register("multiplex")
 def multiplex(inputs, index, name=None):
-    idx = raw(as_tensor(index))
-    return apply(lambda *xs: jnp.stack(xs, 0)[jnp.squeeze(idx, -1),
-                                              jnp.arange(xs[0].shape[0])],
+    def f(idx, *xs):
+        return jnp.stack(xs, 0)[jnp.squeeze(idx, -1),
+                                jnp.arange(xs[0].shape[0])]
+    return apply(f, as_tensor(index),
                  *[as_tensor(i) for i in inputs], name="multiplex")
 
 

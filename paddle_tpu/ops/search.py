@@ -169,9 +169,8 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
 
 @register("index_sample", tensor_method=False)
 def index_sample(x, index, name=None):
-    idx = raw(as_tensor(index))
-    return apply(lambda v: jnp.take_along_axis(v, idx, axis=1), as_tensor(x),
-                 name="index_sample")
+    return apply(lambda v, idx: jnp.take_along_axis(v, idx, axis=1),
+                 as_tensor(x), as_tensor(index), name="index_sample")
 
 
 @register("histogramdd", tensor_method=False)

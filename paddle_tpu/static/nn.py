@@ -40,6 +40,22 @@ def _is_traced(v) -> bool:
     return isinstance(v, jax.core.Tracer)
 
 
+def _as_pred_eq(idx, k):
+    import paddle_tpu as _p
+    t = idx if isinstance(idx, Tensor) else _p.to_tensor(idx)
+    return t == k
+
+
+def _select_pytree(pred, tval, fval):
+    """Elementwise select between two same-structure pytrees of Tensors,
+    recorded as ordinary `where` ops (replay-safe)."""
+    import paddle_tpu as _p
+    pred_t = pred if isinstance(pred, Tensor) else _p.to_tensor(pred)
+    return jax.tree_util.tree_map(
+        lambda a, b: _p.where(pred_t.reshape([]), a, b), tval, fval,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
 def _call_nograd(fn):
     """Run a branch under trace: jit differentiates the traced program, so
     the python tape is skipped (same contract as StaticFunction.traced)."""
@@ -55,6 +71,18 @@ def cond(pred, true_fn: Optional[Callable] = None,
     when the predicate is traced (XLA requirement)."""
     pv = _scalar(pred)
     if not _is_traced(pv):
+        from . import in_static_mode
+        if in_static_mode() and true_fn is not None \
+                and false_fn is not None:
+            # static-record mode: the predicate's BUILD value must not
+            # bake the branch (the reference's ConditionalBlock runs the
+            # select at execution) — record both branches + a select so
+            # Executor replay re-evaluates against the fed values.
+            # CONTRACT: branches must be PURE here (both are executed and
+            # recorded; in-place side effects in the untaken branch would
+            # replay unconditionally — XLA select semantics, same rule as
+            # the traced lax.cond path below)
+            return _select_pytree(pred, true_fn(), false_fn())
         fn = true_fn if bool(pv) else false_fn
         return fn() if fn is not None else None
     if true_fn is None or false_fn is None:
@@ -135,6 +163,14 @@ def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
         default = fns[-1]  # reference: last branch doubles as default
     iv = _scalar(branch_index)
     if not _is_traced(iv):
+        from . import in_static_mode
+        if in_static_mode():
+            # record every branch + select chain (replay re-evaluates)
+            out = default()
+            for k, f in items:
+                out = _select_pytree(
+                    _as_pred_eq(branch_index, k), f(), out)
+            return out
         k = int(iv)
         fn = dict(items).get(k, default)
         return fn()
@@ -166,7 +202,12 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
             in_dim *= int(d)
         w = _p.create_parameter([in_dim, size], str(xi.dtype),
                                 attr=weight_attr)
-        flat = xi.reshape(list(shape[:nfd]) + [in_dim])
+        if len(shape) == nfd + 1:
+            flat = xi  # trailing dim already flat; keeps dynamic batches
+        else:
+            # -1 for the (possibly None/dynamic) leading extent
+            flat = xi.reshape([-1] + [int(d) for d in shape[1:nfd]]
+                              + [in_dim])
         outs.append(flat.matmul(w))
     out = outs[0]
     for o in outs[1:]:

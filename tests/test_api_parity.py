@@ -31,7 +31,9 @@ class TestTopLevel:
     def test_inplace_random_fills(self):
         x = paddle.ones([500])
         paddle.geometric_(x, 0.5)
-        assert x.numpy().min() >= 1
+        # reference continuous form log(u)/log1p(-p): support (0, inf),
+        # values below 1 included (ADVICE r2 parity fix)
+        assert x.numpy().min() > 0
         paddle.log_normal_(x)
         assert x.numpy().min() > 0
         paddle.cauchy_(x)

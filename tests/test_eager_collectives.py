@@ -148,3 +148,201 @@ def test_reduce_mutates_in_place():
     r = dist.reduce(t, dst=0)
     assert r is t
     np.testing.assert_allclose(t.numpy(), [8.0, 8.0])
+
+
+# ---------------------------------------------------------------------------
+# Full op x placement matrix (VERDICT r2 'do this' #8): every collective in
+# the eager dist-tensor regime against the literal per-rank definition, for
+# each of the three placements; mapped-regime ops (scatter/gather/
+# all_to_all/ppermute/batch p2p/barrier) checked inside shard_map.
+# ---------------------------------------------------------------------------
+import jax
+import jax.numpy as jnp
+
+
+def _locals_for(placement, shape=(8, 4)):
+    """Per-rank local views + the dist tensor for a placement."""
+    rs = np.random.RandomState(7)
+    if isinstance(placement, Partial):
+        locs = [rs.randn(2, 3).astype("float32") for _ in range(8)]
+        t = dtensor_from_local_list(locs, _pm(), [Partial()])
+    elif isinstance(placement, Shard):
+        glob = rs.randn(*shape).astype("float32")
+        locs = [glob[i] for i in range(8)]
+        t = shard_tensor(paddle.to_tensor(glob), _pm(), [Shard(0)])
+    else:
+        x = rs.randn(2, 3).astype("float32")
+        locs = [x for _ in range(8)]
+        t = shard_tensor(paddle.to_tensor(x), _pm(), [Replicate()])
+    return locs, t
+
+
+_REDUCERS = {
+    dist.ReduceOp.SUM: lambda a: np.sum(a, 0),
+    dist.ReduceOp.MAX: lambda a: np.max(a, 0),
+    dist.ReduceOp.MIN: lambda a: np.min(a, 0),
+    dist.ReduceOp.PROD: lambda a: np.prod(a, 0),
+    dist.ReduceOp.AVG: lambda a: np.mean(a, 0),
+}
+
+
+class TestEagerMatrix:
+    @pytest.mark.parametrize("placement", [Partial(), Shard(0),
+                                           Replicate()],
+                             ids=["partial", "shard", "replicate"])
+    @pytest.mark.parametrize("op", list(_REDUCERS),
+                             ids=[str(o).split(".")[-1]
+                                  for o in _REDUCERS])
+    def test_all_reduce(self, op, placement):
+        if isinstance(placement, Shard) and op == dist.ReduceOp.AVG:
+            pytest.skip("AVG over shard slices: ambiguous in reference")
+        locs, t = _locals_for(placement)
+        want = _REDUCERS[op](np.stack([np.asarray(l).reshape(
+            locs[0].shape) if not isinstance(placement, Shard)
+            else l for l in locs]))
+        out = dist.all_reduce(t, op=op)
+        np.testing.assert_allclose(np.asarray(out.numpy()).reshape(
+            want.shape), want, rtol=1e-4)
+
+    @pytest.mark.parametrize("placement", [Partial(), Shard(0),
+                                           Replicate()],
+                             ids=["partial", "shard", "replicate"])
+    def test_reduce(self, placement):
+        locs, t = _locals_for(placement)
+        want = np.sum(np.stack(locs), 0)
+        out = dist.reduce(t, dst=0)
+        got = np.asarray((out if out is not None else t).numpy())
+        np.testing.assert_allclose(got.reshape(want.shape), want,
+                                   rtol=1e-4)
+
+    @pytest.mark.parametrize("placement", [Shard(0), Replicate()],
+                             ids=["shard", "replicate"])
+    def test_all_gather(self, placement):
+        locs, t = _locals_for(placement)
+        outs = []
+        dist.all_gather(outs, t)
+        assert len(outs) == 8
+        for o, l in zip(outs, locs):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy()).reshape(np.asarray(l).shape), l,
+                rtol=1e-5)
+
+    def test_all_gather_partial_is_documented_error(self):
+        # gathering Partial pieces is undefined in the metadata regime
+        # (the summed global is stored; per-rank pieces are not) — the
+        # documented contract is a clear error, not silent garbage
+        locs, t = _locals_for(Partial())
+        with pytest.raises(RuntimeError, match="all_gather"):
+            dist.all_gather([], t)
+
+    @pytest.mark.parametrize("placement", [Partial(), Replicate()],
+                             ids=["partial", "replicate"])
+    def test_reduce_scatter(self, placement):
+        locs, t = _locals_for(placement, shape=(8, 8))
+        summed = np.sum(np.stack(locs), 0).reshape(-1)
+        out = dist.reduce_scatter(t)
+        got = np.asarray(out.numpy()).reshape(-1)
+        np.testing.assert_allclose(got, summed, rtol=1e-4)
+
+    def test_broadcast_replicate(self):
+        locs, t = _locals_for(Replicate())
+        out = dist.broadcast(t, src=3)
+        got = np.asarray((out if out is not None else t).numpy())
+        np.testing.assert_allclose(got, locs[3], rtol=1e-5)
+
+    def test_broadcast_shard(self):
+        # per-rank contract: every coordinate ends with src's slice, so
+        # the global becomes that slice tiled over the shard axis
+        locs, t = _locals_for(Shard(0))
+        dist.broadcast(t, src=3)
+        want = np.stack([locs[3]] * 8)
+        np.testing.assert_allclose(np.asarray(t.numpy()), want, rtol=1e-5)
+
+    def test_broadcast_partial_is_documented_error(self):
+        locs, t = _locals_for(Partial())
+        with pytest.raises(RuntimeError, match="broadcast"):
+            dist.broadcast(t, src=3)
+
+
+class TestMappedRegimeOps:
+    """The p2p/distribution collectives execute per-rank inside shard_map —
+    checked against their literal definitions on the 8-dev world mesh."""
+
+    def _run(self, fn, *vals):
+        from paddle_tpu.distributed.mesh import get_world_group
+        g = get_world_group()
+        mesh = dist.mesh._state["mesh"]
+
+        def body(*xs):
+            return fn(g, *[paddle.Tensor(x, _internal=True) for x in xs])
+        from jax.sharding import PartitionSpec as P
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=tuple(P("world") for _ in vals),
+            out_specs=P("world"), check_vma=False)(*vals)
+
+    def test_scatter(self):
+        vals = np.arange(16, dtype="float32").reshape(8, 2)
+
+        def fn(g, x):
+            out = paddle.zeros([2])
+            pieces = [paddle.Tensor(jnp.full((2,), float(i)),
+                                    _internal=True) for i in range(8)]
+            dist.scatter(out, pieces, src=0, group=g)
+            return out._value[None]
+        got = self._run(fn, jnp.asarray(vals))
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.repeat(np.arange(8.0), 2)
+                                   .reshape(8, 2))
+
+    def test_gather(self):
+        vals = np.arange(8, dtype="float32").reshape(8, 1)
+
+        def fn(g, x):
+            full = dist.gather(x, dst=0, group=g)
+            return full._value.reshape(1, -1)
+        got = self._run(fn, jnp.asarray(vals))
+        for r in range(8):
+            np.testing.assert_allclose(np.asarray(got)[r],
+                                       np.arange(8.0))
+
+    def test_all_to_all_single(self):
+        vals = np.arange(64, dtype="float32").reshape(8, 8)
+
+        def fn(g, x):
+            # local view is (1, 8); the exchanged axis is the length-8 one
+            out = dist.alltoall_single(
+                paddle.Tensor(x._value[0], _internal=True), group=g,
+                axis=0)
+            return out._value[None]
+        got = np.asarray(self._run(fn, jnp.asarray(vals))).reshape(8, 8)
+        np.testing.assert_allclose(got, vals.T)
+
+    def test_shift_ring(self):
+        vals = np.arange(8, dtype="float32").reshape(8, 1)
+
+        def fn(g, x):
+            return dist.shift(x, offset=1, group=g)._value
+        got = np.asarray(self._run(fn, jnp.asarray(vals))).reshape(-1)
+        np.testing.assert_allclose(got, np.roll(np.arange(8.0), 1))
+
+    def test_batch_isend_irecv(self):
+        vals = np.arange(8, dtype="float32").reshape(8, 1)
+
+        def fn(g, x):
+            recv_buf = paddle.Tensor(jnp.zeros_like(x._value),
+                                     _internal=True)
+            ops = [dist.isend(x, 1, group=g),
+                   dist.irecv(recv_buf, -1, group=g)]
+            dist.batch_isend_irecv(ops)
+            return recv_buf._value
+        got = np.asarray(self._run(fn, jnp.asarray(vals))).reshape(-1)
+        np.testing.assert_allclose(got, np.roll(np.arange(8.0), 1))
+
+    def test_barrier_mapped(self):
+        vals = np.zeros((8, 1), "float32")
+
+        def fn(g, x):
+            dist.barrier(group=g)
+            return x._value
+        got = self._run(fn, jnp.asarray(vals))
+        assert np.asarray(got).shape == (8, 1)

@@ -22,7 +22,9 @@ except Exception:
     sys.exit(1)
 EOF
     then
-      echo "$(date -u +%FT%TZ) bench captured" >>"$LOG"
+      echo "$(date -u +%FT%TZ) bench captured; running perf sweep" >>"$LOG"
+      timeout 3000 python tools/perf_sweep.py >/tmp/perf_sweep.out 2>&1
+      echo "$(date -u +%FT%TZ) perf sweep done (rc=$?)" >>"$LOG"
       exit 0
     else
       echo "$(date -u +%FT%TZ) bench failed despite probe ok; retrying later" >>"$LOG"
