@@ -78,24 +78,45 @@ class Config:
     def enable_custom_device(self, device_type, device_id=0):
         self._device = device_type
 
-    # --- optimization toggles (parity no-ops: XLA optimizes always) ---
+    # --- optimization toggles ---
+    # XLA subsumes the reference's IR/memory/TensorRT/OneDNN pipeline:
+    # every toggle is accepted for parity but has no engine to configure.
+    # Toggles that a user might rely on semantically (turning optimization
+    # OFF, routing to TensorRT) warn ONCE instead of silently no-opping.
+    @staticmethod
+    def _inert(what, detail):
+        import warnings
+        warnings.warn(
+            f"inference.Config.{what}: accepted for API parity but inert "
+            f"on TPU — {detail}", stacklevel=3)
+
     def switch_ir_optim(self, flag=True):
+        if not flag:
+            self._inert("switch_ir_optim(False)",
+                        "XLA always compiles/optimizes; there is no "
+                        "unoptimized executor to fall back to")
         self._optim = flag
 
     def enable_tensorrt_engine(self, *a, **k):
-        pass
+        self._inert("enable_tensorrt_engine",
+                    "the compiled engine is XLA; TensorRT is a GPU "
+                    "deployment path")
 
     def enable_mkldnn(self):
-        pass
+        self._inert("enable_mkldnn", "OneDNN is a CPU kernel library; "
+                    "XLA:CPU compiles the fallback path")
 
     def enable_memory_optim(self, flag=True):
-        pass
+        if flag:
+            return  # XLA's buffer assignment already reuses/donates
+        self._inert("enable_memory_optim(False)",
+                    "XLA buffer reuse cannot be disabled")
 
     def switch_use_feed_fetch_ops(self, flag):
-        pass
+        pass  # feed/fetch are jit arguments; nothing to switch
 
     def switch_specify_input_names(self, flag=True):
-        pass
+        pass  # inputs are always named (get_input_names order)
 
     def enable_profile(self):
         self._enable_profile = True

@@ -135,6 +135,11 @@ class StaticFunction:
                 _current_amp_key())
 
     def __call__(self, *args, **kwargs):
+        # enable_to_static(False) is CALL-time (reference
+        # ProgramTranslator.enable): already-decorated functions drop to
+        # eager while the switch is off and recompile when it returns
+        if not _to_static_enabled[0]:
+            return self._fn(*args, **kwargs)
         # fast path: no graph break has ever occurred -> skip the
         # signature computation entirely (it is only needed to route
         # already-broken input classes to eager)
@@ -185,10 +190,20 @@ class StaticFunction:
         return self
 
 
+_to_static_enabled = [True]
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, **kwargs):
-    """reference: python/paddle/jit/api.py:197."""
+    """reference: python/paddle/jit/api.py:197. Honors
+    ``enable_to_static(False)`` (global dygraph switch) and
+    ``@not_to_static`` marks — both return the function un-compiled,
+    matching ProgramTranslator.enable semantics."""
     def decorate(f):
+        if getattr(f, "_not_to_static", False) or \
+                getattr(getattr(f, "forward", None), "_not_to_static",
+                        False) or not _to_static_enabled[0]:
+            return f
         if isinstance(f, Layer):
             sf = StaticFunction(f.forward, layer=f, input_spec=input_spec)
             f.forward = sf
@@ -204,7 +219,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
 
 def not_to_static(fn):
-    fn._not_to_static = True
+    """reference: jit/api.py not_to_static — mark a function/Layer so
+    to_static leaves it eager (SOT's skip list). Bound methods are marked
+    through their underlying function (method objects reject attributes).
+    """
+    target = getattr(fn, "__func__", fn)
+    target._not_to_static = True
     return fn
 
 
@@ -213,7 +233,10 @@ def ignore_module(modules):
 
 
 def enable_to_static(flag: bool):
-    pass
+    """reference: jit/api.py enable_to_static / ProgramTranslator.enable —
+    global switch: when False, to_static returns functions unwrapped (pure
+    dygraph), the standard debugging escape hatch."""
+    _to_static_enabled[0] = bool(flag)
 
 
 def _write_back_opt_state(optimizer, trainable, state, step_count):

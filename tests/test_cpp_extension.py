@@ -4,6 +4,8 @@ import numpy as np
 import jax
 import pytest
 
+pytestmark = pytest.mark.slow  # subprocess/integration heavies (tools/run_tests.sh --fast skips)
+
 import paddle_tpu as paddle
 from paddle_tpu.utils import cpp_extension
 

@@ -254,3 +254,67 @@ class TestPerSignatureGraphBreak:
         np.testing.assert_allclose(f(x1).numpy(), 2 * np.ones(3))
         np.testing.assert_allclose(f(x2).numpy(), 2 * np.ones((2, 2)))
         assert len(f._eager_keys) == 1
+
+
+class TestToStaticSwitches:
+    def test_enable_to_static_false_returns_eager(self):
+        jit.enable_to_static(False)
+        try:
+            @jit.to_static
+            def f(x):
+                if x.sum() > 0:      # would graph-break when compiled
+                    return x * 2
+                return x - 1
+            assert not hasattr(f, "_jitted")   # plain function, unwrapped
+            x = paddle.to_tensor(np.ones(2, np.float32))
+            np.testing.assert_allclose(f(x).numpy(), [2.0, 2.0])
+        finally:
+            jit.enable_to_static(True)
+
+    def test_enable_to_static_false_is_call_time(self):
+        """reference ProgramTranslator.enable: flipping the switch affects
+        ALREADY-decorated functions at call time."""
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        f(x); f(x)
+        assert len(calls) == 1            # compiled: traced once
+        jit.enable_to_static(False)
+        try:
+            f(x); f(x)
+            assert len(calls) == 3        # eager: body runs every call
+        finally:
+            jit.enable_to_static(True)
+        f(x)
+        assert len(calls) == 3            # compiled again (cache hit)
+
+    def test_not_to_static_on_bound_method(self):
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+        net = Net()
+        jit.not_to_static(net.forward)     # bound method, no workaround
+        out = jit.to_static(net)
+        from paddle_tpu.jit.api import StaticFunction
+        assert not isinstance(out.forward, StaticFunction)
+
+    def test_not_to_static_skips_wrapping(self):
+        @jit.not_to_static
+        def helper(x):
+            return x * 3
+
+        wrapped = jit.to_static(helper)
+        assert wrapped is helper               # left eager
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(wrapped(x).numpy(), [3.0, 3.0])
+

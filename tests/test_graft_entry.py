@@ -7,9 +7,12 @@ pin the fixes so they can never regress silently.
 """
 import json
 import os
+import pytest
 import subprocess
 import sys
 import time
+
+pytestmark = pytest.mark.slow  # subprocess/integration heavies (tools/run_tests.sh --fast skips)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -8,11 +8,13 @@ CPU throughput floor to catch order-of-magnitude regressions.
 """
 import time
 
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess/integration heavies (tools/run_tests.sh --fast skips)
+
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
-
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.jit.api import TrainStep, to_static

@@ -6,9 +6,11 @@ import sys
 import textwrap
 import time
 
-import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # subprocess/integration heavies (tools/run_tests.sh --fast skips)
+
+import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import profiler as prof
 
