@@ -1142,3 +1142,59 @@ def test_op_behavior(op):
             return o
         check_grad(fn, *spec.args, numeric=(spec.grad == "fd"),
                    atol=5e-3, rtol=5e-3)
+
+
+# ------------------------------------------------- bf16 tolerance tier
+# The reference OpTest runs fp16/bf16 variants with per-dtype tolerances
+# (test/legacy_test/op_test.py check_output max_relative_error tiers).
+# bf16 is THE TPU compute dtype, so every elementwise/activation/reduction
+# spec re-runs with bf16 inputs against the float64 numpy reference.
+BF16_OPS = [
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil",
+    "cos", "cosh", "erf", "exp", "expm1", "floor", "log", "log10",
+    "log1p", "log2", "logit", "reciprocal", "round", "rsqrt", "sigmoid",
+    "sign", "sin", "sinh", "sqrt", "square", "tan", "tanh", "trunc",
+    "celu", "elu", "gelu", "hardshrink", "hardsigmoid", "hardtanh",
+    "leaky_relu", "log_softmax", "mish", "relu", "relu6", "selu", "silu",
+    "softplus", "softshrink", "softsign", "swish", "thresholded_relu",
+    "stanh", "atan2", "copysign", "fmax", "fmin", "heaviside", "pow",
+    "kron", "dot", "mv", "bmm", "cross", "sum", "mean", "prod", "max",
+    "amax", "amin", "logsumexp", "cumsum", "argmax", "argmin", "topk",
+    "norm", "clip", "scale", "concat", "stack", "split", "squeeze",
+    "unsqueeze", "reshape", "transpose", "flip", "roll", "expand",
+    "flatten", "tril", "triu", "trace", "where", "swiglu", "addmm",
+    "lerp", "label_smooth",
+]
+
+
+@pytest.mark.parametrize("op", sorted(BF16_OPS))
+def test_op_behavior_bf16(op):
+    import jax.numpy as jnp
+    spec = SPECS[op]
+    call = spec.call or _resolve(op)
+    tensors = []
+    for a in spec.args:
+        a = np.asarray(a)
+        if a.dtype == np.float32:
+            t = paddle.to_tensor(a)
+            tensors.append(t.astype("bfloat16"))
+        else:
+            tensors.append(paddle.to_tensor(a))
+    out = call(*tensors, **spec.kw)
+    outs = [o for o in (out if isinstance(out, (tuple, list)) else [out])
+            if o is not None]
+    refs = spec.ref(*spec.args)
+    refs = refs if isinstance(refs, tuple) else (refs,)
+    for o, r in zip(outs, refs):
+        got = np.asarray(jnp.asarray(o._value, jnp.float32)
+                         if hasattr(o, "_value") else o, np.float64)
+        np.testing.assert_allclose(
+            got, np.asarray(r, np.float64),
+            # bf16 has 8 mantissa bits: ~0.4% relative tier (reference
+            # uses 1e-2 for bf16 check_output)
+            rtol=2e-2, atol=2e-2, err_msg=f"{op} [bf16]")
+
+
+def test_bf16_tier_covers_core_ops():
+    missing = [op for op in BF16_OPS if op not in SPECS]
+    assert not missing, missing
