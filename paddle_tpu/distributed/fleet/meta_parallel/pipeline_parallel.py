@@ -149,12 +149,15 @@ class PipelineParallel(MetaParallelBase):
 
     def _stage_layers_hetero(self):
         """Per-stage layer lists for the heterogeneous SPMD path — no
-        homogeneity requirement, but one stage per pp coordinate (no
-        virtual chunks) and every member a Layer."""
+        homogeneity requirement; num_seg == pp (plain schedules) or a
+        multiple of pp (interleaved VPP over heterogeneous virtual
+        stages), every member a Layer."""
         from ....nn.layer.layers import Layer
         num_seg = len(self._layers.segment_bounds()) - 1
         pp = self._hcg.get_pipe_parallel_world_size()
-        if num_seg != pp:
+        if num_seg % pp != 0:
+            return None
+        if num_seg != pp and self.schedule != "interleave":
             return None
         stages = []
         for s in range(num_seg):
@@ -309,10 +312,12 @@ class PipelineParallel(MetaParallelBase):
             return None
         pre, ring, head, carry = plan
         mesh = self._hcg.mesh
+        pp = self._hcg.get_pipe_parallel_world_size()
+        num_chunks = len(ring) // pp
         M = self.accumulate_steps
         loss_fn = self._layers._loss_fn
         schedule = self.schedule
-        if schedule == "interleave":
+        if schedule == "interleave" and num_chunks == 1:
             schedule = "gpipe"  # one stage per coord == plain wavefront
 
         def to_raw(t):
@@ -337,7 +342,11 @@ class PipelineParallel(MetaParallelBase):
         ring_params = [params_of(st) for st in ring]
         pre_params = params_of(pre)
         head_params = params_of(head)
-        vec, specs = pp_spmd.flatten_stage_params(ring_params, mesh)
+        if schedule == "interleave":
+            vec, specs = pp_spmd.flatten_stage_params_interleaved(
+                ring_params, mesh, num_chunks)
+        else:
+            vec, specs = pp_spmd.flatten_stage_params(ring_params, mesh)
         stage_fns = [
             (lambda plist, xin, st=st: apply_layers(st, plist, xin))
             for st in ring]
@@ -358,12 +367,17 @@ class PipelineParallel(MetaParallelBase):
                         mesh, defer_dw=(schedule == "zero_bubble"))
                     dpre = vjp_pre(dmbs.astype(mbs.dtype))[0]
                     return loss, (dv, dpre, dhead)
-            else:  # gpipe wavefront, AD backward
+            else:  # gpipe / interleaved wavefront, AD backward
                 def run(v, prp, hdp, mb, lab):
                     def total(v_, prp_, hdp_):
                         mbs = pre_apply(prp_, mb)
-                        outs = pp_spmd.pipeline_hetero(
-                            stage_fns, v_, specs, mbs, mesh)
+                        if schedule == "interleave":
+                            outs = pp_spmd.pipeline_hetero_interleave(
+                                stage_fns, v_, specs, mbs, mesh,
+                                num_chunks)
+                        else:
+                            outs = pp_spmd.pipeline_hetero(
+                                stage_fns, v_, specs, mbs, mesh)
                         losses = jax.vmap(
                             lambda y, l: head_loss(hdp_, y, l))(outs, lab)
                         return jnp.mean(losses)
@@ -374,6 +388,10 @@ class PipelineParallel(MetaParallelBase):
         loss, (dv, dpre, dhead) = self._spmd_step(
             vec, pre_params, head_params, xmb, lbs)
 
+        if schedule == "interleave":
+            # [P, chunks, Lmax] round-robin -> canonical [V, Lmax]
+            dv = jnp.transpose(dv, (1, 0, 2)).reshape(
+                len(ring), dv.shape[-1])
         dring = pp_spmd.unflatten_stage_grads(dv, specs)
 
         def scatter(layers, grads):

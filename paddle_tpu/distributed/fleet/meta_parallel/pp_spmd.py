@@ -619,3 +619,99 @@ def pipeline_hetero_1f1b(stage_fns: Sequence[Callable], loss_fn: Callable,
         out_specs=(P(), P(pp_axis, None), P(), P()),
         check_vma=False)
     return fn(stacked_vec, head_params, microbatches, labels)
+
+
+def flatten_stage_params_interleaved(per_stage_params: Sequence[Any],
+                                     mesh: Mesh, num_chunks: int,
+                                     pp_axis: str = "pp"):
+    """Heterogeneous VPP stacking: V = P*num_chunks virtual-stage pytrees
+    flatten to vectors, pad to the longest, and stack [P, num_chunks, Lmax]
+    in the Megatron round-robin layout (virtual stage s = chunk s//P on
+    device s%P). Returns (stacked, specs) with specs in CANONICAL virtual
+    stage order (index s)."""
+    P_ = mesh.shape[pp_axis]
+    V = P_ * num_chunks
+    assert len(per_stage_params) == V
+    # reuse the canonical flatten/pad/stack, then fold [V, L] into the
+    # round-robin [P, chunks, L] layout (canonical v -> [v % P, v // P])
+    flat, specs = flatten_stage_params(per_stage_params, mesh, pp_axis)
+    stacked = jnp.transpose(
+        flat.reshape(num_chunks, P_, flat.shape[-1]), (1, 0, 2))
+    try:
+        stacked = jax.device_put(
+            stacked, NamedSharding(mesh, P(pp_axis, None, None)))
+    except Exception:
+        pass
+    return stacked, specs
+
+
+def pipeline_hetero_interleave(stage_fns: Sequence[Callable], stacked_vec,
+                               specs, microbatches, mesh: Mesh,
+                               num_chunks: int, pp_axis: str = "pp"):
+    """Interleaved (VPP) wavefront over heterogeneous virtual stages.
+
+    Same closed-form schedule as :func:`pipeline_interleave`; the virtual
+    stage applied at a tick is ``v = c*P + d`` (a traced value), so the
+    per-stage function/spec dispatch is a ``lax.switch`` over all V
+    branches — branch v statically unflattens specs[v] from the chunk's
+    padded vector. stage_fns are indexed by canonical virtual stage.
+    """
+    num_stages = mesh.shape[pp_axis]
+    V = num_stages * num_chunks
+    assert len(stage_fns) == V == len(specs)
+    M = microbatches.shape[0]
+    assert M % num_stages == 0, (
+        f"interleaved schedule needs microbatches ({M}) % pp stages "
+        f"({num_stages}) == 0")
+    T = M * num_chunks + num_stages - 1
+    manual = frozenset({pp_axis})
+
+    def per_device(vec_local, mb_local):
+        vec_me = vec_local[0]                      # [num_chunks, Lmax]
+        stage = lax.axis_index(pp_axis)
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        x0 = jnp.zeros_like(mb_local[0])
+        out0 = jnp.zeros((M,) + mb_local.shape[1:], mb_local.dtype)
+
+        def apply_virtual(c, x_in):
+            v_id = c * num_stages + stage
+            vec_c = lax.dynamic_index_in_dim(vec_me, c, 0, keepdims=False)
+            branches = [
+                (lambda args, s=s: stage_fns[s](
+                    unflatten_stage(args[0], specs[s]), args[1]))
+                for s in range(V)]
+            return lax.switch(v_id, branches, (vec_c, x_in))
+
+        def tick(carry, t):
+            x_rc, out_buf = carry
+            u = t - stage
+            vP = V
+            g = jnp.where(u >= 0, u // vP, 0)
+            rem = jnp.clip(u - g * vP, 0, vP - 1)
+            c = rem // num_stages
+            m = jnp.clip(g * num_stages + rem % num_stages, 0, M - 1)
+            active = (u >= 0) & (u < M * num_chunks)
+
+            feed = lax.dynamic_index_in_dim(mb_local, m, 0, keepdims=False)
+            x_in = jnp.where((stage == 0) & (c == 0), feed, x_rc)
+            y = apply_virtual(c, x_in)
+            y = jnp.where(active, y, x_in)
+
+            emit = active & (stage == num_stages - 1) & \
+                (c == num_chunks - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                out_buf, y.astype(out_buf.dtype), m, 0)
+            out_buf = jnp.where(emit, upd, out_buf)
+
+            x_nx = lax.ppermute(y, pp_axis, perm)
+            return (x_nx, out_buf), None
+
+        (_, outs), _ = lax.scan(tick, (x0, out0), jnp.arange(T))
+        mask = (stage == num_stages - 1).astype(outs.dtype)
+        return lax.psum(outs * mask, pp_axis)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh, axis_names=manual,
+        in_specs=(P(pp_axis, None, None), P()), out_specs=P(),
+        check_vma=False)
+    return fn(stacked_vec, microbatches)

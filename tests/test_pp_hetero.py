@@ -144,3 +144,44 @@ def test_embed_only_first_stage():
     for n, p in pipe.named_parameters():
         np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=5e-4,
                                    err_msg=n)
+
+
+def test_hetero_interleaved_vpp_matches_eager():
+    """Heterogeneous VIRTUAL stages (VPP): 8 segments over 4 pp coords ×
+    2 chunks, embed/head peeled, loss+grads == sequential eager."""
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+    np.random.seed(4)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    descs = [
+        LayerDesc(paddle.nn.Embedding, 16, 8),           # vstage 0
+        LayerDesc(paddle.nn.Linear, 8, 8),               # vstage 1
+        LayerDesc(paddle.nn.Tanh),                       # vstage 2
+        LayerDesc(paddle.nn.Linear, 8, 8),               # vstage 3
+        LayerDesc(paddle.nn.Tanh),                       # vstage 4
+        LayerDesc(paddle.nn.Linear, 8, 8),               # vstage 5
+        LayerDesc(paddle.nn.Tanh),                       # vstage 6
+        LayerDesc(paddle.nn.Linear, 8, 12),              # vstage 7 (head)
+    ]
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": "VPP"}
+    dist.fleet.init(strategy=strategy)
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    pipe = PipelineLayer(layers=descs, num_stages=4,
+                         num_virtual_pipeline_stages=2, loss_fn=loss_fn)
+    model = dist.fleet.distributed_model(pipe)
+    x = paddle.to_tensor(np.random.randint(0, 16, (8,)).astype("int64"))
+    y = paddle.to_tensor(np.random.rand(8, 12).astype("float32"))
+    ref_loss, ref_g = _ref_grads(pipe, loss_fn, x, y)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert not any("NO pipeline" in str(m.message) for m in w), \
+            "hetero VPP silently de-pipelined"
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=2e-4)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=5e-4,
+                                   err_msg=n)
