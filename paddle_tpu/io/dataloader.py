@@ -116,18 +116,28 @@ class _PrefetchLoaderIter:
         self._lock = threading.Lock()
         if loader._is_iterable:
             # sequential source: one producer thread, bounded queue
-            self.inner = _SingleProcessLoaderIter(loader)
             self.q: "queue.Queue" = queue.Queue(
                 maxsize=max(2, num_workers * prefetch_factor))
             self._done = object()
 
             def worker():
+                # reference get_worker_info() contract: inside a loader
+                # worker, the dataset can ask who it is to self-shard.
+                # The iterable path has ONE sequential producer, so it is
+                # worker 0 of 1 (each reference worker would otherwise
+                # re-iterate the whole dataset). The TLS is set BEFORE
+                # iter(dataset) runs, so non-generator __iter__ bodies
+                # also see it.
+                _worker_info_tls.info = WorkerInfo(
+                    id=0, num_workers=1, dataset=loader.dataset)
                 try:
+                    self.inner = _SingleProcessLoaderIter(loader)
                     for item in self.inner:
                         self.q.put(item)
                 except Exception as e:  # propagate to consumer
                     self._err = e
                 finally:
+                    _worker_info_tls.info = None
                     self.q.put(self._done)
             self.t = threading.Thread(target=worker, daemon=True)
             self.t.start()
@@ -313,5 +323,20 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
+class WorkerInfo:
+    """reference: io/dataloader/worker.py WorkerInfo — (id, num_workers,
+    dataset) visible to IterableDataset.__iter__ for self-sharding."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info_tls = threading.local()
+
+
 def get_worker_info():
-    return None
+    """reference: io/reader.py get_worker_info — None outside a loader
+    worker; inside, the worker's identity."""
+    return getattr(_worker_info_tls, "info", None)

@@ -161,16 +161,7 @@ class Executor:
         if not prog.ops and not fetch_list and not feed:
             return []  # startup-program run: params already initialized
         env = {}
-        for name, val in (feed or {}).items():
-            ph = prog.placeholders.get(name)
-            if ph is None:
-                raise KeyError(f"feed target {name!r} is not a "
-                               f"static.data placeholder of this program")
-            if isinstance(val, Tensor):
-                val = val._value
-            # jnp.asarray passes traced arrays through (the feed may be a
-            # tracer when save_inference_model exports the replay)
-            env[id(ph)] = jnp.asarray(val)
+        swapped = []
 
         def resolve(a):
             if isinstance(a, Tensor):
@@ -184,6 +175,25 @@ class Executor:
 
         _state.replaying = True
         try:
+            for name, val in (feed or {}).items():
+                ph = prog.placeholders.get(name)
+                if ph is None:
+                    raise KeyError(f"feed target {name!r} is not a "
+                                   f"static.data placeholder of this "
+                                   f"program")
+                if isinstance(val, Tensor):
+                    val = val._value
+                # jnp.asarray passes traced arrays through (the feed may
+                # be a tracer when save_inference_model exports the replay)
+                fed = jnp.asarray(val)
+                env[id(ph)] = fed
+                # ALSO swap the fed value into the placeholder object for
+                # the replay's duration: recorded closures that read an
+                # external placeholder directly (e.g. a while op's cond
+                # reading a fed trip count) then see the fed value —
+                # the reference's sub-block variable scoping
+                swapped.append((ph, ph._value))
+                ph._value = fed
             for entry in prog.ops:
                 if entry[0] == "bind":
                     _, alias, src = entry
@@ -192,13 +202,20 @@ class Executor:
                     continue
                 fn, args, outs = entry
                 vals = fn(*[resolve(a) for a in args])
-                if isinstance(vals, (tuple, list)):
-                    for o, v in zip(outs, vals):
-                        env[id(o)] = v
-                else:
-                    env[id(outs[0])] = vals
+                if not isinstance(vals, (tuple, list)):
+                    vals = (vals,)
+                for o, v in zip(outs, vals):
+                    env[id(o)] = v
+                    # swap recomputed intermediates into their Tensor
+                    # objects too, so sub-block closures reading DERIVED
+                    # values (e.g. while cond over `n + 1`) stay current
+                    if isinstance(o, Tensor):
+                        swapped.append((o, o._value))
+                        o._value = v
         finally:
             _state.replaying = False
+            for ph, old in swapped:
+                ph._value = old
 
         fetches = fetch_list or []
         out = []

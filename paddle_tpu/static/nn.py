@@ -104,15 +104,6 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
     static-shape requirement — same contract as the reference's While op,
     whose block also fixes var shapes)."""
     loop_vars = list(loop_vars)
-    pv0 = _scalar(cond_fn(*loop_vars))
-    if not _is_traced(pv0) and not any(
-            _is_traced(v._value if isinstance(v, Tensor) else v)
-            for v in loop_vars):
-        while bool(_scalar(cond_fn(*loop_vars))):
-            out = body_fn(*loop_vars)
-            loop_vars = list(out) if isinstance(out, (tuple, list)) \
-                else [out]
-        return loop_vars
 
     def c(vs):
         with ag.no_grad():
@@ -122,6 +113,61 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
         with ag.no_grad():
             out = body_fn(*vs)
         return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    pv0 = _scalar(cond_fn(*loop_vars))
+    if not _is_traced(pv0) and not any(
+            _is_traced(v._value if isinstance(v, Tensor) else v)
+            for v in loop_vars):
+        from . import in_static_mode
+        if in_static_mode():
+            # static-record mode: the trip count must come from the FED
+            # values, not the build values — record the whole loop as ONE
+            # op whose body is a lax.while_loop (the reference's While op
+            # with its sub-block). Replay re-executes it; forward-only
+            # (grad through a dynamic while needs the traced path).
+            def f(*vals):
+                # suspend the recorder inside the sub-trace: the loop's
+                # interior ops belong to the while op's body, not the
+                # program (their tracers must not leak into recorded args)
+                from .._core import autograd as _ag
+                hook = _ag._static_hook[0]
+                _ag.set_static_hook(None)
+
+                # FRESH closures per execution: lax.while_loop caches the
+                # traced body by function identity, so reusing c/b would
+                # bake the build-time value of any closure-captured
+                # placeholder (e.g. a fed trip count) into the cached
+                # jaxpr as a constant
+                def c_(vs):
+                    with ag.no_grad():
+                        return _scalar(cond_fn(*vs)).astype(bool)
+
+                def b_(vs):
+                    with ag.no_grad():
+                        out = body_fn(*vs)
+                    return tuple(out) if isinstance(out, (tuple, list)) \
+                        else (out,)
+                try:
+                    ts = tuple(Tensor(v, _internal=True) for v in vals)
+                    outs = lax.while_loop(c_, b_, ts)
+                finally:
+                    _ag.set_static_hook(hook)
+                return tuple(t._value if isinstance(t, Tensor) else t
+                             for t in outs)
+            from .._core.autograd import apply as _apply
+            with ag.no_grad():
+                # forward-only contract: reverse-mode through a dynamic
+                # lax.while_loop has no rule; grads need the traced path
+                outs = _apply(f, *[v if isinstance(v, Tensor) else
+                                   Tensor(jnp.asarray(v), _internal=True)
+                                   for v in loop_vars],
+                              name="while_loop", multi_out=True)
+            return list(outs if isinstance(outs, tuple) else (outs,))
+        while bool(_scalar(cond_fn(*loop_vars))):
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (tuple, list)) \
+                else [out]
+        return loop_vars
 
     return list(lax.while_loop(c, b, tuple(loop_vars)))
 

@@ -219,3 +219,46 @@ class TestExtraZooFamilies:
         loss.backward()
         opt.step()
         assert np.isfinite(float(loss.numpy()))
+
+
+def test_iterable_dataset_worker_info_sharding():
+    """reference get_worker_info(): an IterableDataset can self-shard by
+    worker identity; the streaming producer is worker 0 of 1, and outside
+    a worker the call returns None."""
+    import paddle_tpu.io as io
+    assert io.get_worker_info() is None
+    seen_info = []
+
+    class Stream(io.IterableDataset):
+        def __iter__(self):
+            wi = io.get_worker_info()
+            seen_info.append((wi.id, wi.num_workers))
+            lo = wi.id
+            step = wi.num_workers
+            for i in range(lo, 8, step):
+                yield np.asarray([float(i)], np.float32)
+
+    loader = io.DataLoader(Stream(), batch_size=2, num_workers=2)
+    vals = [np.asarray(b).ravel().tolist() for b in loader]
+    flat = [v for batch in vals for v in batch]
+    assert flat == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]
+    assert seen_info == [(0, 1)]
+    assert io.get_worker_info() is None
+
+
+def test_worker_info_non_generator_iter():
+    """__iter__ that RETURNS an iterator (not a generator) runs eagerly
+    when iter(dataset) is called — that must happen inside the worker so
+    get_worker_info() is visible."""
+    import paddle_tpu.io as io
+
+    class DS(io.IterableDataset):
+        def __iter__(self):
+            wi = io.get_worker_info()
+            assert wi is not None and wi.num_workers == 1
+            return iter([np.asarray([float(i)], np.float32)
+                         for i in range(wi.id, 4, wi.num_workers)])
+
+    loader = io.DataLoader(DS(), batch_size=2, num_workers=2)
+    flat = [v for b in loader for v in np.asarray(b).ravel().tolist()]
+    assert flat == [0.0, 1.0, 2.0, 3.0]

@@ -297,3 +297,43 @@ class TestStaticMisc:
         out, = exe.run(cp, feed={"x": np.array([1., 2.], "float32")},
                        fetch_list=[y])
         np.testing.assert_allclose(out, [2., 4.])
+
+    def test_while_loop_feed_dependent_trip_count(self):
+        """The recorded while op must take its trip count from the FED
+        value, not the build value (reference While op semantics)."""
+        n = paddle.static.data("n", [], "int32")
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i2, s2 = paddle.static.nn.while_loop(
+            lambda i, s: i < n,
+            lambda i, s: (i + 1, s + 2.0), [i, s])
+        exe = paddle.static.Executor()
+        for trips in (3, 7, 0):
+            got, = exe.run(feed={"n": np.int32(trips)}, fetch_list=[s2])
+            np.testing.assert_allclose(got, 2.0 * trips)
+
+    def test_while_loop_derived_bound_replays(self):
+        """The loop bound can be an op DERIVED from a placeholder — the
+        replay must propagate recomputed intermediates into sub-block
+        closures, not just raw placeholder feeds."""
+        n = paddle.static.data("n", [], "int32")
+        limit = n + 1
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        _, s2 = paddle.static.nn.while_loop(
+            lambda i, s: i < limit,
+            lambda i, s: (i + 1, s + 2.0), [i, s])
+        exe = paddle.static.Executor()
+        for trips in (7, 2):
+            got, = exe.run(feed={"n": np.int32(trips)}, fetch_list=[s2])
+            np.testing.assert_allclose(got, 2.0 * (trips + 1))
+
+    def test_bad_feed_does_not_corrupt_placeholder(self):
+        x = paddle.static.data("x", [2], "float32")
+        exe = paddle.static.Executor()
+        build_val = x.numpy().copy()
+        with pytest.raises(KeyError):
+            exe.run(feed={"x": np.ones(2, "float32"),
+                          "bogus": np.zeros(2, "float32")},
+                    fetch_list=[])
+        np.testing.assert_allclose(x.numpy(), build_val)
