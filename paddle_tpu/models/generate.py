@@ -33,6 +33,44 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
     }
 
 
+def quantize_weights(params, cfg: LlamaConfig) -> Dict:
+    """Weight-only int8 quantization for serving (reference:
+    paddle/phi/kernels/fusion weight_only_linear / llm.int8 path;
+    python surface nn.quant.weight_quantize).
+
+    Per-output-channel symmetric int8: w ~= q * scale[None, :]. Decode is
+    HBM-bandwidth-bound, so halving weight bytes is the TPU win; dequant
+    (convert+scale) fuses into the matmul read. The embedding table stays
+    bf16 (it is a gather, and the tied head reuses it)."""
+    def q(w):
+        scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+        scale = jnp.maximum(scale, 1e-8)
+        qw = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
+                      -127, 127).astype(jnp.int8)
+        return qw, scale.astype(jnp.float32)
+
+    out = {k: v for k, v in params.items()}
+    layers = dict(params["layers"])
+    for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+        qw, sc = jax.vmap(q)(layers[name])
+        layers[name] = qw
+        layers[name + "_scale"] = sc
+    out["layers"] = layers
+    if not cfg.tie_embeddings and "lm_head" in params:
+        qw, sc = q(params["lm_head"])
+        out["lm_head"] = qw
+        out["lm_head_scale"] = sc
+    return out
+
+
+def _w(lp, name, dtype):
+    """Weight fetch with on-the-fly int8 dequant when quantized."""
+    w = lp[name]
+    if name + "_scale" in lp:
+        return w.astype(dtype) * lp[name + "_scale"][None, :].astype(dtype)
+    return w
+
+
 def _use_decode_kernel(override=None):
     """Pallas decode attention on real TPU; jnp composition elsewhere
     (interpret-mode pallas inside a scan is pointlessly slow on CPU)."""
@@ -77,9 +115,9 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
     B, T, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = (h1 @ lp["wq"]).reshape(B, T, nh, hd)
-    k = (h1 @ lp["wk"]).reshape(B, T, nkv, hd)
-    v = (h1 @ lp["wv"]).reshape(B, T, nkv, hd)
+    q = (h1 @ _w(lp, "wq", x.dtype)).reshape(B, T, nh, hd)
+    k = (h1 @ _w(lp, "wk", x.dtype)).reshape(B, T, nkv, hd)
+    v = (h1 @ _w(lp, "wv", x.dtype)).reshape(B, T, nkv, hd)
     q = apply_rope(q, lax.dynamic_slice_in_dim(cos, pos, T),
                    lax.dynamic_slice_in_dim(sin, pos, T))
     k = apply_rope(k, lax.dynamic_slice_in_dim(cos, pos, T),
@@ -90,11 +128,12 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
         cache_v.dtype), pos, axis=1)
     o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh,
                          use_kernel=use_kernel)
-    x = x + o.reshape(B, T, nh * hd) @ lp["wo"]
+    x = x + o.reshape(B, T, nh * hd) @ _w(lp, "wo", x.dtype)
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    g = jax.nn.silu((h2 @ lp["wg"]).astype(jnp.float32)).astype(x.dtype)
-    u = h2 @ lp["wu"]
-    return x + (g * u) @ lp["wd"], cache_k, cache_v
+    g = jax.nn.silu((h2 @ _w(lp, "wg", x.dtype)).astype(
+        jnp.float32)).astype(x.dtype)
+    u = h2 @ _w(lp, "wu", x.dtype)
+    return x + (g * u) @ _w(lp, "wd", x.dtype), cache_k, cache_v
 
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
@@ -114,8 +153,11 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
     x, (new_k, new_v) = lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, -1] @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        head = params["embed"].T.astype(x.dtype)
+    else:
+        head = _w(params, "lm_head", x.dtype)
+    logits = (x[:, -1] @ head).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -119,19 +119,43 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
             _is_traced(v._value if isinstance(v, Tensor) else v)
             for v in loop_vars):
         from . import in_static_mode
-        if in_static_mode():
+        needs_grad = any(
+            isinstance(v, Tensor) and not v.stop_gradient
+            and jnp.issubdtype(jnp.result_type(v._value), jnp.floating)
+            for v in loop_vars)
+        if in_static_mode() and not needs_grad:
             # static-record mode: the trip count must come from the FED
             # values, not the build values — record the whole loop as ONE
             # op whose body is a lax.while_loop (the reference's While op
-            # with its sub-block). Replay re-executes it; forward-only
-            # (grad through a dynamic while needs the traced path).
+            # with its sub-block). Replay re-executes it. Forward-only:
+            # differentiable loop vars keep the taped eager-unroll path
+            # below (reverse-mode through a dynamic lax.while_loop has no
+            # rule; the reference's While grad comes from its own grad
+            # block).
             def f(*vals):
-                # suspend the recorder inside the sub-trace: the loop's
+                # suspend the recorder inside the sub-trace (the loop's
                 # interior ops belong to the while op's body, not the
-                # program (their tracers must not leak into recorded args)
+                # program) and intercept in-place mutation of EXTERNAL
+                # tensors: writing a trace-local tracer into a concrete
+                # tensor would leak it past the trace
                 from .._core import autograd as _ag
+                from .._core import tensor as _tc
                 hook = _ag._static_hook[0]
+                ip_hook = _tc._inplace_hook[0]
+
+                def guard(alias, src_tensor, new_value, old_value=None):
+                    old = old_value if old_value is not None else \
+                        getattr(alias, "_value", None)
+                    nv = new_value if new_value is not None else \
+                        getattr(src_tensor, "_value", None)
+                    if not _is_traced(old) and _is_traced(nv):
+                        raise RuntimeError(
+                            "static.nn.while_loop body mutated a tensor "
+                            "defined OUTSIDE the loop in place; carry it "
+                            "as a loop var instead (the While sub-block "
+                            "is pure, like lax.while_loop)")
                 _ag.set_static_hook(None)
+                _tc.set_inplace_hook(guard)
 
                 # FRESH closures per execution: lax.while_loop caches the
                 # traced body by function identity, so reusing c/b would
@@ -152,12 +176,11 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
                     outs = lax.while_loop(c_, b_, ts)
                 finally:
                     _ag.set_static_hook(hook)
+                    _tc.set_inplace_hook(ip_hook)
                 return tuple(t._value if isinstance(t, Tensor) else t
                              for t in outs)
             from .._core.autograd import apply as _apply
             with ag.no_grad():
-                # forward-only contract: reverse-mode through a dynamic
-                # lax.while_loop has no rule; grads need the traced path
                 outs = _apply(f, *[v if isinstance(v, Tensor) else
                                    Tensor(jnp.asarray(v), _internal=True)
                                    for v in loop_vars],

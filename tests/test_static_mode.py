@@ -337,3 +337,28 @@ class TestStaticMisc:
                           "bogus": np.zeros(2, "float32")},
                     fetch_list=[])
         np.testing.assert_allclose(x.numpy(), build_val)
+
+    def test_while_loop_grad_path_still_works(self):
+        """Differentiable loop vars keep the taped eager-unroll path so
+        gradients flow (reference While supports append_backward)."""
+        x = paddle.to_tensor(np.float32(2.0))
+        x.stop_gradient = False
+        i = paddle.to_tensor(np.int32(0))
+        _, y = paddle.static.nn.while_loop(
+            lambda i, s: i < 3,
+            lambda i, s: (i + 1, s * 2.0), [i, x])
+        (gx,) = paddle.static.gradients([y], [x])
+        np.testing.assert_allclose(np.asarray(gx.numpy()), 8.0)
+
+    def test_while_loop_external_mutation_raises_clearly(self):
+        buf = paddle.to_tensor(np.zeros(4, np.float32))
+        n = paddle.static.data("m", [], "int32")
+        i = paddle.to_tensor(np.int32(0))
+
+        def body(i):
+            # external in-place write of a LOOP-LOCAL value: would leak a
+            # tracer into buf past the trace — must be rejected
+            buf[0] = i.astype("float32")
+            return (i + 1,)
+        with pytest.raises(RuntimeError, match="loop var"):
+            paddle.static.nn.while_loop(lambda i: i < n, body, [i])
