@@ -35,6 +35,10 @@ class SparseCooTensor:
     def to_dense(self) -> Tensor:
         out = jnp.zeros(tuple(self.shape), self.values.dtype)
         idx = tuple(self.indices[i] for i in range(self.indices.shape[0]))
+        if self.values.dtype == jnp.bool_:
+            # scatter-add has no bool rule; bools scatter by set (a
+            # coalesced bool pattern has no duplicates to sum anyway)
+            return Tensor(out.at[idx].set(self.values), _internal=True)
         return Tensor(out.at[idx].add(self.values), _internal=True)
 
     def coalesce(self) -> "SparseCooTensor":

@@ -1,0 +1,174 @@
+"""Sparse op sweep: every covered sparse op executed against its DENSE
+numpy oracle (reference: test/legacy_test/test_sparse_*_op.py pattern —
+sparse result densified and compared elementwise).
+
+Complements tests/test_op_sweep.py (dense ops) and the structural sparse
+tests in test_dist_sparse_quant.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _coo(dense):
+    idx = np.stack(np.nonzero(dense))
+    vals = dense[tuple(idx)]
+    return sparse.sparse_coo_tensor(
+        paddle.to_tensor(idx.astype(np.int64)),
+        paddle.to_tensor(vals.astype(np.float32)), list(dense.shape))
+
+
+def _dense(st):
+    return np.asarray(st.to_dense().numpy())
+
+
+def _mat(seed=0, shape=(4, 5), density=0.4):
+    rs = np.random.RandomState(seed)
+    d = rs.randn(*shape).astype(np.float32)
+    d[rs.rand(*shape) >= density] = 0.0
+    return d
+
+
+UNARY = {
+    "abs": np.abs, "asin": lambda x: np.arcsin(np.clip(x, -1, 1)),
+    "asinh": np.arcsinh, "atan": np.arctan, "atanh":
+    lambda x: np.arctanh(np.clip(x, -0.9, 0.9)), "expm1": np.expm1,
+    "log1p": lambda x: np.log1p(np.maximum(x, -0.9)), "neg": np.negative,
+    "relu": lambda x: np.maximum(x, 0), "sin": np.sin, "sinh": np.sinh,
+    "sqrt": lambda x: np.sqrt(np.abs(x)), "square": np.square,
+    "tan": np.tan, "tanh": np.tanh, "deg2rad": np.deg2rad,
+    "rad2deg": np.rad2deg, "isnan": np.isnan,
+}
+
+
+@pytest.mark.parametrize("op", sorted(UNARY))
+def test_sparse_unary_matches_dense(op):
+    d = _mat(3)
+    if op in ("asin", "atanh"):
+        d = np.clip(d, -0.9, 0.9)
+    if op in ("sqrt", "log1p"):
+        d = np.abs(d)
+    st = _coo(d)
+    out = getattr(sparse, op)(st)
+    ref = UNARY[op](d) * (d != 0)   # sparse unary acts on nonzeros only
+    got = _dense(out) if hasattr(out, "to_dense") else \
+        np.asarray(out.numpy())
+    np.testing.assert_allclose(np.asarray(got, np.float64),
+                               np.asarray(ref, np.float64),
+                               atol=1e-5, err_msg=op)
+
+
+class TestSparseBinaryAndMatmul:
+    def test_add_subtract_multiply_divide(self):
+        a, b = _mat(1), _mat(1)      # same pattern (elementwise pair ops)
+        sa, sb = _coo(a), _coo(b)
+        np.testing.assert_allclose(_dense(sparse.add(sa, sb)), a + b,
+                                   atol=1e-6)
+        np.testing.assert_allclose(_dense(sparse.subtract(sa, sb)), a - b,
+                                   atol=1e-6)
+        np.testing.assert_allclose(_dense(sparse.multiply(sa, sb)), a * b,
+                                   atol=1e-6)
+        got = _dense(sparse.divide(sa, sb))
+        mask = a != 0
+        np.testing.assert_allclose(got[mask], (a / b)[mask], atol=1e-5)
+
+    def test_matmul_vs_dense(self):
+        a = _mat(2, (4, 6))
+        w = np.random.RandomState(5).randn(6, 3).astype(np.float32)
+        out = sparse.matmul(_coo(a), paddle.to_tensor(w))
+        got = out.to_dense().numpy() if hasattr(out, "to_dense") else \
+            out.numpy()
+        np.testing.assert_allclose(np.asarray(got), a @ w, atol=1e-5)
+
+    def test_mv(self):
+        a = _mat(6, (4, 6))
+        v = np.random.RandomState(6).randn(6).astype(np.float32)
+        out = sparse.mv(_coo(a), paddle.to_tensor(v))
+        got = out.to_dense().numpy() if hasattr(out, "to_dense") else \
+            out.numpy()
+        np.testing.assert_allclose(np.asarray(got), a @ v, atol=1e-5)
+
+    def test_addmm(self):
+        inp = np.random.RandomState(7).randn(4, 3).astype(np.float32)
+        a = _mat(8, (4, 6))
+        w = np.random.RandomState(9).randn(6, 3).astype(np.float32)
+        out = sparse.addmm(paddle.to_tensor(inp), _coo(a),
+                           paddle.to_tensor(w), beta=0.5, alpha=2.0)
+        got = out.to_dense().numpy() if hasattr(out, "to_dense") else \
+            out.numpy()
+        np.testing.assert_allclose(np.asarray(got), 0.5 * inp + 2.0 *
+                                   (a @ w), atol=1e-4)
+
+    def test_masked_matmul(self):
+        x = np.random.RandomState(10).randn(4, 6).astype(np.float32)
+        y = np.random.RandomState(11).randn(6, 4).astype(np.float32)
+        mask = _mat(12, (4, 4), density=0.5)
+        out = sparse.masked_matmul(paddle.to_tensor(x),
+                                   paddle.to_tensor(y), _coo(mask))
+        ref = (x @ y) * (mask != 0)
+        np.testing.assert_allclose(_dense(out), ref, atol=1e-4)
+
+
+class TestSparseStructure:
+    def test_pow_cast_sum(self):
+        d = _mat(13)
+        st = _coo(d)
+        np.testing.assert_allclose(_dense(sparse.pow(st, 2.0)),
+                                   d ** 2 * (d != 0), atol=1e-5)
+        c = sparse.cast(st, value_dtype="float32")
+        np.testing.assert_allclose(_dense(c), d, atol=1e-6)
+        s = sparse.sum(st)
+        np.testing.assert_allclose(float(np.asarray(
+            s.to_dense().numpy() if hasattr(s, "to_dense")
+            else s.numpy())), d.sum(), rtol=1e-5)
+
+    def test_reshape_transpose_slice(self):
+        d = _mat(14, (4, 6))
+        st = _coo(d)
+        np.testing.assert_allclose(
+            _dense(sparse.reshape(st, [6, 4])), d.reshape(6, 4))
+        np.testing.assert_allclose(
+            _dense(sparse.transpose(st, [1, 0])), d.T)
+        np.testing.assert_allclose(
+            _dense(sparse.slice(st, [0], [1], [3])), d[1:3])
+
+    def test_conversions_and_predicates(self):
+        d = _mat(15)
+        st = _coo(d)
+        assert sparse.is_sparse_coo(st)
+        csr = sparse.to_sparse_csr(st) if hasattr(
+            sparse, "to_sparse_csr") else st.to_sparse_csr()
+        assert sparse.is_sparse_csr(csr)
+        np.testing.assert_allclose(np.asarray(csr.to_dense().numpy()), d)
+        back = csr.to_sparse_coo(2) if hasattr(
+            csr, "to_sparse_coo") else sparse.to_sparse_coo(csr, 2)
+        np.testing.assert_allclose(_dense(back), d)
+        assert sparse.is_same_shape(st, _coo(d))
+
+    def test_values_like_and_mask_as(self):
+        d = _mat(16)
+        st = _coo(d)
+        nnz = int((d != 0).sum())
+        vl = sparse.sparse_coo_tensor_values_like(
+            st, paddle.to_tensor(np.ones(nnz, np.float32)))
+        np.testing.assert_allclose(_dense(vl), (d != 0).astype(np.float32))
+        dense_new = np.random.RandomState(17).randn(*d.shape).astype(
+            np.float32)
+        m = sparse.mask_as(paddle.to_tensor(dense_new), st)
+        np.testing.assert_allclose(_dense(m), dense_new * (d != 0),
+                                   atol=1e-6)
+
+    def test_nn_layers(self):
+        import paddle_tpu.sparse.nn as snn
+        d = np.abs(_mat(18))
+        st = _coo(d)
+        out = snn.ReLU()(st)
+        np.testing.assert_allclose(_dense(out), np.maximum(d, 0) * (d != 0),
+                                   atol=1e-6)
+        sm = snn.Softmax()(_coo(_mat(19)))
+        dd = _dense(sm)
+        rows = dd.sum(-1)
+        # each non-empty row's nonzeros softmax to 1
+        assert np.all((np.abs(rows - 1) < 1e-5) | (rows == 0))
