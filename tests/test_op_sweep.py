@@ -174,11 +174,14 @@ b2("fmin", np.fmin)
 b2("heaviside", np.heaviside, grad=None)
 b2("nextafter", np.nextafter, grad=None)
 b2("pow", lambda x, y: np.power(x, y), a=P(2, 3), b=P(2, 3, seed=7))
-b2("kron", np.kron, a=S(2, 2), b=S(3, 2, seed=7))
-b2("dot", lambda x, y: np.dot(x, y), a=S(4), b=S(4, seed=7))
-b2("mv", lambda m, v: m @ v, a=S(3, 4), b=S(4, seed=7))
-b2("bmm", np.matmul, a=S(2, 3, 4), b=S(2, 4, 2, seed=7))
-b2("cross", lambda x, y: np.cross(x, y), a=S(2, 3), b=S(2, 3, seed=7))
+b2("kron", np.kron, a=S(2, 2), b=S(3, 2, seed=7), grad="jax")
+b2("dot", lambda x, y: np.dot(x, y), a=S(4), b=S(4, seed=7),
+   grad="jax")
+b2("mv", lambda m, v: m @ v, a=S(3, 4), b=S(4, seed=7), grad="jax")
+b2("bmm", np.matmul, a=S(2, 3, 4), b=S(2, 4, 2, seed=7),
+   grad="jax")
+b2("cross", lambda x, y: np.cross(x, y), a=S(2, 3),
+   b=S(2, 3, seed=7), grad="jax")
 SPECS["lerp"] = Spec(args=(S(2, 3), S(2, 3, seed=7), np.float32(0.3)),
                      call=lambda x, y, w: paddle.lerp(x, y, 0.3),
                      ref=lambda x, y, w: x + 0.3 * (y - x), grad=None)
@@ -593,7 +596,8 @@ def _chk_lu(out, a):
 
 
 SPECS["cholesky"] = Spec(args=(SPD(3),),
-                         ref=lambda a: np.linalg.cholesky(a), atol=1e-4)
+                         ref=lambda a: np.linalg.cholesky(a), atol=1e-4,
+                         grad="jax")
 SPECS["cholesky_solve"] = Spec(
     args=(S(3, 1), SPD(3)),
     call=lambda b, a: paddle.linalg.cholesky_solve(
@@ -603,9 +607,9 @@ SPECS["det"] = Spec(args=(SPD(3),), ref=np.linalg.det, atol=1e-3,
                     rtol=1e-3, grad="jax")
 SPECS["slogdet"] = Spec(
     args=(SPD(3),),
-    ref=lambda a: tuple(np.linalg.slogdet(a)), atol=1e-4)
+    ref=lambda a: tuple(np.linalg.slogdet(a)), atol=1e-4, grad="jax")
 SPECS["inverse"] = Spec(args=(SPD(3),), ref=np.linalg.inv, atol=1e-3,
-                        rtol=1e-3)
+                        rtol=1e-3, grad="jax")
 SPECS["matrix_power"] = Spec(args=(SPD(3),), kw={"n": 2},
                              ref=lambda a: a @ a, atol=1e-3, rtol=1e-3)
 SPECS["matrix_rank"] = Spec(
@@ -618,7 +622,7 @@ SPECS["multi_dot"] = Spec(
     ref=lambda a, b, c: a @ b @ c, atol=1e-4)
 SPECS["solve"] = Spec(args=(SPD(3), S(3, 2)),
                       ref=lambda a, b: np.linalg.solve(a, b), atol=1e-3,
-                      rtol=1e-3)
+                      rtol=1e-3, grad="jax")
 SPECS["triangular_solve"] = Spec(
     args=(np.triu(SPD(3)).astype(np.float32), S(3, 1)),
     kw={"upper": True},
@@ -736,7 +740,7 @@ SPECS["rms_norm"] = Spec(
     args=(S(2, 4), np.ones(4, np.float32)),
     call=lambda x, w: F.rms_norm(x, w, epsilon=1e-6),
     ref=lambda x, w: x / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-6),
-    atol=1e-4)
+    atol=1e-4, grad="jax")
 SPECS["group_norm"] = Spec(
     args=(S(2, 4, 2, 2),),
     call=lambda x: F.group_norm(x, num_groups=2, epsilon=1e-5),
@@ -813,7 +817,7 @@ SPECS["fractional_max_pool3d"] = Spec(
     check=lambda out, x: np.asarray(out[0]).shape == (1, 1, 2, 2, 2))
 SPECS["swiglu"] = Spec(
     args=(S(2, 4), S(2, 4, seed=7)),
-    ref=lambda x, y: (x * sps.expit(x)) * y, atol=1e-4)
+    ref=lambda x, y: (x * sps.expit(x)) * y, atol=1e-4, grad="jax")
 SPECS["gumbel_softmax"] = Spec(
     args=(S(4, 5),), kw={"hard": True},
     check=lambda out, x: np.allclose(np.asarray(out[0]).sum(-1), 1.0))
