@@ -17,7 +17,8 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["store.cpp", "datapath.cpp", "ckptio.cpp", "datafeed.cpp"]
+_SOURCES = ["store.cpp", "datapath.cpp", "ckptio.cpp", "datafeed.cpp",
+            "hosttracer.cpp"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -87,6 +88,19 @@ def load():
         lib.pt_file_read.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
             ctypes.c_int]
+        lib.pt_trace_enable.argtypes = [ctypes.c_int64]
+        lib.pt_trace_disable.argtypes = []
+        lib.pt_trace_record.restype = ctypes.c_int
+        lib.pt_trace_record.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.pt_trace_count.restype = ctypes.c_int64
+        lib.pt_trace_dropped.restype = ctypes.c_int64
+        lib.pt_trace_dump.restype = ctypes.c_int64
+        lib.pt_trace_dump.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pt_trace_drain.restype = ctypes.c_int64
+        lib.pt_trace_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pt_trace_clear.argtypes = []
         _lib = lib
         return _lib
 

@@ -1,0 +1,97 @@
+// Native host event tracer — the HostTracer analog.
+//
+// Re-design of the reference's native profiler collection path
+// (reference: paddle/fluid/platform/profiler/host_tracer.cc — RecordEvent
+// spans land in a native buffer without touching the Python allocator or
+// GIL-serialized list appends; the chrome-trace writer reads them out).
+//
+// Fixed-record ring: the hot path (pt_trace_record) takes one mutex'd
+// append of 32 bytes — called from any thread, including DataLoader
+// workers and the step timer. Python interns names to int32 ids and
+// rebuilds strings at dump time.
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+struct PtTraceEvent {
+  int64_t start_ns;
+  int64_t end_ns;
+  int64_t tid;
+  int32_t name_id;
+  int32_t type_id;
+};
+
+static std::vector<PtTraceEvent> g_events;
+static std::mutex g_mu;
+static bool g_enabled = false;
+static size_t g_capacity = 0;
+static int64_t g_dropped = 0;
+
+void pt_trace_enable(int64_t capacity) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_capacity = capacity > 0 ? static_cast<size_t>(capacity) : (1u << 20);
+  g_events.clear();
+  g_events.reserve(g_capacity < (1u << 16) ? g_capacity : (1u << 16));
+  g_dropped = 0;
+  g_enabled = true;
+}
+
+void pt_trace_disable() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_enabled = false;
+}
+
+int pt_trace_record(int32_t name_id, int32_t type_id, int64_t start_ns,
+                    int64_t end_ns, int64_t tid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_enabled) return 0;
+  if (g_events.size() >= g_capacity) {  // bounded: drop, count, report
+    ++g_dropped;
+    return -1;
+  }
+  g_events.push_back(PtTraceEvent{start_ns, end_ns, tid, name_id, type_id});
+  return 1;
+}
+
+int64_t pt_trace_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<int64_t>(g_events.size());
+}
+
+int64_t pt_trace_dropped() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return g_dropped;
+}
+
+// copy up to max events into out; returns the number copied
+int64_t pt_trace_dump(PtTraceEvent* out, int64_t max) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t n = static_cast<int64_t>(g_events.size());
+  if (n > max) n = max;
+  std::memcpy(out, g_events.data(),
+              static_cast<size_t>(n) * sizeof(PtTraceEvent));
+  return n;
+}
+
+// copy AND remove up to max events atomically (spans recorded while the
+// reader was busy stay queued for the next drain — no dump/clear gap)
+int64_t pt_trace_drain(PtTraceEvent* out, int64_t max) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t n = static_cast<int64_t>(g_events.size());
+  if (n > max) n = max;
+  std::memcpy(out, g_events.data(),
+              static_cast<size_t>(n) * sizeof(PtTraceEvent));
+  g_events.erase(g_events.begin(), g_events.begin() + n);
+  return n;
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.clear();
+  g_dropped = 0;
+}
+
+}  // extern "C"

@@ -45,16 +45,97 @@ class _Event:
 
 
 class _Collector:
-    """Host event buffer (the HostTracer analog)."""
+    """Host event buffer — the HostTracer analog. Spans land in the NATIVE
+    ring (_native/hosttracer.cpp: one mutex'd 32-byte append, no Python
+    allocator on the hot path, like the reference's host_tracer.cc) when
+    the toolchain built it; pure-python list otherwise."""
 
     def __init__(self):
         self.events: List[_Event] = []
         self.enabled = False
         self.lock = threading.Lock()
+        self._names: dict = {}        # name -> int32 id
+        self._names_rev: list = []
+        self._types: dict = {}
+        self._types_rev: list = []
+        self._native = None           # resolved lazily at first enable
+
+    def _lib(self):
+        if self._native is None:
+            from .. import _native
+            self._native = (_native.load(), )
+        return self._native[0]
+
+    def _intern(self, table, rev, s):
+        i = table.get(s)
+        if i is None:
+            i = table[s] = len(rev)
+            rev.append(s)
+        return i
+
+    def native_start(self, capacity=1 << 20):
+        lib = self._lib()
+        if lib is not None:
+            # preserve earlier record windows: drain the ring into the
+            # python list BEFORE enable resets it, and restart the intern
+            # tables together with the ring (ids restart from 0)
+            self.drain()
+            with self.lock:
+                self._names.clear()
+                self._names_rev.clear()
+                self._types.clear()
+                self._types_rev.clear()
+            lib.pt_trace_enable(capacity)
+
+    def native_stop(self):
+        lib = self._lib()
+        if lib is not None:
+            lib.pt_trace_disable()
 
     def add(self, ev: _Event):
+        lib = self._lib()
+        if lib is not None:
+            with self.lock:
+                nid = self._intern(self._names, self._names_rev, ev.name)
+                tid_ = self._intern(self._types, self._types_rev,
+                                    ev.event_type)
+            lib.pt_trace_record(nid, tid_, ev.start, ev.end, ev.tid)
+            return
         with self.lock:
             self.events.append(ev)
+
+    def drain(self) -> List[_Event]:
+        """events list + everything recorded natively (converted back).
+        Atomic against concurrent recording (pt_trace_drain removes only
+        what it copied) and serialized against concurrent drains."""
+        lib = self._lib()
+        if lib is None:
+            with self.lock:
+                return list(self.events)
+        import ctypes
+        import struct
+        with self.lock:
+            n = lib.pt_trace_count()
+            if n:
+                buf = (ctypes.c_int64 * (n * 4))()  # 32-byte records
+                got = lib.pt_trace_drain(ctypes.cast(
+                    buf, ctypes.c_void_p), n)
+                raw = memoryview(buf).cast("b")[:got * 32]
+                for i in range(got):
+                    s, e, t, nid, tyid = struct.unpack_from(
+                        "<qqqii", raw, i * 32)
+                    self.events.append(_Event(
+                        self._names_rev[nid], s, e, t,
+                        self._types_rev[tyid]))
+            dropped = lib.pt_trace_dropped()
+            if dropped:
+                import warnings
+                warnings.warn(
+                    f"profiler: native ring capacity reached — {dropped} "
+                    f"span(s) dropped; raise the window capacity or "
+                    f"shorten the RECORD window")
+                lib.pt_trace_clear()  # resets the drop counter
+            return list(self.events)
 
 
 _collector = _Collector()
@@ -171,6 +252,7 @@ class Profiler:
             jax.profiler.stop_trace()
             self._device_tracing = False
         _collector.enabled = False
+        _collector.native_stop()
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -193,7 +275,11 @@ class Profiler:
     def _apply_state(self):
         rec = self.current_state in (ProfilerState.RECORD,
                                      ProfilerState.RECORD_AND_RETURN)
+        was = _collector.enabled
         _collector.enabled = rec and not self._timer_only
+        if _collector.enabled and not was:
+            # transition edge only: pt_trace_enable resets the ring
+            _collector.native_start()
         if rec and not self._timer_only and not self._device_tracing and \
                 os.environ.get("PADDLE_TPU_DEVICE_TRACE"):
             self._device_trace_dir = os.environ.get(
@@ -213,7 +299,7 @@ class Profiler:
 
     # ---- results ----
     def events(self) -> List[_Event]:
-        return list(_collector.events)
+        return _collector.drain()
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit='ms'):
@@ -227,7 +313,7 @@ class Profiler:
 
     def _export_chrome(self, path: str):
         evs = []
-        for e in _collector.events:
+        for e in _collector.drain():
             evs.append({
                 "name": e.name, "ph": "X", "pid": os.getpid(),
                 "tid": e.tid, "ts": e.start / 1000.0,

@@ -333,3 +333,59 @@ class TestNativeDatafeed:
                 assert sa.dtype == sb.dtype
                 np.testing.assert_array_equal(
                     np.asarray(sa, np.float64), np.asarray(sb, np.float64))
+
+
+class TestNativeHostTracer:
+    """Native host event ring (_native/hosttracer.cpp — the reference
+    host_tracer.cc analog): multi-threaded spans land natively and drain
+    back with names/types intact."""
+
+    def test_multithreaded_record_and_drain(self):
+        import threading
+        import paddle_tpu.profiler as prof
+        from paddle_tpu.profiler.profiler import _collector
+        if _collector._lib() is None:
+            pytest.skip("native toolchain unavailable")
+        p = prof.Profiler()
+        p.start()
+
+        def work(tag):
+            for _ in range(50):
+                with prof.RecordEvent(tag):
+                    pass
+        ts = [threading.Thread(target=work, args=(f"t{i}",))
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        p.stop()
+        evs = p.events()
+        names = {}
+        for e in evs:
+            names[e.name] = names.get(e.name, 0) + 1
+        for i in range(4):
+            assert names.get(f"t{i}") == 50, names
+        tids = {e.tid for e in evs}
+        # thread idents can be reused after join; at least two distinct
+        # ids proves per-thread identity survives the native ring
+        assert len(tids) >= 2
+        assert all(e.end >= e.start for e in evs)
+
+    def test_capacity_bound_drops_not_grows(self):
+        import ctypes
+        from paddle_tpu import _native
+        lib = _native.load()
+        if lib is None:
+            pytest.skip("native toolchain unavailable")
+        lib.pt_trace_enable(8)
+        for i in range(20):
+            lib.pt_trace_record(0, 0, i, i + 1, 7)
+        assert lib.pt_trace_count() == 8
+        assert lib.pt_trace_dropped() == 12
+        buf = (ctypes.c_int64 * (8 * 4))()
+        got = lib.pt_trace_dump(ctypes.cast(buf, ctypes.c_void_p), 8)
+        assert got == 8
+        lib.pt_trace_clear()
+        lib.pt_trace_disable()
+        assert lib.pt_trace_count() == 0

@@ -144,3 +144,21 @@ class TestElasticManager:
         time.sleep(0.2)
         m._stop.set()
         assert hits
+
+
+def test_multi_window_events_accumulate():
+    """Scheduler with several RECORD windows: spans from EARLIER windows
+    must survive later windows' ring resets (native path drains first)."""
+    import time as _t
+    import paddle_tpu.profiler as prof
+    p = prof.Profiler(scheduler=prof.make_scheduler(
+        closed=1, ready=0, record=1, repeat=3))
+    p.start()
+    for i in range(6):
+        with prof.RecordEvent(f"w{i}"):
+            _t.sleep(0.001)
+        p.step()
+    p.stop()
+    names = {e.name for e in p.events()}
+    # record windows are steps 1, 3, 5 (closed=1/record=1 cycle)
+    assert {"w1", "w3", "w5"} <= names, names
