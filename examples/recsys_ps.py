@@ -1,0 +1,33 @@
+"""Parameter-server sparse-embedding training (reference workflow:
+fleet PS mode + sparse_embedding + QueueDataset), single-process loopback.
+
+Run: JAX_PLATFORMS=cpu PADDLE_RPC_REGISTRY=/tmp/ps_example \
+     PADDLE_JOB_ID=ex python examples/recsys_ps.py
+"""
+import os
+import numpy as np
+
+os.environ.setdefault("PADDLE_RPC_REGISTRY", "/tmp/ps_example")
+os.environ.setdefault("PADDLE_JOB_ID", "ex")
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.ps import PsServer, PsClient, TableConfig
+from paddle_tpu.distributed.ps.the_one_ps import sparse_embedding
+
+rpc.init_rpc("server0", rank=0, world_size=1)
+try:
+    # SSD tier: table bounded by disk, not RAM (kind="ssd")
+    PsServer([TableConfig(name="emb", dim=8, kind="ssd", optimizer="sgd",
+                          lr=0.1, cache_rows=256)])
+    client = PsClient(["server0"])
+    rng = np.random.default_rng(0)
+    for step in range(5):
+        ids = paddle.to_tensor(rng.integers(0, 10_000, (16,)))
+        feats = sparse_embedding(client, "emb", ids)     # pull
+        loss = (feats ** 2).mean()
+        loss.backward()                                  # push-on-backward
+        print(f"step {step}: loss={float(loss.numpy()):.5f} "
+              f"rows={client.table_size('emb')}")
+finally:
+    rpc.shutdown()

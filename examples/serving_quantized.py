@@ -1,0 +1,26 @@
+"""Weight-only int8 LLM serving in ~30 lines (reference workflow:
+paddle.inference + weight_only_linear fused kernels).
+
+Run: JAX_PLATFORMS=cpu python examples/serving_quantized.py
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama, generate as gen
+
+cfg = llama.LlamaConfig.tiny(num_layers=2, hidden_size=64, num_heads=4,
+                             num_kv_heads=4, intermediate_size=128,
+                             vocab_size=256)
+params = llama.init_params(jax.random.key(0), cfg)
+
+# one-call weight-only int8: per-channel scales, dequant fused into the
+# decode matmuls — halves weight HBM traffic on the bandwidth-bound
+# decode loop
+qparams = gen.quantize_weights(params, cfg)
+
+prompt = jnp.asarray(np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (2, 8)), jnp.int32)
+out = gen.generate(qparams, prompt, cfg, max_new_tokens=16,
+                   temperature=0.8, top_k=40, eos_token_id=None)
+print("generated:", np.asarray(out)[:, 8:])

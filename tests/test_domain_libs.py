@@ -262,3 +262,25 @@ def test_worker_info_non_generator_iter():
     loader = io.DataLoader(DS(), batch_size=2, num_workers=2)
     flat = [v for b in loader for v in np.asarray(b).ravel().tolist()]
     assert flat == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_examples_smoke(tmp_path):
+    """The examples/ scripts must stay runnable (same contract as the
+    benchmarks smoke)."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = root
+    env["PADDLE_RPC_REGISTRY"] = str(tmp_path)
+    env["PADDLE_JOB_ID"] = "ex_smoke"
+    for script in ("serving_quantized.py", "train_hybrid_3d.py",
+                   "recsys_ps.py"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "examples", script)],
+            env=env, text=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, timeout=300)
+        assert proc.returncode == 0, (script, proc.stdout[-1200:])
