@@ -27,12 +27,21 @@ __all__ = [
 
 @register("sequence_mask", tensor_method=False)
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
-    """reference: sequence_mask_kernel — mask[i, j] = j < x[i]."""
-    xv = raw(as_tensor(x))
-    m = int(maxlen) if maxlen is not None and maxlen > 0 \
-        else int(np.asarray(jax.device_get(xv)).max())
+    """reference: sequence_mask_kernel — mask[i, j] = j < x[i]. With an
+    explicit maxlen the lengths are a real op arg (recorded/replayable);
+    maxlen=None derives the static mask width from the data on the host."""
     from .._core import dtype as dtypes
+    from .._core.autograd import apply
     d = dtypes.convert_dtype(dtype) if dtype is not None else jnp.int32
+    if maxlen is not None and maxlen > 0:
+        m = int(maxlen)
+
+        def f(lv):
+            return (lax.broadcasted_iota(jnp.int32, lv.shape + (m,),
+                                         lv.ndim) < lv[..., None]).astype(d)
+        return apply(f, as_tensor(x), name="sequence_mask")
+    xv = raw(as_tensor(x))
+    m = int(np.asarray(jax.device_get(xv)).max())
     out = (lax.broadcasted_iota(jnp.int32, xv.shape + (m,), xv.ndim)
            < xv[..., None]).astype(d)
     return Tensor(out, _internal=True)
