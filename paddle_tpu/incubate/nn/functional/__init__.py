@@ -505,3 +505,388 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
                         jnp.finfo(jnp.float32).min)
         return jax.nn.softmax(s32, axis=-1).astype(xv.dtype)
     return apply(fn, as_tensor(x), name="softmax_mask_fuse_upper_triangle")
+
+
+# --------------------------------------------------------------------------
+# Serving-stack fused ops
+# --------------------------------------------------------------------------
+def _norm(x, scale, bias, eps, norm_type="layernorm"):
+    xv = x.astype(jnp.float32)
+    if norm_type == "rmsnorm":
+        out = xv * jax.lax.rsqrt(
+            jnp.mean(xv * xv, axis=-1, keepdims=True) + eps)
+    else:
+        mu = jnp.mean(xv, axis=-1, keepdims=True)
+        var = jnp.var(xv, axis=-1, keepdims=True)
+        out = (xv - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, residual_alpha=1.0, cache_kvs=None, beam_offset=None,
+        pre_caches=None, seq_lens=None, rotary_embs=None, time_step=None,
+        attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, norm_type="layernorm",
+        use_neox_rotary_style=False, gqa_group_size=-1, name=None):
+    """reference: incubate/nn/functional/fused_transformer.py:976
+    fused_multi_transformer / fused_multi_transformer_kernel.cu — the
+    whole serving transformer stack in one call, with static KV caches.
+
+    TPU-native: one jnp composition per layer; XLA fuses the LN/bias/act
+    chains into the matmuls. ``cache_kvs[i]``: [2, B, nh, max_seq, hd].
+    ``time_step`` (int/scalar) = decode position; None = context encode.
+    Returns (out, cache_kvs) when caches are given, else out.
+    """
+    xv = as_tensor(x)._value
+    B, S, E = xv.shape
+    L = len(qkv_weights)
+
+    def raw(t):
+        return None if t is None else as_tensor(t)._value
+
+    def pick(seq, i):
+        if seq is None:
+            return None
+        v = seq[i] if i < len(seq) else None
+        return None if v is None else as_tensor(v)._value
+
+    # exact (erf) gelu — the reference kernel's GeluFunctor, not the
+    # tanh approximation
+    exact_gelu = lambda t: jax.nn.gelu(t, approximate=False)
+    act = {"gelu": exact_gelu, "relu": jax.nn.relu,
+           "swiglu": None}.get(activation, exact_gelu)
+    step = None if time_step is None else int(
+        np.asarray(raw(time_step)).reshape(-1)[0]) if not isinstance(
+        time_step, int) else time_step
+    new_caches = []
+    h = xv
+    for i in range(L):
+        qkvw = raw(qkv_weights[i])
+        residual = h
+        z = _norm(h, pick(ln_scales, i), pick(ln_biases, i), epsilon,
+                  norm_type) if pre_layer_norm else h
+        if qkvw.ndim != 4:
+            raise ValueError(
+                "fused_multi_transformer: qkv_weights must be 4-D — "
+                "[3, nh, hd, E] with trans_qkvw=True (default) or "
+                "[E, 3, nh, hd] with trans_qkvw=False (a 2-D [E, 3E] "
+                "weight cannot encode the head split)")
+        if trans_qkvw:           # [3, nh, hd, E]
+            three, nh, hd, _ = qkvw.shape
+            qkv = z @ qkvw.reshape(3 * nh * hd, E).T.astype(z.dtype)
+        else:                    # [E, 3, nh, hd]
+            _, three, nh, hd = qkvw.shape
+            qkv = z @ qkvw.reshape(E, 3 * nh * hd).astype(z.dtype)
+        b = pick(qkv_biases, i)
+        if b is not None:
+            qkv = qkv + b.reshape(-1).astype(qkv.dtype)
+        qkv = qkv.reshape(B, S, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if rotary_embs is not None and rotary_emb_dims > 0:
+            rot = raw(rotary_embs)      # [2, B, 1, max_seq, hd]
+            pos0 = 0 if step is None else step
+            cos = jax.lax.dynamic_slice_in_dim(rot[0], pos0, S, axis=2)
+            sin = jax.lax.dynamic_slice_in_dim(rot[1], pos0, S, axis=2)
+            cos = jnp.moveaxis(cos, 2, 1)   # [B, S, 1, hd]
+            sin = jnp.moveaxis(sin, 2, 1)
+
+            def rope(t):
+                if use_neox_rotary_style:
+                    h1, h2 = jnp.split(t, 2, axis=-1)
+                    rot = jnp.concatenate([-h2, h1], axis=-1)
+                else:            # interleaved (GPT-J) pairs — the default
+                    te, to = t[..., 0::2], t[..., 1::2]
+                    rot = jnp.stack([-to, te], axis=-1).reshape(t.shape)
+                return t * cos.astype(t.dtype) + rot * sin.astype(t.dtype)
+            q, k = rope(q), rope(k)
+        if cache_kvs is not None:
+            cache = raw(cache_kvs[i])    # [2, B, nh, max_seq, hd]
+            kt = jnp.moveaxis(k, 1, 2)   # [B, nh, S, hd]
+            vt = jnp.moveaxis(v, 1, 2)
+            pos = 0 if step is None else step
+            ck = jax.lax.dynamic_update_slice_in_dim(cache[0], kt.astype(
+                cache.dtype), pos, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(cache[1], vt.astype(
+                cache.dtype), pos, axis=2)
+            new_caches.append(Tensor(jnp.stack([ck, cv]), _internal=True))
+            kk, vv = ck, cv
+            logits = jnp.einsum("bqhd,bhkd->bhqk",
+                                q.astype(jnp.float32),
+                                kk.astype(jnp.float32)) / math.sqrt(hd)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+            qpos = pos + jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                                  2)
+            logits = jnp.where(kpos <= qpos, logits, -1e30)
+            if attn_mask is not None:
+                # same contract as the no-cache branch: bool keeps, float
+                # adds; broadcast over [B, 1|nh, Sq, cache_len]
+                m = raw(attn_mask)
+                mw = m[..., :logits.shape[-1]]
+                if m.dtype == jnp.bool_:
+                    logits = jnp.where(mw, logits, -1e30)
+                else:
+                    logits = logits + mw.astype(jnp.float32)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bqhd", p.astype(vv.dtype), vv)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                q.astype(jnp.float32),
+                                k.astype(jnp.float32)) / math.sqrt(hd)
+            if attn_mask is not None:
+                m = raw(attn_mask)
+                if m.dtype == jnp.bool_:
+                    logits = jnp.where(m, logits, -1e30)
+                else:
+                    logits = logits + m.astype(jnp.float32)
+            else:
+                kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+                qpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+                logits = jnp.where(kpos <= qpos, logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        o = o.reshape(B, S, nh * hd)
+        lw = raw(linear_weights[i])
+        o = o @ lw.astype(o.dtype)
+        lb = pick(linear_biases, i)
+        if lb is not None:
+            o = o + lb.astype(o.dtype)
+        h = residual * residual_alpha + o
+        if not pre_layer_norm:
+            h = _norm(h, pick(ln_scales, i), pick(ln_biases, i), epsilon,
+                      norm_type)
+        # ffn
+        residual = h
+        z = _norm(h, pick(ffn_ln_scales, i), pick(ffn_ln_biases, i),
+                  epsilon, norm_type) if pre_layer_norm else h
+        f1 = z @ raw(ffn1_weights[i]).astype(z.dtype)
+        f1b = pick(ffn1_biases, i)
+        if f1b is not None:
+            f1 = f1 + f1b.astype(f1.dtype)
+        if activation == "swiglu":
+            g, u = jnp.split(f1, 2, axis=-1)
+            f1 = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
+        else:
+            f1 = act(f1.astype(jnp.float32)).astype(f1.dtype)
+        f2 = f1 @ raw(ffn2_weights[i]).astype(f1.dtype)
+        f2b = pick(ffn2_biases, i)
+        if f2b is not None:
+            f2 = f2 + f2b.astype(f2.dtype)
+        h = residual * residual_alpha + f2
+        if not pre_layer_norm:
+            h = _norm(h, pick(ffn_ln_scales, i), pick(ffn_ln_biases, i),
+                      epsilon, norm_type)
+    out = Tensor(h, _internal=True)
+    return (out, new_caches) if cache_kvs is not None else out
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    """reference: incubate/nn/functional/blha_get_max_len.py — max
+    encoder/decoder lengths for block attention planning."""
+    enc = as_tensor(seq_lens_encoder)._value
+    dec = as_tensor(seq_lens_decoder)._value
+    return (Tensor(jnp.max(enc).reshape(1), _internal=True),
+            Tensor(jnp.max(dec).reshape(1), _internal=True))
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets=None, cum_offsets=None,
+        cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
+        pre_key_cache=None, pre_value_cache=None, rope_emb=None, mask=None,
+        tgt_mask=None, max_seq_len=-1, block_size=64, use_neox_style=False,
+        qkv_bias=None, out_shift=None, out_smooth=None,
+        max_enc_len_this_time=None, max_dec_len_this_time=None, **_):
+    """reference: incubate/nn/functional/block_multihead_attention.py /
+    block_multi_head_attention_kernel.cu — PAGED-kv-cache attention: each
+    sequence's cache lives in `block_size`-row pages addressed through
+    ``block_tables`` (vLLM-style), mixing prefill rows and decode rows in
+    one varlen token batch.
+
+    TPU-native correctness path (jnp; the Pallas decode kernel covers the
+    contiguous-cache hot loop): per-row gather of the page list ->
+    contiguous K/V -> masked attention. Shapes:
+      qkv            [total_tokens, 3*nh*hd]
+      key/value_cache[num_blocks, nh, block_size, hd]
+      block_tables   [B, max_blocks_per_seq] (-1 padded)
+    Returns (out [total_tokens, nh*hd], qkv, key_cache, value_cache).
+    """
+    if pre_key_cache is not None or pre_value_cache is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: pre_key/value_cache (prompt "
+            "prefix cache) is not supported on this path")
+    qv = as_tensor(qkv)._value
+    kc = as_tensor(key_cache)._value
+    vc = as_tensor(value_cache)._value
+    enc = np.asarray(as_tensor(seq_lens_encoder)._value)
+    dec = np.asarray(as_tensor(seq_lens_decoder)._value)
+    this = np.asarray(as_tensor(seq_lens_this_time)._value)
+    bt = np.asarray(as_tensor(block_tables)._value)
+    if qkv_bias is not None:
+        qv = qv + as_tensor(qkv_bias)._value.reshape(-1)
+    nh, bs, hd = kc.shape[1], kc.shape[2], kc.shape[3]
+    B = bt.shape[0]
+    total = qv.shape[0]
+    q3 = qv.reshape(total, 3, nh, hd)
+    outs = []
+    tok = 0
+    for b in range(B):
+        t = int(this[b])
+        if t == 0:
+            continue
+        q = q3[tok:tok + t, 0]
+        k_new = q3[tok:tok + t, 1]
+        v_new = q3[tok:tok + t, 2]
+        start = int(dec[b])          # existing cache length (decode rows)
+        if int(enc[b]) > 0:
+            start = 0                # prefill writes from position 0
+        if rope_emb is not None:
+            rot = as_tensor(rope_emb)._value   # [2, 1|B, 1, max_seq, hd]
+            rb = rot[:, b] if rot.shape[1] > 1 else rot[:, 0]
+            cos = rb[0, 0, start:start + t][:, None, :]
+            sin = rb[1, 0, start:start + t][:, None, :]
+
+            def rope_t(tn):
+                if use_neox_style:
+                    h1, h2 = jnp.split(tn, 2, axis=-1)
+                    r = jnp.concatenate([-h2, h1], axis=-1)
+                else:
+                    te, to = tn[..., 0::2], tn[..., 1::2]
+                    r = jnp.stack([-to, te], axis=-1).reshape(tn.shape)
+                return tn * cos.astype(tn.dtype) + r * sin.astype(tn.dtype)
+            q, k_new = rope_t(q), rope_t(k_new)
+        # ONE vectorized page scatter for this row's tokens
+        pos = start + np.arange(t)
+        pages = jnp.asarray(bt[b, pos // bs].astype(np.int32))
+        rows = jnp.asarray((pos % bs).astype(np.int32))
+        kc = kc.at[pages, :, rows].set(k_new.astype(kc.dtype))
+        vc = vc.at[pages, :, rows].set(v_new.astype(vc.dtype))
+        kl = start + t
+        npages = (kl + bs - 1) // bs
+        pages = [int(bt[b, p]) for p in range(npages)]
+        ks = jnp.concatenate([kc[p] for p in pages], axis=1)[:, :kl]
+        vs = jnp.concatenate([vc[p] for p in pages], axis=1)[:, :kl]
+        logits = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32),
+                            ks.astype(jnp.float32)) / math.sqrt(hd)
+        qpos = start + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+        logits = jnp.where(kpos <= qpos, logits, -1e30)
+        if mask is not None:
+            mv = as_tensor(mask)._value    # [B, 1, Smax, Smax]-broadcast
+            mb = mv[b if mv.shape[0] > 1 else 0]
+            mb = mb[..., start:start + t, :kl].astype(jnp.float32)
+            logits = logits + mb
+        p = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("hqk,hkd->qhd", p.astype(vs.dtype), vs)
+        outs.append(o.reshape(t, nh * hd))
+        tok += t
+    out = jnp.concatenate(outs, axis=0) if outs else \
+        jnp.zeros((0, nh * hd), qv.dtype)
+    return (Tensor(out, _internal=True), Tensor(qv, _internal=True),
+            Tensor(kc, _internal=True), Tensor(vc, _internal=True))
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, scaling_factor=None,
+                                dropout_p=0.0, is_causal=False,
+                                training=False, name=None, **_):
+    """reference: incubate/nn/functional/fused_dot_product_attention.py —
+    cuDNN fused SDPA; here the flash/sdpa path (Pallas on TPU)."""
+    from ....nn.functional.attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """reference: incubate/nn/functional/
+    variable_length_memory_efficient_attention.py — varlen attention with
+    per-sequence lengths. q/k/v: [B, nh, S, hd]; seq_lens [B, 1]."""
+    qv = as_tensor(query)._value
+    kv = as_tensor(key)._value
+    vv = as_tensor(value)._value
+    ql = as_tensor(seq_lens)._value.reshape(-1)
+    kl = as_tensor(kv_seq_lens)._value.reshape(-1)
+    hd = qv.shape[-1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qv.astype(jnp.float32),
+                        kv.astype(jnp.float32)) * sc
+    qpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+    valid = (qpos < ql[:, None, None, None]) & \
+        (kpos < kl[:, None, None, None])
+    if causal:
+        valid = valid & (kpos <= qpos)
+    if mask is not None:
+        m = as_tensor(mask)._value
+        logits = logits + m.astype(jnp.float32)
+    logits = jnp.where(valid, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(valid, p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vv.dtype), vv)
+    return Tensor(out, _internal=True)
+
+
+def fused_gate_attention(query, key=None, query_weight=None,
+                         key_weight=None, value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False, name=None):
+    """reference: incubate/nn/functional fused_gate_attention
+    (AlphaFold-style gated attention, fused_gate_attention_kernel).
+    query: [B, M, S, E]; qkv_weight: [3, nh, hd, E] when merge_qkv."""
+    qv = as_tensor(query)._value
+
+    def raw(t):
+        return None if t is None else as_tensor(t)._value
+    if merge_qkv:
+        w = raw(qkv_weight)          # [3, nh, hd, E]
+        three, nh, hd, E = w.shape
+        qkv = jnp.einsum("bmse,cnde->bmscnd", qv, w)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+    else:
+        kv = as_tensor(key)._value
+        qw, kw, vw = raw(query_weight), raw(key_weight), raw(value_weight)
+        # per-projection weights: [E, nh, hd]
+        q = jnp.einsum("bmse,end->bmsnd", qv, qw)
+        k = jnp.einsum("bmse,end->bmsnd", kv, kw)
+        v = jnp.einsum("bmse,end->bmsnd", kv, vw)
+    logits = jnp.einsum("bmsnd,bmtnd->bmnst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(q.shape[-1])
+    if nonbatched_bias is not None:
+        logits = logits + raw(nonbatched_bias).astype(jnp.float32)[:, None]
+    if attn_mask is not None:
+        m = raw(attn_mask)
+        logits = logits + (1.0 - m.astype(jnp.float32)) * -1e9
+    p = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bmnst,bmtnd->bmsnd", p.astype(v.dtype), v)
+    if has_gating and gate_linear_weight is not None:
+        gw = raw(gate_linear_weight)      # [E, nh, hd]
+        g = jnp.einsum("bmse,end->bmsnd", qv, gw)
+        if gate_linear_bias is not None:
+            g = g + raw(gate_linear_bias).astype(g.dtype)
+        o = o * jax.nn.sigmoid(g.astype(jnp.float32)).astype(o.dtype)
+    ow = raw(out_linear_weight)           # [nh, hd, E]
+    out = jnp.einsum("bmsnd,nde->bmse", o, ow)
+    if out_linear_bias is not None:
+        out = out + raw(out_linear_bias).astype(out.dtype)
+    return Tensor(out, _internal=True)
+
+
+import numpy as np  # noqa: E402 — used by fused_multi_transformer
+
+__all__ += ["fused_multi_transformer", "block_multihead_attention",
+            "blha_get_max_len", "fused_dot_product_attention",
+            "variable_length_memory_efficient_attention",
+            "fused_gate_attention"]

@@ -1,0 +1,319 @@
+"""Serving-stack fused ops (reference: fused_multi_transformer_kernel.cu,
+block_multi_head_attention_kernel.cu, blha_get_max_len,
+fused_dot_product_attention, variable_length_memory_efficient_attention,
+fused_gate_attention) — each verified against an explicit composition /
+numpy oracle.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _r(*shape, seed=0, scale=0.3):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+class TestFusedMultiTransformer:
+    def _params(self, L=2, E=16, nh=2, ffn=32, seed=0):
+        rs = np.random.RandomState(seed)
+        hd = E // nh
+        mk = lambda *s: (rs.randn(*s) * 0.3).astype(np.float32)
+        return {
+            "ln_s": [_t(np.ones(E, np.float32)) for _ in range(L)],
+            "ln_b": [_t(np.zeros(E, np.float32)) for _ in range(L)],
+            "qkv_w": [_t(mk(3, nh, hd, E)) for _ in range(L)],
+            "qkv_b": [_t(mk(3 * nh * hd)) for _ in range(L)],
+            "lin_w": [_t(mk(E, E)) for _ in range(L)],
+            "lin_b": [_t(mk(E)) for _ in range(L)],
+            "fln_s": [_t(np.ones(E, np.float32)) for _ in range(L)],
+            "fln_b": [_t(np.zeros(E, np.float32)) for _ in range(L)],
+            "f1_w": [_t(mk(E, ffn)) for _ in range(L)],
+            "f1_b": [_t(mk(ffn)) for _ in range(L)],
+            "f2_w": [_t(mk(ffn, E)) for _ in range(L)],
+            "f2_b": [_t(mk(E)) for _ in range(L)],
+        }
+
+    def _manual(self, x, p, L=2, E=16, nh=2):
+        """Explicit pre-LN GPT block stack (the docstring contract)."""
+        hd = E // nh
+        h = x.astype(np.float64)
+        for i in range(L):
+            res = h
+            mu, var = h.mean(-1, keepdims=True), h.var(-1, keepdims=True)
+            z = (h - mu) / np.sqrt(var + 1e-5)
+            w = np.asarray(p["qkv_w"][i].numpy()).reshape(3 * nh * hd, E)
+            qkv = z @ w.T + np.asarray(p["qkv_b"][i].numpy())
+            B, S = x.shape[:2]
+            qkv = qkv.reshape(B, S, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            logits = np.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+            mask = np.tril(np.ones((S, S), bool))
+            logits = np.where(mask, logits, -1e30)
+            pr = np.exp(logits - logits.max(-1, keepdims=True))
+            pr = pr / pr.sum(-1, keepdims=True)
+            o = np.einsum("bhqk,bkhd->bqhd", pr, v).reshape(B, S, E)
+            o = o @ np.asarray(p["lin_w"][i].numpy()) + np.asarray(
+                p["lin_b"][i].numpy())
+            h = res + o
+            res = h
+            mu, var = h.mean(-1, keepdims=True), h.var(-1, keepdims=True)
+            z = (h - mu) / np.sqrt(var + 1e-5)
+            f1 = z @ np.asarray(p["f1_w"][i].numpy()) + np.asarray(
+                p["f1_b"][i].numpy())
+            from scipy.stats import norm
+            g = f1 * norm.cdf(f1)           # exact gelu
+            f2 = g @ np.asarray(p["f2_w"][i].numpy()) + np.asarray(
+                p["f2_b"][i].numpy())
+            h = res + f2
+        return h
+
+    def test_context_matches_manual(self):
+        E, nh, L = 16, 2, 2
+        p = self._params(L, E, nh)
+        x = _r(2, 5, E, seed=9)
+        out = F.fused_multi_transformer(
+            _t(x), p["ln_s"], p["ln_b"], p["qkv_w"], p["qkv_b"],
+            p["lin_w"], p["lin_b"], p["fln_s"], p["fln_b"],
+            p["f1_w"], p["f1_b"], p["f2_w"], p["f2_b"],
+            pre_layer_norm=True, activation="gelu")
+        want = self._manual(x, p, L, E, nh)
+        np.testing.assert_allclose(np.asarray(out.numpy(), np.float64),
+                                   want, atol=2e-4, rtol=2e-3)
+
+    def test_cache_decode_matches_full_recompute(self):
+        """prefill(time_step=None) then decode(time_step=S) must equal the
+        full-context forward on the concatenated sequence."""
+        E, nh, L, hd = 16, 2, 2, 8
+        p = self._params(L, E, nh, seed=3)
+        B, S, maxlen = 2, 4, 8
+        x = _r(B, S, E, seed=11)
+        nxt = _r(B, 1, E, seed=12)
+        caches = [_t(np.zeros((2, B, nh, maxlen, hd), np.float32))
+                  for _ in range(L)]
+        args = (p["ln_s"], p["ln_b"], p["qkv_w"], p["qkv_b"],
+                p["lin_w"], p["lin_b"], p["fln_s"], p["fln_b"],
+                p["f1_w"], p["f1_b"], p["f2_w"], p["f2_b"])
+        out1, caches = F.fused_multi_transformer(
+            _t(x), *args, pre_layer_norm=True, cache_kvs=caches,
+            time_step=None)
+        out2, caches = F.fused_multi_transformer(
+            _t(nxt), *args, pre_layer_norm=True, cache_kvs=caches,
+            time_step=S)
+        full = F.fused_multi_transformer(
+            _t(np.concatenate([x, nxt], 1)), *args, pre_layer_norm=True)
+        np.testing.assert_allclose(
+            np.asarray(out2.numpy())[:, 0],
+            np.asarray(full.numpy())[:, -1], atol=2e-4, rtol=2e-3)
+
+
+class TestBlockAttention:
+    def test_paged_mixed_batch_matches_dense(self):
+        nh, hd, bs = 2, 8, 4
+        B, nblocks = 2, 8
+        rs = np.random.RandomState(0)
+        kc = np.zeros((nblocks, nh, bs, hd), np.float32)
+        vc = np.zeros((nblocks, nh, bs, hd), np.float32)
+        block_tables = np.array([[0, 1, -1, -1], [2, 3, -1, -1]], np.int32)
+        # row 0: prefill of 5 tokens; row 1: decode (3 cached + 1 new)
+        dec_len = 3
+        kd = (rs.randn(dec_len, nh, hd) * 0.5).astype(np.float32)
+        vd = (rs.randn(dec_len, nh, hd) * 0.5).astype(np.float32)
+        for j in range(dec_len):
+            kc[2 + j // bs, :, j % bs] = kd[j]
+            vc[2 + j // bs, :, j % bs] = vd[j]
+        enc = np.array([5, 0], np.int32)
+        dec = np.array([0, dec_len], np.int32)
+        this = np.array([5, 1], np.int32)
+        total = int(this.sum())
+        qkv = (rs.randn(total, 3 * nh * hd) * 0.5).astype(np.float32)
+        out, _, kc2, vc2 = F.block_multihead_attention(
+            _t(qkv), _t(kc), _t(vc), _t(enc), _t(dec), _t(this),
+            block_tables=_t(block_tables), block_size=bs)
+        got = np.asarray(out.numpy())
+
+        q3 = qkv.reshape(total, 3, nh, hd)
+
+        def dense(q, ks, vs, qpos0):
+            logits = np.einsum("qhd,khd->hqk", q, ks) / math.sqrt(hd)
+            qpos = qpos0 + np.arange(q.shape[0])[None, :, None]
+            kpos = np.arange(ks.shape[0])[None, None, :]
+            logits = np.where(kpos <= qpos, logits, -1e30)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            return np.einsum("hqk,khd->qhd", p, vs).reshape(-1, nh * hd)
+
+        # row 0 (prefill): keys are its own 5 tokens
+        w0 = dense(q3[:5, 0], q3[:5, 1], q3[:5, 2], 0)
+        np.testing.assert_allclose(got[:5], w0, atol=1e-4)
+        # row 1 (decode): the 3 cached tokens + the new one
+        ks = np.concatenate([kd, q3[5:6, 1]], 0)
+        vs = np.concatenate([vd, q3[5:6, 2]], 0)
+        w1 = dense(q3[5:6, 0], ks, vs, dec_len)
+        np.testing.assert_allclose(got[5:6], w1, atol=1e-4)
+        # the new K/V landed in row 1's pages
+        np.testing.assert_allclose(
+            np.asarray(kc2.numpy())[2, :, dec_len], q3[5, 1], atol=1e-6)
+
+    def test_blha_get_max_len(self):
+        me, md = F.blha_get_max_len(_t(np.array([3, 7], np.int32)),
+                                    _t(np.array([5, 2], np.int32)))
+        assert int(me.numpy()[0]) == 7 and int(md.numpy()[0]) == 5
+
+
+class TestVarlenAndGate:
+    def test_variable_length_attention_masks_lengths(self):
+        B, nh, S, hd = 2, 2, 6, 8
+        rs = np.random.RandomState(1)
+        q = (rs.randn(B, nh, S, hd) * 0.5).astype(np.float32)
+        k = (rs.randn(B, nh, S, hd) * 0.5).astype(np.float32)
+        v = (rs.randn(B, nh, S, hd) * 0.5).astype(np.float32)
+        ql = np.array([[4], [6]], np.int32)
+        kl = np.array([[4], [6]], np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            _t(q), _t(k), _t(v), _t(ql), _t(kl))
+        got = np.asarray(out.numpy())
+        for b in range(B):
+            L = int(ql[b, 0])
+            logits = np.einsum("hqd,hkd->hqk", q[b, :, :L],
+                               k[b, :, :L]) / math.sqrt(hd)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            want = np.einsum("hqk,hkd->hqd", p, v[b, :, :L])
+            np.testing.assert_allclose(got[b, :, :L], want, atol=1e-4)
+
+    def test_fused_dot_product_attention_matches_sdpa(self):
+        import paddle_tpu.nn.functional as NF
+        rs = np.random.RandomState(2)
+        q = _t((rs.randn(1, 4, 2, 8) * 0.5).astype(np.float32))
+        k = _t((rs.randn(1, 4, 2, 8) * 0.5).astype(np.float32))
+        v = _t((rs.randn(1, 4, 2, 8) * 0.5).astype(np.float32))
+        a = F.fused_dot_product_attention(q, k, v, is_causal=True)
+        b = NF.scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(a.numpy()),
+                                   np.asarray(b.numpy()), atol=1e-6)
+
+    def test_fused_gate_attention_gating_and_bias(self):
+        B, M, S, E, nh = 1, 2, 3, 8, 2
+        hd = E // nh
+        rs = np.random.RandomState(3)
+        x = (rs.randn(B, M, S, E) * 0.5).astype(np.float32)
+        qkvw = (rs.randn(3, nh, hd, E) * 0.5).astype(np.float32)
+        gw = (rs.randn(E, nh, hd) * 0.5).astype(np.float32)
+        gb = (rs.randn(nh, hd) * 0.1).astype(np.float32)
+        ow = (rs.randn(nh, hd, E) * 0.5).astype(np.float32)
+        ob = (rs.randn(E) * 0.1).astype(np.float32)
+        out = F.fused_gate_attention(
+            _t(x), qkv_weight=_t(qkvw), gate_linear_weight=_t(gw),
+            gate_linear_bias=_t(gb), out_linear_weight=_t(ow),
+            out_linear_bias=_t(ob), merge_qkv=True, has_gating=True)
+        # manual composition
+        qkv = np.einsum("bmse,cnde->bmscnd", x, qkvw)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        logits = np.einsum("bmsnd,bmtnd->bmnst", q, k) / math.sqrt(hd)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        o = np.einsum("bmnst,bmtnd->bmsnd", p, v)
+        g = np.einsum("bmse,end->bmsnd", x, gw) + gb
+        o = o / (1 + np.exp(-g)) if False else o * (1 / (1 + np.exp(-g)))
+        want = np.einsum("bmsnd,nde->bmse", o, ow) + ob
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   atol=1e-4)
+
+
+class TestFusedServingEdgeCases:
+    def test_trans_qkvw_false_layout(self):
+        """[E, 3, nh, hd] layout (trans_qkvw=False) must equal the
+        transposed default layout."""
+        E, nh, hd, L = 16, 2, 8, 1
+        rs = np.random.RandomState(5)
+        w_t = (rs.randn(3, nh, hd, E) * 0.3).astype(np.float32)
+        w_f = w_t.reshape(3 * nh * hd, E).T.reshape(E, 3, nh, hd)
+        x = _r(2, 3, E, seed=6)
+        zeros = [_t(np.zeros(E, np.float32))]
+        ones = [_t(np.ones(E, np.float32))]
+        common = dict(pre_layer_norm=True, activation="relu")
+        mk = lambda *s: [_t((rs.randn(*s) * 0.0).astype(np.float32))]
+        lin = [_t(np.eye(E, dtype=np.float32))]
+        f1 = [_t(np.zeros((E, 8), np.float32))]
+        f2 = [_t(np.zeros((8, E), np.float32))]
+        a = F.fused_multi_transformer(
+            _t(x), ones, zeros, [_t(w_t)], mk(3 * nh * hd), lin, mk(E),
+            ones, zeros, f1, mk(8), f2, mk(E), trans_qkvw=True, **common)
+        b = F.fused_multi_transformer(
+            _t(x), ones, zeros, [_t(w_f)], mk(3 * nh * hd), lin, mk(E),
+            ones, zeros, f1, mk(8), f2, mk(E), trans_qkvw=False, **common)
+        np.testing.assert_allclose(np.asarray(a.numpy()),
+                                   np.asarray(b.numpy()), atol=1e-5)
+
+    def test_2d_qkv_weight_raises_clearly(self):
+        E = 8
+        ones = [_t(np.ones(E, np.float32))]
+        zeros = [_t(np.zeros(E, np.float32))]
+        with pytest.raises(ValueError, match="4-D"):
+            F.fused_multi_transformer(
+                _t(_r(1, 2, E)), ones, zeros,
+                [_t(_r(E, 3 * E))], [None], [_t(np.eye(E, dtype=np.float32))],
+                [None], ones, zeros, [_t(_r(E, 8))], [None],
+                [_t(_r(8, E))], [None])
+
+    def test_cache_branch_honors_attn_mask(self):
+        """A float -inf mask over pad keys must change the cache-branch
+        output (it used to be silently ignored)."""
+        E, nh, hd, L = 16, 2, 8, 1
+        p_ = np.random.RandomState(7)
+        mk = lambda *s: [_t((p_.randn(*s) * 0.3).astype(np.float32))]
+        ones = [_t(np.ones(E, np.float32))]
+        zeros = [_t(np.zeros(E, np.float32))]
+        args = (ones, zeros, mk(3, nh, hd, E), mk(3 * nh * hd),
+                mk(E, E), mk(E), ones, zeros, mk(E, 8), mk(8),
+                mk(8, E), mk(E))
+        x = _r(1, 4, E, seed=8)
+        caches = [_t(np.zeros((2, 1, nh, 8, hd), np.float32))]
+        neg = np.zeros((1, 1, 4, 8), np.float32)
+        neg[..., 2:4] = -1e30          # mask keys 2..3
+        out_m, _ = F.fused_multi_transformer(
+            _t(x), *args, cache_kvs=list(caches), attn_mask=_t(neg))
+        out_u, _ = F.fused_multi_transformer(
+            _t(x), *args, cache_kvs=list(caches))
+        assert not np.allclose(np.asarray(out_m.numpy()),
+                               np.asarray(out_u.numpy()))
+
+    def test_block_attention_rope_changes_output(self):
+        nh, hd, bs = 2, 8, 4
+        kc = np.zeros((4, nh, bs, hd), np.float32)
+        vc = np.zeros((4, nh, bs, hd), np.float32)
+        bt = np.array([[0, 1]], np.int32)
+        enc = np.array([3], np.int32)
+        dec = np.array([0], np.int32)
+        this = np.array([3], np.int32)
+        qkv = _r(3, 3 * nh * hd, seed=9, scale=0.5)
+        rope = np.stack([np.cos(np.linspace(0, 1, 8 * hd)),
+                         np.sin(np.linspace(0, 1, 8 * hd))]).reshape(
+            2, 1, 1, 8, hd).astype(np.float32)
+        out_r, _, _, _ = F.block_multihead_attention(
+            _t(qkv), _t(kc), _t(vc), _t(enc), _t(dec), _t(this),
+            block_tables=_t(bt), block_size=bs, rope_emb=_t(rope))
+        out_n, _, _, _ = F.block_multihead_attention(
+            _t(qkv), _t(kc), _t(vc), _t(enc), _t(dec), _t(this),
+            block_tables=_t(bt), block_size=bs)
+        assert not np.allclose(np.asarray(out_r.numpy()),
+                               np.asarray(out_n.numpy()))
+
+    def test_block_attention_pre_cache_raises(self):
+        with pytest.raises(NotImplementedError, match="pre_key"):
+            F.block_multihead_attention(
+                _t(_r(1, 48)), _t(np.zeros((1, 2, 4, 8), np.float32)),
+                _t(np.zeros((1, 2, 4, 8), np.float32)),
+                _t(np.array([1], np.int32)), _t(np.array([0], np.int32)),
+                _t(np.array([1], np.int32)),
+                block_tables=_t(np.array([[0]], np.int32)),
+                pre_key_cache=_t(np.zeros((1,), np.float32)))
