@@ -116,3 +116,142 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedLinear(Layer):
+    """reference: incubate/nn/layer/fused_linear.py:26 — Linear backed by
+    the fused matmul+bias op."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.fused_matmul_bias(x, self.weight, self.bias,
+                                   transpose_y=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: incubate/nn/layer/fused_dropout_add.py:26 —
+    y = dropout(x) + residual in one fused op."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                   mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py:94 —
+    out = LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py:1071 — the
+    serving transformer stack as ONE layer holding per-layer param lists,
+    forwarding through functional.fused_multi_transformer (static KV
+    caches, prefill/decode via time_step)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, residual_alpha=1.0,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
+                 norm_type="layernorm", use_neox_rotary_style=False,
+                 gqa_group_size=-1, name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self._epsilon = epsilon
+        self._residual_alpha = residual_alpha
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self._trans_qkvw = trans_qkvw
+        self._norm_type = norm_type
+        self._neox = use_neox_rotary_style
+        hd = embed_dim // num_heads
+        mk, mkb = self.create_parameter, \
+            lambda s: self.create_parameter(s, is_bias=True)
+        one = Constant(1.0)
+        self.ln_scales = [mk([embed_dim], default_initializer=one)
+                          for _ in range(num_layers)]
+        self.ln_biases = [mkb([embed_dim]) for _ in range(num_layers)]
+        self.qkv_weights = [mk([3, num_heads, hd, embed_dim])
+                            for _ in range(num_layers)]
+        self.qkv_biases = [mkb([3 * num_heads * hd])
+                           for _ in range(num_layers)]
+        self.linear_weights = [mk([embed_dim, embed_dim])
+                               for _ in range(num_layers)]
+        self.linear_biases = [mkb([embed_dim]) for _ in range(num_layers)]
+        self.ffn_ln_scales = [mk([embed_dim], default_initializer=one)
+                              for _ in range(num_layers)]
+        self.ffn_ln_biases = [mkb([embed_dim]) for _ in range(num_layers)]
+        self.ffn1_weights = [mk([embed_dim, dim_feedforward])
+                             for _ in range(num_layers)]
+        self.ffn1_biases = [mkb([dim_feedforward])
+                            for _ in range(num_layers)]
+        self.ffn2_weights = [mk([dim_feedforward, embed_dim])
+                             for _ in range(num_layers)]
+        self.ffn2_biases = [mkb([embed_dim]) for _ in range(num_layers)]
+        # register list params under stable names
+        for attr in ("ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
+                     "linear_weights", "linear_biases", "ffn_ln_scales",
+                     "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
+                     "ffn2_weights", "ffn2_biases"):
+            for i, pp in enumerate(getattr(self, attr)):
+                self.add_parameter(f"{attr}_{i}", pp)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self._epsilon,
+            residual_alpha=self._residual_alpha, cache_kvs=caches,
+            pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, rotary_emb_dims=rotary_emb_dims,
+            activation=self.activation, training=self.training,
+            trans_qkvw=self._trans_qkvw, norm_type=self._norm_type,
+            use_neox_rotary_style=self._neox)

@@ -317,3 +317,54 @@ class TestFusedServingEdgeCases:
                 _t(np.array([1], np.int32)),
                 block_tables=_t(np.array([[0]], np.int32)),
                 pre_key_cache=_t(np.zeros((1,), np.float32)))
+
+
+class TestFusedLayers:
+    def test_fused_multi_transformer_layer_decode_flow(self):
+        import paddle_tpu.incubate.nn as inn
+        import jax
+        paddle.seed(11)
+        net = inn.FusedMultiTransformer(embed_dim=16, num_heads=2,
+                                        dim_feedforward=32, num_layers=2)
+        B, S, maxlen, hd = 2, 4, 8, 8
+        x = _t(_r(B, S, 16, seed=20))
+        caches = [_t(np.zeros((2, B, 2, maxlen, hd), np.float32))
+                  for _ in range(2)]
+        out, caches = net(x, caches=caches)
+        nxt = _t(_r(B, 1, 16, seed=21))
+        out2, caches = net(nxt, caches=caches, time_step=S)
+        full = net(_t(np.concatenate([np.asarray(x.numpy()),
+                                      np.asarray(nxt.numpy())], 1)))
+        np.testing.assert_allclose(np.asarray(out2.numpy())[:, 0],
+                                   np.asarray(full.numpy())[:, -1],
+                                   atol=2e-4, rtol=2e-3)
+        # all per-layer params registered (12 lists x 2 layers)
+        assert len(list(net.parameters())) == 24
+
+    def test_fused_linear_and_dropout_add(self):
+        import paddle_tpu.incubate.nn as inn
+        lin = inn.FusedLinear(4, 3)
+        x = _t(_r(2, 4, seed=22))
+        out = lin(x)
+        want = np.asarray(x.numpy()) @ np.asarray(lin.weight.numpy()) + \
+            np.asarray(lin.bias.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   atol=1e-5)
+        da = inn.FusedDropoutAdd(p=0.0)
+        a, b = _t(_r(2, 4, seed=23)), _t(_r(2, 4, seed=24))
+        np.testing.assert_allclose(np.asarray(da(a, b).numpy()),
+                                   np.asarray(a.numpy()) +
+                                   np.asarray(b.numpy()), atol=1e-6)
+
+    def test_bias_dropout_residual_ln(self):
+        import paddle_tpu.incubate.nn as inn
+        m = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        m.eval()
+        x, r = _t(_r(2, 8, seed=25)), _t(_r(2, 8, seed=26))
+        out = np.asarray(m(x, r).numpy())
+        pre = np.asarray(x.numpy()) + np.asarray(
+            m.linear_bias.numpy()) + np.asarray(r.numpy())
+        mu = pre.mean(-1, keepdims=True)
+        var = pre.var(-1, keepdims=True)
+        want = (pre - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, want, atol=1e-4)
