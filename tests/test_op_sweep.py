@@ -1273,3 +1273,43 @@ def test_op_static_replay(op):
                 err_msg=f"{op} [static replay]")
     finally:
         paddle.disable_static()
+
+
+# ------------------------------------------------- tensor-method tier
+# paddle exposes most ops as Tensor METHODS too (x.abs(), x.cumsum(axis)).
+# For every spec whose first arg is the only tensor and whose name is a
+# Tensor method, the method form must agree with the functional oracle.
+def _method_ops():
+    from paddle_tpu import Tensor
+    out = []
+    for op, spec in SPECS.items():
+        if spec.call is not None or spec.ref is None or spec.grad == "fd":
+            pass  # method tier only needs call-form compatibility
+        attr = getattr(Tensor, op, None)
+        if (spec.call is None and spec.ref is not None
+                and len(spec.args) == 1 and callable(attr)
+                and not isinstance(spec.args[0], tuple)):
+            out.append(op)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("op", _method_ops())
+def test_op_method_form(op):
+    spec = SPECS[op]
+    t = paddle.to_tensor(np.asarray(spec.args[0]))
+    out = getattr(t, op)(**spec.kw)
+    outs = [o for o in (out if isinstance(out, (tuple, list)) else [out])
+            if o is not None]
+    refs = spec.ref(*spec.args)
+    refs = refs if isinstance(refs, tuple) else (refs,)
+    for o, r in zip(outs, refs):
+        got = np.asarray(o.numpy()) if hasattr(o, "numpy") else \
+            np.asarray(o)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), np.asarray(r, np.float64),
+            atol=max(spec.atol, 1e-5), rtol=max(spec.rtol, 1e-5),
+            err_msg=f"{op} [method form]")
+
+
+def test_method_tier_nonempty():
+    assert len(_method_ops()) >= 60, _method_ops()
