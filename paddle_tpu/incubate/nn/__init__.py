@@ -2,5 +2,5 @@ from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer,
     FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
-    FusedMultiTransformer,
+    FusedMultiTransformer, FusedDropout, FusedTransformer,
 )

@@ -255,3 +255,47 @@ class FusedMultiTransformer(Layer):
             activation=self.activation, training=self.training,
             trans_qkvw=self._trans_qkvw, norm_type=self._norm_type,
             use_neox_rotary_style=self._neox)
+
+
+class FusedDropout(Layer):
+    """reference: incubate/nn/layer/fused_dropout_nd.py:20 — dropout with
+    an axis argument (shared mask along the non-listed axes)."""
+
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        from ...nn.functional.common import dropout
+        return dropout(x, p=self.p, axis=self.axis,
+                       training=self.training, mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, axis={self.axis}, mode={self.mode}"
+
+
+class FusedTransformer(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py:951 — the full
+    encoder-decoder container. The reference's own forward raises
+    NotImplementedError (fused_transformer.py:1065); this mirrors that
+    contract, existing for construction/config parity."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        raise NotImplementedError(
+            "FusedTransformer.forward is unimplemented in the reference "
+            "too (fused_transformer.py:1065); compose "
+            "FusedTransformerEncoderLayer / FusedMultiHeadAttention "
+            "directly")
