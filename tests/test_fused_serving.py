@@ -368,3 +368,17 @@ class TestFusedLayers:
         var = pre.var(-1, keepdims=True)
         want = (pre - mu) / np.sqrt(var + 1e-5)
         np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_fused_dropout_axiswise_and_transformer_container(self):
+        import paddle_tpu.incubate.nn as inn
+        d = inn.FusedDropout(p=0.5, axis=0)
+        d.train()
+        out = np.asarray(d(_t(np.ones((64, 8), np.float32))).numpy())
+        assert all(row.std() < 1e-6 for row in out)   # shared row mask
+        d.eval()
+        np.testing.assert_allclose(
+            np.asarray(d(_t(np.ones((4, 4), np.float32))).numpy()), 1.0)
+        t = inn.FusedTransformer()
+        with pytest.raises(NotImplementedError):
+            t(_t(np.ones((1, 2, 512), np.float32)),
+              _t(np.ones((1, 2, 512), np.float32)))
