@@ -736,6 +736,29 @@ def block_multihead_attention(
     B = bt.shape[0]
     total = qv.shape[0]
     q3 = qv.reshape(total, 3, nh, hd)
+
+    # pure-decode batches (one new token per sequence, no prefill rows)
+    # take the Pallas paged-attention kernel: the block-table gather rides
+    # the kernel's scalar-prefetch index map instead of materializing a
+    # contiguous copy per sequence
+    from ....ops.pallas import fused as _pf
+    if (rope_emb is None and mask is None and total == B
+            and int(enc.max(initial=0)) == 0 and np.all(this == 1)
+            and _pf.available()):   # True on TPU or under set_interpret
+        q1 = q3[:, 0]                       # (B, nh, hd)
+        pos = dec.astype(np.int64)
+        pages = jnp.asarray(bt[np.arange(B), pos // bs].astype(np.int32))
+        rows = jnp.asarray((pos % bs).astype(np.int32))
+        kc = kc.at[pages, :, rows].set(q3[:, 1].astype(kc.dtype))
+        vc = vc.at[pages, :, rows].set(q3[:, 2].astype(vc.dtype))
+        # kernel page layout: (P, HK, page, D) == this cache layout
+        out = _pf.paged_decode_attention(
+            q1, kc, vc, jnp.asarray(bt), jnp.asarray(
+                (dec + 1).astype(np.int32)))
+        return (Tensor(out.reshape(B, nh * hd), _internal=True),
+                Tensor(qv, _internal=True), Tensor(kc, _internal=True),
+                Tensor(vc, _internal=True))
+
     outs = []
     tok = 0
     for b in range(B):

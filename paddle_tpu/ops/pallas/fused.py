@@ -269,8 +269,11 @@ def rope_qk(q, k, cos, sin, block_seq: int = 256):
 
 
 # ---------------- decode-time block attention (KV cache) ----------------
-def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
-                   *, scale, block_k):
+def _decode_softmax_step(q, k, v, cache_len, o_ref, acc, m_sc, l_sc,
+                         *, scale, block_k):
+    """Shared online-softmax step for the decode kernels (contiguous and
+    paged): one (H_rep, D) query block against one (block_k, D) K/V block
+    at sequence offset ki*block_k, masked by cache_len."""
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
 
@@ -280,10 +283,6 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
         m_sc[...] = jnp.full_like(m_sc, -jnp.inf)
         l_sc[...] = jnp.zeros_like(l_sc)
 
-    q = q_ref[0]                                  # (H_rep, D)
-    k = k_ref[0]                                  # (block_k, D)
-    v = v_ref[0]
-    cache_len = len_ref[0]
     # zero possibly-padded cache rows: 0 * NaN would poison the p @ v sum
     vrows = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
     v = jnp.where(vrows < cache_len, v, jnp.zeros_like(v))
@@ -311,6 +310,13 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
         l = l_sc[:, :1]
         o_ref[0] = (acc[...] / jnp.where(l == 0.0, 1.0, l)).astype(
             o_ref.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
+                   *, scale, block_k):
+    _decode_softmax_step(q_ref[0], k_ref[0], v_ref[0], len_ref[0],
+                         o_ref, acc, m_sc, l_sc, scale=scale,
+                         block_k=block_k)
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
@@ -358,4 +364,72 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
         ],
         interpret=_interp(),
     )(qt, kt, vt, lens)
+    return out.reshape(B, HK, rep, D).reshape(B, H, D)
+
+
+# ---------------- paged decode attention (block tables) ----------------
+def _paged_decode_kernel(bt_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
+                         acc, m_sc, l_sc, *, scale, page):
+    """Same online-softmax as _decode_kernel; k/v blocks arrive via the
+    scalar-prefetched block-table index map (vLLM-style indirection), so
+    the block refs carry (1, 1, page, D) with the page-pool dims leading.
+    """
+    _decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0], len_ref[0],
+                         o_ref, acc, m_sc, l_sc, scale=scale,
+                         block_k=page)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
+                           scale=None):
+    """Single-token flash attention over a PAGED KV cache (reference:
+    block_multi_head_attention_kernel.cu + vLLM paged attention).
+
+    q:            (B, H, D) current queries
+    k/v_pages:    (num_pages, HK, page_size, D) page pool
+    block_tables: (B, pages_per_seq) int32 page ids (-1 pad allowed)
+    cache_len:    scalar or (B,) valid lengths
+    returns (B, H, D). The page id feeds the kernel's BlockSpec index map
+    via scalar prefetch — the gather over pages happens in the memory
+    pipeline, not as a materialized contiguous copy.
+    """
+    B, H, D = q.shape
+    HK, page = k_pages.shape[1], k_pages.shape[2]
+    assert H % HK == 0
+    rep = H // HK
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    ppseq = block_tables.shape[1]
+    cache_len = jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (B,))
+
+    kp = k_pages.transpose(1, 0, 2, 3)       # (HK, P, page, D)
+    vp = v_pages.transpose(1, 0, 2, 3)
+    qt = q.reshape(B, HK, rep, D).reshape(B * HK, rep, D)
+    lens = jnp.repeat(cache_len, HK)
+    bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)  # clamp -1
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * HK, ppseq),
+        in_specs=[
+            pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, page, D),
+                         lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+            pl.BlockSpec((1, 1, page, D),
+                         lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+            pl.BlockSpec((1,), lambda i, j, bt_: (i,),
+                         memory_space=pltpu.SMEM if _PALLAS_OK else None),
+        ],
+        out_specs=pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, D), jnp.float32),
+            pltpu.VMEM((rep, 128), jnp.float32),
+            pltpu.VMEM((rep, 128), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=s, page=page),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * HK, rep, D), q.dtype),
+        interpret=_interp(),
+    )(bt, qt, kp, vp, lens)
     return out.reshape(B, HK, rep, D).reshape(B, H, D)

@@ -244,3 +244,52 @@ def test_llama_fused_kernels_parity():
     for a, b in zip(flat_x, flat_p):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-2, atol=2e-4)
+
+
+class TestPagedDecodeAttention:
+    """Paged (block-table) decode kernel vs a dense gather oracle —
+    the vLLM-style serving cache layout (VERDICT-adjacent: the serving
+    stack's hot loop)."""
+
+    def _oracle(self, q, kp, vp, bt, lens, page):
+        import math
+        B, H, D = q.shape
+        HK = kp.shape[1]
+        rep = H // HK
+        out = np.zeros_like(q)
+        for b in range(B):
+            L = int(lens[b])
+            npg = (L + page - 1) // page
+            ks = np.concatenate([kp[int(bt[b, j])] for j in range(npg)],
+                                1)[:, :L]
+            vs = np.concatenate([vp[int(bt[b, j])] for j in range(npg)],
+                                1)[:, :L]
+            for h in range(H):
+                hk = h // rep
+                logits = ks[hk] @ q[b, h] / math.sqrt(D)
+                p = np.exp(logits - logits.max())
+                p /= p.sum()
+                out[b, h] = p @ vs[hk]
+        return out
+
+    @pytest.mark.parametrize("gqa", [False, True])
+    def test_matches_oracle(self, gqa):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas import fused
+        fused.set_interpret(True)
+        try:
+            rs = np.random.RandomState(3)
+            B, HK, D, page, P = 2, 2, 8, 4, 6
+            H = HK * (2 if gqa else 1)
+            q = rs.randn(B, H, D).astype(np.float32)
+            kp = rs.randn(P, HK, page, D).astype(np.float32)
+            vp = rs.randn(P, HK, page, D).astype(np.float32)
+            bt = np.array([[0, 2, -1], [4, 1, 3]], np.int32)
+            lens = np.array([6, 11], np.int32)
+            out = fused.paged_decode_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens))
+            want = self._oracle(q, kp, vp, bt, lens, page)
+            np.testing.assert_allclose(np.asarray(out), want, atol=2e-5)
+        finally:
+            fused.set_interpret(False)
