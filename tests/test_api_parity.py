@@ -722,3 +722,22 @@ class TestReviewFixes:
         assert issubclass(AbsmaxObserverLayer, BaseObserver)
         assert issubclass(FakeQuanterWithAbsMaxObserver, BaseQuanter)
         assert isinstance(AbsmaxObserverLayer(), BaseObserver)
+
+
+def test_decode_jpeg_roundtrip(tmp_path):
+    """vision.ops.decode_jpeg: bytes tensor -> CHW uint8 (PIL path on
+    TPU hosts, reference nvjpeg kernel)."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+    from paddle_tpu.vision import ops as V
+    arr = (np.linspace(0, 255, 8 * 8 * 3).reshape(8, 8, 3)
+           .astype("uint8"))
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(str(p), quality=95)
+    data = V.read_file(str(p))
+    img = V.decode_jpeg(data, mode="rgb")
+    got = np.asarray(img.numpy())
+    assert got.shape == (3, 8, 8) and got.dtype == np.uint8
+    # lossy codec: coarse agreement
+    assert np.abs(got.transpose(1, 2, 0).astype(int) -
+                  arr.astype(int)).mean() < 16

@@ -1091,6 +1091,23 @@ def _stft_check(out, x):
 # behavior-tested in a dedicated module instead of this sweep
 EXEMPT = {
     "masked_multihead_attention_": "tests/test_incubate.py",
+    # detection/vision surface promoted from oos in round 3 — oracle
+    # tests live in the api-parity/nn suites
+    "box_coder": "tests/test_api_parity.py",
+    "prior_box": "tests/test_api_parity.py",
+    "yolo_box": "tests/test_api_parity.py",
+    "yolo_loss": "tests/test_api_parity.py",
+    "matrix_nms": "tests/test_api_parity.py",
+    "roi_align": "tests/test_api_parity.py",
+    "roi_pool": "tests/test_api_parity.py",
+    "psroi_pool": "tests/test_api_parity.py",
+    "decode_jpeg": "tests/test_api_parity.py",
+    "read_file": "tests/test_api_parity.py",
+    "distribute_fpn_proposals": "tests/test_api_parity.py",
+    "generate_proposals": "tests/test_api_parity.py",
+    "temporal_shift": "tests/test_nn_extras.py",
+    "class_center_sample": "tests/test_nn_extras.py",
+    "hsigmoid_loss": "tests/test_nn_extras.py",
     "all_gather": "tests/test_eager_collectives.py",
     "all_reduce": "tests/test_eager_collectives.py",
     "all_to_all": "tests/test_eager_collectives.py",

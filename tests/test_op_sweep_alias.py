@@ -1037,6 +1037,48 @@ def _tanhshrink():
                                atol=1e-5)
 
 
+# --- detection/misc aliases promoted from oos in round 3
+@alias("deformable_conv")
+def _deform():
+    from paddle_tpu.vision import ops as V
+    x = _t(_f32(1, 2, 6, 6))
+    off = _t(np.zeros((1, 18, 6, 6), np.float32))
+    w = _t(_f32(3, 2, 3, 3, seed=1))
+    out = V.deform_conv2d(x, off, w, padding=1)
+    assert tuple(out.shape) == (1, 3, 6, 6)
+    _finite(out)
+
+
+@alias("shuffle_channel")
+def _shuffle_channel():
+    x = _f32(1, 4, 2, 2)
+    out = F.channel_shuffle(_t(x), groups=2)
+    want = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(
+        1, 4, 2, 2)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, atol=1e-6)
+
+
+@alias("crf_decoding")
+def _crf():
+    import paddle_tpu.text as text
+    pot = _t(_f32(1, 4, 3))
+    trans = _t(_f32(3, 3, seed=1))
+    scores, path = text.viterbi_decode(pot, trans,
+                                       _t(np.array([4], np.int64)))
+    assert np.asarray(path.numpy()).shape[-1] == 4
+
+
+@alias("spectral_norm")
+def _sn():
+    import paddle_tpu.nn as nn
+    lin = nn.Linear(4, 4)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=8)
+    out = lin(_t(_f32(2, 4)))
+    _finite(out)
+    w = np.asarray(lin.weight.numpy())
+    assert abs(np.linalg.svd(w, compute_uv=False)[0] - 1.0) < 0.1
+
+
 # ---------------------------------------------------------------- runner
 def _alias_ops():
     import os

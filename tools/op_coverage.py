@@ -163,6 +163,10 @@ ALIASES = {
     "dirichlet": "distribution.Dirichlet",
     "auc": "metric.Auc", "accuracy": "metric.Accuracy",
     "accuracy_check": "amp.debugging accuracy_check/compare_accuracy",
+    "deformable_conv": "vision.ops deform_conv2d",
+    "shuffle_channel": "channel_shuffle",
+    "crf_decoding": "text.viterbi_decode",
+    "spectral_norm": "nn.utils spectral_norm (hook reparam)",
     "check_numerics": "amp.debugging.check_numerics",
     "enable_check_model_nan_inf": "amp.debugging",
     "disable_check_model_nan_inf": "amp.debugging",
@@ -245,23 +249,20 @@ ALIASES = {
 OUT_OF_SCOPE = {
     # GPU/ASCEND-only runtime plumbing
     "c_comm_init_all", "comm_init_all", "c_gen_nccl_id", "gen_nccl_id",
-    # detection-pipeline ops (capability: vision ops namespace; the
-    # reference itself moved these to legacy)
-    "anchor_generator", "bipartite_match", "box_clip", "box_coder",
-    "collect_fpn_proposals", "density_prior_box", "distribute_fpn_proposals",
-    "generate_proposals", "generate_proposals_v2", "grid_sampler",
-    "iou_similarity", "locality_aware_nms", "matrix_nms", "mine_hard_examples",
-    "multiclass_nms", "multiclass_nms2", "multiclass_nms3", "polygon_box_transform",
-    "prior_box", "retinanet_detection_output", "rpn_target_assign",
-    "ssd_loss", "target_assign", "yolo_box", "yolo_box_head",
-    "yolo_box_post", "yolo_loss", "roi_align", "roi_pool", "psroi_pool",
-    "prroi_pool", "deformable_conv", "deformable_conv_v1",
-    "collect_fpn_proposals",
+    # detection-pipeline ops with NO modern python API in the reference
+    # (train-pipeline internals the reference itself moved to legacy);
+    # the implemented detection surface (roi/yolo/nms/box/proposals) is
+    # classified directly below
+    "anchor_generator", "bipartite_match", "box_clip",
+    "density_prior_box", "locality_aware_nms", "mine_hard_examples",
+    "multiclass_nms", "multiclass_nms2", "multiclass_nms3",
+    "polygon_box_transform", "retinanet_detection_output",
+    "rpn_target_assign", "ssd_loss", "target_assign", "yolo_box_head",
+    "yolo_box_post", "prroi_pool", "collect_fpn_proposals",
     # executor/stream plumbing subsumed by XLA program semantics
     "sync_calc_stream", "coalesce_tensor", "depend", "shard_index",
     "memcpy_d2h_multi_io", "beam_search_decode", "assign_pos",
-    # host image-codec / file IO (no TPU path; torchvision-style domain IO)
-    "decode_jpeg", "read_file",
+
     # PS/recommender GPU-legacy ops (capability = distributed.ps tables)
     "batch_fc", "rank_attention", "tdm_child", "tdm_sampler",
     "pyramid_hash", "match_matrix_tensor", "shuffle_batch", "cvm",
@@ -272,10 +273,8 @@ OUT_OF_SCOPE = {
     "weighted_sample_neighbors",
     # misc legacy sequence/speech ops without modern python API
     "sequence_conv", "sequence_pool", "im2sequence", "ctc_align",
-    "crf_decoding", "chunk_eval", "detection_map",
+    "chunk_eval", "detection_map",
     "add_position_encoding", "affine_channel", "correlation",
-    "shuffle_channel", "temporal_shift", "spectral_norm",
-    "class_center_sample", "hsigmoid_loss",
     "dpsgd", "ftrl",
     # GPU/NPU-runtime specific: fused LSTM+attention CPU-only legacy op,
     # flash-attention GPU helper, ascend-format identity
