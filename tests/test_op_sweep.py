@@ -472,6 +472,11 @@ SPECS["multiplex"] = Spec(
 SPECS["one_hot"] = Spec(
     args=(np.array([0, 2, 1], np.int64),), kw={"num_classes": 4},
     ref=lambda x: np.eye(4, dtype=np.float32)[x])
+SPECS["shard_index"] = Spec(
+    args=(np.array([[1], [6], [12]], np.int64),),
+    kw={"index_num": 20, "nshards": 2, "shard_id": 0,
+        "ignore_value": -1},
+    ref=lambda x: np.where((x >= 0) & (x < 10), x, -1))
 SPECS["sequence_mask"] = Spec(
     args=(np.array([1, 3], np.int64),), kw={"maxlen": 4},
     ref=lambda x: (np.arange(4)[None, :] < x[:, None]))
@@ -1108,6 +1113,8 @@ EXEMPT = {
     "temporal_shift": "tests/test_nn_extras.py",
     "class_center_sample": "tests/test_nn_extras.py",
     "hsigmoid_loss": "tests/test_nn_extras.py",
+    "graph_khop_sampler": "tests/test_api_parity.py",
+    "graph_sample_neighbors": "tests/test_api_parity.py",
     "all_gather": "tests/test_eager_collectives.py",
     "all_reduce": "tests/test_eager_collectives.py",
     "all_to_all": "tests/test_eager_collectives.py",

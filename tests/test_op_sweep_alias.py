@@ -1068,6 +1068,18 @@ def _crf():
     assert np.asarray(path.numpy()).shape[-1] == 4
 
 
+@alias("reindex_graph")
+def _reindex():
+    import paddle_tpu as p
+    from paddle_tpu.incubate import graph_reindex
+    rs, rd, on = graph_reindex(
+        p.to_tensor([0, 1, 2]),
+        p.to_tensor([8, 9, 0, 4, 7, 6, 7]),
+        p.to_tensor(np.array([2, 3, 2], np.int32)))
+    np.testing.assert_array_equal(np.asarray(rd.numpy()),
+                                  [0, 0, 1, 1, 1, 2, 2])
+
+
 @alias("spectral_norm")
 def _sn():
     import paddle_tpu.nn as nn

@@ -166,6 +166,7 @@ ALIASES = {
     "deformable_conv": "vision.ops deform_conv2d",
     "shuffle_channel": "channel_shuffle",
     "crf_decoding": "text.viterbi_decode",
+    "reindex_graph": "incubate.graph_reindex",
     "spectral_norm": "nn.utils spectral_norm (hook reparam)",
     "check_numerics": "amp.debugging.check_numerics",
     "enable_check_model_nan_inf": "amp.debugging",
@@ -260,16 +261,15 @@ OUT_OF_SCOPE = {
     "rpn_target_assign", "ssd_loss", "target_assign", "yolo_box_head",
     "yolo_box_post", "prroi_pool", "collect_fpn_proposals",
     # executor/stream plumbing subsumed by XLA program semantics
-    "sync_calc_stream", "coalesce_tensor", "depend", "shard_index",
+    "sync_calc_stream", "coalesce_tensor", "depend",
     "memcpy_d2h_multi_io", "beam_search_decode", "assign_pos",
 
     # PS/recommender GPU-legacy ops (capability = distributed.ps tables)
     "batch_fc", "rank_attention", "tdm_child", "tdm_sampler",
     "pyramid_hash", "match_matrix_tensor", "shuffle_batch", "cvm",
     "partial_concat", "partial_sum",
-    # graph sampling (host-side neighbor sampling; geometric covers
-    # message passing + segment reduction)
-    "graph_khop_sampler", "graph_sample_neighbors", "reindex_graph",
+    # weighted neighbor sampling: host-side; the uniform samplers below
+    # are implemented (incubate.graph_*), the weighted variant is not
     "weighted_sample_neighbors",
     # misc legacy sequence/speech ops without modern python API
     "sequence_conv", "sequence_pool", "im2sequence", "ctc_align",
