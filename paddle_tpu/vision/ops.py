@@ -889,3 +889,84 @@ class DeformConv2D(Layer):
         st, pd, dl, dg, g = self._a
         return deform_conv2d(x, offset, self.weight, self.bias, st, pd,
                              dl, dg, g, mask)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """reference: phi/kernels/impl/anchor_generator_kernel_impl.h — RPN
+    anchor grid over a feature map. input: (N, C, H, W) (only H/W used).
+    Returns (anchors (H, W, A, 4) xyxy, variances (H, W, A, 4)),
+    A = len(aspect_ratios) * len(anchor_sizes)."""
+    x = as_tensor(input)
+    H, W = int(x.shape[2]), int(x.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    xs = np.arange(W, dtype=np.float32) * sw + offset * (sw - 1)
+    ys = np.arange(H, dtype=np.float32) * sh + offset * (sh - 1)
+    widths, heights = [], []
+    area = sw * sh
+    for ar in aspect_ratios:
+        base_w = np.round(np.sqrt(area / ar))
+        base_h = np.round(base_w * ar)
+        for size in anchor_sizes:
+            widths.append(size / sw * base_w)
+            heights.append(size / sh * base_h)
+    wv = np.asarray(widths, np.float32)
+    hv = np.asarray(heights, np.float32)
+    xc = np.broadcast_to(xs[None, :, None], (H, W, wv.size))
+    yc = np.broadcast_to(ys[:, None, None], (H, W, wv.size))
+    anchors = np.stack([xc - 0.5 * (wv - 1), yc - 0.5 * (hv - 1),
+                        xc + 0.5 * (wv - 1), yc + 0.5 * (hv - 1)], -1)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          anchors.shape).copy()
+    return (Tensor(jnp.asarray(anchors), _internal=True),
+            Tensor(jnp.asarray(var), _internal=True))
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=-1, return_index=False,
+                   rois_num=None, name=None):
+    """reference: multiclass_nms3 op (legacy detection pipeline) — per-
+    class greedy NMS then cross-class keep_top_k.
+
+    bboxes (B, M, 4); scores (B, C, M). Returns (out (K, 6) rows
+    [label, score, x1, y1, x2, y2], index (K, 1), nms_rois_num (B,)).
+    Host-composed over the existing nms (same as the reference's CPU
+    kernel)."""
+    bv = np.asarray(raw(as_tensor(bboxes)))
+    sv = np.asarray(raw(as_tensor(scores)))
+    B, C, M = sv.shape
+    rows, idxs, nums = [], [], []
+    for b in range(B):
+        cand = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            keep = sv[b, c] > score_threshold
+            if not keep.any():
+                continue
+            cls_idx = np.nonzero(keep)[0]
+            order = np.argsort(-sv[b, c, cls_idx])
+            cls_idx = cls_idx[order][:nms_top_k if nms_top_k > 0 else None]
+            kept = np.asarray(nms(
+                Tensor(jnp.asarray(bv[b, cls_idx]), _internal=True),
+                iou_threshold=nms_threshold,
+                scores=Tensor(jnp.asarray(sv[b, c, cls_idx]),
+                              _internal=True)).numpy())
+            for j in cls_idx[kept]:
+                cand.append((c, float(sv[b, c, j]), j))
+        cand.sort(key=lambda t: -t[1])
+        if keep_top_k > 0:
+            cand = cand[:keep_top_k]
+        nums.append(len(cand))
+        for c, s, j in cand:
+            rows.append([float(c), s, *bv[b, j].tolist()])
+            idxs.append(b * M + int(j))
+    out = np.asarray(rows, np.float32).reshape(-1, 6)
+    index = np.asarray(idxs, np.int32).reshape(-1, 1)
+    res = (Tensor(jnp.asarray(out), _internal=True),
+           Tensor(jnp.asarray(np.asarray(nums, np.int32)), _internal=True))
+    if return_index:
+        return res[0], Tensor(jnp.asarray(index), _internal=True), res[1]
+    return res[0], res[1]

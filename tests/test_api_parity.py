@@ -741,3 +741,51 @@ def test_decode_jpeg_roundtrip(tmp_path):
     # lossy codec: coarse agreement
     assert np.abs(got.transpose(1, 2, 0).astype(int) -
                   arr.astype(int)).mean() < 16
+
+
+class TestDetectionRound3:
+    def test_anchor_generator_reference_geometry(self):
+        """reference kernel math: base box from stride area/aspect, scaled
+        by anchor_size/stride, centered at offset*(stride-1)."""
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(np.zeros((1, 8, 2, 3), np.float32))
+        anchors, variances = V.anchor_generator(
+            x, anchor_sizes=[32.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0], offset=0.5)
+        a = np.asarray(anchors.numpy())
+        v = np.asarray(variances.numpy())
+        assert a.shape == (2, 3, 1, 4) and v.shape == (2, 3, 1, 4)
+        # cell (0,0): center 0.5*15=7.5; base 16x16 scaled by 2 -> 32x32
+        np.testing.assert_allclose(a[0, 0, 0],
+                                   [7.5 - 15.5, 7.5 - 15.5,
+                                    7.5 + 15.5, 7.5 + 15.5])
+        # stride steps between neighbouring cells
+        np.testing.assert_allclose(a[0, 1, 0] - a[0, 0, 0],
+                                   [16, 0, 16, 0])
+        np.testing.assert_allclose(a[1, 0, 0] - a[0, 0, 0],
+                                   [0, 16, 0, 16])
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_multiclass_nms_per_class_then_topk(self):
+        from paddle_tpu.vision import ops as V
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.2],      # class 0
+                            [0.1, 0.7, 0.6]]], np.float32)  # class 1
+        out, index, nums = V.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, nms_top_k=10, keep_top_k=10,
+            nms_threshold=0.5, return_index=True)
+        o = np.asarray(out.numpy())
+        # class 0: box0 (0.9) suppresses box1; box2 below threshold
+        # class 1: box1 (0.7) keeps, box2 (0.6) keeps (no overlap)
+        assert int(np.asarray(nums.numpy())[0]) == 3
+        assert o.shape == (3, 6)
+        np.testing.assert_allclose(o[0, :2], [0, 0.9])   # best row first
+        np.testing.assert_allclose(sorted(o[1:, 1].tolist()), [0.6, 0.7])
+        # keep_top_k=1 truncates across classes
+        out2, nums2 = V.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, nms_top_k=10, keep_top_k=1,
+            nms_threshold=0.5)
+        assert np.asarray(out2.numpy()).shape == (1, 6)

@@ -1080,6 +1080,16 @@ def _reindex():
                                   [0, 0, 1, 1, 1, 2, 2])
 
 
+@alias("multiclass_nms3")
+def _mcnms():
+    from paddle_tpu.vision import ops as V
+    boxes = _t(np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32))
+    scores = _t(np.array([[[0.9, 0.2], [0.1, 0.8]]], np.float32))
+    out, nums = V.multiclass_nms(boxes, scores, score_threshold=0.3,
+                                 nms_top_k=5, keep_top_k=5)
+    assert int(np.asarray(nums.numpy())[0]) == 2
+
+
 @alias("spectral_norm")
 def _sn():
     import paddle_tpu.nn as nn

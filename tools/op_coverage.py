@@ -167,6 +167,7 @@ ALIASES = {
     "shuffle_channel": "channel_shuffle",
     "crf_decoding": "text.viterbi_decode",
     "reindex_graph": "incubate.graph_reindex",
+    "multiclass_nms3": "vision.ops multiclass_nms",
     "spectral_norm": "nn.utils spectral_norm (hook reparam)",
     "check_numerics": "amp.debugging.check_numerics",
     "enable_check_model_nan_inf": "amp.debugging",
@@ -254,9 +255,8 @@ OUT_OF_SCOPE = {
     # (train-pipeline internals the reference itself moved to legacy);
     # the implemented detection surface (roi/yolo/nms/box/proposals) is
     # classified directly below
-    "anchor_generator", "bipartite_match", "box_clip",
+    "bipartite_match", "box_clip",
     "density_prior_box", "locality_aware_nms", "mine_hard_examples",
-    "multiclass_nms", "multiclass_nms2", "multiclass_nms3",
     "polygon_box_transform", "retinanet_detection_output",
     "rpn_target_assign", "ssd_loss", "target_assign", "yolo_box_head",
     "yolo_box_post", "prroi_pool", "collect_fpn_proposals",
