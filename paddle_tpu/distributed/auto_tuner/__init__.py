@@ -1,3 +1,8 @@
 from .tuner import AutoTuner  # noqa: F401
 from .cost_model import estimate_step_time  # noqa: F401
 from .memory_cost_model import estimate_memory_gb  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+from .search import (  # noqa: F401
+    CustomizeSearch, GBSSearch, GridSearch, SearchAlgo)
+from .prune import (  # noqa: F401
+    register_prune, register_prune_history)

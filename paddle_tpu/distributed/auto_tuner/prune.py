@@ -1,0 +1,146 @@
+"""Prune-rule registry for the auto tuner.
+
+Reference: python/paddle/distributed/auto_tuner/prune.py — two registries
+(`_PRUNE_FUNC` static rules at candidate-generation time,
+`_PRUNE_HISTORY_FUNC` rules consulted at search time against the run
+history). The TPU build keeps the same two-phase contract but the rules
+themselves reason over mesh axes (tp/pp/dp/cp/sharding on an ICI mesh) and
+the analytic HBM model instead of per-GPU allocator telemetry.
+
+A rule returns True to prune. Static rules see (tuner, cfg, model) —
+model is the dict under evaluation, which GBS search varies per candidate
+grid; history rules see (tuner, cfg, history) where history is a list of
+record dicts ({"cfg", "metric", "error", "memory_gb"}).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from .memory_cost_model import estimate_memory_gb
+from .recorder import normalize_cfg
+
+_PRUNE_FUNC: List[Callable] = []
+_PRUNE_HISTORY_FUNC: List[Callable] = []
+
+
+def register_prune(fn):
+    """Register a static prune rule (reference prune.py:128 pattern)."""
+    _PRUNE_FUNC.append(fn)
+    return fn
+
+
+def register_prune_history(fn):
+    """Register a history-aware prune rule (reference prune.py:206)."""
+    _PRUNE_HISTORY_FUNC.append(fn)
+    return fn
+
+
+def prune_static(tuner, cfg: Dict, model: Dict = None) -> bool:
+    """model overrides tuner.model for rules that read model dims (GBS
+    search evaluates candidate grids for scaled global batches without
+    mutating shared tuner state)."""
+    model = model if model is not None else tuner.model
+    return any(fn(tuner, cfg, model) for fn in _PRUNE_FUNC)
+
+
+def prune_with_history(tuner, cfg: Dict, history: List[Dict]) -> bool:
+    return any(fn(tuner, cfg, history) for fn in _PRUNE_HISTORY_FUNC)
+
+
+def _same_shape(a: Dict, b: Dict, *keys) -> bool:
+    return all(a.get(k, 1) == b.get(k, 1) for k in keys)
+
+
+# ---- static rules -------------------------------------------------------
+
+@register_prune
+def prune_by_divisibility(tuner, cfg, model):
+    """tp | heads, pp | layers, dp | global batch, product == world.
+
+    Reference prune_by_mp/prune_by_pp (prune.py:129,173): degree must
+    divide the model dimension it splits.
+    """
+    m = model
+    heads = m.get("num_heads")
+    if heads and heads % cfg.get("tp", 1):
+        return True
+    layers = m.get("num_layers")
+    if layers and layers % cfg.get("pp", 1):
+        return True
+    B = m.get("global_batch")
+    if B and B % max(cfg.get("dp", 1), 1):
+        return True
+    world = 1
+    for k in ("dp", "tp", "pp", "cp"):
+        world *= cfg.get(k, 1)
+    return world != tuner.world_size
+
+
+@register_prune
+def prune_by_memory_estimation(tuner, cfg, model):
+    """Analytic per-chip HBM estimate over budget (prune.py:605)."""
+    return estimate_memory_gb(model, cfg) > tuner.hbm_gb
+
+
+@register_prune
+def prune_by_sharding(tuner, cfg, model):
+    """sharding degree must divide the dp degree it lives on
+    (prune.py:395 — sharding_degree > degree of its axis is invalid)."""
+    sh = cfg.get("sharding", 1)
+    dp = max(cfg.get("dp", 1), 1)
+    return sh > 1 and dp % sh != 0
+
+
+@register_prune
+def prune_by_allowed_candidates(tuner, cfg, model):
+    """User-restricted candidate lists (reference tuner_cfg candidates)."""
+    allowed = tuner.tuner_cfg
+    for key, axis in (("mp_degree", "tp"), ("pp_degree", "pp"),
+                      ("dp_degree", "dp"), ("cp_degree", "cp"),
+                      ("sharding_degree", "sharding")):
+        lst = allowed.get(key)
+        if lst is not None and cfg.get(axis, 1) not in lst:
+            return True
+    return False
+
+
+# ---- history rules ------------------------------------------------------
+# History records store normalized cfgs (recorder.add_record); incoming
+# candidates are normalized here so sparse user configs compare equal to
+# their round-tripped form.
+
+@register_prune_history
+def prune_duplicate(tuner, cfg, history):
+    cfg = normalize_cfg(cfg)
+    return any(r["cfg"] == cfg for r in history)
+
+
+@register_prune_history
+def prune_by_oom_history(tuner, cfg, history):
+    """Skip configs at least as memory-hungry as one that already OOM'd
+    with the same model split AND batch recipe (reference
+    prune_by_mbs_history / prune_by_sharding_history prune.py:361,447:
+    once a shape dies of OOM, every strictly-heavier sibling dies too).
+    global_batch is part of the dominance key — a smaller-batch sibling
+    of an OOM'd shape may well fit."""
+    cfg = normalize_cfg(cfg)
+    mem = estimate_memory_gb(tuner.model, cfg)
+    for r in history:
+        if r.get("error") != "oom":
+            continue
+        oom_mem = r.get("memory_gb")
+        if oom_mem is None:
+            continue  # no estimate recorded — can't establish dominance
+        if _same_shape(cfg, r["cfg"], "tp", "pp", "cp", "global_batch") \
+                and mem >= oom_mem - 1e-9:
+            return True
+    return False
+
+
+@register_prune_history
+def prune_by_error_history(tuner, cfg, history):
+    """A config that failed for a non-OOM reason is not retried
+    (reference search loop records error runs with time=-1)."""
+    cfg = normalize_cfg(cfg)
+    return any(r["cfg"] == cfg and r.get("error") not in (None, "oom")
+               for r in history)

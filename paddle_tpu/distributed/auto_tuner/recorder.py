@@ -1,0 +1,123 @@
+"""Run-history recorder with CSV persistence + breakpoint resume.
+
+Reference: python/paddle/distributed/auto_tuner/recorder.py
+(HistoryRecorder: add_cfg / sort_metric / get_best / store_history) and
+tuner.py:76 resume_form_history. Stdlib csv only (the reference pulls in
+pandas; nothing here needs it).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional, Tuple
+
+_AXES = ("dp", "tp", "pp", "cp", "sharding")
+
+
+def normalize_cfg(cfg: Dict) -> Dict:
+    """Canonical config identity: every mesh axis explicit (default 1),
+    extra keys (e.g. global_batch) preserved. All history comparisons go
+    through this so sparse user configs ({"dp": 4, "tp": 8}) and their
+    CSV-round-tripped form compare equal."""
+    out = {a: int(cfg.get(a, 1)) for a in _AXES}
+    for k, v in cfg.items():
+        if k not in _AXES:
+            out[k] = v
+    return out
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "tokens_per_sec",
+                 direction: str = "Maximize"):
+        self.metric_name = metric_name
+        self.direction = direction
+        self.history: List[Dict] = []
+
+    def add_record(self, cfg: Dict, metric: Optional[float] = None, *,
+                   error: Optional[str] = None,
+                   memory_gb: Optional[float] = None) -> None:
+        self.history.append({"cfg": normalize_cfg(cfg), "metric": metric,
+                             "error": error, "memory_gb": memory_gb})
+
+    def sorted_history(self) -> List[Dict]:
+        worst = float("-inf") if self.direction == "Maximize" \
+            else float("inf")
+        return sorted(
+            self.history,
+            key=lambda r: r["metric"] if r["metric"] is not None else worst,
+            reverse=self.direction == "Maximize")
+
+    def get_best(self) -> Tuple[Optional[Dict], bool]:
+        """(best record, found) over non-errored runs (recorder.py:58)."""
+        ok = [r for r in self.history
+              if r["error"] is None and r["metric"] is not None]
+        if not ok:
+            return None, False
+        pick = max if self.direction == "Maximize" else min
+        return pick(ok, key=lambda r: r["metric"]), True
+
+    def _extra_cfg_keys(self) -> List[str]:
+        """Non-axis cfg keys present anywhere in history (e.g. GBSSearch's
+        global_batch) — they are part of the config identity and must
+        survive the CSV round trip."""
+        keys = []
+        for r in self.history:
+            for k in r["cfg"]:
+                if k not in _AXES and k not in keys:
+                    keys.append(k)
+        return keys
+
+    # ---- persistence ----------------------------------------------------
+    def save_csv(self, path: str) -> None:
+        extras = self._extra_cfg_keys()
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(list(_AXES) + extras
+                       + [self.metric_name, "error", "memory_gb"])
+            for r in self.history:
+                w.writerow([r["cfg"].get(a, 1) for a in _AXES]
+                           + [r["cfg"].get(k, "") for k in extras]
+                           + [r["metric"] if r["metric"] is not None else "",
+                              r["error"] or "",
+                              r["memory_gb"]
+                              if r["memory_gb"] is not None else ""])
+
+    def load_csv(self, path: str) -> int:
+        """Merge records from a history CSV; returns how many were loaded.
+        Missing file is a no-op (reference tuner.py:78: resume does not
+        start when the csv does not exist). Rows whose cfg is already in
+        history are skipped, so repeated resumes don't duplicate records."""
+        if not os.path.exists(path):
+            return 0
+        with open(path, newline="") as f:
+            rows = list(csv.reader(f))
+        if not rows:
+            return 0
+        head = rows[0]
+        # layout (save_csv): cfg columns, then [<metric>, error, memory_gb]
+        # — the metric column is identified positionally, so a recorder
+        # configured with a different metric_name still parses the file
+        if len(head) < 3 or head[-2:] != ["error", "memory_gb"]:
+            raise ValueError(f"unrecognized history CSV header: {head}")
+        cfg_cols, metric_col = head[:-3], head[-3]
+        n = 0
+        for row in rows[1:]:
+            d = dict(zip(head, row))
+            cfg = {k: int(d[k]) for k in cfg_cols if d.get(k, "") != ""}
+            cfg = normalize_cfg(cfg)
+            if any(r["cfg"] == cfg for r in self.history):
+                continue
+            metric = float(d[metric_col]) if d.get(metric_col) else None
+            mem = float(d["memory_gb"]) if d.get("memory_gb") else None
+            self.add_record(cfg, metric, error=d.get("error") or None,
+                            memory_gb=mem)
+            n += 1
+        return n
+
+    def find(self, cfg: Dict) -> Optional[Dict]:
+        """Record whose full normalized identity matches cfg, or None."""
+        cfg = normalize_cfg(cfg)
+        for r in self.history:
+            if r["cfg"] == cfg:
+                return r
+        return None

@@ -1,0 +1,125 @@
+"""Search algorithms over parallel-config candidates.
+
+Reference: python/paddle/distributed/auto_tuner/search.py — SearchAlgo
+base with a prune loop, GridSearch (cost-ordered full grid), GBSSearch
+(additionally searches the global batch size), CustomizeSearch (explicit
+task list / CSV). The dp_estimation mode is subsumed here by the analytic
+cost model: candidates are already emitted best-estimate-first, which is
+what that mode approximates with a single-dp measurement.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+from .cost_model import estimate_step_time
+from .prune import prune_with_history
+
+
+class SearchAlgo(ABC):
+    def __init__(self, tuner):
+        self.tuner = tuner
+        self._tasks_cache: Optional[List[Dict]] = None
+        self._idx = 0
+
+    @abstractmethod
+    def _build_tasks(self) -> List[Dict]:
+        ...
+
+    def all_tasks(self) -> List[Dict]:
+        """Task list, built once (grid generation + cost-model sort are
+        not cheap; the search queue serves from the same cache)."""
+        if self._tasks_cache is None:
+            self._tasks_cache = self._build_tasks()
+        return list(self._tasks_cache)
+
+    def search_once(self, history: List[Dict]) -> Optional[Dict]:
+        """Next un-pruned task, or None when exhausted (search.py:62)."""
+        while True:
+            cfg = self._next()
+            if cfg is None:
+                return None
+            if not prune_with_history(self.tuner, cfg, history):
+                return cfg
+
+    def _next(self) -> Optional[Dict]:
+        if self._tasks_cache is None:
+            self._tasks_cache = self._build_tasks()
+        if self._idx >= len(self._tasks_cache):
+            return None
+        cfg = self._tasks_cache[self._idx]
+        self._idx += 1
+        return dict(cfg)
+
+
+class GridSearch(SearchAlgo):
+    """Full grid, best-estimated-cost first (search.py:48 GridSearch;
+    ordering ≙ its need_baseline memory/performance sort, driven here by
+    the TPU cost model instead of a first measured run)."""
+
+    def _build_tasks(self) -> List[Dict]:
+        cands = self.tuner.generate_candidates()
+        cands.sort(key=lambda c: estimate_step_time(
+            self.tuner.model, c, chip=self.tuner.chip))
+        return cands
+
+
+class GBSSearch(SearchAlgo):
+    """Grid × global-batch-size scan (search.py:120 GBSSearch): for each
+    parallel shape, also try scaled global batches; the metric feedback
+    decides the winner."""
+
+    def __init__(self, tuner, gbs_candidates: Optional[List[int]] = None):
+        super().__init__(tuner)
+        base = tuner.model.get("global_batch", 8)
+        self.gbs_candidates = gbs_candidates or [
+            base, base * 2, base * 4]
+
+    def _build_tasks(self) -> List[Dict]:
+        # round-robin across batch sizes, each group best-estimate-first:
+        # absolute step time always grows with global batch, so any global
+        # sort would group by gbs and a task_limit would starve all but
+        # one batch size — interleaving guarantees every gbs gets its best
+        # shapes explored
+        groups = []
+        for gbs in self.gbs_candidates:
+            model = dict(self.tuner.model, global_batch=gbs)
+            cands = self.tuner.generate_candidates(model)
+            cands.sort(key=lambda c: estimate_step_time(
+                model, c, chip=self.tuner.chip))
+            groups.append([dict(c, global_batch=gbs) for c in cands])
+        out = []
+        for i in range(max((len(g) for g in groups), default=0)):
+            for g in groups:
+                if i < len(g):
+                    out.append(g[i])
+        return out
+
+
+class CustomizeSearch(SearchAlgo):
+    """Explicit task list, in order (search.py:143 CustomizeSearch —
+    configs come from the user, only history pruning applies). Accepts a
+    list of dicts or a CSV path with axis-name headers."""
+
+    def __init__(self, tuner, configs=None, configs_csv: str = None):
+        super().__init__(tuner)
+        if configs is None:
+            if not (configs_csv and os.path.exists(configs_csv)):
+                raise ValueError(
+                    "CustomizeSearch needs configs or an existing "
+                    "configs_csv")
+            with open(configs_csv, newline="") as f:
+                rows = list(csv.reader(f))
+            if not rows:
+                raise ValueError(
+                    f"CustomizeSearch: configs_csv {configs_csv!r} is "
+                    "empty (need a header row of axis names)")
+            head = rows[0]
+            configs = [{k: int(v) for k, v in zip(head, row) if v}
+                       for row in rows[1:]]
+        self.configs = configs
+
+    def _build_tasks(self) -> List[Dict]:
+        return [dict(c) for c in self.configs]

@@ -1,19 +1,33 @@
 """AutoTuner (reference: python/paddle/distributed/auto_tuner/tuner.py:21
-AutoTuner — grid/prune search over dp/mp/pp/sharding candidates, ranked by
-cost; utils.py candidate generation + pruning).
+AutoTuner — pluggable search algorithms over dp/mp/pp/sharding/cp
+candidates, a prune-rule registry consulted both at generation time and
+against run history, a CSV-persisted recorder with breakpoint resume, and
+a measurement loop that actually executes candidates).
 
-Usage:
+The reference tuner launches each candidate as a fresh distributed job and
+greps its logs for the metric; the TPU-native loop instead builds the
+candidate's `jax.sharding.Mesh` in-process and times a jitted hybrid train
+step on it (`tune()`), which is both faster and exact — the same XLA
+program the real run would compile.
+
+Usage (protocol identical to the reference search_once/add_cfg loop):
     tuner = AutoTuner(model_desc, world_size=64, hbm_gb=16)
     cfg = tuner.search_once()          # best unexplored candidate
     tuner.update(cfg, observed_tps)    # feed measurement back
+    tuner.best()
+
+or end-to-end:
+    best = tuner.tune(run_fn)          # run_fn(cfg) -> tokens/sec
 """
 from __future__ import annotations
 
-import itertools
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .cost_model import estimate_step_time
 from .memory_cost_model import estimate_memory_gb
+from .prune import prune_static
+from .recorder import HistoryRecorder
+from .search import CustomizeSearch, GBSSearch, GridSearch
 
 
 def _divisors(n: int) -> List[int]:
@@ -28,80 +42,130 @@ class AutoTuner:
         self.hbm_gb = hbm_gb
         self.chip = chip
         self.tuner_cfg = tuner_cfg or {}
-        self.history: Dict[tuple, float] = {}
-        self._candidates = self._generate()
-        self._cursor = 0
+        # None = exhaustive (the reference defaults task_limit to 100, but
+        # silently truncating the grid loses the optimum; cap only on ask)
+        self.task_limit = self.tuner_cfg.get("task_limit")
+        self._tasks_issued = 0
+        self.recorder = HistoryRecorder(
+            metric_name=self.tuner_cfg.get("metric", "tokens_per_sec"),
+            direction=self.tuner_cfg.get("direction", "Maximize"))
 
-    # ---- candidate generation + pruning (reference: utils.py
-    # generate_combinations + prune functions) ----
-    def _generate(self) -> List[Dict]:
+        algo = self.tuner_cfg.get("search_algo", "grid")
+        if algo == "grid":
+            self.algo = GridSearch(self)
+        elif algo == "gbs":
+            self.algo = GBSSearch(
+                self, self.tuner_cfg.get("gbs_candidates"))
+        elif algo == "customize":
+            self.algo = CustomizeSearch(
+                self, configs=self.tuner_cfg.get("configs"),
+                configs_csv=self.tuner_cfg.get("configs_csv"))
+        else:
+            raise NotImplementedError(f"search_algo={algo!r}")
+
+    # ---- candidate generation (reference utils.py search_all) ----------
+    def generate_candidates(self, model: Optional[Dict] = None) \
+            -> List[Dict]:
+        """Every (dp, tp, pp, cp, sharding) factorization of world_size
+        surviving the static prune rules. model (default self.model) is
+        passed through to the rules explicitly — GBS search evaluates
+        grids for scaled global batches without touching shared state."""
+        model = model if model is not None else self.model
         W = self.world_size
         cands = []
-        allowed = self.tuner_cfg
-        for tp in allowed.get("mp_degree", _divisors(W)):
-            if W % tp:
-                continue
-            for pp in allowed.get("pp_degree", _divisors(W // tp)):
-                if (W // tp) % pp:
-                    continue
+        for tp in _divisors(W):
+            for pp in _divisors(W // tp):
                 rest = W // tp // pp
-                for cp in allowed.get("cp_degree", [1]):
+                for cp in self.tuner_cfg.get("cp_degree", [1]):
                     if rest % cp:
                         continue
                     dp = rest // cp
-                    for sh in allowed.get("sharding_degree",
-                                          _divisors(dp)):
-                        if dp % sh:
-                            continue
+                    for sh in _divisors(dp):
                         cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
                                "sharding": sh}
-                        if self._prune(cfg):
-                            continue
-                        cands.append(cfg)
-        cands.sort(key=lambda c: estimate_step_time(
-            self.model, c, chip=self.chip))
+                        if not prune_static(self, cfg, model):
+                            cands.append(cfg)
         return cands
 
-    def _prune(self, cfg) -> bool:
-        # memory prune
-        if estimate_memory_gb(self.model, cfg) > self.hbm_gb:
-            return True
-        # tp must divide heads; pp must divide layers
-        heads = self.model.get("num_heads")
-        if heads and heads % cfg["tp"]:
-            return True
-        L = self.model.get("num_layers")
-        if L and L % cfg["pp"]:
-            return True
-        # batch must divide over dp
-        B = self.model.get("global_batch")
-        if B and B % max(cfg["dp"], 1):
-            return True
-        return False
-
-    # ---- search protocol (reference: tuner.py search_once) ----
     @property
     def candidates(self) -> List[Dict]:
-        return list(self._candidates)
+        return self.algo.all_tasks()
 
+    # ---- search protocol (reference tuner.py:62 search_once) -----------
     def search_once(self) -> Optional[Dict]:
-        while self._cursor < len(self._candidates):
-            cfg = self._candidates[self._cursor]
-            self._cursor += 1
-            if self._key(cfg) not in self.history:
-                return cfg
-        return None
+        if self.task_limit is not None \
+                and self._tasks_issued >= self.task_limit:
+            return None
+        cfg = self.algo.search_once(self.recorder.history)
+        if cfg is not None:
+            self._tasks_issued += 1
+        return cfg
 
-    def update(self, cfg: Dict, metric: float):
-        """metric: higher is better (e.g. tokens/sec)."""
-        self.history[self._key(cfg)] = metric
+    def update(self, cfg: Dict, metric: Optional[float] = None, *,
+               error: Optional[str] = None):
+        """Record a run. metric: higher is better (e.g. tokens/sec);
+        error: "oom" engages the OOM-history prune rule, any other string
+        marks a failed run."""
+        self.recorder.add_record(
+            cfg, metric, error=error,
+            memory_gb=estimate_memory_gb(self.model, cfg))
 
     def best(self) -> Optional[Dict]:
-        if not self.history:
-            return None
-        key = max(self.history, key=self.history.get)
-        return dict(key)
+        rec, ok = self.recorder.get_best()
+        return dict(rec["cfg"]) if ok else None
 
+    @property
+    def history(self) -> List[Dict]:
+        # defensive copy (like .candidates): caller mutation must not
+        # corrupt dedup/best/resume state inside the recorder
+        return list(self.recorder.history)
+
+    # ---- persistence / resume (reference tuner.py:76) ------------------
+    def save_history(self, csv_path: str) -> None:
+        self.recorder.save_csv(csv_path)
+
+    def resume_from_history(self, csv_path: str) -> int:
+        """Load prior runs; already-run configs are then skipped by the
+        duplicate-history prune rule, and resumed runs count toward
+        task_limit (a crash/resume cycle must not double the budget)."""
+        n = self.recorder.load_csv(csv_path)
+        self._tasks_issued += n
+        return n
+
+    # ---- end-to-end measurement loop -----------------------------------
+    def tune(self, run_fn: Callable[[Dict], float], *,
+             max_trials: Optional[int] = None,
+             history_csv: Optional[str] = None) -> Optional[Dict]:
+        """search → run → record until exhausted (reference launch-side
+        loop: launch/main.py auto-tuner branch). run_fn returns the metric;
+        raising MemoryError (or any exception whose text smells of OOM)
+        records an "oom" run, other exceptions record a failed run.
+        """
+        trials = 0
+        if history_csv:
+            self.resume_from_history(history_csv)
+        while max_trials is None or trials < max_trials:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            trials += 1
+            try:
+                metric = run_fn(cfg)
+            except Exception as e:  # noqa: BLE001 — classify and record
+                s = f"{type(e).__name__}: {e}"
+                oom = isinstance(e, MemoryError) or \
+                    "RESOURCE_EXHAUSTED" in s or "ut of memory" in s
+                self.update(cfg, error="oom" if oom else s[:200])
+            else:
+                self.update(cfg, metric)
+            if history_csv:
+                self.save_history(history_csv)
+        return self.best()
+
+    # kept for backward compatibility with earlier rounds' callers
     @staticmethod
     def _key(cfg: Dict) -> tuple:
         return tuple(sorted(cfg.items()))
+
+    def estimate(self, cfg: Dict) -> float:
+        return estimate_step_time(self.model, cfg, chip=self.chip)

@@ -3,7 +3,8 @@ import numpy as np
 import pytest
 
 from paddle_tpu.distributed.auto_tuner import (
-    AutoTuner, estimate_memory_gb, estimate_step_time)
+    AutoTuner, CustomizeSearch, GBSSearch, HistoryRecorder,
+    estimate_memory_gb, estimate_step_time)
 
 MODEL_7B = {
     "num_params": 6.7e9, "num_layers": 32, "hidden": 4096,
@@ -122,3 +123,325 @@ def test_tune_apply_measure_end_to_end():
     best = tuner.best()
     assert best is not None
     assert measured[AutoTuner._key(best)] == max(measured.values())
+
+
+# ---- round-3 subsystem depth: search algos, prune history, recorder ----
+
+def test_gbs_search_scans_global_batch():
+    """reference search.py:120 GBSSearch: the global batch size is part of
+    the search space."""
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                      tuner_cfg={"search_algo": "gbs",
+                                 "gbs_candidates": [64, 128]})
+    gbs_seen = {c["global_batch"] for c in tuner.candidates}
+    assert gbs_seen == {64, 128}
+    cfg = tuner.search_once()
+    assert cfg is not None and "global_batch" in cfg
+
+
+def test_customize_search_runs_given_configs_in_order():
+    cfgs = [{"dp": 4, "tp": 8, "pp": 1, "cp": 1, "sharding": 4},
+            {"dp": 2, "tp": 8, "pp": 2, "cp": 1, "sharding": 2}]
+    tuner = AutoTuner(MODEL_7B, world_size=32,
+                      tuner_cfg={"search_algo": "customize",
+                                 "configs": cfgs})
+    assert tuner.search_once() == cfgs[0]
+    tuner.update(cfgs[0], 100.0)
+    assert tuner.search_once() == cfgs[1]
+
+
+def test_task_limit_caps_search():
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                      tuner_cfg={"task_limit": 2})
+    got = []
+    while True:
+        c = tuner.search_once()
+        if c is None:
+            break
+        got.append(c)
+        tuner.update(c, 1.0)
+    assert len(got) == 2
+
+
+def test_oom_history_prunes_heavier_siblings():
+    """reference prune.py:361,447: after an OOM, same-shape configs that
+    are at least as memory-hungry are never launched."""
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=64.0)
+    first = tuner.search_once()
+    assert first is not None
+    tuner.update(first, error="oom")
+    mem_oom = estimate_memory_gb(MODEL_7B, first)
+    while True:
+        c = tuner.search_once()
+        if c is None:
+            break
+        same_split = all(c[k] == first[k] for k in ("tp", "pp", "cp"))
+        if same_split:
+            assert estimate_memory_gb(MODEL_7B, c) < mem_oom, \
+                f"OOM-dominated config {c} was not pruned"
+        tuner.update(c, 1.0)
+
+
+def test_failed_config_not_retried():
+    cfgs = [{"dp": 4, "tp": 8, "pp": 1, "cp": 1, "sharding": 1}] * 2
+    tuner = AutoTuner(MODEL_7B, world_size=32,
+                      tuner_cfg={"search_algo": "customize",
+                                 "configs": cfgs})
+    c = tuner.search_once()
+    tuner.update(c, error="compile failure")
+    assert tuner.search_once() is None  # duplicate pruned by error history
+
+
+def test_recorder_csv_roundtrip_and_resume(tmp_path):
+    """reference tuner.py:76 resume_form_history + recorder store_history:
+    a fresh tuner resumed from CSV skips already-run configs and keeps
+    their metrics."""
+    csv_path = str(tmp_path / "history.csv")
+    t1 = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0)
+    a = t1.search_once()
+    b = t1.search_once()
+    t1.update(a, 500.0)
+    t1.update(b, error="oom")
+    t1.save_history(csv_path)
+
+    t2 = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0)
+    assert t2.resume_from_history(csv_path) == 2
+    assert t2.best() == a                 # metric survived the round trip
+    nxt = t2.search_once()
+    assert nxt not in (a, b)              # resumed runs are not re-issued
+    errs = [r for r in t2.history if r["error"] == "oom"]
+    assert errs and errs[0]["cfg"] == b   # oom flag survived (prunes heavies)
+
+
+def test_tune_driver_classifies_oom_and_picks_best():
+    """tune(): search -> run -> record loop; OOM exceptions become "oom"
+    records, the best non-errored metric wins."""
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                      tuner_cfg={"task_limit": 6})
+    calls = []
+
+    def run_fn(cfg):
+        calls.append(cfg)
+        if cfg["sharding"] == 1:
+            raise MemoryError("RESOURCE_EXHAUSTED: out of memory")
+        return 1000.0 * cfg["sharding"]
+
+    best = tuner.tune(run_fn, max_trials=6)
+    assert calls
+    assert best is not None and best["sharding"] > 1
+    best_metric = max(r["metric"] for r in tuner.history
+                      if r["metric"] is not None)
+    rec = [r for r in tuner.history if r["cfg"] == best][0]
+    assert rec["metric"] == best_metric
+
+
+def test_tune_history_csv_written_each_trial(tmp_path):
+    csv_path = str(tmp_path / "h.csv")
+    tuner = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0,
+                      tuner_cfg={"task_limit": 2})
+    tuner.tune(lambda c: 1.0, max_trials=2, history_csv=csv_path)
+    r = HistoryRecorder()
+    assert r.load_csv(csv_path) == 2
+
+
+def test_recorder_get_best_skips_errors():
+    r = HistoryRecorder()
+    r.add_record({"dp": 1, "tp": 8}, None, error="oom")
+    rec, ok = r.get_best()
+    assert not ok and rec is None
+    r.add_record({"dp": 2, "tp": 4}, 10.0)
+    r.add_record({"dp": 4, "tp": 2}, 20.0)
+    rec, ok = r.get_best()
+    assert ok and (rec["cfg"]["dp"], rec["cfg"]["tp"]) == (4, 2)
+    # Minimize direction flips the pick (reference sort_metric)
+    r2 = HistoryRecorder(metric_name="step_time", direction="Minimize")
+    r2.add_record({"dp": 2, "tp": 4}, 10.0)
+    r2.add_record({"dp": 4, "tp": 2}, 20.0)
+    rec, ok = r2.get_best()
+    assert ok and rec["metric"] == 10.0
+
+
+# ---- regression tests for review findings ----
+
+def test_gbs_csv_roundtrip_keeps_global_batch(tmp_path):
+    """global_batch is part of the config identity: it must survive the
+    CSV round trip so resumed GBS searches don't re-issue run configs."""
+    p = str(tmp_path / "g.csv")
+    t1 = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                   tuner_cfg={"search_algo": "gbs",
+                              "gbs_candidates": [64, 128]})
+    t1.tune(lambda c: float(c["global_batch"]), max_trials=3,
+            history_csv=p)
+    ran = [r["cfg"] for r in t1.history]
+    t2 = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                   tuner_cfg={"search_algo": "gbs",
+                              "gbs_candidates": [64, 128]})
+    assert t2.resume_from_history(p) == len(ran)
+    assert all("global_batch" in r["cfg"] for r in t2.history)
+    assert t2.best() == t1.best() and "global_batch" in t2.best()
+    nxt = t2.search_once()
+    assert nxt is not None and nxt not in ran
+
+
+def test_oom_record_without_memory_estimate_does_not_crash():
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=64.0)
+    first = tuner.search_once()
+    tuner.recorder.add_record(first, None, error="oom")  # no memory_gb
+    nxt = tuner.search_once()          # must not TypeError
+    assert nxt is not None and nxt != first
+
+
+def test_default_search_is_exhaustive():
+    tuner = AutoTuner(MODEL_7B, world_size=128, hbm_gb=80.0)
+    total = len(tuner.candidates)
+    assert total > 100                 # would trip a silent 100-task cap
+    n = 0
+    while True:
+        c = tuner.search_once()
+        if c is None:
+            break
+        n += 1
+        tuner.update(c, 1.0)
+    assert n == total
+
+
+def test_repeated_tune_does_not_duplicate_history(tmp_path):
+    p = str(tmp_path / "h.csv")
+    tuner = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0,
+                      tuner_cfg={"task_limit": 2})
+    tuner.tune(lambda c: 1.0, max_trials=2, history_csv=p)
+    assert len(tuner.history) == 2
+    tuner.tune(lambda c: 1.0, max_trials=2, history_csv=p)
+    # resume of its own CSV must not double the records
+    assert len([r for r in tuner.history
+                if r["cfg"] == tuner.history[0]["cfg"]]) == 1
+
+
+def test_sparse_custom_config_identity_survives_resume(tmp_path):
+    """Sparse user configs ({"dp":4,"tp":8}) and their CSV round-tripped
+    form are the same identity: resume must not re-issue or re-launch."""
+    p = str(tmp_path / "c.csv")
+    sparse = [{"dp": 4, "tp": 8}]          # cp/pp/sharding implied 1
+    t1 = AutoTuner(MODEL_7B, world_size=32,
+                   tuner_cfg={"search_algo": "customize",
+                              "configs": sparse})
+    c = t1.search_once()
+    t1.update(c, error="compile failure")
+    t1.save_history(p)
+    t2 = AutoTuner(MODEL_7B, world_size=32,
+                   tuner_cfg={"search_algo": "customize",
+                              "configs": sparse})
+    assert t2.resume_from_history(p) == 1
+    assert t2.search_once() is None        # failed config not re-launched
+
+
+def test_load_csv_with_different_metric_name(tmp_path):
+    from paddle_tpu.distributed.auto_tuner import HistoryRecorder
+    p = str(tmp_path / "m.csv")
+    r1 = HistoryRecorder(metric_name="tokens_per_sec")
+    r1.add_record({"dp": 2, "tp": 4}, 512.5)
+    r1.save_csv(p)
+    r2 = HistoryRecorder(metric_name="step_time", direction="Minimize")
+    assert r2.load_csv(p) == 1             # positional metric column
+    assert r2.history[0]["metric"] == 512.5
+    assert "tokens_per_sec" not in r2.history[0]["cfg"]
+
+
+def test_gbs_oom_does_not_prune_smaller_batch_sibling():
+    """An OOM at global_batch=256 must not kill the same shape at 64 —
+    the memory model is batch-recipe-aware only through the dominance
+    key."""
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                      tuner_cfg={"search_algo": "gbs",
+                                 "gbs_candidates": [64, 256]})
+    first = tuner.candidates[0]           # not consumed from the queue
+    big = dict(first, global_batch=256)
+    tuner.update(big, error="oom")
+    small = dict(first, global_batch=64)
+    seen = []
+    while True:
+        c = tuner.search_once()
+        if c is None:
+            break
+        seen.append(c)
+        tuner.update(c, 1.0)
+    assert small in seen, "smaller-batch sibling was wrongly pruned"
+
+
+def test_recorder_find_and_sorted_history():
+    from paddle_tpu.distributed.auto_tuner import HistoryRecorder
+    r = HistoryRecorder()
+    r.add_record({"dp": 2, "tp": 4, "global_batch": 64}, 10.0)
+    r.add_record({"dp": 2, "tp": 4, "global_batch": 128}, 30.0)
+    r.add_record({"dp": 4, "tp": 2}, 20.0)
+    # find keys on the FULL identity incl. extras
+    got = r.find({"dp": 2, "tp": 4, "global_batch": 128})
+    assert got is not None and got["metric"] == 30.0
+    assert r.find({"dp": 2, "tp": 4, "global_batch": 999}) is None
+    assert [x["metric"] for x in r.sorted_history()] == [30.0, 20.0, 10.0]
+
+
+def test_resume_counts_toward_task_limit(tmp_path):
+    """A crash/resume cycle must not double the trial budget."""
+    p = str(tmp_path / "b.csv")
+    t1 = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0,
+                   tuner_cfg={"task_limit": 3})
+    t1.tune(lambda c: 1.0, max_trials=2, history_csv=p)   # "crash" after 2
+    t2 = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0,
+                   tuner_cfg={"task_limit": 3})
+    issued = 0
+    t2.resume_from_history(p)
+    while True:
+        c = t2.search_once()
+        if c is None:
+            break
+        issued += 1
+        t2.update(c, 1.0)
+    assert issued == 1                 # only the remaining budget
+
+
+def test_candidates_property_is_cached_and_stable():
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0)
+    a = tuner.candidates
+    b = tuner.candidates
+    assert a == b
+    assert tuner.algo.all_tasks() is not tuner.algo._tasks_cache
+    # mutating the returned list must not corrupt the search queue
+    a.clear()
+    assert tuner.search_once() is not None
+
+
+def test_gbs_tasks_interleave_batch_sizes_under_task_limit():
+    """The merged GBS list is globally cost-sorted, so a task_limit still
+    explores every batch size's best shapes (not just the first group)."""
+    tuner = AutoTuner(MODEL_7B, world_size=32, hbm_gb=16.0,
+                      tuner_cfg={"search_algo": "gbs",
+                                 "gbs_candidates": [64, 128],
+                                 "task_limit": 6})
+    seen_gbs = set()
+    while True:
+        c = tuner.search_once()
+        if c is None:
+            break
+        seen_gbs.add(c["global_batch"])
+        tuner.update(c, 1.0)
+    assert seen_gbs == {64, 128}, seen_gbs
+
+
+def test_customize_empty_csv_raises_clear_error(tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("")
+    with pytest.raises(ValueError, match="empty"):
+        AutoTuner(MODEL_7B, world_size=32,
+                  tuner_cfg={"search_algo": "customize",
+                             "configs_csv": str(p)})
+
+
+def test_history_property_returns_copy():
+    tuner = AutoTuner(MODEL_7B, world_size=16, hbm_gb=32.0)
+    c = tuner.search_once()
+    tuner.update(c, 1.0)
+    h = tuner.history
+    h.clear()
+    assert len(tuner.history) == 1     # recorder state untouched
+    assert tuner.search_once() != c    # dedup still sees the run
