@@ -20,6 +20,7 @@ from ..ops._registry import as_tensor, raw
 __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",
     "send_u_recv", "send_ue_recv", "send_uv",
+    "weighted_sample_neighbors",
 ]
 
 
@@ -121,3 +122,56 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
         return mfn(jnp.take(xv, si, axis=0), jnp.take(yv, di, axis=0))
     return apply(fn, as_tensor(x), as_tensor(y), as_tensor(src_index),
                  as_tensor(dst_index), name="send_uv")
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None, seed=None):
+    """Weighted neighbor sampling over a CSC graph: for each input node,
+    draw up to ``sample_size`` neighbors without replacement with
+    probability proportional to ``edge_weight`` (A-Res reservoir keys:
+    top-k of u^(1/w)); degree <= sample_size (or sample_size < 0) keeps
+    every neighbor. Returns (out_neighbors, out_count[, out_eids]).
+
+    reference: python/paddle/geometric/sampling/neighbors.py:244 +
+    gpu/weighted_sample_neighbors_kernel.cu. Host-side numpy like the
+    other samplers (incubate/graph.py) — sampling is data prep, not the
+    jit path.
+    """
+    import numpy as _np_mod
+    from ..incubate.graph import _np
+    rown, cp = _np(row).reshape(-1), _np(colptr).reshape(-1)
+    wts = _np(edge_weight).reshape(-1).astype(_np_mod.float64)
+    nodes = _np(input_nodes).reshape(-1)
+    eidsn = _np(eids).reshape(-1) if eids is not None else None
+    if return_eids and eidsn is None:
+        raise ValueError("return_eids=True requires eids")
+    if seed is None:
+        from .._core import random as _random
+        import jax as _jax
+        seed = int(_np_mod.asarray(
+            _jax.random.bits(_random.next_rng_key(), dtype=_np_mod.uint32)))
+    rng = _np_mod.random.default_rng(seed)
+    neigh, eid_parts, counts = [], [], []
+    for nd in nodes:
+        lo, hi = int(cp[nd]), int(cp[nd + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = _np_mod.arange(lo, hi)
+        else:
+            w = _np_mod.clip(wts[lo:hi], 1e-30, None)
+            keys = rng.random(deg) ** (1.0 / w)
+            sel = lo + _np_mod.argsort(-keys)[:sample_size]
+        neigh.append(rown[sel])
+        counts.append(len(sel))
+        if eidsn is not None:
+            eid_parts.append(eidsn[sel])
+    from .._core.tensor import Tensor as _T
+    out_n = _np_mod.concatenate(neigh) if neigh else \
+        _np_mod.zeros((0,), rown.dtype)
+    outs = (_T(out_n), _T(_np_mod.asarray(counts, _np_mod.int32)))
+    if return_eids:
+        out_e = _np_mod.concatenate(eid_parts) if eid_parts else \
+            _np_mod.zeros((0,), eidsn.dtype)
+        outs = outs + (_T(out_e),)
+    return outs

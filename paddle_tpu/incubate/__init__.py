@@ -5,6 +5,7 @@ On TPU the "fused" ops are expressed as jnp compositions XLA fuses (plus
 Pallas kernels for attention); the API surface is kept for drop-in parity.
 """
 from . import nn  # noqa: F401
+from . import layers  # noqa: F401
 from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401

@@ -1,0 +1,788 @@
+"""Rec-sys / legacy incubate layers (reference: python/paddle/incubate/
+layers/nn.py — shuffle_batch:274, partial_concat:346, partial_sum:426,
+tdm_child:488, tdm_sampler:583, rank_attention:863, batch_fc:932,
+correlation:1003) plus kernel-only legacy ops the reference snapshot keeps
+registered but no longer wraps in Python (affine_channel, add_position_
+encoding, bipartite_match, box_clip, ctc_align, chunk_eval, im2sequence —
+paddle/phi/kernels/cpu/*.cc).
+
+TPU-native re-design notes:
+- LoD inputs become padded batches + explicit ``lengths`` (dynamic row
+  counts defeat XLA static shapes); batch-dims stay leading.
+- Parameter-creating reference APIs (``param_attr`` + LayerHelper) become
+  functional: weights are passed in as tensors, matching this framework's
+  functional substrate (create them with ``paddle.create_parameter``).
+- Sampling ops (tdm_sampler) are host-side numpy like the other data-prep
+  samplers (incubate/graph.py); gather/compute ops are jnp and jit-able.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core.autograd import apply
+from .._core.tensor import Tensor
+from ..ops._registry import as_tensor
+
+__all__ = [
+    "shuffle_batch", "partial_concat", "partial_sum", "tdm_child",
+    "tdm_sampler", "rank_attention", "batch_fc", "correlation",
+    "affine_channel", "add_position_encoding", "bipartite_match",
+    "box_clip", "ctc_align", "chunk_eval", "im2sequence",
+    "detection_map",
+]
+
+
+def _np(t):
+    if isinstance(t, Tensor):
+        return np.asarray(t._value)
+    return np.asarray(t)
+
+
+# --------------------------------------------------------------- shuffle
+def shuffle_batch(x, seed=None, startup_seed: int = 0, name=None):
+    """Randomly permute the batch rows (all dims but the last are the
+    "batch"; rows of width ``x.shape[-1]`` move as units).
+
+    reference: incubate/layers/nn.py:274 + cpu/shuffle_batch_kernel.cc
+    (the reference kernel draws fresh entropy from std::random_device even
+    when seeded; here the permutation derives from ``seed`` /
+    ``startup_seed`` / the framework PRNG stream, so runs under
+    ``paddle.seed`` are reproducible — deviation documented in
+    MIGRATION.md). Differentiable: the backward scatters grads through the
+    inverse permutation (reference shuffle_batch_grad).
+    """
+    t = as_tensor(x)
+    if seed is None:
+        seed = startup_seed
+        if seed == 0:
+            from .._core import random as _random
+            seed = int(np.asarray(
+                jax.random.bits(_random.next_rng_key(), dtype=np.uint32)))
+    elif isinstance(seed, Tensor):
+        seed = int(np.asarray(seed._value).reshape(-1)[0])
+    n = 1
+    for d in t.shape[:-1]:
+        n *= int(d)
+    perm = jnp.asarray(np.random.default_rng(seed).permutation(n))
+
+    def fn(v):
+        flat = v.reshape((n,) + v.shape[len(v.shape) - 1:])
+        return jnp.take(flat, perm, axis=0).reshape(v.shape)
+
+    return apply(fn, t, name="shuffle_batch")
+
+
+# ------------------------------------------------------- partial concat/sum
+def _partial_slice_bounds(in_size: int, start_index: int, length: int):
+    start = start_index if start_index >= 0 else in_size + start_index
+    if not 0 <= start < in_size:
+        raise ValueError(
+            f"partial start_index {start_index} out of range for width "
+            f"{in_size}")
+    plen = length if length >= 0 else in_size - start
+    if start + plen > in_size:
+        raise ValueError("partial slice exceeds input width")
+    return start, plen
+
+
+def partial_concat(x, start_index: int = 0, length: int = -1, name=None):
+    """Concat the column slice ``[start_index, start_index+length)`` of
+    every 2-D input along axis 1.
+
+    reference: incubate/layers/nn.py:346 +
+    impl/partial_concat_kernel_impl.h (negative start counts from the
+    right; length -1 means "to the end").
+    """
+    ts = [as_tensor(t) for t in x]
+    if ts[0].ndim != 2:
+        raise ValueError("partial_concat expects 2-D inputs")
+    start, plen = _partial_slice_bounds(int(ts[0].shape[1]),
+                                        start_index, length)
+
+    def fn(*vs):
+        return jnp.concatenate([v[:, start:start + plen] for v in vs],
+                               axis=1)
+
+    return apply(fn, *ts, name="partial_concat")
+
+
+def partial_sum(x, start_index: int = 0, length: int = -1, name=None):
+    """Sum the column slice ``[start_index, start_index+length)`` across
+    the 2-D inputs. reference: incubate/layers/nn.py:426 +
+    impl/partial_sum_kernel_impl.h."""
+    ts = [as_tensor(t) for t in x]
+    if ts[0].ndim != 2:
+        raise ValueError("partial_sum expects 2-D inputs")
+    start, plen = _partial_slice_bounds(int(ts[0].shape[1]),
+                                        start_index, length)
+
+    def fn(*vs):
+        acc = vs[0][:, start:start + plen]
+        for v in vs[1:]:
+            acc = acc + v[:, start:start + plen]
+        return acc
+
+    return apply(fn, *ts, name="partial_sum")
+
+
+# ------------------------------------------------------------------- TDM
+def tdm_child(x, tree_info, child_nums: int, dtype="int32", name=None):
+    """Children lookup in a TDM tree. ``tree_info`` rows are
+    ``[item_id, layer_id, ancestor_id, child_0..child_{n-1}]``; node 0 is
+    the padding node. Returns ``(child, leaf_mask)`` of shape
+    ``x.shape + (child_nums,)``; nodes without children emit zeros with
+    mask 0, a child's mask is 1 iff its item_id != 0 (leaf).
+
+    reference: incubate/layers/nn.py:488 + cpu/tdm_child_kernel.cc
+    (TDMChildInner).
+    """
+    xt = as_tensor(x)
+    info = as_tensor(tree_info)
+    odt = jnp.int64 if str(dtype) in ("int64", "paddle.int64") else jnp.int32
+
+    def fn(ids, ti):
+        ids = ids.astype(jnp.int32)
+        has_child = (ids != 0) & (ti[ids, 3] != 0)
+        child = ti[ids[..., None], 3 + jnp.arange(child_nums)]
+        child = jnp.where(has_child[..., None], child, 0)
+        leaf = jnp.where(has_child[..., None], (ti[child, 0] != 0), False)
+        return child.astype(odt), leaf.astype(odt)
+
+    return apply(fn, xt, info, name="tdm_child", multi_out=True)
+
+
+def tdm_sampler(x, travel, layer, neg_samples_num_list: Sequence[int],
+                layer_offset_lod: Sequence[int], output_positive: bool = True,
+                output_list: bool = False, seed: int = 0,
+                dtype="int32", name=None):
+    """Layer-wise negative sampling over a TDM tree.
+
+    For each input leaf id ``i`` and tree layer ``l``: the positive node
+    is ``travel[i, l]`` (0 = padding -> zeros with mask 0), plus
+    ``neg_samples_num_list[l]`` negatives drawn uniformly without
+    replacement from that layer's nodes (``layer`` flat array sliced by
+    ``layer_offset_lod``), never equal to the positive. Returns
+    ``(out, label, mask)`` each ``(N, sum(neg + output_positive))``, or
+    per-layer splits when ``output_list``.
+
+    reference: incubate/layers/nn.py:583 + cpu/tdm_sampler_kernel.cc
+    (TDMSamplerInner). Host-side numpy (sampling is data prep, like
+    incubate/graph.py samplers).
+    """
+    ids = _np(x).reshape(-1).astype(np.int64)
+    trav = _np(travel)
+    lay = _np(layer).reshape(-1)
+    offs = list(layer_offset_lod)
+    layer_nums = len(neg_samples_num_list)
+    if trav.ndim == 1:
+        trav = trav.reshape(-1, layer_nums)
+    widths = [n + int(output_positive) for n in neg_samples_num_list]
+    res_len = sum(widths)
+    n_ids = len(ids)
+    odt = np.int64 if str(dtype) in ("int64", "paddle.int64") else np.int32
+    out = np.zeros((n_ids, res_len), odt)
+    label = np.zeros((n_ids, res_len), odt)
+    mask = np.ones((n_ids, res_len), odt)
+    rng = np.random.default_rng(seed if seed else None)
+    for i, leaf in enumerate(ids):
+        off = 0
+        for l_idx in range(layer_nums):
+            k = neg_samples_num_list[l_idx]
+            node_lo, node_hi = offs[l_idx], offs[l_idx + 1]
+            node_nums = node_hi - node_lo
+            if k > node_nums - 1:
+                raise ValueError(
+                    f"neg_samples_num_list[{l_idx}]={k} must be <= layer "
+                    f"node count - 1 ({node_nums - 1})")
+            pos = int(trav[leaf, l_idx])
+            w = widths[l_idx]
+            if pos == 0:  # padding layer for this leaf
+                out[i, off:off + w] = 0
+                label[i, off:off + w] = 0
+                mask[i, off:off + w] = 0
+                off += w
+                continue
+            if output_positive:
+                out[i, off] = pos
+                label[i, off] = 1
+                off += 1
+            layer_nodes = lay[node_lo:node_hi]
+            cand = np.flatnonzero(layer_nodes != pos)
+            sel = rng.choice(len(cand), size=k, replace=False)
+            out[i, off:off + k] = layer_nodes[cand[sel]]
+            label[i, off:off + k] = 0
+            off += k
+    outs = (Tensor(out), Tensor(label), Tensor(mask))
+    if output_list:
+        splits = np.cumsum(widths)[:-1]
+        return tuple([Tensor(p) for p in np.split(_np(t), splits, axis=1)]
+                     for t in outs)
+    return outs
+
+
+# --------------------------------------------------------- rank attention
+def rank_attention(input, rank_offset, rank_param, max_rank: int = 3,
+                   max_size: int = 0, name=None):
+    """Rank-aware attention for rec-sys ranking.
+
+    ``rank_offset`` rows are ``[rank_i, (rank_j_1, ins_1), ...,
+    (rank_j_k, ins_k)]`` (1-based ranks, 0 = absent). For instance ``i``
+    the expanded feature block k is ``input[ins_k]`` and the per-instance
+    weight block is ``rank_param`` block ``(rank_i-1)*max_rank +
+    (rank_j_k-1)`` of shape (D, out); output is the sum of block matmuls.
+
+    ``rank_param`` shape: ``(D * max_rank * max_rank, out)``; ``max_size``
+    is a GPU scratch-buffer hint in the reference — ignored here.
+
+    reference: incubate/layers/nn.py:863 + funcs/rank_attention.cu.h
+    (expand_input_by_rank_kernel / expand_rank_attention_param_kernel).
+    Functional deviation: the weight is passed in, not created from a
+    ParamAttr (MIGRATION.md).
+    """
+    xt, ro, pt = as_tensor(input), as_tensor(rank_offset), \
+        as_tensor(rank_param)
+    d = int(xt.shape[1])
+    out_col = int(pt.shape[1])
+    if int(pt.shape[0]) != d * max_rank * max_rank:
+        raise ValueError("rank_param rows must equal D * max_rank^2")
+
+    def fn(x, off, p):
+        off = off.astype(jnp.int32)
+        lower = off[:, 0] - 1                       # (N,)
+        pr = p.reshape(max_rank * max_rank, d, out_col)
+        acc = jnp.zeros((x.shape[0], out_col), x.dtype)
+        for k in range(max_rank):
+            faster = off[:, 2 * k + 1] - 1
+            idx = off[:, 2 * k + 2]
+            valid = (lower >= 0) & (faster >= 0)
+            xk = jnp.where(valid[:, None], x[idx], 0)            # (N, D)
+            blk = jnp.clip(lower * max_rank + faster, 0, None)
+            wk = jnp.where(valid[:, None, None], pr[blk], 0)     # (N,D,O)
+            acc = acc + jnp.einsum("nd,ndo->no", xk, wk)
+        return acc
+
+    return apply(fn, xt, ro, pt, name="rank_attention", nondiff=(1,))
+
+
+def batch_fc(input, w, bias=None, act: Optional[str] = None, name=None):
+    """Per-slot batched FC: ``out[s] = act(input[s] @ w[s] + bias[s])``
+    with input (S, N, D), w (S, D, O), bias (S, O).
+
+    reference: incubate/layers/nn.py:932 + cpu batch_fc kernel (slot-major
+    batched gemm + bias + activation). Weight passed functionally.
+    """
+    xt, wt = as_tensor(input), as_tensor(w)
+    args = [xt, wt]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def fn(x, wv, *rest):
+        y = jnp.einsum("snd,sdo->sno", x, wv)
+        if rest:
+            y = y + rest[0][:, None, :]
+        if act == "relu":
+            y = jax.nn.relu(y)
+        elif act == "sigmoid":
+            y = jax.nn.sigmoid(y)
+        elif act == "tanh":
+            y = jnp.tanh(y)
+        elif act is not None:
+            raise ValueError(f"unsupported act {act!r}")
+        return y
+
+    return apply(fn, *args, name="batch_fc")
+
+
+# ------------------------------------------------------------ correlation
+def correlation(x, y, pad_size: int, kernel_size: int, max_displacement: int,
+                stride1: int, stride2: int, corr_type_multiply: int = 1,
+                name=None):
+    """FlowNet correlation cost volume over NCHW pairs.
+
+    ``out[n, (tj,ti), oh, ow]`` = mean over the kernel window and channels
+    of ``x[.., h1+j, w1+i] * y[.., h1+tj*stride2+j, w1+ti*stride2+i]``
+    with ``h1 = oh*stride1 + max_displacement`` on zero-padded inputs;
+    displacement channels enumerate ``tj, ti`` in
+    ``[-max_displacement/stride2, +max_displacement/stride2]``
+    row-major.
+
+    reference: incubate/layers/nn.py:1003 + gpu/correlation_kernel.cu
+    (correlation_forward; CPU raises Unimplemented there — this jnp
+    version runs on every backend, a strict capability win).
+    """
+    xt, yt = as_tensor(x), as_tensor(y)
+    krad = (kernel_size - 1) // 2
+    drad = max_displacement // stride2
+    n, c, h, w = (int(s) for s in xt.shape)
+    hp, wp = h + 2 * pad_size, w + 2 * pad_size
+    border = krad + max_displacement
+    out_h = int(math.ceil(float(hp - 2 * border) / stride1))
+    out_w = int(math.ceil(float(wp - 2 * border) / stride1))
+    if out_h <= 0 or out_w <= 0:
+        raise ValueError("correlation output is empty; check pad/kernel/"
+                         "displacement geometry")
+    nelems = kernel_size * kernel_size * c
+    marg = drad * stride2
+
+    def fn(xv, yv):
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (pad_size, pad_size),
+                          (pad_size, pad_size)))
+        # extra margin so displaced windows never index out of bounds
+        yp = jnp.pad(yv, ((0, 0), (0, 0),
+                          (pad_size + marg, pad_size + marg),
+                          (pad_size + marg, pad_size + marg)))
+        planes = []
+        for tj in range(-drad, drad + 1):
+            for ti in range(-drad, drad + 1):
+                dy, dx = tj * stride2 + marg, ti * stride2 + marg
+                ysh = lax.dynamic_slice(
+                    yp, (0, 0, dy, dx), (n, c, hp, wp))
+                prod = jnp.sum(xp * ysh, axis=1)          # (N, Hp, Wp)
+                pk = jnp.pad(prod, ((0, 0), (krad, krad), (krad, krad)))
+                win = lax.reduce_window(
+                    pk, 0.0, lax.add,
+                    (1, kernel_size, kernel_size), (1, 1, 1), "VALID")
+                rows = max_displacement + stride1 * jnp.arange(out_h)
+                cols = max_displacement + stride1 * jnp.arange(out_w)
+                planes.append(win[:, rows[:, None], cols[None, :]]
+                              / nelems)
+        return jnp.stack(planes, axis=1)                   # (N, D^2, oh, ow)
+
+    return apply(fn, xt, yt, name="correlation")
+
+
+# ----------------------------------------------------- legacy kernel ops
+def affine_channel(x, scale, bias, data_layout: str = "NCHW", name=None):
+    """Per-channel affine: ``y = x * scale[c] + bias[c]``.
+
+    reference: cpu/affine_channel_kernel.cc (kernel-only in this
+    snapshot; NCHW/NHWC layouts).
+    """
+    xt, st, bt = as_tensor(x), as_tensor(scale), as_tensor(bias)
+    ch_axis = 1 if data_layout in ("NCHW", "NCDHW") else -1
+
+    def fn(v, s, b):
+        shape = [1] * v.ndim
+        shape[ch_axis] = -1
+        return v * s.reshape(shape) + b.reshape(shape)
+
+    return apply(fn, xt, st, bt, name="affine_channel")
+
+
+def add_position_encoding(x, alpha: float, beta: float, name=None):
+    """Scaled sinusoidal position encoding over (B, L, D) input:
+    ``out[..., k] = x*alpha + sin(pos / 10000^(k/(D/2-1)))*beta`` for the
+    first half of D, ``cos`` for the second half.
+
+    reference: cpu/add_position_encoding_kernel.cc (kernel-only; the
+    LoD 2-D form maps to padded 3-D here).
+    """
+    xt = as_tensor(x)
+    if xt.ndim != 3:
+        raise ValueError("add_position_encoding expects (batch, seq, dim)")
+    d = int(xt.shape[-1])
+    if d % 2:
+        raise ValueError("feature size must be even")
+    half = d // 2
+
+    def fn(v):
+        pos = jnp.arange(v.shape[1], dtype=jnp.float32)[:, None]
+        k = jnp.arange(half, dtype=jnp.float32)[None, :]
+        div = jnp.power(10000.0, k / (half - 1)) if half > 1 \
+            else jnp.full((1, 1), 10000.0)
+        val = pos / div                                    # (L, half)
+        enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=-1)
+        return v * alpha + enc[None].astype(v.dtype) * beta
+
+    return apply(fn, xt, name="add_position_encoding")
+
+
+def box_clip(input, im_info, pixel_offset: bool = True, name=None):
+    """Clip (B, M, 4) boxes to per-image bounds derived from ``im_info``
+    rows ``[h, w, scale]``: width/height are ``round(w/scale)`` minus a
+    1-pixel offset. reference: impl/box_clip_kernel_impl.h
+    (ClipTiledBoxes; the LoD slice loop maps to the leading batch dim).
+    """
+    bt, it = as_tensor(input), as_tensor(im_info)
+
+    def fn(boxes, info):
+        offset = 1.0 if pixel_offset else 0.0
+        im_w = jnp.round(info[:, 1] / info[:, 2]) - offset
+        im_h = jnp.round(info[:, 0] / info[:, 2]) - offset
+        shape = (-1,) + (1,) * (boxes.ndim - 2)
+        im_w, im_h = im_w.reshape(shape), im_h.reshape(shape)
+        x1 = jnp.minimum(jnp.clip(boxes[..., 0], 0, None), im_w)
+        y1 = jnp.minimum(jnp.clip(boxes[..., 1], 0, None), im_h)
+        x2 = jnp.minimum(jnp.clip(boxes[..., 2], 0, None), im_w)
+        y2 = jnp.minimum(jnp.clip(boxes[..., 3], 0, None), im_h)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return apply(fn, bt, it, name="box_clip")
+
+
+def bipartite_match(dist_matrix, match_type: str = "bipartite",
+                    dist_threshold: Optional[float] = None, name=None):
+    """Greedy bipartite matching on a (row, col) distance matrix — each
+    round matches the globally-largest remaining (row, col) pair; with
+    ``match_type='per_prediction'`` unmatched columns then take their
+    argmax row if it clears ``dist_threshold``.
+
+    Returns ``(match_indices, match_dist)`` of shape (1, col) (or
+    (B, col) for a batched 3-D input): column j's matched row or -1.
+
+    reference: cpu/bipartite_match_kernel.cc (BipartiteMatch greedy path
+    + ArgMaxMatch). Host-side numpy — the output feeds CPU-side target
+    assignment, not the hot path.
+    """
+    dm = _np(dist_matrix).astype(np.float64)
+    batched = dm.ndim == 3
+    mats = dm if batched else dm[None]
+    eps = 1e-6
+    all_idx, all_dist = [], []
+    for mat in mats:
+        row, col = mat.shape
+        midx = np.full((col,), -1, np.int32)
+        mdist = np.zeros((col,), np.float32)
+        pool = mat.copy()
+        row_free = np.ones((row,), bool)
+        while row_free.any():
+            sub = np.where(row_free[:, None] & (midx[None, :] == -1),
+                           pool, -np.inf)
+            sub = np.where(sub < eps, -np.inf, sub)
+            if not np.isfinite(sub).any():
+                break
+            r, cc = np.unravel_index(np.argmax(sub), sub.shape)
+            midx[cc] = r
+            mdist[cc] = mat[r, cc]
+            row_free[r] = False
+        if match_type == "per_prediction":
+            thr = 0.5 if dist_threshold is None else dist_threshold
+            for j in range(col):
+                if midx[j] != -1:
+                    continue
+                colv = mat[:, j]
+                r = int(np.argmax(colv))
+                if colv[r] >= thr and colv[r] >= eps:
+                    midx[j] = r
+                    mdist[j] = colv[r]
+        elif match_type != "bipartite":
+            raise ValueError(f"unknown match_type {match_type!r}")
+        all_idx.append(midx)
+        all_dist.append(mdist)
+    ii, dd = np.stack(all_idx), np.stack(all_dist)
+    return Tensor(ii), Tensor(dd)
+
+
+def ctc_align(input, input_length, blank: int = 0,
+              merge_repeated: bool = True, padding_value: int = 0,
+              name=None):
+    """CTC decode alignment: drop blanks (and merged repeats) from each
+    row of (B, L) int tokens, left-compact, pad with ``padding_value``.
+    Returns ``(output, output_length)``.
+
+    reference: impl/ctc_align_kernel_impl.h (padded-tensor branch; the
+    LoD branch is the legacy flat form).
+    """
+    xt, lt = as_tensor(input), as_tensor(input_length)
+
+    def fn(v, ln):
+        L = v.shape[1]
+        pos = jnp.arange(L)[None, :]
+        in_len = ln.reshape(-1, 1).astype(jnp.int32)
+        prev = jnp.concatenate(
+            [jnp.full((v.shape[0], 1), -1, v.dtype), v[:, :-1]], axis=1)
+        keep = (v != blank) & (pos < in_len)
+        if merge_repeated:
+            keep &= v != prev
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        gathered = jnp.take_along_axis(v, order, axis=1)
+        out_len = keep.sum(axis=1)
+        out = jnp.where(pos < out_len[:, None], gathered,
+                        jnp.asarray(padding_value, v.dtype))
+        return out, out_len.astype(v.dtype)
+
+    return apply(fn, xt, lt, name="ctc_align", multi_out=True)
+
+
+def im2sequence(input, kernels: Sequence[int], strides: Sequence[int] =
+                (1, 1), paddings: Sequence[int] = (0, 0, 0, 0), name=None):
+    """Image to patch-sequence: (N, C, H, W) -> (N*oh*ow, C*kh*kw), each
+    row one (C, kh, kw) patch, positions row-major, batches contiguous.
+    ``paddings`` is (up, left, down, right).
+
+    reference: impl/im2sequence_kernel_impl.h (static-shape branch; the
+    real-size LoD branch is per-image crop — slice before calling).
+    """
+    xt = as_tensor(input)
+    kh, kw = kernels
+    sh, sw = strides
+    pu, pl, pd, pr = paddings
+    n, c, h, w = (int(s) for s in xt.shape)
+    oh = (h + pu + pd - kh) // sh + 1
+    ow = (w + pl + pr - kw) // sw + 1
+
+    def fn(v):
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+        rows = []
+        for kj in range(kh):
+            for ki in range(kw):
+                rows.append(lax.slice(
+                    vp, (0, 0, kj, ki),
+                    (n, c, kj + (oh - 1) * sh + 1, ki + (ow - 1) * sw + 1),
+                    (1, 1, sh, sw)))                     # (N, C, oh, ow)
+        pat = jnp.stack(rows, axis=2).reshape(n, c, kh, kw, oh, ow)
+        pat = pat.transpose(0, 4, 5, 1, 2, 3)
+        return pat.reshape(n * oh * ow, c * kh * kw)
+
+    return apply(fn, xt, name="im2sequence")
+
+
+# -------------------------------------------------------------- chunk_eval
+_CHUNK_SCHEMES = {
+    # num_tag_types, (begin, inside, end, single)
+    "IOB": (2, (0, 1, -1, -1)),
+    "IOE": (2, (-1, 0, 1, -1)),
+    "IOBES": (4, (0, 1, 2, 3)),
+    "plain": (1, (-1, -1, -1, -1)),
+}
+
+
+def _chunk_segments(labels, num_chunk_types, scheme):
+    num_tag, (tb, ti_, te, ts) = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(ptag, ptype, tag, typ):
+        if ptype == other:
+            return False
+        if typ == other or typ != ptype:
+            return True
+        if ptag == tb or ptag == ti_:
+            return tag in (tb, ts)
+        if ptag in (te, ts):
+            return True
+        return False
+
+    def chunk_begin(ptag, ptype, tag, typ):
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == tb or tag == ts:
+            return True
+        if tag in (ti_, te):
+            return ptag in (te, ts)
+        return False
+
+    segs = []
+    in_chunk, start = False, 0
+    tag, typ = -1, other
+    for i, lab in enumerate(labels):
+        ptag, ptype = tag, typ
+        lab = int(lab)
+        if lab > num_chunk_types * num_tag:
+            raise ValueError(f"label {lab} out of range")
+        tag, typ = lab % num_tag, lab // num_tag
+        if in_chunk and chunk_end(ptag, ptype, tag, typ):
+            segs.append((start, i - 1, ptype))
+            in_chunk = False
+        if chunk_begin(ptag, ptype, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return segs
+
+
+def chunk_eval(input, label, chunk_scheme: str, num_chunk_types: int,
+               excluded_chunk_types: Optional[Sequence[int]] = None,
+               seq_length=None, name=None):
+    """Chunking (NER) precision/recall/F1 over (B, L) int64 tag batches
+    with per-row ``seq_length``. Labels encode ``type * num_tag_types +
+    tag`` with scheme IOB / IOE / IOBES / plain; type ``num_chunk_types``
+    is "other/outside".
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks).
+
+    reference: impl/chunk_eval_kernel_impl.h (GetSegments / ChunkBegin /
+    ChunkEnd / EvalOneSeq). Host-side numpy metric.
+    """
+    if chunk_scheme not in _CHUNK_SCHEMES:
+        raise ValueError(f"unknown chunk scheme {chunk_scheme!r}")
+    inf = _np(input)
+    lab = _np(label)
+    if inf.ndim == 1:
+        inf, lab = inf[None], lab[None]
+    if seq_length is None:
+        lens = np.full((inf.shape[0],), inf.shape[1], np.int64)
+    else:
+        lens = _np(seq_length).reshape(-1).astype(np.int64)
+    excl = set(excluded_chunk_types or ())
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        segs_o = _chunk_segments(inf[b, :L], num_chunk_types, chunk_scheme)
+        segs_l = _chunk_segments(lab[b, :L], num_chunk_types, chunk_scheme)
+        i = j = 0
+        while i < len(segs_o) and j < len(segs_l):
+            if segs_o[i] == segs_l[j] and segs_o[i][2] not in excl:
+                n_cor += 1
+            if segs_o[i][1] < segs_l[j][1]:
+                i += 1
+            elif segs_o[i][1] > segs_l[j][1]:
+                j += 1
+            else:
+                i += 1
+                j += 1
+        n_inf += sum(1 for s in segs_o if s[2] not in excl)
+        n_lab += sum(1 for s in segs_l if s[2] not in excl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if n_cor else 0.0
+    return (Tensor(np.float32(prec)), Tensor(np.float32(rec)),
+            Tensor(np.float32(f1)), Tensor(np.int64(n_inf)),
+            Tensor(np.int64(n_lab)), Tensor(np.int64(n_cor)))
+
+
+# ------------------------------------------------------------ detection_map
+def detection_map(detect_res, gt_label, class_num: int,
+                  background_label: int = 0,
+                  overlap_threshold: float = 0.5,
+                  evaluate_difficult: bool = True,
+                  ap_version: str = "integral", state=None, name=None):
+    """VOC-style detection mAP with streaming accumulation.
+
+    ``detect_res``: per-image list of (n_i, 6) arrays
+    ``[label, score, xmin, ymin, xmax, ymax]`` (the reference's LoD rows
+    become a python list — TPU-native host metric). ``gt_label``:
+    per-image list of (m_i, 5) ``[label, xmin, ymin, xmax, ymax]`` or
+    (m_i, 6) with a ``difficult`` flag after label. ``state`` is the
+    previous call's returned state for cross-batch accumulation (the
+    kernel's HasState/PosCount streaming inputs). Returns
+    ``(mAP_tensor, state)``.
+
+    reference: cpu/detection_map_kernel.cc (CalcTrueAndFalsePositive /
+    CalcMAP; pred boxes are clipped to [0,1] before the Jaccard overlap,
+    matching ClipBBox — coordinates are normalized).
+    """
+    if ap_version not in ("integral", "11point"):
+        raise ValueError(f"unknown ap_version {ap_version!r}")
+    label_pos = dict(state[0]) if state else {}
+    true_pos = {k: list(v) for k, v in state[1].items()} if state else {}
+    false_pos = {k: list(v) for k, v in state[2].items()} if state else {}
+
+    def _iou(b1, b2):
+        if (b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3]
+                or b2[3] < b1[1]):
+            return 0.0
+        ix1, iy1 = max(b1[0], b2[0]), max(b1[1], b2[1])
+        ix2, iy2 = min(b1[2], b2[2]), min(b1[3], b2[3])
+        inter = (ix2 - ix1) * (iy2 - iy1)
+        a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+        a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+        return inter / (a1 + a2 - inter)
+
+    gts, dets = [], []
+    for img_gt, img_det in zip(gt_label, detect_res):
+        g = _np(img_gt).astype(np.float64).reshape(-1, _np(img_gt).shape[-1]
+                                                   if _np(img_gt).size else 5)
+        d = _np(img_det).astype(np.float64).reshape(
+            -1, 6) if _np(img_det).size else np.zeros((0, 6))
+        by_label: dict = {}
+        for r in g:
+            if len(r) == 6:
+                lab, diff, box = int(r[0]), bool(r[1]), r[2:6]
+            else:
+                lab, diff, box = int(r[0]), False, r[1:5]
+            by_label.setdefault(lab, []).append((box, diff))
+        gts.append(by_label)
+        dby: dict = {}
+        for r in d:
+            dby.setdefault(int(r[0]), []).append((float(r[1]), r[2:6]))
+        dets.append(dby)
+
+    # label_pos_count (reference: first loop of CalcTrueAndFalsePositive)
+    for by_label in gts:
+        for lab, boxes in by_label.items():
+            cnt = len(boxes) if evaluate_difficult else \
+                sum(1 for _, diff in boxes if not diff)
+            if cnt:
+                label_pos[lab] = label_pos.get(lab, 0) + cnt
+
+    for by_label, dby in zip(gts, dets):
+        for lab, preds in dby.items():
+            if lab not in by_label:
+                for score, _ in preds:
+                    true_pos.setdefault(lab, []).append((score, 0))
+                    false_pos.setdefault(lab, []).append((score, 1))
+                continue
+            matched = by_label[lab]
+            visited = [False] * len(matched)
+            for score, box in sorted(preds, key=lambda p: -p[0]):
+                box = np.clip(box, 0.0, 1.0)
+                ious = [_iou(box, m[0]) for m in matched]
+                mi = int(np.argmax(ious)) if ious else 0
+                if ious and ious[mi] > overlap_threshold:
+                    if evaluate_difficult or not matched[mi][1]:
+                        hit = 0 if visited[mi] else 1
+                        visited[mi] |= bool(hit)
+                        true_pos.setdefault(lab, []).append((score, hit))
+                        false_pos.setdefault(lab, []).append(
+                            (score, 1 - hit))
+                else:
+                    true_pos.setdefault(lab, []).append((score, 0))
+                    false_pos.setdefault(lab, []).append((score, 1))
+
+    # CalcMAP
+    m_ap, count = 0.0, 0
+    for lab, num_pos in label_pos.items():
+        # skip the background CLASS (the reference kernel compares the
+        # positive COUNT to background_label — detection_map_kernel.cc
+        # CalcMAP `label_num_pos == background_label` — which includes
+        # background in mAP and drops classes whose count collides;
+        # deliberate deviation to the correct VOC semantics)
+        if lab == background_label:
+            continue
+        if lab not in true_pos:
+            count += 1
+            continue
+        tp = sorted(true_pos[lab], key=lambda p: -p[0])
+        fp = sorted(false_pos[lab], key=lambda p: -p[0])
+        tp_sum = np.cumsum([f for _, f in tp])
+        fp_sum = np.cumsum([f for _, f in fp])
+        prec = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+        rec = tp_sum / num_pos
+        if ap_version == "11point":
+            maxp = np.zeros(11)
+            start = len(rec) - 1
+            for j in range(10, -1, -1):
+                for i in range(start, -1, -1):
+                    if rec[i] < j / 10.0:
+                        start = i
+                        if j > 0:
+                            maxp[j - 1] = maxp[j]
+                        break
+                    maxp[j] = max(maxp[j], prec[i])
+            m_ap += maxp.sum() / 11
+        else:
+            prev_r, ap = 0.0, 0.0
+            for p, r in zip(prec, rec):
+                if abs(r - prev_r) > 1e-6:
+                    ap += p * abs(r - prev_r)
+                prev_r = r
+            m_ap += ap
+        count += 1
+    if count:
+        m_ap /= count
+    return Tensor(np.float32(m_ap)), (dict(label_pos),
+                                      {k: list(v) for k, v in
+                                       true_pos.items()},
+                                      {k: list(v) for k, v in
+                                       false_pos.items()})

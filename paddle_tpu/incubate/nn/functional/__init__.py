@@ -210,12 +210,9 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             and position_ids is None and q is not None and k is not None
             and v is None):
         from ....ops.pallas import fused as _pf
-        # the kernel reads (S, D) tables whose two halves repeat
-        cos_full = jnp.concatenate([cos_t, cos_t], axis=-1)
-        sin_full = jnp.concatenate([sin_t, sin_t], axis=-1)
 
         def frope(qv, kv):
-            return _pf.rope_qk(qv, kv, cos_full, sin_full)
+            return _pf.rope_qk(qv, kv, cos_t, sin_t)   # (S, D/2) tables
         rq, rk = apply(frope, as_tensor(q), as_tensor(k),
                        name="fused_rope", multi_out=True)
         return rq, rk, None

@@ -3,3 +3,4 @@
 from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401
 from .modelaverage import ModelAverage  # noqa: F401
 from .lookahead import LookAhead  # noqa: F401
+from .legacy import Ftrl, Dpsgd  # noqa: F401

@@ -1,0 +1,88 @@
+"""Legacy per-kernel optimizers kept registered in the reference op set
+(paddle/phi/ops/yaml/ops.yaml: ftrl, dpsgd) whose python wrappers lived in
+the removed fluid.optimizer module — parity home here, following the
+framework's functional update-rule contract (optimizer/optimizer.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (McMahan et al., "Ad Click Prediction").
+
+    Update (reference: paddle/phi/kernels/impl/ftrl_kernel_impl.h
+    FTRLOpKernel, incl. the kernel's own l1/l2 += 1e-10 bias):
+        new_acc = s + g^2
+        linear += g - (new_acc^{-p} - s^{-p}) / lr * param
+        param   = (l1*sign(linear) - linear) /
+                  (new_acc^{-p}/lr + 2*l2)   if |linear| > l1 else 0
+        s       = new_acc
+    """
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _slots(self):
+        return ("squared_accum", "linear_accum")
+
+    def _context(self):
+        return {"l1": self._l1 + 1e-10, "l2": self._l2 + 1e-10,
+                "p": self._lr_power}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        l1, l2, pw = ctx["l1"], ctx["l2"], ctx["p"]
+        g = g.astype(jnp.float32)
+        s = state["squared_accum"]
+        new_acc = s + g * g
+        # pow(-pw) on s==0 with pw=-0.5 is sqrt(0)=0; general powers keep
+        # the kernel's pow semantics
+        lin = state["linear_accum"] + g - \
+            (jnp.power(new_acc, -pw) - jnp.power(s, -pw)) / lr * p
+        x = l1 * jnp.sign(lin) - lin
+        y = jnp.power(new_acc, -pw) / lr + 2.0 * l2
+        state["squared_accum"] = new_acc
+        state["linear_accum"] = lin
+        return jnp.where(jnp.abs(lin) > l1, x / y, 0.0).astype(p.dtype), \
+            state
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (Abadi et al., CCS'16).
+
+    Per step and per parameter tensor: scale the gradient down when its
+    L2 norm exceeds ``clip`` (scale = norm/clip), add one gaussian noise
+    draw ``N(0, sigma^2)/batch_size``, and apply SGD.
+
+    reference: paddle/phi/kernels/cpu/dpsgd_kernel.cc (DpsgdOpKernel).
+    Deviation (MIGRATION.md): noise comes from the JAX counter-based PRNG
+    (seeded, reproducible) instead of the kernel's Box-Muller over
+    minstd_rand — the distribution is identical, the stream is not.
+    """
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, seed=0, parameters=None, name=None):
+        super().__init__(learning_rate, parameters, None, None)
+        self._clip, self._bs, self._sigma = clip, batch_size, sigma
+        self._seed = seed
+
+    def _slots(self):
+        return ()
+
+    def _context(self):
+        return {"clip": self._clip, "bs": self._bs, "sigma": self._sigma,
+                "seed": self._seed}
+
+    def _update_rule(self, p, g, state, lr, ctx):
+        g = g.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(g * g))
+        scale = jnp.where(norm > ctx["clip"], norm / ctx["clip"], 1.0)
+        key = jax.random.fold_in(jax.random.key(ctx["seed"]),
+                                 jnp.asarray(ctx["step"], jnp.uint32))
+        noise = jax.random.normal(key, ()) * ctx["sigma"]
+        return (p - lr * (g / scale + noise / ctx["bs"])).astype(p.dtype), \
+            state

@@ -281,15 +281,11 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
     q = tpact(h1 @ lp["wq"]).reshape(B, S, nh, hd)
     k = tpact(h1 @ lp["wk"]).reshape(B, S, nkv, hd)
     v = tpact(h1 @ lp["wv"]).reshape(B, S, nkv, hd)
-    if fused:
-        from ..ops.pallas import fused as _pf
-        # the kernel reads (S, hd) tables whose two halves repeat
-        cos_f = jnp.concatenate([cos, cos], axis=-1)
-        sin_f = jnp.concatenate([sin, sin], axis=-1)
-        q, k = _pf.rope_qk(q, k, cos_f, sin_f)
-    else:
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+    # rope stays XLA even when fused=True: it folds into the qkv matmul
+    # epilogue for free, while the pallas rope kernel needs its halves
+    # split/concatenated outside the kernel (extra HBM passes)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
     if cp:
         from jax import shard_map
         from ..distributed.fleet.meta_parallel.context_parallel import (

@@ -83,7 +83,12 @@ def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref, *, eps,
     # dx = rstd * (wg - xhat * mean(wg * xhat))
     m = jnp.mean(wg * xhat, axis=-1, keepdims=True)
     dx_ref[...] = (rstd * (wg - xhat * m)).astype(dx_ref.dtype)
-    dwp_ref[...] = jnp.sum(g * xhat, axis=0, keepdims=True)  # block dw
+    # per-block dw partial, padded to an (8, h) tile: Mosaic requires the
+    # second-to-last block dim divisible by 8 (a (1, h) block fails to
+    # lower on hardware); row 0 carries the sum, rows 1-7 are zero
+    part = jnp.sum(g * xhat, axis=0, keepdims=True)          # (1, h)
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, part.shape[-1]), 0)
+    dwp_ref[...] = jnp.where(row == 0, part, 0.0)[None]
 
 
 def _rms_norm_bwd(eps, block_rows, res, g):
@@ -100,12 +105,12 @@ def _rms_norm_bwd(eps, block_rows, res, g):
                   pl.BlockSpec((br, 1), lambda i: (i, 0)),
                   pl.BlockSpec((br, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+                   pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, h), x.dtype),
-                   jax.ShapeDtypeStruct((nb, h), jnp.float32)],
+                   jax.ShapeDtypeStruct((nb, 8, h), jnp.float32)],
         interpret=_interp(),
     )(x, w, rstd, g)
-    return dx, jnp.sum(dwp, axis=0).astype(w.dtype)
+    return dx, jnp.sum(dwp, axis=(0, 1)).astype(w.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -205,38 +210,47 @@ def swiglu(g, u, block_rows: int = 256):
 
 
 # ---------------- fused RoPE (q and k in one launch) ----------------
-def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, sign):
-    x = x_ref[...].astype(jnp.float32)          # (1, bs, h*d)
-    c = cos_ref[...].astype(jnp.float32)        # (bs, d)
+def _rope_kernel(x1_ref, x2_ref, cos_ref, sin_ref, o1_ref, o2_ref, *,
+                 sign):
+    # pure elementwise on pre-split halves: Mosaic rejects both lane-dim
+    # slices at `half` (gather rule) and lane-splitting in-kernel
+    # reshapes ("unsupported shape cast") — round-2's packed kernel hit
+    # both on real hardware while CPU interpret mode hid it. The halves
+    # and the per-head table tiling are prepared outside, in XLA.
+    x1 = x1_ref[...].astype(jnp.float32)
+    x2 = x2_ref[...].astype(jnp.float32)
+    c = cos_ref[...].astype(jnp.float32)
     s = sin_ref[...].astype(jnp.float32) * sign
-    bs = x.shape[1]
-    d = c.shape[-1]
-    xh = x.reshape(bs, -1, d)                   # (bs, heads, d)
-    half = d // 2
-    x1 = xh[..., :half]
-    x2 = xh[..., half:]
-    c1 = c[:, None, :half]
-    s1 = s[:, None, :half]
-    out = jnp.concatenate([x1 * c1 - x2 * s1, x2 * c1 + x1 * s1], axis=-1)
-    o_ref[...] = out.reshape(1, bs, -1).astype(o_ref.dtype)
+    o1_ref[...] = (x1 * c - x2 * s).astype(o1_ref.dtype)
+    o2_ref[...] = (x2 * c + x1 * s).astype(o2_ref.dtype)
 
 
 def _rope_apply(x, cos, sin, sign, block_seq):
-    """x: (B, S, H, D) -> rotated; cos/sin: (S, D)."""
+    """x: (B, S, H, D) -> rotated; cos/sin: (S, D/2) half tables."""
     B, S, H, D = x.shape
     bs = min(block_seq, S)
-    x3 = x.reshape(B, S, H * D)
-    out = pl.pallas_call(
+    half = D // 2
+    x1 = x[..., :half].reshape(B, S, H * half)
+    x2 = x[..., half:].reshape(B, S, H * half)
+    ct = jnp.tile(cos, (1, H))                   # (S, H*half)
+    st = jnp.tile(sin, (1, H))
+    o1, o2 = pl.pallas_call(
         functools.partial(_rope_kernel, sign=sign),
         grid=(B, pl.cdiv(S, bs)),
-        in_specs=[pl.BlockSpec((1, bs, H * D), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((bs, D), lambda b, i: (i, 0)),
-                  pl.BlockSpec((bs, D), lambda b, i: (i, 0))],
-        out_specs=pl.BlockSpec((1, bs, H * D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, S, H * D), x.dtype),
+        in_specs=[pl.BlockSpec((1, bs, H * half), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, bs, H * half), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((bs, H * half), lambda b, i: (i, 0)),
+                  pl.BlockSpec((bs, H * half), lambda b, i: (i, 0))],
+        out_specs=[pl.BlockSpec((1, bs, H * half),
+                                lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, bs, H * half),
+                                lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, S, H * half), x.dtype),
+                   jax.ShapeDtypeStruct((B, S, H * half), x.dtype)],
         interpret=_interp(),
-    )(x3, cos, sin)
-    return out.reshape(B, S, H, D)
+    )(x1, x2, ct, st)
+    return jnp.concatenate(
+        [o1.reshape(B, S, H, half), o2.reshape(B, S, H, half)], axis=-1)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -262,8 +276,11 @@ _rope_qk.defvjp(_rope_qk_fwd, _rope_qk_bwd)
 
 def rope_qk(q, k, cos, sin, block_seq: int = 256):
     """Fused neox-style RoPE on q and k (reference:
-    fused_rope_kernel.cu). cos/sin: (S, D) tables; q (B,S,H,D),
-    k (B,S,HK,D)."""
+    fused_rope_kernel.cu). cos/sin: (S, D/2) half tables or (S, D)
+    repeated-half tables; q (B,S,H,D), k (B,S,HK,D)."""
+    half = q.shape[-1] // 2
+    if cos.shape[-1] == 2 * half:   # repeated-half layout: halves equal
+        cos, sin = cos[:, :half], sin[:, :half]
     return _rope_qk(q, k, cos.astype(jnp.float32),
                     sin.astype(jnp.float32), block_seq)
 

@@ -309,3 +309,165 @@ def sparse_embedding(*args, **kwargs):
     pull/push-on-backward flow."""
     from ..distributed.ps.the_one_ps import sparse_embedding as _se
     return _se(*args, **kwargs)
+
+
+# -------------------------------------------------- legacy sequence ops
+# (reference: python/paddle/static/nn/sequence_lod.py — the LoD-tensor
+# forms become padded (batch, max_len, width) + ``lengths`` here: dynamic
+# per-row lengths defeat XLA static shapes, and the reference's own
+# padded-tensor branches of these kernels use exactly this layout.)
+
+def continuous_value_model(input, cvm, use_cvm: bool = True):
+    """CVM feature transform for rec-sys CTR models: the first two
+    columns of each row are show/click counters. ``use_cvm=True`` keeps
+    the width and rewrites them to ``log(show+1)`` and ``log(click+1) -
+    log(show+1)``; ``use_cvm=False`` drops both columns. The backward
+    writes the ``cvm`` values into the counter-column grads (reference
+    grad-kernel contract).
+
+    reference: python/paddle/static/nn/common.py:412 +
+    phi/kernels/impl/cvm_kernel_impl.h (CvmComputeKernel /
+    CvmGradComputeKernel).
+    """
+    import jax as _jax
+    from .._core.autograd import apply as _apply
+    from ..ops._registry import as_tensor as _as
+
+    xt, ct = _as(input), _as(cvm)
+
+    @_jax.custom_vjp
+    def _cvm(x, cv):
+        if use_cvm:
+            c0 = jnp.log(x[:, :1] + 1)
+            c1 = jnp.log(x[:, 1:2] + 1) - c0
+            return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+        return x[:, 2:]
+
+    def _fwd(x, cv):
+        return _cvm(x, cv), (cv, x.shape[1])
+
+    def _bwd(res, dy):
+        cv, width = res
+        if use_cvm:
+            body = dy[:, 2:]
+        else:
+            body = dy
+        dx = jnp.concatenate([cv[:, :2].astype(dy.dtype), body], axis=1)
+        return dx, jnp.zeros_like(cv)
+
+    _cvm.defvjp(_fwd, _bwd)
+    return _apply(_cvm, xt, ct, name="cvm", nondiff=(1,))
+
+
+def sequence_pool(input, pool_type: str, lengths=None, is_test=False,
+                  pad_value: float = 0.0):
+    """Pool each sequence of a padded (batch, max_len, width) tensor down
+    to (batch, width). ``pool_type``: average | sum | sqrt (sum /
+    sqrt(len)) | max | last | first; empty sequences yield ``pad_value``.
+
+    reference: python/paddle/static/nn/sequence_lod.py:250 +
+    funcs/sequence_pooling.cc (SequencePoolFunctor).
+    """
+    from .._core.autograd import apply as _apply
+    from ..ops._registry import as_tensor as _as
+    pt = pool_type.lower()
+    if pt not in ("average", "sum", "sqrt", "max", "last", "first"):
+        raise ValueError(f"unsupported pool_type {pool_type!r}")
+    xt = _as(input)
+    if xt.ndim != 3:
+        raise ValueError("sequence_pool expects (batch, max_len, width) + "
+                         "lengths (LoD-free padded form)")
+    b, L = int(xt.shape[0]), int(xt.shape[1])
+    args = [xt]
+    if lengths is not None:
+        args.append(_as(lengths))
+
+    def fn(v, *rest):
+        ln = rest[0].reshape(-1).astype(jnp.int32) if rest else \
+            jnp.full((b,), L, jnp.int32)
+        pos = jnp.arange(L)[None, :, None]
+        valid = pos < ln[:, None, None]
+        lnf = jnp.maximum(ln, 1).astype(v.dtype)[:, None]
+        if pt in ("average", "sum", "sqrt"):
+            s = jnp.where(valid, v, 0).sum(axis=1)
+            out = {"average": s / lnf, "sum": s,
+                   "sqrt": s / jnp.sqrt(lnf)}[pt]
+        elif pt == "max":
+            out = jnp.where(valid, v, -jnp.inf).max(axis=1)
+        elif pt == "first":
+            out = v[:, 0, :]
+        else:  # last
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return jnp.where((ln > 0)[:, None], out,
+                         jnp.asarray(pad_value, v.dtype))
+
+    return _apply(fn, *args, name="sequence_pool")
+
+
+def sequence_first_step(input, lengths=None):
+    """reference: sequence_lod.py:367 — first-timestep pooling."""
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    """reference: sequence_lod.py:425 — last-valid-timestep pooling."""
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_conv(input, filter_weight, lengths=None, context_length=3,
+                  context_start=None, bias=None, act=None):
+    """Context-window convolution over padded (batch, max_len, width)
+    sequences: each position concatenates ``context_length`` rows
+    starting at offset ``context_start`` (default ``-context_length//2``,
+    zeros outside the valid range) and multiplies
+    ``filter_weight (context_length*width, num_filters)``.
+
+    reference: python/paddle/static/nn/sequence_lod.py:23 +
+    impl/sequence_conv_kernel_impl.h (ContextProjectFunctor + gemm).
+    ``padding_trainable`` is not carried over — the reference marks it
+    deprecated/untrainable-by-default; zero padding is the supported
+    contract here.
+    """
+    from .._core.autograd import apply as _apply
+    from ..ops._registry import as_tensor as _as
+    xt, wt = _as(input), _as(filter_weight)
+    if xt.ndim != 3:
+        raise ValueError("sequence_conv expects (batch, max_len, width) + "
+                         "lengths (LoD-free padded form)")
+    start = -int(context_length // 2) if context_start is None \
+        else context_start
+    b, L = int(xt.shape[0]), int(xt.shape[1])
+    args = [xt, wt]
+    if bias is not None:
+        args.append(_as(bias))
+    if lengths is not None:
+        args.append(_as(lengths))
+
+    def fn(v, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias is not None else None
+        ln = rest.pop(0).reshape(-1).astype(jnp.int32) if rest else \
+            jnp.full((b,), L, jnp.int32)
+        pos = jnp.arange(L)
+        valid_row = pos[None, :] < ln[:, None]          # (B, L)
+        cols = []
+        for o in range(start, start + context_length):
+            sh = jnp.roll(v, -o, axis=1)
+            src = pos + o
+            ok = (src >= 0) & (src < ln[:, None])
+            cols.append(jnp.where(ok[..., None], sh, 0))
+        col = jnp.concatenate(cols, axis=-1)            # (B, L, ctx*W)
+        y = jnp.einsum("blc,cf->blf", col, wv)
+        if bv is not None:
+            y = y + bv
+        if act == "relu":
+            y = jnp.maximum(y, 0)
+        elif act == "tanh":
+            y = jnp.tanh(y)
+        elif act is not None:
+            raise ValueError(f"unsupported act {act!r}")
+        return jnp.where(valid_row[..., None], y, 0)
+
+    return _apply(fn, *args, name="sequence_conv")

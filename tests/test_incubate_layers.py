@@ -1,0 +1,422 @@
+"""Behavioral tests for paddle_tpu.incubate.layers (reference:
+python/paddle/incubate/layers/nn.py + the kernel-only legacy ops'
+cpu kernels). Each op runs against an independently-coded numpy oracle
+of the reference kernel's arithmetic (OpTest check_output model,
+test/legacy_test/op_test.py:418)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import layers as L
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _f32(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ------------------------------------------------------------- shuffle
+def test_shuffle_batch_permutes_and_grads():
+    x = _f32(8, 3)
+    xt = _t(x)
+    xt.stop_gradient = False
+    out = L.shuffle_batch(xt, seed=7)
+    arr = out.numpy()
+    # same multiset of rows, deterministic under the seed
+    got = sorted(map(tuple, np.asarray(arr).tolist()))
+    want = sorted(map(tuple, x.tolist()))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    arr2 = L.shuffle_batch(_t(x), seed=7).numpy()
+    np.testing.assert_array_equal(np.asarray(arr), np.asarray(arr2))
+    # backward is the inverse permutation: d(sum)/dx == 1 everywhere
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad.numpy()),
+                               np.ones_like(x))
+
+
+# ------------------------------------------------- partial concat / sum
+@pytest.mark.parametrize("start,length", [(0, -1), (1, 2), (-2, 2), (2, 1)])
+def test_partial_concat(start, length):
+    xs = [_f32(3, 4, seed=s) for s in range(3)]
+    out = L.partial_concat([_t(a) for a in xs], start, length).numpy()
+    s = start if start >= 0 else 4 + start
+    ln = length if length >= 0 else 4 - s
+    want = np.concatenate([a[:, s:s + ln] for a in xs], axis=1)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+
+def test_partial_sum_and_grad():
+    xs = [_t(_f32(3, 4, seed=s)) for s in range(2)]
+    for x in xs:
+        x.stop_gradient = False
+    out = L.partial_sum(xs, 1, 2)
+    want = xs[0].numpy()[:, 1:3] + xs[1].numpy()[:, 1:3]
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(want),
+                               rtol=1e-6)
+    out.sum().backward()
+    g = np.asarray(xs[0].grad.numpy())
+    assert g[:, 1:3].sum() == 6 and g[:, 0].sum() == 0
+
+
+def test_partial_bad_start_raises():
+    with pytest.raises(ValueError):
+        L.partial_sum([_t(_f32(2, 4))], start_index=9)
+
+
+# ------------------------------------------------------------------ tdm
+def _tree_info():
+    # node rows: [item_id, layer_id, ancestor, child0, child1]
+    # tree: 1 -> (2, 3); 2 -> (4, 5); 3 -> (6, 0); 4..6 leaves (item != 0)
+    return np.array([
+        [0, 0, 0, 0, 0],     # padding node
+        [0, 0, 0, 2, 3],     # root (non-item)
+        [0, 1, 1, 4, 5],
+        [0, 1, 1, 6, 0],
+        [9, 2, 2, 0, 0],
+        [8, 2, 2, 0, 0],
+        [7, 2, 3, 0, 0],
+    ], np.int32)
+
+
+def test_tdm_child_matches_reference_walk():
+    info = _tree_info()
+    child, mask = L.tdm_child(_t(np.array([1, 2, 3, 4, 0], np.int32)),
+                              _t(info), child_nums=2)
+    child, mask = np.asarray(child.numpy()), np.asarray(mask.numpy())
+    np.testing.assert_array_equal(child[0], [2, 3])   # root children
+    np.testing.assert_array_equal(mask[0], [0, 0])    # non-items
+    np.testing.assert_array_equal(child[1], [4, 5])
+    np.testing.assert_array_equal(mask[1], [1, 1])    # leaves
+    np.testing.assert_array_equal(child[2], [6, 0])
+    np.testing.assert_array_equal(mask[2], [1, 0])    # child 0 = padding
+    np.testing.assert_array_equal(child[3], [0, 0])   # leaf: no children
+    np.testing.assert_array_equal(child[4], [0, 0])   # node 0: padding
+
+
+def test_tdm_sampler_layerwise_negatives():
+    # travel[leaf] = path root-layer-0 .. layer-1; leaf ids as x
+    travel = np.zeros((7, 2), np.int32)
+    travel[4] = [2, 4]
+    travel[5] = [2, 5]
+    travel[6] = [3, 6]
+    layer = np.array([2, 3, 4, 5, 6], np.int32)   # layer0: [2,3] layer1: [4,5,6]
+    out, label, mask = L.tdm_sampler(
+        _t(np.array([4, 6], np.int32)), _t(travel), _t(layer),
+        neg_samples_num_list=[1, 1], layer_offset_lod=[0, 2, 5], seed=3)
+    out, label, mask = (np.asarray(t.numpy()) for t in (out, label, mask))
+    assert out.shape == (2, 4)
+    np.testing.assert_array_equal(label, [[1, 0, 1, 0], [1, 0, 1, 0]])
+    np.testing.assert_array_equal(mask, np.ones((2, 4)))
+    # positives are the travel path; negatives in-layer and != positive
+    assert out[0, 0] == 2 and out[0, 1] == 3
+    assert out[0, 2] == 4 and out[0, 3] in (5, 6)
+    assert out[1, 0] == 3 and out[1, 1] == 2
+    assert out[1, 2] == 6 and out[1, 3] in (4, 5)
+
+
+def test_tdm_sampler_padding_layer():
+    travel = np.array([[0, 0], [2, 0]], np.int32)  # leaf 1: layer1 padded
+    layer = np.array([2, 3, 4, 5], np.int32)
+    out, label, mask = L.tdm_sampler(
+        _t(np.array([1], np.int32)), _t(travel), _t(layer),
+        neg_samples_num_list=[1, 1], layer_offset_lod=[0, 2, 4], seed=1)
+    m = np.asarray(mask.numpy())
+    np.testing.assert_array_equal(m[0, 2:], [0, 0])
+    assert np.asarray(out.numpy())[0, 2:].sum() == 0
+
+
+# -------------------------------------------------------- rank attention
+def test_rank_attention_oracle():
+    n, d, max_rank, out_col = 4, 3, 2, 5
+    x = _f32(n, d)
+    param = _f32(d * max_rank * max_rank, out_col, seed=1)
+    # rows: [rank_i, (rank_j1, ins1), (rank_j2, ins2)] 1-based; 0 = absent
+    ro = np.array([
+        [1, 1, 0, 2, 1],
+        [2, 1, 2, 0, 0],
+        [0, 1, 1, 2, 2],    # lower invalid -> zeros
+        [1, 0, 3, 2, 3],    # k=0 absent, k=1 valid
+    ], np.int32)
+    out = np.asarray(L.rank_attention(
+        _t(x), _t(ro), _t(param), max_rank=max_rank).numpy())
+    pr = param.reshape(max_rank * max_rank, d, out_col)
+    want = np.zeros((n, out_col), np.float32)
+    for i in range(n):
+        lower = ro[i, 0] - 1
+        for k in range(max_rank):
+            faster = ro[i, 2 * k + 1] - 1
+            if lower < 0 or faster < 0:
+                continue
+            idx = ro[i, 2 * k + 2]
+            want[i] += x[idx] @ pr[lower * max_rank + faster]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_fc_oracle_and_grad():
+    x, w, b = _f32(2, 3, 4), _f32(2, 4, 5, seed=1), _f32(2, 5, seed=2)
+    xt, wt = _t(x), _t(w)
+    wt.stop_gradient = False
+    out = L.batch_fc(xt, wt, _t(b), act="relu")
+    want = np.maximum(np.einsum("snd,sdo->sno", x, w) + b[:, None], 0)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-5,
+                               atol=1e-5)
+    out.sum().backward()
+    assert np.isfinite(np.asarray(wt.grad.numpy())).all()
+
+
+# ------------------------------------------------------------ correlation
+def test_correlation_oracle():
+    n, c, h, w = 1, 2, 6, 6
+    pad, ksz, maxd, s1, s2 = 1, 1, 1, 1, 1
+    x = _f32(n, c, h, w)
+    y = _f32(n, c, h, w, seed=5)
+    out = np.asarray(L.correlation(_t(x), _t(y), pad, ksz, maxd, s1,
+                                   s2).numpy())
+    # brute-force the GPU kernel geometry
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    yp = np.pad(y, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    krad, drad = (ksz - 1) // 2, maxd // s2
+    border = krad + maxd
+    oh = int(np.ceil((h + 2 * pad - 2 * border) / s1))
+    ow = int(np.ceil((w + 2 * pad - 2 * border) / s1))
+    dsz = 2 * drad + 1
+    want = np.zeros((n, dsz * dsz, oh, ow), np.float32)
+    nelems = ksz * ksz * c
+    for tj in range(-drad, drad + 1):
+        for ti in range(-drad, drad + 1):
+            dch = (tj + drad) * dsz + (ti + drad)
+            for o_h in range(oh):
+                for o_w in range(ow):
+                    h1, w1 = o_h * s1 + maxd, o_w * s1 + maxd
+                    h2, w2 = h1 + tj * s2, w1 + ti * s2
+                    acc = 0.0
+                    for j in range(-krad, krad + 1):
+                        for i in range(-krad, krad + 1):
+                            acc += (xp[0, :, h1 + j, w1 + i]
+                                    * yp[0, :, h2 + j, w2 + i]).sum()
+                    want[0, dch, o_h, o_w] = acc / nelems
+    assert out.shape == want.shape
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------- legacy kernels
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_affine_channel(layout):
+    c = 3
+    x = _f32(2, c, 4, 5) if layout == "NCHW" else _f32(2, 4, 5, c)
+    s, b = _f32(c, seed=1), _f32(c, seed=2)
+    out = np.asarray(L.affine_channel(_t(x), _t(s), _t(b), layout).numpy())
+    shape = (1, c, 1, 1) if layout == "NCHW" else (1, 1, 1, c)
+    np.testing.assert_allclose(
+        out, x * s.reshape(shape) + b.reshape(shape), rtol=1e-6)
+
+
+def test_add_position_encoding_matches_kernel_loop():
+    b_, l_, d_ = 2, 5, 6
+    x = _f32(b_, l_, d_)
+    alpha, beta = 0.7, 1.3
+    out = np.asarray(L.add_position_encoding(_t(x), alpha, beta).numpy())
+    half = d_ // 2
+    want = np.empty_like(x)
+    for j in range(l_):
+        for k in range(half):
+            val = j / (10000.0 ** (k / (half - 1))) if half > 1 \
+                else j / 10000.0
+            want[:, j, k] = x[:, j, k] * alpha + np.sin(val) * beta
+            want[:, j, half + k] = (x[:, j, half + k] * alpha
+                                    + np.cos(val) * beta)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_box_clip():
+    boxes = np.array([[[-2.0, 3.0, 80.0, 40.0], [5.0, -1.0, 20.0, 90.0]]],
+                     np.float32)
+    im_info = np.array([[60.0, 80.0, 2.0]], np.float32)  # h, w, scale
+    out = np.asarray(L.box_clip(_t(boxes), _t(im_info)).numpy())
+    # im_w = round(80/2)-1 = 39, im_h = round(60/2)-1 = 29
+    np.testing.assert_allclose(
+        out[0], [[0, 3, 39, 29], [5, 0, 20, 29]], rtol=1e-6)
+
+
+def test_bipartite_match_greedy_and_argmax():
+    dist = np.array([
+        [0.80, 0.10, 0.55],
+        [0.70, 0.60, 0.00],
+    ], np.float32)
+    idx, d = L.bipartite_match(_t(dist))
+    idx, d = np.asarray(idx.numpy()), np.asarray(d.numpy())
+    # greedy: (r0,c0)=0.8 first, then r1's best free col c1=0.6
+    np.testing.assert_array_equal(idx[0], [0, 1, -1])
+    np.testing.assert_allclose(d[0], [0.8, 0.6, 0.0], rtol=1e-6)
+    idx2, d2 = L.bipartite_match(_t(dist), "per_prediction", 0.5)
+    idx2 = np.asarray(idx2.numpy())
+    np.testing.assert_array_equal(idx2[0], [0, 1, 0])  # c2 argmax row 0
+    np.testing.assert_allclose(np.asarray(d2.numpy())[0], [0.8, 0.6, 0.55],
+                               rtol=1e-6)
+
+
+def test_ctc_align_padded_batch():
+    x = np.array([[0, 1, 1, 0, 2, 2, 3, 0],
+                  [4, 4, 4, 0, 0, 5, 0, 0]], np.int32)
+    lens = np.array([8, 6], np.int32)
+    out, olen = L.ctc_align(_t(x), _t(lens), blank=0, merge_repeated=True,
+                            padding_value=9)
+    out, olen = np.asarray(out.numpy()), np.asarray(olen.numpy())
+    np.testing.assert_array_equal(out[0], [1, 2, 3, 9, 9, 9, 9, 9])
+    np.testing.assert_array_equal(out[1], [4, 5, 9, 9, 9, 9, 9, 9])
+    np.testing.assert_array_equal(olen, [3, 2])
+    # merge_repeated=False keeps runs, still drops blanks
+    out2, _ = L.ctc_align(_t(x), _t(lens), blank=0, merge_repeated=False)
+    np.testing.assert_array_equal(np.asarray(out2.numpy())[0][:5],
+                                  [1, 1, 2, 2, 3])
+
+
+def test_im2sequence_patch_layout():
+    n, c, h, w = 2, 3, 4, 5
+    x = np.arange(n * c * h * w, dtype=np.float32).reshape(n, c, h, w)
+    kh, kw, sh, sw = 2, 2, 2, 1
+    out = np.asarray(L.im2sequence(_t(x), [kh, kw], [sh, sw]).numpy())
+    oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+    assert out.shape == (n * oh * ow, c * kh * kw)
+    want = np.zeros_like(out)
+    r = 0
+    for b in range(n):
+        for i in range(oh):
+            for j in range(ow):
+                want[r] = x[b, :, i * sh:i * sh + kh,
+                            j * sw:j * sw + kw].reshape(-1)
+                r += 1
+    np.testing.assert_allclose(out, want)
+
+
+def test_im2sequence_padding():
+    x = _f32(1, 1, 3, 3)
+    out = np.asarray(L.im2sequence(_t(x), [3, 3], [1, 1],
+                                   [1, 1, 1, 1]).numpy())
+    assert out.shape == (9, 9)
+    # center patch (position 1,1) is the unpadded image
+    np.testing.assert_allclose(out[4], x.reshape(-1), rtol=1e-6)
+
+
+# -------------------------------------------------------------- chunk_eval
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types: labels = type*2 + tag (tag 0=B, 1=I), O = 4
+    # label  : [B0 I0] [B1] O    -> chunks (0,1,t0), (2,2,t1)
+    # infer  : [B0 I0] O   [B1]  -> chunks (0,1,t0), (3,3,t1)
+    lab = np.array([[0, 1, 2, 4]], np.int64)
+    inf = np.array([[0, 1, 4, 2]], np.int64)
+    p, r, f1, ni, nl, nc = L.chunk_eval(_t(inf), _t(lab), "IOB",
+                                        num_chunk_types=2)
+    assert int(np.asarray(ni.numpy())) == 2
+    assert int(np.asarray(nl.numpy())) == 2
+    assert int(np.asarray(nc.numpy())) == 1
+    np.testing.assert_allclose(float(np.asarray(p.numpy())), 0.5)
+    np.testing.assert_allclose(float(np.asarray(r.numpy())), 0.5)
+    np.testing.assert_allclose(float(np.asarray(f1.numpy())), 0.5)
+
+
+def test_chunk_eval_perfect_and_excluded():
+    lab = np.array([[0, 1, 4, 2, 4]], np.int64)
+    p, r, f1, ni, nl, nc = L.chunk_eval(_t(lab), _t(lab), "IOB", 2)
+    assert float(np.asarray(f1.numpy())) == 1.0
+    # excluding type 1 drops its chunk from all counts
+    _, _, _, ni2, _, nc2 = L.chunk_eval(_t(lab), _t(lab), "IOB", 2,
+                                        excluded_chunk_types=[1])
+    assert int(np.asarray(ni2.numpy())) == 1
+    assert int(np.asarray(nc2.numpy())) == 1
+
+
+def test_chunk_eval_seq_length_and_iobes():
+    # IOBES single-token chunk: tag 3 = S; type*4+tag
+    lab = np.array([[3, 8, 7, 99]], np.int64)   # S0, O, E1(partial)...
+    # only first 3 positions are valid
+    lab[0, 1] = 2 * 4  # = 8 -> other? other_chunk_type = num_chunk_types=2
+    p, r, f1, ni, nl, nc = L.chunk_eval(
+        _t(lab), _t(lab), "IOBES", 2,
+        seq_length=_t(np.array([3], np.int64)))
+    assert int(np.asarray(nc.numpy())) == int(np.asarray(ni.numpy()))
+    assert float(np.asarray(f1.numpy())) == 1.0
+
+
+def test_chunk_eval_bad_scheme():
+    with pytest.raises(ValueError):
+        L.chunk_eval(_t(np.zeros((1, 2), np.int64)),
+                     _t(np.zeros((1, 2), np.int64)), "XYZ", 2)
+
+
+# ------------------------------------------------------------ detection_map
+def _dm_case():
+    gt = [np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                    [2, 0.5, 0.5, 0.9, 0.9]], np.float32)]
+    det = [np.array([
+        [1, 0.9, 0.1, 0.1, 0.4, 0.4],     # TP class 1
+        [1, 0.6, 0.6, 0.6, 0.8, 0.8],     # FP class 1
+        [2, 0.8, 0.5, 0.5, 0.9, 0.9],     # TP class 2
+    ], np.float32)]
+    return det, gt
+
+
+def test_detection_map_integral():
+    det, gt = _dm_case()
+    m, state = L.detection_map(det, gt, class_num=3)
+    # class 1: dets sorted by score -> TP first: AP = 1.0*1.0 (recall 0->1
+    # at precision 1); class 2: AP = 1.0 -> mAP 1.0
+    np.testing.assert_allclose(float(np.asarray(m.numpy())), 1.0)
+    # streaming: same batch again doubles counts, mAP unchanged
+    m2, state = L.detection_map(det, gt, class_num=3, state=state)
+    np.testing.assert_allclose(float(np.asarray(m2.numpy())), 1.0)
+    # one class-1 gt per image per batch -> 2 after two batches
+    assert state[0][1] == 2
+
+
+def test_detection_map_miss_and_11point():
+    gt = [np.array([[1, 0.1, 0.1, 0.4, 0.4]], np.float32)]
+    det = [np.array([[1, 0.9, 0.6, 0.6, 0.9, 0.9]], np.float32)]  # miss
+    m, _ = L.detection_map(det, gt, class_num=2)
+    np.testing.assert_allclose(float(np.asarray(m.numpy())), 0.0)
+    det2, gt2 = _dm_case()
+    m11, _ = L.detection_map(det2, gt2, class_num=3, ap_version="11point")
+    np.testing.assert_allclose(float(np.asarray(m11.numpy())), 1.0)
+    with pytest.raises(ValueError):
+        L.detection_map(det2, gt2, class_num=3, ap_version="bogus")
+
+
+def test_detection_map_duplicate_detection_is_fp():
+    gt = [np.array([[1, 0.1, 0.1, 0.4, 0.4]], np.float32)]
+    det = [np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                     [1, 0.8, 0.1, 0.1, 0.4, 0.4]], np.float32)]
+    m, _ = L.detection_map(det, gt, class_num=2)
+    # AP: first det TP (p=1, r=1), second is a duplicate FP (visited
+    # gt) -> integral AP = 1.0 (recall saturates at first det)
+    np.testing.assert_allclose(float(np.asarray(m.numpy())), 1.0)
+    # difficult gt excluded when evaluate_difficult=False
+    gt_d = [np.array([[1, 1, 0.1, 0.1, 0.4, 0.4]], np.float32)]  # difficult
+    m2, st2 = L.detection_map(det, gt_d, class_num=2,
+                              evaluate_difficult=False)
+    assert 1 not in st2[0]     # no countable positives
+
+
+def test_detection_map_excludes_background_class():
+    # background (label 0) must not enter the mAP average (deviation from
+    # the reference kernel's count-vs-background_label comparison —
+    # documented in the docstring)
+    gt = [np.array([[0, 0.1, 0.1, 0.4, 0.4],
+                    [1, 0.5, 0.5, 0.9, 0.9]], np.float32)]
+    det = [np.array([[0, 0.9, 0.6, 0.6, 0.9, 0.9],    # background FP
+                     [1, 0.8, 0.5, 0.5, 0.9, 0.9]], np.float32)]
+    m, _ = L.detection_map(det, gt, class_num=2, background_label=0)
+    # only class 1 counts: perfect detection -> 1.0 (the background FP
+    # would otherwise drag the average to 0.5)
+    np.testing.assert_allclose(float(np.asarray(m.numpy())), 1.0)
+    # a class whose positive COUNT equals background_label must stay in
+    gt3 = [np.array([[1, 0.1, 0.1, 0.2, 0.2],
+                     [1, 0.3, 0.3, 0.4, 0.4],
+                     [1, 0.5, 0.5, 0.6, 0.6]], np.float32)]
+    det3 = [np.array([[1, 0.9, 0.1, 0.1, 0.2, 0.2],
+                      [1, 0.8, 0.3, 0.3, 0.4, 0.4],
+                      [1, 0.7, 0.5, 0.5, 0.6, 0.6]], np.float32)]
+    m3, _ = L.detection_map(det3, gt3, class_num=2, background_label=3)
+    np.testing.assert_allclose(float(np.asarray(m3.numpy())), 1.0)

@@ -1,0 +1,219 @@
+"""Behavioral tests for the second legacy-op batch: static.nn sequence
+ops + continuous_value_model, incubate.optimizer.{Ftrl,Dpsgd},
+geometric.weighted_sample_neighbors (reference kernels cited per-op in
+the implementations)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _f32(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- cvm
+def test_cvm_use_cvm_forward_and_grad():
+    x = np.abs(_f32(3, 5)) + 0.1
+    cvm = _f32(3, 2, seed=3)
+    xt = _t(x)
+    xt.stop_gradient = False
+    out = snn.continuous_value_model(xt, _t(cvm), use_cvm=True)
+    want = x.copy()
+    want[:, 0] = np.log(x[:, 0] + 1)
+    want[:, 1] = np.log(x[:, 1] + 1) - want[:, 0]
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-5)
+    out.sum().backward()
+    g = np.asarray(xt.grad.numpy())
+    # reference grad kernel: counter-column grads come from the CVM input
+    np.testing.assert_allclose(g[:, :2], cvm, rtol=1e-6)
+    np.testing.assert_allclose(g[:, 2:], 1.0)
+
+
+def test_cvm_drop_counters():
+    x = np.abs(_f32(3, 5)) + 0.1
+    out = snn.continuous_value_model(_t(x), _t(_f32(3, 2)), use_cvm=False)
+    np.testing.assert_allclose(np.asarray(out.numpy()), x[:, 2:], rtol=1e-6)
+
+
+# ------------------------------------------------------- sequence pool
+def test_sequence_pool_modes_vs_oracle():
+    x = _f32(3, 4, 2)
+    lens = np.array([4, 2, 0], np.int64)
+    for mode in ("average", "sum", "sqrt", "max", "last", "first"):
+        out = np.asarray(snn.sequence_pool(_t(x), mode, _t(lens),
+                                           pad_value=-7.0).numpy())
+        for b in range(3):
+            L = int(lens[b])
+            if L == 0:
+                np.testing.assert_allclose(out[b], -7.0)
+                continue
+            seg = x[b, :L]
+            want = {"average": seg.mean(0), "sum": seg.sum(0),
+                    "sqrt": seg.sum(0) / np.sqrt(L), "max": seg.max(0),
+                    "last": seg[-1], "first": seg[0]}[mode]
+            np.testing.assert_allclose(out[b], want, rtol=1e-5, atol=1e-6,
+                                       err_msg=mode)
+
+
+def test_sequence_pool_grad_masks_padding():
+    x = _t(_f32(2, 3, 2))
+    x.stop_gradient = False
+    out = snn.sequence_pool(x, "sum", _t(np.array([2, 3], np.int64)))
+    out.sum().backward()
+    g = np.asarray(x.grad.numpy())
+    assert g[0, 2].sum() == 0 and g[1].sum() == 6
+
+
+def test_sequence_first_last_step():
+    x = _f32(2, 3, 4)
+    lens = np.array([2, 3], np.int64)
+    np.testing.assert_allclose(
+        np.asarray(snn.sequence_first_step(_t(x), _t(lens)).numpy()),
+        x[:, 0], rtol=1e-6)
+    last = np.asarray(snn.sequence_last_step(_t(x), _t(lens)).numpy())
+    np.testing.assert_allclose(last[0], x[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(last[1], x[1, 2], rtol=1e-6)
+
+
+# ------------------------------------------------------- sequence conv
+def test_sequence_conv_oracle():
+    b, L, w, ctx, nf = 2, 5, 3, 3, 4
+    x = _f32(b, L, w)
+    filt = _f32(ctx * w, nf, seed=1)
+    lens = np.array([5, 3], np.int64)
+    out = np.asarray(snn.sequence_conv(_t(x), _t(filt), _t(lens),
+                                       context_length=ctx).numpy())
+    start = -(ctx // 2)
+    want = np.zeros((b, L, nf), np.float32)
+    for bi in range(b):
+        for t in range(int(lens[bi])):
+            col = np.zeros((ctx, w), np.float32)
+            for o in range(ctx):
+                src = t + start + o
+                if 0 <= src < lens[bi]:
+                    col[o] = x[bi, src]
+            want[bi, t] = col.reshape(-1) @ filt
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_conv_grad_and_context_start():
+    x = _t(_f32(1, 4, 2))
+    x.stop_gradient = False
+    filt = _t(_f32(4, 3, seed=2))
+    filt.stop_gradient = False
+    out = snn.sequence_conv(x, filt, context_length=2, context_start=0)
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.numpy())).all()
+    assert np.isfinite(np.asarray(filt.grad.numpy())).all()
+
+
+# ---------------------------------------------------------- optimizers
+def test_ftrl_matches_kernel_formula():
+    from paddle_tpu.incubate.optimizer import Ftrl
+    w0 = np.array([0.5, -0.3, 0.8], np.float32)
+    w = _t(w0.copy())
+    w.stop_gradient = False
+    lr, l1, l2 = 0.1, 0.01, 0.1
+    opt = Ftrl(learning_rate=lr, l1=l1, l2=l2, parameters=[w])
+    target = _t(np.zeros(3, np.float32))
+    loss = ((w - target) ** 2).sum()
+    loss.backward()
+    g = 2 * w0
+    opt.step()
+    # oracle: first step, s=0, lin=0 (impl/ftrl_kernel_impl.h)
+    l1e, l2e = l1 + 1e-10, l2 + 1e-10
+    new_acc = g * g
+    lin = g - (np.sqrt(new_acc) - 0.0) / lr * w0
+    x = l1e * np.sign(lin) - lin
+    y = np.sqrt(new_acc) / lr + 2 * l2e
+    want = np.where(np.abs(lin) > l1e, x / y, 0.0)
+    np.testing.assert_allclose(np.asarray(w.numpy()), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_ftrl_l1_sparsifies():
+    from paddle_tpu.incubate.optimizer import Ftrl
+    w = _t(np.array([1e-4], np.float32))
+    w.stop_gradient = False
+    opt = Ftrl(learning_rate=0.5, l1=10.0, parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    assert float(np.asarray(w.numpy())[0]) == 0.0  # |linear| <= l1 -> 0
+
+
+def test_dpsgd_clips_and_steps():
+    from paddle_tpu.incubate.optimizer import Dpsgd
+    w0 = np.full(4, 3.0, np.float32)
+    w = _t(w0.copy())
+    w.stop_gradient = False
+    opt = Dpsgd(learning_rate=0.1, clip=0.5, batch_size=1e9, sigma=0.0,
+                parameters=[w])
+    (w * w).sum().backward()          # g = 6 per element, ||g|| = 12
+    opt.step()
+    # scale = 12/0.5 -> effective grad = g/scale with norm == clip
+    g = 6.0 * np.ones(4)
+    scale = np.linalg.norm(g) / 0.5
+    np.testing.assert_allclose(np.asarray(w.numpy()), w0 - 0.1 * g / scale,
+                               rtol=1e-5)
+
+
+def test_dpsgd_noise_reproducible():
+    from paddle_tpu.incubate.optimizer import Dpsgd
+    outs = []
+    for _ in range(2):
+        w = _t(np.ones(3, np.float32))
+        w.stop_gradient = False
+        opt = Dpsgd(learning_rate=0.1, clip=1e9, batch_size=2.0, sigma=0.7,
+                    seed=11, parameters=[w])
+        (w.sum()).backward()
+        opt.step()
+        outs.append(np.asarray(w.numpy()))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    # noise is one scalar per tensor: all elements shift identically
+    assert np.ptp(outs[0] - (1.0 - 0.1 * 1.0)) < 1e-6
+
+
+# ---------------------------------------------- weighted neighbor sample
+def test_weighted_sample_neighbors_caps_and_weights():
+    from paddle_tpu.geometric import weighted_sample_neighbors
+    row = _t(np.array([1, 2, 3, 0, 2, 0, 1, 3, 4], np.int64))
+    colptr = _t(np.array([0, 3, 5, 9, 9, 9], np.int64))
+    w = _t(np.array([1, 1, 1, 1, 1, 1000.0, 1000.0, 0.001, 0.001],
+                    np.float32))
+    n, c = weighted_sample_neighbors(row, colptr, w,
+                                     _t(np.array([0, 1], np.int64)),
+                                     sample_size=-1)
+    np.testing.assert_array_equal(np.asarray(c.numpy()), [3, 2])
+    np.testing.assert_array_equal(np.asarray(n.numpy()), [1, 2, 3, 0, 2])
+    # heavy-weight neighbors of node 2 dominate a size-2 weighted draw
+    hits = 0
+    for s in range(20):
+        n2, c2 = weighted_sample_neighbors(
+            row, colptr, w, _t(np.array([2], np.int64)), sample_size=2,
+            seed=s)
+        got = set(np.asarray(n2.numpy()).tolist())
+        hits += got == {0, 1}
+    assert hits >= 18, hits
+
+
+def test_weighted_sample_neighbors_eids():
+    from paddle_tpu.geometric import weighted_sample_neighbors
+    row = _t(np.array([5, 6, 7], np.int64))
+    colptr = _t(np.array([0, 3], np.int64))
+    w = _t(np.ones(3, np.float32))
+    n, c, e = weighted_sample_neighbors(
+        row, colptr, w, _t(np.array([0], np.int64)), sample_size=2,
+        eids=_t(np.array([10, 11, 12], np.int64)), return_eids=True,
+        seed=4)
+    n, e = np.asarray(n.numpy()), np.asarray(e.numpy())
+    assert len(n) == 2 and (e - 10 == n - 5).all()
+    with pytest.raises(ValueError):
+        weighted_sample_neighbors(row, colptr, w,
+                                  _t(np.array([0], np.int64)),
+                                  return_eids=True)

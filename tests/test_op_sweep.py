@@ -1115,6 +1115,7 @@ EXEMPT = {
     "hsigmoid_loss": "tests/test_nn_extras.py",
     "graph_khop_sampler": "tests/test_api_parity.py",
     "graph_sample_neighbors": "tests/test_api_parity.py",
+    "weighted_sample_neighbors": "tests/test_legacy_tier2.py",
     "all_gather": "tests/test_eager_collectives.py",
     "all_reduce": "tests/test_eager_collectives.py",
     "all_to_all": "tests/test_eager_collectives.py",

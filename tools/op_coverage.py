@@ -33,7 +33,7 @@ ALIASES = {
     "merged_adam_": "optimizer.Adam", "merged_momentum_": "optimizer.Momentum",
     "dgc_momentum": "fleet.meta_optimizers.DGCMomentumOptimizer",
     "dgc": "fleet.meta_optimizers.dgc_optimizer.dgc_compress",
-    "ftrl": None, "dpsgd": None, "sparse_momentum": None,
+    "sparse_momentum": None,
     "distributed_fused_lamb_init": "incubate.DistributedFusedLamb",
     # elementwise / math renames
     "elementwise_pow": "pow", "divide": "divide", "fmin": "fmin",
@@ -110,7 +110,6 @@ ALIASES = {
     "set_value_with_tensor": "Tensor.__setitem__",
     "tile": "tile", "unbind": "unbind", "unstack": "unstack",
     "viterbi_decode": "text.viterbi_decode",
-    "partial_sum": None, "partial_concat": None,
     "pull_sparse_v2": "distributed.ps", "push_sparse_v2": "distributed.ps",
     "pull_box_sparse": "distributed.ps", "push_box_sparse": "distributed.ps",
     "pull_gpups_sparse": "distributed.ps",
@@ -244,6 +243,29 @@ ALIASES = {
     "nms": "vision.ops.nms",
     "assign_value_": "assign",
     "mean": "mean",
+    # rec-sys / legacy incubate tier (incubate/layers.py; reference
+    # python/paddle/incubate/layers/nn.py + kernel-only legacy ops)
+    "shuffle_batch": "incubate.layers.shuffle_batch",
+    "partial_concat": "incubate.layers.partial_concat",
+    "partial_sum": "incubate.layers.partial_sum",
+    "tdm_child": "incubate.layers.tdm_child",
+    "tdm_sampler": "incubate.layers.tdm_sampler",
+    "rank_attention": "incubate.layers.rank_attention",
+    "batch_fc": "incubate.layers.batch_fc",
+    "correlation": "incubate.layers.correlation",
+    "affine_channel": "incubate.layers.affine_channel",
+    "add_position_encoding": "incubate.layers.add_position_encoding",
+    "bipartite_match": "incubate.layers.bipartite_match",
+    "box_clip": "incubate.layers.box_clip",
+    "ctc_align": "incubate.layers.ctc_align",
+    "chunk_eval": "incubate.layers.chunk_eval",
+    "im2sequence": "incubate.layers.im2sequence",
+    "cvm": "static.nn.continuous_value_model",
+    "sequence_conv": "static.nn.sequence_conv",
+    "sequence_pool": "static.nn.sequence_pool",
+    "ftrl": "incubate.optimizer.Ftrl",
+    "detection_map": "incubate.layers.detection_map",
+    "dpsgd": "incubate.optimizer.Dpsgd",
 }
 
 # ops that are deliberately out of scope on TPU (hardware-specific, legacy
@@ -253,9 +275,8 @@ OUT_OF_SCOPE = {
     "c_comm_init_all", "comm_init_all", "c_gen_nccl_id", "gen_nccl_id",
     # detection-pipeline ops with NO modern python API in the reference
     # (train-pipeline internals the reference itself moved to legacy);
-    # the implemented detection surface (roi/yolo/nms/box/proposals) is
-    # classified directly below
-    "bipartite_match", "box_clip",
+    # the implemented detection surface (roi/yolo/nms/box/proposals/
+    # bipartite_match/box_clip) is classified directly or via ALIASES
     "density_prior_box", "locality_aware_nms", "mine_hard_examples",
     "polygon_box_transform", "retinanet_detection_output",
     "rpn_target_assign", "ssd_loss", "target_assign", "yolo_box_head",
@@ -264,18 +285,11 @@ OUT_OF_SCOPE = {
     "sync_calc_stream", "coalesce_tensor", "depend",
     "memcpy_d2h_multi_io", "beam_search_decode", "assign_pos",
 
-    # PS/recommender GPU-legacy ops (capability = distributed.ps tables)
-    "batch_fc", "rank_attention", "tdm_child", "tdm_sampler",
-    "pyramid_hash", "match_matrix_tensor", "shuffle_batch", "cvm",
-    "partial_concat", "partial_sum",
-    # weighted neighbor sampling: host-side; the uniform samplers below
-    # are implemented (incubate.graph_*), the weighted variant is not
-    "weighted_sample_neighbors",
-    # misc legacy sequence/speech ops without modern python API
-    "sequence_conv", "sequence_pool", "im2sequence", "ctc_align",
-    "chunk_eval", "detection_map",
-    "add_position_encoding", "affine_channel", "correlation",
-    "dpsgd", "ftrl",
+    # PS/recommender GPU-legacy ops with no reimplementable contract:
+    # pyramid_hash is a bespoke hash-embedding scheme, match_matrix_tensor
+    # a legacy text-matching op; the rest of the rec-sys tier now lives in
+    # incubate.layers (ALIASES)
+    "pyramid_hash", "match_matrix_tensor",
     # GPU/NPU-runtime specific: fused LSTM+attention CPU-only legacy op,
     # flash-attention GPU helper, ascend-format identity
     "attention_lstm", "calc_reduced_attn_scores", "npu_identity",
