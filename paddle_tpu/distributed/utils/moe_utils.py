@@ -87,3 +87,28 @@ def global_gather(x, local_count=None, global_count=None,
     """reference: moe_utils.py:153 — inverse of global_scatter (alltoall is
     self-inverse for equal splits)."""
     return global_scatter(x, local_count, global_count, group)
+
+
+def assign_pos(x, cum_count, eff_num_len=None, name=None):
+    """Token-position assignment for MoE all-to-all dispatch: tokens are
+    grouped by expert id so that positions ``[cum_count[e-1],
+    cum_count[e])`` hold the indices of tokens routed to expert ``e``
+    (ids < 0 are dropped). ``eff_num_len`` bounds the output length
+    (defaults to ``cum_count[-1]``).
+
+    reference: paddle/phi/kernels/gpu/assign_pos_kernel.cu (AssignPos;
+    the CPU kernel raises Unavailable there — this runs everywhere).
+    Deviation: within an expert group the reference's atomic fill order
+    is nondeterministic; here tokens keep ascending order (stable
+    argsort) — MIGRATION.md.
+    """
+    import numpy as _np
+    from ..._core.tensor import Tensor as _T
+    from ...ops._registry import as_tensor as _as, raw as _raw
+    ids = _np.asarray(_raw(_as(x))).reshape(-1)
+    cc = _np.asarray(_raw(_as(cum_count))).reshape(-1)
+    n = int(cc[-1]) if eff_num_len is None else \
+        int(_np.asarray(_raw(_as(eff_num_len))).reshape(-1)[0])
+    keep = _np.flatnonzero(ids >= 0)
+    order = keep[_np.argsort(ids[keep], kind="stable")]
+    return _T(order[:n].astype(cc.dtype))

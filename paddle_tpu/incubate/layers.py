@@ -102,6 +102,9 @@ def partial_concat(x, start_index: int = 0, length: int = -1, name=None):
     ts = [as_tensor(t) for t in x]
     if ts[0].ndim != 2:
         raise ValueError("partial_concat expects 2-D inputs")
+    if any(tuple(t.shape) != tuple(ts[0].shape) for t in ts[1:]):
+        raise ValueError("partial_concat inputs must share one shape "
+                         f"(got {[tuple(t.shape) for t in ts]})")
     start, plen = _partial_slice_bounds(int(ts[0].shape[1]),
                                         start_index, length)
 
@@ -119,6 +122,9 @@ def partial_sum(x, start_index: int = 0, length: int = -1, name=None):
     ts = [as_tensor(t) for t in x]
     if ts[0].ndim != 2:
         raise ValueError("partial_sum expects 2-D inputs")
+    if any(tuple(t.shape) != tuple(ts[0].shape) for t in ts[1:]):
+        raise ValueError("partial_sum inputs must share one shape "
+                         f"(got {[tuple(t.shape) for t in ts]})")
     start, plen = _partial_slice_bounds(int(ts[0].shape[1]),
                                         start_index, length)
 

@@ -55,13 +55,16 @@ class Dpsgd(Optimizer):
     """Differentially-private SGD (Abadi et al., CCS'16).
 
     Per step and per parameter tensor: scale the gradient down when its
-    L2 norm exceeds ``clip`` (scale = norm/clip), add one gaussian noise
-    draw ``N(0, sigma^2)/batch_size``, and apply SGD.
+    L2 norm exceeds ``clip`` (scale = norm/clip), add gaussian noise
+    ``N(0, sigma^2)/batch_size``, and apply SGD.
 
     reference: paddle/phi/kernels/cpu/dpsgd_kernel.cc (DpsgdOpKernel).
-    Deviation (MIGRATION.md): noise comes from the JAX counter-based PRNG
-    (seeded, reproducible) instead of the kernel's Box-Muller over
-    minstd_rand — the distribution is identical, the stream is not.
+    Deviations (MIGRATION.md): noise comes from the JAX counter-based
+    PRNG (seeded, reproducible; keyed per parameter AND per step), and is
+    drawn PER COORDINATE — the reference kernel adds one shared scalar
+    per tensor per step, which makes the noise rank-1/correlated and
+    voids the DP-SGD privacy analysis (Abadi et al. require independent
+    N(0, sigma^2 I) coordinates).
     """
 
     def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
@@ -69,6 +72,7 @@ class Dpsgd(Optimizer):
         super().__init__(learning_rate, parameters, None, None)
         self._clip, self._bs, self._sigma = clip, batch_size, sigma
         self._seed = seed
+        self._noise_step, self._noise_ord = None, 0
 
     def _slots(self):
         return ()
@@ -81,8 +85,18 @@ class Dpsgd(Optimizer):
         g = g.astype(jnp.float32)
         norm = jnp.sqrt(jnp.sum(g * g))
         scale = jnp.where(norm > ctx["clip"], norm / ctx["clip"], 1.0)
+        # key folds in the parameter's position in the (fixed) update
+        # order so tensors never share a noise draw — auto-generated
+        # tensor names are not stable across runs, positions are — and
+        # the draw is per-coordinate (see docstring)
+        step = ctx["step"]
+        if step != self._noise_step:
+            self._noise_step, self._noise_ord = step, 0
+        idx = self._noise_ord
+        self._noise_ord += 1
         key = jax.random.fold_in(jax.random.key(ctx["seed"]),
-                                 jnp.asarray(ctx["step"], jnp.uint32))
-        noise = jax.random.normal(key, ()) * ctx["sigma"]
+                                 jnp.asarray(step, jnp.uint32))
+        key = jax.random.fold_in(key, jnp.uint32(idx))
+        noise = jax.random.normal(key, g.shape) * ctx["sigma"]
         return (p - lr * (g / scale + noise / ctx["bs"])).astype(p.dtype), \
             state

@@ -22,6 +22,7 @@ __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "psroi_pool",
            "box_coder", "prior_box", "yolo_box", "yolo_loss",
            "matrix_nms", "deform_conv2d", "distribute_fpn_proposals",
            "generate_proposals", "read_file", "decode_jpeg",
+           "yolo_box_head", "yolo_box_post", "collect_fpn_proposals",
            "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D"]
 
 
@@ -970,3 +971,152 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     if return_index:
         return res[0], Tensor(jnp.asarray(index), _internal=True), res[1]
     return res[0], res[1]
+
+
+def yolo_box_head(x, anchors, class_num, name=None):
+    """YOLO head activation: per anchor block of (5+class_num) channels,
+    sigmoid on x/y/objectness/class logits and exp on w/h — the
+    pre-decode step of the serving yolo pipeline.
+
+    reference: paddle/phi/kernels/gpu/yolo_box_head_kernel.cu
+    (YoloBoxHeadCudaKernel). jnp elementwise; runs on every backend (the
+    reference kernel is GPU-only).
+    """
+    na = len(list(anchors)) // 2
+
+    def f(pred):
+        B, C, H, W = pred.shape
+        p = pred.reshape(B, na, C // na, H, W)
+        xy = jax.nn.sigmoid(p[:, :, 0:2])
+        wh = jnp.exp(p[:, :, 2:4])
+        rest = jax.nn.sigmoid(p[:, :, 4:])
+        return jnp.concatenate([xy, wh, rest], axis=2).reshape(pred.shape)
+
+    return apply(f, as_tensor(x), name="yolo_box_head")
+
+
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0, anchors1, anchors2, class_num, conf_thresh,
+                  downsample_ratio0, downsample_ratio1, downsample_ratio2,
+                  clip_bbox=True, scale_x_y=1.0, nms_threshold=0.45,
+                  name=None):
+    """Decode three yolo_box_head outputs and run class-wise NMS.
+
+    Per level: candidates with objectness >= conf_thresh decode to image
+    coordinates (``pic = image_shape / image_scale``, anchors scaled by
+    ``downsample_ratio * grid``), clipped to the image; per batch the
+    candidates sort by (class, prob desc) and same-class boxes with
+    IoU > nms_threshold are suppressed (score zeroed, kept in the output
+    — reference PostNMS contract). Returns ``(out (total, 6)
+    [label, score, x1, y1, x2, y2], nms_rois_num (B,))``.
+
+    reference: paddle/phi/kernels/gpu/yolo_box_post_kernel.cu
+    (YoloTensorParseKernel + PostNMS; clip_bbox/scale_x_y are accepted
+    and unused there too). Host-side numpy: serving post-processing.
+    """
+    import numpy as _np
+
+    def _arr(t):
+        return _np.asarray(raw(as_tensor(t))).astype(_np.float32)
+
+    levels = [(_arr(boxes0), list(anchors0), downsample_ratio0),
+              (_arr(boxes1), list(anchors1), downsample_ratio1),
+              (_arr(boxes2), list(anchors2), downsample_ratio2)]
+    shp, scl = _arr(image_shape), _arr(image_scale)
+    batch = shp.shape[0]
+    out_rows, nums = [], []
+    for b in range(batch):
+        pic_h = shp[b, 0] / scl[b, 0]
+        pic_w = shp[b, 1] / scl[b, 1]
+        dets = []   # (cls, obj, x1, y1, x2, y2, probs)
+        for pred, anc, ds in levels:
+            na = len(anc) // 2
+            B, C, H, W = pred.shape
+            g = H                      # square grids (reference contract)
+            p = pred[b].reshape(na, C // na, H, W)
+            netw, neth = ds * W, ds * H
+            for a in range(na):
+                obj = p[a, 4]
+                ys, xs = _np.nonzero(obj >= conf_thresh)
+                for yy, xx in zip(ys, xs):
+                    o = obj[yy, xx]
+                    cx = (p[a, 0, yy, xx] + xx) * pic_w / W
+                    cy = (p[a, 1, yy, xx] + yy) * pic_h / H
+                    ww = p[a, 2, yy, xx] * anc[2 * a] * pic_w / netw
+                    hh = p[a, 3, yy, xx] * anc[2 * a + 1] * pic_h / neth
+                    x1 = max(cx - ww / 2, 0.0)
+                    y1 = max(cy - hh / 2, 0.0)
+                    x2 = min(cx + ww / 2, pic_w - 1)
+                    y2 = min(cy + hh / 2, pic_h - 1)
+                    probs = p[a, 5:, yy, xx] * o
+                    cls = int(_np.argmax(probs)) if probs.size else -1
+                    dets.append([cls, float(o), x1, y1, x2, y2,
+                                 float(probs[cls]) if probs.size else 0.0])
+        dets.sort(key=lambda d: (d[0], -d[6]))
+        if dets:
+            # one IoU matrix via the module's box_iou (single source of
+            # IoU truth with nms/detection paths)
+            bx = _np.asarray([d[2:6] for d in dets], _np.float32)
+            iou = _np.asarray(raw(box_iou(Tensor(bx), Tensor(bx))))
+        for i in range(len(dets)):
+            if dets[i][1] == 0:
+                continue
+            for j in range(i + 1, len(dets)):
+                if dets[j][0] != dets[i][0]:
+                    break
+                if dets[j][1] == 0:
+                    continue
+                if iou[i, j] > nms_threshold:
+                    dets[j][1] = 0.0
+        for d in dets:
+            out_rows.append([d[0], d[1], d[2], d[3], d[4], d[5]])
+        nums.append(len(dets))
+    if not out_rows:
+        out_rows = [[0.0] * 6]
+    return (Tensor(_np.asarray(out_rows, _np.float32)),
+            Tensor(_np.asarray(nums, _np.int32)))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-FPN-level proposals and keep the global top
+    ``post_nms_top_n`` by score, re-grouped by batch image (the inverse
+    of ``distribute_fpn_proposals``).
+
+    ``multi_rois``: per-level (N_l, 4) boxes; ``multi_scores``: per-level
+    (N_l,) scores; ``rois_num_per_level``: per-level (B,) int counts
+    (the LoD-free batch encoding). Returns ``(fpn_rois (K, 4),
+    rois_num (B,))`` with rows batch-major, score-sorted within batch.
+
+    reference: phi/kernels/impl/collect_fpn_proposals_kernel_impl.h
+    (stable score sort -> truncate -> stable batch-id sort).
+    """
+    import numpy as _np
+    rois = [_np.asarray(raw(as_tensor(r))).reshape(-1, 4)
+            for r in multi_rois]
+    scores = [_np.asarray(raw(as_tensor(s))).reshape(-1)
+              for s in multi_scores]
+    nlev = len(rois)
+    if rois_num_per_level is None:
+        # single-image convenience: everything is batch 0
+        nums = [_np.asarray([len(s)], _np.int64) for s in scores]
+    else:
+        nums = [_np.asarray(raw(as_tensor(n))).reshape(-1).astype(
+            _np.int64) for n in rois_num_per_level]
+    batch = len(nums[0])
+    recs = []          # (score, level, index_in_level, batch_id)
+    for lv in range(nlev):
+        bid = _np.repeat(_np.arange(batch), nums[lv])
+        for j in range(len(scores[lv])):
+            recs.append((float(scores[lv][j]), lv, j, int(bid[j])))
+    order = sorted(range(len(recs)), key=lambda i: -recs[i][0])
+    keep = min(post_nms_top_n, len(recs))
+    top = [recs[i] for i in order[:keep]]
+    top.sort(key=lambda r: r[3])            # stable: batch-major
+    out = _np.stack([rois[lv][idx] for _, lv, idx, _ in top]) if top \
+        else _np.zeros((0, 4), _np.float32)
+    counts = _np.zeros((batch,), _np.int32)
+    for _, _, _, b in top:
+        counts[b] += 1
+    return Tensor(out.astype(_np.float32)), Tensor(counts)

@@ -175,8 +175,10 @@ def test_dpsgd_noise_reproducible():
         opt.step()
         outs.append(np.asarray(w.numpy()))
     np.testing.assert_array_equal(outs[0], outs[1])
-    # noise is one scalar per tensor: all elements shift identically
-    assert np.ptp(outs[0] - (1.0 - 0.1 * 1.0)) < 1e-6
+    # noise is per-coordinate (deviation from the reference's shared
+    # scalar — see Dpsgd docstring): coordinates must NOT all shift by
+    # the same amount
+    assert np.ptp(outs[0] - (1.0 - 0.1 * 1.0)) > 1e-6
 
 
 # ---------------------------------------------- weighted neighbor sample
@@ -217,3 +219,86 @@ def test_weighted_sample_neighbors_eids():
         weighted_sample_neighbors(row, colptr, w,
                                   _t(np.array([0], np.int64)),
                                   return_eids=True)
+
+
+# ----------------------------------------------- yolo serving pipeline
+def test_yolo_box_head_activations():
+    from paddle_tpu.vision.ops import yolo_box_head
+    na, cls, H, W = 2, 3, 4, 4
+    x = _f32(1, na * (5 + cls), H, W)
+    out = np.asarray(yolo_box_head(_t(x), [10, 14, 23, 27], cls).numpy())
+    p = x.reshape(na, 5 + cls, H, W)
+    o = out.reshape(na, 5 + cls, H, W)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    np.testing.assert_allclose(o[:, 0:2], sig(p[:, 0:2]), rtol=1e-5)
+    np.testing.assert_allclose(o[:, 2:4], np.exp(p[:, 2:4]), rtol=1e-5)
+    np.testing.assert_allclose(o[:, 4:], sig(p[:, 4:]), rtol=1e-5)
+
+
+def test_yolo_box_post_decode_and_nms():
+    from paddle_tpu.vision.ops import yolo_box_post
+    cls, na = 2, 1
+    H = W = 2
+    # one strong candidate at cell (0,0), one duplicate to suppress at
+    # (0,1) with same class, one below conf_thresh
+    def mk(obj_map, xy=0.5, wh=1.0):
+        p = np.zeros((1, na * (5 + cls), H, W), np.float32)
+        p[0, 0] = xy   # x
+        p[0, 1] = xy   # y
+        p[0, 2] = wh
+        p[0, 3] = wh
+        p[0, 4] = obj_map
+        p[0, 5] = 0.9  # class 0 prob
+        p[0, 6] = 0.1
+        return p
+    obj = np.array([[0.9, 0.85], [0.05, 0.05]], np.float32)
+    b0 = mk(obj)
+    empty = np.zeros((1, na * (5 + cls), 1, 1), np.float32)
+    shape = np.array([[64.0, 64.0]], np.float32)
+    scale = np.array([[1.0, 1.0]], np.float32)
+    out, nums = yolo_box_post(
+        _t(b0), _t(empty), _t(empty), _t(shape), _t(scale),
+        [32, 32], [16, 16], [8, 8], class_num=cls, conf_thresh=0.3,
+        downsample_ratio0=32, downsample_ratio1=16, downsample_ratio2=8,
+        nms_threshold=0.45)
+    out, nums = np.asarray(out.numpy()), np.asarray(nums.numpy())
+    assert nums[0] == 2 and out.shape == (2, 6)
+    # both are class 0; the lower-scoring overlapping box is suppressed
+    assert out[0, 0] == 0 and out[0, 1] > 0.5
+    kept = out[out[:, 1] > 0]
+    assert len(kept) >= 1
+    # boxes are clipped inside the 64x64 image
+    assert kept[:, 2:].min() >= 0 and kept[:, 2:].max() <= 63
+
+
+def test_collect_fpn_proposals_top_and_batch_order():
+    from paddle_tpu.vision.ops import collect_fpn_proposals
+    # two levels, two images; counts [2,1] and [1,2]
+    rois0 = np.array([[0, 0, 1, 1], [1, 1, 2, 2], [2, 2, 3, 3]], np.float32)
+    rois1 = np.array([[3, 3, 4, 4], [4, 4, 5, 5], [5, 5, 6, 6]], np.float32)
+    sc0 = np.array([0.9, 0.1, 0.8], np.float32)   # img0, img0, img1
+    sc1 = np.array([0.7, 0.95, 0.2], np.float32)  # img0, img1, img1
+    n0 = np.array([2, 1], np.int32)
+    n1 = np.array([1, 2], np.int32)
+    rois, nums = collect_fpn_proposals(
+        [_t(rois0), _t(rois1)], [_t(sc0), _t(sc1)], 2, 3,
+        post_nms_top_n=3, rois_num_per_level=[_t(n0), _t(n1)])
+    rois, nums = np.asarray(rois.numpy()), np.asarray(nums.numpy())
+    # top-3 scores: 0.95 (img1), 0.9 (img0), 0.8 (img1) -> batch-major
+    np.testing.assert_array_equal(nums, [1, 2])
+    np.testing.assert_allclose(rois[0], [0, 0, 1, 1])       # img0's 0.9
+    np.testing.assert_allclose(rois[1], [4, 4, 5, 5])       # img1's 0.95
+    np.testing.assert_allclose(rois[2], [2, 2, 3, 3])       # img1's 0.8
+
+
+def test_assign_pos_groups_by_expert():
+    from paddle_tpu.distributed.utils.moe_utils import assign_pos
+    gate = np.array([2, 0, 1, 0, 2, -1, 1], np.int64)
+    counts = np.bincount(gate[gate >= 0], minlength=3)
+    cum = np.cumsum(counts).astype(np.int64)
+    pos = np.asarray(assign_pos(_t(gate), _t(cum)).numpy())
+    np.testing.assert_array_equal(pos, [1, 3, 2, 6, 0, 4])
+    # eff_num_len truncates
+    pos2 = np.asarray(assign_pos(_t(gate), _t(cum),
+                                 _t(np.array([4], np.int64))).numpy())
+    np.testing.assert_array_equal(pos2, [1, 3, 2, 6])

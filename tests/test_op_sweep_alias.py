@@ -1265,6 +1265,15 @@ def _sequence_pool():
     np.testing.assert_allclose(out[1], x[1, 0], rtol=1e-6)
 
 
+@alias("assign_pos")
+def _assign_pos():
+    from paddle_tpu.distributed.utils.moe_utils import assign_pos
+    gate = np.array([1, 0, 1], np.int64)
+    cum = np.array([1, 3], np.int64)
+    pos = np.asarray(assign_pos(_t(gate), _t(cum)).numpy())
+    np.testing.assert_array_equal(pos, [1, 0, 2])
+
+
 @alias("detection_map")
 def _detection_map():
     from paddle_tpu.incubate import layers as IL

@@ -40,7 +40,8 @@ ALIASES = {
     "fmax": "fmax", "grad_add": "add", "remainder": "remainder",
     "share_buffer": "Tensor.detach", "share_data": "Tensor.detach",
     "assign": "assign", "assign_out_": "assign",
-    "assign_pos": None, "assign_value": "assign",
+    "assign_value": "assign",
+    "assign_pos": "distributed.utils.moe_utils.assign_pos",
     "full_batch_size_like": "full", "fill": "full",
     "fill_diagonal": "Tensor.fill_diagonal_",
     "fill_diagonal_tensor": "Tensor.fill_diagonal_",
@@ -279,11 +280,10 @@ OUT_OF_SCOPE = {
     # bipartite_match/box_clip) is classified directly or via ALIASES
     "density_prior_box", "locality_aware_nms", "mine_hard_examples",
     "polygon_box_transform", "retinanet_detection_output",
-    "rpn_target_assign", "ssd_loss", "target_assign", "yolo_box_head",
-    "yolo_box_post", "prroi_pool", "collect_fpn_proposals",
+    "rpn_target_assign", "ssd_loss", "target_assign", "prroi_pool",
     # executor/stream plumbing subsumed by XLA program semantics
     "sync_calc_stream", "coalesce_tensor", "depend",
-    "memcpy_d2h_multi_io", "beam_search_decode", "assign_pos",
+    "memcpy_d2h_multi_io", "beam_search_decode",
 
     # PS/recommender GPU-legacy ops with no reimplementable contract:
     # pyramid_hash is a bespoke hash-embedding scheme, match_matrix_tensor
