@@ -34,7 +34,7 @@ __all__ = [
     "tdm_sampler", "rank_attention", "batch_fc", "correlation",
     "affine_channel", "add_position_encoding", "bipartite_match",
     "box_clip", "ctc_align", "chunk_eval", "im2sequence",
-    "detection_map",
+    "detection_map", "attention_lstm", "match_matrix_tensor",
 ]
 
 
@@ -792,3 +792,147 @@ def detection_map(detect_res, gt_label, class_num: int,
                                        true_pos.items()},
                                       {k: list(v) for k, v in
                                        false_pos.items()})
+
+
+# ------------------------------------------------------- attention_lstm
+_LSTM_ACTS = {
+    "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+    "relu": jax.nn.relu, "identity": (lambda v: v),
+}
+
+
+def attention_lstm(x, c0, h0=None, attention_weight=None,
+                   attention_bias=None, attention_scalar=None,
+                   attention_scalar_bias=None, lstm_weight=None,
+                   lstm_bias=None, lengths=None,
+                   gate_activation: str = "sigmoid",
+                   cell_activation: str = "tanh",
+                   candidate_activation: str = "tanh", name=None):
+    """Fused attention + LSTM over padded (B, L, M) sequences.
+
+    Every step re-attends over the whole sequence: scores =
+    softmax(relu(x @ aw[:M] + ab + prev_cell . aw[M:]) [* scalar + sb]),
+    the attention-pooled input drives one LSTM step with gate layout
+    ``[forget, input, output, candidate]`` in ``lstm_weight
+    ((D+M), 4D)`` (hidden rows first, input rows after — reference
+    kernel's `lstm_w_data + D*D4` split). Returns ``(hidden (B, L, D),
+    cell (B, L, D))``, zero-padded past each length.
+
+    reference: paddle/phi/kernels/cpu/attention_lstm_kernel.cc
+    (AttentionLSTMKernel; CPU-only legacy fusion — LoD becomes padded +
+    ``lengths``). lax.scan over steps: one compiled program, grads via
+    jax autodiff (the reference op is forward-only).
+    """
+    for act in (gate_activation, cell_activation, candidate_activation):
+        if act not in _LSTM_ACTS:
+            raise ValueError(f"unsupported activation {act!r}")
+    xt = as_tensor(x)
+    if xt.ndim != 3:
+        raise ValueError("attention_lstm expects (batch, max_len, M) + "
+                         "lengths (LoD-free padded form)")
+    B, L, M = (int(s) for s in xt.shape)
+    aw = as_tensor(attention_weight)
+    lw = as_tensor(lstm_weight)
+    D = int(lw.shape[1]) // 4
+    args = [xt, as_tensor(c0), aw, lw, as_tensor(lstm_bias)]
+    opt = {"h0": h0, "ab": attention_bias, "asc": attention_scalar,
+           "asb": attention_scalar_bias, "lens": lengths}
+    keys = [k for k, v in opt.items() if v is not None]
+    args += [as_tensor(opt[k]) for k in keys]
+    act_g = _LSTM_ACTS[gate_activation]
+    act_c = _LSTM_ACTS[cell_activation]
+    act_d = _LSTM_ACTS[candidate_activation]
+
+    def fn(xv, c0v, awv, lwv, lbv, *rest):
+        o = dict(zip(keys, rest))
+        ln = o["lens"].reshape(-1).astype(jnp.int32) if "lens" in o \
+            else jnp.full((B,), L, jnp.int32)
+        mask = jnp.arange(L)[None, :] < ln[:, None]          # (B, L)
+        atted = xv.astype(jnp.float32) @ awv[:M].reshape(M)  # (B, L)
+        if "ab" in o:
+            atted = atted + o["ab"].reshape(())
+        h_init = o["h0"].astype(jnp.float32) if "h0" in o else \
+            jnp.zeros((B, D), jnp.float32)
+        w_h, w_x = lwv[:D].astype(jnp.float32), lwv[D:].astype(jnp.float32)
+
+        def step(carry, _):
+            h_prev, c_prev = carry
+            s = atted + (c_prev @ awv[M:].reshape(D, 1)[:, 0])[:, None]
+            s = jax.nn.relu(s)
+            if "asc" in o:
+                s = s * o["asc"].reshape(())
+                if "asb" in o:
+                    s = jax.nn.relu(s + o["asb"].reshape(()))
+                else:
+                    s = jax.nn.relu(s)
+            # finite mask value, not -inf: a zero-length row would make
+            # softmax NaN, and 0 * NaN = NaN poisons the summed weight
+            # grads of the whole batch in the scan backward
+            s = jnp.where(mask, s, -1e30)
+            attn = jax.nn.softmax(s, axis=1)                 # (B, L)
+            attn = jnp.where(mask & (ln > 0)[:, None], attn, 0.0)
+            pooled = jnp.einsum("bl,blm->bm", attn,
+                                xv.astype(jnp.float32))      # (B, M)
+            gates = pooled @ w_x + h_prev @ w_h + lbv.reshape(-1)
+            f = act_g(gates[:, :D])
+            i = act_g(gates[:, D:2 * D])
+            og = act_g(gates[:, 2 * D:3 * D])
+            cand = act_d(gates[:, 3 * D:])
+            c_new = f * c_prev + i * cand
+            h_new = act_c(c_new) * og
+            return (h_new, c_new), (h_new, c_new)
+
+        (_, _), (hs, cs) = lax.scan(step, (h_init, c0v.astype(jnp.float32)),
+                                    None, length=L)
+        hs = jnp.swapaxes(hs, 0, 1)                          # (B, L, D)
+        cs = jnp.swapaxes(cs, 0, 1)
+        hs = jnp.where(mask[..., None], hs, 0).astype(xv.dtype)
+        cs = jnp.where(mask[..., None], cs, 0).astype(xv.dtype)
+        return hs, cs
+
+    return apply(fn, *args, name="attention_lstm", multi_out=True)
+
+
+# --------------------------------------------------- match_matrix_tensor
+def match_matrix_tensor(x, y, w, dim_t: int, x_lengths=None,
+                        y_lengths=None, name=None):
+    """Bilinear text-matching tensor: for each pair of rows
+    ``out[b, t, i, j] = x[b, i] @ W[:, t, :] @ y[b, j]`` over padded
+    (B, Lx, D) x and (B, Ly, D) y with ``w (D, dim_t, D)`` (or the
+    reference's flattened ``(D, dim_t*D)``); positions past the lengths
+    are zero.
+
+    reference: paddle/phi/kernels/cpu/match_matrix_tensor_kernel.cc
+    (x @ w as one gemm, then per-(batch, t) gemm against y^T — here one
+    einsum the MXU tiles directly; LoD pairs become the padded batch).
+    """
+    xt, yt, wt = as_tensor(x), as_tensor(y), as_tensor(w)
+    if xt.ndim != 3 or yt.ndim != 3:
+        raise ValueError("match_matrix_tensor expects padded (B, L, D) "
+                         "inputs + lengths")
+    d = int(xt.shape[-1])
+    args = [xt, yt, wt]
+    keys = []
+    if x_lengths is not None:
+        keys.append("lx")
+        args.append(as_tensor(x_lengths))
+    if y_lengths is not None:
+        keys.append("ly")
+        args.append(as_tensor(y_lengths))
+
+    def fn(xv, yv, wv, *rest):
+        o = dict(zip(keys, rest))
+        w3 = wv.reshape(d, dim_t, d).astype(jnp.float32)
+        out = jnp.einsum("bid,dte,bje->btij", xv.astype(jnp.float32),
+                         w3, yv.astype(jnp.float32))
+        if "lx" in o:
+            mi = jnp.arange(out.shape[2])[None, None, :, None] < \
+                o["lx"].reshape(-1, 1, 1, 1)
+            out = jnp.where(mi, out, 0)
+        if "ly" in o:
+            mj = jnp.arange(out.shape[3])[None, None, None, :] < \
+                o["ly"].reshape(-1, 1, 1, 1)
+            out = jnp.where(mj, out, 0)
+        return out.astype(xv.dtype)
+
+    return apply(fn, *args, name="match_matrix_tensor")

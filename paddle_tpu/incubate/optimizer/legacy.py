@@ -72,12 +72,17 @@ class Dpsgd(Optimizer):
         super().__init__(learning_rate, parameters, None, None)
         self._clip, self._bs, self._sigma = clip, batch_size, sigma
         self._seed = seed
-        self._noise_step, self._noise_ord = None, 0
+        self._noise_ord = 0
 
     def _slots(self):
         return ()
 
     def _context(self):
+        # reset the per-step parameter counter here: _context runs once
+        # per step()/functional build, BEFORE the per-parameter
+        # _update_rule loop, and never touches traced values (a traced
+        # ctx["step"] comparison would break under jax.jit)
+        self._noise_ord = 0
         return {"clip": self._clip, "bs": self._bs, "sigma": self._sigma,
                 "seed": self._seed}
 
@@ -88,14 +93,12 @@ class Dpsgd(Optimizer):
         # key folds in the parameter's position in the (fixed) update
         # order so tensors never share a noise draw — auto-generated
         # tensor names are not stable across runs, positions are — and
-        # the draw is per-coordinate (see docstring)
-        step = ctx["step"]
-        if step != self._noise_step:
-            self._noise_step, self._noise_ord = step, 0
+        # the draw is per-coordinate (see docstring). The position is a
+        # python-level (trace-time) constant; ctx["step"] may be traced.
         idx = self._noise_ord
         self._noise_ord += 1
         key = jax.random.fold_in(jax.random.key(ctx["seed"]),
-                                 jnp.asarray(step, jnp.uint32))
+                                 jnp.asarray(ctx["step"], jnp.uint32))
         key = jax.random.fold_in(key, jnp.uint32(idx))
         noise = jax.random.normal(key, g.shape) * ctx["sigma"]
         return (p - lr * (g / scale + noise / ctx["bs"])).astype(p.dtype), \

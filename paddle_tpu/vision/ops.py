@@ -1014,10 +1014,8 @@ def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
     (YoloTensorParseKernel + PostNMS; clip_bbox/scale_x_y are accepted
     and unused there too). Host-side numpy: serving post-processing.
     """
-    import numpy as _np
-
     def _arr(t):
-        return _np.asarray(raw(as_tensor(t))).astype(_np.float32)
+        return np.asarray(raw(as_tensor(t))).astype(np.float32)
 
     levels = [(_arr(boxes0), list(anchors0), downsample_ratio0),
               (_arr(boxes1), list(anchors1), downsample_ratio1),
@@ -1031,13 +1029,12 @@ def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
         dets = []   # (cls, obj, x1, y1, x2, y2, probs)
         for pred, anc, ds in levels:
             na = len(anc) // 2
-            B, C, H, W = pred.shape
-            g = H                      # square grids (reference contract)
+            _, C, H, W = pred.shape
             p = pred[b].reshape(na, C // na, H, W)
             netw, neth = ds * W, ds * H
             for a in range(na):
                 obj = p[a, 4]
-                ys, xs = _np.nonzero(obj >= conf_thresh)
+                ys, xs = np.nonzero(obj >= conf_thresh)
                 for yy, xx in zip(ys, xs):
                     o = obj[yy, xx]
                     cx = (p[a, 0, yy, xx] + xx) * pic_w / W
@@ -1049,15 +1046,15 @@ def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
                     x2 = min(cx + ww / 2, pic_w - 1)
                     y2 = min(cy + hh / 2, pic_h - 1)
                     probs = p[a, 5:, yy, xx] * o
-                    cls = int(_np.argmax(probs)) if probs.size else -1
+                    cls = int(np.argmax(probs)) if probs.size else -1
                     dets.append([cls, float(o), x1, y1, x2, y2,
                                  float(probs[cls]) if probs.size else 0.0])
         dets.sort(key=lambda d: (d[0], -d[6]))
         if dets:
             # one IoU matrix via the module's box_iou (single source of
             # IoU truth with nms/detection paths)
-            bx = _np.asarray([d[2:6] for d in dets], _np.float32)
-            iou = _np.asarray(raw(box_iou(Tensor(bx), Tensor(bx))))
+            bx = np.asarray([d[2:6] for d in dets], np.float32)
+            iou = np.asarray(raw(box_iou(Tensor(bx), Tensor(bx))))
         for i in range(len(dets)):
             if dets[i][1] == 0:
                 continue
@@ -1073,8 +1070,8 @@ def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
         nums.append(len(dets))
     if not out_rows:
         out_rows = [[0.0] * 6]
-    return (Tensor(_np.asarray(out_rows, _np.float32)),
-            Tensor(_np.asarray(nums, _np.int32)))
+    return (Tensor(np.asarray(out_rows, np.float32)),
+            Tensor(np.asarray(nums, np.int32)))
 
 
 def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
@@ -1092,31 +1089,30 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     reference: phi/kernels/impl/collect_fpn_proposals_kernel_impl.h
     (stable score sort -> truncate -> stable batch-id sort).
     """
-    import numpy as _np
-    rois = [_np.asarray(raw(as_tensor(r))).reshape(-1, 4)
+    rois = [np.asarray(raw(as_tensor(r))).reshape(-1, 4)
             for r in multi_rois]
-    scores = [_np.asarray(raw(as_tensor(s))).reshape(-1)
+    scores = [np.asarray(raw(as_tensor(s))).reshape(-1)
               for s in multi_scores]
     nlev = len(rois)
     if rois_num_per_level is None:
         # single-image convenience: everything is batch 0
-        nums = [_np.asarray([len(s)], _np.int64) for s in scores]
+        nums = [np.asarray([len(s)], np.int64) for s in scores]
     else:
-        nums = [_np.asarray(raw(as_tensor(n))).reshape(-1).astype(
-            _np.int64) for n in rois_num_per_level]
+        nums = [np.asarray(raw(as_tensor(n))).reshape(-1).astype(
+            np.int64) for n in rois_num_per_level]
     batch = len(nums[0])
     recs = []          # (score, level, index_in_level, batch_id)
     for lv in range(nlev):
-        bid = _np.repeat(_np.arange(batch), nums[lv])
+        bid = np.repeat(np.arange(batch), nums[lv])
         for j in range(len(scores[lv])):
             recs.append((float(scores[lv][j]), lv, j, int(bid[j])))
     order = sorted(range(len(recs)), key=lambda i: -recs[i][0])
     keep = min(post_nms_top_n, len(recs))
     top = [recs[i] for i in order[:keep]]
     top.sort(key=lambda r: r[3])            # stable: batch-major
-    out = _np.stack([rois[lv][idx] for _, lv, idx, _ in top]) if top \
-        else _np.zeros((0, 4), _np.float32)
-    counts = _np.zeros((batch,), _np.int32)
+    out = np.stack([rois[lv][idx] for _, lv, idx, _ in top]) if top \
+        else np.zeros((0, 4), np.float32)
+    counts = np.zeros((batch,), np.int32)
     for _, _, _, b in top:
         counts[b] += 1
-    return Tensor(out.astype(_np.float32)), Tensor(counts)
+    return Tensor(out.astype(np.float32)), Tensor(counts)

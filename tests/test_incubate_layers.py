@@ -420,3 +420,111 @@ def test_detection_map_excludes_background_class():
                       [1, 0.7, 0.5, 0.5, 0.6, 0.6]], np.float32)]
     m3, _ = L.detection_map(det3, gt3, class_num=2, background_label=3)
     np.testing.assert_allclose(float(np.asarray(m3.numpy())), 1.0)
+
+
+# ---------------------------------------------------- attention_lstm
+def test_attention_lstm_oracle():
+    B, SL, M, D = 2, 4, 3, 2
+    rs = np.random.RandomState(7)
+    x = rs.randn(B, SL, M).astype(np.float32)
+    lens = np.array([4, 2], np.int64)
+    c0 = rs.randn(B, D).astype(np.float32) * 0.1
+    h0 = rs.randn(B, D).astype(np.float32) * 0.1
+    aw = rs.randn(M + D, 1).astype(np.float32)
+    ab = np.float32(rs.randn())
+    lw = rs.randn(D + M, 4 * D).astype(np.float32) * 0.3
+    lb = rs.randn(4 * D).astype(np.float32) * 0.1
+    hs, cs = L.attention_lstm(
+        _t(x), _t(c0), h0=_t(h0), attention_weight=_t(aw),
+        attention_bias=_t(np.array([ab])), lstm_weight=_t(lw),
+        lstm_bias=_t(lb), lengths=_t(lens))
+    hs, cs = np.asarray(hs.numpy()), np.asarray(cs.numpy())
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    # oracle: reference kernel loop (attention_lstm_kernel.cc)
+    for b in range(B):
+        T = int(lens[b])
+        seq = x[b, :T]
+        atted = seq @ aw[:M, 0] + ab
+        hp, cp = h0[b], c0[b]
+        for t in range(T):
+            s = np.maximum(atted + cp @ aw[M:, 0], 0)
+            e = np.exp(s - s.max())
+            attn = e / e.sum()
+            pooled = attn @ seq
+            gates = pooled @ lw[D:] + hp @ lw[:D] + lb
+            f, i, o = sig(gates[:D]), sig(gates[D:2*D]), sig(gates[2*D:3*D])
+            cand = np.tanh(gates[3*D:])
+            cp = f * cp + i * cand
+            hp = np.tanh(cp) * o
+            np.testing.assert_allclose(cs[b, t], cp, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(hs[b, t], hp, rtol=1e-4, atol=1e-5)
+    # padding stays zero
+    assert np.abs(hs[1, 2:]).sum() == 0
+
+
+def test_attention_lstm_scalar_and_grad():
+    B, SL, M, D = 1, 3, 2, 2
+    rs = np.random.RandomState(1)
+    x = _t(rs.randn(B, SL, M).astype(np.float32))
+    x.stop_gradient = False
+    lw = _t(rs.randn(D + M, 4 * D).astype(np.float32) * 0.3)
+    lw.stop_gradient = False
+    hs, cs = L.attention_lstm(
+        x, _t(np.zeros((B, D), np.float32)),
+        attention_weight=_t(rs.randn(M + D, 1).astype(np.float32)),
+        attention_scalar=_t(np.array([2.0], np.float32)),
+        attention_scalar_bias=_t(np.array([0.1], np.float32)),
+        lstm_weight=lw, lstm_bias=_t(np.zeros(4 * D, np.float32)))
+    hs.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.numpy())).all()
+    assert np.isfinite(np.asarray(lw.grad.numpy())).all()
+    with pytest.raises(ValueError):
+        L.attention_lstm(x, _t(np.zeros((B, D), np.float32)),
+                         attention_weight=_t(np.zeros((M + D, 1),
+                                                      np.float32)),
+                         lstm_weight=lw,
+                         lstm_bias=_t(np.zeros(4 * D, np.float32)),
+                         gate_activation="selu")
+
+
+# ------------------------------------------------ match_matrix_tensor
+def test_match_matrix_tensor_oracle():
+    B, Lx, Ly, D, T = 2, 3, 4, 2, 3
+    rs = np.random.RandomState(5)
+    x = rs.randn(B, Lx, D).astype(np.float32)
+    y = rs.randn(B, Ly, D).astype(np.float32)
+    w = rs.randn(D, T, D).astype(np.float32)
+    lx = np.array([3, 2], np.int64)
+    ly = np.array([4, 1], np.int64)
+    out = np.asarray(L.match_matrix_tensor(
+        _t(x), _t(y), _t(w), dim_t=T, x_lengths=_t(lx),
+        y_lengths=_t(ly)).numpy())
+    assert out.shape == (B, T, Lx, Ly)
+    for b in range(B):
+        for t in range(T):
+            for i in range(int(lx[b])):
+                for j in range(int(ly[b])):
+                    np.testing.assert_allclose(
+                        out[b, t, i, j], x[b, i] @ w[:, t] @ y[b, j],
+                        rtol=1e-4, atol=1e-5)
+    assert np.abs(out[1, :, 2:, :]).sum() == 0
+    assert np.abs(out[1, :, :, 1:]).sum() == 0
+    # flattened reference weight layout accepted
+    out2 = np.asarray(L.match_matrix_tensor(
+        _t(x), _t(y), _t(w.reshape(D, T * D)), dim_t=T).numpy())
+    np.testing.assert_allclose(out2[0], out[0], rtol=1e-5, atol=1e-6)
+
+
+def test_match_matrix_tensor_grad():
+    rs = np.random.RandomState(9)
+    x = _t(rs.randn(1, 2, 3).astype(np.float32))
+    w = _t(rs.randn(3, 2, 3).astype(np.float32))
+    x.stop_gradient = False
+    w.stop_gradient = False
+    out = L.match_matrix_tensor(x, _t(rs.randn(1, 2, 3).astype(np.float32)),
+                                w, dim_t=2)
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.numpy())).all()
+    assert np.isfinite(np.asarray(w.grad.numpy())).all()

@@ -1274,6 +1274,29 @@ def _assign_pos():
     np.testing.assert_array_equal(pos, [1, 0, 2])
 
 
+@alias("attention_lstm")
+def _attention_lstm():
+    from paddle_tpu.incubate import layers as IL
+    B, SL, M, D = 1, 3, 2, 2
+    hs, cs = IL.attention_lstm(
+        _t(_f32(B, SL, M)), _t(np.zeros((B, D), np.float32)),
+        attention_weight=_t(_f32(M + D, 1, seed=1)),
+        lstm_weight=_t(_f32(D + M, 4 * D, seed=2) * 0.3),
+        lstm_bias=_t(np.zeros(4 * D, np.float32)))
+    assert np.asarray(hs.numpy()).shape == (B, SL, D)
+    _finite(hs)
+
+
+@alias("match_matrix_tensor")
+def _match_matrix_tensor():
+    from paddle_tpu.incubate import layers as IL
+    out = IL.match_matrix_tensor(
+        _t(_f32(1, 2, 3)), _t(_f32(1, 4, 3, seed=1)),
+        _t(_f32(3, 2, 3, seed=2)), dim_t=2)
+    assert np.asarray(out.numpy()).shape == (1, 2, 2, 4)
+    _finite(out)
+
+
 @alias("detection_map")
 def _detection_map():
     from paddle_tpu.incubate import layers as IL

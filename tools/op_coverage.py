@@ -266,6 +266,8 @@ ALIASES = {
     "sequence_pool": "static.nn.sequence_pool",
     "ftrl": "incubate.optimizer.Ftrl",
     "detection_map": "incubate.layers.detection_map",
+    "attention_lstm": "incubate.layers.attention_lstm",
+    "match_matrix_tensor": "incubate.layers.match_matrix_tensor",
     "dpsgd": "incubate.optimizer.Dpsgd",
 }
 
@@ -285,14 +287,13 @@ OUT_OF_SCOPE = {
     "sync_calc_stream", "coalesce_tensor", "depend",
     "memcpy_d2h_multi_io", "beam_search_decode",
 
-    # PS/recommender GPU-legacy ops with no reimplementable contract:
-    # pyramid_hash is a bespoke hash-embedding scheme, match_matrix_tensor
-    # a legacy text-matching op; the rest of the rec-sys tier now lives in
-    # incubate.layers (ALIASES)
-    "pyramid_hash", "match_matrix_tensor",
-    # GPU/NPU-runtime specific: fused LSTM+attention CPU-only legacy op,
-    # flash-attention GPU helper, ascend-format identity
-    "attention_lstm", "calc_reduced_attn_scores", "npu_identity",
+    # pyramid_hash: bespoke fused bloom-filter hash-embedding scheme with
+    # no reimplementable python contract (de-scoped; the embedding
+    # capability = nn.Embedding / PS sparse tables)
+    "pyramid_hash",
+    # GPU/NPU-runtime specific: flash-attention GPU scratch helper,
+    # ascend-format identity
+    "calc_reduced_attn_scores", "npu_identity",
     # sparse 3D point-cloud conv stack (GPU implicit-gemm; no TPU sparse
     # conv path — dense conv3d covers the capability)
     "conv3d_implicit_gemm", "maxpool", "fused_attention",
