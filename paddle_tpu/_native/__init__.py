@@ -18,7 +18,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["store.cpp", "datapath.cpp", "ckptio.cpp", "datafeed.cpp",
-            "hosttracer.cpp"]
+            "hosttracer.cpp", "ssdtable.cpp"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -101,6 +101,29 @@ def load():
         lib.pt_trace_drain.restype = ctypes.c_int64
         lib.pt_trace_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.pt_trace_clear.argtypes = []
+        lib.pt_ssd_open.restype = ctypes.c_void_p
+        lib.pt_ssd_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64]
+        lib.pt_ssd_pull.restype = ctypes.c_int64
+        lib.pt_ssd_pull.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_ssd_insert.restype = ctypes.c_int
+        lib.pt_ssd_insert.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float)]
+        lib.pt_ssd_push.restype = ctypes.c_int64
+        lib.pt_ssd_push.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_float, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_ssd_flush.restype = ctypes.c_int
+        lib.pt_ssd_flush.argtypes = [ctypes.c_void_p]
+        lib.pt_ssd_stats.restype = ctypes.c_int
+        lib.pt_ssd_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_ssd_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

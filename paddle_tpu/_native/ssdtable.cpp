@@ -1,0 +1,260 @@
+// Native SSD-tier sparse table (reference:
+// paddle/fluid/distributed/ps/table/ssd_sparse_table.h — RocksDB-backed
+// rows behind a RAM hot cache; the reference's table storage layer is
+// C++, so this framework's is too).
+//
+// Design (matches the python SSDTable contract in
+// distributed/ps/the_one_ps.py): fixed-size records (row + adagrad
+// accumulator, 2*dim float32) in one slot file addressed by a RAM
+// key->slot index; bounded LRU cache of hot rows; evictions write back.
+// Row INITIALIZATION stays in python (numpy PCG64 stream parity): pull
+// reports missing keys, the wrapper inserts initialized rows.
+//
+// Exposed C ABI (ctypes): pt_ssd_open/pull/insert/push/flush/stats/close.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <list>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kRecGrow = 65536;  // slots per file extension
+
+struct Entry {
+  std::vector<float> row;
+  std::vector<float> g2;
+  std::list<int64_t>::iterator it;  // position in LRU order
+};
+
+struct SsdTable {
+  int fd = -1;
+  int64_t dim = 0;
+  int64_t rec = 0;  // record bytes: 2*dim*4
+  int64_t capacity = 0;  // slots allocated in the file
+  size_t cache_rows = 0;
+  int64_t evictions = 0;
+  bool io_error = false;  // sticky: any slot read/write failure
+  std::unordered_map<int64_t, int64_t> slots;  // key -> slot
+  std::list<int64_t> order;                    // LRU (front = oldest)
+  std::unordered_map<int64_t, Entry> cache;
+  std::mutex mu;
+};
+
+bool ensure_capacity(SsdTable* t, int64_t slot) {
+  if (slot < t->capacity) return true;
+  int64_t cap = t->capacity;
+  while (slot >= cap) cap += kRecGrow;
+  if (ftruncate(t->fd, cap * t->rec) != 0) return false;
+  t->capacity = cap;
+  return true;
+}
+
+bool write_slot(SsdTable* t, int64_t slot, const float* row,
+                const float* g2) {
+  if (!ensure_capacity(t, slot)) return false;
+  const int64_t half = t->dim * (int64_t)sizeof(float);
+  if (pwrite(t->fd, row, half, slot * t->rec) != half) return false;
+  if (pwrite(t->fd, g2, half, slot * t->rec + half) != half) return false;
+  return true;
+}
+
+bool read_slot(SsdTable* t, int64_t slot, float* row, float* g2) {
+  const int64_t half = t->dim * (int64_t)sizeof(float);
+  if (pread(t->fd, row, half, slot * t->rec) != half) return false;
+  if (pread(t->fd, g2, half, slot * t->rec + half) != half) return false;
+  return true;
+}
+
+void evict_if_full(SsdTable* t) {
+  while (t->cache.size() > t->cache_rows && !t->order.empty()) {
+    int64_t k = t->order.front();
+    t->order.pop_front();
+    auto it = t->cache.find(k);
+    if (it == t->cache.end()) continue;
+    if (!write_slot(t, t->slots[k], it->second.row.data(),
+                    it->second.g2.data()))
+      t->io_error = true;  // losing an evicted row silently would
+                           // corrupt training state — fail the table
+    t->cache.erase(it);
+    t->evictions++;
+  }
+}
+
+void touch(SsdTable* t, std::unordered_map<int64_t, Entry>::iterator it,
+           int64_t key) {
+  t->order.erase(it->second.it);
+  t->order.push_back(key);
+  it->second.it = std::prev(t->order.end());
+}
+
+// cache-or-disk lookup. status: 0 = found (*out set), 1 = key absent,
+// -1 = I/O failure (a disk error must NOT read as "missing" — the
+// wrapper would silently re-initialize a trained row).
+int get_entry(SsdTable* t, int64_t key, Entry** out) {
+  auto it = t->cache.find(key);
+  if (it != t->cache.end()) {
+    touch(t, it, key);
+    *out = &it->second;
+    return 0;
+  }
+  auto sit = t->slots.find(key);
+  if (sit == t->slots.end()) return 1;
+  Entry e;
+  e.row.resize(t->dim);
+  e.g2.resize(t->dim);
+  if (!read_slot(t, sit->second, e.row.data(), e.g2.data())) {
+    t->io_error = true;
+    return -1;
+  }
+  t->order.push_back(key);
+  e.it = std::prev(t->order.end());
+  t->cache.emplace(key, std::move(e));
+  evict_if_full(t);
+  // eviction cannot remove the entry just appended at the LRU back
+  // unless cache_rows == 0; re-find to stay correct in that edge
+  auto again = t->cache.find(key);
+  if (again == t->cache.end()) return -1;
+  *out = &again->second;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ssd_open(const char* path, int64_t dim, int64_t cache_rows) {
+  SsdTable* t = new SsdTable();
+  t->dim = dim;
+  t->rec = 2 * dim * (int64_t)sizeof(float);
+  t->cache_rows = (size_t)(cache_rows > 0 ? cache_rows : 1);
+  t->fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (t->fd < 0) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+// out: (n, dim) float32. missing: caller-allocated int64[n]; returns the
+// count of missing keys written there (their out rows are untouched),
+// or -1 on I/O failure.
+int64_t pt_ssd_pull(void* h, const int64_t* keys, int64_t n, float* out,
+                    int64_t* missing) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  int64_t miss = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Entry* e = nullptr;
+    int st = get_entry(t, keys[i], &e);
+    if (st < 0 || t->io_error) return -1;
+    if (st == 1) {
+      missing[miss++] = i;
+      continue;
+    }
+    memcpy(out + i * t->dim, e->row.data(), t->dim * sizeof(float));
+  }
+  return miss;
+}
+
+// rows: (n, dim) initialized values for NEW keys (g2 starts zero).
+int pt_ssd_insert(void* h, const int64_t* keys, int64_t n,
+                  const float* rows) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    if (t->slots.find(key) == t->slots.end())
+      t->slots.emplace(key, (int64_t)t->slots.size());
+    auto it = t->cache.find(key);
+    if (it != t->cache.end()) {
+      memcpy(it->second.row.data(), rows + i * t->dim,
+             t->dim * sizeof(float));
+      std::fill(it->second.g2.begin(), it->second.g2.end(), 0.f);
+      touch(t, it, key);
+      continue;
+    }
+    Entry e;
+    e.row.assign(rows + i * t->dim, rows + (i + 1) * t->dim);
+    e.g2.assign(t->dim, 0.f);
+    t->order.push_back(key);
+    e.it = std::prev(t->order.end());
+    t->cache.emplace(key, std::move(e));
+    evict_if_full(t);
+  }
+  return 0;
+}
+
+// opt: 0 = sgd, 1 = adagrad. Unknown keys are skipped; their INDICES
+// land in caller-allocated skipped[n] and the count is returned (the
+// wrapper initializes exactly those and re-pushes only them — re-pushing
+// the whole batch would double-apply existing keys). -1 on I/O failure.
+int64_t pt_ssd_push(void* h, const int64_t* keys, int64_t n,
+                    const float* grads, float lr, int opt,
+                    int64_t* skipped) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  int64_t n_skip = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Entry* e = nullptr;
+    int st = get_entry(t, keys[i], &e);
+    if (st < 0 || t->io_error) return -1;
+    if (st == 1) {
+      skipped[n_skip++] = i;
+      continue;
+    }
+    const float* g = grads + i * t->dim;
+    float* row = e->row.data();
+    float* g2 = e->g2.data();
+    if (opt == 1) {
+      for (int64_t d = 0; d < t->dim; ++d) {
+        g2[d] += g[d] * g[d];
+        row[d] -= lr * g[d] / (sqrtf(g2[d]) + 1e-8f);
+      }
+    } else {
+      for (int64_t d = 0; d < t->dim; ++d) row[d] -= lr * g[d];
+    }
+  }
+  return n_skip;
+}
+
+int pt_ssd_flush(void* h) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (auto& kv : t->cache) {
+    if (!write_slot(t, t->slots[kv.first], kv.second.row.data(),
+                    kv.second.g2.data()))
+      return -1;
+  }
+  return fsync(t->fd) == 0 ? 0 : -1;
+}
+
+// out: int64[4] = {keys, ram_rows, evictions, disk_bytes}
+int pt_ssd_stats(void* h, int64_t* out) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  struct stat st;
+  out[0] = (int64_t)t->slots.size();
+  out[1] = (int64_t)t->cache.size();
+  out[2] = t->evictions;
+  out[3] = fstat(t->fd, &st) == 0 ? (int64_t)st.st_size : 0;
+  return 0;
+}
+
+void pt_ssd_close(void* h) {
+  SsdTable* t = (SsdTable*)h;
+  if (t == nullptr) return;
+  pt_ssd_flush(h);
+  close(t->fd);
+  delete t;
+}
+
+}  // extern "C"

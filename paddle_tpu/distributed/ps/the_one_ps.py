@@ -214,13 +214,155 @@ class SSDTable(Table):
         return self._slots
 
 
+
+class NativeSSDTable(SSDTable):
+    """C++ SSD table (``_native/ssdtable.cpp``) behind the same contract:
+    pull/push/flush/stats match SSDTable bit-for-bit (row INIT stays in
+    python so the numpy init stream is identical; the native pull reports
+    missing keys and the wrapper inserts their initialized rows). Falls
+    back to the python table automatically when the toolchain is absent
+    (table factory below).
+
+    reference: paddle/fluid/distributed/ps/table/ssd_sparse_table.h — the
+    reference's table storage layer is C++; so is this one.
+    """
+
+    def __init__(self, cfg: TableConfig):
+        import os
+        import ctypes
+        import tempfile
+        from ... import _native
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self.cfg = cfg
+        self._dim = cfg.dim
+        self._lib = lib
+        d = cfg.path or tempfile.mkdtemp(prefix=f"ps_ssd_{cfg.name}_")
+        os.makedirs(d, exist_ok=True)
+        self._path = os.path.join(d, f"{cfg.name}.slots")
+        self._h = lib.pt_ssd_open(self._path.encode(), cfg.dim,
+                                  cfg.cache_rows)
+        if not self._h:
+            raise RuntimeError(f"pt_ssd_open failed for {self._path}")
+        self._tlock = threading.RLock()
+        self._nkeys = 0
+        self._c_opt = 1 if cfg.optimizer == "adagrad" else 0
+
+    def _ptr(self, arr, ctype):
+        import ctypes
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def pull_sparse(self, keys: np.ndarray) -> np.ndarray:
+        import ctypes
+        keys = np.ascontiguousarray(keys, np.int64)
+        n = len(keys)
+        out = np.empty((n, self._dim), np.float32)
+        missing = np.empty(n, np.int64)
+        with self._tlock:
+            n_miss = self._lib.pt_ssd_pull(
+                self._h, self._ptr(keys, ctypes.c_int64), n,
+                self._ptr(out, ctypes.c_float),
+                self._ptr(missing, ctypes.c_int64))
+            if n_miss < 0:
+                raise IOError(f"SSD table I/O failure ({self._path}) — "
+                              "refusing to reinitialize trained rows")
+            if n_miss:
+                idx = missing[:n_miss]
+                rows = np.stack([self._init_row(int(keys[i]))
+                                 for i in idx])
+                mk = np.ascontiguousarray(keys[idx])
+                rows = np.ascontiguousarray(rows, np.float32)
+                self._lib.pt_ssd_insert(
+                    self._h, self._ptr(mk, ctypes.c_int64), n_miss,
+                    self._ptr(rows, ctypes.c_float))
+                out[idx] = rows
+                self._nkeys += n_miss
+        return out
+
+    def push_sparse(self, keys: np.ndarray, grads: np.ndarray):
+        import ctypes
+        keys = np.ascontiguousarray(keys, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        skip_idx = np.empty(len(keys), np.int64)
+        with self._tlock:
+            skipped = self._lib.pt_ssd_push(
+                self._h, self._ptr(keys, ctypes.c_int64), len(keys),
+                self._ptr(grads, ctypes.c_float),
+                float(self.cfg.lr), self._c_opt,
+                self._ptr(skip_idx, ctypes.c_int64))
+            if skipped < 0:
+                raise IOError(f"SSD table I/O failure ({self._path})")
+            if skipped:
+                # push before pull on brand-new keys: init THOSE keys and
+                # re-push ONLY them (re-pushing the whole batch would
+                # double-apply the grads of keys the first call updated)
+                idx = skip_idx[:skipped]
+                sub_k = np.ascontiguousarray(keys[idx])
+                sub_g = np.ascontiguousarray(grads[idx])
+                self.pull_sparse(sub_k)
+                rc = self._lib.pt_ssd_push(
+                    self._h, self._ptr(sub_k, ctypes.c_int64), len(sub_k),
+                    self._ptr(sub_g, ctypes.c_float),
+                    float(self.cfg.lr), self._c_opt,
+                    self._ptr(skip_idx, ctypes.c_int64))
+                if rc != 0:
+                    raise IOError(
+                        f"SSD table push retry failed ({self._path})")
+
+    def flush(self):
+        with self._tlock:
+            if self._lib.pt_ssd_flush(self._h) != 0:
+                raise IOError(f"pt_ssd_flush failed for {self._path}")
+
+    def stats(self) -> dict:
+        import ctypes
+        st = np.zeros(4, np.int64)
+        with self._tlock:
+            self._lib.pt_ssd_stats(self._h, self._ptr(st, ctypes.c_int64))
+        return {"keys": int(st[0]), "ram_rows": int(st[1]),
+                "evictions": int(st[2]), "disk_bytes": int(st[3])}
+
+    @property
+    def rows(self):
+        class _Sized:  # len() without materializing an O(#keys) dict
+            def __init__(self, n):
+                self._n = n
+
+            def __len__(self):
+                return self._n
+        return _Sized(self.stats()["keys"])
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_ssd_close(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+def _make_ssd_table(cfg: TableConfig):
+    """Native C++ table when the toolchain allows, python otherwise."""
+    from ... import _native
+    if _native.available():
+        try:
+            return NativeSSDTable(cfg)
+        except Exception as e:  # real failure (path perms, open error):
+            import warnings     # degrading silently would hide the slow
+            warnings.warn(       # python fallback in production
+                f"native SSD table unavailable ({type(e).__name__}: {e});"
+                " falling back to the python table", RuntimeWarning)
+    return SSDTable(cfg)
+
+
 # ---- RPC-served functions (executed in the server process) ----
 def _srv_create_table(cfg_dict: dict):
     with _lock:
         cfg = TableConfig(**cfg_dict)
         if cfg.name not in _tables:
-            _tables[cfg.name] = (SSDTable(cfg) if cfg.kind == "ssd"
-                                 else Table(cfg))
+            _tables[cfg.name] = (_make_ssd_table(cfg)
+                                 if cfg.kind == "ssd" else Table(cfg))
     return True
 
 

@@ -826,6 +826,10 @@ def attention_lstm(x, c0, h0=None, attention_weight=None,
     for act in (gate_activation, cell_activation, candidate_activation):
         if act not in _LSTM_ACTS:
             raise ValueError(f"unsupported activation {act!r}")
+    if attention_scalar_bias is not None and attention_scalar is None:
+        # the kernel only reads the bias inside the scalar branch —
+        # accepting it alone would silently ignore a user parameter
+        raise ValueError("attention_scalar_bias requires attention_scalar")
     xt = as_tensor(x)
     if xt.ndim != 3:
         raise ValueError("attention_lstm expects (batch, max_len, M) + "

@@ -287,13 +287,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, g):
+def _bwd(scale, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+         res, g):
     q, k, v, out, lse = res
     do = g
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    # bwd blocks tune independently of fwd (the dkv pass re-reads q/do
+    # per k block and the dq pass re-reads k/v per q block — different
+    # reuse patterns than the fwd)
+    bq = min(block_q_bwd or block_q, sq)
+    bk = min(block_k_bwd or block_k, sk)
     nq = pl.cdiv(sq, bq)
     nk = pl.cdiv(sk, bk)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
@@ -346,13 +350,15 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k,
+                block_q_bwd=None, block_k_bwd=None):
     out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                    block_q_bwd=None, block_k_bwd=None):
     out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
     return out, (q, k, v, out, lse)
 
@@ -361,9 +367,10 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=1024):
+                    block_k=1024, block_q_bwd=None, block_k_bwd=None):
     """(B, S, H, D) flash attention. Raw jax arrays in/out (op-layer wraps
-    it into the Tensor/autograd surface)."""
+    it into the Tensor/autograd surface). block_q_bwd/block_k_bwd
+    override the backward kernels' tiling (None = same as forward)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     hk = k.shape[2]
@@ -376,5 +383,6 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out = _flash_bhsd(qt, kt, vt, s, causal, block_q, block_k)
+    out = _flash_bhsd(qt, kt, vt, s, causal, block_q, block_k,
+                      block_q_bwd, block_k_bwd)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,90 @@
+"""Standalone flash-attention kernel tuner for the bench shape.
+
+Times fwd and fwd+bwd at the headline config (B=4, H=12, S=4096, D=128,
+bf16, causal) across block tilings — much cheaper than full-step sweeps
+(one kernel pair per config instead of a 20-layer model). Run on a live
+chip:  python tools/flash_bench.py [--configs bq,bk,bqb,bkb ...]
+"""
+import sys
+import time
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from paddle_tpu.ops.pallas import flash_attention as fa  # noqa: E402
+
+B, H, S, D = 4, 12, 4096, 128
+
+CONFIGS = [
+    (512, 1024, None, None),     # current default (round-2 retune)
+    (512, 1024, 256, 1024),
+    (512, 1024, 512, 512),
+    (512, 1024, 1024, 512),
+    (512, 1024, 256, 512),
+    (512, 1024, 1024, 1024),
+    (1024, 1024, None, None),
+    (512, 2048, 512, 1024),
+]
+
+
+def main():
+    if len(sys.argv) > 1:
+        cfgs = []
+        for a in sys.argv[1:]:
+            parts = [None if p in ("None", "-") else int(p)
+                     for p in a.split(",")]
+            cfgs.append(tuple(parts))
+    else:
+        cfgs = CONFIGS
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    # causal model-flops for MFU-share accounting: 2*0.5*S^2*D mac*2 ops,
+    # fwd qk+av = 2x, bwd = 2.5x fwd (dq, dkv re-do score matmuls)
+    fwd_flops = 2 * 2 * 0.5 * B * H * S * S * D
+
+    for bq, bk, bqb, bkb in cfgs:
+        def fwd_fn(q, k, v):
+            return fa.flash_attention(q, k, v, causal=True, block_q=bq,
+                                      block_k=bk, block_q_bwd=bqb,
+                                      block_k_bwd=bkb)
+
+        def loss_fn(q, k, v):
+            return fwd_fn(q, k, v).astype(jnp.float32).sum()
+
+        jf = jax.jit(fwd_fn)
+        jg = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
+        try:
+            jf(q, k, v)[0].block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(8):
+                out = jf(q, k, v)
+            out.block_until_ready()
+            t_fwd = (time.perf_counter() - t0) / 8
+            g = jg(q, k, v)
+            g[0].block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(8):
+                g = jg(q, k, v)
+            g[0].block_until_ready()
+            t_all = (time.perf_counter() - t0) / 8
+        except Exception as e:  # noqa: BLE001
+            print(f"CFG {bq},{bk},{bqb},{bkb} FAIL "
+                  f"{type(e).__name__}: {str(e)[:160]}")
+            continue
+        print("FLASH_BENCH " + json.dumps({
+            "cfg": [bq, bk, bqb, bkb],
+            "fwd_ms": round(t_fwd * 1e3, 2),
+            "fwd_bwd_ms": round(t_all * 1e3, 2),
+            "fwd_tflops": round(fwd_flops / t_fwd / 1e12, 1),
+            "fwd_bwd_tflops": round(3.5 * fwd_flops / t_all / 1e12, 1),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
