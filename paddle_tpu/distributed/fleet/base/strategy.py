@@ -60,6 +60,10 @@ class DistributedStrategy:
         self.adaptive_localsgd_configs: Dict[str, Any] = {
             "init_k_steps": 1, "begin_step": 1,
         }
+        # parameter-server modes (reference: distributed_strategy.proto
+        # a_sync + a_sync_configs; k_steps>0 selects geo-SGD)
+        self.a_sync = False
+        self.a_sync_configs: Dict[str, Any] = {"k_steps": 0}
         self.find_unused_parameters = False
         self.hybrid_parallel_order = list(_HYBRID_DEFAULTS["order"])
 

@@ -1,3 +1,6 @@
 from .the_one_ps import (  # noqa: F401
     PsServer, PsClient, Table, TableConfig, sparse_embedding,
 )
+from .communicator import (  # noqa: F401
+    AsyncCommunicator, GeoCommunicator, create_communicator,
+)

@@ -43,6 +43,10 @@ class TableConfig:
 class Table:
     def __init__(self, cfg: TableConfig):
         self.cfg = cfg
+        # the RPC server dispatches handlers on threads (ThreadingTCPServer);
+        # concurrent trainers hitting one table must serialize row access or
+        # a racing _init_row/+= pair silently drops an update
+        self._tlock = threading.RLock()
         if cfg.kind == "dense":
             rng = np.random.default_rng(hash(cfg.name) & 0xffff)
             self.dense = (rng.standard_normal(
@@ -63,39 +67,55 @@ class Table:
     # ---- sparse ----
     def pull_sparse(self, keys: np.ndarray) -> np.ndarray:
         out = np.empty((len(keys), self.cfg.dim), np.float32)
-        for i, k in enumerate(keys.tolist()):
-            row = self.rows.get(k)
-            if row is None:
-                row = self.rows[k] = self._init_row(k)
-            out[i] = row
+        with self._tlock:
+            for i, k in enumerate(keys.tolist()):
+                row = self.rows.get(k)
+                if row is None:
+                    row = self.rows[k] = self._init_row(k)
+                out[i] = row
         return out
 
     def push_sparse(self, keys: np.ndarray, grads: np.ndarray):
         lr = self.cfg.lr
-        for i, k in enumerate(keys.tolist()):
-            row = self.rows.get(k)
-            if row is None:
-                row = self.rows[k] = self._init_row(k)
-            g = grads[i]
-            if self.cfg.optimizer == "adagrad":
-                acc = self.g2.setdefault(
-                    k, np.zeros(self.cfg.dim, np.float32))
-                acc += g * g
-                row -= lr * g / (np.sqrt(acc) + 1e-8)
-            else:
-                row -= lr * g
+        with self._tlock:
+            for i, k in enumerate(keys.tolist()):
+                row = self.rows.get(k)
+                if row is None:
+                    row = self.rows[k] = self._init_row(k)
+                g = grads[i]
+                if self.cfg.optimizer == "adagrad":
+                    acc = self.g2.setdefault(
+                        k, np.zeros(self.cfg.dim, np.float32))
+                    acc += g * g
+                    row -= lr * g / (np.sqrt(acc) + 1e-8)
+                else:
+                    row -= lr * g
+
+    def apply_delta(self, keys: np.ndarray, deltas: np.ndarray):
+        """Geo-mode merge: add raw parameter deltas (no optimizer state —
+        reference GeoCommunicator sends (param - old)/trainer_num and the
+        server adds it; paddle/fluid/distributed/ps/service/communicator/
+        communicator.cc SendSparse/RecvSparse)."""
+        with self._tlock:
+            for i, k in enumerate(keys.tolist()):
+                row = self.rows.get(k)
+                if row is None:
+                    row = self.rows[k] = self._init_row(k)
+                row += deltas[i]
 
     # ---- dense ----
     def pull_dense(self) -> np.ndarray:
-        return self.dense
+        with self._tlock:
+            return self.dense.copy()
 
     def push_dense(self, grads: np.ndarray):
         lr = self.cfg.lr
-        if self.cfg.optimizer == "adagrad":
-            self.dense_g2 += grads * grads
-            self.dense -= lr * grads / (np.sqrt(self.dense_g2) + 1e-8)
-        else:
-            self.dense -= lr * grads
+        with self._tlock:
+            if self.cfg.optimizer == "adagrad":
+                self.dense_g2 += grads * grads
+                self.dense -= lr * grads / (np.sqrt(self.dense_g2) + 1e-8)
+            else:
+                self.dense -= lr * grads
 
 
 class SSDTable(Table):
@@ -193,6 +213,13 @@ class SSDTable(Table):
                     row -= lr * g
                 self._cache[k] = (row, g2)
 
+    def apply_delta(self, keys: np.ndarray, deltas: np.ndarray):
+        with self._tlock:
+            for i, k in enumerate(keys.tolist()):
+                row, g2 = self._get(k)
+                row += deltas[i]
+                self._cache[k] = (row, g2)
+
     def flush(self):
         """Write every cached row back to its slot (checkpoint barrier)."""
         with self._tlock:
@@ -281,6 +308,14 @@ class NativeSSDTable(SSDTable):
         return out
 
     def push_sparse(self, keys: np.ndarray, grads: np.ndarray):
+        self._push(keys, grads, self.cfg.lr, self._c_opt)
+
+    def apply_delta(self, keys: np.ndarray, deltas: np.ndarray):
+        # row -= 1.0 * (-delta) == row += delta; sgd mode (opt=0) leaves
+        # the adagrad accumulator untouched, matching the python tables
+        self._push(keys, np.negative(deltas), 1.0, 0)
+
+    def _push(self, keys, grads, lr, opt):
         import ctypes
         keys = np.ascontiguousarray(keys, np.int64)
         grads = np.ascontiguousarray(grads, np.float32)
@@ -289,7 +324,7 @@ class NativeSSDTable(SSDTable):
             skipped = self._lib.pt_ssd_push(
                 self._h, self._ptr(keys, ctypes.c_int64), len(keys),
                 self._ptr(grads, ctypes.c_float),
-                float(self.cfg.lr), self._c_opt,
+                float(lr), opt,
                 self._ptr(skip_idx, ctypes.c_int64))
             if skipped < 0:
                 raise IOError(f"SSD table I/O failure ({self._path})")
@@ -304,7 +339,7 @@ class NativeSSDTable(SSDTable):
                 rc = self._lib.pt_ssd_push(
                     self._h, self._ptr(sub_k, ctypes.c_int64), len(sub_k),
                     self._ptr(sub_g, ctypes.c_float),
-                    float(self.cfg.lr), self._c_opt,
+                    float(lr), opt,
                     self._ptr(skip_idx, ctypes.c_int64))
                 if rc != 0:
                     raise IOError(
@@ -375,6 +410,19 @@ def _srv_push_sparse(name: str, keys, grads) -> bool:
     return True
 
 
+def _srv_apply_delta(name: str, keys, deltas) -> bool:
+    _tables[name].apply_delta(np.asarray(keys),
+                              np.asarray(deltas, np.float32))
+    return True
+
+
+def _srv_apply_dense_delta(name: str, deltas) -> bool:
+    t = _tables[name]
+    with t._tlock:
+        t.dense += np.asarray(deltas, np.float32)
+    return True
+
+
 def _srv_pull_dense(name: str) -> np.ndarray:
     return _tables[name].pull_dense()
 
@@ -421,22 +469,27 @@ class PsClient:
             self._rpc().rpc_sync(s, _srv_create_table,
                                  args=(dataclasses.asdict(cfg),))
 
+    def _fanout(self, handler, name: str, keys: np.ndarray,
+                vals: Optional[np.ndarray]):
+        """Mod-hash shard keys (+row payload) across servers, fire the
+        handler per shard, wait all; returns [(shard row indices, reply)]."""
+        n = len(self.servers)
+        parts = []
+        for si in range(n):
+            mask = (keys % n) == si
+            if mask.any():
+                args = ((name, keys[mask]) if vals is None
+                        else (name, keys[mask], vals[mask]))
+                parts.append((np.nonzero(mask)[0], self._rpc().rpc_async(
+                    self.servers[si], handler, args=args)))
+        return [(idx, fut.wait()) for idx, fut in parts]
+
     def pull_sparse(self, name: str, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
         if keys.size == 0:
             return np.zeros((0, 0), np.float32)
-        n = len(self.servers)
-        parts = {}
-        for si in range(n):
-            mask = (keys % n) == si
-            if mask.any():
-                parts[si] = (np.nonzero(mask)[0],
-                             self._rpc().rpc_async(
-                                 self.servers[si], _srv_pull_sparse,
-                                 args=(name, keys[mask])))
         rows = [None] * len(keys)
-        for si, (idx, fut) in parts.items():
-            vals = fut.wait()
+        for idx, vals in self._fanout(_srv_pull_sparse, name, keys, None):
             for j, i in enumerate(idx.tolist()):
                 rows[i] = vals[j]
         return np.stack(rows).astype(np.float32)
@@ -444,16 +497,18 @@ class PsClient:
     def push_sparse(self, name: str, keys: np.ndarray, grads: np.ndarray):
         keys = np.asarray(keys, np.int64).ravel()
         grads = np.asarray(grads, np.float32).reshape(len(keys), -1)
-        n = len(self.servers)
-        futs = []
-        for si in range(n):
-            mask = (keys % n) == si
-            if mask.any():
-                futs.append(self._rpc().rpc_async(
-                    self.servers[si], _srv_push_sparse,
-                    args=(name, keys[mask], grads[mask])))
-        for f in futs:
-            f.wait()
+        self._fanout(_srv_push_sparse, name, keys, grads)
+
+    def push_sparse_delta(self, name: str, keys: np.ndarray,
+                          deltas: np.ndarray):
+        """Geo-mode raw delta merge (no server-side optimizer)."""
+        keys = np.asarray(keys, np.int64).ravel()
+        deltas = np.asarray(deltas, np.float32).reshape(len(keys), -1)
+        self._fanout(_srv_apply_delta, name, keys, deltas)
+
+    def push_dense_delta(self, name: str, deltas: np.ndarray):
+        self._rpc().rpc_sync(self.servers[0], _srv_apply_dense_delta,
+                             args=(name, np.asarray(deltas)))
 
     def pull_dense(self, name: str) -> np.ndarray:
         return self._rpc().rpc_sync(self.servers[0], _srv_pull_dense,

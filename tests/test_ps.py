@@ -158,6 +158,116 @@ class TestSSDTable:
             np.testing.assert_allclose(g2, 0.0)
 
 
+class TestCommunicators:
+    """Async / geo trainer-side communicators (reference:
+    paddle/fluid/distributed/ps/service/communicator/communicator.h,
+    strategy a_sync + a_sync_configs['k_steps'])."""
+
+    def test_async_merges_and_matches_sync(self, ps_env):
+        from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                               PsClient, TableConfig)
+        from paddle_tpu.distributed.ps.the_one_ps import Table
+        client = PsClient(["server0"])
+        client.create_table(TableConfig(name="as1", dim=4,
+                                        optimizer="sgd", lr=0.1))
+        oracle = Table(TableConfig(name="as1", dim=4, optimizer="sgd",
+                                   lr=0.1))
+        comm = AsyncCommunicator(client)
+        rs = np.random.RandomState(3)
+        for _ in range(15):
+            keys = rs.randint(0, 6, 4).astype(np.int64)
+            g = rs.randn(4, 4).astype(np.float32)
+            comm.push_sparse("as1", keys, g)
+            comm.flush()    # step-barriered: order == the sync schedule
+            oracle.push_sparse(keys, g)
+        allk = np.arange(6, dtype=np.int64)
+        np.testing.assert_allclose(comm.pull_sparse("as1", allk),
+                                   oracle.pull_sparse(allk), rtol=1e-5,
+                                   atol=1e-6)
+        comm.stop()
+
+    def test_async_merge_sums_duplicate_keys(self, ps_env):
+        from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                               PsClient, TableConfig)
+        client = PsClient(["server0"])
+        client.create_table(TableConfig(name="as2", dim=2,
+                                        optimizer="sgd", lr=1.0))
+        comm = AsyncCommunicator(client)
+        k = np.array([9], np.int64)
+        before = client.pull_sparse("as2", k).copy()
+        # many queued pushes of the same key merge to one summed update
+        for _ in range(8):
+            comm.push_sparse("as2", k, np.ones((1, 2), np.float32))
+        comm.flush()
+        np.testing.assert_allclose(client.pull_sparse("as2", k),
+                                   before - 8.0, rtol=1e-6)
+        comm.stop()
+
+    def test_geo_two_trainers_converge_to_mean_delta(self, ps_env):
+        from paddle_tpu.distributed.ps import (GeoCommunicator, PsClient,
+                                               TableConfig)
+        client = PsClient(["server0"])
+        client.create_table(TableConfig(name="geo1", dim=4,
+                                        optimizer="sgd", lr=1.0))
+        k = np.array([2], np.int64)
+        base = client.pull_sparse("geo1", k).copy()
+        t0 = GeoCommunicator(client, k_steps=2, trainer_num=2, lr=1.0)
+        t1 = GeoCommunicator(client, k_steps=2, trainer_num=2, lr=1.0)
+        g0 = np.full((1, 4), 1.0, np.float32)
+        g1 = np.full((1, 4), 3.0, np.float32)
+        # no wire traffic before the k-step boundary
+        t0.push_sparse("geo1", k, g0)
+        t0.step()
+        np.testing.assert_allclose(client.pull_sparse("geo1", k), base)
+        t1.push_sparse("geo1", k, g1)
+        t1.step()
+        # k-th step on both: each merges -lr*g/trainer_num
+        t0.push_sparse("geo1", k, g0)
+        t0.step()
+        t1.push_sparse("geo1", k, g1)
+        t1.step()
+        expect = base - (2 * 1.0 + 2 * 3.0) / 2.0
+        np.testing.assert_allclose(client.pull_sparse("geo1", k), expect,
+                                   rtol=1e-5)
+        # after its sync, each trainer's local row folds in the OTHER
+        # trainer's movement (t1 synced last and pulled the final row)
+        np.testing.assert_allclose(t1.pull_sparse("geo1", k), expect,
+                                   rtol=1e-5)
+
+    def test_geo_delta_on_ssd_table_native_or_python(self, ps_env,
+                                                     tmp_path):
+        from paddle_tpu.distributed.ps import (GeoCommunicator, PsClient,
+                                               TableConfig)
+        client = PsClient(["server0"])
+        client.create_table(TableConfig(
+            name="geossd", dim=4, kind="ssd", optimizer="adagrad", lr=0.1,
+            cache_rows=4, path=str(tmp_path)))
+        geo = GeoCommunicator(client, k_steps=1, trainer_num=1, lr=0.5)
+        keys = np.arange(20, dtype=np.int64)   # spill past the cache
+        base = client.pull_sparse("geossd", keys).copy()
+        geo.push_sparse("geossd", keys, np.ones((20, 4), np.float32))
+        geo.step()
+        np.testing.assert_allclose(client.pull_sparse("geossd", keys),
+                                   base - 0.5, rtol=1e-5)
+
+    def test_strategy_mode_selection(self, ps_env):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                               GeoCommunicator, PsClient,
+                                               create_communicator)
+        client = PsClient(["server0"])
+        s = DistributedStrategy()
+        assert create_communicator(client, s) is client
+        s.a_sync = True
+        comm = create_communicator(client, s)
+        assert isinstance(comm, AsyncCommunicator)
+        comm.stop()
+        s.a_sync_configs = {"k_steps": 4}
+        geo = create_communicator(client, s, trainer_num=3)
+        assert isinstance(geo, GeoCommunicator)
+        assert geo._k == 4 and geo._n == 3
+
+
 def test_native_ssd_table_parity_with_python():
     """The C++ SSD table (_native/ssdtable.cpp) matches the python
     SSDTable bit-for-bit across pulls/pushes with evictions (reference
