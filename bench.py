@@ -137,6 +137,8 @@ def measure(batch_override: Optional[int] = None):
     budget = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "600"))
     elapsed = time.perf_counter() - t_measure_start
     if elapsed > 0.35 * budget:
+        print(f"decode bench skipped: {elapsed:.0f}s elapsed > "
+              f"0.35*{budget}s budget", file=sys.stderr)
         return _result(tps, mfu, seq, batch, cfg, lossv, None)
     try:
         from paddle_tpu.models import generate as gen
@@ -165,8 +167,11 @@ def measure(batch_override: Optional[int] = None):
             return round(db * (dnew - 1) / ddt, 2)
 
         decode_tps = decode_rate(state.params)
-    except Exception:
-        pass  # decode bench is auxiliary; never kill the headline number
+    except Exception as e:  # decode bench is auxiliary; never kill the
+        # headline number — but say why it's missing (it has come back
+        # null on every live run so far)
+        print(f"decode bench failed: {type(e).__name__}: {e}"[:500],
+              file=sys.stderr)
 
     # int8 weight-only serving variant (decode is HBM-bound; int8 halves
     # the weight bytes) — only with budget left after the fp decode
@@ -176,8 +181,9 @@ def measure(batch_override: Optional[int] = None):
         try:
             decode_int8_tps = decode_rate(
                 gen.quantize_weights(state.params, cfg))
-        except Exception:
-            pass
+        except Exception as e:
+            print(f"int8 decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
 
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                    decode_int8_tps)
@@ -351,6 +357,8 @@ def parent_main():
                 continue
             if isinstance(parsed, dict) and "metric" in parsed:
                 _record_last_good(parsed)
+                for dl in (proc.stderr or "").strip().splitlines()[-5:]:
+                    print(f"[child] {dl}", file=sys.stderr)
                 print(line)
                 sys.stdout.flush()
                 os._exit(0)
