@@ -308,6 +308,27 @@ def _record_last_good(parsed: dict) -> None:
         pass
 
 
+def _emit_headline_from(stdout_text: str, stderr_text: str = "",
+                        note: str = "") -> None:
+    """If the child's stdout carries a metric line, echo diagnostics +
+    the LAST parseable line and exit 0. Shared by the normal-exit and
+    watchdog-salvage paths."""
+    for line in reversed((stdout_text or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            _record_last_good(parsed)
+            if note:
+                print(note, file=sys.stderr)
+            for dl in (stderr_text or "").strip().splitlines()[-5:]:
+                print(f"[child] {dl}", file=sys.stderr)
+            print(line)
+            sys.stdout.flush()
+            os._exit(0)
+
+
 def parent_main():
     """Run the measurement in a watchdog-guarded child; ALWAYS print exactly
     one JSON line.
@@ -366,10 +387,8 @@ def parent_main():
                 salvaged = (out.decode(errors="replace")
                             if isinstance(out, bytes) else out)
                 err = te.stderr or b""
-                err = (err.decode(errors="replace")
-                       if isinstance(err, bytes) else err)
-                for dl in err.strip().splitlines()[-5:]:
-                    print(f"[child] {dl}", file=sys.stderr)
+                salvaged_err = (err.decode(errors="replace")
+                                if isinstance(err, bytes) else err)
             if (proc is not None and proc.returncode == RC_OOM_RETRY
                     and spawns < 6):
                 diag[-1]["oom_respawns"] = spawns
@@ -377,36 +396,16 @@ def parent_main():
             break
         if timed_out:
             # watchdog fired: the headline may still be on the pipe
-            for line in reversed(salvaged.strip().splitlines()):
-                try:
-                    parsed = json.loads(line)
-                except (json.JSONDecodeError, ValueError):
-                    continue
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    _record_last_good(parsed)
-                    print(f"watchdog killed decode extras; headline "
-                          f"salvaged", file=sys.stderr)
-                    print(line)
-                    sys.stdout.flush()
-                    os._exit(0)
+            _emit_headline_from(
+                salvaged, salvaged_err,
+                note="watchdog killed decode extras; headline salvaged")
             last_err = f"attempt {i + 1}: watchdog timeout after {timeout_s}s"
             diag[-1]["measure"] = last_err
             if measured >= 2:
                 break
             continue
         diag[-1]["measure_elapsed_s"] = round(time.perf_counter() - t0, 1)
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                parsed = json.loads(line)
-            except (json.JSONDecodeError, ValueError):
-                continue
-            if isinstance(parsed, dict) and "metric" in parsed:
-                _record_last_good(parsed)
-                for dl in (proc.stderr or "").strip().splitlines()[-5:]:
-                    print(f"[child] {dl}", file=sys.stderr)
-                print(line)
-                sys.stdout.flush()
-                os._exit(0)
+        _emit_headline_from(proc.stdout, proc.stderr)
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-15:]
         last_err = (f"attempt {i + 1}: rc={proc.returncode}; "
                     + " | ".join(tail)[-1500:])

@@ -13,6 +13,7 @@ unnecessary (single controller = single source of truth).
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -28,6 +29,7 @@ _fleet_state = {
     "initialized": False,
     "strategy": None,
     "hcg": None,
+    "role_maker": None,
 }
 
 
@@ -41,6 +43,13 @@ def init(role_maker=None, is_collective: bool = True,
     """reference: fleet.py:218."""
     if strategy is None:
         strategy = DistributedStrategy()
+    _fleet_state["role_maker"] = role_maker
+    if role_maker is not None and not is_collective:
+        # parameter-server mode (reference: fleet.init(role) + the_one_ps
+        # runtime): no device mesh — roles split into servers hosting
+        # tables and workers training against them over RPC
+        _fleet_state.update(initialized=True, strategy=strategy, hcg=None)
+        return fleet
     hc = strategy.hybrid_configs
     order = list(hc.get("order") or strategy.hybrid_parallel_order or
                  ["dp", "pp", "sharding", "sep", "mp"])
@@ -154,12 +163,118 @@ def get_strategy():
     return _fleet_state["strategy"]
 
 
+# ---- parameter-server mode lifecycle (reference: fleet.py init_server
+# :1013, run_server:1049, init_worker:944, stop_worker:1084 — the_one_ps
+# runtime over brpc; here PsServer/PsClient over the framework RPC) ----
+
+_ps_stop = threading.Event()
+
+
+def _role_maker():
+    rm = _fleet_state.get("role_maker")
+    if rm is None:
+        raise RuntimeError(
+            "PS mode needs fleet.init(role_maker, is_collective=False)")
+    return rm
+
+
+def is_server() -> bool:
+    return _role_maker().is_server()
+
+
+def is_worker() -> bool:
+    return _role_maker().is_worker()
+
+
+def server_num() -> int:
+    return max(1, _role_maker()._server_num())
+
+
+def _srv_shutdown() -> bool:
+    """RPC-served: a worker asks this server process to leave run_server."""
+    _ps_stop.set()
+    return True
+
+
+def init_server(*table_configs):
+    """Start this server's RPC endpoint and host its tables. Extra tables
+    arrive later via client ``create_table`` calls (the reference derives
+    them from the program; here they are explicit configs)."""
+    from .. import rpc
+    from ..ps import PsServer
+    rm = _role_maker()
+    idx = rm.worker_index()
+    # rendezvous on the servers only: workers register later (the
+    # lifecycle guarantees it) and servers never call workers, so waiting
+    # for worker .addr files would just eat the full rendezvous deadline
+    rpc.init_rpc(f"server{idx}", rank=idx, world_size=server_num())
+    _ps_stop.clear()
+    _fleet_state["ps_server"] = PsServer(list(table_configs))
+
+
+def run_server():
+    """Serve until a worker calls :func:`stop_worker` (which shuts the
+    servers down) — reference ``fleet.run_server`` blocks the same way."""
+    _ps_stop.wait()
+    from .. import rpc
+    rpc.shutdown()
+
+
+def init_worker(*table_configs):
+    """Connect to the servers, create the declared tables, and install
+    the strategy-selected communicator (sync / async / geo —
+    ``strategy.a_sync`` + ``a_sync_configs['k_steps']``)."""
+    from .. import rpc
+    from ..ps import PsClient, create_communicator
+    rm = _role_maker()
+    n_srv = server_num()
+    idx = rm.worker_index()
+    # wait for the servers + this worker; sibling workers are never
+    # called directly, so don't block on their registration
+    rpc.init_rpc(f"worker{idx}", rank=n_srv + idx,
+                 world_size=n_srv + 1)
+    client = PsClient([f"server{i}" for i in range(n_srv)])
+    comm = create_communicator(client, _fleet_state["strategy"],
+                               trainer_num=rm.worker_num())
+    for cfg in table_configs:
+        comm.create_table(cfg)   # geo records the table lr here
+    _fleet_state["ps_comm"] = comm
+    return comm
+
+
+def get_ps_client():
+    """The worker-side communicator installed by :func:`init_worker`."""
+    return _fleet_state.get("ps_comm")
+
+
+def stop_worker():
+    """Flush/stop the communicator, ask the servers to shut down (first
+    worker only, mirroring the reference's single stop), release RPC."""
+    from .. import rpc
+    from ..ps import AsyncCommunicator, GeoCommunicator
+    comm = _fleet_state.pop("ps_comm", None)
+    if isinstance(comm, GeoCommunicator):
+        comm.sync()
+    elif isinstance(comm, AsyncCommunicator):
+        comm.stop()
+    rm = _fleet_state.get("role_maker")
+    if rm is not None and rm.is_first_worker():
+        for i in range(server_num()):
+            try:
+                rpc.rpc_sync(f"server{i}", _srv_shutdown)
+            except Exception:
+                pass  # server already gone
+    rpc.shutdown()
+
+
 def worker_num() -> int:
-    return _mesh.get_world_size()
+    rm = _fleet_state.get("role_maker")
+    return rm.worker_num() if rm is not None else _mesh.get_world_size()
 
 
 def worker_index() -> int:
-    return _mesh.get_rank()
+    rm = _fleet_state.get("role_maker")
+    return rm.worker_index() if rm is not None else _mesh.get_rank()
 
 
 def is_first_worker() -> bool:
@@ -181,6 +296,15 @@ class _FleetModule:
     worker_index = staticmethod(worker_index)
     is_first_worker = staticmethod(is_first_worker)
     barrier_worker = staticmethod(barrier_worker)
+    # PS mode
+    is_server = staticmethod(is_server)
+    is_worker = staticmethod(is_worker)
+    server_num = staticmethod(server_num)
+    init_server = staticmethod(init_server)
+    run_server = staticmethod(run_server)
+    init_worker = staticmethod(init_worker)
+    get_ps_client = staticmethod(get_ps_client)
+    stop_worker = staticmethod(stop_worker)
 
 
 fleet = _FleetModule()

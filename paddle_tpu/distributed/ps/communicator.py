@@ -157,13 +157,21 @@ class GeoCommunicator:
         # per table: key -> local row / key -> snapshot-at-last-sync
         self._local: Dict[str, Dict[int, np.ndarray]] = {}
         self._snap: Dict[str, Dict[int, np.ndarray]] = {}
+        self._table_lr: Dict[str, float] = {}
 
     def __getattr__(self, name):
         # drop-in substitutable with the bare client (dense ops,
-        # create_table, stats pass straight through)
+        # stats pass straight through)
         if name.startswith("_"):
             raise AttributeError(name)
         return getattr(self._client, name)
+
+    def create_table(self, cfg):
+        # local SGD must step at the table's configured rate, not the
+        # communicator default (geo's trainer-side optimizer is plain SGD
+        # at the table lr — the reference's geo sparse rule)
+        self._table_lr[cfg.name] = float(cfg.lr)
+        return self._client.create_table(cfg)
 
     # -- trainer API --
     def pull_sparse(self, name: str, keys: np.ndarray) -> np.ndarray:
@@ -192,8 +200,9 @@ class GeoCommunicator:
         grads = np.asarray(grads, np.float32).reshape(len(keys), -1)
         self.pull_sparse(name, keys)        # materialize missing rows
         local = self._local[name]
+        lr = self._table_lr.get(name, self._lr)
         for i, k in enumerate(keys.tolist()):
-            local[k] -= self._lr * grads[i]
+            local[k] -= lr * grads[i]
 
     def step(self):
         """One trainer step; triggers the geo sync every k steps."""

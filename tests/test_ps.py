@@ -268,6 +268,74 @@ class TestCommunicators:
         assert geo._k == 4 and geo._n == 3
 
 
+class TestFleetPsMode:
+    """fleet PS-mode lifecycle (reference: fleet.init(role) +
+    init_server/run_server on PSERVER ranks, init_worker/stop_worker on
+    trainers — test pattern: test_dist_base.py subprocess ranks)."""
+
+    SERVER = (
+        "import os, sys\n"
+        "from paddle_tpu.distributed.fleet.base.role_maker import (\n"
+        "    UserDefinedRoleMaker, Role)\n"
+        "from paddle_tpu.distributed.fleet.fleet import fleet\n"
+        "rm = UserDefinedRoleMaker(role=Role.SERVER, current_id=0,\n"
+        "                          worker_num=1,\n"
+        "                          server_endpoints=['s0'])\n"
+        "fleet.init(rm, is_collective=False)\n"
+        "assert fleet.is_server() and not fleet.is_worker()\n"
+        "fleet.init_server()\n"
+        "print('SERVER_UP', flush=True)\n"
+        "fleet.run_server()\n"
+        "print('SERVER_DOWN', flush=True)\n"
+    )
+
+    @pytest.mark.slow
+    def test_server_worker_lifecycle_geo(self, tmp_path, monkeypatch):
+        import subprocess
+        import sys
+        import time
+        monkeypatch.setenv("PADDLE_RPC_REGISTRY", str(tmp_path))
+        monkeypatch.setenv("PADDLE_JOB_ID", "fleet_ps")
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        env = dict(__import__("os").environ)
+        env["PYTHONPATH"] = ""
+        srv = subprocess.Popen([sys.executable, "-c", self.SERVER],
+                               stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            assert srv.stdout.readline().strip() == "SERVER_UP"
+            from paddle_tpu.distributed.fleet.base.role_maker import (
+                UserDefinedRoleMaker, Role)
+            from paddle_tpu.distributed.fleet.fleet import fleet
+            from paddle_tpu.distributed.ps import (GeoCommunicator,
+                                                   TableConfig)
+            rm = UserDefinedRoleMaker(role=Role.WORKER, current_id=0,
+                                      worker_num=1,
+                                      server_endpoints=["s0"])
+            s = fleet.DistributedStrategy()
+            s.a_sync = True
+            s.a_sync_configs = {"k_steps": 2}
+            fleet.init(rm, is_collective=False, strategy=s)
+            assert fleet.is_worker() and not fleet.is_server()
+            comm = fleet.init_worker(
+                TableConfig(name="emb", dim=4, optimizer="sgd", lr=1.0))
+            assert isinstance(comm, GeoCommunicator)
+            assert fleet.get_ps_client() is comm
+            k = np.array([3], np.int64)
+            base = comm.pull_sparse("emb", k).copy()
+            for _ in range(4):   # 2 geo syncs at k_steps=2
+                comm.push_sparse("emb", k, np.ones((1, 4), np.float32))
+                comm.step()
+            # stop_worker: final sync + remote server shutdown
+            fleet.stop_worker()
+            out, _ = srv.communicate(timeout=20)
+            assert "SERVER_DOWN" in out
+            np.testing.assert_allclose(comm._local["emb"][3],
+                                       base[0] - 4.0, rtol=1e-5)
+        finally:
+            if srv.poll() is None:
+                srv.kill()
+
+
 def test_native_ssd_table_parity_with_python():
     """The C++ SSD table (_native/ssdtable.cpp) matches the python
     SSDTable bit-for-bit across pulls/pushes with evictions (reference
