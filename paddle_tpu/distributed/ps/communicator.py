@@ -157,6 +157,9 @@ class GeoCommunicator:
         # per table: key -> local row / key -> snapshot-at-last-sync
         self._local: Dict[str, Dict[int, np.ndarray]] = {}
         self._snap: Dict[str, Dict[int, np.ndarray]] = {}
+        # dense tables: whole-matrix local copy + snapshot
+        self._dlocal: Dict[str, np.ndarray] = {}
+        self._dsnap: Dict[str, np.ndarray] = {}
         self._table_lr: Dict[str, float] = {}
 
     def __getattr__(self, name):
@@ -204,6 +207,21 @@ class GeoCommunicator:
         for i, k in enumerate(keys.tolist()):
             local[k] -= lr * grads[i]
 
+    def pull_dense(self, name: str) -> np.ndarray:
+        if name not in self._dlocal:
+            w = self._client.pull_dense(name)
+            self._dlocal[name] = np.array(w, np.float32, copy=True)
+            self._dsnap[name] = self._dlocal[name].copy()
+        return self._dlocal[name]
+
+    def push_dense(self, name: str, grads: np.ndarray):
+        """Local SGD on the dense table; merged at the k-step sync like
+        the sparse rows (the reference geo protocol covers dense vars the
+        same way — trainer_nums-averaged deltas)."""
+        w = self.pull_dense(name)
+        w -= (self._table_lr.get(name, self._lr)
+              * np.asarray(grads, np.float32))
+
     def step(self):
         """One trainer step; triggers the geo sync every k steps."""
         self._step += 1
@@ -232,6 +250,14 @@ class GeoCommunicator:
             for i, k in enumerate(allk):
                 local[k] = fresh[i].copy()
                 snap[k] = fresh[i].copy()
+        for name, local in self._dlocal.items():
+            delta = (local - self._dsnap[name]) / self._n
+            if np.any(delta):
+                self._client.push_dense_delta(name, delta)
+            fresh = np.array(self._client.pull_dense(name), np.float32,
+                             copy=True)
+            self._dlocal[name] = fresh
+            self._dsnap[name] = fresh.copy()
 
 
 def create_communicator(client: PsClient, strategy=None,

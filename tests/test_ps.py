@@ -250,6 +250,27 @@ class TestCommunicators:
         np.testing.assert_allclose(client.pull_sparse("geossd", keys),
                                    base - 0.5, rtol=1e-5)
 
+    def test_geo_dense_two_trainers(self, ps_env):
+        from paddle_tpu.distributed.ps import (GeoCommunicator, PsClient,
+                                               TableConfig)
+        client = PsClient(["server0"])
+        cfg = TableConfig(name="gd", dim=3, kind="dense", dense_rows=2,
+                          optimizer="sgd", lr=1.0)
+        t0 = GeoCommunicator(client, k_steps=1, trainer_num=2, lr=1.0)
+        t1 = GeoCommunicator(client, k_steps=1, trainer_num=2, lr=1.0)
+        t0.create_table(cfg)
+        base = client.pull_dense("gd").copy()
+        g = np.ones((2, 3), np.float32)
+        t0.push_dense("gd", g)
+        t0.step()                      # merges -1*g/2
+        t1.push_dense("gd", 2 * g)
+        t1.step()                      # merges -2*g/2; refreshes local
+        np.testing.assert_allclose(client.pull_dense("gd"),
+                                   base - 1.5, rtol=1e-6)
+        # both trainers see the merged server state after their sync
+        np.testing.assert_allclose(t1._dlocal["gd"], base - 1.5,
+                                   rtol=1e-6)
+
     def test_strategy_mode_selection(self, ps_env):
         from paddle_tpu.distributed.fleet import DistributedStrategy
         from paddle_tpu.distributed.ps import (AsyncCommunicator,
