@@ -7,6 +7,10 @@ from .fleet import (  # noqa: F401
     init, distributed_model, distributed_optimizer,
     get_hybrid_communicate_group, get_strategy, worker_num, worker_index,
     is_first_worker, barrier_worker,
+    # PS-mode lifecycle (reference: fleet.init_server/run_server/
+    # init_worker/stop_worker)
+    is_server, is_worker, server_num, init_server, run_server,
+    init_worker, get_ps_client, stop_worker,
 )
 from .base.strategy import DistributedStrategy  # noqa: F401
 from .base.topology import (  # noqa: F401
