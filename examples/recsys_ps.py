@@ -1,33 +1,67 @@
-"""Parameter-server sparse-embedding training (reference workflow:
-fleet PS mode + sparse_embedding + QueueDataset), single-process loopback.
+"""Parameter-server sparse-embedding training — the full fleet PS
+lifecycle (reference workflow: fleet.init(role) on every rank,
+init_server/run_server on PSERVER ranks, init_worker/stop_worker on
+trainers, strategy.a_sync + k_steps selecting geo-SGD).
+
+This script plays both roles: run as a worker, it re-execs itself with
+TRAINING_ROLE=PSERVER as the server process (the reference launcher sets
+the same env), then trains sparse embeddings through the geo communicator
+against an SSD-tier table.
 
 Run: JAX_PLATFORMS=cpu PADDLE_RPC_REGISTRY=/tmp/ps_example \
      PADDLE_JOB_ID=ex python examples/recsys_ps.py
 """
 import os
+import subprocess
+import sys
+
 import numpy as np
 
 os.environ.setdefault("PADDLE_RPC_REGISTRY", "/tmp/ps_example")
 os.environ.setdefault("PADDLE_JOB_ID", "ex")
+os.environ.setdefault("PADDLE_PSERVERS_IP_PORT_LIST", "auto:0")  # 1 server
 
 import paddle_tpu as paddle
-from paddle_tpu.distributed import rpc
-from paddle_tpu.distributed.ps import PsServer, PsClient, TableConfig
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+from paddle_tpu.distributed.ps import TableConfig
 from paddle_tpu.distributed.ps.the_one_ps import sparse_embedding
 
-rpc.init_rpc("server0", rank=0, world_size=1)
-try:
-    # SSD tier: table bounded by disk, not RAM (kind="ssd")
-    PsServer([TableConfig(name="emb", dim=8, kind="ssd", optimizer="sgd",
-                          lr=0.1, cache_rows=256)])
-    client = PsClient(["server0"])
-    rng = np.random.default_rng(0)
-    for step in range(5):
-        ids = paddle.to_tensor(rng.integers(0, 10_000, (16,)))
-        feats = sparse_embedding(client, "emb", ids)     # pull
-        loss = (feats ** 2).mean()
-        loss.backward()                                  # push-on-backward
-        print(f"step {step}: loss={float(loss.numpy()):.5f} "
-              f"rows={client.table_size('emb')}")
-finally:
-    rpc.shutdown()
+if os.environ.get("TRAINING_ROLE") == "PSERVER":
+    fleet.init(PaddleCloudRoleMaker(), is_collective=False)
+    assert fleet.is_server()
+    fleet.init_server()          # tables arrive via worker create_table
+    print("SERVER_UP", flush=True)
+    fleet.run_server()           # blocks until a worker stops us
+    sys.exit(0)
+
+# ---- worker role ----
+env = dict(os.environ)
+env["TRAINING_ROLE"] = "PSERVER"
+srv = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                       env=env, stdout=subprocess.PIPE, text=True)
+assert srv.stdout.readline().strip() == "SERVER_UP"
+
+strategy = fleet.DistributedStrategy()
+strategy.a_sync = True
+strategy.a_sync_configs = {"k_steps": 2}     # k>0 -> geo-SGD
+fleet.init(PaddleCloudRoleMaker(), is_collective=False, strategy=strategy)
+assert fleet.is_worker()
+
+# SSD tier: table bounded by disk, not RAM (kind="ssd")
+comm = fleet.init_worker(TableConfig(name="emb", dim=8, kind="ssd",
+                                     optimizer="sgd", lr=0.1,
+                                     cache_rows=256))
+rng = np.random.default_rng(0)
+for step in range(5):
+    ids = paddle.to_tensor(rng.integers(0, 10_000, (16,)))
+    feats = sparse_embedding(comm, "emb", ids)       # pull (geo-local)
+    loss = (feats ** 2).mean()
+    loss.backward()                                  # push-on-backward
+    comm.step()                                      # geo sync every k
+    print(f"step {step}: loss={float(loss.numpy()):.5f} "
+          f"rows={comm.table_size('emb')}")
+
+fleet.stop_worker()                                  # final sync + stop
+srv.wait(timeout=30)
+print("done: server exited", srv.returncode)
