@@ -42,26 +42,32 @@ srv = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                        env=env, stdout=subprocess.PIPE, text=True)
 assert srv.stdout.readline().strip() == "SERVER_UP"
 
-strategy = fleet.DistributedStrategy()
-strategy.a_sync = True
-strategy.a_sync_configs = {"k_steps": 2}     # k>0 -> geo-SGD
-fleet.init(PaddleCloudRoleMaker(), is_collective=False, strategy=strategy)
-assert fleet.is_worker()
+try:
+    strategy = fleet.DistributedStrategy()
+    strategy.a_sync = True
+    strategy.a_sync_configs = {"k_steps": 2}     # k>0 -> geo-SGD
+    fleet.init(PaddleCloudRoleMaker(), is_collective=False,
+               strategy=strategy)
+    assert fleet.is_worker()
 
-# SSD tier: table bounded by disk, not RAM (kind="ssd")
-comm = fleet.init_worker(TableConfig(name="emb", dim=8, kind="ssd",
-                                     optimizer="sgd", lr=0.1,
-                                     cache_rows=256))
-rng = np.random.default_rng(0)
-for step in range(5):
-    ids = paddle.to_tensor(rng.integers(0, 10_000, (16,)))
-    feats = sparse_embedding(comm, "emb", ids)       # pull (geo-local)
-    loss = (feats ** 2).mean()
-    loss.backward()                                  # push-on-backward
-    comm.step()                                      # geo sync every k
-    print(f"step {step}: loss={float(loss.numpy()):.5f} "
-          f"rows={comm.table_size('emb')}")
+    # SSD tier: table bounded by disk, not RAM (kind="ssd")
+    comm = fleet.init_worker(TableConfig(name="emb", dim=8, kind="ssd",
+                                         optimizer="sgd", lr=0.1,
+                                         cache_rows=256))
+    rng = np.random.default_rng(0)
+    for step in range(5):
+        ids = paddle.to_tensor(rng.integers(0, 10_000, (16,)))
+        feats = sparse_embedding(comm, "emb", ids)   # pull (geo-local)
+        loss = (feats ** 2).mean()
+        loss.backward()                              # push-on-backward
+        comm.step()                                  # geo sync every k
+        print(f"step {step}: loss={float(loss.numpy()):.5f} "
+              f"rows={comm.table_size('emb')}")
 
-fleet.stop_worker()                                  # final sync + stop
-srv.wait(timeout=30)
-print("done: server exited", srv.returncode)
+    fleet.save_persistables("/tmp/ps_example/ckpt")  # shard-per-server
+    fleet.stop_worker()                              # final sync + stop
+    srv.wait(timeout=30)
+    print("done: server exited", srv.returncode)
+finally:
+    if srv.poll() is None:   # a worker failure must not strand the
+        srv.kill()           # server in run_server forever

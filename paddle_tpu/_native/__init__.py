@@ -123,6 +123,15 @@ def load():
         lib.pt_ssd_stats.restype = ctypes.c_int
         lib.pt_ssd_stats.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_ssd_dump.restype = ctypes.c_int64
+        lib.pt_ssd_dump.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        lib.pt_ssd_restore.restype = ctypes.c_int
+        lib.pt_ssd_restore.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
         lib.pt_ssd_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib

@@ -249,6 +249,48 @@ int pt_ssd_stats(void* h, int64_t* out) {
   return 0;
 }
 
+// Bulk export for table checkpointing (reference: ssd_sparse_table.h
+// Save — the PS persists its shards). Writes every (key, row, g2)
+// triple; caller sizes the buffers from stats[0]. Cache is flushed
+// first so slot data is fresh. Returns the key count, -1 on I/O error.
+int64_t pt_ssd_dump(void* h, int64_t* keys, float* rows, float* g2) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (auto& kv : t->cache) {
+    if (!write_slot(t, t->slots[kv.first], kv.second.row.data(),
+                    kv.second.g2.data()))
+      return -1;
+  }
+  int64_t i = 0;
+  for (auto& kv : t->slots) {
+    keys[i] = kv.first;
+    if (!read_slot(t, kv.second, rows + i * t->dim, g2 + i * t->dim))
+      return -1;
+    ++i;
+  }
+  return i;
+}
+
+// Bulk import (checkpoint load): assigns slots in order, writes rows+g2
+// straight to disk, and drops the RAM cache (stale pre-load entries must
+// not shadow restored values). 0 on success, -1 on I/O error.
+int pt_ssd_restore(void* h, const int64_t* keys, int64_t n,
+                   const float* rows, const float* g2) {
+  SsdTable* t = (SsdTable*)h;
+  std::lock_guard<std::mutex> lock(t->mu);
+  // the checkpoint is authoritative: keys trained after the save must
+  // NOT survive the restore (RAM-table load clears; so does this).
+  // Orphaned slot payloads beyond the new index are unreferenced.
+  t->cache.clear();
+  t->order.clear();
+  t->slots.clear();
+  for (int64_t i = 0; i < n; ++i) {
+    t->slots.emplace(keys[i], i);
+    if (!write_slot(t, i, rows + i * t->dim, g2 + i * t->dim)) return -1;
+  }
+  return fsync(t->fd) == 0 ? 0 : -1;
+}
+
 void pt_ssd_close(void* h) {
   SsdTable* t = (SsdTable*)h;
   if (t == nullptr) return;

@@ -10,7 +10,8 @@ from .fleet import (  # noqa: F401
     # PS-mode lifecycle (reference: fleet.init_server/run_server/
     # init_worker/stop_worker)
     is_server, is_worker, server_num, init_server, run_server,
-    init_worker, get_ps_client, stop_worker,
+    init_worker, get_ps_client, stop_worker, save_persistables,
+    load_persistables,
 )
 from .base.strategy import DistributedStrategy  # noqa: F401
 from .base.topology import (  # noqa: F401

@@ -247,6 +247,36 @@ def get_ps_client():
     return _fleet_state.get("ps_comm")
 
 
+def save_persistables(dirname: str, *args, **kwargs):
+    """Persist every table to per-server shard files (reference:
+    fleet.save_persistables). Geo/async state is synced/flushed first so
+    the checkpoint reflects all trainer movement."""
+    from ..ps import AsyncCommunicator, GeoCommunicator
+    comm = _fleet_state.get("ps_comm")
+    if comm is None:
+        raise RuntimeError("fleet.init_worker first")
+    if isinstance(comm, GeoCommunicator):
+        comm.sync()
+    elif isinstance(comm, AsyncCommunicator):
+        comm.flush()
+    comm.save_persistables(dirname)
+
+
+def load_persistables(dirname: str, *args, **kwargs):
+    """Restore tables from shard files (reference: fleet PS load — the
+    shard partition is the mod-hash, so the server count must match)."""
+    from ..ps import AsyncCommunicator, GeoCommunicator
+    comm = _fleet_state.get("ps_comm")
+    if comm is None:
+        raise RuntimeError("fleet.init_worker first")
+    if isinstance(comm, AsyncCommunicator):
+        comm.flush()   # queued pre-load grads must not land on top of
+                       # the restored tables
+    comm.load_persistables(dirname)
+    if isinstance(comm, GeoCommunicator):
+        comm.invalidate()   # local copies predate the load
+
+
 def stop_worker():
     """Flush/stop the communicator, ask the servers to shut down (first
     worker only, mirroring the reference's single stop), release RPC."""
@@ -305,6 +335,8 @@ class _FleetModule:
     init_worker = staticmethod(init_worker)
     get_ps_client = staticmethod(get_ps_client)
     stop_worker = staticmethod(stop_worker)
+    save_persistables = staticmethod(save_persistables)
+    load_persistables = staticmethod(load_persistables)
 
 
 fleet = _FleetModule()

@@ -228,6 +228,15 @@ class GeoCommunicator:
         if self._step % self._k == 0:
             self.sync()
 
+    def invalidate(self):
+        """Drop every local copy/snapshot — next pulls refetch from the
+        servers (needed after an external table mutation, e.g.
+        load_persistables)."""
+        self._local.clear()
+        self._snap.clear()
+        self._dlocal.clear()
+        self._dsnap.clear()
+
     def sync(self):
         """Merge deltas into the PS and refresh EVERY local row — pull-only
         rows too, so reads fold in other trainers' movement instead of

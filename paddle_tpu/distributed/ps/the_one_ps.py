@@ -103,6 +103,40 @@ class Table:
                     row = self.rows[k] = self._init_row(k)
                 row += deltas[i]
 
+    # ---- checkpoint (reference: the PS persists table shards —
+    # save_persistables; ssd_sparse_table.h Save/Load) ----
+    def save(self, path: str):
+        with self._tlock:
+            if self.cfg.kind == "dense":
+                np.savez(path, kind="dense", dense=self.dense,
+                         dense_g2=self.dense_g2)
+                return
+            n, d = len(self.rows), self.cfg.dim
+            keys = np.fromiter(self.rows.keys(), np.int64, n)
+            rows = (np.stack([self.rows[k] for k in keys.tolist()])
+                    if n else np.zeros((0, d), np.float32))
+            zero = np.zeros(d, np.float32)
+            g2 = (np.stack([self.g2.get(k, zero) for k in keys.tolist()])
+                  if n else np.zeros((0, d), np.float32))
+            np.savez(path, kind="sparse", keys=keys, rows=rows, g2=g2)
+
+    def load(self, path: str):
+        with np.load(path) as z:
+            if str(z["kind"]) == "dense":
+                dense = z["dense"]
+                g2 = z["dense_g2"]
+                with self._tlock:
+                    self.dense = np.array(dense, np.float32)
+                    self.dense_g2 = np.array(g2, np.float32)
+                return
+            keys, rows, g2 = z["keys"], z["rows"], z["g2"]
+        with self._tlock:
+            self.rows.clear()
+            self.g2.clear()
+            for i, k in enumerate(keys.tolist()):
+                self.rows[k] = np.array(rows[i], np.float32)
+                self.g2[k] = np.array(g2[i], np.float32)
+
     # ---- dense ----
     def pull_dense(self) -> np.ndarray:
         with self._tlock:
@@ -219,6 +253,31 @@ class SSDTable(Table):
                 row, g2 = self._get(k)
                 row += deltas[i]
                 self._cache[k] = (row, g2)
+
+    def save(self, path: str):
+        with self._tlock:
+            self.flush()
+            n, d = len(self._slots), self._dim
+            keys = np.fromiter(self._slots.keys(), np.int64, n)
+            rows = np.empty((n, d), np.float32)
+            g2 = np.empty((n, d), np.float32)
+            for i, k in enumerate(keys.tolist()):
+                rows[i], g2[i] = self._read_slot(self._slots[k])
+            np.savez(path, kind="sparse", keys=keys, rows=rows, g2=g2)
+
+    def load(self, path: str):
+        with np.load(path) as z:
+            keys, rows, g2 = z["keys"], z["rows"], z["g2"]
+        with self._tlock:
+            # checkpoint is authoritative: post-save keys must not
+            # survive (parity with Table.load's clear)
+            self._cache.clear()
+            self._slots.clear()
+            for i, k in enumerate(keys.tolist()):
+                self._slots[k] = i
+                self._write_slot(i, np.ascontiguousarray(rows[i]),
+                                 np.ascontiguousarray(g2[i]))
+            self._f.flush()
 
     def flush(self):
         """Write every cached row back to its slot (checkpoint barrier)."""
@@ -350,6 +409,36 @@ class NativeSSDTable(SSDTable):
             if self._lib.pt_ssd_flush(self._h) != 0:
                 raise IOError(f"pt_ssd_flush failed for {self._path}")
 
+    def save(self, path: str):
+        import ctypes
+        with self._tlock:
+            n = self.stats()["keys"]
+            keys = np.empty(n, np.int64)
+            rows = np.empty((n, self._dim), np.float32)
+            g2 = np.empty((n, self._dim), np.float32)
+            got = self._lib.pt_ssd_dump(
+                self._h, self._ptr(keys, ctypes.c_int64),
+                self._ptr(rows, ctypes.c_float),
+                self._ptr(g2, ctypes.c_float))
+            if got != n:
+                raise IOError(f"pt_ssd_dump failed for {self._path}")
+        np.savez(path, kind="sparse", keys=keys, rows=rows, g2=g2)
+
+    def load(self, path: str):
+        import ctypes
+        with np.load(path) as z:
+            keys = np.ascontiguousarray(z["keys"], np.int64)
+            rows = np.ascontiguousarray(z["rows"], np.float32)
+            g2 = np.ascontiguousarray(z["g2"], np.float32)
+        with self._tlock:
+            rc = self._lib.pt_ssd_restore(
+                self._h, self._ptr(keys, ctypes.c_int64), len(keys),
+                self._ptr(rows, ctypes.c_float),
+                self._ptr(g2, ctypes.c_float))
+            if rc != 0:
+                raise IOError(f"pt_ssd_restore failed for {self._path}")
+            self._nkeys = self.stats()["keys"]
+
     def stats(self) -> dict:
         import ctypes
         st = np.zeros(4, np.int64)
@@ -423,6 +512,23 @@ def _srv_apply_dense_delta(name: str, deltas) -> bool:
     return True
 
 
+def _srv_table_names() -> List[str]:
+    with _lock:
+        return sorted(_tables.keys())
+
+
+def _srv_save_table(name: str, path: str) -> bool:
+    import os
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    _tables[name].save(path)
+    return True
+
+
+def _srv_load_table(name: str, path: str) -> bool:
+    _tables[name].load(path)
+    return True
+
+
 def _srv_pull_dense(name: str) -> np.ndarray:
     return _tables[name].pull_dense()
 
@@ -459,6 +565,7 @@ class PsClient:
 
     def __init__(self, server_names: List[str]):
         self.servers = list(server_names)
+        self._table_names: List[str] = []
 
     def _rpc(self):
         from .. import rpc
@@ -468,6 +575,8 @@ class PsClient:
         for s in self.servers:
             self._rpc().rpc_sync(s, _srv_create_table,
                                  args=(dataclasses.asdict(cfg),))
+        if cfg.name not in self._table_names:
+            self._table_names.append(cfg.name)
 
     def _fanout(self, handler, name: str, keys: np.ndarray,
                 vals: Optional[np.ndarray]):
@@ -521,6 +630,48 @@ class PsClient:
     def table_size(self, name: str) -> int:
         return sum(self._rpc().rpc_sync(s, _srv_table_size, args=(name,))
                    for s in self.servers)
+
+    # ---- checkpoint (reference: fleet save/load persistables — each
+    # server persists its own shard; the key partition is the mod-hash,
+    # so shards reload onto the SAME server count) ----
+    def _shard_path(self, dirname: str, name: str, si: int) -> str:
+        import os
+        return os.path.join(dirname, f"{name}.shard{si}.npz")
+
+    def save_table(self, name: str, dirname: str):
+        futs = [self._rpc().rpc_async(
+            s, _srv_save_table,
+            args=(name, self._shard_path(dirname, name, si)))
+            for si, s in enumerate(self.servers)]
+        for f in futs:
+            f.wait()
+
+    def load_table(self, name: str, dirname: str):
+        futs = [self._rpc().rpc_async(
+            s, _srv_load_table,
+            args=(name, self._shard_path(dirname, name, si)))
+            for si, s in enumerate(self.servers)]
+        for f in futs:
+            f.wait()
+
+    def _all_table_names(self) -> List[str]:
+        """Server-authoritative name list: tables created by OTHER
+        workers or declared in init_server(*tables) must checkpoint too,
+        not just the ones this client created."""
+        names = list(self._table_names)
+        for s in self.servers:
+            for n in self._rpc().rpc_sync(s, _srv_table_names):
+                if n not in names:
+                    names.append(n)
+        return names
+
+    def save_persistables(self, dirname: str):
+        for name in self._all_table_names():
+            self.save_table(name, dirname)
+
+    def load_persistables(self, dirname: str):
+        for name in self._all_table_names():
+            self.load_table(name, dirname)
 
     def table_stats(self, name: str) -> List[dict]:
         return [self._rpc().rpc_sync(s, _srv_table_stats, args=(name,))

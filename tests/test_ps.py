@@ -289,6 +289,108 @@ class TestCommunicators:
         assert geo._k == 4 and geo._n == 3
 
 
+class TestTableCheckpoint:
+    """PS table persistence (reference: fleet save/load persistables;
+    ssd_sparse_table.h Save/Load). One uniform npz shard format across
+    RAM / python-SSD / native-SSD tables."""
+
+    def test_sparse_and_dense_roundtrip(self, ps_env, tmp_path):
+        from paddle_tpu.distributed.ps import PsClient, TableConfig
+        client = PsClient(["server0"])
+        client.create_table(TableConfig(name="cs", dim=4,
+                                        optimizer="adagrad", lr=0.3))
+        client.create_table(TableConfig(name="cd", dim=3, kind="dense",
+                                        dense_rows=2, optimizer="sgd",
+                                        lr=0.1))
+        keys = np.arange(10, dtype=np.int64)
+        g = np.random.RandomState(0).randn(10, 4).astype(np.float32)
+        client.push_sparse("cs", keys, g)
+        client.push_dense("cd", np.ones((2, 3), np.float32))
+        want_s = client.pull_sparse("cs", keys).copy()
+        want_d = client.pull_dense("cd").copy()
+        client.save_persistables(str(tmp_path))
+        # mutate AFTER the checkpoint, then restore
+        client.push_sparse("cs", keys, g)
+        client.push_dense("cd", np.ones((2, 3), np.float32))
+        client.load_persistables(str(tmp_path))
+        np.testing.assert_allclose(client.pull_sparse("cs", keys),
+                                   want_s, rtol=1e-6)
+        np.testing.assert_allclose(client.pull_dense("cd"), want_d,
+                                   rtol=1e-6)
+        # adagrad accumulator restored too: next push must match a twin
+        # that took the same history
+        from paddle_tpu.distributed.ps.the_one_ps import Table
+        twin = Table(TableConfig(name="cs", dim=4, optimizer="adagrad",
+                                 lr=0.3))
+        twin.push_sparse(keys, g)
+        client.push_sparse("cs", keys, g)
+        twin.push_sparse(keys, g)
+        np.testing.assert_allclose(client.pull_sparse("cs", keys),
+                                   twin.pull_sparse(keys), rtol=1e-5)
+
+    def test_ssd_roundtrip_and_cross_kind_load(self, ps_env, tmp_path):
+        from paddle_tpu.distributed.ps import TableConfig
+        from paddle_tpu.distributed.ps.the_one_ps import (Table,
+                                                          _make_ssd_table)
+        cfg = TableConfig(name="ck", dim=6, kind="ssd",
+                          optimizer="adagrad", lr=0.2, cache_rows=8,
+                          path=str(tmp_path / "tbl"))
+        t = _make_ssd_table(cfg)     # native when toolchain, else python
+        keys = np.arange(50, dtype=np.int64)     # spills past the cache
+        g = np.random.RandomState(1).randn(50, 6).astype(np.float32)
+        t.pull_sparse(keys)
+        t.push_sparse(keys, g)
+        want = t.pull_sparse(keys).copy()
+        shard = str(tmp_path / "ck.npz")
+        t.save(shard)
+        t.push_sparse(keys, g)       # diverge
+        t.load(shard)
+        np.testing.assert_allclose(t.pull_sparse(keys), want, rtol=1e-6)
+        # the npz shard is table-kind portable: a RAM table loads it
+        ram = Table(TableConfig(name="ck", dim=6, optimizer="adagrad",
+                                lr=0.2))
+        ram.load(shard)
+        np.testing.assert_allclose(ram.pull_sparse(keys), want,
+                                   rtol=1e-6)
+        # and g2 came along: identical next-step updates
+        t.push_sparse(keys, g)
+        ram.push_sparse(keys, g)
+        np.testing.assert_allclose(t.pull_sparse(keys),
+                                   ram.pull_sparse(keys), rtol=1e-5)
+
+
+    def test_load_clears_post_save_keys(self, ps_env, tmp_path):
+        """The checkpoint is authoritative: keys trained after the save
+        must not survive a restore — on EVERY table kind (regression:
+        SSD slot indices once outlived the load)."""
+        from paddle_tpu.distributed.ps import TableConfig
+        from paddle_tpu.distributed.ps.the_one_ps import (Table,
+                                                          _make_ssd_table)
+        for kind, mk in (("sparse", lambda c: Table(c)),
+                         ("ssd", _make_ssd_table)):
+            cfg = TableConfig(name=f"st_{kind}", dim=4, kind=kind,
+                              optimizer="sgd", lr=1.0, cache_rows=4,
+                              path=str(tmp_path / kind))
+            t = mk(cfg)
+            keys = np.arange(8, dtype=np.int64)
+            t.pull_sparse(keys)
+            g = np.ones((8, 4), np.float32)
+            t.push_sparse(keys, g)
+            shard = str(tmp_path / f"{kind}.npz")
+            t.save(shard)
+            t.push_sparse(np.array([999], np.int64),
+                          np.ones((1, 4), np.float32))  # post-save key
+            t.load(shard)
+            assert len(t.rows) == 8, kind
+            # 999 re-initializes fresh, exactly like a never-seen key
+            oracle = Table(TableConfig(name=f"st_{kind}", dim=4,
+                                       optimizer="sgd", lr=1.0))
+            np.testing.assert_allclose(
+                t.pull_sparse(np.array([999], np.int64)),
+                oracle.pull_sparse(np.array([999], np.int64)),
+                rtol=1e-6, err_msg=kind)
+
+
 class TestFleetPsMode:
     """fleet PS-mode lifecycle (reference: fleet.init(role) +
     init_server/run_server on PSERVER ranks, init_worker/stop_worker on
