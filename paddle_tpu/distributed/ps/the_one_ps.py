@@ -48,7 +48,8 @@ class Table:
         # a racing _init_row/+= pair silently drops an update
         self._tlock = threading.RLock()
         if cfg.kind == "dense":
-            rng = np.random.default_rng(hash(cfg.name) & 0xffff)
+            import zlib
+            rng = np.random.default_rng(zlib.crc32(cfg.name.encode()))
             self.dense = (rng.standard_normal(
                 (cfg.dense_rows, cfg.dim)) * cfg.init_std).astype(
                 np.float32)
@@ -58,7 +59,10 @@ class Table:
             self.g2: Dict[int, np.ndarray] = {}
 
     def _init_row(self, key: int) -> np.ndarray:
-        seed = (((hash(self.cfg.name) & 0xFFFFFFFF) << 20)
+        # zlib.crc32, NOT hash(): str hashing is salted per process, and
+        # row init must be identical across server processes/restarts
+        import zlib
+        seed = ((zlib.crc32(self.cfg.name.encode()) << 20)
                 ^ (int(key) & 0xFFFFFFFF))
         rng = np.random.default_rng(seed)
         return (rng.standard_normal(self.cfg.dim) *

@@ -401,9 +401,11 @@ class TestFleetPsMode:
         "from paddle_tpu.distributed.fleet.base.role_maker import (\n"
         "    UserDefinedRoleMaker, Role)\n"
         "from paddle_tpu.distributed.fleet.fleet import fleet\n"
-        "rm = UserDefinedRoleMaker(role=Role.SERVER, current_id=0,\n"
+        "idx = int(sys.argv[1]) if len(sys.argv) > 1 else 0\n"
+        "n = int(sys.argv[2]) if len(sys.argv) > 2 else 1\n"
+        "rm = UserDefinedRoleMaker(role=Role.SERVER, current_id=idx,\n"
         "                          worker_num=1,\n"
-        "                          server_endpoints=['s0'])\n"
+        "                          server_endpoints=['s'] * n)\n"
         "fleet.init(rm, is_collective=False)\n"
         "assert fleet.is_server() and not fleet.is_worker()\n"
         "fleet.init_server()\n"
@@ -457,6 +459,71 @@ class TestFleetPsMode:
         finally:
             if srv.poll() is None:
                 srv.kill()
+
+
+    @pytest.mark.slow
+    def test_two_server_shard_and_checkpoint(self, tmp_path, monkeypatch):
+        """Mod-hash key sharding across TWO server shards + per-server
+        shard checkpoint (reference: brpc PS client shards by key; each
+        server saves its own table shard)."""
+        import subprocess
+        import sys
+        monkeypatch.setenv("PADDLE_RPC_REGISTRY", str(tmp_path / "reg"))
+        monkeypatch.setenv("PADDLE_JOB_ID", "fleet_ps2")
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        env = dict(__import__("os").environ)
+        env["PYTHONPATH"] = ""
+        srvs = [subprocess.Popen(
+            [sys.executable, "-c", self.SERVER, str(i), "2"],
+            stdout=subprocess.PIPE, text=True, env=env)
+            for i in range(2)]
+        try:
+            for s in srvs:
+                assert s.stdout.readline().strip() == "SERVER_UP"
+            from paddle_tpu.distributed.fleet.base.role_maker import (
+                UserDefinedRoleMaker, Role)
+            from paddle_tpu.distributed.fleet.fleet import fleet
+            from paddle_tpu.distributed.ps import TableConfig
+            from paddle_tpu.distributed.ps.the_one_ps import Table
+            rm = UserDefinedRoleMaker(role=Role.WORKER, current_id=0,
+                                      worker_num=1,
+                                      server_endpoints=["s", "s"])
+            fleet.init(rm, is_collective=False,
+                       strategy=fleet.DistributedStrategy())  # sync mode
+            client = fleet.init_worker(
+                TableConfig(name="emb", dim=4, optimizer="adagrad",
+                            lr=0.2))
+            oracle = Table(TableConfig(name="emb", dim=4,
+                                       optimizer="adagrad", lr=0.2))
+            rs = np.random.RandomState(7)
+            keys = np.arange(40, dtype=np.int64)   # even/odd split
+            client.pull_sparse("emb", keys)
+            oracle.pull_sparse(keys)
+            g = rs.randn(40, 4).astype(np.float32)
+            client.push_sparse("emb", keys, g)
+            oracle.push_sparse(keys, g)
+            np.testing.assert_allclose(client.pull_sparse("emb", keys),
+                                       oracle.pull_sparse(keys),
+                                       rtol=1e-5)
+            assert client.table_size("emb") == 40   # 20 + 20
+            ck = str(tmp_path / "ck")
+            fleet.save_persistables(ck)
+            import os
+            shards = sorted(os.listdir(ck))
+            assert shards == ["emb.shard0.npz", "emb.shard1.npz"]
+            client.push_sparse("emb", keys, g)      # diverge
+            fleet.load_persistables(ck)
+            np.testing.assert_allclose(client.pull_sparse("emb", keys),
+                                       oracle.pull_sparse(keys),
+                                       rtol=1e-5)
+            fleet.stop_worker()
+            for s in srvs:
+                out, _ = s.communicate(timeout=20)
+                assert "SERVER_DOWN" in out
+        finally:
+            for s in srvs:
+                if s.poll() is None:
+                    s.kill()
 
 
 def test_native_ssd_table_parity_with_python():
