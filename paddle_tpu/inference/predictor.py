@@ -45,6 +45,26 @@ class Config:
         self._memory_pool_mb = 0
         self._enable_profile = False
         self._optim = True
+        self._mesh = None
+        self._input_pspec = None
+        self._param_spec_fn = None
+
+    # --- multi-chip serving (TPU-native analog of the reference's
+    # multi-device inference paths: TRT multi-stream, fleet inference
+    # helper) — the compiled program runs SPMD over a device mesh ---
+    def enable_mesh(self, mesh, input_spec=None, param_spec_fn=None):
+        """Serve over ``mesh``. ``input_spec``: a PartitionSpec (or one
+        per input) for the data inputs — default shards dim 0 over the
+        mesh's first axis (data-parallel serving). ``param_spec_fn(name,
+        array) -> PartitionSpec | None`` places parameters (None =
+        replicate); supply Column/Row splits for tensor-parallel serving.
+        """
+        self._mesh = mesh
+        self._input_pspec = input_spec
+        self._param_spec_fn = param_spec_fn
+
+    def mesh(self):
+        return self._mesh
 
     # --- model location ---
     def set_model(self, model_path, params_path=None):
@@ -170,6 +190,21 @@ class Predictor:
             n: Tensor(n, self) for n in self._input_names}
         self._outputs: Dict[str, Tensor] = {}
         self._jitted = None
+        if config._mesh is not None:
+            self._place_params(config._mesh, config._param_spec_fn)
+
+    def _place_params(self, mesh, spec_fn):
+        """Install mesh placements on the layer's parameters in place
+        (replicated unless spec_fn says otherwise)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        for name, t in self._layer.state_dict().items():
+            # state_dict entries are always framework Tensors (Layer
+            # wraps buffers; TranslatedLayer._state holds Tensors)
+            spec = None
+            if spec_fn is not None:
+                spec = spec_fn(name, t._value)
+            sh = NamedSharding(mesh, spec if spec is not None else P())
+            t._value = jax.device_put(t._value, sh)
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -192,7 +227,20 @@ class Predictor:
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 return tuple(o._value if isinstance(o, FrameworkTensor)
                              else o for o in outs)
-            self._jitted = jax.jit(f)
+
+            mesh = self._config._mesh
+            if mesh is None:
+                self._jitted = jax.jit(f)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                spec = self._config._input_pspec
+                if spec is None:
+                    spec = P(mesh.axis_names[0])   # batch over axis 0
+                specs = (list(spec) if isinstance(spec, (list, tuple))
+                         and not isinstance(spec, P)
+                         else [spec] * len(self._input_names))
+                shards = tuple(NamedSharding(mesh, s) for s in specs)
+                self._jitted = jax.jit(f, in_shardings=shards)
         return self._jitted
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
@@ -208,6 +256,11 @@ class Predictor:
             try:
                 out = self._compiled()(*raw)
             except Exception:
+                if self._config._mesh is not None:
+                    # the user asked for SPMD serving: a sharding
+                    # misconfiguration (uneven batch, wrong spec count)
+                    # must surface, not silently degrade to one chip
+                    raise
                 jit_failed = True
                 self._jitted = None  # decide after the eager attempt
         if out is None:

@@ -89,6 +89,69 @@ class TestPredictor:
             pred.get_output_names()[0]).copy_to_cpu()
         np.testing.assert_allclose(out, ref, rtol=1e-5)
 
+    def test_sharded_serving_dp_mesh(self, tmp_path):
+        """Multi-chip serving: the predictor compiles one SPMD program
+        over a device mesh, batch sharded over the dp axis (reference
+        analog: multi-device inference)."""
+        import jax
+        from jax.sharding import Mesh
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = np.random.randn(16, 4).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "model")
+        paddle.jit.save(net, path,
+                        input_spec=[paddle.jit.api.InputSpec([16, 4])])
+        cfg = inference.Config(path)
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        cfg.enable_mesh(mesh)
+        pred = inference.create_predictor(cfg)
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # the SPMD path genuinely ran (a silent eager fallback would
+        # latch _jitted=False and still produce the right values)
+        assert pred._jitted not in (None, False)
+        # params actually live on every device of the mesh (replicated)
+        some_param = next(iter(pred._layer.state_dict().values()))
+        val = getattr(some_param, "_value", some_param)
+        assert len(val.sharding.device_set) == 8
+        # a sharding misconfiguration must raise, not degrade silently
+        with pytest.raises(Exception):
+            pred.run([np.random.randn(12, 4).astype(np.float32)])
+        assert pred._jitted not in (None, False)
+
+    def test_sharded_serving_tensor_parallel(self):
+        """Tensor-parallel serving: param_spec_fn column-splits the
+        weight over 'mp'; inputs replicate; output matches the dense
+        layer."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        net = nn.Linear(16, 8)
+        net.eval()
+        x = np.random.randn(4, 16).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("mp",))
+
+        def spec_fn(name, arr):
+            if arr.ndim == 2:
+                return P(None, "mp")      # column-parallel weight
+            return P("mp")                # bias follows the split
+
+        cfg = inference.Config()
+        cfg.enable_mesh(mesh, input_spec=P(), param_spec_fn=spec_fn)
+        pred = inference.create_predictor(cfg, layer=net)
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        w = net.weight._value
+        assert len(w.sharding.device_set) == 8
+        # the weight is genuinely split: each device holds 1/8 columns
+        shard = w.addressable_shards[0]
+        assert shard.data.shape == (16, 1)
+
     def test_run_with_inputs_list(self):
         import paddle_tpu.nn as nn
         from paddle_tpu import inference
