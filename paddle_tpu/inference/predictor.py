@@ -190,8 +190,15 @@ class Predictor:
             n: Tensor(n, self) for n in self._input_names}
         self._outputs: Dict[str, Tensor] = {}
         self._jitted = None
-        if config._mesh is not None:
-            self._place_params(config._mesh, config._param_spec_fn)
+        # snapshot the mesh config: enable_mesh must be called BEFORE
+        # create_predictor (a later call changing the live Config would
+        # otherwise shard inputs but silently skip param placement)
+        self._mesh = config._mesh
+        self._input_pspec = config._input_pspec
+        if self._mesh is not None and hasattr(self._layer, "state_dict"):
+            # plain-function layers have no params to place; the input
+            # sharding below still applies
+            self._place_params(self._mesh, config._param_spec_fn)
 
     def _place_params(self, mesh, spec_fn):
         """Install mesh placements on the layer's parameters in place
@@ -228,12 +235,12 @@ class Predictor:
                 return tuple(o._value if isinstance(o, FrameworkTensor)
                              else o for o in outs)
 
-            mesh = self._config._mesh
+            mesh = self._mesh
             if mesh is None:
                 self._jitted = jax.jit(f)
             else:
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                spec = self._config._input_pspec
+                spec = self._input_pspec
                 if spec is None:
                     spec = P(mesh.axis_names[0])   # batch over axis 0
                 specs = (list(spec) if isinstance(spec, (list, tuple))
@@ -256,7 +263,7 @@ class Predictor:
             try:
                 out = self._compiled()(*raw)
             except Exception:
-                if self._config._mesh is not None:
+                if self._mesh is not None:
                     # the user asked for SPMD serving: a sharding
                     # misconfiguration (uneven batch, wrong spec count)
                     # must surface, not silently degrade to one chip

@@ -83,11 +83,14 @@ def _use_decode_kernel(override=None):
         return False
 
 
-def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None):
+def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
+                     kstart=None):
     """q (B,T,nh,hd) vs cache (B,Smax,nkv,hd); positions >= length masked.
-    length: scalar or (B,) current valid length INCLUDING q's tokens."""
+    length: scalar or (B,) current valid length INCLUDING q's tokens.
+    kstart: optional (B,) first VALID cache position per row (left-padded
+    ragged prompts — positions below it are pad slots and masked out)."""
     B, T, _, hd = q.shape
-    if T == 1 and _use_decode_kernel(use_kernel):
+    if T == 1 and kstart is None and _use_decode_kernel(use_kernel):
         # single-token decode: fused block attention against the padded
         # cache (reference: block_multi_head_attention_kernel.cu)
         from ..ops.pallas.fused import decode_attention
@@ -104,30 +107,49 @@ def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None):
     # query i (global position length-T+i) attends to kpos <= its position
     qpos = (length - T) + lax.broadcasted_iota(jnp.int32, s.shape, 2)
     s = jnp.where(kpos <= qpos, s, -1e30)
+    if kstart is not None:
+        s = jnp.where(kpos >= kstart[:, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
 
 
+def _rope_rows(x, cos, sin, rpos):
+    """Per-row rope: x (B,T,H,hd), rpos (B,T) int32 logical positions
+    (ragged left-padded prompts shift each row's rotation)."""
+    c = cos[rpos][:, :, None, :]
+    s = sin[rpos][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
 def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
-                 use_kernel=None):
-    """One decoder layer over T tokens starting at position ``pos``.
-    cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated."""
+                 use_kernel=None, rpos=None, kstart=None):
+    """One decoder layer over T tokens starting at cache index ``pos``.
+    cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
+    rpos: optional (B,T) per-row rope positions (!= cache index when the
+    batch is left-padded); kstart: optional (B,) first valid cache slot.
+    """
     B, T, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = (h1 @ _w(lp, "wq", x.dtype)).reshape(B, T, nh, hd)
     k = (h1 @ _w(lp, "wk", x.dtype)).reshape(B, T, nkv, hd)
     v = (h1 @ _w(lp, "wv", x.dtype)).reshape(B, T, nkv, hd)
-    q = apply_rope(q, lax.dynamic_slice_in_dim(cos, pos, T),
-                   lax.dynamic_slice_in_dim(sin, pos, T))
-    k = apply_rope(k, lax.dynamic_slice_in_dim(cos, pos, T),
-                   lax.dynamic_slice_in_dim(sin, pos, T))
+    if rpos is None:
+        q = apply_rope(q, lax.dynamic_slice_in_dim(cos, pos, T),
+                       lax.dynamic_slice_in_dim(sin, pos, T))
+        k = apply_rope(k, lax.dynamic_slice_in_dim(cos, pos, T),
+                       lax.dynamic_slice_in_dim(sin, pos, T))
+    else:
+        q = _rope_rows(q, cos, sin, rpos)
+        k = _rope_rows(k, cos, sin, rpos)
     cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(
         cache_k.dtype), pos, axis=1)
     cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(
         cache_v.dtype), pos, axis=1)
     o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh,
-                         use_kernel=use_kernel)
+                         use_kernel=use_kernel, kstart=kstart)
     x = x + o.reshape(B, T, nh * hd) @ _w(lp, "wo", x.dtype)
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     g = jax.nn.silu((h2 @ _w(lp, "wg", x.dtype)).astype(
@@ -137,9 +159,10 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
 
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
-                    max_len: int, use_kernel=None):
-    """tokens (B, T) at positions [pos, pos+T) -> (logits_last (B, V),
-    updated cache)."""
+                    max_len: int, use_kernel=None, rpos=None,
+                    kstart=None):
+    """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
+    (B, V), updated cache)."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
 
@@ -147,7 +170,8 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
         xc = carry
         lp, ck, cv = layer_in
         y, nk, nv = _block_infer(xc, lp, ck, cv, pos, cos, sin, cfg,
-                                 use_kernel=use_kernel)
+                                 use_kernel=use_kernel, rpos=rpos,
+                                 kstart=kstart)
         return y, (nk, nv)
 
     x, (new_k, new_v) = lax.scan(
@@ -166,11 +190,18 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
              temperature: float = 0.0, top_k: int = 0,
              key: Optional[jax.Array] = None,
              eos_token_id: Optional[int] = None,
+             pad_token_id: Optional[int] = None,
              use_kernel: Optional[bool] = None) -> jax.Array:
     """prompt (B, S_prompt) int32 -> (B, S_prompt + max_new_tokens).
 
     greedy when temperature == 0, else temperature (+ optional top-k)
     sampling. Whole decode loop is one jitted scan.
+
+    ``pad_token_id``: ragged batches LEFT-padded with this id — each
+    row's rope positions start at its first real token and pad cache
+    slots are masked out of attention, so every row decodes exactly as
+    it would unpadded (reference: the generation stack's attention_mask
+    handling, python/paddle/generation/utils.py).
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -180,7 +211,18 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
         key = jax.random.key(0)
     cache = init_cache(cfg, B, max_len)
 
-    logits, cache = _forward_cached(params, prompt, cache, 0, cfg, max_len)
+    rpos = kstart = None
+    if pad_token_id is not None:
+        # first real-token index per row (left padding)
+        kstart = jnp.argmax(prompt != pad_token_id, axis=1).astype(
+            jnp.int32)
+        rpos = jnp.clip(jnp.arange(S, dtype=jnp.int32)[None, :]
+                        - kstart[:, None], 0, None)
+        # (_attn_with_cache bypasses the fused decode kernel itself
+        # whenever kstart is set — it has no pad-slot mask)
+
+    logits, cache = _forward_cached(params, prompt, cache, 0, cfg,
+                                    max_len, rpos=rpos, kstart=kstart)
     # prefill uses the jnp path (multi-token); decode steps may use the
     # fused pallas kernel
 
@@ -205,9 +247,11 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     def step(carry, i):
         cache, tok, kk, done = carry
         kk, ks = jax.random.split(kk)
+        drpos = (None if kstart is None
+                 else (S + i - kstart)[:, None].astype(jnp.int32))
         logits, cache = _forward_cached(
             params, tok[:, None], cache, S + i, cfg, max_len,
-            use_kernel=use_kernel)
+            use_kernel=use_kernel, rpos=drpos, kstart=kstart)
         nxt = sample(logits, ks)
         if eos is not None:
             nxt = jnp.where(done, jnp.int32(eos), nxt)

@@ -166,6 +166,34 @@ class TestPredictor:
                                    rtol=1e-6)
 
 
+def test_generate_left_padded_ragged_matches_unpadded():
+    """Ragged batch (left-padded) decodes row-for-row identically to
+    each row generated alone unpadded — per-row rope shift + pad-slot
+    masking (reference: generation attention_mask semantics)."""
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
+    params = llama.init_params(jax.random.key(0), cfg)
+    rs = np.random.RandomState(0)
+    p_short = rs.randint(3, cfg.vocab_size, (1, 3)).astype(np.int32)
+    p_long = rs.randint(3, cfg.vocab_size, (1, 6)).astype(np.int32)
+    PAD = 0
+    batch = np.full((2, 6), PAD, np.int32)
+    batch[0, 3:] = p_short[0]
+    batch[1, :] = p_long[0]
+    out = np.asarray(generate.generate(
+        params, jnp.asarray(batch), cfg, max_new_tokens=5,
+        temperature=0.0, pad_token_id=PAD))
+    ref_short = np.asarray(generate.generate(
+        params, jnp.asarray(p_short), cfg, max_new_tokens=5,
+        temperature=0.0))
+    ref_long = np.asarray(generate.generate(
+        params, jnp.asarray(p_long), cfg, max_new_tokens=5,
+        temperature=0.0))
+    np.testing.assert_array_equal(out[0, 6:], ref_short[0, 3:])
+    np.testing.assert_array_equal(out[1, 6:], ref_long[0, 6:])
+    # prompt region is passed through untouched (pads included)
+    np.testing.assert_array_equal(out[:, :6], batch)
+
+
 def test_generate_eos_masks_tail():
     """Once EOS is sampled, every later token must be pinned to EOS
     (ADVICE r1: eos_token_id was accepted but unused)."""
