@@ -188,9 +188,11 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
 def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
              max_new_tokens: int = 32, max_len: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0,
              key: Optional[jax.Array] = None,
              eos_token_id: Optional[int] = None,
              pad_token_id: Optional[int] = None,
+             prompt_lengths: Optional[jax.Array] = None,
              use_kernel: Optional[bool] = None) -> jax.Array:
     """prompt (B, S_prompt) int32 -> (B, S_prompt + max_new_tokens).
 
@@ -201,7 +203,9 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     row's rope positions start at its first real token and pad cache
     slots are masked out of attention, so every row decodes exactly as
     it would unpadded (reference: the generation stack's attention_mask
-    handling, python/paddle/generation/utils.py).
+    handling, python/paddle/generation/utils.py). Detection takes the
+    leading run of pad ids; pass ``prompt_lengths`` (B,) instead when a
+    row's genuine first token may equal the pad id.
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -212,10 +216,19 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     cache = init_cache(cfg, B, max_len)
 
     rpos = kstart = None
-    if pad_token_id is not None:
-        # first real-token index per row (left padding)
+    if prompt_lengths is not None:
+        # explicit per-row lengths are unambiguous (a genuine first
+        # token equal to pad_token_id cannot be mis-detected)
+        kstart = (S - jnp.asarray(prompt_lengths, jnp.int32))
+        kstart = jnp.clip(kstart, 0, S - 1)
+    elif pad_token_id is not None:
+        # length of the LEADING pad run per row; an all-pad row clamps
+        # to keep one slot real instead of decoding from garbage
         kstart = jnp.argmax(prompt != pad_token_id, axis=1).astype(
             jnp.int32)
+        kstart = jnp.where(jnp.any(prompt != pad_token_id, axis=1),
+                           kstart, S - 1)
+    if kstart is not None:
         rpos = jnp.clip(jnp.arange(S, dtype=jnp.int32)[None, :]
                         - kstart[:, None], 0, None)
         # (_attn_with_cache bypasses the fused decode kernel itself
@@ -230,7 +243,23 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         l = logits / temperature
-        if top_k > 0:
+        if top_p > 0.0:
+            # one descending sort serves BOTH filters: rank < top_k and
+            # the nucleus rule "exclusive prefix sum < top_p" (which
+            # always keeps the argmax; reference: top_p_sampling kernel)
+            order = jnp.argsort(-l, axis=-1)
+            ls = jnp.take_along_axis(l, order, axis=-1)
+            keep_sorted = jnp.ones_like(ls, bool)
+            if top_k > 0:
+                keep_sorted &= (lax.broadcasted_iota(
+                    jnp.int32, ls.shape, 1) < top_k)
+            p = jax.nn.softmax(jnp.where(keep_sorted, ls, -1e30),
+                               axis=-1)
+            keep_sorted &= (jnp.cumsum(p, axis=-1) - p) < top_p
+            keep = jnp.zeros_like(keep_sorted).at[
+                jnp.arange(l.shape[0])[:, None], order].set(keep_sorted)
+            l = jnp.where(keep, l, -1e30)
+        elif top_k > 0:
             kth = jnp.sort(l, axis=-1)[:, -top_k][:, None]
             l = jnp.where(l < kth, -1e30, l)
         return jax.random.categorical(k, l, axis=-1).astype(jnp.int32)
@@ -263,3 +292,84 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     out = jnp.concatenate(
         [prompt, first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
     return out
+
+
+def beam_search(params, prompt: jax.Array, cfg: LlamaConfig, *,
+                num_beams: int = 4, max_new_tokens: int = 32,
+                max_len: Optional[int] = None,
+                eos_token_id: Optional[int] = None,
+                length_penalty: float = 1.0,
+                use_kernel: Optional[bool] = None) -> jax.Array:
+    """Beam-search decoding with a reordered KV cache (reference: the
+    generation stack's beam_search + gather_tree finalize; here beams
+    live as cache rows and every step gathers the winning rows, so no
+    backpointer walk is needed). prompt (B, S) -> (B, S+max_new_tokens),
+    the best beam per batch row; finished beams emit EOS forever.
+
+    Scoring: sum of token log-probs, finalized with GNMT-style
+    ``score / len**length_penalty``.
+    """
+    B, S = prompt.shape
+    K = num_beams
+    total = S + max_new_tokens
+    max_len = max_len or total
+    assert max_len >= total
+    eos = eos_token_id
+    NEG = jnp.float32(-1e30)
+
+    cache = init_cache(cfg, B * K, max_len)
+    ptile = jnp.repeat(prompt, K, axis=0)                    # (B*K, S)
+    logits, cache = _forward_cached(params, ptile, cache, 0, cfg,
+                                    max_len, use_kernel=use_kernel)
+    V = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+    # all K beams are identical after prefill: expand from beam 0 only
+    scores, first = lax.top_k(logp[:, 0], K)                 # (B, K)
+    first = first.astype(jnp.int32)
+    done = (first == eos) if eos is not None else jnp.zeros((B, K), bool)
+    gen = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+    gen = gen.at[:, :, 0].set(first)
+
+    def step(carry, i):
+        cache, gen, scores, done, last = carry
+        # `last` holds the tokens generated at step i-1 — they live at
+        # cache position S+i-1; their successors land at gen index i
+        logits, cache = _forward_cached(
+            params, last.reshape(B * K, 1), cache, S + i - 1, cfg,
+            max_len, use_kernel=use_kernel)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        if eos is not None:
+            # finished beams: only "emit eos at zero cost" survives, so
+            # their cumulative score freezes
+            frozen = jnp.full((V,), NEG).at[eos].set(0.0)
+            logp = jnp.where(done[:, :, None], frozen[None, None, :],
+                             logp)
+        cand = (scores[:, :, None] + logp).reshape(B, K * V)
+        scores2, idx = lax.top_k(cand, K)                    # (B, K)
+        beam = idx // V                                      # (B, K)
+        tok = (idx % V).astype(jnp.int32)
+        gen = jnp.take_along_axis(gen, beam[:, :, None], axis=1)
+        gen = lax.dynamic_update_slice_in_dim(gen, tok[:, :, None], i,
+                                              axis=2)
+        if eos is not None:
+            done = jnp.take_along_axis(done, beam, axis=1) | (tok == eos)
+        # gather the winning beams' cache rows
+        rows = (jnp.arange(B)[:, None] * K + beam).reshape(-1)  # (B*K,)
+        cache = {n: v[:, rows] for n, v in cache.items()}
+        return (cache, gen, scores2, done, tok), None
+
+    (cache, gen, scores, done, _), _ = lax.scan(
+        step, (cache, gen, scores, done, first),
+        jnp.arange(1, max_new_tokens))
+
+    # GNMT length normalization: length = tokens up to and incl. eos
+    if eos is not None:
+        has = jnp.any(gen == eos, axis=-1)
+        first_eos = jnp.argmax(gen == eos, axis=-1)
+        lengths = jnp.where(has, first_eos + 1, max_new_tokens)
+    else:
+        lengths = jnp.full((B, K), max_new_tokens)
+    final = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(final, axis=1)                         # (B,)
+    seq = jnp.take_along_axis(gen, best[:, None, None], axis=1)[:, 0]
+    return jnp.concatenate([prompt, seq], axis=1)
