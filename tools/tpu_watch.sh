@@ -25,6 +25,8 @@ EOF
       echo "$(date -u +%FT%TZ) bench captured; running perf sweep" >>"$LOG"
       timeout 3000 python tools/perf_sweep.py >/tmp/perf_sweep.out 2>&1
       echo "$(date -u +%FT%TZ) perf sweep done (rc=$?)" >>"$LOG"
+      timeout 1500 python tools/step_profile.py >/tmp/step_profile.out 2>&1
+      echo "$(date -u +%FT%TZ) step profile done (rc=$?)" >>"$LOG"
       exit 0
     else
       echo "$(date -u +%FT%TZ) bench failed despite probe ok; retrying later" >>"$LOG"
