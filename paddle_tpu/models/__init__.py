@@ -12,5 +12,7 @@ calls.
 from . import llama  # noqa: F401
 from . import moe  # noqa: F401
 from . import generate  # noqa: F401
+from . import ernie  # noqa: F401
 from .llama import LlamaConfig  # noqa: F401
+from .ernie import ErnieConfig  # noqa: F401
 from .train import TrainState, make_train_step, init_train_state  # noqa: F401

@@ -1,0 +1,141 @@
+"""ERNIE/BERT encoder family: forward semantics, MLM training via the
+shared train step, tp loss parity on the 8-device mesh."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.models import ernie, train
+
+
+@pytest.fixture(scope="module")
+def cfgp():
+    cfg = ernie.ErnieConfig.tiny()
+    return cfg, ernie.init_params(jax.random.key(0), cfg)
+
+
+class TestForward:
+    def test_shapes_and_determinism(self, cfgp):
+        cfg, params = cfgp
+        toks = jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 16)), jnp.int32)
+        h1 = ernie.forward(params, toks, cfg)
+        h2 = ernie.forward(params, toks, cfg)
+        assert h1.shape == (2, 16, cfg.hidden_size)
+        np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+
+    def test_bidirectional_not_causal(self, cfgp):
+        """Changing a LATER token must change EARLIER positions' outputs
+        (encoders attend both ways — unlike the causal decoder)."""
+        cfg, params = cfgp
+        rs = np.random.RandomState(1)
+        toks = rs.randint(0, cfg.vocab_size, (1, 12)).astype(np.int32)
+        h = np.asarray(ernie.forward(params, jnp.asarray(toks), cfg))
+        toks2 = toks.copy()
+        toks2[0, -1] = (toks2[0, -1] + 1) % cfg.vocab_size
+        h2 = np.asarray(ernie.forward(params, jnp.asarray(toks2), cfg))
+        assert np.abs(h[0, 0] - h2[0, 0]).max() > 1e-6
+
+    def test_attention_mask_matches_unpadded(self, cfgp):
+        """Right-padded rows with a mask encode real positions exactly
+        like the unpadded sequence."""
+        cfg, params = cfgp
+        rs = np.random.RandomState(2)
+        real = rs.randint(0, cfg.vocab_size, (1, 10)).astype(np.int32)
+        padded = np.concatenate(
+            [real, rs.randint(0, cfg.vocab_size, (1, 6)).astype(np.int32)],
+            axis=1)
+        mask = np.concatenate([np.ones((1, 10)), np.zeros((1, 6))],
+                              axis=1).astype(np.int32)
+        h_ref = np.asarray(ernie.forward(params, jnp.asarray(real), cfg))
+        h_pad = np.asarray(ernie.forward(
+            params, jnp.asarray(padded), cfg,
+            attention_mask=jnp.asarray(mask)))
+        np.testing.assert_allclose(h_pad[:, :10], h_ref, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_segment_embeddings_matter(self, cfgp):
+        cfg, params = cfgp
+        toks = jnp.asarray(np.random.RandomState(3).randint(
+            0, cfg.vocab_size, (1, 8)), jnp.int32)
+        seg0 = jnp.zeros((1, 8), jnp.int32)
+        seg1 = jnp.ones((1, 8), jnp.int32)
+        h0 = np.asarray(ernie.forward(params, toks, cfg,
+                                      segment_ids=seg0))
+        h1 = np.asarray(ernie.forward(params, toks, cfg,
+                                      segment_ids=seg1))
+        assert np.abs(h0 - h1).max() > 1e-6
+
+    def test_pooler_and_nsp_head(self, cfgp):
+        cfg, params = cfgp
+        toks = jnp.asarray(np.random.RandomState(4).randint(
+            0, cfg.vocab_size, (3, 8)), jnp.int32)
+        h = ernie.forward(params, toks, cfg)
+        pooled = ernie.pooled_output(params, h, cfg)
+        assert pooled.shape == (3, cfg.hidden_size)
+        assert np.abs(np.asarray(pooled)).max() <= 1.0 + 1e-6
+        logits = ernie.nsp_logits(params, pooled)
+        assert logits.shape == (3, 2)
+        # the head is differentiable end-to-end (fine-tuning path)
+        def nsp_loss(p):
+            hh = ernie.forward(p, toks, cfg)
+            lg = ernie.nsp_logits(p, ernie.pooled_output(p, hh, cfg))
+            return -jnp.mean(jax.nn.log_softmax(lg)[:, 0])
+        g = jax.grad(nsp_loss)(params)
+        assert float(jnp.abs(g["nsp_w"]).sum()) > 0
+
+    def test_mlm_mask_varies_with_batch_content(self, cfgp):
+        cfg, _ = cfgp
+        rs = np.random.RandomState(7)
+        a = jnp.asarray(rs.randint(0, cfg.vocab_size, (2, 16)), jnp.int32)
+        b = jnp.asarray(rs.randint(0, cfg.vocab_size, (2, 16)), jnp.int32)
+        ma = np.asarray(ernie._mlm_mask(a, cfg))
+        mb = np.asarray(ernie._mlm_mask(b, cfg))
+        assert (ma != mb).any()      # different batches, different masks
+        np.testing.assert_array_equal(
+            ma, np.asarray(ernie._mlm_mask(a, cfg)))  # but deterministic
+
+
+class TestTraining:
+    def test_mlm_loss_decreases_with_shared_train_step(self):
+        cfg = ernie.ErnieConfig.tiny(num_layers=1)
+        step = train.make_train_step(cfg, lr=5e-3, model=ernie)
+        state = train.init_train_state(jax.random.key(0), cfg,
+                                       model=ernie)
+        toks = jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (4, 16)), jnp.int32)
+        first = None
+        for _ in range(30):
+            state, m = step(state, toks)
+            if first is None:
+                first = float(m["loss"])
+        assert float(m["loss"]) < 0.5 * first, (first, float(m["loss"]))
+
+    def test_chunked_loss_matches_dense(self, cfgp):
+        cfg, params = cfgp
+        toks = jnp.asarray(np.random.RandomState(5).randint(
+            0, cfg.vocab_size, (2, 16)), jnp.int32)
+        dense = float(ernie.loss_fn(params, toks, cfg))
+        chunked = float(ernie.loss_fn(params, toks, cfg, seq_chunk=4))
+        np.testing.assert_allclose(chunked, dense, rtol=1e-5)
+
+    def test_tp_mesh_loss_parity(self):
+        """dp×tp sharded train step produces the single-device loss
+        (the loss-equivalence contract every parallelism must meet)."""
+        cfg = ernie.ErnieConfig.tiny(num_layers=2)
+        toks = jnp.asarray(np.random.RandomState(6).randint(
+            0, cfg.vocab_size, (4, 16)), jnp.int32)
+        single = train.make_train_step(cfg, model=ernie)
+        s0 = train.init_train_state(jax.random.key(0), cfg, model=ernie)
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+        sharded = train.make_train_step(cfg, mesh, model=ernie)
+        s1 = jax.jit(
+            lambda k: train.init_train_state(k, cfg, model=ernie),
+            out_shardings=train.state_shardings(mesh, cfg, ernie))(
+            jax.random.key(0))
+        for _ in range(3):
+            s0, m0 = single(s0, toks)
+            s1, m1 = sharded(s1, toks)
+            np.testing.assert_allclose(float(m0["loss"]),
+                                       float(m1["loss"]), rtol=2e-5)
