@@ -196,12 +196,21 @@ def _srv_shutdown() -> bool:
     return True
 
 
-def init_server(*table_configs):
+def init_server(*table_configs, model_dir: Optional[str] = None):
     """Start this server's RPC endpoint and host its tables. Extra tables
     arrive later via client ``create_table`` calls (the reference derives
-    them from the program; here they are explicit configs)."""
+    them from the program; here they are explicit configs).
+
+    ``model_dir``: restore each declared table's shard saved by a prior
+    ``save_persistables`` (reference: ``fleet.init_server(dirname)``
+    warm-start). Missing shard files are skipped with a warning — a
+    fresh table is not an error on first launch.
+    """
+    import os
+    import warnings
     from .. import rpc
     from ..ps import PsServer
+    from ..ps.the_one_ps import _tables
     rm = _role_maker()
     idx = rm.worker_index()
     # rendezvous on the servers only: workers register later (the
@@ -210,6 +219,15 @@ def init_server(*table_configs):
     rpc.init_rpc(f"server{idx}", rank=idx, world_size=server_num())
     _ps_stop.clear()
     _fleet_state["ps_server"] = PsServer(list(table_configs))
+    if model_dir is not None:
+        for cfg in table_configs:
+            shard = os.path.join(model_dir, f"{cfg.name}.shard{idx}.npz")
+            if os.path.exists(shard):
+                _tables[cfg.name].load(shard)
+            else:
+                warnings.warn(f"init_server: no shard {shard} to "
+                              f"warm-start table {cfg.name!r}; starting "
+                              f"fresh")
 
 
 def run_server():

@@ -401,6 +401,7 @@ class TestFleetPsMode:
         "from paddle_tpu.distributed.fleet.base.role_maker import (\n"
         "    UserDefinedRoleMaker, Role)\n"
         "from paddle_tpu.distributed.fleet.fleet import fleet\n"
+        "from paddle_tpu.distributed.ps import TableConfig\n"
         "idx = int(sys.argv[1]) if len(sys.argv) > 1 else 0\n"
         "n = int(sys.argv[2]) if len(sys.argv) > 2 else 1\n"
         "rm = UserDefinedRoleMaker(role=Role.SERVER, current_id=idx,\n"
@@ -408,7 +409,11 @@ class TestFleetPsMode:
         "                          server_endpoints=['s'] * n)\n"
         "fleet.init(rm, is_collective=False)\n"
         "assert fleet.is_server() and not fleet.is_worker()\n"
-        "fleet.init_server()\n"
+        "decl = os.environ.get('TEST_PS_TABLE')\n"
+        "tables = ([TableConfig(name=decl, dim=4, optimizer='sgd',\n"
+        "                       lr=1.0)] if decl else [])\n"
+        "fleet.init_server(*tables,\n"
+        "                  model_dir=os.environ.get('TEST_PS_WARMDIR'))\n"
         "print('SERVER_UP', flush=True)\n"
         "fleet.run_server()\n"
         "print('SERVER_DOWN', flush=True)\n"
@@ -460,6 +465,60 @@ class TestFleetPsMode:
             if srv.poll() is None:
                 srv.kill()
 
+
+    @pytest.mark.slow
+    def test_init_server_warm_start_after_restart(self, tmp_path,
+                                                  monkeypatch):
+        """Kill the server, restart with init_server(model_dir=...) —
+        the worker sees the pre-crash rows (reference: fleet
+        init_server(dirname) warm start)."""
+        import subprocess
+        import sys
+        monkeypatch.setenv("PADDLE_RPC_REGISTRY", str(tmp_path / "reg"))
+        monkeypatch.setenv("PADDLE_JOB_ID", "fleet_warm")
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        import os
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ""
+        env["TEST_PS_TABLE"] = "emb"
+        from paddle_tpu.distributed.fleet.base.role_maker import (
+            UserDefinedRoleMaker, Role)
+        from paddle_tpu.distributed.fleet.fleet import fleet
+        rm = UserDefinedRoleMaker(role=Role.WORKER, current_id=0,
+                                  worker_num=1, server_endpoints=["s0"])
+
+        def spawn():
+            p = subprocess.Popen([sys.executable, "-c", self.SERVER],
+                                 stdout=subprocess.PIPE, text=True,
+                                 env=env)
+            assert p.stdout.readline().strip() == "SERVER_UP"
+            return p
+
+        srv = spawn()
+        try:
+            fleet.init(rm, is_collective=False,
+                       strategy=fleet.DistributedStrategy())
+            client = fleet.init_worker()   # table declared server-side
+            keys = np.arange(6, dtype=np.int64)
+            client.push_sparse("emb", keys, np.ones((6, 4), np.float32))
+            want = client.pull_sparse("emb", keys).copy()
+            ck = str(tmp_path / "ck")
+            fleet.save_persistables(ck)
+            fleet.stop_worker()
+            srv.communicate(timeout=20)
+            # restart warm
+            env["TEST_PS_WARMDIR"] = ck
+            srv = spawn()
+            fleet.init(rm, is_collective=False,
+                       strategy=fleet.DistributedStrategy())
+            client = fleet.init_worker()
+            np.testing.assert_allclose(
+                client.pull_sparse("emb", keys), want, rtol=1e-6)
+            fleet.stop_worker()
+            srv.communicate(timeout=20)
+        finally:
+            if srv.poll() is None:
+                srv.kill()
 
     @pytest.mark.slow
     def test_two_server_shard_and_checkpoint(self, tmp_path, monkeypatch):
