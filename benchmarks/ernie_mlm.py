@@ -1,0 +1,44 @@
+"""Extra recipe: ERNIE/BERT-style MLM pretraining, dp×tp hybrid.
+
+Beyond the five BASELINE.md rows — covers the encoder model family (the
+reference's flagship NLP lineage). tp shards the attention/ffn matmuls;
+dp×fsdp shards batch + optimizer state.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import (  # noqa: E402
+    parse_args, build_mesh, run_train_bench, dp_sharded_tokens)
+
+
+def main():
+    args = parse_args()
+    from paddle_tpu.models import ernie, train
+
+    if args.preset == "full":
+        cfg = ernie.ErnieConfig(dtype=jnp.bfloat16, remat=True)  # base
+        batch, seq = 16 * max(1, jax.device_count()), 512
+    else:
+        cfg = ernie.ErnieConfig.tiny()
+        batch, seq = 2 * max(1, jax.device_count()), 64
+
+    mesh = build_mesh(("dp", "fsdp", "tp"), (-1, 1, 2))
+    step = train.make_train_step(cfg, mesh, model=ernie)
+    state = jax.jit(
+        lambda k: train.init_train_state(k, cfg, model=ernie),
+        out_shardings=train.state_shardings(mesh, cfg, model=ernie))(
+        jax.random.key(0))
+    tokens = dp_sharded_tokens(mesh, batch, seq, cfg.vocab_size,
+                               axes=("dp",))
+    run_train_bench(step, state, tokens, "ernie_mlm_tokens_per_sec",
+                    iters=args.iters, preset=args.preset,
+                    devices=jax.device_count(), params=cfg.num_params())
+
+
+if __name__ == "__main__":
+    main()

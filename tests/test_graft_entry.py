@@ -75,7 +75,7 @@ def test_benchmark_recipes_smoke():
     env["PYTHONPATH"] = root
     for script in ("gpt2_dp.py", "moe_ep.py",
                    "llama_tp_sharding.py", "llama_3d.py",
-                   "resnet_fit.py"):
+                   "resnet_fit.py", "ernie_mlm.py"):
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "benchmarks", script),
              "--iters", "2"],
