@@ -79,14 +79,81 @@ def _ring_fwd_scan(q, k, v, axis_name, causal, scale):
     return out, lse
 
 
+def _flash_ring_ok(q) -> bool:
+    """Static gate: use the Pallas flash kernel for the per-chunk
+    attention inside the ring (the einsum path materializes a fp32
+    (B,H,S,S) score block per ring step — the flash partials never do)."""
+    from ....ops.pallas import flash_attention as fa
+    B, H, S, D = q.shape
+    return fa.available() and S % 128 == 0 and D >= 64
+
+
+def _ring_fwd_flash(q, k, v, axis_name, causal, scale):
+    """Flash-partial ring: step 0 runs the SELF chunk (statically causal
+    when ``causal``), later steps run full-attention partials whose lse
+    is knocked to -1e30 on ranks where the chunk is future context; the
+    online log-sum-exp merge combines normalized partials exactly.
+    Returns (out fp32, lse) — same contract as :func:`_ring_fwd_scan`,
+    so the einsum backward (which only consumes q,k,v,out,lse) is
+    untouched."""
+    from ....ops.pallas import flash_attention as fa
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+
+    def chunk(kc, vc, is_causal):
+        # fp32 partials: rounding each chunk's output to bf16 before the
+        # cross-chunk merge would compound error ~n times vs the einsum
+        # ring's end-to-end fp32 accumulation
+        o, l = fa._fwd(qf, kc.reshape(B * H, S, D),
+                       vc.reshape(B * H, S, D), scale, is_causal,
+                       512, 1024, out_dtype=jnp.float32)
+        return o.reshape(B, H, S, D), l.reshape(B, H, S)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc, m = chunk(k, v, causal)            # self chunk: never all-masked
+    ssum = jnp.ones_like(m)
+    # prologue rotate; the scan body computes on the CARRIED chunk and
+    # permutes at the tail, so the next chunk's ICI transfer overlaps the
+    # current chunk's kernel (same schedule as the einsum ring)
+    kc = lax.ppermute(k, axis_name, perm)
+    vc = lax.ppermute(v, axis_name, perm)
+
+    def body(carry, step):
+        acc, m, ssum, kc, vc = carry
+        src = (me - step) % n               # ring position of this chunk
+        oj, lj = chunk(kc, vc, False)
+        if causal:
+            lj = jnp.where(src < me, lj, _NEG)   # future chunks: no mass
+        m2 = jnp.maximum(m, lj)
+        a = jnp.exp(m - m2)                 # m is finite from step 0 on
+        bw = jnp.exp(lj - m2)               # exp(-1e30 - m2) == 0
+        acc = acc * a[..., None] + oj * bw[..., None]
+        ssum = ssum * a + bw
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (acc, m2, ssum, kc, vc), None
+
+    (acc, m, ssum, _, _), _ = lax.scan(
+        body, (acc, m, ssum, kc, vc), jnp.arange(1, n))
+    return acc / ssum[..., None], m + jnp.log(ssum)
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    if _flash_ring_ok(q):
+        return _ring_fwd_flash(q, k, v, axis_name, causal, scale)
+    return _ring_fwd_scan(q, k, v, axis_name, causal, scale)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _ring_attn_bhsd(q, k, v, axis_name, causal, scale):
-    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal, scale)
+    out, _ = _ring_fwd(q, k, v, axis_name, causal, scale)
     return out.astype(q.dtype)
 
 
 def _ring_attn_fwd(q, k, v, axis_name, causal, scale):
-    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, scale)
+    out, lse = _ring_fwd(q, k, v, axis_name, causal, scale)
     out = out.astype(q.dtype)
     return out, (q, k, v, out, lse)
 

@@ -140,7 +140,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = lse[:, :1].astype(jnp.float32)
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, scale, causal, block_q, block_k, out_dtype=None):
+    """out_dtype: dtype of the normalized output (default q.dtype). The
+    ring-attention partial merge passes fp32 so per-chunk partials are
+    not rounded to bf16 before the cross-chunk combine."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -162,7 +165,9 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, d),
+                                 out_dtype if out_dtype is not None
+                                 else q.dtype),
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[

@@ -113,3 +113,40 @@ def test_ulysses_grads():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_ring_partials_match_einsum_ring(causal):
+    """The flash-kernel-backed ring fwd (pallas partials + lse merge)
+    equals the einsum ring and dense attention — fwd AND grads (the
+    einsum backward consumes the flash fwd's saved out/lse)."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    if not fa._PALLAS_OK:
+        pytest.skip("no pallas")
+    mesh = make_mesh()
+    # flash gate needs S_local % 128 == 0 and D >= 64
+    q, k, v = rand_qkv(b=1, s=512, h=2, d=64, seed=3)
+    fa.set_interpret(True)
+    try:
+        assert cp._flash_ring_ok(
+            jnp.zeros((1, 2, 128, 64)))      # the gate is actually on
+        got = run_sharded(
+            lambda a, b, c: cp.ring_attention(a, b, c, "cp",
+                                              causal=causal),
+            mesh, q, k, v)
+        g1 = jax.jit(jax.grad(
+            lambda a, b, c: jnp.sum(run_sharded(
+                lambda x, y, z: cp.ring_attention(x, y, z, "cp",
+                                                  causal=causal),
+                mesh, a, b, c) ** 2), argnums=(0, 1, 2)))(q, k, v)
+    finally:
+        fa.set_interpret(False)
+    ref = _attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-3, atol=2e-4)
+    g2 = jax.grad(lambda a, b, c: jnp.sum(
+        _attention(a, b, c, causal=causal) ** 2), argnums=(0, 1, 2))(
+        q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
