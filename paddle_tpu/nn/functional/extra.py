@@ -455,7 +455,13 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         sampled = pos
     else:
         rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos)
-        extra = np.random.default_rng().choice(
+        # negatives drawn from the framework PRNG: reproducible under
+        # paddle.seed (an unseeded default_rng ignores it)
+        from ..._core import random as _random
+        import jax as _jax
+        seed = int(np.asarray(_jax.random.bits(_random.next_rng_key(),
+                                               dtype=np.uint32)))
+        extra = np.random.default_rng(seed).choice(
             rest, size=num_samples - len(pos), replace=False)
         sampled = np.concatenate([pos, np.sort(extra)])
     remap = -np.ones(num_classes, np.int64)
