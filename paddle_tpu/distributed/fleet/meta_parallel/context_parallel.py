@@ -43,17 +43,27 @@ def _chunk_scores(q, k, scale, causal, qi, kj, s_loc):
     return s
 
 
+def _rep_heads(t, rep):
+    """Local GQA head repeat (B,Hk,S,D) -> (B,Hk*rep,S,D). Lives INSIDE
+    the ring body so the traveling kv buffers stay unrepeated — ICI
+    moves h/hk× less data per step."""
+    return t if rep == 1 else jnp.repeat(t, rep, axis=1)
+
+
 def _ring_fwd_scan(q, k, v, axis_name, causal, scale):
-    """Returns (out fp32 (B,H,S,D), lse (B,H,S))."""
+    """Returns (out fp32 (B,H,S,D), lse (B,H,S)). k/v may carry fewer
+    (GQA) heads than q."""
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, H, S, D = q.shape
+    rep = H // k.shape[1]
     perm = [(i, (i + 1) % n) for i in range(n)]  # kv travels to next rank
 
     def body(carry, step):
         acc, m, l, kc, vc = carry
         src = (me - step) % n          # ring position of current kv chunk
-        s = _chunk_scores(q, kc, scale, causal, me, src, S)
+        s = _chunk_scores(q, _rep_heads(kc, rep), scale, causal, me, src,
+                          S)
         mj = jnp.max(s, axis=-1)                     # (B,H,S)
         m_new = jnp.maximum(m, mj)
         # fully-masked rows keep m=_NEG; guard exp of (-inf - -inf)
@@ -63,7 +73,8 @@ def _ring_fwd_scan(q, k, v, axis_name, causal, scale):
         alpha = jnp.where(m <= _NEG, 0.0, jnp.exp(m - safe_m))
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p,
+            _rep_heads(vc, rep).astype(jnp.float32),
             preferred_element_type=jnp.float32)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
@@ -100,15 +111,19 @@ def _ring_fwd_flash(q, k, v, axis_name, causal, scale):
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, H, S, D = q.shape
+    rep = H // k.shape[1]
     qf = q.reshape(B * H, S, D)
 
     def chunk(kc, vc, is_causal):
         # fp32 partials: rounding each chunk's output to bf16 before the
         # cross-chunk merge would compound error ~n times vs the einsum
-        # ring's end-to-end fp32 accumulation
-        o, l = fa._fwd(qf, kc.reshape(B * H, S, D),
-                       vc.reshape(B * H, S, D), scale, is_causal,
-                       512, 1024, out_dtype=jnp.float32)
+        # ring's end-to-end fp32 accumulation. GQA kv stays UNREPEATED —
+        # the kernel's kv index map divides by rep (no HBM duplication)
+        Hk = kc.shape[1]
+        o, l = fa._fwd(qf, kc.reshape(B * Hk, S, D),
+                       vc.reshape(B * Hk, S, D),
+                       scale, is_causal, 512, 1024,
+                       out_dtype=jnp.float32, kv_rep=rep)
         return o.reshape(B, H, S, D), l.reshape(B, H, S)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -163,26 +178,35 @@ def _ring_attn_bwd(axis_name, causal, scale, res, do):
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, H, S, D = q.shape
+    Hk = k.shape[1]
+    rep = H // Hk
     perm = [(i, (i + 1) % n) for i in range(n)]
     do32 = do.astype(jnp.float32)
     delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # (B,H,S)
 
+    def gqa_sum(g):  # (B,H,S,D) grads -> (B,Hk,S,D) traveling layout
+        return g if rep == 1 else g.reshape(B, Hk, rep, S, D).sum(2)
+
     def body(carry, step):
         dq, kc, vc, dkc, dvc = carry
         src = (me - step) % n
-        s = _chunk_scores(q, kc, scale, causal, me, src, S)
+        kr = _rep_heads(kc, rep)
+        s = _chunk_scores(q, kr, scale, causal, me, src, S)
         safe_lse = jnp.where(lse <= _NEG, 0.0, lse)
         p = jnp.exp(s - safe_lse[..., None])
         p = jnp.where(s <= _NEG, 0.0, p)
-        dvc = dvc + jnp.einsum("bhqk,bhqd->bhkd", p, do32,
-                               preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vc.astype(jnp.float32),
+        dvc = dvc + gqa_sum(jnp.einsum(
+            "bhqk,bhqd->bhkd", p, do32,
+            preferred_element_type=jnp.float32))
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32,
+                        _rep_heads(vc, rep).astype(jnp.float32),
                         preferred_element_type=jnp.float32)
         ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kc.astype(jnp.float32),
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kr.astype(jnp.float32),
                              preferred_element_type=jnp.float32)
-        dkc = dkc + jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32),
-                               preferred_element_type=jnp.float32)
+        dkc = dkc + gqa_sum(jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, q.astype(jnp.float32),
+            preferred_element_type=jnp.float32))
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         dkc = lax.ppermute(dkc, axis_name, perm)
@@ -190,8 +214,8 @@ def _ring_attn_bwd(axis_name, causal, scale, res, do):
         return (dq, kc, vc, dkc, dvc), None
 
     init = (jnp.zeros((B, H, S, D), jnp.float32), k, v,
-            jnp.zeros((B, H, S, D), jnp.float32),
-            jnp.zeros((B, H, S, D), jnp.float32))
+            jnp.zeros((B, Hk, S, D), jnp.float32),
+            jnp.zeros((B, Hk, S, D), jnp.float32))
     (dq, _, _, dk, dv), _ = lax.scan(body, init, jnp.arange(n))
     # after n ppermute hops the traveling dk/dv buffers are home again
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
@@ -204,15 +228,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
                    scale: Optional[float] = None):
     """Ring attention over sequence-sharded q/k/v (B, S_local, H, D).
 
-    Call inside ``shard_map`` with seq sharded over ``axis_name``. GQA: kv
-    heads are repeated to match q heads.
+    Call inside ``shard_map`` with seq sharded over ``axis_name``. GQA:
+    the UNREPEATED kv heads travel the ring (h/hk× less ICI traffic);
+    the per-chunk compute repeats them locally.
     """
     b, s, h, d = q.shape
     hk = k.shape[2]
-    if hk != h:
-        assert h % hk == 0
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
+    assert h % hk == 0
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)

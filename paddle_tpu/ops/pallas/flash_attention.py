@@ -140,10 +140,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = lse[:, :1].astype(jnp.float32)
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k, out_dtype=None):
+def _fwd(q, k, v, scale, causal, block_q, block_k, out_dtype=None,
+         kv_rep=1):
     """out_dtype: dtype of the normalized output (default q.dtype). The
     ring-attention partial merge passes fp32 so per-chunk partials are
-    not rounded to bf16 before the cross-chunk combine."""
+    not rounded to bf16 before the cross-chunk combine.
+
+    kv_rep: GQA — q rows are (B*H) while k/v rows are (B*H/kv_rep); the
+    kv BlockSpec index map divides the grid's batch-head index, so the
+    kernel reads each kv head group once with NO repeated HBM copy (same
+    trick as the decode kernel in fused.py)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -157,8 +163,10 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, out_dtype=None):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, r=kv_rep: (b // r, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, r=kv_rep: (b // r, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),

@@ -150,3 +150,66 @@ def test_flash_ring_partials_match_einsum_ring(causal):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-4)
+
+
+def test_ring_gqa_grads_match_dense():
+    """GQA backward through the ring: the traveling dk/dv buffers carry
+    only the UNREPEATED heads; grads must still match dense attention
+    (whose kv-repeat autodiff sums over the query-head groups)."""
+    mesh = make_mesh()
+    q, k, v = rand_qkv(h=8, hk=2, seed=11)
+
+    def loss_ring(q, k, v):
+        spec = P(None, "cp", None, None)
+        f = shard_map(
+            lambda a, b, c: cp.ring_attention(a, b, c, "cp", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        kk = jnp.repeat(k, 4, axis=2)
+        vv = jnp.repeat(v, 4, axis=2)
+        return jnp.sum(_attention(q, kk, vv, causal=True) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_flash_ring_gqa_fwd_and_grads():
+    """The novel composition: flash forward with the kv-index-map GQA
+    feed (unrepeated kv, kernel divides the batch-head index) producing
+    the lse the GQA einsum backward consumes — fwd AND grads vs dense."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    if not fa._PALLAS_OK:
+        pytest.skip("no pallas")
+    mesh = make_mesh()
+    q, k, v = rand_qkv(b=1, s=512, h=4, hk=2, d=64, seed=12)
+
+    def dense(a, b, c):
+        return _attention(a, jnp.repeat(b, 2, axis=2),
+                          jnp.repeat(c, 2, axis=2), causal=True)
+
+    fa.set_interpret(True)
+    try:
+        got = run_sharded(
+            lambda a, b, c: cp.ring_attention(a, b, c, "cp", causal=True),
+            mesh, q, k, v)
+        g1 = jax.jit(jax.grad(
+            lambda a, b, c: jnp.sum(run_sharded(
+                lambda x, y, z: cp.ring_attention(x, y, z, "cp",
+                                                  causal=True),
+                mesh, a, b, c) ** 2), argnums=(0, 1, 2)))(q, k, v)
+    finally:
+        fa.set_interpret(False)
+    ref = dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-3, atol=2e-4)
+    g2 = jax.grad(lambda a, b, c: jnp.sum(dense(a, b, c) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
