@@ -257,8 +257,21 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     hk = k.shape[2]
     if hk != h:
         assert h % hk == 0
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
+        # GQA: repeat kv only enough for the head dim to split over n
+        # ranks — the local attention maps q-head groups to kv heads, so
+        # the all_to_all moves up to h/hk× less kv than a full repeat.
+        # Custom attn_fn gets the full repeat (its GQA support is
+        # unknown; the default _attention and the flash wrapper repeat
+        # residual groups themselves).
+        need = n // math.gcd(hk, n)
+        hk2 = hk * need
+        if attn_fn is None and hk2 <= h and h % hk2 == 0:
+            rep = need
+        else:
+            rep = h // hk
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
     def seq2head(t):  # (B, S/n, H, D) -> (B, S, H/n, D)
         return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
