@@ -299,6 +299,8 @@ def beam_search(params, prompt: jax.Array, cfg: LlamaConfig, *,
                 max_len: Optional[int] = None,
                 eos_token_id: Optional[int] = None,
                 length_penalty: float = 1.0,
+                pad_token_id: Optional[int] = None,
+                prompt_lengths: Optional[jax.Array] = None,
                 use_kernel: Optional[bool] = None) -> jax.Array:
     """Beam-search decoding with a reordered KV cache (reference: the
     generation stack's beam_search + gather_tree finalize; here beams
@@ -307,7 +309,9 @@ def beam_search(params, prompt: jax.Array, cfg: LlamaConfig, *,
     the best beam per batch row; finished beams emit EOS forever.
 
     Scoring: sum of token log-probs, finalized with GNMT-style
-    ``score / len**length_penalty``.
+    ``score / len**length_penalty``. Ragged LEFT-padded batches via
+    ``pad_token_id`` / ``prompt_lengths`` — same semantics as
+    :func:`generate`.
     """
     B, S = prompt.shape
     K = num_beams
@@ -317,10 +321,25 @@ def beam_search(params, prompt: jax.Array, cfg: LlamaConfig, *,
     eos = eos_token_id
     NEG = jnp.float32(-1e30)
 
+    kstart = rpos = ktile = None
+    if prompt_lengths is not None:
+        kstart = jnp.clip(S - jnp.asarray(prompt_lengths, jnp.int32),
+                          0, S - 1)
+    elif pad_token_id is not None:
+        kstart = jnp.argmax(prompt != pad_token_id, axis=1).astype(
+            jnp.int32)
+        kstart = jnp.where(jnp.any(prompt != pad_token_id, axis=1),
+                           kstart, S - 1)
+    if kstart is not None:
+        ktile = jnp.repeat(kstart, K, axis=0)            # (B*K,)
+        rpos = jnp.clip(jnp.arange(S, dtype=jnp.int32)[None, :]
+                        - ktile[:, None], 0, None)
+
     cache = init_cache(cfg, B * K, max_len)
     ptile = jnp.repeat(prompt, K, axis=0)                    # (B*K, S)
     logits, cache = _forward_cached(params, ptile, cache, 0, cfg,
-                                    max_len, use_kernel=use_kernel)
+                                    max_len, use_kernel=use_kernel,
+                                    rpos=rpos, kstart=ktile)
     V = logits.shape[-1]
     logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
     # all K beams are identical after prefill: expand from beam 0 only
@@ -334,9 +353,11 @@ def beam_search(params, prompt: jax.Array, cfg: LlamaConfig, *,
         cache, gen, scores, done, last = carry
         # `last` holds the tokens generated at step i-1 — they live at
         # cache position S+i-1; their successors land at gen index i
+        drpos = (None if ktile is None
+                 else (S + i - 1 - ktile)[:, None].astype(jnp.int32))
         logits, cache = _forward_cached(
             params, last.reshape(B * K, 1), cache, S + i - 1, cfg,
-            max_len, use_kernel=use_kernel)
+            max_len, use_kernel=use_kernel, rpos=drpos, kstart=ktile)
         logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
         if eos is not None:
             # finished beams: only "emit eos at zero cost" survives, so
