@@ -251,7 +251,11 @@ def init_worker(*table_configs):
     # called directly, so don't block on their registration
     rpc.init_rpc(f"worker{idx}", rank=n_srv + idx,
                  world_size=n_srv + 1)
-    client = PsClient([f"server{i}" for i in range(n_srv)])
+    server_names = [f"server{i}" for i in range(n_srv)]
+    # the count wait can be satisfied by sibling workers racing ahead of
+    # a slow server — insist on the actual server names
+    rpc.wait_for_workers(server_names)
+    client = PsClient(server_names)
     comm = create_communicator(client, _fleet_state["strategy"],
                                trainer_num=rm.worker_num())
     for cfg in table_configs:

@@ -1,4 +1,4 @@
 from .rpc import (  # noqa: F401
     init_rpc, rpc_sync, rpc_async, shutdown, get_current_worker_info,
-    get_all_worker_infos, get_worker_info, WorkerInfo,
+    get_all_worker_infos, get_worker_info, WorkerInfo, wait_for_workers,
 )

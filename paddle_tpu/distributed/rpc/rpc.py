@@ -111,6 +111,12 @@ def init_rpc(name: str, rank: Optional[int] = None,
         if len([e for e in entries if e.endswith(".addr")]) >= world_size:
             break
         time.sleep(0.05)
+    _rescan_registry()
+
+
+def _rescan_registry():
+    reg = os.environ.get("PADDLE_RPC_REGISTRY", "/tmp/paddle_tpu_rpc")
+    job = os.environ.get("PADDLE_JOB_ID", "default")
     for fn in os.listdir(os.path.join(reg, job)):
         if fn.endswith(".addr"):
             wname = fn[:-5]
@@ -118,6 +124,20 @@ def init_rpc(name: str, rank: Optional[int] = None,
                 r, host, p = f.read().split("\t")
             _state["workers"][wname] = WorkerInfo(wname, int(r), host,
                                                   int(p))
+
+
+def wait_for_workers(names, timeout: float = 60.0):
+    """Block until every NAMED peer is registered (the generic count
+    wait can be satisfied by the wrong peers — e.g. sibling trainers
+    racing ahead of a slow server)."""
+    deadline = time.time() + timeout
+    missing = [n for n in names if n not in _state["workers"]]
+    while missing and time.time() < deadline:
+        time.sleep(0.05)
+        _rescan_registry()
+        missing = [n for n in names if n not in _state["workers"]]
+    if missing:
+        raise TimeoutError(f"rpc peers never registered: {missing}")
 
 
 def _call(to: str, fn, args, kwargs, timeout):
