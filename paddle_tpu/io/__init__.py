@@ -5,4 +5,6 @@ from .dataset import (  # noqa: F401
     RandomSampler, WeightedRandomSampler, SubsetRandomSampler, BatchSampler,
     DistributedBatchSampler,
 )
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, get_worker_info, prefetch_to_device,
+)

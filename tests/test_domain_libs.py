@@ -284,3 +284,41 @@ def test_examples_smoke(tmp_path):
             env=env, text=True, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, timeout=300)
         assert proc.returncode == 0, (script, proc.stdout[-1200:])
+
+
+def test_prefetch_to_device_order_and_sharding():
+    """prefetch_to_device keeps batch order/values, transfers ahead, and
+    lands batches pre-sharded when given a NamedSharding."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu import io
+    from paddle_tpu._core.tensor import Tensor
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.full((4,), i, np.float32)
+
+    got = list(io.prefetch_to_device(io.DataLoader(DS(), batch_size=2),
+                                     size=3))
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        v = b._value if isinstance(b, Tensor) else b
+        np.testing.assert_allclose(np.asarray(v)[:, 0],
+                                   [2 * i, 2 * i + 1])
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+
+    class DS8(io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32)
+
+    for b in io.prefetch_to_device(io.DataLoader(DS8(), batch_size=8),
+                                   size=2, sharding=sh):
+        v = b._value if isinstance(b, Tensor) else b
+        assert len(v.sharding.device_set) == 8
