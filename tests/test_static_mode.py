@@ -362,3 +362,50 @@ class TestStaticMisc:
             return (i + 1,)
         with pytest.raises(RuntimeError, match="loop var"):
             paddle.static.nn.while_loop(lambda i: i < n, body, [i])
+
+
+class TestStaticReplayFuzz:
+    """Random op-chain programs recorded in static mode and REPLAYED with
+    fresh feeds must match eager recomputation — the record/replay
+    machinery's equivalent of the tape fuzzer."""
+
+    OPS = [
+        lambda t: paddle.exp(t * 0.3),
+        lambda t: paddle.tanh(t),
+        lambda t: paddle.nn.functional.relu(t - 0.2),
+        lambda t: t * t,
+        lambda t: t + 1.5,
+        lambda t: paddle.sum(t, axis=-1, keepdim=True) + t,
+        lambda t: paddle.mean(t, axis=0, keepdim=True) * t,
+        lambda t: paddle.transpose(t, [1, 0]) @ t,
+        lambda t: paddle.nn.functional.sigmoid(t) * 2.0,
+    ]
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_program_replays(self, seed):
+        rs = np.random.RandomState(seed)
+        n = int(rs.randint(3, 7))
+        picks = [int(rs.randint(len(self.OPS))) for _ in range(n)]
+        shape = (4, 4)   # square keeps the transpose@matmul op legal
+
+        def compute(t):
+            for p in picks:
+                t = self.OPS[p](t)
+            return t
+
+        paddle.enable_static()
+        try:
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = compute(x)
+            exe = paddle.static.Executor()
+            exe.run(paddle.static.default_startup_program())
+            for trial in range(3):      # replay with fresh feeds
+                feed = rs.randn(*shape).astype("float32")
+                out, = exe.run(feed={"x": feed}, fetch_list=[y])
+                paddle.disable_static()
+                want = compute(paddle.to_tensor(feed)).numpy()
+                paddle.enable_static()
+                np.testing.assert_allclose(out, want, rtol=1e-4,
+                                           atol=1e-5, err_msg=str(picks))
+        finally:
+            paddle.disable_static()
