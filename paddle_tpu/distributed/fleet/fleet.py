@@ -14,6 +14,7 @@ unnecessary (single controller = single source of truth).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -43,13 +44,16 @@ def init(role_maker=None, is_collective: bool = True,
     """reference: fleet.py:218."""
     if strategy is None:
         strategy = DistributedStrategy()
-    _fleet_state["role_maker"] = role_maker
     if role_maker is not None and not is_collective:
         # parameter-server mode (reference: fleet.init(role) + the_one_ps
         # runtime): no device mesh — roles split into servers hosting
         # tables and workers training against them over RPC
+        _fleet_state["role_maker"] = role_maker
         _fleet_state.update(initialized=True, strategy=strategy, hcg=None)
         return fleet
+    # collective mode: worker_num/worker_index must reflect the mesh, so a
+    # role maker passed here must not shadow mesh world size/rank
+    _fleet_state["role_maker"] = None
     hc = strategy.hybrid_configs
     order = list(hc.get("order") or strategy.hybrid_parallel_order or
                  ["dp", "pp", "sharding", "sep", "mp"])
@@ -196,6 +200,24 @@ def _srv_shutdown() -> bool:
     return True
 
 
+_done_lock = threading.Lock()
+_done_count = 0
+
+
+def _srv_trainer_done() -> int:
+    """RPC-served on server0: a trainer announces it has finished.
+    Returns the running count so the caller can observe progress."""
+    global _done_count
+    with _done_lock:
+        _done_count += 1
+        return _done_count
+
+
+def _srv_done_count() -> int:
+    with _done_lock:
+        return _done_count
+
+
 def init_server(*table_configs, model_dir: Optional[str] = None):
     """Start this server's RPC endpoint and host its tables. Extra tables
     arrive later via client ``create_table`` calls (the reference derives
@@ -218,6 +240,10 @@ def init_server(*table_configs, model_dir: Optional[str] = None):
     # for worker .addr files would just eat the full rendezvous deadline
     rpc.init_rpc(f"server{idx}", rank=idx, world_size=server_num())
     _ps_stop.clear()
+    global _done_count
+    with _done_lock:
+        _done_count = 0   # stale counts from a prior run must not satisfy
+                          # the next run's trainer-done barrier
     _fleet_state["ps_server"] = PsServer(list(table_configs))
     if model_dir is not None:
         for cfg in table_configs:
@@ -299,9 +325,16 @@ def load_persistables(dirname: str, *args, **kwargs):
         comm.invalidate()   # local copies predate the load
 
 
-def stop_worker():
-    """Flush/stop the communicator, ask the servers to shut down (first
-    worker only, mirroring the reference's single stop), release RPC."""
+def stop_worker(barrier_timeout: float = 120.0):
+    """Flush/stop the communicator, rendezvous all trainers, then ask the
+    servers to shut down (first worker only, mirroring the reference's
+    barrier-then-stop in ``fleet.stop_worker``), release RPC.
+
+    The rendezvous rides server0 as a counter host: every trainer posts
+    ``_srv_trainer_done``; the first worker waits until the count reaches
+    ``worker_num()`` so it cannot shut the servers down while a sibling
+    trainer is still pushing/pulling."""
+    import warnings
     from .. import rpc
     from ..ps import AsyncCommunicator, GeoCommunicator
     comm = _fleet_state.pop("ps_comm", None)
@@ -310,12 +343,38 @@ def stop_worker():
     elif isinstance(comm, AsyncCommunicator):
         comm.stop()
     rm = _fleet_state.get("role_maker")
-    if rm is not None and rm.is_first_worker():
-        for i in range(server_num()):
-            try:
-                rpc.rpc_sync(f"server{i}", _srv_shutdown)
-            except Exception:
-                pass  # server already gone
+    if rm is not None:
+        n_trainers = worker_num()
+        try:
+            rpc.rpc_sync("server0", _srv_trainer_done)
+        except Exception:
+            pass  # server already gone — no one left to protect
+        if rm.is_first_worker():
+            if n_trainers > 1:
+                deadline = time.time() + barrier_timeout
+                while time.time() < deadline:
+                    remaining = max(deadline - time.time(), 1.0)
+                    try:
+                        if rpc.rpc_sync("server0", _srv_done_count,
+                                        timeout=remaining) >= n_trainers:
+                            break
+                    except Exception as e:
+                        # transient (connection burst, reset) — keep
+                        # polling until the deadline; a dead server0 just
+                        # rides the deadline out
+                        warnings.warn(
+                            f"stop_worker: barrier poll failed ({e!r}); "
+                            "retrying until deadline")
+                    time.sleep(0.1)
+                else:
+                    warnings.warn(
+                        "stop_worker: trainer barrier timed out after "
+                        f"{barrier_timeout}s; shutting servers down anyway")
+            for i in range(server_num()):
+                try:
+                    rpc.rpc_sync(f"server{i}", _srv_shutdown)
+                except Exception:
+                    pass  # server already gone
     rpc.shutdown()
 
 

@@ -254,6 +254,11 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     """
     n = lax.psum(1, axis_name)
     b, s, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses_attention: num_heads ({h}) must be divisible by the "
+            f"context-parallel degree ({n}) — the all_to_all splits the "
+            f"head dim across cp ranks")
     hk = k.shape[2]
     if hk != h:
         assert h % hk == 0

@@ -43,11 +43,16 @@ class AsyncCommunicator:
             queue.Queue())
         self._max_merge = max_merge
         self._err: Optional[BaseException] = None
+        self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # -- trainer API --
     def push_sparse(self, name: str, keys: np.ndarray, grads: np.ndarray):
+        if self._stopped:
+            raise RuntimeError(
+                "async communicator is stopped; push_sparse after stop() "
+                "would enqueue onto a dead worker thread")
         if self._err is not None:
             raise RuntimeError("async communicator worker died") \
                 from self._err
@@ -63,12 +68,19 @@ class AsyncCommunicator:
 
     def flush(self):
         """Block until every queued push has been applied on the PS."""
+        if self._stopped:
+            raise RuntimeError(
+                "async communicator is stopped; flush() after stop() would "
+                "wait on a dead worker thread")
         self._q.join()
         if self._err is not None:
             raise RuntimeError("async communicator worker died") \
                 from self._err
 
     def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
         self._q.put(None)
         self._thread.join(timeout=10)
         if self._err is not None:

@@ -203,6 +203,27 @@ class TestCommunicators:
                                    before - 8.0, rtol=1e-6)
         comm.stop()
 
+    def test_async_push_and_flush_raise_after_stop(self, ps_env):
+        # ADVICE r3: push_sparse after stop() must raise, not enqueue onto
+        # a dead worker thread; flush() after stop() must raise, not hang
+        # forever on Queue.join()
+        import pytest
+        from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                               PsClient, TableConfig)
+        client = PsClient(["server0"])
+        client.create_table(TableConfig(name="as3", dim=2,
+                                        optimizer="sgd", lr=1.0))
+        comm = AsyncCommunicator(client)
+        comm.push_sparse("as3", np.array([1], np.int64),
+                         np.ones((1, 2), np.float32))
+        comm.stop()
+        comm.stop()   # idempotent
+        with pytest.raises(RuntimeError, match="stopped"):
+            comm.push_sparse("as3", np.array([1], np.int64),
+                             np.ones((1, 2), np.float32))
+        with pytest.raises(RuntimeError, match="stopped"):
+            comm.flush()
+
     def test_geo_two_trainers_converge_to_mean_delta(self, ps_env):
         from paddle_tpu.distributed.ps import (GeoCommunicator, PsClient,
                                                TableConfig)
