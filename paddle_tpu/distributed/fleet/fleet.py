@@ -53,6 +53,12 @@ def init(role_maker=None, is_collective: bool = True,
         return fleet
     # collective mode: worker_num/worker_index must reflect the mesh, so a
     # role maker passed here must not shadow mesh world size/rank
+    if role_maker is not None:
+        import warnings
+        warnings.warn(
+            "fleet.init: role_maker is ignored in collective mode "
+            "(is_collective=True); pass is_collective=False for "
+            "parameter-server mode")
     _fleet_state["role_maker"] = None
     hc = strategy.hybrid_configs
     order = list(hc.get("order") or strategy.hybrid_parallel_order or
@@ -345,12 +351,14 @@ def stop_worker(barrier_timeout: float = 120.0):
     rm = _fleet_state.get("role_maker")
     if rm is not None:
         n_trainers = worker_num()
+        servers_alive = True
         try:
-            rpc.rpc_sync("server0", _srv_trainer_done)
+            rpc.rpc_sync("server0", _srv_trainer_done,
+                         timeout=max(barrier_timeout, 1.0))
         except Exception:
-            pass  # server already gone — no one left to protect
+            servers_alive = False  # server gone — no one left to protect
         if rm.is_first_worker():
-            if n_trainers > 1:
+            if n_trainers > 1 and servers_alive:
                 deadline = time.time() + barrier_timeout
                 while time.time() < deadline:
                     remaining = max(deadline - time.time(), 1.0)

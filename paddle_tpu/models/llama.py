@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.pallas import flash_attention as _fa
 from . import moe as _moe
@@ -255,8 +255,6 @@ def _block(x, lp, cos, sin, cfg: LlamaConfig, mesh_axes):
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
-    from jax.sharding import NamedSharding
-
     cp = mesh_axes.get("cp") if mesh_axes else None
     # seq-dim sharding of the residual stream: the cp axis when context
     # parallel is on, else the tp axis (Megatron-SP)
@@ -326,6 +324,22 @@ def _trunk(params, tokens, cfg: LlamaConfig, mesh_axes=None):
     """-> (final-norm hidden (B,S,H), summed MoE aux loss scalar)."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if mesh_axes is not None:
+        # Pin the gather output to the one layout the partitioner can
+        # produce without moving the table: batch over the data axes (the
+        # tokens' sharding) and hidden over tp (the table's sharding).
+        # Left unconstrained, GSPMD assigns the gather the residual-stream
+        # layout (seq sharded over cp or tp, hidden replicated) and cannot
+        # reach it from the operands — it falls back to "involuntary full
+        # rematerialization", a full-tensor replicate in the hot path.
+        # From here the hop to the residual layout is a cheap explicit
+        # reshard: hidden-dim all-gather (cp) or seq<->hidden all-to-all
+        # (Megatron-SP), both inserted by the next sharding constraint
+        # inside the first block.
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh_axes["mesh"],
+                             P(mesh_axes["data"], mesh_axes.get("cp"),
+                               mesh_axes["tp"])))
     cos, sin = rope_tables(S, cfg.hd, cfg.rope_theta)
 
     def block(carry, lp):
