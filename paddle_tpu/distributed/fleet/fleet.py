@@ -351,28 +351,45 @@ def stop_worker(barrier_timeout: float = 120.0):
     rm = _fleet_state.get("role_maker")
     if rm is not None:
         n_trainers = worker_num()
-        servers_alive = True
-        try:
-            rpc.rpc_sync("server0", _srv_trainer_done,
-                         timeout=max(barrier_timeout, 1.0))
-        except Exception:
-            servers_alive = False  # server gone — no one left to protect
+        # post this trainer's done mark; retry briefly — a connect burst
+        # of N trainers can reset a connection, and a lost post either
+        # defeats the barrier (first worker) or stalls it (sibling)
+        posted = False
+        for _ in range(5):
+            try:
+                rpc.rpc_sync("server0", _srv_trainer_done,
+                             timeout=max(min(barrier_timeout, 10.0), 1.0))
+                posted = True
+                break
+            except Exception:
+                time.sleep(0.2)
+        if not posted:
+            warnings.warn("stop_worker: could not post trainer-done to "
+                          "server0 after retries; barrier may stall or "
+                          "servers are already gone")
         if rm.is_first_worker():
-            if n_trainers > 1 and servers_alive:
+            if n_trainers > 1:
                 deadline = time.time() + barrier_timeout
+                consec_fail = 0
                 while time.time() < deadline:
                     remaining = max(deadline - time.time(), 1.0)
                     try:
                         if rpc.rpc_sync("server0", _srv_done_count,
-                                        timeout=remaining) >= n_trainers:
+                                        timeout=min(remaining, 10.0)) \
+                                >= n_trainers:
                             break
-                    except Exception as e:
-                        # transient (connection burst, reset) — keep
-                        # polling until the deadline; a dead server0 just
-                        # rides the deadline out
-                        warnings.warn(
-                            f"stop_worker: barrier poll failed ({e!r}); "
-                            "retrying until deadline")
+                        consec_fail = 0
+                    except Exception:
+                        # transient resets recover; a dead server0 fails
+                        # every poll — give up after a short streak
+                        # instead of riding out the full deadline
+                        consec_fail += 1
+                        if consec_fail >= 20:
+                            warnings.warn(
+                                "stop_worker: server0 unreachable for 20 "
+                                "consecutive barrier polls; assuming "
+                                "servers are gone")
+                            break
                     time.sleep(0.1)
                 else:
                     warnings.warn(
