@@ -365,10 +365,13 @@ def stop_worker(barrier_timeout: float = 120.0):
                 time.sleep(0.2)
         if not posted:
             warnings.warn("stop_worker: could not post trainer-done to "
-                          "server0 after retries; barrier may stall or "
-                          "servers are already gone")
+                          "server0 after retries; skipping the trainer "
+                          "barrier (it cannot complete without this "
+                          "trainer's count)")
         if rm.is_first_worker():
-            if n_trainers > 1:
+            # without our own post the count can never reach n_trainers —
+            # waiting would just ride out the full timeout
+            if n_trainers > 1 and posted:
                 deadline = time.time() + barrier_timeout
                 consec_fail = 0
                 while time.time() < deadline:

@@ -90,6 +90,10 @@ class StaticFunction:
         # de-optimize signatures that compiled fine (reference SOT breaks
         # per-graph-site)
         self._eager_keys = set()
+        # signature -> graph_break.SplitProgram: compiled prefix/suffix
+        # regions around the eager break statements (SOT-equivalent
+        # recovery); signatures absent here run whole-function eager
+        self._split_programs = {}
         self._warned_break = False
         functools.update_wrapper(self, fn)
 
@@ -142,10 +146,11 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         # fast path: no graph break has ever occurred -> skip the
         # signature computation entirely (it is only needed to route
-        # already-broken input classes to eager)
-        if self._eager_keys and self._signature(args, kwargs) in \
-                self._eager_keys:
-            return self._fn(*args, **kwargs)
+        # already-broken input classes to their recovery path)
+        if self._eager_keys:
+            sig = self._signature(args, kwargs)
+            if sig in self._eager_keys:
+                return self._run_broken(sig, args, kwargs)
         import jax.errors as jerr
         try:
             if self._layer is not None:
@@ -166,10 +171,13 @@ class StaticFunction:
             # JAXTypeError covers every tracer-concretization variant
             # (ConcretizationTypeError, TracerArrayConversionError,
             # TracerBool/IntegerConversionError). If the function is
-            # genuinely broken the eager re-run below raises the real error.
+            # genuinely broken the re-run below raises the real error.
             # data-dependent control flow: break the graph for THIS input
-            # signature, resume eagerly
-            self._eager_keys.add(self._signature(args, kwargs))
+            # signature and recover SOT-style — compiled regions around
+            # the eager break statement (graph_break.SplitProgram), or
+            # whole-function eager where splitting is unsupported
+            sig = self._signature(args, kwargs)
+            self._eager_keys.add(sig)
             if not self._warned_break:
                 import warnings
                 self._warned_break = True
@@ -177,10 +185,39 @@ class StaticFunction:
                     f"to_static({getattr(self._fn, '__name__', '?')}): "
                     f"data-dependent Python control flow cannot be compiled "
                     f"({type(e).__name__}); falling back to eager execution "
-                    f"for this input signature. Use paddle.static.nn.cond/"
-                    f"while_loop to keep this function compiled.",
+                    f"at the break site (surrounding regions stay compiled "
+                    f"where possible). Use paddle.static.nn.cond/while_loop "
+                    f"to keep the whole function compiled.",
                     stacklevel=2)
+            return self._run_broken(sig, args, kwargs)
+
+    def _run_broken(self, sig, args, kwargs):
+        """Recovery path for signatures that graph-broke: split execution
+        (compiled regions + eager break statements) when supported, else
+        whole-function eager."""
+        from . import graph_break as gb
+        # grad-tracked inputs always take whole-function eager (the split
+        # path is no-tape; a partial tape would silently drop gradients) —
+        # checked per call because requires-grad is not part of the
+        # signature
+        if self._layer is not None or gb.inputs_require_grad(args, kwargs):
             return self._fn(*args, **kwargs)
+        sp = self._split_programs.get(sig, _NO_SPLIT)
+        if sp is _NO_SPLIT:   # first broken call for this signature
+            try:
+                sp = gb.SplitProgram(self._fn, amp_key=_current_amp_key())
+            except gb.SplitUnsupported:
+                sp = None
+            self._split_programs[sig] = sp
+        if sp is not None:
+            out = sp(args, kwargs)
+            if sp.poisoned:
+                # the split proved unviable mid-call (value churn,
+                # unstable locals); THIS call completed correctly via
+                # eager completion — future ones go whole-eager
+                self._split_programs[sig] = None
+            return out
+        return self._fn(*args, **kwargs)
 
     def concrete_program_specify_input_spec(self, *a, **k):
         return None
@@ -189,6 +226,8 @@ class StaticFunction:
     def forward(self):
         return self
 
+
+_NO_SPLIT = object()   # sentinel: "no split decision made yet"
 
 _to_static_enabled = [True]
 

@@ -1,0 +1,14 @@
+"""Helper module for test_graph_break_split: a to_static function whose
+eager break statement reads a module global that the test rebinds."""
+import numpy as np
+
+from paddle_tpu import jit
+
+SCALE = 10
+
+
+@jit.to_static
+def f(x):
+    h = x + 0
+    n = int(h.sum()) * 0 + SCALE    # break reads the LIVE global
+    return h * n

@@ -1,0 +1,305 @@
+"""SOT-equivalent graph-break recovery (VERDICT r3 missing #1).
+
+reference: python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py — bytecode-level graph splitting with resume code. The
+TPU-native analog (paddle_tpu/jit/graph_break.py) splits at the AST
+statement level: one untraceable statement runs eagerly while the
+compiled regions around it stay compiled, memoized per input signature.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+
+def _split_of(f):
+    """The (single) SplitProgram a broken StaticFunction built."""
+    sps = [sp for sp in f._split_programs.values() if sp is not None]
+    assert len(sps) == 1, f._split_programs
+    return sps[0]
+
+
+def _kinds(f):
+    return [seg.kind for seg in _split_of(f).segments]
+
+
+class TestSplitRecovery:
+    def test_matmul_regions_stay_compiled_around_break(self):
+        """The VERDICT done-criterion: a function with one untraceable
+        statement still executes its surrounding matmul regions
+        compiled (trace-once proves the jit cache is used)."""
+        prefix_traces, suffix_traces = [], []
+        w1 = paddle.to_tensor(np.eye(4, dtype=np.float32) * 2)
+        w2 = paddle.to_tensor(np.eye(4, dtype=np.float32) * 3)
+
+        @jit.to_static
+        def f(x):
+            h = x.matmul(w1)            # compiled region 1
+            prefix_traces.append(1)
+            n = int(h.sum()) * 0 + 2    # untraceable: int() on a tracer
+            z = h.matmul(w2) * n        # compiled region 2
+            suffix_traces.append(1)
+            return z
+
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out1 = f(x)
+            assert any("falling back to eager" in str(m.message)
+                       for m in w)
+        # the first call includes discovery traces (failed whole-function
+        # attempts also execute the prefix python); once split, further
+        # calls must NOT re-trace — eager would append every call
+        n_pre, n_suf = len(prefix_traces), len(suffix_traces)
+        out2 = f(x)
+        out3 = f(x)
+        expect = np.ones((2, 4)) @ (np.eye(4) * 2) @ (np.eye(4) * 3) * 2
+        np.testing.assert_allclose(out1.numpy(), expect)
+        np.testing.assert_allclose(out2.numpy(), expect)
+        np.testing.assert_allclose(out3.numpy(), expect)
+        assert len(prefix_traces) == n_pre
+        assert len(suffix_traces) == n_suf
+        assert _kinds(f) == ["jit", "eager", "jit"]
+
+    def test_return_inside_eager_break(self):
+        """A break statement containing `return` stops the splice exactly
+        like a real return; the suffix still runs compiled when the
+        break does not return."""
+        suffix_traces = []
+
+        @jit.to_static
+        def f(x):
+            if float(x.sum()) > 0:      # break stmt WITH a return inside
+                return x * 2
+            suffix_traces.append(1)
+            return x - 1                # compiled suffix
+
+        xp = paddle.to_tensor(np.ones(3, np.float32))
+        xn = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(f(xp).numpy(), 2 * np.ones(3))
+        np.testing.assert_allclose(f(xn).numpy(), -2 * np.ones(3))
+        np.testing.assert_allclose(f(xn).numpy(), -2 * np.ones(3))
+        assert len(suffix_traces) == 1      # suffix compiled once
+        assert _kinds(f) == ["eager", "jit"]
+
+    def test_static_int_crosses_boundary_as_guard(self):
+        """Non-tensor values crossing a region boundary are jit-cache
+        guards: a changed value retraces rather than reusing a stale
+        constant."""
+        @jit.to_static
+        def f(x, flag):
+            n = int(x.sum()) * 0 + (3 if flag else 5)   # break
+            return x * n                                 # compiled suffix
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x, True).numpy(), [3.0, 3.0])
+        np.testing.assert_allclose(f(x, False).numpy(), [5.0, 5.0])
+        np.testing.assert_allclose(f(x, True).numpy(), [3.0, 3.0])
+
+    def test_loop_with_data_dependent_bound(self):
+        """A `for` over a tensor-derived range: the loop statement runs
+        eagerly, regions before/after stay compiled."""
+        pre, post = [], []
+
+        @jit.to_static
+        def f(x):
+            y = x * 2                       # compiled
+            pre.append(1)
+            for _ in range(int(y.max())):   # break: concretized bound
+                y = y + 1
+            z = y * 10                      # compiled
+            post.append(1)
+            return z
+
+        x = paddle.to_tensor(np.full(3, 2.0, np.float32))
+        out = f(x)
+        n_pre, n_post = len(pre), len(post)
+        out = f(x)
+        # y = 4 -> loop 4x -> 8 -> *10
+        np.testing.assert_allclose(out.numpy(), [80.0, 80.0, 80.0])
+        # no re-trace once split (discovery traces excluded)
+        assert len(pre) == n_pre and len(post) == n_post
+        assert _kinds(f) == ["jit", "eager", "jit"]
+
+    def test_two_break_sites_split_recursively(self):
+        @jit.to_static
+        def f(x):
+            a = x + 1
+            n = int(a.sum()) * 0 + 2        # break 1
+            b = a * n
+            m = int(b.sum()) * 0 + 3        # break 2
+            return b * m
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [12.0, 12.0])
+        np.testing.assert_allclose(f(x).numpy(), [12.0, 12.0])
+        kinds = _kinds(f)
+        assert kinds.count("eager") == 2
+        assert kinds.count("jit") >= 2
+
+    def test_break_inside_helper_splits_at_call_site(self):
+        """Concretization inside a called helper: the calling statement
+        becomes the eager break; neighbours stay compiled."""
+        pre = []
+
+        def helper(t):
+            return int(t.sum()) * 0 + 7     # concretizes
+
+        @jit.to_static
+        def f(x):
+            h = x * 3
+            pre.append(1)
+            n = helper(h)                   # break at this call site
+            return h * n
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [21.0, 21.0])
+        n_pre = len(pre)
+        np.testing.assert_allclose(f(x).numpy(), [21.0, 21.0])
+        assert len(pre) == n_pre
+        assert _kinds(f) == ["jit", "eager", "jit"]
+
+    def test_requires_grad_inputs_take_whole_eager(self):
+        """Grad-tracked inputs never route through the no-tape split
+        path — full autograd via whole-function eager."""
+        @jit.to_static
+        def f(x):
+            if float(x.sum()) > 0:
+                return (x * x).sum()
+            return (x * 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        f(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+        # the broken signature must NOT have built a split program for
+        # the grad path
+        assert all(sp is None for sp in f._split_programs.values()) or \
+            not f._split_programs
+
+    def test_closure_write_falls_back_whole_eager(self):
+        state = [0]
+
+        def make():
+            acc = 0
+
+            def g(x):
+                nonlocal acc                  # closure write: unsplittable
+                acc += 1
+                state[0] = acc
+                if float(x.sum()) > 0:
+                    return x * acc
+                return x
+
+            return g
+
+        f = jit.to_static(make())
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        f(x); f(x)
+        # whole-eager on every broken call: the closure keeps
+        # accumulating (a split/compiled path would freeze it)
+        before = state[0]
+        f(x)
+        assert state[0] == before + 1
+        assert all(sp is None for sp in f._split_programs.values())
+
+    def test_namedtuple_crosses_boundary(self):
+        from collections import namedtuple
+        Pair = namedtuple("Pair", ["a", "b"])
+
+        @jit.to_static
+        def f(x):
+            p = Pair(x * 2, 5)
+            n = int(x.sum()) * 0 + 1        # break
+            return p.a * p.b * n
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [10.0, 10.0])
+        np.testing.assert_allclose(f(x).numpy(), [10.0, 10.0])
+
+    def test_augassign_only_segment_gets_its_operand(self):
+        """`h += n` as the sole statement of a region must receive h
+        (aug-assign targets are loads too)."""
+        @jit.to_static
+        def f(x):
+            h = x * 2
+            n = int(h.sum()) * 0 + 3        # break 1
+            h += n
+            m = int(h.sum()) * 0 + 2        # break 2
+            return h * m
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [10.0, 10.0])
+        np.testing.assert_allclose(f(x).numpy(), [10.0, 10.0])
+
+    def test_value_churn_poisons_split_to_whole_eager(self):
+        """A tensor-derived int that changes every call would recompile
+        the suffix per call; after the trace cap the split poisons
+        itself and the signature goes whole-function eager — every call
+        still returns the right value."""
+        @jit.to_static
+        def f(x):
+            n = int(x.sum())                # break; n varies per call
+            return x * 0 + n
+
+        vals = []
+        for v in range(1, 15):
+            x = paddle.to_tensor(np.full(2, float(v), np.float32))
+            vals.append(float(f(x).numpy()[0]))
+        assert vals == [2.0 * v for v in range(1, 15)]
+        # churn detected: the split for this signature was dropped
+        assert all(sp is None for sp in f._split_programs.values())
+
+    def test_grad_tracked_global_falls_back_whole_eager(self):
+        """A trainable captured via module/closure scope must keep full
+        autograd — the split (no-tape) path is rejected."""
+        w = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        w.stop_gradient = False
+
+        @jit.to_static
+        def f(x):
+            h = x * w
+            if float(h.sum()) > 0:          # break
+                return h.sum()
+            return (h * 2).sum()
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        f(x).backward()
+        np.testing.assert_allclose(w.grad.numpy(), [1.0, 1.0])
+        assert all(sp is None for sp in f._split_programs.values())
+
+    def test_live_global_rebinding_seen_by_eager_break(self):
+        """Eager break statements read LIVE module globals (plain-Python
+        semantics), not a construction-time snapshot."""
+        import tests._gb_scale_mod as mod
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(mod.f(x).numpy(), [10.0, 10.0])
+        mod.SCALE = 99
+        try:
+            np.testing.assert_allclose(mod.f(x).numpy(), [99.0, 99.0])
+        finally:
+            mod.SCALE = 10
+
+    def test_split_matches_eager_value_parity(self):
+        """Property check: split execution == plain python execution for
+        a mixed pipeline."""
+        def body(x, w):
+            h = x.matmul(w)
+            h = h + 1
+            k = int(h.sum()) % 7            # break
+            h = h * (k + 1)
+            h = h.matmul(w)
+            return h.sum()
+
+        f = jit.to_static(body)
+        rs = np.random.RandomState(0)
+        for _ in range(3):
+            xv = rs.randn(3, 4).astype(np.float32)
+            wv = rs.randn(4, 4).astype(np.float32)
+            x, w = paddle.to_tensor(xv), paddle.to_tensor(wv)
+            got = f(x, w).numpy()
+            want = body(paddle.to_tensor(xv), paddle.to_tensor(wv)).numpy()
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
