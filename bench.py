@@ -72,7 +72,7 @@ def peak_flops(dev) -> float:
 
 
 def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
-            decode_int8_tps=None):
+            decode_int8_tps=None, decode_int4_tps=None):
     import jax
     return {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -84,7 +84,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "device": str(jax.devices()[0].device_kind),
                   "loss": lossv,
                   "decode_tokens_per_sec": decode_tps,
-                  "decode_int8_tokens_per_sec": decode_int8_tps},
+                  "decode_int8_tokens_per_sec": decode_int8_tps,
+                  "decode_int4_tokens_per_sec": decode_int4_tps},
     }
 
 
@@ -201,8 +202,19 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"int8 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # per-group int4 variant (quarter weight bytes; reference weight_only
+    # int4 path) — cheapest-to-skip, so it goes last
+    decode_int4_tps = None
+    if decode_int8_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_int4_tps = decode_rate(
+                gen.quantize_weights(state.params, cfg, bits=4))
+        except Exception as e:
+            print(f"int4 decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
-                   decode_int8_tps)
+                   decode_int8_tps, decode_int4_tps)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

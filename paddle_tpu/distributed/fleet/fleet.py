@@ -365,13 +365,13 @@ def stop_worker(barrier_timeout: float = 120.0):
                 time.sleep(0.2)
         if not posted:
             warnings.warn("stop_worker: could not post trainer-done to "
-                          "server0 after retries; skipping the trainer "
-                          "barrier (it cannot complete without this "
-                          "trainer's count)")
+                          "server0 after retries; the barrier will wait "
+                          "for the sibling trainers only")
         if rm.is_first_worker():
-            # without our own post the count can never reach n_trainers —
-            # waiting would just ride out the full timeout
-            if n_trainers > 1 and posted:
+            # without our own post the count tops out at n_trainers-1 —
+            # still wait for every SIBLING before shutting servers down
+            target = n_trainers if posted else n_trainers - 1
+            if n_trainers > 1 and target > 0:
                 deadline = time.time() + barrier_timeout
                 consec_fail = 0
                 while time.time() < deadline:
@@ -379,7 +379,7 @@ def stop_worker(barrier_timeout: float = 120.0):
                     try:
                         if rpc.rpc_sync("server0", _srv_done_count,
                                         timeout=min(remaining, 10.0)) \
-                                >= n_trainers:
+                                >= target:
                             break
                         consec_fail = 0
                     except Exception:

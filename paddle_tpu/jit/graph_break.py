@@ -105,15 +105,18 @@ def _unwrap(v):
     return v
 
 
-def _has_grad_tracked(v, depth: int = 2) -> bool:
-    """Shallow scan for grad-tracked Tensors in captured state."""
+def _has_grad_tracked(v, depth: int = 4) -> bool:
+    """Scan captured state for grad-tracked Tensors. Containers too big
+    or too deep to scan are treated AS grad-tracked (reject the split):
+    a silently-missed trainable would mean silently-wrong gradients,
+    while a false positive only costs the eager fallback."""
     if isinstance(v, Tensor):
         return not v.stop_gradient
-    if depth > 0 and isinstance(v, (list, tuple)):
-        return any(_has_grad_tracked(x, depth - 1) for x in v[:64])
-    if depth > 0 and isinstance(v, dict):
-        return any(_has_grad_tracked(x, depth - 1)
-                   for x in list(v.values())[:64])
+    if isinstance(v, (list, tuple, dict)):
+        items = list(v.values()) if isinstance(v, dict) else list(v)
+        if depth <= 0 or len(items) > 256:
+            return True   # unscannable — assume the worst
+        return any(_has_grad_tracked(x, depth - 1) for x in items)
     return False
 
 
@@ -375,9 +378,10 @@ class SplitProgram:
                     except SplitUnsupported:
                         self.poisoned = True
                         flag, rv = seg.run_eager(env, self._amp_ctx)
-                except (KeyError, SplitUnsupported):
-                    # env-key drift / unhashable boundary value — finish
-                    # this call eagerly, poison for the future
+                except SplitUnsupported:
+                    # unhashable boundary value (raised by _wrap; user
+                    # exceptions propagate untouched) — finish this call
+                    # eagerly, poison for the future
                     self.poisoned = True
                     flag, rv = seg.run_eager(env, self._amp_ctx)
             if flag:

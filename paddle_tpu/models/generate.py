@@ -33,22 +33,43 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
     }
 
 
-def quantize_weights(params, cfg: LlamaConfig) -> Dict:
-    """Weight-only int8 quantization for serving (reference:
+def quantize_weights(params, cfg: LlamaConfig, bits: int = 8,
+                     group_size: int = 128) -> Dict:
+    """Weight-only quantization for serving (reference:
     paddle/phi/kernels/fusion weight_only_linear / llm.int8 path;
-    python surface nn.quant.weight_quantize).
+    python surface nn.quant.weight_quantize, weight_only int4 variant).
 
-    Per-output-channel symmetric int8: w ~= q * scale[None, :]. Decode is
-    HBM-bandwidth-bound, so halving weight bytes is the TPU win; dequant
+    ``bits=8``: per-output-channel symmetric int8, w ~= q * scale[None,:].
+    ``bits=4``: per-group symmetric int4 (``group_size`` rows of the
+    input dim share a scale — reference GroupWiseWeightObserver), stored
+    as ``jnp.int4`` so HBM holds true 4-bit weights. Decode is
+    HBM-bandwidth-bound, so weight bytes are the TPU win; dequant
     (convert+scale) fuses into the matmul read. The embedding table stays
     bf16 (it is a gather, and the tied head reuses it)."""
-    def q(w):
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+
+    def q8(w):
         scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
         scale = jnp.maximum(scale, 1e-8)
         qw = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
                       -127, 127).astype(jnp.int8)
         return qw, scale.astype(jnp.float32)
 
+    def q4(w):
+        din, dout = w.shape
+        g = min(group_size, din)
+        if din % g:
+            # serving weights are multiples of 128; bail to one group
+            g = din
+        wf = w.astype(jnp.float32).reshape(din // g, g, dout)
+        scale = jnp.max(jnp.abs(wf), axis=1) / 7.0          # (G, out)
+        scale = jnp.maximum(scale, 1e-8)
+        qw = jnp.clip(jnp.round(wf / scale[:, None, :]), -7, 7)
+        return (qw.reshape(din, dout).astype(jnp.int4),
+                scale.astype(jnp.float32))
+
+    q = q4 if bits == 4 else q8
     out = {k: v for k, v in params.items()}
     layers = dict(params["layers"])
     for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
@@ -64,10 +85,18 @@ def quantize_weights(params, cfg: LlamaConfig) -> Dict:
 
 
 def _w(lp, name, dtype):
-    """Weight fetch with on-the-fly int8 dequant when quantized."""
+    """Weight fetch with on-the-fly dequant when quantized: per-channel
+    int8 (scale (out,)) or per-group int4 (scale (G, out))."""
     w = lp[name]
     if name + "_scale" in lp:
-        return w.astype(dtype) * lp[name + "_scale"][None, :].astype(dtype)
+        s = lp[name + "_scale"]
+        if s.ndim == w.ndim:              # per-group: (G, out) vs (in, out)
+            gct = s.shape[-2]
+            g = w.shape[-2] // gct
+            wf = w.astype(dtype).reshape(w.shape[:-2] + (gct, g, w.shape[-1]))
+            wf = wf * s[..., :, None, :].astype(dtype)
+            return wf.reshape(w.shape)
+        return w.astype(dtype) * s[None, :].astype(dtype)
     return w
 
 

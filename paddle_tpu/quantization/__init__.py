@@ -8,7 +8,10 @@ TPU-native: fake-quant uses the straight-through estimator expressed as
 simulation targets the MXU's int8 mode for deployment.
 """
 from .config import QuantConfig  # noqa: F401
-from .observers import AbsmaxObserver, ObserverFactory  # noqa: F401
+from .observers import (  # noqa: F401
+    AbsmaxObserver, ObserverFactory, EMAObserver, HistObserver, KLObserver,
+    AbsMaxChannelWiseWeightObserver, GroupWiseWeightObserver,
+)
 from .quanters import (  # noqa: F401
     FakeQuanterWithAbsMaxObserver, quant, dequant, fake_quant,
 )

@@ -1,7 +1,17 @@
 """Observers (reference: python/paddle/quantization/observers/abs_max.py
-AbsmaxObserver + factory.py ObserverFactory)."""
+AbsmaxObserver, observers/groupwise.py GroupWiseWeightObserver,
+factory.py ObserverFactory; histogram/KL calibration re-designs the
+static stack python/paddle/static/quantization/cal_kl_threshold.py +
+post_training_quantization.py hist/KL/percent algorithms).
+
+TPU-native split of labor: per-batch statistics (absmax, histograms) are
+single jnp reductions on device; the calibration math (EMA, percentile
+search, KL threshold search) is host-side numpy over the collected
+statistics — it runs once, between steps, and never enters a compiled
+program."""
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from .._core.tensor import Tensor
@@ -59,3 +69,304 @@ class AbsmaxObserverLayer(BaseObserver):
 
     def zero_points(self):
         return Tensor(jnp.zeros(()), _internal=True)
+
+
+class EMAObserver(ObserverFactory):
+    """Exponential-moving-average abs-max (reference: the moving-average
+    flavor of abs_max used by PTQ activation calibration)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits=quant_bits, moving_rate=moving_rate)
+        self._cls = EMAObserverLayer
+
+
+class EMAObserverLayer(BaseObserver):
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._rate = moving_rate
+        self._ema = None
+
+    def forward(self, x):
+        x = as_tensor(x)
+        cur = float(jnp.max(jnp.abs(x._value)))
+        self._ema = cur if self._ema is None else (
+            self._rate * self._ema + (1 - self._rate) * cur)
+        return x
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._ema or 1.0), _internal=True)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+class _HistogramState:
+    """Running |x| histogram with proportional range growth: when a batch
+    exceeds the current range, old bins are merged into the wider bins
+    (old bin i -> new bin i // factor) so earlier batches keep their
+    weight — the rebinning trick of the static PTQ hist collector."""
+
+    def __init__(self, bins=2048):
+        self.bins = bins
+        self.hist = np.zeros(bins, np.float64)
+        self.amax = None
+
+    def update(self, absx: np.ndarray):
+        bmax = float(absx.max()) if absx.size else 0.0
+        if bmax == 0.0 and self.amax is None:
+            return
+        if self.amax is None:
+            self.amax = bmax
+        elif bmax > self.amax:
+            factor = int(np.ceil(bmax / self.amax))
+            merged = np.zeros(self.bins, np.float64)
+            idx = np.arange(self.bins) // factor
+            np.add.at(merged, idx, self.hist)
+            self.hist = merged
+            self.amax *= factor
+        h, _ = np.histogram(absx, bins=self.bins, range=(0.0, self.amax))
+        self.hist += h
+
+    @property
+    def bin_width(self) -> float:
+        return (self.amax or 1.0) / self.bins
+
+
+class HistObserver(ObserverFactory):
+    """Percentile-of-histogram scale (reference: the 'hist' algo of
+    static PostTrainingQuantization, hist_percent)."""
+
+    def __init__(self, quant_bits=8, bins=2048, percent=0.99999):
+        super().__init__(quant_bits=quant_bits, bins=bins, percent=percent)
+        self._cls = HistObserverLayer
+
+
+class HistObserverLayer(BaseObserver):
+    def __init__(self, quant_bits=8, bins=2048, percent=0.99999):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._percent = percent
+        self._state = _HistogramState(bins)
+
+    def forward(self, x):
+        x = as_tensor(x)
+        self._state.update(np.abs(np.asarray(x._value, np.float32)).ravel())
+        return x
+
+    def scales(self):
+        st = self._state
+        if st.amax is None:
+            return Tensor(jnp.asarray(1.0), _internal=True)
+        cum = np.cumsum(st.hist)
+        total = cum[-1]
+        if total <= 0:
+            return Tensor(jnp.asarray(st.amax), _internal=True)
+        i = int(np.searchsorted(cum, self._percent * total))
+        scale = (i + 1) * st.bin_width
+        return Tensor(jnp.asarray(scale), _internal=True)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+def _kl_divergence_threshold(hist: np.ndarray, levels: int) -> int:
+    """Index of the clip bin minimizing KL(P || quantize(P, levels)) —
+    the entropy-calibration search (reference:
+    static/quantization/cal_kl_threshold.py cal_kl_threshold; algorithm
+    re-derived, implementation original)."""
+    n = len(hist)
+    if n <= levels:
+        return n
+    best_i, best_kl = n, np.inf
+    total = hist.sum()
+    if total <= 0:
+        return n
+    # start the search at half the range (reference: cal_kl_threshold's
+    # starting_iter = (bins-1)*0.5) — candidates below that degenerate
+    # toward Q == P (tiny merge groups), which always "wins" with KL 0
+    # while clipping almost everything
+    start = max(levels, n // 2)
+    for i in range(start, n + 1):
+        p = hist[:i].astype(np.float64).copy()
+        p[i - 1] += hist[i:].sum()          # outliers clip into last bin
+        # reference distribution, smoothed where empty
+        p_nz = p > 0
+        # quantized distribution: i bins grouped into `levels` buckets;
+        # each bucket's mass spreads uniformly over its NONZERO src bins
+        group = (np.arange(i) * levels) // i
+        bucket_sum = np.bincount(group, weights=p, minlength=levels)
+        bucket_nz = np.bincount(group, weights=p_nz.astype(np.float64),
+                                minlength=levels)
+        q = np.zeros(i, np.float64)
+        safe = bucket_nz[group] > 0
+        q[safe] = (bucket_sum[group] / np.maximum(bucket_nz[group], 1))[safe]
+        q[~p_nz] = 0.0
+        ps = p / p.sum()
+        qs_total = q.sum()
+        if qs_total <= 0:
+            continue
+        qs = q / qs_total
+        mask = (ps > 0) & (qs > 0)
+        if not mask.any():
+            continue
+        kl = float(np.sum(ps[mask] * np.log(ps[mask] / qs[mask])))
+        # mass of p where q is zero is unrepresentable: penalize
+        kl += float(ps[(ps > 0) & (qs <= 0)].sum()) * 10.0
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i
+
+
+class KLObserver(ObserverFactory):
+    """Entropy (KL-divergence) calibrated scale (reference: the 'KL' algo
+    of static PostTrainingQuantization)."""
+
+    def __init__(self, quant_bits=8, bins=2048):
+        super().__init__(quant_bits=quant_bits, bins=bins)
+        self._cls = KLObserverLayer
+
+
+class KLObserverLayer(BaseObserver):
+    def __init__(self, quant_bits=8, bins=2048):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._state = _HistogramState(bins)
+
+    def forward(self, x):
+        x = as_tensor(x)
+        self._state.update(np.abs(np.asarray(x._value, np.float32)).ravel())
+        return x
+
+    def scales(self):
+        st = self._state
+        if st.amax is None:
+            return Tensor(jnp.asarray(1.0), _internal=True)
+        levels = 2 ** (self._quant_bits - 1)
+        i = _kl_divergence_threshold(st.hist, levels)
+        return Tensor(jnp.asarray(i * st.bin_width), _internal=True)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+class AbsMaxChannelWiseWeightObserver(ObserverFactory):
+    """Per-output-channel weight abs-max (reference:
+    observers/abs_max.py AbsMaxChannelWiseWeightObserver)."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__(quant_bits=quant_bits, quant_axis=quant_axis)
+        self._cls = AbsMaxChannelWiseWeightObserverLayer
+
+
+class AbsMaxChannelWiseWeightObserverLayer(BaseObserver):
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._axis = quant_axis
+        self._max = None
+
+    def forward(self, w):
+        w = as_tensor(w)
+        v = jnp.abs(w._value.astype(jnp.float32))
+        red = tuple(a for a in range(v.ndim)
+                    if a != (self._axis % v.ndim))
+        cur = jnp.max(v, axis=red)
+        self._max = cur if self._max is None else jnp.maximum(self._max,
+                                                              cur)
+        return w
+
+    def scales(self):
+        if self._max is None:
+            return Tensor(jnp.asarray(1.0), _internal=True)
+        return Tensor(jnp.maximum(self._max, 1e-8), _internal=True)
+
+    def fake_quant(self, w):
+        """STE fake-quant with per-channel broadcast."""
+        from .quanters import fake_quant as _fq
+        w = as_tensor(w)
+        s = self.scales()._value
+        shape = [1] * w._value.ndim
+        shape[self._axis % w._value.ndim] = -1
+        return _fq(w, Tensor(s.reshape(shape), _internal=True),
+                   self._quant_bits)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return self._axis
+
+
+class GroupWiseWeightObserver(ObserverFactory):
+    """Per-group weight abs-max for low-bit (int4) quantization
+    (reference: observers/groupwise.py GroupWiseWeightObserver — groups
+    of ``group_size`` along the input dim share one scale)."""
+
+    def __init__(self, quant_bits=4, group_size=128):
+        super().__init__(quant_bits=quant_bits, group_size=group_size)
+        self._cls = GroupWiseWeightObserverLayer
+
+
+class GroupWiseWeightObserverLayer(BaseObserver):
+    def __init__(self, quant_bits=4, group_size=128):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._group = group_size
+        self._max = None
+
+    def _group_absmax(self, v):
+        """(in, out) -> (ceil(in/g), out) per-group abs-max."""
+        din = v.shape[0]
+        g = min(self._group, din)
+        pad = (-din) % g
+        if pad:
+            v = jnp.concatenate(
+                [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)], 0)
+        grouped = v.reshape((v.shape[0] // g, g) + v.shape[1:])
+        return jnp.max(jnp.abs(grouped.astype(jnp.float32)), axis=1)
+
+    def forward(self, w):
+        w = as_tensor(w)
+        cur = self._group_absmax(w._value)
+        self._max = cur if self._max is None else jnp.maximum(self._max,
+                                                              cur)
+        return w
+
+    def scales(self):
+        if self._max is None:
+            return Tensor(jnp.asarray(1.0), _internal=True)
+        return Tensor(jnp.maximum(self._max, 1e-8), _internal=True)
+
+    def fake_quant(self, w):
+        from .quanters import fake_quant as _fq
+        w = as_tensor(w)
+        v = w._value
+        din = v.shape[0]
+        g = min(self._group, din)
+        pad = (-din) % g
+        s = self.scales()._value          # (G, *rest)
+        vv = v
+        if pad:
+            vv = jnp.concatenate(
+                [vv, jnp.zeros((pad,) + v.shape[1:], v.dtype)], 0)
+        grouped = vv.reshape((vv.shape[0] // g, g) + vv.shape[1:])
+        out = _fq(Tensor(grouped, _internal=True),
+                  Tensor(s[:, None], _internal=True), self._quant_bits)
+        flat = out._value.reshape((-1,) + v.shape[1:])[:din]
+        return Tensor(flat, _internal=True)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return 0

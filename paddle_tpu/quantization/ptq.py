@@ -13,16 +13,46 @@ class PTQ:
 
     def quantize(self, model: Layer, inplace=False) -> Layer:
         """Insert observers — run calibration batches through the model
-        afterwards."""
+        afterwards (or use :meth:`calibrate`)."""
         qat_like = __import__(
             "paddle_tpu.quantization.qat", fromlist=["QAT"]).QAT(
             self._config)
         return qat_like.quantize(model, inplace)
 
+    def calibrate(self, model: Layer, data, num_batches: int = None):
+        """Drive calibration batches through the observer-instrumented
+        model (reference: PostTrainingQuantization's sampling loop over a
+        DataLoader). ``data``: any iterable — a DataLoader, a list of
+        Tensors, or a list of (inputs...) tuples; only the inputs are
+        fed (a trailing label in a 2-tuple is dropped, matching the
+        common ``(x, y)`` loader)."""
+        from .._core import autograd as ag
+        was_training = getattr(model, "training", False)
+        model.eval()
+        try:
+            with ag.no_grad():
+                for i, batch in enumerate(data):
+                    if num_batches is not None and i >= num_batches:
+                        break
+                    if isinstance(batch, (tuple, list)):
+                        feed = batch[:-1] if len(batch) == 2 else batch
+                        model(*feed)
+                    else:
+                        model(batch)
+        finally:
+            if was_training:
+                model.train()
+        return model
+
     def convert(self, model: Layer, inplace=False) -> Layer:
         """Replace observers with fixed-scale fake-quant using collected
-        scales."""
+        scales; observer-calibrated weights are baked into the layer.
+        ``inplace=False`` (default) converts a deep copy so the
+        calibrated model keeps its fp32 weights for recalibration."""
+        import copy
         from .quanters import fake_quant
+        if not inplace:
+            model = copy.deepcopy(model)
 
         class _Frozen(Layer):
             def __init__(self, inner, scale, bits):
@@ -40,6 +70,12 @@ class PTQ:
                 parts = name.split(".")
                 for p in parts[:-1]:
                     parent = getattr(parent, p)
+                w = getattr(sub.inner, "weight", None)
+                if sub.weight_quanter is not None and w is not None and \
+                        hasattr(sub.weight_quanter, "fake_quant"):
+                    if getattr(sub.weight_quanter, "_max", None) is None:
+                        sub.weight_quanter(w)   # never calibrated: one shot
+                    w.set_value(sub.weight_quanter.fake_quant(w)._value)
                 if sub.activation_quanter is not None and \
                         hasattr(sub.activation_quanter, "scales"):
                     scale = float(sub.activation_quanter.scales()._value)

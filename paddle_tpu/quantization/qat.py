@@ -22,11 +22,23 @@ class QuantedLayer(Layer):
             x = self.activation_quanter(x)
         w = getattr(self.inner, "weight", None)
         if self.weight_quanter is not None and w is not None:
-            from .quanters import fake_quant
-            import jax.numpy as jnp
-            scale = float(jnp.max(jnp.abs(w._value))) or 1.0
+            wq = self.weight_quanter
+            if hasattr(wq, "fake_quant"):
+                # observer-calibrated (channel-wise / group-wise) scales.
+                # Training (QAT): the scale must track the CURRENT weight
+                # — a running max would keep a stale grid as weight decay
+                # shrinks channels. Eval (PTQ calibration): accumulate.
+                if self.training:
+                    wq._max = None
+                wq(w)
+                new = wq.fake_quant(w)._value
+            else:
+                from .quanters import fake_quant
+                import jax.numpy as jnp
+                scale = float(jnp.max(jnp.abs(w._value))) or 1.0
+                new = fake_quant(w, scale)._value
             orig = w._value
-            w._value = fake_quant(w, scale)._value
+            w._value = new
             try:
                 return self.inner(x)
             finally:

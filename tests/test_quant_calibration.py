@@ -1,0 +1,273 @@
+"""Quantization calibration tier (VERDICT r3 missing #3).
+
+reference: python/paddle/quantization/observers/ (abs_max, groupwise),
+python/paddle/static/quantization/cal_kl_threshold.py +
+post_training_quantization.py (hist/KL/percent calibration), and the
+weight-only int4/int8 serving path (phi weight_only_linear).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+
+
+class TestObservers:
+    def test_ema_observer_tracks_moving_absmax(self):
+        ob = Q.EMAObserver(moving_rate=0.5)._instance(None)
+        ob(paddle.to_tensor(np.array([1.0, -2.0], np.float32)))
+        ob(paddle.to_tensor(np.array([4.0], np.float32)))
+        # ema: 2.0 then 0.5*2 + 0.5*4 = 3.0
+        np.testing.assert_allclose(float(ob.scales().numpy()), 3.0)
+
+    def test_hist_observer_matches_percentile(self):
+        rs = np.random.RandomState(0)
+        data = rs.randn(20000).astype(np.float32)
+        ob = Q.HistObserver(percent=0.99, bins=2048)._instance(None)
+        for chunk in np.split(data, 4):
+            ob(paddle.to_tensor(chunk))
+        got = float(ob.scales().numpy())
+        want = np.quantile(np.abs(data), 0.99)
+        assert abs(got - want) < 0.05 * want, (got, want)
+
+    def test_hist_observer_rebins_when_range_grows(self):
+        ob = Q.HistObserver(percent=1.0, bins=64)._instance(None)
+        ob(paddle.to_tensor(np.linspace(-1, 1, 100).astype(np.float32)))
+        # 8x wider batch forces proportional rebinning
+        ob(paddle.to_tensor(np.array([8.0], np.float32)))
+        got = float(ob.scales().numpy())
+        assert 7.9 <= got <= 8.2, got
+        # total mass preserved through the rebin
+        assert ob._state.hist.sum() == 101
+
+    def test_kl_observer_clips_outliers(self):
+        """KL calibration picks a threshold below a lone extreme outlier
+        (absmax would not). The search floor is half the observed range
+        (reference: cal_kl_threshold starting_iter = (bins-1)*0.5), so
+        the clip is bounded at ~2x — not arbitrary."""
+        rs = np.random.RandomState(1)
+        data = rs.randn(30000).astype(np.float32)
+        data[0] = 1000.0
+        ob = Q.KLObserver(bins=2048)._instance(None)
+        ob(paddle.to_tensor(data))
+        got = float(ob.scales().numpy())
+        amax = float(np.abs(data).max())
+        assert got < 0.75 * amax, (got, amax)   # clipped vs absmax
+        assert got >= 0.4 * amax, (got, amax)   # reference's half floor
+        # gaussian-only data: KL must keep (near) full range
+        ob2 = Q.KLObserver(bins=2048)._instance(None)
+        clean = rs.randn(30000).astype(np.float32)
+        ob2(paddle.to_tensor(clean))
+        got2 = float(ob2.scales().numpy())
+        assert got2 > 1.5, got2                  # covers the bulk
+
+    def test_channelwise_weight_observer_beats_per_tensor(self):
+        """A weight whose channels differ 100x in scale quantizes far
+        more accurately per-channel than per-tensor."""
+        rs = np.random.RandomState(2)
+        w = rs.randn(64, 4).astype(np.float32)
+        w[:, 0] *= 100.0
+        t = paddle.to_tensor(w)
+        ob = Q.AbsMaxChannelWiseWeightObserver()._instance(None)
+        ob(t)
+        per_ch = ob.fake_quant(t).numpy()
+        per_tensor = Q.fake_quant(t, float(np.abs(w).max())).numpy()
+        err_ch = np.abs(per_ch - w)[:, 1:].mean()
+        err_pt = np.abs(per_tensor - w)[:, 1:].mean()
+        assert err_ch < err_pt / 10, (err_ch, err_pt)
+        assert ob.scales().numpy().shape == (4,)
+
+    def test_groupwise_weight_observer_int4(self):
+        rs = np.random.RandomState(3)
+        w = rs.randn(256, 8).astype(np.float32)
+        w[:128] *= 50.0                  # two very different groups
+        t = paddle.to_tensor(w)
+        ob = Q.GroupWiseWeightObserver(quant_bits=4,
+                                       group_size=128)._instance(None)
+        ob(t)
+        assert ob.scales().numpy().shape == (2, 8)
+        fq = ob.fake_quant(t).numpy()
+        assert fq.shape == w.shape
+        # per-group int4: relative error bounded by half a quant step
+        rel = np.abs(fq - w).max() / np.abs(w).max()
+        assert rel < 0.15, rel
+        # the small group must NOT be crushed by the large group's scale
+        small_err = np.abs(fq[128:] - w[128:]).mean()
+        assert small_err < 0.5, small_err
+
+
+class TestPTQCalibration:
+    def _net(self):
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                             nn.Linear(16, 4))
+
+    def test_calibrate_over_dataloader_and_convert(self):
+        import paddle_tpu.io as io
+        rs = np.random.RandomState(0)
+        xs = rs.randn(32, 8).astype(np.float32) * 2
+        ys = rs.randint(0, 4, 32).astype(np.int64)
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return xs[i], ys[i]
+
+        loader = io.DataLoader(DS(), batch_size=8)
+        cfg = Q.QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(nn.Linear, activation=Q.HistObserver(),
+                            weight=Q.AbsMaxChannelWiseWeightObserver())
+        net = self._net()
+        ptq = Q.PTQ(cfg)
+        qnet = ptq.quantize(net)
+        ptq.calibrate(qnet, loader, num_batches=4)
+        final = ptq.convert(qnet)
+        out = final(paddle.to_tensor(xs[:2]))
+        assert out.shape == [2, 4]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_qat_weight_scale_tracks_current_weight(self):
+        """In training mode the weight fake-quant grid follows the
+        CURRENT weight, not a historical running max (weight decay must
+        not leave a 10x-too-coarse grid)."""
+        lin = nn.Linear(8, 4)
+        cfg = Q.QuantConfig(activation=None, weight=None)
+        cfg.add_layer_config(lin, weight=Q.AbsMaxChannelWiseWeightObserver())
+        qnet = Q.QAT(cfg).quantize(nn.Sequential(lin))
+        qnet.train()
+        x = paddle.to_tensor(np.ones((1, 8), np.float32))
+        qnet(x)
+        s_big = np.array(lin.weight._value).__abs__().max()
+        lin.weight.set_value(np.asarray(lin.weight.numpy() / 10))
+        qnet(x)
+        wq = qnet[0].weight_quanter
+        got = float(wq.scales().numpy().max())
+        assert got < s_big / 5, (got, s_big)
+
+    def test_convert_not_inplace_keeps_fp32_weights(self):
+        """convert(inplace=False) must not bake fake-quant values into
+        the calibrated model's weights — recalibration stays possible."""
+        net = nn.Sequential(nn.Linear(8, 4))
+        cfg = Q.QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(nn.Linear,
+                            weight=Q.AbsMaxChannelWiseWeightObserver())
+        ptq = Q.PTQ(cfg)
+        qnet = ptq.quantize(net)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            4, 8).astype(np.float32))
+        ptq.calibrate(qnet, [x])
+        w_before = qnet[0].inner.weight.numpy().copy()
+        final = ptq.convert(qnet)
+        # original keeps fp32; converted copy got the baked weights
+        np.testing.assert_array_equal(qnet[0].inner.weight.numpy(),
+                                      w_before)
+        assert not np.array_equal(final[0].weight.numpy(), w_before)
+
+    def test_ptq_output_drift_bounded(self):
+        """int8 PTQ with hist calibration keeps outputs close to fp32."""
+        rs = np.random.RandomState(1)
+        net = self._net()
+        x = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+        ref = net(x).numpy()
+        cfg = Q.QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(
+            nn.Linear, activation=Q.HistObserver(percent=0.9999),
+            weight=Q.AbsMaxChannelWiseWeightObserver())
+        ptq = Q.PTQ(cfg)
+        qnet = ptq.quantize(net)
+        ptq.calibrate(qnet, [x])
+        out = ptq.convert(qnet)(x).numpy()
+        denom = np.abs(ref).mean() + 1e-6
+        assert np.abs(out - ref).mean() / denom < 0.05
+
+
+def _dequant_params(qp, cfg):
+    """Densify quantized serving params through generate._w — the exact
+    dequant math the decode path computes on the fly."""
+    import jax
+    from paddle_tpu.models import generate
+    layers = dict(qp["layers"])
+    out_layers = {}
+    for name in list(layers):
+        if name.endswith("_scale"):
+            continue
+        if name + "_scale" in layers:
+            out_layers[name] = jax.vmap(
+                lambda wi, si: generate._w(
+                    {"x": wi, "x_scale": si}, "x", cfg.dtype))(
+                layers[name], layers[name + "_scale"])
+        else:
+            out_layers[name] = layers[name]
+    out = {k: v for k, v in qp.items() if k != "layers"}
+    out["layers"] = out_layers
+    if "lm_head_scale" in out:
+        from paddle_tpu.models import generate as g
+        out["lm_head"] = g._w(
+            {"x": out["lm_head"], "x_scale": out.pop("lm_head_scale")},
+            "x", cfg.dtype)
+    return out
+
+
+class TestInt4Serving:
+    def _setup(self, seed):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models import llama
+        cfg = llama.LlamaConfig.tiny(num_layers=2, hidden_size=128,
+                                     num_heads=4, num_kv_heads=4,
+                                     intermediate_size=256, vocab_size=128)
+        params = llama.init_params(jax.random.key(seed), cfg)
+        tokens = jnp.asarray(np.random.default_rng(seed).integers(
+            0, cfg.vocab_size, (2, 64)), jnp.int32)
+        return cfg, params, tokens
+
+    def test_int4_group_quant_serves_with_bounded_ppl_drift(self):
+        """Per-group int4 weights: (a) loss (log-perplexity) drift within
+        a bound through the serving dequant math, (b) generate() runs
+        off the quantized params directly."""
+        import jax.numpy as jnp
+        from paddle_tpu.models import llama, generate
+
+        cfg, params, tokens = self._setup(0)
+        base = float(llama.loss_fn(params, tokens, cfg))
+        qp = generate.quantize_weights(params, cfg, bits=4, group_size=64)
+        assert qp["layers"]["wq"].dtype == jnp.int4
+        assert qp["layers"]["wq_scale"].ndim == 3      # (L, G, out)
+        qloss = float(llama.loss_fn(_dequant_params(qp, cfg), tokens, cfg))
+        assert abs(qloss - base) / base < 0.05, (qloss, base)
+
+        out = generate.generate(qp, tokens[:, :8], cfg, max_new_tokens=4)
+        assert out.shape[1] == 12
+        assert int(out.max()) < cfg.vocab_size
+
+    def test_int8_vs_int4_fidelity_ordering(self):
+        import jax.numpy as jnp
+        from paddle_tpu.models import llama, generate
+
+        cfg, params, tokens = self._setup(1)
+        base = llama.forward(params, tokens, cfg)
+        p8 = _dequant_params(
+            generate.quantize_weights(params, cfg, bits=8), cfg)
+        p4 = _dequant_params(
+            generate.quantize_weights(params, cfg, bits=4, group_size=64),
+            cfg)
+        denom = float(jnp.mean(jnp.abs(base))) + 1e-6
+        e8 = float(jnp.mean(jnp.abs(
+            llama.forward(p8, tokens, cfg) - base))) / denom
+        e4 = float(jnp.mean(jnp.abs(
+            llama.forward(p4, tokens, cfg) - base))) / denom
+        assert e8 < e4          # int8 strictly more faithful
+        assert e4 < 0.5         # int4 still sane (relative to logit scale)
+
+    def test_int4_generate_matches_dequantized_generate(self):
+        """The on-the-fly int4 dequant in the decode loop must equal
+        decoding with pre-densified weights (greedy, same argmax path)."""
+        from paddle_tpu.models import generate
+        cfg, params, tokens = self._setup(2)
+        qp = generate.quantize_weights(params, cfg, bits=4, group_size=64)
+        dp = _dequant_params(qp, cfg)
+        a = generate.generate(qp, tokens[:, :8], cfg, max_new_tokens=6)
+        b = generate.generate(dp, tokens[:, :8], cfg, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
