@@ -77,7 +77,10 @@ def elastic_train(train_one_step: Callable[[int], Any],
                   num_steps: int,
                   checkpointer: ElasticCheckpointer,
                   manager=None,
-                  save_every: int = 0) -> int:
+                  save_every: int = 0,
+                  watch_scale: bool = False,
+                  scale_interval: float = 2.0,
+                  scale_ttl: float = 60.0) -> int:
     """Run ``train_one_step(step)`` for steps [resume..num_steps), with
     preemption-safe checkpointing:
 
@@ -85,7 +88,14 @@ def elastic_train(train_one_step: Callable[[int], Any],
     - installs a SIGTERM handler that requests a checkpoint; at the NEXT
       step boundary the consistent state is saved and the process exits
       with ELASTIC_EXIT_CODE=101 (the launch controller relaunches);
-    - optionally checkpoints every ``save_every`` steps as well.
+    - optionally checkpoints every ``save_every`` steps as well;
+    - ``watch_scale=True``: registers this rank in the manager's
+      registry, heartbeats every step, and watches for N→M world-size
+      changes (a dead rank past its TTL, or a joiner) — a scale event
+      records the new np for the launch controller and takes the same
+      checkpoint-then-exit-101 path, so the relaunch re-forms the mesh
+      at the new size and resumes from the shared checkpoint
+      (reference: fleet/elastic/manager.py:125 etcd scale watch).
 
     Returns the first step that was NOT run (== num_steps on completion).
     """
@@ -103,12 +113,37 @@ def elastic_train(train_one_step: Callable[[int], Any],
     preempted = {"flag": False}
     manager.on_preemption(lambda: preempted.update(flag=True),
                           exit_after=False)
-    for step in range(start + 1, num_steps):
-        train_one_step(step)
-        if preempted["flag"]:
-            checkpointer.save(step, state_fn())
-            _os._exit(ELASTIC_EXIT_CODE)
-        if save_every and (step + 1) % save_every == 0:
-            checkpointer.save(step, state_fn())
-    checkpointer.save(num_steps - 1, state_fn())
+    hb_stop = None
+    if watch_scale:
+        manager.register()
+
+        def on_scale(n, survivors):
+            manager.write_scale_event(n, survivors)
+            preempted.update(flag=True)
+        manager.watch_scale(on_scale, interval=scale_interval,
+                            ttl=scale_ttl)
+        # heartbeat on its OWN thread: a step longer than the TTL must
+        # not read as this rank's death
+        hb_stop = threading.Event()
+        hb_period = max(min(scale_ttl / 4.0, 5.0), 0.05)
+
+        def _beat():
+            while not hb_stop.is_set():
+                manager.heartbeat()
+                hb_stop.wait(hb_period)
+        threading.Thread(target=_beat, daemon=True).start()
+    try:
+        for step in range(start + 1, num_steps):
+            train_one_step(step)
+            if preempted["flag"]:
+                checkpointer.save(step, state_fn())
+                _os._exit(ELASTIC_EXIT_CODE)
+            if save_every and (step + 1) % save_every == 0:
+                checkpointer.save(step, state_fn())
+        checkpointer.save(num_steps - 1, state_fn())
+    finally:
+        if hb_stop is not None:
+            hb_stop.set()
+    if watch_scale:
+        manager.exit()   # tombstone: completion is not a scale event
     return num_steps

@@ -57,12 +57,39 @@ class ElasticManager:
         with open(path, "w") as f:
             json.dump({"rank": self.rank, "pid": os.getpid(),
                        "ts": time.time()}, f)
+        try:   # a fresh generation of this rank clears its tombstone
+            os.remove(self._done_path(self.rank))
+        except OSError:
+            pass
 
-    def deregister(self):
+    def deregister(self, completed: bool = False):
+        """``completed=True`` leaves a tombstone so sibling watchers can
+        tell normal completion from a crash — only a vanished rank with
+        NO tombstone is a scale event."""
         try:
             os.remove(self._node_path(self.rank))
         except OSError:
             pass
+        if completed:
+            path = self._done_path(self.rank)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump({"rank": self.rank, "ts": time.time()}, f)
+
+    def _done_path(self, rank):
+        return os.path.join(self.registry_dir, self.job_id,
+                            f"rank_{rank}.done")
+
+    def done_ranks(self):
+        """Ranks (< np) that completed normally this generation."""
+        base = os.path.join(self.registry_dir, self.job_id)
+        out = []
+        if not os.path.isdir(base):
+            return out
+        for r in range(self.np):
+            if os.path.exists(self._done_path(r)):
+                out.append(r)
+        return out
 
     def alive_nodes(self, ttl: float = 60.0):
         base = os.path.join(self.registry_dir, self.job_id)
@@ -95,6 +122,113 @@ class ElasticManager:
             time.sleep(1.0)
         return False
 
+    # ---- scale events: N -> M rank changes (reference: manager.py:125
+    # watches etcd for node count changes and re-forms the job) ----
+    def _scale_path(self) -> str:
+        return os.path.join(self.registry_dir, self.job_id, "new_np")
+
+    def write_scale_event(self, n: int, survivors=None):
+        """Record the re-formed world for the launch controller(s):
+        new size, the surviving GLOBAL ranks (so hosts can renumber
+        contiguously and losers retire), and a timestamp (stale events
+        from an aborted prior run must not shrink a fresh job)."""
+        path = self._scale_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"np": int(n),
+                       "survivors": sorted(int(r) for r in survivors)
+                       if survivors is not None else None,
+                       "ts": time.time()}, f)
+        os.replace(tmp, path)
+
+    # back-compat spelling
+    def write_new_np(self, n: int):
+        self.write_scale_event(n)
+
+    def read_scale_event(self, clear: bool = False,
+                         max_age: float = 3600.0) -> Optional[dict]:
+        """``clear=False`` lets every host's controller read the same
+        event (multi-host); the writer's next generation or ``clear``
+        removes it. Events older than ``max_age`` are discarded."""
+        try:
+            with open(self._scale_path()) as f:
+                raw = f.read().strip()
+        except OSError:
+            return None
+        try:
+            ev = json.loads(raw)
+            if not isinstance(ev, dict):
+                raise ValueError(raw)
+        except ValueError:
+            try:
+                ev = {"np": int(raw), "survivors": None, "ts": None}
+            except ValueError:
+                return None
+        stale = ev.get("ts") is not None and \
+            time.time() - ev["ts"] > max_age
+        if clear or stale:
+            try:
+                os.remove(self._scale_path())
+            except OSError:
+                pass
+        return None if stale else ev
+
+    def read_new_np(self, clear: bool = False) -> Optional[int]:
+        ev = self.read_scale_event(clear=clear)
+        return None if ev is None else ev.get("np")
+
+    def watch_scale(self, on_scale: Optional[Callable] = None,
+                    interval: float = 2.0, ttl: float = 60.0,
+                    settle: int = 2, arm_timeout: float = 300.0):
+        """Background watch for the alive-node count departing from
+        ``self.np`` (a rank died past its heartbeat TTL, or a new one
+        joined). After ``settle`` consecutive differing polls,
+        ``on_scale(new_np)`` fires ONCE — the default records the new
+        world size (:meth:`write_new_np`) and triggers the preemption
+        path (checkpoint → exit 101 → controller relaunch at new np).
+
+        The watch ARMS only after it has seen the full world once
+        (slow-starting ranks must not read as a scale-down); if the
+        world never assembles within ``arm_timeout`` it fires with
+        whoever showed up — the rendezvous-timeout re-form. A rank that
+        completed normally left a tombstone (:meth:`deregister` with
+        ``completed=True``) and does NOT count as a death.
+
+        ``on_scale(new_np, survivors)`` — survivors are the alive
+        global ranks at fire time."""
+        def default(n, survivors):
+            self.write_scale_event(n, survivors)
+            self._handle(None, None)
+
+        cb = on_scale or default
+
+        def loop():
+            consec = 0
+            armed = False
+            t0 = time.time()
+            while not self._stop.is_set():
+                alive = self.alive_nodes(ttl)
+                n = len(alive)
+                effective = n + len(self.done_ranks())
+                if effective >= self.np:
+                    armed = True
+                    consec = 0
+                elif not armed:
+                    if time.time() - t0 > arm_timeout and n > 0:
+                        cb(n, alive)
+                        return
+                else:
+                    consec = consec + 1 if n > 0 else 0
+                    if consec >= settle:
+                        cb(n, alive)
+                        return
+                time.sleep(interval)
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._scale_watcher = t
+        return t
+
     # ---- preemption (TPU maintenance events) ----
     def on_preemption(self, callback: Callable, exit_after: bool = True):
         """Register checkpoint-and-exit callback; triggered by SIGTERM (the
@@ -123,5 +257,5 @@ class ElasticManager:
 
     def exit(self, completed: bool = True) -> ElasticStatus:
         self._stop.set()
-        self.deregister()
+        self.deregister(completed=completed)
         return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

@@ -46,6 +46,9 @@ def _parse(argv):
                    help="accepted for reference-CLI parity")
     p.add_argument("--job_id", default="default")
     p.add_argument("--log_dir", default="log")
+    p.add_argument("--elastic_registry",
+                   default=os.environ.get("PADDLE_ELASTIC_REGISTRY"),
+                   help="shared-FS dir for the elastic rank registry")
     p.add_argument("--max_restarts", type=int,
                    default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
                                               "3")))
@@ -76,6 +79,10 @@ class ControllerBase:
             "PADDLE_TRAINERS_NUM": str(nprocs),
             "PADDLE_JOB_ID": a.job_id,
         })
+        if a.elastic_registry:
+            # trainers' ElasticManager defaults must hit the same
+            # registry the controller reads scale events from
+            env["PADDLE_ELASTIC_REGISTRY"] = a.elastic_registry
         if a.master:
             env["PADDLE_MASTER"] = a.master
             env["JAX_COORDINATOR_ADDRESS"] = a.master
@@ -126,14 +133,61 @@ class ControllerBase:
                 p.kill()
         self.procs.clear()
 
+    def _apply_scale_event(self) -> Optional[int]:
+        """Pick up an N→M world change recorded by a training rank
+        (ElasticManager.write_scale_event) before the relaunch
+        (reference: the etcd-driven re-form in
+        fleet/elastic/manager.py:125).
+
+        Local fan-out (nnodes<=1): resize nproc_per_node; the event is
+        consumed (clear=True — one controller owns it). Multi-host with
+        one rank per host: every host's controller reads the SAME event
+        (no clear), survivors renumber contiguously by their position
+        in the survivor list, losers retire (self._retire). Multi-host
+        with nproc_per_node>1 is not re-formable from per-host
+        controllers and is left unchanged with a warning."""
+        import warnings
+        from ..fleet.elastic.manager import ElasticManager
+        a = self.ctx.args
+        mgr = ElasticManager(job_id=a.job_id,
+                             registry_dir=a.elastic_registry or None,
+                             np=a.nnodes * a.nproc_per_node)
+        local = a.nnodes <= 1
+        ev = mgr.read_scale_event(clear=local)
+        if ev is None or not ev.get("np"):
+            return None
+        new = int(ev["np"])
+        if local:
+            a.nproc_per_node = new
+            return new
+        if a.nproc_per_node != 1:
+            warnings.warn(
+                "elastic scale event ignored: multi-host re-form needs "
+                "one rank per host (nproc_per_node=1)")
+            return None
+        survivors = ev.get("survivors")
+        if survivors is not None:
+            if a.rank in survivors:
+                a.rank = survivors.index(a.rank)   # contiguous renumber
+            else:
+                self._retire = True
+        elif a.rank >= new:
+            self._retire = True
+        a.nnodes = new
+        return new
+
     def run(self) -> int:
         restarts = 0
+        self._retire = False
         while True:
             self.spawn()
             ret = self.watch()
             if ret == ELASTIC_EXIT_CODE and \
                     restarts < self.ctx.args.max_restarts:
                 restarts += 1
+                self._apply_scale_event()
+                if self._retire:
+                    return 0   # this host is outside the new world
                 continue
             return ret
 
