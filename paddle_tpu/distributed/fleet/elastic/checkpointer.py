@@ -131,7 +131,8 @@ def elastic_train(train_one_step: Callable[[int], Any],
             while not hb_stop.is_set():
                 manager.heartbeat()
                 hb_stop.wait(hb_period)
-        threading.Thread(target=_beat, daemon=True).start()
+        hb_thread = threading.Thread(target=_beat, daemon=True)
+        hb_thread.start()
     try:
         for step in range(start + 1, num_steps):
             train_one_step(step)
@@ -144,6 +145,9 @@ def elastic_train(train_one_step: Callable[[int], Any],
     finally:
         if hb_stop is not None:
             hb_stop.set()
+            # a racing beat could re-register and erase the tombstone
+            # AFTER exit() below — join first
+            hb_thread.join(timeout=5)
     if watch_scale:
         manager.exit()   # tombstone: completion is not a scale event
     return num_steps

@@ -98,6 +98,10 @@ class ElasticManager:
             return out
         now = time.time()
         for fn in os.listdir(base):
+            # node files only — tombstones (.done) and scale records
+            # share the directory and must not read as live ranks
+            if not (fn.startswith("rank_") and fn.endswith(".json")):
+                continue
             try:
                 with open(os.path.join(base, fn)) as f:
                     d = json.load(f)
@@ -210,8 +214,10 @@ class ElasticManager:
             while not self._stop.is_set():
                 alive = self.alive_nodes(ttl)
                 n = len(alive)
-                effective = n + len(self.done_ranks())
-                if effective >= self.np:
+                # completed ranks shrink the expected RUNNING world; a
+                # joiner grows n past it — both directions are events
+                expected = self.np - len(self.done_ranks())
+                if n == expected:
                     armed = True
                     consec = 0
                 elif not armed:

@@ -83,6 +83,7 @@ class ControllerBase:
             # trainers' ElasticManager defaults must hit the same
             # registry the controller reads scale events from
             env["PADDLE_ELASTIC_REGISTRY"] = a.elastic_registry
+        env.update(getattr(self, "_scale_env", {}))
         if a.master:
             env["PADDLE_MASTER"] = a.master
             env["JAX_COORDINATOR_ADDRESS"] = a.master
@@ -156,7 +157,21 @@ class ControllerBase:
         ev = mgr.read_scale_event(clear=local)
         if ev is None or not ev.get("np"):
             return None
+        # one application per event: in multi-host mode the file is left
+        # for sibling controllers, so a LATER unrelated 101 exit must not
+        # re-apply the same generation's renumbering (double-retire /
+        # rank collision)
+        if ev.get("ts") is not None and \
+                ev["ts"] == getattr(self, "_applied_scale_ts", None):
+            return None
+        self._applied_scale_ts = ev.get("ts")
         new = int(ev["np"])
+        survivors = ev.get("survivors")
+        if survivors is not None:
+            # resuming apps can adopt the freshest surviving rank's
+            # checkpoint (rank-private checkpoint dirs)
+            self._scale_env = {"PADDLE_ELASTIC_PREV_SURVIVORS":
+                               ",".join(str(r) for r in survivors)}
         if local:
             a.nproc_per_node = new
             return new
@@ -165,7 +180,6 @@ class ControllerBase:
                 "elastic scale event ignored: multi-host re-form needs "
                 "one rank per host (nproc_per_node=1)")
             return None
-        survivors = ev.get("survivors")
         if survivors is not None:
             if a.rank in survivors:
                 a.rank = survivors.index(a.rank)   # contiguous renumber

@@ -13,7 +13,9 @@ from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, make_scheduler,
     export_chrome_tracing, RecordEvent, load_profiler_result,
 )
-from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401
+from .profiler_statistic import (  # noqa: F401
+    DeviceStatistics, SortedKeys, StatisticData,
+)
 from .utils import benchmark  # noqa: F401
 from . import timer  # noqa: F401
 

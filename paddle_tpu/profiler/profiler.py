@@ -259,7 +259,10 @@ class Profiler:
     def step(self, num_samples: Optional[int] = None):
         from . import timer as _timer
         now = time.perf_counter()
-        if self._last_step_t is not None:
+        # count only RECORD-window steps: events exist only for those, so
+        # a summary over all steps would understate every Window%/Step%
+        if self._last_step_t is not None and self.current_state in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             self._step_times.append(now - self._last_step_t)
         self._last_step_t = now
         _timer.benchmark().step(num_samples)
@@ -303,10 +306,19 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit='ms'):
-        """reference: profiler.py summary -> profiler_statistic tables."""
-        from .profiler_statistic import StatisticData
-        return StatisticData(self.events(), self._step_times).report(
-            time_unit=time_unit)
+        """reference: profiler.py summary -> profiler_statistic tables
+        (Overview / Model / ranked host events / device op + category).
+        The device tier appears when a jax.profiler trace was captured
+        (PADDLE_TPU_DEVICE_TRACE=1 during a RECORD window)."""
+        from .profiler_statistic import DeviceStatistics, StatisticData
+        device = None
+        if self._device_trace_dir:
+            device = DeviceStatistics.from_trace_dir(
+                self._device_trace_dir)
+        return StatisticData(self.events(), self._step_times,
+                             device=device).report(
+            time_unit=time_unit, sorted_by=sorted_by,
+            op_detail=op_detail, thread_sep=thread_sep)
 
     def export(self, path: str, format: str = "json"):
         self._export_chrome(path)

@@ -67,6 +67,61 @@ class TestScaleWatch:
         time.sleep(1.5)
         assert events == []
 
+    def test_scale_up_joiner_fires(self, tmp_path):
+        """A NEW rank joining past np must also fire (N->M with M>N)."""
+        mgr0 = ElasticManager(registry_dir=str(tmp_path), job_id="ju",
+                              np=2)
+        mgr0.rank = 0
+        mgr1 = ElasticManager(registry_dir=str(tmp_path), job_id="ju",
+                              np=2)
+        mgr1.rank = 1
+        mgr0.register()
+        mgr1.register()
+        events = []
+        mgr0.watch_scale(lambda n, s: events.append((n, s)),
+                         interval=0.1, ttl=5.0, settle=2)
+        time.sleep(0.4)              # arm at n == np
+        joiner = ElasticManager(registry_dir=str(tmp_path), job_id="ju",
+                                np=2)
+        joiner.rank = 2
+        joiner.register()
+        t0 = time.time()
+        while not events and time.time() - t0 < 10:
+            time.sleep(0.05)
+        assert events == [(3, [0, 1, 2])]
+
+    def test_tombstone_not_counted_alive(self, tmp_path):
+        mgr = ElasticManager(registry_dir=str(tmp_path), job_id="jt",
+                             np=2)
+        mgr.rank = 0
+        mgr.register()
+        mgr1 = ElasticManager(registry_dir=str(tmp_path), job_id="jt",
+                              np=2)
+        mgr1.rank = 1
+        mgr1.register()
+        mgr1.exit(completed=True)
+        assert mgr.alive_nodes() == [0]       # .done is not a live rank
+        assert mgr.done_ranks() == [1]
+
+    def test_controller_applies_event_once(self, tmp_path, monkeypatch):
+        """Multi-host: the same (unconsumed) event must not re-apply on a
+        later unrelated 101 exit."""
+        from paddle_tpu.distributed.launch.main import (_parse, Context,
+                                                        ControllerBase)
+        monkeypatch.setenv("PADDLE_ELASTIC_REGISTRY", str(tmp_path))
+        mgr = ElasticManager(registry_dir=str(tmp_path), job_id="once",
+                             np=4)
+        mgr.write_scale_event(3, survivors=[0, 2, 3])
+        args = _parse(["--nnodes", "4", "--rank", "2", "--job_id",
+                       "once", "dummy.py"])
+        ctl = ControllerBase(Context(args))
+        ctl._retire = False
+        assert ctl._apply_scale_event() == 3
+        assert args.rank == 1
+        # second 101 with the SAME event: no re-renumber, no retire
+        assert ctl._apply_scale_event() is None
+        assert args.rank == 1 and not ctl._retire
+
     def test_default_callback_records_new_np(self, tmp_path):
         mgr = ElasticManager(registry_dir=str(tmp_path), job_id="j2",
                              np=2)

@@ -132,6 +132,37 @@ def main():
           f"opt by subtraction (full - grad): "
           f"{1e3 * (t_full - t_grad):.0f} ms")
 
+    # profiler summary tables (host spans + device op/category tables
+    # from the jax.profiler trace) — the per-XLA-op ranking that feeds
+    # the MFU residual accounting in PERF_NOTES.md
+    try:
+        profiled_summary(step, hold["s"], tokens)
+    except Exception as e:     # analysis extra; never kill the timings
+        print(f"profiler summary skipped: {type(e).__name__}: {e}")
+
+
+def profiled_summary(step, state, tokens, record_steps=2):
+    """Run the fused step under the Profiler with a device trace and
+    print Profiler.summary()'s ranked tables."""
+    import os
+    import tempfile
+    import paddle_tpu.profiler as profiler
+
+    os.environ["PADDLE_TPU_DEVICE_TRACE"] = "1"
+    os.environ.setdefault("PADDLE_TPU_DEVICE_TRACE_DIR",
+                          tempfile.mkdtemp(prefix="pt_trace_"))
+    hold = {"s": state}
+    prof = profiler.Profiler(scheduler=(1, 1 + record_steps))
+    prof.start()
+    for _ in range(1 + record_steps):
+        with profiler.RecordEvent("fused_train_step", "Operator"):
+            hold["s"], m = step(hold["s"], tokens)
+            jax.block_until_ready(m["loss"])
+        prof.step()
+    prof.stop()
+    print()
+    print(prof.summary(time_unit="ms"))
+
 
 if __name__ == "__main__":
     main()
