@@ -37,8 +37,16 @@ class WorkerInfo:
     port: int
 
 
+class _CleanEOF(ConnectionError):
+    """Peer closed BETWEEN messages (zero bytes at a message boundary) —
+    distinguishable from a tear mid-message, so a stale pooled
+    connection can be retried safely."""
+
+
 def _send_msg(sock, obj):
-    data = pickle.dumps(obj)
+    # protocol 5: numpy arrays serialize through the buffer protocol —
+    # the PS pull/push hot path is row matrices
+    data = pickle.dumps(obj, protocol=5)
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
@@ -47,29 +55,56 @@ def _recv_msg(sock):
     while len(hdr) < 8:
         c = sock.recv(8 - len(hdr))
         if not c:
-            raise ConnectionError("rpc peer closed")
+            raise (_CleanEOF if not hdr else ConnectionError)(
+                "rpc peer closed")
         hdr += c
     n = struct.unpack("<Q", hdr)[0]
-    buf = b""
-    while len(buf) < n:
-        c = sock.recv(min(1 << 20, n - len(buf)))
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(1 << 20, n - got))
         if not c:
             raise ConnectionError("rpc peer closed")
-        buf += c
-    return pickle.loads(buf)
+        chunks.append(c)
+        got += len(c)
+    return pickle.loads(b"".join(chunks))
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    """Serves a PERSISTENT connection: one request/response per loop
+    iteration until the peer closes (the reference's brpc keeps
+    long-lived channels; a fresh TCP handshake per pull/push was the
+    dominant wire cost — see tools/ps_bench.py)."""
+
     def handle(self):
         try:
-            fn, args, kwargs = _recv_msg(self.request)
+            self.request.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        while True:
+            try:
+                fn, args, kwargs = _recv_msg(self.request)
+            except ConnectionError:
+                return
             try:
                 result = ("ok", fn(*args, **kwargs))
             except Exception as e:  # ship the exception back
                 result = ("err", e)
-            _send_msg(self.request, result)
-        except ConnectionError:
-            pass
+            try:
+                _send_msg(self.request, result)
+            except ConnectionError:
+                return
+            except Exception as e:
+                # unpicklable result/exception: the request DID execute,
+                # so the connection must stay open with a response — a
+                # silent close would let the client's clean-EOF retry
+                # run it twice
+                try:
+                    _send_msg(self.request, ("err", RuntimeError(
+                        f"rpc result not serializable: {e!r}")))
+                except Exception:
+                    return
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -140,14 +175,107 @@ def wait_for_workers(names, timeout: float = 60.0):
         raise TimeoutError(f"rpc peers never registered: {missing}")
 
 
+_conn_local = threading.local()
+_all_conns: set = set()          # every pooled socket, across threads
+_all_conns_lock = threading.Lock()
+
+
+def _conn_cache() -> Dict[str, socket.socket]:
+    cache = getattr(_conn_local, "conns", None)
+    if cache is None:
+        cache = _conn_local.conns = {}
+    return cache
+
+
+def _dial(info, timeout) -> socket.socket:
+    s = socket.create_connection((info.ip, info.port),
+                                 timeout=timeout or None)
+    try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+    return s
+
+
+def _drop_conn(to: str):
+    s = _conn_cache().pop(to, None)
+    if s is not None:
+        with _all_conns_lock:
+            _all_conns.discard(s)
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _close_all_conns():
+    """Close EVERY pooled socket — including ones owned by other
+    threads (the rpc_async pool); their caches keep stale entries, but
+    the next _call on those threads fails-fast and re-dials."""
+    for to in list(_conn_cache()):
+        _drop_conn(to)
+    with _all_conns_lock:
+        conns = list(_all_conns)
+        _all_conns.clear()
+    for s in conns:
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
 def _call(to: str, fn, args, kwargs, timeout):
+    """Request/response over a pooled per-(thread, peer) persistent
+    connection. A STALE pooled connection (peer restarted between
+    calls: send fails, or clean EOF at the response boundary) is
+    re-dialed once; a tear mid-response is NOT retried — the request
+    may have executed, and pull/push must stay at-most-once.
+
+    PADDLE_TPU_RPC_ONESHOT=1: dial-per-call (the pre-pooling wire, kept
+    as the measurement A/B for tools/ps_bench.py)."""
     info = _state["workers"].get(to)
     if info is None:
         raise RuntimeError(f"unknown rpc worker {to!r}")
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout or None) as s:
-        _send_msg(s, (fn, args or (), kwargs or {}))
-        status, payload = _recv_msg(s)
+    oneshot = bool(os.environ.get("PADDLE_TPU_RPC_ONESHOT"))
+    cache = _conn_cache()
+    s = None
+    try:
+        for attempt in (0, 1):
+            if oneshot:
+                s, fresh = _dial(info, timeout), True
+            else:
+                s = cache.get(to)
+                fresh = s is None
+                if fresh:
+                    s = _dial(info, timeout)
+                    cache[to] = s
+                    with _all_conns_lock:
+                        _all_conns.add(s)
+            try:
+                s.settimeout(timeout or None)
+                _send_msg(s, (fn, args or (), kwargs or {}))
+            except (ConnectionError, OSError):
+                _drop_conn(to)
+                if fresh or attempt:
+                    raise
+                continue       # stale pooled conn: safe to re-dial
+            try:
+                status, payload = _recv_msg(s)
+                break
+            except _CleanEOF:
+                _drop_conn(to)
+                if fresh or attempt:
+                    raise
+                continue       # closed at the boundary: not executed
+            except (ConnectionError, OSError):
+                _drop_conn(to)
+                raise          # mid-response tear: may have executed
+    finally:
+        if oneshot and s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
     if status == "err":
         raise payload
     return payload
@@ -179,6 +307,7 @@ def get_all_worker_infos():
 
 def shutdown():
     """reference: rpc.py shutdown (barrier semantics relaxed: local)."""
+    _close_all_conns()
     if _state["server"] is not None:
         _state["server"].shutdown()
         _state["server"] = None

@@ -158,6 +158,74 @@ class TestSSDTable:
             np.testing.assert_allclose(g2, 0.0)
 
 
+def _unpicklable_result():
+    return lambda: None     # local lambdas don't pickle
+
+
+class TestRpcWire:
+    """Persistent-connection wire behavior (reference: the brpc
+    channel-keeping client, brpc_ps_client.h)."""
+
+    def test_connection_reused_across_calls(self, ps_env):
+        from paddle_tpu.distributed.rpc import rpc as rpc_core
+        import paddle_tpu.distributed.fleet.fleet as fl
+        rpc_core._close_all_conns()
+        for _ in range(5):
+            rpc_core.rpc_sync("server0", fl._srv_done_count)
+        # one pooled socket for the peer, not one per call
+        assert len(rpc_core._conn_cache()) == 1
+
+    def test_stale_pooled_connection_redials(self, ps_env):
+        """Server restarts between calls: the pooled socket is dead; the
+        next call must transparently re-dial the NEW endpoint."""
+        import socket as socklib
+        import threading
+        from paddle_tpu.distributed.rpc import rpc as rpc_core
+        import paddle_tpu.distributed.fleet.fleet as fl
+        rpc_core.rpc_sync("server0", fl._srv_done_count)   # pool a conn
+        assert len(rpc_core._conn_cache()) == 1
+        stale = rpc_core._conn_cache()["server0"]
+        # genuinely kill the old server: stop accepting, close the
+        # listener fd, AND tear the live handler connection
+        old = rpc_core._state["server"]
+        old.shutdown()
+        old.server_close()
+        stale.shutdown(socklib.SHUT_RDWR)   # handler sees EOF and exits
+        server = rpc_core._Server(("127.0.0.1", 0), rpc_core._Handler)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        rpc_core._state["server"] = server
+        info = rpc_core._state["workers"]["server0"]
+        rpc_core._state["workers"]["server0"] = rpc_core.WorkerInfo(
+            info.name, info.rank, info.ip, port)
+        # pooled conn is stale -> clean failure -> one re-dial, succeeds
+        assert rpc_core.rpc_sync("server0", fl._srv_done_count) >= 0
+        # and the pool now holds a NEW socket, not the stale one
+        assert rpc_core._conn_cache()["server0"] is not stale
+
+    def test_unpicklable_result_ships_error_not_retry(self, ps_env):
+        """A server fn whose result can't pickle must surface an error
+        WITHOUT killing the connection (a silent close would let the
+        clean-EOF retry execute the call twice)."""
+        import pytest
+        from paddle_tpu.distributed.rpc import rpc as rpc_core
+        import paddle_tpu.distributed.fleet.fleet as fl
+        with pytest.raises(RuntimeError, match="not serializable"):
+            rpc_core.rpc_sync("server0", _unpicklable_result)
+        # connection survived: next call reuses it
+        n = len(rpc_core._conn_cache())
+        rpc_core.rpc_sync("server0", fl._srv_done_count)
+        assert len(rpc_core._conn_cache()) == n
+
+    def test_oneshot_escape_hatch(self, ps_env, monkeypatch):
+        from paddle_tpu.distributed.rpc import rpc as rpc_core
+        import paddle_tpu.distributed.fleet.fleet as fl
+        monkeypatch.setenv("PADDLE_TPU_RPC_ONESHOT", "1")
+        rpc_core._close_all_conns()
+        rpc_core.rpc_sync("server0", fl._srv_done_count)
+        assert len(rpc_core._conn_cache()) == 0
+
+
 class TestCommunicators:
     """Async / geo trainer-side communicators (reference:
     paddle/fluid/distributed/ps/service/communicator/communicator.h,
