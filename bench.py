@@ -309,13 +309,18 @@ _LASTGOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def _record_last_good(parsed: dict) -> None:
     """Persist the freshest successful TPU measurement so a later dead-tunnel
-    failure JSON can still carry a (marked-stale) number."""
+    failure JSON can still carry a (marked-stale) number. Stamped with
+    capture time so the embed can state its age unambiguously."""
     try:
         dev = str(parsed.get("extra", {}).get("device", "")).lower()
         if "tpu" not in dev:
             return  # CPU smoke runs don't overwrite the TPU record
+        rec = dict(parsed)
+        rec["recorded_unix"] = time.time()
+        rec["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
         with open(_LASTGOOD, "w") as f:
-            json.dump(parsed, f)
+            json.dump(rec, f)
     except Exception:
         pass
 
@@ -432,7 +437,15 @@ def parent_main():
     }
     try:
         with open(_LASTGOOD) as f:
-            out["stale_last_good"] = {**json.load(f), "stale": True}
+            lg = json.load(f)
+        lg["stale"] = True
+        if lg.get("recorded_unix"):
+            age = time.time() - lg["recorded_unix"]
+            lg["age_seconds"] = round(age)
+            # a capture from the last few hours is this ROUND's own live
+            # measurement riding a tunnel window — say so explicitly
+            lg["same_round_live_capture"] = age < 6 * 3600
+        out["stale_last_good"] = lg
     except Exception:
         pass
     print(json.dumps(out))

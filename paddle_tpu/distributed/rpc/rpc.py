@@ -224,12 +224,34 @@ def _close_all_conns():
             pass
 
 
+def _peer_closed(s: socket.socket) -> bool:
+    """Non-blocking FIN probe on an idle pooled connection: a peer that
+    restarted between calls has closed its end, making the socket
+    readable with EOF. Request/response discipline means no data is
+    ever pending on an idle connection, so readable == dead (EOF or
+    RST). A zero-timeout select does the probe — MSG_DONTWAIT alone
+    would be defeated by CPython's readiness wait on blocking sockets."""
+    import select
+    try:
+        r, _, _ = select.select([s], [], [], 0)
+        if not r:
+            return False       # nothing pending — alive
+        return s.recv(1, socket.MSG_PEEK) == b""
+    except OSError:
+        return True
+
+
 def _call(to: str, fn, args, kwargs, timeout):
     """Request/response over a pooled per-(thread, peer) persistent
-    connection. A STALE pooled connection (peer restarted between
-    calls: send fails, or clean EOF at the response boundary) is
-    re-dialed once; a tear mid-response is NOT retried — the request
-    may have executed, and pull/push must stay at-most-once.
+    connection, strictly at-most-once:
+
+    - staleness (peer restarted between calls) is detected BEFORE the
+      send — a FIN probe on the idle socket — and re-dialed, so no
+      retry ever races an executed request;
+    - a send failure re-dials once (a partially-sent request tears the
+      server's message decode before the function runs);
+    - any failure AFTER the request is fully sent raises — the response
+      is lost and the request may have executed.
 
     PADDLE_TPU_RPC_ONESHOT=1: dial-per-call (the pre-pooling wire, kept
     as the measurement A/B for tools/ps_bench.py)."""
@@ -245,6 +267,9 @@ def _call(to: str, fn, args, kwargs, timeout):
                 s, fresh = _dial(info, timeout), True
             else:
                 s = cache.get(to)
+                if s is not None and _peer_closed(s):
+                    _drop_conn(to)
+                    s = None
                 fresh = s is None
                 if fresh:
                     s = _dial(info, timeout)
@@ -255,21 +280,20 @@ def _call(to: str, fn, args, kwargs, timeout):
                 s.settimeout(timeout or None)
                 _send_msg(s, (fn, args or (), kwargs or {}))
             except (ConnectionError, OSError):
-                _drop_conn(to)
+                if not oneshot:
+                    _drop_conn(to)
                 if fresh or attempt:
                     raise
-                continue       # stale pooled conn: safe to re-dial
+                continue       # partial send: server cannot have run it
             try:
                 status, payload = _recv_msg(s)
                 break
-            except _CleanEOF:
-                _drop_conn(to)
-                if fresh or attempt:
-                    raise
-                continue       # closed at the boundary: not executed
-            except (ConnectionError, OSError):
-                _drop_conn(to)
-                raise          # mid-response tear: may have executed
+            except (ConnectionError, OSError) as e:
+                if not oneshot:
+                    _drop_conn(to)
+                raise ConnectionError(
+                    f"rpc response from {to!r} lost ({e}); the request "
+                    f"may have executed — not retrying") from e
     finally:
         if oneshot and s is not None:
             try:

@@ -1,10 +1,30 @@
 #!/bin/bash
-# Poll the TPU tunnel; the moment it's healthy, run bench.py and record the
-# result. Keeps BENCH_LASTGOOD.json fresh so a later dead-tunnel driver run
-# still carries a recent (marked-stale) number. Exits after first success.
+# Poll the TPU tunnel; whenever it's healthy AND the last-good capture is
+# older than REFRESH_S, run bench.py and record the result. Keeps
+# BENCH_LASTGOOD.json fresh to end-of-round so a dead-tunnel driver run
+# still carries a recent timestamped number (VERDICT r3 weak #1/#10);
+# the refresh interval keeps the chip mostly idle for the driver's own
+# end-of-round bench.
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_watch.log}
+REFRESH_S=${REFRESH_S:-10800}   # re-bench at most every 3h
+EXTRAS_DONE=0
 while true; do
+  # skip entirely while the record is fresh
+  if python - <<EOF
+import json, os, sys, time
+try:
+    with open("BENCH_LASTGOOD.json") as f:
+        lg = json.load(f)
+    fresh = time.time() - lg.get("recorded_unix", 0) < $REFRESH_S
+except Exception:
+    fresh = False
+sys.exit(0 if fresh else 1)
+EOF
+  then
+    sleep 240
+    continue
+  fi
   if timeout 90 python -c "import jax, os, sys; d = jax.devices(); assert d[0].platform == 'tpu'; print('PROBE_OK', d[0].device_kind); sys.stdout.flush(); os._exit(0)" >>"$LOG" 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel up — running bench" >>"$LOG"
     # outer timeout must exceed bench.py's own worst case (probe schedule
@@ -22,12 +42,19 @@ except Exception:
     sys.exit(1)
 EOF
     then
-      echo "$(date -u +%FT%TZ) bench captured; running perf sweep" >>"$LOG"
-      timeout 3000 python tools/perf_sweep.py >/tmp/perf_sweep.out 2>&1
-      echo "$(date -u +%FT%TZ) perf sweep done (rc=$?)" >>"$LOG"
-      timeout 1500 python tools/step_profile.py >/tmp/step_profile.out 2>&1
-      echo "$(date -u +%FT%TZ) step profile done (rc=$?)" >>"$LOG"
-      exit 0
+      if [ "$EXTRAS_DONE" = "0" ]; then
+        echo "$(date -u +%FT%TZ) bench captured; running perf sweep" >>"$LOG"
+        timeout 3000 python tools/perf_sweep.py >/tmp/perf_sweep.out 2>&1
+        echo "$(date -u +%FT%TZ) perf sweep done (rc=$?)" >>"$LOG"
+        timeout 1500 python tools/step_profile.py >/tmp/step_profile.out 2>&1
+        echo "$(date -u +%FT%TZ) step profile done (rc=$?)" >>"$LOG"
+        timeout 1500 python tools/flash_bench.py >/tmp/flash_bench.out 2>&1
+        echo "$(date -u +%FT%TZ) flash bench done (rc=$?)" >>"$LOG"
+        EXTRAS_DONE=1
+      else
+        echo "$(date -u +%FT%TZ) bench refreshed (extras already ran)" >>"$LOG"
+      fi
+      # stay armed: the loop re-benches when the record ages past REFRESH_S
     else
       echo "$(date -u +%FT%TZ) bench failed despite probe ok; retrying later" >>"$LOG"
     fi
