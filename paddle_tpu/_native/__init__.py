@@ -32,16 +32,43 @@ def _src_hash() -> str:
     return h.hexdigest()[:16]
 
 
-def _build() -> str:
-    out = os.path.join(_DIR, f"libpaddle_tpu_native_{_src_hash()}.so")
+def _compile(srcs, out, extra_flags=()) -> str:
+    """Compile-and-cache: skip when the hashed artifact exists; build to
+    a pid-unique temp so concurrent builders (pytest-xdist, two services
+    cold-starting) can't interleave output, then atomically publish."""
     if os.path.exists(out):
         return out
-    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    tmp = f"{out}.tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *srcs, "-o", out + ".tmp"]
+           *srcs, "-o", tmp, *extra_flags]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(out + ".tmp", out)
+    os.replace(tmp, out)
     return out
+
+
+def _build() -> str:
+    return _compile(
+        [os.path.join(_DIR, s) for s in _SOURCES],
+        os.path.join(_DIR, f"libpaddle_tpu_native_{_src_hash()}.so"))
+
+
+def build_capi() -> str:
+    """Build (cached) the C serving ABI shared library
+    (pd_inference.cpp — reference capi_exp/pd_inference_api.h). Linked
+    against libpython: the shim embeds an interpreter that drives the
+    XLA predictor; non-Python services link only this library."""
+    import sysconfig
+    src = os.path.join(_DIR, "pd_inference.cpp")
+    with open(src, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:16]
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = (sysconfig.get_config_var("LDVERSION")
+           or sysconfig.get_config_var("VERSION"))
+    return _compile(
+        [src], os.path.join(_DIR, f"libpaddle_tpu_capi_{h}.so"),
+        extra_flags=[f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+                     f"-Wl,-rpath,{libdir}"])
 
 
 def load():

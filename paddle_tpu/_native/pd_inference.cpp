@@ -1,0 +1,439 @@
+// C serving ABI (reference: paddle/fluid/inference/capi_exp/pd_inference_api.h
+// PD_ConfigCreate/PD_PredictorCreate/PD_PredictorRun/PD_Tensor*, consumed by
+// the Go bindings paddle/fluid/inference/goapi/predictor.go).
+//
+// TPU-native design: the compute path IS XLA — a saved artifact's fast path
+// is a StableHLO program executed by the XLA runtime. This shim embeds a
+// CPython interpreter that drives the existing predictor stack
+// (paddle_tpu.inference.create_predictor), so a non-Python service links ONE
+// shared library, calls the same PD_* surface the reference exposes, and the
+// heavy lifting still happens inside compiled XLA programs — the interpreter
+// only orchestrates (the reference's C API similarly marshals into its C++
+// AnalysisPredictor; here the "C++ engine" is XLA itself).
+//
+// Threading: every entry point takes the GIL via PyGILState; PD_Init
+// releases the GIL after bootstrap so callers may invoke from any thread.
+// Errors: returns 0/NULL and records a message for PD_GetLastError().
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> l(g_err_mu);
+  g_last_error = msg;
+}
+
+// capture the pending Python exception into g_last_error
+void capture_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = std::string(where) + ": ";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);
+      msg += u ? u : "<error text not utf-8 representable>";
+      Py_DECREF(s);
+    }
+  } else {
+    msg += "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+struct CConfig {
+  std::string model_dir;
+};
+
+struct CTensor;
+
+struct CPredictor {
+  PyObject* pred = nullptr;                  // paddle predictor object
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+  std::vector<CTensor*> tensors;             // owned handles
+};
+
+struct CTensor {
+  CPredictor* owner = nullptr;
+  std::string name;
+  bool is_input = false;
+  PyObject* handle = nullptr;                // python Tensor handle
+  PyObject* last_out = nullptr;              // cached output ndarray
+  std::vector<int64_t> shape;
+};
+
+bool g_we_initialized = false;
+
+std::vector<std::string> names_from_list(PyObject* list) {
+  std::vector<std::string> out;
+  if (!list) return out;
+  Py_ssize_t n = PySequence_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_GetItem(list, i);
+    if (item) {
+      const char* s = PyUnicode_AsUTF8(item);
+      if (s) out.emplace_back(s);
+      Py_DECREF(item);
+    }
+  }
+  return out;
+}
+
+// np.frombuffer(memoryview, dtype).reshape(shape).copy()
+PyObject* ndarray_from(const void* data, size_t nbytes, const char* dtype,
+                       const std::vector<int64_t>& shape) {
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) return nullptr;
+  PyObject* mem = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+  PyObject* arr = mem ? PyObject_CallMethod(np, "frombuffer", "Os", mem,
+                                            dtype)
+                      : nullptr;
+  Py_XDECREF(mem);
+  PyObject* shaped = nullptr;
+  if (arr) {
+    PyObject* tup = PyTuple_New(shape.size());
+    for (size_t i = 0; i < shape.size(); ++i)
+      PyTuple_SetItem(tup, i, PyLong_FromLongLong(shape[i]));
+    PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "O", tup);
+    Py_DECREF(tup);
+    if (reshaped) {
+      shaped = PyObject_CallMethod(reshaped, "copy", nullptr);
+      Py_DECREF(reshaped);
+    }
+    Py_DECREF(arr);
+  }
+  Py_DECREF(np);
+  return shaped;   // may be null with error set
+}
+
+bool copy_from_cpu(CTensor* t, const void* data, const char* dtype,
+                   size_t elem) {
+  Gil g;
+  size_t count = 1;
+  for (int64_t d : t->shape) count *= static_cast<size_t>(d);
+  PyObject* arr = ndarray_from(data, count * elem, dtype, t->shape);
+  if (!arr) {
+    capture_py_error("PD_TensorCopyFromCpu");
+    return false;
+  }
+  PyObject* r = PyObject_CallMethod(t->handle, "copy_from_cpu", "O", arr);
+  Py_DECREF(arr);
+  if (!r) {
+    capture_py_error("PD_TensorCopyFromCpu");
+    return false;
+  }
+  Py_DECREF(r);
+  return true;
+}
+
+// fetch + cache the output ndarray (astype(dtype), C-contiguous).
+// The python Predictor REBUILDS its output Tensor objects on every
+// run(), so the handle is re-resolved by name here — a C handle held
+// across runs must always read the CURRENT run's values.
+bool fetch_output(CTensor* t, const char* dtype);
+
+bool fetch_output_impl(CTensor* t, const char* dtype, PyObject* pred) {
+  PyObject* h = PyObject_CallMethod(pred, "get_output_handle", "s",
+                                    t->name.c_str());
+  if (!h) {
+    capture_py_error("PD_TensorCopyToCpu(handle)");
+    return false;
+  }
+  PyObject* arr = PyObject_CallMethod(h, "copy_to_cpu", nullptr);
+  Py_DECREF(h);
+  if (!arr) {
+    capture_py_error("PD_TensorCopyToCpu");
+    return false;
+  }
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* conv =
+      np ? PyObject_CallMethod(np, "ascontiguousarray", "Os", arr, dtype)
+         : nullptr;
+  Py_XDECREF(np);
+  Py_DECREF(arr);
+  if (!conv) {
+    capture_py_error("PD_TensorCopyToCpu");
+    return false;
+  }
+  Py_XDECREF(t->last_out);
+  t->last_out = conv;
+  return true;
+}
+
+bool fetch_output(CTensor* t, const char* dtype) {
+  return fetch_output_impl(t, dtype, t->owner->pred);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- lifecycle ----
+
+// Initialize the embedded runtime. repo_root (may be NULL) is prepended to
+// sys.path so an installed-by-checkout paddle_tpu resolves. Safe to call
+// when the host process is already a Python interpreter (the test harness):
+// then nothing is initialized and teardown is a no-op.
+int PD_Init(const char* repo_root) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  {
+    Gil g;
+    if (repo_root && *repo_root) {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      PyObject* p = PyUnicode_FromString(repo_root);
+      if (sys_path && p) PyList_Insert(sys_path, 0, p);
+      Py_XDECREF(p);
+    }
+  }
+  if (g_we_initialized) {
+    // release the GIL the bootstrap holds so any thread can call PD_*
+    static PyThreadState* main_state = nullptr;
+    if (!main_state) main_state = PyEval_SaveThread();
+  }
+  return 1;
+}
+
+void PD_Finalize() {
+  // Embedded XLA runtimes do not tear down cleanly (the same reason
+  // __graft_entry__ exits via os._exit); leave the interpreter alive and
+  // let process exit reclaim everything, matching the reference's
+  // process-lifetime predictor pools.
+}
+
+const char* PD_GetLastError() {
+  // a per-thread copy: the returned pointer must survive a concurrent
+  // set_error reallocating the shared string
+  static thread_local std::string tl;
+  {
+    std::lock_guard<std::mutex> l(g_err_mu);
+    tl = g_last_error;
+  }
+  return tl.c_str();
+}
+
+// ---- config ----
+
+void* PD_ConfigCreate() { return new CConfig(); }
+
+void PD_ConfigDestroy(void* cfg) { delete static_cast<CConfig*>(cfg); }
+
+void PD_ConfigSetModelDir(void* cfg, const char* dir) {
+  static_cast<CConfig*>(cfg)->model_dir = dir ? dir : "";
+}
+
+// ---- predictor ----
+
+void* PD_PredictorCreate(void* cfg_v) {
+  auto* cfg = static_cast<CConfig*>(cfg_v);
+  Gil g;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    capture_py_error("PD_PredictorCreate(import)");
+    return nullptr;
+  }
+  PyObject* pycfg = PyObject_CallMethod(mod, "Config", "s",
+                                        cfg->model_dir.c_str());
+  PyObject* pred =
+      pycfg ? PyObject_CallMethod(mod, "create_predictor", "O", pycfg)
+            : nullptr;
+  Py_XDECREF(pycfg);
+  Py_DECREF(mod);
+  if (!pred) {
+    capture_py_error("PD_PredictorCreate");
+    return nullptr;
+  }
+  auto* p = new CPredictor();
+  p->pred = pred;
+  PyObject* in = PyObject_CallMethod(pred, "get_input_names", nullptr);
+  p->input_names = names_from_list(in);
+  Py_XDECREF(in);
+  PyErr_Clear();
+  return p;
+}
+
+void PD_PredictorDestroy(void* pred_v) {
+  auto* p = static_cast<CPredictor*>(pred_v);
+  if (!p) return;
+  Gil g;
+  for (CTensor* t : p->tensors) {
+    Py_XDECREF(t->handle);
+    Py_XDECREF(t->last_out);
+    delete t;
+  }
+  Py_XDECREF(p->pred);
+  delete p;
+}
+
+size_t PD_PredictorGetInputNum(void* pred_v) {
+  return static_cast<CPredictor*>(pred_v)->input_names.size();
+}
+
+const char* PD_PredictorGetInputName(void* pred_v, size_t i) {
+  auto* p = static_cast<CPredictor*>(pred_v);
+  return i < p->input_names.size() ? p->input_names[i].c_str() : "";
+}
+
+size_t PD_PredictorGetOutputNum(void* pred_v) {
+  return static_cast<CPredictor*>(pred_v)->output_names.size();
+}
+
+const char* PD_PredictorGetOutputName(void* pred_v, size_t i) {
+  auto* p = static_cast<CPredictor*>(pred_v);
+  return i < p->output_names.size() ? p->output_names[i].c_str() : "";
+}
+
+static void* get_handle(CPredictor* p, const char* name, bool input) {
+  // one CTensor per (name, direction): serving loops re-fetch handles
+  // every iteration and must not grow the handle table unboundedly
+  for (CTensor* t : p->tensors) {
+    if (t->is_input == input && t->name == name) return t;
+  }
+  Gil g;
+  auto* t = new CTensor();
+  t->owner = p;
+  t->name = name;
+  t->is_input = input;
+  if (input) {
+    t->handle = PyObject_CallMethod(p->pred, "get_input_handle", "s",
+                                    name);
+    if (!t->handle) {
+      capture_py_error("PD_PredictorGetInputHandle");
+      delete t;
+      return nullptr;
+    }
+  }
+  // outputs: no cached python handle — the predictor rebuilds output
+  // tensors on every run, so they resolve by name at read time
+  p->tensors.push_back(t);
+  return t;
+}
+
+void* PD_PredictorGetInputHandle(void* pred_v, const char* name) {
+  return get_handle(static_cast<CPredictor*>(pred_v), name, true);
+}
+
+void* PD_PredictorGetOutputHandle(void* pred_v, const char* name) {
+  return get_handle(static_cast<CPredictor*>(pred_v), name, false);
+}
+
+int PD_PredictorRun(void* pred_v) {
+  auto* p = static_cast<CPredictor*>(pred_v);
+  Gil g;
+  PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
+  if (!r) {
+    capture_py_error("PD_PredictorRun");
+    return 0;
+  }
+  Py_DECREF(r);
+  PyObject* out = PyObject_CallMethod(p->pred, "get_output_names", nullptr);
+  p->output_names = names_from_list(out);
+  Py_XDECREF(out);
+  PyErr_Clear();
+  return 1;
+}
+
+// ---- tensors ----
+
+void PD_TensorReshape(void* t_v, int ndim, const int64_t* shape) {
+  auto* t = static_cast<CTensor*>(t_v);
+  t->shape.assign(shape, shape + ndim);
+}
+
+int PD_TensorCopyFromCpuFloat(void* t_v, const float* data) {
+  return copy_from_cpu(static_cast<CTensor*>(t_v), data, "float32", 4);
+}
+
+int PD_TensorCopyFromCpuInt32(void* t_v, const int32_t* data) {
+  return copy_from_cpu(static_cast<CTensor*>(t_v), data, "int32", 4);
+}
+
+int PD_TensorCopyFromCpuInt64(void* t_v, const int64_t* data) {
+  return copy_from_cpu(static_cast<CTensor*>(t_v), data, "int64", 8);
+}
+
+// ndim via return; shape written into caller buffer (cap entries).
+// Inputs report the staged PD_TensorReshape shape (the inference
+// Tensor's python `shape` is a method, not an attribute); outputs
+// report the CURRENT run's ndarray shape.
+int PD_TensorGetShape(void* t_v, int64_t* shape, int cap) {
+  auto* t = static_cast<CTensor*>(t_v);
+  if (t->is_input) {
+    int n = static_cast<int>(t->shape.size());
+    for (int i = 0; i < n && i < cap; ++i) shape[i] = t->shape[i];
+    return n;
+  }
+  Gil g;
+  if (!fetch_output(t, "float32")) return -1;
+  PyObject* shp = PyObject_GetAttrString(t->last_out, "shape");
+  if (!shp) {
+    capture_py_error("PD_TensorGetShape");
+    return -1;
+  }
+  Py_ssize_t n = PySequence_Size(shp);
+  for (Py_ssize_t i = 0; i < n && i < cap; ++i) {
+    PyObject* d = PySequence_GetItem(shp, i);
+    shape[i] = d ? PyLong_AsLongLong(d) : -1;
+    Py_XDECREF(d);
+  }
+  Py_DECREF(shp);
+  PyErr_Clear();
+  return static_cast<int>(n);
+}
+
+static int copy_to_cpu(CTensor* t, void* out, const char* dtype,
+                       size_t elem) {
+  Gil g;
+  if (!fetch_output(t, dtype)) return 0;
+  PyObject* b = PyObject_CallMethod(t->last_out, "tobytes", nullptr);
+  if (!b) {
+    capture_py_error("PD_TensorCopyToCpu");
+    return 0;
+  }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(b, &buf, &n) == 0) {
+    std::memcpy(out, buf, static_cast<size_t>(n));
+  }
+  Py_DECREF(b);
+  (void)elem;
+  return 1;
+}
+
+int PD_TensorCopyToCpuFloat(void* t_v, float* out) {
+  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "float32", 4);
+}
+
+int PD_TensorCopyToCpuInt32(void* t_v, int32_t* out) {
+  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "int32", 4);
+}
+
+int PD_TensorCopyToCpuInt64(void* t_v, int64_t* out) {
+  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "int64", 8);
+}
+
+}  // extern "C"
