@@ -241,8 +241,14 @@ class ExecutionStrategy:
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..autograd.functional import grad
+    # Static-record mode: run the backward through the create_graph
+    # engine, whose VJPs are RECORDED apply ops that rebuild jax.vjp
+    # from current values at execution (_core.autograd._node_vjp_graph).
+    # The replay then recomputes gradients against FED values — the
+    # reference's grad-block re-execution. Plain eager keeps the cheap
+    # one-shot vjp closures.
     return grad(targets, inputs, grad_outputs=target_gradients,
-                allow_unused=True)
+                allow_unused=True, create_graph=in_static_mode())
 
 
 # re-exports for static-style model code

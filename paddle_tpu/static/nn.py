@@ -96,14 +96,89 @@ def cond(pred, true_fn: Optional[Callable] = None,
                     lambda _: _call_nograd(false_fn), None)
 
 
+def _bounded_while_raw(cond_fn, body_fn, n):
+    """Reverse-differentiable while: lax.scan over ``n`` steps with an
+    active mask (lax.while_loop has no reverse rule; scan does). The
+    body runs all ``n`` steps — finished iterations select the old
+    carry — so the body must be pure and shape-stable, and a loop whose
+    condition is still true after ``n`` steps is truncated (the bounded
+    XLA While contract)."""
+    def run(*vals):
+        def step(carry, _):
+            vs, active = carry
+            ts = tuple(Tensor(v, _internal=True) for v in vs)
+            with ag.no_grad():
+                # no python tape inside the scan: jax differentiates the
+                # traced program itself (same contract as the other
+                # compiled control-flow paths)
+                pred = jnp.logical_and(
+                    active, _scalar(cond_fn(*ts)).astype(bool))
+                out = body_fn(*ts)
+            out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            new = tuple(o._value if isinstance(o, Tensor)
+                        else jnp.asarray(o) for o in out)
+            sel = tuple(jnp.where(pred, nv, ov)
+                        for nv, ov in zip(new, vs))
+            return (sel, pred), None
+        (vs, _), _ = lax.scan(step, (tuple(vals), jnp.asarray(True)),
+                              None, length=int(n))
+        return vs
+    return run
+
+
+def _harvest_grad_captures(body_fn, loop_vars):
+    """Differentiable PRE-EXISTING tensors the body directly reads (loop
+    vars and closure captures alike, leaf or derived) — the reference
+    While grad block's external-variable grads. Discovered by running
+    the body once at build with an op-observer hook collecting every
+    Tensor operand not itself created during the probe; they become
+    explicit inputs of the recorded op so the VJP and the fed replay
+    both see them. (A tape-leaf walk would miss DERIVED captures like
+    ``w = a * 3`` read in the body: the body reads w's value, not
+    a's.)"""
+    from .._core import autograd as _ag
+    hook = _ag._static_hook[0]
+    reads, rids, created = [], set(), set()
+
+    def collector(fn, args, outs):
+        for a in args:
+            if isinstance(a, Tensor) and id(a) not in created and \
+                    id(a) not in rids and not a.stop_gradient and \
+                    jnp.issubdtype(jnp.result_type(a._value),
+                                   jnp.floating):
+                rids.add(id(a))
+                reads.append(a)
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        for o in outs_t:
+            if isinstance(o, Tensor):
+                created.add(id(o))
+
+    _ag.set_static_hook(collector)   # probe ops are not program ops
+    try:
+        body_fn(*loop_vars)
+    finally:
+        _ag.set_static_hook(hook)
+    return reads
+
+
 def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
-               is_test: bool = False, name=None):
+               is_test: bool = False, name=None,
+               maximum_trip_count: Optional[int] = None):
     """reference: python/paddle/static/nn/control_flow.py:1383 while_loop.
 
     ``body_fn`` must return loop vars with unchanged shapes/dtypes (XLA
     static-shape requirement — same contract as the reference's While op,
-    whose block also fixes var shapes)."""
+    whose block also fixes var shapes).
+
+    ``maximum_trip_count`` (TPU-native extension): bounds the loop at N
+    iterations and lowers it to a masked ``lax.scan``, which HAS a
+    reverse-mode rule — gradients then flow through the loop in static
+    mode (with FED trip counts, the reference While + append_backward
+    capability) and under jit tracing, where the unbounded
+    ``lax.while_loop`` is forward-only. A loop still live after N steps
+    is truncated."""
     loop_vars = list(loop_vars)
+    bounded = maximum_trip_count is not None
 
     def c(vs):
         with ag.no_grad():
@@ -123,15 +198,26 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
             isinstance(v, Tensor) and not v.stop_gradient
             and jnp.issubdtype(jnp.result_type(v._value), jnp.floating)
             for v in loop_vars)
-        if in_static_mode() and not needs_grad:
+        captures = []
+        if in_static_mode() and bounded and ag.is_grad_enabled():
+            # grads may also enter purely through closure captures (all
+            # loop vars non-differentiable) — harvest decides
+            captures = _harvest_grad_captures(body_fn, loop_vars)
+            needs_grad = needs_grad or bool(captures)
+        if in_static_mode() and (not needs_grad or bounded):
             # static-record mode: the trip count must come from the FED
             # values, not the build values — record the whole loop as ONE
             # op whose body is a lax.while_loop (the reference's While op
-            # with its sub-block). Replay re-executes it. Forward-only:
-            # differentiable loop vars keep the taped eager-unroll path
-            # below (reverse-mode through a dynamic lax.while_loop has no
-            # rule; the reference's While grad comes from its own grad
-            # block).
+            # with its sub-block). Replay re-executes it. Differentiable
+            # loop vars: with maximum_trip_count the body is a masked
+            # scan and the recorded op carries a VJP (the reference's
+            # While grad block); unbounded, they keep the taped
+            # eager-unroll path below (reverse-mode through a dynamic
+            # lax.while_loop has no rule).
+            n_lv = len(loop_vars)
+            if not needs_grad:
+                captures = []
+
             def f(*vals):
                 # suspend the recorder inside the sub-trace (the loop's
                 # interior ops belong to the while op's body, not the
@@ -142,6 +228,13 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
                 from .._core import tensor as _tc
                 hook = _ag._static_hook[0]
                 ip_hook = _tc._inplace_hook[0]
+                lv_vals, cap_vals = vals[:n_lv], vals[n_lv:]
+                # closure captures read THROUGH the tensor objects: swap
+                # the op-input values in for the body's duration so the
+                # vjp trace (and the fed replay) sees them as inputs
+                cap_swap = [(t, t._value) for t in captures]
+                for (t, _), v in zip(cap_swap, cap_vals):
+                    t._value = v
 
                 def guard(alias, src_tensor, new_value, old_value=None):
                     old = old_value if old_value is not None else \
@@ -172,26 +265,72 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
                     return tuple(out) if isinstance(out, (tuple, list)) \
                         else (out,)
                 try:
-                    ts = tuple(Tensor(v, _internal=True) for v in vals)
-                    outs = lax.while_loop(c_, b_, ts)
+                    if bounded and needs_grad:
+                        # masked scan: full N steps, but reverse-
+                        # differentiable — the grad-carrying lowering
+                        raw = tuple(v._value if isinstance(v, Tensor)
+                                    else jnp.asarray(v)
+                                    for v in lv_vals)
+                        outs = _bounded_while_raw(
+                            cond_fn, body_fn, maximum_trip_count)(*raw)
+                    elif bounded:
+                        # forward-only: keep the early-exiting while —
+                        # a fed trip count of 3 must not execute an
+                        # N=10000 bound — with the cap in the condition
+                        ts = tuple(Tensor(v, _internal=True)
+                                   for v in lv_vals)
+
+                        def c_cap(carry):
+                            return jnp.logical_and(
+                                c_(carry[:-1]),
+                                carry[-1] < maximum_trip_count)
+
+                        def b_cap(carry):
+                            return b_(carry[:-1]) + (carry[-1] + 1,)
+                        outs = lax.while_loop(
+                            c_cap, b_cap,
+                            ts + (jnp.asarray(0, jnp.int32),))[:-1]
+                    else:
+                        ts = tuple(Tensor(v, _internal=True)
+                                   for v in lv_vals)
+                        outs = lax.while_loop(c_, b_, ts)
                 finally:
                     _ag.set_static_hook(hook)
                     _tc.set_inplace_hook(ip_hook)
+                    for t, old in cap_swap:
+                        t._value = old
                 return tuple(t._value if isinstance(t, Tensor) else t
                              for t in outs)
+            import contextlib
             from .._core.autograd import apply as _apply
-            with ag.no_grad():
+            grad_ctx = contextlib.nullcontext() if (needs_grad and
+                                                    bounded) \
+                else ag.no_grad()
+            with grad_ctx:
                 outs = _apply(f, *[v if isinstance(v, Tensor) else
                                    Tensor(jnp.asarray(v), _internal=True)
                                    for v in loop_vars],
+                              *captures,
                               name="while_loop", multi_out=True)
             return list(outs if isinstance(outs, tuple) else (outs,))
+        trips = 0
         while bool(_scalar(cond_fn(*loop_vars))):
+            if bounded and trips >= maximum_trip_count:
+                break    # the bounded contract: truncate, like the scan
             out = body_fn(*loop_vars)
             loop_vars = list(out) if isinstance(out, (tuple, list)) \
                 else [out]
+            trips += 1
         return loop_vars
 
+    if bounded:
+        # traced + bounded: the masked scan keeps the loop differentiable
+        # under jit (lax.while_loop below is forward-only)
+        raw = tuple(v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    for v in loop_vars)
+        outs = _bounded_while_raw(cond_fn, body_fn,
+                                  maximum_trip_count)(*raw)
+        return [Tensor(o, _internal=True) for o in outs]
     return list(lax.while_loop(c, b, tuple(loop_vars)))
 
 

@@ -5,9 +5,10 @@ bf16, causal) across block tilings — much cheaper than full-step sweeps
 (one kernel pair per config instead of a 20-layer model). Run on a live
 chip:  python tools/flash_bench.py [--configs bq,bk,bqb,bkb ...]
 """
+import json
+import os
 import sys
 import time
-import json
 
 import numpy as np
 import jax
@@ -16,7 +17,10 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 from paddle_tpu.ops.pallas import flash_attention as fa  # noqa: E402
 
-B, H, S, D = 4, 12, 4096, 128
+if os.environ.get("PADDLE_TPU_FLASH_SMOKE"):
+    B, H, S, D = 1, 2, 256, 64          # CPU interpret-mode smoke
+else:
+    B, H, S, D = 4, 12, 4096, 128
 
 CONFIGS = [
     (512, 1024, None, None),     # current default (round-2 retune)
@@ -91,6 +95,61 @@ def main():
             "fwd_bwd_tflops": round(3.5 * fwd_flops / t_all / 1e12, 1),
         }))
         sys.stdout.flush()
+
+    _bench_canonical(q, k, v, fwd_flops)
+
+
+def _bench_canonical(q, k, v, fwd_flops):
+    """Also time jax.experimental.pallas.ops.tpu.flash_attention — the
+    canonical TPU kernel, same two-pass bwd decomposition as ours. If it
+    beats our kernel on hardware, its block parameters (BlockSizes) are
+    the tuning target to adopt."""
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention as jfa
+    except Exception as e:
+        print(f"canonical kernel unavailable: {e}")
+        return
+    # their layout is (B, H, S, D)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    def fwd_fn(q, k, v):
+        return jfa.flash_attention(q, k, v, causal=True)
+
+    def loss_fn(q, k, v):
+        return fwd_fn(q, k, v).astype(jnp.float32).sum()
+
+    jf = jax.jit(fwd_fn)
+    jg = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
+
+    def fence(x):
+        return float(jnp.sum(x[0, 0].astype(jnp.float32)))
+
+    try:
+        fence(jf(qt, kt, vt))
+        t0 = time.perf_counter()
+        for _ in range(8):
+            out = jf(qt, kt, vt)
+        fence(out)
+        t_fwd = (time.perf_counter() - t0) / 8
+        fence(jg(qt, kt, vt)[0])
+        t0 = time.perf_counter()
+        for _ in range(8):
+            g = jg(qt, kt, vt)
+        fence(g[0])
+        t_all = (time.perf_counter() - t0) / 8
+    except Exception as e:  # noqa: BLE001
+        print(f"canonical kernel FAIL {type(e).__name__}: {str(e)[:160]}")
+        return
+    print("FLASH_BENCH " + json.dumps({
+        "cfg": "jax-pallas-ops-canonical",
+        "fwd_ms": round(t_fwd * 1e3, 2),
+        "fwd_bwd_ms": round(t_all * 1e3, 2),
+        "fwd_tflops": round(fwd_flops / t_fwd / 1e12, 1),
+        "fwd_bwd_tflops": round(3.5 * fwd_flops / t_all / 1e12, 1),
+    }))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":
