@@ -72,6 +72,7 @@ struct CPredictor {
   std::vector<std::string> input_names;
   std::vector<std::string> output_names;
   std::vector<CTensor*> tensors;             // owned handles
+  uint64_t run_id = 0;                       // bumps on every Run
 };
 
 struct CTensor {
@@ -80,6 +81,8 @@ struct CTensor {
   bool is_input = false;
   PyObject* handle = nullptr;                // python Tensor handle
   PyObject* last_out = nullptr;              // cached output ndarray
+  uint64_t fetched_run = 0;                  // run_id last_out belongs to
+  std::string fetched_dtype;
   std::vector<int64_t> shape;
 };
 
@@ -155,6 +158,12 @@ bool copy_from_cpu(CTensor* t, const void* data, const char* dtype,
 bool fetch_output(CTensor* t, const char* dtype);
 
 bool fetch_output_impl(CTensor* t, const char* dtype, PyObject* pred) {
+  // per-run cache: GetShape then CopyToCpu must not transfer the output
+  // from the device twice for the same run
+  if (t->last_out && t->fetched_run == t->owner->run_id &&
+      t->fetched_dtype == dtype) {
+    return true;
+  }
   PyObject* h = PyObject_CallMethod(pred, "get_output_handle", "s",
                                     t->name.c_str());
   if (!h) {
@@ -179,6 +188,8 @@ bool fetch_output_impl(CTensor* t, const char* dtype, PyObject* pred) {
   }
   Py_XDECREF(t->last_out);
   t->last_out = conv;
+  t->fetched_run = t->owner->run_id;
+  t->fetched_dtype = dtype;
   return true;
 }
 
@@ -290,30 +301,36 @@ void PD_PredictorDestroy(void* pred_v) {
 }
 
 size_t PD_PredictorGetInputNum(void* pred_v) {
+  Gil g;   // serialize against concurrent mutation (any-thread contract)
   return static_cast<CPredictor*>(pred_v)->input_names.size();
 }
 
 const char* PD_PredictorGetInputName(void* pred_v, size_t i) {
+  Gil g;
   auto* p = static_cast<CPredictor*>(pred_v);
   return i < p->input_names.size() ? p->input_names[i].c_str() : "";
 }
 
 size_t PD_PredictorGetOutputNum(void* pred_v) {
+  Gil g;   // PD_PredictorRun rewrites output_names under the GIL
   return static_cast<CPredictor*>(pred_v)->output_names.size();
 }
 
 const char* PD_PredictorGetOutputName(void* pred_v, size_t i) {
+  Gil g;
   auto* p = static_cast<CPredictor*>(pred_v);
   return i < p->output_names.size() ? p->output_names[i].c_str() : "";
 }
 
 static void* get_handle(CPredictor* p, const char* name, bool input) {
   // one CTensor per (name, direction): serving loops re-fetch handles
-  // every iteration and must not grow the handle table unboundedly
+  // every iteration and must not grow the handle table unboundedly.
+  // The GIL serializes the scan + growth against concurrent lookups
+  // from other service threads (the any-thread contract).
+  Gil g;
   for (CTensor* t : p->tensors) {
     if (t->is_input == input && t->name == name) return t;
   }
-  Gil g;
   auto* t = new CTensor();
   t->owner = p;
   t->name = name;
@@ -344,6 +361,7 @@ void* PD_PredictorGetOutputHandle(void* pred_v, const char* name) {
 int PD_PredictorRun(void* pred_v) {
   auto* p = static_cast<CPredictor*>(pred_v);
   Gil g;
+  p->run_id++;   // invalidates per-run output caches
   PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
   if (!r) {
     capture_py_error("PD_PredictorRun");

@@ -207,21 +207,21 @@ def _srv_shutdown() -> bool:
 
 
 _done_lock = threading.Lock()
-_done_count = 0
+_done_ranks: set = set()
 
 
-def _srv_trainer_done() -> int:
+def _srv_trainer_done(rank: int = -1) -> int:
     """RPC-served on server0: a trainer announces it has finished.
-    Returns the running count so the caller can observe progress."""
-    global _done_count
+    IDEMPOTENT per rank — a retried post after a lost response must not
+    double-count and release the barrier early. Returns the count."""
     with _done_lock:
-        _done_count += 1
-        return _done_count
+        _done_ranks.add(int(rank))
+        return len(_done_ranks)
 
 
 def _srv_done_count() -> int:
     with _done_lock:
-        return _done_count
+        return len(_done_ranks)
 
 
 def init_server(*table_configs, model_dir: Optional[str] = None):
@@ -246,10 +246,9 @@ def init_server(*table_configs, model_dir: Optional[str] = None):
     # for worker .addr files would just eat the full rendezvous deadline
     rpc.init_rpc(f"server{idx}", rank=idx, world_size=server_num())
     _ps_stop.clear()
-    global _done_count
     with _done_lock:
-        _done_count = 0   # stale counts from a prior run must not satisfy
-                          # the next run's trainer-done barrier
+        _done_ranks.clear()   # stale marks from a prior run must not
+                              # satisfy the next run's trainer-done barrier
     _fleet_state["ps_server"] = PsServer(list(table_configs))
     if model_dir is not None:
         for cfg in table_configs:
@@ -355,9 +354,13 @@ def stop_worker(barrier_timeout: float = 120.0):
         # of N trainers can reset a connection, and a lost post either
         # defeats the barrier (first worker) or stalls it (sibling)
         posted = False
+        my_rank = rm.worker_index()
         for _ in range(5):
             try:
+                # idempotent per rank: a retry after a LOST RESPONSE (the
+                # request may have executed) cannot double-count
                 rpc.rpc_sync("server0", _srv_trainer_done,
+                             args=(my_rank,),
                              timeout=max(min(barrier_timeout, 10.0), 1.0))
                 posted = True
                 break

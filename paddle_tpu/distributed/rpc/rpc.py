@@ -37,12 +37,6 @@ class WorkerInfo:
     port: int
 
 
-class _CleanEOF(ConnectionError):
-    """Peer closed BETWEEN messages (zero bytes at a message boundary) —
-    distinguishable from a tear mid-message, so a stale pooled
-    connection can be retried safely."""
-
-
 def _send_msg(sock, obj):
     # protocol 5: numpy arrays serialize through the buffer protocol —
     # the PS pull/push hot path is row matrices
@@ -55,8 +49,7 @@ def _recv_msg(sock):
     while len(hdr) < 8:
         c = sock.recv(8 - len(hdr))
         if not c:
-            raise (_CleanEOF if not hdr else ConnectionError)(
-                "rpc peer closed")
+            raise ConnectionError("rpc peer closed")
         hdr += c
     n = struct.unpack("<Q", hdr)[0]
     chunks = []
@@ -237,7 +230,9 @@ def _peer_closed(s: socket.socket) -> bool:
         if not r:
             return False       # nothing pending — alive
         return s.recv(1, socket.MSG_PEEK) == b""
-    except OSError:
+    except (OSError, ValueError):
+        # ValueError: fd >= FD_SETSIZE (select's 1024 limit) — can't
+        # probe; treat as dead so the call re-dials a fresh socket
         return True
 
 

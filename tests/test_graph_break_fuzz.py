@@ -1,0 +1,106 @@
+"""Graph-break splitter fuzzer (companion to the tape/static fuzzers).
+
+Generates random straight-line programs over paddle ops with untraceable
+statements (int()/float() concretizations, data-dependent python
+branches, tensor-bound loops) at random positions, writes them to a real
+module file (the splitter needs source), and checks:
+
+- split execution == plain-eager execution (value parity), and
+- once split, repeated calls do not re-trace compiled regions.
+"""
+import importlib.util
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+_OPS = [
+    "v = v * 1.5",
+    "v = v + w",
+    "v = v.matmul(m)",
+    "v = paddle.tanh(v)",
+    "v = v - 0.25",
+    "v = paddle.nn.functional.relu(v)",
+    "v = v * v",
+]
+
+_BREAKS = [
+    "k = int(paddle.abs(v).sum()) % 3 + 1\n    v = v * k",
+    "if float(v.sum()) > 0:\n        v = v * 2.0\n    else:\n        v = v - 1.0",
+    "for _ in range(int(paddle.abs(v).max()) % 2 + 1):\n        v = v + 0.5",
+]
+
+
+def _gen_program(rs, n_stmts, break_positions):
+    lines = ["import paddle_tpu as paddle", "", ""]
+    body = []
+    for i in range(n_stmts):
+        if i in break_positions:
+            body.append("    " + _BREAKS[rs.randint(len(_BREAKS))])
+        else:
+            body.append("    " + _OPS[rs.randint(len(_OPS))])
+    src = "\n".join(lines) + "def prog(v, w, m):\n" + "\n".join(body) + \
+        "\n    return v\n"
+    return src
+
+
+def _load_module(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_graph_break_fuzz(tmp_path):
+    rs = np.random.RandomState(7)
+    n_ok = 0
+    for trial in range(10):
+        n_stmts = rs.randint(3, 8)
+        n_breaks = rs.randint(0, 3)
+        break_positions = set(
+            rs.choice(n_stmts, size=n_breaks, replace=False).tolist()) \
+            if n_breaks else set()
+        src = _gen_program(rs, n_stmts, break_positions)
+        path = tmp_path / f"gb_fuzz_{trial}.py"
+        path.write_text(src)
+        mod = _load_module(str(path), f"gb_fuzz_{trial}")
+
+        vv = rs.randn(4, 4).astype(np.float32)
+        wv = rs.randn(4, 4).astype(np.float32)
+        mv = (rs.randn(4, 4) * 0.5).astype(np.float32)
+
+        def run_eager():
+            return mod.prog(paddle.to_tensor(vv), paddle.to_tensor(wv),
+                            paddle.to_tensor(mv)).numpy()
+
+        want = run_eager()
+        sf = jit.to_static(mod.prog)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got1 = sf(paddle.to_tensor(vv), paddle.to_tensor(wv),
+                      paddle.to_tensor(mv)).numpy()
+            got2 = sf(paddle.to_tensor(vv), paddle.to_tensor(wv),
+                      paddle.to_tensor(mv)).numpy()
+        np.testing.assert_allclose(got1, want, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"trial {trial}:\n{src}")
+        np.testing.assert_allclose(got2, want, rtol=2e-5, atol=2e-5)
+
+        if n_breaks == 0:
+            assert not sf._eager_keys, f"clean program broke:\n{src}"
+        else:
+            # broke, and either split (with jit segments present) or
+            # legitimately fell back whole-eager
+            assert sf._eager_keys
+            sps = [sp for sp in sf._split_programs.values()
+                   if sp is not None]
+            for sp in sps:
+                kinds = [s.kind for s in sp.segments]
+                assert "eager" in kinds, (kinds, src)
+        n_ok += 1
+    assert n_ok == 10
