@@ -19,6 +19,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -69,8 +70,10 @@ struct CTensor;
 
 struct CPredictor {
   PyObject* pred = nullptr;                  // paddle predictor object
-  std::vector<std::string> input_names;
-  std::vector<std::string> output_names;
+  // deque: element addresses are stable across growth, so c_str()
+  // pointers handed to C callers stay valid for the predictor lifetime
+  std::deque<std::string> input_names;
+  std::deque<std::string> output_names;
   std::vector<CTensor*> tensors;             // owned handles
   uint64_t run_id = 0;                       // bumps on every Run
 };
@@ -80,9 +83,8 @@ struct CTensor {
   std::string name;
   bool is_input = false;
   PyObject* handle = nullptr;                // python Tensor handle
-  PyObject* last_out = nullptr;              // cached output ndarray
+  PyObject* last_out = nullptr;              // cached NATIVE-dtype ndarray
   uint64_t fetched_run = 0;                  // run_id last_out belongs to
-  std::string fetched_dtype;
   std::vector<int64_t> shape;
 };
 
@@ -155,17 +157,15 @@ bool copy_from_cpu(CTensor* t, const void* data, const char* dtype,
 // The python Predictor REBUILDS its output Tensor objects on every
 // run(), so the handle is re-resolved by name here — a C handle held
 // across runs must always read the CURRENT run's values.
-bool fetch_output(CTensor* t, const char* dtype);
-
-bool fetch_output_impl(CTensor* t, const char* dtype, PyObject* pred) {
-  // per-run cache: GetShape then CopyToCpu must not transfer the output
-  // from the device twice for the same run
-  if (t->last_out && t->fetched_run == t->owner->run_id &&
-      t->fetched_dtype == dtype) {
+bool fetch_output(CTensor* t) {
+  // per-run cache of the NATIVE-dtype array: GetShape then CopyToCpu
+  // (any dtype) transfers the output from the device ONCE per run;
+  // dtype conversion happens host-side at copy time
+  if (t->last_out && t->fetched_run == t->owner->run_id) {
     return true;
   }
-  PyObject* h = PyObject_CallMethod(pred, "get_output_handle", "s",
-                                    t->name.c_str());
+  PyObject* h = PyObject_CallMethod(t->owner->pred, "get_output_handle",
+                                    "s", t->name.c_str());
   if (!h) {
     capture_py_error("PD_TensorCopyToCpu(handle)");
     return false;
@@ -178,7 +178,7 @@ bool fetch_output_impl(CTensor* t, const char* dtype, PyObject* pred) {
   }
   PyObject* np = PyImport_ImportModule("numpy");
   PyObject* conv =
-      np ? PyObject_CallMethod(np, "ascontiguousarray", "Os", arr, dtype)
+      np ? PyObject_CallMethod(np, "ascontiguousarray", "O", arr)
          : nullptr;
   Py_XDECREF(np);
   Py_DECREF(arr);
@@ -189,12 +189,7 @@ bool fetch_output_impl(CTensor* t, const char* dtype, PyObject* pred) {
   Py_XDECREF(t->last_out);
   t->last_out = conv;
   t->fetched_run = t->owner->run_id;
-  t->fetched_dtype = dtype;
   return true;
-}
-
-bool fetch_output(CTensor* t, const char* dtype) {
-  return fetch_output_impl(t, dtype, t->owner->pred);
 }
 
 }  // namespace
@@ -281,7 +276,9 @@ void* PD_PredictorCreate(void* cfg_v) {
   auto* p = new CPredictor();
   p->pred = pred;
   PyObject* in = PyObject_CallMethod(pred, "get_input_names", nullptr);
-  p->input_names = names_from_list(in);
+  for (const std::string& n : names_from_list(in)) {
+    p->input_names.push_back(n);
+  }
   Py_XDECREF(in);
   PyErr_Clear();
   return p;
@@ -322,15 +319,20 @@ const char* PD_PredictorGetOutputName(void* pred_v, size_t i) {
   return i < p->output_names.size() ? p->output_names[i].c_str() : "";
 }
 
-static void* get_handle(CPredictor* p, const char* name, bool input) {
-  // one CTensor per (name, direction): serving loops re-fetch handles
-  // every iteration and must not grow the handle table unboundedly.
-  // The GIL serializes the scan + growth against concurrent lookups
-  // from other service threads (the any-thread contract).
-  Gil g;
+static CTensor* find_handle(CPredictor* p, const char* name, bool input) {
   for (CTensor* t : p->tensors) {
     if (t->is_input == input && t->name == name) return t;
   }
+  return nullptr;
+}
+
+static void* get_handle(CPredictor* p, const char* name, bool input) {
+  // one CTensor per (name, direction): serving loops re-fetch handles
+  // every iteration and must not grow the handle table unboundedly.
+  // The GIL serializes scan/growth, but a Python call in the middle can
+  // YIELD it — so re-scan after the call before publishing.
+  Gil g;
+  if (CTensor* t = find_handle(p, name, input)) return t;
   auto* t = new CTensor();
   t->owner = p;
   t->name = name;
@@ -342,6 +344,13 @@ static void* get_handle(CPredictor* p, const char* name, bool input) {
       capture_py_error("PD_PredictorGetInputHandle");
       delete t;
       return nullptr;
+    }
+    // the call above may have yielded the GIL: a racing thread could
+    // have inserted this handle — keep THEIRS, discard ours
+    if (CTensor* existing = find_handle(p, name, input)) {
+      Py_XDECREF(t->handle);
+      delete t;
+      return existing;
     }
   }
   // outputs: no cached python handle — the predictor rebuilds output
@@ -361,15 +370,29 @@ void* PD_PredictorGetOutputHandle(void* pred_v, const char* name) {
 int PD_PredictorRun(void* pred_v) {
   auto* p = static_cast<CPredictor*>(pred_v);
   Gil g;
-  p->run_id++;   // invalidates per-run output caches
   PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
   if (!r) {
     capture_py_error("PD_PredictorRun");
     return 0;
   }
   Py_DECREF(r);
+  // bump AFTER run() returns: the call yields the GIL at bytecode
+  // boundaries, and a concurrent fetch mid-run must not cache the
+  // previous run's output under the new id
+  p->run_id++;
   PyObject* out = PyObject_CallMethod(p->pred, "get_output_names", nullptr);
-  p->output_names = names_from_list(out);
+  // append-only merge: returned name pointers (GetOutputName) must stay
+  // valid for the predictor's lifetime — never free or reassign entries
+  for (const std::string& n : names_from_list(out)) {
+    bool have = false;
+    for (const std::string& e : p->output_names) {
+      if (e == n) {
+        have = true;
+        break;
+      }
+    }
+    if (!have) p->output_names.push_back(n);
+  }
   Py_XDECREF(out);
   PyErr_Clear();
   return 1;
@@ -406,7 +429,7 @@ int PD_TensorGetShape(void* t_v, int64_t* shape, int cap) {
     return n;
   }
   Gil g;
-  if (!fetch_output(t, "float32")) return -1;
+  if (!fetch_output(t)) return -1;   // native dtype: shape-only read
   PyObject* shp = PyObject_GetAttrString(t->last_out, "shape");
   if (!shp) {
     capture_py_error("PD_TensorGetShape");
@@ -426,8 +449,21 @@ int PD_TensorGetShape(void* t_v, int64_t* shape, int cap) {
 static int copy_to_cpu(CTensor* t, void* out, const char* dtype,
                        size_t elem) {
   Gil g;
-  if (!fetch_output(t, dtype)) return 0;
-  PyObject* b = PyObject_CallMethod(t->last_out, "tobytes", nullptr);
+  if (!fetch_output(t)) return 0;
+  // host-side dtype conversion from the cached native array (no second
+  // device transfer)
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* conv =
+      np ? PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                               t->last_out, dtype)
+         : nullptr;
+  Py_XDECREF(np);
+  if (!conv) {
+    capture_py_error("PD_TensorCopyToCpu");
+    return 0;
+  }
+  PyObject* b = PyObject_CallMethod(conv, "tobytes", nullptr);
+  Py_DECREF(conv);
   if (!b) {
     capture_py_error("PD_TensorCopyToCpu");
     return 0;

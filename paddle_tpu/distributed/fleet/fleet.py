@@ -210,10 +210,12 @@ _done_lock = threading.Lock()
 _done_ranks: set = set()
 
 
-def _srv_trainer_done(rank: int = -1) -> int:
+def _srv_trainer_done(rank: int) -> int:
     """RPC-served on server0: a trainer announces it has finished.
     IDEMPOTENT per rank — a retried post after a lost response must not
-    double-count and release the barrier early. Returns the count."""
+    double-count and release the barrier early. ``rank`` is REQUIRED: a
+    rank-less caller (version skew) must fail loudly over RPC rather
+    than silently collapse onto one set entry and hang the barrier."""
     with _done_lock:
         _done_ranks.add(int(rank))
         return len(_done_ranks)
