@@ -446,8 +446,7 @@ int PD_TensorGetShape(void* t_v, int64_t* shape, int cap) {
   return static_cast<int>(n);
 }
 
-static int copy_to_cpu(CTensor* t, void* out, const char* dtype,
-                       size_t elem) {
+static int copy_to_cpu(CTensor* t, void* out, const char* dtype) {
   Gil g;
   if (!fetch_output(t)) return 0;
   // host-side dtype conversion from the cached native array (no second
@@ -474,20 +473,19 @@ static int copy_to_cpu(CTensor* t, void* out, const char* dtype,
     std::memcpy(out, buf, static_cast<size_t>(n));
   }
   Py_DECREF(b);
-  (void)elem;
   return 1;
 }
 
 int PD_TensorCopyToCpuFloat(void* t_v, float* out) {
-  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "float32", 4);
+  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "float32");
 }
 
 int PD_TensorCopyToCpuInt32(void* t_v, int32_t* out) {
-  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "int32", 4);
+  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "int32");
 }
 
 int PD_TensorCopyToCpuInt64(void* t_v, int64_t* out) {
-  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "int64", 8);
+  return copy_to_cpu(static_cast<CTensor*>(t_v), out, "int64");
 }
 
 }  // extern "C"

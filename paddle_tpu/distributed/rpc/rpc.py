@@ -13,6 +13,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import pickle
+import select
 import socket
 import socketserver
 import struct
@@ -224,7 +225,6 @@ def _peer_closed(s: socket.socket) -> bool:
     ever pending on an idle connection, so readable == dead (EOF or
     RST). A zero-timeout select does the probe — MSG_DONTWAIT alone
     would be defeated by CPython's readiness wait on blocking sockets."""
-    import select
     try:
         r, _, _ = select.select([s], [], [], 0)
         if not r:

@@ -204,7 +204,6 @@ class TestCServingABI:
         # python predictor rebuilds its output tensors every run, so a
         # held C handle must read the CURRENT run's values, and handle
         # re-fetches must not grow the handle table
-        import paddle_tpu.inference  # noqa: F401  (already imported)
         x2 = np.ascontiguousarray(x * -2.0)
         assert lib.PD_TensorCopyFromCpuFloat(
             h, x2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
@@ -214,7 +213,6 @@ class TestCServingABI:
         out2 = np.zeros_like(out)
         assert lib.PD_TensorCopyToCpuFloat(
             oh2, out2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        import paddle_tpu as paddle_
         # build the reference for x2 by reloading the artifact in python
         cfg2 = paddle_tpu.inference.Config(model_path)
         p2 = paddle_tpu.inference.create_predictor(cfg2)
