@@ -7,6 +7,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 CHILD = r"""
 import time, json, os, sys
@@ -57,8 +58,45 @@ VARIANTS = [
 ]
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WINNER_PATH = os.path.join(_REPO, "PERF_WINNER.json")
+BASE_NAME = "base_b4_nothing"
+ADOPT_MARGIN = 1.02     # flip the bench config only for a >2% win
+
+
+def _record_winner(results):
+    """If a measured variant beats the base by the adoption margin,
+    write PERF_WINNER.json so bench.py's pick_config applies it on the
+    next (e.g. driver end-of-round) run — no manual flip needed."""
+    by_name = {r["variant"]["name"]: r for r in results}
+    base = by_name.get(BASE_NAME)
+    if base is None or not results:
+        return
+    best = max(results, key=lambda r: r["tps"])
+    if best["variant"]["name"] == BASE_NAME or \
+            best["tps"] < base["tps"] * ADOPT_MARGIN:
+        # base (still) wins: clear any stale winner so bench reverts
+        if os.path.exists(WINNER_PATH):
+            os.remove(WINNER_PATH)
+            print("SWEEP_WINNER cleared (base config wins)")
+        return
+    rec = {"variant": best["variant"], "tps": best["tps"],
+           "mfu": best["mfu"], "base_tps": base["tps"],
+           "gain": round(best["tps"] / base["tps"] - 1, 4),
+           "recorded_unix": time.time(),
+           "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())}
+    # atomic: the driver's bench may read concurrently with this write
+    tmp = WINNER_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, WINNER_PATH)
+    print("SWEEP_WINNER " + json.dumps(rec))
+
+
 def main():
     names = sys.argv[1:]
+    results = []
     for v in VARIANTS:
         if names and v["name"] not in names:
             continue
@@ -69,16 +107,26 @@ def main():
                                   stdout=subprocess.PIPE,
                                   stderr=subprocess.STDOUT, text=True,
                                   timeout=600)
+            parsed = None
             for line in proc.stdout.splitlines():
                 if line.startswith("SWEEP_RESULT"):
+                    try:
+                        # runtime log writes can interleave into stdout;
+                        # a torn line must not abort the whole sweep
+                        parsed = json.loads(line[len("SWEEP_RESULT "):])
+                    except ValueError:
+                        continue
                     print(line)
+                    results.append(parsed)
                     break
-            else:
+            if parsed is None:
                 tail = " | ".join(proc.stdout.strip().splitlines()[-3:])
                 print(f"SWEEP_FAIL {v['name']}: {tail[-300:]}")
         except subprocess.TimeoutExpired:
             print(f"SWEEP_TIMEOUT {v['name']}")
         sys.stdout.flush()
+    if not names:                 # only a FULL sweep may adopt a winner
+        _record_winner(results)
 
 
 if __name__ == "__main__":
