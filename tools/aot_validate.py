@@ -149,12 +149,46 @@ def validate_13b(n: int, batch_mult: int = 1):
          "microbatches": microbatches, "remat_policy": cfg.remat_policy})
 
 
+def validate_moe(n: int, batch_mult: int = 1):
+    """BASELINE #5: ERNIE-4.5-style MoE with expert parallelism
+    (all-to-all over ICI), seq 4096. Representative mid-size: 16
+    experts top-2 over the ep axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.models import llama, moe, train
+
+    tp = 2 if n % 2 == 0 else 1
+    ep = min(8, max(1, n // (tp * 1)))
+    dp = max(1, n // (ep * tp))
+    mesh = Mesh(np.asarray(jax.devices()[:dp * ep * tp]).reshape(dp, ep,
+                                                                 tp),
+                ("dp", "ep", "tp"))
+    cfg = llama.LlamaConfig(
+        hidden_size=2048, intermediate_size=5632, num_layers=24,
+        num_heads=16, num_kv_heads=16, vocab_size=32000,
+        max_seq_len=4096, dtype=jnp.bfloat16, remat=True,
+        moe=moe.MoEConfig(num_experts=16, top_k=2, capacity_factor=1.25))
+    batch = max(1, dp) * 2 * batch_mult
+    step = train.make_train_step(cfg, mesh, data_axes=("dp",),
+                                 ep_axis="ep")
+    st_sh = train.state_shardings(mesh, cfg)
+    return _analyze(
+        "ernie_moe_ep16", step,
+        _state_sds(cfg, mesh, st_sh),
+        _tokens_sds(mesh, batch, 4096, ("dp",)), mesh,
+        {"params": cfg.num_params(), "batch": batch, "seq": 4096,
+         "experts": 16, "top_k": 2, "remat_policy": cfg.remat_policy})
+
+
 def _impl(args) -> int:
     rows = []
     if args.config in ("7b", "all"):
         rows.append(validate_7b(args.devices, args.batch_mult))
     if args.config in ("13b", "all"):
         rows.append(validate_13b(args.devices, args.batch_mult))
+    if args.config in ("moe", "all"):
+        rows.append(validate_moe(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         print(json.dumps(r))
@@ -166,7 +200,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=16,
                     help="virtual chips (v5p-32 slice = 16 chips)")
-    ap.add_argument("--config", choices=["7b", "13b", "all"], default="all")
+    ap.add_argument("--config", choices=["7b", "13b", "moe", "all"],
+                    default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")
     ap.add_argument("--_child", action="store_true")
