@@ -698,10 +698,14 @@ def block_multihead_attention(
         qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
         seq_lens_this_time, padding_offsets=None, cum_offsets=None,
         cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
-        pre_key_cache=None, pre_value_cache=None, rope_emb=None, mask=None,
+        pre_key_cache=None, pre_value_cache=None,
+        cache_k_quant_scales=None, cache_v_quant_scales=None,
+        cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+        rope_emb=None, mask=None,
         tgt_mask=None, max_seq_len=-1, block_size=64, use_neox_style=False,
         qkv_bias=None, out_shift=None, out_smooth=None,
-        max_enc_len_this_time=None, max_dec_len_this_time=None, **_):
+        max_enc_len_this_time=None, max_dec_len_this_time=None,
+        use_dynamic_cachekv_quant=False, **_):
     """reference: incubate/nn/functional/block_multihead_attention.py /
     block_multi_head_attention_kernel.cu — PAGED-kv-cache attention: each
     sequence's cache lives in `block_size`-row pages addressed through
@@ -715,6 +719,14 @@ def block_multihead_attention(
       key/value_cache[num_blocks, nh, block_size, hd]
       block_tables   [B, max_blocks_per_seq] (-1 padded)
     Returns (out [total_tokens, nh*hd], qkv, key_cache, value_cache).
+
+    **int8 KV cache** (reference: cache_k/v_quant_scales +
+    use_dynamic_cachekv_quant — the cachekv-int8 serving tier): when
+    quant scales are given the caches hold int8; writes quantize new
+    rows with the per-head (static, [nh]) or per-sequence-per-head
+    (dynamic, [B, nh]) quant scales, reads dequantize with the
+    dequant scales (default 1/quant). Halves KV HBM, the long-context
+    decode bandwidth win.
     """
     if pre_key_cache is not None or pre_value_cache is not None:
         raise NotImplementedError(
@@ -734,6 +746,41 @@ def block_multihead_attention(
     total = qv.shape[0]
     q3 = qv.reshape(total, 3, nh, hd)
 
+    kq = (as_tensor(cache_k_quant_scales)._value
+          if cache_k_quant_scales is not None else None)
+    vq = (as_tensor(cache_v_quant_scales)._value
+          if cache_v_quant_scales is not None else None)
+    kdq = (as_tensor(cache_k_dequant_scales)._value
+           if cache_k_dequant_scales is not None else
+           (1.0 / kq if kq is not None else None))
+    vdq = (as_tensor(cache_v_dequant_scales)._value
+           if cache_v_dequant_scales is not None else
+           (1.0 / vq if vq is not None else None))
+    if (kq is None) != (vq is None):
+        raise ValueError(
+            "block_multihead_attention: cache_k_quant_scales and "
+            "cache_v_quant_scales must be passed together (got only "
+            f"{'k' if kq is not None else 'v'} scales) — an int8 cache "
+            "quantizes both K and V")
+    cache_quant = kq is not None
+
+    def _sc(scales, b, shape):
+        """Per-head scale broadcast: static [nh] or dynamic [B, nh]."""
+        s = scales[b] if (use_dynamic_cachekv_quant and
+                          jnp.ndim(scales) == 2) else scales
+        return jnp.asarray(s, jnp.float32).reshape(shape)
+
+    def _quant_rows(x, scales, b):
+        # x: (t, nh, hd) new rows -> int8
+        s = _sc(scales, b, (1, nh, 1))
+        return jnp.clip(jnp.round(x.astype(jnp.float32) * s),
+                        -127, 127).astype(jnp.int8)
+
+    def _dequant_ctx(x, scales, b):
+        # x: (nh, kl, hd) gathered cache -> fp32
+        s = _sc(scales, b, (nh, 1, 1))
+        return x.astype(jnp.float32) * s
+
     # pure-decode batches (one new token per sequence, no prefill rows)
     # take the Pallas paged-attention kernel: the block-table gather rides
     # the kernel's scalar-prefetch index map instead of materializing a
@@ -741,6 +788,7 @@ def block_multihead_attention(
     from ....ops.pallas import fused as _pf
     if (rope_emb is None and mask is None and total == B
             and int(enc.max(initial=0)) == 0 and np.all(this == 1)
+            and not cache_quant    # int8 cache takes the dequant path
             and _pf.available()):   # True on TPU or under set_interpret
         q1 = q3[:, 0]                       # (B, nh, hd)
         pos = dec.astype(np.int64)
@@ -787,13 +835,20 @@ def block_multihead_attention(
         pos = start + np.arange(t)
         pages = jnp.asarray(bt[b, pos // bs].astype(np.int32))
         rows = jnp.asarray((pos % bs).astype(np.int32))
-        kc = kc.at[pages, :, rows].set(k_new.astype(kc.dtype))
-        vc = vc.at[pages, :, rows].set(v_new.astype(vc.dtype))
+        if cache_quant:
+            kc = kc.at[pages, :, rows].set(_quant_rows(k_new, kq, b))
+            vc = vc.at[pages, :, rows].set(_quant_rows(v_new, vq, b))
+        else:
+            kc = kc.at[pages, :, rows].set(k_new.astype(kc.dtype))
+            vc = vc.at[pages, :, rows].set(v_new.astype(vc.dtype))
         kl = start + t
         npages = (kl + bs - 1) // bs
         pages = [int(bt[b, p]) for p in range(npages)]
         ks = jnp.concatenate([kc[p] for p in pages], axis=1)[:, :kl]
         vs = jnp.concatenate([vc[p] for p in pages], axis=1)[:, :kl]
+        if cache_quant:
+            ks = _dequant_ctx(ks, kdq, b)
+            vs = _dequant_ctx(vs, vdq, b).astype(qv.dtype)
         logits = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32),
                             ks.astype(jnp.float32)) / math.sqrt(hd)
         qpos = start + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)

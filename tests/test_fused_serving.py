@@ -116,6 +116,65 @@ class TestFusedMultiTransformer:
 
 
 class TestBlockAttention:
+    def test_int8_kv_cache_matches_fp_within_quant_error(self):
+        """cachekv-int8 (reference: cache_k/v_quant_scales): int8 caches
+        with per-head scales must track the fp-cache result within
+        quantization error, for static AND dynamic scales."""
+        nh, hd, bs = 2, 8, 4
+        B, nblocks = 2, 6
+        rs = np.random.RandomState(3)
+        block_tables = np.array([[0, 1, -1], [2, 3, -1]], np.int32)
+        enc = np.array([6, 5], np.int32)
+        dec = np.array([0, 0], np.int32)
+        this = enc.copy()
+        total = int(this.sum())
+        qkv = (rs.randn(total, 3 * nh * hd) * 0.5).astype(np.float32)
+
+        ref, _, _, _ = F.block_multihead_attention(
+            _t(qkv), _t(np.zeros((nblocks, nh, bs, hd), np.float32)),
+            _t(np.zeros((nblocks, nh, bs, hd), np.float32)),
+            _t(enc), _t(dec), _t(this),
+            block_tables=_t(block_tables), block_size=bs)
+        ref = np.asarray(ref.numpy())
+
+        q3 = qkv.reshape(total, 3, nh, hd)
+        for dynamic in (False, True):
+            if dynamic:
+                # genuinely per-sequence scales (different per row) so a
+                # wrong batch index or an ignored dynamic flag FAILS
+                row_amax = np.stack([
+                    np.abs(q3[:6, 1:]).max(axis=(0, 1, 3)),
+                    np.abs(q3[6:, 1:]).max(axis=(0, 1, 3))])
+                scales = (127.0 / np.maximum(row_amax, 1e-6)).astype(
+                    np.float32)
+                assert not np.allclose(scales[0], scales[1])
+            else:
+                scales = np.full((nh,), 127.0 / np.abs(qkv).max(),
+                                 np.float32)
+            kq = np.zeros((nblocks, nh, bs, hd), np.int8)
+            vq = np.zeros((nblocks, nh, bs, hd), np.int8)
+            out, _, kc2, vc2 = F.block_multihead_attention(
+                _t(qkv), _t(kq), _t(vq), _t(enc), _t(dec), _t(this),
+                block_tables=_t(block_tables), block_size=bs,
+                cache_k_quant_scales=_t(scales),
+                cache_v_quant_scales=_t(scales),
+                use_dynamic_cachekv_quant=dynamic)
+            got = np.asarray(out.numpy())
+            assert np.asarray(kc2.numpy()).dtype == np.int8
+            assert np.abs(np.asarray(kc2.numpy())).max() > 0
+            # int8 quantization error bound, not exactness
+            np.testing.assert_allclose(got, ref, atol=0.05, rtol=0.05)
+
+        # K-only or V-only scales: loud error, not silent corruption
+        import pytest
+        with pytest.raises(ValueError, match="together"):
+            F.block_multihead_attention(
+                _t(qkv), _t(np.zeros((nblocks, nh, bs, hd), np.int8)),
+                _t(np.zeros((nblocks, nh, bs, hd), np.int8)),
+                _t(enc), _t(dec), _t(this),
+                block_tables=_t(block_tables), block_size=bs,
+                cache_k_quant_scales=_t(np.ones(nh, np.float32)))
+
     def test_paged_mixed_batch_matches_dense(self):
         nh, hd, bs = 2, 8, 4
         B, nblocks = 2, 8
