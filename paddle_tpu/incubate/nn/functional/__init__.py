@@ -763,6 +763,17 @@ def block_multihead_attention(
             f"{'k' if kq is not None else 'v'} scales) — an int8 cache "
             "quantizes both K and V")
     cache_quant = kq is not None
+    if cache_quant:
+        want = 2 if use_dynamic_cachekv_quant else 1
+        for nm, s in (("cache_k_quant_scales", kq),
+                      ("cache_v_quant_scales", vq)):
+            if jnp.ndim(s) != want:
+                raise ValueError(
+                    f"block_multihead_attention: {nm} must be "
+                    f"{'[B, num_head]' if want == 2 else '[num_head]'} "
+                    f"for use_dynamic_cachekv_quant="
+                    f"{use_dynamic_cachekv_quant}, got ndim "
+                    f"{jnp.ndim(s)}")
 
     def _sc(scales, b, shape):
         """Per-head scale broadcast: static [nh] or dynamic [B, nh]."""
@@ -788,18 +799,32 @@ def block_multihead_attention(
     from ....ops.pallas import fused as _pf
     if (rope_emb is None and mask is None and total == B
             and int(enc.max(initial=0)) == 0 and np.all(this == 1)
-            and not cache_quant    # int8 cache takes the dequant path
             and _pf.available()):   # True on TPU or under set_interpret
         q1 = q3[:, 0]                       # (B, nh, hd)
         pos = dec.astype(np.int64)
         pages = jnp.asarray(bt[np.arange(B), pos // bs].astype(np.int32))
         rows = jnp.asarray((pos % bs).astype(np.int32))
-        kc = kc.at[pages, :, rows].set(q3[:, 1].astype(kc.dtype))
-        vc = vc.at[pages, :, rows].set(q3[:, 2].astype(vc.dtype))
+        if cache_quant:
+            # int8 pages stay int8 in HBM; the kernel dequants in VMEM.
+            # ONE vectorized quantize per cache — this is the decode hot
+            # path, not a place for a per-sequence python loop
+            def _qbatch(x, scales):   # x: (B, nh, hd)
+                s = jnp.asarray(scales, jnp.float32)
+                s = s[:, :, None] if use_dynamic_cachekv_quant \
+                    else s.reshape(1, nh, 1)
+                return jnp.clip(jnp.round(x.astype(jnp.float32) * s),
+                                -127, 127).astype(jnp.int8)
+            kc = kc.at[pages, :, rows].set(_qbatch(q3[:, 1], kq))
+            vc = vc.at[pages, :, rows].set(_qbatch(q3[:, 2], vq))
+        else:
+            kc = kc.at[pages, :, rows].set(q3[:, 1].astype(kc.dtype))
+            vc = vc.at[pages, :, rows].set(q3[:, 2].astype(vc.dtype))
         # kernel page layout: (P, HK, page, D) == this cache layout
         out = _pf.paged_decode_attention(
             q1, kc, vc, jnp.asarray(bt), jnp.asarray(
-                (dec + 1).astype(np.int32)))
+                (dec + 1).astype(np.int32)),
+            k_dequant_scale=kdq if cache_quant else None,
+            v_dequant_scale=vdq if cache_quant else None)
         return (Tensor(out.reshape(B, nh * hd), _internal=True),
                 Tensor(qv, _internal=True), Tensor(kc, _internal=True),
                 Tensor(vc, _internal=True))

@@ -287,10 +287,18 @@ def rope_qk(q, k, cos, sin, block_seq: int = 256):
 
 # ---------------- decode-time block attention (KV cache) ----------------
 def _decode_softmax_step(q, k, v, cache_len, o_ref, acc, m_sc, l_sc,
-                         *, scale, block_k):
+                         *, scale, block_k, k_scale=None, v_scale=None):
     """Shared online-softmax step for the decode kernels (contiguous and
     paged): one (H_rep, D) query block against one (block_k, D) K/V block
-    at sequence offset ki*block_k, masked by cache_len."""
+    at sequence offset ki*block_k, masked by cache_len.
+
+    ``k_scale``/``v_scale``: optional per-row DEQUANT scalars for int8
+    pages (the cachekv-int8 tier) — dequantization happens here in VMEM,
+    so the HBM reads stay 1 byte/element."""
+    if k_scale is not None:
+        k = (k.astype(jnp.float32) * k_scale).astype(q.dtype)
+    if v_scale is not None:
+        v = (v.astype(jnp.float32) * v_scale).astype(q.dtype)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
 
@@ -396,8 +404,20 @@ def _paged_decode_kernel(bt_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
                          block_k=page)
 
 
+def _paged_decode_kernel_q(bt_ref, q_ref, k_ref, v_ref, len_ref, ks_ref,
+                           vs_ref, o_ref, acc, m_sc, l_sc, *, scale,
+                           page):
+    """int8-page variant: per-row dequant scales ride SMEM; pages stay
+    1 byte/element in HBM and dequantize in VMEM."""
+    _decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0], len_ref[0],
+                         o_ref, acc, m_sc, l_sc, scale=scale,
+                         block_k=page, k_scale=ks_ref[0],
+                         v_scale=vs_ref[0])
+
+
 def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
-                           scale=None):
+                           scale=None, k_dequant_scale=None,
+                           v_dequant_scale=None):
     """Single-token flash attention over a PAGED KV cache (reference:
     block_multi_head_attention_kernel.cu + vLLM paged attention).
 
@@ -408,6 +428,11 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
     returns (B, H, D). The page id feeds the kernel's BlockSpec index map
     via scalar prefetch — the gather over pages happens in the memory
     pipeline, not as a materialized contiguous copy.
+
+    ``k/v_dequant_scale`` (cachekv-int8): per-head ``(HK,)`` or
+    per-sequence-per-head ``(B, HK)`` fp32 dequant scales for int8
+    pages; dequantization happens inside the kernel, so HBM reads stay
+    1 byte/element — the paged long-context bandwidth win.
     """
     B, H, D = q.shape
     HK, page = k_pages.shape[1], k_pages.shape[2]
@@ -422,19 +447,44 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
     qt = q.reshape(B, HK, rep, D).reshape(B * HK, rep, D)
     lens = jnp.repeat(cache_len, HK)
     bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)  # clamp -1
+    if (k_dequant_scale is None) != (v_dequant_scale is None):
+        raise ValueError(
+            "paged_decode_attention: k_dequant_scale and v_dequant_scale "
+            "must be passed together — int8 pages quantize both K and V")
+    quant = k_dequant_scale is not None
+
+    def _rows(sc):
+        # grid row i = b*HK + h: (HK,) tiles over B; (B, HK) flattens
+        sc = jnp.asarray(sc, jnp.float32)
+        return (jnp.tile(sc, B) if sc.ndim == 1
+                else sc.reshape(B * HK))
+
+    in_specs = [
+        pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
+        pl.BlockSpec((1, 1, page, D),
+                     lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+        pl.BlockSpec((1, 1, page, D),
+                     lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+        pl.BlockSpec((1,), lambda i, j, bt_: (i,),
+                     memory_space=pltpu.SMEM if _PALLAS_OK else None),
+    ]
+    inputs = [bt, qt, kp, vp, lens]
+    if quant:
+        for _ in range(2):
+            in_specs.append(pl.BlockSpec(
+                (1,), lambda i, j, bt_: (i,),
+                memory_space=pltpu.SMEM if _PALLAS_OK else None))
+        inputs += [_rows(k_dequant_scale), _rows(v_dequant_scale)]
+        kernel = functools.partial(_paged_decode_kernel_q, scale=s,
+                                   page=page)
+    else:
+        kernel = functools.partial(_paged_decode_kernel, scale=s,
+                                   page=page)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B * HK, ppseq),
-        in_specs=[
-            pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
-            pl.BlockSpec((1, 1, page, D),
-                         lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
-            pl.BlockSpec((1, 1, page, D),
-                         lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
-            pl.BlockSpec((1,), lambda i, j, bt_: (i,),
-                         memory_space=pltpu.SMEM if _PALLAS_OK else None),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((rep, D), jnp.float32),
@@ -444,9 +494,9 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
     )
 
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, scale=s, page=page),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * HK, rep, D), q.dtype),
         interpret=_interp(),
-    )(bt, qt, kp, vp, lens)
+    )(*inputs)
     return out.reshape(B, HK, rep, D).reshape(B, H, D)

@@ -481,3 +481,47 @@ def test_block_attention_kernel_path_matches_jnp():
                                np.asarray(kc_r.numpy()), atol=1e-6)
     np.testing.assert_allclose(np.asarray(vc_k.numpy()),
                                np.asarray(vc_r.numpy()), atol=1e-6)
+
+
+def test_block_attention_int8_kernel_path_matches_jnp():
+    """The int8-page Pallas decode dispatch (in-kernel dequant, scales
+    in SMEM) must equal the jnp int8 reference path — per-head AND
+    per-sequence scales."""
+    from paddle_tpu.ops.pallas import fused as pf
+    nh, hd, bs = 2, 8, 4
+    B = 2
+    rs = np.random.RandomState(5)
+    bt = np.array([[0, 2, -1], [4, 1, 3]], np.int32)
+    enc = np.array([0, 0], np.int32)
+    dec = np.array([5, 9], np.int32)
+    this = np.array([1, 1], np.int32)
+    qkv = (rs.randn(B, 3 * nh * hd) * 0.4).astype(np.float32)
+    kq = rs.randint(-90, 90, (6, nh, bs, hd)).astype(np.int8)
+    vq = rs.randint(-90, 90, (6, nh, bs, hd)).astype(np.int8)
+    for dynamic, scales in ((False, np.array([80.0, 120.0], np.float32)),
+                            (True, np.array([[70.0, 110.0],
+                                             [90.0, 130.0]], np.float32))):
+        kw = dict(block_tables=_t(bt), block_size=bs,
+                  cache_k_quant_scales=_t(scales),
+                  cache_v_quant_scales=_t(scales * 1.25),
+                  use_dynamic_cachekv_quant=dynamic)
+        args = (_t(qkv), _t(kq.copy()), _t(vq.copy()), _t(enc), _t(dec),
+                _t(this))
+        real_avail = pf.available
+        pf.available = lambda: False
+        try:
+            o_ref, _, kc_r, vc_r = F.block_multihead_attention(*args, **kw)
+        finally:
+            pf.available = real_avail
+        pf.set_interpret(True)
+        try:
+            o_k, _, kc_k, vc_k = F.block_multihead_attention(*args, **kw)
+        finally:
+            pf.set_interpret(False)
+        np.testing.assert_allclose(np.asarray(o_k.numpy()),
+                                   np.asarray(o_ref.numpy()), atol=3e-5)
+        assert np.asarray(kc_k.numpy()).dtype == np.int8
+        np.testing.assert_array_equal(np.asarray(kc_k.numpy()),
+                                      np.asarray(kc_r.numpy()))
+        np.testing.assert_array_equal(np.asarray(vc_k.numpy()),
+                                      np.asarray(vc_r.numpy()))
