@@ -119,7 +119,8 @@ def peak_flops(dev) -> float:
 
 
 def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
-            decode_int8_tps=None, decode_int4_tps=None):
+            decode_int8_tps=None, decode_int4_tps=None,
+            decode_w8kv8_tps=None):
     import jax
     return {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -132,7 +133,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "loss": lossv,
                   "decode_tokens_per_sec": decode_tps,
                   "decode_int8_tokens_per_sec": decode_int8_tps,
-                  "decode_int4_tokens_per_sec": decode_int4_tps},
+                  "decode_int4_tokens_per_sec": decode_int4_tps,
+                  "decode_w8kv8_tokens_per_sec": decode_w8kv8_tps},
     }
 
 
@@ -214,11 +216,14 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
         db, dp_len, dnew = (8, 128, 64) if on_tpu else (2, 8, 8)
         prompt = jnp.asarray(np.random.default_rng(1).integers(
             0, cfg.vocab_size, (db, dp_len)), jnp.int32)
-        def decode_rate(pp):
-            """Prefill-subtracted decode tokens/s for a params tree."""
+        def decode_rate(pp, kv=None):
+            """Prefill-subtracted decode tokens/s for a params tree;
+            ``kv="int8"`` also quantizes the KV cache (per-row scales,
+            in-kernel dequant)."""
             def make(n):
                 f = jax.jit(lambda pr: gen.generate(
-                    pp, pr, cfg, max_new_tokens=n, temperature=0.0))
+                    pp, pr, cfg, max_new_tokens=n, temperature=0.0,
+                    kv_cache_dtype=kv))
                 np.asarray(f(prompt))              # compile + host fence
                 return f
 
@@ -245,16 +250,17 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
     # int8 weight-only serving variant (decode is HBM-bound; int8 halves
     # the weight bytes) — only with budget left after the fp decode
     decode_int8_tps = None
+    int8_params = None
     if decode_tps is not None and (not on_tpu or remaining() > 120):
         try:
-            decode_int8_tps = decode_rate(
-                gen.quantize_weights(state.params, cfg))
+            int8_params = gen.quantize_weights(state.params, cfg)
+            decode_int8_tps = decode_rate(int8_params)
         except Exception as e:
             print(f"int8 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
     # per-group int4 variant (quarter weight bytes; reference weight_only
-    # int4 path) — cheapest-to-skip, so it goes last
+    # int4 path)
     decode_int4_tps = None
     if decode_int8_tps is not None and (not on_tpu or remaining() > 120):
         try:
@@ -264,8 +270,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"int4 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # weight-int8 + KV-int8: the serving sweet spot (both weight AND
+    # cache HBM traffic halved) — cheapest-to-skip, so it goes last
+    decode_w8kv8_tps = None
+    if decode_int8_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_w8kv8_tps = decode_rate(int8_params, kv="int8")
+        except Exception as e:
+            print(f"w8kv8 decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
-                   decode_int8_tps, decode_int4_tps)
+                   decode_int8_tps, decode_int4_tps, decode_w8kv8_tps)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

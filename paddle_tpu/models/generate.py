@@ -25,8 +25,26 @@ from . import llama
 from .llama import LlamaConfig, rope_tables, apply_rope, rms_norm
 
 
-def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               kv_dtype=None) -> Dict:
+    """``kv_dtype="int8"``: int8 KV cache with PER-ROW dequant scales
+    (each cached token row carries its own scale — self-calibrating, no
+    calibration pass), halving KV HBM for long-context decode
+    (reference: the cachekv-int8 tier of block_multihead_attention)."""
     L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.hd
+    if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
+        raise ValueError(
+            f"init_cache: kv_dtype={kv_dtype!r} is not supported — pass "
+            f"None (model dtype) or 'int8' (quantized cache with per-row "
+            f"scales); a silently full-precision cache would misreport "
+            f"the serving configuration")
+    if kv_dtype is not None:
+        return {
+            "k": jnp.zeros((L, batch, max_len, nkv, hd), jnp.int8),
+            "v": jnp.zeros((L, batch, max_len, nkv, hd), jnp.int8),
+            "ks": jnp.zeros((L, batch, max_len, nkv), jnp.float32),
+            "vs": jnp.zeros((L, batch, max_len, nkv), jnp.float32),
+        }
     return {
         "k": jnp.zeros((L, batch, max_len, nkv, hd), cfg.dtype),
         "v": jnp.zeros((L, batch, max_len, nkv, hd), cfg.dtype),
@@ -113,18 +131,26 @@ def _use_decode_kernel(override=None):
 
 
 def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
-                     kstart=None):
+                     kstart=None, k_rows=None, v_rows=None):
     """q (B,T,nh,hd) vs cache (B,Smax,nkv,hd); positions >= length masked.
     length: scalar or (B,) current valid length INCLUDING q's tokens.
     kstart: optional (B,) first VALID cache position per row (left-padded
-    ragged prompts — positions below it are pad slots and masked out)."""
+    ragged prompts — positions below it are pad slots and masked out).
+    k_rows/v_rows: per-row dequant scales (B, Smax, nkv) for an int8
+    cache (see init_cache kv_dtype)."""
     B, T, _, hd = q.shape
     if T == 1 and kstart is None and _use_decode_kernel(use_kernel):
         # single-token decode: fused block attention against the padded
-        # cache (reference: block_multi_head_attention_kernel.cu)
+        # cache (reference: block_multi_head_attention_kernel.cu); int8
+        # caches dequantize INSIDE the kernel
         from ..ops.pallas.fused import decode_attention
-        o = decode_attention(q[:, 0], ck, cv, length)
+        o = decode_attention(q[:, 0], ck, cv, length,
+                             k_dequant_rows=k_rows, v_dequant_rows=v_rows)
         return o[:, None]
+    if k_rows is not None:
+        # XLA fuses the dequant into the attention reads
+        ck = (ck.astype(jnp.float32) * k_rows[..., None]).astype(q.dtype)
+        cv = (cv.astype(jnp.float32) * v_rows[..., None]).astype(q.dtype)
     nkv = ck.shape[2]
     if nkv != nh:
         ck = jnp.repeat(ck, nh // nkv, axis=2)
@@ -153,11 +179,14 @@ def _rope_rows(x, cos, sin, rpos):
 
 
 def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
-                 use_kernel=None, rpos=None, kstart=None):
+                 use_kernel=None, rpos=None, kstart=None,
+                 cache_ks=None, cache_vs=None):
     """One decoder layer over T tokens starting at cache index ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
     rpos: optional (B,T) per-row rope positions (!= cache index when the
     batch is left-padded); kstart: optional (B,) first valid cache slot.
+    cache_ks/vs: (B, Smax, nkv) per-row dequant scales when the cache is
+    int8 (see init_cache kv_dtype).
     """
     B, T, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
@@ -173,18 +202,44 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
     else:
         q = _rope_rows(q, cos, sin, rpos)
         k = _rope_rows(k, cos, sin, rpos)
-    cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(
-        cache_k.dtype), pos, axis=1)
-    cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(
-        cache_v.dtype), pos, axis=1)
+    quant = cache_ks is not None
+
+    def _rowq(t):
+        """Per-row symmetric int8: (B,T,nkv,hd) -> (int8 rows,
+        (B,T,nkv) scales)."""
+        sc = jnp.maximum(jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1)
+                         / 127.0, 1e-8)
+        ti = jnp.clip(jnp.round(t.astype(jnp.float32) / sc[..., None]),
+                      -127, 127).astype(jnp.int8)
+        return ti, sc.astype(jnp.float32)
+
+    if quant:
+        kqr, ksc = _rowq(k)
+        vqr, vsc = _rowq(v)
+        cache_k = lax.dynamic_update_slice_in_dim(cache_k, kqr, pos,
+                                                  axis=1)
+        cache_v = lax.dynamic_update_slice_in_dim(cache_v, vqr, pos,
+                                                  axis=1)
+        cache_ks = lax.dynamic_update_slice_in_dim(cache_ks, ksc, pos,
+                                                   axis=1)
+        cache_vs = lax.dynamic_update_slice_in_dim(cache_vs, vsc, pos,
+                                                   axis=1)
+    else:
+        cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(
+            cache_k.dtype), pos, axis=1)
+        cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(
+            cache_v.dtype), pos, axis=1)
     o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh,
-                         use_kernel=use_kernel, kstart=kstart)
+                         use_kernel=use_kernel, kstart=kstart,
+                         k_rows=cache_ks if quant else None,
+                         v_rows=cache_vs if quant else None)
     x = x + o.reshape(B, T, nh * hd) @ _w(lp, "wo", x.dtype)
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     g = jax.nn.silu((h2 @ _w(lp, "wg", x.dtype)).astype(
         jnp.float32)).astype(x.dtype)
     u = h2 @ _w(lp, "wu", x.dtype)
-    return x + (g * u) @ _w(lp, "wd", x.dtype), cache_k, cache_v
+    return (x + (g * u) @ _w(lp, "wd", x.dtype), cache_k, cache_v,
+            cache_ks, cache_vs)
 
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
@@ -194,24 +249,33 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
     (B, V), updated cache)."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
+    quant = "ks" in cache
 
     def body(carry, layer_in):
         xc = carry
-        lp, ck, cv = layer_in
-        y, nk, nv = _block_infer(xc, lp, ck, cv, pos, cos, sin, cfg,
-                                 use_kernel=use_kernel, rpos=rpos,
-                                 kstart=kstart)
-        return y, (nk, nv)
+        if quant:
+            lp, ck, cv, cks, cvs = layer_in
+        else:
+            lp, ck, cv = layer_in
+            cks = cvs = None
+        y, nk, nv, nks, nvs = _block_infer(
+            xc, lp, ck, cv, pos, cos, sin, cfg, use_kernel=use_kernel,
+            rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs)
+        return y, ((nk, nv, nks, nvs) if quant else (nk, nv))
 
-    x, (new_k, new_v) = lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    xs = ((params["layers"], cache["k"], cache["v"], cache["ks"],
+           cache["vs"]) if quant else
+          (params["layers"], cache["k"], cache["v"]))
+    x, new = lax.scan(body, x, xs)
+    new_cache = ({"k": new[0], "v": new[1], "ks": new[2], "vs": new[3]}
+                 if quant else {"k": new[0], "v": new[1]})
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     if cfg.tie_embeddings:
         head = params["embed"].T.astype(x.dtype)
     else:
         head = _w(params, "lm_head", x.dtype)
     logits = (x[:, -1] @ head).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
@@ -222,8 +286,13 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
              eos_token_id: Optional[int] = None,
              pad_token_id: Optional[int] = None,
              prompt_lengths: Optional[jax.Array] = None,
-             use_kernel: Optional[bool] = None) -> jax.Array:
+             use_kernel: Optional[bool] = None,
+             kv_cache_dtype=None) -> jax.Array:
     """prompt (B, S_prompt) int32 -> (B, S_prompt + max_new_tokens).
+
+    ``kv_cache_dtype="int8"``: int8 KV cache with per-row dequant scales
+    (self-calibrating, halves KV HBM; the decode kernel dequants in
+    VMEM on TPU).
 
     greedy when temperature == 0, else temperature (+ optional top-k)
     sampling. Whole decode loop is one jitted scan.
@@ -242,7 +311,7 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     assert max_len >= total
     if key is None:
         key = jax.random.key(0)
-    cache = init_cache(cfg, B, max_len)
+    cache = init_cache(cfg, B, max_len, kv_dtype=kv_cache_dtype)
 
     rpos = kstart = None
     if prompt_lengths is not None:

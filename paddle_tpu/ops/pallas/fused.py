@@ -344,8 +344,20 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
                          block_k=block_k)
 
 
+def _decode_kernel_qrow(q_ref, k_ref, v_ref, ks_ref, vs_ref, len_ref,
+                        o_ref, acc, m_sc, l_sc, *, scale, block_k):
+    """int8-cache variant with PER-ROW dequant scales (each cached token
+    row carries its own scale — self-calibrating, no static calibration
+    pass): scales ride a (block_k, 1) VMEM block and broadcast over D."""
+    _decode_softmax_step(q_ref[0], k_ref[0], v_ref[0], len_ref[0],
+                         o_ref, acc, m_sc, l_sc, scale=scale,
+                         block_k=block_k, k_scale=ks_ref[0],
+                         v_scale=vs_ref[0])
+
+
 def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
-                     block_k: int = 512):
+                     block_k: int = 512, k_dequant_rows=None,
+                     v_dequant_rows=None):
     """Single-token flash attention against a padded KV cache (reference:
     block_multi_head_attention_kernel.cu decode path).
 
@@ -353,6 +365,11 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
     k_cache/v_cache: (B, S_max, HK, D); positions >= cache_len are masked
     cache_len: scalar or (B,) int32 valid-length(s)
     returns (B, H, D). GQA/MQA handled by head-group mapping, no repeat.
+
+    ``k/v_dequant_rows`` (cachekv-int8): (B, S_max, HK) fp32 PER-ROW
+    dequant scales for int8 caches — each cached token row carries its
+    own scale; dequantization happens in VMEM so HBM reads stay
+    1 byte/element.
     """
     B, H, D = q.shape
     S = k_cache.shape[1]
@@ -362,6 +379,11 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
     s = scale if scale is not None else 1.0 / math.sqrt(D)
     bk = min(block_k, S)
     cache_len = jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (B,))
+    if (k_dequant_rows is None) != (v_dequant_rows is None):
+        raise ValueError(
+            "decode_attention: k_dequant_rows and v_dequant_rows must be "
+            "passed together — int8 caches quantize both K and V")
+    quant = k_dequant_rows is not None
 
     # (B, S, HK, D) -> (B*HK, S, D); q -> (B*HK, rep, D): one grid row per
     # kv-head group so GQA costs no HBM duplication
@@ -370,16 +392,32 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
     qt = q.reshape(B, HK, rep, D).reshape(B * HK, rep, D)
     lens = jnp.repeat(cache_len, HK)
 
+    in_specs = [
+        pl.BlockSpec((1, rep, D), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
+    ]
+    inputs = [qt, kt, vt]
+    if quant:
+        def rows(sc):   # (B, S, HK) -> (B*HK, S, 1)
+            return jnp.asarray(sc, jnp.float32).transpose(
+                0, 2, 1).reshape(B * HK, S, 1)
+        in_specs += [pl.BlockSpec((1, bk, 1), lambda i, j: (i, j, 0)),
+                     pl.BlockSpec((1, bk, 1), lambda i, j: (i, j, 0))]
+        inputs += [rows(k_dequant_rows), rows(v_dequant_rows)]
+        kernel = functools.partial(_decode_kernel_qrow, scale=s,
+                                   block_k=bk)
+    else:
+        kernel = functools.partial(_decode_kernel, scale=s, block_k=bk)
+    in_specs.append(pl.BlockSpec(
+        (1,), lambda i, j: (i,),
+        memory_space=pltpu.SMEM if _PALLAS_OK else None))
+    inputs.append(lens)
+
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=s, block_k=bk),
+        kernel,
         grid=(B * HK, pl.cdiv(S, bk)),
-        in_specs=[
-            pl.BlockSpec((1, rep, D), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1,), lambda i, j: (i,),
-                         memory_space=pltpu.SMEM if _PALLAS_OK else None),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, rep, D), lambda i, j: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B * HK, rep, D), q.dtype),
         scratch_shapes=[
@@ -388,7 +426,7 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
             pltpu.VMEM((rep, 128), jnp.float32),
         ],
         interpret=_interp(),
-    )(qt, kt, vt, lens)
+    )(*inputs)
     return out.reshape(B, HK, rep, D).reshape(B, H, D)
 
 
