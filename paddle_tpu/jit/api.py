@@ -194,14 +194,12 @@ class StaticFunction:
     def _run_broken(self, sig, args, kwargs):
         """Recovery path for signatures that graph-broke: split execution
         (compiled regions + eager break statements) when supported, else
-        whole-function eager."""
+        whole-function eager. Grad-tracked inputs and Layer forwards are
+        handled by the split path itself: compiled regions are recorded
+        as single tape nodes and Layer params enter as dynamic
+        differentiated inputs (graph_break._JitSegment), so a break
+        inside a training forward keeps its prefix/suffix compiled."""
         from . import graph_break as gb
-        # grad-tracked inputs always take whole-function eager (the split
-        # path is no-tape; a partial tape would silently drop gradients) —
-        # checked per call because requires-grad is not part of the
-        # signature
-        if self._layer is not None or gb.inputs_require_grad(args, kwargs):
-            return self._fn(*args, **kwargs)
         sp = self._split_programs.get(sig, _NO_SPLIT)
         if sp is _NO_SPLIT:   # first broken call for this signature
             try:

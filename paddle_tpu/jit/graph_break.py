@@ -29,11 +29,26 @@ Mechanics:
   arguments; everything else is wrapped ``jax.tree_util.register_static``
   so it rides the jit cache key (the guard semantics of SOT: a changed
   static value retraces).
+- Autograd ACROSS regions (the reference keeps compiled regions live
+  under autograd — opcode_executor.py resumes with grad state): each
+  compiled region is recorded as ONE tape node whose vjp is the region's
+  ``jax.vjp`` — grad-tracked env tensors and Layer parameters enter as
+  differentiated jit arguments, region outputs carry the node, and the
+  eager break statement records per-op nodes as usual, so ``backward()``
+  walks the whole splice. ``create_graph=True`` through a region is not
+  supported (the region node has no re-traceable primitive).
+- Layers bound in the env (e.g. ``self`` of a Layer.forward): their
+  parameters/buffers are passed as *dynamic* jit inputs and patched into
+  the module during tracing (the ``functional_call`` idiom,
+  nn/layer/layers.py:326), so optimizer updates are picked up without
+  retracing and param gradients flow; in-trace buffer mutations (BN
+  running stats) are captured as region outputs and written back.
 
-Scope limits (whole-function eager fallback otherwise): plain functions
-only (Layer forwards keep the existing fallback), no generators/async, no
-writes to closure variables, inputs must not require grad (the compiled
-path is the inference/no-tape path — eager fallback keeps full autograd).
+Scope limits (whole-function eager fallback otherwise): no
+generators/async, no writes to closure variables, no grad-tracked
+tensors captured via globals/closure (only env/args/Layer state is
+differentiated), no Layers nested inside containers (top-level env
+bindings only).
 """
 from __future__ import annotations
 
@@ -47,6 +62,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from .._core.tensor import Tensor
 from .._core import autograd as ag
@@ -70,20 +86,44 @@ class _Static:
     value: Any
 
 
-def _wrap(v):
+def _wrap(v, deny_layers=False, dynamic_scalars=False):
     """Classify env values for the jit boundary: tensors dynamic, the
-    rest static (hashable) or unsupported."""
+    rest static (hashable) or unsupported. ``deny_layers`` rejects Layer
+    instances (nested in containers or flowing OUT of a region) — their
+    parameters would be baked as stale constants (inputs) or carry
+    tracers (outputs); only top-level env Layers get the dynamic-state
+    treatment in :class:`_JitSegment`.
+
+    ``dynamic_scalars`` (region INPUTS only): Python floats cross as
+    weak-typed 0-d arrays instead of static guards. Floats are
+    overwhelmingly data-derived (``.item()`` values — the archetypal
+    break) and churn every batch; as statics they would retrace per call
+    until the churn guard poisons the split. As dynamics the region
+    compiles once; float control flow inside just splits further (the
+    failing statement goes eager, the rest stays compiled). Ints/bools
+    stay static: they are overwhelmingly structural (shapes, counts,
+    flags) and low-cardinality. Weak typing (``jnp.asarray`` without a
+    dtype) preserves Python-scalar promotion — ``bf16 * n`` stays bf16."""
     if isinstance(v, (Tensor, jax.Array, np.ndarray)):
         return v
     if v is None:
         return None
+    if dynamic_scalars and isinstance(v, float):
+        return jnp.asarray(v)
+    if deny_layers:
+        from ..nn.layer.layers import Layer
+        if isinstance(v, Layer):
+            raise SplitUnsupported(
+                "a Layer nested in a container (or created inside a "
+                "compiled region) crosses a graph-break boundary")
     if isinstance(v, tuple) and hasattr(v, "_fields"):  # namedtuple
-        v2 = type(v)(*(_wrap(x) for x in v))
+        v2 = type(v)(*(_wrap(x, deny_layers, dynamic_scalars) for x in v))
         return v2
     if isinstance(v, (list, tuple)):
-        return type(v)(_wrap(x) for x in v)
+        return type(v)(_wrap(x, deny_layers, dynamic_scalars) for x in v)
     if isinstance(v, dict):
-        return {k: _wrap(x) for k, x in v.items()}
+        return {k: _wrap(x, deny_layers, dynamic_scalars)
+                for k, x in v.items()}
     try:
         hash(v)
     except TypeError:
@@ -249,27 +289,179 @@ class _JitSegment(_Segment):
         self._jitted = None
         self._amp_ctx = None
         self._trace_count = 0
+        # per-call layer state, read by _traced at trace time only (a
+        # changed layer identity is a changed _Static in the treedef, so
+        # cache hits never see a stale map)
+        self._cur_layer_maps = None
+        # id(layer) -> (params, buffers) enumeration, cached: walking a
+        # big module's tree + sorting every step would dominate the
+        # split-path hot loop. A param/buffer ADDED to the module after
+        # the first split call is not picked up — same accepted
+        # staleness class as rebound globals (see module docstring)
+        self._layer_enum = {}
 
     def cache_churned(self) -> bool:
         return self._trace_count > self.MAX_TRACES
 
-    def run(self, env, amp_ctx):
-        if self._jitted is None:
-            self._amp_ctx = amp_ctx
+    def _traced(self, diff_vals, rest, dyn_vals, treedef, diff_pos,
+                lp_diff_spec, lp_dyn_spec):
+        """The jitted region body. ``diff_vals``: raw values of the
+        differentiated inputs (env tensor leaves then layer params);
+        ``rest``: non-diff env leaves (None at diff positions);
+        ``dyn_vals``: raw values of frozen layer params + buffers.
+        Statics: env treedef, diff positions, and the (layer, name)
+        specs. Returns ``(primal_diff_outputs, aux)`` — the shape
+        ``jax.vjp(..., has_aux=True)`` differentiates."""
+        self._trace_count += 1
+        n_env = len(diff_pos)
+        leaves = list(rest)
+        for j, p in enumerate(diff_pos):
+            leaves[p] = Tensor(diff_vals[j], stop_gradient=True,
+                               _internal=True)
+        wenv = jax.tree_util.tree_unflatten(treedef, leaves)
+        raw = {k: _unwrap(v) for k, v in wenv.items()}
+        # patch layer params/buffers with the traced inputs (the
+        # functional_call idiom): restore originals in finally so no
+        # tracer ever survives in module state
+        patched = []  # (tensor, old_value, old_node, old_oi, set_value)
+        mut_spec, mut_vals = [], []
+        try:
+            for j, (li, pn) in enumerate(lp_diff_spec):
+                t = self._cur_layer_maps[li][pn]
+                patched.append((t, t._value, t._node, t._out_index,
+                                diff_vals[n_env + j]))
+                t._value = diff_vals[n_env + j]
+                t._node, t._out_index = None, 0
+            for j, (li, pn) in enumerate(lp_dyn_spec):
+                t = self._cur_layer_maps[li][pn]
+                patched.append((t, t._value, t._node, t._out_index,
+                                dyn_vals[j]))
+                t._value = dyn_vals[j]
+                t._node, t._out_index = None, 0
+            with self._amp_ctx(), ag.no_grad():
+                updates, flag, rv = self._exec(raw)
+            # in-trace mutations of layer state (BN running stats,
+            # in-place param writes) become extra region outputs,
+            # written back by run(); identity check against the patched
+            # value keeps this free when nothing mutates
+            for idx, (t, _, _, _, setv) in enumerate(patched):
+                if t._value is not setv:
+                    mut_spec.append(idx)
+                    mut_vals.append(t._value)
+        finally:
+            for t, old, node, oi, _ in patched:
+                t._value, t._node, t._out_index = old, node, oi
+        tree = ({k: _wrap(v, deny_layers=True) for k, v in updates.items()},
+                _wrap(flag), _wrap(rv, deny_layers=True),
+                _Static(tuple(mut_spec)), list(mut_vals))
+        oflat, otreedef = jax.tree_util.tree_flatten(
+            tree, is_leaf=lambda x: isinstance(x, Tensor))
+        odiff = tuple(i for i, v in enumerate(oflat)
+                      if isinstance(v, Tensor)
+                      and ag._is_diff_dtype(v.dtype))
+        od = set(odiff)
+        primal = tuple(oflat[i]._value for i in odiff)
+        aux_leaves = [None if i in od else v for i, v in enumerate(oflat)]
+        return primal, (aux_leaves, _Static((otreedef, odiff)))
 
-            def traced(wrapped_env):
-                self._trace_count += 1
-                raw = {k: _unwrap(v) for k, v in wrapped_env.items()}
-                with self._amp_ctx(), ag.no_grad():
-                    updates, flag, rv = self._exec(raw)
-                return ({k: _wrap(v) for k, v in updates.items()},
-                        flag, _wrap(rv))
-            self._jitted = jax.jit(traced)
-        wrapped = {k: _wrap(v) for k, v in env.items()
-                   if k in self._loads}
-        updates, flag, rv = self._jitted(wrapped)
-        env.update({k: _unwrap(v) for k, v in updates.items()})
-        return bool(flag), _unwrap(rv)
+    def run(self, env, amp_ctx):
+        from ..nn.layer.layers import Layer
+        if self._amp_ctx is None:
+            self._amp_ctx = amp_ctx
+        # -- partition the env: Layers get dynamic-state handling, the
+        # rest the usual wrap (nested Layers rejected -> SplitUnsupported)
+        wrapped = {}
+        layers = []  # (name, layer, [(pname, ptensor)], [(bufname, btensor)])
+        for k in self._loads:
+            if k not in env:
+                continue
+            v = env[k]
+            if isinstance(v, Layer):
+                enum = self._layer_enum.get(id(v))
+                if enum is None:
+                    ps = sorted(dict(v.named_parameters()).items())
+                    bs = sorted(dict(v.named_buffers()).items())
+                    enum = (ps, bs,
+                            {**dict(ps), **{"buf:" + bn: b
+                                            for bn, b in bs}})
+                    self._layer_enum[id(v)] = enum
+                layers.append((k, v, enum[0], enum[1], enum[2]))
+                wrapped[k] = _Static(v)
+            else:
+                wrapped[k] = _wrap(v, deny_layers=True,
+                                   dynamic_scalars=True)
+        layers.sort(key=lambda e: e[0])  # deterministic (li, pn) specs
+        flat, treedef = jax.tree_util.tree_flatten(
+            wrapped, is_leaf=lambda x: isinstance(x, Tensor))
+        grad_on = ag.is_grad_enabled()
+        diff_pos = tuple(
+            i for i, v in enumerate(flat)
+            if grad_on and isinstance(v, Tensor) and not v.stop_gradient
+            and ag._is_diff_dtype(v.dtype))
+        dset = set(diff_pos)
+        diff_tensors = [flat[i] for i in diff_pos]
+        rest = [None if i in dset else v for i, v in enumerate(flat)]
+        lp_diff, lp_dyn = [], []  # (li, name, tensor)
+        for li, (_, _, ps, bs, _) in enumerate(layers):
+            for pn, p in ps:
+                if grad_on and not p.stop_gradient and \
+                        ag._is_diff_dtype(p.dtype):
+                    lp_diff.append((li, pn, p))
+                else:
+                    lp_dyn.append((li, pn, p))
+            for bn, b in bs:
+                lp_dyn.append((li, "buf:" + bn, b))
+        diff_tensors += [p for _, _, p in lp_diff]
+        lp_diff_spec = tuple((li, pn) for li, pn, _ in lp_diff)
+        lp_dyn_spec = tuple((li, pn) for li, pn, _ in lp_dyn)
+        dyn_vals = [p._value for _, _, p in lp_dyn]
+        self._cur_layer_maps = [m for (_, _, _, _, m) in layers]
+
+        if self._jitted is None:
+            self._jitted = jax.jit(self._traced,
+                                   static_argnums=(3, 4, 5, 6))
+        dv = tuple(t._value for t in diff_tensors)
+        if diff_tensors:
+            primals, vjp_fn, aux = jax.vjp(
+                lambda d: self._jitted(d, rest, dyn_vals, treedef,
+                                       diff_pos, lp_diff_spec,
+                                       lp_dyn_spec),
+                dv, has_aux=True)
+        else:
+            primals, aux = self._jitted(dv, rest, dyn_vals, treedef,
+                                        diff_pos, lp_diff_spec,
+                                        lp_dyn_spec)
+            vjp_fn = None
+        aux_leaves, stat = aux
+        otreedef, odiff = stat.value
+        # the region is ONE tape node; its vjp routes cotangents to the
+        # diff env tensors and layer params (SOT's compiled-region-under-
+        # autograd capability, reference opcode_executor.py)
+        node = None
+        if vjp_fn is not None and odiff:
+            out_meta = [(tuple(p.shape), p.dtype) for p in primals]
+
+            def _region_vjp(cots, _vjp=vjp_fn):
+                (gs,) = _vjp(tuple(cots))
+                return list(gs)
+            node = ag.Node(_region_vjp, diff_tensors, out_meta, True,
+                           name=f"jit_region@{self.lo}")
+        leaves = list(aux_leaves)
+        for k, i in enumerate(odiff):
+            t = Tensor(primals[k], stop_gradient=node is None,
+                       _internal=True)
+            if node is not None:
+                t._node, t._out_index = node, k
+            leaves[i] = t
+        wup, wflag, wrv, mut_stat, mut_vals = jax.tree_util.tree_unflatten(
+            otreedef, leaves)
+        # write back in-trace layer-state mutations (BN running stats)
+        patch_list = lp_diff + lp_dyn
+        for ms, mv in zip(mut_stat.value, mut_vals):
+            patch_list[ms][2]._inplace_assign(
+                mv._value if isinstance(mv, Tensor) else mv)
+        env.update({k: _unwrap(v) for k, v in wup.items()})
+        return bool(_unwrap(wflag)), _unwrap(wrv)
 
 
 def _concretization_errors():
@@ -442,13 +634,3 @@ class SplitProgram:
         return None
 
 
-def inputs_require_grad(args, kwargs) -> bool:
-    """Grad-tracked inputs keep the whole-function eager fallback: the
-    compiled path is no-tape, and partial tapes would silently drop
-    gradient paths through compiled regions."""
-    if not ag.is_grad_enabled():
-        return False
-    leaves = jax.tree_util.tree_leaves(
-        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
-    return any(isinstance(t, Tensor) and not t.stop_gradient
-               for t in leaves)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import numpy as np
 import jax
@@ -379,11 +380,58 @@ def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=1024, block_q_bwd=None, block_k_bwd=None):
+_FLASH_WINNER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+    "FLASH_WINNER.json")
+_TUNED_BLOCKS = None  # cache; False = checked and absent/invalid
+
+
+def _tuned_blocks():
+    """Adopt the hardware-measured tiling winner (tools/flash_bench.py
+    writes FLASH_WINNER.json when a config beats the built-in default by
+    >2% fwd+bwd) so a live retune reaches every default-blocks caller
+    without a code change. Validated whole: malformed, out-of-range, or
+    stale (>14 d) records are ignored."""
+    global _TUNED_BLOCKS
+    if _TUNED_BLOCKS is not None:
+        return _TUNED_BLOCKS or None
+    _TUNED_BLOCKS = False
+    if os.environ.get("PADDLE_TPU_FLASH_TUNED", "1") == "0":
+        return None
+    try:
+        import json
+        import time
+        with open(_FLASH_WINNER) as f:
+            rec = json.load(f)
+        cfg = rec.get("cfg")
+        if (isinstance(cfg, list) and len(cfg) == 4
+                and all(c is None or (isinstance(c, int)
+                                      and 128 <= c <= 4096 and c % 128 == 0)
+                        for c in cfg)
+                and cfg[0] and cfg[1]
+                and time.time() - rec.get("recorded_unix", 0) < 14 * 86400):
+            _TUNED_BLOCKS = tuple(cfg)
+    except Exception:
+        pass
+    return _TUNED_BLOCKS or None
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, block_q_bwd=None, block_k_bwd=None):
     """(B, S, H, D) flash attention. Raw jax arrays in/out (op-layer wraps
     it into the Tensor/autograd surface). block_q_bwd/block_k_bwd
-    override the backward kernels' tiling (None = same as forward)."""
+    override the backward kernels' tiling (None = same as forward).
+    With all four block args left at None, a hardware-measured tiling
+    from FLASH_WINNER.json is adopted when present (else 512/1024)."""
+    if block_q is None and block_k is None and block_q_bwd is None \
+            and block_k_bwd is None:
+        tuned = _tuned_blocks()
+        if tuned is not None:
+            block_q, block_k, block_q_bwd, block_k_bwd = tuned
+    if block_q is None:
+        block_q = 512
+    if block_k is None:
+        block_k = 1024
     b, sq, h, d = q.shape
     sk = k.shape[1]
     hk = k.shape[2]
