@@ -9,6 +9,7 @@ automatically for numeric batches when built.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from typing import Any, Callable, List, Optional
@@ -298,6 +299,12 @@ class DataLoader:
                 num_workers = tuned
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        self._mp_pool = None
+        self._mp_failed = False
         self._is_iterable = isinstance(dataset, IterableDataset)
         if not self._is_iterable:
             if batch_sampler is not None:
@@ -313,6 +320,42 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers > 0:
+            # process workers (reference: multiprocessing.Process,
+            # dataloader_iter.py:459) for map-style datasets — true
+            # parallelism for GIL-bound Python transforms. Thread tier
+            # stays the fallback: IterableDataset (sequential iterator
+            # protocol), use_shared_memory=False, or unpicklable
+            # dataset/collate/worker_init_fn (warned once).
+            if not self._is_iterable and self.use_shared_memory and \
+                    not self._mp_failed and \
+                    os.environ.get("PADDLE_TPU_LOADER_THREADS") != "1":
+                from .mp_loader import MPLoaderIter, _MPPool
+                try:
+                    if self.persistent_workers:
+                        # one pool serves every epoch (spawn cost paid
+                        # once; reference: reader.py persistent_workers).
+                        # A pool whose workers all died (startup error in
+                        # epoch 1) is recreated so epoch 2 re-raises the
+                        # ROOT error instead of an opaque dead-worker one
+                        pool = self._mp_pool
+                        if pool is not None and not pool.closed and \
+                                not any(p.is_alive() for p in pool.procs):
+                            pool.close()
+                            pool = None
+                        if pool is None or pool.closed:
+                            self._mp_pool = _MPPool(self, self.num_workers)
+                        return MPLoaderIter(self, self.num_workers,
+                                            self.prefetch_factor,
+                                            pool=self._mp_pool)
+                    return MPLoaderIter(self, self.num_workers,
+                                        self.prefetch_factor)
+                except Exception as e:  # pickle/spawn failure
+                    self._mp_failed = True
+                    import warnings
+                    warnings.warn(
+                        f"DataLoader: multiprocess workers unavailable "
+                        f"({type(e).__name__}: {e}); falling back to "
+                        f"thread workers", stacklevel=2)
             return _PrefetchLoaderIter(self, self.num_workers,
                                        self.prefetch_factor)
         return _SingleProcessLoaderIter(self)
@@ -321,6 +364,14 @@ class DataLoader:
         if self._is_iterable:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
+
+    def __del__(self):
+        pool = getattr(self, "_mp_pool", None)
+        if pool is not None:
+            try:
+                pool.close()
+            except Exception:
+                pass
 
 
 class WorkerInfo:

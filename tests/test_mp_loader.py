@@ -1,0 +1,286 @@
+"""Multiprocess DataLoader workers (VERDICT r4 missing #3).
+
+reference: python/paddle/io/dataloader/worker.py:281 _worker_loop,
+dataloader_iter.py:459 (multiprocessing.Process), worker.py:184
+(_WorkerException). The TPU-native tier (paddle_tpu/io/mp_loader.py)
+spawns cpu-pinned worker processes and ships batch arrays through
+SharedMemory segments; datasets/collate/worker_init_fn must be
+module-level picklable — these classes are, deliberately.
+"""
+import os
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.mp_loader import MPLoaderIter
+
+
+class RangeDS(Dataset):
+    """Big-sample dataset: each sample > the shm threshold (64 KiB)."""
+
+    def __init__(self, n=24, dim=(64, 160)):  # 40 KiB f32 -> batch > 64K
+        self.n = n
+        self.dim = dim
+
+    def __getitem__(self, i):
+        return np.full(self.dim, i, np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+class SmallDS(Dataset):
+    def __getitem__(self, i):
+        return np.float32(i)
+
+    def __len__(self):
+        return 32
+
+
+class PairDS(Dataset):
+    """(dict, scalar) structured samples."""
+
+    def __getitem__(self, i):
+        return ({"x": np.full((8,), i, np.float32), "tag": str(i)},
+                np.int64(i))
+
+    def __len__(self):
+        return 12
+
+
+class BoomDS(Dataset):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("boom-13")
+        return np.float32(i)
+
+    def __len__(self):
+        return 32
+
+
+class WorkerIdDS(Dataset):
+    """Samples carry the worker id that produced them."""
+
+    def __getitem__(self, i):
+        from paddle_tpu.io import get_worker_info
+        wi = get_worker_info()
+        assert wi is not None and 0 <= wi.id < wi.num_workers
+        return np.array([i, wi.id], np.int64)
+
+    def __len__(self):
+        return 24
+
+
+def _mark_init(worker_id):
+    open(os.path.join(os.environ["PT_MP_MARK_DIR"],
+                      f"w{worker_id}"), "w").close()
+
+
+def _double_collate(samples):
+    import paddle_tpu as paddle
+    return paddle.to_tensor(np.stack(samples) * 2.0)
+
+
+def _uses_mp(loader):
+    it = iter(loader)
+    try:
+        return isinstance(it, MPLoaderIter)
+    finally:
+        close = getattr(it, "close", None)
+        if close:
+            close()
+
+
+class TestMPLoader:
+    def test_order_and_values_shm_path(self):
+        dl = DataLoader(RangeDS(), batch_size=4, shuffle=False,
+                        num_workers=2)
+        assert _uses_mp(dl)
+        got = [b.numpy() for b in dl]
+        assert len(got) == 6
+        for bi, b in enumerate(got):
+            assert b.shape == (4, 64, 160)
+            for j in range(4):
+                assert np.all(b[j] == bi * 4 + j)
+
+    def test_small_samples_pickle_path(self):
+        dl = DataLoader(SmallDS(), batch_size=8, shuffle=True,
+                        num_workers=2)
+        seen = []
+        for b in dl:
+            seen.extend(b.numpy().tolist())
+        assert sorted(seen) == list(range(32))
+
+    def test_structured_batch(self):
+        dl = DataLoader(PairDS(), batch_size=4, shuffle=False,
+                        num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 3
+        d, y = batches[1]
+        np.testing.assert_allclose(d["x"].numpy()[:, 0], [4, 5, 6, 7])
+        assert d["tag"] == ["4", "5", "6", "7"]
+        assert y.numpy().tolist() == [4, 5, 6, 7]
+
+    def test_error_propagates_with_worker_traceback(self):
+        dl = DataLoader(BoomDS(), batch_size=4, shuffle=False,
+                        num_workers=3)
+        with pytest.raises(ValueError, match="boom-13"):
+            list(dl)
+
+    def test_earlier_batches_delivered_before_error(self):
+        dl = DataLoader(BoomDS(), batch_size=4, shuffle=False,
+                        num_workers=3)
+        it = iter(dl)
+        got = [next(it).numpy().tolist() for _ in range(3)]
+        assert got[0] == [0, 1, 2, 3] and got[2] == [8, 9, 10, 11]
+        with pytest.raises(ValueError, match="boom-13"):
+            next(it)
+
+    def test_worker_init_fn_runs_in_every_worker(self):
+        with tempfile.TemporaryDirectory() as d:
+            os.environ["PT_MP_MARK_DIR"] = d
+            try:
+                dl = DataLoader(SmallDS(), batch_size=4, num_workers=2,
+                                worker_init_fn=_mark_init)
+                list(dl)
+                assert sorted(os.listdir(d)) == ["w0", "w1"]
+            finally:
+                os.environ.pop("PT_MP_MARK_DIR", None)
+
+    def test_get_worker_info_in_workers(self):
+        dl = DataLoader(WorkerIdDS(), batch_size=4, shuffle=False,
+                        num_workers=2)
+        rows = np.concatenate([b.numpy() for b in dl])
+        assert rows[:, 0].tolist() == list(range(24))
+        assert set(rows[:, 1]) <= {0, 1}
+
+    def test_custom_collate_runs_in_worker(self):
+        dl = DataLoader(SmallDS(), batch_size=4, shuffle=False,
+                        num_workers=2, collate_fn=_double_collate)
+        b0 = next(iter(dl))
+        assert b0.numpy().tolist() == [0.0, 2.0, 4.0, 6.0]
+
+    def test_unpicklable_dataset_falls_back_to_threads(self):
+        class LocalDS(Dataset):          # local class: not picklable
+            def __getitem__(self, i):
+                return np.float32(i)
+
+            def __len__(self):
+                return 8
+
+        dl = DataLoader(LocalDS(), batch_size=4, shuffle=False,
+                        num_workers=2)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = []
+            for b in dl:
+                got.extend(b.numpy().tolist())
+        assert got == [float(i) for i in range(8)]
+        assert any("falling back to thread" in str(m.message) for m in w)
+
+    def test_use_shared_memory_false_uses_threads(self):
+        dl = DataLoader(SmallDS(), batch_size=4, num_workers=2,
+                        use_shared_memory=False)
+        assert not _uses_mp(dl)
+
+    def test_early_break_no_leak(self):
+        dl = DataLoader(RangeDS(n=40), batch_size=4, num_workers=2)
+        it = iter(dl)
+        next(it)
+        next(it)
+        it.close()   # all in-flight shm released, procs torn down
+        assert all(not p.is_alive() for p in it._procs)
+
+
+class _Unpicklable:
+    def __reduce__(self):
+        raise TypeError("deliberately unpicklable")
+
+
+def _bad_collate(samples):
+    return _Unpicklable()
+
+
+class CustomExc(Exception):
+    pass
+
+
+class CustomBoomDS(Dataset):
+    """Raises a NON-builtin exception type: the worker ships only the
+    type name, so the parent degrades it to RuntimeError + traceback."""
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise CustomExc("custom-boom")
+        return np.float32(i)
+
+    def __len__(self):
+        return 16
+
+
+class InitBoom:
+    def __call__(self, worker_id):
+        raise ValueError("init-boom")
+
+
+class TestMPLoaderRobustness:
+    def test_unpicklable_batch_raises_instead_of_hanging(self):
+        dl = DataLoader(SmallDS(), batch_size=4, shuffle=False,
+                        num_workers=2, collate_fn=_bad_collate, timeout=30)
+        with pytest.raises(Exception, match="unpicklable"):
+            list(dl)
+
+    def test_custom_exception_degrades_to_runtimeerror(self):
+        dl = DataLoader(CustomBoomDS(), batch_size=4, shuffle=False,
+                        num_workers=2)
+        with pytest.raises(RuntimeError, match="custom-boom"):
+            list(dl)
+
+    def test_second_iterator_invalidates_first_on_persistent_pool(self):
+        dl = DataLoader(SmallDS(), batch_size=4, shuffle=False,
+                        num_workers=2, persistent_workers=True)
+        try:
+            it1 = iter(dl)
+            assert next(it1).numpy().tolist() == [0, 1, 2, 3]
+            it2 = iter(dl)           # invalidates it1
+            assert it1._closed
+            got = []
+            for b in it2:
+                got.extend(b.numpy().tolist())
+            assert got == list(range(32))
+        finally:
+            if dl._mp_pool is not None:
+                dl._mp_pool.close()
+
+    def test_persistent_pool_recreated_after_startup_death(self):
+        dl = DataLoader(SmallDS(), batch_size=4, num_workers=2,
+                        persistent_workers=True,
+                        worker_init_fn=InitBoom())
+        try:
+            with pytest.raises(ValueError, match="init-boom"):
+                list(dl)
+            # epoch 2 re-raises the ROOT error, not an opaque
+            # dead-worker RuntimeError
+            with pytest.raises(ValueError, match="init-boom"):
+                list(dl)
+        finally:
+            if dl._mp_pool is not None:
+                dl._mp_pool.close()
+
+    def test_persistent_pool_reused_across_epochs(self):
+        dl = DataLoader(SmallDS(), batch_size=4, shuffle=False,
+                        num_workers=2, persistent_workers=True)
+        try:
+            e1 = [b.numpy().tolist() for b in dl]
+            pool1 = dl._mp_pool
+            pids1 = [p.pid for p in pool1.procs]
+            e2 = [b.numpy().tolist() for b in dl]
+            assert dl._mp_pool is pool1
+            assert [p.pid for p in dl._mp_pool.procs] == pids1
+            assert e1 == e2
+        finally:
+            if dl._mp_pool is not None:
+                dl._mp_pool.close()

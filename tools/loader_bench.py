@@ -1,0 +1,79 @@
+"""DataLoader tier benchmark: thread pool vs multiprocess workers.
+
+The thread tier caps Python-transform throughput at ~1 core (GIL); the
+process tier (io/mp_loader.py) parallelizes it. This measures a
+transform-heavy dataset (pure-Python per-sample work, the worst case
+for threads) end to end through the public DataLoader API.
+
+Run: python tools/loader_bench.py [num_workers]
+Prints one JSON line per tier plus the speedup.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.io import DataLoader, Dataset  # noqa: E402
+
+
+class TransformHeavyDS(Dataset):
+    """Per-sample pure-Python transform (~1 ms of bytecode): stands in
+    for tokenization / albumentations-style augmentation pipelines."""
+
+    thread_safe = True
+
+    def __init__(self, n=256, work=4000):
+        self.n = n
+        self.work = work
+
+    def __getitem__(self, i):
+        acc = 0.0
+        for k in range(self.work):            # GIL-bound python loop
+            acc += (i * 31 + k) % 97
+        base = np.full((64, 64), np.float32(acc % 1000))
+        return (base + np.float32(i)).astype(np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+def run_tier(num_workers, use_mp):
+    os.environ.pop("PADDLE_TPU_LOADER_THREADS", None)
+    if not use_mp:
+        os.environ["PADDLE_TPU_LOADER_THREADS"] = "1"
+    ds = TransformHeavyDS()
+    dl = DataLoader(ds, batch_size=16, shuffle=False,
+                    num_workers=num_workers, persistent_workers=True)
+    # warm epoch (spawn + import cost excluded from the steady-state rate)
+    t_cold0 = time.perf_counter()
+    n = sum(1 for _ in dl)
+    cold = time.perf_counter() - t_cold0
+    t0 = time.perf_counter()
+    n = sum(1 for _ in dl)
+    dt = time.perf_counter() - t0
+    os.environ.pop("PADDLE_TPU_LOADER_THREADS", None)
+    return {"tier": "process" if use_mp else "thread",
+            "num_workers": num_workers, "batches": n,
+            "samples_per_sec": round(len(ds) / dt, 1),
+            "epoch_s": round(dt, 3), "first_epoch_s": round(cold, 3)}
+
+
+def main():
+    nw = int(sys.argv[1]) if len(sys.argv) > 1 else max(
+        2, min(8, (os.cpu_count() or 4) - 1))
+    thread = run_tier(nw, use_mp=False)
+    print("LOADER_BENCH " + json.dumps(thread))
+    proc = run_tier(nw, use_mp=True)
+    print("LOADER_BENCH " + json.dumps(proc))
+    speedup = proc["samples_per_sec"] / max(thread["samples_per_sec"], 1e-9)
+    print("LOADER_BENCH " + json.dumps(
+        {"speedup_process_over_thread": round(speedup, 2),
+         "cores": os.cpu_count()}))
+
+
+if __name__ == "__main__":
+    main()
