@@ -334,12 +334,14 @@ class DataLoader:
                     if self.persistent_workers:
                         # one pool serves every epoch (spawn cost paid
                         # once; reference: reader.py persistent_workers).
-                        # A pool whose workers all died (startup error in
-                        # epoch 1) is recreated so epoch 2 re-raises the
-                        # ROOT error instead of an opaque dead-worker one
+                        # A pool with ANY dead worker is recreated: a
+                        # startup error re-raises at root cause on the
+                        # fresh pool, and a partially-dead pool (one
+                        # OOM-killed worker) would otherwise trip the
+                        # dead-worker check spuriously in later epochs
                         pool = self._mp_pool
                         if pool is not None and not pool.closed and \
-                                not any(p.is_alive() for p in pool.procs):
+                                any(not p.is_alive() for p in pool.procs):
                             pool.close()
                             pool = None
                         if pool is None or pool.closed:

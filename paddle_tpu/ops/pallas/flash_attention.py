@@ -39,10 +39,33 @@ def set_interpret(v: bool):
     _INTERPRET = v
 
 
+_FORCE_COMPILE = False   # AOT lowering guard: emit Mosaic even off-TPU
+
+
+class force_compiled_lowering:
+    """Context manager for the AOT lowering guard (tests/test_pallas_
+    lowering.py): pretend the backend is a TPU so every kernel takes the
+    COMPILED (Mosaic) lowering path under ``jax.export(platforms=
+    ['tpu'])`` on a CPU host. Never use for execution — only lowering."""
+
+    def __enter__(self):
+        global _FORCE_COMPILE
+        self._old = _FORCE_COMPILE
+        _FORCE_COMPILE = True
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_COMPILE
+        _FORCE_COMPILE = self._old
+        return False
+
+
 def _interpret_mode() -> bool:
     """True when kernels must run in pallas interpret mode: forced by
     set_interpret, or whenever the backend is not a real TPU (CPU pallas
     lowering supports interpret only)."""
+    if _FORCE_COMPILE:
+        return False
     if _INTERPRET:
         return True
     try:

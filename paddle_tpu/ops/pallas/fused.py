@@ -339,7 +339,11 @@ def _decode_softmax_step(q, k, v, cache_len, o_ref, acc, m_sc, l_sc,
 
 def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc, m_sc, l_sc,
                    *, scale, block_k):
-    _decode_softmax_step(q_ref[0], k_ref[0], v_ref[0], len_ref[0],
+    # len_ref is the WHOLE (B*HK,) SMEM vector (Mosaic rejects rank-1
+    # blocks of size 1 that aren't lane-multiples — caught by the AOT
+    # lowering guard); index it by grid row
+    _decode_softmax_step(q_ref[0], k_ref[0], v_ref[0],
+                         len_ref[pl.program_id(0)],
                          o_ref, acc, m_sc, l_sc, scale=scale,
                          block_k=block_k)
 
@@ -349,7 +353,8 @@ def _decode_kernel_qrow(q_ref, k_ref, v_ref, ks_ref, vs_ref, len_ref,
     """int8-cache variant with PER-ROW dequant scales (each cached token
     row carries its own scale — self-calibrating, no static calibration
     pass): scales ride a (block_k, 1) VMEM block and broadcast over D."""
-    _decode_softmax_step(q_ref[0], k_ref[0], v_ref[0], len_ref[0],
+    _decode_softmax_step(q_ref[0], k_ref[0], v_ref[0],
+                         len_ref[pl.program_id(0)],
                          o_ref, acc, m_sc, l_sc, scale=scale,
                          block_k=block_k, k_scale=ks_ref[0],
                          v_scale=vs_ref[0])
@@ -409,8 +414,10 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None,
                                    block_k=bk)
     else:
         kernel = functools.partial(_decode_kernel, scale=s, block_k=bk)
+    # whole-vector SMEM block (Mosaic rank-1 rule: block dim must equal
+    # the array dim or be a lane multiple); kernels index by grid row
     in_specs.append(pl.BlockSpec(
-        (1,), lambda i, j: (i,),
+        (B * HK,), lambda i, j: (0,),
         memory_space=pltpu.SMEM if _PALLAS_OK else None))
     inputs.append(lens)
 
@@ -436,8 +443,10 @@ def _paged_decode_kernel(bt_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
     """Same online-softmax as _decode_kernel; k/v blocks arrive via the
     scalar-prefetched block-table index map (vLLM-style indirection), so
     the block refs carry (1, 1, page, D) with the page-pool dims leading.
-    """
-    _decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0], len_ref[0],
+    len_ref/scale refs are whole SMEM vectors indexed by grid row (the
+    Mosaic rank-1 block rule — AOT lowering guard)."""
+    _decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0],
+                         len_ref[pl.program_id(0)],
                          o_ref, acc, m_sc, l_sc, scale=scale,
                          block_k=page)
 
@@ -447,10 +456,11 @@ def _paged_decode_kernel_q(bt_ref, q_ref, k_ref, v_ref, len_ref, ks_ref,
                            page):
     """int8-page variant: per-row dequant scales ride SMEM; pages stay
     1 byte/element in HBM and dequantize in VMEM."""
-    _decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0], len_ref[0],
+    i = pl.program_id(0)
+    _decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0], len_ref[i],
                          o_ref, acc, m_sc, l_sc, scale=scale,
-                         block_k=page, k_scale=ks_ref[0],
-                         v_scale=vs_ref[0])
+                         block_k=page, k_scale=ks_ref[i],
+                         v_scale=vs_ref[i])
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
@@ -503,14 +513,14 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, cache_len, *,
                      lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
         pl.BlockSpec((1, 1, page, D),
                      lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
-        pl.BlockSpec((1,), lambda i, j, bt_: (i,),
+        pl.BlockSpec((B * HK,), lambda i, j, bt_: (0,),
                      memory_space=pltpu.SMEM if _PALLAS_OK else None),
     ]
     inputs = [bt, qt, kp, vp, lens]
     if quant:
         for _ in range(2):
             in_specs.append(pl.BlockSpec(
-                (1,), lambda i, j, bt_: (i,),
+                (B * HK,), lambda i, j, bt_: (0,),
                 memory_space=pltpu.SMEM if _PALLAS_OK else None))
         inputs += [_rows(k_dequant_scale), _rows(v_dequant_scale)]
         kernel = functools.partial(_paged_decode_kernel_q, scale=s,

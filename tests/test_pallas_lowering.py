@@ -1,0 +1,158 @@
+"""Pallas AOT lowering guard (VERDICT r4 weak #2 / next #5).
+
+Every Pallas kernel is lowered for the REAL TPU platform via
+``jax.export(platforms=['tpu'])`` on this CPU host — no device, no
+execution. This catches the interpret-passes-but-won't-lower bug class
+machine-side: the round-2/3 incident (PERF_NOTES) was rms/swiglu kernels
+green in interpret mode that failed Mosaic lowering on silicon (lane-dim
+slice); nothing in CI would have caught it before a live window.
+
+The assert is twofold: export succeeds AND the module actually contains
+a Mosaic custom call (``tpu_custom_call``) — a kernel that silently fell
+back to the jnp reference path would otherwise pass vacuously.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import fused
+
+
+def _lower_tpu(fn, *args, expect_mosaic=True):
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    mlir = exp.mlir_module()
+    if expect_mosaic:
+        assert "tpu_custom_call" in mlir, \
+            "kernel lowered without a Mosaic custom call (fell back?)"
+    return mlir
+
+
+# headline-bench-shaped operands, small but real tilings
+B, S, H, HK, D = 2, 1024, 4, 2, 128
+
+
+def _qkv(dtype=jnp.bfloat16):
+    rs = np.random.RandomState(0)
+    mk = lambda *sh: jnp.asarray(rs.randn(*sh), dtype)
+    return mk(B, S, H, D), mk(B, S, HK, D), mk(B, S, HK, D)
+
+
+class TestFlashLowering:
+    def test_fwd_lowers(self):
+        q, k, v = _qkv()
+        _lower_tpu(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True, block_q=512, block_k=512), q, k, v)
+
+    def test_fwd_bwd_lowers(self):
+        q, k, v = _qkv()
+
+        def loss(q, k, v):
+            o = fa.flash_attention(q, k, v, causal=True, block_q=512,
+                                   block_k=512)
+            return o.astype(jnp.float32).sum()
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+    def test_bwd_retune_blocks_lower(self):
+        """Every tiling in the flash_bench sweep must lower — the sweep
+        runs unattended in a live window; a config that cannot lower
+        would waste it."""
+        import tools.flash_bench as fb
+        q, k, v = _qkv()
+        for bq, bk, bqb, bkb in fb.CONFIGS:
+            def loss(q, k, v, bq=bq, bk=bk, bqb=bqb, bkb=bkb):
+                o = fa.flash_attention(q, k, v, causal=True, block_q=bq,
+                                       block_k=bk, block_q_bwd=bqb,
+                                       block_k_bwd=bkb)
+                return o.astype(jnp.float32).sum()
+            _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+    def test_noncausal_and_gqa_lower(self):
+        q, k, v = _qkv()
+        _lower_tpu(lambda q, k, v: fa.flash_attention(q, k, v), q, k, v)
+
+
+class TestFusedLowering:
+    def test_rms_norm_fwd_bwd(self):
+        x = jnp.ones((256, 1024), jnp.bfloat16)
+        w = jnp.ones((1024,), jnp.bfloat16)
+        _lower_tpu(fused.rms_norm, x, w)
+        _lower_tpu(jax.grad(
+            lambda x, w: fused.rms_norm(x, w).astype(jnp.float32).sum(),
+            argnums=(0, 1)), x, w)
+
+    def test_rms_norm_residual(self):
+        x = jnp.ones((256, 1024), jnp.bfloat16)
+        w = jnp.ones((1024,), jnp.bfloat16)
+        r = jnp.ones((256, 1024), jnp.bfloat16)
+        _lower_tpu(lambda x, w, r: fused.rms_norm(x, w, residual=r),
+                   x, w, r)
+
+    def test_swiglu_fwd_bwd(self):
+        g = jnp.ones((256, 1024), jnp.bfloat16)
+        u = jnp.ones((256, 1024), jnp.bfloat16)
+        _lower_tpu(fused.swiglu, g, u)
+        _lower_tpu(jax.grad(
+            lambda g, u: fused.swiglu(g, u).astype(jnp.float32).sum(),
+            argnums=(0, 1)), g, u)
+
+    def test_rope_fwd_bwd(self):
+        q = jnp.ones((B, S, H, D), jnp.bfloat16)
+        k = jnp.ones((B, S, HK, D), jnp.bfloat16)
+        cos = jnp.ones((S, D // 2), jnp.float32)
+        sin = jnp.ones((S, D // 2), jnp.float32)
+        _lower_tpu(fused.rope_qk, q, k, cos, sin)
+
+        def loss(q, k):
+            qo, ko = fused.rope_qk(q, k, cos, sin)
+            return (qo.astype(jnp.float32).sum()
+                    + ko.astype(jnp.float32).sum())
+        _lower_tpu(jax.grad(loss, argnums=(0, 1)), q, k)
+
+
+class TestDecodeLowering:
+    def test_contiguous_decode(self):
+        q = jnp.ones((B, H, D), jnp.bfloat16)
+        kc = jnp.ones((B, S, HK, D), jnp.bfloat16)
+        vc = jnp.ones((B, S, HK, D), jnp.bfloat16)
+        ln = jnp.full((B,), 17, jnp.int32)
+        _lower_tpu(lambda q, kc, vc, ln: fused.decode_attention(
+            q, kc, vc, ln), q, kc, vc, ln)
+
+    def test_contiguous_decode_int8_kv(self):
+        q = jnp.ones((B, H, D), jnp.bfloat16)
+        kc = jnp.ones((B, S, HK, D), jnp.int8)
+        vc = jnp.ones((B, S, HK, D), jnp.int8)
+        ks = jnp.ones((B, S, HK), jnp.float32)
+        vs = jnp.ones((B, S, HK), jnp.float32)
+        ln = jnp.full((B,), 17, jnp.int32)
+        _lower_tpu(lambda q, kc, vc, ks, vs, ln: fused.decode_attention(
+            q, kc, vc, ln, k_dequant_rows=ks, v_dequant_rows=vs),
+            q, kc, vc, ks, vs, ln)
+
+    def test_paged_decode(self):
+        page, npages, ppseq = 128, 16, 4
+        q = jnp.ones((B, H, D), jnp.bfloat16)
+        kp = jnp.ones((npages, HK, page, D), jnp.bfloat16)
+        vp = jnp.ones((npages, HK, page, D), jnp.bfloat16)
+        bt = jnp.zeros((B, ppseq), jnp.int32)
+        ln = jnp.full((B,), 100, jnp.int32)
+        _lower_tpu(lambda q, kp, vp, bt, ln: fused.paged_decode_attention(
+            q, kp, vp, bt, ln), q, kp, vp, bt, ln)
+
+    def test_paged_decode_int8(self):
+        page, npages, ppseq = 128, 16, 4
+        q = jnp.ones((B, H, D), jnp.bfloat16)
+        kp = jnp.ones((npages, HK, page, D), jnp.int8)
+        vp = jnp.ones((npages, HK, page, D), jnp.int8)
+        ks = jnp.ones((HK,), jnp.float32)
+        vs = jnp.ones((HK,), jnp.float32)
+        bt = jnp.zeros((B, ppseq), jnp.int32)
+        ln = jnp.full((B,), 100, jnp.int32)
+        _lower_tpu(
+            lambda q, kp, vp, bt, ln, ks, vs: fused.paged_decode_attention(
+                q, kp, vp, bt, ln, k_dequant_scale=ks, v_dequant_scale=vs),
+            q, kp, vp, bt, ln, ks, vs)
