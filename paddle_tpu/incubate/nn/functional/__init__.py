@@ -728,10 +728,19 @@ def block_multihead_attention(
     dequant scales (default 1/quant). Halves KV HBM, the long-context
     decode bandwidth win.
     """
-    if pre_key_cache is not None or pre_value_cache is not None:
-        raise NotImplementedError(
-            "block_multihead_attention: pre_key/value_cache (prompt "
-            "prefix cache) is not supported on this path")
+    if (pre_key_cache is None) != (pre_value_cache is None):
+        raise ValueError(
+            "block_multihead_attention: pre_key_cache and "
+            "pre_value_cache must be passed together")
+    # pre caches (reference: block_multihead_attention.py:45,86 —
+    # [B, num_head, pre_len, head_dim]): prefix-tuning-style virtual
+    # tokens PREPENDED to every sequence's attention context. They are
+    # fully visible to all queries, never occupy the paged cache, and do
+    # not shift real token positions (rope indices stay 0-based).
+    pre_k = (as_tensor(pre_key_cache)._value
+             if pre_key_cache is not None else None)
+    pre_v = (as_tensor(pre_value_cache)._value
+             if pre_value_cache is not None else None)
     qv = as_tensor(qkv)._value
     kc = as_tensor(key_cache)._value
     vc = as_tensor(value_cache)._value
@@ -799,6 +808,7 @@ def block_multihead_attention(
     from ....ops.pallas import fused as _pf
     if (rope_emb is None and mask is None and total == B
             and int(enc.max(initial=0)) == 0 and np.all(this == 1)
+            and pre_k is None
             and _pf.available()):   # True on TPU or under set_interpret
         q1 = q3[:, 0]                       # (B, nh, hd)
         pos = dec.astype(np.int64)
@@ -874,15 +884,29 @@ def block_multihead_attention(
         if cache_quant:
             ks = _dequant_ctx(ks, kdq, b)
             vs = _dequant_ctx(vs, vdq, b).astype(qv.dtype)
+        plen = 0
+        if pre_k is not None:
+            # prepend the prefix context: columns [0, plen) are virtual
+            # tokens visible to every query; cache columns shift right
+            plen = pre_k.shape[2]
+            ks = jnp.concatenate([pre_k[b].astype(ks.dtype), ks], axis=1)
+            vs = jnp.concatenate([pre_v[b].astype(vs.dtype), vs], axis=1)
         logits = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32),
                             ks.astype(jnp.float32)) / math.sqrt(hd)
         qpos = start + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
-        logits = jnp.where(kpos <= qpos, logits, -1e30)
+        logits = jnp.where((kpos < plen) | (kpos - plen <= qpos),
+                           logits, -1e30)
         if mask is not None:
             mv = as_tensor(mask)._value    # [B, 1, Smax, Smax]-broadcast
             mb = mv[b if mv.shape[0] > 1 else 0]
             mb = mb[..., start:start + t, :kl].astype(jnp.float32)
+            if plen:
+                # the user mask addresses real cache positions; prefix
+                # columns are additively transparent
+                mb = jnp.concatenate(
+                    [jnp.zeros(mb.shape[:-1] + (plen,), jnp.float32), mb],
+                    axis=-1)
             logits = logits + mb
         p = jax.nn.softmax(logits, axis=-1)
         o = jnp.einsum("hqk,hkd->qhd", p.astype(vs.dtype), vs)

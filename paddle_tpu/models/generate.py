@@ -278,6 +278,33 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
     return logits, new_cache
 
 
+def precompute_prompt_cache(params, prefix: jax.Array, cfg: LlamaConfig, *,
+                            kv_cache_dtype=None) -> Dict:
+    """Prefill a SHARED prompt prefix once and return its KV state for
+    reuse across requests (reference capability: pre_key_cache /
+    pre_value_cache of block_multihead_attention + the serving stacks'
+    system-prompt caching). The returned dict feeds
+    ``generate(prompt_cache=...)``, which skips re-prefilling the prefix
+    for every request — the standard shared-system-prompt win.
+
+    ``prefix``: (P,) or (1, P) int32 token ids. The prefix KV is stored
+    at exactly P positions — the consumer's own cache provides the
+    capacity for its prompt + new tokens. ``kv_cache_dtype`` must match
+    the consumer's (int8 prefixes feed int8 decode caches)."""
+    prefix = jnp.asarray(prefix, jnp.int32)
+    if prefix.ndim == 1:
+        prefix = prefix[None, :]
+    if prefix.shape[0] != 1:
+        raise ValueError(
+            "precompute_prompt_cache: the shared prefix is one sequence "
+            f"(got batch {prefix.shape[0]}); it is broadcast across the "
+            "request batch at generate() time")
+    P = prefix.shape[1]
+    cache = init_cache(cfg, 1, P, kv_dtype=kv_cache_dtype)
+    _, cache = _forward_cached(params, prefix, cache, 0, cfg, P)
+    return {"cache": cache, "len": P}
+
+
 def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
              max_new_tokens: int = 32, max_len: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
@@ -287,7 +314,8 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
              pad_token_id: Optional[int] = None,
              prompt_lengths: Optional[jax.Array] = None,
              use_kernel: Optional[bool] = None,
-             kv_cache_dtype=None) -> jax.Array:
+             kv_cache_dtype=None,
+             prompt_cache: Optional[Dict] = None) -> jax.Array:
     """prompt (B, S_prompt) int32 -> (B, S_prompt + max_new_tokens).
 
     ``kv_cache_dtype="int8"``: int8 KV cache with per-row dequant scales
@@ -304,14 +332,43 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     handling, python/paddle/generation/utils.py). Detection takes the
     leading run of pad ids; pass ``prompt_lengths`` (B,) instead when a
     row's genuine first token may equal the pad id.
+
+    ``prompt_cache``: a :func:`precompute_prompt_cache` result — the
+    shared prefix's KV is broadcast into every row's cache and the
+    per-request ``prompt`` continues at position P, so the prefix is
+    never re-prefilled (reference: pre_key/value_cache serving path).
+    The returned array holds ``prompt`` + new tokens (prefix excluded).
+    Decoded tokens match a run whose prompt is ``concat(prefix,
+    prompt)`` exactly.
     """
     B, S = prompt.shape
-    total = S + max_new_tokens
+    P = 0
+    if prompt_cache is not None:
+        if pad_token_id is not None or prompt_lengths is not None:
+            raise ValueError(
+                "generate: prompt_cache cannot be combined with left-"
+                "padded ragged prompts (pad_token_id/prompt_lengths) — "
+                "the shared prefix assumes aligned positions")
+        P = int(prompt_cache["len"])
+        pc = prompt_cache["cache"]
+        if ("ks" in pc) != (kv_cache_dtype is not None):
+            raise ValueError(
+                "generate: prompt_cache kv dtype does not match "
+                "kv_cache_dtype — an int8 prefix must feed an int8 cache")
+    total = P + S + max_new_tokens
     max_len = max_len or total
     assert max_len >= total
     if key is None:
         key = jax.random.key(0)
     cache = init_cache(cfg, B, max_len, kv_dtype=kv_cache_dtype)
+    if prompt_cache is not None:
+        # broadcast the prefix KV (batch 1) into every request row
+        for name, arr in cache.items():
+            src = prompt_cache["cache"][name][:, :, :P]
+            src = jnp.broadcast_to(
+                src, (src.shape[0], B) + src.shape[2:]).astype(arr.dtype)
+            cache[name] = lax.dynamic_update_slice_in_dim(
+                arr, src, 0, axis=2)
 
     rpos = kstart = None
     if prompt_lengths is not None:
@@ -332,7 +389,7 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
         # (_attn_with_cache bypasses the fused decode kernel itself
         # whenever kstart is set — it has no pad-slot mask)
 
-    logits, cache = _forward_cached(params, prompt, cache, 0, cfg,
+    logits, cache = _forward_cached(params, prompt, cache, P, cfg,
                                     max_len, rpos=rpos, kstart=kstart)
     # prefill uses the jnp path (multi-token); decode steps may use the
     # fused pallas kernel
@@ -377,7 +434,7 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
         drpos = (None if kstart is None
                  else (S + i - kstart)[:, None].astype(jnp.int32))
         logits, cache = _forward_cached(
-            params, tok[:, None], cache, S + i, cfg, max_len,
+            params, tok[:, None], cache, P + S + i, cfg, max_len,
             use_kernel=use_kernel, rpos=drpos, kstart=kstart)
         nxt = sample(logits, ks)
         if eos is not None:

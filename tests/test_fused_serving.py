@@ -367,15 +367,96 @@ class TestFusedServingEdgeCases:
         assert not np.allclose(np.asarray(out_r.numpy()),
                                np.asarray(out_n.numpy()))
 
-    def test_block_attention_pre_cache_raises(self):
-        with pytest.raises(NotImplementedError, match="pre_key"):
+    def test_block_attention_pre_cache_prefill_matches_dense(self):
+        """pre_key/value_cache (reference: block_multihead_attention.py:
+        45,86): prefix-tuning virtual tokens prepended to the context —
+        fully visible, never in the paged cache, no position shift."""
+        import math
+        nh, hd, bs, P = 2, 8, 4, 3
+        B, nblocks = 2, 4
+        rs = np.random.RandomState(7)
+        bt = np.array([[0, 1], [2, 3]], np.int32)
+        enc = np.array([5, 4], np.int32)
+        dec = np.array([0, 0], np.int32)
+        this = enc.copy()
+        total = int(this.sum())
+        qkv = (rs.randn(total, 3 * nh * hd) * 0.5).astype(np.float32)
+        pre_k = (rs.randn(B, nh, P, hd) * 0.5).astype(np.float32)
+        pre_v = (rs.randn(B, nh, P, hd) * 0.5).astype(np.float32)
+        out, _, _, _ = F.block_multihead_attention(
+            _t(qkv), _t(np.zeros((nblocks, nh, bs, hd), np.float32)),
+            _t(np.zeros((nblocks, nh, bs, hd), np.float32)),
+            _t(enc), _t(dec), _t(this), block_tables=_t(bt),
+            block_size=bs, pre_key_cache=_t(pre_k),
+            pre_value_cache=_t(pre_v))
+        got = np.asarray(out.numpy())
+
+        q3 = qkv.reshape(total, 3, nh, hd)
+        tok = 0
+        for b in range(B):
+            t = int(this[b])
+            q = q3[tok:tok + t, 0]
+            ks = np.concatenate(
+                [pre_k[b].transpose(1, 0, 2), q3[tok:tok + t, 1]], 0)
+            vs = np.concatenate(
+                [pre_v[b].transpose(1, 0, 2), q3[tok:tok + t, 2]], 0)
+            logits = np.einsum("qhd,khd->hqk", q, ks) / math.sqrt(hd)
+            qpos = np.arange(t)[None, :, None]
+            kpos = np.arange(P + t)[None, None, :]
+            logits = np.where((kpos < P) | (kpos - P <= qpos), logits,
+                              -1e30)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            want = np.einsum("hqk,khd->qhd", p, vs).reshape(t, nh * hd)
+            np.testing.assert_allclose(got[tok:tok + t], want, atol=1e-4,
+                                       err_msg=f"row {b}")
+            tok += t
+
+    def test_block_attention_pre_cache_decode(self):
+        """Decode rows see the prefix too (loop path, since the Pallas
+        pure-decode fast path excludes pre caches)."""
+        import math
+        nh, hd, bs, P = 2, 8, 4, 2
+        rs = np.random.RandomState(8)
+        bt = np.array([[0, 1]], np.int32)
+        kc = np.zeros((2, nh, bs, hd), np.float32)
+        vc = np.zeros((2, nh, bs, hd), np.float32)
+        dl = 3
+        kd = (rs.randn(dl, nh, hd) * 0.5).astype(np.float32)
+        vd = (rs.randn(dl, nh, hd) * 0.5).astype(np.float32)
+        for j in range(dl):
+            kc[j // bs, :, j % bs] = kd[j]
+            vc[j // bs, :, j % bs] = vd[j]
+        enc = np.array([0], np.int32)
+        dec = np.array([dl], np.int32)
+        this = np.array([1], np.int32)
+        qkv = (rs.randn(1, 3 * nh * hd) * 0.5).astype(np.float32)
+        pre_k = (rs.randn(1, nh, P, hd) * 0.5).astype(np.float32)
+        pre_v = (rs.randn(1, nh, P, hd) * 0.5).astype(np.float32)
+        out, _, _, _ = F.block_multihead_attention(
+            _t(qkv), _t(kc), _t(vc), _t(enc), _t(dec), _t(this),
+            block_tables=_t(bt), block_size=bs,
+            pre_key_cache=_t(pre_k), pre_value_cache=_t(pre_v))
+        got = np.asarray(out.numpy())
+
+        q3 = qkv.reshape(1, 3, nh, hd)
+        ks = np.concatenate([pre_k[0].transpose(1, 0, 2), kd, q3[:1, 1]], 0)
+        vs = np.concatenate([pre_v[0].transpose(1, 0, 2), vd, q3[:1, 2]], 0)
+        logits = np.einsum("qhd,khd->hqk", q3[:1, 0], ks) / math.sqrt(hd)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)   # decode row: everything visible
+        want = np.einsum("hqk,khd->qhd", p, vs).reshape(1, nh * hd)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_block_attention_pre_cache_k_only_raises(self):
+        with pytest.raises(ValueError, match="together"):
             F.block_multihead_attention(
                 _t(_r(1, 48)), _t(np.zeros((1, 2, 4, 8), np.float32)),
                 _t(np.zeros((1, 2, 4, 8), np.float32)),
                 _t(np.array([1], np.int32)), _t(np.array([0], np.int32)),
                 _t(np.array([1], np.int32)),
                 block_tables=_t(np.array([[0]], np.int32)),
-                pre_key_cache=_t(np.zeros((1,), np.float32)))
+                pre_key_cache=_t(np.zeros((1, 2, 3, 8), np.float32)))
 
 
 class TestFusedLayers:

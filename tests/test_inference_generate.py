@@ -543,3 +543,88 @@ class TestQuantizedServing:
         denom = np.maximum(np.abs(np.asarray(lf)), 1.0)
         rel = np.abs(np.asarray(lf) - np.asarray(lq)) / denom
         assert rel.max() < 0.15, rel.max()
+
+
+class TestPromptCache:
+    """Shared-system-prompt KV reuse (VERDICT r4 missing #4; reference:
+    pre_key/value_cache serving path): decode parity vs re-prefilling
+    the full prompt, across fp and int8 KV tiers."""
+
+    def _setup(self, seed=0):
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(jax.random.key(1), cfg)
+        rng = np.random.default_rng(seed)
+        prefix = jnp.asarray(rng.integers(0, cfg.vocab_size, (6,)),
+                             jnp.int32)
+        user = jnp.asarray(rng.integers(0, cfg.vocab_size, (3, 4)),
+                           jnp.int32)
+        return cfg, params, prefix, user
+
+    def test_greedy_parity_vs_full_prefill(self):
+        cfg, params, prefix, user = self._setup()
+        full_prompt = jnp.concatenate(
+            [jnp.broadcast_to(prefix[None], (3, 6)), user], axis=1)
+        want = generate.generate(params, full_prompt, cfg,
+                                 max_new_tokens=6, temperature=0.0)
+        pc = generate.precompute_prompt_cache(params, prefix, cfg)
+        got = generate.generate(params, user, cfg, max_new_tokens=6,
+                                temperature=0.0, max_len=32,
+                                prompt_cache=pc)
+        # cached output excludes the prefix: compare generated tails
+        np.testing.assert_array_equal(np.asarray(got[:, 4:]),
+                                      np.asarray(want[:, 10:]))
+
+    def test_int8_kv_prompt_cache_parity(self):
+        cfg, params, prefix, user = self._setup(seed=3)
+        full_prompt = jnp.concatenate(
+            [jnp.broadcast_to(prefix[None], (3, 6)), user], axis=1)
+        want = generate.generate(params, full_prompt, cfg,
+                                 max_new_tokens=5, temperature=0.0,
+                                 kv_cache_dtype="int8")
+        pc = generate.precompute_prompt_cache(params, prefix, cfg,
+                                              kv_cache_dtype="int8")
+        got = generate.generate(params, user, cfg, max_new_tokens=5,
+                                temperature=0.0, max_len=32,
+                                kv_cache_dtype="int8", prompt_cache=pc)
+        np.testing.assert_array_equal(np.asarray(got[:, 4:]),
+                                      np.asarray(want[:, 10:]))
+
+    def test_kernel_decode_path_with_prompt_cache(self):
+        """The paged/fused decode kernel path (interpret mode on CPU)
+        agrees with the jnp path under a prompt cache."""
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        cfg, params, prefix, user = self._setup(seed=5)
+        pc = generate.precompute_prompt_cache(params, prefix, cfg)
+        ref = generate.generate(params, user, cfg, max_new_tokens=4,
+                                temperature=0.0, max_len=32,
+                                prompt_cache=pc, use_kernel=False)
+        fa.set_interpret(True)
+        try:
+            got = generate.generate(params, user, cfg, max_new_tokens=4,
+                                    temperature=0.0, max_len=32,
+                                    prompt_cache=pc, use_kernel=True)
+        finally:
+            fa.set_interpret(False)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_mismatched_kv_dtype_raises(self):
+        cfg, params, prefix, user = self._setup()
+        pc = generate.precompute_prompt_cache(params, prefix, cfg)
+        with pytest.raises(ValueError, match="int8"):
+            generate.generate(params, user, cfg, max_new_tokens=2,
+                              max_len=32, kv_cache_dtype="int8",
+                              prompt_cache=pc)
+
+    def test_prompt_cache_with_padding_raises(self):
+        cfg, params, prefix, user = self._setup()
+        pc = generate.precompute_prompt_cache(params, prefix, cfg)
+        with pytest.raises(ValueError, match="prompt_cache"):
+            generate.generate(params, user, cfg, max_new_tokens=2,
+                              max_len=32, prompt_cache=pc,
+                              pad_token_id=0)
+
+    def test_batched_prefix_rejected(self):
+        cfg, params, prefix, user = self._setup()
+        with pytest.raises(ValueError, match="one sequence"):
+            generate.precompute_prompt_cache(
+                params, jnp.stack([prefix, prefix]), cfg)
