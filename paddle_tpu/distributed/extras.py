@@ -257,32 +257,14 @@ def shard_scaler(scaler):
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
           weight_attr=None, bias_attr=None, name=None):
     """reference: fleet/layers/mpu/mp_ops.py split:714 — one-call
-    model-parallel embedding/linear over the mp group."""
-    from .fleet.fleet import get_hybrid_communicate_group
-    from .fleet.layers.mpu.mp_layers import (
-        VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear)
-    hcg = get_hybrid_communicate_group()
-    group = hcg.get_model_parallel_group() if hcg is not None else None
-    if operation == "embedding":
-        layer = VocabParallelEmbedding(size[0], size[1],
-                                       weight_attr=weight_attr,
-                                       mp_group=group)
-        return layer(x)
-    if operation == "linear":
-        if axis == 0:
-            layer = RowParallelLinear(size[0], size[1],
-                                      weight_attr=weight_attr,
-                                      has_bias=bias_attr is not False,
-                                      input_is_parallel=False,
-                                      mp_group=group)
-        else:
-            layer = ColumnParallelLinear(size[0], size[1],
-                                         weight_attr=weight_attr,
-                                         has_bias=bias_attr is not False,
-                                         gather_output=gather_out,
-                                         mp_group=group)
-        return layer(x)
-    raise ValueError(f"unsupported operation {operation!r}")
+    model-parallel embedding/linear over the mp group. Delegates to
+    mpu.mp_ops.split, whose per-(name, shape) layer cache gives the
+    reference's create-once parameter semantics (a fresh layer per call
+    would re-initialize weights every step)."""
+    from .fleet.layers.mpu.mp_ops import split as _split
+    return _split(x, size, operation=operation, axis=axis,
+                  num_partitions=num_partitions, gather_out=gather_out,
+                  weight_attr=weight_attr, bias_attr=bias_attr, name=name)
 
 
 # ---------------- PS sparse-table entry configs ----------------

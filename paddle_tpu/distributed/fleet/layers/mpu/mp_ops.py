@@ -155,11 +155,71 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
     return loss
 
 
-def split(x, size, num_partitions=1, operation="linear", axis=0, gather_out=True):
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
     """reference: mp_ops.py:714 paddle.distributed.split — one-shot
-    parallel linear/embedding. Provided for API parity; prefer the
-    ColumnParallelLinear/RowParallelLinear layers."""
-    raise NotImplementedError(
-        "paddle_tpu: use fleet.meta_parallel ColumnParallelLinear/"
-        "RowParallelLinear/VocabParallelEmbedding instead of "
-        "distributed.split")
+    parallel linear/embedding over the model-parallel group.
+
+    - ``operation="embedding"`` (axis must be 0): VocabParallelEmbedding
+      over ``size=(vocab, dim)``.
+    - ``operation="linear", axis=0``: RowParallelLinear — weight rows
+      split; outputs partial-sum-reduced over the mp group.
+    - ``operation="linear", axis=1``: ColumnParallelLinear — weight
+      columns split; ``gather_out`` gathers the column shards.
+
+    TPU-native: the created layer's weights carry mp shardings and GSPMD
+    inserts the collectives. Like the reference, each (unnamed) call
+    creates a FRESH layer — split is a model-construction helper, called
+    once per projection. Passing ``name`` opts into create-once reuse:
+    repeated calls with the same name (and config, and mp group) return
+    the same parameters, so split can live inside a per-step forward."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation not in ("linear", "embedding"):
+        raise ValueError(
+            f"distributed.split: operation must be 'linear' or "
+            f"'embedding', got {operation!r}")
+    if len(tuple(size)) != 2:
+        raise ValueError(f"distributed.split: size must be (in, out), "
+                         f"got {size}")
+    g = _mp_group(None)
+    if num_partitions not in (1, max(g.nranks, 1)):
+        raise ValueError(
+            f"distributed.split: num_partitions={num_partitions} does "
+            f"not match the model-parallel degree {max(g.nranks, 1)}")
+    cache = getattr(split, "_layers", None)
+    if cache is None:
+        cache = split._layers = {}
+    # group identity is part of the key: a fleet re-init with a new mesh
+    # must not resurrect layers sharded over the old one
+    key = (name, operation, axis, tuple(size), bool(gather_out),
+           bias_attr is not False, g.nranks, id(g.mesh))
+    layer = cache.get(key) if name is not None else None
+    if layer is None:
+        if operation == "embedding":
+            if axis != 0:
+                raise ValueError(
+                    "distributed.split(embedding): only axis=0 is "
+                    "supported (vocab-dimension split), got "
+                    f"axis={axis}")
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr,
+                                           name=name)
+        elif axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False, name=name)
+        elif axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out,
+                                         name=name)
+        else:
+            raise ValueError(
+                f"distributed.split(linear): axis must be 0 (row "
+                f"parallel) or 1 (column parallel), got {axis}")
+        if name is not None:
+            cache[key] = layer
+    return layer(x)
