@@ -52,6 +52,8 @@ from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
+from . import strings  # noqa: E402
+from .strings import pstring  # noqa: E402
 from . import version  # noqa: E402
 from . import utils  # noqa: E402
 from . import onnx  # noqa: E402

@@ -258,9 +258,11 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
           weight_attr=None, bias_attr=None, name=None):
     """reference: fleet/layers/mpu/mp_ops.py split:714 — one-call
     model-parallel embedding/linear over the mp group. Delegates to
-    mpu.mp_ops.split, whose per-(name, shape) layer cache gives the
-    reference's create-once parameter semantics (a fresh layer per call
-    would re-initialize weights every step)."""
+    mpu.mp_ops.split. ``name=`` is REQUIRED for create-once parameter
+    reuse: only named calls hit the per-(name, config, mesh) layer cache;
+    an unnamed call builds a fresh layer with freshly initialized weights
+    every time (fine at model construction, wrong inside a per-step
+    forward)."""
     from .fleet.layers.mpu.mp_ops import split as _split
     return _split(x, size, operation=operation, axis=axis,
                   num_partitions=num_partitions, gather_out=gather_out,

@@ -71,7 +71,7 @@ class ColumnParallelLinear(Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, gather_output=True, fuse_matmul_bias=False,
-                 mp_group=None, name=None):
+                 mp_group=None, name=None, bias_attr=None):
         super().__init__()
         self._group = _mp_group(mp_group)
         self.gather_output = gather_output
@@ -81,7 +81,10 @@ class ColumnParallelLinear(Layer):
                 f"out_features {out_features} not divisible by mp degree {n}")
         self.weight = self.create_parameter([in_features, out_features],
                                             attr=weight_attr)
-        self.bias = self.create_parameter([out_features], attr=weight_attr,
+        # bias gets its OWN attr (default zero-init like Megatron;
+        # reference mp_layers.py:442 Constant(0.0)) — never weight_attr,
+        # whose initializer expects the weight's 2-D shape
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
                                           is_bias=True) if has_bias else None
         if n > 1:
             ax = self._group.axis_names[0]
@@ -109,7 +112,8 @@ class RowParallelLinear(Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False,
-                 fuse_matmul_bias=False, mp_group=None, name=None):
+                 fuse_matmul_bias=False, mp_group=None, name=None,
+                 bias_attr=None):
         super().__init__()
         self._group = _mp_group(mp_group)
         self.input_is_parallel = input_is_parallel
@@ -120,8 +124,10 @@ class RowParallelLinear(Layer):
         self.weight = self.create_parameter([in_features, out_features],
                                             attr=weight_attr)
         # bias is NOT sharded and added after the reduce (reference keeps a
-        # full bias on every rank and adds post-allreduce)
-        self.bias = self.create_parameter([out_features], attr=weight_attr,
+        # full bias on every rank and adds post-allreduce); it gets its OWN
+        # attr (default zero-init, reference mp_layers.py:678) — never
+        # weight_attr, whose initializer expects the weight's 2-D shape
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
                                           is_bias=True) if has_bias else None
         if n > 1:
             _shard_param(self.weight, self._group.mesh,

@@ -190,12 +190,36 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
     cache = getattr(split, "_layers", None)
     if cache is None:
         cache = split._layers = {}
-    # group identity is part of the key: a fleet re-init with a new mesh
-    # must not resurrect layers sharded over the old one
+    # the mesh OBJECT is part of the key (jax.sharding.Mesh is hashable;
+    # holding it in the key also keeps it alive, so — unlike an id() key —
+    # a GC'd-and-reallocated mesh can never collide): a fleet re-init with
+    # a different mesh must not resurrect layers sharded over the old one.
+    # Attrs are NOT in the key (an inline-constructed ParamAttr would miss
+    # every step and re-initialize); instead the creation-time attrs are
+    # remembered and a later mismatch warns that attrs only apply at
+    # creation.
     key = (name, operation, axis, tuple(size), bool(gather_out),
-           bias_attr is not False, g.nranks, id(g.mesh))
-    layer = cache.get(key) if name is not None else None
-    if layer is None:
+           bias_attr is not False, g.nranks, g.mesh)
+    entry = cache.get(key) if name is not None else None
+    if name is not None and entry is None and \
+            any(k[0] == name and k != key for k in cache):
+        import warnings
+        warnings.warn(
+            f"distributed.split(name={name!r}): called with a DIFFERENT "
+            "config than an existing layer of the same name — a second "
+            "parameter set will be created for this config. If this is "
+            "the per-step forward of a layer built at construction time, "
+            "the configs must match exactly for reuse.", stacklevel=2)
+    if entry is not None:
+        layer, w0, b0 = entry
+        if w0 is not weight_attr or b0 is not bias_attr:
+            import warnings
+            warnings.warn(
+                f"distributed.split(name={name!r}): weight_attr/bias_attr "
+                "differ from the layer's creation-time attrs and are "
+                "ignored — attrs only apply when the named layer is first "
+                "created", stacklevel=2)
+    else:
         if operation == "embedding":
             if axis != 0:
                 raise ValueError(
@@ -208,11 +232,15 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
         elif axis == 0:
             layer = RowParallelLinear(size[0], size[1],
                                       weight_attr=weight_attr,
+                                      bias_attr=(None if bias_attr is False
+                                                 else bias_attr),
                                       has_bias=bias_attr is not False,
                                       input_is_parallel=False, name=name)
         elif axis == 1:
             layer = ColumnParallelLinear(size[0], size[1],
                                          weight_attr=weight_attr,
+                                         bias_attr=(None if bias_attr is
+                                                    False else bias_attr),
                                          has_bias=bias_attr is not False,
                                          gather_output=gather_out,
                                          name=name)
@@ -221,5 +249,14 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
                 f"distributed.split(linear): axis must be 0 (row "
                 f"parallel) or 1 (column parallel), got {axis}")
         if name is not None:
-            cache[key] = layer
+            # evict entries built over OTHER meshes before inserting: a
+            # fleet re-init must not pin dead meshes' parameter buffers.
+            # Same-ness is EQUALITY (!=) to match the cache lookup: an
+            # equal-but-distinct Mesh object after a re-init keeps its
+            # entries (identical devices/axes -> identical shardings);
+            # identity-based eviction here would silently re-initialize
+            # named layers that lookup had just been serving
+            for k in [k for k in cache if k[7] != g.mesh]:
+                del cache[k]
+            cache[key] = (layer, weight_attr, bias_attr)
     return layer(x)

@@ -358,10 +358,24 @@ class PipelineParallel(MetaParallelBase):
         def pre_apply(pp_, mb):
             return jax.vmap(lambda xi: apply_layers(pre, pp_, xi))(mb)
 
+        # Gradients must ACCUMULATE in f32 even for bf16 params: cotangents
+        # match the primal dtype, so the differentiated-against trees are
+        # f32 VIEWS, cast back to native dtype before compute (the stored
+        # params stay native; the f32 copies are in-graph only).
+        def f32_view(tree):
+            return jax.tree.map(
+                lambda a: a.astype(jnp.float32)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+        def native_cast(tree, ref):
+            return jax.tree.map(lambda a, r: a.astype(r.dtype), tree, ref)
+
         if self._spmd_step is None:
             if schedule in ("1f1b", "zero_bubble"):
                 def run(v, prp, hdp, mb, lab):
-                    mbs, vjp_pre = jax.vjp(lambda q: pre_apply(q, mb), prp)
+                    mbs, vjp_pre = jax.vjp(
+                        lambda q: pre_apply(native_cast(q, prp), mb),
+                        f32_view(prp))
                     loss, dv, dhead, dmbs = pp_spmd.pipeline_hetero_1f1b(
                         stage_fns, head_loss, v, specs, hdp, mbs, lab,
                         mesh, defer_dw=(schedule == "zero_bubble"))
@@ -369,8 +383,11 @@ class PipelineParallel(MetaParallelBase):
                     return loss, (dv, dpre, dhead)
             else:  # gpipe / interleaved wavefront, AD backward
                 def run(v, prp, hdp, mb, lab):
+                    v32 = jax.tree.map(
+                        lambda a: a.astype(jnp.float32), v)
+
                     def total(v_, prp_, hdp_):
-                        mbs = pre_apply(prp_, mb)
+                        mbs = pre_apply(native_cast(prp_, prp), mb)
                         if schedule == "interleave":
                             outs = pp_spmd.pipeline_hetero_interleave(
                                 stage_fns, v_, specs, mbs, mesh,
@@ -378,20 +395,22 @@ class PipelineParallel(MetaParallelBase):
                         else:
                             outs = pp_spmd.pipeline_hetero(
                                 stage_fns, v_, specs, mbs, mesh)
+                        hp = native_cast(hdp_, hdp)
                         losses = jax.vmap(
-                            lambda y, l: head_loss(hdp_, y, l))(outs, lab)
+                            lambda y, l: head_loss(hp, y, l))(outs, lab)
                         return jnp.mean(losses)
                     return jax.value_and_grad(total, argnums=(0, 1, 2))(
-                        v, prp, hdp)
+                        v32, f32_view(prp), f32_view(hdp))
             self._spmd_step = jax.jit(run)
 
         loss, (dv, dpre, dhead) = self._spmd_step(
             vec, pre_params, head_params, xmb, lbs)
 
         if schedule == "interleave":
-            # [P, chunks, Lmax] round-robin -> canonical [V, Lmax]
-            dv = jnp.transpose(dv, (1, 0, 2)).reshape(
-                len(ring), dv.shape[-1])
+            # {dt: [P, chunks, Lmax]} round-robin -> canonical [V, Lmax]
+            dv = jax.tree.map(
+                lambda a: jnp.transpose(a, (1, 0, 2)).reshape(
+                    len(ring), a.shape[-1]), dv)
         dring = pp_spmd.unflatten_stage_grads(dv, specs)
 
         def scatter(layers, grads):

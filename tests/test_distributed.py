@@ -265,7 +265,8 @@ class TestTensorParallel:
         _mpu_split._layers = {}          # fresh cache for the test
 
         def _cached(name):
-            return next(v for k, v in _mpu_split._layers.items()
+            # cache entries are (layer, creation weight_attr, bias_attr)
+            return next(v[0] for k, v in _mpu_split._layers.items()
                         if k[0] == name)
         np.random.seed(3)
         w_col = np.random.randn(6, 8).astype("float32") * 0.1

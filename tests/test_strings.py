@@ -1,0 +1,99 @@
+"""String tensor tier (VERDICT r4 missing #6).
+
+Parity bar: the reference's complete strings kernel family —
+paddle/phi/core/string_tensor.h:33 StringTensor,
+paddle/phi/kernels/strings/strings_empty_kernel.h (empty/empty_like),
+strings_copy_kernel.h (copy), strings_lower_upper_kernel.h:30/:36
+(lower/upper with use_utf8_encoding) — host-tier here, since strings are
+host data on a TPU system.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+
+
+def test_construct_and_meta():
+    t = strings.to_string_tensor([["ab", "CD"], ["", "xY"]])
+    assert t.shape == [2, 2]
+    assert t.ndim == 2
+    assert t.numel() == 4
+    assert t.dtype is paddle.pstring
+    assert t.tolist() == [["ab", "CD"], ["", "xY"]]
+    assert t[0, 1] == "CD"
+    assert t[1].tolist() == ["", "xY"]
+
+
+def test_construct_scalar_bytes_none():
+    t = strings.to_string_tensor("hello")
+    assert t.shape == []
+    assert t.item() == "hello"
+    # bytes decode as utf-8, None becomes "" (pstring default-constructs
+    # empty, reference string_tensor.h mutable_data init)
+    t2 = strings.StringTensor([b"caf\xc3\xa9", None])
+    assert t2.tolist() == ["café", ""]
+    with pytest.raises(TypeError):
+        strings.StringTensor([1, 2])
+
+
+def test_empty_and_empty_like():
+    t = strings.empty([2, 3])
+    assert t.shape == [2, 3]
+    assert all(s == "" for s in np.asarray(t.numpy()).ravel())
+    u = strings.empty_like(strings.to_string_tensor(["a", "b"]))
+    assert u.shape == [2] and u.tolist() == ["", ""]
+
+
+def test_copy_is_deep():
+    src = strings.to_string_tensor(["a", "b"])
+    dst = strings.copy(src)
+    assert (dst == src).all()
+    dst._data[0] = "z"
+    assert src.tolist() == ["a", "b"]
+
+
+def test_eq_elementwise():
+    a = strings.to_string_tensor(["x", "y", "z"])
+    b = strings.to_string_tensor(["x", "q", "z"])
+    np.testing.assert_array_equal(a == b, [True, False, True])
+    np.testing.assert_array_equal(a == "x", [True, False, False])
+    with pytest.raises(TypeError):
+        hash(a)  # unhashable, same as jax/numpy arrays
+
+
+def test_lower_upper_ascii_mode():
+    """ASCII mode flips ONLY A-Z/a-z bytes (reference case_utils.h
+    AsciiToLower/AsciiToUpper); non-ASCII text passes through untouched."""
+    t = strings.to_string_tensor(["HeLLo, World! 123", "ÉCOLE Straße"])
+    lo = strings.lower(t)
+    up = strings.upper(t)
+    assert lo.tolist() == ["hello, world! 123", "École straße"]
+    assert up.tolist() == ["HELLO, WORLD! 123", "ÉCOLE STRAßE"]
+
+
+def test_lower_upper_utf8_mode():
+    """use_utf8_encoding=True applies the full Unicode case map
+    (reference unicode.h case tables == Python's str casing database)."""
+    t = strings.to_string_tensor(["ÉCOLE", "straße", "ΣΟΦΙΑ"])
+    assert strings.lower(t, use_utf8_encoding=True).tolist() == \
+        ["école", "straße", "σοφια"]
+    up = strings.upper(t, use_utf8_encoding=True)
+    assert up.tolist()[0] == "ÉCOLE"
+    assert up.tolist()[2] == "ΣΟΦΙΑ"
+
+
+def test_method_surface_and_shape_preserved():
+    t = strings.to_string_tensor([["Ab", "cD"], ["EF", "gh"]])
+    assert t.lower().shape == [2, 2]
+    assert t.upper().tolist() == [["AB", "CD"], ["EF", "GH"]]
+    # empty-string elements survive the transforms
+    e = strings.empty([3])
+    assert e.lower().tolist() == ["", "", ""]
+    assert e.upper(use_utf8_encoding=True).tolist() == ["", "", ""]
+
+
+def test_top_level_exposure():
+    assert hasattr(paddle, "strings")
+    assert repr(paddle.pstring) == "paddle_tpu.pstring"
+    assert str(paddle.pstring) == "pstring"

@@ -121,9 +121,14 @@ def validate_7b(n: int, batch_mult: int = 1):
          "remat_policy": cfg.remat_policy})
 
 
-def validate_13b(n: int, batch_mult: int = 1):
+def validate_13b(n: int, batch_mult: int = 1, schedule: str = "zero_bubble",
+                 num_chunks: int = 1):
     """BASELINE #4: Llama-2 13B, 3D hybrid (dp × pp × tp) + recompute,
-    1F1B, seq 4096."""
+    seq 4096. ``schedule`` selects the pipeline schedule (VERDICT r4 weak
+    #3 / next #6: the original 1F1B figure was bounded by per-microbatch
+    activation residency; the VPP/zero-bubble schedules show the headroom —
+    probe each via ``--config 13b --schedule {1f1b,zero_bubble,interleave}``
+    in separate invocations; one XLA CHECK-crash must not kill the rest)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -139,14 +144,17 @@ def validate_13b(n: int, batch_mult: int = 1):
     # one sequence per microbatch per dp replica at mult 1
     batch = microbatches * dp * batch_mult
     step = train_pp.make_train_step_pp(cfg, mesh, num_microbatches=microbatches,
-                                       schedule="1f1b")
+                                       schedule=schedule,
+                                       num_chunks=num_chunks)
     st_sh = train_pp.state_shardings_pp(mesh, cfg)
+    tag = schedule + (f"_c{num_chunks}" if schedule == "interleave" else "")
     return _analyze(
-        "llama2_13b_3d_1f1b", step,
+        f"llama2_13b_3d_{tag}", step,
         _state_sds(cfg, mesh, st_sh),
         _tokens_sds(mesh, batch, 4096, ("dp",)), mesh,
         {"params": cfg.num_params(), "batch": batch, "seq": 4096,
-         "microbatches": microbatches, "remat_policy": cfg.remat_policy})
+         "microbatches": microbatches, "schedule": tag,
+         "remat_policy": cfg.remat_policy})
 
 
 def validate_moe(n: int, batch_mult: int = 1):
@@ -186,7 +194,9 @@ def _impl(args) -> int:
     if args.config in ("7b", "all"):
         rows.append(validate_7b(args.devices, args.batch_mult))
     if args.config in ("13b", "all"):
-        rows.append(validate_13b(args.devices, args.batch_mult))
+        rows.append(validate_13b(args.devices, args.batch_mult,
+                                 schedule=args.schedule,
+                                 num_chunks=args.num_chunks))
     if args.config in ("moe", "all"):
         rows.append(validate_moe(args.devices, args.batch_mult))
     ok = True
@@ -204,6 +214,11 @@ def main():
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")
+    ap.add_argument("--schedule", default="zero_bubble",
+                    choices=["gpipe", "1f1b", "zero_bubble", "interleave"],
+                    help="13b pipeline schedule (VERDICT r4 #6 residency)")
+    ap.add_argument("--num-chunks", type=int, default=1,
+                    help="VPP chunks when --schedule interleave")
     ap.add_argument("--_child", action="store_true")
     args = ap.parse_args()
     if args._child:
@@ -224,7 +239,9 @@ def main():
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--_child",
          "--devices", str(args.devices), "--config", args.config,
-         "--batch-mult", str(args.batch_mult)],
+         "--batch-mult", str(args.batch_mult),
+         "--schedule", args.schedule,
+         "--num-chunks", str(args.num_chunks)],
         env=env, timeout=3600,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.exit(proc.returncode)

