@@ -1,7 +1,12 @@
-"""BASELINE target #4: Llama 3D hybrid (dp x pp x tp) + recompute, 1F1B.
+"""BASELINE target #4: Llama 3D hybrid (dp x pp x tp) + recompute.
 
 Reference recipe: TP x PP x DP with recompute on v5p-32; TPU-native: the
-SPMD pipeline wavefront (shard_map + ppermute) with the 1F1B schedule.
+SPMD pipeline wavefront (shard_map + ppermute) with the ZERO-BUBBLE
+schedule — the round-5 AOT schedule sweep (tools/aot_validate.py
+--config 13b --schedule ...) measured 38.53 GB/chip for zero-bubble vs
+38.62 for 1F1B at identical fit, with dW hoisted off the serialized
+per-tick path; AD-backed VPP interleave has GPipe-like residency
+(211.8 GB temp) and is a non-starter at 13B scale.
 """
 import sys
 
@@ -32,13 +37,13 @@ def main():
 
     mesh = build_mesh(("dp", "pp", "tp"), (-1, pp, tp))
     step = train_pp.make_train_step_pp(
-        cfg, mesh, num_microbatches=microbatches, schedule="1f1b")
+        cfg, mesh, num_microbatches=microbatches, schedule="zero_bubble")
     state = jax.jit(lambda k: train.init_train_state(k, cfg),
                     out_shardings=train_pp.state_shardings_pp(mesh, cfg))(
         jax.random.key(0))
     tokens = dp_sharded_tokens(mesh, batch, seq, cfg.vocab_size,
                                axes=("dp",))
-    run_train_bench(step, state, tokens, "llama_3d_1f1b_tokens_per_sec",
+    run_train_bench(step, state, tokens, "llama_3d_zero_bubble_tokens_per_sec",
                     iters=args.iters, preset=args.preset,
                     devices=jax.device_count(), pp=pp, tp=tp, microbatches=microbatches)
 

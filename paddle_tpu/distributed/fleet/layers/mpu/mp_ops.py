@@ -249,14 +249,13 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
                 f"distributed.split(linear): axis must be 0 (row "
                 f"parallel) or 1 (column parallel), got {axis}")
         if name is not None:
-            # evict entries built over OTHER meshes before inserting: a
-            # fleet re-init must not pin dead meshes' parameter buffers.
-            # Same-ness is EQUALITY (!=) to match the cache lookup: an
-            # equal-but-distinct Mesh object after a re-init keeps its
-            # entries (identical devices/axes -> identical shardings);
-            # identity-based eviction here would silently re-initialize
-            # named layers that lookup had just been serving
-            for k in [k for k in cache if k[7] != g.mesh]:
-                del cache[k]
+            # NO eviction: named layers persist for the process, exactly
+            # like layers held on a module — a process that alternates
+            # meshes (train mesh / eval mesh, tests re-initializing
+            # fleet) must find its named layers again under each, and
+            # any eviction policy here silently re-initializes trained
+            # weights for whichever mesh it evicts. Growth is bounded by
+            # the number of distinct (name, config, mesh) layers the
+            # program actually creates.
             cache[key] = (layer, weight_attr, bias_attr)
     return layer(x)

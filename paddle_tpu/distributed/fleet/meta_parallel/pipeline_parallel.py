@@ -383,8 +383,7 @@ class PipelineParallel(MetaParallelBase):
                     return loss, (dv, dpre, dhead)
             else:  # gpipe / interleaved wavefront, AD backward
                 def run(v, prp, hdp, mb, lab):
-                    v32 = jax.tree.map(
-                        lambda a: a.astype(jnp.float32), v)
+                    v32 = f32_view(v)
 
                     def total(v_, prp_, hdp_):
                         mbs = pre_apply(native_cast(prp_, prp), mb)

@@ -42,10 +42,13 @@ FThenB; passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62):
   input (remat), the same tradeoff the big configs already take.
 - ``zero_bubble`` (``pipeline_1f1b(defer_dw=True)``): 1F1B structure but
   the per-tick backward computes only dX (the serial dependency); dW
-  matmuls are hoisted out of the scan into one batched contraction over
+  matmuls are hoisted out of the scan into a scan-accumulated pass over
   the stashed (input, cotangent) pairs — the XLA translation of
   zero-bubble's "fill bubbles with W-grad work": the serialized chain per
-  tick drops from fwd+dX+dW to fwd+dX, at gpipe-like stash memory.
+  tick drops from fwd+dX+dW to fwd+dX, at gpipe-like stash memory. The
+  dW tail accumulates via lax.scan, NOT vmap: a vmapped tail
+  materializes T full dW trees at once (AOT-measured 307 GB temp on the
+  13B recipe vs 27 GB for 1f1b).
 """
 from __future__ import annotations
 
@@ -70,6 +73,20 @@ def stack_stage_params(per_stage_params: Sequence[Any], mesh: Mesh,
         except Exception:
             return x
     return jax.tree.map(place, stacked)
+
+
+def _psum_act(x, pp_axis: str, mesh: Mesh):
+    """psum for activation-sized tensors. On CPU meshes the all-reduce
+    runs in f32: XLA's CPU AllReducePromotion pass CHECK-crashes cloning
+    a bf16 all-reduce whose reducer carries a copy ("Invalid binary
+    instruction opcode copy", hlo_instruction.cc:1585 — observed
+    AOT-compiling the 13B bf16 recipe on the 16-device CPU mesh; TPU
+    backends never run that pass). Native-dtype psum is kept on TPU so
+    the collective rides ICI at bf16 bytes."""
+    if mesh.devices.flat[0].platform == "cpu" and \
+            x.dtype == jnp.bfloat16:
+        return lax.psum(x.astype(jnp.float32), pp_axis).astype(x.dtype)
+    return lax.psum(x, pp_axis)
 
 
 def pipeline_spmd(stage_fn: Callable, stacked_params, microbatches,
@@ -110,7 +127,7 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, microbatches,
         # broadcast last-stage outputs to all pp coords so the result is
         # replicated over pp (callers compute loss once)
         mask = (stage_id == num_stages - 1).astype(outs.dtype)
-        outs = lax.psum(outs * mask, pp_axis)
+        outs = _psum_act(outs * mask, pp_axis, mesh)
         return outs
 
     fn = jax.shard_map(
@@ -225,7 +242,7 @@ def pipeline_interleave(stage_fn: Callable, stacked_params, microbatches,
         (_, outs), _ = lax.scan(tick, (x0, out0), jnp.arange(T))
         # out_buf is populated only on the last stage; replicate over pp
         mask = (stage == num_stages - 1).astype(outs.dtype)
-        return lax.psum(outs * mask, pp_axis)
+        return _psum_act(outs * mask, pp_axis, mesh)
 
     fn = jax.shard_map(
         per_device, mesh=mesh, axis_names=manual,
@@ -337,16 +354,21 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
             tick, init, jnp.arange(T))
 
         if defer_dw:
+            # dW AFTER the pipeline scan (the zero-bubble point: dW work
+            # leaves the serialized per-tick path) — but accumulated with
+            # a scan, NOT a vmap: vmapping the per-tick vjp materializes
+            # T full dW trees at once (AOT-measured 307 GB temp on the
+            # 13B recipe vs 27 GB for 1f1b); the scan keeps dW at O(1)
             xs, dys, mask = stash
-            def one(x_sv, dy):
+
+            def acc_one(acc, xdm):
+                x_sv, dy, on = xdm
                 _, vjp = jax.vjp(stage_fn, params_me, x_sv)
-                return vjp(dy)[0]
-            dps = jax.vmap(one)(xs, dys)
-            dw = jax.tree.map(
-                lambda acc, g: acc + jnp.sum(
-                    jnp.where(mask.reshape((-1,) + (1,) * (g.ndim - 1)),
-                              g, 0.0).astype(jnp.float32), axis=0),
-                dw, dps)
+                dp = vjp(dy)[0]
+                return jax.tree.map(
+                    lambda a, g: a + jnp.where(on, g, 0.0).astype(
+                        jnp.float32), acc, dp), None
+            dw, _ = lax.scan(acc_one, dw, (xs, dys, mask))
 
         # replicate scalars / edge products over pp (mask -> psum)
         lastf = (stage == last).astype(jnp.float32)
@@ -507,7 +529,7 @@ def pipeline_hetero(stage_fns: Sequence[Callable], stacked_vec, specs,
         _, ys = lax.scan(tick, x0, jnp.arange(T))
         outs = lax.dynamic_slice_in_dim(ys, num_stages - 1, M, axis=0)
         mask = (stage_id == num_stages - 1).astype(outs.dtype)
-        return lax.psum(outs * mask, pp_axis)
+        return _psum_act(outs * mask, pp_axis, mesh)
 
     fn = jax.shard_map(
         per_device, mesh=mesh, axis_names=manual,
@@ -613,17 +635,18 @@ def pipeline_hetero_1f1b(stage_fns: Sequence[Callable], loss_fn: Callable,
             tick, init, jnp.arange(T))
 
         if defer_dw:
+            # scan-accumulated (not vmapped) for O(1) dW memory — see
+            # pipeline_1f1b's defer_dw note
             xs, dys, mask = stash
 
-            def one(x_sv, dy):
+            def acc_one(acc, xdm):
+                x_sv, dy, on = xdm
                 _, vjp = jax.vjp(apply, vec_me, x_sv)
-                return vjp(dy)[0]
-            dvs = jax.vmap(one)(xs, dys)
-            dw = jax.tree.map(
-                lambda acc, dv: acc + jnp.sum(
-                    jnp.where(mask[:, None], dv.astype(jnp.float32), 0.0),
-                    axis=0),
-                dw, dvs)
+                dv = vjp(dy)[0]
+                return jax.tree.map(
+                    lambda a, g: a + jnp.where(on, g.astype(jnp.float32),
+                                               0.0), acc, dv), None
+            dw, _ = lax.scan(acc_one, dw, (xs, dys, mask))
 
         lastf = (stage == last).astype(jnp.float32)
         loss_mean = lax.psum(loss_acc * lastf, pp_axis) * inv_m
@@ -733,7 +756,7 @@ def pipeline_hetero_interleave(stage_fns: Sequence[Callable], stacked_vec,
 
         (_, outs), _ = lax.scan(tick, (x0, out0), jnp.arange(T))
         mask = (stage == num_stages - 1).astype(outs.dtype)
-        return lax.psum(outs * mask, pp_axis)
+        return _psum_act(outs * mask, pp_axis, mesh)
 
     fn = jax.shard_map(
         per_device, mesh=mesh, axis_names=manual,

@@ -75,6 +75,15 @@ class StringTensor:
                     f"{type(v).__name__}")
         self._data = flat.reshape(arr.shape)
 
+    @classmethod
+    def _wrap(cls, arr):
+        """Adopt an already-validated object ndarray WITHOUT the
+        constructor's validation/copy pass (internal: every element must
+        already be str)."""
+        t = object.__new__(cls)
+        t._data = arr
+        return t
+
     # -- meta ------------------------------------------------------------
     @property
     def shape(self):
@@ -152,7 +161,7 @@ def to_string_tensor(data: Any) -> StringTensor:
 def empty(shape: Sequence[int]) -> StringTensor:
     """All-empty-string tensor (reference:
     paddle/phi/kernels/strings/strings_empty_kernel.h EmptyKernel)."""
-    return StringTensor(np.full(tuple(shape), "", dtype=object))
+    return StringTensor._wrap(np.full(tuple(shape), "", dtype=object))
 
 
 def empty_like(x: StringTensor) -> StringTensor:
@@ -163,7 +172,7 @@ def empty_like(x: StringTensor) -> StringTensor:
 def copy(x: StringTensor) -> StringTensor:
     """Deep copy (reference: strings_copy_kernel.h — device/host copies
     collapse to one host copy here)."""
-    return StringTensor(x._data)
+    return StringTensor._wrap(x._data.copy())
 
 
 # case_utils.h AsciiToLower/AsciiToUpper: ONLY 'A'-'Z'/'a'-'z' flip;
@@ -188,7 +197,7 @@ def _map(x: StringTensor, fn) -> StringTensor:
     of, xf = out.ravel(), x._data.ravel()
     for i in range(xf.size):
         of[i] = fn(xf[i])
-    return StringTensor(out.reshape(x._data.shape))
+    return StringTensor._wrap(out)
 
 
 def lower(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:

@@ -229,7 +229,12 @@ def main():
         os._exit(rc)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # all-reduce-promotion: XLA's CPU pass CHECK-crashes ("Invalid binary
+    # instruction opcode copy", hlo_instruction.cc:1585) cloning some
+    # GSPMD-inserted bf16 all-reduces in the interleave-schedule AD graph;
+    # bf16 all-reduces compile and run correctly on CPU without the pass
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_disable_hlo_passes=all-reduce-promotion"
                         f" --xla_force_host_platform_device_count="
                         f"{args.devices}")
     # repo root only: the ambient PYTHONPATH carries a sitecustomize that
