@@ -383,6 +383,18 @@ def _record_last_good(parsed: dict) -> None:
         if "tpu" not in dev:
             return  # CPU smoke runs don't overwrite the TPU record
         rec = dict(parsed)
+        # carry forward decode tiers the standalone decode bench merged
+        # into the record (tools/tpu_watch.sh stage b): a headline-only
+        # run reports them null and must not clobber measured numbers
+        try:
+            with open(_LASTGOOD) as f:
+                old = json.load(f)
+            for k, v in old.get("extra", {}).items():
+                if (k.startswith("decode") and v is not None
+                        and rec.get("extra", {}).get(k) is None):
+                    rec.setdefault("extra", {})[k] = v
+        except Exception:
+            pass
         rec["recorded_unix"] = time.time()
         rec["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())

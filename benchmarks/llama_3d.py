@@ -1,12 +1,12 @@
 """BASELINE target #4: Llama 3D hybrid (dp x pp x tp) + recompute.
 
 Reference recipe: TP x PP x DP with recompute on v5p-32; TPU-native: the
-SPMD pipeline wavefront (shard_map + ppermute) with the ZERO-BUBBLE
-schedule — the round-5 AOT schedule sweep (tools/aot_validate.py
---config 13b --schedule ...) measured 38.53 GB/chip for zero-bubble vs
-38.62 for 1F1B at identical fit, with dW hoisted off the serialized
-per-tick path; AD-backed VPP interleave has GPipe-like residency
-(211.8 GB temp) and is a non-starter at 13B scale.
+SPMD pipeline wavefront (shard_map + ppermute) with the hand-written
+INTERLEAVED 1F1B (VPP) schedule — the round-5 AOT schedule sweep
+(tools/aot_validate.py --config 13b --schedule ..., PERF_NOTES) ranked
+it first at 31.0 GB/chip vs 38.5 zero-bubble / 38.6 1F1B / 223 AD-VPP,
+with the VPP bubble (P-1)/(M*C+P-1) on top; it still fits at 4x global
+batch (64.5 GB).
 """
 import sys
 
@@ -36,16 +36,31 @@ def main():
         batch, seq, microbatches = 4, 64, 2 * pp
 
     mesh = build_mesh(("dp", "pp", "tp"), (-1, pp, tp))
+    chunks = 2
     step = train_pp.make_train_step_pp(
-        cfg, mesh, num_microbatches=microbatches, schedule="zero_bubble")
+        cfg, mesh, num_microbatches=microbatches,
+        schedule="interleave_1f1b", num_chunks=chunks)
     state = jax.jit(lambda k: train.init_train_state(k, cfg),
                     out_shardings=train_pp.state_shardings_pp(mesh, cfg))(
         jax.random.key(0))
+    # interleaved schedules need layers in round-robin STORAGE order
+    perm = train_pp.interleave_layer_perm(cfg, pp, chunks)
+
+    def permute(tree_):
+        return jax.tree.map(lambda a: a[perm], tree_)
+    state = state._replace(
+        params={**state.params, "layers": permute(state.params["layers"])},
+        master={**state.master, "layers": permute(state.master["layers"])},
+        m={**state.m, "layers": permute(state.m["layers"])},
+        v={**state.v, "layers": permute(state.v["layers"])})
+    # the permuting gather drops the pp shardings; re-place
+    state = jax.device_put(state, train_pp.state_shardings_pp(mesh, cfg))
     tokens = dp_sharded_tokens(mesh, batch, seq, cfg.vocab_size,
                                axes=("dp",))
-    run_train_bench(step, state, tokens, "llama_3d_zero_bubble_tokens_per_sec",
+    run_train_bench(step, state, tokens, "llama_3d_vpp_tokens_per_sec",
                     iters=args.iters, preset=args.preset,
-                    devices=jax.device_count(), pp=pp, tp=tp, microbatches=microbatches)
+                    devices=jax.device_count(), pp=pp, tp=tp,
+                    microbatches=microbatches, chunks=chunks)
 
 
 if __name__ == "__main__":

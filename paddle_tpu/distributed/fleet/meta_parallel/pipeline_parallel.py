@@ -213,13 +213,15 @@ class PipelineParallel(MetaParallelBase):
                         defer_dw=(schedule == "zero_bubble"))
                     return loss, dw
             elif schedule == "interleave":
+                # the reference's VPP training schedule IS interleaved
+                # 1F1B (pipeline_parallel.py:1174) — use the hand-written
+                # depth-bounded backward (round 5), not AD through the
+                # wavefront, whose residency grows with accumulate_steps
                 def run(stacked, mb, lab):
-                    def total(sp):
-                        outs = pp_spmd.pipeline_interleave(
-                            stage_fn, sp, mb, mesh, num_chunks)
-                        return jnp.mean(jax.vmap(
-                            lambda y, l: head_loss({}, y, l))(outs, lab))
-                    return jax.value_and_grad(total)(stacked)
+                    loss, dw, _, _ = pp_spmd.pipeline_interleave_1f1b(
+                        stage_fn, head_loss, stacked, {}, mb, lab, mesh,
+                        num_chunks)
+                    return loss, dw
             else:  # gpipe
                 def run(stacked, mb, lab):
                     def total(sp):

@@ -251,6 +251,176 @@ def pipeline_interleave(stage_fn: Callable, stacked_params, microbatches,
     return fn(stacked_params, microbatches)
 
 
+def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
+                             stacked_params, head_params, microbatches,
+                             labels, mesh: Mesh, num_chunks: int,
+                             pp_axis: str = "pp"):
+    """Interleaved (VPP) schedule with a HAND-WRITTEN depth-bounded
+    backward — the memory contract of ``pipeline_1f1b`` at the bubble of
+    ``pipeline_interleave``.
+
+    Motivation (round-5 AOT sweep, PERF_NOTES): AD through the interleave
+    wavefront keeps every in-flight microbatch residual alive until the
+    reverse wavefront — 223 GB/chip on the 13B recipe. Here the combined
+    scan runs one forward AND one backward VIRTUAL-STAGE unit per tick,
+    stashing only raw stage inputs in a (2V-1)-slot ring (V = P*C virtual
+    stages), so activation residency is bounded by the virtual pipeline
+    depth — NOT by M — while the bubble stays the VPP (P-1)/(M*C + P-1)
+    class. This is the TPU lockstep translation of Megatron's interleaved
+    1F1B (reference: meta_parallel/pipeline_parallel.py:1174
+    forward_backward_pipeline_with_interleaving).
+
+    Schedule closed forms (d = device, t = tick, requires M % P == 0):
+    - forward: unit u = t - d; u = g*V + c*P + r -> chunk c,
+      microbatch m = g*P + r. Output ppermutes d -> d+1 (wrap P-1 -> 0
+      carries chunk c's exit into chunk c+1's entry), consumed next tick.
+    - backward: unit w = t - (V-1) - (P-1-d); w = g*V + q*P + r ->
+      chunk c = C-1 - q, microbatch m = g*P + r. Cotangent ppermutes
+      d -> d-1 (wrap 0 -> P-1 carries chunk c+1's entry-grad back to
+      chunk c's exit), consumed next tick. The first backward (v = V-1)
+      consumes the same-tick head-loss cotangent, as in pipeline_1f1b.
+    - the stash ring holds stage INPUTS by forward tick mod (2V-1); the
+      backward of a unit forward-run at tick t_f reads slot t_f mod R,
+      and max(t_b - t_f) = 2V - 2 < R, so no slot is overwritten early.
+      Backward recomputes the stage forward from the saved input (remat).
+
+    stage_fn(chunk_params, x) -> y; loss_fn(head_params, y, label) ->
+    scalar (per-microbatch, scaled by 1/M here).
+    stacked_params: pytree [P, num_chunks, ...] round-robin layout
+    (virtual stage v at [v % P, v // P]), dim 0 sharded over pp.
+    Returns (mean_loss, d_stacked [P, num_chunks, ...] f32, d_head,
+    d_microbatches) — gradients accumulate in f32.
+    """
+    num_stages = mesh.shape[pp_axis]
+    C = num_chunks
+    M = microbatches.shape[0]
+    assert M % num_stages == 0, (
+        f"interleaved schedule needs microbatches ({M}) % pp stages "
+        f"({num_stages}) == 0")
+    V = num_stages * C
+    U = M * C                       # fwd (= bwd) units per device
+    T = U + V + num_stages - 2      # last bwd: w=U-1 at d=0
+    R = 2 * V - 1
+    manual = frozenset({pp_axis})
+    inv_m = 1.0 / M
+
+    def per_device(params_local, head, mb_local, lab_local):
+        params_me = jax.tree.map(lambda x: x[0], params_local)  # [C, ...]
+        d = lax.axis_index(pp_axis)
+        P_ = num_stages
+        last = P_ - 1
+        perm_f = [(i, (i + 1) % P_) for i in range(P_)]
+        perm_b = [(i, (i - 1) % P_) for i in range(P_)]
+
+        def chunk_apply(vme, c, x):
+            p_c = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                vme)
+            return stage_fn(p_c, x)
+
+        zero_x = jnp.zeros_like(mb_local[0])
+        ring0 = jnp.zeros((R,) + zero_x.shape, zero_x.dtype)
+        dw0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                           params_me)
+        dhead0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                              head)
+        dx0 = jnp.zeros((M,) + zero_x.shape, jnp.float32)
+
+        def tick(carry, t):
+            (f_rc, b_rc, ring, dw, dhead, dx_out, loss_acc) = carry
+
+            # ---- forward unit u = t - d ----
+            u = t - d
+            f_on = (u >= 0) & (u < U)
+            uc = jnp.clip(u, 0, U - 1)
+            g_f = uc // V
+            rem_f = uc - g_f * V
+            c_f = rem_f // P_
+            m_f = jnp.clip(g_f * P_ + rem_f % P_, 0, M - 1)
+            feed = lax.dynamic_index_in_dim(mb_local, m_f, 0,
+                                            keepdims=False)
+            x_in = jnp.where((d == 0) & (c_f == 0), feed, f_rc)
+            y = chunk_apply(params_me, c_f, x_in)
+            ring = jnp.where(
+                f_on,
+                lax.dynamic_update_index_in_dim(ring, x_in,
+                                                jnp.mod(t, R), 0),
+                ring)
+
+            # head loss + cotangent on the LAST virtual stage's output
+            lab = jax.tree.map(
+                lambda l: lax.dynamic_index_in_dim(l, m_f, 0,
+                                                   keepdims=False),
+                lab_local)
+            lval, head_vjp = jax.vjp(lambda hp, yy: loss_fn(hp, yy, lab),
+                                     head, y)
+            dhead_c, dy_self = head_vjp(jnp.asarray(inv_m, jnp.float32))
+            on_last = f_on & (d == last) & (c_f == C - 1)
+            loss_acc = loss_acc + jnp.where(on_last, lval, 0.0)
+            dhead = jax.tree.map(
+                lambda acc, g: acc + jnp.where(on_last, g, 0.0),
+                dhead, dhead_c)
+
+            # ---- backward unit w = t - (V-1) - (P-1-d) ----
+            w = t - (V - 1) - (last - d)
+            b_on = (w >= 0) & (w < U)
+            wc = jnp.clip(w, 0, U - 1)
+            g_b = wc // V
+            rem_b = wc - g_b * V
+            c_b = C - 1 - rem_b // P_
+            # forward of this unit ran here at tick u_b + d
+            u_b = g_b * V + c_b * P_ + rem_b % P_
+            slot_b = jnp.mod(u_b + d, R)
+            x_sv = lax.dynamic_index_in_dim(ring, slot_b, 0,
+                                            keepdims=False)
+            dy_in = jnp.where((d == last) & (c_b == C - 1),
+                              dy_self.astype(b_rc.dtype), b_rc)
+            _, stage_vjp = jax.vjp(
+                lambda vme, xx: chunk_apply(vme, c_b, xx), params_me,
+                x_sv)
+            # vjp through dynamic_index scatters into a full [C, ...]
+            # tree (zeros off-chunk), so plain accumulation lands the
+            # chunk's grads without any indexed add
+            dv_c, dx_c = stage_vjp(dy_in)
+            dw = jax.tree.map(
+                lambda acc, g: acc + jnp.where(b_on,
+                                               g.astype(jnp.float32),
+                                               0.0),
+                dw, dv_c)
+            m_b = jnp.clip(g_b * P_ + rem_b % P_, 0, M - 1)
+            dx_out = jnp.where(
+                b_on & (d == 0) & (c_b == 0),
+                lax.dynamic_update_index_in_dim(
+                    dx_out, dx_c.astype(jnp.float32), m_b, 0),
+                dx_out)
+
+            f_nx = lax.ppermute(y, pp_axis, perm_f)
+            b_nx = lax.ppermute(dx_c.astype(b_rc.dtype), pp_axis, perm_b)
+            return (f_nx, b_nx, ring, dw, dhead, dx_out, loss_acc), None
+
+        init = (zero_x, jnp.zeros_like(zero_x), ring0, dw0, dhead0,
+                dx0, jnp.float32(0.0))
+        (_, _, _, dw, dhead, dx_out, loss_acc), _ = lax.scan(
+            tick, init, jnp.arange(T))
+
+        lastf = (d == last).astype(jnp.float32)
+        loss_mean = lax.psum(loss_acc * lastf, pp_axis) * inv_m
+        dhead = jax.tree.map(lambda g: lax.psum(g * lastf, pp_axis), dhead)
+        dx_out = lax.psum(
+            dx_out * (d == 0).astype(jnp.float32), pp_axis)
+        return loss_mean, jax.tree.map(lambda a: a[None], dw), dhead, \
+            dx_out
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh, axis_names=manual,
+        in_specs=(jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                  P(), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                   P(), P()),
+        check_vma=False)
+    return fn(stacked_params, head_params, microbatches, labels)
+
+
 def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
                   head_params, microbatches, labels, mesh: Mesh,
                   pp_axis: str = "pp", defer_dw: bool = False):

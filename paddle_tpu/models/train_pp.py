@@ -76,21 +76,26 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
                        weight_decay: float = 0.1, grad_clip: float = 1.0):
     """jitted ``step(state, tokens) -> (state, metrics)`` pipelined over
     ``pp_axis`` with the selected schedule (pp_spmd module docstring):
-    "gpipe" AD wavefront, "interleave" VPP (state must be in
-    ``interleave_layer_perm`` storage order), "1f1b" depth-bounded
-    residency, "zero_bubble" 1F1B with deferred dW.
+    "gpipe" AD wavefront, "interleave" VPP AD backward (state must be in
+    ``interleave_layer_perm`` storage order), "interleave_1f1b" VPP with
+    the hand-written depth-bounded backward (same storage order; the
+    schedule for VPP at scale — AD-VPP's residency grows with M),
+    "1f1b" depth-bounded residency, "zero_bubble" 1F1B with deferred dW.
     Batch dim must divide num_microbatches.
     """
     assert cfg.moe is None, "pp+MoE composition not yet supported"
-    assert schedule in ("gpipe", "interleave", "1f1b", "zero_bubble")
+    assert schedule in ("gpipe", "interleave", "interleave_1f1b", "1f1b",
+                        "zero_bubble")
     num_stages = mesh.shape[pp_axis]
-    nseg = num_stages * (num_chunks if schedule == "interleave" else 1)
+    chunked = schedule in ("interleave", "interleave_1f1b")
+    nseg = num_stages * (num_chunks if chunked else 1)
     assert cfg.num_layers % nseg == 0
     lp_per_stage = cfg.num_layers // nseg
     dp = dp_axis if dp_axis in mesh.axis_names else None
 
     from ..distributed.fleet.meta_parallel.pp_spmd import (
-        pipeline_spmd, pipeline_interleave, pipeline_1f1b)
+        pipeline_spmd, pipeline_interleave, pipeline_1f1b,
+        pipeline_interleave_1f1b)
 
     def make_stage_fn(cos, sin):
         def stage_fn(stage_params, xin):
@@ -157,19 +162,35 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
 
         mbs, vjp_embed = jax.vjp(embed_fn, params["embed"])
         labels = tokens.reshape(M, mb, S)
-        stacked = jax.tree.map(
-            lambda a: a.reshape(num_stages, lp_per_stage, *a.shape[1:]),
-            params["layers"])
         hp = {"final_norm": params["final_norm"], "head": head_of(params)}
-        lv, d_stacked, d_head, d_mbs = pipeline_1f1b(
-            stage_fn, head_loss, stacked, hp, mbs, labels, mesh, pp_axis,
-            defer_dw=(schedule == "zero_bubble"))
+        if schedule == "interleave_1f1b":
+            # [P, C, layers/chunk, ...] round-robin storage order
+            # (state must be in interleave_layer_perm order, as for
+            # "interleave")
+            stacked = jax.tree.map(
+                lambda a: a.reshape(num_stages, num_chunks, lp_per_stage,
+                                    *a.shape[1:]),
+                params["layers"])
+            lv, d_stacked, d_head, d_mbs = pipeline_interleave_1f1b(
+                stage_fn, head_loss, stacked, hp, mbs, labels, mesh,
+                num_chunks, pp_axis)
+        else:
+            stacked = jax.tree.map(
+                lambda a: a.reshape(num_stages, lp_per_stage,
+                                    *a.shape[1:]),
+                params["layers"])
+            lv, d_stacked, d_head, d_mbs = pipeline_1f1b(
+                stage_fn, head_loss, stacked, hp, mbs, labels, mesh,
+                pp_axis, defer_dw=(schedule == "zero_bubble"))
         d_embed = vjp_embed(d_mbs.astype(mbs.dtype))[0].astype(jnp.float32)
+        # flatten the stage dims back to [L, ...] in STORAGE order (the
+        # same contiguous reinterpretation the forward reshape used)
+        lead = 3 if schedule == "interleave_1f1b" else 2
         grads = {
             "embed": d_embed + (d_head["head"].T if cfg.tie_embeddings
                                 else 0.0),
             "layers": jax.tree.map(
-                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]),
+                lambda a: a.reshape(cfg.num_layers, *a.shape[lead:]),
                 d_stacked),
             "final_norm": d_head["final_norm"],
         }
@@ -178,7 +199,7 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
         return lv, grads
 
     def step_fn(state: TrainState, tokens):
-        if schedule in ("1f1b", "zero_bubble"):
+        if schedule in ("1f1b", "zero_bubble", "interleave_1f1b"):
             lv, grads = loss_and_grads_1f1b(state.params, tokens)
         else:
             lv, grads = jax.value_and_grad(loss)(state.params, tokens)

@@ -147,7 +147,8 @@ def validate_13b(n: int, batch_mult: int = 1, schedule: str = "zero_bubble",
                                        schedule=schedule,
                                        num_chunks=num_chunks)
     st_sh = train_pp.state_shardings_pp(mesh, cfg)
-    tag = schedule + (f"_c{num_chunks}" if schedule == "interleave" else "")
+    tag = schedule + (f"_c{num_chunks}"
+                      if schedule.startswith("interleave") else "")
     return _analyze(
         f"llama2_13b_3d_{tag}", step,
         _state_sds(cfg, mesh, st_sh),
@@ -215,10 +216,13 @@ def main():
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")
     ap.add_argument("--schedule", default="zero_bubble",
-                    choices=["gpipe", "1f1b", "zero_bubble", "interleave"],
+                    choices=["gpipe", "1f1b", "zero_bubble", "interleave",
+                             "interleave_1f1b"],
                     help="13b pipeline schedule (VERDICT r4 #6 residency)")
     ap.add_argument("--num-chunks", type=int, default=1,
-                    help="VPP chunks when --schedule interleave")
+                    help="VPP chunks for the interleave / interleave_1f1b "
+                         "schedules (the PERF_NOTES sweep used 2; 1 "
+                         "degenerates to a plain wavefront)")
     ap.add_argument("--_child", action="store_true")
     args = ap.parse_args()
     if args._child:

@@ -22,6 +22,7 @@ if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
   exit 0
 fi
 echo $$ >"$LOCK"
+trap 'rm -f "$LOCK"' EXIT
 mkdir -p artifacts
 FLASH_DONE=0
 DECODE_DONE=0
@@ -43,31 +44,46 @@ while true; do
       PADDLE_TPU_BENCH_TIMEOUT=2400 timeout 2700 python tools/decode_bench.py >artifacts/decode_live.json 2>>"$LOG"
       rc=$?
       echo "$(date -u +%FT%TZ) decode bench done (rc=$rc)" >>"$LOG"
+      # DECODE_DONE tracks the MEASUREMENT only; the record merge below
+      # is best-effort and retried on later windows via artifacts/ (a
+      # transient merge failure must not re-burn a 45-min decode bench).
+      # bench.py's _record_last_good also carries decode keys forward, so
+      # a later headline rewrite cannot clobber them.
       if python - <<'EOF'
-import json, sys, time
+import json, sys
 try:
     with open("artifacts/decode_live.json") as f:
         lines = [l for l in f.read().splitlines() if l.strip()]
-    dec = json.loads(lines[-1])
-    ok = dec.get("decode_tokens_per_sec") is not None
-    if ok:  # merge the tiers into the last-good record for the judge
-        with open("BENCH_LASTGOOD.json") as f:
-            lg = json.load(f)
-        for k in ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
-                  "decode_int4_tokens_per_sec",
-                  "decode_w8kv8_tokens_per_sec"):
-            if dec.get(k) is not None:
-                lg.setdefault("extra", {})[k] = dec[k]
-        lg["extra"]["decode_recorded_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        with open("BENCH_LASTGOOD.json", "w") as f:
-            json.dump(lg, f)
-    sys.exit(0 if ok else 1)
+    sys.exit(0 if json.loads(lines[-1]).get("decode_tokens_per_sec")
+             is not None else 1)
 except Exception:
     sys.exit(1)
 EOF
       then DECODE_DONE=1; fi
     fi
+    # merge measured decode tiers into the last-good record (idempotent;
+    # runs every window so a once-failed merge self-heals)
+    python - <<'EOF' 2>>"$LOG" || true
+import json, time
+with open("artifacts/decode_live.json") as f:
+    lines = [l for l in f.read().splitlines() if l.strip()]
+dec = json.loads(lines[-1])
+if dec.get("decode_tokens_per_sec") is not None:
+    with open("BENCH_LASTGOOD.json") as f:
+        lg = json.load(f)
+    changed = False
+    for k in ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
+              "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec"):
+        if dec.get(k) is not None and \
+                lg.setdefault("extra", {}).get(k) != dec[k]:
+            lg["extra"][k] = dec[k]
+            changed = True
+    if changed:
+        lg["extra"]["decode_recorded_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open("BENCH_LASTGOOD.json", "w") as f:
+            json.dump(lg, f)
+EOF
     # (c) headline bench, freshness-gated
     if ! python - <<EOF
 import json, sys, time
