@@ -383,6 +383,9 @@ def _record_last_good(parsed: dict) -> None:
         if "tpu" not in dev:
             return  # CPU smoke runs don't overwrite the TPU record
         rec = dict(parsed)
+        # deep-copy the extra dict: the merge below must not leak
+        # carried-forward values into the caller's parsed object
+        rec["extra"] = dict(parsed.get("extra", {}))
         # carry forward decode tiers the standalone decode bench merged
         # into the record (tools/tpu_watch.sh stage b): a headline-only
         # run reports them null and must not clobber measured numbers

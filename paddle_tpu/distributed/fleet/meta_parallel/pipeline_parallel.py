@@ -373,29 +373,37 @@ class PipelineParallel(MetaParallelBase):
             return jax.tree.map(lambda a, r: a.astype(r.dtype), tree, ref)
 
         if self._spmd_step is None:
-            if schedule in ("1f1b", "zero_bubble"):
+            if schedule in ("1f1b", "zero_bubble", "interleave"):
+                # hand-written depth-bounded backwards. "interleave"
+                # (VPP) uses the round-5 interleaved-1F1B program — the
+                # reference's VPP training schedule — instead of AD
+                # through the wavefront, whose residency grows with
+                # accumulate_steps
                 def run(v, prp, hdp, mb, lab):
                     mbs, vjp_pre = jax.vjp(
                         lambda q: pre_apply(native_cast(q, prp), mb),
                         f32_view(prp))
-                    loss, dv, dhead, dmbs = pp_spmd.pipeline_hetero_1f1b(
-                        stage_fns, head_loss, v, specs, hdp, mbs, lab,
-                        mesh, defer_dw=(schedule == "zero_bubble"))
+                    if schedule == "interleave":
+                        loss, dv, dhead, dmbs = \
+                            pp_spmd.pipeline_hetero_interleave_1f1b(
+                                stage_fns, head_loss, v, specs, hdp,
+                                mbs, lab, mesh, num_chunks)
+                    else:
+                        loss, dv, dhead, dmbs = \
+                            pp_spmd.pipeline_hetero_1f1b(
+                                stage_fns, head_loss, v, specs, hdp,
+                                mbs, lab, mesh,
+                                defer_dw=(schedule == "zero_bubble"))
                     dpre = vjp_pre(dmbs.astype(mbs.dtype))[0]
                     return loss, (dv, dpre, dhead)
-            else:  # gpipe / interleaved wavefront, AD backward
+            else:  # gpipe wavefront, AD backward
                 def run(v, prp, hdp, mb, lab):
                     v32 = f32_view(v)
 
                     def total(v_, prp_, hdp_):
                         mbs = pre_apply(native_cast(prp_, prp), mb)
-                        if schedule == "interleave":
-                            outs = pp_spmd.pipeline_hetero_interleave(
-                                stage_fns, v_, specs, mbs, mesh,
-                                num_chunks)
-                        else:
-                            outs = pp_spmd.pipeline_hetero(
-                                stage_fns, v_, specs, mbs, mesh)
+                        outs = pp_spmd.pipeline_hetero(
+                            stage_fns, v_, specs, mbs, mesh)
                         hp = native_cast(hdp_, hdp)
                         losses = jax.vmap(
                             lambda y, l: head_loss(hp, y, l))(outs, lab)

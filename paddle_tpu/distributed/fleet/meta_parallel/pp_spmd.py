@@ -251,77 +251,40 @@ def pipeline_interleave(stage_fn: Callable, stacked_params, microbatches,
     return fn(stacked_params, microbatches)
 
 
-def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
-                             stacked_params, head_params, microbatches,
-                             labels, mesh: Mesh, num_chunks: int,
-                             pp_axis: str = "pp"):
-    """Interleaved (VPP) schedule with a HAND-WRITTEN depth-bounded
-    backward — the memory contract of ``pipeline_1f1b`` at the bubble of
-    ``pipeline_interleave``.
-
-    Motivation (round-5 AOT sweep, PERF_NOTES): AD through the interleave
-    wavefront keeps every in-flight microbatch residual alive until the
-    reverse wavefront — 223 GB/chip on the 13B recipe. Here the combined
-    scan runs one forward AND one backward VIRTUAL-STAGE unit per tick,
-    stashing only raw stage inputs in a (2V-1)-slot ring (V = P*C virtual
-    stages), so activation residency is bounded by the virtual pipeline
-    depth — NOT by M — while the bubble stays the VPP (P-1)/(M*C + P-1)
-    class. This is the TPU lockstep translation of Megatron's interleaved
-    1F1B (reference: meta_parallel/pipeline_parallel.py:1174
-    forward_backward_pipeline_with_interleaving).
-
-    Schedule closed forms (d = device, t = tick, requires M % P == 0):
-    - forward: unit u = t - d; u = g*V + c*P + r -> chunk c,
-      microbatch m = g*P + r. Output ppermutes d -> d+1 (wrap P-1 -> 0
-      carries chunk c's exit into chunk c+1's entry), consumed next tick.
-    - backward: unit w = t - (V-1) - (P-1-d); w = g*V + q*P + r ->
-      chunk c = C-1 - q, microbatch m = g*P + r. Cotangent ppermutes
-      d -> d-1 (wrap 0 -> P-1 carries chunk c+1's entry-grad back to
-      chunk c's exit), consumed next tick. The first backward (v = V-1)
-      consumes the same-tick head-loss cotangent, as in pipeline_1f1b.
-    - the stash ring holds stage INPUTS by forward tick mod (2V-1); the
-      backward of a unit forward-run at tick t_f reads slot t_f mod R,
-      and max(t_b - t_f) = 2V - 2 < R, so no slot is overwritten early.
-      Backward recomputes the stage forward from the saved input (remat).
-
-    stage_fn(chunk_params, x) -> y; loss_fn(head_params, y, label) ->
-    scalar (per-microbatch, scaled by 1/M here).
-    stacked_params: pytree [P, num_chunks, ...] round-robin layout
-    (virtual stage v at [v % P, v // P]), dim 0 sharded over pp.
-    Returns (mean_loss, d_stacked [P, num_chunks, ...] f32, d_head,
-    d_microbatches) — gradients accumulate in f32.
-    """
+def _interleave_1f1b_core(apply_chunk, stacked_vec, head_params,
+                          microbatches, labels, mesh: Mesh,
+                          num_chunks: int, pp_axis: str, loss_fn,
+                          vec_spec):
+    """Shared combined fwd+bwd scan for the interleaved (VPP) 1F1B
+    schedule — the closed forms documented on pipeline_interleave_1f1b.
+    ``apply_chunk(params_me, c, x, d)`` applies this device's virtual
+    stage of chunk ``c``; ``vec_spec`` is the shard_map pytree-prefix
+    spec for the stacked carrier (and its gradient)."""
     num_stages = mesh.shape[pp_axis]
     C = num_chunks
+    V = num_stages * C
     M = microbatches.shape[0]
     assert M % num_stages == 0, (
         f"interleaved schedule needs microbatches ({M}) % pp stages "
         f"({num_stages}) == 0")
-    V = num_stages * C
-    U = M * C                       # fwd (= bwd) units per device
-    T = U + V + num_stages - 2      # last bwd: w=U-1 at d=0
+    U = M * C
+    T = U + V + num_stages - 2
     R = 2 * V - 1
     manual = frozenset({pp_axis})
     inv_m = 1.0 / M
 
-    def per_device(params_local, head, mb_local, lab_local):
-        params_me = jax.tree.map(lambda x: x[0], params_local)  # [C, ...]
+    def per_device(vec_local, head, mb_local, lab_local):
+        vec_me = jax.tree.map(lambda a: a[0], vec_local)
         d = lax.axis_index(pp_axis)
         P_ = num_stages
         last = P_ - 1
         perm_f = [(i, (i + 1) % P_) for i in range(P_)]
         perm_b = [(i, (i - 1) % P_) for i in range(P_)]
 
-        def chunk_apply(vme, c, x):
-            p_c = jax.tree.map(
-                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
-                vme)
-            return stage_fn(p_c, x)
-
         zero_x = jnp.zeros_like(mb_local[0])
         ring0 = jnp.zeros((R,) + zero_x.shape, zero_x.dtype)
         dw0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
-                           params_me)
+                           vec_me)
         dhead0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
                               head)
         dx0 = jnp.zeros((M,) + zero_x.shape, jnp.float32)
@@ -340,7 +303,7 @@ def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
             feed = lax.dynamic_index_in_dim(mb_local, m_f, 0,
                                             keepdims=False)
             x_in = jnp.where((d == 0) & (c_f == 0), feed, f_rc)
-            y = chunk_apply(params_me, c_f, x_in)
+            y = apply_chunk(vec_me, c_f, x_in, d)
             ring = jnp.where(
                 f_on,
                 lax.dynamic_update_index_in_dim(ring, x_in,
@@ -376,11 +339,11 @@ def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
             dy_in = jnp.where((d == last) & (c_b == C - 1),
                               dy_self.astype(b_rc.dtype), b_rc)
             _, stage_vjp = jax.vjp(
-                lambda vme, xx: chunk_apply(vme, c_b, xx), params_me,
+                lambda vme, xx: apply_chunk(vme, c_b, xx, d), vec_me,
                 x_sv)
-            # vjp through dynamic_index scatters into a full [C, ...]
-            # tree (zeros off-chunk), so plain accumulation lands the
-            # chunk's grads without any indexed add
+            # vjp through dynamic_index scatters into a full-size tree
+            # (zeros off-chunk), so plain accumulation lands the chunk's
+            # grads without any indexed add
             dv_c, dx_c = stage_vjp(dy_in)
             dw = jax.tree.map(
                 lambda acc, g: acc + jnp.where(b_on,
@@ -413,12 +376,64 @@ def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
 
     fn = jax.shard_map(
         per_device, mesh=mesh, axis_names=manual,
-        in_specs=(jax.tree.map(lambda _: P(pp_axis), stacked_params),
-                  P(), P(), P()),
-        out_specs=(P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
-                   P(), P()),
+        in_specs=(vec_spec, P(), P(), P()),
+        out_specs=(P(), vec_spec, P(), P()),
         check_vma=False)
-    return fn(stacked_params, head_params, microbatches, labels)
+    return fn(stacked_vec, head_params, microbatches, labels)
+
+
+def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
+                             stacked_params, head_params, microbatches,
+                             labels, mesh: Mesh, num_chunks: int,
+                             pp_axis: str = "pp"):
+    """Interleaved (VPP) schedule with a HAND-WRITTEN depth-bounded
+    backward — the memory contract of ``pipeline_1f1b`` at the bubble of
+    ``pipeline_interleave``.
+
+    Motivation (round-5 AOT sweep, PERF_NOTES): AD through the interleave
+    wavefront keeps every in-flight microbatch residual alive until the
+    reverse wavefront — 223 GB/chip on the 13B recipe. Here the combined
+    scan runs one forward AND one backward VIRTUAL-STAGE unit per tick
+    (the shared ``_interleave_1f1b_core``), stashing only raw stage
+    inputs in a (2V-1)-slot ring (V = P*C virtual stages), so activation
+    residency is bounded by the virtual pipeline depth — NOT by M —
+    while the bubble stays the VPP (P-1)/(M*C + P-1) class. This is the
+    TPU lockstep translation of Megatron's interleaved 1F1B (reference:
+    meta_parallel/pipeline_parallel.py:1174
+    forward_backward_pipeline_with_interleaving).
+
+    Schedule closed forms (d = device, t = tick, requires M % P == 0):
+    - forward: unit u = t - d; u = g*V + c*P + r -> chunk c,
+      microbatch m = g*P + r. Output ppermutes d -> d+1 (wrap P-1 -> 0
+      carries chunk c's exit into chunk c+1's entry), consumed next tick.
+    - backward: unit w = t - (V-1) - (P-1-d); w = g*V + q*P + r ->
+      chunk c = C-1 - q, microbatch m = g*P + r. Cotangent ppermutes
+      d -> d-1 (wrap 0 -> P-1 carries chunk c+1's entry-grad back to
+      chunk c's exit), consumed next tick. The first backward (v = V-1)
+      consumes the same-tick head-loss cotangent, as in pipeline_1f1b.
+    - the stash ring holds stage INPUTS by forward tick mod (2V-1); the
+      backward of a unit forward-run at tick t_f reads slot t_f mod R,
+      and max(t_b - t_f) = 2V - 2 < R, so no slot is overwritten early.
+      Backward recomputes the stage forward from the saved input (remat).
+
+    stage_fn(chunk_params, x) -> y; loss_fn(head_params, y, label) ->
+    scalar (per-microbatch, scaled by 1/M here).
+    stacked_params: pytree [P, num_chunks, ...] round-robin layout
+    (virtual stage v at [v % P, v // P]), dim 0 sharded over pp.
+    Returns (mean_loss, d_stacked [P, num_chunks, ...] f32, d_head,
+    d_microbatches) — gradients accumulate in f32.
+    """
+    def apply_chunk(vme, c, x, d):
+        p_c = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            vme)
+        return stage_fn(p_c, x)
+
+    return _interleave_1f1b_core(
+        apply_chunk, stacked_params, head_params, microbatches, labels,
+        mesh, num_chunks, pp_axis, loss_fn,
+        jax.tree.map(lambda _: P(pp_axis), stacked_params))
+
 
 
 def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
@@ -933,3 +948,39 @@ def pipeline_hetero_interleave(stage_fns: Sequence[Callable], stacked_vec,
         in_specs=(P(pp_axis, None, None), P()), out_specs=P(),
         check_vma=False)
     return fn(stacked_vec, microbatches)
+
+
+def pipeline_hetero_interleave_1f1b(stage_fns: Sequence[Callable],
+                                    loss_fn: Callable, stacked_vec, specs,
+                                    head_params, microbatches, labels,
+                                    mesh: Mesh, num_chunks: int,
+                                    pp_axis: str = "pp"):
+    """Heterogeneous VPP with the hand-written depth-bounded backward —
+    ``pipeline_interleave_1f1b``'s schedule (same shared
+    ``_interleave_1f1b_core``) over the per-dtype flattened carrier +
+    lax.switch virtual-stage dispatch of the hetero tier.
+
+    stacked_vec: {dtype: [P, num_chunks, Lmax_dt]} (round-robin layout
+    from ``flatten_stage_params_interleaved``); specs in canonical
+    virtual-stage order. Returns (mean_loss, d_stacked {dtype:
+    [P, num_chunks, Lmax_dt] f32}, d_head_params, d_microbatches).
+    Requires M % P == 0.
+    """
+    num_stages = mesh.shape[pp_axis]
+    V = num_stages * num_chunks
+    assert len(stage_fns) == V == len(specs)
+
+    def apply_chunk(vme, c, x, d):
+        vec_c = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            vme)
+        v_id = c * num_stages + d
+        branches = [
+            (lambda args, s=s: stage_fns[s](
+                unflatten_stage(args[0], specs[s]), args[1]))
+            for s in range(V)]
+        return lax.switch(v_id, branches, (vec_c, x))
+
+    return _interleave_1f1b_core(
+        apply_chunk, stacked_vec, head_params, microbatches, labels,
+        mesh, num_chunks, pp_axis, loss_fn, P(pp_axis, None, None))

@@ -273,3 +273,125 @@ def test_hetero_stacking_native_dtype():
                 np.asarray(dstages[s][k], np.float32),
                 np.asarray(ref_dp[s][k], np.float32),
                 atol=atol, err_msg=f"stage {s} {k}")
+
+
+def test_hetero_interleave_1f1b_direct_parity():
+    """Direct pp_spmd-level check of the hetero hand-written VPP: stages
+    with DIFFERENT param structures per virtual stage, loss + all grads
+    equal to sequential AD, and temp memory flat in M (depth-bounded)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.meta_parallel import pp_spmd
+
+    P_, C, H = 4, 2, 8
+    V = P_ * C
+    mesh = Mesh(np.array(jax.devices()[:P_]), ("pp",))
+    rng = np.random.RandomState(7)
+
+    def mk(i):
+        if i % 2 == 0:   # even virtual stages: affine
+            return {"w": jnp.asarray(rng.randn(H, H).astype("float32"))
+                    * 0.3,
+                    "b": jnp.asarray(rng.randn(H).astype("float32"))}
+        # odd virtual stages: two-matrix bottleneck (different structure)
+        return {"w1": jnp.asarray(rng.randn(H, 4).astype("float32")) * 0.3,
+                "w2": jnp.asarray(rng.randn(4, H).astype("float32")) * 0.3}
+
+    per_stage = [mk(i) for i in range(V)]
+
+    def make_fn(i):
+        if i % 2 == 0:
+            return lambda p, x: jnp.tanh(x @ p["w"] + p["b"])
+        return lambda p, x: x + jnp.tanh(x @ p["w1"]) @ p["w2"]
+
+    stage_fns = [make_fn(i) for i in range(V)]
+    head = {"v": jnp.asarray(rng.randn(H).astype("float32"))}
+
+    def loss_fn(hp, y, lab):
+        return jnp.mean((y @ hp["v"] - lab) ** 2)
+
+    M = 8
+    mbs = jnp.asarray(rng.randn(M, 2, H).astype("float32"))
+    labs = jnp.asarray(rng.randn(M, 2).astype("float32"))
+    stacked, specs = pp_spmd.flatten_stage_params_interleaved(
+        per_stage, mesh, C)
+
+    loss, dvec, dhead, dmbs = jax.jit(
+        lambda v, h, m, l: pp_spmd.pipeline_hetero_interleave_1f1b(
+            stage_fns, loss_fn, v, specs, h, m, l, mesh, C))(
+        stacked, head, mbs, labs)
+
+    def seq(params, hp, m, l):
+        tot = 0.0
+        for i in range(M):
+            y = m[i]
+            for s in range(V):
+                y = stage_fns[s](params[s], y)
+            tot = tot + loss_fn(hp, y, l[i])
+        return tot / M
+
+    ref_loss, (ref_dp, ref_dh, ref_dm) = jax.value_and_grad(
+        seq, argnums=(0, 1, 2))(per_stage, head, mbs, labs)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dhead["v"]),
+                               np.asarray(ref_dh["v"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dmbs), np.asarray(ref_dm),
+                               atol=1e-4)
+    # canonical virtual stage v -> round-robin [v % P, v // P]
+    dv_canon = jax.tree.map(
+        lambda a: jnp.transpose(a, (1, 0, 2)).reshape(V, a.shape[-1]),
+        dvec)
+    dstages = pp_spmd.unflatten_stage_grads(dv_canon, specs)
+    for s in range(V):
+        for k in per_stage[s]:
+            np.testing.assert_allclose(
+                np.asarray(dstages[s][k]), np.asarray(ref_dp[s][k]),
+                atol=1e-4, err_msg=f"vstage {s} {k}")
+
+    # depth-bounded residency: temp ~flat as M grows
+    def temp_bytes(m):
+        sds = jax.ShapeDtypeStruct((m, 2, H), jnp.float32)
+        lsd = jax.ShapeDtypeStruct((m, 2), jnp.float32)
+        f = jax.jit(
+            lambda v, h, mb, l: pp_spmd.pipeline_hetero_interleave_1f1b(
+                stage_fns, loss_fn, v, specs, h, mb, l, mesh, C))
+        comp = f.lower(stacked, head, sds, lsd).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    small, big = temp_bytes(8), temp_bytes(64)
+    per_mb = 2 * H * 4
+    assert (big - small) / 56 < 4 * per_mb, (small, big)
+
+
+def test_hetero_interleave_ad_forward_matches_sequential():
+    """Pin the AD-backed hetero VPP wavefront (pipeline_hetero_interleave)
+    directly: the engine now trains through the hand-written backward, so
+    this is the only executable contract keeping the AD formulation (the
+    reference implementation the hand-written one is checked against)
+    honest."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.meta_parallel import pp_spmd
+
+    P_, C, H = 4, 2, 8
+    V = P_ * C
+    mesh = Mesh(np.array(jax.devices()[:P_]), ("pp",))
+    rng = np.random.RandomState(11)
+    per_stage = [{"w": jnp.asarray(rng.randn(H, H).astype("float32"))
+                  * 0.3} for _ in range(V)]
+    stage_fns = [(lambda p, x: jnp.tanh(x @ p["w"]))] * V
+    stacked, specs = pp_spmd.flatten_stage_params_interleaved(
+        per_stage, mesh, C)
+    M = 8
+    mbs = jnp.asarray(rng.randn(M, 2, H).astype("float32"))
+    outs = jax.jit(lambda v, m: pp_spmd.pipeline_hetero_interleave(
+        stage_fns, v, specs, m, mesh, C))(stacked, mbs)
+
+    def seq(x):
+        for s in range(V):
+            x = stage_fns[s](per_stage[s], x)
+        return x
+    np.testing.assert_allclose(np.asarray(outs),
+                               np.asarray(jax.vmap(seq)(mbs)), atol=1e-5)

@@ -63,7 +63,7 @@ EOF
     fi
     # merge measured decode tiers into the last-good record (idempotent;
     # runs every window so a once-failed merge self-heals)
-    python - <<'EOF' 2>>"$LOG" || true
+    [ -f artifacts/decode_live.json ] && python - <<'EOF' 2>>"$LOG" || true
 import json, time
 with open("artifacts/decode_live.json") as f:
     lines = [l for l in f.read().splitlines() if l.strip()]
