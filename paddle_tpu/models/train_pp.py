@@ -83,7 +83,6 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
     "1f1b" depth-bounded residency, "zero_bubble" 1F1B with deferred dW.
     Batch dim must divide num_microbatches.
     """
-    assert cfg.moe is None, "pp+MoE composition not yet supported"
     assert schedule in ("gpipe", "interleave", "interleave_1f1b", "1f1b",
                         "zero_bubble")
     num_stages = mesh.shape[pp_axis]
@@ -93,28 +92,61 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
     lp_per_stage = cfg.num_layers // nseg
     dp = dp_axis if dp_axis in mesh.axis_names else None
 
+    # pp × MoE composition: the MoE load-balance aux loss must (a) reach
+    # the final loss and (b) backprop into each stage's router — but the
+    # pipeline carry is ONE static-shape array. The aux scalar rides IN
+    # the carry as one extra sequence position (spread uniformly over the
+    # hidden dim so its bf16 transport keeps ~0.4% relative precision on
+    # a regularizer term): stages slice the real activations, run their
+    # blocks, add their aux into the extra row, and re-concat. Works
+    # identically under every schedule (gpipe AD, 1F1B, zero-bubble, VPP)
+    # because gradients flow through the slice/concat like any other op.
+    # Reference capability: pp+EP hybrid (fleet hybrid_configs with moe;
+    # experts shard over an "ep" mesh axis via the param specs).
+    moe_aux = cfg.moe is not None
+
     from ..distributed.fleet.meta_parallel.pp_spmd import (
         pipeline_spmd, pipeline_interleave, pipeline_1f1b,
         pipeline_interleave_1f1b)
 
     def make_stage_fn(cos, sin):
         def stage_fn(stage_params, xin):
+            x = xin[:, :-1] if moe_aux else xin
+
             def body(c, lp):
-                y, _ = llama._block(c, lp, cos, sin, cfg, None)
-                return y, None
-            y, _ = lax.scan(body, xin, stage_params)
-            return y
+                y, aux = llama._block(c, lp, cos, sin, cfg, None)
+                return y, aux
+            y, auxs = lax.scan(body, x, stage_params)
+            if not moe_aux:
+                return y
+            aux_row = xin[:, -1:] + (jnp.sum(auxs) /
+                                     xin[:, -1:].size).astype(xin.dtype)
+            return jnp.concatenate([y, aux_row], axis=1)
         return stage_fn
 
     def head_of(params):
         return params["embed"].T if cfg.tie_embeddings else \
             params["lm_head"]
 
+    def _split_aux(y):
+        """(activations, accumulated aux scalar) from a carry."""
+        if not moe_aux:
+            return y, jnp.float32(0.0)
+        return y[:, :-1], jnp.sum(y[:, -1:].astype(jnp.float32))
+
+    def _augment(x):
+        """Append the zeroed aux row to embedded microbatch activations."""
+        if not moe_aux:
+            return x
+        pad = jnp.zeros(x.shape[:-2] + (1, x.shape[-1]), x.dtype)
+        return jnp.concatenate([x, pad], axis=-2)
+
     def head_loss(hp, y, label):
+        y, aux = _split_aux(y)
         h = llama.rms_norm(y, hp["final_norm"], cfg.rms_eps)
         logits = (h @ hp["head"].astype(h.dtype)).astype(jnp.float32)
         ce = llama._ce(logits[:, :-1], label[:, 1:])
-        return jnp.mean(ce)
+        return jnp.mean(ce) + aux
 
     def loss(params, tokens):
         B, S = tokens.shape
@@ -129,7 +161,7 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
                 lambda a: a.reshape(num_stages, num_chunks, lp_per_stage,
                                     *a.shape[1:]),
                 params["layers"])
-            mbs = x.reshape(M, mb, S, cfg.hidden_size)
+            mbs = _augment(x.reshape(M, mb, S, cfg.hidden_size))
             outs = pipeline_interleave(stage_fn, stacked, mbs, mesh,
                                        num_chunks, pp_axis)
         else:
@@ -137,10 +169,17 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
                 lambda a: a.reshape(num_stages, lp_per_stage,
                                     *a.shape[1:]),
                 params["layers"])
-            mbs = x.reshape(M, mb, S, cfg.hidden_size)
+            mbs = _augment(x.reshape(M, mb, S, cfg.hidden_size))
             outs = pipeline_spmd(stage_fn, stacked, mbs, mesh, pp_axis)
+        if moe_aux:
+            # per-microbatch aux rows -> mean over microbatches (same
+            # accounting as the per-microbatch head_loss path)
+            aux = jnp.sum(outs[:, :, -1:].astype(jnp.float32)) / M
+            outs = outs[:, :, :-1]
+        else:
+            aux = jnp.float32(0.0)
         outs = outs.reshape(B, S, cfg.hidden_size)
-        return _full_head_loss(params, outs, tokens)
+        return _full_head_loss(params, outs, tokens) + aux
 
     def _full_head_loss(params, outs, tokens):
         h = llama.rms_norm(outs, params["final_norm"], cfg.rms_eps)
@@ -158,7 +197,7 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
 
         def embed_fn(emb):
             x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
-            return x.reshape(M, mb, S, cfg.hidden_size)
+            return _augment(x.reshape(M, mb, S, cfg.hidden_size))
 
         mbs, vjp_embed = jax.vjp(embed_fn, params["embed"])
         labels = tokens.reshape(M, mb, S)

@@ -190,6 +190,45 @@ def validate_moe(n: int, batch_mult: int = 1):
          "experts": 16, "top_k": 2, "remat_policy": cfg.remat_policy})
 
 
+def validate_moe_pp(n: int, batch_mult: int = 1):
+    """Round-5 composition: the BASELINE #5 MoE under the PIPELINE engine
+    (pp × ep × tp, hand-written VPP schedule) — the reference's pp+MoE
+    hybrid. Aux load-balance loss rides the pipeline carry
+    (train_pp.make_train_step_pp moe_aux)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.models import llama, moe, train, train_pp
+
+    pp = 2
+    ep = min(4, max(1, n // (pp * 2)))
+    tp = 2 if n % 2 == 0 else 1
+    dp = max(1, n // (pp * ep * tp))
+    mesh = Mesh(
+        np.asarray(jax.devices()[:dp * pp * ep * tp]).reshape(
+            dp, pp, ep, tp),
+        ("dp", "pp", "ep", "tp"))
+    cfg = llama.LlamaConfig(
+        hidden_size=2048, intermediate_size=5632, num_layers=24,
+        num_heads=16, num_kv_heads=16, vocab_size=32000,
+        max_seq_len=4096, dtype=jnp.bfloat16, remat=True,
+        moe=moe.MoEConfig(num_experts=16, top_k=2, capacity_factor=1.25))
+    microbatches = 4
+    batch = microbatches * dp * batch_mult
+    step = train_pp.make_train_step_pp(
+        cfg, mesh, num_microbatches=microbatches,
+        schedule="interleave_1f1b", num_chunks=2)
+    st_sh = train_pp.state_shardings_pp(mesh, cfg)
+    return _analyze(
+        "ernie_moe_pp2_ep_vpp", step,
+        _state_sds(cfg, mesh, st_sh),
+        _tokens_sds(mesh, batch, 4096, ("dp",)), mesh,
+        {"params": cfg.num_params(), "batch": batch, "seq": 4096,
+         "microbatches": microbatches, "experts": 16, "top_k": 2,
+         "schedule": "interleave_1f1b_c2",
+         "remat_policy": cfg.remat_policy})
+
+
 def _impl(args) -> int:
     rows = []
     if args.config in ("7b", "all"):
@@ -200,6 +239,8 @@ def _impl(args) -> int:
                                  num_chunks=args.num_chunks))
     if args.config in ("moe", "all"):
         rows.append(validate_moe(args.devices, args.batch_mult))
+    if args.config in ("moe-pp", "all"):
+        rows.append(validate_moe_pp(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         print(json.dumps(r))
@@ -211,7 +252,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=16,
                     help="virtual chips (v5p-32 slice = 16 chips)")
-    ap.add_argument("--config", choices=["7b", "13b", "moe", "all"],
+    ap.add_argument("--config",
+                    choices=["7b", "13b", "moe", "moe-pp",
+                             "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")
