@@ -45,6 +45,13 @@ from .engines import MetaParallelBase
 from .parallel_layers import PipelineLayer
 
 
+def _aux_layers(layer):
+    """Sublayers (incl. self) that report an MoE-style aux loss on
+    ``_last_aux_loss`` after each forward (incubate MoELayer & friends)."""
+    return [l for l in layer.sublayers(include_self=True)
+            if hasattr(l, "_last_aux_loss")]
+
+
 class PipelineParallel(MetaParallelBase):
     """reference: meta_parallel/pipeline_parallel.py:255."""
 
@@ -194,16 +201,47 @@ class PipelineParallel(MetaParallelBase):
         per_stage = [[{k: jnp.asarray(p._value) for k, p in pd.items()}
                       for _, pd in st] for st in stages]
 
-        def stage_fn(stage_params, xin):
-            t = Tensor(xin, _internal=True)
-            for (layer, _), pd in zip(stages[0], stage_params):
+        # pp × MoE (round 5): MoE layers report their load-balance aux
+        # loss on ``_last_aux_loss`` after each forward — eager users add
+        # it to the objective via the ``aux_loss`` property. The pipeline
+        # carry is one static-shape array, so when any ring layer
+        # produces aux, the carry grows ONE extra last-axis slot that
+        # accumulates each stage's aux (spread over the slot so bf16
+        # transport stays precise); the head slices it off and adds it to
+        # the loss. Gradients flow through the slice/concat under every
+        # schedule.
+        moe_aux = any(_aux_layers(layer)
+                      for st in stages for layer, _ in st)
+
+        def _apply_layers(layer_list, plist, t):
+            aux = jnp.float32(0.0)
+            for (layer, _), pd in zip(layer_list, plist):
                 t = layer.functional_call(pd, t, training=True)
-            return to_raw(t)
+                for l in _aux_layers(layer):
+                    a = l._last_aux_loss
+                    if a is not None:
+                        aux = aux + to_raw(a).astype(jnp.float32)
+            return t, aux
+
+        def stage_fn(stage_params, xin):
+            x = xin[..., :-1] if moe_aux else xin
+            t, aux = _apply_layers(stages[0], stage_params,
+                                   Tensor(x, _internal=True))
+            y = to_raw(t)
+            if not moe_aux:
+                return y
+            row = xin[..., -1:] + (aux / xin[..., -1:].size).astype(
+                xin.dtype)
+            return jnp.concatenate([y, row], axis=-1)
 
         def head_loss(_head, y, label):
+            aux = jnp.float32(0.0)
+            if moe_aux:
+                aux = jnp.sum(y[..., -1:].astype(jnp.float32))
+                y = y[..., :-1]
             out = loss_fn(Tensor(y, _internal=True),
                           Tensor(label, _internal=True))
-            return to_raw(out)
+            return to_raw(out) + aux
 
         if self._spmd_step is None:
             if schedule in ("1f1b", "zero_bubble"):
@@ -236,6 +274,9 @@ class PipelineParallel(MetaParallelBase):
                 per_stage, mesh, num_chunks)
         else:
             stacked = pp_spmd.stack_stage_params(per_stage, mesh)
+        if moe_aux:  # zeroed aux slot on the carry's last axis
+            pad = jnp.zeros(mbs.shape[:-1] + (1,), mbs.dtype)
+            mbs = jnp.concatenate([mbs, pad], axis=-1)
         loss, dstacked = step(stacked, mbs, lbs)
 
         # scatter grads back into parameter .grad slots
@@ -330,11 +371,37 @@ class PipelineParallel(MetaParallelBase):
                      for k, p in dict(layer.named_parameters()).items()}
                     for layer in layers]
 
-        def apply_layers(layers, plist, xin):
-            t = Tensor(xin, _internal=True)
+        # pp × MoE on the hetero path: same aux-slot carry trick as the
+        # homogeneous engine (see _spmd_forward_backward) — MoE layers'
+        # ``_last_aux_loss`` accumulates in one extra last-axis slot of
+        # the static carry and lands in the head loss
+        moe_aux = any(_aux_layers(layer)
+                      for st in ([pre] + list(ring) + [head]) for layer
+                      in st)
+
+        def _apply_raw(layers, plist, t):
+            aux = jnp.float32(0.0)
             for layer, pd in zip(layers, plist):
                 t = layer.functional_call(pd, t, training=True)
-            return to_raw(t)
+                for l in _aux_layers(layer):
+                    a = l._last_aux_loss
+                    if a is not None:
+                        aux = aux + to_raw(a).astype(jnp.float32)
+            return t, aux
+
+        def apply_layers(layers, plist, xin):
+            """Ring-stage application over the (possibly aux-augmented)
+            carry."""
+            if not moe_aux:
+                t, _ = _apply_raw(layers, plist,
+                                  Tensor(xin, _internal=True))
+                return to_raw(t)
+            x = xin[..., :-1]
+            t, aux = _apply_raw(layers, plist, Tensor(x, _internal=True))
+            y = to_raw(t)
+            row = xin[..., -1:] + (aux / xin[..., -1:].size).astype(
+                xin.dtype)
+            return jnp.concatenate([y, row], axis=-1)
 
         x = to_raw(inputs)
         lb = to_raw(labels)
@@ -354,11 +421,24 @@ class PipelineParallel(MetaParallelBase):
             for st in ring]
 
         def head_loss(hp, y, lab):
-            out = Tensor(apply_layers(head, hp, y), _internal=True)
-            return to_raw(loss_fn(out, Tensor(lab, _internal=True)))
+            aux = jnp.float32(0.0)
+            if moe_aux:
+                aux = jnp.sum(y[..., -1:].astype(jnp.float32))
+                y = y[..., :-1]
+            t, head_aux = _apply_raw(head, hp, Tensor(y, _internal=True))
+            return to_raw(loss_fn(t, Tensor(lab, _internal=True))) + \
+                aux + head_aux
 
         def pre_apply(pp_, mb):
-            return jax.vmap(lambda xi: apply_layers(pre, pp_, xi))(mb)
+            def one(xi):
+                t, aux = _apply_raw(pre, pp_, Tensor(xi, _internal=True))
+                y = to_raw(t)
+                if not moe_aux:
+                    return y
+                row = jnp.zeros(y.shape[:-1] + (1,), y.dtype) + \
+                    (aux / int(np.prod(y.shape[:-1]))).astype(y.dtype)
+                return jnp.concatenate([y, row], axis=-1)
+            return jax.vmap(one)(mb)
 
         # Gradients must ACCUMULATE in f32 even for bf16 params: cotangents
         # match the primal dtype, so the differentiated-against trees are
@@ -488,6 +568,14 @@ class PipelineParallel(MetaParallelBase):
                 raise RuntimeError("PipelineLayer needs loss_fn for "
                                    "train_batch")
             loss = loss_fn(out, y)
+            # MoE layers' load-balance aux joins the objective here too —
+            # the SPMD paths carry it in the pipeline carry's aux slot;
+            # a fallback that dropped it would make the engine's loss
+            # (and the routers' gradients) path-dependent
+            for l in _aux_layers(self._layers):
+                a = l._last_aux_loss
+                if a is not None:
+                    loss = loss + a
             scaled = loss / self.accumulate_steps
             if scaler is not None:
                 scaled = scaler.scale(scaled)

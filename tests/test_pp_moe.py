@@ -121,3 +121,206 @@ def test_pp_moe_trains():
         st, m = step(st, toks)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] - 0.2, losses
+
+
+# ---------------- fleet engine (PipelineLayer) tier ----------------
+
+def _engine_aux_ref(pipe, loss_fn, x, y, m=4):
+    """Eager PER-MICROBATCH reference (the pipeline's accounting, same
+    as the reference engine's): for each microbatch, loss_fn + that
+    microbatch's MoE aux (aux is nonlinear in batch statistics, so
+    full-batch aux would NOT match a microbatched pipeline); mean over
+    microbatches. Returns (loss, grads) and clears."""
+    import paddle_tpu as paddle
+    sz = x.shape[0] // m
+    total = None
+    for i in range(m):
+        xi = paddle.to_tensor(x.numpy()[i * sz:(i + 1) * sz])
+        yi = paddle.to_tensor(y.numpy()[i * sz:(i + 1) * sz])
+        out = pipe(xi)
+        loss = loss_fn(out, yi)
+        for layer in pipe.sublayers(include_self=True):
+            a = getattr(layer, "_last_aux_loss", None)
+            if a is not None:
+                loss = loss + a
+        total = loss if total is None else total + loss
+    total = total / m
+    total.backward()
+    g = {n: p.grad.numpy().copy() for n, p in pipe.named_parameters()}
+    for p in pipe.parameters():
+        p.clear_grad()
+    return float(total.numpy()), g
+
+
+@pytest.mark.parametrize("schedule", ["1F1B", "VPP"])
+def test_engine_pp_moe_matches_eager(schedule):
+    """Fleet PipelineLayer with MoE layers in every stage: the SPMD
+    pipeline loss and grads equal eager loss+aux (the engine carries the
+    aux in the carry's extra last-axis slot)."""
+    import warnings
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    np.random.seed(5)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": schedule}
+    dist.fleet.init(strategy=strategy)
+    chunks = 2 if schedule == "VPP" else 1
+    descs = [LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
+                       capacity_factor=2.0)
+             for _ in range(4 * chunks)]
+    kw = ({"num_virtual_pipeline_stages": 2} if chunks == 2 else {})
+    pipe = PipelineLayer(layers=descs, num_stages=4, loss_fn=loss_fn,
+                         **kw)
+    model = dist.fleet.distributed_model(pipe)
+    x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    ref_loss, ref_g = _engine_aux_ref(pipe, loss_fn, x, y)
+
+    import warnings as _w
+    with _w.catch_warnings(record=True) as w:
+        _w.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert not any("NO pipeline" in str(m.message) for m in w), \
+            "pp x MoE fell back to accumulation"
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=2e-3)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=2e-3,
+                                   err_msg=f"{schedule}: {n}")
+
+
+def test_engine_pp_moe_hetero_matches_eager():
+    """Hetero stages (embed != MoE blocks != head) under the hetero SPMD
+    path with the aux slot on the carry."""
+    import warnings
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    np.random.seed(6)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": "1F1B"}
+    dist.fleet.init(strategy=strategy)
+    descs = [
+        LayerDesc(paddle.nn.Embedding, 16, 8),               # stage 0
+        LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
+                  capacity_factor=2.0),                      # stage 1
+        LayerDesc(paddle.nn.Linear, 8, 8),                   # stage 2
+        LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
+                  capacity_factor=2.0),                      # stage 3
+    ]
+    pipe = PipelineLayer(layers=descs, num_stages=4, loss_fn=loss_fn)
+    model = dist.fleet.distributed_model(pipe)
+    x = paddle.to_tensor(np.random.randint(0, 16, (8,)).astype("int64"))
+    y = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    ref_loss, ref_g = _engine_aux_ref(pipe, loss_fn, x, y)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert not any("NO pipeline" in str(m.message) for m in w)
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=2e-3)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=2e-3,
+                                   err_msg=n)
+
+
+def test_engine_pp_moe_fallback_keeps_aux():
+    """The accumulation FALLBACK must include MoE aux too — otherwise the
+    engine's loss (and the routers' gradients) would be path-dependent.
+    Trigger the fallback with a shape-changing mid-ring stage."""
+    import warnings
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    np.random.seed(7)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": "1F1B"}
+    dist.fleet.init(strategy=strategy)
+    descs = [
+        LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
+                  capacity_factor=2.0),
+        LayerDesc(paddle.nn.Linear, 8, 12),   # widens mid-ring: fallback
+        LayerDesc(paddle.nn.Linear, 12, 8),
+        LayerDesc(paddle.nn.Linear, 8, 8),
+    ]
+    pipe = PipelineLayer(layers=descs, num_stages=4, loss_fn=loss_fn)
+    model = dist.fleet.distributed_model(pipe)
+    x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    ref_loss, ref_g = _engine_aux_ref(pipe, loss_fn, x, y)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert any("NO pipeline" in str(m.message) for m in w)
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=1e-4)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=1e-3,
+                                   err_msg=n)
+
+
+def test_engine_pp_moe_in_pre_peel():
+    """An MoE layer peeled into the PRE segment (stage 0 = [MoELayer,
+    Linear(8->16)], carry 16-wide): its aux is computed per MICROBATCH
+    under the vmap (the vmap maps over microbatches, not examples) and
+    must match the per-microbatch eager reference."""
+    import warnings
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    np.random.seed(8)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": "1F1B"}
+    dist.fleet.init(strategy=strategy)
+    descs = [
+        LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
+                  capacity_factor=2.0),
+        LayerDesc(paddle.nn.Linear, 8, 16),                  # stage 0
+        LayerDesc(paddle.nn.Linear, 16, 16),                 # stage 1
+        LayerDesc(paddle.nn.Linear, 16, 16),                 # stage 2
+        LayerDesc(paddle.nn.Linear, 16, 16),                 # stage 3
+    ]
+    pipe = PipelineLayer(layers=descs, num_stages=4, loss_fn=loss_fn)
+    model = dist.fleet.distributed_model(pipe)
+    x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(8, 16).astype("float32"))
+    ref_loss, ref_g = _engine_aux_ref(pipe, loss_fn, x, y)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loss = model.forward_backward_pipeline([x, y])
+        assert not any("NO pipeline" in str(m.message) for m in w)
+    np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=2e-3)
+    for n, p in pipe.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), ref_g[n], atol=2e-3,
+                                   err_msg=n)
