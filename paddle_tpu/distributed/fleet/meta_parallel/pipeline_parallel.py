@@ -52,6 +52,21 @@ def _aux_layers(layer):
             if hasattr(l, "_last_aux_loss")]
 
 
+def _append_aux_slot(y, slot, aux):
+    """Add ``aux`` (f32 scalar) into the carry's last-axis aux slot,
+    spread uniformly so the slot's SUM recovers the accumulated total
+    (bf16 transport keeps relative precision on a regularizer)."""
+    import jax.numpy as jnp
+    row = slot + (aux / slot.size).astype(y.dtype)
+    return jnp.concatenate([y, row], axis=-1)
+
+
+def _split_aux_slot(y):
+    """(activations, accumulated f32 aux) from an aux-augmented carry."""
+    import jax.numpy as jnp
+    return y[..., :-1], jnp.sum(y[..., -1:].astype(jnp.float32))
+
+
 class PipelineParallel(MetaParallelBase):
     """reference: meta_parallel/pipeline_parallel.py:255."""
 
@@ -230,15 +245,12 @@ class PipelineParallel(MetaParallelBase):
             y = to_raw(t)
             if not moe_aux:
                 return y
-            row = xin[..., -1:] + (aux / xin[..., -1:].size).astype(
-                xin.dtype)
-            return jnp.concatenate([y, row], axis=-1)
+            return _append_aux_slot(y, xin[..., -1:], aux)
 
         def head_loss(_head, y, label):
             aux = jnp.float32(0.0)
             if moe_aux:
-                aux = jnp.sum(y[..., -1:].astype(jnp.float32))
-                y = y[..., :-1]
+                y, aux = _split_aux_slot(y)
             out = loss_fn(Tensor(y, _internal=True),
                           Tensor(label, _internal=True))
             return to_raw(out) + aux
@@ -398,10 +410,7 @@ class PipelineParallel(MetaParallelBase):
                 return to_raw(t)
             x = xin[..., :-1]
             t, aux = _apply_raw(layers, plist, Tensor(x, _internal=True))
-            y = to_raw(t)
-            row = xin[..., -1:] + (aux / xin[..., -1:].size).astype(
-                xin.dtype)
-            return jnp.concatenate([y, row], axis=-1)
+            return _append_aux_slot(to_raw(t), xin[..., -1:], aux)
 
         x = to_raw(inputs)
         lb = to_raw(labels)
@@ -423,8 +432,7 @@ class PipelineParallel(MetaParallelBase):
         def head_loss(hp, y, lab):
             aux = jnp.float32(0.0)
             if moe_aux:
-                aux = jnp.sum(y[..., -1:].astype(jnp.float32))
-                y = y[..., :-1]
+                y, aux = _split_aux_slot(y)
             t, head_aux = _apply_raw(head, hp, Tensor(y, _internal=True))
             return to_raw(loss_fn(t, Tensor(lab, _internal=True))) + \
                 aux + head_aux
@@ -435,9 +443,8 @@ class PipelineParallel(MetaParallelBase):
                 y = to_raw(t)
                 if not moe_aux:
                     return y
-                row = jnp.zeros(y.shape[:-1] + (1,), y.dtype) + \
-                    (aux / int(np.prod(y.shape[:-1]))).astype(y.dtype)
-                return jnp.concatenate([y, row], axis=-1)
+                return _append_aux_slot(
+                    y, jnp.zeros(y.shape[:-1] + (1,), y.dtype), aux)
             return jax.vmap(one)(mb)
 
         # Gradients must ACCUMULATE in f32 even for bf16 params: cotangents

@@ -254,12 +254,16 @@ def pipeline_interleave(stage_fn: Callable, stacked_params, microbatches,
 def _interleave_1f1b_core(apply_chunk, stacked_vec, head_params,
                           microbatches, labels, mesh: Mesh,
                           num_chunks: int, pp_axis: str, loss_fn,
-                          vec_spec):
+                          vec_spec, defer_dw: bool = False):
     """Shared combined fwd+bwd scan for the interleaved (VPP) 1F1B
     schedule — the closed forms documented on pipeline_interleave_1f1b.
     ``apply_chunk(params_me, c, x, d)`` applies this device's virtual
     stage of chunk ``c``; ``vec_spec`` is the shard_map pytree-prefix
-    spec for the stacked carrier (and its gradient)."""
+    spec for the stacked carrier (and its gradient). ``defer_dw`` is the
+    ZB-V composition: the per-tick backward emits only dX, and dW
+    accumulates in a scan-accumulated tail over the stashed (input,
+    cotangent, chunk) triples — the zero-bubble contract at the VPP
+    bubble, with O(1) dW memory like pipeline_1f1b's defer_dw."""
     num_stages = mesh.shape[pp_axis]
     C = num_chunks
     V = num_stages * C
@@ -345,11 +349,12 @@ def _interleave_1f1b_core(apply_chunk, stacked_vec, head_params,
             # (zeros off-chunk), so plain accumulation lands the chunk's
             # grads without any indexed add
             dv_c, dx_c = stage_vjp(dy_in)
-            dw = jax.tree.map(
-                lambda acc, g: acc + jnp.where(b_on,
-                                               g.astype(jnp.float32),
-                                               0.0),
-                dw, dv_c)
+            if not defer_dw:
+                dw = jax.tree.map(
+                    lambda acc, g: acc + jnp.where(b_on,
+                                                   g.astype(jnp.float32),
+                                                   0.0),
+                    dw, dv_c)
             m_b = jnp.clip(g_b * P_ + rem_b % P_, 0, M - 1)
             dx_out = jnp.where(
                 b_on & (d == 0) & (c_b == 0),
@@ -359,12 +364,29 @@ def _interleave_1f1b_core(apply_chunk, stacked_vec, head_params,
 
             f_nx = lax.ppermute(y, pp_axis, perm_f)
             b_nx = lax.ppermute(dx_c.astype(b_rc.dtype), pp_axis, perm_b)
-            return (f_nx, b_nx, ring, dw, dhead, dx_out, loss_acc), None
+            stash = (x_sv, dy_in, b_on, c_b) if defer_dw else None
+            return (f_nx, b_nx, ring, dw, dhead, dx_out, loss_acc), stash
 
         init = (zero_x, jnp.zeros_like(zero_x), ring0, dw0, dhead0,
                 dx0, jnp.float32(0.0))
-        (_, _, _, dw, dhead, dx_out, loss_acc), _ = lax.scan(
+        (_, _, _, dw, dhead, dx_out, loss_acc), stash = lax.scan(
             tick, init, jnp.arange(T))
+
+        if defer_dw:
+            # scan-accumulated dW tail (NOT vmap — see pipeline_1f1b's
+            # defer_dw note: a vmapped tail materializes T dW trees)
+            xs, dys, mask, cs = stash
+
+            def acc_one(acc, xdmc):
+                x_sv, dy, on, c = xdmc
+                _, vjp = jax.vjp(
+                    lambda vme, xx: apply_chunk(vme, c, xx, d), vec_me,
+                    x_sv)
+                dv = vjp(dy)[0]
+                return jax.tree.map(
+                    lambda a, g: a + jnp.where(on, g.astype(jnp.float32),
+                                               0.0), acc, dv), None
+            dw, _ = lax.scan(acc_one, dw, (xs, dys, mask, cs))
 
         lastf = (d == last).astype(jnp.float32)
         loss_mean = lax.psum(loss_acc * lastf, pp_axis) * inv_m
@@ -385,7 +407,8 @@ def _interleave_1f1b_core(apply_chunk, stacked_vec, head_params,
 def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
                              stacked_params, head_params, microbatches,
                              labels, mesh: Mesh, num_chunks: int,
-                             pp_axis: str = "pp"):
+                             pp_axis: str = "pp",
+                             defer_dw: bool = False):
     """Interleaved (VPP) schedule with a HAND-WRITTEN depth-bounded
     backward — the memory contract of ``pipeline_1f1b`` at the bubble of
     ``pipeline_interleave``.
@@ -432,7 +455,8 @@ def pipeline_interleave_1f1b(stage_fn: Callable, loss_fn: Callable,
     return _interleave_1f1b_core(
         apply_chunk, stacked_params, head_params, microbatches, labels,
         mesh, num_chunks, pp_axis, loss_fn,
-        jax.tree.map(lambda _: P(pp_axis), stacked_params))
+        jax.tree.map(lambda _: P(pp_axis), stacked_params),
+        defer_dw=defer_dw)
 
 
 
@@ -954,7 +978,8 @@ def pipeline_hetero_interleave_1f1b(stage_fns: Sequence[Callable],
                                     loss_fn: Callable, stacked_vec, specs,
                                     head_params, microbatches, labels,
                                     mesh: Mesh, num_chunks: int,
-                                    pp_axis: str = "pp"):
+                                    pp_axis: str = "pp",
+                                    defer_dw: bool = False):
     """Heterogeneous VPP with the hand-written depth-bounded backward —
     ``pipeline_interleave_1f1b``'s schedule (same shared
     ``_interleave_1f1b_core``) over the per-dtype flattened carrier +
@@ -983,4 +1008,5 @@ def pipeline_hetero_interleave_1f1b(stage_fns: Sequence[Callable],
 
     return _interleave_1f1b_core(
         apply_chunk, stacked_vec, head_params, microbatches, labels,
-        mesh, num_chunks, pp_axis, loss_fn, P(pp_axis, None, None))
+        mesh, num_chunks, pp_axis, loss_fn, P(pp_axis, None, None),
+        defer_dw=defer_dw)

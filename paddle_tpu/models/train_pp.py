@@ -80,13 +80,15 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
     ``interleave_layer_perm`` storage order), "interleave_1f1b" VPP with
     the hand-written depth-bounded backward (same storage order; the
     schedule for VPP at scale — AD-VPP's residency grows with M),
-    "1f1b" depth-bounded residency, "zero_bubble" 1F1B with deferred dW.
+    "1f1b" depth-bounded residency, "zero_bubble" 1F1B with deferred dW,
+    "vpp_zb" ZB-V (interleaved 1F1B with deferred dW: the VPP bubble AND
+    dW off the serialized tick path).
     Batch dim must divide num_microbatches.
     """
-    assert schedule in ("gpipe", "interleave", "interleave_1f1b", "1f1b",
-                        "zero_bubble")
+    assert schedule in ("gpipe", "interleave", "interleave_1f1b",
+                        "vpp_zb", "1f1b", "zero_bubble")
     num_stages = mesh.shape[pp_axis]
-    chunked = schedule in ("interleave", "interleave_1f1b")
+    chunked = schedule in ("interleave", "interleave_1f1b", "vpp_zb")
     nseg = num_stages * (num_chunks if chunked else 1)
     assert cfg.num_layers % nseg == 0
     lp_per_stage = cfg.num_layers // nseg
@@ -202,17 +204,18 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
         mbs, vjp_embed = jax.vjp(embed_fn, params["embed"])
         labels = tokens.reshape(M, mb, S)
         hp = {"final_norm": params["final_norm"], "head": head_of(params)}
-        if schedule == "interleave_1f1b":
+        if schedule in ("interleave_1f1b", "vpp_zb"):
             # [P, C, layers/chunk, ...] round-robin storage order
             # (state must be in interleave_layer_perm order, as for
-            # "interleave")
+            # "interleave"); "vpp_zb" = ZB-V, deferred dW at the VPP
+            # bubble
             stacked = jax.tree.map(
                 lambda a: a.reshape(num_stages, num_chunks, lp_per_stage,
                                     *a.shape[1:]),
                 params["layers"])
             lv, d_stacked, d_head, d_mbs = pipeline_interleave_1f1b(
                 stage_fn, head_loss, stacked, hp, mbs, labels, mesh,
-                num_chunks, pp_axis)
+                num_chunks, pp_axis, defer_dw=(schedule == "vpp_zb"))
         else:
             stacked = jax.tree.map(
                 lambda a: a.reshape(num_stages, lp_per_stage,
@@ -224,7 +227,7 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
         d_embed = vjp_embed(d_mbs.astype(mbs.dtype))[0].astype(jnp.float32)
         # flatten the stage dims back to [L, ...] in STORAGE order (the
         # same contiguous reinterpretation the forward reshape used)
-        lead = 3 if schedule == "interleave_1f1b" else 2
+        lead = 3 if schedule in ("interleave_1f1b", "vpp_zb") else 2
         grads = {
             "embed": d_embed + (d_head["head"].T if cfg.tie_embeddings
                                 else 0.0),
@@ -238,7 +241,8 @@ def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
         return lv, grads
 
     def step_fn(state: TrainState, tokens):
-        if schedule in ("1f1b", "zero_bubble", "interleave_1f1b"):
+        if schedule in ("1f1b", "zero_bubble", "interleave_1f1b",
+                        "vpp_zb"):
             lv, grads = loss_and_grads_1f1b(state.params, tokens)
         else:
             lv, grads = jax.value_and_grad(loss)(state.params, tokens)

@@ -125,6 +125,23 @@ def test_pp_moe_trains():
 
 # ---------------- fleet engine (PipelineLayer) tier ----------------
 
+def _engine_setup(schedule):
+    """Shared fleet init for the engine-tier tests; returns
+    (LayerDesc, PipelineLayer, loss_fn)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+    loss_fn = lambda o, l: ((o - l) ** 2).mean()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule_mode": schedule}
+    dist.fleet.init(strategy=strategy)
+    return LayerDesc, PipelineLayer, loss_fn
+
+
 def _engine_aux_ref(pipe, loss_fn, x, y, m=4):
     """Eager PER-MICROBATCH reference (the pipeline's accounting, same
     as the reference engine's): for each microbatch, loss_fn + that
@@ -157,22 +174,11 @@ def test_engine_pp_moe_matches_eager(schedule):
     """Fleet PipelineLayer with MoE layers in every stage: the SPMD
     pipeline loss and grads equal eager loss+aux (the engine carries the
     aux in the carry's extra last-axis slot)."""
-    import warnings
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
-                                                            PipelineLayer)
     from paddle_tpu.incubate.distributed.models.moe import MoELayer
-
+    LayerDesc, PipelineLayer, loss_fn = _engine_setup(schedule)
     np.random.seed(5)
-    loss_fn = lambda o, l: ((o - l) ** 2).mean()
-    strategy = dist.fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
-                               "pp_degree": 4}
-    strategy.pipeline_configs = {"accumulate_steps": 4,
-                                 "micro_batch_size": 2,
-                                 "schedule_mode": schedule}
-    dist.fleet.init(strategy=strategy)
     chunks = 2 if schedule == "VPP" else 1
     descs = [LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
                        capacity_factor=2.0)
@@ -185,9 +191,9 @@ def test_engine_pp_moe_matches_eager(schedule):
     y = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
     ref_loss, ref_g = _engine_aux_ref(pipe, loss_fn, x, y)
 
-    import warnings as _w
-    with _w.catch_warnings(record=True) as w:
-        _w.simplefilter("always")
+    import warnings
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
         loss = model.forward_backward_pipeline([x, y])
         assert not any("NO pipeline" in str(m.message) for m in w), \
             "pp x MoE fell back to accumulation"
@@ -203,19 +209,9 @@ def test_engine_pp_moe_hetero_matches_eager():
     import warnings
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
-                                                            PipelineLayer)
     from paddle_tpu.incubate.distributed.models.moe import MoELayer
-
+    LayerDesc, PipelineLayer, loss_fn = _engine_setup("1F1B")
     np.random.seed(6)
-    loss_fn = lambda o, l: ((o - l) ** 2).mean()
-    strategy = dist.fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
-                               "pp_degree": 4}
-    strategy.pipeline_configs = {"accumulate_steps": 4,
-                                 "micro_batch_size": 2,
-                                 "schedule_mode": "1F1B"}
-    dist.fleet.init(strategy=strategy)
     descs = [
         LayerDesc(paddle.nn.Embedding, 16, 8),               # stage 0
         LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
@@ -247,19 +243,9 @@ def test_engine_pp_moe_fallback_keeps_aux():
     import warnings
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
-                                                            PipelineLayer)
     from paddle_tpu.incubate.distributed.models.moe import MoELayer
-
+    LayerDesc, PipelineLayer, loss_fn = _engine_setup("1F1B")
     np.random.seed(7)
-    loss_fn = lambda o, l: ((o - l) ** 2).mean()
-    strategy = dist.fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
-                               "pp_degree": 4}
-    strategy.pipeline_configs = {"accumulate_steps": 4,
-                                 "micro_batch_size": 2,
-                                 "schedule_mode": "1F1B"}
-    dist.fleet.init(strategy=strategy)
     descs = [
         LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
                   capacity_factor=2.0),
@@ -290,19 +276,9 @@ def test_engine_pp_moe_in_pre_peel():
     import warnings
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
-                                                            PipelineLayer)
     from paddle_tpu.incubate.distributed.models.moe import MoELayer
-
+    LayerDesc, PipelineLayer, loss_fn = _engine_setup("1F1B")
     np.random.seed(8)
-    loss_fn = lambda o, l: ((o - l) ** 2).mean()
-    strategy = dist.fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
-                               "pp_degree": 4}
-    strategy.pipeline_configs = {"accumulate_steps": 4,
-                                 "micro_batch_size": 2,
-                                 "schedule_mode": "1F1B"}
-    dist.fleet.init(strategy=strategy)
     descs = [
         LayerDesc(MoELayer, 8, 16, 4, gate="gshard", top_k=2,
                   capacity_factor=2.0),

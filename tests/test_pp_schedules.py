@@ -186,6 +186,15 @@ def test_interleave_1f1b_matches_sequential(data):
         lambda sp, hd, mb, lb: pp_spmd.pipeline_interleave_1f1b(
             _stage_fn, _loss_fn, sp, hd, mb, lb, mesh, chunks))(
         stacked, head, mbs, labels)
+    # ZB-V (deferred dW) must produce identical results
+    loss_z, dw_z, dhead_z, dmbs_z = jax.jit(
+        lambda sp, hd, mb, lb: pp_spmd.pipeline_interleave_1f1b(
+            _stage_fn, _loss_fn, sp, hd, mb, lb, mesh, chunks,
+            defer_dw=True))(stacked, head, mbs, labels)
+    np.testing.assert_allclose(float(loss_z), float(loss), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(dw_z), jax.tree.leaves(dw)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
 
     def ref_loss(sp, hd, mb):
         # canonical virtual stage s lives at [s % P, s // P]

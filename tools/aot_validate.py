@@ -148,7 +148,7 @@ def validate_13b(n: int, batch_mult: int = 1, schedule: str = "zero_bubble",
                                        num_chunks=num_chunks)
     st_sh = train_pp.state_shardings_pp(mesh, cfg)
     tag = schedule + (f"_c{num_chunks}"
-                      if schedule.startswith("interleave") else "")
+                      if schedule.startswith(("interleave", "vpp")) else "")
     return _analyze(
         f"llama2_13b_3d_{tag}", step,
         _state_sds(cfg, mesh, st_sh),
@@ -260,12 +260,12 @@ def main():
                     help="scale the recipe batch to probe HBM headroom")
     ap.add_argument("--schedule", default="zero_bubble",
                     choices=["gpipe", "1f1b", "zero_bubble", "interleave",
-                             "interleave_1f1b"],
+                             "interleave_1f1b", "vpp_zb"],
                     help="13b pipeline schedule (VERDICT r4 #6 residency)")
     ap.add_argument("--num-chunks", type=int, default=1,
-                    help="VPP chunks for the interleave / interleave_1f1b "
-                         "schedules (the PERF_NOTES sweep used 2; 1 "
-                         "degenerates to a plain wavefront)")
+                    help="VPP chunks for the interleave / interleave_1f1b / "
+                         "vpp_zb schedules (the PERF_NOTES sweep used 2; "
+                         "1 degenerates to a non-interleaved program)")
     ap.add_argument("--_child", action="store_true")
     args = ap.parse_args()
     if args._child:
