@@ -195,6 +195,11 @@ def test_interleave_1f1b_matches_sequential(data):
     for a, b in zip(jax.tree.leaves(dw_z), jax.tree.leaves(dw)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5)
+    for a, b in zip(jax.tree.leaves(dhead_z), jax.tree.leaves(dhead)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dmbs_z), np.asarray(dmbs),
+                               atol=1e-5)
 
     def ref_loss(sp, hd, mb):
         # canonical virtual stage s lives at [s % P, s // P]

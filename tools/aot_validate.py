@@ -190,6 +190,43 @@ def validate_moe(n: int, batch_mult: int = 1):
          "experts": 16, "top_k": 2, "remat_policy": cfg.remat_policy})
 
 
+def validate_13b_long(n: int, batch_mult: int = 1, seq: int = 32768):
+    """Round-5 long-context evidence: Llama-2 13B at 32k sequence under
+    CONTEXT PARALLELISM (GQA-aware ring attention over a cp axis +
+    Megatron-SP + ZeRO over fsdp) — the long-context capability the
+    framework carries beyond the reference (SURVEY §2.3: the reference
+    has no CP). Max sequence is extended past the config default; rope
+    tables are computed from the run's seq."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.models import llama, train
+
+    cp = min(4, max(1, n))
+    tp = 2 if n // cp >= 2 and (n // cp) % 2 == 0 else 1
+    fsdp = max(1, n // (cp * tp))
+    mesh = Mesh(
+        np.asarray(jax.devices()[:fsdp * cp * tp]).reshape(
+            1, fsdp, cp, tp),
+        ("dp", "fsdp", "cp", "tp"))
+    import dataclasses
+    cfg = llama.LlamaConfig.llama2_13b(dtype=jnp.bfloat16, remat=True)
+    cfg = dataclasses.replace(cfg, max_seq_len=seq)
+    batch = fsdp * batch_mult   # tokens shard over (dp, fsdp)
+    step = train.make_train_step(cfg, mesh, data_axes=("dp", "fsdp"),
+                                 cp_axis="cp")
+    st_sh = train.state_shardings(mesh, cfg)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tokens_sds = jax.ShapeDtypeStruct(
+        (batch, seq), jnp.int32,
+        sharding=NamedSharding(mesh, P(("dp", "fsdp"), "cp")))
+    return _analyze(
+        f"llama2_13b_cp4_seq{seq}", step,
+        _state_sds(cfg, mesh, st_sh), tokens_sds, mesh,
+        {"params": cfg.num_params(), "batch": batch, "seq": seq,
+         "remat_policy": cfg.remat_policy})
+
+
 def validate_moe_pp(n: int, batch_mult: int = 1):
     """Round-5 composition: the BASELINE #5 MoE under the PIPELINE engine
     (pp × ep × tp, hand-written VPP schedule) — the reference's pp+MoE
@@ -241,6 +278,8 @@ def _impl(args) -> int:
         rows.append(validate_moe(args.devices, args.batch_mult))
     if args.config in ("moe-pp", "all"):
         rows.append(validate_moe_pp(args.devices, args.batch_mult))
+    if args.config in ("13b-long", "all"):
+        rows.append(validate_13b_long(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         print(json.dumps(r))
@@ -253,7 +292,7 @@ def main():
     ap.add_argument("--devices", type=int, default=16,
                     help="virtual chips (v5p-32 slice = 16 chips)")
     ap.add_argument("--config",
-                    choices=["7b", "13b", "moe", "moe-pp",
+                    choices=["7b", "13b", "13b-long", "moe", "moe-pp",
                              "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
