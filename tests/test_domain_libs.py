@@ -278,7 +278,8 @@ def test_examples_smoke(tmp_path):
     env["PADDLE_RPC_REGISTRY"] = str(tmp_path)
     env["PADDLE_JOB_ID"] = "ex_smoke"
     for script in ("serving_quantized.py", "train_hybrid_3d.py",
-                   "recsys_ps.py", "c_serving.py"):
+                   "train_pp_vpp_moe.py", "recsys_ps.py",
+                   "c_serving.py"):
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "examples", script)],
             env=env, text=True, stdout=subprocess.PIPE,

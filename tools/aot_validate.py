@@ -88,13 +88,16 @@ def _state_sds(cfg, mesh, shardings, model=None):
         struct, shardings)
 
 
-def _tokens_sds(mesh, batch, seq, axes):
+def _tokens_sds(mesh, batch, seq, axes, seq_axes=None):
+    """Sharded tokens ShapeDtypeStruct; ``seq_axes`` optionally shards
+    the sequence dim (context parallelism)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(axes, seq_axes) if seq_axes else P(axes)
     return jax.ShapeDtypeStruct(
         (batch, seq), jnp.int32,
-        sharding=NamedSharding(mesh, P(axes)))
+        sharding=NamedSharding(mesh, spec))
 
 
 def validate_7b(n: int, batch_mult: int = 1):
@@ -216,13 +219,11 @@ def validate_13b_long(n: int, batch_mult: int = 1, seq: int = 32768):
     step = train.make_train_step(cfg, mesh, data_axes=("dp", "fsdp"),
                                  cp_axis="cp")
     st_sh = train.state_shardings(mesh, cfg)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    tokens_sds = jax.ShapeDtypeStruct(
-        (batch, seq), jnp.int32,
-        sharding=NamedSharding(mesh, P(("dp", "fsdp"), "cp")))
     return _analyze(
         f"llama2_13b_cp4_seq{seq}", step,
-        _state_sds(cfg, mesh, st_sh), tokens_sds, mesh,
+        _state_sds(cfg, mesh, st_sh),
+        _tokens_sds(mesh, batch, seq, ("dp", "fsdp"), seq_axes="cp"),
+        mesh,
         {"params": cfg.num_params(), "batch": batch, "seq": seq,
          "remat_policy": cfg.remat_policy})
 
@@ -268,21 +269,27 @@ def validate_moe_pp(n: int, batch_mult: int = 1):
 
 def _impl(args) -> int:
     rows = []
+
+    def emit(row):
+        """Print each row the moment it exists: a CHECK-crash in a later
+        (bigger) config must not discard the results already produced."""
+        print(json.dumps(row))
+        sys.stdout.flush()
+        rows.append(row)
     if args.config in ("7b", "all"):
-        rows.append(validate_7b(args.devices, args.batch_mult))
+        emit(validate_7b(args.devices, args.batch_mult))
     if args.config in ("13b", "all"):
-        rows.append(validate_13b(args.devices, args.batch_mult,
+        emit(validate_13b(args.devices, args.batch_mult,
                                  schedule=args.schedule,
                                  num_chunks=args.num_chunks))
     if args.config in ("moe", "all"):
-        rows.append(validate_moe(args.devices, args.batch_mult))
+        emit(validate_moe(args.devices, args.batch_mult))
     if args.config in ("moe-pp", "all"):
-        rows.append(validate_moe_pp(args.devices, args.batch_mult))
+        emit(validate_moe_pp(args.devices, args.batch_mult))
     if args.config in ("13b-long", "all"):
-        rows.append(validate_13b_long(args.devices, args.batch_mult))
+        emit(validate_13b_long(args.devices, args.batch_mult))
     ok = True
     for r in rows:
-        print(json.dumps(r))
         ok = ok and (r.get("fits_v5p") is not False)
     return 0 if ok else 2
 
