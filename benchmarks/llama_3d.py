@@ -44,17 +44,7 @@ def main():
                     out_shardings=train_pp.state_shardings_pp(mesh, cfg))(
         jax.random.key(0))
     # interleaved schedules need layers in round-robin STORAGE order
-    perm = train_pp.interleave_layer_perm(cfg, pp, chunks)
-
-    def permute(tree_):
-        return jax.tree.map(lambda a: a[perm], tree_)
-    state = state._replace(
-        params={**state.params, "layers": permute(state.params["layers"])},
-        master={**state.master, "layers": permute(state.master["layers"])},
-        m={**state.m, "layers": permute(state.m["layers"])},
-        v={**state.v, "layers": permute(state.v["layers"])})
-    # the permuting gather drops the pp shardings; re-place
-    state = jax.device_put(state, train_pp.state_shardings_pp(mesh, cfg))
+    state = train_pp.to_interleave_storage(state, cfg, mesh, chunks)
     tokens = dp_sharded_tokens(mesh, batch, seq, cfg.vocab_size,
                                axes=("dp",))
     run_train_bench(step, state, tokens, "llama_3d_vpp_tokens_per_sec",

@@ -26,16 +26,10 @@ step = train_pp.make_train_step_pp(cfg, mesh, num_microbatches=4,
 state = jax.jit(lambda k: train.init_train_state(k, cfg),
                 out_shardings=train_pp.state_shardings_pp(mesh, cfg))(
     jax.random.key(0))
-# interleaved schedules hold each device's chunks contiguously: permute
-# the layer stack into round-robin storage order (checkpoints should
-# store canonical order and apply/invert this permutation at the edge)
-perm = train_pp.interleave_layer_perm(cfg, 2, chunks)
-reorder = lambda tr: {**tr, "layers": jax.tree.map(lambda a: a[perm],
-                                                   tr["layers"])}
-state = train.TrainState(state.step, reorder(state.params),
-                         reorder(state.master), reorder(state.m),
-                         reorder(state.v))
-state = jax.device_put(state, train_pp.state_shardings_pp(mesh, cfg))
+# interleaved schedules hold each device's chunks contiguously; the
+# helper permutes into round-robin storage order (checkpoints store
+# canonical order — from_interleave_storage inverts at save time)
+state = train_pp.to_interleave_storage(state, cfg, mesh, chunks)
 tokens = jax.device_put(
     jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (8, 64)), jnp.int32),

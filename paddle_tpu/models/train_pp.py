@@ -68,6 +68,39 @@ def interleave_layer_perm(cfg: llama.LlamaConfig, num_stages: int,
     return jnp.asarray(idx)
 
 
+def _permute_layer_stacks(state: TrainState, idx, cfg, mesh,
+                          pp_axis: str) -> TrainState:
+    """Apply a layer-dim index to every layer stack of the state and
+    re-place on the pp shardings (the permuting gather drops them)."""
+    reorder = lambda tr: {
+        **tr, "layers": jax.tree.map(lambda a: a[idx], tr["layers"])}
+    st = TrainState(state.step, reorder(state.params),
+                    reorder(state.master), reorder(state.m),
+                    reorder(state.v))
+    return jax.device_put(st, state_shardings_pp(mesh, cfg, pp_axis))
+
+
+def to_interleave_storage(state: TrainState, cfg: llama.LlamaConfig,
+                          mesh: Mesh, num_chunks: int,
+                          pp_axis: str = "pp") -> TrainState:
+    """Permute a CANONICAL-layer-order train state into the round-robin
+    storage order the interleaved schedules require. Checkpoints should
+    store canonical order: apply this after load / before the first
+    interleaved step."""
+    perm = interleave_layer_perm(cfg, mesh.shape[pp_axis], num_chunks)
+    return _permute_layer_stacks(state, perm, cfg, mesh, pp_axis)
+
+
+def from_interleave_storage(state: TrainState, cfg: llama.LlamaConfig,
+                            mesh: Mesh, num_chunks: int,
+                            pp_axis: str = "pp") -> TrainState:
+    """Inverse of :func:`to_interleave_storage` — storage order back to
+    canonical (what checkpoint IO should persist)."""
+    perm = interleave_layer_perm(cfg, mesh.shape[pp_axis], num_chunks)
+    return _permute_layer_stacks(state, jnp.argsort(perm), cfg, mesh,
+                                 pp_axis)
+
+
 def make_train_step_pp(cfg: llama.LlamaConfig, mesh: Mesh, *,
                        num_microbatches: int, schedule: str = "gpipe",
                        num_chunks: int = 1, pp_axis: str = "pp",

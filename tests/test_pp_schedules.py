@@ -19,6 +19,10 @@ def _mk(seed, shape):
     return jax.random.normal(jax.random.key(seed), shape, jnp.float32) * 0.3
 
 
+def _stage_fn_w(p, x):
+    return jnp.tanh(x @ p["w"])
+
+
 def _stage_fn(p, x):
     return jnp.tanh(x @ p["w"] + p["b"])
 
@@ -253,3 +257,51 @@ def test_interleave_1f1b_residency_bounded_by_depth():
     asmall, abig = temp_bytes(8, "ad"), temp_bytes(64, "ad")
     assert (abig - asmall) > 2 * (big - small), (
         "AD-VPP was expected to grow with M", asmall, abig, small, big)
+
+
+@pytest.mark.parametrize("p_, chunks, m", [(2, 3, 4), (4, 2, 4),
+                                           (2, 2, 8), (2, 4, 2)])
+def test_interleave_1f1b_closed_forms_sweep(p_, chunks, m):
+    """Property sweep of the hand-written VPP schedule's closed forms
+    over pipeline depth x chunk count x microbatch count — the unit
+    indexing, ring sizing (2V-1), and wrap-around permute continuity
+    must hold for ANY (P, C, M % P == 0), not just the C=2 shapes the
+    main tests use."""
+    mesh = Mesh(np.array(jax.devices()[:p_]), ("pp",))
+    v = p_ * chunks
+    rng = np.random.RandomState(p_ * 100 + chunks * 10 + m)
+
+    per_stage = [{"w": jnp.asarray(rng.randn(D, D).astype("float32"))
+                  * 0.3} for _ in range(v)]
+    stacked = pp_spmd.stack_stage_params_interleaved(per_stage, mesh,
+                                                     chunks)
+    head = {"w": jnp.asarray(rng.randn(D, D).astype("float32"))}
+    mbs = jnp.asarray(rng.randn(m, 2, D).astype("float32"))
+    labels = jnp.asarray(rng.randn(m, 2, D).astype("float32"))
+
+    loss, dw, dhead, dmbs = jax.jit(
+        lambda sp, hd, mb, lb: pp_spmd.pipeline_interleave_1f1b(
+            _stage_fn_w, _loss_fn, sp, hd, mb, lb, mesh, chunks))(
+        stacked, head, mbs, labels)
+
+    def ref_loss(sp, hd, mb):
+        stages = [jax.tree.map(lambda a: a[s % p_, s // p_], sp)
+                  for s in range(v)]
+
+        def one(x, l):
+            for pstage in stages:
+                x = _stage_fn_w(pstage, x)
+            return _loss_fn(hd, x, l)
+        return jnp.mean(jax.vmap(one)(mb, labels))
+
+    lr, (gw, gh, gm) = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        stacked, head, mbs)
+    np.testing.assert_allclose(float(loss), float(lr), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(dw), jax.tree.leaves(gw)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5)
+    for a, b in zip(jax.tree.leaves(dhead), jax.tree.leaves(gh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5)
+    np.testing.assert_allclose(np.asarray(dmbs), np.asarray(gm),
+                               atol=3e-5)
