@@ -47,8 +47,12 @@ def estimate_step_time(model: Dict, cfg: Dict, *, chip: str = "v5e",
     comm_dp = 0.0
     if dp > 1:
         comm_dp = 2 * (n / (tp * pp)) * 4 * (dp - 1) / dp / bw
-    # pp bubble: (pp-1)/(M+pp-1) of compute
-    bubble = compute * (pp - 1) / (num_microbatches + pp - 1) if pp > 1 \
-        else 0.0
+    # pp bubble: (pp-1)/(M*vpp+pp-1) of compute — interleaved (VPP)
+    # virtual stages lap the ring vpp times, shrinking the bubble
+    # (reference: auto_tuner/utils.py vpp_degree search dim;
+    # pp_spmd.pipeline_interleave_1f1b)
+    vpp = cfg.get("vpp", 1)
+    bubble = compute * (pp - 1) / \
+        (num_microbatches * max(vpp, 1) + pp - 1) if pp > 1 else 0.0
     # cp ring attention adds kv rotation traffic, minor: fold into tp term
     return compute + bubble + max(comm_tp, comm_dp * 0.3)

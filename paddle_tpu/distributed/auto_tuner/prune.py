@@ -67,6 +67,12 @@ def prune_by_divisibility(tuner, cfg, model):
     layers = m.get("num_layers")
     if layers and layers % cfg.get("pp", 1):
         return True
+    # interleaved VPP: vpp>1 needs a pipeline and pp*vpp virtual stages
+    # must split the layer stack (reference vpp_degree dim)
+    vpp = cfg.get("vpp", 1)
+    if vpp > 1 and (cfg.get("pp", 1) < 2 or
+                    (layers and layers % (cfg.get("pp", 1) * vpp))):
+        return True
     B = m.get("global_batch")
     if B and B % max(cfg.get("dp", 1), 1):
         return True

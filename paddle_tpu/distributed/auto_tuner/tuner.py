@@ -80,11 +80,25 @@ class AutoTuner:
                     if rest % cp:
                         continue
                     dp = rest // cp
+                    # interleaved-VPP chunk degrees (reference:
+                    # auto_tuner/utils.py "vpp_degree"). The plain
+                    # (vpp-absent) config is ALWAYS emitted — vpp>1 is
+                    # impossible at pp=1, and a vpp_degree list without
+                    # 1 must not delete the non-pipelined baselines —
+                    # then each vpp>1 variant joins the grid; validity
+                    # (pipeline present, layers split into pp*vpp) is
+                    # prune.py's divisibility rule, the shared home of
+                    # static config validity
+                    vpps = [v for v in
+                            self.tuner_cfg.get("vpp_degree", [1])
+                            if v > 1]
                     for sh in _divisors(dp):
-                        cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
-                               "sharding": sh}
-                        if not prune_static(self, cfg, model):
-                            cands.append(cfg)
+                        base = {"dp": dp, "tp": tp, "pp": pp,
+                                "cp": cp, "sharding": sh}
+                        for cfg in ([base] +
+                                    [{**base, "vpp": v} for v in vpps]):
+                            if not prune_static(self, cfg, model):
+                                cands.append(cfg)
         return cands
 
     @property

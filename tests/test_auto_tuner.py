@@ -445,3 +445,34 @@ def test_history_property_returns_copy():
     h.clear()
     assert len(tuner.history) == 1     # recorder state untouched
     assert tuner.search_once() != c    # dedup still sees the run
+
+
+def test_vpp_degree_search_dim():
+    """reference: auto_tuner/utils.py vpp_degree — VPP chunk degrees
+    join the candidate grid (pp>1 only, layer count must split into
+    pp*vpp virtual stages), and the cost model prices the smaller VPP
+    bubble below the plain-pp bubble."""
+    model = {"num_params": 1e9, "num_layers": 8, "hidden": 1024,
+             "vocab": 32000, "seq_len": 2048, "micro_batch": 1,
+             "global_batch": 8}
+    tuner = AutoTuner(model, world_size=8,
+                      tuner_cfg={"vpp_degree": [1, 2, 4]})
+    cands = tuner.generate_candidates()
+    vpp_cands = [c for c in cands if c.get("vpp", 1) > 1]
+    assert vpp_cands, "no VPP candidates generated"
+    assert all(c["pp"] > 1 for c in vpp_cands)
+    assert all(model["num_layers"] % (c["pp"] * c["vpp"]) == 0
+               for c in vpp_cands)
+    # vpp=4 with pp=8 would need 32 virtual stages > 8 layers: pruned
+    assert not any(c["pp"] * c.get("vpp", 1) > model["num_layers"]
+                   for c in cands)
+    # a vpp_degree list WITHOUT 1 must keep the non-pipelined baselines
+    t2 = AutoTuner(model, world_size=8,
+                   tuner_cfg={"vpp_degree": [2, 4]})
+    c2 = t2.generate_candidates()
+    assert any(c["pp"] == 1 for c in c2), "pp=1 baselines dropped"
+
+    base = {"dp": 1, "tp": 2, "pp": 4, "cp": 1, "sharding": 1}
+    t_plain = estimate_step_time(model, base)
+    t_vpp = estimate_step_time(model, {**base, "vpp": 2})
+    assert t_vpp < t_plain, (t_vpp, t_plain)
