@@ -122,7 +122,7 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
             decode_w8kv8_tps=None):
     import jax
-    return {
+    rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tps, 2),
         "unit": "tokens/s",
@@ -136,6 +136,40 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_int4_tokens_per_sec": decode_int4_tps,
                   "decode_w8kv8_tokens_per_sec": decode_w8kv8_tps},
     }
+    return _backfill_decode(rec)
+
+
+_DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
+                 "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec")
+
+
+def _backfill_decode(rec: dict) -> dict:
+    """If this run's decode extras are null but a previous standalone
+    decode-bench capture lives in BENCH_LASTGOOD (merged there by
+    tools/tpu_watch.sh stage b / _record_last_good carry-forward), carry
+    the measured tiers into the emitted record — LABELED via
+    ``decode_source`` so a carried number can never masquerade as a
+    same-run measurement. TPU records only; CPU smoke stays pure."""
+    try:
+        if "tpu" not in str(rec.get("extra", {}).get("device", "")).lower():
+            return rec
+        if rec["extra"].get("decode_tokens_per_sec") is not None:
+            return rec
+        with open(_LASTGOOD) as f:
+            lg = json.load(f)
+        lx = lg.get("extra", {})
+        carried = False
+        for k in _DECODE_TIERS:
+            if rec["extra"].get(k) is None and lx.get(k) is not None:
+                rec["extra"][k] = lx[k]
+                carried = True
+        if carried:
+            rec["extra"]["decode_source"] = (
+                "carried from BENCH_LASTGOOD "
+                f"({lx.get('decode_recorded_at') or lg.get('recorded_at')})")
+    except Exception:
+        pass
+    return rec
 
 
 def _is_oom(exc) -> bool:
@@ -386,16 +420,27 @@ def _record_last_good(parsed: dict) -> None:
         # deep-copy the extra dict: the merge below must not leak
         # carried-forward values into the caller's parsed object
         rec["extra"] = dict(parsed.get("extra", {}))
-        # carry forward decode tiers the standalone decode bench merged
-        # into the record (tools/tpu_watch.sh stage b): a headline-only
-        # run reports them null and must not clobber measured numbers
+        # carry forward decode TIER VALUES the standalone decode bench
+        # merged into the record (tools/tpu_watch.sh stage b): a
+        # headline-only run reports them null and must not clobber
+        # measured numbers. Only _DECODE_TIERS values carry — metadata
+        # (decode_source / decode_recorded_at) follows ONLY when a value
+        # actually carried, so a later record with genuinely-measured
+        # tiers never inherits a stale "carried" label
         try:
             with open(_LASTGOOD) as f:
                 old = json.load(f)
-            for k, v in old.get("extra", {}).items():
-                if (k.startswith("decode") and v is not None
-                        and rec.get("extra", {}).get(k) is None):
-                    rec.setdefault("extra", {})[k] = v
+            ox = old.get("extra", {})
+            carried = False
+            for k in _DECODE_TIERS:
+                if ox.get(k) is not None and \
+                        rec.get("extra", {}).get(k) is None:
+                    rec.setdefault("extra", {})[k] = ox[k]
+                    carried = True
+            if carried:
+                for meta in ("decode_recorded_at", "decode_source"):
+                    if meta not in rec.get("extra", {}) and meta in ox:
+                        rec["extra"][meta] = ox[meta]
         except Exception:
             pass
         rec["recorded_unix"] = time.time()
