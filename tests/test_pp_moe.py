@@ -169,6 +169,7 @@ def _engine_aux_ref(pipe, loss_fn, x, y, m=4):
     return float(total.numpy()), g
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["1F1B", "VPP"])
 def test_engine_pp_moe_matches_eager(schedule):
     """Fleet PipelineLayer with MoE layers in every stage: the SPMD
@@ -203,6 +204,7 @@ def test_engine_pp_moe_matches_eager(schedule):
                                    err_msg=f"{schedule}: {n}")
 
 
+@pytest.mark.slow
 def test_engine_pp_moe_hetero_matches_eager():
     """Hetero stages (embed != MoE blocks != head) under the hetero SPMD
     path with the aux slot on the carry."""
@@ -236,6 +238,7 @@ def test_engine_pp_moe_hetero_matches_eager():
                                    err_msg=n)
 
 
+@pytest.mark.slow
 def test_engine_pp_moe_fallback_keeps_aux():
     """The accumulation FALLBACK must include MoE aux too — otherwise the
     engine's loss (and the routers' gradients) would be path-dependent.
@@ -268,6 +271,7 @@ def test_engine_pp_moe_fallback_keeps_aux():
                                    err_msg=n)
 
 
+@pytest.mark.slow
 def test_engine_pp_moe_in_pre_peel():
     """An MoE layer peeled into the PRE segment (stage 0 = [MoELayer,
     Linear(8->16)], carry 16-wide): its aux is computed per MICROBATCH
