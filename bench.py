@@ -120,7 +120,7 @@ def peak_flops(dev) -> float:
 
 def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
-            decode_w8kv8_tps=None):
+            decode_w8kv8_tps=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -136,7 +136,66 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_int4_tokens_per_sec": decode_int4_tps,
                   "decode_w8kv8_tokens_per_sec": decode_w8kv8_tps},
     }
+    if phases is not None:
+        rec["phases"] = phases
     return _backfill_decode(rec)
+
+
+def _capture_phases(step, state, tokens, cfg):
+    """Instrumented mini-pass AFTER the timed measurement: one train
+    step + one small eager generate() under observability + a Profiler,
+    yielding the per-phase summary dict that rides each round's JSON
+    under ``phases`` — so BENCH_r*.json shows where train/prefill/decode
+    time went, not just end-to-end tiers. Never allowed to damage the
+    headline: any failure returns None.
+
+    The process-global registry is CLEARED first so the snapshot holds
+    only this capture (a PADDLE_TPU_METRICS=1 run would otherwise leak
+    trace-time junk from the jitted decode tiers into the round JSON);
+    bench is a dedicated child process, so nothing else owns it. The
+    prior enabled-state is restored on the way out."""
+    import numpy as np
+    import jax.numpy as jnp
+    p = None
+    was_enabled = False
+    try:
+        from paddle_tpu import observability as obs
+        from paddle_tpu import profiler as prof
+        from paddle_tpu.models import generate as gen
+        was_enabled = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        p = prof.Profiler()
+        p.start()
+        with prof.RecordEvent("Train.step", "Operator"):
+            state2, m2 = step(state, tokens)
+            float(m2["loss"])           # host fence
+        prompt = jnp.asarray(np.random.default_rng(7).integers(
+            0, cfg.vocab_size, (2, 8)), jnp.int32)
+        # eager call: the prefill/decode instrumentation inside
+        # generate() times real work (jit would record trace time)
+        np.asarray(gen.generate(state.params, prompt, cfg,
+                                max_new_tokens=4, temperature=0.0))
+        p.step()
+        return p.phase_summary()
+    except Exception as e:
+        print(f"phase capture failed: {type(e).__name__}: {e}"[:300],
+              file=sys.stderr)
+        return None
+    finally:
+        # a mid-capture failure must not leave the collector recording,
+        # and a PADDLE_TPU_METRICS=1 opt-in must survive the capture
+        try:
+            if p is not None:
+                p.stop()
+        except Exception:
+            pass
+        try:
+            from paddle_tpu import observability as obs
+            if not was_enabled:
+                obs.disable()
+        except Exception:
+            pass
 
 
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
@@ -241,10 +300,17 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
     def remaining():
         return budget - (time.perf_counter() - t_measure_start)
 
+    # per-phase breakdown (one already-compiled train step + a tiny
+    # eager generate) — rides the round JSON under "phases"; captured
+    # AFTER the decode tiers normally so it can't starve them, and only
+    # here on the skip path when decode is off the table anyway
     if on_tpu and remaining() < 150:
         print(f"decode bench skipped: only {remaining():.0f}s of "
               f"{budget}s budget left", file=sys.stderr)
-        return _result(tps, mfu, seq, batch, cfg, lossv, None)
+        phases = (_capture_phases(step, state, tokens, cfg)
+                  if remaining() > 75 else None)
+        return _result(tps, mfu, seq, batch, cfg, lossv, None,
+                       phases=phases)
     try:
         from paddle_tpu.models import generate as gen
         db, dp_len, dnew = (8, 128, 64) if on_tpu else (2, 8, 8)
@@ -314,8 +380,13 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"w8kv8 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    phases = None
+    if not on_tpu or remaining() > 75:
+        phases = _capture_phases(step, state, tokens, cfg)
+
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
-                   decode_int8_tps, decode_int4_tps, decode_w8kv8_tps)
+                   decode_int8_tps, decode_int4_tps, decode_w8kv8_tps,
+                   phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

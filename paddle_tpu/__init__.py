@@ -44,6 +44,7 @@ from . import hapi  # noqa: E402
 from . import distributed  # noqa: E402
 from . import incubate  # noqa: E402
 from . import profiler  # noqa: E402
+from . import observability  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402

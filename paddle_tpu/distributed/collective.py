@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._core.tensor import Tensor
+from ..observability import hooks as _obs
 from . import mesh as _mesh
 from .mesh import Group, ReduceOp, get_world_group, in_mapped_context
 
@@ -120,6 +121,8 @@ def _preduce(x, op, axis):
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
     """reference: communication/all_reduce.py (all_reduce)."""
+    if _obs.enabled:
+        _obs.collective("all_reduce", tensor)
     g = _resolve_group(group)
     x = _raw(tensor)
     if in_mapped_context(g):
@@ -181,6 +184,8 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
         t, out_list = tensor_or_list, None
     else:
         t, out_list = tensor, tensor_or_list
+    if _obs.enabled:
+        _obs.collective("all_gather", t)
     g = _resolve_group(group)
     x = _raw(t)
     if in_mapped_context(g):
@@ -222,6 +227,8 @@ def reduce_scatter(output, input=None, op=ReduceOp.SUM,
                    axis: int = 0):
     """reference: communication/reduce_scatter.py — reduce then scatter
     along dim 0. Functional form: ``y = reduce_scatter(x)``."""
+    if _obs.enabled:
+        _obs.collective("reduce_scatter", output if input is None else input)
     if input is None:
         x_in, out_t = _raw(output), None
     else:
@@ -276,6 +283,8 @@ def all_to_all(out_tensor_list, in_tensor_list=None,
     """
     if in_tensor_list is None:
         in_tensor_list, out_tensor_list = out_tensor_list, None
+    if _obs.enabled:
+        _obs.collective("all_to_all", in_tensor_list)
     g = _resolve_group(group)
     x = jnp.stack([_raw(t) for t in in_tensor_list], axis=0)
     if in_mapped_context(g):
@@ -298,6 +307,8 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     """reference: communication/all_to_all.py alltoall_single — equal-split
     all-to-all along ``axis`` (static shapes: TPU requires equal splits).
     """
+    if _obs.enabled:
+        _obs.collective("all_to_all", in_tensor)
     g = _resolve_group(group)
     x = _raw(in_tensor)
     if in_mapped_context(g):
@@ -318,6 +329,8 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
               sync_op: bool = True):
     """reference: communication/broadcast.py — all ranks end with src's
     value. Mapped impl: mask + psum (one ICI reduction)."""
+    if _obs.enabled:
+        _obs.collective("broadcast", tensor)
     g = _resolve_group(group)
     x = _raw(tensor)
     if in_mapped_context(g):
@@ -354,6 +367,8 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
            group: Optional[Group] = None, sync_op: bool = True):
     """reference: communication/reduce.py — dst rank gets the reduction,
     other ranks keep their input (the reference leaves them undefined)."""
+    if _obs.enabled:
+        _obs.collective("reduce", tensor)
     g = _resolve_group(group)
     x = _raw(tensor)
     if in_mapped_context(g):
@@ -382,6 +397,8 @@ def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[Group] = None, sync_op: bool = True):
     """reference: communication/scatter.py — src's list is distributed; rank
     i receives tensor_list[i]."""
+    if _obs.enabled:
+        _obs.collective("scatter", tensor_list)
     g = _resolve_group(group)
     if in_mapped_context(g):
         a = _axis(g)
@@ -405,6 +422,8 @@ def scatter(tensor, tensor_list=None, src: int = 0,
 def gather(tensor, gather_list=None, dst: int = 0,
            group: Optional[Group] = None, sync_op: bool = True):
     """reference: communication/gather.py."""
+    if _obs.enabled:
+        _obs.collective("gather", tensor)
     g = _resolve_group(group)
     x = _raw(tensor)
     if in_mapped_context(g):
@@ -429,6 +448,8 @@ def ppermute(tensor, perm: Sequence, group: Optional[Group] = None):
     """TPU-native p2p primitive: pairwise send over ICI neighbours
     (reference's send/recv pairs, p2p_communication.py:573 — subsumed by
     lax.ppermute; perm is a list of (src, dst))."""
+    if _obs.enabled:
+        _obs.collective("ppermute", tensor)
     g = _resolve_group(group)
     x = _raw(tensor)
     if not in_mapped_context(g):
@@ -494,6 +515,8 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
     recvs = [p for p in p2p_op_list if p.op is irecv]
     if not sends and not recvs:
         return []
+    if _obs.enabled:
+        _obs.collective("send_recv", [s.tensor for s in sends])
     if len(sends) != len(recvs):
         raise ValueError(
             f"batch_isend_irecv needs matched send/recv pairs, got "
@@ -521,6 +544,8 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
 
 
 def barrier(group: Optional[Group] = None):
+    if _obs.enabled:
+        _obs.collective("barrier", ())
     g = _resolve_group(group)
     if in_mapped_context(g):
         return lax.psum(jnp.zeros(()), _axis(g))

@@ -41,6 +41,7 @@ import numpy as np
 
 from ...._core.tensor import Tensor
 from ...._core.autograd import backward as _tape_backward
+from ....observability import hooks as _obs
 from .engines import MetaParallelBase
 from .parallel_layers import PipelineLayer
 
@@ -281,17 +282,27 @@ class PipelineParallel(MetaParallelBase):
             self._spmd_step = jax.jit(run)
 
         step = self._spmd_step
-        if schedule == "interleave":
-            stacked = pp_spmd.stack_stage_params_interleaved(
-                per_stage, mesh, num_chunks)
-        else:
-            stacked = pp_spmd.stack_stage_params(per_stage, mesh)
+        with _obs.span("PP.spmd.stack", "Operator"):
+            if schedule == "interleave":
+                stacked = pp_spmd.stack_stage_params_interleaved(
+                    per_stage, mesh, num_chunks)
+            else:
+                stacked = pp_spmd.stack_stage_params(per_stage, mesh)
         if moe_aux:  # zeroed aux slot on the carry's last axis
             pad = jnp.zeros(mbs.shape[:-1] + (1,), mbs.dtype)
             mbs = jnp.concatenate([mbs, pad], axis=-1)
-        loss, dstacked = step(stacked, mbs, lbs)
+        with _obs.span("PP.spmd.step", "Forward"):
+            loss, dstacked = step(stacked, mbs, lbs)
+        _obs.pp_step(schedule, num_stages, M, num_chunks)
 
         # scatter grads back into parameter .grad slots
+        with _obs.span("PP.spmd.scatter", "Backward"):
+            self._scatter_stacked_grads(stages, dstacked, schedule,
+                                        num_stages)
+        return Tensor(loss, _internal=True)
+
+    def _scatter_stacked_grads(self, stages, dstacked, schedule,
+                               num_stages):
         for s, st in enumerate(stages):
             for li, (_, pd) in enumerate(st):
                 for k, p in pd.items():
@@ -301,7 +312,6 @@ class PipelineParallel(MetaParallelBase):
                         g = dstacked[li][k][s]
                     g = Tensor(g, _internal=True)
                     p.grad = g if p.grad is None else p.grad + g
-        return Tensor(loss, _internal=True)
 
     # ---------------- heterogeneous SPMD path ----------------
     def _hetero_plan(self, stages, inputs):
@@ -499,8 +509,10 @@ class PipelineParallel(MetaParallelBase):
                         v32, f32_view(prp), f32_view(hdp))
             self._spmd_step = jax.jit(run)
 
-        loss, (dv, dpre, dhead) = self._spmd_step(
-            vec, pre_params, head_params, xmb, lbs)
+        with _obs.span("PP.spmd.step", "Forward"):
+            loss, (dv, dpre, dhead) = self._spmd_step(
+                vec, pre_params, head_params, xmb, lbs)
+        _obs.pp_step(schedule, pp, M, num_chunks)
 
         if schedule == "interleave":
             # {dt: [P, chunks, Lmax]} round-robin -> canonical [V, Lmax]
@@ -514,10 +526,11 @@ class PipelineParallel(MetaParallelBase):
                 for k, p in dict(layer.named_parameters()).items():
                     g = Tensor(gd[k], _internal=True)
                     p.grad = g if p.grad is None else p.grad + g
-        for st, gst in zip(ring, dring):
-            scatter(st, gst)
-        scatter(pre, dpre)
-        scatter(head, dhead)
+        with _obs.span("PP.spmd.scatter", "Backward"):
+            for st, gst in zip(ring, dring):
+                scatter(st, gst)
+            scatter(pre, dpre)
+            scatter(head, dhead)
         return Tensor(loss, _internal=True)
 
     def forward_backward_pipeline(self, data, scaler=None):
@@ -567,9 +580,13 @@ class PipelineParallel(MetaParallelBase):
                 "pipeline parallelism.", stacklevel=2)
         micro_in = self._split_micro(inputs)
         micro_lb = self._split_micro(labels)
+        pp_degree = (self._hcg.get_pipe_parallel_world_size()
+                     if self._hcg is not None else 1)
+        _obs.pp_step("accum", pp_degree, self.accumulate_steps)
         total = None
         for x, y in zip(micro_in, micro_lb):
-            out = self._layers(x)
+            with _obs.span("PP.forward", "Forward"):
+                out = self._layers(x)
             loss_fn = self._layers._loss_fn
             if loss_fn is None:
                 raise RuntimeError("PipelineLayer needs loss_fn for "
@@ -586,7 +603,8 @@ class PipelineParallel(MetaParallelBase):
             scaled = loss / self.accumulate_steps
             if scaler is not None:
                 scaled = scaler.scale(scaled)
-            _tape_backward(scaled)
+            with _obs.span("PP.backward", "Backward"):
+                _tape_backward(scaled)
             total = loss if total is None else total + loss
         self.total_loss = total / self.accumulate_steps
         return self.total_loss

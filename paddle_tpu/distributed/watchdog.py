@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..observability import hooks as _obs
+
 _WATCHDOG_ENV = "PADDLE_STEP_TIMEOUT"
 
 
@@ -82,6 +84,8 @@ class StepWatchdog:
     def tick(self):
         self._grace_pending = False
         self._last = time.monotonic()
+        if _obs.enabled:
+            _obs.watchdog_tick(self.name)
 
     @property
     def fired(self) -> bool:
@@ -89,6 +93,14 @@ class StepWatchdog:
 
     # ---- internals ----
     def _dump_stacks(self):
+        # stall telemetry: fired counter + last-stall gauge, plus a span
+        # into the profiler collector (when recording) so the stall
+        # window shows up in exported chrome traces. Never allowed to
+        # break the dump/kill path the watchdog exists for.
+        try:
+            _obs.watchdog_fired(self.name, time.monotonic() - self._last)
+        except Exception:
+            pass
         msg = (f"[watchdog] no {self.name} tick for {self.timeout:.0f}s "
                f"(pid {os.getpid()}) — dumping all thread stacks\n")
         sys.stderr.write(msg)

@@ -5,11 +5,14 @@ from __future__ import annotations
 
 import enum
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ..observability import hooks as _obs
 
 
 class PlaceType(enum.Enum):
@@ -251,7 +254,24 @@ class Predictor:
         return self._jitted
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
-        """reference: AnalysisPredictor::Run / ZeroCopyRun."""
+        """reference: AnalysisPredictor::Run / ZeroCopyRun.
+
+        Telemetry (paddle_tpu.observability): per-request latency
+        histogram + request/sample counters, plus a ``Predictor.run``
+        span when the profiler is recording — zero-cost when neither
+        sink is active."""
+        if not _obs.active():
+            return self._run_impl(inputs)
+        t0 = time.perf_counter_ns()
+        out = self._run_impl(inputs)
+        first = next(iter(self._inputs.values()), None)
+        batch = (first._value.shape[0]
+                 if first is not None and first._value is not None
+                 and getattr(first._value, "ndim", 0) else 0)
+        _obs.predictor_run(t0, int(batch))
+        return out
+
+    def _run_impl(self, inputs: Optional[List[np.ndarray]] = None):
         from .._core.tensor import Tensor as FrameworkTensor
         if inputs is not None:
             for n, arr in zip(self._input_names, inputs):

@@ -12,6 +12,7 @@ import itertools
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -19,6 +20,7 @@ import numpy as np
 from .dataset import (BatchSampler, Dataset, IterableDataset,
                       SequenceSampler, RandomSampler)
 from .._core.tensor import Tensor
+from ..observability import hooks as _obs
 
 
 def default_collate_fn(batch):
@@ -64,6 +66,16 @@ class _SingleProcessLoaderIter:
         return self
 
     def __next__(self):
+        # reader-wait telemetry (observability.hooks): time blocked in
+        # the loader vs the consumer's compute gap — zero-cost when off
+        if not _obs.active():
+            return self._next_impl()
+        t0 = time.perf_counter_ns()
+        batch = self._next_impl()
+        _obs.dataloader_next(self, t0)
+        return batch
+
+    def _next_impl(self):
         if self.loader._is_iterable:
             batch = list(itertools.islice(self._it,
                                           self.loader.batch_size or 1))
@@ -248,6 +260,14 @@ class _PrefetchLoaderIter:
         return self
 
     def __next__(self):
+        if not _obs.active():
+            return self._next_impl()
+        t0 = time.perf_counter_ns()
+        batch = self._next_impl()
+        _obs.dataloader_next(self, t0)
+        return batch
+
+    def _next_impl(self):
         if self._mode == "stream":
             item = self.q.get()
             if item is self._done:

@@ -23,6 +23,7 @@ from jax import lax
 
 from . import llama
 from .llama import LlamaConfig, rope_tables, apply_rope, rms_norm
+from ..observability import hooks as _obs
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
@@ -342,6 +343,11 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
     prompt)`` exactly.
     """
     B, S = prompt.shape
+    # telemetry anchor (observability.hooks): prefill/decode latency
+    # histograms + tokens counters + profiler spans; 0 when disabled.
+    # Timings under jax.jit are TRACE times (fired once per compile) —
+    # eager serving calls get real per-phase wall time.
+    _t_obs = _obs.generate_begin()
     P = 0
     if prompt_cache is not None:
         if pad_token_id is not None or prompt_lengths is not None:
@@ -393,6 +399,7 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
                                     max_len, rpos=rpos, kstart=kstart)
     # prefill uses the jnp path (multi-token); decode steps may use the
     # fused pallas kernel
+    _t_obs = _obs.generate_phase("prefill", _t_obs, logits, B * S)
 
     def sample(logits, k):
         if temperature == 0.0:
@@ -446,6 +453,7 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig, *,
         step, (cache, first, key, done0), jnp.arange(max_new_tokens - 1))
     out = jnp.concatenate(
         [prompt, first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
+    _obs.generate_phase("decode", _t_obs, out, B * max_new_tokens)
     return out
 
 

@@ -1,0 +1,238 @@
+"""Hot-path instrumentation hooks.
+
+Every training/serving hot path (pipeline engine, predictor, generate,
+dataloader, collectives, watchdog) calls into THIS module instead of
+touching the registry or the profiler collector directly, so the
+disabled-path cost is one module-attribute read (``hooks.enabled``) per
+call site — no allocation, no string formatting, no lock (the contract
+ISSUE telemetry demands and ``tools/check_instrumentation.py`` lints).
+
+Two independent switches feed two sinks:
+
+- ``enabled`` (set via :func:`enable`/:func:`disable`, or the
+  ``PADDLE_TPU_METRICS=1`` env at import): metric emission into
+  :data:`paddle_tpu.observability.metrics.REGISTRY`.
+- the profiler collector's RECORD state: span emission. :func:`span`
+  returns a shared ``nullcontext`` singleton when neither is active, so
+  an un-profiled step allocates nothing.
+
+Spans emitted inside a ``jax.jit`` trace measure TRACE time (they fire
+once per compile, not per execution) — device time lives in the
+jax.profiler xplane tier. Host-loop spans (eager pipeline fallback,
+generate called eagerly, dataloader) measure real wall time.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from ..profiler.profiler import RecordEvent, _Event, _collector
+from . import metrics as _m
+
+#: module-global fast-path flag — call sites read this directly
+enabled = os.environ.get("PADDLE_TPU_METRICS", "").lower() in (
+    "1", "true", "yes", "on")
+
+_NULL = contextlib.nullcontext()  # shared: the disabled span() result
+
+
+def enable():
+    """Turn metric emission on (idempotent)."""
+    global enabled
+    enabled = True
+
+
+def disable():
+    global enabled
+    enabled = False
+
+
+def metrics_enabled() -> bool:
+    return enabled
+
+
+def active() -> bool:
+    """True when ANY sink wants events (metrics on, or profiler
+    RECORDing) — the guard for instrumentation that must time work."""
+    return enabled or _collector.enabled
+
+
+def span(name: str, event_type: str = "UserDefined"):
+    """Context manager for a host span; a shared no-op unless the
+    profiler collector is recording (spans feed ONLY the collector —
+    metrics-enabled alone must not pay the RecordEvent allocation)."""
+    if not _collector.enabled:
+        return _NULL
+    return RecordEvent(name, event_type)
+
+
+def _record(name: str, start_ns: int, end_ns: int, event_type: str):
+    """Append a closed span to the profiler collector (if recording)."""
+    if _collector.enabled:
+        _collector.add(_Event(name, start_ns, end_ns,
+                              threading.get_ident(), event_type))
+
+
+def _block(x):
+    """Fence on device values so a span measures compute, not dispatch.
+    No-op for tracers (instrumented code running under jit)."""
+    try:
+        import jax
+        jax.block_until_ready(x)
+    except Exception:
+        pass
+
+
+# ---------------- pipeline engine ----------------
+
+def pp_step(schedule: str, pp: int, micro: int, num_chunks: int = 1):
+    """One pipeline step: bubble-ratio gauge + step/microbatch counters.
+
+    Bubble ratio is the schedule's theoretical fill fraction lost to
+    pipeline bubbles: (pp-1)/(M*chunks + pp - 1) for the wavefront
+    family (GPipe/1F1B; interleave divides by the chunk count), ~0 for
+    zero-bubble, and (pp-1)/pp for the de-pipelined accumulation
+    fallback (no overlap at all).
+    """
+    if not enabled:
+        return
+    if schedule == "accum":
+        bubble = (pp - 1) / pp if pp > 1 else 0.0
+    elif schedule == "zero_bubble":
+        bubble = 0.0
+    else:
+        denom = micro * max(1, num_chunks) + pp - 1
+        bubble = (pp - 1) / denom if denom > 0 else 0.0
+    _m.gauge("pp_bubble_ratio",
+             "theoretical pipeline bubble fraction of the last step",
+             ("schedule",)).labels(schedule).set(bubble)
+    _m.counter("pp_steps_total", "pipeline forward_backward steps",
+               ("schedule",)).labels(schedule).inc()
+    _m.counter("pp_microbatches_total",
+               "microbatches consumed by the pipeline engine").inc(micro)
+
+
+# ---------------- serving ----------------
+
+def generate_begin() -> int:
+    """Phase-timing anchor; 0 when no sink is active (callers skip)."""
+    if not (enabled or _collector.enabled):
+        return 0
+    return time.perf_counter_ns()
+
+
+def generate_phase(phase: str, t0_ns: int, out, tokens: int) -> int:
+    """Close a generate() phase opened at ``t0_ns``: fence ``out``,
+    record the span, feed the phase histogram + token counter. Returns a
+    fresh anchor for the next phase."""
+    if not t0_ns:
+        return 0
+    _block(out)
+    now = time.perf_counter_ns()
+    _record(f"Generate.{phase}", t0_ns, now, "Forward")
+    if enabled:
+        secs = (now - t0_ns) / 1e9
+        _m.histogram(f"generate_{phase}_seconds",
+                     f"wall seconds per generate() {phase} phase"
+                     ).observe(secs)
+        _m.counter("generate_tokens_total",
+                   "tokens processed by generate()",
+                   ("phase",)).labels(phase).inc(tokens)
+        if phase == "decode" and secs > 0:
+            _m.gauge("generate_decode_tokens_per_sec",
+                     "decode throughput of the last generate() call"
+                     ).set(tokens / secs)
+    return time.perf_counter_ns()
+
+
+def predictor_run(t0_ns: int, batch: int):
+    """Close a Predictor.run span: latency histogram + request counter."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Predictor.run", t0_ns, now, "Forward")
+    if enabled:
+        _m.histogram("inference_run_seconds",
+                     "Predictor.run wall seconds").observe(
+            (now - t0_ns) / 1e9)
+        _m.counter("inference_requests_total",
+                   "Predictor.run calls").inc()
+        if batch:
+            _m.counter("inference_samples_total",
+                       "samples served by Predictor.run").inc(batch)
+
+
+# ---------------- data path ----------------
+
+def dataloader_next(it, t0_ns: int):
+    """One ``__next__`` return: ``wait`` is the time blocked inside the
+    loader, ``compute`` the gap since the previous batch was handed out
+    (the consumer's step time) — the reader-wait vs compute split."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("DataLoader.next", t0_ns, now, "DataLoader")
+    if enabled:
+        _m.histogram("dataloader_wait_seconds",
+                     "seconds the consumer blocked waiting for a batch"
+                     ).observe((now - t0_ns) / 1e9)
+        prev = getattr(it, "_obs_last_ret_ns", None)
+        if prev is not None:
+            _m.histogram("dataloader_compute_seconds",
+                         "seconds between batches (consumer compute)"
+                         ).observe(max(0, t0_ns - prev) / 1e9)
+    it._obs_last_ret_ns = now
+
+
+# ---------------- collectives ----------------
+
+def _nbytes(x) -> int:
+    total = 0
+    for t in (x if isinstance(x, (list, tuple)) else (x,)):
+        v = getattr(t, "_value", t)  # unwrap framework Tensor
+        try:
+            import numpy as np
+            total += int(v.size) * int(np.dtype(v.dtype).itemsize)
+        except Exception:
+            pass
+    return total
+
+
+def collective(op: str, x):
+    """Count one collective call + its payload bytes. Inside jit this
+    counts TRACE-time calls (once per compile), which is exactly the
+    number of collectives in the compiled program."""
+    # callers pre-check ``hooks.enabled``; re-check for direct users
+    if not enabled:
+        return
+    _m.counter("collective_calls_total",
+               "collective API calls", ("op",)).labels(op).inc()
+    _m.counter("collective_bytes_total",
+               "payload bytes through collective calls",
+               ("op",)).labels(op).inc(_nbytes(x))
+
+
+# ---------------- watchdog ----------------
+
+def watchdog_tick(name: str):
+    if not enabled:
+        return
+    _m.counter("watchdog_ticks_total", "watchdog ticks",
+               ("watchdog",)).labels(name).inc()
+
+
+def watchdog_fired(name: str, stall_seconds: float):
+    """A stall fired: counters + last-stall gauge, and a span into the
+    profiler collector (when recording) covering the stall window so it
+    shows up in exported chrome traces."""
+    now = time.perf_counter_ns()
+    _record(f"Watchdog.fired[{name}]",
+            now - int(stall_seconds * 1e9), now, "Watchdog")
+    if enabled:
+        _m.counter("watchdog_fired_total", "watchdog stall firings",
+                   ("watchdog",)).labels(name).inc()
+        _m.gauge("watchdog_last_stall_seconds",
+                 "length of the most recent stall",
+                 ("watchdog",)).labels(name).set(stall_seconds)

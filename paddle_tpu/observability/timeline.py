@@ -1,0 +1,119 @@
+"""Step-timeline aggregation: profiler spans -> per-phase summary dict.
+
+Merges the host spans collected by the profiler's ``_Collector`` (the
+HostTracer analog) with the metrics registry snapshot into ONE
+structured dict, so a single ``Profiler`` run yields a chrome trace AND
+a machine-readable per-phase breakdown — the piece BENCH_r*.json rounds
+were missing (totals with no attribution). ``bench.py`` attaches this
+dict under each round's ``phases`` key.
+
+Phase mapping: the reference Model-Summary event types (Forward /
+Backward / Optimization / DataLoader) plus the serving phases carried in
+span names (``Generate.prefill`` / ``Generate.decode`` /
+``Predictor.run``), the pipeline engine's spans (``PP.*``) and watchdog
+firings. (Collectives contribute counters/bytes to the ``metrics``
+snapshot, not spans — they execute inside compiled programs.)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# event_type -> phase bucket (the reference Model Summary split)
+_TYPE_PHASE = {
+    "Forward": "forward",
+    "Backward": "backward",
+    "Optimization": "optimizer",
+    "DataLoader": "dataloader",
+    "Watchdog": "watchdog",
+}
+
+# name prefix -> phase bucket; FIRST match wins, checked before the
+# event-type mapping so serving/pipeline spans land in their own buckets
+_NAME_PHASE = (
+    ("Generate.prefill", "prefill"),
+    ("Generate.decode", "decode"),
+    ("Predictor.run", "inference"),
+    ("PP.forward", "forward"),
+    ("PP.backward", "backward"),
+    ("PP.spmd", "pp_spmd"),
+    ("PP.", "pipeline"),
+    ("Optimizer.step", "optimizer"),
+    ("DataLoader.", "dataloader"),
+    ("Train.step", "train_step"),
+    ("Watchdog.", "watchdog"),
+)
+
+
+def phase_of(name: str, event_type: str) -> str:
+    for prefix, phase in _NAME_PHASE:
+        if name.startswith(prefix):
+            return phase
+    return _TYPE_PHASE.get(event_type, "other")
+
+
+def phase_summary(events, step_times: Optional[List[float]] = None,
+                  include_metrics: bool = True) -> dict:
+    """Aggregate spans into ``{"phases": {...}, "window_ms": ...}``.
+
+    Each phase bucket: calls, total_ms, avg_ms, max_ms and share (of the
+    step window when step times exist, else of the summed span time).
+    ``metrics`` carries the registry JSON snapshot so counters (tokens,
+    collective bytes, watchdog firings) ride along with the timings.
+    """
+    phases: Dict[str, dict] = {}
+    total_span_ns = 0.0
+    for e in events:
+        ph = phase_of(e.name, e.event_type)
+        d = phases.setdefault(ph, {"calls": 0, "total_ms": 0.0,
+                                   "max_ms": 0.0})
+        dur = e.end - e.start
+        d["calls"] += 1
+        d["total_ms"] += dur / 1e6
+        d["max_ms"] = max(d["max_ms"], dur / 1e6)
+        total_span_ns += dur
+    window_ms = (sum(step_times) * 1e3 if step_times
+                 else total_span_ns / 1e6)
+    for d in phases.values():
+        d["avg_ms"] = round(d["total_ms"] / d["calls"], 6)
+        d["share"] = round(d["total_ms"] / window_ms, 6) if window_ms \
+            else 0.0
+        d["total_ms"] = round(d["total_ms"], 6)
+        d["max_ms"] = round(d["max_ms"], 6)
+    out = {
+        "phases": phases,
+        "window_ms": round(window_ms, 6),
+        "steps": len(step_times or ()),
+    }
+    if include_metrics:
+        from . import metrics as _m
+        snap = _m.REGISTRY.to_json()
+        if snap:
+            out["metrics"] = snap
+    return out
+
+
+class StepTimeline:
+    """Incremental aggregator over a live profiler run.
+
+    ``merge(profiler)`` folds the profiler's collected spans (draining
+    the native ring through ``_Collector.drain``) and its step times
+    into this timeline; ``summary()`` emits the combined per-phase
+    dict. Lets a long job merge several RECORD windows into one
+    breakdown."""
+
+    def __init__(self):
+        self._events = []
+        self._step_times: List[float] = []
+
+    def merge(self, prof) -> "StepTimeline":
+        self._events.extend(prof.events())
+        self._step_times.extend(getattr(prof, "_step_times", ()))
+        return self
+
+    def add_events(self, events) -> "StepTimeline":
+        self._events.extend(events)
+        return self
+
+    def summary(self, include_metrics: bool = True) -> dict:
+        return phase_summary(self._events, self._step_times,
+                             include_metrics=include_metrics)

@@ -16,7 +16,7 @@ from .profiler import (  # noqa: F401
 from .profiler_statistic import (  # noqa: F401
     DeviceStatistics, SortedKeys, StatisticData,
 )
-from .utils import benchmark  # noqa: F401
+from .utils import benchmark, wrap_optimizers, in_profiler_mode  # noqa: F401
 from . import timer  # noqa: F401
 
 import enum as _enum

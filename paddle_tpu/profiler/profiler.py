@@ -320,6 +320,16 @@ class Profiler:
             time_unit=time_unit, sorted_by=sorted_by,
             op_detail=op_detail, thread_sep=thread_sep)
 
+    def phase_summary(self) -> dict:
+        """Structured per-phase breakdown of the collected spans —
+        forward/backward/optimizer/dataloader plus the serving phases
+        (prefill/decode/inference) and pipeline buckets — merged with
+        the metrics-registry snapshot (observability.timeline). The
+        machine-readable counterpart of :meth:`summary`; ``bench.py``
+        attaches it under each round's ``phases`` key."""
+        from ..observability.timeline import phase_summary
+        return phase_summary(self.events(), self._step_times)
+
     def export(self, path: str, format: str = "json"):
         self._export_chrome(path)
 

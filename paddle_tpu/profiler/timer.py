@@ -1,5 +1,12 @@
 """Benchmark step timer (reference: python/paddle/profiler/timer.py —
-Benchmark with reader/batch cost and ips)."""
+Benchmark with reader/batch cost and ips).
+
+Two accumulation tiers per stat: LIFETIME (never reset — long-run
+averages) and WINDOW (reset on every ``step_info()`` report, like the
+reference's ``benchmark().step_info`` which clears its interval stats),
+so periodic log lines reflect the RECENT steps instead of averaging a
+slow warmup into hour-long runs. ``reset()`` clears both tiers.
+"""
 from __future__ import annotations
 
 import time
@@ -13,14 +20,30 @@ class _Stat:
     def reset(self):
         self.total = 0.0
         self.count = 0
-        self._last = None
+        self.window_total = 0.0
+        self.window_count = 0
+
+    def reset_window(self):
+        self.window_total = 0.0
+        self.window_count = 0
 
     def record(self, v):
         self.total += v
         self.count += 1
+        self.window_total += v
+        self.window_count += 1
 
     def avg(self):
+        """Lifetime average."""
         return self.total / self.count if self.count else 0.0
+
+    def window_avg(self):
+        """Average over the steps since the last report/reset; 0.0 when
+        no step landed in the window (an idle interval must not
+        re-print the lifetime average as if it were recent)."""
+        if not self.window_count:
+            return 0.0
+        return self.window_total / self.window_count
 
 
 class Benchmark:
@@ -47,9 +70,22 @@ class Benchmark:
     def end(self):
         self._start = None
 
-    def step_info(self, unit: str = "samples") -> str:
-        return (f"batch_cost: {self.batch_cost.avg():.5f} s  "
-                f"ips: {self.ips_stat.avg():.3f} {unit}/s")
+    def reset(self):
+        """Clear lifetime AND window stats (timing anchors survive)."""
+        self.batch_cost.reset()
+        self.ips_stat.reset()
+
+    def step_info(self, unit: str = "samples", reset: bool = True) -> str:
+        """Recent-steps report: averages over the window since the last
+        ``step_info`` call (reset-on-report, reference timer.py
+        semantics). ``reset=False`` peeks without consuming the window;
+        lifetime averages stay available via ``.batch_cost.avg()``."""
+        info = (f"batch_cost: {self.batch_cost.window_avg():.5f} s  "
+                f"ips: {self.ips_stat.window_avg():.3f} {unit}/s")
+        if reset:
+            self.batch_cost.reset_window()
+            self.ips_stat.reset_window()
+        return info
 
 
 _bench = Benchmark()

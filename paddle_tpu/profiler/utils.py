@@ -1,4 +1,6 @@
 """reference: python/paddle/profiler/utils.py."""
+import functools
+
 from .timer import benchmark  # noqa: F401
 from .profiler import RecordEvent  # noqa: F401
 
@@ -8,5 +10,31 @@ def in_profiler_mode() -> bool:
     return _collector.enabled
 
 
-def wrap_optimizers():  # API parity no-op: RecordEvent hooks are explicit
-    pass
+def wrap_optimizers():
+    """Monkeypatch every Optimizer's ``step`` with a
+    ``RecordEvent("Optimizer.step")`` wrapper (reference:
+    profiler/utils.py wrap_optimizers — patches optimizer step so the
+    Optimization phase shows up in the Model Summary without manual
+    spans). Idempotent per class (``_prof_wrapped`` mark), and each
+    call re-walks the subclass graph so optimizers defined after an
+    earlier call get wrapped too; spans are only recorded while the
+    profiler is in a RECORD window, so wrapped optimizers stay cheap
+    outside one.
+    """
+    from ..optimizer.optimizer import Optimizer
+
+    def _wrap_cls(cls):
+        # wrap only classes that DEFINE their own step (subclasses that
+        # inherit it get the wrapped base method for free)
+        orig = cls.__dict__.get("step")
+        if orig is not None and not getattr(orig, "_prof_wrapped", False):
+            @functools.wraps(orig)
+            def step(self, *args, _prof_orig=orig, **kwargs):
+                with RecordEvent("Optimizer.step", "Optimization"):
+                    return _prof_orig(self, *args, **kwargs)
+            step._prof_wrapped = True
+            cls.step = step
+        for sub in cls.__subclasses__():
+            _wrap_cls(sub)
+
+    _wrap_cls(Optimizer)

@@ -1,0 +1,32 @@
+"""Fast tier-1 guard: the hot-path telemetry hooks must stay in place
+(tools/check_instrumentation.py — a dropped hook silently blinds every
+future BENCH_r*.json per-phase breakdown)."""
+import importlib.util
+import os
+
+
+def _load_checker():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "tools", "check_instrumentation.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_instrumentation", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, root
+
+
+def test_hot_paths_keep_their_telemetry_hooks():
+    mod, root = _load_checker()
+    problems = mod.check(root)
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_flags_a_dropped_hook(tmp_path):
+    """The lint itself must fail loudly when a hook disappears."""
+    mod, root = _load_checker()
+    fake = tmp_path / "paddle_tpu" / "distributed"
+    fake.mkdir(parents=True)
+    (fake / "watchdog.py").write_text("def tick(self): pass\n")
+    problems = mod.check(str(tmp_path))
+    assert any("watchdog" in p and "_obs.watchdog_tick(" in p
+               for p in problems)

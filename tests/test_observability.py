@@ -1,0 +1,491 @@
+"""Runtime telemetry layer (ISSUE 1): metrics registry, hot-path span
+instrumentation, per-phase summaries, chrome-trace round-trip, and the
+zero-overhead disabled path."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import hooks, metrics as om
+from paddle_tpu import profiler as prof
+from paddle_tpu.profiler.profiler import _collector
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test gets a clean global registry + span collector and
+    starts disabled (the collector accumulates across Profiler runs by
+    design — tests here assert exact event sets)."""
+    obs.disable()
+    om.REGISTRY.clear()
+    with _collector.lock:
+        _collector.events.clear()
+    yield
+    obs.disable()
+    om.REGISTRY.clear()
+    with _collector.lock:
+        _collector.events.clear()
+
+
+# ---------------- metrics registry ----------------
+
+class TestMetricsRegistry:
+    def test_counter_inc_and_get(self):
+        c = om.counter("requests_total", "reqs")
+        c.inc()
+        c.inc(2.5)
+        assert c.get() == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_labels_positional_and_kw(self):
+        c = om.counter("calls_total", "", ("op", "rank"))
+        c.labels("all_reduce", "0").inc(2)
+        c.labels(op="all_reduce", rank="0").inc()
+        c.labels("all_gather", "1").inc()
+        assert c.labels("all_reduce", "0").get() == 3
+        assert c.labels("all_gather", "1").get() == 1
+        with pytest.raises(ValueError):
+            c.labels("only_one")           # wrong arity
+        with pytest.raises(ValueError):
+            c.labels(op="x", bogus="y")    # unknown label name
+        with pytest.raises(ValueError):
+            c.inc()                        # labeled metric needs labels()
+
+    def test_get_or_create_and_kind_collision(self):
+        a = om.counter("shared_name")
+        b = om.counter("shared_name")
+        assert a is b
+        with pytest.raises(ValueError):
+            om.gauge("shared_name")
+        with pytest.raises(ValueError):
+            om.counter("shared_name", labelnames=("x",))
+
+    def test_histogram_bucket_collision(self):
+        a = om.histogram("hb_seconds", buckets=(0.001, 0.01))
+        assert om.histogram("hb_seconds") is a          # None = don't care
+        assert om.histogram("hb_seconds", buckets=(0.01, 0.001)) is a
+        with pytest.raises(ValueError):
+            om.histogram("hb_seconds", buckets=(1.0, 10.0))
+
+    def test_gauge_set_inc_dec(self):
+        g = om.gauge("inflight")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.get() == 4
+
+    def test_histogram_buckets_cumulative(self):
+        h = om.histogram("lat", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = h.get()
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(5.555)
+        assert snap["buckets"][0.01] == 1
+        assert snap["buckets"][0.1] == 2
+        assert snap["buckets"][1.0] == 3   # +Inf (count) holds the 4th
+
+    def test_prometheus_text_format(self):
+        om.counter("c_total", "a counter", ("op",)).labels("x\"y").inc()
+        om.gauge("g_now", "a gauge").set(1.5)
+        om.histogram("h_seconds", buckets=(0.1,)).observe(0.05)
+        text = om.REGISTRY.to_prometheus()
+        assert "# TYPE c_total counter" in text
+        assert 'c_total{op="x\\"y"} 1.0' in text   # label escaping
+        assert "# TYPE g_now gauge" in text and "g_now 1.5" in text
+        assert 'h_seconds_bucket{le="0.1"} 1' in text
+        assert 'h_seconds_bucket{le="+Inf"} 1' in text
+        assert "h_seconds_sum" in text and "h_seconds_count 1" in text
+
+    def test_json_snapshot_round_trips(self):
+        om.counter("j_total", "", ("k",)).labels("v").inc(7)
+        snap = json.loads(om.REGISTRY.dumps())
+        assert snap["j_total"]["kind"] == "counter"
+        assert snap["j_total"]["values"]["k=v"] == 7.0
+
+    def test_thread_safety_under_contention(self):
+        import threading
+        c = om.counter("contended_total")
+        h = om.histogram("contended_seconds", buckets=(0.5,))
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.1)
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.get() == 8000
+        assert h.get()["count"] == 8000
+
+
+# ---------------- disabled path: zero overhead ----------------
+
+class TestDisabledPath:
+    def test_span_is_shared_nullcontext(self):
+        assert not hooks.enabled and not _collector.enabled
+        s1, s2 = hooks.span("a"), hooks.span("b", "Forward")
+        assert s1 is s2 is hooks._NULL     # no allocation when disabled
+
+    def test_disabled_emitters_create_no_metrics(self):
+        hooks.pp_step("1f1b", 4, 8)
+        hooks.collective("all_reduce", paddle.to_tensor([1.0]))
+        hooks.watchdog_tick("step")
+        hooks.predictor_run(0, 4)
+        hooks.dataloader_next(object(), 0)
+        assert hooks.generate_begin() == 0
+        assert hooks.generate_phase("prefill", 0, None, 4) == 0
+        assert om.REGISTRY.names() == []
+
+    def test_disabled_overhead_regression(self):
+        """The disabled hot path is one flag check — a generous wall
+        bound (50us/call) that only a real regression (allocation,
+        locking, registry work on the disabled path) can blow."""
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            hooks.span("PP.forward", "Forward")
+        dt_span = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if hooks.enabled:
+                hooks.collective("all_reduce", None)
+        dt_flag = time.perf_counter() - t0
+        assert dt_span / n < 50e-6, f"span() disabled cost {dt_span/n}"
+        assert dt_flag / n < 50e-6
+        assert om.REGISTRY.names() == []
+
+    def test_instrumented_paths_silent_when_disabled(self):
+        """Predictor.run + DataLoader iteration with everything off:
+        no spans collected, no metrics registered."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        from paddle_tpu.io import DataLoader
+        net = nn.Linear(4, 2)
+        net.eval()
+        pred = inference.create_predictor(inference.Config(), layer=net)
+        pred.run([np.random.randn(2, 4).astype(np.float32)])
+        xs = np.random.randn(8, 3).astype(np.float32)
+
+        class DS:
+            thread_safe = True
+
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return xs[i]
+        for _ in DataLoader(DS(), batch_size=4):
+            pass
+        assert om.REGISTRY.names() == []
+        assert _collector.events == [] and not _collector.enabled
+
+
+# ---------------- chrome trace round-trip ----------------
+
+class TestChromeRoundTrip:
+    def test_export_then_load_preserves_names_and_durations(self, tmp_path):
+        out = tmp_path / "trace"
+        p = prof.Profiler(scheduler=(0, 5),
+                          on_trace_ready=prof.export_chrome_tracing(
+                              str(out)))
+        p.start()
+        with prof.RecordEvent("alpha", "Forward"):
+            time.sleep(0.003)
+        with prof.RecordEvent("beta", "Backward"):
+            time.sleep(0.001)
+        p.step()
+        collected = {e.name: e.duration for e in p.events()}
+        p.stop()
+        files = list(out.glob("*.json"))
+        assert files
+        data = prof.load_profiler_result(str(files[0]))
+        by_name = {e["name"]: e for e in data["traceEvents"]}
+        assert {"alpha", "beta"} <= set(by_name)
+        for name in ("alpha", "beta"):
+            # chrome dur is microseconds; collector durations are ns
+            assert by_name[name]["dur"] == pytest.approx(
+                collected[name] / 1000.0)
+            assert by_name[name]["ph"] == "X"
+        assert by_name["alpha"]["cat"] == "Forward"
+
+
+# ---------------- hot-path integration ----------------
+
+def _toy_pp_engine():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, LayerDesc, PipelineParallel)
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2,
+                            "schedule_mode": "1F1B"}
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 8, 4)],
+        num_stages=1,
+        loss_fn=lambda out, lbl: ((out - lbl) ** 2).mean())
+    return PipelineParallel(pipe, None, Strat())
+
+
+class TestEndToEndPhaseSummary:
+    def test_profiler_run_yields_trace_phases_and_prometheus(
+            self, tmp_path):
+        """Acceptance: ONE Profiler run over a toy PP step + a
+        generate() call produces a chrome trace, a per-phase summary
+        with nonzero fwd/bwd/prefill/decode buckets, and Prometheus
+        text with >= 6 distinct metric names."""
+        import jax
+        from paddle_tpu.models import llama, generate
+        obs.enable()
+        engine = _toy_pp_engine()
+        prof.wrap_optimizers()
+        opt = paddle.optimizer.SGD(
+            learning_rate=0.01, parameters=engine.parameters())
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+
+        cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=32)
+        params = llama.init_params(jax.random.key(0), cfg)
+        prompt = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 4)).astype(np.int32)
+
+        out_dir = tmp_path / "trace"
+        p = prof.Profiler(scheduler=(0, 4),
+                          on_trace_ready=prof.export_chrome_tracing(
+                              str(out_dir)))
+        p.start()
+        engine.train_batch([x, y], opt)                 # toy PP step
+        generate.generate(params, prompt, cfg, max_new_tokens=4)
+        p.step()
+        summary = p.phase_summary()
+        p.stop()
+        obs.disable()
+
+        # chrome trace exists and carries the hot-path spans
+        files = list(out_dir.glob("*.json"))
+        assert files
+        names = {e["name"] for e in json.loads(
+            files[0].read_text())["traceEvents"]}
+        assert {"PP.forward", "PP.backward", "Generate.prefill",
+                "Generate.decode", "Optimizer.step"} <= names
+
+        # per-phase dict: nonzero fwd/bwd/prefill/decode buckets
+        ph = summary["phases"]
+        for bucket in ("forward", "backward", "prefill", "decode",
+                       "optimizer"):
+            assert ph[bucket]["calls"] >= 1, (bucket, ph)
+            assert ph[bucket]["total_ms"] > 0, (bucket, ph)
+        assert ph["forward"]["calls"] == 2          # accumulate_steps
+        assert summary["window_ms"] > 0
+
+        # metrics snapshot rode along
+        assert "pp_steps_total" in summary["metrics"]
+
+        # Prometheus exposition: >= 6 distinct metric families
+        text = om.REGISTRY.to_prometheus()
+        fams = {l.split()[2] for l in text.splitlines()
+                if l.startswith("# TYPE")}
+        assert len(fams) >= 6, fams
+        assert "pp_bubble_ratio" in fams
+        assert "generate_tokens_total" in fams
+
+    def test_pp_bubble_ratio_gauge_values(self):
+        obs.enable()
+        hooks.pp_step("gpipe", 4, 8)
+        g = om.REGISTRY.get("pp_bubble_ratio")
+        assert g.labels("gpipe").get() == pytest.approx(3 / 11)
+        hooks.pp_step("zero_bubble", 4, 8)
+        assert g.labels("zero_bubble").get() == 0.0
+        hooks.pp_step("accum", 4, 8)
+        assert g.labels("accum").get() == pytest.approx(3 / 4)
+        hooks.pp_step("interleave", 4, 8, num_chunks=2)
+        assert g.labels("interleave").get() == pytest.approx(3 / 19)
+        assert om.REGISTRY.get("pp_microbatches_total").get() == 32
+
+
+class TestHotPathMetrics:
+    def test_predictor_run_metrics(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        obs.enable()
+        net = nn.Linear(4, 2)
+        net.eval()
+        pred = inference.create_predictor(inference.Config(), layer=net)
+        for _ in range(3):
+            pred.run([np.random.randn(2, 4).astype(np.float32)])
+        assert om.REGISTRY.get("inference_requests_total").get() == 3
+        assert om.REGISTRY.get("inference_run_seconds").get()["count"] == 3
+        assert om.REGISTRY.get("inference_samples_total").get() == 6
+
+    def test_dataloader_wait_vs_compute_split(self):
+        from paddle_tpu.io import DataLoader
+        obs.enable()
+        xs = np.random.randn(8, 3).astype(np.float32)
+
+        class DS:
+            thread_safe = True
+
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return xs[i]
+        for _ in DataLoader(DS(), batch_size=2):
+            time.sleep(0.001)            # consumer "compute"
+        waits = om.REGISTRY.get("dataloader_wait_seconds").get()
+        comps = om.REGISTRY.get("dataloader_compute_seconds").get()
+        assert waits["count"] == 4
+        assert comps["count"] == 3       # gaps between 4 batches
+        assert comps["sum"] >= 0.003
+
+    def test_collective_call_and_byte_counters(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        from paddle_tpu.distributed.auto_parallel.api import (
+            dtensor_from_local_list)
+        from paddle_tpu.distributed.auto_parallel.placement import Partial
+        obs.enable()
+        dist.init_parallel_env(mesh_shape=[8], axis_names=["world"])
+        try:
+            pm = ProcessMesh(np.arange(8), ["world"])
+            locs = [np.ones((2, 4), "float32") for _ in range(8)]
+            t = dtensor_from_local_list(locs, pm, [Partial()])
+            dist.all_reduce(t)
+            calls = om.REGISTRY.get("collective_calls_total")
+            bts = om.REGISTRY.get("collective_bytes_total")
+            assert calls.labels("all_reduce").get() == 1
+            # the global dist tensor is (2, 4) f32 = 32 bytes
+            assert bts.labels("all_reduce").get() == 32
+        finally:
+            dist.mesh._state["groups"].clear()
+            dist.mesh._state["mesh"] = None
+            dist.mesh._state["initialized"] = False
+
+    def test_watchdog_counters_and_trace_event(self):
+        from paddle_tpu.distributed.watchdog import StepWatchdog
+        obs.enable()
+        fired = []
+        p = prof.Profiler(scheduler=(0, 2))
+        p.start()
+        wd = StepWatchdog(0.05, action="callback",
+                          callback=lambda: fired.append(1),
+                          name="obs_test", start_grace=0.0)
+        wd.start()
+        wd.tick()
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        p.step()
+        evs = [e for e in p.events()
+               if e.name.startswith("Watchdog.fired")]
+        p.stop()
+        assert fired
+        assert om.REGISTRY.get("watchdog_fired_total").labels(
+            "obs_test").get() >= 1
+        assert om.REGISTRY.get("watchdog_ticks_total").labels(
+            "obs_test").get() == 1
+        assert om.REGISTRY.get("watchdog_last_stall_seconds").labels(
+            "obs_test").get() >= 0.05
+        assert evs and evs[0].event_type == "Watchdog"
+        assert evs[0].duration >= 0.04e9   # span covers the stall window
+
+
+# ---------------- satellites ----------------
+
+class TestWrapOptimizers:
+    def test_step_records_event_and_is_idempotent(self):
+        import paddle_tpu.nn as nn
+        prof.wrap_optimizers()
+        prof.wrap_optimizers()            # idempotent
+        net = nn.Linear(3, 3)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        assert getattr(type(opt).step, "_prof_wrapped", False) or \
+            getattr(opt.step.__func__, "_prof_wrapped", False)
+        p = prof.Profiler(scheduler=(0, 2))
+        p.start()
+        loss = (net(paddle.to_tensor(
+            np.random.rand(2, 3).astype("float32"))) ** 2).mean()
+        loss.backward()
+        opt.step()
+        p.step()
+        evs = [e for e in p.events() if e.name == "Optimizer.step"]
+        p.stop()
+        assert len(evs) == 1
+        assert evs[0].event_type == "Optimization"
+
+    def test_wraps_subclasses_defined_after_first_call(self):
+        from paddle_tpu.optimizer.optimizer import Optimizer
+        prof.wrap_optimizers()
+
+        class LateOpt(Optimizer):
+            def step(self):
+                return "stepped"
+        assert not getattr(LateOpt.step, "_prof_wrapped", False)
+        prof.wrap_optimizers()          # re-walk picks up the new class
+        assert LateOpt.step._prof_wrapped
+
+
+class TestTimerWindow:
+    def test_step_info_reflects_recent_window(self):
+        from paddle_tpu.profiler.timer import Benchmark
+        b = Benchmark()
+        b.begin()
+        b.batch_cost.record(1.0)          # "slow warmup" steps
+        b.batch_cost.record(1.0)
+        info = b.step_info()              # consumes the window
+        assert "batch_cost: 1.00000" in info
+        b.batch_cost.record(0.1)          # recent steps are fast
+        info = b.step_info()
+        assert "batch_cost: 0.10000" in info, info
+        # lifetime average still blends everything
+        assert b.batch_cost.avg() == pytest.approx(2.1 / 3)
+
+    def test_empty_window_reports_zero_not_lifetime(self):
+        from paddle_tpu.profiler.timer import Benchmark
+        b = Benchmark()
+        b.batch_cost.record(0.5)
+        b.step_info()
+        info = b.step_info()              # window empty: idle interval
+        assert "batch_cost: 0.00000" in info
+        assert b.batch_cost.avg() == 0.5  # lifetime still intact
+
+    def test_reset_clears_everything(self):
+        from paddle_tpu.profiler.timer import Benchmark
+        b = Benchmark()
+        b.batch_cost.record(2.0)
+        b.ips_stat.record(10.0)
+        b.reset()
+        assert b.batch_cost.avg() == 0.0
+        assert b.ips_stat.window_avg() == 0.0
+
+
+class TestStepTimeline:
+    def test_merges_profiler_events(self):
+        from paddle_tpu.profiler.profiler import _Event
+        tl = obs.StepTimeline()
+        tl.add_events([
+            _Event("PP.forward", 0, int(10e6), 1, "Forward"),
+            _Event("PP.backward", int(10e6), int(30e6), 1, "Backward"),
+            _Event("Generate.prefill", 0, int(5e6), 2, "Forward"),
+        ])
+        s = tl.summary(include_metrics=False)
+        assert s["phases"]["forward"]["total_ms"] == pytest.approx(10.0)
+        assert s["phases"]["backward"]["total_ms"] == pytest.approx(20.0)
+        assert s["phases"]["prefill"]["total_ms"] == pytest.approx(5.0)
+        assert "metrics" not in s
+
+    def test_phase_of_mapping(self):
+        from paddle_tpu.observability.timeline import phase_of
+        assert phase_of("Generate.decode", "UserDefined") == "decode"
+        assert phase_of("PP.spmd.step", "Forward") == "pp_spmd"
+        assert phase_of("whatever", "Backward") == "backward"
+        assert phase_of("whatever", "NoSuchType") == "other"

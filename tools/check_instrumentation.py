@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Instrumentation lint: the hot paths must keep their telemetry hooks.
+
+The observability layer only attributes time if the hot-path modules
+keep emitting their spans/metrics — a refactor that drops one hook
+silently degrades every future BENCH_r*.json breakdown. This lint greps
+each known hot-path module for its REQUIRED hook call sites and fails
+if any went missing. Wired into the tier-1 run as a fast test
+(tests/test_instrumentation_lint.py); runnable standalone:
+
+    python tools/check_instrumentation.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+# module (repo-relative) -> [(required substring, min occurrences)]
+REQUIRED = {
+    "paddle_tpu/distributed/fleet/meta_parallel/pipeline_parallel.py": [
+        ('_obs.span("PP.forward"', 1),
+        ('_obs.span("PP.backward"', 1),
+        ('_obs.span("PP.spmd.step"', 2),      # homogeneous + hetero
+        ('_obs.span("PP.spmd.scatter"', 2),
+        ("_obs.pp_step(", 3),                 # both SPMD paths + accum
+    ],
+    "paddle_tpu/inference/predictor.py": [
+        ("_obs.predictor_run(", 1),
+        ("_obs.active()", 1),
+    ],
+    "paddle_tpu/models/generate.py": [
+        ("_obs.generate_begin()", 1),
+        ('_obs.generate_phase("prefill"', 1),
+        ('_obs.generate_phase("decode"', 1),
+    ],
+    "paddle_tpu/io/dataloader.py": [
+        ("_obs.dataloader_next(", 2),         # single-process + prefetch
+        ("_obs.active()", 2),
+    ],
+    "paddle_tpu/distributed/collective.py": [
+        ("_obs.collective(", 12),             # one per collective entry
+        ('_obs.collective("all_reduce"', 1),
+        ('_obs.collective("all_gather"', 1),
+        ('_obs.collective("send_recv"', 1),
+    ],
+    "paddle_tpu/distributed/watchdog.py": [
+        ("_obs.watchdog_tick(", 1),
+        ("_obs.watchdog_fired(", 1),
+    ],
+    "paddle_tpu/profiler/utils.py": [
+        ('RecordEvent("Optimizer.step"', 1),
+    ],
+    "bench.py": [
+        ("phase_summary()", 1),
+        ('"phases"', 1),
+    ],
+}
+
+
+def check(root: str) -> list:
+    """Returns a list of human-readable violation strings (empty = ok)."""
+    problems = []
+    for rel, rules in REQUIRED.items():
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: file missing")
+            continue
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for needle, min_count in rules:
+            n = src.count(needle)
+            if n < min_count:
+                problems.append(
+                    f"{rel}: expected >= {min_count} occurrence(s) of "
+                    f"{needle!r}, found {n} — a telemetry hook was "
+                    f"dropped (see paddle_tpu/observability/hooks.py)")
+    return problems
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    problems = check(root)
+    if problems:
+        for p in problems:
+            print(f"check_instrumentation: {p}", file=sys.stderr)
+        return 1
+    print(f"check_instrumentation: {len(REQUIRED)} hot-path modules ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
