@@ -120,7 +120,7 @@ def peak_flops(dev) -> float:
 
 def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
-            decode_w8kv8_tps=None, phases=None):
+            decode_w8kv8_tps=None, decode_paged_tps=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -134,7 +134,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_tokens_per_sec": decode_tps,
                   "decode_int8_tokens_per_sec": decode_int8_tps,
                   "decode_int4_tokens_per_sec": decode_int4_tps,
-                  "decode_w8kv8_tokens_per_sec": decode_w8kv8_tps},
+                  "decode_w8kv8_tokens_per_sec": decode_w8kv8_tps,
+                  "decode_paged_tokens_per_sec": decode_paged_tps},
     }
     if phases is not None:
         rec["phases"] = phases
@@ -198,17 +199,73 @@ def _capture_phases(step, state, tokens, cfg):
             pass
 
 
+def paged_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                      kv_cache_dtype=None):
+    """The decode_paged_tokens_per_sec measurement, shared by measure()
+    and tools/decode_bench.py so the two sources stay comparable.
+
+    2x-oversubscribed queue, mixed prompt lengths AND mixed decode
+    budgets: short rows retire mid-run and queued prompts admit into
+    the freed slots — without queue depth the tier would never exercise
+    the continuous-batching mechanism it exists to measure. Throughput
+    includes the host scheduling loop (an ENGINE number, not a kernel
+    microbench)."""
+    import numpy as np
+    from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    plens = [dp_len if i % 2 else max(dp_len // 2, 1)
+             for i in range(2 * db)]
+    rngp = np.random.default_rng(2)
+    prompts = [rngp.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in plens]
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_batch=db, page_size=16 if on_tpu else 8,
+        max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype)
+
+    def paged_pass():
+        reqs = [eng.submit(p, max_new_tokens=(
+            dnew if i % 2 else max(dnew // 2, 1)))
+                for i, p in enumerate(prompts)]
+        eng.run()
+        return sum(r.max_new_tokens for r in reqs)
+
+    paged_pass()                                    # compile pass
+    t0 = time.perf_counter()
+    toks_out = paged_pass()                         # steady state
+    return round(toks_out / (time.perf_counter() - t0), 2)
+
+
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
-                 "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec")
+                 "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec",
+                 "decode_paged_tokens_per_sec")
+
+
+def _label_decode_source(extra: dict, carried_tiers) -> None:
+    """Stamp PER-TIER provenance: ``decode_source`` maps each non-null
+    decode tier to ``"live"`` (measured by the run that owns the record)
+    or ``"carried"`` (inherited from BENCH_LASTGOOD) — a blanket string
+    would misattribute mixed fresh/stale records (ADVICE r5). Only
+    written when at least one tier actually carried; absent means every
+    present tier is live."""
+    if not carried_tiers:
+        return
+    # respect labels already on the record (e.g. a _backfill_decode
+    # carry riding into _record_last_good): a tier once marked carried
+    # stays carried; only genuinely unlabeled tiers default to live
+    prev = extra.get("decode_source")
+    prev = prev if isinstance(prev, dict) else {}
+    extra["decode_source"] = {
+        k: ("carried" if k in carried_tiers else prev.get(k, "live"))
+        for k in _DECODE_TIERS if extra.get(k) is not None}
 
 
 def _backfill_decode(rec: dict) -> dict:
     """If this run's decode extras are null but a previous standalone
     decode-bench capture lives in BENCH_LASTGOOD (merged there by
     tools/tpu_watch.sh stage b / _record_last_good carry-forward), carry
-    the measured tiers into the emitted record — LABELED via
-    ``decode_source`` so a carried number can never masquerade as a
-    same-run measurement. TPU records only; CPU smoke stays pure."""
+    the measured tiers into the emitted record — labeled PER TIER via
+    ``decode_source`` ({tier: "live"|"carried"}) so a carried number can
+    never masquerade as a same-run measurement. TPU records only; CPU
+    smoke stays pure."""
     try:
         if "tpu" not in str(rec.get("extra", {}).get("device", "")).lower():
             return rec
@@ -217,15 +274,16 @@ def _backfill_decode(rec: dict) -> dict:
         with open(_LASTGOOD) as f:
             lg = json.load(f)
         lx = lg.get("extra", {})
-        carried = False
+        carried = set()
         for k in _DECODE_TIERS:
             if rec["extra"].get(k) is None and lx.get(k) is not None:
                 rec["extra"][k] = lx[k]
-                carried = True
+                carried.add(k)
         if carried:
-            rec["extra"]["decode_source"] = (
-                "carried from BENCH_LASTGOOD "
+            rec["extra"]["decode_carried_from"] = (
+                "BENCH_LASTGOOD "
                 f"({lx.get('decode_recorded_at') or lg.get('recorded_at')})")
+            _label_decode_source(rec["extra"], carried)
     except Exception:
         pass
     return rec
@@ -380,13 +438,26 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"w8kv8 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # paged KV + continuous batching at MIXED request lengths: the
+    # serving-engine tier (paddle_tpu/serving + ContinuousBatchingEngine)
+    # — throughput includes the host scheduling loop, i.e. what a server
+    # actually ships
+    decode_paged_tps = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_paged_tps = paged_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"paged decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
 
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                    decode_int8_tps, decode_int4_tps, decode_w8kv8_tps,
-                   phases=phases)
+                   decode_paged_tps, phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"
@@ -497,21 +568,25 @@ def _record_last_good(parsed: dict) -> None:
         # measured numbers. Only _DECODE_TIERS values carry — metadata
         # (decode_source / decode_recorded_at) follows ONLY when a value
         # actually carried, so a later record with genuinely-measured
-        # tiers never inherits a stale "carried" label
+        # tiers never inherits a stale "carried" label; decode_source is
+        # rebuilt PER TIER ({tier: "live"|"carried"}) so a record mixing
+        # same-run and inherited numbers attributes each one correctly
         try:
             with open(_LASTGOOD) as f:
                 old = json.load(f)
             ox = old.get("extra", {})
-            carried = False
+            carried = set()
             for k in _DECODE_TIERS:
                 if ox.get(k) is not None and \
                         rec.get("extra", {}).get(k) is None:
                     rec.setdefault("extra", {})[k] = ox[k]
-                    carried = True
+                    carried.add(k)
             if carried:
-                for meta in ("decode_recorded_at", "decode_source"):
-                    if meta not in rec.get("extra", {}) and meta in ox:
-                        rec["extra"][meta] = ox[meta]
+                if "decode_recorded_at" not in rec.get("extra", {}) and \
+                        "decode_recorded_at" in ox:
+                    rec["extra"]["decode_recorded_at"] = \
+                        ox["decode_recorded_at"]
+                _label_decode_source(rec["extra"], carried)
         except Exception:
             pass
         rec["recorded_unix"] = time.time()

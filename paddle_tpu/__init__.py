@@ -49,6 +49,7 @@ from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
 from . import inference  # noqa: E402
+from . import serving  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import audio  # noqa: E402

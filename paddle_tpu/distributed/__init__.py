@@ -49,6 +49,7 @@ from .extras import (  # noqa: F401
     all_gather_object, broadcast_object_list, scatter_object_list,
     dtensor_from_fn, ShardingStage1, ShardingStage2, ShardingStage3,
     DistAttr, shard_dataloader, shard_scaler, split,
+    reset_split_layer_cache,
     CountFilterEntry, ProbabilityEntry, ShowClickEntry,
 )
 from . import io  # noqa: F401

@@ -30,6 +30,7 @@ __all__ = [
     "all_gather_object", "broadcast_object_list", "scatter_object_list",
     "dtensor_from_fn", "ShardingStage1", "ShardingStage2", "ShardingStage3",
     "DistAttr", "shard_dataloader", "shard_scaler", "split",
+    "reset_split_layer_cache",
     "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
 ]
 
@@ -267,6 +268,16 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     return _split(x, size, operation=operation, axis=axis,
                   num_partitions=num_partitions, gather_out=gather_out,
                   weight_attr=weight_attr, bias_attr=bias_attr, name=name)
+
+
+def reset_split_layer_cache() -> int:
+    """Explicitly release the named :func:`split` layer cache (which
+    never evicts on its own — each entry pins its mesh alive). Called
+    automatically by ``fleet.init`` on re-initialization; exposed here
+    for servers/tests that churn meshes outside fleet. Returns the
+    number of evicted layers."""
+    from .fleet.layers.mpu.mp_ops import reset_split_layer_cache as _r
+    return _r()
 
 
 # ---------------- PS sparse-table entry configs ----------------

@@ -44,6 +44,24 @@ def init(role_maker=None, is_collective: bool = True,
     """reference: fleet.py:218."""
     if strategy is None:
         strategy = DistributedStrategy()
+    if _fleet_state["initialized"]:
+        # RE-init starts a fresh topology generation: release the named
+        # split-layer cache (mp_ops) so dead layers sharded over retired
+        # meshes — whose keys pin those meshes alive — don't accumulate
+        # in servers/tests that churn meshes. Loud, not silent: a
+        # workflow relying on named-layer reuse ACROSS re-inits (the old
+        # no-eviction behavior) would otherwise re-initialize trained
+        # weights without a trace.
+        from .layers.mpu.mp_ops import reset_split_layer_cache
+        n = reset_split_layer_cache()
+        if n:
+            import warnings
+            warnings.warn(
+                f"fleet.init re-initialization released {n} named "
+                "distributed.split layer(s); the next same-named split "
+                "call re-creates them with FRESH weights. Hold trained "
+                "layers on a module (or re-create them per generation) "
+                "if you re-init fleet mid-run.", stacklevel=2)
     if role_maker is not None and not is_collective:
         # parameter-server mode (reference: fleet.init(role) + the_one_ps
         # runtime): no device mesh — roles split into servers hosting

@@ -155,6 +155,28 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
     return loss
 
 
+def reset_split_layer_cache() -> int:
+    """Release every layer created by named :func:`split` calls.
+
+    The split cache never evicts on its own (named layers must persist
+    like layers held on a module, and each key pins its mesh object
+    alive), so a long-lived server or test process that churns meshes
+    accumulates dead layers — and their sharded parameters — forever.
+    This is the explicit release valve: call it when a mesh generation
+    is retired for good. :func:`paddle_tpu.distributed.fleet.init` calls
+    it automatically on RE-initialization (a fresh topology starts a
+    fresh layer generation); returns the number of evicted layers.
+
+    After a reset, the next named split call re-creates (and
+    re-initializes) its layer — don't reset between the construction
+    and use of live layers."""
+    cache = getattr(split, "_layers", None)
+    n = len(cache) if cache else 0
+    if cache:
+        cache.clear()
+    return n
+
+
 def split(x, size, operation="linear", axis=0, num_partitions=1,
           gather_out=True, weight_attr=None, bias_attr=None, name=None):
     """reference: mp_ops.py:714 paddle.distributed.split — one-shot
@@ -249,13 +271,14 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
                 f"distributed.split(linear): axis must be 0 (row "
                 f"parallel) or 1 (column parallel), got {axis}")
         if name is not None:
-            # NO eviction: named layers persist for the process, exactly
-            # like layers held on a module — a process that alternates
-            # meshes (train mesh / eval mesh, tests re-initializing
-            # fleet) must find its named layers again under each, and
-            # any eviction policy here silently re-initializes trained
-            # weights for whichever mesh it evicts. Growth is bounded by
-            # the number of distinct (name, config, mesh) layers the
-            # program actually creates.
+            # NO automatic eviction: named layers persist like layers
+            # held on a module — a process that alternates meshes within
+            # one fleet generation must find its named layers again
+            # under each, and any eviction policy here silently
+            # re-initializes trained weights for whichever mesh it
+            # evicts. The release valve is EXPLICIT:
+            # reset_split_layer_cache(), called by fleet.init on
+            # RE-initialization, so servers/tests that churn meshes
+            # don't leak dead layers' sharded parameters.
             cache[key] = (layer, weight_attr, bias_attr)
     return layer(x)

@@ -82,9 +82,33 @@ def _psum_act(x, pp_axis: str, mesh: Mesh):
     instruction opcode copy", hlo_instruction.cc:1585 — observed
     AOT-compiling the 13B bf16 recipe on the 16-device CPU mesh; TPU
     backends never run that pass). Native-dtype psum is kept on TPU so
-    the collective rides ICI at bf16 bytes."""
+    the collective rides ICI at bf16 bytes.
+
+    THE SAME XLA BUG has two workarounds in this repo — this is the
+    canonical inventory so one can be retired when upstream fixes the
+    CHECK:
+
+    1. **This f32 upcast** — covers every bf16 activation psum the
+       SPMD pipeline entry points emit EXPLICITLY (``pipeline_spmd``,
+       ``pipeline_spmd_grad``, ``pipeline_spmd_hetero``, and the
+       interleave forward), i.e. all in-process CPU-mesh runs: tier-1
+       tests, the 16-device CPU smoke meshes, eager fleet engines.
+    2. **The XLA-flag disable** (``tools/aot_validate.py`` child env:
+       ``--xla_disable_hlo_passes=all-reduce-promotion``) — needed
+       because the interleave-schedule AD graph also contains
+       GSPMD-INSERTED bf16 all-reduces that never route through this
+       helper, so the upcast can't reach them; bf16 all-reduces compile
+       and run correctly on CPU with the pass off.
+
+    Retirement order once the upstream CHECK is fixed: drop (1) first
+    (native bf16 everywhere, this helper becomes plain ``lax.psum``),
+    then (2); keep them in lockstep with this docstring. Set
+    ``PADDLE_TPU_NATIVE_BF16_PSUM=1`` to bypass the upcast early and
+    probe whether the installed XLA still crashes."""
+    import os
     if mesh.devices.flat[0].platform == "cpu" and \
-            x.dtype == jnp.bfloat16:
+            x.dtype == jnp.bfloat16 and \
+            not os.environ.get("PADDLE_TPU_NATIVE_BF16_PSUM"):
         return lax.psum(x.astype(jnp.float32), pp_axis).astype(x.dtype)
     return lax.psum(x, pp_axis)
 

@@ -52,6 +52,217 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
     }
 
 
+def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
+                     kv_dtype=None) -> Dict:
+    """Paged KV cache: one global pool of fixed-size token pages per
+    layer — ``(L, num_pages, page_size, nkv, hd)`` — indexed by
+    per-request block tables instead of a dense ``(L, B, S_max, ...)``
+    slab, so serving HBM is sized by tokens in flight (reference:
+    block_multi_head_attention's block cache; see
+    paddle_tpu/serving/paged_cache.py for the allocator).
+
+    ``kv_dtype="int8"`` mirrors :func:`init_cache`'s per-row-scale int8
+    tier: pages store int8 rows, ``ks``/``vs`` pools carry the per-row
+    dequant scales."""
+    L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.hd
+    if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
+        raise ValueError(
+            f"init_paged_cache: kv_dtype={kv_dtype!r} is not supported — "
+            f"pass None (model dtype) or 'int8'")
+    if kv_dtype is not None:
+        return {
+            "k": jnp.zeros((L, num_pages, page_size, nkv, hd), jnp.int8),
+            "v": jnp.zeros((L, num_pages, page_size, nkv, hd), jnp.int8),
+            "ks": jnp.zeros((L, num_pages, page_size, nkv), jnp.float32),
+            "vs": jnp.zeros((L, num_pages, page_size, nkv), jnp.float32),
+        }
+    return {
+        "k": jnp.zeros((L, num_pages, page_size, nkv, hd), cfg.dtype),
+        "v": jnp.zeros((L, num_pages, page_size, nkv, hd), cfg.dtype),
+    }
+
+
+def _scatter_rows(pool, dst, rows):
+    """Write token rows into pool slots: pool (L, P, page, ...), dst
+    (N,) flat slot ids (page*page_size + offset), rows (L, N, ...)."""
+    L, P, page = pool.shape[0], pool.shape[1], pool.shape[2]
+    flat = pool.reshape((L, P * page) + pool.shape[3:])
+    flat = flat.at[:, dst].set(rows.astype(pool.dtype))
+    return flat.reshape(pool.shape)
+
+
+def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
+                         block_table: jax.Array, cfg: LlamaConfig,
+                         prompt_len=None):
+    """Prefill ONE request and scatter its KV into the paged pools.
+
+    prompt:      (1, S) int32 — continuous batching admits one request
+                 at a time into a free slot
+    paged:       :func:`init_paged_cache` pools (int8 tier included)
+    block_table: (ppseq,) int32 page ids for this request, in logical
+                 order; entries beyond the allocated pages may point at
+                 the trash page (their scattered rows are zeros)
+    prompt_len:  optional TRACED scalar — the true prompt length when
+                 ``prompt`` is LEFT-padded to a bucketed width (the
+                 engine pads to page multiples so a long-lived server
+                 compiles one prefill program per page count, not per
+                 distinct prompt length). Decode parity is preserved
+                 exactly: left-padded prefill is row-identical to the
+                 unpadded one (the ragged-``generate`` guarantee) and
+                 the scatter shifts rows so page slot ``s`` holds
+                 logical token ``s``.
+    returns (last-token logits (1, V), updated pools).
+
+    The prefill itself runs the DENSE path (:func:`_forward_cached`)
+    over a temporary cache sized to the PROMPT's width ``S`` (not the
+    slot's full ``max_len`` extent — per-admission cost scales with the
+    prompt, the serving hot path's bill), then scatters those ``S``
+    rows into the request's pages. Page slots past the prompt keep
+    whatever a previous tenant left: decode masks ``kpos <= length``
+    and overwrites each position before any mask exposes it, so stale
+    rows are never visible."""
+    B, S = prompt.shape
+    if B != 1:
+        raise ValueError(
+            f"paged_prefill_insert: one request at a time (got batch "
+            f"{B}); continuous batching admits requests individually")
+    page = paged["k"].shape[2]
+    ext = block_table.shape[0] * page          # the slot's full extent
+    if S > ext:
+        raise ValueError(
+            f"prompt of {S} tokens exceeds the block table's "
+            f"{ext}-token extent")
+    quant = "ks" in paged
+    dense = init_cache(cfg, 1, S, kv_dtype="int8" if quant else None)
+    if prompt_len is None:
+        logits, dense = _forward_cached(params, prompt, dense, 0, cfg,
+                                        S)
+        src = None
+    else:
+        pad = S - jnp.asarray(prompt_len, jnp.int32).reshape(())
+        kstart = jnp.clip(pad, 0, S - 1)[None]                  # (1,)
+        rpos = jnp.clip(jnp.arange(S, dtype=jnp.int32)[None, :]
+                        - kstart[:, None], 0, None)
+        logits, dense = _forward_cached(params, prompt, dense, 0, cfg,
+                                        S, rpos=rpos, kstart=kstart)
+        # logical token s lives at padded cache row pad + s; rows past
+        # the prompt clip to the last row (finite garbage, overwritten
+        # by decode steps before any attention mask exposes them)
+        src = jnp.clip(pad + jnp.arange(S, dtype=jnp.int32), 0, S - 1)
+    pos = jnp.arange(S, dtype=jnp.int32)
+    dst = block_table[pos // page] * page + pos % page
+    out = {}
+    for name in paged:
+        rows = dense[name][:, 0]
+        if src is not None:
+            rows = jnp.take(rows, src, axis=1)
+        out[name] = _scatter_rows(paged[name], dst, rows)
+    return logits, out
+
+
+def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
+                         block_tables: jax.Array, lengths: jax.Array,
+                         cfg: LlamaConfig, *, active=None,
+                         use_kernel=None):
+    """One continuous-batching decode step over the ragged batch: every
+    slot advances one token in a single static-shape program.
+
+    tokens:       (B,) int32 — each slot's previous token
+    block_tables: (B, ppseq) int32 page ids per slot
+    lengths:      (B,) valid lengths; the new token's KV lands at
+                  position ``lengths`` and attention sees ``lengths+1``
+    active:       (B,) bool — inactive slots still compute (static
+                  shapes) but their KV writes are routed to the trash
+                  page and their logits are garbage to be ignored
+    returns (logits (B, V) f32, updated pools).
+
+    Math is kept op-for-op identical to the dense decode
+    (:func:`_block_infer` + ``_attn_with_cache``-equivalent paged
+    attention), so greedy tokens match the dense path exactly."""
+    from ..ops.pallas import paged_attention as _pa
+    B = tokens.shape[0]
+    page = paged["k"].shape[2]
+    ext = block_tables.shape[1] * page
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    quant = "ks" in paged
+    if active is None:
+        active = jnp.ones((B,), bool)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    cos, sin = rope_tables(ext, cfg.hd, cfg.rope_theta)
+    rpos = lengths[:, None]                          # (B, 1)
+    # per-row destination slot; inactive rows dump into the trash page
+    # (page 0 slot 0 — reserved by serving.BlockAllocator) so a retired
+    # slot's stale table can never clobber a live request's pages
+    row = jnp.arange(B)
+    dst = jnp.where(active,
+                    block_tables[row, lengths // page] * page
+                    + lengths % page,
+                    0)
+    x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(
+        cfg.dtype)                                   # (B, 1, H)
+
+    def body(xc, layer_in):
+        if quant:
+            lp, kp, vp, ksp, vsp = layer_in
+        else:
+            lp, kp, vp = layer_in
+            ksp = vsp = None
+        h1 = rms_norm(xc, lp["attn_norm"], cfg.rms_eps)
+        q = (h1 @ _w(lp, "wq", xc.dtype)).reshape(B, 1, nh, hd)
+        k = (h1 @ _w(lp, "wk", xc.dtype)).reshape(B, 1, nkv, hd)
+        v = (h1 @ _w(lp, "wv", xc.dtype)).reshape(B, 1, nkv, hd)
+        q = _rope_rows(q, cos, sin, rpos)
+        k = _rope_rows(k, cos, sin, rpos)
+        if quant:
+            sc = jnp.maximum(
+                jnp.max(jnp.abs(k.astype(jnp.float32)), axis=-1) / 127.0,
+                1e-8)
+            kq = jnp.clip(jnp.round(k.astype(jnp.float32)
+                                    / sc[..., None]), -127, 127)
+            vc = jnp.maximum(
+                jnp.max(jnp.abs(v.astype(jnp.float32)), axis=-1) / 127.0,
+                1e-8)
+            vq = jnp.clip(jnp.round(v.astype(jnp.float32)
+                                    / vc[..., None]), -127, 127)
+            kp = kp.reshape((-1,) + kp.shape[2:]).at[dst].set(
+                kq[:, 0].astype(jnp.int8)).reshape(kp.shape)
+            vp = vp.reshape((-1,) + vp.shape[2:]).at[dst].set(
+                vq[:, 0].astype(jnp.int8)).reshape(vp.shape)
+            ksp = ksp.reshape((-1,) + ksp.shape[2:]).at[dst].set(
+                sc[:, 0].astype(jnp.float32)).reshape(ksp.shape)
+            vsp = vsp.reshape((-1,) + vsp.shape[2:]).at[dst].set(
+                vc[:, 0].astype(jnp.float32)).reshape(vsp.shape)
+        else:
+            kp = kp.reshape((-1,) + kp.shape[2:]).at[dst].set(
+                k[:, 0].astype(kp.dtype)).reshape(kp.shape)
+            vp = vp.reshape((-1,) + vp.shape[2:]).at[dst].set(
+                v[:, 0].astype(vp.dtype)).reshape(vp.shape)
+        o = _pa.paged_attention(
+            q[:, 0], kp, vp, block_tables, lengths + 1,
+            ks_pages=ksp, vs_pages=vsp, use_kernel=use_kernel)
+        xo = xc + o.reshape(B, 1, nh * hd) @ _w(lp, "wo", xc.dtype)
+        h2 = rms_norm(xo, lp["mlp_norm"], cfg.rms_eps)
+        g = jax.nn.silu((h2 @ _w(lp, "wg", xc.dtype)).astype(
+            jnp.float32)).astype(xc.dtype)
+        u = h2 @ _w(lp, "wu", xc.dtype)
+        y = xo + (g * u) @ _w(lp, "wd", xc.dtype)
+        return y, ((kp, vp, ksp, vsp) if quant else (kp, vp))
+
+    xs = ((params["layers"], paged["k"], paged["v"], paged["ks"],
+           paged["vs"]) if quant else
+          (params["layers"], paged["k"], paged["v"]))
+    x, new = lax.scan(body, x, xs)
+    new_paged = ({"k": new[0], "v": new[1], "ks": new[2], "vs": new[3]}
+                 if quant else {"k": new[0], "v": new[1]})
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        head = params["embed"].T.astype(x.dtype)
+    else:
+        head = _w(params, "lm_head", x.dtype)
+    logits = (x[:, -1] @ head).astype(jnp.float32)
+    return logits, new_paged
+
+
 def quantize_weights(params, cfg: LlamaConfig, bits: int = 8,
                      group_size: int = 128) -> Dict:
     """Weight-only quantization for serving (reference:

@@ -164,6 +164,51 @@ def predictor_run(t0_ns: int, batch: int):
                        "samples served by Predictor.run").inc(batch)
 
 
+# ---------------- continuous-batching serving ----------------
+
+def serving_admitted(n: int, prompt_tokens: int):
+    """A request entered a decode slot (admission counter + prefill
+    token counter)."""
+    if not enabled:
+        return
+    _m.counter("serving_admissions_total",
+               "requests admitted into decode slots").inc(n)
+    _m.counter("serving_prefill_tokens_total",
+               "prompt tokens prefilled into the paged cache"
+               ).inc(prompt_tokens)
+
+
+def serving_retired(n: int, reason: str):
+    """A request left its slot and recycled its pages; ``reason`` is
+    ``eos`` / ``length`` / ``evicted``."""
+    if not enabled:
+        return
+    _m.counter("serving_evictions_total",
+               "requests retired from decode slots",
+               ("reason",)).labels(reason).inc(n)
+
+
+def serving_step(active: int, max_slots: int, pages_used: int,
+                 pages_total: int):
+    """One continuous-batching decode step: batch-occupancy histogram +
+    block-pool utilization gauge."""
+    if not enabled:
+        return
+    _m.histogram("serving_batch_occupancy",
+                 "active decode slots per step, as a fraction of "
+                 "max_batch",
+                 buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                          1.0)).observe(active / max(max_slots, 1))
+    _m.gauge("serving_block_pool_utilization",
+             "fraction of the paged KV block pool in use"
+             ).set(pages_used / max(pages_total, 1))
+    _m.counter("serving_decode_steps_total",
+               "continuous-batching decode steps").inc()
+    _m.counter("serving_decode_tokens_total",
+               "tokens decoded by the continuous-batching engine"
+               ).inc(active)
+
+
 # ---------------- data path ----------------
 
 def dataloader_next(it, t0_ns: int):

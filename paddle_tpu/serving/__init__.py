@@ -1,0 +1,14 @@
+"""Serving subsystem: paged KV cache + continuous batching.
+
+- :mod:`paddle_tpu.serving.paged_cache` — global page pools, per-request
+  block tables, the host-side :class:`BlockAllocator` (alloc/free/defrag
+  stats) and :class:`PagedKVCache` bundle.
+- the paged attention op lives in
+  :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
+  fallback) and the continuous-batching engine in
+  :mod:`paddle_tpu.inference.predictor`
+  (:class:`~paddle_tpu.inference.ContinuousBatchingEngine`).
+"""
+from .paged_cache import (  # noqa: F401
+    TRASH_PAGE, BlockAllocator, PagedKVCache, PoolExhausted,
+)

@@ -1,0 +1,226 @@
+"""Paged KV-cache subsystem: global page pools + host-side allocator.
+
+Serving memory layout (reference: the block_multi_head_attention tier of
+the serving stack; TPU-native design: Ragged Paged Attention, arxiv
+2604.15464 / vLLM block tables): K/V for ALL in-flight requests live in
+one global pool of fixed-size token pages per layer —
+``(L, num_pages, page_size, nkv, hd)`` — and each request holds an
+ordered block table of page ids. HBM is sized by tokens actually in
+flight instead of ``batch * longest_request``, which is what lets the
+continuous-batching engine (inference/predictor.py) admit short requests
+into the headroom long ones would otherwise pad-burn.
+
+Everything here is HOST-side bookkeeping (free lists, stats, tables);
+the device-side pool arrays are built by
+``models/generate.init_paged_cache`` and updated functionally inside the
+jitted prefill/decode programs. Page id 0 is RESERVED as the trash page:
+the single jitted ragged-decode program runs every slot each step with
+static shapes, and retired/empty slots route their (masked, garbage)
+KV writes there instead of clobbering live pages.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: page id never handed out by the allocator — the write target for
+#: inactive rows of the static-shape decode program
+TRASH_PAGE = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Raised when an allocation cannot be satisfied from the free list.
+
+    Continuous batching treats this as back-pressure: the admission is
+    deferred until running requests retire and recycle their pages."""
+
+
+class BlockAllocator:
+    """Host-side slot allocator over the global page pool.
+
+    Tracks a free list plus alloc/free/defrag stats. Page ids start at
+    ``reserved`` (default 1 — page 0 is the trash page)."""
+
+    def __init__(self, num_pages: int, reserved: int = 1):
+        if num_pages <= reserved:
+            raise ValueError(
+                f"BlockAllocator: num_pages={num_pages} must exceed the "
+                f"{reserved} reserved page(s)")
+        self.num_pages = num_pages
+        self.reserved = reserved
+        # descending storage so list.pop() hands out ascending ids
+        # (deterministic placement; tests rely on it)
+        self._free: List[int] = list(range(num_pages - 1, reserved - 1, -1))
+        self.allocs_total = 0
+        self.frees_total = 0
+        self.alloc_failures = 0
+        self.defrags_total = 0
+        self.peak_in_use = 0
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return (self.num_pages - self.reserved) - len(self._free)
+
+    def utilization(self) -> float:
+        total = self.num_pages - self.reserved
+        return self.num_used / total if total else 0.0
+
+    def fragmentation(self) -> float:
+        """Fraction of free pages sitting BELOW the highest used page —
+        holes a compaction (:meth:`PagedKVCache.defrag`) would close."""
+        if not self._free or self.num_used == 0:
+            return 0.0
+        free = set(self._free)
+        top_used = max(i for i in range(self.reserved, self.num_pages)
+                       if i not in free)
+        holes = sum(1 for f in self._free if f < top_used)
+        return holes / len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Hand out ``n`` pages; raises :class:`PoolExhausted` (and
+        counts the failure) when the free list is short."""
+        if n > len(self._free):
+            self.alloc_failures += 1
+            raise PoolExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"(pool {self.num_pages}, {self.reserved} reserved)")
+        got = [self._free.pop() for _ in range(n)]
+        self.allocs_total += n
+        self.peak_in_use = max(self.peak_in_use, self.num_used)
+        return got
+
+    def free(self, pages: Sequence[int]):
+        seen = set(self._free)
+        for p in pages:
+            if not (self.reserved <= p < self.num_pages):
+                raise ValueError(f"free of out-of-range page {p}")
+            if p in seen:
+                raise ValueError(f"double free of page {p}")
+            seen.add(p)
+        self._free.extend(pages)
+        self._free.sort(reverse=True)
+        self.frees_total += len(pages)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "num_pages": self.num_pages,
+            "num_used": self.num_used,
+            "num_free": self.num_free,
+            "utilization": self.utilization(),
+            "fragmentation": self.fragmentation(),
+            "allocs_total": self.allocs_total,
+            "frees_total": self.frees_total,
+            "alloc_failures": self.alloc_failures,
+            "defrags_total": self.defrags_total,
+            "peak_in_use": self.peak_in_use,
+        }
+
+
+class PagedKVCache:
+    """Device page pools + per-slot block tables + the allocator.
+
+    ``max_batch`` decode slots share one pool of ``num_pages`` pages of
+    ``page_size`` tokens. Block tables are host numpy (tiny; shipped to
+    the device each step as jitted-program arguments so shapes stay
+    static). The pool arrays live in ``self.pool`` — a dict with the
+    same keys as the dense cache (``k``/``v`` [+ ``ks``/``vs`` for the
+    int8 tier]) — and are REPLACED functionally by the jitted programs
+    (donated buffers update in place on device).
+    """
+
+    def __init__(self, cfg, max_batch: int, max_len: int,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 kv_dtype=None):
+        from ..models import generate as _gen
+        if max_len % page_size:
+            max_len = (max_len // page_size + 1) * page_size
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.page_size = page_size
+        self.pages_per_seq = max_len // page_size
+        if num_pages is None:
+            # worst case every slot runs a full-length request, +1 trash
+            num_pages = 1 + max_batch * self.pages_per_seq
+        self.num_pages = num_pages
+        self.kv_dtype = kv_dtype
+        self.pool = _gen.init_paged_cache(cfg, num_pages, page_size,
+                                          kv_dtype=kv_dtype)
+        self.allocator = BlockAllocator(num_pages)
+        # TRASH_PAGE-filled tables: unassigned entries route to trash
+        self.block_tables = np.full((max_batch, self.pages_per_seq),
+                                    TRASH_PAGE, np.int32)
+        self.lengths = np.zeros((max_batch,), np.int32)
+        self.active = np.zeros((max_batch,), bool)
+        self._slot_pages: List[List[int]] = [[] for _ in range(max_batch)]
+
+    # ---- slot lifecycle (host) ----
+    def pages_for(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.page_size)
+
+    def admit(self, slot: int, total_tokens: int) -> np.ndarray:
+        """Reserve pages for a request of ``total_tokens`` (prompt + new)
+        on ``slot``; returns the slot's block-table row. Raises
+        :class:`PoolExhausted` when the pool can't cover it."""
+        if self.active[slot]:
+            raise ValueError(f"slot {slot} already active")
+        n = self.pages_for(total_tokens)
+        if n > self.pages_per_seq:
+            raise ValueError(
+                f"request of {total_tokens} tokens needs {n} pages; the "
+                f"cache holds max_len={self.max_len} "
+                f"({self.pages_per_seq} pages) per request")
+        pages = self.allocator.alloc(n)
+        self._slot_pages[slot] = pages
+        self.block_tables[slot] = TRASH_PAGE
+        self.block_tables[slot, :n] = pages
+        self.active[slot] = True
+        return self.block_tables[slot]
+
+    def release(self, slot: int):
+        """Retire a request: recycle its pages into the free list."""
+        if self._slot_pages[slot]:
+            self.allocator.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.block_tables[slot] = TRASH_PAGE
+        self.lengths[slot] = 0
+        self.active[slot] = False
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.max_batch) if not self.active[i]]
+
+    def utilization(self) -> float:
+        return self.allocator.utilization()
+
+    def defrag(self):
+        """Compact used pages to the front of the pool: one device
+        gather rewrites each pool array, block tables are remapped on
+        the host, and the free list becomes the contiguous tail. Keeps
+        long-running servers' pools dense after many admit/retire
+        cycles (the allocator's ``fragmentation()`` stat measures the
+        holes this closes)."""
+        import jax.numpy as jnp
+        used = sorted({p for pages in self._slot_pages for p in pages})
+        remap = np.arange(self.num_pages, dtype=np.int32)
+        src = np.arange(self.num_pages, dtype=np.int32)
+        for new_id, old_id in enumerate(used, start=self.allocator.reserved):
+            remap[old_id] = new_id
+            src[new_id] = old_id
+        # unused destination slots keep pointing at SOME page (their
+        # contents are dead — nothing references them)
+        self.pool = {name: jnp.take(arr, jnp.asarray(src), axis=1)
+                     for name, arr in self.pool.items()}
+        self.block_tables = np.where(
+            self.block_tables == TRASH_PAGE, TRASH_PAGE,
+            remap[self.block_tables]).astype(np.int32)
+        self._slot_pages = [[int(remap[p]) for p in pages]
+                            for pages in self._slot_pages]
+        alloc = self.allocator
+        first_free = alloc.reserved + len(used)
+        alloc._free = list(range(self.num_pages - 1, first_free - 1, -1))
+        alloc.defrags_total += 1

@@ -99,8 +99,9 @@ def test_lastgood_survives_missing_prior(tmp_path, monkeypatch):
 def test_result_backfills_decode_from_lastgood(tmp_path, monkeypatch):
     """Driver-facing output: when the in-run decode extras died (null)
     but a standalone decode capture lives in the last-good record, the
-    emitted record carries the tiers — labeled via decode_source so a
-    carried number can't masquerade as a same-run measurement."""
+    emitted record carries the tiers — labeled PER TIER via
+    decode_source ({tier: "live"|"carried"}, ADVICE r5) so a carried
+    number can't masquerade as a same-run measurement."""
     bench = _load_bench()
     rec_path = tmp_path / "BENCH_LASTGOOD.json"
     monkeypatch.setattr(bench, "_LASTGOOD", str(rec_path))
@@ -111,8 +112,10 @@ def test_result_backfills_decode_from_lastgood(tmp_path, monkeypatch):
 
     rec = bench._backfill_decode(_tpu_parsed())
     assert rec["extra"]["decode_tokens_per_sec"] == 777.0
-    assert "carried from BENCH_LASTGOOD" in rec["extra"]["decode_source"]
-    assert "2026-08-01T09:00:00Z" in rec["extra"]["decode_source"]
+    assert rec["extra"]["decode_source"] == {
+        "decode_tokens_per_sec": "carried"}
+    assert "BENCH_LASTGOOD" in rec["extra"]["decode_carried_from"]
+    assert "2026-08-01T09:00:00Z" in rec["extra"]["decode_carried_from"]
 
     # same-run measurements are never overwritten or labeled
     fresh = _tpu_parsed(decode_tokens_per_sec=999.0)
@@ -125,6 +128,44 @@ def test_result_backfills_decode_from_lastgood(tmp_path, monkeypatch):
     cpu["extra"]["device"] = "cpu"
     out = bench._backfill_decode(cpu)
     assert out["extra"]["decode_tokens_per_sec"] is None
+
+
+def test_lastgood_mixed_provenance_labeled_per_tier(tmp_path,
+                                                    monkeypatch):
+    """A record that measured some tiers live while inheriting others
+    from the prior last-good must attribute EACH tier correctly —
+    the old blanket 'carried' string misattributed mixed records
+    (ADVICE r5)."""
+    bench = _load_bench()
+    rec_path = tmp_path / "BENCH_LASTGOOD.json"
+    monkeypatch.setattr(bench, "_LASTGOOD", str(rec_path))
+    seeded = _tpu_parsed()
+    seeded["extra"]["decode_int8_tokens_per_sec"] = 111.0
+    seeded["extra"]["decode_paged_tokens_per_sec"] = 222.0
+    rec_path.write_text(json.dumps(seeded))
+
+    fresh = _tpu_parsed(decode_tokens_per_sec=999.0)
+    bench._record_last_good(fresh)
+    out = json.loads(rec_path.read_text())
+    assert out["extra"]["decode_tokens_per_sec"] == 999.0
+    assert out["extra"]["decode_int8_tokens_per_sec"] == 111.0
+    assert out["extra"]["decode_paged_tokens_per_sec"] == 222.0
+    assert out["extra"]["decode_source"] == {
+        "decode_tokens_per_sec": "live",
+        "decode_int8_tokens_per_sec": "carried",
+        "decode_paged_tokens_per_sec": "carried"}
+    # a tier labeled carried at backfill time STAYS carried through a
+    # later last-good merge that carries something else
+    again = _tpu_parsed(decode_tokens_per_sec=999.0)
+    again["extra"]["decode_int4_tokens_per_sec"] = 333.0
+    again["extra"]["decode_source"] = {
+        "decode_tokens_per_sec": "live",
+        "decode_int4_tokens_per_sec": "carried"}
+    bench._record_last_good(again)
+    out = json.loads(rec_path.read_text())
+    assert out["extra"]["decode_source"]["decode_int4_tokens_per_sec"] \
+        == "carried"
+    assert out["extra"]["decode_source"]["decode_tokens_per_sec"] == "live"
 
 
 def test_lastgood_fresh_measurement_sheds_stale_carry_label(tmp_path,

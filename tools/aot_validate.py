@@ -325,7 +325,11 @@ def main():
     # all-reduce-promotion: XLA's CPU pass CHECK-crashes ("Invalid binary
     # instruction opcode copy", hlo_instruction.cc:1585) cloning some
     # GSPMD-inserted bf16 all-reduces in the interleave-schedule AD graph;
-    # bf16 all-reduces compile and run correctly on CPU without the pass
+    # bf16 all-reduces compile and run correctly on CPU without the pass.
+    # Companion workaround for the SAME bug: pp_spmd._psum_act upcasts
+    # the EXPLICIT activation psums to f32 on CPU meshes (GSPMD-inserted
+    # all-reduces never route through it, hence this flag) — see its
+    # docstring for the retirement order when upstream fixes the CHECK
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                         " --xla_disable_hlo_passes=all-reduce-promotion"
                         f" --xla_force_host_platform_device_count="

@@ -27,6 +27,12 @@ REQUIRED = {
     "paddle_tpu/inference/predictor.py": [
         ("_obs.predictor_run(", 1),
         ("_obs.active()", 1),
+        # continuous-batching engine hot path: block-pool utilization
+        # gauge + occupancy histogram (serving_step), admission and
+        # eviction counters — the serving dashboard's inputs
+        ("_obs.serving_step(", 1),
+        ("_obs.serving_admitted(", 1),
+        ("_obs.serving_retired(", 1),
     ],
     "paddle_tpu/models/generate.py": [
         ("_obs.generate_begin()", 1),

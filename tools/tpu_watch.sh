@@ -72,11 +72,31 @@ if dec.get("decode_tokens_per_sec") is not None:
     with open("BENCH_LASTGOOD.json") as f:
         lg = json.load(f)
     changed = False
-    for k in ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
-              "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec"):
-        if dec.get(k) is not None and \
-                lg.setdefault("extra", {}).get(k) != dec[k]:
+    for k in ("decode_tokens_per_sec", "decode_paged_tokens_per_sec",
+              "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
+              "decode_w8kv8_tokens_per_sec"):
+        if dec.get(k) is None:
+            continue
+        if lg.setdefault("extra", {}).get(k) != dec[k]:
             lg["extra"][k] = dec[k]
+            changed = True
+        # this tier was just MEASURED: shed any stale carried label even
+        # when the value repeats exactly (2-decimal rounding collides).
+        # A pre-PR2 blanket string label migrates to the dict form
+        # first, seeded with "carried" for every tier it covered — an
+        # empty-dict migration would relabel still-carried tiers live.
+        src = lg["extra"].get("decode_source")
+        if src is not None and not isinstance(src, dict):
+            src = lg["extra"]["decode_source"] = {
+                t: "carried" for t in (
+                    "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
+                    "decode_int8_tokens_per_sec",
+                    "decode_int4_tokens_per_sec",
+                    "decode_w8kv8_tokens_per_sec")
+                if lg["extra"].get(t) is not None}
+            changed = True
+        if isinstance(src, dict) and src.get(k) != "live":
+            src[k] = "live"
             changed = True
     if changed:
         lg["extra"]["decode_recorded_at"] = time.strftime(
