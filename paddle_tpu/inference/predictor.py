@@ -365,16 +365,30 @@ class ContinuousBatchingEngine:
     recycle — so short requests stop pad-burning the long ones' HBM and
     decode throughput at mixed request lengths rises with occupancy.
 
+    Prefill is CHUNKED: an admission's prompt advances by at most one
+    fixed-size chunk (``prefill_chunk`` tokens, page-rounded; default
+    unbounded = one chunk) per engine step, interleaved with the decode
+    program — so a 4k-token admission adds one chunk's latency per step
+    to the in-flight decodes instead of stalling them for a monolithic
+    prefill. And prefill is PREFIX-CACHED: the paged cache's hash-trie
+    maps previously prefilled prompt pages (shared system prompts,
+    few-shot headers) straight into the new request's block table —
+    refcounted, copy-on-write on the first partial page — so the shared
+    span costs neither prefill FLOPs nor fresh KV HBM.
+
     Admission control is page-pool back-pressure: a request is admitted
-    only when the allocator can cover ``prompt + max_new_tokens``; a
+    only when the allocator can cover ``prompt + max_new_tokens``
+    (prefix-cache-held pages are evicted LRU-first under pressure); a
     :class:`~paddle_tpu.serving.PoolExhausted` defers it until running
     requests retire (OOM-free by construction).
 
     Sampling: greedy at ``temperature == 0`` (token-identical to the
-    dense :func:`~paddle_tpu.models.generate.generate`), else
-    temperature sampling with a per-step PRNG fold.
+    dense :func:`~paddle_tpu.models.generate.generate` — chunking and
+    prefix sharing are bit-exact, not approximate), else temperature
+    sampling with a per-step PRNG fold.
 
     Telemetry (paddle_tpu.observability): admission/eviction counters,
+    prefix hit/miss token counters, per-chunk prefill latency histogram,
     per-step batch-occupancy histogram, block-pool utilization gauge —
     zero-cost when metrics are disabled.
     """
@@ -384,7 +398,9 @@ class ContinuousBatchingEngine:
                  num_pages: Optional[int] = None, kv_cache_dtype=None,
                  temperature: float = 0.0, eos_token_id=None,
                  use_kernel: Optional[bool] = None,
-                 key: Optional[jax.Array] = None):
+                 key: Optional[jax.Array] = None,
+                 prefill_chunk: Optional[int] = None,
+                 enable_prefix_cache: bool = True):
         from ..serving import PagedKVCache
         self.params = params
         self.cfg = cfg
@@ -394,7 +410,14 @@ class ContinuousBatchingEngine:
         self.cache = PagedKVCache(
             cfg, max_batch, max_len or cfg.max_seq_len,
             page_size=page_size, num_pages=num_pages,
-            kv_dtype=kv_cache_dtype)
+            kv_dtype=kv_cache_dtype,
+            enable_prefix_cache=enable_prefix_cache)
+        if prefill_chunk is not None:
+            # page-rounded so chunk boundaries stay page-aligned (the
+            # chunk program's static ctx_cap) and >= one page
+            prefill_chunk = self.cache.pages_for(
+                max(1, int(prefill_chunk))) * self.cache.page_size
+        self.prefill_chunk = prefill_chunk
         self.max_batch = max_batch
         self._key = key if key is not None else jax.random.key(0)
         self._queue: List[GenerationRequest] = []
@@ -403,7 +426,9 @@ class ContinuousBatchingEngine:
         self._next_rid = 0
         self._steps = 0
         self._decode_fn = None
-        self._prefill_fns: Dict[int, object] = {}
+        # slot -> [request, tokens already in pages (shared + chunks)]
+        self._pending: Dict[int, List] = {}
+        self._chunk_fns: Dict[tuple, object] = {}
 
     # ---- request intake ----
     def submit(self, prompt, max_new_tokens: int = 16,
@@ -454,22 +479,25 @@ class ContinuousBatchingEngine:
             self._decode_fn = jax.jit(f, donate_argnums=(2,))
         return self._decode_fn
 
-    def _prefill(self, s_pad: int):
-        """One compiled prefill program per PAGE-BUCKETED prompt width
-        (prompts are left-padded to page multiples before prefill), so
-        a long-lived server compiles at most ``pages_per_seq`` variants
-        instead of one per distinct prompt length."""
-        if s_pad not in self._prefill_fns:
+    def _chunk_fn(self, ctx_cap: int, width: int):
+        """One compiled chunked-prefill program per static ``(context
+        cap, chunk width)`` pair. ``ctx_cap`` is power-of-two-bucketed
+        and ``width`` page-bucketed (capped at ``prefill_chunk``), so a
+        long-lived server compiles O(width_buckets x log(pages_per_seq))
+        variants — not one per distinct prompt or shared-prefix
+        length."""
+        key = (ctx_cap, width)
+        if key not in self._chunk_fns:
             from ..models import generate as gen
             cfg = self.cfg
 
-            def f(params, prompt, paged, table, prompt_len):
-                return gen.paged_prefill_insert(
-                    params, prompt, paged, table, cfg,
-                    prompt_len=prompt_len)
+            def f(params, chunk, paged, table, ctx_len, chunk_len):
+                return gen.paged_prefill_chunk(
+                    params, chunk, paged, table, cfg, ctx_cap=ctx_cap,
+                    ctx_len=ctx_len, chunk_len=chunk_len)
 
-            self._prefill_fns[s_pad] = jax.jit(f, donate_argnums=(2,))
-        return self._prefill_fns[s_pad]
+            self._chunk_fns[key] = jax.jit(f, donate_argnums=(2,))
+        return self._chunk_fns[key]
 
     # ---- scheduling ----
     def _sample_first(self, logits) -> int:
@@ -482,7 +510,10 @@ class ContinuousBatchingEngine:
     def _admit(self):
         """Fill free slots from the queue (FIFO; a head-of-line request
         the pool can't cover yet blocks admission — fairness over
-        utilization)."""
+        utilization). Admission only RESERVES pages (mapping any
+        trie-shared prefix span into the block table); the prompt's
+        remaining tokens prefill chunk-by-chunk in :meth:`_prefill_step`
+        so one long admission cannot stall the in-flight decodes."""
         from ..serving import PoolExhausted
         cache = self.cache
         for slot in cache.free_slots():
@@ -491,25 +522,72 @@ class ContinuousBatchingEngine:
             req = self._queue[0]
             S = req.prompt.shape[1]
             try:
-                table = cache.admit(slot, S + req.max_new_tokens)
+                _, shared = cache.admit_prompt(
+                    slot, req.prompt[0], S + req.max_new_tokens)
             except PoolExhausted:
                 if not cache.active.any():
                     raise  # nothing running will ever free pages
                 break
             self._queue.pop(0)
             req.slot = slot
-            s_pad = cache.pages_for(S) * cache.page_size
-            padded = np.zeros((1, s_pad), np.int32)
-            padded[0, s_pad - S:] = req.prompt[0]
-            logits, cache.pool = self._prefill(s_pad)(
-                self.params, jnp.asarray(padded), cache.pool,
-                jnp.asarray(table), jnp.int32(S))
-            first = self._sample_first(logits)
-            cache.lengths[slot] = S
-            self._last[slot] = first
             self._slots[slot] = req
-            self._record_token(req, first)
+            self._pending[slot] = [req, int(shared)]
+            # full prompt size here — the prefix hit/miss split is the
+            # serving_prefix pair's job, and the chunk-token counter
+            # already measures tokens actually forwarded
             _obs.serving_admitted(1, S)
+            _obs.serving_prefix(int(shared), S - int(shared))
+
+    def _prefill_step(self):
+        """Advance chunked prefill by ONE static-shape chunk (the
+        oldest pending admission, FIFO): the per-step latency added to
+        in-flight decodes is bounded by one chunk's forward instead of
+        a whole prompt's. The final chunk's logits (taken at the last
+        VALID token) seed sampling, and the completed prompt's pages
+        are published to the prefix trie for future admissions."""
+        if not self._pending:
+            return
+        cache = self.cache
+        slot = min(self._pending, key=lambda s: self._pending[s][0].rid)
+        req, done = self._pending[slot]
+        S = req.prompt.shape[1]
+        page = cache.page_size
+        remaining = S - done
+        width = cache.pages_for(remaining) * page
+        if self.prefill_chunk is not None:
+            width = min(width, self.prefill_chunk)
+        take = min(remaining, width)
+        # ctx_cap buckets UP to a power-of-two page count so the
+        # (ctx_cap, width) compile-key space stays O(width_buckets *
+        # log(pages_per_seq)) instead of quadratic in pages_per_seq —
+        # shared-prefix lengths and prompt lengths vary independently
+        # across requests. The extra gathered rows beyond ctx_len are
+        # masked (kstart), so bucketing is parity-free.
+        ctx_pages = cache.pages_for(done)
+        if ctx_pages:
+            p2 = 1
+            while p2 < ctx_pages:
+                p2 *= 2
+            ctx_pages = min(p2, cache.pages_per_seq)
+        ctx_cap = ctx_pages * page
+        chunk = np.zeros((1, width), np.int32)
+        chunk[0, :take] = req.prompt[0, done:done + take]
+        t0 = _obs.generate_begin()
+        logits, cache.pool = self._chunk_fn(ctx_cap, width)(
+            self.params, jnp.asarray(chunk), cache.pool,
+            jnp.asarray(cache.block_tables[slot]), jnp.int32(done),
+            jnp.int32(take))
+        _obs.serving_prefill_chunk(t0, logits, take)
+        done += take
+        if done < S:
+            self._pending[slot][1] = done
+            return
+        del self._pending[slot]
+        cache.register_prefix(slot, req.prompt[0])
+        first = self._sample_first(logits)
+        cache.lengths[slot] = S
+        self._last[slot] = first
+        self._record_token(req, first)
 
     def _record_token(self, req: GenerationRequest, tok: int):
         req.tokens.append(int(tok))
@@ -526,22 +604,30 @@ class ContinuousBatchingEngine:
         _obs.serving_retired(1, reason)
 
     def step(self) -> bool:
-        """Admit, then advance every active slot one token. Returns
-        False when no work remains (queue empty, all slots idle)."""
+        """Admit, advance chunked prefill by one chunk, then advance
+        every fully prefilled slot one decode token. Returns False when
+        no work remains (queue empty, all slots idle)."""
         self._admit()
+        self._prefill_step()
         cache = self.cache
-        if not cache.active.any():
-            return bool(self._queue)
+        # decode only slots whose prompt is fully in the pool; slots
+        # mid-prefill hold pages (active) but skip the decode program
+        ready = cache.active.copy()
+        for s in self._pending:
+            ready[s] = False
+        if not ready.any():
+            return bool(self._queue or self._pending
+                        or cache.active.any())
         self._key, k = jax.random.split(self._key)
         nxt, cache.pool = self._decode()(
             self.params, jnp.asarray(self._last), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths),
-            jnp.asarray(cache.active), k)
+            jnp.asarray(ready), k)
         nxt = np.asarray(nxt)
-        n_active = int(cache.active.sum())
+        n_active = int(ready.sum())
         for slot, req in enumerate(self._slots):
-            if req is None or not cache.active[slot]:
+            if req is None or not ready[slot]:
                 continue
             cache.lengths[slot] += 1
             self._last[slot] = nxt[slot]
@@ -549,7 +635,7 @@ class ContinuousBatchingEngine:
         self._steps += 1
         alloc = cache.allocator
         _obs.serving_step(n_active, self.max_batch, alloc.num_used,
-                          alloc.num_pages - alloc.reserved)
+                          alloc.num_usable)
         return bool(self._queue) or bool(cache.active.any())
 
     def run(self) -> None:
@@ -570,4 +656,9 @@ class ContinuousBatchingEngine:
         s["steps"] = self._steps
         s["queued"] = len(self._queue)
         s["active_slots"] = int(self.cache.active.sum())
+        s["pending_prefills"] = len(self._pending)
+        s["cow_copies"] = self.cache.cow_copies
+        if self.cache.prefix is not None:
+            s["prefix_evictions_total"] = \
+                self.cache.prefix.evictions_total
         return s

@@ -160,6 +160,83 @@ def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
     return logits, out
 
 
+def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
+                        block_table: jax.Array, cfg: LlamaConfig, *,
+                        ctx_cap: int, ctx_len, chunk_len):
+    """Prefill ONE chunk of a request's prompt against the KV already in
+    its pages — the chunked-prefill / prefix-cache continuation program
+    (one compile per static ``(ctx_cap, C)`` pair; the engine buckets
+    ``ctx_cap`` to power-of-two page counts and ``C`` to page multiples,
+    bounding a long-lived server's compile count independent of prompt
+    or shared-prefix lengths).
+
+    tokens:      (1, C) int32 chunk, RIGHT-padded past ``chunk_len``
+    paged:       :func:`init_paged_cache` pools (int8 tier included)
+    block_table: (ppseq,) int32 — the slot's page ids, logical order
+    ctx_cap:     STATIC page multiple >= ctx_len (``ceil(ctx/page) *
+                 page``) — the gathered-context width / compile key
+    ctx_len:     TRACED true token count already in the slot's pages
+                 (shared prefix + previous chunks; any value, so
+                 copy-on-write partial-page shares need no realignment)
+    chunk_len:   TRACED valid tokens in this chunk
+    returns (logits (1, V) at the chunk's LAST VALID token, updated
+    pools).
+
+    Layout: the slot's first ``ctx_len`` cached rows are gathered from
+    its pages and RIGHT-ALIGNED into a ``(1, ctx_cap + C)`` dense temp
+    cache (garbage below masked via the same ``kstart``/``rpos``
+    machinery as left-padded ragged prompts), the chunk forwards at
+    temp positions ``[ctx_cap, ctx_cap + C)`` with logical rope
+    positions ``ctx_len + i``, and the new rows scatter into the slot's
+    pages (pad rows route to the trash page). Chunk rows see exactly
+    the KV a monolithic prefill's rows ``[ctx_len, ctx_len + chunk)``
+    would see — cached rows are bit-identical and masked columns
+    contribute exact zeros — so chunked + prefix-shared prefill stays
+    TOKEN-IDENTICAL to the dense path."""
+    B, C = tokens.shape
+    if B != 1:
+        raise ValueError(
+            f"paged_prefill_chunk: one request at a time (got batch {B})")
+    page = paged["k"].shape[2]
+    if ctx_cap % page:
+        raise ValueError(
+            f"paged_prefill_chunk: ctx_cap={ctx_cap} must be a multiple "
+            f"of the page size {page}")
+    ext = block_table.shape[0] * page
+    quant = "ks" in paged
+    W = ctx_cap + C
+    ctx_len = jnp.asarray(ctx_len, jnp.int32).reshape(())
+    chunk_len = jnp.asarray(chunk_len, jnp.int32).reshape(())
+    pad = ctx_cap - ctx_len                       # garbage rows below
+    dense = init_cache(cfg, 1, W, kv_dtype="int8" if quant else None)
+    if ctx_cap:
+        ppc = ctx_cap // page
+        ctx_tbl = block_table[:ppc]
+        srows = jnp.clip(jnp.arange(ctx_cap, dtype=jnp.int32) - pad,
+                         0, ctx_cap - 1)
+        for name in paged:
+            g = jnp.take(paged[name], ctx_tbl, axis=1)  # (L, ppc, pg, .)
+            g = g.reshape((g.shape[0], ppc * page) + g.shape[3:])
+            g = jnp.take(g, srows, axis=1)              # right-aligned
+            dense[name] = dense[name].at[:, 0, :ctx_cap].set(
+                g.astype(dense[name].dtype))
+    kstart = pad[None]                                  # (1,)
+    rpos = (ctx_len + jnp.arange(C, dtype=jnp.int32))[None, :]
+    logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
+                                    W, rpos=rpos, kstart=kstart,
+                                    logits_at=chunk_len - 1)
+    pos = jnp.arange(C, dtype=jnp.int32)
+    logical = jnp.clip(ctx_len + pos, 0, ext - 1)
+    dst = jnp.where(pos < chunk_len,
+                    block_table[logical // page] * page + logical % page,
+                    0)
+    out = {}
+    for name in paged:
+        rows = dense[name][:, 0, ctx_cap:]              # (L, C, ...)
+        out[name] = _scatter_rows(paged[name], dst, rows)
+    return logits, out
+
+
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, active=None,
@@ -456,9 +533,12 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
-                    kstart=None):
+                    kstart=None, logits_at=None):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
-    (B, V), updated cache)."""
+    (B, V), updated cache). ``logits_at``: optional TRACED row index
+    into ``tokens`` — logits are taken there instead of at row T-1
+    (chunked prefill right-pads the final chunk, so the last VALID
+    token is not the last row)."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
     quant = "ks" in cache
@@ -482,6 +562,10 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
     new_cache = ({"k": new[0], "v": new[1], "ks": new[2], "vs": new[3]}
                  if quant else {"k": new[0], "v": new[1]})
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if logits_at is not None:
+        idx = jnp.clip(jnp.asarray(logits_at, jnp.int32).reshape(()),
+                       0, x.shape[1] - 1)
+        x = lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
     if cfg.tie_embeddings:
         head = params["embed"].T.astype(x.dtype)
     else:

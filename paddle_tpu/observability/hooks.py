@@ -178,6 +178,43 @@ def serving_admitted(n: int, prompt_tokens: int):
                ).inc(prompt_tokens)
 
 
+def serving_prefix(hit_tokens: int, miss_tokens: int):
+    """One admission's prefix-cache outcome: ``hit`` tokens were mapped
+    from already-prefilled shared pages (zero prefill FLOPs, zero fresh
+    KV HBM), ``miss`` tokens go through chunked prefill. The ratio is
+    the live prefix-cache hit rate — the multiplier on the
+    shared-system-prompt serving win."""
+    if not enabled:
+        return
+    _m.counter("serving_prefix_hit_tokens_total",
+               "prompt tokens served from shared prefix pages"
+               ).inc(hit_tokens)
+    _m.counter("serving_prefix_miss_tokens_total",
+               "prompt tokens that required fresh prefill"
+               ).inc(miss_tokens)
+
+
+def serving_prefill_chunk(t0_ns: int, out, tokens: int):
+    """Close one chunked-prefill step opened at ``t0_ns`` (a
+    :func:`generate_begin` anchor): fence ``out``, feed the per-chunk
+    latency histogram — the engine's per-step latency bound — plus the
+    chunk-size counter."""
+    if not t0_ns:
+        return
+    _block(out)
+    now = time.perf_counter_ns()
+    _record("Serving.prefill_chunk", t0_ns, now, "Forward")
+    if enabled:
+        _m.histogram("serving_prefill_chunk_ms",
+                     "wall milliseconds per chunked-prefill step",
+                     buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
+                              500, 1000, 2500)).observe(
+            (now - t0_ns) / 1e6)
+        _m.counter("serving_prefill_chunk_tokens_total",
+                   "prompt tokens prefilled via chunked prefill"
+                   ).inc(tokens)
+
+
 def serving_retired(n: int, reason: str):
     """A request left its slot and recycled its pages; ``reason`` is
     ``eos`` / ``length`` / ``evicted``."""

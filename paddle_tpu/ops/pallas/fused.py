@@ -287,20 +287,28 @@ def rope_qk(q, k, cos, sin, block_seq: int = 256):
 
 # ---------------- decode-time block attention (KV cache) ----------------
 def _decode_softmax_step(q, k, v, cache_len, o_ref, acc, m_sc, l_sc,
-                         *, scale, block_k, k_scale=None, v_scale=None):
+                         *, scale, block_k, k_scale=None, v_scale=None,
+                         num_valid=None):
     """Shared online-softmax step for the decode kernels (contiguous and
     paged): one (H_rep, D) query block against one (block_k, D) K/V block
     at sequence offset ki*block_k, masked by cache_len.
 
     ``k_scale``/``v_scale``: optional per-row DEQUANT scalars for int8
     pages (the cachekv-int8 tier) — dequantization happens here in VMEM,
-    so the HBM reads stay 1 byte/element."""
-    if k_scale is not None:
-        k = (k.astype(jnp.float32) * k_scale).astype(q.dtype)
-    if v_scale is not None:
-        v = (v.astype(jnp.float32) * v_scale).astype(q.dtype)
+    so the HBM reads stay 1 byte/element.
+
+    ``num_valid``: optional traced count of LIVE column blocks for this
+    grid row (the ragged paged grid: ``ceil(cache_len / block_k)``).
+    Blocks past it are fully masked — their contribution is an exact
+    no-op (p == 0, alpha == 1) — so the step early-outs: compute is
+    skipped under ``pl.when`` and the output is finalized at the row's
+    OWN last live block instead of the grid extent. The caller's index
+    map must clamp exhausted iterations to a previously fetched block so
+    no DMA is issued for them (Ragged Paged Attention, arxiv
+    2604.15464). ``None`` keeps the dense behavior: every block live,
+    finalize at ``num_programs(1) - 1``."""
     ki = pl.program_id(1)
-    nk = pl.num_programs(1)
+    last = (pl.num_programs(1) if num_valid is None else num_valid) - 1
 
     @pl.when(ki == 0)
     def _init():
@@ -308,29 +316,41 @@ def _decode_softmax_step(q, k, v, cache_len, o_ref, acc, m_sc, l_sc,
         m_sc[...] = jnp.full_like(m_sc, -jnp.inf)
         l_sc[...] = jnp.zeros_like(l_sc)
 
-    # zero possibly-padded cache rows: 0 * NaN would poison the p @ v sum
-    vrows = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
-    v = jnp.where(vrows < cache_len, v, jnp.zeros_like(v))
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale       # (H_rep, bk)
-    cols = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, s.shape, 1)
-    s = jnp.where(cols < cache_len, s, _fa.DEFAULT_MASK_VALUE)
-    m_prev = m_sc[...]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, :1])
-    p = jnp.where(cols < cache_len, p, 0.0)
-    l_sc[...] = alpha * l_sc[...] + jnp.broadcast_to(
-        jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
-    acc[...] = acc[...] * alpha[:, :1] + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_sc[...] = m_new
+    def _accum():
+        kk, vv = k, v
+        if k_scale is not None:
+            kk = (kk.astype(jnp.float32) * k_scale).astype(q.dtype)
+        if v_scale is not None:
+            vv = (vv.astype(jnp.float32) * v_scale).astype(q.dtype)
+        # zero possibly-padded cache rows: 0 * NaN would poison p @ v
+        vrows = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, vv.shape, 0)
+        vv = jnp.where(vrows < cache_len, vv, jnp.zeros_like(vv))
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (H_rep, bk)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < cache_len, s, _fa.DEFAULT_MASK_VALUE)
+        m_prev = m_sc[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        p = jnp.where(cols < cache_len, p, 0.0)
+        l_sc[...] = alpha * l_sc[...] + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
+        acc[...] = acc[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = m_new
 
-    @pl.when(ki == nk - 1)
+    if num_valid is None:
+        _accum()
+    else:
+        pl.when(ki <= last)(_accum)
+
+    @pl.when(ki == last)
     def _done():
         l = l_sc[:, :1]
         o_ref[0] = (acc[...] / jnp.where(l == 0.0, 1.0, l)).astype(

@@ -12,9 +12,13 @@ Two implementations with IDENTICAL semantics:
 - :func:`paged_attention_kernel` — Pallas TPU kernel: the block table
   feeds the K/V BlockSpec index maps via scalar prefetch, so the page
   gather happens in the memory pipeline (no materialized contiguous
-  copy). int8 pages carry PER-ROW dequant scales (the cachekv-int8 tier
-  of the dense path) and dequantize in VMEM — HBM reads stay
-  1 byte/element.
+  copy). The grid is RAGGED: a second scalar-prefetched vector of
+  per-row page counts clamps the index maps (no DMA past a row's last
+  live page) and early-outs the softmax step, so a mixed-length batch
+  pays ``Σ ceil(len_i/page)`` pages of attention work instead of
+  ``B * ppseq``. int8 pages carry PER-ROW dequant scales (the
+  cachekv-int8 tier of the dense path) and dequantize in VMEM — HBM
+  reads stay 1 byte/element.
 - :func:`paged_attention_reference` — pure ``lax`` gather + the exact
   attention composition of ``models/generate._attn_with_cache`` (same
   einsums, f32 accumulation, -1e30 masking), so tier-1 CPU tests
@@ -101,37 +105,55 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,
     return o[:, 0]                                 # (B, H, D)
 
 
-# ---------------- Pallas kernel (per-row-scale int8 tier) ----------------
-def _paged_kernel(bt_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
+# ------------- Pallas RAGGED kernel (per-row-scale int8 tier) -------------
+#
+# The grid's column extent is the SLOT extent (ppseq pages — static
+# shapes), but per-row work is LENGTH-PROPORTIONAL (Ragged Paged
+# Attention, arxiv 2604.15464): a scalar-prefetched per-row page count
+# drives (a) the K/V index maps, which CLAMP exhausted iterations to the
+# row's last live page — the pipeline sees an unchanged block index and
+# issues no new DMA — and (b) an early-out in the softmax step, which
+# skips the dots and finalizes the output at the row's own last page.
+# A mixed-length batch therefore streams Σ ceil(len_i/page) pages of KV
+# instead of B * ppseq.
+
+def _paged_kernel(bt_ref, cnt_ref, q_ref, k_ref, v_ref, len_ref, o_ref,
                   acc, m_sc, l_sc, *, scale, page):
     """One (rep, D) query block vs one page of K/V; pages arrive via the
     scalar-prefetched block-table index maps, so grid column j IS logical
-    page j of this request (online-softmax offset j*page). len_ref is the
-    whole (B*HK,) SMEM vector (Mosaic rank-1 block rule)."""
+    page j of this request (online-softmax offset j*page) while j is
+    live; cnt_ref (the per-row page count) early-outs the rest. len_ref
+    is the whole (B*HK,) SMEM vector (Mosaic rank-1 block rule)."""
+    i = pl.program_id(0)
     _fused._decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0],
-                                len_ref[pl.program_id(0)],
+                                len_ref[i],
                                 o_ref, acc, m_sc, l_sc, scale=scale,
-                                block_k=page)
+                                block_k=page, num_valid=cnt_ref[i])
 
 
-def _paged_kernel_rowq(bt_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
-                       len_ref, o_ref, acc, m_sc, l_sc, *, scale, page):
+def _paged_kernel_rowq(bt_ref, cnt_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, len_ref, o_ref, acc, m_sc, l_sc, *,
+                       scale, page):
     """int8-page variant: PER-ROW dequant scales ride (1, 1, page, 1)
     VMEM blocks gathered by the same block-table index map as K/V, so
     each cached token row dequantizes with its own scale in VMEM (the
     self-calibrating cachekv-int8 tier of the dense decode kernel)."""
+    i = pl.program_id(0)
     _fused._decode_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0],
-                                len_ref[pl.program_id(0)],
+                                len_ref[i],
                                 o_ref, acc, m_sc, l_sc, scale=scale,
                                 block_k=page, k_scale=ks_ref[0, 0],
-                                v_scale=vs_ref[0, 0])
+                                v_scale=vs_ref[0, 0],
+                                num_valid=cnt_ref[i])
 
 
 def paged_attention_kernel(q, k_pages, v_pages, block_tables, lengths, *,
                            scale=None, ks_pages=None, vs_pages=None):
-    """Pallas paged decode attention; same contract as
+    """Pallas ragged paged decode attention; same contract (and the same
+    results, bit for bit — masked pages were exact no-ops) as
     :func:`paged_attention_reference` (pool layout (P, page, HK, D),
-    per-row int8 scales (P, page, HK))."""
+    per-row int8 scales (P, page, HK)), but per-row attention work is
+    sized by ``ceil(length/page)`` instead of the slot extent."""
     if not _PALLAS_OK:
         raise RuntimeError(
             "paged_attention_kernel: jax.experimental.pallas is "
@@ -152,6 +174,10 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables, lengths, *,
     qt = q.reshape(B, HK, rep, D).reshape(B * HK, rep, D)
     lens = jnp.repeat(lengths, HK)
     bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)  # clamp -1
+    # per-ROW live page counts (broadcast over the HK grid rows of each
+    # request); >= 1 so every row finalizes its output block
+    cnt = jnp.clip(-(-lengths // page), 1, ppseq).astype(jnp.int32)
+    cnt = jnp.repeat(cnt, HK)
 
     if (ks_pages is None) != (vs_pages is None):
         raise ValueError(
@@ -159,37 +185,49 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables, lengths, *,
             "together — int8 pools quantize both K and V")
     quant = ks_pages is not None
 
+    def _page_idx(i, j, bt_, cnt_):
+        # clamp exhausted iterations to the row's LAST live page: the
+        # block index is unchanged vs the previous iteration, so the
+        # pipeline skips the copy — the ragged grid's DMA early-out
+        return bt_[i // HK, jnp.minimum(j, cnt_[i] - 1)]
+
     in_specs = [
-        pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
+        pl.BlockSpec((1, rep, D), lambda i, j, bt_, cnt_: (i, 0, 0)),
         pl.BlockSpec((1, 1, page, D),
-                     lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+                     lambda i, j, bt_, cnt_:
+                     (i % HK, _page_idx(i, j, bt_, cnt_), 0, 0)),
         pl.BlockSpec((1, 1, page, D),
-                     lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+                     lambda i, j, bt_, cnt_:
+                     (i % HK, _page_idx(i, j, bt_, cnt_), 0, 0)),
     ]
-    inputs = [bt, qt, kp, vp]
+    inputs = [bt, cnt, qt, kp, vp]
     if quant:
         def _scl(sc):   # (P, page, HK) -> (HK, P, page, 1)
             return jnp.asarray(sc, jnp.float32).transpose(
                 2, 0, 1).reshape(HK, P, page, 1)
         in_specs += [
             pl.BlockSpec((1, 1, page, 1),
-                         lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+                         lambda i, j, bt_, cnt_:
+                         (i % HK, _page_idx(i, j, bt_, cnt_), 0, 0)),
             pl.BlockSpec((1, 1, page, 1),
-                         lambda i, j, bt_: (i % HK, bt_[i // HK, j], 0, 0)),
+                         lambda i, j, bt_, cnt_:
+                         (i % HK, _page_idx(i, j, bt_, cnt_), 0, 0)),
         ]
         inputs += [_scl(ks_pages), _scl(vs_pages)]
         kernel = functools.partial(_paged_kernel_rowq, scale=s, page=page)
     else:
         kernel = functools.partial(_paged_kernel, scale=s, page=page)
     in_specs.append(pl.BlockSpec(
-        (B * HK,), lambda i, j, bt_: (0,), memory_space=pltpu.SMEM))
+        (B * HK,), lambda i, j, bt_, cnt_: (0,),
+        memory_space=pltpu.SMEM))
     inputs.append(lens)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(B * HK, ppseq),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, rep, D), lambda i, j, bt_: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, rep, D),
+                               lambda i, j, bt_, cnt_: (i, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((rep, D), jnp.float32),
             pltpu.VMEM((rep, 128), jnp.float32),

@@ -10,5 +10,5 @@
   (:class:`~paddle_tpu.inference.ContinuousBatchingEngine`).
 """
 from .paged_cache import (  # noqa: F401
-    TRASH_PAGE, BlockAllocator, PagedKVCache, PoolExhausted,
+    TRASH_PAGE, BlockAllocator, PagedKVCache, PoolExhausted, PrefixCache,
 )

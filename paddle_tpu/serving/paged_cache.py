@@ -1,4 +1,5 @@
-"""Paged KV-cache subsystem: global page pools + host-side allocator.
+"""Paged KV-cache subsystem: global page pools + host-side allocator
+with REFCOUNTED pages and a shared-prefix page cache.
 
 Serving memory layout (reference: the block_multi_head_attention tier of
 the serving stack; TPU-native design: Ragged Paged Attention, arxiv
@@ -10,8 +11,19 @@ flight instead of ``batch * longest_request``, which is what lets the
 continuous-batching engine (inference/predictor.py) admit short requests
 into the headroom long ones would otherwise pad-burn.
 
-Everything here is HOST-side bookkeeping (free lists, stats, tables);
-the device-side pool arrays are built by
+Pages are REFCOUNTED (vLLM-style copy-on-write sharing): a page lives in
+more than one block table when requests share a prompt prefix, and it
+returns to the free list only when its last reference drops. The
+:class:`PrefixCache` hash-trie maps chains of FULL prompt pages (plus
+one partial-page tail donor per chain) to the page ids that already hold
+their KV, so an admission with a shared prefix maps existing pages into
+its table instead of re-prefilling them — skipping both the prefill
+FLOPs and the KV HBM for the shared span. The first PARTIAL page of a
+shared span is copy-on-write: decode will append into it, so its shared
+rows are device-copied into a privately owned page.
+
+Everything here is HOST-side bookkeeping (free lists, refcounts, tries,
+stats, tables); the device-side pool arrays are built by
 ``models/generate.init_paged_cache`` and updated functionally inside the
 jitted prefill/decode programs. Page id 0 is RESERVED as the trash page:
 the single jitted ragged-decode program runs every slot each step with
@@ -20,7 +32,7 @@ KV writes there instead of clobbering live pages.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -37,10 +49,15 @@ class PoolExhausted(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side slot allocator over the global page pool.
+    """Host-side slot allocator over the global page pool, refcounted.
 
-    Tracks a free list plus alloc/free/defrag stats. Page ids start at
-    ``reserved`` (default 1 — page 0 is the trash page)."""
+    Tracks a free list, per-page reference counts, and
+    alloc/share/free/defrag stats. Page ids start at ``reserved``
+    (default 1 — page 0 is the trash page). ``alloc`` hands out pages at
+    refcount 1; ``share`` takes an additional reference on a live page
+    (prefix sharing); ``free`` drops one reference and recycles the page
+    only at zero — so ``allocs_total == frees_total`` at full teardown
+    (every reference, allocated or shared, is dropped exactly once)."""
 
     def __init__(self, num_pages: int, reserved: int = 1):
         if num_pages <= reserved:
@@ -52,11 +69,21 @@ class BlockAllocator:
         # descending storage so list.pop() hands out ascending ids
         # (deterministic placement; tests rely on it)
         self._free: List[int] = list(range(num_pages - 1, reserved - 1, -1))
+        self._refcount = np.zeros((num_pages,), np.int32)
         self.allocs_total = 0
         self.frees_total = 0
+        self.shares_total = 0
         self.alloc_failures = 0
         self.defrags_total = 0
         self.peak_in_use = 0
+
+    @property
+    def num_usable(self) -> int:
+        """Pages the allocator can ever hand out (pool minus reserved) —
+        the consistent denominator for ``num_free``/``num_used``/
+        ``utilization`` (the raw ``num_pages`` includes the trash page,
+        which is neither free nor used)."""
+        return self.num_pages - self.reserved
 
     @property
     def num_free(self) -> int:
@@ -64,15 +91,26 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
-        return (self.num_pages - self.reserved) - len(self._free)
+        return self.num_usable - len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently referenced more than once (prefix sharing)."""
+        return int((self._refcount > 1).sum())
+
+    def refcount(self, page: int) -> int:
+        return int(self._refcount[page])
 
     def utilization(self) -> float:
-        total = self.num_pages - self.reserved
+        total = self.num_usable
         return self.num_used / total if total else 0.0
 
     def fragmentation(self) -> float:
         """Fraction of free pages sitting BELOW the highest used page —
-        holes a compaction (:meth:`PagedKVCache.defrag`) would close."""
+        holes a compaction (:meth:`PagedKVCache.defrag`) would close.
+        Shared (refcount>1) pages count as used like any other live
+        page: they are movable (defrag remaps every table and the
+        prefix trie atomically), so holes below them are closable."""
         if not self._free or self.num_used == 0:
             return 0.0
         free = set(self._free)
@@ -82,43 +120,259 @@ class BlockAllocator:
         return holes / len(self._free)
 
     def alloc(self, n: int) -> List[int]:
-        """Hand out ``n`` pages; raises :class:`PoolExhausted` (and
-        counts the failure) when the free list is short."""
+        """Hand out ``n`` pages at refcount 1; raises
+        :class:`PoolExhausted` (and counts the failure) when the free
+        list is short."""
+        if n < 0:
+            raise ValueError(f"alloc of negative page count {n}")
         if n > len(self._free):
             self.alloc_failures += 1
             raise PoolExhausted(
                 f"need {n} pages, {len(self._free)} free "
                 f"(pool {self.num_pages}, {self.reserved} reserved)")
         got = [self._free.pop() for _ in range(n)]
+        for p in got:
+            self._refcount[p] = 1
         self.allocs_total += n
         self.peak_in_use = max(self.peak_in_use, self.num_used)
         return got
 
+    def share(self, pages: Sequence[int]):
+        """Take one additional reference on each (live) page — the
+        prefix-sharing primitive. Counted into ``allocs_total`` so every
+        reference is matched by exactly one ``free``."""
+        for p in pages:
+            if not (self.reserved <= p < self.num_pages):
+                raise ValueError(f"share of out-of-range page {p}")
+            if self._refcount[p] < 1:
+                raise ValueError(f"share of free page {p}")
+        for p in pages:
+            self._refcount[p] += 1
+        self.allocs_total += len(pages)
+        self.shares_total += len(pages)
+
     def free(self, pages: Sequence[int]):
-        seen = set(self._free)
+        """Drop one reference per entry; a page recycles into the free
+        list when its count reaches zero. Dropping more references than
+        a page holds (including duplicates within one call) is a loud
+        ``double free`` — the whole call is validated before any state
+        changes."""
+        drops: Dict[int, int] = {}
         for p in pages:
             if not (self.reserved <= p < self.num_pages):
                 raise ValueError(f"free of out-of-range page {p}")
-            if p in seen:
+            drops[p] = drops.get(p, 0) + 1
+        for p, n in drops.items():
+            if n > self._refcount[p]:
                 raise ValueError(f"double free of page {p}")
-            seen.add(p)
-        self._free.extend(pages)
+        recycled = []
+        for p in pages:
+            self._refcount[p] -= 1
+            if self._refcount[p] == 0:
+                recycled.append(p)
+        self._free.extend(recycled)
         self._free.sort(reverse=True)
         self.frees_total += len(pages)
 
     def stats(self) -> Dict[str, float]:
         return {
             "num_pages": self.num_pages,
+            "num_reserved": self.reserved,
+            "num_usable": self.num_usable,
             "num_used": self.num_used,
             "num_free": self.num_free,
+            "shared_pages": self.shared_pages,
             "utilization": self.utilization(),
             "fragmentation": self.fragmentation(),
             "allocs_total": self.allocs_total,
             "frees_total": self.frees_total,
+            "shares_total": self.shares_total,
             "alloc_failures": self.alloc_failures,
             "defrags_total": self.defrags_total,
             "peak_in_use": self.peak_in_use,
         }
+
+
+class _TrieNode:
+    __slots__ = ("page", "children", "tail", "tick")
+
+    def __init__(self, page: Optional[int] = None):
+        self.page = page
+        self.children: Dict[bytes, "_TrieNode"] = {}
+        # (page_id, token array) — ONE partial-page donor per chain: its
+        # rows [0, len(tokens)) are immutable prompt KV (decode appends
+        # strictly after them), the copy-on-write source
+        self.tail: Optional[Tuple[int, np.ndarray]] = None
+        self.tick = 0
+
+
+class PrefixCache:
+    """Hash-trie over FULL prompt pages (+ one partial tail per chain).
+
+    A node at depth ``j`` keys the content of prompt page ``j`` given
+    the pages before it (the dict key is the page's raw tokens; the
+    chain from the root IS the context hash), and holds the pool page
+    that already stores that span's KV. The trie owns one allocator
+    reference per held page, so donor pages survive their original
+    request's retirement; :meth:`evict` drops references LRU-first
+    (tails, then leaf nodes — an inner node's KV is context for its
+    descendants' reachability, so leaves go first) when the pool needs
+    the room back.
+    """
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self.root = _TrieNode()
+        self._tick = 0
+        self.evictions_total = 0
+
+    def _bump(self, node: _TrieNode):
+        self._tick += 1
+        node.tick = self._tick
+
+    def match(self, prompt: np.ndarray):
+        """Longest shared span for ``prompt``: returns
+        ``(full_page_ids, tail)`` where ``tail`` is ``(donor_page,
+        rows)`` for a copy-on-write partial continuation or None. The
+        span is capped at ``len(prompt) - 1`` tokens so at least one
+        prompt token is always forwarded (its logits seed sampling)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pg = self.page_size
+        max_full = max(0, (prompt.size - 1) // pg)
+        node, pages = self.root, []
+        for j in range(max_full):
+            child = node.children.get(
+                prompt[j * pg:(j + 1) * pg].tobytes())
+            if child is None:
+                break
+            node = child
+            self._bump(node)
+            pages.append(node.page)
+        rem = prompt[len(pages) * pg:]
+        limit = prompt.size - 1 - len(pages) * pg
+        tail = None
+        if rem.size == pg:
+            # page-ALIGNED shared span: the span cap (not a mismatch)
+            # stopped the walk, and the next full page may itself be a
+            # trie child registered by an aligned donor — CoW all but
+            # its last row (the maximal share: one token must forward)
+            child = node.children.get(rem.tobytes())
+            if child is not None:
+                self._bump(child)
+                tail = (int(child.page), pg - 1)
+        if tail is None and node.tail is not None:
+            donor, ttok = node.tail
+            m = min(ttok.size, rem.size, limit)
+            if m > 0:
+                eq = ttok[:m] == rem[:m]
+                t = int(m if eq.all() else np.argmax(~eq))
+                if t > 0:
+                    self._bump(node)
+                    tail = (int(donor), t)
+        return pages, tail
+
+    def register(self, prompt: np.ndarray, pages: Sequence[int],
+                 allocator: BlockAllocator):
+        """Insert ``prompt``'s full pages (and partial tail, if any)
+        into the trie, taking one allocator reference per page NEWLY
+        covered (spans already in the trie — including ones this very
+        request shared at admission — are left as-is)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pg = self.page_size
+        node = self.root
+        for j in range(prompt.size // pg):
+            key = prompt[j * pg:(j + 1) * pg].tobytes()
+            child = node.children.get(key)
+            if child is None:
+                child = _TrieNode(page=int(pages[j]))
+                allocator.share([child.page])
+                node.children[key] = child
+            node = child
+            self._bump(node)
+        rem = prompt.size % pg
+        if rem and node.tail is None:
+            k = prompt.size // pg
+            node.tail = (int(pages[k]), prompt[k * pg:].copy())
+            allocator.share([node.tail[0]])
+            self._bump(node)
+
+    def _candidates(self):
+        """Evictable references: every tail, plus leaf nodes with no
+        tail (inner nodes only become evictable once their subtree is
+        gone — a child chain is unreachable without its ancestors)."""
+        out = []
+        stack = [(self.root, None, None)]
+        while stack:
+            node, parent, key = stack.pop()
+            if node.tail is not None:
+                out.append((node.tick, 0, node, parent, key, True))
+            elif parent is not None and not node.children:
+                out.append((node.tick, 1, node, parent, key, False))
+            for k, c in node.children.items():
+                stack.append((c, node, k))
+        return out
+
+    def evict(self, allocator: BlockAllocator, need: int) -> int:
+        """Drop trie references LRU-first until ``need`` pages actually
+        returned to the free list (a dropped reference frees nothing
+        while live block tables still share the page) or nothing
+        evictable remains. Returns pages freed. One trie walk + sort
+        serves a whole batch of drops; the walk repeats only when the
+        candidate list ran dry and drops made new parents evictable —
+        so reclaiming k pages from an n-node trie is O(n log n + k),
+        not O(k * n log n), on the admission path."""
+        start = allocator.num_free
+        progressed = True
+        while allocator.num_free - start < need and progressed:
+            cands = self._candidates()
+            cands.sort(key=lambda c: (c[0], c[1]))
+            progressed = False
+            for _, _, node, parent, key, is_tail in cands:
+                if is_tail:
+                    allocator.free([node.tail[0]])
+                    node.tail = None
+                else:
+                    allocator.free([node.page])
+                    del parent.children[key]
+                self.evictions_total += 1
+                progressed = True
+                if allocator.num_free - start >= need:
+                    break
+        return allocator.num_free - start
+
+    def drop_all(self, allocator: BlockAllocator) -> int:
+        """Release every trie reference (server reset / tests).
+        ``need=num_pages`` can never be satisfied, so :meth:`evict`
+        runs until no candidate remains — i.e. the trie is empty."""
+        start = allocator.num_free
+        self.evict(allocator, allocator.num_pages)
+        return allocator.num_free - start
+
+    def pages(self) -> List[int]:
+        """Every page id the trie holds a reference on (defrag's
+        used-set must include them — they are live storage even when no
+        block table maps them)."""
+        out = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node.page is not None:
+                out.append(node.page)
+            if node.tail is not None:
+                out.append(node.tail[0])
+            stack.extend(node.children.values())
+        return out
+
+    def remap_pages(self, remap: np.ndarray):
+        """Rewrite held page ids after a defrag compaction."""
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node.page is not None:
+                node.page = int(remap[node.page])
+            if node.tail is not None:
+                node.tail = (int(remap[node.tail[0]]), node.tail[1])
+            stack.extend(node.children.values())
 
 
 class PagedKVCache:
@@ -131,11 +385,15 @@ class PagedKVCache:
     same keys as the dense cache (``k``/``v`` [+ ``ks``/``vs`` for the
     int8 tier]) — and are REPLACED functionally by the jitted programs
     (donated buffers update in place on device).
+
+    ``enable_prefix_cache`` (default on) attaches a :class:`PrefixCache`
+    so :meth:`admit_prompt` can map previously prefilled prompt pages
+    into new admissions (refcounted sharing + copy-on-write tails).
     """
 
     def __init__(self, cfg, max_batch: int, max_len: int,
                  page_size: int = 16, num_pages: Optional[int] = None,
-                 kv_dtype=None):
+                 kv_dtype=None, enable_prefix_cache: bool = True):
         from ..models import generate as _gen
         if max_len % page_size:
             max_len = (max_len // page_size + 1) * page_size
@@ -152,6 +410,9 @@ class PagedKVCache:
         self.pool = _gen.init_paged_cache(cfg, num_pages, page_size,
                                           kv_dtype=kv_dtype)
         self.allocator = BlockAllocator(num_pages)
+        self.prefix = PrefixCache(page_size) if enable_prefix_cache else None
+        self.cow_copies = 0
+        self._cow_fn = None                     # jitted CoW row copier
         # TRASH_PAGE-filled tables: unassigned entries route to trash
         self.block_tables = np.full((max_batch, self.pages_per_seq),
                                     TRASH_PAGE, np.int32)
@@ -163,10 +424,7 @@ class PagedKVCache:
     def pages_for(self, total_tokens: int) -> int:
         return -(-total_tokens // self.page_size)
 
-    def admit(self, slot: int, total_tokens: int) -> np.ndarray:
-        """Reserve pages for a request of ``total_tokens`` (prompt + new)
-        on ``slot``; returns the slot's block-table row. Raises
-        :class:`PoolExhausted` when the pool can't cover it."""
+    def _check_admit(self, slot: int, total_tokens: int) -> int:
         if self.active[slot]:
             raise ValueError(f"slot {slot} already active")
         n = self.pages_for(total_tokens)
@@ -175,15 +433,119 @@ class PagedKVCache:
                 f"request of {total_tokens} tokens needs {n} pages; the "
                 f"cache holds max_len={self.max_len} "
                 f"({self.pages_per_seq} pages) per request")
-        pages = self.allocator.alloc(n)
+        return n
+
+    def _alloc_with_evict(self, n: int) -> List[int]:
+        """Allocate ``n`` pages, reclaiming prefix-cache references
+        under pool pressure: trie-only pages are cache, not workload —
+        admissions outrank them. One failed admission counts ONE
+        ``alloc_failures`` (the eviction retry re-raises the original
+        exception instead of re-attempting through the counter)."""
+        try:
+            return self.allocator.alloc(n)
+        except PoolExhausted:
+            if self.prefix is not None:
+                self.prefix.evict(self.allocator,
+                                  n - self.allocator.num_free)
+            if n > self.allocator.num_free:
+                raise
+            return self.allocator.alloc(n)
+
+    def _install(self, slot: int, pages: List[int]) -> np.ndarray:
         self._slot_pages[slot] = pages
         self.block_tables[slot] = TRASH_PAGE
-        self.block_tables[slot, :n] = pages
+        self.block_tables[slot, :len(pages)] = pages
         self.active[slot] = True
         return self.block_tables[slot]
 
+    def admit(self, slot: int, total_tokens: int) -> np.ndarray:
+        """Reserve pages for a request of ``total_tokens`` (prompt + new)
+        on ``slot``; returns the slot's block-table row. Raises
+        :class:`PoolExhausted` when the pool can't cover it. No prefix
+        sharing — use :meth:`admit_prompt` to share prompt pages."""
+        n = self._check_admit(slot, total_tokens)
+        return self._install(slot, self._alloc_with_evict(n))
+
+    def admit_prompt(self, slot: int, prompt,
+                     total_tokens: int) -> Tuple[np.ndarray, int]:
+        """Admit with prefix sharing: map the longest trie-matched span
+        of ``prompt``'s pages into the slot's table (one extra reference
+        each), copy-on-write the matched rows of the first partial page,
+        and allocate fresh pages for the rest. Returns ``(block-table
+        row, shared_tokens)`` — the first ``shared_tokens`` tokens of
+        the prompt already have their KV in the mapped pages and must
+        NOT be prefilled again."""
+        n = self._check_admit(slot, total_tokens)
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if total_tokens < prompt.size:
+            # the budget must cover the whole prompt — a shorter one
+            # would let a trie match exceed the requested page count
+            raise ValueError(
+                f"admit_prompt: total_tokens={total_tokens} is smaller "
+                f"than the {prompt.size}-token prompt it must contain")
+        if self.prefix is None or prompt.size == 0:
+            return self._install(slot, self._alloc_with_evict(n)), 0
+        shared, tail = self.prefix.match(prompt)
+        # pin the matched pages FIRST: the eviction a fresh-page alloc
+        # may trigger must not recycle the span we are about to map
+        self.allocator.share(shared)
+        try:
+            fresh = self._alloc_with_evict(n - len(shared))
+        except PoolExhausted:
+            if shared:
+                self.allocator.free(shared)
+            raise
+        shared_tokens = len(shared) * self.page_size
+        if tail is not None and fresh:
+            donor, rows = tail
+            self._cow_copy(donor, fresh[0], rows)
+            shared_tokens += rows
+            self.cow_copies += 1
+        return self._install(slot, shared + fresh), shared_tokens
+
+    def _cow_copy(self, src_page: int, dst_page: int, rows: int):
+        """Device-copy the first ``rows`` token rows of ``src_page``
+        into ``dst_page`` for every pool array (all layers): the
+        copy-on-write that lets an admission reuse a donor's partial
+        prompt page without re-prefilling those rows, while decode
+        appends into its OWN copy. Runs as ONE jitted program with the
+        pool DONATED so XLA updates the buffers in place — an eager
+        ``.at[].set`` would re-materialize the whole (GB-scale) pool to
+        move at most one page of rows, on the admission hot path. The
+        row count is a TRACED scalar (rows past it keep the dst page's
+        values via a select), so every CoW admission shares a single
+        compile instead of one per distinct share length."""
+        import jax
+        import jax.numpy as jnp
+        if self._cow_fn is None:
+            def f(pool, src, dst, rows):
+                out = {}
+                for name, arr in pool.items():
+                    srcp = arr[:, src]                  # (L, page, ...)
+                    dstp = arr[:, dst]
+                    keep = jnp.arange(arr.shape[2]) < rows
+                    keep = keep.reshape((1, -1) + (1,) * (srcp.ndim - 2))
+                    out[name] = arr.at[:, dst].set(
+                        jnp.where(keep, srcp, dstp))
+                return out
+            self._cow_fn = jax.jit(f, donate_argnums=(0,))
+        self.pool = self._cow_fn(self.pool, jnp.int32(src_page),
+                                 jnp.int32(dst_page), jnp.int32(rows))
+
+    def register_prefix(self, slot: int, prompt):
+        """Publish a fully prefilled prompt's pages into the prefix
+        trie (call once the whole prompt's KV is in the pool)."""
+        if self.prefix is None:
+            return
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0 or not self.active[slot]:
+            return
+        self.prefix.register(prompt, self._slot_pages[slot],
+                             self.allocator)
+
     def release(self, slot: int):
-        """Retire a request: recycle its pages into the free list."""
+        """Retire a request: drop its page references (shared pages
+        survive under the trie's or other tables' references)."""
         if self._slot_pages[slot]:
             self.allocator.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
@@ -199,13 +561,19 @@ class PagedKVCache:
 
     def defrag(self):
         """Compact used pages to the front of the pool: one device
-        gather rewrites each pool array, block tables are remapped on
-        the host, and the free list becomes the contiguous tail. Keeps
-        long-running servers' pools dense after many admit/retire
-        cycles (the allocator's ``fragmentation()`` stat measures the
-        holes this closes)."""
+        gather rewrites each pool array, block tables (and the prefix
+        trie's held pages) are remapped on the host, and the free list
+        becomes the contiguous tail. Shared pages move like any other —
+        every reference (tables, ``_slot_pages``, trie nodes/tails) is
+        rewritten atomically, so no live table is left pointing at a
+        vacated id. Keeps long-running servers' pools dense after many
+        admit/retire cycles (the allocator's ``fragmentation()`` stat
+        measures the holes this closes)."""
         import jax.numpy as jnp
-        used = sorted({p for pages in self._slot_pages for p in pages})
+        used = {p for pages in self._slot_pages for p in pages}
+        if self.prefix is not None:
+            used |= set(self.prefix.pages())
+        used = sorted(used)
         remap = np.arange(self.num_pages, dtype=np.int32)
         src = np.arange(self.num_pages, dtype=np.int32)
         for new_id, old_id in enumerate(used, start=self.allocator.reserved):
@@ -221,6 +589,12 @@ class PagedKVCache:
         self._slot_pages = [[int(remap[p]) for p in pages]
                             for pages in self._slot_pages]
         alloc = self.allocator
+        new_rc = np.zeros_like(alloc._refcount)
+        for old_id in used:
+            new_rc[remap[old_id]] = alloc._refcount[old_id]
+        alloc._refcount = new_rc
+        if self.prefix is not None:
+            self.prefix.remap_pages(remap)
         first_free = alloc.reserved + len(used)
         alloc._free = list(range(self.num_pages - 1, first_free - 1, -1))
         alloc.defrags_total += 1

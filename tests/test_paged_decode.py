@@ -54,9 +54,10 @@ class TestPagedDenseParity:
         for out, p in zip(outs, prompts):
             np.testing.assert_array_equal(
                 out, _dense_ref(params, p, cfg, new, ext, kv=kv))
-        # prefill programs are bucketed by PAGE multiple, not prompt
-        # length: both prompts (4 and 7 tokens) share the 8-wide program
-        assert list(eng._prefill_fns) == [8]
+        # chunked-prefill programs are bucketed by PAGE multiple, not
+        # prompt length: both prompts (4 and 7 tokens) share the
+        # (ctx=0, width=8) program
+        assert list(eng._chunk_fns) == [(0, 8)]
 
     def test_prefill_insert_scatters_dense_rows(self):
         """Pages gathered back in block-table order hold exactly the
@@ -250,7 +251,13 @@ class TestContinuousBatching:
             np.testing.assert_array_equal(
                 r.output, _dense_ref(params, p, cfg, new, ext))
         st = eng.stats()
-        assert st["num_used"] == 0 and st["active_slots"] == 0
+        assert st["active_slots"] == 0
+        # the prefix cache retains prompt pages past retirement (future
+        # admissions share them); dropping its references empties the
+        # pool and every reference taken was dropped exactly once
+        assert st["num_used"] == len(eng.cache.prefix.pages())
+        eng.cache.prefix.drop_all(eng.cache.allocator)
+        assert eng.cache.allocator.num_used == 0
         assert eng.cache.allocator.frees_total == \
             eng.cache.allocator.allocs_total > 0
 

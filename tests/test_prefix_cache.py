@@ -1,0 +1,393 @@
+"""Prefix caching + chunked prefill + refcounted allocator tests
+(ISSUE 3: the serving-throughput pack).
+
+Acceptance gates: greedy decode through the ragged paged kernel +
+prefix cache + chunked prefill stays TOKEN-IDENTICAL to dense
+``generate()`` at fp and int8-KV tiers, and a prefix-sharing admission
+reuses >= 1 shared page with ZERO extra prefill FLOPs for the shared
+span (asserted via the ``serving_prefix_hit_tokens_total`` counter
+against the chunk-prefill token counter). Allocator edge cases:
+double-release of a shared page, copy-on-write on a partially filled
+page, defrag with live shared pages, PoolExhausted while holding shared
+prefixes.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models import llama, generate
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.serving import (BlockAllocator, PagedKVCache,
+                                PoolExhausted)
+from paddle_tpu.ops.pallas import paged_attention as pa
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _setup(seed=0, **kw):
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64, **kw)
+    params = llama.init_params(jax.random.key(seed), cfg)
+    return cfg, params
+
+
+def _dense_ref(params, prompt, cfg, new, ext, kv=None):
+    return np.asarray(generate.generate(
+        params, jnp.asarray(prompt[None]), cfg, max_new_tokens=new,
+        temperature=0.0, max_len=ext, kv_cache_dtype=kv))[0]
+
+
+def _shared_prompts(cfg, sys_len, tail_len, n, seed=0):
+    """``n`` prompts sharing one system prefix + unique tails."""
+    rs = np.random.RandomState(seed)
+    sysp = rs.randint(3, cfg.vocab_size, (sys_len,)).astype(np.int32)
+    return [np.concatenate(
+        [sysp, rs.randint(3, cfg.vocab_size, (tail_len,)).astype(np.int32)])
+        for _ in range(n)]
+
+
+class TestAllocatorRefcounts:
+    def test_share_lifecycle_and_stats(self):
+        a = BlockAllocator(6)                      # pages 1..5 usable
+        p = a.alloc(2)
+        a.share([p[0]])
+        assert a.refcount(p[0]) == 2 and a.shared_pages == 1
+        assert a.shares_total == 1
+        # every reference (alloc or share) is one future free
+        assert a.allocs_total == 3
+        a.free([p[0]])                             # drop one of two refs
+        assert a.refcount(p[0]) == 1 and a.shared_pages == 0
+        assert a.num_used == 2                     # page still live
+        a.free(p)                                  # last refs drop
+        assert a.num_used == 0
+        assert a.frees_total == a.allocs_total == 3
+
+    def test_double_release_of_shared_page(self):
+        a = BlockAllocator(6)
+        p = a.alloc(1)
+        a.share(p)
+        a.free(p + p)                  # two refs, two drops in one call
+        assert a.num_free == 5
+        with pytest.raises(ValueError, match="double free"):
+            a.free(p)                  # refcount 0: loud
+        q = a.alloc(1)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(q + q)              # more drops than refs in one call
+        assert a.refcount(q[0]) == 1   # validated BEFORE any mutation
+        with pytest.raises(ValueError, match="share of free page"):
+            a.share([5])
+        with pytest.raises(ValueError, match="negative"):
+            a.alloc(-1)
+        assert a.alloc(0) == []        # zero is a legal no-op
+
+    def test_stats_count_reserved_page_consistently(self):
+        """The trash page is neither free nor used: ``num_usable`` is
+        the one denominator, and used + free always sums to it."""
+        a = BlockAllocator(8, reserved=1)
+        a.alloc(3)
+        s = a.stats()
+        assert s["num_reserved"] == 1
+        assert s["num_usable"] == s["num_pages"] - s["num_reserved"] == 7
+        assert s["num_used"] + s["num_free"] == s["num_usable"]
+        assert s["utilization"] == s["num_used"] / s["num_usable"]
+        assert s["shared_pages"] == 0
+
+
+class TestPrefixCacheUnit:
+    """PagedKVCache-level sharing: admit_prompt / register_prefix /
+    copy-on-write / defrag / eviction."""
+
+    def _cache(self, seed=0, **kw):
+        cfg, params = _setup(seed=seed)
+        kw.setdefault("max_batch", 3)
+        kw.setdefault("max_len", 32)
+        kw.setdefault("page_size", 8)
+        return cfg, params, PagedKVCache(cfg, **kw)
+
+    def test_second_admission_maps_shared_pages(self):
+        cfg, params, cache = self._cache()
+        prompt = np.arange(3, 23, dtype=np.int32)   # 20 tokens: 2 full + 4
+        t0, shared0 = cache.admit_prompt(0, prompt, 24)
+        assert shared0 == 0                         # cold trie
+        cache.register_prefix(0, prompt)
+        t1, shared1 = cache.admit_prompt(1, prompt, 24)
+        # 2 full pages (16) + copy-on-write tail rows (3 of 4: the span
+        # is capped so >= 1 token still forwards for logits)
+        assert shared1 == 19
+        assert cache.cow_copies == 1
+        np.testing.assert_array_equal(t0[:2], t1[:2])   # mapped, not copied
+        assert t0[2] != t1[2]                       # CoW page is private
+        for p in cache._slot_pages[0][:2]:
+            assert cache.allocator.refcount(p) == 3  # slot0 + trie + slot1
+
+    def test_cow_copies_partial_page_rows(self):
+        """Copy-on-write on a partially filled page: the donor's shared
+        rows are byte-copied into the fresh page; rows past the share
+        stay private."""
+        cfg, params, cache = self._cache(seed=1)
+        rs = np.random.RandomState(0)
+        cache.pool = {n: jnp.asarray(rs.randn(*v.shape), v.dtype)
+                      for n, v in cache.pool.items()}
+        prompt = np.arange(3, 23, dtype=np.int32)
+        cache.admit_prompt(0, prompt, 24)
+        cache.register_prefix(0, prompt)
+        donor = cache._slot_pages[0][2]
+        _, shared = cache.admit_prompt(1, prompt, 24)
+        mine = cache._slot_pages[1][2]
+        rows = shared - 16
+        assert rows == 3
+        for name, arr in cache.pool.items():
+            got = np.asarray(arr[:, mine, :rows])
+            np.testing.assert_array_equal(
+                got, np.asarray(arr[:, donor, :rows]))
+
+    def test_defrag_with_live_shared_pages(self):
+        """Defrag must not move shared pages out from under live tables
+        OR the trie: every reference is remapped atomically and the
+        bytes seen through each table are unchanged."""
+        cfg, params, cache = self._cache(seed=2)
+        rs = np.random.RandomState(1)
+        cache.pool = {n: jnp.asarray(rs.randn(*v.shape), v.dtype)
+                      for n, v in cache.pool.items()}
+        prompt = np.arange(3, 23, dtype=np.int32)
+        cache.admit_prompt(0, prompt, 24)           # pages 1,2,3
+        cache.register_prefix(0, prompt)
+        cache.admit(2, 16)                          # filler: pages 4,5
+        cache.admit_prompt(1, prompt, 24)           # shares 1,2; CoW 6
+        before = {n: np.asarray(pa.gather_pages(
+            v[0], jnp.asarray(cache.block_tables)))
+            for n, v in cache.pool.items()}
+        rc_before = [cache.allocator.refcount(p)
+                     for p in cache._slot_pages[1]]
+        cache.release(2)                            # hole below page 6
+        assert cache.allocator.fragmentation() > 0
+        cache.defrag()
+        assert cache.allocator.fragmentation() == 0
+        for n, v in cache.pool.items():
+            after = np.asarray(pa.gather_pages(
+                v[0], jnp.asarray(cache.block_tables)))
+            for s in (0, 1):
+                np.testing.assert_array_equal(after[s], before[n][s])
+        # refcounts follow the pages through the remap
+        assert [cache.allocator.refcount(p)
+                for p in cache._slot_pages[1]] == rc_before
+        # the trie survived the remap: a third admission still shares
+        _, shared = cache.admit_prompt(2, prompt, 24)
+        assert shared == 19
+        np.testing.assert_array_equal(cache.block_tables[2][:2],
+                                      cache.block_tables[1][:2])
+
+    def test_pool_exhausted_evicts_held_prefixes(self):
+        """PoolExhausted while the trie holds retired prompts' pages:
+        trie-only references are cache, not workload — they evict
+        LRU-first and the admission succeeds; a pool genuinely full of
+        LIVE pages still raises."""
+        cfg, params, cache = self._cache(max_batch=2, max_len=32,
+                                         num_pages=1 + 4)
+        prompt = np.arange(3, 23, dtype=np.int32)   # 20 tokens, 3 pages
+        cache.admit_prompt(0, prompt, 24)
+        cache.register_prefix(0, prompt)
+        cache.release(0)                            # trie keeps 3 refs
+        assert cache.allocator.num_used == 3
+        other = np.arange(40, 60, dtype=np.int32)
+        _, shared = cache.admit_prompt(0, other, 32)  # needs all 4 pages
+        assert shared == 0
+        assert cache.allocator.alloc_failures >= 1
+        assert cache.prefix.evictions_total >= 1
+        with pytest.raises(PoolExhausted):
+            # all pages live now: even a 1-page request can't land
+            cache.admit_prompt(1, np.arange(60, 66, dtype=np.int32), 8)
+
+    def test_release_then_drop_all_balances_references(self):
+        cfg, params, cache = self._cache(seed=3)
+        prompt = np.arange(3, 23, dtype=np.int32)
+        cache.admit_prompt(0, prompt, 24)
+        cache.register_prefix(0, prompt)
+        cache.admit_prompt(1, prompt, 24)
+        cache.release(0)
+        cache.release(1)
+        assert cache.allocator.num_used == len(cache.prefix.pages()) == 3
+        cache.prefix.drop_all(cache.allocator)
+        assert cache.allocator.num_used == 0
+        assert cache.allocator.frees_total == cache.allocator.allocs_total
+
+    def test_page_aligned_prompt_cow_from_child_page(self):
+        """A page-ALIGNED shared span still reuses the next full page:
+        the span cap stops the walk one page short, but that page is a
+        trie child — its rows CoW except the last (one token must
+        forward for logits)."""
+        cfg, params, cache = self._cache(seed=4)
+        prompt = np.arange(3, 19, dtype=np.int32)   # 16 tokens, aligned
+        cache.admit_prompt(0, prompt, 20)
+        cache.register_prefix(0, prompt)            # 2 full child pages
+        _, shared = cache.admit_prompt(1, prompt, 20)
+        assert shared == 15                         # 8 mapped + 7 CoW
+        assert cache.cow_copies == 1
+        np.testing.assert_array_equal(cache.block_tables[0][:1],
+                                      cache.block_tables[1][:1])
+        assert cache.block_tables[0][1] != cache.block_tables[1][1]
+
+    def test_disabled_prefix_cache_never_shares(self):
+        cfg, params, cache = self._cache(enable_prefix_cache=False)
+        prompt = np.arange(3, 23, dtype=np.int32)
+        _, s0 = cache.admit_prompt(0, prompt, 24)
+        cache.register_prefix(0, prompt)            # no-op
+        _, s1 = cache.admit_prompt(1, prompt, 24)
+        assert s0 == s1 == 0 and cache.prefix is None
+
+    def test_budget_must_cover_prompt(self):
+        """A total_tokens smaller than the prompt would let a trie
+        match exceed the requested page count — rejected loudly."""
+        cfg, params, cache = self._cache(seed=5, max_len=48)
+        prompt = np.arange(3, 43, dtype=np.int32)   # 40 tokens
+        cache.admit_prompt(0, prompt, 44)
+        cache.register_prefix(0, prompt)
+        with pytest.raises(ValueError, match="smaller than the"):
+            cache.admit_prompt(1, prompt, 16)
+        before = cache.allocator.allocs_total
+        assert not cache.active[1]
+        assert cache.allocator.allocs_total == before
+
+
+class TestChunkedPrefillEngine:
+    """Engine-level gates: chunked + prefix-shared prefill stays
+    token-identical to dense generate(), with the hit counter proving
+    the shared span was never re-prefilled."""
+
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    def test_prefix_sharing_parity_and_hit_counter(self, kv):
+        from paddle_tpu import observability as obs
+        cfg, params = _setup(seed=1)
+        prompts = _shared_prompts(cfg, sys_len=20, tail_len=3, n=3,
+                                  seed=2)
+        was = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            # max_batch=1 serializes admissions, so the donor's pages
+            # are registered before every later request admits
+            eng = ContinuousBatchingEngine(
+                params, cfg, max_batch=1, page_size=8, max_len=32,
+                kv_cache_dtype=kv, prefill_chunk=8)
+            outs = eng.generate(prompts, max_new_tokens=4)
+            snap = obs.REGISTRY.to_json()
+        finally:
+            obs.REGISTRY.clear()
+            if not was:
+                obs.disable()
+        for out, p in zip(outs, prompts):
+            np.testing.assert_array_equal(
+                out, _dense_ref(params, p, cfg, 4, eng.cache.max_len,
+                                kv=kv))
+        hit = snap["serving_prefix_hit_tokens_total"]["values"][""]
+        miss = snap["serving_prefix_miss_tokens_total"]["values"][""]
+        total = sum(len(p) for p in prompts)
+        # requests 2 and 3 each map 2 full pages + the 4 remaining
+        # system-prompt rows via CoW on the partially filled 3rd page
+        assert hit == 2 * (2 * 8 + 4)
+        assert hit + miss == total
+        # ZERO extra prefill FLOPs for the shared span: the tokens that
+        # went through the chunked-prefill forward are exactly the
+        # misses, and the per-request page reuse is >= 1 whole page
+        assert snap["serving_prefill_chunk_tokens_total"][
+            "values"][""] == miss
+        assert eng.cache.cow_copies == 2
+
+    def test_chunked_prefill_parity_long_prompt(self):
+        """A prompt spanning several chunks decodes token-identically
+        to the dense path, and per-step prefill work is bounded by one
+        chunk (one histogram entry per chunk)."""
+        from paddle_tpu import observability as obs
+        cfg, params = _setup(seed=2)
+        rs = np.random.RandomState(5)
+        prompts = [rs.randint(3, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (21, 9)]
+        was = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            eng = ContinuousBatchingEngine(
+                params, cfg, max_batch=2, page_size=8, max_len=32,
+                prefill_chunk=8, enable_prefix_cache=False)
+            outs = eng.generate(prompts, max_new_tokens=4)
+            snap = obs.REGISTRY.to_json()
+        finally:
+            obs.REGISTRY.clear()
+            if not was:
+                obs.disable()
+        for out, p in zip(outs, prompts):
+            np.testing.assert_array_equal(
+                out, _dense_ref(params, p, cfg, 4, eng.cache.max_len))
+        # 21 tokens -> chunks of 8/8/8(5 valid); 9 -> 8/8(1 valid)
+        assert snap["serving_prefill_chunk_ms"]["values"][""][
+            "count"] == 5
+        assert snap["serving_prefix_hit_tokens_total"][
+            "values"][""] == 0
+        # compile cache is keyed by page-granular (ctx, width) pairs
+        assert set(eng._chunk_fns) <= {(0, 8), (8, 8), (16, 8)}
+
+    def test_mid_decode_admission_with_chunked_prefill(self):
+        """Chunked prefill interleaves with decode: while a long prompt
+        prefills one chunk per step, an already-running request keeps
+        decoding — and both stay token-identical to dense."""
+        cfg, params = _setup(seed=3)
+        rs = np.random.RandomState(7)
+        p_short = rs.randint(3, cfg.vocab_size, (4,)).astype(np.int32)
+        p_long = rs.randint(3, cfg.vocab_size, (24,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=2, page_size=8, max_len=32,
+            prefill_chunk=8, enable_prefix_cache=False)
+        r1 = eng.submit(p_short, max_new_tokens=8)
+        eng.step()                      # r1 prefilled + first token
+        r2 = eng.submit(p_long, max_new_tokens=4)
+        decoded_during_prefill = 0
+        while eng.step():
+            if r2.slot is not None and not r2.done and \
+                    eng._pending and not r1.done:
+                decoded_during_prefill += 1
+        assert decoded_during_prefill >= 2   # r1 advanced during chunks
+        np.testing.assert_array_equal(
+            r1.output, _dense_ref(params, p_short, cfg, 8,
+                                  eng.cache.max_len))
+        np.testing.assert_array_equal(
+            r2.output, _dense_ref(params, p_long, cfg, 4,
+                                  eng.cache.max_len))
+
+    def test_kernel_path_matches_reference_with_prefix(self):
+        """The ragged Pallas kernel (interpret mode) under prefix
+        sharing + chunked prefill matches the pure-lax path token for
+        token."""
+        cfg, params = _setup(seed=4)
+        prompts = _shared_prompts(cfg, sys_len=18, tail_len=3, n=2,
+                                  seed=8)
+        kw = dict(max_batch=2, page_size=8, max_len=32, prefill_chunk=8)
+        refs = ContinuousBatchingEngine(
+            params, cfg, use_kernel=False, **kw).generate(
+                prompts, max_new_tokens=4)
+        fa.set_interpret(True)
+        try:
+            kers = ContinuousBatchingEngine(
+                params, cfg, use_kernel=True, **kw).generate(
+                    prompts, max_new_tokens=4)
+        finally:
+            fa.set_interpret(False)
+        for a, b in zip(refs, kers):
+            np.testing.assert_array_equal(a, b)
+
+    def test_chunk_program_lowers_for_tpu(self):
+        """AOT lowering guard for the chunked-prefill step (the
+        interpret-green-but-won't-lower class; the ragged kernel's own
+        guard lives in test_paged_decode + tools/aot_validate.py
+        --config serving)."""
+        import jax.export
+        cfg, params = _setup(seed=5)
+        paged = generate.init_paged_cache(cfg, num_pages=9, page_size=8)
+        table = jnp.asarray([1, 2, 3, 4], jnp.int32)
+        chunk = jnp.ones((1, 8), jnp.int32)
+        exp = jax.export.export(
+            jax.jit(lambda p, c, pool, bt, cl, kl:
+                    generate.paged_prefill_chunk(
+                        p, c, pool, bt, cfg, ctx_cap=8, ctx_len=cl,
+                        chunk_len=kl)),
+            platforms=["tpu"])(params, chunk, paged, table,
+                               jnp.int32(6), jnp.int32(8))
+        assert exp.mlir_module()       # export completing is the gate

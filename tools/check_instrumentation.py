@@ -33,6 +33,11 @@ REQUIRED = {
         ("_obs.serving_step(", 1),
         ("_obs.serving_admitted(", 1),
         ("_obs.serving_retired(", 1),
+        # prefix-cache hit/miss token counters (the live hit rate) and
+        # the per-chunk prefill latency histogram (the engine's
+        # per-step latency bound) — ISSUE 3's serving telemetry
+        ("_obs.serving_prefix(", 1),
+        ("_obs.serving_prefill_chunk(", 1),
     ],
     "paddle_tpu/models/generate.py": [
         ("_obs.generate_begin()", 1),

@@ -4,12 +4,14 @@ The driver bench's decode extras share one watchdog with the train
 headline; on a slow-compile day the extras die and the decode tiers
 stay null (they have been null in every round so far). This tool measures
 ONLY the decode tiers — fp bf16, the paged continuous-batching engine,
-int8 weight-only, int4 weight-only, int8-weight+int8-KV — with the whole
+the prefix-cache + chunked-prefill shared-system-prompt engine, int8
+weight-only, int4 weight-only, int8-weight+int8-KV — with the whole
 budget to itself, on freshly initialized weights (decode throughput does
 not depend on weight values).
 
 Prints one JSON line:
   {"decode_tokens_per_sec": ..., "decode_paged_tokens_per_sec": ...,
+   "decode_prefix_tokens_per_sec": ...,
    "decode_int8_tokens_per_sec": ..., "decode_int4_tokens_per_sec": ...,
    "decode_w8kv8_tokens_per_sec": ..., "device": ...,
    "ratios_vs_fp": {...}}
@@ -101,6 +103,11 @@ def main():
     run_tier("decode_paged_tokens_per_sec",
              lambda: bench_mod.paged_decode_tier(
                  params, cfg, db, dp_len, dnew, on_tpu))
+    # shared-system-prompt workload (prefix cache + chunked prefill),
+    # also shared with bench.py so both sources stay comparable
+    run_tier("decode_prefix_tokens_per_sec",
+             lambda: bench_mod.prefix_decode_tier(
+                 params, cfg, db, dp_len, dnew, on_tpu))
     int8_p = {}
 
     def _int8():
@@ -115,6 +122,7 @@ def main():
 
     out.update({k: tiers.get(k) for k in (
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
+        "decode_prefix_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")

@@ -73,6 +73,7 @@ if dec.get("decode_tokens_per_sec") is not None:
         lg = json.load(f)
     changed = False
     for k in ("decode_tokens_per_sec", "decode_paged_tokens_per_sec",
+              "decode_prefix_tokens_per_sec",
               "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
               "decode_w8kv8_tokens_per_sec"):
         if dec.get(k) is None:
@@ -90,6 +91,7 @@ if dec.get("decode_tokens_per_sec") is not None:
             src = lg["extra"]["decode_source"] = {
                 t: "carried" for t in (
                     "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
+                    "decode_prefix_tokens_per_sec",
                     "decode_int8_tokens_per_sec",
                     "decode_int4_tokens_per_sec",
                     "decode_w8kv8_tokens_per_sec")
