@@ -121,7 +121,7 @@ def peak_flops(dev) -> float:
 def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
             decode_w8kv8_tps=None, decode_paged_tps=None,
-            decode_prefix_tps=None, phases=None):
+            decode_prefix_tps=None, decode_sched=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -137,8 +137,14 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_int4_tokens_per_sec": decode_int4_tps,
                   "decode_w8kv8_tokens_per_sec": decode_w8kv8_tps,
                   "decode_paged_tokens_per_sec": decode_paged_tps,
-                  "decode_prefix_tokens_per_sec": decode_prefix_tps},
+                  "decode_prefix_tokens_per_sec": decode_prefix_tps,
+                  "decode_sched_tokens_per_sec": (
+                      decode_sched[0] if decode_sched else None)},
     }
+    if decode_sched:
+        # the tier's point is the BOUND, not just the throughput:
+        # p50/p99 step latency under the bursty two-priority workload
+        rec["extra"]["decode_sched_step_ms"] = decode_sched[1]
     if phases is not None:
         rec["phases"] = phases
     return _backfill_decode(rec)
@@ -285,10 +291,75 @@ def prefix_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                         prefill_chunk=2 * page)
 
 
+def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                      kv_cache_dtype=None):
+    """The decode_sched_tokens_per_sec measurement, shared by measure()
+    and tools/decode_bench.py so the two sources stay comparable.
+
+    Oversubscribed TWO-PRIORITY bursty workload through the ISSUE 4
+    :class:`~paddle_tpu.serving.ServingScheduler`: ``db`` LOW
+    long-prompt requests fill every slot first, then a burst of ``db``
+    HIGH short-prompt requests lands — each HIGH admission preempts a
+    LOW victim (pages evicted back to the pool) and the victim later
+    resumes token-identically through the continuation-prefill replay.
+    The step planner runs with a real token budget (one decode per
+    slot + one two-page chunk), so the number measures the whole
+    control plane: planning, preempt/evict/resume churn, and the
+    budget-bounded step latency. Returns ``(tokens_per_sec,
+    {"p50_step_ms", "p99_step_ms", "preemptions"})`` — the latency
+    percentiles are the tier's point: FIFO has no bound on them.
+    Prefix cache OFF (same reason as the paged tier: the warm pass
+    must not convert the timed pass into a hit workload)."""
+    import numpy as np
+    from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    from paddle_tpu.serving import Priority, ServingScheduler
+    page = 16 if on_tpu else 8
+    rngp = np.random.default_rng(5)
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_batch=db, page_size=page,
+        max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype,
+        enable_prefix_cache=False)
+    sched = ServingScheduler(eng, token_budget=db + 2 * page)
+
+    def one_pass():
+        def mk(n):
+            return rngp.integers(0, cfg.vocab_size, (n,)).astype(
+                np.int32)
+        lows = [sched.submit(mk(dp_len), max_new_tokens=dnew,
+                             priority=Priority.LOW) for _ in range(db)]
+        # let the LOW wave occupy every slot before the burst
+        for _ in range(4):
+            sched.step()
+        highs = [sched.submit(mk(max(dp_len // 2, 1)),
+                              max_new_tokens=max(dnew // 2, 1),
+                              priority=Priority.HIGH)
+                 for _ in range(db)]
+        lats = []
+        while True:
+            t0 = time.perf_counter()
+            more = sched.step()
+            lats.append(time.perf_counter() - t0)
+            if not more:
+                break
+        return (sum(len(r.tokens) for r in lows + highs), lats)
+
+    one_pass()                                      # compile/warm pass
+    p0 = sched.preemptions_total
+    t0 = time.perf_counter()
+    toks_out, lats = one_pass()                     # steady state
+    tps = round(toks_out / (time.perf_counter() - t0), 2)
+    return tps, {
+        "p50_step_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+        "p99_step_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        "preemptions": sched.preemptions_total - p0,
+    }
+
+
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec",
                  "decode_paged_tokens_per_sec",
-                 "decode_prefix_tokens_per_sec")
+                 "decode_prefix_tokens_per_sec",
+                 "decode_sched_tokens_per_sec")
 
 
 def _label_decode_source(extra: dict, carried_tiers) -> None:
@@ -331,6 +402,13 @@ def _backfill_decode(rec: dict) -> dict:
             if rec["extra"].get(k) is None and lx.get(k) is not None:
                 rec["extra"][k] = lx[k]
                 carried.add(k)
+        # the scheduler tier's p50/p99 step-latency dict travels with
+        # its throughput number — a carried decode_sched tier without
+        # its latency bound would drop the quantity the tier reports
+        if ("decode_sched_tokens_per_sec" in carried
+                and rec["extra"].get("decode_sched_step_ms") is None
+                and lx.get("decode_sched_step_ms") is not None):
+            rec["extra"]["decode_sched_step_ms"] = lx["decode_sched_step_ms"]
         if carried:
             rec["extra"]["decode_carried_from"] = (
                 "BENCH_LASTGOOD "
@@ -514,13 +592,26 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"prefix decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # SLO-scheduler control plane: oversubscribed two-priority bursty
+    # workload (preempt/evict/resume + token-budgeted steps) — the
+    # ISSUE 4 tier, with p50/p99 step latency riding the record
+    decode_sched = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_sched = sched_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"sched decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
 
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                    decode_int8_tps, decode_int4_tps, decode_w8kv8_tps,
-                   decode_paged_tps, decode_prefix_tps, phases=phases)
+                   decode_paged_tps, decode_prefix_tps,
+                   decode_sched=decode_sched, phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

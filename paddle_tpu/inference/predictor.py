@@ -333,9 +333,24 @@ def create_predictor(config: Config, layer=None) -> Predictor:
 # ---------------- continuous-batching decode engine ----------------
 
 class GenerationRequest:
-    """One in-flight generation request tracked by the engine."""
+    """One in-flight generation request tracked by the engine.
+
+    ``finish_reason`` is STRUCTURED (the string values of
+    :class:`paddle_tpu.serving.policy.FinishReason`): ``eos`` /
+    ``max_len`` on completion, ``deadline_exceeded`` when a scheduler
+    cancels a queued request, and the transient ``preempted`` while the
+    request sits evicted awaiting resume (``done`` stays False and the
+    reason clears when its replay prefill completes).
+
+    ``priority`` (lower = more important), ``deadline_at`` /
+    ``submitted_at`` / ``enqueued_at`` (scheduler-clock seconds; the
+    last resets on every requeue) and ``preemptions`` are
+    scheduler-facing metadata; the engine's own FIFO path ignores them.
+    """
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
-                 "tokens", "done", "finish_reason", "slot")
+                 "tokens", "done", "finish_reason", "slot",
+                 "priority", "deadline_at", "submitted_at",
+                 "enqueued_at", "preemptions")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
         self.rid = rid
@@ -346,6 +361,23 @@ class GenerationRequest:
         self.done = False
         self.finish_reason: Optional[str] = None
         self.slot: Optional[int] = None
+        self.priority = 1                # serving.policy.Priority.NORMAL
+        self.deadline_at: Optional[float] = None
+        self.submitted_at: Optional[float] = None
+        self.enqueued_at: Optional[float] = None   # latest (re)queue time
+        self.preemptions = 0
+
+    def resume_sequence(self) -> np.ndarray:
+        """The tokens whose KV must be in the pool before this request
+        can (re)enter decode: the prompt plus — after a preemption —
+        every generated token EXCEPT the last (decode feeds the last
+        sampled token back through the step program, which writes its
+        KV then; replaying ``tokens[:-1]`` through the continuation
+        prefill reproduces the evicted cache bit-for-bit)."""
+        if not self.tokens:
+            return self.prompt[0]
+        return np.concatenate(
+            [self.prompt[0], np.asarray(self.tokens[:-1], np.int32)])
 
     @property
     def output(self) -> np.ndarray:
@@ -380,7 +412,16 @@ class ContinuousBatchingEngine:
     only when the allocator can cover ``prompt + max_new_tokens``
     (prefix-cache-held pages are evicted LRU-first under pressure); a
     :class:`~paddle_tpu.serving.PoolExhausted` defers it until running
-    requests retire (OOM-free by construction).
+    requests retire (OOM-free by construction). The engine's own
+    :meth:`step` admits FIFO; the SLO-aware control plane
+    (:class:`~paddle_tpu.serving.ServingScheduler`) composes the same
+    lifecycle pieces — :meth:`admit_request`, :meth:`preempt_request`
+    (pages evicted back to the pool, token-identical resume through the
+    continuation-prefill program), :meth:`cancel_request`,
+    :meth:`prefill_step`, :meth:`decode_step` — under priority classes,
+    deadlines and a per-step token budget. Requests finish with
+    STRUCTURED reasons (``eos`` / ``max_len`` / ``deadline_exceeded``,
+    transient ``preempted`` — serving.policy.FinishReason).
 
     Sampling: greedy at ``temperature == 0`` (token-identical to the
     dense :func:`~paddle_tpu.models.generate.generate` — chunking and
@@ -426,15 +467,17 @@ class ContinuousBatchingEngine:
         self._next_rid = 0
         self._steps = 0
         self._decode_fn = None
-        # slot -> [request, tokens already in pages (shared + chunks)]
+        # slot -> [request, sequence being prefilled (prompt, or the
+        # preemption-resume replay), tokens already in pages]
         self._pending: Dict[int, List] = {}
         self._chunk_fns: Dict[tuple, object] = {}
 
     # ---- request intake ----
-    def submit(self, prompt, max_new_tokens: int = 16,
-               eos_token_id=None) -> GenerationRequest:
-        """Queue a prompt (1D int sequence); returns the request handle
-        (``.done`` / ``.tokens`` / ``.output`` fill in as steps run)."""
+    def create_request(self, prompt, max_new_tokens: int = 16,
+                       eos_token_id=None) -> GenerationRequest:
+        """Validate and build a request WITHOUT queueing it — external
+        schedulers (:class:`~paddle_tpu.serving.ServingScheduler`) own
+        their queues and place requests via :meth:`admit_request`."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("submit: empty prompt")
@@ -456,6 +499,14 @@ class ContinuousBatchingEngine:
             self._next_rid, prompt, max_new_tokens,
             self.eos_token_id if eos_token_id is None else eos_token_id)
         self._next_rid += 1
+        return req
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               eos_token_id=None) -> GenerationRequest:
+        """Queue a prompt (1D int sequence); returns the request handle
+        (``.done`` / ``.tokens`` / ``.output`` fill in as steps run)."""
+        req = self.create_request(prompt, max_new_tokens=max_new_tokens,
+                                  eos_token_id=eos_token_id)
         self._queue.append(req)
         return req
 
@@ -507,55 +558,142 @@ class ContinuousBatchingEngine:
         return int(jax.random.categorical(
             k, logits[0] / self.temperature))
 
+    def admit_request(self, req: GenerationRequest) -> bool:
+        """Place ``req`` into a free slot, reserving its pages (prefix-
+        shared where the trie already holds them). Returns False when
+        every slot is busy; raises
+        :class:`~paddle_tpu.serving.PoolExhausted` when the pool can't
+        cover it. Admission only RESERVES pages; the request's tokens
+        prefill chunk-by-chunk in :meth:`prefill_step`.
+
+        A previously PREEMPTED request re-admits through the same path:
+        its replay sequence (``resume_sequence()`` — prompt + generated
+        tokens minus the last) reserves pages and replays through the
+        continuation-prefill program, so resume is token-identical to
+        an uninterrupted run."""
+        cache = self.cache
+        free = cache.free_slots()
+        if not free:
+            return False
+        slot = free[0]
+        seq = req.resume_sequence()
+        _, shared = cache.admit_prompt(
+            slot, seq, req.prompt.shape[1] + req.max_new_tokens)
+        req.slot = slot
+        self._slots[slot] = req
+        self._pending[slot] = [req, seq, int(shared)]
+        if req.preemptions > 0:
+            # resume re-entry: the replay cost has its own counter —
+            # counting it as an admission would drift the occupancy
+            # identity (admissions - evictions - preemptions), and its
+            # generated-token replay is NOT a prompt prefix miss (it
+            # would collapse the dashboarded prefix hit rate)
+            _obs.serving_resumed(1, seq.size - int(shared))
+        else:
+            # full sequence size here — the prefix hit/miss split is
+            # the serving_prefix pair's job, and the chunk-token
+            # counter already measures tokens actually forwarded
+            _obs.serving_admitted(1, seq.size)
+            _obs.serving_prefix(int(shared), seq.size - int(shared))
+        return True
+
+    def preempt_request(self, req: GenerationRequest) -> int:
+        """Evict a RUNNING request's pages back to the pool (the
+        scheduler's evict-for-preempt: refcounts drop; pages shared
+        with the prefix trie or other tables survive under those
+        references) and reset the request for a token-identical resume
+        via :meth:`admit_request`. ``finish_reason`` reads the
+        transient ``preempted`` until the resume's replay prefill
+        completes; ``done`` stays False. Returns the number of pages
+        actually returned to the free list."""
+        slot = req.slot
+        if slot is None or self._slots[slot] is not req:
+            raise ValueError(
+                f"preempt_request: request {req.rid} is not running")
+        self._pending.pop(slot, None)
+        freed = self.cache.evict_for_preempt(slot)
+        self._slots[slot] = None
+        req.slot = None
+        req.preemptions += 1
+        req.finish_reason = "preempted"
+        _obs.serving_preempted(1, freed)
+        return freed
+
+    def cancel_request(self, req: GenerationRequest,
+                       reason: str = "cancelled"):
+        """Finish ``req`` without further decode (e.g. a scheduler's
+        ``deadline_exceeded``): a running request releases its slot and
+        pages, a queued/preempted one just marks done. Idempotent on
+        finished requests."""
+        if req.done:
+            return
+        if req.slot is not None and self._slots[req.slot] is req:
+            self._pending.pop(req.slot, None)
+            self._retire(req, reason)
+            return
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass                        # scheduler-owned queue entry
+        req.done = True
+        req.finish_reason = reason
+        if req.preemptions > 0:
+            # preempted awaiting resume: it WAS admitted (its pages
+            # already freed at preempt time) — the cancel finalizes
+            # the retirement so admissions - evictions drains to zero
+            _obs.serving_retired(1, reason)
+        else:
+            # never held a slot/pages: a cancellation, NOT an eviction
+            _obs.serving_cancelled(1, reason)
+
     def _admit(self):
         """Fill free slots from the queue (FIFO; a head-of-line request
         the pool can't cover yet blocks admission — fairness over
-        utilization). Admission only RESERVES pages (mapping any
-        trie-shared prefix span into the block table); the prompt's
-        remaining tokens prefill chunk-by-chunk in :meth:`_prefill_step`
-        so one long admission cannot stall the in-flight decodes."""
+        utilization). Priority-aware admission lives in
+        :class:`~paddle_tpu.serving.ServingScheduler`, which calls
+        :meth:`admit_request` directly."""
         from ..serving import PoolExhausted
-        cache = self.cache
-        for slot in cache.free_slots():
-            if not self._queue:
-                break
-            req = self._queue[0]
-            S = req.prompt.shape[1]
+        while self._queue:
             try:
-                _, shared = cache.admit_prompt(
-                    slot, req.prompt[0], S + req.max_new_tokens)
+                if not self.admit_request(self._queue[0]):
+                    break               # no free slot
             except PoolExhausted:
-                if not cache.active.any():
+                if not self.cache.active.any():
                     raise  # nothing running will ever free pages
                 break
             self._queue.pop(0)
-            req.slot = slot
-            self._slots[slot] = req
-            self._pending[slot] = [req, int(shared)]
-            # full prompt size here — the prefix hit/miss split is the
-            # serving_prefix pair's job, and the chunk-token counter
-            # already measures tokens actually forwarded
-            _obs.serving_admitted(1, S)
-            _obs.serving_prefix(int(shared), S - int(shared))
 
-    def _prefill_step(self):
-        """Advance chunked prefill by ONE static-shape chunk (the
-        oldest pending admission, FIFO): the per-step latency added to
+    def prefill_step(self, slot: Optional[int] = None,
+                     max_tokens: Optional[int] = None) -> int:
+        """Advance ONE pending admission by one static-shape chunk
+        (default: the oldest, FIFO): the per-step latency added to
         in-flight decodes is bounded by one chunk's forward instead of
-        a whole prompt's. The final chunk's logits (taken at the last
-        VALID token) seed sampling, and the completed prompt's pages
-        are published to the prefix trie for future admissions."""
+        a whole prompt's. ``max_tokens`` caps the chunk width (floored
+        to a page multiple — the scheduler's token-budget debit must be
+        a hard ceiling); returns the width actually scheduled (0 when
+        nothing was). The final chunk's logits (taken at the last VALID
+        token) seed sampling — except on a preemption RESUME, where the
+        next token is already known and is fed back into decode instead
+        — and the completed prompt's pages are published to the prefix
+        trie for future admissions."""
         if not self._pending:
-            return
+            return 0
         cache = self.cache
-        slot = min(self._pending, key=lambda s: self._pending[s][0].rid)
-        req, done = self._pending[slot]
-        S = req.prompt.shape[1]
+        if slot is None:
+            slot = min(self._pending,
+                       key=lambda s: self._pending[s][0].rid)
+        req, seq, done = self._pending[slot]
+        S = seq.size
         page = cache.page_size
         remaining = S - done
         width = cache.pages_for(remaining) * page
         if self.prefill_chunk is not None:
             width = min(width, self.prefill_chunk)
+        if max_tokens is not None:
+            cap = (int(max_tokens) // page) * page
+            if cap < page:
+                return 0
+            width = min(width, cap)
         take = min(remaining, width)
         # ctx_cap buckets UP to a power-of-two page count so the
         # (ctx_cap, width) compile-key space stays O(width_buckets *
@@ -571,7 +709,7 @@ class ContinuousBatchingEngine:
             ctx_pages = min(p2, cache.pages_per_seq)
         ctx_cap = ctx_pages * page
         chunk = np.zeros((1, width), np.int32)
-        chunk[0, :take] = req.prompt[0, done:done + take]
+        chunk[0, :take] = seq[done:done + take]
         t0 = _obs.generate_begin()
         logits, cache.pool = self._chunk_fn(ctx_cap, width)(
             self.params, jnp.asarray(chunk), cache.pool,
@@ -580,21 +718,35 @@ class ContinuousBatchingEngine:
         _obs.serving_prefill_chunk(t0, logits, take)
         done += take
         if done < S:
-            self._pending[slot][1] = done
-            return
+            self._pending[slot][2] = done
+            return width
         del self._pending[slot]
         cache.register_prefix(slot, req.prompt[0])
-        first = self._sample_first(logits)
         cache.lengths[slot] = S
-        self._last[slot] = first
-        self._record_token(req, first)
+        req.finish_reason = None            # clears transient "preempted"
+        if req.tokens:
+            # preemption resume: the replay covered prompt +
+            # tokens[:-1]; decode continues from the already-sampled
+            # last token (its KV lands on the next decode step, exactly
+            # as in the uninterrupted run). The final chunk's logits
+            # are what the original step already sampled from — no
+            # re-sampling, or the resumed request would fork.
+            self._last[slot] = np.int32(req.tokens[-1])
+        else:
+            # fresh admission, or a resume of a victim preempted
+            # mid-prefill (no token sampled yet): seed from the final
+            # chunk's logits either way
+            first = self._sample_first(logits)
+            self._last[slot] = first
+            self._record_token(req, first)
+        return width
 
     def _record_token(self, req: GenerationRequest, tok: int):
         req.tokens.append(int(tok))
         if req.eos_token_id is not None and tok == req.eos_token_id:
             self._retire(req, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
-            self._retire(req, "length")
+            self._retire(req, "max_len")
 
     def _retire(self, req: GenerationRequest, reason: str):
         req.done = True
@@ -603,31 +755,35 @@ class ContinuousBatchingEngine:
         self._slots[req.slot] = None
         _obs.serving_retired(1, reason)
 
-    def step(self) -> bool:
-        """Admit, advance chunked prefill by one chunk, then advance
-        every fully prefilled slot one decode token. Returns False when
-        no work remains (queue empty, all slots idle)."""
-        self._admit()
-        self._prefill_step()
-        cache = self.cache
-        # decode only slots whose prompt is fully in the pool; slots
-        # mid-prefill hold pages (active) but skip the decode program
-        ready = cache.active.copy()
+    def ready_mask(self) -> np.ndarray:
+        """(max_batch,) bool — slots whose sequence is fully in the
+        pool and can decode this step; slots mid-prefill hold pages
+        (active) but skip the decode program."""
+        ready = self.cache.active.copy()
         for s in self._pending:
             ready[s] = False
-        if not ready.any():
-            return bool(self._queue or self._pending
-                        or cache.active.any())
+        return ready
+
+    def decode_step(self, mask) -> int:
+        """Advance every ``mask`` slot one decode token through the
+        single jitted ragged decode program (callers pass
+        :meth:`ready_mask` or a scheduler's budgeted subset of it).
+        Returns the number of slots advanced (0 skips the program
+        entirely)."""
+        cache = self.cache
+        mask = np.asarray(mask, bool)
+        if not mask.any():
+            return 0
         self._key, k = jax.random.split(self._key)
         nxt, cache.pool = self._decode()(
             self.params, jnp.asarray(self._last), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths),
-            jnp.asarray(ready), k)
+            jnp.asarray(mask), k)
         nxt = np.asarray(nxt)
-        n_active = int(ready.sum())
+        n_active = int(mask.sum())
         for slot, req in enumerate(self._slots):
-            if req is None or not ready[slot]:
+            if req is None or not mask[slot]:
                 continue
             cache.lengths[slot] += 1
             self._last[slot] = nxt[slot]
@@ -636,12 +792,52 @@ class ContinuousBatchingEngine:
         alloc = cache.allocator
         _obs.serving_step(n_active, self.max_batch, alloc.num_used,
                           alloc.num_usable)
-        return bool(self._queue) or bool(cache.active.any())
+        return n_active
+
+    def step(self) -> bool:
+        """Admit (FIFO), advance chunked prefill by one chunk, then
+        advance every fully prefilled slot one decode token. Returns
+        False when no work remains (queue empty, all slots idle).
+        Priority/budget/preemption scheduling composes the same pieces
+        from :class:`~paddle_tpu.serving.ServingScheduler`."""
+        self._admit()
+        self.prefill_step()
+        if self.decode_step(self.ready_mask()) == 0:
+            return bool(self._queue or self._pending
+                        or self.cache.active.any())
+        return bool(self._queue) or bool(self.cache.active.any())
 
     def run(self) -> None:
         """Drive steps until every submitted request finished."""
         while self.step():
             pass
+
+    # ---- scheduler-facing state accessors ----
+    @property
+    def idle(self) -> bool:
+        """True when nothing is queued, mid-prefill, or decoding — the
+        state an external scheduler requires at attach time."""
+        return not (self._queue or self._pending
+                    or self.cache.active.any())
+
+    def running_requests(self) -> List[GenerationRequest]:
+        """Live requests currently holding slots (mid-prefill ones
+        included) — the preemption-victim candidate set."""
+        return [r for r in self._slots if r is not None]
+
+    def queued_requests(self) -> List[GenerationRequest]:
+        """Requests waiting in the engine's OWN FIFO queue (the
+        scheduler-less :meth:`submit` path; empty under an attached
+        :class:`~paddle_tpu.serving.ServingScheduler`, which owns its
+        queues)."""
+        return list(self._queue)
+
+    def pending_prefills(self) -> Dict[int, tuple]:
+        """``slot -> (request, remaining_tokens)`` for every admission
+        whose sequence is not yet fully in the pool — the planner's
+        prefill work items."""
+        return {s: (ent[0], int(ent[1].size - ent[2]))
+                for s, ent in self._pending.items()}
 
     def generate(self, prompts, max_new_tokens: int = 16) -> List[np.ndarray]:
         """Convenience batch API: submit all, run to completion, return

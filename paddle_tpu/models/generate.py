@@ -192,7 +192,16 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     the KV a monolithic prefill's rows ``[ctx_len, ctx_len + chunk)``
     would see — cached rows are bit-identical and masked columns
     contribute exact zeros — so chunked + prefix-shared prefill stays
-    TOKEN-IDENTICAL to the dense path."""
+    TOKEN-IDENTICAL to the dense path.
+
+    This one program serves THREE consumers: chunked prefill of a fresh
+    admission, the prefix-cache continuation (``ctx_len`` > 0 on the
+    first chunk), and the SLO scheduler's preemption RESUME — a
+    preempted request replays ``prompt + generated[:-1]`` through here
+    to rebuild its evicted pages (decode then re-feeds the last sampled
+    token), which is why resume is bit-identical to an uninterrupted
+    run rather than approximately so (gated in tests/test_scheduler.py
+    at fp and int8-KV)."""
     B, C = tokens.shape
     if B != 1:
         raise ValueError(

@@ -167,8 +167,11 @@ def predictor_run(t0_ns: int, batch: int):
 # ---------------- continuous-batching serving ----------------
 
 def serving_admitted(n: int, prompt_tokens: int):
-    """A request entered a decode slot (admission counter + prefill
-    token counter)."""
+    """A FRESH request entered a decode slot (admission counter +
+    prefill token counter). Preemption resumes re-enter through
+    ``serving_resumed`` instead, so drained occupancy satisfies
+    ``admissions - evictions == 0`` (resumes == preemptions cancel
+    out)."""
     if not enabled:
         return
     _m.counter("serving_admissions_total",
@@ -215,14 +218,94 @@ def serving_prefill_chunk(t0_ns: int, out, tokens: int):
                    ).inc(tokens)
 
 
+def serving_cancelled(n: int, reason: str):
+    """A request was cancelled while QUEUED — it never held a slot or
+    pages (e.g. the scheduler's ``deadline_exceeded``), so it must not
+    count as an eviction: admissions - evictions is an occupancy
+    derivation and would go negative."""
+    if not enabled:
+        return
+    _m.counter("serving_cancellations_total",
+               "queued requests cancelled before admission (never held "
+               "a slot)", ("reason",)).labels(reason).inc(n)
+
+
 def serving_retired(n: int, reason: str):
-    """A request left its slot and recycled its pages; ``reason`` is
-    ``eos`` / ``length`` / ``evicted``."""
+    """A request left its slot and recycled its pages; ``reason`` is a
+    structured finish reason (``eos`` / ``max_len`` /
+    ``deadline_exceeded`` / other cancellations of RUNNING requests —
+    queued-request cancellations count in
+    ``serving_cancellations_total`` instead)."""
     if not enabled:
         return
     _m.counter("serving_evictions_total",
                "requests retired from decode slots",
                ("reason",)).labels(reason).inc(n)
+
+
+def serving_preempted(n: int, pages_freed: int):
+    """A running request's pages were evicted back to the pool to make
+    room for a higher-priority admission (it will resume token-
+    identically later). ``pages_freed`` counts pages that actually
+    reached the free list — trie-shared pages survive elsewhere."""
+    if not enabled:
+        return
+    _m.counter("serving_preemptions_total",
+               "requests preempted (pages evicted for higher-priority "
+               "admissions)").inc(n)
+    _m.counter("serving_preempt_pages_freed_total",
+               "pages returned to the pool by preemption evictions"
+               ).inc(pages_freed)
+
+
+def serving_resumed(n: int, replay_tokens: int):
+    """A preempted request re-entered a slot; ``replay_tokens`` is the
+    continuation-prefill work its eviction cost (tokens re-forwarded —
+    prefix-trie survivors subtract from it)."""
+    if not enabled:
+        return
+    _m.counter("serving_resumes_total",
+               "preempted requests resumed into decode slots").inc(n)
+    _m.counter("serving_resume_replay_tokens_total",
+               "tokens re-prefilled by preemption resumes"
+               ).inc(replay_tokens)
+
+
+def serving_queue_wait(seconds: float, priority: int):
+    """One admission's time-in-queue (scheduler submit -> slot), by
+    priority class — the SLO the scheduler exists to bound."""
+    if not enabled:
+        return
+    _m.histogram("serving_time_in_queue_seconds",
+                 "seconds from scheduler submit to slot admission",
+                 ("priority",),
+                 buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 1, 2.5, 5, 10,
+                          30, 60, 120)).labels(str(int(priority))
+                                               ).observe(seconds)
+
+
+def serving_sched_step(queue_depths, scheduled_tokens: int, budget):
+    """One scheduler step: per-class queue-depth gauges + the
+    budget-utilization gauge (skipped when no budget is configured).
+    ``queue_depths`` maps priority class -> queued requests; classes
+    that have EVER queued keep reporting (a depth that drops to zero
+    must overwrite the stale gauge, not vanish)."""
+    if not enabled:
+        return
+    g = _m.gauge("serving_queue_depth",
+                 "queued requests awaiting admission, by priority class",
+                 ("priority",))
+    for prio, depth in queue_depths.items():
+        g.labels(str(int(prio))).set(depth)
+    _m.counter("serving_sched_steps_total",
+               "SLO-scheduler steps planned").inc()
+    _m.counter("serving_sched_tokens_total",
+               "tokens scheduled by the step planner (decode slots + "
+               "prefill-chunk widths)").inc(scheduled_tokens)
+    if budget:
+        _m.gauge("serving_step_budget_utilization",
+                 "fraction of the per-step token budget the planner "
+                 "scheduled").set(scheduled_tokens / budget)
 
 
 def serving_step(active: int, max_slots: int, pages_used: int,

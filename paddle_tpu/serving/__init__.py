@@ -1,8 +1,15 @@
-"""Serving subsystem: paged KV cache + continuous batching.
+"""Serving subsystem: paged KV cache + continuous batching + the
+SLO-aware scheduler.
 
 - :mod:`paddle_tpu.serving.paged_cache` — global page pools, per-request
-  block tables, the host-side :class:`BlockAllocator` (alloc/free/defrag
-  stats) and :class:`PagedKVCache` bundle.
+  block tables, the host-side :class:`BlockAllocator` (refcounted pages,
+  alloc/free/defrag stats), the :class:`PrefixCache` hash-trie and the
+  :class:`PagedKVCache` bundle (incl. the ``evict_for_preempt`` API).
+- :mod:`paddle_tpu.serving.policy` — :class:`Priority` classes,
+  structured :class:`FinishReason`, the :class:`TokenBudgetPlanner`
+  step packer and the :class:`PreemptionPolicy` victim selector.
+- :mod:`paddle_tpu.serving.scheduler` — :class:`ServingScheduler`, the
+  priority/deadline/preemption control plane over the engine.
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -12,3 +19,8 @@
 from .paged_cache import (  # noqa: F401
     TRASH_PAGE, BlockAllocator, PagedKVCache, PoolExhausted, PrefixCache,
 )
+from .policy import (  # noqa: F401
+    FinishReason, PreemptionPolicy, Priority, StepPlan,
+    TokenBudgetPlanner,
+)
+from .scheduler import ServingScheduler  # noqa: F401

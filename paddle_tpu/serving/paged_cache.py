@@ -553,8 +553,31 @@ class PagedKVCache:
         self.lengths[slot] = 0
         self.active[slot] = False
 
+    def evict_for_preempt(self, slot: int) -> int:
+        """Preemption eviction: release ``slot``'s page references back
+        to the pool and report how many pages actually reached the free
+        list. Pages the prefix trie (or another table) still references
+        survive under those references — the preemptor's own
+        allocation reclaims trie-only copies through the usual
+        evict-on-pressure path if the freed count alone doesn't cover
+        it, and a later resume can map surviving trie pages straight
+        back in. The slot's KV rows are NOT zeroed: freed pages carry
+        finite garbage until their next tenant overwrites them, the
+        same contract every release already relies on."""
+        if not self.active[slot]:
+            raise ValueError(f"evict_for_preempt of inactive slot {slot}")
+        before = self.allocator.num_free
+        self.release(slot)
+        return self.allocator.num_free - before
+
     def free_slots(self) -> List[int]:
         return [i for i in range(self.max_batch) if not self.active[i]]
+
+    def pages_held(self, slot: int) -> List[int]:
+        """The page ids ``slot``'s block table currently references
+        (copy) — e.g. the scheduler's preemption-feasibility
+        accounting of pages pinned by non-victim requests."""
+        return list(self._slot_pages[slot])
 
     def utilization(self) -> float:
         return self.allocator.utilization()

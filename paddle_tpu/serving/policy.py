@@ -1,0 +1,175 @@
+"""Scheduling policy for the SLO-aware serving scheduler.
+
+Pure host-side policy objects — no jax, no device state — consumed by
+:class:`paddle_tpu.serving.scheduler.ServingScheduler`:
+
+- :class:`Priority`: the request priority classes (lower value = more
+  important; plain ints are accepted anywhere a Priority is).
+- :class:`FinishReason`: the structured per-request finish reasons the
+  engine reports (``eos`` / ``max_len`` on completion, the transient
+  ``preempted`` while a request sits evicted awaiting resume, and
+  ``deadline_exceeded`` when the scheduler cancels a queued request
+  whose SLO already lapsed).
+- :class:`StepPlan` / :class:`TokenBudgetPlanner`: the per-step
+  token-budget packing — how many decode slots advance and how many
+  prefill-chunk tokens forward this step, bounding step latency.
+- :class:`PreemptionPolicy`: victim selection when a higher-priority
+  admission cannot be satisfied from the free list.
+
+Design shape: Orca/vLLM-style continuous-batching scheduling on
+page-granular preemption — the Ragged Paged Attention design
+(PAPERS.md) makes attention cost length-proportional precisely so a
+planner like this can pack mixed workloads against a token budget.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional, Sequence, Tuple
+
+
+class Priority(enum.IntEnum):
+    """Request priority classes; LOWER value = MORE important (class 0
+    preempts class 1 preempts class 2). Any int is accepted where a
+    Priority is expected — the named classes are the common tiers."""
+    HIGH = 0
+    NORMAL = 1
+    LOW = 2
+
+
+class FinishReason(str, enum.Enum):
+    """Structured per-request finish reasons (``str``-valued, so
+    ``req.finish_reason == "eos"`` keeps working for callers that
+    compare against plain strings)."""
+    EOS = "eos"                               # hit the eos token
+    MAX_LEN = "max_len"                       # exhausted max_new_tokens
+    PREEMPTED = "preempted"                   # transient: evicted, will resume
+    DEADLINE_EXCEEDED = "deadline_exceeded"   # cancelled before admission
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """One engine step's work, as the planner budgeted it.
+
+    ``decode_slots``: slot ids that advance one decode token (cost: one
+    token each). ``prefills``: ``(slot, token_cap)`` pairs — each named
+    pending admission forwards at most ``token_cap`` prompt tokens of
+    chunked prefill this step (page-multiple caps; the engine takes
+    ``min(cap, remaining, prefill_chunk)``). ``deferred_decodes`` counts
+    ready slots the budget pushed to a later step — the observable
+    fairness cost of a tight budget."""
+    decode_slots: List[int] = dataclasses.field(default_factory=list)
+    prefills: List[Tuple[int, int]] = dataclasses.field(
+        default_factory=list)
+    budget: Optional[int] = None
+    deferred_decodes: int = 0
+
+    @property
+    def scheduled_tokens(self) -> int:
+        """The step's token debit: one per decode slot + every prefill
+        cap — the quantity the budget bounds."""
+        return len(self.decode_slots) + sum(c for _, c in self.prefills)
+
+
+class TokenBudgetPlanner:
+    """Greedy priority-ordered packing of one step under a token budget.
+
+    Work items are unified: a ready decode slot costs ONE token, a
+    pending prefill chunk costs its page-rounded width. Items are taken
+    in ``(priority, rid)`` order — so a HIGH-priority admission's
+    prefill outranks a LOW-priority decode, and within a class age wins
+    (FIFO). A prefill is taken only when at least one whole page of
+    budget remains (its width is floored to a page multiple, so the
+    budget is a hard ceiling, never rounded through); a decode costs 1
+    and can always use the tail of the budget.
+
+    ``token_budget=None`` disables budgeting: every ready slot decodes
+    and the single highest-priority pending admission advances one
+    chunk (the engine's native one-chunk-per-step latency bound).
+    """
+
+    def __init__(self, token_budget: Optional[int], page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if token_budget is not None and token_budget < page_size:
+            # a budget below one page can never schedule a prefill
+            # chunk: a queue holding only pending prefills would spin
+            # forever. Reject at construction, loudly.
+            raise ValueError(
+                f"token_budget={token_budget} is smaller than one "
+                f"{page_size}-token page — chunked prefill could never "
+                f"be scheduled and pending admissions would starve")
+        self.token_budget = token_budget
+        self.page_size = page_size
+
+    def plan(self, decode_ready: Sequence[Tuple[int, int, int]],
+             pending: Sequence[Tuple[int, int, int, int]],
+             chunk_cap: Optional[int] = None) -> StepPlan:
+        """Build one step's :class:`StepPlan`.
+
+        decode_ready: ``(priority, rid, slot)`` per decodable slot
+        pending:      ``(priority, rid, slot, remaining_tokens)`` per
+                      mid-prefill admission
+        chunk_cap:    the engine's ``prefill_chunk`` (already
+                      page-rounded) or None
+        """
+        page = self.page_size
+        if self.token_budget is None:
+            plan = StepPlan([s for _, _, s in
+                             sorted(decode_ready)], [], None)
+            if pending:
+                _, _, slot, remaining = min(pending)
+                width = -(-remaining // page) * page
+                if chunk_cap is not None:
+                    width = min(width, chunk_cap)
+                plan.prefills.append((slot, width))
+            return plan
+        left = self.token_budget
+        plan = StepPlan(budget=self.token_budget)
+        items = [(p, rid, "decode", slot, 1)
+                 for p, rid, slot in decode_ready]
+        for p, rid, slot, remaining in pending:
+            width = -(-remaining // page) * page
+            if chunk_cap is not None:
+                width = min(width, chunk_cap)
+            items.append((p, rid, "prefill", slot, width))
+        for p, rid, kind, slot, cost in sorted(
+                items, key=lambda it: (it[0], it[1])):
+            if kind == "decode":
+                if left >= 1:
+                    plan.decode_slots.append(slot)
+                    left -= 1
+                else:
+                    plan.deferred_decodes += 1
+            else:
+                afford = (left // page) * page
+                if afford >= page:
+                    take = min(cost, afford)
+                    plan.prefills.append((slot, take))
+                    left -= take
+        return plan
+
+
+class PreemptionPolicy:
+    """Victim selection for evict-for-preempt admissions.
+
+    A victim must be STRICTLY lower class (numerically greater
+    priority value) than the incoming request — preemption never
+    reorders within a class. Among eligible victims the policy picks
+    the lowest class first, then the fewest generated tokens (the
+    cheapest token-identical resume replay), then the youngest request
+    (highest rid) — so the work already sunk into older, further-along
+    requests is preserved.
+    """
+
+    def pick_victim(self, running, priority: int):
+        """``running``: live request objects (``.priority`` /
+        ``.tokens`` / ``.rid``); returns one or None."""
+        cands = [r for r in running if r.priority > int(priority)]
+        if not cands:
+            return None
+        return max(cands,
+                   key=lambda r: (r.priority, -len(r.tokens), r.rid))

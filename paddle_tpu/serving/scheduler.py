@@ -1,0 +1,279 @@
+"""SLO-aware serving scheduler: the control plane over the
+continuous-batching engine.
+
+PRs 2–3 built the data plane — paged KV pool, refcounted prefix cache,
+chunked prefill, one static-shape ragged decode program — but admission
+stayed FIFO and best-effort: a burst of long prompts starves in-flight
+decodes, and under :class:`~paddle_tpu.serving.PoolExhausted` the engine
+can only back-pressure, never reclaim. :class:`ServingScheduler` closes
+that gap (design shape: Orca/vLLM-style schedulers on page-granular
+preemption):
+
+- **Priority queues** — requests carry a priority class
+  (:class:`~paddle_tpu.serving.policy.Priority`; lower = more
+  important) and admit strictly by class, FIFO within a class.
+- **Token-budgeted step planning** — per step a
+  :class:`~paddle_tpu.serving.policy.TokenBudgetPlanner` packs decode
+  slots (1 token each) and prefill chunks (page-rounded widths) in
+  priority order under ``token_budget``, bounding the latency of every
+  engine step; ready work the budget defers runs on later steps.
+- **Preempt / resume over paged KV** — when a higher-priority admission
+  cannot be satisfied, a
+  :class:`~paddle_tpu.serving.policy.PreemptionPolicy` victim's pages
+  are evicted back to the pool
+  (:meth:`~paddle_tpu.serving.PagedKVCache.evict_for_preempt`; pages
+  shared with the prefix trie survive under the trie's references and
+  reclaim via the allocator's evict-on-pressure path) and the victim
+  requeues at the FRONT of its class. Resume replays ``prompt +
+  tokens[:-1]`` through the PR-3 continuation-prefill program
+  (:func:`~paddle_tpu.models.generate.paged_prefill_chunk`) — prefix
+  pages still in the trie map straight back in — and continues decoding
+  from the last sampled token, TOKEN-IDENTICAL to an uninterrupted run
+  (gated in ``tests/test_scheduler.py`` at fp and int8-KV).
+- **Deadlines** — a queued request whose ``deadline_s`` lapses before
+  admission is cancelled with the structured finish reason
+  ``deadline_exceeded`` instead of silently aging in the queue. The
+  deadline is an ADMISSION SLO: a request that was admitted in time
+  and later preempted already met it, so preempted requeues resume
+  instead of being cancelled.
+
+Telemetry (paddle_tpu.observability): per-class queue-depth gauges,
+preemption/resume counters, a time-in-queue histogram, and a per-step
+budget-utilization gauge — zero-cost when metrics are disabled.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, Optional
+
+import numpy as np
+
+from ..observability import hooks as _obs
+from .paged_cache import PoolExhausted
+from .policy import (FinishReason, PreemptionPolicy, Priority, StepPlan,
+                     TokenBudgetPlanner)
+
+
+class ServingScheduler:
+    """Request-lifecycle scheduler between callers and a
+    :class:`~paddle_tpu.inference.ContinuousBatchingEngine`.
+
+    The scheduler OWNS the engine: callers submit through
+    :meth:`submit` (never ``engine.submit``) and drive :meth:`step` /
+    :meth:`run`; the engine's own FIFO queue stays empty. ``clock`` is
+    injectable (monotonic seconds) so deadline behavior is testable.
+    """
+
+    def __init__(self, engine, *, token_budget: Optional[int] = None,
+                 enable_preemption: bool = True,
+                 planner: Optional[TokenBudgetPlanner] = None,
+                 preemption_policy: Optional[PreemptionPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not engine.idle:
+            raise ValueError(
+                "ServingScheduler requires a fresh engine: it owns "
+                "admission, and requests already queued or running "
+                "through the engine's FIFO path would bypass priority")
+        self.engine = engine
+        self.planner = planner or TokenBudgetPlanner(
+            token_budget, engine.cache.page_size)
+        self.preemption = (preemption_policy or PreemptionPolicy()
+                           if enable_preemption else None)
+        self.clock = clock
+        self._queues: Dict[int, Deque] = {}
+        self.last_plan: Optional[StepPlan] = None
+        self._steps = 0
+        self.preemptions_total = 0
+        self.resumes_total = 0
+        self.deadline_cancels_total = 0
+
+    # ---- intake ----
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               priority=Priority.NORMAL,
+               deadline_s: Optional[float] = None, eos_token_id=None):
+        """Queue a prompt with a priority class and an optional
+        admission deadline (seconds from now; a request still queued
+        when it lapses is cancelled with ``deadline_exceeded``).
+        Returns the request handle (``.done`` / ``.tokens`` /
+        ``.output`` / ``.finish_reason`` fill in as steps run)."""
+        req = self.engine.create_request(
+            prompt, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id)
+        req.priority = int(priority)
+        req.submitted_at = req.enqueued_at = self.clock()
+        if deadline_s is not None:
+            req.deadline_at = req.submitted_at + float(deadline_s)
+        self._queues.setdefault(int(priority), deque()).append(req)
+        return req
+
+    # ---- per-step phases ----
+    def _expire_deadlines(self, now: float):
+        """Cancel queued requests whose admission deadline lapsed. The
+        deadline is an ADMISSION SLO: a request the scheduler already
+        admitted once and then preempted (``preemptions > 0``) met it —
+        cancelling would discard finished work because of the
+        scheduler's own eviction, so preempted requeues are exempt and
+        simply resume."""
+        def expired(r):
+            return (r.deadline_at is not None and now >= r.deadline_at
+                    and r.preemptions == 0)
+        for prio, q in self._queues.items():
+            if not any(expired(r) for r in q):
+                continue
+            keep: Deque = deque()
+            for req in q:
+                if expired(req):
+                    self.engine.cancel_request(
+                        req, FinishReason.DEADLINE_EXCEEDED.value)
+                    self.deadline_cancels_total += 1
+                else:
+                    keep.append(req)
+            self._queues[prio] = keep
+
+    def _preempt_for(self, req) -> bool:
+        """Evict one strictly-lower-class running request to make room
+        for ``req``; the victim requeues at the FRONT of its class (it
+        already waited its turn once). Returns False when no eligible
+        victim exists."""
+        if self.preemption is None:
+            return False
+        running = self.engine.running_requests()
+        victim = self.preemption.pick_victim(running, req.priority)
+        if victim is None:
+            return False
+        self.engine.preempt_request(victim)
+        self.preemptions_total += 1
+        victim.enqueued_at = self.clock()   # queue wait restarts here
+        self._queues.setdefault(int(victim.priority),
+                                deque()).appendleft(victim)
+        return True
+
+    def _preemption_feasible(self, req) -> bool:
+        """Optimistic feasibility bound before evicting ANYONE for a
+        pool shortfall: every usable page not pinned by an
+        equal-or-higher-class table is reclaimable in principle (free
+        pages, strictly-lower-class victims' pages, trie-held pages —
+        the allocator's evict-on-pressure path reaches the last). If
+        even that bound can't cover the request, preempting would cost
+        each victim an eviction + full resume replay and the admission
+        would STILL fail — bail out with zero casualties instead."""
+        cache = self.engine.cache
+        pinned = set()
+        for r in self.engine.running_requests():
+            if r.priority <= int(req.priority):
+                pinned.update(cache.pages_held(r.slot))
+        need = cache.pages_for(req.prompt.shape[1] + req.max_new_tokens)
+        return need <= cache.allocator.num_usable - len(pinned)
+
+    def _admit_one(self, req) -> bool:
+        eng = self.engine
+        while True:
+            if not eng.cache.free_slots():
+                # no slot: preempt only when the POOL side can work out
+                # too (feasibility), else the victim pays for nothing
+                if not (self._preemption_feasible(req)
+                        and self._preempt_for(req)):
+                    return False
+                continue                # preemption freed a slot; retry
+            try:
+                return eng.admit_request(req)
+            except PoolExhausted:
+                # a slot is free but the POOL can't cover the request:
+                # evict a lower-class victim's pages and retry. Each
+                # round removes one running request, so this terminates.
+                if not (self._preemption_feasible(req)
+                        and self._preempt_for(req)):
+                    return False
+
+    def _admit(self, now: float):
+        """Admit strictly by class (FIFO within a class). A blocked
+        head-of-class blocks everything below it — admitting a smaller
+        lower-class request around a starved higher-class one would be
+        priority inversion by another name."""
+        for prio in sorted(self._queues):
+            q = self._queues[prio]
+            while q:
+                req = q[0]
+                if req.done:
+                    # cancelled while queued (e.g. a caller's direct
+                    # engine.cancel_request): admitting would decode it
+                    # anyway and overwrite the cancellation
+                    q.popleft()
+                    continue
+                if not self._admit_one(req):
+                    return
+                q.popleft()
+                if req.preemptions > 0:
+                    self.resumes_total += 1
+                # time-in-queue since the LATEST enqueue: a resumed
+                # request's prior running time is not queue wait. The
+                # clamp covers a victim preempted and re-admitted
+                # within this same pass (its requeue stamp postdates
+                # ``now``) — that wait is zero, not negative.
+                _obs.serving_queue_wait(
+                    max(0.0, now - req.enqueued_at), prio)
+
+    def _plan(self) -> StepPlan:
+        eng = self.engine
+        ready = eng.ready_mask()
+        decode = [(r.priority, r.rid, r.slot)
+                  for r in eng.running_requests() if ready[r.slot]]
+        pending = [(req.priority, req.rid, slot, remaining)
+                   for slot, (req, remaining)
+                   in eng.pending_prefills().items()]
+        return self.planner.plan(decode, pending,
+                                 chunk_cap=eng.prefill_chunk)
+
+    def step(self) -> bool:
+        """One scheduler step: expire deadlines, admit (preempting if
+        needed), plan under the token budget, execute the plan (prefill
+        chunks, then the masked decode program). Returns False when no
+        work remains. ``last_plan`` holds the step's
+        :class:`~paddle_tpu.serving.policy.StepPlan`."""
+        eng = self.engine
+        if eng.queued_requests():
+            # engine.submit() after attach would sit in the engine's
+            # FIFO queue forever (the scheduler only drains its own
+            # priority queues) — step() would spin reporting work
+            # remains while never decoding it. Fail loudly instead.
+            raise ValueError(
+                "requests were queued through engine.submit() after "
+                "the scheduler attached — submit through "
+                "ServingScheduler.submit so priority admission is "
+                "not bypassed")
+        now = self.clock()
+        self._expire_deadlines(now)
+        self._admit(now)
+        plan = self._plan()
+        for slot, cap in plan.prefills:
+            eng.prefill_step(slot, max_tokens=cap)
+        if plan.decode_slots:
+            mask = np.zeros((eng.max_batch,), bool)
+            mask[plan.decode_slots] = True
+            eng.decode_step(mask)
+        self.last_plan = plan
+        self._steps += 1
+        _obs.serving_sched_step(
+            {p: len(q) for p, q in self._queues.items()},
+            plan.scheduled_tokens, plan.budget)
+        return any(self._queues.values()) or not eng.idle
+
+    def run(self) -> None:
+        """Drive steps until every submitted request finished (or was
+        cancelled by its deadline)."""
+        while self.step():
+            pass
+
+    def stats(self) -> Dict:
+        s = self.engine.stats()
+        s["sched_steps"] = self._steps
+        s["sched_queued"] = {int(p): len(q)
+                             for p, q in self._queues.items() if q}
+        s["preemptions_total"] = self.preemptions_total
+        s["resumes_total"] = self.resumes_total
+        s["deadline_cancels_total"] = self.deadline_cancels_total
+        if self.last_plan is not None:
+            s["last_step_tokens"] = self.last_plan.scheduled_tokens
+            s["token_budget"] = self.last_plan.budget
+        return s

@@ -244,7 +244,7 @@ class TestContinuousBatching:
             saw_mixed = saw_mixed or (r1.done and r3.slot is not None
                                       and not r2.done)
         assert saw_mixed, "r3 never ran concurrently with r2 mid-decode"
-        assert r1.finish_reason == r2.finish_reason == "length"
+        assert r1.finish_reason == r2.finish_reason == "max_len"
         ext = eng.cache.max_len
         for r, p, new in ((r1, prompts[0], 2), (r2, prompts[1], 8),
                           (r3, prompts[2], 4)):
@@ -342,7 +342,7 @@ class TestContinuousBatching:
                 obs.disable()
         assert snap["serving_admissions_total"]["values"][""] == 2
         assert snap["serving_evictions_total"]["values"][
-            "reason=length"] == 2
+            "reason=max_len"] == 2
         occ = snap["serving_batch_occupancy"]["values"][""]
         assert occ["count"] >= 1                   # one obs per step
         assert "serving_block_pool_utilization" in snap

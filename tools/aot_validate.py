@@ -327,6 +327,20 @@ def validate_serving(n: int, batch_mult: int = 1):
                 p, t, pl_, bt_, ln_, cfg, use_kernel=True)),
             platforms=["tpu"])(params, toks, pool, tables, lens)
     lowered["ragged_decode_step"] = "tpu_custom_call" in exp.mlir_module()
+    # ISSUE 4 budgeted step program: the SLO scheduler's token budget
+    # reaches the device as a decode MASK (deferred slots skip the
+    # program) — export the MASKED ragged decode step, the exact
+    # program ServingScheduler.step executes, so a mask-handling
+    # regression that interprets green but won't Mosaic-lower is gated
+    msk = jnp.asarray(rs.rand(B) > 0.5)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda p, t, pl_, bt_, ln_, m:
+                    gen.paged_decode_forward(
+                        p, t, pl_, bt_, ln_, cfg, active=m,
+                        use_kernel=True)),
+            platforms=["tpu"])(params, toks, pool, tables, lens, msk)
+    lowered["budgeted_decode_step"] = "tpu_custom_call" in exp.mlir_module()
     chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 32)), jnp.int32)
     exp = jax.export.export(
         jax.jit(lambda p, c, pl_, bt_, cl, kl: gen.paged_prefill_chunk(

@@ -38,6 +38,19 @@ REQUIRED = {
         # per-step latency bound) — ISSUE 3's serving telemetry
         ("_obs.serving_prefix(", 1),
         ("_obs.serving_prefill_chunk(", 1),
+        # preempt/resume lifecycle counters (ISSUE 4): evictions for
+        # higher-priority admissions + the replay cost of resumes;
+        # queued-request cancellations stay OUT of the eviction counter
+        ("_obs.serving_preempted(", 1),
+        ("_obs.serving_resumed(", 1),
+        ("_obs.serving_cancelled(", 1),
+    ],
+    "paddle_tpu/serving/scheduler.py": [
+        # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
+        # every admission, per-class queue-depth gauges + the
+        # budget-utilization gauge once per planned step
+        ("_obs.serving_queue_wait(", 1),
+        ("_obs.serving_sched_step(", 1),
     ],
     "paddle_tpu/models/generate.py": [
         ("_obs.generate_begin()", 1),

@@ -11,7 +11,8 @@ not depend on weight values).
 
 Prints one JSON line:
   {"decode_tokens_per_sec": ..., "decode_paged_tokens_per_sec": ...,
-   "decode_prefix_tokens_per_sec": ...,
+   "decode_prefix_tokens_per_sec": ..., "decode_sched_tokens_per_sec": ...,
+   "decode_sched_step_ms": {"p50_step_ms": ..., "p99_step_ms": ...},
    "decode_int8_tokens_per_sec": ..., "decode_int4_tokens_per_sec": ...,
    "decode_w8kv8_tokens_per_sec": ..., "device": ...,
    "ratios_vs_fp": {...}}
@@ -108,6 +109,17 @@ def main():
     run_tier("decode_prefix_tokens_per_sec",
              lambda: bench_mod.prefix_decode_tier(
                  params, cfg, db, dp_len, dnew, on_tpu))
+
+    # SLO-scheduler control plane (ISSUE 4): oversubscribed
+    # two-priority bursty workload with preempt/evict/resume under a
+    # token-budgeted step planner — also shared with bench.py; the
+    # p50/p99 step-latency dict rides the record separately
+    def _sched():
+        tps, lat = bench_mod.sched_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        out["decode_sched_step_ms"] = lat
+        return tps
+    run_tier("decode_sched_tokens_per_sec", _sched)
     int8_p = {}
 
     def _int8():
@@ -122,7 +134,7 @@ def main():
 
     out.update({k: tiers.get(k) for k in (
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
-        "decode_prefix_tokens_per_sec",
+        "decode_prefix_tokens_per_sec", "decode_sched_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")

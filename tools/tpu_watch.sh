@@ -74,6 +74,7 @@ if dec.get("decode_tokens_per_sec") is not None:
     changed = False
     for k in ("decode_tokens_per_sec", "decode_paged_tokens_per_sec",
               "decode_prefix_tokens_per_sec",
+              "decode_sched_tokens_per_sec",
               "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
               "decode_w8kv8_tokens_per_sec"):
         if dec.get(k) is None:
@@ -100,6 +101,13 @@ if dec.get("decode_tokens_per_sec") is not None:
         if isinstance(src, dict) and src.get(k) != "live":
             src[k] = "live"
             changed = True
+    # the scheduler tier's p50/p99 step-latency dict rides alongside
+    # its throughput number (ISSUE 4: the latency BOUND is the point)
+    ms = dec.get("decode_sched_step_ms")
+    if ms is not None and lg.setdefault("extra", {}).get(
+            "decode_sched_step_ms") != ms:
+        lg["extra"]["decode_sched_step_ms"] = ms
+        changed = True
     if changed:
         lg["extra"]["decode_recorded_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
