@@ -58,6 +58,41 @@ def pick_config():
     return llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256), 256, 2
 
 
+_XLA_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "artifacts", "xla_cache")
+
+
+def enable_persistent_compilation_cache(path: Optional[str] = None):
+    """Point JAX's persistent compilation cache at
+    ``artifacts/xla_cache/`` (VERDICT r5 top_next: five rounds of rc=1
+    are an OPS problem — a short tunnel window must bank every decode
+    tier instead of burning itself on recompiles; with the cache, a
+    re-run after a watchdog kill re-loads the programs the killed run
+    already compiled). Shared by bench.py, tools/decode_bench.py and —
+    via the ``JAX_COMPILATION_CACHE_DIR`` env this helper honors —
+    tools/tpu_watch.sh and tools/aot_validate.py.
+
+    Every compile persists (min-time/min-size thresholds zeroed): the
+    serving programs are individually small but numerous — the bucketed
+    chunk/verify grid is exactly the long tail the default 1s threshold
+    would skip. Returns the cache dir, or None when setup failed (the
+    measurement still runs, uncached — never fail a bench over cache
+    plumbing)."""
+    try:
+        import jax
+        path = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or _XLA_CACHE_DIR)
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return path
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        print(f"persistent compilation cache unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
 _WINNER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "PERF_WINNER.json")
 
@@ -121,7 +156,8 @@ def peak_flops(dev) -> float:
 def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
             decode_w8kv8_tps=None, decode_paged_tps=None,
-            decode_prefix_tps=None, decode_sched=None, phases=None):
+            decode_prefix_tps=None, decode_sched=None,
+            decode_spec=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -139,12 +175,18 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_paged_tokens_per_sec": decode_paged_tps,
                   "decode_prefix_tokens_per_sec": decode_prefix_tps,
                   "decode_sched_tokens_per_sec": (
-                      decode_sched[0] if decode_sched else None)},
+                      decode_sched[0] if decode_sched else None),
+                  "decode_spec_tokens_per_sec": (
+                      decode_spec[0] if decode_spec else None)},
     }
     if decode_sched:
         # the tier's point is the BOUND, not just the throughput:
         # p50/p99 step latency under the bursty two-priority workload
         rec["extra"]["decode_sched_step_ms"] = decode_sched[1]
+    if decode_spec:
+        # the speculative tier's throughput only means something next
+        # to the acceptance rate that produced it — they travel together
+        rec["extra"]["decode_spec_acceptance"] = decode_spec[1]
     if phases is not None:
         rec["phases"] = phases
     return _backfill_decode(rec)
@@ -208,17 +250,21 @@ def _capture_phases(step, state, tokens, cfg):
 
 
 def _engine_tier(params, cfg, db, dnew, max_len, on_tpu, make_prompts,
-                 **engine_kwargs):
+                 between_passes=None, **engine_kwargs):
     """Shared engine-tier measurement scaffold (paged + prefix tiers):
     2x-oversubscribed queue with alternating decode budgets — short
     rows retire mid-run and queued prompts admit into the freed slots,
     exercising the continuous-batching mechanism itself. One warm pass
     (compiles + trie), one timed steady-state pass; ``make_prompts()``
     is called PER PASS so a tier can regenerate its unique parts (the
-    prefix tier must not let the warm pass's full prompts recache).
-    Throughput includes the host scheduling loop (an ENGINE number,
-    not a kernel microbench). Keeping ONE scaffold guarantees the
-    tiers whose delta is reported stay comparable by construction."""
+    prefix tier must not let the warm pass's full prompts recache), and
+    ``between_passes(eng)`` — if given — runs after the warm pass so a
+    tier can snapshot engine counters the timed pass should be deltaed
+    against (the spec tier's acceptance record). Throughput includes
+    the host scheduling loop (an ENGINE number, not a kernel
+    microbench). Keeping ONE scaffold guarantees the tiers whose delta
+    is reported stay comparable by construction. Returns ``(tokens/sec,
+    engine)`` — the engine so tiers can read post-run stats."""
     from paddle_tpu.inference.predictor import ContinuousBatchingEngine
     eng = ContinuousBatchingEngine(
         params, cfg, max_batch=db, page_size=16 if on_tpu else 8,
@@ -232,9 +278,11 @@ def _engine_tier(params, cfg, db, dnew, max_len, on_tpu, make_prompts,
         return sum(r.max_new_tokens for r in reqs)
 
     one_pass()                                      # compile/warm pass
+    if between_passes is not None:
+        between_passes(eng)
     t0 = time.perf_counter()
     toks_out = one_pass()                           # steady state
-    return round(toks_out / (time.perf_counter() - t0), 2)
+    return round(toks_out / (time.perf_counter() - t0), 2), eng
 
 
 def paged_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
@@ -254,7 +302,7 @@ def paged_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                for n in plens]
     return _engine_tier(params, cfg, db, dnew, dp_len + dnew, on_tpu,
                         lambda: prompts, kv_cache_dtype=kv_cache_dtype,
-                        enable_prefix_cache=False)
+                        enable_prefix_cache=False)[0]
 
 
 def prefix_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
@@ -288,7 +336,7 @@ def prefix_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
             for _ in range(2 * db)]
     return _engine_tier(params, cfg, db, dnew, dp_len + dnew, on_tpu,
                         make_prompts, kv_cache_dtype=kv_cache_dtype,
-                        prefill_chunk=2 * page)
+                        prefill_chunk=2 * page)[0]
 
 
 def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
@@ -355,11 +403,73 @@ def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     }
 
 
+def spec_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                     kv_cache_dtype=None):
+    """The decode_spec_tokens_per_sec measurement, shared by measure()
+    and tools/decode_bench.py so the two sources stay comparable.
+
+    The paged-engine workload with SPECULATIVE decoding on (ISSUE 5):
+    n-gram prompt-lookup drafting + the batched greedy verify program,
+    over REPETITIVE prompts (a tiled motif behind a unique head token)
+    — the proposer needs in-context repetition to draft from, which is
+    exactly the workload speculation targets (templated serving
+    traffic, code, structured extraction). Rides the same
+    :func:`_engine_tier` scaffold as the paged/prefix tiers (identical
+    oversubscription and token accounting, so the delta vs
+    decode_paged IS the speculation win), snapshotting the speculation
+    counters after the warm pass so the record reflects the timed pass
+    only. Returns ``(tokens_per_sec, {"acceptance_rate", "drafted",
+    "accepted"})`` — the throughput number only means something next
+    to the acceptance rate that produced it, so they ride the record
+    together. Prefix cache OFF (same reason as the paged tier: the
+    warm pass must not convert the timed pass into a hit workload)."""
+    import numpy as np
+    rngp = np.random.default_rng(7)
+    motif = rngp.integers(0, cfg.vocab_size,
+                          (max(dp_len // 8, 1),)).astype(np.int32)
+
+    def make_prompts():
+        # unique head so rows aren't identical; the motif repeats so the
+        # last n-gram has prior in-context occurrences to look up
+        reps = -(-dp_len // motif.size) + 1
+        return [np.concatenate([
+            rngp.integers(0, cfg.vocab_size, (1,)).astype(np.int32),
+            np.tile(motif, reps)[:dp_len - 1]]) for _ in range(2 * db)]
+
+    warm = {}
+
+    def snapshot(eng):
+        warm.update(d=eng.spec.drafted_total, a=eng.spec.accepted_total)
+
+    tps, eng = _engine_tier(params, cfg, db, dnew, dp_len + dnew,
+                            on_tpu, make_prompts,
+                            between_passes=snapshot,
+                            kv_cache_dtype=kv_cache_dtype,
+                            enable_prefix_cache=False, spec_k=4)
+    drafted = eng.spec.drafted_total - warm["d"]
+    accepted = eng.spec.accepted_total - warm["a"]
+    return tps, {
+        "acceptance_rate": round(accepted / drafted, 3) if drafted
+        else 0.0,
+        "drafted": drafted, "accepted": accepted,
+    }
+
+
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec",
                  "decode_paged_tokens_per_sec",
                  "decode_prefix_tokens_per_sec",
-                 "decode_sched_tokens_per_sec")
+                 "decode_sched_tokens_per_sec",
+                 "decode_spec_tokens_per_sec")
+
+# rider dicts that travel with their tier when it carries from an older
+# record: the scheduler tier's p50/p99 step-latency bound (ISSUE 4) and
+# the speculative tier's acceptance rate (ISSUE 5 — the number that
+# explains the throughput). A carried tier without its rider would drop
+# the very quantity the tier reports. tools/tpu_watch.sh merges the
+# same pairs on the shell side.
+_DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
+                  ("decode_spec_tokens_per_sec", "decode_spec_acceptance"))
 
 
 def _label_decode_source(extra: dict, carried_tiers) -> None:
@@ -402,13 +512,10 @@ def _backfill_decode(rec: dict) -> dict:
             if rec["extra"].get(k) is None and lx.get(k) is not None:
                 rec["extra"][k] = lx[k]
                 carried.add(k)
-        # the scheduler tier's p50/p99 step-latency dict travels with
-        # its throughput number — a carried decode_sched tier without
-        # its latency bound would drop the quantity the tier reports
-        if ("decode_sched_tokens_per_sec" in carried
-                and rec["extra"].get("decode_sched_step_ms") is None
-                and lx.get("decode_sched_step_ms") is not None):
-            rec["extra"]["decode_sched_step_ms"] = lx["decode_sched_step_ms"]
+        for tier, rider in _DECODE_RIDERS:
+            if (tier in carried and rec["extra"].get(rider) is None
+                    and lx.get(rider) is not None):
+                rec["extra"][rider] = lx[rider]
         if carried:
             rec["extra"]["decode_carried_from"] = (
                 "BENCH_LASTGOOD "
@@ -604,6 +711,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"sched decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # speculative decoding on the paged engine: n-gram draft + batched
+    # verify over a repetitive workload — the ISSUE 5 tier, with the
+    # acceptance rate riding the record
+    decode_spec = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_spec = spec_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"spec decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
@@ -611,7 +730,8 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
     return _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                    decode_int8_tps, decode_int4_tps, decode_w8kv8_tps,
                    decode_paged_tps, decode_prefix_tps,
-                   decode_sched=decode_sched, phases=phases)
+                   decode_sched=decode_sched, decode_spec=decode_spec,
+                   phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"
@@ -623,6 +743,9 @@ def child_main():
     if plat:  # local/CI smoke runs; driver runs on the real chip
         import jax
         jax.config.update("jax_platforms", plat)
+    # persisted compiles: a watchdog-killed attempt's programs survive
+    # into the retry instead of re-burning the tunnel window
+    enable_persistent_compilation_cache()
     # The HBM-tier batch scaling in pick_config has only been validated on
     # 16G v5e; if it overshoots on another chip, halve the batch instead of
     # wasting a live tunnel on an OOM crash (VERDICT r2 weak #2). Each
@@ -740,6 +863,11 @@ def _record_last_good(parsed: dict) -> None:
                         "decode_recorded_at" in ox:
                     rec["extra"]["decode_recorded_at"] = \
                         ox["decode_recorded_at"]
+                for tier, rider in _DECODE_RIDERS:
+                    if (tier in carried
+                            and rec["extra"].get(rider) is None
+                            and ox.get(rider) is not None):
+                        rec["extra"][rider] = ox[rider]
                 _label_decode_source(rec["extra"], carried)
         except Exception:
             pass

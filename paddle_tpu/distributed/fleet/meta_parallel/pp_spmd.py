@@ -338,15 +338,38 @@ def _interleave_1f1b_core(apply_chunk, stacked_vec, head_params,
                                                 jnp.mod(t, R), 0),
                 ring)
 
-            # head loss + cotangent on the LAST virtual stage's output
+            # head loss + cotangent on the LAST virtual stage's output.
+            # Gated behind ``on_last`` (ADVICE r5): only the last
+            # device's last chunk ever uses these values — off-tick
+            # lanes previously paid a full head forward+backward (a
+            # vocab-sized matmul pair at LM shapes) per tick just to be
+            # masked to zero. ``lax.cond`` evaluates the cheap
+            # zeros branch instead on every other (device, tick).
+            # Parity: the only OFF-tick consumer is ``dy_self`` via the
+            # ``(d == last) & (c_b == C-1)`` select below, and at the
+            # ticks where that backward is ON its unit coincides with
+            # this tick's forward unit (u_b == u), which makes the
+            # predicate equal to ``on_last`` — so a live path never
+            # reads the zeros.
             lab = jax.tree.map(
                 lambda l: lax.dynamic_index_in_dim(l, m_f, 0,
                                                    keepdims=False),
                 lab_local)
-            lval, head_vjp = jax.vjp(lambda hp, yy: loss_fn(hp, yy, lab),
-                                     head, y)
-            dhead_c, dy_self = head_vjp(jnp.asarray(inv_m, jnp.float32))
             on_last = f_on & (d == last) & (c_f == C - 1)
+
+            def _head_eval(hp, yy):
+                lval, head_vjp = jax.vjp(
+                    lambda h, yo: loss_fn(h, yo, lab), hp, yy)
+                dhead_c, dy_self = head_vjp(
+                    jnp.asarray(inv_m, jnp.float32))
+                return lval, dhead_c, dy_self
+
+            lval, dhead_c, dy_self = lax.cond(
+                on_last, _head_eval,
+                lambda hp, yy: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype),
+                    jax.eval_shape(_head_eval, hp, yy)),
+                head, y)
             loss_acc = loss_acc + jnp.where(on_last, lval, 0.0)
             dhead = jax.tree.map(
                 lambda acc, g: acc + jnp.where(on_last, g, 0.0),

@@ -428,6 +428,20 @@ class ContinuousBatchingEngine:
     prefix sharing are bit-exact, not approximate), else temperature
     sampling with a per-step PRNG fold.
 
+    Speculative decoding (``spec_k > 0``, greedy only): each step a
+    host-side n-gram proposer (:class:`~paddle_tpu.serving.Speculator`,
+    prompt-lookup over the row's own ``prompt + generated`` history —
+    no draft model, no extra weights) drafts up to ``spec_k`` tokens
+    per row, ONE batched verify forward
+    (:func:`~paddle_tpu.models.generate.paged_verify_forward`) scores
+    every speculating row's drafts against its paged KV, and the
+    longest greedily-accepted prefix plus the bonus token commit — so
+    a step emits up to ``spec_k + 1`` tokens per row for barely more
+    HBM traffic than one. A per-row acceptance-rate EMA adapts the
+    draft length and falls back to plain decode when the history does
+    not repeat, and greedy output stays TOKEN-IDENTICAL to plain paged
+    decode at fp and int8-KV (gated in tests/test_spec_decode.py).
+
     Telemetry (paddle_tpu.observability): admission/eviction counters,
     prefix hit/miss token counters, per-chunk prefill latency histogram,
     per-step batch-occupancy histogram, block-pool utilization gauge —
@@ -441,7 +455,9 @@ class ContinuousBatchingEngine:
                  use_kernel: Optional[bool] = None,
                  key: Optional[jax.Array] = None,
                  prefill_chunk: Optional[int] = None,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 speculator=None):
         from ..serving import PagedKVCache
         self.params = params
         self.cfg = cfg
@@ -471,6 +487,24 @@ class ContinuousBatchingEngine:
         # preemption-resume replay), tokens already in pages]
         self._pending: Dict[int, List] = {}
         self._chunk_fns: Dict[tuple, object] = {}
+        # --- speculative decoding (ISSUE 5): n-gram draft + batched
+        # greedy verify; spec_k = max drafts per row per step, 0 = off
+        self.spec_k = int(spec_k)
+        if self.spec_k:
+            if self.temperature != 0.0:
+                raise ValueError(
+                    "spec_k > 0 requires greedy decoding (temperature "
+                    "== 0): speculative verification accepts drafts "
+                    "against the greedy argmax — sampled acceptance "
+                    "would need distribution-matched rejection "
+                    "sampling, which this engine does not implement")
+            from ..serving.speculative import Speculator
+            self.spec = (speculator if speculator is not None
+                         else Speculator(self.spec_k,
+                                         ngram_max=spec_ngram))
+        else:
+            self.spec = None
+        self._spec_fns: Dict[tuple, object] = {}
 
     # ---- request intake ----
     def create_request(self, prompt, max_new_tokens: int = 16,
@@ -549,6 +583,28 @@ class ContinuousBatchingEngine:
 
             self._chunk_fns[key] = jax.jit(f, donate_argnums=(2,))
         return self._chunk_fns[key]
+
+    def _spec_fn(self, ctx_cap: int, T: int):
+        """One compiled speculative-verify program per static ``(context
+        cap, chunk width)`` pair: the batched verify forward + greedy
+        argmax at every position. ``ctx_cap`` buckets to power-of-two
+        page counts (same rule as :meth:`_chunk_fn`) and ``T`` is
+        ``spec_k + 1``, so a long-lived server compiles
+        O(log(pages_per_seq)) variants."""
+        key = (ctx_cap, T)
+        if key not in self._spec_fns:
+            from ..models import generate as gen
+            cfg, uk = self.cfg, self.use_kernel
+
+            def f(params, chunk, paged, tables, lengths, active):
+                logits, paged = gen.paged_verify_forward(
+                    params, chunk, paged, tables, lengths, cfg,
+                    ctx_cap=ctx_cap, active=active, use_kernel=uk)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        paged)
+
+            self._spec_fns[key] = jax.jit(f, donate_argnums=(2,))
+        return self._spec_fns[key]
 
     # ---- scheduling ----
     def _sample_first(self, logits) -> int:
@@ -699,15 +755,8 @@ class ContinuousBatchingEngine:
         # (ctx_cap, width) compile-key space stays O(width_buckets *
         # log(pages_per_seq)) instead of quadratic in pages_per_seq —
         # shared-prefix lengths and prompt lengths vary independently
-        # across requests. The extra gathered rows beyond ctx_len are
-        # masked (kstart), so bucketing is parity-free.
-        ctx_pages = cache.pages_for(done)
-        if ctx_pages:
-            p2 = 1
-            while p2 < ctx_pages:
-                p2 *= 2
-            ctx_pages = min(p2, cache.pages_per_seq)
-        ctx_cap = ctx_pages * page
+        # across requests.
+        ctx_cap = cache.ctx_cap_pages(cache.pages_for(done)) * page
         chunk = np.zeros((1, width), np.int32)
         chunk[0, :take] = seq[done:done + take]
         t0 = _obs.generate_begin()
@@ -794,15 +843,130 @@ class ContinuousBatchingEngine:
                           alloc.num_usable)
         return n_active
 
+    # ---- speculative decoding (ISSUE 5) ----
+    def propose_drafts(self, mask) -> Dict[int, np.ndarray]:
+        """Host-side n-gram draft proposals for every masked ready slot
+        — ``slot -> up-to-spec_k draft tokens`` (rows with no in-history
+        match, a poor acceptance EMA, or no remaining token room are
+        simply absent and decode plainly). Separated from
+        :meth:`spec_step` so the SLO scheduler can charge each row's
+        verify width against its token budget BEFORE executing."""
+        if self.spec is None:
+            return {}
+        mask = np.asarray(mask, bool)
+        drafts: Dict[int, np.ndarray] = {}
+        for slot, req in enumerate(self._slots):
+            if req is None or not mask[slot]:
+                continue
+            # a verify commits accepted + 1 (bonus) tokens: cap drafts
+            # so the commit can never overshoot max_new_tokens — plain
+            # decode would have stopped there, and parity is the gate
+            room = req.max_new_tokens - len(req.tokens) - 1
+            if room <= 0:
+                continue
+            d = self.spec.propose(
+                slot, req.rid,
+                np.concatenate([req.prompt[0],
+                                np.asarray(req.tokens, np.int32)]),
+                cap=min(self.spec_k, room))
+            if d.size:
+                drafts[slot] = d
+        return drafts
+
+    def spec_step(self, mask, drafts: Optional[Dict] = None) -> int:
+        """The speculative sibling of :meth:`decode_step`, sharing its
+        ready-mask machinery: draft (host n-gram lookup), verify all
+        masked rows' drafts in ONE batched forward
+        (:func:`~paddle_tpu.models.generate.paged_verify_forward` +
+        greedy argmax at every position), then commit each row's
+        longest accepted prefix plus the bonus token. Rows without
+        drafts ride the same program and commit exactly their plain
+        greedy token (the static-shape program computes every lane
+        regardless, like the decode program's inactive rows); when NO
+        masked row drafted, this falls back to :meth:`decode_step`
+        outright — the worst case is the baseline step. Returns the
+        number of tokens committed (>= slots advanced).
+
+        Rollback of rejected draft KV is pure host bookkeeping:
+        ``lengths`` advances only past the accepted prefix, the length
+        mask keeps the stale page rows invisible, and the strictly
+        sequential writes at ``lengths`` overwrite them before the mask
+        ever reaches them — no device copy, no page churn (the
+        allocator never sees a verify)."""
+        if self.spec is None:
+            return self.decode_step(mask)
+        cache = self.cache
+        mask = np.asarray(mask, bool)
+        if not mask.any():
+            return 0
+        if drafts is None:
+            drafts = self.propose_drafts(mask)
+        drafts = {s: np.asarray(d, np.int32) for s, d in drafts.items()
+                  if len(d) and mask[s]}
+        if not drafts:
+            return self.decode_step(mask)
+        B, T = self.max_batch, self.spec_k + 1
+        chunk = np.zeros((B, T), np.int32)
+        chunk[:, 0] = self._last
+        dlen = np.zeros((B,), np.int32)
+        for s, d in drafts.items():
+            chunk[s, 1:1 + d.size] = d
+            dlen[s] = d.size
+        # ctx_cap: power-of-two page bucket of the longest masked
+        # context (same compile-key rule as chunked prefill; ready
+        # rows always hold >= 1 prefilled token, so the cap is > 0)
+        ctx_cap = cache.ctx_cap_pages(cache.pages_for(
+            int(cache.lengths[mask].max()))) * cache.page_size
+        t0 = _obs.generate_begin()
+        out, cache.pool = self._spec_fn(ctx_cap, T)(
+            self.params, jnp.asarray(chunk), cache.pool,
+            jnp.asarray(cache.block_tables),
+            jnp.asarray(cache.lengths), jnp.asarray(mask))
+        out = np.asarray(out)              # (B, T) greedy targets
+        t1 = time.perf_counter_ns()        # device fence: verify done
+        from ..serving.speculative import longest_accepted_prefix
+        n_slots = committed = drafted = accepted = 0
+        for slot, req in enumerate(self._slots):
+            if req is None or not mask[slot]:
+                continue
+            n_slots += 1
+            j = int(dlen[slot])
+            d = drafts.get(slot)
+            a = longest_accepted_prefix(d, out[slot]) if j else 0
+            # commit: the last token's KV + a accepted drafts are now
+            # context; the bonus target becomes the new last token
+            cache.lengths[slot] += a + 1
+            self._last[slot] = out[slot, a]
+            for tok in (list(d[:a]) if j else []) + [out[slot, a]]:
+                self._record_token(req, int(tok))
+                committed += 1
+                if req.done:
+                    break                  # eos/max_len: drop the tail
+            if j:
+                drafted += j
+                accepted += a
+                self.spec.observe(slot, req.rid, j, a)
+        self._steps += 1
+        _obs.serving_spec_verify(t0, out, n_slots, drafted, accepted,
+                                 t1_ns=t1)
+        alloc = cache.allocator
+        _obs.serving_step(n_slots, self.max_batch, alloc.num_used,
+                          alloc.num_usable)
+        return committed
+
     def step(self) -> bool:
         """Admit (FIFO), advance chunked prefill by one chunk, then
-        advance every fully prefilled slot one decode token. Returns
-        False when no work remains (queue empty, all slots idle).
-        Priority/budget/preemption scheduling composes the same pieces
-        from :class:`~paddle_tpu.serving.ServingScheduler`."""
+        advance every fully prefilled slot — one decode token each, or
+        a drafted-and-verified run of tokens when speculation is on
+        (``spec_k``). Returns False when no work remains (queue empty,
+        all slots idle). Priority/budget/preemption scheduling composes
+        the same pieces from
+        :class:`~paddle_tpu.serving.ServingScheduler`."""
         self._admit()
         self.prefill_step()
-        if self.decode_step(self.ready_mask()) == 0:
+        advance = (self.spec_step if self.spec is not None
+                   else self.decode_step)
+        if advance(self.ready_mask()) == 0:
             return bool(self._queue or self._pending
                         or self.cache.active.any())
         return bool(self._queue) or bool(self.cache.active.any())
@@ -857,4 +1021,6 @@ class ContinuousBatchingEngine:
         if self.cache.prefix is not None:
             s["prefix_evictions_total"] = \
                 self.cache.prefix.evictions_total
+        if self.spec is not None:
+            s.update(self.spec.stats())
         return s

@@ -246,6 +246,95 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     return logits, out
 
 
+def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
+                         block_tables: jax.Array, lengths: jax.Array,
+                         cfg: LlamaConfig, *, ctx_cap: int, active=None,
+                         use_kernel=None):
+    """Batched speculative-decode VERIFY: score a ``T``-token chunk for
+    EVERY speculating row against its paged KV in ONE forward — the
+    batched generalization of :func:`paged_prefill_chunk` (which runs
+    one request's chunk; here every row carries its own block table and
+    context length).
+
+    tokens:       (B, T) int32 — per row: ``[last_sampled_token,
+                  draft_1, ..., draft_{T-1}]`` (rows proposing fewer
+                  drafts right-pad; pad lanes are causally masked from
+                  every earlier position, so their garbage never
+                  reaches an accepted token's logits)
+    block_tables: (B, ppseq) int32 page ids per slot
+    lengths:      (B,) tokens already COMMITTED in each row's pages
+                  (the chunk's KV lands at ``lengths + i``); must be
+                  <= ``ctx_cap``
+    ctx_cap:      STATIC page multiple >= max(lengths) — the gathered
+                  context width / compile key (callers bucket it to
+                  power-of-two page counts, same as the chunk program)
+    active:       (B,) bool — inactive rows compute (static shapes) but
+                  their KV writes route to the trash page
+    returns (logits (B, T, V) f32 at EVERY chunk position, updated
+    pools). ``argmax(logits[r, i])`` is the greedy next token given the
+    row's context plus ``tokens[r, :i+1]`` — the verify target for
+    draft ``i+1`` and the bonus token at the first rejection.
+
+    Math is the chunk program's, vectorized over rows: per-row context
+    gathered from pages and RIGHT-ALIGNED into a ``(B, ctx_cap + T)``
+    dense temp cache (``kstart`` masks the pad rows below), the chunk
+    forwards at temp positions ``[ctx_cap, ctx_cap + T)`` with logical
+    rope positions ``lengths + i``, and the new rows scatter back into
+    each row's pages. Cached rows are bit-identical and masked columns
+    contribute exact zeros, so greedy acceptance against these logits
+    is TOKEN-IDENTICAL to plain paged decode at fp and int8-KV (gated
+    in tests/test_spec_decode.py). Rejected-tail rows need NO device
+    rollback: the host simply doesn't advance ``lengths`` past the
+    accepted prefix, the length mask keeps stale rows invisible, and
+    sequential writes overwrite them before the mask ever reaches them
+    (the same contract decode already relies on for retired tenants)."""
+    B, T = tokens.shape
+    page = paged["k"].shape[2]
+    if ctx_cap % page:
+        raise ValueError(
+            f"paged_verify_forward: ctx_cap={ctx_cap} must be a "
+            f"multiple of the page size {page}")
+    ext = block_tables.shape[1] * page
+    quant = "ks" in paged
+    W = ctx_cap + T
+    if active is None:
+        active = jnp.ones((B,), bool)
+    lengths = jnp.clip(jnp.asarray(lengths, jnp.int32), 0, ctx_cap)
+    pad = ctx_cap - lengths                              # (B,)
+    dense = init_cache(cfg, B, W, kv_dtype="int8" if quant else None)
+    if ctx_cap:
+        ppc = ctx_cap // page
+        ctx_tbl = block_tables[:, :ppc]                  # (B, ppc)
+        srows = jnp.clip(jnp.arange(ctx_cap, dtype=jnp.int32)[None, :]
+                         - pad[:, None], 0, ctx_cap - 1)  # (B, ctx_cap)
+        for name in paged:
+            g = jnp.take(paged[name], ctx_tbl, axis=1)   # (L,B,ppc,pg,.)
+            g = g.reshape((g.shape[0], B, ppc * page) + g.shape[4:])
+            idx = srows[None].reshape(
+                (1, B, ctx_cap) + (1,) * (g.ndim - 3))
+            g = jnp.take_along_axis(g, idx, axis=2)      # right-aligned
+            dense[name] = dense[name].at[:, :, :ctx_cap].set(
+                g.astype(dense[name].dtype))
+    rpos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
+                                    W, use_kernel=use_kernel, rpos=rpos,
+                                    kstart=pad, logits_all=True)
+    # scatter the T new rows of every row into its pages; inactive rows
+    # and positions past the slot extent route to the trash page
+    pos = rpos                                           # (B, T)
+    ok = active[:, None] & (pos < ext)
+    posc = jnp.clip(pos, 0, ext - 1)
+    row = jnp.arange(B)[:, None]
+    dst = jnp.where(ok, block_tables[row, posc // page] * page
+                    + posc % page, 0)                    # (B, T)
+    out = {}
+    for name in paged:
+        rows = dense[name][:, :, ctx_cap:]               # (L, B, T, ...)
+        rows = rows.reshape((rows.shape[0], B * T) + rows.shape[3:])
+        out[name] = _scatter_rows(paged[name], dst.reshape(-1), rows)
+    return logits, out
+
+
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, active=None,
@@ -542,12 +631,14 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
-                    kstart=None, logits_at=None):
+                    kstart=None, logits_at=None, logits_all=False):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
     (B, V), updated cache). ``logits_at``: optional TRACED row index
     into ``tokens`` — logits are taken there instead of at row T-1
     (chunked prefill right-pads the final chunk, so the last VALID
-    token is not the last row)."""
+    token is not the last row). ``logits_all``: return logits at EVERY
+    row — (B, T, V) — for the speculative-verify program, which needs
+    the greedy target at all draft positions."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
     quant = "ks" in cache
@@ -579,6 +670,8 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
         head = params["embed"].T.astype(x.dtype)
     else:
         head = _w(params, "lm_head", x.dtype)
+    if logits_all:
+        return (x @ head).astype(jnp.float32), new_cache
     logits = (x[:, -1] @ head).astype(jnp.float32)
     return logits, new_cache
 

@@ -271,6 +271,51 @@ def serving_resumed(n: int, replay_tokens: int):
                ).inc(replay_tokens)
 
 
+def serving_spec_verify(t0_ns: int, out, rows: int, drafted: int,
+                        accepted: int, t1_ns: int = 0):
+    """Close one speculative-decode verify step opened at ``t0_ns`` (a
+    :func:`generate_begin` anchor): fence the verify output, record the
+    span, and feed the speculation counters — drafted/accepted token
+    totals, the rejected-tail rollback counter, and the per-step
+    acceptance-rate histogram (the quantity the adaptive per-row k is
+    driven by; its EMA is observable as accepted/drafted over any
+    scrape window). ``rows`` is the number of slots the verify
+    advanced. ``t1_ns``: the caller's own device-fence timestamp —
+    the engine materializes the verify output (a host np.asarray sync)
+    and only then runs its per-slot commit loop before reaching this
+    hook, so the span must close at that fence, not at call time, or
+    the histogram would charge the host loop to the device."""
+    if not t0_ns:
+        return
+    _block(out)
+    now = t1_ns or time.perf_counter_ns()
+    _record("Serving.spec_verify", t0_ns, now, "Forward")
+    if not enabled:
+        return
+    _m.histogram("serving_spec_verify_ms",
+                 "wall milliseconds per speculative verify step",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000, 2500)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_spec_steps_total",
+               "speculative verify steps executed").inc()
+    _m.counter("serving_spec_rows_total",
+               "slots advanced through the verify program").inc(rows)
+    _m.counter("serving_spec_drafted_tokens_total",
+               "draft tokens proposed to the verify program"
+               ).inc(drafted)
+    _m.counter("serving_spec_accepted_tokens_total",
+               "draft tokens accepted by greedy verification"
+               ).inc(accepted)
+    _m.counter("serving_spec_rollback_tokens_total",
+               "rejected draft tokens whose KV rows were rolled back "
+               "(length bookkeeping, no copy)").inc(drafted - accepted)
+    if drafted:
+        _m.histogram("serving_spec_acceptance_rate",
+                     "accepted/drafted ratio per verify step",
+                     buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                              0.875, 1.0)).observe(accepted / drafted)
+
+
 def serving_queue_wait(seconds: float, priority: int):
     """One admission's time-in-queue (scheduler submit -> slot), by
     priority class — the SLO the scheduler exists to bound."""

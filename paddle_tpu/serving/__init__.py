@@ -10,6 +10,11 @@ SLO-aware scheduler.
   step packer and the :class:`PreemptionPolicy` victim selector.
 - :mod:`paddle_tpu.serving.scheduler` — :class:`ServingScheduler`, the
   priority/deadline/preemption control plane over the engine.
+- :mod:`paddle_tpu.serving.speculative` — :class:`NgramProposer`
+  (model-free prompt-lookup drafting), :class:`Speculator` (per-row
+  acceptance-rate EMA + adaptive draft length) and the greedy
+  :func:`longest_accepted_prefix` acceptance rule for the engine's
+  batched-verify ``spec_step``.
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -24,3 +29,6 @@ from .policy import (  # noqa: F401
     TokenBudgetPlanner,
 )
 from .scheduler import ServingScheduler  # noqa: F401
+from .speculative import (  # noqa: F401
+    NgramProposer, Speculator, longest_accepted_prefix,
+)

@@ -424,6 +424,20 @@ class PagedKVCache:
     def pages_for(self, total_tokens: int) -> int:
         return -(-total_tokens // self.page_size)
 
+    def ctx_cap_pages(self, n_pages: int) -> int:
+        """Bucket a context page count UP to a power of two (capped at
+        ``pages_per_seq``) — the shared compile-key rule for every
+        gathered-context program (chunked prefill, prefix-cache resume,
+        speculative verify), keeping the key space O(log(pages_per_seq))
+        instead of linear. Extra gathered rows beyond the true context
+        are ``kstart``-masked, so bucketing is parity-free."""
+        if n_pages <= 0:
+            return 0
+        p2 = 1
+        while p2 < n_pages:
+            p2 *= 2
+        return min(p2, self.pages_per_seq)
+
     def _check_admit(self, slot: int, total_tokens: int) -> int:
         if self.active[slot]:
             raise ValueError(f"slot {slot} already active")

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class Priority(enum.IntEnum):
@@ -55,23 +55,32 @@ class StepPlan:
     """One engine step's work, as the planner budgeted it.
 
     ``decode_slots``: slot ids that advance one decode token (cost: one
-    token each). ``prefills``: ``(slot, token_cap)`` pairs — each named
-    pending admission forwards at most ``token_cap`` prompt tokens of
-    chunked prefill this step (page-multiple caps; the engine takes
-    ``min(cap, remaining, prefill_chunk)``). ``deferred_decodes`` counts
-    ready slots the budget pushed to a later step — the observable
-    fairness cost of a tight budget."""
+    token each). ``spec_drafts``: ``slot -> draft count`` for decode
+    slots whose advance is a SPECULATIVE verify this step — each draft
+    costs one extra token on top of the slot's base decode token (a
+    k-draft verify forwards ``1 + k`` positions and can commit up to
+    ``1 + k`` tokens), and the planner trims drafts to the budget tail
+    rather than deferring the whole row. ``prefills``: ``(slot,
+    token_cap)`` pairs — each named pending admission forwards at most
+    ``token_cap`` prompt tokens of chunked prefill this step
+    (page-multiple caps; the engine takes ``min(cap, remaining,
+    prefill_chunk)``). ``deferred_decodes`` counts ready slots the
+    budget pushed to a later step — the observable fairness cost of a
+    tight budget."""
     decode_slots: List[int] = dataclasses.field(default_factory=list)
     prefills: List[Tuple[int, int]] = dataclasses.field(
         default_factory=list)
     budget: Optional[int] = None
     deferred_decodes: int = 0
+    spec_drafts: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     @property
     def scheduled_tokens(self) -> int:
-        """The step's token debit: one per decode slot + every prefill
-        cap — the quantity the budget bounds."""
-        return len(self.decode_slots) + sum(c for _, c in self.prefills)
+        """The step's token debit: one per decode slot + that slot's
+        budgeted draft tokens + every prefill cap — the quantity the
+        budget bounds."""
+        return (len(self.decode_slots) + sum(self.spec_drafts.values())
+                + sum(c for _, c in self.prefills))
 
 
 class TokenBudgetPlanner:
@@ -107,7 +116,8 @@ class TokenBudgetPlanner:
 
     def plan(self, decode_ready: Sequence[Tuple[int, int, int]],
              pending: Sequence[Tuple[int, int, int, int]],
-             chunk_cap: Optional[int] = None) -> StepPlan:
+             chunk_cap: Optional[int] = None,
+             spec_drafts: Optional[Dict[int, int]] = None) -> StepPlan:
         """Build one step's :class:`StepPlan`.
 
         decode_ready: ``(priority, rid, slot)`` per decodable slot
@@ -115,11 +125,23 @@ class TokenBudgetPlanner:
                       mid-prefill admission
         chunk_cap:    the engine's ``prefill_chunk`` (already
                       page-rounded) or None
+        spec_drafts:  ``slot -> proposed draft count`` for decode slots
+                      the engine wants to advance via speculative
+                      verify — a k-draft verify is charged ``1 + k``
+                      tokens. Drafts are TRIMMED to the remaining
+                      budget (never rounded through it: the base
+                      decode token is taken first, drafts only fill
+                      what is left), so the ceiling stays hard and a
+                      tight budget degrades a row to plain decode
+                      instead of deferring it.
         """
         page = self.page_size
+        spec = spec_drafts or {}
         if self.token_budget is None:
             plan = StepPlan([s for _, _, s in
                              sorted(decode_ready)], [], None)
+            plan.spec_drafts = {s: int(k) for s, k in spec.items()
+                                if s in plan.decode_slots and k > 0}
             if pending:
                 _, _, slot, remaining = min(pending)
                 width = -(-remaining // page) * page
@@ -129,7 +151,7 @@ class TokenBudgetPlanner:
             return plan
         left = self.token_budget
         plan = StepPlan(budget=self.token_budget)
-        items = [(p, rid, "decode", slot, 1)
+        items = [(p, rid, "decode", slot, 1 + int(spec.get(slot, 0)))
                  for p, rid, slot in decode_ready]
         for p, rid, slot, remaining in pending:
             width = -(-remaining // page) * page
@@ -141,7 +163,10 @@ class TokenBudgetPlanner:
             if kind == "decode":
                 if left >= 1:
                     plan.decode_slots.append(slot)
-                    left -= 1
+                    take = min(cost - 1, left - 1)   # drafts: budget tail
+                    if take > 0:
+                        plan.spec_drafts[slot] = take
+                    left -= 1 + max(0, take)
                 else:
                     plan.deferred_decodes += 1
             else:

@@ -82,6 +82,7 @@ class ServingScheduler:
                            if enable_preemption else None)
         self.clock = clock
         self._queues: Dict[int, Deque] = {}
+        self._drafts: Dict = {}      # this step's speculative proposals
         self.last_plan: Optional[StepPlan] = None
         self._steps = 0
         self.preemptions_total = 0
@@ -222,8 +223,17 @@ class ServingScheduler:
         pending = [(req.priority, req.rid, slot, remaining)
                    for slot, (req, remaining)
                    in eng.pending_prefills().items()]
-        return self.planner.plan(decode, pending,
-                                 chunk_cap=eng.prefill_chunk)
+        # speculative engines draft at PLAN time so each row's verify
+        # width (1 + drafts) is charged against the budget before
+        # anything executes; the proposals are stashed for this step's
+        # execution (the engine must not re-propose under a different
+        # history)
+        self._drafts = eng.propose_drafts(ready) if getattr(
+            eng, "spec", None) is not None else {}
+        return self.planner.plan(
+            decode, pending, chunk_cap=eng.prefill_chunk,
+            spec_drafts={s: d.size for s, d in self._drafts.items()}
+            or None)
 
     def step(self) -> bool:
         """One scheduler step: expire deadlines, admit (preempting if
@@ -251,7 +261,16 @@ class ServingScheduler:
         if plan.decode_slots:
             mask = np.zeros((eng.max_batch,), bool)
             mask[plan.decode_slots] = True
-            eng.decode_step(mask)
+            if plan.spec_drafts:
+                # execute the budgeted verify: proposals trimmed to the
+                # planner's per-row draft allowance (a row the budget
+                # degraded to plain decode rides the verify batch with
+                # zero drafts — it commits exactly its greedy token)
+                eng.spec_step(mask, {
+                    s: self._drafts[s][:k]
+                    for s, k in plan.spec_drafts.items()})
+            else:
+                eng.decode_step(mask)
         self.last_plan = plan
         self._steps += 1
         _obs.serving_sched_step(

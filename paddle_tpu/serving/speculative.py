@@ -1,0 +1,207 @@
+"""Speculative decoding for the paged serving engine: n-gram drafting,
+greedy acceptance, and the adaptive per-row speculation controller.
+
+Every decode step of the PR 2-4 stack emits exactly ONE token per row
+per forward, so decode throughput is pinned at weight+KV bandwidth per
+token — even though the ragged paged machinery can score a k-token
+chunk against cached context for barely more HBM traffic than a
+single-token step (the Ragged Paged Attention observation, PAPERS.md).
+Speculative decoding converts that slack into accepted tokens:
+
+- **Draft** (host, model-free): :class:`NgramProposer` matches the last
+  n-gram of a row's ``prompt + generated`` history against its own
+  earlier tokens (prompt-lookup decoding) and proposes up to ``k``
+  continuation tokens. No draft model, no extra weights — so the
+  acceptance math needs no distribution matching and parity is trivial.
+- **Verify** (device, batched): the engine scores all k draft tokens of
+  every speculating row in ONE forward
+  (:func:`paddle_tpu.models.generate.paged_verify_forward`) and takes
+  the greedy argmax at every position.
+- **Accept** (host): :func:`longest_accepted_prefix` — drafts are
+  accepted while they equal the greedy target; the first mismatch
+  position's target is the BONUS token (it is exactly what plain
+  greedy decode would have emitted there), so every verify commits
+  ``accepted + 1`` tokens and greedy output is BIT-IDENTICAL to plain
+  paged decode at fp and int8-KV (gated in tests/test_spec_decode.py).
+- **Adapt** (host): :class:`Speculator` keeps a per-row acceptance-rate
+  EMA and scales the proposal length with it — rows whose history
+  doesn't repeat fall back to plain decode (k=0, re-probed
+  periodically), so the worst case costs ≈ the baseline step.
+
+Everything here is pure host-side numpy — no jax, no device state —
+consumed by :class:`paddle_tpu.inference.ContinuousBatchingEngine`
+(``spec_k``/``spec_step``) and budgeted by
+:class:`~paddle_tpu.serving.policy.TokenBudgetPlanner` (a verify with k
+drafts is charged ``1 + k`` tokens, so the step budget stays a hard
+ceiling).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def longest_accepted_prefix(drafts: np.ndarray,
+                            targets: np.ndarray) -> int:
+    """Number of leading draft tokens that match the greedy verify
+    targets: ``drafts[i]`` is accepted iff it equals ``targets[i]``
+    (the argmax logits at chunk position ``i``, i.e. the token plain
+    greedy decode would emit after the drafts before it) and every
+    earlier draft was accepted."""
+    drafts = np.asarray(drafts)
+    j = drafts.size
+    if j == 0:
+        return 0
+    neq = drafts != np.asarray(targets)[:j]
+    return int(j if not neq.any() else np.argmax(neq))
+
+
+class NgramProposer:
+    """Model-free prompt-lookup drafting: propose the continuation of
+    the most recent PRIOR occurrence of the history's last n-gram.
+
+    Tries the longest n-gram first (``ngram_max`` down to
+    ``ngram_min``) — a longer match is a stronger repetition signal —
+    and returns the tokens that followed it, up to ``k``. Pure numpy on
+    the host (one sliding-window compare per n); the engine calls this
+    once per speculating row per step, so the cost is O(history x n)
+    bytes of compare, trivial next to a decode forward."""
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1):
+        if not (1 <= ngram_min <= ngram_max):
+            raise ValueError(
+                f"NgramProposer: need 1 <= ngram_min ({ngram_min}) <= "
+                f"ngram_max ({ngram_max})")
+        self.ngram_max = ngram_max
+        self.ngram_min = ngram_min
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        """history: 1-D int32 ``prompt + generated`` tokens; returns up
+        to ``k`` draft tokens (possibly empty — no match is a normal
+        outcome, the row just decodes plainly this step)."""
+        history = np.asarray(history, np.int32).reshape(-1)
+        empty = np.zeros((0,), np.int32)
+        if k <= 0:
+            return empty
+        for n in range(min(self.ngram_max, history.size - 1),
+                       self.ngram_min - 1, -1):
+            tail = history[-n:]
+            # windows over history[:-1]: a match at i guarantees at
+            # least one continuation token and excludes the tail's own
+            # (self-)occurrence at the very end
+            win = np.lib.stride_tricks.sliding_window_view(
+                history[:-1], n)
+            hits = np.nonzero((win == tail).all(axis=1))[0]
+            if hits.size:
+                i = int(hits[-1])                 # most recent match
+                return history[i + n:i + n + k].copy()
+        return empty
+
+
+class Speculator:
+    """Per-row speculation state: proposer + windowed acceptance-rate
+    EMA + adaptive draft length.
+
+    ``k_for`` scales each row's proposal with its EMA (optimistic start
+    at 1.0): ``round(ema * max_k)`` while the EMA stays at or above
+    ``min_rate``; below it the row falls back to plain decode (k=0) and
+    re-probes with a single draft after ``probe_every`` opportunities —
+    the probe stays OFFERED until one actually verifies (a probe the
+    budget trims or that finds no n-gram match doesn't re-arm the
+    counter), so a row that stops repeating stops paying verify width,
+    and one that starts repeating again is rediscovered even under a
+    tight token budget. State is keyed by the occupying request's rid
+    and resets when a slot changes tenants.
+
+    Counters (``drafted_total`` / ``accepted_total`` /
+    ``rejected_total`` / ``verify_steps``) feed the
+    ``serving_spec_*`` metrics and the bench tier's acceptance-rate
+    record."""
+
+    def __init__(self, max_k: int, *, ngram_max: int = 3,
+                 ngram_min: int = 1, ema_beta: float = 0.5,
+                 min_rate: float = 0.125, probe_every: int = 8,
+                 proposer: Optional[NgramProposer] = None):
+        if max_k < 1:
+            raise ValueError(f"Speculator: max_k must be >= 1, got "
+                             f"{max_k} (spec_k=0 disables speculation "
+                             f"at the engine instead)")
+        if not (0.0 <= ema_beta < 1.0):
+            raise ValueError(f"ema_beta must be in [0, 1), got {ema_beta}")
+        self.max_k = max_k
+        self.proposer = proposer or NgramProposer(ngram_max, ngram_min)
+        self.ema_beta = float(ema_beta)
+        self.min_rate = float(min_rate)
+        self.probe_every = int(probe_every)
+        self._ema: Dict[int, float] = {}          # slot -> acceptance EMA
+        self._rid: Dict[int, int] = {}            # slot -> tenant rid
+        self._since_probe: Dict[int, int] = {}
+        self.drafted_total = 0
+        self.accepted_total = 0
+        self.rejected_total = 0
+        self.verify_steps = 0
+
+    def _sync_slot(self, slot: int, rid: int):
+        if self._rid.get(slot) != rid:
+            self._rid[slot] = rid
+            self._ema[slot] = 1.0                 # optimistic start
+            self._since_probe[slot] = 0
+
+    def k_for(self, slot: int, rid: int) -> int:
+        """Adaptive draft length for this row, 0 = plain decode."""
+        self._sync_slot(slot, rid)
+        ema = self._ema[slot]
+        if ema < self.min_rate:
+            self._since_probe[slot] += 1
+            if self._since_probe[slot] >= self.probe_every:
+                # the counter re-arms in observe(), NOT here: a probe
+                # the budget trims away (or that finds no n-gram match)
+                # never executes, so it keeps being OFFERED until one
+                # actually verifies — otherwise a tight token_budget
+                # could silently disable speculation for a row whose
+                # history has resumed repeating
+                return 1                          # periodic re-probe
+            return 0
+        return max(1, min(self.max_k, int(round(ema * self.max_k))))
+
+    def propose(self, slot: int, rid: int, history: np.ndarray,
+                cap: Optional[int] = None) -> np.ndarray:
+        """Draft tokens for the row occupying ``slot`` (``cap``
+        additionally bounds the length, e.g. the request's remaining
+        ``max_new_tokens`` room)."""
+        k = self.k_for(slot, rid)
+        if cap is not None:
+            k = min(k, int(cap))
+        if k <= 0:
+            return np.zeros((0,), np.int32)
+        return self.proposer.propose(history, k)
+
+    def observe(self, slot: int, rid: int, proposed: int, accepted: int):
+        """Fold one verify outcome into the row's EMA + the counters."""
+        if proposed <= 0:
+            return
+        self._sync_slot(slot, rid)
+        self._since_probe[slot] = 0               # executed: re-arm probe
+        rate = accepted / proposed
+        b = self.ema_beta
+        self._ema[slot] = b * self._ema[slot] + (1.0 - b) * rate
+        self.drafted_total += proposed
+        self.accepted_total += accepted
+        self.rejected_total += proposed - accepted
+        self.verify_steps += 1
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Lifetime accepted/drafted ratio (0.0 before any verify)."""
+        return (self.accepted_total / self.drafted_total
+                if self.drafted_total else 0.0)
+
+    def stats(self) -> Dict:
+        return {
+            "spec_drafted_total": self.drafted_total,
+            "spec_accepted_total": self.accepted_total,
+            "spec_rejected_total": self.rejected_total,
+            "spec_verify_steps": self.verify_steps,
+            "spec_acceptance_rate": round(self.acceptance_rate, 4),
+        }

@@ -36,6 +36,33 @@ def test_api_coverage_stays_complete():
     assert "missing=0" in out, out[-600:]
 
 
+def test_api_audit_includes_strings_and_pstring():
+    """VERDICT r5 weak #8 pin (reference-free, so it runs everywhere):
+    ``pstring`` ships via the strings module, so the API audit must
+    treat it as IN scope (not parked in OUT_OF_SCOPE) and must walk the
+    ``paddle.strings`` namespace; every name the living strings module
+    exports must resolve and actually work (no refusal stubs)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "api_coverage", os.path.join(ROOT, "tools", "api_coverage.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "pstring" not in mod.OUT_OF_SCOPE.get("paddle", set()), (
+        "pstring is shipped by paddle_tpu.strings — it must be audited, "
+        "not excluded")
+    assert ("paddle.strings", "strings/__init__.py") in mod.NAMESPACES, (
+        "the paddle.strings namespace must be part of the API audit")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu
+    from paddle_tpu import strings
+    assert mod.resolve(paddle_tpu, "pstring")
+    for name in strings.__all__:
+        obj = getattr(strings, name, None)
+        assert mod.resolve(strings, name), name
+        assert not mod.unconditionally_raises(obj), (
+            f"strings.{name} resolves but refuses every call")
+
+
 def test_op_sweep_cannot_decay():
     """The behavioral sweep (test_op_sweep.py + test_op_sweep_alias.py)
     must keep exercising the full audit table: every direct op has a

@@ -1,0 +1,476 @@
+"""Speculative decoding tests (ISSUE 5 acceptance gates).
+
+N-gram draft + batched greedy verify on the paged engine. The hard
+gates:
+
+- speculative greedy decode is TOKEN-IDENTICAL to plain paged decode
+  at fp AND int8-KV — across no-accept, partial-accept and
+  forced-full-accept workloads;
+- acceptance edges behave: ``spec_k=0`` disables speculation entirely,
+  a full-accept verify commits ``k+1`` tokens in one step, a
+  reject-at-first-draft verify commits exactly the plain greedy token;
+- rejected-tail rollback leaves the page pool CONSISTENT (allocator
+  refcounts/stats balance at drain — rollback is pure length
+  bookkeeping, the allocator never sees a verify);
+- the SLO scheduler's token budget stays a HARD ceiling when verifies
+  are in the plan (a k-draft verify charged ``1 + k``);
+- the batched verify program AOT-lowers for the TPU platform.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models import llama, generate
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.serving import (NgramProposer, Priority, ServingScheduler,
+                                Speculator, TokenBudgetPlanner,
+                                longest_accepted_prefix)
+
+
+def _setup(seed=0, **kw):
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64, **kw)
+    params = llama.init_params(jax.random.key(seed), cfg)
+    return cfg, params
+
+
+def _prompts(cfg, lens, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(3, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in lens]
+
+
+def _repetitive_prompts(cfg, lens, seed=0, motif=4):
+    """Tiled-motif prompts (unique head token) — in-context repetition
+    for the n-gram proposer to draft from."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for n in lens:
+        m = rs.randint(3, cfg.vocab_size, (motif,)).astype(np.int32)
+        head = rs.randint(3, cfg.vocab_size, (1,)).astype(np.int32)
+        out.append(np.concatenate([head, np.tile(m, -(-n // motif))])[:n])
+    return out
+
+
+class _OracleSpeculator(Speculator):
+    """Proposes the TRUE greedy continuation (from a reference run's
+    FULL prompt+generated rows, keyed by rid) — forces full acceptance,
+    deterministically."""
+
+    def __init__(self, max_k, full_rows_by_rid):
+        super().__init__(max_k)
+        self._rows = full_rows_by_rid
+
+    def propose(self, slot, rid, history, cap=None):
+        full = np.asarray(self._rows[rid], np.int32)
+        k = self.max_k if cap is None else min(self.max_k, int(cap))
+        got = len(history)                   # prompt + generated so far
+        return full[got:got + k].copy()
+
+
+class _WrongSpeculator(Speculator):
+    """Always proposes token id 0 — with prompts drawn from [3, vocab)
+    and a model that never greedily emits 0 in these fixtures, every
+    draft is rejected at the first position."""
+
+    def propose(self, slot, rid, history, cap=None):
+        k = self.max_k if cap is None else min(self.max_k, int(cap))
+        return np.zeros((max(k, 0),), np.int32)
+
+
+class TestAcceptanceRule:
+    """Pure host-side acceptance: longest accepted prefix."""
+
+    def test_edges(self):
+        assert longest_accepted_prefix(np.array([], np.int32),
+                                       np.array([7])) == 0
+        assert longest_accepted_prefix(np.array([5]), np.array([5])) == 1
+        assert longest_accepted_prefix(np.array([5]), np.array([6])) == 0
+        assert longest_accepted_prefix(np.array([5, 6, 7]),
+                                       np.array([5, 6, 7, 9])) == 3
+        assert longest_accepted_prefix(np.array([5, 9, 7]),
+                                       np.array([5, 6, 7])) == 1
+
+    def test_mismatch_past_reject_does_not_resurrect(self):
+        # a match AFTER the first mismatch must not count
+        assert longest_accepted_prefix(np.array([1, 9, 3]),
+                                       np.array([1, 2, 3])) == 1
+
+
+class TestNgramProposer:
+    def test_match_proposes_continuation(self):
+        p = NgramProposer(ngram_max=2)
+        hist = np.array([1, 2, 3, 4, 9, 1, 2], np.int32)
+        # last 2-gram (1,2) occurred at 0, continuation 3,4,9
+        np.testing.assert_array_equal(p.propose(hist, 3), [3, 4, 9])
+
+    def test_most_recent_match_wins(self):
+        p = NgramProposer(ngram_max=2)
+        hist = np.array([1, 2, 7, 5, 1, 2, 8, 6, 1, 2], np.int32)
+        np.testing.assert_array_equal(p.propose(hist, 2), [8, 6])
+
+    def test_longest_ngram_tried_first(self):
+        p = NgramProposer(ngram_max=3, ngram_min=1)
+        # 3-gram (5,1,2) matches at position 2 -> 9; the more recent
+        # 2-gram match (1,2)->8 must NOT shadow the longer signal
+        hist = np.array([7, 3, 5, 1, 2, 9, 1, 2, 8, 5, 1, 2], np.int32)
+        np.testing.assert_array_equal(p.propose(hist, 1), [9])
+
+    def test_no_match_and_short_history(self):
+        p = NgramProposer(ngram_max=2)
+        assert p.propose(np.array([1, 2, 3, 4], np.int32), 4).size == 0
+        assert p.propose(np.array([5], np.int32), 4).size == 0
+        assert p.propose(np.array([1, 2, 1, 2], np.int32), 0).size == 0
+
+    def test_self_match_excluded(self):
+        # the tail's own occurrence at the end must not match itself
+        p = NgramProposer(ngram_max=2)
+        assert p.propose(np.array([9, 8, 1, 2], np.int32), 2).size == 0
+
+
+class TestSpeculatorAdaptiveK:
+    def test_k_scales_with_ema_and_probes_after_collapse(self):
+        sp = Speculator(4, ema_beta=0.5, min_rate=0.25, probe_every=3)
+        assert sp.k_for(0, rid=1) == 4                  # optimistic start
+        for _ in range(6):                              # total rejection
+            sp.observe(0, 1, proposed=4, accepted=0)
+        assert sp._ema[0] < 0.25
+        ks = [sp.k_for(0, rid=1) for _ in range(5)]
+        assert ks[:2] == [0, 0]                         # plain, counting
+        # the probe stays OFFERED until one executes (a trimmed/no-match
+        # probe must not burn the opportunity — budget-starvation guard)
+        assert ks[2:] == [1, 1, 1]
+        sp.observe(0, 1, proposed=1, accepted=0)        # probe executed
+        assert sp.k_for(0, rid=1) == 0                  # re-armed
+        for _ in range(8):                              # recovery
+            sp.observe(0, 1, proposed=4, accepted=4)
+        assert sp.k_for(0, rid=1) == 4
+
+    def test_state_resets_per_tenant(self):
+        sp = Speculator(4, min_rate=0.25)
+        for _ in range(6):
+            sp.observe(0, 1, proposed=4, accepted=0)
+        assert sp.k_for(0, rid=1) == 0
+        assert sp.k_for(0, rid=2) == 4                  # new tenant
+
+    def test_counters(self):
+        sp = Speculator(4)
+        sp.observe(0, 1, proposed=3, accepted=2)
+        sp.observe(1, 2, proposed=4, accepted=0)
+        assert sp.drafted_total == 7
+        assert sp.accepted_total == 2
+        assert sp.rejected_total == 5
+        assert sp.verify_steps == 2
+        assert sp.acceptance_rate == pytest.approx(2 / 7)
+
+
+class TestSpecParity:
+    """ACCEPTANCE: speculative greedy decode == plain paged decode,
+    token for token, at fp and int8-KV."""
+
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    def test_ngram_spec_matches_plain(self, kv):
+        cfg, params = _setup()
+        prompts = (_repetitive_prompts(cfg, [13, 9], seed=2)
+                   + _prompts(cfg, [7], seed=3))
+        new = 10
+        kw = dict(max_batch=3, page_size=8, max_len=32,
+                  kv_cache_dtype=kv)
+        plain = ContinuousBatchingEngine(params, cfg, **kw)
+        ref = plain.generate(prompts, max_new_tokens=new)
+        spec = ContinuousBatchingEngine(params, cfg, spec_k=3, **kw)
+        got = spec.generate(prompts, max_new_tokens=new)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        assert spec.spec.verify_steps > 0     # speculation actually ran
+
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    def test_full_accept_matches_and_compresses_steps(self, kv):
+        """Oracle drafts (the true continuation) -> every draft accepts,
+        output identical, and the engine takes ~1/(k+1) the decode
+        steps a plain run needs."""
+        cfg, params = _setup()
+        prompts = _prompts(cfg, [5, 7], seed=1)
+        new = 12
+        kw = dict(max_batch=2, page_size=8, max_len=32,
+                  kv_cache_dtype=kv)
+        plain = ContinuousBatchingEngine(params, cfg, **kw)
+        ref = plain.generate(prompts, max_new_tokens=new)
+        oracle = _OracleSpeculator(4, dict(enumerate(ref)))
+        spec = ContinuousBatchingEngine(params, cfg, spec_k=4,
+                                        speculator=oracle, **kw)
+        got = spec.generate(prompts, max_new_tokens=new)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        assert spec.spec.accepted_total == spec.spec.drafted_total > 0
+        # 12 tokens: first from prefill, the rest in ceil(11/5) verifies
+        assert spec._steps < plain._steps
+
+    def test_reject_at_first_draft_matches_plain(self):
+        """Every draft wrong -> every verify commits exactly the one
+        greedy token (the bonus) — plain decode, paid at verify width."""
+        cfg, params = _setup()
+        prompts = _prompts(cfg, [5, 7], seed=4)
+        new = 8
+        kw = dict(max_batch=2, page_size=8, max_len=32)
+        plain = ContinuousBatchingEngine(params, cfg, **kw)
+        ref = plain.generate(prompts, max_new_tokens=new)
+        spec = ContinuousBatchingEngine(params, cfg, spec_k=3,
+                                        speculator=_WrongSpeculator(3),
+                                        **kw)
+        got = spec.generate(prompts, max_new_tokens=new)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        assert spec.spec.accepted_total == 0
+        assert spec.spec.drafted_total > 0
+
+    def test_spec_k0_disables_entirely(self):
+        cfg, params = _setup()
+        eng = ContinuousBatchingEngine(params, cfg, max_batch=2,
+                                       page_size=8, max_len=32)
+        assert eng.spec is None
+        assert eng.propose_drafts(np.ones(2, bool)) == {}
+        prompts = _prompts(cfg, [5], seed=5)
+        ref = ContinuousBatchingEngine(
+            params, cfg, max_batch=2, page_size=8,
+            max_len=32).generate(prompts, max_new_tokens=6)
+        # spec_step on a spec-disabled engine degrades to decode_step
+        eng2 = ContinuousBatchingEngine(params, cfg, max_batch=2,
+                                        page_size=8, max_len=32)
+        reqs = [eng2.submit(p, max_new_tokens=6) for p in prompts]
+        eng2._admit()
+        while eng2._pending:
+            eng2.prefill_step()
+        while not all(r.done for r in reqs):
+            assert eng2.spec_step(eng2.ready_mask()) > 0
+        np.testing.assert_array_equal(reqs[0].output, ref[0])
+
+    def test_spec_requires_greedy(self):
+        cfg, params = _setup()
+        with pytest.raises(ValueError, match="greedy"):
+            ContinuousBatchingEngine(params, cfg, max_batch=2,
+                                     temperature=0.7, spec_k=2)
+
+    def test_eos_inside_accepted_run_stops_exactly(self):
+        """A draft run that crosses the eos token must stop AT eos —
+        accepted tokens past it are dropped, matching plain decode."""
+        cfg, params = _setup()
+        prompts = _prompts(cfg, [6], seed=6)
+        new = 12
+        kw = dict(max_batch=1, page_size=8, max_len=32)
+        plain = ContinuousBatchingEngine(params, cfg, **kw)
+        ref = plain.generate(prompts, max_new_tokens=new)
+        # pick the 3rd generated token as "eos" so it lands mid-run
+        gen_toks = ref[0][len(prompts[0]):]
+        eos = int(gen_toks[2])
+        plain2 = ContinuousBatchingEngine(params, cfg,
+                                          eos_token_id=eos, **kw)
+        r_ref = plain2.submit(prompts[0], max_new_tokens=new)
+        plain2.run()
+        oracle = _OracleSpeculator(4, {0: ref[0]})
+        spec = ContinuousBatchingEngine(params, cfg, eos_token_id=eos,
+                                        spec_k=4, speculator=oracle,
+                                        **kw)
+        r_spec = spec.submit(prompts[0], max_new_tokens=new)
+        spec.run()
+        assert r_spec.finish_reason == "eos"
+        np.testing.assert_array_equal(r_spec.output, r_ref.output)
+
+
+class TestRollbackConsistency:
+    """Rollback is pure length bookkeeping: the allocator never sees a
+    verify, refcounts stay balanced, and pages drain clean."""
+
+    def test_allocator_balanced_after_spec_run_with_rejections(self):
+        cfg, params = _setup()
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=2, page_size=8, max_len=32,
+            spec_k=3, speculator=_WrongSpeculator(3),
+            enable_prefix_cache=False)
+        eng.generate(_prompts(cfg, [5, 9, 7], seed=7),
+                     max_new_tokens=8)
+        st = eng.cache.allocator.stats()
+        assert st["num_used"] == 0
+        assert st["allocs_total"] == st["frees_total"] > 0
+        assert eng.spec.rejected_total > 0
+
+    def test_lengths_track_committed_tokens_only(self):
+        """Mid-run, a slot's length is prompt + generated - 1 (the last
+        sampled token's KV is pending) — rejected verify rows never
+        advance it."""
+        cfg, params = _setup()
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=1, page_size=8, max_len=32,
+            spec_k=3, speculator=_WrongSpeculator(3))
+        prompt = _prompts(cfg, [6], seed=8)[0]
+        req = eng.submit(prompt, max_new_tokens=8)
+        eng._admit()
+        eng.prefill_step()
+        for _ in range(3):
+            eng.spec_step(eng.ready_mask())
+            assert eng.cache.lengths[0] == prompt.size + len(req.tokens) - 1
+
+    def test_stale_rows_overwritten_before_visible(self):
+        """After a rejected verify wrote garbage rows past the committed
+        length, continuing decode still matches plain decode (the
+        length mask + sequential overwrite contract)."""
+        cfg, params = _setup()
+        prompts = _prompts(cfg, [5], seed=9)
+        ref = ContinuousBatchingEngine(
+            params, cfg, max_batch=1, page_size=8,
+            max_len=32).generate(prompts, max_new_tokens=10)
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=1, page_size=8, max_len=32,
+            spec_k=3, speculator=_WrongSpeculator(3))
+        req = eng.submit(prompts[0], max_new_tokens=10)
+        eng._admit()
+        eng.prefill_step()
+        eng.spec_step(eng.ready_mask())     # rejected verify, stale rows
+        eng.spec = None                     # continue PLAIN from here
+        while not req.done:
+            eng.decode_step(eng.ready_mask())
+        np.testing.assert_array_equal(req.output, ref[0])
+
+
+class TestBudgetWithVerifies:
+    def test_planner_charges_verify_width(self):
+        planner = TokenBudgetPlanner(8, page_size=8)
+        plan = planner.plan([(0, 0, 0), (0, 1, 1), (0, 2, 2)], [],
+                            spec_drafts={0: 4, 1: 4, 2: 4})
+        assert plan.scheduled_tokens == 8
+        # greedy in rid order: slot0 gets 1+4 (left 3), slot1 1+2
+        # drafts trimmed to the budget tail (left 0), slot2 defers
+        assert plan.decode_slots == [0, 1]
+        assert plan.spec_drafts == {0: 4, 1: 2}
+        assert plan.deferred_decodes == 1
+        # a budget tail of exactly 1 degrades a verify to plain decode
+        plan = TokenBudgetPlanner(8, page_size=8).plan(
+            [(0, 0, 0), (0, 1, 1)], [], spec_drafts={0: 6, 1: 6})
+        assert plan.spec_drafts == {0: 6}
+        assert plan.decode_slots == [0, 1]     # slot1 rides plain
+        assert plan.scheduled_tokens == 8
+
+    def test_budget_never_exceeded_with_verifies_in_plan(self):
+        """ACCEPTANCE: across a bursty two-priority spec run, every
+        executed step's debit stays within the budget while verifies
+        are actually planned."""
+        cfg, params = _setup()
+        prompts = _prompts(cfg, [5, 7, 6, 9], seed=10)
+        new = 10
+        ref = {}
+        plain = ContinuousBatchingEngine(params, cfg, max_batch=2,
+                                         page_size=8, max_len=32)
+        for i, r in enumerate(plain.generate(prompts,
+                                             max_new_tokens=new)):
+            ref[i] = r
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=2, page_size=8, max_len=32,
+            spec_k=4, speculator=_OracleSpeculator(4, dict(ref)))
+        budget = 12
+        sched = ServingScheduler(eng, token_budget=budget)
+        reqs = [sched.submit(p, max_new_tokens=new,
+                             priority=Priority.NORMAL if i % 2
+                             else Priority.LOW)
+                for i, p in enumerate(prompts)]
+        saw_verify = False
+        while sched.step():
+            plan = sched.last_plan
+            assert plan.scheduled_tokens <= budget
+            saw_verify = saw_verify or bool(plan.spec_drafts)
+        assert saw_verify
+        # budgeted speculative run stays token-identical, too
+        for i, r in enumerate(reqs):
+            np.testing.assert_array_equal(r.output, ref[i])
+
+    def test_planner_spec_without_budget_passes_drafts_through(self):
+        planner = TokenBudgetPlanner(None, page_size=8)
+        plan = planner.plan([(0, 0, 0), (1, 1, 1)], [],
+                            spec_drafts={0: 3})
+        assert plan.decode_slots == [0, 1]
+        assert plan.spec_drafts == {0: 3}
+        assert plan.scheduled_tokens == 5
+
+
+class TestSpecTelemetry:
+    def test_spec_metrics_emitted(self):
+        from paddle_tpu import observability as obs
+        cfg, params = _setup()
+        was = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            eng = ContinuousBatchingEngine(
+                params, cfg, max_batch=2, page_size=8, max_len=32,
+                spec_k=3, speculator=_WrongSpeculator(3))
+            eng.generate(_prompts(cfg, [5, 7], seed=11),
+                         max_new_tokens=6)
+            snap = obs.REGISTRY.to_json()
+        finally:
+            obs.REGISTRY.clear()
+            if not was:
+                obs.disable()
+        assert snap["serving_spec_steps_total"]["values"][""] >= 1
+        drafted = snap["serving_spec_drafted_tokens_total"]["values"][""]
+        rolled = snap["serving_spec_rollback_tokens_total"]["values"][""]
+        assert drafted > 0
+        # the wrong-speculator run rejects everything
+        assert rolled == drafted
+        assert snap["serving_spec_accepted_tokens_total"]["values"][
+            ""] == 0
+        rate = snap["serving_spec_acceptance_rate"]["values"][""]
+        assert rate["count"] >= 1          # one observation per verify
+
+
+class TestVerifyProgram:
+    def test_verify_matches_decode_forward_position0(self):
+        """The verify program's position-0 logits equal the plain
+        decode forward's logits for the same last token — the op-level
+        identity the engine parity rests on."""
+        cfg, params = _setup(seed=12)
+        page = 8
+        pool = generate.init_paged_cache(cfg, num_pages=9,
+                                         page_size=page)
+        tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+        rs = np.random.RandomState(13)
+        # seed the pools with prefilled prompts via the insert program
+        plens = [6, 10]
+        for b, n in enumerate(plens):
+            pr = jnp.asarray(rs.randint(3, cfg.vocab_size, (1, n)),
+                             jnp.int32)
+            _, pool = generate.paged_prefill_insert(params, pr, pool,
+                                                    tables[b], cfg)
+        lengths = jnp.asarray(plens, jnp.int32)
+        toks = jnp.asarray(rs.randint(3, cfg.vocab_size, (2,)),
+                           jnp.int32)
+        ref_logits, _ = generate.paged_decode_forward(
+            params, toks, pool, tables, lengths, cfg, use_kernel=False)
+        chunk = jnp.concatenate(
+            [toks[:, None],
+             jnp.asarray(rs.randint(3, cfg.vocab_size, (2, 3)),
+                         jnp.int32)], axis=1)
+        all_logits, _ = generate.paged_verify_forward(
+            params, chunk, pool, tables, lengths, cfg, ctx_cap=16,
+            use_kernel=False)
+        np.testing.assert_allclose(np.asarray(all_logits[:, 0]),
+                                   np.asarray(ref_logits),
+                                   rtol=2e-5, atol=2e-5)
+        assert (jnp.argmax(all_logits[:, 0], -1)
+                == jnp.argmax(ref_logits, -1)).all()
+
+    def test_verify_program_lowers_for_tpu(self):
+        """AOT lowering guard for the batched verify step (the
+        interpret-green-but-won't-lower class; mirrored in
+        tools/aot_validate.py --config serving)."""
+        import jax.export
+        cfg, params = _setup(seed=5)
+        paged = generate.init_paged_cache(cfg, num_pages=9, page_size=8)
+        tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+        chunk = jnp.ones((2, 4), jnp.int32)
+        exp = jax.export.export(
+            jax.jit(lambda p, c, pool, bt, ln, m:
+                    generate.paged_verify_forward(
+                        p, c, pool, bt, ln, cfg, ctx_cap=16, active=m)),
+            platforms=["tpu"])(params, chunk, paged, tables,
+                               jnp.asarray([6, 10], jnp.int32),
+                               jnp.asarray([True, True]))
+        assert exp.mlir_module()       # export completing is the gate

