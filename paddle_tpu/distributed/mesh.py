@@ -164,6 +164,27 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
         return g
 
 
+def serving_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 1-D ``("tp",)`` mesh the tensor-parallel serving
+    engine shards over (ISSUE 7): the first ``tp`` devices, one axis.
+    The serving stack deliberately takes a plain Mesh rather than a
+    :class:`Group` — the engine's shard_map programs only need the axis
+    name, and keeping it decoupled from the global-mesh singleton lets
+    a server and a trainer coexist in one process.
+
+    Use with ``ContinuousBatchingEngine(..., mesh=serving_mesh(4))``;
+    weights partition by :data:`paddle_tpu.models.llama.
+    SERVING_TP_RULES` and the KV page pools shard on the head axis."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if tp < 1:
+        raise ValueError(f"serving_mesh: tp must be >= 1, got {tp}")
+    if tp > len(devs):
+        raise ValueError(
+            f"serving_mesh: tp={tp} exceeds the {len(devs)} available "
+            f"device(s)")
+    return Mesh(np.asarray(devs[:tp]), ("tp",))
+
+
 def is_initialized() -> bool:
     return _state["initialized"]
 

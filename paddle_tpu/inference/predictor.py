@@ -442,10 +442,28 @@ class ContinuousBatchingEngine:
     not repeat, and greedy output stays TOKEN-IDENTICAL to plain paged
     decode at fp and int8-KV (gated in tests/test_spec_decode.py).
 
+    Tensor-parallel serving (``mesh=`` — ISSUE 7): pass a 1-D
+    :func:`~paddle_tpu.distributed.mesh.serving_mesh` and the engine
+    shards weights by regex partition rules
+    (:data:`~paddle_tpu.models.llama.SERVING_TP_RULES` — column splits
+    per layer matrix, vocab-sharded lm_head) and every page pool on the
+    kv-head axis, lowering the decode/chunk/verify programs through
+    ``shard_map``. Page IDS are identical on every shard, so the whole
+    host control plane — queues, slots, allocator, refcounts, prefix
+    trie, preemption — runs unchanged; per-shard HBM drops to ``1/tp``
+    of the weight+pool bytes (the decode bottleneck), and the sharded
+    programs stay BIT-identical to single-chip paged decode at fp and
+    int8-KV (exact all-gather concats, no psum —
+    tests/test_tp_serving.py). GQA configs with ``num_kv_heads < tp``
+    replicate one kv head per shard; invalid head/tp combinations raise
+    loudly at construction.
+
     Telemetry (paddle_tpu.observability): admission/eviction counters,
     prefix hit/miss token counters, per-chunk prefill latency histogram,
     per-step batch-occupancy histogram, block-pool utilization gauge —
-    zero-cost when metrics are disabled.
+    plus, under a mesh, the ``serving_tp_*`` family (traced all-gather
+    calls/bytes, per-shard pool gauge, probed logits-collective latency
+    histogram) — zero-cost when metrics are disabled.
     """
 
     def __init__(self, params, cfg, *, max_batch: int = 4,
@@ -457,18 +475,41 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  enable_prefix_cache: bool = True,
                  spec_k: int = 0, spec_ngram: int = 3,
-                 speculator=None):
+                 speculator=None, mesh=None):
         from ..serving import PagedKVCache
-        self.params = params
         self.cfg = cfg
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
         self.use_kernel = use_kernel
+        # --- tensor-parallel serving (ISSUE 7): a 1-D mesh shards the
+        # weights (llama.SERVING_TP_RULES: column splits + vocab-sharded
+        # lm_head) and every page pool on the kv-head axis; the jitted
+        # step programs below lower through shard_map. ALL host logic —
+        # queues, slots, block tables, allocator, trie — is unchanged:
+        # page ids are the same on every shard.
+        self.mesh = mesh
+        self._tp = None
+        self._tp_axis = None
+        self._param_specs = None
+        self._tp_probe = None
+        if mesh is not None:
+            from ..models import llama as _llama
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    f"ContinuousBatchingEngine: the serving mesh must "
+                    f"be 1-D (a tp axis), got axes {mesh.axis_names}")
+            self._tp_axis = mesh.axis_names[0]
+            self._tp = int(mesh.shape[self._tp_axis])
+            # validates num_heads/num_kv_heads divisibility loudly and
+            # takes the KV-replication path when num_kv_heads < tp
+            params, self._param_specs = _llama.shard_serving_params(
+                params, cfg, mesh, axis=self._tp_axis)
+        self.params = params
         self.cache = PagedKVCache(
             cfg, max_batch, max_len or cfg.max_seq_len,
             page_size=page_size, num_pages=num_pages,
             kv_dtype=kv_cache_dtype,
-            enable_prefix_cache=enable_prefix_cache)
+            enable_prefix_cache=enable_prefix_cache, mesh=mesh)
         if prefill_chunk is not None:
             # page-rounded so chunk boundaries stay page-aligned (the
             # chunk program's static ctx_cap) and >= one page
@@ -545,15 +586,42 @@ class ContinuousBatchingEngine:
         return req
 
     # ---- jitted programs (one decode; one prefill per page bucket) ----
+    def _tp_map(self, fn, arg_kinds):
+        """Lower a per-shard serving forward through shard_map on the
+        engine's 1-D tp mesh. ``arg_kinds``: one of ``"params"`` (the
+        regex-rule spec pytree), ``"pool"`` (page pools, head axis
+        sharded) or ``"rep"`` (replicated host-side small args) per
+        positional argument. Outputs are always ``(logits, pool)`` —
+        logits are replicated (the per-shard body already all-gathered
+        them; ``check_rep=False`` skips the symbolic replication proof,
+        same as the training-side ring-attention shard_map)."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        kinds = {"params": self._param_specs,
+                 "pool": self.cache.pool_specs, "rep": P()}
+        return shard_map(
+            fn, mesh=self.mesh,
+            in_specs=tuple(kinds[k] for k in arg_kinds),
+            out_specs=(P(), self.cache.pool_specs), check_rep=False)
+
     def _decode(self):
         if self._decode_fn is None:
             from ..models import generate as gen
             cfg, temp, uk = self.cfg, self.temperature, self.use_kernel
+            ax = self._tp_axis
+
+            def fwd(params, last, paged, tables, lengths, active):
+                return gen.paged_decode_forward(
+                    params, last, paged, tables, lengths, cfg,
+                    active=active, use_kernel=uk, tp_axis=ax)
+
+            if self.mesh is not None:
+                fwd = self._tp_map(fwd, ("params", "rep", "pool",
+                                         "rep", "rep", "rep"))
 
             def f(params, last, paged, tables, lengths, active, key):
-                logits, paged = gen.paged_decode_forward(
-                    params, last, paged, tables, lengths, cfg,
-                    active=active, use_kernel=uk)
+                logits, paged = fwd(params, last, paged, tables,
+                                    lengths, active)
                 if temp == 0.0:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
@@ -574,13 +642,16 @@ class ContinuousBatchingEngine:
         key = (ctx_cap, width)
         if key not in self._chunk_fns:
             from ..models import generate as gen
-            cfg = self.cfg
+            cfg, ax = self.cfg, self._tp_axis
 
             def f(params, chunk, paged, table, ctx_len, chunk_len):
                 return gen.paged_prefill_chunk(
                     params, chunk, paged, table, cfg, ctx_cap=ctx_cap,
-                    ctx_len=ctx_len, chunk_len=chunk_len)
+                    ctx_len=ctx_len, chunk_len=chunk_len, tp_axis=ax)
 
+            if self.mesh is not None:
+                f = self._tp_map(f, ("params", "rep", "pool", "rep",
+                                     "rep", "rep"))
             self._chunk_fns[key] = jax.jit(f, donate_argnums=(2,))
         return self._chunk_fns[key]
 
@@ -594,12 +665,21 @@ class ContinuousBatchingEngine:
         key = (ctx_cap, T)
         if key not in self._spec_fns:
             from ..models import generate as gen
-            cfg, uk = self.cfg, self.use_kernel
+            cfg, uk, ax = self.cfg, self.use_kernel, self._tp_axis
+
+            def fwd(params, chunk, paged, tables, lengths, active):
+                return gen.paged_verify_forward(
+                    params, chunk, paged, tables, lengths, cfg,
+                    ctx_cap=ctx_cap, active=active, use_kernel=uk,
+                    tp_axis=ax)
+
+            if self.mesh is not None:
+                fwd = self._tp_map(fwd, ("params", "rep", "pool",
+                                         "rep", "rep", "rep"))
 
             def f(params, chunk, paged, tables, lengths, active):
-                logits, paged = gen.paged_verify_forward(
-                    params, chunk, paged, tables, lengths, cfg,
-                    ctx_cap=ctx_cap, active=active, use_kernel=uk)
+                logits, paged = fwd(params, chunk, paged, tables,
+                                    lengths, active)
                 return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                         paged)
 
@@ -804,6 +884,39 @@ class ContinuousBatchingEngine:
         self._slots[req.slot] = None
         _obs.serving_retired(1, reason)
 
+    def _tp_observe(self):
+        """tp-serving telemetry (ISSUE 7): the per-shard pool gauge
+        every step, plus — every 16th step — a TIMED logits-collective
+        probe: a dedicated jitted all-gather of a logits-shard-sized
+        array over the serving mesh. The step program's own collective
+        time is invisible from the host (it fuses into one XLA
+        program), so the probe measures the same collective in
+        isolation and feeds the ``serving_tp_logits_gather_ms``
+        histogram."""
+        if self.mesh is None or not _obs.active():
+            return
+        alloc = self.cache.allocator
+        _obs.serving_tp_step(self._tp, alloc.num_used, alloc.num_usable)
+        if (self._steps - 1) % 16:      # first step, then every 16th
+            return
+        if self._tp_probe is None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh, ax, tp = self.mesh, self._tp_axis, self._tp
+            vp = -(-self.cfg.vocab_size // tp)  # per-shard logits cols
+            x = jax.device_put(
+                jnp.zeros((self.max_batch, vp * tp), jnp.float32),
+                NamedSharding(mesh, P(None, ax)))
+            f = jax.jit(shard_map(
+                lambda t: jax.lax.all_gather(t, ax, axis=1, tiled=True),
+                mesh=mesh, in_specs=P(None, ax), out_specs=P(),
+                check_rep=False))
+            np.asarray(f(x))            # compile outside the timing
+            self._tp_probe = (f, x)
+        probe, x = self._tp_probe
+        t0 = _obs.generate_begin()
+        _obs.serving_tp_logits_gather(t0, probe(x))
+
     def ready_mask(self) -> np.ndarray:
         """(max_batch,) bool — slots whose sequence is fully in the
         pool and can decode this step; slots mid-prefill hold pages
@@ -841,6 +954,7 @@ class ContinuousBatchingEngine:
         alloc = cache.allocator
         _obs.serving_step(n_active, self.max_batch, alloc.num_used,
                           alloc.num_usable)
+        self._tp_observe()
         return n_active
 
     # ---- speculative decoding (ISSUE 5) ----
@@ -952,6 +1066,7 @@ class ContinuousBatchingEngine:
         alloc = cache.allocator
         _obs.serving_step(n_slots, self.max_batch, alloc.num_used,
                           alloc.num_usable)
+        self._tp_observe()
         return committed
 
     def step(self) -> bool:
@@ -1015,6 +1130,9 @@ class ContinuousBatchingEngine:
         s = self.cache.allocator.stats()
         s["steps"] = self._steps
         s["queued"] = len(self._queue)
+        if self.mesh is not None:
+            s["tp"] = self._tp
+            s["pool_bytes_per_shard"] = self.cache.pool_bytes_per_shard
         s["active_slots"] = int(self.cache.active.sum())
         s["pending_prefills"] = len(self._pending)
         s["cow_copies"] = self.cache.cow_copies

@@ -26,13 +26,37 @@ from .llama import LlamaConfig, rope_tables, apply_rope, rms_norm
 from ..observability import hooks as _obs
 
 
+def _tp_allgather(x: jax.Array, axis_name: str, axis: int) -> jax.Array:
+    """Tensor-parallel serving collective: tiled all-gather of a
+    column-sharded activation along ``axis`` (exact — a concatenation
+    in shard order, no reduction to reassociate, which is what keeps
+    tp-sharded decode BIT-identical to single-chip). The byte counter
+    fires at TRACE time, so like ``hooks.collective`` it counts the
+    collectives in the compiled program (per-shard payload bytes)."""
+    _obs.serving_tp_allgather(int(x.size) * jnp.dtype(x.dtype).itemsize)
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _tp_heads(layers: Dict, cfg: LlamaConfig) -> Tuple[int, int]:
+    """Per-SHARD (num_heads, num_kv_heads) from the local weight shards
+    (inside shard_map the cfg still describes the GLOBAL model; the
+    sliced wq/wk columns carry the local head counts)."""
+    return (layers["wq"].shape[-1] // cfg.hd,
+            layers["wk"].shape[-1] // cfg.hd)
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
-               kv_dtype=None) -> Dict:
+               kv_dtype=None, num_kv_heads: Optional[int] = None) -> Dict:
     """``kv_dtype="int8"``: int8 KV cache with PER-ROW dequant scales
     (each cached token row carries its own scale — self-calibrating, no
     calibration pass), halving KV HBM for long-context decode
-    (reference: the cachekv-int8 tier of block_multihead_attention)."""
+    (reference: the cachekv-int8 tier of block_multihead_attention).
+    ``num_kv_heads`` overrides the config's head count — the per-shard
+    temp caches of the tensor-parallel chunk/verify programs hold only
+    the shard's own kv heads."""
     L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.hd
+    if num_kv_heads is not None:
+        nkv = num_kv_heads
     if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
         raise ValueError(
             f"init_cache: kv_dtype={kv_dtype!r} is not supported — pass "
@@ -53,7 +77,7 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
 
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
-                     kv_dtype=None) -> Dict:
+                     kv_dtype=None, tp: Optional[int] = None) -> Dict:
     """Paged KV cache: one global pool of fixed-size token pages per
     layer — ``(L, num_pages, page_size, nkv, hd)`` — indexed by
     per-request block tables instead of a dense ``(L, B, S_max, ...)``
@@ -63,8 +87,21 @@ def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
 
     ``kv_dtype="int8"`` mirrors :func:`init_cache`'s per-row-scale int8
     tier: pages store int8 rows, ``ks``/``vs`` pools carry the per-row
-    dequant scales."""
+    dequant scales.
+
+    ``tp``: build the GLOBAL pool for a tensor-parallel serving mesh of
+    that size — the head axis shards over tp (``nkv/tp`` heads per
+    shard, same page ids everywhere so the host-side allocator / block
+    tables / prefix trie stay replicated and untouched). Divisibility
+    is validated LOUDLY (:func:`~paddle_tpu.models.llama.
+    validate_serving_tp`): a silent mis-shard would split heads across
+    chips. GQA with ``num_kv_heads < tp`` takes the replication path —
+    the head extent expands to ``tp`` (each kv head repeated
+    ``tp/num_kv_heads`` times, one per shard), so per-shard page bytes
+    are ``1/num_kv_heads`` of the pool rather than ``1/tp``."""
     L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.hd
+    if tp is not None:
+        nkv = llama.validate_serving_tp(cfg, tp) * tp
     if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
         raise ValueError(
             f"init_paged_cache: kv_dtype={kv_dtype!r} is not supported — "
@@ -162,7 +199,7 @@ def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
 
 def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
                         block_table: jax.Array, cfg: LlamaConfig, *,
-                        ctx_cap: int, ctx_len, chunk_len):
+                        ctx_cap: int, ctx_len, chunk_len, tp_axis=None):
     """Prefill ONE chunk of a request's prompt against the KV already in
     its pages — the chunked-prefill / prefix-cache continuation program
     (one compile per static ``(ctx_cap, C)`` pair; the engine buckets
@@ -201,7 +238,11 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     to rebuild its evicted pages (decode then re-feeds the last sampled
     token), which is why resume is bit-identical to an uninterrupted
     run rather than approximately so (gated in tests/test_scheduler.py
-    at fp and int8-KV)."""
+    at fp and int8-KV).
+
+    ``tp_axis``: run as one tensor-parallel shard (inside shard_map;
+    see :func:`_block_infer`) — ``paged`` then holds the shard's own kv
+    heads and the temp cache is sized from the pool, not the config."""
     B, C = tokens.shape
     if B != 1:
         raise ValueError(
@@ -217,7 +258,8 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     ctx_len = jnp.asarray(ctx_len, jnp.int32).reshape(())
     chunk_len = jnp.asarray(chunk_len, jnp.int32).reshape(())
     pad = ctx_cap - ctx_len                       # garbage rows below
-    dense = init_cache(cfg, 1, W, kv_dtype="int8" if quant else None)
+    dense = init_cache(cfg, 1, W, kv_dtype="int8" if quant else None,
+                       num_kv_heads=paged["k"].shape[3])
     if ctx_cap:
         ppc = ctx_cap // page
         ctx_tbl = block_table[:ppc]
@@ -233,7 +275,8 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     rpos = (ctx_len + jnp.arange(C, dtype=jnp.int32))[None, :]
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
                                     W, rpos=rpos, kstart=kstart,
-                                    logits_at=chunk_len - 1)
+                                    logits_at=chunk_len - 1,
+                                    tp_axis=tp_axis)
     pos = jnp.arange(C, dtype=jnp.int32)
     logical = jnp.clip(ctx_len + pos, 0, ext - 1)
     dst = jnp.where(pos < chunk_len,
@@ -249,7 +292,7 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
 def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, ctx_cap: int, active=None,
-                         use_kernel=None):
+                         use_kernel=None, tp_axis=None):
     """Batched speculative-decode VERIFY: score a ``T``-token chunk for
     EVERY speculating row against its paged KV in ONE forward — the
     batched generalization of :func:`paged_prefill_chunk` (which runs
@@ -301,7 +344,8 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
         active = jnp.ones((B,), bool)
     lengths = jnp.clip(jnp.asarray(lengths, jnp.int32), 0, ctx_cap)
     pad = ctx_cap - lengths                              # (B,)
-    dense = init_cache(cfg, B, W, kv_dtype="int8" if quant else None)
+    dense = init_cache(cfg, B, W, kv_dtype="int8" if quant else None,
+                       num_kv_heads=paged["k"].shape[3])
     if ctx_cap:
         ppc = ctx_cap // page
         ctx_tbl = block_tables[:, :ppc]                  # (B, ppc)
@@ -318,7 +362,8 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     rpos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
                                     W, use_kernel=use_kernel, rpos=rpos,
-                                    kstart=pad, logits_all=True)
+                                    kstart=pad, logits_all=True,
+                                    tp_axis=tp_axis)
     # scatter the T new rows of every row into its pages; inactive rows
     # and positions past the slot extent route to the trash page
     pos = rpos                                           # (B, T)
@@ -338,7 +383,7 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, active=None,
-                         use_kernel=None):
+                         use_kernel=None, tp_axis=None):
     """One continuous-batching decode step over the ragged batch: every
     slot advances one token in a single static-shape program.
 
@@ -353,12 +398,22 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
 
     Math is kept op-for-op identical to the dense decode
     (:func:`_block_infer` + ``_attn_with_cache``-equivalent paged
-    attention), so greedy tokens match the dense path exactly."""
+    attention), so greedy tokens match the dense path exactly.
+
+    ``tp_axis``: run as one shard of a tensor-parallel serving mesh
+    (inside shard_map): weights arrive column-sharded, ``paged`` holds
+    the shard's own kv heads (same page ids on every shard — block
+    tables/lengths replicate), attention is per-head local (no comm in
+    the kernel), and activations all-gather to full width before each
+    contraction — exact concats, so tp decode stays BIT-identical to
+    single-chip paged decode (gated in tests/test_tp_serving.py)."""
     from ..ops.pallas import paged_attention as _pa
     B = tokens.shape[0]
     page = paged["k"].shape[2]
     ext = block_tables.shape[1] * page
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    if tp_axis is not None:
+        nh, nkv = _tp_heads(params["layers"], cfg)
     quant = "ks" in paged
     if active is None:
         active = jnp.ones((B,), bool)
@@ -415,12 +470,23 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
         o = _pa.paged_attention(
             q[:, 0], kp, vp, block_tables, lengths + 1,
             ks_pages=ksp, vs_pages=vsp, use_kernel=use_kernel)
-        xo = xc + o.reshape(B, 1, nh * hd) @ _w(lp, "wo", xc.dtype)
+        o = o.reshape(B, 1, nh * hd)
+        if tp_axis is not None:
+            o = _tp_allgather(o, tp_axis, 2)
+            xo = xc + _tp_allgather(o @ _w(lp, "wo", xc.dtype),
+                                    tp_axis, 2)
+        else:
+            xo = xc + o @ _w(lp, "wo", xc.dtype)
         h2 = rms_norm(xo, lp["mlp_norm"], cfg.rms_eps)
         g = jax.nn.silu((h2 @ _w(lp, "wg", xc.dtype)).astype(
             jnp.float32)).astype(xc.dtype)
         u = h2 @ _w(lp, "wu", xc.dtype)
-        y = xo + (g * u) @ _w(lp, "wd", xc.dtype)
+        if tp_axis is not None:
+            gu = _tp_allgather(g * u, tp_axis, 2)
+            y = xo + _tp_allgather(gu @ _w(lp, "wd", xc.dtype),
+                                   tp_axis, 2)
+        else:
+            y = xo + (g * u) @ _w(lp, "wd", xc.dtype)
         return y, ((kp, vp, ksp, vsp) if quant else (kp, vp))
 
     xs = ((params["layers"], paged["k"], paged["v"], paged["ks"],
@@ -431,10 +497,14 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                  if quant else {"k": new[0], "v": new[1]})
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     if cfg.tie_embeddings:
-        head = params["embed"].T.astype(x.dtype)
+        head = params["embed"].T.astype(x.dtype)    # replicated: full
+        gather = False
     else:
         head = _w(params, "lm_head", x.dtype)
+        gather = tp_axis is not None                # vocab-sharded
     logits = (x[:, -1] @ head).astype(jnp.float32)
+    if gather:
+        logits = _tp_allgather(logits, tp_axis, 1)
     return logits, new_paged
 
 
@@ -567,16 +637,24 @@ def _rope_rows(x, cos, sin, rpos):
 
 def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                  use_kernel=None, rpos=None, kstart=None,
-                 cache_ks=None, cache_vs=None):
+                 cache_ks=None, cache_vs=None, tp_axis=None):
     """One decoder layer over T tokens starting at cache index ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
     rpos: optional (B,T) per-row rope positions (!= cache index when the
     batch is left-padded); kstart: optional (B,) first valid cache slot.
     cache_ks/vs: (B, Smax, nkv) per-row dequant scales when the cache is
     int8 (see init_cache kv_dtype).
+    tp_axis: mesh axis name when running as one shard of a
+    tensor-parallel serving mesh (inside shard_map): weights arrive
+    column-sharded (local head/ffn/hidden output columns), the cache
+    holds the shard's own kv heads, and activations all-gather to full
+    width before each contraction — exact concats, so the math stays
+    bit-identical to the single-chip path (see llama.SERVING_TP_RULES).
     """
     B, T, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    if tp_axis is not None:
+        nh, nkv = _tp_heads(lp, cfg)
     h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = (h1 @ _w(lp, "wq", x.dtype)).reshape(B, T, nh, hd)
     k = (h1 @ _w(lp, "wk", x.dtype)).reshape(B, T, nkv, hd)
@@ -620,25 +698,40 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                          use_kernel=use_kernel, kstart=kstart,
                          k_rows=cache_ks if quant else None,
                          v_rows=cache_vs if quant else None)
-    x = x + o.reshape(B, T, nh * hd) @ _w(lp, "wo", x.dtype)
+    o = o.reshape(B, T, nh * hd)
+    if tp_axis is not None:
+        # full heads before the (column-sharded) wo contraction, then
+        # full hidden before the residual add — both exact concats
+        o = _tp_allgather(o, tp_axis, 2)
+        x = x + _tp_allgather(o @ _w(lp, "wo", x.dtype), tp_axis, 2)
+    else:
+        x = x + o @ _w(lp, "wo", x.dtype)
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     g = jax.nn.silu((h2 @ _w(lp, "wg", x.dtype)).astype(
         jnp.float32)).astype(x.dtype)
     u = h2 @ _w(lp, "wu", x.dtype)
+    if tp_axis is not None:
+        gu = _tp_allgather(g * u, tp_axis, 2)
+        ff = _tp_allgather(gu @ _w(lp, "wd", x.dtype), tp_axis, 2)
+        return x + ff, cache_k, cache_v, cache_ks, cache_vs
     return (x + (g * u) @ _w(lp, "wd", x.dtype), cache_k, cache_v,
             cache_ks, cache_vs)
 
 
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
-                    kstart=None, logits_at=None, logits_all=False):
+                    kstart=None, logits_at=None, logits_all=False,
+                    tp_axis=None):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
     (B, V), updated cache). ``logits_at``: optional TRACED row index
     into ``tokens`` — logits are taken there instead of at row T-1
     (chunked prefill right-pads the final chunk, so the last VALID
     token is not the last row). ``logits_all``: return logits at EVERY
     row — (B, T, V) — for the speculative-verify program, which needs
-    the greedy target at all draft positions."""
+    the greedy target at all draft positions. ``tp_axis``: run as one
+    shard of a tensor-parallel serving mesh (see :func:`_block_infer`);
+    the vocab-sharded lm_head's partial logits all-gather at the end —
+    the single logits collective the tp decode path pays."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
     quant = "ks" in cache
@@ -652,7 +745,8 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
             cks = cvs = None
         y, nk, nv, nks, nvs = _block_infer(
             xc, lp, ck, cv, pos, cos, sin, cfg, use_kernel=use_kernel,
-            rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs)
+            rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs,
+            tp_axis=tp_axis)
         return y, ((nk, nv, nks, nvs) if quant else (nk, nv))
 
     xs = ((params["layers"], cache["k"], cache["v"], cache["ks"],
@@ -667,12 +761,21 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                        0, x.shape[1] - 1)
         x = lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
     if cfg.tie_embeddings:
+        # tied head = the replicated embedding table: logits are already
+        # full on every shard, no collective needed
         head = params["embed"].T.astype(x.dtype)
+        gather = False
     else:
         head = _w(params, "lm_head", x.dtype)
+        gather = tp_axis is not None          # vocab-sharded partials
     if logits_all:
-        return (x @ head).astype(jnp.float32), new_cache
+        logits = (x @ head).astype(jnp.float32)
+        if gather:
+            logits = _tp_allgather(logits, tp_axis, 2)
+        return logits, new_cache
     logits = (x[:, -1] @ head).astype(jnp.float32)
+    if gather:
+        logits = _tp_allgather(logits, tp_axis, 1)
     return logits, new_cache
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -193,6 +194,124 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
         "layers": layers,
         **({} if cfg.tie_embeddings else {"lm_head": P("fsdp", "tp")}),
     }
+
+
+# ---------------- serving tensor parallelism ----------------
+#
+# The serving engine shards decode/prefill/verify over a 1-D tp mesh
+# (ISSUE 7 / ROADMAP 1). Unlike the training specs above (Megatron
+# column->ROW split, psums inserted by GSPMD), serving TP is built for
+# BIT-IDENTITY with the single-chip paged path: every weight matmul is
+# COLUMN-parallel (output dim sharded over tp) and the activation is
+# all-gathered to full width before each contraction. An all-gather is
+# an exact concatenation and a column-subset matmul computes each output
+# element with the full, identically-ordered contraction — whereas a
+# row-parallel psum of partial matmuls reassociates the reduction and
+# drifts in the last mantissa bits. Decode is HBM-bound (PERF_NOTES):
+# the win is weight + KV BYTES per shard (all seven layer matrices and
+# lm_head shard 1/tp), and the (B, ·) decode activations the gathers
+# move are noise next to that, so buying exactness with two extra
+# gathers per layer costs ~nothing on the hot path.
+
+#: name-regex -> rule for :func:`match_partition_rules` ("last" shards
+#: the final axis over tp; "replicate" keeps the leaf whole). Quantized
+#: serving weights ride along: per-channel/per-group scales end in the
+#: same output axis as the matrix they scale.
+SERVING_TP_RULES = (
+    (r"layers/(wq|wk|wv|wo|wg|wu|wd)(_scale)?$", "last"),
+    (r"lm_head(_scale)?$", "last"),
+    (r"", "replicate"),
+)
+
+
+def match_partition_rules(params, rules=SERVING_TP_RULES, axis="tp"):
+    """Regex partition rules over '/'-joined leaf names -> a pytree of
+    PartitionSpecs (the fmengine/EasyLM ``match_partition_rules`` idiom;
+    see SNIPPETS [3]). First matching rule wins; scalars replicate."""
+    def spec(path, leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        for pat, kind in rules:
+            if re.search(pat, name) is None:
+                continue
+            if kind == "replicate" or leaf.ndim == 0:
+                return P()
+            if kind == "last":
+                return P(*([None] * (leaf.ndim - 1) + [axis]))
+            raise ValueError(f"unknown partition rule kind {kind!r}")
+        raise ValueError(f"no partition rule matched param {name!r}")
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def validate_serving_tp(cfg: LlamaConfig, tp: int) -> int:
+    """Divisibility gate for serving TP; returns PER-SHARD kv heads.
+
+    Raises a LOUD error instead of mis-sharding: ``num_heads % tp != 0``
+    would split a head across shards (rope/softmax are per-head), and a
+    ``num_kv_heads`` that neither divides into ``tp`` shards nor is a
+    divisor of ``tp`` has no consistent query->kv mapping per shard.
+    GQA with ``num_kv_heads < tp`` takes the KV-REPLICATION path: each
+    shard stores exactly one kv head (its local query heads' group
+    head), i.e. the pool's head extent expands to ``tp`` with each kv
+    head repeated ``tp/num_kv_heads`` times — page bytes per shard are
+    ``1/num_kv_heads`` of the pool instead of ``1/tp``."""
+    if tp < 1:
+        raise ValueError(f"serving tp must be >= 1, got {tp}")
+    if cfg.moe is not None:
+        raise ValueError(
+            "serving TP does not support MoE configs yet — expert "
+            "parallelism owns the ffn axis (train-side ep meshes)")
+    if cfg.num_heads % tp:
+        raise ValueError(
+            f"num_heads={cfg.num_heads} is not divisible by tp={tp}: "
+            f"attention shards at head granularity (rope + softmax are "
+            f"per-head); a silent mis-shard would split a head across "
+            f"chips. Pick tp from the divisors of num_heads.")
+    if cfg.num_kv_heads % tp == 0:
+        return cfg.num_kv_heads // tp
+    if tp % cfg.num_kv_heads == 0:
+        return 1                      # replication path: 1 kv head/shard
+    raise ValueError(
+        f"num_kv_heads={cfg.num_kv_heads} is neither a multiple of "
+        f"tp={tp} (head-sharded KV pools) nor a divisor of it (the "
+        f"replicated-KV GQA path, one kv head per shard); no consistent "
+        f"per-shard query->kv mapping exists. Pick tp so that "
+        f"num_kv_heads % tp == 0 or tp % num_kv_heads == 0.")
+
+
+def _expand_kv_heads(w: jax.Array, hd: int, rep: int) -> jax.Array:
+    """Repeat the per-head column blocks of a K/V projection (or its
+    quant scale) ``rep`` times: (..., nkv*hd) -> (..., nkv*rep*hd). The
+    GQA replication transform — after it, the uniform "head axis shards
+    over tp" machinery applies with every shard holding one kv head."""
+    nkv = w.shape[-1] // hd
+    w = w.reshape(w.shape[:-1] + (nkv, 1, hd))
+    w = jnp.broadcast_to(w, w.shape[:-3] + (nkv, rep, hd))
+    return w.reshape(w.shape[:-3] + (nkv * rep * hd,))
+
+
+def shard_serving_params(params: Dict[str, Any], cfg: LlamaConfig, mesh,
+                         axis: str = "tp"):
+    """Place a (possibly weight-quantized) serving param tree on a 1-D
+    tp mesh: validate divisibility, apply the GQA KV-replication expand
+    when ``num_kv_heads < tp``, match the regex partition rules, and
+    device_put every leaf. Returns ``(placed_params, spec_pytree)`` —
+    the specs double as the ``shard_map`` in_specs of the serving
+    programs (inference/predictor.py)."""
+    tp = int(mesh.shape[axis])
+    nkv_shard = validate_serving_tp(cfg, tp)
+    if nkv_shard * tp != cfg.num_kv_heads:        # replication path
+        rep = tp // cfg.num_kv_heads
+        layers = dict(params["layers"])
+        for nm in ("wk", "wv", "wk_scale", "wv_scale"):
+            if nm in layers:
+                layers[nm] = _expand_kv_heads(layers[nm], cfg.hd, rep)
+        params = {**params, "layers": layers}
+    specs = match_partition_rules(params, axis=axis)
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    return placed, specs
 
 
 # ---------------- building blocks ----------------

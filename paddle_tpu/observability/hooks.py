@@ -316,6 +316,63 @@ def serving_spec_verify(t0_ns: int, out, rows: int, drafted: int,
                               0.875, 1.0)).observe(accepted / drafted)
 
 
+def serving_tp_allgather(nbytes: int):
+    """One tensor-parallel serving all-gather in a TRACED program
+    (models/generate._tp_allgather). Like :func:`collective`, this
+    fires at TRACE time — the counters report the number of collectives
+    (and per-shard payload bytes) in each COMPILED serving program, once
+    per compile, which is exactly the per-step collective bill of the
+    tp decode/prefill/verify path."""
+    if not enabled:
+        return
+    _m.counter("serving_tp_allgather_calls_total",
+               "all-gather collectives traced into tp serving programs"
+               ).inc()
+    _m.counter("serving_tp_allgather_bytes_total",
+               "per-shard payload bytes of traced tp serving all-gathers"
+               ).inc(nbytes)
+
+
+def serving_tp_step(tp: int, pages_used: int, pages_total: int):
+    """One tp-sharded engine step: per-shard pool-utilization gauge.
+    Block tables and the allocator are REPLICATED across the mesh (same
+    page ids everywhere), so every shard's utilization is identical by
+    construction — the per-shard labels make that invariant observable
+    (a divergence would be a sharding bug) and give dashboards the
+    per-shard HBM view (each shard holds 1/tp of the pool bytes)."""
+    if not enabled:
+        return
+    g = _m.gauge("serving_tp_pool_utilization",
+                 "paged-pool utilization per tp shard (replicated "
+                 "tables: all shards identical by construction)",
+                 ("shard",))
+    util = pages_used / max(pages_total, 1)
+    for s in range(tp):
+        g.labels(str(s)).set(util)
+    _m.gauge("serving_tp_shards",
+             "tp mesh size of the serving engine").set(tp)
+
+
+def serving_tp_logits_gather(t0_ns: int, out):
+    """Close one timed logits-collective probe (a dedicated jitted
+    all-gather of a logits-shard-sized array over the serving mesh,
+    run periodically by the engine): the latency histogram of the ONE
+    cross-shard collective the tp decode step ends with. Probed in
+    isolation because the fused step program cannot attribute its own
+    collective time from the host."""
+    if not t0_ns:
+        return
+    _block(out)
+    now = time.perf_counter_ns()
+    _record("Serving.tp_logits_gather", t0_ns, now, "Communication")
+    if enabled:
+        _m.histogram("serving_tp_logits_gather_ms",
+                     "wall milliseconds per probed logits all-gather "
+                     "over the serving tp mesh",
+                     buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25,
+                              50, 100)).observe((now - t0_ns) / 1e6)
+
+
 def serving_queue_wait(seconds: float, priority: int):
     """One admission's time-in-queue (scheduler submit -> slot), by
     priority class — the SLO the scheduler exists to bound."""

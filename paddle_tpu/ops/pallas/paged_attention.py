@@ -26,6 +26,19 @@ Two implementations with IDENTICAL semantics:
 
 :func:`paged_attention` dispatches: kernel on real TPU (or when forced
 via ``use_kernel=True`` — interpret mode in tests), reference elsewhere.
+
+TENSOR-PARALLEL serving (ISSUE 7) runs this op UNCHANGED, per shard:
+inside the engine's ``shard_map`` each shard holds ``nkv/tp`` heads of
+every page (``(P, page, nkv/tp, hd)`` local pools, the same page ids
+everywhere) and its own ``nh/tp`` query heads. Attention softmax is
+per-head, so the kernel needs NO cross-shard communication — the grid
+simply has ``B * nkv/tp`` rows instead of ``B * nkv``, and the GQA
+``rep = H // HK`` grouping still holds because query and kv heads shard
+along the same head-group boundaries (``models/llama.
+validate_serving_tp`` guarantees the divisibility; the ``nkv < tp``
+replication path presents exactly one kv head per shard). Lowering of
+the sharded program is gated by ``tools/aot_validate.py --config
+serving-tp``.
 """
 from __future__ import annotations
 

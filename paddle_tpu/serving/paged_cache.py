@@ -41,6 +41,20 @@ import numpy as np
 TRASH_PAGE = 0
 
 
+def pool_partition_specs(pool: Dict, axis: str = "tp") -> Dict:
+    """Per-array PartitionSpecs sharding a paged pool on its KV-HEAD
+    axis: k/v pages are ``(L, P, page, nkv, hd)`` (head axis 3), the
+    int8 tier's ks/vs scale pools ``(L, P, page, nkv)`` (head axis
+    last). The ONE place this layout is written down — the engine's
+    shard_map programs (inference/predictor.py) and the serving-tp
+    lowering gate (tools/aot_validate.py) must agree on it by
+    construction, not by parallel maintenance."""
+    from jax.sharding import PartitionSpec as P
+    return {name: (P(None, None, None, axis, None) if a.ndim == 5
+                   else P(None, None, None, axis))
+            for name, a in pool.items()}
+
+
 class PoolExhausted(RuntimeError):
     """Raised when an allocation cannot be satisfied from the free list.
 
@@ -389,11 +403,23 @@ class PagedKVCache:
     ``enable_prefix_cache`` (default on) attaches a :class:`PrefixCache`
     so :meth:`admit_prompt` can map previously prefilled prompt pages
     into new admissions (refcounted sharing + copy-on-write tails).
-    """
+
+    ``mesh`` (a 1-D ``("tp",)`` jax Mesh — see
+    :func:`paddle_tpu.distributed.mesh.serving_mesh`): shard the pool
+    arrays on the KV-HEAD axis across a tensor-parallel serving mesh.
+    Each shard holds ``nkv/tp`` heads of every page (GQA with
+    ``nkv < tp``: one replicated head per shard) while page IDS are the
+    same everywhere — so ALL host-side bookkeeping in this module (the
+    :class:`BlockAllocator`, refcounts, the :class:`PrefixCache` trie,
+    block tables, defrag remaps) is replicated and runs UNCHANGED; only
+    the device bytes split. ``pool_specs`` carries the per-array
+    PartitionSpecs for the engine's shard_map programs, and
+    ``pool_bytes_per_shard`` the adjusted page-byte accounting."""
 
     def __init__(self, cfg, max_batch: int, max_len: int,
                  page_size: int = 16, num_pages: Optional[int] = None,
-                 kv_dtype=None, enable_prefix_cache: bool = True):
+                 kv_dtype=None, enable_prefix_cache: bool = True,
+                 mesh=None):
         from ..models import generate as _gen
         if max_len % page_size:
             max_len = (max_len // page_size + 1) * page_size
@@ -407,8 +433,30 @@ class PagedKVCache:
             num_pages = 1 + max_batch * self.pages_per_seq
         self.num_pages = num_pages
         self.kv_dtype = kv_dtype
+        self.mesh = mesh
+        self.tp = None
+        self.tp_axis = None
+        self.pool_specs = None
+        if mesh is not None and len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"PagedKVCache: the serving mesh must be 1-D (a tp "
+                f"axis), got axes {mesh.axis_names}")
+        tp = int(mesh.shape[mesh.axis_names[0]]) if mesh is not None \
+            else None
+        # init_paged_cache(tp=...) validates head divisibility LOUDLY
+        # (and expands the head extent on the GQA replication path)
         self.pool = _gen.init_paged_cache(cfg, num_pages, page_size,
-                                          kv_dtype=kv_dtype)
+                                          kv_dtype=kv_dtype, tp=tp)
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding
+            self.tp = tp
+            self.tp_axis = ax = mesh.axis_names[0]
+            self.pool_specs = pool_partition_specs(self.pool, ax)
+            self.pool = {
+                n: jax.device_put(a, NamedSharding(mesh,
+                                                   self.pool_specs[n]))
+                for n, a in self.pool.items()}
         self.allocator = BlockAllocator(num_pages)
         self.prefix = PrefixCache(page_size) if enable_prefix_cache else None
         self.cow_copies = 0
@@ -595,6 +643,18 @@ class PagedKVCache:
 
     def utilization(self) -> float:
         return self.allocator.utilization()
+
+    @property
+    def pool_bytes_per_shard(self) -> int:
+        """Device bytes of pool arrays RESIDENT PER SHARD — the number
+        the tp sharding exists to shrink. On the GQA replication path
+        the global head extent is already expanded to ``tp`` (each kv
+        head copied ``tp/nkv`` times), so dividing the global bytes by
+        ``tp`` yields the honest per-shard bill: ``1/nkv`` of the
+        unsharded pool, not ``1/tp``."""
+        total = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                    for a in self.pool.values())
+        return total // (self.tp or 1)
 
     def defrag(self):
         """Compact used pages to the front of the pool: one device

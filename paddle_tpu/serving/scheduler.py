@@ -69,12 +69,28 @@ class ServingScheduler:
                  enable_preemption: bool = True,
                  planner: Optional[TokenBudgetPlanner] = None,
                  preemption_policy: Optional[PreemptionPolicy] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 mesh=None):
         if not engine.idle:
             raise ValueError(
                 "ServingScheduler requires a fresh engine: it owns "
                 "admission, and requests already queued or running "
                 "through the engine's FIFO path would bypass priority")
+        if mesh is not None and getattr(engine, "mesh", None) is not mesh:
+            # the scheduler is pure host logic and shards NOTHING
+            # itself — the tensor-parallel data plane lives in the
+            # engine (ISSUE 7). The knob exists so a deployment that
+            # wires the mesh at the scheduler surface fails loudly on a
+            # mismatch instead of silently scheduling a single-chip
+            # engine it believed was sharded.
+            raise ValueError(
+                "ServingScheduler(mesh=...) does not match the "
+                "engine's mesh — pass the mesh to "
+                "ContinuousBatchingEngine(mesh=...); the scheduler's "
+                "host logic is mesh-agnostic (identical plans, "
+                "replicated block tables)")
+        self.mesh = mesh if mesh is not None else getattr(
+            engine, "mesh", None)
         self.engine = engine
         self.planner = planner or TokenBudgetPlanner(
             token_budget, engine.cache.page_size)

@@ -191,3 +191,50 @@ def test_lastgood_fresh_measurement_sheds_stale_carry_label(tmp_path,
     assert out["extra"]["decode_tokens_per_sec"] == 999.0
     assert "decode_source" not in out["extra"]
     assert "decode_recorded_at" not in out["extra"]
+
+
+def test_probe_backend_kill_is_bounded_and_diagnostic(monkeypatch):
+    """Satellite (ISSUE 7): a probe child that outlives its deadline is
+    SIGKILLed with its whole process group — the probe returns within
+    ~deadline + the short drain window instead of wedging the parent
+    past its own watchdog (the rounds-1-5 stale_last_good cause). The
+    child is a deterministic hang (sleep), not a race against jax's
+    real init time."""
+    import time
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_PROBE_CODE",
+                        "import time; time.sleep(60)")
+    t0 = time.monotonic()
+    err = bench.probe_backend(1)
+    assert time.monotonic() - t0 < 10
+    assert err is not None and "SIGKILL" in err
+
+
+def test_quick_capture_rider_and_tp_tier_in_schema():
+    """The quick-capture flag and the tp tier/rider ride the record
+    plumbing: decode_tp_tokens_per_sec is a carried tier and
+    decode_tp_scaling travels with it."""
+    bench = _load_bench()
+    assert "decode_tp_tokens_per_sec" in bench._DECODE_TIERS
+    assert ("decode_tp_tokens_per_sec",
+            "decode_tp_scaling") in bench._DECODE_RIDERS
+
+
+def test_lastgood_carries_tp_rider_with_tier(tmp_path, monkeypatch):
+    """A headline-only rewrite carries the tp tier AND its scaling
+    rider from the prior record (a carried tier without its rider
+    would drop the aggregate-vs-single-chip factor it exists for)."""
+    bench = _load_bench()
+    rec_path = tmp_path / "BENCH_LASTGOOD.json"
+    monkeypatch.setattr(bench, "_LASTGOOD", str(rec_path))
+    seeded = _tpu_parsed()
+    seeded["extra"]["decode_tp_tokens_per_sec"] = 4321.0
+    seeded["extra"]["decode_tp_scaling"] = {"tp": 4,
+                                            "vs_single_chip": 3.4}
+    rec_path.write_text(json.dumps(seeded))
+    bench._record_last_good(_tpu_parsed())
+    out = json.loads(rec_path.read_text())
+    assert out["extra"]["decode_tp_tokens_per_sec"] == 4321.0
+    assert out["extra"]["decode_tp_scaling"]["vs_single_chip"] == 3.4
+    assert out["extra"]["decode_source"][
+        "decode_tp_tokens_per_sec"] == "carried"

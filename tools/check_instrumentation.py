@@ -49,6 +49,11 @@ REQUIRED = {
         # adaptive draft length is judged by — dropping this hook
         # blinds the decode_spec bench tier's acceptance record
         ("_obs.serving_spec_verify(", 1),
+        # tensor-parallel serving (ISSUE 7): per-shard pool gauge every
+        # step + the timed logits-collective probe — the dashboard's
+        # only view of the tp collective bill
+        ("_obs.serving_tp_step(", 1),
+        ("_obs.serving_tp_logits_gather(", 1),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -61,6 +66,11 @@ REQUIRED = {
         ("_obs.generate_begin()", 1),
         ('_obs.generate_phase("prefill"', 1),
         ('_obs.generate_phase("decode"', 1),
+        # tensor-parallel serving (ISSUE 7): every traced all-gather in
+        # the tp decode/prefill/verify programs counts its calls +
+        # per-shard payload bytes (once per compile, like hooks.
+        # collective) — dropping it blinds the tp collective counters
+        ("_obs.serving_tp_allgather(", 1),
     ],
     "paddle_tpu/io/dataloader.py": [
         ("_obs.dataloader_next(", 2),         # single-process + prefetch

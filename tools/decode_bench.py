@@ -15,6 +15,8 @@ Prints one JSON line:
    "decode_sched_step_ms": {"p50_step_ms": ..., "p99_step_ms": ...},
    "decode_spec_tokens_per_sec": ...,
    "decode_spec_acceptance": {"acceptance_rate": ..., ...},
+   "decode_tp_tokens_per_sec": ...,
+   "decode_tp_scaling": {"tp": 4, "vs_single_chip": ...},
    "decode_int8_tokens_per_sec": ..., "decode_int4_tokens_per_sec": ...,
    "decode_w8kv8_tokens_per_sec": ..., "device": ...,
    "ratios_vs_fp": {...}}
@@ -138,6 +140,20 @@ def main():
         out["decode_spec_acceptance"] = acc
         return tps
     run_tier("decode_spec_tokens_per_sec", _spec)
+
+    # tensor-parallel paged serving (ISSUE 7): the mixed-length paged
+    # workload over a tp=4 serving mesh, with the aggregate-vs-single-
+    # chip scaling factor riding the record (needs >= 4 devices — a
+    # single-chip tunnel records the tier null, honestly)
+    def _tp():
+        tps = bench_mod.tp_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        paged = tiers.get("decode_paged_tokens_per_sec")
+        out["decode_tp_scaling"] = {
+            "tp": 4,
+            "vs_single_chip": round(tps / paged, 3) if paged else None}
+        return tps
+    run_tier("decode_tp_tokens_per_sec", _tp)
     int8_p = {}
 
     def _int8():
@@ -153,7 +169,7 @@ def main():
     out.update({k: tiers.get(k) for k in (
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
         "decode_prefix_tokens_per_sec", "decode_sched_tokens_per_sec",
-        "decode_spec_tokens_per_sec",
+        "decode_spec_tokens_per_sec", "decode_tp_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")

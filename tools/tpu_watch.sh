@@ -81,6 +81,7 @@ if dec.get("decode_tokens_per_sec") is not None:
               "decode_prefix_tokens_per_sec",
               "decode_sched_tokens_per_sec",
               "decode_spec_tokens_per_sec",
+              "decode_tp_tokens_per_sec",
               "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
               "decode_w8kv8_tokens_per_sec"):
         if dec.get(k) is None:
@@ -110,7 +111,8 @@ if dec.get("decode_tokens_per_sec") is not None:
     # rider dicts travel with their tier: the scheduler tier's p50/p99
     # step-latency bound (ISSUE 4) and the speculative tier's
     # acceptance rate (ISSUE 5 — the number that explains the tput)
-    for rider in ("decode_sched_step_ms", "decode_spec_acceptance"):
+    for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
+                  "decode_tp_scaling"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
             lg["extra"][rider] = ms
