@@ -516,13 +516,22 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                   ("decode_tp_tokens_per_sec", "decode_tp_scaling"))
 
 
-def _label_decode_source(extra: dict, carried_tiers) -> None:
+def _label_decode_source(extra: dict, carried_tiers,
+                         reason: str = None) -> None:
     """Stamp PER-TIER provenance: ``decode_source`` maps each non-null
     decode tier to ``"live"`` (measured by the run that owns the record)
     or ``"carried"`` (inherited from BENCH_LASTGOOD) — a blanket string
     would misattribute mixed fresh/stale records (ADVICE r5). Only
     written when at least one tier actually carried; absent means every
-    present tier is live."""
+    present tier is live.
+
+    ``reason`` (ISSUE 8 satellite) additionally records WHY each tier
+    carried in ``decode_fallback`` — ``probe_killed`` (the backend
+    probe child died/hung, so nothing could be measured),
+    ``quick_capture`` (the reduced-rep live fallback banked the
+    headline but skipped every decode tier) or ``stale_last_good``
+    (the values are simply inherited from the last good record).
+    Labels already on a tier are respected, same as decode_source."""
     if not carried_tiers:
         return
     # respect labels already on the record (e.g. a _backfill_decode
@@ -533,6 +542,14 @@ def _label_decode_source(extra: dict, carried_tiers) -> None:
     extra["decode_source"] = {
         k: ("carried" if k in carried_tiers else prev.get(k, "live"))
         for k in _DECODE_TIERS if extra.get(k) is not None}
+    if reason:
+        prev_fb = extra.get("decode_fallback")
+        prev_fb = prev_fb if isinstance(prev_fb, dict) else {}
+        extra["decode_fallback"] = {
+            **{k: v for k, v in prev_fb.items()
+               if extra.get(k) is not None},
+            **{k: prev_fb.get(k, reason) for k in carried_tiers
+               if extra.get(k) is not None}}
 
 
 def _backfill_decode(rec: dict) -> dict:
@@ -564,7 +581,14 @@ def _backfill_decode(rec: dict) -> dict:
             rec["extra"]["decode_carried_from"] = (
                 "BENCH_LASTGOOD "
                 f"({lx.get('decode_recorded_at') or lg.get('recorded_at')})")
-            _label_decode_source(rec["extra"], carried)
+            # WHY the tiers carried: a quick-capture child deliberately
+            # skips every decode tier; anything else inherited a
+            # plain stale value
+            reason = ("quick_capture"
+                      if (rec["extra"].get("quick_capture")
+                          or os.environ.get("PADDLE_TPU_BENCH_QUICK"))
+                      else "stale_last_good")
+            _label_decode_source(rec["extra"], carried, reason=reason)
     except Exception:
         pass
     return rec
@@ -966,7 +990,11 @@ def _record_last_good(parsed: dict) -> None:
                             and rec["extra"].get(rider) is None
                             and ox.get(rider) is not None):
                         rec["extra"][rider] = ox[rider]
-                _label_decode_source(rec["extra"], carried)
+                _label_decode_source(
+                    rec["extra"], carried,
+                    reason=("quick_capture"
+                            if rec["extra"].get("quick_capture")
+                            else "stale_last_good"))
         except Exception:
             pass
         rec["recorded_unix"] = time.time()
@@ -1117,6 +1145,21 @@ def parent_main():
                  "rep/batch headline after all full attempts failed")
     except Exception as e:  # noqa: BLE001 — fallback must never mask
         diag.append({"quick_capture": f"{type(e).__name__}: {e}"[:200]})
+    print(json.dumps(_failure_record(last_err, diag)))
+    sys.stdout.flush()
+    os._exit(1)
+
+
+def _failure_record(last_err: str, diag: list) -> dict:
+    """The surrender JSON after every probe/measure/quick attempt
+    failed: the error + diagnostics, plus the last-known-good record
+    marked stale. Each carried decode tier gets a ``decode_fallback``
+    label explaining WHY it rides this round's JSON (ISSUE 8
+    satellite): ``probe_killed`` when a probe child had to be SIGKILLed
+    (the tunnel never even answered — nothing could run), else
+    ``stale_last_good`` (attempts ran and failed; the values are
+    inherited). Factored out of parent_main so the labeling is unit-
+    testable without spawning children."""
     out = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
@@ -1133,12 +1176,23 @@ def parent_main():
             # a capture from the last few hours is this ROUND's own live
             # measurement riding a tunnel window — say so explicitly
             lg["same_round_live_capture"] = age < 6 * 3600
+        # key off the LAST probe outcome: an early SIGKILLed probe
+        # followed by a healthy one (whose measurement then failed)
+        # means attempts DID run — that is stale_last_good, not
+        # probe_killed
+        last_probe = next((d.get("probe_error")
+                           for d in reversed(diag or [])
+                           if "probe_error" in d), None)
+        probe_killed = "SIGKILL" in str(last_probe or "")
+        reason = "probe_killed" if probe_killed else "stale_last_good"
+        fallback = {k: reason for k in _DECODE_TIERS
+                    if lg.get("extra", {}).get(k) is not None}
+        if fallback:
+            out["decode_fallback"] = fallback
         out["stale_last_good"] = lg
     except Exception:
         pass
-    print(json.dumps(out))
-    sys.stdout.flush()
-    os._exit(1)
+    return out
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..observability import hooks as _obs
+from ..serving.resilience import fault_point as _fault_point
 
 
 class PlaceType(enum.Enum):
@@ -839,6 +840,9 @@ class ContinuousBatchingEngine:
         ctx_cap = cache.ctx_cap_pages(cache.pages_for(done)) * page
         chunk = np.zeros((1, width), np.int32)
         chunk[0, :take] = seq[done:done + take]
+        # resilience site: fires before the chunk program so a fault
+        # commits nothing (neither ``done`` nor a sampled token)
+        _fault_point("prefill_chunk")
         t0 = _obs.generate_begin()
         logits, cache.pool = self._chunk_fn(ctx_cap, width)(
             self.params, jnp.asarray(chunk), cache.pool,
@@ -936,12 +940,18 @@ class ContinuousBatchingEngine:
         mask = np.asarray(mask, bool)
         if not mask.any():
             return 0
+        # resilience sites: step execution, then the device->host fetch
+        # — host state (lengths/tokens) commits only after both, so a
+        # fault at either leaves the request handles at the previous
+        # step's committed state (the supervisor's recovery contract)
+        _fault_point("decode_step")
         self._key, k = jax.random.split(self._key)
         nxt, cache.pool = self._decode()(
             self.params, jnp.asarray(self._last), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths),
             jnp.asarray(mask), k)
+        _fault_point("transfer")
         nxt = np.asarray(nxt)
         n_active = int(mask.sum())
         for slot, req in enumerate(self._slots):
@@ -1031,11 +1041,13 @@ class ContinuousBatchingEngine:
         # rows always hold >= 1 prefilled token, so the cap is > 0)
         ctx_cap = cache.ctx_cap_pages(cache.pages_for(
             int(cache.lengths[mask].max()))) * cache.page_size
+        _fault_point("verify_step")
         t0 = _obs.generate_begin()
         out, cache.pool = self._spec_fn(ctx_cap, T)(
             self.params, jnp.asarray(chunk), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths), jnp.asarray(mask))
+        _fault_point("transfer")
         out = np.asarray(out)              # (B, T) greedy targets
         t1 = time.perf_counter_ns()        # device fence: verify done
         from ..serving.speculative import longest_accepted_prefix

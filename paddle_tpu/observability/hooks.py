@@ -410,6 +410,129 @@ def serving_sched_step(queue_depths, scheduled_tokens: int, budget):
                  "scheduled").set(scheduled_tokens / budget)
 
 
+def serving_fault(site: str, kind: str, injected: bool):
+    """One serving fault, classified by hot-path site
+    (:data:`paddle_tpu.serving.resilience.SITES`) and kind (the
+    injector's mode, or the caught exception's class name). Injected
+    faults (the deterministic :class:`FaultInjector`) and real ones
+    keep SEPARATE counters — a chaos soak must be able to prove its
+    faults were all its own."""
+    if not enabled:
+        return
+    if injected:
+        _m.counter("serving_fault_injected_total",
+                   "faults fired by the deterministic fault injector",
+                   ("site", "kind")).labels(site, kind).inc()
+    else:
+        _m.counter("serving_fault_failures_total",
+                   "real (non-injected) serving step failures the "
+                   "supervisor caught", ("site", "kind")
+                   ).labels(site, kind).inc()
+
+
+def serving_fault_recovery(t0_ns: int, sessions: int,
+                           replay_tokens: int):
+    """Close one supervisor recovery opened at ``t0_ns`` (a
+    :func:`generate_begin` anchor): teardown + pool rebuild + journal
+    restore. ``replay_tokens`` is the continuation-prefill bill the
+    restored sessions will pay (prompt + committed tokens minus one,
+    per admitted session) — the recovery-cost model's x-axis
+    (PERF_NOTES: recovery time ∝ resident tokens)."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.fault_recovery", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_fault_recovery_ms",
+                 "wall milliseconds per engine teardown+rebuild+restore",
+                 buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                          2500, 5000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_fault_recoveries_total",
+               "engine teardown+rebuild recoveries").inc()
+    _m.counter("serving_fault_restored_sessions_total",
+               "in-flight sessions restored through the resume replay"
+               ).inc(sessions)
+    _m.counter("serving_fault_replay_tokens_total",
+               "tokens scheduled for re-prefill by crash recoveries"
+               ).inc(replay_tokens)
+
+
+def serving_degraded(level: int):
+    """The supervisor's degraded-mode rung (0 = healthy, 1 = spec
+    decode off, 2 = one-page prefill chunks, 3 = LOW admissions shed;
+    one past the ladder = circuit open / dead) — the replica-health
+    gauge a multi-engine router steers by."""
+    if not enabled:
+        return
+    _m.gauge("serving_degraded_mode",
+             "degraded-mode ladder rung of the engine supervisor "
+             "(0 healthy .. 3 shed_low; 4 = circuit open)"
+             ).set(level)
+
+
+def serving_journal(entries: int, tokens: int):
+    """Write-ahead request-journal size after a committed step: live
+    entries and their resident tokens (prompt + committed) — the
+    recovery bill if the engine died right now."""
+    if not enabled:
+        return
+    _m.gauge("serving_fault_journal_entries",
+             "live requests in the supervisor's write-ahead journal"
+             ).set(entries)
+    _m.gauge("serving_fault_journal_tokens",
+             "resident tokens (prompt + committed) the journal would "
+             "replay on a crash").set(tokens)
+
+
+def serving_drain_checkpoint(t0_ns: int, nbytes: int, sessions: int,
+                             trie_pages: int):
+    """Close one engine drain opened at ``t0_ns``: checkpoint latency
+    histogram + size gauges (bytes on disk, sessions checkpointed,
+    prefix-trie pages persisted)."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.drain_checkpoint", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_drain_checkpoint_ms",
+                 "wall milliseconds per drain checkpoint write",
+                 buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                          2500, 5000)).observe((now - t0_ns) / 1e6)
+    _m.gauge("serving_drain_checkpoint_bytes",
+             "size of the last drain checkpoint on disk").set(nbytes)
+    _m.counter("serving_drain_sessions_total",
+               "in-flight sessions checkpointed by drains"
+               ).inc(sessions)
+    _m.counter("serving_drain_trie_pages_total",
+               "prefix-trie pages persisted by drains").inc(trie_pages)
+
+
+def serving_drain_restore(t0_ns: int, nbytes: int, sessions: int,
+                          trie_pages: int):
+    """Close one drain-checkpoint restore opened at ``t0_ns``: restore
+    latency histogram + size gauges (the other half of the
+    ``serving_drain_*`` pair — restarts are observable end to end)."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.drain_restore", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_drain_restore_ms",
+                 "wall milliseconds per drain-checkpoint restore",
+                 buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                          2500, 5000)).observe((now - t0_ns) / 1e6)
+    _m.gauge("serving_drain_restore_bytes",
+             "size of the last restored drain checkpoint").set(nbytes)
+    _m.counter("serving_drain_restored_sessions_total",
+               "sessions restored from drain checkpoints").inc(sessions)
+    _m.counter("serving_drain_restored_trie_pages_total",
+               "prefix-trie pages restored from drain checkpoints"
+               ).inc(trie_pages)
+
+
 def serving_step(active: int, max_slots: int, pages_used: int,
                  pages_total: int):
     """One continuous-batching decode step: batch-occupancy histogram +

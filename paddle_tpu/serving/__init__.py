@@ -15,6 +15,13 @@ SLO-aware scheduler.
   acceptance-rate EMA + adaptive draft length) and the greedy
   :func:`longest_accepted_prefix` acceptance rule for the engine's
   batched-verify ``spec_step``.
+- :mod:`paddle_tpu.serving.resilience` — fault-tolerant serving:
+  :class:`FaultInjector` (deterministic seeded fault injection at the
+  named hot-path :data:`~paddle_tpu.serving.resilience.SITES`),
+  :class:`EngineSupervisor` (write-ahead :class:`RequestJournal`,
+  token-identical crash recovery via the resume replay, circuit
+  breaker + degraded-mode ladder, drain/restore with prefix-trie
+  persistence).
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -27,6 +34,11 @@ from .paged_cache import (  # noqa: F401
 from .policy import (  # noqa: F401
     FinishReason, PreemptionPolicy, Priority, StepPlan,
     TokenBudgetPlanner,
+)
+from .resilience import (  # noqa: F401
+    DEGRADED_MODES, SITES, CorruptionDetected, EngineDead,
+    EngineSupervisor, FaultInjector, InjectedFault, RequestJournal,
+    StepStalled, fault_point,
 )
 from .scheduler import ServingScheduler  # noqa: F401
 from .speculative import (  # noqa: F401

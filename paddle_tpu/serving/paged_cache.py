@@ -36,6 +36,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .resilience import fault_point
+
 #: page id never handed out by the allocator — the write target for
 #: inactive rows of the static-shape decode program
 TRASH_PAGE = 0
@@ -139,6 +141,11 @@ class BlockAllocator:
         list is short."""
         if n < 0:
             raise ValueError(f"alloc of negative page count {n}")
+        # resilience injection site: fires BEFORE any free-list
+        # mutation, so an injected allocator fault leaves the
+        # allocator's books consistent (the supervisor discards the
+        # whole pool on recovery regardless)
+        fault_point("alloc")
         if n > len(self._free):
             self.alloc_failures += 1
             raise PoolExhausted(
@@ -171,6 +178,7 @@ class BlockAllocator:
         a page holds (including duplicates within one call) is a loud
         ``double free`` — the whole call is validated before any state
         changes."""
+        fault_point("free")
         drops: Dict[int, int] = {}
         for p in pages:
             if not (self.reserved <= p < self.num_pages):
@@ -376,6 +384,52 @@ class PrefixCache:
                 out.append(node.tail[0])
             stack.extend(node.children.values())
         return out
+
+    def to_records(self) -> Dict:
+        """Serialize the trie STRUCTURE for a drain checkpoint
+        (ISSUE 8): ``nodes`` is a parent-before-child list of
+        ``[parent_index, page_tokens, page_id]`` (parent ``-1`` = the
+        root), ``tails`` a list of ``[node_index, tail_tokens,
+        page_id]`` (node ``-1`` = a root tail). Page ids are the OLD
+        pool's — :meth:`restore_records` remaps them into the restored
+        pool. Pure host data, JSON-able."""
+        nodes: List[list] = []
+        tails: List[list] = []
+        stack = [(self.root, -1)]
+        while stack:
+            node, idx = stack.pop()
+            if node.tail is not None:
+                tails.append([idx, node.tail[1].tolist(),
+                              int(node.tail[0])])
+            for key, child in node.children.items():
+                nodes.append([idx,
+                              np.frombuffer(key, np.int32).tolist(),
+                              int(child.page)])
+                stack.append((child, len(nodes) - 1))
+        return {"nodes": nodes, "tails": tails}
+
+    def restore_records(self, records: Dict, page_map: Dict[int, int],
+                        allocator: BlockAllocator):
+        """Rebuild the trie from :meth:`to_records` output under
+        remapped page ids, taking ONE allocator reference per restored
+        page reference (the same ownership contract
+        :meth:`register` establishes). Restores into an EMPTY trie
+        only — merging two tries would double-count references."""
+        if self.root.children or self.root.tail is not None:
+            raise ValueError("restore_records: the trie is not empty")
+        built: List[_TrieNode] = []
+        for parent, tokens, page in records["nodes"]:
+            node = _TrieNode(page=page_map[int(page)])
+            allocator.share([node.page])
+            owner = self.root if parent < 0 else built[parent]
+            owner.children[
+                np.asarray(tokens, np.int32).tobytes()] = node
+            built.append(node)
+        for idx, tokens, page in records["tails"]:
+            owner = self.root if idx < 0 else built[idx]
+            owner.tail = (page_map[int(page)],
+                          np.asarray(tokens, np.int32))
+            allocator.share([owner.tail[0]])
 
     def remap_pages(self, remap: np.ndarray):
         """Rewrite held page ids after a defrag compaction."""
@@ -655,6 +709,62 @@ class PagedKVCache:
         total = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                     for a in self.pool.values())
         return total // (self.tp or 1)
+
+    # ---- drain/restore (ISSUE 8): prefix-trie persistence ----
+    def checkpoint_prefix(self) -> Optional[Dict]:
+        """Checkpoint the prefix-cache trie for an engine drain: the
+        trie structure (:meth:`PrefixCache.to_records`) plus the KV
+        BYTES of every page the trie references, gathered from the
+        device pools — the part of the pool worth persisting across a
+        restart (in-flight sessions replay from the journal instead;
+        their pages are recomputed). Returns None when the prefix
+        cache is disabled or empty."""
+        if self.prefix is None:
+            return None
+        ids = sorted(set(self.prefix.pages()))
+        if not ids:
+            return None
+        sel = np.asarray(ids, np.int32)
+        arrays = {name: np.asarray(arr[:, sel])
+                  for name, arr in self.pool.items()}
+        return {"page_ids": [int(p) for p in ids],
+                "records": self.prefix.to_records(),
+                "arrays": arrays}
+
+    def restore_prefix(self, ckpt: Dict) -> int:
+        """Restore a :meth:`checkpoint_prefix` into THIS (fresh)
+        cache: allocate pages, write the saved KV bytes into the new
+        pool at the remapped ids (one jitted donated scatter — the
+        pool is not re-materialized eagerly), and rebuild the trie so
+        future admissions prefix-HIT the restored pages. The bootstrap
+        allocation references are dropped once the trie holds its own
+        (alloc/free symmetry: the trie ends up owning exactly one
+        reference per page, as :meth:`register_prefix` would leave
+        it). Returns the number of pages restored."""
+        if self.prefix is None:
+            raise ValueError(
+                "restore_prefix into a cache with prefix caching "
+                "disabled (enable_prefix_cache=False)")
+        import jax
+        import jax.numpy as jnp
+        old_ids = [int(p) for p in ckpt["page_ids"]]
+        fresh = self.allocator.alloc(len(old_ids))
+        page_map = dict(zip(old_ids, fresh))
+
+        def write(pool, vals, dst):
+            return {name: arr.at[:, dst].set(
+                jnp.asarray(vals[name]).astype(arr.dtype))
+                for name, arr in pool.items()}
+
+        self.pool = jax.jit(write, donate_argnums=(0,))(
+            self.pool,
+            {n: np.ascontiguousarray(a)
+             for n, a in ckpt["arrays"].items()},
+            jnp.asarray(np.asarray(fresh, np.int32)))
+        self.prefix.restore_records(ckpt["records"], page_map,
+                                    self.allocator)
+        self.allocator.free(fresh)      # the trie owns the pages now
+        return len(fresh)
 
     def defrag(self):
         """Compact used pages to the front of the pool: one device

@@ -45,6 +45,7 @@ class FinishReason(str, enum.Enum):
     MAX_LEN = "max_len"                       # exhausted max_new_tokens
     PREEMPTED = "preempted"                   # transient: evicted, will resume
     DEADLINE_EXCEEDED = "deadline_exceeded"   # cancelled before admission
+    REJECTED_OVERLOAD = "rejected_overload"   # shed by a degraded supervisor
 
     def __str__(self) -> str:  # pragma: no cover - cosmetic
         return self.value

@@ -1,0 +1,876 @@
+"""Fault-tolerant serving: fault injection, supervised recovery, and
+drain/restore over the continuous-batching engine (ISSUE 8).
+
+The PR 2–7 serving stack assumes every device step succeeds: one raised
+exception, stalled transfer, or poisoned compile kills the engine and
+every in-flight session with it. This module closes that gap with three
+pieces, all HOST-side (no new device programs):
+
+- :class:`FaultInjector` — a deterministic, seeded injector with NAMED
+  sites threaded through the hot path (:data:`SITES`: allocator
+  alloc/free, decode / prefill-chunk / verify step execution,
+  device→host transfer, scheduler tick). Each firing can ``raise``,
+  ``stall`` past a watchdog deadline, or model a detected-corruption
+  (``corrupt``: the payload never commits — the checksum caught it).
+  Hot paths call :func:`fault_point`; when no injector is installed the
+  cost is one module-attribute read.
+
+- :class:`EngineSupervisor` — wraps a fresh
+  :class:`~paddle_tpu.inference.ContinuousBatchingEngine` (built by an
+  ``engine_factory`` so it can be rebuilt from scratch) behind a
+  :class:`~paddle_tpu.serving.ServingScheduler`, keeping a host-side
+  write-ahead :class:`RequestJournal`: admission params are journaled at
+  submit time (before anything executes) and every committed token after
+  each successful step. On a failed — or watchdog-stalled — step the
+  supervisor tears the poisoned engine down, rebuilds pools from
+  scratch, and restores every in-flight session through the PR 4
+  ``resume_sequence`` replay path, so recovery is TOKEN-IDENTICAL to an
+  uninterrupted run at fp and int8-KV, including under tp sharding
+  (gated in tests/test_resilience.py). Between "healthy" and "dead" sit
+  bounded exponential-backoff retries, a circuit breaker on repeated
+  failures, and a pressure-ordered DEGRADED-MODE ladder
+  (:data:`DEGRADED_MODES`: disable spec decode → shrink the prefill
+  chunk → shed LOW-priority admissions with a structured
+  ``rejected_overload`` finish reason), published to the PR 1 metrics
+  registry as the ``serving_degraded_mode`` gauge (the future router's
+  replica-health signal).
+
+- **drain/restore** — :meth:`EngineSupervisor.drain` stops admissions
+  and checkpoints every in-flight session (journal records) PLUS the
+  prefix-cache trie — structure AND page KV bytes
+  (:meth:`~paddle_tpu.serving.PagedKVCache.checkpoint_prefix`) — to one
+  ``.npz`` file; :meth:`EngineSupervisor.restore` rebuilds a fresh
+  engine, writes the trie pages back into the new pool, and requeues
+  the sessions — so shared system prompts survive restarts as prefix
+  HITS (ROADMAP item 4's persistence ask) and interrupted decodes
+  finish token-identically.
+
+Recovery cost model: the journal replays ``prompt + tokens[:-1]``
+through the continuation-prefill program — exactly the PR 4 resume
+cost — so recovery time is proportional to RESIDENT tokens, not to the
+wall-clock already served (PERF_NOTES "Fault-tolerant serving").
+
+Determinism note: greedy decode (``temperature == 0``) is bit-identical
+across recovery by construction (replay never re-samples). For sampled
+decode the supervisor snapshots the engine's PRNG key at each step
+commit, so the stream also survives recovery at STEP granularity; a
+fault after an intra-step key split replays with the committed
+snapshot (the failed attempt's split is discarded with the engine).
+
+Stall caveat: a watchdog-stalled step's thread is abandoned with the
+poisoned engine (its slot table is cleared as a best-effort fence). An
+injected ``stall`` always raises when it wakes — it never commits. A
+REAL stalled device program that later completes could still race a
+token append; the journal is authoritative (recovery resets every
+request to its journaled tokens), which bounds the damage to a
+transiently wrong ``req.tokens`` tail on an already-poisoned handle.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..observability import hooks as _obs
+from .policy import FinishReason, Priority
+
+#: the named injection sites threaded through the serving hot path —
+#: tools/check_instrumentation.py enforces that every name here has a
+#: matching ``fault_point("<site>")`` call site (and therefore a
+#: matching ``site=`` label on the serving_fault_* counters)
+SITES = ("alloc", "free", "decode_step", "prefill_chunk",
+         "verify_step", "transfer", "sched_tick")
+
+#: the pressure-ordered degraded-mode ladder (index == level): each
+#: recovery escalates one rung, sustained healthy steps climb back down
+DEGRADED_MODES = ("healthy", "no_spec", "small_chunks", "shed_low")
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the :class:`FaultInjector` (``site`` / ``mode``
+    carry the classification through to the supervisor's counters)."""
+
+    def __init__(self, site: str, mode: str = "raise", detail: str = ""):
+        self.site = site
+        self.mode = mode
+        super().__init__(
+            f"injected {mode} fault at site {site!r}"
+            + (f": {detail}" if detail else ""))
+
+
+class CorruptionDetected(InjectedFault):
+    """The corrupt-and-detect mode: models a device→host payload whose
+    checksum failed verification — the corrupted bytes are NEVER
+    committed to host state (detection precedes the commit), so the
+    supervisor recovers exactly as for a raised fault."""
+
+    def __init__(self, site: str):
+        super().__init__(site, "corrupt",
+                         "checksum mismatch on fetched payload; "
+                         "data discarded before commit")
+
+
+class StepStalled(RuntimeError):
+    """The supervisor's watchdog gave up on a step that exceeded its
+    deadline (a hung transfer / wedged device program)."""
+
+    def __init__(self, seconds: float):
+        self.site = "watchdog"
+        self.mode = "stall"
+        super().__init__(f"engine step exceeded the {seconds:.3f}s "
+                         f"watchdog deadline")
+
+
+class EngineDead(RuntimeError):
+    """The circuit breaker opened: repeated step failures exhausted the
+    recovery budget and the supervisor will not retry further."""
+
+
+#: the installed injector — hot paths read this ONE module attribute;
+#: None (the default) costs nothing beyond the read
+_ACTIVE: Optional["FaultInjector"] = None
+
+
+def fault_point(site: str) -> None:
+    """Hot-path injection site: no-op unless a :class:`FaultInjector`
+    is installed (:func:`install` / ``with injector:``)."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.fire(site)
+
+
+def install(injector: Optional["FaultInjector"]) -> None:
+    """Install ``injector`` globally (``None`` uninstalls)."""
+    global _ACTIVE
+    _ACTIVE = injector
+
+
+def uninstall() -> None:
+    install(None)
+
+
+class FaultInjector:
+    """Deterministic, seeded fault source for the named serving sites.
+
+    Two firing styles compose:
+
+    - **armed** (on demand): :meth:`arm` schedules a fault on the n-th
+      FUTURE call at a site — the unit tests' way of killing the engine
+      at an exact point (e.g. mid-decode, during a spec-verify step).
+    - **rate** (chaos): every :func:`fault_point` call at an enabled
+      site draws from a seeded RNG; at most ``max_faults`` total fire.
+      Same seed + same call sequence => same faults, every run.
+
+    ``modes`` picks what a rate-fired fault does: ``"raise"`` (raise
+    :class:`InjectedFault`), ``"stall"`` (sleep ``stall_s`` — past the
+    supervisor's watchdog deadline — then raise, so a stalled site never
+    commits), ``"corrupt"`` (raise :class:`CorruptionDetected`,
+    modeling a checksum catching a corrupted transfer before commit).
+
+    Every firing is counted per site (``fired``), logged
+    (``log``: ``(site, mode, call_index)``) and emitted to the
+    ``serving_fault_injected_total{site,mode}`` counter.
+    """
+
+    def __init__(self, seed: int = 0, rate: float = 0.0,
+                 sites: Optional[List[str]] = None,
+                 modes=("raise",), stall_s: float = 0.1,
+                 max_faults: Optional[int] = None):
+        bad = set(sites or ()) - set(SITES)
+        if bad:
+            raise ValueError(
+                f"FaultInjector: unknown site(s) {sorted(bad)}; "
+                f"valid sites: {SITES}")
+        bad = set(modes) - {"raise", "stall", "corrupt"}
+        if bad:
+            raise ValueError(f"FaultInjector: unknown mode(s) "
+                             f"{sorted(bad)}")
+        self.rate = float(rate)
+        self.sites = tuple(sites) if sites is not None else SITES
+        self.modes = tuple(modes)
+        self.stall_s = float(stall_s)
+        self.max_faults = max_faults
+        self._rng = np.random.RandomState(seed)
+        self.calls: Dict[str, int] = {s: 0 for s in SITES}
+        self.fired: Dict[str, int] = {s: 0 for s in SITES}
+        self.fired_total = 0
+        self.log: List[tuple] = []
+        self._armed: Dict[str, List[tuple]] = {}
+        # stalls in flight, not yet attributed by a supervisor: the
+        # watchdog only ever sees a StepStalled, so the supervisor asks
+        # the installed injector whether the stall was its own (keeps
+        # the injected-vs-real counter split exact under chaos)
+        self.pending_stalls: List[str] = []
+
+    def arm(self, site: str, mode: str = "raise", nth: int = 1) -> None:
+        """Schedule one fault on the ``nth`` future call at ``site``
+        (1 = the very next call). Armed faults fire regardless of
+        ``rate``/``max_faults`` — they are the on-demand kill switch."""
+        if site not in SITES:
+            raise ValueError(f"arm: unknown site {site!r}")
+        self._armed.setdefault(site, []).append(
+            (self.calls[site] + int(nth), mode))
+
+    def fire(self, site: str) -> None:
+        """One hot-path visit to ``site``: decide (armed schedule, then
+        seeded rate) and inject. Raises on injection; returns silently
+        otherwise."""
+        self.calls[site] = n = self.calls[site] + 1
+        mode = None
+        armed = self._armed.get(site)
+        if armed:
+            for i, (target, m) in enumerate(armed):
+                if n >= target:
+                    mode = m
+                    del armed[i]
+                    break
+        if (mode is None and self.rate > 0.0 and site in self.sites
+                and (self.max_faults is None
+                     or self.fired_total < self.max_faults)
+                and self._rng.random_sample() < self.rate):
+            mode = self.modes[self._rng.randint(len(self.modes))]
+        if mode is None:
+            return
+        self.fired[site] += 1
+        self.fired_total += 1
+        self.log.append((site, mode, n))
+        _obs.serving_fault(site, mode, injected=True)
+        if mode == "stall":
+            # sleep past the supervisor's watchdog, then raise — the
+            # stalled site never commits, so the abandoned step thread
+            # cannot race the recovery that replaced it. Registered
+            # BEFORE the sleep: the watchdog fires mid-sleep and the
+            # supervisor attributes the StepStalled to this injection
+            self.pending_stalls.append(site)
+            time.sleep(self.stall_s)
+            raise InjectedFault(site, "stall",
+                                f"stalled {self.stall_s}s past deadline")
+        if mode == "corrupt":
+            raise CorruptionDetected(site)
+        raise InjectedFault(site)
+
+    def stats(self) -> Dict:
+        return {"fired_total": self.fired_total,
+                "fired": {s: n for s, n in self.fired.items() if n},
+                "calls": {s: n for s, n in self.calls.items() if n}}
+
+    # installable as a context manager: ``with injector: ...``
+    def __enter__(self) -> "FaultInjector":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+class JournalEntry:
+    """One request's journaled state (the supervisor's recovery unit)."""
+    __slots__ = ("req", "rid", "prompt", "max_new_tokens",
+                 "eos_token_id", "priority", "deadline_at",
+                 "submitted_at", "tokens", "admitted", "preemptions")
+
+    def __init__(self, req):
+        self.req = req
+        self.rid = req.rid
+        self.prompt = req.prompt[0].copy()
+        self.max_new_tokens = req.max_new_tokens
+        self.eos_token_id = req.eos_token_id
+        self.priority = int(req.priority)
+        self.deadline_at = req.deadline_at
+        self.submitted_at = req.submitted_at
+        self.tokens: List[int] = list(req.tokens)
+        self.admitted = False
+        self.preemptions = int(req.preemptions)
+
+    def as_record(self, now: Optional[float] = None) -> Dict:
+        """JSON-able checkpoint record (drain/restore). Deadlines are
+        serialized as REMAINING seconds against ``now`` (the draining
+        supervisor's clock), never as absolute monotonic stamps — a
+        monotonic value from the draining host is meaningless on the
+        restoring one (different boot epoch), and would either freeze
+        the SLO for days or expire still-valid requests instantly.
+        Restore re-anchors against its own clock."""
+        remaining = None
+        if self.deadline_at is not None and now is not None:
+            remaining = self.deadline_at - now
+        return {"rid": self.rid, "prompt": self.prompt.tolist(),
+                "max_new_tokens": self.max_new_tokens,
+                "eos_token_id": self.eos_token_id,
+                "priority": self.priority,
+                "deadline_remaining_s": remaining,
+                "tokens": list(self.tokens),
+                "admitted": self.admitted,
+                "preemptions": self.preemptions}
+
+
+class RequestJournal:
+    """Host-side write-ahead journal of every live request.
+
+    Admission params are recorded at SUBMIT time — before any device
+    work — and committed tokens are copied in at each successful step
+    (:meth:`sync`). The journal, not the engine, is the source of truth
+    at recovery: a poisoned engine is discarded wholesale and every
+    live request is reset to its journaled state, which is exactly the
+    host state as of the last committed step (a failed step committed
+    nothing — device results only reach ``req.tokens`` after the
+    transfer that would have raised)."""
+
+    def __init__(self):
+        self._entries: Dict[int, JournalEntry] = {}
+        self.finished_total = 0
+
+    def record_submit(self, req) -> JournalEntry:
+        e = JournalEntry(req)
+        self._entries[req.rid] = e
+        return e
+
+    def adopt(self, req, rec: Dict) -> JournalEntry:
+        """Re-journal a request rebuilt from a drain checkpoint."""
+        e = JournalEntry(req)
+        e.admitted = bool(rec.get("admitted"))
+        self._entries[req.rid] = e
+        return e
+
+    def sync(self) -> None:
+        """Copy committed host state from the live request handles;
+        finished requests leave the journal (their results live on the
+        caller's handle — nothing to recover)."""
+        for rid in list(self._entries):
+            e = self._entries[rid]
+            req = e.req
+            if len(e.tokens) != len(req.tokens):
+                e.tokens = list(req.tokens)
+            e.preemptions = int(req.preemptions)
+            if (req.slot is not None or req.tokens
+                    or req.preemptions > 0):
+                e.admitted = True
+            if req.done:
+                self.finished_total += 1
+                del self._entries[rid]
+
+    def live_entries(self) -> List[JournalEntry]:
+        return [self._entries[r] for r in sorted(self._entries)]
+
+    @property
+    def size(self) -> int:
+        return len(self._entries)
+
+    @property
+    def token_count(self) -> int:
+        return sum(e.prompt.size + len(e.tokens)
+                   for e in self._entries.values())
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a checkpointed dtype name, including the ml_dtypes
+    extension types (bfloat16 & friends) numpy can't look up by
+    string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class EngineSupervisor:
+    """Crash-recovering wrapper around engine + scheduler.
+
+    ``engine_factory() -> ContinuousBatchingEngine`` must build a FRESH
+    engine with an identical configuration each call — the supervisor
+    invokes it at construction and after every teardown ("rebuild pools
+    from scratch"). Compiled step programs are carried across rebuilds
+    (they are pure functions of their array arguments; only the pools
+    and host bookkeeping are poisoned), so a recovery costs journal
+    replay, not recompilation.
+
+    Lifecycle knobs:
+
+    - ``watchdog_s``: run each step on a watchdog thread and declare
+      :class:`StepStalled` past the deadline (None = no watchdog; a
+      genuinely hung step then blocks forever, as before).
+    - ``backoff_s`` / ``backoff_max_s``: exponential backoff slept
+      between consecutive failures (injectable ``sleep`` for tests).
+    - ``circuit_threshold``: consecutive failed step attempts (no
+      successful step in between) before the breaker opens — the
+      supervisor marks every live request ``engine_dead``, reports
+      ``health == "dead"`` and raises :class:`EngineDead`.
+    - ``recover_after``: consecutive successful steps per rung of
+      degraded-ladder descent.
+
+    Degraded ladder (:data:`DEGRADED_MODES`): every recovery escalates
+    one rung — 1: speculative decoding off (the most failure-adjacent
+    optional program); 2: prefill chunk shrunk to one page (smallest
+    step granularity, fastest fault isolation); 3: LOW-priority
+    admissions shed at submit with the structured ``rejected_overload``
+    finish reason. The current rung is published to the metrics
+    registry (``serving_degraded_mode``) — the signal ROADMAP item 2's
+    router will steer replicas by.
+    """
+
+    def __init__(self, engine_factory: Callable, *,
+                 token_budget: Optional[int] = None,
+                 watchdog_s: Optional[float] = None,
+                 backoff_s: float = 0.05, backoff_max_s: float = 2.0,
+                 circuit_threshold: int = 5, recover_after: int = 32,
+                 reuse_compiled: bool = True,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 scheduler_kw: Optional[Dict] = None):
+        self._factory = engine_factory
+        self.token_budget = token_budget
+        self.watchdog_s = watchdog_s
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.circuit_threshold = int(circuit_threshold)
+        self.recover_after = int(recover_after)
+        self.reuse_compiled = reuse_compiled
+        self.clock = clock
+        self._sleep = sleep
+        self._sched_kw = dict(scheduler_kw or {})
+        self.journal = RequestJournal()
+        self.degraded_level = 0
+        self.recoveries = 0
+        self.injected_faults = 0
+        self.real_faults = 0
+        self.shed_total = 0
+        self.steps_total = 0
+        self._consec_failures = 0
+        self._successes_since_change = 0
+        self._next_rid = 0
+        self._key_data: Optional[np.ndarray] = None
+        self._spec_shelf = None
+        self._chunk_shelf = None
+        self._chunk_shrunk = False
+        self._dead = False
+        self._draining = False
+        self.engine = None
+        self.scheduler = None
+        self.restored: Dict[int, object] = {}
+        self._build()
+        self._snapshot_key()
+
+    # ---- health ----
+    @property
+    def health(self) -> str:
+        if self._dead:
+            return "dead"
+        return "healthy" if self.degraded_level == 0 else "degraded"
+
+    @property
+    def degraded_mode(self) -> str:
+        return DEGRADED_MODES[self.degraded_level]
+
+    def _check_alive(self):
+        if self._dead:
+            raise EngineDead(
+                "circuit breaker open after "
+                f"{self.circuit_threshold} consecutive step failures")
+        if self._draining:
+            raise RuntimeError(
+                "EngineSupervisor was drained; restore the checkpoint "
+                "into a fresh supervisor (EngineSupervisor.restore)")
+
+    # ---- build / teardown ----
+    def _build(self):
+        """(Re)build the engine + scheduler pair from scratch. Pools,
+        allocator, trie, slots all start empty; compiled step programs
+        carry over from the previous engine when configurations match
+        (pure functions of their array arguments — only state was
+        poisoned, not code)."""
+        from .scheduler import ServingScheduler
+        old = self.engine
+        eng = self._factory()
+        if not eng.idle:
+            raise ValueError(
+                "engine_factory must return a FRESH engine (no queued "
+                "or running requests)")
+        eng._next_rid = max(eng._next_rid, self._next_rid)
+        if (old is not None and self.reuse_compiled
+                and old.temperature == eng.temperature
+                and old.use_kernel == eng.use_kernel
+                and old._tp == eng._tp):
+            eng._decode_fn = old._decode_fn
+            eng._chunk_fns = old._chunk_fns
+            eng._spec_fns = old._spec_fns
+            eng.cache._cow_fn = old.cache._cow_fn
+        if self._key_data is not None:
+            import jax
+            import jax.numpy as jnp
+            eng._key = jax.random.wrap_key_data(
+                jnp.asarray(self._key_data))
+        self.engine = eng
+        self.scheduler = ServingScheduler(
+            eng, token_budget=self.token_budget, clock=self.clock,
+            **self._sched_kw)
+        self._apply_degraded()
+
+    def _fence(self, old):
+        """Best-effort fence on the poisoned engine: an abandoned
+        (stalled) step thread that wakes later finds empty slot/pending
+        tables and commits nothing. Injected stalls never commit anyway
+        (they raise on wake); this narrows the window for real ones."""
+        if old is None:
+            return
+        old._slots = [None] * old.max_batch
+        old._pending = {}
+        old._queue = []
+
+    def _snapshot_key(self):
+        import jax
+        self._key_data = np.asarray(jax.random.key_data(self.engine._key))
+
+    # ---- degraded ladder ----
+    def _apply_degraded(self):
+        """Impose the current rung on the live engine (called on every
+        rebuild and escalation; shelves keep what descent restores)."""
+        eng = self.engine
+        if self.degraded_level >= 1:
+            if eng.spec is not None:
+                self._spec_shelf = eng.spec
+                eng.spec = None
+        elif eng.spec is None and self._spec_shelf is not None:
+            eng.spec = self._spec_shelf
+            self._spec_shelf = None
+        if self.degraded_level >= 2:
+            if not self._chunk_shrunk:
+                self._chunk_shelf = eng.prefill_chunk
+                self._chunk_shrunk = True
+            eng.prefill_chunk = eng.cache.page_size
+        elif self._chunk_shrunk:
+            eng.prefill_chunk = self._chunk_shelf
+            self._chunk_shrunk = False
+        _obs.serving_degraded(self.degraded_level)
+
+    def _escalate(self):
+        if self.degraded_level < len(DEGRADED_MODES) - 1:
+            self.degraded_level += 1
+        self._successes_since_change = 0
+        self._apply_degraded()
+
+    def _deescalate_maybe(self):
+        if self.degraded_level == 0:
+            return
+        self._successes_since_change += 1
+        if self._successes_since_change >= self.recover_after:
+            self.degraded_level -= 1
+            self._successes_since_change = 0
+            self._apply_degraded()
+
+    # ---- intake ----
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               priority=Priority.NORMAL,
+               deadline_s: Optional[float] = None, eos_token_id=None):
+        """Journaled submit (write-ahead: the admission params are on
+        the journal before anything can execute). At degraded level 3
+        (``shed_low``) LOW-priority requests are rejected immediately
+        with the structured ``rejected_overload`` finish reason instead
+        of queueing into an engine that keeps failing."""
+        self._check_alive()
+        if (self.degraded_level >= 3
+                and int(priority) >= int(Priority.LOW)):
+            req = self.engine.create_request(
+                prompt, max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id)
+            req.priority = int(priority)
+            req.done = True
+            req.finish_reason = FinishReason.REJECTED_OVERLOAD.value
+            self.shed_total += 1
+            self._next_rid = self.engine._next_rid
+            _obs.serving_cancelled(1, req.finish_reason)
+            return req
+        req = self.scheduler.submit(
+            prompt, max_new_tokens=max_new_tokens, priority=priority,
+            deadline_s=deadline_s, eos_token_id=eos_token_id)
+        self._next_rid = self.engine._next_rid
+        self.journal.record_submit(req)
+        return req
+
+    # ---- stepping ----
+    def _guarded(self, fn):
+        if self.watchdog_s is None:
+            return fn()
+        box: Dict = {}
+
+        def run():
+            try:
+                box["r"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["e"] = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="supervised-engine-step")
+        t.start()
+        t.join(self.watchdog_s)
+        if t.is_alive():
+            raise StepStalled(self.watchdog_s)
+        if "e" in box:
+            raise box["e"]
+        return box.get("r")
+
+    def step(self) -> bool:
+        """One supervised scheduler step. A failure triggers teardown +
+        journal recovery and the step is retried on the rebuilt engine;
+        the circuit breaker bounds consecutive failures. Returns False
+        when no work remains."""
+        self._check_alive()
+        while True:
+            try:
+                alive = self._guarded(self.scheduler.step)
+            except EngineDead:
+                raise
+            except Exception as e:  # noqa: BLE001 — classify + recover
+                self._on_failure(e)
+                continue
+            self._on_success()
+            return alive
+
+    def run(self) -> None:
+        """Drive steps until every request finished (raises
+        :class:`EngineDead` if the circuit opens first)."""
+        while self.step():
+            pass
+
+    def _on_success(self):
+        self.steps_total += 1
+        self._consec_failures = 0
+        self.journal.sync()
+        self._snapshot_key()
+        self._deescalate_maybe()
+        _obs.serving_journal(self.journal.size, self.journal.token_count)
+
+    def _on_failure(self, err: Exception):
+        stalled = isinstance(err, StepStalled)
+        injected = isinstance(err, InjectedFault)
+        site = getattr(err, "site", None) or "step"
+        kind = getattr(err, "mode", None) or type(err).__name__
+        inj = _ACTIVE
+        if stalled and not injected:
+            # the watchdog only ever sees a StepStalled — ask the
+            # installed injector whether the stall was its own, so
+            # chaos runs never inflate the REAL-failure counter (and a
+            # genuine stall during a chaos run is at worst attributed
+            # to the one pending injection, never silently dropped)
+            if inj is not None and inj.pending_stalls:
+                site = inj.pending_stalls.pop(0)
+                injected = True
+        elif injected and kind == "stall":
+            # the stall woke BEFORE the watchdog (stall_s < watchdog_s)
+            # and raised itself: retire its pending entry, or a later
+            # REAL watchdog stall would be misattributed as injected
+            if inj is not None and site in inj.pending_stalls:
+                inj.pending_stalls.remove(site)
+        if injected:
+            self.injected_faults += 1
+            # the injector already counted itself at fire time
+        else:
+            self.real_faults += 1
+            _obs.serving_fault(site, kind, injected=False)
+        self._consec_failures += 1
+        if self._consec_failures >= self.circuit_threshold:
+            self._die(err)
+        self._sleep(min(self.backoff_max_s,
+                        self.backoff_s
+                        * (2 ** (self._consec_failures - 1))))
+        self._recover(sync=not stalled)
+
+    def _die(self, err: Exception):
+        """Open the circuit: mark every live request with the
+        structured ``engine_dead`` reason (nothing is silently lost —
+        the journal is retained for post-mortem/drain tooling) and stop
+        retrying."""
+        self._dead = True
+        for e in self.journal.live_entries():
+            req = e.req
+            if req is not None and not req.done:
+                req.done = True
+                req.finish_reason = "engine_dead"
+        _obs.serving_degraded(len(DEGRADED_MODES))  # off-ladder: dead
+        raise EngineDead(
+            f"circuit breaker open after {self._consec_failures} "
+            f"consecutive step failures; last: "
+            f"{type(err).__name__}: {err}") from err
+
+    def _recover(self, sync: bool = True):
+        """Teardown + rebuild + journal restore. ``sync=False`` for
+        stalls: the abandoned thread may still be running, so the
+        journal keeps its last-committed state instead of reading the
+        handles mid-race."""
+        t0 = _obs.generate_begin()
+        if sync:
+            self.journal.sync()
+        live = self.journal.live_entries()
+        replay = sum(e.prompt.size + max(0, len(e.tokens) - 1)
+                     for e in live if e.admitted)
+        self._fence(self.engine)
+        self._build()
+        for e in live:
+            req = e.req
+            req.slot = None
+            req.done = False
+            req.tokens = list(e.tokens)
+            if e.admitted:
+                # a crashed-out session is an eviction the request never
+                # asked for: resume semantics (transient reason, replay
+                # accounting, deadline exemption) apply verbatim
+                req.preemptions = e.preemptions + 1
+                req.finish_reason = FinishReason.PREEMPTED.value
+            else:
+                req.finish_reason = None
+            self.scheduler.requeue(req)
+        self.recoveries += 1
+        self._escalate()
+        _obs.serving_fault_recovery(t0, len(live), replay)
+
+    # ---- drain / restore ----
+    def drain(self, path: str) -> Dict:
+        """Stop admissions and checkpoint to ``path`` (one ``.npz``):
+        every live session's journal record, the prefix-cache trie
+        (structure + page KV bytes), the PRNG key snapshot and the
+        engine geometry for restore-time validation. The supervisor is
+        frozen afterwards (submit/step raise) — restore the file into a
+        fresh process via :meth:`restore`. Returns a summary dict."""
+        self._check_alive()
+        t0 = _obs.generate_begin()
+        self.journal.sync()
+        self._snapshot_key()
+        now = self.clock()
+        cache = self.engine.cache
+        ckpt = cache.checkpoint_prefix()
+        meta = {
+            "sessions": [e.as_record(now)
+                         for e in self.journal.live_entries()],
+            "next_rid": self._next_rid,
+            "page_size": cache.page_size,
+            "max_len": cache.max_len,
+            "max_batch": cache.max_batch,
+            "kv_dtype": (str(np.dtype(cache.kv_dtype))
+                         if cache.kv_dtype is not None else None),
+            "prefix": None,
+        }
+        arrays: Dict[str, np.ndarray] = {
+            "key_data": self._key_data if self._key_data is not None
+            else np.zeros((0,), np.uint32)}
+        if ckpt is not None:
+            meta["prefix"] = {
+                "page_ids": ckpt["page_ids"],
+                "records": ckpt["records"],
+                "shapes": {n: list(a.shape)
+                           for n, a in ckpt["arrays"].items()},
+                "dtypes": {n: str(a.dtype)
+                           for n, a in ckpt["arrays"].items()},
+            }
+            for n, a in ckpt["arrays"].items():
+                # raw-byte views round-trip extension dtypes (bf16)
+                # that np.savez cannot serialize natively
+                arrays[f"prefix_{n}"] = np.frombuffer(
+                    np.ascontiguousarray(a).tobytes(), np.uint8)
+        with open(path, "wb") as f:
+            np.savez(f, meta=np.frombuffer(
+                json.dumps(meta).encode(), np.uint8), **arrays)
+        # freeze ONLY once the checkpoint is safely on disk: a failed
+        # write (bad path, disk full) leaves the supervisor serving —
+        # bricking a healthy engine with nothing saved would strand
+        # every in-flight session
+        self._draining = True
+        nbytes = os.path.getsize(path)
+        n_pages = len(meta["prefix"]["page_ids"]) if meta["prefix"] \
+            else 0
+        _obs.serving_drain_checkpoint(t0, nbytes,
+                                      len(meta["sessions"]), n_pages)
+        return {"path": path, "bytes": nbytes,
+                "sessions": len(meta["sessions"]),
+                "trie_pages": n_pages}
+
+    @classmethod
+    def restore(cls, engine_factory: Callable, path: str,
+                **kw) -> "EngineSupervisor":
+        """Build a fresh supervisor and restore a :meth:`drain`
+        checkpoint into it: trie pages are written back into the new
+        pool FIRST (so session replays — and future admissions — hit
+        the restored prefix cache), then every checkpointed session is
+        requeued through the resume path. Restored request handles live
+        in ``.restored`` (rid -> request)."""
+        sup = cls(engine_factory, **kw)
+        t0 = _obs.generate_begin()
+        with np.load(path) as data:
+            meta = json.loads(bytes(data["meta"]).decode())
+            cache = sup.engine.cache
+            for knob in ("page_size", "max_len", "max_batch"):
+                if meta[knob] != getattr(cache, knob):
+                    raise ValueError(
+                        f"restore: checkpoint {knob}={meta[knob]} does "
+                        f"not match the fresh engine's "
+                        f"{getattr(cache, knob)} — the factory must "
+                        f"rebuild the drained engine's geometry")
+            kv = (str(np.dtype(cache.kv_dtype))
+                  if cache.kv_dtype is not None else None)
+            if meta["kv_dtype"] != kv:
+                raise ValueError(
+                    f"restore: checkpoint kv_dtype={meta['kv_dtype']} "
+                    f"!= engine kv_dtype={kv}")
+            key_data = np.asarray(data["key_data"])
+            if key_data.size:
+                import jax
+                import jax.numpy as jnp
+                sup._key_data = key_data
+                sup.engine._key = jax.random.wrap_key_data(
+                    jnp.asarray(key_data))
+            n_pages = 0
+            if meta["prefix"] is not None:
+                pf = meta["prefix"]
+                arrays = {
+                    n: np.frombuffer(
+                        bytes(data[f"prefix_{n}"]),
+                        _np_dtype(pf["dtypes"][n])).reshape(
+                            pf["shapes"][n])
+                    for n in pf["shapes"]}
+                cache.restore_prefix({"page_ids": pf["page_ids"],
+                                      "records": pf["records"],
+                                      "arrays": arrays})
+                n_pages = len(pf["page_ids"])
+        sup._next_rid = int(meta["next_rid"])
+        sup.engine._next_rid = max(sup.engine._next_rid, sup._next_rid)
+        from ..inference.predictor import GenerationRequest
+        sup.restored: Dict[int, object] = {}
+        for rec in meta["sessions"]:
+            req = GenerationRequest(
+                rec["rid"], np.asarray(rec["prompt"], np.int32),
+                rec["max_new_tokens"], rec["eos_token_id"])
+            req.priority = rec["priority"]
+            if rec.get("deadline_remaining_s") is not None:
+                # re-anchor the SLO on THIS process's clock (the
+                # checkpoint stores remaining seconds, not monotonic
+                # stamps from the drained host)
+                req.deadline_at = (sup.clock()
+                                   + rec["deadline_remaining_s"])
+            req.tokens = list(rec["tokens"])
+            if rec["admitted"]:
+                req.preemptions = rec["preemptions"] + 1
+                req.finish_reason = FinishReason.PREEMPTED.value
+            sup.journal.adopt(req, rec)
+            sup.scheduler.requeue(req)
+            sup.restored[req.rid] = req
+        _obs.serving_drain_restore(t0, os.path.getsize(path),
+                                   len(meta["sessions"]), n_pages)
+        return sup
+
+    # ---- introspection ----
+    def stats(self) -> Dict:
+        s = self.scheduler.stats() if self.scheduler is not None else {}
+        s.update({
+            "health": self.health,
+            "degraded_level": self.degraded_level,
+            "degraded_mode": self.degraded_mode,
+            "recoveries": self.recoveries,
+            "injected_faults": self.injected_faults,
+            "real_faults": self.real_faults,
+            "shed_total": self.shed_total,
+            "supervised_steps": self.steps_total,
+            "journal_entries": self.journal.size,
+            "journal_tokens": self.journal.token_count,
+        })
+        return s

@@ -53,6 +53,7 @@ from ..observability import hooks as _obs
 from .paged_cache import PoolExhausted
 from .policy import (FinishReason, PreemptionPolicy, Priority, StepPlan,
                      TokenBudgetPlanner)
+from .resilience import fault_point
 
 
 class ServingScheduler:
@@ -124,13 +125,42 @@ class ServingScheduler:
         self._queues.setdefault(int(priority), deque()).append(req)
         return req
 
+    def requeue(self, req, *, front: bool = False):
+        """Re-enqueue an EXISTING request handle into its priority
+        class — the supervisor's recovery/restore path
+        (:class:`~paddle_tpu.serving.resilience.EngineSupervisor`
+        re-seats journaled sessions through the normal admission
+        machinery so the resume replay stays the one gated code path).
+        ``front`` requeues ahead of the class (a preemption-style
+        requeue)."""
+        req.enqueued_at = self.clock()
+        if req.submitted_at is None:
+            req.submitted_at = req.enqueued_at
+        q = self._queues.setdefault(int(req.priority), deque())
+        if front:
+            q.appendleft(req)
+        else:
+            q.append(req)
+
     # ---- per-step phases ----
     def _expire_deadlines(self, now: float):
-        """Cancel queued requests whose admission deadline lapsed. The
-        deadline is an ADMISSION SLO: a request the scheduler already
-        admitted once and then preempted (``preemptions > 0``) met it —
-        cancelling would discard finished work because of the
-        scheduler's own eviction, so preempted requeues are exempt and
+        """Cancel requests whose deadline lapsed before they produced a
+        token. The deadline is a FIRST-TOKEN SLO in two phases:
+
+        - QUEUED requests that lapse cancel with ``deadline_exceeded``
+          (never admitted, never held pages).
+        - MID-PREFILL admissions that lapse cancel BEFORE their next
+          chunk is planned, releasing their reserved pages back to the
+          pool (previously expiry only fired between queue scans, so a
+          long chunked prefill kept burning budget and pages for a
+          request that could never meet its SLO). Pages shared with the
+          prefix trie survive under the trie's references, exactly as
+          on any retirement.
+
+        A request the scheduler admitted in time and then preempted
+        (``preemptions > 0``) already met the SLO — cancelling would
+        discard finished work because of the scheduler's own eviction,
+        so preempted requeues (and their resume replays) are exempt and
         simply resume."""
         def expired(r):
             return (r.deadline_at is not None and now >= r.deadline_at
@@ -147,6 +177,16 @@ class ServingScheduler:
                 else:
                     keep.append(req)
             self._queues[prio] = keep
+        # mid-prefill expiry (ISSUE 8 satellite): tokens are only
+        # sampled once prefill completes, so a pending admission past
+        # its deadline has produced nothing worth keeping — cancel it
+        # and free its reserved pages before planning its next chunk
+        for slot, (req, _rem) in list(
+                self.engine.pending_prefills().items()):
+            if expired(req) and not req.tokens:
+                self.engine.cancel_request(
+                    req, FinishReason.DEADLINE_EXCEEDED.value)
+                self.deadline_cancels_total += 1
 
     def _preempt_for(self, req) -> bool:
         """Evict one strictly-lower-class running request to make room
@@ -257,6 +297,7 @@ class ServingScheduler:
         chunks, then the masked decode program). Returns False when no
         work remains. ``last_plan`` holds the step's
         :class:`~paddle_tpu.serving.policy.StepPlan`."""
+        fault_point("sched_tick")
         eng = self.engine
         if eng.queued_requests():
             # engine.submit() after attach would sit in the engine's

@@ -20,6 +20,55 @@ import numpy as np
 import pytest
 
 
+def _enable_compilation_cache():
+    """Persistent XLA compilation cache (the PR 5 bench-infra cache at
+    artifacts/xla_cache, extended to the test harness): the suite
+    compiles hundreds of tiny programs, many HLO-identical across test
+    files (every serving test builds its own engine closures over the
+    same tiny config) — deduping them cuts tier-1 wall-clock even on a
+    cold cache, and a warmed cache survives into later runs in the
+    same checkout. Thresholds zeroed for the same reason bench.py
+    zeroes them. Best-effort: failure to set up must never fail the
+    suite."""
+    try:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "artifacts", "xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run tests/test_offload.py FIRST, then arm the compilation cache
+    to switch on for everything after it: once the cache machinery has
+    been active in a process, the offload suite's host-memory-space
+    programs segfault XLA:CPU (even with the cache re-disabled for
+    that module) — so offload runs before any cache activity and the
+    REST of the suite (including the heavy op sweeps and distributed
+    files) gets the dedup win. PADDLE_TPU_TEST_NO_COMPCACHE=1 opts
+    out (cache never enabled; original order kept)."""
+    if os.environ.get("PADDLE_TPU_TEST_NO_COMPCACHE") or not items:
+        return
+    offload = [it for it in items
+               if "test_offload" in str(getattr(it, "fspath", it.nodeid))]
+    rest = [it for it in items if it not in offload]
+    if not rest:
+        return
+    items[:] = offload + rest
+    config._compcache_boundary = rest[0].nodeid
+
+
+def pytest_runtest_setup(item):
+    boundary = getattr(item.config, "_compcache_boundary", None)
+    if boundary is not None and item.nodeid == boundary:
+        item.config._compcache_boundary = None
+        _enable_compilation_cache()
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

@@ -193,6 +193,88 @@ def test_lastgood_fresh_measurement_sheds_stale_carry_label(tmp_path,
     assert "decode_recorded_at" not in out["extra"]
 
 
+def test_backfill_fallback_reason_stale_vs_quick(tmp_path, monkeypatch):
+    """Satellite (ISSUE 8): carried tiers say WHY they carried —
+    decode_fallback labels each one stale_last_good by default and
+    quick_capture when the reduced-rep live fallback owned the run
+    (quick children skip every decode tier by design)."""
+    bench = _load_bench()
+    rec_path = tmp_path / "BENCH_LASTGOOD.json"
+    monkeypatch.setattr(bench, "_LASTGOOD", str(rec_path))
+    seeded = _tpu_parsed()
+    seeded["extra"]["decode_tokens_per_sec"] = 777.0
+    seeded["extra"]["decode_paged_tokens_per_sec"] = 555.0
+    rec_path.write_text(json.dumps(seeded))
+
+    monkeypatch.delenv("PADDLE_TPU_BENCH_QUICK", raising=False)
+    rec = bench._backfill_decode(_tpu_parsed())
+    assert rec["extra"]["decode_fallback"] == {
+        "decode_tokens_per_sec": "stale_last_good",
+        "decode_paged_tokens_per_sec": "stale_last_good"}
+
+    quick = _tpu_parsed()
+    quick["extra"]["quick_capture"] = True
+    rec = bench._backfill_decode(quick)
+    assert rec["extra"]["decode_fallback"] == {
+        "decode_tokens_per_sec": "quick_capture",
+        "decode_paged_tokens_per_sec": "quick_capture"}
+
+    # env-only signal (the quick child labels its extra AFTER _result
+    # runs, so _backfill_decode must also honor the env)
+    monkeypatch.setenv("PADDLE_TPU_BENCH_QUICK", "1")
+    rec = bench._backfill_decode(_tpu_parsed())
+    assert rec["extra"]["decode_fallback"][
+        "decode_tokens_per_sec"] == "quick_capture"
+
+
+def test_failure_record_labels_probe_killed_per_tier(tmp_path,
+                                                     monkeypatch):
+    """Satellite (ISSUE 8): the surrender JSON explains each carried
+    tier — probe_killed when a probe child had to be SIGKILLed, else
+    stale_last_good — so BENCH_r*.json finally says WHY a tier was
+    carried."""
+    bench = _load_bench()
+    rec_path = tmp_path / "BENCH_LASTGOOD.json"
+    monkeypatch.setattr(bench, "_LASTGOOD", str(rec_path))
+    seeded = _tpu_parsed()
+    seeded["extra"]["decode_tokens_per_sec"] = 777.0
+    seeded["extra"]["decode_tp_tokens_per_sec"] = 888.0
+    seeded["recorded_unix"] = 1.0
+    rec_path.write_text(json.dumps(seeded))
+
+    killed_diag = [{"attempt": 1, "probe_error":
+                    "backend probe hung >60s (TPU tunnel down?); "
+                    "probe child SIGKILLed with its process group"}]
+    out = bench._failure_record("attempt 1: probe hung", killed_diag)
+    assert out["decode_fallback"] == {
+        "decode_tokens_per_sec": "probe_killed",
+        "decode_tp_tokens_per_sec": "probe_killed"}
+    assert out["stale_last_good"]["stale"] is True
+    assert out["error"] == "attempt 1: probe hung"
+
+    soft_diag = [{"attempt": 1, "probe_error": None,
+                  "measure": "rc=1; OOM"}]
+    out = bench._failure_record("attempt 1: rc=1", soft_diag)
+    assert out["decode_fallback"] == {
+        "decode_tokens_per_sec": "stale_last_good",
+        "decode_tp_tokens_per_sec": "stale_last_good"}
+
+    # an EARLY killed probe followed by a healthy one (whose measure
+    # then failed) means attempts DID run: the label keys off the LAST
+    # probe outcome, not any historical SIGKILL
+    mixed_diag = killed_diag + [{"attempt": 2, "probe_error": None,
+                                 "measure": "rc=1; tunnel dropped"}]
+    out = bench._failure_record("attempt 2: rc=1", mixed_diag)
+    assert out["decode_fallback"][
+        "decode_tokens_per_sec"] == "stale_last_good"
+
+    # no last-good file: the record still emits, without the labels
+    rec_path.unlink()
+    out = bench._failure_record("err", killed_diag)
+    assert "decode_fallback" not in out
+    assert "stale_last_good" not in out
+
+
 def test_probe_backend_kill_is_bounded_and_diagnostic(monkeypatch):
     """Satellite (ISSUE 7): a probe child that outlives its deadline is
     SIGKILLed with its whole process group — the probe returns within

@@ -9,6 +9,20 @@ import jax
 import pytest
 
 import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_compilation_cache():
+    """The offload programs pin buffers to host memory spaces; running
+    them in a process where the persistent XLA compilation cache has
+    been active segfaults XLA:CPU. conftest only switches the cache on
+    AFTER this module (pytest_collection_modifyitems boundary); this
+    fixture additionally guards direct invocations where the cache was
+    enabled externally (e.g. a user-set JAX_COMPILATION_CACHE_DIR)."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
 import paddle_tpu.nn as nn
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed.sharding import (

@@ -301,6 +301,80 @@ class TestSchedulerLifecycle:
         sched.run()
         assert a.finish_reason == "max_len"
 
+    def test_deadline_expires_mid_prefill_frees_pages(self):
+        """BUGFIX (ISSUE 8 satellite): a request whose deadline passes
+        MID-prefill-chunk — admitted, pages reserved, no token sampled
+        yet — cancels with ``deadline_exceeded`` before its next chunk
+        is planned, and its reserved pages return to the pool. Pages
+        shared with the prefix TRIE survive under the trie's
+        references, like any other retirement."""
+        cfg, params = _setup(seed=3)
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=2, page_size=8, max_len=48,
+            prefill_chunk=8)
+        t = [0.0]
+        sched = ServingScheduler(eng, clock=lambda: t[0])
+        rs = np.random.RandomState(40)
+        sys_p = rs.randint(3, cfg.vocab_size, (16,)).astype(np.int32)
+        # warm the trie: a completes and publishes its prompt pages
+        a = sched.submit(sys_p, max_new_tokens=4)
+        sched.run()
+        assert a.done
+        alloc = eng.cache.allocator
+        trie_held = alloc.num_used          # trie references only
+        assert trie_held > 0
+        # b shares the 16-token prefix, then needs 2 more chunks of
+        # fresh prefill — and its deadline lapses after the first
+        b = sched.submit(
+            np.concatenate([sys_p, rs.randint(
+                3, cfg.vocab_size, (16,)).astype(np.int32)]),
+            max_new_tokens=8, deadline_s=5.0)
+        sched.step()                        # admit + first fresh chunk
+        assert b.slot is not None and len(b.tokens) == 0
+        assert b.slot in dict(eng.pending_prefills())
+        reserved = alloc.num_used
+        assert reserved > trie_held         # fresh pages reserved
+        t[0] = 10.0                         # deadline lapses mid-prefill
+        sched.step()                        # cancels BEFORE next chunk
+        assert b.done and b.tokens == []
+        assert b.finish_reason == "deadline_exceeded"
+        assert sched.deadline_cancels_total == 1
+        assert not eng.pending_prefills()   # no further chunk planned
+        # the fresh pages came back; the trie-shared prefix survived
+        assert alloc.num_used == trie_held
+        # the survivors are still servable: a prefix-sharing admission
+        # after the cancel maps them straight back in
+        c = sched.submit(np.concatenate(
+            [sys_p, rs.randint(3, cfg.vocab_size, (4,)
+                               ).astype(np.int32)]), max_new_tokens=4)
+        sched.run()
+        assert c.done and len(c.tokens) == 4
+
+    def test_deadline_spares_mid_prefill_resume_replay(self):
+        """A PREEMPTED victim resuming through the continuation-prefill
+        replay is exempt from mid-prefill expiry (it met its admission
+        SLO before the scheduler's own eviction)."""
+        cfg, params = _setup(seed=1)
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=1, page_size=8, max_len=32,
+            prefill_chunk=8, enable_prefix_cache=False)
+        t = [0.0]
+        sched = ServingScheduler(eng, clock=lambda: t[0])
+        a = sched.submit(_prompts(cfg, [20], seed=41)[0],
+                         max_new_tokens=4, priority=Priority.LOW,
+                         deadline_s=1.0)    # admitted well within it
+        while len(a.tokens) < 2:
+            sched.step()
+        b = sched.submit(_prompts(cfg, [4], seed=42)[0],
+                         max_new_tokens=2, priority=Priority.HIGH)
+        sched.step()                        # evicts a
+        assert a.preemptions == 1
+        t[0] = 9.0                          # far past a's deadline
+        sched.run()                         # a's replay is mid-prefill
+        assert sched.deadline_cancels_total == 0
+        assert a.done and a.finish_reason == "max_len"
+        assert len(a.tokens) == 4 and b.done
+
     def test_infeasible_preemption_evicts_no_one(self):
         """When even evicting EVERY lower-class victim could not cover
         the admission (equal-class tables pin too much of the pool),

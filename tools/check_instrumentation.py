@@ -54,6 +54,12 @@ REQUIRED = {
         # only view of the tp collective bill
         ("_obs.serving_tp_step(", 1),
         ("_obs.serving_tp_logits_gather(", 1),
+        # fault-injection sites (ISSUE 8): step execution + the
+        # device->host transfers (decode AND spec-verify paths)
+        ('_fault_point("decode_step")', 1),
+        ('_fault_point("prefill_chunk")', 1),
+        ('_fault_point("verify_step")', 1),
+        ('_fault_point("transfer")', 2),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -61,6 +67,27 @@ REQUIRED = {
         # budget-utilization gauge once per planned step
         ("_obs.serving_queue_wait(", 1),
         ("_obs.serving_sched_step(", 1),
+        # fault-injection site (ISSUE 8): the scheduler tick
+        ('fault_point("sched_tick")', 1),
+    ],
+    "paddle_tpu/serving/resilience.py": [
+        # fault-tolerant serving (ISSUE 8): injected + real failure
+        # counters (fire + catch sides), the recovery-latency
+        # histogram, the degraded-mode gauge, the journal-size gauges
+        # and both halves of the drain/restore pair — the supervisor
+        # is the unit the multi-engine router will replicate, and a
+        # blind supervisor cannot be routed around
+        ("_obs.serving_fault(", 2),
+        ("_obs.serving_fault_recovery(", 1),
+        ("_obs.serving_degraded(", 2),        # ladder moves + dead
+        ("_obs.serving_journal(", 1),
+        ("_obs.serving_drain_checkpoint(", 1),
+        ("_obs.serving_drain_restore(", 1),
+    ],
+    "paddle_tpu/serving/paged_cache.py": [
+        # fault-injection sites (ISSUE 8): allocator alloc/free
+        ('fault_point("alloc")', 1),
+        ('fault_point("free")', 1),
     ],
     "paddle_tpu/models/generate.py": [
         ("_obs.generate_begin()", 1),
@@ -96,9 +123,55 @@ REQUIRED = {
 }
 
 
+#: modules allowed to host fault-injection call sites (the serving hot
+#: path) — the site-coverage rule greps these
+_FAULT_SITE_MODULES = (
+    "paddle_tpu/serving/paged_cache.py",
+    "paddle_tpu/serving/scheduler.py",
+    "paddle_tpu/inference/predictor.py",
+)
+
+
+def check_fault_sites(root: str) -> list:
+    """ISSUE 8 rule: every FaultInjector site name declared in
+    ``serving/resilience.py``'s ``SITES`` tuple must have a matching
+    ``fault_point("<site>")`` call threaded through a hot-path module —
+    a declared-but-unthreaded site would silently produce NO
+    ``serving_fault_*{site=...}`` counter label, and chaos coverage of
+    that site would be a no-op that still claims the site was
+    exercised."""
+    import re
+    problems = []
+    res_path = os.path.join(root, "paddle_tpu/serving/resilience.py")
+    if not os.path.exists(res_path):
+        return [f"paddle_tpu/serving/resilience.py: file missing"]
+    with open(res_path, encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"^SITES\s*=\s*\(([^)]*)\)", src, re.M)
+    if not m:
+        return ["paddle_tpu/serving/resilience.py: SITES tuple missing"]
+    sites = re.findall(r"\"([a-z_]+)\"", m.group(1))
+    if not sites:
+        return ["paddle_tpu/serving/resilience.py: SITES tuple empty"]
+    hot = ""
+    for rel in _FAULT_SITE_MODULES:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                hot += f.read()
+    for site in sites:
+        if f'fault_point("{site}")' not in hot:
+            problems.append(
+                f"paddle_tpu/serving/resilience.py: SITES declares "
+                f"{site!r} but no hot-path module calls "
+                f"fault_point(\"{site}\") — the serving_fault_* "
+                f"counters would never carry that site label")
+    return problems
+
+
 def check(root: str) -> list:
     """Returns a list of human-readable violation strings (empty = ok)."""
-    problems = []
+    problems = check_fault_sites(root)
     for rel, rules in REQUIRED.items():
         path = os.path.join(root, rel)
         if not os.path.exists(path):
