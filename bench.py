@@ -157,7 +157,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
             decode_w8kv8_tps=None, decode_paged_tps=None,
             decode_prefix_tps=None, decode_sched=None,
-            decode_spec=None, decode_tp=None, phases=None):
+            decode_spec=None, decode_tp=None, decode_cluster=None,
+            phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -179,7 +180,9 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_spec_tokens_per_sec": (
                       decode_spec[0] if decode_spec else None),
                   "decode_tp_tokens_per_sec": (
-                      decode_tp[0] if decode_tp else None)},
+                      decode_tp[0] if decode_tp else None),
+                  "decode_cluster_tokens_per_sec": (
+                      decode_cluster[0] if decode_cluster else None)},
     }
     if decode_sched:
         # the tier's point is the BOUND, not just the throughput:
@@ -193,6 +196,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # the tp tier reports an AGGREGATE over tp chips: the scaling
         # factor vs the single-chip paged tier is the honest headline
         rec["extra"]["decode_tp_scaling"] = decode_tp[1]
+    if decode_cluster:
+        # the cluster tier's ratio vs one engine on the same tenant
+        # workload (router+handoff overhead on one host, the scaling
+        # win on real multi-chip deployments) travels with the number
+        rec["extra"]["decode_cluster_scaling"] = decode_cluster[1]
     if phases is not None:
         rec["phases"] = phases
     return _backfill_decode(rec)
@@ -496,13 +504,99 @@ def tp_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                         mesh=serving_mesh(tp))[0]
 
 
+def cluster_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                        kv_cache_dtype=None, replicas=2):
+    """The decode_cluster_tokens_per_sec measurement, shared by
+    measure() and tools/decode_bench.py so the two sources stay
+    comparable.
+
+    TWO engine replicas behind the ISSUE 9
+    :class:`~paddle_tpu.serving.ServingCluster` router, serving a
+    shared-prefix TENANT workload: one tenant per replica, each with
+    its own system prompt (3/4 of the prompt, page-aligned) plus
+    per-request unique suffixes — prefix-affinity routing pins each
+    tenant to the replica whose trie holds its system prompt, so the
+    cluster converts the tenant mix into per-replica prefix-hit
+    workloads instead of thrashing every trie with every tenant.
+    Suffixes REGENERATE per pass (only the system prefix may hit the
+    warm trie, same rule as the prefix tier). The rider is the
+    cluster's honest headline: the SAME request set through ONE engine
+    (same geometry, prefix cache on), with the cluster-vs-single-engine
+    ratio riding the record as ``decode_cluster_scaling`` — on one
+    host the replicas timeshare the chip, so the ratio measures router
+    + handoff overhead; on a multi-chip deployment each replica owns
+    its silicon and the ratio is the scaling win. Returns
+    ``(tokens_per_sec, {"replicas", "vs_single_engine",
+    "affinity_hit_rate"})``."""
+    import numpy as np
+    from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    from paddle_tpu.serving import ServingCluster
+    page = 16 if on_tpu else 8
+    sys_len = min(max(page, (dp_len * 3 // 4 // page) * page), dp_len)
+    rngp = np.random.default_rng(13)
+    sys_prompts = [rngp.integers(0, cfg.vocab_size, (sys_len,)).astype(
+        np.int32) for _ in range(replicas)]
+
+    def make_jobs():
+        jobs = []
+        for t in range(replicas):
+            for _ in range(2 * db):
+                jobs.append((t, np.concatenate([
+                    sys_prompts[t],
+                    rngp.integers(0, cfg.vocab_size,
+                                  (dp_len - sys_len,)).astype(
+                                      np.int32)])))
+        return jobs
+
+    def engine():
+        return ContinuousBatchingEngine(
+            params, cfg, max_batch=db, page_size=page,
+            max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype)
+
+    single = engine()      # persistent, like the cluster's replicas —
+    # both sides' warm pass absorbs compiles and seeds the tries
+
+    def run_single():
+        reqs = [single.submit(p, max_new_tokens=dnew)
+                for _, p in make_jobs()]
+        single.run()
+        return sum(r.max_new_tokens for r in reqs)
+
+    run_single()                                    # compile/warm pass
+    t0 = time.perf_counter()
+    toks = run_single()
+    single_tps = toks / (time.perf_counter() - t0)
+
+    cluster = ServingCluster(engine, replicas=replicas)
+
+    def run_cluster():
+        reqs = [cluster.submit(p, max_new_tokens=dnew,
+                               tenant=f"tenant{t}")
+                for t, p in make_jobs()]
+        cluster.run()
+        return sum(r.max_new_tokens for r in reqs)
+
+    run_cluster()                                   # warm (binds affinity)
+    t0 = time.perf_counter()
+    toks = run_cluster()
+    tps = round(toks / (time.perf_counter() - t0), 2)
+    return tps, {
+        "replicas": replicas,
+        "vs_single_engine": round(tps / single_tps, 3) if single_tps
+        else None,
+        "affinity_hit_rate": round(
+            cluster.router.stats()["affinity_hit_rate"], 3),
+    }
+
+
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec",
                  "decode_paged_tokens_per_sec",
                  "decode_prefix_tokens_per_sec",
                  "decode_sched_tokens_per_sec",
                  "decode_spec_tokens_per_sec",
-                 "decode_tp_tokens_per_sec")
+                 "decode_tp_tokens_per_sec",
+                 "decode_cluster_tokens_per_sec")
 
 # rider dicts that travel with their tier when it carries from an older
 # record: the scheduler tier's p50/p99 step-latency bound (ISSUE 4),
@@ -513,7 +607,9 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
 # same pairs on the shell side.
 _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                   ("decode_spec_tokens_per_sec", "decode_spec_acceptance"),
-                  ("decode_tp_tokens_per_sec", "decode_tp_scaling"))
+                  ("decode_tp_tokens_per_sec", "decode_tp_scaling"),
+                  ("decode_cluster_tokens_per_sec",
+                   "decode_cluster_scaling"))
 
 
 def _label_decode_source(extra: dict, carried_tiers,
@@ -822,6 +918,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"tp decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # disaggregated serving cluster (ISSUE 9): two replicas behind the
+    # prefix-affinity router on a shared-prefix tenant workload, with
+    # the cluster-vs-single-engine ratio riding the record
+    decode_cluster = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_cluster = cluster_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"cluster decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
@@ -830,7 +938,8 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_int8_tps, decode_int4_tps, decode_w8kv8_tps,
                    decode_paged_tps, decode_prefix_tps,
                    decode_sched=decode_sched, decode_spec=decode_spec,
-                   decode_tp=decode_tp, phases=phases)
+                   decode_tp=decode_tp, decode_cluster=decode_cluster,
+                   phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

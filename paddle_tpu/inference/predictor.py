@@ -921,6 +921,72 @@ class ContinuousBatchingEngine:
         t0 = _obs.generate_begin()
         _obs.serving_tp_logits_gather(t0, probe(x))
 
+    # ---- prefill→decode KV handoff (ISSUE 9) ----
+    def export_prefilled(self, req: GenerationRequest) -> Dict:
+        """Export a fully prefilled, decode-ready request's KV pages as
+        a handoff payload (the disaggregated cluster's prefill→decode
+        transfer): the slot's live page bytes
+        (:meth:`~paddle_tpu.serving.PagedKVCache.export_request`), the
+        committed length and the already-sampled last token. PURE READ
+        — the request keeps running here until :meth:`finish_handoff`
+        detaches it, so a failed import on the decode side loses
+        nothing."""
+        slot = req.slot
+        if slot is None or self._slots[slot] is not req:
+            raise ValueError(
+                f"export_prefilled: request {req.rid} is not running")
+        if slot in self._pending:
+            raise ValueError(
+                f"export_prefilled: request {req.rid} is still "
+                f"mid-prefill — hand off only decode-ready slots")
+        return {"rid": req.rid, "slot": slot,
+                "length": int(self.cache.lengths[slot]),
+                "last": int(self._last[slot]),
+                "kv": self.cache.export_request(slot)}
+
+    def import_prefilled(self, req: GenerationRequest,
+                         payload: Dict) -> bool:
+        """Install an exported request DIRECTLY into a decode slot: the
+        payload's pages scatter into freshly allocated pages
+        (:meth:`~paddle_tpu.serving.PagedKVCache.import_request`), the
+        block table / length / last-token state matches what in-place
+        prefill would have left, and the prompt's pages publish to THIS
+        engine's prefix trie (future same-prefix admissions here HIT).
+        Returns False when no slot is free; raises
+        :class:`~paddle_tpu.serving.PoolExhausted` (nothing changed)
+        when the pool can't cover it. Decode from here is BIT-identical
+        to having prefilled in place."""
+        free = self.cache.free_slots()
+        if not free:
+            return False
+        slot = free[0]
+        self.cache.import_request(
+            slot, payload["kv"],
+            req.prompt.shape[1] + req.max_new_tokens)
+        self.cache.lengths[slot] = np.int32(payload["length"])
+        self._last[slot] = np.int32(payload["last"])
+        req.slot = slot
+        self._slots[slot] = req
+        self.cache.register_prefix(slot, req.prompt[0])
+        return True
+
+    def finish_handoff(self, req: GenerationRequest, slot: int):
+        """Detach a handed-off request from THIS engine after a
+        successful import elsewhere: the slot entry clears FIRST (so
+        even a fault inside the page release cannot leave two engines
+        decoding the same request), then the pages release — ones the
+        prefix trie shares survive under its references, which is what
+        keeps the prefill replica's trie warm for the tenant's next
+        prompt. ``slot`` is the ORIGINAL slot from the export payload
+        (``req.slot`` already points at the importing engine)."""
+        if self._slots[slot] is not req:
+            raise ValueError(
+                f"finish_handoff: slot {slot} does not hold request "
+                f"{req.rid}")
+        self._slots[slot] = None
+        self._pending.pop(slot, None)
+        self.cache.release(slot)
+
     def ready_mask(self) -> np.ndarray:
         """(max_batch,) bool — slots whose sequence is fully in the
         pool and can decode this step; slots mid-prefill hold pages

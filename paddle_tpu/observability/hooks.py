@@ -533,6 +533,123 @@ def serving_drain_restore(t0_ns: int, nbytes: int, sessions: int,
                ).inc(trie_pages)
 
 
+# ---------------- disaggregated cluster serving (ISSUE 9) ----------------
+
+def serving_router_dispatch(replica: int, affinity_hit: bool):
+    """One router dispatch decision: per-replica dispatch counter plus
+    the affinity hit/miss split — the live prefix-affinity hit rate
+    (hits mean the tenant's system prompt lands on a replica whose trie
+    already holds it; misses fall back to least-loaded placement)."""
+    if not enabled:
+        return
+    _m.counter("serving_router_dispatch_total",
+               "requests dispatched to engine replicas by the cluster "
+               "router", ("replica",)).labels(str(replica)).inc()
+    _m.counter("serving_router_affinity_total",
+               "prefix-affinity routing outcomes",
+               ("outcome",)).labels(
+        "hit" if affinity_hit else "miss").inc()
+
+
+def serving_router_retry(n: int = 1):
+    """A request a degraded replica shed (``rejected_overload``) was
+    re-dispatched to the healthiest replica before surfacing the
+    rejection to the caller — the router-level retry of shed work."""
+    if not enabled:
+        return
+    _m.counter("serving_router_retries_total",
+               "shed requests re-dispatched to a healthier replica"
+               ).inc(n)
+
+
+def serving_router_ratelimited(tenant: str):
+    """A submission exceeded its tenant's token quota and finished
+    ``rejected_ratelimit`` without touching any replica."""
+    if not enabled:
+        return
+    _m.counter("serving_router_ratelimited_total",
+               "submissions rejected by per-tenant rate limits",
+               ("tenant",)).labels(tenant).inc()
+
+
+def serving_router_failover(sessions: int):
+    """A replica left service (circuit open, or a rolling-upgrade
+    drain) and the router rehomed its live sessions onto surviving
+    replicas — counted per event, with the rehomed-session total
+    alongside (zero lost requests is the gate)."""
+    if not enabled:
+        return
+    _m.counter("serving_router_failovers_total",
+               "replica exits (death or retirement) the router "
+               "rehomed sessions from").inc()
+    _m.counter("serving_router_rehomed_sessions_total",
+               "live sessions re-dispatched off dead or retiring "
+               "replicas").inc(sessions)
+
+
+def serving_router_replica(replica: int, queued: int, occupancy: float,
+                           degraded_level: int):
+    """One replica's published load signals, refreshed each cluster
+    step: queue depth, paged-pool occupancy and the degraded-mode rung
+    — the registry-side mirror of ``ServingScheduler.load_stats()``
+    (the router reads the structured API; dashboards read these)."""
+    if not enabled:
+        return
+    _m.gauge("serving_replica_queue_depth",
+             "queued requests per engine replica",
+             ("replica",)).labels(str(replica)).set(queued)
+    _m.gauge("serving_replica_pool_occupancy",
+             "paged-pool occupancy per engine replica",
+             ("replica",)).labels(str(replica)).set(occupancy)
+    _m.gauge("serving_replica_degraded_mode",
+             "degraded-mode ladder rung per engine replica",
+             ("replica",)).labels(str(replica)).set(degraded_level)
+
+
+def serving_handoff_export(t0_ns: int, nbytes: int, pages: int):
+    """Close one prefill→decode KV export opened at ``t0_ns`` (a
+    :func:`generate_begin` anchor): latency histogram + bytes/pages
+    counters — the numerator of the handoff cost model (page bytes
+    moved vs the replay-prefill FLOPs they replace; PERF_NOTES)."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.handoff_export", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_handoff_export_ms",
+                 "wall milliseconds per prefill-side KV export",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_handoff_exports_total",
+               "prefill→decode KV handoffs exported").inc()
+    _m.counter("serving_handoff_bytes_total",
+               "KV bytes moved by prefill→decode handoffs").inc(nbytes)
+    _m.counter("serving_handoff_pages_total",
+               "KV pages moved by prefill→decode handoffs").inc(pages)
+
+
+def serving_handoff_import(t0_ns: int):
+    """Close one decode-side KV import (allocate + donated scatter)
+    opened at ``t0_ns`` — the latency half of the other side of the
+    ``serving_handoff_*`` pair. Bytes/pages are counted ONCE, at
+    export (:func:`serving_handoff_export`): a successful handoff
+    moves each byte exactly once, so a second counter here would
+    double the cost model's numerator."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.handoff_import", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_handoff_import_ms",
+                 "wall milliseconds per decode-side KV import scatter",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_handoff_imports_total",
+               "prefill→decode KV handoffs imported").inc()
+
+
 def serving_step(active: int, max_slots: int, pages_used: int,
                  pages_total: int):
     """One continuous-batching decode step: batch-occupancy histogram +

@@ -22,6 +22,12 @@ SLO-aware scheduler.
   token-identical crash recovery via the resume replay, circuit
   breaker + degraded-mode ladder, drain/restore with prefix-trie
   persistence).
+- :mod:`paddle_tpu.serving.cluster` / :mod:`paddle_tpu.serving.router`
+  — the disaggregated serving tier (ISSUE 9): :class:`ServingCluster`
+  (N supervised replicas, prefill→decode KV handoff over the page
+  export/import APIs, failover and rolling drain/upgrade) routed by
+  :class:`ClusterRouter` (prefix-affinity placement, load/SLO-aware
+  dispatch, per-tenant fair share + :class:`TenantQuota` rate limits).
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -38,9 +44,11 @@ from .policy import (  # noqa: F401
 from .resilience import (  # noqa: F401
     DEGRADED_MODES, SITES, CorruptionDetected, EngineDead,
     EngineSupervisor, FaultInjector, InjectedFault, RequestJournal,
-    StepStalled, fault_point,
+    StepStalled, fault_point, load_drain_checkpoint,
 )
 from .scheduler import ServingScheduler  # noqa: F401
 from .speculative import (  # noqa: F401
     NgramProposer, Speculator, longest_accepted_prefix,
 )
+from .router import ClusterRouter, TenantQuota  # noqa: F401
+from .cluster import ServingCluster  # noqa: F401

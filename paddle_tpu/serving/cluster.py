@@ -1,0 +1,534 @@
+"""Disaggregated serving cluster: engine replicas behind a
+prefix-affinity router, with prefill→decode KV handoff (ISSUE 9).
+
+The PR 2–8 stack tops out at ONE engine — one pool of HBM, one blast
+radius, no way to upgrade without dropping sessions.
+:class:`ServingCluster` is the horizontal layer above it: N
+:class:`~paddle_tpu.serving.EngineSupervisor`-wrapped replicas (each
+optionally tp-sharded) behind a
+:class:`~paddle_tpu.serving.router.ClusterRouter`.
+
+- **Routing** — submissions queue at the cluster and dispatch in
+  per-tenant fair-share order (ascending token account); placement is
+  prefix-affinity first (the prompt's leading full pages hash to the
+  replica whose :class:`~paddle_tpu.serving.PrefixCache` trie already
+  holds the tenant's system prompt), least-loaded/healthiest otherwise,
+  read from the PUBLIC
+  :meth:`~paddle_tpu.serving.ServingScheduler.load_stats` snapshot
+  (and mirrored to the metrics registry as the ``serving_replica_*``
+  gauges) — the router never reaches into engine internals. Per-tenant
+  :class:`~paddle_tpu.serving.router.TenantQuota` rate limits reject
+  over-quota submissions with the structured ``rejected_ratelimit``
+  finish reason before any replica sees them; a request a degraded
+  replica sheds (``rejected_overload``) is re-dispatched ONCE to the
+  healthiest replica before the rejection surfaces
+  (``serving_router_retries_total``).
+
+- **Prefill/decode disaggregation** (``prefill_replicas > 0``) —
+  dedicated prefill replicas run chunked prefill to completion, then
+  hand the finished pages to a decode replica:
+  :meth:`~paddle_tpu.serving.PagedKVCache.export_request` (raw page
+  bytes of the request's ARBITRARY block table — the PR 8
+  ``checkpoint_prefix`` machinery generalized past trie chains) →
+  :meth:`~paddle_tpu.serving.PagedKVCache.import_request` (one jitted
+  donated scatter into the decode pool). The handoff is BIT-identical
+  to prefilling in place at fp and int8-KV, including tp-sharded
+  replicas (tests/test_cluster.py); when no decode slot is free the
+  prefill replica simply keeps serving the request — disaggregation is
+  an optimization, never a stall.
+
+- **Failover & rolling upgrade** — a replica whose circuit opens
+  (:class:`~paddle_tpu.serving.EngineDead`) is rebuilt in place and its
+  journaled sessions re-dispatch onto survivors (resume semantics:
+  token-identical replay, zero lost requests —
+  tools/chaos_soak.py --cluster); :meth:`retire_replica` drains one
+  replica through the PR 8 drain path, requeues its sessions elsewhere
+  MID-DECODE, and restores the drained prefix trie into the
+  replacement so the tenant's next prompt still prefix-HITs.
+
+Token identity holds by construction: per-request greedy decode is
+independent of batch composition (the PR 2–7 parity gates), so routed
+output matches a single engine serving the same request set
+bit-for-bit — gated in tests/test_cluster.py.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from ..observability import hooks as _obs
+from .paged_cache import PoolExhausted
+from .policy import FinishReason, Priority
+from .resilience import (EngineDead, EngineSupervisor,
+                         load_drain_checkpoint)
+from .router import ClusterRouter, TenantQuota
+
+
+class ServingCluster:
+    """N supervised engine replicas behind a cluster router.
+
+    ``engine_factory() -> ContinuousBatchingEngine`` builds one FRESH
+    replica engine (identical config each call — the same contract
+    :class:`~paddle_tpu.serving.EngineSupervisor` already imposes;
+    replicas share the params tree read-only). ``prefill_replicas``
+    carves the first K replicas out as dedicated prefill engines
+    (0 = every replica serves end-to-end). ``quotas`` maps tenant ->
+    :class:`~paddle_tpu.serving.router.TenantQuota`. ``supervisor_kw``
+    passes through to every replica's supervisor (watchdog, backoff,
+    circuit threshold). ``clock`` is shared by the router, every
+    scheduler and every supervisor so deadlines mean one thing
+    cluster-wide.
+    """
+
+    def __init__(self, engine_factory: Callable, replicas: int = 2, *,
+                 prefill_replicas: int = 0,
+                 token_budget: Optional[int] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 router: Optional[ClusterRouter] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 supervisor_kw: Optional[Dict] = None):
+        if replicas < 1:
+            raise ValueError(f"replicas={replicas} must be >= 1")
+        if not 0 <= prefill_replicas < replicas:
+            raise ValueError(
+                f"prefill_replicas={prefill_replicas} must leave at "
+                f"least one decode replica (replicas={replicas})")
+        self._factory = engine_factory
+        self.token_budget = token_budget
+        self.clock = clock
+        self._sup_kw = dict(supervisor_kw or {})
+        self._next_rid = 0
+        self.replicas: List[EngineSupervisor] = [
+            self._new_supervisor() for _ in range(replicas)]
+        self.prefill_replicas = prefill_replicas
+        page = self.replicas[0].engine.cache.page_size
+        for sup in self.replicas[1:]:
+            if sup.engine.cache.page_size != page:
+                raise ValueError(
+                    "engine_factory returned replicas with different "
+                    "page sizes — handoff and affinity need one "
+                    "geometry")
+        self.router = router if router is not None else ClusterRouter(
+            page, quotas=quotas, clock=clock)
+        self._rq: List[Dict] = []       # undispatched submissions
+        self._live: Dict[int, object] = {}  # rid -> live request handle
+        self._meta: Dict[int, Dict] = {}  # rid -> {tenant, cost}
+        self._owner: Dict[int, int] = {}  # rid -> replica idx
+        self._seq = 0
+        self._steps = 0
+        self.handoffs_total = 0
+        self.failovers_total = 0
+        self.retirements_total = 0
+        self.deadline_cancels_total = 0
+
+    def _new_supervisor(self) -> EngineSupervisor:
+        sup = EngineSupervisor(self._factory,
+                               token_budget=self.token_budget,
+                               clock=self.clock, **self._sup_kw)
+        sup.engine._next_rid = max(sup.engine._next_rid, self._next_rid)
+        return sup
+
+    # ---- roles ----
+    def _prefill_idxs(self) -> List[int]:
+        return list(range(self.prefill_replicas))
+
+    def _decode_idxs(self) -> List[int]:
+        return list(range(self.prefill_replicas, len(self.replicas)))
+
+    def _alive(self, idxs) -> Dict[int, Dict]:
+        """load_stats snapshots of the serviceable replicas among
+        ``idxs`` — the router's whole worldview."""
+        out = {}
+        for i in idxs:
+            sup = self.replicas[i]
+            if sup.health == "dead" or sup._draining:
+                continue
+            out[i] = sup.load_stats()
+        return out
+
+    # ---- intake ----
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               tenant: str = "default", priority=Priority.NORMAL,
+               deadline_s: Optional[float] = None, eos_token_id=None):
+        """Queue a prompt for routed dispatch. The handle fills in as
+        cluster steps run, exactly like a single engine's. Over-quota
+        tenants get an immediate ``rejected_ratelimit``; everything
+        else dispatches on the next :meth:`step` in fair-share order."""
+        eng = self.replicas[self._first_alive()].engine
+        eng._next_rid = max(eng._next_rid, self._next_rid)
+        req = eng.create_request(prompt, max_new_tokens=max_new_tokens,
+                                 eos_token_id=eos_token_id)
+        self._next_rid = eng._next_rid
+        req.priority = int(priority)
+        cost = req.prompt.shape[1] + req.max_new_tokens
+        self._live[req.rid] = req
+        self._meta[req.rid] = {"tenant": tenant, "cost": cost}
+        if not self.router.admit_rate_limit(tenant, cost):
+            req.done = True
+            req.finish_reason = FinishReason.REJECTED_RATELIMIT.value
+            self.router.note_ratelimited(tenant)
+            _obs.serving_cancelled(1, req.finish_reason)
+            return req
+        if deadline_s is not None:
+            req.deadline_at = self.clock() + float(deadline_s)
+        self._rq.append({"req": req, "tenant": tenant, "cost": cost,
+                         "seq": self._seq})
+        self._seq += 1
+        return req
+
+    def _first_alive(self) -> int:
+        for i, sup in enumerate(self.replicas):
+            if sup.health != "dead" and not sup._draining:
+                return i
+        raise EngineDead("every replica in the cluster is dead")
+
+    # ---- dispatch ----
+    def _dispatch(self):
+        """Drain the router queue in fair-share order: per-tenant FIFO
+        deques, always serving the tenant with the smallest token
+        account next (ties break on submission order) — O(n log n)
+        over the whole queue, and the ordering bound the fairness
+        guarantee rests on: a light tenant's request outranks every
+        request of any tenant that already consumed more. Dispatch =
+        journaled intake on the chosen replica
+        (:meth:`~paddle_tpu.serving.EngineSupervisor.submit_request`);
+        a shed (``rejected_overload``) dispatch retries ONCE on the
+        healthiest other replica. Queued requests whose deadline lapsed
+        at the router cancel here — the same admission SLO the replica
+        schedulers enforce."""
+        if not self._rq:
+            return
+        now = self.clock()
+        by_tenant: Dict[str, Deque] = {}
+        for e in self._rq:              # already in ascending seq order
+            by_tenant.setdefault(e["tenant"], deque()).append(e)
+        self._rq = []
+        accounts = self.router.accounts
+        while by_tenant:
+            tenant = min(by_tenant,
+                         key=lambda t: (accounts.get(t, 0),
+                                        by_tenant[t][0]["seq"]))
+            q = by_tenant[tenant]
+            e = q.popleft()
+            if not q:
+                del by_tenant[tenant]
+            req = e["req"]
+            if req.done:
+                continue
+            if req.deadline_at is not None and now >= req.deadline_at:
+                req.done = True
+                req.finish_reason = FinishReason.DEADLINE_EXCEEDED.value
+                self.deadline_cancels_total += 1
+                _obs.serving_cancelled(1, req.finish_reason)
+                continue
+            self._dispatch_one(e)
+
+    def _dispatch_one(self, entry: Dict):
+        req = entry["req"]
+        fresh = not req.tokens and req.preemptions == 0
+        role = (self._prefill_idxs()
+                if self.prefill_replicas and fresh
+                else self._decode_idxs())
+        loads = self._alive(role) or self._alive(
+            range(len(self.replicas)))
+        key = self.router.affinity_key(req.prompt[0])
+        idx, hit = self.router.pick_replica(key, loads)
+        self.replicas[idx].submit_request(req)
+        self.router.note_dispatch(idx, hit)
+        self._owner[req.rid] = idx
+        if req.done and req.finish_reason == \
+                FinishReason.REJECTED_OVERLOAD.value and len(loads) > 1:
+            # router-level retry of shed work: once, to the healthiest
+            # OTHER replica (ignore affinity — the bound replica just
+            # proved it cannot take new work)
+            self.router.note_retry()
+            req.done = False
+            req.finish_reason = None
+            idx2, _ = self.router.pick_replica(None, loads,
+                                               exclude=(idx,))
+            self.replicas[idx2].submit_request(req)
+            self.router.note_dispatch(idx2, False)
+            self._owner[req.rid] = idx2
+            if req.done:
+                # the healthiest replica is shedding too: the cluster
+                # really is overloaded — surface the rejection
+                req.finish_reason = FinishReason.REJECTED_OVERLOAD.value
+        if not (req.done and req.finish_reason ==
+                FinishReason.REJECTED_OVERLOAD.value):
+            # the fair-share account charges only work a replica
+            # actually accepted — a tenant whose requests are shed
+            # during a degraded blip must not also sink in the
+            # dispatch order for service it never received
+            self.router.charge(entry["tenant"], entry["cost"])
+
+    # ---- stepping ----
+    def step(self) -> bool:
+        """One cluster step: dispatch the router queue, step every
+        serviceable replica (a replica whose circuit opens fails over
+        in place), harvest completed prefills into decode replicas,
+        publish replica load gauges. Returns False when no work remains
+        anywhere."""
+        self._dispatch()
+        for i in range(len(self.replicas)):
+            sup = self.replicas[i]
+            if sup.health == "dead" or sup._draining:
+                continue
+            try:
+                sup.step()
+            except EngineDead:
+                self._failover(i)
+        if self.prefill_replicas:
+            self._harvest_handoffs()
+        self._publish()
+        self._prune_finished()
+        self._steps += 1
+        return self._has_work()
+
+    def run(self) -> None:
+        """Drive steps until every submitted request finished."""
+        while self.step():
+            pass
+
+    def _prune_finished(self) -> None:
+        """Drop router bookkeeping for finished requests (the results
+        live on the callers' handles) — without this, _live/_meta/
+        _owner would grow with every request ever served, the same
+        leak the RequestJournal's sync() avoids."""
+        for rid in [r for r, req in self._live.items() if req.done]:
+            del self._live[rid]
+            self._meta.pop(rid, None)
+            self._owner.pop(rid, None)
+
+    def _has_work(self) -> bool:
+        if any(not e["req"].done for e in self._rq):
+            return True
+        for sup in self.replicas:
+            if sup.health == "dead" or sup._draining:
+                continue
+            if (any(sup.scheduler._queues.values())
+                    or not sup.engine.idle):
+                return True
+        return False
+
+    def _publish(self):
+        """Refresh the ``serving_replica_*`` gauges — the metrics
+        registry is the cluster's signal bus (PR 1): replicas publish,
+        dashboards (and any external balancer) read."""
+        if not _obs.enabled:
+            return
+        for i, sup in enumerate(self.replicas):
+            s = sup.load_stats()
+            _obs.serving_router_replica(
+                i, s["queued_total"], s["pool_occupancy"],
+                s["degraded_level"])
+
+    # ---- prefill→decode handoff ----
+    def _harvest_handoffs(self):
+        """Move every decode-ready request off the prefill replicas:
+        export the slot's live pages (pure read), import + journal them
+        on a decode replica, then detach from the prefill side
+        (slot-clear before page-release, so no fault can leave two
+        engines decoding one request). A request that cannot place (no
+        free decode slot / pool full) stays on its prefill replica and
+        keeps decoding there — the handoff is opportunistic."""
+        decode = self._alive(self._decode_idxs())
+        if not decode:
+            return
+        for i in self._prefill_idxs():
+            sup = self.replicas[i]
+            if sup.health == "dead" or sup._draining:
+                continue
+            eng = sup.engine
+            for req in list(eng.running_requests()):
+                if (req.done or not req.tokens
+                        or req.slot in eng._pending):
+                    continue
+                try:
+                    self._handoff_one(sup, req, decode)
+                except EngineDead:
+                    self._failover(i)
+                    break
+                except Exception as exc:  # noqa: BLE001 — injected or
+                    # real fault on the PREFILL side of the handoff
+                    # (page release inside finish_handoff; decode-side
+                    # faults are attributed inside _handoff_one): route
+                    # it through the prefill supervisor's
+                    # classify+recover machinery, same as a step fault.
+                    # The request is safe: finish_handoff clears the
+                    # slot before anything fallible, and the journal
+                    # already moved to the decode side.
+                    try:
+                        sup._on_failure(exc)
+                    except EngineDead:
+                        self._failover(i)
+                    # recovery REBUILT the engine: the remaining
+                    # snapshot entries are no longer running there
+                    # (they were requeued), so exporting them now
+                    # would raise and masquerade as fresh failures —
+                    # stop and let the next step re-harvest
+                    break
+
+    def _handoff_one(self, sup, req, decode_loads: Dict[int, Dict]):
+        eng = sup.engine
+        t0 = _obs.generate_begin()
+        payload = eng.export_prefilled(req)     # pure host-side read
+        nbytes = sum(a.nbytes for a in payload["kv"]["arrays"].values())
+        pages = payload["kv"]["num_pages"]
+        _obs.serving_handoff_export(t0, nbytes, pages)
+        placed = None
+        for didx in sorted(decode_loads,
+                           key=lambda d: self.router._score(
+                               decode_loads[d]) + (d,)):
+            dsup = self.replicas[didx]
+            t1 = _obs.generate_begin()
+            try:
+                if dsup.engine.import_prefilled(req, payload):
+                    placed = didx
+                    _obs.serving_handoff_import(t1)
+                    break
+            except PoolExhausted:
+                continue                # full pool: try the next replica
+            except EngineDead:
+                self._failover(didx)
+                continue
+            except Exception as exc:  # noqa: BLE001 — a fault inside
+                # the DECODE-side import (allocator, scatter) is that
+                # replica's failure: its supervisor pays the recovery
+                # and its circuit counts it — never the healthy prefill
+                # replica's. The request is untouched (import cleans up
+                # its allocations before re-raising).
+                try:
+                    dsup._on_failure(exc)
+                except EngineDead:
+                    self._failover(didx)
+                continue
+        if placed is None:
+            return                      # keep decoding on the prefill side
+        dsup = self.replicas[placed]
+        dsup.adopt_running(req)
+        self._owner[req.rid] = placed
+        sup.journal.forget(req.rid)
+        eng.finish_handoff(req, payload["slot"])
+        self.handoffs_total += 1
+
+    # ---- failover / rolling upgrade ----
+    def _rehome(self, entries):
+        """Re-dispatch journaled sessions from a dead/retiring replica:
+        in-flight ones re-enter elsewhere with resume semantics (the
+        PR 4 replay — token-identical), never-admitted ones go back
+        through the router queue as fresh work."""
+        rehomed = 0
+        for e in entries:
+            req = e.req
+            if req is None or (req.done
+                               and req.finish_reason != "engine_dead"):
+                continue
+            req.done = False
+            req.slot = None
+            req.tokens = list(e.tokens)
+            if e.admitted:
+                req.preemptions = e.preemptions + 1
+                req.finish_reason = FinishReason.PREEMPTED.value
+                loads = self._alive(self._decode_idxs()) or self._alive(
+                    range(len(self.replicas)))
+                idx, _ = self.router.pick_replica(None, loads)
+                self.replicas[idx].submit_request(req)
+                self.router.note_dispatch(idx, False)
+                self._owner[req.rid] = idx
+            else:
+                req.finish_reason = None
+                meta = self._meta.get(req.rid, {"tenant": "default",
+                                                "cost": 0})
+                self._rq.append({"req": req, "tenant": meta["tenant"],
+                                 "cost": meta["cost"],
+                                 "seq": self._seq})
+                self._seq += 1
+            rehomed += 1
+        _obs.serving_router_failover(rehomed)
+        return rehomed
+
+    def _failover(self, idx: int):
+        """A replica's circuit opened: rebuild it in place (fresh
+        pools, empty trie — its affinity bindings drop) and rehome its
+        journaled sessions onto the survivors. Requests the dying
+        supervisor marked ``engine_dead`` un-finish and resume
+        elsewhere — cluster-wide, nothing is lost."""
+        dead = self.replicas[idx]
+        self.failovers_total += 1
+        self.replicas[idx] = self._new_supervisor()
+        self.router.drop_replica(idx)
+        self._rehome(dead.journal.live_entries())
+
+    def retire_replica(self, idx: int, *, path: Optional[str] = None,
+                       replace: bool = True) -> Dict:
+        """Rolling drain/upgrade: drain replica ``idx`` through the
+        PR 8 drain path (journal + prefix-trie checkpoint to one
+        ``.npz``), requeue its live sessions onto other replicas
+        MID-DECODE (resume semantics — they finish token-identically),
+        and — with ``replace`` — install a fresh replica with the
+        drained prefix trie restored, so the tenant's next prompt still
+        prefix-HITs and the router's affinity bindings stay valid.
+        Returns the drain summary."""
+        if not replace:
+            # count SERVICEABLE survivors, not list length — drained
+            # husks stay in self.replicas, so repeated non-replace
+            # retirements would otherwise drain the whole cluster
+            # through this guard one replica at a time
+            survivors = [i for i, s in enumerate(self.replicas)
+                         if i != idx and s.health != "dead"
+                         and not s._draining]
+            if not survivors:
+                raise ValueError(
+                    "retire_replica(replace=False) would leave no "
+                    "serviceable replica — nothing left to serve or "
+                    "absorb the drained sessions")
+        sup = self.replicas[idx]
+        tmp = None
+        if path is None:
+            fd, tmp = tempfile.mkstemp(suffix=".npz",
+                                       prefix="retire_replica_")
+            os.close(fd)
+            path = tmp
+        try:
+            summary = sup.drain(path)
+            entries = sup.journal.live_entries()
+            if replace:
+                new = self._new_supervisor()
+                ckpt = load_drain_checkpoint(path)
+                if ckpt["prefix"] is not None:
+                    new.engine.cache.restore_prefix(ckpt["prefix"])
+                self.replicas[idx] = new
+            else:
+                self.router.drop_replica(idx)
+            summary["rehomed"] = self._rehome(entries)
+            self.retirements_total += 1
+            return summary
+        finally:
+            if tmp is not None and os.path.exists(tmp):
+                os.unlink(tmp)
+
+    # ---- introspection ----
+    def stats(self) -> Dict:
+        per = []
+        for i, sup in enumerate(self.replicas):
+            s = sup.load_stats()
+            s["role"] = ("prefill" if i < self.prefill_replicas
+                         else "decode")
+            per.append(s)
+        return {
+            "replicas": len(self.replicas),
+            "prefill_replicas": self.prefill_replicas,
+            "cluster_steps": self._steps,
+            "router_queued": len(self._rq),
+            "handoffs_total": self.handoffs_total,
+            "failovers_total": self.failovers_total,
+            "retirements_total": self.retirements_total,
+            "deadline_cancels_total": self.deadline_cancels_total,
+            "router": self.router.stats(),
+            "per_replica": per,
+        }

@@ -43,6 +43,21 @@ from .resilience import fault_point
 TRASH_PAGE = 0
 
 
+def _pool_scatter(pool: Dict, vals: Dict, dst):
+    """The KV-import scatter program: write ``vals`` (per-array page
+    payloads, shape ``(L, k, page, ...)``) into the pool at page ids
+    ``dst`` — ONE donated jitted program so XLA updates the (GB-scale)
+    pool buffers in place instead of re-materializing them. Shared by
+    :meth:`PagedKVCache.restore_prefix` (drain/restore) and
+    :meth:`PagedKVCache.import_request` (the prefill→decode handoff),
+    and Mosaic-lowered by ``tools/aot_validate.py --config
+    serving-cluster`` — one program, one lowering gate."""
+    import jax.numpy as jnp
+    return {name: arr.at[:, dst].set(jnp.asarray(vals[name])
+                                     .astype(arr.dtype))
+            for name, arr in pool.items()}
+
+
 def pool_partition_specs(pool: Dict, axis: str = "tp") -> Dict:
     """Per-array PartitionSpecs sharding a paged pool on its KV-HEAD
     axis: k/v pages are ``(L, P, page, nkv, hd)`` (head axis 3), the
@@ -515,6 +530,7 @@ class PagedKVCache:
         self.prefix = PrefixCache(page_size) if enable_prefix_cache else None
         self.cow_copies = 0
         self._cow_fn = None                     # jitted CoW row copier
+        self._scatter_fn = None                 # jitted page-import scatter
         # TRASH_PAGE-filled tables: unassigned entries route to trash
         self.block_tables = np.full((max_batch, self.pages_per_seq),
                                     TRASH_PAGE, np.int32)
@@ -745,26 +761,124 @@ class PagedKVCache:
             raise ValueError(
                 "restore_prefix into a cache with prefix caching "
                 "disabled (enable_prefix_cache=False)")
-        import jax
-        import jax.numpy as jnp
         old_ids = [int(p) for p in ckpt["page_ids"]]
         fresh = self.allocator.alloc(len(old_ids))
         page_map = dict(zip(old_ids, fresh))
-
-        def write(pool, vals, dst):
-            return {name: arr.at[:, dst].set(
-                jnp.asarray(vals[name]).astype(arr.dtype))
-                for name, arr in pool.items()}
-
-        self.pool = jax.jit(write, donate_argnums=(0,))(
-            self.pool,
-            {n: np.ascontiguousarray(a)
-             for n, a in ckpt["arrays"].items()},
-            jnp.asarray(np.asarray(fresh, np.int32)))
+        self._scatter_pages(ckpt["arrays"], fresh)
         self.prefix.restore_records(ckpt["records"], page_map,
                                     self.allocator)
         self.allocator.free(fresh)      # the trie owns the pages now
         return len(fresh)
+
+    def _scatter_pages(self, arrays: Dict, dst: Sequence[int]):
+        """Write per-array page payloads into the pool at ids ``dst``
+        through the shared donated :func:`_pool_scatter` program (one
+        compile per payload shape; carried across supervisor rebuilds
+        like the CoW copier)."""
+        import jax
+        import jax.numpy as jnp
+        if self._scatter_fn is None:
+            kw = {}
+            if self.mesh is not None:
+                # keep the pool's kv-head sharding through the donated
+                # update: without the constraint the compiler may pick
+                # a fresh layout and the next shard_map step would
+                # silently pay a reshard of the whole pool
+                from jax.sharding import NamedSharding
+                kw["out_shardings"] = {
+                    n: NamedSharding(self.mesh, self.pool_specs[n])
+                    for n in self.pool}
+            self._scatter_fn = jax.jit(_pool_scatter,
+                                       donate_argnums=(0,), **kw)
+        self.pool = self._scatter_fn(
+            self.pool,
+            {n: np.ascontiguousarray(a) for n, a in arrays.items()},
+            jnp.asarray(np.asarray(dst, np.int32)))
+
+    # ---- KV handoff (ISSUE 9): per-request page export/import ----
+    def export_request(self, slot: int) -> Dict:
+        """Export one ACTIVE slot's live KV pages as a serializable
+        handoff payload — the prefill→decode transfer unit of the
+        disaggregated cluster, generalizing :meth:`checkpoint_prefix`
+        from trie chains to an ARBITRARY per-request block table. Only
+        the pages covering ``lengths[slot]`` tokens travel (the tail
+        reservation holds no KV yet); array bytes ride as raw uint8
+        views + dtype/shape metadata so extension dtypes (bf16) and
+        cross-host transports round-trip exactly. Pure read — the
+        slot's pages, tables and refcounts are untouched."""
+        if not self.active[slot]:
+            raise ValueError(f"export_request of inactive slot {slot}")
+        length = int(self.lengths[slot])
+        if length <= 0:
+            raise ValueError(
+                f"export_request of slot {slot} with no committed "
+                f"tokens — hand off only after prefill completes")
+        k = self.pages_for(length)
+        sel = np.asarray(self._slot_pages[slot][:k], np.int32)
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Dict] = {}
+        for name, arr in self.pool.items():
+            a = np.ascontiguousarray(np.asarray(arr[:, sel]))
+            arrays[name] = np.frombuffer(a.tobytes(), np.uint8)
+            meta[name] = {"shape": list(a.shape), "dtype": str(a.dtype)}
+        return {"page_size": self.page_size, "num_pages": k,
+                "length": length, "arrays": arrays, "meta": meta}
+
+    def import_request(self, slot: int, payload: Dict,
+                       total_tokens: int) -> np.ndarray:
+        """Admit ``slot`` with the full ``total_tokens`` page budget and
+        scatter a :meth:`export_request` payload's KV bytes into the
+        leading pages (the shared donated :func:`_pool_scatter`
+        program) — the decode-side half of the prefill→decode handoff,
+        BIT-identical to having prefilled in place (raw bytes in, raw
+        bytes out; page ids differ but the block table makes content
+        position-addressed). Geometry and dtype are validated LOUDLY
+        before any allocation; returns the slot's block-table row.
+        Callers set ``lengths[slot]`` from the payload."""
+        from .resilience import _np_dtype
+        n = self._check_admit(slot, total_tokens)
+        k = int(payload["num_pages"])
+        if payload["page_size"] != self.page_size:
+            raise ValueError(
+                f"import_request: payload page_size="
+                f"{payload['page_size']} != pool page_size="
+                f"{self.page_size} — prefill and decode replicas must "
+                f"share page geometry")
+        if k > n:
+            raise ValueError(
+                f"import_request: payload holds {k} pages but "
+                f"total_tokens={total_tokens} only budgets {n}")
+        if set(payload["meta"]) != set(self.pool):
+            raise ValueError(
+                f"import_request: payload arrays "
+                f"{sorted(payload['meta'])} != pool arrays "
+                f"{sorted(self.pool)} — kv-dtype tiers of the two "
+                f"replicas differ")
+        arrays = {}
+        for name, m in payload["meta"].items():
+            if m["dtype"] != str(self.pool[name].dtype):
+                raise ValueError(
+                    f"import_request: payload {name} dtype "
+                    f"{m['dtype']} != pool dtype "
+                    f"{self.pool[name].dtype} — a silent cast would "
+                    f"break the handoff bit-identity gate")
+            a = np.frombuffer(bytes(payload["arrays"][name]),
+                              _np_dtype(m["dtype"])).reshape(m["shape"])
+            want = self.pool[name].shape
+            got = tuple(a.shape)
+            if got[0] != want[0] or got[1] != k or got[2:] != want[2:]:
+                raise ValueError(
+                    f"import_request: payload {name} shape {got} does "
+                    f"not match pool page shape "
+                    f"{(want[0], k) + tuple(want[2:])}")
+            arrays[name] = a
+        pages = self._alloc_with_evict(n)
+        try:
+            self._scatter_pages(arrays, pages[:k])
+        except Exception:
+            self.allocator.free(pages)
+            raise
+        return self._install(slot, pages)
 
     def defrag(self):
         """Compact used pages to the front of the pool: one device

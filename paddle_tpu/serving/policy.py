@@ -46,6 +46,7 @@ class FinishReason(str, enum.Enum):
     PREEMPTED = "preempted"                   # transient: evicted, will resume
     DEADLINE_EXCEEDED = "deadline_exceeded"   # cancelled before admission
     REJECTED_OVERLOAD = "rejected_overload"   # shed by a degraded supervisor
+    REJECTED_RATELIMIT = "rejected_ratelimit" # over the tenant's token quota
 
     def __str__(self) -> str:  # pragma: no cover - cosmetic
         return self.value

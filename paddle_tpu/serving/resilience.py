@@ -335,6 +335,13 @@ class RequestJournal:
         self._entries[req.rid] = e
         return e
 
+    def forget(self, rid: int) -> None:
+        """Drop a live entry WITHOUT counting it finished — the
+        handoff path: a request exported to another replica is that
+        replica's journal's to recover now, and recovering it here too
+        would decode it twice."""
+        self._entries.pop(rid, None)
+
     def sync(self) -> None:
         """Copy committed host state from the live request handles;
         finished requests leave the journal (their results live on the
@@ -374,6 +381,33 @@ def _np_dtype(name: str) -> np.dtype:
     except TypeError:
         import ml_dtypes
         return np.dtype(getattr(ml_dtypes, name))
+
+
+def load_drain_checkpoint(path: str) -> Dict:
+    """Decode a :meth:`EngineSupervisor.drain` ``.npz`` back into host
+    data: ``meta`` (sessions, geometry, next_rid), ``key_data`` (PRNG
+    snapshot, empty when none) and — when a prefix trie was
+    checkpointed — ``prefix`` in the exact dict shape
+    :meth:`~paddle_tpu.serving.PagedKVCache.restore_prefix` consumes.
+    Shared by :meth:`EngineSupervisor.restore` (whole-supervisor
+    restore) and the cluster's rolling upgrade
+    (:meth:`~paddle_tpu.serving.cluster.ServingCluster.retire_replica`
+    restores ONLY the trie into the replacement replica — the sessions
+    were requeued live onto other replicas)."""
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["meta"]).decode())
+        key_data = np.asarray(data["key_data"])
+        prefix = None
+        if meta["prefix"] is not None:
+            pf = meta["prefix"]
+            arrays = {
+                n: np.frombuffer(
+                    bytes(data[f"prefix_{n}"]),
+                    _np_dtype(pf["dtypes"][n])).reshape(pf["shapes"][n])
+                for n in pf["shapes"]}
+            prefix = {"page_ids": pf["page_ids"],
+                      "records": pf["records"], "arrays": arrays}
+    return {"meta": meta, "key_data": key_data, "prefix": prefix}
 
 
 class EngineSupervisor:
@@ -497,6 +531,7 @@ class EngineSupervisor:
             eng._chunk_fns = old._chunk_fns
             eng._spec_fns = old._spec_fns
             eng.cache._cow_fn = old.cache._cow_fn
+            eng.cache._scatter_fn = old.cache._scatter_fn
         if self._key_data is not None:
             import jax
             import jax.numpy as jnp
@@ -543,6 +578,11 @@ class EngineSupervisor:
         elif self._chunk_shrunk:
             eng.prefill_chunk = self._chunk_shelf
             self._chunk_shrunk = False
+        if self.scheduler is not None:
+            # mirror the rung onto the scheduler so load_stats() is a
+            # complete health snapshot (the router's signal) even with
+            # the metrics registry disabled
+            self.scheduler.degraded_level = self.degraded_level
         _obs.serving_degraded(self.degraded_level)
 
     def _escalate(self):
@@ -570,23 +610,48 @@ class EngineSupervisor:
         with the structured ``rejected_overload`` finish reason instead
         of queueing into an engine that keeps failing."""
         self._check_alive()
-        if (self.degraded_level >= 3
-                and int(priority) >= int(Priority.LOW)):
-            req = self.engine.create_request(
-                prompt, max_new_tokens=max_new_tokens,
-                eos_token_id=eos_token_id)
-            req.priority = int(priority)
+        req = self.engine.create_request(
+            prompt, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id)
+        req.priority = int(priority)
+        self._next_rid = self.engine._next_rid
+        return self.submit_request(req, deadline_s=deadline_s)
+
+    def submit_request(self, req, *, deadline_s: Optional[float] = None):
+        """Journaled intake of an EXISTING request handle — the
+        cluster router's dispatch (and re-dispatch) path (ISSUE 9).
+        The shed-LOW ladder applies only to FRESH requests: a handle
+        that already committed tokens (or was preempted) is in-flight
+        work being rehomed, and shedding it would lose it."""
+        self._check_alive()
+        fresh = not req.tokens and req.preemptions == 0
+        if (fresh and self.degraded_level >= 3
+                and int(req.priority) >= int(Priority.LOW)):
             req.done = True
             req.finish_reason = FinishReason.REJECTED_OVERLOAD.value
             self.shed_total += 1
-            self._next_rid = self.engine._next_rid
             _obs.serving_cancelled(1, req.finish_reason)
             return req
-        req = self.scheduler.submit(
-            prompt, max_new_tokens=max_new_tokens, priority=priority,
-            deadline_s=deadline_s, eos_token_id=eos_token_id)
-        self._next_rid = self.engine._next_rid
+        self.engine._next_rid = max(self.engine._next_rid, req.rid + 1)
+        self._next_rid = max(self._next_rid, self.engine._next_rid)
+        if deadline_s is not None:
+            req.deadline_at = self.clock() + float(deadline_s)
+        self.scheduler.requeue(req)
         self.journal.record_submit(req)
+        return req
+
+    def adopt_running(self, req):
+        """Journal a request installed DIRECTLY into a running slot
+        (the decode side of a prefill→decode handoff —
+        :meth:`~paddle_tpu.inference.ContinuousBatchingEngine.import_prefilled`
+        bypasses the admission queue): from here this supervisor owns
+        its recovery (a crash replays ``prompt + tokens[:-1]`` through
+        THIS engine's continuation prefill, token-identically)."""
+        self._check_alive()
+        self.engine._next_rid = max(self.engine._next_rid, req.rid + 1)
+        self._next_rid = max(self._next_rid, self.engine._next_rid)
+        e = self.journal.record_submit(req)
+        e.admitted = True
         return req
 
     # ---- stepping ----
@@ -688,6 +753,8 @@ class EngineSupervisor:
             if req is not None and not req.done:
                 req.done = True
                 req.finish_reason = "engine_dead"
+        if self.scheduler is not None:
+            self.scheduler.degraded_level = len(DEGRADED_MODES)
         _obs.serving_degraded(len(DEGRADED_MODES))  # off-ladder: dead
         raise EngineDead(
             f"circuit breaker open after {self._consec_failures} "
@@ -796,42 +863,33 @@ class EngineSupervisor:
         in ``.restored`` (rid -> request)."""
         sup = cls(engine_factory, **kw)
         t0 = _obs.generate_begin()
-        with np.load(path) as data:
-            meta = json.loads(bytes(data["meta"]).decode())
-            cache = sup.engine.cache
-            for knob in ("page_size", "max_len", "max_batch"):
-                if meta[knob] != getattr(cache, knob):
-                    raise ValueError(
-                        f"restore: checkpoint {knob}={meta[knob]} does "
-                        f"not match the fresh engine's "
-                        f"{getattr(cache, knob)} — the factory must "
-                        f"rebuild the drained engine's geometry")
-            kv = (str(np.dtype(cache.kv_dtype))
-                  if cache.kv_dtype is not None else None)
-            if meta["kv_dtype"] != kv:
+        ckpt = load_drain_checkpoint(path)
+        meta = ckpt["meta"]
+        cache = sup.engine.cache
+        for knob in ("page_size", "max_len", "max_batch"):
+            if meta[knob] != getattr(cache, knob):
                 raise ValueError(
-                    f"restore: checkpoint kv_dtype={meta['kv_dtype']} "
-                    f"!= engine kv_dtype={kv}")
-            key_data = np.asarray(data["key_data"])
-            if key_data.size:
-                import jax
-                import jax.numpy as jnp
-                sup._key_data = key_data
-                sup.engine._key = jax.random.wrap_key_data(
-                    jnp.asarray(key_data))
-            n_pages = 0
-            if meta["prefix"] is not None:
-                pf = meta["prefix"]
-                arrays = {
-                    n: np.frombuffer(
-                        bytes(data[f"prefix_{n}"]),
-                        _np_dtype(pf["dtypes"][n])).reshape(
-                            pf["shapes"][n])
-                    for n in pf["shapes"]}
-                cache.restore_prefix({"page_ids": pf["page_ids"],
-                                      "records": pf["records"],
-                                      "arrays": arrays})
-                n_pages = len(pf["page_ids"])
+                    f"restore: checkpoint {knob}={meta[knob]} does "
+                    f"not match the fresh engine's "
+                    f"{getattr(cache, knob)} — the factory must "
+                    f"rebuild the drained engine's geometry")
+        kv = (str(np.dtype(cache.kv_dtype))
+              if cache.kv_dtype is not None else None)
+        if meta["kv_dtype"] != kv:
+            raise ValueError(
+                f"restore: checkpoint kv_dtype={meta['kv_dtype']} "
+                f"!= engine kv_dtype={kv}")
+        key_data = ckpt["key_data"]
+        if key_data.size:
+            import jax
+            import jax.numpy as jnp
+            sup._key_data = key_data
+            sup.engine._key = jax.random.wrap_key_data(
+                jnp.asarray(key_data))
+        n_pages = 0
+        if ckpt["prefix"] is not None:
+            cache.restore_prefix(ckpt["prefix"])
+            n_pages = len(ckpt["prefix"]["page_ids"])
         sup._next_rid = int(meta["next_rid"])
         sup.engine._next_rid = max(sup.engine._next_rid, sup._next_rid)
         from ..inference.predictor import GenerationRequest
@@ -859,6 +917,23 @@ class EngineSupervisor:
         return sup
 
     # ---- introspection ----
+    def load_stats(self) -> Dict:
+        """The scheduler's structured load snapshot
+        (:meth:`~paddle_tpu.serving.ServingScheduler.load_stats`) plus
+        the supervisor's own health/draining state — the per-replica
+        signal the cluster router dispatches by."""
+        s = (self.scheduler.load_stats()
+             if self.scheduler is not None else {
+                 "queue_depths": {}, "queued_total": 0, "running": 0,
+                 "pending_prefills": 0, "free_slots": 0,
+                 "oldest_deadline_slack_s": None, "pool_occupancy": 1.0,
+                 "pool_free_pages": 0,
+                 "degraded_level": len(DEGRADED_MODES),
+                 "degraded_mode": "dead"})
+        s["health"] = self.health
+        s["draining"] = self._draining
+        return s
+
     def stats(self) -> Dict:
         s = self.scheduler.stats() if self.scheduler is not None else {}
         s.update({

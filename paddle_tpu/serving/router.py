@@ -1,0 +1,215 @@
+"""Cluster routing policy: prefix affinity, load/SLO-aware placement,
+per-tenant fair share and rate limits (ISSUE 9).
+
+Pure host-side policy — no jax, no engine internals — consumed by
+:class:`paddle_tpu.serving.cluster.ServingCluster`. The router's only
+view of a replica is the structured
+:meth:`~paddle_tpu.serving.ServingScheduler.load_stats` snapshot the
+cluster hands it (queue depths, pool occupancy, degraded-mode rung) —
+it reads signals, it never pokes engine state.
+
+- **Prefix affinity** — the dispatch key is the prompt's leading
+  FULL pages (page-aligned, exactly the span the
+  :class:`~paddle_tpu.serving.PrefixCache` trie can hold), hashed as
+  raw token bytes. The first dispatch of a key binds it to the chosen
+  replica; later requests with the same system prompt follow the
+  binding to the replica whose trie already holds their prefix KV
+  (admission maps the shared pages instead of re-prefilling them).
+  Unbound keys fall back to least-loaded placement.
+- **Load/SLO-aware placement** — replicas order by ``(degraded rung,
+  backlog, pool occupancy)``; the healthiest wins. Affinity outranks
+  load (prefix locality is worth a longer queue — the shed-retry path
+  in the cluster is the safety net when a bound replica degrades all
+  the way to ``shed_low``), but never a dead or draining replica.
+- **Fair share** — a per-tenant token account (prompt + budgeted new
+  tokens, charged at dispatch). The cluster dispatches its queue in
+  ascending-account order, which bounds starvation: a light tenant's
+  request outranks every queued request of any tenant that has already
+  consumed more tokens, no matter how many the heavy tenant submitted
+  first.
+- **Rate limits** — an optional per-tenant :class:`TenantQuota`
+  (tokens per fixed window); an over-quota submission finishes
+  ``rejected_ratelimit`` without touching any replica.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import hooks as _obs
+
+
+class TenantQuota:
+    """Token budget per tenant per FIXED window (anchored at the
+    tenant's first charge, reset once ``window_s`` elapses — so a
+    worst-case boundary burst can reach 2x the quota inside one
+    wall-clock window, the standard fixed-window trade): a submission
+    costs ``prompt tokens + max_new_tokens`` (the pages it could pin),
+    and a window's spend above ``tokens_per_window`` rejects further
+    submissions until the window rolls."""
+
+    def __init__(self, tokens_per_window: int, window_s: float = 60.0):
+        if tokens_per_window < 1:
+            raise ValueError(
+                f"TenantQuota: tokens_per_window={tokens_per_window} "
+                f"must be >= 1")
+        self.tokens_per_window = int(tokens_per_window)
+        self.window_s = float(window_s)
+
+
+class ClusterRouter:
+    """Placement + accounting policy for a :class:`ServingCluster`.
+
+    ``page_size`` aligns affinity keys with the replicas' prefix tries;
+    ``affinity_pages`` caps how many leading full pages feed the key
+    (system prompts longer than the cap still share — the key is a
+    routing hint, the trie matches the full span). ``quotas`` maps
+    tenant name -> :class:`TenantQuota`; absent tenants are unlimited.
+    ``clock`` is injectable (monotonic seconds) so windows are
+    testable.
+    """
+
+    def __init__(self, page_size: int, *, affinity_pages: int = 2,
+                 max_bindings: int = 65536,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.affinity_pages = max(1, int(affinity_pages))
+        self.max_bindings = max(1, int(max_bindings))
+        self.quotas = dict(quotas or {})
+        self.clock = clock
+        # LRU-bounded (dict insertion order = recency; hits re-insert):
+        # mostly-unique prompts would otherwise bind one entry per
+        # request forever — the same leak class _prune_finished and
+        # journal.sync exist to prevent. An evicted binding costs one
+        # affinity miss on the tenant's next request, nothing more.
+        self._affinity: Dict[bytes, int] = {}
+        self._windows: Dict[str, Tuple[float, int]] = {}
+        #: tenant -> tokens dispatched (the fair-share deficit counter)
+        self.accounts: Dict[str, int] = {}
+        self.dispatch_by_replica: Dict[int, int] = {}
+        self.dispatches_total = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.retries_total = 0
+        self.ratelimited_total = 0
+
+    # ---- prefix affinity ----
+    def affinity_key(self, prompt) -> Optional[bytes]:
+        """The prompt's prefix-trie-aligned dispatch key: its leading
+        full pages' raw token bytes (capped at ``affinity_pages``).
+        None for prompts shorter than one page — nothing the trie
+        could share."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        k = min(prompt.size // self.page_size, self.affinity_pages)
+        if k < 1:
+            return None
+        return prompt[:k * self.page_size].tobytes()
+
+    def drop_replica(self, idx: int) -> int:
+        """Forget every affinity binding to ``idx`` (its trie died with
+        it — a dead replica's bindings would keep routing a tenant to
+        guaranteed prefix misses). Returns bindings dropped. A RETIRED
+        replica whose trie was restored into its replacement keeps its
+        bindings instead."""
+        dead = [k for k, v in self._affinity.items() if v == idx]
+        for k in dead:
+            del self._affinity[k]
+        return len(dead)
+
+    # ---- placement ----
+    @staticmethod
+    def _score(load: Dict) -> Tuple:
+        """Health-then-load ordering: degraded rung first (a shedding
+        replica is worse than a long queue), then total backlog, then
+        pool occupancy."""
+        return (load.get("degraded_level", 0),
+                load.get("queued_total", 0) + load.get("running", 0)
+                + load.get("pending_prefills", 0),
+                load.get("pool_occupancy", 0.0))
+
+    def pick_replica(self, key: Optional[bytes], loads: Dict[int, Dict],
+                     exclude: Sequence[int] = ()) -> Tuple[int, bool]:
+        """Choose a replica from ``loads`` (idx -> load_stats snapshot
+        of the ALIVE candidates): the affinity binding for ``key`` when
+        it points at a candidate, else the healthiest/least-loaded
+        (which then becomes the binding). Returns ``(idx,
+        affinity_hit)``."""
+        cands = {i: s for i, s in loads.items() if i not in set(exclude)}
+        if not cands:
+            raise ValueError("pick_replica: no eligible replicas")
+        if key is not None:
+            bound = self._affinity.get(key)
+            if bound in cands:
+                del self._affinity[key]         # LRU touch: move to
+                self._affinity[key] = bound     # the recent end
+                self.affinity_hits += 1
+                return bound, True
+        idx = min(cands, key=lambda i: self._score(cands[i]) + (i,))
+        if key is not None:
+            while len(self._affinity) >= self.max_bindings:
+                self._affinity.pop(next(iter(self._affinity)))
+            self._affinity[key] = idx
+            self.affinity_misses += 1
+        return idx, False
+
+    # ---- accounting ----
+    def admit_rate_limit(self, tenant: str, cost: int) -> bool:
+        """Charge ``cost`` tokens against ``tenant``'s quota window;
+        False (and nothing charged) when it would overflow. Unlimited
+        tenants always pass."""
+        quota = self.quotas.get(tenant)
+        if quota is None:
+            return True
+        now = self.clock()
+        start, spent = self._windows.get(tenant, (now, 0))
+        if now - start >= quota.window_s:
+            start, spent = now, 0
+        if spent + cost > quota.tokens_per_window:
+            self._windows[tenant] = (start, spent)
+            return False
+        self._windows[tenant] = (start, spent + cost)
+        return True
+
+    def charge(self, tenant: str, cost: int):
+        """Debit the fair-share account once a replica ACCEPTS the
+        dispatch (shed work is never charged). The cluster dispatches
+        per-tenant FIFO queues in ascending-account order — that
+        ordering is the fairness mechanism; priority classes stay the
+        REPLICA scheduler's job (the router's fairness is across
+        tenants, not within one)."""
+        self.accounts[tenant] = self.accounts.get(tenant, 0) + int(cost)
+
+    # ---- telemetry (the serving_router_* hook family) ----
+    def note_dispatch(self, replica: int, affinity_hit: bool):
+        self.dispatches_total += 1
+        self.dispatch_by_replica[replica] = \
+            self.dispatch_by_replica.get(replica, 0) + 1
+        _obs.serving_router_dispatch(replica, affinity_hit)
+
+    def note_retry(self):
+        self.retries_total += 1
+        _obs.serving_router_retry(1)
+
+    def note_ratelimited(self, tenant: str):
+        self.ratelimited_total += 1
+        _obs.serving_router_ratelimited(tenant)
+
+    def stats(self) -> Dict:
+        total = self.affinity_hits + self.affinity_misses
+        return {
+            "dispatches_total": self.dispatches_total,
+            "dispatch_by_replica": dict(self.dispatch_by_replica),
+            "affinity_hits": self.affinity_hits,
+            "affinity_misses": self.affinity_misses,
+            "affinity_hit_rate": (self.affinity_hits / total
+                                  if total else 0.0),
+            "affinity_bindings": len(self._affinity),
+            "retries_total": self.retries_total,
+            "ratelimited_total": self.ratelimited_total,
+            "tenant_accounts": dict(self.accounts),
+        }

@@ -53,7 +53,7 @@ from ..observability import hooks as _obs
 from .paged_cache import PoolExhausted
 from .policy import (FinishReason, PreemptionPolicy, Priority, StepPlan,
                      TokenBudgetPlanner)
-from .resilience import fault_point
+from .resilience import DEGRADED_MODES, fault_point
 
 
 class ServingScheduler:
@@ -105,6 +105,12 @@ class ServingScheduler:
         self.preemptions_total = 0
         self.resumes_total = 0
         self.deadline_cancels_total = 0
+        # the engine's degraded-mode rung, mirrored here by whoever
+        # owns the ladder (EngineSupervisor._apply_degraded) so
+        # load_stats() is a complete health snapshot — previously the
+        # rung was only observable through the metrics registry, which
+        # a router cannot read when metrics are disabled
+        self.degraded_level = 0
 
     # ---- intake ----
     def submit(self, prompt, max_new_tokens: int = 16, *,
@@ -340,6 +346,38 @@ class ServingScheduler:
         cancelled by its deadline)."""
         while self.step():
             pass
+
+    def load_stats(self) -> Dict:
+        """One structured load/health snapshot — the PUBLIC surface a
+        multi-replica router reads (ISSUE 9): per-class queue depths,
+        the tightest queued deadline's remaining slack, slot and page
+        occupancy, and the degraded-mode rung. Everything here is host
+        bookkeeping (no device sync); the router never reaches into
+        engine internals."""
+        now = self.clock()
+        eng = self.engine
+        alloc = eng.cache.allocator
+        depths = {int(p): len(q) for p, q in self._queues.items() if q}
+        slack = None
+        for q in self._queues.values():
+            for r in q:
+                if r.deadline_at is not None and not r.done:
+                    s = r.deadline_at - now
+                    slack = s if slack is None else min(slack, s)
+        level = self.degraded_level
+        return {
+            "queue_depths": depths,
+            "queued_total": sum(depths.values()),
+            "running": len(eng.running_requests()),
+            "pending_prefills": len(eng.pending_prefills()),
+            "free_slots": len(eng.cache.free_slots()),
+            "oldest_deadline_slack_s": slack,
+            "pool_occupancy": alloc.utilization(),
+            "pool_free_pages": alloc.num_free,
+            "degraded_level": level,
+            "degraded_mode": (DEGRADED_MODES[level]
+                              if level < len(DEGRADED_MODES) else "dead"),
+        }
 
     def stats(self) -> Dict:
         s = self.engine.stats()

@@ -489,6 +489,72 @@ def validate_serving_tp(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_cluster(n: int, batch_mult: int = 1):
+    """ISSUE 9 disaggregated-cluster lowering gate: AOT-export the
+    KV-import scatter program — ``serving.paged_cache._pool_scatter``,
+    the EXACT donated program ``PagedKVCache.import_request`` (the
+    prefill→decode handoff) and ``restore_prefix`` (drain/restore) run
+    — to the TPU platform, at fp and int8-KV pool layouts and at a
+    kv-head-SHARDED tp=2 pool (shared ``pool_partition_specs`` layout,
+    so this gate can never validate a divergent sharding). Pure-XLA
+    scatter: export completing is the gate; the donated pool must
+    update in place (a re-materializing lowering would move the whole
+    GB-scale pool per handoff)."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.serving.paged_cache import (_pool_scatter,
+                                                pool_partition_specs)
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    skipped = {}
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    B, pg, k = 8, 16, 4          # k pages per handoff payload
+
+    def export_scatter(tag, kv=None, tp=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv,
+                                    tp=tp)
+        if tp is not None:
+            from jax.sharding import NamedSharding
+            from paddle_tpu.distributed.mesh import serving_mesh
+            mesh = serving_mesh(tp)
+            pspecs = pool_partition_specs(pool, "tp")
+            pool = {nm: jax.device_put(
+                a, NamedSharding(mesh, pspecs[nm]))
+                for nm, a in pool.items()}
+        vals = {nm: np.zeros((a.shape[0], k) + a.shape[2:],
+                             a.dtype) for nm, a in pool.items()}
+        dst = jnp.asarray(rs.choice(np.arange(1, 2 * B), k,
+                                    replace=False).astype(np.int32))
+        jax.export.export(
+            jax.jit(_pool_scatter, donate_argnums=(0,)),
+            platforms=["tpu"])(pool, vals, dst)
+        lowered[tag] = True
+
+    export_scatter("kv_import_scatter_fp")
+    export_scatter("kv_import_scatter_int8", kv="int8")
+    ndev = len(jax.devices())
+    if ndev >= 2:
+        export_scatter("kv_import_scatter_tp2_sharded", tp=2)
+    else:
+        skipped["kv_import_scatter_tp2_sharded"] = (
+            f"--devices {ndev} < tp=2; sharded scatter not exportable")
+    ok = all(lowered.values())
+    return {
+        "config": "serving_cluster_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({"skipped": skipped} if skipped else {}),
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def _impl(args) -> int:
     rows = []
 
@@ -514,6 +580,8 @@ def _impl(args) -> int:
         emit(validate_serving(args.devices, args.batch_mult))
     if args.config in ("serving-tp", "all"):
         emit(validate_serving_tp(args.devices, args.batch_mult))
+    if args.config in ("serving-cluster", "all"):
+        emit(validate_serving_cluster(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         ok = ok and (r.get("fits_v5p") is not False)
@@ -526,7 +594,8 @@ def main():
                     help="virtual chips (v5p-32 slice = 16 chips)")
     ap.add_argument("--config",
                     choices=["7b", "13b", "13b-long", "moe", "moe-pp",
-                             "serving", "serving-tp", "all"],
+                             "serving", "serving-tp", "serving-cluster",
+                             "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")

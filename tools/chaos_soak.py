@@ -259,13 +259,209 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     }
 
 
+def run_cluster_soak(seed: int = 0, requests: int = 18,
+                     replicas: int = 3, max_steps: int = 20000) -> dict:
+    """Cluster-mode soak (ISSUE 9): a multi-tenant shared-prefix
+    workload through a :class:`~paddle_tpu.serving.ServingCluster`
+    while a deterministic :class:`~paddle_tpu.serving.FaultInjector`
+    KILLS a random replica mid-soak — ``circuit_threshold``
+    consecutive armed faults at the ``sched_tick`` site blow whichever
+    replica steps next straight through its circuit breaker (the same
+    hot-path sites the single-engine soak exercises). Invariants:
+
+    - **zero lost / duplicated requests cluster-wide** — every request
+      finishes with a structured reason and a token stream EXACTLY
+      equal to its uninterrupted single-engine reference (the dead
+      replica's sessions rehome and resume token-identically);
+    - **prefix-affinity recovers** — after the replica rebuilds, fresh
+      same-tenant traffic produces prefix HITs again (counter-gated:
+      the hit-token counter and the router's affinity-hit counter both
+      advance post-rebuild);
+    - **balanced allocators** — every surviving replica drains to zero
+      pages in use with ``allocs_total == frees_total``.
+
+    Wired into tier-1 via tests/test_cluster.py::TestClusterChaosSoak.
+    """
+    import jax
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.serving import (FaultInjector, Priority,
+                                    ServingCluster)
+
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
+    params = llama.init_params(jax.random.key(0), cfg)
+    rs = np.random.RandomState(seed)
+    circuit = 3
+
+    def factory():
+        return ContinuousBatchingEngine(
+            params, cfg, max_batch=2, page_size=8, max_len=48,
+            prefill_chunk=8)
+
+    # multi-tenant workload: each tenant has its own system prompt
+    # (affinity + prefix hits) plus a unique tail, three priorities
+    tenants = [f"tenant{i}" for i in range(3)]
+    sys_prompts = {t: rs.randint(3, cfg.vocab_size, (16,)).astype(
+        np.int32) for t in tenants}
+
+    def make_job():
+        t = tenants[int(rs.randint(len(tenants)))]
+        tail = rs.randint(3, cfg.vocab_size,
+                          (int(rs.randint(2, 8)),)).astype(np.int32)
+        return (t, np.concatenate([sys_prompts[t], tail]),
+                int(rs.randint(3, 6)),
+                Priority(int(rs.randint(0, 3))))
+
+    jobs = [make_job() for _ in range(requests)]
+    ref_engine = factory()
+    refs = [np.asarray(ref_engine.generate([p], max_new_tokens=m)[0])
+            for _, p, m, _ in jobs]
+
+    was = obs.metrics_enabled()
+    obs.REGISTRY.clear()
+    obs.enable()
+    t_start = time.perf_counter()
+    try:
+        cluster = ServingCluster(
+            factory, replicas=replicas,
+            supervisor_kw=dict(backoff_s=0.0, sleep=lambda s: None,
+                               circuit_threshold=circuit,
+                               recover_after=4))
+        inj = FaultInjector(seed=seed)
+        reqs = []
+        with inj:
+            for t, p, m, prio in jobs:
+                reqs.append(cluster.submit(p, max_new_tokens=m,
+                                           tenant=t, priority=prio))
+            # let traffic occupy every replica, then KILL one: arm
+            # circuit_threshold consecutive sched_tick faults — the
+            # next replica to step burns through its whole retry
+            # budget and opens its circuit (EngineDead -> failover)
+            steps = 0
+            for _ in range(3):
+                cluster.step()
+                steps += 1
+            for _ in range(circuit):
+                inj.arm("sched_tick", "raise", nth=1)
+            failovers_before = cluster.failovers_total
+            hits_before = cluster.router.affinity_hits
+            while cluster.step():
+                steps += 1
+                if steps >= max_steps:
+                    raise SoakError(f"cluster soak did not drain "
+                                    f"within {max_steps} steps")
+        if cluster.failovers_total <= failovers_before:
+            raise SoakError("the armed fault burst did not kill a "
+                            "replica — nothing failed over")
+        # post-rebuild traffic: the SAME tenants return; affinity and
+        # prefix hits must recover (references computed with the
+        # injector uninstalled)
+        hit0 = sum(obs.REGISTRY.to_json()
+                   .get("serving_prefix_hit_tokens_total", {})
+                   .get("values", {}).values())
+        post_jobs = [make_job() for _ in range(6)]
+        for t, p, m, prio in post_jobs:
+            reqs.append(cluster.submit(p, max_new_tokens=m, tenant=t,
+                                       priority=prio))
+            jobs.append((t, p, m, prio))
+        while cluster.step():
+            steps += 1
+            if steps >= max_steps:
+                raise SoakError("post-rebuild traffic did not drain")
+        for _, p, m, _ in post_jobs:
+            refs.append(np.asarray(
+                ref_engine.generate([p], max_new_tokens=m)[0]))
+        snap = obs.REGISTRY.to_json()
+    finally:
+        obs.REGISTRY.clear()
+        if not was:
+            obs.disable()
+
+    # ---- invariants ----
+    lost = [r.rid for r in reqs if not r.done or r.finish_reason is None]
+    if lost:
+        raise SoakError(f"lost requests (not done after drain): {lost}")
+    ok_reasons = {"eos", "max_len", "rejected_overload"}
+    bad = [(r.rid, r.finish_reason) for r in reqs
+           if r.finish_reason not in ok_reasons]
+    if bad:
+        raise SoakError(f"unstructured finish reasons: {bad}")
+    mismatched = []
+    for r, ref in zip(reqs, refs):
+        if r.finish_reason == "rejected_overload":
+            if r.tokens:
+                mismatched.append((r.rid, "shed request has tokens"))
+            continue
+        if not np.array_equal(r.output, ref):
+            mismatched.append((r.rid, "token stream != uninterrupted"))
+    if mismatched:
+        raise SoakError(
+            f"duplicated/diverged token streams: {mismatched}")
+    hit1 = sum(snap.get("serving_prefix_hit_tokens_total", {})
+               .get("values", {}).values())
+    if hit1 <= hit0:
+        raise SoakError(
+            f"prefix hit-rate did not recover after the replica "
+            f"rebuild (hit tokens {hit0} -> {hit1})")
+    if cluster.router.affinity_hits <= hits_before:
+        raise SoakError("router affinity hits did not advance after "
+                        "the failover")
+    unbalanced = {}
+    for i, sup in enumerate(cluster.replicas):
+        alloc = sup.engine.cache.allocator
+        if sup.engine.cache.prefix is not None:
+            sup.engine.cache.prefix.drop_all(alloc)
+        st = alloc.stats()
+        if st["num_used"] != 0 or \
+                st["allocs_total"] != st["frees_total"]:
+            unbalanced[i] = st
+    if unbalanced:
+        raise SoakError(f"allocator unbalanced after drain: "
+                        f"{unbalanced}")
+
+    return {
+        "seed": seed,
+        "mode": "cluster",
+        "replicas": replicas,
+        "requests": len(reqs),
+        "shed_rejected_overload": len(
+            [r for r in reqs if r.finish_reason == "rejected_overload"]),
+        "failovers": cluster.failovers_total,
+        "handoffs": cluster.handoffs_total,
+        "rehomed_sessions": int(
+            sum(snap.get("serving_router_rehomed_sessions_total", {})
+                .get("values", {}).values())),
+        "affinity_hit_rate": round(
+            cluster.router.stats()["affinity_hit_rate"], 3),
+        "prefix_hit_tokens": int(hit1),
+        "cluster_steps": cluster.stats()["cluster_steps"],
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--faults", type=int, default=50,
                     help="minimum injected faults across all sites")
     ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--cluster", action="store_true",
+                    help="cluster mode: kill a random replica "
+                         "mid-soak, assert zero lost/duplicated "
+                         "requests cluster-wide + affinity recovery")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="cluster-mode replica count")
     args = ap.parse_args()
+    if args.cluster:
+        report = run_cluster_soak(seed=args.seed,
+                                  requests=args.requests,
+                                  replicas=args.replicas)
+        print(json.dumps(report, indent=2))
+        print("chaos_soak: OK — replica killed and rebuilt, zero "
+              "lost/duplicated requests cluster-wide, affinity "
+              "recovered", file=sys.stderr)
+        return 0
     report = run_soak(seed=args.seed, faults=args.faults,
                       requests=args.requests)
     print(json.dumps(report, indent=2))

@@ -89,6 +89,26 @@ REQUIRED = {
         ('fault_point("alloc")', 1),
         ('fault_point("free")', 1),
     ],
+    "paddle_tpu/serving/cluster.py": [
+        # disaggregated cluster (ISSUE 9): both halves of the
+        # prefill→decode handoff pair (bytes/pages moved + latency —
+        # the PERF_NOTES cost model's inputs), the failover/rehome
+        # counter (zero-lost-requests is only provable if rehomes are
+        # visible) and the per-replica load gauges the registry-side
+        # signal bus publishes each step
+        ("_obs.serving_handoff_export(", 1),
+        ("_obs.serving_handoff_import(", 1),
+        ("_obs.serving_router_failover(", 1),
+        ("_obs.serving_router_replica(", 1),
+    ],
+    "paddle_tpu/serving/router.py": [
+        # cluster router (ISSUE 9): per-dispatch replica + affinity
+        # hit/miss counters (the live prefix-affinity hit rate), the
+        # shed-work retry counter and the rate-limit rejection counter
+        ("_obs.serving_router_dispatch(", 1),
+        ("_obs.serving_router_retry(", 1),
+        ("_obs.serving_router_ratelimited(", 1),
+    ],
     "paddle_tpu/models/generate.py": [
         ("_obs.generate_begin()", 1),
         ('_obs.generate_phase("prefill"', 1),

@@ -154,6 +154,17 @@ def main():
             "vs_single_chip": round(tps / paged, 3) if paged else None}
         return tps
     run_tier("decode_tp_tokens_per_sec", _tp)
+
+    # disaggregated serving cluster (ISSUE 9): two replicas behind the
+    # prefix-affinity router on a shared-prefix tenant workload — the
+    # cluster-vs-single-engine ratio rides the record next to the
+    # throughput it explains, same contract as the other riders
+    def _cluster():
+        tps, scaling = bench_mod.cluster_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        out["decode_cluster_scaling"] = scaling
+        return tps
+    run_tier("decode_cluster_tokens_per_sec", _cluster)
     int8_p = {}
 
     def _int8():
@@ -170,6 +181,7 @@ def main():
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
         "decode_prefix_tokens_per_sec", "decode_sched_tokens_per_sec",
         "decode_spec_tokens_per_sec", "decode_tp_tokens_per_sec",
+        "decode_cluster_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")
