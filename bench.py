@@ -158,7 +158,7 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_w8kv8_tps=None, decode_paged_tps=None,
             decode_prefix_tps=None, decode_sched=None,
             decode_spec=None, decode_tp=None, decode_cluster=None,
-            phases=None):
+            decode_offload=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -182,7 +182,9 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_tp_tokens_per_sec": (
                       decode_tp[0] if decode_tp else None),
                   "decode_cluster_tokens_per_sec": (
-                      decode_cluster[0] if decode_cluster else None)},
+                      decode_cluster[0] if decode_cluster else None),
+                  "decode_offload_tokens_per_sec": (
+                      decode_offload[0] if decode_offload else None)},
     }
     if decode_sched:
         # the tier's point is the BOUND, not just the throughput:
@@ -201,6 +203,10 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # workload (router+handoff overhead on one host, the scaling
         # win on real multi-chip deployments) travels with the number
         rec["extra"]["decode_cluster_scaling"] = decode_cluster[1]
+    if decode_offload:
+        # the host-tier tier's point is the RESUME cost it removed:
+        # swap-in latency + the ratio vs the replay-prefill baseline
+        rec["extra"]["decode_offload_resume"] = decode_offload[1]
     if phases is not None:
         rec["phases"] = phases
     return _backfill_decode(rec)
@@ -589,6 +595,83 @@ def cluster_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     }
 
 
+def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                        kv_cache_dtype=None):
+    """The decode_offload_tokens_per_sec measurement, shared by
+    measure() and tools/decode_bench.py so the two sources stay
+    comparable.
+
+    The ISSUE 4 scheduler tier's oversubscribed TWO-PRIORITY bursty
+    workload (LOW long-prompt wave fills every slot, then a HIGH burst
+    preempts its way in) with the ISSUE 10 HOST TIER enabled: every
+    preemption victim SWAPS OUT to host RAM and every resume SWAPS IN
+    by one donated scatter instead of the replay prefill. The rider is
+    the tier's honest story: ``swap_in_ms_p50`` (the host→device copy
+    that replaced the replay) and ``vs_replay_prefill`` — the same
+    workload through the same scheduler with the host tier OFF, so the
+    ratio IS the swap-vs-replay win at this geometry (PERF_NOTES has
+    the crossover model; on CPU smoke shapes the replay is tiny, so
+    the ratio mostly prices the swap machinery's overhead — the TPU
+    run is where replay FLOPs dominate). Prefix cache OFF (same rule
+    as every engine tier: the warm pass must not convert the timed
+    pass into a hit workload; the host store holds only swap
+    payloads). Returns ``(tokens_per_sec, {"preemptions", "swap_ins",
+    "swap_in_ms_p50", "vs_replay_prefill"})``."""
+    import numpy as np
+    from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    from paddle_tpu.serving import Priority, ServingScheduler
+    page = 16 if on_tpu else 8
+    rngp = np.random.default_rng(19)
+
+    def build(host):
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=db, page_size=page,
+            max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype,
+            enable_prefix_cache=False, host_tier=host)
+        return eng, ServingScheduler(eng, token_budget=db + 2 * page)
+
+    def one_pass(sched):
+        def mk(n):
+            return rngp.integers(0, cfg.vocab_size, (n,)).astype(
+                np.int32)
+        lows = [sched.submit(mk(dp_len), max_new_tokens=dnew,
+                             priority=Priority.LOW) for _ in range(db)]
+        for _ in range(4):
+            sched.step()
+        highs = [sched.submit(mk(max(dp_len // 2, 1)),
+                              max_new_tokens=max(dnew // 2, 1),
+                              priority=Priority.HIGH)
+                 for _ in range(db)]
+        while sched.step():
+            pass
+        return sum(len(r.tokens) for r in lows + highs)
+
+    # replay baseline: the identical workload, host tier OFF — the
+    # rider's denominator (every resume pays the replay prefill)
+    _, sched_replay = build(False)
+    one_pass(sched_replay)                          # compile/warm pass
+    t0 = time.perf_counter()
+    toks = one_pass(sched_replay)
+    replay_tps = toks / (time.perf_counter() - t0)
+
+    eng, sched = build(True)
+    one_pass(sched)                                 # warm (shares compiles)
+    n0 = len(eng.cache.swap_in_ms)
+    si0, p0 = eng.cache.swap_ins_total, sched.preemptions_total
+    t0 = time.perf_counter()
+    toks = one_pass(sched)
+    tps = round(toks / (time.perf_counter() - t0), 2)
+    lat = eng.cache.swap_in_ms[n0:]
+    return tps, {
+        "preemptions": sched.preemptions_total - p0,
+        "swap_ins": eng.cache.swap_ins_total - si0,
+        "swap_in_ms_p50": (round(float(np.percentile(lat, 50)), 3)
+                           if lat else None),
+        "vs_replay_prefill": (round(tps / replay_tps, 3)
+                              if replay_tps else None),
+    }
+
+
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec",
                  "decode_paged_tokens_per_sec",
@@ -596,7 +679,8 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_sched_tokens_per_sec",
                  "decode_spec_tokens_per_sec",
                  "decode_tp_tokens_per_sec",
-                 "decode_cluster_tokens_per_sec")
+                 "decode_cluster_tokens_per_sec",
+                 "decode_offload_tokens_per_sec")
 
 # rider dicts that travel with their tier when it carries from an older
 # record: the scheduler tier's p50/p99 step-latency bound (ISSUE 4),
@@ -609,7 +693,9 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                   ("decode_spec_tokens_per_sec", "decode_spec_acceptance"),
                   ("decode_tp_tokens_per_sec", "decode_tp_scaling"),
                   ("decode_cluster_tokens_per_sec",
-                   "decode_cluster_scaling"))
+                   "decode_cluster_scaling"),
+                  ("decode_offload_tokens_per_sec",
+                   "decode_offload_resume"))
 
 
 def _label_decode_source(extra: dict, carried_tiers,
@@ -930,6 +1016,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"cluster decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # hierarchical KV host tier (ISSUE 10): the scheduler tier's bursty
+    # preempt workload with swap-out/swap-in instead of evict/replay —
+    # swap-in latency + the vs-replay ratio ride the record
+    decode_offload = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_offload = offload_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"offload decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
@@ -939,7 +1037,7 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_paged_tps, decode_prefix_tps,
                    decode_sched=decode_sched, decode_spec=decode_spec,
                    decode_tp=decode_tp, decode_cluster=decode_cluster,
-                   phases=phases)
+                   decode_offload=decode_offload, phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

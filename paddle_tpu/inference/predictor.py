@@ -351,7 +351,7 @@ class GenerationRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "tokens", "done", "finish_reason", "slot",
                  "priority", "deadline_at", "submitted_at",
-                 "enqueued_at", "preemptions")
+                 "enqueued_at", "preemptions", "swapped")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
         self.rid = rid
@@ -367,6 +367,7 @@ class GenerationRequest:
         self.submitted_at: Optional[float] = None
         self.enqueued_at: Optional[float] = None   # latest (re)queue time
         self.preemptions = 0
+        self.swapped = False    # KV currently host-resident (ISSUE 10)
 
     def resume_sequence(self) -> np.ndarray:
         """The tokens whose KV must be in the pool before this request
@@ -476,7 +477,9 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  enable_prefix_cache: bool = True,
                  spec_k: int = 0, spec_ngram: int = 3,
-                 speculator=None, mesh=None):
+                 speculator=None, mesh=None,
+                 host_tier: bool = False,
+                 host_tier_kw: Optional[Dict] = None):
         from ..serving import PagedKVCache
         self.cfg = cfg
         self.temperature = float(temperature)
@@ -506,11 +509,25 @@ class ContinuousBatchingEngine:
             params, self._param_specs = _llama.shard_serving_params(
                 params, cfg, mesh, axis=self._tp_axis)
         self.params = params
-        self.cache = PagedKVCache(
-            cfg, max_batch, max_len or cfg.max_seq_len,
-            page_size=page_size, num_pages=num_pages,
-            kv_dtype=kv_cache_dtype,
-            enable_prefix_cache=enable_prefix_cache, mesh=mesh)
+        # --- hierarchical KV (ISSUE 10): host_tier=True swaps the
+        # cache for a TieredKVCache — preemption victims swap out to
+        # host RAM and resume by swap-in scatter instead of the replay
+        # prefill, evicted prefix-trie chains demote/promote, and
+        # registered prompt chains persist to the standing store
+        # (host_tier_kw: host_capacity_pages / prefix_store_dir /
+        # store — a shared HostPageStore across engines).
+        cache_kw = dict(page_size=page_size, num_pages=num_pages,
+                        kv_dtype=kv_cache_dtype,
+                        enable_prefix_cache=enable_prefix_cache,
+                        mesh=mesh)
+        if host_tier:
+            from ..serving.host_tier import TieredKVCache
+            self.cache = TieredKVCache(
+                cfg, max_batch, max_len or cfg.max_seq_len,
+                **cache_kw, **(host_tier_kw or {}))
+        else:
+            self.cache = PagedKVCache(
+                cfg, max_batch, max_len or cfg.max_seq_len, **cache_kw)
         if prefill_chunk is not None:
             # page-rounded so chunk boundaries stay page-aligned (the
             # chunk program's static ctx_cap) and >= one page
@@ -707,13 +724,38 @@ class ContinuousBatchingEngine:
         its replay sequence (``resume_sequence()`` — prompt + generated
         tokens minus the last) reserves pages and replays through the
         continuation-prefill program, so resume is token-identical to
-        an uninterrupted run."""
+        an uninterrupted run. Under the host tier (ISSUE 10) a victim
+        that was SWAPPED OUT resumes by swap-in scatter instead: its
+        exact KV bytes return from host RAM in one donated scatter —
+        bit-identical and decode-ready immediately, no replay forward.
+        A missing/stale payload (LRU-dropped) falls back to the replay
+        path, which remains the one gated resume code path."""
         cache = self.cache
         free = cache.free_slots()
         if not free:
             return False
         slot = free[0]
         seq = req.resume_sequence()
+        if (req.swapped and req.tokens
+                and getattr(cache, "host", None) is not None):
+            # a raised swap_in (injected fault, PoolExhausted) leaves
+            # the flag SET — the payload committed nothing and survives
+            # for the retried admission after recovery/back-pressure
+            length = cache.swap_in(
+                slot, req.rid, req.prompt.shape[1] + req.max_new_tokens,
+                expect_tokens=seq.size)
+            req.swapped = False
+            if length is not None:
+                req.slot = slot
+                self._slots[slot] = req
+                # decode continues from the already-sampled last token,
+                # exactly as the replay path would after its final chunk
+                self._last[slot] = np.int32(req.tokens[-1])
+                req.finish_reason = None    # clears transient "preempted"
+                _obs.serving_resumed(1, 0)  # zero replay tokens: swap-in
+                return True
+            # payload gone (capacity drop / stale — swap_in counted the
+            # fallback): replay below, the gated resume path
         _, shared = cache.admit_prompt(
             slot, seq, req.prompt.shape[1] + req.max_new_tokens)
         req.slot = slot
@@ -734,21 +776,41 @@ class ContinuousBatchingEngine:
             _obs.serving_prefix(int(shared), seq.size - int(shared))
         return True
 
+    def swap_candidate(self, req: GenerationRequest) -> bool:
+        """True when preempting ``req`` would SWAP its KV to the host
+        tier (near-free resume) rather than evict-and-replay: the
+        cache is tiered and the request is decode-phase (committed KV
+        exists — mid-prefill victims have nothing worth moving). The
+        :class:`~paddle_tpu.serving.PreemptionPolicy` prefers such
+        victims when the scheduler passes this predicate through."""
+        return (getattr(self.cache, "host", None) is not None
+                and req.slot is not None
+                and req.slot not in self._pending
+                and int(self.cache.lengths[req.slot]) > 0)
+
     def preempt_request(self, req: GenerationRequest) -> int:
         """Evict a RUNNING request's pages back to the pool (the
         scheduler's evict-for-preempt: refcounts drop; pages shared
         with the prefix trie or other tables survive under those
         references) and reset the request for a token-identical resume
-        via :meth:`admit_request`. ``finish_reason`` reads the
-        transient ``preempted`` until the resume's replay prefill
-        completes; ``done`` stays False. Returns the number of pages
-        actually returned to the free list."""
+        via :meth:`admit_request`. Under the host tier (ISSUE 10) a
+        decode-phase victim's live pages SWAP OUT to host RAM first,
+        so the later resume is a swap-in scatter instead of the
+        ``O(resident tokens)`` replay prefill. ``finish_reason`` reads
+        the transient ``preempted`` until the resume completes;
+        ``done`` stays False. Returns the number of pages actually
+        returned to the free list."""
         slot = req.slot
         if slot is None or self._slots[slot] is not req:
             raise ValueError(
                 f"preempt_request: request {req.rid} is not running")
+        swap = self.swap_candidate(req)
         self._pending.pop(slot, None)
-        freed = self.cache.evict_for_preempt(slot)
+        if swap:
+            freed = self.cache.swap_out(slot, req.rid)
+            req.swapped = True
+        else:
+            freed = self.cache.evict_for_preempt(slot)
         self._slots[slot] = None
         req.slot = None
         req.preemptions += 1
@@ -774,6 +836,10 @@ class ContinuousBatchingEngine:
             pass                        # scheduler-owned queue entry
         req.done = True
         req.finish_reason = reason
+        if getattr(self.cache, "host", None) is not None:
+            # a swap-preempted victim cancelled while evicted retires
+            # its host payload with it (nothing will ever swap it in)
+            self.cache.drop_swapped(req.rid)
         if req.preemptions > 0:
             # preempted awaiting resume: it WAS admitted (its pages
             # already freed at preempt time) — the cancel finalizes
@@ -1214,6 +1280,8 @@ class ContinuousBatchingEngine:
         s["active_slots"] = int(self.cache.active.sum())
         s["pending_prefills"] = len(self._pending)
         s["cow_copies"] = self.cache.cow_copies
+        if getattr(self.cache, "host", None) is not None:
+            s.update(self.cache.tier_stats())
         if self.cache.prefix is not None:
             s["prefix_evictions_total"] = \
                 self.cache.prefix.evictions_total

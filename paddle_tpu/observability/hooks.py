@@ -533,6 +533,115 @@ def serving_drain_restore(t0_ns: int, nbytes: int, sessions: int,
                ).inc(trie_pages)
 
 
+# ---------------- hierarchical KV tier (ISSUE 10) ----------------
+
+def serving_swap_out(t0_ns: int, nbytes: int, pages: int):
+    """Close one preemption SWAP-OUT opened at ``t0_ns``: the victim's
+    live KV pages gathered device→host before its device pages freed.
+    Latency histogram + bytes/pages counters — the 'bytes moved' half
+    of the swap-vs-replay crossover model (PERF_NOTES)."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.swap_out", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_swap_out_ms",
+                 "wall milliseconds per preemption swap-out gather",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_swap_outs_total",
+               "preemption victims swapped out to the host tier").inc()
+    _m.counter("serving_swap_out_bytes_total",
+               "KV bytes moved device→host by swap-outs").inc(nbytes)
+    _m.counter("serving_swap_pages_total",
+               "KV pages moved through the host tier",
+               ("direction",)).labels("out").inc(pages)
+
+
+def serving_swap_in(t0_ns: int, nbytes: int, pages: int):
+    """Close one resume SWAP-IN opened at ``t0_ns``: fresh pages
+    allocated and the host payload scattered back (the shared donated
+    ``_pool_scatter``) — the resume that replaces the ``O(resident
+    tokens)`` replay prefill. Latency histogram + bytes/pages
+    counters."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.swap_in", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_swap_in_ms",
+                 "wall milliseconds per resume swap-in scatter",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_swap_ins_total",
+               "preempted requests resumed by host-tier swap-in").inc()
+    _m.counter("serving_swap_in_bytes_total",
+               "KV bytes moved host→device by swap-ins").inc(nbytes)
+    _m.counter("serving_swap_pages_total",
+               "KV pages moved through the host tier",
+               ("direction",)).labels("in").inc(pages)
+
+
+def serving_swap_fallback():
+    """A resume found no (valid) host payload — LRU capacity drop or a
+    stale length — and fell back to the replay-prefill path. The
+    fallback rate is the honest cost of bounding host-tier RAM."""
+    if not enabled:
+        return
+    _m.counter("serving_swap_replay_fallbacks_total",
+               "swap-in resumes that fell back to replay prefill "
+               "(payload dropped or stale)").inc()
+
+
+def serving_host_pool(pages: int, nbytes: int, capacity):
+    """Host-tier residency gauges after a store mutation: pages/bytes
+    resident in host RAM, plus occupancy against the configured page
+    capacity (skipped when unbounded)."""
+    if not enabled:
+        return
+    _m.gauge("serving_host_pool_pages",
+             "KV pages resident in the host-RAM tier").set(pages)
+    _m.gauge("serving_host_pool_bytes",
+             "KV bytes resident in the host-RAM tier").set(nbytes)
+    if capacity:
+        _m.gauge("serving_host_pool_utilization",
+                 "host-tier page residency over its configured "
+                 "capacity").set(pages / capacity)
+
+
+def serving_prefix_demoted(pages: int):
+    """Prefix-trie pages DEMOTED to the host tier under pool pressure
+    (instead of dying with their eviction) — each is a candidate for a
+    later promote hit."""
+    if not enabled:
+        return
+    _m.counter("serving_prefix_demoted_pages_total",
+               "prefix-trie pages demoted to the host tier on "
+               "eviction").inc(pages)
+
+
+def serving_prefix_promoted(t0_ns: int, pages: int):
+    """Close one prefix PROMOTION opened at ``t0_ns``: demoted (or
+    standing-store-persisted) chain pages scattered back into the pool
+    and re-registered, converting what would have been a prefill miss
+    into a prefix HIT — the demoted-trie promote hit counter."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.prefix_promote", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_prefix_promote_ms",
+                 "wall milliseconds per host→pool prefix promotion",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_prefix_promoted_pages_total",
+               "prefix pages promoted back from the host tier "
+               "(demote/persist hits)").inc(pages)
+
+
 # ---------------- disaggregated cluster serving (ISSUE 9) ----------------
 
 def serving_router_dispatch(replica: int, affinity_hit: bool):

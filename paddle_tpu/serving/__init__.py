@@ -22,6 +22,11 @@ SLO-aware scheduler.
   token-identical crash recovery via the resume replay, circuit
   breaker + degraded-mode ladder, drain/restore with prefix-trie
   persistence).
+- :mod:`paddle_tpu.serving.host_tier` — the hierarchical KV tier
+  (ISSUE 10): :class:`HostPageStore` (host-numpy page pool with an
+  optional standing on-disk layer) and :class:`TieredKVCache`
+  (preemption swap-out/swap-in under the allocator, prefix-trie
+  demote/promote, write-through prefix persistence across restarts).
 - :mod:`paddle_tpu.serving.cluster` / :mod:`paddle_tpu.serving.router`
   — the disaggregated serving tier (ISSUE 9): :class:`ServingCluster`
   (N supervised replicas, prefill→decode KV handoff over the page
@@ -50,5 +55,6 @@ from .scheduler import ServingScheduler  # noqa: F401
 from .speculative import (  # noqa: F401
     NgramProposer, Speculator, longest_accepted_prefix,
 )
+from .host_tier import HostPageStore, TieredKVCache  # noqa: F401
 from .router import ClusterRouter, TenantQuota  # noqa: F401
 from .cluster import ServingCluster  # noqa: F401

@@ -91,7 +91,8 @@ class ServingCluster:
                  quotas: Optional[Dict[str, TenantQuota]] = None,
                  router: Optional[ClusterRouter] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 supervisor_kw: Optional[Dict] = None):
+                 supervisor_kw: Optional[Dict] = None,
+                 share_host_tier: bool = True):
         if replicas < 1:
             raise ValueError(f"replicas={replicas} must be >= 1")
         if not 0 <= prefill_replicas < replicas:
@@ -103,8 +104,22 @@ class ServingCluster:
         self.clock = clock
         self._sup_kw = dict(supervisor_kw or {})
         self._next_rid = 0
+        self._host_store = None
         self.replicas: List[EngineSupervisor] = [
             self._new_supervisor() for _ in range(replicas)]
+        if share_host_tier:
+            # hierarchical KV (ISSUE 10): when the factory builds
+            # host-tiered engines, every replica shares ONE
+            # HostPageStore — rids are cluster-unique, and page bytes
+            # are position-addressed, so a session swapped out on a
+            # dying replica SWAPS IN on whichever replica it rehomes
+            # to (no replay), and a failover/retirement replacement
+            # starts warm from the standing prefix tier
+            store = getattr(self.replicas[0].engine.cache, "host", None)
+            if store is not None:
+                self._host_store = store
+                for sup in self.replicas[1:]:
+                    self._attach_host_store(sup)
         self.prefill_replicas = prefill_replicas
         page = self.replicas[0].engine.cache.page_size
         for sup in self.replicas[1:]:
@@ -131,7 +146,17 @@ class ServingCluster:
                                token_budget=self.token_budget,
                                clock=self.clock, **self._sup_kw)
         sup.engine._next_rid = max(sup.engine._next_rid, self._next_rid)
+        self._attach_host_store(sup)
         return sup
+
+    def _attach_host_store(self, sup: EngineSupervisor) -> None:
+        """Point a (tiered) replica's cache at the cluster-shared
+        :class:`~paddle_tpu.serving.host_tier.HostPageStore`; the
+        supervisor's own rebuilds then carry it forward
+        (``adopt_host_tier``), so the share survives recoveries."""
+        store = getattr(self, "_host_store", None)
+        if store is not None and hasattr(sup.engine.cache, "host"):
+            sup.engine.cache.host = store
 
     # ---- roles ----
     def _prefill_idxs(self) -> List[int]:
@@ -531,4 +556,6 @@ class ServingCluster:
             "deadline_cancels_total": self.deadline_cancels_total,
             "router": self.router.stats(),
             "per_replica": per,
+            **({"host_tier": self._host_store.stats()}
+               if self._host_store is not None else {}),
         }

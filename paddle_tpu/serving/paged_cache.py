@@ -336,20 +336,25 @@ class PrefixCache:
     def _candidates(self):
         """Evictable references: every tail, plus leaf nodes with no
         tail (inner nodes only become evictable once their subtree is
-        gone — a child chain is unreachable without its ancestors)."""
+        gone — a child chain is unreachable without its ancestors).
+        Each candidate carries its full chain-token path from the root
+        (the trie's context hash) so an eviction hook can identify the
+        span being dropped — the host tier's demotion key."""
         out = []
-        stack = [(self.root, None, None)]
+        stack = [(self.root, None, None, b"")]
         while stack:
-            node, parent, key = stack.pop()
+            node, parent, key, path = stack.pop()
             if node.tail is not None:
-                out.append((node.tick, 0, node, parent, key, True))
+                out.append((node.tick, 0, node, parent, key, True, path))
             elif parent is not None and not node.children:
-                out.append((node.tick, 1, node, parent, key, False))
+                out.append((node.tick, 1, node, parent, key, False,
+                            path))
             for k, c in node.children.items():
-                stack.append((c, node, k))
+                stack.append((c, node, k, path + k))
         return out
 
-    def evict(self, allocator: BlockAllocator, need: int) -> int:
+    def evict(self, allocator: BlockAllocator, need: int,
+              on_evict=None) -> int:
         """Drop trie references LRU-first until ``need`` pages actually
         returned to the free list (a dropped reference frees nothing
         while live block tables still share the page) or nothing
@@ -357,18 +362,26 @@ class PrefixCache:
         serves a whole batch of drops; the walk repeats only when the
         candidate list ran dry and drops made new parents evictable —
         so reclaiming k pages from an n-node trie is O(n log n + k),
-        not O(k * n log n), on the admission path."""
+        not O(k * n log n), on the admission path.
+
+        ``on_evict(chain_tokens, page_id)`` — if given — fires for
+        every FULL page before its reference drops (the host tier's
+        demote hook: the page bytes are still live when it runs).
+        Partial-page tails never fire it."""
         start = allocator.num_free
         progressed = True
         while allocator.num_free - start < need and progressed:
             cands = self._candidates()
             cands.sort(key=lambda c: (c[0], c[1]))
             progressed = False
-            for _, _, node, parent, key, is_tail in cands:
+            for _, _, node, parent, key, is_tail, path in cands:
                 if is_tail:
                     allocator.free([node.tail[0]])
                     node.tail = None
                 else:
+                    if on_evict is not None:
+                        on_evict(np.frombuffer(path, np.int32),
+                                 int(node.page))
                     allocator.free([node.page])
                     del parent.children[key]
                 self.evictions_total += 1
@@ -577,11 +590,19 @@ class PagedKVCache:
             return self.allocator.alloc(n)
         except PoolExhausted:
             if self.prefix is not None:
-                self.prefix.evict(self.allocator,
-                                  n - self.allocator.num_free)
+                self._evict_prefix(n - self.allocator.num_free)
             if n > self.allocator.num_free:
                 raise
             return self.allocator.alloc(n)
+
+    def _evict_prefix(self, need: int) -> int:
+        """Reclaim ``need`` pages of prefix-trie references under pool
+        pressure. The hierarchical host tier
+        (:class:`~paddle_tpu.serving.host_tier.TieredKVCache`)
+        overrides this to DEMOTE each dropped full page's bytes to
+        host RAM before the reference goes — here they simply die and
+        re-prefill on the next miss."""
+        return self.prefix.evict(self.allocator, need)
 
     def _install(self, slot: int, pages: List[int]) -> np.ndarray:
         self._slot_pages[slot] = pages

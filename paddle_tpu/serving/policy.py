@@ -68,13 +68,18 @@ class StepPlan:
     (page-multiple caps; the engine takes ``min(cap, remaining,
     prefill_chunk)``). ``deferred_decodes`` counts ready slots the
     budget pushed to a later step — the observable fairness cost of a
-    tight budget."""
+    tight budget. ``reserved_tokens`` is the debit already spent
+    BEFORE planning (the host tier's swap-in scatters during this
+    step's admissions — ISSUE 10): the planner packs into ``budget -
+    reserved_tokens``, so the configured budget stays a hard per-step
+    ceiling on KV bytes written."""
     decode_slots: List[int] = dataclasses.field(default_factory=list)
     prefills: List[Tuple[int, int]] = dataclasses.field(
         default_factory=list)
     budget: Optional[int] = None
     deferred_decodes: int = 0
     spec_drafts: Dict[int, int] = dataclasses.field(default_factory=dict)
+    reserved_tokens: int = 0
 
     @property
     def scheduled_tokens(self) -> int:
@@ -119,7 +124,8 @@ class TokenBudgetPlanner:
     def plan(self, decode_ready: Sequence[Tuple[int, int, int]],
              pending: Sequence[Tuple[int, int, int, int]],
              chunk_cap: Optional[int] = None,
-             spec_drafts: Optional[Dict[int, int]] = None) -> StepPlan:
+             spec_drafts: Optional[Dict[int, int]] = None,
+             reserved_tokens: int = 0) -> StepPlan:
         """Build one step's :class:`StepPlan`.
 
         decode_ready: ``(priority, rid, slot)`` per decodable slot
@@ -136,12 +142,21 @@ class TokenBudgetPlanner:
                       what is left), so the ceiling stays hard and a
                       tight budget degrades a row to plain decode
                       instead of deferring it.
+        reserved_tokens: tokens of budget already spent before the
+                      plan — the host tier's swap-in scatters during
+                      this step's admissions (ISSUE 10), charged at
+                      ``page_size`` per swapped-in page (the same KV
+                      bytes a prefill chunk writes, minus the FLOPs).
+                      The plan packs into the remainder, keeping the
+                      budget a hard per-step ceiling; with no budget
+                      configured the reserve is recorded but unused.
         """
         page = self.page_size
         spec = spec_drafts or {}
         if self.token_budget is None:
             plan = StepPlan([s for _, _, s in
                              sorted(decode_ready)], [], None)
+            plan.reserved_tokens = int(reserved_tokens)
             plan.spec_drafts = {s: int(k) for s, k in spec.items()
                                 if s in plan.decode_slots and k > 0}
             if pending:
@@ -151,8 +166,9 @@ class TokenBudgetPlanner:
                     width = min(width, chunk_cap)
                 plan.prefills.append((slot, width))
             return plan
-        left = self.token_budget
-        plan = StepPlan(budget=self.token_budget)
+        left = max(0, self.token_budget - int(reserved_tokens))
+        plan = StepPlan(budget=self.token_budget,
+                        reserved_tokens=int(reserved_tokens))
         items = [(p, rid, "decode", slot, 1 + int(spec.get(slot, 0)))
                  for p, rid, slot in decode_ready]
         for p, rid, slot, remaining in pending:
@@ -186,17 +202,24 @@ class PreemptionPolicy:
     A victim must be STRICTLY lower class (numerically greater
     priority value) than the incoming request — preemption never
     reorders within a class. Among eligible victims the policy picks
-    the lowest class first, then the fewest generated tokens (the
-    cheapest token-identical resume replay), then the youngest request
-    (highest rid) — so the work already sunk into older, further-along
-    requests is preserved.
+    the lowest class first, then — when a ``swappable`` predicate is
+    supplied (the host tier, ISSUE 10) — victims whose eviction SWAPS
+    (one page copy to host, near-free resume) over ones that would
+    evict-and-replay (mid-prefill victims with no committed KV), then
+    the fewest generated tokens (the cheapest replay if one does
+    happen), then the youngest request (highest rid) — so the work
+    already sunk into older, further-along requests is preserved.
     """
 
-    def pick_victim(self, running, priority: int):
+    def pick_victim(self, running, priority: int, swappable=None):
         """``running``: live request objects (``.priority`` /
-        ``.tokens`` / ``.rid``); returns one or None."""
+        ``.tokens`` / ``.rid``); ``swappable(req) -> bool`` marks
+        victims whose preemption swaps out instead of replaying.
+        Returns one victim or None."""
         cands = [r for r in running if r.priority > int(priority)]
         if not cands:
             return None
+        sw = swappable if swappable is not None else (lambda r: False)
         return max(cands,
-                   key=lambda r: (r.priority, -len(r.tokens), r.rid))
+                   key=lambda r: (r.priority, bool(sw(r)),
+                                  -len(r.tokens), r.rid))

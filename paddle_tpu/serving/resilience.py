@@ -83,7 +83,7 @@ from .policy import FinishReason, Priority
 #: matching ``fault_point("<site>")`` call site (and therefore a
 #: matching ``site=`` label on the serving_fault_* counters)
 SITES = ("alloc", "free", "decode_step", "prefill_chunk",
-         "verify_step", "transfer", "sched_tick")
+         "verify_step", "transfer", "sched_tick", "swap_out", "swap_in")
 
 #: the pressure-ordered degraded-mode ladder (index == level): each
 #: recovery escalates one rung, sustained healthy steps climb back down
@@ -268,10 +268,16 @@ class FaultInjector:
 
 
 class JournalEntry:
-    """One request's journaled state (the supervisor's recovery unit)."""
+    """One request's journaled state (the supervisor's recovery unit).
+
+    ``swapped`` (ISSUE 10) records whether the request's KV currently
+    lives in the HOST tier (a swap-out payload exists for its rid) —
+    host-resident state survives an engine teardown, so recovery SWAPS
+    such sessions back in instead of charging them the replay prefill."""
     __slots__ = ("req", "rid", "prompt", "max_new_tokens",
                  "eos_token_id", "priority", "deadline_at",
-                 "submitted_at", "tokens", "admitted", "preemptions")
+                 "submitted_at", "tokens", "admitted", "preemptions",
+                 "swapped")
 
     def __init__(self, req):
         self.req = req
@@ -285,6 +291,7 @@ class JournalEntry:
         self.tokens: List[int] = list(req.tokens)
         self.admitted = False
         self.preemptions = int(req.preemptions)
+        self.swapped = False
 
     def as_record(self, now: Optional[float] = None) -> Dict:
         """JSON-able checkpoint record (drain/restore). Deadlines are
@@ -304,7 +311,8 @@ class JournalEntry:
                 "deadline_remaining_s": remaining,
                 "tokens": list(self.tokens),
                 "admitted": self.admitted,
-                "preemptions": self.preemptions}
+                "preemptions": self.preemptions,
+                "swapped": self.swapped}
 
 
 class RequestJournal:
@@ -342,10 +350,13 @@ class RequestJournal:
         would decode it twice."""
         self._entries.pop(rid, None)
 
-    def sync(self) -> None:
+    def sync(self, swapped_check=None) -> None:
         """Copy committed host state from the live request handles;
         finished requests leave the journal (their results live on the
-        caller's handle — nothing to recover)."""
+        caller's handle — nothing to recover). ``swapped_check(rid) ->
+        bool`` — when the engine runs a host tier — marks entries
+        whose KV is host-resident (they recover by swap-in, not
+        replay)."""
         for rid in list(self._entries):
             e = self._entries[rid]
             req = e.req
@@ -355,6 +366,8 @@ class RequestJournal:
             if (req.slot is not None or req.tokens
                     or req.preemptions > 0):
                 e.admitted = True
+            if swapped_check is not None:
+                e.swapped = bool(swapped_check(rid))
             if req.done:
                 self.finished_total += 1
                 del self._entries[rid]
@@ -532,6 +545,15 @@ class EngineSupervisor:
             eng._spec_fns = old._spec_fns
             eng.cache._cow_fn = old.cache._cow_fn
             eng.cache._scatter_fn = old.cache._scatter_fn
+        if (old is not None
+                and hasattr(eng.cache, "adopt_host_tier")
+                and hasattr(old.cache, "adopt_host_tier")):
+            # hierarchical KV (ISSUE 10): the host tier is HOST state
+            # committed only after successful device→host gathers — it
+            # survives the poisoned pool, so swapped-out sessions (and
+            # the standing prefix store) carry into the rebuilt engine
+            # and recovery SWAPS them in instead of replaying
+            eng.cache.adopt_host_tier(old.cache)
         if self._key_data is not None:
             import jax
             import jax.numpy as jnp
@@ -699,10 +721,14 @@ class EngineSupervisor:
         while self.step():
             pass
 
+    def _sync_journal(self):
+        self.journal.sync(swapped_check=getattr(
+            self.engine.cache, "has_swapped", None))
+
     def _on_success(self):
         self.steps_total += 1
         self._consec_failures = 0
-        self.journal.sync()
+        self._sync_journal()
         self._snapshot_key()
         self._deescalate_maybe()
         _obs.serving_journal(self.journal.size, self.journal.token_count)
@@ -768,10 +794,13 @@ class EngineSupervisor:
         handles mid-race."""
         t0 = _obs.generate_begin()
         if sync:
-            self.journal.sync()
+            self._sync_journal()
         live = self.journal.live_entries()
+        # host-resident sessions (ISSUE 10) swap back in: their resume
+        # is one page scatter, not a replay — the recovery bill counts
+        # only the sessions that actually re-forward tokens
         replay = sum(e.prompt.size + max(0, len(e.tokens) - 1)
-                     for e in live if e.admitted)
+                     for e in live if e.admitted and not e.swapped)
         self._fence(self.engine)
         self._build()
         for e in live:
@@ -802,7 +831,7 @@ class EngineSupervisor:
         fresh process via :meth:`restore`. Returns a summary dict."""
         self._check_alive()
         t0 = _obs.generate_begin()
-        self.journal.sync()
+        self._sync_journal()
         self._snapshot_key()
         now = self.clock()
         cache = self.engine.cache

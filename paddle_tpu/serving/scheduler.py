@@ -105,6 +105,7 @@ class ServingScheduler:
         self.preemptions_total = 0
         self.resumes_total = 0
         self.deadline_cancels_total = 0
+        self._swap_debt = 0     # host-tier swap-in tokens not yet charged
         # the engine's degraded-mode rung, mirrored here by whoever
         # owns the ladder (EngineSupervisor._apply_degraded) so
         # load_stats() is a complete health snapshot — previously the
@@ -197,12 +198,16 @@ class ServingScheduler:
     def _preempt_for(self, req) -> bool:
         """Evict one strictly-lower-class running request to make room
         for ``req``; the victim requeues at the FRONT of its class (it
-        already waited its turn once). Returns False when no eligible
-        victim exists."""
+        already waited its turn once). Under the host tier (ISSUE 10)
+        the policy PREFERS victims whose eviction swaps to host RAM
+        (near-free swap-in resume) over mid-prefill victims that would
+        pay a replay. Returns False when no eligible victim exists."""
         if self.preemption is None:
             return False
         running = self.engine.running_requests()
-        victim = self.preemption.pick_victim(running, req.priority)
+        victim = self.preemption.pick_victim(
+            running, req.priority,
+            swappable=getattr(self.engine, "swap_candidate", None))
         if victim is None:
             return False
         self.engine.preempt_request(victim)
@@ -277,7 +282,7 @@ class ServingScheduler:
                 _obs.serving_queue_wait(
                     max(0.0, now - req.enqueued_at), prio)
 
-    def _plan(self) -> StepPlan:
+    def _plan(self, reserved: int = 0) -> StepPlan:
         eng = self.engine
         ready = eng.ready_mask()
         decode = [(r.priority, r.rid, r.slot)
@@ -295,7 +300,7 @@ class ServingScheduler:
         return self.planner.plan(
             decode, pending, chunk_cap=eng.prefill_chunk,
             spec_drafts={s: d.size for s, d in self._drafts.items()}
-            or None)
+            or None, reserved_tokens=reserved)
 
     def step(self) -> bool:
         """One scheduler step: expire deadlines, admit (preempting if
@@ -318,7 +323,22 @@ class ServingScheduler:
         now = self.clock()
         self._expire_deadlines(now)
         self._admit(now)
-        plan = self._plan()
+        # host tier (ISSUE 10): admissions that SWAPPED IN during
+        # _admit already wrote KV bytes this step (one scatter per
+        # resume) — charge them against the step budget at the prefill
+        # rate (page_size tokens per page). A single swap-in larger
+        # than the whole budget AMORTIZES: the debt carries into later
+        # steps' reserves, so every step's (planned + reserved) stays
+        # under the ceiling and the average per-step KV-write bound
+        # the budget promises holds through swap-heavy bursts.
+        consume = getattr(eng.cache, "consume_swap_charge", None)
+        if consume is not None:
+            self._swap_debt += consume()
+        budget = self.planner.token_budget
+        reserved = (min(self._swap_debt, budget) if budget
+                    else self._swap_debt)
+        self._swap_debt -= reserved
+        plan = self._plan(reserved)
         for slot, cap in plan.prefills:
             eng.prefill_step(slot, max_tokens=cap)
         if plan.decode_slots:
@@ -338,7 +358,9 @@ class ServingScheduler:
         self._steps += 1
         _obs.serving_sched_step(
             {p: len(q) for p, q in self._queues.items()},
-            plan.scheduled_tokens, plan.budget)
+            # swap-in reserves are spent budget: the utilization gauge
+            # reports what the step actually consumed, plan + reserve
+            plan.scheduled_tokens + plan.reserved_tokens, plan.budget)
         return any(self._queues.values()) or not eng.idle
 
     def run(self) -> None:
@@ -365,7 +387,7 @@ class ServingScheduler:
                     s = r.deadline_at - now
                     slack = s if slack is None else min(slack, s)
         level = self.degraded_level
-        return {
+        s = {
             "queue_depths": depths,
             "queued_total": sum(depths.values()),
             "running": len(eng.running_requests()),
@@ -378,6 +400,14 @@ class ServingScheduler:
             "degraded_mode": (DEGRADED_MODES[level]
                               if level < len(DEGRADED_MODES) else "dead"),
         }
+        host = getattr(eng.cache, "host", None)
+        if host is not None:
+            # hierarchical KV (ISSUE 10): the host tier's residency is
+            # part of a replica's load picture — a router can prefer
+            # replicas with host headroom for swap-heavy tenants
+            s["host_pool_pages"] = host.pages_resident
+            s["host_pool_bytes"] = host.bytes_resident
+        return s
 
     def stats(self) -> Dict:
         s = self.engine.stats()

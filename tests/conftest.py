@@ -53,12 +53,25 @@ def pytest_collection_modifyitems(config, items):
     out (cache never enabled; original order kept)."""
     if os.environ.get("PADDLE_TPU_TEST_NO_COMPCACHE") or not items:
         return
-    offload = [it for it in items
-               if "test_offload" in str(getattr(it, "fspath", it.nodeid))]
-    rest = [it for it in items if it not in offload]
+
+    def _pre_cache(it):
+        # test_host_tier moves KV through host memory like the offload
+        # suite and carries the same segfault guard (ISSUE 10): both
+        # run before any compilation-cache activity, offload first
+        # (its module fixture assumes a completely cache-naive process)
+        path = str(getattr(it, "fspath", it.nodeid))
+        if "test_offload" in path:
+            return 0
+        if "test_host_tier" in path:
+            return 1
+        return None
+
+    pre = sorted((it for it in items if _pre_cache(it) is not None),
+                 key=_pre_cache)
+    rest = [it for it in items if _pre_cache(it) is None]
     if not rest:
         return
-    items[:] = offload + rest
+    items[:] = pre + rest
     config._compcache_boundary = rest[0].nodeid
 
 

@@ -186,6 +186,12 @@ class TestRecoveryParity:
     @pytest.mark.parametrize("kv", [None, "int8"])
     @pytest.mark.parametrize("site", SITES)
     def test_each_site(self, site, kv):
+        if site in ("swap_out", "swap_in"):
+            pytest.skip(
+                "host-tier sites only run on the preemption path — "
+                "their recovery-parity gates live in "
+                "tests/test_host_tier.py::TestResilience (and the "
+                "chaos soak fires them)")
         refs = _refs(kv)
         # the verify site only exists on the speculative path; every
         # other site uses the plain engine (where decode_step always

@@ -555,6 +555,79 @@ def validate_serving_cluster(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_host(n: int, batch_mult: int = 1):
+    """ISSUE 10 hierarchical-KV lowering gate: AOT-export the host
+    tier's device programs to the TPU platform — the swap-out GATHER
+    (``serving.host_tier._pool_gather``, the one read program every
+    swap-out/demote/write-through shares) and the swap-in SCATTER
+    (``serving.paged_cache._pool_scatter``, the same donated program
+    the PR 9 handoff gate already lowers — re-validated here because
+    the swap path is its third consumer) — at fp, int8-KV and a
+    kv-head-SHARDED tp=2 pool (shared ``pool_partition_specs`` layout).
+    Pure-XLA gather/scatter: export completing is the gate."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.serving.host_tier import _pool_gather
+    from paddle_tpu.serving.paged_cache import (_pool_scatter,
+                                                pool_partition_specs)
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    skipped = {}
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    B, pg, k = 8, 16, 4          # k pages per swap payload
+
+    def build_pool(kv=None, tp=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv,
+                                    tp=tp)
+        if tp is not None:
+            from jax.sharding import NamedSharding
+            from paddle_tpu.distributed.mesh import serving_mesh
+            mesh = serving_mesh(tp)
+            pspecs = pool_partition_specs(pool, "tp")
+            pool = {nm: jax.device_put(
+                a, NamedSharding(mesh, pspecs[nm]))
+                for nm, a in pool.items()}
+        return pool
+
+    def export_pair(tag, kv=None, tp=None):
+        pool = build_pool(kv=kv, tp=tp)
+        ids = jnp.asarray(rs.choice(np.arange(1, 2 * B), k,
+                                    replace=False).astype(np.int32))
+        jax.export.export(jax.jit(_pool_gather),
+                          platforms=["tpu"])(pool, ids)
+        lowered[f"swap_out_gather_{tag}"] = True
+        vals = {nm: np.zeros((a.shape[0], k) + a.shape[2:], a.dtype)
+                for nm, a in pool.items()}
+        jax.export.export(
+            jax.jit(_pool_scatter, donate_argnums=(0,)),
+            platforms=["tpu"])(pool, vals, ids)
+        lowered[f"swap_in_scatter_{tag}"] = True
+
+    export_pair("fp")
+    export_pair("int8", kv="int8")
+    ndev = len(jax.devices())
+    if ndev >= 2:
+        export_pair("tp2_sharded", tp=2)
+    else:
+        skipped["swap_tp2_sharded"] = (
+            f"--devices {ndev} < tp=2; sharded pool not exportable")
+    ok = all(lowered.values())
+    return {
+        "config": "serving_host_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({"skipped": skipped} if skipped else {}),
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def _impl(args) -> int:
     rows = []
 
@@ -582,6 +655,8 @@ def _impl(args) -> int:
         emit(validate_serving_tp(args.devices, args.batch_mult))
     if args.config in ("serving-cluster", "all"):
         emit(validate_serving_cluster(args.devices, args.batch_mult))
+    if args.config in ("serving-host", "all"):
+        emit(validate_serving_host(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         ok = ok and (r.get("fits_v5p") is not False)
@@ -595,7 +670,7 @@ def main():
     ap.add_argument("--config",
                     choices=["7b", "13b", "13b-long", "moe", "moe-pp",
                              "serving", "serving-tp", "serving-cluster",
-                             "all"],
+                             "serving-host", "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")

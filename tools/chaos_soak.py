@@ -81,10 +81,13 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     spec_k = 2
 
     def factory():
+        # host tier ON (ISSUE 10): preemptions swap out / resumes swap
+        # in, so the soak's fault stream also exercises the swap_out /
+        # swap_in sites under the same zero-lost/zero-duplicated gate
         return ContinuousBatchingEngine(
             params, cfg, max_batch=3, page_size=8, max_len=48,
             prefill_chunk=8, spec_k=spec_k,
-            speculator=_speculator(spec_k))
+            speculator=_speculator(spec_k), host_tier=True)
 
     # mixed workload: long prompts (multi-chunk prefill), short ones,
     # repetitive motifs (accepted drafts), three priority classes
@@ -126,9 +129,19 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             max_faults=faults, stall_s=2.5)
         # guarantee coverage: arm one fault at EVERY site up front
         # (the rate-based stream fills in the rest), plus a couple of
-        # watchdog stalls
+        # watchdog stalls. The swap sites are visited far less often
+        # than the per-step sites (once per preemption/resume, not per
+        # step), so their armed shots sit on early calls: the FIRST
+        # swap-out succeeds (a payload must exist for any swap-in to
+        # run at all), the second faults; the first swap-in faults and
+        # its retry proves the payload survived the recovery.
         for i, site in enumerate(SITES):
-            inj.arm(site, "raise", nth=3 + 2 * i)
+            if site == "swap_out":
+                inj.arm(site, "raise", nth=2)
+            elif site == "swap_in":
+                inj.arm(site, "raise", nth=1)
+            else:
+                inj.arm(site, "raise", nth=3 + 2 * i)
         for i in range(stall_faults):
             inj.arm("transfer", "stall", nth=30 + 40 * i)
         sup = EngineSupervisor(
@@ -138,9 +151,24 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
         reqs = []
         steps = 0
         with inj:
+            # TRICKLE the submissions (two steps between arrivals)
+            # instead of batching them up front: strictly-by-class
+            # admission would otherwise drain every HIGH before any
+            # LOW ever holds a slot, and the preemption path — and
+            # with it the host tier's swap_out/swap_in sites
+            # (ISSUE 10) — would never execute. Arrival dynamics are
+            # what make HIGH-preempts-running-LOW happen.
             for p, m, prio in jobs:
                 reqs.append(sup.submit(p, max_new_tokens=m,
                                        priority=prio))
+                for _ in range(2):
+                    try:
+                        sup.step()
+                    except EngineDead:
+                        raise SoakError(
+                            "circuit breaker opened mid-soak — raise "
+                            "circuit_threshold or lower the fault rate")
+                    steps += 1
             while True:
                 try:
                     if not sup.step():
@@ -153,13 +181,54 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                 if steps >= max_steps:
                     raise SoakError(f"soak did not drain within "
                                     f"{max_steps} steps")
+            # deterministic SWAP DRILL (ISSUE 10): two rounds of
+            # fill-slots-then-HIGH-preempts, so the swap_out/swap_in
+            # sites get guaranteed visits (and their armed shots
+            # guaranteed firings) even at small --requests where the
+            # organic arrival mix may preempt only once. The fillers
+            # are NORMAL class — the degraded ladder may be shedding
+            # LOW by now, and a shed filler never occupies the slot a
+            # preemption needs. References for these requests are
+            # computed after the injector uninstalls, like the
+            # top-ups'.
+            topup_jobs = []
+            for _ in range(2):
+                lows = []
+                for _ in range(3):          # max_batch slots
+                    p = rs.randint(3, cfg.vocab_size, (6,)).astype(
+                        np.int32)
+                    lows.append(sup.submit(p, max_new_tokens=8,
+                                           priority=Priority.NORMAL))
+                    reqs.append(lows[-1])
+                    topup_jobs.append((p, 8))
+                while not all(len(r.tokens) >= 2 or r.done
+                              for r in lows):
+                    try:
+                        sup.step()
+                    except EngineDead:
+                        raise SoakError("circuit opened in swap drill")
+                    steps += 1
+                    if steps >= max_steps:
+                        raise SoakError("swap drill did not settle")
+                p = rs.randint(3, cfg.vocab_size, (4,)).astype(np.int32)
+                reqs.append(sup.submit(p, max_new_tokens=2,
+                                       priority=Priority.HIGH))
+                topup_jobs.append((p, 2))
+                while True:
+                    try:
+                        if not sup.step():
+                            break
+                    except EngineDead:
+                        raise SoakError("circuit opened in swap drill")
+                    steps += 1
+                    if steps >= max_steps:
+                        raise SoakError("swap drill did not drain")
             # keep injecting until the fault budget is spent: top up
             # with fresh NORMAL traffic so every site stays hot (the
             # top-ups' uninterrupted references are computed AFTER the
             # injector uninstalls — a faulted reference run would gate
             # parity against a poisoned oracle)
             topup = 0
-            topup_jobs = []
             while inj.fired_total < faults:
                 p = rs.randint(3, cfg.vocab_size,
                                (int(rs.randint(3, 20)),)).astype(np.int32)
@@ -295,9 +364,13 @@ def run_cluster_soak(seed: int = 0, requests: int = 18,
     circuit = 3
 
     def factory():
+        # host tier ON (ISSUE 10); the cluster shares ONE HostPageStore
+        # across replicas (share_host_tier default), so sessions the
+        # killed replica swapped out SWAP IN on the replica they rehome
+        # to — the failover path exercises the cross-replica host tier
         return ContinuousBatchingEngine(
             params, cfg, max_batch=2, page_size=8, max_len=48,
-            prefill_chunk=8)
+            prefill_chunk=8, host_tier=True)
 
     # multi-tenant workload: each tenant has its own system prompt
     # (affinity + prefix hits) plus a unique tail, three priorities

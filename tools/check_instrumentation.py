@@ -89,6 +89,24 @@ REQUIRED = {
         ('fault_point("alloc")', 1),
         ('fault_point("free")', 1),
     ],
+    "paddle_tpu/serving/host_tier.py": [
+        # hierarchical KV tier (ISSUE 10): both halves of the
+        # swap pair (bytes/pages + transfer latency — the
+        # swap-vs-replay crossover model's inputs), the replay
+        # fallback counter (the honest cost of bounding host RAM),
+        # the host-pool occupancy gauges, and the demote/promote
+        # counters that make the prefix tier's hit economy visible
+        ("_obs.serving_swap_out(", 1),
+        ("_obs.serving_swap_in(", 1),
+        ("_obs.serving_swap_fallback(", 1),
+        ("_obs.serving_host_pool(", 1),
+        ("_obs.serving_prefix_demoted(", 1),
+        ("_obs.serving_prefix_promoted(", 1),
+        # fault-injection sites: swap-out BEFORE the gather, swap-in
+        # BEFORE the allocation — both commit nothing when they fire
+        ('fault_point("swap_out")', 1),
+        ('fault_point("swap_in")', 1),
+    ],
     "paddle_tpu/serving/cluster.py": [
         # disaggregated cluster (ISSUE 9): both halves of the
         # prefill→decode handoff pair (bytes/pages moved + latency —
@@ -148,6 +166,7 @@ REQUIRED = {
 _FAULT_SITE_MODULES = (
     "paddle_tpu/serving/paged_cache.py",
     "paddle_tpu/serving/scheduler.py",
+    "paddle_tpu/serving/host_tier.py",
     "paddle_tpu/inference/predictor.py",
 )
 

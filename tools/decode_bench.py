@@ -165,6 +165,16 @@ def main():
         out["decode_cluster_scaling"] = scaling
         return tps
     run_tier("decode_cluster_tokens_per_sec", _cluster)
+
+    # hierarchical KV host tier (ISSUE 10): the bursty preempt workload
+    # with swap-out/swap-in resume — swap-in latency p50 and the
+    # vs-replay-prefill ratio ride the record next to the throughput
+    def _offload():
+        tps, resume = bench_mod.offload_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        out["decode_offload_resume"] = resume
+        return tps
+    run_tier("decode_offload_tokens_per_sec", _offload)
     int8_p = {}
 
     def _int8():
@@ -182,6 +192,7 @@ def main():
         "decode_prefix_tokens_per_sec", "decode_sched_tokens_per_sec",
         "decode_spec_tokens_per_sec", "decode_tp_tokens_per_sec",
         "decode_cluster_tokens_per_sec",
+        "decode_offload_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")

@@ -83,6 +83,7 @@ if dec.get("decode_tokens_per_sec") is not None:
               "decode_spec_tokens_per_sec",
               "decode_tp_tokens_per_sec",
               "decode_cluster_tokens_per_sec",
+              "decode_offload_tokens_per_sec",
               "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
               "decode_w8kv8_tokens_per_sec"):
         if dec.get(k) is None:
@@ -113,7 +114,8 @@ if dec.get("decode_tokens_per_sec") is not None:
     # step-latency bound (ISSUE 4) and the speculative tier's
     # acceptance rate (ISSUE 5 — the number that explains the tput)
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
-                  "decode_tp_scaling", "decode_cluster_scaling"):
+                  "decode_tp_scaling", "decode_cluster_scaling",
+                  "decode_offload_resume"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
             lg["extra"][rider] = ms
