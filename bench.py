@@ -158,7 +158,7 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_w8kv8_tps=None, decode_paged_tps=None,
             decode_prefix_tps=None, decode_sched=None,
             decode_spec=None, decode_tp=None, decode_cluster=None,
-            decode_offload=None, phases=None):
+            decode_offload=None, decode_fused=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -207,6 +207,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # the host-tier tier's point is the RESUME cost it removed:
         # swap-in latency + the ratio vs the replay-prefill baseline
         rec["extra"]["decode_offload_resume"] = decode_offload[1]
+    if decode_fused:
+        # fused-kernel rider on the paged tier (ISSUE 11): per-step
+        # wall ms unfused vs fused + the throughput ratio — the direct
+        # measurement of the Pallas fusions' HBM win
+        rec["extra"]["decode_fused_speedup"] = decode_fused
     if phases is not None:
         rec["phases"] = phases
     return _backfill_decode(rec)
@@ -306,14 +311,82 @@ def _engine_tier(params, cfg, db, dnew, max_len, on_tpu, make_prompts,
 
 
 def paged_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
-                      kv_cache_dtype=None):
+                      kv_cache_dtype=None, fused_rider=True):
     """The decode_paged_tokens_per_sec measurement, shared by measure()
     and tools/decode_bench.py so the two sources stay comparable:
     mixed prompt lengths through the :func:`_engine_tier` scaffold.
     The prefix cache is OFF: this tier is the paged-engine baseline the
     prefix tier's delta is measured against (the warm pass resubmits
     the same prompts, so a warm trie would silently convert the timed
-    pass into a prefix-hit workload)."""
+    pass into a prefix-hit workload).
+
+    Returns ``(tokens_per_sec, decode_fused_speedup)`` (ISSUE 11): the
+    rider re-runs the IDENTICAL workload with the fused Pallas serving
+    kernels on (``fused=True`` — in-VMEM q-RoPE + KV dequant in the
+    decode kernel, flash chunk attention behind prefill) and reports
+    per-step wall ms for both paths plus the throughput ratio — the
+    direct measurement of what the fusions buy at this geometry. The
+    rider is best-effort: a fused-path failure leaves the baseline
+    number standing with the rider None."""
+    import numpy as np
+    plens = [dp_len if i % 2 else max(dp_len // 2, 1)
+             for i in range(2 * db)]
+    rngp = np.random.default_rng(2)
+    prompts = [rngp.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in plens]
+
+    def run(fused):
+        info = {}
+
+        def snap(eng):
+            info["s0"], info["t0"] = eng._steps, time.perf_counter()
+
+        tps, eng = _engine_tier(
+            params, cfg, db, dnew, dp_len + dnew, on_tpu,
+            lambda: prompts, between_passes=snap,
+            kv_cache_dtype=kv_cache_dtype, enable_prefix_cache=False,
+            fused=fused)
+        steps = max(eng._steps - info["s0"], 1)
+        step_ms = (time.perf_counter() - info["t0"]) * 1e3 / steps
+        return tps, round(step_ms, 3)
+
+    tps, step_ms = run(False)
+    rider = None
+    if not fused_rider:
+        # budget-guarded skip (measure()/decode_bench gate it like any
+        # other optional tier): the baseline number must never pay for
+        # its own rider on a slow-compile day
+        return tps, rider
+    try:
+        fused_tps, fused_ms = run(True)
+        rider = {"fused_tokens_per_sec": fused_tps,
+                 "unfused_step_ms": step_ms,
+                 "fused_step_ms": fused_ms,
+                 "speedup": round(fused_tps / tps, 3) if tps else None}
+    except Exception as e:
+        print(f"fused paged tier failed: {type(e).__name__}: {e}"[:300],
+              file=sys.stderr)
+    return tps, rider
+
+
+def lowbit_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                       weight_bits, kv_cache_dtype=None):
+    """The decode_int4_tokens_per_sec / decode_w8kv8_tokens_per_sec
+    measurement (ISSUE 11), shared by measure() and
+    tools/decode_bench.py so the two sources stay comparable.
+
+    The PAGED ENGINE's mixed-length workload (identical mix /
+    oversubscription / page-size rule as decode_paged — the tier it is
+    deltaed against) with LOW-BIT weights: ``weight_bits=4`` is the
+    per-group-int4 tier (quarter weight bytes — decode is HBM-bound,
+    so the ratio vs decode_paged at the same lengths IS the
+    weight-bandwidth win), ``weight_bits=8`` with
+    ``kv_cache_dtype="int8"`` the w8/kv8 tier (weight AND KV bytes
+    halved). Until this tier landed both slots were measured on the
+    DENSE generate() path and had never produced a live number; the
+    engine tier is what the serving tower actually ships. Prefix cache
+    OFF (the paged-tier rule: the warm pass must not convert the timed
+    pass into a hit workload)."""
     import numpy as np
     plens = [dp_len if i % 2 else max(dp_len // 2, 1)
              for i in range(2 * db)]
@@ -322,6 +395,7 @@ def paged_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                for n in plens]
     return _engine_tier(params, cfg, db, dnew, dp_len + dnew, on_tpu,
                         lambda: prompts, kv_cache_dtype=kv_cache_dtype,
+                        weight_bits=weight_bits,
                         enable_prefix_cache=False)[0]
 
 
@@ -695,7 +769,9 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                   ("decode_cluster_tokens_per_sec",
                    "decode_cluster_scaling"),
                   ("decode_offload_tokens_per_sec",
-                   "decode_offload_resume"))
+                   "decode_offload_resume"),
+                  ("decode_paged_tokens_per_sec",
+                   "decode_fused_speedup"))
 
 
 def _label_decode_source(extra: dict, carried_tiers,
@@ -918,23 +994,28 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"int8 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
-    # per-group int4 variant (quarter weight bytes; reference weight_only
-    # int4 path)
+    # per-group int4 variant on the PAGED ENGINE (ISSUE 11): quarter
+    # weight bytes through the serving tower the cluster actually
+    # ships, not the dense generate() path the slot used to alias.
+    # Gated on the fp decode baseline only — a dense-int8 failure must
+    # not null the paged low-bit slots (the pre-ISSUE-11 outcome)
     decode_int4_tps = None
-    if decode_int8_tps is not None and (not on_tpu or remaining() > 120):
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
         try:
-            decode_int4_tps = decode_rate(
-                gen.quantize_weights(state.params, cfg, bits=4))
+            decode_int4_tps = lowbit_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu, 4)
         except Exception as e:
             print(f"int4 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
-    # weight-int8 + KV-int8: the serving sweet spot (both weight AND
-    # cache HBM traffic halved) — cheapest-to-skip, so it goes last
+    # weight-int8 + KV-int8 on the PAGED ENGINE: the serving sweet spot
+    # (both weight AND cache HBM traffic halved)
     decode_w8kv8_tps = None
-    if decode_int8_tps is not None and (not on_tpu or remaining() > 120):
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
         try:
-            decode_w8kv8_tps = decode_rate(int8_params, kv="int8")
+            decode_w8kv8_tps = lowbit_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu, 8,
+                kv_cache_dtype="int8")
         except Exception as e:
             print(f"w8kv8 decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
@@ -942,12 +1023,14 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
     # paged KV + continuous batching at MIXED request lengths: the
     # serving-engine tier (paddle_tpu/serving + ContinuousBatchingEngine)
     # — throughput includes the host scheduling loop, i.e. what a server
-    # actually ships
+    # actually ships; the fused-kernel speedup rider travels with it
     decode_paged_tps = None
+    decode_fused = None
     if decode_tps is not None and (not on_tpu or remaining() > 120):
         try:
-            decode_paged_tps = paged_decode_tier(
-                state.params, cfg, db, dp_len, dnew, on_tpu)
+            decode_paged_tps, decode_fused = paged_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu,
+                fused_rider=not on_tpu or remaining() > 240)
         except Exception as e:
             print(f"paged decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
@@ -1037,7 +1120,8 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_paged_tps, decode_prefix_tps,
                    decode_sched=decode_sched, decode_spec=decode_spec,
                    decode_tp=decode_tp, decode_cluster=decode_cluster,
-                   decode_offload=decode_offload, phases=phases)
+                   decode_offload=decode_offload,
+                   decode_fused=decode_fused, phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

@@ -479,12 +479,38 @@ class ContinuousBatchingEngine:
                  spec_k: int = 0, spec_ngram: int = 3,
                  speculator=None, mesh=None,
                  host_tier: bool = False,
-                 host_tier_kw: Optional[Dict] = None):
+                 host_tier_kw: Optional[Dict] = None,
+                 weight_bits: Optional[int] = None,
+                 fused: Optional[bool] = None):
         from ..serving import PagedKVCache
         self.cfg = cfg
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
         self.use_kernel = use_kernel
+        # --- low-bit decode tiers (ISSUE 11): weight_bits quantizes the
+        # weights at construction (8 = per-channel int8, 4 = per-group
+        # int4 — models/generate.quantize_weights); every serving
+        # program dequants on the fly inside its matmul reads, and the
+        # quant scales shard under the same regex partition rules as
+        # their matrices. weight_bits=8 + kv_cache_dtype="int8" is the
+        # w8/kv8 tier (weight AND cache HBM halved). Pre-quantized
+        # param trees pass through untouched (weight_bits=None).
+        self.weight_bits = weight_bits
+        if weight_bits is not None:
+            from ..models.generate import quantize_weights
+            params = quantize_weights(params, cfg, bits=weight_bits)
+        # --- fused serving kernels (ISSUE 11): route the decode /
+        # chunked-prefill / spec-verify programs through the fused
+        # Pallas kernels (ops/pallas/serving_fused.py — in-VMEM q-RoPE
+        # + KV dequant for decode, flash chunk attention for
+        # prefill/verify). Default OFF, same contract as
+        # LlamaConfig.fused_kernels: flip only with a sweep showing >=
+        # parity (the decode_fused_speedup bench rider measures it);
+        # off-TPU the fused path is the bit-identical reference, and
+        # the kernels themselves are gated token-identical per tier
+        # (tests/test_lowbit_decode.py) + Mosaic-lowered by
+        # aot_validate --config serving-lowbit.
+        self.fused = bool(fused)
         # --- tensor-parallel serving (ISSUE 7): a 1-D mesh shards the
         # weights (llama.SERVING_TP_RULES: column splits + vocab-sharded
         # lm_head) and every page pool on the kv-head axis; the jitted
@@ -626,12 +652,12 @@ class ContinuousBatchingEngine:
         if self._decode_fn is None:
             from ..models import generate as gen
             cfg, temp, uk = self.cfg, self.temperature, self.use_kernel
-            ax = self._tp_axis
+            ax, fz = self._tp_axis, self.fused
 
             def fwd(params, last, paged, tables, lengths, active):
                 return gen.paged_decode_forward(
                     params, last, paged, tables, lengths, cfg,
-                    active=active, use_kernel=uk, tp_axis=ax)
+                    active=active, use_kernel=uk, tp_axis=ax, fused=fz)
 
             if self.mesh is not None:
                 fwd = self._tp_map(fwd, ("params", "rep", "pool",
@@ -660,12 +686,14 @@ class ContinuousBatchingEngine:
         key = (ctx_cap, width)
         if key not in self._chunk_fns:
             from ..models import generate as gen
-            cfg, ax = self.cfg, self._tp_axis
+            cfg, ax, fz = self.cfg, self._tp_axis, self.fused
+            uk = self.use_kernel
 
             def f(params, chunk, paged, table, ctx_len, chunk_len):
                 return gen.paged_prefill_chunk(
                     params, chunk, paged, table, cfg, ctx_cap=ctx_cap,
-                    ctx_len=ctx_len, chunk_len=chunk_len, tp_axis=ax)
+                    ctx_len=ctx_len, chunk_len=chunk_len, tp_axis=ax,
+                    fused=fz, use_kernel=uk)
 
             if self.mesh is not None:
                 f = self._tp_map(f, ("params", "rep", "pool", "rep",
@@ -684,12 +712,13 @@ class ContinuousBatchingEngine:
         if key not in self._spec_fns:
             from ..models import generate as gen
             cfg, uk, ax = self.cfg, self.use_kernel, self._tp_axis
+            fz = self.fused
 
             def fwd(params, chunk, paged, tables, lengths, active):
                 return gen.paged_verify_forward(
                     params, chunk, paged, tables, lengths, cfg,
                     ctx_cap=ctx_cap, active=active, use_kernel=uk,
-                    tp_axis=ax)
+                    tp_axis=ax, fused=fz)
 
             if self.mesh is not None:
                 fwd = self._tp_map(fwd, ("params", "rep", "pool",
@@ -914,6 +943,8 @@ class ContinuousBatchingEngine:
             self.params, jnp.asarray(chunk), cache.pool,
             jnp.asarray(cache.block_tables[slot]), jnp.int32(done),
             jnp.int32(take))
+        if self.fused:
+            _obs.serving_fused_latency("chunk_flash_attn", t0, logits)
         _obs.serving_prefill_chunk(t0, logits, take)
         done += take
         if done < S:
@@ -988,7 +1019,8 @@ class ContinuousBatchingEngine:
         _obs.serving_tp_logits_gather(t0, probe(x))
 
     # ---- prefill→decode KV handoff (ISSUE 9) ----
-    def export_prefilled(self, req: GenerationRequest) -> Dict:
+    def export_prefilled(self, req: GenerationRequest,
+                         with_kv: bool = True) -> Dict:
         """Export a fully prefilled, decode-ready request's KV pages as
         a handoff payload (the disaggregated cluster's prefill→decode
         transfer): the slot's live page bytes
@@ -996,7 +1028,11 @@ class ContinuousBatchingEngine:
         committed length and the already-sampled last token. PURE READ
         — the request keeps running here until :meth:`finish_handoff`
         detaches it, so a failed import on the decode side loses
-        nothing."""
+        nothing. ``with_kv=False`` (the ISSUE 11 fused direct-handoff
+        path) skips materializing the page bytes on the host — the
+        importer copies them device-to-device through the fused
+        :func:`~paddle_tpu.serving.paged_cache._pool_move` instead;
+        the payload then carries only the slot metadata."""
         slot = req.slot
         if slot is None or self._slots[slot] is not req:
             raise ValueError(
@@ -1005,13 +1041,15 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"export_prefilled: request {req.rid} is still "
                 f"mid-prefill — hand off only decode-ready slots")
-        return {"rid": req.rid, "slot": slot,
-                "length": int(self.cache.lengths[slot]),
-                "last": int(self._last[slot]),
-                "kv": self.cache.export_request(slot)}
+        out = {"rid": req.rid, "slot": slot,
+               "length": int(self.cache.lengths[slot]),
+               "last": int(self._last[slot])}
+        if with_kv:
+            out["kv"] = self.cache.export_request(slot)
+        return out
 
     def import_prefilled(self, req: GenerationRequest,
-                         payload: Dict) -> bool:
+                         payload: Dict, src_engine=None) -> bool:
         """Install an exported request DIRECTLY into a decode slot: the
         payload's pages scatter into freshly allocated pages
         (:meth:`~paddle_tpu.serving.PagedKVCache.import_request`), the
@@ -1021,14 +1059,26 @@ class ContinuousBatchingEngine:
         Returns False when no slot is free; raises
         :class:`~paddle_tpu.serving.PoolExhausted` (nothing changed)
         when the pool can't cover it. Decode from here is BIT-identical
-        to having prefilled in place."""
+        to having prefilled in place.
+
+        ``src_engine`` (ISSUE 11): the exporting engine, when it shares
+        this process — the pages then copy device-to-device through the
+        fused :func:`~paddle_tpu.serving.paged_cache._pool_move` (one
+        donated program, no host staging) and the payload needs no
+        ``"kv"`` bytes (``export_prefilled(with_kv=False)``). Same
+        byte-identity gate either way."""
         free = self.cache.free_slots()
         if not free:
             return False
         slot = free[0]
-        self.cache.import_request(
-            slot, payload["kv"],
-            req.prompt.shape[1] + req.max_new_tokens)
+        if src_engine is not None:
+            self.cache.import_request_direct(
+                slot, src_engine.cache, payload["slot"],
+                req.prompt.shape[1] + req.max_new_tokens)
+        else:
+            self.cache.import_request(
+                slot, payload["kv"],
+                req.prompt.shape[1] + req.max_new_tokens)
         self.cache.lengths[slot] = np.int32(payload["length"])
         self._last[slot] = np.int32(payload["last"])
         req.slot = slot
@@ -1077,12 +1127,14 @@ class ContinuousBatchingEngine:
         # fault at either leaves the request handles at the previous
         # step's committed state (the supervisor's recovery contract)
         _fault_point("decode_step")
+        t0f = _obs.generate_begin() if self.fused else 0
         self._key, k = jax.random.split(self._key)
         nxt, cache.pool = self._decode()(
             self.params, jnp.asarray(self._last), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths),
             jnp.asarray(mask), k)
+        _obs.serving_fused_latency("decode_rope_attn", t0f, nxt)
         _fault_point("transfer")
         nxt = np.asarray(nxt)
         n_active = int(mask.sum())
@@ -1179,6 +1231,8 @@ class ContinuousBatchingEngine:
             self.params, jnp.asarray(chunk), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths), jnp.asarray(mask))
+        if self.fused:
+            _obs.serving_fused_latency("verify_flash_attn", t0, out)
         _fault_point("transfer")
         out = np.asarray(out)              # (B, T) greedy targets
         t1 = time.perf_counter_ns()        # device fence: verify done
@@ -1279,6 +1333,10 @@ class ContinuousBatchingEngine:
             s["pool_bytes_per_shard"] = self.cache.pool_bytes_per_shard
         s["active_slots"] = int(self.cache.active.sum())
         s["pending_prefills"] = len(self._pending)
+        if self.weight_bits is not None:
+            s["weight_bits"] = self.weight_bits
+        if self.fused:
+            s["fused_kernels"] = True
         s["cow_copies"] = self.cache.cow_copies
         if getattr(self.cache, "host", None) is not None:
             s.update(self.cache.tier_stats())

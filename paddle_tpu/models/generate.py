@@ -199,7 +199,8 @@ def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
 
 def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
                         block_table: jax.Array, cfg: LlamaConfig, *,
-                        ctx_cap: int, ctx_len, chunk_len, tp_axis=None):
+                        ctx_cap: int, ctx_len, chunk_len, tp_axis=None,
+                        fused=None, use_kernel=None):
     """Prefill ONE chunk of a request's prompt against the KV already in
     its pages — the chunked-prefill / prefix-cache continuation program
     (one compile per static ``(ctx_cap, C)`` pair; the engine buckets
@@ -242,7 +243,12 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
 
     ``tp_axis``: run as one tensor-parallel shard (inside shard_map;
     see :func:`_block_infer`) — ``paged`` then holds the shard's own kv
-    heads and the temp cache is sized from the pool, not the config."""
+    heads and the temp cache is sized from the pool, not the config.
+
+    ``fused`` (ISSUE 11): the chunk's attention runs through the flash
+    prefill kernel (``ops/pallas/serving_fused.flash_chunk_attention``)
+    instead of the materialized-score jnp path — same ragged
+    ``kstart``/``rpos`` masks, int8 dequant in VMEM."""
     B, C = tokens.shape
     if B != 1:
         raise ValueError(
@@ -274,9 +280,10 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     kstart = pad[None]                                  # (1,)
     rpos = (ctx_len + jnp.arange(C, dtype=jnp.int32))[None, :]
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
-                                    W, rpos=rpos, kstart=kstart,
+                                    W, use_kernel=use_kernel, rpos=rpos,
+                                    kstart=kstart,
                                     logits_at=chunk_len - 1,
-                                    tp_axis=tp_axis)
+                                    tp_axis=tp_axis, fused=bool(fused))
     pos = jnp.arange(C, dtype=jnp.int32)
     logical = jnp.clip(ctx_len + pos, 0, ext - 1)
     dst = jnp.where(pos < chunk_len,
@@ -292,7 +299,7 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
 def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, ctx_cap: int, active=None,
-                         use_kernel=None, tp_axis=None):
+                         use_kernel=None, tp_axis=None, fused=None):
     """Batched speculative-decode VERIFY: score a ``T``-token chunk for
     EVERY speculating row against its paged KV in ONE forward — the
     batched generalization of :func:`paged_prefill_chunk` (which runs
@@ -363,7 +370,7 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
                                     W, use_kernel=use_kernel, rpos=rpos,
                                     kstart=pad, logits_all=True,
-                                    tp_axis=tp_axis)
+                                    tp_axis=tp_axis, fused=bool(fused))
     # scatter the T new rows of every row into its pages; inactive rows
     # and positions past the slot extent route to the trash page
     pos = rpos                                           # (B, T)
@@ -383,7 +390,7 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, active=None,
-                         use_kernel=None, tp_axis=None):
+                         use_kernel=None, tp_axis=None, fused=None):
     """One continuous-batching decode step over the ragged batch: every
     slot advances one token in a single static-shape program.
 
@@ -406,8 +413,23 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
     tables/lengths replicate), attention is per-head local (no comm in
     the kernel), and activations all-gather to full width before each
     contraction — exact concats, so tp decode stays BIT-identical to
-    single-chip paged decode (gated in tests/test_tp_serving.py)."""
+    single-chip paged decode (gated in tests/test_tp_serving.py).
+
+    ``fused`` (ISSUE 11): route attention through the FUSED
+    dequant+RoPE+paged-attention kernel
+    (:func:`~paddle_tpu.ops.pallas.serving_fused.
+    fused_paged_decode_attention`) — q streams into the kernel
+    unrotated with its per-row cos/sin rows and both the rotation and
+    the int8 dequant happen in VMEM, removing the rotated-q HBM
+    round-trip per layer. Off-TPU the fused reference path is
+    BIT-identical to the unfused one by construction; the kernel path
+    is gated token-identical per tier (tests/test_lowbit_decode.py).
+    Weight-quantized params (int8/int4 — :func:`quantize_weights`) ride
+    either path unchanged: ``_w`` dequants on the fly, which is the
+    low-bit decode tier."""
     from ..ops.pallas import paged_attention as _pa
+    from ..ops.pallas import serving_fused as _sf
+    fused = bool(fused)
     B = tokens.shape[0]
     page = paged["k"].shape[2]
     ext = block_tables.shape[1] * page
@@ -420,6 +442,11 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
     lengths = jnp.asarray(lengths, jnp.int32)
     cos, sin = rope_tables(ext, cfg.hd, cfg.rope_theta)
     rpos = lengths[:, None]                          # (B, 1)
+    if fused:
+        # per-row rope table rows for the in-kernel rotation (the new
+        # token sits at position ``lengths``, always < ext)
+        cos_row = jnp.take(cos, lengths, axis=0)     # (B, hd/2)
+        sin_row = jnp.take(sin, lengths, axis=0)
     # per-row destination slot; inactive rows dump into the trash page
     # (page 0 slot 0 — reserved by serving.BlockAllocator) so a retired
     # slot's stale table can never clobber a live request's pages
@@ -441,7 +468,10 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
         q = (h1 @ _w(lp, "wq", xc.dtype)).reshape(B, 1, nh, hd)
         k = (h1 @ _w(lp, "wk", xc.dtype)).reshape(B, 1, nkv, hd)
         v = (h1 @ _w(lp, "wv", xc.dtype)).reshape(B, 1, nkv, hd)
-        q = _rope_rows(q, cos, sin, rpos)
+        if not fused:
+            # unfused: q rotates here in XLA and round-trips HBM into
+            # the attention op; fused moves this rotation into VMEM
+            q = _rope_rows(q, cos, sin, rpos)
         k = _rope_rows(k, cos, sin, rpos)
         if quant:
             sc = jnp.maximum(
@@ -467,9 +497,23 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                 k[:, 0].astype(kp.dtype)).reshape(kp.shape)
             vp = vp.reshape((-1,) + vp.shape[2:]).at[dst].set(
                 v[:, 0].astype(vp.dtype)).reshape(vp.shape)
-        o = _pa.paged_attention(
-            q[:, 0], kp, vp, block_tables, lengths + 1,
-            ks_pages=ksp, vs_pages=vsp, use_kernel=use_kernel)
+        if fused:
+            # trace-time dispatch counter + bytes-saved estimate: the
+            # rotated q's HBM write+read per layer (plus, on int8
+            # tiers, the in-VMEM dequant the unfused reference pays as
+            # an fp copy) — fires once per compile per layer, like
+            # serving_tp_allgather
+            _obs.serving_fused_dispatch(
+                "decode_rope_attn",
+                2 * B * nh * hd * jnp.dtype(cfg.dtype).itemsize)
+            o = _sf.fused_paged_decode_attention(
+                q[:, 0], cos_row, sin_row, kp, vp, block_tables,
+                lengths + 1, ks_pages=ksp, vs_pages=vsp,
+                use_kernel=use_kernel)
+        else:
+            o = _pa.paged_attention(
+                q[:, 0], kp, vp, block_tables, lengths + 1,
+                ks_pages=ksp, vs_pages=vsp, use_kernel=use_kernel)
         o = o.reshape(B, 1, nh * hd)
         if tp_axis is not None:
             o = _tp_allgather(o, tp_axis, 2)
@@ -588,13 +632,20 @@ def _use_decode_kernel(override=None):
 
 
 def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
-                     kstart=None, k_rows=None, v_rows=None):
+                     kstart=None, k_rows=None, v_rows=None,
+                     fused=False):
     """q (B,T,nh,hd) vs cache (B,Smax,nkv,hd); positions >= length masked.
     length: scalar or (B,) current valid length INCLUDING q's tokens.
     kstart: optional (B,) first VALID cache position per row (left-padded
     ragged prompts — positions below it are pad slots and masked out).
     k_rows/v_rows: per-row dequant scales (B, Smax, nkv) for an int8
-    cache (see init_cache kv_dtype)."""
+    cache (see init_cache kv_dtype).
+    fused (ISSUE 11): route MULTI-token ragged attention (the chunked-
+    prefill and spec-verify programs — T > 1 with per-row ``kstart``)
+    through the flash chunk kernel
+    (:func:`~paddle_tpu.ops.pallas.serving_fused.flash_chunk_attention`)
+    instead of materializing the full (B, H, T, W) score tensor; the
+    off-TPU reference is op-for-op this function's jnp composition."""
     B, T, _, hd = q.shape
     if T == 1 and kstart is None and _use_decode_kernel(use_kernel):
         # single-token decode: fused block attention against the padded
@@ -604,6 +655,19 @@ def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
         o = decode_attention(q[:, 0], ck, cv, length,
                              k_dequant_rows=k_rows, v_dequant_rows=v_rows)
         return o[:, None]
+    if fused and kstart is not None and isinstance(length, int):
+        # flash prefill/verify kernel: online softmax over cache blocks
+        # with the exact kstart + per-query causal masks of the jnp
+        # path below; int8 temp caches dequantize in VMEM. The
+        # bytes-saved estimate is the f32 score+prob round-trip the
+        # unfused composition materializes. Trace-time counter, once
+        # per compile (serving_tp_allgather contract).
+        from ..ops.pallas.serving_fused import flash_chunk_attention
+        _obs.serving_fused_dispatch(
+            "chunk_flash_attn", 2 * B * nh * T * ck.shape[1] * 4)
+        return flash_chunk_attention(
+            q, ck, cv, length, kstart, k_rows=k_rows, v_rows=v_rows,
+            use_kernel=use_kernel)
     if k_rows is not None:
         # XLA fuses the dequant into the attention reads
         ck = (ck.astype(jnp.float32) * k_rows[..., None]).astype(q.dtype)
@@ -637,7 +701,8 @@ def _rope_rows(x, cos, sin, rpos):
 
 def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                  use_kernel=None, rpos=None, kstart=None,
-                 cache_ks=None, cache_vs=None, tp_axis=None):
+                 cache_ks=None, cache_vs=None, tp_axis=None,
+                 fused=False):
     """One decoder layer over T tokens starting at cache index ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
     rpos: optional (B,T) per-row rope positions (!= cache index when the
@@ -697,7 +762,8 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
     o = _attn_with_cache(q, cache_k, cache_v, pos + T, nh,
                          use_kernel=use_kernel, kstart=kstart,
                          k_rows=cache_ks if quant else None,
-                         v_rows=cache_vs if quant else None)
+                         v_rows=cache_vs if quant else None,
+                         fused=fused)
     o = o.reshape(B, T, nh * hd)
     if tp_axis is not None:
         # full heads before the (column-sharded) wo contraction, then
@@ -721,7 +787,7 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
                     kstart=None, logits_at=None, logits_all=False,
-                    tp_axis=None):
+                    tp_axis=None, fused=False):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
     (B, V), updated cache). ``logits_at``: optional TRACED row index
     into ``tokens`` — logits are taken there instead of at row T-1
@@ -746,7 +812,7 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
         y, nk, nv, nks, nvs = _block_infer(
             xc, lp, ck, cv, pos, cos, sin, cfg, use_kernel=use_kernel,
             rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs,
-            tp_axis=tp_axis)
+            tp_axis=tp_axis, fused=fused)
         return y, ((nk, nv, nks, nvs) if quant else (nk, nv))
 
     xs = ((params["layers"], cache["k"], cache["v"], cache["ks"],

@@ -215,8 +215,14 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
 
 #: name-regex -> rule for :func:`match_partition_rules` ("last" shards
 #: the final axis over tp; "replicate" keeps the leaf whole). Quantized
-#: serving weights ride along: per-channel/per-group scales end in the
-#: same output axis as the matrix they scale.
+#: serving weights ride along on the SAME rule as their matrix: the
+#: per-channel int8 scale ``(L, out)`` and the per-GROUP int4 scale
+#:``(L, G, out)`` (ISSUE 11) both end in the output axis the rule
+#: shards, so a ``weight_bits=4`` tree partitions with zero extra
+#: rules — and :func:`_expand_kv_heads` applies the GQA replication
+#: transform to ``wk_scale``/``wv_scale`` exactly as to ``wk``/``wv``
+#: (per-head column blocks, group axis untouched). Coverage gated in
+#: tests/test_lowbit_decode.py.
 SERVING_TP_RULES = (
     (r"layers/(wq|wk|wv|wo|wg|wu|wd)(_scale)?$", "last"),
     (r"lm_head(_scale)?$", "last"),

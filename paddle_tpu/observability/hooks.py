@@ -642,6 +642,55 @@ def serving_prefix_promoted(t0_ns: int, pages: int):
                "(demote/persist hits)").inc(pages)
 
 
+# ---------------- fused serving kernels (ISSUE 11) ----------------
+
+def serving_fused_dispatch(kernel: str, bytes_saved: int):
+    """One fused-kernel dispatch TRACED into a serving program
+    (models/generate's fused decode/chunk/verify branches and the
+    paged-cache fused page move). Like :func:`serving_tp_allgather`
+    this fires at TRACE time — the counters report the fused launches
+    (and the HBM bytes each fusion removes from the hot loop: the
+    rotated-q round-trip, the materialized f32 score/prob tensors, the
+    host-staged page payload) in each COMPILED program, once per
+    compile — exactly the per-step fusion bill. ``bytes_saved`` also
+    feeds the per-kernel bytes-saved gauge the PERF_NOTES roofline
+    model reads."""
+    if not enabled:
+        return
+    _m.counter("serving_fused_dispatch_total",
+               "fused-kernel launches traced into serving programs",
+               ("kernel",)).labels(kernel).inc()
+    _m.counter("serving_fused_bytes_saved_total",
+               "estimated HBM bytes the fused kernels keep out of the "
+               "decode hot loop (per traced launch)",
+               ("kernel",)).labels(kernel).inc(int(bytes_saved))
+    _m.gauge("serving_fused_bytes_saved",
+             "estimated HBM bytes saved per launch by each fused "
+             "serving kernel", ("kernel",)).labels(kernel).set(
+        int(bytes_saved))
+
+
+def serving_fused_latency(kernel: str, t0_ns: int, out):
+    """Close one HOST-timed fused-path step opened at ``t0_ns`` (the
+    engine's decode/prefill/verify step with fusion on, or one fused
+    page move): blocks on ``out`` so the histogram holds real device
+    wall time per kernel — the ``decode_fused_speedup`` bench rider's
+    per-kernel breakdown."""
+    if not t0_ns:
+        return
+    _block(out)
+    now = time.perf_counter_ns()
+    _record(f"Serving.fused.{kernel}", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_fused_step_ms",
+                 "wall milliseconds per fused-path serving step",
+                 ("kernel",),
+                 buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                          250, 1000)).labels(kernel).observe(
+        (now - t0_ns) / 1e6)
+
+
 # ---------------- disaggregated cluster serving (ISSUE 9) ----------------
 
 def serving_router_dispatch(replica: int, affinity_hit: bool):

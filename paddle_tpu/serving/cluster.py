@@ -92,7 +92,8 @@ class ServingCluster:
                  router: Optional[ClusterRouter] = None,
                  clock: Callable[[], float] = time.monotonic,
                  supervisor_kw: Optional[Dict] = None,
-                 share_host_tier: bool = True):
+                 share_host_tier: bool = True,
+                 direct_handoff: bool = False):
         if replicas < 1:
             raise ValueError(f"replicas={replicas} must be >= 1")
         if not 0 <= prefill_replicas < replicas:
@@ -134,6 +135,13 @@ class ServingCluster:
         self._live: Dict[int, object] = {}  # rid -> live request handle
         self._meta: Dict[int, Dict] = {}  # rid -> {tenant, cost}
         self._owner: Dict[int, int] = {}  # rid -> replica idx
+        # fused prefill→decode handoff (ISSUE 11): replicas sharing this
+        # process copy pages device-to-device through the donated
+        # serving.paged_cache._pool_move program instead of staging raw
+        # bytes through host numpy — byte-identical, gated in
+        # tests/test_lowbit_decode.py. Opt-in: cross-host clusters (and
+        # the PR 9 byte-payload gates) keep the host-staged path.
+        self.direct_handoff = bool(direct_handoff)
         self._seq = 0
         self._steps = 0
         self.handoffs_total = 0
@@ -400,10 +408,14 @@ class ServingCluster:
 
     def _handoff_one(self, sup, req, decode_loads: Dict[int, Dict]):
         eng = sup.engine
+        direct = self.direct_handoff
         t0 = _obs.generate_begin()
-        payload = eng.export_prefilled(req)     # pure host-side read
-        nbytes = sum(a.nbytes for a in payload["kv"]["arrays"].values())
-        pages = payload["kv"]["num_pages"]
+        # pure host-side read; the direct path exports metadata only —
+        # the page bytes move device-to-device inside the import
+        payload = eng.export_prefilled(req, with_kv=not direct)
+        pages = eng.cache.pages_for(payload["length"])
+        nbytes = (eng.cache.page_payload_bytes(pages) if direct else
+                  sum(a.nbytes for a in payload["kv"]["arrays"].values()))
         _obs.serving_handoff_export(t0, nbytes, pages)
         placed = None
         for didx in sorted(decode_loads,
@@ -412,7 +424,9 @@ class ServingCluster:
             dsup = self.replicas[didx]
             t1 = _obs.generate_begin()
             try:
-                if dsup.engine.import_prefilled(req, payload):
+                if dsup.engine.import_prefilled(
+                        req, payload,
+                        src_engine=eng if direct else None):
                     placed = didx
                     _obs.serving_handoff_import(t1)
                     break

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .resilience import fault_point
+from ..observability import hooks as _obs
 
 #: page id never handed out by the allocator — the write target for
 #: inactive rows of the static-shape decode program
@@ -56,6 +57,25 @@ def _pool_scatter(pool: Dict, vals: Dict, dst):
     return {name: arr.at[:, dst].set(jnp.asarray(vals[name])
                                      .astype(arr.dtype))
             for name, arr in pool.items()}
+
+
+def _pool_move(pool: Dict, src_ids, dst_ids, src_pool: Optional[Dict] = None):
+    """The FUSED page gather+scatter program (ISSUE 11): copy pages
+    ``src_ids`` into pages ``dst_ids`` for every pool array in ONE
+    donated jitted program — the device-to-device collapse of the
+    ``_pool_gather`` → host numpy → ``_pool_scatter`` pair the PR 9
+    handoff and PR 10 swap paths stage through host RAM. ``src_pool``
+    None moves pages WITHIN the donated pool (defrag compaction — the
+    gather is evaluated against the pre-update buffers, so overlapping
+    src/dst ranges are safe); a separate ``src_pool`` moves pages
+    ACROSS pools (the in-process prefill→decode handoff fast path —
+    source read-only, destination donated). Mosaic-lowered by
+    ``tools/aot_validate.py --config serving-lowbit``."""
+    import jax.numpy as jnp
+    src = pool if src_pool is None else src_pool
+    return {name: arr.at[:, dst_ids].set(
+        jnp.asarray(src[name])[:, src_ids].astype(arr.dtype))
+        for name, arr in pool.items()}
 
 
 def pool_partition_specs(pool: Dict, axis: str = "tp") -> Dict:
@@ -544,6 +564,9 @@ class PagedKVCache:
         self.cow_copies = 0
         self._cow_fn = None                     # jitted CoW row copier
         self._scatter_fn = None                 # jitted page-import scatter
+        self._move_fn = None                    # fused same-pool page move
+        self._move_from_fn = None               # fused cross-pool page move
+        self.direct_moves_total = 0
         # TRASH_PAGE-filled tables: unassigned entries route to trash
         self.block_tables = np.full((max_batch, self.pages_per_seq),
                                     TRASH_PAGE, np.int32)
@@ -735,6 +758,15 @@ class PagedKVCache:
     def utilization(self) -> float:
         return self.allocator.utilization()
 
+    def page_payload_bytes(self, k: int) -> int:
+        """Device bytes of ``k`` pages across every pool array — what a
+        host-staged :meth:`export_request` payload of that many pages
+        would weigh (the handoff byte-accounting for the fused direct
+        path, which never materializes those bytes)."""
+        return sum(
+            int(np.prod(a.shape[2:])) * a.shape[0] * k
+            * np.dtype(a.dtype).itemsize for a in self.pool.values())
+
     @property
     def pool_bytes_per_shard(self) -> int:
         """Device bytes of pool arrays RESIDENT PER SHARD — the number
@@ -815,6 +847,105 @@ class PagedKVCache:
             self.pool,
             {n: np.ascontiguousarray(a) for n, a in arrays.items()},
             jnp.asarray(np.asarray(dst, np.int32)))
+
+    def _move_pages(self, src_ids: Sequence[int], dst_ids: Sequence[int],
+                    src_cache: Optional["PagedKVCache"] = None):
+        """Run the fused :func:`_pool_move` program: pages ``src_ids``
+        (of this pool, or of ``src_cache``'s pool) copied into this
+        pool's ``dst_ids`` in one donated device program — no host
+        staging, no re-materialized pool. Compiled once per id-count
+        (the `_scatter_pages` contract) and carried across supervisor
+        rebuilds like the CoW/scatter programs."""
+        import jax
+        import jax.numpy as jnp
+        kw = {}
+        if self.mesh is not None:
+            # keep the kv-head sharding through the donated update
+            # (same reasoning as _scatter_pages)
+            from jax.sharding import NamedSharding
+            kw["out_shardings"] = {
+                n: NamedSharding(self.mesh, self.pool_specs[n])
+                for n in self.pool}
+        src = jnp.asarray(np.asarray(src_ids, np.int32))
+        dst = jnp.asarray(np.asarray(dst_ids, np.int32))
+        t0 = _obs.generate_begin()
+        if src_cache is None:
+            if self._move_fn is None:
+                self._move_fn = jax.jit(
+                    lambda pool, s, d: _pool_move(pool, s, d),
+                    donate_argnums=(0,), **kw)
+            self.pool = self._move_fn(self.pool, src, dst)
+        else:
+            if self._move_from_fn is None:
+                self._move_from_fn = jax.jit(
+                    lambda pool, sp, s, d: _pool_move(
+                        pool, s, d, src_pool=sp),
+                    donate_argnums=(0,), **kw)
+            self.pool = self._move_from_fn(self.pool, src_cache.pool,
+                                           src, dst)
+        self.direct_moves_total += 1
+        _obs.serving_fused_latency("pool_move",
+                                   t0, next(iter(self.pool.values())))
+
+    def import_request_direct(self, slot: int,
+                              src_cache: "PagedKVCache", src_slot: int,
+                              total_tokens: int) -> np.ndarray:
+        """The IN-PROCESS fast path of the prefill→decode handoff
+        (ISSUE 11): admit ``slot`` and copy the source slot's live
+        pages straight from ``src_cache``'s pool into freshly allocated
+        pages through the fused :func:`_pool_move` — one donated device
+        program instead of the ``export_request`` (device→host raw
+        bytes) → ``import_request`` (host→device scatter) pair.
+        Byte-identical to the host-staged handoff by construction (the
+        same pool bytes land at the same logical positions); geometry
+        is validated as loudly. The source slot is read-only — the
+        exporting engine still owns it until ``finish_handoff``."""
+        if not src_cache.active[src_slot]:
+            raise ValueError(
+                f"import_request_direct: source slot {src_slot} is "
+                f"inactive")
+        length = int(src_cache.lengths[src_slot])
+        if length <= 0:
+            raise ValueError(
+                f"import_request_direct: source slot {src_slot} has no "
+                f"committed tokens — hand off only after prefill "
+                f"completes")
+        if src_cache.page_size != self.page_size:
+            raise ValueError(
+                f"import_request_direct: source page_size="
+                f"{src_cache.page_size} != pool page_size="
+                f"{self.page_size} — prefill and decode replicas must "
+                f"share page geometry")
+        if set(src_cache.pool) != set(self.pool):
+            raise ValueError(
+                f"import_request_direct: source arrays "
+                f"{sorted(src_cache.pool)} != pool arrays "
+                f"{sorted(self.pool)} — kv-dtype tiers of the two "
+                f"replicas differ")
+        for name, arr in self.pool.items():
+            other = src_cache.pool[name]
+            if (str(other.dtype) != str(arr.dtype)
+                    or other.shape[0] != arr.shape[0]
+                    or other.shape[2:] != arr.shape[2:]):
+                raise ValueError(
+                    f"import_request_direct: source {name} "
+                    f"{other.dtype}{tuple(other.shape)} does not match "
+                    f"pool page geometry {arr.dtype}"
+                    f"{tuple(arr.shape)}")
+        n = self._check_admit(slot, total_tokens)
+        k = src_cache.pages_for(length)
+        if k > n:
+            raise ValueError(
+                f"import_request_direct: source holds {k} pages but "
+                f"total_tokens={total_tokens} only budgets {n}")
+        src_ids = src_cache._slot_pages[src_slot][:k]
+        pages = self._alloc_with_evict(n)
+        try:
+            self._move_pages(src_ids, pages[:k], src_cache=src_cache)
+        except Exception:
+            self.allocator.free(pages)
+            raise
+        return self._install(slot, pages)
 
     # ---- KV handoff (ISSUE 9): per-request page export/import ----
     def export_request(self, slot: int) -> Dict:
@@ -902,29 +1033,39 @@ class PagedKVCache:
         return self._install(slot, pages)
 
     def defrag(self):
-        """Compact used pages to the front of the pool: one device
-        gather rewrites each pool array, block tables (and the prefix
-        trie's held pages) are remapped on the host, and the free list
-        becomes the contiguous tail. Shared pages move like any other —
-        every reference (tables, ``_slot_pages``, trie nodes/tails) is
-        rewritten atomically, so no live table is left pointing at a
-        vacated id. Keeps long-running servers' pools dense after many
+        """Compact used pages to the front of the pool: ONE donated
+        fused gather+scatter (:func:`_pool_move` — ISSUE 11; the old
+        implementation re-materialized every pool array with a
+        full-pool ``jnp.take``, paying the whole pool's HBM to move a
+        handful of pages) moves only the LIVE pages in place, block
+        tables (and the prefix trie's held pages) are remapped on the
+        host, and the free list becomes the contiguous tail. Shared
+        pages move like any other — every reference (tables,
+        ``_slot_pages``, trie nodes/tails) is rewritten atomically, so
+        no live table is left pointing at a vacated id. Unused
+        destination pages keep their (dead) contents — nothing
+        references them. The move's id vectors pad to a power-of-two
+        bucket with trash-page self-copies, bounding the compile count.
+        Keeps long-running servers' pools dense after many
         admit/retire cycles (the allocator's ``fragmentation()`` stat
         measures the holes this closes)."""
-        import jax.numpy as jnp
         used = {p for pages in self._slot_pages for p in pages}
         if self.prefix is not None:
             used |= set(self.prefix.pages())
         used = sorted(used)
         remap = np.arange(self.num_pages, dtype=np.int32)
-        src = np.arange(self.num_pages, dtype=np.int32)
+        moves = []                      # (src, dst) for pages that move
         for new_id, old_id in enumerate(used, start=self.allocator.reserved):
             remap[old_id] = new_id
-            src[new_id] = old_id
-        # unused destination slots keep pointing at SOME page (their
-        # contents are dead — nothing references them)
-        self.pool = {name: jnp.take(arr, jnp.asarray(src), axis=1)
-                     for name, arr in self.pool.items()}
+            if old_id != new_id:
+                moves.append((old_id, new_id))
+        if moves:
+            n = 1
+            while n < len(moves):
+                n *= 2
+            moves += [(TRASH_PAGE, TRASH_PAGE)] * (n - len(moves))
+            self._move_pages([m[0] for m in moves],
+                             [m[1] for m in moves])
         self.block_tables = np.where(
             self.block_tables == TRASH_PAGE, TRASH_PAGE,
             remap[self.block_tables]).astype(np.int32)

@@ -260,6 +260,30 @@ class TestSwapResume:
         assert eng.cache.swap_ins_total == 1
         np.testing.assert_array_equal(a.output, ref)
 
+    @pytest.mark.parametrize("wb,kv", [(4, None), (8, "int8")])
+    def test_swap_resume_parity_lowbit_tiers(self, wb, kv):
+        """ISSUE 11: preempt→swap-out→swap-in→finish on the LOW-BIT
+        weight tiers (per-group int4; w8/kv8) — the swap path moves KV
+        bytes and is weight-dtype-agnostic, and decode after the
+        swap-in stays token-identical to uninterrupted low-bit
+        decode."""
+        ref = _engine(kv=kv, host=False, weight_bits=wb).generate(
+            [_prompt(6, seed=2)], max_new_tokens=8)[0]
+        eng = _engine(kv=kv, weight_bits=wb)
+        sched = ServingScheduler(eng)
+        a = sched.submit(_prompt(6, seed=2), max_new_tokens=8,
+                         priority=Priority.LOW)
+        while len(a.tokens) < 3:
+            sched.step()
+        sched.submit(_prompt(4, seed=3), max_new_tokens=2,
+                     priority=Priority.HIGH)
+        sched.step()
+        assert a.preemptions == 1
+        sched.run()
+        assert eng.cache.swap_outs_total == 1
+        assert eng.cache.swap_ins_total == 1
+        np.testing.assert_array_equal(a.output, ref)
+
     def test_swap_fallback_to_replay_when_dropped(self):
         """A payload LRU-dropped from a tiny host pool falls back to
         the replay-prefill resume — slower, still bit-identical."""

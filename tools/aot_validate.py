@@ -628,6 +628,223 @@ def validate_serving_host(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_lowbit(n: int, batch_mult: int = 1):
+    """ISSUE 11 low-bit + fused-kernel lowering gate: Mosaic-lower the
+    fused serving kernels and the low-bit decode tiers to the TPU
+    platform — (a) the fused dequant+RoPE+ragged-paged-attention decode
+    kernel (fp + per-row-int8 pages) and the flash chunk/verify kernel
+    (fp + int8 temp cache) at serving-realistic shapes, requiring the
+    Mosaic ``tpu_custom_call``; (b) the FULL fused decode step with
+    per-group INT4 weights and the w8/kv8 tier (int8 weights + int8-KV
+    pool), plus the fused chunk and verify programs; (c) the same
+    programs SHARDED on the tp mesh (tp=2 head-sharded KV with int4
+    weights, tp=4 GQA-replicated — devices permitting); (d) the fused
+    page gather/scatter (``_pool_move``) at fp, int8-KV and tp=2
+    layouts, same-pool (defrag) and cross-pool (direct handoff) forms.
+    The interpret-green-but-won't-lower failure mode of rounds 2/3,
+    gated for every new fused program."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import serving_fused as sf
+    from paddle_tpu.serving.paged_cache import (_pool_move,
+                                                pool_partition_specs)
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    skipped = {}
+    ndev = len(jax.devices())
+
+    # (a) op-level kernels, serving-realistic shapes (D=128)
+    P_, page, HK, D, B, pp = 32, 64, 4, 128, 8, 8
+    q = jnp.asarray(rs.randn(B, 32, D), jnp.bfloat16)
+    kp = jnp.asarray(rs.randn(P_, page, HK, D), jnp.bfloat16)
+    bt = jnp.asarray(rs.randint(1, P_, (B, pp)), jnp.int32)
+    ln = jnp.asarray(rs.randint(1, pp * page, (B,)), jnp.int32)
+    cr = jnp.asarray(rs.randn(B, D // 2), jnp.float32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda q, c, s, kp, vp, bt, ln:
+                    sf.fused_paged_decode_kernel(q, c, s, kp, vp, bt,
+                                                 ln)),
+            platforms=["tpu"])(q, cr, cr, kp, kp, bt, ln)
+    lowered["fused_rope_paged_fp"] = "tpu_custom_call" in exp.mlir_module()
+    k8 = jnp.asarray(rs.randint(-127, 128, (P_, page, HK, D)), jnp.int8)
+    ks = jnp.asarray(rs.rand(P_, page, HK), jnp.float32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda q, c, s, kp, vp, bt, ln, ks_, vs_:
+                    sf.fused_paged_decode_kernel(
+                        q, c, s, kp, vp, bt, ln, ks_pages=ks_,
+                        vs_pages=vs_)),
+            platforms=["tpu"])(q, cr, cr, k8, k8, bt, ln, ks, ks)
+    lowered["fused_rope_paged_int8"] = \
+        "tpu_custom_call" in exp.mlir_module()
+    T, W = 8, 256
+    qc = jnp.asarray(rs.randn(B, T, 32, D), jnp.bfloat16)
+    ck = jnp.asarray(rs.randn(B, W, HK, D), jnp.bfloat16)
+    kst = jnp.asarray(rs.randint(0, W - T, (B,)), jnp.int32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda q, ck, cv, kst:
+                    sf.flash_chunk_attention_kernel(q, ck, cv, W, kst)),
+            platforms=["tpu"])(qc, ck, ck, kst)
+    lowered["flash_chunk_fp"] = "tpu_custom_call" in exp.mlir_module()
+    c8 = jnp.asarray(rs.randint(-127, 128, (B, W, HK, D)), jnp.int8)
+    rows = jnp.asarray(rs.rand(B, W, HK), jnp.float32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda q, ck, cv, kst, kr, vr:
+                    sf.flash_chunk_attention_kernel(
+                        q, ck, cv, W, kst, k_rows=kr, v_rows=vr)),
+            platforms=["tpu"])(qc, c8, c8, kst, rows, rows)
+    lowered["flash_chunk_int8"] = "tpu_custom_call" in exp.mlir_module()
+
+    # (b) full fused low-bit step programs, tiny config
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    params = llama.init_params(jax.random.key(0), cfg)
+    p_int4 = gen.quantize_weights(params, cfg, bits=4)
+    p_int8 = gen.quantize_weights(params, cfg, bits=8)
+    pg = 16
+    tables = jnp.asarray(rs.randint(1, B * 4, (B, 256 // pg)), jnp.int32)
+    toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B,)), jnp.int32)
+    lens = jnp.asarray(rs.randint(1, 200, (B,)), jnp.int32)
+    msk = jnp.asarray(rs.rand(B) > 0.5)
+
+    def export_step(tag, pp_, kv=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(
+                jax.jit(lambda p, t, pl_, bt_, ln_, m:
+                        gen.paged_decode_forward(
+                            p, t, pl_, bt_, ln_, cfg, active=m,
+                            use_kernel=True, fused=True)),
+                platforms=["tpu"])(pp_, toks, pool, tables, lens, msk)
+        lowered[tag] = "tpu_custom_call" in exp.mlir_module()
+
+    export_step("fused_decode_step_int4", p_int4)
+    export_step("fused_decode_step_w8kv8", p_int8, kv="int8")
+    # fused chunk + verify programs at int4 weights (the flash kernel
+    # must Mosaic-lower inside the layer scan too)
+    pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg) + 1,
+                                page_size=pg)
+    chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 32)), jnp.int32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda p, c, pl_, bt_, cl, kl:
+                    gen.paged_prefill_chunk(
+                        p, c, pl_, bt_, cfg, ctx_cap=64, ctx_len=cl,
+                        chunk_len=kl, fused=True, use_kernel=True)),
+            platforms=["tpu"])(p_int4, chunk, pool, tables[0],
+                               jnp.int32(60), jnp.int32(32))
+    lowered["fused_chunk_step_int4"] = \
+        "tpu_custom_call" in exp.mlir_module()
+    spec_chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, 5)),
+                             jnp.int32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda p, c, pl_, bt_, ln_, m:
+                    gen.paged_verify_forward(
+                        p, c, pl_, bt_, ln_, cfg, ctx_cap=64, active=m,
+                        use_kernel=True, fused=True)),
+            platforms=["tpu"])(p_int4, spec_chunk, pool, tables,
+                               jnp.minimum(lens, 60), msk)
+    lowered["fused_verify_step_int4"] = \
+        "tpu_custom_call" in exp.mlir_module()
+
+    # (c) sharded fused low-bit steps on the tp mesh
+    def export_tp(tag, tp, pp_, kv=None):
+        from paddle_tpu.distributed.mesh import serving_mesh
+        mesh = serving_mesh(tp)
+        placed, specs = llama.shard_serving_params(pp_, cfg, mesh)
+        spool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                     + 1, page_size=pg, kv_dtype=kv,
+                                     tp=tp)
+        pspecs = pool_partition_specs(spool, "tp")
+        spool = {nm: jax.device_put(a, NamedSharding(mesh, pspecs[nm]))
+                 for nm, a in spool.items()}
+        fwd = shard_map(
+            lambda p, t, pl_, bt_, ln_, m: gen.paged_decode_forward(
+                p, t, pl_, bt_, ln_, cfg, active=m, use_kernel=True,
+                tp_axis="tp", fused=True),
+            mesh=mesh, in_specs=(specs, P(), pspecs, P(), P(), P()),
+            out_specs=(P(), pspecs), check_rep=False)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(jax.jit(fwd), platforms=["tpu"])(
+                placed, toks, spool, tables, lens, msk)
+        lowered[tag] = "tpu_custom_call" in exp.mlir_module()
+
+    if ndev >= 2:
+        export_tp("tp2_fused_decode_int4", 2, p_int4)
+        export_tp("tp2_fused_decode_w8kv8", 2, p_int8, kv="int8")
+    else:
+        skipped["tp2_fused_decode"] = (
+            f"--devices {ndev} < tp=2; nothing to shard")
+    if ndev >= 4:
+        export_tp("tp4_gqa_fused_decode_int4", 4, p_int4)
+    else:
+        skipped["tp4_gqa_fused_decode_int4"] = (
+            f"--devices {ndev} < tp=4 (GQA replication level)")
+
+    # (d) fused page gather/scatter (_pool_move): same-pool compaction
+    # and cross-pool direct handoff, fp / int8-KV / tp=2-sharded
+    def export_move(tag, kv=None, tp=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv,
+                                    tp=tp)
+        src_pool = jax.tree.map(lambda a: a, pool)
+        if tp is not None:
+            from paddle_tpu.distributed.mesh import serving_mesh
+            mesh = serving_mesh(tp)
+            pspecs = pool_partition_specs(pool, "tp")
+            pool = {nm: jax.device_put(
+                a, NamedSharding(mesh, pspecs[nm]))
+                for nm, a in pool.items()}
+            src_pool = {nm: jax.device_put(
+                a, NamedSharding(mesh, pspecs[nm]))
+                for nm, a in src_pool.items()}
+        k = 4
+        src = jnp.asarray(rs.choice(np.arange(1, 2 * B), k,
+                                    replace=False).astype(np.int32))
+        dst = jnp.asarray(rs.choice(np.arange(2 * B, 4 * B), k,
+                                    replace=False).astype(np.int32))
+        jax.export.export(
+            jax.jit(lambda pool, s, d: _pool_move(pool, s, d),
+                    donate_argnums=(0,)),
+            platforms=["tpu"])(pool, src, dst)
+        lowered[f"pool_move_compact_{tag}"] = True
+        jax.export.export(
+            jax.jit(lambda pool, sp, s, d: _pool_move(pool, s, d,
+                                                      src_pool=sp),
+                    donate_argnums=(0,)),
+            platforms=["tpu"])(pool, src_pool, src, dst)
+        lowered[f"pool_move_handoff_{tag}"] = True
+
+    export_move("fp")
+    export_move("int8", kv="int8")
+    if ndev >= 2:
+        export_move("tp2_sharded", tp=2)
+    else:
+        skipped["pool_move_tp2_sharded"] = (
+            f"--devices {ndev} < tp=2; sharded move not exportable")
+    ok = all(lowered.values())
+    return {
+        "config": "serving_lowbit_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({"skipped": skipped} if skipped else {}),
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def _impl(args) -> int:
     rows = []
 
@@ -657,6 +874,8 @@ def _impl(args) -> int:
         emit(validate_serving_cluster(args.devices, args.batch_mult))
     if args.config in ("serving-host", "all"):
         emit(validate_serving_host(args.devices, args.batch_mult))
+    if args.config in ("serving-lowbit", "all"):
+        emit(validate_serving_lowbit(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         ok = ok and (r.get("fits_v5p") is not False)
@@ -670,7 +889,7 @@ def main():
     ap.add_argument("--config",
                     choices=["7b", "13b", "13b-long", "moe", "moe-pp",
                              "serving", "serving-tp", "serving-cluster",
-                             "serving-host", "all"],
+                             "serving-host", "serving-lowbit", "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")

@@ -60,6 +60,12 @@ REQUIRED = {
         ('_fault_point("prefill_chunk")', 1),
         ('_fault_point("verify_step")', 1),
         ('_fault_point("transfer")', 2),
+        # fused serving kernels (ISSUE 11): per-kernel host-timed step
+        # latency on all three fused paths (decode / chunk / verify) —
+        # the decode_fused_speedup rider's per-kernel breakdown
+        ('_obs.serving_fused_latency("decode_rope_attn"', 1),
+        ('_obs.serving_fused_latency("chunk_flash_attn"', 1),
+        ('_obs.serving_fused_latency("verify_flash_attn"', 1),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -88,6 +94,12 @@ REQUIRED = {
         # fault-injection sites (ISSUE 8): allocator alloc/free
         ('fault_point("alloc")', 1),
         ('fault_point("free")', 1),
+        # fused page gather/scatter (ISSUE 11): the one donated move
+        # program shared by defrag compaction and the direct handoff —
+        # its latency histogram is the only visibility into device
+        # page-move cost (the host-staged path's bytes counters don't
+        # see it)
+        ('_obs.serving_fused_latency("pool_move"', 1),
     ],
     "paddle_tpu/serving/host_tier.py": [
         # hierarchical KV tier (ISSUE 10): both halves of the
@@ -136,6 +148,11 @@ REQUIRED = {
         # per-shard payload bytes (once per compile, like hooks.
         # collective) — dropping it blinds the tp collective counters
         ("_obs.serving_tp_allgather(", 1),
+        # fused serving kernels (ISSUE 11): trace-time dispatch +
+        # bytes-saved counters on BOTH fused branches (the decode
+        # rope+attn fusion and the chunk/verify flash fusion) —
+        # dropping one silently un-counts every launch of that kernel
+        ("_obs.serving_fused_dispatch(", 2),
     ],
     "paddle_tpu/io/dataloader.py": [
         ("_obs.dataloader_next(", 2),         # single-process + prefetch

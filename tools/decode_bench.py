@@ -3,11 +3,13 @@
 The driver bench's decode extras share one watchdog with the train
 headline; on a slow-compile day the extras die and the decode tiers
 stay null (they have been null in every round so far). This tool measures
-ONLY the decode tiers — fp bf16, the paged continuous-batching engine,
-the prefix-cache + chunked-prefill shared-system-prompt engine, int8
-weight-only, int4 weight-only, int8-weight+int8-KV — with the whole
-budget to itself, on freshly initialized weights (decode throughput does
-not depend on weight values).
+ONLY the decode tiers — fp bf16, the paged continuous-batching engine
+(with the fused-kernel speedup rider), the prefix-cache +
+chunked-prefill shared-system-prompt engine, int8 weight-only (dense),
+and the LOW-BIT PAGED tiers (per-group-int4 weights and
+int8-weight+int8-KV on the serving engine itself — ISSUE 11) — with the
+whole budget to itself, on freshly initialized weights (decode
+throughput does not depend on weight values).
 
 Prints one JSON line:
   {"decode_tokens_per_sec": ..., "decode_paged_tokens_per_sec": ...,
@@ -109,11 +111,18 @@ def main():
                   file=sys.stderr)
 
     run_tier("decode_tokens_per_sec", lambda: decode_rate(params))
+
     # shared workload with bench.py's tier (same mix, oversubscription,
-    # page-size rule) so the two decode_paged sources stay comparable
-    run_tier("decode_paged_tokens_per_sec",
-             lambda: bench_mod.paged_decode_tier(
-                 params, cfg, db, dp_len, dnew, on_tpu))
+    # page-size rule) so the two decode_paged sources stay comparable;
+    # the fused-kernel speedup rider (ISSUE 11 — per-step ms unfused vs
+    # fused + the ratio) rides the record next to the number it explains
+    def _paged():
+        tps, fused = bench_mod.paged_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        if fused:
+            out["decode_fused_speedup"] = fused
+        return tps
+    run_tier("decode_paged_tokens_per_sec", _paged)
     # shared-system-prompt workload (prefix cache + chunked prefill),
     # also shared with bench.py so both sources stay comparable
     run_tier("decode_prefix_tokens_per_sec",
@@ -181,11 +190,17 @@ def main():
         int8_p["p"] = gen.quantize_weights(params, cfg)
         return decode_rate(int8_p["p"])
     run_tier("decode_int8_tokens_per_sec", _int8)
+    # low-bit PAGED-ENGINE tiers (ISSUE 11): int4 weights and w8/kv8 on
+    # the serving tower itself (same workload as decode_paged — the
+    # ratio against it IS the low-bit bandwidth win); these two slots
+    # had never produced a number while they aliased the dense path
     run_tier("decode_int4_tokens_per_sec",
-             lambda: decode_rate(gen.quantize_weights(params, cfg, bits=4)))
-    if "p" in int8_p:
-        run_tier("decode_w8kv8_tokens_per_sec",
-                 lambda: decode_rate(int8_p["p"], kv="int8"))
+             lambda: bench_mod.lowbit_decode_tier(
+                 params, cfg, db, dp_len, dnew, on_tpu, 4))
+    run_tier("decode_w8kv8_tokens_per_sec",
+             lambda: bench_mod.lowbit_decode_tier(
+                 params, cfg, db, dp_len, dnew, on_tpu, 8,
+                 kv_cache_dtype="int8"))
 
     out.update({k: tiers.get(k) for k in (
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",

@@ -111,11 +111,12 @@ if dec.get("decode_tokens_per_sec") is not None:
             src[k] = "live"
             changed = True
     # rider dicts travel with their tier: the scheduler tier's p50/p99
-    # step-latency bound (ISSUE 4) and the speculative tier's
-    # acceptance rate (ISSUE 5 — the number that explains the tput)
+    # step-latency bound (ISSUE 4), the speculative tier's acceptance
+    # rate (ISSUE 5 — the number that explains the tput) and the paged
+    # tier's fused-kernel speedup (ISSUE 11)
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
                   "decode_tp_scaling", "decode_cluster_scaling",
-                  "decode_offload_resume"):
+                  "decode_offload_resume", "decode_fused_speedup"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
             lg["extra"][rider] = ms
