@@ -190,6 +190,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # the tier's point is the BOUND, not just the throughput:
         # p50/p99 step latency under the bursty two-priority workload
         rec["extra"]["decode_sched_step_ms"] = decode_sched[1]
+        if len(decode_sched) > 2 and decode_sched[2]:
+            # overlap rider (ISSUE 12): the same workload through the
+            # double-buffered scheduler — sync vs overlapped step ms +
+            # the host_overhead_fraction the overlap hides
+            rec["extra"]["decode_overlap_speedup"] = decode_sched[2]
     if decode_spec:
         # the speculative tier's throughput only means something next
         # to the acceptance rate that produced it — they travel together
@@ -434,7 +439,7 @@ def prefix_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
 
 
 def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
-                      kv_cache_dtype=None):
+                      kv_cache_dtype=None, overlap_rider=True):
     """The decode_sched_tokens_per_sec measurement, shared by measure()
     and tools/decode_bench.py so the two sources stay comparable.
 
@@ -448,22 +453,33 @@ def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     slot + one two-page chunk), so the number measures the whole
     control plane: planning, preempt/evict/resume churn, and the
     budget-bounded step latency. Returns ``(tokens_per_sec,
-    {"p50_step_ms", "p99_step_ms", "preemptions"})`` — the latency
-    percentiles are the tier's point: FIFO has no bound on them.
-    Prefix cache OFF (same reason as the paged tier: the warm pass
-    must not convert the timed pass into a hit workload)."""
+    {"p50_step_ms", "p99_step_ms", "preemptions"}, overlap_rider)`` —
+    the latency percentiles are the tier's point: FIFO has no bound on
+    them. Prefix cache OFF (same reason as the paged tier: the warm
+    pass must not convert the timed pass into a hit workload).
+
+    The overlap rider (ISSUE 12) re-runs the IDENTICAL workload with
+    the double-buffered scheduler (``overlap=True`` — expire/admit/
+    plan hidden under the in-flight decode step, one commit fence per
+    step) and reports {sync_step_ms, overlapped_step_ms,
+    host_overhead_fraction (both modes), speedup} — the direct
+    measurement of how much host plane the overlap hides at this
+    geometry. Best-effort: an overlapped-path failure leaves the
+    baseline number standing with the rider None."""
     import numpy as np
     from paddle_tpu.inference.predictor import ContinuousBatchingEngine
     from paddle_tpu.serving import Priority, ServingScheduler
     page = 16 if on_tpu else 8
-    rngp = np.random.default_rng(5)
-    eng = ContinuousBatchingEngine(
-        params, cfg, max_batch=db, page_size=page,
-        max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype,
-        enable_prefix_cache=False)
-    sched = ServingScheduler(eng, token_budget=db + 2 * page)
 
-    def one_pass():
+    def build(overlap):
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=db, page_size=page,
+            max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype,
+            enable_prefix_cache=False, overlap=overlap)
+        return ServingScheduler(eng, token_budget=db + 2 * page,
+                                overlap=overlap)
+
+    def one_pass(sched, rngp):
         def mk(n):
             return rngp.integers(0, cfg.vocab_size, (n,)).astype(
                 np.int32)
@@ -483,18 +499,46 @@ def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
             lats.append(time.perf_counter() - t0)
             if not more:
                 break
+        sched.flush()                   # overlap: drain the last step
         return (sum(len(r.tokens) for r in lows + highs), lats)
 
-    one_pass()                                      # compile/warm pass
-    p0 = sched.preemptions_total
-    t0 = time.perf_counter()
-    toks_out, lats = one_pass()                     # steady state
-    tps = round(toks_out / (time.perf_counter() - t0), 2)
-    return tps, {
+    def measure(sched):
+        # fresh generator per mode: the sync baseline and the overlap
+        # rider must replay the IDENTICAL warm+timed prompt stream, or
+        # the speedup would compare two different request sets
+        rngp = np.random.default_rng(5)
+        one_pass(sched, rngp)                           # compile/warm
+        p0 = sched.preemptions_total
+        t0 = time.perf_counter()
+        toks_out, lats = one_pass(sched, rngp)          # steady state
+        tps = round(toks_out / (time.perf_counter() - t0), 2)
+        return tps, lats, sched.preemptions_total - p0
+
+    sched = build(False)
+    tps, lats, preempts = measure(sched)
+    lat = {
         "p50_step_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
         "p99_step_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
-        "preemptions": sched.preemptions_total - p0,
+        "preemptions": preempts,
     }
+    rider = None
+    if overlap_rider:
+        try:
+            sched_ov = build(True)
+            ov_tps, ov_lats, _ = measure(sched_ov)
+            rider = {
+                "sync_step_ms": lat["p50_step_ms"],
+                "overlapped_step_ms": round(
+                    float(np.percentile(ov_lats, 50)) * 1e3, 3),
+                "host_overhead_fraction": {
+                    "sync": round(sched.host_frac_ema, 4),
+                    "overlap": round(sched_ov.host_frac_ema, 4)},
+                "speedup": round(ov_tps / tps, 3) if tps else None,
+            }
+        except Exception as e:
+            print(f"overlap sched rider failed: {type(e).__name__}: "
+                  f"{e}"[:300], file=sys.stderr)
+    return tps, lat, rider
 
 
 def spec_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
@@ -660,13 +704,38 @@ def cluster_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     t0 = time.perf_counter()
     toks = run_cluster()
     tps = round(toks / (time.perf_counter() - t0), 2)
-    return tps, {
+    scaling = {
         "replicas": replicas,
         "vs_single_engine": round(tps / single_tps, 3) if single_tps
         else None,
         "affinity_hit_rate": round(
             cluster.router.stats()["affinity_hit_rate"], 3),
     }
+    # overlap sub-rider (ISSUE 12): the same tenant workload with every
+    # supervised replica running the double-buffered scheduler —
+    # best-effort, the sync number stands either way
+    try:
+        cl_ov = ServingCluster(engine, replicas=replicas, overlap=True)
+
+        def run_ov():
+            reqs = [cl_ov.submit(p, max_new_tokens=dnew,
+                                 tenant=f"tenant{t}")
+                    for t, p in make_jobs()]
+            cl_ov.run()
+            return sum(r.max_new_tokens for r in reqs)
+
+        run_ov()                                    # warm
+        t0 = time.perf_counter()
+        toks = run_ov()
+        ov_tps = round(toks / (time.perf_counter() - t0), 2)
+        scaling["overlap"] = {
+            "tokens_per_sec": ov_tps,
+            "vs_sync": round(ov_tps / tps, 3) if tps else None,
+        }
+    except Exception as e:
+        print(f"overlap cluster rider failed: {type(e).__name__}: "
+              f"{e}"[:300], file=sys.stderr)
+    return tps, scaling
 
 
 def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
@@ -695,16 +764,15 @@ def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     from paddle_tpu.inference.predictor import ContinuousBatchingEngine
     from paddle_tpu.serving import Priority, ServingScheduler
     page = 16 if on_tpu else 8
-    rngp = np.random.default_rng(19)
 
-    def build(host):
+    def build(host, overlap=False):
         eng = ContinuousBatchingEngine(
             params, cfg, max_batch=db, page_size=page,
             max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype,
-            enable_prefix_cache=False, host_tier=host)
+            enable_prefix_cache=False, host_tier=host, overlap=overlap)
         return eng, ServingScheduler(eng, token_budget=db + 2 * page)
 
-    def one_pass(sched):
+    def one_pass(sched, rngp):
         def mk(n):
             return rngp.integers(0, cfg.vocab_size, (n,)).astype(
                 np.int32)
@@ -722,21 +790,26 @@ def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
 
     # replay baseline: the identical workload, host tier OFF — the
     # rider's denominator (every resume pays the replay prefill)
+    # every mode replays the IDENTICAL warm+timed prompt stream (one
+    # fresh generator per mode) so the rider ratios compare the same
+    # request set, not different draws from a shared stream
+    rng = np.random.default_rng(19)
     _, sched_replay = build(False)
-    one_pass(sched_replay)                          # compile/warm pass
+    one_pass(sched_replay, rng)                     # compile/warm pass
     t0 = time.perf_counter()
-    toks = one_pass(sched_replay)
+    toks = one_pass(sched_replay, rng)
     replay_tps = toks / (time.perf_counter() - t0)
 
+    rng = np.random.default_rng(19)
     eng, sched = build(True)
-    one_pass(sched)                                 # warm (shares compiles)
+    one_pass(sched, rng)                            # warm (shares compiles)
     n0 = len(eng.cache.swap_in_ms)
     si0, p0 = eng.cache.swap_ins_total, sched.preemptions_total
     t0 = time.perf_counter()
-    toks = one_pass(sched)
+    toks = one_pass(sched, rng)
     tps = round(toks / (time.perf_counter() - t0), 2)
     lat = eng.cache.swap_in_ms[n0:]
-    return tps, {
+    rider = {
         "preemptions": sched.preemptions_total - p0,
         "swap_ins": eng.cache.swap_ins_total - si0,
         "swap_in_ms_p50": (round(float(np.percentile(lat, 50)), 3)
@@ -744,6 +817,25 @@ def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
         "vs_replay_prefill": (round(tps / replay_tps, 3)
                               if replay_tps else None),
     }
+    # overlap sub-rider (ISSUE 12): the same swap-heavy workload with
+    # the double-buffered scheduler AND async swap-out DMAs (issued
+    # under the in-flight decode, fenced at commit) — best-effort
+    try:
+        rng = np.random.default_rng(19)
+        eng_ov, sched_ov = build(True, overlap=True)
+        one_pass(sched_ov, rng)                     # warm
+        t0 = time.perf_counter()
+        toks = one_pass(sched_ov, rng)
+        ov_tps = round(toks / (time.perf_counter() - t0), 2)
+        rider["overlap"] = {
+            "tokens_per_sec": ov_tps,
+            "vs_sync": round(ov_tps / tps, 3) if tps else None,
+            "host_overhead_fraction": round(sched_ov.host_frac_ema, 4),
+        }
+    except Exception as e:
+        print(f"overlap offload rider failed: {type(e).__name__}: "
+              f"{e}"[:300], file=sys.stderr)
+    return tps, rider
 
 
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
@@ -764,6 +856,8 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
 # the very quantity the tier reports. tools/tpu_watch.sh merges the
 # same pairs on the shell side.
 _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
+                  ("decode_sched_tokens_per_sec",
+                   "decode_overlap_speedup"),
                   ("decode_spec_tokens_per_sec", "decode_spec_acceptance"),
                   ("decode_tp_tokens_per_sec", "decode_tp_scaling"),
                   ("decode_cluster_tokens_per_sec",

@@ -9,7 +9,7 @@ program), jit-compiles it once per input signature (the analog of the
 predictor's optimized program cache) and serves zero-copy device arrays.
 """
 from .predictor import (  # noqa: F401
-    Config, ContinuousBatchingEngine, GenerationRequest, Predictor,
-    Tensor as PredictorTensor, create_predictor,
+    Config, ContinuousBatchingEngine, GenerationRequest, InFlightStep,
+    Predictor, Tensor as PredictorTensor, create_predictor,
     PlaceType, PrecisionType, get_version,
 )

@@ -333,6 +333,39 @@ def create_predictor(config: Config, layer=None) -> Predictor:
 
 # ---------------- continuous-batching decode engine ----------------
 
+class InFlightStep:
+    """One dispatched-but-uncommitted decode/verify program (ISSUE 12).
+
+    The async overlapped runtime splits every engine step into a
+    DISPATCH half (launch the jitted program — JAX dispatch is
+    asynchronous, so this returns while the device works) and a COMMIT
+    half (the single device→host fetch plus all host bookkeeping). The
+    handle carries everything commit needs: the device output array,
+    the mask, and a SNAPSHOT of the per-slot request ids AND seat
+    generations at dispatch time — commit only applies a slot's result
+    when the slot still holds the same SEATING of the same request (a
+    slot preempted-and-readmitted between dispatch and commit must not
+    receive the old seating's token, even when the re-admission seated
+    the SAME request back into its own slot — its pages and lengths
+    were reset, so the in-flight token belongs to freed pages; the
+    victim re-decodes the dropped token on resume, greedy-identically,
+    so no stream ever forks)."""
+    __slots__ = ("kind", "mask", "rids", "seats", "out", "drafts",
+                 "dlen", "t0", "t0f")
+
+    def __init__(self, kind, mask, rids, seats, out, drafts=None,
+                 dlen=None, t0=0, t0f=0):
+        self.kind = kind                # "decode" | "spec"
+        self.mask = mask
+        self.rids = rids                # per-slot rid snapshot at dispatch
+        self.seats = seats              # per-slot seating generation
+        self.out = out                  # device array: nxt (B,) / (B, T)
+        self.drafts = drafts
+        self.dlen = dlen
+        self.t0 = t0
+        self.t0f = t0f
+
+
 class GenerationRequest:
     """One in-flight generation request tracked by the engine.
 
@@ -481,12 +514,24 @@ class ContinuousBatchingEngine:
                  host_tier: bool = False,
                  host_tier_kw: Optional[Dict] = None,
                  weight_bits: Optional[int] = None,
-                 fused: Optional[bool] = None):
+                 fused: Optional[bool] = None,
+                 overlap: bool = False):
         from ..serving import PagedKVCache
         self.cfg = cfg
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
         self.use_kernel = use_kernel
+        # --- async overlapped runtime (ISSUE 12): overlap=True marks
+        # this engine for the double-buffered scheduler pipeline — a
+        # ServingScheduler attached without its own overlap= knob
+        # inherits it, and the host tier's swap-out DMAs go
+        # NON-BLOCKING (issued at preemption, fenced at the next
+        # commit) so the device→host copy rides under the in-flight
+        # decode step. The dispatch/commit split itself is always
+        # available (decode_step == dispatch immediately followed by
+        # commit), so the synchronous path stays the bit-identity
+        # reference.
+        self.overlap = bool(overlap)
         # --- low-bit decode tiers (ISSUE 11): weight_bits quantizes the
         # weights at construction (8 = per-channel int8, 4 = per-group
         # int4 — models/generate.quantize_weights); every serving
@@ -565,6 +610,26 @@ class ContinuousBatchingEngine:
         self._queue: List[GenerationRequest] = []
         self._slots: List[Optional[GenerationRequest]] = [None] * max_batch
         self._last = np.zeros((max_batch,), np.int32)
+        # per-slot request state MIRRORED into flat numpy arrays so the
+        # decode commit is vectorized host bookkeeping (ISSUE 12): one
+        # fancy-indexed update per step instead of a per-row Python
+        # loop of scalar conversions. _install_slot/_clear_slot are the
+        # only writers; -1 rid == empty slot.
+        self._rids = np.full((max_batch,), -1, np.int64)
+        # seating GENERATION per slot, bumped on every _install_slot:
+        # the commit guard compares it so a request preempted and
+        # re-seated (even into its own slot, rid unchanged) between
+        # dispatch and commit never receives the stale seating's token
+        self._seat = np.zeros((max_batch,), np.int64)
+        self._ntok = np.zeros((max_batch,), np.int64)
+        self._maxnew = np.zeros((max_batch,), np.int64)
+        self._eos = np.full((max_batch,), -1, np.int64)
+        # in-flight dispatched-but-uncommitted work (overlap pipeline):
+        # at most ONE decode/verify program plus this step's prefill
+        # chunk handles — committed in dispatch order by commit_inflight
+        self._inflight: Optional[InFlightStep] = None
+        self._inflight_chunks: List[Dict] = []
+        self._fence_ns = 0      # device-wait accumulated since last take
         self._next_rid = 0
         self._steps = 0
         self._decode_fn = None
@@ -734,12 +799,22 @@ class ContinuousBatchingEngine:
         return self._spec_fns[key]
 
     # ---- scheduling ----
-    def _sample_first(self, logits) -> int:
-        if self.temperature == 0.0:
-            return int(jnp.argmax(logits[0]))
-        self._key, k = jax.random.split(self._key)
-        return int(jax.random.categorical(
-            k, logits[0] / self.temperature))
+    def _install_slot(self, slot: int, req: GenerationRequest):
+        """Seat ``req`` in ``slot`` and mirror its commit-relevant
+        state into the flat per-slot arrays the vectorized decode
+        commit indexes (rid guard, token count, max_new, eos id)."""
+        req.slot = slot
+        self._slots[slot] = req
+        self._rids[slot] = req.rid
+        self._seat[slot] += 1
+        self._ntok[slot] = len(req.tokens)
+        self._maxnew[slot] = req.max_new_tokens
+        self._eos[slot] = (-1 if req.eos_token_id is None
+                           else int(req.eos_token_id))
+
+    def _clear_slot(self, slot: int):
+        self._slots[slot] = None
+        self._rids[slot] = -1
 
     def admit_request(self, req: GenerationRequest) -> bool:
         """Place ``req`` into a free slot, reserving its pages (prefix-
@@ -775,8 +850,7 @@ class ContinuousBatchingEngine:
                 expect_tokens=seq.size)
             req.swapped = False
             if length is not None:
-                req.slot = slot
-                self._slots[slot] = req
+                self._install_slot(slot, req)
                 # decode continues from the already-sampled last token,
                 # exactly as the replay path would after its final chunk
                 self._last[slot] = np.int32(req.tokens[-1])
@@ -787,8 +861,7 @@ class ContinuousBatchingEngine:
             # fallback): replay below, the gated resume path
         _, shared = cache.admit_prompt(
             slot, seq, req.prompt.shape[1] + req.max_new_tokens)
-        req.slot = slot
-        self._slots[slot] = req
+        self._install_slot(slot, req)
         self._pending[slot] = [req, seq, int(shared)]
         if req.preemptions > 0:
             # resume re-entry: the replay cost has its own counter —
@@ -836,11 +909,16 @@ class ContinuousBatchingEngine:
         swap = self.swap_candidate(req)
         self._pending.pop(slot, None)
         if swap:
-            freed = self.cache.swap_out(slot, req.rid)
+            # overlap engines issue the swap-out DMA NON-BLOCKING: the
+            # device→host copy rides under the in-flight decode step
+            # and the host-store entry materializes at the next commit
+            # fence (ISSUE 12 satellite a)
+            freed = self.cache.swap_out(slot, req.rid,
+                                        nonblocking=self.overlap)
             req.swapped = True
         else:
             freed = self.cache.evict_for_preempt(slot)
-        self._slots[slot] = None
+        self._clear_slot(slot)
         req.slot = None
         req.preemptions += 1
         req.finish_reason = "preempted"
@@ -895,19 +973,17 @@ class ContinuousBatchingEngine:
                 break
             self._queue.pop(0)
 
-    def prefill_step(self, slot: Optional[int] = None,
-                     max_tokens: Optional[int] = None) -> int:
-        """Advance ONE pending admission by one static-shape chunk
-        (default: the oldest, FIFO): the per-step latency added to
-        in-flight decodes is bounded by one chunk's forward instead of
-        a whole prompt's. ``max_tokens`` caps the chunk width (floored
-        to a page multiple — the scheduler's token-budget debit must be
-        a hard ceiling); returns the width actually scheduled (0 when
-        nothing was). The final chunk's logits (taken at the last VALID
-        token) seed sampling — except on a preemption RESUME, where the
-        next token is already known and is fed back into decode instead
-        — and the completed prompt's pages are published to the prefix
-        trie for future admissions."""
+    def prefill_dispatch(self, slot: Optional[int] = None,
+                         max_tokens: Optional[int] = None) -> int:
+        """DISPATCH half of :meth:`prefill_step` (ISSUE 12): launch one
+        pending admission's next static-shape chunk program and queue
+        an in-flight handle; ALL host mutation (the ``done`` cursor,
+        prefix registration, first-token sampling) waits for
+        :meth:`commit_prefills`. On a FINAL chunk the first token is
+        argmax/sampled ON DEVICE here — the PRNG split happens at
+        dispatch, so the sync and overlapped paths split keys in the
+        same order — and only the scalar fetch is deferred to commit.
+        Returns the width actually scheduled (0 when nothing was)."""
         if not self._pending:
             return 0
         cache = self.cache
@@ -915,6 +991,10 @@ class ContinuousBatchingEngine:
             slot = min(self._pending,
                        key=lambda s: self._pending[s][0].rid)
         req, seq, done = self._pending[slot]
+        if any(h["slot"] == slot for h in self._inflight_chunks):
+            raise RuntimeError(
+                f"prefill_dispatch: slot {slot} already has an "
+                f"in-flight chunk — commit it first")
         S = seq.size
         page = cache.page_size
         remaining = S - done
@@ -943,36 +1023,103 @@ class ContinuousBatchingEngine:
             self.params, jnp.asarray(chunk), cache.pool,
             jnp.asarray(cache.block_tables[slot]), jnp.int32(done),
             jnp.int32(take))
+        samp = None
+        if done + take >= S and not req.tokens:
+            # final chunk of a fresh admission (or a mid-prefill
+            # victim's resume): the first token comes from these
+            # logits. Keep the sample on device; fetch at commit.
+            if self.temperature == 0.0:
+                samp = jnp.argmax(logits[0])
+            else:
+                self._key, k = jax.random.split(self._key)
+                samp = jax.random.categorical(
+                    k, logits[0] / self.temperature)
+        self._inflight_chunks.append(
+            {"slot": slot, "req": req, "seat": int(self._seat[slot]),
+             "take": take, "t0": t0, "logits": logits, "samp": samp})
+        return width
+
+    def _commit_chunk(self, h: Dict) -> int:
+        """COMMIT half of one dispatched prefill chunk: fence, advance
+        the ``done`` cursor, and on completion publish the prompt to
+        the prefix trie and seed decode — on a preemption RESUME the
+        next token is already known and is fed back into decode
+        instead of re-sampling (the resumed request must not fork)."""
+        slot, req, take = h["slot"], h["req"], h["take"]
+        cache = self.cache
+        # both obs calls fence the chunk logits when a sink is active —
+        # that wait is device time, not exposed host time
+        t_f0 = time.perf_counter_ns()
         if self.fused:
-            _obs.serving_fused_latency("chunk_flash_attn", t0, logits)
-        _obs.serving_prefill_chunk(t0, logits, take)
-        done += take
-        if done < S:
-            self._pending[slot][2] = done
-            return width
+            _obs.serving_fused_latency("chunk_flash_attn", h["t0"],
+                                       h["logits"])
+        _obs.serving_prefill_chunk(h["t0"], h["logits"], take)
+        self._fence_ns += time.perf_counter_ns() - t_f0
+        ent = self._pending.get(slot)
+        if (ent is None or ent[0] is not req
+                or int(self._seat[slot]) != h["seat"]):
+            # cancelled/expired — or preempted and RE-ADMITTED (even
+            # the same request: the seat generation moved, so this
+            # chunk's KV went to the old seating's freed pages) —
+            # between dispatch and commit: commit nothing; the fresh
+            # admission replays the span through its own chunks
+            return 0
+        done = ent[2] + take
+        if done < ent[1].size:
+            ent[2] = done
+            return take
         del self._pending[slot]
         cache.register_prefix(slot, req.prompt[0])
-        cache.lengths[slot] = S
+        cache.lengths[slot] = ent[1].size
         req.finish_reason = None            # clears transient "preempted"
         if req.tokens:
             # preemption resume: the replay covered prompt +
             # tokens[:-1]; decode continues from the already-sampled
             # last token (its KV lands on the next decode step, exactly
-            # as in the uninterrupted run). The final chunk's logits
-            # are what the original step already sampled from — no
-            # re-sampling, or the resumed request would fork.
+            # as in the uninterrupted run).
             self._last[slot] = np.int32(req.tokens[-1])
         else:
-            # fresh admission, or a resume of a victim preempted
-            # mid-prefill (no token sampled yet): seed from the final
-            # chunk's logits either way
-            first = self._sample_first(logits)
+            t_f = time.perf_counter_ns()
+            first = int(h["samp"])          # the ONE device→host fetch
+            self._fence_ns += time.perf_counter_ns() - t_f
             self._last[slot] = first
             self._record_token(req, first)
+        return take
+
+    def commit_prefills(self) -> int:
+        """Commit every in-flight prefill chunk in dispatch order;
+        returns prompt tokens committed."""
+        n = 0
+        chunks, self._inflight_chunks = self._inflight_chunks, []
+        for h in chunks:
+            n += self._commit_chunk(h)
+        return n
+
+    def prefill_step(self, slot: Optional[int] = None,
+                     max_tokens: Optional[int] = None) -> int:
+        """Advance ONE pending admission by one static-shape chunk
+        (default: the oldest, FIFO): the per-step latency added to
+        in-flight decodes is bounded by one chunk's forward instead of
+        a whole prompt's. ``max_tokens`` caps the chunk width (floored
+        to a page multiple — the scheduler's token-budget debit must be
+        a hard ceiling); returns the width actually scheduled (0 when
+        nothing was). The final chunk's logits (taken at the last VALID
+        token) seed sampling — except on a preemption RESUME, where the
+        next token is already known and is fed back into decode instead
+        — and the completed prompt's pages are published to the prefix
+        trie for future admissions. Synchronous composition of
+        :meth:`prefill_dispatch` + :meth:`commit_prefills` — the
+        overlapped scheduler drives the halves separately."""
+        width = self.prefill_dispatch(slot, max_tokens=max_tokens)
+        self.commit_prefills()
         return width
 
     def _record_token(self, req: GenerationRequest, tok: int):
         req.tokens.append(int(tok))
+        if req.slot is not None:
+            # keep the vectorized-commit mirror in sync on the scalar
+            # paths (prefill first-token, spec commit loop)
+            self._ntok[req.slot] = len(req.tokens)
         if req.eos_token_id is not None and tok == req.eos_token_id:
             self._retire(req, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
@@ -982,7 +1129,7 @@ class ContinuousBatchingEngine:
         req.done = True
         req.finish_reason = reason
         self.cache.release(req.slot)
-        self._slots[req.slot] = None
+        self._clear_slot(req.slot)
         _obs.serving_retired(1, reason)
 
     def _tp_observe(self):
@@ -1081,8 +1228,7 @@ class ContinuousBatchingEngine:
                 req.prompt.shape[1] + req.max_new_tokens)
         self.cache.lengths[slot] = np.int32(payload["length"])
         self._last[slot] = np.int32(payload["last"])
-        req.slot = slot
-        self._slots[slot] = req
+        self._install_slot(slot, req)
         self.cache.register_prefix(slot, req.prompt[0])
         return True
 
@@ -1099,7 +1245,7 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"finish_handoff: slot {slot} does not hold request "
                 f"{req.rid}")
-        self._slots[slot] = None
+        self._clear_slot(slot)
         self._pending.pop(slot, None)
         self.cache.release(slot)
 
@@ -1108,24 +1254,42 @@ class ContinuousBatchingEngine:
         pool and can decode this step; slots mid-prefill hold pages
         (active) but skip the decode program."""
         ready = self.cache.active.copy()
-        for s in self._pending:
-            ready[s] = False
+        if self._pending:
+            ready[list(self._pending)] = False
         return ready
 
-    def decode_step(self, mask) -> int:
-        """Advance every ``mask`` slot one decode token through the
-        single jitted ragged decode program (callers pass
-        :meth:`ready_mask` or a scheduler's budgeted subset of it).
-        Returns the number of slots advanced (0 skips the program
-        entirely)."""
+    # ---- dispatch / commit halves (ISSUE 12 overlapped runtime) ----
+    def has_inflight(self) -> bool:
+        """True while a dispatched decode/verify program or prefill
+        chunk awaits its commit — the overlapped scheduler's signal
+        that a commit fence is pending."""
+        return self._inflight is not None or bool(self._inflight_chunks)
+
+    def take_fence_ns(self) -> int:
+        """Device-wait nanoseconds accumulated by commit fences since
+        the last call — the scheduler's host-vs-device attribution
+        input for the ``host_overhead_fraction`` gauge."""
+        ns, self._fence_ns = self._fence_ns, 0
+        return ns
+
+    def decode_dispatch(self, mask) -> Optional[InFlightStep]:
+        """DISPATCH half of :meth:`decode_step`: launch the jitted
+        ragged decode program for the ``mask`` slots and return the
+        in-flight handle WITHOUT fetching the result — the device works
+        while the caller plans the next step. The PRNG split happens
+        here (same order as the synchronous path). At most one
+        decode/verify program may be in flight."""
         cache = self.cache
         mask = np.asarray(mask, bool)
         if not mask.any():
-            return 0
-        # resilience sites: step execution, then the device->host fetch
-        # — host state (lengths/tokens) commits only after both, so a
-        # fault at either leaves the request handles at the previous
-        # step's committed state (the supervisor's recovery contract)
+            return None
+        if self._inflight is not None:
+            raise RuntimeError(
+                "decode_dispatch: a decode/verify program is already "
+                "in flight — commit_inflight() first")
+        # resilience sites: step execution (before the launch), then
+        # the dispatch seam (after it) — neither commits host state,
+        # so a fault at either recovers by journal replay
         _fault_point("decode_step")
         t0f = _obs.generate_begin() if self.fused else 0
         self._key, k = jax.random.split(self._key)
@@ -1134,22 +1298,92 @@ class ContinuousBatchingEngine:
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths),
             jnp.asarray(mask), k)
-        _obs.serving_fused_latency("decode_rope_attn", t0f, nxt)
+        _fault_point("dispatch")
+        self._inflight = InFlightStep("decode", mask, self._rids.copy(),
+                                      self._seat.copy(), nxt, t0f=t0f)
+        return self._inflight
+
+    def _decode_commit(self, h: InFlightStep) -> int:
+        """COMMIT half of :meth:`decode_step`: the single device→host
+        fetch plus VECTORIZED host bookkeeping — lengths/last-token
+        scatter via one fancy-indexed update, eos/max_len finish
+        detection against the mirrored per-slot arrays, per-row Python
+        work only for the rows that actually finish. A slot whose
+        request changed since dispatch (preempt + readmit) is skipped
+        via the rid snapshot; the dropped token is re-decoded
+        greedy-identically on resume."""
+        cache = self.cache
+        # resilience sites: the commit seam, then the device→host
+        # transfer — host state commits only after both, so a fault at
+        # either leaves the request handles at the previous step's
+        # committed state (the supervisor's recovery contract)
+        _fault_point("commit")
+        # the device-wait window OPENS before the observability calls:
+        # serving_fused_latency fences h.out when metrics are on, and
+        # charging that wait to exposed host time would inflate the
+        # host_overhead_fraction gauge exactly when it is emitted
+        t_f = time.perf_counter_ns()
+        _obs.serving_fused_latency("decode_rope_attn", h.t0f, h.out)
         _fault_point("transfer")
-        nxt = np.asarray(nxt)
-        n_active = int(mask.sum())
-        for slot, req in enumerate(self._slots):
-            if req is None or not mask[slot]:
-                continue
-            cache.lengths[slot] += 1
-            self._last[slot] = nxt[slot]
-            self._record_token(req, int(nxt[slot]))
+        nxt = np.asarray(h.out)
+        self._fence_ns += time.perf_counter_ns() - t_f
+        valid = (h.mask & (self._rids == h.rids) & (h.rids >= 0)
+                 & (self._seat == h.seats))
+        slots = np.flatnonzero(valid)
+        if slots.size:
+            toks = nxt[slots]
+            cache.lengths[slots] += 1
+            self._last[slots] = toks
+            new_cnt = self._ntok[slots] + 1
+            self._ntok[slots] = new_cnt
+            fin_eos = (self._eos[slots] >= 0) & (toks == self._eos[slots])
+            fin_max = new_cnt >= self._maxnew[slots]
+            sl, tl = slots.tolist(), toks.tolist()
+            for s, t in zip(sl, tl):
+                self._slots[s].tokens.append(t)
+            for i in np.flatnonzero(fin_eos | fin_max).tolist():
+                self._retire(self._slots[sl[i]],
+                             "eos" if fin_eos[i] else "max_len")
         self._steps += 1
         alloc = cache.allocator
-        _obs.serving_step(n_active, self.max_batch, alloc.num_used,
-                          alloc.num_usable)
+        # occupancy reports the rows the DISPATCHED program computed
+        # (mask), matching the synchronous path; the return counts only
+        # rows that passed the seat guard and actually committed —
+        # identical in sync mode (nothing re-seats between dispatch and
+        # commit there), honest under overlap preemption races
+        _obs.serving_step(int(h.mask.sum()), self.max_batch,
+                          alloc.num_used, alloc.num_usable)
         self._tp_observe()
-        return n_active
+        return int(slots.size)
+
+    def commit_inflight(self) -> int:
+        """Commit everything in flight, in dispatch order: prefill
+        chunks first (they were dispatched first — the decode program
+        chained behind them on device), then the decode/verify step;
+        finally fence any pending async swap-out DMAs into the host
+        store (ISSUE 12 satellite a). Returns the number of committed
+        units (prompt tokens + decode slots / verify tokens)."""
+        n = self.commit_prefills()
+        h, self._inflight = self._inflight, None
+        if h is not None:
+            n += (self._decode_commit(h) if h.kind == "decode"
+                  else self._spec_commit(h))
+        fence = getattr(self.cache, "fence_swaps", None)
+        if fence is not None:
+            fence()
+        return n
+
+    def decode_step(self, mask) -> int:
+        """Advance every ``mask`` slot one decode token through the
+        single jitted ragged decode program (callers pass
+        :meth:`ready_mask` or a scheduler's budgeted subset of it).
+        Returns the number of slots advanced (0 skips the program
+        entirely). Synchronous composition of :meth:`decode_dispatch`
+        + :meth:`commit_inflight` — the bit-identity reference the
+        overlapped scheduler is gated against."""
+        if self.decode_dispatch(mask) is None:
+            return 0
+        return self.commit_inflight()
 
     # ---- speculative decoding (ISSUE 5) ----
     def propose_drafts(self, mask) -> Dict[int, np.ndarray]:
@@ -1201,18 +1435,54 @@ class ContinuousBatchingEngine:
         sequential writes at ``lengths`` overwrite them before the mask
         ever reaches them — no device copy, no page churn (the
         allocator never sees a verify)."""
+        if self.spec_dispatch(mask, drafts) is None:
+            return 0
+        return self.commit_inflight()
+
+    def spec_plan_widths(self, mask) -> Dict[int, int]:
+        """Pessimistic per-row verify widths for budget planning when
+        drafts cannot be proposed yet: the OVERLAPPED scheduler plans
+        step N+1 before step N commits, so the history the n-gram
+        proposer needs is not final. Charging ``min(spec_k, room)``
+        per ready row keeps the token budget a hard ceiling (executed
+        drafts are trimmed to the planned allowance at dispatch);
+        rows with no token room are absent, exactly as in
+        :meth:`propose_drafts`."""
         if self.spec is None:
-            return self.decode_step(mask)
+            return {}
+        mask = np.asarray(mask, bool)
+        out: Dict[int, int] = {}
+        for slot, req in enumerate(self._slots):
+            if req is None or not mask[slot]:
+                continue
+            room = req.max_new_tokens - len(req.tokens) - 1
+            if room > 0:
+                out[slot] = min(self.spec_k, room)
+        return out
+
+    def spec_dispatch(self, mask,
+                      drafts: Optional[Dict] = None
+                      ) -> Optional[InFlightStep]:
+        """DISPATCH half of :meth:`spec_step`: build the draft chunk,
+        launch the batched verify program, return the in-flight handle.
+        Falls back to :meth:`decode_dispatch` when no masked row
+        drafted (the worst case is the baseline step)."""
+        if self.spec is None:
+            return self.decode_dispatch(mask)
         cache = self.cache
         mask = np.asarray(mask, bool)
         if not mask.any():
-            return 0
+            return None
+        if self._inflight is not None:
+            raise RuntimeError(
+                "spec_dispatch: a decode/verify program is already "
+                "in flight — commit_inflight() first")
         if drafts is None:
             drafts = self.propose_drafts(mask)
         drafts = {s: np.asarray(d, np.int32) for s, d in drafts.items()
                   if len(d) and mask[s]}
         if not drafts:
-            return self.decode_step(mask)
+            return self.decode_dispatch(mask)
         B, T = self.max_batch, self.spec_k + 1
         chunk = np.zeros((B, T), np.int32)
         chunk[:, 0] = self._last
@@ -1231,15 +1501,36 @@ class ContinuousBatchingEngine:
             self.params, jnp.asarray(chunk), cache.pool,
             jnp.asarray(cache.block_tables),
             jnp.asarray(cache.lengths), jnp.asarray(mask))
+        _fault_point("dispatch")
+        self._inflight = InFlightStep("spec", mask, self._rids.copy(),
+                                      self._seat.copy(), out,
+                                      drafts=drafts, dlen=dlen, t0=t0)
+        return self._inflight
+
+    def _spec_commit(self, h: InFlightStep) -> int:
+        """COMMIT half of :meth:`spec_step`: fetch the greedy targets,
+        commit each row's longest accepted prefix + bonus token.
+        Rollback of rejected draft KV is pure host bookkeeping (see
+        :meth:`spec_step`); slots whose request changed since dispatch
+        are skipped via the rid snapshot."""
+        cache = self.cache
+        mask, drafts, dlen = h.mask, h.drafts, h.dlen
+        _fault_point("commit")
+        # device-wait window opens before the (fencing) obs call —
+        # same host-attribution rule as _decode_commit
+        t_f = time.perf_counter_ns()
         if self.fused:
-            _obs.serving_fused_latency("verify_flash_attn", t0, out)
+            _obs.serving_fused_latency("verify_flash_attn", h.t0, h.out)
         _fault_point("transfer")
-        out = np.asarray(out)              # (B, T) greedy targets
+        out = np.asarray(h.out)            # (B, T) greedy targets
         t1 = time.perf_counter_ns()        # device fence: verify done
+        self._fence_ns += t1 - t_f
         from ..serving.speculative import longest_accepted_prefix
         n_slots = committed = drafted = accepted = 0
         for slot, req in enumerate(self._slots):
-            if req is None or not mask[slot]:
+            if (req is None or not mask[slot]
+                    or self._rids[slot] != h.rids[slot]
+                    or self._seat[slot] != h.seats[slot]):
                 continue
             n_slots += 1
             j = int(dlen[slot])
@@ -1259,7 +1550,7 @@ class ContinuousBatchingEngine:
                 accepted += a
                 self.spec.observe(slot, req.rid, j, a)
         self._steps += 1
-        _obs.serving_spec_verify(t0, out, n_slots, drafted, accepted,
+        _obs.serving_spec_verify(h.t0, out, n_slots, drafted, accepted,
                                  t1_ns=t1)
         alloc = cache.allocator
         _obs.serving_step(n_slots, self.max_batch, alloc.num_used,

@@ -410,6 +410,57 @@ def serving_sched_step(queue_depths, scheduled_tokens: int, budget):
                  "scheduled").set(scheduled_tokens / budget)
 
 
+def serving_overlap_step(exposed_ns: int, wall_ns: int, committed: int,
+                         overlap: bool):
+    """One scheduler step's host-plane attribution (ISSUE 12 — the
+    async overlapped runtime's scoreboard). ``exposed_ns`` is the host
+    bookkeeping time NOT hidden under an in-flight device program
+    (wall minus commit-fence device waits minus the planning phase
+    when it ran under an in-flight step); the ratio against the step's
+    wall time is the ``serving_host_overhead_fraction`` gauge —
+    measurably lower with ``overlap=True``, because expire/admit/plan
+    then runs while the device executes. ``serving_sched_step_ms``
+    (per-step wall latency, the p99 source) and the per-mode step
+    counter ride alongside so sync-vs-overlap comparisons need no
+    external clock."""
+    if not enabled:
+        return
+    _m.gauge("serving_host_overhead_fraction",
+             "fraction of the last scheduler step's wall time spent "
+             "on exposed host-plane work (not hidden under an "
+             "in-flight device program)").set(
+        min(1.0, exposed_ns / max(1, wall_ns)))
+    _m.histogram("serving_sched_step_ms",
+                 "wall milliseconds per scheduler step (plan + "
+                 "dispatch + commit)",
+                 ("mode",),
+                 buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                          250, 1000)).labels(
+        "overlap" if overlap else "sync").observe(wall_ns / 1e6)
+    _m.counter("serving_overlap_steps_total",
+               "scheduler steps by execution mode",
+               ("mode",)).labels(
+        "overlap" if overlap else "sync").inc()
+    if committed:
+        _m.counter("serving_overlap_committed_total",
+                   "units (tokens/slots/chunks) committed at step "
+                   "commit fences").inc(committed)
+
+
+def serving_sched_idle(fenced: bool):
+    """A scheduler step planned zero tokens and committed nothing —
+    all remaining work waits on device or swap completion. The run
+    loop FENCED in-flight work (or yielded when there was nothing to
+    fence) instead of busy-spinning through another empty
+    expire/admit/plan pass (ISSUE 12 bugfix)."""
+    if not enabled:
+        return
+    _m.counter("serving_sched_idle_steps_total",
+               "zero-work scheduler steps resolved by fence or yield "
+               "instead of re-planning",
+               ("action",)).labels("fence" if fenced else "yield").inc()
+
+
 def serving_fault(site: str, kind: str, injected: bool):
     """One serving fault, classified by hot-path site
     (:data:`paddle_tpu.serving.resilience.SITES`) and kind (the

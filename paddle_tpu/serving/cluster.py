@@ -93,7 +93,8 @@ class ServingCluster:
                  clock: Callable[[], float] = time.monotonic,
                  supervisor_kw: Optional[Dict] = None,
                  share_host_tier: bool = True,
-                 direct_handoff: bool = False):
+                 direct_handoff: bool = False,
+                 overlap: Optional[bool] = None):
         if replicas < 1:
             raise ValueError(f"replicas={replicas} must be >= 1")
         if not 0 <= prefill_replicas < replicas:
@@ -104,6 +105,16 @@ class ServingCluster:
         self.token_budget = token_budget
         self.clock = clock
         self._sup_kw = dict(supervisor_kw or {})
+        if overlap is not None:
+            # async overlapped runtime (ISSUE 12): every supervised
+            # replica's scheduler runs the double-buffered pipeline —
+            # threaded through scheduler_kw so supervisor rebuilds
+            # (failover, retirement replacements) keep the mode. None
+            # defers to the factory's engines (their overlap knob).
+            kw = dict(self._sup_kw.get("scheduler_kw") or {})
+            kw["overlap"] = bool(overlap)
+            self._sup_kw["scheduler_kw"] = kw
+        self.overlap = overlap
         self._next_rid = 0
         self._host_store = None
         self.replicas: List[EngineSupervisor] = [

@@ -324,25 +324,43 @@ class TieredKVCache(PagedKVCache):
         self.demotions_total = 0
         self.promote_hits_total = 0
         self._swap_charge = 0   # pending planner debit, tokens
+        # async swap-outs issued but not yet fenced into the host
+        # store (ISSUE 12): key -> {arrays (device), length, pages,
+        # t0}. The gather program was enqueued and its device→host
+        # copies started non-blocking; fence_swaps() materializes the
+        # entries. Everything that READS the store (has_swapped /
+        # swap_in / drop_swapped) fences first, so a pending payload
+        # is never invisible.
+        self._pending_swaps: "OrderedDict" = OrderedDict()
         #: last swap-in wall latencies (ms), host-side — the bench
         #: rider's swap_in_ms_p50 source (bounded; metrics registry
         #: keeps the full histogram)
         self.swap_in_ms: List[float] = []
 
     # ---- shared device programs ----
+    def _gather_device(self, ids) -> Dict:
+        """Launch the jitted gather (:func:`_pool_gather`) for the
+        pages at ``ids`` and return the DEVICE arrays without fetching
+        — the async swap-out path starts their device→host copies
+        non-blocking and fences later. PJRT usage holds keep the read
+        ordered before any later donation of the same pool buffers, so
+        freeing the pages (host bookkeeping) immediately after is
+        safe."""
+        import jax
+        import jax.numpy as jnp
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(_pool_gather)
+        return self._gather_fn(self.pool,
+                               jnp.asarray(np.asarray(ids, np.int32)))
+
     def _gather_pages(self, ids) -> Dict[str, np.ndarray]:
         """Fetch the pages at ``ids`` from every pool array to host as
         typed numpy — one jitted gather (:func:`_pool_gather`) + one
         device→host transfer, shared across all swap/demote paths and
         carried across supervisor rebuilds like the scatter/CoW
         programs."""
-        import jax
-        import jax.numpy as jnp
-        if self._gather_fn is None:
-            self._gather_fn = jax.jit(_pool_gather)
-        out = self._gather_fn(self.pool,
-                              jnp.asarray(np.asarray(ids, np.int32)))
-        return {n: np.asarray(a) for n, a in out.items()}
+        return {n: np.asarray(a)
+                for n, a in self._gather_device(ids).items()}
 
     def _decode_validated(self, entry: Dict,
                           k: Optional[int] = None) -> Dict:
@@ -381,7 +399,8 @@ class TieredKVCache(PagedKVCache):
     def _swap_key(rid: int):
         return ("swap", int(rid))
 
-    def swap_out(self, slot: int, rid: int) -> int:
+    def swap_out(self, slot: int, rid: int,
+                 nonblocking: bool = False) -> int:
         """Preemption SWAP-OUT: gather ``slot``'s live pages (the ones
         covering ``lengths[slot]`` committed tokens — the tail
         reservation holds no KV) to the host store keyed by ``rid``,
@@ -389,7 +408,15 @@ class TieredKVCache(PagedKVCache):
         :meth:`~paddle_tpu.serving.PagedKVCache.evict_for_preempt`
         would. Returns pages actually freed. The fault site fires
         BEFORE the gather, so an injected fault commits nothing and
-        the supervisor's recovery sees an ordinary running slot."""
+        the supervisor's recovery sees an ordinary running slot.
+
+        ``nonblocking=True`` (the overlapped runtime, ISSUE 12): the
+        gather is enqueued and its device→host copies START here, but
+        the host-store entry materializes at the next
+        :meth:`fence_swaps` — issued under the in-flight decode step,
+        fenced at commit, so the DMA never sits on the critical path.
+        Every store read (has_swapped / swap_in) fences first, so the
+        payload is observable the moment anyone asks."""
         if not self.active[slot]:
             raise ValueError(f"swap_out of inactive slot {slot}")
         length = int(self.lengths[slot])
@@ -400,7 +427,17 @@ class TieredKVCache(PagedKVCache):
         fault_point("swap_out")
         t0 = time.perf_counter_ns()
         k = self.pages_for(length)
-        arrays = self._gather_pages(self._slot_pages[slot][:k])
+        ids = self._slot_pages[slot][:k]
+        if nonblocking:
+            out = self._gather_device(ids)
+            for a in out.values():
+                start = getattr(a, "copy_to_host_async", None)
+                if start is not None:
+                    start()             # non-blocking device→host DMA
+            self._pending_swaps[self._swap_key(rid)] = {
+                "arrays": out, "length": length, "pages": k, "t0": t0}
+            return self.evict_for_preempt(slot)
+        arrays = self._gather_pages(ids)
         entry = self.host.put(self._swap_key(rid), arrays,
                               extra={"length": length})
         freed = self.evict_for_preempt(slot)
@@ -409,13 +446,37 @@ class TieredKVCache(PagedKVCache):
         _obs.serving_swap_out(t0, entry["bytes"], k)
         return freed
 
+    def fence_swaps(self) -> int:
+        """Materialize every pending async swap-out into the host
+        store (the commit-time fence of the overlapped runtime).
+        Returns the number fenced; 0 when nothing was pending. The
+        ``serving_swap_out`` latency histogram spans issue→fence —
+        the honest wall cost of the overlapped DMA."""
+        if not self._pending_swaps:
+            return 0
+        n = 0
+        pend, self._pending_swaps = self._pending_swaps, OrderedDict()
+        for key, ent in pend.items():
+            arrays = {nm: np.asarray(a)
+                      for nm, a in ent["arrays"].items()}
+            entry = self.host.put(key, arrays,
+                                  extra={"length": ent["length"]})
+            self.swap_outs_total += 1
+            self.swap_out_bytes_total += entry["bytes"]
+            _obs.serving_swap_out(ent["t0"], entry["bytes"],
+                                  ent["pages"])
+            n += 1
+        return n
+
     def has_swapped(self, rid: int) -> bool:
-        return self.host.contains(self._swap_key(rid))
+        key = self._swap_key(rid)
+        return key in self._pending_swaps or self.host.contains(key)
 
     def drop_swapped(self, rid: int) -> None:
         """Retire a swapped payload (its request finished or was
         cancelled while evicted) — always safe, never required: a
         missing payload just means the resume replays."""
+        self._pending_swaps.pop(self._swap_key(rid), None)
         self.host.pop(self._swap_key(rid))
 
     def swap_in(self, slot: int, rid: int, total_tokens: int,
@@ -430,6 +491,7 @@ class TieredKVCache(PagedKVCache):
         replay-prefill resume. Raises
         :class:`~paddle_tpu.serving.PoolExhausted` with NOTHING
         committed (the payload survives for the retry)."""
+        self.fence_swaps()      # a pending async payload must be visible
         entry = self.host.get(self._swap_key(rid))
         if entry is None:
             self.swap_replay_fallbacks += 1
@@ -615,7 +677,14 @@ class TieredKVCache(PagedKVCache):
         it survives a poisoned device pool, which is exactly what lets
         recovery swap sessions in instead of replaying them. Lifetime
         counters and the compiled gather carry too (monotonic stats,
-        pure function)."""
+        pure function). Pending ASYNC swap-outs (ISSUE 12) fence into
+        the store first — their gathers committed on device before the
+        fault — and a fence that itself fails just drops the payloads:
+        those resumes fall back to the gated replay path."""
+        try:
+            old.fence_swaps()
+        except Exception:
+            old._pending_swaps.clear()
         self.host = old.host
         self._gather_fn = old._gather_fn
         self.persist_prefix = old.persist_prefix
@@ -628,6 +697,7 @@ class TieredKVCache(PagedKVCache):
 
     def tier_stats(self) -> Dict:
         s = {"swap_outs_total": self.swap_outs_total,
+             "swap_outs_pending": len(self._pending_swaps),
              "swap_ins_total": self.swap_ins_total,
              "swap_out_bytes_total": self.swap_out_bytes_total,
              "swap_in_bytes_total": self.swap_in_bytes_total,

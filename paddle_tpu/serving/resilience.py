@@ -82,8 +82,14 @@ from .policy import FinishReason, Priority
 #: tools/check_instrumentation.py enforces that every name here has a
 #: matching ``fault_point("<site>")`` call site (and therefore a
 #: matching ``site=`` label on the serving_fault_* counters)
+#: "dispatch" fires AFTER a decode/verify program launches (the
+#: in-flight handle is lost with the fault — nothing committed, the
+#: journal replays); "commit" fires at the top of the commit half,
+#: before the device→host fetch — the two seams the overlapped
+#: runtime (ISSUE 12) opens between launch and host-state commit
 SITES = ("alloc", "free", "decode_step", "prefill_chunk",
-         "verify_step", "transfer", "sched_tick", "swap_out", "swap_in")
+         "verify_step", "transfer", "sched_tick", "swap_out", "swap_in",
+         "dispatch", "commit")
 
 #: the pressure-ordered degraded-mode ladder (index == level): each
 #: recovery escalates one rung, sustained healthy steps climb back down
@@ -575,6 +581,12 @@ class EngineSupervisor:
         old._slots = [None] * old.max_batch
         old._pending = {}
         old._queue = []
+        # drop dispatched-but-uncommitted work with the poisoned engine
+        # (ISSUE 12): the journal holds the last COMMITTED state, so
+        # the lost in-flight result is recomputed by the replay —
+        # token-identically (the fault-between-dispatch-and-commit gate)
+        old._inflight = None
+        old._inflight_chunks = []
 
     def _snapshot_key(self):
         import jax
@@ -831,6 +843,10 @@ class EngineSupervisor:
         fresh process via :meth:`restore`. Returns a summary dict."""
         self._check_alive()
         t0 = _obs.generate_begin()
+        # the overlapped runtime (ISSUE 12) may hold a dispatched-but-
+        # uncommitted step: commit it so sessions checkpoint with every
+        # token the device already produced (no-op when synchronous)
+        self.engine.commit_inflight()
         self._sync_journal()
         self._snapshot_key()
         now = self.clock()

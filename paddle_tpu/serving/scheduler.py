@@ -71,7 +71,7 @@ class ServingScheduler:
                  planner: Optional[TokenBudgetPlanner] = None,
                  preemption_policy: Optional[PreemptionPolicy] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 mesh=None):
+                 mesh=None, overlap: Optional[bool] = None):
         if not engine.idle:
             raise ValueError(
                 "ServingScheduler requires a fresh engine: it owns "
@@ -112,6 +112,30 @@ class ServingScheduler:
         # rung was only observable through the metrics registry, which
         # a router cannot read when metrics are disabled
         self.degraded_level = 0
+        # --- async overlapped runtime (ISSUE 12): overlap=True turns
+        # step() into the double-buffered pipeline — expire/admit/plan
+        # step N+1 WHILE step N's decode/verify program runs on device,
+        # commit step N (the single host fetch + bookkeeping) only when
+        # its result is needed (just before step N+1's dispatch), then
+        # dispatch N+1 and return with it in flight. None inherits the
+        # engine's own knob; False is the synchronous bit-identity
+        # reference the overlapped path is gated against.
+        self.overlap = bool(getattr(engine, "overlap", False)
+                            if overlap is None else overlap)
+        # deadline fast path: _expire_deadlines scans every queue each
+        # step — pointless host work when no live request ever carried
+        # a deadline (the common case); one counter skips it
+        self._deadlines_live = 0
+        #: committed units (tokens/slots) of the last step — the
+        #: busy-spin detector's input alongside last_plan
+        self.last_committed = 0
+        #: host-overhead telemetry mirrors (readable without the
+        #: metrics registry — the bench rider's source): fraction of
+        #: the last step's wall time spent on EXPOSED host work (host
+        #: bookkeeping not hidden under an in-flight device program)
+        self.last_host_frac: Optional[float] = None
+        self.host_frac_ema: Optional[float] = None
+        self.idle_fences_total = 0
 
     # ---- intake ----
     def submit(self, prompt, max_new_tokens: int = 16, *,
@@ -129,6 +153,7 @@ class ServingScheduler:
         req.submitted_at = req.enqueued_at = self.clock()
         if deadline_s is not None:
             req.deadline_at = req.submitted_at + float(deadline_s)
+            self._deadlines_live += 1
         self._queues.setdefault(int(priority), deque()).append(req)
         return req
 
@@ -143,6 +168,8 @@ class ServingScheduler:
         req.enqueued_at = self.clock()
         if req.submitted_at is None:
             req.submitted_at = req.enqueued_at
+        if req.deadline_at is not None:
+            self._deadlines_live += 1
         q = self._queues.setdefault(int(req.priority), deque())
         if front:
             q.appendleft(req)
@@ -169,6 +196,13 @@ class ServingScheduler:
         discard finished work because of the scheduler's own eviction,
         so preempted requeues (and their resume replays) are exempt and
         simply resume."""
+        if not self._deadlines_live:
+            # vectorized-bookkeeping fast path (ISSUE 12 satellite c):
+            # no deadline-bearing request was ever (re)enqueued, so the
+            # per-queue scans below can never find work — skip the
+            # whole pass instead of walking every queue every step
+            return
+
         def expired(r):
             return (r.deadline_at is not None and now >= r.deadline_at
                     and r.preemptions == 0)
@@ -294,20 +328,108 @@ class ServingScheduler:
         # width (1 + drafts) is charged against the budget before
         # anything executes; the proposals are stashed for this step's
         # execution (the engine must not re-propose under a different
-        # history)
-        self._drafts = eng.propose_drafts(ready) if getattr(
-            eng, "spec", None) is not None else {}
+        # history). The OVERLAPPED pipeline plans before the previous
+        # step commits — the history the proposer needs is not final —
+        # so it charges the pessimistic per-row width instead
+        # (spec_plan_widths) and proposes real drafts post-commit,
+        # trimmed to the planned allowance (the budget stays a hard
+        # ceiling either way).
+        if getattr(eng, "spec", None) is None:
+            self._drafts = {}
+            widths = None
+        elif self.overlap:
+            self._drafts = None
+            widths = eng.spec_plan_widths(ready) or None
+        else:
+            self._drafts = eng.propose_drafts(ready)
+            widths = {s: d.size for s, d in self._drafts.items()} or None
         return self.planner.plan(
             decode, pending, chunk_cap=eng.prefill_chunk,
-            spec_drafts={s: d.size for s, d in self._drafts.items()}
-            or None, reserved_tokens=reserved)
+            spec_drafts=widths, reserved_tokens=reserved)
+
+    def _trim_plan(self, plan: StepPlan) -> StepPlan:
+        """Reconcile an overlap-mode plan with the commit that just
+        landed: the plan was drawn against the PREDICTED post-commit
+        state, so slots whose request finished (eos at commit), was
+        preempted, or whose prefill completed are dropped. Trimming
+        only ever REMOVES work, so the budget ceiling the plan was
+        packed under still holds; per-request output is unaffected
+        (greedy decode is batch-composition independent — the standing
+        parity gates)."""
+        eng = self.engine
+
+        def alive(s):
+            req = eng._slots[s]
+            return (req is not None and not req.done
+                    and s not in eng._pending)
+        plan.decode_slots = [s for s in plan.decode_slots if alive(s)]
+        if plan.spec_drafts:
+            keep = set(plan.decode_slots)
+            plan.spec_drafts = {s: k for s, k in plan.spec_drafts.items()
+                                if s in keep}
+        plan.prefills = [(s, c) for s, c in plan.prefills
+                         if s in eng._pending]
+        return plan
+
+    def _dispatch_plan(self, plan: StepPlan) -> None:
+        """Launch the plan's programs WITHOUT committing: prefill
+        chunks first (the decode program chains behind them on
+        device), then the masked decode/verify step. Speculative rows
+        propose their REAL drafts here — post-commit, so the history
+        is final — trimmed to the planner's per-row allowance."""
+        eng = self.engine
+        for slot, cap in plan.prefills:
+            eng.prefill_dispatch(slot, max_tokens=cap)
+        if not plan.decode_slots:
+            return
+        mask = np.zeros((eng.max_batch,), bool)
+        mask[plan.decode_slots] = True
+        if plan.spec_drafts and getattr(eng, "spec", None) is not None:
+            fresh = eng.propose_drafts(mask)
+            eng.spec_dispatch(mask, {
+                s: fresh[s][:k] for s, k in plan.spec_drafts.items()
+                if s in fresh})
+        else:
+            eng.decode_dispatch(mask)
+
+    def _execute_plan(self, plan: StepPlan) -> int:
+        """The synchronous reference execution: each program dispatches
+        and commits in place (prefill chunks, then the masked
+        decode/verify program). Returns committed units."""
+        eng = self.engine
+        n = 0
+        for slot, cap in plan.prefills:
+            eng.prefill_step(slot, max_tokens=cap)
+            n += 1
+        if plan.decode_slots:
+            mask = np.zeros((eng.max_batch,), bool)
+            mask[plan.decode_slots] = True
+            if plan.spec_drafts:
+                # execute the budgeted verify: proposals trimmed to the
+                # planner's per-row draft allowance (a row the budget
+                # degraded to plain decode rides the verify batch with
+                # zero drafts — it commits exactly its greedy token)
+                n += eng.spec_step(mask, {
+                    s: self._drafts[s][:k]
+                    for s, k in plan.spec_drafts.items()})
+            else:
+                n += eng.decode_step(mask)
+        return n
 
     def step(self) -> bool:
         """One scheduler step: expire deadlines, admit (preempting if
-        needed), plan under the token budget, execute the plan (prefill
-        chunks, then the masked decode program). Returns False when no
-        work remains. ``last_plan`` holds the step's
-        :class:`~paddle_tpu.serving.policy.StepPlan`."""
+        needed), plan under the token budget, then execute. With
+        ``overlap=False`` execution is the synchronous chain (prefill
+        chunks, then the masked decode program, each committed in
+        place). With ``overlap=True`` the step is DOUBLE-BUFFERED: the
+        host phases above run while the PREVIOUS step's programs are
+        still in flight on device; that step commits only once its
+        result is actually needed (just before this step's dispatch),
+        the plan is trimmed against what the commit changed, and this
+        step's programs dispatch and are left in flight. Returns False
+        when no work remains (the overlapped path drains its last
+        in-flight step before saying so). ``last_plan`` holds the
+        step's :class:`~paddle_tpu.serving.policy.StepPlan`."""
         fault_point("sched_tick")
         eng = self.engine
         if eng.queued_requests():
@@ -320,6 +442,14 @@ class ServingScheduler:
                 "the scheduler attached — submit through "
                 "ServingScheduler.submit so priority admission is "
                 "not bypassed")
+        t_wall0 = time.perf_counter_ns()
+        # host work done while a previous step is in flight on device
+        # is HIDDEN (off the critical path); the same work with the
+        # device idle is EXPOSED — the host_overhead_fraction gauge's
+        # numerator. The synchronous path never overlaps, so all its
+        # host time is exposed by construction.
+        hidden = self.overlap and eng.has_inflight()
+        eng.take_fence_ns()                 # reset the device-wait tally
         now = self.clock()
         self._expire_deadlines(now)
         self._admit(now)
@@ -339,35 +469,76 @@ class ServingScheduler:
                     else self._swap_debt)
         self._swap_debt -= reserved
         plan = self._plan(reserved)
-        for slot, cap in plan.prefills:
-            eng.prefill_step(slot, max_tokens=cap)
-        if plan.decode_slots:
-            mask = np.zeros((eng.max_batch,), bool)
-            mask[plan.decode_slots] = True
-            if plan.spec_drafts:
-                # execute the budgeted verify: proposals trimmed to the
-                # planner's per-row draft allowance (a row the budget
-                # degraded to plain decode rides the verify batch with
-                # zero drafts — it commits exactly its greedy token)
-                eng.spec_step(mask, {
-                    s: self._drafts[s][:k]
-                    for s, k in plan.spec_drafts.items()})
-            else:
-                eng.decode_step(mask)
+        t_planned = time.perf_counter_ns()
+        if self.overlap:
+            # the ONE commit fence: step N's result is needed now —
+            # its sampled tokens seed step N+1's dispatch inputs
+            committed = eng.commit_inflight()
+            plan = self._trim_plan(plan)
+            self._dispatch_plan(plan)
+        else:
+            committed = self._execute_plan(plan)
         self.last_plan = plan
+        self.last_committed = committed
         self._steps += 1
+        t_end = time.perf_counter_ns()
+        wall = max(1, t_end - t_wall0)
+        exposed = max(0, (t_end - t_wall0) - eng.take_fence_ns()
+                      - ((t_planned - t_wall0) if hidden else 0))
+        frac = min(1.0, exposed / wall)
+        self.last_host_frac = frac
+        self.host_frac_ema = (frac if self.host_frac_ema is None
+                              else 0.9 * self.host_frac_ema + 0.1 * frac)
         _obs.serving_sched_step(
             {p: len(q) for p, q in self._queues.items()},
             # swap-in reserves are spent budget: the utilization gauge
             # reports what the step actually consumed, plan + reserve
             plan.scheduled_tokens + plan.reserved_tokens, plan.budget)
-        return any(self._queues.values()) or not eng.idle
+        _obs.serving_overlap_step(exposed, wall, committed, self.overlap)
+        return (any(self._queues.values()) or not eng.idle
+                or eng.has_inflight())
+
+    def _idle_fence(self) -> None:
+        """The busy-spin fix (ISSUE 12 satellite): a step that planned
+        nothing and committed nothing means every remaining obligation
+        is waiting on device or swap completion — re-planning empty
+        steps would burn host CPU re-scanning queues (visible as
+        zero-token steps in ``serving_sched_step``). Instead: commit
+        whatever is in flight (a real fence — the blocked work becomes
+        plannable next step), else flush pending async swap-out DMAs,
+        else yield the thread."""
+        eng = self.engine
+        self.idle_fences_total += 1
+        fenced = False
+        if eng.has_inflight():
+            self.last_committed = eng.commit_inflight()
+            fenced = True
+        else:
+            fence = getattr(eng.cache, "fence_swaps", None)
+            if fence is not None and fence():
+                fenced = True
+            else:
+                time.sleep(0)           # yield: no fence to make progress on
+        _obs.serving_sched_idle(fenced)
 
     def run(self) -> None:
         """Drive steps until every submitted request finished (or was
-        cancelled by its deadline)."""
+        cancelled by its deadline). A step that planned zero tokens and
+        committed nothing fences/yields instead of immediately
+        re-planning (see :meth:`_idle_fence`)."""
         while self.step():
-            pass
+            plan = self.last_plan
+            if (plan is not None and plan.scheduled_tokens == 0
+                    and plan.reserved_tokens == 0
+                    and self.last_committed == 0):
+                self._idle_fence()
+
+    def flush(self) -> int:
+        """Commit any in-flight work immediately (the overlapped
+        path's explicit fence for callers that need every committed
+        token visible NOW — e.g. before reading ``req.tokens`` between
+        steps). No-op on the synchronous path."""
+        return self.engine.commit_inflight()
 
     def load_stats(self) -> Dict:
         """One structured load/health snapshot — the PUBLIC surface a
@@ -417,6 +588,10 @@ class ServingScheduler:
         s["preemptions_total"] = self.preemptions_total
         s["resumes_total"] = self.resumes_total
         s["deadline_cancels_total"] = self.deadline_cancels_total
+        s["overlap"] = self.overlap
+        s["idle_fences_total"] = self.idle_fences_total
+        if self.host_frac_ema is not None:
+            s["host_overhead_fraction"] = round(self.host_frac_ema, 4)
         if self.last_plan is not None:
             s["last_step_tokens"] = self.last_plan.scheduled_tokens
             s["token_budget"] = self.last_plan.budget

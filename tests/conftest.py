@@ -71,6 +71,15 @@ def pytest_collection_modifyitems(config, items):
     rest = [it for it in items if _pre_cache(it) is None]
     if not rest:
         return
+    # newest gate file LAST (ISSUE 12): the suite has brushed its
+    # tier-1 watchdog since PR 8, so a slow-box run that gets
+    # truncated should lose the NEWEST gates first and keep the
+    # long-established prefix comparable run-to-run — the overlap
+    # gates still run (and pass) whenever the box keeps pace
+    tail = [it for it in rest
+            if "test_overlap" in str(getattr(it, "fspath", it.nodeid))]
+    if tail and tail != rest:
+        rest = [it for it in rest if it not in tail] + tail
     items[:] = pre + rest
     config._compcache_boundary = rest[0].nodeid
 

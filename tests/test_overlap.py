@@ -1,0 +1,385 @@
+"""Async overlapped serving runtime tests (ISSUE 12 acceptance gates).
+
+The double-buffered scheduler pipeline — dispatch step N, plan step
+N+1 while N runs on device, commit N at the single fence — must be
+TOKEN-IDENTICAL to the synchronous reference path on every tier and
+scenario the serving tower supports:
+
+- fp, int8-KV, int4 and w8/kv8 engines (mixed-priority bursty
+  workload with chunked prefill and preemption);
+- tp=2 sharded engines (8 virtual host devices, conftest);
+- speculative verify;
+- preempt→swap→resume through the host tier (async swap-out DMAs
+  fenced at commit);
+- supervisor crash recovery with faults at the new dispatch/commit
+  seams (the fault lands BETWEEN dispatch and commit by construction
+  — the in-flight result is lost and the journal replay must
+  reproduce it).
+
+Plus the runtime's own contracts: the token budget stays a hard
+ceiling under the predicted-state planner, `host_overhead_fraction`
+is emitted and measurably lower with overlap on the same workload,
+the run loop fences/yields on zero-work steps instead of busy-spinning
+(the ISSUE 12 bugfix), the commit rid-guard never credits a token to
+a slot's new occupant, and the `check_sync_points` lint holds.
+"""
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu.models import llama
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.distributed.mesh import serving_mesh
+from paddle_tpu.serving import (EngineSupervisor, FaultInjector,
+                                Priority, ServingScheduler)
+
+_CFG = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
+_PARAMS = llama.init_params(jax.random.key(0), _CFG)
+_REF = {}      # scenario key -> synchronous reference outputs
+
+
+def _prompts(lens, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(3, _CFG.vocab_size, (n,)).astype(np.int32)
+            for n in lens]
+
+
+def _engine(overlap, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_len", 48)
+    return ContinuousBatchingEngine(_PARAMS, _CFG, overlap=overlap, **kw)
+
+
+def _run_workload(overlap, *, budget=20, prompts=None, max_new=5,
+                  burst=True, **engine_kw):
+    """Mixed-priority workload through a scheduler: a wave of LOW/
+    NORMAL requests, then (optionally) a HIGH burst that preempts.
+    Returns (per-request outputs, scheduler). Prompt lengths are kept
+    to two page buckets so every test in this file shares the same
+    compiled chunk/decode programs (tier-1 wall-clock discipline)."""
+    prompts = prompts if prompts is not None else _prompts(
+        (5, 11, 3), seed=3)
+    eng = _engine(overlap, **engine_kw)
+    sched = ServingScheduler(eng, token_budget=budget)
+    reqs = [sched.submit(p, max_new_tokens=max_new,
+                         priority=Priority.LOW if i % 2 else
+                         Priority.NORMAL)
+            for i, p in enumerate(prompts[:-1])]
+    if burst:
+        for _ in range(5):
+            sched.step()
+        reqs.append(sched.submit(prompts[-1], max_new_tokens=max_new,
+                                 priority=Priority.HIGH))
+    else:
+        reqs.append(sched.submit(prompts[-1], max_new_tokens=max_new))
+    sched.run()
+    assert all(r.done for r in reqs), \
+        [(r.rid, r.finish_reason) for r in reqs]
+    return [r.output.tolist() for r in reqs], sched
+
+
+def _gate_identity(key, **kw):
+    """Run the workload sync and overlapped; the token streams must
+    match request for request (sync reference cached per scenario)."""
+    if key not in _REF:
+        _REF[key] = _run_workload(False, **kw)[0]
+    ov, sched = _run_workload(True, **kw)
+    assert sched.overlap
+    assert ov == _REF[key], f"overlapped != synchronous for {key}"
+    return sched
+
+
+class TestOverlapIdentity:
+    """ACCEPTANCE: overlapped output token-identical to sync."""
+
+    def test_fp(self):
+        sched = _gate_identity("fp")
+        # drained overlapped engine leaves nothing in flight
+        eng = sched.engine
+        assert not eng.has_inflight()
+        assert eng.idle
+
+    def test_int8_kv(self):
+        _gate_identity("int8", kv_cache_dtype="int8")
+
+    def test_int4(self):
+        _gate_identity("int4", weight_bits=4)
+
+    def test_w8kv8(self):
+        _gate_identity("w8kv8", weight_bits=8, kv_cache_dtype="int8")
+
+    def test_tp2(self):
+        """Sharded engine: same pipeline, decode/chunk programs lowered
+        through shard_map. The overlapped tp=2 run is compared against
+        the SINGLE-CHIP synchronous reference — tp decode is already
+        gated bit-identical to single-chip (tests/test_tp_serving.py),
+        so this transitively gates overlap-tp2 == sync-tp2 while
+        skipping a redundant sharded reference run (tier-1 wall-clock
+        discipline)."""
+        if "fp" not in _REF:
+            _REF["fp"] = _run_workload(False)[0]
+        ov, sched = _run_workload(True, mesh=serving_mesh(2))
+        assert sched.overlap
+        assert ov == _REF["fp"]
+
+    def test_spec_verify(self):
+        """Speculative engines plan pessimistic widths pre-commit and
+        propose real drafts post-commit — committed greedy streams
+        must not move."""
+        motif = np.asarray([7, 11, 13], np.int32)
+        prompts = [np.tile(motif, 5)[:14] for _ in range(3)] + \
+            [np.tile(motif, 4)[:9]]
+        _gate_identity("spec", prompts=prompts, budget=24, burst=False,
+                       spec_k=2)
+
+    def test_swap_resume(self):
+        """Host tier: preempt→swap-out (async DMA)→swap-in resume under
+        overlap matches the synchronous swap path token for token, and
+        swaps actually happened in both modes."""
+        swap_prompts = _prompts((11, 12, 5), seed=6)
+        kw = dict(host_tier=True, prompts=swap_prompts, max_new=8)
+        if "swap" not in _REF:
+            out, sched = _run_workload(False, **kw)
+            assert sched.preemptions_total > 0
+            assert sched.engine.cache.swap_ins_total > 0
+            _REF["swap"] = out
+        ov, sched = _run_workload(True, **kw)
+        assert sched.preemptions_total > 0
+        assert sched.engine.cache.swap_ins_total > 0
+        assert ov == _REF["swap"]
+
+class TestOverlapRecovery:
+    """Faults at the dispatch/commit seams recover token-identically
+    (the in-flight step's result is lost with the poisoned engine;
+    the journal replay recomputes it)."""
+
+    @staticmethod
+    def _run_sup(arm_site=None, nth=3):
+        def factory():
+            return _engine(True)
+        sup = EngineSupervisor(factory, token_budget=20, backoff_s=0.0,
+                               sleep=lambda s: None,
+                               scheduler_kw={"overlap": True})
+        inj = FaultInjector(seed=0)
+        if arm_site:
+            inj.arm(arm_site, "raise", nth=nth)
+        prompts = _prompts((5, 11, 3), seed=3)
+        reqs = []
+        with inj:
+            for p in prompts:
+                reqs.append(sup.submit(p, max_new_tokens=5))
+            sup.run()
+        assert all(r.done for r in reqs)
+        return [r.output.tolist() for r in reqs], sup
+
+    def test_fault_at_dispatch_and_commit(self):
+        """The synchronous path's coverage of these sites lives in
+        tests/test_resilience.py::TestRecoveryParity (parametrized over
+        SITES); this is the OVERLAPPED pipeline, where the commit-seam
+        fault strikes with a step genuinely in flight — the journal
+        held only COMMITTED tokens, so identity is the
+        write-ahead-precedes-commit contract."""
+        ref, sup0 = self._run_sup(None)
+        assert sup0.recoveries == 0
+        for site in ("dispatch", "commit"):
+            out, sup = self._run_sup(site)
+            assert sup.recoveries >= 1, f"{site}: nothing recovered"
+            assert out == ref, f"{site}: recovery diverged"
+
+
+class TestOverlapContracts:
+    def test_budget_hard_ceiling(self):
+        """Every overlapped step's (planned + reserved) tokens stay
+        under the configured budget — prediction + trim never round
+        through the ceiling."""
+        budget = 16
+        eng = _engine(True, max_batch=2, host_tier=True)
+        sched = ServingScheduler(eng, token_budget=budget)
+        prompts = _prompts((11, 14, 5, 3), seed=9)
+        reqs = [sched.submit(p, max_new_tokens=6,
+                             priority=Priority.LOW) for p in prompts[:2]]
+        steps = 0
+        while True:
+            more = sched.step()
+            plan = sched.last_plan
+            assert (plan.scheduled_tokens + plan.reserved_tokens
+                    <= budget), vars(plan)
+            steps += 1
+            if steps == 4:
+                reqs += [sched.submit(p, max_new_tokens=4,
+                                      priority=Priority.HIGH)
+                         for p in prompts[2:]]
+            if not more:
+                break
+            assert steps < 500
+        assert all(r.done for r in reqs)
+
+    def test_commit_rid_guard(self):
+        """A slot preempted and re-seated between dispatch and commit
+        must NOT receive the in-flight token; the victim re-decodes it
+        on resume, identically."""
+        eng = _engine(False, max_batch=1)
+        pa, pb = _prompts((5, 7), seed=5)
+        ref = eng.generate([pa], max_new_tokens=4)[0]
+
+        eng = _engine(False, max_batch=1)
+        a = eng.create_request(pa, max_new_tokens=4)
+        assert eng.admit_request(a)
+        while eng.pending_prefills():
+            eng.prefill_step()
+        h = eng.decode_dispatch(eng.ready_mask())
+        assert h is not None and eng.has_inflight()
+        eng.preempt_request(a)          # slot cleared mid-flight
+        b = eng.create_request(pb, max_new_tokens=4)
+        assert eng.admit_request(b)     # new occupant of slot 0
+        len_before = int(eng.cache.lengths[0])
+        eng.commit_inflight()
+        assert b.tokens == []           # the in-flight token was dropped
+        assert int(eng.cache.lengths[0]) == len_before
+        # the victim resumes and finishes identically regardless
+        eng.cancel_request(b)           # free the only slot for the resume
+        assert eng.admit_request(a)
+        eng.run()
+        assert a.tokens == ref[pa.size:].tolist()
+
+    def test_commit_seat_guard_same_request(self):
+        """The SAME request preempted (swap) and re-seated into its own
+        slot between dispatch and commit: the rid is unchanged, so only
+        the seat-generation snapshot can reject the stale token — its
+        KV went to the old seating's freed pages. The dropped token is
+        re-decoded after the swap-in, identically."""
+        p = _prompts((7,), seed=8)[0]
+        ref = _engine(False, max_batch=1).generate(
+            [p], max_new_tokens=4)[0]
+        eng = _engine(False, max_batch=1, host_tier=True)
+        a = eng.create_request(p, max_new_tokens=4)
+        assert eng.admit_request(a)
+        while eng.pending_prefills():
+            eng.prefill_step()
+        h = eng.decode_dispatch(eng.ready_mask())
+        assert h is not None
+        eng.preempt_request(a)          # swap-out mid-flight
+        assert eng.admit_request(a)     # swap-in: SAME rid, same slot
+        ntok, len0 = len(a.tokens), int(eng.cache.lengths[0])
+        eng.commit_inflight()
+        assert len(a.tokens) == ntok    # stale seating's token dropped
+        assert int(eng.cache.lengths[0]) == len0
+        eng.run()
+        assert np.array_equal(a.output, ref)
+
+    def test_host_overhead_fraction_emitted_and_lower(self):
+        """The scoreboard: the gauge is emitted, and the overlapped
+        path's exposed-host fraction is lower than sync on the same
+        workload (planning hides under the in-flight step)."""
+        from paddle_tpu import observability as obs
+        was = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            prompts = _prompts((9, 12, 7, 5), seed=13)
+            _, s_sync = _run_workload(False, prompts=prompts,
+                                      max_new=8)
+            snap = obs.REGISTRY.to_json()
+            assert "serving_host_overhead_fraction" in snap
+            assert "serving_sched_step_ms" in snap
+            _, s_ov = _run_workload(True, prompts=prompts, max_new=8)
+        finally:
+            obs.REGISTRY.clear()
+            if not was:
+                obs.disable()
+        assert s_sync.host_frac_ema is not None
+        assert s_ov.host_frac_ema is not None
+        assert s_ov.host_frac_ema < s_sync.host_frac_ema, (
+            s_sync.host_frac_ema, s_ov.host_frac_ema)
+        assert s_ov.stats()["overlap"] is True
+        assert "host_overhead_fraction" in s_ov.stats()
+
+    def test_run_fences_instead_of_busy_spin(self):
+        """BUGFIX: a step that plans zero tokens and commits nothing
+        fences in-flight work (or yields) instead of re-planning empty
+        steps. Forced here by stubbing the planner empty for a few
+        ticks while a request is mid-decode."""
+        from paddle_tpu.serving.policy import StepPlan
+        from paddle_tpu import observability as obs
+        eng = _engine(True)
+        sched = ServingScheduler(eng, token_budget=20)
+        req = sched.submit(_prompts((5,), seed=2)[0], max_new_tokens=6)
+        sched.step()                    # admit + first dispatch
+        real_plan = sched._plan
+        holes = {"n": 3}
+
+        def empty_plan(reserved=0):
+            if holes["n"] > 0:
+                holes["n"] -= 1
+                return StepPlan(budget=sched.planner.token_budget)
+            return real_plan(reserved)
+
+        sched._plan = empty_plan
+        was = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            sched.run()
+            snap = obs.REGISTRY.to_json()
+        finally:
+            obs.REGISTRY.clear()
+            if not was:
+                obs.disable()
+        assert req.done
+        assert sched.idle_fences_total >= 1
+        assert "serving_sched_idle_steps_total" in snap
+
+    def test_flush_makes_tokens_visible(self):
+        """flush() commits the in-flight step so callers can read
+        req.tokens between steps."""
+        eng = _engine(True)
+        sched = ServingScheduler(eng, token_budget=20)
+        req = sched.submit(_prompts((5,), seed=2)[0], max_new_tokens=6)
+        while not req.tokens:
+            sched.step()
+        n0 = len(req.tokens)
+        sched.step()                    # leaves a step in flight
+        if eng.has_inflight():
+            sched.flush()
+            assert not eng.has_inflight()
+        assert len(req.tokens) >= n0
+
+    def test_async_swap_pending_visibility(self):
+        """A non-blocking swap-out is observable (has_swapped) before
+        the fence, and fence_swaps materializes it into the store."""
+        eng = _engine(True, host_tier=True, max_batch=1)
+        a = eng.create_request(_prompts((7,), seed=4)[0],
+                               max_new_tokens=6)
+        assert eng.admit_request(a)
+        while eng.pending_prefills():
+            eng.prefill_step()
+        eng.decode_step(eng.ready_mask())
+        eng.preempt_request(a)          # overlap engine: async swap-out
+        cache = eng.cache
+        assert cache.has_swapped(a.rid)
+        assert cache.fence_swaps() == 1
+        assert cache.fence_swaps() == 0
+        assert cache.has_swapped(a.rid)
+        assert cache.swap_outs_total == 1
+
+    def test_sync_points_lint(self):
+        """The check_sync_points rule passes on the repo and catches a
+        planted violation."""
+        import os
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(root, "tools"))
+        try:
+            import check_instrumentation as ci
+        finally:
+            sys.path.pop(0)
+        assert ci.check_sync_points(root) == []
+        body = ci._function_bodies(
+            "class X:\n"
+            "    def decode_dispatch(self):\n"
+            "        x = np.asarray(nxt)\n"
+            "    def other(self):\n"
+            "        y = np.asarray(nxt)\n",
+            ("decode_dispatch",))
+        assert "np.asarray" in body and "y = " not in body

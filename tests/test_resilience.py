@@ -176,7 +176,11 @@ class TestFaultInjector:
 #: 3-request workload (admissions, retirements and steps interleave)
 _SITE_NTH = {"alloc": 2, "free": 1, "decode_step": 2,
              "prefill_chunk": 2, "verify_step": 2, "transfer": 3,
-             "sched_tick": 4}
+             "sched_tick": 4,
+             # ISSUE 12 dispatch/commit seams: visited on every decode
+             # (the sync path composes dispatch+commit), so mid-run
+             # firings mirror decode_step/transfer
+             "dispatch": 2, "commit": 3}
 
 
 class TestRecoveryParity:
@@ -192,6 +196,13 @@ class TestRecoveryParity:
                 "their recovery-parity gates live in "
                 "tests/test_host_tier.py::TestResilience (and the "
                 "chaos soak fires them)")
+        if site in ("dispatch", "commit"):
+            pytest.skip(
+                "the ISSUE 12 dispatch/commit seams are gated in "
+                "tests/test_overlap.py::TestOverlapRecovery on the "
+                "OVERLAPPED pipeline (a step genuinely in flight when "
+                "the fault strikes — the case these sites exist for); "
+                "the chaos soak fires them in both modes")
         refs = _refs(kv)
         # the verify site only exists on the speculative path; every
         # other site uses the plain engine (where decode_step always

@@ -845,6 +845,132 @@ def validate_serving_lowbit(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_async(n: int, batch_mult: int = 1):
+    """ISSUE 12 overlapped-runtime lowering gate: Mosaic-lower the
+    programs the double-buffered scheduler leaves IN FLIGHT — the
+    masked ragged decode step at fp, int8-KV and per-group INT4
+    weights, the batched spec-verify step, and the chunked-prefill
+    program COMPOSED with the dispatch-side first-token argmax (the
+    overlap pipeline samples on device at dispatch and fetches the
+    scalar at commit, so argmax-over-chunk-logits is a new program
+    tail that must lower with the chunk forward) — plus the tp=2
+    sharded masked decode (devices permitting). The dispatch/commit
+    split never changes a program's body, but an interpret-green
+    composition that won't lower would stall the pipeline at its very
+    first dispatch, so the same gate every other hot path carries
+    applies here."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.serving.paged_cache import pool_partition_specs
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    skipped = {}
+    ndev = len(jax.devices())
+    B = 8
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    params = llama.init_params(jax.random.key(0), cfg)
+    p_int4 = gen.quantize_weights(params, cfg, bits=4)
+    pg = 16
+    tables = jnp.asarray(rs.randint(1, B * 4, (B, 256 // pg)), jnp.int32)
+    toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B,)), jnp.int32)
+    lens = jnp.asarray(rs.randint(1, 200, (B,)), jnp.int32)
+    msk = jnp.asarray(rs.rand(B) > 0.5)
+
+    def decode_with_sample(p, t, pl_, bt_, ln_, m):
+        # the exact in-flight program decode_dispatch launches: masked
+        # ragged forward + greedy argmax, pool donated
+        logits, pl_ = gen.paged_decode_forward(
+            p, t, pl_, bt_, ln_, cfg, active=m, use_kernel=True)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), pl_
+
+    def export_decode(tag, pp_, kv=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(
+                jax.jit(decode_with_sample, donate_argnums=(2,)),
+                platforms=["tpu"])(pp_, toks, pool, tables, lens, msk)
+        lowered[tag] = "tpu_custom_call" in exp.mlir_module()
+
+    export_decode("overlap_decode_dispatch_fp", params)
+    export_decode("overlap_decode_dispatch_int8", params, kv="int8")
+    export_decode("overlap_decode_dispatch_int4", p_int4)
+
+    # spec-verify dispatch program (greedy targets at every position)
+    pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg) + 1,
+                                page_size=pg)
+    spec_chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, 5)),
+                             jnp.int32)
+    jax.export.export(
+        jax.jit(lambda p, c, pl_, bt_, ln_, m: gen.paged_verify_forward(
+            p, c, pl_, bt_, ln_, cfg, ctx_cap=64, active=m,
+            use_kernel=True), donate_argnums=(2,)),
+        platforms=["tpu"])(params, spec_chunk, pool, tables,
+                           jnp.minimum(lens, 60), msk)
+    # pure-XLA gather path (no Pallas kernel unless fused) — export
+    # completing is the gate, as in the serving config's verify export
+    lowered["overlap_verify_dispatch"] = True
+
+    # chunk program + dispatch-side first-token argmax: the deferred-
+    # sample composition new to the overlapped runtime
+    chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 32)), jnp.int32)
+
+    def chunk_with_sample(p, c, pl_, bt_, cl, kl):
+        logits, pl_ = gen.paged_prefill_chunk(
+            p, c, pl_, bt_, cfg, ctx_cap=64, ctx_len=cl, chunk_len=kl)
+        return jnp.argmax(logits[0]), pl_
+    jax.export.export(
+        jax.jit(chunk_with_sample, donate_argnums=(2,)),
+        platforms=["tpu"])(params, chunk, pool, tables[0],
+                           jnp.int32(60), jnp.int32(32))
+    lowered["overlap_chunk_dispatch_sample"] = True  # export IS the gate
+
+    if ndev >= 2:
+        from paddle_tpu.distributed.mesh import serving_mesh
+        mesh = serving_mesh(2)
+        placed, specs = llama.shard_serving_params(params, cfg, mesh)
+        spool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                     + 1, page_size=pg, tp=2)
+        pspecs = pool_partition_specs(spool, "tp")
+        spool = {nm: jax.device_put(a, NamedSharding(mesh, pspecs[nm]))
+                 for nm, a in spool.items()}
+
+        def tp_body(p, t, pl_, bt_, ln_, m):
+            logits, pl_ = gen.paged_decode_forward(
+                p, t, pl_, bt_, ln_, cfg, active=m, use_kernel=True,
+                tp_axis="tp")
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pl_
+        fwd = shard_map(tp_body, mesh=mesh,
+                        in_specs=(specs, P(), pspecs, P(), P(), P()),
+                        out_specs=(P(), pspecs), check_rep=False)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(
+                jax.jit(fwd, donate_argnums=(2,)), platforms=["tpu"])(
+                placed, toks, spool, tables, lens, msk)
+        lowered["overlap_decode_dispatch_tp2"] = \
+            "tpu_custom_call" in exp.mlir_module()
+    else:
+        skipped["overlap_decode_dispatch_tp2"] = (
+            f"--devices {ndev} < tp=2; nothing to shard")
+    ok = all(lowered.values())
+    return {
+        "config": "serving_async_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({"skipped": skipped} if skipped else {}),
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def _impl(args) -> int:
     rows = []
 
@@ -876,6 +1002,8 @@ def _impl(args) -> int:
         emit(validate_serving_host(args.devices, args.batch_mult))
     if args.config in ("serving-lowbit", "all"):
         emit(validate_serving_lowbit(args.devices, args.batch_mult))
+    if args.config in ("serving-async", "all"):
+        emit(validate_serving_async(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         ok = ok and (r.get("fits_v5p") is not False)
@@ -889,7 +1017,8 @@ def main():
     ap.add_argument("--config",
                     choices=["7b", "13b", "13b-long", "moe", "moe-pp",
                              "serving", "serving-tp", "serving-cluster",
-                             "serving-host", "serving-lowbit", "all"],
+                             "serving-host", "serving-lowbit",
+                             "serving-async", "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")

@@ -7,8 +7,9 @@ speculative verify, priority preemption — through an
 :class:`~paddle_tpu.serving.FaultInjector` fires at least ``--faults``
 faults across EVERY hot-path site (allocator alloc/free, decode /
 prefill-chunk / verify execution, device→host transfer, scheduler
-tick; raise + stall + corrupt modes), then asserts the invariants that
-make recovery trustworthy:
+tick, host-tier swap out/in, and the overlapped runtime's
+dispatch/commit seams — ISSUE 12; raise + stall + corrupt modes), then
+asserts the invariants that make recovery trustworthy:
 
 - **zero lost requests** — every submitted request finishes with a
   structured reason (eos / max_len / rejected_overload when the
@@ -83,11 +84,20 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     def factory():
         # host tier ON (ISSUE 10): preemptions swap out / resumes swap
         # in, so the soak's fault stream also exercises the swap_out /
-        # swap_in sites under the same zero-lost/zero-duplicated gate
+        # swap_in sites under the same zero-lost/zero-duplicated gate.
+        # overlap ON (ISSUE 12): the supervisor's scheduler runs the
+        # double-buffered pipeline — faults at the new dispatch/commit
+        # seams (and between them) must recover token-identically via
+        # journal replay, and preemption swap-outs go through the
+        # async DMA + commit-fence path. The per-request references
+        # run through engine.generate(), which is synchronous
+        # regardless of the knob — so the soak's parity gate is ALSO
+        # the overlap-vs-sync identity gate, under fault fire.
         return ContinuousBatchingEngine(
             params, cfg, max_batch=3, page_size=8, max_len=48,
             prefill_chunk=8, spec_k=spec_k,
-            speculator=_speculator(spec_k), host_tier=True)
+            speculator=_speculator(spec_k), host_tier=True,
+            overlap=True)
 
     # mixed workload: long prompts (multi-chunk prefill), short ones,
     # repetitive motifs (accepted drafts), three priority classes
@@ -133,13 +143,23 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
         # than the per-step sites (once per preemption/resume, not per
         # step), so their armed shots sit on early calls: the FIRST
         # swap-out succeeds (a payload must exist for any swap-in to
-        # run at all), the second faults; the first swap-in faults and
-        # its retry proves the payload survived the recovery.
+        # run at all — and a recovery rebuilds a fresh engine with
+        # every slot free, so a faulted swap-out is not re-attempted
+        # until the next drill round preempts again), the second
+        # faults; the first swap-in faults and its retry proves the
+        # payload survived the recovery.
         for i, site in enumerate(SITES):
             if site == "swap_out":
                 inj.arm(site, "raise", nth=2)
             elif site == "swap_in":
                 inj.arm(site, "raise", nth=1)
+            elif site == "verify_step":
+                # spec verify only runs at degraded level 0 — the
+                # first recovery shelves it (no_spec) and every armed
+                # fault elsewhere costs a recovery, so the verify shot
+                # must land on an EARLY call or the site may never
+                # accumulate enough visits to reach a deep nth
+                inj.arm(site, "raise", nth=2)
             else:
                 inj.arm(site, "raise", nth=3 + 2 * i)
         for i in range(stall_faults):
@@ -194,15 +214,29 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             topup_jobs = []
             for _ in range(2):
                 lows = []
-                for _ in range(3):          # max_batch slots
-                    p = rs.randint(3, cfg.vocab_size, (6,)).astype(
-                        np.int32)
-                    lows.append(sup.submit(p, max_new_tokens=8,
-                                           priority=Priority.NORMAL))
-                    reqs.append(lows[-1])
-                    topup_jobs.append((p, 8))
-                while not all(len(r.tokens) >= 2 or r.done
-                              for r in lows):
+                # fill EVERY slot with decode-phase NORMAL work, topping
+                # up as earlier fillers finish (or recoveries churn the
+                # slots): the HIGH below must find no free slot and only
+                # swappable victims, or the admission would not preempt
+                # and the swap sites would go unvisited — the organic
+                # phase's preemption count depends on the seeded fault
+                # sequence, which shifts whenever SITES grows (ISSUE 12
+                # added dispatch/commit), so the drill must not rely on it
+                while True:
+                    eng = sup.engine       # recoveries swap the engine
+                    running = eng.running_requests()
+                    if (len(running) == eng.max_batch
+                            and all(eng.swap_candidate(r)
+                                    for r in running)):
+                        break
+                    if sum(1 for r in lows if not r.done) < eng.max_batch:
+                        p = rs.randint(3, cfg.vocab_size, (6,)).astype(
+                            np.int32)
+                        lows.append(sup.submit(
+                            p, max_new_tokens=6,
+                            priority=Priority.NORMAL))
+                        reqs.append(lows[-1])
+                        topup_jobs.append((p, 6))
                     try:
                         sup.step()
                     except EngineDead:
@@ -367,10 +401,14 @@ def run_cluster_soak(seed: int = 0, requests: int = 18,
         # host tier ON (ISSUE 10); the cluster shares ONE HostPageStore
         # across replicas (share_host_tier default), so sessions the
         # killed replica swapped out SWAP IN on the replica they rehome
-        # to — the failover path exercises the cross-replica host tier
+        # to — the failover path exercises the cross-replica host tier.
+        # overlap ON (ISSUE 12): every supervised replica runs the
+        # double-buffered scheduler, so the replica kill lands with a
+        # step in flight and the rehomed sessions' resumes gate the
+        # overlapped cluster against the synchronous references.
         return ContinuousBatchingEngine(
             params, cfg, max_batch=2, page_size=8, max_len=48,
-            prefill_chunk=8, host_tier=True)
+            prefill_chunk=8, host_tier=True, overlap=True)
 
     # multi-tenant workload: each tenant has its own system prompt
     # (affinity + prefix hits) plus a unique tail, three priorities

@@ -66,6 +66,12 @@ REQUIRED = {
         ('_obs.serving_fused_latency("decode_rope_attn"', 1),
         ('_obs.serving_fused_latency("chunk_flash_attn"', 1),
         ('_obs.serving_fused_latency("verify_flash_attn"', 1),
+        # async overlapped runtime (ISSUE 12): the dispatch/commit
+        # seams — decode AND spec paths each fire both sites, so a
+        # fault between program launch and host-state commit is
+        # injectable (and chaos-soaked) on every step kind
+        ('_fault_point("dispatch")', 2),
+        ('_fault_point("commit")', 2),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -73,6 +79,13 @@ REQUIRED = {
         # budget-utilization gauge once per planned step
         ("_obs.serving_queue_wait(", 1),
         ("_obs.serving_sched_step(", 1),
+        # async overlapped runtime (ISSUE 12): the per-step host-plane
+        # attribution (host_overhead_fraction gauge + the
+        # serving_sched_step_ms p99 source) and the idle-fence counter
+        # of the busy-spin fix — the scoreboard the overlap refactor
+        # is judged by
+        ("_obs.serving_overlap_step(", 1),
+        ("_obs.serving_sched_idle(", 1),
         # fault-injection site (ISSUE 8): the scheduler tick
         ('fault_point("sched_tick")', 1),
     ],
@@ -225,9 +238,84 @@ def check_fault_sites(root: str) -> list:
     return problems
 
 
+#: the sync-point discipline of the overlapped runtime (ISSUE 12):
+#: module -> function names whose bodies must stay FREE of device→host
+#: sync idioms (single-argument ``np.asarray(...)`` fetches and
+#: ``block_until_ready``). None = the whole module. The scheduler's
+#: host plane and the engine's DISPATCH-path functions plan and launch
+#: only — every fetch of a step result belongs in the commit helpers
+#: (_decode_commit / _spec_commit / _commit_chunk), or the overlap
+#: pipeline silently degrades back to a synchronous chain.
+_SYNC_FREE = {
+    "paddle_tpu/serving/scheduler.py": None,
+    "paddle_tpu/inference/predictor.py": (
+        "decode_dispatch", "spec_dispatch", "prefill_dispatch",
+        "ready_mask", "propose_drafts", "spec_plan_widths"),
+}
+
+#: device-sync idioms: a bare one-argument np.asarray (dtype-annotated
+#: conversions of host arrays pass — they never touch device values on
+#: these paths) and any block_until_ready
+_SYNC_RE = (r"(?<!j)np\.asarray\([^,()]*(\([^()]*\))?[^,()]*\)(?!\s*,)",
+            r"block_until_ready")
+
+
+def _function_bodies(src: str, names) -> str:
+    """Concatenate the bodies of the named top-level-in-class defs
+    (selected by indentation: a body line is any line more indented
+    than its ``def``)."""
+    import re
+    out = []
+    lines = src.splitlines()
+    for name in names:
+        for i, line in enumerate(lines):
+            m = re.match(rf"(\s*)def {re.escape(name)}\(", line)
+            if not m:
+                continue
+            indent = len(m.group(1))
+            j = i + 1
+            while j < len(lines):
+                ln = lines[j]
+                if ln.strip() and (len(ln) - len(ln.lstrip())) <= indent:
+                    break
+                out.append(ln)
+                j += 1
+    return "\n".join(out)
+
+
+def check_sync_points(root: str) -> list:
+    """ISSUE 12 rule: no ``np.asarray`` / ``block_until_ready`` on
+    step results outside the commit helpers in the scheduler/predictor
+    hot paths. The textual heuristic flags single-argument
+    ``np.asarray(x)`` (the device-fetch idiom) and any
+    ``block_until_ready`` inside the :data:`_SYNC_FREE` scopes —
+    dtype-annotated conversions (``np.asarray(x, np.int32)``) are
+    host-side and pass."""
+    import re
+    problems = []
+    for rel, names in _SYNC_FREE.items():
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: file missing")
+            continue
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        scope = src if names is None else _function_bodies(src, names)
+        where = ("module" if names is None
+                 else "dispatch-path functions " + "/".join(names))
+        for pat in _SYNC_RE:
+            for m in re.finditer(pat, scope):
+                problems.append(
+                    f"{rel}: device-sync idiom {m.group(0)!r} in the "
+                    f"{where} — step results must be fetched only in "
+                    f"the commit helpers (the overlapped runtime's "
+                    f"single-fence contract, ISSUE 12)")
+    return problems
+
+
 def check(root: str) -> list:
     """Returns a list of human-readable violation strings (empty = ok)."""
-    problems = check_fault_sites(root)
+    problems = check_fault_sites(root) + check_sync_points(root)
     for rel, rules in REQUIRED.items():
         path = os.path.join(root, rel)
         if not os.path.exists(path):

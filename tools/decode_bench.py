@@ -132,11 +132,15 @@ def main():
     # SLO-scheduler control plane (ISSUE 4): oversubscribed
     # two-priority bursty workload with preempt/evict/resume under a
     # token-budgeted step planner — also shared with bench.py; the
-    # p50/p99 step-latency dict rides the record separately
+    # p50/p99 step-latency dict rides the record separately, and the
+    # ISSUE 12 overlap rider (sync vs double-buffered step ms +
+    # host_overhead_fraction) rides next to it
     def _sched():
-        tps, lat = bench_mod.sched_decode_tier(
+        tps, lat, ov = bench_mod.sched_decode_tier(
             params, cfg, db, dp_len, dnew, on_tpu)
         out["decode_sched_step_ms"] = lat
+        if ov:
+            out["decode_overlap_speedup"] = ov
         return tps
     run_tier("decode_sched_tokens_per_sec", _sched)
 

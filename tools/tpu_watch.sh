@@ -116,7 +116,8 @@ if dec.get("decode_tokens_per_sec") is not None:
     # tier's fused-kernel speedup (ISSUE 11)
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
                   "decode_tp_scaling", "decode_cluster_scaling",
-                  "decode_offload_resume", "decode_fused_speedup"):
+                  "decode_offload_resume", "decode_fused_speedup",
+                  "decode_overlap_speedup"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
             lg["extra"][rider] = ms
