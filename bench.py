@@ -158,7 +158,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_w8kv8_tps=None, decode_paged_tps=None,
             decode_prefix_tps=None, decode_sched=None,
             decode_spec=None, decode_tp=None, decode_cluster=None,
-            decode_offload=None, decode_fused=None, phases=None):
+            decode_offload=None, decode_slo=None, decode_fused=None,
+            phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -184,7 +185,9 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_cluster_tokens_per_sec": (
                       decode_cluster[0] if decode_cluster else None),
                   "decode_offload_tokens_per_sec": (
-                      decode_offload[0] if decode_offload else None)},
+                      decode_offload[0] if decode_offload else None),
+                  "decode_slo_goodput_tokens_per_sec": (
+                      decode_slo[0] if decode_slo else None)},
     }
     if decode_sched:
         # the tier's point is the BOUND, not just the throughput:
@@ -212,6 +215,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # the host-tier tier's point is the RESUME cost it removed:
         # swap-in latency + the ratio vs the replay-prefill baseline
         rec["extra"]["decode_offload_resume"] = decode_offload[1]
+    if decode_slo:
+        # goodput only means something next to the SLO outcomes and
+        # autoscale activity that produced it (ISSUE 13) — they ride
+        # the record together
+        rec["extra"]["decode_slo_metrics"] = decode_slo[1]
     if decode_fused:
         # fused-kernel rider on the paged tier (ISSUE 11): per-step
         # wall ms unfused vs fused + the throughput ratio — the direct
@@ -838,6 +846,78 @@ def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     return tps, rider
 
 
+def slo_goodput_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                     kv_cache_dtype=None):
+    """The decode_slo_goodput_tokens_per_sec measurement (ISSUE 13),
+    shared by measure() and tools/decode_bench.py so the two sources
+    stay comparable.
+
+    The trace-driven traffic harness against an AUTOSCALING cluster:
+    a fixed-seed open-loop trace (tenant prefix families, one 4x burst
+    window, mixed priority/deadline/length — see
+    :func:`paddle_tpu.serving.traffic.synth_trace`) drives a cluster
+    that starts at ONE replica and breathes with load through the
+    :class:`~paddle_tpu.serving.ClusterAutoscaler` (scale-up on
+    backlog, scale-down after the burst, through the retire_replica
+    drain path). The virtual :class:`~paddle_tpu.serving.FakeClock`
+    makes arrival dynamics and SLO accounting deterministic; wall time
+    prices the actual serving work. The headline is GOODPUT — tokens
+    of deadline-met requests per wall second, not raw throughput:
+    overload work that misses its SLO counts for nothing, which is
+    exactly the regression this tier gates. The rider carries the
+    quantities that explain the number: deadline-met fraction, p99
+    TTFT (virtual ms), p99 per-token latency, the autoscaler's
+    up/down event counts for the timed pass, and the rejection split
+    (the admission machinery's visible work)."""
+    from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    from paddle_tpu.serving import (ClusterAutoscaler, FakeClock,
+                                    ServingCluster, run_trace,
+                                    synth_trace)
+    page = 16 if on_tpu else 8
+    prefix_pages = max(1, (dp_len // 2) // page)
+    tail_max = max(2, dp_len // 2)
+    # the engine must hold the LONGEST trace prompt plus its decode
+    # budget (prefix family + unique tail + new tokens)
+    max_len = prefix_pages * page + tail_max + dnew
+
+    def factory():
+        return ContinuousBatchingEngine(
+            params, cfg, max_batch=db, page_size=page,
+            max_len=max_len, kv_cache_dtype=kv_cache_dtype)
+
+    clock = FakeClock()
+    cluster = ServingCluster(
+        factory, replicas=1, clock=clock,
+        autoscaler=ClusterAutoscaler(
+            min_replicas=1, max_replicas=3,
+            up_backlog_per_replica=2.0 * db,
+            down_backlog_per_replica=0.5,
+            up_after=1, down_after=4, cooldown_ticks=3),
+        supervisor_kw=dict(backoff_s=0.0, sleep=lambda s: None))
+    trace = synth_trace(
+        seed=29, duration_s=3.0, base_rps=4.0 * db, tenants=3,
+        page_size=page, prefix_pages=prefix_pages,
+        vocab=cfg.vocab_size, tail_tokens=(1, tail_max),
+        new_tokens=(max(1, dnew // 2), dnew),
+        burst_mult=4.0, deadline_frac=0.5, deadline_s=(0.5, 2.5))
+    run_trace(cluster, trace, clock, step_dt=0.05)  # compile/warm pass
+    report = run_trace(cluster, trace, clock, step_dt=0.05)
+    rider = {
+        "requests": report.requests,
+        "deadline_met_fraction": round(report.deadline_met_fraction,
+                                       4),
+        "p99_ttft_ms": (round(report.p99_ttft_s * 1e3, 1)
+                        if report.p99_ttft_s is not None else None),
+        "p99_per_token_ms": (
+            round(report.p99_per_token_s * 1e3, 3)
+            if report.p99_per_token_s is not None else None),
+        "autoscale_up": report.autoscale_up,
+        "autoscale_down": report.autoscale_down,
+        "rejected": dict(report.rejected),
+    }
+    return round(report.goodput_tokens_per_s, 2), rider
+
+
 _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_int4_tokens_per_sec", "decode_w8kv8_tokens_per_sec",
                  "decode_paged_tokens_per_sec",
@@ -846,7 +926,8 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_spec_tokens_per_sec",
                  "decode_tp_tokens_per_sec",
                  "decode_cluster_tokens_per_sec",
-                 "decode_offload_tokens_per_sec")
+                 "decode_offload_tokens_per_sec",
+                 "decode_slo_goodput_tokens_per_sec")
 
 # rider dicts that travel with their tier when it carries from an older
 # record: the scheduler tier's p50/p99 step-latency bound (ISSUE 4),
@@ -864,6 +945,8 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                    "decode_cluster_scaling"),
                   ("decode_offload_tokens_per_sec",
                    "decode_offload_resume"),
+                  ("decode_slo_goodput_tokens_per_sec",
+                   "decode_slo_metrics"),
                   ("decode_paged_tokens_per_sec",
                    "decode_fused_speedup"))
 
@@ -1205,6 +1288,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"offload decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # goodput-under-SLO (ISSUE 13): the trace-driven traffic harness
+    # against the autoscaling cluster — goodput, deadline-met fraction,
+    # p99 TTFT and the autoscale event counts ride the record
+    decode_slo = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_slo = slo_goodput_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"slo goodput bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
@@ -1214,7 +1309,7 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_paged_tps, decode_prefix_tps,
                    decode_sched=decode_sched, decode_spec=decode_spec,
                    decode_tp=decode_tp, decode_cluster=decode_cluster,
-                   decode_offload=decode_offload,
+                   decode_offload=decode_offload, decode_slo=decode_slo,
                    decode_fused=decode_fused, phases=phases)
 
 

@@ -859,6 +859,133 @@ def serving_handoff_import(t0_ns: int):
                "prefill→decode KV handoffs imported").inc()
 
 
+def serving_router_retry_exhausted():
+    """A shed request exhausted its per-request retry budget (or its
+    tenant's retry-rate cap) and the rejection surfaced to the caller —
+    counted SEPARATELY from first-try rejection so overload dashboards
+    can tell 'the cluster is full' from 'one replica is degraded and
+    retries are amplifying' (ISSUE 13 satellite)."""
+    if not enabled:
+        return
+    _m.counter("serving_router_retry_exhausted_total",
+               "shed requests whose retry budget or tenant retry-rate "
+               "cap ran out before a replica accepted them").inc()
+
+
+# ---------------- overload & SLO (ISSUE 13) ----------------
+
+def serving_slo_rejected(tenant: str):
+    """The admission controller rejected a submission at the cluster
+    door because its deadline was infeasible against current backlog
+    (``rejected_infeasible``) — shed BEFORE any replica pays queueing
+    or prefill for a request that could never meet its SLO."""
+    if not enabled:
+        return
+    _m.counter("serving_slo_rejected_infeasible_total",
+               "submissions rejected at admission as deadline-"
+               "infeasible", ("tenant",)).labels(tenant).inc()
+
+
+def serving_slo_ttft(ttft_s: float, met: bool, priority: int):
+    """One request's time-to-first-token under the trace-driven
+    harness (virtual-clock seconds from arrival to first committed
+    token), with its deadline outcome — the p99 TTFT and
+    deadline-met-fraction sources of the goodput-under-SLO tier."""
+    if not enabled:
+        return
+    _m.histogram("serving_slo_ttft_ms",
+                 "milliseconds from arrival to first token under the "
+                 "traffic harness", ("priority",),
+                 buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                          5000, 10000)).labels(
+        str(int(priority))).observe(ttft_s * 1e3)
+    _m.counter("serving_slo_deadline_total",
+               "requests by deadline outcome under the traffic harness",
+               ("outcome",)).labels("met" if met else "missed").inc()
+
+
+def serving_slo_tokens(n: int, met: bool):
+    """Tokens produced by a finished request, split by whether the
+    request met its SLO: the ``met`` stream is GOODPUT, the rest is
+    work the cluster did for requests that missed anyway — the split
+    the admission controller exists to improve."""
+    if not enabled:
+        return
+    _m.counter("serving_slo_tokens_total",
+               "tokens produced under the traffic harness, by SLO "
+               "outcome", ("outcome",)).labels(
+        "goodput" if met else "badput").inc(n)
+
+
+def serving_slo_report(goodput_tps: float, met_frac: float,
+                       p99_ttft_ms):
+    """End-of-trace summary gauges: goodput (tokens/s of SLO-met
+    requests over the run's wall time), deadline-met fraction, and
+    p99 TTFT — the three headline numbers of the
+    ``decode_slo_goodput`` bench tier."""
+    if not enabled:
+        return
+    _m.gauge("serving_slo_goodput_tokens_per_sec",
+             "goodput of the last traffic-harness run (tokens of "
+             "deadline-met requests per wall second)").set(goodput_tps)
+    _m.gauge("serving_slo_deadline_met_fraction",
+             "deadline-met fraction of the last traffic-harness run"
+             ).set(met_frac)
+    if p99_ttft_ms is not None:
+        _m.gauge("serving_slo_p99_ttft_ms",
+                 "p99 time-to-first-token of the last traffic-harness "
+                 "run").set(p99_ttft_ms)
+
+
+def serving_autoscale(direction: str, replicas: int,
+                      backlog_per_replica: float):
+    """One autoscaler decision that actually scaled (``direction`` in
+    ``up``/``down``): event counter + the serviceable-replica-count
+    and backlog gauges — the closed loop's observable trajectory
+    (tools/chaos_soak.py --traffic asserts both directions fired)."""
+    if not enabled:
+        return
+    _m.counter("serving_autoscale_events_total",
+               "autoscaler scale events", ("direction",)).labels(
+        direction).inc()
+    _m.gauge("serving_autoscale_replicas",
+             "serviceable replicas after the last autoscaler decision"
+             ).set(replicas)
+    _m.gauge("serving_autoscale_backlog_per_replica",
+             "backlog per serviceable replica at the last autoscaler "
+             "decision").set(backlog_per_replica)
+
+
+# ---------------- payload integrity (ISSUE 13) ----------------
+
+def serving_integrity(site: str, action: str):
+    """One payload-integrity event at a byte-moving site (``handoff``,
+    ``swap_in``, ``prefix_promote``, ``disk_store``): ``detected`` — a
+    checksum caught a corrupt/torn payload before install;
+    ``quarantined`` — the entry was removed so it can never be
+    re-served; ``replayed`` — the request recovered through the gated
+    replay path. detected == quarantined (+ the replay where one
+    applies) is the integrity gate's arithmetic."""
+    if not enabled:
+        return
+    _m.counter("serving_integrity_events_total",
+               "payload-integrity events at byte-moving sites",
+               ("site", "action")).labels(site, action).inc()
+
+
+def serving_integrity_retry(site: str):
+    """One bounded-backoff retry of a byte-moving operation
+    (``handoff_import`` / ``swap_in``) after a transient fault — the
+    retry is idempotent (a failed attempt frees everything it
+    allocated before re-raising), so the counter measures transient
+    flakiness absorbed without a full engine recovery."""
+    if not enabled:
+        return
+    _m.counter("serving_integrity_retries_total",
+               "bounded retries of byte-moving operations after "
+               "transient faults", ("site",)).labels(site).inc()
+
+
 def serving_step(active: int, max_slots: int, pages_used: int,
                  pages_total: int):
     """One continuous-batching decode step: batch-occupancy histogram +

@@ -33,6 +33,16 @@ SLO-aware scheduler.
   export/import APIs, failover and rolling drain/upgrade) routed by
   :class:`ClusterRouter` (prefix-affinity placement, load/SLO-aware
   dispatch, per-tenant fair share + :class:`TenantQuota` rate limits).
+- :mod:`paddle_tpu.serving.traffic` — the trace-driven traffic harness
+  (ISSUE 13): :func:`synth_trace` (seeded open-loop traces — tenant
+  prefix families, bursty/diurnal arrivals, mixed priority/deadline/
+  length), :class:`FakeClock`, and :func:`run_trace` →
+  :class:`SLOReport` (p99 TTFT, per-token latency, deadline-met
+  fraction, goodput-under-SLO). The cluster side adds
+  :class:`~paddle_tpu.serving.router.AdmissionController`
+  (deadline-infeasible submissions shed at the door) and
+  :class:`~paddle_tpu.serving.cluster.ClusterAutoscaler` (hysteresis
+  scale up/down through the ``retire_replica`` drain path).
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -56,5 +66,10 @@ from .speculative import (  # noqa: F401
     NgramProposer, Speculator, longest_accepted_prefix,
 )
 from .host_tier import HostPageStore, TieredKVCache  # noqa: F401
-from .router import ClusterRouter, TenantQuota  # noqa: F401
-from .cluster import ServingCluster  # noqa: F401
+from .router import (  # noqa: F401
+    AdmissionController, ClusterRouter, TenantQuota,
+)
+from .cluster import ClusterAutoscaler, ServingCluster  # noqa: F401
+from .traffic import (  # noqa: F401
+    FakeClock, SLOReport, TraceRequest, run_trace, synth_trace,
+)

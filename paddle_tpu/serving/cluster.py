@@ -20,9 +20,11 @@ optionally tp-sharded) behind a
   :class:`~paddle_tpu.serving.router.TenantQuota` rate limits reject
   over-quota submissions with the structured ``rejected_ratelimit``
   finish reason before any replica sees them; a request a degraded
-  replica sheds (``rejected_overload``) is re-dispatched ONCE to the
-  healthiest replica before the rejection surfaces
-  (``serving_router_retries_total``).
+  replica sheds (``rejected_overload``) re-dispatches to untried
+  replicas under a per-request retry budget and per-tenant retry-rate
+  cap (ISSUE 13) before the rejection surfaces
+  (``serving_router_retries_total`` /
+  ``serving_router_retry_exhausted_total``).
 
 - **Prefill/decode disaggregation** (``prefill_replicas > 0``) —
   dedicated prefill replicas run chunked prefill to completion, then
@@ -46,6 +48,17 @@ optionally tp-sharded) behind a
   MID-DECODE, and restores the drained prefix trie into the
   replacement so the tenant's next prompt still prefix-HITs.
 
+- **Overload hardening (ISSUE 13)** — an optional
+  :class:`~paddle_tpu.serving.router.AdmissionController` sheds
+  deadline-infeasible submissions at the door
+  (``rejected_infeasible``), a :class:`ClusterAutoscaler` breathes the
+  decode-replica count with backlog + degraded rungs (hysteresis +
+  cooldown; scale-down drains through :meth:`retire_replica`, so
+  sessions rehome with zero loss), and the handoff verifies payload
+  CRCs before install (a corrupt payload is detected, counted, and
+  the request keeps decoding on its prefill replica) with bounded
+  idempotent retries on transient import faults.
+
 Token identity holds by construction: per-request greedy decode is
 independent of batch composition (the PR 2–7 parity gates), so routed
 output matches a single engine serving the same request set
@@ -62,11 +75,104 @@ from typing import Callable, Deque, Dict, List, Optional
 import numpy as np
 
 from ..observability import hooks as _obs
+from .host_tier import _tampered_entry
 from .paged_cache import PoolExhausted
 from .policy import FinishReason, Priority
-from .resilience import (EngineDead, EngineSupervisor,
-                         load_drain_checkpoint)
-from .router import ClusterRouter, TenantQuota
+from .resilience import (CorruptionDetected, EngineDead,
+                         EngineSupervisor, StepStalled, fault_point,
+                         load_drain_checkpoint, run_with_deadline,
+                         tamper_point)
+from .router import AdmissionController, ClusterRouter, TenantQuota
+
+
+class ClusterAutoscaler:
+    """Hysteresis policy + state for the cluster's closed scaling loop
+    (ISSUE 13): each :meth:`ServingCluster.step` feeds it the decode
+    tier's backlog-per-serviceable-replica and worst degraded rung, and
+    it answers ``"up"`` / ``"down"`` / ``None``.
+
+    Flap-proofing is structural: scale-up needs ``up_after``
+    CONSECUTIVE over-threshold ticks (backlog at or above
+    ``up_backlog_per_replica``, or any replica at or past
+    ``degraded_rung_trigger`` — a rung that deep means the PR 8 ladder
+    is already shedding, so more silicon beats more shedding), scale-
+    down needs ``down_after`` consecutive under-threshold ticks with
+    every replica healthy, the two thresholds leave a dead band
+    between them, and ANY action starts a ``cooldown_ticks`` refractory
+    window. ``min_replicas``/``max_replicas`` bound the serviceable
+    decode-replica count."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4, *,
+                 up_backlog_per_replica: float = 4.0,
+                 down_backlog_per_replica: float = 0.5,
+                 up_after: int = 2, down_after: int = 4,
+                 cooldown_ticks: int = 8,
+                 degraded_rung_trigger: int = 2):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"ClusterAutoscaler: need 1 <= min_replicas="
+                f"{min_replicas} <= max_replicas={max_replicas}")
+        if down_backlog_per_replica >= up_backlog_per_replica:
+            raise ValueError(
+                f"ClusterAutoscaler: down threshold "
+                f"{down_backlog_per_replica} must sit strictly below "
+                f"the up threshold {up_backlog_per_replica} — the dead "
+                f"band between them is the anti-flap margin")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_backlog = float(up_backlog_per_replica)
+        self.down_backlog = float(down_backlog_per_replica)
+        self.up_after = max(1, int(up_after))
+        self.down_after = max(1, int(down_after))
+        self.cooldown_ticks = max(0, int(cooldown_ticks))
+        self.degraded_rung_trigger = int(degraded_rung_trigger)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown = 0
+        self.up_events = 0
+        self.down_events = 0
+
+    def decide(self, backlog_per_replica: float, serviceable: int,
+               max_rung: int) -> Optional[str]:
+        """One tick's decision; mutates the hysteresis state."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        pressure = (backlog_per_replica >= self.up_backlog
+                    or max_rung >= self.degraded_rung_trigger)
+        calm = (backlog_per_replica <= self.down_backlog
+                and max_rung == 0)
+        if pressure:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif calm:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            # the dead band: neither streak advances, neither resets
+            # the other's progress to zero-and-back flapping
+            self._up_streak = 0
+            self._down_streak = 0
+        if (pressure and self._up_streak >= self.up_after
+                and serviceable < self.max_replicas):
+            self._up_streak = 0
+            self._cooldown = self.cooldown_ticks
+            self.up_events += 1
+            return "up"
+        if (self._down_streak >= self.down_after
+                and serviceable > self.min_replicas):
+            self._down_streak = 0
+            self._cooldown = self.cooldown_ticks
+            self.down_events += 1
+            return "down"
+        return None
+
+    def stats(self) -> Dict:
+        return {"up_events": self.up_events,
+                "down_events": self.down_events,
+                "cooldown_remaining": self._cooldown,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas}
 
 
 class ServingCluster:
@@ -94,7 +200,12 @@ class ServingCluster:
                  supervisor_kw: Optional[Dict] = None,
                  share_host_tier: bool = True,
                  direct_handoff: bool = False,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 admission: Optional[AdmissionController] = None,
+                 autoscaler: Optional[ClusterAutoscaler] = None,
+                 handoff_retries: int = 2,
+                 handoff_timeout_s: Optional[float] = None,
+                 retry_sleep: Callable[[float], None] = time.sleep):
         if replicas < 1:
             raise ValueError(f"replicas={replicas} must be >= 1")
         if not 0 <= prefill_replicas < replicas:
@@ -155,7 +266,23 @@ class ServingCluster:
         self.direct_handoff = bool(direct_handoff)
         self._seq = 0
         self._steps = 0
+        # SLO-guarded admission + autoscaling (ISSUE 13): the
+        # controller sheds deadline-infeasible submissions at the door
+        # (rejected_infeasible — BEFORE the PR 8 degraded ladder pays
+        # for them), the autoscaler breathes the decode-replica count
+        # with load through the existing retire_replica drain path
+        self.admission = admission
+        self.autoscaler = autoscaler
+        # bounded idempotent handoff retry (+ optional per-import
+        # deadline): a transient decode-side import fault retries with
+        # backoff before it costs that replica a recovery
+        self.handoff_retries = int(handoff_retries)
+        self.handoff_timeout_s = handoff_timeout_s
+        self._retry_sleep = retry_sleep
         self.handoffs_total = 0
+        self.handoff_retries_total = 0
+        self.handoff_corruptions_total = 0
+        self.autoscale_faults_total = 0
         self.failovers_total = 0
         self.retirements_total = 0
         self.deadline_cancels_total = 0
@@ -218,6 +345,33 @@ class ServingCluster:
             self.router.note_ratelimited(tenant)
             _obs.serving_cancelled(1, req.finish_reason)
             return req
+        if deadline_s is not None and self.admission is not None:
+            # SLO-guarded admission (ISSUE 13): feasibility is judged
+            # against the tier that will produce this request's FIRST
+            # token — fresh submissions dispatch to the prefill tier
+            # when one exists (_dispatch_one's role rule), so an idle
+            # decode replica must not mask a buried prefill queue.
+            # The load_stats walk (O(queued requests) per replica)
+            # only runs when the service-rate model is on; without
+            # tokens_per_s feasible() never reads the loads.
+            if self.admission.tokens_per_s is not None:
+                role = (self._prefill_idxs() if self.prefill_replicas
+                        else self._decode_idxs())
+                loads = (self._alive(role) or self._alive(
+                    range(len(self.replicas)))).values()
+            else:
+                loads = ()
+            if not self.admission.feasible(
+                    float(deadline_s), req.prompt.shape[1], loads):
+                # the deadline cannot be met against current backlog —
+                # reject at the door instead of queueing work that will
+                # expire (or push replicas onto the degraded ladder)
+                # without ever producing goodput
+                req.done = True
+                req.finish_reason = FinishReason.REJECTED_INFEASIBLE.value
+                self.router.note_slo_rejected(tenant)
+                _obs.serving_cancelled(1, req.finish_reason)
+                return req
         if deadline_s is not None:
             req.deadline_at = self.clock() + float(deadline_s)
         self._rq.append({"req": req, "tenant": tenant, "cost": cost,
@@ -241,8 +395,10 @@ class ServingCluster:
         request of any tenant that already consumed more. Dispatch =
         journaled intake on the chosen replica
         (:meth:`~paddle_tpu.serving.EngineSupervisor.submit_request`);
-        a shed (``rejected_overload``) dispatch retries ONCE on the
-        healthiest other replica. Queued requests whose deadline lapsed
+        a shed (``rejected_overload``) dispatch retries on untried
+        replicas up to the router's per-request retry budget, bounded
+        by the tenant's retry-rate cap. Queued requests whose deadline
+        lapsed
         at the router cancel here — the same admission SLO the replica
         schedulers enforce."""
         if not self._rq:
@@ -274,6 +430,7 @@ class ServingCluster:
 
     def _dispatch_one(self, entry: Dict):
         req = entry["req"]
+        tenant = entry["tenant"]
         fresh = not req.tokens and req.preemptions == 0
         role = (self._prefill_idxs()
                 if self.prefill_replicas and fresh
@@ -283,32 +440,46 @@ class ServingCluster:
         key = self.router.affinity_key(req.prompt[0])
         idx, hit = self.router.pick_replica(key, loads)
         self.replicas[idx].submit_request(req)
-        self.router.note_dispatch(idx, hit)
+        self.router.note_dispatch(idx, hit, tenant)
         self._owner[req.rid] = idx
-        if req.done and req.finish_reason == \
-                FinishReason.REJECTED_OVERLOAD.value and len(loads) > 1:
-            # router-level retry of shed work: once, to the healthiest
-            # OTHER replica (ignore affinity — the bound replica just
-            # proved it cannot take new work)
-            self.router.note_retry()
+
+        def shed():
+            return (req.done and req.finish_reason
+                    == FinishReason.REJECTED_OVERLOAD.value)
+        # router-level retry of shed work (ISSUE 13 satellite): a
+        # per-request budget of re-dispatches to untried replicas
+        # (ignore affinity — the bound replica just proved it cannot
+        # take new work), bounded by the tenant's retry-rate cap so a
+        # degraded replica cannot amplify one tenant's burst into a
+        # cluster-wide retry storm. Exhaustion (budget/cap ran out, or
+        # every replica tried) counts separately from a first-try
+        # rejection with nowhere else to go.
+        tried = {idx}
+        attempts = 0
+        while (shed() and len(loads) > len(tried)
+               and self.router.may_retry(tenant, attempts)):
+            self.router.note_retry(tenant)
+            attempts += 1
             req.done = False
             req.finish_reason = None
             idx2, _ = self.router.pick_replica(None, loads,
-                                               exclude=(idx,))
+                                               exclude=tried)
             self.replicas[idx2].submit_request(req)
-            self.router.note_dispatch(idx2, False)
+            self.router.note_dispatch(idx2, False, tenant)
+            tried.add(idx2)
             self._owner[req.rid] = idx2
-            if req.done:
-                # the healthiest replica is shedding too: the cluster
-                # really is overloaded — surface the rejection
-                req.finish_reason = FinishReason.REJECTED_OVERLOAD.value
-        if not (req.done and req.finish_reason ==
-                FinishReason.REJECTED_OVERLOAD.value):
+        if shed():
+            req.finish_reason = FinishReason.REJECTED_OVERLOAD.value
+            if attempts > 0 or (len(loads) > len(tried)
+                                and not self.router.may_retry(
+                                    tenant, attempts)):
+                self.router.note_retry_exhausted()
+        else:
             # the fair-share account charges only work a replica
             # actually accepted — a tenant whose requests are shed
             # during a degraded blip must not also sink in the
             # dispatch order for service it never received
-            self.router.charge(entry["tenant"], entry["cost"])
+            self.router.charge(tenant, entry["cost"])
 
     # ---- stepping ----
     def step(self) -> bool:
@@ -328,6 +499,7 @@ class ServingCluster:
                 self._failover(i)
         if self.prefill_replicas:
             self._harvest_handoffs()
+        self._autoscale_tick()
         self._publish()
         self._prune_finished()
         self._steps += 1
@@ -370,6 +542,73 @@ class ServingCluster:
             _obs.serving_router_replica(
                 i, s["queued_total"], s["pool_occupancy"],
                 s["degraded_level"])
+
+    # ---- autoscaling (ISSUE 13) ----
+    def _spawn_replica(self) -> int:
+        """Install one fresh decode replica: reuse a drained/dead husk
+        slot first (replica INDICES are identity — the owner map and
+        affinity bindings key on them, so the list must not shift),
+        else append. The fresh supervisor shares the cluster host
+        tier/clock like any construction-time replica."""
+        for i in self._decode_idxs():
+            sup = self.replicas[i]
+            if sup.health == "dead" or sup._draining:
+                self.replicas[i] = self._new_supervisor()
+                self.router.drop_replica(i)
+                return i
+        self.replicas.append(self._new_supervisor())
+        return len(self.replicas) - 1
+
+    def _autoscale_tick(self):
+        """One closed-loop scaling decision (no-op without an
+        :class:`ClusterAutoscaler`): feed the decode tier's backlog
+        per serviceable replica + worst degraded rung through the
+        hysteresis policy; ``up`` installs a fresh replica, ``down``
+        retires the least-loaded one through the PR 9
+        :meth:`retire_replica` drain path — its sessions rehome
+        MID-DECODE with resume semantics, so scale-down loses and
+        duplicates nothing (the soak gate). The tick itself is a
+        best-effort control plane: a fault here (the
+        ``autoscale_tick`` site) skips ONE decision and the next step
+        re-evaluates from fresh signals — it must never take serving
+        down with it."""
+        if self.autoscaler is None:
+            return
+        try:
+            fault_point("autoscale_tick")
+        except Exception:
+            self.autoscale_faults_total += 1
+            return
+        # one load_stats pass over the whole fleet (load_stats walks
+        # every queued request since queued_tokens landed — the decode
+        # subset is derived, not re-computed)
+        every = self._alive(range(len(self.replicas)))
+        alive = {i: s for i, s in every.items()
+                 if i >= self.prefill_replicas}
+        if not alive:
+            return
+        # pressure signal: the WHOLE cluster's undone work (router
+        # queue + every serviceable replica's queues — a disaggregated
+        # prefill replica's backlog is future decode work in disguise)
+        # over the decode capacity the autoscaler actually controls
+        backlog = (
+            sum(1 for e in self._rq if not e["req"].done)
+            + sum(s["queued_total"] + s["pending_prefills"]
+                  for s in every.values()))
+        per = backlog / len(alive)
+        max_rung = max(s["degraded_level"] for s in every.values())
+        action = self.autoscaler.decide(per, len(alive), max_rung)
+        if action == "up":
+            self._spawn_replica()
+            _obs.serving_autoscale("up", len(alive) + 1, per)
+        elif action == "down":
+            # retire the healthiest/least-loaded replica: fewest live
+            # sessions to rehome, and the survivors keep the hot tries
+            victim = min(alive,
+                         key=lambda i: self.router._score(alive[i])
+                         + (i,))
+            self.retire_replica(victim, replace=False)
+            _obs.serving_autoscale("down", len(alive) - 1, per)
 
     # ---- prefill→decode handoff ----
     def _harvest_handoffs(self):
@@ -421,9 +660,17 @@ class ServingCluster:
         eng = sup.engine
         direct = self.direct_handoff
         t0 = _obs.generate_begin()
+        # export-side fault site (ISSUE 13): fires before the pure
+        # read — a fault here commits nothing and routes through the
+        # PREFILL supervisor's recovery (the _harvest_handoffs catch)
+        fault_point("handoff_export")
         # pure host-side read; the direct path exports metadata only —
         # the page bytes move device-to-device inside the import
         payload = eng.export_prefilled(req, with_kv=not direct)
+        if not direct and tamper_point("handoff_export"):
+            # injected payload corruption: real bytes flip here, the
+            # import-side CRC verifier must catch them before install
+            payload["kv"] = _tampered_entry(payload["kv"])
         pages = eng.cache.pages_for(payload["length"])
         nbytes = (eng.cache.page_payload_bytes(pages) if direct else
                   sum(a.nbytes for a in payload["kv"]["arrays"].values()))
@@ -434,29 +681,74 @@ class ServingCluster:
                                decode_loads[d]) + (d,)):
             dsup = self.replicas[didx]
             t1 = _obs.generate_begin()
-            try:
-                if dsup.engine.import_prefilled(
-                        req, payload,
-                        src_engine=eng if direct else None):
-                    placed = didx
-                    _obs.serving_handoff_import(t1)
-                    break
-            except PoolExhausted:
-                continue                # full pool: try the next replica
-            except EngineDead:
-                self._failover(didx)
-                continue
-            except Exception as exc:  # noqa: BLE001 — a fault inside
-                # the DECODE-side import (allocator, scatter) is that
-                # replica's failure: its supervisor pays the recovery
-                # and its circuit counts it — never the healthy prefill
-                # replica's. The request is untouched (import cleans up
-                # its allocations before re-raising).
+            attempts = 0
+            while True:
                 try:
-                    dsup._on_failure(exc)
+                    fault_point("handoff_import")
+                    if run_with_deadline(
+                            lambda: dsup.engine.import_prefilled(
+                                req, payload,
+                                src_engine=eng if direct else None),
+                            self.handoff_timeout_s):
+                        placed = didx
+                        _obs.serving_handoff_import(t1)
+                    break               # placed, or no free slot there
+                except PoolExhausted:
+                    break               # full pool: try the next replica
+                except CorruptionDetected:
+                    # the payload failed its checksum BEFORE install
+                    # (ISSUE 13): nothing was committed on the decode
+                    # side, and the request is untouched on the
+                    # PREFILL replica — it simply keeps decoding there,
+                    # token-identically (the handoff is opportunistic).
+                    # The corrupt payload dies with this attempt: it is
+                    # never offered to another replica.
+                    self.handoff_corruptions_total += 1
+                    _obs.serving_integrity("handoff", "detected")
+                    _obs.serving_integrity("handoff", "quarantined")
+                    return
                 except EngineDead:
                     self._failover(didx)
-                continue
+                    break
+                except StepStalled as exc:
+                    # a TIMED-OUT import is NOT retryable in place:
+                    # the abandoned watchdog thread may still complete
+                    # the original install, so a retry could run
+                    # concurrently and double-install. Charge the
+                    # replica a recovery instead — the rebuild fences
+                    # the poisoned engine (slot tables cleared), so a
+                    # late-completing import commits into a discarded
+                    # engine, never a live one.
+                    try:
+                        dsup._on_failure(exc)
+                    except EngineDead:
+                        self._failover(didx)
+                    break
+                except Exception as exc:  # noqa: BLE001 — transient or
+                    # real fault inside the DECODE-side import
+                    # (allocator, scatter, injected). First the
+                    # bounded idempotent retry (a failed import frees
+                    # everything it allocated before re-raising, and
+                    # journal ownership moves only at adopt_running —
+                    # so a retry can never double-install pages or
+                    # double-own recovery); past the budget it is that
+                    # replica's failure: its supervisor pays the
+                    # recovery and its circuit counts it — never the
+                    # healthy prefill replica's.
+                    attempts += 1
+                    if attempts <= self.handoff_retries:
+                        self.handoff_retries_total += 1
+                        _obs.serving_integrity_retry("handoff_import")
+                        self._retry_sleep(
+                            min(0.2, 0.005 * 2 ** (attempts - 1)))
+                        continue
+                    try:
+                        dsup._on_failure(exc)
+                    except EngineDead:
+                        self._failover(didx)
+                    break
+            if placed is not None:
+                break
         if placed is None:
             return                      # keep decoding on the prefill side
         dsup = self.replicas[placed]
@@ -572,15 +864,22 @@ class ServingCluster:
             per.append(s)
         return {
             "replicas": len(self.replicas),
+            "replicas_serviceable": len(
+                self._alive(range(len(self.replicas)))),
             "prefill_replicas": self.prefill_replicas,
             "cluster_steps": self._steps,
             "router_queued": len(self._rq),
             "handoffs_total": self.handoffs_total,
+            "handoff_retries_total": self.handoff_retries_total,
+            "handoff_corruptions_total": self.handoff_corruptions_total,
+            "autoscale_faults_total": self.autoscale_faults_total,
             "failovers_total": self.failovers_total,
             "retirements_total": self.retirements_total,
             "deadline_cancels_total": self.deadline_cancels_total,
             "router": self.router.stats(),
             "per_replica": per,
+            **({"autoscaler": self.autoscaler.stats()}
+               if self.autoscaler is not None else {}),
             **({"host_tier": self._host_store.stats()}
                if self._host_store is not None else {}),
         }

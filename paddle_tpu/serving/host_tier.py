@@ -68,7 +68,9 @@ import numpy as np
 
 from ..observability import hooks as _obs
 from .paged_cache import PagedKVCache, PoolExhausted
-from .resilience import _np_dtype, fault_point
+from .resilience import (CorruptionDetected, _np_dtype, fault_point,
+                         payload_checksums, tamper_point,
+                         verify_checksums)
 
 
 def _pool_gather(pool: Dict, src):
@@ -86,6 +88,23 @@ def _key_name(key: bytes) -> str:
     bytes) — content-addressed, so two engines sharing one store
     directory converge on the same files."""
     return hashlib.sha1(key).hexdigest() + ".npz"
+
+
+def _tampered_entry(entry: Dict) -> Dict:
+    """A copy of ``entry`` with one payload byte flipped — the
+    injector's payload-corruption mode (ISSUE 13:
+    ``FaultInjector.arm_tamper``): the CHECKSUM verifier, not the
+    injector, must detect the damage, so the whole
+    detect→quarantine→replay path runs on real corrupt bytes."""
+    arrays = {n: np.array(a, copy=True)
+              for n, a in entry["arrays"].items()}
+    name = sorted(arrays)[0]
+    flat = arrays[name].reshape(-1).view(np.uint8)
+    if flat.size:
+        flat[flat.size // 2] ^= 0xFF
+    out = dict(entry)
+    out["arrays"] = arrays
+    return out
 
 
 class HostPageStore:
@@ -121,6 +140,9 @@ class HostPageStore:
         self.hits_total = 0
         self.misses_total = 0
         self.capacity_drops_total = 0
+        #: corrupt/torn entries removed so they can never be re-served
+        #: (ISSUE 13) — the integrity gate's quarantine counter
+        self.quarantined_total = 0
         if path is not None:
             os.makedirs(path, exist_ok=True)
 
@@ -144,7 +166,9 @@ class HostPageStore:
 
     @staticmethod
     def encode(arrays: Dict[str, np.ndarray]) -> Dict:
-        """Pack host arrays into the raw-uint8 + meta payload form."""
+        """Pack host arrays into the raw-uint8 + meta payload form,
+        stamped with per-array CRCs (ISSUE 13) — every consumer
+        verifies them before installing the bytes anywhere."""
         enc, meta, pages = {}, {}, 0
         for name, a in arrays.items():
             a = np.ascontiguousarray(a)
@@ -153,7 +177,8 @@ class HostPageStore:
             if a.ndim >= 2:
                 pages = max(pages, int(a.shape[1]))
         return {"arrays": enc, "meta": meta, "pages": pages,
-                "bytes": sum(int(v.nbytes) for v in enc.values())}
+                "bytes": sum(int(v.nbytes) for v in enc.values()),
+                "checksums": payload_checksums(enc)}
 
     @staticmethod
     def decode(entry: Dict) -> Dict[str, np.ndarray]:
@@ -214,7 +239,8 @@ class HostPageStore:
 
     def _write_disk(self, key: bytes, entry: Dict):
         meta = {"meta": entry["meta"], "pages": entry["pages"],
-                "extra": entry["extra"]}
+                "extra": entry["extra"],
+                "checksums": entry.get("checksums")}
         fn = os.path.join(self.path, _key_name(key))
         tmp = fn + ".tmp"
         with open(tmp, "wb") as f:
@@ -223,6 +249,18 @@ class HostPageStore:
                                         np.uint8),
                      **{f"a_{n}": a for n, a in entry["arrays"].items()})
         os.replace(tmp, fn)     # atomic: a reader never sees half a file
+
+    def _quarantine_disk(self, fn: str):
+        """Remove a corrupt/torn standing-store file so it is NEVER
+        re-read (counted; removal failure still counts — the in-RAM
+        miss already protects this process, the unlink protects the
+        next one)."""
+        self.quarantined_total += 1
+        _obs.serving_integrity("disk_store", "quarantined")
+        try:
+            os.unlink(fn)
+        except OSError:
+            pass
 
     def _read_disk(self, key: bytes) -> Optional[Dict]:
         fn = os.path.join(self.path, _key_name(key))
@@ -235,9 +273,24 @@ class HostPageStore:
                                     for n in data.files
                                     if n.startswith("a_")},
                          "meta": meta["meta"], "pages": meta["pages"],
-                         "extra": meta["extra"], "persist": True}
+                         "extra": meta["extra"], "persist": True,
+                         "checksums": meta.get("checksums")}
         except Exception:
-            return None         # torn/foreign file: a miss, not a crash
+            # torn/truncated/foreign file: a detected corruption — the
+            # file quarantines (never re-read) and the caller serves a
+            # plain miss (prefix MISS -> replay), not a crash
+            _obs.serving_integrity("disk_store", "detected")
+            self._quarantine_disk(fn)
+            return None
+        try:
+            # bit-flips np.load cannot see: verify the stamped CRCs
+            # BEFORE the entry enters RAM or any scatter (ISSUE 13)
+            verify_checksums(entry["arrays"], entry.get("checksums"),
+                             "disk_store")
+        except CorruptionDetected:
+            _obs.serving_integrity("disk_store", "detected")
+            self._quarantine_disk(fn)
+            return None
         entry["bytes"] = sum(int(v.nbytes)
                              for v in entry["arrays"].values())
         return entry
@@ -271,6 +324,21 @@ class HostPageStore:
             self._publish()
         return entry
 
+    def quarantine(self, key, site: str) -> None:
+        """Remove a corrupt entry EVERYWHERE it could be re-served
+        (RAM and, for persisted bytes keys, the standing disk file) and
+        count it (ISSUE 13). A quarantined entry is gone for good: the
+        next lookup is an honest miss, and its request recovers through
+        the gated replay path."""
+        self.pop(key)
+        self.quarantined_total += 1
+        _obs.serving_integrity(site, "quarantined")
+        if self.path is not None and isinstance(key, bytes):
+            try:
+                os.unlink(os.path.join(self.path, _key_name(key)))
+            except OSError:
+                pass
+
     def stats(self) -> Dict:
         return {"entries": len(self._entries),
                 "pages_resident": self.pages_resident,
@@ -279,7 +347,8 @@ class HostPageStore:
                 "puts_total": self.puts_total,
                 "hits_total": self.hits_total,
                 "misses_total": self.misses_total,
-                "capacity_drops_total": self.capacity_drops_total}
+                "capacity_drops_total": self.capacity_drops_total,
+                "quarantined_total": self.quarantined_total}
 
 
 class TieredKVCache(PagedKVCache):
@@ -308,19 +377,30 @@ class TieredKVCache(PagedKVCache):
                  host_capacity_pages: Optional[int] = None,
                  prefix_store_dir: Optional[str] = None,
                  persist_prefix: bool = True,
-                 store: Optional[HostPageStore] = None, **kw):
+                 store: Optional[HostPageStore] = None,
+                 swap_in_retries: int = 2,
+                 retry_sleep=time.sleep, **kw):
         super().__init__(cfg, max_batch, max_len, **kw)
         self.host = store if store is not None else HostPageStore(
             self.page_size, capacity_pages=host_capacity_pages,
             path=prefix_store_dir)
         self.persist_prefix = persist_prefix
         self._gather_fn = None
+        # bounded idempotent retry of the swap-in scatter (ISSUE 13):
+        # a transient fault retries in place with exponential backoff
+        # instead of costing a full engine recovery — every failed
+        # attempt frees what it allocated first, and the fault site
+        # fires before any commit, so retries never double-install
+        self.swap_in_retries = int(swap_in_retries)
+        self._retry_sleep = retry_sleep
         self.swap_outs_total = 0
         self.swap_ins_total = 0
         self.swap_out_bytes_total = 0
         self.swap_in_bytes_total = 0
         self.swap_in_pages_total = 0
         self.swap_replay_fallbacks = 0
+        self.swap_in_retries_total = 0
+        self.corruptions_detected_total = 0
         self.demotions_total = 0
         self.promote_hits_total = 0
         self._swap_charge = 0   # pending planner debit, tokens
@@ -362,13 +442,17 @@ class TieredKVCache(PagedKVCache):
         return {n: np.asarray(a)
                 for n, a in self._gather_device(ids).items()}
 
-    def _decode_validated(self, entry: Dict,
-                          k: Optional[int] = None) -> Dict:
+    def _decode_validated(self, entry: Dict, k: Optional[int] = None,
+                          site: str = "host_payload") -> Dict:
         """Decode a host payload and validate it against THIS pool's
         geometry (array set, dtypes, layer/page shape) — a stale
         standing store from a different config must read as a loud
         error on the swap path and a silent miss on the prefix path,
-        never a corrupt scatter."""
+        never a corrupt scatter. The payload's stamped CRCs verify
+        FIRST (ISSUE 13): corrupt bytes raise
+        :class:`~paddle_tpu.serving.CorruptionDetected` before any
+        decode — the callers quarantine and fall back to replay."""
+        verify_checksums(entry["arrays"], entry.get("checksums"), site)
         if set(entry["meta"]) != set(self.pool):
             raise ValueError(
                 f"host payload arrays {sorted(entry['meta'])} != pool "
@@ -479,6 +563,20 @@ class TieredKVCache(PagedKVCache):
         self._pending_swaps.pop(self._swap_key(rid), None)
         self.host.pop(self._swap_key(rid))
 
+    def _quarantine_swap_in(self, rid: int) -> None:
+        """Corrupt swap payload: quarantine (counted, never re-served)
+        and count the fall-back to the gated replay resume — the
+        journal holds everything needed to recompute the KV bit-exactly.
+        ``fence_swaps`` already drained any pending async copy of this
+        payload into the store, so quarantining the store entry is the
+        whole cleanup."""
+        self.corruptions_detected_total += 1
+        _obs.serving_integrity("swap_in", "detected")
+        self.host.quarantine(self._swap_key(rid), "swap_in")
+        self.swap_replay_fallbacks += 1
+        _obs.serving_swap_fallback()
+        _obs.serving_integrity("swap_in", "replayed")
+
     def swap_in(self, slot: int, rid: int, total_tokens: int,
                 expect_tokens: int) -> Optional[int]:
         """Preemption SWAP-IN: re-admit ``rid`` on ``slot`` by
@@ -506,17 +604,50 @@ class TieredKVCache(PagedKVCache):
             self.swap_replay_fallbacks += 1
             _obs.serving_swap_fallback()
             return None
-        fault_point("swap_in")
+        if tamper_point("swap_in"):
+            # injected payload corruption: real bytes flip, the CRC
+            # verifier below must catch them (never the injector)
+            entry = _tampered_entry(entry)
         t0 = time.perf_counter_ns()
         n = self._check_admit(slot, total_tokens)
         k = self.pages_for(length)
-        arrays = self._decode_validated(entry, k=k)
-        pages = self._alloc_with_evict(n)
         try:
-            self._scatter_pages(arrays, pages[:k])
-        except Exception:
-            self.allocator.free(pages)
-            raise
+            arrays = self._decode_validated(entry, k=k, site="swap_in")
+        except CorruptionDetected:
+            self._quarantine_swap_in(rid)
+            return None
+        # bounded idempotent retry (ISSUE 13): a transient fault at the
+        # site — or inside the alloc/scatter — retries in place with
+        # exponential backoff instead of poisoning the whole engine.
+        # Each failed attempt frees everything it allocated before
+        # re-raising (and the fault site fires before any allocation),
+        # so a retried swap-in can never double-install pages.
+        # PoolExhausted stays back-pressure (the caller's contract);
+        # an injected corrupt-mode fault is a detection (quarantine +
+        # replay, same as real corrupt bytes above).
+        attempt = 0
+        while True:
+            try:
+                fault_point("swap_in")
+                pages = self._alloc_with_evict(n)
+                try:
+                    self._scatter_pages(arrays, pages[:k])
+                except Exception:
+                    self.allocator.free(pages)
+                    raise
+                break
+            except PoolExhausted:
+                raise
+            except CorruptionDetected:
+                self._quarantine_swap_in(rid)
+                return None
+            except Exception:
+                attempt += 1
+                if attempt > self.swap_in_retries:
+                    raise
+                self.swap_in_retries_total += 1
+                _obs.serving_integrity_retry("swap_in")
+                self._retry_sleep(min(0.2, 0.005 * 2 ** (attempt - 1)))
         self._install(slot, pages)
         self.lengths[slot] = length
         self.host.pop(self._swap_key(rid))
@@ -634,7 +765,21 @@ class TieredKVCache(PagedKVCache):
             return 0
         t0 = time.perf_counter_ns()
         try:
-            arrays = [self._decode_validated(e, k=1) for e in entries]
+            arrays = [self._decode_validated(e, k=1,
+                                             site="prefix_promote")
+                      for e in entries]
+        except CorruptionDetected:
+            # corrupt demoted/persisted chain (bit-flip, torn write):
+            # quarantine every entry of the chain (counted, never
+            # re-served — RAM and disk) and serve the admission as a
+            # plain prefix MISS; the replay prefill recomputes the KV
+            self.corruptions_detected_total += 1
+            _obs.serving_integrity("prefix_promote", "detected")
+            for jj in range(len(matched), len(matched) + len(entries)):
+                self.host.quarantine(self._chain_key(prompt, jj + 1),
+                                     "prefix_promote")
+            _obs.serving_integrity("prefix_promote", "replayed")
+            return 0
         except ValueError:
             # stale store (different geometry/kv tier): drop the bad
             # chain and serve the admission as a plain miss
@@ -691,9 +836,13 @@ class TieredKVCache(PagedKVCache):
         for name in ("swap_outs_total", "swap_ins_total",
                      "swap_out_bytes_total", "swap_in_bytes_total",
                      "swap_in_pages_total", "swap_replay_fallbacks",
+                     "swap_in_retries_total",
+                     "corruptions_detected_total",
                      "demotions_total", "promote_hits_total"):
             setattr(self, name, getattr(old, name))
         self.swap_in_ms = old.swap_in_ms
+        self.swap_in_retries = old.swap_in_retries
+        self._retry_sleep = old._retry_sleep
 
     def tier_stats(self) -> Dict:
         s = {"swap_outs_total": self.swap_outs_total,
@@ -702,6 +851,9 @@ class TieredKVCache(PagedKVCache):
              "swap_out_bytes_total": self.swap_out_bytes_total,
              "swap_in_bytes_total": self.swap_in_bytes_total,
              "swap_replay_fallbacks": self.swap_replay_fallbacks,
+             "swap_in_retries_total": self.swap_in_retries_total,
+             "corruptions_detected_total":
+                 self.corruptions_detected_total,
              "prefix_demotions_total": self.demotions_total,
              "prefix_promote_hits_total": self.promote_hits_total}
         s.update({f"host_{k}": v for k, v in self.host.stats().items()})

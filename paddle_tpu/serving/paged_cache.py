@@ -973,8 +973,14 @@ class PagedKVCache:
             a = np.ascontiguousarray(np.asarray(arr[:, sel]))
             arrays[name] = np.frombuffer(a.tobytes(), np.uint8)
             meta[name] = {"shape": list(a.shape), "dtype": str(a.dtype)}
+        # integrity (ISSUE 13): per-array CRCs computed at export time
+        # — import_request verifies before any scatter, so a payload
+        # corrupted in transit is a loud CorruptionDetected at the
+        # decode door, never a silently-wrong KV page
+        from .resilience import payload_checksums
         return {"page_size": self.page_size, "num_pages": k,
-                "length": length, "arrays": arrays, "meta": meta}
+                "length": length, "arrays": arrays, "meta": meta,
+                "checksums": payload_checksums(arrays)}
 
     def import_request(self, slot: int, payload: Dict,
                        total_tokens: int) -> np.ndarray:
@@ -986,8 +992,15 @@ class PagedKVCache:
         bytes out; page ids differ but the block table makes content
         position-addressed). Geometry and dtype are validated LOUDLY
         before any allocation; returns the slot's block-table row.
-        Callers set ``lengths[slot]`` from the payload."""
-        from .resilience import _np_dtype
+        Callers set ``lengths[slot]`` from the payload. The payload's
+        per-array checksums (stamped by :meth:`export_request`) are
+        verified BEFORE any allocation or scatter — a corrupt or torn
+        payload raises
+        :class:`~paddle_tpu.serving.CorruptionDetected` with nothing
+        committed (ISSUE 13)."""
+        from .resilience import _np_dtype, verify_checksums
+        verify_checksums(payload["arrays"], payload.get("checksums"),
+                         "handoff_import")
         n = self._check_admit(slot, total_tokens)
         k = int(payload["num_pages"])
         if payload["page_size"] != self.page_size:

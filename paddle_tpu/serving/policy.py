@@ -47,6 +47,7 @@ class FinishReason(str, enum.Enum):
     DEADLINE_EXCEEDED = "deadline_exceeded"   # cancelled before admission
     REJECTED_OVERLOAD = "rejected_overload"   # shed by a degraded supervisor
     REJECTED_RATELIMIT = "rejected_ratelimit" # over the tenant's token quota
+    REJECTED_INFEASIBLE = "rejected_infeasible" # deadline unmeetable at the door
 
     def __str__(self) -> str:  # pragma: no cover - cosmetic
         return self.value

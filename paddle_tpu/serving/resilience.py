@@ -71,6 +71,7 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -87,9 +88,18 @@ from .policy import FinishReason, Priority
 #: journal replays); "commit" fires at the top of the commit half,
 #: before the device→host fetch — the two seams the overlapped
 #: runtime (ISSUE 12) opens between launch and host-state commit
-SITES = ("alloc", "free", "decode_step", "prefill_chunk",
-         "verify_step", "transfer", "sched_tick", "swap_out", "swap_in",
-         "dispatch", "commit")
+ENGINE_SITES = ("alloc", "free", "decode_step", "prefill_chunk",
+                "verify_step", "transfer", "sched_tick", "swap_out",
+                "swap_in", "dispatch", "commit")
+
+#: cluster-plane sites (ISSUE 13): the prefill→decode handoff's two
+#: byte-moving halves and the autoscaler's control tick. They only
+#: execute inside a :class:`~paddle_tpu.serving.cluster.ServingCluster`
+#: — the single-engine chaos soak covers :data:`ENGINE_SITES`, the
+#: traffic soak (tools/chaos_soak.py --traffic) covers these
+CLUSTER_SITES = ("handoff_export", "handoff_import", "autoscale_tick")
+
+SITES = ENGINE_SITES + CLUSTER_SITES
 
 #: the pressure-ordered degraded-mode ladder (index == level): each
 #: recovery escalates one rung, sustained healthy steps climb back down
@@ -109,15 +119,20 @@ class InjectedFault(RuntimeError):
 
 
 class CorruptionDetected(InjectedFault):
-    """The corrupt-and-detect mode: models a device→host payload whose
-    checksum failed verification — the corrupted bytes are NEVER
-    committed to host state (detection precedes the commit), so the
-    supervisor recovers exactly as for a raised fault."""
+    """A byte payload failed its checksum verification BEFORE install
+    (ISSUE 13: every exported payload — handoff export/import, host-tier
+    swap, standing-store ``.npz`` — carries per-array CRCs that are
+    verified before any scatter). The corrupted bytes are NEVER
+    committed to host or device state, so the caller either quarantines
+    the entry and falls back to the gated replay path (swap/prefix
+    payloads) or keeps the request on its exporting replica (handoff).
+    Also raised by the injector's corrupt-and-detect mode, which models
+    the same detection without real bytes."""
 
-    def __init__(self, site: str):
+    def __init__(self, site: str, detail: str = ""):
         super().__init__(site, "corrupt",
-                         "checksum mismatch on fetched payload; "
-                         "data discarded before commit")
+                         detail or "checksum mismatch on fetched "
+                         "payload; data discarded before commit")
 
 
 class StepStalled(RuntimeError):
@@ -147,6 +162,46 @@ def fault_point(site: str) -> None:
     inj = _ACTIVE
     if inj is not None:
         inj.fire(site)
+
+
+def tamper_point(site: str) -> bool:
+    """Payload-corruption injection site (ISSUE 13): True when the
+    installed injector has an armed TAMPER shot due at ``site`` — the
+    caller then flips real bytes in the payload it is about to verify,
+    so the CHECKSUM path (not the injector) raises
+    :class:`CorruptionDetected`. Unlike :func:`fault_point` this never
+    raises: the whole point is that detection happens downstream, in
+    the verifier the tamper exists to exercise."""
+    inj = _ACTIVE
+    return inj is not None and inj.tamper(site)
+
+
+def run_with_deadline(fn: Callable, seconds: Optional[float]):
+    """Run ``fn()`` under a watchdog deadline (the
+    :meth:`EngineSupervisor._guarded` pattern, reusable for the
+    cluster's handoff imports — ISSUE 13): raises :class:`StepStalled`
+    past ``seconds``; ``None`` runs inline. The abandoned thread is
+    daemonic — same contract (and same caveat) as the supervisor's
+    step watchdog."""
+    if seconds is None:
+        return fn()
+    box: Dict = {}
+
+    def run():
+        try:
+            box["r"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            box["e"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="deadline-guarded-call")
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        raise StepStalled(seconds)
+    if "e" in box:
+        raise box["e"]
+    return box.get("r")
 
 
 def install(injector: Optional["FaultInjector"]) -> None:
@@ -206,6 +261,11 @@ class FaultInjector:
         self.fired_total = 0
         self.log: List[tuple] = []
         self._armed: Dict[str, List[tuple]] = {}
+        # payload-corruption shots (ISSUE 13): consumed by
+        # tamper_point(), never by fire() — a tamper must flow through
+        # the caller's checksum verifier, not raise here
+        self._tamper_armed: Dict[str, List[int]] = {}
+        self.tamper_calls: Dict[str, int] = {s: 0 for s in SITES}
         # stalls in flight, not yet attributed by a supervisor: the
         # watchdog only ever sees a StepStalled, so the supervisor asks
         # the installed injector whether the stall was its own (keeps
@@ -220,6 +280,38 @@ class FaultInjector:
             raise ValueError(f"arm: unknown site {site!r}")
         self._armed.setdefault(site, []).append(
             (self.calls[site] + int(nth), mode))
+
+    def arm_tamper(self, site: str, nth: int = 1) -> None:
+        """Schedule one PAYLOAD CORRUPTION on the ``nth`` future
+        :func:`tamper_point` visit at ``site`` (ISSUE 13): the hot path
+        then flips real bytes in the payload it is about to verify, so
+        the checksum — not the injector — detects the corruption. The
+        end-to-end detect→quarantine→replay path is what gets
+        exercised, which a raised :class:`CorruptionDetected` (the
+        ``corrupt`` mode) cannot do."""
+        if site not in SITES:
+            raise ValueError(f"arm_tamper: unknown site {site!r}")
+        self._tamper_armed.setdefault(site, []).append(
+            self.tamper_calls[site] + int(nth))
+
+    def tamper(self, site: str) -> bool:
+        """One :func:`tamper_point` visit: True when an armed tamper
+        shot is due — counted, logged and metered like any firing
+        (mode ``"tamper"``), but the caller corrupts its own payload
+        instead of this method raising."""
+        self.tamper_calls[site] = n = self.tamper_calls[site] + 1
+        armed = self._tamper_armed.get(site)
+        if not armed:
+            return False
+        for i, target in enumerate(armed):
+            if n >= target:
+                del armed[i]
+                self.fired[site] += 1
+                self.fired_total += 1
+                self.log.append((site, "tamper", n))
+                _obs.serving_fault(site, "tamper", injected=True)
+                return True
+        return False
 
     def fire(self, site: str) -> None:
         """One hot-path visit to ``site``: decide (armed schedule, then
@@ -389,6 +481,48 @@ class RequestJournal:
     def token_count(self) -> int:
         return sum(e.prompt.size + len(e.tokens)
                    for e in self._entries.values())
+
+
+def payload_checksums(arrays: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """Per-array CRC32s of a byte payload (ISSUE 13): computed at
+    export/put time by every path that materializes KV bytes (handoff
+    export, host-tier swap/demote, standing-store writes) and verified
+    by :func:`verify_checksums` before any install — a corrupt or torn
+    payload becomes a :class:`CorruptionDetected` at the door, never a
+    silently-wrong KV page."""
+    return {n: zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+            for n, a in arrays.items()}
+
+
+def verify_checksums(arrays: Dict[str, np.ndarray],
+                     checksums: Optional[Dict[str, int]],
+                     site: str) -> None:
+    """Verify ``arrays`` against :func:`payload_checksums` output;
+    raises :class:`CorruptionDetected` (tagged ``site``) on any
+    mismatch or missing array entry. A payload with no checksum dict
+    (pre-ISSUE-13 producer) passes — verification is the consumer's
+    defense, not a format break."""
+    if not checksums:
+        return
+    lost = set(checksums) - set(arrays)
+    if lost:
+        # the inverse hole: a checksummed array VANISHED from the
+        # payload (partial rewrite / truncation that dropped a whole
+        # member) — that is corruption, not a geometry mismatch
+        raise CorruptionDetected(
+            site, f"payload lost checksummed array(s) {sorted(lost)} "
+            f"— truncated payload")
+    for name, a in arrays.items():
+        want = checksums.get(name)
+        if want is None:
+            raise CorruptionDetected(
+                site, f"payload array {name!r} has no checksum — "
+                f"truncated or foreign payload")
+        got = zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+        if got != int(want):
+            raise CorruptionDetected(
+                site, f"payload array {name!r} checksum mismatch "
+                f"(expected {int(want)}, got {got})")
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -969,7 +1103,8 @@ class EngineSupervisor:
         signal the cluster router dispatches by."""
         s = (self.scheduler.load_stats()
              if self.scheduler is not None else {
-                 "queue_depths": {}, "queued_total": 0, "running": 0,
+                 "queue_depths": {}, "queued_total": 0,
+                 "queued_tokens": 0, "inflight_tokens": 0, "running": 0,
                  "pending_prefills": 0, "free_slots": 0,
                  "oldest_deadline_slack_s": None, "pool_occupancy": 1.0,
                  "pool_free_pages": 0,

@@ -59,6 +59,50 @@ class TenantQuota:
         self.window_s = float(window_s)
 
 
+class AdmissionController:
+    """SLO-guarded admission at the cluster door (ISSUE 13): a
+    deadline-bearing submission whose deadline is INFEASIBLE against
+    the cluster's current backlog rejects immediately with the
+    structured ``rejected_infeasible`` finish reason — shed BEFORE any
+    replica queues, prefills, or degrades for a request that could
+    never meet its SLO (the door is cheaper than the PR 8 degraded
+    ladder, which only sheds after replicas are already hurting).
+
+    The feasibility model is deliberately simple and injectable:
+    estimated TTFT = (least-loaded replica's backlog tokens + the
+    request's own prompt) / ``tokens_per_s``, scaled by ``safety``.
+    ``tokens_per_s`` is the operator's service-rate estimate (the
+    bench's decode tokens/s is the natural source); an estimate of 0
+    or None disables the backlog term and only rejects
+    already-lapsed deadlines."""
+
+    def __init__(self, tokens_per_s: Optional[float] = None, *,
+                 safety: float = 1.0, min_slack_s: float = 0.0):
+        self.tokens_per_s = (float(tokens_per_s)
+                             if tokens_per_s else None)
+        self.safety = float(safety)
+        self.min_slack_s = float(min_slack_s)
+
+    def feasible(self, deadline_s: Optional[float],
+                 prompt_tokens: int, loads) -> bool:
+        """``loads``: the serviceable replicas' ``load_stats``
+        snapshots. Deadline-less requests always pass; so does an
+        empty cluster view (the dispatch path owns that failure)."""
+        if deadline_s is None:
+            return True
+        if deadline_s <= 0:
+            return False
+        loads = list(loads)
+        if not loads or self.tokens_per_s is None:
+            return True
+        backlog = min(
+            s.get("queued_tokens", 0) + s.get("inflight_tokens", 0)
+            for s in loads)
+        est_ttft = (self.safety * (backlog + int(prompt_tokens))
+                    / self.tokens_per_s)
+        return deadline_s >= est_ttft + self.min_slack_s
+
+
 class ClusterRouter:
     """Placement + accounting policy for a :class:`ServingCluster`.
 
@@ -69,19 +113,37 @@ class ClusterRouter:
     tenant name -> :class:`TenantQuota`; absent tenants are unlimited.
     ``clock`` is injectable (monotonic seconds) so windows are
     testable.
-    """
+
+    ``retry_budget`` / ``tenant_retry_cap`` (ISSUE 13 satellite): a
+    request a degraded replica sheds re-dispatches up to
+    ``retry_budget`` times (was: exactly once), but a tenant's total
+    retries may never exceed ``tenant_retry_cap`` x its dispatches —
+    one degraded replica must not turn a single tenant's burst into a
+    cluster-wide retry storm. Exhaustion (budget or cap ran out before
+    a replica accepted) is counted separately from first-try
+    rejection (``retry_exhausted_total``)."""
 
     def __init__(self, page_size: int, *, affinity_pages: int = 2,
                  max_bindings: int = 65536,
                  quotas: Optional[Dict[str, TenantQuota]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 retry_budget: int = 2,
+                 tenant_retry_cap: float = 0.5):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if retry_budget < 0:
+            raise ValueError(
+                f"retry_budget={retry_budget} must be >= 0")
+        if tenant_retry_cap <= 0:
+            raise ValueError(
+                f"tenant_retry_cap={tenant_retry_cap} must be > 0")
         self.page_size = page_size
         self.affinity_pages = max(1, int(affinity_pages))
         self.max_bindings = max(1, int(max_bindings))
         self.quotas = dict(quotas or {})
         self.clock = clock
+        self.retry_budget = int(retry_budget)
+        self.tenant_retry_cap = float(tenant_retry_cap)
         # LRU-bounded (dict insertion order = recency; hits re-insert):
         # mostly-unique prompts would otherwise bind one entry per
         # request forever — the same leak class _prune_finished and
@@ -92,11 +154,15 @@ class ClusterRouter:
         #: tenant -> tokens dispatched (the fair-share deficit counter)
         self.accounts: Dict[str, int] = {}
         self.dispatch_by_replica: Dict[int, int] = {}
+        self.dispatch_by_tenant: Dict[str, int] = {}
+        self.retries_by_tenant: Dict[str, int] = {}
         self.dispatches_total = 0
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.retries_total = 0
+        self.retry_exhausted_total = 0
         self.ratelimited_total = 0
+        self.slo_rejected_total = 0
 
     # ---- prefix affinity ----
     def affinity_key(self, prompt) -> Optional[bytes]:
@@ -184,20 +250,48 @@ class ClusterRouter:
         tenants, not within one)."""
         self.accounts[tenant] = self.accounts.get(tenant, 0) + int(cost)
 
+    # ---- shed-work retry accounting (ISSUE 13 satellite) ----
+    def may_retry(self, tenant: str, attempts: int) -> bool:
+        """True when a shed dispatch may re-dispatch: the request has
+        per-request budget left AND the tenant's aggregate retry rate
+        (retries / dispatches) stays under the cap — the bound that
+        stops one degraded replica amplifying one tenant's traffic
+        into a retry storm."""
+        if attempts >= self.retry_budget:
+            return False
+        d = max(1, self.dispatch_by_tenant.get(tenant, 0))
+        r = self.retries_by_tenant.get(tenant, 0)
+        return r < max(1.0, self.tenant_retry_cap * d)
+
     # ---- telemetry (the serving_router_* hook family) ----
-    def note_dispatch(self, replica: int, affinity_hit: bool):
+    def note_dispatch(self, replica: int, affinity_hit: bool,
+                      tenant: Optional[str] = None):
         self.dispatches_total += 1
         self.dispatch_by_replica[replica] = \
             self.dispatch_by_replica.get(replica, 0) + 1
+        if tenant is not None:
+            self.dispatch_by_tenant[tenant] = \
+                self.dispatch_by_tenant.get(tenant, 0) + 1
         _obs.serving_router_dispatch(replica, affinity_hit)
 
-    def note_retry(self):
+    def note_retry(self, tenant: Optional[str] = None):
         self.retries_total += 1
+        if tenant is not None:
+            self.retries_by_tenant[tenant] = \
+                self.retries_by_tenant.get(tenant, 0) + 1
         _obs.serving_router_retry(1)
+
+    def note_retry_exhausted(self):
+        self.retry_exhausted_total += 1
+        _obs.serving_router_retry_exhausted()
 
     def note_ratelimited(self, tenant: str):
         self.ratelimited_total += 1
         _obs.serving_router_ratelimited(tenant)
+
+    def note_slo_rejected(self, tenant: str):
+        self.slo_rejected_total += 1
+        _obs.serving_slo_rejected(tenant)
 
     def stats(self) -> Dict:
         total = self.affinity_hits + self.affinity_misses
@@ -210,6 +304,8 @@ class ClusterRouter:
                                   if total else 0.0),
             "affinity_bindings": len(self._affinity),
             "retries_total": self.retries_total,
+            "retry_exhausted_total": self.retry_exhausted_total,
             "ratelimited_total": self.ratelimited_total,
+            "slo_rejected_total": self.slo_rejected_total,
             "tenant_accounts": dict(self.accounts),
         }

@@ -552,15 +552,29 @@ class ServingScheduler:
         alloc = eng.cache.allocator
         depths = {int(p): len(q) for p, q in self._queues.items() if q}
         slack = None
+        # backlog in TOKENS (ISSUE 13): what the queued requests will
+        # actually cost to serve — the autoscaler's scale signal and
+        # the admission controller's TTFT-feasibility denominator
+        # (request counts hide the long-prompt/short-prompt mix)
+        queued_tokens = 0
         for q in self._queues.values():
             for r in q:
+                if not r.done:
+                    queued_tokens += (r.prompt.shape[1]
+                                      + r.max_new_tokens
+                                      - len(r.tokens))
                 if r.deadline_at is not None and not r.done:
                     s = r.deadline_at - now
                     slack = s if slack is None else min(slack, s)
+        inflight_tokens = int(sum(
+            r.max_new_tokens - len(r.tokens)
+            for r in eng.running_requests() if not r.done))
         level = self.degraded_level
         s = {
             "queue_depths": depths,
             "queued_total": sum(depths.values()),
+            "queued_tokens": int(queued_tokens),
+            "inflight_tokens": inflight_tokens,
             "running": len(eng.running_requests()),
             "pending_prefills": len(eng.pending_prefills()),
             "free_slots": len(eng.cache.free_slots()),

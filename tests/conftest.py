@@ -71,15 +71,23 @@ def pytest_collection_modifyitems(config, items):
     rest = [it for it in items if _pre_cache(it) is None]
     if not rest:
         return
-    # newest gate file LAST (ISSUE 12): the suite has brushed its
-    # tier-1 watchdog since PR 8, so a slow-box run that gets
-    # truncated should lose the NEWEST gates first and keep the
-    # long-established prefix comparable run-to-run — the overlap
-    # gates still run (and pass) whenever the box keeps pace
-    tail = [it for it in rest
-            if "test_overlap" in str(getattr(it, "fspath", it.nodeid))]
+    # newest gate files LAST (ISSUE 12, extended by ISSUE 13): the
+    # suite has brushed its tier-1 watchdog since PR 8, so a slow-box
+    # run that gets truncated should lose the NEWEST gates first and
+    # keep the long-established prefix comparable run-to-run — the
+    # overlap/traffic gates still run (and pass) whenever the box
+    # keeps pace. Order within the tail: older first, newest dead last.
+    def _tail_rank(it):
+        path = str(getattr(it, "fspath", it.nodeid))
+        if "test_overlap" in path:
+            return 0
+        if "test_traffic" in path:
+            return 1
+        return None
+    tail = sorted((it for it in rest if _tail_rank(it) is not None),
+                  key=_tail_rank)
     if tail and tail != rest:
-        rest = [it for it in rest if it not in tail] + tail
+        rest = [it for it in rest if _tail_rank(it) is None] + tail
     items[:] = pre + rest
     config._compcache_boundary = rest[0].nodeid
 

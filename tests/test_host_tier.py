@@ -462,6 +462,81 @@ class TestPrefixTier:
         pages = trie_pages()
         assert len(pages) == len(set(pages))
 
+    def _corrupted_store_roundtrip(self, damage, seed0):
+        """Shared scaffold (ISSUE 13 satellite): write a standing
+        store, DAMAGE one chain file on disk, then restart the engine
+        against the same directory — the admission must fall back to a
+        prefix MISS + replay (no crash, no corrupt KV served), with
+        the quarantine counters emitted and the bad file removed so it
+        can never be re-read."""
+        from paddle_tpu import observability as obs
+        d = tempfile.mkdtemp()
+        sys_prompt = _prompt(16, seed=seed0)
+        p1 = np.concatenate([sys_prompt, _prompt(4, seed=seed0 + 1)])
+        p2 = np.concatenate([sys_prompt, _prompt(4, seed=seed0 + 2)])
+        ref = _engine(host=False).generate([p2], max_new_tokens=4)[0]
+        host_kw = {"prefix_store_dir": d}
+        _engine(host_tier_kw=host_kw).generate([p1], max_new_tokens=4)
+        files = sorted(os.listdir(d))
+        assert len(files) == 2
+        damage(os.path.join(d, files[0]))
+        was = obs.metrics_enabled()
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            eng2 = _engine(host_tier_kw=host_kw)
+            o2 = eng2.generate([p2], max_new_tokens=4)[0]
+            snap = obs.REGISTRY.to_json()
+        finally:
+            obs.REGISTRY.clear()
+            if not was:
+                obs.disable()
+        np.testing.assert_array_equal(o2, ref)
+        assert eng2.cache.host.quarantined_total >= 1
+        # the damaged chain never promoted (its pages replayed); only
+        # the intact sibling may have
+        assert eng2.cache.promote_hits_total < 2
+        # the quarantined file was removed, then the replayed chain's
+        # write-through re-created it with FRESH bytes — a brand-new
+        # store must read every surviving file cleanly
+        from paddle_tpu.serving import HostPageStore
+        probe = HostPageStore(8, path=d)
+        for f in list(os.listdir(d)):
+            with np.load(os.path.join(d, f)) as data:
+                raw_key = bytes(np.asarray(data["key"]))
+            assert probe.get(raw_key) is not None, \
+                f"store file {f} unreadable after recovery"
+        assert probe.quarantined_total == 0
+        q = sum(v for k, v in snap.get(
+            "serving_integrity_events_total", {})
+            .get("values", {}).items()
+            if "quarantined" in k)
+        assert q >= 1
+
+    def test_torn_standing_store_file_replays(self):
+        """SATELLITE: a TRUNCATED (torn-write) standing-store ``.npz``
+        is a quarantined miss on restart, never a crash or corrupt
+        KV."""
+        def truncate(fn):
+            n = os.path.getsize(fn)
+            with open(fn, "rb") as f:
+                half = f.read(n // 2)
+            with open(fn, "wb") as f:
+                f.write(half)
+        self._corrupted_store_roundtrip(truncate, seed0=40)
+
+    def test_bitflipped_standing_store_file_replays(self):
+        """SATELLITE: a BIT-FLIPPED standing-store ``.npz`` (payload
+        damage a torn-write check can't see) is detected before any
+        scatter — quarantined miss + replay, token-identically."""
+        def bitflip(fn):
+            with open(fn, "rb") as f:
+                raw = bytearray(f.read())
+            raw[len(raw) // 2] ^= 0xFF
+            with open(fn, "wb") as f:
+                f.write(bytes(raw))
+        self._corrupted_store_roundtrip(bitflip, seed0=44)
+
     def test_stale_store_geometry_reads_as_miss(self):
         """A standing store written by a DIFFERENT kv tier must not
         corrupt the pool: promotion drops the bad chain and the
@@ -513,9 +588,12 @@ class TestResilience:
         assert sup.engine.cache.swap_replay_fallbacks == 0
         np.testing.assert_array_equal(a.output, ref)
 
-    def test_faults_at_swap_sites_recover_token_identically(self):
-        """An injected fault AT swap_in commits nothing: the payload
-        survives for the retried resume after recovery."""
+    def test_fault_at_swap_in_absorbed_by_bounded_retry(self):
+        """ISSUE 13: a transient fault AT swap_in retries in place
+        (bounded exponential backoff, idempotent — the failed attempt
+        committed nothing) instead of costing a full engine recovery;
+        the payload survives and the retried scatter installs it
+        bit-identically."""
         ref = _engine(host=False).generate(
             [_prompt(6, seed=2)], max_new_tokens=8)[0]
 
@@ -535,7 +613,42 @@ class TestResilience:
             inj.arm("swap_in", "raise", nth=1)
             sup.run()
         assert inj.fired["swap_in"] == 1
+        assert sup.recoveries == 0           # absorbed, no teardown
+        assert sup.engine.cache.swap_in_retries_total == 1
+        assert sup.engine.cache.swap_ins_total == 1
+        np.testing.assert_array_equal(a.output, ref)
+
+    def test_swap_in_retry_exhaustion_recovers_token_identically(self):
+        """Past the retry budget the fault escalates to the supervisor
+        (the pre-ISSUE-13 path): the payload still committed nothing,
+        survives the teardown, and the recovered resume swaps it in —
+        bit-identical either way."""
+        ref = _engine(host=False).generate(
+            [_prompt(6, seed=2)], max_new_tokens=8)[0]
+
+        def factory():
+            return _engine()
+        inj = FaultInjector(seed=0)
+        sup = EngineSupervisor(factory, backoff_s=0.0,
+                               sleep=lambda s: None)
+        with inj:
+            a = sup.submit(_prompt(6, seed=2), max_new_tokens=8,
+                           priority=Priority.LOW)
+            while len(a.tokens) < 3:
+                sup.step()
+            sup.submit(_prompt(4, seed=3), max_new_tokens=2,
+                       priority=Priority.HIGH)
+            sup.step()                       # swap-out succeeds
+            # one more fault than the budget (default 2 retries = 3
+            # attempts): every in-place attempt fails, the supervisor
+            # pays one recovery, and the post-recovery admission swaps
+            # the surviving payload in
+            for _ in range(3):
+                inj.arm("swap_in", "raise", nth=1)
+            sup.run()
+        assert inj.fired["swap_in"] == 3
         assert sup.recoveries == 1
+        assert sup.engine.cache.swap_in_retries_total == 2
         assert sup.engine.cache.swap_ins_total == 1
         np.testing.assert_array_equal(a.output, ref)
 

@@ -284,7 +284,10 @@ class TestLowbitScenarios:
         sched.run()
         np.testing.assert_array_equal(np.asarray(a.output), ref[0])
 
-    @pytest.mark.parametrize("tier", ["int4", "w8kv8"])
+    # int4 stays the tier-1 representative; the w8kv8 sweep is a
+    # slow variant (ISSUE 13 watchdog-headroom satellite)
+    @pytest.mark.parametrize("tier", [
+        "int4", pytest.param("w8kv8", marks=pytest.mark.slow)])
     def test_spec_verify_parity(self, tier):
         """Speculative decoding (n-gram draft + fused verify forward)
         commits exactly the plain-decode tokens at the low-bit

@@ -32,7 +32,8 @@ from paddle_tpu.serving import (BlockAllocator, CorruptionDetected,
                                 EngineDead, EngineSupervisor,
                                 FaultInjector, InjectedFault,
                                 PrefixCache, Priority)
-from paddle_tpu.serving.resilience import DEGRADED_MODES, SITES
+from paddle_tpu.serving.resilience import (DEGRADED_MODES,
+                                           ENGINE_SITES, SITES)
 
 _CFG = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
 _PARAMS = llama.init_params(jax.random.key(0), _CFG)
@@ -203,6 +204,13 @@ class TestRecoveryParity:
                 "OVERLAPPED pipeline (a step genuinely in flight when "
                 "the fault strikes — the case these sites exist for); "
                 "the chaos soak fires them in both modes")
+        if site in ("handoff_export", "handoff_import",
+                    "autoscale_tick"):
+            pytest.skip(
+                "cluster-plane sites (ISSUE 13) only execute inside a "
+                "ServingCluster — gated in tests/test_traffic.py and "
+                "fired by the traffic soak "
+                "(tools/chaos_soak.py --traffic)")
         refs = _refs(kv)
         # the verify site only exists on the speculative path; every
         # other site uses the plain engine (where decode_step always
@@ -447,7 +455,9 @@ class TestChaosSoak:
         report = _SOAK.run_soak(seed=0, faults=50, requests=12,
                                stall_faults=1)
         assert report["faults_fired"] >= 50
-        assert set(report["faults_by_site"]) == set(SITES)
+        # the single-engine soak covers the per-engine sites; the
+        # cluster-plane sites (ISSUE 13) are the traffic soak's job
+        assert set(report["faults_by_site"]) == set(ENGINE_SITES)
         assert report["recoveries"] >= 1
         assert report["allocator"]["num_used"] == 0
         assert (report["allocator"]["allocs_total"]
@@ -455,7 +465,11 @@ class TestChaosSoak:
 
 
 class TestDrainRestore:
-    @pytest.mark.parametrize("kv", [None, "int8"])
+    # int8 is the slowest single parity sweep in the file (ISSUE 13
+    # watchdog-headroom satellite): the fp case stays the tier-1
+    # representative, the int8 variant runs outside `-m 'not slow'`
+    @pytest.mark.parametrize("kv", [
+        None, pytest.param("int8", marks=pytest.mark.slow)])
     def test_roundtrip_prefix_hits_and_parity(self, kv, tmp_path):
         """ACCEPTANCE: drain with a warm prefix trie + an in-flight
         session; restore into a fresh engine; the session finishes

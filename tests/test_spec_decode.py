@@ -168,7 +168,10 @@ class TestSpecParity:
     """ACCEPTANCE: speculative greedy decode == plain paged decode,
     token for token, at fp and int8-KV."""
 
-    @pytest.mark.parametrize("kv", [None, "int8"])
+    # fp stays the tier-1 representative; the int8 sweep is a slow
+    # variant (ISSUE 13 watchdog-headroom satellite)
+    @pytest.mark.parametrize("kv", [
+        None, pytest.param("int8", marks=pytest.mark.slow)])
     def test_ngram_spec_matches_plain(self, kv):
         cfg, params = _setup()
         prompts = (_repetitive_prompts(cfg, [13, 9], seed=2)

@@ -107,7 +107,10 @@ class TestTpDecodeParity:
 
 
 class TestTpPrefillParity:
-    @pytest.mark.parametrize("kv", [None, "int8"])
+    # fp stays the tier-1 representative; the int8 sweep is a slow
+    # variant (ISSUE 13 watchdog-headroom satellite)
+    @pytest.mark.parametrize("kv", [
+        None, pytest.param("int8", marks=pytest.mark.slow)])
     def test_chunked_prefill(self, kv):
         """An 18-token prompt through 8-token chunks: the continuation
         program (gathered right-aligned context) runs per shard on its

@@ -114,6 +114,15 @@ REQUIRED = {
         # see it)
         ('_obs.serving_fused_latency("pool_move"', 1),
     ],
+    "paddle_tpu/serving/traffic.py": [
+        # trace-driven traffic harness (ISSUE 13): per-request TTFT +
+        # deadline outcome, goodput/badput token split, and the
+        # end-of-run summary gauges — the serving_slo_* family the
+        # decode_slo_goodput bench tier records
+        ("_obs.serving_slo_ttft(", 1),
+        ("_obs.serving_slo_tokens(", 1),
+        ("_obs.serving_slo_report(", 1),
+    ],
     "paddle_tpu/serving/host_tier.py": [
         # hierarchical KV tier (ISSUE 10): both halves of the
         # swap pair (bytes/pages + transfer latency — the
@@ -131,6 +140,13 @@ REQUIRED = {
         # BEFORE the allocation — both commit nothing when they fire
         ('fault_point("swap_out")', 1),
         ('fault_point("swap_in")', 1),
+        # payload integrity (ISSUE 13): detection/quarantine/replay
+        # events on the swap and promote paths + the bounded-retry
+        # counter — the serving_integrity_* family the integrity gate
+        # audits (detected == quarantined + replayed arithmetic)
+        ("_obs.serving_integrity(", 4),
+        ("_obs.serving_integrity_retry(", 1),
+        ('tamper_point("swap_in")', 1),
     ],
     "paddle_tpu/serving/cluster.py": [
         # disaggregated cluster (ISSUE 9): both halves of the
@@ -143,6 +159,18 @@ REQUIRED = {
         ("_obs.serving_handoff_import(", 1),
         ("_obs.serving_router_failover(", 1),
         ("_obs.serving_router_replica(", 1),
+        # overload hardening (ISSUE 13): the autoscaler's event
+        # counter + gauges on BOTH scale directions, the handoff
+        # integrity events (a corrupt payload detected before install)
+        # and the bounded-retry counter, plus the three cluster-plane
+        # fault sites (export/import halves of the handoff and the
+        # autoscale control tick — also enforced by check_fault_sites)
+        ("_obs.serving_autoscale(", 2),
+        ("_obs.serving_integrity(", 2),
+        ("_obs.serving_integrity_retry(", 1),
+        ('fault_point("handoff_export")', 1),
+        ('fault_point("handoff_import")', 1),
+        ('fault_point("autoscale_tick")', 1),
     ],
     "paddle_tpu/serving/router.py": [
         # cluster router (ISSUE 9): per-dispatch replica + affinity
@@ -151,6 +179,12 @@ REQUIRED = {
         ("_obs.serving_router_dispatch(", 1),
         ("_obs.serving_router_retry(", 1),
         ("_obs.serving_router_ratelimited(", 1),
+        # ISSUE 13: the SLO-guarded admission rejection counter
+        # (deadline-infeasible at the door) and the retry-budget
+        # exhaustion counter (counted separately from first-try
+        # rejection — the satellite's whole point)
+        ("_obs.serving_slo_rejected(", 1),
+        ("_obs.serving_router_retry_exhausted(", 1),
     ],
     "paddle_tpu/models/generate.py": [
         ("_obs.generate_begin()", 1),
@@ -197,6 +231,7 @@ _FAULT_SITE_MODULES = (
     "paddle_tpu/serving/paged_cache.py",
     "paddle_tpu/serving/scheduler.py",
     "paddle_tpu/serving/host_tier.py",
+    "paddle_tpu/serving/cluster.py",
     "paddle_tpu/inference/predictor.py",
 )
 
@@ -216,12 +251,17 @@ def check_fault_sites(root: str) -> list:
         return [f"paddle_tpu/serving/resilience.py: file missing"]
     with open(res_path, encoding="utf-8") as f:
         src = f.read()
-    m = re.search(r"^SITES\s*=\s*\(([^)]*)\)", src, re.M)
-    if not m:
-        return ["paddle_tpu/serving/resilience.py: SITES tuple missing"]
-    sites = re.findall(r"\"([a-z_]+)\"", m.group(1))
+    # SITES is composed from the engine-plane and cluster-plane
+    # tuples (ISSUE 13) — collect the declared names from both
+    sites = []
+    for name in ("ENGINE_SITES", "CLUSTER_SITES"):
+        m = re.search(rf"^{name}\s*=\s*\(([^)]*)\)", src, re.M)
+        if not m:
+            return [f"paddle_tpu/serving/resilience.py: {name} "
+                    f"tuple missing"]
+        sites += re.findall(r"\"([a-z_]+)\"", m.group(1))
     if not sites:
-        return ["paddle_tpu/serving/resilience.py: SITES tuple empty"]
+        return ["paddle_tpu/serving/resilience.py: SITES tuples empty"]
     hot = ""
     for rel in _FAULT_SITE_MODULES:
         path = os.path.join(root, rel)

@@ -188,6 +188,17 @@ def main():
         out["decode_offload_resume"] = resume
         return tps
     run_tier("decode_offload_tokens_per_sec", _offload)
+
+    # goodput-under-SLO (ISSUE 13): the trace-driven traffic harness
+    # against the autoscaling cluster — deadline-met fraction, p99
+    # TTFT and the autoscale event counts ride the record next to the
+    # goodput they explain, same contract as the other riders
+    def _slo():
+        tps, metrics = bench_mod.slo_goodput_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        out["decode_slo_metrics"] = metrics
+        return tps
+    run_tier("decode_slo_goodput_tokens_per_sec", _slo)
     int8_p = {}
 
     def _int8():
@@ -212,6 +223,7 @@ def main():
         "decode_spec_tokens_per_sec", "decode_tp_tokens_per_sec",
         "decode_cluster_tokens_per_sec",
         "decode_offload_tokens_per_sec",
+        "decode_slo_goodput_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")

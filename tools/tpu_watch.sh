@@ -84,6 +84,7 @@ if dec.get("decode_tokens_per_sec") is not None:
               "decode_tp_tokens_per_sec",
               "decode_cluster_tokens_per_sec",
               "decode_offload_tokens_per_sec",
+              "decode_slo_goodput_tokens_per_sec",
               "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
               "decode_w8kv8_tokens_per_sec"):
         if dec.get(k) is None:
@@ -116,7 +117,8 @@ if dec.get("decode_tokens_per_sec") is not None:
     # tier's fused-kernel speedup (ISSUE 11)
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
                   "decode_tp_scaling", "decode_cluster_scaling",
-                  "decode_offload_resume", "decode_fused_speedup",
+                  "decode_offload_resume", "decode_slo_metrics",
+                  "decode_fused_speedup",
                   "decode_overlap_speedup"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
