@@ -159,7 +159,7 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_prefix_tps=None, decode_sched=None,
             decode_spec=None, decode_tp=None, decode_cluster=None,
             decode_offload=None, decode_slo=None, decode_fused=None,
-            phases=None):
+            decode_multilora=None, phases=None):
     import jax
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -187,7 +187,10 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                   "decode_offload_tokens_per_sec": (
                       decode_offload[0] if decode_offload else None),
                   "decode_slo_goodput_tokens_per_sec": (
-                      decode_slo[0] if decode_slo else None)},
+                      decode_slo[0] if decode_slo else None),
+                  "decode_multilora_tokens_per_sec": (
+                      decode_multilora[0] if decode_multilora
+                      else None)},
     }
     if decode_sched:
         # the tier's point is the BOUND, not just the throughput:
@@ -225,6 +228,12 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # wall ms unfused vs fused + the throughput ratio — the direct
         # measurement of the Pallas fusions' HBM win
         rec["extra"]["decode_fused_speedup"] = decode_fused
+    if decode_multilora:
+        # the multi-LoRA tier's throughput only means something next
+        # to the adapter traffic the pool absorbed (ISSUE 14): variant
+        # population, slot hits, demote/promote churn and the ratio vs
+        # the one-variant merged-model deployment it replaces
+        rec["extra"]["decode_multilora_density"] = decode_multilora[1]
     if phases is not None:
         rec["phases"] = phases
     return _backfill_decode(rec)
@@ -302,15 +311,20 @@ def _engine_tier(params, cfg, db, dnew, max_len, on_tpu, make_prompts,
     the host scheduling loop (an ENGINE number, not a kernel
     microbench). Keeping ONE scaffold guarantees the tiers whose delta
     is reported stay comparable by construction. Returns ``(tokens/sec,
-    engine)`` — the engine so tiers can read post-run stats."""
+    engine)`` — the engine so tiers can read post-run stats.
+    ``per_request_kw(i)`` — if given — returns extra ``submit`` kwargs
+    for the i-th request of each pass (the multi-LoRA tier's per-row
+    ``adapter_id``)."""
     from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    per_request_kw = engine_kwargs.pop("per_request_kw", None)
     eng = ContinuousBatchingEngine(
         params, cfg, max_batch=db, page_size=16 if on_tpu else 8,
         max_len=max_len, **engine_kwargs)
 
     def one_pass():
         reqs = [eng.submit(p, max_new_tokens=(
-            dnew if i % 2 else max(dnew // 2, 1)))
+            dnew if i % 2 else max(dnew // 2, 1)),
+            **(per_request_kw(i) if per_request_kw else {}))
                 for i, p in enumerate(make_prompts())]
         eng.run()
         return sum(r.max_new_tokens for r in reqs)
@@ -594,10 +608,114 @@ def spec_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                             enable_prefix_cache=False, spec_k=4)
     drafted = eng.spec.drafted_total - warm["d"]
     accepted = eng.spec.accepted_total - warm["a"]
-    return tps, {
+    rider = {
         "acceptance_rate": round(accepted / drafted, 3) if drafted
         else 0.0,
         "drafted": drafted, "accepted": accepted,
+    }
+    # sampled-spec rider (ISSUE 14): the SAME workload at
+    # temperature>0 through the rejection-sampled verify commit — the
+    # acceptance rate under min(1, p/q) is the realized 1+k·rate
+    # multiplier for sampled traffic, the restriction this PR lifts.
+    # Best-effort: a failure leaves the greedy tier standing.
+    try:
+        warm_s = {}
+
+        def snap_s(e):
+            warm_s.update(d=e.spec.drafted_total,
+                          a=e.spec.accepted_total)
+
+        tps_s, eng_s = _engine_tier(
+            params, cfg, db, dnew, dp_len + dnew, on_tpu,
+            make_prompts, between_passes=snap_s,
+            kv_cache_dtype=kv_cache_dtype, enable_prefix_cache=False,
+            spec_k=4, temperature=0.7)
+        d_s = eng_s.spec.drafted_total - warm_s["d"]
+        a_s = eng_s.spec.accepted_total - warm_s["a"]
+        rider["sampled"] = {
+            "temperature": 0.7,
+            "tokens_per_sec": tps_s,
+            "acceptance_rate": round(a_s / d_s, 3) if d_s else 0.0,
+            "drafted": d_s, "accepted": a_s,
+        }
+    except Exception as e:
+        print(f"sampled-spec rider failed: {type(e).__name__}: "
+              f"{e}"[:300], file=sys.stderr)
+    return tps, rider
+
+
+def multilora_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                          kv_cache_dtype=None, adapters=6, slots=None,
+                          rank=8):
+    """The decode_multilora_tokens_per_sec measurement (ISSUE 14),
+    shared by measure() and tools/decode_bench.py so the two sources
+    stay comparable.
+
+    MANY-TENANT mixed-adapter workload: ``adapters`` LoRA variants
+    (rank ``rank``) over a pool of FEWER slots (``slots``, default
+    ``adapters - 2``) so the steady state churns — slot hits for hot
+    adapters, LRU demotions to the host store and promotions back for
+    the tail. Requests cycle through the variant population (plus the
+    id-0 base rows every engine serves for free), same mixed-length /
+    oversubscription scaffold as the paged tier. The headline is the
+    multi-tenant engine's throughput; the baseline it is judged
+    against is the SINGLE-MERGED-MODEL engine (one adapter dense-
+    merged into the weights, plain engine — the status-quo deployment
+    that can only serve ONE variant), whose ratio rides as
+    ``vs_single_merged``. Returns ``(tokens_per_sec,
+    {"distinct_adapters", "slot_hits", "promote_count", ...})`` — the
+    ``decode_multilora_density`` rider: throughput only means
+    something next to how much adapter traffic the pool absorbed."""
+    import numpy as np
+    from paddle_tpu.serving.adapters import (AdapterRegistry, init_lora,
+                                             merge_lora)
+    from paddle_tpu.serving import HostPageStore
+    slots = slots if slots is not None else max(adapters - 2, 1)
+    registry = AdapterRegistry(cfg)
+    for aid in range(1, adapters + 1):
+        registry.register(aid, init_lora(cfg, rank, seed=300 + aid))
+    plens = [dp_len if i % 2 else max(dp_len // 2, 1)
+             for i in range(2 * db)]
+    rngp = np.random.default_rng(17)
+    prompts = [rngp.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in plens]
+
+    # single-merged-model baseline: adapter 1 dense-merged, plain
+    # engine — measured FIRST so a multilora failure can't orphan it
+    merged = merge_lora(params, cfg, registry.get(1))
+    base_tps, _ = _engine_tier(merged, cfg, db, dnew, dp_len + dnew,
+                               on_tpu, lambda: prompts,
+                               kv_cache_dtype=kv_cache_dtype,
+                               enable_prefix_cache=False)
+
+    pool_kw = dict(slots=slots, rank=rank, registry=registry,
+                   store=HostPageStore(page_size=16 if on_tpu else 8))
+    warm = {}
+
+    def snapshot(eng):
+        st = eng.adapters.stats()
+        warm.update(h=st["adapter_slot_hits_total"],
+                    p=st["adapter_promotions_total"],
+                    d=st["adapter_demotions_total"])
+
+    tps, eng = _engine_tier(
+        params, cfg, db, dnew, dp_len + dnew, on_tpu,
+        lambda: prompts, between_passes=snapshot,
+        kv_cache_dtype=kv_cache_dtype, enable_prefix_cache=False,
+        adapters=pool_kw,
+        # request i serves variant (i mod (adapters+1)): id 0 = base
+        per_request_kw=lambda i: {"adapter_id": i % (adapters + 1)})
+    st = eng.adapters.stats()
+    return tps, {
+        "distinct_adapters": adapters,
+        "pool_slots": slots,
+        "rank": rank,
+        "slot_hits": st["adapter_slot_hits_total"] - warm["h"],
+        "promote_count": st["adapter_promotions_total"] - warm["p"],
+        "demote_count": st["adapter_demotions_total"] - warm["d"],
+        "vs_single_merged": (round(tps / base_tps, 3) if base_tps
+                             else None),
+        "single_merged_tokens_per_sec": base_tps,
     }
 
 
@@ -927,7 +1045,8 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_tp_tokens_per_sec",
                  "decode_cluster_tokens_per_sec",
                  "decode_offload_tokens_per_sec",
-                 "decode_slo_goodput_tokens_per_sec")
+                 "decode_slo_goodput_tokens_per_sec",
+                 "decode_multilora_tokens_per_sec")
 
 # rider dicts that travel with their tier when it carries from an older
 # record: the scheduler tier's p50/p99 step-latency bound (ISSUE 4),
@@ -947,6 +1066,8 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                    "decode_offload_resume"),
                   ("decode_slo_goodput_tokens_per_sec",
                    "decode_slo_metrics"),
+                  ("decode_multilora_tokens_per_sec",
+                   "decode_multilora_density"),
                   ("decode_paged_tokens_per_sec",
                    "decode_fused_speedup"))
 
@@ -1300,6 +1421,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"slo goodput bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # multi-tenant adapter plane (ISSUE 14): many LoRA variants through
+    # one engine's slot pool vs the single-merged-model deployment —
+    # throughput + the adapter-density rider travel together
+    decode_multilora = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_multilora = multilora_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+        except Exception as e:
+            print(f"multilora decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     phases = None
     if not on_tpu or remaining() > 75:
         phases = _capture_phases(step, state, tokens, cfg)
@@ -1310,7 +1443,8 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_sched=decode_sched, decode_spec=decode_spec,
                    decode_tp=decode_tp, decode_cluster=decode_cluster,
                    decode_offload=decode_offload, decode_slo=decode_slo,
-                   decode_fused=decode_fused, phases=phases)
+                   decode_fused=decode_fused,
+                   decode_multilora=decode_multilora, phases=phases)
 
 
 _BATCH_HINT = "/tmp/paddle_tpu_bench_batch_hint"

@@ -351,10 +351,10 @@ class InFlightStep:
     victim re-decodes the dropped token on resume, greedy-identically,
     so no stream ever forks)."""
     __slots__ = ("kind", "mask", "rids", "seats", "out", "drafts",
-                 "dlen", "t0", "t0f")
+                 "dlen", "t0", "t0f", "raw")
 
     def __init__(self, kind, mask, rids, seats, out, drafts=None,
-                 dlen=None, t0=0, t0f=0):
+                 dlen=None, t0=0, t0f=0, raw=None):
         self.kind = kind                # "decode" | "spec"
         self.mask = mask
         self.rids = rids                # per-slot rid snapshot at dispatch
@@ -364,6 +364,9 @@ class InFlightStep:
         self.dlen = dlen
         self.t0 = t0
         self.t0f = t0f
+        self.raw = raw                  # UNCONSTRAINED argmax (B,) when
+        #                                 the engine masks sampling — the
+        #                                 violation-avoided counter input
 
 
 class GenerationRequest:
@@ -384,7 +387,8 @@ class GenerationRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "tokens", "done", "finish_reason", "slot",
                  "priority", "deadline_at", "submitted_at",
-                 "enqueued_at", "preemptions", "swapped")
+                 "enqueued_at", "preemptions", "swapped",
+                 "adapter_id", "constraint")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
         self.rid = rid
@@ -401,6 +405,8 @@ class GenerationRequest:
         self.enqueued_at: Optional[float] = None   # latest (re)queue time
         self.preemptions = 0
         self.swapped = False    # KV currently host-resident (ISSUE 10)
+        self.adapter_id = 0     # 0 = the base model (ISSUE 14)
+        self.constraint = None  # live ConstraintState or None (ISSUE 14)
 
     def resume_sequence(self) -> np.ndarray:
         """The tokens whose KV must be in the pool before this request
@@ -515,7 +521,9 @@ class ContinuousBatchingEngine:
                  host_tier_kw: Optional[Dict] = None,
                  weight_bits: Optional[int] = None,
                  fused: Optional[bool] = None,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 adapters=None,
+                 constraints: bool = False):
         from ..serving import PagedKVCache
         self.cfg = cfg
         self.temperature = float(temperature)
@@ -607,6 +615,42 @@ class ContinuousBatchingEngine:
         self.prefill_chunk = prefill_chunk
         self.max_batch = max_batch
         self._key = key if key is not None else jax.random.key(0)
+        # --- multi-tenant adapter plane (ISSUE 14): a device-resident
+        # AdapterPool of packed per-layer LoRA factors, paged like KV —
+        # per-request adapter_id pins a slot at admission (refcounted;
+        # LRU reclaim demotes cold adapters to the host tier) and the
+        # per-row slot ids gather into every forward. None compiles
+        # the adapter term out of every program (the plain engine).
+        # A dict builds the pool in place (slots/rank/registry/store —
+        # serving.adapters.AdapterPool kwargs); a pre-built pool must
+        # match this engine's mesh (the B factors column-shard with
+        # the weights).
+        from ..serving.adapters import AdapterPool
+        if isinstance(adapters, dict):
+            adapters = AdapterPool(cfg, mesh=mesh, **adapters)
+        if adapters is not None and adapters.mesh is not mesh:
+            raise ValueError(
+                "ContinuousBatchingEngine: the AdapterPool's mesh does "
+                "not match the engine's — build the pool with the same "
+                "serving mesh (its B factors shard with the weights)")
+        self.adapters = adapters
+        self._aslot = np.zeros((max_batch,), np.int32)
+        # --- constrained decoding (ISSUE 14): constraints=True grows
+        # the decode program a per-row (B, vocab) allowed-token mask
+        # (logits[~mask] = -inf before the argmax/categorical) plus a
+        # violation-avoided output; per-request DFA state advances at
+        # commit. Default OFF so the plain engine's programs (and the
+        # bit-identity gates) are untouched.
+        self.constraints = bool(constraints)
+        # the (B, vocab) mask is real memory at serving vocab sizes —
+        # only constrained engines pay for it
+        self._cmask = (np.ones((max_batch, cfg.vocab_size), bool)
+                       if self.constraints else None)
+        # device copy of the mask, re-uploaded only after a host-side
+        # mutation (commit refresh, seat/clear) — steady-state traffic
+        # with no constrained rows pays zero per-step transfer
+        self._cmask_dev = None
+        self._cmask_dirty = True
         self._queue: List[GenerationRequest] = []
         self._slots: List[Optional[GenerationRequest]] = [None] * max_batch
         self._last = np.zeros((max_batch,), np.int32)
@@ -637,17 +681,25 @@ class ContinuousBatchingEngine:
         # preemption-resume replay), tokens already in pages]
         self._pending: Dict[int, List] = {}
         self._chunk_fns: Dict[tuple, object] = {}
-        # --- speculative decoding (ISSUE 5): n-gram draft + batched
-        # greedy verify; spec_k = max drafts per row per step, 0 = off
+        # --- speculative decoding (ISSUE 5 / ISSUE 14): n-gram draft +
+        # batched verify; spec_k = max drafts per row per step, 0 = off.
+        # temperature == 0 verifies against the greedy argmax (the
+        # PR 5 path, token-identical to plain decode); temperature > 0
+        # runs standard REJECTION SAMPLING against the verify logits
+        # (serving.speculative.rejection_sample_tokens — q is the
+        # deterministic proposer's point mass, so acceptance is p(x)
+        # and the corrected residual keeps the output distribution
+        # exactly the plain sampled-decode law), which is what gives
+        # temperature>0 traffic the 1+k speedup.
         self.spec_k = int(spec_k)
         if self.spec_k:
-            if self.temperature != 0.0:
+            if self.constraints:
                 raise ValueError(
-                    "spec_k > 0 requires greedy decoding (temperature "
-                    "== 0): speculative verification accepts drafts "
-                    "against the greedy argmax — sampled acceptance "
-                    "would need distribution-matched rejection "
-                    "sampling, which this engine does not implement")
+                    "spec_k > 0 cannot combine with constraints=True: "
+                    "a verify batch commits tokens the per-row grammar "
+                    "mask never saw — run constrained requests on a "
+                    "plain-decode engine (the scenarios compose at the "
+                    "cluster tier, one engine per workload class)")
             from ..serving.speculative import Speculator
             self.spec = (speculator if speculator is not None
                          else Speculator(self.spec_k,
@@ -655,13 +707,48 @@ class ContinuousBatchingEngine:
         else:
             self.spec = None
         self._spec_fns: Dict[tuple, object] = {}
+        # host-side acceptance RNG for sampled speculation, seeded from
+        # the engine key so two engines built identically draw the same
+        # stream (recovery keeps committed tokens; uncommitted futures
+        # re-draw — the same step-granularity contract sampled decode
+        # already has)
+        self._accept_rng = np.random.default_rng(
+            int(np.asarray(jax.random.key_data(self._key)).sum()
+                & 0x7FFFFFFF))
 
     # ---- request intake ----
     def create_request(self, prompt, max_new_tokens: int = 16,
-                       eos_token_id=None) -> GenerationRequest:
+                       eos_token_id=None, adapter_id: int = 0,
+                       constraint=None) -> GenerationRequest:
         """Validate and build a request WITHOUT queueing it — external
         schedulers (:class:`~paddle_tpu.serving.ServingScheduler`) own
-        their queues and place requests via :meth:`admit_request`."""
+        their queues and place requests via :meth:`admit_request`.
+
+        ``adapter_id`` (ISSUE 14): the LoRA variant serving this
+        request (0 = base model); needs an engine built with an
+        :class:`~paddle_tpu.serving.adapters.AdapterPool`. The slot is
+        pinned at ADMISSION, not here — a queued request holds no
+        device residency. ``constraint``: a
+        :class:`~paddle_tpu.serving.constraints.TokenDFA` (wrapped
+        into a fresh per-request state) or a live
+        :class:`~paddle_tpu.serving.constraints.ConstraintState`;
+        needs ``constraints=True``."""
+        if int(adapter_id) != 0:
+            if self.adapters is None:
+                raise ValueError(
+                    f"create_request: adapter_id={adapter_id} on an "
+                    f"engine without an adapter pool — pass adapters= "
+                    f"at construction")
+            # resolvability check at INTAKE: an unknown/oversized id
+            # must reject this request here, not raise at admission
+            # inside the serving loop (a poison-pill that would crash
+            # every step and every recovery re-admission)
+            self.adapters.validate_id(adapter_id)
+        if constraint is not None and not self.constraints:
+            raise ValueError(
+                "create_request: a grammar constraint needs an engine "
+                "built with constraints=True (the decode program "
+                "carries the per-row mask input)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("submit: empty prompt")
@@ -682,15 +769,25 @@ class ContinuousBatchingEngine:
         req = GenerationRequest(
             self._next_rid, prompt, max_new_tokens,
             self.eos_token_id if eos_token_id is None else eos_token_id)
+        req.adapter_id = int(adapter_id)
+        if constraint is not None:
+            from ..serving.constraints import ConstraintState, TokenDFA
+            if isinstance(constraint, TokenDFA):
+                constraint = ConstraintState(constraint,
+                                             eos_token_id=req.eos_token_id)
+            req.constraint = constraint
         self._next_rid += 1
         return req
 
     def submit(self, prompt, max_new_tokens: int = 16,
-               eos_token_id=None) -> GenerationRequest:
+               eos_token_id=None, adapter_id: int = 0,
+               constraint=None) -> GenerationRequest:
         """Queue a prompt (1D int sequence); returns the request handle
         (``.done`` / ``.tokens`` / ``.output`` fill in as steps run)."""
         req = self.create_request(prompt, max_new_tokens=max_new_tokens,
-                                  eos_token_id=eos_token_id)
+                                  eos_token_id=eos_token_id,
+                                  adapter_id=adapter_id,
+                                  constraint=constraint)
         self._queue.append(req)
         return req
 
@@ -708,6 +805,11 @@ class ContinuousBatchingEngine:
         from jax.sharding import PartitionSpec as P
         kinds = {"params": self._param_specs,
                  "pool": self.cache.pool_specs, "rep": P()}
+        if self.adapters is not None:
+            # adapter-pool factor dict: B factors column-sharded on the
+            # same output axis as the base weights, A + scales
+            # replicated (llama.adapter_partition_specs)
+            kinds["adapters"] = self.adapters.specs
         return shard_map(
             fn, mesh=self.mesh,
             in_specs=tuple(kinds[k] for k in arg_kinds),
@@ -718,24 +820,58 @@ class ContinuousBatchingEngine:
             from ..models import generate as gen
             cfg, temp, uk = self.cfg, self.temperature, self.use_kernel
             ax, fz = self._tp_axis, self.fused
+            ad_on, cons = self.adapters is not None, self.constraints
 
-            def fwd(params, last, paged, tables, lengths, active):
-                return gen.paged_decode_forward(
-                    params, last, paged, tables, lengths, cfg,
-                    active=active, use_kernel=uk, tp_axis=ax, fused=fz)
+            if ad_on:
+                def fwd(params, last, paged, tables, lengths, active,
+                        ad, aslot):
+                    return gen.paged_decode_forward(
+                        params, last, paged, tables, lengths, cfg,
+                        active=active, use_kernel=uk, tp_axis=ax,
+                        fused=fz, adapters=ad, adapter_slots=aslot)
+                if self.mesh is not None:
+                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
+                                             "rep", "rep", "rep",
+                                             "adapters", "rep"))
+            else:
+                def fwd(params, last, paged, tables, lengths, active):
+                    return gen.paged_decode_forward(
+                        params, last, paged, tables, lengths, cfg,
+                        active=active, use_kernel=uk, tp_axis=ax,
+                        fused=fz)
+                if self.mesh is not None:
+                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
+                                             "rep", "rep", "rep"))
 
-            if self.mesh is not None:
-                fwd = self._tp_map(fwd, ("params", "rep", "pool",
-                                         "rep", "rep", "rep"))
-
-            def f(params, last, paged, tables, lengths, active, key):
-                logits, paged = fwd(params, last, paged, tables,
-                                    lengths, active)
+            def f(params, last, paged, tables, lengths, active, key,
+                  *extra):
+                # extra layout (engine-config-static): [adapter arrays,
+                # adapter slots] when the pool is on, then [the (B, V)
+                # allowed-token mask] when constraints are on
+                extra = list(extra)
+                if ad_on:
+                    logits, paged = fwd(params, last, paged, tables,
+                                        lengths, active, extra.pop(0),
+                                        extra.pop(0))
+                else:
+                    logits, paged = fwd(params, last, paged, tables,
+                                        lengths, active)
+                raw = None
+                if cons:
+                    # the UNCONSTRAINED argmax rides along so the commit
+                    # can count violations the mask avoided; masking
+                    # happens BEFORE the temperature split so greedy and
+                    # sampled constrained decode share one rule
+                    cmask = extra.pop(0)
+                    raw = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    logits = jnp.where(cmask, logits, -jnp.inf)
                 if temp == 0.0:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
                     nxt = jax.random.categorical(
                         key, logits / temp, axis=-1).astype(jnp.int32)
+                if cons:
+                    return (nxt, raw), paged
                 return nxt, paged
 
             self._decode_fn = jax.jit(f, donate_argnums=(2,))
@@ -754,15 +890,28 @@ class ContinuousBatchingEngine:
             cfg, ax, fz = self.cfg, self._tp_axis, self.fused
             uk = self.use_kernel
 
-            def f(params, chunk, paged, table, ctx_len, chunk_len):
-                return gen.paged_prefill_chunk(
-                    params, chunk, paged, table, cfg, ctx_cap=ctx_cap,
-                    ctx_len=ctx_len, chunk_len=chunk_len, tp_axis=ax,
-                    fused=fz, use_kernel=uk)
-
-            if self.mesh is not None:
-                f = self._tp_map(f, ("params", "rep", "pool", "rep",
-                                     "rep", "rep"))
+            if self.adapters is not None:
+                def f(params, chunk, paged, table, ctx_len, chunk_len,
+                      ad, aslot):
+                    return gen.paged_prefill_chunk(
+                        params, chunk, paged, table, cfg,
+                        ctx_cap=ctx_cap, ctx_len=ctx_len,
+                        chunk_len=chunk_len, tp_axis=ax, fused=fz,
+                        use_kernel=uk, adapters=ad, adapter_slot=aslot)
+                if self.mesh is not None:
+                    f = self._tp_map(f, ("params", "rep", "pool", "rep",
+                                         "rep", "rep", "adapters",
+                                         "rep"))
+            else:
+                def f(params, chunk, paged, table, ctx_len, chunk_len):
+                    return gen.paged_prefill_chunk(
+                        params, chunk, paged, table, cfg,
+                        ctx_cap=ctx_cap, ctx_len=ctx_len,
+                        chunk_len=chunk_len, tp_axis=ax, fused=fz,
+                        use_kernel=uk)
+                if self.mesh is not None:
+                    f = self._tp_map(f, ("params", "rep", "pool", "rep",
+                                         "rep", "rep"))
             self._chunk_fns[key] = jax.jit(f, donate_argnums=(2,))
         return self._chunk_fns[key]
 
@@ -778,22 +927,46 @@ class ContinuousBatchingEngine:
             from ..models import generate as gen
             cfg, uk, ax = self.cfg, self.use_kernel, self._tp_axis
             fz = self.fused
+            ad_on, temp = self.adapters is not None, self.temperature
 
-            def fwd(params, chunk, paged, tables, lengths, active):
-                return gen.paged_verify_forward(
-                    params, chunk, paged, tables, lengths, cfg,
-                    ctx_cap=ctx_cap, active=active, use_kernel=uk,
-                    tp_axis=ax, fused=fz)
+            if ad_on:
+                def fwd(params, chunk, paged, tables, lengths, active,
+                        ad, aslot):
+                    return gen.paged_verify_forward(
+                        params, chunk, paged, tables, lengths, cfg,
+                        ctx_cap=ctx_cap, active=active, use_kernel=uk,
+                        tp_axis=ax, fused=fz, adapters=ad,
+                        adapter_slots=aslot)
+                if self.mesh is not None:
+                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
+                                             "rep", "rep", "rep",
+                                             "adapters", "rep"))
+            else:
+                def fwd(params, chunk, paged, tables, lengths, active):
+                    return gen.paged_verify_forward(
+                        params, chunk, paged, tables, lengths, cfg,
+                        ctx_cap=ctx_cap, active=active, use_kernel=uk,
+                        tp_axis=ax, fused=fz)
+                if self.mesh is not None:
+                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
+                                             "rep", "rep", "rep"))
 
-            if self.mesh is not None:
-                fwd = self._tp_map(fwd, ("params", "rep", "pool",
-                                         "rep", "rep", "rep"))
-
-            def f(params, chunk, paged, tables, lengths, active):
-                logits, paged = fwd(params, chunk, paged, tables,
-                                    lengths, active)
-                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                        paged)
+            def f(params, chunk, paged, tables, lengths, active,
+                  *extra):
+                logits, paged = (fwd(params, chunk, paged, tables,
+                                     lengths, active, *extra) if ad_on
+                                 else fwd(params, chunk, paged, tables,
+                                          lengths, active))
+                if temp == 0.0:
+                    # greedy verify: only the per-position argmax leaves
+                    # the device (the ISSUE 5 path, unchanged)
+                    return (jnp.argmax(logits, axis=-1)
+                            .astype(jnp.int32), paged)
+                # sampled verify (ISSUE 14): rejection sampling needs
+                # the full (B, T, V) verify distributions on the host —
+                # acceptance is min(1, p/q) per draft position and the
+                # corrected residual draws from p with the draft zeroed
+                return logits.astype(jnp.float32), paged
 
             self._spec_fns[key] = jax.jit(f, donate_argnums=(2,))
         return self._spec_fns[key]
@@ -811,10 +984,23 @@ class ContinuousBatchingEngine:
         self._maxnew[slot] = req.max_new_tokens
         self._eos[slot] = (-1 if req.eos_token_id is None
                            else int(req.eos_token_id))
+        # per-row adapter slot mirror (ISSUE 14): the pool pin taken at
+        # admission guarantees the slot id stays valid while seated
+        self._aslot[slot] = (self.adapters.slot_of(req.adapter_id)
+                             if self.adapters is not None else 0)
+        if self.constraints:
+            self._cmask[slot] = (
+                req.constraint.mask(self.cfg.vocab_size)
+                if req.constraint is not None else True)
+            self._cmask_dirty = True
 
     def _clear_slot(self, slot: int):
         self._slots[slot] = None
         self._rids[slot] = -1
+        self._aslot[slot] = 0
+        if self.constraints:
+            self._cmask[slot] = True
+            self._cmask_dirty = True
 
     def admit_request(self, req: GenerationRequest) -> bool:
         """Place ``req`` into a free slot, reserving its pages (prefix-
@@ -839,6 +1025,27 @@ class ContinuousBatchingEngine:
         if not free:
             return False
         slot = free[0]
+        # adapter residency (ISSUE 14): pin the request's adapter slot
+        # BEFORE any page work — acquire may itself defer
+        # (AdapterPoolExhausted is a PoolExhausted: every slot pinned is
+        # back-pressure, same as a full page pool), and a later
+        # PoolExhausted from the page side must drop the pin it took so
+        # a deferred admission leaks nothing
+        pinned = False
+        if self.adapters is not None and req.adapter_id:
+            self.adapters.acquire(req.adapter_id)
+            pinned = True
+        try:
+            return self._admit_pinned(req, slot)
+        except BaseException:
+            if pinned:
+                self.adapters.release(req.adapter_id)
+            raise
+
+    def _admit_pinned(self, req: GenerationRequest, slot: int) -> bool:
+        """The page-side half of :meth:`admit_request`, run with the
+        request's adapter pin (if any) already held."""
+        cache = self.cache
         seq = req.resume_sequence()
         if (req.swapped and req.tokens
                 and getattr(cache, "host", None) is not None):
@@ -922,6 +1129,11 @@ class ContinuousBatchingEngine:
         req.slot = None
         req.preemptions += 1
         req.finish_reason = "preempted"
+        if self.adapters is not None and req.adapter_id:
+            # the evicted request holds no device residency of any kind
+            # while preempted: re-admission re-pins (and, if the slot
+            # was reclaimed meanwhile, promotes the adapter back)
+            self.adapters.release(req.adapter_id)
         _obs.serving_preempted(1, freed)
         return freed
 
@@ -1019,24 +1231,39 @@ class ContinuousBatchingEngine:
         # commits nothing (neither ``done`` nor a sampled token)
         _fault_point("prefill_chunk")
         t0 = _obs.generate_begin()
-        logits, cache.pool = self._chunk_fn(ctx_cap, width)(
-            self.params, jnp.asarray(chunk), cache.pool,
-            jnp.asarray(cache.block_tables[slot]), jnp.int32(done),
-            jnp.int32(take))
-        samp = None
+        args = [self.params, jnp.asarray(chunk), cache.pool,
+                jnp.asarray(cache.block_tables[slot]), jnp.int32(done),
+                jnp.int32(take)]
+        if self.adapters is not None:
+            args += [self.adapters.arrays,
+                     jnp.asarray(self._aslot[slot:slot + 1])]
+        logits, cache.pool = self._chunk_fn(ctx_cap, width)(*args)
+        samp = rawmax = None
         if done + take >= S and not req.tokens:
             # final chunk of a fresh admission (or a mid-prefill
             # victim's resume): the first token comes from these
             # logits. Keep the sample on device; fetch at commit.
+            lg = logits[0]
+            if self.constraints and req.constraint is not None:
+                # the FIRST token obeys the grammar too: the slot mask
+                # (installed at admission from the DFA start state)
+                # applies before the argmax/categorical, same rule as
+                # the decode program's in-graph where. The UNMASKED
+                # argmax rides along so the violation-avoided counter
+                # covers this commit path like the decode one.
+                rawmax = jnp.argmax(lg)
+                lg = jnp.where(jnp.asarray(self._cmask[slot]), lg,
+                               -jnp.inf)
             if self.temperature == 0.0:
-                samp = jnp.argmax(logits[0])
+                samp = jnp.argmax(lg)
             else:
                 self._key, k = jax.random.split(self._key)
                 samp = jax.random.categorical(
-                    k, logits[0] / self.temperature)
+                    k, lg / self.temperature)
         self._inflight_chunks.append(
             {"slot": slot, "req": req, "seat": int(self._seat[slot]),
-             "take": take, "t0": t0, "logits": logits, "samp": samp})
+             "take": take, "t0": t0, "logits": logits, "samp": samp,
+             "rawmax": rawmax})
         return width
 
     def _commit_chunk(self, h: Dict) -> int:
@@ -1083,7 +1310,23 @@ class ContinuousBatchingEngine:
             first = int(h["samp"])          # the ONE device→host fetch
             self._fence_ns += time.perf_counter_ns() - t_f
             self._last[slot] = first
+            # violation check against the PRE-advance slot mask with
+            # the UNMASKED argmax, mirroring the decode commit — read
+            # BEFORE _record_token, whose retirement clears the slot
+            # (and its mask) when this token finishes the request
+            viol = (int(not self._cmask[slot, int(h["rawmax"])])
+                    if self.constraints and req.constraint is not None
+                    else 0)
             self._record_token(req, first)
+            if self.constraints and req.constraint is not None:
+                t0m = time.perf_counter_ns()
+                req.constraint.advance(first)
+                if not req.done:
+                    self._cmask[slot] = req.constraint.mask(
+                        self.cfg.vocab_size)
+                    self._cmask_dirty = True
+                _obs.serving_constrain(
+                    time.perf_counter_ns() - t0m, viol, 1)
         return take
 
     def commit_prefills(self) -> int:
@@ -1130,6 +1373,8 @@ class ContinuousBatchingEngine:
         req.finish_reason = reason
         self.cache.release(req.slot)
         self._clear_slot(req.slot)
+        if self.adapters is not None and req.adapter_id:
+            self.adapters.release(req.adapter_id)
         _obs.serving_retired(1, reason)
 
     def _tp_observe(self):
@@ -1218,14 +1463,26 @@ class ContinuousBatchingEngine:
         if not free:
             return False
         slot = free[0]
-        if src_engine is not None:
-            self.cache.import_request_direct(
-                slot, src_engine.cache, payload["slot"],
-                req.prompt.shape[1] + req.max_new_tokens)
-        else:
-            self.cache.import_request(
-                slot, payload["kv"],
-                req.prompt.shape[1] + req.max_new_tokens)
+        # the importing engine pins the adapter on ITS pool (the KV
+        # payload is adapter-agnostic by the q/o-only design, so the
+        # bytes install unchanged; a failed page install drops the pin)
+        pinned = False
+        if self.adapters is not None and req.adapter_id:
+            self.adapters.acquire(req.adapter_id)
+            pinned = True
+        try:
+            if src_engine is not None:
+                self.cache.import_request_direct(
+                    slot, src_engine.cache, payload["slot"],
+                    req.prompt.shape[1] + req.max_new_tokens)
+            else:
+                self.cache.import_request(
+                    slot, payload["kv"],
+                    req.prompt.shape[1] + req.max_new_tokens)
+        except BaseException:
+            if pinned:
+                self.adapters.release(req.adapter_id)
+            raise
         self.cache.lengths[slot] = np.int32(payload["length"])
         self._last[slot] = np.int32(payload["last"])
         self._install_slot(slot, req)
@@ -1248,6 +1505,9 @@ class ContinuousBatchingEngine:
         self._clear_slot(slot)
         self._pending.pop(slot, None)
         self.cache.release(slot)
+        if self.adapters is not None and req.adapter_id:
+            # the importing engine took its own pin; this side's drops
+            self.adapters.release(req.adapter_id)
 
     def ready_mask(self) -> np.ndarray:
         """(max_batch,) bool — slots whose sequence is fully in the
@@ -1293,14 +1553,25 @@ class ContinuousBatchingEngine:
         _fault_point("decode_step")
         t0f = _obs.generate_begin() if self.fused else 0
         self._key, k = jax.random.split(self._key)
-        nxt, cache.pool = self._decode()(
-            self.params, jnp.asarray(self._last), cache.pool,
-            jnp.asarray(cache.block_tables),
-            jnp.asarray(cache.lengths),
-            jnp.asarray(mask), k)
+        args = [self.params, jnp.asarray(self._last), cache.pool,
+                jnp.asarray(cache.block_tables),
+                jnp.asarray(cache.lengths),
+                jnp.asarray(mask), k]
+        if self.adapters is not None:
+            args += [self.adapters.arrays, jnp.asarray(self._aslot)]
+        if self.constraints:
+            if self._cmask_dirty or self._cmask_dev is None:
+                self._cmask_dev = jnp.asarray(self._cmask)
+                self._cmask_dirty = False
+            args += [self._cmask_dev]
+        out, cache.pool = self._decode()(*args)
+        raw = None
+        if self.constraints:
+            out, raw = out
         _fault_point("dispatch")
         self._inflight = InFlightStep("decode", mask, self._rids.copy(),
-                                      self._seat.copy(), nxt, t0f=t0f)
+                                      self._seat.copy(), out, t0f=t0f,
+                                      raw=raw)
         return self._inflight
 
     def _decode_commit(self, h: InFlightStep) -> int:
@@ -1341,6 +1612,29 @@ class ContinuousBatchingEngine:
             sl, tl = slots.tolist(), toks.tolist()
             for s, t in zip(sl, tl):
                 self._slots[s].tokens.append(t)
+            if self.constraints:
+                # advance each constrained row's DFA with the token
+                # that actually COMMITTED, refresh its next-step mask,
+                # and count the steps where the UNCONSTRAINED argmax
+                # would have violated the grammar (each one is a saved
+                # parse failure). Runs BEFORE retirement clears slots.
+                t0m = time.perf_counter_ns()
+                raw = np.asarray(h.raw)
+                viol = crows = 0
+                for s, t in zip(sl, tl):
+                    creq = self._slots[s]
+                    if creq is None or creq.constraint is None:
+                        continue
+                    crows += 1
+                    if not self._cmask[s, int(raw[s])]:
+                        viol += 1
+                    creq.constraint.advance(t)
+                    self._cmask[s] = creq.constraint.mask(
+                        self.cfg.vocab_size)
+                    self._cmask_dirty = True
+                if crows:
+                    _obs.serving_constrain(
+                        time.perf_counter_ns() - t0m, viol, crows)
             for i in np.flatnonzero(fin_eos | fin_max).tolist():
                 self._retire(self._slots[sl[i]],
                              "eos" if fin_eos[i] else "max_len")
@@ -1497,10 +1791,12 @@ class ContinuousBatchingEngine:
             int(cache.lengths[mask].max()))) * cache.page_size
         _fault_point("verify_step")
         t0 = _obs.generate_begin()
-        out, cache.pool = self._spec_fn(ctx_cap, T)(
-            self.params, jnp.asarray(chunk), cache.pool,
-            jnp.asarray(cache.block_tables),
-            jnp.asarray(cache.lengths), jnp.asarray(mask))
+        args = [self.params, jnp.asarray(chunk), cache.pool,
+                jnp.asarray(cache.block_tables),
+                jnp.asarray(cache.lengths), jnp.asarray(mask)]
+        if self.adapters is not None:
+            args += [self.adapters.arrays, jnp.asarray(self._aslot)]
+        out, cache.pool = self._spec_fn(ctx_cap, T)(*args)
         _fault_point("dispatch")
         self._inflight = InFlightStep("spec", mask, self._rids.copy(),
                                       self._seat.copy(), out,
@@ -1522,10 +1818,14 @@ class ContinuousBatchingEngine:
         if self.fused:
             _obs.serving_fused_latency("verify_flash_attn", h.t0, h.out)
         _fault_point("transfer")
-        out = np.asarray(h.out)            # (B, T) greedy targets
+        out = np.asarray(h.out)   # (B, T) greedy targets — or, under
+        #                           sampled speculation, (B, T, V)
+        #                           verify logits for rejection sampling
         t1 = time.perf_counter_ns()        # device fence: verify done
         self._fence_ns += t1 - t_f
-        from ..serving.speculative import longest_accepted_prefix
+        from ..serving.speculative import (longest_accepted_prefix,
+                                           rejection_sample_tokens)
+        sampled = self.temperature != 0.0
         n_slots = committed = drafted = accepted = 0
         for slot, req in enumerate(self._slots):
             if (req is None or not mask[slot]
@@ -1535,12 +1835,24 @@ class ContinuousBatchingEngine:
             n_slots += 1
             j = int(dlen[slot])
             d = drafts.get(slot)
-            a = longest_accepted_prefix(d, out[slot]) if j else 0
+            if sampled:
+                # standard rejection sampling (ISSUE 14): accept draft i
+                # with p_i(draft), otherwise draw the corrective token
+                # from the residual — output distribution identical in
+                # law to plain sampled decode, so temperature>0 rows get
+                # the 1+k speedup without changing what they emit
+                toks, a = rejection_sample_tokens(
+                    out[slot, :j + 1], d if j else None,
+                    self.temperature, self._accept_rng)
+            else:
+                a = longest_accepted_prefix(d, out[slot]) if j else 0
+                toks = ((list(d[:a]) if j else [])
+                        + [int(out[slot, a])])
             # commit: the last token's KV + a accepted drafts are now
-            # context; the bonus target becomes the new last token
+            # context; the corrective/bonus token becomes the new last
             cache.lengths[slot] += a + 1
-            self._last[slot] = out[slot, a]
-            for tok in (list(d[:a]) if j else []) + [out[slot, a]]:
+            self._last[slot] = np.int32(toks[-1])
+            for tok in toks:
                 self._record_token(req, int(tok))
                 committed += 1
                 if req.done:
@@ -1549,6 +1861,8 @@ class ContinuousBatchingEngine:
                 drafted += j
                 accepted += a
                 self.spec.observe(slot, req.rid, j, a)
+        if sampled and drafted:
+            _obs.serving_sample_accept(drafted, accepted)
         self._steps += 1
         _obs.serving_spec_verify(h.t0, out, n_slots, drafted, accepted,
                                  t1_ns=t1)
@@ -1629,6 +1943,8 @@ class ContinuousBatchingEngine:
         if self.fused:
             s["fused_kernels"] = True
         s["cow_copies"] = self.cache.cow_copies
+        if self.adapters is not None:
+            s.update(self.adapters.stats())
         if getattr(self.cache, "host", None) is not None:
             s.update(self.cache.tier_stats())
         if self.cache.prefix is not None:

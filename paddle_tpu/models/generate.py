@@ -37,6 +37,41 @@ def _tp_allgather(x: jax.Array, axis_name: str, axis: int) -> jax.Array:
     return lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
+def _lora_delta(x, a_l, b_l, aslot, scale):
+    """Per-row batched LoRA term (ISSUE 14): gather each row's packed
+    low-rank factors from the adapter pool's per-layer arrays and add
+    ``(x @ A_i) @ B_i · α/r``. ``x`` (B, T, in); ``a_l`` (S, in, r) /
+    ``b_l`` (S, r, out) — this layer's slice of the pool; ``aslot``
+    (B,) int32 pool-slot per row; ``scale`` (B,) the per-row α/r.
+    Slot 0 holds exact zeros (the base model), so a base row's term is
+    an exactly-zero add — the adapter_id=0 bit-identity gate. Under
+    tensor parallel ``b_l`` arrives column-sharded on the same output
+    axis as the base matrix, so each shard's delta columns use the
+    full, identically ordered rank contraction (bit-identical by the
+    ISSUE 7 column-split argument)."""
+    a = jnp.take(a_l, aslot, axis=0).astype(x.dtype)      # (B, in, r)
+    b = jnp.take(b_l, aslot, axis=0).astype(x.dtype)      # (B, r, out)
+    t = jnp.einsum("bti,bir->btr", x, a)
+    return jnp.einsum("btr,bro->bto", t, b) * scale[:, None, None]
+
+
+def _adapter_prep(adapters, adapter_slots, cfg: LlamaConfig):
+    """Shared per-forward adapter setup: the (B,) slot vector, the
+    gathered per-row α/r scale, and the TRACE-time factor-gather byte
+    counter (``serving_adapter_gather`` — fires once per compile, the
+    serving_tp_allgather contract: it reports the per-step adapter
+    bytes the compiled program gathers out of the pool)."""
+    aslot = jnp.asarray(adapter_slots, jnp.int32).reshape(-1)
+    asc = jnp.take(adapters["scale"], aslot).astype(cfg.dtype)
+    B = aslot.shape[0]
+    per_row = sum(int(adapters[n].shape[-1] * adapters[n].shape[-2])
+                  for n in ("aq", "bq", "ao", "bo"))
+    _obs.serving_adapter_gather(
+        B * cfg.num_layers * per_row
+        * jnp.dtype(adapters["aq"].dtype).itemsize)
+    return aslot, asc
+
+
 def _tp_heads(layers: Dict, cfg: LlamaConfig) -> Tuple[int, int]:
     """Per-SHARD (num_heads, num_kv_heads) from the local weight shards
     (inside shard_map the cfg still describes the GLOBAL model; the
@@ -200,7 +235,8 @@ def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
 def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
                         block_table: jax.Array, cfg: LlamaConfig, *,
                         ctx_cap: int, ctx_len, chunk_len, tp_axis=None,
-                        fused=None, use_kernel=None):
+                        fused=None, use_kernel=None, adapters=None,
+                        adapter_slot=None):
     """Prefill ONE chunk of a request's prompt against the KV already in
     its pages — the chunked-prefill / prefix-cache continuation program
     (one compile per static ``(ctx_cap, C)`` pair; the engine buckets
@@ -248,7 +284,13 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     ``fused`` (ISSUE 11): the chunk's attention runs through the flash
     prefill kernel (``ops/pallas/serving_fused.flash_chunk_attention``)
     instead of the materialized-score jnp path — same ragged
-    ``kstart``/``rpos`` masks, int8 dequant in VMEM."""
+    ``kstart``/``rpos`` masks, int8 dequant in VMEM.
+
+    ``adapters`` / ``adapter_slot`` (ISSUE 14): the request's LoRA term
+    — the one-request sibling of :func:`paged_decode_forward`'s per-row
+    gather (``adapter_slot`` is this request's pool slot; q/o adapters
+    leave the chunk's CACHED K/V adapter-agnostic by construction, so
+    prefix sharing stays valid across tenants)."""
     B, C = tokens.shape
     if B != 1:
         raise ValueError(
@@ -283,7 +325,9 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
                                     W, use_kernel=use_kernel, rpos=rpos,
                                     kstart=kstart,
                                     logits_at=chunk_len - 1,
-                                    tp_axis=tp_axis, fused=bool(fused))
+                                    tp_axis=tp_axis, fused=bool(fused),
+                                    adapters=adapters,
+                                    adapter_slots=adapter_slot)
     pos = jnp.arange(C, dtype=jnp.int32)
     logical = jnp.clip(ctx_len + pos, 0, ext - 1)
     dst = jnp.where(pos < chunk_len,
@@ -299,7 +343,8 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
 def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, ctx_cap: int, active=None,
-                         use_kernel=None, tp_axis=None, fused=None):
+                         use_kernel=None, tp_axis=None, fused=None,
+                         adapters=None, adapter_slots=None):
     """Batched speculative-decode VERIFY: score a ``T``-token chunk for
     EVERY speculating row against its paged KV in ONE forward — the
     batched generalization of :func:`paged_prefill_chunk` (which runs
@@ -370,7 +415,9 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
                                     W, use_kernel=use_kernel, rpos=rpos,
                                     kstart=pad, logits_all=True,
-                                    tp_axis=tp_axis, fused=bool(fused))
+                                    tp_axis=tp_axis, fused=bool(fused),
+                                    adapters=adapters,
+                                    adapter_slots=adapter_slots)
     # scatter the T new rows of every row into its pages; inactive rows
     # and positions past the slot extent route to the trash page
     pos = rpos                                           # (B, T)
@@ -390,7 +437,8 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, active=None,
-                         use_kernel=None, tp_axis=None, fused=None):
+                         use_kernel=None, tp_axis=None, fused=None,
+                         adapters=None, adapter_slots=None):
     """One continuous-batching decode step over the ragged batch: every
     slot advances one token in a single static-shape program.
 
@@ -426,7 +474,16 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
     is gated token-identical per tier (tests/test_lowbit_decode.py).
     Weight-quantized params (int8/int4 — :func:`quantize_weights`) ride
     either path unchanged: ``_w`` dequants on the fly, which is the
-    low-bit decode tier."""
+    low-bit decode tier.
+
+    ``adapters`` / ``adapter_slots`` (ISSUE 14): the multi-LoRA term —
+    ``adapters`` is the :class:`~paddle_tpu.serving.adapters.
+    AdapterPool` array dict (per-layer packed A/B factors + per-slot
+    α/r scales), ``adapter_slots`` the (B,) per-row pool slot ids; the
+    q and o projections grow a batched ``y += (x @ A_i) @ B_i · α/r``
+    term gathered per row. Slot 0 is the base model's exact-zero
+    factors, and ``adapters=None`` (the default) compiles the term out
+    entirely — both ends of the bit-identity gate."""
     from ..ops.pallas import paged_attention as _pa
     from ..ops.pallas import serving_fused as _sf
     fused = bool(fused)
@@ -440,6 +497,9 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
     if active is None:
         active = jnp.ones((B,), bool)
     lengths = jnp.asarray(lengths, jnp.int32)
+    aslot = asc = None
+    if adapters is not None:
+        aslot, asc = _adapter_prep(adapters, adapter_slots, cfg)
     cos, sin = rope_tables(ext, cfg.hd, cfg.rope_theta)
     rpos = lengths[:, None]                          # (B, 1)
     if fused:
@@ -459,13 +519,20 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
         cfg.dtype)                                   # (B, 1, H)
 
     def body(xc, layer_in):
+        layer_in = list(layer_in)
+        ad_l = None
+        if adapters is not None:
+            ad_l, layer_in = layer_in[-4:], layer_in[:-4]
         if quant:
             lp, kp, vp, ksp, vsp = layer_in
         else:
             lp, kp, vp = layer_in
             ksp = vsp = None
         h1 = rms_norm(xc, lp["attn_norm"], cfg.rms_eps)
-        q = (h1 @ _w(lp, "wq", xc.dtype)).reshape(B, 1, nh, hd)
+        q = h1 @ _w(lp, "wq", xc.dtype)
+        if ad_l is not None:
+            q = q + _lora_delta(h1, ad_l[0], ad_l[1], aslot, asc)
+        q = q.reshape(B, 1, nh, hd)
         k = (h1 @ _w(lp, "wk", xc.dtype)).reshape(B, 1, nkv, hd)
         v = (h1 @ _w(lp, "wv", xc.dtype)).reshape(B, 1, nkv, hd)
         if not fused:
@@ -517,10 +584,15 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
         o = o.reshape(B, 1, nh * hd)
         if tp_axis is not None:
             o = _tp_allgather(o, tp_axis, 2)
-            xo = xc + _tp_allgather(o @ _w(lp, "wo", xc.dtype),
-                                    tp_axis, 2)
+        ow = o @ _w(lp, "wo", xc.dtype)
+        if ad_l is not None:
+            # the o-projection's adapter term: input is the (full-
+            # width) attention output, B_o column-sharded with wo
+            ow = ow + _lora_delta(o, ad_l[2], ad_l[3], aslot, asc)
+        if tp_axis is not None:
+            xo = xc + _tp_allgather(ow, tp_axis, 2)
         else:
-            xo = xc + o @ _w(lp, "wo", xc.dtype)
+            xo = xc + ow
         h2 = rms_norm(xo, lp["mlp_norm"], cfg.rms_eps)
         g = jax.nn.silu((h2 @ _w(lp, "wg", xc.dtype)).astype(
             jnp.float32)).astype(xc.dtype)
@@ -533,10 +605,13 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
             y = xo + (g * u) @ _w(lp, "wd", xc.dtype)
         return y, ((kp, vp, ksp, vsp) if quant else (kp, vp))
 
-    xs = ((params["layers"], paged["k"], paged["v"], paged["ks"],
-           paged["vs"]) if quant else
-          (params["layers"], paged["k"], paged["v"]))
-    x, new = lax.scan(body, x, xs)
+    xs = [params["layers"], paged["k"], paged["v"]]
+    if quant:
+        xs += [paged["ks"], paged["vs"]]
+    if adapters is not None:
+        xs += [adapters["aq"], adapters["bq"], adapters["ao"],
+               adapters["bo"]]
+    x, new = lax.scan(body, x, tuple(xs))
     new_paged = ({"k": new[0], "v": new[1], "ks": new[2], "vs": new[3]}
                  if quant else {"k": new[0], "v": new[1]})
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
@@ -702,7 +777,7 @@ def _rope_rows(x, cos, sin, rpos):
 def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                  use_kernel=None, rpos=None, kstart=None,
                  cache_ks=None, cache_vs=None, tp_axis=None,
-                 fused=False):
+                 fused=False, ad_l=None, aslot=None, ascale=None):
     """One decoder layer over T tokens starting at cache index ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
     rpos: optional (B,T) per-row rope positions (!= cache index when the
@@ -715,13 +790,19 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
     holds the shard's own kv heads, and activations all-gather to full
     width before each contraction — exact concats, so the math stays
     bit-identical to the single-chip path (see llama.SERVING_TP_RULES).
+    ad_l/aslot/ascale (ISSUE 14): this layer's adapter-pool factor
+    slice + per-row slot/scale — the q/o projections grow the batched
+    LoRA term (see :func:`paged_decode_forward`); None compiles it out.
     """
     B, T, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     if tp_axis is not None:
         nh, nkv = _tp_heads(lp, cfg)
     h1 = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = (h1 @ _w(lp, "wq", x.dtype)).reshape(B, T, nh, hd)
+    q = h1 @ _w(lp, "wq", x.dtype)
+    if ad_l is not None:
+        q = q + _lora_delta(h1, ad_l[0], ad_l[1], aslot, ascale)
+    q = q.reshape(B, T, nh, hd)
     k = (h1 @ _w(lp, "wk", x.dtype)).reshape(B, T, nkv, hd)
     v = (h1 @ _w(lp, "wv", x.dtype)).reshape(B, T, nkv, hd)
     if rpos is None:
@@ -769,9 +850,13 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
         # full heads before the (column-sharded) wo contraction, then
         # full hidden before the residual add — both exact concats
         o = _tp_allgather(o, tp_axis, 2)
-        x = x + _tp_allgather(o @ _w(lp, "wo", x.dtype), tp_axis, 2)
+    ow = o @ _w(lp, "wo", x.dtype)
+    if ad_l is not None:
+        ow = ow + _lora_delta(o, ad_l[2], ad_l[3], aslot, ascale)
+    if tp_axis is not None:
+        x = x + _tp_allgather(ow, tp_axis, 2)
     else:
-        x = x + o @ _w(lp, "wo", x.dtype)
+        x = x + ow
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     g = jax.nn.silu((h2 @ _w(lp, "wg", x.dtype)).astype(
         jnp.float32)).astype(x.dtype)
@@ -787,7 +872,8 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
                     kstart=None, logits_at=None, logits_all=False,
-                    tp_axis=None, fused=False):
+                    tp_axis=None, fused=False, adapters=None,
+                    adapter_slots=None):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
     (B, V), updated cache). ``logits_at``: optional TRACED row index
     into ``tokens`` — logits are taken there instead of at row T-1
@@ -801,9 +887,16 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     cos, sin = rope_tables(max_len, cfg.hd, cfg.rope_theta)
     quant = "ks" in cache
+    aslot = asc = None
+    if adapters is not None:
+        aslot, asc = _adapter_prep(adapters, adapter_slots, cfg)
 
     def body(carry, layer_in):
         xc = carry
+        layer_in = list(layer_in)
+        ad_l = None
+        if adapters is not None:
+            ad_l, layer_in = layer_in[-4:], layer_in[:-4]
         if quant:
             lp, ck, cv, cks, cvs = layer_in
         else:
@@ -812,13 +905,17 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
         y, nk, nv, nks, nvs = _block_infer(
             xc, lp, ck, cv, pos, cos, sin, cfg, use_kernel=use_kernel,
             rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs,
-            tp_axis=tp_axis, fused=fused)
+            tp_axis=tp_axis, fused=fused, ad_l=ad_l, aslot=aslot,
+            ascale=asc)
         return y, ((nk, nv, nks, nvs) if quant else (nk, nv))
 
-    xs = ((params["layers"], cache["k"], cache["v"], cache["ks"],
-           cache["vs"]) if quant else
-          (params["layers"], cache["k"], cache["v"]))
-    x, new = lax.scan(body, x, xs)
+    xs = [params["layers"], cache["k"], cache["v"]]
+    if quant:
+        xs += [cache["ks"], cache["vs"]]
+    if adapters is not None:
+        xs += [adapters["aq"], adapters["bq"], adapters["ao"],
+               adapters["bo"]]
+    x, new = lax.scan(body, x, tuple(xs))
     new_cache = ({"k": new[0], "v": new[1], "ks": new[2], "vs": new[3]}
                  if quant else {"k": new[0], "v": new[1]})
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)

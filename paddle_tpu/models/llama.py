@@ -320,6 +320,40 @@ def shard_serving_params(params: Dict[str, Any], cfg: LlamaConfig, mesh,
     return placed, specs
 
 
+def adapter_partition_specs(cfg: LlamaConfig, mesh,
+                            axis: Optional[str] = None) -> Dict[str, P]:
+    """Partition specs for an adapter-pool factor dict (ISSUE 14) —
+    the LoRA sibling of :data:`SERVING_TP_RULES`, kept next to them so
+    the column-split bit-identity argument lives in one place.
+
+    The pool arrays are ``(L, slots, in, r)`` ``A`` factors /
+    ``(L, slots, r, out)`` ``B`` factors / ``(slots,)`` scales. ``B``
+    factors shard their OUTPUT axis over tp — the same axis the base
+    ``wq``/``wo`` shard under the "last" rule — while ``A`` factors and
+    scales replicate: each shard then computes its own delta columns
+    ``(x @ A_i) @ B_i[:, local]`` with the full, identically ordered
+    rank-r contraction, so the adapter term is bit-identical to
+    single-chip by the same exact-concat argument as the column-split
+    weights. Validates the same divisibility contract the base rules
+    assume (q width ``nh*hd`` and o width ``hidden`` both divide tp)."""
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"adapter_partition_specs: the serving mesh must be 1-D, "
+            f"got axes {mesh.axis_names}")
+    ax = axis or mesh.axis_names[0]
+    tp = int(mesh.shape[ax])
+    h, dq = cfg.hidden_size, cfg.num_heads * cfg.hd
+    if dq % tp or h % tp:
+        raise ValueError(
+            f"adapter factors cannot column-shard: B-factor output "
+            f"axes (q: {dq}, o: {h}) must divide tp={tp} — the "
+            f"adapter term shards with the base matrices")
+    return {"aq": P(), "ao": P(),
+            "bq": P(None, None, None, ax),
+            "bo": P(None, None, None, ax),
+            "scale": P()}
+
+
 # ---------------- building blocks ----------------
 def _pallas_fused(cfg: "LlamaConfig") -> bool:
     if cfg.fused_kernels == "pallas":

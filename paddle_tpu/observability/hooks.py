@@ -693,6 +693,139 @@ def serving_prefix_promoted(t0_ns: int, pages: int):
                "(demote/persist hits)").inc(pages)
 
 
+# ---------------- multi-tenant adapter plane (ISSUE 14) ----------------
+
+def serving_adapter_slots(used: int, capacity: int, pinned: int):
+    """Adapter-pool residency gauges after a slot mutation: slots
+    holding a loaded adapter, the configured slot capacity, and how
+    many resident adapters are currently pinned by running rows — the
+    occupancy picture the multi-LoRA admission path breathes by."""
+    if not enabled:
+        return
+    _m.gauge("serving_adapter_slots_used",
+             "adapter-pool slots holding a loaded adapter").set(used)
+    _m.gauge("serving_adapter_slots_capacity",
+             "configured adapter-pool slot capacity").set(capacity)
+    _m.gauge("serving_adapter_slots_pinned",
+             "resident adapters pinned by running requests").set(pinned)
+
+
+def serving_adapter_load(t0_ns: int, nbytes: int, promoted: bool):
+    """Close one adapter slot install opened at ``t0_ns``: packed
+    factors written into a pool slot (one donated device program).
+    ``promoted`` splits host-store promotions (the demoted/persisted
+    copy came back) from fresh registry loads — the hit economy of the
+    adapter tier, same shape as the prefix demote/promote pair."""
+    if not t0_ns:
+        return
+    now = time.perf_counter_ns()
+    _record("Serving.adapter_load", t0_ns, now, "UserDefined")
+    if not enabled:
+        return
+    _m.histogram("serving_adapter_load_ms",
+                 "wall milliseconds per adapter slot install",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_adapter_loads_total",
+               "adapter slot installs, by source",
+               ("source",)).labels(
+        "promote" if promoted else "load").inc()
+    _m.counter("serving_adapter_load_bytes_total",
+               "packed factor bytes installed into adapter slots"
+               ).inc(nbytes)
+
+
+def serving_adapter_demoted(nbytes: int):
+    """One cold adapter DEMOTED to the host tier on LRU slot reclaim
+    (CRC-stamped packed bytes; a later admission promotes it back
+    instead of re-reading the registry)."""
+    if not enabled:
+        return
+    _m.counter("serving_adapter_demotions_total",
+               "adapters demoted to the host tier on slot reclaim"
+               ).inc()
+    _m.counter("serving_adapter_demote_bytes_total",
+               "packed factor bytes demoted to the host tier"
+               ).inc(nbytes)
+
+
+def serving_adapter_fallback(site: str):
+    """A corrupt/torn demoted adapter payload failed its CRC before
+    install: the entry quarantined and the admission fell back to a
+    FRESH registry load — counted, never silent (the PR 13 integrity
+    discipline on adapter bytes)."""
+    if not enabled:
+        return
+    _m.counter("serving_adapter_fallbacks_total",
+               "adapter promotions that fell back to a fresh load "
+               "(corrupt/torn payload quarantined)",
+               ("site",)).labels(site).inc()
+
+
+def serving_adapter_gather(nbytes: int):
+    """One adapter-augmented serving forward TRACED: the per-step
+    factor bytes the compiled program gathers out of the adapter pool
+    (per-row A/B slices, all layers). Fires at TRACE time like
+    :func:`serving_tp_allgather` — once per compile, which is exactly
+    the per-step adapter-bandwidth bill of the multi-LoRA path (the
+    PERF_NOTES rank-r bytes/token model reads this)."""
+    if not enabled:
+        return
+    _m.counter("serving_adapter_gather_calls_total",
+               "adapter factor gathers traced into serving programs"
+               ).inc()
+    _m.counter("serving_adapter_gather_bytes_total",
+               "per-step adapter factor bytes gathered by traced "
+               "serving programs").inc(int(nbytes))
+
+
+# ---------------- sampled speculation (ISSUE 14) ----------------
+
+def serving_sample_accept(drafted: int, accepted: int):
+    """One REJECTION-SAMPLED verify commit: drafted/accepted token
+    counters plus the per-step accept-rate histogram — the sampled
+    sibling of ``serving_spec_acceptance_rate`` (temperature>0 rows
+    accept with probability p(draft), so this rate IS the realized
+    1+k·rate speedup multiplier of sampled speculative decode)."""
+    if not enabled:
+        return
+    _m.counter("serving_sample_drafted_total",
+               "draft tokens offered to rejection-sampled acceptance"
+               ).inc(drafted)
+    _m.counter("serving_sample_accepted_total",
+               "draft tokens accepted by rejection sampling"
+               ).inc(accepted)
+    if drafted:
+        _m.histogram("serving_sample_accept_rate",
+                     "accepted/drafted ratio per rejection-sampled "
+                     "verify step",
+                     buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                              0.875, 1.0)).observe(accepted / drafted)
+
+
+# ---------------- constrained decoding (ISSUE 14) ----------------
+
+def serving_constrain(mask_ns: int, violations: int, rows: int):
+    """One constrained decode commit: the host-side mask
+    build/advance latency, the violation-avoided counter (steps where
+    the UNCONSTRAINED argmax was grammar-invalid — each one is an
+    output the mask saved from a parse failure), and the constrained
+    row count."""
+    if not enabled:
+        return
+    _m.histogram("serving_constrain_mask_ms",
+                 "wall milliseconds per step of constraint mask "
+                 "build + DFA advance",
+                 buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+                          5, 10, 25)).observe(mask_ns / 1e6)
+    _m.counter("serving_constrain_violations_avoided_total",
+               "steps whose unconstrained argmax would have violated "
+               "the grammar").inc(violations)
+    _m.counter("serving_constrain_rows_total",
+               "constrained rows advanced through masked sampling"
+               ).inc(rows)
+
+
 # ---------------- fused serving kernels (ISSUE 11) ----------------
 
 def serving_fused_dispatch(kernel: str, bytes_saved: int):

@@ -43,6 +43,24 @@ SLO-aware scheduler.
   (deadline-infeasible submissions shed at the door) and
   :class:`~paddle_tpu.serving.cluster.ClusterAutoscaler` (hysteresis
   scale up/down through the ``retire_replica`` drain path).
+- :mod:`paddle_tpu.serving.adapters` — the multi-tenant adapter plane
+  (ISSUE 14): :class:`AdapterRegistry` (the tenant population's packed
+  q/o LoRA factors), :class:`AdapterPool` (device-resident refcounted
+  slots with LRU reclaim, host-tier demote/promote, rank-bucketed
+  compile keys, tp column-sharded B factors) and the
+  :func:`init_lora` / :func:`merge_lora` reference helpers — one
+  engine serves thousands of fine-tuned variants with the base
+  weights loaded once.
+- :mod:`paddle_tpu.serving.constraints` — grammar/JSON-schema
+  constrained decoding: :class:`TokenDFA` (+ the
+  :func:`dfa_from_sequences` / :func:`dfa_from_regex` /
+  :func:`json_schema_dfa` compilers) applied as per-row logit masks in
+  the engine's sampling step, with :class:`ConstraintState` advancing
+  at commit.
+- sampled speculation (ISSUE 14) lives in
+  :mod:`paddle_tpu.serving.speculative`:
+  :func:`rejection_sample_tokens` lifts spec decode's greedy-only
+  restriction with standard min(1, p/q) rejection sampling.
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -64,6 +82,15 @@ from .resilience import (  # noqa: F401
 from .scheduler import ServingScheduler  # noqa: F401
 from .speculative import (  # noqa: F401
     NgramProposer, Speculator, longest_accepted_prefix,
+    rejection_sample_tokens,
+)
+from .adapters import (  # noqa: F401
+    AdapterPool, AdapterPoolExhausted, AdapterRegistry, init_lora,
+    merge_lora,
+)
+from .constraints import (  # noqa: F401
+    ConstraintState, TokenDFA, dfa_from_regex, dfa_from_sequences,
+    json_schema_dfa,
 )
 from .host_tier import HostPageStore, TieredKVCache  # noqa: F401
 from .router import (  # noqa: F401

@@ -1,0 +1,547 @@
+"""Multi-tenant adapter plane: paged multi-LoRA serving (ISSUE 14).
+
+The PR 2–13 engine serves exactly ONE set of base weights — a deployment
+with thousands of fine-tuned variants would need one engine (one pool of
+HBM, one compiled tower) per variant. This module treats adapters the
+way the paged cache treats KV: a device-resident pool of fixed SLOTS of
+packed low-rank factors, per-row ``adapter_id`` gathered into every
+forward, refcounted residency with LRU reclaim, and a host tier below it
+(the PR 10 :class:`~paddle_tpu.serving.HostPageStore`) that cold
+adapters demote into and promote back from — so one engine serves the
+whole variant population with the base weights loaded once.
+
+Design choices, each load-bearing:
+
+- **q/o-projection adapters only** (``wq`` and ``wo`` grow the
+  ``y += (x @ A_i) @ B_i · α/r`` term). LoRA on ``wk``/``wv`` would make
+  the CACHED KV adapter-dependent, forking every prefix-trie chain,
+  swap payload and prefill→decode handoff per tenant — the whole paged
+  sharing economy keys on tokens alone. q/o adapters leave the KV bytes
+  adapter-agnostic, so prefix sharing, swap-in resume and handoff ride
+  unchanged; registration REJECTS k/v factors loudly.
+- **Slot 0 is the base model**: its factors are exact zeros, so a row
+  with ``adapter_id=0`` adds an exactly-zero term — the adapter-enabled
+  engine is gated BIT-identical to the plain engine on base rows (and
+  an engine constructed without a pool compiles the term out entirely).
+- **One rank bucket per pool**: the pool's ``rank`` is part of every
+  program's compile key (array shapes), so a long-lived server compiles
+  one adapter-augmented program set per rank bucket, not per adapter.
+  Adapters of smaller rank zero-pad into the bucket — padded rank
+  columns contribute exact zeros, so bucketing is parity-free.
+- **Tensor parallel for free**: ``A`` factors replicate (their input is
+  the already-full activation), ``B`` factors column-shard on the same
+  output axis the base matrices shard under ``SERVING_TP_RULES`` — each
+  shard computes its own output columns with the full, identically
+  ordered rank contraction, so tp stays bit-identical by the same
+  argument as the column-split weights (ISSUE 7).
+- **Host tier below the slots**: an LRU-evicted adapter DEMOTES its
+  CRC-stamped packed bytes to the host store (``persist=True`` — the
+  standing on-disk layer survives restarts) and PROMOTES back on the
+  next admission that pins it; a torn/corrupt payload quarantines and
+  falls back to a fresh registry load, counted
+  (``serving_adapter_fallbacks_total``) — the PR 13 integrity
+  discipline, applied to adapter bytes.
+
+Fault sites (ISSUE 8 discipline): ``adapter_load`` fires BEFORE a fresh
+load installs anything, ``adapter_promote`` BEFORE a host-store
+promotion installs anything — a fault at either commits nothing (the
+registry entry / store payload survives for the retried admission), and
+both are chaos-soaked with zero lost/duplicated requests
+(tools/chaos_soak.py).
+
+Consumed by :class:`paddle_tpu.inference.ContinuousBatchingEngine`
+(``adapters=`` knob, per-request ``adapter_id``) with the forward-side
+gather living in :mod:`paddle_tpu.models.generate` (``adapters=`` /
+``adapter_slots=`` on the decode/chunk/verify programs).
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..observability import hooks as _obs
+from .paged_cache import PoolExhausted
+from .resilience import (CorruptionDetected, fault_point,
+                         verify_checksums)
+
+#: the four packed factor arrays of one adapter, in pool order; every
+#: payload, registry entry and device pool carries exactly this set
+FACTOR_NAMES = ("aq", "bq", "ao", "bo")
+
+
+class AdapterPoolExhausted(PoolExhausted):
+    """Every usable adapter slot is pinned by a running request.
+
+    A subclass of :class:`~paddle_tpu.serving.PoolExhausted` on
+    purpose: the engine/scheduler admission paths already treat that as
+    BACK-PRESSURE (defer the admission until running requests retire),
+    which is exactly the right behavior when the contended resource is
+    an adapter slot instead of a KV page."""
+
+
+def _factor_shapes(cfg, rank: int) -> Dict[str, tuple]:
+    """Per-layer packed factor shapes for one adapter at ``rank``."""
+    h, dq = cfg.hidden_size, cfg.num_heads * cfg.hd
+    return {"aq": (cfg.num_layers, h, rank),
+            "bq": (cfg.num_layers, rank, dq),
+            "ao": (cfg.num_layers, dq, rank),
+            "bo": (cfg.num_layers, rank, h)}
+
+
+def init_lora(cfg, rank: int, seed: int = 0, *, alpha: Optional[float] =
+              None, scale: float = 0.02) -> Dict:
+    """Fabricate one random q/o LoRA adapter (tests / bench / soak):
+    per-layer stacked ``A`` factors are small gaussians and ``B``
+    factors likewise (a NONZERO B, unlike training-style init — a zero
+    delta would make every parity gate vacuous). Returns the registry
+    entry shape :meth:`AdapterRegistry.register` accepts."""
+    rs = np.random.RandomState(seed)
+    out = {name: (rs.standard_normal(shape) * scale).astype(np.float32)
+           for name, shape in _factor_shapes(cfg, rank).items()}
+    out["alpha"] = float(alpha if alpha is not None else rank)
+    return out
+
+
+def merge_lora(params: Dict, cfg, adapter: Dict) -> Dict:
+    """Dense-merge one adapter into a COPY of the base param tree:
+    ``wq += A_q @ B_q · α/r`` and ``wo += A_o @ B_o · α/r`` — the
+    per-request single-model reference the multi-adapter batch gate is
+    judged against (tests/test_adapters.py), and the bench tier's
+    "single merged model" baseline. Only unquantized trees merge (a
+    quantized matrix would need requantization — the engine applies
+    adapters as a separate term precisely so low-bit weights never
+    do)."""
+    layers = dict(params["layers"])
+    if "wq_scale" in layers:
+        raise ValueError(
+            "merge_lora: cannot dense-merge into quantized weights — "
+            "merge into the fp tree before quantize_weights, or serve "
+            "the adapter through the AdapterPool term")
+    sc = float(adapter["alpha"]) / adapter["aq"].shape[-1]
+    dt = layers["wq"].dtype
+    import jax.numpy as jnp
+    dq = jnp.einsum("lhr,lro->lho", jnp.asarray(adapter["aq"]),
+                    jnp.asarray(adapter["bq"])) * sc
+    do = jnp.einsum("lhr,lro->lho", jnp.asarray(adapter["ao"]),
+                    jnp.asarray(adapter["bo"])) * sc
+    layers["wq"] = (layers["wq"].astype(jnp.float32)
+                    + dq).astype(dt)
+    layers["wo"] = (layers["wo"].astype(jnp.float32)
+                    + do).astype(dt)
+    return {**params, "layers": layers}
+
+
+class AdapterRegistry:
+    """Host-side source of truth: ``adapter_id -> packed factors``.
+
+    Shared read-mostly across engines/replicas (the cluster's replicas
+    each own device SLOTS, but one registry describes the tenant
+    population). Registration validates shapes loudly — and rejects
+    k/v-projection factors by construction (only q/o names exist)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._entries: Dict[int, Dict] = {}
+
+    def register(self, adapter_id: int, factors: Dict) -> None:
+        """Install ``factors`` (the :func:`init_lora` shape: the four
+        per-layer stacked arrays + ``alpha``) under ``adapter_id``.
+        Id 0 is the reserved base-model no-op and cannot be
+        registered."""
+        aid = int(adapter_id)
+        if aid <= 0:
+            raise ValueError(
+                f"adapter_id {aid} is reserved (0 = the base model); "
+                f"register adapters at ids >= 1")
+        unknown = set(factors) - set(FACTOR_NAMES) - {"alpha"}
+        if unknown:
+            raise ValueError(
+                f"register: unknown factor(s) {sorted(unknown)} — only "
+                f"q/o-projection adapters are servable ({FACTOR_NAMES}); "
+                f"k/v factors would fork the cached KV per tenant")
+        missing = set(FACTOR_NAMES) - set(factors)
+        if missing:
+            raise ValueError(f"register: missing factor(s) "
+                             f"{sorted(missing)}")
+        rank = int(factors["aq"].shape[-1])
+        want = _factor_shapes(self.cfg, rank)
+        packed = {}
+        for name in FACTOR_NAMES:
+            a = np.asarray(factors[name], np.float32)
+            if tuple(a.shape) != want[name]:
+                raise ValueError(
+                    f"register: {name} shape {tuple(a.shape)} != "
+                    f"{want[name]} (rank inferred from aq: {rank})")
+            packed[name] = a
+        packed["alpha"] = float(factors.get("alpha", rank))
+        packed["rank"] = rank
+        self._entries[aid] = packed
+
+    def get(self, adapter_id: int) -> Optional[Dict]:
+        return self._entries.get(int(adapter_id))
+
+    def __contains__(self, adapter_id) -> bool:
+        return int(adapter_id) in self._entries
+
+    def ids(self):
+        return sorted(self._entries)
+
+
+class AdapterPool:
+    """Device-resident slots of packed per-layer LoRA factors, paged
+    like KV (ISSUE 14 tentpole).
+
+    ``slots`` counts USABLE adapter slots; slot 0 is additionally
+    reserved as the base-model no-op (exact zeros), so the device
+    arrays hold ``slots + 1`` entries. ``rank`` is the pool's rank
+    bucket (the compile key — smaller-rank adapters zero-pad into it).
+    ``registry`` is the shared :class:`AdapterRegistry`; ``store`` an
+    optional :class:`~paddle_tpu.serving.HostPageStore` the pool
+    demotes cold adapters into (and, when the store has a disk path,
+    persists them across restarts). ``mesh`` builds the pool for a 1-D
+    tp serving mesh: ``B`` factors column-shard on their output axis
+    (the same axis the base matrices shard), ``A`` factors and scales
+    replicate — ``specs`` carries the shard_map in_specs.
+
+    Residency protocol (the KV-page idiom, applied to adapters):
+    :meth:`acquire` pins one reference per RUNNING row (concurrent rows
+    sharing an adapter pin the same slot — one copy in HBM no matter
+    how many rows use it), :meth:`release` drops it, and an admission
+    that needs a non-resident adapter reclaims the LRU UNPINNED slot
+    (demoting its bytes to the host tier first). When every slot is
+    pinned the admission defers with :class:`AdapterPoolExhausted`
+    (back-pressure, not failure). All bookkeeping is host-side; the
+    only device work is one donated slot-write program per load."""
+
+    def __init__(self, cfg, *, slots: int = 8, rank: int = 8,
+                 registry: Optional[AdapterRegistry] = None,
+                 store=None, mesh=None, dtype=None):
+        import jax
+        import jax.numpy as jnp
+        if slots < 1:
+            raise ValueError(f"AdapterPool: slots={slots} must be >= 1")
+        if rank < 1:
+            raise ValueError(f"AdapterPool: rank={rank} must be >= 1")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.rank = int(rank)
+        self.registry = (registry if registry is not None
+                         else AdapterRegistry(cfg))
+        self.store = store
+        self.mesh = mesh
+        self.dtype = dtype or cfg.dtype
+        S = self.slots + 1                        # + the base slot 0
+        shapes = _factor_shapes(cfg, self.rank)
+        self.arrays: Dict = {
+            name: jnp.zeros((shp[0], S) + shp[1:], self.dtype)
+            for name, shp in shapes.items()}
+        self.arrays["scale"] = jnp.zeros((S,), jnp.float32)
+        self.specs = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from ..models.llama import adapter_partition_specs
+            # B factors shard on the OUTPUT axis (the same axis the
+            # base wq/wo shard under SERVING_TP_RULES); A + scales
+            # replicate — so each shard's delta columns are computed
+            # with the full rank contraction, bit-identical to
+            # single-chip by the column-split argument (ISSUE 7); the
+            # spec derivation + divisibility gate live next to the
+            # base rules in models/llama.py
+            self.specs = adapter_partition_specs(cfg, mesh)
+            self.arrays = {
+                n: jax.device_put(a, NamedSharding(mesh, self.specs[n]))
+                for n, a in self.arrays.items()}
+        # host bookkeeping: aid -> slot / pins, LRU recency (OrderedDict
+        # order), and the packed host copy of each RESIDENT adapter
+        # (what demotion writes — no device gather needed)
+        self._slot_of: Dict[int, int] = {}
+        self._pins: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._packed: Dict[int, Dict] = {}
+        self._install_fn = None
+        self.loads_total = 0
+        self.load_bytes_total = 0
+        self.demotions_total = 0
+        self.demote_bytes_total = 0
+        self.promotions_total = 0
+        self.evictions_total = 0
+        self.slot_hits_total = 0
+        self.fallbacks_total = 0
+
+    # ---- residency queries ----
+    def slot_of(self, adapter_id: int) -> int:
+        """The POOL slot currently holding ``adapter_id`` (0 for the
+        base model). Valid only while the adapter is pinned — the
+        engine mirrors it into its per-row slot array at seating."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return 0
+        slot = self._slot_of.get(aid)
+        if slot is None:
+            raise KeyError(f"adapter {aid} is not resident")
+        return slot
+
+    def resident(self, adapter_id: int) -> bool:
+        return int(adapter_id) == 0 or int(adapter_id) in self._slot_of
+
+    def pins(self, adapter_id: int) -> int:
+        return self._pins.get(int(adapter_id), 0)
+
+    def validate_id(self, adapter_id: int) -> None:
+        """Reject an UNRESOLVABLE ``adapter_id`` at request intake —
+        an id that is neither resident, registered, nor demoted to the
+        host store (or whose rank exceeds the pool bucket) would
+        otherwise queue, then raise at ADMISSION inside the serving
+        loop, where the error poisons every tenant's step and every
+        recovery re-admission instead of just this request. Stat-only:
+        no load, promote or pin happens here."""
+        aid = int(adapter_id)
+        if aid == 0 or aid in self._slot_of:
+            return
+        src = self.registry.get(aid)
+        if src is not None:
+            if src["rank"] > self.rank:
+                raise ValueError(
+                    f"adapter {aid} rank {src['rank']} exceeds the "
+                    f"pool's rank bucket {self.rank} — build the pool "
+                    f"with rank >= the largest registered adapter")
+            return
+        if self.store is not None and self.store.contains(
+                self._store_key(aid)):
+            return
+        raise ValueError(
+            f"adapter {aid} is neither registered nor present in the "
+            f"host store — register it before submitting requests "
+            f"that reference it")
+
+    @property
+    def used_slots(self) -> int:
+        return len(self._slot_of)
+
+    def slot_available(self) -> bool:
+        """True when an :meth:`acquire` needing a NEW slot could
+        succeed right now: a free slot exists or some resident adapter
+        is unpinned (LRU-reclaimable). Stat-only — the scheduler's
+        admission-feasibility probe."""
+        if self.used_slots < self.slots:
+            return True
+        return any(self._pins.get(aid, 0) == 0 for aid in self._slot_of)
+
+    # ---- acquire / release (the per-request pin protocol) ----
+    def acquire(self, adapter_id: int) -> int:
+        """Pin ``adapter_id`` for one running row and return its pool
+        slot. Resident adapters pin in place (a slot hit — concurrent
+        rows share the one copy); non-resident ones load into a free
+        slot, reclaiming the LRU UNPINNED slot (demote-first) when the
+        pool is full. Raises :class:`AdapterPoolExhausted` when every
+        slot is pinned (admission back-pressure) and ``KeyError`` when
+        the adapter is known to neither the registry nor the host
+        store. A fault at the load/promote site commits nothing — the
+        retried admission finds the same sources intact."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return 0
+        if aid in self._slot_of:
+            self._pins[aid] = self._pins.get(aid, 0) + 1
+            self._lru.move_to_end(aid)
+            self.slot_hits_total += 1
+            return self._slot_of[aid]
+        slot = self._free_slot()
+        packed = self._fetch_packed(aid)
+        self._install(slot, aid, packed)
+        self._pins[aid] = self._pins.get(aid, 0) + 1
+        return slot
+
+    def release(self, adapter_id: int) -> None:
+        """Drop one pin; the slot stays resident (warm) until LRU
+        reclaim needs it. Safe on the base id and on already-zero
+        pins (idempotent retirement paths)."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return
+        n = self._pins.get(aid, 0)
+        if n > 0:
+            self._pins[aid] = n - 1
+
+    def reset_pins(self) -> None:
+        """Zero every pin — the supervisor-rebuild hook: recovery
+        re-admits every journaled session through :meth:`acquire`, so
+        stale pins from the poisoned engine must not leak slots."""
+        self._pins = {}
+
+    # ---- slot lifecycle ----
+    def _free_slot(self) -> int:
+        taken = set(self._slot_of.values())
+        for s in range(1, self.slots + 1):
+            if s not in taken:
+                return s
+        # LRU reclaim among UNPINNED residents; demote before the
+        # reference drops so the bytes survive in the host tier
+        for aid in list(self._lru):
+            if self._pins.get(aid, 0) == 0:
+                return self._evict(aid)
+        raise AdapterPoolExhausted(
+            f"all {self.slots} adapter slots are pinned by running "
+            f"requests; the admission defers until one retires")
+
+    def _evict(self, aid: int) -> int:
+        slot = self._slot_of.pop(aid)
+        self._lru.pop(aid, None)
+        self._pins.pop(aid, None)
+        packed = self._packed.pop(aid, None)
+        if self.store is not None and packed is not None:
+            entry = self.store.put(
+                self._store_key(aid),
+                {n: packed[n] for n in FACTOR_NAMES},
+                extra={"alpha": packed["alpha"], "rank": packed["rank"],
+                       "adapter_id": aid},
+                persist=True)
+            self.demote_bytes_total += entry["bytes"]
+            self.demotions_total += 1
+            _obs.serving_adapter_demoted(entry["bytes"])
+        self.evictions_total += 1
+        # the vacated slot's device factors are stale garbage until the
+        # next install overwrites the WHOLE slot row — and no row
+        # gathers a slot the host books don't map, the same contract
+        # freed KV pages rely on
+        return slot
+
+    @staticmethod
+    def _store_key(aid: int) -> bytes:
+        # bytes key => eligible for the store's standing on-disk layer
+        return f"adapter/{int(aid)}".encode()
+
+    def _fetch_packed(self, aid: int) -> Dict:
+        """Resolve ``aid``'s packed factors: host-store promotion first
+        (the demoted/persisted copy — CRC-verified before anything
+        installs; corrupt/torn payloads quarantine and fall back), then
+        a fresh registry load. The fault sites fire BEFORE any
+        install-side mutation."""
+        if self.store is not None:
+            entry = self.store.get(self._store_key(aid))
+            if entry is not None:
+                try:
+                    verify_checksums(entry["arrays"],
+                                     entry.get("checksums"),
+                                     "adapter_promote")
+                    packed = self._decode_entry(entry)
+                    fault_point("adapter_promote")
+                    self.promotions_total += 1
+                    packed["promoted"] = True
+                    return packed
+                except CorruptionDetected:
+                    # torn/corrupt demoted payload: quarantine (never
+                    # re-served) and fall back to a FRESH load from the
+                    # registry — counted, never silent
+                    self.store.quarantine(self._store_key(aid),
+                                          "adapter_promote")
+                    self.fallbacks_total += 1
+                    _obs.serving_adapter_fallback("adapter_promote")
+        src = self.registry.get(aid)
+        if src is None:
+            raise KeyError(
+                f"adapter {aid} is neither registered nor present in "
+                f"the host store — register it before submitting "
+                f"requests that reference it")
+        if src["rank"] > self.rank:
+            raise ValueError(
+                f"adapter {aid} rank {src['rank']} exceeds the pool's "
+                f"rank bucket {self.rank} — build the pool with rank "
+                f">= the largest registered adapter")
+        fault_point("adapter_load")
+        return {**{n: src[n] for n in FACTOR_NAMES},
+                "alpha": src["alpha"], "rank": src["rank"],
+                "promoted": False}
+
+    def _decode_entry(self, entry: Dict) -> Dict:
+        from .host_tier import HostPageStore
+        arrays = HostPageStore.decode(entry)
+        want = _factor_shapes(self.cfg, int(entry["extra"]["rank"]))
+        for name in FACTOR_NAMES:
+            if tuple(arrays[name].shape) != want[name]:
+                raise CorruptionDetected(
+                    "adapter_promote",
+                    f"adapter payload {name} shape "
+                    f"{tuple(arrays[name].shape)} != {want[name]}")
+        return {**{n: arrays[n] for n in FACTOR_NAMES},
+                "alpha": float(entry["extra"]["alpha"]),
+                "rank": int(entry["extra"]["rank"]),
+                "promoted": True}
+
+    def _install(self, slot: int, aid: int, packed: Dict) -> None:
+        """Write one adapter's factors into ``slot`` (zero-padded to
+        the pool rank) as ONE donated device program, then commit the
+        host books. Factor bytes + the α/r scale land together; the
+        write covers the whole slot row, so a previously evicted
+        tenant's stale factors are fully overwritten."""
+        import jax
+        import jax.numpy as jnp
+        t0 = _obs.generate_begin()
+        r = int(packed["rank"])
+        vals = {}
+        nbytes = 0
+        for name, shp in _factor_shapes(self.cfg, self.rank).items():
+            full = np.zeros(shp, np.float32)
+            src = np.asarray(packed[name], np.float32)
+            if name in ("aq", "ao"):
+                full[:, :, :r] = src
+            else:
+                full[:, :r, :] = src
+            vals[name] = full
+            nbytes += src.nbytes
+        scale = np.float32(packed["alpha"] / max(r, 1))
+        if self._install_fn is None:
+            def f(arrays, slot_i, vq, vbq, vao, vbo, sc):
+                out = {n: arrays[n].at[:, slot_i].set(
+                    v.astype(arrays[n].dtype))
+                    for n, v in (("aq", vq), ("bq", vbq),
+                                 ("ao", vao), ("bo", vbo))}
+                out["scale"] = arrays["scale"].at[slot_i].set(sc)
+                return out
+            kw = {}
+            if self.mesh is not None:
+                # keep the B factors' column sharding through the
+                # donated update (the _scatter_pages reasoning)
+                from jax.sharding import NamedSharding
+                kw["out_shardings"] = {
+                    n: NamedSharding(self.mesh, self.specs[n])
+                    for n in self.arrays}
+            self._install_fn = jax.jit(f, donate_argnums=(0,), **kw)
+        self.arrays = self._install_fn(
+            self.arrays, jnp.int32(slot), jnp.asarray(vals["aq"]),
+            jnp.asarray(vals["bq"]), jnp.asarray(vals["ao"]),
+            jnp.asarray(vals["bo"]), jnp.float32(scale))
+        self._slot_of[aid] = slot
+        self._lru[aid] = None
+        self._lru.move_to_end(aid)
+        self._packed[aid] = {**{n: np.asarray(packed[n], np.float32)
+                                for n in FACTOR_NAMES},
+                             "alpha": float(packed["alpha"]),
+                             "rank": r}
+        self.loads_total += 1
+        self.load_bytes_total += nbytes
+        _obs.serving_adapter_load(t0, nbytes,
+                                  promoted=bool(packed.get("promoted")))
+        self._publish()
+
+    def _publish(self):
+        pinned = sum(1 for n in self._pins.values() if n > 0)
+        _obs.serving_adapter_slots(self.used_slots, self.slots, pinned)
+
+    def stats(self) -> Dict:
+        return {
+            "adapter_slots": self.slots,
+            "adapter_slots_used": self.used_slots,
+            "adapter_rank": self.rank,
+            "adapter_loads_total": self.loads_total,
+            "adapter_load_bytes_total": self.load_bytes_total,
+            "adapter_slot_hits_total": self.slot_hits_total,
+            "adapter_evictions_total": self.evictions_total,
+            "adapter_demotions_total": self.demotions_total,
+            "adapter_demote_bytes_total": self.demote_bytes_total,
+            "adapter_promotions_total": self.promotions_total,
+            "adapter_fallbacks_total": self.fallbacks_total,
+        }

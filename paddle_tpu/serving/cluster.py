@@ -325,15 +325,26 @@ class ServingCluster:
     # ---- intake ----
     def submit(self, prompt, max_new_tokens: int = 16, *,
                tenant: str = "default", priority=Priority.NORMAL,
-               deadline_s: Optional[float] = None, eos_token_id=None):
+               deadline_s: Optional[float] = None, eos_token_id=None,
+               adapter_id: int = 0, constraint=None):
         """Queue a prompt for routed dispatch. The handle fills in as
         cluster steps run, exactly like a single engine's. Over-quota
         tenants get an immediate ``rejected_ratelimit``; everything
-        else dispatches on the next :meth:`step` in fair-share order."""
+        else dispatches on the next :meth:`step` in fair-share order.
+
+        ``adapter_id`` (ISSUE 14): the request's LoRA variant — every
+        replica must have been built with an adapter pool over a
+        SHARED registry (the factory closes over one
+        :class:`~paddle_tpu.serving.adapters.AdapterRegistry`), so any
+        replica can load the adapter and the router is free to place
+        by affinity. ``constraint``: a per-request grammar
+        (``constraints=True`` engines)."""
         eng = self.replicas[self._first_alive()].engine
         eng._next_rid = max(eng._next_rid, self._next_rid)
         req = eng.create_request(prompt, max_new_tokens=max_new_tokens,
-                                 eos_token_id=eos_token_id)
+                                 eos_token_id=eos_token_id,
+                                 adapter_id=adapter_id,
+                                 constraint=constraint)
         self._next_rid = eng._next_rid
         req.priority = int(priority)
         cost = req.prompt.shape[1] + req.max_new_tokens
@@ -438,7 +449,9 @@ class ServingCluster:
         loads = self._alive(role) or self._alive(
             range(len(self.replicas)))
         key = self.router.affinity_key(req.prompt[0])
-        idx, hit = self.router.pick_replica(key, loads)
+        akey = self.router.adapter_key(getattr(req, "adapter_id", 0))
+        idx, hit = self.router.pick_replica(key, loads,
+                                            adapter_key=akey)
         self.replicas[idx].submit_request(req)
         self.router.note_dispatch(idx, hit, tenant)
         self._owner[req.rid] = idx

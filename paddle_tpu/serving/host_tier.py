@@ -111,8 +111,11 @@ class HostPageStore:
     """Host-numpy page pool: the RAM (+ optional disk) tier below HBM.
 
     Entries are keyed by an arbitrary hashable key — the tiered cache
-    uses ``("swap", rid)`` for swapped-out requests and the raw token
-    bytes of a chain prefix for demoted/persisted trie pages — and hold
+    uses ``("swap", rid)`` for swapped-out requests, the raw token
+    bytes of a chain prefix for demoted/persisted trie pages, and the
+    adapter plane (ISSUE 14) ``b"adapter/<id>"`` for LoRA factors
+    demoted on slot reclaim (:class:`~paddle_tpu.serving.adapters.
+    AdapterPool`) — and hold
     raw-uint8 array payloads with dtype/shape metadata (the
     ``export_request`` byte convention: extension dtypes like bf16
     round-trip exactly). ``capacity_pages`` LRU-bounds RAM residency;

@@ -90,7 +90,14 @@ from .policy import FinishReason, Priority
 #: runtime (ISSUE 12) opens between launch and host-state commit
 ENGINE_SITES = ("alloc", "free", "decode_step", "prefill_chunk",
                 "verify_step", "transfer", "sched_tick", "swap_out",
-                "swap_in", "dispatch", "commit")
+                "swap_in", "dispatch", "commit",
+                # adapter plane, ISSUE 14 — both fire BEFORE anything
+                # installs: a fresh registry load / a host-store
+                # promotion that faults commits nothing, and the
+                # retried admission finds the same sources intact.
+                # NB keep this comment paren-free: check_fault_sites
+                # parses the tuple with a non-greedy paren match
+                "adapter_load", "adapter_promote")
 
 #: cluster-plane sites (ISSUE 13): the prefill→decode handoff's two
 #: byte-moving halves and the autoscaler's control tick. They only
@@ -375,7 +382,7 @@ class JournalEntry:
     __slots__ = ("req", "rid", "prompt", "max_new_tokens",
                  "eos_token_id", "priority", "deadline_at",
                  "submitted_at", "tokens", "admitted", "preemptions",
-                 "swapped")
+                 "swapped", "adapter_id", "constrained")
 
     def __init__(self, req):
         self.req = req
@@ -390,6 +397,16 @@ class JournalEntry:
         self.admitted = False
         self.preemptions = int(req.preemptions)
         self.swapped = False
+        # the LoRA variant serving this request (ISSUE 14): journaled
+        # so recovery/restore re-admissions re-pin the same adapter
+        # (the handle carries it in-process; the drain record needs it
+        # explicitly). Grammar-constraint STATE rides the live handle
+        # only — a drain checkpoint does not serialize host DFA
+        # objects, so constrained requests must finish before a drain
+        # (drain() refuses while any are live; the flag is how it
+        # knows).
+        self.adapter_id = int(getattr(req, "adapter_id", 0))
+        self.constrained = getattr(req, "constraint", None) is not None
 
     def as_record(self, now: Optional[float] = None) -> Dict:
         """JSON-able checkpoint record (drain/restore). Deadlines are
@@ -410,7 +427,8 @@ class JournalEntry:
                 "tokens": list(self.tokens),
                 "admitted": self.admitted,
                 "preemptions": self.preemptions,
-                "swapped": self.swapped}
+                "swapped": self.swapped,
+                "adapter_id": self.adapter_id}
 
 
 class RequestJournal:
@@ -694,6 +712,15 @@ class EngineSupervisor:
             # the standing prefix store) carry into the rebuilt engine
             # and recovery SWAPS them in instead of replaying
             eng.cache.adopt_host_tier(old.cache)
+        pool = getattr(eng, "adapters", None)
+        if (pool is not None and old is not None
+                and getattr(old, "adapters", None) is pool):
+            # the adapter pool rode across the rebuild (the factory
+            # closes over one pool, the usual shape): stale pins from
+            # the poisoned engine's rows must not leak slots — recovery
+            # re-admits every journaled session through acquire(),
+            # which re-pins exactly the live set
+            pool.reset_pins()
         if self._key_data is not None:
             import jax
             import jax.numpy as jnp
@@ -771,7 +798,8 @@ class EngineSupervisor:
     # ---- intake ----
     def submit(self, prompt, max_new_tokens: int = 16, *,
                priority=Priority.NORMAL,
-               deadline_s: Optional[float] = None, eos_token_id=None):
+               deadline_s: Optional[float] = None, eos_token_id=None,
+               adapter_id: int = 0, constraint=None):
         """Journaled submit (write-ahead: the admission params are on
         the journal before anything can execute). At degraded level 3
         (``shed_low``) LOW-priority requests are rejected immediately
@@ -780,7 +808,8 @@ class EngineSupervisor:
         self._check_alive()
         req = self.engine.create_request(
             prompt, max_new_tokens=max_new_tokens,
-            eos_token_id=eos_token_id)
+            eos_token_id=eos_token_id, adapter_id=adapter_id,
+            constraint=constraint)
         req.priority = int(priority)
         self._next_rid = self.engine._next_rid
         return self.submit_request(req, deadline_s=deadline_s)
@@ -974,8 +1003,22 @@ class EngineSupervisor:
         (structure + page KV bytes), the PRNG key snapshot and the
         engine geometry for restore-time validation. The supervisor is
         frozen afterwards (submit/step raise) — restore the file into a
-        fresh process via :meth:`restore`. Returns a summary dict."""
+        fresh process via :meth:`restore`. Returns a summary dict.
+
+        Refuses (loudly, leaving the supervisor serving) while any live
+        session carries a grammar constraint: the checkpoint does not
+        serialize host DFA objects, so restoring such a session would
+        silently finish it UNCONSTRAINED — let constrained requests
+        finish (or cancel them) before draining."""
         self._check_alive()
+        constrained = [e.rid for e in self.journal.live_entries()
+                       if getattr(e, "constrained", False)]
+        if constrained:
+            raise RuntimeError(
+                f"drain: live session(s) {constrained} carry grammar "
+                f"constraints, which a drain checkpoint cannot "
+                f"serialize — restoring them would decode "
+                f"unconstrained. Let them finish or cancel them first")
         t0 = _obs.generate_begin()
         # the overlapped runtime (ISSUE 12) may hold a dispatched-but-
         # uncommitted step: commit it so sessions checkpoint with every
@@ -1078,6 +1121,7 @@ class EngineSupervisor:
                 rec["rid"], np.asarray(rec["prompt"], np.int32),
                 rec["max_new_tokens"], rec["eos_token_id"])
             req.priority = rec["priority"]
+            req.adapter_id = int(rec.get("adapter_id", 0))
             if rec.get("deadline_remaining_s") is not None:
                 # re-anchor the SLO on THIS process's clock (the
                 # checkpoint stores remaining seconds, not monotonic

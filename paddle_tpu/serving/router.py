@@ -159,6 +159,8 @@ class ClusterRouter:
         self.dispatches_total = 0
         self.affinity_hits = 0
         self.affinity_misses = 0
+        self.adapter_affinity_hits = 0
+        self.adapter_affinity_misses = 0
         self.retries_total = 0
         self.retry_exhausted_total = 0
         self.ratelimited_total = 0
@@ -175,6 +177,22 @@ class ClusterRouter:
         if k < 1:
             return None
         return prompt[:k * self.page_size].tobytes()
+
+    @staticmethod
+    def adapter_key(adapter_id) -> Optional[bytes]:
+        """The adapter-affinity dispatch key (ISSUE 14): requests of
+        the same LoRA variant bind to the replica whose pool already
+        holds its slot — a repeat dispatch costs zero adapter
+        load/promote bytes, the slot-residency sibling of prefix
+        affinity. None for the base model (every replica serves it for
+        free). The ``adapter:/`` namespace keeps these keys disjoint
+        from prompt-prefix keys in practice: a prefix key is a raw
+        little-endian int32 token record whose every 4th byte is a
+        token's high byte — zero at real vocab sizes, never ASCII."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return None
+        return b"adapter:/" + str(aid).encode()
 
     def drop_replica(self, idx: int) -> int:
         """Forget every affinity binding to ``idx`` (its trie died with
@@ -199,12 +217,19 @@ class ClusterRouter:
                 load.get("pool_occupancy", 0.0))
 
     def pick_replica(self, key: Optional[bytes], loads: Dict[int, Dict],
-                     exclude: Sequence[int] = ()) -> Tuple[int, bool]:
+                     exclude: Sequence[int] = (),
+                     adapter_key: Optional[bytes] = None
+                     ) -> Tuple[int, bool]:
         """Choose a replica from ``loads`` (idx -> load_stats snapshot
         of the ALIVE candidates): the affinity binding for ``key`` when
-        it points at a candidate, else the healthiest/least-loaded
-        (which then becomes the binding). Returns ``(idx,
-        affinity_hit)``."""
+        it points at a candidate, else — for an adapter request — the
+        binding for ``adapter_key`` (ISSUE 14: the replica whose pool
+        already holds the LoRA slot), else the healthiest/least-loaded
+        (which then becomes the binding for BOTH keys). Prefix affinity
+        outranks adapter affinity: a prefix hit saves ``O(prefix
+        tokens)`` of prefill, an adapter hit one ``O(rank·hidden)``
+        factor load. Returns ``(idx, affinity_hit)`` — the flag counts
+        prefix hits only; adapter hits have their own counters."""
         cands = {i: s for i, s in loads.items() if i not in set(exclude)}
         if not cands:
             raise ValueError("pick_replica: no eligible replicas")
@@ -214,14 +239,33 @@ class ClusterRouter:
                 del self._affinity[key]         # LRU touch: move to
                 self._affinity[key] = bound     # the recent end
                 self.affinity_hits += 1
+                if adapter_key is not None:
+                    self._bind(adapter_key, bound)
                 return bound, True
+        if adapter_key is not None:
+            bound = self._affinity.get(adapter_key)
+            if bound in cands:
+                del self._affinity[adapter_key]
+                self._affinity[adapter_key] = bound
+                self.adapter_affinity_hits += 1
+                if key is not None:
+                    self._bind(key, bound)
+                return bound, False
         idx = min(cands, key=lambda i: self._score(cands[i]) + (i,))
         if key is not None:
-            while len(self._affinity) >= self.max_bindings:
-                self._affinity.pop(next(iter(self._affinity)))
-            self._affinity[key] = idx
+            self._bind(key, idx)
             self.affinity_misses += 1
+        if adapter_key is not None:
+            self._bind(adapter_key, idx)
+            self.adapter_affinity_misses += 1
         return idx, False
+
+    def _bind(self, key: bytes, idx: int) -> None:
+        """(Re)bind ``key`` to ``idx`` under the LRU bound."""
+        self._affinity.pop(key, None)
+        while len(self._affinity) >= self.max_bindings:
+            self._affinity.pop(next(iter(self._affinity)))
+        self._affinity[key] = idx
 
     # ---- accounting ----
     def admit_rate_limit(self, tenant: str, cost: int) -> bool:
@@ -303,6 +347,8 @@ class ClusterRouter:
             "affinity_hit_rate": (self.affinity_hits / total
                                   if total else 0.0),
             "affinity_bindings": len(self._affinity),
+            "adapter_affinity_hits": self.adapter_affinity_hits,
+            "adapter_affinity_misses": self.adapter_affinity_misses,
             "retries_total": self.retries_total,
             "retry_exhausted_total": self.retry_exhausted_total,
             "ratelimited_total": self.ratelimited_total,

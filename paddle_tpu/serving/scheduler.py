@@ -50,6 +50,7 @@ from typing import Callable, Deque, Dict, Optional
 import numpy as np
 
 from ..observability import hooks as _obs
+from .adapters import AdapterPoolExhausted
 from .paged_cache import PoolExhausted
 from .policy import (FinishReason, PreemptionPolicy, Priority, StepPlan,
                      TokenBudgetPlanner)
@@ -140,15 +141,23 @@ class ServingScheduler:
     # ---- intake ----
     def submit(self, prompt, max_new_tokens: int = 16, *,
                priority=Priority.NORMAL,
-               deadline_s: Optional[float] = None, eos_token_id=None):
+               deadline_s: Optional[float] = None, eos_token_id=None,
+               adapter_id: int = 0, constraint=None):
         """Queue a prompt with a priority class and an optional
         admission deadline (seconds from now; a request still queued
         when it lapses is cancelled with ``deadline_exceeded``).
         Returns the request handle (``.done`` / ``.tokens`` /
-        ``.output`` / ``.finish_reason`` fill in as steps run)."""
+        ``.output`` / ``.finish_reason`` fill in as steps run).
+
+        ``adapter_id`` / ``constraint`` (ISSUE 14) pass through to the
+        engine's request intake; an admission whose adapter slot pool
+        is fully pinned defers exactly like one the page pool can't
+        cover (:class:`~paddle_tpu.serving.adapters.
+        AdapterPoolExhausted` is a :class:`PoolExhausted`)."""
         req = self.engine.create_request(
             prompt, max_new_tokens=max_new_tokens,
-            eos_token_id=eos_token_id)
+            eos_token_id=eos_token_id, adapter_id=adapter_id,
+            constraint=constraint)
         req.priority = int(priority)
         req.submitted_at = req.enqueued_at = self.clock()
         if deadline_s is not None:
@@ -229,16 +238,20 @@ class ServingScheduler:
                     req, FinishReason.DEADLINE_EXCEEDED.value)
                 self.deadline_cancels_total += 1
 
-    def _preempt_for(self, req) -> bool:
+    def _preempt_for(self, req, candidates=None) -> bool:
         """Evict one strictly-lower-class running request to make room
         for ``req``; the victim requeues at the FRONT of its class (it
         already waited its turn once). Under the host tier (ISSUE 10)
         the policy PREFERS victims whose eviction swaps to host RAM
         (near-free swap-in resume) over mid-prefill victims that would
-        pay a replay. Returns False when no eligible victim exists."""
+        pay a replay. ``candidates`` restricts the victim set (the
+        adapter-slot shortfall path: only victims that pin a slot can
+        relieve it). Returns False when no eligible victim exists."""
         if self.preemption is None:
             return False
         running = self.engine.running_requests()
+        if candidates is not None:
+            running = [r for r in running if r in candidates]
         victim = self.preemption.pick_victim(
             running, req.priority,
             swappable=getattr(self.engine, "swap_candidate", None))
@@ -268,9 +281,27 @@ class ServingScheduler:
         need = cache.pages_for(req.prompt.shape[1] + req.max_new_tokens)
         return need <= cache.allocator.num_usable - len(pinned)
 
+    def _adapter_feasible(self, req) -> bool:
+        """Can ``req``'s adapter be seated AT ALL right now? False
+        when the pool needs a new slot, none is free or reclaimable,
+        and no strictly-lower-class running request pins one — in that
+        state every preemption (seat- or page-motivated) is pointless,
+        so the admission defers with zero casualties."""
+        aid = getattr(req, "adapter_id", 0)
+        pool = getattr(self.engine, "adapters", None)
+        if not aid or pool is None or pool.resident(aid):
+            return True                 # base row / pin-in-place hit
+        if pool.slot_available():
+            return True
+        return any(getattr(r, "adapter_id", 0) != 0
+                   and int(r.priority) > int(req.priority)
+                   for r in self.engine.running_requests())
+
     def _admit_one(self, req) -> bool:
         eng = self.engine
         while True:
+            if not self._adapter_feasible(req):
+                return False
             if not eng.cache.free_slots():
                 # no slot: preempt only when the POOL side can work out
                 # too (feasibility), else the victim pays for nothing
@@ -280,6 +311,17 @@ class ServingScheduler:
                 continue                # preemption freed a slot; retry
             try:
                 return eng.admit_request(req)
+            except AdapterPoolExhausted:
+                # every ADAPTER slot is pinned: page reclaim cannot
+                # help, so only a strictly-lower-class victim that
+                # itself pins a slot is worth evicting — with none,
+                # defer (back-pressure) instead of thrashing base-model
+                # victims whose preemption frees no adapter slot
+                pinning = [r for r in eng.running_requests()
+                           if getattr(r, "adapter_id", 0) != 0]
+                if not (pinning
+                        and self._preempt_for(req, candidates=pinning)):
+                    return False
             except PoolExhausted:
                 # a slot is free but the POOL can't cover the request:
                 # evict a lower-class victim's pages and retry. Each
@@ -592,6 +634,14 @@ class ServingScheduler:
             # replicas with host headroom for swap-heavy tenants
             s["host_pool_pages"] = host.pages_resident
             s["host_pool_bytes"] = host.bytes_resident
+        pool = getattr(eng, "adapters", None)
+        if pool is not None:
+            # adapter plane (ISSUE 14): slot headroom + residency — the
+            # router's adapter-affinity tie-breaker signal (a replica
+            # already holding a tenant's adapter serves it with zero
+            # load/promote cost)
+            s["adapter_slots_free"] = pool.slots - pool.used_slots
+            s["adapter_slots_used"] = pool.used_slots
         return s
 
     def stats(self) -> Dict:

@@ -37,9 +37,76 @@ ceiling).
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+
+def _softmax(logits: np.ndarray) -> np.ndarray:
+    z = logits - logits.max()
+    e = np.exp(z, dtype=np.float64)
+    return e / e.sum()
+
+
+def rejection_sample_tokens(logits: np.ndarray, drafts,
+                            temperature: float,
+                            rng: np.random.Generator
+                            ) -> Tuple[list, int]:
+    """Standard speculative REJECTION SAMPLING (ISSUE 14), specialized
+    to a deterministic draft proposer — the lift of spec decode's
+    greedy-only restriction.
+
+    ``logits``: (T, V) f32 verify-forward outputs — row ``i`` is the
+    target model's distribution over the token AFTER ``drafts[:i]``
+    (position 0 conditions on the committed context alone). ``drafts``:
+    up to T-1 proposed tokens. Returns ``(tokens, accepted)`` where
+    ``tokens`` is the committed run (``accepted`` drafts plus exactly
+    one corrective/bonus token) — the sampled sibling of the greedy
+    ``longest_accepted_prefix + bonus`` commit.
+
+    The math is the min(1, p/q) acceptance test with the corrected
+    residual distribution. The n-gram proposer is DETERMINISTIC, so its
+    draft distribution q is a point mass at the proposed token x:
+    min(1, p(x)/q(x)) = p(x), and the residual norm_+(p - q) zeroes
+    exactly the x entry of p and renormalizes. Accepting x with
+    probability p(x) and otherwise drawing from that residual emits
+    tokens distributed EXACTLY as p — the output distribution matches
+    plain sampled decode token-for-token in law (the distribution gate
+    in tests/test_adapters.py), which is what makes temperature>0
+    traffic eligible for the 1+k speculative speedup.
+
+    ``temperature == 0`` is the greedy limit: p collapses onto the
+    argmax, acceptance degenerates to draft == argmax and the
+    corrective token to the argmax itself — token-identical to
+    :func:`longest_accepted_prefix` + bonus by construction (gated)."""
+    logits = np.asarray(logits, np.float64)
+    drafts = np.asarray(drafts if drafts is not None else (),
+                        np.int64).reshape(-1)
+    j = int(drafts.size)
+    if temperature == 0.0:
+        targets = np.argmax(logits, axis=-1)
+        a = longest_accepted_prefix(drafts, targets) if j else 0
+        return [int(t) for t in drafts[:a]] + [int(targets[a])], a
+    for i in range(j):
+        p = _softmax(logits[i] / temperature)
+        x = int(drafts[i])
+        if rng.random() < p[x]:
+            continue                              # accept draft i
+        resid = p.copy()
+        resid[x] = 0.0
+        s = resid.sum()
+        if s <= 0.0:
+            # p was (numerically) a point mass at x — the accept draw
+            # can only have failed by float fuzz; treat as accepted
+            continue
+        tok = int(rng.choice(resid.size, p=resid / s))
+        return [int(t) for t in drafts[:i]] + [tok], i
+    # every draft accepted: the bonus token samples from the
+    # distribution at the position after the last draft — exactly what
+    # plain sampled decode would draw there
+    p = _softmax(logits[j] / temperature)
+    return ([int(t) for t in drafts]
+            + [int(rng.choice(p.size, p=p))], j)
 
 
 def longest_accepted_prefix(drafts: np.ndarray,

@@ -83,6 +83,10 @@ class TraceRequest:
     max_new_tokens: int
     priority: int = int(Priority.NORMAL)
     deadline_s: Optional[float] = None
+    #: the tenant's LoRA variant (ISSUE 14); 0 = the base model — a
+    #: trace generated without an adapter population runs unchanged on
+    #: adapter-less clusters
+    adapter_id: int = 0
 
 
 def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
@@ -96,7 +100,9 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
                 diurnal_amp: float = 0.5,
                 deadline_frac: float = 0.6,
                 deadline_s: tuple = (0.5, 2.0),
-                priority_weights=(0.2, 0.6, 0.2)) -> List[TraceRequest]:
+                priority_weights=(0.2, 0.6, 0.2),
+                adapters: int = 0,
+                adapter_zipf: float = 1.2) -> List[TraceRequest]:
     """Generate a seeded open-loop trace.
 
     Arrivals draw from a non-homogeneous Poisson process by thinning:
@@ -112,16 +118,37 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
     ``uniform(*new_tokens)`` new tokens, draws its priority class from
     ``priority_weights`` (HIGH/NORMAL/LOW) and — with probability
     ``deadline_frac`` — a first-token deadline of
-    ``uniform(*deadline_s)`` virtual seconds."""
+    ``uniform(*deadline_s)`` virtual seconds.
+
+    ``adapters`` (ISSUE 14): size of the LoRA variant population. When
+    > 0 each TENANT is assigned one ``adapter_id`` drawn
+    Zipf(``adapter_zipf``)-weighted over ``1..adapters`` — the
+    head-heavy popularity curve of real fine-tune fleets (a few hot
+    variants pinned resident, a long cold tail that exercises the
+    slot-reclaim/demote/promote path) — and every request of that
+    tenant carries it, so the trace drives adapter affinity and slot
+    residency through the same open-loop arrivals as everything else.
+    0 (default) leaves every request on the base model."""
     if duration_s <= 0 or base_rps <= 0:
         raise ValueError(
             f"synth_trace: duration_s={duration_s} and base_rps="
             f"{base_rps} must be > 0")
+    if adapters < 0:
+        raise ValueError(f"synth_trace: adapters={adapters} must be "
+                         f">= 0")
     rs = np.random.RandomState(seed)
     sys_prompts = {
         t: rs.randint(3, vocab, (prefix_pages * page_size,)).astype(
             np.int32)
         for t in range(tenants)}
+    tenant_adapter = {t: 0 for t in range(tenants)}
+    if adapters:
+        ranks = np.arange(1, adapters + 1,
+                          dtype=np.float64) ** -adapter_zipf
+        tenant_adapter = {
+            t: int(rs.choice(np.arange(1, adapters + 1),
+                             p=ranks / ranks.sum()))
+            for t in range(tenants)}
     peak = base_rps * (1 + diurnal_amp) * max(1.0, burst_mult)
 
     def rate(t: float) -> float:
@@ -156,7 +183,8 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
             prompt=np.concatenate([sys_prompts[tenant], tail]),
             max_new_tokens=int(rs.randint(new_tokens[0],
                                           new_tokens[1] + 1)),
-            priority=prio, deadline_s=dl))
+            priority=prio, deadline_s=dl,
+            adapter_id=tenant_adapter[tenant]))
     return out
 
 
@@ -249,7 +277,8 @@ def run_trace(cluster, trace: List[TraceRequest], clock: FakeClock, *,
             req = cluster.submit(
                 tr.prompt, max_new_tokens=tr.max_new_tokens,
                 tenant=tr.tenant, priority=tr.priority,
-                deadline_s=tr.deadline_s)
+                deadline_s=tr.deadline_s,
+                adapter_id=getattr(tr, "adapter_id", 0))
             if on_submit is not None:
                 # the chaos soak's handle collector: invariants like
                 # zero-lost/zero-duplicated need every request handle,

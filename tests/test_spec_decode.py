@@ -248,11 +248,19 @@ class TestSpecParity:
             assert eng2.spec_step(eng2.ready_mask()) > 0
         np.testing.assert_array_equal(reqs[0].output, ref[0])
 
-    def test_spec_requires_greedy(self):
+    def test_spec_composes_with_temperature_not_constraints(self):
+        """ISSUE 14 lifted the greedy-only restriction: temperature>0
+        spec engines build (rejection-sampled acceptance — gated in
+        tests/test_adapters.py); the remaining exclusion is grammar
+        constraints (a verify batch would commit tokens the per-row
+        mask never saw)."""
         cfg, params = _setup()
-        with pytest.raises(ValueError, match="greedy"):
+        eng = ContinuousBatchingEngine(params, cfg, max_batch=2,
+                                       temperature=0.7, spec_k=2)
+        assert eng.spec is not None
+        with pytest.raises(ValueError, match="constraints"):
             ContinuousBatchingEngine(params, cfg, max_batch=2,
-                                     temperature=0.7, spec_k=2)
+                                     spec_k=2, constraints=True)
 
     def test_eos_inside_accepted_run_stops_exactly(self):
         """A draft run that crosses the eos token must stop AT eos —

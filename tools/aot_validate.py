@@ -971,6 +971,160 @@ def validate_serving_async(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_adapters(n: int, batch_mult: int = 1):
+    """ISSUE 14 multi-LoRA lowering gate: Mosaic-lower the
+    adapter-augmented serving programs — the ragged decode step with
+    the per-row gathered ``(x @ A_i) @ B_i · α/r`` term at fp, int8-KV
+    and per-group INT4 weights, the single-request chunked-prefill and
+    batched spec-verify programs with the same term, the tp=2 sharded
+    adapter decode (B factors column-sharded with the base weights;
+    devices permitting) — plus the CONSTRAINED sampling step (masked
+    argmax + the unconstrained-argmax rider the violation counter
+    reads). The adapter term is a batched einsum gather and the mask
+    one ``where`` — both should fuse into the existing programs — but
+    a composition Mosaic rejects would take down every multi-tenant
+    engine at its first admission, so the standing lowering gate
+    applies."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.serving.adapters import AdapterPool
+    from paddle_tpu.serving.paged_cache import pool_partition_specs
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    skipped = {}
+    ndev = len(jax.devices())
+    B = 8
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    params = llama.init_params(jax.random.key(0), cfg)
+    p_int4 = gen.quantize_weights(params, cfg, bits=4)
+    pg = 16
+    tables = jnp.asarray(rs.randint(1, B * 4, (B, 256 // pg)), jnp.int32)
+    toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B,)), jnp.int32)
+    lens = jnp.asarray(rs.randint(1, 200, (B,)), jnp.int32)
+    msk = jnp.asarray(rs.rand(B) > 0.5)
+    pool_a = AdapterPool(cfg, slots=3, rank=4)
+    aslot = jnp.asarray(rs.randint(0, 4, (B,)), jnp.int32)
+
+    def adapter_decode(p, t, pl_, bt_, ln_, m, ad, sl):
+        logits, pl_ = gen.paged_decode_forward(
+            p, t, pl_, bt_, ln_, cfg, active=m, use_kernel=True,
+            adapters=ad, adapter_slots=sl)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), pl_
+
+    def export_decode(tag, pp_, kv=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(
+                jax.jit(adapter_decode, donate_argnums=(2,)),
+                platforms=["tpu"])(pp_, toks, pool, tables, lens, msk,
+                                   pool_a.arrays, aslot)
+        lowered[tag] = "tpu_custom_call" in exp.mlir_module()
+
+    export_decode("adapter_decode_fp", params)
+    export_decode("adapter_decode_int8", params, kv="int8")
+    export_decode("adapter_decode_int4", p_int4)
+
+    # chunked prefill with the one-request adapter term
+    pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg) + 1,
+                                page_size=pg)
+    chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 32)),
+                        jnp.int32)
+    jax.export.export(
+        jax.jit(lambda p, c, pl_, bt_, cl, kl, ad, sl:
+                gen.paged_prefill_chunk(
+                    p, c, pl_, bt_, cfg, ctx_cap=64, ctx_len=cl,
+                    chunk_len=kl, adapters=ad, adapter_slot=sl),
+                donate_argnums=(2,)),
+        platforms=["tpu"])(params, chunk, pool, tables[0],
+                           jnp.int32(60), jnp.int32(32),
+                           pool_a.arrays, aslot[:1])
+    lowered["adapter_chunk"] = True          # export IS the gate
+
+    # batched spec verify with the per-row adapter term
+    spec_chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, 5)),
+                             jnp.int32)
+    jax.export.export(
+        jax.jit(lambda p, c, pl_, bt_, ln_, m, ad, sl:
+                gen.paged_verify_forward(
+                    p, c, pl_, bt_, ln_, cfg, ctx_cap=64, active=m,
+                    adapters=ad, adapter_slots=sl),
+                donate_argnums=(2,)),
+        platforms=["tpu"])(params, spec_chunk, pool, tables,
+                           jnp.minimum(lens, 60), msk,
+                           pool_a.arrays, aslot)
+    lowered["adapter_verify"] = True
+
+    # the constrained sampling step: masked argmax + the raw-argmax
+    # rider (the engine's constraints=True decode program tail)
+    cmask = jnp.asarray(rs.rand(B, cfg.vocab_size) > 0.1)
+
+    def constrained_decode(p, t, pl_, bt_, ln_, m, cm):
+        logits, pl_ = gen.paged_decode_forward(
+            p, t, pl_, bt_, ln_, cfg, active=m, use_kernel=True)
+        raw = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.argmax(jnp.where(cm, logits, -jnp.inf),
+                         axis=-1).astype(jnp.int32)
+        return (nxt, raw), pl_
+    pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg) + 1,
+                                page_size=pg)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(constrained_decode, donate_argnums=(2,)),
+            platforms=["tpu"])(params, toks, pool, tables, lens, msk,
+                               cmask)
+    lowered["constrained_decode"] = "tpu_custom_call" in \
+        exp.mlir_module()
+
+    if ndev >= 2:
+        from paddle_tpu.distributed.mesh import serving_mesh
+        mesh = serving_mesh(2)
+        placed, specs = llama.shard_serving_params(params, cfg, mesh)
+        tp_pool = AdapterPool(cfg, slots=3, rank=4, mesh=mesh)
+        spool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                     + 1, page_size=pg, tp=2)
+        pspecs = pool_partition_specs(spool, "tp")
+        spool = {nm: jax.device_put(a, NamedSharding(mesh, pspecs[nm]))
+                 for nm, a in spool.items()}
+
+        def tp_body(p, t, pl_, bt_, ln_, m, ad, sl):
+            logits, pl_ = gen.paged_decode_forward(
+                p, t, pl_, bt_, ln_, cfg, active=m, use_kernel=True,
+                tp_axis="tp", adapters=ad, adapter_slots=sl)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pl_
+        fwd = shard_map(tp_body, mesh=mesh,
+                        in_specs=(specs, P(), pspecs, P(), P(), P(),
+                                  tp_pool.specs, P()),
+                        out_specs=(P(), pspecs), check_rep=False)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(
+                jax.jit(fwd, donate_argnums=(2,)), platforms=["tpu"])(
+                placed, toks, spool, tables, lens, msk,
+                tp_pool.arrays, aslot)
+        lowered["adapter_decode_tp2"] = \
+            "tpu_custom_call" in exp.mlir_module()
+    else:
+        skipped["adapter_decode_tp2"] = (
+            f"--devices {ndev} < tp=2; nothing to shard")
+    ok = all(lowered.values())
+    return {
+        "config": "serving_adapters_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({"skipped": skipped} if skipped else {}),
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def _impl(args) -> int:
     rows = []
 
@@ -1004,6 +1158,8 @@ def _impl(args) -> int:
         emit(validate_serving_lowbit(args.devices, args.batch_mult))
     if args.config in ("serving-async", "all"):
         emit(validate_serving_async(args.devices, args.batch_mult))
+    if args.config in ("serving-adapters", "all"):
+        emit(validate_serving_adapters(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         ok = ok and (r.get("fits_v5p") is not False)
@@ -1018,7 +1174,8 @@ def main():
                     choices=["7b", "13b", "13b-long", "moe", "moe-pp",
                              "serving", "serving-tp", "serving-cluster",
                              "serving-host", "serving-lowbit",
-                             "serving-async", "all"],
+                             "serving-async", "serving-adapters",
+                             "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")

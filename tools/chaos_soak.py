@@ -7,9 +7,10 @@ speculative verify, priority preemption — through an
 :class:`~paddle_tpu.serving.FaultInjector` fires at least ``--faults``
 faults across EVERY hot-path site (allocator alloc/free, decode /
 prefill-chunk / verify execution, device→host transfer, scheduler
-tick, host-tier swap out/in, and the overlapped runtime's
-dispatch/commit seams — ISSUE 12; raise + stall + corrupt modes), then
-asserts the invariants that make recovery trustworthy:
+tick, host-tier swap out/in, the overlapped runtime's dispatch/commit
+seams — ISSUE 12 — and the adapter plane's load/promote sites with
+multi-LoRA traffic live — ISSUE 14; raise + stall + corrupt modes),
+then asserts the invariants that make recovery trustworthy:
 
 - **zero lost requests** — every submitted request finishes with a
   structured reason (eos / max_len / rejected_overload when the
@@ -72,16 +73,37 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     from paddle_tpu import observability as obs
     from paddle_tpu.models import llama
     from paddle_tpu.inference import ContinuousBatchingEngine
-    from paddle_tpu.serving import (EngineDead, EngineSupervisor,
-                                    FaultInjector, Priority)
+    from paddle_tpu.serving import (AdapterPool, AdapterRegistry,
+                                    EngineDead, EngineSupervisor,
+                                    FaultInjector, HostPageStore,
+                                    Priority, init_lora)
     from paddle_tpu.serving.resilience import ENGINE_SITES as SITES
 
     cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
     params = llama.init_params(jax.random.key(0), cfg)
     rs = np.random.RandomState(seed)
     spec_k = 2
+    # adapter plane (ISSUE 14): three LoRA variants over a TWO-slot
+    # pool with a host store below it — cycling adapter ids through
+    # the workload forces loads, LRU evictions (demote) and
+    # promotions, so the adapter_load / adapter_promote fault sites
+    # get organic visits under the same zero-lost/zero-duplicated
+    # gate. One registry describes the population; the supervisor's
+    # pool is SHARED across recovery rebuilds (the host-tier pattern:
+    # pool state commits at admission, never mid-step) while the
+    # reference engine gets its own pool so reference runs never
+    # touch the soaked pool's residency.
+    registry = AdapterRegistry(cfg)
+    for aid in (1, 2, 3):
+        registry.register(aid, init_lora(cfg, 4, seed=100 + aid))
 
-    def factory():
+    def make_pool():
+        return AdapterPool(cfg, slots=2, rank=4, registry=registry,
+                           store=HostPageStore(page_size=8))
+
+    soak_pool = make_pool()
+
+    def factory(pool=None):
         # host tier ON (ISSUE 10): preemptions swap out / resumes swap
         # in, so the soak's fault stream also exercises the swap_out /
         # swap_in sites under the same zero-lost/zero-duplicated gate.
@@ -97,14 +119,17 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             params, cfg, max_batch=3, page_size=8, max_len=48,
             prefill_chunk=8, spec_k=spec_k,
             speculator=_speculator(spec_k), host_tier=True,
-            overlap=True)
+            overlap=True,
+            adapters=pool if pool is not None else soak_pool)
 
     # mixed workload: long prompts (multi-chunk prefill), short ones,
     # repetitive motifs (accepted drafts), three priority classes
-    # (HIGH admissions preempt LOW runners)
+    # (HIGH admissions preempt LOW runners); every request cycles
+    # through adapter ids 0..3 (0 = base) so the 2-slot pool churns
     jobs = []
     for i in range(requests):
         kind = i % 4
+        aid = i % 4                                # adapter id 0..3
         if kind == 0:
             n = int(rs.randint(18, 30))            # chunked prefill
         elif kind == 1:
@@ -113,21 +138,25 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             motif = rs.randint(3, cfg.vocab_size, (3,))
             jobs.append((np.tile(motif, 5).astype(np.int32)[:14],
                          int(rs.randint(4, 7)),
-                         Priority(int(rs.randint(0, 3)))))
+                         Priority(int(rs.randint(0, 3))), aid))
             continue
         else:
             n = int(rs.randint(8, 16))
         jobs.append((rs.randint(3, cfg.vocab_size, (n,)).astype(np.int32),
                      int(rs.randint(4, 7)),
-                     Priority(int(rs.randint(0, 3)))))
+                     Priority(int(rs.randint(0, 3))), aid))
 
     # uninterrupted references, one engine run per request (per-row
     # greedy decode is independent of batch composition — the PR 2-5
     # parity gates — so per-request references are exact)
-    ref_engine = factory()
-    refs = [np.asarray(o) for o in (
-        ref_engine.generate([p], max_new_tokens=m)[0]
-        for p, m, _ in jobs)]
+    ref_engine = factory(pool=make_pool())
+
+    def ref_run(p, m, aid=0):
+        r = ref_engine.submit(p, max_new_tokens=m, adapter_id=aid)
+        ref_engine.run()
+        return np.asarray(r.output)
+
+    refs = [ref_run(p, m, aid) for p, m, _, aid in jobs]
 
     was = obs.metrics_enabled()
     obs.REGISTRY.clear()
@@ -152,6 +181,19 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             if site == "swap_out":
                 inj.arm(site, "raise", nth=2)
             elif site == "swap_in":
+                inj.arm(site, "raise", nth=1)
+            elif site == "adapter_load":
+                # fires once per FRESH registry load (a handful per
+                # soak, not per step): the first load must succeed so
+                # an eviction/demotion can ever happen, the second
+                # eats the shot — the re-admission after recovery
+                # retries against an intact registry
+                inj.arm(site, "raise", nth=2)
+            elif site == "adapter_promote":
+                # fires once per host-store promotion (needs a prior
+                # LRU demotion): the first promotion faults, and the
+                # retried admission proves the demoted payload
+                # survived the fault un-installed
                 inj.arm(site, "raise", nth=1)
             elif site == "verify_step":
                 # spec verify only runs at degraded level 0 — the
@@ -178,9 +220,9 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             # with it the host tier's swap_out/swap_in sites
             # (ISSUE 10) — would never execute. Arrival dynamics are
             # what make HIGH-preempts-running-LOW happen.
-            for p, m, prio in jobs:
+            for p, m, prio, aid in jobs:
                 reqs.append(sup.submit(p, max_new_tokens=m,
-                                       priority=prio))
+                                       priority=prio, adapter_id=aid))
                 for _ in range(2):
                     try:
                         sup.step()
@@ -278,7 +320,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                 m = int(rs.randint(3, 6))
                 r = sup.submit(p, max_new_tokens=m,
                                priority=Priority.NORMAL)
-                jobs.append((p, m, Priority.NORMAL))
+                jobs.append((p, m, Priority.NORMAL, 0))
                 reqs.append(r)
                 topup_jobs.append((p, m))
                 topup += 1
@@ -301,8 +343,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
         for p, m in topup_jobs:
             # the ONE reference engine serves every reference run (its
             # compiled programs amortize across the whole soak)
-            refs.append(np.asarray(
-                ref_engine.generate([p], max_new_tokens=m)[0]))
+            refs.append(ref_run(p, m))
         snap = obs.REGISTRY.to_json()
     finally:
         obs.REGISTRY.clear()
@@ -600,8 +641,17 @@ def run_traffic_soak(seed: int = 0, duration_s: float = 3.0,
                                     run_trace, synth_trace)
     from paddle_tpu.serving.traffic import REJECTED_REASONS
 
+    from paddle_tpu.serving import AdapterRegistry, init_lora
+
     cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
     params = llama.init_params(jax.random.key(0), cfg)
+    # adapter traffic (ISSUE 14): one shared registry, one fresh
+    # 2-slot pool per replica engine — the trace's Zipf-assigned
+    # tenant adapters exercise router adapter-affinity, cross-replica
+    # loads and slot churn under the same fault/parity gates
+    registry = AdapterRegistry(cfg)
+    for aid in (1, 2, 3):
+        registry.register(aid, init_lora(cfg, 4, seed=200 + aid))
 
     def factory():
         # host tier + overlap ON: the burst's preemptions swap through
@@ -610,7 +660,8 @@ def run_traffic_soak(seed: int = 0, duration_s: float = 3.0,
         # the parity gate is also an overlap-identity gate under fire
         return ContinuousBatchingEngine(
             params, cfg, max_batch=2, page_size=8, max_len=48,
-            prefill_chunk=8, host_tier=True, overlap=True)
+            prefill_chunk=8, host_tier=True, overlap=True,
+            adapters=dict(slots=2, rank=4, registry=registry))
 
     # priority-heavy mix + long decodes: the burst's HIGH arrivals
     # must find decode-phase NORMAL/LOW victims in full slots, or the
@@ -620,7 +671,8 @@ def run_traffic_soak(seed: int = 0, duration_s: float = 3.0,
         tenants=3, page_size=8, prefix_pages=2, vocab=cfg.vocab_size,
         burst_mult=5.0, new_tokens=(6, 12),
         priority_weights=(0.3, 0.4, 0.3),
-        deadline_frac=0.3, deadline_s=(1.5, 4.0))
+        deadline_frac=0.3, deadline_s=(1.5, 4.0),
+        adapters=3)
 
     was = obs.metrics_enabled()
     obs.REGISTRY.clear()
@@ -677,8 +729,11 @@ def run_traffic_soak(seed: int = 0, duration_s: float = 3.0,
                 mismatched.append((req.rid, "declined request has "
                                    "tokens"))
             continue
-        ref = np.asarray(ref_engine.generate(
-            [tr.prompt], max_new_tokens=tr.max_new_tokens)[0])
+        ref_req = ref_engine.submit(
+            tr.prompt, max_new_tokens=tr.max_new_tokens,
+            adapter_id=getattr(tr, "adapter_id", 0))
+        ref_engine.run()
+        ref = np.asarray(ref_req.output)
         if not np.array_equal(req.output, ref):
             mismatched.append((req.rid,
                                "token stream != uninterrupted"))

@@ -72,6 +72,14 @@ REQUIRED = {
         # injectable (and chaos-soaked) on every step kind
         ('_fault_point("dispatch")', 2),
         ('_fault_point("commit")', 2),
+        # sampled speculation (ISSUE 14): drafted/accepted counters +
+        # the accept-rate histogram of the rejection-sampled verify
+        # commit — the realized 1+k·rate speedup multiplier
+        ("_obs.serving_sample_accept(", 1),
+        # constrained decoding (ISSUE 14): mask-latency histogram +
+        # violation-avoided counter on BOTH commit paths (the prefill
+        # first token and the vectorized decode commit)
+        ("_obs.serving_constrain(", 2),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -122,6 +130,23 @@ REQUIRED = {
         ("_obs.serving_slo_ttft(", 1),
         ("_obs.serving_slo_tokens(", 1),
         ("_obs.serving_slo_report(", 1),
+    ],
+    "paddle_tpu/serving/adapters.py": [
+        # multi-tenant adapter plane (ISSUE 14): slot residency gauges
+        # on every pool mutation, the install latency/bytes pair split
+        # by source (fresh load vs host-store promote), the demote
+        # counter+bytes of LRU slot reclaim, and the corrupt-payload
+        # fallback counter — the serving_adapter_* family the
+        # decode_multilora bench rider and the PERF_NOTES
+        # adapter-bandwidth model read
+        ("_obs.serving_adapter_slots(", 1),
+        ("_obs.serving_adapter_load(", 1),
+        ("_obs.serving_adapter_demoted(", 1),
+        ("_obs.serving_adapter_fallback(", 1),
+        # fault-injection sites: fresh load + host-store promotion —
+        # both fire BEFORE any install-side mutation
+        ('fault_point("adapter_load")', 1),
+        ('fault_point("adapter_promote")', 1),
     ],
     "paddle_tpu/serving/host_tier.py": [
         # hierarchical KV tier (ISSUE 10): both halves of the
@@ -200,6 +225,11 @@ REQUIRED = {
         # rope+attn fusion and the chunk/verify flash fusion) —
         # dropping one silently un-counts every launch of that kernel
         ("_obs.serving_fused_dispatch(", 2),
+        # multi-LoRA serving (ISSUE 14): the trace-time adapter factor
+        # gather counter — the per-step adapter bytes every compiled
+        # adapter-augmented program bills (the rank-r bytes/token
+        # model's live input; the serving_tp_allgather contract)
+        ("_obs.serving_adapter_gather(", 1),
     ],
     "paddle_tpu/io/dataloader.py": [
         ("_obs.dataloader_next(", 2),         # single-process + prefetch
@@ -232,6 +262,7 @@ _FAULT_SITE_MODULES = (
     "paddle_tpu/serving/scheduler.py",
     "paddle_tpu/serving/host_tier.py",
     "paddle_tpu/serving/cluster.py",
+    "paddle_tpu/serving/adapters.py",
     "paddle_tpu/inference/predictor.py",
 )
 

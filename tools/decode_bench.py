@@ -199,6 +199,17 @@ def main():
         out["decode_slo_metrics"] = metrics
         return tps
     run_tier("decode_slo_goodput_tokens_per_sec", _slo)
+
+    # multi-tenant adapter plane (ISSUE 14): many LoRA variants through
+    # one engine's slot pool vs the single-merged-model deployment —
+    # the adapter-density rider (slot hits, demote/promote churn, the
+    # vs-merged ratio) rides next to the throughput it explains
+    def _multilora():
+        tps, density = bench_mod.multilora_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        out["decode_multilora_density"] = density
+        return tps
+    run_tier("decode_multilora_tokens_per_sec", _multilora)
     int8_p = {}
 
     def _int8():
@@ -224,6 +235,7 @@ def main():
         "decode_cluster_tokens_per_sec",
         "decode_offload_tokens_per_sec",
         "decode_slo_goodput_tokens_per_sec",
+        "decode_multilora_tokens_per_sec",
         "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
         "decode_w8kv8_tokens_per_sec")})
     fp = tiers.get("decode_tokens_per_sec")

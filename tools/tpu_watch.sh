@@ -85,6 +85,7 @@ if dec.get("decode_tokens_per_sec") is not None:
               "decode_cluster_tokens_per_sec",
               "decode_offload_tokens_per_sec",
               "decode_slo_goodput_tokens_per_sec",
+              "decode_multilora_tokens_per_sec",
               "decode_int8_tokens_per_sec", "decode_int4_tokens_per_sec",
               "decode_w8kv8_tokens_per_sec"):
         if dec.get(k) is None:
@@ -119,7 +120,8 @@ if dec.get("decode_tokens_per_sec") is not None:
                   "decode_tp_scaling", "decode_cluster_scaling",
                   "decode_offload_resume", "decode_slo_metrics",
                   "decode_fused_speedup",
-                  "decode_overlap_speedup"):
+                  "decode_overlap_speedup",
+                  "decode_multilora_density"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
             lg["extra"][rider] = ms
