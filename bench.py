@@ -201,6 +201,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             # double-buffered scheduler — sync vs overlapped step ms +
             # the host_overhead_fraction the overlap hides
             rec["extra"]["decode_overlap_speedup"] = decode_sched[2]
+        if len(decode_sched) > 3 and decode_sched[3]:
+            # durability rider (ISSUE 15): the same workload through a
+            # WAL-backed supervisor at each fsync rung vs journal-off —
+            # the measured cost of crash durability
+            rec["extra"]["decode_durability_overhead"] = decode_sched[3]
     if decode_spec:
         # the speculative tier's throughput only means something next
         # to the acceptance rate that produced it — they travel together
@@ -461,7 +466,8 @@ def prefix_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
 
 
 def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
-                      kv_cache_dtype=None, overlap_rider=True):
+                      kv_cache_dtype=None, overlap_rider=True,
+                      durability_rider=True):
     """The decode_sched_tokens_per_sec measurement, shared by measure()
     and tools/decode_bench.py so the two sources stay comparable.
 
@@ -560,7 +566,119 @@ def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
         except Exception as e:
             print(f"overlap sched rider failed: {type(e).__name__}: "
                   f"{e}"[:300], file=sys.stderr)
-    return tps, lat, rider
+    durability = None
+    if durability_rider:
+        try:
+            durability = _durability_rider(
+                params, cfg, db, dp_len, dnew, page,
+                kv_cache_dtype=kv_cache_dtype)
+        except Exception as e:
+            print(f"durability sched rider failed: "
+                  f"{type(e).__name__}: {e}"[:300], file=sys.stderr)
+    return tps, lat, rider, durability
+
+
+def _durability_rider(params, cfg, db, dp_len, dnew, page,
+                      kv_cache_dtype=None):
+    """The decode_durability_overhead rider (ISSUE 15): the sched
+    tier's two-wave preemption workload re-run through an
+    :class:`~paddle_tpu.serving.EngineSupervisor` with the durable
+    journal OFF (in-memory only — the baseline), then with the on-disk
+    WAL at each fsync rung (``group`` — the default group-commit
+    window — and ``commit`` — fsync every append). Reports
+    ``{fsync_policy, wal_ms_per_step, steps_per_sec, overhead_frac}``
+    — the measured durability tax next to the PERF_NOTES
+    bytes/record · records/step amortization model. The headline gate:
+    group-commit overhead < 5% at the CPU smoke geometry."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from paddle_tpu.inference.predictor import ContinuousBatchingEngine
+    from paddle_tpu.serving import EngineSupervisor, Priority
+
+    def factory():
+        return ContinuousBatchingEngine(
+            params, cfg, max_batch=db, page_size=page,
+            max_len=dp_len + dnew, kv_cache_dtype=kv_cache_dtype,
+            enable_prefix_cache=False)
+
+    root = tempfile.mkdtemp(prefix="bench_wal_")
+
+    def run_mode(mode):
+        kw = {}
+        if mode != "journal_off":
+            kw = dict(wal_dir=os.path.join(root, mode),
+                      wal_fsync=mode, checkpoint_every=64)
+        rngp = np.random.default_rng(5)
+
+        def mk(n):
+            return rngp.integers(0, cfg.vocab_size, (n,)).astype(
+                np.int32)
+
+        def one_pass(sup):
+            reqs = [sup.submit(mk(dp_len), max_new_tokens=dnew,
+                               priority=Priority.LOW)
+                    for _ in range(db)]
+            for _ in range(4):
+                sup.step()
+            reqs += [sup.submit(mk(max(dp_len // 2, 1)),
+                                max_new_tokens=max(dnew // 2, 1),
+                                priority=Priority.HIGH)
+                     for _ in range(db)]
+            s0 = sup.steps_total
+            sup.run()
+            return (sum(len(r.tokens) for r in reqs),
+                    sup.steps_total - s0 + 4)
+        sup = EngineSupervisor(factory, token_budget=db + 2 * page,
+                               **kw)
+        one_pass(sup)                           # compile/warm
+        rates, wal_ms = [], []
+        for _ in range(3):                      # median beats CPU noise
+            w0 = (sup.wal.append_ns + sup.wal.fsync_ns
+                  if sup.wal is not None else 0)
+            s0 = sup.steps_total
+            t0 = time.perf_counter()
+            _toks, steps = one_pass(sup)
+            dt = time.perf_counter() - t0
+            if dt and steps:
+                rates.append(steps / dt)
+            if sup.wal is not None:
+                wal_ms.append(
+                    (sup.wal.append_ns + sup.wal.fsync_ns - w0) / 1e6
+                    / max(1, sup.steps_total - s0))
+        return {"steps_per_sec": (float(np.median(rates))
+                                  if rates else None),
+                "wal_ms_per_step": (float(np.median(wal_ms))
+                                    if wal_ms else None)}
+    try:
+        base = run_mode("journal_off")
+        group = run_mode("group")
+        commit = run_mode("commit")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    def overhead(m):
+        b, w = base["steps_per_sec"], m["steps_per_sec"]
+        return round(1.0 - w / b, 4) if b and w else None
+    # the end-to-end ratio is noisy at smoke step times (~2 ms);
+    # wal_frac_of_step is the DIRECT measurement — WAL append+fsync ms
+    # over the measured step period — and is the honest < 5% headline
+    wal_frac = None
+    if group["wal_ms_per_step"] and group["steps_per_sec"]:
+        wal_frac = round(group["wal_ms_per_step"]
+                         / (1000.0 / group["steps_per_sec"]), 4)
+    return {
+        "fsync_policy": "group",
+        "wal_ms_per_step": round(group["wal_ms_per_step"] or 0, 4),
+        "wal_frac_of_step": wal_frac,
+        "steps_per_sec": {
+            "journal_off": round(base["steps_per_sec"], 2),
+            "group": round(group["steps_per_sec"], 2),
+            "commit": round(commit["steps_per_sec"], 2)},
+        "overhead_frac": {"group": overhead(group),
+                          "commit": overhead(commit)},
+    }
 
 
 def spec_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
@@ -1058,6 +1176,8 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
 _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                   ("decode_sched_tokens_per_sec",
                    "decode_overlap_speedup"),
+                  ("decode_sched_tokens_per_sec",
+                   "decode_durability_overhead"),
                   ("decode_spec_tokens_per_sec", "decode_spec_acceptance"),
                   ("decode_tp_tokens_per_sec", "decode_tp_scaling"),
                   ("decode_cluster_tokens_per_sec",

@@ -779,6 +779,27 @@ class ContinuousBatchingEngine:
         self._next_rid += 1
         return req
 
+    def attach_constraint(self, req: GenerationRequest,
+                          constraint) -> GenerationRequest:
+        """Attach a live
+        :class:`~paddle_tpu.serving.constraints.ConstraintState` to an
+        EXISTING request handle — the restore/cold-recovery path
+        (ISSUE 15): checkpointed grammar state rebuilds outside
+        :meth:`create_request`, and re-attaching through the engine
+        keeps the one validation that matters — an engine whose decode
+        program carries no mask input must refuse loudly, never
+        silently finish the session unconstrained."""
+        if constraint is None:
+            return req
+        if not self.constraints:
+            raise ValueError(
+                "attach_constraint: this engine was built without "
+                "constraints=True — restoring a grammar-constrained "
+                "session into it would decode unconstrained; rebuild "
+                "the engine with constraints=True")
+        req.constraint = constraint
+        return req
+
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_token_id=None, adapter_id: int = 0,
                constraint=None) -> GenerationRequest:

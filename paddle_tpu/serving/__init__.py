@@ -61,6 +61,14 @@ SLO-aware scheduler.
   :mod:`paddle_tpu.serving.speculative`:
   :func:`rejection_sample_tokens` lifts spec decode's greedy-only
   restriction with standard min(1, p/q) rejection sampling.
+- :mod:`paddle_tpu.serving.wal` — the crash-durable journal plane
+  (ISSUE 15): :class:`WriteAheadLog` (segmented CRC-framed on-disk
+  log under the request journal, configurable fsync ladder,
+  incremental checkpoints that compact the log without stopping
+  admissions) and :func:`recover_state` (torn-tail truncation +
+  checkpoint-plus-suffix replay) — the machinery behind
+  :meth:`EngineSupervisor.recover_from_disk` /
+  :meth:`ServingCluster.recover_from_disk` cold-restart recovery.
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -93,6 +101,7 @@ from .constraints import (  # noqa: F401
     json_schema_dfa,
 )
 from .host_tier import HostPageStore, TieredKVCache  # noqa: F401
+from .wal import WriteAheadLog, recover_state  # noqa: F401
 from .router import (  # noqa: F401
     AdmissionController, ClusterRouter, TenantQuota,
 )

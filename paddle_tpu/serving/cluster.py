@@ -205,7 +205,9 @@ class ServingCluster:
                  autoscaler: Optional[ClusterAutoscaler] = None,
                  handoff_retries: int = 2,
                  handoff_timeout_s: Optional[float] = None,
-                 retry_sleep: Callable[[float], None] = time.sleep):
+                 retry_sleep: Callable[[float], None] = time.sleep,
+                 wal_dir: Optional[str] = None,
+                 _recover: bool = False):
         if replicas < 1:
             raise ValueError(f"replicas={replicas} must be >= 1")
         if not 0 <= prefill_replicas < replicas:
@@ -216,6 +218,13 @@ class ServingCluster:
         self.token_budget = token_budget
         self.clock = clock
         self._sup_kw = dict(supervisor_kw or {})
+        # crash-durable cluster (ISSUE 15): wal_dir gives EVERY replica
+        # its own journal directory (replica<i>/) — failover
+        # replacements adopt the dead replica's directory (journal
+        # continuity), and recover_from_disk() rebuilds the whole
+        # cluster after whole-process death, replica by replica
+        self.wal_dir = wal_dir
+        self._recovering = bool(_recover)
         if overlap is not None:
             # async overlapped runtime (ISSUE 12): every supervised
             # replica's scheduler runs the double-buffered pipeline —
@@ -229,7 +238,8 @@ class ServingCluster:
         self._next_rid = 0
         self._host_store = None
         self.replicas: List[EngineSupervisor] = [
-            self._new_supervisor() for _ in range(replicas)]
+            self._new_supervisor(i) for i in range(replicas)]
+        self._recovering = False
         if share_host_tier:
             # hierarchical KV (ISSUE 10): when the factory builds
             # host-tiered engines, every replica shares ONE
@@ -287,11 +297,32 @@ class ServingCluster:
         self.retirements_total = 0
         self.deadline_cancels_total = 0
 
-    def _new_supervisor(self) -> EngineSupervisor:
-        sup = EngineSupervisor(self._factory,
-                               token_budget=self.token_budget,
-                               clock=self.clock, **self._sup_kw)
+    def _replica_wal_dir(self, idx: int) -> Optional[str]:
+        if self.wal_dir is None:
+            return None
+        return os.path.join(self.wal_dir, f"replica{idx:03d}")
+
+    def _new_supervisor(self, idx: int) -> EngineSupervisor:
+        kw = dict(self._sup_kw)
+        wdir = self._replica_wal_dir(idx)
+        if wdir is not None:
+            kw.setdefault("wal_dir", wdir)
+        if wdir is not None and self._recovering \
+                and os.path.isdir(wdir) and os.listdir(wdir):
+            # cold cluster recovery: the replica adopts its (or its
+            # dead predecessor's) journal directory wholesale — torn
+            # tail repaired, checkpoint + suffix replayed, sessions
+            # requeued through the resume path
+            sup = EngineSupervisor.recover_from_disk(
+                self._factory, wdir,
+                token_budget=self.token_budget, clock=self.clock,
+                **{k: v for k, v in kw.items() if k != "wal_dir"})
+        else:
+            sup = EngineSupervisor(self._factory,
+                                   token_budget=self.token_budget,
+                                   clock=self.clock, **kw)
         sup.engine._next_rid = max(sup.engine._next_rid, self._next_rid)
+        self._next_rid = max(self._next_rid, sup.engine._next_rid)
         self._attach_host_store(sup)
         return sup
 
@@ -566,10 +597,10 @@ class ServingCluster:
         for i in self._decode_idxs():
             sup = self.replicas[i]
             if sup.health == "dead" or sup._draining:
-                self.replicas[i] = self._new_supervisor()
+                self.replicas[i] = self._new_supervisor(i)
                 self.router.drop_replica(i)
                 return i
-        self.replicas.append(self._new_supervisor())
+        self.replicas.append(self._new_supervisor(len(self.replicas)))
         return len(self.replicas) - 1
 
     def _autoscale_tick(self):
@@ -815,9 +846,24 @@ class ServingCluster:
         elsewhere — cluster-wide, nothing is lost."""
         dead = self.replicas[idx]
         self.failovers_total += 1
-        self.replicas[idx] = self._new_supervisor()
+        entries = dead.journal.live_entries()
+        if dead.wal is not None:
+            # ownership moves with the rehome: tombstone every live
+            # session in the DEAD replica's journal directory (and
+            # fsync + close it) BEFORE the replacement adopts the dir —
+            # a later cold recovery of this directory must not
+            # resurrect sessions the survivors are already serving,
+            # and two writers must never interleave frames in one file
+            try:
+                for e in entries:
+                    dead.journal.forget(e.rid)
+                dead.wal.commit(force=True)
+            except Exception:
+                pass    # best-effort: cold recovery dedupes by rid
+            dead.wal.close()
+        self.replicas[idx] = self._new_supervisor(idx)
         self.router.drop_replica(idx)
-        self._rehome(dead.journal.live_entries())
+        self._rehome(entries)
 
     def retire_replica(self, idx: int, *, path: Optional[str] = None,
                        replace: bool = True) -> Dict:
@@ -853,7 +899,7 @@ class ServingCluster:
             summary = sup.drain(path)
             entries = sup.journal.live_entries()
             if replace:
-                new = self._new_supervisor()
+                new = self._new_supervisor(idx)
                 ckpt = load_drain_checkpoint(path)
                 if ckpt["prefix"] is not None:
                     new.engine.cache.restore_prefix(ckpt["prefix"])
@@ -866,6 +912,58 @@ class ServingCluster:
         finally:
             if tmp is not None and os.path.exists(tmp):
                 os.unlink(tmp)
+
+    # ---- whole-process cold recovery (ISSUE 15) ----
+    @classmethod
+    def recover_from_disk(cls, engine_factory: Callable,
+                          wal_dir: str, *, replicas: Optional[int] = None,
+                          **kw) -> "ServingCluster":
+        """Rebuild a cluster after WHOLE-PROCESS death from its
+        per-replica journal directories: each ``replica<i>/`` WAL
+        recovers into replica ``i``
+        (:meth:`~paddle_tpu.serving.EngineSupervisor.recover_from_disk`
+        — torn tails truncated, checkpoints + log suffixes replayed),
+        sessions that a crash caught MID-HANDOFF (adopted on the
+        decode side, not yet tombstoned on the prefill side) dedupe by
+        rid — the copy with more committed tokens wins, the loser is
+        durably forgotten — and every recovered handle re-enters the
+        cluster's owner map so :meth:`step`/:meth:`run` drive it to
+        completion. Recovered handles live in ``.recovered``
+        (rid → request)."""
+        sub = sorted(d for d in (os.listdir(wal_dir)
+                                 if os.path.isdir(wal_dir) else ())
+                     if d.startswith("replica"))
+        n = replicas if replicas is not None else max(len(sub), 1)
+        cluster = cls(engine_factory, replicas=n, wal_dir=wal_dir,
+                      _recover=True, **kw)
+        cluster.recovered: Dict[int, object] = {}
+        best: Dict[int, tuple] = {}     # rid -> (idx, req)
+        for i, sup in enumerate(cluster.replicas):
+            for rid, req in getattr(sup, "restored", {}).items():
+                prev = best.get(rid)
+                if prev is None:
+                    best[rid] = (i, req)
+                    continue
+                # mid-handoff duplicate: keep the furthest-along copy
+                # (the adopt side committed at least as many tokens);
+                # the loser forgets durably so the NEXT cold recovery
+                # of that directory is already clean
+                keep_new = len(req.tokens) > len(prev[1].tokens)
+                (lose_i, lose_req) = prev if keep_new else (i, req)
+                if keep_new:
+                    best[rid] = (i, req)
+                loser = cluster.replicas[lose_i]
+                loser.journal.forget(rid)
+                loser.engine.cancel_request(lose_req, "superseded")
+        for rid, (idx, req) in best.items():
+            cluster._live[rid] = req
+            cluster._owner[rid] = idx
+            cluster._meta[rid] = {"tenant": "default",
+                                  "cost": req.prompt.shape[1]
+                                  + req.max_new_tokens}
+            cluster.recovered[rid] = req
+            cluster._next_rid = max(cluster._next_rid, rid + 1)
+        return cluster
 
     # ---- introspection ----
     def stats(self) -> Dict:

@@ -37,6 +37,7 @@ token is grammar-valid on every workload.
 """
 from __future__ import annotations
 
+import base64
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -53,6 +54,7 @@ class TokenDFA:
 
     def __init__(self, next_table: np.ndarray, accepting: np.ndarray,
                  start: int = 0):
+        self._hash: Optional[str] = None
         self.next = np.asarray(next_table, np.int32)
         if self.next.ndim != 2:
             raise ValueError(
@@ -81,6 +83,45 @@ class TokenDFA:
     def advance(self, state: int, token: int) -> int:
         """The successor state; -1 when ``token`` is not admitted."""
         return int(self.next[state, int(token)])
+
+    def content_hash(self) -> str:
+        """Stable identity of this grammar (sha1 of the table bytes):
+        checkpoint/WAL records DEDUPE the dense table by it — many
+        sessions sharing one grammar serialize the table once, and
+        per-session records carry only the hash (ISSUE 15: at serving
+        vocab sizes the table is MBs; re-encoding it per record would
+        dominate every journal frame)."""
+        if self._hash is None:
+            import hashlib
+            h = hashlib.sha1(np.ascontiguousarray(self.next).tobytes())
+            h.update(np.packbits(self.accepting).tobytes())
+            h.update(str(self.start).encode())
+            self._hash = h.hexdigest()
+        return self._hash
+
+    def to_record(self) -> Dict:
+        """JSON-able serialization of the dense table (ISSUE 15: the
+        drain-checkpoint / WAL shape — base64 of the raw int32 table
+        plus the accepting bitmap, so a mid-grammar session survives a
+        drain or a cold restart with its grammar intact)."""
+        return {
+            "shape": list(self.next.shape),
+            "table": base64.b64encode(
+                np.ascontiguousarray(self.next).tobytes()).decode(),
+            "accepting": base64.b64encode(
+                np.packbits(self.accepting).tobytes()).decode(),
+            "start": self.start,
+        }
+
+    @classmethod
+    def from_record(cls, rec: Dict) -> "TokenDFA":
+        shape = tuple(int(x) for x in rec["shape"])
+        table = np.frombuffer(base64.b64decode(rec["table"]),
+                              np.int32).reshape(shape)
+        accepting = np.unpackbits(np.frombuffer(
+            base64.b64decode(rec["accepting"]),
+            np.uint8))[:shape[0]].astype(bool)
+        return cls(table, accepting, start=int(rec.get("start", 0)))
 
 
 class ConstraintState:
@@ -159,6 +200,55 @@ class ConstraintState:
                 f"{int(token)} from state {self.state} — the sampling "
                 f"mask was not applied")
         self.state = nxt
+
+    def to_record(self, grammars: Optional[Dict] = None) -> Dict:
+        """Serialize the LIVE state (ISSUE 15): dense DFA table + the
+        current state id + the violation counters, so drain/restore and
+        cold-restart recovery re-attach an equivalent constraint — the
+        standing drain() refusal for constrained sessions retires with
+        this. ``grammars`` (hash → table record) dedupes the table:
+        the record then carries only ``dfa_hash`` and the caller ships
+        the shared dict once (checkpoint meta / WAL grammar records)."""
+        rec = {"state": int(self.state),
+               "eos_token_id": self.eos_token_id,
+               "finished": bool(self.finished),
+               "dead_ends": int(self.dead_ends),
+               "tokens_masked_total": int(self.tokens_masked_total)}
+        if grammars is None:
+            rec["dfa"] = self.dfa.to_record()
+        else:
+            h = self.dfa.content_hash()
+            grammars.setdefault(h, self.dfa.to_record())
+            rec["dfa_hash"] = h
+        return rec
+
+    @classmethod
+    def from_record(cls, rec: Dict,
+                    grammars: Optional[Dict] = None) -> "ConstraintState":
+        if "dfa" in rec:
+            dfa_rec = rec["dfa"]
+        else:
+            h = rec.get("dfa_hash")
+            dfa_rec = (grammars or {}).get(h)
+            if dfa_rec is None:
+                raise ValueError(
+                    f"ConstraintState.from_record: grammar {h!r} is "
+                    f"not in the supplied grammar table — the "
+                    f"checkpoint/WAL record set is incomplete")
+        st = cls(TokenDFA.from_record(dfa_rec),
+                 eos_token_id=rec.get("eos_token_id"))
+        st.state = int(rec.get("state", st.dfa.start))
+        st.finished = bool(rec.get("finished", False))
+        st.dead_ends = int(rec.get("dead_ends", 0))
+        st.tokens_masked_total = int(rec.get("tokens_masked_total", 0))
+        return st
+
+    def state_record(self) -> Dict:
+        """The cheap per-step delta (WAL ``cstate``): everything but
+        the table — folded over the submit-time record at replay."""
+        return {"state": int(self.state), "finished": bool(self.finished),
+                "dead_ends": int(self.dead_ends),
+                "tokens_masked_total": int(self.tokens_masked_total)}
 
 
 def dfa_from_sequences(sequences: Sequence[Sequence[int]],

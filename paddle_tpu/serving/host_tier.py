@@ -128,14 +128,26 @@ class HostPageStore:
 
     def __init__(self, page_size: int,
                  capacity_pages: Optional[int] = None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None,
+                 max_disk_bytes: Optional[int] = None):
         if capacity_pages is not None and capacity_pages < 1:
             raise ValueError(
                 f"HostPageStore: capacity_pages={capacity_pages} "
                 f"must be >= 1 (or None for unbounded)")
+        if max_disk_bytes is not None and max_disk_bytes < 1:
+            raise ValueError(
+                f"HostPageStore: max_disk_bytes={max_disk_bytes} "
+                f"must be >= 1 (or None for unbounded)")
         self.page_size = page_size
         self.capacity_pages = capacity_pages
         self.path = path
+        #: ISSUE 15 satellite: byte bound on the STANDING disk layer —
+        #: long-running engines write prefix chains through forever,
+        #: so without a cap artifacts/ grows without limit. Oldest-
+        #: mtime files prune first (LRU by last write/promotion);
+        #: pruning a standing entry is always safe — the next miss is
+        #: a plain prefix MISS and the chain re-prefills.
+        self.max_disk_bytes = max_disk_bytes
         self._entries: "OrderedDict" = OrderedDict()
         self.pages_resident = 0
         self.bytes_resident = 0
@@ -146,8 +158,24 @@ class HostPageStore:
         #: corrupt/torn entries removed so they can never be re-served
         #: (ISSUE 13) — the integrity gate's quarantine counter
         self.quarantined_total = 0
+        #: standing-store files (and bytes) removed by the disk bound —
+        #: next to the corrupt-unlink counter, so dashboards can tell
+        #: capacity pruning from quarantine
+        self.disk_pruned_total = 0
+        self.disk_pruned_bytes_total = 0
+        # cached standing-store residency: adjusted on every write,
+        # re-synced from a full directory scan only when the bound
+        # trips (the prune needs the listing anyway to pick LRU) — a
+        # put() on the serving hot path must not stat the whole
+        # directory (engines sharing a dir drift the cache slightly;
+        # the overflow re-scan corrects it before anything prunes)
+        self._disk_bytes: Optional[int] = None
         if path is not None:
             os.makedirs(path, exist_ok=True)
+            if max_disk_bytes is not None:
+                self._disk_bytes = sum(
+                    os.path.getsize(os.path.join(path, f))
+                    for f in os.listdir(path) if f.endswith(".npz"))
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -246,12 +274,71 @@ class HostPageStore:
                 "checksums": entry.get("checksums")}
         fn = os.path.join(self.path, _key_name(key))
         tmp = fn + ".tmp"
+        old_size = 0
+        if self._disk_bytes is not None:
+            try:
+                old_size = os.path.getsize(fn)
+            except OSError:
+                pass
         with open(tmp, "wb") as f:
             np.savez(f, key=np.frombuffer(key, np.uint8),
                      meta=np.frombuffer(json.dumps(meta).encode(),
                                         np.uint8),
                      **{f"a_{n}": a for n, a in entry["arrays"].items()})
         os.replace(tmp, fn)     # atomic: a reader never sees half a file
+        if self._disk_bytes is not None:
+            try:
+                self._disk_bytes += os.path.getsize(fn) - old_size
+            except OSError:
+                pass
+            if self._disk_bytes > self.max_disk_bytes:
+                self._enforce_disk_bound(keep=fn)
+
+    def _enforce_disk_bound(self, keep: Optional[str] = None) -> int:
+        """Prune oldest-mtime standing-store files until total disk
+        residency fits ``max_disk_bytes`` (ISSUE 15 satellite). Runs
+        only when the cached byte total trips the bound; the full
+        directory scan here re-syncs that cache (the listing is needed
+        anyway to pick the LRU victims). The just-written file
+        (``keep``) never prunes — the bound must not eat the entry
+        whose write triggered it. Best-effort: a file raced away by
+        another engine sharing the directory just skips."""
+        if self.max_disk_bytes is None or self.path is None:
+            return 0
+        try:
+            files = []
+            total = 0
+            for fn in os.listdir(self.path):
+                if not fn.endswith(".npz"):
+                    continue
+                full = os.path.join(self.path, fn)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                files.append((st.st_mtime, st.st_size, full))
+                total += st.st_size
+            pruned = 0
+            for _mtime, size, full in sorted(files):
+                if total <= self.max_disk_bytes:
+                    break
+                if full == keep:
+                    continue
+                try:
+                    os.unlink(full)
+                except OSError:
+                    continue
+                total -= size
+                pruned += 1
+                self.disk_pruned_total += 1
+                self.disk_pruned_bytes_total += size
+            self._disk_bytes = total
+            if pruned:
+                _obs.serving_host_disk_pruned(
+                    pruned, self.disk_pruned_bytes_total)
+            return pruned
+        except OSError:
+            return 0
 
     def _quarantine_disk(self, fn: str):
         """Remove a corrupt/torn standing-store file so it is NEVER
@@ -260,10 +347,23 @@ class HostPageStore:
         next one)."""
         self.quarantined_total += 1
         _obs.serving_integrity("disk_store", "quarantined")
+        self._unlink_tracked(fn)
+
+    def _unlink_tracked(self, fn: str) -> None:
+        """Unlink a standing-store file, keeping the cached disk-byte
+        total honest (best-effort on both syscalls)."""
+        size = 0
+        if self._disk_bytes is not None:
+            try:
+                size = os.path.getsize(fn)
+            except OSError:
+                pass
         try:
             os.unlink(fn)
         except OSError:
-            pass
+            return
+        if self._disk_bytes is not None:
+            self._disk_bytes = max(0, self._disk_bytes - size)
 
     def _read_disk(self, key: bytes) -> Optional[Dict]:
         fn = os.path.join(self.path, _key_name(key))
@@ -296,6 +396,14 @@ class HostPageStore:
             return None
         entry["bytes"] = sum(int(v.nbytes)
                              for v in entry["arrays"].values())
+        try:
+            # bump mtime on promotion so the disk bound's oldest-mtime
+            # pruning is genuinely LRU (last write OR promotion), not
+            # FIFO by original write time — without this the hottest
+            # standing entries would prune first
+            os.utime(fn, None)
+        except OSError:
+            pass
         return entry
 
     def get(self, key, touch: bool = True) -> Optional[Dict]:
@@ -337,10 +445,8 @@ class HostPageStore:
         self.quarantined_total += 1
         _obs.serving_integrity(site, "quarantined")
         if self.path is not None and isinstance(key, bytes):
-            try:
-                os.unlink(os.path.join(self.path, _key_name(key)))
-            except OSError:
-                pass
+            self._unlink_tracked(
+                os.path.join(self.path, _key_name(key)))
 
     def stats(self) -> Dict:
         return {"entries": len(self._entries),
@@ -351,7 +457,9 @@ class HostPageStore:
                 "hits_total": self.hits_total,
                 "misses_total": self.misses_total,
                 "capacity_drops_total": self.capacity_drops_total,
-                "quarantined_total": self.quarantined_total}
+                "quarantined_total": self.quarantined_total,
+                "disk_pruned_total": self.disk_pruned_total,
+                "disk_pruned_bytes_total": self.disk_pruned_bytes_total}
 
 
 class TieredKVCache(PagedKVCache):

@@ -97,7 +97,13 @@ ENGINE_SITES = ("alloc", "free", "decode_step", "prefill_chunk",
                 # retried admission finds the same sources intact.
                 # NB keep this comment paren-free: check_fault_sites
                 # parses the tuple with a non-greedy paren match
-                "adapter_load", "adapter_promote")
+                "adapter_load", "adapter_promote",
+                # durable journal plane, ISSUE 15: wal_append fires
+                # BEFORE a frame is written, wal_fsync before the
+                # fsync, checkpoint_write before the checkpoint file —
+                # none commits anything, and the crash-point sweep
+                # kills the process after each and recovers from disk
+                "wal_append", "wal_fsync", "checkpoint_write")
 
 #: cluster-plane sites (ISSUE 13): the prefill→decode handoff's two
 #: byte-moving halves and the autoscaler's control tick. They only
@@ -382,7 +388,9 @@ class JournalEntry:
     __slots__ = ("req", "rid", "prompt", "max_new_tokens",
                  "eos_token_id", "priority", "deadline_at",
                  "submitted_at", "tokens", "admitted", "preemptions",
-                 "swapped", "adapter_id", "constrained")
+                 "swapped", "adapter_id", "constrained",
+                 "wal_submitted", "wal_tokens", "wal_prem",
+                 "wal_swapped", "wal_admitted")
 
     def __init__(self, req):
         self.req = req
@@ -407,8 +415,18 @@ class JournalEntry:
         # knows).
         self.adapter_id = int(getattr(req, "adapter_id", 0))
         self.constrained = getattr(req, "constraint", None) is not None
+        # durable-WAL cursors (ISSUE 15): what of this entry already
+        # reached the on-disk log — sync() appends only the deltas, and
+        # a failed append just leaves the cursor behind for the next
+        # successful sync to heal
+        self.wal_submitted = False
+        self.wal_tokens = 0
+        self.wal_prem = self.preemptions
+        self.wal_swapped = False
+        self.wal_admitted = False
 
-    def as_record(self, now: Optional[float] = None) -> Dict:
+    def as_record(self, now: Optional[float] = None,
+                  grammars: Optional[Dict] = None) -> Dict:
         """JSON-able checkpoint record (drain/restore). Deadlines are
         serialized as REMAINING seconds against ``now`` (the draining
         supervisor's clock), never as absolute monotonic stamps — a
@@ -419,6 +437,17 @@ class JournalEntry:
         remaining = None
         if self.deadline_at is not None and now is not None:
             remaining = self.deadline_at - now
+        constraint = None
+        cs = getattr(self.req, "constraint", None) \
+            if self.req is not None else None
+        if cs is not None:
+            # grammar state serializes (ISSUE 15 satellite): dense DFA
+            # table + state id + violation counters — a mid-grammar
+            # session survives drain/restore and cold restarts, so the
+            # old drain() refusal is gone. ``grammars`` dedupes the
+            # table across sessions sharing one grammar (MBs at real
+            # vocab sizes — it must never re-encode per record)
+            constraint = cs.to_record(grammars)
         return {"rid": self.rid, "prompt": self.prompt.tolist(),
                 "max_new_tokens": self.max_new_tokens,
                 "eos_token_id": self.eos_token_id,
@@ -428,7 +457,8 @@ class JournalEntry:
                 "admitted": self.admitted,
                 "preemptions": self.preemptions,
                 "swapped": self.swapped,
-                "adapter_id": self.adapter_id}
+                "adapter_id": self.adapter_id,
+                "constraint": constraint}
 
 
 class RequestJournal:
@@ -441,21 +471,88 @@ class RequestJournal:
     live request is reset to its journaled state, which is exactly the
     host state as of the last committed step (a failed step committed
     nothing — device results only reach ``req.tokens`` after the
-    transfer that would have raised)."""
+    transfer that would have raised).
 
-    def __init__(self):
+    ``wal`` (ISSUE 15) attaches a
+    :class:`~paddle_tpu.serving.wal.WriteAheadLog`: admission params
+    append at submit time (write-ahead — on disk before anything can
+    execute), per-step committed-token deltas / preempt-swap ownership
+    transitions / constraint-state deltas append at each :meth:`sync`,
+    and finish / handoff-forget tombstones retire sessions from the
+    log. The in-memory journal stays the in-process recovery source;
+    the WAL is what a COLD restart replays
+    (:meth:`EngineSupervisor.recover_from_disk`)."""
+
+    def __init__(self, wal=None):
         self._entries: Dict[int, JournalEntry] = {}
         self.finished_total = 0
+        self.wal = wal
+        # finish tombstones awaiting the next due delta pass (the
+        # group-commit cadence batches step deltas; a finished entry
+        # leaves _entries immediately, so its tombstone must queue)
+        self._pending_fin: List[tuple] = []
+        # grammar tables already durably appended (hash set): many
+        # sessions share one grammar, and the dense table is MBs at
+        # serving vocab sizes — it goes to disk ONCE per hash, and
+        # per-session records carry only the hash. Cleared at every
+        # checkpoint (which carries its own grammar dict), so a
+        # post-checkpoint submit re-appends tables the pruning may
+        # have compacted away.
+        self._wal_grammars: set = set()
 
-    def record_submit(self, req) -> JournalEntry:
+    def _wal_submit(self, e: JournalEntry,
+                    now: Optional[float] = None) -> None:
+        grammars: Dict[str, Dict] = {}
+        rec = e.as_record(now, grammars=grammars)
+        rec["admitted"] = e.admitted
+        for h, dfa_rec in grammars.items():
+            if h not in self._wal_grammars:
+                self.wal.append("grammar", {"hash": h, "dfa": dfa_rec})
+        # flush=True: the write-ahead ACK — an accepted submission is
+        # OS-durable before the caller gets its handle back
+        self.wal.append("submit", rec, flush=True)
+        # mark only after BOTH appends landed: a submit that failed
+        # after its grammar record leaves the hash unmarked, and the
+        # retry harmlessly re-appends it (last-wins at replay)
+        self._wal_grammars.update(grammars)
+        e.wal_submitted = True
+        e.wal_tokens = len(e.tokens)
+        e.wal_prem = e.preemptions
+        e.wal_swapped = e.swapped
+        e.wal_admitted = e.admitted
+
+    def record_submit(self, req, now: Optional[float] = None
+                      ) -> JournalEntry:
         e = JournalEntry(req)
+        if self.wal is not None:
+            # WRITE-AHEAD: the admission is on disk before the entry is
+            # even registered — a failed append leaves no half-accepted
+            # request (the caller sees the error before any execution)
+            self._wal_submit(e, now)
         self._entries[req.rid] = e
         return e
 
-    def adopt(self, req, rec: Dict) -> JournalEntry:
-        """Re-journal a request rebuilt from a drain checkpoint."""
+    def adopt(self, req, rec: Dict, durable: bool = False,
+              now: Optional[float] = None) -> JournalEntry:
+        """Re-journal a request rebuilt from a drain checkpoint or a
+        cold-restart recovery. ``durable=True`` (the recovery path)
+        marks the entry as already on THIS journal's disk — its WAL
+        records are the very ones recovery just replayed, so only
+        future deltas append. ``now`` (the adopting supervisor's
+        clock) keeps a re-anchored deadline durable: without it the
+        fresh submit record would serialize the deadline as null and a
+        later cold restart would silently stop enforcing the SLO."""
         e = JournalEntry(req)
         e.admitted = bool(rec.get("admitted"))
+        if self.wal is not None:
+            if durable:
+                e.wal_submitted = True
+                e.wal_tokens = len(e.tokens)
+                e.wal_prem = e.preemptions
+                e.wal_swapped = e.swapped
+                e.wal_admitted = e.admitted
+            else:
+                self._wal_submit(e, now)
         self._entries[req.rid] = e
         return e
 
@@ -463,16 +560,29 @@ class RequestJournal:
         """Drop a live entry WITHOUT counting it finished — the
         handoff path: a request exported to another replica is that
         replica's journal's to recover now, and recovering it here too
-        would decode it twice."""
-        self._entries.pop(rid, None)
+        would decode it twice. With a WAL attached the tombstone is
+        durable too, so a cold restart of THIS directory can never
+        resurrect the handed-off session."""
+        e = self._entries.pop(rid, None)
+        if e is not None and self.wal is not None and e.wal_submitted:
+            try:
+                self.wal.append("forget", {"rid": rid})
+            except Exception:
+                pass    # in-memory ownership moved; best-effort stone
 
-    def sync(self, swapped_check=None) -> None:
+    def sync(self, swapped_check=None, wal: bool = True,
+             force: bool = False) -> None:
         """Copy committed host state from the live request handles;
         finished requests leave the journal (their results live on the
         caller's handle — nothing to recover). ``swapped_check(rid) ->
         bool`` — when the engine runs a host tier — marks entries
         whose KV is host-resident (they recover by swap-in, not
-        replay)."""
+        replay). The in-memory pass always completes FIRST; the WAL
+        delta pass (``wal=True``) runs after it on the log's
+        group-commit cadence (``force`` runs it regardless — the
+        drain/checkpoint path), so an append fault can never leave the
+        in-process recovery source stale."""
+        finished: List[tuple] = []
         for rid in list(self._entries):
             e = self._entries[rid]
             req = e.req
@@ -486,7 +596,72 @@ class RequestJournal:
                 e.swapped = bool(swapped_check(rid))
             if req.done:
                 self.finished_total += 1
+                finished.append((e, req.finish_reason))
                 del self._entries[rid]
+        if self.wal is None:
+            return
+        # finished entries leave _entries NOW but their durable
+        # tombstones must queue UNCONDITIONALLY — including on the
+        # recovery path's wal=False sync, or a finished session's
+        # submit record would stand tombstone-less forever and a later
+        # cold restart would resurrect completed work
+        for e, reason in finished:
+            if e.wal_submitted:
+                self._pending_fin.append((e.rid, reason))
+        if not wal or not (force or self.wal.delta_due()):
+            return
+        self.wal.mark_delta()
+        deltas: List[Dict] = []
+        synced: List[JournalEntry] = []
+        for e in list(self._entries.values()) \
+                + [f[0] for f in finished]:
+            if not e.wal_submitted:
+                # a submit-time append failed earlier: heal with the
+                # full record (write-ahead degraded to one-step lag)
+                self._wal_submit(e)
+                continue
+            delta = {}
+            if len(e.tokens) > e.wal_tokens:
+                delta["toks"] = [int(t) for t in
+                                 e.tokens[e.wal_tokens:]]
+            if e.preemptions != e.wal_prem:
+                delta["preemptions"] = e.preemptions
+            if e.swapped != e.wal_swapped:
+                delta["swapped"] = e.swapped
+            if e.admitted != e.wal_admitted:
+                delta["admitted"] = e.admitted
+            if not delta:
+                continue
+            cs = getattr(e.req, "constraint", None)
+            if cs is not None:
+                delta["cstate"] = cs.state_record()
+            delta["rid"] = e.rid
+            deltas.append(delta)
+            synced.append(e)
+        fins, self._pending_fin = self._pending_fin, []
+        deltas += [{"rid": rid, "fin": reason} for rid, reason in fins]
+        if deltas:
+            # ONE batched frame per sync: the per-record framing/flush
+            # cost is what the durability rider measures per step, so a
+            # B-slot commit must not pay it B times (the group-commit
+            # amortization argument, applied to the frame too)
+            try:
+                if len(deltas) == 1 and "fin" not in deltas[0]:
+                    self.wal.append("step", deltas[0])
+                else:
+                    self.wal.append("steps", {"entries": deltas})
+            except BaseException:
+                # the append committed nothing (frame-boundary
+                # rollback): live deltas re-derive from the cursors on
+                # the next sync, but the tombstones would be GONE —
+                # re-queue them before surfacing the fault
+                self._pending_fin = fins + self._pending_fin
+                raise
+            for e in synced:
+                e.wal_tokens = len(e.tokens)
+                e.wal_prem = e.preemptions
+                e.wal_swapped = e.swapped
+                e.wal_admitted = e.admitted
 
     def live_entries(self) -> List[JournalEntry]:
         return [self._entries[r] for r in sorted(self._entries)]
@@ -581,6 +756,42 @@ def load_drain_checkpoint(path: str) -> Dict:
     return {"meta": meta, "key_data": key_data, "prefix": prefix}
 
 
+def _session_from_record(sup: "EngineSupervisor", rec: Dict,
+                         grammars: Optional[Dict] = None):
+    """Rebuild one live request handle from a checkpoint/WAL session
+    record (shared by :meth:`EngineSupervisor.restore` and
+    :meth:`EngineSupervisor.recover_from_disk`): admission params,
+    committed tokens, re-anchored deadline, adapter pin, swapped flag
+    and — when the session was grammar-constrained — an equivalent
+    :class:`~paddle_tpu.serving.constraints.ConstraintState` attached
+    through the engine's validated surface."""
+    from ..inference.predictor import GenerationRequest
+    req = GenerationRequest(
+        rec["rid"], np.asarray(rec["prompt"], np.int32),
+        rec["max_new_tokens"], rec.get("eos_token_id"))
+    req.priority = rec.get("priority", 1)
+    req.adapter_id = int(rec.get("adapter_id", 0))
+    if rec.get("deadline_remaining_s") is not None:
+        # re-anchor the SLO on THIS process's clock (records store
+        # remaining seconds, never monotonic stamps from the dead host)
+        req.deadline_at = sup.clock() + rec["deadline_remaining_s"]
+    req.tokens = list(rec.get("tokens") or ())
+    # a swapped-out session's host payload may have died with the
+    # process (host RAM) or survived (shared/standing store): the
+    # admit-time swap-in probes and falls back to the gated replay
+    # resume either way, so the flag is safe to carry verbatim
+    req.swapped = bool(rec.get("swapped"))
+    if rec.get("admitted"):
+        req.preemptions = int(rec.get("preemptions", 0)) + 1
+        req.finish_reason = FinishReason.PREEMPTED.value
+    if rec.get("constraint") is not None:
+        from .constraints import ConstraintState
+        sup.engine.attach_constraint(
+            req, ConstraintState.from_record(rec["constraint"],
+                                             grammars=grammars))
+    return req
+
+
 class EngineSupervisor:
     """Crash-recovering wrapper around engine + scheduler.
 
@@ -624,7 +835,12 @@ class EngineSupervisor:
                  reuse_compiled: bool = True,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 scheduler_kw: Optional[Dict] = None):
+                 scheduler_kw: Optional[Dict] = None,
+                 wal_dir: Optional[str] = None,
+                 wal_fsync: str = "group",
+                 wal_kw: Optional[Dict] = None,
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_prefix: bool = False):
         self._factory = engine_factory
         self.token_budget = token_budget
         self.watchdog_s = watchdog_s
@@ -636,7 +852,20 @@ class EngineSupervisor:
         self.clock = clock
         self._sleep = sleep
         self._sched_kw = dict(scheduler_kw or {})
-        self.journal = RequestJournal()
+        # durable journal plane (ISSUE 15): wal_dir attaches an on-disk
+        # write-ahead log under the journal — admissions/token commits/
+        # ownership transitions become crash-durable, periodic
+        # incremental checkpoints compact the log without stopping
+        # admissions, and EngineSupervisor.recover_from_disk() rebuilds
+        # a cold-started process from the directory alone
+        self.wal = None
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_prefix = bool(checkpoint_prefix)
+        if wal_dir is not None:
+            from .wal import WriteAheadLog
+            self.wal = WriteAheadLog(wal_dir, fsync=wal_fsync,
+                                     **(wal_kw or {}))
+        self.journal = RequestJournal(wal=self.wal)
         self.degraded_level = 0
         self.recoveries = 0
         self.injected_faults = 0
@@ -657,6 +886,19 @@ class EngineSupervisor:
         self.restored: Dict[int, object] = {}
         self._build()
         self._snapshot_key()
+        if self.wal is not None:
+            # geometry record: cold recovery validates the replacement
+            # engine against it (the restore() contract, made durable)
+            cache = self.engine.cache
+            self.wal.append("meta", {
+                "page_size": cache.page_size, "max_len": cache.max_len,
+                "max_batch": cache.max_batch,
+                "kv_dtype": (str(np.dtype(cache.kv_dtype))
+                             if cache.kv_dtype is not None else None),
+                "constraints": bool(getattr(self.engine, "constraints",
+                                            False)),
+                "next_rid": self._next_rid})
+            self.wal.commit(force=True)
 
     # ---- health ----
     @property
@@ -833,8 +1075,11 @@ class EngineSupervisor:
         self._next_rid = max(self._next_rid, self.engine._next_rid)
         if deadline_s is not None:
             req.deadline_at = self.clock() + float(deadline_s)
+        # write-ahead BEFORE the queue: a failed durable append rejects
+        # the submission here, with the caller watching — never a
+        # request the engine acknowledged but disk never heard of
+        self.journal.record_submit(req, now=self.clock())
         self.scheduler.requeue(req)
-        self.journal.record_submit(req)
         return req
 
     def adopt_running(self, req):
@@ -847,8 +1092,16 @@ class EngineSupervisor:
         self._check_alive()
         self.engine._next_rid = max(self.engine._next_rid, req.rid + 1)
         self._next_rid = max(self._next_rid, self.engine._next_rid)
-        e = self.journal.record_submit(req)
+        e = self.journal.record_submit(req, now=self.clock())
         e.admitted = True
+        if self.journal.wal is not None and e.wal_submitted \
+                and not e.wal_admitted:
+            # the adopt side of a handoff owns recovery from here: make
+            # the admitted flag durable with the submit record's lsn
+            # neighborhood, not a whole step later
+            self.journal.wal.append("step", {"rid": e.rid,
+                                             "admitted": True})
+            e.wal_admitted = True
         return req
 
     # ---- stepping ----
@@ -877,17 +1130,30 @@ class EngineSupervisor:
         """One supervised scheduler step. A failure triggers teardown +
         journal recovery and the step is retried on the rebuilt engine;
         the circuit breaker bounds consecutive failures. Returns False
-        when no work remains."""
+        when no work remains. The post-step bookkeeping
+        (:meth:`_on_success`: journal sync, WAL append/group-commit,
+        incremental checkpoint) is inside the failure domain too — a
+        durable-log fault recovers exactly like a device fault, and
+        the retried step re-runs against the requeued sessions."""
         self._check_alive()
         while True:
             try:
                 alive = self._guarded(self.scheduler.step)
+                self._on_success()
+                if not alive and self.wal is not None:
+                    # going idle: force the buffered delta pass + fsync
+                    # so a QUIESCENT supervisor is always durably
+                    # consistent — the group-commit loss window only
+                    # ever spans work actually in flight (a crash
+                    # mid-window replays it token-identically; it must
+                    # not resurrect work that visibly finished)
+                    self._sync_journal(force=True)
+                    self.wal.commit(force=True)
             except EngineDead:
                 raise
             except Exception as e:  # noqa: BLE001 — classify + recover
                 self._on_failure(e)
                 continue
-            self._on_success()
             return alive
 
     def run(self) -> None:
@@ -896,17 +1162,84 @@ class EngineSupervisor:
         while self.step():
             pass
 
-    def _sync_journal(self):
+    def _sync_journal(self, wal: bool = True, force: bool = False):
         self.journal.sync(swapped_check=getattr(
-            self.engine.cache, "has_swapped", None))
+            self.engine.cache, "has_swapped", None), wal=wal,
+            force=force)
 
     def _on_success(self):
         self.steps_total += 1
         self._consec_failures = 0
         self._sync_journal()
         self._snapshot_key()
+        if self.wal is not None:
+            if (self.engine.temperature != 0.0
+                    and self._key_data is not None):
+                # sampled decode: the PRNG snapshot is recovery state
+                # (greedy replay never consults it — skip the bytes)
+                import base64
+                self.wal.append("key", {
+                    "data": base64.b64encode(
+                        self._key_data.tobytes()).decode(),
+                    "dtype": str(self._key_data.dtype),
+                    "shape": list(self._key_data.shape)})
+            self.wal.commit()       # the group-commit boundary
+            if (self.checkpoint_every
+                    and self.steps_total % self.checkpoint_every == 0):
+                self.checkpoint_now()
         self._deescalate_maybe()
         _obs.serving_journal(self.journal.size, self.journal.token_count)
+
+    def checkpoint_now(self) -> Optional[str]:
+        """One INCREMENTAL checkpoint (ISSUE 15): snapshot the live
+        journal + PRNG key (and, with ``checkpoint_prefix``, the
+        prefix-trie pages — the drain machinery) into an atomic
+        ``ckpt-<lsn>.npz`` next to the log, then prune the segments it
+        covers. Admissions never stop — this is a host-side call
+        between steps; cold recovery is checkpoint + log-suffix
+        replay."""
+        if self.wal is None:
+            return None
+        now = self.clock()
+        cache = self.engine.cache
+        grammars: Dict[str, Dict] = {}
+        meta = {
+            "sessions": [e.as_record(now, grammars=grammars)
+                         for e in self.journal.live_entries()],
+            "grammars": grammars,
+            "next_rid": self._next_rid,
+            "page_size": cache.page_size,
+            "max_len": cache.max_len,
+            "max_batch": cache.max_batch,
+            "kv_dtype": (str(np.dtype(cache.kv_dtype))
+                         if cache.kv_dtype is not None else None),
+            "constraints": bool(getattr(self.engine, "constraints",
+                                        False)),
+            "prefix": None,
+        }
+        arrays: Dict[str, np.ndarray] = {
+            "key_data": self._key_data if self._key_data is not None
+            else np.zeros((0,), np.uint32)}
+        if self.checkpoint_prefix:
+            ckpt = cache.checkpoint_prefix()
+            if ckpt is not None:
+                meta["prefix"] = {
+                    "page_ids": ckpt["page_ids"],
+                    "records": ckpt["records"],
+                    "shapes": {n: list(a.shape)
+                               for n, a in ckpt["arrays"].items()},
+                    "dtypes": {n: str(a.dtype)
+                               for n, a in ckpt["arrays"].items()},
+                }
+                for n, a in ckpt["arrays"].items():
+                    arrays[f"prefix_{n}"] = np.frombuffer(
+                        np.ascontiguousarray(a).tobytes(), np.uint8)
+        path = self.wal.checkpoint(meta, arrays)
+        # the checkpoint carries its own grammar dict and pruning may
+        # compact away earlier grammar records: future submits must
+        # re-append their tables, so the dedupe set resets here
+        self.journal._wal_grammars.clear()
+        return path
 
     def _on_failure(self, err: Exception):
         stalled = isinstance(err, StepStalled)
@@ -969,7 +1302,11 @@ class EngineSupervisor:
         handles mid-race."""
         t0 = _obs.generate_begin()
         if sync:
-            self._sync_journal()
+            # in-memory only: the WAL delta pass is deferred to the
+            # next successful step's sync — a recovery triggered BY a
+            # WAL fault must not re-enter the faulting append mid-
+            # recovery (the cursors heal once appends succeed again)
+            self._sync_journal(wal=False)
         live = self.journal.live_entries()
         # host-resident sessions (ISSUE 10) swap back in: their resume
         # is one page scatter, not a replay — the recovery bill counts
@@ -1005,33 +1342,29 @@ class EngineSupervisor:
         frozen afterwards (submit/step raise) — restore the file into a
         fresh process via :meth:`restore`. Returns a summary dict.
 
-        Refuses (loudly, leaving the supervisor serving) while any live
-        session carries a grammar constraint: the checkpoint does not
-        serialize host DFA objects, so restoring such a session would
-        silently finish it UNCONSTRAINED — let constrained requests
-        finish (or cancel them) before draining."""
+        Live grammar-constrained sessions checkpoint too (ISSUE 15
+        satellite — the old refusal is gone): each session record
+        carries the serialized DFA table + live state id + violation
+        counters, and :meth:`restore` re-attaches an equivalent
+        :class:`~paddle_tpu.serving.constraints.ConstraintState`, so a
+        mid-grammar session resumes always-valid and token-identical
+        (gated in tests/test_wal.py)."""
         self._check_alive()
-        constrained = [e.rid for e in self.journal.live_entries()
-                       if getattr(e, "constrained", False)]
-        if constrained:
-            raise RuntimeError(
-                f"drain: live session(s) {constrained} carry grammar "
-                f"constraints, which a drain checkpoint cannot "
-                f"serialize — restoring them would decode "
-                f"unconstrained. Let them finish or cancel them first")
         t0 = _obs.generate_begin()
         # the overlapped runtime (ISSUE 12) may hold a dispatched-but-
         # uncommitted step: commit it so sessions checkpoint with every
         # token the device already produced (no-op when synchronous)
         self.engine.commit_inflight()
-        self._sync_journal()
+        self._sync_journal(force=True)
         self._snapshot_key()
         now = self.clock()
         cache = self.engine.cache
         ckpt = cache.checkpoint_prefix()
+        grammars: Dict[str, Dict] = {}
         meta = {
-            "sessions": [e.as_record(now)
+            "sessions": [e.as_record(now, grammars=grammars)
                          for e in self.journal.live_entries()],
+            "grammars": grammars,
             "next_rid": self._next_rid,
             "page_size": cache.page_size,
             "max_len": cache.max_len,
@@ -1065,6 +1398,20 @@ class EngineSupervisor:
         # bricking a healthy engine with nothing saved would strand
         # every in-flight session
         self._draining = True
+        if self.wal is not None:
+            # the drain checkpoint owns these sessions now: tombstone
+            # them in the WAL (and fsync) so a cold recovery of this
+            # directory can never resurrect what restore() will also
+            # revive elsewhere — exactly one recovery owner
+            try:
+                for e in self.journal.live_entries():
+                    if e.wal_submitted:
+                        self.wal.append("finish", {"rid": e.rid,
+                                                   "reason": "drained"})
+                self.wal.commit(force=True)
+                self.wal.close()
+            except Exception:
+                pass        # drain file is authoritative regardless
         nbytes = os.path.getsize(path)
         n_pages = len(meta["prefix"]["page_ids"]) if meta["prefix"] \
             else 0
@@ -1114,30 +1461,100 @@ class EngineSupervisor:
             n_pages = len(ckpt["prefix"]["page_ids"])
         sup._next_rid = int(meta["next_rid"])
         sup.engine._next_rid = max(sup.engine._next_rid, sup._next_rid)
-        from ..inference.predictor import GenerationRequest
         sup.restored: Dict[int, object] = {}
         for rec in meta["sessions"]:
-            req = GenerationRequest(
-                rec["rid"], np.asarray(rec["prompt"], np.int32),
-                rec["max_new_tokens"], rec["eos_token_id"])
-            req.priority = rec["priority"]
-            req.adapter_id = int(rec.get("adapter_id", 0))
-            if rec.get("deadline_remaining_s") is not None:
-                # re-anchor the SLO on THIS process's clock (the
-                # checkpoint stores remaining seconds, not monotonic
-                # stamps from the drained host)
-                req.deadline_at = (sup.clock()
-                                   + rec["deadline_remaining_s"])
-            req.tokens = list(rec["tokens"])
-            if rec["admitted"]:
-                req.preemptions = rec["preemptions"] + 1
-                req.finish_reason = FinishReason.PREEMPTED.value
-            sup.journal.adopt(req, rec)
+            req = _session_from_record(sup, rec,
+                                       grammars=meta.get("grammars"))
+            sup.journal.adopt(req, rec, now=sup.clock())
             sup.scheduler.requeue(req)
             sup.restored[req.rid] = req
         _obs.serving_drain_restore(t0, os.path.getsize(path),
                                    len(meta["sessions"]), n_pages)
         return sup
+
+    # ---- cold-restart recovery (ISSUE 15) ----
+    @classmethod
+    def recover_from_disk(cls, engine_factory: Callable, wal_dir: str,
+                          **kw) -> "EngineSupervisor":
+        """Rebuild a supervisor from its durable journal directory
+        after WHOLE-PROCESS death (``kill -9``, OOM-kill, host reboot
+        — no drain, no in-memory journal): scan the WAL (torn tail
+        truncated at the last valid frame, corrupt media quarantined,
+        newest VALID checkpoint + log-suffix replay), build a fresh
+        engine, and requeue every journaled live session through the
+        ``resume_sequence`` replay path — token-identical to an
+        uninterrupted run, the same gate the in-process recovery
+        carries (tests/test_wal.py crash-point sweep). The recovered
+        supervisor keeps appending to the SAME directory, so repeated
+        crashes recover repeatedly."""
+        from .wal import recover_state
+        t0 = _obs.generate_begin()
+        state = recover_state(wal_dir, repair=True)
+        kw = dict(kw)
+        wk = dict(kw.get("wal_kw") or {})
+        # the scan just ran (and repaired): hand its lsn to the fresh
+        # log so construction doesn't re-read every segment
+        wk.setdefault("last_lsn", state["report"]["last_lsn"])
+        kw["wal_kw"] = wk
+        sup = cls(engine_factory, wal_dir=wal_dir, **kw)
+        sup._install_recovered(state, t0)
+        return sup
+
+    def _install_recovered(self, state: Dict, t0: int = 0) -> None:
+        """Apply a :func:`~paddle_tpu.serving.wal.recover_state` fold:
+        validate geometry, install the PRNG snapshot, requeue every
+        live session (durable journal entries — only future deltas
+        append)."""
+        geo = state.get("geometry")
+        cache = self.engine.cache
+        if geo is not None:
+            for knob in ("page_size", "max_len", "max_batch"):
+                if geo.get(knob) is not None \
+                        and geo[knob] != getattr(cache, knob):
+                    raise ValueError(
+                        f"recover_from_disk: journaled {knob}="
+                        f"{geo[knob]} does not match the fresh "
+                        f"engine's {getattr(cache, knob)} — the "
+                        f"factory must rebuild the dead engine's "
+                        f"geometry")
+            kv = (str(np.dtype(cache.kv_dtype))
+                  if cache.kv_dtype is not None else None)
+            if geo.get("kv_dtype") != kv:
+                raise ValueError(
+                    f"recover_from_disk: journaled kv_dtype="
+                    f"{geo.get('kv_dtype')} != engine kv_dtype={kv}")
+        key_data = state.get("key_data")
+        if key_data is not None and key_data.size:
+            import jax
+            import jax.numpy as jnp
+            self._key_data = np.asarray(key_data)
+            self.engine._key = jax.random.wrap_key_data(
+                jnp.asarray(key_data))
+        if state.get("prefix") is not None:
+            # checkpoint_prefix payload: write the trie pages back
+            # into the fresh pool FIRST, so the session replays below
+            # (and future admissions) hit the restored prefix cache —
+            # the same ordering restore() uses
+            cache.restore_prefix(state["prefix"])
+        self._next_rid = max(self._next_rid,
+                             int(state.get("next_rid", 0)))
+        self.engine._next_rid = max(self.engine._next_rid,
+                                    self._next_rid)
+        report = state.get("report", {})
+        self.restored = {}
+        for rid in sorted(state.get("sessions", {})):
+            rec = state["sessions"][rid]
+            req = _session_from_record(self, rec,
+                                       grammars=state.get("grammars"))
+            self.journal.adopt(req, rec, durable=True)
+            self.scheduler.requeue(req)
+            self.restored[req.rid] = req
+        _obs.serving_wal_recovery(
+            t0, len(self.restored),
+            int(report.get("replayed_records", 0)),
+            int(report.get("torn_tail_truncated", 0)),
+            int(report.get("corrupt_quarantined", 0))
+            + int(report.get("ckpt_quarantined", 0)))
 
     # ---- introspection ----
     def load_stats(self) -> Dict:
@@ -1156,6 +1573,11 @@ class EngineSupervisor:
                  "degraded_mode": "dead"})
         s["health"] = self.health
         s["draining"] = self._draining
+        if self.wal is not None:
+            # durable-plane lag signal (ISSUE 15): how far the on-disk
+            # journal trails host state — a router/autoscaler can keep
+            # crash-exposure bounded the same way it reads backlog
+            s["wal"] = self.wal.stats()
         return s
 
     def stats(self) -> Dict:

@@ -83,8 +83,10 @@ def pytest_collection_modifyitems(config, items):
             return 0
         if "test_traffic" in path:
             return 1
-        if "test_adapters" in path:     # ISSUE 14: newest, dead last
+        if "test_adapters" in path:
             return 2
+        if "test_wal" in path:          # ISSUE 15: newest, dead last
+            return 3
         return None
     tail = sorted((it for it in rest if _tail_rank(it) is not None),
                   key=_tail_rank)

@@ -608,30 +608,51 @@ class TestConstrainedEngine:
         assert snap["serving_constrain_violations_avoided_total"][
             "values"][""] == 1
 
-    def test_drain_refuses_live_constrained_sessions(self, tmp_path):
-        """A drain checkpoint cannot serialize host DFA state, so
-        drain() must refuse while a constrained session is live (a
-        silent drop would finish it UNCONSTRAINED) — and succeed once
-        it retires."""
+    def test_drain_carries_live_constrained_sessions(self, tmp_path):
+        """ISSUE 15 satellite: a drain checkpoint now SERIALIZES live
+        grammar state (dense DFA table + state id + violation
+        counters), so draining mid-grammar works — and the restored
+        session finishes always-valid and token-identical to the
+        uninterrupted constrained run (the standing refusal is gone).
+        A restore into an engine WITHOUT constraints=True still fails
+        loudly instead of silently decoding unconstrained."""
         def factory():
             return ContinuousBatchingEngine(
                 _PARAMS, _CFG, max_batch=1, page_size=8, max_len=32,
                 constraints=True, eos_token_id=2)
 
+        dfa = dfa_from_sequences([[4, 5, 6, 7, 8, 9]], _CFG.vocab_size)
+        p = _prompts([4], seed=14)[0]
+        ref_eng = factory()
+        ref = ref_eng.submit(p, max_new_tokens=5, constraint=dfa)
+        ref_eng.run()
+
         sup = EngineSupervisor(factory, backoff_s=0.0,
                                sleep=lambda s: None)
-        dfa = dfa_from_sequences([[4, 5, 6, 7]], _CFG.vocab_size)
-        r = sup.submit(_prompts([4], seed=14)[0], max_new_tokens=3,
-                       constraint=dfa)
-        sup.step()
+        r = sup.submit(p, max_new_tokens=5, constraint=dfa)
+        for _ in range(4):                 # mid-grammar: some tokens in
+            sup.step()
+        assert r.tokens and not r.done
         path = str(tmp_path / "drain.npz")
-        with pytest.raises(RuntimeError, match="constraint"):
-            sup.drain(path)
-        assert not sup._draining           # still serving
-        sup.run()
-        assert r.done
         summary = sup.drain(path)
-        assert summary is not None
+        assert summary["sessions"] == 1
+        # an engine with no mask input must refuse the restore loudly
+        def bare_factory():
+            return ContinuousBatchingEngine(
+                _PARAMS, _CFG, max_batch=1, page_size=8, max_len=32,
+                eos_token_id=2)
+        with pytest.raises(ValueError, match="constraints=True"):
+            EngineSupervisor.restore(bare_factory, path,
+                                     backoff_s=0.0,
+                                     sleep=lambda s: None)
+        sup2 = EngineSupervisor.restore(factory, path, backoff_s=0.0,
+                                        sleep=lambda s: None)
+        sup2.run()
+        r2 = sup2.restored[r.rid]
+        np.testing.assert_array_equal(r2.output, ref.output)
+        # always-valid: every emitted token walks the grammar (or eos)
+        assert r2.constraint is not None and r2.constraint.finished \
+            or all(t in (4, 5, 6, 7, 8, 9, 2) for t in r2.tokens)
 
     def test_eosless_engine_completed_grammar_freeruns(self):
         """Regression: on an engine with NO eos id, a grammar

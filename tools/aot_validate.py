@@ -1125,6 +1125,86 @@ def validate_serving_adapters(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_wal(n: int, batch_mult: int = 1):
+    """ISSUE 15 cold-restart lowering gate: AOT-export the RECOVERY-
+    CRITICAL program set — what a freshly-booted process must compile
+    before a ``recover_from_disk`` replay can serve its first token —
+    at the crash-sweep geometry, fp and int8-KV:
+
+    - the continuation-prefill REPLAY chunk (``ctx_len > 0`` — every
+      journaled session re-enters decode through it),
+    - the masked ragged decode step the replayed sessions then run,
+    - the checkpoint-prefix restore scatter
+      (``paged_cache._pool_scatter`` — the program that writes a WAL
+      checkpoint's trie pages back into the fresh pool).
+
+    ``compile_s`` is the headline: it is the compile half of recovery
+    MTTR (the replay half is journal-proportional — PERF_NOTES
+    'Durability'). Export completing is the gate (pure-XLA paths)."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.serving.paged_cache import _pool_scatter
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    params = llama.init_params(jax.random.key(0), cfg)
+    B, pg, k = 8, 16, 4
+
+    def export_tier(tag, kv=None):
+        pool = gen.init_paged_cache(
+            cfg, num_pages=2 * B * (256 // pg) + 1, page_size=pg,
+            kv_dtype=kv)
+        tables = jnp.asarray(rs.randint(1, B * 4, (B, 256 // pg)),
+                             jnp.int32)
+        # recovery replay: prompt + tokens[:-1] continues against the
+        # session's own pages — a CONTINUATION chunk (ctx_len > 0),
+        # not the fresh-prefill shape the serving config lowers
+        chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 32)),
+                            jnp.int32)
+        jax.export.export(
+            jax.jit(lambda p, c, pl_, bt_, cl, kl:
+                    gen.paged_prefill_chunk(
+                        p, c, pl_, bt_, cfg, ctx_cap=64, ctx_len=cl,
+                        chunk_len=kl)),
+            platforms=["tpu"])(params, chunk, pool, tables[0],
+                               jnp.int32(48), jnp.int32(32))
+        lowered[f"recovery_replay_chunk_{tag}"] = True
+        toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B,)),
+                           jnp.int32)
+        lens = jnp.asarray(rs.randint(1, 200, (B,)), jnp.int32)
+        msk = jnp.asarray(rs.rand(B) > 0.5)
+        jax.export.export(
+            jax.jit(lambda p, t, pl_, bt_, ln_, m:
+                    gen.paged_decode_forward(
+                        p, t, pl_, bt_, ln_, cfg, active=m)),
+            platforms=["tpu"])(params, toks, pool, tables, lens, msk)
+        lowered[f"recovered_decode_step_{tag}"] = True
+        vals = {nm: np.zeros((a.shape[0], k) + a.shape[2:], a.dtype)
+                for nm, a in pool.items()}
+        ids = jnp.asarray(rs.choice(np.arange(1, 2 * B), k,
+                                    replace=False).astype(np.int32))
+        jax.export.export(
+            jax.jit(_pool_scatter, donate_argnums=(0,)),
+            platforms=["tpu"])(pool, vals, ids)
+        lowered[f"ckpt_prefix_restore_{tag}"] = True
+
+    export_tier("fp")
+    export_tier("int8", kv="int8")
+    ok = all(lowered.values())
+    return {
+        "config": "serving_wal_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def _impl(args) -> int:
     rows = []
 
@@ -1160,6 +1240,8 @@ def _impl(args) -> int:
         emit(validate_serving_async(args.devices, args.batch_mult))
     if args.config in ("serving-adapters", "all"):
         emit(validate_serving_adapters(args.devices, args.batch_mult))
+    if args.config in ("serving-wal", "all"):
+        emit(validate_serving_wal(args.devices, args.batch_mult))
     ok = True
     for r in rows:
         ok = ok and (r.get("fits_v5p") is not False)
@@ -1175,7 +1257,7 @@ def main():
                              "serving", "serving-tp", "serving-cluster",
                              "serving-host", "serving-lowbit",
                              "serving-async", "serving-adapters",
-                             "all"],
+                             "serving-wal", "all"],
                     default="all")
     ap.add_argument("--batch-mult", type=int, default=1,
                     help="scale the recipe batch to probe HBM headroom")

@@ -69,6 +69,8 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
              max_steps: int = 20000, stall_faults: int = 2) -> dict:
     """One seeded soak; returns the report dict (raises
     :class:`SoakError` on any invariant violation)."""
+    import tempfile
+
     import jax
     from paddle_tpu import observability as obs
     from paddle_tpu.models import llama
@@ -76,7 +78,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     from paddle_tpu.serving import (AdapterPool, AdapterRegistry,
                                     EngineDead, EngineSupervisor,
                                     FaultInjector, HostPageStore,
-                                    Priority, init_lora)
+                                    InjectedFault, Priority, init_lora)
     from paddle_tpu.serving.resilience import ENGINE_SITES as SITES
 
     cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
@@ -128,7 +130,12 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     # through adapter ids 0..3 (0 = base) so the 2-slot pool churns
     jobs = []
     for i in range(requests):
-        kind = i % 4
+        # the motif (draftable) job leads: spec verify only runs at
+        # degraded level 0, and the armed-fault ramp starts escalating
+        # the ladder within a few admissions — the first verify must
+        # happen before that (ISSUE 15 widened the armed set, which
+        # pushed the old ordering's first verify past the first rung)
+        kind = (i + 2) % 4
         aid = i % 4                                # adapter id 0..3
         if kind == 0:
             n = int(rs.randint(18, 30))            # chunked prefill
@@ -199,17 +206,42 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                 # spec verify only runs at degraded level 0 — the
                 # first recovery shelves it (no_spec) and every armed
                 # fault elsewhere costs a recovery, so the verify shot
-                # must land on an EARLY call or the site may never
-                # accumulate enough visits to reach a deep nth
-                inj.arm(site, "raise", nth=2)
+                # must land on the FIRST call or the site may never be
+                # visited again before the soak drains (the ISSUE 15
+                # wal sites joined the rate stream, which reshuffled
+                # the seeded recovery timing that nth=2 relied on)
+                inj.arm(site, "raise", nth=1)
+            elif site == "checkpoint_write":
+                # one visit per checkpoint_every steps — a deep nth
+                # may never be reached in a short soak; the first
+                # checkpoint is expendable (it commits nothing when it
+                # faults, and the next period retries)
+                inj.arm(site, "raise", nth=1)
             else:
                 inj.arm(site, "raise", nth=3 + 2 * i)
         for i in range(stall_faults):
             inj.arm("transfer", "stall", nth=30 + 40 * i)
+        # durable journal ON (ISSUE 15): per-step delta cadence
+        # (group_interval_s=0) + a small checkpoint period so the
+        # wal_append / wal_fsync / checkpoint_write sites get organic
+        # per-step visits under the same zero-lost/duplicated gate
         sup = EngineSupervisor(
             factory, watchdog_s=2.0, backoff_s=0.0,
             sleep=lambda s: None, circuit_threshold=10,
-            recover_after=8)
+            recover_after=8,
+            wal_dir=tempfile.mkdtemp(prefix="chaos_wal_"),
+            checkpoint_every=16, wal_kw=dict(group_interval_s=0.0))
+
+        def submit(p, m, prio=Priority.NORMAL, aid=0):
+            # a fault at the write-ahead append rejects the submission
+            # BEFORE the ack — the client's move is a plain retry, and
+            # nothing was half-accepted (the append rolls back)
+            while True:
+                try:
+                    return sup.submit(p, max_new_tokens=m,
+                                      priority=prio, adapter_id=aid)
+                except InjectedFault:
+                    continue
         reqs = []
         steps = 0
         with inj:
@@ -221,8 +253,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             # (ISSUE 10) — would never execute. Arrival dynamics are
             # what make HIGH-preempts-running-LOW happen.
             for p, m, prio, aid in jobs:
-                reqs.append(sup.submit(p, max_new_tokens=m,
-                                       priority=prio, adapter_id=aid))
+                reqs.append(submit(p, m, prio=prio, aid=aid))
                 for _ in range(2):
                     try:
                         sup.step()
@@ -283,9 +314,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                     if sum(1 for r in lows if not r.done) < eng.max_batch:
                         p = rs.randint(3, cfg.vocab_size, (6,)).astype(
                             np.int32)
-                        lows.append(sup.submit(
-                            p, max_new_tokens=6,
-                            priority=Priority.NORMAL))
+                        lows.append(submit(p, 6))
                         reqs.append(lows[-1])
                         topup_jobs.append((p, 6))
                     try:
@@ -296,8 +325,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                     if steps >= max_steps:
                         raise SoakError("swap drill did not settle")
                 p = rs.randint(3, cfg.vocab_size, (4,)).astype(np.int32)
-                reqs.append(sup.submit(p, max_new_tokens=2,
-                                       priority=Priority.HIGH))
+                reqs.append(submit(p, 2, prio=Priority.HIGH))
                 topup_jobs.append((p, 2))
                 while True:
                     try:
@@ -318,8 +346,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                 p = rs.randint(3, cfg.vocab_size,
                                (int(rs.randint(3, 20)),)).astype(np.int32)
                 m = int(rs.randint(3, 6))
-                r = sup.submit(p, max_new_tokens=m,
-                               priority=Priority.NORMAL)
+                r = submit(p, m)
                 jobs.append((p, m, Priority.NORMAL, 0))
                 reqs.append(r)
                 topup_jobs.append((p, m))
@@ -798,6 +825,328 @@ def run_traffic_soak(seed: int = 0, duration_s: float = 3.0,
     }
 
 
+class _ProcessDied(RuntimeError):
+    """The crash harness's simulated ``kill -9``: raised instead of the
+    supervisor's in-process recovery, the supervisor object is then
+    ABANDONED (no cleanup, no drain — host memory 'gone') and a fresh
+    process recovers from the journal directory alone."""
+
+
+def _crashy(sup):
+    """Make ``sup`` die instead of recovering: any step fault now
+    escapes as :class:`_ProcessDied` — the harness abandons the object
+    and calls ``EngineSupervisor.recover_from_disk``."""
+    def die(err):
+        raise _ProcessDied(f"{type(err).__name__}: {err}") from err
+    sup._on_failure = die
+    return sup
+
+
+def _sweep_env(kv_cache_dtype=None, tp=None, constrained=False,
+               spec_k=2):
+    """One crash-sweep environment: config/params (optionally
+    tp-sharded), an engine factory (host tier + adapters + either
+    speculation or constrained decoding — the two compose everywhere
+    except spec×constraints, which the engine rejects), the job list
+    that visits every engine fault site, and per-job uninterrupted
+    references."""
+    import jax
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.serving import (AdapterRegistry, HostPageStore,
+                                    Priority, init_lora)
+    from paddle_tpu.serving.constraints import dfa_from_sequences
+
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = None
+    if tp:
+        from paddle_tpu.distributed.mesh import serving_mesh
+        if len(jax.devices()) < tp:
+            raise RuntimeError(f"crash sweep tp={tp} needs {tp} devices")
+        mesh = serving_mesh(tp)
+    registry = AdapterRegistry(cfg)
+    for aid in (1, 2, 3):
+        registry.register(aid, init_lora(cfg, 4, seed=300 + aid))
+    dfa = (dfa_from_sequences(
+        [[4, 5, 6, 7, 8, 9], [4, 5, 6, 3, 3, 3]], cfg.vocab_size)
+        if constrained else None)
+
+    def factory():
+        kw = dict(max_batch=2, page_size=8, max_len=48,
+                  prefill_chunk=8, kv_cache_dtype=kv_cache_dtype,
+                  host_tier=True, mesh=mesh,
+                  adapters=dict(slots=2, rank=4, registry=registry,
+                                store=HostPageStore(page_size=8)))
+        if constrained:
+            kw["constraints"] = True
+        else:
+            kw.update(spec_k=spec_k, speculator=_speculator(spec_k))
+        return ContinuousBatchingEngine(params, cfg, **kw)
+
+    rs = np.random.RandomState(7)
+    motif = rs.randint(3, cfg.vocab_size, (3,))
+    # (prompt, max_new, priority, adapter_id, constraint): a long
+    # chunked prefill, a speculative motif, adapter churn over the
+    # 2-slot pool (load → demote → promote), then a HIGH burst that
+    # preempts decode-phase victims through the swap pair
+    jobs = [
+        (rs.randint(3, cfg.vocab_size, (18,)).astype(np.int32), 4,
+         Priority.NORMAL, 1, None),
+        (np.tile(motif, 5).astype(np.int32)[:14], 5,
+         Priority.NORMAL, 2, dfa),
+        (rs.randint(3, cfg.vocab_size, (6,)).astype(np.int32), 5,
+         Priority.NORMAL, 3, None),
+        (rs.randint(3, cfg.vocab_size, (5,)).astype(np.int32), 4,
+         Priority.NORMAL, 1, None),
+        (rs.randint(3, cfg.vocab_size, (4,)).astype(np.int32), 2,
+         Priority.HIGH, 0, None),
+        (rs.randint(3, cfg.vocab_size, (7,)).astype(np.int32), 4,
+         Priority.NORMAL, 0, None),
+    ]
+    ref_engine = factory()
+    refs = []
+    for p, m, _prio, aid, con in jobs:
+        r = ref_engine.submit(p, max_new_tokens=m, adapter_id=aid,
+                              constraint=con)
+        ref_engine.run()
+        refs.append(np.asarray(r.output))
+    return factory, jobs, refs, dfa
+
+
+def run_crash_sweep(sites=None, kv_cache_dtype=None, tp=None,
+                    constrained=False, checkpoint_every=3,
+                    max_steps: int = 4000, wal_root=None) -> dict:
+    """The HEADLINE crash-point sweep (ISSUE 15): for each engine
+    fault site, arm one raise, drive a crash-on-fault supervisor until
+    the 'process dies' at that exact site, abandon it, and
+    ``recover_from_disk`` — every acked request must finish
+    TOKEN-IDENTICAL to its uninterrupted reference, zero
+    lost/duplicated, allocator balanced, and the armed site must have
+    actually fired. ``constrained=True`` swaps the speculative engine
+    for a constrained+adapter one (spec×constraints is rejected by the
+    engine), covering mid-grammar sessions on the same gate."""
+    import tempfile
+
+    from paddle_tpu.serving import (EngineSupervisor, FaultInjector,
+                                    InjectedFault)
+    from paddle_tpu.serving.resilience import ENGINE_SITES
+
+    factory, jobs, refs, _dfa = _sweep_env(
+        kv_cache_dtype=kv_cache_dtype, tp=tp, constrained=constrained)
+    if sites is None:
+        sites = list(ENGINE_SITES)
+        if constrained:
+            # a constrained engine rejects spec_k > 0, so the verify
+            # program never runs — the speculative sweep owns that site
+            sites = [s for s in sites if s != "verify_step"]
+    root = wal_root or tempfile.mkdtemp(prefix="crash_sweep_")
+    per_site = {}
+    for site in sites:
+        wd = os.path.join(root, f"{site}-{kv_cache_dtype or 'fp'}"
+                          + (f"-tp{tp}" if tp else "")
+                          + ("-con" if constrained else ""))
+        sup_kw = dict(backoff_s=0.0, sleep=lambda s: None,
+                      circuit_threshold=50, wal_dir=wd,
+                      checkpoint_every=checkpoint_every,
+                      wal_kw=dict(group_interval_s=0.0))
+        sup = _crashy(EngineSupervisor(factory, **sup_kw))
+        inj = FaultInjector(seed=0)
+        # sites behind a bounded in-place retry (the ISSUE 13 swap-in
+        # retry) absorb a single shot without the process ever dying —
+        # arm enough consecutive shots to exhaust the retry budget so
+        # the kill actually lands
+        shots = (sup.engine.cache.swap_in_retries + 1
+                 if site == "swap_in" else 1)
+        for k in range(shots):
+            inj.arm(site, "raise", nth=k + 1)
+        job_of = {}                 # rid -> job index (set at ack)
+        cur = {}                    # rid -> live handle (recoveries
+        #                             supersede the dead object)
+        deaths = 0
+        steps = 0
+
+        def recover():
+            nonlocal sup, deaths
+            deaths += 1
+            sup = _crashy(EngineSupervisor.recover_from_disk(
+                factory, wd, **{k: v for k, v in sup_kw.items()
+                                if k != "wal_dir"}))
+            cur.update(sup.restored)
+
+        with inj:
+            for i, (p, m, prio, aid, con) in enumerate(jobs):
+                while True:
+                    try:
+                        r = sup.submit(p, max_new_tokens=m,
+                                       priority=prio, adapter_id=aid,
+                                       constraint=con)
+                        job_of[r.rid] = i
+                        cur[r.rid] = r
+                        break
+                    except (InjectedFault, _ProcessDied):
+                        # write-ahead append died BEFORE the ack: the
+                        # client never got a handle — recover and
+                        # resubmit, like any client retry
+                        recover()
+                for _ in range(2):
+                    try:
+                        sup.step()
+                    except _ProcessDied:
+                        recover()
+                    steps += 1
+            while True:
+                try:
+                    if not sup.step():
+                        break
+                except _ProcessDied:
+                    recover()
+                steps += 1
+                if steps >= max_steps:
+                    raise SoakError(f"[{site}] sweep did not drain "
+                                    f"within {max_steps} steps")
+        by_job = {j: cur[rid] for rid, j in job_of.items()}
+        if not inj.fired.get(site):
+            raise SoakError(f"[{site}] armed site never fired — the "
+                            f"sweep workload does not visit it")
+        if deaths < 1:
+            raise SoakError(
+                f"[{site}] the site fired but the process never died "
+                f"— the kill was absorbed before it could land")
+        for j, req in by_job.items():
+            if not req.done or req.finish_reason not in ("eos",
+                                                         "max_len"):
+                raise SoakError(
+                    f"[{site}] job {j} lost: done={req.done} "
+                    f"reason={req.finish_reason}")
+            if not np.array_equal(np.asarray(req.output), refs[j]):
+                raise SoakError(
+                    f"[{site}] job {j} diverged after recovery: "
+                    f"{req.output} vs {refs[j]}")
+        if len(by_job) != len(jobs):
+            raise SoakError(f"[{site}] {len(jobs) - len(by_job)} "
+                            f"job(s) never acked")
+        alloc = sup.engine.cache.allocator
+        if sup.engine.cache.prefix is not None:
+            sup.engine.cache.prefix.drop_all(alloc)
+        st = alloc.stats()
+        if st["num_used"] != 0:
+            raise SoakError(f"[{site}] allocator unbalanced after "
+                            f"drain: {st}")
+        per_site[site] = {"deaths": deaths,
+                          "fired": int(inj.fired[site])}
+    return {"mode": "crash_sweep", "tier": kv_cache_dtype or "fp",
+            "tp": tp, "constrained": constrained,
+            "sites": per_site}
+
+
+def run_crash_soak(seed: int = 0, kills: int = 4,
+                   max_steps: int = 8000, wal_root=None) -> dict:
+    """Randomized crash soak (ISSUE 15 CI satellite): a seeded
+    workload against a WAL-backed supervisor, the 'process' killed
+    after a RANDOM armed site (one kill is a torn-write tamper — half
+    a frame reaches disk), recovered from the journal directory each
+    time, with the standing zero-lost/zero-duplicated +
+    token-identity + balanced-allocator gates at the end. Wired into
+    tier-1 via tests/test_wal.py::TestCrashSoak."""
+    import tempfile
+
+    from paddle_tpu.serving import (EngineSupervisor, FaultInjector,
+                                    InjectedFault)
+    from paddle_tpu.serving.resilience import ENGINE_SITES
+
+    factory, jobs, refs, _dfa = _sweep_env()
+    rs = np.random.RandomState(seed)
+    wd = os.path.join(wal_root or tempfile.mkdtemp(prefix="crash_soak_"),
+                      "journal")
+    sup_kw = dict(backoff_s=0.0, sleep=lambda s: None,
+                  circuit_threshold=50, wal_dir=wd, checkpoint_every=4,
+                  wal_kw=dict(group_interval_s=0.0))
+    sup = _crashy(EngineSupervisor(factory, **sup_kw))
+    inj = FaultInjector(seed=seed)
+    # frequently-visited sites so every armed kill actually lands;
+    # the per-site sweep (run_crash_sweep) owns exhaustive coverage
+    kill_sites = [s for s in ENGINE_SITES
+                  if s in ("decode_step", "prefill_chunk", "sched_tick",
+                           "transfer", "dispatch", "commit",
+                           "wal_append", "wal_fsync",
+                           "checkpoint_write")]
+    job_of = {}                     # rid -> job index (set at ack)
+    cur = {}                        # rid -> live handle
+    deaths = 0
+    steps = 0
+
+    def recover():
+        nonlocal sup, deaths
+        deaths += 1
+        sup = _crashy(EngineSupervisor.recover_from_disk(
+            factory, wd, **{k: v for k, v in sup_kw.items()
+                            if k != "wal_dir"}))
+        cur.update(sup.restored)
+
+    job_stream = [jobs[i % len(jobs)] for i in range(3 * len(jobs))]
+    armed = 0
+    with inj:
+        for i, (p, m, prio, aid, con) in enumerate(job_stream):
+            if armed < kills and i % 4 == 1:
+                if armed == kills - 1:
+                    inj.arm_tamper("wal_append",
+                                   nth=int(rs.randint(1, 4)))
+                else:
+                    inj.arm(str(rs.choice(kill_sites)), "raise",
+                            nth=int(rs.randint(1, 6)))
+                armed += 1
+            while True:
+                try:
+                    r = sup.submit(p, max_new_tokens=m, priority=prio,
+                                   adapter_id=aid, constraint=con)
+                    job_of[r.rid] = i % len(jobs)
+                    cur[r.rid] = r
+                    break
+                except (InjectedFault, _ProcessDied):
+                    recover()
+            for _ in range(2):
+                try:
+                    sup.step()
+                except _ProcessDied:
+                    recover()
+                steps += 1
+        while True:
+            try:
+                if not sup.step():
+                    break
+            except _ProcessDied:
+                recover()
+            steps += 1
+            if steps >= max_steps:
+                raise SoakError(f"crash soak did not drain within "
+                                f"{max_steps} steps")
+    if deaths < 1:
+        raise SoakError("no armed kill ever landed — the soak "
+                        "exercised nothing")
+    final = {rid: (cur[rid], j) for rid, j in job_of.items()}
+    lost = [rid for rid, (req, _j) in final.items()
+            if not req.done or req.finish_reason not in ("eos",
+                                                         "max_len")]
+    if lost:
+        raise SoakError(f"lost requests after crash soak: {lost}")
+    mism = [rid for rid, (req, j) in final.items()
+            if not np.array_equal(np.asarray(req.output), refs[j])]
+    if mism:
+        raise SoakError(f"duplicated/diverged token streams: {mism}")
+    alloc = sup.engine.cache.allocator
+    if sup.engine.cache.prefix is not None:
+        sup.engine.cache.prefix.drop_all(alloc)
+    st = alloc.stats()
+    if st["num_used"] != 0:
+        raise SoakError(f"allocator unbalanced after drain: {st}")
+    return {"seed": seed, "mode": "crash", "deaths": deaths,
+            "requests": len(final), "steps": steps,
+            "faults_by_site": {s: n for s, n in inj.fired.items()
+                               if n},
+            "wal_stats": sup.wal.stats()}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -810,6 +1159,14 @@ def main() -> int:
                          "requests cluster-wide + affinity recovery")
     ap.add_argument("--replicas", type=int, default=3,
                     help="cluster-mode replica count")
+    ap.add_argument("--crash", action="store_true",
+                    help="crash mode (ISSUE 15): seeded workload, "
+                         "process-death simulation after random armed "
+                         "sites (incl. a torn WAL write), "
+                         "recover-from-disk each time; asserts zero "
+                         "lost/duplicated + token identity")
+    ap.add_argument("--kills", type=int, default=4,
+                    help="crash-mode simulated process deaths")
     ap.add_argument("--traffic", action="store_true",
                     help="traffic mode (ISSUE 13): trace-driven "
                          "open-loop load against an autoscaling "
@@ -818,6 +1175,14 @@ def main() -> int:
                          "requests and that the replica count both "
                          "grew and shrank")
     args = ap.parse_args()
+    if args.crash:
+        report = run_crash_soak(seed=args.seed, kills=args.kills)
+        print(json.dumps(report, indent=2))
+        print("chaos_soak: OK — process died and recovered from disk "
+              f"{report['deaths']}x, zero lost/duplicated requests, "
+              "token-identical streams, balanced allocator",
+              file=sys.stderr)
+        return 0
     if args.traffic:
         report = run_traffic_soak(seed=args.seed)
         print(json.dumps(report, indent=2))

@@ -110,6 +110,27 @@ REQUIRED = {
         ("_obs.serving_journal(", 1),
         ("_obs.serving_drain_checkpoint(", 1),
         ("_obs.serving_drain_restore(", 1),
+        # durable journal plane (ISSUE 15): the cold-restart recovery
+        # gauge/counters — a recovery that replays sessions invisibly
+        # would make the crash-durability story unauditable
+        ("_obs.serving_wal_recovery(", 1),
+    ],
+    "paddle_tpu/serving/wal.py": [
+        # durable WAL (ISSUE 15): per-record append counter/bytes/
+        # latency, the fsync-ladder latency pair, and the incremental-
+        # checkpoint triple — the fsync-policy overhead model's inputs
+        # (PERF_NOTES 'Durability', decode_durability_overhead rider)
+        ("_obs.serving_wal_append(", 1),
+        ("_obs.serving_wal_fsync(", 1),
+        ("_obs.serving_wal_checkpoint(", 1),
+        # fault sites: append BEFORE the frame write, fsync before the
+        # fsync, checkpoint before the file — none commits anything
+        ('fault_point("wal_append")', 1),
+        ('fault_point("wal_fsync")', 1),
+        ('fault_point("checkpoint_write")', 1),
+        # torn-write tamper: half a frame reaches disk and the 'process
+        # dies' — recovery's tail truncation is what gets exercised
+        ('tamper_point("wal_append")', 1),
     ],
     "paddle_tpu/serving/paged_cache.py": [
         # fault-injection sites (ISSUE 8): allocator alloc/free
@@ -165,6 +186,9 @@ REQUIRED = {
         # BEFORE the allocation — both commit nothing when they fire
         ('fault_point("swap_out")', 1),
         ('fault_point("swap_in")', 1),
+        # disk-bound pruning (ISSUE 15 satellite): the pruned-files/
+        # bytes pair next to the corrupt-unlink counter
+        ("_obs.serving_host_disk_pruned(", 1),
         # payload integrity (ISSUE 13): detection/quarantine/replay
         # events on the swap and promote paths + the bounded-retry
         # counter — the serving_integrity_* family the integrity gate
@@ -263,6 +287,7 @@ _FAULT_SITE_MODULES = (
     "paddle_tpu/serving/host_tier.py",
     "paddle_tpu/serving/cluster.py",
     "paddle_tpu/serving/adapters.py",
+    "paddle_tpu/serving/wal.py",
     "paddle_tpu/inference/predictor.py",
 )
 

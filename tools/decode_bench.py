@@ -136,11 +136,15 @@ def main():
     # ISSUE 12 overlap rider (sync vs double-buffered step ms +
     # host_overhead_fraction) rides next to it
     def _sched():
-        tps, lat, ov = bench_mod.sched_decode_tier(
+        tps, lat, ov, dur = bench_mod.sched_decode_tier(
             params, cfg, db, dp_len, dnew, on_tpu)
         out["decode_sched_step_ms"] = lat
         if ov:
             out["decode_overlap_speedup"] = ov
+        if dur:
+            # durability rider (ISSUE 15): WAL fsync-ladder overhead
+            # vs the journal-off baseline on the same workload
+            out["decode_durability_overhead"] = dur
         return tps
     run_tier("decode_sched_tokens_per_sec", _sched)
 

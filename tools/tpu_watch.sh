@@ -121,6 +121,7 @@ if dec.get("decode_tokens_per_sec") is not None:
                   "decode_offload_resume", "decode_slo_metrics",
                   "decode_fused_speedup",
                   "decode_overlap_speedup",
+                  "decode_durability_overhead",
                   "decode_multilora_density"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:
