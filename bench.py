@@ -206,6 +206,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             # WAL-backed supervisor at each fsync rung vs journal-off —
             # the measured cost of crash durability
             rec["extra"]["decode_durability_overhead"] = decode_sched[3]
+        if len(decode_sched) > 4 and decode_sched[4]:
+            # trace rider (ISSUE 16): the same workload with request
+            # tracing ON vs the plain run — the measured price of the
+            # always-on observability switch
+            rec["extra"]["decode_trace_overhead"] = decode_sched[4]
     if decode_spec:
         # the speculative tier's throughput only means something next
         # to the acceptance rate that produced it — they travel together
@@ -467,7 +472,7 @@ def prefix_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
 
 def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                       kv_cache_dtype=None, overlap_rider=True,
-                      durability_rider=True):
+                      durability_rider=True, trace_rider=True):
     """The decode_sched_tokens_per_sec measurement, shared by measure()
     and tools/decode_bench.py so the two sources stay comparable.
 
@@ -575,7 +580,33 @@ def sched_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
         except Exception as e:
             print(f"durability sched rider failed: "
                   f"{type(e).__name__}: {e}"[:300], file=sys.stderr)
-    return tps, lat, rider, durability
+    trace = None
+    if trace_rider:
+        # decode_trace_overhead (ISSUE 16): the IDENTICAL two-wave
+        # workload with request tracing ON — every span-close site
+        # live on every step — against the baseline above. The
+        # zero-cost-when-disabled contract makes the off number the
+        # plain run; the rider prices the on switch.
+        try:
+            from paddle_tpu.observability import tracing as _tracing
+            sched_tr = build(False)
+            _tracing.enable()
+            try:
+                _, tr_lats, _ = measure(sched_tr)
+            finally:
+                _tracing.disable()
+            tr_p50 = round(float(np.percentile(tr_lats, 50)) * 1e3, 3)
+            off = lat["p50_step_ms"]
+            trace = {
+                "tracing_off_step_ms": off,
+                "tracing_on_step_ms": tr_p50,
+                "overhead_frac": (round(tr_p50 / off - 1.0, 4)
+                                  if off else None),
+            }
+        except Exception as e:
+            print(f"trace sched rider failed: {type(e).__name__}: "
+                  f"{e}"[:300], file=sys.stderr)
+    return tps, lat, rider, durability, trace
 
 
 def _durability_rider(params, cfg, db, dp_len, dnew, page,
@@ -1178,6 +1209,8 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                    "decode_overlap_speedup"),
                   ("decode_sched_tokens_per_sec",
                    "decode_durability_overhead"),
+                  ("decode_sched_tokens_per_sec",
+                   "decode_trace_overhead"),
                   ("decode_spec_tokens_per_sec", "decode_spec_acceptance"),
                   ("decode_tp_tokens_per_sec", "decode_tp_scaling"),
                   ("decode_cluster_tokens_per_sec",

@@ -351,10 +351,10 @@ class InFlightStep:
     victim re-decodes the dropped token on resume, greedy-identically,
     so no stream ever forks)."""
     __slots__ = ("kind", "mask", "rids", "seats", "out", "drafts",
-                 "dlen", "t0", "t0f", "raw")
+                 "dlen", "t0", "t0f", "raw", "ttr")
 
     def __init__(self, kind, mask, rids, seats, out, drafts=None,
-                 dlen=None, t0=0, t0f=0, raw=None):
+                 dlen=None, t0=0, t0f=0, raw=None, ttr=0):
         self.kind = kind                # "decode" | "spec"
         self.mask = mask
         self.rids = rids                # per-slot rid snapshot at dispatch
@@ -367,6 +367,7 @@ class InFlightStep:
         self.raw = raw                  # UNCONSTRAINED argmax (B,) when
         #                                 the engine masks sampling — the
         #                                 violation-avoided counter input
+        self.ttr = ttr                  # trace-clock anchor (ISSUE 16)
 
 
 class GenerationRequest:
@@ -388,7 +389,7 @@ class GenerationRequest:
                  "tokens", "done", "finish_reason", "slot",
                  "priority", "deadline_at", "submitted_at",
                  "enqueued_at", "preemptions", "swapped",
-                 "adapter_id", "constraint")
+                 "adapter_id", "constraint", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
         self.rid = rid
@@ -407,6 +408,7 @@ class GenerationRequest:
         self.swapped = False    # KV currently host-resident (ISSUE 10)
         self.adapter_id = 0     # 0 = the base model (ISSUE 14)
         self.constraint = None  # live ConstraintState or None (ISSUE 14)
+        self.trace = None       # RequestTrace riding the handle (ISSUE 16)
 
     def resume_sequence(self) -> np.ndarray:
         """The tokens whose KV must be in the pool before this request
@@ -676,6 +678,9 @@ class ContinuousBatchingEngine:
         self._fence_ns = 0      # device-wait accumulated since last take
         self._next_rid = 0
         self._steps = 0
+        # replica id spans carry (ISSUE 16) — stamped by the cluster /
+        # supervisor; -1 renders as the "router" lane in exports
+        self.replica_id = -1
         self._decode_fn = None
         # slot -> [request, sequence being prefilled (prompt, or the
         # preemption-resume replay), tokens already in pages]
@@ -1068,6 +1073,9 @@ class ContinuousBatchingEngine:
         request's adapter pin (if any) already held."""
         cache = self.cache
         seq = req.resume_sequence()
+        # trace: queue_wait closes at the admission INSTANT (anchored
+        # here), so the swap-in work below lands in swap_ms, not queue
+        t_adm = _obs.serving_trace_now()
         if (req.swapped and req.tokens
                 and getattr(cache, "host", None) is not None):
             # a raised swap_in (injected fault, PoolExhausted) leaves
@@ -1084,14 +1092,33 @@ class ContinuousBatchingEngine:
                 self._last[slot] = np.int32(req.tokens[-1])
                 req.finish_reason = None    # clears transient "preempted"
                 _obs.serving_resumed(1, 0)  # zero replay tokens: swap-in
+                _obs.serving_trace_admitted(
+                    req, replica=self.replica_id, slot=slot, t_ns=t_adm)
+                _obs.serving_trace_span(
+                    req, "swap_in", t_adm, replica=self.replica_id,
+                    slot=slot, seq=len(req.tokens),
+                    meta={"tokens": int(length)})
                 return True
             # payload gone (capacity drop / stale — swap_in counted the
             # fallback): replay below, the gated resume path
+            if t_adm:
+                _obs.serving_trace_mark(
+                    req, "swap_fallback", replica=self.replica_id,
+                    slot=slot, meta={"why": getattr(
+                        cache, "last_swap_fallback", None)})
         _, shared = cache.admit_prompt(
             slot, seq, req.prompt.shape[1] + req.max_new_tokens)
         self._install_slot(slot, req)
         self._pending[slot] = [req, seq, int(shared)]
+        _obs.serving_trace_admitted(
+            req, replica=self.replica_id, slot=slot, t_ns=t_adm,
+            meta={"shared": int(shared)} if t_adm else None)
         if req.preemptions > 0:
+            if t_adm:
+                _obs.serving_trace_mark(
+                    req, "resume_replay", replica=self.replica_id,
+                    slot=slot,
+                    meta={"replay": int(seq.size) - int(shared)})
             # resume re-entry: the replay cost has its own counter —
             # counting it as an admission would drift the occupancy
             # identity (admissions - evictions - preemptions), and its
@@ -1136,6 +1163,7 @@ class ContinuousBatchingEngine:
                 f"preempt_request: request {req.rid} is not running")
         swap = self.swap_candidate(req)
         self._pending.pop(slot, None)
+        t_tr = _obs.serving_trace_now()
         if swap:
             # overlap engines issue the swap-out DMA NON-BLOCKING: the
             # device→host copy rides under the in-flight decode step
@@ -1144,8 +1172,18 @@ class ContinuousBatchingEngine:
             freed = self.cache.swap_out(slot, req.rid,
                                         nonblocking=self.overlap)
             req.swapped = True
+            if t_tr:
+                _obs.serving_trace_span(
+                    req, "swap_out", t_tr, replica=self.replica_id,
+                    slot=slot, seq=len(req.tokens),
+                    meta={"pages": int(freed),
+                          "nonblocking": bool(self.overlap)})
         else:
             freed = self.cache.evict_for_preempt(slot)
+        if t_tr:
+            _obs.serving_trace_mark(
+                req, "preempt", replica=self.replica_id, slot=slot,
+                seq=len(req.tokens), meta={"swap": bool(swap)})
         self._clear_slot(slot)
         req.slot = None
         req.preemptions += 1
@@ -1176,6 +1214,7 @@ class ContinuousBatchingEngine:
             pass                        # scheduler-owned queue entry
         req.done = True
         req.finish_reason = reason
+        _obs.serving_trace_finish(req, reason, replica=self.replica_id)
         if getattr(self.cache, "host", None) is not None:
             # a swap-preempted victim cancelled while evicted retires
             # its host payload with it (nothing will ever swap it in)
@@ -1284,7 +1323,7 @@ class ContinuousBatchingEngine:
         self._inflight_chunks.append(
             {"slot": slot, "req": req, "seat": int(self._seat[slot]),
              "take": take, "t0": t0, "logits": logits, "samp": samp,
-             "rawmax": rawmax})
+             "rawmax": rawmax, "ttr": _obs.serving_trace_now()})
         return width
 
     def _commit_chunk(self, h: Dict) -> int:
@@ -1313,6 +1352,10 @@ class ContinuousBatchingEngine:
             # admission replays the span through its own chunks
             return 0
         done = ent[2] + take
+        _obs.serving_trace_span(
+            req, "prefill_chunk", h.get("ttr", 0),
+            replica=self.replica_id, slot=slot, seq=len(req.tokens),
+            meta={"take": int(take), "done": int(done)})
         if done < ent[1].size:
             ent[2] = done
             return take
@@ -1380,6 +1423,8 @@ class ContinuousBatchingEngine:
 
     def _record_token(self, req: GenerationRequest, tok: int):
         req.tokens.append(int(tok))
+        if len(req.tokens) == 1:
+            _obs.serving_trace_first_token(req)
         if req.slot is not None:
             # keep the vectorized-commit mirror in sync on the scalar
             # paths (prefill first-token, spec commit loop)
@@ -1392,6 +1437,7 @@ class ContinuousBatchingEngine:
     def _retire(self, req: GenerationRequest, reason: str):
         req.done = True
         req.finish_reason = reason
+        _obs.serving_trace_finish(req, reason, replica=self.replica_id)
         self.cache.release(req.slot)
         self._clear_slot(req.slot)
         if self.adapters is not None and req.adapter_id:
@@ -1592,7 +1638,8 @@ class ContinuousBatchingEngine:
         _fault_point("dispatch")
         self._inflight = InFlightStep("decode", mask, self._rids.copy(),
                                       self._seat.copy(), out, t0f=t0f,
-                                      raw=raw)
+                                      raw=raw,
+                                      ttr=_obs.serving_trace_now())
         return self._inflight
 
     def _decode_commit(self, h: InFlightStep) -> int:
@@ -1633,6 +1680,20 @@ class ContinuousBatchingEngine:
             sl, tl = slots.tolist(), toks.tolist()
             for s, t in zip(sl, tl):
                 self._slots[s].tokens.append(t)
+            if h.ttr:
+                # one decode_step span per committed row, closed at the
+                # commit fence (h.ttr anchored at dispatch). The
+                # vectorized append above bypasses _record_token, so
+                # the TTFT stamp happens here for first tokens.
+                t1 = _obs.serving_trace_now()
+                for s in sl:
+                    treq = self._slots[s]
+                    _obs.serving_trace_span(
+                        treq, "decode_step", h.ttr, t1,
+                        replica=self.replica_id, slot=s,
+                        seq=len(treq.tokens))
+                    if len(treq.tokens) == 1:
+                        _obs.serving_trace_first_token(treq)
             if self.constraints:
                 # advance each constrained row's DFA with the token
                 # that actually COMMITTED, refresh its next-step mask,
@@ -1821,7 +1882,8 @@ class ContinuousBatchingEngine:
         _fault_point("dispatch")
         self._inflight = InFlightStep("spec", mask, self._rids.copy(),
                                       self._seat.copy(), out,
-                                      drafts=drafts, dlen=dlen, t0=t0)
+                                      drafts=drafts, dlen=dlen, t0=t0,
+                                      ttr=_obs.serving_trace_now())
         return self._inflight
 
     def _spec_commit(self, h: InFlightStep) -> int:
@@ -1882,6 +1944,11 @@ class ContinuousBatchingEngine:
                 drafted += j
                 accepted += a
                 self.spec.observe(slot, req.rid, j, a)
+            if h.ttr:
+                _obs.serving_trace_span(
+                    req, "spec_verify", h.ttr, replica=self.replica_id,
+                    slot=slot, seq=len(req.tokens),
+                    meta={"drafted": j, "accepted": int(a)})
         if sampled and drafted:
             _obs.serving_sample_accept(drafted, accepted)
         self._steps += 1

@@ -14,7 +14,18 @@ TPU build:
   allocation (``hooks.span`` hands back a shared nullcontext).
 - :mod:`timeline` — merges profiler spans + metrics into one per-phase
   summary dict (``Profiler.phase_summary()``; ``bench.py`` attaches it
-  under each round's ``phases`` key).
+  under each round's ``phases`` key) + the shared sort-stable Chrome
+  trace exporter (``chrome_trace``).
+- :mod:`tracing` — request-scoped distributed tracing for the serving
+  plane: a trace minted at submission rides the request handle through
+  queue/prefill/handoff/swap/decode/recovery, stitching cross-replica
+  hops into one trace; per-request TTFT breakdowns; Chrome export.
+  Independent switch (``tracing.enable(clock_ns=...)``), zero-cost
+  when off.
+- :mod:`flight` — the crash flight recorder: per-supervisor ring of
+  scheduler ticks + request-trace tails, dumped as a CRC-framed
+  ``flight-<ts>.json`` black box on EngineDead / step exceptions / on
+  demand.
 
 Usage::
 
@@ -27,9 +38,15 @@ Usage::
 from . import metrics  # noqa: F401
 from . import hooks  # noqa: F401
 from . import timeline  # noqa: F401
+from . import tracing  # noqa: F401
+from . import flight  # noqa: F401
 from .metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
     counter, gauge, histogram,
 )
 from .hooks import enable, disable, metrics_enabled, span  # noqa: F401
-from .timeline import StepTimeline, phase_summary  # noqa: F401
+from .timeline import (  # noqa: F401
+    StepTimeline, chrome_trace, phase_summary,
+)
+from .tracing import RequestTrace, Tracer  # noqa: F401
+from .flight import FlightRecorder  # noqa: F401

@@ -30,6 +30,7 @@ import time
 
 from ..profiler.profiler import RecordEvent, _Event, _collector
 from . import metrics as _m
+from . import tracing as _tr
 
 #: module-global fast-path flag — call sites read this directly
 enabled = os.environ.get("PADDLE_TPU_METRICS", "").lower() in (
@@ -1300,6 +1301,120 @@ def collective(op: str, x):
     _m.counter("collective_bytes_total",
                "payload bytes through collective calls",
                ("op",)).labels(op).inc(_nbytes(x))
+
+
+# ------- request tracing + flight recorder (ISSUE 16) -------
+#
+# A THIRD switch, independent of metrics and the profiler collector:
+# ``tracing.enabled`` (set via ``tracing.enable()``). Every hook below
+# starts with that one module-attribute read — the PR 1 zero-cost
+# contract — and none of them touches device values: span timestamps
+# come from the tracer's injectable host clock, and call sites close
+# spans only at existing commit fences or on pure host paths
+# (check_sync_points lints tracing.py alongside the dispatch paths).
+
+def serving_trace_now() -> int:
+    """Span anchor from the tracer's (injectable) clock; 0 when
+    tracing is off, so call sites skip the close entirely — the same
+    skip-on-zero convention as :func:`generate_begin`."""
+    if not _tr.enabled:
+        return 0
+    return _tr.TRACER.now()
+
+
+def serving_trace_submit(req, replica: int = -1):
+    """Mint a trace onto a freshly-submitted request handle
+    (idempotent — a handle that already rides a trace keeps it, which
+    is what stitches cross-replica handoff/rehome hops into ONE
+    trace)."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.attach(req, replica=replica)
+    if enabled:
+        _m.counter("serving_trace_requests_total",
+                   "request traces minted at submission").inc()
+
+
+def serving_trace_enqueued(req):
+    """Re-stamp the queue-wait anchor: submission and every requeue
+    (preemption, recovery resume, shed-retry re-dispatch) restart the
+    queue_wait span the next admission closes."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.enqueued(req)
+
+
+def serving_trace_admitted(req, replica: int = -1, slot: int = -1,
+                           meta=None, t_ns: int = 0):
+    """Close the queue_wait span opened at the last enqueue and mark
+    the admission edge (slot assignment). ``t_ns``: admission instant
+    anchored earlier by the caller (keeps queue and swap disjoint on
+    the swap-in admit path)."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.admitted(req, replica=replica, slot=slot, meta=meta,
+                        t_ns=t_ns)
+
+
+def serving_trace_first_token(req):
+    """Explicit TTFT stamp for the row whose first token just
+    committed — called from the commit fence, never from dispatch."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.first_token(req)
+
+
+def serving_trace_span(req, name: str, t0_ns: int, t1_ns: int = 0,
+                       replica: int = -1, slot: int = -1,
+                       seq: int = -1, meta=None):
+    """Close a lifecycle span opened at ``t0_ns`` (a
+    :func:`serving_trace_now` anchor; 0 skips) onto the request's
+    trace. ``seq`` is the per-request step sequence — committed-token
+    count at close — so step participation is reconstructable."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.record(req, name, t0_ns, t1_ns, replica=replica,
+                      slot=slot, seq=seq, meta=meta)
+
+
+def serving_trace_mark(req, name: str, replica: int = -1,
+                       slot: int = -1, seq: int = -1, meta=None):
+    """Zero-duration point event (preempt, dispatch, rehome, WAL
+    replay, ...)."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.mark(req, name, replica=replica, slot=slot, seq=seq,
+                    meta=meta)
+
+
+def serving_trace_finish(req, reason: str, replica: int = -1):
+    """Terminal edge: stamp the finish reason and end timestamp."""
+    if not _tr.enabled:
+        return
+    _tr.TRACER.finish(req, reason, replica=replica)
+
+
+def serving_flight_tick():
+    """One scheduler tick folded into a supervisor's flight-recorder
+    ring (the ring itself lives on the supervisor; this is the
+    metrics-side counter)."""
+    if not enabled:
+        return
+    _m.counter("serving_flight_ticks_total",
+               "scheduler ticks recorded into flight-recorder rings"
+               ).inc()
+
+
+def serving_flight_dump(reason: str, nbytes: int):
+    """One flight-recorder black box written (EngineDead, an exception
+    escaping step(), or on demand): per-reason counter + size gauge."""
+    if not enabled:
+        return
+    _m.counter("serving_flight_dumps_total",
+               "flight-recorder dumps written, by trigger",
+               ("reason",)).labels(reason).inc()
+    _m.gauge("serving_flight_dump_bytes",
+             "size of the last flight-recorder dump").set(nbytes)
 
 
 # ---------------- watchdog ----------------

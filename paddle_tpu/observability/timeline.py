@@ -92,6 +92,46 @@ def phase_summary(events, step_times: Optional[List[float]] = None,
     return out
 
 
+def chrome_trace(rows, pid_names: Optional[Dict[int, str]] = None,
+                 tid_names: Optional[Dict[int, str]] = None) -> dict:
+    """Rows -> a Chrome trace-event dict (``chrome://tracing`` /
+    Perfetto's legacy JSON format). Each row: {name, cat, start_ns,
+    dur_ns, pid, tid, args?}.
+
+    Two properties every exporter in the tree routes through here for
+    (ISSUE 16 bugfix — the old ``Profiler._export_chrome`` emitted one
+    ``os.getpid()`` row, so cluster traces interleaved into a single
+    unreadable lane):
+
+    - DISTINCT pid/tid rows: callers map replica -> pid and slot ->
+      tid (``pid_names``/``tid_names`` become process_name /
+      thread_name metadata events), so a 2-replica handoff renders as
+      two labeled process groups instead of one shredded row.
+    - SORT-STABLE output: events are ordered by (pid, tid, ts, dur,
+      name) and metadata precedes them, so two exports of the same
+      spans serialize byte-identically — golden tests diff the bytes.
+    """
+    meta = []
+    for pid, label in sorted((pid_names or {}).items()):
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": label}})
+    for tid, label in sorted((tid_names or {}).items()):
+        meta.append({"ph": "M", "name": "thread_name", "pid": 0,
+                     "tid": tid, "args": {"name": label}})
+    events = []
+    for r in rows:
+        ev = {"ph": "X", "name": r["name"], "cat": r.get("cat", ""),
+              "pid": int(r.get("pid", 0)), "tid": int(r.get("tid", 0)),
+              "ts": r["start_ns"] / 1e3,        # chrome wants microsecs
+              "dur": r.get("dur_ns", 0) / 1e3}
+        if r.get("args"):
+            ev["args"] = r["args"]
+        events.append(ev)
+    events.sort(key=lambda e: (e["pid"], e["tid"], e["ts"], e["dur"],
+                               e["name"]))
+    return {"traceEvents": meta + events}
+
+
 class StepTimeline:
     """Incremental aggregator over a live profiler run.
 

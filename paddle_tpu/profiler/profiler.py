@@ -334,13 +334,16 @@ class Profiler:
         self._export_chrome(path)
 
     def _export_chrome(self, path: str):
-        evs = []
-        for e in _collector.drain():
-            evs.append({
-                "name": e.name, "ph": "X", "pid": os.getpid(),
-                "tid": e.tid, "ts": e.start / 1000.0,
-                "dur": e.duration / 1000.0,
-                "cat": e.event_type,
-            })
+        # route through the shared sort-stable exporter (ISSUE 16):
+        # distinct pid/tid rows + deterministic ordering, so exports of
+        # the same spans are byte-identical and cluster traces never
+        # interleave into one lane
+        from ..observability.timeline import chrome_trace
+        pid = os.getpid()
+        rows = [{"name": e.name, "cat": e.event_type,
+                 "start_ns": e.start, "dur_ns": e.duration,
+                 "pid": pid, "tid": e.tid}
+                for e in _collector.drain()]
+        doc = chrome_trace(rows, pid_names={pid: f"host {pid}"})
         with open(path, "w") as f:
-            json.dump({"traceEvents": evs}, f)
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))

@@ -323,6 +323,10 @@ class ServingCluster:
                                    clock=self.clock, **kw)
         sup.engine._next_rid = max(sup.engine._next_rid, self._next_rid)
         self._next_rid = max(self._next_rid, sup.engine._next_rid)
+        # replica identity for trace spans + flight dumps (ISSUE 16):
+        # the supervisor propagates it into scheduler/engine and
+        # re-stamps across its own rebuilds
+        sup.replica_id = idx
         self._attach_host_store(sup)
         return sup
 
@@ -381,11 +385,16 @@ class ServingCluster:
         cost = req.prompt.shape[1] + req.max_new_tokens
         self._live[req.rid] = req
         self._meta[req.rid] = {"tenant": tenant, "cost": cost}
+        # trace minted at CLUSTER intake (ISSUE 16) — replica -1 is the
+        # router lane; the handle carries the trace through dispatch,
+        # handoff and failover rehomes, stitching them into one trace
+        _obs.serving_trace_submit(req)
         if not self.router.admit_rate_limit(tenant, cost):
             req.done = True
             req.finish_reason = FinishReason.REJECTED_RATELIMIT.value
             self.router.note_ratelimited(tenant)
             _obs.serving_cancelled(1, req.finish_reason)
+            _obs.serving_trace_finish(req, req.finish_reason)
             return req
         if deadline_s is not None and self.admission is not None:
             # SLO-guarded admission (ISSUE 13): feasibility is judged
@@ -413,9 +422,11 @@ class ServingCluster:
                 req.finish_reason = FinishReason.REJECTED_INFEASIBLE.value
                 self.router.note_slo_rejected(tenant)
                 _obs.serving_cancelled(1, req.finish_reason)
+                _obs.serving_trace_finish(req, req.finish_reason)
                 return req
         if deadline_s is not None:
             req.deadline_at = self.clock() + float(deadline_s)
+        _obs.serving_trace_enqueued(req)
         self._rq.append({"req": req, "tenant": tenant, "cost": cost,
                          "seq": self._seq})
         self._seq += 1
@@ -467,6 +478,7 @@ class ServingCluster:
                 req.finish_reason = FinishReason.DEADLINE_EXCEEDED.value
                 self.deadline_cancels_total += 1
                 _obs.serving_cancelled(1, req.finish_reason)
+                _obs.serving_trace_finish(req, req.finish_reason)
                 continue
             self._dispatch_one(e)
 
@@ -483,6 +495,9 @@ class ServingCluster:
         akey = self.router.adapter_key(getattr(req, "adapter_id", 0))
         idx, hit = self.router.pick_replica(key, loads,
                                             adapter_key=akey)
+        _obs.serving_trace_mark(req, "dispatch", replica=idx,
+                                meta={"affinity_hit": bool(hit),
+                                      "tenant": tenant})
         self.replicas[idx].submit_request(req)
         self.router.note_dispatch(idx, hit, tenant)
         self._owner[req.rid] = idx
@@ -508,6 +523,7 @@ class ServingCluster:
             req.finish_reason = None
             idx2, _ = self.router.pick_replica(None, loads,
                                                exclude=tried)
+            _obs.serving_trace_mark(req, "dispatch_retry", replica=idx2)
             self.replicas[idx2].submit_request(req)
             self.router.note_dispatch(idx2, False, tenant)
             tried.add(idx2)
@@ -708,6 +724,8 @@ class ServingCluster:
         # read — a fault here commits nothing and routes through the
         # PREFILL supervisor's recovery (the _harvest_handoffs catch)
         fault_point("handoff_export")
+        src = getattr(sup, "replica_id", -1)
+        tx = _obs.serving_trace_now()
         # pure host-side read; the direct path exports metadata only —
         # the page bytes move device-to-device inside the import
         payload = eng.export_prefilled(req, with_kv=not direct)
@@ -719,12 +737,18 @@ class ServingCluster:
         nbytes = (eng.cache.page_payload_bytes(pages) if direct else
                   sum(a.nbytes for a in payload["kv"]["arrays"].values()))
         _obs.serving_handoff_export(t0, nbytes, pages)
+        _obs.serving_trace_span(req, "handoff_export", tx, replica=src,
+                                slot=payload["slot"],
+                                seq=len(req.tokens),
+                                meta={"bytes": int(nbytes),
+                                      "pages": int(pages)})
         placed = None
         for didx in sorted(decode_loads,
                            key=lambda d: self.router._score(
                                decode_loads[d]) + (d,)):
             dsup = self.replicas[didx]
             t1 = _obs.generate_begin()
+            t1t = _obs.serving_trace_now()
             attempts = 0
             while True:
                 try:
@@ -736,6 +760,12 @@ class ServingCluster:
                             self.handoff_timeout_s):
                         placed = didx
                         _obs.serving_handoff_import(t1)
+                        _obs.serving_trace_span(
+                            req, "handoff_import", t1t, replica=didx,
+                            slot=(req.slot if req.slot is not None
+                                  else -1),
+                            seq=len(req.tokens),
+                            meta={"src": int(src)})
                     break               # placed, or no free slot there
                 except PoolExhausted:
                     break               # full pool: try the next replica
@@ -823,6 +853,8 @@ class ServingCluster:
                 loads = self._alive(self._decode_idxs()) or self._alive(
                     range(len(self.replicas)))
                 idx, _ = self.router.pick_replica(None, loads)
+                _obs.serving_trace_mark(req, "rehome", replica=idx,
+                                        seq=len(req.tokens))
                 self.replicas[idx].submit_request(req)
                 self.router.note_dispatch(idx, False)
                 self._owner[req.rid] = idx

@@ -511,6 +511,10 @@ class TieredKVCache(PagedKVCache):
         self.swap_in_pages_total = 0
         self.swap_replay_fallbacks = 0
         self.swap_in_retries_total = 0
+        #: why the LAST swap_in fell back to replay-prefill
+        #: ("dropped" | "stale" | "corrupt"; None after a success) —
+        #: the predictor's trace mark reads this for the request trace
+        self.last_swap_fallback: Optional[str] = None
         self.corruptions_detected_total = 0
         self.demotions_total = 0
         self.promote_hits_total = 0
@@ -703,6 +707,7 @@ class TieredKVCache(PagedKVCache):
         self.fence_swaps()      # a pending async payload must be visible
         entry = self.host.get(self._swap_key(rid))
         if entry is None:
+            self.last_swap_fallback = "dropped"
             self.swap_replay_fallbacks += 1
             _obs.serving_swap_fallback()
             return None
@@ -711,6 +716,7 @@ class TieredKVCache(PagedKVCache):
             # the journal rolled the request past/behind this payload
             # (shouldn't happen — tokens only append — but the journal
             # is authoritative): drop and replay rather than trust it
+            self.last_swap_fallback = "stale"
             self.drop_swapped(rid)
             self.swap_replay_fallbacks += 1
             _obs.serving_swap_fallback()
@@ -725,6 +731,7 @@ class TieredKVCache(PagedKVCache):
         try:
             arrays = self._decode_validated(entry, k=k, site="swap_in")
         except CorruptionDetected:
+            self.last_swap_fallback = "corrupt"
             self._quarantine_swap_in(rid)
             return None
         # bounded idempotent retry (ISSUE 13): a transient fault at the
@@ -750,6 +757,7 @@ class TieredKVCache(PagedKVCache):
             except PoolExhausted:
                 raise
             except CorruptionDetected:
+                self.last_swap_fallback = "corrupt"
                 self._quarantine_swap_in(rid)
                 return None
             except Exception:
@@ -761,6 +769,7 @@ class TieredKVCache(PagedKVCache):
                 self._retry_sleep(min(0.2, 0.005 * 2 ** (attempt - 1)))
         self._install(slot, pages)
         self.lengths[slot] = length
+        self.last_swap_fallback = None
         self.host.pop(self._swap_key(rid))
         self.swap_ins_total += 1
         self.swap_in_pages_total += k

@@ -69,6 +69,7 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import threading
 import time
 import zlib
@@ -840,7 +841,8 @@ class EngineSupervisor:
                  wal_fsync: str = "group",
                  wal_kw: Optional[Dict] = None,
                  checkpoint_every: Optional[int] = None,
-                 checkpoint_prefix: bool = False):
+                 checkpoint_prefix: bool = False,
+                 flight_ticks: int = 256):
         self._factory = engine_factory
         self.token_budget = token_budget
         self.watchdog_s = watchdog_s
@@ -884,6 +886,17 @@ class EngineSupervisor:
         self.engine = None
         self.scheduler = None
         self.restored: Dict[int, object] = {}
+        # crash flight recorder (ISSUE 16): a fixed ring of the last N
+        # scheduler ticks, dumped as a CRC-framed black box on
+        # EngineDead / any exception escaping step() / on demand.
+        # flight_ticks=0 disables the recorder entirely.
+        self._replica_id = -1
+        self.flight = None
+        if flight_ticks:
+            from ..observability.flight import FlightRecorder
+            self.flight = FlightRecorder(max_ticks=flight_ticks,
+                                         meta={"replica": -1})
+        self.last_flight_dump: Optional[str] = None
         self._build()
         self._snapshot_key()
         if self.wal is not None:
@@ -910,6 +923,22 @@ class EngineSupervisor:
     @property
     def degraded_mode(self) -> str:
         return DEGRADED_MODES[self.degraded_level]
+
+    @property
+    def replica_id(self) -> int:
+        """Cluster replica index carried by trace spans and flight
+        dumps; -1 for a standalone supervisor. The setter propagates to
+        the engine (and :meth:`_build` re-stamps across rebuilds), so
+        cross-replica handoffs stitch into one trace."""
+        return self._replica_id
+
+    @replica_id.setter
+    def replica_id(self, value: int) -> None:
+        self._replica_id = int(value)
+        if self.engine is not None:
+            self.engine.replica_id = self._replica_id
+        if self.flight is not None:
+            self.flight.meta["replica"] = self._replica_id
 
     def _check_alive(self):
         if self._dead:
@@ -969,6 +998,9 @@ class EngineSupervisor:
             eng._key = jax.random.wrap_key_data(
                 jnp.asarray(self._key_data))
         self.engine = eng
+        # re-stamp the replica identity across rebuilds (ISSUE 16) —
+        # spans from the recovered engine must land in the same lane
+        eng.replica_id = getattr(self, "_replica_id", -1)
         self.scheduler = ServingScheduler(
             eng, token_budget=self.token_budget, clock=self.clock,
             **self._sched_kw)
@@ -1078,7 +1110,13 @@ class EngineSupervisor:
         # write-ahead BEFORE the queue: a failed durable append rejects
         # the submission here, with the caller watching — never a
         # request the engine acknowledged but disk never heard of
-        self.journal.record_submit(req, now=self.clock())
+        try:
+            self.journal.record_submit(req, now=self.clock())
+        except BaseException as exc:
+            # a submit-path death never reaches step()'s dump hook —
+            # leave the black box on this exit too (ISSUE 16)
+            self._flight_dump_safe(type(exc).__name__, err=str(exc))
+            raise
         self.scheduler.requeue(req)
         return req
 
@@ -1136,10 +1174,23 @@ class EngineSupervisor:
         durable-log fault recovers exactly like a device fault, and
         the retried step re-runs against the requeued sessions."""
         self._check_alive()
+        try:
+            return self._step_supervised()
+        except BaseException as exc:
+            # black box on the way out (ISSUE 16): EngineDead (circuit
+            # open) and anything a failure handler re-raised leave a
+            # flight dump next to the journal before propagating —
+            # even when _on_failure itself was replaced (the chaos
+            # harness's process-kill surrogate raises from inside it)
+            self._flight_dump_safe(type(exc).__name__, err=str(exc))
+            raise
+
+    def _step_supervised(self) -> bool:
         while True:
             try:
                 alive = self._guarded(self.scheduler.step)
                 self._on_success()
+                self._record_flight_tick()
                 if not alive and self.wal is not None:
                     # going idle: force the buffered delta pass + fsync
                     # so a QUIESCENT supervisor is always durably
@@ -1189,6 +1240,70 @@ class EngineSupervisor:
                 self.checkpoint_now()
         self._deescalate_maybe()
         _obs.serving_journal(self.journal.size, self.journal.token_count)
+
+    # ---- flight recorder (ISSUE 16) ----
+    def _record_flight_tick(self, fault: Optional[str] = None) -> None:
+        """Fold one scheduler tick into the flight ring: plan summary,
+        budget use, degraded rung, failure streak, WAL lsn. One small
+        dict append — noise next to the WAL append the tick already
+        paid; no-op when the recorder is disabled."""
+        if self.flight is None:
+            return
+        sched = self.scheduler
+        plan = sched.last_plan if sched is not None else None
+        self.flight.record_tick(
+            step=self.steps_total,
+            committed=(sched.last_committed if sched is not None else 0),
+            planned_tokens=(plan.scheduled_tokens if plan is not None
+                            else 0),
+            reserved_tokens=(plan.reserved_tokens if plan is not None
+                             else 0),
+            budget=(plan.budget if plan is not None else None),
+            decode_slots=(len(plan.decode_slots) if plan is not None
+                          else 0),
+            prefills=(len(plan.prefills) if plan is not None else 0),
+            queued=(sum(len(q) for q in sched._queues.values())
+                    if sched is not None else 0),
+            degraded=self.degraded_level,
+            failures=self._consec_failures,
+            host_frac=(sched.last_host_frac if sched is not None
+                       else None),
+            wal_lsn=(self.wal.lsn if self.wal is not None else None),
+            fault=fault)
+        _obs.serving_flight_tick()
+
+    def dump_flight(self, reason: str = "manual",
+                    out_dir: Optional[str] = None,
+                    err: Optional[str] = None) -> Optional[str]:
+        """Write the flight-recorder black box (on demand, and the
+        crash paths' exit hatch): the tick ring + request-trace tails
+        as a CRC-framed ``flight-<ts>.json`` in ``out_dir`` (default:
+        the WAL/journal directory, else the system temp dir). Returns
+        the path; None when the recorder is disabled."""
+        if self.flight is None:
+            return None
+        if out_dir is None:
+            out_dir = (self.wal.path if self.wal is not None
+                       else tempfile.gettempdir())
+        extra = {"health": self.health,
+                 "degraded_level": self.degraded_level,
+                 "consec_failures": self._consec_failures,
+                 "recoveries": self.recoveries,
+                 "steps_total": self.steps_total}
+        if err:
+            extra["error"] = err
+        path = self.flight.dump(out_dir, reason, extra=extra)
+        self.last_flight_dump = path
+        _obs.serving_flight_dump(reason, os.path.getsize(path))
+        return path
+
+    def _flight_dump_safe(self, reason: str, err: str = "") -> None:
+        """Best-effort dump on the crash path — a second failure here
+        must never mask the one propagating."""
+        try:
+            self.dump_flight(reason, err=err)
+        except Exception:
+            pass
 
     def checkpoint_now(self) -> Optional[str]:
         """One INCREMENTAL checkpoint (ISSUE 15): snapshot the live
@@ -1269,6 +1384,9 @@ class EngineSupervisor:
             self.real_faults += 1
             _obs.serving_fault(site, kind, injected=False)
         self._consec_failures += 1
+        # a faulted tick never reached the success-path recorder —
+        # fold it in here so the black box shows the firing itself
+        self._record_flight_tick(fault=f"{site}:{kind}")
         if self._consec_failures >= self.circuit_threshold:
             self._die(err)
         self._sleep(min(self.backoff_max_s,
@@ -1498,6 +1616,12 @@ class EngineSupervisor:
         kw["wal_kw"] = wk
         sup = cls(engine_factory, wal_dir=wal_dir, **kw)
         sup._install_recovered(state, t0)
+        # surface the dead incarnation's black box (if it got one out)
+        # so post-mortem tooling finds it next to the recovered WAL
+        from ..observability import flight as _flight
+        dumps = _flight.find_dumps(wal_dir)
+        if dumps:
+            sup.last_flight_dump = dumps[-1]
         return sup
 
     def _install_recovered(self, state: Dict, t0: int = 0) -> None:
@@ -1543,11 +1667,18 @@ class EngineSupervisor:
         report = state.get("report", {})
         self.restored = {}
         for rid in sorted(state.get("sessions", {})):
+            trs = _obs.serving_trace_now()
             rec = state["sessions"][rid]
             req = _session_from_record(self, rec,
                                        grammars=state.get("grammars"))
             self.journal.adopt(req, rec, durable=True)
+            # requeue attaches the trace; the replay span lands after
+            # so the recovered handle actually records it
             self.scheduler.requeue(req)
+            if trs:
+                _obs.serving_trace_span(
+                    req, "wal_replay", trs,
+                    replica=self.replica_id, seq=len(req.tokens))
             self.restored[req.rid] = req
         _obs.serving_wal_recovery(
             t0, len(self.restored),

@@ -138,6 +138,17 @@ class ServingScheduler:
         self.host_frac_ema: Optional[float] = None
         self.idle_fences_total = 0
 
+    # ---- identity (ISSUE 16) ----
+    @property
+    def replica_id(self) -> int:
+        """The replica id trace spans carry — one source of truth (the
+        engine's), stamped by the cluster/supervisor; -1 = unplaced."""
+        return getattr(self.engine, "replica_id", -1)
+
+    @replica_id.setter
+    def replica_id(self, value: int) -> None:
+        self.engine.replica_id = int(value)
+
     # ---- intake ----
     def submit(self, prompt, max_new_tokens: int = 16, *,
                priority=Priority.NORMAL,
@@ -163,6 +174,10 @@ class ServingScheduler:
         if deadline_s is not None:
             req.deadline_at = req.submitted_at + float(deadline_s)
             self._deadlines_live += 1
+        # trace minted HERE (ISSUE 16): it rides the handle through
+        # every lifecycle edge from this point on
+        _obs.serving_trace_submit(req, replica=self.replica_id)
+        _obs.serving_trace_enqueued(req)
         self._queues.setdefault(int(priority), deque()).append(req)
         return req
 
@@ -179,6 +194,11 @@ class ServingScheduler:
             req.submitted_at = req.enqueued_at
         if req.deadline_at is not None:
             self._deadlines_live += 1
+        # attach is idempotent: a handle that already rides a trace
+        # (handoff import, failover rehome) keeps it — stitching; a
+        # recovered handle minted fresh gets one here
+        _obs.serving_trace_submit(req, replica=self.replica_id)
+        _obs.serving_trace_enqueued(req)
         q = self._queues.setdefault(int(req.priority), deque())
         if front:
             q.appendleft(req)
@@ -260,6 +280,7 @@ class ServingScheduler:
         self.engine.preempt_request(victim)
         self.preemptions_total += 1
         victim.enqueued_at = self.clock()   # queue wait restarts here
+        _obs.serving_trace_enqueued(victim)
         self._queues.setdefault(int(victim.priority),
                                 deque()).appendleft(victim)
         return True

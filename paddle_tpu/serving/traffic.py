@@ -208,6 +208,10 @@ class SLOReport:
     steps: int = 0
     autoscale_up: int = 0
     autoscale_down: int = 0
+    #: per-phase TTFT attribution (ISSUE 16) — {phase: {p50_ms,
+    #: p99_ms}} over completed first-token requests, harvested from
+    #: each handle's request trace; None unless tracing was enabled
+    ttft_breakdown: Optional[Dict] = None
 
     def as_dict(self) -> Dict:
         d = dataclasses.asdict(self)
@@ -218,6 +222,10 @@ class SLOReport:
         d["goodput_tokens_per_s"] = round(d["goodput_tokens_per_s"], 2)
         d["deadline_met_fraction"] = round(d["deadline_met_fraction"], 4)
         d["wall_s"] = round(d["wall_s"], 3)
+        if d["ttft_breakdown"] is not None:
+            d["ttft_breakdown"] = {
+                ph: {q: round(v, 3) for q, v in pcts.items()}
+                for ph, pcts in d["ttft_breakdown"].items()}
         return d
 
 
@@ -249,6 +257,10 @@ def run_trace(cluster, trace: List[TraceRequest], clock: FakeClock, *,
     report = SLOReport(requests=len(trace))
     ttfts: List[float] = []
     per_tok: List[float] = []
+    # per-phase TTFT rows (ISSUE 16): harvested from each COMPLETED
+    # handle's own trace, so a shared tracer polluted by other runs
+    # (or LRU aging) never skews this run's percentiles
+    bd_rows: List[Dict] = []
     met = missed = 0
     # arrivals are RELATIVE to the clock at entry, so one cluster (and
     # its compiled programs) can serve a warm pass and a timed pass of
@@ -309,6 +321,11 @@ def run_trace(cluster, trace: List[TraceRequest], clock: FakeClock, *,
                 report.lost += 1
                 continue
             report.completed += 1
+            rtr = getattr(req, "trace", None)
+            if rtr is not None:
+                bd = rtr.ttft_breakdown()
+                if bd is not None:
+                    bd_rows.append(bd)
             ok = True
             if rec["first_s"] is not None:
                 ttft = rec["first_s"] - rec["arrival"]
@@ -357,6 +374,14 @@ def run_trace(cluster, trace: List[TraceRequest], clock: FakeClock, *,
     if per_tok:
         report.p50_per_token_s = float(np.percentile(per_tok, 50))
         report.p99_per_token_s = float(np.percentile(per_tok, 99))
+    if bd_rows:
+        report.ttft_breakdown = {
+            ph: {"p50_ms": float(np.percentile(
+                     [r[ph] for r in bd_rows], 50)),
+                 "p99_ms": float(np.percentile(
+                     [r[ph] for r in bd_rows], 99))}
+            for ph in ("queue_ms", "prefill_ms", "handoff_ms",
+                       "swap_ms", "sched_overhead_ms", "ttft_ms")}
     if auto is not None:
         # THIS run's scaling activity (a warm pass on the same
         # cluster has its own events)

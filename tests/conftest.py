@@ -85,8 +85,10 @@ def pytest_collection_modifyitems(config, items):
             return 1
         if "test_adapters" in path:
             return 2
-        if "test_wal" in path:          # ISSUE 15: newest, dead last
+        if "test_wal" in path:
             return 3
+        if "test_tracing" in path:      # ISSUE 16: newest, dead last
+            return 4
         return None
     tail = sorted((it for it in rest if _tail_rank(it) is not None),
                   key=_tail_rank)

@@ -1013,6 +1013,16 @@ def run_crash_sweep(sites=None, kv_cache_dtype=None, tp=None,
             raise SoakError(
                 f"[{site}] the site fired but the process never died "
                 f"— the kill was absorbed before it could land")
+        # flight-recorder gate (ISSUE 16): every simulated kill must
+        # leave a parseable CRC-framed black box next to the WAL
+        from paddle_tpu.observability import flight as _flight
+        dumps = _flight.find_dumps(wd)
+        if len(dumps) < deaths:
+            raise SoakError(
+                f"[{site}] {deaths} death(s) but only {len(dumps)} "
+                f"flight dump(s) in {wd} — a kill left no black box")
+        for dp in dumps:
+            _flight.load(dp)    # raises on CRC mismatch / torn dump
         for j, req in by_job.items():
             if not req.done or req.finish_reason not in ("eos",
                                                          "max_len"):
@@ -1034,7 +1044,9 @@ def run_crash_sweep(sites=None, kv_cache_dtype=None, tp=None,
             raise SoakError(f"[{site}] allocator unbalanced after "
                             f"drain: {st}")
         per_site[site] = {"deaths": deaths,
-                          "fired": int(inj.fired[site])}
+                          "fired": int(inj.fired[site]),
+                          "flight_dumps": len(dumps),
+                          "last_flight_dump": dumps[-1]}
     return {"mode": "crash_sweep", "tier": kv_cache_dtype or "fp",
             "tp": tp, "constrained": constrained,
             "sites": per_site}
@@ -1124,6 +1136,16 @@ def run_crash_soak(seed: int = 0, kills: int = 4,
     if deaths < 1:
         raise SoakError("no armed kill ever landed — the soak "
                         "exercised nothing")
+    # flight-recorder gate (ISSUE 16): every kill left a black box,
+    # and every box loads back CRC-clean
+    from paddle_tpu.observability import flight as _flight
+    flight_dumps = _flight.find_dumps(wd)
+    if len(flight_dumps) < deaths:
+        raise SoakError(
+            f"{deaths} death(s) but only {len(flight_dumps)} flight "
+            f"dump(s) in {wd} — a kill left no black box")
+    for dp in flight_dumps:
+        _flight.load(dp)        # raises on CRC mismatch / torn dump
     final = {rid: (cur[rid], j) for rid, j in job_of.items()}
     lost = [rid for rid, (req, _j) in final.items()
             if not req.done or req.finish_reason not in ("eos",
@@ -1144,6 +1166,8 @@ def run_crash_soak(seed: int = 0, kills: int = 4,
             "requests": len(final), "steps": steps,
             "faults_by_site": {s: n for s, n in inj.fired.items()
                                if n},
+            "flight_dumps": len(flight_dumps),
+            "last_flight_dump": flight_dumps[-1],
             "wal_stats": sup.wal.stats()}
 
 

@@ -80,6 +80,15 @@ REQUIRED = {
         # violation-avoided counter on BOTH commit paths (the prefill
         # first token and the vectorized decode commit)
         ("_obs.serving_constrain(", 2),
+        # request tracing (ISSUE 16): span-close sites on every engine
+        # lifecycle edge — admission (swap-in AND replay paths), the
+        # per-chunk prefill close, the per-row decode/verify closes,
+        # preempt/swap-out, and the retire-side finish — dropping one
+        # tears a hole in every TTFT breakdown
+        ("_obs.serving_trace_admitted(", 2),
+        ("_obs.serving_trace_span(", 5),
+        ("_obs.serving_trace_finish(", 2),
+        ("_obs.serving_trace_first_token(", 2),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -96,6 +105,12 @@ REQUIRED = {
         ("_obs.serving_sched_idle(", 1),
         # fault-injection site (ISSUE 8): the scheduler tick
         ('fault_point("sched_tick")', 1),
+        # request tracing (ISSUE 16): trace minting at submission +
+        # the queue-wait open on every (re)enqueue — the trace's first
+        # edge; requeue re-attaches recovered/preempted handles so
+        # cross-lifecycle stitching survives
+        ("_obs.serving_trace_submit(", 1),
+        ("_obs.serving_trace_enqueued(", 2),
     ],
     "paddle_tpu/serving/resilience.py": [
         # fault-tolerant serving (ISSUE 8): injected + real failure
@@ -114,6 +129,13 @@ REQUIRED = {
         # gauge/counters — a recovery that replays sessions invisibly
         # would make the crash-durability story unauditable
         ("_obs.serving_wal_recovery(", 1),
+        # flight recorder (ISSUE 16): the per-tick ring append, the
+        # dump counter on every black-box write, and the wal_replay
+        # span on each recovered session — a crash with no flight dump
+        # is an unauditable crash
+        ("_obs.serving_flight_tick(", 1),
+        ("_obs.serving_flight_dump(", 1),
+        ("_obs.serving_trace_span(", 1),
     ],
     "paddle_tpu/serving/wal.py": [
         # durable WAL (ISSUE 15): per-record append counter/bytes/
@@ -220,6 +242,13 @@ REQUIRED = {
         ('fault_point("handoff_export")', 1),
         ('fault_point("handoff_import")', 1),
         ('fault_point("autoscale_tick")', 1),
+        # request tracing (ISSUE 16): router-lane minting at submit,
+        # both halves of the handoff span pair (the cross-replica
+        # stitch), and the structured-rejection finishes — dropping
+        # one breaks the one-trace-per-request contract
+        ("_obs.serving_trace_submit(", 1),
+        ("_obs.serving_trace_span(", 2),
+        ("_obs.serving_trace_finish(", 3),
     ],
     "paddle_tpu/serving/router.py": [
         # cluster router (ISSUE 9): per-dispatch replica + affinity
@@ -347,6 +376,11 @@ _SYNC_FREE = {
     "paddle_tpu/inference/predictor.py": (
         "decode_dispatch", "spec_dispatch", "prefill_dispatch",
         "ready_mask", "propose_drafts", "spec_plan_widths"),
+    # the tracing layer (ISSUE 16) runs INSIDE the hot path on every
+    # span close — it must never fetch a device value or fence; its
+    # zero-device-syncs contract is what lets call sites fire between
+    # dispatch and commit
+    "paddle_tpu/observability/tracing.py": None,
 }
 
 #: device-sync idioms: a bare one-argument np.asarray (dtype-annotated

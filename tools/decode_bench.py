@@ -136,7 +136,7 @@ def main():
     # ISSUE 12 overlap rider (sync vs double-buffered step ms +
     # host_overhead_fraction) rides next to it
     def _sched():
-        tps, lat, ov, dur = bench_mod.sched_decode_tier(
+        tps, lat, ov, dur, trc = bench_mod.sched_decode_tier(
             params, cfg, db, dp_len, dnew, on_tpu)
         out["decode_sched_step_ms"] = lat
         if ov:
@@ -145,6 +145,10 @@ def main():
             # durability rider (ISSUE 15): WAL fsync-ladder overhead
             # vs the journal-off baseline on the same workload
             out["decode_durability_overhead"] = dur
+        if trc:
+            # trace rider (ISSUE 16): request tracing ON vs the plain
+            # run — the measured price of the observability switch
+            out["decode_trace_overhead"] = trc
         return tps
     run_tier("decode_sched_tokens_per_sec", _sched)
 

@@ -122,6 +122,7 @@ if dec.get("decode_tokens_per_sec") is not None:
                   "decode_fused_speedup",
                   "decode_overlap_speedup",
                   "decode_durability_overhead",
+                  "decode_trace_overhead",
                   "decode_multilora_density"):
         ms = dec.get(rider)
         if ms is not None and lg.setdefault("extra", {}).get(rider) != ms:

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Render a serving-plane black box for humans (ISSUE 16).
+
+Reads either kind of observability artifact and prints it as text:
+
+- a CRC-framed flight dump (``flight-<ts>.json``, written by
+  :meth:`EngineSupervisor.dump_flight` and the crash paths): the
+  last-N scheduler-tick table plus a per-request span waterfall of
+  the recorded trace tails;
+- a Chrome trace-event export (``tracing.export_chrome`` /
+  ``profiler`` output): the same waterfall, reconstructed from the
+  ``X`` events (pid/tid metadata rows name the replica/slot lanes).
+
+The render functions return plain line lists so the round-trip is
+testable without a subprocess (tests/test_tracing.py)::
+
+    python tools/trace_dump.py <path> [--ticks N] [--rid RID]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+#: tick-table columns: (header, payload key, width)
+_TICK_COLS = (
+    ("step", "step", 6), ("commit", "committed", 6),
+    ("plan", "planned_tokens", 5), ("rsrv", "reserved_tokens", 5),
+    ("budget", "budget", 6), ("dec", "decode_slots", 4),
+    ("pre", "prefills", 4), ("queue", "queued", 5),
+    ("degr", "degraded", 4), ("fail", "failures", 4),
+    ("lsn", "wal_lsn", 6), ("fault", "fault", 18),
+)
+
+
+def _cell(v, width: int) -> str:
+    s = "-" if v is None else str(v)
+    return s[:width].rjust(width)
+
+
+def render_ticks(ticks, last: int = 0) -> list:
+    """The flight ring as a fixed-width table, newest last."""
+    if last:
+        ticks = ticks[-last:]
+    lines = ["  ".join(h.rjust(w) for h, _k, w in _TICK_COLS)]
+    for t in ticks:
+        lines.append("  ".join(_cell(t.get(k), w)
+                               for _h, k, w in _TICK_COLS))
+    return lines
+
+
+def _lane(span: dict) -> str:
+    rep = span.get("replica", -1)
+    slot = span.get("slot", -1)
+    left = "router" if rep < 0 else f"r{rep}"
+    return left if slot < 0 else f"{left}/s{slot}"
+
+
+def render_trace(tr: dict) -> list:
+    """One request trace as a span waterfall: offsets are ms from the
+    trace's submit stamp, so cross-replica spans line up on the one
+    timeline the stitching promises."""
+    t0 = tr.get("submit_ns", 0)
+    head = (f"trace {tr.get('trace_id')} rid={tr.get('rid')} "
+            f"replicas={tr.get('replicas')} "
+            f"spans={tr.get('recorded')} dropped={tr.get('dropped')}"
+            + (f" done={tr.get('reason')}" if tr.get("done") else ""))
+    lines = [head]
+    for s in tr.get("spans", []):
+        off = (s.get("start_ns", 0) - t0) / 1e6
+        dur = (s.get("end_ns", 0) - s.get("start_ns", 0)) / 1e6
+        meta = s.get("meta")
+        lines.append(
+            f"  +{off:10.3f}ms {dur:9.3f}ms  {_lane(s):>9}  "
+            f"{s.get('name')} seq={s.get('seq')}"
+            + (f" {meta}" if meta else ""))
+    bd = tr.get("ttft_breakdown")
+    if bd:
+        lines.append("  ttft: " + "  ".join(
+            f"{k.removesuffix('_ms')}={v:.3f}ms"
+            for k, v in bd.items()))
+    return lines
+
+
+def render_flight(payload: dict, last_ticks: int = 0,
+                  rid=None) -> list:
+    """A loaded (CRC-verified) flight-dump payload as text."""
+    meta = payload.get("meta", {})
+    lines = [f"flight dump: reason={payload.get('reason')} "
+             f"replica={meta.get('replica')} "
+             f"ticks={len(payload.get('ticks', []))}"
+             f"/{payload.get('ticks_total')} "
+             f"traces={len(payload.get('traces', []))}"]
+    extra = payload.get("extra") or {}
+    if extra:
+        lines.append("extra: " + json.dumps(extra, sort_keys=True))
+    lines.append("")
+    lines += render_ticks(payload.get("ticks", []), last=last_ticks)
+    for tr in payload.get("traces", []):
+        if rid is not None and tr.get("rid") != rid:
+            continue
+        lines.append("")
+        lines += render_trace(tr)
+    return lines
+
+
+def render_chrome(doc: dict, rid=None) -> list:
+    """A Chrome trace-event export as per-request waterfalls: ``X``
+    events regrouped by the ``rid`` arg each span carries, lanes named
+    from the pid/tid metadata rows."""
+    pids, tids = {}, {}
+    by_rid = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M":
+            name = (ev.get("args") or {}).get("name")
+            if ev.get("name") == "process_name":
+                pids[ev.get("pid")] = name
+            elif ev.get("name") == "thread_name":
+                tids[(ev.get("pid"), ev.get("tid"))] = name
+        elif ev.get("ph") == "X":
+            r = (ev.get("args") or {}).get("rid")
+            by_rid.setdefault(r, []).append(ev)
+    lines = []
+    for r in sorted(by_rid, key=lambda x: (x is None, x)):
+        if rid is not None and r != rid:
+            continue
+        evs = sorted(by_rid[r], key=lambda e: e.get("ts", 0))
+        t0 = evs[0].get("ts", 0)
+        if lines:
+            lines.append("")
+        lines.append(f"rid={r} spans={len(evs)}")
+        for ev in evs:
+            lane = pids.get(ev.get("pid"), f"pid{ev.get('pid')}")
+            tl = tids.get((ev.get("pid"), ev.get("tid")))
+            if tl:
+                lane = f"{lane}/{tl}"
+            lines.append(
+                f"  +{(ev.get('ts', 0) - t0) / 1e3:10.3f}ms "
+                f"{ev.get('dur', 0) / 1e3:9.3f}ms  {lane:>16}  "
+                f"{ev.get('name')}")
+    return lines
+
+
+def render_path(path: str, last_ticks: int = 0, rid=None) -> list:
+    """Sniff + render either artifact kind (the CLI body, shared with
+    the round-trip test)."""
+    with open(path, "rb") as f:
+        doc = json.load(f)
+    if doc.get("magic") == "PTFR":
+        from paddle_tpu.observability import flight
+        return render_flight(flight.load(path), last_ticks=last_ticks,
+                             rid=rid)
+    if "traceEvents" in doc:
+        return render_chrome(doc, rid=rid)
+    raise ValueError(f"{path}: neither a flight dump (PTFR) nor a "
+                     f"Chrome trace export (traceEvents)")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="flight-<ts>.json or a Chrome trace "
+                                 "export")
+    ap.add_argument("--ticks", type=int, default=0,
+                    help="show only the last N scheduler ticks")
+    ap.add_argument("--rid", type=int, default=None,
+                    help="show only this request's waterfall")
+    args = ap.parse_args()
+    for line in render_path(args.path, last_ticks=args.ticks,
+                            rid=args.rid):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
