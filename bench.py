@@ -157,7 +157,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_int8_tps=None, decode_int4_tps=None,
             decode_w8kv8_tps=None, decode_paged_tps=None,
             decode_prefix_tps=None, decode_sched=None,
-            decode_spec=None, decode_tp=None, decode_cluster=None,
+            decode_spec=None, decode_tp=None, decode_tp2d=None,
+            decode_cluster=None,
             decode_offload=None, decode_slo=None, decode_fused=None,
             decode_multilora=None, phases=None):
     import jax
@@ -182,6 +183,8 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
                       decode_spec[0] if decode_spec else None),
                   "decode_tp_tokens_per_sec": (
                       decode_tp[0] if decode_tp else None),
+                  "decode_tp2d_tokens_per_sec": (
+                      decode_tp2d[0] if decode_tp2d else None),
                   "decode_cluster_tokens_per_sec": (
                       decode_cluster[0] if decode_cluster else None),
                   "decode_offload_tokens_per_sec": (
@@ -219,6 +222,11 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # the tp tier reports an AGGREGATE over tp chips: the scaling
         # factor vs the single-chip paged tier is the honest headline
         rec["extra"]["decode_tp_scaling"] = decode_tp[1]
+    if decode_tp2d:
+        # the 2-D mesh tier's honest headline is the dp batch-scaling
+        # factor vs the 1-D tp tier at the same per-shard geometry —
+        # {tp, dp, vs_1d_tp} travel with the aggregate number
+        rec["extra"]["decode_tp2d_scaling"] = decode_tp2d[1]
     if decode_cluster:
         # the cluster tier's ratio vs one engine on the same tenant
         # workload (router+handoff overhead on one host, the scaling
@@ -903,6 +911,43 @@ def tp_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                         mesh=serving_mesh(tp))[0]
 
 
+def tp2d_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
+                     kv_cache_dtype=None, tp=2, dp=2):
+    """The decode_tp2d_tokens_per_sec measurement, shared by measure()
+    and tools/decode_bench.py so the two sources stay comparable.
+
+    The same MIXED-LENGTH paged workload as the 1-D tp tier, on a 2-D
+    ``tp x dp`` serving mesh (ISSUE 17): weights column-sharded over
+    tp exactly as before, page pools head-sharded on tp and REPLICATED
+    across dp, and the decode batch SPLIT over dp — ``db`` rows per dp
+    shard, so ``max_batch = db * dp`` rows advance per step through
+    the same per-shard program geometry the 1-D tier runs. The ratio
+    vs the 1-D tp tier is the dp batch-scaling factor and rides the
+    record as ``decode_tp2d_scaling``. Needs >= tp*dp devices: a
+    single-chip tunnel run raises (tier stays null with honest
+    provenance) — multi-chip slices and the 8-device host-platform CI
+    mesh measure it."""
+    import numpy as np
+    import jax
+    from paddle_tpu.distributed.mesh import serving_mesh
+    ndev = len(jax.devices())
+    if ndev < tp * dp:
+        raise RuntimeError(
+            f"decode_tp2d tier needs a {tp}x{dp}-device mesh, found "
+            f"{ndev} device(s) — run on a multi-chip slice (or the "
+            f"host-platform 8-device CI mesh)")
+    rows = db * dp
+    plens = [dp_len if i % 2 else max(dp_len // 2, 1)
+             for i in range(2 * rows)]
+    rngp = np.random.default_rng(17)
+    prompts = [rngp.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in plens]
+    return _engine_tier(params, cfg, rows, dnew, dp_len + dnew, on_tpu,
+                        lambda: prompts, kv_cache_dtype=kv_cache_dtype,
+                        enable_prefix_cache=False,
+                        mesh=serving_mesh(tp, dp))[0]
+
+
 def cluster_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                         kv_cache_dtype=None, replicas=2):
     """The decode_cluster_tokens_per_sec measurement, shared by
@@ -1192,6 +1237,7 @@ _DECODE_TIERS = ("decode_tokens_per_sec", "decode_int8_tokens_per_sec",
                  "decode_sched_tokens_per_sec",
                  "decode_spec_tokens_per_sec",
                  "decode_tp_tokens_per_sec",
+                 "decode_tp2d_tokens_per_sec",
                  "decode_cluster_tokens_per_sec",
                  "decode_offload_tokens_per_sec",
                  "decode_slo_goodput_tokens_per_sec",
@@ -1213,6 +1259,7 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                    "decode_trace_overhead"),
                   ("decode_spec_tokens_per_sec", "decode_spec_acceptance"),
                   ("decode_tp_tokens_per_sec", "decode_tp_scaling"),
+                  ("decode_tp2d_tokens_per_sec", "decode_tp2d_scaling"),
                   ("decode_cluster_tokens_per_sec",
                    "decode_cluster_scaling"),
                   ("decode_offload_tokens_per_sec",
@@ -1538,6 +1585,24 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"tp decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # 2-D tp x dp serving mesh (ISSUE 17): the same mixed-length paged
+    # workload with the decode batch SPLIT over a dp axis on top of
+    # tp=2 — db rows per dp shard, so dp multiplies the rows each step
+    # advances; the vs-1-D-tp ratio rides the record (needs >= 4
+    # devices; a single-chip tunnel run records it null)
+    decode_tp2d = None
+    if decode_tps is not None and (not on_tpu or remaining() > 120):
+        try:
+            tp2d_tps = tp2d_decode_tier(
+                state.params, cfg, db, dp_len, dnew, on_tpu)
+            decode_tp2d = (tp2d_tps, {
+                "tp": 2, "dp": 2,
+                "vs_1d_tp": (round(tp2d_tps / decode_tp[0], 3)
+                             if decode_tp and decode_tp[0] else None)})
+        except Exception as e:
+            print(f"tp2d decode bench failed: {type(e).__name__}: "
+                  f"{e}"[:500], file=sys.stderr)
+
     # disaggregated serving cluster (ISSUE 9): two replicas behind the
     # prefix-affinity router on a shared-prefix tenant workload, with
     # the cluster-vs-single-engine ratio riding the record
@@ -1594,7 +1659,8 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_int8_tps, decode_int4_tps, decode_w8kv8_tps,
                    decode_paged_tps, decode_prefix_tps,
                    decode_sched=decode_sched, decode_spec=decode_spec,
-                   decode_tp=decode_tp, decode_cluster=decode_cluster,
+                   decode_tp=decode_tp, decode_tp2d=decode_tp2d,
+                   decode_cluster=decode_cluster,
                    decode_offload=decode_offload, decode_slo=decode_slo,
                    decode_fused=decode_fused,
                    decode_multilora=decode_multilora, phases=phases)

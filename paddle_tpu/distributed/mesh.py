@@ -164,25 +164,38 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
         return g
 
 
-def serving_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
-    """Build the 1-D ``("tp",)`` mesh the tensor-parallel serving
-    engine shards over (ISSUE 7): the first ``tp`` devices, one axis.
-    The serving stack deliberately takes a plain Mesh rather than a
+def serving_mesh(tp: int, dp: int = 1,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """Build the mesh the tensor-parallel serving engine shards over:
+    1-D ``("tp",)`` at ``dp == 1`` (ISSUE 7, unchanged), 2-D
+    ``("tp", "dp")`` when a data-parallel axis is requested (ISSUE 17)
+    — the first ``tp * dp`` devices as a ``tp x dp`` grid. The serving
+    stack deliberately takes a plain Mesh rather than a
     :class:`Group` — the engine's shard_map programs only need the axis
-    name, and keeping it decoupled from the global-mesh singleton lets
+    names, and keeping it decoupled from the global-mesh singleton lets
     a server and a trainer coexist in one process.
 
-    Use with ``ContinuousBatchingEngine(..., mesh=serving_mesh(4))``;
-    weights partition by :data:`paddle_tpu.models.llama.
-    SERVING_TP_RULES` and the KV page pools shard on the head axis."""
+    Use with ``ContinuousBatchingEngine(..., mesh=serving_mesh(4))``
+    (or ``serving_mesh(2, 2)``); weights partition by
+    :data:`paddle_tpu.models.llama.SERVING_TP_RULES` and the KV page
+    pools shard on the head axis over tp (replicated across dp — same
+    page ids on every dp shard), while the batch axis of the step
+    programs splits over dp."""
     devs = list(devices) if devices is not None else list(jax.devices())
     if tp < 1:
         raise ValueError(f"serving_mesh: tp must be >= 1, got {tp}")
-    if tp > len(devs):
+    if dp < 1:
+        raise ValueError(f"serving_mesh: dp must be >= 1, got {dp}")
+    if tp * dp > len(devs):
         raise ValueError(
+            f"serving_mesh: tp={tp} x dp={dp} exceeds the {len(devs)} "
+            f"available device(s)" if dp > 1 else
             f"serving_mesh: tp={tp} exceeds the {len(devs)} available "
             f"device(s)")
-    return Mesh(np.asarray(devs[:tp]), ("tp",))
+    if dp == 1:
+        return Mesh(np.asarray(devs[:tp]), ("tp",))
+    return Mesh(np.asarray(devs[:tp * dp]).reshape(tp, dp),
+                ("tp", "dp"))
 
 
 def is_initialized() -> bool:

@@ -572,21 +572,48 @@ class ContinuousBatchingEngine:
         # step programs below lower through shard_map. ALL host logic —
         # queues, slots, block tables, allocator, trie — is unchanged:
         # page ids are the same on every shard.
+        # --- 2-D serving mesh (ISSUE 17): a ("tp", "dp") mesh
+        # additionally splits the BATCH axis of the decode and verify
+        # programs over dp — each dp shard computes max_batch/dp rows
+        # against its own (dp-replicated, tp-head-sharded) page pool
+        # replica, and the per-layer KV rows + scatter indices
+        # all-gather across dp before the pool write so every replica
+        # receives the full batch's writes in single-chip row order.
+        # Chunked prefill stays dp-replicated (one row per program).
+        # MoE configs shard their expert stacks over dp with per-token
+        # all-to-all dispatch (llama.validate_serving_mesh accepts what
+        # validate_serving_tp rejects). Host logic is still unchanged.
         self.mesh = mesh
         self._tp = None
         self._tp_axis = None
+        self._dp_axis = None
+        self.dp = 1
         self._param_specs = None
         self._tp_probe = None
         if mesh is not None:
             from ..models import llama as _llama
-            if len(mesh.axis_names) != 1:
+            names = mesh.axis_names
+            if len(names) > 2 or (len(names) == 2 and "tp" not in names):
                 raise ValueError(
                     f"ContinuousBatchingEngine: the serving mesh must "
-                    f"be 1-D (a tp axis), got axes {mesh.axis_names}")
-            self._tp_axis = mesh.axis_names[0]
+                    f"be 1-D (a tp axis) or 2-D (tp, dp), got axes "
+                    f"{names}")
+            self._tp_axis = "tp" if "tp" in names else names[0]
             self._tp = int(mesh.shape[self._tp_axis])
+            if len(names) == 2:
+                self._dp_axis = next(a for a in names
+                                     if a != self._tp_axis)
+                self.dp = int(mesh.shape[self._dp_axis])
+                if max_batch % self.dp:
+                    raise ValueError(
+                        f"ContinuousBatchingEngine: max_batch="
+                        f"{max_batch} is not divisible by dp={self.dp}"
+                        f" — the decode batch splits into equal "
+                        f"per-dp-shard row blocks")
             # validates num_heads/num_kv_heads divisibility loudly and
             # takes the KV-replication path when num_kv_heads < tp
+            # (validate_serving_mesh also checks the MoE expert/dp and
+            # expert-matrix/tp splits on 2-D meshes)
             params, self._param_specs = _llama.shard_serving_params(
                 params, cfg, mesh, axis=self._tp_axis)
         self.params = params
@@ -820,17 +847,23 @@ class ContinuousBatchingEngine:
     # ---- jitted programs (one decode; one prefill per page bucket) ----
     def _tp_map(self, fn, arg_kinds):
         """Lower a per-shard serving forward through shard_map on the
-        engine's 1-D tp mesh. ``arg_kinds``: one of ``"params"`` (the
+        engine's serving mesh. ``arg_kinds``: one of ``"params"`` (the
         regex-rule spec pytree), ``"pool"`` (page pools, head axis
-        sharded) or ``"rep"`` (replicated host-side small args) per
-        positional argument. Outputs are always ``(logits, pool)`` —
-        logits are replicated (the per-shard body already all-gathered
-        them; ``check_rep=False`` skips the symbolic replication proof,
-        same as the training-side ring-attention shard_map)."""
+        sharded over tp, replicated across dp), ``"rep"`` (replicated
+        host-side small args) or ``"batch"`` (per-row batch args —
+        last tokens, block tables, lengths, the active mask, adapter
+        slots — split over the dp axis on a 2-D mesh, replicated on a
+        1-D one) per positional argument. Outputs are always
+        ``(logits, pool)`` — logits are replicated (the per-shard body
+        already all-gathered them over tp AND dp; ``check_rep=False``
+        skips the symbolic replication proof, same as the
+        training-side ring-attention shard_map)."""
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
         kinds = {"params": self._param_specs,
-                 "pool": self.cache.pool_specs, "rep": P()}
+                 "pool": self.cache.pool_specs, "rep": P(),
+                 "batch": (P(self._dp_axis)
+                           if self._dp_axis is not None else P())}
         if self.adapters is not None:
             # adapter-pool factor dict: B factors column-sharded on the
             # same output axis as the base weights, A + scales
@@ -846,6 +879,7 @@ class ContinuousBatchingEngine:
             from ..models import generate as gen
             cfg, temp, uk = self.cfg, self.temperature, self.use_kernel
             ax, fz = self._tp_axis, self.fused
+            dpx = self._dp_axis
             ad_on, cons = self.adapters is not None, self.constraints
 
             if ad_on:
@@ -854,20 +888,21 @@ class ContinuousBatchingEngine:
                     return gen.paged_decode_forward(
                         params, last, paged, tables, lengths, cfg,
                         active=active, use_kernel=uk, tp_axis=ax,
-                        fused=fz, adapters=ad, adapter_slots=aslot)
+                        dp_axis=dpx, fused=fz, adapters=ad,
+                        adapter_slots=aslot)
                 if self.mesh is not None:
-                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
-                                             "rep", "rep", "rep",
-                                             "adapters", "rep"))
+                    fwd = self._tp_map(fwd, ("params", "batch", "pool",
+                                             "batch", "batch", "batch",
+                                             "adapters", "batch"))
             else:
                 def fwd(params, last, paged, tables, lengths, active):
                     return gen.paged_decode_forward(
                         params, last, paged, tables, lengths, cfg,
                         active=active, use_kernel=uk, tp_axis=ax,
-                        fused=fz)
+                        dp_axis=dpx, fused=fz)
                 if self.mesh is not None:
-                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
-                                             "rep", "rep", "rep"))
+                    fwd = self._tp_map(fwd, ("params", "batch", "pool",
+                                             "batch", "batch", "batch"))
 
             def f(params, last, paged, tables, lengths, active, key,
                   *extra):
@@ -914,16 +949,21 @@ class ContinuousBatchingEngine:
         if key not in self._chunk_fns:
             from ..models import generate as gen
             cfg, ax, fz = self.cfg, self._tp_axis, self.fused
-            uk = self.use_kernel
+            uk, dpx = self.use_kernel, self._dp_axis
 
+            # chunked prefill stays dp-REPLICATED (one row per
+            # program): every batch arg keeps the "rep" kind and only
+            # dp_axis threads through, so a MoE config's expert
+            # dispatch can still all-to-all over the dp axis
             if self.adapters is not None:
                 def f(params, chunk, paged, table, ctx_len, chunk_len,
                       ad, aslot):
                     return gen.paged_prefill_chunk(
                         params, chunk, paged, table, cfg,
                         ctx_cap=ctx_cap, ctx_len=ctx_len,
-                        chunk_len=chunk_len, tp_axis=ax, fused=fz,
-                        use_kernel=uk, adapters=ad, adapter_slot=aslot)
+                        chunk_len=chunk_len, tp_axis=ax, dp_axis=dpx,
+                        fused=fz, use_kernel=uk, adapters=ad,
+                        adapter_slot=aslot)
                 if self.mesh is not None:
                     f = self._tp_map(f, ("params", "rep", "pool", "rep",
                                          "rep", "rep", "adapters",
@@ -933,8 +973,8 @@ class ContinuousBatchingEngine:
                     return gen.paged_prefill_chunk(
                         params, chunk, paged, table, cfg,
                         ctx_cap=ctx_cap, ctx_len=ctx_len,
-                        chunk_len=chunk_len, tp_axis=ax, fused=fz,
-                        use_kernel=uk)
+                        chunk_len=chunk_len, tp_axis=ax, dp_axis=dpx,
+                        fused=fz, use_kernel=uk)
                 if self.mesh is not None:
                     f = self._tp_map(f, ("params", "rep", "pool", "rep",
                                          "rep", "rep"))
@@ -952,7 +992,7 @@ class ContinuousBatchingEngine:
         if key not in self._spec_fns:
             from ..models import generate as gen
             cfg, uk, ax = self.cfg, self.use_kernel, self._tp_axis
-            fz = self.fused
+            fz, dpx = self.fused, self._dp_axis
             ad_on, temp = self.adapters is not None, self.temperature
 
             if ad_on:
@@ -961,21 +1001,21 @@ class ContinuousBatchingEngine:
                     return gen.paged_verify_forward(
                         params, chunk, paged, tables, lengths, cfg,
                         ctx_cap=ctx_cap, active=active, use_kernel=uk,
-                        tp_axis=ax, fused=fz, adapters=ad,
+                        tp_axis=ax, dp_axis=dpx, fused=fz, adapters=ad,
                         adapter_slots=aslot)
                 if self.mesh is not None:
-                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
-                                             "rep", "rep", "rep",
-                                             "adapters", "rep"))
+                    fwd = self._tp_map(fwd, ("params", "batch", "pool",
+                                             "batch", "batch", "batch",
+                                             "adapters", "batch"))
             else:
                 def fwd(params, chunk, paged, tables, lengths, active):
                     return gen.paged_verify_forward(
                         params, chunk, paged, tables, lengths, cfg,
                         ctx_cap=ctx_cap, active=active, use_kernel=uk,
-                        tp_axis=ax, fused=fz)
+                        tp_axis=ax, dp_axis=dpx, fused=fz)
                 if self.mesh is not None:
-                    fwd = self._tp_map(fwd, ("params", "rep", "pool",
-                                             "rep", "rep", "rep"))
+                    fwd = self._tp_map(fwd, ("params", "batch", "pool",
+                                             "batch", "batch", "batch"))
 
             def f(params, chunk, paged, tables, lengths, active,
                   *extra):
@@ -1729,6 +1769,12 @@ class ContinuousBatchingEngine:
         # commit there), honest under overlap preemption races
         _obs.serving_step(int(h.mask.sum()), self.max_batch,
                           alloc.num_used, alloc.num_usable)
+        if self._dp_axis is not None:
+            # per-dp-shard row load of the DISPATCHED program: slot s
+            # rides shard s // (max_batch/dp), the same contiguous
+            # row-block split the "batch" in_specs apply
+            _obs.serving_dp_step(
+                self.dp, h.mask.reshape(self.dp, -1).sum(axis=1))
         self._tp_observe()
         return int(slots.size)
 
@@ -1957,6 +2003,9 @@ class ContinuousBatchingEngine:
         alloc = cache.allocator
         _obs.serving_step(n_slots, self.max_batch, alloc.num_used,
                           alloc.num_usable)
+        if self._dp_axis is not None:
+            _obs.serving_dp_step(
+                self.dp, h.mask.reshape(self.dp, -1).sum(axis=1))
         self._tp_observe()
         return committed
 
@@ -2023,6 +2072,8 @@ class ContinuousBatchingEngine:
         s["queued"] = len(self._queue)
         if self.mesh is not None:
             s["tp"] = self._tp
+            if self._dp_axis is not None:
+                s["dp"] = self.dp
             s["pool_bytes_per_shard"] = self.cache.pool_bytes_per_shard
         s["active_slots"] = int(self.cache.active.sum())
         s["pending_prefills"] = len(self._pending)

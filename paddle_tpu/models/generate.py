@@ -136,7 +136,10 @@ def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
     are ``1/num_kv_heads`` of the pool rather than ``1/tp``."""
     L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.hd
     if tp is not None:
-        nkv = llama.validate_serving_tp(cfg, tp) * tp
+        # validate_serving_mesh rather than validate_serving_tp: the
+        # head contract is identical and MoE configs are legal on the
+        # serving mesh (ISSUE 17 expert-parallel decode)
+        nkv = llama.validate_serving_mesh(cfg, tp) * tp
     if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
         raise ValueError(
             f"init_paged_cache: kv_dtype={kv_dtype!r} is not supported — "
@@ -161,6 +164,112 @@ def _scatter_rows(pool, dst, rows):
     flat = pool.reshape((L, P * page) + pool.shape[3:])
     flat = flat.at[:, dst].set(rows.astype(pool.dtype))
     return flat.reshape(pool.shape)
+
+
+def _moe_apply(xi, le, wg, wu, wd, cfg: LlamaConfig, tp_axis=None):
+    """Per-item expert SwiGLU: ``xi`` (n, H) routed token copies,
+    ``le`` (n,) LOCAL expert ids into this shard's expert stacks
+    ``wg``/``wu`` (E_l, H, i_cols) / ``wd`` (E_l, i, h_cols).
+
+    Every item's FFN is the dense SwiGLU with its expert's matrices,
+    gathered per item (``jnp.take`` over the expert axis) and applied
+    as a batched matvec — the contraction order over the input axis is
+    identical for every batch size, which is what makes the
+    expert-parallel path token-identical to the single-device
+    dense-dispatch reference (the SAME function with full stacks and
+    global ids). Under tp the expert matrices arrive column-sharded
+    exactly like the dense ``wg``/``wu``/``wd`` and the activations
+    all-gather to full width before each contraction — the ISSUE 7
+    exact-concat argument, unchanged."""
+    dt = xi.dtype
+    gw = jnp.take(wg, le, axis=0).astype(dt)            # (n, H, i_l)
+    uw = jnp.take(wu, le, axis=0).astype(dt)
+    dw = jnp.take(wd, le, axis=0).astype(dt)            # (n, i, h_l)
+    g = jax.nn.silu(jnp.einsum("nh,nhi->ni", xi, gw).astype(
+        jnp.float32)).astype(dt)
+    u = jnp.einsum("nh,nhi->ni", xi, uw)
+    gu = g * u
+    if tp_axis is not None:
+        gu = _tp_allgather(gu, tp_axis, 1)
+    o = jnp.einsum("ni,nih->nh", gu, dw)
+    if tp_axis is not None:
+        o = _tp_allgather(o, tp_axis, 1)
+    return o
+
+
+def _moe_ffn(x, lp, cfg: LlamaConfig, tp_axis=None, dp_axis=None):
+    """Serving MoE FFN (ISSUE 17): capacity-DROPLESS top-k routing +
+    per-item expert apply, expert-parallel over the dp axis.
+
+    x: (B, T, H); lp carries this layer's ``moe_gate`` (H, E) fp32
+    router (replicated — every shard routes identically, the
+    bit-identity precondition) and expert stacks ``moe_wg``/``moe_wu``/
+    ``moe_wd`` — FULL E on a single chip, E/dp experts per shard under
+    expert parallelism (their column axis tp-sharded either way).
+
+    Routing: ``top_k`` over the fp32 router logits (lax.top_k —
+    deterministic lowest-index tie-break), softmax over the k selected
+    logits, and the combine ``y = sum_j w_j * out_j`` runs over the
+    top-k slots IN SLOT ORDER in fp32 — the same fixed-order sum on
+    every path, so EP decode is token-identical to the dense-dispatch
+    reference (this function with ``dp_axis=None`` and full stacks).
+
+    Dispatch (dp > 1): the N*k routed items scatter into per-owner send
+    buffers of capacity N*k each — dropless BY CONSTRUCTION (a worst
+    case where one owner receives every item still fits), unlike the
+    train-side ``moe.router`` whose capacity_factor DROPS overflow —
+    then one ``lax.all_to_all`` ships tokens to their experts' owners
+    and a second ships the outputs back. Unfilled capacity slots
+    compute FFN(0) on expert 0 and are never read back. Serving decode
+    batches are small, so the quadratic rank assignment and the
+    padded capacity are noise next to the expert matmuls."""
+    B, T, H = x.shape
+    moe = cfg.moe
+    k = moe.top_k
+    gate = lp["moe_gate"].astype(jnp.float32)           # (H, E)
+    wg, wu, wd = lp["moe_wg"], lp["moe_wu"], lp["moe_wd"]
+    E = gate.shape[-1]
+    El = wg.shape[0]                                    # local experts
+    N = B * T
+    xf = x.reshape(N, H)
+    logits = xf.astype(jnp.float32) @ gate              # (N, E)
+    vals, idx = lax.top_k(logits, k)                    # (N, k)
+    w = jax.nn.softmax(vals, axis=-1)                   # fp32
+    items_x = jnp.repeat(xf, k, axis=0)                 # (N*k, H)
+    items_e = idx.reshape(-1).astype(jnp.int32)         # global ids
+    n = N * k
+    if dp_axis is not None and El != E:
+        # expert-parallel dispatch: owner shard + local id from the
+        # LOCAL stack shape (dp = E/El — no collective needed), rank
+        # within owner via pairwise comparison cumsum
+        dp = E // El
+        owner = items_e // El
+        le = items_e % El
+        ar = jnp.arange(n, dtype=jnp.int32)
+        pos = jnp.sum((owner[None, :] == owner[:, None])
+                      & (ar[None, :] < ar[:, None]),
+                      axis=1).astype(jnp.int32)
+        sx = jnp.zeros((dp, n, H), x.dtype).at[owner, pos].set(items_x)
+        se = jnp.zeros((dp, n), jnp.int32).at[owner, pos].set(le)
+        # trace-time all-to-all accounting (the serving_tp_allgather
+        # contract — fires once per compile per layer): token payload
+        # there + outputs back, plus the local-id plane
+        _obs.serving_moe_dispatch(
+            2 * int(sx.size) * jnp.dtype(sx.dtype).itemsize
+            + int(se.size) * 4, n)
+        rx = lax.all_to_all(sx, dp_axis, split_axis=0, concat_axis=0)
+        re = lax.all_to_all(se, dp_axis, split_axis=0, concat_axis=0)
+        out = _moe_apply(rx.reshape(dp * n, H), re.reshape(dp * n),
+                         wg, wu, wd, cfg, tp_axis=tp_axis)
+        back = lax.all_to_all(out.reshape(dp, n, H), dp_axis,
+                              split_axis=0, concat_axis=0)
+        items_out = back[owner, pos]                    # (N*k, H)
+    else:
+        items_out = _moe_apply(items_x, items_e, wg, wu, wd, cfg,
+                               tp_axis=tp_axis)
+    y = jnp.sum(items_out.reshape(N, k, H).astype(jnp.float32)
+                * w[:, :, None], axis=1)
+    return y.astype(x.dtype).reshape(B, T, H)
 
 
 def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
@@ -235,8 +344,8 @@ def paged_prefill_insert(params, prompt: jax.Array, paged: Dict,
 def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
                         block_table: jax.Array, cfg: LlamaConfig, *,
                         ctx_cap: int, ctx_len, chunk_len, tp_axis=None,
-                        fused=None, use_kernel=None, adapters=None,
-                        adapter_slot=None):
+                        dp_axis=None, fused=None, use_kernel=None,
+                        adapters=None, adapter_slot=None):
     """Prefill ONE chunk of a request's prompt against the KV already in
     its pages — the chunked-prefill / prefix-cache continuation program
     (one compile per static ``(ctx_cap, C)`` pair; the engine buckets
@@ -280,6 +389,13 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
     ``tp_axis``: run as one tensor-parallel shard (inside shard_map;
     see :func:`_block_infer`) — ``paged`` then holds the shard's own kv
     heads and the temp cache is sized from the pool, not the config.
+
+    ``dp_axis`` (ISSUE 17): on the 2-D tp x dp mesh this one-request
+    program runs fully dp-REPLICATED — every dp shard computes the
+    identical chunk and scatters the identical rows into its pool
+    replica, so no batch gathers are needed; the axis only feeds the
+    MoE expert-parallel dispatch (:func:`_moe_ffn`), whose replicated
+    inputs make the all-to-all redundant but exact.
 
     ``fused`` (ISSUE 11): the chunk's attention runs through the flash
     prefill kernel (``ops/pallas/serving_fused.flash_chunk_attention``)
@@ -325,7 +441,8 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
                                     W, use_kernel=use_kernel, rpos=rpos,
                                     kstart=kstart,
                                     logits_at=chunk_len - 1,
-                                    tp_axis=tp_axis, fused=bool(fused),
+                                    tp_axis=tp_axis, dp_axis=dp_axis,
+                                    fused=bool(fused),
                                     adapters=adapters,
                                     adapter_slots=adapter_slot)
     pos = jnp.arange(C, dtype=jnp.int32)
@@ -343,8 +460,8 @@ def paged_prefill_chunk(params, tokens: jax.Array, paged: Dict,
 def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, ctx_cap: int, active=None,
-                         use_kernel=None, tp_axis=None, fused=None,
-                         adapters=None, adapter_slots=None):
+                         use_kernel=None, tp_axis=None, dp_axis=None,
+                         fused=None, adapters=None, adapter_slots=None):
     """Batched speculative-decode VERIFY: score a ``T``-token chunk for
     EVERY speculating row against its paged KV in ONE forward — the
     batched generalization of :func:`paged_prefill_chunk` (which runs
@@ -382,7 +499,14 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     rollback: the host simply doesn't advance ``lengths`` past the
     accepted prefix, the length mask keeps stale rows invisible, and
     sequential writes overwrite them before the mask ever reaches them
-    (the same contract decode already relies on for retired tenants)."""
+    (the same contract decode already relies on for retired tenants).
+
+    ``dp_axis`` (ISSUE 17): run as one dp shard of the 2-D mesh — the
+    batch args arrive SPLIT over dp (B is the per-shard rows), pools
+    stay dp-replicated; this program has ONE gather site at the end:
+    the new KV rows + destination slots all-gather across dp before
+    the scatter (full-batch writes on every replica, single-chip row
+    order) and the logits batch-gather to (B_total, T, V)."""
     B, T = tokens.shape
     page = paged["k"].shape[2]
     if ctx_cap % page:
@@ -415,9 +539,12 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
                                     W, use_kernel=use_kernel, rpos=rpos,
                                     kstart=pad, logits_all=True,
-                                    tp_axis=tp_axis, fused=bool(fused),
+                                    tp_axis=tp_axis, dp_axis=dp_axis,
+                                    fused=bool(fused),
                                     adapters=adapters,
                                     adapter_slots=adapter_slots)
+    if dp_axis is not None:
+        logits = _tp_allgather(logits, dp_axis, 0)       # full batch
     # scatter the T new rows of every row into its pages; inactive rows
     # and positions past the slot extent route to the trash page
     pos = rpos                                           # (B, T)
@@ -426,19 +553,26 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     row = jnp.arange(B)[:, None]
     dst = jnp.where(ok, block_tables[row, posc // page] * page
                     + posc % page, 0)                    # (B, T)
+    dst = dst.reshape(-1)
+    if dp_axis is not None:
+        dst = _tp_allgather(dst, dp_axis, 0)             # (B_total*T,)
     out = {}
     for name in paged:
         rows = dense[name][:, :, ctx_cap:]               # (L, B, T, ...)
         rows = rows.reshape((rows.shape[0], B * T) + rows.shape[3:])
-        out[name] = _scatter_rows(paged[name], dst.reshape(-1), rows)
+        if dp_axis is not None:
+            # full-batch rows in shard order — row b*T+t of the global
+            # batch, matching the gathered dst exactly
+            rows = _tp_allgather(rows, dp_axis, 1)
+        out[name] = _scatter_rows(paged[name], dst, rows)
     return logits, out
 
 
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, active=None,
-                         use_kernel=None, tp_axis=None, fused=None,
-                         adapters=None, adapter_slots=None):
+                         use_kernel=None, tp_axis=None, dp_axis=None,
+                         fused=None, adapters=None, adapter_slots=None):
     """One continuous-batching decode step over the ragged batch: every
     slot advances one token in a single static-shape program.
 
@@ -483,7 +617,22 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
     q and o projections grow a batched ``y += (x @ A_i) @ B_i · α/r``
     term gathered per row. Slot 0 is the base model's exact-zero
     factors, and ``adapters=None`` (the default) compiles the term out
-    entirely — both ends of the bit-identity gate."""
+    entirely — both ends of the bit-identity gate.
+
+    ``dp_axis`` (ISSUE 17): run as one dp shard of a 2-D tp x dp
+    serving mesh — the batch args (tokens/block_tables/lengths/active/
+    adapter_slots) arrive SPLIT over dp (B here is the per-shard
+    B/dp), while the page pools stay replicated across dp. Each shard
+    computes its own rows' attention and FFN; the freshly computed KV
+    rows AND their destination slots all-gather across dp (exact tiled
+    concats in shard order) before every pool scatter, so each dp
+    replica of the pool receives the FULL batch's writes in the single-
+    chip row order and the replicas stay bit-identical. The logits
+    batch-gather at the end hands every shard the full (B_total, V) —
+    sampling stays on replicated data outside the mesh. With
+    ``cfg.moe`` set the dense SwiGLU is replaced by :func:`_moe_ffn`
+    (expert-parallel over dp when the expert stacks arrive
+    E-sharded)."""
     from ..ops.pallas import paged_attention as _pa
     from ..ops.pallas import serving_fused as _sf
     fused = bool(fused)
@@ -515,6 +664,11 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                     block_tables[row, lengths // page] * page
                     + lengths % page,
                     0)
+    if dp_axis is not None:
+        # the FULL batch's destination slots, in single-chip row order
+        # (tiled concat over dp shards = the batch split's inverse);
+        # gathered ONCE here, closed over by every layer's scatter
+        dst = _tp_allgather(dst, dp_axis, 0)
     x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(
         cfg.dtype)                                   # (B, 1, H)
 
@@ -540,6 +694,16 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
             # the attention op; fused moves this rotation into VMEM
             q = _rope_rows(q, cos, sin, rpos)
         k = _rope_rows(k, cos, sin, rpos)
+        def _pool_write(pool, rows):
+            # dp shards scatter the FULL batch's rows (gathered in
+            # shard order to match the full dst) into their pool
+            # replica — identical writes on every replica, which is
+            # what keeps the dp-replicated pools bit-identical
+            if dp_axis is not None:
+                rows = _tp_allgather(rows, dp_axis, 0)
+            return pool.reshape((-1,) + pool.shape[2:]).at[dst].set(
+                rows).reshape(pool.shape)
+
         if quant:
             sc = jnp.maximum(
                 jnp.max(jnp.abs(k.astype(jnp.float32)), axis=-1) / 127.0,
@@ -551,19 +715,13 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
                 1e-8)
             vq = jnp.clip(jnp.round(v.astype(jnp.float32)
                                     / vc[..., None]), -127, 127)
-            kp = kp.reshape((-1,) + kp.shape[2:]).at[dst].set(
-                kq[:, 0].astype(jnp.int8)).reshape(kp.shape)
-            vp = vp.reshape((-1,) + vp.shape[2:]).at[dst].set(
-                vq[:, 0].astype(jnp.int8)).reshape(vp.shape)
-            ksp = ksp.reshape((-1,) + ksp.shape[2:]).at[dst].set(
-                sc[:, 0].astype(jnp.float32)).reshape(ksp.shape)
-            vsp = vsp.reshape((-1,) + vsp.shape[2:]).at[dst].set(
-                vc[:, 0].astype(jnp.float32)).reshape(vsp.shape)
+            kp = _pool_write(kp, kq[:, 0].astype(jnp.int8))
+            vp = _pool_write(vp, vq[:, 0].astype(jnp.int8))
+            ksp = _pool_write(ksp, sc[:, 0].astype(jnp.float32))
+            vsp = _pool_write(vsp, vc[:, 0].astype(jnp.float32))
         else:
-            kp = kp.reshape((-1,) + kp.shape[2:]).at[dst].set(
-                k[:, 0].astype(kp.dtype)).reshape(kp.shape)
-            vp = vp.reshape((-1,) + vp.shape[2:]).at[dst].set(
-                v[:, 0].astype(vp.dtype)).reshape(vp.shape)
+            kp = _pool_write(kp, k[:, 0].astype(kp.dtype))
+            vp = _pool_write(vp, v[:, 0].astype(vp.dtype))
         if fused:
             # trace-time dispatch counter + bytes-saved estimate: the
             # rotated q's HBM write+read per layer (plus, on int8
@@ -594,15 +752,19 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
         else:
             xo = xc + ow
         h2 = rms_norm(xo, lp["mlp_norm"], cfg.rms_eps)
-        g = jax.nn.silu((h2 @ _w(lp, "wg", xc.dtype)).astype(
-            jnp.float32)).astype(xc.dtype)
-        u = h2 @ _w(lp, "wu", xc.dtype)
-        if tp_axis is not None:
-            gu = _tp_allgather(g * u, tp_axis, 2)
-            y = xo + _tp_allgather(gu @ _w(lp, "wd", xc.dtype),
-                                   tp_axis, 2)
+        if cfg.moe is not None:
+            y = xo + _moe_ffn(h2, lp, cfg, tp_axis=tp_axis,
+                              dp_axis=dp_axis)
         else:
-            y = xo + (g * u) @ _w(lp, "wd", xc.dtype)
+            g = jax.nn.silu((h2 @ _w(lp, "wg", xc.dtype)).astype(
+                jnp.float32)).astype(xc.dtype)
+            u = h2 @ _w(lp, "wu", xc.dtype)
+            if tp_axis is not None:
+                gu = _tp_allgather(g * u, tp_axis, 2)
+                y = xo + _tp_allgather(gu @ _w(lp, "wd", xc.dtype),
+                                       tp_axis, 2)
+            else:
+                y = xo + (g * u) @ _w(lp, "wd", xc.dtype)
         return y, ((kp, vp, ksp, vsp) if quant else (kp, vp))
 
     xs = [params["layers"], paged["k"], paged["v"]]
@@ -624,6 +786,10 @@ def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
     logits = (x[:, -1] @ head).astype(jnp.float32)
     if gather:
         logits = _tp_allgather(logits, tp_axis, 1)
+    if dp_axis is not None:
+        # full-batch logits on every shard: sampling + constraint masks
+        # stay on replicated data outside the mesh
+        logits = _tp_allgather(logits, dp_axis, 0)
     return logits, new_paged
 
 
@@ -667,6 +833,8 @@ def quantize_weights(params, cfg: LlamaConfig, bits: int = 8,
     out = {k: v for k, v in params.items()}
     layers = dict(params["layers"])
     for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+        if name not in layers:
+            continue        # MoE trees: moe_* expert stacks stay fp
         qw, sc = jax.vmap(q)(layers[name])
         layers[name] = qw
         layers[name + "_scale"] = sc
@@ -777,7 +945,8 @@ def _rope_rows(x, cos, sin, rpos):
 def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                  use_kernel=None, rpos=None, kstart=None,
                  cache_ks=None, cache_vs=None, tp_axis=None,
-                 fused=False, ad_l=None, aslot=None, ascale=None):
+                 dp_axis=None, fused=False, ad_l=None, aslot=None,
+                 ascale=None):
     """One decoder layer over T tokens starting at cache index ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
     rpos: optional (B,T) per-row rope positions (!= cache index when the
@@ -858,6 +1027,12 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
     else:
         x = x + ow
     h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    if cfg.moe is not None:
+        # serving MoE FFN (ISSUE 17): dense-dispatch on a single chip,
+        # expert-parallel over dp when the stacks arrive E-sharded
+        return (x + _moe_ffn(h2, lp, cfg, tp_axis=tp_axis,
+                             dp_axis=dp_axis),
+                cache_k, cache_v, cache_ks, cache_vs)
     g = jax.nn.silu((h2 @ _w(lp, "wg", x.dtype)).astype(
         jnp.float32)).astype(x.dtype)
     u = h2 @ _w(lp, "wu", x.dtype)
@@ -872,8 +1047,8 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
 def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
                     kstart=None, logits_at=None, logits_all=False,
-                    tp_axis=None, fused=False, adapters=None,
-                    adapter_slots=None):
+                    tp_axis=None, dp_axis=None, fused=False,
+                    adapters=None, adapter_slots=None):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
     (B, V), updated cache). ``logits_at``: optional TRACED row index
     into ``tokens`` — logits are taken there instead of at row T-1
@@ -905,8 +1080,8 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
         y, nk, nv, nks, nvs = _block_infer(
             xc, lp, ck, cv, pos, cos, sin, cfg, use_kernel=use_kernel,
             rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs,
-            tp_axis=tp_axis, fused=fused, ad_l=ad_l, aslot=aslot,
-            ascale=asc)
+            tp_axis=tp_axis, dp_axis=dp_axis, fused=fused, ad_l=ad_l,
+            aslot=aslot, ascale=asc)
         return y, ((nk, nv, nks, nvs) if quant else (nk, nv))
 
     xs = [params["layers"], cache["k"], cache["v"]]

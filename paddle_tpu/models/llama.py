@@ -223,17 +223,33 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
 #: transform to ``wk_scale``/``wv_scale`` exactly as to ``wk``/``wv``
 #: (per-head column blocks, group axis untouched). Coverage gated in
 #: tests/test_lowbit_decode.py.
+#:
+#: MoE leaves (ISSUE 17): the router ``moe_gate`` replicates (every
+#: shard routes identically — the bit-identity precondition for
+#: expert-parallel dispatch), while the expert stacks ``moe_wg`` /
+#: ``moe_wu`` / ``moe_wd`` (``(L, E, h, i)`` / ``(L, E, i, h)``) shard
+#: their EXPERT axis over dp (expert parallelism — each dp shard owns
+#: ``E/dp`` experts) and their output columns over tp, the same
+#: column-parallel trick as the dense matrices. On a 1-D mesh
+#: (``dp_axis=None``) the expert axis stays whole and only the column
+#: split applies.
 SERVING_TP_RULES = (
+    (r"layers/moe_gate$", "replicate"),
+    (r"layers/(moe_wg|moe_wu|moe_wd)(_scale)?$", "experts"),
     (r"layers/(wq|wk|wv|wo|wg|wu|wd)(_scale)?$", "last"),
     (r"lm_head(_scale)?$", "last"),
     (r"", "replicate"),
 )
 
 
-def match_partition_rules(params, rules=SERVING_TP_RULES, axis="tp"):
+def match_partition_rules(params, rules=SERVING_TP_RULES, axis="tp",
+                          dp_axis=None):
     """Regex partition rules over '/'-joined leaf names -> a pytree of
     PartitionSpecs (the fmengine/EasyLM ``match_partition_rules`` idiom;
-    see SNIPPETS [3]). First matching rule wins; scalars replicate."""
+    see SNIPPETS [3]). First matching rule wins; scalars replicate.
+    ``dp_axis`` names the mesh axis the "experts" rule shards the
+    expert dimension over (None = replicate the experts, the 1-D
+    mesh)."""
     def spec(path, leaf):
         name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                         for k in path)
@@ -244,6 +260,10 @@ def match_partition_rules(params, rules=SERVING_TP_RULES, axis="tp"):
                 return P()
             if kind == "last":
                 return P(*([None] * (leaf.ndim - 1) + [axis]))
+            if kind == "experts":
+                # (L, E, ..., out): experts over dp, columns over tp
+                return P(None, dp_axis,
+                         *([None] * (leaf.ndim - 3) + [axis]))
             raise ValueError(f"unknown partition rule kind {kind!r}")
         raise ValueError(f"no partition rule matched param {name!r}")
     return jax.tree_util.tree_map_with_path(spec, params)
@@ -261,12 +281,20 @@ def validate_serving_tp(cfg: LlamaConfig, tp: int) -> int:
     head), i.e. the pool's head extent expands to ``tp`` with each kv
     head repeated ``tp/num_kv_heads`` times — page bytes per shard are
     ``1/num_kv_heads`` of the pool instead of ``1/tp``."""
-    if tp < 1:
-        raise ValueError(f"serving tp must be >= 1, got {tp}")
     if cfg.moe is not None:
         raise ValueError(
-            "serving TP does not support MoE configs yet — expert "
-            "parallelism owns the ffn axis (train-side ep meshes)")
+            "serving TP does not support MoE configs yet — use "
+            "validate_serving_mesh / a 2-D serving_mesh(tp, dp) for "
+            "expert-parallel MoE decode (ISSUE 17)")
+    return _validate_serving_heads(cfg, tp)
+
+
+def _validate_serving_heads(cfg: LlamaConfig, tp: int) -> int:
+    """The head-divisibility half of the serving-mesh gate (shared by
+    :func:`validate_serving_tp` and :func:`validate_serving_mesh`);
+    returns per-shard kv heads."""
+    if tp < 1:
+        raise ValueError(f"serving tp must be >= 1, got {tp}")
     if cfg.num_heads % tp:
         raise ValueError(
             f"num_heads={cfg.num_heads} is not divisible by tp={tp}: "
@@ -285,6 +313,40 @@ def validate_serving_tp(cfg: LlamaConfig, tp: int) -> int:
         f"num_kv_heads % tp == 0 or tp % num_kv_heads == 0.")
 
 
+def validate_serving_mesh(cfg: LlamaConfig, tp: int, dp: int = 1) -> int:
+    """Divisibility gate for the 2-D tp x dp serving mesh (ISSUE 17);
+    returns PER-SHARD kv heads (the tp half — identical contract to
+    :func:`validate_serving_tp`).
+
+    The dp axis splits the step programs' BATCH, so it imposes no
+    weight-divisibility constraint of its own on dense configs — the
+    engine separately requires ``max_batch % dp == 0``. MoE configs ARE
+    accepted here (unlike ``validate_serving_tp``): expert parallelism
+    shards the expert stacks' E axis over dp and their output columns
+    over tp, so ``num_experts % dp``, ``intermediate_size % tp`` and
+    ``hidden_size % tp`` must all divide — anything else raises LOUDLY
+    instead of mis-sharding an expert across shards."""
+    if dp < 1:
+        raise ValueError(f"serving dp must be >= 1, got {dp}")
+    nkv_shard = _validate_serving_heads(cfg, tp)
+    if cfg.moe is not None:
+        E = cfg.moe.num_experts
+        if E % dp:
+            raise ValueError(
+                f"num_experts={E} is not divisible by dp={dp}: expert "
+                f"parallelism places whole experts (E/dp per dp shard); "
+                f"a split expert has no owner for its tokens. Pick dp "
+                f"from the divisors of num_experts.")
+        if cfg.intermediate_size % tp or cfg.hidden_size % tp:
+            raise ValueError(
+                f"MoE expert matrices cannot column-shard: "
+                f"intermediate_size={cfg.intermediate_size} and "
+                f"hidden_size={cfg.hidden_size} must both divide "
+                f"tp={tp} (the experts' gate/up columns and down-proj "
+                f"output columns shard over tp).")
+    return nkv_shard
+
+
 def _expand_kv_heads(w: jax.Array, hd: int, rep: int) -> jax.Array:
     """Repeat the per-head column blocks of a K/V projection (or its
     quant scale) ``rep`` times: (..., nkv*hd) -> (..., nkv*rep*hd). The
@@ -298,14 +360,19 @@ def _expand_kv_heads(w: jax.Array, hd: int, rep: int) -> jax.Array:
 
 def shard_serving_params(params: Dict[str, Any], cfg: LlamaConfig, mesh,
                          axis: str = "tp"):
-    """Place a (possibly weight-quantized) serving param tree on a 1-D
-    tp mesh: validate divisibility, apply the GQA KV-replication expand
-    when ``num_kv_heads < tp``, match the regex partition rules, and
+    """Place a (possibly weight-quantized) serving param tree on the
+    serving mesh — 1-D tp or 2-D tp x dp (ISSUE 17): validate
+    divisibility, apply the GQA KV-replication expand when
+    ``num_kv_heads < tp``, match the regex partition rules, and
     device_put every leaf. Returns ``(placed_params, spec_pytree)`` —
     the specs double as the ``shard_map`` in_specs of the serving
-    programs (inference/predictor.py)."""
+    programs (inference/predictor.py). On the 2-D mesh dense weights
+    replicate across dp (their specs name only the tp axis) and the MoE
+    expert stacks shard E over the dp axis."""
     tp = int(mesh.shape[axis])
-    nkv_shard = validate_serving_tp(cfg, tp)
+    dp_axis = next((a for a in mesh.axis_names if a != axis), None)
+    dp = int(mesh.shape[dp_axis]) if dp_axis is not None else 1
+    nkv_shard = validate_serving_mesh(cfg, tp, dp)
     if nkv_shard * tp != cfg.num_kv_heads:        # replication path
         rep = tp // cfg.num_kv_heads
         layers = dict(params["layers"])
@@ -313,7 +380,7 @@ def shard_serving_params(params: Dict[str, Any], cfg: LlamaConfig, mesh,
             if nm in layers:
                 layers[nm] = _expand_kv_heads(layers[nm], cfg.hd, rep)
         params = {**params, "layers": layers}
-    specs = match_partition_rules(params, axis=axis)
+    specs = match_partition_rules(params, axis=axis, dp_axis=dp_axis)
     placed = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs)
@@ -336,11 +403,11 @@ def adapter_partition_specs(cfg: LlamaConfig, mesh,
     single-chip by the same exact-concat argument as the column-split
     weights. Validates the same divisibility contract the base rules
     assume (q width ``nh*hd`` and o width ``hidden`` both divide tp)."""
-    if len(mesh.axis_names) != 1:
+    ax = axis or ("tp" if "tp" in mesh.axis_names else mesh.axis_names[0])
+    if ax not in mesh.axis_names:
         raise ValueError(
-            f"adapter_partition_specs: the serving mesh must be 1-D, "
-            f"got axes {mesh.axis_names}")
-    ax = axis or mesh.axis_names[0]
+            f"adapter_partition_specs: axis {ax!r} is not an axis of "
+            f"the serving mesh {mesh.axis_names}")
     tp = int(mesh.shape[ax])
     h, dq = cfg.hidden_size, cfg.num_heads * cfg.hd
     if dq % tp or h % tp:

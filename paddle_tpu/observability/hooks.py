@@ -374,6 +374,48 @@ def serving_tp_logits_gather(t0_ns: int, out):
                               50, 100)).observe((now - t0_ns) / 1e6)
 
 
+def serving_dp_step(dp: int, batches):
+    """One 2-D-mesh engine step (ISSUE 17): per-dp-shard batch gauge.
+    ``batches`` maps dp shard index -> decode rows the scheduler
+    assigned that shard this step (the planner balances within each
+    priority class, so a persistent skew here is a planning bug made
+    observable, the serving_tp_step idiom applied to the second
+    axis)."""
+    if not enabled:
+        return
+    g = _m.gauge("serving_dp_batch_rows",
+                 "decode rows per dp shard in the last 2-D-mesh step",
+                 ("shard",))
+    for s in range(dp):
+        g.labels(str(s)).set(batches.get(s, 0) if hasattr(batches, "get")
+                             else batches[s])
+    _m.gauge("serving_dp_shards",
+             "dp mesh size of the serving engine").set(dp)
+
+
+def serving_moe_dispatch(nbytes: int, routed: int):
+    """One expert-parallel MoE dispatch traced into a serving program
+    (models/generate._moe_ffn): the all-to-all pair that ships routed
+    token copies to their experts' owner shards and the outputs back.
+    Fires at TRACE time (the :func:`serving_tp_allgather` contract) —
+    once per compile per layer, reporting the compiled program's
+    per-step collective bill; the routed-tokens histogram records the
+    static item count (tokens x top_k) each dispatch carries."""
+    if not enabled:
+        return
+    _m.counter("serving_moe_dispatch_calls_total",
+               "expert-parallel all-to-all dispatches traced into "
+               "serving programs").inc()
+    _m.counter("serving_moe_dispatch_bytes_total",
+               "per-shard payload bytes of traced MoE all-to-all "
+               "dispatches (tokens there + outputs back)").inc(nbytes)
+    _m.histogram("serving_moe_routed_tokens",
+                 "routed token copies (tokens x top_k) per traced MoE "
+                 "dispatch",
+                 buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+                 ).observe(routed)
+
+
 def serving_queue_wait(seconds: float, priority: int):
     """One admission's time-in-queue (scheduler submit -> slot), by
     priority class — the SLO the scheduler exists to bound."""

@@ -539,12 +539,20 @@ class PagedKVCache:
         self.tp = None
         self.tp_axis = None
         self.pool_specs = None
-        if mesh is not None and len(mesh.axis_names) != 1:
-            raise ValueError(
-                f"PagedKVCache: the serving mesh must be 1-D (a tp "
-                f"axis), got axes {mesh.axis_names}")
-        tp = int(mesh.shape[mesh.axis_names[0]]) if mesh is not None \
-            else None
+        # 1-D ("tp",) or 2-D ("tp", "dp") serving mesh (ISSUE 17): the
+        # pool shards on the head axis over tp only; its specs never
+        # name the dp axis, so the pool is REPLICATED across dp — same
+        # page ids on every dp shard, host bookkeeping unchanged.
+        if mesh is not None:
+            ax = "tp" if "tp" in mesh.axis_names else mesh.axis_names[0]
+            if len(mesh.axis_names) > 2 or (
+                    len(mesh.axis_names) == 2 and ax != "tp"):
+                raise ValueError(
+                    f"PagedKVCache: the serving mesh must be 1-D (tp) "
+                    f"or 2-D (tp, dp), got axes {mesh.axis_names}")
+            tp = int(mesh.shape[ax])
+        else:
+            ax, tp = None, None
         # init_paged_cache(tp=...) validates head divisibility LOUDLY
         # (and expands the head extent on the GQA replication path)
         self.pool = _gen.init_paged_cache(cfg, num_pages, page_size,
@@ -553,7 +561,7 @@ class PagedKVCache:
             import jax
             from jax.sharding import NamedSharding
             self.tp = tp
-            self.tp_axis = ax = mesh.axis_names[0]
+            self.tp_axis = ax
             self.pool_specs = pool_partition_specs(self.pool, ax)
             self.pool = {
                 n: jax.device_put(a, NamedSharding(mesh,

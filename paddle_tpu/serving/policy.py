@@ -126,7 +126,8 @@ class TokenBudgetPlanner:
              pending: Sequence[Tuple[int, int, int, int]],
              chunk_cap: Optional[int] = None,
              spec_drafts: Optional[Dict[int, int]] = None,
-             reserved_tokens: int = 0) -> StepPlan:
+             reserved_tokens: int = 0,
+             dp_group: Optional[Dict[int, int]] = None) -> StepPlan:
         """Build one step's :class:`StepPlan`.
 
         decode_ready: ``(priority, rid, slot)`` per decodable slot
@@ -151,6 +152,18 @@ class TokenBudgetPlanner:
                       The plan packs into the remainder, keeping the
                       budget a hard per-step ceiling; with no budget
                       configured the reserve is recorded but unused.
+        dp_group:     ``slot -> dp shard row-block`` on a 2-D serving
+                      mesh (ISSUE 17). The step program's wall time is
+                      the max over dp shards, so a budget that
+                      truncates the decode set must spread the taken
+                      rows ACROSS shards, not fill one shard's block
+                      first. Within each priority class the decode
+                      items are re-keyed so the sorted-merge visits
+                      them round-robin across dp groups (FIFO within a
+                      group) — the (priority, rid) key multiset is
+                      unchanged, so fairness against prefills and the
+                      hard budget ceiling are untouched; with budget
+                      headroom for every row the same rows decode.
         """
         page = self.page_size
         spec = spec_drafts or {}
@@ -172,6 +185,8 @@ class TokenBudgetPlanner:
                         reserved_tokens=int(reserved_tokens))
         items = [(p, rid, "decode", slot, 1 + int(spec.get(slot, 0)))
                  for p, rid, slot in decode_ready]
+        if dp_group:
+            items = self._balance_dp(items, dp_group)
         for p, rid, slot, remaining in pending:
             width = -(-remaining // page) * page
             if chunk_cap is not None:
@@ -195,6 +210,37 @@ class TokenBudgetPlanner:
                     plan.prefills.append((slot, take))
                     left -= take
         return plan
+
+    @staticmethod
+    def _balance_dp(decode_items, dp_group):
+        """Re-key decode items for a 2-D mesh (see :meth:`plan`):
+        within each priority class, hand the class's sorted rid keys
+        out to the items in round-robin-across-dp-group order (FIFO
+        within a group). The (priority, rid) multiset — and therefore
+        every decode-vs-prefill merge decision and the budget math —
+        is exactly what it was; only WHICH decode row a truncation
+        drops changes, from "the youngest rids" to "the youngest rid
+        of the most-loaded shard, repeatedly"."""
+        out = []
+        by_p: Dict[int, list] = {}
+        for it in decode_items:
+            by_p.setdefault(it[0], []).append(it)
+        for p, its in by_p.items():
+            its.sort(key=lambda it: it[1])
+            rids = [it[1] for it in its]
+            gq: Dict[int, list] = {}
+            for it in its:
+                gq.setdefault(dp_group.get(it[3], 0), []).append(it)
+            queues = [q for _, q in sorted(gq.items())]
+            order = []
+            while any(queues):
+                for q in queues:
+                    if q:
+                        order.append(q.pop(0))
+            out.extend((p, rid, kind, slot, cost)
+                       for rid, (_, _, kind, slot, cost)
+                       in zip(rids, order))
+        return out
 
 
 class PreemptionPolicy:

@@ -406,9 +406,17 @@ class ServingScheduler:
         else:
             self._drafts = eng.propose_drafts(ready)
             widths = {s: d.size for s, d in self._drafts.items()} or None
+        # 2-D serving mesh (ISSUE 17): slots split into contiguous
+        # per-dp-shard row blocks, and the step's wall time is the max
+        # over shards — tell the planner which block each slot rides
+        # so a budget-truncated decode set spreads across shards
+        dpg = None
+        if int(getattr(eng, "dp", 1) or 1) > 1:
+            rows = eng.max_batch // eng.dp
+            dpg = {s: s // rows for s in range(eng.max_batch)}
         return self.planner.plan(
             decode, pending, chunk_cap=eng.prefill_chunk,
-            spec_drafts=widths, reserved_tokens=reserved)
+            spec_drafts=widths, reserved_tokens=reserved, dp_group=dpg)
 
     def _trim_plan(self, plan: StepPlan) -> StepPlan:
         """Reconcile an overlap-mode plan with the commit that just

@@ -87,8 +87,10 @@ def pytest_collection_modifyitems(config, items):
             return 2
         if "test_wal" in path:
             return 3
-        if "test_tracing" in path:      # ISSUE 16: newest, dead last
+        if "test_tracing" in path:
             return 4
+        if "test_tp2d" in path:         # ISSUE 17: newest, dead last
+            return 5
         return None
     tail = sorted((it for it in rest if _tail_rank(it) is not None),
                   key=_tail_rank)

@@ -1,0 +1,363 @@
+"""2-D tp x dp serving-mesh tests (ISSUE 17).
+
+The acceptance gate: the 2-D-sharded engine — weights tp-partitioned and
+dp-replicated, page pools head-sharded on tp and REPLICATED across dp
+(the host allocator assigns the same page ids on every dp shard), the
+decode/verify batch split into per-dp-shard row blocks — must be
+BIT-IDENTICAL to the single-chip paged engine at fp and int8-KV, for
+plain decode, chunked prefill, prefix-cache resume, preempt->resume and
+speculative verify; the PR 11 fused kernels and the PR 12 overlap
+scheduler must survive the 2-D lowering unchanged; and expert-parallel
+MoE decode (experts sharded E/dp per shard, per-token all-to-all
+dispatch) must reproduce the single-device dense-dispatch MoE engine.
+
+GEOMETRY RULE (the parity precondition): XLA CPU matmuls are
+batch-extent-sensitive in the last mantissa bit, so the single-chip
+reference engine's ``max_batch`` must equal the 2-D engine's PER-SHARD
+row count (``max_batch // dp``) — references are jitted engine runs,
+never eager recomputes. Prompt lists are duplicated per dp block so
+every shard carries the same work its reference saw.
+
+Runs on 8 virtual host-platform devices (conftest forces
+``--xla_force_host_platform_device_count=8``): tp=2 x dp=2 is the fast
+tier-1 representative; the tp=2 x dp=4 and int8 sweeps ride outside
+``-m 'not slow'`` (ISSUE 13 watchdog-headroom satellite).
+"""
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu.models import llama, generate
+from paddle_tpu.models.moe import MoEConfig
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.distributed.mesh import serving_mesh
+from paddle_tpu.serving import Priority, ServingScheduler
+from paddle_tpu.serving.policy import TokenBudgetPlanner
+
+_CFG = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
+_PARAMS = llama.init_params(jax.random.key(0), _CFG)
+_MOE_CFG = llama.LlamaConfig.tiny(
+    num_layers=2, max_seq_len=64,
+    moe=MoEConfig(num_experts=4, top_k=2))
+_MOE_PARAMS = llama.init_params(jax.random.key(1), _MOE_CFG)
+_REF = {}           # (scenario, kv) -> single-chip reference outputs
+
+
+def _prompts(cfg, lens, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(3, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in lens]
+
+
+def _engine(params, cfg, tp=None, dp=1, **kw):
+    mesh = serving_mesh(tp, dp) if tp else None
+    kw.setdefault("max_batch", 2 * dp)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_len", 32)
+    return ContinuousBatchingEngine(params, cfg, mesh=mesh, **kw)
+
+
+def _ref(scenario, kv, make):
+    """One cached single-chip reference run per (scenario, kv)."""
+    key = (scenario, kv)
+    if key not in _REF:
+        _REF[key] = make()
+    return _REF[key]
+
+
+_MIX = _prompts(_CFG, [4, 7], seed=1)
+
+
+def _mix_ref(kv):
+    # max_batch=2 == the 2-D engines' per-shard row count
+    return _ref("mix", kv, lambda: [np.asarray(o) for o in _engine(
+        _PARAMS, _CFG, kv_cache_dtype=kv, max_batch=2).generate(
+            _MIX, max_new_tokens=6)])
+
+
+def _assert_blocks_match(outs, ref, dp):
+    """Every dp block of outputs reproduces the reference streams."""
+    per = len(ref)
+    for d in range(dp):
+        for a, b in zip(ref, outs[d * per:(d + 1) * per]):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestTp2dDecodeParity:
+    """ACCEPTANCE: 2-D-sharded paged decode == single-chip paged
+    decode, token for token, at fp and int8-KV."""
+
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    @pytest.mark.parametrize("dp", [
+        2, pytest.param(4, marks=pytest.mark.slow)])
+    def test_mixed_length_batch(self, dp, kv):
+        ref = _mix_ref(kv)
+        eng = _engine(_PARAMS, _CFG, tp=2, dp=dp, kv_cache_dtype=kv)
+        out = eng.generate(_MIX * dp, max_new_tokens=6)
+        _assert_blocks_match(out, ref, dp)
+        assert eng.dp == dp and eng.stats()["dp"] == dp
+        if kv is None and dp == 2:
+            # the pool stays tp-only sharded (dp-REPLICATED): per-shard
+            # bytes equal a 1-D tp=2 engine's at the SAME geometry —
+            # the dp axis adds no pool partitions
+            e1 = _engine(_PARAMS, _CFG, tp=2, max_batch=2 * dp)
+            assert eng.cache.pool_bytes_per_shard == \
+                e1.cache.pool_bytes_per_shard
+
+
+class TestTp2dPrefillParity:
+    @pytest.mark.parametrize("dp,kv", [
+        (2, None),
+        pytest.param(2, "int8", marks=pytest.mark.slow),
+        pytest.param(4, None, marks=pytest.mark.slow)])
+    def test_chunked_prefill(self, dp, kv):
+        """An 18-token prompt through 8-token chunks per dp block: the
+        chunk program stays dp-replicated (B==1) and bit-identical."""
+        prompts = _prompts(_CFG, [18], seed=3)
+        ref = _ref("chunk", kv, lambda: np.asarray(_engine(
+            _PARAMS, _CFG, max_batch=1, prefill_chunk=8,
+            kv_cache_dtype=kv).generate(prompts, max_new_tokens=5)[0]))
+        out = _engine(_PARAMS, _CFG, tp=2, dp=dp, max_batch=dp,
+                      prefill_chunk=8, kv_cache_dtype=kv).generate(
+                          prompts * dp, max_new_tokens=5)
+        _assert_blocks_match(out, [ref], dp)
+
+    @pytest.mark.parametrize("kv", [
+        None, pytest.param("int8", marks=pytest.mark.slow)])
+    def test_prefix_cache_resume(self, kv):
+        """Shared-system-prompt wave, one request at a time (identical
+        admission pattern on both engines): later admissions map trie
+        pages + copy-on-write the partial tail on the dp-replicated
+        pool, and the host-side allocator bookkeeping stays
+        byte-identical to the single-chip engine's (it never sees the
+        mesh)."""
+        rs = np.random.RandomState(5)
+        sysp = rs.randint(3, _CFG.vocab_size, (12,)).astype(np.int32)
+        wave = [np.concatenate([sysp, rs.randint(
+            3, _CFG.vocab_size, (3,)).astype(np.int32)])
+            for _ in range(3)]
+
+        def run(tp, dp, mb):
+            eng = _engine(_PARAMS, _CFG, tp=tp, dp=dp, max_batch=mb,
+                          kv_cache_dtype=kv)
+            outs = [np.asarray(eng.generate([p], max_new_tokens=4)[0])
+                    for p in wave]
+            return outs, (eng.cache.allocator.stats(),
+                          eng.cache.allocator._refcount.copy(),
+                          eng.cache.cow_copies,
+                          eng.cache.allocator.shares_total)
+
+        ref, ref_state = _ref("prefix", kv, lambda: run(None, 1, 2))
+        out, state = run(2, 2, 4)
+        for a, b in zip(ref, out):
+            np.testing.assert_array_equal(a, b)
+        assert state[2] > 0 and state[3] > 0     # CoW + shares fired
+        assert state[2] == ref_state[2] and state[3] == ref_state[3]
+        # geometry-independent bookkeeping matches exactly (the 2-D
+        # pool holds more pages — max_batch 4 vs 2 — so the capacity
+        # keys differ by construction; the allocation/refcount STORY
+        # must not)
+        for k in ("allocs_total", "frees_total", "num_used",
+                  "shares_total", "alloc_failures"):
+            assert state[0][k] == ref_state[0][k], k
+        n = len(ref_state[1])
+        np.testing.assert_array_equal(ref_state[1], state[1][:n])
+        assert not state[1][n:].any()
+
+
+class TestTp2dSchedulerAndSpec:
+    @pytest.mark.parametrize("kv", [
+        None, pytest.param("int8", marks=pytest.mark.slow)])
+    def test_preempt_resume_parity(self, kv):
+        """Preempt -> swap/evict -> resume on the 2-D engine reproduces
+        the uninterrupted SINGLE-CHIP decode bit-for-bit (per-shard row
+        count 1 -> reference max_batch=1)."""
+        ps = _prompts(_CFG, [6, 5, 4], seed=2)
+
+        def ref_one(p, new):
+            return np.asarray(_engine(
+                _PARAMS, _CFG, max_batch=1, kv_cache_dtype=kv).generate(
+                    [p], max_new_tokens=new)[0])
+
+        refs = _ref("preempt", kv,
+                    lambda: [ref_one(ps[0], 8), ref_one(ps[1], 8)])
+        mesh = serving_mesh(2, 2)
+        eng = ContinuousBatchingEngine(
+            _PARAMS, _CFG, max_batch=2, page_size=8, max_len=32,
+            kv_cache_dtype=kv, mesh=mesh)
+        sched = ServingScheduler(eng, mesh=mesh)
+        a = sched.submit(ps[0], max_new_tokens=8, priority=Priority.LOW)
+        b = sched.submit(ps[1], max_new_tokens=8, priority=Priority.LOW)
+        while len(a.tokens) < 3:
+            sched.step()
+        c = sched.submit(ps[2], max_new_tokens=2,
+                         priority=Priority.HIGH)
+        sched.step()
+        assert sched.preemptions_total == 1
+        sched.run()
+        assert a.done and b.done and c.done
+        np.testing.assert_array_equal(a.output, refs[0])
+        np.testing.assert_array_equal(b.output, refs[1])
+
+    @pytest.mark.parametrize("dp,kv", [
+        (2, None),
+        pytest.param(2, "int8", marks=pytest.mark.slow),
+        pytest.param(4, None, marks=pytest.mark.slow)])
+    def test_spec_verify_parity(self, dp, kv):
+        """Speculative decoding on the 2-D engine (batch-split verify
+        program) == plain single-chip paged decode, with real n-gram
+        drafts accepted along the way."""
+        rs = np.random.RandomState(7)
+        motif = rs.randint(3, _CFG.vocab_size, (4,)).astype(np.int32)
+        rep = [np.concatenate([
+            rs.randint(3, _CFG.vocab_size, (1,)).astype(np.int32),
+            np.tile(motif, 4)[:11]])]
+        ref = _ref("spec", kv, lambda: np.asarray(_engine(
+            _PARAMS, _CFG, max_batch=1, kv_cache_dtype=kv).generate(
+                rep, max_new_tokens=8)[0]))
+        eng = _engine(_PARAMS, _CFG, tp=2, dp=dp, max_batch=dp,
+                      spec_k=3, kv_cache_dtype=kv)
+        out = eng.generate(rep * dp, max_new_tokens=8)
+        _assert_blocks_match(out, [ref], dp)
+        assert eng.spec.drafted_total > 0      # verify actually ran
+
+    def test_planner_spreads_budget_across_dp_groups(self):
+        """A budget that truncates the decode set must take rows
+        round-robin ACROSS dp shard groups (step wall time is the max
+        over shards), FIFO within a group — and leave the
+        (priority, rid) fairness order against prefills untouched."""
+        planner = TokenBudgetPlanner(2, 1)
+        decode = [(int(Priority.NORMAL), rid, slot)
+                  for rid, slot in [(10, 0), (11, 1), (12, 2), (13, 3)]]
+        dpg = {0: 0, 1: 0, 2: 1, 3: 1}
+        plan = planner.plan(decode, [], dp_group=dpg)
+        assert sorted(plan.decode_slots) == [0, 2]   # one per group
+        assert plan.deferred_decodes == 2
+        # without the grouping the same budget fills one shard's block
+        plain = planner.plan(decode, [])
+        assert sorted(plain.decode_slots) == [0, 1]
+        # headroom for every row -> the same rows decode either way
+        full = TokenBudgetPlanner(8, 1)
+        assert sorted(full.plan(decode, [], dp_group=dpg).decode_slots) \
+            == sorted(full.plan(decode, []).decode_slots)
+
+
+class TestTp2dEngineKnobs:
+    @pytest.mark.parametrize("kw", [{"fused": True}, {"overlap": True}])
+    def test_fused_and_overlap_survive_2d(self, kw):
+        """The PR 11 fused-kernel route and the PR 12 double-buffered
+        scheduler must hold token identity on the 2-D mesh."""
+        ref = _mix_ref(None)
+        eng = _engine(_PARAMS, _CFG, tp=2, dp=2, **kw)
+        out = eng.generate(_MIX * 2, max_new_tokens=6)
+        _assert_blocks_match(out, ref, 2)
+
+    def test_max_batch_not_divisible_by_dp_raises(self):
+        with pytest.raises(ValueError, match="divisible by dp"):
+            _engine(_PARAMS, _CFG, tp=2, dp=2, max_batch=3)
+
+
+class TestMoeEpDecode:
+    """ACCEPTANCE: expert-parallel MoE decode (experts E/dp per shard,
+    per-token all-to-all dispatch, capacity-dropless routing) ==
+    the single-device dense-dispatch MoE engine, token for token."""
+
+    @pytest.mark.parametrize("dp", [
+        2, pytest.param(4, marks=pytest.mark.slow)])
+    def test_moe_ep_parity(self, dp):
+        mps = _prompts(_MOE_CFG, [4, 7], seed=3)
+        ref = _ref("moe", None, lambda: [np.asarray(o) for o in _engine(
+            _MOE_PARAMS, _MOE_CFG, max_batch=2).generate(
+                mps, max_new_tokens=6)])
+        eng = _engine(_MOE_PARAMS, _MOE_CFG, tp=2, dp=dp)
+        out = eng.generate(mps * dp, max_new_tokens=6)
+        _assert_blocks_match(out, ref, dp)
+
+    def test_moe_weights_stay_unquantized(self):
+        """Weight-only quant skips the expert stacks (the routed
+        einsum dequant would dominate the dispatch win): no moe_*
+        scales appear and the fp stacks pass through untouched."""
+        qp = generate.quantize_weights(_MOE_PARAMS, _MOE_CFG, bits=8)
+        layers = qp["layers"]
+        assert not any(n.startswith("moe_") and n.endswith("_scale")
+                       for n in layers)
+        for n in ("moe_gate", "moe_wg", "moe_wu", "moe_wd"):
+            assert layers[n].dtype == _MOE_PARAMS["layers"][n].dtype
+        assert layers["wq"].dtype == np.int8      # dense path did quant
+
+
+class TestTp2dValidation:
+    """Satellite: divisibility failures must be LOUD, not mis-shards."""
+
+    def test_mesh_accepts_dense_and_moe(self):
+        assert llama.validate_serving_mesh(_CFG, 2, 2) == 1
+        assert llama.validate_serving_mesh(_MOE_CFG, 2, 2) == 1
+        assert llama.validate_serving_mesh(_MOE_CFG, 2, 4) == 1
+
+    def test_experts_not_divisible_by_dp_raises(self):
+        cfg = llama.LlamaConfig.tiny(
+            num_layers=2, moe=MoEConfig(num_experts=4, top_k=2))
+        with pytest.raises(ValueError, match="num_experts"):
+            llama.validate_serving_mesh(cfg, 2, 3)
+
+    def test_expert_columns_not_divisible_by_tp_raises(self):
+        # num_heads=8 % tp=8 ok, but intermediate_size=100 % 8 != 0
+        cfg = llama.LlamaConfig.tiny(
+            num_layers=2, num_heads=8, num_kv_heads=8,
+            intermediate_size=100,
+            moe=MoEConfig(num_experts=8, top_k=2))
+        with pytest.raises(ValueError, match="intermediate_size"):
+            llama.validate_serving_mesh(cfg, 8, 2)
+
+    def test_validate_serving_tp_rejects_moe(self):
+        with pytest.raises(ValueError, match="MoE"):
+            llama.validate_serving_tp(_MOE_CFG, 2)
+
+    def test_dp_lower_bound(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            llama.validate_serving_mesh(_CFG, 2, 0)
+
+    def test_serving_mesh_2d_validates(self):
+        m = serving_mesh(2, 2)
+        assert m.axis_names == ("tp", "dp")
+        assert m.shape["tp"] == 2 and m.shape["dp"] == 2
+        with pytest.raises(ValueError, match="exceeds"):
+            serving_mesh(2, 99)
+        with pytest.raises(ValueError, match=">= 1"):
+            serving_mesh(2, 0)
+
+    def test_moe_partition_rules(self):
+        """The serving rules replicate the router and shard the expert
+        stacks E-over-dp / columns-over-tp."""
+        from jax.sharding import PartitionSpec as P
+        mesh = serving_mesh(2, 2)
+        _, specs = llama.shard_serving_params(
+            _MOE_PARAMS, _MOE_CFG, mesh)
+        assert specs["layers"]["moe_gate"] == P()
+        assert specs["layers"]["moe_wg"][1] == "dp"
+        assert specs["layers"]["moe_wg"][-1] == "tp"
+        assert specs["layers"]["moe_wd"][1] == "dp"
+        assert specs["layers"]["moe_wd"][-1] == "tp"
+        assert specs["layers"]["wq"][-1] == "tp"   # dense stays tp-only
+
+
+class TestTp2dObservability:
+    def test_dp_and_moe_dispatch_metrics_emitted(self):
+        """One MoE tp2 x dp2 run lands both new families: the
+        per-dp-shard batch gauges (engine commit path) and the traced
+        all-to-all dispatch counters (generate._moe_ffn)."""
+        from paddle_tpu import observability as obs
+        obs.REGISTRY.clear()
+        obs.enable()
+        try:
+            _engine(_MOE_PARAMS, _MOE_CFG, tp=2, dp=2).generate(
+                _prompts(_MOE_CFG, [4], seed=1), max_new_tokens=3)
+            snap = {m.name for m in obs.REGISTRY.collect()}
+        finally:
+            obs.disable()
+            obs.REGISTRY.clear()
+        assert "serving_dp_batch_rows" in snap
+        assert "serving_dp_shards" in snap
+        assert "serving_moe_dispatch_calls_total" in snap
+        assert "serving_moe_dispatch_bytes_total" in snap
+        assert "serving_moe_routed_tokens" in snap

@@ -489,6 +489,137 @@ def validate_serving_tp(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_tp2d(n: int, batch_mult: int = 1):
+    """ISSUE 17 2-D serving-mesh lowering gate: export the
+    dp-BATCH-SHARDED step programs — decode (fp + int8-KV), chunked
+    prefill and spec verify with their batch args split over the dp
+    axis of a ``serving_mesh(tp, dp)`` and the per-layer KV rows +
+    scatter indices all-gathered across dp before the pool write —
+    plus the EXPERT-PARALLEL MoE decode step (expert stacks sharded
+    over dp, per-token all-to-all dispatch) to the TPU platform on the
+    8-device host mesh, requiring the Mosaic ``tpu_custom_call`` where
+    the ragged Pallas kernel is involved. The interpret-green-but-
+    won't-lower failure mode, gated for the 2-D programs."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.models.moe import MoEConfig
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.serving.paged_cache import pool_partition_specs
+    from paddle_tpu.distributed.mesh import serving_mesh
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+    skipped = {}
+    n = len(jax.devices())  # the --devices count the parent forced
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    params = llama.init_params(jax.random.key(0), cfg)
+    mcfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256,
+                                  moe=MoEConfig(num_experts=4, top_k=2))
+    mparams = llama.init_params(jax.random.key(1), mcfg)
+    B, pg = 8, 16
+    tables = jnp.asarray(rs.randint(1, B * 4, (B, 256 // pg)), jnp.int32)
+    toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B,)), jnp.int32)
+    lens = jnp.asarray(rs.randint(1, 200, (B,)), jnp.int32)
+    msk = jnp.asarray(rs.rand(B) > 0.5)
+
+    def build(tp, dp, c, p, kv=None):
+        mesh = serving_mesh(tp, dp)
+        placed, specs = llama.shard_serving_params(p, c, mesh)
+        pool = gen.init_paged_cache(c, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv,
+                                    tp=tp)
+        # head-sharded on tp, REPLICATED across dp — the one layout
+        # the engine uses (shared helper; specs never mention dp)
+        pspecs = pool_partition_specs(pool, "tp")
+        pool = {nm: jax.device_put(a, NamedSharding(mesh, pspecs[nm]))
+                for nm, a in pool.items()}
+        return mesh, placed, specs, pool, pspecs
+
+    def export_decode(tag, tp, dp, c, p, kv=None, kernel=True):
+        mesh, placed, specs, pool, pspecs = build(tp, dp, c, p, kv=kv)
+        bspec = P("dp")  # batch args split over the dp axis
+        fwd = shard_map(
+            lambda pr, t, pl_, bt_, ln_, m: gen.paged_decode_forward(
+                pr, t, pl_, bt_, ln_, c, active=m, use_kernel=kernel,
+                tp_axis="tp", dp_axis="dp"),
+            mesh=mesh,
+            in_specs=(specs, bspec, pspecs, bspec, bspec, bspec),
+            out_specs=(P(), pspecs), check_rep=False)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(jax.jit(fwd), platforms=["tpu"])(
+                placed, toks, pool, tables, lens, msk)
+        lowered[tag] = (not kernel
+                        or "tpu_custom_call" in exp.mlir_module())
+
+    # honor the --devices count: the 2-D gate needs at least a 2x2 grid
+    if n < 4:
+        return {"config": "serving_tp2d_lowering",
+                "compile_s": round(time.monotonic() - t0, 1),
+                "lowered": {},
+                "skipped": {"all": f"--devices {n} < minimum tp2 x dp2; "
+                                   f"nothing to shard"},
+                "fits_v5p": False}
+    export_decode("tp2dp2_ragged_decode_fp", 2, 2, cfg, params)
+    export_decode("tp2dp2_ragged_decode_int8", 2, 2, cfg, params,
+                  kv="int8")
+    # expert-parallel MoE decode (experts sharded over dp, per-token
+    # all-to-all dispatch): pure-XLA path — export completing is the
+    # gate, same contract as the spec-verify/chunk programs
+    export_decode("tp2dp2_moe_ep_decode", 2, 2, mcfg, mparams,
+                  kernel=False)
+    if n >= 8:
+        export_decode("tp2dp4_moe_ep_decode", 2, 4, mcfg, mparams,
+                      kernel=False)
+    else:
+        skipped["tp2dp4_moe_ep_decode"] = (
+            f"--devices {n} < tp2 x dp4 (single-expert-per-shard level)")
+
+    # dp-sharded speculative-verify program (one gather site at the
+    # program end: rows axis 1, dst axis 0, logits axis 0)
+    mesh, placed, specs, pool, pspecs = build(2, 2, cfg, params)
+    spec_chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, 5)),
+                             jnp.int32)
+    bspec = P("dp")
+    vfwd = shard_map(
+        lambda p, ch, pl_, bt_, ln_, m: gen.paged_verify_forward(
+            p, ch, pl_, bt_, ln_, cfg, ctx_cap=64, active=m,
+            tp_axis="tp", dp_axis="dp"),
+        mesh=mesh,
+        in_specs=(specs, bspec, pspecs, bspec, bspec, bspec),
+        out_specs=(P(), pspecs), check_rep=False)
+    jax.export.export(jax.jit(vfwd), platforms=["tpu"])(
+        placed, spec_chunk, pool, tables, jnp.minimum(lens, 60), msk)
+    lowered["tp2dp2_spec_verify_step"] = True
+    # dp-REPLICATED continuation-prefill chunk (batch args keep P();
+    # dp_axis threads through for the MoE dispatch path)
+    cfwd = shard_map(
+        lambda p, ch, pl_, bt_, cl, kl: gen.paged_prefill_chunk(
+            p, ch, pl_, bt_, cfg, ctx_cap=64, ctx_len=cl, chunk_len=kl,
+            tp_axis="tp", dp_axis="dp"),
+        mesh=mesh, in_specs=(specs, P(), pspecs, P(), P(), P()),
+        out_specs=(P(), pspecs), check_rep=False)
+    chunk = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 32)),
+                        jnp.int32)
+    jax.export.export(jax.jit(cfwd), platforms=["tpu"])(
+        placed, chunk, pool, tables[0], jnp.int32(60), jnp.int32(32))
+    lowered["tp2dp2_chunked_prefill_step"] = True
+    ok = all(lowered.values())
+    return {
+        "config": "serving_tp2d_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "lowered": lowered,
+        **({"skipped": skipped} if skipped else {}),
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def validate_serving_cluster(n: int, batch_mult: int = 1):
     """ISSUE 9 disaggregated-cluster lowering gate: AOT-export the
     KV-import scatter program — ``serving.paged_cache._pool_scatter``,
@@ -1230,6 +1361,8 @@ def _impl(args) -> int:
         emit(validate_serving(args.devices, args.batch_mult))
     if args.config in ("serving-tp", "all"):
         emit(validate_serving_tp(args.devices, args.batch_mult))
+    if args.config in ("serving-tp2d", "all"):
+        emit(validate_serving_tp2d(args.devices, args.batch_mult))
     if args.config in ("serving-cluster", "all"):
         emit(validate_serving_cluster(args.devices, args.batch_mult))
     if args.config in ("serving-host", "all"):
@@ -1254,7 +1387,8 @@ def main():
                     help="virtual chips (v5p-32 slice = 16 chips)")
     ap.add_argument("--config",
                     choices=["7b", "13b", "13b-long", "moe", "moe-pp",
-                             "serving", "serving-tp", "serving-cluster",
+                             "serving", "serving-tp", "serving-tp2d",
+                             "serving-cluster",
                              "serving-host", "serving-lowbit",
                              "serving-async", "serving-adapters",
                              "serving-wal", "all"],

@@ -66,9 +66,17 @@ def _speculator(spec_k):
 
 
 def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
-             max_steps: int = 20000, stall_faults: int = 2) -> dict:
+             max_steps: int = 20000, stall_faults: int = 2,
+             tp: int = None, dp: int = 1) -> dict:
     """One seeded soak; returns the report dict (raises
-    :class:`SoakError` on any invariant violation)."""
+    :class:`SoakError` on any invariant violation).
+
+    ``tp``/``dp`` (ISSUE 17) put the SOAKED engine on a
+    ``serving_mesh(tp, dp)`` while the per-request references stay
+    single-chip — the parity gate then doubles as the 2-D-mesh
+    identity gate under fault fire: every recovery rebuild, swap
+    round-trip and journal replay must reproduce the single-chip
+    token streams exactly."""
     import tempfile
 
     import jax
@@ -83,6 +91,13 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
 
     cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=64)
     params = llama.init_params(jax.random.key(0), cfg)
+    mesh = None
+    if tp:
+        from paddle_tpu.distributed.mesh import serving_mesh
+        if len(jax.devices()) < tp * dp:
+            raise RuntimeError(
+                f"soak tp={tp} x dp={dp} needs {tp * dp} devices")
+        mesh = serving_mesh(tp, dp)
     rs = np.random.RandomState(seed)
     spec_k = 2
     # adapter plane (ISSUE 14): three LoRA variants over a TWO-slot
@@ -99,13 +114,17 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     for aid in (1, 2, 3):
         registry.register(aid, init_lora(cfg, 4, seed=100 + aid))
 
-    def make_pool():
+    def make_pool(reference=False):
+        # the pool's B factors shard with the weights, so the soaked
+        # pool is built on the soak mesh (if any) and the reference
+        # pool stays single-chip like its engine
         return AdapterPool(cfg, slots=2, rank=4, registry=registry,
-                           store=HostPageStore(page_size=8))
+                           store=HostPageStore(page_size=8),
+                           mesh=None if reference else mesh)
 
     soak_pool = make_pool()
 
-    def factory(pool=None):
+    def factory(pool=None, reference=False):
         # host tier ON (ISSUE 10): preemptions swap out / resumes swap
         # in, so the soak's fault stream also exercises the swap_out /
         # swap_in sites under the same zero-lost/zero-duplicated gate.
@@ -117,11 +136,16 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
         # run through engine.generate(), which is synchronous
         # regardless of the knob — so the soak's parity gate is ALSO
         # the overlap-vs-sync identity gate, under fault fire.
+        # On a 2-D mesh (ISSUE 17) the soaked engine scales its batch
+        # to 3 rows PER dp shard while references stay single-chip at
+        # max_batch=3: per-shard geometry matches the reference's, so
+        # the parity check below is exactly the 2-D identity gate.
+        mb = 3 if (reference or mesh is None) else 3 * dp
         return ContinuousBatchingEngine(
-            params, cfg, max_batch=3, page_size=8, max_len=48,
+            params, cfg, max_batch=mb, page_size=8, max_len=48,
             prefill_chunk=8, spec_k=spec_k,
             speculator=_speculator(spec_k), host_tier=True,
-            overlap=True,
+            overlap=True, mesh=None if reference else mesh,
             adapters=pool if pool is not None else soak_pool)
 
     # mixed workload: long prompts (multi-chunk prefill), short ones,
@@ -156,7 +180,8 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     # uninterrupted references, one engine run per request (per-row
     # greedy decode is independent of batch composition — the PR 2-5
     # parity gates — so per-request references are exact)
-    ref_engine = factory(pool=make_pool())
+    ref_engine = factory(pool=make_pool(reference=True),
+                         reference=True)
 
     def ref_run(p, m, aid=0):
         r = ref_engine.submit(p, max_new_tokens=m, adapter_id=aid)
@@ -292,6 +317,14 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             # visited twice (first call succeeds, second eats the
             # armed shot) instead of assuming two rounds suffice
             topup_jobs = []
+            # decode-heavy fillers on a dp-widened batch (ISSUE 17):
+            # chunked prefill admits ~one filler per step (the chunk
+            # budget), so the LAST slot starts decoding ~max_batch
+            # steps after the first — the first filler must still be
+            # decoding then (even at full spec acceptance, 3
+            # tokens/step) or the all-slots-swappable window the HIGH
+            # preemption needs never opens
+            fill_new = 6 if mesh is None else 6 + 9 * dp
             drill_rounds = 0
             while inj.calls["swap_out"] < 2 and drill_rounds < 8:
                 drill_rounds += 1
@@ -311,12 +344,18 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                             and all(eng.swap_candidate(r)
                                     for r in running)):
                         break
-                    if sum(1 for r in lows if not r.done) < eng.max_batch:
+                    # top up the FULL deficit, not one per step: at
+                    # dp-widened max_batch a filler's lifetime is
+                    # fewer steps than there are slots, so
+                    # one-per-step arrivals can never have every slot
+                    # occupied at once
+                    while sum(1 for r in lows
+                              if not r.done) < eng.max_batch:
                         p = rs.randint(3, cfg.vocab_size, (6,)).astype(
                             np.int32)
-                        lows.append(submit(p, 6))
+                        lows.append(submit(p, fill_new))
                         reqs.append(lows[-1])
-                        topup_jobs.append((p, 6))
+                        topup_jobs.append((p, fill_new))
                     try:
                         sup.step()
                     except EngineDead:
@@ -427,6 +466,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     return {
         "seed": seed,
         "requests": len(reqs),
+        **({"tp": tp, "dp": dp} if mesh is not None else {}),
         "shed_rejected_overload": len(shed),
         "faults_fired": inj.fired_total,
         "faults_by_site": {s: n for s, n in inj.fired.items() if n},
@@ -1191,6 +1231,12 @@ def main() -> int:
                          "lost/duplicated + token identity")
     ap.add_argument("--kills", type=int, default=4,
                     help="crash-mode simulated process deaths")
+    ap.add_argument("--tp2d", action="store_true",
+                    help="single-engine soak on a tp=2 x dp=2 serving "
+                         "mesh (ISSUE 17); references stay "
+                         "single-chip, so the parity gate doubles as "
+                         "the 2-D-mesh identity gate under fault "
+                         "fire (needs 4 devices)")
     ap.add_argument("--traffic", action="store_true",
                     help="traffic mode (ISSUE 13): trace-driven "
                          "open-loop load against an autoscaling "
@@ -1223,11 +1269,14 @@ def main() -> int:
               "lost/duplicated requests cluster-wide, affinity "
               "recovered", file=sys.stderr)
         return 0
+    kw = dict(tp=2, dp=2) if args.tp2d else {}
     report = run_soak(seed=args.seed, faults=args.faults,
-                      requests=args.requests)
+                      requests=args.requests, **kw)
     print(json.dumps(report, indent=2))
     print("chaos_soak: OK — zero lost/duplicated requests, balanced "
-          "allocator, all sites faulted", file=sys.stderr)
+          "allocator, all sites faulted"
+          + (" (tp=2 x dp=2 mesh)" if args.tp2d else ""),
+          file=sys.stderr)
     return 0
 
 

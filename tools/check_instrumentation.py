@@ -89,6 +89,10 @@ REQUIRED = {
         ("_obs.serving_trace_span(", 5),
         ("_obs.serving_trace_finish(", 2),
         ("_obs.serving_trace_first_token(", 2),
+        # 2-D serving mesh (ISSUE 17): per-dp-shard batch gauge on
+        # both commit paths (decode AND spec verify) — the only view
+        # of planner skew across the dp row blocks
+        ("_obs.serving_dp_step(", 2),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -283,6 +287,11 @@ REQUIRED = {
         # adapter-augmented program bills (the rank-r bytes/token
         # model's live input; the serving_tp_allgather contract)
         ("_obs.serving_adapter_gather(", 1),
+        # expert-parallel MoE decode (ISSUE 17): the trace-time
+        # all-to-all dispatch counter at the EP branch of _moe_ffn —
+        # calls, per-shard payload bytes and the routed-tokens
+        # histogram (the serving_tp_allgather contract)
+        ("_obs.serving_moe_dispatch(", 1),
     ],
     "paddle_tpu/io/dataloader.py": [
         ("_obs.dataloader_next(", 2),         # single-process + prefetch

@@ -176,6 +176,20 @@ def main():
         return tps
     run_tier("decode_tp_tokens_per_sec", _tp)
 
+    # 2-D tp x dp serving mesh (ISSUE 17): the same workload with the
+    # decode batch split over a dp axis on top of tp=2 — db rows per
+    # dp shard; the vs-1-D-tp ratio rides the record (needs >= 4
+    # devices — a single-chip tunnel records the tier null, honestly)
+    def _tp2d():
+        tps = bench_mod.tp2d_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        tp1d = tiers.get("decode_tp_tokens_per_sec")
+        out["decode_tp2d_scaling"] = {
+            "tp": 2, "dp": 2,
+            "vs_1d_tp": round(tps / tp1d, 3) if tp1d else None}
+        return tps
+    run_tier("decode_tp2d_tokens_per_sec", _tp2d)
+
     # disaggregated serving cluster (ISSUE 9): two replicas behind the
     # prefix-affinity router on a shared-prefix tenant workload — the
     # cluster-vs-single-engine ratio rides the record next to the
@@ -240,6 +254,7 @@ def main():
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
         "decode_prefix_tokens_per_sec", "decode_sched_tokens_per_sec",
         "decode_spec_tokens_per_sec", "decode_tp_tokens_per_sec",
+        "decode_tp2d_tokens_per_sec",
         "decode_cluster_tokens_per_sec",
         "decode_offload_tokens_per_sec",
         "decode_slo_goodput_tokens_per_sec",

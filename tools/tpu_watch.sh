@@ -82,6 +82,7 @@ if dec.get("decode_tokens_per_sec") is not None:
               "decode_sched_tokens_per_sec",
               "decode_spec_tokens_per_sec",
               "decode_tp_tokens_per_sec",
+              "decode_tp2d_tokens_per_sec",
               "decode_cluster_tokens_per_sec",
               "decode_offload_tokens_per_sec",
               "decode_slo_goodput_tokens_per_sec",
@@ -117,7 +118,8 @@ if dec.get("decode_tokens_per_sec") is not None:
     # rate (ISSUE 5 — the number that explains the tput) and the paged
     # tier's fused-kernel speedup (ISSUE 11)
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
-                  "decode_tp_scaling", "decode_cluster_scaling",
+                  "decode_tp_scaling", "decode_tp2d_scaling",
+                  "decode_cluster_scaling",
                   "decode_offload_resume", "decode_slo_metrics",
                   "decode_fused_speedup",
                   "decode_overlap_speedup",
