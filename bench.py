@@ -158,7 +158,7 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
             decode_w8kv8_tps=None, decode_paged_tps=None,
             decode_prefix_tps=None, decode_sched=None,
             decode_spec=None, decode_tp=None, decode_tp2d=None,
-            decode_cluster=None,
+            decode_cluster=None, decode_multiproc=None,
             decode_offload=None, decode_slo=None, decode_fused=None,
             decode_multilora=None, phases=None):
     import jax
@@ -232,6 +232,12 @@ def _result(tps, mfu, seq, batch, cfg, lossv, decode_tps,
         # workload (router+handoff overhead on one host, the scaling
         # win on real multi-chip deployments) travels with the number
         rec["extra"]["decode_cluster_scaling"] = decode_cluster[1]
+    if decode_multiproc:
+        # multi-process rider (ISSUE 19): the price of running the
+        # cluster's replicas as real processes behind the socket RPC
+        # control plane — rpc wall per step, handoff wire cost and the
+        # vs-in-process ratio travel with the cluster tier
+        rec["extra"]["decode_multiproc_overhead"] = decode_multiproc
     if decode_offload:
         # the host-tier tier's point is the RESUME cost it removed:
         # swap-in latency + the ratio vs the replay-prefill baseline
@@ -1058,6 +1064,117 @@ def cluster_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
     return tps, scaling
 
 
+def multiproc_overhead_tier(on_tpu, replicas=2):
+    """The ``decode_multiproc_overhead`` rider (ISSUE 19), shared by
+    measure() and tools/decode_bench.py so the two sources stay
+    comparable.
+
+    The cluster tier's disaggregated shape (one prefill + one decode
+    replica) as a real PROCESS TREE behind the socket RPC control
+    plane, priced against the identical shape in-process. Workers
+    build their own engines from the spawn-stable tiny factory
+    (bit-identical params from the seed), and the controller-side
+    stubs are wrapped with a wall-clock accumulator, so the rider
+    measures the CONTROL PLANE and not the model: ``rpc_ms_per_step``
+    is total controller-side RPC wall per cluster step (the step
+    fan-out plus load_stats/handoff probes), ``handoff_wire_ms`` the
+    mean wall cost of moving one prefilled session across the process
+    boundary (export_prefilled + adopt_prefilled, CRC-gated KV payload
+    included), and ``vs_in_process`` the multiproc/in-process
+    throughput ratio on the same request set — the per-host price of
+    process isolation (PERF_NOTES has the frame-bytes cost model; on a
+    multi-host deployment the same frames buy kill -9 survival, which
+    one process can never offer). Workers are pinned to CPU: the tiny
+    model is host-latency-bound either way, and a TPU-owning bench
+    process must not share the chip lock with its children."""
+    import numpy as np
+    import shutil
+    import tempfile
+    from paddle_tpu.serving.cluster import ServingCluster
+    from paddle_tpu.serving.multiproc import MultiProcessCluster
+    from paddle_tpu.serving.node import tiny_llama_engine
+
+    rngp = np.random.RandomState(11)
+    sys_prompt = rngp.randint(3, 256, (12,)).astype(np.int32)
+
+    def make_jobs():
+        # shared system prefix + unique tails, regenerated per pass —
+        # same discipline as the in-process cluster tier above
+        jobs = []
+        for _ in range(3 * replicas):
+            tail = rngp.randint(3, 256,
+                                (int(rngp.randint(2, 7)),)).astype(
+                                    np.int32)
+            jobs.append((np.concatenate([sys_prompt, tail]),
+                         int(rngp.randint(3, 6))))
+        return jobs
+
+    def run_pass(cluster):
+        handles = [cluster.submit(p, max_new_tokens=m)
+                   for p, m in make_jobs()]
+        steps = 0
+        while cluster.step():
+            steps += 1
+        return sum(len(h.tokens) for h in handles), steps
+
+    inproc = ServingCluster(tiny_llama_engine(), replicas=replicas,
+                            prefill_replicas=1,
+                            supervisor_kw=dict(sleep=lambda s: None,
+                                               backoff_s=0.0))
+    run_pass(inproc)                                # compile/warm pass
+    t0 = time.perf_counter()
+    toks, _ = run_pass(inproc)
+    in_tps = toks / (time.perf_counter() - t0)
+
+    acc = {"rpc_ns": 0, "handoff_ns": 0, "exports": 0}
+
+    def _instrument(node):
+        orig = node.call
+
+        def timed(method, data=None, blobs=None, **kw):
+            t0 = time.perf_counter_ns()
+            try:
+                return orig(method, data, blobs, **kw)
+            finally:
+                dt = time.perf_counter_ns() - t0
+                acc["rpc_ns"] += dt
+                if method in ("export_prefilled", "adopt_prefilled"):
+                    acc["handoff_ns"] += dt
+                    if method == "export_prefilled":
+                        acc["exports"] += 1
+        node.call = timed
+
+    wd = tempfile.mkdtemp(prefix="ptpu_mpbench_")
+    mc = MultiProcessCluster(
+        replicas=replicas, prefill_replicas=1, workdir=wd,
+        xla_cache_dir=_XLA_CACHE_DIR,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    try:
+        for node in mc.nodes:
+            _instrument(node)
+        run_pass(mc)                                # workers compile
+        base = dict(acc)
+        t0 = time.perf_counter()
+        toks, steps = run_pass(mc)
+        dt = time.perf_counter() - t0
+        mp_tps = toks / dt
+        rpc_ns = acc["rpc_ns"] - base["rpc_ns"]
+        handoff_ns = acc["handoff_ns"] - base["handoff_ns"]
+        exports = acc["exports"] - base["exports"]
+    finally:
+        mc.close()
+        shutil.rmtree(wd, ignore_errors=True)
+    return {
+        "replicas": replicas,
+        "tokens_per_sec": round(mp_tps, 2),
+        "rpc_ms_per_step": (round(rpc_ns / steps / 1e6, 3)
+                            if steps else None),
+        "handoff_wire_ms": (round(handoff_ns / exports / 1e6, 3)
+                            if exports else None),
+        "vs_in_process": round(mp_tps / in_tps, 3) if in_tps else None,
+    }
+
+
 def offload_decode_tier(params, cfg, db, dp_len, dnew, on_tpu,
                         kv_cache_dtype=None):
     """The decode_offload_tokens_per_sec measurement, shared by
@@ -1262,6 +1379,8 @@ _DECODE_RIDERS = (("decode_sched_tokens_per_sec", "decode_sched_step_ms"),
                   ("decode_tp2d_tokens_per_sec", "decode_tp2d_scaling"),
                   ("decode_cluster_tokens_per_sec",
                    "decode_cluster_scaling"),
+                  ("decode_cluster_tokens_per_sec",
+                   "decode_multiproc_overhead"),
                   ("decode_offload_tokens_per_sec",
                    "decode_offload_resume"),
                   ("decode_slo_goodput_tokens_per_sec",
@@ -1615,6 +1734,18 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
             print(f"cluster decode bench failed: {type(e).__name__}: "
                   f"{e}"[:500], file=sys.stderr)
 
+    # multi-process overhead rider (ISSUE 19): the same disaggregated
+    # shape as a process tree behind the socket RPC control plane —
+    # rpc wall per step, handoff wire cost and the vs-in-process ratio
+    # ride the cluster tier's record
+    decode_multiproc = None
+    if decode_cluster is not None and (not on_tpu or remaining() > 120):
+        try:
+            decode_multiproc = multiproc_overhead_tier(on_tpu)
+        except Exception as e:
+            print(f"multiproc overhead rider failed: "
+                  f"{type(e).__name__}: {e}"[:500], file=sys.stderr)
+
     # hierarchical KV host tier (ISSUE 10): the scheduler tier's bursty
     # preempt workload with swap-out/swap-in instead of evict/replay —
     # swap-in latency + the vs-replay ratio ride the record
@@ -1661,6 +1792,7 @@ def measure(batch_override: Optional[int] = None, on_headline=None,
                    decode_sched=decode_sched, decode_spec=decode_spec,
                    decode_tp=decode_tp, decode_tp2d=decode_tp2d,
                    decode_cluster=decode_cluster,
+                   decode_multiproc=decode_multiproc,
                    decode_offload=decode_offload, decode_slo=decode_slo,
                    decode_fused=decode_fused,
                    decode_multilora=decode_multilora, phases=phases)

@@ -69,6 +69,21 @@ SLO-aware scheduler.
   checkpoint-plus-suffix replay) — the machinery behind
   :meth:`EngineSupervisor.recover_from_disk` /
   :meth:`ServingCluster.recover_from_disk` cold-restart recovery.
+- :mod:`paddle_tpu.serving.rpc` / :mod:`paddle_tpu.serving.node` /
+  :mod:`paddle_tpu.serving.fabric` /
+  :mod:`paddle_tpu.serving.multiproc` — the multi-PROCESS serving
+  cluster (ISSUE 19): a minimal length-prefixed CRC-framed socket RPC
+  layer (:class:`RpcClient` / :class:`RpcServer` — torn/corrupt frames
+  detected, bounded idempotent retry, typed remote exceptions),
+  :class:`~paddle_tpu.serving.node.ReplicaNode` worker processes (one
+  supervisor + scheduler each, per-replica WAL dir as durable process
+  identity), the shared content-addressed KV fabric
+  (:class:`FabricServer` / :class:`FabricClient` — the PR 10 standing
+  prefix store as a cluster-wide service, CRC-verified promotes,
+  quarantine-on-corrupt) and :class:`MultiProcessCluster` — the
+  in-process cluster control plane re-hosted over RPC stubs,
+  token-identical to :class:`ServingCluster` on the same trace,
+  ``kill -9`` of a replica process handled as WAL-recovering failover.
 - the paged attention op lives in
   :mod:`paddle_tpu.ops.pallas.paged_attention` (Pallas kernel + pure-lax
   fallback) and the continuous-batching engine in
@@ -108,4 +123,13 @@ from .router import (  # noqa: F401
 from .cluster import ClusterAutoscaler, ServingCluster  # noqa: F401
 from .traffic import (  # noqa: F401
     FakeClock, SLOReport, TraceRequest, run_trace, synth_trace,
+)
+from .rpc import (  # noqa: F401
+    ReplicaUnreachable, RpcClient, RpcClosed, RpcCorruptFrame,
+    RpcError, RpcRemoteError, RpcServer, RpcTimeout, RpcTornFrame,
+)
+from .fabric import FabricClient, FabricServer  # noqa: F401
+from .node import ReplicaNode, tiny_llama_engine  # noqa: F401
+from .multiproc import (  # noqa: F401
+    FabricProcess, MultiProcessCluster, ReplicaProcess,
 )

@@ -48,6 +48,7 @@ class FinishReason(str, enum.Enum):
     REJECTED_OVERLOAD = "rejected_overload"   # shed by a degraded supervisor
     REJECTED_RATELIMIT = "rejected_ratelimit" # over the tenant's token quota
     REJECTED_INFEASIBLE = "rejected_infeasible" # deadline unmeetable at the door
+    REPLICA_UNREACHABLE = "replica_unreachable" # transport-level loss (ISSUE 19)
 
     def __str__(self) -> str:  # pragma: no cover - cosmetic
         return self.value

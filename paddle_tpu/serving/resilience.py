@@ -111,7 +111,15 @@ ENGINE_SITES = ("alloc", "free", "decode_step", "prefill_chunk",
 #: execute inside a :class:`~paddle_tpu.serving.cluster.ServingCluster`
 #: — the single-engine chaos soak covers :data:`ENGINE_SITES`, the
 #: traffic soak (tools/chaos_soak.py --traffic) covers these
-CLUSTER_SITES = ("handoff_export", "handoff_import", "autoscale_tick")
+CLUSTER_SITES = ("handoff_export", "handoff_import", "autoscale_tick",
+                 # multi-process plane, ISSUE 19 — all four fire BEFORE
+                 # any commit: rpc_send before a frame hits the socket,
+                 # rpc_recv before a reply is decoded, fabric_put before
+                 # a payload ships to the fabric server, fabric_get
+                 # before a fetched payload is verified or installed.
+                 # NB keep this comment paren-free: check_fault_sites
+                 # parses the tuple with a non-greedy paren match
+                 "rpc_send", "rpc_recv", "fabric_put", "fabric_get")
 
 SITES = ENGINE_SITES + CLUSTER_SITES
 

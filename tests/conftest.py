@@ -89,9 +89,11 @@ def pytest_collection_modifyitems(config, items):
             return 3
         if "test_tracing" in path:
             return 4
-        if "test_tp2d" in path:         # ISSUE 17: newest, dead last
+        if "test_tp2d" in path:
             return 5
-        return None
+        if "test_multiproc" in path:    # ISSUE 19: newest, dead last
+            return 6                    # (also the only spawner of
+        return None                     # worker process trees)
     tail = sorted((it for it in rest if _tail_rank(it) is not None),
                   key=_tail_rank)
     if tail and tail != rest:

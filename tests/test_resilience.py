@@ -217,6 +217,12 @@ class TestRecoveryParity:
                 "reference a LoRA variant — recovery-parity gates live "
                 "in tests/test_adapters.py::TestAdapterLifecycle (and "
                 "the chaos soak fires them with adapter traffic)")
+        if site in ("rpc_send", "rpc_recv", "fabric_put", "fabric_get"):
+            pytest.skip(
+                "multi-process sites (ISSUE 19) only execute on the "
+                "RPC transport / fabric client — gated in "
+                "tests/test_multiproc.py and fired by the multiproc "
+                "soak (tools/chaos_soak.py --multiproc)")
         if site in ("wal_append", "wal_fsync", "checkpoint_write"):
             pytest.skip(
                 "durable-journal sites (ISSUE 15) only execute on a "

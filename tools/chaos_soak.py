@@ -1211,6 +1211,154 @@ def run_crash_soak(seed: int = 0, kills: int = 4,
             "wal_stats": sup.wal.stats()}
 
 
+def run_multiproc_soak(seed: int = 0, requests: int = 6,
+                       max_steps: int = 600, workdir=None,
+                       xla_cache_dir=None) -> dict:
+    """Multi-process soak (ISSUE 19): a REAL process tree — one
+    prefill worker, one decode worker, one shared KV fabric server —
+    driven by :class:`~paddle_tpu.serving.MultiProcessCluster` with
+    chaos armed at the controller's wire seams:
+
+    - a TAMPER shot on ``handoff_export`` flips real payload bytes in
+      a cross-process KV handoff — the decode-side CRC verifier must
+      refuse the install (nothing committed) and the request must
+      finish on its prefill replica token-identically;
+    - armed ``rpc_send`` / ``rpc_recv`` transport faults drop frames
+      mid-call — the bounded idempotent retry plus the server-side
+      dedupe cache must absorb them with zero duplicate execution;
+    - the decode worker is ``SIGKILL``ed once it owns decoded tokens —
+      failover spawns a replacement on the same WAL dir and the
+      recovered sessions resume mid-stream.
+
+    Invariants: zero lost / duplicated requests (every token stream
+    EXACTLY equals its uninterrupted in-process single-engine
+    reference), the corruption was detected (never installed), every
+    armed transport fault actually fired, the fabric served demotes,
+    and both surviving workers drain to balanced allocators
+    (``num_used == 0`` once the standing prefix pages are dropped).
+    Wired into tier-1 via tests/test_multiproc.py (conftest-ordered
+    dead last; spawn count budgeted for the 870s watchdog).
+    """
+    import signal
+    import tempfile
+
+    from paddle_tpu.serving import FaultInjector
+    from paddle_tpu.serving.multiproc import (FabricProcess,
+                                              MultiProcessCluster)
+    from paddle_tpu.serving.node import tiny_llama_engine
+
+    rs = np.random.RandomState(seed)
+    sys_prompt = rs.randint(3, 256, (12,)).astype(np.int32)
+    jobs = []
+    for _ in range(requests):
+        tail = rs.randint(3, 256,
+                          (int(rs.randint(2, 7)),)).astype(np.int32)
+        jobs.append((np.concatenate([sys_prompt, tail]),
+                     int(rs.randint(3, 6))))
+    # uninterrupted single-engine references: the factory builds
+    # bit-identical weights from the seed in every process, and
+    # per-request greedy decode is batch-composition-independent, so
+    # routing cannot change any stream
+    ref_engine = tiny_llama_engine()()
+    refs = [np.asarray(ref_engine.generate([p], max_new_tokens=m)[0])
+            for p, m in jobs]
+
+    wd = workdir or tempfile.mkdtemp(prefix="mp_soak_")
+    if xla_cache_dir is None:
+        xla_cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "artifacts", "xla_cache")
+    t_start = time.perf_counter()
+    fp = None
+    mc = None
+    inj = FaultInjector(seed=seed)
+    try:
+        fp = FabricProcess(wd, page_size=8)
+        mc = MultiProcessCluster(
+            replicas=2, prefill_replicas=1,
+            workdir=os.path.join(wd, "cluster"), fabric=fp.endpoint,
+            xla_cache_dir=xla_cache_dir)
+        reqs = [mc.submit(p, max_new_tokens=m) for p, m in jobs]
+        with inj:
+            # first handoff export ships corrupt bytes; a mid-run send
+            # and recv each drop a frame (site counts are RPC calls,
+            # so double-digit nth lands a few steps in)
+            inj.arm_tamper("handoff_export", nth=1)
+            inj.arm("rpc_send", "raise", nth=7)
+            inj.arm("rpc_recv", "raise", nth=19)
+            killed = False
+            steps = 0
+            while mc.step():
+                steps += 1
+                if not killed and any(
+                        len(r.tokens) >= 2
+                        and mc._owner.get(r.rid) == 1
+                        for r in reqs if not r.done):
+                    os.kill(mc.nodes[1].proc.pid, signal.SIGKILL)
+                    killed = True
+                if steps >= max_steps:
+                    raise SoakError(f"multiproc soak did not drain "
+                                    f"within {max_steps} steps")
+
+        # ---- invariants ----
+        if not killed:
+            raise SoakError("the decode worker never owned tokens — "
+                            "the SIGKILL gate was not exercised")
+        if mc.failovers_total < 1:
+            raise SoakError("SIGKILL did not surface as a failover")
+        if mc.handoff_corruptions_total < 1:
+            raise SoakError("the tampered handoff payload was not "
+                            "detected by the decode-side CRC gate")
+        for site in ("rpc_send", "rpc_recv"):
+            if not inj.fired.get(site):
+                raise SoakError(f"armed {site} fault never fired — "
+                                f"the transport retry path was not "
+                                f"exercised")
+        lost = [r.rid for r in reqs
+                if not r.done or r.finish_reason not in ("eos",
+                                                         "max_len")]
+        if lost:
+            raise SoakError(f"lost requests after drain: {lost}")
+        mism = [r.rid for r, ref in zip(reqs, refs)
+                if not np.array_equal(np.asarray(r.output), ref)]
+        if mism:
+            raise SoakError(
+                f"duplicated/diverged token streams: {mism}")
+        unbalanced = {}
+        for i in range(len(mc.nodes)):
+            st, _ = mc.nodes[i].call("tier_stats",
+                                     {"drop_prefix": True})
+            alloc = st["allocator"]
+            if alloc["num_used"] != 0 or \
+                    alloc["allocs_total"] != alloc["frees_total"]:
+                unbalanced[i] = alloc
+        if unbalanced:
+            raise SoakError(f"allocator unbalanced after drain: "
+                            f"{unbalanced}")
+        fc = fp.client()
+        fab_stats, _ = fc.call("stats")
+        fc.close()
+        if fab_stats["puts_total"] < 1:
+            raise SoakError("the fabric never saw a demote — the "
+                            "shared tier was not exercised")
+        return {"seed": seed, "mode": "multiproc",
+                "requests": len(reqs), "steps": steps,
+                "failovers": mc.failovers_total,
+                "handoffs": mc.handoffs_total,
+                "handoff_corruptions": mc.handoff_corruptions_total,
+                "faults_by_site": {s: n for s, n in inj.fired.items()
+                                   if n},
+                "fabric": {k: fab_stats[k]
+                           for k in ("puts_total", "hits_total",
+                                     "misses_total", "entries")},
+                "elapsed_s": round(time.perf_counter() - t_start, 1)}
+    finally:
+        if mc is not None:
+            mc.close()
+        if fp is not None:
+            fp.close()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -1237,6 +1385,13 @@ def main() -> int:
                          "single-chip, so the parity gate doubles as "
                          "the 2-D-mesh identity gate under fault "
                          "fire (needs 4 devices)")
+    ap.add_argument("--multiproc", action="store_true",
+                    help="multi-process mode (ISSUE 19): a real "
+                         "2-replica + fabric process tree; SIGKILL "
+                         "the decode worker mid-soak, tamper a wire "
+                         "handoff, drop RPC frames; asserts zero "
+                         "lost/duplicated requests, every corruption "
+                         "detected, balanced allocators")
     ap.add_argument("--traffic", action="store_true",
                     help="traffic mode (ISSUE 13): trace-driven "
                          "open-loop load against an autoscaling "
@@ -1245,6 +1400,16 @@ def main() -> int:
                          "requests and that the replica count both "
                          "grew and shrank")
     args = ap.parse_args()
+    if args.multiproc:
+        report = run_multiproc_soak(seed=args.seed,
+                                    requests=args.requests)
+        print(json.dumps(report, indent=2))
+        print("chaos_soak: OK — decode worker SIGKILLed and replaced "
+              "from its WAL dir, corrupt wire handoff detected, "
+              "dropped RPC frames absorbed by bounded retry, zero "
+              "lost/duplicated requests, balanced allocators",
+              file=sys.stderr)
+        return 0
     if args.crash:
         report = run_crash_soak(seed=args.seed, kills=args.kills)
         print(json.dumps(report, indent=2))

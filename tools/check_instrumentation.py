@@ -254,6 +254,47 @@ REQUIRED = {
         ("_obs.serving_trace_span(", 2),
         ("_obs.serving_trace_finish(", 3),
     ],
+    "paddle_tpu/serving/rpc.py": [
+        # multi-process control plane (ISSUE 19): the per-call
+        # latency/bytes pair on the client side + the served-side
+        # decode/dispatch/encode latency, the bounded-retry counter,
+        # the timeout counter and the corrupt-frame counter (client
+        # CRC/torn detection AND the server's two inbound-frame
+        # rejections) — the serving_rpc_* family the
+        # decode_multiproc_overhead bench rider reads
+        ("_obs.serving_rpc_call(", 1),
+        ("_obs.serving_rpc_served(", 1),
+        ("_obs.serving_rpc_retry(", 1),
+        ("_obs.serving_rpc_timeout(", 1),
+        ("_obs.serving_rpc_corrupt(", 4),
+        # fault-injection sites: immediately BEFORE the frame send and
+        # immediately AFTER the reply recv — both inside the bounded
+        # retry loop, so an injected drop exercises the idempotent
+        # retry + server dedupe path end to end
+        ('fault_point("rpc_send")', 1),
+        ('fault_point("rpc_recv")', 1),
+    ],
+    "paddle_tpu/serving/fabric.py": [
+        # shared KV fabric (ISSUE 19): demote (put) latency/bytes,
+        # promote (get) latency/bytes split by hit/miss, and the
+        # quarantine counter on all three corruption seams — the
+        # server's inbound CRC gate, the client's post-fetch verify
+        # and the explicit peer-initiated quarantine RPC
+        ("_obs.serving_fabric_demote(", 1),
+        ("_obs.serving_fabric_promote(", 4),
+        ("_obs.serving_fabric_quarantine(", 3),
+        # fault sites: put BEFORE the demote RPC, get BEFORE the
+        # promote RPC — neither commits anything when it fires
+        ('fault_point("fabric_put")', 1),
+        ('fault_point("fabric_get")', 1),
+    ],
+    "paddle_tpu/serving/node.py": [
+        # replica worker (ISSUE 19): trace lanes must re-open node-side
+        # on BOTH ingress edges (fresh dispatch submit and the decode
+        # half of a cross-process handoff adopt) or the stitched trace
+        # the controller folds together loses every worker-side span
+        ("_obs.serving_trace_submit(", 2),
+    ],
     "paddle_tpu/serving/router.py": [
         # cluster router (ISSUE 9): per-dispatch replica + affinity
         # hit/miss counters (the live prefix-affinity hit rate), the
@@ -326,6 +367,8 @@ _FAULT_SITE_MODULES = (
     "paddle_tpu/serving/cluster.py",
     "paddle_tpu/serving/adapters.py",
     "paddle_tpu/serving/wal.py",
+    "paddle_tpu/serving/rpc.py",
+    "paddle_tpu/serving/fabric.py",
     "paddle_tpu/inference/predictor.py",
 )
 
@@ -390,6 +433,11 @@ _SYNC_FREE = {
     # zero-device-syncs contract is what lets call sites fire between
     # dispatch and commit
     "paddle_tpu/observability/tracing.py": None,
+    # the RPC layer (ISSUE 19) frames host bytes only — it must never
+    # import jax or fetch a device value; KV payloads reach it already
+    # exported as host numpy views, and keeping it device-blind is
+    # what lets the fabric server run as a jax-free process
+    "paddle_tpu/serving/rpc.py": None,
 }
 
 #: device-sync idioms: a bare one-argument np.asarray (dtype-annotated

@@ -198,6 +198,15 @@ def main():
         tps, scaling = bench_mod.cluster_decode_tier(
             params, cfg, db, dp_len, dnew, on_tpu)
         out["decode_cluster_scaling"] = scaling
+        # multi-process overhead rider (ISSUE 19): the same shape as a
+        # process tree behind the socket RPC control plane — best
+        # effort, the in-process cluster number stands either way
+        try:
+            out["decode_multiproc_overhead"] = (
+                bench_mod.multiproc_overhead_tier(on_tpu))
+        except Exception as e:
+            print(f"multiproc overhead rider failed: "
+                  f"{type(e).__name__}: {e}"[:300], file=sys.stderr)
         return tps
     run_tier("decode_cluster_tokens_per_sec", _cluster)
 

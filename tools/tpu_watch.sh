@@ -120,6 +120,7 @@ if dec.get("decode_tokens_per_sec") is not None:
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
                   "decode_tp_scaling", "decode_tp2d_scaling",
                   "decode_cluster_scaling",
+                  "decode_multiproc_overhead",
                   "decode_offload_resume", "decode_slo_metrics",
                   "decode_fused_speedup",
                   "decode_overlap_speedup",
