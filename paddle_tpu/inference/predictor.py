@@ -6,7 +6,7 @@ from __future__ import annotations
 import enum
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
@@ -351,11 +351,12 @@ class InFlightStep:
     victim re-decodes the dropped token on resume, greedy-identically,
     so no stream ever forks)."""
     __slots__ = ("kind", "mask", "rids", "seats", "out", "drafts",
-                 "dlen", "t0", "t0f", "raw", "ttr")
+                 "dlen", "t0", "t0f", "raw", "ttr", "qs", "rows")
 
     def __init__(self, kind, mask, rids, seats, out, drafts=None,
-                 dlen=None, t0=0, t0f=0, raw=None, ttr=0):
-        self.kind = kind                # "decode" | "spec"
+                 dlen=None, t0=0, t0f=0, raw=None, ttr=0, qs=None,
+                 rows=None):
+        self.kind = kind                # "decode" | "spec" | "tree"
         self.mask = mask
         self.rids = rids                # per-slot rid snapshot at dispatch
         self.seats = seats              # per-slot seating generation
@@ -368,6 +369,13 @@ class InFlightStep:
         #                                 the engine masks sampling — the
         #                                 violation-avoided counter input
         self.ttr = ttr                  # trace-clock anchor (ISSUE 16)
+        self.qs = qs                    # slot -> (j, V) draft-model q
+        #                                 distributions (ISSUE 20): the
+        #                                 real proposal law the rejection
+        #                                 sampler's min(1, p/q) needs
+        self.rows = rows                # tree verify's un-placed per-node
+        #                                 KV (ISSUE 20) — scattered by
+        #                                 paged_tree_commit at commit
 
 
 class GenerationRequest:
@@ -518,7 +526,10 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  enable_prefix_cache: bool = True,
                  spec_k: int = 0, spec_ngram: int = 3,
-                 speculator=None, mesh=None,
+                 speculator=None, draft_layers: Optional[int] = None,
+                 draft_pages: Optional[int] = None,
+                 spec_tree: Optional[Tuple[int, int]] = None,
+                 mesh=None,
                  host_tier: bool = False,
                  host_tier_kw: Optional[Dict] = None,
                  weight_bits: Optional[int] = None,
@@ -723,6 +734,52 @@ class ContinuousBatchingEngine:
         # and the corrected residual keeps the output distribution
         # exactly the plain sampled-decode law), which is what gives
         # temperature>0 traffic the 1+k speedup.
+        # --- model-based draft + tree speculation (ISSUE 20):
+        # draft_layers builds a truncated-layer shared-embedding DRAFT
+        # model (models/generate.make_draft_params) that proposes
+        # spec_k tokens autoregressively on device, with its own KV in
+        # a SECOND small paged pool under the same BlockAllocator
+        # machinery; verification rides the existing verify forward,
+        # and the rejection sampler is fed the draft's REAL q
+        # distribution instead of a point mass. spec_tree=(width,
+        # depth) additionally fans each draft step's top-``width``
+        # candidates into a token TREE verified in ONE forward (the
+        # tree-attention ancestor mask folds into the chunk kernel's
+        # ragged masking); the longest accepted root path commits.
+        # Draft pool state is DISPOSABLE: it is never journaled, never
+        # swapped — preemption/recovery rebuild it cold through the
+        # catch-up forward, token-identically.
+        if spec_tree is not None:
+            w, d = int(spec_tree[0]), int(spec_tree[1])
+            if draft_layers is None:
+                raise ValueError(
+                    "spec_tree requires draft_layers: the tree's "
+                    "candidates come from the draft model's per-step "
+                    "top-width distributions")
+            if w < 1 or d < 1:
+                raise ValueError(
+                    f"spec_tree=(width, depth) must both be >= 1, got "
+                    f"{spec_tree}")
+            if spec_k and int(spec_k) != d:
+                raise ValueError(
+                    f"spec_tree depth {d} conflicts with spec_k="
+                    f"{spec_k}: the tree's chain IS the linear draft "
+                    f"(leave spec_k at 0 or pass spec_k={d})")
+            spec_k = d
+            if 1 + w * d > 32:
+                raise ValueError(
+                    f"spec_tree=({w}, {d}) needs {1 + w * d} tree "
+                    f"nodes; the fused kernel's per-query ancestor "
+                    f"bitmask holds at most 32")
+            self.spec_tree = (w, d)
+            self._tree_T = 1 + w * d
+        else:
+            self.spec_tree = None
+            self._tree_T = None
+        if draft_layers is not None and int(spec_k) < 1:
+            raise ValueError(
+                "draft_layers requires spec_k >= 1: the draft model "
+                "proposes spec_k tokens per step")
         self.spec_k = int(spec_k)
         if self.spec_k:
             if self.constraints:
@@ -747,6 +804,41 @@ class ContinuousBatchingEngine:
         self._accept_rng = np.random.default_rng(
             int(np.asarray(jax.random.key_data(self._key)).sum()
                 & 0x7FFFFFFF))
+        self.draft_layers = (int(draft_layers)
+                             if draft_layers is not None else None)
+        self.draft_params = self.draft_cfg = self.draft_cache = None
+        if self.draft_layers is not None:
+            from ..models.generate import make_draft_params
+            # truncation slices the (possibly quantized, possibly
+            # sharded) SERVING params — the draft inherits the target's
+            # weight tier and tp partitioning by construction, and the
+            # param-spec pytree structure is unchanged (only the stacked
+            # layer axis shrank), so _tp_map reuses self._param_specs
+            self.draft_params, self.draft_cfg = make_draft_params(
+                self.params, cfg, self.draft_layers)
+            # + spec_k + 1 headroom past the main pool's max_len: the
+            # draft loop's speculative feeds write up to spec_k
+            # positions BEYOND the committed context, so a row drafted
+            # at the tail of a full-length request still has pages
+            self.draft_cache = PagedKVCache(
+                self.draft_cfg, max_batch,
+                (max_len or cfg.max_seq_len) + self.spec_k + 1,
+                page_size=page_size, num_pages=draft_pages,
+                kv_dtype=kv_cache_dtype, enable_prefix_cache=False,
+                mesh=mesh)
+        # per-slot draft bookkeeping: _draft_base[slot] is the main
+        # context length at the last propose (the draft pool's valid
+        # prefix is base + the accepted tokens that MATCH the fed
+        # chain); _draft_chain holds the chain tokens actually fed
+        # through the draft model, _draft_q the stashed per-position q
+        # distributions awaiting the next linear dispatch
+        self._draft_base = np.zeros((max_batch,), np.int64)
+        self._draft_chain: Dict[int, np.ndarray] = {}
+        self._draft_q: Dict[int, np.ndarray] = {}
+        self._draft_fns: Dict[tuple, object] = {}
+        self._draft_dec_fn = None
+        self._tree_fns: Dict[tuple, object] = {}
+        self._tree_commit_fns: Dict[int, object] = {}
 
     # ---- request intake ----
     def create_request(self, prompt, max_new_tokens: int = 16,
@@ -845,34 +937,58 @@ class ContinuousBatchingEngine:
         return req
 
     # ---- jitted programs (one decode; one prefill per page bucket) ----
-    def _tp_map(self, fn, arg_kinds):
+    def _rows_specs(self):
+        """PartitionSpecs for the tree verify's un-placed per-node KV
+        rows (ISSUE 20): ``rows[name]`` is (L, B, T, nkv[, hd]) — the
+        kv-head axis shards over tp exactly like the pool's (same axis
+        index 3), and the BATCH axis rides the dp split (the rows come
+        out of the per-shard dense temp cache, one row block per dp
+        shard; paged_tree_commit all-gathers them before the
+        scatter)."""
+        from jax.sharding import PartitionSpec as P
+        ax, dpx = self._tp_axis, self._dp_axis
+        return {name: (P(None, dpx, None, ax, None) if a.ndim == 5
+                       else P(None, dpx, None, ax))
+                for name, a in self.cache.pool.items()}
+
+    def _tp_map(self, fn, arg_kinds, out_kinds=("rep", "pool"),
+                cache=None):
         """Lower a per-shard serving forward through shard_map on the
         engine's serving mesh. ``arg_kinds``: one of ``"params"`` (the
         regex-rule spec pytree), ``"pool"`` (page pools, head axis
         sharded over tp, replicated across dp), ``"rep"`` (replicated
-        host-side small args) or ``"batch"`` (per-row batch args —
+        host-side small args), ``"batch"`` (per-row batch args —
         last tokens, block tables, lengths, the active mask, adapter
         slots — split over the dp axis on a 2-D mesh, replicated on a
-        1-D one) per positional argument. Outputs are always
-        ``(logits, pool)`` — logits are replicated (the per-shard body
-        already all-gathered them over tp AND dp; ``check_rep=False``
-        skips the symbolic replication proof, same as the
-        training-side ring-attention shard_map)."""
+        1-D one) or ``"rows"`` (tree-verify per-node KV,
+        :meth:`_rows_specs`) per positional argument. ``out_kinds``
+        names the output positions the same way (default ``(logits,
+        pool)``; a single kind maps the output pytree directly) —
+        logits are replicated (the per-shard body already all-gathered
+        them over tp AND dp; ``check_rep=False`` skips the symbolic
+        replication proof, same as the training-side ring-attention
+        shard_map). ``cache`` picks whose pool specs "pool" means —
+        the DRAFT pool's programs (ISSUE 20) pass their own cache."""
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
+        pool_specs = (cache if cache is not None else self.cache
+                      ).pool_specs
         kinds = {"params": self._param_specs,
-                 "pool": self.cache.pool_specs, "rep": P(),
+                 "pool": pool_specs, "rep": P(),
                  "batch": (P(self._dp_axis)
-                           if self._dp_axis is not None else P())}
+                           if self._dp_axis is not None else P()),
+                 "rows": self._rows_specs()}
         if self.adapters is not None:
             # adapter-pool factor dict: B factors column-sharded on the
             # same output axis as the base weights, A + scales
             # replicated (llama.adapter_partition_specs)
             kinds["adapters"] = self.adapters.specs
+        out_specs = (kinds[out_kinds[0]] if len(out_kinds) == 1
+                     else tuple(kinds[k] for k in out_kinds))
         return shard_map(
             fn, mesh=self.mesh,
             in_specs=tuple(kinds[k] for k in arg_kinds),
-            out_specs=(P(), self.cache.pool_specs), check_rep=False)
+            out_specs=out_specs, check_rep=False)
 
     def _decode(self):
         if self._decode_fn is None:
@@ -1037,6 +1153,129 @@ class ContinuousBatchingEngine:
             self._spec_fns[key] = jax.jit(f, donate_argnums=(2,))
         return self._spec_fns[key]
 
+    # ---- draft-model + tree speculation programs (ISSUE 20) ----
+    def _draft_catchup_fn(self, ctx_cap: int, T: int):
+        """One compiled draft-pool CATCH-UP program per static
+        ``(context cap, width)`` pair: the verify forward over the
+        DRAFT model writing a ``T``-token chunk of already-committed
+        context into the draft pool (logits discarded — only the KV
+        matters). Cold draft pools (first propose after prefill,
+        post-preemption resume, crash recovery) replay through this,
+        which is what makes the rebuilt pool token-identical."""
+        key = (ctx_cap, T)
+        if key not in self._draft_fns:
+            from ..models import generate as gen
+            cfg, uk, ax = self.draft_cfg, self.use_kernel, self._tp_axis
+            fz, dpx = self.fused, self._dp_axis
+
+            def f(params, chunk, paged, tables, lengths, active):
+                _, paged = gen.paged_verify_forward(
+                    params, chunk, paged, tables, lengths, cfg,
+                    ctx_cap=ctx_cap, active=active, use_kernel=uk,
+                    tp_axis=ax, dp_axis=dpx, fused=fz)
+                return paged
+            if self.mesh is not None:
+                f = self._tp_map(f, ("params", "batch", "pool",
+                                     "batch", "batch", "batch"),
+                                 out_kinds=("pool",),
+                                 cache=self.draft_cache)
+            self._draft_fns[key] = jax.jit(f, donate_argnums=(2,))
+        return self._draft_fns[key]
+
+    def _draft_decode(self):
+        """The draft model's one-token decode program: same ragged
+        paged decode as :meth:`_decode` but over the draft params/pool
+        and returning the full (B, V) f32 LOGITS — the proposer needs
+        the real distribution q on the host (chain token + tree
+        candidates + the rejection sampler's min(1, p/q))."""
+        if self._draft_dec_fn is None:
+            from ..models import generate as gen
+            cfg, uk, ax = self.draft_cfg, self.use_kernel, self._tp_axis
+            fz, dpx = self.fused, self._dp_axis
+
+            def f(params, last, paged, tables, lengths, active):
+                logits, paged = gen.paged_decode_forward(
+                    params, last, paged, tables, lengths, cfg,
+                    active=active, use_kernel=uk, tp_axis=ax,
+                    dp_axis=dpx, fused=fz)
+                return logits.astype(jnp.float32), paged
+            if self.mesh is not None:
+                f = self._tp_map(f, ("params", "batch", "pool",
+                                     "batch", "batch", "batch"),
+                                 cache=self.draft_cache)
+            self._draft_dec_fn = jax.jit(f, donate_argnums=(2,))
+        return self._draft_dec_fn
+
+    def _tree_fn(self, ctx_cap: int, T: int):
+        """One compiled TREE-VERIFY program per static ``(context cap,
+        node count)`` pair: the verify forward in tree mode — rope
+        positions ``lengths + depth``, the ancestor mask folded into
+        the chunk attention — returning the greedy per-node argmax
+        (temp 0) or the full per-node logits (sampled), PLUS the
+        un-placed per-node KV rows (no scatter: placement waits for
+        the host's accepted root path, :meth:`_tree_commit_fn`). The
+        main pool passes through untouched, so it is NOT donated."""
+        key = (ctx_cap, T)
+        if key not in self._tree_fns:
+            from ..models import generate as gen
+            cfg, uk, ax = self.cfg, self.use_kernel, self._tp_axis
+            fz, dpx = self.fused, self._dp_axis
+            ad_on, temp = self.adapters is not None, self.temperature
+
+            def fwd(params, chunk, paged, tables, lengths, active,
+                    depths, anc, *extra):
+                kw = {}
+                if ad_on:
+                    kw = {"adapters": extra[0],
+                          "adapter_slots": extra[1]}
+                return gen.paged_verify_forward(
+                    params, chunk, paged, tables, lengths, cfg,
+                    ctx_cap=ctx_cap, active=active, use_kernel=uk,
+                    tp_axis=ax, dp_axis=dpx, fused=fz,
+                    tree_depth=depths, tree_mask=anc, **kw)
+
+            def f(params, chunk, paged, tables, lengths, active,
+                  depths, anc, *extra):
+                logits, rows = fwd(params, chunk, paged, tables,
+                                   lengths, active, depths, anc,
+                                   *extra)
+                if temp == 0.0:
+                    return (jnp.argmax(logits, axis=-1)
+                            .astype(jnp.int32), rows)
+                return logits.astype(jnp.float32), rows
+            if self.mesh is not None:
+                kinds = ["params", "batch", "pool", "batch", "batch",
+                         "batch", "batch", "batch"]
+                if ad_on:
+                    kinds += ["adapters", "batch"]
+                f = self._tp_map(f, tuple(kinds),
+                                 out_kinds=("rep", "rows"))
+            self._tree_fns[key] = jax.jit(f)
+        return self._tree_fns[key]
+
+    def _tree_commit_fn(self, T: int):
+        """The tree commit's jitted placement: gather each row's
+        accepted root-path nodes out of the verify's KV rows and
+        scatter them into the main pool at ``lengths + d`` —
+        bit-identical to what a linear verify of the accepted path
+        would have written. Only the pool is donated — the rows'
+        ``(L, B, T, nkv, hd)`` buffers never match an output shape,
+        so donating them would just warn."""
+        if T not in self._tree_commit_fns:
+            from ..models import generate as gen
+            dpx = self._dp_axis
+
+            def f(paged, rows, tables, lengths, path_nodes, path_len):
+                return gen.paged_tree_commit(
+                    paged, rows, tables, lengths, path_nodes,
+                    path_len, dp_axis=dpx)
+            if self.mesh is not None:
+                f = self._tp_map(f, ("pool", "rows", "batch", "batch",
+                                     "batch", "batch"),
+                                 out_kinds=("pool",))
+            self._tree_commit_fns[T] = jax.jit(f, donate_argnums=(0,))
+        return self._tree_commit_fns[T]
+
     # ---- scheduling ----
     def _install_slot(self, slot: int, req: GenerationRequest):
         """Seat ``req`` in ``slot`` and mirror its commit-relevant
@@ -1064,6 +1303,16 @@ class ContinuousBatchingEngine:
         self._slots[slot] = None
         self._rids[slot] = -1
         self._aslot[slot] = 0
+        if self.draft_cache is not None:
+            # draft pool state is DISPOSABLE (ISSUE 20): retire,
+            # preempt, swap and cancel all just drop the slot's draft
+            # pages — resume/recovery rebuild them cold through the
+            # catch-up forward, token-identically
+            if self.draft_cache.active[slot]:
+                self.draft_cache.release(slot)
+            self._draft_chain.pop(slot, None)
+            self._draft_q.pop(slot, None)
+            self._draft_base[slot] = 0
         if self.constraints:
             self._cmask[slot] = True
             self._cmask_dirty = True
@@ -1789,6 +2038,7 @@ class ContinuousBatchingEngine:
         h, self._inflight = self._inflight, None
         if h is not None:
             n += (self._decode_commit(h) if h.kind == "decode"
+                  else self._tree_commit(h) if h.kind == "tree"
                   else self._spec_commit(h))
         fence = getattr(self.cache, "fence_swaps", None)
         if fence is not None:
@@ -1809,14 +2059,24 @@ class ContinuousBatchingEngine:
 
     # ---- speculative decoding (ISSUE 5) ----
     def propose_drafts(self, mask) -> Dict[int, np.ndarray]:
-        """Host-side n-gram draft proposals for every masked ready slot
-        — ``slot -> up-to-spec_k draft tokens`` (rows with no in-history
-        match, a poor acceptance EMA, or no remaining token room are
-        simply absent and decode plainly). Separated from
-        :meth:`spec_step` so the SLO scheduler can charge each row's
-        verify width against its token budget BEFORE executing."""
+        """Draft proposals for every masked ready slot — ``slot ->
+        up-to-spec_k draft tokens`` (rows with no in-history match, a
+        poor acceptance EMA, or no remaining token room are simply
+        absent and decode plainly). Separated from :meth:`spec_step`
+        so the SLO scheduler can charge each row's verify width
+        against its token budget BEFORE executing.
+
+        With a DRAFT MODEL configured (``draft_layers``, ISSUE 20) the
+        proposals come from :meth:`_propose_model_drafts` instead of
+        the host n-gram lookup; under ``spec_tree`` the returned
+        values are :class:`~paddle_tpu.serving.speculative.TreeDraft`
+        trees, which satisfy the same ``d.size`` / ``d[:k]`` planner
+        contract (the budget charges tree NODES; trimming drops
+        leaves, never the root path)."""
         if self.spec is None:
             return {}
+        if self.draft_params is not None:
+            return self._propose_model_drafts(mask)
         mask = np.asarray(mask, bool)
         drafts: Dict[int, np.ndarray] = {}
         for slot, req in enumerate(self._slots):
@@ -1836,6 +2096,158 @@ class ContinuousBatchingEngine:
             if d.size:
                 drafts[slot] = d
         return drafts
+
+    def _propose_model_drafts(self, mask) -> Dict:
+        """DRAFT-MODEL proposer (ISSUE 20): k autoregressive steps of
+        the truncated-layer draft model on device, against the slot's
+        own pages in the SECOND (draft) paged pool.
+
+        Protocol per masked row: (1) lazy-admit a draft-pool slot
+        (PoolExhausted skips drafting — pure back-pressure, the row
+        decodes plainly); (2) CATCH-UP — feed the gap between the
+        draft pool's valid prefix and the committed context (all but
+        the last token) through the draft verify forward. Steady state
+        is zero-width: every commit leaves the pool caught up, so the
+        catch-up only pays on a cold slot (first propose, resume,
+        crash recovery) — which is exactly the disposable-pool
+        rebuild; (3) k one-token draft decode steps from the last
+        sampled token, each yielding the full distribution q on the
+        host: the chain token is its argmax (or a q-sample at
+        temperature — the rejection sampler's min(1, p/q) requires
+        drafts ~ q), tree mode takes the top-``width`` candidates per
+        depth (deterministic candidates keep sequential point-mass
+        rejection exact in law).
+
+        The draft pool's ``lengths`` stay at the VALID prefix — the
+        speculative feeds advance only a local run-length, so a
+        fallback plain-decode step (or a preemption) never has to roll
+        anything back; the commit advances the valid prefix past
+        exactly the accepted tokens that match the fed chain."""
+        from ..serving import PoolExhausted
+        from ..serving.speculative import TreeDraft, build_comb_tree
+        mask = np.asarray(mask, bool)
+        dc = self.draft_cache
+        _fault_point("draft_propose")
+        rows: Dict[int, np.ndarray] = {}
+        rooms: Dict[int, int] = {}
+        for slot, req in enumerate(self._slots):
+            if req is None or not mask[slot]:
+                continue
+            room = req.max_new_tokens - len(req.tokens) - 1
+            if room <= 0:
+                continue
+            if not dc.active[slot]:
+                total = (req.prompt.shape[1] + req.max_new_tokens
+                         + self.spec_k + 1)
+                try:
+                    dc.admit(slot, total)
+                except PoolExhausted:
+                    continue        # back-pressure: decode plainly
+                dc.lengths[slot] = 0
+            rows[slot] = np.concatenate(
+                [req.prompt[0], np.asarray(req.tokens, np.int32)])
+            rooms[slot] = room
+        if not rows:
+            return {}
+        B, k, temp = self.max_batch, self.spec_k, self.temperature
+        # --- catch-up: page-bucketed verify chunks over the draft
+        # model until every row's pool covers its context minus the
+        # last token (multi-chunk only for prompt-scale gaps)
+        catchup = 0
+        while True:
+            need = {s: rows[s].size - 1 - int(dc.lengths[s])
+                    for s in rows}
+            cmax = max(need.values())
+            if cmax <= 0:
+                break
+            W = 1
+            while W < min(cmax, 128):
+                W *= 2
+            chunk = np.zeros((B, W), np.int32)
+            cmask = np.zeros((B,), bool)
+            adv = np.zeros((B,), np.int32)
+            for s, c in need.items():
+                if c <= 0:
+                    continue
+                c = min(c, W)
+                st = int(dc.lengths[s])
+                chunk[s, :c] = rows[s][st:st + c]
+                cmask[s] = True
+                adv[s] = c
+                catchup += c
+            ctx_cap = dc.ctx_cap_pages(dc.pages_for(
+                int(dc.lengths[cmask].max()))) * dc.page_size
+            dc.pool = self._draft_catchup_fn(ctx_cap, W)(
+                self.draft_params, jnp.asarray(chunk), dc.pool,
+                jnp.asarray(dc.block_tables), jnp.asarray(dc.lengths),
+                jnp.asarray(cmask))
+            dc.lengths[cmask] += adv[cmask]
+        # --- autoregressive draft loop (speculative feeds advance
+        # only the LOCAL run-length; dc.lengths stays the valid prefix)
+        amask = np.zeros((B,), bool)
+        for s in rows:
+            amask[s] = True
+            self._draft_base[s] = rows[s].size
+        run_len = dc.lengths.copy()
+        x = self._last.copy()
+        tree_w = self.spec_tree[0] if self.spec_tree is not None else 0
+        chains = {s: [] for s in rows}
+        fed = {s: [] for s in rows}
+        qs = ({s: [] for s in rows}
+              if temp != 0.0 and not tree_w else None)
+        cands = {s: [] for s in rows} if tree_w else None
+        dec = self._draft_decode()
+        for i in range(k):
+            logits, dc.pool = dec(
+                self.draft_params, jnp.asarray(x), dc.pool,
+                jnp.asarray(dc.block_tables), jnp.asarray(run_len),
+                jnp.asarray(amask))
+            logits = np.asarray(logits)
+            run_len[amask] += 1
+            for s in rows:
+                z = logits[s].astype(np.float64)
+                if tree_w:
+                    top = np.argsort(z)[::-1][:tree_w]
+                    cands[s].append(top.astype(np.int32))
+                    nxt = int(top[0])
+                elif temp != 0.0:
+                    z = z / temp
+                    z -= z.max()
+                    q = np.exp(z)
+                    q /= q.sum()
+                    nxt = int(self._accept_rng.choice(q.size, p=q))
+                    qs[s].append(q)
+                else:
+                    nxt = int(np.argmax(z))
+                if len(chains[s]) < min(k, rooms[s]):
+                    chains[s].append(nxt)
+                if i < k - 1:
+                    fed[s].append(nxt)
+                x[s] = nxt
+        out: Dict = {}
+        drafted = 0
+        for s in rows:
+            self._draft_chain[s] = np.asarray(fed[s], np.int32)
+            if tree_w:
+                t = build_comb_tree(
+                    int(self._last[s]),
+                    np.asarray(chains[s], np.int32),
+                    [c[1:] for c in cands[s]])
+                t = t[:min(t.size, rooms[s])]
+                if t.size:
+                    out[s] = t
+                    drafted += t.size
+            else:
+                d = np.asarray(chains[s], np.int32)
+                if qs is not None:
+                    self._draft_q[s] = np.stack(qs[s])[:d.size]
+                if d.size:
+                    out[s] = d
+                    drafted += d.size
+        _obs.serving_draft_propose(len(rows), drafted, catchup)
+        _obs.serving_draft_pool(dc.allocator.num_used,
+                                dc.allocator.num_usable)
+        return out
 
     def spec_step(self, mask, drafts: Optional[Dict] = None) -> int:
         """The speculative sibling of :meth:`decode_step`, sharing its
@@ -1869,17 +2281,23 @@ class ContinuousBatchingEngine:
         per ready row keeps the token budget a hard ceiling (executed
         drafts are trimmed to the planned allowance at dispatch);
         rows with no token room are absent, exactly as in
-        :meth:`propose_drafts`."""
+        :meth:`propose_drafts`. Tree speculation (ISSUE 20) charges
+        tree NODES — the verify program's width is the whole tree, so
+        the pessimistic width is ``width x depth`` (the planner's trim
+        then drops leaves first; the root path survives, so the token
+        ceiling stays hard)."""
         if self.spec is None:
             return {}
         mask = np.asarray(mask, bool)
+        nodes = (self._tree_T - 1 if self.spec_tree is not None
+                 else self.spec_k)
         out: Dict[int, int] = {}
         for slot, req in enumerate(self._slots):
             if req is None or not mask[slot]:
                 continue
             room = req.max_new_tokens - len(req.tokens) - 1
             if room > 0:
-                out[slot] = min(self.spec_k, room)
+                out[slot] = min(nodes, room)
         return out
 
     def spec_dispatch(self, mask,
@@ -1901,10 +2319,22 @@ class ContinuousBatchingEngine:
                 "in flight — commit_inflight() first")
         if drafts is None:
             drafts = self.propose_drafts(mask)
+        if self.spec_tree is not None:
+            # tree speculation (ISSUE 20): the proposals are TreeDraft
+            # trees — one tree-mode verify forward scores every node
+            return self._tree_dispatch(mask, drafts)
         drafts = {s: np.asarray(d, np.int32) for s, d in drafts.items()
                   if len(d) and mask[s]}
         if not drafts:
             return self.decode_dispatch(mask)
+        # draft-model q snapshot (ISSUE 20): the stashed per-position
+        # proposal distributions ride the in-flight handle, trimmed to
+        # the (possibly planner-shortened) dispatched width — the
+        # commit's rejection sampler accepts with min(1, p/q)
+        qs = None
+        if self.draft_params is not None and self.temperature != 0.0:
+            qs = {s: self._draft_q[s][:d.size]
+                  for s, d in drafts.items() if s in self._draft_q}
         B, T = self.max_batch, self.spec_k + 1
         chunk = np.zeros((B, T), np.int32)
         chunk[:, 0] = self._last
@@ -1929,7 +2359,8 @@ class ContinuousBatchingEngine:
         self._inflight = InFlightStep("spec", mask, self._rids.copy(),
                                       self._seat.copy(), out,
                                       drafts=drafts, dlen=dlen, t0=t0,
-                                      ttr=_obs.serving_trace_now())
+                                      ttr=_obs.serving_trace_now(),
+                                      qs=qs)
         return self._inflight
 
     def _spec_commit(self, h: InFlightStep) -> int:
@@ -1969,14 +2400,32 @@ class ContinuousBatchingEngine:
                 # with p_i(draft), otherwise draw the corrective token
                 # from the residual — output distribution identical in
                 # law to plain sampled decode, so temperature>0 rows get
-                # the 1+k speedup without changing what they emit
+                # the 1+k speedup without changing what they emit.
+                # Under the draft model (ISSUE 20) q is the REAL
+                # proposal distribution (acceptance min(1, p/q),
+                # residual norm_+(p - q)); None keeps the n-gram
+                # point-mass law
+                q = h.qs.get(slot) if h.qs is not None else None
                 toks, a = rejection_sample_tokens(
                     out[slot, :j + 1], d if j else None,
-                    self.temperature, self._accept_rng)
+                    self.temperature, self._accept_rng,
+                    q=(q[:j] if q is not None and j else None))
             else:
                 a = longest_accepted_prefix(d, out[slot]) if j else 0
                 toks = ((list(d[:a]) if j else [])
                         + [int(out[slot, a])])
+            # draft-pool valid prefix (ISSUE 20): advance past exactly
+            # the accepted tokens that MATCH what was fed through the
+            # draft model — a mismatch tail re-feeds via catch-up
+            if (self.draft_cache is not None
+                    and slot in self._draft_chain):
+                ch = self._draft_chain.pop(slot)
+                m = 0
+                while (m < min(a, ch.size)
+                       and int(toks[m]) == int(ch[m])):
+                    m += 1
+                self.draft_cache.lengths[slot] = int(
+                    self._draft_base[slot]) + m
             # commit: the last token's KV + a accepted drafts are now
             # context; the corrective/bonus token becomes the new last
             cache.lengths[slot] += a + 1
@@ -2000,6 +2449,172 @@ class ContinuousBatchingEngine:
         self._steps += 1
         _obs.serving_spec_verify(h.t0, out, n_slots, drafted, accepted,
                                  t1_ns=t1)
+        alloc = cache.allocator
+        _obs.serving_step(n_slots, self.max_batch, alloc.num_used,
+                          alloc.num_usable)
+        if self._dp_axis is not None:
+            _obs.serving_dp_step(
+                self.dp, h.mask.reshape(self.dp, -1).sum(axis=1))
+        self._tp_observe()
+        return committed
+
+    # ---- tree speculation (ISSUE 20) ----
+    def _tree_dispatch(self, mask, trees) -> Optional[InFlightStep]:
+        """DISPATCH half of the TREE-speculation step: pack every
+        masked row's token tree into one (B, T) chunk — node 0 the
+        last sampled token (the root), topology as per-node parent
+        indices turned into depths + ancestor matrices — and launch
+        the ONE tree-mode verify forward (:meth:`_tree_fn`). Un-drafted
+        rows ride the same program as a root-only tree and commit
+        exactly their plain token; pad nodes hang off the root and are
+        never referenced at commit. When NO masked row holds a tree,
+        falls back to plain decode — the worst case is the baseline
+        step, same as the linear path."""
+        from ..serving.speculative import (TreeDraft, tree_depths,
+                                           tree_ancestor_matrix)
+        cache = self.cache
+        trees = {s: t for s, t in trees.items()
+                 if mask[s] and isinstance(t, TreeDraft) and t.size}
+        if not trees:
+            return self.decode_dispatch(mask)
+        B, T = self.max_batch, self._tree_T
+        chunk = np.zeros((B, T), np.int32)
+        chunk[:, 0] = self._last
+        depths = np.ones((B, T), np.int32)
+        depths[:, 0] = 0
+        anc = np.zeros((B, T, T), bool)
+        anc[:, np.arange(T), np.arange(T)] = True
+        anc[:, :, 0] = True             # pad nodes hang off the root
+        for s, t in trees.items():
+            n = t.tokens.size
+            chunk[s, :n] = t.tokens
+            depths[s, :n] = tree_depths(t.parents)
+            anc[s, :n, :n] = tree_ancestor_matrix(t.parents)
+        ctx_cap = cache.ctx_cap_pages(cache.pages_for(
+            int(cache.lengths[mask].max()))) * cache.page_size
+        _fault_point("tree_verify")
+        t0 = _obs.generate_begin()
+        args = [self.params, jnp.asarray(chunk), cache.pool,
+                jnp.asarray(cache.block_tables),
+                jnp.asarray(cache.lengths), jnp.asarray(mask),
+                jnp.asarray(depths), jnp.asarray(anc)]
+        if self.adapters is not None:
+            args += [self.adapters.arrays, jnp.asarray(self._aslot)]
+        out, rows = self._tree_fn(ctx_cap, T)(*args)
+        _fault_point("dispatch")
+        self._inflight = InFlightStep(
+            "tree", mask, self._rids.copy(), self._seat.copy(), out,
+            drafts=trees, t0=t0, ttr=_obs.serving_trace_now(),
+            rows=rows)
+        return self._inflight
+
+    def _tree_commit(self, h: InFlightStep) -> int:
+        """COMMIT half of the tree step: fetch the per-node targets,
+        pick each row's longest accepted ROOT PATH (greedy:
+        :func:`~paddle_tpu.serving.speculative.longest_accepted_path`;
+        sampled: sequential point-mass rejection down the tree,
+        :func:`~paddle_tpu.serving.speculative.tree_rejection_sample`),
+        place exactly those nodes' KV via the jitted
+        :meth:`_tree_commit_fn` (positions are the PRE-commit lengths,
+        bit-identical to a linear verify of the path), then run the
+        host bookkeeping. Rejected nodes were never placed, so
+        rejection needs NO rollback of any kind; guard-skipped slots
+        pass path_len 0 and their nodes route to the trash page."""
+        cache = self.cache
+        mask = h.mask
+        _fault_point("commit")
+        t_f = time.perf_counter_ns()
+        if self.fused:
+            _obs.serving_fused_latency("verify_flash_attn", h.t0, h.out)
+        _fault_point("transfer")
+        out = np.asarray(h.out)     # (B, T) argmax — or, sampled,
+        #                             (B, T, V) per-node verify logits
+        t1 = time.perf_counter_ns()
+        self._fence_ns += t1 - t_f
+        from ..serving.speculative import (longest_accepted_path,
+                                           tree_rejection_sample)
+        sampled = self.temperature != 0.0
+        B, T = self.max_batch, self._tree_T
+        path_nodes = np.zeros((B, T), np.int32)
+        path_len = np.zeros((B,), np.int32)
+        base_len = cache.lengths.copy()
+        plans = []
+        for slot, req in enumerate(self._slots):
+            if (req is None or not mask[slot]
+                    or self._rids[slot] != h.rids[slot]
+                    or self._seat[slot] != h.seats[slot]):
+                continue
+            t = h.drafts.get(slot)
+            if t is None:
+                # un-drafted row: exactly the plain token at the root
+                if sampled:
+                    z = out[slot, 0].astype(np.float64)
+                    z /= self.temperature
+                    z -= z.max()
+                    p = np.exp(z)
+                    p /= p.sum()
+                    toks = [int(self._accept_rng.choice(p.size, p=p))]
+                else:
+                    toks = [int(out[slot, 0])]
+                path, a = [0], 0
+            elif sampled:
+                path, toks, a = tree_rejection_sample(
+                    t.tokens, t.parents, out[slot],
+                    self.temperature, self._accept_rng)
+            else:
+                path, toks, a = longest_accepted_path(
+                    t.tokens, t.parents, out[slot])
+            path_nodes[slot, :len(path)] = path
+            path_len[slot] = len(path)
+            plans.append((slot, req, t, toks, a))
+        # device placement FIRST, against the pre-commit tables and
+        # lengths (retirement below resets them for finished rows —
+        # their already-placed rows die with their freed pages, the
+        # contract every release relies on)
+        cache.pool = self._tree_commit_fn(T)(
+            cache.pool, h.rows, jnp.asarray(cache.block_tables),
+            jnp.asarray(base_len), jnp.asarray(path_nodes),
+            jnp.asarray(path_len))
+        n_slots = committed = drafted = accepted = 0
+        paths = []
+        for slot, req, t, toks, a in plans:
+            n_slots += 1
+            # draft-pool valid prefix: same matched-chain rule as the
+            # linear commit (the fed chain is the tree's top-1 spine;
+            # an accepted path through a SIBLING diverges and re-feeds
+            # from the divergence via catch-up)
+            if (self.draft_cache is not None
+                    and slot in self._draft_chain):
+                ch = self._draft_chain.pop(slot)
+                m = 0
+                while (m < min(a, ch.size)
+                       and int(toks[m]) == int(ch[m])):
+                    m += 1
+                self.draft_cache.lengths[slot] = int(
+                    self._draft_base[slot]) + m
+            cache.lengths[slot] += a + 1
+            self._last[slot] = np.int32(toks[-1])
+            for tok in toks:
+                self._record_token(req, int(tok))
+                committed += 1
+                if req.done:
+                    break              # eos/max_len: drop the tail
+            if t is not None:
+                drafted += t.size
+                accepted += a
+                paths.append(a + 1)
+                self.spec.observe(slot, req.rid, t.size, a)
+            if h.ttr:
+                _obs.serving_trace_span(
+                    req, "tree_verify", h.ttr, replica=self.replica_id,
+                    slot=slot, seq=len(req.tokens),
+                    meta={"nodes": t.size if t is not None else 0,
+                          "accepted": int(a)})
+        if sampled and drafted:
+            _obs.serving_sample_accept(drafted, accepted)
+        self._steps += 1
+        _obs.serving_tree_verify(h.t0, out, n_slots, drafted, accepted,
+                                 paths, t1_ns=t1)
         alloc = cache.allocator
         _obs.serving_step(n_slots, self.max_batch, alloc.num_used,
                           alloc.num_usable)
@@ -2091,4 +2706,12 @@ class ContinuousBatchingEngine:
                 self.cache.prefix.evictions_total
         if self.spec is not None:
             s.update(self.spec.stats())
+        if self.draft_cache is not None:
+            s["draft_layers"] = self.draft_layers
+            da = self.draft_cache.allocator
+            s["draft_pool_pages_used"] = da.num_used
+            s["draft_pool_pages_usable"] = da.num_usable
+        if self.spec_tree is not None:
+            s["tree_width"], s["tree_depth"] = self.spec_tree
+            s["tree_nodes"] = self._tree_T - 1
         return s

@@ -13,6 +13,7 @@ temperature/top-k sampling via stateless PRNG.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from functools import partial
 from typing import Dict, Optional, Tuple
@@ -461,7 +462,8 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
                          block_tables: jax.Array, lengths: jax.Array,
                          cfg: LlamaConfig, *, ctx_cap: int, active=None,
                          use_kernel=None, tp_axis=None, dp_axis=None,
-                         fused=None, adapters=None, adapter_slots=None):
+                         fused=None, adapters=None, adapter_slots=None,
+                         tree_depth=None, tree_mask=None):
     """Batched speculative-decode VERIFY: score a ``T``-token chunk for
     EVERY speculating row against its paged KV in ONE forward — the
     batched generalization of :func:`paged_prefill_chunk` (which runs
@@ -506,8 +508,27 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
     stay dp-replicated; this program has ONE gather site at the end:
     the new KV rows + destination slots all-gather across dp before
     the scatter (full-batch writes on every replica, single-chip row
-    order) and the logits batch-gather to (B_total, T, V)."""
+    order) and the logits batch-gather to (B_total, T, V).
+
+    TREE mode (ISSUE 20): with ``tree_depth`` (B, T) int32 per-node
+    depths (root 0) and ``tree_mask`` (B, T, T) bool ancestor-or-self
+    matrices, the T chunk lanes are token-TREE nodes instead of a
+    linear draft: rope positions become ``lengths + depth`` and the
+    ancestor matrix replaces the intra-chunk causal triangle (see
+    :func:`_attn_with_cache`), so ``logits[r, i]`` scores node i
+    against exactly its ROOT PATH — the whole tree verifies in this
+    ONE forward. Same-depth nodes would collide at the same page slot,
+    so tree mode does NOT scatter: it returns ``(logits, rows)`` where
+    ``rows[name]`` is the (L, B, T, ...) per-node new KV (rope'd,
+    int8-quantized — everything but placed); the host picks the
+    accepted root path and :func:`paged_tree_commit` scatters exactly
+    those nodes. Pools pass through untouched (the caller keeps its
+    reference), so rejection needs no rollback at all."""
     B, T = tokens.shape
+    tree = tree_depth is not None
+    if tree and tree_mask is None:
+        raise ValueError("paged_verify_forward: tree_depth requires "
+                         "tree_mask (and vice versa)")
     page = paged["k"].shape[2]
     if ctx_cap % page:
         raise ValueError(
@@ -535,16 +556,26 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
             g = jnp.take_along_axis(g, idx, axis=2)      # right-aligned
             dense[name] = dense[name].at[:, :, :ctx_cap].set(
                 g.astype(dense[name].dtype))
-    rpos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    if tree:
+        rpos = lengths[:, None] + jnp.asarray(tree_depth, jnp.int32)
+    else:
+        rpos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     logits, dense = _forward_cached(params, tokens, dense, ctx_cap, cfg,
                                     W, use_kernel=use_kernel, rpos=rpos,
                                     kstart=pad, logits_all=True,
                                     tp_axis=tp_axis, dp_axis=dp_axis,
                                     fused=bool(fused),
                                     adapters=adapters,
-                                    adapter_slots=adapter_slots)
+                                    adapter_slots=adapter_slots,
+                                    tree_mask=(jnp.asarray(tree_mask, bool)
+                                               if tree else None))
     if dp_axis is not None:
         logits = _tp_allgather(logits, dp_axis, 0)       # full batch
+    if tree:
+        # no scatter: same-depth nodes share a page slot, so placement
+        # waits for the host's accepted root path (paged_tree_commit)
+        rows = {name: dense[name][:, :, ctx_cap:] for name in paged}
+        return logits, rows
     # scatter the T new rows of every row into its pages; inactive rows
     # and positions past the slot extent route to the trash page
     pos = rpos                                           # (B, T)
@@ -566,6 +597,82 @@ def paged_verify_forward(params, tokens: jax.Array, paged: Dict,
             rows = _tp_allgather(rows, dp_axis, 1)
         out[name] = _scatter_rows(paged[name], dst, rows)
     return logits, out
+
+
+def paged_tree_commit(paged: Dict, rows: Dict, block_tables: jax.Array,
+                      lengths: jax.Array, path_nodes: jax.Array,
+                      path_len: jax.Array, *, dp_axis=None):
+    """Place the ACCEPTED root path of a tree verify into the paged
+    pools — the deferred second half of
+    :func:`paged_verify_forward`'s tree mode.
+
+    rows:       per-node new KV from the tree verify — ``rows[name]``
+                is (L, B, T, ...), node-indexed on axis 2
+    path_nodes: (B, T) int32 node indices of each row's accepted root
+                path in COMMIT ORDER (entry 0 is the tree root — its
+                KV lands at position ``lengths``, exactly where the
+                linear verify writes ``chunk[:, 0]``); entries past
+                ``path_len`` are don't-care
+    path_len:   (B,) int32 committed node count (= accepted + 1 with
+                the bonus token's node never included — the bonus has
+                no KV yet, its row decodes it next step; rows that
+                committed nothing pass 0)
+
+    Gathers each row's path nodes out of ``rows`` and scatters them at
+    positions ``lengths + d`` — pure data movement (no model math), so
+    the committed pool state is bit-identical to what a linear verify
+    of the accepted path would have written. Unaccepted nodes are
+    simply never placed: the tree path inherits the linear path's
+    no-rollback contract for free. Under dp the destinations + rows
+    all-gather before the scatter (pools stay replicated, same as the
+    linear verify's single gather site)."""
+    some = next(iter(rows.values()))
+    B, T = some.shape[1], some.shape[2]
+    page = paged["k"].shape[2]
+    ext = block_tables.shape[1] * page
+    path_nodes = jnp.clip(jnp.asarray(path_nodes, jnp.int32), 0, T - 1)
+    path_len = jnp.asarray(path_len, jnp.int32)
+    d = jnp.arange(T, dtype=jnp.int32)[None, :]          # (1, T)
+    pos = jnp.asarray(lengths, jnp.int32)[:, None] + d   # (B, T)
+    ok = (d < path_len[:, None]) & (pos < ext)
+    posc = jnp.clip(pos, 0, ext - 1)
+    row = jnp.arange(B)[:, None]
+    dst = jnp.where(ok, block_tables[row, posc // page] * page
+                    + posc % page, 0).reshape(-1)        # (B*T,)
+    if dp_axis is not None:
+        dst = _tp_allgather(dst, dp_axis, 0)
+    out = {}
+    for name in rows:
+        r = rows[name]                                   # (L, B, T, ...)
+        idx = path_nodes[None].reshape(
+            (1, B, T) + (1,) * (r.ndim - 3))
+        r = jnp.take_along_axis(r, idx, axis=2)          # path order
+        r = r.reshape((r.shape[0], B * T) + r.shape[3:])
+        if dp_axis is not None:
+            r = _tp_allgather(r, dp_axis, 1)
+        out[name] = _scatter_rows(paged[name], dst, r)
+    return out
+
+
+def make_draft_params(params, cfg: LlamaConfig, n_layers: int):
+    """Truncated-layer, shared-embedding DRAFT model (ISSUE 20): the
+    first ``n_layers`` decoder layers of the target plus its embedding
+    / final norm / head, by REFERENCE — no copies, no extra weight
+    memory beyond what jax may materialize for sliced layer stacks.
+    Returns ``(draft_params, draft_cfg)`` ready for every paged program
+    in this module (the draft model is just a smaller Llama). Sharded
+    targets stay sharded: slicing the stacked (L, ...) layer arrays on
+    axis 0 preserves each leaf's head/vocab partitioning, so the draft
+    runs under the same tp mesh with the same param specs."""
+    L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+    if not (1 <= n_layers < L):
+        raise ValueError(
+            f"make_draft_params: n_layers must be in [1, {L}), got "
+            f"{n_layers} (the draft must be a strict truncation)")
+    draft = {k: v for k, v in params.items() if k != "layers"}
+    draft["layers"] = jax.tree_util.tree_map(
+        lambda a: a[:n_layers], params["layers"])
+    return draft, dataclasses.replace(cfg, num_layers=n_layers)
 
 
 def paged_decode_forward(params, tokens: jax.Array, paged: Dict,
@@ -876,7 +983,7 @@ def _use_decode_kernel(override=None):
 
 def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
                      kstart=None, k_rows=None, v_rows=None,
-                     fused=False):
+                     fused=False, tree_mask=None):
     """q (B,T,nh,hd) vs cache (B,Smax,nkv,hd); positions >= length masked.
     length: scalar or (B,) current valid length INCLUDING q's tokens.
     kstart: optional (B,) first VALID cache position per row (left-padded
@@ -888,7 +995,16 @@ def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
     through the flash chunk kernel
     (:func:`~paddle_tpu.ops.pallas.serving_fused.flash_chunk_attention`)
     instead of materializing the full (B, H, T, W) score tensor; the
-    off-TPU reference is op-for-op this function's jnp composition."""
+    off-TPU reference is op-for-op this function's jnp composition.
+    tree_mask (ISSUE 20): optional (B, T, T) bool ancestor-or-self
+    matrix for TREE speculative verify — the T chunk lanes are token-
+    tree nodes, and node i may attend chunk lane j only when j lies on
+    i's root path. It REPLACES the intra-chunk causal triangle (the
+    committed cache below the chunk stays fully visible, the kstart pad
+    mask still applies); a linear-chain tree's matrix is exactly the
+    lower triangle, reproducing this function's causal mask bit for
+    bit. Requires the verify layout: static ``length`` == Smax (the
+    chunk is the last T cache rows)."""
     B, T, _, hd = q.shape
     if T == 1 and kstart is None and _use_decode_kernel(use_kernel):
         # single-token decode: fused block attention against the padded
@@ -898,6 +1014,11 @@ def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
         o = decode_attention(q[:, 0], ck, cv, length,
                              k_dequant_rows=k_rows, v_dequant_rows=v_rows)
         return o[:, None]
+    if tree_mask is not None and not (
+            isinstance(length, int) and length == ck.shape[1]):
+        raise ValueError(
+            "_attn_with_cache: tree_mask requires the verify layout — "
+            f"static length ({length}) == Smax ({ck.shape[1]})")
     if fused and kstart is not None and isinstance(length, int):
         # flash prefill/verify kernel: online softmax over cache blocks
         # with the exact kstart + per-query causal masks of the jnp
@@ -910,7 +1031,7 @@ def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
             "chunk_flash_attn", 2 * B * nh * T * ck.shape[1] * 4)
         return flash_chunk_attention(
             q, ck, cv, length, kstart, k_rows=k_rows, v_rows=v_rows,
-            use_kernel=use_kernel)
+            use_kernel=use_kernel, tree_mask=tree_mask)
     if k_rows is not None:
         # XLA fuses the dequant into the attention reads
         ck = (ck.astype(jnp.float32) * k_rows[..., None]).astype(q.dtype)
@@ -923,9 +1044,17 @@ def _attn_with_cache(q, ck, cv, length, nh, use_kernel=None,
                    ck.astype(jnp.float32)) / math.sqrt(hd)
     Smax = ck.shape[1]
     kpos = lax.broadcasted_iota(jnp.int32, s.shape, 3)
-    # query i (global position length-T+i) attends to kpos <= its position
-    qpos = (length - T) + lax.broadcasted_iota(jnp.int32, s.shape, 2)
-    s = jnp.where(kpos <= qpos, s, -1e30)
+    if tree_mask is None:
+        # query i (global position length-T+i) attends to kpos <= its
+        # position
+        qpos = (length - T) + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos <= qpos, s, -1e30)
+    else:
+        # tree verify: committed columns (below the chunk) stay fully
+        # visible, chunk columns obey the ancestor matrix
+        allow = jnp.concatenate(
+            [jnp.ones((B, T, Smax - T), bool), tree_mask], axis=2)
+        s = jnp.where(allow[:, None], s, -1e30)
     if kstart is not None:
         s = jnp.where(kpos >= kstart[:, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
@@ -946,7 +1075,7 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                  use_kernel=None, rpos=None, kstart=None,
                  cache_ks=None, cache_vs=None, tp_axis=None,
                  dp_axis=None, fused=False, ad_l=None, aslot=None,
-                 ascale=None):
+                 ascale=None, tree_mask=None):
     """One decoder layer over T tokens starting at cache index ``pos``.
     cache_k/v: (B, Smax, nkv, hd) this layer's cache; returns updated.
     rpos: optional (B,T) per-row rope positions (!= cache index when the
@@ -1013,7 +1142,7 @@ def _block_infer(x, lp, cache_k, cache_v, pos, cos, sin, cfg: LlamaConfig,
                          use_kernel=use_kernel, kstart=kstart,
                          k_rows=cache_ks if quant else None,
                          v_rows=cache_vs if quant else None,
-                         fused=fused)
+                         fused=fused, tree_mask=tree_mask)
     o = o.reshape(B, T, nh * hd)
     if tp_axis is not None:
         # full heads before the (column-sharded) wo contraction, then
@@ -1048,7 +1177,7 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
                     max_len: int, use_kernel=None, rpos=None,
                     kstart=None, logits_at=None, logits_all=False,
                     tp_axis=None, dp_axis=None, fused=False,
-                    adapters=None, adapter_slots=None):
+                    adapters=None, adapter_slots=None, tree_mask=None):
     """tokens (B, T) at cache positions [pos, pos+T) -> (logits_last
     (B, V), updated cache). ``logits_at``: optional TRACED row index
     into ``tokens`` — logits are taken there instead of at row T-1
@@ -1081,7 +1210,7 @@ def _forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
             xc, lp, ck, cv, pos, cos, sin, cfg, use_kernel=use_kernel,
             rpos=rpos, kstart=kstart, cache_ks=cks, cache_vs=cvs,
             tp_axis=tp_axis, dp_axis=dp_axis, fused=fused, ad_l=ad_l,
-            aslot=aslot, ascale=asc)
+            aslot=aslot, ascale=asc, tree_mask=tree_mask)
         return y, ((nk, nv, nks, nvs) if quant else (nk, nv))
 
     xs = [params["layers"], cache["k"], cache["v"]]

@@ -958,6 +958,87 @@ def serving_sample_accept(drafted: int, accepted: int):
                               0.875, 1.0)).observe(accepted / drafted)
 
 
+# ------- model-based draft + tree speculation (ISSUE 20) -------
+
+def serving_draft_propose(rows: int, tokens: int, catchup: int):
+    """One draft-model propose pass: ``rows`` slots drafted ``tokens``
+    proposal tokens (linear chain tokens, or tree NODES under tree
+    speculation) after ``catchup`` catch-up tokens re-fed through the
+    draft forward (zero in steady state; prompt-sized on a cold slot —
+    first propose, post-preemption resume, crash recovery, so this
+    counter IS the disposable-draft-pool rebuild bill)."""
+    if not enabled:
+        return
+    _m.counter("serving_draft_propose_total",
+               "draft-model propose passes").inc()
+    _m.counter("serving_draft_rows_total",
+               "slots that received draft-model proposals").inc(rows)
+    _m.counter("serving_draft_proposed_tokens_total",
+               "draft-model proposal tokens (tree nodes under tree "
+               "speculation)").inc(tokens)
+    _m.counter("serving_draft_catchup_tokens_total",
+               "committed-context tokens re-fed through the draft "
+               "model to rebuild its disposable pool").inc(catchup)
+
+
+def serving_draft_pool(pages_used: int, pages_usable: int):
+    """Draft paged-pool occupancy after a propose pass — the second
+    (small) pool's utilization gauge pair; balanced against its
+    allocator after every rejection cascade by construction (proposal
+    feeds never allocate; pages move only at admit/release)."""
+    if not enabled:
+        return
+    _m.gauge("serving_draft_pool_pages_used",
+             "draft-pool pages currently referenced").set(pages_used)
+    _m.gauge("serving_draft_pool_pages_usable",
+             "draft-pool pages usable (total minus reserved)"
+             ).set(pages_usable)
+
+
+def serving_tree_verify(t0_ns: int, out, rows: int, nodes: int,
+                        accepted: int, paths, t1_ns: int = 0):
+    """Close one TREE-speculation verify step opened at ``t0_ns``: the
+    whole token tree scored in ONE forward. ``nodes``/``accepted``
+    count tree nodes offered vs accepted along the committed root
+    paths; ``paths`` is the per-row committed path length (accepted +
+    1 — the path-length histogram is the quantity the (width, depth)
+    expected-gain model in PERF_NOTES is fit against). Same
+    device-fence contract as :func:`serving_spec_verify`."""
+    if not t0_ns:
+        return
+    _block(out)
+    now = t1_ns or time.perf_counter_ns()
+    _record("Serving.tree_verify", t0_ns, now, "Forward")
+    if not enabled:
+        return
+    _m.histogram("serving_tree_verify_ms",
+                 "wall milliseconds per tree-speculation verify step",
+                 buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                          1000, 2500)).observe((now - t0_ns) / 1e6)
+    _m.counter("serving_tree_steps_total",
+               "tree-speculation verify steps executed").inc()
+    _m.counter("serving_tree_rows_total",
+               "slots advanced through the tree verify program"
+               ).inc(rows)
+    _m.counter("serving_tree_nodes_total",
+               "tree nodes proposed to the verify program").inc(nodes)
+    _m.counter("serving_tree_accepted_nodes_total",
+               "tree nodes accepted on committed root paths"
+               ).inc(accepted)
+    h = _m.histogram("serving_tree_path_len",
+                     "committed root-path length per row (accepted "
+                     "nodes + 1)",
+                     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
+    for p in paths:
+        h.observe(p)
+    if nodes:
+        _m.histogram("serving_tree_acceptance_rate",
+                     "accepted/proposed node ratio per tree verify "
+                     "step",
+                     buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                              0.875, 1.0)).observe(accepted / nodes)
+
+
 # ---------------- constrained decoding (ISSUE 14) ----------------
 
 def serving_constrain(mask_ns: int, violations: int, rows: int):

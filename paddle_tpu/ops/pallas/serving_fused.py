@@ -288,13 +288,19 @@ def fused_paged_decode_attention(q, cos_row, sin_row, k_pages, v_pages,
 
 def _chunk_softmax_step(q, k, v, kstart, o_ref, acc, m_sc, l_sc, *,
                         scale, block_k, rep, qoff, seq_len,
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None, anc=None):
     """Online-softmax step for MULTI-TOKEN queries against one
     (block_k, D) cache block: query row r (= t*rep + h_rep) attends to
     columns ``kstart <= col <= qoff + t`` — the exact masks of
     ``generate._attn_with_cache`` with per-row ``kstart`` (ragged
     right-aligned context) and causal chunk positions. ``k/v_scale``:
-    per-row int8 dequant scalars (dequant in VMEM)."""
+    per-row int8 dequant scalars (dequant in VMEM). ``anc`` (ISSUE
+    20): per-NODE ancestor bitmasks for TREE verify — a python list of
+    T scalar int32s (SMEM reads), bit j of ``anc[t]`` set iff chunk
+    node j lies on node t's root path; the intra-chunk causal triangle
+    is replaced by the ancestor bit (committed columns below ``qoff``
+    stay fully visible), everything else — kstart, online softmax,
+    dequant — is byte-for-byte the linear path."""
     ki = pl.program_id(1)
     last = pl.num_programs(1) - 1
 
@@ -317,8 +323,23 @@ def _chunk_softmax_step(q, k, v, kstart, o_ref, acc, m_sc, l_sc, *,
         q, kk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale       # (T*rep, bk)
     cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    qpos = qoff + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
-    ok = (cols <= qpos) & (cols >= kstart)
+    if anc is None:
+        qpos = qoff + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // rep
+        ok = (cols <= qpos) & (cols >= kstart)
+    else:
+        # tree verify: select each query row's ancestor bitmask (T is
+        # small and static — an unrolled select chain, no gather), then
+        # allow committed columns plus chunk columns whose bit is set
+        T = len(anc)
+        rowt = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
+        av = jnp.zeros(s.shape, jnp.int32)
+        for t in range(T):
+            av = jnp.where(rowt == t, anc[t], av)
+        rel = cols - qoff                    # chunk-node column index
+        bit = (av >> jnp.clip(rel, 0, 31)) & 1
+        ok = (cols < qoff) | ((rel < T) & (bit == 1))
+        ok = ok & (cols >= kstart)
     s = jnp.where(ok, s, _fa.DEFAULT_MASK_VALUE)
     m_prev = m_sc[...]
     m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -363,13 +384,41 @@ def _chunk_kernel_rowq(q_ref, k_ref, v_ref, sk_ref, sv_ref, kst_ref,
                         v_scale=sv_ref[0])
 
 
+def _chunk_kernel_tree(q_ref, k_ref, v_ref, kst_ref, anc_ref, o_ref,
+                       acc, m_sc, l_sc, *, scale, block_k, rep, qoff,
+                       seq_len, nnodes):
+    i = pl.program_id(0)
+    _chunk_softmax_step(q_ref[0], k_ref[0], v_ref[0], kst_ref[i],
+                        o_ref, acc, m_sc, l_sc, scale=scale,
+                        block_k=block_k, rep=rep, qoff=qoff,
+                        seq_len=seq_len,
+                        anc=[anc_ref[i, t] for t in range(nnodes)])
+
+
+def _chunk_kernel_rowq_tree(q_ref, k_ref, v_ref, sk_ref, sv_ref,
+                            kst_ref, anc_ref, o_ref, acc, m_sc, l_sc,
+                            *, scale, block_k, rep, qoff, seq_len,
+                            nnodes):
+    i = pl.program_id(0)
+    _chunk_softmax_step(q_ref[0], k_ref[0], v_ref[0], kst_ref[i],
+                        o_ref, acc, m_sc, l_sc, scale=scale,
+                        block_k=block_k, rep=rep, qoff=qoff,
+                        seq_len=seq_len, k_scale=sk_ref[0],
+                        v_scale=sv_ref[0],
+                        anc=[anc_ref[i, t] for t in range(nnodes)])
+
+
 def flash_chunk_attention_reference(q, ck, cv, length, kstart, *,
                                     scale=None, k_rows=None,
-                                    v_rows=None):
+                                    v_rows=None, tree_mask=None):
     """Pure-lax reference — op-for-op the jnp composition of
     ``generate._attn_with_cache`` (same einsums, f32 accumulation,
     -1e30 masks, dequant-then-cast), so the CPU fallback is
-    BIT-identical to the unfused path."""
+    BIT-identical to the unfused path. ``tree_mask`` (ISSUE 20):
+    optional (B, T, T) ancestor-or-self matrix replacing the
+    intra-chunk causal triangle for TREE verify (committed columns
+    below the chunk stay fully visible; a chain tree reproduces the
+    causal mask exactly)."""
     B, T, H, D = q.shape
     if (k_rows is None) != (v_rows is None):
         raise ValueError(
@@ -386,8 +435,15 @@ def flash_chunk_attention_reference(q, ck, cv, length, kstart, *,
                    ck.astype(jnp.float32))
     s = s * scale if scale is not None else s / math.sqrt(D)
     kpos = lax.broadcasted_iota(jnp.int32, s.shape, 3)
-    qpos = (length - T) + lax.broadcasted_iota(jnp.int32, s.shape, 2)
-    s = jnp.where(kpos <= qpos, s, -1e30)
+    if tree_mask is None:
+        qpos = (length - T) + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos <= qpos, s, -1e30)
+    else:
+        Smax = ck.shape[1]
+        allow = jnp.concatenate(
+            [jnp.ones((B, T, Smax - T), bool),
+             jnp.asarray(tree_mask, bool)], axis=2)
+        s = jnp.where(allow[:, None], s, -1e30)
     s = jnp.where(kpos >= jnp.asarray(kstart, jnp.int32)
                   [:, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
@@ -396,7 +452,7 @@ def flash_chunk_attention_reference(q, ck, cv, length, kstart, *,
 
 def flash_chunk_attention_kernel(q, ck, cv, length, kstart, *,
                                  scale=None, k_rows=None, v_rows=None,
-                                 block_k: int = 512):
+                                 block_k: int = 512, tree_mask=None):
     """Pallas flash attention for the multi-token serving programs.
 
     q:       (B, T, H, D) rotated chunk queries
@@ -407,6 +463,13 @@ def flash_chunk_attention_kernel(q, ck, cv, length, kstart, *,
     kstart:  (B,) traced first valid cache column per row
     returns (B, T, H, D); query row t sees columns
     ``[kstart_b, ctx_cap + t]`` — exactly the unfused masks.
+
+    tree_mask (ISSUE 20): optional (B, T, T) bool ancestor-or-self
+    matrix — the chunk lanes become token-TREE nodes and node t sees
+    chunk column j only when the matrix row allows it. The matrix
+    packs into per-node int32 BITMASKS riding SMEM next to ``kstart``
+    (hence T <= 32 in tree mode — comb trees are shallow and narrow),
+    and only the mask predicate changes inside the step.
     """
     if not _PALLAS_OK:
         raise RuntimeError(
@@ -426,6 +489,11 @@ def flash_chunk_attention_kernel(q, ck, cv, length, kstart, *,
             "flash_chunk_attention: k_rows and v_rows must be passed "
             "together — int8 caches quantize both K and V")
     quant = k_rows is not None
+    if tree_mask is not None and T > 32:
+        raise ValueError(
+            f"flash_chunk_attention: tree mode packs ancestor rows "
+            f"into int32 bitmasks, so the tree is capped at 32 nodes "
+            f"(got T={T})")
 
     # (B, T, H, D) -> (B*HK, T*rep, D): one grid row per kv-head group
     qt = q.reshape(B, T, HK, rep, D).transpose(0, 2, 1, 3, 4).reshape(
@@ -441,6 +509,13 @@ def flash_chunk_attention_kernel(q, ck, cv, length, kstart, *,
         pl.BlockSpec((1, bk, D), lambda i, j: (i, j, 0)),
     ]
     inputs = [qt, kt, vt]
+    tkw = {}
+    if tree_mask is not None:
+        tkw = {"nnodes": T}
+        kernel_plain, kernel_quant = _chunk_kernel_tree, \
+            _chunk_kernel_rowq_tree
+    else:
+        kernel_plain, kernel_quant = _chunk_kernel, _chunk_kernel_rowq
     if quant:
         def rows(sc):   # (B, W, HK) -> (B*HK, W, 1)
             return jnp.asarray(sc, jnp.float32).transpose(
@@ -448,16 +523,27 @@ def flash_chunk_attention_kernel(q, ck, cv, length, kstart, *,
         in_specs += [pl.BlockSpec((1, bk, 1), lambda i, j: (i, j, 0)),
                      pl.BlockSpec((1, bk, 1), lambda i, j: (i, j, 0))]
         inputs += [rows(k_rows), rows(v_rows)]
-        kernel = functools.partial(_chunk_kernel_rowq, scale=s,
+        kernel = functools.partial(kernel_quant, scale=s,
                                    block_k=bk, rep=rep, qoff=qoff,
-                                   seq_len=length)
+                                   seq_len=length, **tkw)
     else:
-        kernel = functools.partial(_chunk_kernel, scale=s, block_k=bk,
-                                   rep=rep, qoff=qoff, seq_len=length)
+        kernel = functools.partial(kernel_plain, scale=s, block_k=bk,
+                                   rep=rep, qoff=qoff, seq_len=length,
+                                   **tkw)
     in_specs.append(pl.BlockSpec(
         (B * HK,), lambda i, j: (0,),
         memory_space=pltpu.SMEM if _PALLAS_OK else None))
     inputs.append(kst)
+    if tree_mask is not None:
+        # per-node ancestor bitmask, repeated over kv-head groups like
+        # kstart: bit j of anc[b*HK + g, t] = node j on node t's path
+        bits = (jnp.asarray(tree_mask, jnp.int32)
+                * (1 << jnp.arange(T, dtype=jnp.int32))[None, None, :]
+                ).sum(axis=2)                             # (B, T)
+        in_specs.append(pl.BlockSpec(
+            (B * HK, T), lambda i, j: (0, 0),
+            memory_space=pltpu.SMEM if _PALLAS_OK else None))
+        inputs.append(jnp.repeat(bits, HK, axis=0))
 
     out = pl.pallas_call(
         kernel,
@@ -477,13 +563,15 @@ def flash_chunk_attention_kernel(q, ck, cv, length, kstart, *,
 
 
 def flash_chunk_attention(q, ck, cv, length, kstart, *, scale=None,
-                          k_rows=None, v_rows=None, use_kernel=None):
+                          k_rows=None, v_rows=None, use_kernel=None,
+                          tree_mask=None):
     """Dispatcher for the multi-token serving attention: Pallas flash
     kernel on real TPU or when forced (interpret mode in tests),
     pure-lax reference — bit-identical to the unfused
     ``_attn_with_cache`` composition — elsewhere. Consumers:
     ``paged_prefill_chunk`` (the fused PREFILL kernel) and
-    ``paged_verify_forward`` (the fused VERIFY kernel)."""
+    ``paged_verify_forward`` (the fused VERIFY kernel, linear AND —
+    via ``tree_mask`` — tree speculative)."""
     if use_kernel is None:
         try:
             use_kernel = jax.devices()[0].platform == "tpu"
@@ -492,7 +580,7 @@ def flash_chunk_attention(q, ck, cv, length, kstart, *, scale=None,
     if use_kernel:
         return flash_chunk_attention_kernel(
             q, ck, cv, length, kstart, scale=scale, k_rows=k_rows,
-            v_rows=v_rows)
+            v_rows=v_rows, tree_mask=tree_mask)
     return flash_chunk_attention_reference(
         q, ck, cv, length, kstart, scale=scale, k_rows=k_rows,
-        v_rows=v_rows)
+        v_rows=v_rows, tree_mask=tree_mask)

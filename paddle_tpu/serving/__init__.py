@@ -104,8 +104,10 @@ from .resilience import (  # noqa: F401
 )
 from .scheduler import ServingScheduler  # noqa: F401
 from .speculative import (  # noqa: F401
-    NgramProposer, Speculator, longest_accepted_prefix,
-    rejection_sample_tokens,
+    NgramProposer, Speculator, TreeDraft, build_comb_tree,
+    longest_accepted_path, longest_accepted_prefix,
+    rejection_sample_tokens, tree_ancestor_matrix, tree_depths,
+    tree_rejection_sample,
 )
 from .adapters import (  # noqa: F401
     AdapterPool, AdapterPoolExhausted, AdapterRegistry, init_lora,

@@ -64,7 +64,13 @@ class StepPlan:
     costs one extra token on top of the slot's base decode token (a
     k-draft verify forwards ``1 + k`` positions and can commit up to
     ``1 + k`` tokens), and the planner trims drafts to the budget tail
-    rather than deferring the whole row. ``prefills``: ``(slot,
+    rather than deferring the whole row. Under TREE speculation
+    (ISSUE 20) the count is the tree's NODE count (a (width, depth)
+    tree verifies ``1 + width*depth`` positions in one forward and is
+    charged identically); a budget trim reaches the engine as a
+    leading-slice of the node array, whose chain-first ordering drops
+    sibling leaves and chain tail first — the root path survives, so
+    a tight budget narrows the tree instead of breaking it. ``prefills``: ``(slot,
     token_cap)`` pairs — each named pending admission forwards at most
     ``token_cap`` prompt tokens of chunked prefill this step
     (page-multiple caps; the engine takes ``min(cap, remaining,
@@ -139,12 +145,13 @@ class TokenBudgetPlanner:
         spec_drafts:  ``slot -> proposed draft count`` for decode slots
                       the engine wants to advance via speculative
                       verify — a k-draft verify is charged ``1 + k``
-                      tokens. Drafts are TRIMMED to the remaining
-                      budget (never rounded through it: the base
-                      decode token is taken first, drafts only fill
-                      what is left), so the ceiling stays hard and a
-                      tight budget degrades a row to plain decode
-                      instead of deferring it.
+                      tokens (tree speculation proposes its NODE
+                      count: same charge, same trim). Drafts are
+                      TRIMMED to the remaining budget (never rounded
+                      through it: the base decode token is taken
+                      first, drafts only fill what is left), so the
+                      ceiling stays hard and a tight budget degrades a
+                      row to plain decode instead of deferring it.
         reserved_tokens: tokens of budget already spent before the
                       plan — the host tier's swap-in scatters during
                       this step's admissions (ISSUE 10), charged at

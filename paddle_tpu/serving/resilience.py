@@ -104,7 +104,16 @@ ENGINE_SITES = ("alloc", "free", "decode_step", "prefill_chunk",
                 # fsync, checkpoint_write before the checkpoint file —
                 # none commits anything, and the crash-point sweep
                 # kills the process after each and recovers from disk
-                "wal_append", "wal_fsync", "checkpoint_write")
+                "wal_append", "wal_fsync", "checkpoint_write",
+                # draft-model + tree speculation, ISSUE 20 — both fire
+                # BEFORE any commit: draft_propose before the draft
+                # model's catch-up/propose forwards touch its pool,
+                # tree_verify before the one-forward tree verify
+                # launches. Draft-pool state is disposable, so a fault
+                # at either recovers by rebuilding it cold.
+                # NB keep this comment paren-free: check_fault_sites
+                # parses the tuple with a non-greedy paren match
+                "draft_propose", "tree_verify")
 
 #: cluster-plane sites (ISSUE 13): the prefill→decode handoff's two
 #: byte-moving halves and the autoscaler's control tick. They only
@@ -126,6 +135,21 @@ SITES = ENGINE_SITES + CLUSTER_SITES
 #: the pressure-ordered degraded-mode ladder (index == level): each
 #: recovery escalates one rung, sustained healthy steps climb back down
 DEGRADED_MODES = ("healthy", "no_spec", "small_chunks", "shed_low")
+
+
+def _draft_identity(engine):
+    """The journaled DRAFT-MODEL identity (ISSUE 20): draft-pool
+    STATE is disposable — never checkpointed, never journaled — so
+    recovery only needs ``[draft_layers]`` (linear draft) or
+    ``[draft_layers, tree_width, tree_depth]`` (tree speculation) to
+    prove the replacement engine re-drafts token-identically; the
+    rebuilt pool then refills cold through the catch-up forward.
+    ``None`` for engines without a draft model."""
+    dl = getattr(engine, "draft_layers", None)
+    if dl is None:
+        return None
+    tree = getattr(engine, "spec_tree", None)
+    return [int(dl)] + ([int(tree[0]), int(tree[1])] if tree else [])
 
 
 class InjectedFault(RuntimeError):
@@ -918,6 +942,7 @@ class EngineSupervisor:
                              if cache.kv_dtype is not None else None),
                 "constraints": bool(getattr(self.engine, "constraints",
                                             False)),
+                "draft": _draft_identity(self.engine),
                 "next_rid": self._next_rid})
             self.wal.commit(force=True)
 
@@ -1338,6 +1363,7 @@ class EngineSupervisor:
                          if cache.kv_dtype is not None else None),
             "constraints": bool(getattr(self.engine, "constraints",
                                         False)),
+            "draft": _draft_identity(self.engine),
             "prefix": None,
         }
         arrays: Dict[str, np.ndarray] = {
@@ -1574,6 +1600,13 @@ class EngineSupervisor:
             raise ValueError(
                 f"restore: checkpoint kv_dtype={meta['kv_dtype']} "
                 f"!= engine kv_dtype={kv}")
+        draft = _draft_identity(sup.engine)
+        if meta.get("draft") != draft:
+            raise ValueError(
+                f"restore: checkpoint draft identity="
+                f"{meta.get('draft')} != engine {draft} — the factory "
+                f"must rebuild the same draft_layers/spec_tree (the "
+                f"draft pool itself rebuilds cold)")
         key_data = ckpt["key_data"]
         if key_data.size:
             import jax
@@ -1623,7 +1656,22 @@ class EngineSupervisor:
         wk.setdefault("last_lsn", state["report"]["last_lsn"])
         kw["wal_kw"] = wk
         sup = cls(engine_factory, wal_dir=wal_dir, **kw)
-        sup._install_recovered(state, t0)
+        try:
+            sup._install_recovered(state, t0)
+        except Exception:
+            # a REFUSED recovery (factory geometry / kv tier / draft
+            # identity mismatch) must be side-effect-free on the
+            # journal: construction above already appended the fresh
+            # engine's meta record, so latest-wins would hand the NEXT
+            # attempt the wrong factory's identity to validate against
+            # — re-append the dead incarnation's geometry so a retry
+            # with the correct factory still recovers
+            geo = state.get("geometry")
+            if geo is not None and sup.wal is not None:
+                sup.wal.append("meta", dict(
+                    geo, next_rid=int(state.get("next_rid", 0))))
+                sup.wal.commit(force=True)
+            raise
         # surface the dead incarnation's black box (if it got one out)
         # so post-mortem tooling finds it next to the recovered WAL
         from ..observability import flight as _flight
@@ -1655,6 +1703,13 @@ class EngineSupervisor:
                 raise ValueError(
                     f"recover_from_disk: journaled kv_dtype="
                     f"{geo.get('kv_dtype')} != engine kv_dtype={kv}")
+            draft = _draft_identity(self.engine)
+            if geo.get("draft") != draft:
+                raise ValueError(
+                    f"recover_from_disk: journaled draft identity="
+                    f"{geo.get('draft')} != engine {draft} — the "
+                    f"factory must rebuild the same draft_layers/"
+                    f"spec_tree (the draft pool itself rebuilds cold)")
         key_data = state.get("key_data")
         if key_data is not None and key_data.size:
             import jax

@@ -28,16 +28,22 @@ Speculative decoding converts that slack into accepted tokens:
   doesn't repeat fall back to plain decode (k=0, re-probed
   periodically), so the worst case costs ≈ the baseline step.
 
-Everything here is pure host-side numpy — no jax, no device state —
-consumed by :class:`paddle_tpu.inference.ContinuousBatchingEngine`
+ISSUE 20 adds the MODEL-BASED and TREE layers on the same spine: the
+engine's truncated-layer draft model proposes tokens (linear chain or
+a :class:`TreeDraft` comb), verification still rides one paged forward
+(linear: real-q :func:`rejection_sample_tokens`; tree: ancestor-masked
+attention + :func:`longest_accepted_path` /
+:func:`tree_rejection_sample`). This module stays pure host-side
+numpy — no jax, no device state — consumed by
+:class:`paddle_tpu.inference.ContinuousBatchingEngine`
 (``spec_k``/``spec_step``) and budgeted by
 :class:`~paddle_tpu.serving.policy.TokenBudgetPlanner` (a verify with k
-drafts is charged ``1 + k`` tokens, so the step budget stays a hard
-ceiling).
+drafts — linear tokens or tree nodes alike — is charged ``1 + k``
+tokens, so the step budget stays a hard ceiling).
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -50,7 +56,8 @@ def _softmax(logits: np.ndarray) -> np.ndarray:
 
 def rejection_sample_tokens(logits: np.ndarray, drafts,
                             temperature: float,
-                            rng: np.random.Generator
+                            rng: np.random.Generator,
+                            q: Optional[np.ndarray] = None
                             ) -> Tuple[list, int]:
     """Standard speculative REJECTION SAMPLING (ISSUE 14), specialized
     to a deterministic draft proposer — the lift of spec decode's
@@ -65,15 +72,29 @@ def rejection_sample_tokens(logits: np.ndarray, drafts,
     ``longest_accepted_prefix + bonus`` commit.
 
     The math is the min(1, p/q) acceptance test with the corrected
-    residual distribution. The n-gram proposer is DETERMINISTIC, so its
-    draft distribution q is a point mass at the proposed token x:
-    min(1, p(x)/q(x)) = p(x), and the residual norm_+(p - q) zeroes
-    exactly the x entry of p and renormalizes. Accepting x with
-    probability p(x) and otherwise drawing from that residual emits
-    tokens distributed EXACTLY as p — the output distribution matches
-    plain sampled decode token-for-token in law (the distribution gate
-    in tests/test_adapters.py), which is what makes temperature>0
-    traffic eligible for the 1+k speculative speedup.
+    residual distribution. With ``q=None`` the proposer is taken to be
+    DETERMINISTIC (the n-gram case): its draft distribution is a point
+    mass at the proposed token x, min(1, p(x)/q(x)) = p(x), and the
+    residual norm_+(p - q) zeroes exactly the x entry of p and
+    renormalizes. With a REAL draft distribution (``q`` is a (j, V)
+    array of the draft model's sampling probabilities, row i the
+    distribution draft i was drawn from), draft i is accepted with
+    probability min(1, p(x)/q(x)) and on rejection the corrective
+    token samples the residual norm_+(p - q) — note the residual
+    subtracts the WHOLE q row, not just the x entry. Either way the
+    committed tokens are distributed EXACTLY as p — the output
+    distribution matches plain sampled decode token-for-token in law
+    (the distribution gate in tests/test_adapters.py and the real-q
+    property gates in tests/test_tree_spec.py), which is what makes
+    temperature>0 traffic eligible for the 1+k speculative speedup.
+
+    Real-q edge cases (found by the ISSUE 20 property tests):
+    q(x) <= 0 with p(x) > 0 is the limit min(1, p/q) -> 1 (accept);
+    q(x) <= 0 with p(x) == 0 rejects (the ratio's 0/0 limit along
+    q -> 0+ is p/q with p = 0). A residual that sums to <= 0 means
+    p <= q everywhere, i.e. p == q up to float fuzz (both sum to 1),
+    where acceptance is certain — treat the draft as accepted rather
+    than dividing by ~0.
 
     ``temperature == 0`` is the greedy limit: p collapses onto the
     argmax, acceptance degenerates to draft == argmax and the
@@ -87,18 +108,46 @@ def rejection_sample_tokens(logits: np.ndarray, drafts,
         targets = np.argmax(logits, axis=-1)
         a = longest_accepted_prefix(drafts, targets) if j else 0
         return [int(t) for t in drafts[:a]] + [int(targets[a])], a
+    if q is not None:
+        q = np.asarray(q, np.float64)
+        if q.ndim != 2 or q.shape[0] < j:
+            raise ValueError(
+                f"rejection_sample_tokens: q must cover all {j} drafts, "
+                f"got shape {q.shape}")
     for i in range(j):
         p = _softmax(logits[i] / temperature)
         x = int(drafts[i])
-        if rng.random() < p[x]:
+        if q is None:
+            accept_p = p[x]                       # point-mass draft
+        else:
+            qx = q[i, x]
+            if qx <= 0.0:
+                # q -> 0+ limit of min(1, p/q): certain accept when the
+                # target puts any mass on x, certain reject when p(x)=0
+                accept_p = 1.0 if p[x] > 0.0 else 0.0
+            else:
+                accept_p = min(1.0, p[x] / qx)
+        if rng.random() < accept_p:
             continue                              # accept draft i
-        resid = p.copy()
-        resid[x] = 0.0
+        if q is None:
+            resid = p.copy()
+            resid[x] = 0.0
+        else:
+            resid = np.maximum(p - q[i], 0.0)
         s = resid.sum()
         if s <= 0.0:
-            # p was (numerically) a point mass at x — the accept draw
-            # can only have failed by float fuzz; treat as accepted
-            continue
+            if q is None:
+                # p was (numerically) a point mass at x — the accept
+                # draw can only have failed by float fuzz; treat as
+                # accepted
+                continue
+            # p <= q everywhere with both summing to 1 means p == q up
+            # to float fuzz: the residual is empty and a fresh draw
+            # from p IS the exact corrective distribution (this also
+            # covers the q(x)=0, p(x)=0 reject, where x itself must
+            # not be committed)
+            tok = int(rng.choice(p.size, p=p))
+            return [int(t) for t in drafts[:i]] + [tok], i
         tok = int(rng.choice(resid.size, p=resid / s))
         return [int(t) for t in drafts[:i]] + [tok], i
     # every draft accepted: the bonus token samples from the
@@ -272,3 +321,210 @@ class Speculator:
             "spec_verify_steps": self.verify_steps,
             "spec_acceptance_rate": round(self.acceptance_rate, 4),
         }
+
+
+# ---------------------------------------------------------------------------
+# Tree speculation (ISSUE 20): token trees, ancestor masks, path acceptance
+# ---------------------------------------------------------------------------
+
+
+class TreeDraft:
+    """A per-row token tree proposal: node 0 is the ROOT (the row's
+    last committed token, re-scored just like ``chunk[:, 0]`` on the
+    linear path) and nodes 1..n-1 are draft tokens. Topology is encoded
+    as per-node parent indices with ``parents[0] == -1`` and
+    ``parents[i] < i`` (parents precede children), so any PREFIX of the
+    node list is itself a valid tree.
+
+    The node ORDER is the budget-trim contract: the root path (the
+    draft model's top-1 chain) comes first, then sibling leaves in
+    decreasing priority. ``d.size`` is the DRAFT node count (n - 1,
+    the extra verify positions the row charges against the token
+    budget — same accounting as a linear draft of that length), and
+    ``d[:k]`` keeps the first k draft nodes, so when
+    :class:`~paddle_tpu.serving.policy.TokenBudgetPlanner` trims a
+    row's width it sheds sibling leaves and chain tail first and the
+    root-path prefix always survives — the planner and scheduler use
+    exactly the ``.size`` / ``[:k]`` surface they already use for
+    linear ``np.ndarray`` drafts and need no tree awareness."""
+
+    __slots__ = ("tokens", "parents")
+
+    def __init__(self, tokens, parents):
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.parents = np.asarray(parents, np.int32).reshape(-1)
+        n = self.tokens.size
+        if self.parents.size != n or n < 1:
+            raise ValueError(
+                f"TreeDraft: need matching non-empty tokens/parents, "
+                f"got {self.tokens.size}/{self.parents.size}")
+        if self.parents[0] != -1 or (n > 1 and not (
+                (self.parents[1:] >= 0)
+                & (self.parents[1:] < np.arange(1, n))).all()):
+            raise ValueError(
+                "TreeDraft: parents must be topological (parents[0] "
+                f"== -1, parents[i] < i), got {self.parents.tolist()}")
+
+    @property
+    def size(self) -> int:
+        """Draft-node count (excludes the root) — the token-budget
+        charge, mirroring ``np.ndarray.size`` of a linear draft."""
+        return int(self.tokens.size - 1)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, key) -> "TreeDraft":
+        """``d[:k]`` keeps the first k DRAFT nodes (plus the root).
+        Only leading slices are meaningful — the chain-first node order
+        makes every such prefix parent-closed."""
+        if not isinstance(key, slice) or key.start not in (None, 0) \
+                or key.step not in (None, 1):
+            raise TypeError("TreeDraft supports only leading slices "
+                            "([:k]) — the budget-trim contract")
+        k = self.size if key.stop is None else max(0, min(
+            int(key.stop), self.size))
+        return TreeDraft(self.tokens[:k + 1], self.parents[:k + 1])
+
+    def depths(self) -> np.ndarray:
+        return tree_depths(self.parents)
+
+    def __repr__(self):
+        return (f"TreeDraft(n={self.tokens.size}, "
+                f"depth={int(self.depths().max())})")
+
+
+def build_comb_tree(root_token: int, chain, siblings=None) -> TreeDraft:
+    """Assemble the draft model's proposal into the COMB topology the
+    engine verifies: a top-1 chain ``chain[0..d-1]`` hanging off the
+    root, plus optional sibling leaves — ``siblings[i]`` are the
+    lower-ranked alternatives to ``chain[i]``, children of the same
+    parent (chain node i, i.e. the root for i = 0). Chain nodes are
+    emitted first, then siblings by depth, so budget trimming drops
+    the deepest-priority leaves first and the chain tail last."""
+    chain = np.asarray(chain, np.int32).reshape(-1)
+    tokens = [int(root_token)] + [int(t) for t in chain]
+    parents = [-1] + list(range(chain.size))
+    for d, sib in enumerate(siblings or ()):
+        if d >= chain.size:
+            break
+        for t in np.asarray(sib, np.int32).reshape(-1):
+            tokens.append(int(t))
+            parents.append(d)                     # same parent as chain[d]
+    return TreeDraft(tokens, parents)
+
+
+def tree_depths(parents: np.ndarray) -> np.ndarray:
+    """Per-node depth (root = 0) — the verify position offset of each
+    node: node i scores at sequence position ``lengths + depth[i]``."""
+    parents = np.asarray(parents, np.int64).reshape(-1)
+    depth = np.zeros(parents.size, np.int32)
+    for i in range(1, parents.size):
+        depth[i] = depth[parents[i]] + 1
+    return depth
+
+
+def tree_ancestor_matrix(parents: np.ndarray) -> np.ndarray:
+    """(n, n) bool ancestor-or-self matrix: ``anc[i, j]`` iff node j
+    lies on the root path of node i (including i == j). Row i is node
+    i's attention allowance over the in-flight tree chunk — the mask
+    :func:`paddle_tpu.models.generate.paged_verify_forward` folds into
+    flash_chunk_attention. For a pure chain this is lower-triangular
+    ones, i.e. exactly the causal mask the linear verify path already
+    applies (the parity anchor in tests/test_tree_spec.py)."""
+    parents = np.asarray(parents, np.int64).reshape(-1)
+    n = parents.size
+    anc = np.eye(n, dtype=bool)
+    for i in range(1, n):
+        anc[i] = anc[parents[i]]
+        anc[i, i] = True
+    return anc
+
+
+def longest_accepted_path(tokens: np.ndarray, parents: np.ndarray,
+                          targets: np.ndarray
+                          ) -> Tuple[List[int], List[int], int]:
+    """Greedy tree acceptance: walk from the root, at each accepted
+    node following the child whose token equals that node's greedy
+    verify target (``targets[i]`` = argmax of the logits scored at
+    node i, i.e. the token plain greedy decode would emit after node
+    i's root path). The first node with no matching child contributes
+    the target as the BONUS token. Returns ``(path, committed,
+    accepted)`` where ``path`` is the node-index root path (starting
+    at 0), ``committed`` the ``accepted + 1`` tokens to commit —
+    token-identical to plain greedy decode by construction: every
+    committed token is the argmax conditioned on exactly the committed
+    prefix."""
+    tokens = np.asarray(tokens, np.int64).reshape(-1)
+    parents = np.asarray(parents, np.int64).reshape(-1)
+    targets = np.asarray(targets, np.int64).reshape(-1)
+    children: List[List[int]] = [[] for _ in range(tokens.size)]
+    for i in range(1, parents.size):
+        children[int(parents[i])].append(i)
+    v, path, committed = 0, [0], []
+    while True:
+        t = int(targets[v])
+        nxt = next((c for c in children[v] if int(tokens[c]) == t), None)
+        committed.append(t)
+        if nxt is None:
+            return path, committed, len(path) - 1
+        v = nxt
+        path.append(v)
+
+
+def tree_rejection_sample(tokens: np.ndarray, parents: np.ndarray,
+                          logits: np.ndarray, temperature: float,
+                          rng: np.random.Generator
+                          ) -> Tuple[List[int], List[int], int]:
+    """Sampled tree acceptance (multi-draft point-mass rejection):
+    walk from the root; at node v with target distribution p =
+    softmax(logits[v] / T), try v's children IN ORDER — child c with
+    token x is accepted with probability p_cur(x); on rejection x is
+    zeroed out of p_cur and the remainder renormalized (the point-mass
+    residual, exactly :func:`rejection_sample_tokens` with q = a point
+    mass per sibling). If no child accepts, the corrective token
+    samples the final residual; if the walk reaches a leaf, the bonus
+    token samples that leaf's own target distribution. Sequentially
+    peeling point masses this way keeps the committed-token law EXACTLY
+    plain sampled decode regardless of how the tree was proposed (the
+    distribution gate in tests/test_tree_spec.py). The draft model's
+    real q sharpens acceptance only on the LINEAR path, where each
+    position has a single draft drawn from q.
+
+    ``temperature == 0`` degenerates to :func:`longest_accepted_path`.
+    Returns ``(path, committed, accepted)`` like the greedy walk."""
+    tokens = np.asarray(tokens, np.int64).reshape(-1)
+    parents = np.asarray(parents, np.int64).reshape(-1)
+    logits = np.asarray(logits, np.float64)
+    if temperature == 0.0:
+        return longest_accepted_path(
+            tokens, parents, np.argmax(logits, axis=-1))
+    children: List[List[int]] = [[] for _ in range(tokens.size)]
+    for i in range(1, parents.size):
+        children[int(parents[i])].append(i)
+    v, path, committed = 0, [0], []
+    while True:
+        p = _softmax(logits[v] / temperature)
+        nxt = None
+        for c in children[v]:
+            x = int(tokens[c])
+            if rng.random() < p[x]:
+                nxt = c
+                break
+            p[x] = 0.0
+            s = p.sum()
+            if s <= 0.0:
+                # residual emptied by float fuzz: p was (numerically) a
+                # point mass on the rejected siblings — acceptance was
+                # certain in exact arithmetic, take this child
+                nxt = c
+                break
+            p = p / s
+        if nxt is None:
+            # all children rejected (or leaf): corrective/bonus token
+            # from the current (residual) distribution
+            committed.append(int(rng.choice(p.size, p=p)))
+            return path, committed, len(path) - 1
+        committed.append(int(tokens[nxt]))
+        v = nxt
+        path.append(v)

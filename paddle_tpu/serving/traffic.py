@@ -102,7 +102,8 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
                 deadline_s: tuple = (0.5, 2.0),
                 priority_weights=(0.2, 0.6, 0.2),
                 adapters: int = 0,
-                adapter_zipf: float = 1.2) -> List[TraceRequest]:
+                adapter_zipf: float = 1.2,
+                text: bool = False) -> List[TraceRequest]:
     """Generate a seeded open-loop trace.
 
     Arrivals draw from a non-homogeneous Poisson process by thinning:
@@ -128,7 +129,19 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
     slot-reclaim/demote/promote path) — and every request of that
     tenant carries it, so the trace drives adapter affinity and slot
     residency through the same open-loop arrivals as everything else.
-    0 (default) leaves every request on the base model."""
+    0 (default) leaves every request on the base model.
+
+    ``text`` (ISSUE 20): NON-REPETITIVE text mode. Every prompt —
+    system prefix AND tail — is drawn WITHOUT REPLACEMENT from a
+    Zipf-weighted token population (head-heavy marginals like natural
+    prose, but no token ever occurs twice in one prompt), so an
+    in-context n-gram lookup finds NOTHING to draft from by
+    construction. This is the scoreboard workload for model-based
+    draft/tree speculation: the prompt-lookup proposer's acceptance
+    rounds to zero here while a draft model's does not — exactly the
+    traffic where speculation pays most and PR 5's proposer pays
+    least. Requires ``vocab >= prefix_pages*page_size +
+    tail_tokens[1]``."""
     if duration_s <= 0 or base_rps <= 0:
         raise ValueError(
             f"synth_trace: duration_s={duration_s} and base_rps="
@@ -136,11 +149,34 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
     if adapters < 0:
         raise ValueError(f"synth_trace: adapters={adapters} must be "
                          f">= 0")
+    plen = prefix_pages * page_size
+    if text and vocab - 3 < plen + tail_tokens[1]:
+        raise ValueError(
+            f"synth_trace: text mode needs vocab >= "
+            f"{3 + plen + tail_tokens[1]} (prefix {plen} + tail "
+            f"{tail_tokens[1]} distinct tokens), got {vocab}")
     rs = np.random.RandomState(seed)
-    sys_prompts = {
-        t: rs.randint(3, vocab, (prefix_pages * page_size,)).astype(
-            np.int32)
-        for t in range(tenants)}
+    if text:
+        # Zipf marginals over a seeded permutation of the usable ids
+        # (so popularity is decoupled from token-id order), sampled
+        # WITHOUT replacement per prompt — head-heavy like prose, but
+        # zero in-context repetition for an n-gram lookup to find
+        ids = rs.permutation(np.arange(3, vocab, dtype=np.int32))
+        zw = np.arange(1, ids.size + 1, dtype=np.float64) ** -1.1
+        zw /= zw.sum()
+        sys_prompts = {
+            t: rs.choice(ids, size=plen, replace=False, p=zw).astype(
+                np.int32)
+            for t in range(tenants)}
+        tail_pool = {}
+        for t in range(tenants):
+            keep = ~np.isin(ids, sys_prompts[t])
+            w = zw[keep]
+            tail_pool[t] = (ids[keep], w / w.sum())
+    else:
+        sys_prompts = {
+            t: rs.randint(3, vocab, (plen,)).astype(np.int32)
+            for t in range(tenants)}
     tenant_adapter = {t: 0 for t in range(tenants)}
     if adapters:
         ranks = np.arange(1, adapters + 1,
@@ -169,8 +205,13 @@ def synth_trace(seed: int = 0, *, duration_s: float = 4.0,
         if rs.random_sample() >= rate(t) / peak:
             continue
         tenant = int(rs.randint(tenants))
-        tail = rs.randint(3, vocab, (int(rs.randint(
-            tail_tokens[0], tail_tokens[1] + 1)),)).astype(np.int32)
+        nt = int(rs.randint(tail_tokens[0], tail_tokens[1] + 1))
+        if text:
+            pool, pw = tail_pool[tenant]
+            tail = rs.choice(pool, size=nt, replace=False,
+                             p=pw).astype(np.int32)
+        else:
+            tail = rs.randint(3, vocab, (nt,)).astype(np.int32)
         prio = int(rs.choice(
             [int(Priority.HIGH), int(Priority.NORMAL),
              int(Priority.LOW)], p=np.asarray(priority_weights)

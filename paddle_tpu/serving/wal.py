@@ -528,7 +528,7 @@ def recover_state(path: str, repair: bool = True) -> Dict:
         state["next_rid"] = int(meta.get("next_rid", 0))
         state["geometry"] = {k: meta.get(k) for k in
                              ("page_size", "max_len", "max_batch",
-                              "kv_dtype", "constraints")}
+                              "kv_dtype", "constraints", "draft")}
         kd = ck["arrays"].get("key_data")
         if kd is not None and kd.size:
             state["key_data"] = kd
@@ -560,7 +560,7 @@ def recover_state(path: str, repair: bool = True) -> Dict:
         if kind == "meta":
             state["geometry"] = {k: rec.get(k) for k in
                                  ("page_size", "max_len", "max_batch",
-                                  "kv_dtype", "constraints")}
+                                  "kv_dtype", "constraints", "draft")}
             state["next_rid"] = max(state["next_rid"],
                                     int(rec.get("next_rid", 0)))
         elif kind == "submit":

@@ -91,9 +91,11 @@ def pytest_collection_modifyitems(config, items):
             return 4
         if "test_tp2d" in path:
             return 5
-        if "test_multiproc" in path:    # ISSUE 19: newest, dead last
-            return 6                    # (also the only spawner of
-        return None                     # worker process trees)
+        if "test_multiproc" in path:    # ISSUE 19 (the only spawner
+            return 6                    # of worker process trees)
+        if "test_tree_spec" in path:    # ISSUE 20: newest, dead last
+            return 7
+        return None
     tail = sorted((it for it in rest if _tail_rank(it) is not None),
                   key=_tail_rank)
     if tail and tail != rest:

@@ -976,6 +976,141 @@ def validate_serving_lowbit(n: int, batch_mult: int = 1):
     }
 
 
+def validate_serving_treespec(n: int, batch_mult: int = 1):
+    """ISSUE 20 tree-speculation lowering gate: Mosaic-lower the
+    programs the model-based draft + tree speculation path leaves on
+    device — (a) the TREE-MASKED flash chunk/verify kernel (the
+    ancestor-bitmask variant of ``flash_chunk_attention_kernel``) at
+    serving-realistic shapes, fp AND int8 temp cache, requiring the
+    Mosaic ``tpu_custom_call``; (b) the full fused one-forward tree
+    verify program (``paged_verify_forward`` in tree mode) over fp and
+    int8-KV pools; (c) the DRAFT-MODEL decode step — the truncated-
+    layer params from ``make_draft_params`` through the fused paged
+    decode program against the second (draft) pool; (d) the tree
+    commit program (``paged_tree_commit`` — gather accepted root-path
+    rows, scatter into the main pool). The interpret-green-but-won't-
+    lower failure mode, gated for the tree path before a chip ever
+    sees it."""
+    import time
+    import numpy as np
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama, generate as gen
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import serving_fused as sf
+    from paddle_tpu.serving.speculative import (build_comb_tree,
+                                                tree_ancestor_matrix,
+                                                tree_depths)
+
+    t0 = time.monotonic()
+    rs = np.random.RandomState(0)
+    lowered = {}
+
+    # one realistic comb-tree topology, shared by every stage: width 2,
+    # depth 4 -> T = 9 nodes (root + chain + siblings), inside the
+    # kernel's 32-node int32 ancestor-bitmask bound
+    w, d = 2, 4
+    T = 1 + w * d
+    tr = build_comb_tree(
+        5, np.arange(10, 10 + d, dtype=np.int32),
+        [np.arange(50 + i, 50 + i + w - 1, dtype=np.int32)
+         for i in range(d)])
+    depths1 = tree_depths(tr.parents).astype(np.int32)
+    anc1 = tree_ancestor_matrix(tr.parents)
+
+    # (a) op-level tree-masked flash kernel, serving-realistic shapes
+    B, W, HK, D = 8, 256, 4, 128
+    qc = jnp.asarray(rs.randn(B, T, 32, D), jnp.bfloat16)
+    ck = jnp.asarray(rs.randn(B, W, HK, D), jnp.bfloat16)
+    kst = jnp.asarray(rs.randint(0, W - T, (B,)), jnp.int32)
+    anc = jnp.asarray(np.broadcast_to(anc1, (B, T, T)))
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda q, ck, cv, kst, tm:
+                    sf.flash_chunk_attention_kernel(q, ck, cv, W, kst,
+                                                    tree_mask=tm)),
+            platforms=["tpu"])(qc, ck, ck, kst, anc)
+    lowered["flash_tree_fp"] = "tpu_custom_call" in exp.mlir_module()
+    c8 = jnp.asarray(rs.randint(-127, 128, (B, W, HK, D)), jnp.int8)
+    rows = jnp.asarray(rs.rand(B, W, HK), jnp.float32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda q, ck, cv, kst, kr, vr, tm:
+                    sf.flash_chunk_attention_kernel(
+                        q, ck, cv, W, kst, k_rows=kr, v_rows=vr,
+                        tree_mask=tm)),
+            platforms=["tpu"])(qc, c8, c8, kst, rows, rows, anc)
+    lowered["flash_tree_int8"] = "tpu_custom_call" in exp.mlir_module()
+
+    # (b) full fused tree-verify program, tiny config, fp + int8-KV
+    cfg = llama.LlamaConfig.tiny(num_layers=2, max_seq_len=256)
+    params = llama.init_params(jax.random.key(0), cfg)
+    pg = 16
+    tables = jnp.asarray(rs.randint(1, B * 4, (B, 256 // pg)), jnp.int32)
+    lens = jnp.asarray(rs.randint(1, 60, (B,)), jnp.int32)
+    msk = jnp.asarray(rs.rand(B) > 0.5)
+    toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    dep = jnp.asarray(np.broadcast_to(depths1, (B, T)))
+
+    def export_tree_verify(tag, kv=None):
+        pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg)
+                                    + 1, page_size=pg, kv_dtype=kv)
+        with fa.force_compiled_lowering():
+            exp = jax.export.export(
+                jax.jit(lambda p, t, pl_, bt_, ln_, m, dp_, tm:
+                        gen.paged_verify_forward(
+                            p, t, pl_, bt_, ln_, cfg, ctx_cap=128,
+                            active=m, use_kernel=True, fused=True,
+                            tree_depth=dp_, tree_mask=tm)),
+                platforms=["tpu"])(params, toks, pool, tables, lens,
+                                   msk, dep, anc)
+        lowered[tag] = "tpu_custom_call" in exp.mlir_module()
+
+    export_tree_verify("tree_verify_step_fp")
+    export_tree_verify("tree_verify_step_int8kv", kv="int8")
+
+    # (c) draft-model decode step: truncated-layer params against the
+    # second (draft) paged pool through the fused decode program
+    dparams, dcfg = gen.make_draft_params(params, cfg, 1)
+    dpool = gen.init_paged_cache(dcfg, num_pages=B * (256 // pg) + 1,
+                                 page_size=pg)
+    dt = jnp.asarray(rs.randint(0, cfg.vocab_size, (B,)), jnp.int32)
+    with fa.force_compiled_lowering():
+        exp = jax.export.export(
+            jax.jit(lambda p, t, pl_, bt_, ln_, m:
+                    gen.paged_decode_forward(
+                        p, t, pl_, bt_, ln_, dcfg, active=m,
+                        use_kernel=True, fused=True)),
+            platforms=["tpu"])(dparams, dt, dpool, tables, lens, msk)
+    lowered["draft_decode_step"] = "tpu_custom_call" in exp.mlir_module()
+
+    # (d) the tree commit program (pure gather/scatter — no kernel to
+    # find, the gate is that it EXPORTS for the platform)
+    pool = gen.init_paged_cache(cfg, num_pages=2 * B * (256 // pg) + 1,
+                                page_size=pg)
+    rows_kv = {nm: jnp.zeros((cfg.num_layers, B, T)
+                             + a.shape[3:], a.dtype)
+               for nm, a in pool.items()}
+    pn = jnp.asarray(rs.randint(0, T, (B, T)), jnp.int32)
+    pl = jnp.asarray(rs.randint(0, d + 1, (B,)), jnp.int32)
+    jax.export.export(
+        jax.jit(lambda pool, r, bt_, ln_, n, l:
+                gen.paged_tree_commit(pool, r, bt_, ln_, n, l),
+                donate_argnums=(0,)),
+        platforms=["tpu"])(pool, rows_kv, tables, lens, pn, pl)
+    lowered["tree_commit"] = True
+
+    ok = all(lowered.values())
+    return {
+        "config": "serving_treespec_lowering",
+        "compile_s": round(time.monotonic() - t0, 1),
+        "tree": {"width": w, "depth": d, "nodes": T},
+        "lowered": lowered,
+        **({} if ok else {"fits_v5p": False}),
+    }
+
+
 def validate_serving_async(n: int, batch_mult: int = 1):
     """ISSUE 12 overlapped-runtime lowering gate: Mosaic-lower the
     programs the double-buffered scheduler leaves IN FLIGHT — the
@@ -1369,6 +1504,8 @@ def _impl(args) -> int:
         emit(validate_serving_host(args.devices, args.batch_mult))
     if args.config in ("serving-lowbit", "all"):
         emit(validate_serving_lowbit(args.devices, args.batch_mult))
+    if args.config in ("serving-treespec", "all"):
+        emit(validate_serving_treespec(args.devices, args.batch_mult))
     if args.config in ("serving-async", "all"):
         emit(validate_serving_async(args.devices, args.batch_mult))
     if args.config in ("serving-adapters", "all"):
@@ -1390,6 +1527,7 @@ def main():
                              "serving", "serving-tp", "serving-tp2d",
                              "serving-cluster",
                              "serving-host", "serving-lowbit",
+                             "serving-treespec",
                              "serving-async", "serving-adapters",
                              "serving-wal", "all"],
                     default="all")

@@ -8,8 +8,10 @@ speculative verify, priority preemption — through an
 faults across EVERY hot-path site (allocator alloc/free, decode /
 prefill-chunk / verify execution, device→host transfer, scheduler
 tick, host-tier swap out/in, the overlapped runtime's dispatch/commit
-seams — ISSUE 12 — and the adapter plane's load/promote sites with
-multi-LoRA traffic live — ISSUE 14; raise + stall + corrupt modes),
+seams — ISSUE 12 — the adapter plane's load/promote sites with
+multi-LoRA traffic live — ISSUE 14 — and the draft-model tree
+speculation plane's propose/verify sites via a second supervised
+engine — ISSUE 20; raise + stall + corrupt modes),
 then asserts the invariants that make recovery trustworthy:
 
 - **zero lost requests** — every submitted request finishes with a
@@ -214,6 +216,20 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                 inj.arm(site, "raise", nth=2)
             elif site == "swap_in":
                 inj.arm(site, "raise", nth=1)
+            elif site == "tree_verify":
+                # visited only by the ISSUE 20 tree interlude below:
+                # the FIRST one-forward tree verify eats the shot —
+                # it fires BEFORE the verify launches, so nothing
+                # committed and recovery rebuilds the draft pool cold
+                inj.arm(site, "raise", nth=1)
+            elif site == "draft_propose":
+                # the first propose must succeed (the interlude needs
+                # at least one full propose->verify->commit round and
+                # a rejection cascade against a LIVE draft pool before
+                # a fault tears it down); the recover_after=2 tree
+                # supervisor climbs back to healthy fast enough for
+                # the second propose to eat the shot
+                inj.arm(site, "raise", nth=2)
             elif site == "adapter_load":
                 # fires once per FRESH registry load (a handful per
                 # soak, not per step): the first load must succeed so
@@ -375,6 +391,63 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
                     steps += 1
                     if steps >= max_steps:
                         raise SoakError("swap drill did not drain")
+            # ---- draft-model TREE speculation interlude (ISSUE 20):
+            # a SECOND supervised engine on the same injector — the
+            # truncated-layer draft model proposes token trees, one
+            # forward verifies them, and the armed draft_propose /
+            # tree_verify shots (both fire BEFORE any commit) land
+            # mid-traffic. recover_after=2 so the no_spec rung the
+            # first fault buys climbs off fast enough for the second
+            # armed site to be visited again before the drain.
+            # References are computed after the injector uninstalls,
+            # on the plain reference engine: tree speculation is
+            # token-identical to plain decode, so the standing parity
+            # gate doubles as the tree-identity gate under fault fire.
+            def tree_factory():
+                return ContinuousBatchingEngine(
+                    params, cfg, max_batch=3, page_size=8, max_len=48,
+                    prefill_chunk=8, draft_layers=1, spec_tree=(2, 2),
+                    overlap=True)
+
+            tsup = EngineSupervisor(
+                tree_factory, watchdog_s=2.0, backoff_s=0.0,
+                sleep=lambda s: None, circuit_threshold=10,
+                recover_after=2,
+                wal_dir=tempfile.mkdtemp(prefix="chaos_tree_wal_"),
+                checkpoint_every=16, wal_kw=dict(group_interval_s=0.0))
+            tree_jobs, tree_reqs = [], []
+            for i in range(8):
+                if i % 2:
+                    motif = rs.randint(3, cfg.vocab_size, (3,))
+                    p = np.tile(motif, 5).astype(np.int32)[:12]
+                else:
+                    p = rs.randint(3, cfg.vocab_size, (int(
+                        rs.randint(4, 14)),)).astype(np.int32)
+                m = int(rs.randint(4, 7))
+                while True:
+                    try:
+                        tree_reqs.append(tsup.submit(
+                            p, max_new_tokens=m))
+                        break
+                    except InjectedFault:
+                        continue
+                tree_jobs.append((p, m))
+                for _ in range(2):
+                    try:
+                        tsup.step()
+                    except EngineDead:
+                        raise SoakError(
+                            "circuit opened in tree interlude")
+                    steps += 1
+            while True:
+                try:
+                    if not tsup.step():
+                        break
+                except EngineDead:
+                    raise SoakError("circuit opened in tree interlude")
+                steps += 1
+                if steps >= max_steps:
+                    raise SoakError("tree interlude did not drain")
             # keep injecting until the fault budget is spent: top up
             # with fresh NORMAL traffic so every site stays hot (the
             # top-ups' uninterrupted references are computed AFTER the
@@ -410,6 +483,7 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
             # the ONE reference engine serves every reference run (its
             # compiled programs amortize across the whole soak)
             refs.append(ref_run(p, m))
+        tree_refs = [ref_run(p, m) for p, m in tree_jobs]
         snap = obs.REGISTRY.to_json()
     finally:
         obs.REGISTRY.clear()
@@ -444,6 +518,28 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
     if astats["num_used"] != 0 or \
             astats["allocs_total"] != astats["frees_total"]:
         raise SoakError(f"allocator unbalanced after drain: {astats}")
+    # ---- ISSUE 20 tree-interlude invariants: zero lost, streams
+    # token-identical to plain decode, and BOTH pools balanced — the
+    # draft pool drained through admits, rejection cascades, faults
+    # and cold recovery rebuilds, so a leaked draft page shows here
+    tlost = [r.rid for r in tree_reqs
+             if not r.done or r.finish_reason not in ("eos", "max_len")]
+    if tlost:
+        raise SoakError(f"tree interlude lost requests: {tlost}")
+    tmism = [r.rid for r, ref in zip(tree_reqs, tree_refs)
+             if not np.array_equal(r.output, ref)]
+    if tmism:
+        raise SoakError(f"tree-speculated streams diverged from plain "
+                        f"decode under fault fire: {tmism}")
+    talloc = tsup.engine.cache.allocator
+    if tsup.engine.cache.prefix is not None:
+        tsup.engine.cache.prefix.drop_all(talloc)
+    tstats = talloc.stats()
+    dstats = tsup.engine.draft_cache.allocator.stats()
+    if tstats["num_used"] != 0 or dstats["num_used"] != 0 or \
+            dstats["allocs_total"] != dstats["frees_total"]:
+        raise SoakError(f"tree engine pools unbalanced after drain: "
+                        f"main={tstats} draft={dstats}")
     if inj.fired_total < faults:
         raise SoakError(f"only {inj.fired_total}/{faults} faults fired")
     missing = [s for s in SITES if not inj.fired.get(s)]
@@ -471,6 +567,15 @@ def run_soak(seed: int = 0, faults: int = 50, requests: int = 24,
         "faults_fired": inj.fired_total,
         "faults_by_site": {s: n for s, n in inj.fired.items() if n},
         "recoveries": sup.recoveries,
+        "tree_interlude": {
+            "requests": len(tree_reqs),
+            "recoveries": tsup.recoveries,
+            "draft_propose_fired": int(inj.fired.get(
+                "draft_propose", 0)),
+            "tree_verify_fired": int(inj.fired.get("tree_verify", 0)),
+            "draft_pool": {k: dstats[k] for k in
+                           ("allocs_total", "frees_total", "num_used")},
+        },
         "supervised_steps": sup.stats()["supervised_steps"],
         "final_degraded_mode": sup.degraded_mode,
         "allocator": {k: astats[k] for k in
@@ -883,13 +988,17 @@ def _crashy(sup):
 
 
 def _sweep_env(kv_cache_dtype=None, tp=None, constrained=False,
-               spec_k=2):
+               spec_k=2, tree=False):
     """One crash-sweep environment: config/params (optionally
     tp-sharded), an engine factory (host tier + adapters + either
     speculation or constrained decoding — the two compose everywhere
     except spec×constraints, which the engine rejects), the job list
     that visits every engine fault site, and per-job uninterrupted
-    references."""
+    references. ``tree=True`` (ISSUE 20) swaps the host-speculator
+    engine for a draft-model TREE-speculation one, so the
+    ``draft_propose``/``tree_verify`` sites get organic per-step
+    visits — its references are still exact for every site's recovery
+    because tree speculation is token-identical to plain decode."""
     import jax
     from paddle_tpu.models import llama
     from paddle_tpu.inference import ContinuousBatchingEngine
@@ -920,6 +1029,8 @@ def _sweep_env(kv_cache_dtype=None, tp=None, constrained=False,
                                 store=HostPageStore(page_size=8)))
         if constrained:
             kw["constraints"] = True
+        elif tree:
+            kw.update(spec_k=2, draft_layers=1, spec_tree=(2, 2))
         else:
             kw.update(spec_k=spec_k, speculator=_speculator(spec_k))
         return ContinuousBatchingEngine(params, cfg, **kw)
@@ -972,17 +1083,31 @@ def run_crash_sweep(sites=None, kv_cache_dtype=None, tp=None,
                                     InjectedFault)
     from paddle_tpu.serving.resilience import ENGINE_SITES
 
-    factory, jobs, refs, _dfa = _sweep_env(
-        kv_cache_dtype=kv_cache_dtype, tp=tp, constrained=constrained)
+    # the draft_propose / tree_verify sites (ISSUE 20) only execute on
+    # a draft-model tree-speculation engine, so the sweep swaps in the
+    # tree environment for exactly those sites (built lazily — a
+    # sites= list that never names them pays nothing); everything else
+    # keeps the host-speculator env. References are interchangeable:
+    # both engines are token-identical to plain decode.
+    tree_sites = ("draft_propose", "tree_verify")
+    envs = {False: _sweep_env(
+        kv_cache_dtype=kv_cache_dtype, tp=tp, constrained=constrained)}
     if sites is None:
         sites = list(ENGINE_SITES)
         if constrained:
-            # a constrained engine rejects spec_k > 0, so the verify
-            # program never runs — the speculative sweep owns that site
-            sites = [s for s in sites if s != "verify_step"]
+            # a constrained engine rejects spec_k > 0, so neither the
+            # verify program nor the draft/tree path ever runs — the
+            # speculative sweep owns those sites
+            sites = [s for s in sites
+                     if s not in ("verify_step",) + tree_sites]
     root = wal_root or tempfile.mkdtemp(prefix="crash_sweep_")
     per_site = {}
     for site in sites:
+        tree = site in tree_sites
+        if tree and tree not in envs:
+            envs[tree] = _sweep_env(kv_cache_dtype=kv_cache_dtype,
+                                    tp=tp, tree=True)
+        factory, jobs, refs, _dfa = envs[tree]
         wd = os.path.join(root, f"{site}-{kv_cache_dtype or 'fp'}"
                           + (f"-tp{tp}" if tp else "")
                           + ("-con" if constrained else ""))
@@ -1083,6 +1208,11 @@ def run_crash_sweep(sites=None, kv_cache_dtype=None, tp=None,
         if st["num_used"] != 0:
             raise SoakError(f"[{site}] allocator unbalanced after "
                             f"drain: {st}")
+        if sup.engine.draft_cache is not None:
+            dst = sup.engine.draft_cache.allocator.stats()
+            if dst["num_used"] != 0:
+                raise SoakError(f"[{site}] DRAFT pool unbalanced "
+                                f"after drain: {dst}")
         per_site[site] = {"deaths": deaths,
                           "fired": int(inj.fired[site]),
                           "flight_dumps": len(dumps),

@@ -93,6 +93,30 @@ REQUIRED = {
         # both commit paths (decode AND spec verify) — the only view
         # of planner skew across the dp row blocks
         ("_obs.serving_dp_step(", 2),
+        # model-based draft + tree speculation (ISSUE 20): the propose
+        # counters (rows/drafted/catch-up tokens), the draft-pool
+        # occupancy gauge pair, and the fence-anchored tree-verify span
+        # with its path-length/acceptance histograms — the
+        # decode_treespec bench tier's only inputs; plus the two new
+        # fault sites, both firing BEFORE any state commits (a killed
+        # propose or verify must leave lengths/pools untouched)
+        ("_obs.serving_draft_propose(", 1),
+        ("_obs.serving_draft_pool(", 1),
+        ("_obs.serving_tree_verify(", 1),
+        ('_fault_point("draft_propose")', 1),
+        ('_fault_point("tree_verify")', 1),
+    ],
+    "paddle_tpu/observability/hooks.py": [
+        # the ISSUE 20 hook families themselves: the predictor entries
+        # above only prove the CALL sites exist — these prove the hook
+        # layer still defines them (a hooks.py refactor that drops one
+        # def would turn every call site into an AttributeError only
+        # at serve time, with metrics enabled)
+        ("def serving_draft_propose(", 1),
+        ("def serving_draft_pool(", 1),
+        ("def serving_tree_verify(", 1),
+        ("serving_tree_path_len", 1),
+        ("serving_tree_acceptance_rate", 1),
     ],
     "paddle_tpu/serving/scheduler.py": [
         # SLO-scheduler hot path (ISSUE 4): time-in-queue histogram on
@@ -425,9 +449,16 @@ def check_fault_sites(root: str) -> list:
 #: pipeline silently degrades back to a synchronous chain.
 _SYNC_FREE = {
     "paddle_tpu/serving/scheduler.py": None,
+    # _tree_dispatch launches the one-forward tree verify and must not
+    # fetch its logits or KV rows (both ride the InFlightStep to
+    # _tree_commit); _propose_model_drafts is deliberately NOT listed —
+    # the draft loop is sequential by construction (each draft token
+    # feeds the next step), so its per-step logits fetch is the design,
+    # not a regression
     "paddle_tpu/inference/predictor.py": (
         "decode_dispatch", "spec_dispatch", "prefill_dispatch",
-        "ready_mask", "propose_drafts", "spec_plan_widths"),
+        "ready_mask", "propose_drafts", "spec_plan_widths",
+        "_tree_dispatch"),
     # the tracing layer (ISSUE 16) runs INSIDE the hot path on every
     # span close — it must never fetch a device value or fence; its
     # zero-device-syncs contract is what lets call sites fire between

@@ -16,7 +16,11 @@ Prints one JSON line:
    "decode_prefix_tokens_per_sec": ..., "decode_sched_tokens_per_sec": ...,
    "decode_sched_step_ms": {"p50_step_ms": ..., "p99_step_ms": ...},
    "decode_spec_tokens_per_sec": ...,
-   "decode_spec_acceptance": {"acceptance_rate": ..., ...},
+   "decode_spec_acceptance": {"acceptance_rate": ...,
+                              "nonrepetitive": {...}, ...},
+   "decode_treespec_tokens_per_sec": ...,
+   "decode_treespec_stats": {"tree_width": ..., "depth": ...,
+                             "mean_accepted_path": ..., ...},
    "decode_tp_tokens_per_sec": ...,
    "decode_tp_scaling": {"tp": 4, "vs_single_chip": ...},
    "decode_int8_tokens_per_sec": ..., "decode_int4_tokens_per_sec": ...,
@@ -162,6 +166,17 @@ def main():
         return tps
     run_tier("decode_spec_tokens_per_sec", _spec)
 
+    # model-based draft + tree speculation (ISSUE 20): truncated-layer
+    # draft model + one-forward tree verify on the NON-repetitive
+    # text-mode trace — the {tree_width, depth, mean_accepted_path}
+    # rider rides next to the throughput it explains
+    def _treespec():
+        tps, stats = bench_mod.treespec_decode_tier(
+            params, cfg, db, dp_len, dnew, on_tpu)
+        out["decode_treespec_stats"] = stats
+        return tps
+    run_tier("decode_treespec_tokens_per_sec", _treespec)
+
     # tensor-parallel paged serving (ISSUE 7): the mixed-length paged
     # workload over a tp=4 serving mesh, with the aggregate-vs-single-
     # chip scaling factor riding the record (needs >= 4 devices — a
@@ -262,7 +277,8 @@ def main():
     out.update({k: tiers.get(k) for k in (
         "decode_tokens_per_sec", "decode_paged_tokens_per_sec",
         "decode_prefix_tokens_per_sec", "decode_sched_tokens_per_sec",
-        "decode_spec_tokens_per_sec", "decode_tp_tokens_per_sec",
+        "decode_spec_tokens_per_sec",
+        "decode_treespec_tokens_per_sec", "decode_tp_tokens_per_sec",
         "decode_tp2d_tokens_per_sec",
         "decode_cluster_tokens_per_sec",
         "decode_offload_tokens_per_sec",

@@ -81,6 +81,7 @@ if dec.get("decode_tokens_per_sec") is not None:
               "decode_prefix_tokens_per_sec",
               "decode_sched_tokens_per_sec",
               "decode_spec_tokens_per_sec",
+              "decode_treespec_tokens_per_sec",
               "decode_tp_tokens_per_sec",
               "decode_tp2d_tokens_per_sec",
               "decode_cluster_tokens_per_sec",
@@ -118,6 +119,7 @@ if dec.get("decode_tokens_per_sec") is not None:
     # rate (ISSUE 5 — the number that explains the tput) and the paged
     # tier's fused-kernel speedup (ISSUE 11)
     for rider in ("decode_sched_step_ms", "decode_spec_acceptance",
+                  "decode_treespec_stats",
                   "decode_tp_scaling", "decode_tp2d_scaling",
                   "decode_cluster_scaling",
                   "decode_multiproc_overhead",
